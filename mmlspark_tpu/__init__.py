@@ -1,0 +1,13 @@
+"""mmlspark_tpu — TPU-native framework with the capabilities of MMLSpark.
+
+Compute path: JAX / XLA / Pallas / pjit. Public API: SparkML-shaped
+Estimator/Transformer/Pipeline stages over a columnar DataFrame.
+"""
+
+__version__ = "0.1.0"
+
+from .core import (DataFrame, Pipeline, PipelineModel, Transformer, Estimator,
+                   Model, load_stage)
+
+__all__ = ["DataFrame", "Pipeline", "PipelineModel", "Transformer",
+           "Estimator", "Model", "load_stage", "__version__"]

@@ -1,0 +1,22 @@
+from .dataframe import DataFrame, Row, GroupedData
+from .param import (Param, Params, ComplexParam, TypeConverters, StageParam,
+                    StageListParam, DataFrameParam, ArrayParam, UDFParam,
+                    ServiceParam)
+from .pipeline import (PipelineStage, Transformer, Estimator, Model, Pipeline,
+                       PipelineModel, ml_transform, ml_fit)
+from .serialize import load_stage, register_stage
+from .utils import (ClusterUtil, StopWatch, retry_with_timeout,
+                    find_unused_column_name, as_2d_features)
+from . import contracts
+
+__all__ = [
+    "DataFrame", "Row", "GroupedData",
+    "Param", "Params", "ComplexParam", "TypeConverters", "StageParam",
+    "StageListParam", "DataFrameParam", "ArrayParam", "UDFParam",
+    "ServiceParam",
+    "PipelineStage", "Transformer", "Estimator", "Model", "Pipeline",
+    "PipelineModel", "ml_transform", "ml_fit",
+    "load_stage", "register_stage",
+    "ClusterUtil", "StopWatch", "retry_with_timeout",
+    "find_unused_column_name", "as_2d_features", "contracts",
+]

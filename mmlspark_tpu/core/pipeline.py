@@ -1,0 +1,127 @@
+"""Estimator/Transformer/Pipeline — the SparkML-shaped public API surface.
+
+The reference is an ecosystem of SparkML pipeline stages; every component is an
+``Estimator`` (``fit(df) -> Model``) or ``Transformer`` (``transform(df) ->
+df``) composed into ``Pipeline``s (see SURVEY §1). We keep that exact surface
+— it's the contract ~120 stages and the binding generator rely on — while the
+execution underneath is columnar batches → jitted XLA programs.
+"""
+
+from __future__ import annotations
+
+from typing import Sequence
+
+from .dataframe import DataFrame
+from .param import Params, Param, StageListParam, StageParam
+from .logging import BasicLogging
+from .serialize import SaveLoadMixin, register_stage
+
+
+class PipelineStage(Params, BasicLogging, SaveLoadMixin):
+    """Common base of all stages."""
+
+    def __init_subclass__(cls, **kwargs):
+        super().__init_subclass__(**kwargs)
+        register_stage(cls)
+
+    def __init__(self, **kwargs):
+        Params.__init__(self, **kwargs)
+        self.log_class()
+
+
+class Transformer(PipelineStage):
+    def transform(self, df: DataFrame) -> DataFrame:
+        with self.log_call("transform"):
+            return self._transform(df)
+
+    def _transform(self, df: DataFrame) -> DataFrame:
+        raise NotImplementedError
+
+    def __call__(self, df: DataFrame) -> DataFrame:
+        return self.transform(df)
+
+
+class Estimator(PipelineStage):
+    def fit(self, df: DataFrame) -> "Model":
+        with self.log_call("fit"):
+            model = self._fit(df)
+        model._resolve_parent(self)
+        return model
+
+    def _fit(self, df: DataFrame) -> "Model":
+        raise NotImplementedError
+
+
+class Model(Transformer):
+    """A fitted transformer produced by an Estimator."""
+
+    parent: Estimator | None = None
+
+    def _resolve_parent(self, parent: Estimator) -> None:
+        self.parent = parent
+
+
+class Pipeline(Estimator):
+    """Sequential composition of stages (SparkML ``Pipeline`` analogue)."""
+
+    stages = StageListParam("stages", "pipeline stages", default=[],
+                            has_default=True)
+
+    def _fit(self, df: DataFrame) -> "PipelineModel":
+        fitted = []
+        cur = df
+        stages = self.getOrDefault("stages")
+        for i, stage in enumerate(stages):
+            if isinstance(stage, Estimator):
+                model = stage.fit(cur)
+                fitted.append(model)
+                if i < len(stages) - 1:
+                    cur = model.transform(cur)
+            elif isinstance(stage, Transformer):
+                fitted.append(stage)
+                if i < len(stages) - 1:
+                    cur = stage.transform(cur)
+            else:
+                raise TypeError(f"stage {stage!r} is not a pipeline stage")
+        return PipelineModel().setStages(fitted)
+
+
+class PipelineModel(Model):
+    """Fitted pipeline: a chain of transformers.
+
+    Constructible directly from transformers — the role of the reference's
+    ``NamespaceInjections.pipelineModel`` (which needed private-API access in
+    Spark; here it's just a constructor).
+    """
+
+    stages = StageListParam("stages", "fitted stages", default=[],
+                            has_default=True)
+
+    def __init__(self, stages: Sequence[Transformer] | None = None, **kwargs):
+        super().__init__(**kwargs)
+        if stages is not None:
+            self.setStages(list(stages))
+
+    def _transform(self, df: DataFrame) -> DataFrame:
+        cur = df
+        for stage in self.getOrDefault("stages"):
+            cur = stage.transform(cur)
+        return cur
+
+
+# ---------------------------------------------------------------- fluent API
+# Reference core/spark/FluentAPI.scala:12-30 — df.mlTransform(t1, t2),
+# df.mlFit(e): chain stages without building a Pipeline.
+def ml_transform(df: DataFrame, *stages: Transformer) -> DataFrame:
+    cur = df
+    for s in stages:
+        cur = s.transform(cur)
+    return cur
+
+
+def ml_fit(df: DataFrame, estimator: Estimator) -> Model:
+    return estimator.fit(df)
+
+
+DataFrame.mlTransform = lambda self, *stages: ml_transform(self, *stages)
+DataFrame.mlFit = lambda self, est: ml_fit(self, est)

@@ -1,0 +1,142 @@
+"""Core utilities: fault tolerance, timing, device topology, schema helpers.
+
+Covers the reference's ``core/utils`` + ``downloader/ModelDownloader.scala``
+fault-tolerance wrapper + ``core/utils/ClusterUtil.scala`` cluster-topology
+discovery. On TPU, "cluster topology" = the JAX device/mesh view: number of
+local devices, hosts, and a default mesh over which stages shard work.
+"""
+
+from __future__ import annotations
+
+import concurrent.futures
+import time
+from typing import Callable, Sequence, TypeVar
+
+import numpy as np
+
+T = TypeVar("T")
+
+# Reference downloader/ModelDownloader.scala:37-60 backoff sequence.
+DEFAULT_BACKOFFS_MS: tuple[int, ...] = (0, 100, 200, 500)
+
+
+def retry_with_timeout(fn: Callable[[], T],
+                       timeout_s: float | None = None,
+                       backoffs_ms: Sequence[int] = DEFAULT_BACKOFFS_MS) -> T:
+    """Retry ``fn`` over a backoff schedule; optional per-attempt timeout."""
+    last: Exception | None = None
+    for i, backoff in enumerate(backoffs_ms):
+        if backoff:
+            time.sleep(backoff / 1000.0)
+        try:
+            if timeout_s is None:
+                return fn()
+            # No `with`: __exit__ would join the worker and defeat the timeout.
+            ex = concurrent.futures.ThreadPoolExecutor(max_workers=1)
+            try:
+                return ex.submit(fn).result(timeout=timeout_s)
+            finally:
+                ex.shutdown(wait=False)
+        except Exception as e:  # noqa: BLE001 — retry wrapper by design
+            last = e
+    assert last is not None
+    raise last
+
+
+class StopWatch:
+    """Nanosecond accumulator (reference ``core/utils/StopWatch.scala``)."""
+
+    def __init__(self):
+        self.elapsed_ns = 0
+        self._start: int | None = None
+
+    def start(self) -> None:
+        self._start = time.perf_counter_ns()
+
+    def stop(self) -> None:
+        if self._start is not None:
+            self.elapsed_ns += time.perf_counter_ns() - self._start
+            self._start = None
+
+    def __enter__(self):
+        self.start()
+        return self
+
+    def __exit__(self, *exc):
+        self.stop()
+
+    def measure(self, fn: Callable[[], T]) -> T:
+        with self:
+            return fn()
+
+
+class ClusterUtil:
+    """Device-topology discovery — the TPU analogue of executor counting.
+
+    Reference ``core/utils/ClusterUtil.scala:13-291`` asks Spark how many
+    executors × cores are available to size the LightGBM worker mesh; here we
+    ask JAX for devices/hosts and size shard counts the same way.
+    """
+
+    @staticmethod
+    def get_num_devices() -> int:
+        import jax
+        return jax.device_count()
+
+    @staticmethod
+    def get_num_local_devices() -> int:
+        import jax
+        return jax.local_device_count()
+
+    @staticmethod
+    def get_num_hosts() -> int:
+        import jax
+        return jax.process_count()
+
+    @staticmethod
+    def get_host_index() -> int:
+        import jax
+        return jax.process_index()
+
+    @staticmethod
+    def default_mesh(axis_name: str = "dp"):
+        import jax
+        from jax.sharding import Mesh
+        devices = np.asarray(jax.devices())
+        return Mesh(devices, (axis_name,))
+
+    @staticmethod
+    def get_jvm_cpus() -> int:
+        import os
+        return os.cpu_count() or 1
+
+
+def find_unused_column_name(prefix: str, df) -> str:
+    """Reference ``core/schema/DatasetExtensions.findUnusedColumnName``."""
+    name = prefix
+    i = 0
+    while name in df.columns:
+        i += 1
+        name = f"{prefix}_{i}"
+    return name
+
+
+def as_2d_features(df, features_col: str) -> np.ndarray:
+    """Features column → dense float32 [n, d] matrix."""
+    arr = df[features_col]
+    if arr.dtype == object:
+        arr = np.stack([np.asarray(v, dtype=np.float32) for v in arr])
+    if arr.ndim == 1:
+        arr = arr[:, None]
+    return np.ascontiguousarray(arr, dtype=np.float32)
+
+
+def using(resources: Sequence, fn: Callable):
+    """RAII helper (reference ``core/env/StreamUtilities.using``)."""
+    try:
+        return fn(*resources)
+    finally:
+        for r in resources:
+            close = getattr(r, "close", None)
+            if close:
+                close()

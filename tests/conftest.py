@@ -1,0 +1,32 @@
+"""Test harness: force an 8-device virtual CPU mesh before JAX initializes.
+
+Mirrors the reference's test strategy (SURVEY §4.4): distributed behavior is
+exercised without a real cluster — there, multi-partition DataFrames on
+local[*]; here, a virtual 8-device CPU platform so every sharding/collective
+path runs the real SPMD code.
+"""
+
+import os
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in flags:
+    os.environ["XLA_FLAGS"] = (
+        flags + " --xla_force_host_platform_device_count=8").strip()
+
+import numpy as np  # noqa: E402
+import pytest  # noqa: E402
+
+
+@pytest.fixture(scope="session")
+def rng():
+    return np.random.default_rng(42)
+
+
+@pytest.fixture(scope="session")
+def eight_device_mesh():
+    import jax
+    from jax.sharding import Mesh
+    devices = np.asarray(jax.devices())
+    assert devices.size == 8, f"expected 8 virtual devices, got {devices.size}"
+    return Mesh(devices, ("dp",))

@@ -1,0 +1,92 @@
+import numpy as np
+import pytest
+
+from mmlspark_tpu.core import DataFrame
+
+
+def make_df():
+    return DataFrame({
+        "a": [1, 2, 3, 4],
+        "b": [1.0, 2.0, 3.0, 4.0],
+        "s": ["x", "y", "x", "z"],
+        "v": np.arange(8, dtype=np.float32).reshape(4, 2),
+    })
+
+
+def test_basic_shape():
+    df = make_df()
+    assert df.num_rows == 4
+    assert df.columns == ["a", "b", "s", "v"]
+    assert df["v"].shape == (4, 2)
+    assert df["s"].dtype == object
+
+
+def test_select_drop_with_column():
+    df = make_df()
+    assert df.select("a", "b").columns == ["a", "b"]
+    assert df.drop("s").columns == ["a", "b", "v"]
+    df2 = df.with_column("c", df["a"] * 2)
+    assert df2["c"].tolist() == [2, 4, 6, 8]
+    df3 = df.with_column("c", lambda d: d["a"] + 1)
+    assert df3["c"].tolist() == [2, 3, 4, 5]
+    # scalar broadcast
+    df4 = df.with_column("k", 7)
+    assert df4["k"].tolist() == [7, 7, 7, 7]
+
+
+def test_filter_sort_limit():
+    df = make_df()
+    assert df.filter(df["a"] > 2).num_rows == 2
+    assert df.filter(lambda d: d["s"] == "x")["a"].tolist() == [1, 3]
+    assert df.sort("s")["s"].tolist() == ["x", "x", "y", "z"]
+    assert df.limit(2).num_rows == 2
+
+
+def test_union_join_groupby():
+    df = make_df()
+    u = df.union(df)
+    assert u.num_rows == 8
+    other = DataFrame({"s": ["x", "y"], "t": [10, 20]})
+    j = df.select("a", "s").join(other, on="s")
+    assert j.num_rows == 3
+    g = df.group_by("s").agg(total=("a", "sum"))
+    got = {r["s"]: r["total"] for r in g.collect()}
+    assert got == {"x": 4, "y": 2, "z": 4}
+
+
+def test_partitions():
+    df = make_df().repartition(3)
+    parts = df.partitions()
+    assert [p.num_rows for p in parts] == [2, 1, 1]
+    out = df.map_partitions(lambda p: p.with_column("n", p.num_rows))
+    assert out["n"].tolist() == [2, 2, 1, 1]
+
+
+def test_random_split_roundtrip():
+    df = make_df()
+    a, b = df.random_split([0.5, 0.5], seed=3)
+    assert a.num_rows + b.num_rows == 4
+
+
+def test_pandas_roundtrip():
+    df = make_df()
+    back = DataFrame.from_pandas(df.to_pandas())
+    assert back.columns == df.columns
+    np.testing.assert_array_equal(back["v"], df["v"])
+
+
+def test_collect_rows():
+    rows = make_df().collect()
+    assert rows[0]["a"] == 1 and rows[0].s == "x"
+    assert isinstance(rows[0]["a"], int)
+
+
+def test_jnp_conversion():
+    df = make_df()
+    x = df.jnp("v")
+    assert x.shape == (4, 2)
+
+
+def test_ragged_rejected():
+    with pytest.raises(ValueError):
+        DataFrame({"a": [1, 2], "b": [1, 2, 3]})

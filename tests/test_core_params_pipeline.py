@@ -1,0 +1,117 @@
+import numpy as np
+import pytest
+
+from mmlspark_tpu.core import (DataFrame, Estimator, Model, Param, Pipeline,
+                               PipelineModel, Transformer, TypeConverters,
+                               load_stage)
+from mmlspark_tpu.core.contracts import HasInputCol, HasOutputCol
+from mmlspark_tpu.core.param import ArrayParam
+
+
+class AddN(Transformer, HasInputCol, HasOutputCol):
+    n = Param("n", "amount to add", TypeConverters.toFloat, default=1.0)
+
+    def _transform(self, df):
+        return df.with_column(self.getOutputCol(),
+                              df[self.getInputCol()] + self.getN())
+
+
+class MeanCenter(Estimator, HasInputCol, HasOutputCol):
+    def _fit(self, df):
+        mu = float(np.mean(df[self.getInputCol()]))
+        model = MeanCenterModel().setMean(mu)
+        self._copy_params_to(model)
+        return model
+
+
+class MeanCenterModel(Model, HasInputCol, HasOutputCol):
+    mean = Param("mean", "fitted mean", TypeConverters.toFloat)
+
+    def _transform(self, df):
+        return df.with_column(self.getOutputCol(),
+                              df[self.getInputCol()] - self.getMean())
+
+
+def make_df():
+    return DataFrame({"x": [1.0, 2.0, 3.0, 6.0]})
+
+
+def test_param_accessors():
+    t = AddN()
+    t.setInputCol("x").setOutputCol("y").setN(2)
+    assert t.getInputCol() == "x"
+    assert t.getN() == 2.0
+    with pytest.raises(AttributeError):
+        t.setNope(1)
+    with pytest.raises(TypeError):
+        t.setN("three")
+    assert "amount to add" in t.explainParams()
+
+
+def test_transform_and_fit():
+    df = make_df()
+    out = AddN(inputCol="x", outputCol="y", n=10).transform(df)
+    assert out["y"].tolist() == [11.0, 12.0, 13.0, 16.0]
+    model = MeanCenter(inputCol="x", outputCol="c").fit(df)
+    assert model.getMean() == 3.0
+    assert model.transform(df)["c"].tolist() == [-2.0, -1.0, 0.0, 3.0]
+
+
+def test_pipeline_fit_transform():
+    df = make_df()
+    pipe = Pipeline().setStages([
+        AddN(inputCol="x", outputCol="y", n=1),
+        MeanCenter(inputCol="y", outputCol="z"),
+    ])
+    pm = pipe.fit(df)
+    out = pm.transform(df)
+    assert out["z"].tolist() == [-2.0, -1.0, 0.0, 3.0]
+
+
+def test_save_load_roundtrip(tmp_path):
+    df = make_df()
+    pipe = Pipeline().setStages([
+        AddN(inputCol="x", outputCol="y", n=1),
+        MeanCenter(inputCol="y", outputCol="z"),
+    ])
+    pm = pipe.fit(df)
+    expected = pm.transform(df)["z"].tolist()
+
+    p = tmp_path / "pm"
+    pm.save(str(p))
+    loaded = load_stage(str(p))
+    assert isinstance(loaded, PipelineModel)
+    assert loaded.transform(df)["z"].tolist() == expected
+
+    p2 = tmp_path / "pipe"
+    pipe.save(str(p2))
+    pipe2 = load_stage(str(p2))
+    assert pipe2.fit(df).transform(df)["z"].tolist() == expected
+
+
+def test_array_param_roundtrip(tmp_path):
+    class WithWeights(Model):
+        weights = ArrayParam("weights", "model weights")
+
+        def _transform(self, df):
+            return df
+
+    m = WithWeights()
+    m.set("weights", {"w": np.ones((2, 3)), "b": np.zeros(3)})
+    m.save(str(tmp_path / "m"))
+    m2 = load_stage(str(tmp_path / "m"))
+    np.testing.assert_array_equal(m2.get("weights")["w"], np.ones((2, 3)))
+
+
+def test_fluent_api():
+    df = make_df()
+    out = df.mlTransform(AddN(inputCol="x", outputCol="y", n=1),
+                         AddN(inputCol="y", outputCol="z", n=1))
+    assert out["z"].tolist() == [3.0, 4.0, 5.0, 8.0]
+
+
+def test_copy_semantics():
+    t = AddN(inputCol="x", n=5)
+    c = t.copy({"n": 6})
+    assert t.getN() == 5.0 and c.getN() == 6.0
+    assert c.getInputCol() == "x"
