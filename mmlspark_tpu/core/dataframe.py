@@ -29,19 +29,23 @@ def _normalize_column(values: Any, n_rows: int | None = None) -> np.ndarray:
     if isinstance(values, np.ndarray):
         arr = values
     elif isinstance(values, (list, tuple)):
-        if len(values) > 0 and isinstance(values[0], (list, tuple, np.ndarray)):
+        has_seq = any(isinstance(v, (list, tuple, np.ndarray)) for v in values)
+        if has_seq:
             # Potential vector column: only keep 2-D if rectangular & numeric.
             try:
                 arr = np.asarray(values)
                 if arr.dtype == object or arr.ndim == 1:
-                    arr = np.empty(len(values), dtype=object)
-                    arr[:] = [np.asarray(v) if isinstance(v, (list, tuple)) else v
-                              for v in values]
+                    raise ValueError("ragged")
+            except ValueError:
+                arr = np.empty(len(values), dtype=object)
+                arr[:] = [np.asarray(v) if isinstance(v, (list, tuple)) else v
+                          for v in values]
+        else:
+            try:
+                arr = np.asarray(values)
             except ValueError:
                 arr = np.empty(len(values), dtype=object)
                 arr[:] = list(values)
-        else:
-            arr = np.asarray(values)
             if arr.dtype.kind == "U":
                 arr = arr.astype(object)
     else:
@@ -227,7 +231,13 @@ class DataFrame:
     @staticmethod
     def _sort_key(arr: np.ndarray) -> np.ndarray:
         if arr.dtype == object:
-            return np.asarray([str(x) for x in arr])
+            try:
+                # Numeric-valued object column (e.g. None-padded from_rows):
+                # sort numerically, Nones last.
+                return np.asarray(
+                    [np.inf if x is None else float(x) for x in arr])
+            except (TypeError, ValueError):
+                return np.asarray([str(x) for x in arr])
         return arr
 
     def random_split(self, weights: Sequence[float],
@@ -252,8 +262,11 @@ class DataFrame:
             a, b = self._data[k], other._data[k]
             if a.dtype == object or b.dtype == object:
                 out = np.empty(len(a) + len(b), dtype=object)
-                out[:len(a)] = a
-                out[len(a):] = b
+                # Per-row assignment so a 2-D numeric side becomes row cells.
+                out[:len(a)] = [a[i] for i in range(len(a))] \
+                    if a.ndim > 1 else a
+                out[len(a):] = [b[i] for i in range(len(b))] \
+                    if b.ndim > 1 else b
                 data[k] = out
             else:
                 data[k] = np.concatenate([a, b])
@@ -360,8 +373,12 @@ class DataFrame:
                   num_partitions: int = 1) -> "DataFrame":
         if not rows:
             return DataFrame()
-        cols = list(rows[0].keys())
-        return DataFrame({c: [r[c] for r in rows] for c in cols},
+        cols: list[str] = []
+        for r in rows:
+            for k in r.keys():
+                if k not in cols:
+                    cols.append(k)
+        return DataFrame({c: [r.get(c) for r in rows] for c in cols},
                          num_partitions=num_partitions)
 
     def _with_data(self, data: dict[str, np.ndarray]) -> "DataFrame":

@@ -238,12 +238,6 @@ class ServiceParam(Param):
     (``setXCol``). Encoded as {"value": v} or {"col": name}.
     """
 
-    def encode(self, value):
-        return value
-
-    def decode(self, payload):
-        return payload
-
 
 class Params:
     """Base for anything with params. Synthesizes set/get accessors."""

@@ -71,18 +71,21 @@ class Pipeline(Estimator):
         fitted = []
         cur = df
         stages = self.getOrDefault("stages")
+        last_estimator = max(
+            (i for i, s in enumerate(stages) if isinstance(s, Estimator)),
+            default=-1)
         for i, stage in enumerate(stages):
             if isinstance(stage, Estimator):
                 model = stage.fit(cur)
                 fitted.append(model)
-                if i < len(stages) - 1:
-                    cur = model.transform(cur)
             elif isinstance(stage, Transformer):
                 fitted.append(stage)
-                if i < len(stages) - 1:
-                    cur = stage.transform(cur)
+                model = stage
             else:
                 raise TypeError(f"stage {stage!r} is not a pipeline stage")
+            # Transforms past the last estimator feed nothing during fit.
+            if i < last_estimator:
+                cur = model.transform(cur)
         return PipelineModel().setStages(fitted)
 
 

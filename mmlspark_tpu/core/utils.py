@@ -23,7 +23,14 @@ DEFAULT_BACKOFFS_MS: tuple[int, ...] = (0, 100, 200, 500)
 def retry_with_timeout(fn: Callable[[], T],
                        timeout_s: float | None = None,
                        backoffs_ms: Sequence[int] = DEFAULT_BACKOFFS_MS) -> T:
-    """Retry ``fn`` over a backoff schedule; optional per-attempt timeout."""
+    """Retry ``fn`` over a backoff schedule; optional per-attempt timeout.
+
+    Caveat (same semantics as the reference's ``Await.result``-based wrapper):
+    a timed-out attempt's thread keeps running in the background, so with
+    ``timeout_s`` the ``fn`` must tolerate concurrent invocations.
+    """
+    if not backoffs_ms:
+        raise ValueError("backoffs_ms must contain at least one entry")
     last: Exception | None = None
     for i, backoff in enumerate(backoffs_ms):
         if backoff:
@@ -39,7 +46,7 @@ def retry_with_timeout(fn: Callable[[], T],
                 ex.shutdown(wait=False)
         except Exception as e:  # noqa: BLE001 — retry wrapper by design
             last = e
-    assert last is not None
+    assert last is not None  # loop ran ≥ once since backoffs_ms is non-empty
     raise last
 
 

@@ -1,0 +1,16 @@
+from .featurize import Featurize, FeaturizeModel
+from .value_indexer import ValueIndexer, ValueIndexerModel, IndexToValue
+from .clean_missing_data import CleanMissingData, CleanMissingDataModel
+from .data_conversion import DataConversion
+from .count_selector import CountSelector, CountSelectorModel
+from .text import (Tokenizer, NGram, MultiNGram, HashingTF, IDF, IDFModel,
+                   TextFeaturizer, TextFeaturizerModel, PageSplitter)
+
+__all__ = [
+    "Featurize", "FeaturizeModel",
+    "ValueIndexer", "ValueIndexerModel", "IndexToValue",
+    "CleanMissingData", "CleanMissingDataModel",
+    "DataConversion", "CountSelector", "CountSelectorModel",
+    "Tokenizer", "NGram", "MultiNGram", "HashingTF", "IDF", "IDFModel",
+    "TextFeaturizer", "TextFeaturizerModel", "PageSplitter",
+]

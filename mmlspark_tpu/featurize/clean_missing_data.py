@@ -1,0 +1,55 @@
+"""Missing-value imputation.
+
+Reference ``featurize/CleanMissingData.scala``: per-column cleaning with
+mean / median / custom replacement, fitted as a model so the replacement
+values learned on train data apply to test data.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from ..core import Estimator, Model, Param, TypeConverters as TC
+from ..core.contracts import HasInputCols, HasOutputCols
+
+
+MEAN, MEDIAN, CUSTOM = "Mean", "Median", "Custom"
+
+
+class CleanMissingData(Estimator, HasInputCols, HasOutputCols):
+    cleaningMode = Param("cleaningMode", "Mean | Median | Custom",
+                         TC.toString, default=MEAN)
+    customValue = Param("customValue", "replacement for Custom mode",
+                        TC.toFloat)
+
+    def _fit(self, df):
+        mode = self.getCleaningMode()
+        fills = {}
+        for col in self.getInputCols():
+            arr = np.asarray(df[col], dtype=np.float64)
+            valid = arr[~np.isnan(arr)]
+            if mode == MEAN:
+                fills[col] = float(valid.mean()) if valid.size else 0.0
+            elif mode == MEDIAN:
+                fills[col] = float(np.median(valid)) if valid.size else 0.0
+            elif mode == CUSTOM:
+                fills[col] = self.getCustomValue()
+            else:
+                raise ValueError(f"unknown cleaningMode {mode!r}")
+        model = CleanMissingDataModel().setFillValues(fills)
+        self._copy_params_to(model)
+        return model
+
+
+class CleanMissingDataModel(Model, HasInputCols, HasOutputCols):
+    fillValues = Param("fillValues", "column → replacement value", TC.toDict)
+
+    def _transform(self, df):
+        fills = self.getFillValues()
+        out_cols = self.get("outputCols") or self.getInputCols()
+        cur = df
+        for in_col, out_col in zip(self.getInputCols(), out_cols):
+            arr = np.asarray(df[in_col], dtype=np.float64).copy()
+            arr[np.isnan(arr)] = fills[in_col]
+            cur = cur.with_column(out_col, arr)
+        return cur

@@ -1,0 +1,31 @@
+"""Feature-slot selection by nonzero count.
+
+Reference ``featurize/CountSelector.scala``: drop feature-vector slots that
+are zero for every row (dead features inflate histogram work on device).
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from ..core import Estimator, Model, Param
+from ..core.contracts import HasInputCol, HasOutputCol
+from ..core.utils import as_2d_features
+
+
+class CountSelector(Estimator, HasInputCol, HasOutputCol):
+    def _fit(self, df):
+        x = as_2d_features(df, self.getInputCol())
+        keep = np.flatnonzero((x != 0).any(axis=0)).tolist()
+        model = CountSelectorModel().setIndices(keep)
+        self._copy_params_to(model)
+        return model
+
+
+class CountSelectorModel(Model, HasInputCol, HasOutputCol):
+    indices = Param("indices", "kept feature-slot indices")
+
+    def _transform(self, df):
+        x = as_2d_features(df, self.getInputCol())
+        idx = np.asarray(self.getIndices(), dtype=np.int64)
+        return df.with_column(self.getOutputCol(), x[:, idx])

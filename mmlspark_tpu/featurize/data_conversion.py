@@ -1,0 +1,54 @@
+"""Column type conversion.
+
+Reference ``featurize/DataConversion.scala``: cast a set of columns to a
+target type (boolean/byte/short/integer/long/float/double/string/date).
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from ..core import Transformer, Param, TypeConverters as TC
+from ..core.contracts import HasInputCols
+
+_CONVERSIONS = {
+    "boolean": np.bool_,
+    "byte": np.int8,
+    "short": np.int16,
+    "integer": np.int32,
+    "long": np.int64,
+    "float": np.float32,
+    "double": np.float64,
+    "string": object,
+    "date": "datetime64[s]",
+}
+
+
+class DataConversion(Transformer, HasInputCols):
+    convertTo = Param("convertTo", "target type: " + "|".join(_CONVERSIONS),
+                      TC.toString)
+    dateTimeFormat = Param("dateTimeFormat", "format for date parsing",
+                           TC.toString, default="%Y-%m-%d %H:%M:%S")
+
+    def _transform(self, df):
+        target = self.getConvertTo()
+        if target not in _CONVERSIONS:
+            raise ValueError(f"unknown convertTo {target!r}; "
+                             f"expected one of {sorted(_CONVERSIONS)}")
+        cur = df
+        for col in self.getInputCols():
+            arr = df[col]
+            if target == "string":
+                out = np.asarray([None if v is None else str(v)
+                                  for v in arr.tolist()], dtype=object)
+            elif target == "date":
+                import pandas as pd
+                out = pd.to_datetime(
+                    pd.Series(arr.tolist()),
+                    format=self.getDateTimeFormat()).to_numpy()
+            else:
+                if arr.dtype == object:
+                    arr = np.asarray(arr.tolist(), dtype=np.float64)
+                out = arr.astype(_CONVERSIONS[target])
+            cur = cur.with_column(col, out)
+        return cur

@@ -1,0 +1,228 @@
+"""Text featurization.
+
+Reference ``featurize/text/TextFeaturizer.scala`` (tokenize → n-gram →
+hashingTF → IDF pipeline builder), ``MultiNGram.scala`` (concatenated n-gram
+ranges), ``PageSplitter.scala`` (split long documents into bounded-length
+pages). Hashing uses a stable crc32 so featurization is reproducible across
+processes — the role VW-compatible murmur plays in the reference.
+"""
+
+from __future__ import annotations
+
+import re
+import zlib
+
+import numpy as np
+
+from ..core import Estimator, Model, Transformer, Param, TypeConverters as TC
+from ..core.contracts import HasInputCol, HasOutputCol
+
+
+def _tokenize(text: str, lower: bool, pattern: str) -> list[str]:
+    if text is None:
+        return []
+    if lower:
+        text = text.lower()
+    return [t for t in re.split(pattern, text) if t]
+
+
+def _ngrams(tokens: list[str], n: int) -> list[str]:
+    if n <= 1:
+        return list(tokens)
+    return [" ".join(tokens[i:i + n]) for i in range(len(tokens) - n + 1)]
+
+
+def _hash_tf(grams: list[str], width: int, binary: bool) -> np.ndarray:
+    vec = np.zeros(width, dtype=np.float32)
+    for g in grams:
+        vec[zlib.crc32(g.encode("utf-8")) % width] += 1.0
+    if binary:
+        vec = (vec > 0).astype(np.float32)
+    return vec
+
+
+class Tokenizer(Transformer, HasInputCol, HasOutputCol):
+    toLowercase = Param("toLowercase", "lowercase before splitting",
+                        TC.toBoolean, default=True)
+    pattern = Param("pattern", "regex split pattern", TC.toString,
+                    default=r"\W+")
+
+    def _transform(self, df):
+        lower, pat = self.getToLowercase(), self.getPattern()
+        col = df[self.getInputCol()]
+        out = np.empty(len(col), dtype=object)
+        out[:] = [_tokenize(v, lower, pat) for v in col.tolist()]
+        return df.with_column(self.getOutputCol(), out)
+
+
+class NGram(Transformer, HasInputCol, HasOutputCol):
+    n = Param("n", "n-gram length", TC.toInt, default=2)
+
+    def _transform(self, df):
+        n = self.getN()
+        col = df[self.getInputCol()]
+        out = np.empty(len(col), dtype=object)
+        out[:] = [_ngrams(list(v), n) for v in col.tolist()]
+        return df.with_column(self.getOutputCol(), out)
+
+
+class MultiNGram(Transformer, HasInputCol, HasOutputCol):
+    """Concatenate n-grams for each length in ``lengths`` (reference
+    ``featurize/text/MultiNGram.scala``)."""
+
+    lengths = Param("lengths", "n-gram lengths to include", TC.toListInt,
+                    default=[1, 2, 3])
+
+    def _transform(self, df):
+        lengths = self.getLengths()
+        col = df[self.getInputCol()]
+        out = np.empty(len(col), dtype=object)
+        out[:] = [[g for n in lengths for g in _ngrams(list(v), n)]
+                  for v in col.tolist()]
+        return df.with_column(self.getOutputCol(), out)
+
+
+class HashingTF(Transformer, HasInputCol, HasOutputCol):
+    numFeatures = Param("numFeatures", "hash space width", TC.toInt,
+                        default=1 << 18)
+    binary = Param("binary", "binary term presence instead of counts",
+                   TC.toBoolean, default=False)
+
+    def _transform(self, df):
+        width, binary = self.getNumFeatures(), self.getBinary()
+        col = df[self.getInputCol()]
+        mat = np.stack([_hash_tf(list(v), width, binary)
+                        for v in col.tolist()])
+        return df.with_column(self.getOutputCol(), mat)
+
+
+class IDF(Estimator, HasInputCol, HasOutputCol):
+    minDocFreq = Param("minDocFreq", "min docs a term must appear in",
+                       TC.toInt, default=0)
+
+    def _fit(self, df):
+        tf = np.asarray(df[self.getInputCol()], dtype=np.float32)
+        n_docs = tf.shape[0]
+        doc_freq = (tf > 0).sum(axis=0)
+        idf = np.log((n_docs + 1.0) / (doc_freq + 1.0)).astype(np.float32)
+        idf[doc_freq < self.getMinDocFreq()] = 0.0
+        model = IDFModel().set("idf", idf.tolist())
+        self._copy_params_to(model)
+        return model
+
+
+class IDFModel(Model, HasInputCol, HasOutputCol):
+    idf = Param("idf", "inverse document frequencies")
+
+    def _transform(self, df):
+        idf = np.asarray(self.get("idf"), dtype=np.float32)
+        tf = np.asarray(df[self.getInputCol()], dtype=np.float32)
+        return df.with_column(self.getOutputCol(), tf * idf)
+
+
+class TextFeaturizer(Estimator, HasInputCol, HasOutputCol):
+    """One-stop text → feature-vector pipeline builder.
+
+    Reference ``featurize/text/TextFeaturizer.scala:1-586``: composes
+    tokenizer, optional n-grams, hashingTF, optional IDF into a PipelineModel.
+    """
+
+    useTokenizer = Param("useTokenizer", "tokenize input strings",
+                         TC.toBoolean, default=True)
+    toLowercase = Param("toLowercase", "lowercase text", TC.toBoolean,
+                        default=True)
+    useNGram = Param("useNGram", "add n-grams", TC.toBoolean, default=False)
+    nGramLength = Param("nGramLength", "n-gram length", TC.toInt, default=2)
+    numFeatures = Param("numFeatures", "hash space width", TC.toInt,
+                        default=1 << 18)
+    binary = Param("binary", "binary term counts", TC.toBoolean,
+                   default=False)
+    useIDF = Param("useIDF", "apply IDF weighting", TC.toBoolean,
+                   default=True)
+    minDocFreq = Param("minDocFreq", "IDF min doc frequency", TC.toInt,
+                       default=0)
+
+    def _fit(self, df):
+        from ..core import PipelineModel
+        in_col, out_col = self.getInputCol(), self.getOutputCol()
+        stages = []
+        cur_col = in_col
+        cur = df
+        if self.getUseTokenizer():
+            tok = Tokenizer(inputCol=cur_col, outputCol=f"{out_col}_tokens",
+                            toLowercase=self.getToLowercase())
+            stages.append(tok)
+            cur = tok.transform(cur)
+            cur_col = f"{out_col}_tokens"
+        if self.getUseNGram():
+            ng = NGram(inputCol=cur_col, outputCol=f"{out_col}_ngrams",
+                       n=self.getNGramLength())
+            stages.append(ng)
+            cur = ng.transform(cur)
+            cur_col = f"{out_col}_ngrams"
+        tf_col = f"{out_col}_tf" if self.getUseIDF() else out_col
+        htf = HashingTF(inputCol=cur_col, outputCol=tf_col,
+                        numFeatures=self.getNumFeatures(),
+                        binary=self.getBinary())
+        stages.append(htf)
+        cur = htf.transform(cur)
+        if self.getUseIDF():
+            idf_model = IDF(inputCol=tf_col, outputCol=out_col,
+                            minDocFreq=self.getMinDocFreq()).fit(cur)
+            stages.append(idf_model)
+        helper_cols = [c for c in
+                       (f"{out_col}_tokens", f"{out_col}_ngrams",
+                        f"{out_col}_tf") if c != out_col]
+        from ..stages.basic import DropColumns
+        stages.append(DropColumns(cols=helper_cols))
+        return TextFeaturizerModel().setStages(stages)
+
+
+class TextFeaturizerModel(Model):
+    from ..core.param import StageListParam as _SLP
+    stages = _SLP("stages", "fitted text pipeline stages", default=[],
+                  has_default=True)
+
+    def _transform(self, df):
+        cur = df
+        for s in self.getStages():
+            cur = s.transform(cur)
+        return cur
+
+
+class PageSplitter(Transformer, HasInputCol, HasOutputCol):
+    """Split documents into pages of bounded character length.
+
+    Reference ``featurize/text/PageSplitter.scala``: bounded pages with
+    min/max length, preferring whitespace/word boundaries.
+    """
+
+    maximumPageLength = Param("maximumPageLength", "max chars per page",
+                              TC.toInt, default=5000)
+    minimumPageLength = Param("minimumPageLength",
+                              "min chars before a boundary split is allowed",
+                              TC.toInt, default=4500)
+    boundaryRegex = Param("boundaryRegex", "preferred split boundary",
+                          TC.toString, default=r"\s")
+
+    def _transform(self, df):
+        maxlen = self.getMaximumPageLength()
+        minlen = self.getMinimumPageLength()
+        pat = re.compile(self.getBoundaryRegex())
+        col = df[self.getInputCol()]
+        out = np.empty(len(col), dtype=object)
+        for i, text in enumerate(col.tolist()):
+            pages = []
+            if text:
+                start = 0
+                while start < len(text):
+                    end = min(start + maxlen, len(text))
+                    if end < len(text):
+                        window = text[start + minlen:end]
+                        candidates = [m.start() for m in pat.finditer(window)]
+                        if candidates:
+                            end = start + minlen + candidates[-1] + 1
+                    pages.append(text[start:end])
+                    start = end
+            out[i] = pages
+        return df.with_column(self.getOutputCol(), out)

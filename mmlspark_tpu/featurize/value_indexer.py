@@ -1,0 +1,77 @@
+"""Categorical value indexing.
+
+Reference ``featurize/ValueIndexer.scala`` / ``IndexToValue.scala`` +
+categorical metadata (``core/schema/Categoricals.scala``): map arbitrary
+category values to dense integer indices (and back), recording the level
+order on the model so downstream stages (one-hot, label decoding) agree.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from ..core import Estimator, Model, Param, TypeConverters as TC
+from ..core.contracts import HasInputCol, HasOutputCol
+
+
+class ValueIndexer(Estimator, HasInputCol, HasOutputCol):
+    """Fit: collect distinct values (sorted); transform: value → index."""
+
+    def _fit(self, df):
+        col = df[self.getInputCol()]
+        if col.dtype == object:
+            levels = sorted({v for v in col.tolist() if v is not None},
+                            key=lambda v: str(v))
+        else:
+            levels = np.unique(col[~_isnan(col)]).tolist()
+        model = ValueIndexerModel().setLevels(list(levels))
+        self._copy_params_to(model)
+        return model
+
+
+class ValueIndexerModel(Model, HasInputCol, HasOutputCol):
+    levels = Param("levels", "ordered category levels")
+    unknownIndex = Param("unknownIndex",
+                         "index assigned to unseen values (-1 = error)",
+                         TC.toInt, default=-1)
+
+    def _transform(self, df):
+        levels = self.getLevels()
+        lookup = {v: i for i, v in enumerate(levels)}
+        col = df[self.getInputCol()]
+        unknown = self.getUnknownIndex()
+        out = np.empty(len(col), dtype=np.int64)
+        for i, v in enumerate(col.tolist()):
+            if v in lookup:
+                out[i] = lookup[v]
+            elif unknown >= 0:
+                out[i] = unknown
+            else:
+                raise ValueError(f"unseen value {v!r} in column "
+                                 f"{self.getInputCol()!r}")
+        return df.with_column(self.getOutputCol(), out)
+
+
+class IndexToValue(Model, HasInputCol, HasOutputCol):
+    """Inverse mapping: index column → original values."""
+
+    levels = Param("levels", "ordered category levels")
+
+    def _transform(self, df):
+        levels = self.getLevels()
+        idx = df[self.getInputCol()].astype(np.int64)
+        values = np.empty(len(idx), dtype=object)
+        for i, j in enumerate(idx):
+            values[i] = levels[j]
+        arr = np.asarray(values)
+        try:
+            arr = arr.astype(type(levels[0])) if levels else arr
+        except (ValueError, TypeError):
+            pass
+        return df.with_column(self.getOutputCol(), arr)
+
+
+def _isnan(arr: np.ndarray) -> np.ndarray:
+    if arr.dtype.kind == "f":
+        return np.isnan(arr)
+    return np.zeros(arr.shape[0], dtype=bool)

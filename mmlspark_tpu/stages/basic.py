@@ -1,0 +1,145 @@
+"""Generic DataFrame plumbing transformers.
+
+Reference ``stages/`` (SURVEY §2.9): the ~20 utility transformers every
+pipeline uses — column selection/renaming, UDFs, lambdas, repartitioning,
+caching, timing.
+"""
+
+from __future__ import annotations
+
+import time
+
+import numpy as np
+
+from ..core import Transformer, Param, TypeConverters as TC, UDFParam
+from ..core.contracts import HasInputCol, HasInputCols, HasOutputCol
+
+
+class DropColumns(Transformer):
+    cols = Param("cols", "columns to drop", TC.toListString, default=[],
+                 has_default=True)
+
+    def _transform(self, df):
+        present = [c for c in self.getCols() if c in df.columns]
+        return df.drop(*present) if present else df
+
+
+class SelectColumns(Transformer):
+    cols = Param("cols", "columns to keep", TC.toListString)
+
+    def _transform(self, df):
+        return df.select(*self.getCols())
+
+
+class RenameColumn(Transformer, HasInputCol, HasOutputCol):
+    def _transform(self, df):
+        return df.with_column_renamed(self.getInputCol(), self.getOutputCol())
+
+
+class UDFTransformer(Transformer, HasInputCol, HasInputCols, HasOutputCol):
+    """Apply a user function to one or more columns (reference
+    ``stages/UDFTransformer.scala``). The function receives numpy arrays
+    (whole-column, not per-row — columnar by design)."""
+
+    udf = UDFParam("udf", "function(column_array...) -> column_array")
+
+    def _transform(self, df):
+        fn = self.get("udf")
+        if self.isSet("inputCols"):
+            args = [df[c] for c in self.getInputCols()]
+        else:
+            args = [df[self.getInputCol()]]
+        return df.with_column(self.getOutputCol(), fn(*args))
+
+
+class Lambda(Transformer):
+    """Arbitrary DataFrame → DataFrame function (reference
+    ``stages/Lambda.scala``)."""
+
+    transformFunc = UDFParam("transformFunc", "df -> df function")
+
+    def _transform(self, df):
+        return self.get("transformFunc")(df)
+
+
+class MultiColumnAdapter(Transformer, HasInputCols):
+    """Apply a single-column stage across many columns (reference
+    ``stages/MultiColumnAdapter.scala``)."""
+
+    from ..core.param import StageParam as _SP
+    baseStage = _SP("baseStage", "single-column stage to replicate")
+    outputCols = Param("outputCols", "output column names", TC.toListString)
+
+    def _transform(self, df):
+        base = self.get("baseStage")
+        cur = df
+        for in_col, out_col in zip(self.getInputCols(), self.getOutputCols()):
+            stage = base.copy({"inputCol": in_col, "outputCol": out_col})
+            cur = stage.transform(cur)
+        return cur
+
+
+class Repartition(Transformer):
+    n = Param("n", "target partition count", TC.toInt)
+    disable = Param("disable", "no-op passthrough", TC.toBoolean,
+                    default=False)
+
+    def _transform(self, df):
+        if self.getDisable():
+            return df
+        return df.repartition(self.getN())
+
+
+class Cacher(Transformer):
+    disable = Param("disable", "no-op passthrough", TC.toBoolean,
+                    default=False)
+
+    def _transform(self, df):
+        return df if self.getDisable() else df.cache()
+
+
+class Explode(Transformer, HasInputCol, HasOutputCol):
+    """Explode a list column into one row per element (reference
+    ``stages/Explode.scala``)."""
+
+    def _transform(self, df):
+        col = df[self.getInputCol()]
+        lengths = np.asarray([len(v) for v in col.tolist()])
+        idx = np.repeat(np.arange(df.num_rows), lengths)
+        exploded = np.empty(int(lengths.sum()), dtype=object)
+        k = 0
+        for v in col.tolist():
+            for item in v:
+                exploded[k] = item
+                k += 1
+        out = df.take(idx)
+        return out.with_column(self.getOutputCol(), exploded)
+
+
+class Timer(Transformer):
+    """Wrap a stage and log its wall time (reference ``stages/Timer.scala``).
+
+    The measured duration is recorded on ``lastDuration`` and logged through
+    the telemetry channel.
+    """
+
+    from ..core.param import StageParam as _SP
+    stage = _SP("stage", "stage to time")
+    logToScala = Param("logToScala", "kept for API parity; logs to telemetry",
+                       TC.toBoolean, default=True)
+
+    lastDuration: float | None = None
+
+    def _transform(self, df):
+        inner = self.get("stage")
+        start = time.perf_counter()
+        from ..core import Estimator
+        if isinstance(inner, Estimator):
+            fitted = inner.fit(df)
+            out = fitted.transform(df)
+        else:
+            out = inner.transform(df)
+        self.lastDuration = time.perf_counter() - start
+        self._log_event("timer", stage=type(inner).__name__,
+                        seconds=self.lastDuration)
+        return out

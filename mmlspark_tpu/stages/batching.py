@@ -1,0 +1,181 @@
+"""Mini-batching transformers — the serving/DL throughput trick.
+
+Reference ``stages/MiniBatchTransformer.scala:15-225`` + ``Batchers.scala``:
+batch rows into list-valued rows so downstream stages amortize per-call cost
+(for us: one jitted XLA call per batch instead of per row), then
+``FlattenBatch`` un-batches. ``DynamicBufferedBatcher`` adaptively sizes
+batches from a producer queue — the key serving-latency mechanism.
+"""
+
+from __future__ import annotations
+
+import queue
+import threading
+import time
+from typing import Iterator
+
+import numpy as np
+
+from ..core import DataFrame, Transformer, Param, TypeConverters as TC
+
+
+def _batch_df(df: DataFrame, bounds: list[tuple[int, int]]) -> DataFrame:
+    """Rows → one row per (start, end) batch; each cell becomes an array."""
+    data = {}
+    for col in df.columns:
+        arr = df[col]
+        cells = np.empty(len(bounds), dtype=object)
+        cells[:] = [arr[a:b] for a, b in bounds]
+        data[col] = cells
+    out = DataFrame(data)
+    out.num_partitions = df.num_partitions
+    return out
+
+
+class FixedMiniBatchTransformer(Transformer):
+    batchSize = Param("batchSize", "rows per batch", TC.toInt, default=10)
+    maxBufferSize = Param("maxBufferSize", "kept for API parity", TC.toInt,
+                          default=1 << 20)
+
+    def _transform(self, df):
+        size = self.getBatchSize()
+        n = df.num_rows
+        bounds = [(i, min(i + size, n)) for i in range(0, n, size)]
+        return _batch_df(df, bounds)
+
+
+class DynamicMiniBatchTransformer(Transformer):
+    """One batch per partition (the dynamic batcher consumes whatever is
+    available — in columnar form, a partition is 'what's available')."""
+
+    maxBatchSize = Param("maxBatchSize", "upper bound on batch size",
+                         TC.toInt, default=1 << 30)
+
+    def _transform(self, df):
+        size = min(self.getMaxBatchSize(), max(df.num_rows, 1))
+        n = df.num_rows
+        bounds = [(i, min(i + size, n)) for i in range(0, n, size)] or []
+        return _batch_df(df, bounds)
+
+
+class TimeIntervalMiniBatchTransformer(Transformer):
+    """Batch by arrival-time windows. On a materialized frame this groups by
+    a timestamp column into ``millisToWait`` windows (reference streams rows;
+    columnar equivalent uses the recorded arrival time)."""
+
+    millisToWait = Param("millisToWait", "window length in ms", TC.toInt,
+                         default=1000)
+    timestampCol = Param("timestampCol",
+                         "epoch-millis column; absent → single batch",
+                         TC.toString)
+    maxBatchSize = Param("maxBatchSize", "upper bound on batch size",
+                         TC.toInt, default=1 << 30)
+
+    def _transform(self, df):
+        n = df.num_rows
+        if not self.isSet("timestampCol"):
+            bounds = [(0, n)] if n else []
+            return _batch_df(df, bounds)
+        ts = np.asarray(df[self.getTimestampCol()], dtype=np.int64)
+        order = np.argsort(ts, kind="stable")
+        sorted_df = df.take(order)
+        ts = ts[order]
+        window = self.getMillisToWait()
+        max_size = self.getMaxBatchSize()
+        bounds, start = [], 0
+        for i in range(1, n + 1):
+            if (i == n or ts[i] - ts[start] >= window
+                    or i - start >= max_size):
+                bounds.append((start, i))
+                start = i
+        return _batch_df(sorted_df, bounds)
+
+
+class FlattenBatch(Transformer):
+    """Inverse of the mini-batchers: list-valued rows → one row per element."""
+
+    def _transform(self, df):
+        cols = df.columns
+        if not cols or df.num_rows == 0:
+            return df
+        lengths = None
+        for c in cols:
+            cells = df[c]
+            if cells.dtype == object and len(cells) and \
+                    hasattr(cells[0], "__len__"):
+                lengths = np.asarray([len(v) for v in cells.tolist()])
+                break
+        if lengths is None:
+            return df
+        data = {}
+        for c in cols:
+            cells = df[c]
+            if cells.dtype == object and hasattr(cells[0], "__len__") and \
+                    not isinstance(cells[0], str):
+                parts = [np.asarray(v) for v in cells.tolist()]
+                if parts and parts[0].dtype != object and \
+                        all(p.ndim == parts[0].ndim for p in parts):
+                    data[c] = np.concatenate(parts, axis=0)
+                else:
+                    flat = np.empty(int(lengths.sum()), dtype=object)
+                    k = 0
+                    for v in cells.tolist():
+                        for item in v:
+                            flat[k] = item
+                            k += 1
+                    data[c] = flat
+            else:
+                data[c] = np.repeat(cells, lengths, axis=0)
+        out = DataFrame(data)
+        out.num_partitions = df.num_partitions
+        return out
+
+
+class DynamicBufferedBatcher:
+    """Queue-based adaptive batcher (reference ``stages/Batchers.scala:1-152``).
+
+    A producer thread fills a bounded queue; ``__iter__`` yields batches of
+    whatever has accumulated — under light load batches are small (low
+    latency), under heavy load they grow (high throughput). This is the core
+    of the serving engine's latency/throughput tradeoff.
+    """
+
+    def __init__(self, it: Iterator, max_buffer_size: int = 1024):
+        self._it = it
+        self._queue: queue.Queue = queue.Queue(maxsize=max_buffer_size)
+        self._done = threading.Event()
+        self._thread = threading.Thread(target=self._produce, daemon=True)
+        self._thread.start()
+
+    def _produce(self):
+        try:
+            for item in self._it:
+                self._queue.put(item)
+        finally:
+            self._done.set()
+
+    def __iter__(self):
+        while True:
+            batch = []
+            try:
+                batch.append(self._queue.get(timeout=0.01))
+            except queue.Empty:
+                if self._done.is_set() and self._queue.empty():
+                    return
+                continue
+            while True:
+                try:
+                    batch.append(self._queue.get_nowait())
+                except queue.Empty:
+                    break
+            yield batch
+
+
+class PartitionConsolidator(Transformer):
+    """Funnel many partitions through one consolidated stream (reference
+    ``stages/PartitionConsolidator.scala:21-143``) — used to respect
+    per-process rate limits on HTTP services. Columnar equivalent: collapse
+    to a single partition while preserving rows."""
+
+    def _transform(self, df):
+        return df.repartition(1)

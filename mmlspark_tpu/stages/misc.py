@@ -1,0 +1,209 @@
+"""Data-shaping and profiling stages.
+
+Reference ``stages/``: SummarizeData, ClassBalancer, StratifiedRepartition,
+EnsembleByKey, TextPreprocessor, UnicodeNormalize (SURVEY §2.9).
+"""
+
+from __future__ import annotations
+
+import re
+import unicodedata
+
+import numpy as np
+
+from ..core import DataFrame, Estimator, Model, Transformer, Param, \
+    TypeConverters as TC
+from ..core.contracts import HasInputCol, HasLabelCol, HasOutputCol, HasSeed
+
+
+class SummarizeData(Transformer):
+    """Counts / quantiles / missing-value profile per column (reference
+    ``stages/SummarizeData.scala:1-238``)."""
+
+    counts = Param("counts", "include counts block", TC.toBoolean, default=True)
+    basic = Param("basic", "include basic stats block", TC.toBoolean,
+                  default=True)
+    sample = Param("sample", "include quantiles block", TC.toBoolean,
+                   default=True)
+    percentiles = Param("percentiles", "quantiles to compute", TC.toListFloat,
+                        default=[0.005, 0.01, 0.05, 0.25, 0.5, 0.75, 0.95,
+                                 0.99, 0.995])
+    errorThreshold = Param("errorThreshold",
+                           "quantile error (parity; exact here)", TC.toFloat,
+                           default=0.0)
+
+    def _transform(self, df):
+        rows = []
+        for col in df.columns:
+            arr = df[col]
+            row = {"Feature": col}
+            if self.getCounts():
+                row["Count"] = float(len(arr))
+                row["Unique Value Count"] = float(len(set(map(str, arr.tolist())))) \
+                    if arr.dtype == object else float(np.unique(arr[~_nan(arr)]).size)
+                row["Missing Value Count"] = float(_nan(arr).sum()) if \
+                    arr.dtype != object else float(sum(v is None for v in arr))
+            numeric = arr.dtype.kind in "iuf" and arr.ndim == 1
+            if self.getBasic():
+                if numeric:
+                    vals = arr[~_nan(arr)].astype(np.float64)
+                    row.update({"Mean": float(vals.mean()) if vals.size else np.nan,
+                                "Std": float(vals.std(ddof=1)) if vals.size > 1 else np.nan,
+                                "Min": float(vals.min()) if vals.size else np.nan,
+                                "Max": float(vals.max()) if vals.size else np.nan})
+                else:
+                    row.update({"Mean": np.nan, "Std": np.nan,
+                                "Min": np.nan, "Max": np.nan})
+            if self.getSample():
+                vals = arr[~_nan(arr)].astype(np.float64) if numeric else \
+                    np.empty(0)
+                for p in self.getPercentiles():
+                    row[f"Quantile_{p}"] = float(np.quantile(vals, p)) \
+                        if vals.size else np.nan
+            rows.append(row)
+        return DataFrame.from_rows(rows)
+
+
+def _nan(arr):
+    if arr.dtype.kind == "f":
+        return np.isnan(arr)
+    return np.zeros(len(arr), dtype=bool)
+
+
+class ClassBalancer(Estimator, HasInputCol):
+    """Compute per-class weights inversely proportional to frequency
+    (reference ``stages/ClassBalancer.scala``)."""
+
+    outputCol = Param("outputCol", "weight column", TC.toString,
+                      default="weight")
+    broadcastJoin = Param("broadcastJoin", "parity flag", TC.toBoolean,
+                          default=True)
+
+    def _fit(self, df):
+        col = df[self.getInputCol()]
+        values, counts = np.unique(col, return_counts=True)
+        weights = counts.max() / counts.astype(np.float64)
+        model = ClassBalancerModel().setWeights(
+            {str(v): float(w) for v, w in zip(values.tolist(), weights)})
+        self._copy_params_to(model)
+        return model
+
+
+class ClassBalancerModel(Model, HasInputCol):
+    weights = Param("weights", "class → weight", TC.toDict)
+    outputCol = Param("outputCol", "weight column", TC.toString,
+                      default="weight")
+
+    def _transform(self, df):
+        w = self.getWeights()
+        col = df[self.getInputCol()]
+        out = np.asarray([w[str(v)] for v in col.tolist()], dtype=np.float64)
+        return df.with_column(self.getOutputCol(), out)
+
+
+class StratifiedRepartition(Transformer, HasLabelCol, HasSeed):
+    """Rebalance rows across partitions so every partition sees every label
+    (reference ``stages/StratifiedRepartition.scala:1-82``). Matters here for
+    the same reason as the reference: distributed GBDT shards must all hold
+    examples of each class or their histogram collectives degrade."""
+
+    mode = Param("mode", "equal | original | mixed", TC.toString,
+                 default="mixed")
+
+    def _transform(self, df):
+        labels = df[self.getLabelCol()]
+        rng = np.random.default_rng(self.getSeed())
+        order = []
+        # Round-robin interleave per label so contiguous block partitioning
+        # gives each partition a balanced label mix.
+        by_label = {}
+        for v in np.unique(labels):
+            idx = np.flatnonzero(labels == v)
+            rng.shuffle(idx)
+            by_label[v] = list(idx)
+        pools = list(by_label.values())
+        while any(pools):
+            for pool in pools:
+                if pool:
+                    order.append(pool.pop())
+        return df.take(np.asarray(order, dtype=np.int64))
+
+
+class EnsembleByKey(Transformer):
+    """Group rows by key columns and average vector/score columns (reference
+    ``stages/EnsembleByKey.scala``)."""
+
+    keys = Param("keys", "grouping key columns", TC.toListString)
+    cols = Param("cols", "columns to aggregate", TC.toListString)
+    strategy = Param("strategy", "mean (only supported, as in reference)",
+                     TC.toString, default="mean")
+    collapseGroup = Param("collapseGroup", "one row per group", TC.toBoolean,
+                          default=True)
+
+    def _transform(self, df):
+        keys, cols = self.getKeys(), self.getCols()
+        key_arrays = [df[k] for k in keys]
+        key_tuples = list(zip(*[a.tolist() for a in key_arrays]))
+        groups: dict = {}
+        for i, kt in enumerate(key_tuples):
+            groups.setdefault(kt, []).append(i)
+        rows = []
+        for kt, idxs in groups.items():
+            row = dict(zip(keys, kt))
+            for c in cols:
+                arr = df[c]
+                vals = np.stack([np.asarray(arr[i], dtype=np.float64)
+                                 for i in idxs]) if arr.dtype == object else \
+                    np.asarray(arr[idxs], dtype=np.float64)
+                row[f"mean({c})"] = vals.mean(axis=0)
+            rows.append(row)
+        return DataFrame.from_rows(rows)
+
+
+class TextPreprocessor(Transformer, HasInputCol, HasOutputCol):
+    """Trie-based string normalization map (reference
+    ``stages/TextPreprocessor.scala``)."""
+
+    map = Param("map", "substring → replacement", TC.toDict, default={},
+                has_default=True)
+    normFunc = Param("normFunc", "lower | upper | identity", TC.toString,
+                     default="identity")
+
+    def _transform(self, df):
+        mapping = self.get("map")
+        norm = {"lower": str.lower, "upper": str.upper,
+                "identity": lambda s: s}[self.getNormFunc()]
+        pattern = None
+        if mapping:
+            pattern = re.compile("|".join(
+                re.escape(k) for k in sorted(mapping, key=len, reverse=True)))
+        col = df[self.getInputCol()]
+        out = np.empty(len(col), dtype=object)
+        for i, v in enumerate(col.tolist()):
+            s = norm(v) if v is not None else v
+            if s is not None and pattern is not None:
+                s = pattern.sub(lambda m: mapping[m.group(0)], s)
+            out[i] = s
+        return df.with_column(self.getOutputCol(), out)
+
+
+class UnicodeNormalize(Transformer, HasInputCol, HasOutputCol):
+    """Unicode NFC/NFKC/... normalization (reference
+    ``stages/UnicodeNormalize.scala``)."""
+
+    form = Param("form", "NFC | NFD | NFKC | NFKD", TC.toString,
+                 default="NFKC")
+    lower = Param("lower", "lowercase after normalizing", TC.toBoolean,
+                  default=True)
+
+    def _transform(self, df):
+        form, lower = self.getForm(), self.getLower()
+        col = df[self.getInputCol()]
+        out = np.empty(len(col), dtype=object)
+        for i, v in enumerate(col.tolist()):
+            if v is None:
+                out[i] = None
+            else:
+                s = unicodedata.normalize(form, v)
+                out[i] = s.lower() if lower else s
+        return df.with_column(self.getOutputCol(), out)

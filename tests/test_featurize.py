@@ -1,0 +1,135 @@
+import numpy as np
+import pytest
+
+from mmlspark_tpu.core import DataFrame, load_stage
+from mmlspark_tpu.featurize import (CleanMissingData, CountSelector,
+                                    DataConversion, Featurize, HashingTF,
+                                    IndexToValue, MultiNGram, PageSplitter,
+                                    TextFeaturizer, Tokenizer, ValueIndexer)
+
+
+def mixed_df():
+    return DataFrame({
+        "num": [1.0, 2.0, np.nan, 4.0],
+        "cat": ["a", "b", "a", "c"],
+        "intc": [1, 2, 3, 4],
+        "vec": np.arange(8, dtype=np.float32).reshape(4, 2),
+    })
+
+
+def test_featurize_assembles_vector():
+    df = mixed_df()
+    model = Featurize(inputCols=["num", "cat", "intc", "vec"],
+                      outputCol="features").fit(df)
+    out = model.transform(df)
+    feats = out["features"]
+    # num(1) + cat onehot(3) + intc(1) + vec(2) = 7
+    assert feats.shape == (4, 7)
+    # NaN imputed with mean of [1,2,4] = 7/3
+    assert feats[2, 0] == pytest.approx(7 / 3)
+    # one-hot correctness
+    assert feats[0, 1:4].tolist() == [1.0, 0.0, 0.0]
+    assert feats[3, 1:4].tolist() == [0.0, 0.0, 1.0]
+
+
+def test_featurize_unseen_category_is_zero_vector():
+    df = mixed_df()
+    model = Featurize(inputCols=["cat"], outputCol="f").fit(df)
+    test = DataFrame({"cat": ["zzz"]})
+    out = model.transform(test)
+    assert out["f"].tolist() == [[0.0, 0.0, 0.0]]
+
+
+def test_featurize_hashing_high_cardinality():
+    df = DataFrame({"cat": [f"v{i}" for i in range(100)]})
+    model = Featurize(inputCols=["cat"], outputCol="f",
+                      maxOneHotCardinality=10).fit(df)
+    out = model.transform(df)
+    assert out["f"].shape[1] <= 1024
+    assert (out["f"].sum(axis=1) == 1.0).all()
+
+
+def test_featurize_roundtrip(tmp_path):
+    df = mixed_df()
+    model = Featurize(inputCols=["num", "cat"], outputCol="f").fit(df)
+    model.save(str(tmp_path / "m"))
+    loaded = load_stage(str(tmp_path / "m"))
+    np.testing.assert_array_equal(loaded.transform(df)["f"],
+                                  model.transform(df)["f"])
+
+
+def test_value_indexer_roundtrip():
+    df = DataFrame({"c": ["b", "a", "b", "c"]})
+    model = ValueIndexer(inputCol="c", outputCol="i").fit(df)
+    out = model.transform(df)
+    assert out["i"].tolist() == [1, 0, 1, 2]
+    back = IndexToValue(inputCol="i", outputCol="c2") \
+        .setLevels(model.getLevels()).transform(out)
+    assert back["c2"].tolist() == ["b", "a", "b", "c"]
+    with pytest.raises(ValueError):
+        model.transform(DataFrame({"c": ["zzz"]}))
+    ok = model.copy({"unknownIndex": 0}).transform(DataFrame({"c": ["zzz"]}))
+    assert ok["i"].tolist() == [0]
+
+
+def test_clean_missing_data():
+    df = DataFrame({"x": [1.0, np.nan, 3.0], "y": [np.nan, 2.0, 4.0]})
+    model = CleanMissingData(inputCols=["x", "y"],
+                             cleaningMode="Median").fit(df)
+    out = model.transform(df)
+    assert out["x"].tolist() == [1.0, 2.0, 3.0]
+    assert out["y"].tolist() == [3.0, 2.0, 4.0]
+
+
+def test_data_conversion():
+    df = DataFrame({"x": ["1", "2"], "y": [1.9, 2.1]})
+    out = DataConversion(inputCols=["x"], convertTo="double").transform(df)
+    assert out["x"].dtype == np.float64
+    out2 = DataConversion(inputCols=["y"], convertTo="integer").transform(df)
+    assert out2["y"].tolist() == [1, 2]
+    out3 = DataConversion(inputCols=["y"], convertTo="string").transform(df)
+    assert out3["y"].tolist() == ["1.9", "2.1"]
+
+
+def test_count_selector():
+    df = DataFrame({"f": np.array([[1., 0., 2.], [3., 0., 0.]])})
+    model = CountSelector(inputCol="f", outputCol="g").fit(df)
+    assert model.getIndices() == [0, 2]
+    assert model.transform(df)["g"].shape == (2, 2)
+
+
+def test_tokenizer_and_ngrams():
+    df = DataFrame({"t": ["Hello World hello", None]})
+    toks = Tokenizer(inputCol="t", outputCol="w").transform(df)
+    assert toks["w"][0] == ["hello", "world", "hello"]
+    assert toks["w"][1] == []
+    m = MultiNGram(inputCol="w", outputCol="g", lengths=[1, 2]).transform(toks)
+    assert "hello world" in m["g"][0]
+
+
+def test_hashing_tf_deterministic():
+    df = DataFrame({"w": [["a", "b", "a"], ["c"]]})
+    out = HashingTF(inputCol="w", outputCol="tf", numFeatures=32).transform(df)
+    assert out["tf"].shape == (2, 32)
+    assert out["tf"][0].sum() == 3.0
+    out2 = HashingTF(inputCol="w", outputCol="tf", numFeatures=32).transform(df)
+    np.testing.assert_array_equal(out["tf"], out2["tf"])
+
+
+def test_text_featurizer_end_to_end():
+    df = DataFrame({"text": ["the cat sat", "the dog ran", "cats and dogs"]})
+    model = TextFeaturizer(inputCol="text", outputCol="feats",
+                           numFeatures=64).fit(df)
+    out = model.transform(df)
+    assert out["feats"].shape == (3, 64)
+    assert "feats_tokens" not in out.columns
+
+
+def test_page_splitter():
+    df = DataFrame({"doc": ["word " * 100]})  # 500 chars
+    out = PageSplitter(inputCol="doc", outputCol="pages",
+                       maximumPageLength=120,
+                       minimumPageLength=80).transform(df)
+    pages = out["pages"][0]
+    assert all(len(p) <= 120 for p in pages)
+    assert "".join(pages) == "word " * 100

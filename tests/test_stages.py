@@ -1,0 +1,129 @@
+import numpy as np
+
+from mmlspark_tpu.core import DataFrame
+from mmlspark_tpu.stages import (Cacher, ClassBalancer, DropColumns,
+                                 DynamicBufferedBatcher, EnsembleByKey,
+                                 Explode, FixedMiniBatchTransformer,
+                                 FlattenBatch, Lambda, MultiColumnAdapter,
+                                 RenameColumn, Repartition, SelectColumns,
+                                 StratifiedRepartition, SummarizeData,
+                                 TextPreprocessor, Timer,
+                                 TimeIntervalMiniBatchTransformer,
+                                 UDFTransformer, UnicodeNormalize)
+
+
+def make_df():
+    return DataFrame({"a": [1.0, 2.0, 3.0, 4.0],
+                      "b": ["x", "y", "x", "y"]})
+
+
+def test_column_stages():
+    df = make_df()
+    assert DropColumns(cols=["b"]).transform(df).columns == ["a"]
+    assert DropColumns(cols=["zz"]).transform(df).columns == ["a", "b"]
+    assert SelectColumns(cols=["b"]).transform(df).columns == ["b"]
+    assert "c" in RenameColumn(inputCol="a", outputCol="c").transform(df).columns
+    assert Repartition(n=3).transform(df).num_partitions == 3
+    assert Cacher().transform(df) is df
+
+
+def test_udf_and_lambda():
+    df = make_df()
+    out = UDFTransformer(inputCol="a", outputCol="a2",
+                         udf=lambda a: a * 2).transform(df)
+    assert out["a2"].tolist() == [2.0, 4.0, 6.0, 8.0]
+    out2 = UDFTransformer(inputCols=["a", "a"], outputCol="s",
+                          udf=lambda x, y: x + y).transform(df)
+    assert out2["s"].tolist() == [2.0, 4.0, 6.0, 8.0]
+    out3 = Lambda(transformFunc=lambda d: d.filter(d["a"] > 2)).transform(df)
+    assert out3.num_rows == 2
+
+
+def test_multi_column_adapter():
+    from mmlspark_tpu.featurize import Tokenizer
+    df = DataFrame({"t1": ["A b"], "t2": ["C d"]})
+    out = MultiColumnAdapter(
+        inputCols=["t1", "t2"], outputCols=["o1", "o2"],
+        baseStage=Tokenizer()).transform(df)
+    assert out["o1"][0] == ["a", "b"]
+    assert out["o2"][0] == ["c", "d"]
+
+
+def test_explode():
+    df = DataFrame({"k": [1, 2], "v": [[10, 20], [30]]})
+    out = Explode(inputCol="v", outputCol="e").transform(df)
+    assert out["k"].tolist() == [1, 1, 2]
+    assert out["e"].tolist() == [10, 20, 30]
+
+
+def test_minibatch_roundtrip():
+    df = make_df()
+    batched = FixedMiniBatchTransformer(batchSize=3).transform(df)
+    assert batched.num_rows == 2
+    assert len(batched["a"][0]) == 3
+    flat = FlattenBatch().transform(batched)
+    assert flat["a"].tolist() == df["a"].tolist()
+    assert flat["b"].tolist() == df["b"].tolist()
+
+
+def test_time_interval_batcher():
+    df = DataFrame({"ts": [0, 10, 2000, 2010], "v": [1, 2, 3, 4]})
+    out = TimeIntervalMiniBatchTransformer(
+        millisToWait=1000, timestampCol="ts").transform(df)
+    assert out.num_rows == 2
+    assert out["v"][0].tolist() == [1, 2]
+
+
+def test_dynamic_buffered_batcher():
+    batches = list(DynamicBufferedBatcher(iter(range(100))))
+    flat = [x for b in batches for x in b]
+    assert flat == list(range(100))
+
+
+def test_summarize_data():
+    df = make_df()
+    out = SummarizeData().transform(df)
+    rows = {r["Feature"]: r for r in out.collect()}
+    assert rows["a"]["Count"] == 4.0
+    assert rows["a"]["Mean"] == 2.5
+    assert rows["a"]["Quantile_0.5"] == 2.5
+
+
+def test_class_balancer():
+    df = DataFrame({"y": ["a", "a", "a", "b"]})
+    model = ClassBalancer(inputCol="y").fit(df)
+    out = model.transform(df)
+    assert out["weight"].tolist() == [1.0, 1.0, 1.0, 3.0]
+
+
+def test_stratified_repartition():
+    df = DataFrame({"label": [0] * 4 + [1] * 4}).repartition(2)
+    out = StratifiedRepartition(labelCol="label").transform(df)
+    for part in out.partitions():
+        assert set(part["label"].tolist()) == {0, 1}
+
+
+def test_ensemble_by_key():
+    df = DataFrame({"k": ["a", "a", "b"], "score": [1.0, 3.0, 5.0]})
+    out = EnsembleByKey(keys=["k"], cols=["score"]).transform(df)
+    got = {r["k"]: r["mean(score)"] for r in out.collect()}
+    assert got["a"] == 2.0 and got["b"] == 5.0
+
+
+def test_text_preprocessor_and_unicode():
+    df = DataFrame({"t": ["Hello WORLD"]})
+    out = TextPreprocessor(inputCol="t", outputCol="o",
+                           normFunc="lower",
+                           map={"hello": "hi"}).transform(df)
+    assert out["o"][0] == "hi world"
+    df2 = DataFrame({"t": ["Ｈｅｌｌｏ"]})  # fullwidth
+    out2 = UnicodeNormalize(inputCol="t", outputCol="o").transform(df2)
+    assert out2["o"][0] == "hello"
+
+
+def test_timer():
+    df = make_df()
+    t = Timer(stage=DropColumns(cols=["b"]))
+    out = t.transform(df)
+    assert out.columns == ["a"]
+    assert t.lastDuration is not None
