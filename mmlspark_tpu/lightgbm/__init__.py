@@ -1,0 +1,14 @@
+from .booster import Booster
+from .engine import TreeParams, grow_tree
+from .estimators import (LightGBMClassifier, LightGBMClassificationModel,
+                         LightGBMRegressor, LightGBMRegressionModel,
+                         LightGBMRanker, LightGBMRankerModel)
+from .trainer import TrainConfig, train, roc_auc
+
+__all__ = [
+    "Booster", "TreeParams", "grow_tree",
+    "LightGBMClassifier", "LightGBMClassificationModel",
+    "LightGBMRegressor", "LightGBMRegressionModel",
+    "LightGBMRanker", "LightGBMRankerModel",
+    "TrainConfig", "train", "roc_auc",
+]

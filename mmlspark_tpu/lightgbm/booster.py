@@ -1,0 +1,393 @@
+"""Booster: the trained GBDT model — prediction, persistence, introspection.
+
+Role of the reference's ``lightgbm/booster/LightGBMBooster.scala:196-517``:
+score (raw/probability), predict leaf indices, feature importances (split /
+gain), save to / load from the LightGBM *text model format* so models
+interchange with native LightGBM (``saveNativeModel`` /
+``loadNativeModelFromFile`` parity, ``LightGBMClassifier.scala:196-208``).
+
+Trees live as stacked fixed-capacity arrays [T, NN]; prediction is one jitted
+routine that advances every (row, tree) pair one level per step — no per-row
+JNI crossing (the reference pays one per row, ``LightGBMBooster.scala:333-344``).
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+class Booster:
+    """Stacked-tree GBDT model.
+
+    Arrays (numpy, host-resident; pushed to device lazily for predict):
+      feature      i32 [T, NN]
+      threshold    f32 [T, NN]  — raw-value threshold (go left iff x <= thr,
+                                  NaN goes left, matching training where the
+                                  missing bin is 0)
+      left/right   i32 [T, NN]
+      leaf_value   f32 [T, NN]  — shrunk by learning_rate already
+      is_leaf      bool[T, NN]
+      split_gain, node_weight, node_count, node_value f32 [T, NN]
+      num_nodes    i32 [T]
+    """
+
+    def __init__(self, arrays: dict, *, num_class: int = 1,
+                 objective: str = "regression", sigmoid: float = 1.0,
+                 init_score: float | np.ndarray = 0.0,
+                 feature_names: list[str] | None = None,
+                 max_depth_bound: int = 64,
+                 tree_weights: np.ndarray | None = None,
+                 average_output: bool = False):
+        self.arrays = {k: np.asarray(v) for k, v in arrays.items()}
+        self.num_class = num_class
+        self.objective = objective
+        self.sigmoid = sigmoid
+        self.init_score = np.asarray(init_score, dtype=np.float32)
+        T = self.arrays["feature"].shape[0] if "feature" in arrays else 0
+        self.feature_names = feature_names
+        self.max_depth_bound = max_depth_bound
+        self.tree_weights = (np.ones(T, np.float32) if tree_weights is None
+                             else np.asarray(tree_weights, np.float32))
+        self.average_output = average_output
+        self.best_iteration = -1
+
+    # ------------------------------------------------------------ prediction
+    @property
+    def num_trees(self) -> int:
+        return self.arrays["feature"].shape[0]
+
+    @property
+    def num_iterations(self) -> int:
+        return self.num_trees // self.num_class
+
+    def _effective_trees(self, num_iteration: int | None = None) -> int:
+        it = num_iteration
+        if it is None and self.best_iteration >= 0:
+            it = self.best_iteration + 1
+        if it is None:
+            return self.num_trees
+        return min(self.num_trees, it * self.num_class)
+
+    def raw_scores(self, x: np.ndarray,
+                   num_iteration: int | None = None) -> np.ndarray:
+        """Raw margin scores [n] or [n, K]."""
+        if self.num_trees and "feature" in self.arrays:
+            need = int(self.arrays["feature"].max()) + 1
+            if x.shape[1] < need:
+                raise ValueError(
+                    f"model splits on feature {need - 1} but input has only "
+                    f"{x.shape[1]} features")
+        t_end = self._effective_trees(num_iteration)
+        if t_end == 0:
+            base = np.broadcast_to(
+                self.init_score,
+                (x.shape[0], self.num_class)).astype(np.float32)
+            return base[:, 0] if self.num_class == 1 else base
+        leaf_vals = _predict_leaf_values(
+            self._device_arrays(t_end), jnp.asarray(x, jnp.float32),
+            max_depth=self.max_depth_bound)          # [n, T]
+        w = jnp.asarray(self.tree_weights[:t_end])
+        weighted = leaf_vals * w[None, :]
+        per_class = weighted.reshape(x.shape[0], -1, self.num_class)
+        scores = per_class.sum(axis=1)
+        if self.average_output:
+            scores = scores / (t_end // self.num_class)
+        scores = scores + jnp.asarray(self.init_score).reshape(1, -1)
+        out = np.asarray(scores)
+        return out[:, 0] if self.num_class == 1 else out
+
+    def predict_leaf(self, x: np.ndarray,
+                     num_iteration: int | None = None) -> np.ndarray:
+        """Leaf *index* per (row, tree) — reference ``predictLeaf``.
+
+        Indices are leaf ordinals (leaves numbered in node-creation order
+        within each tree), matching LightGBM's predict_leaf_index semantics.
+        """
+        t_end = self._effective_trees(num_iteration)
+        leaves = _predict_leaf_nodes(
+            self._device_arrays(t_end), jnp.asarray(x, jnp.float32),
+            max_depth=self.max_depth_bound)          # node ids [n, T]
+        # map node id -> leaf ordinal
+        is_leaf = self.arrays["is_leaf"][:t_end]
+        out = np.zeros_like(np.asarray(leaves))
+        for t in range(t_end):
+            node_ids = np.flatnonzero(is_leaf[t])
+            ordinal = {int(nid): i for i, nid in enumerate(node_ids)}
+            out[:, t] = [ordinal[int(v)] for v in np.asarray(leaves)[:, t]]
+        return out
+
+    def transform_scores(self, raw: np.ndarray) -> np.ndarray:
+        if self.objective == "binary":
+            return 1.0 / (1.0 + np.exp(-self.sigmoid * raw))
+        if self.objective in ("multiclass", "softmax", "multiclassova"):
+            e = np.exp(raw - raw.max(axis=-1, keepdims=True))
+            return e / e.sum(axis=-1, keepdims=True)
+        if self.objective in ("poisson", "gamma", "tweedie"):
+            return np.exp(raw)
+        return raw
+
+    def _device_arrays(self, t_end: int):
+        a = self.arrays
+        return tuple(jnp.asarray(a[k][:t_end]) for k in
+                     ("feature", "threshold", "left", "right",
+                      "leaf_value", "is_leaf"))
+
+    # ---------------------------------------------------------- importances
+    def feature_importances(self, importance_type: str = "split",
+                            num_features: int | None = None) -> np.ndarray:
+        """Reference ``getFeatureImportances`` (split counts or total gain)."""
+        a = self.arrays
+        F = num_features or int(a["feature"].max() + 1 if a["feature"].size
+                                else 0)
+        out = np.zeros(F, dtype=np.float64)
+        internal = ~a["is_leaf"] & (a["left"] >= 0)
+        feats = a["feature"][internal]
+        if importance_type == "split":
+            np.add.at(out, feats, 1.0)
+        elif importance_type == "gain":
+            np.add.at(out, feats, a["split_gain"][internal])
+        else:
+            raise ValueError("importance_type must be 'split' or 'gain'")
+        return out
+
+    # ------------------------------------------------- LightGBM text format
+    def save_native(self, num_features: int | None = None) -> str:
+        """Serialize to the LightGBM text model format (model-string parity
+        with reference ``saveToString`` / ``saveNativeModel``)."""
+        a = self.arrays
+        F = num_features or (len(self.feature_names)
+                             if self.feature_names else
+                             int(a["feature"].max() + 1))
+        names = self.feature_names or [f"Column_{i}" for i in range(F)]
+        obj = {"binary": f"binary sigmoid:{self.sigmoid:g}",
+               "multiclass": f"multiclass num_class:{self.num_class}",
+               }.get(self.objective, self.objective)
+        lines = [
+            "tree", "version=v3", f"num_class={self.num_class}",
+            f"num_tree_per_iteration={self.num_class}",
+            "label_index=0", f"max_feature_idx={F - 1}",
+            f"objective={obj}",
+            "feature_names=" + " ".join(names),
+            "feature_infos=" + " ".join(["none"] * F), "",
+        ]
+        if self.average_output:
+            # real LightGBM rf models carry this header flag
+            lines.insert(lines.index("feature_infos=" + " ".join(
+                ["none"] * F)) + 1, "average_output")
+        init = np.asarray(self.init_score, dtype=np.float64).reshape(-1)
+        T = self.num_trees
+        denom = max(T // self.num_class, 1) if self.average_output else 1
+        for t in range(T):
+            # LightGBM text models carry no separate init score: fold the
+            # boost-from-average base into the first tree of each class.
+            # For rf, LightGBM averages tree outputs, so the folded init is
+            # multiplied back by the tree count.
+            fold = float(init[t % self.num_class]) * denom \
+                if t < self.num_class and init.size else 0.0
+            # DART/continuation tree weights are baked into leaf values so
+            # the text model is self-contained (LightGBM does the same).
+            lines.extend(self._tree_to_text(
+                t, leaf_shift=fold, leaf_scale=float(self.tree_weights[t])))
+            lines.append("")
+        lines.append("end of trees")
+        lines.append("")
+        lines.append("parameters:")
+        lines.append("end of parameters")
+        return "\n".join(lines)
+
+    def _tree_to_text(self, t: int, leaf_shift: float = 0.0,
+                      leaf_scale: float = 1.0) -> list[str]:
+        a = self.arrays
+        nn = int(a["num_nodes"][t])
+        is_leaf = a["is_leaf"][t]
+        # internal nodes in creation order; leaves in creation order
+        internal_ids = [i for i in range(nn) if not is_leaf[i]]
+        leaf_ids = [i for i in range(nn) if is_leaf[i]]
+        int_ord = {nid: i for i, nid in enumerate(internal_ids)}
+        leaf_ord = {nid: i for i, nid in enumerate(leaf_ids)}
+
+        def child_code(c):
+            return leaf_ord[c] * -1 - 1 if is_leaf[c] else int_ord[c]
+
+        num_leaves = len(leaf_ids)
+        rows = {
+            "split_feature": [int(a["feature"][t, i]) for i in internal_ids],
+            "split_gain": [float(a["split_gain"][t, i])
+                           for i in internal_ids],
+            "threshold": [float(a["threshold"][t, i]) for i in internal_ids],
+            "decision_type": [2] * len(internal_ids),  # missing=NaN, default left
+            "left_child": [child_code(int(a["left"][t, i]))
+                           for i in internal_ids],
+            "right_child": [child_code(int(a["right"][t, i]))
+                            for i in internal_ids],
+            "leaf_value": [float(a["leaf_value"][t, i]) * leaf_scale
+                           + leaf_shift for i in leaf_ids],
+            "leaf_weight": [float(a["node_weight"][t, i]) for i in leaf_ids],
+            "leaf_count": [int(a["node_count"][t, i]) for i in leaf_ids],
+            "internal_value": [float(a["node_value"][t, i])
+                               for i in internal_ids],
+            "internal_weight": [float(a["node_weight"][t, i])
+                                for i in internal_ids],
+            "internal_count": [int(a["node_count"][t, i])
+                               for i in internal_ids],
+        }
+        out = [f"Tree={t}", f"num_leaves={num_leaves}", "num_cat=0"]
+        for key, vals in rows.items():
+            out.append(f"{key}=" + " ".join(_fmt(v) for v in vals))
+        out.append("shrinkage=1")
+        return out
+
+    @staticmethod
+    def load_native(model_str: str) -> "Booster":
+        """Parse a LightGBM text model (ours or native LightGBM's)."""
+        header, trees = {}, []
+        average_output = False
+        cur: dict | None = None
+        for line in model_str.splitlines():
+            line = line.strip()
+            if line.startswith("Tree="):
+                cur = {}
+                trees.append(cur)
+                continue
+            if line == "end of trees":
+                cur = None
+                continue
+            if line == "average_output" and cur is None:
+                average_output = True
+                continue
+            if "=" in line:
+                k, v = line.split("=", 1)
+                (header if cur is None else cur)[k] = v
+        num_class = int(header.get("num_class", 1))
+        objective = header.get("objective", "regression").split()[0]
+        sigmoid = 1.0
+        for tokenised in header.get("objective", "").split():
+            if tokenised.startswith("sigmoid:"):
+                sigmoid = float(tokenised.split(":")[1])
+        T = len(trees)
+        max_leaves = max((int(t["num_leaves"]) for t in trees), default=1)
+        NN = 2 * max_leaves - 1
+        arr = {k: np.zeros((T, NN), dt) for k, dt in [
+            ("feature", np.int32), ("threshold", np.float32),
+            ("left", np.int32), ("right", np.int32),
+            ("leaf_value", np.float32), ("is_leaf", bool),
+            ("split_gain", np.float32), ("node_weight", np.float32),
+            ("node_count", np.float32), ("node_value", np.float32)]}
+        arr["num_nodes"] = np.zeros(T, np.int32)
+        for t, td in enumerate(trees):
+            nl = int(td["num_leaves"])
+            ni = nl - 1
+            def parse(key, dtype=float, default=0):
+                raw = td.get(key, "")
+                vals = [dtype(v) for v in raw.split()] if raw else []
+                return vals
+            sf = parse("split_feature", int)
+            thr = parse("threshold", float)
+            lc = parse("left_child", int)
+            rc = parse("right_child", int)
+            lv = parse("leaf_value", float)
+            lw = parse("leaf_weight", float)
+            lcnt = parse("leaf_count", float)
+            sg = parse("split_gain", float)
+            iv = parse("internal_value", float)
+            iw = parse("internal_weight", float)
+            icnt = parse("internal_count", float)
+            nn = ni + nl
+            arr["num_nodes"][t] = nn
+            # internal node i -> id i; leaf j -> id ni + j
+            def to_id(code):
+                return ni + (-code - 1) if code < 0 else code
+            for i in range(ni):
+                arr["feature"][t, i] = sf[i]
+                arr["threshold"][t, i] = thr[i]
+                arr["left"][t, i] = to_id(lc[i])
+                arr["right"][t, i] = to_id(rc[i])
+                arr["split_gain"][t, i] = sg[i] if i < len(sg) else 0
+                arr["node_value"][t, i] = iv[i] if i < len(iv) else 0
+                arr["node_weight"][t, i] = iw[i] if i < len(iw) else 0
+                arr["node_count"][t, i] = icnt[i] if i < len(icnt) else 0
+            for j in range(nl):
+                nid = ni + j
+                arr["is_leaf"][t, nid] = True
+                arr["leaf_value"][t, nid] = lv[j] if j < len(lv) else 0
+                arr["node_weight"][t, nid] = lw[j] if j < len(lw) else 0
+                arr["node_count"][t, nid] = lcnt[j] if j < len(lcnt) else 0
+            if nl == 1 and not lv:
+                arr["is_leaf"][t, 0] = True
+        names = header.get("feature_names", "").split()
+        return Booster(arr, num_class=num_class, objective=objective,
+                       sigmoid=sigmoid, feature_names=names or None,
+                       max_depth_bound=max_leaves,
+                       average_output=average_output)
+
+
+def _fmt(v) -> str:
+    if isinstance(v, int):
+        return str(v)
+    return np.format_float_scientific(v, unique=True).replace("e+0", "e+") \
+        .replace("e-0", "e-") if abs(v) > 1e4 or (v != 0 and abs(v) < 1e-4) \
+        else repr(float(v))
+
+
+def merge_boosters(first: Booster, second: Booster) -> Booster:
+    """Concatenate tree sequences (reference ``mergeBooster`` continuation,
+    ``booster/LightGBMBooster.scala:237-241``). The merged model keeps the
+    first booster's init score; the second must have been trained from the
+    first's predictions (init handled by the trainer)."""
+    a, b = first.arrays, second.arrays
+    nn = max(a["feature"].shape[1], b["feature"].shape[1])
+
+    def pad(arr_dict):
+        out = {}
+        for k, v in arr_dict.items():
+            if k == "num_nodes":
+                out[k] = v
+            elif v.shape[1] < nn:
+                pad_width = ((0, 0), (0, nn - v.shape[1]))
+                out[k] = np.pad(v, pad_width)
+            else:
+                out[k] = v
+        return out
+
+    pa, pb = pad(a), pad(b)
+    merged = {k: np.concatenate([pa[k], pb[k]]) for k in pa}
+    return Booster(
+        merged, num_class=first.num_class, objective=first.objective,
+        sigmoid=first.sigmoid, init_score=first.init_score,
+        feature_names=first.feature_names,
+        max_depth_bound=max(first.max_depth_bound, second.max_depth_bound),
+        tree_weights=np.concatenate([first.tree_weights,
+                                     second.tree_weights]),
+        average_output=first.average_output)
+
+
+# ------------------------------------------------------------ jitted predict
+@functools.partial(jax.jit, static_argnames=("max_depth",))
+def _predict_leaf_nodes(tree_arrays, x, *, max_depth: int):
+    feature, threshold, left, right, leaf_value, is_leaf = tree_arrays
+    T = feature.shape[0]
+    n = x.shape[0]
+    node = jnp.zeros((n, T), jnp.int32)
+    t_idx = jnp.arange(T)[None, :]
+
+    def step(_, node):
+        f = feature[t_idx, node]                      # [n, T]
+        thr = threshold[t_idx, node]
+        xv = jnp.take_along_axis(x, f.reshape(n, T), axis=1)
+        go_left = (xv <= thr) | jnp.isnan(xv)
+        nxt = jnp.where(go_left, left[t_idx, node], right[t_idx, node])
+        return jnp.where(is_leaf[t_idx, node], node, nxt)
+
+    return jax.lax.fori_loop(0, max_depth, step, node)
+
+
+@functools.partial(jax.jit, static_argnames=("max_depth",))
+def _predict_leaf_values(tree_arrays, x, *, max_depth: int):
+    leaves = _predict_leaf_nodes(tree_arrays, x, max_depth=max_depth)
+    leaf_value = tree_arrays[4]
+    T = leaf_value.shape[0]
+    return leaf_value[jnp.arange(T)[None, :], leaves]

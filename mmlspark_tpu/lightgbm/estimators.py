@@ -1,0 +1,377 @@
+"""LightGBMClassifier / LightGBMRegressor / LightGBMRanker pipeline stages.
+
+API parity with reference ``lightgbm/LightGBMClassifier.scala:26-208``,
+``LightGBMRegressor.scala``, ``LightGBMRanker.scala:80-110``,
+``LightGBMBase.scala:24-293`` (batch training with model continuation,
+validation early stopping, native-model export). The training engine is the
+jitted XLA tree grower in ``engine.py``/``trainer.py``.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from ..core import Estimator, Model, Param, TypeConverters as TC
+from ..core.contracts import (HasGroupCol, HasProbabilityCol,
+                              HasRawPredictionCol)
+from ..core.utils import as_2d_features
+from .booster import Booster
+from .params import LightGBMSharedParams
+from .ranker_objective import (build_group_index, make_lambdarank_grad_hess,
+                               ndcg_at_k)
+from .trainer import TrainConfig, TrainResult, train
+
+
+class _LightGBMBase(Estimator, LightGBMSharedParams):
+    """Template-method base (reference ``LightGBMBase.train``):
+    batching → data extraction → objective config → engine train → model."""
+
+    def _objective_config(self, y: np.ndarray) -> dict:
+        raise NotImplementedError
+
+    def _make_model(self, booster: Booster, result: TrainResult) -> Model:
+        raise NotImplementedError
+
+    def _grad_override(self, df, y):
+        return None
+
+    def _valid_eval_fn(self, valid_df):
+        return None
+
+    def _preprocess(self, df):
+        if self.getCategoricalSlotIndexes() or self.getCategoricalSlotNames():
+            # Set-based categorical splits are not implemented yet; integer
+            # category ids still get per-value bins (ordinal splits), which
+            # differs from LightGBM's k-vs-rest partitioning.
+            self._log_event(
+                "warn", message="categoricalSlotIndexes/Names are treated as "
+                "ordinal (set-based categorical splits not yet implemented)")
+        return df
+
+    def _fit(self, df):
+        df = self._preprocess(df)
+        num_batches = self.getNumBatches()
+        if num_batches and num_batches > 1:
+            parts = df.repartition(num_batches).partitions()
+        else:
+            parts = [df]
+
+        booster: Booster | None = None
+        if self.getModelString():
+            booster = Booster.load_native(self.getModelString())
+        result = None
+        for part in parts:
+            result = self._fit_batch(part, init_booster=booster)
+            booster = result.booster
+        model = self._make_model(booster, result)
+        self._copy_params_to(model)
+        return model
+
+    def _fit_batch(self, df, init_booster: Booster | None) -> TrainResult:
+        # ---- split validation rows (reference validationIndicatorCol)
+        valid = None
+        valid_eval_fn = None
+        train_df = df
+        if self.isSet("validationIndicatorCol"):
+            flag = np.asarray(df[self.getValidationIndicatorCol()],
+                              dtype=bool)
+            train_df = df.filter(~flag)
+            valid_df = df.filter(flag)
+            xv = as_2d_features(valid_df, self.getFeaturesCol())
+            yv = np.asarray(valid_df[self.getLabelCol()], np.float32)
+            wv = (np.asarray(valid_df[self.getWeightCol()], np.float32)
+                  if self.isSet("weightCol") else None)
+            valid = (xv, yv, wv)
+            valid_eval_fn = self._valid_eval_fn(valid_df)
+
+        x = as_2d_features(train_df, self.getFeaturesCol())
+        y = np.asarray(train_df[self.getLabelCol()], np.float32)
+        w = (np.asarray(train_df[self.getWeightCol()], np.float32)
+             if self.isSet("weightCol") else None)
+        init_scores = (np.asarray(train_df[self.getInitScoreCol()],
+                                  np.float32)
+                       if self.isSet("initScoreCol") else None)
+
+        cfg = TrainConfig(**self._train_config_kwargs(),
+                          **self._objective_config(y))
+        names = self.getSlotNames() or \
+            [f"Column_{i}" for i in range(x.shape[1])]
+        return train(x, y, w, cfg, valid=valid, init_booster=init_booster,
+                     init_scores=init_scores, feature_names=names,
+                     grad_hess_override=self._grad_override(train_df, y),
+                     valid_eval_fn=valid_eval_fn)
+
+
+class _BoosterModelMixin:
+    """Shared model surface: native export, importances, SHAP, leaves."""
+
+    leafPredictionCol = Param("leafPredictionCol",
+                              "output column with per-tree leaf indices",
+                              TC.toString)
+    featuresShapCol = Param("featuresShapCol",
+                            "output column with SHAP contributions",
+                            TC.toString)
+    numIterationsForPrediction = Param(
+        "numIterationsForPrediction",
+        "use only the first k iterations when predicting (0 = all/best)",
+        TC.toInt, default=0)
+
+    booster: Booster
+
+    def get_booster(self) -> Booster:
+        return self.booster
+
+    def save_native_model(self, path: str) -> None:
+        """Reference ``saveNativeModel`` — LightGBM text model format."""
+        with open(path, "w") as f:
+            f.write(self.booster.save_native())
+
+    saveNativeModel = save_native_model
+
+    def get_native_model_string(self) -> str:
+        return self.booster.save_native()
+
+    def get_feature_importances(self, importance_type: str = "split"):
+        return self.booster.feature_importances(importance_type).tolist()
+
+    getFeatureImportances = get_feature_importances
+
+    def _num_iter(self):
+        k = self.getNumIterationsForPrediction()
+        return k if k and k > 0 else None
+
+    def _maybe_extra_outputs(self, df, x):
+        out = df
+        if self.isSet("leafPredictionCol"):
+            leaves = self.booster.predict_leaf(x, self._num_iter())
+            out = out.with_column(self.getLeafPredictionCol(),
+                                  leaves.astype(np.float64))
+        if self.isSet("featuresShapCol"):
+            from .shap import booster_shap_values
+            shap = booster_shap_values(self.booster, x, x.shape[1])
+            out = out.with_column(self.getFeaturesShapCol(), shap)
+        return out
+
+    def _save_extra(self, path: str) -> None:
+        import os
+        # The text model is self-contained (init score folded into tree 0).
+        with open(os.path.join(path, "model.txt"), "w") as f:
+            f.write(self.booster.save_native())
+
+    def _load_extra(self, path: str) -> None:
+        import os
+        with open(os.path.join(path, "model.txt")) as f:
+            self.booster = Booster.load_native(f.read())
+
+
+# ------------------------------------------------------------------ classifier
+class LightGBMClassifier(_LightGBMBase, HasRawPredictionCol,
+                         HasProbabilityCol):
+    objective = Param("objective", "binary | multiclass", TC.toString,
+                      default="binary")
+    isUnbalance = Param("isUnbalance", "auto-weight positive class",
+                        TC.toBoolean, default=False)
+    scalePosWeight = Param("scalePosWeight", "positive class weight",
+                           TC.toFloat, default=1.0)
+    sigmoid = Param("sigmoid", "sigmoid sharpness", TC.toFloat, default=1.0)
+    numClass = Param("numClass", "class count (multiclass)", TC.toInt,
+                     default=1)
+    thresholds = Param("thresholds", "per-class prediction thresholds",
+                       TC.toListFloat, default=[])
+
+    def _objective_config(self, y):
+        objective = self.getObjective()
+        n_classes = int(y.max()) + 1 if y.size else 2
+        if objective == "binary" and n_classes > 2:
+            objective = "multiclass"
+        num_class = max(self.getNumClass(),
+                        n_classes if objective != "binary" else 1)
+        return dict(objective=objective, num_class=num_class,
+                    sigmoid=self.getSigmoid(),
+                    is_unbalance=self.getIsUnbalance(),
+                    scale_pos_weight=self.getScalePosWeight())
+
+    def _make_model(self, booster, result):
+        return LightGBMClassificationModel(booster=booster)
+
+
+class LightGBMClassificationModel(_BoosterModelMixin, Model,
+                                  LightGBMSharedParams, HasRawPredictionCol,
+                                  HasProbabilityCol):
+    thresholds = Param("thresholds", "per-class prediction thresholds",
+                       TC.toListFloat, default=[])
+
+    def __init__(self, booster: Booster | None = None, **kwargs):
+        super().__init__(**kwargs)
+        if booster is not None:
+            self.booster = booster
+
+    @property
+    def numClasses(self) -> int:
+        return max(self.booster.num_class, 2)
+
+    def _transform(self, df):
+        x = as_2d_features(df, self.getFeaturesCol())
+        raw = self.booster.raw_scores(x, self._num_iter())
+        prob = np.asarray(self.booster.transform_scores(raw))
+        if raw.ndim == 1:  # binary: expand to 2-class columns
+            raw2 = np.stack([-raw, raw], axis=1)
+            prob2 = np.stack([1 - prob, prob], axis=1)
+        else:
+            raw2, prob2 = raw, prob
+        thresholds = self.getThresholds()
+        if thresholds:
+            scaled = prob2 / np.asarray(thresholds)[None, :]
+            pred = scaled.argmax(axis=1).astype(np.float64)
+        else:
+            pred = prob2.argmax(axis=1).astype(np.float64)
+        out = (df.with_column(self.getRawPredictionCol(), raw2)
+                 .with_column(self.getProbabilityCol(), prob2)
+                 .with_column(self.getPredictionCol(), pred))
+        return self._maybe_extra_outputs(out, x)
+
+    @staticmethod
+    def load_native_model_from_string(model_str: str,
+                                      **kwargs) -> "LightGBMClassificationModel":
+        return LightGBMClassificationModel(
+            booster=Booster.load_native(model_str), **kwargs)
+
+    @staticmethod
+    def load_native_model_from_file(path: str,
+                                    **kwargs) -> "LightGBMClassificationModel":
+        with open(path) as f:
+            return LightGBMClassificationModel.load_native_model_from_string(
+                f.read(), **kwargs)
+
+    loadNativeModelFromString = load_native_model_from_string
+    loadNativeModelFromFile = load_native_model_from_file
+
+
+# ------------------------------------------------------------------- regressor
+class LightGBMRegressor(_LightGBMBase):
+    objective = Param("objective",
+                      "regression | regression_l1 | huber | fair | poisson | "
+                      "quantile | mape | gamma | tweedie", TC.toString,
+                      default="regression")
+    alpha = Param("alpha", "quantile level / huber delta", TC.toFloat,
+                  default=0.9)
+    fairC = Param("fairC", "fair-loss c", TC.toFloat, default=1.0)
+    tweedieVariancePower = Param("tweedieVariancePower",
+                                 "tweedie variance power in (1, 2)",
+                                 TC.toFloat, default=1.5)
+
+    def _objective_config(self, y):
+        return dict(objective=self.getObjective(), alpha=self.getAlpha(),
+                    fair_c=self.getFairC(),
+                    tweedie_variance_power=self.getTweedieVariancePower())
+
+    def _make_model(self, booster, result):
+        return LightGBMRegressionModel(booster=booster)
+
+
+class LightGBMRegressionModel(_BoosterModelMixin, Model,
+                              LightGBMSharedParams):
+    def __init__(self, booster: Booster | None = None, **kwargs):
+        super().__init__(**kwargs)
+        if booster is not None:
+            self.booster = booster
+
+    def _transform(self, df):
+        x = as_2d_features(df, self.getFeaturesCol())
+        raw = self.booster.raw_scores(x, self._num_iter())
+        pred = np.asarray(self.booster.transform_scores(raw))
+        out = df.with_column(self.getPredictionCol(), pred)
+        return self._maybe_extra_outputs(out, x)
+
+    @staticmethod
+    def load_native_model_from_string(model_str: str, **kwargs):
+        return LightGBMRegressionModel(
+            booster=Booster.load_native(model_str), **kwargs)
+
+    @staticmethod
+    def load_native_model_from_file(path: str, **kwargs):
+        with open(path) as f:
+            return LightGBMRegressionModel.load_native_model_from_string(
+                f.read(), **kwargs)
+
+    loadNativeModelFromString = load_native_model_from_string
+    loadNativeModelFromFile = load_native_model_from_file
+
+
+# --------------------------------------------------------------------- ranker
+class LightGBMRanker(_LightGBMBase, HasGroupCol):
+    objective = Param("objective", "lambdarank", TC.toString,
+                      default="lambdarank")
+    maxPosition = Param("maxPosition", "NDCG truncation for eval", TC.toInt,
+                        default=20)
+    truncationLevel = Param("truncationLevel",
+                            "lambdarank pair truncation level", TC.toInt,
+                            default=30)
+    evalAt = Param("evalAt", "NDCG@k eval positions", TC.toListInt,
+                   default=[1, 3, 5, 10])
+    repartitionByGroupingColumn = Param(
+        "repartitionByGroupingColumn",
+        "keep query groups contiguous (reference :92-101)", TC.toBoolean,
+        default=True)
+
+    def _preprocess(self, df):
+        # Reference LightGBMRanker.preprocessData: sort within partitions by
+        # group so each query's docs are contiguous.
+        if self.getRepartitionByGroupingColumn():
+            return df.sort(self.getGroupCol())
+        return df
+
+    def _objective_config(self, y):
+        return dict(objective="lambdarank")
+
+    def _grad_override(self, df, y):
+        groups = _group_ids(df[self.getGroupCol()])
+        gidx = build_group_index(groups)
+        return make_lambdarank_grad_hess(
+            np.asarray(y, np.float32), gidx,
+            truncation_level=self.getTruncationLevel())
+
+    def _valid_eval_fn(self, valid_df):
+        vgroups = _group_ids(valid_df[self.getGroupCol()])
+        k = self.getMaxPosition()
+
+        def eval_ndcg(raw_scores, yv, wv):
+            return ndcg_at_k(raw_scores, yv.astype(np.float64), vgroups, k=k)
+        return eval_ndcg
+
+    def _make_model(self, booster, result):
+        return LightGBMRankerModel(booster=booster)
+
+
+class LightGBMRankerModel(_BoosterModelMixin, Model, LightGBMSharedParams,
+                          HasGroupCol):
+    def __init__(self, booster: Booster | None = None, **kwargs):
+        super().__init__(**kwargs)
+        if booster is not None:
+            self.booster = booster
+
+    def _transform(self, df):
+        x = as_2d_features(df, self.getFeaturesCol())
+        raw = self.booster.raw_scores(x, self._num_iter())
+        out = df.with_column(self.getPredictionCol(), np.asarray(raw))
+        return self._maybe_extra_outputs(out, x)
+
+    def evaluate_ndcg(self, df, k: int = 10) -> float:
+        scored = self.transform(df)
+        return ndcg_at_k(np.asarray(scored[self.getPredictionCol()]),
+                         np.asarray(scored[self.getLabelCol()], np.float64),
+                         _group_ids(scored[self.getGroupCol()]), k=k)
+
+    @staticmethod
+    def load_native_model_from_string(model_str: str, **kwargs):
+        return LightGBMRankerModel(
+            booster=Booster.load_native(model_str), **kwargs)
+
+    loadNativeModelFromString = load_native_model_from_string
+
+
+def _group_ids(col: np.ndarray) -> np.ndarray:
+    """Group column (int/string, reference supports both) → dense int ids."""
+    _, ids = np.unique(np.asarray([str(v) for v in col.tolist()]),
+                       return_inverse=True)
+    return ids

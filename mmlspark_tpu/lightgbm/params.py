@@ -1,0 +1,158 @@
+"""Shared LightGBM-style parameter surface.
+
+Mirrors reference ``lightgbm/params/LightGBMParams.scala`` (469 LoC, ~60
+params) with the same names and defaults so pipelines port unchanged. Params
+that configured the reference's socket mesh (ports, timeouts, barrier mode)
+are kept for API compatibility but are inert — the TPU engine coordinates
+through XLA collectives, not TCP rendezvous.
+"""
+
+from __future__ import annotations
+
+from ..core import Param, TypeConverters as TC, UDFParam
+from ..core.contracts import (HasFeaturesCol, HasInitScoreCol, HasLabelCol,
+                              HasPredictionCol, HasValidationIndicatorCol,
+                              HasWeightCol)
+
+
+class LightGBMExecutionParams:
+    """Execution topology params — reference ``LightGBMParams.scala``.
+
+    ``parallelism``/``topK`` select the distributed histogram mode
+    (data_parallel = full psum, voting_parallel = top-K gather);
+    ``numShards``/``shardAxisName`` size the device mesh (the analogue of
+    Spark task count). Networking params are inert (kept for parity).
+    """
+    parallelism = Param("parallelism",
+                        "data_parallel | voting_parallel", TC.toString,
+                        default="data_parallel")
+    topK = Param("topK", "top-K features per shard in voting parallel",
+                 TC.toInt, default=20)
+    numShards = Param("numShards",
+                      "device shards for training (0 = all devices)",
+                      TC.toInt, default=0)
+    shardAxisName = Param("shardAxisName", "mesh axis to shard rows over",
+                          TC.toString, default="dp")
+    useBarrierExecutionMode = Param("useBarrierExecutionMode",
+                                    "inert; SPMD is inherently barriered",
+                                    TC.toBoolean, default=False)
+    defaultListenPort = Param("defaultListenPort", "inert (no socket mesh)",
+                              TC.toInt, default=12400)
+    timeout = Param("timeout", "inert (no socket mesh)", TC.toFloat,
+                    default=1200.0)
+    numBatches = Param("numBatches",
+                       "split training into sequential batches with model "
+                       "continuation", TC.toInt, default=0)
+    numThreads = Param("numThreads", "host threads (0 = XLA default)",
+                       TC.toInt, default=0)
+
+
+class LightGBMLearnerParams:
+    numIterations = Param("numIterations", "boosting rounds", TC.toInt,
+                          default=100)
+    learningRate = Param("learningRate", "shrinkage rate", TC.toFloat,
+                         default=0.1)
+    numLeaves = Param("numLeaves", "max leaves per tree", TC.toInt,
+                      default=31)
+    maxDepth = Param("maxDepth", "max tree depth (<=0 unlimited)", TC.toInt,
+                     default=-1)
+    maxBin = Param("maxBin", "max feature bins", TC.toInt, default=255)
+    binSampleCount = Param("binSampleCount",
+                           "rows sampled for bin boundaries", TC.toInt,
+                           default=200000)
+    lambdaL1 = Param("lambdaL1", "L1 regularization", TC.toFloat, default=0.0)
+    lambdaL2 = Param("lambdaL2", "L2 regularization", TC.toFloat, default=0.0)
+    minSumHessianInLeaf = Param("minSumHessianInLeaf",
+                                "min hessian mass per leaf", TC.toFloat,
+                                default=1e-3)
+    minDataInLeaf = Param("minDataInLeaf", "min rows per leaf", TC.toInt,
+                          default=20)
+    minGainToSplit = Param("minGainToSplit", "min split gain", TC.toFloat,
+                           default=0.0)
+    featureFraction = Param("featureFraction", "feature subsample per tree",
+                            TC.toFloat, default=1.0)
+    baggingFraction = Param("baggingFraction", "row subsample fraction",
+                            TC.toFloat, default=1.0)
+    baggingFreq = Param("baggingFreq", "re-bag every k iterations", TC.toInt,
+                        default=0)
+    baggingSeed = Param("baggingSeed", "bagging seed", TC.toInt, default=3)
+    boostingType = Param("boostingType", "gbdt | rf | dart | goss",
+                         TC.toString, default="gbdt")
+    topRate = Param("topRate", "GOSS top-gradient keep rate", TC.toFloat,
+                    default=0.2)
+    otherRate = Param("otherRate", "GOSS random keep rate", TC.toFloat,
+                      default=0.1)
+    dropRate = Param("dropRate", "DART tree dropout rate", TC.toFloat,
+                     default=0.1)
+    maxDrop = Param("maxDrop", "DART max dropped trees", TC.toInt, default=50)
+    skipDrop = Param("skipDrop", "DART prob of skipping dropout", TC.toFloat,
+                     default=0.5)
+    uniformDrop = Param("uniformDrop", "DART uniform dropout", TC.toBoolean,
+                        default=False)
+    earlyStoppingRound = Param("earlyStoppingRound",
+                               "stop after k rounds without val improvement",
+                               TC.toInt, default=0)
+    metric = Param("metric", "eval metric ('' = objective default)",
+                   TC.toString, default="")
+    boostFromAverage = Param("boostFromAverage",
+                             "init score from label average", TC.toBoolean,
+                             default=True)
+    seed = Param("seed", "random seed", TC.toInt, default=0)
+    verbosity = Param("verbosity", "log level", TC.toInt, default=-1)
+    categoricalSlotIndexes = Param("categoricalSlotIndexes",
+                                   "feature slots treated as categorical",
+                                   TC.toListInt, default=[])
+    categoricalSlotNames = Param("categoricalSlotNames",
+                                 "feature names treated as categorical",
+                                 TC.toListString, default=[])
+    slotNames = Param("slotNames", "feature names", TC.toListString,
+                      default=[])
+    modelString = Param("modelString",
+                        "initial model string for continuation", TC.toString,
+                        default="")
+    fobj = UDFParam("fobj",
+                    "custom objective: (scores, labels, weights) -> "
+                    "(grad, hess), must be jittable")
+    isProvideTrainingMetric = Param("isProvideTrainingMetric",
+                                    "record metrics on training data",
+                                    TC.toBoolean, default=False)
+
+
+class LightGBMSharedParams(LightGBMExecutionParams, LightGBMLearnerParams,
+                           HasFeaturesCol, HasLabelCol, HasWeightCol,
+                           HasInitScoreCol, HasValidationIndicatorCol,
+                           HasPredictionCol):
+    """Everything shared by classifier / regressor / ranker."""
+
+    def _train_config_kwargs(self) -> dict:
+        return dict(
+            num_iterations=self.getNumIterations(),
+            learning_rate=self.getLearningRate(),
+            num_leaves=self.getNumLeaves(),
+            max_depth=self.getMaxDepth(),
+            max_bin=self.getMaxBin(),
+            lambda_l1=self.getLambdaL1(),
+            lambda_l2=self.getLambdaL2(),
+            min_data_in_leaf=self.getMinDataInLeaf(),
+            min_sum_hessian_in_leaf=self.getMinSumHessianInLeaf(),
+            min_gain_to_split=self.getMinGainToSplit(),
+            feature_fraction=self.getFeatureFraction(),
+            bagging_fraction=self.getBaggingFraction(),
+            bagging_freq=self.getBaggingFreq(),
+            boosting_type=self.getBoostingType(),
+            top_rate=self.getTopRate(),
+            other_rate=self.getOtherRate(),
+            drop_rate=self.getDropRate(),
+            max_drop=self.getMaxDrop(),
+            skip_drop=self.getSkipDrop(),
+            uniform_drop=self.getUniformDrop(),
+            boost_from_average=self.getBoostFromAverage(),
+            seed=self.getSeed(),
+            bagging_seed=self.getBaggingSeed(),
+            bin_sample_count=self.getBinSampleCount(),
+            early_stopping_round=self.getEarlyStoppingRound(),
+            metric=self.getMetric(),
+            is_provide_training_metric=self.getIsProvideTrainingMetric(),
+            verbosity=self.getVerbosity(),
+            fobj=self.get("fobj"),
+        )

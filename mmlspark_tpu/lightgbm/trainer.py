@@ -1,0 +1,458 @@
+"""The boosting loop: objectives → trees → scores, with all four boosting
+modes, sampling, and early stopping.
+
+Role of the reference's ``trainCore`` iteration loop
+(``lightgbm/TrainUtils.scala:360-427``: update-one-iter, eval metrics, early
+stopping, delegate hooks) — but the "update one iteration" is our own jitted
+tree grower rather than a JNI call, and per-iteration score updates are O(n)
+gathers instead of full re-predicts.
+
+Boosting modes (reference ``boostingType`` param, ``LightGBMConstants``):
+  gbdt — standard gradient boosting
+  rf   — random forest: bagged trees on constant init scores, averaged
+  dart — dropout: random subset of prior trees dropped when computing
+         gradients, new tree + dropped trees rescaled (Rashmi & Gilad-Bachrach)
+  goss — gradient one-side sampling: keep top-|g| rows, subsample the rest
+         with amplification (1-a)/b
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Callable
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from .binning import bin_features, compute_bin_boundaries, bin_upper_value
+from .booster import Booster
+from .engine import Tree, TreeParams, grow_tree, tree_route_bins
+from .objectives import Objective, get_objective
+
+
+@dataclasses.dataclass
+class TrainConfig:
+    objective: str = "regression"
+    num_iterations: int = 100
+    learning_rate: float = 0.1
+    num_leaves: int = 31
+    max_depth: int = -1
+    max_bin: int = 255
+    lambda_l1: float = 0.0
+    lambda_l2: float = 0.0
+    min_data_in_leaf: int = 20
+    min_sum_hessian_in_leaf: float = 1e-3
+    min_gain_to_split: float = 0.0
+    feature_fraction: float = 1.0
+    bagging_fraction: float = 1.0
+    bagging_freq: int = 0
+    boosting_type: str = "gbdt"
+    top_rate: float = 0.2          # goss
+    other_rate: float = 0.1        # goss
+    drop_rate: float = 0.1         # dart
+    max_drop: int = 50             # dart
+    skip_drop: float = 0.5         # dart
+    uniform_drop: bool = False     # dart (parity; sampling is uniform)
+    num_class: int = 1
+    sigmoid: float = 1.0
+    alpha: float = 0.9             # quantile / huber
+    fair_c: float = 1.0
+    tweedie_variance_power: float = 1.5
+    is_unbalance: bool = False
+    scale_pos_weight: float = 1.0
+    boost_from_average: bool = True
+    seed: int = 0
+    bagging_seed: int = 3
+    bin_sample_count: int = 200_000
+    early_stopping_round: int = 0
+    metric: str = ""
+    is_provide_training_metric: bool = False
+    verbosity: int = -1
+    # engine plumbing
+    psum_axis: str | None = None
+    fobj: Callable | None = None
+
+    def tree_params(self) -> TreeParams:
+        return TreeParams(
+            num_leaves=self.num_leaves, max_depth=self.max_depth,
+            max_bin=self.max_bin, learning_rate=self.learning_rate,
+            lambda_l1=self.lambda_l1, lambda_l2=self.lambda_l2,
+            min_data_in_leaf=self.min_data_in_leaf,
+            min_sum_hessian_in_leaf=self.min_sum_hessian_in_leaf,
+            min_gain_to_split=self.min_gain_to_split)
+
+
+def _apply_delta(scores, delta, k_cls: int, K: int):
+    if K == 1:
+        return scores + delta
+    return scores.at[:, k_cls].add(delta)
+
+
+def _select_class(scores, k_cls: int, K: int):
+    return scores if K == 1 else scores[:, k_cls]
+
+
+def _set_class(scores, value, k_cls: int, K: int):
+    if K == 1:
+        return value
+    return scores.at[:, k_cls].set(value)
+
+
+@dataclasses.dataclass
+class TrainResult:
+    booster: Booster
+    evals: list[dict]
+    best_iteration: int
+
+
+def train(x: np.ndarray, y: np.ndarray, w: np.ndarray | None,
+          config: TrainConfig,
+          valid: tuple[np.ndarray, np.ndarray, np.ndarray | None]
+          | None = None,
+          init_booster: Booster | None = None,
+          init_scores: np.ndarray | None = None,
+          feature_names: list[str] | None = None,
+          grad_hess_override: Callable | None = None,
+          valid_eval_fn: Callable | None = None,
+          delegate=None) -> TrainResult:
+    """Single-host training. x [n, F] float32 (NaN = missing), y [n].
+
+    ``grad_hess_override`` lets the ranker inject lambdarank gradients (it
+    receives raw scores and returns (grad, hess)). ``init_scores`` is the
+    per-row warm start (reference ``initScoreCol``).
+    """
+    cfg = config
+    n, F = x.shape
+    rng = np.random.default_rng(cfg.seed)
+    bag_rng = np.random.default_rng(cfg.bagging_seed)
+    w_np = np.ones(n, np.float32) if w is None else np.asarray(w, np.float32)
+
+    pos_weight = cfg.scale_pos_weight
+    if cfg.is_unbalance and cfg.objective == "binary":
+        npos = float((y > 0).sum())
+        nneg = float(n - npos)
+        pos_weight = nneg / max(npos, 1.0)
+
+    if cfg.fobj is not None:
+        from .objectives import custom_objective
+        obj = custom_objective(cfg.fobj)
+    else:
+        obj = get_objective(
+            cfg.objective, num_class=cfg.num_class, alpha=cfg.alpha,
+            fair_c=cfg.fair_c,
+            tweedie_variance_power=cfg.tweedie_variance_power,
+            sigmoid=cfg.sigmoid, pos_weight=pos_weight,
+            boost_from_average=cfg.boost_from_average)
+
+    K = max(obj.num_model_per_iter, 1)
+    tp = cfg.tree_params()
+
+    # ---- binning (host boundaries, device mapping)
+    boundaries = compute_bin_boundaries(x, cfg.max_bin,
+                                        sample_cnt=cfg.bin_sample_count,
+                                        seed=cfg.seed)
+    bins = bin_features(jnp.asarray(x, jnp.float32), jnp.asarray(boundaries))
+    y_dev = jnp.asarray(y, jnp.float32)
+    w_dev = jnp.asarray(w_np)
+
+    # ---- init scores
+    if init_scores is not None:
+        base_score = np.zeros(K, np.float32) if K > 1 else \
+            np.float32(0.0)
+        scores = jnp.asarray(init_scores, jnp.float32)
+        if K > 1 and scores.ndim == 1:
+            scores = jnp.broadcast_to(scores[:, None], (n, K))
+    elif init_booster is not None and init_booster.num_trees > 0:
+        init_raw = init_booster.raw_scores(x)
+        scores = jnp.asarray(init_raw, jnp.float32).reshape(n, K) \
+            if K > 1 else jnp.asarray(init_raw, jnp.float32)
+        base_score = init_booster.init_score
+    else:
+        base = obj.init_score(np.asarray(y), w_np)
+        base_score = np.asarray(base, np.float32)
+        scores = jnp.broadcast_to(
+            jnp.asarray(base_score, jnp.float32).reshape(1, -1),
+            (n, K)).astype(jnp.float32)
+        scores = scores[:, 0] if K == 1 else scores
+
+    is_rf = cfg.boosting_type == "rf"
+    is_dart = cfg.boosting_type == "dart"
+    is_goss = cfg.boosting_type == "goss"
+
+    trees: list[Tree] = []
+    tree_class: list[int] = []           # class index of each tree
+    tree_deltas: list[jnp.ndarray] = []  # dart: cached per-tree train deltas
+    tree_vdeltas: list = []              # dart: cached per-tree valid deltas
+    tree_weights: list[float] = []
+
+    def base_flat(k_cls: int):
+        b = np.asarray(base_score).reshape(-1)
+        return float(b[k_cls] if b.size > 1 else b[0])
+    evals: list[dict] = []
+    best_iter, best_metric, rounds_no_improve = -1, None, 0
+    bag_mask = np.ones(n, np.float32)
+
+    # validation setup
+    if valid is not None:
+        xv, yv, wv = valid
+        vbins = bin_features(jnp.asarray(xv, jnp.float32),
+                             jnp.asarray(boundaries))
+        nv = xv.shape[0]
+        vscores = jnp.broadcast_to(
+            jnp.asarray(base_score, jnp.float32).reshape(1, -1),
+            (nv, K)).astype(jnp.float32)
+        vscores = vscores[:, 0] if K == 1 else vscores
+        if init_booster is not None and init_booster.num_trees > 0:
+            vraw = init_booster.raw_scores(xv)
+            vscores = jnp.asarray(vraw, jnp.float32)
+    metric_name = cfg.metric or _default_metric(cfg.objective)
+
+    for it in range(cfg.num_iterations):
+        if delegate is not None:
+            lr = delegate.get_learning_rate(it)
+            if lr is not None and lr != tp.learning_rate:
+                tp = tp._replace(learning_rate=float(lr))
+            delegate.before_train_iteration(it)
+
+        # ---- dart: drop trees for gradient computation
+        new_tree_weight = 1.0
+        dropped: list[int] = []
+        eff_scores = scores
+        if is_dart and trees and rng.random() >= cfg.skip_drop:
+            k_drop = min(cfg.max_drop,
+                         max(1, int(round(cfg.drop_rate * len(trees)))))
+            dropped = sorted(
+                rng.choice(len(trees), size=min(k_drop, len(trees)),
+                           replace=False).tolist())
+            for d in dropped:
+                eff_scores = _apply_delta(
+                    eff_scores, -tree_deltas[d] * tree_weights[d],
+                    tree_class[d], K)
+            # DART normalization: k dropped trees rescale by k/(k+1), the
+            # new tree enters at 1/(k+1).
+            new_tree_weight = 1.0 / (len(dropped) + 1)
+
+        # ---- gradients
+        score_for_grad = (jnp.zeros_like(scores) + base_score) if is_rf \
+            else eff_scores
+        if grad_hess_override is not None:
+            g, h = grad_hess_override(score_for_grad)
+        else:
+            g, h = obj.grad_hess(score_for_grad, y_dev, w_dev)
+
+        # ---- row sampling
+        row_mask = np.ones(n, np.float32)
+        if is_goss:
+            gmag = np.asarray(jnp.abs(g) if g.ndim == 1
+                              else jnp.linalg.norm(g, axis=1))
+            top_n = int(cfg.top_rate * n)
+            other_n = int(cfg.other_rate * n)
+            order = np.argsort(-gmag)
+            row_mask = np.zeros(n, np.float32)
+            row_mask[order[:top_n]] = 1.0
+            rest = order[top_n:]
+            if other_n > 0 and rest.size:
+                chosen = rng.choice(rest, size=min(other_n, rest.size),
+                                    replace=False)
+                row_mask[chosen] = (1.0 - cfg.top_rate) / cfg.other_rate
+        elif (is_rf or cfg.bagging_freq > 0) and cfg.bagging_fraction < 1.0:
+            if is_rf or it % max(cfg.bagging_freq, 1) == 0:
+                bag_mask = (bag_rng.random(n)
+                            < cfg.bagging_fraction).astype(np.float32)
+            row_mask = bag_mask
+
+        # ---- feature sampling
+        feat_mask = np.ones(F, bool)
+        if cfg.feature_fraction < 1.0:
+            k = max(1, int(round(cfg.feature_fraction * F)))
+            feat_mask = np.zeros(F, bool)
+            feat_mask[rng.choice(F, size=k, replace=False)] = True
+
+        row_mask_dev = jnp.asarray(row_mask)
+        feat_mask_dev = jnp.asarray(feat_mask)
+
+        for k_cls in range(K):
+            gk = g if K == 1 else g[:, k_cls]
+            hk = h if K == 1 else h[:, k_cls]
+            tree, row_leaf = grow_tree(
+                bins, gk, hk, feat_mask_dev, row_mask_dev,
+                params=tp, num_features=F, psum_axis=None)
+            delta = tree.leaf_value[row_leaf]
+
+            trees.append(jax.tree.map(np.asarray, tree))
+            tree_class.append(k_cls)
+            tree_weights.append(new_tree_weight if is_dart else 1.0)
+            vdelta = None
+            if valid is not None:
+                vleaf = tree_route_bins(tree, vbins,
+                                        max_depth=cfg.num_leaves)
+                vdelta = tree.leaf_value[vleaf]
+            if is_dart:
+                tree_deltas.append(delta)
+                tree_vdeltas.append(vdelta)
+
+            if is_rf:
+                # running average of tree outputs per class
+                m = it + 1
+                prev = _select_class(scores, k_cls, K) - base_flat(k_cls)
+                scores = _set_class(
+                    scores, base_flat(k_cls) + prev + (delta - prev) / m,
+                    k_cls, K)
+                if valid is not None:
+                    vprev = _select_class(vscores, k_cls, K) \
+                        - base_flat(k_cls)
+                    vscores = _set_class(
+                        vscores,
+                        base_flat(k_cls) + vprev + (vdelta - vprev) / m,
+                        k_cls, K)
+            else:
+                scores = _apply_delta(scores, delta * new_tree_weight,
+                                      k_cls, K)
+                if valid is not None:
+                    vscores = _apply_delta(vscores,
+                                           vdelta * new_tree_weight,
+                                           k_cls, K)
+
+        if is_dart and dropped:
+            # rescale dropped trees' standing contribution by k/(k+1)
+            factor = len(dropped) / (len(dropped) + 1.0)
+            for d in dropped:
+                adj = tree_deltas[d] * (tree_weights[d] * (factor - 1.0))
+                scores = _apply_delta(scores, adj, tree_class[d], K)
+                if valid is not None and tree_vdeltas[d] is not None:
+                    vadj = tree_vdeltas[d] * (tree_weights[d]
+                                              * (factor - 1.0))
+                    vscores = _apply_delta(vscores, vadj, tree_class[d], K)
+                tree_weights[d] *= factor
+
+        # ---- eval + early stopping
+        if cfg.is_provide_training_metric:
+            train_metric = metric_name if metric_name != "ndcg" else "rmse"
+            evals.append({"iteration": it, "dataset": "train",
+                          train_metric: eval_metric(
+                              train_metric, np.asarray(scores),
+                              np.asarray(y), w_np, cfg)})
+        if valid is not None:
+            if valid_eval_fn is not None:
+                m = valid_eval_fn(np.asarray(vscores), np.asarray(yv),
+                                  None if wv is None else np.asarray(wv))
+            else:
+                m = eval_metric(metric_name, np.asarray(vscores),
+                                np.asarray(yv),
+                                None if wv is None else np.asarray(wv), cfg)
+            evals.append({"iteration": it, metric_name: m})
+            better = (best_metric is None
+                      or (m > best_metric if _higher_better(metric_name)
+                          else m < best_metric))
+            if better:
+                best_metric, best_iter, rounds_no_improve = m, it, 0
+            else:
+                rounds_no_improve += 1
+            if (cfg.early_stopping_round > 0
+                    and rounds_no_improve >= cfg.early_stopping_round):
+                break
+        if delegate is not None:
+            delegate.after_train_iteration(it)
+
+    booster = build_booster(trees, boundaries, cfg, base_score,
+                            feature_names, np.asarray(tree_weights,
+                                                      np.float32),
+                            average_output=is_rf)
+    prior_iters = 0
+    if init_booster is not None and init_booster.num_trees > 0:
+        from .booster import merge_boosters
+        booster = merge_boosters(init_booster, booster)
+        prior_iters = init_booster.num_trees // max(K, 1)
+    if best_iter >= 0:
+        booster.best_iteration = best_iter + prior_iters
+    return TrainResult(booster=booster, evals=evals, best_iteration=best_iter)
+
+
+def build_booster(trees: list[Tree], boundaries: np.ndarray,
+                  cfg: TrainConfig, base_score, feature_names,
+                  tree_weights: np.ndarray | None = None,
+                  average_output: bool = False) -> Booster:
+    T = len(trees)
+    NN = 2 * cfg.num_leaves - 1
+    arr = {k: np.zeros((T, NN), dt) for k, dt in [
+        ("feature", np.int32), ("threshold", np.float32),
+        ("left", np.int32), ("right", np.int32),
+        ("leaf_value", np.float32), ("is_leaf", bool),
+        ("split_gain", np.float32), ("node_weight", np.float32),
+        ("node_count", np.float32), ("node_value", np.float32)]}
+    arr["num_nodes"] = np.zeros(T, np.int32)
+    for t, tree in enumerate(trees):
+        arr["feature"][t] = tree.feature
+        arr["left"][t] = tree.left
+        arr["right"][t] = tree.right
+        arr["leaf_value"][t] = tree.leaf_value
+        arr["is_leaf"][t] = tree.is_leaf
+        arr["split_gain"][t] = tree.split_gain
+        arr["node_weight"][t] = tree.node_weight
+        arr["node_count"][t] = tree.node_count
+        arr["node_value"][t] = tree.node_value
+        arr["num_nodes"][t] = tree.num_nodes
+        for i in range(int(tree.num_nodes)):
+            if not tree.is_leaf[i] and tree.left[i] >= 0:
+                arr["threshold"][t, i] = bin_upper_value(
+                    boundaries, int(tree.feature[i]),
+                    int(tree.split_bin[i]))
+    return Booster(arr, num_class=cfg.num_class, objective=cfg.objective,
+                   sigmoid=cfg.sigmoid, init_score=base_score,
+                   feature_names=feature_names,
+                   max_depth_bound=cfg.num_leaves,
+                   tree_weights=tree_weights, average_output=average_output)
+
+
+# --------------------------------------------------------------- eval metrics
+def _default_metric(objective: str) -> str:
+    return {"binary": "auc", "multiclass": "multi_logloss",
+            "softmax": "multi_logloss", "lambdarank": "ndcg",
+            "regression_l1": "mae"}.get(objective, "rmse")
+
+
+def _higher_better(metric: str) -> bool:
+    return metric in ("auc", "ndcg", "map", "accuracy")
+
+
+def eval_metric(name: str, raw_scores: np.ndarray, y: np.ndarray,
+                w: np.ndarray | None, cfg: TrainConfig) -> float:
+    w = np.ones(len(y)) if w is None else w
+    if name == "rmse":
+        return float(np.sqrt(np.average((raw_scores - y) ** 2, weights=w)))
+    if name == "mae":
+        return float(np.average(np.abs(raw_scores - y), weights=w))
+    if name == "auc":
+        p = raw_scores
+        return roc_auc(y, p, w)
+    if name == "binary_logloss":
+        p = 1 / (1 + np.exp(-cfg.sigmoid * raw_scores))
+        p = np.clip(p, 1e-15, 1 - 1e-15)
+        return float(-np.average(y * np.log(p) + (1 - y) * np.log(1 - p),
+                                 weights=w))
+    if name == "multi_logloss":
+        e = np.exp(raw_scores - raw_scores.max(axis=1, keepdims=True))
+        p = e / e.sum(axis=1, keepdims=True)
+        py = np.clip(p[np.arange(len(y)), y.astype(int)], 1e-15, None)
+        return float(-np.average(np.log(py), weights=w))
+    if name.startswith("ndcg"):
+        raise ValueError(
+            "ndcg requires group information; the ranker supplies a "
+            "group-aware valid_eval_fn")
+    raise ValueError(f"unknown metric {name!r}")
+
+
+def roc_auc(y: np.ndarray, score: np.ndarray,
+            w: np.ndarray | None = None) -> float:
+    """Weighted ROC AUC via the rank formulation (no sklearn dependency in
+    the hot path)."""
+    w = np.ones(len(y)) if w is None else w
+    order = np.argsort(score, kind="mergesort")
+    y_s, w_s = y[order], w[order]
+    pos = w_s * (y_s > 0)
+    neg = w_s * (y_s <= 0)
+    cum_neg = np.cumsum(neg)
+    auc_sum = np.sum(pos * (cum_neg - 0.5 * neg))
+    total = pos.sum() * neg.sum()
+    return float(auc_sum / total) if total > 0 else 0.5

@@ -1,0 +1,236 @@
+import numpy as np
+import pytest
+
+from mmlspark_tpu.core import DataFrame, load_stage
+from mmlspark_tpu.lightgbm import (Booster, LightGBMClassificationModel,
+                                   LightGBMClassifier, LightGBMRanker,
+                                   LightGBMRegressor, roc_auc)
+
+
+def classification_df(n=400, seed=0):
+    from sklearn.datasets import make_classification
+    X, y = make_classification(n_samples=n, n_features=10, n_informative=5,
+                               random_state=seed)
+    return DataFrame({"features": X.astype(np.float32),
+                      "label": y.astype(np.float32)})
+
+
+def small_params():
+    return dict(numIterations=20, numLeaves=7, minDataInLeaf=5,
+                learningRate=0.2)
+
+
+@pytest.fixture(scope="module")
+def binary_model_and_df():
+    df = classification_df()
+    model = LightGBMClassifier(**small_params()).fit(df)
+    return model, df
+
+
+def test_binary_classification_auc(binary_model_and_df):
+    model, df = binary_model_and_df
+    out = model.transform(df)
+    assert out["probability"].shape == (400, 2)
+    assert out["rawPrediction"].shape == (400, 2)
+    auc = roc_auc(np.asarray(df["label"]), out["probability"][:, 1])
+    assert auc > 0.95, auc
+    acc = (out["prediction"] == df["label"]).mean()
+    assert acc > 0.85
+
+
+def test_save_load_roundtrip(binary_model_and_df, tmp_path):
+    model, df = binary_model_and_df
+    expected = model.transform(df)["probability"]
+    model.save(str(tmp_path / "m"))
+    loaded = load_stage(str(tmp_path / "m"))
+    np.testing.assert_allclose(loaded.transform(df)["probability"], expected,
+                               rtol=1e-5)
+
+
+def test_native_model_string_roundtrip(binary_model_and_df, tmp_path):
+    model, df = binary_model_and_df
+    x = df["features"]
+    expected = model.booster.raw_scores(x)
+    text = model.get_native_model_string()
+    assert "tree" in text and "split_feature=" in text
+    re = Booster.load_native(text)
+    got = re.raw_scores(x)
+    np.testing.assert_allclose(got, expected, rtol=1e-4, atol=1e-5)
+
+
+def test_feature_importances(binary_model_and_df):
+    model, _ = binary_model_and_df
+    imp_split = np.asarray(model.get_feature_importances("split"))
+    imp_gain = np.asarray(model.get_feature_importances("gain"))
+    assert imp_split.sum() > 0 and imp_gain.sum() > 0
+    with pytest.raises(ValueError):
+        model.get_feature_importances("banana")
+
+
+def test_leaf_prediction_and_shap(binary_model_and_df):
+    model, df = binary_model_and_df
+    small = df.limit(10)
+    m = model.copy({"leafPredictionCol": "leaves",
+                    "featuresShapCol": "shap"})
+    out = m.transform(small)
+    assert out["leaves"].shape == (10, model.booster.num_trees)
+    shap = out["shap"]
+    assert shap.shape == (10, 11)
+    raw = model.booster.raw_scores(small["features"])
+    np.testing.assert_allclose(shap.sum(axis=1), raw, rtol=1e-3, atol=1e-3)
+
+
+def test_multiclass():
+    from sklearn.datasets import load_iris
+    X, y = load_iris(return_X_y=True)
+    df = DataFrame({"features": X.astype(np.float32),
+                    "label": y.astype(np.float32)})
+    model = LightGBMClassifier(objective="multiclass",
+                               **small_params()).fit(df)
+    out = model.transform(df)
+    assert out["probability"].shape == (150, 3)
+    np.testing.assert_allclose(out["probability"].sum(axis=1), 1.0,
+                               rtol=1e-5)
+    assert (out["prediction"] == y).mean() > 0.9
+
+
+def test_regression_modes():
+    rng = np.random.default_rng(0)
+    X = rng.normal(size=(300, 5)).astype(np.float32)
+    y = (X[:, 0] * 3 + X[:, 1] ** 2 + rng.normal(0, 0.1, 300)).astype(
+        np.float32)
+    df = DataFrame({"features": X, "label": y})
+    for objective in ["regression", "regression_l1", "huber", "quantile"]:
+        model = LightGBMRegressor(objective=objective,
+                                  **small_params()).fit(df)
+        pred = model.transform(df)["prediction"]
+        assert np.isfinite(pred).all()
+    model = LightGBMRegressor(objective="regression",
+                              **small_params()).fit(df)
+    rmse = float(np.sqrt(np.mean((model.transform(df)["prediction"] - y) ** 2)))
+    assert rmse < np.std(y), (rmse, np.std(y))
+
+
+def test_poisson_positive_output():
+    rng = np.random.default_rng(1)
+    X = rng.normal(size=(200, 3)).astype(np.float32)
+    y = rng.poisson(np.exp(0.5 * X[:, 0] + 1)).astype(np.float32)
+    df = DataFrame({"features": X, "label": y})
+    model = LightGBMRegressor(objective="poisson", **small_params()).fit(df)
+    assert (model.transform(df)["prediction"] > 0).all()
+
+
+def test_boosting_modes():
+    df = classification_df(300)
+    y = np.asarray(df["label"])
+    for mode in ["gbdt", "goss", "dart", "rf"]:
+        params = small_params()
+        if mode == "rf":
+            params.update(baggingFraction=0.8, baggingFreq=1)
+        model = LightGBMClassifier(boostingType=mode, **params).fit(df)
+        out = model.transform(df)
+        auc = roc_auc(y, out["probability"][:, 1])
+        assert auc > 0.8, (mode, auc)
+
+
+def test_dart_multiclass_and_roundtrip(tmp_path):
+    from sklearn.datasets import load_iris
+    X, y = load_iris(return_X_y=True)
+    df = DataFrame({"features": X.astype(np.float32),
+                    "label": y.astype(np.float32)})
+    model = LightGBMClassifier(objective="multiclass", boostingType="dart",
+                               skipDrop=0.0, dropRate=0.3,
+                               **small_params()).fit(df)
+    out = model.transform(df)
+    assert (out["prediction"] == y).mean() > 0.85
+    # dart tree weights must survive save/load (baked into text model)
+    expected = out["probability"]
+    model.save(str(tmp_path / "m"))
+    loaded = load_stage(str(tmp_path / "m"))
+    np.testing.assert_allclose(loaded.transform(df)["probability"], expected,
+                               rtol=1e-4, atol=1e-5)
+
+
+def test_rf_native_roundtrip():
+    df = classification_df(300)
+    model = LightGBMClassifier(boostingType="rf", baggingFraction=0.8,
+                               baggingFreq=1, **small_params()).fit(df)
+    expected = model.transform(df)["probability"]
+    text = model.get_native_model_string()
+    assert "average_output" in text
+    re = Booster.load_native(text)
+    got = np.asarray(re.transform_scores(re.raw_scores(df["features"])))
+    np.testing.assert_allclose(got, expected[:, 1], rtol=1e-4, atol=1e-5)
+
+
+def test_early_stopping_and_validation():
+    df = classification_df(500)
+    rng = np.random.default_rng(0)
+    flag = rng.random(500) < 0.25
+    df = df.with_column("isVal", flag)
+    model = LightGBMClassifier(validationIndicatorCol="isVal",
+                               earlyStoppingRound=5,
+                               numIterations=200, numLeaves=31,
+                               minDataInLeaf=5, learningRate=0.3).fit(df)
+    assert model.booster.best_iteration >= 0
+    # stopped before all 200 iterations
+    assert model.booster.num_trees < 200
+
+
+def test_weight_column():
+    df = classification_df(300)
+    w = np.where(np.asarray(df["label"]) > 0, 10.0, 1.0).astype(np.float32)
+    df = df.with_column("w", w)
+    model = LightGBMClassifier(weightCol="w", **small_params()).fit(df)
+    out = model.transform(df)
+    # heavily weighting positives should push mean probability up
+    base = LightGBMClassifier(**small_params()).fit(df).transform(df)
+    assert out["probability"][:, 1].mean() > base["probability"][:, 1].mean()
+
+
+def test_batch_training_continuation():
+    df = classification_df(400)
+    model = LightGBMClassifier(numBatches=2, **small_params()).fit(df)
+    # 2 batches x 20 iterations
+    assert model.booster.num_trees == 40
+    out = model.transform(df)
+    assert roc_auc(np.asarray(df["label"]), out["probability"][:, 1]) > 0.9
+
+
+def test_custom_fobj():
+    df = classification_df(300)
+
+    def fobj(scores, y, w):
+        import jax
+        p = jax.nn.sigmoid(scores)
+        return (p - y) * w, p * (1 - p) * w
+
+    model = LightGBMClassifier(fobj=fobj, boostFromAverage=False,
+                               **small_params()).fit(df)
+    out = model.transform(df)
+    assert roc_auc(np.asarray(df["label"]), out["probability"][:, 1]) > 0.9
+
+
+def test_ranker_ndcg():
+    rng = np.random.default_rng(0)
+    n_queries, docs = 40, 12
+    rows = n_queries * docs
+    X = rng.normal(size=(rows, 6)).astype(np.float32)
+    rel = np.clip((X[:, 0] * 2 + rng.normal(0, 0.5, rows)).round(), 0,
+                  3).astype(np.float32)
+    qid = np.repeat(np.arange(n_queries), docs)
+    df = DataFrame({"features": X, "label": rel, "query": qid})
+    model = LightGBMRanker(groupCol="query", numIterations=30, numLeaves=7,
+                           minDataInLeaf=3, learningRate=0.2).fit(df)
+    ndcg = model.evaluate_ndcg(df, k=5)
+    assert ndcg > 0.75, ndcg
+
+
+def test_missing_values_handled():
+    df = classification_df(300)
+    X = np.asarray(df["features"]).copy()
+    X[::7, 0] = np.nan
+    df = DataFrame({"features": X, "label": df["label"]})
+    model = LightGBMClassifier(**small_params()).fit(df)
+    out = model.transform(df)
+    assert np.isfinite(out["probability"]).all()
