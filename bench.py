@@ -1,0 +1,62 @@
+"""Headline benchmark: ImageFeaturizer ResNet-50 inference throughput.
+
+Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
+
+This is the north-star workload (BASELINE.json config 2: ImageFeaturizer
+ResNet-50; reference path = CNTKModel JNI evaluation,
+``cntk/CNTKModel.scala:499-541``). The baseline constant is an A100
+bf16 ResNet-50 inference figure (~2500 images/s) per the BASELINE.json
+"≥3× A100 on a v5e-64 pod" target, i.e. per-chip parity ≈ 0.33×... 1×+
+is chip-for-chip parity with A100.
+"""
+
+from __future__ import annotations
+
+import json
+import time
+
+A100_IMAGES_PER_SEC = 2500.0  # bf16 ResNet-50 inference, batch ~128
+
+
+def main():
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from mmlspark_tpu.models import ModelDownloader
+
+    loaded = ModelDownloader().download_by_name("ResNet50")
+    module, variables = loaded.module, loaded.variables
+
+    batch = 128
+
+    @jax.jit
+    def forward(x):
+        return module.apply(variables, x, False)["pooled"]
+
+    rng = np.random.default_rng(0)
+    x = jnp.asarray(rng.normal(size=(batch, 224, 224, 3)), jnp.bfloat16)
+
+    forward(x).block_until_ready()  # compile
+    # warmup
+    for _ in range(3):
+        forward(x).block_until_ready()
+
+    iters = 20
+    t0 = time.perf_counter()
+    for _ in range(iters):
+        out = forward(x)
+    out.block_until_ready()
+    dt = time.perf_counter() - t0
+
+    images_per_sec = batch * iters / dt
+    print(json.dumps({
+        "metric": "imagefeaturizer_resnet50_inference",
+        "value": round(images_per_sec, 1),
+        "unit": "images/sec/chip",
+        "vs_baseline": round(images_per_sec / A100_IMAGES_PER_SEC, 3),
+    }))
+
+
+if __name__ == "__main__":
+    main()

@@ -9,5 +9,16 @@ __version__ = "0.1.0"
 from .core import (DataFrame, Pipeline, PipelineModel, Transformer, Estimator,
                    Model, load_stage)
 
+# Subpackages (imported lazily by users):
+#   lightgbm  — GBDT engine + estimators        (reference lightgbm/)
+#   vw        — sparse online learning          (reference vw/)
+#   dl, models, image — DL inference/training   (reference cntk/, image/,
+#                                                opencv/, downloader/)
+#   parallel  — mesh/collectives/ring attention (reference L3 comm layer)
+#   featurize, stages — data prep               (reference featurize/, stages/)
+#   train, automl — auto-training + sweeps      (reference train/, automl/)
+#   nn, recommendation, isolationforest, lime — learners long tail
+#   io        — binary/image readers, writers   (reference io/)
+
 __all__ = ["DataFrame", "Pipeline", "PipelineModel", "Transformer",
            "Estimator", "Model", "load_stage", "__version__"]

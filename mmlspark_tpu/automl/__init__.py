@@ -1,0 +1,16 @@
+"""AutoML: hyperparameter sweeps + model selection.
+
+Reference ``automl/`` (SURVEY §2.10): ``TuneHyperparameters`` (random
+search over estimators with k-fold CV, thread-pool parallel),
+``HyperparamBuilder``/``ParamSpace`` (typed ranges), ``FindBestModel``.
+"""
+
+from .hyperparams import (DiscreteHyperParam, DoubleRangeHyperParam,
+                          FloatRangeHyperParam, HyperparamBuilder,
+                          IntRangeHyperParam, GridSpace, RandomSpace)
+from .tune import TuneHyperparameters, TuneHyperparametersModel, FindBestModel
+
+__all__ = ["DiscreteHyperParam", "DoubleRangeHyperParam",
+           "FloatRangeHyperParam", "HyperparamBuilder", "IntRangeHyperParam",
+           "GridSpace", "RandomSpace", "TuneHyperparameters",
+           "TuneHyperparametersModel", "FindBestModel"]

@@ -1,0 +1,92 @@
+"""Hyperparameter ranges and search spaces.
+
+Reference ``automl/HyperparamBuilder.scala:11-111`` (``IntRangeHyperParam``,
+``DoubleRangeHyperParam``, ``DiscreteHyperParam``) and
+``automl/ParamSpace.scala:11-40`` (``GridSpace``, ``RandomSpace``).
+"""
+
+from __future__ import annotations
+
+import itertools
+
+import numpy as np
+
+
+class DiscreteHyperParam:
+    def __init__(self, values, seed: int = 0):
+        self.values = list(values)
+        self._rng = np.random.default_rng(seed)
+
+    def grid(self):
+        return list(self.values)
+
+    def sample(self):
+        return self.values[int(self._rng.integers(len(self.values)))]
+
+
+class IntRangeHyperParam:
+    def __init__(self, lo: int, hi: int, seed: int = 0):
+        self.lo, self.hi = int(lo), int(hi)
+        self._rng = np.random.default_rng(seed)
+
+    def grid(self, n: int = 5):
+        return sorted({int(v) for v in
+                       np.linspace(self.lo, self.hi - 1, n)})
+
+    def sample(self):
+        return int(self._rng.integers(self.lo, self.hi))
+
+
+class DoubleRangeHyperParam:
+    def __init__(self, lo: float, hi: float, seed: int = 0):
+        self.lo, self.hi = float(lo), float(hi)
+        self._rng = np.random.default_rng(seed)
+
+    def grid(self, n: int = 5):
+        return list(np.linspace(self.lo, self.hi, n))
+
+    def sample(self):
+        return float(self._rng.uniform(self.lo, self.hi))
+
+
+FloatRangeHyperParam = DoubleRangeHyperParam
+
+
+class HyperparamBuilder:
+    """(estimator, param-name) → range registry
+    (reference ``HyperparamBuilder.addHyperparam``)."""
+
+    def __init__(self):
+        self._entries: list[tuple[object, str, object]] = []
+
+    def addHyperparam(self, stage, param_name: str, dist):
+        self._entries.append((stage, param_name, dist))
+        return self
+
+    def build(self):
+        return list(self._entries)
+
+
+class GridSpace:
+    """Exhaustive cartesian product of grids."""
+
+    def __init__(self, entries):
+        self.entries = entries
+
+    def param_maps(self):
+        grids = [d.grid() for _, _, d in self.entries]
+        for combo in itertools.product(*grids):
+            yield [(s, name, v) for (s, name, _), v in
+                   zip(self.entries, combo)]
+
+
+class RandomSpace:
+    """Random draws (reference ``RandomSpace.paramMaps`` iterator)."""
+
+    def __init__(self, entries, seed: int = 0):
+        self.entries = entries
+        np.random.default_rng(seed)  # seed threaded via dists
+
+    def param_maps(self, n: int):
+        for _ in range(n):
+            yield [(s, name, d.sample()) for s, name, d in self.entries]

@@ -1,0 +1,126 @@
+"""TuneHyperparameters + FindBestModel.
+
+Reference ``automl/TuneHyperparameters.scala:34-170``: random search across
+(possibly several) estimators with k-fold cross-validation, evaluated in a
+thread pool (:95-125); ``automl/FindBestModel.scala``: pick the best of
+already-fitted models on an evaluation DataFrame.
+
+The thread pool survives here (model fits release the GIL while XLA runs),
+mirroring the reference's task-parallel sweep.
+"""
+
+from __future__ import annotations
+
+from concurrent.futures import ThreadPoolExecutor
+
+import numpy as np
+
+from ..core import ComplexParam, DataFrame, Estimator, Model, Param, \
+    TypeConverters as TC
+from ..core.contracts import HasLabelCol
+from ..train.statistics import classification_metrics, regression_metrics
+from .hyperparams import RandomSpace
+
+
+def _evaluate(model, df, label_col: str, metric: str) -> float:
+    scored = model.transform(df)
+    y = np.asarray(scored[label_col], np.float64)
+    pred = np.asarray(scored["prediction"], np.float64)
+    if metric in ("accuracy", "precision", "recall", "AUC"):
+        scores = None
+        if "probability" in scored.columns:
+            p = np.asarray(scored["probability"])
+            scores = p[:, -1] if p.ndim == 2 else p
+        return classification_metrics(y, pred, scores)[metric]
+    return regression_metrics(y, pred)[metric]
+
+
+def _higher_better(metric: str) -> bool:
+    return metric in ("accuracy", "precision", "recall", "AUC", "r^2")
+
+
+class TuneHyperparameters(Estimator, HasLabelCol):
+    models = ComplexParam("models", "estimators to sweep over")
+    paramSpace = ComplexParam("paramSpace",
+                              "HyperparamBuilder entries (see hyperparams)")
+    evaluationMetric = Param("evaluationMetric", "metric to optimize",
+                             TC.toString, default="accuracy")
+    numFolds = Param("numFolds", "cross-validation folds", TC.toInt,
+                     default=3)
+    numRuns = Param("numRuns", "random-search draws", TC.toInt, default=10)
+    parallelism = Param("parallelism", "concurrent fits", TC.toInt,
+                        default=4)
+    seed = Param("seed", "fold shuffling seed", TC.toInt, default=0)
+
+    def _fit(self, df):
+        metric = self.get("evaluationMetric")
+        folds = self.get("numFolds")
+        label = self.getLabelCol()
+        n = len(df)
+        rng = np.random.default_rng(self.get("seed"))
+        perm = rng.permutation(n)
+        fold_id = np.arange(n) % folds
+        fold_of_row = np.empty(n, np.int64)
+        fold_of_row[perm] = fold_id
+
+        estimators = self.get("models")
+        if not isinstance(estimators, (list, tuple)):
+            estimators = [estimators]
+        space = RandomSpace(self.get("paramSpace"), seed=self.get("seed"))
+        candidates = []
+        for est in estimators:
+            for pm in space.param_maps(self.get("numRuns")):
+                cand = est.copy()
+                for stage, name, value in pm:
+                    if type(stage) is type(est) and cand.has_param(name):
+                        cand.set(name, value)
+                candidates.append(cand)
+
+        def run(cand):
+            scores = []
+            for f in range(folds):
+                tr = df.filter(fold_of_row != f)
+                te = df.filter(fold_of_row == f)
+                m = cand.fit(tr)
+                scores.append(_evaluate(m, te, label, metric))
+            return float(np.mean(scores))
+
+        with ThreadPoolExecutor(self.get("parallelism")) as pool:
+            results = list(pool.map(run, candidates))
+
+        best_idx = int(np.argmax(results) if _higher_better(metric)
+                       else np.argmin(results))
+        best = candidates[best_idx].fit(df)
+        model = TuneHyperparametersModel(
+            bestModel=best, bestMetric=float(results[best_idx]))
+        self._copy_params_to(model)
+        return model
+
+
+class TuneHyperparametersModel(Model):
+    bestModel = ComplexParam("bestModel", "winning fitted model")
+    bestMetric = Param("bestMetric", "winning CV metric", TC.toFloat)
+
+    def _transform(self, df):
+        return self.get("bestModel").transform(df)
+
+
+class FindBestModel(Estimator, HasLabelCol):
+    """Reference ``automl/FindBestModel.scala``: evaluate fitted models on
+    the given data; keep the best."""
+
+    models = ComplexParam("models", "already-fitted models")
+    evaluationMetric = Param("evaluationMetric", "metric", TC.toString,
+                             default="accuracy")
+
+    def _fit(self, df):
+        metric = self.get("evaluationMetric")
+        scores = [_evaluate(m, df, self.getLabelCol(), metric)
+                  for m in self.get("models")]
+        best_idx = int(np.argmax(scores) if _higher_better(metric)
+                       else np.argmin(scores))
+        model = TuneHyperparametersModel(
+            bestModel=self.get("models")[best_idx],
+            bestMetric=float(scores[best_idx]))
+        self._copy_params_to(model)
+        return model

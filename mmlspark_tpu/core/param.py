@@ -250,6 +250,7 @@ class Params:
         Params._uid_counters[cls.__name__] = n + 1
         self.uid = f"{cls.__name__}_{n:04x}"
         self._paramMap: dict[str, Any] = {}
+        self._defaultOverrides: dict[str, Any] = {}
         if kwargs:
             self.setParams(**kwargs)
 
@@ -297,10 +298,22 @@ class Params:
             return dict(p.default)
         return p.default
 
+    def _setDefault(self, **kwargs) -> "Params":
+        """Instance-level default overrides (SparkML ``setDefault``): used by
+        stages whose natural defaults differ from the shared contract mixins
+        (e.g. image stages default inputCol to "image")."""
+        for k, v in kwargs.items():
+            p = self.get_param(k)
+            self._defaultOverrides[p.name] = \
+                v if v is None else p.converter(v)
+        return self
+
     def get(self, param: Param | str, default: Any = None) -> Any:
         p = self.get_param(param) if isinstance(param, str) else param
         if p.name in self._paramMap:
             return self._paramMap[p.name]
+        if p.name in self._defaultOverrides:
+            return self._defaultOverrides[p.name]
         if p.has_default:
             return self._default_value(p)
         return default
@@ -309,6 +322,8 @@ class Params:
         p = self.get_param(param) if isinstance(param, str) else param
         if p.name in self._paramMap:
             return self._paramMap[p.name]
+        if p.name in self._defaultOverrides:
+            return self._defaultOverrides[p.name]
         if p.has_default:
             return self._default_value(p)
         raise KeyError(f"param {p.name!r} is not set and has no default")
@@ -319,7 +334,8 @@ class Params:
 
     def isDefined(self, param: Param | str) -> bool:
         p = self.get_param(param) if isinstance(param, str) else param
-        return p.name in self._paramMap or p.has_default
+        return (p.name in self._paramMap
+                or p.name in self._defaultOverrides or p.has_default)
 
     def explainParams(self) -> str:
         lines = []
@@ -331,9 +347,11 @@ class Params:
 
     def copy(self, extra: dict | None = None) -> "Params":
         out = type(self).__new__(type(self))
-        out.__dict__.update({k: v for k, v in self.__dict__.items()
-                             if k != "_paramMap"})
+        out.__dict__.update(
+            {k: v for k, v in self.__dict__.items()
+             if k not in ("_paramMap", "_defaultOverrides")})
         out._paramMap = dict(self._paramMap)
+        out._defaultOverrides = dict(self._defaultOverrides)
         if extra:
             out.setParams(**extra)
         return out
@@ -342,6 +360,9 @@ class Params:
         for name, value in self._paramMap.items():
             if other.has_param(name):
                 other._paramMap[name] = value
+        for name, value in self._defaultOverrides.items():
+            if other.has_param(name) and name not in other._defaultOverrides:
+                other._defaultOverrides[name] = value
 
     # -------------------------------------------------- synthesized accessors
     def __getattr__(self, item: str):

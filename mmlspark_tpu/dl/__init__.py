@@ -1,0 +1,14 @@
+"""Deep-learning runtime: device-resident model transformer + sharded
+training.
+
+Replaces the reference's CNTK-on-Spark layer (``cntk/CNTKModel.scala``,
+``com/microsoft/CNTK/SerializableFunction.scala``): instead of broadcasting
+serialized native graphs to executor JVMs and crossing JNI per batch, models
+are flax modules jitted once, with weights living in device memory, sharded
+by ``jax.sharding`` over the mesh.
+"""
+
+from .model import TPUModel
+from .train import TrainState, make_train_step, shard_train_state
+
+__all__ = ["TPUModel", "TrainState", "make_train_step", "shard_train_state"]

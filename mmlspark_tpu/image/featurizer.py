@@ -1,0 +1,73 @@
+"""ImageFeaturizer — transfer-learning feature extraction.
+
+Reference ``image/ImageFeaturizer.scala:40-197``: compose
+ResizeImageTransformer + UnrollImage + CNTKModel, with ``cutOutputLayers``
+selecting how many layers to cut off the pretrained net (1 = the
+penultimate features). Here layers are named endpoints of the zoo model:
+``cutOutputLayers=k`` picks ``layer_names[-(k+1)]`` (0 = logits,
+1 = pooled features, 2 = stage4, ...).
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from ..core import ComplexParam, Model, Param, Transformer, \
+    TypeConverters as TC
+from ..core.contracts import HasInputCol, HasOutputCol
+from ..dl.model import TPUModel
+from ..models.zoo import LoadedModel, ModelDownloader
+from .stages import ResizeImageTransformer
+
+
+class ImageFeaturizer(Transformer, HasInputCol, HasOutputCol):
+    modelName = Param("modelName", "zoo model name", TC.toString,
+                      default="ResNet50", has_default=True)
+    model = ComplexParam("model", "explicit LoadedModel (overrides name)",
+                         default=None, has_default=True)
+    cutOutputLayers = Param(
+        "cutOutputLayers",
+        "layers to cut from the top: 0 = logits, 1 = pooled features",
+        TC.toInt, default=1, has_default=True)
+    autoResize = Param("autoResize", "resize inputs to the model's input "
+                       "size first", TC.toBoolean, default=True,
+                       has_default=True)
+    miniBatchSize = Param("miniBatchSize", "device batch size", TC.toInt,
+                          default=64, has_default=True)
+
+    def __init__(self, **kwargs):
+        super().__init__(**kwargs)
+        self._setDefault(inputCol="image", outputCol="features")
+
+    def setModel(self, name_or_model):
+        """Accepts a zoo name or a LoadedModel (reference
+        ``setModel(ModelSchema)``, ``ImageFeaturizer.scala:81-85``)."""
+        if isinstance(name_or_model, str):
+            return self.set("modelName", name_or_model)
+        return self.set("model", name_or_model)
+
+    def _loaded(self) -> LoadedModel:
+        m = self.get("model")
+        if m is not None:
+            return m
+        return ModelDownloader().download_by_name(self.get("modelName"))
+
+    def _transform(self, df):
+        loaded = self._loaded()
+        layers = loaded.layer_names
+        cut = self.get("cutOutputLayers")
+        if not 0 <= cut < len(layers):
+            raise ValueError(
+                f"cutOutputLayers={cut} out of range for {layers}")
+        endpoint = layers[-(cut + 1)]
+
+        col = self.getInputCol()
+        if self.get("autoResize"):
+            size = loaded.schema.input_size
+            df = ResizeImageTransformer(
+                inputCol=col, outputCol=col, height=size,
+                width=size).transform(df)
+        tpu_model = TPUModel(
+            model=loaded, inputCol=col, outputCol=self.getOutputCol(),
+            outputNode=endpoint, minibatchSize=self.get("miniBatchSize"))
+        return tpu_model.transform(df)

@@ -1,0 +1,122 @@
+"""Image pipeline stages: resize, unroll, augment.
+
+Reference ``image/`` package: ``ResizeImageTransformer.scala``,
+``UnrollImage.scala`` (image → flat DenseVector in CHW order),
+``ImageSetAugmenter.scala`` (left/right flip augmentation).
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..core import DataFrame, Transformer, Param, TypeConverters as TC
+from ..core.contracts import HasInputCol, HasOutputCol
+from . import ops
+from .transforms import images_to_batch
+
+
+class ResizeImageTransformer(Transformer, HasInputCol, HasOutputCol):
+    """Reference ``image/ResizeImageTransformer.scala`` — the OpenCV-free
+    resize used by ImageFeaturizer."""
+
+    height = Param("height", "target height", TC.toInt)
+    width = Param("width", "target width", TC.toInt)
+    nChannels = Param("nChannels", "channel count override", TC.toInt,
+                      default=None, has_default=True)
+
+    def __init__(self, **kwargs):
+        super().__init__(**kwargs)
+        self._setDefault(inputCol="image", outputCol="image")
+
+    def _transform(self, df):
+        col = df[self.getInputCol()]
+        H, W = self.getHeight(), self.getWidth()
+        if isinstance(col, np.ndarray) and col.ndim == 4:
+            out = np.asarray(ops.resize(jnp.asarray(col, jnp.float32), H, W))
+        else:
+            imgs = []
+            for a in col:
+                a = np.asarray(a, np.float32)
+                if a.ndim == 2:
+                    a = a[..., None]
+                imgs.append(np.asarray(ops.resize(
+                    jnp.asarray(a)[None], H, W)[0]))
+            out = np.stack(imgs)
+        return df.with_column(self.getOutputCol(), out)
+
+
+class UnrollImage(Transformer, HasInputCol, HasOutputCol):
+    """Image → flat feature vector in CHW order
+    (reference ``image/UnrollImage.scala`` — CNTK expects channels-first;
+    we keep the same layout so unrolled features are comparable)."""
+
+    def __init__(self, **kwargs):
+        super().__init__(**kwargs)
+        self._setDefault(inputCol="image", outputCol="unrolled")
+
+    def _transform(self, df):
+        batch, _ = images_to_batch(df[self.getInputCol()])
+        flat = np.transpose(batch, (0, 3, 1, 2)).reshape(batch.shape[0], -1)
+        return df.with_column(self.getOutputCol(), flat)
+
+
+class UnrollBinaryImage(Transformer, HasInputCol, HasOutputCol):
+    """Decode encoded image bytes then unroll (reference
+    ``image/UnrollImage.scala`` UnrollBinaryImage variant). Decoding uses
+    torch-free pure-python PNG/JPEG via PIL if available, else raises."""
+
+    height = Param("height", "resize height", TC.toInt, default=None,
+                   has_default=True)
+    width = Param("width", "resize width", TC.toInt, default=None,
+                  has_default=True)
+
+    def __init__(self, **kwargs):
+        super().__init__(**kwargs)
+        self._setDefault(inputCol="image", outputCol="unrolled")
+
+    def _transform(self, df):
+        from ..io.binary import decode_image
+        col = df[self.getInputCol()]
+        imgs = [decode_image(b) for b in col]
+        H, W = self.get("height"), self.get("width")
+        out = []
+        for a in imgs:
+            a = np.asarray(a, np.float32)
+            if a.ndim == 2:
+                a = a[..., None]
+            if H and W and a.shape[:2] != (H, W):
+                a = np.asarray(ops.resize(jnp.asarray(a)[None], H, W)[0])
+            out.append(np.transpose(a, (2, 0, 1)).reshape(-1))
+        return df.with_column(self.getOutputCol(), np.stack(out))
+
+
+class ImageSetAugmenter(Transformer, HasInputCol, HasOutputCol):
+    """Dataset augmentation by flips (reference
+    ``image/ImageSetAugmenter.scala``): emits the original rows plus one
+    copy per enabled flip."""
+
+    flipLeftRight = Param("flipLeftRight", "add L/R flipped copies",
+                          TC.toBoolean, default=True, has_default=True)
+    flipUpDown = Param("flipUpDown", "add U/D flipped copies",
+                       TC.toBoolean, default=False, has_default=True)
+
+    def __init__(self, **kwargs):
+        super().__init__(**kwargs)
+        self._setDefault(inputCol="image", outputCol="image")
+
+    def _transform(self, df):
+        batch, _ = images_to_batch(df[self.getInputCol()])
+        out_frames = [df.with_column(self.getOutputCol(), batch)]
+        x = jnp.asarray(batch)
+        if self.get("flipLeftRight"):
+            out_frames.append(df.with_column(
+                self.getOutputCol(), np.asarray(ops.flip(x, 1))))
+        if self.get("flipUpDown"):
+            out_frames.append(df.with_column(
+                self.getOutputCol(), np.asarray(ops.flip(x, 0))))
+        base = out_frames[0]
+        for extra in out_frames[1:]:
+            base = base.union(extra)
+        return base

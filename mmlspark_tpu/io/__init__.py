@@ -1,0 +1,13 @@
+"""IO layer: binary/image file reading, writers.
+
+Reference L6 (SURVEY §2.11): ``io/binary/BinaryFileFormat.scala`` (whole
+files + zip entries as (path, bytes) rows), the patched image data source,
+and the PowerBI streaming sink.
+"""
+
+from .binary import (BinaryFileReader, decode_image, read_binary_files,
+                     read_images)
+from .powerbi import PowerBIWriter
+
+__all__ = ["BinaryFileReader", "decode_image", "read_binary_files",
+           "read_images", "PowerBIWriter"]

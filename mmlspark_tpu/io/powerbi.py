@@ -1,0 +1,32 @@
+"""PowerBI sink (reference ``io/powerbi/PowerBIWriter.scala``): POST row
+batches as JSON to a PowerBI push-dataset REST endpoint."""
+
+from __future__ import annotations
+
+import json
+import urllib.request
+
+from ..core import DataFrame
+
+
+class PowerBIWriter:
+    def __init__(self, url: str, batch_size: int = 1000, timeout: float = 30.0):
+        self.url = url
+        self.batch_size = batch_size
+        self.timeout = timeout
+
+    def write(self, df: DataFrame) -> int:
+        """POST rows in batches; returns number of batches sent."""
+        rows = [dict(r) for r in df.collect()]
+        sent = 0
+        for start in range(0, len(rows), self.batch_size):
+            payload = json.dumps(
+                {"rows": rows[start:start + self.batch_size]},
+                default=str).encode()
+            req = urllib.request.Request(
+                self.url, data=payload,
+                headers={"Content-Type": "application/json"})
+            with urllib.request.urlopen(req, timeout=self.timeout) as resp:
+                resp.read()
+            sent += 1
+        return sent

@@ -1,0 +1,12 @@
+"""Isolation forest anomaly detection.
+
+Reference ``isolationforest/IsolationForest.scala:18-66`` wraps LinkedIn's
+``isolation-forest`` JVM library; here the algorithm itself is implemented
+TPU-first: all trees grow at once as fixed-shape arrays (vmapped random
+splits), and scoring routes every row through every tree in one jitted
+program.
+"""
+
+from .forest import IsolationForest, IsolationForestModel
+
+__all__ = ["IsolationForest", "IsolationForestModel"]

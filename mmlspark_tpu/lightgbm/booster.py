@@ -43,6 +43,10 @@ class Booster:
                  tree_weights: np.ndarray | None = None,
                  average_output: bool = False):
         self.arrays = {k: np.asarray(v) for k, v in arrays.items()}
+        if "default_left" not in self.arrays and "feature" in self.arrays:
+            # our trained trees always send missing (bin 0) left
+            self.arrays["default_left"] = np.ones_like(
+                self.arrays["feature"], bool)
         self.num_class = num_class
         self.objective = objective
         self.sigmoid = sigmoid
@@ -134,7 +138,7 @@ class Booster:
         a = self.arrays
         return tuple(jnp.asarray(a[k][:t_end]) for k in
                      ("feature", "threshold", "left", "right",
-                      "leaf_value", "is_leaf"))
+                      "leaf_value", "is_leaf", "default_left"))
 
     # ---------------------------------------------------------- importances
     def feature_importances(self, importance_type: str = "split",
@@ -278,6 +282,7 @@ class Booster:
             ("split_gain", np.float32), ("node_weight", np.float32),
             ("node_count", np.float32), ("node_value", np.float32)]}
         arr["num_nodes"] = np.zeros(T, np.int32)
+        arr["default_left"] = np.ones((T, NN), bool)
         for t, td in enumerate(trees):
             nl = int(td["num_leaves"])
             ni = nl - 1
@@ -285,6 +290,16 @@ class Booster:
                 raw = td.get(key, "")
                 vals = [dtype(v) for v in raw.split()] if raw else []
                 return vals
+            if int(td.get("num_cat", "0")) > 0:
+                raise NotImplementedError(
+                    "native LightGBM model uses categorical splits "
+                    "(num_cat > 0); set-based categorical routing is not "
+                    "supported yet — retrain with numeric/ordinal features")
+            dt = parse("decision_type", int)
+            if any(d & 1 for d in dt):
+                raise NotImplementedError(
+                    "categorical decision_type in native model is not "
+                    "supported yet")
             sf = parse("split_feature", int)
             thr = parse("threshold", float)
             lc = parse("left_child", int)
@@ -306,6 +321,9 @@ class Booster:
                 arr["threshold"][t, i] = thr[i]
                 arr["left"][t, i] = to_id(lc[i])
                 arr["right"][t, i] = to_id(rc[i])
+                # decision_type bit 1 = default-left for missing values
+                arr["default_left"][t, i] = bool(dt[i] & 2) \
+                    if i < len(dt) else True
                 arr["split_gain"][t, i] = sg[i] if i < len(sg) else 0
                 arr["node_value"][t, i] = iv[i] if i < len(iv) else 0
                 arr["node_weight"][t, i] = iw[i] if i < len(iw) else 0
@@ -368,7 +386,8 @@ def merge_boosters(first: Booster, second: Booster) -> Booster:
 # ------------------------------------------------------------ jitted predict
 @functools.partial(jax.jit, static_argnames=("max_depth",))
 def _predict_leaf_nodes(tree_arrays, x, *, max_depth: int):
-    feature, threshold, left, right, leaf_value, is_leaf = tree_arrays
+    feature, threshold, left, right, leaf_value, is_leaf, default_left = \
+        tree_arrays
     T = feature.shape[0]
     n = x.shape[0]
     node = jnp.zeros((n, T), jnp.int32)
@@ -378,7 +397,8 @@ def _predict_leaf_nodes(tree_arrays, x, *, max_depth: int):
         f = feature[t_idx, node]                      # [n, T]
         thr = threshold[t_idx, node]
         xv = jnp.take_along_axis(x, f.reshape(n, T), axis=1)
-        go_left = (xv <= thr) | jnp.isnan(xv)
+        missing = jnp.isnan(xv)
+        go_left = jnp.where(missing, default_left[t_idx, node], xv <= thr)
         nxt = jnp.where(go_left, left[t_idx, node], right[t_idx, node])
         return jnp.where(is_leaf[t_idx, node], node, nxt)
 

@@ -71,6 +71,7 @@ class _LightGBMBase(Estimator, LightGBMSharedParams):
         # ---- split validation rows (reference validationIndicatorCol)
         valid = None
         valid_eval_fn = None
+        valid_init_scores = None
         train_df = df
         if self.isSet("validationIndicatorCol"):
             flag = np.asarray(df[self.getValidationIndicatorCol()],
@@ -83,6 +84,9 @@ class _LightGBMBase(Estimator, LightGBMSharedParams):
                   if self.isSet("weightCol") else None)
             valid = (xv, yv, wv)
             valid_eval_fn = self._valid_eval_fn(valid_df)
+            if self.isSet("initScoreCol"):
+                valid_init_scores = np.asarray(
+                    valid_df[self.getInitScoreCol()], np.float32)
 
         x = as_2d_features(train_df, self.getFeaturesCol())
         y = np.asarray(train_df[self.getLabelCol()], np.float32)
@@ -96,10 +100,35 @@ class _LightGBMBase(Estimator, LightGBMSharedParams):
                           **self._objective_config(y))
         names = self.getSlotNames() or \
             [f"Column_{i}" for i in range(x.shape[1])]
+        mesh = self._training_mesh(x.shape[0])
         return train(x, y, w, cfg, valid=valid, init_booster=init_booster,
-                     init_scores=init_scores, feature_names=names,
+                     init_scores=init_scores,
+                     valid_init_scores=valid_init_scores,
+                     feature_names=names,
                      grad_hess_override=self._grad_override(train_df, y),
-                     valid_eval_fn=valid_eval_fn)
+                     valid_eval_fn=valid_eval_fn, mesh=mesh,
+                     mesh_axis=self.getShardAxisName())
+
+    def _training_mesh(self, n_rows: int):
+        """Device mesh for distributed histogram training.
+
+        The reference sizes its worker set from cluster topology
+        (``ClusterUtil.getNumTasksPerExecutor``, ``LightGBMBase.scala:
+        102-138``); here the "cluster" is the visible device set.
+        numShards: 0 = auto (all devices when the data is big enough to be
+        worth the collective), 1 = single device, N = exactly N devices.
+        """
+        import jax
+        from jax.sharding import Mesh
+
+        ns = self.getNumShards()
+        devices = jax.devices()
+        if ns == 0:
+            ns = len(devices) if n_rows >= 4096 and len(devices) > 1 else 1
+        ns = min(ns, len(devices))
+        if ns <= 1:
+            return None
+        return Mesh(np.asarray(devices[:ns]), (self.getShardAxisName(),))
 
 
 class _BoosterModelMixin:

@@ -84,6 +84,8 @@ def tree_shap_values(arrays: dict, t: int, x: np.ndarray,
     leaf_value = arrays["leaf_value"][t].astype(np.float64)
     is_leaf = arrays["is_leaf"][t]
     count = arrays["node_count"][t].astype(np.float64)
+    default_left = arrays["default_left"][t] if "default_left" in arrays \
+        else np.ones_like(is_leaf)
 
     n = x.shape[0]
     phi = np.zeros((n, num_features + 1), dtype=np.float64)
@@ -117,7 +119,8 @@ def tree_shap_values(arrays: dict, t: int, x: np.ndarray,
                 return
             f = int(feature[node])
             xv = row[f]
-            goes_left = (xv <= threshold[node]) or np.isnan(xv)
+            goes_left = bool(default_left[node]) if np.isnan(xv) \
+                else xv <= threshold[node]
             hot, cold = (left[node], right[node]) if goes_left \
                 else (right[node], left[node])
             tot = max(count[node], 1e-12)
@@ -145,15 +148,24 @@ def tree_shap_values(arrays: dict, t: int, x: np.ndarray,
 
 def booster_shap_values(booster, x: np.ndarray,
                         num_features: int) -> np.ndarray:
-    """Sum of per-tree SHAP values + init score in the bias slot → [n, F+1]."""
+    """Per-class SHAP values: [n, K*(F+1)] with each class's block ending
+    in its bias slot — the reference's contract for multiclass
+    ``featuresShap`` (K=1 collapses to [n, F+1]). Trees are interleaved by
+    class (tree t explains class t % K)."""
     x = np.asarray(x, dtype=np.float64)
-    out = np.zeros((x.shape[0], num_features + 1), dtype=np.float64)
+    K = max(booster.num_class, 1)
+    blk = num_features + 1
+    out = np.zeros((x.shape[0], K * blk), dtype=np.float64)
     t_end = booster._effective_trees(None)
     depth_cap = booster.max_depth_bound + 2
     for t in range(t_end):
-        out += tree_shap_values(booster.arrays, t, x, num_features,
-                                depth_cap=depth_cap) \
+        k = t % K
+        out[:, k * blk:(k + 1) * blk] += tree_shap_values(
+            booster.arrays, t, x, num_features, depth_cap=depth_cap) \
             * float(booster.tree_weights[t])
     init = np.asarray(booster.init_score).reshape(-1)
-    out[:, num_features] += float(init[0]) if init.size else 0.0
+    for k in range(K):
+        if init.size:
+            out[:, k * blk + num_features] += float(
+                init[k] if init.size > k else init[0])
     return out

@@ -106,32 +106,73 @@ class TrainResult:
     best_iteration: int
 
 
+def _make_grow(mesh, mesh_axis: str | None, tp: TreeParams, F: int):
+    """Tree-growth callable; with a mesh, rows are sharded over
+    ``mesh_axis`` and the histogram reduction inside ``grow_tree`` becomes a
+    real ``psum`` collective (the reference's socket allreduce,
+    ``TrainUtils.scala:609-625``, on ICI)."""
+    if mesh is None:
+        return lambda b, g, h, fm, rm: grow_tree(
+            b, g, h, fm, rm, params=tp, num_features=F, psum_axis=None)
+    from jax.sharding import PartitionSpec as P
+    row = P(mesh_axis)
+
+    def local(b, g, h, fm, rm):
+        return grow_tree(b, g, h, fm, rm, params=tp, num_features=F,
+                         psum_axis=mesh_axis)
+
+    return jax.shard_map(local, mesh=mesh,
+                         in_specs=(row, row, row, P(), row),
+                         out_specs=(P(), row), check_vma=False)
+
+
 def train(x: np.ndarray, y: np.ndarray, w: np.ndarray | None,
           config: TrainConfig,
           valid: tuple[np.ndarray, np.ndarray, np.ndarray | None]
           | None = None,
           init_booster: Booster | None = None,
           init_scores: np.ndarray | None = None,
+          valid_init_scores: np.ndarray | None = None,
           feature_names: list[str] | None = None,
           grad_hess_override: Callable | None = None,
           valid_eval_fn: Callable | None = None,
-          delegate=None) -> TrainResult:
-    """Single-host training. x [n, F] float32 (NaN = missing), y [n].
+          delegate=None, mesh=None, mesh_axis: str = "dp") -> TrainResult:
+    """Training loop. x [n, F] float32 (NaN = missing), y [n].
 
     ``grad_hess_override`` lets the ranker inject lambdarank gradients (it
     receives raw scores and returns (grad, hess)). ``init_scores`` is the
     per-row warm start (reference ``initScoreCol``).
+
+    ``mesh``: distributed data-parallel training — rows are sharded over
+    ``mesh_axis`` and each tree's histogram build runs under ``shard_map``
+    with a ``psum`` reduction, the TPU equivalent of the reference's
+    socket-mesh histogram allreduce (``TrainUtils.scala:609-625``); rows are
+    padded to the shard count with zero-weight masks (the SPMD version of
+    the empty-partition ``ignore`` protocol, ``TrainUtils.scala:652-669``).
     """
     cfg = config
+    n_real = x.shape[0]
+    pad_mask = None
+    if mesh is not None:
+        from ..parallel.sharding import pad_rows
+        n_dev = int(mesh.shape[mesh_axis])
+        (x, y, w, init_scores), pad_np = pad_rows(
+            [np.asarray(x, np.float32), np.asarray(y, np.float32),
+             None if w is None else np.asarray(w, np.float32),
+             None if init_scores is None
+             else np.asarray(init_scores, np.float32)], n_dev)
+        pad_mask = pad_np
     n, F = x.shape
     rng = np.random.default_rng(cfg.seed)
     bag_rng = np.random.default_rng(cfg.bagging_seed)
     w_np = np.ones(n, np.float32) if w is None else np.asarray(w, np.float32)
+    if pad_mask is not None:
+        w_np = w_np * pad_mask
 
     pos_weight = cfg.scale_pos_weight
     if cfg.is_unbalance and cfg.objective == "binary":
-        npos = float((y > 0).sum())
-        nneg = float(n - npos)
+        npos = float((y[:n_real] > 0).sum())
+        nneg = float(n_real - npos)
         pos_weight = nneg / max(npos, 1.0)
 
     if cfg.fobj is not None:
@@ -149,12 +190,18 @@ def train(x: np.ndarray, y: np.ndarray, w: np.ndarray | None,
     tp = cfg.tree_params()
 
     # ---- binning (host boundaries, device mapping)
-    boundaries = compute_bin_boundaries(x, cfg.max_bin,
+    boundaries = compute_bin_boundaries(x[:n_real], cfg.max_bin,
                                         sample_cnt=cfg.bin_sample_count,
                                         seed=cfg.seed)
     bins = bin_features(jnp.asarray(x, jnp.float32), jnp.asarray(boundaries))
     y_dev = jnp.asarray(y, jnp.float32)
     w_dev = jnp.asarray(w_np)
+    if mesh is not None:
+        from jax.sharding import NamedSharding, PartitionSpec as P
+        row_sh = NamedSharding(mesh, P(mesh_axis))
+        bins = jax.device_put(bins, NamedSharding(mesh, P(mesh_axis, None)))
+        y_dev = jax.device_put(y_dev, row_sh)
+        w_dev = jax.device_put(w_dev, row_sh)
 
     # ---- init scores
     if init_scores is not None:
@@ -180,6 +227,15 @@ def train(x: np.ndarray, y: np.ndarray, w: np.ndarray | None,
     is_dart = cfg.boosting_type == "dart"
     is_goss = cfg.boosting_type == "goss"
 
+    if grad_hess_override is not None and n != n_real:
+        # ranker/custom gradients were built for the unpadded rows
+        _orig_override = grad_hess_override
+
+        def grad_hess_override(s):
+            g0, h0 = _orig_override(s[:n_real])
+            pad = [(0, n - n_real)] + [(0, 0)] * (g0.ndim - 1)
+            return jnp.pad(g0, pad), jnp.pad(h0, pad)
+
     trees: list[Tree] = []
     tree_class: list[int] = []           # class index of each tree
     tree_deltas: list[jnp.ndarray] = []  # dart: cached per-tree train deltas
@@ -199,20 +255,30 @@ def train(x: np.ndarray, y: np.ndarray, w: np.ndarray | None,
         vbins = bin_features(jnp.asarray(xv, jnp.float32),
                              jnp.asarray(boundaries))
         nv = xv.shape[0]
-        vscores = jnp.broadcast_to(
-            jnp.asarray(base_score, jnp.float32).reshape(1, -1),
-            (nv, K)).astype(jnp.float32)
-        vscores = vscores[:, 0] if K == 1 else vscores
-        if init_booster is not None and init_booster.num_trees > 0:
-            vraw = init_booster.raw_scores(xv)
-            vscores = jnp.asarray(vraw, jnp.float32)
+        if valid_init_scores is not None:
+            # validation rows get the same per-row warm start as training
+            # rows (reference initScoreCol applies to every scored row) so
+            # early-stopping metrics see comparable margins
+            vscores = jnp.asarray(valid_init_scores, jnp.float32)
+            if K > 1 and vscores.ndim == 1:
+                vscores = jnp.broadcast_to(vscores[:, None], (nv, K))
+        else:
+            vscores = jnp.broadcast_to(
+                jnp.asarray(base_score, jnp.float32).reshape(1, -1),
+                (nv, K)).astype(jnp.float32)
+            vscores = vscores[:, 0] if K == 1 else vscores
+            if init_booster is not None and init_booster.num_trees > 0:
+                vraw = init_booster.raw_scores(xv)
+                vscores = jnp.asarray(vraw, jnp.float32)
     metric_name = cfg.metric or _default_metric(cfg.objective)
 
+    grow = _make_grow(mesh, mesh_axis, tp, F)
     for it in range(cfg.num_iterations):
         if delegate is not None:
             lr = delegate.get_learning_rate(it)
             if lr is not None and lr != tp.learning_rate:
                 tp = tp._replace(learning_rate=float(lr))
+                grow = _make_grow(mesh, mesh_axis, tp, F)
             delegate.before_train_iteration(it)
 
         # ---- dart: drop trees for gradient computation
@@ -241,13 +307,16 @@ def train(x: np.ndarray, y: np.ndarray, w: np.ndarray | None,
         else:
             g, h = obj.grad_hess(score_for_grad, y_dev, w_dev)
 
-        # ---- row sampling
-        row_mask = np.ones(n, np.float32)
+        # ---- row sampling (padded rows always excluded: the SPMD "ignore")
+        valid_mask = pad_mask if pad_mask is not None \
+            else np.ones(n, np.float32)
+        row_mask = valid_mask
         if is_goss:
             gmag = np.asarray(jnp.abs(g) if g.ndim == 1
                               else jnp.linalg.norm(g, axis=1))
-            top_n = int(cfg.top_rate * n)
-            other_n = int(cfg.other_rate * n)
+            gmag = gmag * valid_mask  # padded rows sort last
+            top_n = int(cfg.top_rate * n_real)
+            other_n = int(cfg.other_rate * n_real)
             order = np.argsort(-gmag)
             row_mask = np.zeros(n, np.float32)
             row_mask[order[:top_n]] = 1.0
@@ -256,11 +325,12 @@ def train(x: np.ndarray, y: np.ndarray, w: np.ndarray | None,
                 chosen = rng.choice(rest, size=min(other_n, rest.size),
                                     replace=False)
                 row_mask[chosen] = (1.0 - cfg.top_rate) / cfg.other_rate
+            row_mask *= valid_mask
         elif (is_rf or cfg.bagging_freq > 0) and cfg.bagging_fraction < 1.0:
             if is_rf or it % max(cfg.bagging_freq, 1) == 0:
                 bag_mask = (bag_rng.random(n)
                             < cfg.bagging_fraction).astype(np.float32)
-            row_mask = bag_mask
+            row_mask = bag_mask * valid_mask
 
         # ---- feature sampling
         feat_mask = np.ones(F, bool)
@@ -275,9 +345,7 @@ def train(x: np.ndarray, y: np.ndarray, w: np.ndarray | None,
         for k_cls in range(K):
             gk = g if K == 1 else g[:, k_cls]
             hk = h if K == 1 else h[:, k_cls]
-            tree, row_leaf = grow_tree(
-                bins, gk, hk, feat_mask_dev, row_mask_dev,
-                params=tp, num_features=F, psum_axis=None)
+            tree, row_leaf = grow(bins, gk, hk, feat_mask_dev, row_mask_dev)
             delta = tree.leaf_value[row_leaf]
 
             trees.append(jax.tree.map(np.asarray, tree))
@@ -331,8 +399,8 @@ def train(x: np.ndarray, y: np.ndarray, w: np.ndarray | None,
             train_metric = metric_name if metric_name != "ndcg" else "rmse"
             evals.append({"iteration": it, "dataset": "train",
                           train_metric: eval_metric(
-                              train_metric, np.asarray(scores),
-                              np.asarray(y), w_np, cfg)})
+                              train_metric, np.asarray(scores)[:n_real],
+                              np.asarray(y)[:n_real], w_np[:n_real], cfg)})
         if valid is not None:
             if valid_eval_fn is not None:
                 m = valid_eval_fn(np.asarray(vscores), np.asarray(yv),

@@ -1,0 +1,15 @@
+"""LIME — model-agnostic local explanations at scale.
+
+Reference ``lime/`` (SURVEY §2.10): ``TabularLIME`` (:169), ``ImageLIME``
+(:262, superpixel masking), ``TextLIME`` (word-level), with local linear
+fits via least squares (``lime/BreezeUtils.scala``). TPU framing: mask
+sampling is one RNG batch, perturbed predictions one batched transform,
+and the per-row weighted least-squares solves are a single vmapped
+``jnp.linalg.lstsq``.
+"""
+
+from .lime import TabularLIME, ImageLIME, TextLIME
+from .superpixel import Superpixel, SuperpixelTransformer
+
+__all__ = ["TabularLIME", "ImageLIME", "TextLIME", "Superpixel",
+           "SuperpixelTransformer"]

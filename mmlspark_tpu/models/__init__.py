@@ -1,0 +1,15 @@
+"""Model zoo: flax models with named intermediate layers.
+
+Replaces the reference's CNTK model zoo — pretrained CNNs fetched by
+``ModelDownloader`` (``downloader/ModelDownloader.scala``) and evaluated
+through JNI (``cntk/CNTKModel.scala``). Here models are flax modules whose
+forward pass returns every named layer, so ``ImageFeaturizer``'s
+``cutOutputLayers`` (``image/ImageFeaturizer.scala:137-184``) is a dict
+lookup rather than graph surgery.
+"""
+
+from .resnet import ResNet, ResNet18, ResNet34, ResNet50, ResNet101
+from .zoo import ModelSchema, ModelDownloader, get_model, register_model
+
+__all__ = ["ResNet", "ResNet18", "ResNet34", "ResNet50", "ResNet101",
+           "ModelSchema", "ModelDownloader", "get_model", "register_model"]

@@ -1,0 +1,129 @@
+"""ResNet family in flax.linen, TPU-first.
+
+Replaces the reference's downloaded CNTK ResNet50 graph (the default
+``ImageFeaturizer`` backbone, ``downloader/Schema.scala`` layerNames). The
+forward pass exposes a dict of named endpoints — pooled features, every
+stage output, logits — so feature extraction at any depth is a lookup, the
+moral equivalent of CNTK ``cutOutputLayers``.
+
+TPU notes: NHWC layout (XLA's native conv layout on TPU), bfloat16 compute
+with float32 params/BN statistics, channel dims kept multiples of 128 where
+the architecture allows so conv GEMMs tile cleanly onto the MXU.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import Any, Sequence
+
+import flax.linen as nn
+import jax.numpy as jnp
+
+
+class BasicBlock(nn.Module):
+    filters: int
+    strides: int = 1
+    dtype: Any = jnp.bfloat16
+
+    @nn.compact
+    def __call__(self, x, train: bool = False):
+        conv = partial(nn.Conv, use_bias=False, dtype=self.dtype)
+        norm = partial(nn.BatchNorm, use_running_average=not train,
+                       momentum=0.9, dtype=self.dtype)
+        residual = x
+        y = conv(self.filters, (3, 3), (self.strides, self.strides))(x)
+        y = nn.relu(norm()(y))
+        y = conv(self.filters, (3, 3))(y)
+        y = norm(scale_init=nn.initializers.zeros)(y)
+        if residual.shape != y.shape:
+            residual = conv(self.filters, (1, 1),
+                            (self.strides, self.strides))(residual)
+            residual = norm()(residual)
+        return nn.relu(y + residual)
+
+
+class BottleneckBlock(nn.Module):
+    filters: int
+    strides: int = 1
+    dtype: Any = jnp.bfloat16
+
+    @nn.compact
+    def __call__(self, x, train: bool = False):
+        conv = partial(nn.Conv, use_bias=False, dtype=self.dtype)
+        norm = partial(nn.BatchNorm, use_running_average=not train,
+                       momentum=0.9, dtype=self.dtype)
+        residual = x
+        y = nn.relu(norm()(conv(self.filters, (1, 1))(x)))
+        y = conv(self.filters, (3, 3), (self.strides, self.strides))(y)
+        y = nn.relu(norm()(y))
+        y = conv(self.filters * 4, (1, 1))(y)
+        y = norm(scale_init=nn.initializers.zeros)(y)
+        if residual.shape != y.shape:
+            residual = conv(self.filters * 4, (1, 1),
+                            (self.strides, self.strides))(residual)
+            residual = norm()(residual)
+        return nn.relu(y + residual)
+
+
+class ResNet(nn.Module):
+    """Returns ``{"stage1".."stage4", "pooled", "logits"}`` endpoints.
+
+    ``pooled`` (the global-average-pool vector) is the transfer-learning
+    feature the reference extracts by cutting one layer off the CNTK graph
+    (``image/ImageFeaturizer.scala:40-60``).
+    """
+    stage_sizes: Sequence[int]
+    block: type = BottleneckBlock
+    num_classes: int = 1000
+    width: int = 64
+    dtype: Any = jnp.bfloat16
+
+    @nn.compact
+    def __call__(self, x, train: bool = False):
+        endpoints = {}
+        x = x.astype(self.dtype)
+        x = nn.Conv(self.width, (7, 7), (2, 2), use_bias=False,
+                    dtype=self.dtype, name="conv_init")(x)
+        x = nn.BatchNorm(use_running_average=not train, momentum=0.9,
+                         dtype=self.dtype, name="bn_init")(x)
+        x = nn.relu(x)
+        x = nn.max_pool(x, (3, 3), (2, 2), padding="SAME")
+        for i, n_blocks in enumerate(self.stage_sizes):
+            for j in range(n_blocks):
+                strides = 2 if i > 0 and j == 0 else 1
+                x = self.block(self.width * 2 ** i, strides,
+                               dtype=self.dtype)(x, train)
+            endpoints[f"stage{i + 1}"] = x
+        x = jnp.mean(x, axis=(1, 2))
+        endpoints["pooled"] = x.astype(jnp.float32)
+        logits = nn.Dense(self.num_classes, dtype=self.dtype,
+                          name="head")(x)
+        endpoints["logits"] = logits.astype(jnp.float32)
+        return endpoints
+
+    @property
+    def layer_names(self) -> list[str]:
+        """Feature endpoints ordered shallow→deep, mirroring the reference's
+        ``ModelSchema.layerNames`` contract (``downloader/Schema.scala``)."""
+        return ([f"stage{i+1}" for i in range(len(self.stage_sizes))]
+                + ["pooled", "logits"])
+
+
+def ResNet18(num_classes=1000, dtype=jnp.bfloat16):
+    return ResNet(stage_sizes=(2, 2, 2, 2), block=BasicBlock,
+                  num_classes=num_classes, dtype=dtype)
+
+
+def ResNet34(num_classes=1000, dtype=jnp.bfloat16):
+    return ResNet(stage_sizes=(3, 4, 6, 3), block=BasicBlock,
+                  num_classes=num_classes, dtype=dtype)
+
+
+def ResNet50(num_classes=1000, dtype=jnp.bfloat16):
+    return ResNet(stage_sizes=(3, 4, 6, 3), block=BottleneckBlock,
+                  num_classes=num_classes, dtype=dtype)
+
+
+def ResNet101(num_classes=1000, dtype=jnp.bfloat16):
+    return ResNet(stage_sizes=(3, 4, 23, 3), block=BottleneckBlock,
+                  num_classes=num_classes, dtype=dtype)

@@ -1,0 +1,128 @@
+"""Model registry + downloader.
+
+Reference: ``downloader/ModelDownloader.scala`` + ``downloader/Schema.scala``
+— a catalogue of pretrained CNNs (``ModelSchema``: uri, hash, inputNode,
+numLayers, layerNames) fetched from Azure blob with hash verification and
+retry (``FaultToleranceUtils.retryWithTimeout``,
+``ModelDownloader.scala:37-60``).
+
+TPU-native version: the schema survives; weights come from a local path or
+an orbax checkpoint. In a zero-egress build remote URIs are gated — models
+not found locally are initialized from the flax init (random weights), which
+keeps every downstream pipeline runnable and shape-correct; swap in real
+checkpoints by pointing ``MMLSPARK_TPU_MODEL_DIR`` at a checkpoint tree.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import hashlib
+import os
+from typing import Any, Callable
+
+import jax
+import numpy as np
+
+from ..core.utils import retry_with_timeout
+
+
+@dataclasses.dataclass
+class ModelSchema:
+    """Catalogue entry (reference ``downloader/Schema.scala``)."""
+    name: str
+    dataset: str = "ImageNet"
+    model_type: str = "image"
+    uri: str | None = None
+    hash: str | None = None
+    input_node: str = "image"
+    num_layers: int = 0
+    layer_names: tuple[str, ...] = ()
+    input_size: int = 224
+    num_classes: int = 1000
+    builder: Callable[..., Any] | None = None
+
+
+_REGISTRY: dict[str, ModelSchema] = {}
+
+
+def register_model(schema: ModelSchema) -> ModelSchema:
+    _REGISTRY[schema.name] = schema
+    return schema
+
+
+def _register_builtins():
+    from .resnet import ResNet18, ResNet34, ResNet50, ResNet101
+    for name, builder, layers in [
+            ("ResNet18", ResNet18, 18), ("ResNet34", ResNet34, 34),
+            ("ResNet50", ResNet50, 50), ("ResNet101", ResNet101, 101)]:
+        register_model(ModelSchema(
+            name=name, num_layers=layers, builder=builder,
+            layer_names=("stage1", "stage2", "stage3", "stage4",
+                         "pooled", "logits")))
+
+
+_register_builtins()
+
+
+def get_model(name: str) -> ModelSchema:
+    if name not in _REGISTRY:
+        raise KeyError(
+            f"unknown model {name!r}; registered: {sorted(_REGISTRY)}")
+    return _REGISTRY[name]
+
+
+@dataclasses.dataclass
+class LoadedModel:
+    """A model ready for inference: module + variables + schema."""
+    schema: ModelSchema
+    module: Any
+    variables: dict
+
+    @property
+    def layer_names(self) -> list[str]:
+        return list(self.schema.layer_names)
+
+
+class ModelDownloader:
+    """Resolve a catalogue model to weights (reference
+    ``ModelDownloader.downloadByName``). Local checkpoint dir → orbax
+    restore; otherwise deterministic random init (zero-egress fallback).
+    """
+
+    def __init__(self, local_dir: str | None = None):
+        self.local_dir = local_dir or os.environ.get(
+            "MMLSPARK_TPU_MODEL_DIR", "")
+
+    def download_by_name(self, name: str, *, num_classes: int | None = None,
+                         dtype=None) -> LoadedModel:
+        schema = get_model(name)
+        kwargs = {}
+        if num_classes is not None:
+            kwargs["num_classes"] = num_classes
+        if dtype is not None:
+            kwargs["dtype"] = dtype
+        module = schema.builder(**kwargs)
+        variables = self._load_or_init(schema, module)
+        return LoadedModel(schema=schema, module=module, variables=variables)
+
+    # -- weights ------------------------------------------------------------
+    def _ckpt_path(self, schema: ModelSchema) -> str | None:
+        if not self.local_dir:
+            return None
+        path = os.path.join(self.local_dir, schema.name)
+        return path if os.path.isdir(path) else None
+
+    def _load_or_init(self, schema: ModelSchema, module) -> dict:
+        path = self._ckpt_path(schema)
+        if path:
+            def restore():
+                import orbax.checkpoint as ocp
+                with ocp.PyTreeCheckpointer() as ck:
+                    return ck.restore(path)
+            # reference retries downloads with backoff
+            return retry_with_timeout(restore, retries=3)
+        rng = jax.random.PRNGKey(
+            int(hashlib.md5(schema.name.encode()).hexdigest()[:8], 16))
+        dummy = np.zeros((1, schema.input_size, schema.input_size, 3),
+                         np.float32)
+        return jax.jit(module.init, static_argnums=2)(rng, dummy, False)

@@ -1,0 +1,13 @@
+"""Nearest-neighbor search.
+
+Reference ``nn/`` (SURVEY §2.10): ``BallTree`` / ``ConditionalBallTree``
+with inner-product bound search, broadcast to executors, queried via
+mapPartitions. On TPU brute-force batched matmul + top-k beats tree
+traversal (the MXU does 10^12 dot products/sec; pointer chasing does not),
+so KNN/ConditionalKNN are matmul + ``jax.lax.top_k`` — same API, same
+results, hardware-right algorithm.
+"""
+
+from .knn import KNN, KNNModel, ConditionalKNN, ConditionalKNNModel
+
+__all__ = ["KNN", "KNNModel", "ConditionalKNN", "ConditionalKNNModel"]

@@ -1,0 +1,148 @@
+"""KNN / ConditionalKNN — exact inner-product top-k by batched matmul.
+
+Reference ``nn/KNN.scala`` + ``nn/BallTree.scala:31-55`` (inner-product
+ball tree) and ``nn/ConditionalKNN.scala:31-110`` (per-query label
+conditioning). The reference broadcasts a ball tree and walks it per query;
+here the index is a dense [N, D] matrix resident on device and queries run
+as [Q, D] @ [D, N] → top-k — exact, batched, MXU-bound.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..core import ComplexParam, Estimator, Model, Param, \
+    TypeConverters as TC
+from ..core.contracts import HasFeaturesCol, HasOutputCol
+from ..core.utils import as_2d_features
+
+
+@functools.partial(jax.jit, static_argnames=("k",))
+def _topk_inner(index, queries, k: int):
+    scores = queries @ index.T                       # [Q, N]
+    return jax.lax.top_k(scores, k)
+
+
+@functools.partial(jax.jit, static_argnames=("k",))
+def _topk_conditional(index, labels_onehot, queries, allowed, k: int):
+    """allowed: [Q, L] bool — per-query permitted labels
+    (ConditionalKNN's conditioner)."""
+    scores = queries @ index.T                       # [Q, N]
+    ok = (allowed.astype(jnp.float32)
+          @ labels_onehot.T.astype(jnp.float32)) > 0  # [Q, N]
+    scores = jnp.where(ok, scores, -jnp.inf)
+    return jax.lax.top_k(scores, k)
+
+
+class KNN(Estimator, HasFeaturesCol, HasOutputCol):
+    valuesCol = Param("valuesCol", "payload column carried with neighbors",
+                      TC.toString, default="values")
+    k = Param("k", "neighbors per query", TC.toInt, default=5)
+
+    def __init__(self, **kwargs):
+        super().__init__(**kwargs)
+        self._setDefault(outputCol="output")
+
+    def _fit(self, df):
+        feats = as_2d_features(df, self.getFeaturesCol())
+        values = df[self.get("valuesCol")] \
+            if self.get("valuesCol") in df.columns else None
+        model = KNNModel(index=np.asarray(feats, np.float32),
+                         values=values)
+        self._copy_params_to(model)
+        return model
+
+
+class KNNModel(Model, HasFeaturesCol, HasOutputCol):
+    index = ComplexParam("index", "[N, D] indexed vectors")
+    values = ComplexParam("values", "payload per indexed row", default=None,
+                          has_default=True)
+    k = Param("k", "neighbors per query", TC.toInt, default=5)
+
+    def _transform(self, df):
+        q = as_2d_features(df, self.getFeaturesCol()).astype(np.float32)
+        idx = self.get("index")
+        dist, nbr = _topk_inner(jnp.asarray(idx), jnp.asarray(q),
+                                min(self.get("k"), idx.shape[0]))
+        dist, nbr = np.asarray(dist), np.asarray(nbr)
+        vals = self.get("values")
+        out = np.empty(len(q), object)
+        out[:] = [
+            [{"distance": float(d), "index": int(i),
+              **({"value": vals[i]} if vals is not None else {})}
+             for d, i in zip(drow, irow)]
+            for drow, irow in zip(dist, nbr)]
+        return df.with_column(self.getOutputCol(), out)
+
+
+class ConditionalKNN(Estimator, HasFeaturesCol, HasOutputCol):
+    valuesCol = Param("valuesCol", "payload column", TC.toString,
+                      default="values")
+    labelCol = Param("labelCol", "per-row conditioning label", TC.toString,
+                     default="labels")
+    conditionerCol = Param("conditionerCol",
+                           "per-query set of permitted labels", TC.toString,
+                           default="conditioner")
+    k = Param("k", "neighbors per query", TC.toInt, default=5)
+
+    def __init__(self, **kwargs):
+        super().__init__(**kwargs)
+        self._setDefault(outputCol="output")
+
+    def _fit(self, df):
+        feats = as_2d_features(df, self.getFeaturesCol())
+        labels = np.asarray(df[self.get("labelCol")])
+        values = df[self.get("valuesCol")] \
+            if self.get("valuesCol") in df.columns else None
+        levels = sorted({v for v in labels.tolist()}, key=str)
+        lab_idx = np.asarray([levels.index(v) for v in labels.tolist()])
+        onehot = np.zeros((len(labels), len(levels)), np.float32)
+        onehot[np.arange(len(labels)), lab_idx] = 1.0
+        model = ConditionalKNNModel(
+            index=np.asarray(feats, np.float32), values=values,
+            labels=labels, labelLevels=levels, labelsOnehot=onehot)
+        self._copy_params_to(model)
+        return model
+
+
+class ConditionalKNNModel(Model, HasFeaturesCol, HasOutputCol):
+    index = ComplexParam("index", "[N, D] indexed vectors")
+    values = ComplexParam("values", "payload per indexed row", default=None,
+                          has_default=True)
+    labels = ComplexParam("labels", "label per indexed row")
+    labelLevels = ComplexParam("labelLevels", "ordered distinct labels")
+    labelsOnehot = ComplexParam("labelsOnehot", "[N, L] one-hot labels")
+    conditionerCol = Param("conditionerCol", "per-query permitted labels",
+                           TC.toString, default="conditioner")
+    k = Param("k", "neighbors per query", TC.toInt, default=5)
+
+    def _transform(self, df):
+        q = as_2d_features(df, self.getFeaturesCol()).astype(np.float32)
+        levels = self.get("labelLevels")
+        cond = df[self.get("conditionerCol")]
+        allowed = np.zeros((len(q), len(levels)), bool)
+        for r, permitted in enumerate(cond):
+            items = permitted if isinstance(
+                permitted, (list, tuple, set, np.ndarray)) else [permitted]
+            for v in items:
+                if v in levels:
+                    allowed[r, levels.index(v)] = True
+        idx = self.get("index")
+        dist, nbr = _topk_conditional(
+            jnp.asarray(idx), jnp.asarray(self.get("labelsOnehot")),
+            jnp.asarray(q), jnp.asarray(allowed),
+            min(self.get("k"), idx.shape[0]))
+        dist, nbr = np.asarray(dist), np.asarray(nbr)
+        vals = self.get("values")
+        labels = self.get("labels")
+        out = np.empty(len(q), object)
+        out[:] = [
+            [{"distance": float(d), "index": int(i), "label": labels[i],
+              **({"value": vals[i]} if vals is not None else {})}
+             for d, i in zip(drow, irow) if np.isfinite(d)]
+            for drow, irow in zip(dist, nbr)]
+        return df.with_column(self.getOutputCol(), out)

@@ -1,0 +1,30 @@
+"""Distributed backend: device meshes, collectives, sharding helpers, and
+sequence parallelism.
+
+This package is the TPU-native replacement for the reference's entire L3
+"distributed coordination / comm" layer (SURVEY §2.13): the driver
+ServerSocket rendezvous (``lightgbm/LightGBMUtils.scala:119-188``), the
+LightGBM socket allreduce (``lightgbm/TrainUtils.scala:609-625``), and the VW
+spanning-tree AllReduce (``vw/VowpalWabbitBase.scala:434-461``) all collapse
+into a ``jax.sharding.Mesh`` + XLA collectives over ICI/DCN:
+
+- rendezvous        → :func:`distributed_init` (JAX coordination service)
+- socket allreduce  → :func:`allreduce` / ``psum`` inside ``shard_map``
+- spanning tree     → the same (XLA picks the reduction topology)
+- empty partitions  → padding masks (:func:`pad_rows`), never ragged shards
+"""
+
+from .mesh import (MeshSpec, build_mesh, distributed_init, local_mesh,
+                   mesh_shape_for)
+from .collectives import (allgather, allreduce, barrier, psum_scatter,
+                          ring_permute)
+from .sharding import (batch_sharding, pad_rows, replicated, shard_batch,
+                       unpad_rows)
+from .ring_attention import ring_attention, blockwise_attention
+
+__all__ = [
+    "MeshSpec", "build_mesh", "distributed_init", "local_mesh",
+    "mesh_shape_for", "allgather", "allreduce", "barrier", "psum_scatter",
+    "ring_permute", "batch_sharding", "pad_rows", "replicated",
+    "shard_batch", "unpad_rows", "ring_attention", "blockwise_attention",
+]

@@ -1,0 +1,58 @@
+"""Named collectives over mesh axes.
+
+These are the framework's replacement for the reference's three comm
+backends (SURVEY §2.13): LightGBM's raw-TCP ring/Bruck allreduce
+(``lightgbm/TrainUtils.scala:609-625``), VW's spanning-tree AllReduce
+(``vw/VowpalWabbitBase.scala:434-461``), and Spark broadcast/barrier
+(``LightGBMBase.scala:256-261``). Inside ``shard_map``/``pjit`` these lower
+to XLA collectives that ride ICI within a slice and DCN across slices.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+
+def allreduce(x, axis: str | tuple[str, ...], op: str = "sum"):
+    """psum/pmax/pmin/pmean over a named mesh axis (LightGBM's histogram
+    allreduce; VW's weight averaging with op="mean")."""
+    if op == "sum":
+        return jax.lax.psum(x, axis)
+    if op == "mean":
+        return jax.lax.pmean(x, axis)
+    if op == "max":
+        return jax.lax.pmax(x, axis)
+    if op == "min":
+        return jax.lax.pmin(x, axis)
+    raise ValueError(f"unknown op {op!r}")
+
+
+def allgather(x, axis: str, *, tiled: bool = True, gather_axis: int = 0):
+    """Gather shards along a named axis (voting-parallel top-K exchange)."""
+    return jax.lax.all_gather(x, axis, axis=gather_axis, tiled=tiled)
+
+
+def psum_scatter(x, axis: str, *, scatter_axis: int = 0):
+    """reduce_scatter: each shard gets one slice of the summed tensor."""
+    return jax.lax.psum_scatter(x, axis, scatter_dimension=scatter_axis,
+                                tiled=True)
+
+
+def ring_permute(x, axis: str, shift: int = 1):
+    """Rotate shards around the ring of a named axis (the building block of
+    ring attention / sequence parallelism)."""
+    n = jax.lax.axis_size(axis)
+    perm = [(i, (i + shift) % n) for i in range(n)]
+    return jax.lax.ppermute(x, axis, perm)
+
+
+def barrier(axis: str):
+    """SPMD barrier: a trivial psum forces all shards to rendezvous.
+
+    The reference uses Spark barrier execution to keep partial stages from
+    deadlocking the collective ring (``LightGBMBase.scala:106-137``); in SPMD
+    every program step is already a barrier, but this is handy to delimit
+    phases explicitly.
+    """
+    return jax.lax.psum(jnp.zeros((), jnp.int32), axis)

@@ -1,0 +1,111 @@
+"""Mesh construction and multi-host bootstrap.
+
+Replaces the reference's cluster-topology discovery and rendezvous:
+``ClusterUtil.getNumTasksPerExecutor`` (``core/utils/ClusterUtil.scala:13-291``)
+becomes device enumeration; the driver ServerSocket rendezvous that collects
+``host:port`` from every worker (``lightgbm/LightGBMUtils.scala:119-188``)
+becomes the JAX coordination service (:func:`distributed_init`).
+
+Axis conventions (used throughout the framework):
+  ``dp`` — data parallel (rows / batch)
+  ``tp`` — tensor parallel (model weights)
+  ``pp`` — pipeline parallel (layer stages)
+  ``sp`` — sequence/context parallel (ring attention)
+  ``ep`` — expert parallel (MoE)
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import math
+import os
+
+import numpy as np
+
+AXIS_ORDER = ("pp", "dp", "ep", "sp", "tp")
+
+
+@dataclasses.dataclass(frozen=True)
+class MeshSpec:
+    """Declarative mesh: axis name -> size; -1 for one auto-filled axis."""
+    dp: int = -1
+    tp: int = 1
+    pp: int = 1
+    sp: int = 1
+    ep: int = 1
+
+    def resolve(self, n_devices: int) -> dict[str, int]:
+        sizes = {a: getattr(self, a) for a in AXIS_ORDER}
+        fixed = math.prod(s for s in sizes.values() if s > 0)
+        autos = [a for a, s in sizes.items() if s <= 0]
+        if len(autos) > 1:
+            raise ValueError(f"only one axis may be -1, got {autos}")
+        if autos:
+            if n_devices % fixed:
+                raise ValueError(
+                    f"{n_devices} devices not divisible by fixed axes "
+                    f"{fixed}")
+            sizes[autos[0]] = n_devices // fixed
+        elif fixed != n_devices:
+            raise ValueError(
+                f"mesh {sizes} needs {fixed} devices, have {n_devices}")
+        return sizes
+
+
+def build_mesh(spec: MeshSpec | None = None, devices=None):
+    """Build a Mesh over all (or given) devices.
+
+    Axes of size 1 are kept in the mesh so PartitionSpecs can always name
+    them — XLA elides trivial collectives, so this costs nothing.
+    """
+    import jax
+    from jax.sharding import Mesh
+
+    devices = np.asarray(jax.devices() if devices is None else devices)
+    spec = spec or MeshSpec()
+    sizes = spec.resolve(devices.size)
+    shape = tuple(sizes[a] for a in AXIS_ORDER)
+    return Mesh(devices.reshape(shape), AXIS_ORDER)
+
+
+def local_mesh(axis: str = "dp", devices=None):
+    """1-D mesh over every visible device — the default data-parallel world
+    (the reference's "one LightGBM machine per Spark task")."""
+    import jax
+    from jax.sharding import Mesh
+    devices = np.asarray(jax.devices() if devices is None else devices)
+    return Mesh(devices, (axis,))
+
+
+def mesh_shape_for(n_devices: int, **axes: int) -> MeshSpec:
+    """Convenience: MeshSpec from keyword sizes, validated for n_devices."""
+    spec = MeshSpec(**axes)
+    spec.resolve(n_devices)
+    return spec
+
+
+def distributed_init(coordinator_address: str | None = None,
+                     num_processes: int | None = None,
+                     process_id: int | None = None) -> None:
+    """Multi-host bootstrap: JAX coordination service.
+
+    Stands in for the reference's driver rendezvous
+    (``LightGBMUtils.createDriverNodesThread``,
+    ``lightgbm/LightGBMUtils.scala:119-188``): instead of every worker
+    reporting ``host:port`` over a raw socket and receiving the peer list,
+    every process dials the coordinator and PJRT wires the ICI/DCN mesh.
+
+    No-ops on single-process (local/test) runs so library code can call it
+    unconditionally.
+    """
+    import jax
+
+    addr = coordinator_address or os.environ.get("MMLSPARK_TPU_COORDINATOR")
+    if addr is None:
+        return
+    jax.distributed.initialize(
+        coordinator_address=addr,
+        num_processes=num_processes
+        or int(os.environ.get("MMLSPARK_TPU_NUM_PROCESSES", "1")),
+        process_id=process_id
+        or int(os.environ.get("MMLSPARK_TPU_PROCESS_ID", "0")))

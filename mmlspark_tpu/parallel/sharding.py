@@ -1,0 +1,77 @@
+"""Sharding + padding helpers.
+
+The reference's answer to ragged work distribution is the ``ignore``
+protocol: empty Spark partitions opt out of the collective ring
+(``lightgbm/TrainUtils.scala:652-669``, ``LightGBMConstants.scala:36``).
+SPMD programs need fixed shapes instead, so the framework's convention is
+**pad rows to a multiple of the shard count and carry a row-validity mask**;
+every reduction in the compute path honours the mask, so padded rows are the
+moral equivalent of ignored partitions.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+
+def replicated(mesh):
+    return NamedSharding(mesh, P())
+
+
+def batch_sharding(mesh, axis: str = "dp", ndim: int = 2):
+    """Rows sharded over `axis`, remaining dims replicated."""
+    return NamedSharding(mesh, P(axis, *([None] * (ndim - 1))))
+
+
+def pad_rows(arrays, multiple: int, pad_value=0.0):
+    """Pad each array's leading dim up to a multiple; returns
+    (padded_arrays, mask) where mask is f32 [n_padded] with 1 = real row.
+
+    Accepts a single array or a sequence; None entries pass through.
+    """
+    single = not isinstance(arrays, (list, tuple))
+    arrs = [arrays] if single else list(arrays)
+    n = next(a.shape[0] for a in arrs if a is not None)
+    n_pad = (-n) % multiple
+    out = []
+    for a in arrs:
+        if a is None:
+            out.append(None)
+            continue
+        if a.shape[0] != n:
+            raise ValueError("inconsistent leading dims")
+        pad_width = [(0, n_pad)] + [(0, 0)] * (a.ndim - 1)
+        out.append(np.pad(a, pad_width, constant_values=pad_value))
+    mask = np.ones(n + n_pad, np.float32)
+    mask[n:] = 0.0
+    return (out[0] if single else out), mask
+
+
+def unpad_rows(array, n_real: int):
+    return array[:n_real]
+
+
+def shard_batch(mesh, arrays, axis: str = "dp", pad_value=0.0):
+    """Pad + device_put a batch sharded over a mesh axis.
+
+    Returns (sharded_arrays, mask_sharded, n_real).
+    """
+    import jax
+
+    single = not isinstance(arrays, (list, tuple))
+    arrs = [arrays] if single else list(arrays)
+    n_real = next(a.shape[0] for a in arrs if a is not None)
+    size = int(np.prod([mesh.shape[a] for a in ([axis] if isinstance(
+        axis, str) else axis)]))
+    padded, mask = pad_rows(arrs, size, pad_value)
+    out = []
+    for a in padded:
+        if a is None:
+            out.append(None)
+            continue
+        sh = NamedSharding(mesh, P(axis, *([None] * (a.ndim - 1))))
+        out.append(jax.device_put(a, sh))
+    mask_dev = jax.device_put(mask, NamedSharding(mesh, P(axis)))
+    return (out[0] if single else out), mask_dev, n_real

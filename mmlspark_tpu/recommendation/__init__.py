@@ -1,0 +1,17 @@
+"""Recommendation: SAR + ranking evaluation.
+
+Reference ``recommendation/`` (SURVEY §2.10): ``SAR.scala`` (item-item
+co-occurrence similarities + time-decayed user affinity), ``SARModel.scala``
+(affinity × similarity top-K), ``RankingAdapter``/``RankingEvaluator``
+(NDCG/MAP/recall@k), ``RankingTrainValidationSplit`` (per-user splits +
+param sweep), ``RecommendationIndexer``.
+"""
+
+from .sar import SAR, SARModel
+from .indexer import RecommendationIndexer, RecommendationIndexerModel
+from .evaluator import RankingEvaluator, RankingAdapter
+from .split import RankingTrainValidationSplit
+
+__all__ = ["SAR", "SARModel", "RecommendationIndexer",
+           "RecommendationIndexerModel", "RankingEvaluator",
+           "RankingAdapter", "RankingTrainValidationSplit"]

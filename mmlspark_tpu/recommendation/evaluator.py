@@ -1,0 +1,86 @@
+"""Ranking evaluation: NDCG@k, MAP@k, precision/recall@k.
+
+Reference ``recommendation/RankingEvaluator`` + ``RankingAdapter`` —
+converts scored interactions to per-user ranked lists and computes
+top-k ranking metrics.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from ..core import DataFrame, Transformer, Param, TypeConverters as TC
+
+
+def ndcg_at_k(recommended: list, relevant: set, k: int) -> float:
+    dcg = sum(1.0 / np.log2(i + 2)
+              for i, r in enumerate(recommended[:k]) if r in relevant)
+    ideal = sum(1.0 / np.log2(i + 2)
+                for i in range(min(len(relevant), k)))
+    return dcg / ideal if ideal > 0 else 0.0
+
+
+def map_at_k(recommended: list, relevant: set, k: int) -> float:
+    hits, score = 0, 0.0
+    for i, r in enumerate(recommended[:k]):
+        if r in relevant:
+            hits += 1
+            score += hits / (i + 1)
+    return score / min(len(relevant), k) if relevant else 0.0
+
+
+def precision_at_k(recommended: list, relevant: set, k: int) -> float:
+    return sum(r in relevant for r in recommended[:k]) / k
+
+
+def recall_at_k(recommended: list, relevant: set, k: int) -> float:
+    if not relevant:
+        return 0.0
+    return sum(r in relevant for r in recommended[:k]) / len(relevant)
+
+
+_METRICS = {"ndcgAt": ndcg_at_k, "map": map_at_k,
+            "precisionAtk": precision_at_k, "recallAtK": recall_at_k}
+
+
+class RankingEvaluator:
+    """Evaluate (recommendations, ground-truth) per user.
+
+    ``evaluate(df)`` expects columns ``recommendations`` (list per user,
+    as produced by ``SARModel.recommend_for_all_users``) and ``groundTruth``
+    (list per user).
+    """
+
+    def __init__(self, k: int = 10, metric_name: str = "ndcgAt"):
+        self.k = k
+        self.metric_name = metric_name
+
+    def evaluate(self, df: DataFrame) -> float:
+        fn = _METRICS[self.metric_name]
+        recs = df["recommendations"]
+        truth = df["groundTruth"]
+        vals = [fn(list(r), set(t), self.k) for r, t in zip(recs, truth)]
+        return float(np.mean(vals)) if vals else 0.0
+
+
+class RankingAdapter(Transformer):
+    """Join model recommendations with held-out truth per user
+    (reference ``RankingAdapter``: mode="allUsers" top-k)."""
+
+    userCol = Param("userCol", "user column", TC.toString, default="user")
+    itemCol = Param("itemCol", "item column", TC.toString, default="item")
+    k = Param("k", "recommendations per user", TC.toInt, default=10)
+    recommender = Param("recommender", "fitted SARModel (or compatible)")
+
+    def _transform(self, df):
+        model = self.get("recommender")
+        recs = model.recommend_for_all_users(self.get("k"))
+        truth: dict = {}
+        users = np.asarray(df[self.get("userCol")], np.int64)
+        items = np.asarray(df[self.get("itemCol")], np.int64)
+        for u, i in zip(users, items):
+            truth.setdefault(int(u), []).append(int(i))
+        rec_users = np.asarray(recs[self.get("userCol")], np.int64)
+        gt = np.empty(len(rec_users), object)
+        gt[:] = [truth.get(int(u), []) for u in rec_users]
+        return recs.with_column("groundTruth", gt)

@@ -1,0 +1,63 @@
+"""RecommendationIndexer — user/item id indexing.
+
+Reference ``recommendation/RecommendationIndexer.scala``: string user/item
+columns → contiguous int indices (fit collects vocabularies), with inverse
+mapping for recommendation output.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from ..core import ComplexParam, Estimator, Model, Param, \
+    TypeConverters as TC
+
+
+class RecommendationIndexer(Estimator):
+    userInputCol = Param("userInputCol", "raw user column", TC.toString)
+    userOutputCol = Param("userOutputCol", "indexed user column",
+                          TC.toString, default="user")
+    itemInputCol = Param("itemInputCol", "raw item column", TC.toString)
+    itemOutputCol = Param("itemOutputCol", "indexed item column",
+                          TC.toString, default="item")
+    ratingCol = Param("ratingCol", "rating column", TC.toString,
+                      default="rating")
+
+    def _fit(self, df):
+        users = sorted({v for v in df[self.getUserInputCol()].tolist()},
+                       key=str)
+        items = sorted({v for v in df[self.getItemInputCol()].tolist()},
+                       key=str)
+        model = RecommendationIndexerModel(userLevels=users,
+                                           itemLevels=items)
+        self._copy_params_to(model)
+        return model
+
+
+class RecommendationIndexerModel(Model):
+    userInputCol = Param("userInputCol", "raw user column", TC.toString)
+    userOutputCol = Param("userOutputCol", "indexed user column",
+                          TC.toString, default="user")
+    itemInputCol = Param("itemInputCol", "raw item column", TC.toString)
+    itemOutputCol = Param("itemOutputCol", "indexed item column",
+                          TC.toString, default="item")
+    userLevels = ComplexParam("userLevels", "ordered raw user values")
+    itemLevels = ComplexParam("itemLevels", "ordered raw item values")
+
+    def _transform(self, df):
+        u_map = {v: i for i, v in enumerate(self.get("userLevels"))}
+        i_map = {v: i for i, v in enumerate(self.get("itemLevels"))}
+        users = np.asarray([u_map[v] for v in
+                            df[self.getUserInputCol()].tolist()], np.int64)
+        items = np.asarray([i_map[v] for v in
+                            df[self.getItemInputCol()].tolist()], np.int64)
+        return (df.with_column(self.get("userOutputCol"), users)
+                  .with_column(self.get("itemOutputCol"), items))
+
+    def recover_user(self, idx: np.ndarray):
+        levels = np.asarray(self.get("userLevels"), object)
+        return levels[np.asarray(idx, np.int64)]
+
+    def recover_item(self, idx: np.ndarray):
+        levels = np.asarray(self.get("itemLevels"), object)
+        return levels[np.asarray(idx, np.int64)]

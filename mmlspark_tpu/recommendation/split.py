@@ -1,0 +1,90 @@
+"""RankingTrainValidationSplit — per-user chronological/ratio splits +
+parallel param sweep.
+
+Reference ``recommendation/RankingTrainValidationSplit.scala:25-292``:
+split each user's interactions into train/validation (by ratio, min
+ratings enforced), sweep estimator param maps in a thread pool (:94-132),
+pick the best by a ranking metric.
+"""
+
+from __future__ import annotations
+
+from concurrent.futures import ThreadPoolExecutor
+
+import numpy as np
+
+from ..core import ComplexParam, Estimator, Model, Param, \
+    TypeConverters as TC
+from .evaluator import RankingAdapter, RankingEvaluator
+
+
+class RankingTrainValidationSplit(Estimator):
+    estimator = ComplexParam("estimator", "recommender estimator (SAR)")
+    paramMaps = ComplexParam("paramMaps",
+                             "list of {param: value} dicts to sweep",
+                             default=None, has_default=True)
+    userCol = Param("userCol", "user column", TC.toString, default="user")
+    itemCol = Param("itemCol", "item column", TC.toString, default="item")
+    trainRatio = Param("trainRatio", "per-user train fraction", TC.toFloat,
+                       default=0.75)
+    minRatingsPerUser = Param("minRatingsPerUser",
+                              "users below this are all-train", TC.toInt,
+                              default=1)
+    k = Param("k", "eval cutoff", TC.toInt, default=10)
+    metricName = Param("metricName", "ndcgAt | map | recallAtK",
+                       TC.toString, default="ndcgAt")
+    parallelism = Param("parallelism", "concurrent fits", TC.toInt,
+                        default=2)
+    seed = Param("seed", "shuffle seed", TC.toInt, default=0)
+
+    def _split(self, df):
+        users = np.asarray(df[self.get("userCol")], np.int64)
+        rng = np.random.default_rng(self.get("seed"))
+        in_train = np.ones(len(users), bool)
+        for u in np.unique(users):
+            idx = np.where(users == u)[0]
+            if len(idx) < self.get("minRatingsPerUser") or len(idx) < 2:
+                continue
+            n_val = max(1, int(round(len(idx)
+                                     * (1 - self.get("trainRatio")))))
+            n_val = min(n_val, len(idx) - 1)
+            in_train[rng.choice(idx, size=n_val, replace=False)] = False
+        return df.filter(in_train), df.filter(~in_train)
+
+    def _fit(self, df):
+        train_df, valid_df = self._split(df)
+        base = self.get("estimator")
+        param_maps = self.get("paramMaps") or [{}]
+
+        def run(pm: dict) -> tuple[float, object]:
+            est = base.copy()
+            for name, value in pm.items():
+                est.set(name, value)
+            model = est.fit(train_df)
+            adapter = RankingAdapter(
+                userCol=self.get("userCol"), itemCol=self.get("itemCol"),
+                k=self.get("k"), recommender=model)
+            joined = adapter.transform(valid_df)
+            metric = RankingEvaluator(
+                k=self.get("k"),
+                metric_name=self.get("metricName")).evaluate(joined)
+            return metric, model
+
+        with ThreadPoolExecutor(self.get("parallelism")) as pool:
+            results = list(pool.map(run, param_maps))
+        metrics = [m for m, _ in results]
+        best_idx = int(np.argmax(metrics))
+        model = RankingTrainValidationSplitModel(
+            bestModel=results[best_idx][1],
+            validationMetrics=metrics)
+        self._copy_params_to(model)
+        return model
+
+
+class RankingTrainValidationSplitModel(Model):
+    bestModel = ComplexParam("bestModel", "winning recommender")
+    validationMetrics = ComplexParam("validationMetrics",
+                                     "metric per param map")
+
+    def _transform(self, df):
+        return self.get("bestModel").transform(df)

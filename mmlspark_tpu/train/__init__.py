@@ -1,0 +1,16 @@
+"""Auto-training + evaluation layer.
+
+Reference ``train/`` (SURVEY §2.10): ``TrainClassifier``/``TrainRegressor``
+wrap any predictor with auto-featurization + label indexing;
+``ComputeModelStatistics``/``ComputePerInstanceStatistics`` compute metric
+DataFrames.
+"""
+
+from .train_classifier import (TrainClassifier, TrainRegressor,
+                               TrainedClassifierModel, TrainedRegressorModel)
+from .statistics import (ComputeModelStatistics, ComputePerInstanceStatistics,
+                         MetricConstants)
+
+__all__ = ["TrainClassifier", "TrainRegressor", "TrainedClassifierModel",
+           "TrainedRegressorModel", "ComputeModelStatistics",
+           "ComputePerInstanceStatistics", "MetricConstants"]

@@ -1,0 +1,138 @@
+"""ComputeModelStatistics / ComputePerInstanceStatistics.
+
+Reference ``train/ComputeModelStatistics.scala:58-...`` +
+``core/metrics/MetricConstants.scala``: classification (accuracy,
+precision, recall, AUC, confusion matrix) and regression (mse, rmse, r2,
+mae) metric DataFrames, plus per-row log-loss / squared-error.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from ..core import DataFrame, Transformer, Param, TypeConverters as TC
+from ..core.contracts import HasLabelCol
+from ..lightgbm.trainer import roc_auc
+
+
+class MetricConstants:
+    """Metric names (reference ``core/metrics/MetricConstants.scala``)."""
+    AccuracySparkMetric = "accuracy"
+    PrecisionSparkMetric = "precision"
+    RecallSparkMetric = "recall"
+    AucSparkMetric = "AUC"
+    MseSparkMetric = "mse"
+    RmseSparkMetric = "rmse"
+    R2SparkMetric = "r^2"
+    MaeSparkMetric = "mae"
+    ClassificationMetrics = "classification"
+    RegressionMetrics = "regression"
+    AllSparkMetrics = "all"
+
+
+def confusion_matrix(y: np.ndarray, pred: np.ndarray,
+                     n_classes: int | None = None) -> np.ndarray:
+    k = n_classes or int(max(y.max(), pred.max())) + 1
+    cm = np.zeros((k, k), np.int64)
+    np.add.at(cm, (y.astype(int), pred.astype(int)), 1)
+    return cm
+
+
+def classification_metrics(y, pred, scores=None) -> dict:
+    cm = confusion_matrix(y, pred)
+    acc = float((pred == y).mean())
+    with np.errstate(divide="ignore", invalid="ignore"):
+        # micro-averaged for multiclass; binary reduces to the usual defs
+        tp = np.diag(cm).astype(float)
+        prec = np.nansum(tp / np.maximum(cm.sum(axis=0), 1) *
+                         cm.sum(axis=1) / cm.sum())
+        rec = np.nansum(tp / np.maximum(cm.sum(axis=1), 1) *
+                        cm.sum(axis=1) / cm.sum())
+    out = {"accuracy": acc, "precision": float(prec), "recall": float(rec),
+           "confusion_matrix": cm}
+    if scores is not None and cm.shape[0] <= 2:
+        out["AUC"] = roc_auc(y, scores)
+    return out
+
+
+def regression_metrics(y, pred) -> dict:
+    err = pred - y
+    mse = float(np.mean(err ** 2))
+    ss_tot = float(np.sum((y - y.mean()) ** 2))
+    return {"mse": mse, "rmse": float(np.sqrt(mse)),
+            "mae": float(np.mean(np.abs(err))),
+            "r^2": 1.0 - float(np.sum(err ** 2)) / ss_tot
+            if ss_tot > 0 else 0.0}
+
+
+def roc_curve(y: np.ndarray, scores: np.ndarray,
+              num_points: int = 100) -> DataFrame:
+    """(fpr, tpr) curve DataFrame (reference ``rocCurve`` output)."""
+    order = np.argsort(-scores)
+    y_s = (y[order] > 0).astype(np.float64)
+    tps = np.cumsum(y_s)
+    fps = np.cumsum(1 - y_s)
+    P, N = max(tps[-1], 1), max(fps[-1], 1)
+    idx = np.linspace(0, len(y) - 1, min(num_points, len(y))).astype(int)
+    return DataFrame({"false_positive_rate": fps[idx] / N,
+                      "true_positive_rate": tps[idx] / P})
+
+
+class ComputeModelStatistics(Transformer, HasLabelCol):
+    """Emits a one-row metrics DataFrame for scored data."""
+
+    scoresCol = Param("scoresCol", "raw score / probability column",
+                      TC.toString, default="probability")
+    scoredLabelsCol = Param("scoredLabelsCol", "prediction column",
+                            TC.toString, default="prediction")
+    evaluationMetric = Param("evaluationMetric",
+                             "classification | regression | all",
+                             TC.toString, default="all")
+
+    def _transform(self, df):
+        y = np.asarray(df[self.getLabelCol()], np.float64)
+        pred = np.asarray(df[self.get("scoredLabelsCol")], np.float64)
+        kind = self.get("evaluationMetric")
+        if kind == "all":
+            is_cls = (np.allclose(y, np.round(y))
+                      and len(np.unique(y)) <= max(20, int(y.max()) + 1)
+                      and len(np.unique(y)) < max(20, len(y) // 10))
+            kind = "classification" if is_cls else "regression"
+        if kind == "classification":
+            scores = None
+            if self.get("scoresCol") in df.columns:
+                s = df[self.get("scoresCol")]
+                scores = np.asarray(s)[:, -1] if np.asarray(s).ndim == 2 \
+                    else np.asarray(s, np.float64)
+            m = classification_metrics(y, pred, scores)
+            m.pop("confusion_matrix")
+        else:
+            m = regression_metrics(y, pred)
+        return DataFrame({k: np.asarray([v]) for k, v in m.items()})
+
+
+class ComputePerInstanceStatistics(Transformer, HasLabelCol):
+    """Per-row statistics (reference ``ComputePerInstanceStatistics.scala``):
+    log-loss for classification, squared/absolute error for regression."""
+
+    scoresCol = Param("scoresCol", "probability column", TC.toString,
+                      default="probability")
+    scoredLabelsCol = Param("scoredLabelsCol", "prediction column",
+                            TC.toString, default="prediction")
+    evaluationMetric = Param("evaluationMetric",
+                             "classification | regression", TC.toString,
+                             default="classification")
+
+    def _transform(self, df):
+        y = np.asarray(df[self.getLabelCol()], np.float64)
+        if self.get("evaluationMetric") == "classification":
+            probs = np.asarray(df[self.get("scoresCol")], np.float64)
+            if probs.ndim == 1:
+                probs = np.stack([1 - probs, probs], axis=1)
+            py = np.clip(probs[np.arange(len(y)), y.astype(int)],
+                         1e-15, None)
+            return df.with_column("log_loss",
+                                  (-np.log(py)).astype(np.float64))
+        pred = np.asarray(df[self.get("scoredLabelsCol")], np.float64)
+        return (df.with_column("squared_error", (pred - y) ** 2)
+                  .with_column("absolute_error", np.abs(pred - y)))

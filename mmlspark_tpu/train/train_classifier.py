@@ -1,0 +1,136 @@
+"""TrainClassifier / TrainRegressor — auto-featurizing learner wrappers.
+
+Reference ``train/TrainClassifier.scala:49-...``, ``TrainRegressor.scala``,
+``AutoTrainer.scala``: wrap any predictor with ValueIndexer on the label +
+Featurize on all non-label columns, then score through the fitted model
+with the original label values restored.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from ..core import (ComplexParam, DataFrame, Estimator, Model, Param,
+                    StageParam, TypeConverters as TC)
+from ..core.contracts import HasLabelCol
+from ..featurize import Featurize
+from ..featurize.value_indexer import ValueIndexer, ValueIndexerModel
+
+
+class _AutoTrainer(Estimator, HasLabelCol):
+    """Template (reference ``train/AutoTrainer.scala``): featurize →
+    delegate fit."""
+
+    model = StageParam("model", "inner estimator to train")
+    featuresCol = Param("featuresCol", "assembled features column name",
+                        TC.toString, default="TrainedFeatures")
+    numFeatures = Param("numFeatures",
+                        "hash space for high-cardinality categoricals "
+                        "(0 = featurizer default)", TC.toInt, default=0)
+
+    def _feature_cols(self, df) -> list[str]:
+        return [c for c in df.columns if c != self.getLabelCol()]
+
+    def _featurizer(self, df):
+        kw = {}
+        if self.get("numFeatures"):
+            kw["numFeatures"] = self.get("numFeatures")
+        return Featurize(inputCols=self._feature_cols(df),
+                         outputCol=self.get("featuresCol"), **kw)
+
+
+class TrainClassifier(_AutoTrainer):
+    """Reference ``train/TrainClassifier.scala``: label indexing (handles
+    string/arbitrary labels) + featurization + inner classifier."""
+
+    reindexLabel = Param("reindexLabel", "index the label column",
+                         TC.toBoolean, default=True)
+
+    def _fit(self, df):
+        label = self.getLabelCol()
+        indexer_model = None
+        work = df
+        if self.get("reindexLabel"):
+            indexer_model = ValueIndexer(
+                inputCol=label, outputCol=label).fit(df)
+            work = indexer_model.transform(df)
+
+        feat_model = self._featurizer(df).fit(work)
+        feats = feat_model.transform(work)
+
+        inner = self.get("model")
+        inner = inner.copy() if hasattr(inner, "copy") else inner
+        if inner.has_param("featuresCol"):
+            inner.set("featuresCol", self.get("featuresCol"))
+        if inner.has_param("labelCol"):
+            inner.set("labelCol", label)
+        fitted = inner.fit(feats)
+
+        model = TrainedClassifierModel(
+            featurizeModel=feat_model, innerModel=fitted,
+            labelIndexerModel=indexer_model)
+        self._copy_params_to(model)
+        return model
+
+
+class TrainedClassifierModel(Model, HasLabelCol):
+    featurizeModel = StageParam("featurizeModel", "fitted featurizer")
+    innerModel = StageParam("innerModel", "fitted inner model")
+    labelIndexerModel = ComplexParam("labelIndexerModel",
+                                     "fitted label indexer (or None)",
+                                     default=None, has_default=True)
+    featuresCol = Param("featuresCol", "assembled features column name",
+                        TC.toString, default="TrainedFeatures")
+
+    def _transform(self, df):
+        work = df
+        idx: ValueIndexerModel | None = self.get("labelIndexerModel")
+        label = self.getLabelCol()
+        if idx is not None and label in df.columns:
+            work = idx.transform(df)
+        feats = self.get("featurizeModel").transform(work)
+        scored = self.get("innerModel").transform(feats)
+        scored = scored.drop(self.get("featuresCol"))
+        if idx is not None:
+            levels = np.asarray(idx.getLevels())
+            # map indexed prediction (and label) back to original values
+            pred = scored["prediction"].astype(int)
+            scored = scored.with_column("scored_labels", levels[pred])
+            if label in df.columns:
+                scored = scored.with_column(label, df[label])
+        else:
+            scored = scored.with_column("scored_labels",
+                                        scored["prediction"])
+        return scored
+
+
+class TrainRegressor(_AutoTrainer):
+    """Reference ``train/TrainRegressor.scala``."""
+
+    def _fit(self, df):
+        feat_model = self._featurizer(df).fit(df)
+        feats = feat_model.transform(df)
+        inner = self.get("model")
+        inner = inner.copy() if hasattr(inner, "copy") else inner
+        if inner.has_param("featuresCol"):
+            inner.set("featuresCol", self.get("featuresCol"))
+        if inner.has_param("labelCol"):
+            inner.set("labelCol", self.getLabelCol())
+        fitted = inner.fit(feats)
+        model = TrainedRegressorModel(featurizeModel=feat_model,
+                                      innerModel=fitted)
+        self._copy_params_to(model)
+        return model
+
+
+class TrainedRegressorModel(Model, HasLabelCol):
+    featurizeModel = StageParam("featurizeModel", "fitted featurizer")
+    innerModel = StageParam("innerModel", "fitted inner model")
+    featuresCol = Param("featuresCol", "assembled features column name",
+                        TC.toString, default="TrainedFeatures")
+
+    def _transform(self, df):
+        feats = self.get("featurizeModel").transform(df)
+        scored = self.get("innerModel").transform(feats)
+        return scored.drop(self.get("featuresCol")) \
+            .with_column("scores", scored["prediction"])

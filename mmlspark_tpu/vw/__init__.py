@@ -1,0 +1,24 @@
+"""Vowpal-Wabbit-equivalent sparse online learning.
+
+Reference package ``vw/`` (SURVEY §2.4): JNI bindings over native VW
+(``vw-jni 8.9.1``) — hashing featurizer, online SGD learners, contextual
+bandit, spanning-tree AllReduce. TPU-native rebuild: the murmur hashing is
+ported exactly (the reference itself reimplements VW's hash in Scala for
+speed — ``VowpalWabbitMurmurWithPrefix.scala``); learning is minibatched
+scatter-add SGD in XLA; the spanning-tree AllReduce becomes weight-averaging
+``pmean`` over the mesh (``VowpalWabbitBase.scala:434-461``).
+"""
+
+from .murmur import murmur3_32, vw_hash, vw_feature_hash
+from .featurizer import VowpalWabbitFeaturizer
+from .interactions import VowpalWabbitInteractions
+from .estimators import (VowpalWabbitClassifier, VowpalWabbitClassificationModel,
+                         VowpalWabbitRegressor, VowpalWabbitRegressionModel)
+from .contextual_bandit import (VowpalWabbitContextualBandit,
+                                ContextualBanditMetrics)
+
+__all__ = ["murmur3_32", "vw_hash", "vw_feature_hash",
+           "VowpalWabbitFeaturizer", "VowpalWabbitInteractions",
+           "VowpalWabbitClassifier", "VowpalWabbitClassificationModel",
+           "VowpalWabbitRegressor", "VowpalWabbitRegressionModel",
+           "VowpalWabbitContextualBandit", "ContextualBanditMetrics"]

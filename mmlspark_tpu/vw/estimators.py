@@ -1,0 +1,196 @@
+"""VowpalWabbitClassifier / VowpalWabbitRegressor pipeline stages.
+
+Reference ``vw/VowpalWabbitBase.scala`` (param surface + training loops) and
+``vw/VowpalWabbitClassifier.scala`` / ``VowpalWabbitRegressor.scala``.
+The ``args`` passthrough (``VowpalWabbitBase.scala:81-86``) is parsed for
+the common VW flags so existing VW command lines keep working.
+"""
+
+from __future__ import annotations
+
+import re
+
+import numpy as np
+
+from ..core import ComplexParam, Estimator, Model, Param, \
+    TypeConverters as TC
+from ..core.contracts import (HasFeaturesCol, HasLabelCol, HasWeightCol,
+                              HasProbabilityCol, HasRawPredictionCol)
+from .learner import VWConfig, VWModelState, train
+
+
+class VowpalWabbitBaseParams(HasFeaturesCol, HasLabelCol, HasWeightCol):
+    numBits = Param("numBits", "log2 feature space", TC.toInt, default=18)
+    numPasses = Param("numPasses", "passes over the data", TC.toInt,
+                      default=1)
+    learningRate = Param("learningRate", "base learning rate", TC.toFloat,
+                         default=0.5)
+    powerT = Param("powerT", "lr decay exponent", TC.toFloat, default=0.5)
+    l1 = Param("l1", "L1 regularization", TC.toFloat, default=0.0)
+    l2 = Param("l2", "L2 regularization", TC.toFloat, default=0.0)
+    hashSeed = Param("hashSeed", "hash seed", TC.toInt, default=0)
+    adaptive = Param("adaptive", "AdaGrad-style per-weight rates (VW "
+                     "--adaptive default)", TC.toBoolean, default=True)
+    batchSize = Param("batchSize", "minibatch size (1 = exact online "
+                      "updates)", TC.toInt, default=256)
+    args = Param("args", "VW-style argument passthrough", TC.toString,
+                 default="")
+    initialModel = ComplexParam("initialModel", "warm-start model state",
+                                default=None, has_default=True)
+    numShards = Param("numShards", "device shards (0 = auto)", TC.toInt,
+                      default=0)
+    useBarrierExecutionMode = Param("useBarrierExecutionMode",
+                                    "inert; SPMD is inherently barriered",
+                                    TC.toBoolean, default=False)
+
+    _ARG_MAP = {
+        "-l": ("learning_rate", float), "--learning_rate": (
+            "learning_rate", float),
+        "--l1": ("l1", float), "--l2": ("l2", float),
+        "-b": ("num_bits", int), "--bit_precision": ("num_bits", int),
+        "--power_t": ("power_t", float),
+        "--passes": ("num_passes", int),
+        "--loss_function": ("loss_function", str),
+        "--quantile_tau": ("quantile_tau", float),
+        "--link": ("link", str),
+    }
+
+    def _parse_args(self) -> dict:
+        """Parse the VW arg string (reference users pass raw VW command
+        lines; ``VowpalWabbitBase.scala:81-86`` forwards them verbatim)."""
+        out: dict = {}
+        toks = self.get("args").split()
+        flags = set()
+        i = 0
+        while i < len(toks):
+            tok = toks[i]
+            if tok in self._ARG_MAP and i + 1 < len(toks):
+                name, conv = self._ARG_MAP[tok]
+                out[name] = conv(toks[i + 1])
+                i += 2
+            elif tok in ("--adaptive", "--normalized", "--invariant",
+                         "--holdout_off", "--quiet"):
+                flags.add(tok)
+                i += 1
+            else:
+                i += 1  # unknown args ignored, like VW's permissive CLI
+        if "--adaptive" in flags:
+            out["adaptive"] = True
+        return out
+
+    def _config(self, loss_default: str) -> VWConfig:
+        cfg = VWConfig(
+            num_bits=self.get("numBits"),
+            loss_function=loss_default,
+            learning_rate=self.get("learningRate"),
+            power_t=self.get("powerT"),
+            l1=self.get("l1"), l2=self.get("l2"),
+            num_passes=self.get("numPasses"),
+            adaptive=self.get("adaptive"),
+            batch_size=self.get("batchSize"))
+        for k, v in self._parse_args().items():
+            setattr(cfg, k, v)
+        return cfg
+
+    def _features(self, df):
+        base = self.getFeaturesCol()
+        icol, vcol = f"{base}_indices", f"{base}_values"
+        if icol in df.columns:
+            return np.asarray(df[icol], np.int32), \
+                np.asarray(df[vcol], np.float32)
+        # dense fallback: feature j is index j (no hashing)
+        dense = np.asarray(df[base], np.float32)
+        n, f = dense.shape
+        idx = np.broadcast_to(np.arange(f, dtype=np.int32), (n, f))
+        return np.ascontiguousarray(idx), dense
+
+    def _mesh(self, n_rows: int):
+        import jax
+        from jax.sharding import Mesh
+        ns = self.get("numShards")
+        devices = jax.devices()
+        if ns == 0:
+            ns = len(devices) if n_rows >= 4096 and len(devices) > 1 else 1
+        ns = min(ns, len(devices))
+        if ns <= 1:
+            return None
+        return Mesh(np.asarray(devices[:ns]), ("dp",))
+
+
+class _VWBaseEstimator(Estimator, VowpalWabbitBaseParams):
+    _loss_default = "squared"
+
+    def _prepare_labels(self, y: np.ndarray) -> np.ndarray:
+        return y
+
+    def _fit(self, df):
+        idx, val = self._features(df)
+        y = self._prepare_labels(
+            np.asarray(df[self.getLabelCol()], np.float32))
+        w = (np.asarray(df[self.getWeightCol()], np.float32)
+             if self.isSet("weightCol") else None)
+        cfg = self._config(self._loss_default)
+        state = train(idx, val, y, w, cfg,
+                      initial=self.get("initialModel"),
+                      mesh=self._mesh(idx.shape[0]))
+        model = self._make_model(state)
+        self._copy_params_to(model)
+        return model
+
+
+class VowpalWabbitRegressionModel(Model, VowpalWabbitBaseParams):
+    predictionCol = Param("predictionCol", "output column", TC.toString,
+                          default="prediction")
+    state = ComplexParam("state", "trained VWModelState")
+
+    def _transform(self, df):
+        idx, val = self._features(df)
+        st: VWModelState = self.get("state")
+        raw = st.predict_raw(idx, val)
+        if st.config.link == "logistic":
+            raw = 1.0 / (1.0 + np.exp(-raw))
+        return df.with_column(self.get("predictionCol"),
+                              raw.astype(np.float32))
+
+
+class VowpalWabbitRegressor(_VWBaseEstimator):
+    _loss_default = "squared"
+
+    def _make_model(self, state):
+        return VowpalWabbitRegressionModel(state=state)
+
+
+class VowpalWabbitClassificationModel(Model, VowpalWabbitBaseParams,
+                                      HasRawPredictionCol,
+                                      HasProbabilityCol):
+    predictionCol = Param("predictionCol", "output column", TC.toString,
+                          default="prediction")
+    thresholds = Param("thresholds", "decision threshold on probability",
+                       TC.toFloat, default=0.5)
+    state = ComplexParam("state", "trained VWModelState")
+
+    def _transform(self, df):
+        idx, val = self._features(df)
+        st: VWModelState = self.get("state")
+        raw = st.predict_raw(idx, val)
+        prob1 = 1.0 / (1.0 + np.exp(-raw))
+        probs = np.stack([1.0 - prob1, prob1], axis=1).astype(np.float32)
+        pred = (prob1 >= self.get("thresholds")).astype(np.float32)
+        return (df.with_column(self.getRawPredictionCol(),
+                               np.stack([-raw, raw], axis=1)
+                               .astype(np.float32))
+                  .with_column(self.getProbabilityCol(), probs)
+                  .with_column(self.get("predictionCol"), pred))
+
+
+class VowpalWabbitClassifier(_VWBaseEstimator):
+    """Binary classifier; labels {0,1} are mapped to VW's {-1,+1}
+    (reference ``VowpalWabbitClassifier.scala`` trains with
+    ``--loss_function logistic``)."""
+    _loss_default = "logistic"
+
+    def _prepare_labels(self, y: np.ndarray) -> np.ndarray:
+        return np.where(y > 0, 1.0, -1.0).astype(np.float32)
+
+    def _make_model(self, state):
+        return VowpalWabbitClassificationModel(state=state)

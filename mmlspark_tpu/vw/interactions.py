@@ -1,0 +1,65 @@
+"""VowpalWabbitInteractions — quadratic/cubic feature crossing.
+
+Reference ``vw/VowpalWabbitInteractions.scala`` (à la VW ``-q``/``--cubic``):
+cross the features of two (or more) hashed namespaces into new hashed
+features, weight = product of constituent weights.
+"""
+
+from __future__ import annotations
+
+import itertools
+
+import numpy as np
+
+from ..core import Transformer, Param, TypeConverters as TC
+from ..core.contracts import HasInputCols, HasOutputCol
+from .murmur import quadratic_hash
+
+
+class VowpalWabbitInteractions(Transformer, HasInputCols, HasOutputCol):
+    """Inputs are padded-COO column pairs (``<col>_indices``/``_values``)
+    produced by VowpalWabbitFeaturizer; output is the crossed sparse
+    columns under ``<outputCol>_indices``/``_values``."""
+
+    numBits = Param("numBits", "log2 feature space", TC.toInt, default=18)
+
+    def __init__(self, **kwargs):
+        super().__init__(**kwargs)
+        self._setDefault(outputCol="interactions")
+
+    def _transform(self, df):
+        cols = self.getInputCols()
+        num_bits = self.get("numBits")
+        n = len(df)
+        per_col = [(np.asarray(df[f"{c}_indices"]),
+                    np.asarray(df[f"{c}_values"], np.float32))
+                   for c in cols]
+
+        all_i, all_v = [], []
+        for r in range(n):
+            row_feats = []
+            for idx, val in per_col:
+                keep = idx[r] >= 0
+                row_feats.append(list(zip(idx[r][keep].tolist(),
+                                          val[r][keep].tolist())))
+            ri, rv = [], []
+            for combo in itertools.product(*row_feats):
+                h = combo[0][0]
+                v = combo[0][1]
+                for fi, fv in combo[1:]:
+                    h = quadratic_hash(h, fi, num_bits)
+                    v *= fv
+                ri.append(h)
+                rv.append(v)
+            all_i.append(ri)
+            all_v.append(rv)
+
+        width = max((len(r) for r in all_i), default=1) or 1
+        indices = np.full((n, width), -1, np.int32)
+        values = np.zeros((n, width), np.float32)
+        for r, (ri, rv) in enumerate(zip(all_i, all_v)):
+            indices[r, :len(ri)] = ri
+            values[r, :len(rv)] = rv
+        out = self.getOutputCol()
+        return (df.with_column(f"{out}_indices", indices)
+                  .with_column(f"{out}_values", values))

@@ -1,0 +1,85 @@
+"""VW-compatible MurmurHash3 (x86_32).
+
+The reference reimplements VW's hashing natively in Scala so the featurizer
+can run without JNI (``vw/VowpalWabbitMurmurWithPrefix.scala``,
+``org.vowpalwabbit.spark.VowpalWabbitMurmur``); we do the same in Python.
+VW semantics: feature strings hash with the namespace hash as seed; pure
+integer feature names hash as ``int + seed`` (VW's ``hashstring`` treats
+all-digit strings numerically when ``--hash strings`` is not set — the
+reference's StringFeaturizer always string-hashes, which we follow).
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+_M32 = 0xFFFFFFFF
+
+
+def _rotl32(x: int, r: int) -> int:
+    return ((x << r) | (x >> (32 - r))) & _M32
+
+
+def murmur3_32(data: bytes, seed: int = 0) -> int:
+    """MurmurHash3 x86_32 over bytes → uint32."""
+    c1, c2 = 0xCC9E2D51, 0x1B873593
+    h = seed & _M32
+    n = len(data)
+    nblocks = n // 4
+    for i in range(nblocks):
+        k = int.from_bytes(data[4 * i:4 * i + 4], "little")
+        k = (k * c1) & _M32
+        k = _rotl32(k, 15)
+        k = (k * c2) & _M32
+        h ^= k
+        h = _rotl32(h, 13)
+        h = (h * 5 + 0xE6546B64) & _M32
+    tail = data[nblocks * 4:]
+    k = 0
+    if len(tail) >= 3:
+        k ^= tail[2] << 16
+    if len(tail) >= 2:
+        k ^= tail[1] << 8
+    if len(tail) >= 1:
+        k ^= tail[0]
+        k = (k * c1) & _M32
+        k = _rotl32(k, 15)
+        k = (k * c2) & _M32
+        h ^= k
+    h ^= n
+    h ^= h >> 16
+    h = (h * 0x85EBCA6B) & _M32
+    h ^= h >> 13
+    h = (h * 0xC2B2AE35) & _M32
+    h ^= h >> 16
+    return h
+
+
+def vw_hash(s: str, seed: int = 0) -> int:
+    """VW ``hashstring``: all-digit strings hash numerically
+    (``value + seed``), others murmur (VW src/hash.h semantics, which the
+    reference's JNI VowpalWabbitMurmur.hash mirrors)."""
+    stripped = s.strip()
+    if stripped and all(c.isdigit() for c in stripped):
+        return (int(stripped) + seed) & _M32
+    return murmur3_32(s.encode("utf-8"), seed)
+
+
+def vw_feature_hash(name: str, namespace_hash: int, num_bits: int) -> int:
+    """Feature index = mask & murmur(name, namespaceHash) — the reference's
+    per-featurizer pattern (``vw/featurizer/StringFeaturizer.scala``)."""
+    mask = (1 << num_bits) - 1
+    return mask & murmur3_32(name.encode("utf-8"), namespace_hash)
+
+
+def namespace_hash(namespace: str, hash_seed: int = 0) -> int:
+    """VW hashes the namespace string with the global seed
+    (``VowpalWabbitBase`` hashSeed param)."""
+    return vw_hash(namespace, hash_seed) if namespace else hash_seed
+
+
+def quadratic_hash(idx_a: int, idx_b: int, num_bits: int) -> int:
+    """VW's feature-interaction hash: h(a) * magic ^ h(b), masked
+    (VW ``interactions.cc`` FNV-style combine, constant 0x5bd1e995)."""
+    mask = (1 << num_bits) - 1
+    return mask & (((idx_a * 0x5BD1E995) & _M32) ^ idx_b)

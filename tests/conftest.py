@@ -8,7 +8,10 @@ path runs the real SPMD code.
 
 import os
 
-os.environ.setdefault("JAX_PLATFORMS", "cpu")
+# Force CPU: the session environment pins JAX_PLATFORMS to the real TPU
+# tunnel (axon), which tests must not touch — a plain setdefault would keep
+# it and hang every test on remote compilation.
+os.environ["JAX_PLATFORMS"] = "cpu"
 flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in flags:
     os.environ["XLA_FLAGS"] = (

@@ -1,0 +1,172 @@
+"""Learners long tail: Train*, ComputeModelStatistics, AutoML, KNN,
+IsolationForest."""
+
+import numpy as np
+import pytest
+
+from mmlspark_tpu.core import DataFrame
+from mmlspark_tpu.automl import (DiscreteHyperParam, DoubleRangeHyperParam,
+                                 FindBestModel, GridSpace, HyperparamBuilder,
+                                 IntRangeHyperParam, RandomSpace,
+                                 TuneHyperparameters)
+from mmlspark_tpu.isolationforest import IsolationForest
+from mmlspark_tpu.lightgbm import LightGBMClassifier, LightGBMRegressor
+from mmlspark_tpu.nn import KNN, ConditionalKNN
+from mmlspark_tpu.train import (ComputeModelStatistics,
+                                ComputePerInstanceStatistics,
+                                TrainClassifier, TrainRegressor)
+
+
+def class_df(n=400, seed=0):
+    rng = np.random.default_rng(seed)
+    age = rng.uniform(0, 1, n).astype(np.float32)
+    city = np.asarray(rng.choice(["a", "b", "c"], n), object)
+    y_num = ((age > 0.5) | (city == "a")).astype(int)
+    label = np.asarray(np.where(y_num == 1, "yes", "no"), object)
+    return DataFrame({"age": age, "city": city, "label": label}), y_num
+
+
+class TestTrainClassifier:
+    def test_string_labels_auto_featurize(self):
+        df, y_num = class_df()
+        tc = TrainClassifier(model=LightGBMClassifier(numIterations=20),
+                             labelCol="label")
+        model = tc.fit(df)
+        out = model.transform(df)
+        assert set(np.unique(out["scored_labels"].tolist())) <= \
+            {"yes", "no"}
+        acc = (out["scored_labels"] == df["label"]).mean()
+        assert acc > 0.95
+        # original label column restored to raw values
+        assert out["label"][0] in ("yes", "no")
+
+    def test_train_regressor(self):
+        rng = np.random.default_rng(1)
+        x1 = rng.normal(size=500).astype(np.float32)
+        cat = np.asarray(rng.choice(["u", "v"], 500), object)
+        y = x1 * 2 + np.where(cat == "u", 1.0, -1.0)
+        df = DataFrame({"x1": x1, "cat": cat, "label": y})
+        tr = TrainRegressor(model=LightGBMRegressor(numIterations=30),
+                            labelCol="label")
+        out = tr.fit(df).transform(df)
+        rmse = float(np.sqrt(np.mean((out["scores"] - y) ** 2)))
+        assert rmse < 0.5
+
+
+class TestStatistics:
+    def test_classification_metrics(self):
+        y = np.asarray([0, 0, 1, 1, 1.0])
+        pred = np.asarray([0, 1, 1, 1, 0.0])
+        prob = np.stack([1 - np.asarray([.2, .7, .8, .9, .4]),
+                         np.asarray([.2, .7, .8, .9, .4])], axis=1)
+        df = DataFrame({"label": y, "prediction": pred,
+                        "probability": prob})
+        m = ComputeModelStatistics(labelCol="label").transform(df)
+        assert m["accuracy"][0] == pytest.approx(0.6)
+        assert 0 <= m["AUC"][0] <= 1
+
+    def test_regression_metrics(self):
+        y = np.asarray([1.0, 2.0, 3.0])
+        df = DataFrame({"label": y, "prediction": y + 0.1})
+        m = ComputeModelStatistics(
+            labelCol="label", evaluationMetric="regression").transform(df)
+        assert m["rmse"][0] == pytest.approx(0.1, abs=1e-6)
+        assert m["r^2"][0] > 0.97
+
+    def test_per_instance(self):
+        df = DataFrame({"label": np.asarray([0.0, 1.0]),
+                        "prediction": np.asarray([0.0, 1.0]),
+                        "probability": np.asarray([[0.9, 0.1], [0.2, 0.8]])})
+        out = ComputePerInstanceStatistics(labelCol="label").transform(df)
+        np.testing.assert_allclose(out["log_loss"],
+                                   [-np.log(0.9), -np.log(0.8)], rtol=1e-6)
+
+
+class TestAutoML:
+    def test_hyperparam_spaces(self):
+        b = (HyperparamBuilder()
+             .addHyperparam(None, "numLeaves", DiscreteHyperParam([7, 15]))
+             .addHyperparam(None, "learningRate",
+                            DoubleRangeHyperParam(0.05, 0.2)))
+        grid = list(GridSpace(b.build()).param_maps())
+        assert len(grid) == 2 * 5
+        rand = list(RandomSpace(b.build(), seed=1).param_maps(4))
+        assert len(rand) == 4
+        assert all(0.05 <= pm[1][2] <= 0.2 for pm in rand)
+        assert IntRangeHyperParam(2, 9).sample() in range(2, 9)
+
+    def test_tune_hyperparameters(self):
+        from mmlspark_tpu.featurize import Featurize
+        df, y = class_df(n=300)
+        # numeric label for the inner estimator
+        df = df.with_column("label", y.astype(np.float32))
+        df = Featurize(inputCols=["age", "city"]).fit(df).transform(df)
+        est = LightGBMClassifier(numIterations=10)
+        space = (HyperparamBuilder()
+                 .addHyperparam(est, "numLeaves",
+                                DiscreteHyperParam([4, 15]))).build()
+        tuned = TuneHyperparameters(
+            models=[est], paramSpace=space, numFolds=2, numRuns=2,
+            evaluationMetric="accuracy", labelCol="label").fit(df)
+        assert tuned.get("bestMetric") > 0.8
+        out = tuned.transform(df)
+        assert "prediction" in out.columns
+
+    def test_find_best_model(self):
+        df, y = class_df(n=300, seed=2)
+        df = df.with_column("label", y.astype(np.float32))
+        from mmlspark_tpu.featurize import Featurize
+        fm = Featurize(inputCols=["age", "city"]).fit(df)
+        feats = fm.transform(df)
+        m_good = LightGBMClassifier(numIterations=25).fit(feats)
+        m_bad = LightGBMClassifier(numIterations=1, numLeaves=2).fit(feats)
+        best = FindBestModel(models=[m_bad, m_good],
+                             labelCol="label").fit(feats)
+        assert best.get("bestModel") is m_good
+
+
+class TestKNN:
+    def test_topk_exact(self):
+        rng = np.random.default_rng(0)
+        index = rng.normal(size=(50, 8)).astype(np.float32)
+        vals = np.asarray([f"id{i}" for i in range(50)], object)
+        fit_df = DataFrame({"features": index, "values": vals})
+        q = index[:5] * 0.99  # nearest (by inner product) = themselves
+        out = (KNN(k=3).fit(fit_df)
+               .transform(DataFrame({"features": q})))["output"]
+        for r, matches in enumerate(out):
+            assert matches[0]["index"] == r or \
+                matches[0]["distance"] >= matches[1]["distance"]
+            assert len(matches) == 3
+            assert matches[0]["value"].startswith("id")
+
+    def test_conditional_knn_filters_labels(self):
+        rng = np.random.default_rng(1)
+        index = rng.normal(size=(40, 4)).astype(np.float32)
+        labels = np.asarray(["x"] * 20 + ["y"] * 20, object)
+        fit_df = DataFrame({"features": index, "labels": labels,
+                            "values": np.arange(40)})
+        q_df = DataFrame({
+            "features": index[:3],
+            "conditioner": np.asarray([["y"], ["y"], ["x", "y"]], object)})
+        out = (ConditionalKNN(k=5).fit(fit_df).transform(q_df))["output"]
+        assert all(m["label"] == "y" for m in out[0])
+        assert all(m["label"] == "y" for m in out[1])
+        assert {m["label"] for m in out[2]} <= {"x", "y"}
+
+
+class TestIsolationForest:
+    def test_outliers_scored_higher(self):
+        rng = np.random.default_rng(0)
+        normal = rng.normal(size=(300, 4)).astype(np.float32)
+        outliers = rng.normal(loc=6.0, size=(10, 4)).astype(np.float32)
+        x = np.concatenate([normal, outliers])
+        df = DataFrame({"features": x})
+        model = IsolationForest(numEstimators=50, contamination=0.05).fit(df)
+        out = model.transform(df)
+        scores = out["outlierScore"]
+        assert scores[300:].mean() > scores[:300].mean() + 0.1
+        # most flagged rows are true outliers
+        flagged = np.where(out["predictedLabel"] == 1.0)[0]
+        assert len(flagged) > 0
+        assert (flagged >= 300).mean() > 0.5

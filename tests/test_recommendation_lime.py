@@ -1,0 +1,173 @@
+"""SAR recommender, ranking evaluation, LIME explainers."""
+
+import numpy as np
+import pytest
+
+from mmlspark_tpu.core import DataFrame, Transformer
+from mmlspark_tpu.recommendation import (RankingEvaluator, RankingAdapter,
+                                         RankingTrainValidationSplit,
+                                         RecommendationIndexer, SAR)
+from mmlspark_tpu.recommendation.evaluator import ndcg_at_k, recall_at_k
+from mmlspark_tpu.lime import (ImageLIME, Superpixel, SuperpixelTransformer,
+                               TabularLIME, TextLIME)
+
+
+def interactions(n_users=30, seed=0):
+    """Two blocks: users < half like items 0-4, rest like items 5-9."""
+    rng = np.random.default_rng(seed)
+    users, items = [], []
+    for u in range(n_users):
+        block = 0 if u < n_users // 2 else 5
+        liked = rng.choice(5, size=3, replace=False) + block
+        users += [u] * 3
+        items += liked.tolist()
+    return DataFrame({"user": np.asarray(users),
+                      "item": np.asarray(items),
+                      "rating": np.ones(len(users), np.float32)})
+
+
+class TestSAR:
+    def test_block_structure_recovered(self):
+        df = interactions()
+        model = SAR(supportThreshold=1).fit(df)
+        # each user rated 3 of their block's 5 items → 2 unseen in-block
+        recs = model.recommend_for_all_users(2)
+        # user 0 (block A) gets block-A items; user 29 block-B items
+        assert all(i < 5 for i in recs["recommendations"][0])
+        assert all(i >= 5 for i in recs["recommendations"][29])
+
+    def test_similarity_functions(self):
+        df = interactions()
+        for sim in ("jaccard", "lift", "cooccurrence"):
+            m = SAR(similarityFunction=sim, supportThreshold=1).fit(df)
+            s = m.get("itemSimilarity")
+            assert s.shape == (10, 10) and np.isfinite(s).all()
+
+    def test_transform_scores_pairs(self):
+        df = interactions()
+        model = SAR(supportThreshold=1).fit(df)
+        pairs = DataFrame({"user": np.asarray([0, 0]),
+                           "item": np.asarray([1, 7])})
+        out = model.transform(pairs)["prediction"]
+        assert out[0] > out[1]  # in-block > out-of-block
+
+    def test_time_decay(self):
+        n = 10
+        df = DataFrame({
+            "user": np.zeros(n, np.int64),
+            "item": np.arange(n),
+            "rating": np.ones(n, np.float32),
+            "ts": np.linspace(0, 100 * 86400, n)})
+        m = SAR(timeCol="ts", timeDecayCoeff=30, supportThreshold=1).fit(df)
+        aff = m.get("userAffinity")[0]
+        assert aff[n - 1] > aff[0]  # recent events weigh more
+
+
+class TestRankingEval:
+    def test_ndcg_recall(self):
+        assert ndcg_at_k([1, 2, 3], {1, 2, 3}, 3) == pytest.approx(1.0)
+        assert ndcg_at_k([9, 9, 1], {1}, 3) < 1.0
+        assert recall_at_k([1, 2], {1, 2, 3, 4}, 2) == 0.5
+
+    def test_adapter_and_evaluator(self):
+        df = interactions()
+        model = SAR(supportThreshold=1).fit(df)
+        joined = RankingAdapter(k=5, recommender=model).transform(df)
+        # evaluating against the TRAIN interactions with seen items removed
+        # gives low overlap; against unseen-block items it's high — here we
+        # just check the pipeline shape and range
+        score = RankingEvaluator(k=5, metric_name="recallAtK") \
+            .evaluate(joined)
+        assert 0.0 <= score <= 1.0
+
+    def test_train_validation_split(self):
+        df = interactions(n_users=40)
+        tvs = RankingTrainValidationSplit(
+            estimator=SAR(supportThreshold=1),
+            paramMaps=[{"similarityFunction": "jaccard"},
+                       {"similarityFunction": "lift"}],
+            trainRatio=0.67, k=5, metricName="recallAtK")
+        model = tvs.fit(df)
+        assert len(model.get("validationMetrics")) == 2
+        assert max(model.get("validationMetrics")) > 0.0
+
+    def test_indexer_roundtrip(self):
+        df = DataFrame({"u": np.asarray(["alice", "bob", "alice"], object),
+                        "i": np.asarray(["x", "y", "y"], object)})
+        m = RecommendationIndexer(userInputCol="u",
+                                  itemInputCol="i").fit(df)
+        out = m.transform(df)
+        assert out["user"].tolist() == [0, 1, 0]
+        assert m.recover_item(np.asarray([0, 1])).tolist() == ["x", "y"]
+
+
+class _LinearModel(Transformer):
+    """Deterministic model: prediction = x @ w (for LIME ground truth)."""
+
+    def __init__(self, w, input_col="features"):
+        super().__init__()
+        self.w = w
+        self.input_col = input_col
+
+    def _transform(self, df):
+        x = np.asarray(df[self.input_col], np.float64)
+        x = x.reshape(len(x), -1)
+        return df.with_column("prediction", x @ self.w)
+
+
+class TestLIME:
+    def test_tabular_recovers_linear_weights(self):
+        rng = np.random.default_rng(0)
+        w = np.asarray([3.0, -2.0, 0.0, 0.0])
+        x = rng.normal(size=(5, 4)).astype(np.float32)
+        df = DataFrame({"features": x})
+        lime = TabularLIME(model=_LinearModel(w), nSamples=400, seed=1)
+        out = lime.transform(df)["weights"]
+        # LIME's mask coefficients are per-instance attributions: switching
+        # feature j on moves the prediction by w_j · (x_j - mean_j)
+        mean = x.mean(axis=0)
+        for r in range(5):
+            expected = w * (x[r] - mean)
+            np.testing.assert_allclose(out[r], expected, atol=0.05)
+
+    def test_superpixels_partition_image(self):
+        img = np.zeros((32, 32, 3), np.float32)
+        labels = Superpixel.cluster(img, cell_size=8)
+        assert labels.shape == (32, 32)
+        assert labels.max() < 16 and labels.min() >= 0
+        t = SuperpixelTransformer(cellSize=8.0)
+        df = DataFrame({"image": np.zeros((2, 16, 16, 3), np.float32)})
+        out = t.transform(df)["superpixels"]
+        assert out[0].shape == (16, 16)
+
+    def test_image_lime_finds_bright_region(self):
+        # model output = mean of top-left quadrant brightness
+        class _Quad(Transformer):
+            def _transform(self, df):
+                x = np.asarray(df["image"], np.float64)
+                return df.with_column(
+                    "prediction", x[:, :8, :8].mean(axis=(1, 2, 3)))
+
+        img = np.zeros((1, 16, 16, 3), np.float32)
+        img[0, :8, :8] = 1.0
+        df = DataFrame({"image": img})
+        lime = ImageLIME(model=_Quad(), nSamples=200, cellSize=8.0,
+                         seed=2)
+        out = lime.transform(df)
+        weights, spx = out["weights"][0], out["superpixels"][0]
+        tl_label = spx[2, 2]
+        br_label = spx[12, 12]
+        assert weights[tl_label] > weights[br_label] + 0.05
+
+    def test_text_lime(self):
+        class _HasWord(Transformer):
+            def _transform(self, df):
+                vals = np.asarray(
+                    [1.0 if "good" in t else 0.0 for t in df["text"]])
+                return df.with_column("prediction", vals)
+
+        df = DataFrame({"text": np.asarray(["a good movie"], object)})
+        out = TextLIME(model=_HasWord(), nSamples=100, seed=3).transform(df)
+        toks, w = out["tokens"][0], out["weights"][0]
+        assert toks == ["a", "good", "movie"]
+        assert w[1] > w[0] and w[1] > w[2]

@@ -1,0 +1,188 @@
+"""VW-equivalent: murmur hashing, featurizer, learners, interactions,
+contextual bandit, distributed weight averaging."""
+
+import numpy as np
+import pytest
+
+from mmlspark_tpu.core import DataFrame
+from mmlspark_tpu.vw import (VowpalWabbitClassifier, VowpalWabbitFeaturizer,
+                             VowpalWabbitInteractions, VowpalWabbitRegressor,
+                             ContextualBanditMetrics,
+                             VowpalWabbitContextualBandit, murmur3_32,
+                             vw_hash)
+from mmlspark_tpu.vw.learner import VWConfig, train
+from mmlspark_tpu.lightgbm.trainer import roc_auc
+
+
+class TestMurmur:
+    """Canonical MurmurHash3 x86_32 vectors — VW/the reference's JNI
+    VowpalWabbitMurmur use exactly this function."""
+
+    @pytest.mark.parametrize("data,seed,expected", [
+        (b"", 0, 0x00000000),
+        (b"", 1, 0x514E28B7),
+        (b"a", 0, 0x3C2569B2),
+        (b"abc", 0, 0xB3DD93FA),
+        (b"hello", 0, 0x248BFA47),
+        (b"Hello, world!", 25, 0x00B46F38),
+        (b"abcdefgh", 0, 0x49DDCCC4),
+    ])
+    def test_vectors(self, data, seed, expected):
+        assert murmur3_32(data, seed) == expected
+
+    def test_numeric_strings_hash_numerically(self):
+        # VW hashstring: all-digit feature names hash as int + seed
+        assert vw_hash("42", 7) == 49
+        assert vw_hash("42x", 0) == murmur3_32(b"42x", 0)
+
+
+def featurize(df, cols, **kw):
+    return VowpalWabbitFeaturizer(inputCols=cols, **kw).transform(df)
+
+
+class TestFeaturizer:
+    def test_numeric_and_string(self):
+        df = DataFrame({"age": np.asarray([25.0, 0.0]),
+                        "city": np.asarray(["NY", "SF"], object)})
+        out = featurize(df, ["age", "city"], numBits=10)
+        idx = out["features_indices"]
+        val = out["features_values"]
+        assert idx.shape == val.shape
+        # row 0: age=25 (weight 25) + city=NY (weight 1)
+        assert set(val[0]) <= {25.0, 1.0, 0.0}
+        assert 25.0 in val[0] and 1.0 in val[0]
+        # row 1: age=0 dropped, only city feature
+        assert (val[1] == 1.0).sum() == 1
+        assert (idx >= -1).all() and (idx < 1024).all()
+
+    def test_same_value_same_index(self):
+        df = DataFrame({"city": np.asarray(["NY", "NY", "LA"], object)})
+        out = featurize(df, ["city"])
+        idx = out["features_indices"]
+        assert idx[0, 0] == idx[1, 0] != idx[2, 0]
+
+    def test_string_split(self):
+        df = DataFrame({"text": np.asarray(["big cat", "cat"], object)})
+        out = VowpalWabbitFeaturizer(
+            inputCols=["text"], stringSplitInputCols=["text"]).transform(df)
+        idx = out["features_indices"]
+        assert (idx[0] >= 0).sum() == 2 and (idx[1] >= 0).sum() == 1
+        # shared token hashes identically
+        assert idx[1, 0] in idx[0]
+
+    def test_vector_column(self):
+        df = DataFrame({"vec": np.asarray([[1.0, 0.0, 3.0]])})
+        out = featurize(df, ["vec"])
+        val = out["features_values"]
+        assert sorted(v for v in val[0] if v != 0) == [1.0, 3.0]
+
+
+class TestLearner:
+    def test_regression_converges(self):
+        rng = np.random.default_rng(0)
+        n, f = 2000, 10
+        dense = rng.normal(size=(n, f)).astype(np.float32)
+        w_true = rng.normal(size=f).astype(np.float32)
+        y = dense @ w_true + 0.3
+        idx = np.broadcast_to(np.arange(f, dtype=np.int32), (n, f)).copy()
+        cfg = VWConfig(num_bits=8, loss_function="squared", num_passes=8,
+                       learning_rate=0.5, batch_size=64)
+        st = train(idx, dense, y, None, cfg)
+        pred = st.predict_raw(idx, dense)
+        rmse = float(np.sqrt(np.mean((pred - y) ** 2)))
+        assert rmse < 0.5, rmse
+
+    def test_distributed_matches_single(self):
+        import jax
+        from jax.sharding import Mesh
+        rng = np.random.default_rng(1)
+        n, f = 1024, 6
+        dense = rng.normal(size=(n, f)).astype(np.float32)
+        y = (dense[:, 0] - dense[:, 1]).astype(np.float32)
+        idx = np.broadcast_to(np.arange(f, dtype=np.int32), (n, f)).copy()
+        cfg = VWConfig(num_bits=6, num_passes=6, batch_size=32)
+        st1 = train(idx, dense, y, None, cfg)
+        mesh = Mesh(np.asarray(jax.devices()), ("dp",))
+        st8 = train(idx, dense, y, None, cfg, mesh=mesh)
+        p1 = st1.predict_raw(idx, dense)
+        p8 = st8.predict_raw(idx, dense)
+        # different update orders → statistically equivalent fits
+        assert np.sqrt(np.mean((p1 - y) ** 2)) < 0.3
+        assert np.sqrt(np.mean((p8 - y) ** 2)) < 0.5
+
+
+class TestEstimators:
+    def test_classifier_pipeline(self):
+        rng = np.random.default_rng(2)
+        n = 1500
+        age = rng.uniform(20, 60, n).astype(np.float32)
+        city = np.asarray(rng.choice(["NY", "SF", "LA"], n), object)
+        logit = (age - 40) / 10 + np.where(city == "NY", 1.0, -0.5)
+        y = (logit + rng.normal(scale=0.4, size=n) > 0).astype(np.float32)
+        df = DataFrame({"age": age, "city": city, "label": y})
+        df = featurize(df, ["age", "city"], numBits=12)
+        model = VowpalWabbitClassifier(numPasses=10, batchSize=64).fit(df)
+        out = model.transform(df)
+        assert roc_auc(y, out["probability"][:, 1]) > 0.85
+        assert set(np.unique(out["prediction"])) <= {0.0, 1.0}
+
+    def test_regressor_args_passthrough(self):
+        rng = np.random.default_rng(3)
+        x = rng.normal(size=(800, 4)).astype(np.float32)
+        y = x[:, 0] * 2.0
+        df = DataFrame({"features": x, "label": y})
+        r = VowpalWabbitRegressor(args="-l 0.8 --passes 6 -b 10",
+                                  batchSize=32)
+        cfg = r._config("squared")
+        assert cfg.learning_rate == 0.8 and cfg.num_passes == 6 \
+            and cfg.num_bits == 10
+        model = r.fit(df)
+        pred = model.transform(df)["prediction"]
+        assert np.sqrt(np.mean((pred - y) ** 2)) < 0.6
+
+
+class TestInteractions:
+    def test_quadratic_cross(self):
+        df = DataFrame({"a": np.asarray(["x", "y"], object),
+                        "b": np.asarray([[2.0, 3.0], [1.0, 1.0]])})
+        df = featurize(df, ["a"], numBits=10)
+        df = (VowpalWabbitFeaturizer(inputCols=["b"], outputCol="bf",
+                                     numBits=10).transform(df))
+        out = VowpalWabbitInteractions(
+            inputCols=["features", "bf"], numBits=10).transform(df)
+        # 1 string feature × 2 vector slots = 2 crossed features
+        assert (out["interactions_indices"][0] >= 0).sum() == 2
+        vals = sorted(v for v in out["interactions_values"][0] if v != 0)
+        assert vals == [2.0, 3.0]
+
+
+class TestContextualBandit:
+    def test_metrics_ips_snips(self):
+        m = ContextualBanditMetrics()
+        m.add_example(0.5, 1.0)
+        m.add_example(0.25, 0.0)
+        assert m.ips == pytest.approx((1.0 / 0.5) / 2)
+        assert m.snips == pytest.approx(2.0 / 6.0)
+
+    def test_cb_learns_action_costs(self):
+        rng = np.random.default_rng(4)
+        n_dec, n_act = 400, 3
+        rows = n_dec * n_act
+        decision = np.repeat(np.arange(n_dec), n_act)
+        action = np.tile(np.arange(1, n_act + 1), n_dec)
+        # action 2 always cheapest
+        true_cost = np.where(action == 2, 0.1, 0.9).astype(np.float32)
+        chosen = np.repeat(rng.integers(1, n_act + 1, n_dec), n_act)
+        cost = true_cost + rng.normal(scale=0.05, size=rows) \
+            .astype(np.float32)
+        prob = np.full(rows, 1.0 / n_act, np.float32)
+        feat = np.asarray([f"act{a}" for a in action], object)
+        df = DataFrame({"decision": decision, "action": action,
+                        "chosenAction": chosen, "probability": prob,
+                        "cost": cost, "af": feat})
+        df = VowpalWabbitFeaturizer(inputCols=["af"], numBits=8) \
+            .transform(df)
+        model = VowpalWabbitContextualBandit(numPasses=12, batchSize=32) \
+            .fit(df)
+        best = model.best_actions(df)
+        assert (best == 2).mean() > 0.95
