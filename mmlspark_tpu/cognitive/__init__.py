@@ -1,0 +1,31 @@
+"""Cognitive Services on DataFrames.
+
+Reference ``cognitive/`` (23 files, ~4.3k LoC — SURVEY §2.8): one
+architecture (``CognitiveServiceBase.scala``) where a transformer assembles
+an HTTP request per row from ServiceParams (scalar or column), pipes it
+through the L7 HTTP stack with retry, and parses JSON responses. All
+engine-free — the TPU build reuses it unchanged over its own HTTP layer.
+"""
+
+from .base import CognitiveServiceBase
+from .text import (TextSentiment, KeyPhraseExtractor, NER, LanguageDetector,
+                   EntityDetector)
+from .vision import (AnalyzeImage, DescribeImage, OCR, RecognizeText,
+                     RecognizeDomainSpecificContent, GenerateThumbnails,
+                     TagImage)
+from .face import (DetectFace, FindSimilarFace, GroupFaces, IdentifyFaces,
+                   VerifyFaces)
+from .anomaly import DetectAnomalies, DetectLastAnomaly
+from .bing import BingImageSearch
+from .speech import SpeechToText, SpeechToTextSDK
+from .azure_search import AzureSearchWriter
+
+__all__ = [
+    "CognitiveServiceBase", "TextSentiment", "KeyPhraseExtractor", "NER",
+    "LanguageDetector", "EntityDetector", "AnalyzeImage", "DescribeImage",
+    "OCR", "RecognizeText", "RecognizeDomainSpecificContent",
+    "GenerateThumbnails", "TagImage", "DetectFace", "FindSimilarFace",
+    "GroupFaces", "IdentifyFaces", "VerifyFaces", "DetectAnomalies",
+    "DetectLastAnomaly", "BingImageSearch", "SpeechToText",
+    "SpeechToTextSDK", "AzureSearchWriter",
+]

@@ -1,0 +1,46 @@
+"""Anomaly Detector services.
+
+Reference ``cognitive/AnamolyDetection.scala`` — ``DetectAnomalies``
+(entire series) and ``DetectLastAnomaly`` (latest point), posting
+{"series": [{timestamp, value}...], "granularity": ...}.
+"""
+
+from __future__ import annotations
+
+import json
+
+from ..core import ServiceParam
+from .base import CognitiveServiceBase
+
+
+class _AnomalyBase(CognitiveServiceBase):
+    series = ServiceParam("series", "list of {timestamp, value} points")
+    granularity = ServiceParam("granularity",
+                               "yearly|monthly|weekly|daily|hourly|"
+                               "minutely")
+    maxAnomalyRatio = ServiceParam("maxAnomalyRatio", "max anomaly ratio")
+    sensitivity = ServiceParam("sensitivity", "detection sensitivity")
+    customInterval = ServiceParam("customInterval", "granularity multiple")
+    _path = ""
+
+    def _url_for_location(self, location: str) -> str:
+        return (f"https://{location}.api.cognitive.microsoft.com"
+                f"/anomalydetector/v1.0/timeseries/{self._path}")
+
+    def _body(self, df, row: int) -> bytes:
+        payload = {"series": self._jsonable(
+            self._resolve("series", df, row)),
+            "granularity": self._resolve("granularity", df, row, "daily")}
+        for opt in ("maxAnomalyRatio", "sensitivity", "customInterval"):
+            v = self._resolve(opt, df, row)
+            if v is not None:
+                payload[opt] = self._jsonable(v)
+        return json.dumps(payload).encode()
+
+
+class DetectAnomalies(_AnomalyBase):
+    _path = "entire/detect"
+
+
+class DetectLastAnomaly(_AnomalyBase):
+    _path = "last/detect"

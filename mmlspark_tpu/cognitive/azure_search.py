@@ -1,0 +1,71 @@
+"""Azure Search sink.
+
+Reference ``cognitive/AzureSearch.scala`` (writer with index creation) and
+``AzureSearchAPI.scala``: create the index if missing, then POST row
+batches to ``/docs/index`` with ``@search.action`` per document.
+"""
+
+from __future__ import annotations
+
+import json
+
+import numpy as np
+
+from ..core import DataFrame
+from ..io.http.clients import send_request
+from ..io.http.schema import HTTPRequestData
+
+
+class AzureSearchWriter:
+    def __init__(self, service_name: str, index_name: str, key: str,
+                 index_fields: dict | None = None,
+                 action: str = "mergeOrUpload", batch_size: int = 100,
+                 api_version: str = "2019-05-06"):
+        self.base = (f"https://{service_name}.search.windows.net"
+                     f"/indexes")
+        self.index_name = index_name
+        self.key = key
+        self.index_fields = index_fields
+        self.action = action
+        self.batch_size = batch_size
+        self.api_version = api_version
+
+    def _headers(self):
+        return {"Content-Type": "application/json", "api-key": self.key}
+
+    def ensure_index(self) -> bool:
+        """Create the index when a field schema was given (reference
+        ``SearchIndex.createIfNoneExists``)."""
+        if not self.index_fields:
+            return False
+        fields = [{"name": name, **spec} if isinstance(spec, dict)
+                  else {"name": name, "type": spec}
+                  for name, spec in self.index_fields.items()]
+        body = json.dumps({"name": self.index_name,
+                           "fields": fields}).encode()
+        resp = send_request(HTTPRequestData(
+            url=f"{self.base}?api-version={self.api_version}",
+            method="POST", headers=self._headers(), entity=body))
+        return 200 <= resp.status_code < 300
+
+    def write(self, df: DataFrame) -> list[dict]:
+        """POST documents in batches; returns per-batch API responses."""
+        self.ensure_index()
+        url = (f"{self.base}/{self.index_name}/docs/index"
+               f"?api-version={self.api_version}")
+        rows = [dict(r) for r in df.collect()]
+        results = []
+        for start in range(0, len(rows), self.batch_size):
+            docs = []
+            for r in rows[start:start + self.batch_size]:
+                doc = {"@search.action": self.action}
+                for k, v in r.items():
+                    doc[k] = v.item() if isinstance(v, np.generic) else \
+                        v.tolist() if isinstance(v, np.ndarray) else v
+                docs.append(doc)
+            resp = send_request(HTTPRequestData(
+                url=url, method="POST", headers=self._headers(),
+                entity=json.dumps({"value": docs}).encode()))
+            results.append(resp.json() if resp.entity else
+                           {"statusCode": resp.status_code})
+        return results

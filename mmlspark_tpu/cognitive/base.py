@@ -1,0 +1,180 @@
+"""CognitiveServiceBase — the one architecture all services share.
+
+Reference ``cognitive/CognitiveServiceBase.scala``:
+- every service argument is a ``ServiceParam`` settable as a scalar
+  (``setX``) or per-row column (``setXCol``) (:28-101);
+- ``transform`` assembles one HTTP request per row (subscription key
+  header, url params, JSON body), sends through the retrying client stack,
+  parses JSON into the output column with an error column for failures.
+"""
+
+from __future__ import annotations
+
+import json
+from typing import Any
+
+import numpy as np
+
+from ..core import Transformer, Param, ServiceParam, TypeConverters as TC
+from ..core.contracts import HasOutputCol
+from ..io.http.clients import AsyncClient
+from ..io.http.schema import HTTPRequestData, HTTPResponseData
+
+
+class CognitiveServiceBase(Transformer, HasOutputCol):
+    subscriptionKey = ServiceParam("subscriptionKey", "API key")
+    url = Param("url", "full endpoint url", TC.toString, default="")
+    errorCol = Param("errorCol", "error output column", TC.toString,
+                     default="error")
+    concurrency = Param("concurrency", "concurrent requests", TC.toInt,
+                        default=1)
+    timeout = Param("timeout", "per-request timeout (s)", TC.toFloat,
+                    default=60.0)
+
+    # subclasses override
+    _method = "POST"
+    _content_type = "application/json"
+
+    def setLocation(self, location: str):
+        """Region shorthand: fills url from the service's path template."""
+        self.set("url", self._url_for_location(location))
+        return self
+
+    def _url_for_location(self, location: str) -> str:
+        raise NotImplementedError(
+            f"{type(self).__name__} has no location template; setUrl "
+            "directly")
+
+    # ------------------------------------------------------- value plumbing
+    def _resolve(self, param_name: str, df, row: int, default=None):
+        """ServiceParam resolution: {"value": v} | {"col": name} → value."""
+        spec = self.get(param_name)
+        if spec is None:
+            return default
+        if isinstance(spec, dict) and "col" in spec:
+            return df[spec["col"]][row]
+        if isinstance(spec, dict) and "value" in spec:
+            return spec["value"]
+        return spec
+
+    @staticmethod
+    def _jsonable(v: Any) -> Any:
+        if isinstance(v, np.generic):
+            return v.item()
+        if isinstance(v, np.ndarray):
+            return v.tolist()
+        return v
+
+    # ------------------------------------------------------ request builder
+    def _url_params(self, df, row: int) -> dict:
+        return {}
+
+    def _body(self, df, row: int) -> bytes | None:
+        raise NotImplementedError
+
+    def _headers(self, df, row: int) -> dict:
+        h = {"Content-Type": self._content_type}
+        key = self._resolve("subscriptionKey", df, row)
+        if key:
+            h["Ocp-Apim-Subscription-Key"] = str(key)
+        return h
+
+    def _build_request(self, df, row: int) -> HTTPRequestData | None:
+        url = self.get("url")
+        params = {k: v for k, v in self._url_params(df, row).items()
+                  if v is not None}
+        if params:
+            from urllib.parse import urlencode
+            url = url + ("&" if "?" in url else "?") + urlencode(params)
+        return HTTPRequestData(url=url, method=self._method,
+                               headers=self._headers(df, row),
+                               entity=self._body(df, row))
+
+    def _parse_response(self, resp: HTTPResponseData) -> Any:
+        return resp.json()
+
+    # ------------------------------------------------------------ transform
+    def _transform(self, df):
+        n = len(df)
+        requests: list[HTTPRequestData | None] = [
+            self._build_request(df, i) for i in range(n)]
+        live = [(i, r) for i, r in enumerate(requests) if r is not None]
+        client = AsyncClient(concurrency=self.get("concurrency"),
+                             timeout=self.get("timeout"))
+        responses = client.send([r for _, r in live])
+        out = np.empty(n, object)
+        err = np.empty(n, object)
+        for (i, _), resp in zip(live, responses):
+            if 200 <= resp.status_code < 300:
+                try:
+                    out[i] = self._parse_response(resp)
+                    err[i] = None
+                except Exception as e:
+                    out[i] = None
+                    err[i] = f"parse error: {e}"
+            else:
+                out[i] = None
+                err[i] = {"statusCode": resp.status_code,
+                          "reason": resp.reason,
+                          "response": resp.entity.decode("utf-8", "replace")
+                          if resp.entity else None}
+        return (df.with_column(self.getOutputCol(), out)
+                  .with_column(self.get("errorCol"), err))
+
+
+class _JsonBodyService(CognitiveServiceBase):
+    """Services posting a JSON object built from ServiceParams."""
+
+    _body_params: tuple[str, ...] = ()
+
+    def _body(self, df, row: int) -> bytes:
+        payload = {}
+        for name in self._body_params:
+            v = self._resolve(name, df, row)
+            if v is not None:
+                payload[name] = self._jsonable(v)
+        return json.dumps(payload).encode()
+
+
+class _DocumentsService(CognitiveServiceBase):
+    """Text Analytics shape: {"documents": [{id, text, language?}]}
+    (reference ``cognitive/TextAnalytics.scala`` V3 schemas)."""
+
+    text = ServiceParam("text", "document text")
+    language = ServiceParam("language", "document language")
+
+    def _body(self, df, row: int) -> bytes:
+        doc = {"id": "0",
+               "text": self._jsonable(self._resolve("text", df, row))}
+        lang = self._resolve("language", df, row)
+        if lang:
+            doc["language"] = self._jsonable(lang)
+        return json.dumps({"documents": [doc]}).encode()
+
+    def _parse_response(self, resp: HTTPResponseData):
+        parsed = resp.json()
+        docs = parsed.get("documents") if isinstance(parsed, dict) else None
+        return docs[0] if docs else parsed
+
+
+class _ImageInputService(CognitiveServiceBase):
+    """Vision/Face shape: either {"url": ...} JSON or raw image bytes
+    (reference ``cognitive/ComputerVision.scala`` HasImageInput)."""
+
+    imageUrl = ServiceParam("imageUrl", "image url")
+    imageBytes = ServiceParam("imageBytes", "raw image bytes")
+
+    def _body(self, df, row: int) -> bytes:
+        url = self._resolve("imageUrl", df, row)
+        if url is not None:
+            return json.dumps({"url": str(url)}).encode()
+        data = self._resolve("imageBytes", df, row)
+        if data is None:
+            raise ValueError("set imageUrl(Col) or imageBytes(Col)")
+        return bytes(data)
+
+    def _headers(self, df, row: int) -> dict:
+        h = super()._headers(df, row)
+        if self._resolve("imageUrl", df, row) is None:
+            h["Content-Type"] = "application/octet-stream"
+        return h

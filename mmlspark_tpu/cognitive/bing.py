@@ -1,0 +1,73 @@
+"""Bing Image Search.
+
+Reference ``cognitive/BingImageSearch.scala`` — GET search transformer plus
+the ``downloadFromUrls`` helper that fans result urls out to byte columns.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from ..core import DataFrame, ServiceParam
+from ..io.http.clients import AsyncClient
+from ..io.http.schema import HTTPRequestData
+from .base import CognitiveServiceBase
+
+
+class BingImageSearch(CognitiveServiceBase):
+    _method = "GET"
+    q = ServiceParam("q", "search query")
+    count = ServiceParam("count", "results per page")
+    offset = ServiceParam("offset", "result offset")
+    imageType = ServiceParam("imageType", "Photo|Clipart|...")
+
+    def __init__(self, **kwargs):
+        super().__init__(**kwargs)
+        self._setDefault(
+            url="https://api.bing.microsoft.com/v7.0/images/search",
+            outputCol="images")
+
+    def _url_params(self, df, row):
+        return {"q": self._resolve("q", df, row),
+                "count": self._resolve("count", df, row),
+                "offset": self._resolve("offset", df, row),
+                "imageType": self._resolve("imageType", df, row)}
+
+    def _body(self, df, row):
+        return None
+
+    @staticmethod
+    def getUrlTransformer(image_col: str, url_col: str):
+        """Response → exploded contentUrl rows (reference
+        ``BingImageSearch.getUrlTransformer``)."""
+        from ..core import Transformer
+
+        class _Urls(Transformer):
+            def _transform(self, df):
+                urls = []
+                for r in df[image_col]:
+                    for v in (r or {}).get("value", []):
+                        if "contentUrl" in v:
+                            urls.append(v["contentUrl"])
+                col = np.empty(len(urls), object)
+                col[:] = urls
+                return DataFrame({url_col: col})
+        return _Urls()
+
+    @staticmethod
+    def downloadFromUrls(url_col: str, bytes_col: str,
+                         concurrency: int = 8, timeout: float = 30.0):
+        """URL column → bytes column (reference ``downloadFromUrls``)."""
+        from ..core import Transformer
+
+        class _Download(Transformer):
+            def _transform(self, df):
+                reqs = [HTTPRequestData(url=str(u), method="GET")
+                        for u in df[url_col]]
+                responses = AsyncClient(concurrency=concurrency,
+                                        timeout=timeout).send(reqs)
+                out = np.empty(len(responses), object)
+                out[:] = [r.entity if 200 <= r.status_code < 300 else None
+                          for r in responses]
+                return df.with_column(bytes_col, out)
+        return _Download()

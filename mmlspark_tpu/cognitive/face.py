@@ -1,0 +1,80 @@
+"""Face API services.
+
+Reference ``cognitive/Face.scala`` — detect, find similar, group,
+identify, verify.
+"""
+
+from __future__ import annotations
+
+import json
+
+from ..core import ServiceParam
+from .base import _ImageInputService, _JsonBodyService
+
+
+class DetectFace(_ImageInputService):
+    returnFaceId = ServiceParam("returnFaceId", "include face ids")
+    returnFaceLandmarks = ServiceParam("returnFaceLandmarks",
+                                       "include landmarks")
+    returnFaceAttributes = ServiceParam("returnFaceAttributes",
+                                        "age,gender,emotion,...")
+
+    def _url_for_location(self, location: str) -> str:
+        return (f"https://{location}.api.cognitive.microsoft.com"
+                f"/face/v1.0/detect")
+
+    def _url_params(self, df, row):
+        attrs = self._resolve("returnFaceAttributes", df, row)
+        return {"returnFaceId": self._resolve("returnFaceId", df, row),
+                "returnFaceLandmarks": self._resolve("returnFaceLandmarks",
+                                                     df, row),
+                "returnFaceAttributes": ",".join(attrs) if isinstance(
+                    attrs, (list, tuple)) else attrs}
+
+
+class FindSimilarFace(_JsonBodyService):
+    faceId = ServiceParam("faceId", "query face id")
+    faceIds = ServiceParam("faceIds", "candidate face ids")
+    maxNumOfCandidatesReturned = ServiceParam(
+        "maxNumOfCandidatesReturned", "max matches")
+    mode = ServiceParam("mode", "matchPerson | matchFace")
+    _body_params = ("faceId", "faceIds", "maxNumOfCandidatesReturned",
+                    "mode")
+
+    def _url_for_location(self, location: str) -> str:
+        return (f"https://{location}.api.cognitive.microsoft.com"
+                f"/face/v1.0/findsimilars")
+
+
+class GroupFaces(_JsonBodyService):
+    faceIds = ServiceParam("faceIds", "face ids to cluster")
+    _body_params = ("faceIds",)
+
+    def _url_for_location(self, location: str) -> str:
+        return (f"https://{location}.api.cognitive.microsoft.com"
+                f"/face/v1.0/group")
+
+
+class IdentifyFaces(_JsonBodyService):
+    faceIds = ServiceParam("faceIds", "face ids to identify")
+    personGroupId = ServiceParam("personGroupId", "person group")
+    maxNumOfCandidatesReturned = ServiceParam(
+        "maxNumOfCandidatesReturned", "candidates per face")
+    confidenceThreshold = ServiceParam("confidenceThreshold",
+                                       "min confidence")
+    _body_params = ("faceIds", "personGroupId",
+                    "maxNumOfCandidatesReturned", "confidenceThreshold")
+
+    def _url_for_location(self, location: str) -> str:
+        return (f"https://{location}.api.cognitive.microsoft.com"
+                f"/face/v1.0/identify")
+
+
+class VerifyFaces(_JsonBodyService):
+    faceId1 = ServiceParam("faceId1", "first face")
+    faceId2 = ServiceParam("faceId2", "second face")
+    _body_params = ("faceId1", "faceId2")
+
+    def _url_for_location(self, location: str) -> str:
+        return (f"https://{location}.api.cognitive.microsoft.com"
+                f"/face/v1.0/verify")

@@ -1,0 +1,78 @@
+"""Speech-to-text services.
+
+Reference ``cognitive/SpeechToText.scala`` (REST short-audio API) and
+``SpeechToTextSDK.scala:79-540`` (native Speech SDK streaming with pull
+audio streams). The SDK's native streaming has no TPU-relevant engine —
+here ``SpeechToTextSDK`` approximates continuous recognition by chunking
+audio and posting each chunk to the REST endpoint, emitting one result row
+per chunk (the reference's per-utterance output shape).
+"""
+
+from __future__ import annotations
+
+import json
+
+import numpy as np
+
+from ..core import Param, ServiceParam, TypeConverters as TC
+from .base import CognitiveServiceBase
+
+
+class SpeechToText(CognitiveServiceBase):
+    _content_type = "audio/wav; codecs=audio/pcm; samplerate=16000"
+    audioData = ServiceParam("audioData", "raw audio bytes")
+    language = ServiceParam("language", "BCP-47 language tag")
+    format = ServiceParam("format", "simple | detailed")
+    profanity = ServiceParam("profanity", "masked | removed | raw")
+
+    def _url_for_location(self, location: str) -> str:
+        return (f"https://{location}.stt.speech.microsoft.com/speech/"
+                f"recognition/conversation/cognitiveservices/v1")
+
+    def _url_params(self, df, row):
+        return {"language": self._resolve("language", df, row, "en-US"),
+                "format": self._resolve("format", df, row),
+                "profanity": self._resolve("profanity", df, row)}
+
+    def _body(self, df, row: int) -> bytes:
+        return bytes(self._resolve("audioData", df, row))
+
+
+class SpeechToTextSDK(SpeechToText):
+    """Streaming approximation: chunk audio, one recognition per chunk."""
+
+    chunkSeconds = Param("chunkSeconds", "seconds of audio per chunk",
+                         TC.toFloat, default=15.0)
+    sampleRate = Param("sampleRate", "PCM sample rate", TC.toInt,
+                       default=16000)
+
+    def _transform(self, df):
+        bytes_per_chunk = int(self.get("chunkSeconds")
+                              * self.get("sampleRate") * 2)  # 16-bit mono
+        rows = []
+        audio_col = self.get("audioData")
+        col_name = audio_col["col"] if isinstance(audio_col, dict) and \
+            "col" in audio_col else None
+        for i in range(len(df)):
+            data = bytes(self._resolve("audioData", df, i))
+            chunks = [data[o:o + bytes_per_chunk]
+                      for o in range(0, max(len(data), 1),
+                                     bytes_per_chunk)]
+            for c in chunks:
+                rows.append((i, c))
+        from ..core import DataFrame
+        src = np.empty(len(rows), object)
+        src[:] = [c for _, c in rows]
+        chunk_df = DataFrame({"_chunk": src})
+        inner = SpeechToText(
+            url=self.get("url"), outputCol=self.getOutputCol(),
+            errorCol=self.get("errorCol"),
+            concurrency=self.get("concurrency"))
+        inner.set("subscriptionKey", self.get("subscriptionKey"))
+        inner.setAudioDataCol("_chunk")
+        for p in ("language", "format", "profanity"):
+            if self.isSet(p):
+                inner.set(p, self.get(p))
+        out = inner.transform(chunk_df).drop("_chunk")
+        row_idx = np.asarray([i for i, _ in rows])
+        return out.with_column("sourceRow", row_idx)

@@ -1,0 +1,39 @@
+"""Text Analytics services.
+
+Reference ``cognitive/TextAnalytics.scala`` — sentiment, key phrases, NER,
+entity linking, language detection (V3 endpoints).
+"""
+
+from __future__ import annotations
+
+from .base import _DocumentsService
+
+
+class _TextAnalytics(_DocumentsService):
+    _path = ""
+
+    def _url_for_location(self, location: str) -> str:
+        return (f"https://{location}.api.cognitive.microsoft.com"
+                f"/text/analytics/v3.0/{self._path}")
+
+
+class TextSentiment(_TextAnalytics):
+    """Reference ``TextSentiment`` (V3: sentiment + per-sentence scores)."""
+    _path = "sentiment"
+
+
+class KeyPhraseExtractor(_TextAnalytics):
+    _path = "keyPhrases"
+
+
+class NER(_TextAnalytics):
+    _path = "entities/recognition/general"
+
+
+class EntityDetector(_TextAnalytics):
+    """Entity linking (reference ``EntityDetector``)."""
+    _path = "entities/linking"
+
+
+class LanguageDetector(_TextAnalytics):
+    _path = "languages"

@@ -1,0 +1,129 @@
+"""Computer Vision services.
+
+Reference ``cognitive/ComputerVision.scala`` — AnalyzeImage, OCR,
+RecognizeText (async operation polling), DescribeImage, TagImage,
+GenerateThumbnails, DSIR (celebrity/landmark models).
+"""
+
+from __future__ import annotations
+
+import json
+import time
+
+from ..core import Param, ServiceParam, TypeConverters as TC
+from ..io.http.clients import send_request
+from ..io.http.schema import HTTPRequestData, HTTPResponseData
+from .base import _ImageInputService
+
+
+class _Vision(_ImageInputService):
+    _path = ""
+
+    def _url_for_location(self, location: str) -> str:
+        return (f"https://{location}.api.cognitive.microsoft.com"
+                f"/vision/v2.0/{self._path}")
+
+
+class AnalyzeImage(_Vision):
+    _path = "analyze"
+    visualFeatures = ServiceParam("visualFeatures",
+                                  "Categories,Tags,Description,Faces,...")
+    details = ServiceParam("details", "Celebrities,Landmarks")
+    language = ServiceParam("language", "response language")
+
+    def _url_params(self, df, row):
+        vf = self._resolve("visualFeatures", df, row)
+        det = self._resolve("details", df, row)
+        return {"visualFeatures": ",".join(vf) if isinstance(
+                    vf, (list, tuple)) else vf,
+                "details": ",".join(det) if isinstance(
+                    det, (list, tuple)) else det,
+                "language": self._resolve("language", df, row)}
+
+
+class DescribeImage(_Vision):
+    _path = "describe"
+    maxCandidates = ServiceParam("maxCandidates", "caption candidates")
+
+    def _url_params(self, df, row):
+        return {"maxCandidates": self._resolve("maxCandidates", df, row)}
+
+
+class TagImage(_Vision):
+    _path = "tag"
+
+
+class OCR(_Vision):
+    _path = "ocr"
+    language = ServiceParam("language", "ocr language")
+    detectOrientation = ServiceParam("detectOrientation",
+                                     "auto-detect orientation")
+
+    def _url_params(self, df, row):
+        return {"language": self._resolve("language", df, row),
+                "detectOrientation": self._resolve("detectOrientation",
+                                                   df, row)}
+
+
+class RecognizeDomainSpecificContent(_Vision):
+    """DSIR (reference ``RecognizeDomainSpecificContent``): celebrity /
+    landmark models."""
+    model = ServiceParam("model", "celebrities | landmarks")
+
+    def _build_request(self, df, row):
+        model = self._resolve("model", df, row, "celebrities")
+        self.set("url", self.get("url").replace("{model}", str(model))) \
+            if "{model}" in self.get("url") else None
+        return super()._build_request(df, row)
+
+    def _url_for_location(self, location: str) -> str:
+        return (f"https://{location}.api.cognitive.microsoft.com"
+                f"/vision/v2.0/models/{{model}}/analyze")
+
+
+class GenerateThumbnails(_Vision):
+    _path = "generateThumbnail"
+    width = ServiceParam("width", "thumbnail width")
+    height = ServiceParam("height", "thumbnail height")
+    smartCropping = ServiceParam("smartCropping", "smart crop")
+
+    def _url_params(self, df, row):
+        return {"width": self._resolve("width", df, row, 64),
+                "height": self._resolve("height", df, row, 64),
+                "smartCropping": self._resolve("smartCropping", df, row)}
+
+    def _parse_response(self, resp: HTTPResponseData):
+        return resp.entity  # binary thumbnail
+
+
+class RecognizeText(_Vision):
+    """Async text recognition: POST → Operation-Location → poll until
+    done (reference ``RecognizeText`` with ``pollingDelay`` basic handler)."""
+    _path = "recognizeText"
+    mode = ServiceParam("mode", "Printed | Handwritten")
+    pollingDelay = Param("pollingDelay", "seconds between polls",
+                         TC.toFloat, default=0.3)
+    maxPolls = Param("maxPolls", "poll attempts before giving up",
+                     TC.toInt, default=20)
+
+    def _url_params(self, df, row):
+        return {"mode": self._resolve("mode", df, row, "Printed")}
+
+    def _parse_response(self, resp: HTTPResponseData):
+        op_url = resp.headers.get("Operation-Location") or \
+            resp.headers.get("operation-location")
+        if not op_url:
+            return resp.json() if resp.entity else None
+        key = None
+        for k, v in resp.headers.items():
+            if k.lower() == "x-request-key":
+                key = v
+        headers = {"Ocp-Apim-Subscription-Key": key} if key else {}
+        for _ in range(self.get("maxPolls")):
+            time.sleep(self.get("pollingDelay"))
+            poll = send_request(HTTPRequestData(
+                url=op_url, method="GET", headers=headers))
+            body = poll.json() if poll.entity else {}
+            if body.get("status") in ("Succeeded", "Failed"):
+                return body
+        return {"status": "TimedOut"}

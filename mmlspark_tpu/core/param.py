@@ -235,8 +235,21 @@ class ServiceParam(Param):
 
     Reference ``cognitive/CognitiveServiceBase.scala:28-101``: every service
     argument can be set as a constant (``setX``) or per-row from a column
-    (``setXCol``). Encoded as {"value": v} or {"col": name}.
+    (``setXCol``). Encoded as {"value": v} or {"col": name}; the converter
+    wraps every entry path (constructor kwargs, set, setParams, copy) so
+    the stored representation is always the tagged dict.
     """
+
+    def __init__(self, name: str, doc: str = "", default: Any = None,
+                 has_default: bool | None = None):
+        super().__init__(name, doc, converter=ServiceParam._wrap,
+                         default=default, has_default=has_default)
+
+    @staticmethod
+    def _wrap(v: Any) -> dict:
+        if isinstance(v, dict) and v and set(v) <= {"value", "col"}:
+            return dict(v)
+        return {"value": v}
 
 
 class Params:
@@ -366,17 +379,44 @@ class Params:
 
     # -------------------------------------------------- synthesized accessors
     def __getattr__(self, item: str):
-        # Only called when normal lookup fails: synthesize setX/getX.
+        # Only called when normal lookup fails: synthesize setX/getX, plus
+        # setXCol for ServiceParams (scalar-or-column, reference
+        # ``CognitiveServiceBase.scala:28-101``).
         if item.startswith("set") and len(item) > 3:
+            if item.endswith("Col") and len(item) > 6:
+                name = item[3].lower() + item[4:-3]
+                if (type(self).has_param(name) and isinstance(
+                        type(self).get_param(name), ServiceParam)):
+                    def col_setter(col, _name=name):
+                        return self.set(_name, {"col": col})
+                    return col_setter
             name = item[3].lower() + item[4:]
             if type(self).has_param(name):
                 def setter(value, _name=name):
                     return self.set(_name, value)
                 return setter
         if item.startswith("get") and len(item) > 3:
+            if item.endswith("Col") and len(item) > 6:
+                name = item[3].lower() + item[4:-3]
+                if (type(self).has_param(name) and isinstance(
+                        type(self).get_param(name), ServiceParam)):
+                    def col_getter(_name=name):
+                        spec = self.getOrDefault(_name)
+                        return spec.get("col") if isinstance(spec, dict) \
+                            else None
+                    return col_getter
             name = item[3].lower() + item[4:]
             if type(self).has_param(name):
-                return lambda _name=name: self.getOrDefault(_name)
+                p = type(self).get_param(name)
+
+                def getter(_name=name, _p=p):
+                    v = self.getOrDefault(_name)
+                    # ServiceParam getX returns the scalar (reference
+                    # getter symmetry); column bindings read via getXCol
+                    if isinstance(_p, ServiceParam) and isinstance(v, dict):
+                        return v.get("value")
+                    return v
+                return getter
         raise AttributeError(
             f"{type(self).__name__!r} object has no attribute {item!r}")
 

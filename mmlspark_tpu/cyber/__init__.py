@@ -1,0 +1,17 @@
+"""CyberML: access-anomaly detection.
+
+Reference ``src/main/python/mmlspark/cyber/`` (~2k LoC, Python-only —
+SURVEY §2.10): ALS-based collaborative filtering over (tenant, user,
+resource) access logs (``anomaly/collaborative_filtering.py``),
+complement sampling (``complement_access.py``), per-tenant indexers and
+scalers (``feature/``).
+"""
+
+from .feature import IdIndexer, IdIndexerModel, StandardScalarScaler, \
+    LinearScalarScaler
+from .anomaly import AccessAnomaly, AccessAnomalyModel, \
+    ComplementAccessTransformer
+
+__all__ = ["IdIndexer", "IdIndexerModel", "StandardScalarScaler",
+           "LinearScalarScaler", "AccessAnomaly", "AccessAnomalyModel",
+           "ComplementAccessTransformer"]

@@ -1,0 +1,162 @@
+"""Access-anomaly detection via collaborative filtering.
+
+Reference ``cyber/anomaly/collaborative_filtering.py``: per-tenant ALS
+factorization of the (user, resource) access matrix; the anomaly score of
+an access is the (standardized, negated) predicted affinity — users
+accessing resources far from their latent profile score high.
+``complement_access.py``: sample (user, resource) pairs NOT seen, used to
+calibrate/evaluate.
+
+TPU shape: the ALS alternating ridge solves are batched
+``jnp.linalg.solve`` calls over all users (resp. items) at once.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..core import ComplexParam, DataFrame, Estimator, Model, Param, \
+    Transformer, TypeConverters as TC
+
+
+@functools.partial(jax.jit, static_argnames=("rank",))
+def _als_step(mat, fixed, reg, *, rank: int):
+    """Solve factors for every row of ``mat`` given the ``fixed`` factor
+    matrix: (FᵀF + λI)⁻¹ Fᵀ mat_rowᵀ, batched via one solve."""
+    gram = fixed.T @ fixed + reg * jnp.eye(rank)
+    rhs = mat @ fixed                      # [n, rank]
+    return jnp.linalg.solve(gram[None], rhs[..., None])[..., 0]
+
+
+def _als(mat: np.ndarray, rank: int, reg: float, iters: int,
+         seed: int) -> tuple[np.ndarray, np.ndarray]:
+    rng = np.random.default_rng(seed)
+    U, I = mat.shape
+    u = jnp.asarray(rng.normal(scale=0.1, size=(U, rank)), jnp.float32)
+    v = jnp.asarray(rng.normal(scale=0.1, size=(I, rank)), jnp.float32)
+    m = jnp.asarray(mat, jnp.float32)
+    for _ in range(iters):
+        u = _als_step(m, v, reg, rank=rank)
+        v = _als_step(m.T, u, reg, rank=rank)
+    return np.asarray(u), np.asarray(v)
+
+
+class AccessAnomaly(Estimator):
+    tenantCol = Param("tenantCol", "tenant column", TC.toString,
+                      default="tenant")
+    userCol = Param("userCol", "indexed user column (1-based)",
+                    TC.toString, default="user")
+    resCol = Param("resCol", "indexed resource column (1-based)",
+                   TC.toString, default="res")
+    likelihoodCol = Param("likelihoodCol",
+                          "access count/likelihood column ('' = 1.0)",
+                          TC.toString, default="")
+    rankParam = Param("rankParam", "latent dimension", TC.toInt, default=10)
+    regParam = Param("regParam", "ALS ridge strength", TC.toFloat,
+                     default=0.1)
+    maxIter = Param("maxIter", "ALS iterations", TC.toInt, default=10)
+    seed = Param("seed", "init seed", TC.toInt, default=0)
+    outputCol = Param("outputCol", "anomaly score column", TC.toString,
+                      default="anomaly_score")
+
+    def _fit(self, df):
+        tenants = np.asarray(df[self.get("tenantCol")])
+        users = np.asarray(df[self.get("userCol")], np.int64)
+        res = np.asarray(df[self.get("resCol")], np.int64)
+        lcol = self.get("likelihoodCol")
+        vals = (np.asarray(df[lcol], np.float64) if lcol
+                else np.ones(len(users)))
+
+        factors: dict = {}
+        for t in set(tenants.tolist()):
+            m = tenants == t
+            U = int(users[m].max()) + 1
+            I = int(res[m].max()) + 1
+            mat = np.zeros((U, I), np.float32)
+            np.add.at(mat, (users[m], res[m]), vals[m])
+            mat = np.log1p(mat)
+            u_f, v_f = _als(mat, self.get("rankParam"),
+                            self.get("regParam"), self.get("maxIter"),
+                            self.get("seed"))
+            # standardization stats from observed accesses
+            pred = (u_f[users[m]] * v_f[res[m]]).sum(axis=1)
+            factors[t] = (u_f, v_f, float(pred.mean()),
+                          float(pred.std() or 1.0))
+        model = AccessAnomalyModel(factors=factors)
+        self._copy_params_to(model)
+        return model
+
+
+class AccessAnomalyModel(Model):
+    tenantCol = Param("tenantCol", "tenant column", TC.toString,
+                      default="tenant")
+    userCol = Param("userCol", "indexed user column", TC.toString,
+                    default="user")
+    resCol = Param("resCol", "indexed resource column", TC.toString,
+                   default="res")
+    outputCol = Param("outputCol", "anomaly score column", TC.toString,
+                      default="anomaly_score")
+    factors = ComplexParam("factors",
+                           "tenant -> (user_f, res_f, mean, std)")
+
+    def _transform(self, df):
+        tenants = np.asarray(df[self.get("tenantCol")])
+        users = np.asarray(df[self.get("userCol")], np.int64)
+        res = np.asarray(df[self.get("resCol")], np.int64)
+        out = np.zeros(len(users))
+        for t, (u_f, v_f, mean, std) in self.get("factors").items():
+            m = tenants == t
+            uu = np.clip(users[m], 0, u_f.shape[0] - 1)
+            rr = np.clip(res[m], 0, v_f.shape[0] - 1)
+            pred = (u_f[uu] * v_f[rr]).sum(axis=1)
+            # low predicted affinity → high anomaly score
+            out[m] = -(pred - mean) / std
+        return df.with_column(self.get("outputCol"), out)
+
+
+class ComplementAccessTransformer(Transformer):
+    """Sample (tenant, user, resource) triples NOT present in the data
+    (reference ``complement_access.py``)."""
+
+    tenantCol = Param("tenantCol", "tenant column", TC.toString,
+                      default="tenant")
+    indexedColNamesArr = Param("indexedColNamesArr",
+                               "indexed id columns to complement",
+                               TC.toListString, default=["user", "res"])
+    complementsetFactor = Param("complementsetFactor",
+                                "complement samples per observed row",
+                                TC.toInt, default=2)
+    seed = Param("seed", "sampling seed", TC.toInt, default=0)
+
+    def _transform(self, df):
+        rng = np.random.default_rng(self.get("seed"))
+        tcol = self.get("tenantCol")
+        cols = self.get("indexedColNamesArr")
+        tenants = np.asarray(df[tcol])
+        data = {c: np.asarray(df[c], np.int64) for c in cols}
+        out_rows = {tcol: [], **{c: [] for c in cols}}
+        for t in set(tenants.tolist()):
+            m = tenants == t
+            seen = set(zip(*(data[c][m] for c in cols)))
+            maxes = {c: int(data[c][m].max()) for c in cols}
+            want = int(m.sum()) * self.get("complementsetFactor")
+            tries = 0
+            while len(out_rows[tcol]) < want and tries < want * 20:
+                tries += 1
+                cand = tuple(int(rng.integers(1, maxes[c] + 1))
+                             for c in cols)
+                if cand not in seen:
+                    seen.add(cand)
+                    out_rows[tcol].append(t)
+                    for c, v in zip(cols, cand):
+                        out_rows[c].append(v)
+        n = len(out_rows[tcol])
+        tenant_arr = np.empty(n, object)
+        tenant_arr[:] = out_rows[tcol]
+        return DataFrame({tcol: tenant_arr,
+                          **{c: np.asarray(out_rows[c], np.int64)
+                             for c in cols}})

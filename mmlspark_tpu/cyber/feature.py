@@ -1,0 +1,119 @@
+"""Per-tenant feature plumbing.
+
+Reference ``cyber/feature/indexers.py`` (IdIndexer: per-tenant string→int
+with 1-based ids) and ``cyber/feature/scalers.py`` (partitioned
+standard/linear scalers).
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from ..core import ComplexParam, DataFrame, Estimator, Model, Param, \
+    TypeConverters as TC
+
+
+class IdIndexer(Estimator):
+    inputCol = Param("inputCol", "raw id column", TC.toString)
+    partitionKey = Param("partitionKey", "tenant column", TC.toString)
+    outputCol = Param("outputCol", "indexed id column", TC.toString)
+    resetPerPartition = Param("resetPerPartition",
+                              "ids restart at 1 per tenant", TC.toBoolean,
+                              default=True)
+
+    def _fit(self, df):
+        vocab: dict = {}
+        tenants = df[self.get("partitionKey")]
+        values = df[self.get("inputCol")]
+        reset = self.get("resetPerPartition")
+        for t, v in zip(tenants, values):
+            key = (t if reset else None)
+            tenant_vocab = vocab.setdefault(key, {})
+            if v not in tenant_vocab:
+                tenant_vocab[v] = len(tenant_vocab) + 1  # 1-based
+        model = IdIndexerModel(vocabulary=vocab)
+        self._copy_params_to(model)
+        return model
+
+
+class IdIndexerModel(Model):
+    inputCol = Param("inputCol", "raw id column", TC.toString)
+    partitionKey = Param("partitionKey", "tenant column", TC.toString)
+    outputCol = Param("outputCol", "indexed id column", TC.toString)
+    resetPerPartition = Param("resetPerPartition", "per-tenant ids",
+                              TC.toBoolean, default=True)
+    vocabulary = ComplexParam("vocabulary", "tenant -> value -> id")
+
+    def _transform(self, df):
+        vocab = self.get("vocabulary")
+        reset = self.get("resetPerPartition")
+        tenants = df[self.get("partitionKey")]
+        values = df[self.get("inputCol")]
+        out = np.asarray([
+            vocab.get(t if reset else None, {}).get(v, 0)
+            for t, v in zip(tenants, values)], np.int64)
+        return df.with_column(self.get("outputCol"), out)
+
+
+class _PartitionedScaler(Estimator):
+    inputCol = Param("inputCol", "value column", TC.toString)
+    partitionKey = Param("partitionKey", "tenant column", TC.toString)
+    outputCol = Param("outputCol", "scaled column", TC.toString)
+
+    def _stats(self, vals: np.ndarray) -> tuple:
+        raise NotImplementedError
+
+    def _fit(self, df):
+        stats: dict = {}
+        tenants = np.asarray(df[self.get("partitionKey")])
+        vals = np.asarray(df[self.get("inputCol")], np.float64)
+        for t in set(tenants.tolist()):
+            stats[t] = self._stats(vals[tenants == t])
+        model = _ScalerModel(stats=stats, kind=type(self).__name__)
+        self._copy_params_to(model)
+        return model
+
+
+class _ScalerModel(Model):
+    inputCol = Param("inputCol", "value column", TC.toString)
+    partitionKey = Param("partitionKey", "tenant column", TC.toString)
+    outputCol = Param("outputCol", "scaled column", TC.toString)
+    stats = ComplexParam("stats", "tenant -> scaling stats")
+    kind = Param("kind", "scaler type", TC.toString)
+
+    def _transform(self, df):
+        stats = self.get("stats")
+        tenants = np.asarray(df[self.get("partitionKey")])
+        vals = np.asarray(df[self.get("inputCol")], np.float64)
+        out = np.zeros(len(vals))
+        for t, s in stats.items():
+            m = tenants == t
+            if self.get("kind") == "StandardScalarScaler":
+                mean, std = s
+                out[m] = (vals[m] - mean) / (std if std > 0 else 1.0)
+            else:
+                lo, hi, (a, b) = s
+                span = hi - lo if hi > lo else 1.0
+                out[m] = a + (vals[m] - lo) * (b - a) / span
+        return df.with_column(self.get("outputCol"), out)
+
+
+class StandardScalarScaler(_PartitionedScaler):
+    """Per-tenant (x - mean) / std (reference ``scalers.py``)."""
+
+    def _stats(self, vals):
+        return float(vals.mean()), float(vals.std())
+
+
+class LinearScalarScaler(_PartitionedScaler):
+    """Per-tenant min/max → [minRequired, maxRequired]."""
+
+    minRequiredValue = Param("minRequiredValue", "output min", TC.toFloat,
+                             default=0.0)
+    maxRequiredValue = Param("maxRequiredValue", "output max", TC.toFloat,
+                             default=1.0)
+
+    def _stats(self, vals):
+        return (float(vals.min()), float(vals.max()),
+                (self.get("minRequiredValue"),
+                 self.get("maxRequiredValue")))

@@ -1,0 +1,20 @@
+"""Spark-Serving equivalent: pipelines as low-latency web services.
+
+Reference L9 (SURVEY §2.7): HTTP sources/sinks over structured streaming —
+``HTTPSource``/``HTTPSink`` (head node), ``DistributedHTTPSource``,
+continuous mode with epoch replay (``continuous/HTTPSourceV2.scala``), and
+``ServingUDFs.makeReplyUDF/sendReplyUDF``.
+
+TPU-native shape: one process = one host = one server; requests flow
+through a dynamic batcher into the (device-resident, pre-compiled)
+pipeline; replies are routed back by request id. Fault tolerance keeps the
+reference's semantics: in-flight requests are replayed if a batch fails
+(the epoch/history-queue mechanism of ``HTTPSourceV2.scala:488-517``).
+"""
+
+from .server import ServingServer, serving_query
+from .udfs import make_reply_udf, send_reply_udf
+from .dsl import read_stream
+
+__all__ = ["ServingServer", "serving_query", "make_reply_udf",
+           "send_reply_udf", "read_stream"]

@@ -1,0 +1,115 @@
+"""Serving DSL — the reader/writer chain of the reference.
+
+Reference ``io/IOImplicits.scala:20-100``:
+
+    spark.readStream.server().address(host, port, api).load()
+      ...pipeline...
+    .writeStream.server().replyTo(api).start()
+
+Here:
+
+    (read_stream().server().address(host, port, "api")
+       .load()                       # -> ServingStream
+       .transform(stage_or_fn)       # any Transformer or df->df callable
+       .with_reply(fn)               # row value -> reply body
+       .start())                     # -> ServingQuery
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from ..core import DataFrame
+from ..io.http.schema import request_to_string
+from .server import ServingQuery, ServingServer
+from .udfs import make_reply_udf
+
+
+class _ReadStreamBuilder:
+    def __init__(self):
+        self._mode = "server"
+
+    def server(self):
+        self._mode = "server"
+        return self
+
+    def distributedServer(self):
+        # one process = one host here, so distributed == head-node mode;
+        # multi-host serving fronts N processes with an external LB, as the
+        # reference requires for DistributedHTTPSource too
+        self._mode = "server"
+        return self
+
+    def continuousServer(self):
+        self._mode = "continuous"
+        return self
+
+    def address(self, host: str, port: int, api: str):
+        self._host, self._port, self._api = host, port, api
+        return self
+
+    def option(self, key: str, value):
+        setattr(self, f"_{key}", value)
+        return self
+
+    def load(self) -> "ServingStream":
+        server = ServingServer(
+            getattr(self, "_api", "default"),
+            host=getattr(self, "_host", "127.0.0.1"),
+            port=int(getattr(self, "_port", 0)),
+            api_path="/" + getattr(self, "_api", ""))
+        return ServingStream(server)
+
+
+def read_stream() -> _ReadStreamBuilder:
+    return _ReadStreamBuilder()
+
+
+class ServingStream:
+    """A composable request stream: chain transforms, then reply."""
+
+    def __init__(self, server: ServingServer):
+        self.server = server
+        self._stages: list = []
+        self._reply_fn = None
+        self._reply_col = "reply"
+
+    def transform(self, stage):
+        self._stages.append(stage)
+        return self
+
+    def parse_request(self, parser=None):
+        """Add a stage turning the raw request into a value column
+        (reference ``ServingImplicits.parseRequest``). Default: body text →
+        'value' column."""
+        parser = parser or (lambda r: request_to_string(r))
+
+        def stage(df):
+            col = np.empty(len(df), object)
+            col[:] = [parser(r) for r in df["request"]]
+            return df.with_column("value", col)
+        self._stages.append(stage)
+        return self
+
+    def with_reply(self, fn, input_col: str = "value"):
+        """Final stage: fn(row value) → reply body
+        (reference ``makeReply``)."""
+        self._reply_fn = (fn, input_col)
+        return self
+
+    def start(self, name: str | None = None) -> ServingQuery:
+        stages = list(self._stages)
+        reply = self._reply_fn
+
+        def run(df: DataFrame) -> DataFrame:
+            for s in stages:
+                df = s.transform(df) if hasattr(s, "transform") else s(df)
+            if reply is not None:
+                fn, col = reply
+                out = np.empty(len(df), object)
+                out[:] = [make_reply_udf(fn(v)) for v in df[col]]
+                df = df.with_column("reply", out)
+            return df
+
+        self.server.start()
+        return ServingQuery(self.server, run, name=name).start()

@@ -1,0 +1,217 @@
+"""The serving engine: HTTP front, dynamic batcher, pipeline executor.
+
+Reference mapping:
+- ``WorkerServer`` (``continuous/HTTPSourceV2.scala:475+``): per-process
+  HTTP server enqueueing ``CachedRequest``s → :class:`ServingServer`.
+- micro-batch/continuous readers (:259-326): the executor thread pulling
+  batches from the queue and running the pipeline.
+- ``HTTPSourceStateHolder`` (:337-428): the module-level ``_SERVICES``
+  registry, keyed by service name (used by reply UDFs).
+- epoch replay on task retry (:488-517): failed batches are re-enqueued
+  with a bounded retry count.
+"""
+
+from __future__ import annotations
+
+import queue
+import threading
+import traceback
+import uuid
+from dataclasses import dataclass, field
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+import numpy as np
+
+import logging
+
+from ..core import DataFrame
+from ..io.http.schema import HTTPRequestData, HTTPResponseData
+
+_LOG = logging.getLogger("mmlspark_tpu.serving")
+
+_SERVICES: dict[str, "ServingServer"] = {}
+
+
+def get_service(name: str) -> "ServingServer":
+    """Reference ``HTTPSourceStateHolder.getServer``."""
+    return _SERVICES[name]
+
+
+@dataclass
+class CachedRequest:
+    """An in-flight request (reference ``CachedRequest``): body + the
+    machinery to reply exactly once."""
+    id: str
+    request: HTTPRequestData
+    _event: threading.Event = field(default_factory=threading.Event)
+    _response: HTTPResponseData | None = None
+    retries: int = 0
+
+    def reply(self, response: HTTPResponseData) -> bool:
+        if self._event.is_set():
+            return False
+        self._response = response
+        self._event.set()
+        return True
+
+    def wait(self, timeout: float) -> HTTPResponseData:
+        if not self._event.wait(timeout):
+            return HTTPResponseData(status_code=504,
+                                    reason="pipeline timeout")
+        return self._response
+
+
+class ServingServer:
+    """HTTP server + request queue for one named service."""
+
+    def __init__(self, name: str, host: str = "127.0.0.1", port: int = 0,
+                 api_path: str = "/", reply_timeout: float = 30.0,
+                 max_retries: int = 2):
+        self.name = name
+        self.api_path = api_path.rstrip("/") or "/"
+        self.reply_timeout = reply_timeout
+        self.max_retries = max_retries
+        self.queue: queue.Queue[CachedRequest] = queue.Queue()
+        self.history: dict[str, CachedRequest] = {}
+        self._lock = threading.Lock()
+
+        serving = self
+
+        class Handler(BaseHTTPRequestHandler):
+            def _serve(self):
+                length = int(self.headers.get("Content-Length", 0))
+                body = self.rfile.read(length) if length else None
+                req = HTTPRequestData(
+                    url=self.path, method=self.command,
+                    headers=dict(self.headers.items()), entity=body)
+                cached = CachedRequest(id=str(uuid.uuid4()), request=req)
+                with serving._lock:
+                    serving.history[cached.id] = cached
+                serving.queue.put(cached)
+                resp = cached.wait(serving.reply_timeout)
+                with serving._lock:
+                    serving.history.pop(cached.id, None)
+                try:
+                    self.send_response(resp.status_code or 500)
+                    body = resp.entity or b""
+                    for k, v in resp.headers.items():
+                        if k.lower() != "content-length":
+                            self.send_header(k, v)
+                    self.send_header("Content-Length", str(len(body)))
+                    self.end_headers()
+                    self.wfile.write(body)
+                except (BrokenPipeError, ConnectionResetError):
+                    pass  # flaky client; reference tolerates these too
+
+            do_GET = do_POST = do_PUT = _serve
+
+            def log_message(self, *args):  # quiet
+                pass
+
+        self._httpd = ThreadingHTTPServer((host, port), Handler)
+        self.address = self._httpd.server_address
+        self._server_thread = threading.Thread(
+            target=self._httpd.serve_forever, daemon=True)
+        _SERVICES[name] = self
+
+    # -- lifecycle ---------------------------------------------------------
+    def start(self):
+        self._server_thread.start()
+        return self
+
+    def stop(self):
+        self._httpd.shutdown()
+        self._httpd.server_close()
+        _SERVICES.pop(self.name, None)
+
+    # -- batch intake (called by the query loop) ---------------------------
+    def next_batch(self, max_wait: float = 0.005,
+                   max_batch: int = 1024) -> list[CachedRequest]:
+        """Dynamic batching: whatever accumulated, like the reference's
+        ``DynamicBufferedBatcher`` — small batches under light load (low
+        latency), large under heavy load."""
+        batch: list[CachedRequest] = []
+        try:
+            batch.append(self.queue.get(timeout=max_wait))
+        except queue.Empty:
+            return batch
+        while len(batch) < max_batch:
+            try:
+                batch.append(self.queue.get_nowait())
+            except queue.Empty:
+                break
+        return batch
+
+    def replay(self, cached: CachedRequest) -> None:
+        """Reference epoch replay (``recoveredPartitions``,
+        ``HTTPSourceV2.scala:488-517``): requeue an in-flight request whose
+        processing failed."""
+        cached.retries += 1
+        if cached.retries > self.max_retries:
+            cached.reply(HTTPResponseData(
+                status_code=500, reason="pipeline failed after retries"))
+        else:
+            self.queue.put(cached)
+
+
+class ServingQuery:
+    """The 'streaming query': a thread that pulls request batches through
+    the pipeline and replies. ``transform_fn`` receives a DataFrame with
+    ``id`` and ``request`` (HTTPRequestData) columns and must either call
+    ``send_reply_udf`` itself or return a DataFrame with ``id`` and
+    ``reply`` (HTTPResponseData) columns."""
+
+    def __init__(self, server: ServingServer, transform_fn,
+                 name: str | None = None):
+        self.server = server
+        self.transform_fn = transform_fn
+        self.name = name or server.name
+        self._stop = threading.Event()
+        self._thread = threading.Thread(target=self._run, daemon=True)
+        self.exception: Exception | None = None
+
+    def start(self):
+        self._thread.start()
+        return self
+
+    def stop(self):
+        self._stop.set()
+        self._thread.join(timeout=5)
+        self.server.stop()
+
+    def await_termination(self, timeout: float | None = None):
+        self._thread.join(timeout)
+
+    def _run(self):
+        while not self._stop.is_set():
+            batch = self.server.next_batch()
+            if not batch:
+                continue
+            ids = np.empty(len(batch), object)
+            reqs = np.empty(len(batch), object)
+            ids[:] = [c.id for c in batch]
+            reqs[:] = [c.request for c in batch]
+            df = DataFrame({"id": ids, "request": reqs})
+            try:
+                out = self.transform_fn(df)
+                if out is not None and "reply" in getattr(
+                        out, "columns", []):
+                    by_id = {c.id: c for c in batch}
+                    for rid, reply in zip(out["id"], out["reply"]):
+                        c = by_id.get(rid)
+                        if c is not None:
+                            c.reply(reply)
+            except Exception as e:  # replay the whole failed batch
+                self.exception = e
+                _LOG.warning("serving batch failed, replaying: %s",
+                             traceback.format_exc())
+                for c in batch:
+                    self.server.replay(c)
+
+
+def serving_query(name: str, transform_fn, host: str = "127.0.0.1",
+                  port: int = 0, reply_timeout: float = 30.0) -> ServingQuery:
+    """One-call setup: server + query, started."""
+    server = ServingServer(name, host=host, port=port,
+                           reply_timeout=reply_timeout).start()
+    return ServingQuery(server, transform_fn).start()

@@ -1,0 +1,146 @@
+"""Cognitive services layer against a local mock API server (zero-egress
+stand-in for the Azure endpoints; the architecture under test — request
+assembly, ServiceParam scalar/column, retry, error columns — is identical).
+"""
+
+import json
+import threading
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from urllib.parse import parse_qs, urlparse
+
+import numpy as np
+import pytest
+
+from mmlspark_tpu.core import DataFrame
+from mmlspark_tpu.cognitive import (AnalyzeImage, AzureSearchWriter,
+                                    BingImageSearch, DetectAnomalies,
+                                    DetectFace, TextSentiment, VerifyFaces)
+
+
+@pytest.fixture(scope="module")
+def mock_api():
+    """Echoes method/path/query/body/headers as JSON; /fail returns 500."""
+    class Handler(BaseHTTPRequestHandler):
+        def _do(self):
+            n = int(self.headers.get("Content-Length", 0))
+            body = self.rfile.read(n) if n else b""
+            parsed = urlparse(self.path)
+            if parsed.path.endswith("/fail"):
+                self.send_response(500)
+                self.end_headers()
+                return
+            try:
+                body_json = json.loads(body) if body else None
+            except ValueError:
+                body_json = {"_raw_len": len(body)}
+            # text-analytics shape support
+            if body_json and "documents" in body_json:
+                out = {"documents": [
+                    {"id": d["id"], "sentiment": "positive",
+                     "echo": d["text"]} for d in body_json["documents"]]}
+            else:
+                out = {"method": self.command, "path": parsed.path,
+                       "query": {k: v[0] for k, v in
+                                 parse_qs(parsed.query).items()},
+                       "body": body_json,
+                       "key": self.headers.get(
+                           "Ocp-Apim-Subscription-Key")}
+            payload = json.dumps(out).encode()
+            self.send_response(200)
+            self.send_header("Content-Type", "application/json")
+            self.send_header("Content-Length", str(len(payload)))
+            self.end_headers()
+            self.wfile.write(payload)
+
+        do_GET = do_POST = do_PUT = _do
+
+        def log_message(self, *a):
+            pass
+
+    httpd = ThreadingHTTPServer(("127.0.0.1", 0), Handler)
+    threading.Thread(target=httpd.serve_forever, daemon=True).start()
+    yield f"http://127.0.0.1:{httpd.server_address[1]}"
+    httpd.shutdown()
+
+
+class TestServiceParam:
+    def test_scalar_and_column_accessors(self, mock_api):
+        t = TextSentiment(url=f"{mock_api}/sentiment", outputCol="s")
+        t.setSubscriptionKey("k123").setTextCol("txt").setLanguage("en")
+        texts = np.asarray(["great product", "terrible"], object)
+        out = t.transform(DataFrame({"txt": texts}))
+        assert out["s"][0]["echo"] == "great product"
+        assert out["s"][1]["echo"] == "terrible"
+        assert out["error"][0] is None
+
+    def test_error_column_on_500(self, mock_api):
+        t = TextSentiment(url=f"{mock_api}/fail", outputCol="s",
+                          timeout=5)
+        t.setSubscriptionKey("k").setTextCol("txt")
+        out = t.transform(DataFrame(
+            {"txt": np.asarray(["x"], object)}))
+        assert out["s"][0] is None
+        assert out["error"][0]["statusCode"] == 500
+
+
+class TestVision:
+    def test_analyze_image_url_params_and_key(self, mock_api):
+        t = AnalyzeImage(url=f"{mock_api}/analyze", outputCol="a")
+        (t.setSubscriptionKey("key9")
+          .setVisualFeatures(["Categories", "Tags"])
+          .setImageUrlCol("img"))
+        df = DataFrame({"img": np.asarray(
+            ["http://x/1.jpg", "http://x/2.jpg"], object)})
+        out = t.transform(df)
+        r = out["a"][0]
+        assert r["query"]["visualFeatures"] == "Categories,Tags"
+        assert r["body"] == {"url": "http://x/1.jpg"}
+        assert r["key"] == "key9"
+
+    def test_image_bytes_posts_octet_stream(self, mock_api):
+        t = DetectFace(url=f"{mock_api}/detect", outputCol="f")
+        t.setSubscriptionKey("k").setImageBytesCol("img")
+        img = np.empty(1, object)
+        img[0] = b"\x89PNG fake"
+        out = t.transform(DataFrame({"img": img}))
+        assert out["f"][0]["body"]["_raw_len"] == len(b"\x89PNG fake")
+
+
+class TestOtherServices:
+    def test_verify_faces_json_body(self, mock_api):
+        t = VerifyFaces(url=f"{mock_api}/verify", outputCol="v")
+        t.setSubscriptionKey("k").setFaceId1("a1").setFaceId2Col("f2")
+        out = t.transform(DataFrame(
+            {"f2": np.asarray(["b2"], object)}))
+        assert out["v"][0]["body"] == {"faceId1": "a1", "faceId2": "b2"}
+
+    def test_anomaly_series_body(self, mock_api):
+        series = np.empty(1, object)
+        series[0] = [{"timestamp": "2020-01-01T00:00:00Z", "value": 1.0},
+                     {"timestamp": "2020-01-02T00:00:00Z", "value": 99.0}]
+        t = DetectAnomalies(url=f"{mock_api}/anomaly", outputCol="a")
+        t.setSubscriptionKey("k").setSeriesCol("ts").setGranularity("daily")
+        out = t.transform(DataFrame({"ts": series}))
+        body = out["a"][0]["body"]
+        assert body["granularity"] == "daily"
+        assert len(body["series"]) == 2
+
+    def test_bing_image_search_get(self, mock_api):
+        t = BingImageSearch(outputCol="r")
+        t.set("url", f"{mock_api}/bing")
+        t.setSubscriptionKey("k").setQ("kittens").setCount(5)
+        out = t.transform(DataFrame({"dummy": np.asarray([0])}))
+        assert out["r"][0]["method"] == "GET"
+        assert out["r"][0]["query"] == {"q": "kittens", "count": "5"}
+
+    def test_azure_search_writer(self, mock_api):
+        w = AzureSearchWriter(service_name="unused", index_name="idx",
+                              key="k", batch_size=2)
+        w.base = f"{mock_api}/indexes"
+        df = DataFrame({"id": np.asarray(["1", "2", "3"], object),
+                        "score": np.asarray([0.5, 0.7, 0.9])})
+        results = w.write(df)
+        assert len(results) == 2  # 3 rows / batch 2
+        docs = results[0]["body"]["value"]
+        assert docs[0]["@search.action"] == "mergeOrUpload"
+        assert docs[0]["id"] == "1" and isinstance(docs[0]["score"], float)

@@ -1,0 +1,82 @@
+"""CyberML: indexers, scalers, access-anomaly CF, complement sampling."""
+
+import numpy as np
+
+from mmlspark_tpu.core import DataFrame
+from mmlspark_tpu.cyber import (AccessAnomaly, ComplementAccessTransformer,
+                                IdIndexer, LinearScalarScaler,
+                                StandardScalarScaler)
+
+
+def access_df(seed=0, n_users=12, n_res=10, tenant="t0"):
+    """Block structure: users 0..5 touch resources 0..4, rest 5..9."""
+    rng = np.random.default_rng(seed)
+    rows_u, rows_r = [], []
+    for u in range(1, n_users + 1):
+        block = 1 if u <= n_users // 2 else n_res // 2 + 1
+        for _ in range(6):
+            rows_u.append(u)
+            rows_r.append(int(rng.integers(block, block + n_res // 2)))
+    t = np.empty(len(rows_u), object)
+    t[:] = [tenant] * len(rows_u)
+    return DataFrame({"tenant": t,
+                      "user": np.asarray(rows_u, np.int64),
+                      "res": np.asarray(rows_r, np.int64)})
+
+
+class TestFeature:
+    def test_id_indexer_per_tenant(self):
+        t = np.empty(4, object)
+        t[:] = ["a", "a", "b", "b"]
+        df = DataFrame({"tenant": t,
+                        "name": np.asarray(["u1", "u2", "u1", "u3"],
+                                           object)})
+        m = IdIndexer(inputCol="name", partitionKey="tenant",
+                      outputCol="uid").fit(df)
+        out = m.transform(df)
+        # per-tenant 1-based ids; "u1" indexes independently per tenant
+        assert out["uid"].tolist() == [1, 2, 1, 2]
+
+    def test_standard_scaler_per_tenant(self):
+        t = np.empty(6, object)
+        t[:] = ["a"] * 3 + ["b"] * 3
+        df = DataFrame({"tenant": t,
+                        "v": np.asarray([1.0, 2.0, 3.0, 10.0, 20.0, 30.0])})
+        out = (StandardScalarScaler(inputCol="v", partitionKey="tenant",
+                                    outputCol="s").fit(df).transform(df))
+        s = out["s"]
+        np.testing.assert_allclose(s[:3].mean(), 0, atol=1e-9)
+        np.testing.assert_allclose(s[3:].mean(), 0, atol=1e-9)
+
+    def test_linear_scaler_range(self):
+        t = np.empty(3, object)
+        t[:] = ["a"] * 3
+        df = DataFrame({"tenant": t, "v": np.asarray([5.0, 10.0, 15.0])})
+        out = (LinearScalarScaler(inputCol="v", partitionKey="tenant",
+                                  outputCol="s", minRequiredValue=0.0,
+                                  maxRequiredValue=2.0)
+               .fit(df).transform(df))
+        np.testing.assert_allclose(out["s"], [0.0, 1.0, 2.0])
+
+
+class TestAccessAnomaly:
+    def test_cross_block_access_scores_higher(self):
+        df = access_df()
+        model = AccessAnomaly(rankParam=5, maxIter=8).fit(df)
+        # in-block access (user 1 → res 1) vs cross-block (user 1 → res 9)
+        t = np.empty(2, object)
+        t[:] = ["t0", "t0"]
+        probe = DataFrame({"tenant": t,
+                           "user": np.asarray([1, 1], np.int64),
+                           "res": np.asarray([1, 9], np.int64)})
+        scores = model.transform(probe)["anomaly_score"]
+        assert scores[1] > scores[0]
+
+    def test_complement_sampler_disjoint(self):
+        df = access_df()
+        comp = ComplementAccessTransformer(
+            indexedColNamesArr=["user", "res"],
+            complementsetFactor=1).transform(df)
+        seen = set(zip(df["user"].tolist(), df["res"].tolist()))
+        comp_pairs = set(zip(comp["user"].tolist(), comp["res"].tolist()))
+        assert comp_pairs and not (comp_pairs & seen)
