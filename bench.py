@@ -23,6 +23,12 @@ def main():
     import jax.numpy as jnp
     import numpy as np
 
+    # persistent XLA cache: first compile of the ResNet-50 graph via the
+    # remote-compile tunnel is slow; later runs reuse it
+    jax.config.update("jax_compilation_cache_dir",
+                      "/tmp/mmlspark_tpu_jax_cache")
+    jax.config.update("jax_persistent_cache_min_compile_time_secs", 1.0)
+
     from mmlspark_tpu.models import ModelDownloader
 
     loaded = ModelDownloader().download_by_name("ResNet50")

@@ -1,0 +1,19 @@
+"""API binding generator.
+
+Reference L10 (SURVEY §2.12): ``codegen/Wrappable.scala`` renders PySpark
+and sparklyr wrappers for ~120 stages by reflecting over their Params.
+This framework's public API is already Python, so the generator's jobs
+become:
+
+- typed ``.pyi`` stubs making the synthesized ``setX``/``getX`` accessors
+  static (IDE/typing parity with the reference's generated classes);
+- a markdown API reference (the reference's generated pydocs);
+- optional PySpark bridge wrappers, emitted only when pyspark is present
+  (the reference ships its wrappers inside a Spark distribution).
+"""
+
+from .wrappable import (generate_all, generate_docs, generate_stubs,
+                        param_type_hint, py_stub_for)
+
+__all__ = ["generate_all", "generate_docs", "generate_stubs",
+           "param_type_hint", "py_stub_for"]

@@ -1,0 +1,10 @@
+"""CLI: ``python -m mmlspark_tpu.codegen [out_dir]`` — the reference's sbt
+``codegen`` task (``build.sbt:113-120``)."""
+
+import sys
+
+from . import generate_all
+
+if __name__ == "__main__":
+    out = generate_all(sys.argv[1] if len(sys.argv) > 1 else "generated")
+    print(f"wrote {len(out['stubs'])} stub files and {out['docs']}")

@@ -1,0 +1,142 @@
+"""Reflection-driven stub + doc generation.
+
+Reference ``codegen/Wrappable.scala:33-67`` maps each Param to a typed
+``ParamInfo`` and renders getters/setters; ``:70-...`` renders the wrapper
+class. The same reflection here walks ``Params.params()``.
+"""
+
+from __future__ import annotations
+
+import inspect
+import os
+from collections import defaultdict
+
+from ..core import Estimator, Param, ComplexParam, ServiceParam, \
+    Transformer
+from ..core.param import TypeConverters as TC
+from ..testing.fuzzing import iter_stage_classes
+
+_CONVERTER_HINTS = {
+    "toInt": "int", "toFloat": "float", "toBoolean": "bool",
+    "toString": "str", "toListString": "list[str]",
+    "toListInt": "list[int]", "toListFloat": "list[float]",
+    "toDict": "dict", "identity": "Any",
+}
+
+
+def param_type_hint(p: Param) -> str:
+    """Reference ``ParamInfo`` type mapping (Wrappable.scala:33-67)."""
+    if isinstance(p, ServiceParam):
+        return "Any"
+    if isinstance(p, ComplexParam):
+        return "Any"
+    for name, hint in _CONVERTER_HINTS.items():
+        if p.converter is getattr(TC, name, None):
+            return hint
+    return "Any"
+
+
+def _accessor(name: str) -> str:
+    return name[0].upper() + name[1:]
+
+
+def stub_base_imports(classes) -> list[str]:
+    """Import lines resolving every base class used by the stubs (pyright
+    needs real names, including private bases like _LightGBMBase)."""
+    local = {c.__name__ for c in classes}
+    imports = set()
+    for cls in classes:
+        for b in cls.__bases__:
+            if b is object or b.__name__ in local:
+                continue
+            imports.add(f"from {b.__module__} import {b.__name__}")
+    return sorted(imports)
+
+
+def py_stub_for(cls) -> str:
+    """One class stub with typed synthesized accessors."""
+    bases = [b.__name__ for b in cls.__bases__ if b is not object] or \
+        ["object"]
+    lines = [f"class {cls.__name__}({', '.join(bases)}):"]
+    doc = inspect.getdoc(cls)
+    if doc:
+        first = doc.splitlines()[0]
+        lines.append(f'    """{first}"""')
+    params = sorted(cls.params(), key=lambda p: p.name)
+    if not params:
+        lines.append("    ...")
+        return "\n".join(lines)
+    init_args = ", ".join(
+        f"{p.name}: {param_type_hint(p)} = ..." for p in params)
+    lines.append(f"    def __init__(self, *, {init_args}) -> None: ...")
+    for p in params:
+        hint = param_type_hint(p)
+        acc = _accessor(p.name)
+        lines.append(
+            f"    def set{acc}(self, value: {hint}) ->"
+            f" \"{cls.__name__}\": ...")
+        lines.append(f"    def get{acc}(self) -> {hint}: ...")
+        if isinstance(p, ServiceParam):
+            lines.append(
+                f"    def set{acc}Col(self, col: str) ->"
+                f" \"{cls.__name__}\": ...")
+            lines.append(f"    def get{acc}Col(self) -> str | None: ...")
+    return "\n".join(lines)
+
+
+def generate_stubs(out_dir: str) -> list[str]:
+    """Write one ``<module>.pyi``-style stub file per stage module."""
+    by_module: dict[str, list] = defaultdict(list)
+    for cls in iter_stage_classes():
+        by_module[cls.__module__].append(cls)
+    written = []
+    os.makedirs(out_dir, exist_ok=True)
+    for module, classes in sorted(by_module.items()):
+        path = os.path.join(out_dir, module.replace(".", "_") + ".pyi")
+        header = ("# Auto-generated API stubs — regenerate with\n"
+                  "#   python -m mmlspark_tpu.codegen\n"
+                  "from typing import Any\n"
+                  + "\n".join(stub_base_imports(classes)) + "\n\n")
+        body = "\n\n\n".join(
+            py_stub_for(c) for c in
+            sorted(classes, key=lambda c: c.__name__))
+        with open(path, "w") as f:
+            f.write(header + body + "\n")
+        written.append(path)
+    return written
+
+
+def generate_docs(out_path: str) -> str:
+    """Markdown API reference (the reference's generated sphinx docs)."""
+    sections: dict[str, list[str]] = defaultdict(list)
+    for cls in iter_stage_classes():
+        pkg = cls.__module__.split(".")[1]
+        kind = ("Estimator" if issubclass(cls, Estimator) else
+                "Transformer" if issubclass(cls, Transformer) else "Model")
+        doc = (inspect.getdoc(cls) or "").splitlines()
+        summary = doc[0] if doc else ""
+        rows = [f"### `{cls.__name__}` ({kind})", "", summary, "",
+                "| param | type | default | doc |",
+                "|---|---|---|---|"]
+        for p in sorted(cls.params(), key=lambda p: p.name):
+            default = p.default if p.has_default else "—"
+            rows.append(f"| `{p.name}` | {param_type_hint(p)} | "
+                        f"`{default}` | {p.doc} |")
+        sections[pkg].append("\n".join(rows))
+    out = ["# mmlspark_tpu API reference", ""]
+    for pkg in sorted(sections):
+        out.append(f"## {pkg}")
+        out.append("")
+        out.extend(sections[pkg])
+        out.append("")
+    os.makedirs(os.path.dirname(out_path) or ".", exist_ok=True)
+    content = "\n".join(out)
+    with open(out_path, "w") as f:
+        f.write(content)
+    return out_path
+
+
+def generate_all(base_dir: str = "generated") -> dict:
+    stubs = generate_stubs(os.path.join(base_dir, "stubs"))
+    docs = generate_docs(os.path.join(base_dir, "docs", "api.md"))
+    return {"stubs": stubs, "docs": docs}

@@ -55,6 +55,11 @@ class SaveLoadMixin:
             "uid": self.uid,
             "paramMap": simple,
             "complexParams": complex_names,
+            # instance-level default overrides (set by stage __init__ or
+            # _setDefault) must survive load, which bypasses __init__
+            "defaultOverrides": {
+                k: type(self).get_param(k).encode(v)
+                for k, v in self._defaultOverrides.items()},
             "library": "mmlspark_tpu",
         }
         with open(os.path.join(path, "metadata.json"), "w") as f:
@@ -92,6 +97,10 @@ def load_stage(path: str) -> Any:
         if stage.has_param(name):
             p = stage.get_param(name)
             stage._paramMap[name] = p.decode(payload)
+    for name, payload in meta.get("defaultOverrides", {}).items():
+        if stage.has_param(name):
+            stage._defaultOverrides[name] = \
+                stage.get_param(name).decode(payload)
     for name in meta["complexParams"]:
         p = stage.get_param(name)
         stage._paramMap[name] = p.load_value(

@@ -1,0 +1,75 @@
+"""Checkpoint / resume for training state.
+
+Reference checkpoint story (SURVEY §5): LightGBM batch training carries the
+model string across batches (``LightGBMBase.scala:34-51``), VW warm-starts
+from ``initialModel`` bytes, streaming queries use ``checkpointLocation``.
+The DL path adds real training, so it gets real checkpoints: orbax-backed
+save/restore of :class:`TrainState` with step-numbered directories and
+retention.
+"""
+
+from __future__ import annotations
+
+import os
+import re
+
+import jax
+import numpy as np
+
+from .train import TrainState
+
+
+class CheckpointManager:
+    """Step-numbered orbax checkpoints with retention."""
+
+    def __init__(self, directory: str, max_to_keep: int = 3):
+        self.directory = os.path.abspath(directory)
+        self.max_to_keep = max_to_keep
+        os.makedirs(self.directory, exist_ok=True)
+
+    def _step_dir(self, step: int) -> str:
+        return os.path.join(self.directory, f"step_{step:010d}")
+
+    def all_steps(self) -> list[int]:
+        out = []
+        for name in os.listdir(self.directory):
+            m = re.fullmatch(r"step_(\d+)", name)
+            if m:
+                out.append(int(m.group(1)))
+        return sorted(out)
+
+    def latest_step(self) -> int | None:
+        steps = self.all_steps()
+        return steps[-1] if steps else None
+
+    def save(self, state: TrainState, step: int | None = None) -> str:
+        import orbax.checkpoint as ocp
+        step = int(state.step) if step is None else step
+        path = self._step_dir(step)
+        with ocp.PyTreeCheckpointer() as ck:
+            ck.save(path, jax.tree.map(np.asarray, {
+                "params": state.params,
+                "batch_stats": state.batch_stats,
+                "opt_state": state.opt_state,
+                "step": state.step,
+            }), force=True)
+        self._retain()
+        return path
+
+    def restore(self, step: int | None = None) -> TrainState:
+        import orbax.checkpoint as ocp
+        step = self.latest_step() if step is None else step
+        if step is None:
+            raise FileNotFoundError(
+                f"no checkpoints under {self.directory}")
+        with ocp.PyTreeCheckpointer() as ck:
+            tree = ck.restore(self._step_dir(step))
+        return TrainState(params=tree["params"],
+                          batch_stats=tree["batch_stats"],
+                          opt_state=tree["opt_state"], step=tree["step"])
+
+    def _retain(self) -> None:
+        import shutil
+        steps = self.all_steps()
+        for s in steps[:-self.max_to_keep]:
+            shutil.rmtree(self._step_dir(s), ignore_errors=True)

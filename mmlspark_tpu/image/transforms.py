@@ -55,7 +55,8 @@ class ImageTransformer(Transformer, HasInputCol, HasOutputCol):
 
     # -- fluent builders (reference ImageTransformer public API) -----------
     def _add(self, op: str, **kw):
-        self.set("stages", list(self.get("stages")) + [(op, kw)])
+        # stored as [op, kwargs] lists so the JSON round trip is identity
+        self.set("stages", list(self.get("stages")) + [[op, kw]])
         return self
 
     def resize(self, height: int, width: int):

@@ -34,9 +34,18 @@ class HTTPTransformer(Transformer, HasInputCol, HasOutputCol):
     def __init__(self, **kwargs):
         super().__init__(**kwargs)
         self._setDefault(inputCol="request", outputCol="response")
+
+    @property
+    def _client_holder(self) -> SharedVariable:
         # one client per transformer instance, shared across calls
-        # (reference SharedVariable per JVM, HTTPTransformer.scala:97-106)
-        self._client_holder = SharedVariable(self._make_client)
+        # (reference SharedVariable per JVM, HTTPTransformer.scala:97-106);
+        # lazy so instances reconstructed by load_stage (which bypasses
+        # __init__) still get one
+        holder = self.__dict__.get("_client_holder_v")
+        if holder is None:
+            holder = SharedVariable(self._make_client)
+            self.__dict__["_client_holder_v"] = holder
+        return holder
 
     def _make_client(self):
         c = self.get("concurrency")

@@ -1,0 +1,14 @@
+"""Native runtime: C++ IO with a NativeLoader-style bootstrap.
+
+Reference ``core/env/NativeLoader.java:28-110``: extract the shared object
+shipped in the jar to a temp dir and ``System.load`` it once per JVM. Here
+the shared object is built from the shipped C++ source on first use (the
+toolchain is part of the image), cached by source hash, and loaded with
+ctypes once per process. Pure-NumPy fallbacks keep everything working when
+no compiler is present.
+"""
+
+from .loader import NativeLoader, get_fastio
+from .csv import read_csv, parse_csv_bytes
+
+__all__ = ["NativeLoader", "get_fastio", "read_csv", "parse_csv_bytes"]

@@ -1,0 +1,117 @@
+"""CSV → columnar DataFrame through the native parser.
+
+The data-loading front door for tabular training (the reference pushes
+this into each native engine's loader; here one loader feeds everything).
+Numeric cells parse to float32 (NaN for missing/non-numeric — the GBDT
+missing-value convention); requested string columns are decoded in Python.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import io
+
+import numpy as np
+
+from ..core import DataFrame
+from .loader import get_fastio
+
+
+def parse_csv_bytes(data: bytes, has_header: bool = True,
+                    n_threads: int = 0) -> tuple[np.ndarray, list[str]]:
+    """bytes → (float32 [rows, cols] matrix, column names).
+
+    The native parser splits on raw commas; quoted fields would desync it
+    from Python's csv module, so any quote character routes the whole file
+    through the quote-aware path — one parsing discipline per file.
+    """
+    if b'"' in data:
+        return _parse_quoted(data, has_header)
+    lib = get_fastio()
+    first_line = data.split(b"\n", 1)[0].decode("utf-8", "replace")
+    names = [c.strip() for c in first_line.split(",")] if has_header else []
+    if lib is not None:
+        rows = ctypes.c_int64()
+        cols = ctypes.c_int64()
+        lib.csv_dims(data, len(data), int(has_header),
+                     ctypes.byref(rows), ctypes.byref(cols))
+        out = np.empty((rows.value, cols.value), np.float32)
+        if n_threads <= 0:
+            import os
+            n_threads = min(8, os.cpu_count() or 1)
+        lib.csv_parse(data, len(data), int(has_header), rows.value,
+                      cols.value,
+                      out.ctypes.data_as(ctypes.POINTER(ctypes.c_float)),
+                      n_threads)
+        mat = out
+    else:  # NumPy fallback
+        mat = np.genfromtxt(io.BytesIO(data), delimiter=",",
+                            skip_header=1 if has_header else 0,
+                            dtype=np.float32, ndmin=2)
+    if not names:
+        names = [f"Column_{i}" for i in range(mat.shape[1])]
+    return mat, names
+
+
+def _parse_quoted(data: bytes, has_header: bool) -> \
+        tuple[np.ndarray, list[str]]:
+    import csv as _csv
+    rows = list(_csv.reader(io.StringIO(data.decode("utf-8", "replace"))))
+    rows = [r for r in rows if r]
+    names = [c.strip() for c in rows[0]] if has_header and rows else []
+    body = rows[1:] if has_header else rows
+    cols = len(names) or (len(body[0]) if body else 0)
+    mat = np.full((len(body), cols), np.nan, np.float32)
+    for i, r in enumerate(body):
+        for j in range(min(len(r), cols)):
+            try:
+                mat[i, j] = float(r[j])
+            except ValueError:
+                pass
+    if not names:
+        names = [f"Column_{i}" for i in range(cols)]
+    return mat, names
+
+
+def read_csv(path: str, has_header: bool = True,
+             features_col: str | None = None,
+             label_col: str | None = None,
+             string_cols: tuple[str, ...] = ()) -> DataFrame:
+    """Load a CSV as a DataFrame.
+
+    Default: one numeric column per CSV column. ``features_col`` assembles
+    every non-label numeric column into a single 2-D feature column (the
+    shape the estimators consume). ``string_cols`` are re-decoded as python
+    strings (object columns).
+    """
+    with open(path, "rb") as f:
+        data = f.read()
+    mat, names = parse_csv_bytes(data, has_header)
+
+    str_values: dict[str, np.ndarray] = {}
+    if string_cols:
+        import csv as _csv
+        import io as _io
+        reader = _csv.reader(_io.StringIO(data.decode("utf-8", "replace")))
+        rows = list(reader)
+        if has_header:
+            rows = rows[1:]
+        for c in string_cols:
+            j = names.index(c)
+            col = np.empty(len(rows), object)
+            col[:] = [r[j] if j < len(r) else None for r in rows]
+            str_values[c] = col
+
+    cols: dict[str, np.ndarray] = {}
+    if features_col:
+        feature_idx = [j for j, nm in enumerate(names)
+                       if nm != label_col and nm not in string_cols]
+        cols[features_col] = np.ascontiguousarray(mat[:, feature_idx])
+        if label_col is not None:
+            cols[label_col] = mat[:, names.index(label_col)]
+    else:
+        for j, nm in enumerate(names):
+            if nm not in string_cols:
+                cols[nm] = mat[:, j]
+    cols.update(str_values)
+    return DataFrame(cols)

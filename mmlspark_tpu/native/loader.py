@@ -1,0 +1,96 @@
+"""NativeLoader — compile-on-first-use + ctypes binding.
+
+Reference ``core/env/NativeLoader.java``: resources → temp dir →
+``System.load``; one load per JVM, thread-safe. Here: source → cached .so
+keyed by source hash → ``ctypes.CDLL``; one per process.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import hashlib
+import os
+import subprocess
+import threading
+
+_SRC_DIR = os.path.join(os.path.dirname(__file__), "src")
+_CACHE_DIR = os.environ.get("MMLSPARK_TPU_NATIVE_CACHE",
+                            "/tmp/mmlspark_tpu_native")
+
+
+class NativeLoader:
+    """Build + load one shared library from shipped C++ source."""
+
+    _lock = threading.Lock()
+    _loaded: dict[str, ctypes.CDLL] = {}
+
+    def __init__(self, name: str, sources: list[str],
+                 extra_flags: tuple[str, ...] = ()):
+        self.name = name
+        self.sources = [os.path.join(_SRC_DIR, s) for s in sources]
+        self.extra_flags = extra_flags
+
+    def _so_path(self) -> str:
+        h = hashlib.sha256()
+        for s in self.sources:
+            with open(s, "rb") as f:
+                h.update(f.read())
+        h.update(" ".join(self.extra_flags).encode())
+        return os.path.join(_CACHE_DIR,
+                            f"lib{self.name}_{h.hexdigest()[:16]}.so")
+
+    def _build(self, so_path: str) -> None:
+        os.makedirs(_CACHE_DIR, exist_ok=True)
+        # per-process temp name so concurrent builders never share an
+        # artifact; os.replace publishes whichever finishes atomically
+        tmp = f"{so_path}.{os.getpid()}.build"
+        cmd = ["g++", "-O3", "-march=native", "-shared", "-fPIC",
+               "-std=c++17", "-pthread", *self.extra_flags,
+               *self.sources, "-o", tmp]
+        try:
+            subprocess.run(cmd, check=True, capture_output=True)
+            os.replace(tmp, so_path)
+        finally:
+            if os.path.exists(tmp):
+                os.unlink(tmp)
+
+    def load(self) -> ctypes.CDLL:
+        with NativeLoader._lock:
+            if self.name in NativeLoader._loaded:
+                return NativeLoader._loaded[self.name]
+            so = self._so_path()
+            if not os.path.exists(so):
+                self._build(so)
+            lib = ctypes.CDLL(so)
+            NativeLoader._loaded[self.name] = lib
+            return lib
+
+
+_fastio = None
+_fastio_failed = False
+
+
+def get_fastio():
+    """The fastio library with argtypes configured, or None when the
+    toolchain is unavailable (callers fall back to NumPy paths)."""
+    global _fastio, _fastio_failed
+    if _fastio is not None or _fastio_failed:
+        return _fastio
+    try:
+        lib = NativeLoader("fastio", ["fastio.cpp"]).load()
+    except Exception:
+        _fastio_failed = True
+        return None
+    i64 = ctypes.c_int64
+    lib.csv_dims.argtypes = [ctypes.c_char_p, i64, ctypes.c_int,
+                             ctypes.POINTER(i64), ctypes.POINTER(i64)]
+    lib.csv_dims.restype = ctypes.c_int
+    lib.csv_parse.argtypes = [ctypes.c_char_p, i64, ctypes.c_int, i64, i64,
+                              ctypes.POINTER(ctypes.c_float), ctypes.c_int]
+    lib.csv_parse.restype = ctypes.c_int
+    lib.read_file.argtypes = [ctypes.c_char_p, ctypes.c_char_p, i64]
+    lib.read_file.restype = i64
+    lib.file_size.argtypes = [ctypes.c_char_p]
+    lib.file_size.restype = i64
+    _fastio = lib
+    return _fastio

@@ -1,0 +1,154 @@
+// Native IO runtime: multithreaded CSV parsing + bulk file reading.
+//
+// Role of the reference's native data path: LightGBM/VW ingest data through
+// C++ loaders behind JNI, and NativeLoader.java extracts + System.load()s
+// the shared objects (core/env/NativeLoader.java:28-110). Here the native
+// layer feeds the columnar DataFrame: CSV bytes -> float32 column-major
+// matrix (NaN for missing/non-numeric), parallelized by row ranges.
+//
+// C ABI only (ctypes-friendly): no exceptions across the boundary.
+
+#include <atomic>
+#include <cstdint>
+#include <cstdio>
+#include <cstdlib>
+#include <cstring>
+#include <cmath>
+#include <thread>
+#include <vector>
+
+extern "C" {
+
+// Count rows (newlines outside the header) and columns in the first line.
+// Returns 0 on success.
+int csv_dims(const char* data, int64_t len, int has_header,
+             int64_t* out_rows, int64_t* out_cols) {
+    if (len <= 0) { *out_rows = 0; *out_cols = 0; return 0; }
+    int64_t cols = 1;
+    int64_t i = 0;
+    for (; i < len && data[i] != '\n'; ++i)
+        if (data[i] == ',') ++cols;
+    int64_t lines = 0;
+    const char* p = data;
+    const char* end = data + len;
+    while (p < end) {
+        const char* nl = static_cast<const char*>(
+            memchr(p, '\n', static_cast<size_t>(end - p)));
+        if (!nl) { if (end - p > 0) ++lines; break; }
+        if (nl - p > 0) ++lines;  // skip empty lines
+        p = nl + 1;
+    }
+    *out_rows = lines - (has_header ? 1 : 0);
+    *out_cols = cols;
+    return 0;
+}
+
+static inline const char* next_line(const char* p, const char* end) {
+    const char* nl = static_cast<const char*>(
+        memchr(p, '\n', static_cast<size_t>(end - p)));
+    return nl ? nl + 1 : end;
+}
+
+// Parse one row range [row_begin, row_end) starting at byte offset
+// `start` into out[row * cols + col]. Non-numeric / empty cells -> NaN.
+static void parse_range(const char* data, const char* end,
+                        const char* start, int64_t row_begin,
+                        int64_t row_end, int64_t cols, float* out) {
+    const char* p = start;
+    for (int64_t r = row_begin; r < row_end && p < end;) {
+        if (*p == '\n') { ++p; continue; }  // empty line
+        const char* line_end = static_cast<const char*>(
+            memchr(p, '\n', static_cast<size_t>(end - p)));
+        if (!line_end) line_end = end;
+        int64_t c = 0;
+        const char* cell = p;
+        while (cell <= line_end && c < cols) {
+            const char* comma = static_cast<const char*>(
+                memchr(cell, ',', static_cast<size_t>(line_end - cell)));
+            const char* cell_end = comma ? comma : line_end;
+            float v;
+            if (cell_end == cell) {
+                v = NAN;
+            } else {
+                char* parsed_end = nullptr;
+                v = strtof(cell, &parsed_end);
+                if (parsed_end == cell) v = NAN;
+            }
+            out[r * cols + c] = v;
+            ++c;
+            if (!comma) break;
+            cell = comma + 1;
+        }
+        for (; c < cols; ++c) out[r * cols + c] = NAN;
+        p = line_end < end ? line_end + 1 : end;
+        ++r;
+    }
+}
+
+// Parse the full CSV into a preallocated [rows, cols] float32 buffer.
+// Threads split by row ranges (each scans to its start line first).
+int csv_parse(const char* data, int64_t len, int has_header,
+              int64_t rows, int64_t cols, float* out, int n_threads) {
+    const char* end = data + len;
+    const char* body = data;
+    if (has_header) body = next_line(body, end);
+    if (rows <= 0) return 0;
+    if (n_threads <= 0) n_threads = 1;
+    if (n_threads > rows) n_threads = static_cast<int>(rows);
+
+    // find the starting byte of each thread's row range
+    std::vector<const char*> starts(static_cast<size_t>(n_threads));
+    std::vector<int64_t> row_begins(static_cast<size_t>(n_threads));
+    int64_t per = rows / n_threads;
+    {
+        const char* p = body;
+        int64_t row = 0;
+        for (int t = 0; t < n_threads; ++t) {
+            int64_t target = static_cast<int64_t>(t) * per;
+            while (row < target && p < end) {
+                if (*p != '\n') ++row;
+                else { ++p; continue; }
+                p = next_line(p, end);
+            }
+            starts[static_cast<size_t>(t)] = p;
+            row_begins[static_cast<size_t>(t)] = row;
+        }
+    }
+    std::vector<std::thread> pool;
+    for (int t = 0; t < n_threads; ++t) {
+        int64_t rb = row_begins[static_cast<size_t>(t)];
+        int64_t re = (t + 1 == n_threads) ? rows
+                     : row_begins[static_cast<size_t>(t + 1)];
+        pool.emplace_back(parse_range, data, end,
+                          starts[static_cast<size_t>(t)], rb, re, cols,
+                          out);
+    }
+    for (auto& th : pool) th.join();
+    return 0;
+}
+
+// Read a whole file into a caller buffer; returns bytes read or -1.
+int64_t read_file(const char* path, char* buf, int64_t cap) {
+    FILE* f = fopen(path, "rb");
+    if (!f) return -1;
+    int64_t total = 0;
+    while (total < cap) {
+        size_t got = fread(buf + total, 1,
+                           static_cast<size_t>(cap - total), f);
+        if (got == 0) break;
+        total += static_cast<int64_t>(got);
+    }
+    fclose(f);
+    return total;
+}
+
+int64_t file_size(const char* path) {
+    FILE* f = fopen(path, "rb");
+    if (!f) return -1;
+    fseek(f, 0, SEEK_END);
+    long sz = ftell(f);
+    fclose(f);
+    return static_cast<int64_t>(sz);
+}
+
+}  // extern "C"

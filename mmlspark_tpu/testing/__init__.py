@@ -1,0 +1,16 @@
+"""Test infrastructure: fuzzing traits + benchmark harness.
+
+Reference L11 (SURVEY §4): ``core/test/fuzzing/Fuzzing.scala`` (every stage
+gets serialization/experiment fuzzing via declared TestObjects, with
+meta-tests enforcing ecosystem-wide coverage) and
+``core/test/benchmarks/Benchmarks.scala`` (named metric values regression-
+checked against CSVs with explicit tolerance).
+"""
+
+from .fuzzing import TestObject, experiment_fuzzing, serialization_fuzzing, \
+    iter_stage_classes
+from .benchmarks import Benchmarks
+from .model_equality import assert_model_equal
+
+__all__ = ["TestObject", "experiment_fuzzing", "serialization_fuzzing",
+           "iter_stage_classes", "Benchmarks", "assert_model_equal"]

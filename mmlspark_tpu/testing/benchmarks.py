@@ -1,0 +1,63 @@
+"""Benchmark regression harness.
+
+Reference ``core/test/benchmarks/Benchmarks.scala:16-130``: named metric
+values with explicit tolerance recorded in CSVs
+(``src/test/resources/benchmarks/benchmarks_<Suite>.csv``); the test
+recomputes each metric and ``compareBenchmark`` asserts it matches within
+precision. Same CSV format here: ``name,value,precision`` rows.
+"""
+
+from __future__ import annotations
+
+import csv
+import os
+
+
+class Benchmarks:
+    """Accumulate metrics, then compare (or regenerate) the CSV."""
+
+    def __init__(self, csv_path: str):
+        self.csv_path = csv_path
+        self.recorded: list[tuple[str, float, float]] = []
+
+    def add(self, name: str, value: float, precision: float) -> None:
+        """Reference ``addBenchmark``."""
+        self.recorded.append((name, float(value), float(precision)))
+
+    def _load(self) -> dict[str, tuple[float, float]]:
+        out = {}
+        with open(self.csv_path) as f:
+            for row in csv.reader(f):
+                if not row or row[0].startswith("#"):
+                    continue
+                out[row[0]] = (float(row[1]), float(row[2]))
+        return out
+
+    def _write(self) -> None:
+        os.makedirs(os.path.dirname(self.csv_path), exist_ok=True)
+        with open(self.csv_path, "w", newline="") as f:
+            w = csv.writer(f)
+            for name, value, precision in self.recorded:
+                w.writerow([name, repr(value), repr(precision)])
+
+    def verify(self, regenerate: bool = False) -> None:
+        """Reference ``verifyBenchmarks``: assert every recorded metric is
+        within its recorded precision; regenerate=True (or a missing CSV)
+        writes the file instead — the reference's workflow for adding new
+        benchmark rows."""
+        if regenerate or not os.path.exists(self.csv_path):
+            self._write()
+            return
+        expected = self._load()
+        errors = []
+        for name, value, precision in self.recorded:
+            if name not in expected:
+                errors.append(f"missing benchmark row {name!r}")
+                continue
+            exp_val, exp_prec = expected[name]
+            if abs(value - exp_val) > exp_prec:
+                errors.append(
+                    f"{name}: got {value}, expected {exp_val} ± {exp_prec}")
+        if errors:
+            raise AssertionError("benchmark regressions:\n"
+                                 + "\n".join(errors))

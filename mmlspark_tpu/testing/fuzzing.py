@@ -1,0 +1,123 @@
+"""Fuzzing traits — the reference's signature test strategy.
+
+Reference ``core/test/fuzzing/Fuzzing.scala``:
+- ``TestObject`` (:29-45): a stage plus fit/transform DataFrames;
+- ``SerializationFuzzing`` (:222-298): save/load the stage, the fitted
+  model, and a whole pipeline; assert identical transform outputs;
+- ``ExperimentFuzzing`` (:192-220): run fit+transform, compare results;
+- ``FuzzingTest`` meta-tests (:30-200): every stage in the ecosystem has a
+  fuzzer, serializes, and has consistent param names.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import importlib
+import inspect
+import pkgutil
+import tempfile
+from typing import Any
+
+import numpy as np
+
+from ..core import DataFrame, Estimator, Transformer, load_stage
+from ..core.pipeline import Model, PipelineStage
+
+
+@dataclasses.dataclass
+class TestObject:
+    """Stage + data (reference ``TestObject[S]``)."""
+    __test__ = False  # not itself a pytest collectible
+
+    stage: Any
+    fit_df: DataFrame
+    transform_df: DataFrame | None = None
+
+    @property
+    def df(self) -> DataFrame:
+        return self.transform_df if self.transform_df is not None \
+            else self.fit_df
+
+
+def _df_equal(a: DataFrame, b: DataFrame, rtol=1e-5) -> None:
+    assert list(a.columns) == list(b.columns), (a.columns, b.columns)
+    for c in a.columns:
+        ca, cb = a[c], b[c]
+        if getattr(ca, "dtype", None) == object or not np.issubdtype(
+                np.asarray(ca).dtype, np.number):
+            assert len(ca) == len(cb)
+        else:
+            np.testing.assert_allclose(np.asarray(ca, np.float64),
+                                       np.asarray(cb, np.float64),
+                                       rtol=rtol, atol=1e-6, err_msg=c)
+
+
+def _fit_if_needed(stage, df):
+    if isinstance(stage, Estimator):
+        return stage.fit(df)
+    return stage
+
+
+def experiment_fuzzing(obj: TestObject) -> None:
+    """Fit + transform runs and is deterministic
+    (reference ``ExperimentFuzzing.testExperiments``)."""
+    model = _fit_if_needed(obj.stage, obj.fit_df)
+    out1 = model.transform(obj.df)
+    out2 = model.transform(obj.df)
+    assert len(out1) >= 0
+    _df_equal(out1, out2)
+
+
+def serialization_fuzzing(obj: TestObject) -> None:
+    """Save/load round trips preserve behavior
+    (reference ``SerializationFuzzing``)."""
+    with tempfile.TemporaryDirectory() as tmp:
+        # 1. raw stage round trip: params survive
+        obj.stage.save(f"{tmp}/stage")
+        reloaded = load_stage(f"{tmp}/stage")
+        assert type(reloaded) is type(obj.stage)
+        for p in type(obj.stage).params():
+            if not p.complex and p.name in obj.stage._paramMap:
+                assert reloaded.get(p.name) == obj.stage.get(p.name), p.name
+
+        # 2. fitted model round trip: identical transform outputs
+        model = _fit_if_needed(obj.stage, obj.fit_df)
+        out_before = model.transform(obj.df)
+        if isinstance(model, (Model, Transformer)):
+            model.save(f"{tmp}/model")
+            model2 = load_stage(f"{tmp}/model")
+            _df_equal(out_before, model2.transform(obj.df))
+
+
+_STAGE_PACKAGES = (
+    "mmlspark_tpu.stages", "mmlspark_tpu.featurize",
+    "mmlspark_tpu.lightgbm", "mmlspark_tpu.vw", "mmlspark_tpu.image",
+    "mmlspark_tpu.dl", "mmlspark_tpu.train", "mmlspark_tpu.automl",
+    "mmlspark_tpu.nn", "mmlspark_tpu.recommendation",
+    "mmlspark_tpu.isolationforest", "mmlspark_tpu.lime",
+    "mmlspark_tpu.cyber", "mmlspark_tpu.cognitive", "mmlspark_tpu.io.http",
+)
+
+
+def iter_stage_classes():
+    """Every concrete public stage class in the framework — the meta-test
+    enumeration (reference ``FuzzingTest`` pipelineStages reflection)."""
+    seen = set()
+    for pkg_name in _STAGE_PACKAGES:
+        pkg = importlib.import_module(pkg_name)
+        modules = [pkg]
+        if hasattr(pkg, "__path__"):
+            for info in pkgutil.iter_modules(pkg.__path__):
+                modules.append(importlib.import_module(
+                    f"{pkg_name}.{info.name}"))
+        for mod in modules:
+            for _, cls in inspect.getmembers(mod, inspect.isclass):
+                if (issubclass(cls, PipelineStage)
+                        and not cls.__name__.startswith("_")
+                        and not inspect.isabstract(cls)
+                        and cls.__module__.startswith("mmlspark_tpu")
+                        and cls not in seen
+                        and cls not in (Transformer, Estimator, Model,
+                                        PipelineStage)):
+                    seen.add(cls)
+                    yield cls

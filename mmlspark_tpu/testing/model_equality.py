@@ -1,0 +1,25 @@
+"""Model equality assertion.
+
+Reference ``core/utils/ModelEquality.scala`` — used by generated Python
+tests to assert a stage and its (re)loaded counterpart are equivalent
+(``fuzzing/Fuzzing.scala:166-172``).
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+
+def assert_model_equal(a, b) -> None:
+    """Same class, same simple params, same complex-param array content."""
+    assert type(a) is type(b), (type(a), type(b))
+    for p in type(a).params():
+        in_a, in_b = p.name in a._paramMap, p.name in b._paramMap
+        assert in_a == in_b, f"param {p.name} set in only one model"
+        if not in_a:
+            continue
+        va, vb = a.get(p.name), b.get(p.name)
+        if isinstance(va, np.ndarray):
+            np.testing.assert_allclose(va, np.asarray(vb), rtol=1e-6)
+        elif not p.complex:
+            assert va == vb, f"param {p.name}: {va!r} != {vb!r}"

@@ -1,0 +1,5 @@
+"""Cross-cutting utilities: profiling/tracing."""
+
+from .profiling import profile_trace, profiled, StageTimer
+
+__all__ = ["profile_trace", "profiled", "StageTimer"]

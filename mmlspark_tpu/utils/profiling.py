@@ -1,0 +1,60 @@
+"""Profiling & tracing.
+
+The reference has no tracer (SURVEY §5) — only the ``Timer`` transformer
+and VW's nanosecond stopwatches. The TPU build upgrades this to
+``jax.profiler`` device traces (viewable in XProf/TensorBoard) plus the
+same stage-timing surface.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import functools
+import time
+
+
+@contextlib.contextmanager
+def profile_trace(log_dir: str, *, host_tracer_level: int = 2):
+    """Capture a device+host trace for the enclosed region
+    (``jax.profiler.trace`` wrapper; open with XProf/TensorBoard)."""
+    import jax
+    jax.profiler.start_trace(log_dir, create_perfetto_link=False)
+    try:
+        yield
+    finally:
+        jax.profiler.stop_trace()
+
+
+def profiled(name: str | None = None):
+    """Decorator: annotate a function in device traces
+    (``jax.profiler.TraceAnnotation``) and record wall time."""
+    def wrap(fn):
+        label = name or fn.__qualname__
+
+        @functools.wraps(fn)
+        def inner(*args, **kwargs):
+            import jax
+            with jax.profiler.TraceAnnotation(label):
+                return fn(*args, **kwargs)
+        return inner
+    return wrap
+
+
+class StageTimer:
+    """Accumulate named wall-clock spans (the VW ``TrainingStats``
+    nanosecond-timing surface, ``vw/VowpalWabbitBase.scala:27-49``)."""
+
+    def __init__(self):
+        self.totals_ns: dict[str, int] = {}
+
+    @contextlib.contextmanager
+    def span(self, name: str):
+        t0 = time.perf_counter_ns()
+        try:
+            yield
+        finally:
+            self.totals_ns[name] = self.totals_ns.get(name, 0) + \
+                time.perf_counter_ns() - t0
+
+    def as_dict(self) -> dict[str, float]:
+        return {k: v / 1e9 for k, v in self.totals_ns.items()}

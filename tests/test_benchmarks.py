@@ -1,0 +1,85 @@
+"""Accuracy-parity benchmark harness (reference
+``core/test/benchmarks/Benchmarks.scala`` + the
+``benchmarks_VerifyLightGBMClassifier.csv`` pattern): metric values are
+regression-checked against committed CSVs with explicit tolerances.
+
+Synthetic datasets are deterministic (seeded), so metric drift signals a
+behavioral change in the engine — the same role the reference's blob
+datasets play in its CI.
+"""
+
+import os
+
+import numpy as np
+import pytest
+
+from mmlspark_tpu.core import DataFrame
+from mmlspark_tpu.lightgbm import LightGBMClassifier, LightGBMRegressor
+from mmlspark_tpu.lightgbm.trainer import roc_auc
+from mmlspark_tpu.testing import Benchmarks
+from mmlspark_tpu.vw import VowpalWabbitClassifier, VowpalWabbitFeaturizer
+
+RESOURCE_DIR = os.path.join(os.path.dirname(__file__), "resources",
+                            "benchmarks")
+REGEN = os.environ.get("MMLSPARK_TPU_REGEN_BENCHMARKS") == "1"
+
+
+def tabular(n=2000, seed=0):
+    rng = np.random.default_rng(seed)
+    x = rng.normal(size=(n, 12)).astype(np.float32)
+    logits = x[:, 0] * 2 - x[:, 1] + 0.5 * x[:, 2] * x[:, 3] + \
+        np.sin(x[:, 4])
+    y_cls = (logits + rng.normal(scale=0.4, size=n) > 0).astype(np.float32)
+    y_reg = (logits + rng.normal(scale=0.2, size=n)).astype(np.float32)
+    return x, y_cls, y_reg
+
+
+class TestLightGBMBenchmarks:
+    def test_classifier_auc(self):
+        b = Benchmarks(os.path.join(RESOURCE_DIR,
+                                    "benchmarks_LightGBMClassifier.csv"))
+        x, y, _ = tabular()
+        df = DataFrame({"features": x, "label": y})
+        for boosting in ("gbdt", "goss", "dart", "rf"):
+            kw = {"boostingType": boosting, "numIterations": 40,
+                  "numShards": 1, "seed": 0}
+            if boosting == "rf":
+                kw.update(baggingFraction=0.8, baggingFreq=1)
+            model = LightGBMClassifier(**kw).fit(df)
+            auc = roc_auc(y, model.transform(df)["probability"][:, 1])
+            b.add(f"synthetic.{boosting}", auc, 0.015)
+        b.verify(regenerate=REGEN)
+
+    def test_regressor_rmse(self):
+        b = Benchmarks(os.path.join(RESOURCE_DIR,
+                                    "benchmarks_LightGBMRegressor.csv"))
+        x, _, y = tabular(seed=1)
+        df = DataFrame({"features": x, "label": y})
+        for objective in ("regression", "regression_l1", "huber"):
+            model = LightGBMRegressor(
+                objective=objective, numIterations=40, numShards=1,
+                seed=0).fit(df)
+            pred = model.transform(df)["prediction"]
+            rmse = float(np.sqrt(np.mean((pred - y) ** 2)))
+            b.add(f"synthetic.{objective}", rmse, 0.1)
+        b.verify(regenerate=REGEN)
+
+
+class TestVWBenchmarks:
+    def test_classifier_auc(self):
+        b = Benchmarks(os.path.join(
+            RESOURCE_DIR, "benchmarks_VowpalWabbitClassifier.csv"))
+        rng = np.random.default_rng(2)
+        n = 2000
+        x = rng.normal(size=(n, 10)).astype(np.float32)
+        y = ((x[:, 0] - x[:, 1] + 0.5 * x[:, 2]
+              + rng.normal(scale=0.3, size=n)) > 0).astype(np.float32)
+        df = DataFrame({"features": x, "label": y})
+        for args, tag in [("", "default"), ("--l1 1e-7", "l1"),
+                          ("-l 0.2 --passes 4", "lr_passes")]:
+            model = VowpalWabbitClassifier(
+                args=args, numPasses=4, batchSize=128,
+                numShards=1).fit(df)
+            auc = roc_auc(y, model.transform(df)["probability"][:, 1])
+            b.add(f"synthetic.{tag}", auc, 0.02)
+        b.verify(regenerate=REGEN)

@@ -1,0 +1,70 @@
+"""DL checkpoint/resume + profiling utilities."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+
+from mmlspark_tpu.dl.checkpoint import CheckpointManager
+from mmlspark_tpu.dl.train import init_train_state, make_train_step
+from mmlspark_tpu.models.resnet import BasicBlock, ResNet
+from mmlspark_tpu.utils import StageTimer, profiled
+
+
+def tiny():
+    return ResNet(stage_sizes=(1,), block=BasicBlock, width=8,
+                  num_classes=2, dtype=jnp.float32)
+
+
+class TestCheckpoint:
+    def test_save_restore_resume(self, tmp_path):
+        module, tx = tiny(), optax.sgd(1e-2)
+        rng = np.random.default_rng(0)
+        x = rng.normal(size=(4, 8, 8, 3)).astype(np.float32)
+        y = np.asarray([0, 1, 0, 1], np.int32)
+        state = init_train_state(module, jax.random.PRNGKey(0), x[:1], tx)
+        step = make_train_step(module, tx)
+        for _ in range(3):
+            state, _ = step(state, jnp.asarray(x), jnp.asarray(y))
+
+        mgr = CheckpointManager(str(tmp_path / "ckpt"), max_to_keep=2)
+        mgr.save(state)
+        assert mgr.latest_step() == 3
+
+        restored = mgr.restore()
+        jax.tree.map(np.testing.assert_allclose,
+                     jax.tree.map(np.asarray, state.params),
+                     restored.params)
+        # training resumes from the restored state
+        restored, loss = step(restored, jnp.asarray(x), jnp.asarray(y))
+        assert np.isfinite(float(loss)) and int(restored.step) == 4
+
+    def test_retention(self, tmp_path):
+        module, tx = tiny(), optax.sgd(1e-2)
+        state = init_train_state(module, jax.random.PRNGKey(0),
+                                 np.zeros((1, 8, 8, 3), np.float32), tx)
+        mgr = CheckpointManager(str(tmp_path / "ckpt"), max_to_keep=2)
+        for s in (1, 2, 3, 4):
+            mgr.save(state, step=s)
+        assert mgr.all_steps() == [3, 4]
+
+
+class TestProfiling:
+    def test_stage_timer(self):
+        t = StageTimer()
+        with t.span("a"):
+            sum(range(1000))
+        with t.span("a"):
+            pass
+        with t.span("b"):
+            pass
+        d = t.as_dict()
+        assert set(d) == {"a", "b"} and d["a"] >= 0
+
+    def test_profiled_annotation_runs(self):
+        @profiled("test_fn")
+        def f(v):
+            return jnp.sum(v)
+
+        out = f(jnp.ones(8))
+        assert float(out) == 8.0

@@ -1,0 +1,233 @@
+"""Ecosystem-wide fuzzing — the reference's signature test strategy
+(``core/test/fuzzing/Fuzzing.scala`` + ``FuzzingTest.scala`` meta-tests):
+every stage serializes, round-trips, and transforms deterministically."""
+
+import numpy as np
+import pytest
+
+from mmlspark_tpu.core import DataFrame
+from mmlspark_tpu.testing import (TestObject, experiment_fuzzing,
+                                  iter_stage_classes, serialization_fuzzing)
+
+
+def _num_df(n=40, f=4, seed=0, label=True):
+    rng = np.random.default_rng(seed)
+    x = rng.normal(size=(n, f)).astype(np.float32)
+    d = {"features": x}
+    if label:
+        d["label"] = (x[:, 0] > 0).astype(np.float32)
+    return DataFrame(d)
+
+
+def _str_col(values):
+    col = np.empty(len(values), object)
+    col[:] = values
+    return col
+
+
+def make_test_objects() -> dict[str, TestObject]:
+    """TestObjects keyed by stage class name (reference testObjects())."""
+    from mmlspark_tpu.featurize import (CleanMissingData, CountSelector,
+                                        Featurize, ValueIndexer)
+    from mmlspark_tpu.featurize.text import (HashingTF, IDF, MultiNGram,
+                                             PageSplitter, TextFeaturizer,
+                                             Tokenizer, NGram)
+    from mmlspark_tpu.stages.misc import EnsembleByKey
+    from mmlspark_tpu.image import (ImageSetAugmenter, ImageTransformer,
+                                    ResizeImageTransformer, UnrollImage)
+    from mmlspark_tpu.isolationforest import IsolationForest
+    from mmlspark_tpu.lightgbm import (LightGBMClassifier, LightGBMRanker,
+                                       LightGBMRegressor)
+    from mmlspark_tpu.nn import KNN
+    from mmlspark_tpu.recommendation import SAR
+    from mmlspark_tpu.stages import (Cacher, ClassBalancer, DropColumns,
+                                     DynamicMiniBatchTransformer, Explode,
+                                     FixedMiniBatchTransformer,
+                                     FlattenBatch, MultiColumnAdapter,
+                                     PartitionConsolidator, RenameColumn,
+                                     Repartition, SelectColumns,
+                                     StratifiedRepartition, SummarizeData,
+                                     TextPreprocessor, Timer,
+                                     UnicodeNormalize)
+    from mmlspark_tpu.train import (ComputeModelStatistics,
+                                    ComputePerInstanceStatistics)
+    from mmlspark_tpu.vw import (VowpalWabbitClassifier,
+                                 VowpalWabbitFeaturizer,
+                                 VowpalWabbitRegressor)
+
+    rng = np.random.default_rng(7)
+    num = _num_df()
+    text_df = DataFrame({"text": _str_col(
+        ["the quick brown fox", "jumps over the dog"] * 5)})
+    img_df = DataFrame({"image": rng.integers(
+        0, 255, size=(4, 8, 8, 3)).astype(np.float32)})
+    cat_df = DataFrame({"cat": _str_col(["a", "b", "a", "c"] * 5),
+                        "num": rng.normal(size=20).astype(np.float32),
+                        "label": (np.arange(20) % 2).astype(np.float32)})
+    scored_df = DataFrame({
+        "label": (np.arange(20) % 2).astype(np.float64),
+        "prediction": (np.arange(20) % 2).astype(np.float64),
+        "probability": np.stack([np.linspace(0.9, 0.1, 20),
+                                 np.linspace(0.1, 0.9, 20)], axis=1)})
+    rank_df = DataFrame({
+        "features": rng.normal(size=(24, 3)).astype(np.float32),
+        "label": rng.integers(0, 3, 24).astype(np.float32),
+        "group": np.repeat([0, 1, 2], 8)})
+    sar_df = DataFrame({"user": np.repeat(np.arange(6), 3),
+                        "item": np.tile(np.arange(3), 6),
+                        "rating": np.ones(18, np.float32)})
+    missing = num.with_column(
+        "features", np.where(rng.random((40, 4)) < 0.2, np.nan,
+                             num["features"]).astype(np.float32))
+
+    objs = [
+        TestObject(DropColumns(cols=["label"]), num),
+        TestObject(SelectColumns(cols=["features"]), num),
+        TestObject(RenameColumn(inputCol="label", outputCol="y"), num),
+        TestObject(Repartition(n=2), num),
+        TestObject(Cacher(), num),
+        TestObject(Timer(stage=DropColumns(cols=["label"])), num),
+        TestObject(SummarizeData(), num),
+        TestObject(ClassBalancer(inputCol="label"), num),
+        TestObject(StratifiedRepartition(labelCol="label"), num),
+        TestObject(TextPreprocessor(inputCol="text", outputCol="clean",
+                                    map={"quick": "slow"}), text_df),
+        TestObject(UnicodeNormalize(inputCol="text", outputCol="norm"),
+                   text_df),
+        TestObject(Explode(inputCol="tokens", outputCol="tok"),
+                   Tokenizer(inputCol="text",
+                             outputCol="tokens").transform(text_df)),
+        TestObject(MultiColumnAdapter(
+            baseStage=RenameColumn(inputCol="in", outputCol="out"),
+            inputCols=["features"], outputCols=["f2"]), num),
+        TestObject(FixedMiniBatchTransformer(batchSize=4), num),
+        TestObject(DynamicMiniBatchTransformer(), num),
+        TestObject(FlattenBatch(),
+                   FixedMiniBatchTransformer(batchSize=4).transform(num)),
+        TestObject(PartitionConsolidator(), num),
+        TestObject(Featurize(inputCols=["cat", "num"]), cat_df),
+        TestObject(ValueIndexer(inputCol="cat", outputCol="idx"), cat_df),
+        TestObject(CleanMissingData(inputCols=["features"],
+                                    outputCols=["features"]), missing),
+        TestObject(CountSelector(inputCol="features",
+                                 outputCol="sel"), num),
+        TestObject(Tokenizer(inputCol="text", outputCol="tok"), text_df),
+        TestObject(NGram(inputCol="tok", outputCol="ngrams", n=2),
+                   Tokenizer(inputCol="text",
+                             outputCol="tok").transform(text_df)),
+        TestObject(HashingTF(inputCol="tok", outputCol="tf", numFeatures=64),
+                   Tokenizer(inputCol="text",
+                             outputCol="tok").transform(text_df)),
+        TestObject(IDF(inputCol="tf", outputCol="idf"),
+                   HashingTF(inputCol="tok", outputCol="tf",
+                             numFeatures=64).transform(
+                       Tokenizer(inputCol="text",
+                                 outputCol="tok").transform(text_df))),
+        TestObject(TextFeaturizer(inputCol="text", outputCol="feats",
+                                  numFeatures=64), text_df),
+        TestObject(LightGBMClassifier(numIterations=3, numShards=1), num),
+        TestObject(LightGBMRegressor(numIterations=3, numShards=1), num),
+        TestObject(LightGBMRanker(numIterations=3, numShards=1,
+                                  groupCol="group"), rank_df),
+        TestObject(VowpalWabbitFeaturizer(inputCols=["cat", "num"]),
+                   cat_df),
+        TestObject(VowpalWabbitClassifier(numPasses=2, numBits=8,
+                                          numShards=1), num),
+        TestObject(VowpalWabbitRegressor(numPasses=2, numBits=8,
+                                         numShards=1), num),
+        TestObject(ImageTransformer().resize(4, 4), img_df),
+        TestObject(EnsembleByKey(keys=["label"], cols=["features"]), num),
+        TestObject(MultiNGram(inputCol="tok", outputCol="grams",
+                              lengths=[1, 2]),
+                   Tokenizer(inputCol="text",
+                             outputCol="tok").transform(text_df)),
+        TestObject(PageSplitter(inputCol="text", outputCol="pages",
+                                maximumPageLength=10), text_df),
+        TestObject(ResizeImageTransformer(height=4, width=4), img_df),
+        TestObject(UnrollImage(), img_df),
+        TestObject(ImageSetAugmenter(), img_df),
+        TestObject(KNN(k=2), num),
+        TestObject(SAR(supportThreshold=1), sar_df),
+        TestObject(IsolationForest(numEstimators=5), num),
+        TestObject(ComputeModelStatistics(labelCol="label"), scored_df),
+        TestObject(ComputePerInstanceStatistics(labelCol="label"),
+                   scored_df),
+    ]
+    return {type(o.stage).__name__: o for o in objs}
+
+
+_OBJECTS = make_test_objects()
+
+# Stages legitimately excluded from generic fuzzing (need live services,
+# a model argument, or are facades over other fuzzed stages) — the
+# reference keeps a similar exclusion list in FuzzingTest.scala:30-60.
+_EXCLUDED = {
+    # cognitive/HTTP: require a live endpoint
+    "CognitiveServiceBase", "TextSentiment", "KeyPhraseExtractor", "NER",
+    "EntityDetector", "LanguageDetector", "AnalyzeImage", "DescribeImage",
+    "OCR", "RecognizeText", "RecognizeDomainSpecificContent",
+    "GenerateThumbnails", "TagImage", "DetectFace", "FindSimilarFace",
+    "GroupFaces", "IdentifyFaces", "VerifyFaces", "DetectAnomalies",
+    "DetectLastAnomaly", "BingImageSearch", "SpeechToText",
+    "SpeechToTextSDK", "HTTPTransformer", "SimpleHTTPTransformer",
+    "JSONInputParser", "JSONOutputParser", "CustomInputParser",
+    "CustomOutputParser",
+    # need a function/model/stage argument; fuzzed via dedicated tests
+    "UDFTransformer", "Lambda", "TPUModel", "ImageFeaturizer",
+    "TrainClassifier", "TrainRegressor", "TrainedClassifierModel",
+    "TrainedRegressorModel", "TuneHyperparameters", "FindBestModel",
+    "ConditionalKNN", "TabularLIME", "ImageLIME", "TextLIME",
+    "SuperpixelTransformer", "RankingAdapter",
+    "RankingTrainValidationSplit", "VowpalWabbitContextualBandit",
+    "VowpalWabbitInteractions", "UnrollBinaryImage", "DataConversion",
+    "IndexToValue", "TimeIntervalMiniBatchTransformer",
+    # cyber: need tenant-keyed inputs; fuzzed in test_cyber
+    "IdIndexer", "StandardScalarScaler", "LinearScalarScaler",
+    "AccessAnomaly", "ComplementAccessTransformer",
+    "RecommendationIndexer",
+    # models produced by estimators (covered via their estimators)
+}
+
+
+@pytest.mark.parametrize("name", sorted(_OBJECTS))
+def test_experiment_fuzzing(name):
+    experiment_fuzzing(_OBJECTS[name])
+
+
+@pytest.mark.parametrize("name", sorted(_OBJECTS))
+def test_serialization_fuzzing(name):
+    serialization_fuzzing(_OBJECTS[name])
+
+
+class TestMetaFuzzing:
+    """Reference ``FuzzingTest.scala:30-200`` ecosystem invariants."""
+
+    def test_every_stage_is_fuzzed_or_excluded(self):
+        missing = []
+        for cls in iter_stage_classes():
+            name = cls.__name__
+            if name.endswith("Model"):
+                continue  # models are reached through their estimators
+            if name not in _OBJECTS and name not in _EXCLUDED:
+                missing.append(name)
+        assert not missing, (
+            f"stages with no fuzzing TestObject and no exclusion: "
+            f"{sorted(missing)}")
+
+    def test_param_names_match_attributes(self):
+        """Param attribute name == Param.name for every stage
+        (reference 'params are correctly named' invariant)."""
+        bad = []
+        for cls in iter_stage_classes():
+            for klass in cls.__mro__:
+                for attr, value in vars(klass).items():
+                    from mmlspark_tpu.core import Param
+                    if isinstance(value, Param) and value.name != attr:
+                        bad.append(f"{cls.__name__}.{attr} -> {value.name}")
+        assert not bad, bad
+
+    def test_stage_count_is_substantial(self):
+        # the reference wraps ~120 stages; keep an inventory floor so
+        # regressions in package discovery are caught
+        count = len(list(iter_stage_classes()))
+        assert count >= 90, f"only {count} stages discovered"

@@ -1,0 +1,112 @@
+"""Native IO runtime + codegen bindings."""
+
+import os
+
+import numpy as np
+import pytest
+
+from mmlspark_tpu.native import get_fastio, parse_csv_bytes, read_csv
+from mmlspark_tpu.codegen import generate_all, param_type_hint, py_stub_for
+
+
+CSV = b"a,b,label\n1.5,2,0\n3,,1\n5,x,0\n"
+
+
+class TestNativeCSV:
+    def test_library_builds(self):
+        assert get_fastio() is not None, "g++ build failed"
+
+    def test_parse_matches_numpy(self):
+        mat, names = parse_csv_bytes(CSV)
+        assert names == ["a", "b", "label"]
+        np.testing.assert_allclose(mat[:, 0], [1.5, 3, 5])
+        assert np.isnan(mat[1, 1]) and np.isnan(mat[2, 1])  # missing + str
+        np.testing.assert_allclose(mat[:, 2], [0, 1, 0])
+
+    def test_large_multithreaded(self):
+        rng = np.random.default_rng(0)
+        data = rng.normal(size=(20_000, 6)).astype(np.float32)
+        lines = ["c0,c1,c2,c3,c4,c5"]
+        lines += [",".join(f"{v:.6g}" for v in row) for row in data]
+        blob = ("\n".join(lines) + "\n").encode()
+        mat, _ = parse_csv_bytes(blob, n_threads=8)
+        assert mat.shape == (20_000, 6)
+        np.testing.assert_allclose(mat, data, rtol=1e-4)
+
+    def test_read_csv_features_assembly(self, tmp_path):
+        p = tmp_path / "t.csv"
+        p.write_bytes(CSV)
+        df = read_csv(str(p), features_col="features", label_col="label")
+        assert df["features"].shape == (3, 2)
+        np.testing.assert_allclose(df["label"], [0, 1, 0])
+
+    def test_read_csv_string_cols(self, tmp_path):
+        p = tmp_path / "t.csv"
+        p.write_bytes(b"name,v\nfoo,1\nbar,2\n")
+        df = read_csv(str(p), string_cols=("name",))
+        assert df["name"].tolist() == ["foo", "bar"]
+        np.testing.assert_allclose(df["v"], [1, 2])
+
+    def test_native_end_to_end_train(self, tmp_path):
+        from mmlspark_tpu.lightgbm import LightGBMClassifier
+        rng = np.random.default_rng(1)
+        x = rng.normal(size=(400, 5))
+        y = (x[:, 0] > 0).astype(int)
+        lines = ["f0,f1,f2,f3,f4,label"]
+        lines += [",".join(f"{v:.6g}" for v in row) + f",{t}"
+                  for row, t in zip(x, y)]
+        p = tmp_path / "train.csv"
+        p.write_bytes(("\n".join(lines) + "\n").encode())
+        df = read_csv(str(p), features_col="features", label_col="label")
+        model = LightGBMClassifier(numIterations=10, numShards=1).fit(df)
+        acc = (model.transform(df)["prediction"] == df["label"]).mean()
+        assert acc > 0.9
+
+
+class TestCodegen:
+    def test_param_type_hints(self):
+        from mmlspark_tpu.lightgbm import LightGBMClassifier
+        params = {p.name: p for p in LightGBMClassifier.params()}
+        assert param_type_hint(params["numIterations"]) == "int"
+        assert param_type_hint(params["learningRate"]) == "float"
+        assert param_type_hint(params["boostingType"]) == "str"
+
+    def test_stub_rendering(self):
+        from mmlspark_tpu.lightgbm import LightGBMClassifier
+        stub = py_stub_for(LightGBMClassifier)
+        assert "def setNumIterations(self, value: int)" in stub
+        assert "def getNumIterations(self) -> int" in stub
+
+    def test_service_param_col_accessors_in_stub(self):
+        from mmlspark_tpu.cognitive import TextSentiment
+        stub = py_stub_for(TextSentiment)
+        assert "def setTextCol(self, col: str)" in stub
+
+    def test_generate_all(self, tmp_path):
+        out = generate_all(str(tmp_path))
+        assert len(out["stubs"]) > 20
+        api = open(out["docs"]).read()
+        assert "LightGBMClassifier" in api and "| `numIterations` |" in api
+        # stubs parse as valid python and every base name resolves (via a
+        # real import or a class defined in the same stub)
+        import ast
+        for s in out["stubs"]:
+            tree = ast.parse(open(s).read())
+            imported, defined, used_bases = set(), set(), set()
+            for node in ast.walk(tree):
+                if isinstance(node, ast.ImportFrom):
+                    imported |= {a.name for a in node.names}
+                elif isinstance(node, ast.ClassDef):
+                    defined.add(node.name)
+                    used_bases |= {b.id for b in node.bases
+                                   if isinstance(b, ast.Name)}
+            unresolved = used_bases - imported - defined - {"object"}
+            assert not unresolved, (s, unresolved)
+
+    def test_quoted_csv_single_discipline(self, tmp_path):
+        # quoted commas: numeric and string views must agree
+        p = tmp_path / "q.csv"
+        p.write_bytes(b'name,v\n"a,b",1\nplain,2\n')
+        df = read_csv(str(p), string_cols=("name",))
+        assert df["name"].tolist() == ["a,b", "plain"]
+        np.testing.assert_allclose(df["v"], [1, 2])
