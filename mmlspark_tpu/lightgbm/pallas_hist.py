@@ -1,0 +1,82 @@
+"""Pallas TPU kernel: masked histogram build.
+
+The hot op of GBDT training (SURVEY §7.4 hard part #1): accumulate
+(grad, hess, count) into per-(feature, bin) cells. XLA lowers the
+scatter-add formulation poorly on TPU (serialized updates); the TPU-native
+formulation is a one-hot contraction on the MXU:
+
+    for each feature f, row block R:
+        onehot[r, b] = (bins[r, f] == b)           # [block, B] VPU compare
+        hist[f] += onehotᵀ @ vals                  # [B, 3] MXU contraction
+
+Grid = (F, row_blocks); each feature's output block accumulates across the
+row-block grid dimension (revisited output block, init on first visit).
+
+Used automatically by the trainer when running on TPU; the scatter-add
+path remains the CPU/interpret fallback.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+
+def _hist_kernel(bins_ref, vals_ref, out_ref, *, num_bins: int):
+    """One (feature, row-block) cell: accumulate one-hot contraction."""
+    rb = pl.program_id(1)
+
+    @pl.when(rb == 0)
+    def _init():
+        out_ref[:] = jnp.zeros_like(out_ref)
+
+    bins_col = bins_ref[:]                     # [block, 1] int32
+    vals = vals_ref[:]                         # [block, 3] f32
+    bin_ids = jax.lax.broadcasted_iota(
+        jnp.int32, (bins_col.shape[0], num_bins), 1)
+    onehot = (bins_col == bin_ids).astype(jnp.float32)   # [block, B]
+    # [B, block] @ [block, 3] on the MXU
+    acc = jax.lax.dot_general(
+        onehot, vals, (((0,), (0,)), ((), ())),
+        preferred_element_type=jnp.float32)              # [B, 3]
+    out_ref[0] = out_ref[0] + acc
+
+
+@functools.partial(jax.jit,
+                   static_argnames=("num_bins", "block_rows", "interpret"))
+def hist_pallas(bins: jnp.ndarray, vals: jnp.ndarray, *, num_bins: int,
+                block_rows: int = 2048,
+                interpret: bool = False) -> jnp.ndarray:
+    """bins u8/i32 [n, F], vals f32 [n, 3] (pre-masked) → [F, B, 3]."""
+    n, F = bins.shape
+    n_pad = (-n) % block_rows
+    if n_pad:
+        # pad bins with an out-of-range id so padded rows hit no bin
+        bins = jnp.pad(bins.astype(jnp.int32), ((0, n_pad), (0, 0)),
+                       constant_values=num_bins)
+        vals = jnp.pad(vals, ((0, n_pad), (0, 0)))
+    nb = bins.shape[0] // block_rows
+
+    return pl.pallas_call(
+        functools.partial(_hist_kernel, num_bins=num_bins),
+        out_shape=jax.ShapeDtypeStruct((F, num_bins, 3), jnp.float32),
+        grid=(F, nb),
+        in_specs=[
+            pl.BlockSpec((block_rows, 1), lambda f, r: (r, f)),
+            pl.BlockSpec((block_rows, 3), lambda f, r: (r, 0)),
+        ],
+        out_specs=pl.BlockSpec((1, num_bins, 3), lambda f, r: (f, 0, 0)),
+        interpret=interpret,
+    )(bins.astype(jnp.int32), vals)
+
+
+def use_pallas_hist() -> bool:
+    """TPU only — the scatter path wins on CPU."""
+    try:
+        return jax.default_backend() in ("tpu", "axon")
+    except Exception:
+        return False

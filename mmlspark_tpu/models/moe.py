@@ -1,0 +1,78 @@
+"""Mixture-of-Experts layer with expert parallelism over the ``ep`` axis.
+
+No reference counterpart (SURVEY §2.14: EP absent there). Dense-dispatch
+top-1 MoE: every device holds E/n local experts, receives the full token
+batch (replicated), computes its experts' contributions for the tokens
+routed to them, and a ``psum`` combines — router and combine are einsums
+that XLA maps onto the MXU.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import PartitionSpec as P
+
+
+def init_moe_params(rng, num_experts: int, d_model: int, d_hidden: int):
+    k1, k2, k3 = jax.random.split(rng, 3)
+    scale = d_model ** -0.5
+    return {
+        "router": jax.random.normal(k1, (d_model, num_experts)) * scale,
+        "w_in": jax.random.normal(
+            k2, (num_experts, d_model, d_hidden)) * scale,
+        "w_out": jax.random.normal(
+            k3, (num_experts, d_hidden, d_model)) * (d_hidden ** -0.5),
+    }
+
+
+def moe_forward(params, x):
+    """Single-device reference: x [T, D] → [T, D], top-1 routing."""
+    logits = x @ params["router"]                     # [T, E]
+    expert = jnp.argmax(logits, axis=-1)
+    gate = jax.nn.softmax(logits, axis=-1)
+    gate_top = jnp.take_along_axis(gate, expert[:, None], axis=1)[:, 0]
+    dispatch = jax.nn.one_hot(expert, logits.shape[-1])   # [T, E]
+    h = jnp.einsum("te,td,edh->teh", dispatch, x, params["w_in"])
+    h = jax.nn.gelu(h)
+    y = jnp.einsum("teh,ehd->td", h, params["w_out"])
+    return y * gate_top[:, None]
+
+
+def make_sharded_moe(mesh, *, axis: str = "ep"):
+    """Expert-parallel forward: experts shard over ``axis``; tokens are
+    replicated in, outputs psum-combined."""
+    n = int(mesh.shape[axis])
+
+    def local(params, x):
+        # params' expert dims are local shards [E/n, ...]; the router
+        # column block is this shard's experts
+        shard = jax.lax.axis_index(axis)
+        logits_local = x @ params["router"]           # [T, E/n]
+        # global top-1 routing needs all logits: gather over the axis
+        logits = jax.lax.all_gather(logits_local, axis, axis=1,
+                                    tiled=True)       # [T, E]
+        E = logits.shape[-1]
+        e_per = E // n
+        expert = jnp.argmax(logits, axis=-1)          # [T]
+        gate = jax.nn.softmax(logits, axis=-1)
+        gate_top = jnp.take_along_axis(gate, expert[:, None],
+                                       axis=1)[:, 0]
+        local_expert = expert - shard * e_per
+        mine = (local_expert >= 0) & (local_expert < e_per)
+        dispatch = jax.nn.one_hot(
+            jnp.where(mine, local_expert, 0), e_per) \
+            * mine[:, None]                           # [T, E/n]
+        h = jnp.einsum("te,td,edh->teh", dispatch, x, params["w_in"])
+        h = jax.nn.gelu(h)
+        y = jnp.einsum("teh,ehd->td", h, params["w_out"])
+        y = y * gate_top[:, None]
+        return jax.lax.psum(y, axis)
+
+    spec = {"router": P(None, axis), "w_in": P(axis),
+            "w_out": P(axis)}
+    return jax.shard_map(local, mesh=mesh, in_specs=(spec, P()),
+                         out_specs=P(), check_vma=False)

@@ -1,0 +1,83 @@
+"""Pipeline parallelism: GPipe-style microbatch schedule over the ``pp``
+mesh axis.
+
+No reference counterpart (SURVEY §2.14: PP absent there) — this is part of
+the TPU-native extension that makes large in-framework models trainable.
+Each device holds ONE stage's parameters; microbatches enter stage 0 and
+activations flow around the ring by ``ppermute``, so at steady state every
+stage computes a different microbatch each tick (the classic
+(M + S - 1)-step schedule with bubble fraction (S-1)/(M+S-1)).
+
+Stages must share activation shapes (uniform-width blocks), the usual
+constraint for homogeneous pipeline demos.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import PartitionSpec as P
+
+
+def pipeline_apply(mesh, stage_fn, stacked_params, microbatches,
+                   *, axis: str = "pp"):
+    """Run microbatches through S = mesh.shape[axis] pipeline stages.
+
+    stage_fn(params_i, h) -> h'  applied by stage i; ``stacked_params`` has
+    leading dim S (stage-major, sharded over ``axis``); ``microbatches``
+    is [M, mb, ...] (replicated). Returns [M, mb, ...] outputs of the last
+    stage.
+    """
+    S = int(mesh.shape[axis])
+    M = microbatches.shape[0]
+    T = M + S - 1
+
+    def body(params_local, xs):
+        params_local = jax.tree.map(lambda p: p[0], params_local)
+        stage = jax.lax.axis_index(axis)
+        h = jnp.zeros_like(xs[0])
+        outs = jnp.zeros_like(xs)
+
+        def tick(t, carry):
+            h_in, outs = carry
+            # stage 0 ingests microbatch t (while available)
+            mb = jnp.clip(t, 0, M - 1)
+            inject = jnp.where(stage == 0,
+                               jnp.where(t < M, 1.0, 0.0), 0.0)
+            h_cur = inject * xs[mb] + (1.0 - inject) * h_in
+            h_out = stage_fn(params_local, h_cur)
+            # last stage emits microbatch t-(S-1)
+            emit_idx = jnp.clip(t - (S - 1), 0, M - 1)
+            emit = (stage == S - 1) & (t >= S - 1)
+            outs = jax.lax.cond(
+                emit,
+                lambda o: jax.lax.dynamic_update_slice(
+                    o, h_out[None], (emit_idx,) + (0,) * h_out.ndim),
+                lambda o: o, outs)
+            # rotate activations forward around the ring
+            perm = [(i, (i + 1) % S) for i in range(S)]
+            h_next = jax.lax.ppermute(h_out, axis, perm)
+            return h_next, outs
+
+        _, outs = jax.lax.fori_loop(0, T, tick, (h, outs))
+        # every shard returns its buffer; only the last stage's is real —
+        # broadcast it to all shards so the output is replicated
+        last = jax.lax.psum(
+            outs * (stage == S - 1).astype(outs.dtype), axis)
+        return last
+
+    return jax.shard_map(
+        body, mesh=mesh,
+        in_specs=(P(axis), P()), out_specs=P(),
+        check_vma=False)(stacked_params, microbatches)
+
+
+def make_pipeline_mlp(width: int):
+    """A uniform-width residual MLP block for pipeline demos/tests:
+    params = (W [width, width], b [width])."""
+    def stage_fn(params, h):
+        W, b = params
+        return h + jnp.tanh(h @ W + b)
+    return stage_fn
