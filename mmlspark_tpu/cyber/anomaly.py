@@ -144,13 +144,15 @@ class ComplementAccessTransformer(Transformer):
             seen = set(zip(*(data[c][m] for c in cols)))
             maxes = {c: int(data[c][m].max()) for c in cols}
             want = int(m.sum()) * self.get("complementsetFactor")
+            produced = 0  # per-tenant quota, not the global row count
             tries = 0
-            while len(out_rows[tcol]) < want and tries < want * 20:
+            while produced < want and tries < want * 20:
                 tries += 1
                 cand = tuple(int(rng.integers(1, maxes[c] + 1))
                              for c in cols)
                 if cand not in seen:
                     seen.add(cand)
+                    produced += 1
                     out_rows[tcol].append(t)
                     for c, v in zip(cols, cand):
                         out_rows[c].append(v)

@@ -56,14 +56,38 @@ class CheckpointManager:
         self._retain()
         return path
 
-    def restore(self, step: int | None = None) -> TrainState:
+    def restore(self, step: int | None = None,
+                target: TrainState | None = None) -> TrainState:
+        """Restore a checkpoint.
+
+        ``target`` is a reference TrainState (e.g. a freshly initialized
+        one) whose pytree STRUCTURE the restored arrays are poured into.
+        Without it, orbax returns plain dicts/lists — fine for params and
+        batch_stats, but optax opt_states are namedtuples (e.g.
+        ``ScaleByAdamState``), so resuming adam/momentum without a target
+        would silently hand the optimizer the wrong container types. Pass
+        the live state for anything beyond stateless optimizers.
+        """
         import orbax.checkpoint as ocp
         step = self.latest_step() if step is None else step
         if step is None:
             raise FileNotFoundError(
                 f"no checkpoints under {self.directory}")
         with ocp.PyTreeCheckpointer() as ck:
-            tree = ck.restore(self._step_dir(step))
+            if target is None:
+                tree = ck.restore(self._step_dir(step))
+            else:
+                # read shape/dtype without np.asarray: that would pull
+                # every device array to host just to inspect it
+                abstract = jax.tree.map(
+                    lambda x: jax.ShapeDtypeStruct(
+                        np.shape(x),
+                        getattr(x, "dtype", None) or np.asarray(x).dtype),
+                    {"params": target.params,
+                     "batch_stats": target.batch_stats,
+                     "opt_state": target.opt_state,
+                     "step": target.step})
+                tree = ck.restore(self._step_dir(step), item=abstract)
         return TrainState(params=tree["params"],
                           batch_stats=tree["batch_stats"],
                           opt_state=tree["opt_state"], step=tree["step"])

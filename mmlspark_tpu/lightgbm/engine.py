@@ -19,11 +19,28 @@ split finding, tree growth — reached through ``LGBM_BoosterUpdateOneIter`` at
   LightGBM's data_partition, but as dense int32 arrays.
 
 Distributed training (SURVEY §2.13): the only cross-device exchange GBDT
-needs is the histogram reduction. ``grow_tree`` takes a ``psum_axis``; when
-run under ``shard_map`` with rows sharded over that axis, the single
-``lax.psum`` on the [L,F,B,3] histogram IS the reference's
-``LGBM_NetworkInit`` + socket allreduce (``TrainUtils.scala:609-625``),
-riding ICI instead of TCP.
+needs is histogram information. ``grow_tree`` takes a ``psum_axis``; when
+run under ``shard_map`` with rows sharded over that axis, the histogram
+reduction IS the reference's ``LGBM_NetworkInit`` + socket allreduce
+(``TrainUtils.scala:609-625``), riding ICI instead of TCP. Two modes match
+the reference's ``parallelism`` selector (``params/LightGBMParams.scala:16-21``,
+``LightGBMConstants.scala:24-26``):
+
+- ``data`` (data_parallel): the full [F, B, 3] histogram of each new leaf
+  is ``psum``-reduced;
+- ``voting`` (voting_parallel, PV-Tree): each shard nominates its local
+  top-K features per new leaf, votes are ``psum``-merged, and only the
+  global top-2K candidate feature columns ([2K, B, 3]) are reduced — the
+  histogram state itself stays shard-local. Per split this exchanges
+  ``comm_elements_per_split`` elements, a large reduction for wide
+  feature spaces (the regime the reference reserves voting for).
+
+SPMD-safety invariant: every collective (the histogram psum, the vote
+psum, the candidate-column psum) executes UNCONDITIONALLY on every
+``fori_loop`` iteration, outside any data-dependent ``lax.cond`` — when no
+split applies the inputs are zero-masked instead of skipped. A collective
+under a data-dependent branch is one refactor away from a cross-shard
+deadlock; this engine keeps the lockstep property by construction.
 """
 
 from __future__ import annotations
@@ -47,6 +64,8 @@ class TreeParams(NamedTuple):
     min_data_in_leaf: int = 20
     min_sum_hessian_in_leaf: float = 1e-3
     min_gain_to_split: float = 0.0
+    parallelism: str = "data"    # data | voting (PV-Tree top-K)
+    top_k: int = 20              # voting: local nominations per shard
 
 
 class Tree(NamedTuple):
@@ -77,6 +96,38 @@ def _leaf_gain(g, h, p: TreeParams):
     return t * t / (h + p.lambda_l2 + 1e-35)
 
 
+def comm_elements_per_split(num_features: int, num_bins: int,
+                            top_k: int, parallelism: str) -> int:
+    """Histogram elements exchanged over the mesh per split (per shard).
+
+    data_parallel reduces the new leaf's full histogram; voting_parallel
+    reduces one vote row plus 2K candidate columns for each of the two
+    children (PV-Tree). This is the quantity the distributed test asserts
+    shrinks under voting.
+    """
+    if parallelism == "voting":
+        cand = min(2 * top_k, num_features)
+        return 2 * (num_features + cand * num_bins * 3)
+    return num_features * num_bins * 3
+
+
+def _split_stats(hist, p: TreeParams):
+    """[..., B, 3] histogram(s) → per-bin split stats.
+
+    Returns (gl, hl, cl, gr, hr, cr, gain), each [..., B]: left stats are
+    cumulative (split = "bin <= b goes left"), right = totals - left.
+    """
+    cum = jnp.cumsum(hist, axis=-2)
+    gl, hl, cl = cum[..., 0], cum[..., 1], cum[..., 2]
+    tot = cum[..., -1:, :]
+    gr = tot[..., 0] - gl
+    hr = tot[..., 1] - hl
+    cr = tot[..., 2] - cl
+    gain = (_leaf_gain(gl, hl, p) + _leaf_gain(gr, hr, p)
+            - _leaf_gain(tot[..., 0], tot[..., 1], p))
+    return gl, hl, cl, gr, hr, cr, gain
+
+
 @functools.partial(
     jax.jit,
     static_argnames=("params", "num_features", "psum_axis"))
@@ -97,6 +148,8 @@ def grow_tree(bins: jnp.ndarray, grad: jnp.ndarray, hess: jnp.ndarray,
     NN = 2 * L - 1
     B = p.max_bin + 1  # bin 0 = missing
     max_depth = p.max_depth if p.max_depth and p.max_depth > 0 else 10 ** 9
+    voting = p.parallelism == "voting" and psum_axis is not None
+    C = min(2 * p.top_k, F)  # global candidate features per leaf (voting)
 
     g = grad * row_mask
     h = hess * row_mask
@@ -125,15 +178,6 @@ def grow_tree(bins: jnp.ndarray, grad: jnp.ndarray, hess: jnp.ndarray,
         num_nodes=jnp.int32(1),
     )
 
-    state = {
-        "tree": tree,
-        "slot": jnp.zeros(n, jnp.int32),         # per-row leaf slot
-        "slot_node": jnp.zeros(L, jnp.int32),    # slot -> node id
-        "slot_depth": jnp.zeros(L, jnp.int32),
-        "n_slots": jnp.int32(1),
-        "done": jnp.asarray(False),
-    }
-
     feat_offsets = (jnp.arange(F, dtype=jnp.int32) * B)[None, :]  # [1, F]
     gh1 = jnp.stack([g, h, cnt_w], axis=1)  # [n, 3]
     bin_idx = feat_offsets + bins.astype(jnp.int32)        # [n, F]
@@ -144,132 +188,208 @@ def grow_tree(bins: jnp.ndarray, grad: jnp.ndarray, hess: jnp.ndarray,
     except Exception:  # pragma: no cover - pallas unavailable
         pallas_ok = False
 
-    def masked_hist(row_sel):
-        """Histogram of one row subset → [F, B, 3]: the LightGBM
-        single-leaf ConstructHistogram. On TPU this is the Pallas one-hot
-        MXU kernel; elsewhere one scatter-add over [F*B] keys."""
+    def local_hist(row_sel):
+        """SHARD-LOCAL histogram of one row subset → [F, B, 3]: the
+        LightGBM single-leaf ConstructHistogram. On TPU this is the Pallas
+        one-hot MXU kernel; elsewhere one scatter-add over [F*B] keys.
+        Callers psum (or vote-and-gather) the result as the mode demands —
+        never this function, so it can run under ``lax.cond`` safely."""
         masked = gh1 * row_sel[:, None]
         if pallas_ok:
-            return psum(hist_pallas(bins, masked, num_bins=B))
+            return hist_pallas(bins, masked, num_bins=B)
         vals = jnp.broadcast_to(masked[:, None, :], (n, F, 3))
         hist = jnp.zeros((F * B, 3), jnp.float32)
         hist = hist.at[bin_idx.reshape(-1)].add(vals.reshape(-1, 3))
-        return psum(hist.reshape(F, B, 3))
+        return hist.reshape(F, B, 3)
 
-    # root histogram: every (unmasked) row is in slot 0. Subsequent splits
-    # scatter only the smaller child and derive the larger by subtraction —
-    # LightGBM's histogram-subtraction trick, which cuts per-tree histogram
-    # work from O(L·n·F) to O(n·F·avg_depth).
-    hist0 = jnp.zeros((L, F, B, 3), jnp.float32).at[0].set(
-        masked_hist(jnp.ones_like(row_mask)))
-    state = {**state, "hist": hist0}
+    def local_top_features(hists):
+        """[M, F, B, 3] local hists → bool votes [M, F]: each shard
+        nominates its top-K features by local best-bin gain (PV-Tree local
+        voting), honouring the feature_fraction mask."""
+        *_, gain = _split_stats(hists, p)                  # [M, F, B]
+        fgain = jnp.max(gain, axis=-1)                     # [M, F]
+        fgain = jnp.where(feature_mask[None, :], fgain, -jnp.inf)
+        _, top_idx = jax.lax.top_k(fgain, min(p.top_k, F))  # [M, k]
+        return jnp.zeros_like(fgain).at[
+            jnp.arange(fgain.shape[0])[:, None], top_idx].set(1.0)
 
-    def split_step(_, state):
-        def do_split(state):
+    def vote_and_gather(hists):
+        """[M, F, B, 3] local hists → global candidates for M leaves:
+        (cand_feat [M, C] i32, cand_hist [M, C, B, 3] globally reduced).
+        Runs the two collectives of voting mode; must be called
+        unconditionally."""
+        votes = psum(local_top_features(hists))            # [M, F]
+        _, cand = jax.lax.top_k(votes, C)                  # [M, C]
+        cand = cand.astype(jnp.int32)
+        cols = jnp.take_along_axis(
+            hists, cand[:, :, None, None], axis=1)         # [M, C, B, 3]
+        return cand, psum(cols)
+
+    # ---- root histogram: every (unmasked) row is in slot 0. Subsequent
+    # splits scatter only the smaller child and derive the larger by
+    # subtraction — LightGBM's histogram-subtraction trick, which cuts
+    # per-tree histogram work from O(L·n·F) to O(n·F·avg_depth).
+    h_root = local_hist(jnp.ones_like(row_mask))
+    if voting:
+        hist0 = jnp.zeros((L, F, B, 3), jnp.float32).at[0].set(h_root)
+        cand0, cand_hist0 = vote_and_gather(h_root[None])
+        cand_feat = jnp.zeros((L, C), jnp.int32).at[0].set(cand0[0])
+        cand_hist = jnp.zeros((L, C, B, 3), jnp.float32).at[0].set(
+            cand_hist0[0])
+    else:
+        hist0 = jnp.zeros((L, F, B, 3), jnp.float32).at[0].set(psum(h_root))
+        cand_feat = jnp.zeros((L, 0), jnp.int32)           # unused
+        cand_hist = jnp.zeros((L, 0, B, 3), jnp.float32)   # unused
+
+    state = {
+        "tree": tree,
+        "slot": jnp.zeros(n, jnp.int32),         # per-row leaf slot
+        "slot_node": jnp.zeros(L, jnp.int32),    # slot -> node id
+        "slot_depth": jnp.zeros(L, jnp.int32),
+        "n_slots": jnp.int32(1),
+        "done": jnp.asarray(False),
+        "hist": hist0,          # data: global; voting: shard-local
+        "cand_feat": cand_feat,
+        "cand_hist": cand_hist,
+    }
+
+    def split_body(state):
+        tree = state["tree"]
+        slot_ids = jnp.arange(L)
+        active = slot_ids < state["n_slots"]
+        deep_ok = state["slot_depth"] < max_depth
+
+        # ---- find the best (slot, feature, bin) from GLOBAL histogram
+        # information — bitwise-identical on every shard, so every derived
+        # predicate below is shard-uniform.
+        if voting:
+            search = state["cand_hist"]                    # [L, C, B, 3]
+            n_search = C
+        else:
+            search = state["hist"]                         # [L, F, B, 3]
+            n_search = F
+        gl, hl, cl, gr, hr, cr, gain = _split_stats(search, p)
+        if voting:
+            feat_ok = feature_mask[state["cand_feat"]][:, :, None]
+        else:
+            feat_ok = feature_mask[None, :, None]
+        valid = (
+            active[:, None, None] & deep_ok[:, None, None] & feat_ok
+            & (cl >= p.min_data_in_leaf) & (cr >= p.min_data_in_leaf)
+            & (hl >= p.min_sum_hessian_in_leaf)
+            & (hr >= p.min_sum_hessian_in_leaf)
+            & (state["n_slots"] < L))
+        gain = jnp.where(valid, gain, -jnp.inf)
+
+        flat_best = jnp.argmax(gain)
+        s_star = (flat_best // (n_search * B)).astype(jnp.int32)
+        j_star = ((flat_best // B) % n_search).astype(jnp.int32)
+        b_star = (flat_best % B).astype(jnp.int32)
+        best_gain = gain.reshape(-1)[flat_best]
+        f_star = state["cand_feat"][s_star, j_star] if voting else j_star
+        found = (best_gain > p.min_gain_to_split) & ~state["done"]
+
+        # global child stats of the chosen split
+        lg = gl[s_star, j_star, b_star]
+        lh = hl[s_star, j_star, b_star]
+        lc = cl[s_star, j_star, b_star]
+        tg = lg + gr[s_star, j_star, b_star]
+        th = lh + hr[s_star, j_star, b_star]
+        tc = lc + cr[s_star, j_star, b_star]
+        rg, rh, rc = tg - lg, th - lh, tc - lc
+
+        # ---- row routing + the UNCONDITIONAL histogram work. When no
+        # split applies, sel is all-zero: the scatter/psum still executes
+        # (lockstep) but the results are discarded by the cond below.
+        new_slot = state["n_slots"]
+        row_bin = jnp.take(bins, f_star, axis=1).astype(jnp.int32)
+        in_parent = (state["slot"] == s_star) & found
+        goes_right = in_parent & (row_bin > b_star)
+        use_left = lc <= rc  # scatter the smaller child, derive sibling
+        sel = jnp.where(use_left, in_parent & ~goes_right, goes_right)
+        h_small = local_hist(sel.astype(jnp.float32))
+        if not voting:
+            h_small = psum(h_small)
+        parent_h = state["hist"][s_star]
+        h_other = parent_h - h_small
+        h_left = jnp.where(use_left, h_small, h_other)
+        h_right = jnp.where(use_left, h_other, h_small)
+
+        if voting:
+            # nominate + reduce candidate columns for both children —
+            # collectives outside the cond, zero-data when not found
+            child_cand, child_glob = vote_and_gather(
+                jnp.stack([h_left, h_right]))
+
+        def apply(state):
             tree = state["tree"]
-            hist = state["hist"]                           # [L, F, B, 3]
-            cum = jnp.cumsum(hist, axis=2)                 # left stats
-            gl, hl, cl = cum[..., 0], cum[..., 1], cum[..., 2]
-            tot = cum[:, :, -1:, :]                        # totals per (L,F)
-            gr = tot[..., 0] - gl
-            hr = tot[..., 1] - hl
-            cr = tot[..., 2] - cl
+            parent = state["slot_node"][s_star]
+            nl = tree.num_nodes
+            nr = tree.num_nodes + 1
 
-            gain_l = _leaf_gain(gl, hl, p)
-            gain_r = _leaf_gain(gr, hr, p)
-            gain_p = _leaf_gain(tot[..., 0], tot[..., 1], p)
-            gain = gain_l + gain_r - gain_p                # [L, F, B]
+            new_tree = Tree(
+                feature=tree.feature.at[parent].set(f_star),
+                split_bin=tree.split_bin.at[parent].set(b_star),
+                left=tree.left.at[parent].set(nl),
+                right=tree.right.at[parent].set(nr),
+                leaf_value=tree.leaf_value
+                    .at[nl].set(p.learning_rate * _leaf_output(lg, lh, p))
+                    .at[nr].set(p.learning_rate * _leaf_output(rg, rh, p)),
+                is_leaf=tree.is_leaf.at[parent].set(False)
+                    .at[nl].set(True).at[nr].set(True),
+                split_gain=tree.split_gain.at[parent].set(best_gain),
+                node_value=tree.node_value
+                    .at[nl].set(_leaf_output(lg, lh, p))
+                    .at[nr].set(_leaf_output(rg, rh, p)),
+                node_weight=tree.node_weight.at[nl].set(lh).at[nr].set(rh),
+                node_count=tree.node_count.at[nl].set(lc).at[nr].set(rc),
+                num_nodes=tree.num_nodes + 2,
+            )
 
-            slot_ids = jnp.arange(L)
-            active = slot_ids < state["n_slots"]
-            deep_ok = state["slot_depth"] < max_depth
-            valid = (
-                active[:, None, None] & deep_ok[:, None, None]
-                & feature_mask[None, :, None]
-                & (cl >= p.min_data_in_leaf) & (cr >= p.min_data_in_leaf)
-                & (hl >= p.min_sum_hessian_in_leaf)
-                & (hr >= p.min_sum_hessian_in_leaf)
-                & (state["n_slots"] < L))
-            gain = jnp.where(valid, gain, -jnp.inf)
+            slot = jnp.where(goes_right, new_slot, state["slot"])
+            new_hist = state["hist"].at[s_star].set(h_left) \
+                .at[new_slot].set(h_right)
+            depth = state["slot_depth"][s_star] + 1
+            out = {
+                "tree": new_tree,
+                "slot": slot,
+                "slot_node": state["slot_node"]
+                    .at[s_star].set(nl).at[new_slot].set(nr),
+                "slot_depth": state["slot_depth"]
+                    .at[s_star].set(depth).at[new_slot].set(depth),
+                "n_slots": state["n_slots"] + 1,
+                "done": jnp.asarray(False),
+                "hist": new_hist,
+                "cand_feat": state["cand_feat"],
+                "cand_hist": state["cand_hist"],
+            }
+            if voting:
+                out["cand_feat"] = state["cand_feat"] \
+                    .at[s_star].set(child_cand[0]) \
+                    .at[new_slot].set(child_cand[1])
+                out["cand_hist"] = state["cand_hist"] \
+                    .at[s_star].set(child_glob[0]) \
+                    .at[new_slot].set(child_glob[1])
+            return out
 
-            flat_best = jnp.argmax(gain)
-            s_star = flat_best // (F * B)
-            f_star = (flat_best // B) % F
-            b_star = flat_best % B
-            best_gain = gain.reshape(-1)[flat_best]
-            found = best_gain > p.min_gain_to_split
+        def no_split(state):
+            return {**state, "done": jnp.asarray(True)}
 
-            def apply(state):
-                tree = state["tree"]
-                parent = state["slot_node"][s_star]
-                nl = tree.num_nodes
-                nr = tree.num_nodes + 1
+        # pure arithmetic only — every collective already ran above
+        return jax.lax.cond(found, apply, no_split, state)
 
-                lg = gl[s_star, f_star, b_star]
-                lh = hl[s_star, f_star, b_star]
-                lc = cl[s_star, f_star, b_star]
-                tg = tot[s_star, f_star, 0, 0]
-                th = tot[s_star, f_star, 0, 1]
-                tc = tot[s_star, f_star, 0, 2]
-                rg, rh, rc = tg - lg, th - lh, tc - lc
-
-                new_tree = Tree(
-                    feature=tree.feature.at[parent].set(f_star),
-                    split_bin=tree.split_bin.at[parent].set(b_star),
-                    left=tree.left.at[parent].set(nl),
-                    right=tree.right.at[parent].set(nr),
-                    leaf_value=tree.leaf_value
-                        .at[nl].set(p.learning_rate * _leaf_output(lg, lh, p))
-                        .at[nr].set(p.learning_rate * _leaf_output(rg, rh, p)),
-                    is_leaf=tree.is_leaf.at[parent].set(False)
-                        .at[nl].set(True).at[nr].set(True),
-                    split_gain=tree.split_gain.at[parent].set(best_gain),
-                    node_value=tree.node_value
-                        .at[nl].set(_leaf_output(lg, lh, p))
-                        .at[nr].set(_leaf_output(rg, rh, p)),
-                    node_weight=tree.node_weight.at[nl].set(lh).at[nr].set(rh),
-                    node_count=tree.node_count.at[nl].set(lc).at[nr].set(rc),
-                    num_nodes=tree.num_nodes + 2,
-                )
-
-                new_slot = state["n_slots"]
-                row_bin = jnp.take(bins, f_star, axis=1).astype(jnp.int32)
-                in_parent = state["slot"] == s_star
-                goes_right = in_parent & (row_bin > b_star)
-                slot = jnp.where(goes_right, new_slot, state["slot"])
-
-                # histogram subtraction: scatter only the smaller child,
-                # derive the sibling from the parent
-                use_left = lc <= rc
-                sel = jnp.where(use_left, in_parent & ~goes_right,
-                                goes_right)
-                h_small = masked_hist(sel.astype(jnp.float32))
-                parent_h = state["hist"][s_star]
-                h_other = parent_h - h_small
-                h_left = jnp.where(use_left, h_small, h_other)
-                h_right = jnp.where(use_left, h_other, h_small)
-                new_hist = state["hist"].at[s_star].set(h_left) \
-                    .at[new_slot].set(h_right)
-
-                depth = state["slot_depth"][s_star] + 1
-                return {
-                    "tree": new_tree,
-                    "slot": slot,
-                    "slot_node": state["slot_node"]
-                        .at[s_star].set(nl).at[new_slot].set(nr),
-                    "slot_depth": state["slot_depth"]
-                        .at[s_star].set(depth).at[new_slot].set(depth),
-                    "n_slots": state["n_slots"] + 1,
-                    "done": jnp.asarray(False),
-                    "hist": new_hist,
-                }
-
-            def no_split(state):
-                return {**state, "done": jnp.asarray(True)}
-
-            return jax.lax.cond(found, apply, no_split, state)
-
-        return jax.lax.cond(state["done"], lambda s: s, do_split, state)
+    if psum_axis is None:
+        # single-device: no collectives exist, so the lockstep rule does
+        # not apply — skip the whole body (including the O(n·F) histogram
+        # scatter) once the tree stops splitting
+        def split_step(_, state):
+            return jax.lax.cond(state["done"], lambda s: s, split_body,
+                                state)
+    else:
+        # distributed: the body must run on every iteration on every
+        # shard so its collectives stay in lockstep
+        def split_step(_, state):
+            return split_body(state)
 
     state = jax.lax.fori_loop(0, L - 1, split_step, state)
     row_leaf = state["slot_node"][state["slot"]]

@@ -116,6 +116,10 @@ class LightGBMLearnerParams:
     isProvideTrainingMetric = Param("isProvideTrainingMetric",
                                     "record metrics on training data",
                                     TC.toBoolean, default=False)
+    evalFreq = Param("evalFreq",
+                     "evaluate metrics every k iterations (k>1 removes the "
+                     "per-iteration device sync; early stopping counts "
+                     "evaluations)", TC.toInt, default=1)
 
 
 class LightGBMSharedParams(LightGBMExecutionParams, LightGBMLearnerParams,
@@ -154,5 +158,8 @@ class LightGBMSharedParams(LightGBMExecutionParams, LightGBMLearnerParams,
             metric=self.getMetric(),
             is_provide_training_metric=self.getIsProvideTrainingMetric(),
             verbosity=self.getVerbosity(),
+            eval_freq=self.getEvalFreq(),
+            parallelism=self.getParallelism(),
+            top_k=self.getTopK(),
             fobj=self.get("fobj"),
         )

@@ -94,7 +94,17 @@ class ModelDownloader:
             "MMLSPARK_TPU_MODEL_DIR", "")
 
     def download_by_name(self, name: str, *, num_classes: int | None = None,
-                         dtype=None) -> LoadedModel:
+                         dtype=None,
+                         allow_random_init: bool | None = None) -> LoadedModel:
+        """Resolve ``name`` to a ready model.
+
+        ``allow_random_init``: when no checkpoint is found locally, True
+        falls back to deterministic random init (useful for shape checks
+        and architecture tests); False raises; None (default) reads the
+        ``MMLSPARK_TPU_ALLOW_RANDOM_INIT`` env toggle (default allow,
+        with a warning). The reference fails loudly when its download
+        cannot be verified (``ModelDownloader.scala:37-60``).
+        """
         schema = get_model(name)
         kwargs = {}
         if num_classes is not None:
@@ -102,7 +112,7 @@ class ModelDownloader:
         if dtype is not None:
             kwargs["dtype"] = dtype
         module = schema.builder(**kwargs)
-        variables = self._load_or_init(schema, module)
+        variables = self._load_or_init(schema, module, allow_random_init)
         return LoadedModel(schema=schema, module=module, variables=variables)
 
     # -- weights ------------------------------------------------------------
@@ -112,7 +122,8 @@ class ModelDownloader:
         path = os.path.join(self.local_dir, schema.name)
         return path if os.path.isdir(path) else None
 
-    def _load_or_init(self, schema: ModelSchema, module) -> dict:
+    def _load_or_init(self, schema: ModelSchema, module,
+                      allow_random_init: bool | None = None) -> dict:
         path = self._ckpt_path(schema)
         if path:
             def restore():
@@ -121,8 +132,36 @@ class ModelDownloader:
                     return ck.restore(path)
             # reference retries downloads with backoff
             return retry_with_timeout(restore, retries=3)
+        if allow_random_init is None:
+            allow_random_init = os.environ.get(
+                "MMLSPARK_TPU_ALLOW_RANDOM_INIT", "1") != "0"
+            if allow_random_init:
+                import warnings
+                warnings.warn(
+                    f"no checkpoint for {schema.name!r} under "
+                    f"{self.local_dir or '<unset MMLSPARK_TPU_MODEL_DIR>'}; "
+                    "initializing RANDOM weights (shape-correct, not "
+                    "pretrained). Pass allow_random_init=True to silence, "
+                    "or point MMLSPARK_TPU_MODEL_DIR at a checkpoint tree.",
+                    stacklevel=3)
+        if not allow_random_init:
+            raise FileNotFoundError(
+                f"no local checkpoint for model {schema.name!r} "
+                f"(looked under {self.local_dir or '<unset>'}) and "
+                "allow_random_init is False; convert weights with "
+                "mmlspark_tpu.models.convert and set MMLSPARK_TPU_MODEL_DIR")
         rng = jax.random.PRNGKey(
             int(hashlib.md5(schema.name.encode()).hexdigest()[:8], 16))
         dummy = np.zeros((1, schema.input_size, schema.input_size, 3),
                          np.float32)
-        return jax.jit(module.init, static_argnums=2)(rng, dummy, False)
+        # init on host CPU when available: jitting module.init through a
+        # remote-compile TPU tunnel is slow and can wedge; weights move to
+        # device on first jitted apply (or an explicit device_put).
+        # JAX_PLATFORMS may exclude cpu, in which case use the default.
+        import contextlib
+        try:
+            ctx = jax.default_device(jax.local_devices(backend="cpu")[0])
+        except RuntimeError:
+            ctx = contextlib.nullcontext()
+        with ctx:
+            return jax.jit(module.init, static_argnums=2)(rng, dummy, False)

@@ -79,6 +79,16 @@ class ServingServer:
 
         class Handler(BaseHTTPRequestHandler):
             def _serve(self):
+                # route on the service path like the reference WorkerServer
+                # (continuous/HTTPSourceV2.scala PublicHandler): anything
+                # not addressed to this service's api_path is 404, never
+                # queued.
+                path = self.path.split("?", 1)[0].rstrip("/") or "/"
+                if path != serving.api_path:
+                    self.send_response(404)
+                    self.send_header("Content-Length", "0")
+                    self.end_headers()
+                    return
                 length = int(self.headers.get("Content-Length", 0))
                 body = self.rfile.read(length) if length else None
                 req = HTTPRequestData(

@@ -13,15 +13,25 @@ import numpy as np
 
 from ..core import Transformer, Param, TypeConverters as TC
 from ..core.contracts import HasInputCols, HasOutputCol
-from .murmur import quadratic_hash
+from .murmur import interaction_hash
 
 
 class VowpalWabbitInteractions(Transformer, HasInputCols, HasOutputCol):
     """Inputs are padded-COO column pairs (``<col>_indices``/``_values``)
     produced by VowpalWabbitFeaturizer; output is the crossed sparse
-    columns under ``<outputCol>_indices``/``_values``."""
+    columns under ``<outputCol>_indices``/``_values``.
+
+    Index combine is the reference's FNV-1 recursion
+    (``vw/VowpalWabbitInteractions.scala:49-66``): intermediates stay
+    full 32-bit, the num_bits mask lands only on the final index.
+    Colliding crossed indices are summed (or first-kept) per the
+    ``sumCollisions`` param (``vw/VectorUtils.scala`` sortAndDistinct).
+    """
 
     numBits = Param("numBits", "log2 feature space", TC.toInt, default=18)
+    sumCollisions = Param("sumCollisions",
+                          "sum values for colliding interaction indices "
+                          "(else keep the first)", TC.toBoolean, default=True)
 
     def __init__(self, **kwargs):
         super().__init__(**kwargs)
@@ -30,6 +40,7 @@ class VowpalWabbitInteractions(Transformer, HasInputCols, HasOutputCol):
     def _transform(self, df):
         cols = self.getInputCols()
         num_bits = self.get("numBits")
+        sum_collisions = self.get("sumCollisions")
         n = len(df)
         per_col = [(np.asarray(df[f"{c}_indices"]),
                     np.asarray(df[f"{c}_values"], np.float32))
@@ -42,15 +53,19 @@ class VowpalWabbitInteractions(Transformer, HasInputCols, HasOutputCol):
                 keep = idx[r] >= 0
                 row_feats.append(list(zip(idx[r][keep].tolist(),
                                           val[r][keep].tolist())))
-            ri, rv = [], []
+            crossed: dict[int, float] = {}
             for combo in itertools.product(*row_feats):
-                h = combo[0][0]
-                v = combo[0][1]
-                for fi, fv in combo[1:]:
-                    h = quadratic_hash(h, fi, num_bits)
+                h = interaction_hash((fi for fi, _ in combo), num_bits)
+                v = 1.0
+                for _, fv in combo:
                     v *= fv
-                ri.append(h)
-                rv.append(v)
+                if h in crossed:
+                    if sum_collisions:
+                        crossed[h] += v
+                else:
+                    crossed[h] = v
+            ri = sorted(crossed)
+            rv = [crossed[i] for i in ri]
             all_i.append(ri)
             all_v.append(rv)
 

@@ -132,8 +132,16 @@ def train(indices: np.ndarray, values: np.ndarray, labels: np.ndarray,
                 upd = eta * gw * jnp.where(mask, scale, 0.0)
             else:
                 upd = eta * gw / bs
+            # Regularization follows VW's lazy/truncated-gradient scheme:
+            # a weight is decayed/shrunk only when (and as often as) it is
+            # touched, scaled by example weight — NOT the whole 2^bits
+            # vector per minibatch, which would couple the effective
+            # penalty to batch count and repeatedly shrink rare features.
+            if cfg.l1 > 0 or cfg.l2 > 0:
+                touch = jnp.zeros_like(w).at[safe.ravel()].add(
+                    jnp.where(mask, bw[:, None], 0.0).ravel())
             if cfg.l2 > 0:
-                w = w * (1.0 - eta * cfg.l2)
+                w = w * jnp.power(1.0 - eta * cfg.l2, touch)
             w = w.at[safe.ravel()].add(-upd.ravel())
             gb = jnp.sum(dl)
             if cfg.adaptive:
@@ -143,7 +151,7 @@ def train(indices: np.ndarray, values: np.ndarray, labels: np.ndarray,
                 bias = bias - eta * gb / bs
             if cfg.l1 > 0:
                 w = jnp.sign(w) * jnp.maximum(
-                    jnp.abs(w) - eta * cfg.l1, 0.0)
+                    jnp.abs(w) - eta * cfg.l1 * touch, 0.0)
             return (w, bias, g2, g2b, t), None
 
         (w, bias, g2, g2b, t0), _ = jax.lax.scan(

@@ -78,8 +78,21 @@ def namespace_hash(namespace: str, hash_seed: int = 0) -> int:
     return vw_hash(namespace, hash_seed) if namespace else hash_seed
 
 
+FNV_PRIME = 16777619
+
+
+def interaction_hash(indices, num_bits: int) -> int:
+    """VW/reference feature-interaction hash (FNV-1 combine,
+    ``vw/VowpalWabbitInteractions.scala:49-66``): starting from 0, fold
+    each constituent index with ``idx = idx * 16777619 ^ next`` in 32-bit
+    wrap-around arithmetic; the num_bits mask is applied ONLY to the
+    final combined index (intermediate combines stay full-width)."""
+    h = 0
+    for idx in indices:
+        h = ((h * FNV_PRIME) & _M32) ^ (idx & _M32)
+    return ((1 << num_bits) - 1) & h
+
+
 def quadratic_hash(idx_a: int, idx_b: int, num_bits: int) -> int:
-    """VW's feature-interaction hash: h(a) * magic ^ h(b), masked
-    (VW ``interactions.cc`` FNV-style combine, constant 0x5bd1e995)."""
-    mask = (1 << num_bits) - 1
-    return mask & (((idx_a * 0x5BD1E995) & _M32) ^ idx_b)
+    """Two-way interaction index (FNV-1 combine, final-mask only)."""
+    return interaction_hash((idx_a, idx_b), num_bits)

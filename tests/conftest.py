@@ -24,6 +24,12 @@ import pytest  # noqa: E402
 # hyperparameter set; caching keeps repeat test runs fast.
 import jax  # noqa: E402
 
+# The env var alone is NOT enough: the axon site-hook (when present)
+# overrides the platform list via config.update at register() time, which
+# takes precedence over JAX_PLATFORMS — and a wedged TPU tunnel then hangs
+# every backends() call, even for CPU-only tests. An explicit config
+# update wins over the hook's; tests must never touch the tunnel.
+jax.config.update("jax_platforms", "cpu")
 jax.config.update("jax_compilation_cache_dir", "/tmp/mmlspark_tpu_jax_cache")
 jax.config.update("jax_persistent_cache_min_compile_time_secs", 1.0)
 

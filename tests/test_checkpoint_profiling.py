@@ -39,6 +39,34 @@ class TestCheckpoint:
         restored, loss = step(restored, jnp.asarray(x), jnp.asarray(y))
         assert np.isfinite(float(loss)) and int(restored.step) == 4
 
+    def test_restore_adam_opt_state_with_target(self, tmp_path):
+        # optax adam state is a namedtuple chain (ScaleByAdamState);
+        # restoring without a target hands back plain dicts, which used to
+        # break resume for any stateful optimizer (ADVICE r1)
+        module, tx = tiny(), optax.adam(1e-3)
+        rng = np.random.default_rng(1)
+        x = rng.normal(size=(4, 8, 8, 3)).astype(np.float32)
+        y = np.asarray([0, 1, 1, 0], np.int32)
+        state = init_train_state(module, jax.random.PRNGKey(1), x[:1], tx)
+        step = make_train_step(module, tx)
+        for _ in range(2):
+            state, _ = step(state, jnp.asarray(x), jnp.asarray(y))
+
+        mgr = CheckpointManager(str(tmp_path / "ckpt"))
+        mgr.save(state)
+
+        template = init_train_state(module, jax.random.PRNGKey(2), x[:1], tx)
+        restored = mgr.restore(target=template)
+        assert jax.tree.structure(restored.opt_state) == \
+            jax.tree.structure(state.opt_state)
+        mu_live = jax.tree.leaves(state.opt_state)
+        mu_rest = jax.tree.leaves(restored.opt_state)
+        for a, b in zip(mu_live, mu_rest):
+            np.testing.assert_allclose(np.asarray(a), np.asarray(b))
+        # adam training actually resumes (would TypeError on dict state)
+        restored, loss = step(restored, jnp.asarray(x), jnp.asarray(y))
+        assert np.isfinite(float(loss)) and int(restored.step) == 3
+
     def test_retention(self, tmp_path):
         module, tx = tiny(), optax.sgd(1e-2)
         state = init_train_state(module, jax.random.PRNGKey(0),

@@ -80,3 +80,22 @@ class TestAccessAnomaly:
         seen = set(zip(df["user"].tolist(), df["res"].tolist()))
         comp_pairs = set(zip(comp["user"].tolist(), comp["res"].tolist()))
         assert comp_pairs and not (comp_pairs & seen)
+
+    def test_complement_sampler_multi_tenant_quota(self):
+        # every tenant must get its own quota — the per-tenant `want` used
+        # to be compared against the global output length, starving all
+        # tenants after the first (ADVICE r1)
+        dfs = [access_df(seed=s, tenant=t)
+               for s, t in [(0, "t0"), (1, "t1"), (2, "t2")]]
+        merged = {c: np.concatenate([d[c] for d in dfs])
+                  for c in ("tenant", "user", "res")}
+        df = DataFrame(merged)
+        comp = ComplementAccessTransformer(
+            indexedColNamesArr=["user", "res"],
+            complementsetFactor=1).transform(df)
+        tenants = comp["tenant"]
+        counts = {t: int((tenants == t).sum()) for t in ("t0", "t1", "t2")}
+        per_tenant_want = int((df["tenant"] == "t0").sum())
+        for t, c in counts.items():
+            # sampling can fall slightly short of quota, never to ~zero
+            assert c > per_tenant_want // 2, (t, counts)

@@ -116,6 +116,38 @@ class TestServing:
         finally:
             q.stop()
 
+    def test_unknown_path_404_not_queued(self):
+        # requests off the service path must 404 at the handler, never
+        # reach the queue (reference WorkerServer routes on the service
+        # path; ADVICE r1)
+        import urllib.error
+        import urllib.request
+
+        def pipeline(df):
+            replies = np.empty(len(df), object)
+            for i in range(len(df)):
+                replies[i] = string_to_response("ok")
+            return df.with_column("reply", replies)
+
+        q = serving_query("pathy", pipeline)
+        q.server.api_path = "/api/score"
+        host, port = q.server.address
+        try:
+            with pytest.raises(urllib.error.HTTPError) as exc:
+                urllib.request.urlopen(
+                    urllib.request.Request(
+                        f"http://{host}:{port}/other", data=b"{}",
+                        method="POST"), timeout=5)
+            assert exc.value.code == 404
+            assert q.server.queue.qsize() == 0
+            # the real path still works
+            req = urllib.request.Request(
+                f"http://{host}:{port}/api/score?v=1", data=b"{}",
+                method="POST")
+            assert urllib.request.urlopen(req, timeout=5).status == 200
+        finally:
+            q.stop()
+
     def test_dsl_with_model_pipeline(self):
         from mmlspark_tpu.lightgbm import LightGBMRegressor
         rng = np.random.default_rng(0)

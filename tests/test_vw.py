@@ -156,6 +156,56 @@ class TestInteractions:
         assert vals == [2.0, 3.0]
 
 
+    def test_fnv1_combine_reference_semantics(self):
+        # reference interact() (VowpalWabbitInteractions.scala:49-66):
+        # idx = (idx * 16777619) ^ next in 32-bit wrap-around, num_bits
+        # mask applied ONLY to the final combined index (ADVICE r1)
+        from mmlspark_tpu.vw.murmur import interaction_hash
+        m32 = 0xFFFFFFFF
+        a, b, c = 0x12345678, 0x0FEDCBA9, 77
+        e2 = ((a * 16777619) & m32) ^ b
+        assert interaction_hash((a, b), 30) == e2 & ((1 << 30) - 1)
+        e3 = ((e2 * 16777619) & m32) ^ c
+        assert interaction_hash((a, b, c), 18) == e3 & ((1 << 18) - 1)
+
+    def test_collisions_summed(self):
+        from mmlspark_tpu.vw.interactions import VowpalWabbitInteractions
+        # numBits=1 → only 2 possible crossed indices; 2×2 crossings must
+        # collide and their values sum (reference sortAndDistinct)
+        df = DataFrame({"a_indices": np.asarray([[3, 9]], np.int32),
+                        "a_values": np.asarray([[1.0, 2.0]], np.float32),
+                        "b_indices": np.asarray([[5, 6]], np.int32),
+                        "b_values": np.asarray([[4.0, 8.0]], np.float32)})
+        out = VowpalWabbitInteractions(
+            inputCols=["a", "b"], numBits=1).transform(df)
+        idx = out["interactions_indices"][0]
+        vals = out["interactions_values"][0]
+        live = idx >= 0
+        assert live.sum() <= 2  # deduplicated
+        assert vals[live].sum() == pytest.approx(1 * 4 + 1 * 8 + 2 * 4 + 2 * 8)
+
+
+class TestRegularization:
+    def test_untouched_weights_not_shrunk(self):
+        # VW's lazy/truncated-gradient scheme: a weight no example touches
+        # must never be decayed (ADVICE r1: blanket full-vector shrink)
+        from mmlspark_tpu.vw.learner import VWConfig, VWModelState, train
+        dim_bits = 6
+        idx = np.asarray([[1], [2]] * 20, np.int32)
+        val = np.ones((40, 1), np.float32)
+        y = np.asarray([1.0, -1.0] * 20, np.float32)
+        init = VWModelState(
+            weights=np.full(1 << dim_bits, 0.5, np.float32), bias=0.0,
+            config=VWConfig(num_bits=dim_bits))
+        cfg = VWConfig(num_bits=dim_bits, l1=0.01, l2=0.05, batch_size=8,
+                       loss_function="squared")
+        model = train(idx, val, y, None, cfg, initial=init)
+        # index 50 is never touched: exactly the initial value
+        assert model.weights[50] == pytest.approx(0.5)
+        # touched weights did move
+        assert model.weights[1] != pytest.approx(0.5)
+
+
 class TestContextualBandit:
     def test_metrics_ips_snips(self):
         m = ContextualBanditMetrics()
