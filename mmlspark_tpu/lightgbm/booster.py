@@ -76,33 +76,52 @@ class Booster:
             return self.num_trees
         return min(self.num_trees, it * self.num_class)
 
-    def raw_scores(self, x: np.ndarray,
-                   num_iteration: int | None = None) -> np.ndarray:
-        """Raw margin scores [n] or [n, K]."""
-        if self.num_trees and "feature" in self.arrays:
+    def raw_scores(self, x, num_iteration: int | None = None) -> np.ndarray:
+        """Raw margin scores [n] or [n, K]. ``x`` is a dense [n, F] matrix
+        or a ``sparse.SparseData`` (padded-COO; the reference's CSR predict
+        path, ``LightGBMBooster.scala:453-488``)."""
+        from .sparse import SparseData
+        is_sparse = isinstance(x, SparseData)
+        n_rows = x.n_rows if is_sparse else x.shape[0]
+        width = x.num_features if is_sparse else x.shape[1]
+        if self.num_trees and "feature" in self.arrays and not is_sparse:
+            # sparse input carries no fixed width — absent features read 0
             need = int(self.arrays["feature"].max()) + 1
-            if x.shape[1] < need:
+            if width < need:
                 raise ValueError(
                     f"model splits on feature {need - 1} but input has only "
-                    f"{x.shape[1]} features")
+                    f"{width} features")
         t_end = self._effective_trees(num_iteration)
         if t_end == 0:
             base = np.broadcast_to(
                 self.init_score,
-                (x.shape[0], self.num_class)).astype(np.float32)
+                (n_rows, self.num_class)).astype(np.float32)
             return base[:, 0] if self.num_class == 1 else base
-        leaf_vals = _predict_leaf_values(
-            self._device_arrays(t_end), jnp.asarray(x, jnp.float32),
-            max_depth=self.max_depth_bound)          # [n, T]
+        leaves = self._leaf_nodes(x, t_end)          # [n, T]
+        leaf_vals = jnp.asarray(self.arrays["leaf_value"][:t_end])[
+            jnp.arange(t_end)[None, :], leaves]
         w = jnp.asarray(self.tree_weights[:t_end])
         weighted = leaf_vals * w[None, :]
-        per_class = weighted.reshape(x.shape[0], -1, self.num_class)
+        per_class = weighted.reshape(n_rows, -1, self.num_class)
         scores = per_class.sum(axis=1)
         if self.average_output:
             scores = scores / (t_end // self.num_class)
         scores = scores + jnp.asarray(self.init_score).reshape(1, -1)
         out = np.asarray(scores)
         return out[:, 0] if self.num_class == 1 else out
+
+    def _leaf_nodes(self, x, t_end: int):
+        """Per-(row, tree) leaf node ids, dense or padded-COO input."""
+        from .sparse import SparseData, predict_leaf_nodes_sparse
+        if isinstance(x, SparseData):
+            return predict_leaf_nodes_sparse(
+                self._device_arrays(t_end),
+                jnp.asarray(x.indices, jnp.int32),
+                jnp.asarray(x.values, jnp.float32),
+                max_depth=self.max_depth_bound)
+        return _predict_leaf_nodes(
+            self._device_arrays(t_end), jnp.asarray(x, jnp.float32),
+            max_depth=self.max_depth_bound)
 
     def predict_leaf(self, x: np.ndarray,
                      num_iteration: int | None = None) -> np.ndarray:
@@ -112,9 +131,7 @@ class Booster:
         within each tree), matching LightGBM's predict_leaf_index semantics.
         """
         t_end = self._effective_trees(num_iteration)
-        leaves = _predict_leaf_nodes(
-            self._device_arrays(t_end), jnp.asarray(x, jnp.float32),
-            max_depth=self.max_depth_bound)          # node ids [n, T]
+        leaves = self._leaf_nodes(x, t_end)          # node ids [n, T]
         # map node id -> leaf ordinal
         is_leaf = self.arrays["is_leaf"][:t_end]
         out = np.zeros_like(np.asarray(leaves))
@@ -405,9 +422,3 @@ def _predict_leaf_nodes(tree_arrays, x, *, max_depth: int):
     return jax.lax.fori_loop(0, max_depth, step, node)
 
 
-@functools.partial(jax.jit, static_argnames=("max_depth",))
-def _predict_leaf_values(tree_arrays, x, *, max_depth: int):
-    leaves = _predict_leaf_nodes(tree_arrays, x, max_depth=max_depth)
-    leaf_value = tree_arrays[4]
-    T = leaf_value.shape[0]
-    return leaf_value[jnp.arange(T)[None, :], leaves]

@@ -22,6 +22,24 @@ from .ranker_objective import (build_group_index, make_lambdarank_grad_hess,
 from .trainer import TrainConfig, TrainResult, train
 
 
+def extract_features(df, col: str, sparse_feature_count: int = 0):
+    """Features from a DataFrame: the framework's padded-COO pair
+    (``<col>_indices``/``<col>_values``, e.g. the VW featurizer's output)
+    becomes a ``SparseData`` feeding the CSR-equivalent engine (reference
+    ``TrainUtils.scala:33-92``); otherwise a dense [n, F] matrix."""
+    from .sparse import SparseData, coalesce_coo
+    icol, vcol = f"{col}_indices", f"{col}_values"
+    if icol in df.columns and vcol in df.columns:
+        idx = np.asarray(df[icol], np.int32)
+        val = np.asarray(df[vcol], np.float32)
+        # engine invariant: unique indices per row (sumCollisions=False
+        # featurizer output may carry duplicates — merge them)
+        idx, val = coalesce_coo(idx, val)
+        F = max(sparse_feature_count, int(idx.max()) + 1)
+        return SparseData(idx, val, F)
+    return as_2d_features(df, col)
+
+
 class _LightGBMBase(Estimator, LightGBMSharedParams):
     """Template-method base (reference ``LightGBMBase.train``):
     batching → data extraction → objective config → engine train → model."""
@@ -68,17 +86,27 @@ class _LightGBMBase(Estimator, LightGBMSharedParams):
         return model
 
     def _fit_batch(self, df, init_booster: Booster | None) -> TrainResult:
+        from .sparse import SparseData
+
         # ---- split validation rows (reference validationIndicatorCol)
         valid = None
         valid_eval_fn = None
         valid_init_scores = None
         train_df = df
+        valid_df = None
         if self.isSet("validationIndicatorCol"):
             flag = np.asarray(df[self.getValidationIndicatorCol()],
                               dtype=bool)
             train_df = df.filter(~flag)
             valid_df = df.filter(flag)
-            xv = as_2d_features(valid_df, self.getFeaturesCol())
+
+        fcol = self.getFeaturesCol()
+        x = extract_features(train_df, fcol, self.getSparseFeatureCount())
+        sparse = isinstance(x, SparseData)
+        if valid_df is not None:
+            xv = extract_features(
+                valid_df, fcol,
+                x.num_features if sparse else 0)
             yv = np.asarray(valid_df[self.getLabelCol()], np.float32)
             wv = (np.asarray(valid_df[self.getWeightCol()], np.float32)
                   if self.isSet("weightCol") else None)
@@ -88,7 +116,6 @@ class _LightGBMBase(Estimator, LightGBMSharedParams):
                 valid_init_scores = np.asarray(
                     valid_df[self.getInitScoreCol()], np.float32)
 
-        x = as_2d_features(train_df, self.getFeaturesCol())
         y = np.asarray(train_df[self.getLabelCol()], np.float32)
         w = (np.asarray(train_df[self.getWeightCol()], np.float32)
              if self.isSet("weightCol") else None)
@@ -98,9 +125,11 @@ class _LightGBMBase(Estimator, LightGBMSharedParams):
 
         cfg = TrainConfig(**self._train_config_kwargs(),
                           **self._objective_config(y))
-        names = self.getSlotNames() or \
-            [f"Column_{i}" for i in range(x.shape[1])]
-        mesh = self._training_mesh(x.shape[0])
+        names = self.getSlotNames() or (
+            None if sparse else
+            [f"Column_{i}" for i in range(x.shape[1])])
+        n_rows = x.n_rows if sparse else x.shape[0]
+        mesh = self._training_mesh(n_rows)
         return train(x, y, w, cfg, valid=valid, init_booster=init_booster,
                      init_scores=init_scores,
                      valid_init_scores=valid_init_scores,
@@ -177,6 +206,13 @@ class _BoosterModelMixin:
                                   leaves.astype(np.float64))
         if self.isSet("featuresShapCol"):
             from .shap import booster_shap_values
+            from .sparse import SparseData
+            if isinstance(x, SparseData):
+                raise NotImplementedError(
+                    "featuresShapCol on padded-COO sparse input is not "
+                    "supported (a dense [n, F] SHAP matrix at 2^18 "
+                    "features would defeat the sparse path) — densify a "
+                    "feature subset first")
             shap = booster_shap_values(self.booster, x, x.shape[1])
             out = out.with_column(self.getFeaturesShapCol(), shap)
         return out
@@ -240,7 +276,8 @@ class LightGBMClassificationModel(_BoosterModelMixin, Model,
         return max(self.booster.num_class, 2)
 
     def _transform(self, df):
-        x = as_2d_features(df, self.getFeaturesCol())
+        x = extract_features(df, self.getFeaturesCol(),
+                             self.getSparseFeatureCount())
         raw = self.booster.raw_scores(x, self._num_iter())
         prob = np.asarray(self.booster.transform_scores(raw))
         if raw.ndim == 1:  # binary: expand to 2-class columns
@@ -306,7 +343,8 @@ class LightGBMRegressionModel(_BoosterModelMixin, Model,
             self.booster = booster
 
     def _transform(self, df):
-        x = as_2d_features(df, self.getFeaturesCol())
+        x = extract_features(df, self.getFeaturesCol(),
+                             self.getSparseFeatureCount())
         raw = self.booster.raw_scores(x, self._num_iter())
         pred = np.asarray(self.booster.transform_scores(raw))
         out = df.with_column(self.getPredictionCol(), pred)
@@ -380,7 +418,8 @@ class LightGBMRankerModel(_BoosterModelMixin, Model, LightGBMSharedParams,
             self.booster = booster
 
     def _transform(self, df):
-        x = as_2d_features(df, self.getFeaturesCol())
+        x = extract_features(df, self.getFeaturesCol(),
+                             self.getSparseFeatureCount())
         raw = self.booster.raw_scores(x, self._num_iter())
         out = df.with_column(self.getPredictionCol(), np.asarray(raw))
         return self._maybe_extra_outputs(out, x)

@@ -57,6 +57,14 @@ class LightGBMLearnerParams:
     maxDepth = Param("maxDepth", "max tree depth (<=0 unlimited)", TC.toInt,
                      default=-1)
     maxBin = Param("maxBin", "max feature bins", TC.toInt, default=255)
+    maxBinSparse = Param("maxBinSparse",
+                         "bin cap for padded-COO sparse features (keeps the "
+                         "O(F·bins) split-search scratch small at 2^18-dim)",
+                         TC.toInt, default=16)
+    sparseFeatureCount = Param("sparseFeatureCount",
+                               "logical feature-space width for sparse "
+                               "input (0 = max index + 1)", TC.toInt,
+                               default=0)
     binSampleCount = Param("binSampleCount",
                            "rows sampled for bin boundaries", TC.toInt,
                            default=200000)
@@ -159,6 +167,7 @@ class LightGBMSharedParams(LightGBMExecutionParams, LightGBMLearnerParams,
             is_provide_training_metric=self.getIsProvideTrainingMetric(),
             verbosity=self.getVerbosity(),
             eval_freq=self.getEvalFreq(),
+            sparse_max_bin=self.getMaxBinSparse(),
             parallelism=self.getParallelism(),
             top_k=self.getTopK(),
             fobj=self.get("fobj"),

@@ -1,0 +1,498 @@
+"""Sparse (padded-COO) training path for the histogram GBDT engine.
+
+Role of the reference's CSR dataset path (``lightgbm/TrainUtils.scala:33-92``
+``generateDenseDataset``/``generateSparseDataset`` and
+``LGBM_DatasetCreateFromCSRSpark``): high-dimensional hashed feature vectors
+— e.g. the VW featurizer's 2^numBits output — train without ever
+materializing a dense [n, F] matrix.
+
+TPU-first formulation (vs the dense engine in ``engine.py``):
+
+- data stays in the framework's padded-COO convention (``indices`` [n, W]
+  int32 with -1 padding, ``values`` [n, W] float32) — fixed shapes, so the
+  whole boosting loop jits; training memory is O(nnz) for the data plus an
+  O(F·B) *scratch* histogram (B is small for sparse data, default 16 bins),
+  never O(L·F·B) per-leaf state;
+- implicit zeros are handled LightGBM-style as a per-feature *zero bin*:
+  the histogram is built by one segment-sum over the present entries, then
+  each feature's zero bin receives ``leaf_totals - explicit_sums`` — an
+  O(F) correction instead of an O(n·F) densification;
+- per-leaf histogram state is replaced by per-leaf *best-split records*
+  (O(L) memory): when a leaf is born, its histogram is built once in
+  scratch, reduced over the mesh (data_parallel full psum, or PV-Tree
+  voting exactly as in the dense engine), its best split is recorded, and
+  the scratch is discarded. Leaf-wise growth then picks argmax over the
+  records — LightGBM's histogram *pool* collapsed to its decision-relevant
+  summary.
+
+SPMD-safety: like the dense engine, every collective (child-histogram
+psum / vote psum / candidate psum) runs UNCONDITIONALLY each loop
+iteration with zero-masked inputs when no split applies — collectives
+never sit under a data-dependent branch.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import NamedTuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from .engine import Tree, TreeParams, _leaf_output, _split_stats
+
+
+class SparseData(NamedTuple):
+    """Host-side padded-COO feature matrix.
+
+    indices: int32 [n, W], -1 = pad; values: float32 [n, W];
+    num_features: logical width F (e.g. 2^numBits for hashed features).
+
+    INVARIANT: indices are unique within each row. The engine's zero-bin
+    correction and the predictor's value lookup both assume one entry per
+    (row, feature); build instances through ``coalesce_coo`` (or
+    ``estimators.extract_features``, which calls it) when the source may
+    carry duplicates (e.g. VowpalWabbitFeaturizer(sumCollisions=False)).
+    """
+    indices: np.ndarray
+    values: np.ndarray
+    num_features: int
+
+    @property
+    def n_rows(self) -> int:
+        return self.indices.shape[0]
+
+
+def coalesce_coo(indices: np.ndarray, values: np.ndarray):
+    """Merge duplicate feature indices within each row by summing their
+    values (VW's collision semantics) → padded-COO with unique per-row
+    indices. No-op (no copy) when already unique."""
+    n, W = indices.shape
+    srt = np.argsort(indices, axis=1, kind="stable")
+    idx_s = np.take_along_axis(indices, srt, axis=1)
+    dup = (idx_s[:, 1:] == idx_s[:, :-1]) & (idx_s[:, 1:] >= 0)
+    if not dup.any():
+        return indices, values
+    val_s = np.take_along_axis(values, srt, axis=1)
+    out_i = np.full((n, W), -1, np.int32)
+    out_v = np.zeros((n, W), np.float32)
+    for r in np.flatnonzero(dup.any(axis=1)).tolist():
+        keep = idx_s[r] >= 0
+        uniq, inv = np.unique(idx_s[r][keep], return_inverse=True)
+        sums = np.zeros(uniq.size, np.float32)
+        np.add.at(sums, inv, val_s[r][keep])
+        out_i[r, :uniq.size] = uniq
+        out_v[r, :uniq.size] = sums
+    clean = ~dup.any(axis=1)
+    out_i[clean] = indices[clean]
+    out_v[clean] = values[clean]
+    return out_i, out_v
+
+
+class SparseBinned(NamedTuple):
+    """Device-side binned COO: per-entry bin ids + per-feature zero bin."""
+    indices: jnp.ndarray    # i32 [n, W] (-1 pad)
+    ebins: jnp.ndarray      # i32 [n, W] bin of each explicit entry
+    zero_bin: jnp.ndarray   # i32 [F] bin implicit zeros fall in
+
+
+def compute_sparse_bin_boundaries(sd: SparseData, max_bin: int = 16,
+                                  sample_cnt: int = 1_000_000,
+                                  seed: int = 2) -> np.ndarray:
+    """Per-feature upper bin boundaries [F, max_bin+1] (+inf padded) from
+    the *explicit* (nonzero) values — the zero mass is handled by the
+    zero-bin correction, mirroring LightGBM's sparse bin mappers. Two of
+    the columns are reserved zero-separators (a cut at 0.0 and one at the
+    midpoint between the largest negative value and 0) so implicit zeros
+    always occupy their own bin, as in LightGBM's ``ZeroAsOneBin``.
+
+    Vectorized over all nnz entries (no per-feature Python loop over F,
+    which can be 2^18+): entries are deduplicated to distinct
+    (feature, value) pairs, sorted, and boundaries are midpoints between
+    consecutive distinct values at per-feature quantile positions.
+    """
+    F = sd.num_features
+    B1 = max_bin - 1
+    idx = sd.indices.ravel()
+    val = sd.values.ravel().astype(np.float64)
+    keep = (idx >= 0) & ~np.isnan(val)
+    idx, val = idx[keep], val[keep]
+    if idx.size > sample_cnt:
+        rng = np.random.default_rng(seed)
+        pick = rng.choice(idx.size, sample_cnt, replace=False)
+        idx, val = idx[pick], val[pick]
+    bounds = np.full((F, B1 + 2), np.inf, dtype=np.float64)
+    bounds[:, B1] = 0.0  # zero/positive separator for every feature
+    if idx.size == 0:
+        bounds.sort(axis=1)
+        return bounds.astype(np.float32)
+
+    # distinct (feature, value) pairs, sorted by (feature, value)
+    order = np.lexsort((val, idx))
+    idx_s, val_s = idx[order], val[order]
+    first = np.ones(idx_s.size, bool)
+    first[1:] = (idx_s[1:] != idx_s[:-1]) | (val_s[1:] != val_s[:-1])
+    idx_u, val_u = idx_s[first], val_s[first]
+
+    starts = np.flatnonzero(np.r_[True, idx_u[1:] != idx_u[:-1]])
+    counts = np.diff(np.r_[starts, idx_u.size])
+    feats = idx_u[starts]
+
+    # midpoints between consecutive distinct values within a feature
+    mids = np.full(idx_u.size, np.inf)
+    same_feat = idx_u[:-1] == idx_u[1:]
+    mids[:-1][same_feat] = (val_u[:-1][same_feat] + val_u[1:][same_feat]) / 2
+
+    # boundary j of feature f = midpoint after distinct-value position
+    # round((j+1) * cnt_f / max_bin); features with <= B1 distinct values
+    # get one bin per value (a cut after every distinct value), matching
+    # the dense path's small-cardinality rule.
+    for j in range(B1):
+        pos = np.where(
+            counts <= B1, j,
+            np.round((j + 1) * counts / max_bin).astype(np.int64) - 1)
+        ok = (counts >= 2) & (pos >= 0) & (pos <= counts - 2)
+        src = np.clip(starts + np.clip(pos, 0, None), 0, mids.size - 1)
+        bounds[feats, j] = np.where(ok, mids[src], np.inf)
+
+    # negative/zero separator: midpoint between each feature's largest
+    # negative value and 0 (so negatives never share the zero bin)
+    neg_max = np.maximum.reduceat(
+        np.where(val_u < 0, val_u, -np.inf), starts)
+    has_neg = np.isfinite(neg_max)
+    bounds[feats[has_neg], B1 + 1] = neg_max[has_neg] / 2.0
+    bounds.sort(axis=1)  # duplicate cuts just leave empty bins
+    return bounds.astype(np.float32)
+
+
+def bin_sparse(sd: SparseData, boundaries: np.ndarray) -> SparseBinned:
+    """Map explicit entries to bin ids, column-chunked so peak host memory
+    is O(n · (max_bin-1)) regardless of W. Bin rule matches the dense path
+    (``binning.bin_features``): bin = #(bounds < v) + 1; bin 0 = missing."""
+    n, W = sd.indices.shape
+    ebins = np.zeros((n, W), np.int32)
+    for wcol in range(W):
+        col_idx = sd.indices[:, wcol]
+        col_val = sd.values[:, wcol]
+        safe = np.clip(col_idx, 0, boundaries.shape[0] - 1)
+        b = boundaries[safe]                      # [n, B1]
+        ids = (b < col_val[:, None]).sum(axis=1) + 1
+        ids = np.where(np.isnan(col_val), 0, ids)
+        ebins[:, wcol] = np.where(col_idx >= 0, ids, 0)
+    zero_bin = (boundaries < 0.0).sum(axis=1).astype(np.int32) + 1
+    return SparseBinned(indices=jnp.asarray(sd.indices, jnp.int32),
+                        ebins=jnp.asarray(ebins, jnp.int32),
+                        zero_bin=jnp.asarray(zero_bin))
+
+
+def pad_sparse(sd: SparseData, multiple: int):
+    """Row-pad a SparseData up to a multiple (mesh sharding); pad rows have
+    no entries (indices -1), the COO analogue of ``pad_rows``."""
+    n = sd.n_rows
+    n_pad = (-n) % multiple
+    if n_pad == 0:
+        return sd, np.ones(n, np.float32)
+    idx = np.pad(sd.indices, [(0, n_pad), (0, 0)], constant_values=-1)
+    val = np.pad(sd.values, [(0, n_pad), (0, 0)])
+    mask = np.ones(n + n_pad, np.float32)
+    mask[n:] = 0.0
+    return SparseData(idx, val, sd.num_features), mask
+
+
+# ----------------------------------------------------------------- training
+def _leaf_hist_sparse(binned: SparseBinned, gh1: jnp.ndarray,
+                      sel: jnp.ndarray, F: int, B: int) -> jnp.ndarray:
+    """[F, B, 3] histogram of the rows selected by ``sel`` (f32 weights).
+
+    One scatter-add over present entries (segment-sum over nnz), then the
+    per-feature zero-bin correction: rows of the leaf with no explicit
+    entry for feature f contribute at ``zero_bin[f]`` — computed as
+    leaf totals minus explicit sums, O(F) instead of O(n·F).
+    """
+    idx, ebins, zero_bin = binned
+    n, W = idx.shape
+    valid = idx >= 0
+    key = jnp.where(valid, idx * B + ebins, F * B)
+    entry = gh1 * sel[:, None]                             # [n, 3]
+    vals = jnp.broadcast_to(entry[:, None, :], (n, W, 3))
+    flat = jnp.zeros((F * B + 1, 3), jnp.float32)
+    flat = flat.at[key.reshape(-1)].add(vals.reshape(-1, 3))
+    hist = flat[:F * B].reshape(F, B, 3)
+    explicit = hist.sum(axis=1)                            # [F, 3]
+    totals = entry.sum(axis=0)                             # [3]
+    hist = hist.at[jnp.arange(F), zero_bin].add(
+        totals[None, :] - explicit)
+    return hist
+
+
+def _best_split_of_hist(hist: jnp.ndarray, p: TreeParams,
+                        feature_mask: jnp.ndarray,
+                        cand_feat: jnp.ndarray | None = None):
+    """[F|C, B, 3] histogram → best-split record
+    (gain, feat, bin, lg, lh, lc). Constraint masking matches the dense
+    engine's ``valid`` predicate."""
+    gl, hl, cl, gr, hr, cr, gain = _split_stats(hist, p)
+    if cand_feat is not None:
+        feat_ok = feature_mask[cand_feat][:, None]
+    else:
+        feat_ok = feature_mask[:, None]
+    valid = (feat_ok
+             & (cl >= p.min_data_in_leaf) & (cr >= p.min_data_in_leaf)
+             & (hl >= p.min_sum_hessian_in_leaf)
+             & (hr >= p.min_sum_hessian_in_leaf))
+    gain = jnp.where(valid, gain, -jnp.inf)
+    B = hist.shape[-2]
+    flat = jnp.argmax(gain)
+    j = (flat // B).astype(jnp.int32)
+    b = (flat % B).astype(jnp.int32)
+    f = cand_feat[j] if cand_feat is not None else j
+    return (gain.reshape(-1)[flat], f, b,
+            gl[j, b], hl[j, b], cl[j, b])
+
+
+@functools.partial(
+    jax.jit, static_argnames=("params", "num_features", "num_bins",
+                              "psum_axis"))
+def grow_tree_sparse(indices: jnp.ndarray, ebins: jnp.ndarray,
+                     zero_bin: jnp.ndarray, grad: jnp.ndarray,
+                     hess: jnp.ndarray, feature_mask: jnp.ndarray,
+                     row_mask: jnp.ndarray, *, params: TreeParams,
+                     num_features: int, num_bins: int,
+                     psum_axis: str | None = None):
+    """Grow one tree on binned COO data. Returns (Tree, per-row leaf id).
+
+    Same contract as ``engine.grow_tree`` but over SparseBinned parts;
+    ``num_bins`` is B (zero/missing bin included). Memory: O(nnz) data +
+    O(F·B) scratch + O(L) split records — no [L, F, B, 3] state.
+    """
+    p = params
+    binned = SparseBinned(indices, ebins, zero_bin)
+    n, W = indices.shape
+    F, B = num_features, num_bins
+    L = p.num_leaves
+    NN = 2 * L - 1
+    max_depth = p.max_depth if p.max_depth and p.max_depth > 0 else 10 ** 9
+    voting = p.parallelism == "voting" and psum_axis is not None
+    C = min(2 * p.top_k, F)
+
+    g = grad * row_mask
+    h = hess * row_mask
+    gh1 = jnp.stack([g, h, row_mask], axis=1)   # [n, 3]
+
+    def psum(x):
+        return jax.lax.psum(x, psum_axis) if psum_axis else x
+
+    def local_top_features(hist):
+        """[F, B, 3] local hist → top-K feature votes [F] (PV-Tree)."""
+        *_, gain = _split_stats(hist, p)
+        fgain = jnp.where(feature_mask, jnp.max(gain, axis=-1), -jnp.inf)
+        _, top_idx = jax.lax.top_k(fgain, min(p.top_k, F))
+        return jnp.zeros_like(fgain).at[top_idx].set(1.0)
+
+    def reduce_and_record(local_h):
+        """Shard-local [F, B, 3] child histogram → globally-agreed
+        best-split record. Runs every collective unconditionally."""
+        if voting:
+            votes = psum(local_top_features(local_h))      # [F]
+            _, cand = jax.lax.top_k(votes, C)
+            cand = cand.astype(jnp.int32)
+            cols = psum(local_h[cand])                     # [C, B, 3]
+            return _best_split_of_hist(cols, p, feature_mask,
+                                       cand_feat=cand)
+        return _best_split_of_hist(psum(local_h), p, feature_mask)
+
+    total_g, total_h, total_c = (psum(g.sum()), psum(h.sum()),
+                                 psum(row_mask.sum()))
+    tree = Tree(
+        feature=jnp.zeros(NN, jnp.int32),
+        split_bin=jnp.full(NN, B, jnp.int32),
+        left=jnp.full(NN, -1, jnp.int32),
+        right=jnp.full(NN, -1, jnp.int32),
+        leaf_value=jnp.zeros(NN, jnp.float32).at[0].set(
+            p.learning_rate * _leaf_output(total_g, total_h, p)),
+        is_leaf=jnp.zeros(NN, bool).at[0].set(True),
+        split_gain=jnp.zeros(NN, jnp.float32),
+        node_value=jnp.zeros(NN, jnp.float32).at[0].set(
+            _leaf_output(total_g, total_h, p)),
+        node_weight=jnp.zeros(NN, jnp.float32).at[0].set(total_h),
+        node_count=jnp.zeros(NN, jnp.float32).at[0].set(total_c),
+        num_nodes=jnp.int32(1),
+    )
+
+    root_rec = reduce_and_record(
+        _leaf_hist_sparse(binned, gh1, row_mask, F, B))
+
+    state = {
+        "tree": tree,
+        "slot": jnp.zeros(n, jnp.int32),
+        "slot_node": jnp.zeros(L, jnp.int32),
+        "slot_depth": jnp.zeros(L, jnp.int32),
+        "n_slots": jnp.int32(1),
+        "done": jnp.asarray(False),
+        # per-slot best-split records (the histogram pool's summary)
+        "rec_gain": jnp.full(L, -jnp.inf).at[0].set(root_rec[0]),
+        "rec_feat": jnp.zeros(L, jnp.int32).at[0].set(root_rec[1]),
+        "rec_bin": jnp.zeros(L, jnp.int32).at[0].set(root_rec[2]),
+        "rec_left": jnp.zeros((L, 3), jnp.float32).at[0].set(
+            jnp.stack([root_rec[3], root_rec[4], root_rec[5]])),
+        "rec_total": jnp.zeros((L, 3), jnp.float32).at[0].set(
+            jnp.stack([total_g, total_h, total_c])),
+    }
+
+    def row_bin_of(f_star):
+        """Per-row bin of feature f_star: explicit entry bin if present,
+        else the feature's zero bin. O(n·W)."""
+        match = (indices == f_star)
+        has = match.any(axis=1)
+        eb = jnp.max(jnp.where(match, ebins, 0), axis=1)
+        return jnp.where(has, eb, zero_bin[f_star])
+
+    def split_body(state):
+        slot_ids = jnp.arange(L)
+        active = slot_ids < state["n_slots"]
+        ok = (active & (state["slot_depth"] < max_depth)
+              & (state["n_slots"] < L))
+        gains = jnp.where(ok, state["rec_gain"], -jnp.inf)
+        s_star = jnp.argmax(gains).astype(jnp.int32)
+        best_gain = gains[s_star]
+        found = (best_gain > p.min_gain_to_split) & ~state["done"]
+
+        f_star = state["rec_feat"][s_star]
+        b_star = state["rec_bin"][s_star]
+        lg, lh, lc = (state["rec_left"][s_star, 0],
+                      state["rec_left"][s_star, 1],
+                      state["rec_left"][s_star, 2])
+        tg, th, tc = (state["rec_total"][s_star, 0],
+                      state["rec_total"][s_star, 1],
+                      state["rec_total"][s_star, 2])
+        rg, rh, rc = tg - lg, th - lh, tc - lc
+
+        # ---- route rows + UNCONDITIONAL child histograms/collectives
+        rb = row_bin_of(f_star)
+        in_parent = (state["slot"] == s_star) & found
+        goes_right = in_parent & (rb > b_star)
+        left_sel = (in_parent & ~goes_right).astype(jnp.float32)
+        right_sel = goes_right.astype(jnp.float32)
+        left_rec = reduce_and_record(
+            _leaf_hist_sparse(binned, gh1, left_sel, F, B))
+        right_rec = reduce_and_record(
+            _leaf_hist_sparse(binned, gh1, right_sel, F, B))
+
+        def apply(state):
+            tree = state["tree"]
+            parent = state["slot_node"][s_star]
+            new_slot = state["n_slots"]
+            nl = tree.num_nodes
+            nr = tree.num_nodes + 1
+            new_tree = Tree(
+                feature=tree.feature.at[parent].set(f_star),
+                split_bin=tree.split_bin.at[parent].set(b_star),
+                left=tree.left.at[parent].set(nl),
+                right=tree.right.at[parent].set(nr),
+                leaf_value=tree.leaf_value
+                    .at[nl].set(p.learning_rate * _leaf_output(lg, lh, p))
+                    .at[nr].set(p.learning_rate * _leaf_output(rg, rh, p)),
+                is_leaf=tree.is_leaf.at[parent].set(False)
+                    .at[nl].set(True).at[nr].set(True),
+                split_gain=tree.split_gain.at[parent].set(best_gain),
+                node_value=tree.node_value
+                    .at[nl].set(_leaf_output(lg, lh, p))
+                    .at[nr].set(_leaf_output(rg, rh, p)),
+                node_weight=tree.node_weight.at[nl].set(lh).at[nr].set(rh),
+                node_count=tree.node_count.at[nl].set(lc).at[nr].set(rc),
+                num_nodes=tree.num_nodes + 2,
+            )
+            depth = state["slot_depth"][s_star] + 1
+            return {
+                "tree": new_tree,
+                "slot": jnp.where(goes_right, new_slot, state["slot"]),
+                "slot_node": state["slot_node"]
+                    .at[s_star].set(nl).at[new_slot].set(nr),
+                "slot_depth": state["slot_depth"]
+                    .at[s_star].set(depth).at[new_slot].set(depth),
+                "n_slots": state["n_slots"] + 1,
+                "done": jnp.asarray(False),
+                "rec_gain": state["rec_gain"]
+                    .at[s_star].set(left_rec[0])
+                    .at[new_slot].set(right_rec[0]),
+                "rec_feat": state["rec_feat"]
+                    .at[s_star].set(left_rec[1])
+                    .at[new_slot].set(right_rec[1]),
+                "rec_bin": state["rec_bin"]
+                    .at[s_star].set(left_rec[2])
+                    .at[new_slot].set(right_rec[2]),
+                "rec_left": state["rec_left"]
+                    .at[s_star].set(jnp.stack(left_rec[3:6]))
+                    .at[new_slot].set(jnp.stack(right_rec[3:6])),
+                "rec_total": state["rec_total"]
+                    .at[s_star].set(jnp.stack([lg, lh, lc]))
+                    .at[new_slot].set(jnp.stack([rg, rh, rc])),
+            }
+
+        def no_split(state):
+            return {**state, "done": jnp.asarray(True)}
+
+        return jax.lax.cond(found, apply, no_split, state)
+
+    if psum_axis is None:
+        def split_step(_, state):
+            return jax.lax.cond(state["done"], lambda s: s, split_body,
+                                state)
+    else:
+        def split_step(_, state):
+            return split_body(state)
+
+    state = jax.lax.fori_loop(0, L - 1, split_step, state)
+    row_leaf = state["slot_node"][state["slot"]]
+    return state["tree"], row_leaf
+
+
+@functools.partial(jax.jit, static_argnames=("max_depth",))
+def sparse_route_bins(tree: Tree, indices: jnp.ndarray, ebins: jnp.ndarray,
+                      zero_bin: jnp.ndarray, *, max_depth: int):
+    """Route binned COO rows through one tree → leaf node ids (validation
+    scoring, mirrors ``engine.tree_route_bins``)."""
+    n = indices.shape[0]
+    node = jnp.zeros(n, jnp.int32)
+
+    def step(_, node):
+        f = tree.feature[node]                              # [n]
+        match = indices == f[:, None]
+        has = match.any(axis=1)
+        eb = jnp.max(jnp.where(match, ebins, 0), axis=1)
+        rb = jnp.where(has, eb, zero_bin[f])
+        nxt = jnp.where(rb <= tree.split_bin[node],
+                        tree.left[node], tree.right[node])
+        return jnp.where(tree.is_leaf[node], node, nxt)
+
+    return jax.lax.fori_loop(0, max_depth, step, node)
+
+
+# --------------------------------------------------------------- prediction
+@functools.partial(jax.jit, static_argnames=("max_depth",))
+def predict_leaf_nodes_sparse(tree_arrays, indices, values, *,
+                              max_depth: int):
+    """Per-(row, tree) leaf node ids on raw COO features — the sparse
+    counterpart of ``booster._predict_leaf_nodes`` (reference CSR predict,
+    ``LightGBMBooster.scala:333-344``). Absent features read 0.0."""
+    feature, threshold, left, right, leaf_value, is_leaf, default_left = \
+        tree_arrays
+    T = feature.shape[0]
+    n = indices.shape[0]
+    node = jnp.zeros((n, T), jnp.int32)
+    t_idx = jnp.arange(T)[None, :]
+
+    def step(_, node):
+        f = feature[t_idx, node]                            # [n, T]
+        thr = threshold[t_idx, node]
+        match = indices[:, None, :] == f[:, :, None]        # [n, T, W]
+        xv = jnp.sum(jnp.where(match, values[:, None, :], 0.0), axis=-1)
+        # NaN = missing: honour default_left like the dense predictor
+        # (training maps NaN to bin 0, which routes left)
+        go_left = jnp.where(jnp.isnan(xv), default_left[t_idx, node],
+                            xv <= thr)
+        nxt = jnp.where(go_left, left[t_idx, node], right[t_idx, node])
+        return jnp.where(is_leaf[t_idx, node], node, nxt)
+
+    return jax.lax.fori_loop(0, max_depth, step, node)

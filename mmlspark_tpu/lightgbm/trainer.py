@@ -30,6 +30,8 @@ from .binning import bin_features, compute_bin_boundaries, bin_upper_value
 from .booster import Booster
 from .engine import Tree, TreeParams, grow_tree, tree_route_bins
 from .objectives import Objective, get_objective
+from .sparse import (SparseData, bin_sparse, compute_sparse_bin_boundaries,
+                     grow_tree_sparse, pad_sparse, sparse_route_bins)
 
 
 @dataclasses.dataclass
@@ -55,6 +57,7 @@ class TrainConfig:
     max_drop: int = 50             # dart
     skip_drop: float = 0.5         # dart
     uniform_drop: bool = False     # dart (parity; sampling is uniform)
+    sparse_max_bin: int = 16       # bin cap for the padded-COO path
     num_class: int = 1
     sigmoid: float = 1.0
     alpha: float = 0.9             # quantile / huber
@@ -163,6 +166,27 @@ def _make_grow(mesh, mesh_axis: str | None, tp: TreeParams, F: int):
                          out_specs=(P(), row), check_vma=False)
 
 
+def _make_grow_sparse(mesh, mesh_axis: str | None, tp: TreeParams, F: int,
+                      B: int):
+    """Sparse counterpart of ``_make_grow`` over padded-COO binned parts
+    (reference CSR training, ``TrainUtils.scala:33-92``)."""
+    if mesh is None:
+        return lambda i, e, z, g, h, fm, rm: grow_tree_sparse(
+            i, e, z, g, h, fm, rm, params=tp, num_features=F, num_bins=B,
+            psum_axis=None)
+    from jax.sharding import PartitionSpec as P
+    row = P(mesh_axis)
+
+    def local(i, e, z, g, h, fm, rm):
+        return grow_tree_sparse(i, e, z, g, h, fm, rm, params=tp,
+                                num_features=F, num_bins=B,
+                                psum_axis=mesh_axis)
+
+    return jax.shard_map(local, mesh=mesh,
+                         in_specs=(row, row, P(), row, row, P(), row),
+                         out_specs=(P(), row), check_vma=False)
+
+
 def train(x: np.ndarray, y: np.ndarray, w: np.ndarray | None,
           config: TrainConfig,
           valid: tuple[np.ndarray, np.ndarray, np.ndarray | None]
@@ -188,18 +212,24 @@ def train(x: np.ndarray, y: np.ndarray, w: np.ndarray | None,
     the empty-partition ``ignore`` protocol, ``TrainUtils.scala:652-669``).
     """
     cfg = config
-    n_real = x.shape[0]
+    sparse = isinstance(x, SparseData)
+    n_real = x.n_rows if sparse else x.shape[0]
     pad_mask = None
     if mesh is not None:
         from ..parallel.sharding import pad_rows
         n_dev = int(mesh.shape[mesh_axis])
-        (x, y, w, init_scores), pad_np = pad_rows(
-            [np.asarray(x, np.float32), np.asarray(y, np.float32),
+        if sparse:
+            x, _ = pad_sparse(x, n_dev)
+        else:
+            x, _ = pad_rows(np.asarray(x, np.float32), n_dev)
+        (y, w, init_scores), pad_np = pad_rows(
+            [np.asarray(y, np.float32),
              None if w is None else np.asarray(w, np.float32),
              None if init_scores is None
              else np.asarray(init_scores, np.float32)], n_dev)
         pad_mask = pad_np
-    n, F = x.shape
+    n = x.n_rows if sparse else x.shape[0]
+    F = x.num_features if sparse else x.shape[1]
     rng = np.random.default_rng(cfg.seed)
     bag_rng = np.random.default_rng(cfg.bagging_seed)
     w_np = np.ones(n, np.float32) if w is None else np.asarray(w, np.float32)
@@ -227,16 +257,35 @@ def train(x: np.ndarray, y: np.ndarray, w: np.ndarray | None,
     tp = cfg.tree_params()
 
     # ---- binning (host boundaries, device mapping)
-    boundaries = compute_bin_boundaries(x[:n_real], cfg.max_bin,
-                                        sample_cnt=cfg.bin_sample_count,
-                                        seed=cfg.seed)
-    bins = bin_features(jnp.asarray(x, jnp.float32), jnp.asarray(boundaries))
+    if sparse:
+        sparse_b = min(cfg.sparse_max_bin, cfg.max_bin)
+        # bin_sample_count is a ROW budget; the COO sampler works in
+        # entries, so scale by the per-row entry capacity W
+        entry_budget = cfg.bin_sample_count * max(x.indices.shape[1], 1)
+        boundaries = compute_sparse_bin_boundaries(
+            x, sparse_b, sample_cnt=entry_budget, seed=cfg.seed)
+        # bins 1..(#cuts+1) for values, bin 0 for missing
+        B_s = boundaries.shape[1] + 2
+        binned = bin_sparse(x, boundaries)
+        bins = None
+    else:
+        boundaries = compute_bin_boundaries(x[:n_real], cfg.max_bin,
+                                            sample_cnt=cfg.bin_sample_count,
+                                            seed=cfg.seed)
+        bins = bin_features(jnp.asarray(x, jnp.float32),
+                            jnp.asarray(boundaries))
     y_dev = jnp.asarray(y, jnp.float32)
     w_dev = jnp.asarray(w_np)
     if mesh is not None:
         from jax.sharding import NamedSharding, PartitionSpec as P
         row_sh = NamedSharding(mesh, P(mesh_axis))
-        bins = jax.device_put(bins, NamedSharding(mesh, P(mesh_axis, None)))
+        row2_sh = NamedSharding(mesh, P(mesh_axis, None))
+        if sparse:
+            binned = binned._replace(
+                indices=jax.device_put(binned.indices, row2_sh),
+                ebins=jax.device_put(binned.ebins, row2_sh))
+        else:
+            bins = jax.device_put(bins, row2_sh)
         y_dev = jax.device_put(y_dev, row_sh)
         w_dev = jax.device_put(w_dev, row_sh)
 
@@ -294,9 +343,16 @@ def train(x: np.ndarray, y: np.ndarray, w: np.ndarray | None,
     # validation setup
     if valid is not None:
         xv, yv, wv = valid
-        vbins = bin_features(jnp.asarray(xv, jnp.float32),
-                             jnp.asarray(boundaries))
-        nv = xv.shape[0]
+        if sparse:
+            if not isinstance(xv, SparseData):
+                raise TypeError("validation features must be SparseData "
+                                "when training data is sparse")
+            vbinned = bin_sparse(xv, boundaries)
+            nv = xv.n_rows
+        else:
+            vbins = bin_features(jnp.asarray(xv, jnp.float32),
+                                 jnp.asarray(boundaries))
+            nv = xv.shape[0]
         yv_dev = jnp.asarray(yv, jnp.float32)
         wv_dev = jnp.ones(nv, jnp.float32) if wv is None \
             else jnp.asarray(wv, jnp.float32)
@@ -317,13 +373,24 @@ def train(x: np.ndarray, y: np.ndarray, w: np.ndarray | None,
                 vscores = jnp.asarray(vraw, jnp.float32)
     metric_name = cfg.metric or _default_metric(cfg.objective)
 
-    grow = _make_grow(mesh, mesh_axis, tp, F)
+    def make_grow_step(tp):
+        """(g, h, feat_mask, row_mask) → (Tree, row_leaf), binned data
+        closed over; dispatches dense vs padded-COO engines."""
+        if sparse:
+            gs = _make_grow_sparse(mesh, mesh_axis, tp, F, B_s)
+            return lambda gk, hk, fm, rm: gs(
+                binned.indices, binned.ebins, binned.zero_bin,
+                gk, hk, fm, rm)
+        gd = _make_grow(mesh, mesh_axis, tp, F)
+        return lambda gk, hk, fm, rm: gd(bins, gk, hk, fm, rm)
+
+    grow = make_grow_step(tp)
     for it in range(cfg.num_iterations):
         if delegate is not None:
             lr = delegate.get_learning_rate(it)
             if lr is not None and lr != tp.learning_rate:
                 tp = tp._replace(learning_rate=float(lr))
-                grow = _make_grow(mesh, mesh_axis, tp, F)
+                grow = make_grow_step(tp)
             delegate.before_train_iteration(it)
 
         # ---- dart: drop trees for gradient computation
@@ -381,7 +448,7 @@ def train(x: np.ndarray, y: np.ndarray, w: np.ndarray | None,
         for k_cls in range(K):
             gk = g if K == 1 else g[:, k_cls]
             hk = h if K == 1 else h[:, k_cls]
-            tree, row_leaf = grow(bins, gk, hk, feat_mask_dev, row_mask_dev)
+            tree, row_leaf = grow(gk, hk, feat_mask_dev, row_mask_dev)
             delta = tree.leaf_value[row_leaf]
 
             trees.append(jax.tree.map(np.asarray, tree))
@@ -389,8 +456,13 @@ def train(x: np.ndarray, y: np.ndarray, w: np.ndarray | None,
             tree_weights.append(new_tree_weight if is_dart else 1.0)
             vdelta = None
             if valid is not None:
-                vleaf = tree_route_bins(tree, vbins,
-                                        max_depth=cfg.num_leaves)
+                if sparse:
+                    vleaf = sparse_route_bins(
+                        tree, vbinned.indices, vbinned.ebins,
+                        vbinned.zero_bin, max_depth=cfg.num_leaves)
+                else:
+                    vleaf = tree_route_bins(tree, vbins,
+                                            max_depth=cfg.num_leaves)
                 vdelta = tree.leaf_value[vleaf]
             if is_dart:
                 tree_deltas.append(delta)
