@@ -102,7 +102,8 @@ class Booster:
             jnp.arange(t_end)[None, :], leaves]
         w = jnp.asarray(self.tree_weights[:t_end])
         weighted = leaf_vals * w[None, :]
-        per_class = weighted.reshape(n_rows, -1, self.num_class)
+        per_class = weighted.reshape(n_rows, t_end // self.num_class,
+                                     self.num_class)
         scores = per_class.sum(axis=1)
         if self.average_output:
             scores = scores / (t_end // self.num_class)
@@ -294,10 +295,13 @@ class Booster:
         NN = 2 * max_leaves - 1
         arr = {k: np.zeros((T, NN), dt) for k, dt in [
             ("feature", np.int32), ("threshold", np.float32),
-            ("left", np.int32), ("right", np.int32),
             ("leaf_value", np.float32), ("is_leaf", bool),
             ("split_gain", np.float32), ("node_weight", np.float32),
             ("node_count", np.float32), ("node_value", np.float32)]}
+        # unused padded slots must read "no child" (-1), not node 0 —
+        # feature_importances treats left >= 0 as a real split
+        arr["left"] = np.full((T, NN), -1, np.int32)
+        arr["right"] = np.full((T, NN), -1, np.int32)
         arr["num_nodes"] = np.zeros(T, np.int32)
         arr["default_left"] = np.ones((T, NN), bool)
         for t, td in enumerate(trees):

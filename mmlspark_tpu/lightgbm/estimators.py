@@ -35,7 +35,11 @@ def extract_features(df, col: str, sparse_feature_count: int = 0):
         # engine invariant: unique indices per row (sumCollisions=False
         # featurizer output may carry duplicates — merge them)
         idx, val = coalesce_coo(idx, val)
-        F = max(sparse_feature_count, int(idx.max()) + 1)
+        # empty input / all-padding rows: keep F >= 1 so the binning
+        # scratch shapes stay valid (the sparse analogue of the dense
+        # path's tolerance for empty partitions)
+        max_idx = int(idx.max()) if idx.size else -1
+        F = max(sparse_feature_count, max_idx + 1, 1)
         return SparseData(idx, val, F)
     return as_2d_features(df, col)
 

@@ -1,0 +1,176 @@
+"""Checkpoint conversion: torchvision-layout ResNet weights → flax/orbax.
+
+Fills the reference's pretrained-model supply chain
+(``downloader/ModelDownloader.scala:37-60`` downloads hash-verified CNTK
+graphs; ``downloader/Schema.scala`` carries the catalogue hash): here the
+public pretrained source is a torchvision ``state_dict`` (``.pt``/``.pth``
+pickle or an in-memory dict), converted once to an orbax checkpoint tree
+under ``MMLSPARK_TPU_MODEL_DIR`` with a SHA-256 manifest that
+``ModelDownloader`` verifies on every load.
+
+Layout mapping (torchvision ResNet ↔ ``models/resnet.py``):
+
+==========================  =====================================
+torchvision                 flax (this package)
+==========================  =====================================
+conv1.weight                params/conv_init/kernel   (OIHW→HWIO)
+bn1.{weight,bias}           params/bn_init/{scale,bias}
+bn1.running_{mean,var}      batch_stats/bn_init/{mean,var}
+layer<L>.<B>.conv<k>        params/<Block>_<i>/Conv_<k-1>/kernel
+layer<L>.<B>.bn<k>          params/<Block>_<i>/BatchNorm_<k-1>/…
+layer<L>.<B>.downsample.0   params/<Block>_<i>/Conv_<nc>/kernel
+layer<L>.<B>.downsample.1   params/<Block>_<i>/BatchNorm_<nc>/…
+fc.{weight,bias}            params/head/{kernel (T), bias}
+==========================  =====================================
+
+where ``i`` is the global block index (blocks auto-numbered across
+stages by flax) and ``nc`` the per-block conv count (2 basic /
+3 bottleneck). Strides sit on the 3×3 conv in both (torchvision's
+"v1.5" ResNet), and ``resnet.py`` uses explicit symmetric padding so the
+converted network is numerically identical to the torch source.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import json
+import os
+
+import numpy as np
+
+_ARCHS = {
+    # name -> (stage_sizes, block prefix, convs per block)
+    "ResNet18": ((2, 2, 2, 2), "BasicBlock", 2),
+    "ResNet34": ((3, 4, 6, 3), "BasicBlock", 2),
+    "ResNet50": ((3, 4, 6, 3), "BottleneckBlock", 3),
+    "ResNet101": ((3, 4, 23, 3), "BottleneckBlock", 3),
+}
+
+
+def _np(t) -> np.ndarray:
+    if hasattr(t, "detach"):
+        t = t.detach().cpu().numpy()
+    return np.asarray(t, np.float32)
+
+
+def torch_resnet_to_flax(state_dict: dict, model_name: str) -> dict:
+    """torchvision ResNet ``state_dict`` → flax variables
+    ``{"params": ..., "batch_stats": ...}`` for ``models.resnet``.
+
+    Raises KeyError on missing weights (a truncated/mismatched checkpoint
+    must fail loudly, like the reference's hash check).
+    """
+    if model_name not in _ARCHS:
+        raise KeyError(f"no torchvision mapping for {model_name!r}; "
+                       f"supported: {sorted(_ARCHS)}")
+    stage_sizes, block_prefix, n_convs = _ARCHS[model_name]
+    sd = dict(state_dict)
+    params: dict = {}
+    stats: dict = {}
+
+    def conv(dst: dict, flax_name: str, torch_name: str):
+        w = _np(sd.pop(torch_name + ".weight"))
+        dst[flax_name] = {"kernel": w.transpose(2, 3, 1, 0)}  # OIHW→HWIO
+
+    def bn(torch_name: str, flax_name: str, p: dict, s: dict):
+        p[flax_name] = {"scale": _np(sd.pop(torch_name + ".weight")),
+                        "bias": _np(sd.pop(torch_name + ".bias"))}
+        s[flax_name] = {"mean": _np(sd.pop(torch_name + ".running_mean")),
+                        "var": _np(sd.pop(torch_name + ".running_var"))}
+        sd.pop(torch_name + ".num_batches_tracked", None)
+
+    conv(params, "conv_init", "conv1")
+    bn("bn1", "bn_init", params, stats)
+
+    block_idx = 0
+    for li, n_blocks in enumerate(stage_sizes):
+        for bj in range(n_blocks):
+            t = f"layer{li + 1}.{bj}"
+            name = f"{block_prefix}_{block_idx}"
+            bp: dict = {}
+            bs: dict = {}
+            for k in range(n_convs):
+                conv(bp, f"Conv_{k}", f"{t}.conv{k + 1}")
+                bn(f"{t}.bn{k + 1}", f"BatchNorm_{k}", bp, bs)
+            if f"{t}.downsample.0.weight" in sd:
+                conv(bp, f"Conv_{n_convs}", f"{t}.downsample.0")
+                bn(f"{t}.downsample.1", f"BatchNorm_{n_convs}", bp, bs)
+            params[name] = bp
+            stats[name] = bs
+            block_idx += 1
+
+    params["head"] = {"kernel": _np(sd.pop("fc.weight")).T,
+                      "bias": _np(sd.pop("fc.bias"))}
+    if sd:
+        leftover = sorted(sd)[:5]
+        raise ValueError(
+            f"{len(sd)} unconverted torch weights (first: {leftover}) — "
+            "state_dict does not match the expected torchvision layout")
+    return {"params": params, "batch_stats": stats}
+
+
+# ------------------------------------------------------------- persistence
+def _tree_sha256(tree) -> str:
+    """Deterministic digest over a variables pytree (sorted key walk)."""
+    h = hashlib.sha256()
+
+    def walk(node, prefix):
+        if isinstance(node, dict):
+            for k in sorted(node):
+                walk(node[k], f"{prefix}/{k}")
+        else:
+            arr = np.asarray(node)
+            h.update(prefix.encode())
+            h.update(str(arr.shape).encode())
+            h.update(arr.astype(np.float32).tobytes())
+
+    walk(tree, "")
+    return h.hexdigest()
+
+
+def save_converted(variables: dict, model_name: str,
+                   out_dir: str | None = None) -> str:
+    """Write an orbax checkpoint + SHA-256 manifest under
+    ``<out_dir>/<model_name>`` (out_dir defaults to
+    ``MMLSPARK_TPU_MODEL_DIR``). Returns the checkpoint path."""
+    out_dir = out_dir or os.environ.get("MMLSPARK_TPU_MODEL_DIR", "")
+    if not out_dir:
+        raise ValueError("no output dir: pass out_dir or set "
+                         "MMLSPARK_TPU_MODEL_DIR")
+    import orbax.checkpoint as ocp
+    path = os.path.abspath(os.path.join(out_dir, model_name))
+    with ocp.PyTreeCheckpointer() as ck:
+        ck.save(path, variables, force=True)
+    manifest = {"name": model_name, "sha256": _tree_sha256(variables)}
+    with open(os.path.join(out_dir, f"{model_name}.manifest.json"),
+              "w") as f:
+        json.dump(manifest, f)
+    return path
+
+
+def verify_checkpoint(variables: dict, manifest_path: str) -> None:
+    """Reference hash check (``ModelDownloader.scala:37-60``): raise on
+    digest mismatch."""
+    with open(manifest_path) as f:
+        manifest = json.load(f)
+    got = _tree_sha256(variables)
+    if got != manifest["sha256"]:
+        raise IOError(
+            f"checkpoint hash mismatch for {manifest.get('name')}: "
+            f"manifest {manifest['sha256'][:12]}…, computed {got[:12]}… — "
+            "refusing corrupted/partial weights")
+
+
+def convert_torch_checkpoint(src, model_name: str,
+                             out_dir: str | None = None) -> str:
+    """One-call conversion: torch ``.pt``/``.pth`` path (or a state_dict)
+    → verified orbax checkpoint. Returns the checkpoint path."""
+    if isinstance(src, (str, os.PathLike)):
+        import torch
+        obj = torch.load(src, map_location="cpu", weights_only=True)
+        state_dict = obj.get("state_dict", obj) if isinstance(obj, dict) \
+            else obj
+    else:
+        state_dict = src
+    variables = torch_resnet_to_flax(state_dict, model_name)
+    return save_converted(variables, model_name, out_dir)

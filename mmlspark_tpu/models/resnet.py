@@ -20,6 +20,14 @@ import flax.linen as nn
 import jax.numpy as jnp
 
 
+# All spatial convs use explicit symmetric padding (the torchvision
+# convention) rather than SAME: for stride-2 convs SAME pads
+# asymmetrically, which would make converted torchvision checkpoints
+# (models/convert.py) numerically diverge from their source model.
+_PAD3 = ((1, 1), (1, 1))
+_PAD7 = ((3, 3), (3, 3))
+
+
 class BasicBlock(nn.Module):
     filters: int
     strides: int = 1
@@ -31,9 +39,10 @@ class BasicBlock(nn.Module):
         norm = partial(nn.BatchNorm, use_running_average=not train,
                        momentum=0.9, dtype=self.dtype)
         residual = x
-        y = conv(self.filters, (3, 3), (self.strides, self.strides))(x)
+        y = conv(self.filters, (3, 3), (self.strides, self.strides),
+                 padding=_PAD3)(x)
         y = nn.relu(norm()(y))
-        y = conv(self.filters, (3, 3))(y)
+        y = conv(self.filters, (3, 3), padding=_PAD3)(y)
         y = norm(scale_init=nn.initializers.zeros)(y)
         if residual.shape != y.shape:
             residual = conv(self.filters, (1, 1),
@@ -54,7 +63,8 @@ class BottleneckBlock(nn.Module):
                        momentum=0.9, dtype=self.dtype)
         residual = x
         y = nn.relu(norm()(conv(self.filters, (1, 1))(x)))
-        y = conv(self.filters, (3, 3), (self.strides, self.strides))(y)
+        y = conv(self.filters, (3, 3), (self.strides, self.strides),
+                 padding=_PAD3)(y)
         y = nn.relu(norm()(y))
         y = conv(self.filters * 4, (1, 1))(y)
         y = norm(scale_init=nn.initializers.zeros)(y)
@@ -82,12 +92,12 @@ class ResNet(nn.Module):
     def __call__(self, x, train: bool = False):
         endpoints = {}
         x = x.astype(self.dtype)
-        x = nn.Conv(self.width, (7, 7), (2, 2), use_bias=False,
-                    dtype=self.dtype, name="conv_init")(x)
+        x = nn.Conv(self.width, (7, 7), (2, 2), padding=_PAD7,
+                    use_bias=False, dtype=self.dtype, name="conv_init")(x)
         x = nn.BatchNorm(use_running_average=not train, momentum=0.9,
                          dtype=self.dtype, name="bn_init")(x)
         x = nn.relu(x)
-        x = nn.max_pool(x, (3, 3), (2, 2), padding="SAME")
+        x = nn.max_pool(x, (3, 3), (2, 2), padding=((1, 1), (1, 1)))
         for i, n_blocks in enumerate(self.stage_sizes):
             for j in range(n_blocks):
                 strides = 2 if i > 0 and j == 0 else 1

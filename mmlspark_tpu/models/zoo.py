@@ -130,8 +130,17 @@ class ModelDownloader:
                 import orbax.checkpoint as ocp
                 with ocp.PyTreeCheckpointer() as ck:
                     return ck.restore(path)
-            # reference retries downloads with backoff
-            return retry_with_timeout(restore, retries=3)
+            # reference retries downloads with backoff; hash verification
+            # is deterministic, so it runs once OUTSIDE the retry loop
+            variables = retry_with_timeout(restore, backoffs_ms=(0, 100, 200))
+            manifest = os.path.join(self.local_dir,
+                                    f"{schema.name}.manifest.json")
+            if os.path.exists(manifest):
+                # reference verifies the downloaded artifact's hash
+                # (ModelDownloader.scala:37-60); corrupted weights fail loud
+                from .convert import verify_checkpoint
+                verify_checkpoint(variables, manifest)
+            return variables
         if allow_random_init is None:
             allow_random_init = os.environ.get(
                 "MMLSPARK_TPU_ALLOW_RANDOM_INIT", "1") != "0"

@@ -8,9 +8,13 @@ DataFrames.
 
 from .train_classifier import (TrainClassifier, TrainRegressor,
                                TrainedClassifierModel, TrainedRegressorModel)
+from .linear import (LinearRegression, LinearRegressionModel,
+                     LogisticRegression, LogisticRegressionModel)
 from .statistics import (ComputeModelStatistics, ComputePerInstanceStatistics,
                          MetricConstants)
 
-__all__ = ["TrainClassifier", "TrainRegressor", "TrainedClassifierModel",
+__all__ = ["LinearRegression", "LinearRegressionModel",
+           "LogisticRegression", "LogisticRegressionModel",
+           "TrainClassifier", "TrainRegressor", "TrainedClassifierModel",
            "TrainedRegressorModel", "ComputeModelStatistics",
            "ComputePerInstanceStatistics", "MetricConstants"]

@@ -1,0 +1,104 @@
+"""Vendored, dependency-free LightGBM text-model reader.
+
+Deliberately INDEPENDENT of mmlspark_tpu (plain dict/list walk, recursive
+scoring, no shared code with ``booster.Booster.load_native``): it exists to
+cross-check that ``save_native`` output parses and scores identically under
+a second implementation of the upstream format spec
+(https://github.com/microsoft/LightGBM text serialization; reference
+wrapper ``lightgbm/booster/LightGBMBooster.scala:397-421``).
+
+Semantics implemented straight from the spec:
+- internal nodes indexed 0..num_leaves-2, leaves addressed as negative
+  codes (leaf j ↔ code -(j+1));
+- numerical decision: value <= threshold goes left;
+- decision_type bits: 1 = categorical (rejected here), 2 = default-left,
+  bits 2-3 = missing type (0 none, 1 zero, 2 NaN); missing values follow
+  the default-left bit;
+- model score = sum of tree leaf outputs (+ sigmoid etc. left to caller).
+"""
+
+from __future__ import annotations
+
+import math
+
+
+def parse_model(text: str) -> dict:
+    header: dict = {}
+    trees: list[dict] = []
+    cur: dict | None = None
+    for raw in text.splitlines():
+        line = raw.strip()
+        if line.startswith("Tree="):
+            cur = {"index": int(line.split("=")[1])}
+            trees.append(cur)
+        elif line in ("end of trees", "parameters:", "feature_importances:"):
+            cur = None
+        elif "=" in line and cur is not None:
+            k, v = line.split("=", 1)
+            cur[k] = v
+        elif "=" in line and not trees:
+            k, v = line.split("=", 1)
+            header[k] = v
+    return {"header": header, "trees": [_decode_tree(t) for t in trees]}
+
+
+def _floats(t, key):
+    s = t.get(key, "")
+    return [float(v) for v in s.split()] if s else []
+
+
+def _ints(t, key):
+    s = t.get(key, "")
+    return [int(float(v)) for v in s.split()] if s else []
+
+
+def _decode_tree(t: dict) -> dict:
+    dt = _ints(t, "decision_type")
+    for d in dt:
+        if d & 1:
+            raise ValueError("categorical splits not supported by the "
+                             "vendored reader")
+    return {
+        "num_leaves": int(t["num_leaves"]),
+        "split_feature": _ints(t, "split_feature"),
+        "threshold": _floats(t, "threshold"),
+        "decision_type": dt,
+        "left_child": _ints(t, "left_child"),
+        "right_child": _ints(t, "right_child"),
+        "leaf_value": _floats(t, "leaf_value"),
+    }
+
+
+def _score_tree(tree: dict, row) -> float:
+    if tree["num_leaves"] <= 1:
+        return tree["leaf_value"][0]
+    node = 0
+    while True:
+        f = tree["split_feature"][node]
+        v = row[f] if f < len(row) else 0.0
+        if v is None or (isinstance(v, float) and math.isnan(v)):
+            go_left = bool(tree["decision_type"][node] & 2)
+        else:
+            go_left = v <= tree["threshold"][node]
+        nxt = tree["left_child"][node] if go_left \
+            else tree["right_child"][node]
+        if nxt < 0:
+            return tree["leaf_value"][-nxt - 1]
+        node = nxt
+
+
+def score(model: dict, rows) -> list:
+    """Raw margin per row (list of lists / 2-D array)."""
+    hdr = model["header"]
+    num_class = int(hdr.get("num_class", "1"))
+    out = []
+    for row in rows:
+        row = [float(v) for v in row]
+        if num_class == 1:
+            out.append(sum(_score_tree(t, row) for t in model["trees"]))
+        else:
+            acc = [0.0] * num_class
+            for i, t in enumerate(model["trees"]):
+                acc[i % num_class] += _score_tree(t, row)
+            out.append(acc)
+    return out

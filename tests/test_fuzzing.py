@@ -50,7 +50,8 @@ def make_test_objects() -> dict[str, TestObject]:
                                      TextPreprocessor, Timer,
                                      UnicodeNormalize)
     from mmlspark_tpu.train import (ComputeModelStatistics,
-                                    ComputePerInstanceStatistics)
+                                    ComputePerInstanceStatistics,
+                                    LinearRegression, LogisticRegression)
     from mmlspark_tpu.vw import (VowpalWabbitClassifier,
                                  VowpalWabbitFeaturizer,
                                  VowpalWabbitRegressor)
@@ -149,6 +150,8 @@ def make_test_objects() -> dict[str, TestObject]:
         TestObject(KNN(k=2), num),
         TestObject(SAR(supportThreshold=1), sar_df),
         TestObject(IsolationForest(numEstimators=5), num),
+        TestObject(LogisticRegression(maxIter=10), num),
+        TestObject(LinearRegression(), num),
         TestObject(ComputeModelStatistics(labelCol="label"), scored_df),
         TestObject(ComputePerInstanceStatistics(labelCol="label"),
                    scored_df),

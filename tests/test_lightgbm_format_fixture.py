@@ -1,0 +1,141 @@
+"""Cross-implementation LightGBM text-format checks (VERDICT r1 item 7).
+
+Round 1 only round-tripped our own writer through our own reader. Two
+independent anchors close that loop:
+
+1. ``tests/fixtures/upstream_lgbm_binary.txt`` — a spec-conformant
+   upstream-style model file (realistic header incl. ``tree_sizes``/
+   ``feature_infos``, decision_type missing-value bits, single-leaf tree,
+   importances/parameters footer) with HAND-COMPUTED expected scores.
+   ``load_native`` must reproduce them exactly.
+2. ``tests/fixtures/vendored_lgbm_reader.py`` — a second, dependency-free
+   implementation of the format spec. ``save_native`` output must parse
+   and score identically under it.
+
+Reference parity surface: ``booster/LightGBMBooster.scala:397-421``
+(saveToString / loadNativeModelFromString).
+"""
+
+import math
+import os
+import sys
+
+import numpy as np
+
+from mmlspark_tpu.core import DataFrame
+from mmlspark_tpu.lightgbm import (Booster, LightGBMClassificationModel,
+                                   LightGBMClassifier, LightGBMRegressor)
+
+FIXTURES = os.path.join(os.path.dirname(__file__), "fixtures")
+sys.path.insert(0, FIXTURES)
+
+import vendored_lgbm_reader as vendored  # noqa: E402
+
+NAN = float("nan")
+
+# rows traced through the fixture's trees by hand (see docstrings below)
+FIXTURE_ROWS = np.array([
+    [100.0, 0.0, 0.0],   # t0: region<=0.5 -> leaf0 0.2 | t1: !<=-1.25 -> .12
+    [200.0, -2.0, 1.0],  # t0: region>0.5, age>165 -> 0.4 | t1: -2<=-1.25 -> -0.1
+    [150.0, -1.0, 3.0],  # t0: region>0.5, age<=165 -> -0.15 | t1: -> 0.12
+    [NAN, NAN, NAN],     # t0 dt=10 default-left -> 0.2 | t1 default-left -> -0.1
+    [NAN, 5.0, 2.0],     # t0: region>0.5, age NaN dt=8 default-RIGHT -> 0.4
+], np.float32)
+# every tree also adds the single-leaf tree 2 constant 0.05
+FIXTURE_EXPECTED_RAW = np.array([0.37, 0.35, 0.02, 0.15, 0.57])
+
+
+def fixture_text() -> str:
+    with open(os.path.join(FIXTURES, "upstream_lgbm_binary.txt")) as f:
+        return f.read()
+
+
+class TestLoadUpstreamFixture:
+    def test_raw_scores_match_hand_computed(self):
+        b = Booster.load_native(fixture_text())
+        got = b.raw_scores(FIXTURE_ROWS)
+        np.testing.assert_allclose(got, FIXTURE_EXPECTED_RAW, atol=1e-6)
+
+    def test_probabilities_and_metadata(self):
+        b = Booster.load_native(fixture_text())
+        assert b.objective == "binary"
+        assert b.num_class == 1
+        assert b.feature_names == ["age", "income", "region"]
+        probs = b.transform_scores(b.raw_scores(FIXTURE_ROWS))
+        expected = 1.0 / (1.0 + np.exp(-FIXTURE_EXPECTED_RAW))
+        np.testing.assert_allclose(probs, expected, atol=1e-6)
+
+    def test_model_class_entrypoint(self):
+        m = LightGBMClassificationModel.load_native_model_from_string(
+            fixture_text())
+        df = DataFrame({"features": FIXTURE_ROWS})
+        out = m.transform(df)
+        expected = 1.0 / (1.0 + np.exp(-FIXTURE_EXPECTED_RAW))
+        np.testing.assert_allclose(out["probability"][:, 1], expected,
+                                   atol=1e-6)
+
+    def test_split_importances(self):
+        b = Booster.load_native(fixture_text())
+        # one split each on age(0), income(1), region(2)
+        np.testing.assert_array_equal(
+            b.feature_importances("split"), [1.0, 1.0, 1.0])
+
+    def test_vendored_reader_agrees_on_fixture(self):
+        model = vendored.parse_model(fixture_text())
+        got = vendored.score(model, FIXTURE_ROWS.tolist())
+        np.testing.assert_allclose(got, FIXTURE_EXPECTED_RAW, atol=1e-6)
+
+
+class TestSaveNativeCrossParses:
+    def _train_df(self, seed=0, n=300):
+        rng = np.random.default_rng(seed)
+        x = rng.normal(size=(n, 6)).astype(np.float32)
+        y = (x[:, 0] + x[:, 1] * x[:, 2] > 0).astype(np.float32)
+        return DataFrame({"features": x, "label": y}), x
+
+    def test_binary_model(self):
+        df, x = self._train_df()
+        m = LightGBMClassifier(numIterations=12, numLeaves=7,
+                               minDataInLeaf=5).fit(df)
+        text = m.get_native_model_string()
+        model = vendored.parse_model(text)
+        theirs = np.asarray(vendored.score(model, x.tolist()))
+        ours = m.booster.raw_scores(x)
+        np.testing.assert_allclose(theirs, ours, rtol=1e-5, atol=1e-6)
+
+    def test_binary_model_with_nans(self):
+        df, x = self._train_df(seed=3)
+        m = LightGBMClassifier(numIterations=8, numLeaves=7,
+                               minDataInLeaf=5).fit(df)
+        xq = x[:50].copy()
+        xq[::3, 0] = np.nan
+        xq[::5, 4] = np.nan
+        model = vendored.parse_model(m.get_native_model_string())
+        theirs = np.asarray(vendored.score(model, xq.tolist()))
+        ours = m.booster.raw_scores(xq)
+        np.testing.assert_allclose(theirs, ours, rtol=1e-5, atol=1e-6)
+
+    def test_multiclass_model(self):
+        rng = np.random.default_rng(5)
+        x = rng.normal(size=(300, 5)).astype(np.float32)
+        y = (np.digitize(x[:, 0], [-0.5, 0.5])).astype(np.float32)
+        df = DataFrame({"features": x, "label": y})
+        m = LightGBMClassifier(objective="multiclass", numIterations=6,
+                               numLeaves=7, minDataInLeaf=5).fit(df)
+        model = vendored.parse_model(m.get_native_model_string())
+        theirs = np.asarray(vendored.score(model, x[:40].tolist()))
+        ours = m.booster.raw_scores(x[:40])
+        assert theirs.shape == ours.shape == (40, 3)
+        np.testing.assert_allclose(theirs, ours, rtol=1e-5, atol=1e-6)
+
+    def test_regressor_model(self):
+        rng = np.random.default_rng(7)
+        x = rng.normal(size=(300, 4)).astype(np.float32)
+        y = (x[:, 0] * 2 + x[:, 1]).astype(np.float32)
+        df = DataFrame({"features": x, "label": y})
+        m = LightGBMRegressor(numIterations=10, numLeaves=15,
+                              minDataInLeaf=5).fit(df)
+        model = vendored.parse_model(m.get_native_model_string())
+        theirs = np.asarray(vendored.score(model, x[:40].tolist()))
+        ours = m.booster.raw_scores(x[:40])
+        np.testing.assert_allclose(theirs, ours, rtol=1e-5, atol=1e-6)

@@ -140,6 +140,21 @@ class TestSparseTraining:
         np.testing.assert_allclose(m.booster.raw_scores(x), expected,
                                    rtol=1e-4, atol=1e-5)
 
+    def test_empty_and_all_padding_input(self):
+        df, x, y = sparse_binary_df(seed=21)
+        m = LightGBMClassifier(numIterations=5, numLeaves=7,
+                               minDataInLeaf=5).fit(df)
+        empty = DataFrame({
+            "features_indices": np.zeros((0, 4), np.int32),
+            "features_values": np.zeros((0, 4), np.float32)})
+        out = m.transform(empty)
+        assert out["prediction"].shape == (0,)
+        allpad = DataFrame({
+            "features_indices": np.full((3, 4), -1, np.int32),
+            "features_values": np.zeros((3, 4), np.float32)})
+        out2 = m.transform(allpad)
+        assert out2["prediction"].shape == (3,)
+
     def test_validation_early_stopping_sparse(self):
         df, x, y = sparse_binary_df(n=500, seed=7)
         flag = np.zeros(500, bool)

@@ -1,0 +1,70 @@
+"""Linear learner family (LogisticRegression / LinearRegression) — the
+stock-predictor slot the reference's TrainClassifier fills with SparkML
+learners (``train/TrainClassifier.scala:22-38``)."""
+
+import numpy as np
+
+from mmlspark_tpu.core import DataFrame, load_stage
+from mmlspark_tpu.lightgbm import roc_auc
+from mmlspark_tpu.train import (LinearRegression, LogisticRegression,
+                                TrainClassifier)
+
+
+def test_binary_logistic(rng):
+    x = rng.normal(size=(500, 6)).astype(np.float32)
+    true_w = np.array([2.0, -1.5, 1.0, 0, 0, 0.5])
+    y = ((x @ true_w + 0.3) + rng.normal(scale=0.5, size=500) > 0)
+    df = DataFrame({"features": x, "label": y.astype(np.float32)})
+    m = LogisticRegression(maxIter=30).fit(df)
+    out = m.transform(df)
+    assert roc_auc(y.astype(np.float32), out["probability"][:, 1]) > 0.95
+    assert out["rawPrediction"].shape == (500, 2)
+    # recovered coefficient signs match the generating weights
+    coef = m.coefficients[:, 0]
+    assert coef[0] > 0 and coef[1] < 0
+
+
+def test_multiclass_logistic(rng):
+    x = rng.normal(size=(600, 4)).astype(np.float32)
+    y = np.digitize(x[:, 0] + 0.3 * x[:, 1], [-0.6, 0.6]).astype(np.float32)
+    df = DataFrame({"features": x, "label": y})
+    m = LogisticRegression(maxIter=300).fit(df)
+    out = m.transform(df)
+    assert out["probability"].shape == (600, 3)
+    acc = (out["prediction"] == y).mean()
+    assert acc > 0.85, acc
+
+
+def test_linear_regression(rng):
+    x = rng.normal(size=(400, 5)).astype(np.float32)
+    y = (x @ np.array([1.0, -2.0, 0.5, 0, 3.0]) + 0.7
+         + rng.normal(scale=0.1, size=400)).astype(np.float32)
+    df = DataFrame({"features": x, "label": y})
+    m = LinearRegression().fit(df)
+    pred = m.transform(df)["prediction"]
+    assert np.sqrt(np.mean((pred - y) ** 2)) < 0.15
+    np.testing.assert_allclose(m.coefficients, [1.0, -2.0, 0.5, 0, 3.0],
+                               atol=0.05)
+    assert abs(m.intercept - 0.7) < 0.05
+
+
+def test_save_load(rng, tmp_path):
+    x = rng.normal(size=(200, 3)).astype(np.float32)
+    y = (x[:, 0] > 0).astype(np.float32)
+    df = DataFrame({"features": x, "label": y})
+    m = LogisticRegression().fit(df)
+    expected = m.transform(df)["probability"]
+    m.save(str(tmp_path / "lr"))
+    loaded = load_stage(str(tmp_path / "lr"))
+    np.testing.assert_allclose(loaded.transform(df)["probability"],
+                               expected, rtol=1e-6)
+
+
+def test_inside_train_classifier(rng):
+    x = rng.normal(size=(300, 4)).astype(np.float32)
+    y = np.where(x[:, 0] + x[:, 1] > 0, "yes", "no")
+    df = DataFrame({"f": x, "label": np.asarray(y, object)})
+    tc = TrainClassifier(model=LogisticRegression(maxIter=30),
+                         labelCol="label").fit(df)
+    out = tc.transform(df)
+    assert (out["scored_labels"] == y).mean() > 0.9

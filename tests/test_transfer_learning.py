@@ -1,0 +1,122 @@
+"""Transfer-learning E2E (VERDICT r1 item 5 / BASELINE north star shape).
+
+The reference's headline workflow: a pretrained backbone feeds
+``ImageFeaturizer`` and a cheap head learns a new task from frozen features
+(``image/ImageFeaturizer.scala:40-197``). With zero egress there are no real
+ImageNet weights in this environment, so the test constructs the transfer
+setting honestly: pretext-train a small ResNet on grating-orientation
+classification at one spatial frequency, freeze it, and linear-probe a
+HELD-OUT frequency through the full ImageFeaturizer → TrainClassifier
+pipeline. Frozen pretext features must beat the same probe on a
+random-init backbone and clear a committed accuracy bar.
+"""
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+import optax
+
+from mmlspark_tpu.core import DataFrame
+from mmlspark_tpu.dl.train import init_train_state, make_train_step
+from mmlspark_tpu.image import ImageFeaturizer
+from mmlspark_tpu.models.resnet import BasicBlock, ResNet
+from mmlspark_tpu.models.zoo import LoadedModel, ModelSchema
+from mmlspark_tpu.train import TrainClassifier
+
+SIZE = 32
+ORIENTATIONS = [0.0, np.pi / 4, np.pi / 2, 3 * np.pi / 4]
+
+
+def gratings(n, freq, rng):
+    """Sinusoidal gratings at random orientations + noise; label =
+    orientation bin. Orientation sensitivity is the transferable feature."""
+    yy, xx = np.mgrid[0:SIZE, 0:SIZE] / SIZE
+    imgs = np.zeros((n, SIZE, SIZE, 3), np.float32)
+    labels = np.zeros(n, np.int32)
+    for i in range(n):
+        k = rng.integers(0, len(ORIENTATIONS))
+        theta = ORIENTATIONS[k] + rng.normal(scale=0.05)
+        phase = rng.uniform(0, 2 * np.pi)
+        wave = np.sin(2 * np.pi * freq *
+                      (xx * np.cos(theta) + yy * np.sin(theta)) + phase)
+        img = wave[:, :, None] + rng.normal(scale=0.25,
+                                            size=(SIZE, SIZE, 3))
+        imgs[i] = img
+        labels[i] = k
+    return imgs, labels
+
+
+def tiny_backbone():
+    return ResNet(stage_sizes=(1, 1), block=BasicBlock, width=16,
+                  num_classes=len(ORIENTATIONS), dtype=jnp.float32)
+
+
+def pretrain(module, imgs, labels, steps=60, batch=64, seed=0):
+    tx = optax.adam(3e-3)
+    state = init_train_state(module, jax.random.PRNGKey(seed), imgs[:1], tx)
+    step = make_train_step(module, tx)
+    rng = np.random.default_rng(seed)
+    loss = None
+    for s in range(steps):
+        sel = rng.choice(len(imgs), size=batch, replace=False)
+        state, loss = step(state, jnp.asarray(imgs[sel]),
+                           jnp.asarray(labels[sel]))
+    return state, float(loss)
+
+
+def probe_accuracy(variables, imgs, labels, holdout=100):
+    """Frozen backbone → ImageFeaturizer pooled features → linear head."""
+    loaded = LoadedModel(
+        schema=ModelSchema(name="tiny", input_size=SIZE,
+                           layer_names=("stage1", "stage2", "pooled",
+                                        "logits")),
+        module=tiny_backbone(), variables=variables)
+    feat = ImageFeaturizer(model=loaded, cutOutputLayers=1,
+                           autoResize=False, inputCol="image",
+                           outputCol="features")
+    df = DataFrame({"image": imgs,
+                    "label": labels.astype(np.float64)})
+    fdf = feat.transform(df)
+    # head sees only the frozen features (TrainClassifier featurizes every
+    # non-label column)
+    fdf = DataFrame({"features": np.asarray(fdf["features"]),
+                     "label": np.asarray(fdf["label"])})
+    from mmlspark_tpu.train import LogisticRegression
+    train_df = fdf.filter(np.arange(len(imgs)) >= holdout)
+    test_df = fdf.filter(np.arange(len(imgs)) < holdout)
+    head = TrainClassifier(model=LogisticRegression(maxIter=200),
+                           labelCol="label").fit(train_df)
+    pred = head.transform(test_df)["scored_labels"]
+    return float((pred == labels[:holdout]).mean())
+
+
+@pytest.mark.slow
+def test_frozen_backbone_transfer():
+    rng = np.random.default_rng(0)
+    # pretext: orientation @ frequency 4
+    pre_imgs, pre_labels = gratings(600, freq=4.0, rng=rng)
+    module = tiny_backbone()
+    state, loss = pretrain(module, pre_imgs, pre_labels)
+    assert np.isfinite(loss)
+
+    # downstream: orientation @ HELD-OUT frequency 7
+    down_imgs, down_labels = gratings(400, freq=7.0, rng=rng)
+    trained_vars = {"params": jax.tree.map(np.asarray, state.params),
+                    "batch_stats": jax.tree.map(np.asarray,
+                                                state.batch_stats)}
+    acc_pretrained = probe_accuracy(trained_vars, down_imgs, down_labels)
+
+    random_vars = tiny_backbone().init(jax.random.PRNGKey(99),
+                                       jnp.asarray(down_imgs[:1]), False)
+    acc_random = probe_accuracy(
+        {"params": jax.tree.map(np.asarray, random_vars["params"]),
+         "batch_stats": jax.tree.map(np.asarray,
+                                     random_vars["batch_stats"])},
+        down_imgs, down_labels)
+
+    # committed bar: frozen pretext features linearly separate the held-out
+    # task, and transfer beats random features
+    assert acc_pretrained > 0.8, (acc_pretrained, acc_random)
+    assert acc_pretrained >= acc_random, (acc_pretrained, acc_random)
