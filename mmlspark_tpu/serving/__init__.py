@@ -12,9 +12,13 @@ reference's semantics: in-flight requests are replayed if a batch fails
 (the epoch/history-queue mechanism of ``HTTPSourceV2.scala:488-517``).
 """
 
+from .distributed import (DistributedServingServer, DriverRegistry,
+                          RegistryClient, ServiceInfo, remote_worker_loop)
 from .server import ServingServer, serving_query
 from .udfs import make_reply_udf, send_reply_udf
 from .dsl import read_stream
 
-__all__ = ["ServingServer", "serving_query", "make_reply_udf",
-           "send_reply_udf", "read_stream"]
+__all__ = ["DistributedServingServer", "DriverRegistry", "RegistryClient",
+           "ServiceInfo", "ServingServer", "remote_worker_loop",
+           "serving_query", "make_reply_udf", "send_reply_udf",
+           "read_stream"]

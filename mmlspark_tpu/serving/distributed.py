@@ -1,0 +1,429 @@
+"""Distributed serving: worker registry, cross-worker replies, lease replay.
+
+Reference mapping (``continuous/HTTPSourceV2.scala``):
+
+- ``DriverServiceUtils.createDriverService`` (:133-194) — the driver-side
+  HTTP registry workers report to → :class:`DriverRegistry`.
+- ``WorkerClient.reportServerToDriver`` (:460-468) →
+  :class:`RegistryClient.register`.
+- ``WorkerServer.replyTo`` incl. cross-machine forwarding (:535+) —
+  request ids embed the owning worker (``<worker_id>/<uuid>``) and a reply
+  raised on any process is routed to the owner's internal ``__reply__``
+  endpoint → :meth:`DistributedServingServer.reply_to`.
+- epoch-tagged ``historyQueues``/``recoveredPartitions`` replay on task
+  retry (:488-517) → work *leases*: peers pull batches through the
+  internal ``__lease__`` endpoint; a lease that is not answered before its
+  deadline (worker crash) bumps the epoch and requeues the requests on
+  the owner, so the client-held connection is answered by a surviving
+  worker with no client-visible error.
+
+The data plane stays HTTP (like the reference's worker mesh); the
+model-compute plane inside each worker is the jitted pipeline.
+"""
+
+from __future__ import annotations
+
+import base64
+import dataclasses
+import http.client
+import json
+import queue
+import threading
+import time
+import uuid
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+import numpy as np
+
+from ..core import DataFrame
+from ..io.http.schema import HTTPRequestData, HTTPResponseData
+from .server import CachedRequest, ServingServer, _LOG
+
+
+@dataclasses.dataclass
+class ServiceInfo:
+    """Reference ``ServiceInfo`` — one worker's public coordinates."""
+    name: str
+    worker_id: str
+    host: str
+    port: int
+    api_path: str = "/"
+
+
+def _req_to_json(r: HTTPRequestData) -> dict:
+    return {"url": r.url, "method": r.method, "headers": dict(r.headers),
+            "entity_b64": base64.b64encode(r.entity or b"").decode()}
+
+
+def _req_from_json(d: dict) -> HTTPRequestData:
+    return HTTPRequestData(
+        url=d["url"], method=d["method"], headers=d["headers"],
+        entity=base64.b64decode(d["entity_b64"]) or None)
+
+
+def _resp_to_json(r: HTTPResponseData) -> dict:
+    return {"status_code": r.status_code, "reason": r.reason,
+            "headers": dict(r.headers),
+            "entity_b64": base64.b64encode(r.entity or b"").decode()}
+
+
+def _resp_from_json(d: dict) -> HTTPResponseData:
+    return HTTPResponseData(
+        status_code=d["status_code"], reason=d.get("reason", ""),
+        headers=d.get("headers", {}),
+        entity=base64.b64decode(d["entity_b64"]) or None)
+
+
+def _post(host: str, port: int, path: str, payload: dict,
+          timeout: float = 10.0) -> tuple[int, bytes]:
+    conn = http.client.HTTPConnection(host, port, timeout=timeout)
+    try:
+        conn.request("POST", path, body=json.dumps(payload).encode(),
+                     headers={"Content-Type": "application/json"})
+        resp = conn.getresponse()
+        return resp.status, resp.read()
+    finally:
+        conn.close()
+
+
+# ----------------------------------------------------------------- registry
+class DriverRegistry:
+    """Driver-side worker registry (reference ``DriverServiceUtils``
+    service, ``HTTPSourceV2.scala:133-194``)."""
+
+    def __init__(self, host: str = "127.0.0.1", port: int = 0):
+        self._services: dict[str, dict[str, ServiceInfo]] = {}
+        self._lock = threading.Lock()
+        registry = self
+
+        class Handler(BaseHTTPRequestHandler):
+            def do_POST(self):
+                length = int(self.headers.get("Content-Length", 0))
+                body = json.loads(self.rfile.read(length) or b"{}")
+                if self.path == "/register":
+                    info = ServiceInfo(**body)
+                    with registry._lock:
+                        registry._services.setdefault(
+                            info.name, {})[info.worker_id] = info
+                    out = registry._table_json(info.name)
+                elif self.path == "/unregister":
+                    with registry._lock:
+                        registry._services.get(body["name"], {}).pop(
+                            body["worker_id"], None)
+                    out = b"[]"
+                else:
+                    self.send_response(404)
+                    self.send_header("Content-Length", "0")
+                    self.end_headers()
+                    return
+                self.send_response(200)
+                self.send_header("Content-Length", str(len(out)))
+                self.end_headers()
+                self.wfile.write(out)
+
+            def do_GET(self):
+                if self.path.startswith("/services/"):
+                    name = self.path.split("/services/", 1)[1]
+                    out = registry._table_json(name)
+                    self.send_response(200)
+                    self.send_header("Content-Length", str(len(out)))
+                    self.end_headers()
+                    self.wfile.write(out)
+                else:
+                    self.send_response(404)
+                    self.send_header("Content-Length", "0")
+                    self.end_headers()
+
+            protocol_version = "HTTP/1.1"
+
+            def log_message(self, *args):
+                pass
+
+        self._httpd = ThreadingHTTPServer((host, port), Handler)
+        self.address = self._httpd.server_address
+        self._thread = threading.Thread(target=self._httpd.serve_forever,
+                                        daemon=True)
+
+    def _table_json(self, name: str) -> bytes:
+        with self._lock:
+            infos = list(self._services.get(name, {}).values())
+        return json.dumps([dataclasses.asdict(i) for i in infos]).encode()
+
+    def workers(self, name: str) -> list[ServiceInfo]:
+        with self._lock:
+            return list(self._services.get(name, {}).values())
+
+    def start(self):
+        self._thread.start()
+        return self
+
+    def stop(self):
+        self._httpd.shutdown()
+        self._httpd.server_close()
+
+
+class RegistryClient:
+    """Worker-side registry access (reference ``WorkerClient``)."""
+
+    def __init__(self, driver_address):
+        if isinstance(driver_address, str):
+            host, port = driver_address.rsplit(":", 1)
+            driver_address = (host, int(port))
+        self.driver_address = tuple(driver_address)
+
+    def register(self, info: ServiceInfo) -> list[ServiceInfo]:
+        status, body = _post(*self.driver_address, "/register",
+                             dataclasses.asdict(info))
+        if status != 200:
+            raise IOError(f"driver registry refused registration: {status}")
+        return [ServiceInfo(**d) for d in json.loads(body)]
+
+    def unregister(self, name: str, worker_id: str) -> None:
+        _post(*self.driver_address, "/unregister",
+              {"name": name, "worker_id": worker_id})
+
+    def workers(self, name: str) -> list[ServiceInfo]:
+        conn = http.client.HTTPConnection(*self.driver_address, timeout=10)
+        try:
+            conn.request("GET", f"/services/{name}")
+            resp = conn.getresponse()
+            return [ServiceInfo(**d) for d in json.loads(resp.read())]
+        finally:
+            conn.close()
+
+
+# ------------------------------------------------------------------- worker
+class DistributedServingServer(ServingServer):
+    """A ServingServer that participates in a worker mesh.
+
+    Adds: registration with the driver registry; internal ``__reply__``
+    (cross-worker reply delivery) and ``__lease__`` (peer work pulling)
+    endpoints; and a lease monitor that replays expired leases with an
+    epoch bump — the reference's recovered-partition replay, with worker
+    death detected by deadline instead of task re-registration.
+    """
+
+    def __init__(self, name: str, driver_address, *,
+                 worker_id: str | None = None, host: str = "127.0.0.1",
+                 port: int = 0, lease_timeout: float = 5.0, **kwargs):
+        super().__init__(name, host=host, port=port, **kwargs)
+        self.worker_id = worker_id or uuid.uuid4().hex[:12]
+        self.lease_timeout = lease_timeout
+        # replay-wave counter (observability; dedup itself is carried by
+        # CachedRequest's reply-exactly-once latch, so a late reply from a
+        # presumed-dead worker can still win if nobody answered yet)
+        self.epoch = 0
+        self._leases: dict[str, tuple[float, CachedRequest]] = {}
+        self.registry = RegistryClient(driver_address)
+        self._peers: dict[str, ServiceInfo] = {}
+        base = "" if self.api_path == "/" else self.api_path
+        self._routes[f"{base}/__reply__"] = self._handle_reply
+        self._routes[f"{base}/__lease__"] = self._handle_lease
+        self._monitor = threading.Thread(target=self._monitor_leases,
+                                         daemon=True)
+        self._stopping = threading.Event()
+
+    def _new_id(self) -> str:
+        # the owning worker rides inside the id, so any process can route
+        # a reply home (reference: machine ip inside the id triple)
+        return f"{self.worker_id}/{uuid.uuid4()}"
+
+    @property
+    def service_info(self) -> ServiceInfo:
+        return ServiceInfo(name=self.name, worker_id=self.worker_id,
+                           host=self.address[0], port=self.address[1],
+                           api_path=self.api_path)
+
+    def start(self):
+        super().start()
+        for info in self.registry.register(self.service_info):
+            self._peers[info.worker_id] = info
+        self._monitor.start()
+        return self
+
+    def stop(self):
+        self._stopping.set()
+        try:
+            self.registry.unregister(self.name, self.worker_id)
+        except Exception:
+            pass
+        super().stop()
+
+    # -- internal endpoints -------------------------------------------------
+    def _handle_reply(self, body: bytes) -> tuple[int, bytes]:
+        d = json.loads(body)
+        with self._lock:
+            cached = self.history.get(d["id"])
+        self._leases.pop(d["id"], None)
+        if cached is None:
+            return 404, b'{"delivered": false}'
+        ok = cached.reply(_resp_from_json(d["response"]))
+        return 200, json.dumps({"delivered": bool(ok)}).encode()
+
+    def _handle_lease(self, body: bytes) -> tuple[int, bytes]:
+        d = json.loads(body or b"{}")
+        n = int(d.get("max", 64))
+        batch: list[CachedRequest] = []
+        while len(batch) < n:
+            try:
+                batch.append(self.queue.get_nowait())
+            except queue.Empty:
+                break
+        deadline = time.monotonic() + self.lease_timeout
+        for c in batch:
+            self._leases[c.id] = (deadline, c)
+        out = [{"id": c.id, "request": _req_to_json(c.request)}
+               for c in batch]
+        return 200, json.dumps(out).encode()
+
+    def _monitor_leases(self):
+        while not self._stopping.wait(
+                min(self.lease_timeout / 4.0, 0.25)):
+            now = time.monotonic()
+            expired = [i for i, (dl, _) in list(self._leases.items())
+                       if dl < now]
+            if not expired:
+                continue
+            self.epoch += 1  # a worker died mid-lease: new replay wave
+            _LOG.warning("service %s: %d leases expired, replaying at "
+                         "epoch %d", self.name, len(expired), self.epoch)
+            for i in expired:
+                # a reply may land concurrently and pop the lease first —
+                # that request is answered, nothing to replay
+                entry = self._leases.pop(i, None)
+                if entry is not None and not entry[1]._event.is_set():
+                    self.replay(entry[1])
+
+    # -- cross-worker reply routing ----------------------------------------
+    def reply_to(self, request_id: str, response: HTTPResponseData) -> bool:
+        """Deliver a reply wherever the request was ingested (reference
+        ``WorkerServer.replyTo`` cross-machine branch)."""
+        owner = request_id.split("/", 1)[0]
+        if owner == self.worker_id:
+            with self._lock:
+                cached = self.history.get(request_id)
+            self._leases.pop(request_id, None)
+            return cached is not None and cached.reply(response)
+        info = self._peers.get(owner)
+        if info is None:
+            for i in self.registry.workers(self.name):
+                self._peers[i.worker_id] = i
+            info = self._peers.get(owner)
+        if info is None:
+            return False
+        base = "" if info.api_path == "/" else info.api_path
+        try:
+            status, body = _post(info.host, info.port, f"{base}/__reply__",
+                                 {"id": request_id,
+                                  "response": _resp_to_json(response)})
+        except OSError:
+            return False  # owner unreachable (crashed); bool contract
+        return status == 200 and json.loads(body).get("delivered", False)
+
+
+# ---------------------------------------------------------------- pull loop
+class _PeerConnections:
+    """Persistent keep-alive connections, one per ingest server — the
+    reference's ``WorkerClient`` reuses a pooled HttpClient for the same
+    reason (``HTTPSourceV2.scala:446-458``)."""
+
+    def __init__(self, timeout: float = 10.0):
+        self._conns: dict[tuple[str, int], http.client.HTTPConnection] = {}
+        self.timeout = timeout
+
+    def post(self, host: str, port: int, path: str,
+             payload: dict) -> tuple[int, bytes]:
+        key = (host, port)
+        body = json.dumps(payload).encode()
+        for attempt in (0, 1):  # one reconnect on a stale keep-alive
+            conn = self._conns.get(key)
+            if conn is None:
+                conn = http.client.HTTPConnection(host, port,
+                                                 timeout=self.timeout)
+                self._conns[key] = conn
+            try:
+                conn.request("POST", path, body=body,
+                             headers={"Content-Type": "application/json"})
+                resp = conn.getresponse()
+                return resp.status, resp.read()
+            except (OSError, http.client.HTTPException):
+                # stale keep-alive raises HTTPException subclasses
+                # (CannotSendRequest/BadStatusLine), not just OSError —
+                # either way the connection must be evicted, not reused
+                conn.close()
+                self._conns.pop(key, None)
+                if attempt:
+                    raise
+        raise AssertionError("unreachable")
+
+    def close(self):
+        for c in self._conns.values():
+            c.close()
+        self._conns.clear()
+
+
+def remote_worker_loop(driver_address, service_name: str, transform_fn,
+                       *, poll_interval: float = 0.01,
+                       max_idle_interval: float = 0.2,
+                       stop_event: threading.Event | None = None,
+                       max_batch: int = 64) -> None:
+    """A compute worker with no public ingress: leases request batches from
+    every registered ingest server, runs the pipeline, and posts replies
+    back to each request's owner. Run one per process for model-compute
+    scale-out behind fixed ingest endpoints.
+
+    ``transform_fn`` has the ServingQuery contract: DataFrame(id, request)
+    → DataFrame(id, reply). Connections to ingest servers are persistent
+    keep-alive, and the idle poll backs off from ``poll_interval`` to
+    ``max_idle_interval``.
+    """
+    client = RegistryClient(driver_address)
+    stop_event = stop_event or threading.Event()
+    conns = _PeerConnections()
+    idle = poll_interval
+    try:
+        while not stop_event.is_set():
+            try:
+                infos = client.workers(service_name)
+            except Exception:
+                time.sleep(max_idle_interval)
+                continue
+            got = False
+            for info in infos:
+                base = "" if info.api_path == "/" else info.api_path
+                try:
+                    status, body = conns.post(info.host, info.port,
+                                              f"{base}/__lease__",
+                                              {"max": max_batch})
+                except Exception:
+                    continue  # ingest server died; registry will catch up
+                if status != 200:
+                    continue
+                items = json.loads(body)
+                if not items:
+                    continue
+                got = True
+                ids = np.empty(len(items), object)
+                reqs = np.empty(len(items), object)
+                ids[:] = [i["id"] for i in items]
+                reqs[:] = [_req_from_json(i["request"]) for i in items]
+                try:
+                    out = transform_fn(
+                        DataFrame({"id": ids, "request": reqs}))
+                except Exception:
+                    continue  # lease expiry will replay the batch
+                for rid, reply in zip(out["id"], out["reply"]):
+                    try:
+                        conns.post(info.host, info.port,
+                                   f"{base}/__reply__",
+                                   {"id": rid,
+                                    "response": _resp_to_json(reply)})
+                    except Exception:
+                        pass
+            if got:
+                idle = poll_interval
+            else:
+                time.sleep(idle)
+                idle = min(idle * 2, max_idle_interval)
+    finally:
+        conns.close()

@@ -40,6 +40,16 @@ class _LinearParams(HasFeaturesCol, HasLabelCol, HasPredictionCol,
                         "are mapped back to the original scale)",
                         TC.toBoolean, default=True)
 
+    def _scaling(self, x):
+        """(mu, sd) for the standardized design. Without an intercept,
+        centering would smuggle one back in (SparkML scales but does NOT
+        center when fitIntercept=False) — so mu stays 0 then."""
+        center = self.getStandardize() and self.getFitIntercept()
+        mu = x.mean(axis=0) if center else np.zeros(x.shape[1])
+        sd = x.std(axis=0) + 1e-12 if self.getStandardize() \
+            else np.ones(x.shape[1])
+        return mu.astype(np.float32), sd.astype(np.float32)
+
 
 def _design(x, mu, sd, intercept: bool):
     z = (x - mu) / sd
@@ -129,18 +139,14 @@ class LogisticRegression(Estimator, _LinearParams, HasProbabilityCol,
         y = np.asarray(df[self.getLabelCol()], np.float32)
         w = (np.asarray(df[self.getWeightCol()], np.float32)
              if self.isSet("weightCol") else np.ones(len(y), np.float32))
-        mu = x.mean(axis=0) if self.getStandardize() else np.zeros(x.shape[1])
-        sd = x.std(axis=0) + 1e-12 if self.getStandardize() \
-            else np.ones(x.shape[1])
-        mu = mu.astype(np.float32)
-        sd = sd.astype(np.float32)
+        mu, sd = self._scaling(x)
         k = int(y.max()) + 1 if y.size else 2
         reg = self.getRegParam()
         if k <= 2:
             beta = _fit_binary_irls(
                 jnp.asarray(x), jnp.asarray(y), jnp.asarray(w),
                 jnp.asarray(mu), jnp.asarray(sd),
-                iters=min(self.getMaxIter(), 50), reg=reg,
+                iters=self.getMaxIter(), reg=reg,
                 intercept=self.getFitIntercept())
         else:
             beta = _fit_softmax_adam(
@@ -209,9 +215,7 @@ class LinearRegression(Estimator, _LinearParams):
         y = np.asarray(df[self.getLabelCol()], np.float32)
         w = (np.asarray(df[self.getWeightCol()], np.float32)
              if self.isSet("weightCol") else np.ones(len(y), np.float32))
-        mu = x.mean(axis=0) if self.getStandardize() else np.zeros(x.shape[1])
-        sd = x.std(axis=0) + 1e-12 if self.getStandardize() \
-            else np.ones(x.shape[1])
+        mu, sd = self._scaling(x)
         z = (x - mu) / sd
         if self.getFitIntercept():
             z = np.concatenate([z, np.ones((len(y), 1), np.float32)], axis=1)

@@ -1,0 +1,248 @@
+"""Multi-process distributed serving (VERDICT r1 item 6).
+
+Reference behaviors under test (``continuous/HTTPSourceV2.scala``):
+worker registration with the driver service (:460-468), cross-machine
+reply routing (:535+), and epoch replay of work lost to a dead worker
+(:488-517) — here as lease expiry. Workers are REAL subprocesses.
+"""
+
+import http.client
+import os
+import signal
+import subprocess
+import sys
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from mmlspark_tpu.io.http.schema import HTTPResponseData
+from mmlspark_tpu.serving import (DistributedServingServer, DriverRegistry,
+                                  RegistryClient, ServingServer,
+                                  remote_worker_loop, serving_query)
+
+HELPER = os.path.join(os.path.dirname(__file__),
+                      "serving_worker_helpers.py")
+
+
+def _post(addr, body: bytes, timeout=30):
+    conn = http.client.HTTPConnection(*addr, timeout=timeout)
+    try:
+        conn.request("POST", "/", body=body)
+        resp = conn.getresponse()
+        return resp.status, resp.read()
+    finally:
+        conn.close()
+
+
+def _spawn_worker(driver_addr, service: str, mode: str):
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    env["PYTHONPATH"] = "/root/repo" + os.pathsep + env.get("PYTHONPATH", "")
+    return subprocess.Popen(
+        [sys.executable, HELPER, f"{driver_addr[0]}:{driver_addr[1]}",
+         service, mode], env=env,
+        stdout=subprocess.DEVNULL, stderr=subprocess.DEVNULL)
+
+
+@pytest.fixture
+def driver():
+    reg = DriverRegistry().start()
+    yield reg
+    reg.stop()
+
+
+class TestRegistry:
+    def test_register_and_lookup(self, driver):
+        from mmlspark_tpu.serving import ServiceInfo
+        client = RegistryClient(driver.address)
+        table = client.register(ServiceInfo(
+            name="svc", worker_id="w1", host="127.0.0.1", port=1234))
+        assert [i.worker_id for i in table] == ["w1"]
+        client.register(ServiceInfo(
+            name="svc", worker_id="w2", host="127.0.0.1", port=1235))
+        assert {i.worker_id for i in client.workers("svc")} == {"w1", "w2"}
+        client.unregister("svc", "w1")
+        assert {i.worker_id for i in client.workers("svc")} == {"w2"}
+
+
+class TestCrossWorkerReply:
+    def test_request_on_a_answered_by_subprocess_b(self, driver):
+        server = DistributedServingServer(
+            "xsvc", driver.address, lease_timeout=10.0).start()
+        worker = _spawn_worker(driver.address, "xsvc", "echo")
+        try:
+            status, body = _post(server.address, b"hello world")
+            assert status == 200
+            pid_str, payload = body.split(b":", 1)
+            assert payload == b"HELLO WORLD"
+            # the reply came from the subprocess, not this process
+            assert int(pid_str) == worker.pid
+            assert int(pid_str) != os.getpid()
+        finally:
+            worker.kill()
+            worker.wait()
+            server.stop()
+
+    def test_reply_to_routes_across_servers(self, driver):
+        """Two ingest servers; a reply raised on B for a request owned by
+        A must land on A (the replyTo forwarding table)."""
+        a = DistributedServingServer("rsvc", driver.address,
+                                     worker_id="wa").start()
+        b = DistributedServingServer("rsvc", driver.address,
+                                     worker_id="wb").start()
+        try:
+            got = {}
+
+            def client():
+                got["resp"] = _post(a.address, b"ping")
+
+            t = threading.Thread(target=client)
+            t.start()
+            # pull A's request out of its queue directly (we play the
+            # processing engine here), then reply THROUGH B
+            cached = a.queue.get(timeout=5)
+            assert cached.id.startswith("wa/")
+            ok = b.reply_to(cached.id, HTTPResponseData(
+                status_code=200, entity=b"pong-from-b"))
+            assert ok
+            t.join(timeout=10)
+            assert got["resp"] == (200, b"pong-from-b")
+        finally:
+            a.stop()
+            b.stop()
+
+
+class TestLeaseReplay:
+    def test_killed_worker_replays_without_client_error(self, driver):
+        """Ingest on A; a hanging worker takes the lease and is SIGKILLed;
+        lease expiry replays the request; a healthy worker answers. The
+        client sees one clean 200 — no error, no duplicate."""
+        server = DistributedServingServer(
+            "ksvc", driver.address, lease_timeout=1.0,
+            reply_timeout=30.0).start()
+        hanger = _spawn_worker(driver.address, "ksvc", "hang")
+        result = {}
+
+        def client():
+            result["resp"] = _post(server.address, b"precious", timeout=30)
+
+        t = threading.Thread(target=client)
+        healthy = None
+        try:
+            t.start()
+            # wait until the hanging worker holds the lease
+            deadline = time.monotonic() + 10
+            while not server._leases and time.monotonic() < deadline:
+                time.sleep(0.02)
+            assert server._leases, "hanging worker never leased the request"
+            os.kill(hanger.pid, signal.SIGKILL)
+            hanger.wait()
+            epoch_before = server.epoch
+            healthy = _spawn_worker(driver.address, "ksvc", "echo")
+            t.join(timeout=25)
+            assert not t.is_alive(), "client never got an answer"
+            status, body = result["resp"]
+            assert status == 200
+            assert body.split(b":", 1)[1] == b"PRECIOUS"
+            assert server.epoch > epoch_before  # replay bumped the epoch
+        finally:
+            if healthy is not None:
+                healthy.kill()
+                healthy.wait()
+            if hanger.poll() is None:
+                hanger.kill()
+            server.stop()
+            t.join(timeout=1)
+
+    def test_lease_replay_respects_retry_bound(self, driver):
+        """A request that keeps getting leased and dropped is failed with
+        500 after max_retries (bounded replay, not an infinite loop)."""
+        server = DistributedServingServer(
+            "bsvc", driver.address, lease_timeout=0.2, max_retries=2,
+            reply_timeout=20.0).start()
+        result = {}
+
+        def client():
+            result["resp"] = _post(server.address, b"doomed", timeout=20)
+
+        t = threading.Thread(target=client)
+        t.start()
+        try:
+            # play a crashing worker: drain the queue without replying and
+            # pre-expire each lease (in-proc "crash")
+            deadline = time.monotonic() + 15
+            while t.is_alive() and time.monotonic() < deadline:
+                try:
+                    c = server.queue.get(timeout=0.1)
+                except Exception:
+                    continue
+                server._leases[c.id] = (time.monotonic() - 1,
+                                        c)  # instantly-expired lease
+            t.join(timeout=5)
+            assert not t.is_alive()
+            status, _ = result["resp"]
+            assert status == 500  # failed after bounded retries
+        finally:
+            server.stop()
+            t.join(timeout=1)
+
+
+class TestQueueBound:
+    def test_backpressure_503(self):
+        server = ServingServer("qsvc", max_queue=2,
+                               reply_timeout=5.0).start()
+        try:
+            codes = []
+            lock = threading.Lock()
+
+            def client():
+                try:
+                    s, _ = _post(server.address, b"x", timeout=8)
+                except Exception:
+                    s = -1
+                with lock:
+                    codes.append(s)
+
+            threads = [threading.Thread(target=client) for _ in range(6)]
+            for th in threads:
+                th.start()
+            for th in threads:
+                th.join(timeout=15)
+            # nobody processes the queue: 2 requests buffered (then 504 on
+            # timeout), the overflow must be rejected 503 immediately
+            assert codes.count(503) >= 3, codes
+        finally:
+            server.stop()
+
+
+class TestInProcessWorkerLoop:
+    def test_remote_worker_loop_function(self, driver):
+        """remote_worker_loop as a library call (thread instead of
+        process) — the N-ingest × M-compute topology in one test."""
+        servers = [DistributedServingServer("msvc", driver.address,
+                                            worker_id=f"m{i}").start()
+                   for i in range(2)]
+        stop = threading.Event()
+
+        def transform(df):
+            replies = np.empty(len(df), object)
+            replies[:] = [HTTPResponseData(
+                status_code=200, entity=(r.entity or b"") + b"!")
+                for r in df["request"]]
+            return df.with_column("reply", replies)
+
+        w = threading.Thread(target=remote_worker_loop,
+                             args=(driver.address, "msvc", transform),
+                             kwargs={"stop_event": stop}, daemon=True)
+        w.start()
+        try:
+            for i, s in enumerate(servers):
+                status, body = _post(s.address, f"req{i}".encode())
+                assert (status, body) == (200, f"req{i}!".encode())
+        finally:
+            stop.set()
+            w.join(timeout=5)
+            for s in servers:
+                s.stop()
