@@ -17,8 +17,9 @@ from .face import (DetectFace, FindSimilarFace, GroupFaces, IdentifyFaces,
                    VerifyFaces)
 from .anomaly import DetectAnomalies, DetectLastAnomaly
 from .bing import BingImageSearch
-from .speech import SpeechToText, SpeechToTextSDK
-from .azure_search import AzureSearchWriter
+from .speech import (ConversationTranscription, PullAudioInputStream,
+                     SpeechToText, SpeechToTextSDK, segment_pcm16)
+from .azure_search import AzureSearchWriter, validate_index_fields
 
 __all__ = [
     "CognitiveServiceBase", "TextSentiment", "KeyPhraseExtractor", "NER",
@@ -27,5 +28,7 @@ __all__ = [
     "GenerateThumbnails", "TagImage", "DetectFace", "FindSimilarFace",
     "GroupFaces", "IdentifyFaces", "VerifyFaces", "DetectAnomalies",
     "DetectLastAnomaly", "BingImageSearch", "SpeechToText",
-    "SpeechToTextSDK", "AzureSearchWriter",
+    "SpeechToTextSDK", "ConversationTranscription",
+    "PullAudioInputStream", "segment_pcm16", "AzureSearchWriter",
+    "validate_index_fields",
 ]

@@ -1,8 +1,11 @@
-"""Azure Search sink.
+"""Azure Search sink + index management.
 
-Reference ``cognitive/AzureSearch.scala`` (writer with index creation) and
-``AzureSearchAPI.scala``: create the index if missing, then POST row
-batches to ``/docs/index`` with ``@search.action`` per document.
+Reference ``cognitive/AzureSearch.scala`` (writer with index creation,
+schema/action validation) and ``cognitive/AzureSearchAPI.scala`` (index
+exists/list/statistics/delete management calls): create the index if
+missing, validate the field schema (exactly one key field, known types,
+legal actions), then POST row batches to ``/docs/index`` with
+``@search.action`` per document.
 """
 
 from __future__ import annotations
@@ -16,13 +19,43 @@ from ..io.http.clients import send_request
 from ..io.http.schema import HTTPRequestData
 
 
+VALID_ACTIONS = ("upload", "merge", "mergeOrUpload", "delete")
+VALID_EDM_TYPES = (
+    "Edm.String", "Edm.Boolean", "Edm.Int32", "Edm.Int64", "Edm.Double",
+    "Edm.DateTimeOffset", "Edm.GeographyPoint", "Collection(Edm.String)",
+    "Collection(Edm.Double)", "Collection(Edm.Single)")
+
+
+def validate_index_fields(index_fields: dict) -> list[dict]:
+    """Reference ``AzureSearch.scala`` ``checkSchemaParity``: exactly one
+    key field, every type a known EDM type. Returns normalized specs."""
+    fields = [{"name": name, **spec} if isinstance(spec, dict)
+              else {"name": name, "type": spec}
+              for name, spec in index_fields.items()]
+    keys = [f["name"] for f in fields if f.get("key")]
+    if len(keys) != 1:
+        raise ValueError(
+            f"exactly one field must have key=True, got {keys or 'none'}")
+    for f in fields:
+        if f.get("type") not in VALID_EDM_TYPES:
+            raise ValueError(
+                f"field {f['name']!r} has invalid EDM type "
+                f"{f.get('type')!r}; valid: {VALID_EDM_TYPES}")
+    return fields
+
+
 class AzureSearchWriter:
     def __init__(self, service_name: str, index_name: str, key: str,
                  index_fields: dict | None = None,
                  action: str = "mergeOrUpload", batch_size: int = 100,
-                 api_version: str = "2019-05-06"):
-        self.base = (f"https://{service_name}.search.windows.net"
-                     f"/indexes")
+                 api_version: str = "2019-05-06",
+                 base_url: str | None = None):
+        if action not in VALID_ACTIONS:
+            raise ValueError(f"action must be one of {VALID_ACTIONS}, "
+                             f"got {action!r}")
+        # base_url override keeps tests/self-hosted gateways reachable
+        self.base = base_url or (f"https://{service_name}"
+                                 f".search.windows.net/indexes")
         self.index_name = index_name
         self.key = key
         self.index_fields = index_fields
@@ -33,14 +66,46 @@ class AzureSearchWriter:
     def _headers(self):
         return {"Content-Type": "application/json", "api-key": self.key}
 
+    def _get(self, path: str):
+        return send_request(HTTPRequestData(
+            url=f"{self.base}{path}?api-version={self.api_version}",
+            method="GET", headers=self._headers()))
+
+    # ---- index management (reference AzureSearchAPI.scala) --------------
+    def index_exists(self, name: str | None = None) -> bool:
+        """Reference ``SearchIndex.exists``."""
+        resp = self._get(f"/{name or self.index_name}")
+        return 200 <= resp.status_code < 300
+
+    def list_indexes(self) -> list[str]:
+        """Reference ``SearchIndex.getExisting`` — index names."""
+        resp = self._get("")
+        if not 200 <= resp.status_code < 300:
+            raise IOError(f"list indexes failed: {resp.status_code}")
+        return [i["name"] for i in resp.json().get("value", [])]
+
+    def get_statistics(self, name: str | None = None) -> dict:
+        """Reference ``getStatistics`` — {documentCount, storageSize}."""
+        resp = self._get(f"/{name or self.index_name}/stats")
+        if not 200 <= resp.status_code < 300:
+            raise IOError(f"statistics failed: {resp.status_code}")
+        return resp.json()
+
+    def delete_index(self, name: str | None = None) -> bool:
+        resp = send_request(HTTPRequestData(
+            url=(f"{self.base}/{name or self.index_name}"
+                 f"?api-version={self.api_version}"),
+            method="DELETE", headers=self._headers()))
+        return 200 <= resp.status_code < 300
+
     def ensure_index(self) -> bool:
         """Create the index when a field schema was given (reference
-        ``SearchIndex.createIfNoneExists``)."""
+        ``SearchIndex.createIfNoneExists``); validates the schema first."""
         if not self.index_fields:
             return False
-        fields = [{"name": name, **spec} if isinstance(spec, dict)
-                  else {"name": name, "type": spec}
-                  for name, spec in self.index_fields.items()]
+        fields = validate_index_fields(self.index_fields)
+        if self.index_exists():
+            return False
         body = json.dumps({"name": self.index_name,
                            "fields": fields}).encode()
         resp = send_request(HTTPRequestData(

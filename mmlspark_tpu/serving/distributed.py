@@ -205,10 +205,16 @@ class DistributedServingServer(ServingServer):
 
     def __init__(self, name: str, driver_address, *,
                  worker_id: str | None = None, host: str = "127.0.0.1",
-                 port: int = 0, lease_timeout: float = 5.0, **kwargs):
+                 port: int = 0, lease_timeout: float = 5.0,
+                 mesh_secret: str = "", **kwargs):
         super().__init__(name, host=host, port=port, **kwargs)
         self.worker_id = worker_id or uuid.uuid4().hex[:12]
         self.lease_timeout = lease_timeout
+        # the internal endpoints share the public listener; when the
+        # server binds beyond localhost, set a mesh_secret so untrusted
+        # clients cannot lease (read!) other clients' queued requests —
+        # every internal payload must then carry {"secret": <value>}
+        self.mesh_secret = mesh_secret
         # replay-wave counter (observability; dedup itself is carried by
         # CachedRequest's reply-exactly-once latch, so a late reply from a
         # presumed-dead worker can still win if nobody answered yet)
@@ -249,9 +255,15 @@ class DistributedServingServer(ServingServer):
             pass
         super().stop()
 
+    def _check_secret(self, d: dict) -> bool:
+        return (not self.mesh_secret
+                or d.get("secret") == self.mesh_secret)
+
     # -- internal endpoints -------------------------------------------------
     def _handle_reply(self, body: bytes) -> tuple[int, bytes]:
         d = json.loads(body)
+        if not self._check_secret(d):
+            return 403, b'{"error": "bad mesh secret"}'
         with self._lock:
             cached = self.history.get(d["id"])
         self._leases.pop(d["id"], None)
@@ -262,6 +274,8 @@ class DistributedServingServer(ServingServer):
 
     def _handle_lease(self, body: bytes) -> tuple[int, bytes]:
         d = json.loads(body or b"{}")
+        if not self._check_secret(d):
+            return 403, b'{"error": "bad mesh secret"}'
         n = int(d.get("max", 64))
         batch: list[CachedRequest] = []
         while len(batch) < n:
@@ -315,7 +329,8 @@ class DistributedServingServer(ServingServer):
         try:
             status, body = _post(info.host, info.port, f"{base}/__reply__",
                                  {"id": request_id,
-                                  "response": _resp_to_json(response)})
+                                  "response": _resp_to_json(response),
+                                  "secret": self.mesh_secret})
         except OSError:
             return False  # owner unreachable (crashed); bool contract
         return status == 200 and json.loads(body).get("delivered", False)
@@ -366,7 +381,7 @@ def remote_worker_loop(driver_address, service_name: str, transform_fn,
                        *, poll_interval: float = 0.01,
                        max_idle_interval: float = 0.2,
                        stop_event: threading.Event | None = None,
-                       max_batch: int = 64) -> None:
+                       max_batch: int = 64, mesh_secret: str = "") -> None:
     """A compute worker with no public ingress: leases request batches from
     every registered ingest server, runs the pipeline, and posts replies
     back to each request's owner. Run one per process for model-compute
@@ -394,7 +409,8 @@ def remote_worker_loop(driver_address, service_name: str, transform_fn,
                 try:
                     status, body = conns.post(info.host, info.port,
                                               f"{base}/__lease__",
-                                              {"max": max_batch})
+                                              {"max": max_batch,
+                                               "secret": mesh_secret})
                 except Exception:
                     continue  # ingest server died; registry will catch up
                 if status != 200:
@@ -410,14 +426,20 @@ def remote_worker_loop(driver_address, service_name: str, transform_fn,
                 try:
                     out = transform_fn(
                         DataFrame({"id": ids, "request": reqs}))
+                    # ServingQuery contract: a transform may reply itself
+                    # (send_reply_udf) and return None / no "reply" column
+                    pairs = (list(zip(out["id"], out["reply"]))
+                             if out is not None and "reply" in getattr(
+                                 out, "columns", []) else [])
                 except Exception:
                     continue  # lease expiry will replay the batch
-                for rid, reply in zip(out["id"], out["reply"]):
+                for rid, reply in pairs:
                     try:
                         conns.post(info.host, info.port,
                                    f"{base}/__reply__",
                                    {"id": rid,
-                                    "response": _resp_to_json(reply)})
+                                    "response": _resp_to_json(reply),
+                                    "secret": mesh_secret})
                     except Exception:
                         pass
             if got:

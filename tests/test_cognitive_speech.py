@@ -1,0 +1,263 @@
+"""Streaming speech SDK + Azure Search index management (VERDICT r1
+item 9) against local mock services: pull-audio reads, VAD utterance
+segmentation, partial-result assembly, conversation transcription
+speaker attribution, and the index management API."""
+
+import json
+import threading
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+import numpy as np
+import pytest
+
+from mmlspark_tpu.core import DataFrame
+from mmlspark_tpu.cognitive import (AzureSearchWriter,
+                                    ConversationTranscription,
+                                    PullAudioInputStream, SpeechToTextSDK,
+                                    segment_pcm16, validate_index_fields)
+
+RATE = 16000
+
+
+def tone(seconds: float, freq=440.0, amp=8000):
+    t = np.arange(int(seconds * RATE)) / RATE
+    return (amp * np.sin(2 * np.pi * freq * t)).astype(np.int16)
+
+
+def silence(seconds: float):
+    return np.zeros(int(seconds * RATE), np.int16)
+
+
+def three_utterances():
+    """~0.5s tone, 0.5s gap, 0.7s tone, 0.5s gap, 0.4s tone."""
+    return np.concatenate([
+        silence(0.2), tone(0.5), silence(0.5), tone(0.7, 550),
+        silence(0.5), tone(0.4, 660), silence(0.2)])
+
+
+@pytest.fixture(scope="module")
+def speech_api():
+    """Mock STT endpoint: DisplayText reports the byte count so tests can
+    tie responses to the audio that was posted; /transcribe adds a
+    SpeakerId."""
+    class Handler(BaseHTTPRequestHandler):
+        def do_POST(self):
+            n = int(self.headers.get("Content-Length", 0))
+            body = self.rfile.read(n) if n else b""
+            out = {"RecognitionStatus": "Success",
+                   "DisplayText": f"heard {len(body)} bytes",
+                   "Offset": 0, "Duration": 0}
+            if self.path.startswith("/transcribe"):
+                out["SpeakerId"] = "Guest_0"
+            payload = json.dumps(out).encode()
+            self.send_response(200)
+            self.send_header("Content-Length", str(len(payload)))
+            self.end_headers()
+            self.wfile.write(payload)
+
+        def log_message(self, *a):
+            pass
+
+    httpd = ThreadingHTTPServer(("127.0.0.1", 0), Handler)
+    threading.Thread(target=httpd.serve_forever, daemon=True).start()
+    yield f"http://127.0.0.1:{httpd.server_address[1]}"
+    httpd.shutdown()
+
+
+class TestPullStream:
+    def test_fixed_frames_from_bytes(self):
+        data = bytes(range(256)) * 10
+        s = PullAudioInputStream(data, frame_bytes=300)
+        frames = []
+        while True:
+            f = s.read()
+            if not f:
+                break
+            frames.append(f)
+        assert b"".join(frames) == data
+        assert all(len(f) == 300 for f in frames[:-1])
+
+    def test_callable_source(self):
+        chunks = [b"abc", b"defgh", b""]
+        it = iter(chunks)
+        s = PullAudioInputStream(lambda: next(it), frame_bytes=4)
+        out = b""
+        while True:
+            f = s.read()
+            if not f:
+                break
+            out += f
+        assert out == b"abcdefgh"
+
+
+class TestVAD:
+    def test_three_utterances_found(self):
+        segs = segment_pcm16(three_utterances(), RATE)
+        assert len(segs) == 3
+        # ordered, non-overlapping, each covering roughly the tone lengths
+        durations = [(e - s) / RATE for s, e in segs]
+        assert 0.3 < durations[0] < 0.8
+        assert 0.5 < durations[1] < 1.0
+        assert 0.25 < durations[2] < 0.7
+        assert all(segs[i][1] <= segs[i + 1][0] for i in range(2))
+
+    def test_max_segment_cap(self):
+        segs = segment_pcm16(tone(5.0), RATE, max_segment_s=1.0)
+        assert len(segs) >= 4
+        assert all((e - s) / RATE <= 1.05 for s, e in segs)
+
+    def test_silence_only(self):
+        assert segment_pcm16(silence(1.0), RATE) == []
+
+
+class TestStreamingSDK:
+    def test_final_results_per_utterance(self, speech_api):
+        sdk = SpeechToTextSDK(url=f"{speech_api}/stt", outputCol="text")
+        sdk.set("subscriptionKey", "k")
+        sdk.setAudioDataCol("audio")
+        audio = np.empty(1, object)
+        audio[0] = three_utterances().tobytes()
+        out = sdk.transform(DataFrame({"audio": audio}))
+        rows = list(out["text"])
+        assert len(rows) == 3
+        assert all(r["RecognitionStatus"] == "Success" for r in rows)
+        assert all(r["DisplayText"].startswith("heard") for r in rows)
+        offsets = [r["Offset"] for r in rows]
+        assert offsets == sorted(offsets) and offsets[0] > 0
+        assert all(r["Duration"] > 0 for r in rows)
+        assert list(out["sourceRow"]) == [0, 0, 0]
+
+    def test_intermediate_hypotheses(self, speech_api):
+        sdk = SpeechToTextSDK(url=f"{speech_api}/stt", outputCol="text")
+        sdk.set("subscriptionKey", "k")
+        sdk.set("streamIntermediateResults", True)
+        sdk.set("intermediateInterval", 0.2)
+        sdk.setAudioDataCol("audio")
+        audio = np.empty(1, object)
+        audio[0] = np.concatenate([tone(0.8), silence(0.5)]).tobytes()
+        out = sdk.transform(DataFrame({"audio": audio}))
+        statuses = [r["RecognitionStatus"] for r in out["text"]]
+        assert statuses[-1] == "Success"
+        assert statuses.count("Recognizing") >= 2
+        # hypotheses grow monotonically within the utterance
+        partial_bytes = [int(r["DisplayText"].split()[1])
+                         for r in out["text"]]
+        assert partial_bytes == sorted(partial_bytes)
+
+    def test_multiple_rows_tagged(self, speech_api):
+        sdk = SpeechToTextSDK(url=f"{speech_api}/stt", outputCol="text")
+        sdk.set("subscriptionKey", "k")
+        sdk.setAudioDataCol("audio")
+        audio = np.empty(2, object)
+        audio[0] = np.concatenate([tone(0.4), silence(0.4)]).tobytes()
+        audio[1] = three_utterances().tobytes()
+        out = sdk.transform(DataFrame({"audio": audio}))
+        src = list(out["sourceRow"])
+        assert src.count(0) == 1 and src.count(1) == 3
+
+
+class TestConversationTranscription:
+    def test_speaker_attribution_and_participants(self, speech_api):
+        ct = ConversationTranscription(url=f"{speech_api}/transcribe",
+                                       outputCol="text")
+        ct.set("subscriptionKey", "k")
+        ct.setAudioDataCol("audio")
+        ct.set("participantsJson", json.dumps(
+            [{"name": "alice", "language": "en-US"},
+             {"name": "bob", "language": "en-US"}]))
+        audio = np.empty(1, object)
+        audio[0] = np.concatenate([tone(0.4), silence(0.4)]).tobytes()
+        out = ct.transform(DataFrame({"audio": audio}))
+        rows = list(out["text"])
+        assert len(rows) == 1
+        assert rows[0]["SpeakerId"] == "Guest_0"
+
+    def test_url_template(self):
+        ct = ConversationTranscription(outputCol="t")
+        ct.setLocation("eastus")
+        assert "transcribe.eastus.cts.speech" in ct.get("url")
+
+
+class TestAzureSearchIndexManagement:
+    def test_validate_index_fields(self):
+        ok = validate_index_fields({
+            "id": {"type": "Edm.String", "key": True},
+            "score": "Edm.Double"})
+        assert [f["name"] for f in ok] == ["id", "score"]
+        with pytest.raises(ValueError, match="exactly one"):
+            validate_index_fields({"a": "Edm.String"})
+        with pytest.raises(ValueError, match="exactly one"):
+            validate_index_fields({
+                "a": {"type": "Edm.String", "key": True},
+                "b": {"type": "Edm.String", "key": True}})
+        with pytest.raises(ValueError, match="invalid EDM"):
+            validate_index_fields({"a": {"type": "Edm.Bogus", "key": True}})
+
+    def test_invalid_action_rejected(self):
+        with pytest.raises(ValueError, match="action"):
+            AzureSearchWriter(service_name="s", index_name="i", key="k",
+                              action="replace")
+
+    def test_management_calls(self):
+        """Index management against a stateful mock registry."""
+        indexes: dict[str, dict] = {}
+
+        class Handler(BaseHTTPRequestHandler):
+            def _respond(self, code, obj=None):
+                payload = json.dumps(obj or {}).encode()
+                self.send_response(code)
+                self.send_header("Content-Length", str(len(payload)))
+                self.end_headers()
+                self.wfile.write(payload)
+
+            def do_GET(self):
+                path = self.path.split("?")[0]
+                if path == "/indexes":
+                    self._respond(200, {"value": [
+                        {"name": n} for n in indexes]})
+                elif path.endswith("/stats"):
+                    name = path.split("/")[2]
+                    if name in indexes:
+                        self._respond(200, {"documentCount": 0,
+                                            "storageSize": 0})
+                    else:
+                        self._respond(404)
+                else:
+                    name = path.split("/")[2]
+                    self._respond(200 if name in indexes else 404)
+
+            def do_POST(self):
+                n = int(self.headers.get("Content-Length", 0))
+                body = json.loads(self.rfile.read(n))
+                if self.path.split("?")[0] == "/indexes":
+                    indexes[body["name"]] = body
+                    self._respond(201, body)
+                else:
+                    self._respond(200, {"value": []})
+
+            def do_DELETE(self):
+                name = self.path.split("?")[0].split("/")[2]
+                self._respond(204 if indexes.pop(name, None) else 404)
+
+            def log_message(self, *a):
+                pass
+
+        httpd = ThreadingHTTPServer(("127.0.0.1", 0), Handler)
+        threading.Thread(target=httpd.serve_forever, daemon=True).start()
+        try:
+            base = f"http://127.0.0.1:{httpd.server_address[1]}/indexes"
+            w = AzureSearchWriter(
+                service_name="x", index_name="idx1", key="k",
+                index_fields={"id": {"type": "Edm.String", "key": True},
+                              "text": "Edm.String"},
+                base_url=base)
+            assert not w.index_exists()
+            assert w.ensure_index()      # created
+            assert w.index_exists()
+            assert not w.ensure_index()  # second call: already exists
+            assert w.list_indexes() == ["idx1"]
+            assert w.get_statistics()["documentCount"] == 0
+            assert w.delete_index()
+            assert not w.index_exists()
+        finally:
+            httpd.shutdown()

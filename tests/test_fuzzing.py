@@ -172,7 +172,8 @@ _EXCLUDED = {
     "GenerateThumbnails", "TagImage", "DetectFace", "FindSimilarFace",
     "GroupFaces", "IdentifyFaces", "VerifyFaces", "DetectAnomalies",
     "DetectLastAnomaly", "BingImageSearch", "SpeechToText",
-    "SpeechToTextSDK", "HTTPTransformer", "SimpleHTTPTransformer",
+    "SpeechToTextSDK", "ConversationTranscription", "HTTPTransformer",
+    "SimpleHTTPTransformer",
     "JSONInputParser", "JSONOutputParser", "CustomInputParser",
     "CustomOutputParser",
     # need a function/model/stage argument; fuzzed via dedicated tests

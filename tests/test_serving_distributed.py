@@ -39,7 +39,8 @@ def _post(addr, body: bytes, timeout=30):
 def _spawn_worker(driver_addr, service: str, mode: str):
     env = dict(os.environ)
     env["JAX_PLATFORMS"] = "cpu"
-    env["PYTHONPATH"] = "/root/repo" + os.pathsep + env.get("PYTHONPATH", "")
+    repo_root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    env["PYTHONPATH"] = repo_root + os.pathsep + env.get("PYTHONPATH", "")
     return subprocess.Popen(
         [sys.executable, HELPER, f"{driver_addr[0]}:{driver_addr[1]}",
          service, mode], env=env,
@@ -187,6 +188,27 @@ class TestLeaseReplay:
         finally:
             server.stop()
             t.join(timeout=1)
+
+
+class TestMeshSecret:
+    def test_lease_requires_secret(self, driver):
+        import json as _json
+        server = DistributedServingServer(
+            "ssvc", driver.address, mesh_secret="s3cret").start()
+        try:
+            conn = http.client.HTTPConnection(*server.address, timeout=5)
+            conn.request("POST", "/__lease__",
+                         body=_json.dumps({"max": 4}).encode())
+            assert conn.getresponse().status == 403
+            conn.close()
+            conn = http.client.HTTPConnection(*server.address, timeout=5)
+            conn.request("POST", "/__lease__", body=_json.dumps(
+                {"max": 4, "secret": "s3cret"}).encode())
+            resp = conn.getresponse()
+            assert resp.status == 200 and _json.loads(resp.read()) == []
+            conn.close()
+        finally:
+            server.stop()
 
 
 class TestQueueBound:
