@@ -1,0 +1,37 @@
+"""AutoML: TuneHyperparameters random sweep over a LightGBM space +
+FindBestModel (docs/automl.md; reference TuneHyperparameters)."""
+
+from _common import binary_table, done
+
+import numpy as np
+
+from mmlspark_tpu.automl import (DiscreteHyperParam, DoubleRangeHyperParam,
+                                 FindBestModel, HyperparamBuilder,
+                                 TuneHyperparameters)
+from mmlspark_tpu.core import DataFrame
+from mmlspark_tpu.lightgbm import LightGBMClassifier
+
+import numpy as _np
+
+x, cat, _ = binary_table(n=300)
+# label derived from the visible features only
+y = ((x[:, 0] + 0.5 * x[:, 1] * x[:, 2]) > 0).astype(_np.float32)
+df = DataFrame({"features": x, "label": y})
+
+est = LightGBMClassifier(numIterations=8, minDataInLeaf=5)
+space = (HyperparamBuilder()
+         .addHyperparam(est, "numLeaves", DiscreteHyperParam([4, 15]))
+         .addHyperparam(est, "learningRate",
+                        DoubleRangeHyperParam(0.05, 0.4))).build()
+tuned = TuneHyperparameters(models=[est], paramSpace=space, numFolds=2,
+                            numRuns=3, evaluationMetric="accuracy",
+                            labelCol="label").fit(df)
+print("best metric:", tuned.get("bestMetric"))
+assert tuned.get("bestMetric") > 0.8
+assert "prediction" in tuned.transform(df).columns
+
+m_small = LightGBMClassifier(numIterations=2, minDataInLeaf=5).fit(df)
+m_big = LightGBMClassifier(numIterations=15, minDataInLeaf=5).fit(df)
+best = FindBestModel(models=[m_small, m_big], labelCol="label").fit(df)
+assert "prediction" in best.transform(df).columns
+done("automl_sweep")

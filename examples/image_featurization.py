@@ -1,0 +1,47 @@
+"""ImageFeaturizer: resize → backbone → pooled features → cheap head
+(docs/image.md; the reference's transfer-learning flagship shape)."""
+
+from _common import done
+
+import numpy as np
+
+import jax.numpy as jnp
+
+from mmlspark_tpu.core import DataFrame
+from mmlspark_tpu.image import ImageFeaturizer
+from mmlspark_tpu.models.resnet import BasicBlock, ResNet
+from mmlspark_tpu.models.zoo import LoadedModel, ModelSchema
+from mmlspark_tpu.train import LogisticRegression
+
+rng = np.random.default_rng(0)
+# two visually distinct classes: horizontal vs vertical stripes
+imgs = np.zeros((80, 32, 32, 3), np.float32)
+labels = np.zeros(80, np.float32)
+for i in range(80):
+    if i % 2:
+        imgs[i, ::4, :, :] = 1.0
+        labels[i] = 1.0
+    else:
+        imgs[i, :, ::4, :] = 1.0
+imgs += rng.normal(scale=0.1, size=imgs.shape).astype(np.float32)
+
+module = ResNet(stage_sizes=(1, 1), block=BasicBlock, width=8,
+                num_classes=4, dtype=jnp.float32)
+variables = module.init(__import__("jax").random.PRNGKey(0),
+                        jnp.asarray(imgs[:1]), False)
+loaded = LoadedModel(
+    schema=ModelSchema(name="tiny", input_size=32,
+                       layer_names=("stage1", "stage2", "pooled",
+                                    "logits")),
+    module=module, variables=variables)
+
+feat = ImageFeaturizer(inputCol="image", outputCol="features",
+                       cutOutputLayers=1, autoResize=False)
+feat.setModel(loaded)
+fdf = feat.transform(DataFrame({"image": imgs, "label": labels}))
+head = LogisticRegression(maxIter=30).fit(
+    DataFrame({"features": np.asarray(fdf["features"]), "label": labels}))
+acc = float((head.transform(fdf)["prediction"] == labels).mean())
+print("accuracy:", acc)
+assert acc > 0.9, acc
+done("image_featurization")

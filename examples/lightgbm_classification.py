@@ -1,0 +1,32 @@
+"""LightGBMClassifier end-to-end: featurize → train → evaluate → native
+model roundtrip (docs/lightgbm.md pipeline; the reference's Adult Census
+quickstart shape)."""
+
+from _common import binary_table, done
+
+import numpy as np
+
+from mmlspark_tpu.core import DataFrame, Pipeline
+from mmlspark_tpu.featurize import Featurize
+from mmlspark_tpu.lightgbm import LightGBMClassifier
+from mmlspark_tpu.train import ComputeModelStatistics
+
+x, cat, y = binary_table()
+df = DataFrame({"num": x, "color": np.asarray(cat, object), "label": y})
+
+pipe = Pipeline(stages=[
+    Featurize(inputCols=["num", "color"], outputCol="features"),
+    LightGBMClassifier(numIterations=25, numLeaves=15, minDataInLeaf=5),
+])
+model = pipe.fit(df)
+scored = model.transform(df)
+
+stats = ComputeModelStatistics(labelCol="label").transform(scored)
+auc = float(stats["AUC"][0])
+print("AUC:", auc)
+assert auc > 0.9, auc
+
+gbm = model.getStages()[-1]
+text = gbm.get_native_model_string()
+assert "split_feature=" in text
+done("lightgbm_classification")

@@ -1,0 +1,55 @@
+"""Serving: a jitted pipeline behind a live HTTP endpoint with dynamic
+batching and reply routing (docs/serving.md; reference Spark Serving)."""
+
+from _common import done
+
+import http.client
+import json
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+from mmlspark_tpu.io.http.schema import HTTPResponseData
+from mmlspark_tpu.serving import serving_query
+
+w = jnp.asarray(np.random.default_rng(3).normal(size=(8, 8)), jnp.float32)
+
+
+@jax.jit
+def score(x):
+    return jnp.tanh(x @ w).sum(axis=-1)
+
+
+score(jnp.zeros((1, 8), jnp.float32)).block_until_ready()
+
+
+def transform(df):
+    xs = np.stack([
+        np.frombuffer(r.entity, np.float32) if r.entity
+        and len(r.entity) == 32 else np.zeros(8, np.float32)
+        for r in df["request"]])
+    ys = np.asarray(score(jnp.asarray(xs)))
+    replies = np.empty(len(ys), object)
+    replies[:] = [HTTPResponseData(
+        status_code=200, entity=json.dumps(float(v)).encode()) for v in ys]
+    return df.with_column("reply", replies)
+
+
+query = serving_query("example", transform, reply_timeout=15.0)
+try:
+    host, port = query.server.address
+    conn = http.client.HTTPConnection(host, port, timeout=10)
+    payload = np.arange(8, dtype=np.float32).tobytes()
+    for _ in range(5):
+        conn.request("POST", "/", body=payload)
+        resp = conn.getresponse()
+        body = resp.read()
+        assert resp.status == 200
+        assert isinstance(json.loads(body), float)
+    conn.close()
+    print("served 5 requests")
+finally:
+    query.stop()
+done("serving_pipeline")

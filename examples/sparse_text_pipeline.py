@@ -1,0 +1,33 @@
+"""Hashed text features feeding the sparse GBDT path: VW featurizer at
+2^18 dims → padded-COO training (docs/vw.md + sparse engine)."""
+
+from _common import done
+
+import numpy as np
+
+from mmlspark_tpu.core import DataFrame, Pipeline
+from mmlspark_tpu.lightgbm import LightGBMClassifier, roc_auc
+from mmlspark_tpu.vw import VowpalWabbitFeaturizer
+
+rng = np.random.default_rng(1)
+words = ["spark", "tpu", "jax", "pallas", "mesh", "shard", "psum", "grid"]
+texts, labels = [], []
+for _ in range(300):
+    k = rng.integers(2, 6)
+    pick = rng.choice(len(words), size=k, replace=False)
+    texts.append(" ".join(words[i] for i in pick))
+    labels.append(float(0 in pick or 3 in pick))
+
+df = DataFrame({"text": np.asarray(texts, object),
+                "label": np.asarray(labels, np.float32)})
+pipe = Pipeline(stages=[
+    VowpalWabbitFeaturizer(inputCols=["text"], stringSplitInputCols=["text"],
+                           numBits=18, outputCol="features"),
+    LightGBMClassifier(numIterations=15, numLeaves=7, minDataInLeaf=5,
+                       learningRate=0.3, sparseFeatureCount=2 ** 18),
+])
+out = pipe.fit(df).transform(df)
+auc = roc_auc(np.asarray(labels), out["probability"][:, 1])
+print("AUC:", auc)
+assert auc > 0.9, auc
+done("sparse_text_pipeline")

@@ -12,8 +12,10 @@ become:
   (the reference ships its wrappers inside a Spark distribution).
 """
 
+from .rgen import generate_r, r_function_for, snake_case
 from .wrappable import (generate_all, generate_docs, generate_stubs,
                         param_type_hint, py_stub_for)
 
-__all__ = ["generate_all", "generate_docs", "generate_stubs",
+__all__ = ["generate_r", "r_function_for", "snake_case",
+           "generate_all", "generate_docs", "generate_stubs",
            "param_type_hint", "py_stub_for"]

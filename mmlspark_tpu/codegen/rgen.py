@@ -1,0 +1,106 @@
+"""R binding generation (reticulate-backed).
+
+Reference ``codegen/Wrappable.scala:471-495`` (``RWrappable``): every stage
+renders a sparklyr-style R function ``ml_<snake_case_name>(...)`` with the
+full param surface. The reference calls into the JVM via sparklyr's
+invoke; here the generated functions call the Python package through
+``reticulate`` — the R-native path to a Python/JAX runtime.
+
+Output: one ``R/<package>.R`` file per stage package plus a loader, all
+plain text (no R toolchain required to generate; an R runtime with
+``reticulate`` is required to *use* them).
+"""
+
+from __future__ import annotations
+
+import inspect
+import os
+import re
+from collections import defaultdict
+
+from ..core import ServiceParam
+from ..testing.fuzzing import iter_stage_classes
+from .wrappable import param_type_hint
+
+_R_DEFAULTS = {
+    "int": "NULL", "float": "NULL", "bool": "NULL", "str": "NULL",
+    "list[str]": "NULL", "list[int]": "NULL", "list[float]": "NULL",
+    "dict": "NULL", "Any": "NULL",
+}
+
+
+def snake_case(name: str) -> str:
+    s = re.sub(r"(?<=[a-z0-9])([A-Z])", r"_\1", name)
+    return re.sub(r"([A-Z]+)([A-Z][a-z])", r"\1_\2", s).lower()
+
+
+def r_function_for(cls) -> str:
+    """One R wrapper function (reference RWrappable.rClass)."""
+    fn = "ml_" + snake_case(cls.__name__)
+    params = sorted(cls.params(), key=lambda p: p.name)
+    arg_names = [snake_case(p.name) for p in params]
+    args = ", ".join(f"{a} = {_R_DEFAULTS.get(param_type_hint(p), 'NULL')}"
+                     for a, p in zip(arg_names, params))
+    doc = (inspect.getdoc(cls) or "").splitlines()
+    title = doc[0] if doc else cls.__name__
+    lines = [
+        f"#' {title}",
+        "#'",
+    ]
+    for p, a in zip(params, arg_names):
+        lines.append(f"#' @param {a} {p.doc}")
+    lines += [
+        "#' @export",
+        f"{fn} <- function({args}) {{" if args else f"{fn} <- function() {{",
+        f"  mod <- reticulate::import(\"{cls.__module__}\")",
+        "  kwargs <- list()",
+    ]
+    for p, a in zip(params, arg_names):
+        lines.append(f"  if (!is.null({a})) kwargs[[\"{p.name}\"]] <- {a}")
+    lines += [
+        f"  do.call(mod${cls.__name__}, kwargs)",
+        "}",
+    ]
+    # ServiceParams additionally get the Col-binding setter the Scala
+    # codegen exposes (setXCol)
+    for p, a in zip(params, arg_names):
+        if isinstance(p, ServiceParam):
+            lines += [
+                "",
+                f"#' Bind the {p.name} argument of a fitted stage to a "
+                "column",
+                "#' @export",
+                f"{fn}_set_{a}_col <- function(stage, col) {{",
+                f"  stage$set{p.name[0].upper() + p.name[1:]}Col(col)",
+                "}",
+            ]
+    return "\n".join(lines)
+
+
+def generate_r(out_dir: str) -> list[str]:
+    """Write one R source file per stage package + a package loader."""
+    by_pkg: dict[str, list] = defaultdict(list)
+    for cls in iter_stage_classes():
+        by_pkg[cls.__module__.split(".")[1]].append(cls)
+    os.makedirs(out_dir, exist_ok=True)
+    written = []
+    for pkg, classes in sorted(by_pkg.items()):
+        path = os.path.join(out_dir, f"{pkg}.R")
+        body = "\n\n\n".join(
+            r_function_for(c)
+            for c in sorted(classes, key=lambda c: c.__name__))
+        with open(path, "w") as f:
+            f.write("# Auto-generated R bindings — regenerate with\n"
+                    "#   python -m mmlspark_tpu.codegen\n\n" + body + "\n")
+        written.append(path)
+    loader = os.path.join(out_dir, "zzz.R")
+    with open(loader, "w") as f:
+        f.write(
+            "# package hooks: verify the Python side is importable\n"
+            ".onLoad <- function(libname, pkgname) {\n"
+            "  if (!reticulate::py_module_available(\"mmlspark_tpu\"))\n"
+            "    warning(\"python package mmlspark_tpu not found; \",\n"
+            "            \"install it in the active python env\")\n"
+            "}\n")
+    written.append(loader)
+    return written

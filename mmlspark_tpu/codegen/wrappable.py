@@ -137,6 +137,8 @@ def generate_docs(out_path: str) -> str:
 
 
 def generate_all(base_dir: str = "generated") -> dict:
+    from .rgen import generate_r
     stubs = generate_stubs(os.path.join(base_dir, "stubs"))
     docs = generate_docs(os.path.join(base_dir, "docs", "api.md"))
-    return {"stubs": stubs, "docs": docs}
+    r = generate_r(os.path.join(base_dir, "R"))
+    return {"stubs": stubs, "docs": docs, "r": r}

@@ -79,14 +79,18 @@ class CognitiveServiceBase(Transformer, HasOutputCol):
             h["Ocp-Apim-Subscription-Key"] = str(key)
         return h
 
-    def _build_request(self, df, row: int) -> HTTPRequestData | None:
+    def _build_url(self, df, row: int) -> str:
         url = self.get("url")
         params = {k: v for k, v in self._url_params(df, row).items()
                   if v is not None}
         if params:
             from urllib.parse import urlencode
             url = url + ("&" if "?" in url else "?") + urlencode(params)
-        return HTTPRequestData(url=url, method=self._method,
+        return url
+
+    def _build_request(self, df, row: int) -> HTTPRequestData | None:
+        return HTTPRequestData(url=self._build_url(df, row),
+                               method=self._method,
                                headers=self._headers(df, row),
                                entity=self._body(df, row))
 

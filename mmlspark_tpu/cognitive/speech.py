@@ -56,7 +56,10 @@ class PullAudioInputStream:
 
     def __init__(self, source, frame_bytes: int = 3200):
         self.frame_bytes = frame_bytes
-        self._buffer = b""
+        # immutable buffer + read offset: frame extraction is O(frame)
+        # per call, not O(remaining) reslicing
+        self._buffer = memoryview(b"")
+        self._pos = 0
         self._exhausted = False
         self._file = None
         if isinstance(source, (bytes, bytearray, np.ndarray)):
@@ -72,7 +75,8 @@ class PullAudioInputStream:
 
     def read(self) -> bytes:
         """Next frame (<= frame_bytes); b'' = end of stream."""
-        while len(self._buffer) < self.frame_bytes and not self._exhausted:
+        while (len(self._buffer) - self._pos < self.frame_bytes
+               and not self._exhausted):
             try:
                 chunk = self._next_chunk()
             except StopIteration:
@@ -82,9 +86,11 @@ class PullAudioInputStream:
                 if self._file is not None:
                     self._file.close()
                 break
-            self._buffer += chunk
-        out, self._buffer = (self._buffer[:self.frame_bytes],
-                             self._buffer[self.frame_bytes:])
+            remaining = bytes(self._buffer[self._pos:])
+            self._buffer = memoryview(remaining + bytes(chunk))
+            self._pos = 0
+        out = bytes(self._buffer[self._pos:self._pos + self.frame_bytes])
+        self._pos += len(out)
         return out
 
 
@@ -157,13 +163,8 @@ class SpeechToTextSDK(SpeechToText):
         """One REST recognition request (the SDK's per-utterance service
         hop); sent in bulk through the async client."""
         from ..io.http.schema import HTTPRequestData
-        url = self.get("url")
-        params = {k: v for k, v in self._url_params(df, row).items()
-                  if v is not None}
-        if params:
-            from urllib.parse import urlencode
-            url = url + ("&" if "?" in url else "?") + urlencode(params)
-        return HTTPRequestData(url=url, method="POST",
+        return HTTPRequestData(url=self._build_url(df, row),
+                               method="POST",
                                headers=self._headers(df, row),
                                entity=seg_bytes)
 
@@ -196,17 +197,9 @@ class SpeechToTextSDK(SpeechToText):
         requests = []
         meta = []  # (src_row, status, offset_samples, n_samples)
         for i in range(len(df)):
-            stream = PullAudioInputStream(
-                bytes(self._resolve("audioData", df, i)),
-                frame_bytes=frame_bytes)
-            # the continuous-recognition read loop over the pull stream
-            frames = []
-            while True:
-                frame = stream.read()
-                if not frame:
-                    break
-                frames.append(frame)
-            data = b"".join(frames)
+            # batch rows already hold complete audio; PullAudioInputStream
+            # remains the API for genuinely incremental sources
+            data = bytes(self._resolve("audioData", df, i))
             audio = np.frombuffer(
                 data[:len(data) // 2 * 2], dtype="<i2")
             segments = segment_pcm16(
@@ -237,11 +230,18 @@ class SpeechToTextSDK(SpeechToText):
         src_rows: list[int] = []
         for (i, status, s, n), resp in zip(meta, responses):
             if 200 <= resp.status_code < 300:
-                parsed, err = resp.json(), None
+                try:
+                    parsed, err = resp.json(), None
+                except Exception as e:  # one bad body ≠ whole batch lost
+                    parsed, err = None, f"parse error: {e}"
+                    if status == "Success":
+                        status = "Error"
             else:
                 parsed = None
                 err = {"statusCode": resp.status_code,
-                       "reason": resp.reason}
+                       "reason": resp.reason,
+                       "response": resp.entity.decode("utf-8", "replace")
+                       if resp.entity else None}
                 if status == "Success":
                     status = "Error"
             results.append(self._result_row(parsed, status, s, n, rate))

@@ -7,7 +7,9 @@ and the PowerBI streaming sink.
 
 from .binary import (BinaryFileReader, decode_image, read_binary_files,
                      read_images)
+from .image_source import FileStreamSource, ImageStreamSource
 from .powerbi import PowerBIWriter
 
 __all__ = ["BinaryFileReader", "decode_image", "read_binary_files",
-           "read_images", "PowerBIWriter"]
+           "read_images", "PowerBIWriter", "FileStreamSource",
+           "ImageStreamSource"]

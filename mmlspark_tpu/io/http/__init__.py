@@ -9,12 +9,13 @@ concurrency (``Clients.scala:12-63``), HTTPTransformer/SimpleHTTPTransformer
 from .schema import (HTTPRequestData, HTTPResponseData, string_to_response,
                      request_to_string)
 from .clients import AsyncClient, SingleThreadedClient
+from .port_forwarding import SshTunnel, TcpForwarder
 from .shared import SharedSingleton, SharedVariable
 from .transformer import (CustomInputParser, CustomOutputParser,
                           HTTPTransformer, JSONInputParser,
                           JSONOutputParser, SimpleHTTPTransformer)
 
-__all__ = ["HTTPRequestData", "HTTPResponseData", "string_to_response",
+__all__ = ["SshTunnel", "TcpForwarder", "HTTPRequestData", "HTTPResponseData", "string_to_response",
            "request_to_string", "AsyncClient", "SingleThreadedClient",
            "SharedSingleton", "SharedVariable", "CustomInputParser",
            "CustomOutputParser", "HTTPTransformer", "JSONInputParser",

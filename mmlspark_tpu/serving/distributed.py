@@ -256,8 +256,10 @@ class DistributedServingServer(ServingServer):
         super().stop()
 
     def _check_secret(self, d: dict) -> bool:
+        import hmac
         return (not self.mesh_secret
-                or d.get("secret") == self.mesh_secret)
+                or hmac.compare_digest(str(d.get("secret", "")),
+                                       self.mesh_secret))
 
     # -- internal endpoints -------------------------------------------------
     def _handle_reply(self, body: bytes) -> tuple[int, bytes]:

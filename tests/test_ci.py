@@ -1,0 +1,50 @@
+"""CI harness meta-tests (reference FuzzingTest-style ecosystem
+invariants, applied to the CI matrix): every test file belongs to a CI
+package, every example is discoverable and runnable."""
+
+import importlib.util
+import os
+import subprocess
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _load(path, name):
+    spec = importlib.util.spec_from_file_location(name, path)
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+run_ci = _load(os.path.join(REPO, "ci", "run_ci.py"), "run_ci")
+run_all = _load(os.path.join(REPO, "examples", "run_all.py"), "run_all")
+
+
+def test_every_test_file_assigned_to_a_package():
+    assigned = {f for files in run_ci.PACKAGES.values() for f in files}
+    present = {f for f in os.listdir(os.path.join(REPO, "tests"))
+               if f.startswith("test_") and f.endswith(".py")}
+    missing_from_matrix = present - assigned
+    stale_in_matrix = assigned - present
+    assert not missing_from_matrix, (
+        f"add these to a ci/run_ci.py package: {sorted(missing_from_matrix)}")
+    assert not stale_in_matrix, (
+        f"ci/run_ci.py references deleted tests: {sorted(stale_in_matrix)}")
+
+
+def test_examples_discovered():
+    names = run_all.discover()
+    assert len(names) >= 5
+    assert "run_all.py" not in names and "_common.py" not in names
+
+
+def test_one_example_runs():
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    proc = subprocess.run(
+        [sys.executable, os.path.join(REPO, "examples",
+                                      "serving_pipeline.py")],
+        cwd=os.path.join(REPO, "examples"), env=env,
+        capture_output=True, text=True, timeout=300)
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+    assert "EXAMPLE_OK serving_pipeline" in proc.stdout

@@ -1,0 +1,210 @@
+"""Long-tail coverage gaps (VERDICT r1 table #7/#33/#55/#57): port
+forwarding, dataclass↔row codecs + categorical metadata, R binding
+generation, streaming file/image source."""
+
+import dataclasses
+import http.client
+import json
+import os
+import threading
+import time
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+import numpy as np
+import pytest
+
+from mmlspark_tpu.core import DataFrame
+from mmlspark_tpu.core.bindings import (ColumnMetadata, DataclassBindings,
+                                        bindings)
+from mmlspark_tpu.io import FileStreamSource, ImageStreamSource
+from mmlspark_tpu.io.http import SshTunnel, TcpForwarder
+
+
+# ------------------------------------------------------------- forwarding
+class TestTcpForwarder:
+    def test_http_through_relay(self):
+        class Handler(BaseHTTPRequestHandler):
+            def do_GET(self):
+                body = b"behind-the-relay"
+                self.send_response(200)
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+
+            def log_message(self, *a):
+                pass
+
+        httpd = ThreadingHTTPServer(("127.0.0.1", 0), Handler)
+        threading.Thread(target=httpd.serve_forever, daemon=True).start()
+        fwd = TcpForwarder(*httpd.server_address).start()
+        try:
+            conn = http.client.HTTPConnection(*fwd.local_address,
+                                              timeout=5)
+            conn.request("GET", "/")
+            resp = conn.getresponse()
+            assert (resp.status, resp.read()) == (200, b"behind-the-relay")
+            conn.close()
+        finally:
+            fwd.stop()
+            httpd.shutdown()
+
+
+class TestSshTunnel:
+    def test_command_construction(self):
+        t = SshTunnel("bastion.example", local_port=8080, remote_port=80,
+                      remote_host="10.0.0.5", user="svc",
+                      key_file="/k/id", keepalive_s=15)
+        cmd = t.command()
+        assert cmd[:2] == ["ssh", "-N"]
+        assert "-L" in cmd and "8080:10.0.0.5:80" in cmd
+        assert "ServerAliveInterval=15" in " ".join(cmd)
+        assert "-i" in cmd and "/k/id" in cmd
+        assert cmd[-1] == "svc@bastion.example"
+        rev = SshTunnel("b", local_port=1, remote_port=2, reverse=True)
+        assert "-R" in rev.command()
+        assert "2:127.0.0.1:1" in rev.command()
+
+    def test_start_without_ssh_fails_loudly(self, monkeypatch):
+        import mmlspark_tpu.io.http.port_forwarding as pf
+        monkeypatch.setattr(pf.shutil, "which", lambda _: None)
+        with pytest.raises(RuntimeError, match="no `ssh` binary"):
+            SshTunnel("b", local_port=1, remote_port=2).start()
+
+
+# ---------------------------------------------------------------- bindings
+@dataclasses.dataclass
+class Inner:
+    tag: str
+    score: float = 0.0
+
+
+@dataclasses.dataclass
+class Outer:
+    name: str
+    count: int
+    inner: Inner | None = None
+    labels: list[str] = dataclasses.field(default_factory=list)
+
+
+class TestDataclassBindings:
+    def test_roundtrip_nested(self):
+        items = [
+            Outer("a", 1, Inner("x", 0.5), ["l1", "l2"]),
+            Outer("b", 2, None, []),
+        ]
+        b = bindings(Outer)
+        df = b.to_df(items)
+        assert set(df.columns) == {"name", "count", "inner", "labels"}
+        assert df["inner"][0] == {"tag": "x", "score": 0.5}
+        back = b.from_df(df)
+        assert back == items
+        assert isinstance(back[0].inner, Inner)
+
+    def test_missing_column_uses_default(self):
+        df = DataFrame({"name": np.asarray(["z"], object),
+                        "count": np.asarray([3])})
+        back = bindings(Outer).from_df(df)
+        assert back[0] == Outer("z", 3)
+
+    def test_non_dataclass_rejected(self):
+        with pytest.raises(TypeError):
+            DataclassBindings(int)
+
+
+class TestColumnMetadata:
+    def test_categorical_levels_carry(self):
+        df = DataFrame({"cat": np.asarray(["a", "b"], object),
+                        "x": np.asarray([1.0, 2.0])})
+        df = ColumnMetadata.set_categorical(df, "cat", ["a", "b", "c"])
+        assert ColumnMetadata.categorical_levels(df, "cat") == \
+            ["a", "b", "c"]
+        derived = ColumnMetadata.carry(df, df.select("cat"))
+        assert ColumnMetadata.categorical_levels(derived, "cat") == \
+            ["a", "b", "c"]
+        dropped = ColumnMetadata.carry(df, df.select("x"))
+        assert ColumnMetadata.categorical_levels(dropped, "cat") is None
+
+
+# -------------------------------------------------------------------- rgen
+class TestRGeneration:
+    def test_snake_case(self):
+        from mmlspark_tpu.codegen import snake_case
+        assert snake_case("LightGBMClassifier") == "light_gbm_classifier"
+        assert snake_case("TextSentiment") == "text_sentiment"
+        assert snake_case("IDF") == "idf"
+
+    def test_generates_all_packages(self, tmp_path):
+        from mmlspark_tpu.codegen import generate_r
+        files = generate_r(str(tmp_path))
+        names = {os.path.basename(f) for f in files}
+        assert {"lightgbm.R", "stages.R", "vw.R", "zzz.R"} <= names
+        lgbm = (tmp_path / "lightgbm.R").read_text()
+        assert "ml_light_gbm_classifier <- function(" in lgbm
+        assert "num_iterations = NULL" in lgbm
+        assert "#' @export" in lgbm
+        assert 'reticulate::import("mmlspark_tpu.lightgbm' in lgbm
+        # every generated file balances braces (cheap syntax sanity)
+        for f in files:
+            text = open(f).read()
+            assert text.count("{") == text.count("}"), f
+
+
+# ------------------------------------------------------------- file stream
+class TestFileStream:
+    def _write(self, d, name, data=b"x", ts=None):
+        p = os.path.join(d, name)
+        with open(p, "wb") as f:
+            f.write(data)
+        if ts is not None:
+            os.utime(p, ns=(ts, ts))
+        return p
+
+    def test_microbatches_and_offsets(self, tmp_path):
+        d = str(tmp_path)
+        src = FileStreamSource(d, glob="*.bin")
+        assert src.next_batch() is None
+        self._write(d, "a.bin", b"1", ts=1_000)
+        self._write(d, "b.bin", b"2", ts=2_000)
+        self._write(d, "skip.txt", b"no", ts=1_500)
+        batch = src.next_batch()
+        assert [os.path.basename(p) for p in batch["path"]] == \
+            ["a.bin", "b.bin"]
+        assert src.next_batch() is None  # consumed
+        self._write(d, "c.bin", b"3", ts=3_000)
+        batch2 = src.next_batch()
+        assert [os.path.basename(p) for p in batch2["path"]] == ["c.bin"]
+
+    def test_offset_restore_resumes(self, tmp_path):
+        d = str(tmp_path)
+        src = FileStreamSource(d)
+        self._write(d, "a", ts=1_000)
+        src.next_batch()
+        saved = src.offset_json()
+        self._write(d, "b", ts=2_000)
+        # a fresh source restored from the offset sees only the new file
+        resumed = FileStreamSource(d)
+        resumed.restore_offset(saved)
+        batch = resumed.next_batch()
+        assert [os.path.basename(p) for p in batch["path"]] == ["b"]
+
+    def test_stream_generator_idle_timeout(self, tmp_path):
+        d = str(tmp_path)
+        self._write(d, "a")
+        src = FileStreamSource(d)
+        batches = list(src.stream(poll_interval=0.02, idle_timeout=0.2))
+        assert len(batches) == 1
+
+    def test_image_stream_decodes_and_isolates_errors(self, tmp_path):
+        import io as _io
+        from PIL import Image
+        d = str(tmp_path)
+        buf = _io.BytesIO()
+        Image.fromarray(
+            np.zeros((4, 5, 3), np.uint8)).save(buf, format="PNG")
+        self._write(d, "ok.png", buf.getvalue(), ts=1_000)
+        self._write(d, "bad.png", b"not an image", ts=2_000)
+        src = ImageStreamSource(d, glob="*.png")
+        batch = src.next_batch()
+        assert batch["image"][0].shape == (4, 5, 3)
+        assert batch["image"][1] is None
+        assert batch["error"][1] is not None
