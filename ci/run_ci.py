@@ -30,9 +30,11 @@ PACKAGES: dict[str, list[str]] = {
     "vw": ["test_vw.py"],
     "dl": ["test_image_dl.py", "test_convert.py",
            "test_transfer_learning.py", "test_checkpoint_profiling.py",
-           "test_parallel.py", "test_pipeline_moe.py"],
+           "test_parallel.py", "test_pipeline_moe.py",
+           "test_sharding_analysis.py"],
     "serving": ["test_http_serving.py", "test_serving_distributed.py"],
-    "cognitive": ["test_cognitive.py", "test_cognitive_speech.py"],
+    "cognitive": ["test_cognitive.py", "test_cognitive_speech.py",
+                  "test_cognitive_breadth.py"],
     "learners": ["test_learners.py", "test_linear.py",
                  "test_recommendation_lime.py", "test_cyber.py"],
     "io": ["test_native_codegen.py", "test_benchmarks.py",

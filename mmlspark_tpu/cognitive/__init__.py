@@ -8,18 +8,22 @@ engine-free — the TPU build reuses it unchanged over its own HTTP layer.
 """
 
 from .base import CognitiveServiceBase
-from .text import (TextSentiment, KeyPhraseExtractor, NER, LanguageDetector,
+from .text import (KeyPhraseExtractorV2, LanguageDetectorV2, NERV2,
+                   TextSentimentV2,
+                   TextSentiment, KeyPhraseExtractor, NER, LanguageDetector,
                    EntityDetector)
-from .vision import (AnalyzeImage, DescribeImage, OCR, RecognizeText,
-                     RecognizeDomainSpecificContent, GenerateThumbnails,
-                     TagImage)
+from .vision import (AnalyzeImage, DescribeImage, OCR, Read,
+                     RecognizeText, RecognizeDomainSpecificContent,
+                     GenerateThumbnails, TagImage)
 from .face import (DetectFace, FindSimilarFace, GroupFaces, IdentifyFaces,
                    VerifyFaces)
-from .anomaly import DetectAnomalies, DetectLastAnomaly
+from .anomaly import (DetectAnomalies, DetectLastAnomaly,
+                      SimpleDetectAnomalies)
 from .bing import BingImageSearch
 from .speech import (ConversationTranscription, PullAudioInputStream,
                      SpeechToText, SpeechToTextSDK, segment_pcm16)
-from .azure_search import AzureSearchWriter, validate_index_fields
+from .azure_search import (AddDocuments, AzureSearchWriter,
+                           validate_index_fields)
 
 __all__ = [
     "CognitiveServiceBase", "TextSentiment", "KeyPhraseExtractor", "NER",
@@ -27,7 +31,9 @@ __all__ = [
     "OCR", "RecognizeText", "RecognizeDomainSpecificContent",
     "GenerateThumbnails", "TagImage", "DetectFace", "FindSimilarFace",
     "GroupFaces", "IdentifyFaces", "VerifyFaces", "DetectAnomalies",
-    "DetectLastAnomaly", "BingImageSearch", "SpeechToText",
+    "DetectLastAnomaly", "SimpleDetectAnomalies", "AddDocuments",
+    "TextSentimentV2", "KeyPhraseExtractorV2", "NERV2",
+    "LanguageDetectorV2", "Read", "BingImageSearch", "SpeechToText",
     "SpeechToTextSDK", "ConversationTranscription",
     "PullAudioInputStream", "segment_pcm16", "AzureSearchWriter",
     "validate_index_fields",

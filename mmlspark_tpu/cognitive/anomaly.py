@@ -9,7 +9,7 @@ from __future__ import annotations
 
 import json
 
-from ..core import ServiceParam
+from ..core import Param, ServiceParam, TypeConverters as TC
 from .base import CognitiveServiceBase
 
 
@@ -44,3 +44,101 @@ class DetectAnomalies(_AnomalyBase):
 
 class DetectLastAnomaly(_AnomalyBase):
     _path = "last/detect"
+
+
+class SimpleDetectAnomalies(_AnomalyBase):
+    """Row-oriented anomaly detection over grouped series (reference
+    ``AnamolyDetection.scala:157+``): rows carry (timestamp, value,
+    group); each group becomes ONE service call over its time-sorted
+    series, and every row gets its own point verdict back."""
+
+    _path = "entire/detect"
+
+    timestampCol = Param("timestampCol", "time of the series point",
+                         TC.toString, default="timestamp")
+    valueCol = Param("valueCol", "value of the series point", TC.toString,
+                     default="value")
+    groupbyCol = Param("groupbyCol", "column that groups the series",
+                       TC.toString, default="group")
+
+    def _transform(self, df):
+        import numpy as np
+
+        from ..io.http.clients import AsyncClient
+        from ..io.http.schema import HTTPRequestData
+
+        ts = df[self.get("timestampCol")]
+        vals = df[self.get("valueCol")]
+        groups = df[self.get("groupbyCol")]
+        n = len(df)
+
+        by_group: dict = {}
+        for i in range(n):
+            by_group.setdefault(groups[i], []).append(i)
+
+        def ts_key(i):
+            """Chronological order: numeric timestamps numerically,
+            otherwise ISO-8601 strings (which sort lexicographically)."""
+            v = ts[i]
+            try:
+                return (0, float(v), "")
+            except (TypeError, ValueError):
+                return (1, 0.0, str(v))
+
+        requests = []
+        order = []  # per request: row indices in series order
+        for g, idxs in by_group.items():
+            idxs = sorted(idxs, key=ts_key)
+            payload = {
+                "series": [{"timestamp": str(ts[i]),
+                            "value": float(vals[i])} for i in idxs],
+                "granularity": self._resolve("granularity", df, idxs[0],
+                                             "daily")}
+            for opt in ("maxAnomalyRatio", "sensitivity",
+                        "customInterval"):
+                v = self._resolve(opt, df, idxs[0])
+                if v is not None:
+                    payload[opt] = self._jsonable(v)
+            requests.append(HTTPRequestData(
+                url=self._build_url(df, idxs[0]), method="POST",
+                headers=self._headers(df, idxs[0]),
+                entity=json.dumps(payload).encode()))
+            order.append(idxs)
+
+        client = AsyncClient(concurrency=self.get("concurrency"),
+                             timeout=self.get("timeout"))
+        responses = client.send(requests)
+
+        out = np.empty(n, object)
+        err = np.empty(n, object)
+        for idxs, resp in zip(order, responses):
+            if 200 <= resp.status_code < 300:
+                try:
+                    parsed = resp.json()
+                except Exception as e:
+                    for i in idxs:
+                        out[i], err[i] = None, f"parse error: {e}"
+                    continue
+                # plural response arrays → per-row singular fields, the
+                # reference's ADSingleResponse shape
+                singular = {"isAnomaly": "isAnomaly",
+                            "isPositiveAnomaly": "isPositiveAnomaly",
+                            "isNegativeAnomaly": "isNegativeAnomaly",
+                            "expectedValues": "expectedValue",
+                            "upperMargins": "upperMargin",
+                            "lowerMargins": "lowerMargin"}
+                for pos, i in enumerate(idxs):
+                    point = {}
+                    for key, name in singular.items():
+                        seq = parsed.get(key)
+                        if isinstance(seq, list) and pos < len(seq):
+                            point[name] = seq[pos]
+                    out[i] = point or parsed
+                    err[i] = None
+            else:
+                for i in idxs:
+                    out[i] = None
+                    err[i] = {"statusCode": resp.status_code,
+                              "reason": resp.reason}
+        return (df.with_column(self.getOutputCol(), out)
+                  .with_column(self.get("errorCol"), err))

@@ -44,6 +44,19 @@ def validate_index_fields(index_fields: dict) -> list[dict]:
     return fields
 
 
+def _row_to_doc(row: dict, action: str) -> dict:
+    """One DataFrame row → one indexing document (shared by write() and
+    AddDocuments; numpy scalars/arrays become JSON-native values)."""
+    if action not in VALID_ACTIONS:
+        raise ValueError(f"@search.action must be one of {VALID_ACTIONS}, "
+                         f"got {action!r}")
+    doc = {"@search.action": action}
+    for k, v in row.items():
+        doc[k] = v.item() if isinstance(v, np.generic) else \
+            v.tolist() if isinstance(v, np.ndarray) else v
+    return doc
+
+
 class AzureSearchWriter:
     def __init__(self, service_name: str, index_name: str, key: str,
                  index_fields: dict | None = None,
@@ -121,16 +134,58 @@ class AzureSearchWriter:
         rows = [dict(r) for r in df.collect()]
         results = []
         for start in range(0, len(rows), self.batch_size):
-            docs = []
-            for r in rows[start:start + self.batch_size]:
-                doc = {"@search.action": self.action}
-                for k, v in r.items():
-                    doc[k] = v.item() if isinstance(v, np.generic) else \
-                        v.tolist() if isinstance(v, np.ndarray) else v
-                docs.append(doc)
+            docs = [_row_to_doc(r, self.action)
+                    for r in rows[start:start + self.batch_size]]
             resp = send_request(HTTPRequestData(
                 url=url, method="POST", headers=self._headers(),
                 entity=json.dumps({"value": docs}).encode()))
             results.append(resp.json() if resp.entity else
                            {"statusCode": resp.status_code})
         return results
+
+
+class AddDocuments:
+    """Transformer-shaped Azure Search sink (reference ``AddDocuments`` in
+    ``AzureSearch.scala``): rows become documents with a per-row
+    ``@search.action`` (from ``actionCol`` when set), batched to
+    ``/docs/index``; the per-document API status comes back as a column.
+    """
+
+    def __init__(self, service_name: str = "", index_name: str = "",
+                 key: str = "", action_col: str | None = None,
+                 batch_size: int = 100, base_url: str | None = None,
+                 output_col: str = "indexResponse",
+                 api_version: str = "2019-05-06"):
+        self.writer = AzureSearchWriter(
+            service_name=service_name or "unused", index_name=index_name,
+            key=key, batch_size=batch_size, base_url=base_url,
+            api_version=api_version)
+        self.action_col = action_col
+        self.output_col = output_col
+
+    def transform(self, df: DataFrame) -> DataFrame:
+        url = (f"{self.writer.base}/{self.writer.index_name}/docs/index"
+               f"?api-version={self.writer.api_version}")
+        rows = [dict(r) for r in df.collect()]
+        statuses: list = [None] * len(rows)
+        bs = self.writer.batch_size
+        for start in range(0, len(rows), bs):
+            docs = []
+            for r in rows[start:start + bs]:
+                action = (str(r.pop(self.action_col, self.writer.action))
+                          if self.action_col else self.writer.action)
+                docs.append(_row_to_doc(r, action))
+            resp = send_request(HTTPRequestData(
+                url=url, method="POST",
+                headers=self.writer._headers(),
+                entity=json.dumps({"value": docs}).encode()))
+            parsed = resp.json() if resp.entity else {}
+            values = parsed.get("value", []) if isinstance(parsed, dict) \
+                else []
+            for j in range(start, min(start + bs, len(rows))):
+                pos = j - start
+                statuses[j] = (values[pos] if pos < len(values)
+                               else {"statusCode": resp.status_code})
+        out = np.empty(len(rows), object)
+        out[:] = statuses
+        return df.with_column(self.output_col, out)

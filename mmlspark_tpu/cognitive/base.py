@@ -126,6 +126,92 @@ class CognitiveServiceBase(Transformer, HasOutputCol):
                   .with_column(self.get("errorCol"), err))
 
 
+class _AsyncReplyMixin:
+    """Async-reply services (reference ``HasAsyncReply`` +
+    ``BasicAsyncReply`` handler): the initial POST returns 202 with an
+    ``Operation-Location`` header; the result is polled from that URL
+    until status leaves the running states."""
+
+    pollingDelay = Param("pollingDelay", "seconds between result polls",
+                         TC.toFloat, default=0.3)
+    maxPollingRetries = Param("maxPollingRetries", "max result polls",
+                              TC.toInt, default=1000)
+    suppressMaxRetriesExceededException = Param(
+        "suppressMaxRetriesExceededException",
+        "error-column instead of raising when polling exhausts",
+        TC.toBoolean, default=False)
+
+    _TERMINAL = ("succeeded", "failed", "partiallycompleted")
+
+    def _poll(self, location: str, key: str | None):
+        import time
+
+        from ..io.http.clients import send_request
+        headers = {}
+        if key:
+            headers["Ocp-Apim-Subscription-Key"] = str(key)
+        delay = self.get("pollingDelay")
+        for _ in range(self.get("maxPollingRetries")):
+            resp = send_request(HTTPRequestData(
+                url=location, method="GET", headers=headers))
+            if 200 <= resp.status_code < 300:
+                parsed = resp.json()
+                status = str(parsed.get("status", "")).lower()
+                if status in self._TERMINAL:
+                    return parsed, None
+            elif resp.status_code >= 400 and resp.status_code != 429:
+                # throttling (429) is transient — keep polling; other
+                # 4xx/5xx are terminal for this operation
+                return None, {"statusCode": resp.status_code,
+                              "reason": resp.reason}
+            time.sleep(delay)
+        err = {"error": "max polling retries exceeded",
+               "location": location}
+        if self.get("suppressMaxRetriesExceededException"):
+            return None, err
+        raise TimeoutError(f"async operation never completed: {location}")
+
+    def _transform(self, df):
+        n = len(df)
+        requests = [self._build_request(df, i) for i in range(n)]
+        live = [(i, r) for i, r in enumerate(requests) if r is not None]
+        client = AsyncClient(concurrency=self.get("concurrency"),
+                             timeout=self.get("timeout"))
+        responses = client.send([r for _, r in live])
+        out = np.empty(n, object)
+        err = np.empty(n, object)
+        pending = []  # (row, location, key) — polled concurrently below
+        for (i, _), resp in zip(live, responses):
+            if resp.status_code in (200, 201, 202):
+                loc = {k.lower(): v for k, v in resp.headers.items()}.get(
+                    "operation-location")
+                if not loc:
+                    out[i] = None
+                    err[i] = {"error": "202 without Operation-Location"}
+                    continue
+                pending.append((i, loc,
+                                self._resolve("subscriptionKey", df, i)))
+            else:
+                out[i] = None
+                err[i] = {"statusCode": resp.status_code,
+                          "reason": resp.reason,
+                          "response": resp.entity.decode("utf-8", "replace")
+                          if resp.entity else None}
+        if pending:
+            # operations run server-side in parallel; polling them
+            # one-by-one would serialize the wall clock — reuse the same
+            # concurrency the POST fan-out had
+            from concurrent.futures import ThreadPoolExecutor
+            workers = max(int(self.get("concurrency")), 1)
+            with ThreadPoolExecutor(max_workers=workers) as pool:
+                results = list(pool.map(
+                    lambda p: self._poll(p[1], p[2]), pending))
+            for (i, _, _), (res, e) in zip(pending, results):
+                out[i], err[i] = res, e
+        return (df.with_column(self.getOutputCol(), out)
+                  .with_column(self.get("errorCol"), err))
+
+
 class _JsonBodyService(CognitiveServiceBase):
     """Services posting a JSON object built from ServiceParams."""
 

@@ -11,10 +11,11 @@ from .base import _DocumentsService
 
 class _TextAnalytics(_DocumentsService):
     _path = ""
+    _version = "v3.0"
 
     def _url_for_location(self, location: str) -> str:
         return (f"https://{location}.api.cognitive.microsoft.com"
-                f"/text/analytics/v3.0/{self._path}")
+                f"/text/analytics/{self._version}/{self._path}")
 
 
 class TextSentiment(_TextAnalytics):
@@ -36,4 +37,26 @@ class EntityDetector(_TextAnalytics):
 
 
 class LanguageDetector(_TextAnalytics):
+    _path = "languages"
+
+
+class _TextAnalyticsV2(_TextAnalytics):
+    """V2.0 schema variants (reference ``TextAnalyticsSchemasV2.scala`` —
+    kept for pipelines pinned to the older API)."""
+    _version = "v2.0"
+
+
+class TextSentimentV2(_TextAnalyticsV2):
+    _path = "sentiment"
+
+
+class KeyPhraseExtractorV2(_TextAnalyticsV2):
+    _path = "keyPhrases"
+
+
+class NERV2(_TextAnalyticsV2):
+    _path = "entities"
+
+
+class LanguageDetectorV2(_TextAnalyticsV2):
     _path = "languages"

@@ -7,13 +7,9 @@ GenerateThumbnails, DSIR (celebrity/landmark models).
 
 from __future__ import annotations
 
-import json
-import time
-
-from ..core import Param, ServiceParam, TypeConverters as TC
-from ..io.http.clients import send_request
-from ..io.http.schema import HTTPRequestData, HTTPResponseData
-from .base import _ImageInputService
+from ..core import ServiceParam
+from ..io.http.schema import HTTPResponseData
+from .base import _AsyncReplyMixin, _ImageInputService
 
 
 class _Vision(_ImageInputService):
@@ -96,34 +92,41 @@ class GenerateThumbnails(_Vision):
         return resp.entity  # binary thumbnail
 
 
-class RecognizeText(_Vision):
+class RecognizeText(_AsyncReplyMixin, _Vision):
     """Async text recognition: POST → Operation-Location → poll until
-    done (reference ``RecognizeText`` with ``pollingDelay`` basic handler)."""
+    done (reference ``RecognizeText``); shares the generic async-reply
+    machinery with ``Read``."""
     _path = "recognizeText"
     mode = ServiceParam("mode", "Printed | Handwritten")
-    pollingDelay = Param("pollingDelay", "seconds between polls",
-                         TC.toFloat, default=0.3)
-    maxPolls = Param("maxPolls", "poll attempts before giving up",
-                     TC.toInt, default=20)
 
     def _url_params(self, df, row):
         return {"mode": self._resolve("mode", df, row, "Printed")}
 
-    def _parse_response(self, resp: HTTPResponseData):
-        op_url = resp.headers.get("Operation-Location") or \
-            resp.headers.get("operation-location")
-        if not op_url:
-            return resp.json() if resp.entity else None
-        key = None
-        for k, v in resp.headers.items():
-            if k.lower() == "x-request-key":
-                key = v
-        headers = {"Ocp-Apim-Subscription-Key": key} if key else {}
-        for _ in range(self.get("maxPolls")):
-            time.sleep(self.get("pollingDelay"))
-            poll = send_request(HTTPRequestData(
-                url=op_url, method="GET", headers=headers))
-            body = poll.json() if poll.entity else {}
-            if body.get("status") in ("Succeeded", "Failed"):
-                return body
-        return {"status": "TimedOut"}
+
+class Read(_AsyncReplyMixin, _Vision):
+    """The Read API (async OCR v3): POST → 202 + Operation-Location →
+    poll until a terminal status (reference ``ComputerVision.scala:341+``
+    — ``CognitiveServicesBaseNoHandler with HasAsyncReply``)."""
+
+    _path = "read/analyze"
+    language = ServiceParam(
+        "language", "force processing as this BCP-47 language (en, nl, "
+        "fr, de, it, pt, es); omit for auto-detection")
+
+    def _url_for_location(self, location: str) -> str:
+        return (f"https://{location}.api.cognitive.microsoft.com/vision/"
+                f"v3.1/read/analyze")
+
+    def _url_params(self, df, row):
+        return {"language": self._resolve("language", df, row)}
+
+    @staticmethod
+    def flatten(result: dict | None) -> str:
+        """Reference ``object Read.flatten``: all recognized text lines
+        joined into one string."""
+        if not result:
+            return ""
+        reads = (result.get("analyzeResult") or {}).get("readResults", [])
+        return " ".join(line.get("text", "")
+                        for page in reads
+                        for line in page.get("lines", []))

@@ -120,6 +120,24 @@ def make_train_step(module, tx, mesh=None,
             loss_of, has_aux=True)(state.params)
         updates, new_opt = tx.update(grads, state.opt_state, state.params)
         new_params = optax.apply_updates(state.params, updates)
+        if mesh is not None:
+            # pin output placements to the annotated layout: without the
+            # constraint GSPMD may re-shard leaves it considers
+            # profitable, so the returned state's placements drift from
+            # shard_train_state's and every subsequent step recompiles
+            tp = mesh.shape.get("tp", 1)
+            new_params = jax.tree_util.tree_map_with_path(
+                lambda path, leaf: jax.lax.with_sharding_constraint(
+                    leaf, NamedSharding(
+                        mesh, param_spec(path, leaf, tp) if tp > 1
+                        else P())),
+                new_params)
+            # optimizer state is placed replicated by shard_train_state —
+            # pin it too, or the drift problem just moves into opt_state
+            new_opt = jax.tree.map(
+                lambda leaf: jax.lax.with_sharding_constraint(
+                    leaf, NamedSharding(mesh, P())),
+                new_opt)
         new_state = TrainState(
             params=new_params,
             batch_stats=new_model_state.get("batch_stats",

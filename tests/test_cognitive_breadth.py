@@ -1,0 +1,201 @@
+"""Cognitive long tail added in round 2: async-reply Read, grouped
+SimpleDetectAnomalies, AddDocuments sink, text V2 variants — against
+local mock services (zero-egress; the architecture is what's tested)."""
+
+import json
+import threading
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+import numpy as np
+import pytest
+
+from mmlspark_tpu.core import DataFrame
+from mmlspark_tpu.cognitive import (AddDocuments, NERV2, Read,
+                                    SimpleDetectAnomalies, TextSentimentV2)
+
+
+@pytest.fixture()
+def async_api():
+    """Read-style async endpoint: POST → 202 + Operation-Location; the
+    op URL returns 'running' twice, then 'succeeded'."""
+    polls = {"n": 0}
+
+    class Handler(BaseHTTPRequestHandler):
+        def do_POST(self):
+            n = int(self.headers.get("Content-Length", 0))
+            self.rfile.read(n)
+            self.send_response(202)
+            self.send_header(
+                "Operation-Location",
+                f"http://127.0.0.1:{self.server.server_address[1]}/op/1")
+            self.send_header("Content-Length", "0")
+            self.end_headers()
+
+        def do_GET(self):
+            polls["n"] += 1
+            if polls["n"] < 3:
+                out = json.dumps({"status": "running"}).encode()
+            else:
+                out = json.dumps({
+                    "status": "succeeded",
+                    "analyzeResult": {"readResults": [
+                        {"lines": [{"text": "hello"},
+                                   {"text": "world"}]}]}}).encode()
+            self.send_response(200)
+            self.send_header("Content-Length", str(len(out)))
+            self.end_headers()
+            self.wfile.write(out)
+
+        def log_message(self, *a):
+            pass
+
+    httpd = ThreadingHTTPServer(("127.0.0.1", 0), Handler)
+    threading.Thread(target=httpd.serve_forever, daemon=True).start()
+    yield f"http://127.0.0.1:{httpd.server_address[1]}", polls
+    httpd.shutdown()
+
+
+class TestReadAsyncReply:
+    def test_polls_until_succeeded(self, async_api):
+        url, polls = async_api
+        t = Read(url=f"{url}/analyze", outputCol="r")
+        t.set("subscriptionKey", "k")
+        t.set("pollingDelay", 0.01)
+        t.setImageUrlCol("img")
+        df = DataFrame({"img": np.asarray(["http://x/img.png"], object)})
+        out = t.transform(df)
+        assert out["r"][0]["status"] == "succeeded"
+        assert polls["n"] >= 3  # really polled through 'running'
+        assert Read.flatten(out["r"][0]) == "hello world"
+        assert out["error"][0] is None
+
+    def test_missing_operation_location_is_error(self):
+        class Handler(BaseHTTPRequestHandler):
+            def do_POST(self):
+                self.send_response(202)
+                self.send_header("Content-Length", "0")
+                self.end_headers()
+
+            def log_message(self, *a):
+                pass
+
+        httpd = ThreadingHTTPServer(("127.0.0.1", 0), Handler)
+        threading.Thread(target=httpd.serve_forever, daemon=True).start()
+        try:
+            t = Read(url=f"http://127.0.0.1:"
+                         f"{httpd.server_address[1]}/analyze",
+                     outputCol="r")
+            t.set("subscriptionKey", "k")
+            t.setImageUrlCol("img")
+            out = t.transform(DataFrame(
+                {"img": np.asarray(["http://x"], object)}))
+            assert out["r"][0] is None
+            assert "Operation-Location" in str(out["error"][0])
+        finally:
+            httpd.shutdown()
+
+
+@pytest.fixture()
+def anomaly_api():
+    """Entire-series detector: one bool per point, anomaly iff value>10;
+    records how many service calls were made."""
+    calls = {"n": 0, "sizes": []}
+
+    class Handler(BaseHTTPRequestHandler):
+        def do_POST(self):
+            n = int(self.headers.get("Content-Length", 0))
+            body = json.loads(self.rfile.read(n))
+            series = body["series"]
+            calls["n"] += 1
+            calls["sizes"].append(len(series))
+            out = json.dumps({
+                "isAnomaly": [p["value"] > 10 for p in series],
+                "expectedValues": [1.0] * len(series),
+                "upperMargins": [0.5] * len(series),
+                "lowerMargins": [0.5] * len(series),
+                "isPositiveAnomaly": [p["value"] > 10 for p in series],
+                "isNegativeAnomaly": [False] * len(series),
+            }).encode()
+            self.send_response(200)
+            self.send_header("Content-Length", str(len(out)))
+            self.end_headers()
+            self.wfile.write(out)
+
+        def log_message(self, *a):
+            pass
+
+    httpd = ThreadingHTTPServer(("127.0.0.1", 0), Handler)
+    threading.Thread(target=httpd.serve_forever, daemon=True).start()
+    yield f"http://127.0.0.1:{httpd.server_address[1]}/detect", calls
+    httpd.shutdown()
+
+
+class TestSimpleDetectAnomalies:
+    def test_grouped_series_per_row_results(self, anomaly_api):
+        url, calls = anomaly_api
+        t = SimpleDetectAnomalies(url=url, outputCol="a")
+        t.set("subscriptionKey", "k")
+        n = 8
+        df = DataFrame({
+            "timestamp": np.asarray(
+                [f"2020-01-0{i % 4 + 1}T00:00:00Z" for i in range(n)],
+                object),
+            "value": np.asarray([1.0, 99.0, 2.0, 1.5, 1.0, 2.0, 88.0,
+                                 1.0]),
+            "group": np.asarray(["a", "a", "a", "a", "b", "b", "b", "b"],
+                                object)})
+        out = t.transform(df)
+        assert calls["n"] == 2           # one call per group, not per row
+        assert calls["sizes"] == [4, 4]
+        flags = [r["isAnomaly"] for r in out["a"]]
+        assert flags == [False, True, False, False,
+                         False, False, True, False]
+        assert out["a"][1]["expectedValue"] == 1.0
+
+
+class TestAddDocuments:
+    def test_per_row_action_and_status(self):
+        received = {}
+
+        class Handler(BaseHTTPRequestHandler):
+            def do_POST(self):
+                n = int(self.headers.get("Content-Length", 0))
+                body = json.loads(self.rfile.read(n))
+                received["docs"] = body["value"]
+                out = json.dumps({"value": [
+                    {"key": d.get("id"), "status": True, "statusCode": 200}
+                    for d in body["value"]]}).encode()
+                self.send_response(200)
+                self.send_header("Content-Length", str(len(out)))
+                self.end_headers()
+                self.wfile.write(out)
+
+            def log_message(self, *a):
+                pass
+
+        httpd = ThreadingHTTPServer(("127.0.0.1", 0), Handler)
+        threading.Thread(target=httpd.serve_forever, daemon=True).start()
+        try:
+            base = f"http://127.0.0.1:{httpd.server_address[1]}/indexes"
+            t = AddDocuments(index_name="idx", key="k", base_url=base,
+                             action_col="act")
+            df = DataFrame({
+                "id": np.asarray(["1", "2"], object),
+                "text": np.asarray(["a", "b"], object),
+                "act": np.asarray(["upload", "delete"], object)})
+            out = t.transform(df)
+            actions = [d["@search.action"] for d in received["docs"]]
+            assert actions == ["upload", "delete"]
+            assert "act" not in received["docs"][0]  # consumed, not sent
+            assert out["indexResponse"][0]["statusCode"] == 200
+        finally:
+            httpd.shutdown()
+
+
+class TestTextV2:
+    def test_v2_url_template_and_flow(self):
+        t = TextSentimentV2(outputCol="s")
+        t.setLocation("eastus")
+        assert "/text/analytics/v2.0/sentiment" in t.get("url")
+        assert "/text/analytics/v2.0/entities" in \
+            NERV2(outputCol="n")._url_for_location("westus")

@@ -171,9 +171,10 @@ _EXCLUDED = {
     "OCR", "RecognizeText", "RecognizeDomainSpecificContent",
     "GenerateThumbnails", "TagImage", "DetectFace", "FindSimilarFace",
     "GroupFaces", "IdentifyFaces", "VerifyFaces", "DetectAnomalies",
-    "DetectLastAnomaly", "BingImageSearch", "SpeechToText",
-    "SpeechToTextSDK", "ConversationTranscription", "HTTPTransformer",
-    "SimpleHTTPTransformer",
+    "DetectLastAnomaly", "SimpleDetectAnomalies", "BingImageSearch",
+    "SpeechToText", "SpeechToTextSDK", "ConversationTranscription",
+    "Read", "TextSentimentV2", "KeyPhraseExtractorV2", "NERV2",
+    "LanguageDetectorV2", "HTTPTransformer", "SimpleHTTPTransformer",
     "JSONInputParser", "JSONOutputParser", "CustomInputParser",
     "CustomOutputParser",
     # need a function/model/stage argument; fuzzed via dedicated tests
