@@ -66,31 +66,62 @@ class NativeLoader:
             return lib
 
 
-_fastio = None
-_fastio_failed = False
+_libs: dict[str, ctypes.CDLL | None] = {}
+
+
+def _lazy_native(name: str, sources: list[str], configure):
+    """Shared lazy loader: one build+load per process, honoring the
+    ``MMLSPARK_TPU_DISABLE_NATIVE=1`` kill-switch; returns None when the
+    toolchain is unavailable (callers fall back to Python paths)."""
+    if name in _libs:
+        return _libs[name]
+    if os.environ.get("MMLSPARK_TPU_DISABLE_NATIVE", "") == "1":
+        _libs[name] = None
+        return None
+    try:
+        lib = NativeLoader(name, sources).load()
+        configure(lib)
+    except Exception:
+        _libs[name] = None
+        return None
+    _libs[name] = lib
+    return lib
+
+
+def get_vwhash():
+    """The batch VW-hashing library (vwhash.cpp), or None."""
+    def configure(lib):
+        i64 = ctypes.c_int64
+        u32 = ctypes.c_uint32
+        lib.vw_murmur3_32.argtypes = [ctypes.c_char_p, i64, u32]
+        lib.vw_murmur3_32.restype = u32
+        lib.vw_hash_strings.argtypes = [
+            ctypes.c_char_p, ctypes.POINTER(i64), i64,   # buf, offsets, n
+            ctypes.c_char_p, i64, u32,                   # prefix, len, seed
+            ctypes.c_int, ctypes.c_int, ctypes.c_int32,  # bits, mode, W
+            ctypes.c_int,                                # sum_collisions
+            ctypes.POINTER(ctypes.c_int32),
+            ctypes.POINTER(ctypes.c_float),
+            ctypes.POINTER(ctypes.c_int32)]
+        lib.vw_hash_strings.restype = None
+
+    return _lazy_native("vwhash", ["vwhash.cpp"], configure)
 
 
 def get_fastio():
-    """The fastio library with argtypes configured, or None when the
-    toolchain is unavailable (callers fall back to NumPy paths)."""
-    global _fastio, _fastio_failed
-    if _fastio is not None or _fastio_failed:
-        return _fastio
-    try:
-        lib = NativeLoader("fastio", ["fastio.cpp"]).load()
-    except Exception:
-        _fastio_failed = True
-        return None
-    i64 = ctypes.c_int64
-    lib.csv_dims.argtypes = [ctypes.c_char_p, i64, ctypes.c_int,
-                             ctypes.POINTER(i64), ctypes.POINTER(i64)]
-    lib.csv_dims.restype = ctypes.c_int
-    lib.csv_parse.argtypes = [ctypes.c_char_p, i64, ctypes.c_int, i64, i64,
-                              ctypes.POINTER(ctypes.c_float), ctypes.c_int]
-    lib.csv_parse.restype = ctypes.c_int
-    lib.read_file.argtypes = [ctypes.c_char_p, ctypes.c_char_p, i64]
-    lib.read_file.restype = i64
-    lib.file_size.argtypes = [ctypes.c_char_p]
-    lib.file_size.restype = i64
-    _fastio = lib
-    return _fastio
+    """The fastio library with argtypes configured, or None."""
+    def configure(lib):
+        i64 = ctypes.c_int64
+        lib.csv_dims.argtypes = [ctypes.c_char_p, i64, ctypes.c_int,
+                                 ctypes.POINTER(i64), ctypes.POINTER(i64)]
+        lib.csv_dims.restype = ctypes.c_int
+        lib.csv_parse.argtypes = [ctypes.c_char_p, i64, ctypes.c_int, i64,
+                                  i64, ctypes.POINTER(ctypes.c_float),
+                                  ctypes.c_int]
+        lib.csv_parse.restype = ctypes.c_int
+        lib.read_file.argtypes = [ctypes.c_char_p, ctypes.c_char_p, i64]
+        lib.read_file.restype = i64
+        lib.file_size.argtypes = [ctypes.c_char_p]
+        lib.file_size.restype = i64
+
+    return _lazy_native("fastio", ["fastio.cpp"], configure)

@@ -97,6 +97,106 @@ class VowpalWabbitFeaturizer(Transformer, HasInputCols, HasOutputCol):
                 f"column {colname!r}")
         return out_i, out_v
 
+    # ---------------------------------------------------- columnar fast paths
+    def _string_coo(self, colname: str, arr, ns_hash: int, num_bits: int,
+                    split: bool):
+        """All-string column → COO triples; batch-hashed in C++
+        (``native/src/vwhash.cpp``, the reference's Scala-native murmur
+        hot loop) with a Python fallback.
+
+        Semantics match ``_row_features`` exactly: None → no feature;
+        "" → the ``colname`` categorical feature; split tokenization is
+        Python's Unicode ``str.split()`` (done host-side — the C++ side
+        only hashes, so native and fallback are bit-identical).
+        """
+        import ctypes
+
+        from ..native.loader import get_vwhash
+        valid_rows = np.asarray([i for i, x in enumerate(arr)
+                                 if x is not None], np.int64)
+        if split:
+            # pre-tokenize with Python's Unicode split; tokens contain no
+            # whitespace afterwards, so the ASCII-space re-split in C++
+            # reproduces the exact token list
+            cells = [" ".join(str(arr[i]).split()) for i in valid_rows]
+        else:
+            cells = [str(arr[i]) for i in valid_rows]
+        m = len(cells)
+        lib = get_vwhash()
+        if lib is None:
+            rows, idxs, vals = [], [], []
+            for r, t in zip(valid_rows, cells):
+                toks = t.split() if split else [t]
+                for tok in toks:
+                    rows.append(r)
+                    idxs.append(vw_feature_hash(colname + tok, ns_hash,
+                                                num_bits))
+                    vals.append(1.0)
+            return (np.asarray(rows, np.int64), np.asarray(idxs, np.int32),
+                    np.asarray(vals, np.float32))
+        blobs = [t.encode("utf-8") for t in cells]
+        offsets = np.zeros(m + 1, np.int64)
+        np.cumsum([len(b) for b in blobs], out=offsets[1:])
+        buf = b"".join(blobs)
+        W = 1 if not split else max(
+            (t.count(" ") + 1 for t in cells), default=1) or 1
+        out_idx = np.full((m, W), -1, np.int32)
+        out_val = np.zeros((m, W), np.float32)
+        out_n = np.zeros(m, np.int32)
+        lib.vw_hash_strings(
+            buf, offsets.ctypes.data_as(ctypes.POINTER(ctypes.c_int64)),
+            m, colname.encode("utf-8"), len(colname.encode("utf-8")),
+            ns_hash, num_bits, 1 if split else 0, W,
+            1 if self.get("sumCollisions") else 0,
+            out_idx.ctypes.data_as(ctypes.POINTER(ctypes.c_int32)),
+            out_val.ctypes.data_as(ctypes.POINTER(ctypes.c_float)),
+            out_n.ctypes.data_as(ctypes.POINTER(ctypes.c_int32)))
+        rows = np.repeat(valid_rows, out_n)
+        pos_ok = np.arange(W)[None, :] < out_n[:, None]
+        return rows, out_idx[pos_ok], out_val[pos_ok]
+
+    def _column_coo(self, colname: str, data, n: int, ns_hash: int,
+                    num_bits: int, split: bool):
+        """One column → (rows, indices, values) COO triples, vectorized
+        per dtype; exotic cell types fall back to the per-row dispatcher."""
+        arr = np.asarray(data)
+        mask = (1 << num_bits) - 1
+        if arr.ndim == 1 and arr.dtype.kind == "b":
+            base = vw_feature_hash(colname, ns_hash, num_bits)
+            nz = np.flatnonzero(arr)
+            return (nz.astype(np.int64),
+                    np.full(nz.size, base, np.int32),
+                    np.ones(nz.size, np.float32))
+        if arr.ndim == 1 and arr.dtype.kind in "fiu":
+            base = vw_feature_hash(colname, ns_hash, num_bits)
+            v = arr.astype(np.float32)
+            nz = np.flatnonzero(v != 0.0)
+            return (nz.astype(np.int64),
+                    np.full(nz.size, base, np.int32), v[nz])
+        if arr.ndim == 2 and arr.dtype.kind in "fiu":
+            # VectorFeaturizer: index = hash(col) + slot
+            base = vw_feature_hash(colname, ns_hash, num_bits)
+            slot_idx = ((base + np.arange(arr.shape[1], dtype=np.int64))
+                        & mask).astype(np.int32)
+            v = arr.astype(np.float32)
+            r, cpos = np.nonzero(v)
+            return r.astype(np.int64), slot_idx[cpos], v[r, cpos]
+        if arr.dtype == object and all(
+                x is None or isinstance(x, str) for x in arr):
+            return self._string_coo(colname, arr, ns_hash, num_bits, split)
+        # mixed/object cells (dicts, sequences): per-row dispatch
+        rows: list[int] = []
+        idxs: list[int] = []
+        vals: list[float] = []
+        for r in range(n):
+            i, v = self._row_features(colname, data[r], ns_hash, num_bits,
+                                      split)
+            rows.extend([r] * len(i))
+            idxs.extend(i)
+            vals.extend(v)
+        return (np.asarray(rows, np.int64), np.asarray(idxs, np.int32),
+                np.asarray(vals, np.float32))
+
     def _transform(self, df):
         cols = self.getInputCols()
         num_bits = self.get("numBits")
@@ -106,33 +206,47 @@ class VowpalWabbitFeaturizer(Transformer, HasInputCols, HasOutputCol):
         sum_collisions = self.get("sumCollisions")
 
         n = len(df)
-        all_i: list[list[int]] = []
-        all_v: list[list[float]] = []
         col_data = {c: df[c] for c in list(cols) + list(split_cols - set(cols))}
-        for r in range(n):
-            row_i: list[int] = []
-            row_v: list[float] = []
-            for c, data in col_data.items():
-                i, v = self._row_features(c, data[r], ns_hash, num_bits,
-                                          c in split_cols)
-                row_i += i
-                row_v += v
-            if sum_collisions and len(set(row_i)) != len(row_i):
-                agg: dict[int, float] = {}
-                for i, v in zip(row_i, row_v):
-                    agg[i] = agg.get(i, 0.0) + v
-                row_i, row_v = list(agg), list(agg.values())
-            all_i.append(row_i)
-            all_v.append(row_v)
+        triples = [self._column_coo(c, data, n, ns_hash, num_bits,
+                                    c in split_cols)
+                   for c, data in col_data.items()]
+        rows = np.concatenate([t[0] for t in triples]) if triples else \
+            np.zeros(0, np.int64)
+        idx = np.concatenate([t[1] for t in triples]) if triples else \
+            np.zeros(0, np.int32)
+        val = np.concatenate([t[2] for t in triples]) if triples else \
+            np.zeros(0, np.float32)
 
-        width = self.get("maxFeatures") or max(
-            (len(r) for r in all_i), default=1) or 1
+        if sum_collisions and rows.size:
+            # merge duplicate (row, index) pairs, float64 accumulation
+            key = (rows << 32) | idx.astype(np.int64)
+            uniq, first, inv = np.unique(key, return_index=True,
+                                         return_inverse=True)
+            sums = np.zeros(uniq.size, np.float64)
+            np.add.at(sums, inv, val.astype(np.float64))
+            rows_u = (uniq >> 32).astype(np.int64)
+            # within each row, keep FIRST-SEEN (input-column) order so
+            # maxFeatures truncation keeps the same features it always did
+            order = np.lexsort((first, rows_u))
+            rows = rows_u[order]
+            idx = (uniq & 0xFFFFFFFF).astype(np.int32)[order]
+            val = sums.astype(np.float32)[order]
+        else:
+            order = np.argsort(rows, kind="stable")
+            rows, idx, val = rows[order], idx[order], val[order]
+
+        counts = np.bincount(rows, minlength=n) if rows.size else \
+            np.zeros(n, np.int64)
+        width = self.get("maxFeatures") or max(int(counts.max(initial=0)),
+                                               1)
+        starts = np.zeros(n, np.int64)
+        np.cumsum(counts[:-1], out=starts[1:])
+        pos = np.arange(rows.size, dtype=np.int64) - starts[rows]
+        keep = pos < width
         indices = np.full((n, width), -1, np.int32)
         values = np.zeros((n, width), np.float32)
-        for r, (ri, rv) in enumerate(zip(all_i, all_v)):
-            k = min(len(ri), width)
-            indices[r, :k] = ri[:k]
-            values[r, :k] = rv[:k]
+        indices[rows[keep], pos[keep]] = idx[keep]
+        values[rows[keep], pos[keep]] = val[keep]
         out = self.getOutputCol()
         return (df.with_column(f"{out}_indices", indices)
                   .with_column(f"{out}_values", values))

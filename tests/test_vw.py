@@ -236,3 +236,101 @@ class TestContextualBandit:
             .fit(df)
         best = model.best_actions(df)
         assert (best == 2).mean() > 0.95
+
+
+class TestNativeHashParity:
+    """The C++ batch hasher (native/src/vwhash.cpp) must be bit-identical
+    to the Python murmur reference — and the featurizer must produce the
+    same features with or without the native library."""
+
+    def test_murmur_bit_identical(self):
+        import ctypes
+
+        from mmlspark_tpu.native.loader import get_vwhash
+        from mmlspark_tpu.vw.murmur import murmur3_32
+        lib = get_vwhash()
+        if lib is None:
+            import pytest
+            pytest.skip("native toolchain unavailable")
+        rng = np.random.default_rng(0)
+        cases = [b"", b"a", b"ab", b"abc", b"abcd", b"hello world",
+                 "émoji🙂".encode("utf-8")]
+        cases += [bytes(rng.integers(0, 256, size=k, dtype=np.uint8))
+                  for k in (5, 13, 64, 255)]
+        for data in cases:
+            for seed in (0, 1, 0xDEADBEEF):
+                assert lib.vw_murmur3_32(data, len(data), seed) == \
+                    murmur3_32(data, seed), (data, seed)
+
+    def test_featurizer_native_matches_fallback(self, monkeypatch):
+        import mmlspark_tpu.native.loader as nl
+        texts = np.asarray(["big cat sat", "cat", "", "the the the"],
+                           object)
+        cities = np.asarray(["NY", "SF", "NY", "LA"], object)
+        nums = np.asarray([1.5, 0.0, -2.0, 3.0], np.float32)
+        df = DataFrame({"text": texts, "city": cities, "age": nums})
+
+        def run():
+            f = VowpalWabbitFeaturizer(
+                inputCols=["text", "city", "age"],
+                stringSplitInputCols=["text"], numBits=12,
+                outputCol="f")
+            out = f.transform(df)
+            return out["f_indices"], out["f_values"]
+
+        i_native, v_native = run()
+        monkeypatch.setitem(nl._libs, "vwhash", None)  # force fallback
+        i_py, v_py = run()
+
+        # same feature sets per row (ordering may differ)
+        for r in range(len(df)):
+            native = dict(zip(i_native[r][i_native[r] >= 0].tolist(),
+                              v_native[r][i_native[r] >= 0].tolist()))
+            python = dict(zip(i_py[r][i_py[r] >= 0].tolist(),
+                              v_py[r][i_py[r] >= 0].tolist()))
+            assert native == python, (r, native, python)
+
+    def test_unicode_whitespace_and_empty_parity(self, monkeypatch):
+        """Unicode splits (NBSP) and ''/None handling must be identical
+        with and without the native hasher, and must match the historical
+        per-row semantics: None → no feature, '' → colname feature."""
+        import mmlspark_tpu.native.loader as nl
+        texts = np.empty(4, object)
+        texts[:] = ["a b", "x y", "", None]
+        cats = np.empty(4, object)
+        cats[:] = ["", None, "v", "v"]
+        df = DataFrame({"t": texts, "c": cats})
+
+        def run():
+            out = VowpalWabbitFeaturizer(
+                inputCols=["t", "c"], stringSplitInputCols=["t"],
+                numBits=12, outputCol="f").transform(df)
+            return [dict(zip(out["f_indices"][r][out["f_indices"][r] >= 0]
+                             .tolist(),
+                             out["f_values"][r][out["f_indices"][r] >= 0]
+                             .tolist())) for r in range(4)]
+
+        native = run()
+        monkeypatch.setitem(nl._libs, "vwhash", None)
+        python = run()
+        assert native == python
+        # 'a b' is TWO tokens (Unicode split) + '' categorical
+        assert len(native[0]) == 3
+        # row 3: None text (nothing) + 'v' categorical = 1 feature
+        assert len(native[3]) == 1
+        # row 2: '' text (no tokens) + 'v' → 1 feature, same index as row 3
+        assert native[2] == native[3]
+
+    def test_max_features_keeps_first_seen(self):
+        # truncation keeps input-column order, not smallest hash indices
+        df = DataFrame({"a": np.asarray(["x", "x"], object),
+                        "b": np.asarray(["y", "y"], object),
+                        "c": np.asarray(["z", "z"], object)})
+        full = VowpalWabbitFeaturizer(
+            inputCols=["a", "b", "c"], numBits=12,
+            outputCol="f").transform(df)
+        cut = VowpalWabbitFeaturizer(
+            inputCols=["a", "b", "c"], numBits=12, maxFeatures=2,
+            outputCol="f").transform(df)
+        np.testing.assert_array_equal(cut["f_indices"][0],
+                                      full["f_indices"][0][:2])
