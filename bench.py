@@ -53,8 +53,50 @@ def _acquire_backend():
     diagnosable error instead of an rc=124 hang."""
     import jax
     from mmlspark_tpu.core.utils import retry_with_timeout
-    return retry_with_timeout(jax.devices, timeout_s=180,
-                              backoffs_ms=(0, 1000, 5000, 15000))
+    return retry_with_timeout(jax.devices, timeout_s=120,
+                              backoffs_ms=(0, 2000, 10000))
+
+
+def _timeout_scale() -> float:
+    try:
+        return float(os.environ.get("MMLSPARK_TPU_BENCH_TIMEOUT_SCALE",
+                                    "1"))
+    except ValueError:
+        return 1.0  # a bad knob must never cost the output line
+
+
+def _watchdog(fn, extras: dict, key: str, timeout_s: float):
+    """Run one sub-bench with a deadline: a half-alive TPU tunnel can pass
+    backend acquisition and then hang inside a remote compile, which
+    would reproduce round 1's no-output rc=124. The sub-bench runs in a
+    daemon thread; on timeout its error is recorded, the suite moves on,
+    and the final os._exit abandons the stuck thread. The sub-bench
+    writes into a PRIVATE dict merged only after a successful join — an
+    abandoned thread that later unwedges must not race the shared extras
+    (or the final json.dumps)."""
+    import threading
+    box: dict = {}
+    scratch: dict = {}
+
+    def run():
+        try:
+            box["result"] = fn(scratch)
+        except Exception:
+            box["error"] = traceback.format_exc()[-1500:]
+
+    t = threading.Thread(target=run, daemon=True)
+    t.start()
+    deadline = timeout_s * _timeout_scale()
+    t.join(deadline)
+    if t.is_alive():
+        extras[f"error_{key}"] = (
+            f"timed out after {deadline:.0f}s (wedged backend?)")
+        return None
+    extras.update(scratch)
+    if "error" in box:
+        extras[f"error_{key}"] = box["error"]
+        return None
+    return box.get("result")
 
 
 def bench_resnet(extras: dict) -> float:
@@ -219,18 +261,10 @@ def main():
         extras["error_backend"] = traceback.format_exc()[-1500:]
 
     if "error_backend" not in extras:
-        try:
-            images_per_sec = bench_resnet(extras)
-        except Exception:
-            extras["error_resnet"] = traceback.format_exc()[-1500:]
-        try:
-            bench_gbdt(extras)
-        except Exception:
-            extras["error_gbdt"] = traceback.format_exc()[-1500:]
-        try:
-            bench_serving(extras)
-        except Exception:
-            extras["error_serving"] = traceback.format_exc()[-1500:]
+        images_per_sec = _watchdog(bench_resnet, extras, "resnet",
+                                   600.0) or 0.0
+        _watchdog(bench_gbdt, extras, "gbdt", 420.0)
+        _watchdog(bench_serving, extras, "serving", 120.0)
 
     print(json.dumps({
         "metric": "imagefeaturizer_resnet50_inference",

@@ -98,7 +98,8 @@ def get_vwhash():
         lib.vw_hash_strings.argtypes = [
             ctypes.c_char_p, ctypes.POINTER(i64), i64,   # buf, offsets, n
             ctypes.c_char_p, i64, u32,                   # prefix, len, seed
-            ctypes.c_int, ctypes.c_int, ctypes.c_int32,  # bits, mode, W
+            ctypes.c_int, ctypes.c_int,                  # bits, mode
+            ctypes.POINTER(i64),                         # out CSR offsets
             ctypes.c_int,                                # sum_collisions
             ctypes.POINTER(ctypes.c_int32),
             ctypes.POINTER(ctypes.c_float),

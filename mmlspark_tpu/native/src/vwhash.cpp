@@ -87,7 +87,8 @@ struct Job {
     uint32_t ns_hash;
     uint32_t mask;
     int mode;  // 0 = categorical prefix+value, 1 = whitespace token split
-    int32_t W;
+    const int64_t* out_offsets;  // CSR: row r writes [out_offsets[r],
+                                 // out_offsets[r+1]) — O(nnz) memory
     bool sum_collisions;
     int32_t* out_idx;
     float* out_val;
@@ -100,8 +101,10 @@ void hash_rows(const Job& job, int64_t lo, int64_t hi) {
     for (int64_t r = lo; r < hi; r++) {
         const char* s = job.buf + job.offsets[r];
         const int64_t len = job.offsets[r + 1] - job.offsets[r];
-        int32_t* row_idx = job.out_idx + r * job.W;
-        float* row_val = job.out_val + r * job.W;
+        int32_t* row_idx = job.out_idx + job.out_offsets[r];
+        float* row_val = job.out_val + job.out_offsets[r];
+        const int32_t W =
+            (int32_t)(job.out_offsets[r + 1] - job.out_offsets[r]);
         int32_t count = 0;
         auto hash_token = [&](const char* tok, int64_t tok_len) {
             scratch.assign(job.prefix, (size_t)job.prefix_len);
@@ -110,18 +113,22 @@ void hash_rows(const Job& job, int64_t lo, int64_t hi) {
                 (const uint8_t*)scratch.data(), (int64_t)scratch.size(),
                 job.ns_hash);
             emit((int32_t)(h & job.mask), 1.0f, row_idx, row_val, count,
-                 job.W, job.sum_collisions);
+                 W, job.sum_collisions);
         };
         if (job.mode == 0) {
             // categorical: even an empty value is a feature (prefix-only
             // hash) — None rows never reach this function
             hash_token(s, len);
         } else {
+            // explicit ASCII-space split ONLY: the Python side already
+            // Unicode-tokenized and re-joined with ' '; locale-dependent
+            // std::isspace could misclassify UTF-8 continuation bytes
+            auto is_sep = [](char c) { return c == ' '; };
             int64_t i = 0;
             while (i < len) {
-                while (i < len && std::isspace((unsigned char)s[i])) i++;
+                while (i < len && is_sep(s[i])) i++;
                 int64_t start = i;
-                while (i < len && !std::isspace((unsigned char)s[i])) i++;
+                while (i < len && !is_sep(s[i])) i++;
                 if (i > start) hash_token(s + start, i - start);
             }
         }
@@ -134,11 +141,12 @@ void hash_rows(const Job& job, int64_t lo, int64_t hi) {
 extern "C" void vw_hash_strings(const char* buf, const int64_t* offsets,
                                 int64_t n, const char* prefix,
                                 int64_t prefix_len, uint32_t ns_hash,
-                                int num_bits, int mode, int32_t W,
+                                int num_bits, int mode,
+                                const int64_t* out_offsets,
                                 int sum_collisions, int32_t* out_idx,
                                 float* out_val, int32_t* out_n) {
     Job job{buf, offsets, prefix, prefix_len, ns_hash,
-            (uint32_t)((1u << num_bits) - 1), mode, W,
+            (uint32_t)((1u << num_bits) - 1), mode, out_offsets,
             sum_collisions != 0, out_idx, out_val, out_n};
     const int64_t min_per_thread = 2048;
     int threads = (int)std::min<int64_t>(

@@ -138,22 +138,36 @@ class VowpalWabbitFeaturizer(Transformer, HasInputCols, HasOutputCol):
         offsets = np.zeros(m + 1, np.int64)
         np.cumsum([len(b) for b in blobs], out=offsets[1:])
         buf = b"".join(blobs)
-        W = 1 if not split else max(
-            (t.count(" ") + 1 for t in cells), default=1) or 1
-        out_idx = np.full((m, W), -1, np.int32)
-        out_val = np.zeros((m, W), np.float32)
+        # CSR output: per-row capacity = its own token count, so memory
+        # is O(total tokens) even when one document is huge
+        if split:
+            caps = np.asarray([0 if not t else t.count(" ") + 1
+                               for t in cells], np.int64)
+        else:
+            caps = np.ones(m, np.int64)
+        out_offsets = np.zeros(m + 1, np.int64)
+        np.cumsum(caps, out=out_offsets[1:])
+        total = int(out_offsets[-1])
+        out_idx = np.full(total, -1, np.int32)
+        out_val = np.zeros(total, np.float32)
         out_n = np.zeros(m, np.int32)
         lib.vw_hash_strings(
             buf, offsets.ctypes.data_as(ctypes.POINTER(ctypes.c_int64)),
             m, colname.encode("utf-8"), len(colname.encode("utf-8")),
-            ns_hash, num_bits, 1 if split else 0, W,
+            ns_hash, num_bits, 1 if split else 0,
+            out_offsets.ctypes.data_as(ctypes.POINTER(ctypes.c_int64)),
             1 if self.get("sumCollisions") else 0,
             out_idx.ctypes.data_as(ctypes.POINTER(ctypes.c_int32)),
             out_val.ctypes.data_as(ctypes.POINTER(ctypes.c_float)),
             out_n.ctypes.data_as(ctypes.POINTER(ctypes.c_int32)))
         rows = np.repeat(valid_rows, out_n)
-        pos_ok = np.arange(W)[None, :] < out_n[:, None]
-        return rows, out_idx[pos_ok], out_val[pos_ok]
+        # positions of the filled prefix of each row's CSR slice
+        ends = np.cumsum(out_n.astype(np.int64))
+        pick = (np.arange(int(ends[-1]) if out_n.size else 0,
+                          dtype=np.int64)
+                - np.repeat(ends - out_n, out_n)
+                + np.repeat(out_offsets[:-1], out_n))
+        return rows, out_idx[pick], out_val[pick]
 
     def _column_coo(self, colname: str, data, n: int, ns_hash: int,
                     num_bits: int, split: bool):
