@@ -59,10 +59,12 @@ def _acquire_backend():
 
 def _timeout_scale() -> float:
     try:
-        return float(os.environ.get("MMLSPARK_TPU_BENCH_TIMEOUT_SCALE",
-                                    "1"))
+        scale = float(os.environ.get("MMLSPARK_TPU_BENCH_TIMEOUT_SCALE",
+                                     "1"))
     except ValueError:
         return 1.0  # a bad knob must never cost the output line
+    # 0/negative would zero every deadline and fake-timeout healthy runs
+    return scale if scale > 0 else 1.0
 
 
 def _watchdog(fn, extras: dict, key: str, timeout_s: float):

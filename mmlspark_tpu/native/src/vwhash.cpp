@@ -6,9 +6,11 @@
 // interpreter. MurmurHash3 x86_32, bit-identical to mmlspark_tpu.vw.murmur
 // (verified by parity tests).
 //
-// Interface: one concatenated UTF-8 buffer + per-row offsets; outputs are
-// caller-allocated padded-COO [n, W] arrays. Rows are processed in
-// parallel with std::thread.
+// Interface: one concatenated UTF-8 buffer + per-row input offsets;
+// outputs are caller-allocated CSR buffers — row r writes its entries at
+// out_idx/out_val[out_offsets[r] .. out_offsets[r+1]) and reports the
+// filled count in out_n[r]. Rows are processed in parallel with
+// std::thread.
 
 #include <algorithm>
 #include <cctype>

@@ -21,9 +21,11 @@ from .collectives import (allgather, allreduce, barrier, psum_scatter,
 from .sharding import (batch_sharding, pad_rows, replicated, shard_batch,
                        unpad_rows)
 from .ring_attention import ring_attention, blockwise_attention
+from .ulysses import make_ulysses_attention
 from .pipeline import pipeline_apply, make_pipeline_mlp
 
 __all__ = [
+    "make_ulysses_attention",
     "MeshSpec", "build_mesh", "distributed_init", "local_mesh",
     "mesh_shape_for", "allgather", "allreduce", "barrier", "psum_scatter",
     "ring_permute", "batch_sharding", "pad_rows", "replicated",

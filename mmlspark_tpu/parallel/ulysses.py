@@ -1,0 +1,73 @@
+"""Ulysses-style all-to-all sequence parallelism.
+
+The second first-class long-context strategy beside ring attention
+(``ring_attention.py``): instead of rotating K/V blocks around a ring,
+each device holds a sequence shard and an ``all_to_all`` re-shards the
+activations from sequence-sharded to HEAD-sharded before attention, so
+every device computes FULL-sequence attention for its subset of heads;
+a second ``all_to_all`` restores sequence sharding afterwards.
+
+Trade-off vs ring (the public DeepSpeed-Ulysses formulation, PAPERS.md):
+two all-to-alls move O(T·D/d) per device regardless of sequence length
+and attention itself needs no per-block softmax bookkeeping, but the
+device count is capped by the head count (d ≤ H) — ring has no such cap.
+Both ride ICI; pick per model shape.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, PartitionSpec as P
+
+from .ring_attention import blockwise_attention
+
+
+def make_ulysses_attention(mesh: Mesh, axis: str = "sp", *,
+                           causal: bool = False,
+                           scale: float | None = None,
+                           block_size: int = 512):
+    """Build an all-to-all sequence-parallel attention fn over ``mesh``.
+
+    Inputs/outputs are [B, H, T, D] arrays sequence-sharded over ``axis``
+    (each device holds T/d of the sequence). H must be divisible by the
+    axis size.
+    """
+    d = int(mesh.shape[axis])
+
+    def local(q, k, v):
+        # [B, H, t, D] local sequence shard (t = T/d)
+        B, H, t, D = q.shape
+        if H % d != 0:
+            raise ValueError(
+                f"ulysses needs head count {H} divisible by the '{axis}' "
+                f"axis size {d} (use ring attention otherwise)")
+        h = H // d
+
+        def seq_to_heads(x):
+            # [B, H, t, D] → [B, H/d, T, D]: head-group j of every
+            # device's sequence chunk lands on device j; received chunks
+            # stack in source-device order = sequence order
+            x = x.reshape(B, d, h, t, D)
+            x = jax.lax.all_to_all(x, axis, split_axis=1, concat_axis=2,
+                                   tiled=False)     # [B, h, d, t, D]
+            return x.reshape(B, h, d * t, D)
+
+        def heads_to_seq(x):
+            # inverse: [B, h, T, D] → [B, H, t, D]; sequence chunk i of
+            # every head-group goes home to device i, head-groups stack
+            # in source-device order = head order
+            x = x.reshape(B, h, d, t, D)
+            x = jax.lax.all_to_all(x, axis, split_axis=2, concat_axis=1,
+                                   tiled=False)     # [B, d, h, t, D]
+            return x.reshape(B, d * h, t, D)
+
+        qh, kh, vh = seq_to_heads(q), seq_to_heads(k), seq_to_heads(v)
+        out = blockwise_attention(qh, kh, vh, causal=causal, scale=scale,
+                                  block_size=block_size)
+        return heads_to_seq(out)
+
+    spec = P(None, None, axis, None)
+    return jax.jit(jax.shard_map(local, mesh=mesh,
+                                 in_specs=(spec, spec, spec),
+                                 out_specs=spec, check_vma=False))
