@@ -135,6 +135,36 @@ def segment_pcm16(audio: np.ndarray, sample_rate: int,
     return [(s * frame, min(e * frame, n)) for s, e in segments]
 
 
+def parse_wav(data: bytes) -> tuple[np.ndarray, int]:
+    """RIFF/WAVE container → (mono int16 samples, sample_rate).
+
+    Reference ``cognitive/AudioStreams.scala`` ``WavStream``: the SDK
+    accepts WAV files by parsing the header and feeding raw PCM. Stdlib
+    ``wave`` does the container work (PCM-only by design); 16-bit only,
+    multi-channel audio is downmixed to mono by averaging.
+    """
+    import io
+    import wave
+    try:
+        with wave.open(io.BytesIO(data)) as w:
+            channels = w.getnchannels()
+            rate = w.getframerate()
+            width = w.getsampwidth()
+            pcm = w.readframes(w.getnframes())
+    except (wave.Error, EOFError) as e:
+        raise ValueError(f"not a supported WAV ({e}); note: compressed "
+                         "audio must be decoded upstream") from e
+    if width != 2:
+        raise ValueError(
+            f"only PCM16 WAV is supported (sample width {width} bytes)")
+    samples = np.frombuffer(pcm[:len(pcm) // 2 * 2], dtype="<i2")
+    if channels > 1:
+        n = samples.shape[0] // channels * channels
+        samples = samples[:n].reshape(-1, channels) \
+            .mean(axis=1).astype(np.int16)
+    return samples, rate
+
+
 class SpeechToTextSDK(SpeechToText):
     """Continuous streaming recognition over a pull audio stream.
 
@@ -145,8 +175,12 @@ class SpeechToTextSDK(SpeechToText):
     plus a ``sourceRow`` column tying results to input rows.
     """
 
-    sampleRate = Param("sampleRate", "PCM sample rate", TC.toInt,
-                       default=16000)
+    sampleRate = Param("sampleRate", "PCM sample rate (raw input)",
+                       TC.toInt, default=16000)
+    fileType = Param("fileType",
+                     "auto | wav | raw — auto sniffs the RIFF magic "
+                     "(reference fileType/AudioStreams)", TC.toString,
+                     default="auto")
     maxSegmentSeconds = Param("maxSegmentSeconds",
                               "hard utterance length cap", TC.toFloat,
                               default=15.0)
@@ -159,13 +193,20 @@ class SpeechToTextSDK(SpeechToText):
         "seconds of new audio between intermediate hypotheses",
         TC.toFloat, default=1.0)
 
-    def _recognition_request(self, seg_bytes: bytes, df, row: int):
+    def _recognition_request(self, seg_bytes: bytes, df, row: int,
+                             sample_rate: int | None = None):
         """One REST recognition request (the SDK's per-utterance service
-        hop); sent in bulk through the async client."""
+        hop); sent in bulk through the async client. The Content-Type
+        advertises the ACTUAL sample rate (a WAV's own rate may differ
+        from the sampleRate param — a mismatch would make the service
+        decode at the wrong speed)."""
         from ..io.http.schema import HTTPRequestData
+        headers = self._headers(df, row)
+        if sample_rate:
+            headers["Content-Type"] = (
+                f"audio/wav; codecs=audio/pcm; samplerate={sample_rate}")
         return HTTPRequestData(url=self._build_url(df, row),
-                               method="POST",
-                               headers=self._headers(df, row),
+                               method="POST", headers=headers,
                                entity=seg_bytes)
 
     def _result_row(self, parsed, status: str, offset_samples: int,
@@ -186,36 +227,54 @@ class SpeechToTextSDK(SpeechToText):
 
     def _transform(self, df):
         from ..core import DataFrame
-        rate = self.get("sampleRate")
-        frame_bytes = int(rate * 0.03) * 2  # 30 ms of 16-bit mono
+        rate = self.get("sampleRate")  # raw-PCM default; WAV overrides
         stream_partials = self.get("streamIntermediateResults")
-        partial_every = max(
-            int(self.get("intermediateInterval") * rate) * 2, frame_bytes)
 
         # phase 1: pull + segment each row's audio, build every recognition
         # request (partials and finals) with its result metadata
         requests = []
-        meta = []  # (src_row, status, offset_samples, n_samples)
+        meta = []  # (src_row, status, offset_samples, n_samples, rate)
+        prefailed = []  # (src_row, error) rows that never reach the wire
+        ftype = self.get("fileType")
+        if ftype not in ("auto", "wav", "raw"):
+            raise ValueError(
+                f"fileType must be auto | wav | raw, got {ftype!r}")
         for i in range(len(df)):
             # batch rows already hold complete audio; PullAudioInputStream
             # remains the API for genuinely incremental sources
             data = bytes(self._resolve("audioData", df, i))
-            audio = np.frombuffer(
-                data[:len(data) // 2 * 2], dtype="<i2")
+            row_rate = rate
+            if ftype == "wav" or (ftype == "auto"
+                                  and data[:4] == b"RIFF"):
+                try:
+                    audio, row_rate = parse_wav(data)
+                except ValueError as e:
+                    # one bad container ≠ whole batch lost
+                    prefailed.append((i, str(e)))
+                    continue
+            else:
+                audio = np.frombuffer(
+                    data[:len(data) // 2 * 2], dtype="<i2")
             segments = segment_pcm16(
-                audio, rate, max_segment_s=self.get("maxSegmentSeconds"))
+                audio, row_rate,
+                max_segment_s=self.get("maxSegmentSeconds"))
             for s, e in segments:
                 seg = audio[s:e]
                 if stream_partials:
-                    # incremental hypotheses over the growing utterance
-                    for cut in range(partial_every // 2, seg.shape[0],
-                                     partial_every // 2):
+                    # incremental hypotheses over the growing utterance,
+                    # floored at 30 ms so interval≈0 can't explode into
+                    # one request per sample
+                    step = max(int(self.get("intermediateInterval")
+                                   * row_rate),
+                               int(0.03 * row_rate), 1)
+                    for cut in range(step, seg.shape[0], step):
                         requests.append(self._recognition_request(
-                            seg[:cut].tobytes(), df, i))
-                        meta.append((i, "Recognizing", s, cut))
+                            seg[:cut].tobytes(), df, i, row_rate))
+                        meta.append((i, "Recognizing", s, cut, row_rate))
                 requests.append(self._recognition_request(
-                    seg.tobytes(), df, i))
-                meta.append((i, "Success", s, seg.shape[0]))
+                    seg.tobytes(), df, i, row_rate))
+                meta.append((i, "Success", s, seg.shape[0],
+                             row_rate))
 
         # phase 2: bulk send — the concurrency param applies exactly as in
         # the plain request/response services
@@ -228,7 +287,7 @@ class SpeechToTextSDK(SpeechToText):
         results: list[dict] = []
         errors: list = []
         src_rows: list[int] = []
-        for (i, status, s, n), resp in zip(meta, responses):
+        for (i, status, s, n, row_rate), resp in zip(meta, responses):
             if 200 <= resp.status_code < 300:
                 try:
                     parsed, err = resp.json(), None
@@ -244,8 +303,15 @@ class SpeechToTextSDK(SpeechToText):
                        if resp.entity else None}
                 if status == "Success":
                     status = "Error"
-            results.append(self._result_row(parsed, status, s, n, rate))
+            results.append(self._result_row(parsed, status, s, n,
+                                            row_rate))
             errors.append(err)
+            src_rows.append(i)
+        for i, msg in prefailed:
+            results.append({"ResultId": uuid.uuid4().hex,
+                            "RecognitionStatus": "Error",
+                            "DisplayText": "", "Offset": 0, "Duration": 0})
+            errors.append({"error": msg})
             src_rows.append(i)
 
         out = np.empty(len(results), object)

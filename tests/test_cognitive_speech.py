@@ -261,3 +261,85 @@ class TestAzureSearchIndexManagement:
             assert not w.index_exists()
         finally:
             httpd.shutdown()
+
+
+class TestWavContainer:
+    def _wav_bytes(self, samples: np.ndarray, rate: int, channels=1):
+        import io
+        import wave
+        buf = io.BytesIO()
+        with wave.open(buf, "wb") as w:
+            w.setnchannels(channels)
+            w.setsampwidth(2)
+            w.setframerate(rate)
+            w.writeframes(samples.tobytes())
+        return buf.getvalue()
+
+    def test_parse_wav_roundtrip(self):
+        from mmlspark_tpu.cognitive.speech import parse_wav
+        pcm = tone(0.2)
+        data = self._wav_bytes(pcm, 16000)
+        samples, rate = parse_wav(data)
+        assert rate == 16000
+        np.testing.assert_array_equal(samples, pcm)
+
+    def test_parse_wav_stereo_downmix(self):
+        from mmlspark_tpu.cognitive.speech import parse_wav
+        left = tone(0.1)
+        right = np.zeros_like(left)
+        inter = np.empty(left.size * 2, np.int16)
+        inter[0::2], inter[1::2] = left, right
+        samples, rate = parse_wav(self._wav_bytes(inter, 8000, channels=2))
+        expected = (left.astype(np.float64) / 2).astype(np.int16)
+        np.testing.assert_array_equal(samples, expected)
+        assert rate == 8000
+
+    def test_parse_wav_rejects_garbage(self):
+        import pytest
+        from mmlspark_tpu.cognitive.speech import parse_wav
+        with pytest.raises(ValueError, match="RIFF"):
+            parse_wav(b"not a wav file")
+
+    def test_sdk_auto_detects_wav_and_uses_its_rate(self, speech_api):
+        # 8 kHz WAV: offsets/durations must be computed at 8 kHz
+        sdk = SpeechToTextSDK(url=f"{speech_api}/stt", outputCol="text")
+        sdk.set("subscriptionKey", "k")
+        sdk.setAudioDataCol("audio")
+        rate = 8000
+        t = np.arange(int(0.4 * rate)) / rate
+        pcm = np.concatenate([
+            (8000 * np.sin(2 * np.pi * 440 * t)).astype(np.int16),
+            np.zeros(rate // 2, np.int16)])
+        audio = np.empty(1, object)
+        audio[0] = self._wav_bytes(pcm, rate)
+        out = sdk.transform(DataFrame({"audio": audio}))
+        rows = list(out["text"])
+        assert len(rows) == 1
+        dur_s = rows[0]["Duration"] / 1e7
+        assert 0.3 < dur_s < 0.55, dur_s  # ~0.4s at the WAV's own rate
+
+    def test_bad_wav_is_per_row_error(self, speech_api):
+        sdk = SpeechToTextSDK(url=f"{speech_api}/stt", outputCol="text",
+                              fileType="wav")
+        sdk.set("subscriptionKey", "k")
+        sdk.setAudioDataCol("audio")
+        audio = np.empty(2, object)
+        audio[0] = b"RIFF but truncated garbage"
+        audio[1] = self._wav_bytes(
+            np.concatenate([tone(0.3), silence(0.4)]), 16000)
+        out = sdk.transform(DataFrame({"audio": audio}))
+        by_src = {int(s): (r, e) for s, r, e in
+                  zip(out["sourceRow"], out["text"], out["error"])}
+        assert by_src[0][0]["RecognitionStatus"] == "Error"
+        assert by_src[0][1] is not None
+        assert by_src[1][0]["RecognitionStatus"] == "Success"
+
+    def test_file_type_validated(self):
+        import pytest
+        sdk = SpeechToTextSDK(outputCol="t", fileType="mp3")
+        sdk.set("subscriptionKey", "k")
+        sdk.setAudioDataCol("audio")
+        audio = np.empty(1, object)
+        audio[0] = b"\x00\x00"
+        with pytest.raises(ValueError, match="fileType"):
+            sdk.transform(DataFrame({"audio": audio}))
