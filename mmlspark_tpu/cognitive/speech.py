@@ -194,7 +194,7 @@ class SpeechToTextSDK(SpeechToText):
         TC.toFloat, default=1.0)
 
     def _recognition_request(self, seg_bytes: bytes, df, row: int,
-                             sample_rate: int | None = None):
+                             sample_rate: int):
         """One REST recognition request (the SDK's per-utterance service
         hop); sent in bulk through the async client. The Content-Type
         advertises the ACTUAL sample rate (a WAV's own rate may differ
@@ -202,9 +202,8 @@ class SpeechToTextSDK(SpeechToText):
         decode at the wrong speed)."""
         from ..io.http.schema import HTTPRequestData
         headers = self._headers(df, row)
-        if sample_rate:
-            headers["Content-Type"] = (
-                f"audio/wav; codecs=audio/pcm; samplerate={sample_rate}")
+        headers["Content-Type"] = (
+            f"audio/wav; codecs=audio/pcm; samplerate={sample_rate}")
         return HTTPRequestData(url=self._build_url(df, row),
                                method="POST", headers=headers,
                                entity=seg_bytes)
@@ -308,9 +307,9 @@ class SpeechToTextSDK(SpeechToText):
             errors.append(err)
             src_rows.append(i)
         for i, msg in prefailed:
-            results.append({"ResultId": uuid.uuid4().hex,
-                            "RecognitionStatus": "Error",
-                            "DisplayText": "", "Offset": 0, "Duration": 0})
+            # through _result_row so subclasses' schema additions
+            # (ConversationTranscription's SpeakerId) stay uniform
+            results.append(self._result_row(None, "Error", 0, 0, 1))
             errors.append({"error": msg})
             src_rows.append(i)
 

@@ -83,3 +83,55 @@ class TestVWBenchmarks:
             auc = roc_auc(y, model.transform(df)["probability"][:, 1])
             b.add(f"synthetic.{tag}", auc, 0.02)
         b.verify(regenerate=REGEN)
+
+
+class TestSparseGBDTBenchmarks:
+    def test_sparse_classifier_auc(self):
+        from test_lightgbm_sparse import dense_to_coo
+        b = Benchmarks(os.path.join(
+            RESOURCE_DIR, "benchmarks_LightGBMSparse.csv"))
+        rng = np.random.default_rng(5)
+        x = rng.normal(size=(1500, 16)).astype(np.float32)
+        x[rng.random(x.shape) > 0.4] = 0.0
+        y = ((x[:, 0] * 2 - x[:, 1] + x[:, 2]
+              + rng.normal(scale=0.3, size=1500)) > 0).astype(np.float32)
+        idx, val = dense_to_coo(x)
+        df = DataFrame({"features_indices": idx, "features_values": val,
+                        "label": y})
+        for shards, tag in [(1, "single"), (8, "data_parallel")]:
+            m = LightGBMClassifier(numIterations=30, numLeaves=15,
+                                   minDataInLeaf=5, numShards=shards,
+                                   seed=0).fit(df)
+            auc = roc_auc(y, m.transform(df)["probability"][:, 1])
+            b.add(f"sparse.{tag}", auc, 0.015)
+        m = LightGBMClassifier(numIterations=30, numLeaves=15,
+                               minDataInLeaf=5, numShards=8,
+                               parallelism="voting_parallel", topK=6,
+                               seed=0).fit(df)
+        auc = roc_auc(y, m.transform(df)["probability"][:, 1])
+        b.add("sparse.voting_parallel", auc, 0.02)
+        b.verify(regenerate=REGEN)
+
+
+class TestLinearBenchmarks:
+    def test_linear_family(self):
+        from mmlspark_tpu.train import LinearRegression, LogisticRegression
+        b = Benchmarks(os.path.join(RESOURCE_DIR,
+                                    "benchmarks_LinearLearners.csv"))
+        x, y_cls, y_reg = tabular(seed=3)
+        df_c = DataFrame({"features": x, "label": y_cls})
+        auc = roc_auc(y_cls, LogisticRegression(maxIter=40).fit(df_c)
+                      .transform(df_c)["probability"][:, 1])
+        b.add("logistic.auc", auc, 0.01)
+        df_r = DataFrame({"features": x, "label": y_reg})
+        pred = LinearRegression().fit(df_r).transform(df_r)["prediction"]
+        b.add("ridge.rmse", float(np.sqrt(np.mean((pred - y_reg) ** 2))),
+              0.05)
+        rng = np.random.default_rng(4)
+        y3 = np.digitize(x[:, 0] + 0.3 * x[:, 1],
+                         [-0.6, 0.6]).astype(np.float32)
+        df_m = DataFrame({"features": x, "label": y3})
+        m = LogisticRegression(maxIter=300).fit(df_m)
+        acc = float((m.transform(df_m)["prediction"] == y3).mean())
+        b.add("softmax.accuracy", acc, 0.01)
+        b.verify(regenerate=REGEN)
