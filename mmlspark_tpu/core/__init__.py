@@ -1,3 +1,4 @@
+from .bindings import ColumnMetadata, DataclassBindings, bindings
 from .dataframe import DataFrame, Row, GroupedData
 from .param import (Param, Params, ComplexParam, TypeConverters, StageParam,
                     StageListParam, DataFrameParam, ArrayParam, UDFParam,
@@ -10,6 +11,7 @@ from .utils import (ClusterUtil, StopWatch, retry_with_timeout,
 from . import contracts
 
 __all__ = [
+    "ColumnMetadata", "DataclassBindings", "bindings",
     "DataFrame", "Row", "GroupedData",
     "Param", "Params", "ComplexParam", "TypeConverters", "StageParam",
     "StageListParam", "DataFrameParam", "ArrayParam", "UDFParam",
