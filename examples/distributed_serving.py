@@ -1,0 +1,83 @@
+"""Distributed serving: registry + 2 ingest servers + 2 compute workers,
+with a worker kill mid-stream (docs/serving.md distributed section;
+reference DistributedHTTPSource/HTTPSourceV2)."""
+
+from _common import done
+
+import http.client
+import json
+import threading
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+from mmlspark_tpu.io.http.schema import HTTPResponseData
+from mmlspark_tpu.serving import (DistributedServingServer, DriverRegistry,
+                                  remote_worker_loop)
+
+w = jnp.asarray(np.random.default_rng(0).normal(size=(4, 4)), jnp.float32)
+
+
+@jax.jit
+def score(x):
+    return (x @ w).sum(axis=-1)
+
+
+score(jnp.zeros((1, 4), jnp.float32)).block_until_ready()
+
+
+def transform(df):
+    xs = np.stack([
+        np.frombuffer(r.entity, np.float32) if r.entity
+        and len(r.entity) == 16 else np.zeros(4, np.float32)
+        for r in df["request"]])
+    ys = np.asarray(score(jnp.asarray(xs)))
+    replies = np.empty(len(ys), object)
+    replies[:] = [HTTPResponseData(
+        status_code=200, entity=json.dumps(float(v)).encode()) for v in ys]
+    return df.with_column("reply", replies)
+
+
+registry = DriverRegistry().start()
+servers = [DistributedServingServer("svc", registry.address,
+                                    lease_timeout=1.0,
+                                    reply_timeout=20.0).start()
+           for _ in range(2)]
+stops = [threading.Event() for _ in range(2)]
+workers = [threading.Thread(
+    target=remote_worker_loop, args=(registry.address, "svc", transform),
+    kwargs={"stop_event": st}, daemon=True) for st in stops]
+for t in workers:
+    t.start()
+
+try:
+    payload = np.arange(4, dtype=np.float32).tobytes()
+    for i in range(10):
+        conn = http.client.HTTPConnection(*servers[i % 2].address,
+                                          timeout=15)
+        conn.request("POST", "/", body=payload)
+        resp = conn.getresponse()
+        assert resp.status == 200
+        json.loads(resp.read())
+        conn.close()
+    print("10 requests across 2 ingest servers OK")
+
+    stops[0].set()  # stop one compute worker; survivor keeps serving
+    for i in range(6):
+        conn = http.client.HTTPConnection(*servers[i % 2].address,
+                                          timeout=15)
+        conn.request("POST", "/", body=payload)
+        resp = conn.getresponse()
+        assert resp.status == 200
+        resp.read()
+        conn.close()
+    print("survivor handled requests after worker stop")
+finally:
+    for st in stops:
+        st.set()
+    for s in servers:
+        s.stop()
+    registry.stop()
+done("distributed_serving")

@@ -1,0 +1,69 @@
+"""Streaming speech: WAV in, VAD segmentation, partial + final results
+against a local mock STT service (docs/http-cognitive.md streaming
+section; swap the url for a real region endpoint + key in production)."""
+
+from _common import done
+
+import io
+import json
+import threading
+import wave
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+import numpy as np
+
+from mmlspark_tpu.core import DataFrame
+from mmlspark_tpu.cognitive import SpeechToTextSDK
+
+
+class MockSTT(BaseHTTPRequestHandler):
+    def do_POST(self):
+        n = int(self.headers.get("Content-Length", 0))
+        self.rfile.read(n)
+        out = json.dumps({"RecognitionStatus": "Success",
+                          "DisplayText": f"utterance ({n} bytes)"}).encode()
+        self.send_response(200)
+        self.send_header("Content-Length", str(len(out)))
+        self.end_headers()
+        self.wfile.write(out)
+
+    def log_message(self, *a):
+        pass
+
+
+httpd = ThreadingHTTPServer(("127.0.0.1", 0), MockSTT)
+threading.Thread(target=httpd.serve_forever, daemon=True).start()
+
+# two spoken "utterances" separated by silence, packed as a WAV file
+rate = 16000
+t = np.arange(int(0.5 * rate)) / rate
+utter = (8000 * np.sin(2 * np.pi * 440 * t)).astype(np.int16)
+gap = np.zeros(rate // 2, np.int16)
+buf = io.BytesIO()
+with wave.open(buf, "wb") as f:
+    f.setnchannels(1)
+    f.setsampwidth(2)
+    f.setframerate(rate)
+    f.writeframes(np.concatenate([gap, utter, gap, utter, gap]).tobytes())
+
+audio = np.empty(1, object)
+audio[0] = buf.getvalue()
+
+sdk = SpeechToTextSDK(
+    url=f"http://127.0.0.1:{httpd.server_address[1]}/stt",
+    outputCol="transcript", streamIntermediateResults=True,
+    intermediateInterval=0.25)
+sdk.set("subscriptionKey", "example-key")
+sdk.setAudioDataCol("audio")
+
+out = sdk.transform(DataFrame({"audio": audio}))
+finals = [r for r in out["transcript"]
+          if r["RecognitionStatus"] == "Success"]
+partials = [r for r in out["transcript"]
+            if r["RecognitionStatus"] == "Recognizing"]
+print(f"{len(finals)} final utterances, {len(partials)} partial "
+      f"hypotheses")
+assert len(finals) == 2 and len(partials) >= 2
+assert all(r["Duration"] > 0 for r in finals)
+httpd.shutdown()
+done("speech_streaming")

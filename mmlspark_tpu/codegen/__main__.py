@@ -7,4 +7,5 @@ from . import generate_all
 
 if __name__ == "__main__":
     out = generate_all(sys.argv[1] if len(sys.argv) > 1 else "generated")
-    print(f"wrote {len(out['stubs'])} stub files and {out['docs']}")
+    print(f"wrote {len(out['stubs'])} stub files, {len(out['r'])} R "
+          f"files, and {out['docs']}")

@@ -1,4 +1,6 @@
-from .bindings import ColumnMetadata, DataclassBindings, bindings
+# NB: the bindings() sugar stays submodule-only — re-exporting it here
+# would shadow the mmlspark_tpu.core.bindings module attribute
+from .bindings import ColumnMetadata, DataclassBindings
 from .dataframe import DataFrame, Row, GroupedData
 from .param import (Param, Params, ComplexParam, TypeConverters, StageParam,
                     StageListParam, DataFrameParam, ArrayParam, UDFParam,
@@ -11,7 +13,7 @@ from .utils import (ClusterUtil, StopWatch, retry_with_timeout,
 from . import contracts
 
 __all__ = [
-    "ColumnMetadata", "DataclassBindings", "bindings",
+    "ColumnMetadata", "DataclassBindings",
     "DataFrame", "Row", "GroupedData",
     "Param", "Params", "ComplexParam", "TypeConverters", "StageParam",
     "StageListParam", "DataFrameParam", "ArrayParam", "UDFParam",
