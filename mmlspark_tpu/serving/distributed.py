@@ -37,7 +37,7 @@ import numpy as np
 
 from ..core import DataFrame
 from ..io.http.schema import HTTPRequestData, HTTPResponseData
-from .server import CachedRequest, ServingServer, _LOG
+from .server import CachedRequest, QuietHTTPServer, ServingServer, _LOG
 
 
 @dataclasses.dataclass
@@ -135,11 +135,13 @@ class DriverRegistry:
                     self.end_headers()
 
             protocol_version = "HTTP/1.1"
+            wbufsize = -1                    # one segment per response
+            disable_nagle_algorithm = True   # no Nagle/delayed-ACK stall
 
             def log_message(self, *args):
                 pass
 
-        self._httpd = ThreadingHTTPServer((host, port), Handler)
+        self._httpd = QuietHTTPServer((host, port), Handler)
         self.address = self._httpd.server_address
         self._thread = threading.Thread(target=self._httpd.serve_forever,
                                         daemon=True)

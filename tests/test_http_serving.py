@@ -241,3 +241,75 @@ class TestAsyncClient:
             entity=b'{"x": 1}') for _ in range(8)]
         out = AsyncClient(concurrency=8).send(reqs)
         assert all(r.status_code == 200 for r in out)
+
+
+def test_serving_latency_no_nagle_stall():
+    """Round-trip latency through the real HTTP stack must stay in the
+    low-millisecond regime: the Nagle/delayed-ACK interaction of an
+    unbuffered response stream costs ~40 ms per request, two orders over
+    the reference's ~1 ms continuous-mode claim. The bound here is loose
+    (10 ms median on shared CI hardware) — it exists to catch that class
+    of regression, not to benchmark."""
+    import http.client
+    import time
+
+    import numpy as np
+
+    from mmlspark_tpu.io.http.schema import HTTPResponseData
+    from mmlspark_tpu.serving.server import serving_query
+
+    def transform(df):
+        replies = np.empty(len(df), object)
+        replies[:] = [HTTPResponseData(status_code=200, entity=b"ok")
+                      for _ in range(len(df))]
+        return df.with_column("reply", replies)
+
+    query = serving_query("lat", transform, reply_timeout=10.0)
+    try:
+        conn = http.client.HTTPConnection(*query.server.address,
+                                          timeout=5)
+        lat = []
+        for _ in range(60):
+            t0 = time.perf_counter()
+            conn.request("POST", "/", body=b"x")
+            resp = conn.getresponse()
+            resp.read()
+            assert resp.status == 200
+            lat.append((time.perf_counter() - t0) * 1e3)
+        conn.close()
+        p50 = float(np.percentile(np.asarray(lat[10:]), 50))
+        assert p50 < 10.0, f"serving p50 {p50:.1f} ms — Nagle-stall class"
+    finally:
+        query.stop()
+
+
+def test_early_disconnect_is_quiet(capfd):
+    """A client that hangs up before the reply arrives must not dump a
+    socketserver traceback (buffered responses flush after the handler,
+    outside its guard — QuietHTTPServer swallows the disconnect)."""
+    import socket
+    import time
+
+    import numpy as np
+
+    from mmlspark_tpu.io.http.schema import HTTPResponseData
+    from mmlspark_tpu.serving.server import serving_query
+
+    def slow_transform(df):
+        time.sleep(0.5)
+        replies = np.empty(len(df), object)
+        replies[:] = [HTTPResponseData(status_code=200, entity=b"late")
+                      for _ in range(len(df))]
+        return df.with_column("reply", replies)
+
+    query = serving_query("quiet", slow_transform, reply_timeout=5.0)
+    try:
+        s = socket.create_connection(query.server.address, timeout=5)
+        s.sendall(b"POST / HTTP/1.1\r\nHost: x\r\nContent-Length: 1\r\n"
+                  b"\r\nx")
+        s.close()  # hang up before the 0.5s pipeline replies
+        time.sleep(1.2)
+    finally:
+        query.stop()
+    err = capfd.readouterr().err
+    assert "BrokenPipeError" not in err and "Traceback" not in err, err
