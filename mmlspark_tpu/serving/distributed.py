@@ -31,13 +31,14 @@ import queue
 import threading
 import time
 import uuid
-from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from http.server import BaseHTTPRequestHandler
 
 import numpy as np
 
 from ..core import DataFrame
 from ..io.http.schema import HTTPRequestData, HTTPResponseData
-from .server import CachedRequest, QuietHTTPServer, ServingServer, _LOG
+from .server import (CachedRequest, LowLatencyHandlerMixin,
+                     QuietHTTPServer, ServingServer, _LOG)
 
 
 @dataclasses.dataclass
@@ -96,7 +97,8 @@ class DriverRegistry:
         self._lock = threading.Lock()
         registry = self
 
-        class Handler(BaseHTTPRequestHandler):
+        class Handler(LowLatencyHandlerMixin,
+                      BaseHTTPRequestHandler):
             def do_POST(self):
                 length = int(self.headers.get("Content-Length", 0))
                 body = json.loads(self.rfile.read(length) or b"{}")
@@ -134,12 +136,7 @@ class DriverRegistry:
                     self.send_header("Content-Length", "0")
                     self.end_headers()
 
-            protocol_version = "HTTP/1.1"
-            wbufsize = -1                    # one segment per response
-            disable_nagle_algorithm = True   # no Nagle/delayed-ACK stall
 
-            def log_message(self, *args):
-                pass
 
         self._httpd = QuietHTTPServer((host, port), Handler)
         self.address = self._httpd.server_address

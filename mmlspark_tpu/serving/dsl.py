@@ -25,6 +25,25 @@ from .server import ServingQuery, ServingServer
 from .udfs import make_reply_udf
 
 
+import threading as _threading
+
+_shared_registry = None
+_registry_lock = _threading.Lock()
+
+
+def _default_registry():
+    """Process-wide DriverRegistry, created on first distributed load —
+    the role of the reference's implicitly-started driver service
+    (``DriverServiceUtils.createDriverService``). Creation is locked:
+    two racing loads must not split the mesh across two registries."""
+    global _shared_registry
+    with _registry_lock:
+        if _shared_registry is None:
+            from .distributed import DriverRegistry
+            _shared_registry = DriverRegistry().start()
+        return _shared_registry
+
+
 class _ReadStreamBuilder:
     def __init__(self):
         self._mode = "server"
@@ -34,10 +53,12 @@ class _ReadStreamBuilder:
         return self
 
     def distributedServer(self):
-        # one process = one host here, so distributed == head-node mode;
-        # multi-host serving fronts N processes with an external LB, as the
-        # reference requires for DistributedHTTPSource too
-        self._mode = "server"
+        """Worker-mesh mode (reference ``distributedServer()``): the
+        loaded server registers with a driver registry (pass one with
+        ``.option("driverAddress", (host, port))`` or share the implicit
+        process-wide one) so compute workers can lease its requests and
+        replies route across processes."""
+        self._mode = "distributed"
         return self
 
     def continuousServer(self):
@@ -53,11 +74,20 @@ class _ReadStreamBuilder:
         return self
 
     def load(self) -> "ServingStream":
-        server = ServingServer(
-            getattr(self, "_api", "default"),
+        kwargs = dict(
             host=getattr(self, "_host", "127.0.0.1"),
             port=int(getattr(self, "_port", 0)),
             api_path="/" + getattr(self, "_api", ""))
+        name = getattr(self, "_api", "default")
+        if self._mode == "distributed":
+            from .distributed import DistributedServingServer
+            driver = getattr(self, "_driverAddress", None) or \
+                _default_registry().address
+            server = DistributedServingServer(
+                name, driver, mesh_secret=getattr(self, "_meshSecret", ""),
+                **kwargs)
+        else:
+            server = ServingServer(name, **kwargs)
         return ServingStream(server)
 
 

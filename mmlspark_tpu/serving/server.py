@@ -32,6 +32,20 @@ _LOG = logging.getLogger("mmlspark_tpu.serving")
 _SERVICES: dict[str, "ServingServer"] = {}
 
 
+class LowLatencyHandlerMixin:
+    """Shared handler posture for every serving-plane HTTP handler:
+    HTTP/1.1 keep-alive, responses coalesced into one TCP segment
+    (buffered wfile) with Nagle off — the unbuffered default interacts
+    with delayed ACK for ~40 ms stalls per request — and quiet logs."""
+
+    protocol_version = "HTTP/1.1"
+    wbufsize = -1
+    disable_nagle_algorithm = True
+
+    def log_message(self, *args):
+        pass
+
+
 class QuietHTTPServer(ThreadingHTTPServer):
     """ThreadingHTTPServer that treats dead-client disconnects as routine.
 
@@ -101,7 +115,8 @@ class ServingServer:
 
         serving = self
 
-        class Handler(BaseHTTPRequestHandler):
+        class Handler(LowLatencyHandlerMixin,
+                      BaseHTTPRequestHandler):
             def _serve(self):
                 # route on the service path like the reference WorkerServer
                 # (continuous/HTTPSourceV2.scala PublicHandler): anything
@@ -155,18 +170,6 @@ class ServingServer:
                     pass  # flaky client; reference tolerates these too
 
             do_GET = do_POST = do_PUT = _serve
-            # HTTP/1.1: keep-alive for the internal worker mesh (every
-            # response above sets Content-Length, which 1.1 requires)
-            protocol_version = "HTTP/1.1"
-            # latency-critical: coalesce the whole response into one TCP
-            # segment (buffered wfile) and disable Nagle — the default
-            # unbuffered writes interact with delayed ACK for ~40 ms
-            # stalls per request, two orders over the ~1 ms target
-            wbufsize = -1
-            disable_nagle_algorithm = True
-
-            def log_message(self, *args):  # quiet
-                pass
 
         self._httpd = QuietHTTPServer((host, port), Handler)
         self.address = self._httpd.server_address

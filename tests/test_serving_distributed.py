@@ -268,3 +268,49 @@ class TestInProcessWorkerLoop:
             w.join(timeout=5)
             for s in servers:
                 s.stop()
+
+
+class TestDslDistributed:
+    def test_read_stream_distributed_server(self):
+        """readStream.distributedServer() loads a registry-backed server
+        whose requests compute workers can lease (reference
+        IOImplicits.distributedServer)."""
+        from mmlspark_tpu.serving import read_stream
+        from mmlspark_tpu.serving.dsl import _default_registry
+
+        stream = (read_stream().distributedServer()
+                  .address("127.0.0.1", 0, "dslapi").load())
+        server = stream.server
+        try:
+            assert isinstance(server, DistributedServingServer)
+            server.start()
+            # registered with the shared registry under the api name
+            reg = _default_registry()
+            assert any(i.worker_id == server.worker_id
+                       for i in reg.workers("dslapi"))
+            # a worker answers requests ingested through the DSL server
+            stop = threading.Event()
+
+            def transform(df):
+                import numpy as np
+
+                from mmlspark_tpu.io.http.schema import HTTPResponseData
+                replies = np.empty(len(df), object)
+                replies[:] = [HTTPResponseData(
+                    status_code=200, entity=b"dsl!") for _ in df["request"]]
+                return df.with_column("reply", replies)
+
+            t = threading.Thread(
+                target=remote_worker_loop,
+                args=(reg.address, "dslapi", transform),
+                kwargs={"stop_event": stop}, daemon=True)
+            t.start()
+            conn = http.client.HTTPConnection(*server.address, timeout=10)
+            conn.request("POST", "/dslapi", body=b"hi")
+            resp = conn.getresponse()
+            assert (resp.status, resp.read()) == (200, b"dsl!")
+            conn.close()
+            stop.set()
+            t.join(timeout=5)
+        finally:
+            server.stop()
