@@ -17,6 +17,8 @@ Here:
 
 from __future__ import annotations
 
+import threading
+
 import numpy as np
 
 from ..core import DataFrame
@@ -25,10 +27,8 @@ from .server import ServingQuery, ServingServer
 from .udfs import make_reply_udf
 
 
-import threading as _threading
-
 _shared_registry = None
-_registry_lock = _threading.Lock()
+_registry_lock = threading.Lock()
 
 
 def _default_registry():
@@ -77,7 +77,9 @@ class _ReadStreamBuilder:
         kwargs = dict(
             host=getattr(self, "_host", "127.0.0.1"),
             port=int(getattr(self, "_port", 0)),
-            api_path="/" + getattr(self, "_api", ""))
+            api_path="/" + getattr(self, "_api", ""),
+            reply_timeout=float(getattr(self, "_replyTimeout", 30.0)),
+            max_queue=int(getattr(self, "_maxQueue", 0)))
         name = getattr(self, "_api", "default")
         if self._mode == "distributed":
             from .distributed import DistributedServingServer
@@ -88,7 +90,9 @@ class _ReadStreamBuilder:
                 **kwargs)
         else:
             server = ServingServer(name, **kwargs)
-        return ServingStream(server)
+        return ServingStream(server, mode=self._mode,
+                             max_batch=int(getattr(self, "_maxBatch", 0)),
+                             linger=float(getattr(self, "_linger", 0.0)))
 
 
 def read_stream() -> _ReadStreamBuilder:
@@ -96,10 +100,18 @@ def read_stream() -> _ReadStreamBuilder:
 
 
 class ServingStream:
-    """A composable request stream: chain transforms, then reply."""
+    """A composable request stream: chain transforms, then reply.
 
-    def __init__(self, server: ServingServer):
+    ``continuousServer()`` loads run record-at-a-time (``max_batch=1``,
+    the reference's continuous-trigger semantics); other modes use
+    dynamic batching, optionally with a micro-batch ``linger``."""
+
+    def __init__(self, server: ServingServer, mode: str = "server",
+                 max_batch: int = 0, linger: float = 0.0):
         self.server = server
+        self.mode = mode
+        self.max_batch = max_batch or (1 if mode == "continuous" else 1024)
+        self.linger = linger
         self._stages: list = []
         self._reply_fn = None
         self._reply_col = "reply"
@@ -142,4 +154,6 @@ class ServingStream:
             return df
 
         self.server.start()
-        return ServingQuery(self.server, run, name=name).start()
+        return ServingQuery(self.server, run, name=name,
+                            max_batch=self.max_batch,
+                            linger=self.linger).start()

@@ -15,6 +15,7 @@ from __future__ import annotations
 
 import queue
 import threading
+import time
 import traceback
 import uuid
 from dataclasses import dataclass, field
@@ -193,18 +194,30 @@ class ServingServer:
 
     # -- batch intake (called by the query loop) ---------------------------
     def next_batch(self, max_wait: float = 0.005,
-                   max_batch: int = 1024) -> list[CachedRequest]:
+                   max_batch: int = 1024,
+                   linger: float = 0.0) -> list[CachedRequest]:
         """Dynamic batching: whatever accumulated, like the reference's
         ``DynamicBufferedBatcher`` — small batches under light load (low
-        latency), large under heavy load."""
+        latency), large under heavy load. ``max_wait`` is only the idle
+        poll timeout (an arriving request is picked up immediately);
+        ``linger`` optionally waits after the first request to grow the
+        batch (micro-batch throughput mode); ``max_batch=1`` is strict
+        record-at-a-time (continuous mode)."""
         batch: list[CachedRequest] = []
         try:
             batch.append(self.queue.get(timeout=max_wait))
         except queue.Empty:
             return batch
+        deadline = time.monotonic() + linger if linger > 0 else None
         while len(batch) < max_batch:
             try:
-                batch.append(self.queue.get_nowait())
+                if deadline is None:
+                    batch.append(self.queue.get_nowait())
+                else:
+                    remaining = deadline - time.monotonic()
+                    if remaining <= 0:
+                        break
+                    batch.append(self.queue.get(timeout=remaining))
             except queue.Empty:
                 break
         return batch
@@ -235,10 +248,15 @@ class ServingQuery:
     ``reply`` (HTTPResponseData) columns."""
 
     def __init__(self, server: ServingServer, transform_fn,
-                 name: str | None = None):
+                 name: str | None = None, *, max_batch: int = 1024,
+                 linger: float = 0.0):
         self.server = server
         self.transform_fn = transform_fn
         self.name = name or server.name
+        # max_batch=1 = record-at-a-time (reference continuous mode);
+        # linger > 0 = micro-batch throughput mode (wait to grow batches)
+        self.max_batch = max_batch
+        self.linger = linger
         self._stop = threading.Event()
         self._thread = threading.Thread(target=self._run, daemon=True)
         self.exception: Exception | None = None
@@ -257,7 +275,8 @@ class ServingQuery:
 
     def _run(self):
         while not self._stop.is_set():
-            batch = self.server.next_batch()
+            batch = self.server.next_batch(max_batch=self.max_batch,
+                                           linger=self.linger)
             if not batch:
                 continue
             ids = np.empty(len(batch), object)

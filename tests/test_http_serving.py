@@ -313,3 +313,88 @@ def test_early_disconnect_is_quiet(capfd):
         query.stop()
     err = capfd.readouterr().err
     assert "BrokenPipeError" not in err and "Traceback" not in err, err
+
+
+def test_continuous_mode_record_at_a_time():
+    """continuousServer() processes record-at-a-time (max_batch=1),
+    microbatch server() batches — the reference's trigger distinction."""
+    import http.client
+    import threading
+
+    import numpy as np
+
+    from mmlspark_tpu.serving import read_stream
+
+    seen_batches = []
+
+    def make_transform():
+        def transform(df):
+            from mmlspark_tpu.io.http.schema import HTTPResponseData
+            seen_batches.append(len(df))
+            replies = np.empty(len(df), object)
+            replies[:] = [HTTPResponseData(status_code=200, entity=b"c")
+                          for _ in range(len(df))]
+            return df.with_column("reply", replies)
+        return transform
+
+    stream = (read_stream().continuousServer()
+              .address("127.0.0.1", 0, "cont").load())
+    assert stream.max_batch == 1
+    query = stream.transform(make_transform()).start()
+    try:
+        def one():
+            conn = http.client.HTTPConnection(*query.server.address,
+                                              timeout=10)
+            conn.request("POST", "/cont", body=b"x")
+            assert conn.getresponse().status == 200
+            conn.close()
+
+        threads = [threading.Thread(target=one) for _ in range(8)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(timeout=15)
+        assert sum(seen_batches) == 8
+        assert max(seen_batches) == 1  # never batched
+    finally:
+        query.stop()
+
+
+def test_microbatch_linger_grows_batches():
+    import http.client
+    import threading
+
+    import numpy as np
+
+    from mmlspark_tpu.serving import read_stream
+
+    seen_batches = []
+
+    def transform(df):
+        from mmlspark_tpu.io.http.schema import HTTPResponseData
+        seen_batches.append(len(df))
+        replies = np.empty(len(df), object)
+        replies[:] = [HTTPResponseData(status_code=200, entity=b"b")
+                      for _ in range(len(df))]
+        return df.with_column("reply", replies)
+
+    stream = (read_stream().server().option("linger", 0.1)
+              .address("127.0.0.1", 0, "micro").load())
+    query = stream.transform(transform).start()
+    try:
+        def one():
+            conn = http.client.HTTPConnection(*query.server.address,
+                                              timeout=10)
+            conn.request("POST", "/micro", body=b"x")
+            assert conn.getresponse().status == 200
+            conn.close()
+
+        threads = [threading.Thread(target=one) for _ in range(8)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(timeout=15)
+        assert sum(seen_batches) == 8
+        assert max(seen_batches) >= 3  # linger coalesced concurrent load
+    finally:
+        query.stop()
