@@ -3,6 +3,11 @@ run anywhere (the notebooks' 'works on a laptop' property), keep sizes
 small, and give each example a PASS/FAIL contract the runner checks."""
 
 import os
+import sys
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+if _REPO not in sys.path:
+    sys.path.insert(0, _REPO)
 
 os.environ.setdefault("JAX_PLATFORMS", "cpu")
 import jax  # noqa: E402

@@ -188,13 +188,16 @@ def grow_tree(bins: jnp.ndarray, grad: jnp.ndarray, hess: jnp.ndarray,
     except Exception:  # pragma: no cover - pallas unavailable
         pallas_ok = False
 
-    def local_hist(row_sel):
+    def local_hist(row_sel, full: bool = False):
         """SHARD-LOCAL histogram of one row subset → [F, B, 3]: the
         LightGBM single-leaf ConstructHistogram. On TPU this is the Pallas
-        one-hot MXU kernel; elsewhere one scatter-add over [F*B] keys.
-        Callers psum (or vote-and-gather) the result as the mode demands —
-        never this function, so it can run under ``lax.cond`` safely."""
-        masked = gh1 * row_sel[:, None]
+        one-hot MXU kernel (a masked full-row scan: at v5e speeds the
+        kernel is DMA-bound, so row compaction via nonzero/gather costs
+        ~1000x more than the scan it would save); elsewhere one
+        scatter-add over [F*B] keys. Callers psum (or vote-and-gather)
+        the result as the mode demands — never this function, so it can
+        run under ``lax.cond`` safely."""
+        masked = gh1 if full else gh1 * row_sel[:, None]
         if pallas_ok:
             return hist_pallas(bins, masked, num_bins=B)
         vals = jnp.broadcast_to(masked[:, None, :], (n, F, 3))
@@ -229,7 +232,7 @@ def grow_tree(bins: jnp.ndarray, grad: jnp.ndarray, hess: jnp.ndarray,
     # splits scatter only the smaller child and derive the larger by
     # subtraction — LightGBM's histogram-subtraction trick, which cuts
     # per-tree histogram work from O(L·n·F) to O(n·F·avg_depth).
-    h_root = local_hist(jnp.ones_like(row_mask))
+    h_root = local_hist(jnp.ones_like(row_mask), full=True)
     if voting:
         hist0 = jnp.zeros((L, F, B, 3), jnp.float32).at[0].set(h_root)
         cand0, cand_hist0 = vote_and_gather(h_root[None])

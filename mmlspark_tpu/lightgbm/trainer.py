@@ -344,8 +344,11 @@ def train(x: np.ndarray, y: np.ndarray, w: np.ndarray | None,
     evals: list[dict] = []
     best_iter, best_metric, rounds_no_improve = -1, None, 0
     bag_mask = np.ones(n, np.float32)
-    valid_mask_dev = jnp.asarray(pad_mask) if pad_mask is not None \
-        else jnp.ones(n, jnp.float32)
+    # single source of truth for the pad/ignore mask: host copy feeds the
+    # fused path's host-side bagging product, device copy everything else
+    valid_mask_np = np.asarray(pad_mask, np.float32) \
+        if pad_mask is not None else np.ones(n, np.float32)
+    valid_mask_dev = jnp.asarray(valid_mask_np)
     goss_key = jax.random.PRNGKey(cfg.bagging_seed)
     pulls_bulk = pulls_scalar = 0
     eval_freq = max(int(cfg.eval_freq), 1)
@@ -381,6 +384,8 @@ def train(x: np.ndarray, y: np.ndarray, w: np.ndarray | None,
             if init_booster is not None and init_booster.num_trees > 0:
                 vraw = init_booster.raw_scores(xv)
                 vscores = jnp.asarray(vraw, jnp.float32)
+    else:
+        vscores = jnp.float32(0.0)  # fused-step placeholder
     metric_name = cfg.metric or _default_metric(cfg.objective)
 
     def make_growers(tp):
@@ -398,23 +403,88 @@ def train(x: np.ndarray, y: np.ndarray, w: np.ndarray | None,
         return make_grower(multi=False, **kw), None
 
     grow, grow_multi = make_growers(tp)
+
+    def make_fused_step():
+        """ONE jitted program for a full gbdt/goss boosting iteration:
+        gradients → (GOSS mask) → tree growth → train/valid deltas →
+        score updates. Eager per-op dispatch between these pieces costs a
+        device round-trip each — ruinous when the device is remote — so
+        the common path runs as a single dispatch per iteration. dart/rf
+        keep the stepwise path (their score updates are cross-iteration
+        and host-orchestrated)."""
+        if grad_hess_override is not None:
+            def gh_fn(s, y, w):
+                return grad_hess_override(s)
+        else:
+            gh_fn = obj.grad_hess
+        arange_k = jnp.arange(K)
+        goss_kw = dict(
+            top_n=int(cfg.top_rate * n_real),
+            other_n=int(cfg.other_rate * n_real),
+            amplify=(1.0 - cfg.top_rate) / max(cfg.other_rate, 1e-12)) \
+            if is_goss else None
+
+        def routed_vdelta(tree_b):
+            if sparse:
+                vleaf = jax.vmap(lambda t: sparse_route_bins(
+                    t, vbinned.indices, vbinned.ebins, vbinned.zero_bin,
+                    max_depth=cfg.num_leaves))(tree_b)
+            else:
+                vleaf = jax.vmap(lambda t: tree_route_bins(
+                    t, vbins, max_depth=cfg.num_leaves))(tree_b)
+            return tree_b.leaf_value[arange_k[:, None], vleaf]
+
+        @jax.jit
+        def step(scores, vscores, feat_mask_dev, row_mask_dev, it_dev):
+            g, h = gh_fn(scores, y_dev, w_dev)
+            if is_goss:
+                gmag = jnp.abs(g) if g.ndim == 1 \
+                    else jnp.linalg.norm(g, axis=1)
+                rm = _goss_mask(gmag, row_mask_dev,
+                                jax.random.fold_in(goss_key, it_dev),
+                                **goss_kw)
+            else:
+                rm = row_mask_dev
+            if K == 1:
+                t1, rl1 = grow(g, h, feat_mask_dev, rm)
+                tree_b = jax.tree.map(lambda a: a[None], t1)
+                row_leaf_b = rl1[None]
+            else:
+                tree_b, row_leaf_b = grow_multi(g.T, h.T, feat_mask_dev,
+                                                rm)
+            delta_b = tree_b.leaf_value[arange_k[:, None], row_leaf_b]
+            new_scores = scores + (delta_b[0] if K == 1 else delta_b.T)
+            if valid is not None:
+                vdelta_b = routed_vdelta(tree_b)
+                new_vscores = vscores + (vdelta_b[0] if K == 1
+                                         else vdelta_b.T)
+            else:
+                new_vscores = vscores
+            return new_scores, new_vscores, tree_b
+        return step
+
+    use_fused = not is_dart and not is_rf
+    fused_step = make_fused_step() if use_fused else None
     for it in range(cfg.num_iterations):
         if delegate is not None:
             lr = delegate.get_learning_rate(it)
             if lr is not None and lr != tp.learning_rate:
                 tp = tp._replace(learning_rate=float(lr))
                 grow, grow_multi = make_growers(tp)
+                if use_fused:
+                    fused_step = make_fused_step()
             delegate.before_train_iteration(it)
 
         # ---- dart: drop trees for gradient computation
         new_tree_weight = 1.0
         dropped: list[int] = []
         eff_scores = scores
-        if is_dart and trees and rng.random() >= cfg.skip_drop:
+        n_flat = len(tree_class)  # trees holds [K,...] stacks per iter
+        if is_dart and n_flat and rng.random() >= cfg.skip_drop:
             k_drop = min(cfg.max_drop,
-                         max(1, int(round(cfg.drop_rate * len(trees)))))
+                         max(1, int(round(cfg.drop_rate * n_flat))))
             dropped = sorted(
-                rng.choice(len(trees), size=min(k_drop, len(trees)),
+                rng.choice(n_flat, size=min(k_drop, n_flat),
                            replace=False).tolist())
             for d in dropped:
                 eff_scores = _apply_delta(
@@ -423,31 +493,6 @@ def train(x: np.ndarray, y: np.ndarray, w: np.ndarray | None,
             # DART normalization: k dropped trees rescale by k/(k+1), the
             # new tree enters at 1/(k+1).
             new_tree_weight = 1.0 / (len(dropped) + 1)
-
-        # ---- gradients
-        score_for_grad = (jnp.zeros_like(scores) + base_score) if is_rf \
-            else eff_scores
-        if grad_hess_override is not None:
-            g, h = grad_hess_override(score_for_grad)
-        else:
-            g, h = obj.grad_hess(score_for_grad, y_dev, w_dev)
-
-        # ---- row sampling (padded rows always excluded: the SPMD "ignore")
-        if is_goss:
-            # fully on device: no per-iteration host↔device round trip
-            gmag = jnp.abs(g) if g.ndim == 1 else jnp.linalg.norm(g, axis=1)
-            row_mask_dev = _goss_mask(
-                gmag, valid_mask_dev, jax.random.fold_in(goss_key, it),
-                top_n=int(cfg.top_rate * n_real),
-                other_n=int(cfg.other_rate * n_real),
-                amplify=(1.0 - cfg.top_rate) / max(cfg.other_rate, 1e-12))
-        elif (is_rf or cfg.bagging_freq > 0) and cfg.bagging_fraction < 1.0:
-            if is_rf or it % max(cfg.bagging_freq, 1) == 0:
-                bag_mask = (bag_rng.random(n)
-                            < cfg.bagging_fraction).astype(np.float32)
-            row_mask_dev = jnp.asarray(bag_mask) * valid_mask_dev
-        else:
-            row_mask_dev = valid_mask_dev
 
         # ---- feature sampling
         feat_mask = np.ones(F, bool)
@@ -458,64 +503,116 @@ def train(x: np.ndarray, y: np.ndarray, w: np.ndarray | None,
 
         feat_mask_dev = jnp.asarray(feat_mask)
 
-        # ---- grow this iteration's trees: K classes in ONE jitted call
-        if K == 1:
-            tree_b, row_leaf_b = grow(g, h, feat_mask_dev, row_mask_dev)
-            tree_b = jax.tree.map(lambda a: a[None], tree_b)
-            row_leaf_b = row_leaf_b[None]
+        if fused_step is not None:
+            # ---- fused gbdt/goss iteration: ONE device dispatch for
+            # gradients + sampling + growth + deltas + score updates
+            if is_goss:
+                row_in = valid_mask_dev
+            elif cfg.bagging_freq > 0 and cfg.bagging_fraction < 1.0:
+                if it % max(cfg.bagging_freq, 1) == 0:
+                    bag_mask = (bag_rng.random(n)
+                                < cfg.bagging_fraction).astype(np.float32)
+                row_in = jnp.asarray(bag_mask * valid_mask_np)
+            else:
+                row_in = valid_mask_dev
+            scores, vscores, tree_b = fused_step(
+                scores, vscores, feat_mask_dev, row_in, np.int32(it))
+            trees.append(tree_b)
+            for k_cls in range(K):
+                tree_class.append(k_cls)
+                tree_weights.append(1.0)
         else:
-            tree_b, row_leaf_b = grow_multi(g.T, h.T, feat_mask_dev,
-                                            row_mask_dev)
-        # [K, n] per-class train deltas in one gather
-        delta_b = tree_b.leaf_value[jnp.arange(K)[:, None], row_leaf_b]
-        vdelta_b = None
-        if valid is not None:
-            if sparse:
-                vleaf_b = jax.vmap(
-                    lambda t: sparse_route_bins(
-                        t, vbinned.indices, vbinned.ebins,
-                        vbinned.zero_bin, max_depth=cfg.num_leaves))(
-                            tree_b)
+            # ---- stepwise path (dart/rf: cross-iteration score algebra)
+            # gradients
+            score_for_grad = (jnp.zeros_like(scores) + base_score) \
+                if is_rf else eff_scores
+            if grad_hess_override is not None:
+                g, h = grad_hess_override(score_for_grad)
             else:
-                vleaf_b = jax.vmap(
-                    lambda t: tree_route_bins(
-                        t, vbins, max_depth=cfg.num_leaves))(tree_b)
-            vdelta_b = tree_b.leaf_value[jnp.arange(K)[:, None], vleaf_b]
-        trees_host = jax.tree.map(np.asarray, tree_b)
+                g, h = obj.grad_hess(score_for_grad, y_dev, w_dev)
 
-        for k_cls in range(K):
-            tree = jax.tree.map(lambda a: a[k_cls], trees_host)
-            delta = delta_b[k_cls]
+            # row sampling (padded rows always excluded: SPMD "ignore")
+            if is_goss:
+                gmag = jnp.abs(g) if g.ndim == 1 \
+                    else jnp.linalg.norm(g, axis=1)
+                row_mask_dev = _goss_mask(
+                    gmag, valid_mask_dev, jax.random.fold_in(goss_key, it),
+                    top_n=int(cfg.top_rate * n_real),
+                    other_n=int(cfg.other_rate * n_real),
+                    amplify=(1.0 - cfg.top_rate)
+                    / max(cfg.other_rate, 1e-12))
+            elif (is_rf or cfg.bagging_freq > 0) \
+                    and cfg.bagging_fraction < 1.0:
+                if is_rf or it % max(cfg.bagging_freq, 1) == 0:
+                    bag_mask = (bag_rng.random(n)
+                                < cfg.bagging_fraction).astype(np.float32)
+                row_mask_dev = jnp.asarray(bag_mask) * valid_mask_dev
+            else:
+                row_mask_dev = valid_mask_dev
 
-            trees.append(tree)
-            tree_class.append(k_cls)
-            tree_weights.append(new_tree_weight if is_dart else 1.0)
-            vdelta = None if vdelta_b is None else vdelta_b[k_cls]
-            if is_dart:
-                tree_deltas.append(delta)
-                tree_vdeltas.append(vdelta)
+            # grow this iteration's trees: K classes in ONE jitted call
+            if K == 1:
+                tree_b, row_leaf_b = grow(g, h, feat_mask_dev,
+                                          row_mask_dev)
+                tree_b = jax.tree.map(lambda a: a[None], tree_b)
+                row_leaf_b = row_leaf_b[None]
+            else:
+                tree_b, row_leaf_b = grow_multi(g.T, h.T, feat_mask_dev,
+                                                row_mask_dev)
+            # [K, n] per-class train deltas in one gather
+            delta_b = tree_b.leaf_value[jnp.arange(K)[:, None], row_leaf_b]
+            vdelta_b = None
+            if valid is not None:
+                if sparse:
+                    vleaf_b = jax.vmap(
+                        lambda t: sparse_route_bins(
+                            t, vbinned.indices, vbinned.ebins,
+                            vbinned.zero_bin, max_depth=cfg.num_leaves))(
+                                tree_b)
+                else:
+                    vleaf_b = jax.vmap(
+                        lambda t: tree_route_bins(
+                            t, vbins, max_depth=cfg.num_leaves))(tree_b)
+                vdelta_b = tree_b.leaf_value[jnp.arange(K)[:, None],
+                                             vleaf_b]
+            # Trees stay ON DEVICE during the loop: a per-iteration host
+            # pull is ~10 synchronous transfers, which serializes the
+            # dispatch pipeline (and through a remote-device tunnel costs
+            # a full RTT each). One batched pull happens after the loop.
+            trees.append(tree_b)
+            for k_cls in range(K):
+                delta = delta_b[k_cls]
+                tree_class.append(k_cls)
+                tree_weights.append(new_tree_weight if is_dart else 1.0)
+                vdelta = None if vdelta_b is None else vdelta_b[k_cls]
+                if is_dart:
+                    tree_deltas.append(delta)
+                    tree_vdeltas.append(vdelta)
 
-            if is_rf:
-                # running average of tree outputs per class
-                m = it + 1
-                prev = _select_class(scores, k_cls, K) - base_flat(k_cls)
-                scores = _set_class(
-                    scores, base_flat(k_cls) + prev + (delta - prev) / m,
-                    k_cls, K)
-                if valid is not None:
-                    vprev = _select_class(vscores, k_cls, K) \
+                if is_rf:
+                    # running average of tree outputs per class
+                    m = it + 1
+                    prev = _select_class(scores, k_cls, K) \
                         - base_flat(k_cls)
-                    vscores = _set_class(
-                        vscores,
-                        base_flat(k_cls) + vprev + (vdelta - vprev) / m,
+                    scores = _set_class(
+                        scores,
+                        base_flat(k_cls) + prev + (delta - prev) / m,
                         k_cls, K)
-            else:
-                scores = _apply_delta(scores, delta * new_tree_weight,
-                                      k_cls, K)
-                if valid is not None:
-                    vscores = _apply_delta(vscores,
-                                           vdelta * new_tree_weight,
-                                           k_cls, K)
+                    if valid is not None:
+                        vprev = _select_class(vscores, k_cls, K) \
+                            - base_flat(k_cls)
+                        vscores = _set_class(
+                            vscores,
+                            base_flat(k_cls) + vprev
+                            + (vdelta - vprev) / m,
+                            k_cls, K)
+                else:
+                    scores = _apply_delta(scores, delta * new_tree_weight,
+                                          k_cls, K)
+                    if valid is not None:
+                        vscores = _apply_delta(vscores,
+                                               vdelta * new_tree_weight,
+                                               k_cls, K)
 
         if is_dart and dropped:
             # rescale dropped trees' standing contribution by k/(k+1)
@@ -576,6 +673,16 @@ def train(x: np.ndarray, y: np.ndarray, w: np.ndarray | None,
         if delegate is not None:
             delegate.after_train_iteration(it)
 
+    if trees:
+        # trees holds one [K, ...] stack per iteration. ONE batched
+        # device→host pull for everything: device_get prefetches every
+        # leaf asynchronously before blocking, so this costs ~one
+        # round-trip rather than iterations × fields. (An eager
+        # jnp.stack here would also re-enter the compiler per field —
+        # and crashes on shard_map-produced leaves on CPU meshes.)
+        host_stacks = jax.device_get(trees)
+        trees = [jax.tree.map(lambda a: a[k], stack)
+                 for stack in host_stacks for k in range(K)]
     booster = build_booster(trees, boundaries, cfg, base_score,
                             feature_names, np.asarray(tree_weights,
                                                       np.float32),

@@ -39,3 +39,22 @@ class TestPallasHistogram:
         out = hist_pallas(jnp.asarray(bins), jnp.asarray(vals),
                           num_bins=B, block_rows=32, interpret=True)
         assert float(np.asarray(out)[..., 2].sum()) == n * F
+
+    def test_count_skips_trailing_blocks(self):
+        # rows past `count` live in skipped blocks: garbage bins there
+        # must not reach the histogram
+        rng = np.random.default_rng(2)
+        n, F, B, c = 128, 3, 16, 40
+        bins = rng.integers(0, B, size=(n, F)).astype(np.uint8)
+        vals = rng.normal(size=(n, 3)).astype(np.float32)
+        out = hist_pallas(jnp.asarray(bins), jnp.asarray(vals),
+                          num_bins=B, count=jnp.int32(c), block_rows=32,
+                          interpret=True)
+        # skip granularity is whole blocks: with count=40 and
+        # block_rows=32, blocks 0-1 (rows [0,64)) compute and blocks 2-3
+        # are skipped — mirror that in the reference
+        vals_ref = vals.copy()
+        vals_ref[64:] = 0.0
+        np.testing.assert_allclose(
+            np.asarray(out), scatter_reference(bins, vals_ref, B),
+            rtol=1e-5, atol=1e-5)
