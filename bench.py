@@ -217,17 +217,23 @@ def bench_serving(extras: dict) -> None:
     score(jax.device_put(np.zeros((1, 16), np.float32),
                          cpu)).block_until_ready()  # precompile
 
-    # record the tunnel RTT so the CPU-host choice above is auditable
+    # Record the accelerator dispatch RTT so the CPU-host choice above is
+    # auditable. Only meaningful when an actual accelerator is present —
+    # on a CPU-only host the probe would measure local dispatch and
+    # mislabel it as tunnel RTT, so it is skipped. (jax.devices() was
+    # already resolved by _acquire_backend with a timeout; a wedged
+    # backend can't first hang here.)
     try:
-        tpu_dev = jax.devices()[0]
-        y = jax.device_put(jnp.ones((8, 8), jnp.float32), tpu_dev)
-        f = jax.jit(lambda a: a @ a)
-        f(y).block_until_ready()
-        t0 = time.perf_counter()
-        for _ in range(20):
+        accel = [d for d in jax.devices() if d.platform != "cpu"]
+        if accel:
+            y = jax.device_put(jnp.ones((8, 8), jnp.float32), accel[0])
+            f = jax.jit(lambda a: a @ a)
             f(y).block_until_ready()
-        extras["device_dispatch_rtt_ms"] = round(
-            (time.perf_counter() - t0) / 20 * 1e3, 3)
+            t0 = time.perf_counter()
+            for _ in range(20):
+                f(y).block_until_ready()
+            extras["device_dispatch_rtt_ms"] = round(
+                (time.perf_counter() - t0) / 20 * 1e3, 3)
     except Exception:
         pass
 

@@ -81,9 +81,12 @@ class TrainConfig:
     fobj: Callable | None = None
 
     def tree_params(self) -> TreeParams:
+        # rf: trees are averaged, never shrunk (LightGBM rf.hpp forces
+        # shrinkage_rate = 1; a shrunk average can't move the init score)
+        lr = 1.0 if self.boosting_type == "rf" else self.learning_rate
         return TreeParams(
             num_leaves=self.num_leaves, max_depth=self.max_depth,
-            max_bin=self.max_bin, learning_rate=self.learning_rate,
+            max_bin=self.max_bin, learning_rate=lr,
             lambda_l1=self.lambda_l1, lambda_l2=self.lambda_l2,
             min_data_in_leaf=self.min_data_in_leaf,
             min_sum_hessian_in_leaf=self.min_sum_hessian_in_leaf,
@@ -97,16 +100,6 @@ def _apply_delta(scores, delta, k_cls: int, K: int):
     if K == 1:
         return scores + delta
     return scores.at[:, k_cls].add(delta)
-
-
-def _select_class(scores, k_cls: int, K: int):
-    return scores if K == 1 else scores[:, k_cls]
-
-
-def _set_class(scores, value, k_cls: int, K: int):
-    if K == 1:
-        return value
-    return scores.at[:, k_cls].set(value)
 
 
 @dataclasses.dataclass
@@ -338,9 +331,6 @@ def train(x: np.ndarray, y: np.ndarray, w: np.ndarray | None,
     tree_vdeltas: list = []              # dart: cached per-tree valid deltas
     tree_weights: list[float] = []
 
-    def base_flat(k_cls: int):
-        b = np.asarray(base_score).reshape(-1)
-        return float(b[k_cls] if b.size > 1 else b[0])
     evals: list[dict] = []
     best_iter, best_metric, rounds_no_improve = -1, None, 0
     bag_mask = np.ones(n, np.float32)
@@ -409,15 +399,18 @@ def train(x: np.ndarray, y: np.ndarray, w: np.ndarray | None,
         gradients → (GOSS mask) → tree growth → train/valid deltas →
         score updates. Eager per-op dispatch between these pieces costs a
         device round-trip each — ruinous when the device is remote — so
-        the common path runs as a single dispatch per iteration. dart/rf
-        keep the stepwise path (their score updates are cross-iteration
-        and host-orchestrated)."""
+        gbdt/goss/rf run as a single dispatch per iteration. dart keeps
+        the stepwise path: its drop set is chosen host-side per
+        iteration and rescales standing tree contributions."""
         if grad_hess_override is not None:
             def gh_fn(s, y, w):
                 return grad_hess_override(s)
         else:
             gh_fn = obj.grad_hess
         arange_k = jnp.arange(K)
+        base_arr = np.asarray(base_score, np.float32).reshape(-1)
+        base_const = jnp.float32(base_arr[0]) if K == 1 \
+            else jnp.asarray(base_arr)
         goss_kw = dict(
             top_n=int(cfg.top_rate * n_real),
             other_n=int(cfg.other_rate * n_real),
@@ -436,7 +429,11 @@ def train(x: np.ndarray, y: np.ndarray, w: np.ndarray | None,
 
         @jax.jit
         def step(scores, vscores, feat_mask_dev, row_mask_dev, it_dev):
-            g, h = gh_fn(scores, y_dev, w_dev)
+            # rf: gradients always at the constant init score (trees are
+            # independent); gbdt/goss: at the running margin
+            sfg = (jnp.zeros_like(scores) + base_const) if is_rf \
+                else scores
+            g, h = gh_fn(sfg, y_dev, w_dev)
             if is_goss:
                 gmag = jnp.abs(g) if g.ndim == 1 \
                     else jnp.linalg.norm(g, axis=1)
@@ -453,21 +450,35 @@ def train(x: np.ndarray, y: np.ndarray, w: np.ndarray | None,
                 tree_b, row_leaf_b = grow_multi(g.T, h.T, feat_mask_dev,
                                                 rm)
             delta_b = tree_b.leaf_value[arange_k[:, None], row_leaf_b]
-            new_scores = scores + (delta_b[0] if K == 1 else delta_b.T)
+            d = delta_b[0] if K == 1 else delta_b.T
+            if is_rf:
+                # running average of tree outputs around the init score:
+                # scores = base + prev + (d - prev)/m with m = it + 1
+                m = (it_dev + 1).astype(jnp.float32)
+                new_scores = scores + (d - (scores - base_const)) / m
+            else:
+                new_scores = scores + d
             if valid is not None:
                 vdelta_b = routed_vdelta(tree_b)
-                new_vscores = vscores + (vdelta_b[0] if K == 1
-                                         else vdelta_b.T)
+                vd = vdelta_b[0] if K == 1 else vdelta_b.T
+                if is_rf:
+                    m = (it_dev + 1).astype(jnp.float32)
+                    new_vscores = vscores + (vd - (vscores
+                                                   - base_const)) / m
+                else:
+                    new_vscores = vscores + vd
             else:
                 new_vscores = vscores
             return new_scores, new_vscores, tree_b
         return step
 
-    use_fused = not is_dart and not is_rf
+    use_fused = not is_dart  # dart's drop set is host-chosen per iter
     fused_step = make_fused_step() if use_fused else None
     for it in range(cfg.num_iterations):
         if delegate is not None:
-            lr = delegate.get_learning_rate(it)
+            # rf averages unshrunk trees (tree_params forces lr=1); a
+            # delegate LR schedule must not silently re-shrink them
+            lr = None if is_rf else delegate.get_learning_rate(it)
             if lr is not None and lr != tp.learning_rate:
                 tp = tp._replace(learning_rate=float(lr))
                 grow, grow_multi = make_growers(tp)
@@ -508,8 +519,9 @@ def train(x: np.ndarray, y: np.ndarray, w: np.ndarray | None,
             # gradients + sampling + growth + deltas + score updates
             if is_goss:
                 row_in = valid_mask_dev
-            elif cfg.bagging_freq > 0 and cfg.bagging_fraction < 1.0:
-                if it % max(cfg.bagging_freq, 1) == 0:
+            elif (is_rf or cfg.bagging_freq > 0) \
+                    and cfg.bagging_fraction < 1.0:
+                if is_rf or it % max(cfg.bagging_freq, 1) == 0:
                     bag_mask = (bag_rng.random(n)
                                 < cfg.bagging_fraction).astype(np.float32)
                 row_in = jnp.asarray(bag_mask * valid_mask_np)
@@ -522,28 +534,16 @@ def train(x: np.ndarray, y: np.ndarray, w: np.ndarray | None,
                 tree_class.append(k_cls)
                 tree_weights.append(1.0)
         else:
-            # ---- stepwise path (dart/rf: cross-iteration score algebra)
-            # gradients
-            score_for_grad = (jnp.zeros_like(scores) + base_score) \
-                if is_rf else eff_scores
+            # ---- stepwise path: dart only (gbdt/goss/rf run fused).
+            # Gradients at the dropped-tree margin chosen host-side.
             if grad_hess_override is not None:
-                g, h = grad_hess_override(score_for_grad)
+                g, h = grad_hess_override(eff_scores)
             else:
-                g, h = obj.grad_hess(score_for_grad, y_dev, w_dev)
+                g, h = obj.grad_hess(eff_scores, y_dev, w_dev)
 
             # row sampling (padded rows always excluded: SPMD "ignore")
-            if is_goss:
-                gmag = jnp.abs(g) if g.ndim == 1 \
-                    else jnp.linalg.norm(g, axis=1)
-                row_mask_dev = _goss_mask(
-                    gmag, valid_mask_dev, jax.random.fold_in(goss_key, it),
-                    top_n=int(cfg.top_rate * n_real),
-                    other_n=int(cfg.other_rate * n_real),
-                    amplify=(1.0 - cfg.top_rate)
-                    / max(cfg.other_rate, 1e-12))
-            elif (is_rf or cfg.bagging_freq > 0) \
-                    and cfg.bagging_fraction < 1.0:
-                if is_rf or it % max(cfg.bagging_freq, 1) == 0:
+            if cfg.bagging_freq > 0 and cfg.bagging_fraction < 1.0:
+                if it % max(cfg.bagging_freq, 1) == 0:
                     bag_mask = (bag_rng.random(n)
                                 < cfg.bagging_fraction).astype(np.float32)
                 row_mask_dev = jnp.asarray(bag_mask) * valid_mask_dev
@@ -583,36 +583,16 @@ def train(x: np.ndarray, y: np.ndarray, w: np.ndarray | None,
             for k_cls in range(K):
                 delta = delta_b[k_cls]
                 tree_class.append(k_cls)
-                tree_weights.append(new_tree_weight if is_dart else 1.0)
+                tree_weights.append(new_tree_weight)
                 vdelta = None if vdelta_b is None else vdelta_b[k_cls]
-                if is_dart:
-                    tree_deltas.append(delta)
-                    tree_vdeltas.append(vdelta)
-
-                if is_rf:
-                    # running average of tree outputs per class
-                    m = it + 1
-                    prev = _select_class(scores, k_cls, K) \
-                        - base_flat(k_cls)
-                    scores = _set_class(
-                        scores,
-                        base_flat(k_cls) + prev + (delta - prev) / m,
-                        k_cls, K)
-                    if valid is not None:
-                        vprev = _select_class(vscores, k_cls, K) \
-                            - base_flat(k_cls)
-                        vscores = _set_class(
-                            vscores,
-                            base_flat(k_cls) + vprev
-                            + (vdelta - vprev) / m,
-                            k_cls, K)
-                else:
-                    scores = _apply_delta(scores, delta * new_tree_weight,
-                                          k_cls, K)
-                    if valid is not None:
-                        vscores = _apply_delta(vscores,
-                                               vdelta * new_tree_weight,
-                                               k_cls, K)
+                tree_deltas.append(delta)
+                tree_vdeltas.append(vdelta)
+                scores = _apply_delta(scores, delta * new_tree_weight,
+                                      k_cls, K)
+                if valid is not None:
+                    vscores = _apply_delta(vscores,
+                                           vdelta * new_tree_weight,
+                                           k_cls, K)
 
         if is_dart and dropped:
             # rescale dropped trees' standing contribution by k/(k+1)
