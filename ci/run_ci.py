@@ -32,7 +32,8 @@ PACKAGES: dict[str, list[str]] = {
            "test_transfer_learning.py", "test_checkpoint_profiling.py",
            "test_parallel.py", "test_pipeline_moe.py",
            "test_sharding_analysis.py"],
-    "serving": ["test_http_serving.py", "test_serving_distributed.py"],
+    "serving": ["test_http_serving.py", "test_serving_distributed.py",
+                "test_serving_native.py"],
     "cognitive": ["test_cognitive.py", "test_cognitive_speech.py",
                   "test_cognitive_breadth.py"],
     "learners": ["test_learners.py", "test_linear.py",

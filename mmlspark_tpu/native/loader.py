@@ -109,6 +109,35 @@ def get_vwhash():
     return _lazy_native("vwhash", ["vwhash.cpp"], configure)
 
 
+def get_httpfront():
+    """The native epoll HTTP serving front (httpfront.cpp), or None."""
+    def configure(lib):
+        i64 = ctypes.c_int64
+        u64 = ctypes.c_uint64
+        lib.hf_start.argtypes = [ctypes.c_char_p, ctypes.c_int,
+                                 ctypes.POINTER(ctypes.c_int)]
+        lib.hf_start.restype = i64
+        lib.hf_poll.argtypes = [i64, ctypes.POINTER(u64), i64,
+                                ctypes.c_int]
+        lib.hf_poll.restype = i64
+        lib.hf_req_info.argtypes = [i64, u64, ctypes.c_char_p, i64,
+                                    ctypes.c_char_p, i64,
+                                    ctypes.POINTER(i64),
+                                    ctypes.POINTER(i64)]
+        lib.hf_req_info.restype = ctypes.c_int
+        lib.hf_req_body.argtypes = [i64, u64, ctypes.c_char_p]
+        lib.hf_req_body.restype = i64
+        lib.hf_req_headers.argtypes = [i64, u64, ctypes.c_char_p]
+        lib.hf_req_headers.restype = i64
+        lib.hf_reply.argtypes = [i64, u64, ctypes.c_int, ctypes.c_char_p,
+                                 ctypes.c_char_p, i64]
+        lib.hf_reply.restype = ctypes.c_int
+        lib.hf_stop.argtypes = [i64]
+        lib.hf_stop.restype = None
+
+    return _lazy_native("httpfront", ["httpfront.cpp"], configure)
+
+
 def get_fastio():
     """The fastio library with argtypes configured, or None."""
     def configure(lib):

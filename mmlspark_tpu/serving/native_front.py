@@ -1,0 +1,175 @@
+"""Native serving front: the epoll HTTP server (httpfront.cpp) behind
+the same ServingServer interface.
+
+The Python front (``server.py``) spends a thread per connection and
+several GIL hand-offs per request — that is the serving p99. Here one
+C++ reactor thread owns all sockets; a single Python poller thread
+converts ready requests into :class:`CachedRequest`s on the shared
+queue, so :class:`ServingQuery`, replay, routing, and the distributed
+worker mesh all work unchanged. Replies go straight to the reactor via
+``hf_reply`` from whichever thread calls ``CachedRequest.reply``.
+
+Opt in with ``serving_query(..., backend="native")``; falls back to the
+Python front when the toolchain is unavailable.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import queue as _queue
+import threading
+import time
+from collections import deque
+
+from ..io.http.schema import HTTPRequestData, HTTPResponseData
+from ..native.loader import get_httpfront
+from .server import _SERVICES, CachedRequest, ServingServer
+
+_POLL_BATCH = 256
+
+
+class _NativeCachedRequest(CachedRequest):
+    """Replies by id straight into the C++ reactor (exactly once)."""
+
+    def __init__(self, id: str, request: HTTPRequestData, server,
+                 native_id: int):
+        super().__init__(id=id, request=request)
+        self._server = server
+        self._native_id = native_id
+
+    def reply(self, response: HTTPResponseData) -> bool:
+        if not super().reply(response):
+            return False
+        srv = self._server
+        body = response.entity or b""
+        ctype = response.headers.get("Content-Type",
+                                     "application/octet-stream") \
+            if response.headers else "application/octet-stream"
+        srv._lib.hf_reply(srv._handle, self._native_id,
+                          int(response.status_code or 500),
+                          ctype.encode(), body, len(body))
+        srv.history.pop(self.id, None)
+        return True
+
+
+class NativeServingServer(ServingServer):
+    """ServingServer whose HTTP front is the native epoll reactor."""
+
+    def __init__(self, name: str, host: str = "127.0.0.1", port: int = 0,
+                 api_path: str = "/", reply_timeout: float = 30.0,
+                 max_retries: int = 2, max_queue: int = 0):
+        lib = get_httpfront()
+        if lib is None:
+            raise RuntimeError(
+                "native http front unavailable (no toolchain or "
+                "MMLSPARK_TPU_DISABLE_NATIVE=1)")
+        self._lib = lib
+        out_port = ctypes.c_int(0)
+        handle = lib.hf_start(host.encode(), port,
+                              ctypes.byref(out_port))
+        if handle <= 0:
+            raise OSError(-handle, "hf_start failed")
+        self._handle = handle
+        # shared state, mirroring ServingServer.__init__ minus the
+        # Python httpd
+        self.name = name
+        self.api_path = api_path.rstrip("/") or "/"
+        self.reply_timeout = reply_timeout
+        self.max_retries = max_retries
+        self.queue = _queue.Queue(maxsize=max_queue or 0)
+        self.history = {}
+        self._lock = threading.Lock()
+        self._routes = {}
+        self.address = (host, out_port.value)
+        self._stop = threading.Event()
+        self._poller = threading.Thread(target=self._poll_loop,
+                                        daemon=True)
+        # (deadline, CachedRequest) for 504s, scanned by the poller
+        self._deadlines: deque[tuple[float, CachedRequest]] = deque()
+        _SERVICES[name] = self
+
+    # -- lifecycle ---------------------------------------------------------
+    def start(self):
+        self._poller.start()
+        return self
+
+    def stop(self):
+        self._stop.set()
+        self._poller.join(timeout=5)
+        self._lib.hf_stop(self._handle)
+        _SERVICES.pop(self.name, None)
+
+    # -- intake ------------------------------------------------------------
+    def _poll_loop(self):
+        lib, h = self._lib, self._handle
+        ids = (ctypes.c_uint64 * _POLL_BATCH)()
+        meth = ctypes.create_string_buffer(16)
+        path_buf = ctypes.create_string_buffer(4096)
+        blen = ctypes.c_int64(0)
+        hlen = ctypes.c_int64(0)
+        while not self._stop.is_set():
+            n = lib.hf_poll(h, ids, _POLL_BATCH, 50)
+            now = time.monotonic()
+            # expire overdue requests (replaces the per-request wait()
+            # timeout of the threaded front); also shed already-answered
+            # entries from the front so the deque tracks in-flight work,
+            # not reply_timeout's worth of history
+            while self._deadlines and (
+                    self._deadlines[0][0] <= now
+                    or self._deadlines[0][1]._event.is_set()):
+                _, cached = self._deadlines.popleft()
+                cached.reply(HTTPResponseData(
+                    status_code=504, reason="pipeline timeout"))
+            if len(self._deadlines) > 16384:
+                # out-of-order completions behind one slow request:
+                # compact answered entries wherever they sit
+                self._deadlines = deque(
+                    e for e in self._deadlines
+                    if not e[1]._event.is_set())
+            if n <= 0:
+                continue
+            for i in range(int(n)):
+                nid = ids[i]
+                if lib.hf_req_info(h, nid, meth, 16, path_buf, 4096,
+                                   ctypes.byref(blen),
+                                   ctypes.byref(hlen)) != 0:
+                    continue
+                body = b""
+                if blen.value:
+                    buf = ctypes.create_string_buffer(blen.value)
+                    lib.hf_req_body(h, nid, buf)
+                    body = buf.raw
+                headers: dict = {}
+                if hlen.value:
+                    hbuf = ctypes.create_string_buffer(hlen.value)
+                    lib.hf_req_headers(h, nid, hbuf)
+                    for line in hbuf.raw.decode(
+                            "latin-1").split("\r\n"):
+                        k, sep, v = line.partition(":")
+                        if sep:
+                            headers[k.strip()] = v.strip()
+                raw_path = path_buf.value.decode(errors="replace")
+                path = raw_path.split("?", 1)[0].rstrip("/") or "/"
+                route = self._routes.get(path)
+                if route is not None:
+                    status, out = route(body)
+                    lib.hf_reply(h, nid, status, b"", out, len(out))
+                    continue
+                if path != self.api_path:
+                    lib.hf_reply(h, nid, 404, b"", b"", 0)
+                    continue
+                req = HTTPRequestData(
+                    url=raw_path, method=meth.value.decode(),
+                    headers=headers, entity=body or None)
+                cached = _NativeCachedRequest(
+                    id=self._new_id(), request=req, server=self,
+                    native_id=nid)
+                with self._lock:
+                    self.history[cached.id] = cached
+                    self._deadlines.append(
+                        (now + self.reply_timeout, cached))
+                try:
+                    self.queue.put_nowait(cached)
+                except _queue.Full:
+                    cached.reply(HTTPResponseData(
+                        status_code=503, reason="queue full"))

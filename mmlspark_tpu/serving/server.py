@@ -302,8 +302,25 @@ class ServingQuery:
 
 
 def serving_query(name: str, transform_fn, host: str = "127.0.0.1",
-                  port: int = 0, reply_timeout: float = 30.0) -> ServingQuery:
-    """One-call setup: server + query, started."""
-    server = ServingServer(name, host=host, port=port,
-                           reply_timeout=reply_timeout).start()
+                  port: int = 0, reply_timeout: float = 30.0,
+                  backend: str = "python") -> ServingQuery:
+    """One-call setup: server + query, started.
+
+    ``backend``: ``"python"`` (threaded http.server front), ``"native"``
+    (C++ epoll reactor, ``native_front.py`` — lower tail latency), or
+    ``"auto"`` (native when the toolchain allows, else python).
+    """
+    cls = ServingServer
+    if backend in ("native", "auto"):
+        try:
+            from .native_front import NativeServingServer
+            from ..native.loader import get_httpfront
+            if get_httpfront() is None:
+                raise RuntimeError("native http front unavailable")
+            cls = NativeServingServer
+        except Exception:
+            if backend == "native":
+                raise
+    server = cls(name, host=host, port=port,
+                 reply_timeout=reply_timeout).start()
     return ServingQuery(server, transform_fn).start()

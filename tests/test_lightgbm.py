@@ -163,6 +163,26 @@ def test_rf_native_roundtrip():
     np.testing.assert_allclose(got, expected[:, 1], rtol=1e-4, atol=1e-5)
 
 
+def test_rf_trees_are_not_shrunk():
+    """LightGBM rf semantics (rf.hpp): averaged trees carry NO
+    learning-rate shrinkage. A shrunk average cannot move the init
+    log-odds, so predicted probabilities collapse toward the class
+    prior — which AUC-based checks cannot see (ranking is
+    scale-invariant). Guard the margin scale directly."""
+    df = classification_df(400)
+    y = np.asarray(df["label"])
+    model = LightGBMClassifier(boostingType="rf", baggingFraction=0.8,
+                               baggingFreq=1, learningRate=0.1,
+                               numIterations=20, numLeaves=15,
+                               minDataInLeaf=5).fit(df)
+    prob = np.asarray(model.transform(df)["probability"])[:, 1]
+    # separable-ish data: confident probabilities on both sides, and
+    # accuracy well above the class prior
+    assert prob.max() > 0.8 and prob.min() < 0.2, (prob.min(), prob.max())
+    acc = float(((prob > 0.5) == (y > 0)).mean())
+    assert acc > 0.9, acc
+
+
 def test_early_stopping_and_validation():
     df = classification_df(500)
     rng = np.random.default_rng(0)

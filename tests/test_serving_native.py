@@ -1,0 +1,154 @@
+"""Native epoll serving front (httpfront.cpp + native_front.py): the
+same contracts as the Python front — round trip, burst, 404 routing,
+keep-alive reuse, timeout 504 — driven over real sockets. Skipped
+when the toolchain is unavailable."""
+
+import http.client
+import json
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from mmlspark_tpu.io.http import string_to_response
+from mmlspark_tpu.native.loader import get_httpfront
+from mmlspark_tpu.serving import serving_query
+
+pytestmark = pytest.mark.skipif(
+    get_httpfront() is None, reason="native toolchain unavailable")
+
+
+def post(conn: http.client.HTTPConnection, path: str, payload: dict):
+    conn.request("POST", path, body=json.dumps(payload).encode())
+    resp = conn.getresponse()
+    body = resp.read()
+    return resp.status, body
+
+
+def doubler(df):
+    replies = np.empty(len(df), object)
+    for i, r in enumerate(df["request"]):
+        body = json.loads(r.entity)
+        replies[i] = string_to_response(
+            json.dumps({"double": body["x"] * 2}),
+            content_type="application/json")
+    return df.with_column("reply", replies)
+
+
+def test_native_round_trip_and_keepalive():
+    q = serving_query("native-doubler", doubler, backend="native")
+    host, port = q.server.address
+    try:
+        conn = http.client.HTTPConnection(host, port, timeout=5)
+        # several requests over ONE connection: keep-alive must hold
+        for i in range(5):
+            status, body = post(conn, "/", {"x": i})
+            assert status == 200
+            assert json.loads(body) == {"double": 2 * i}
+        conn.close()
+    finally:
+        q.stop()
+
+
+def test_native_burst_concurrent():
+    q = serving_query("native-burst", doubler, backend="native")
+    host, port = q.server.address
+    results = []
+    try:
+        def hit(i):
+            conn = http.client.HTTPConnection(host, port, timeout=10)
+            _, body = post(conn, "/", {"x": i})
+            results.append(json.loads(body)["double"])
+            conn.close()
+        threads = [threading.Thread(target=hit, args=(i,))
+                   for i in range(32)]
+        [t.start() for t in threads]
+        [t.join() for t in threads]
+        assert sorted(results) == [2 * i for i in range(32)]
+    finally:
+        q.stop()
+
+
+def test_native_unknown_path_404():
+    q = serving_query("native-pathy", doubler, backend="native")
+    q.server.api_path = "/api/score"
+    host, port = q.server.address
+    try:
+        conn = http.client.HTTPConnection(host, port, timeout=5)
+        status, _ = post(conn, "/other", {"x": 1})
+        assert status == 404
+        status, body = post(conn, "/api/score", {"x": 4})
+        assert status == 200 and json.loads(body) == {"double": 8}
+        conn.close()
+    finally:
+        q.stop()
+
+
+def test_native_timeout_504():
+    def stuck(df):
+        time.sleep(10)
+        return None
+
+    q = serving_query("native-stuck", stuck, backend="native",
+                      reply_timeout=0.3)
+    host, port = q.server.address
+    try:
+        conn = http.client.HTTPConnection(host, port, timeout=5)
+        t0 = time.monotonic()
+        status, _ = post(conn, "/", {"x": 1})
+        assert status == 504
+        assert time.monotonic() - t0 < 3
+        conn.close()
+    finally:
+        q.stop()
+
+
+def test_native_latency_sane():
+    """Tail latency guard: the whole point of the native front."""
+    q = serving_query("native-lat", doubler, backend="native")
+    host, port = q.server.address
+    try:
+        conn = http.client.HTTPConnection(host, port, timeout=5)
+        lat = []
+        for i in range(200):
+            t0 = time.perf_counter()
+            status, _ = post(conn, "/", {"x": i})
+            lat.append(time.perf_counter() - t0)
+            assert status == 200
+        conn.close()
+        lat = np.sort(np.asarray(lat[20:])) * 1e3
+        p50 = float(np.percentile(lat, 50))
+        p99 = float(np.percentile(lat, 99))
+        # generous CI bounds; the bench records the real numbers
+        assert p50 < 20, p50
+        assert p99 < 200, p99
+    finally:
+        q.stop()
+
+
+def test_native_headers_reach_pipeline():
+    seen = {}
+
+    def pipeline(df):
+        replies = np.empty(len(df), object)
+        for i, r in enumerate(df["request"]):
+            seen.update(r.headers)
+            replies[i] = string_to_response("ok")
+        return df.with_column("reply", replies)
+
+    q = serving_query("native-headers", pipeline, backend="native")
+    host, port = q.server.address
+    try:
+        conn = http.client.HTTPConnection(host, port, timeout=5)
+        conn.request("POST", "/", body=b"{}",
+                     headers={"X-Request-Id": "abc-123",
+                              "Content-Type": "application/json"})
+        resp = conn.getresponse()
+        resp.read()
+        assert resp.status == 200
+        conn.close()
+        assert seen.get("X-Request-Id") == "abc-123"
+        assert seen.get("Content-Type") == "application/json"
+    finally:
+        q.stop()
