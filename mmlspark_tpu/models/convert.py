@@ -161,6 +161,85 @@ def verify_checkpoint(variables: dict, manifest_path: str) -> None:
             "refusing corrupted/partial weights")
 
 
+_VIT_ARCHS = {
+    # name -> (width, depth)
+    "ViT_B_16": (768, 12),
+    "ViT_L_16": (1024, 24),
+}
+
+
+def torch_vit_to_flax(state_dict: dict, model_name: str) -> dict:
+    """torchvision ViT ``state_dict`` (``vit_b_16`` layout) → flax
+    variables ``{"params": ...}`` for ``models.vit``.
+
+    Mapping: ``conv_proj`` → patchify conv (OIHW→HWIO);
+    ``class_token``/``encoder.pos_embedding`` verbatim;
+    per block ``encoder.layers.encoder_layer_i``:
+    ``ln_1``/``ln_2`` → LayerNorm scale/bias, ``self_attention``'s fused
+    ``in_proj_weight`` [3W, W] splits into q/k/v Dense kernels
+    (transposed), ``out_proj`` → out Dense, ``mlp.0``/``mlp.3`` (or the
+    older ``mlp.linear_1``/``linear_2``) → mlp_1/mlp_2;
+    ``encoder.ln`` → final LayerNorm; ``heads.head`` → head Dense.
+    Raises on missing or leftover weights, like the ResNet path.
+    """
+    if model_name not in _VIT_ARCHS:
+        raise KeyError(f"no torchvision ViT mapping for {model_name!r}; "
+                       f"supported: {sorted(_VIT_ARCHS)}")
+    width, depth = _VIT_ARCHS[model_name]
+    sd = dict(state_dict)
+    params: dict = {}
+
+    def dense(torch_name: str):
+        return {"kernel": _np(sd.pop(torch_name + ".weight")).T,
+                "bias": _np(sd.pop(torch_name + ".bias"))}
+
+    def lnorm(torch_name: str):
+        return {"scale": _np(sd.pop(torch_name + ".weight")),
+                "bias": _np(sd.pop(torch_name + ".bias"))}
+
+    w = _np(sd.pop("conv_proj.weight"))
+    params["conv_proj"] = {"kernel": w.transpose(2, 3, 1, 0),
+                           "bias": _np(sd.pop("conv_proj.bias"))}
+    params["class_token"] = _np(sd.pop("class_token"))
+    params["pos_embedding"] = _np(sd.pop("encoder.pos_embedding"))
+
+    for i in range(depth):
+        t = f"encoder.layers.encoder_layer_{i}"
+        in_w = _np(sd.pop(t + ".self_attention.in_proj_weight"))
+        in_b = _np(sd.pop(t + ".self_attention.in_proj_bias"))
+        attn = {
+            "q": {"kernel": in_w[:width].T, "bias": in_b[:width]},
+            "k": {"kernel": in_w[width:2 * width].T,
+                  "bias": in_b[width:2 * width]},
+            "v": {"kernel": in_w[2 * width:].T, "bias": in_b[2 * width:]},
+            "out": dense(t + ".self_attention.out_proj"),
+        }
+        mlp1_key = t + ".mlp.0" if t + ".mlp.0.weight" in sd \
+            else t + ".mlp.linear_1"
+        mlp2_key = t + ".mlp.3" if t + ".mlp.3.weight" in sd \
+            else t + ".mlp.linear_2"
+        params[f"block{i}"] = {
+            "ln_1": lnorm(t + ".ln_1"), "attn": attn,
+            "ln_2": lnorm(t + ".ln_2"),
+            "mlp_1": dense(mlp1_key), "mlp_2": dense(mlp2_key),
+        }
+    params["ln"] = lnorm("encoder.ln")
+    params["head"] = dense("heads.head")
+    if sd:
+        leftover = sorted(sd)[:5]
+        raise ValueError(
+            f"{len(sd)} unconverted torch weights (first: {leftover}) — "
+            "state_dict does not match the expected torchvision layout")
+    return {"params": params}
+
+
+def torch_to_flax(state_dict: dict, model_name: str) -> dict:
+    """Dispatch to the family converter by zoo model name."""
+    if model_name in _VIT_ARCHS:
+        return torch_vit_to_flax(state_dict, model_name)
+    return torch_resnet_to_flax(state_dict, model_name)
+
+
 def convert_torch_checkpoint(src, model_name: str,
                              out_dir: str | None = None) -> str:
     """One-call conversion: torch ``.pt``/``.pth`` path (or a state_dict)
@@ -172,5 +251,5 @@ def convert_torch_checkpoint(src, model_name: str,
             else obj
     else:
         state_dict = src
-    variables = torch_resnet_to_flax(state_dict, model_name)
+    variables = torch_to_flax(state_dict, model_name)
     return save_converted(variables, model_name, out_dir)

@@ -1,0 +1,125 @@
+"""Vision Transformer (ViT-B/16 family) for the model zoo.
+
+Zoo member beside the ResNets (reference catalogue:
+``downloader/Schema.scala`` / ``ModelDownloader.scala`` — pretrained CNNs
+fed to ``ImageFeaturizer``). A transformer is the TPU-natural image
+backbone: everything is a large matmul on the MXU, no im2col, static
+token count. Layout and forward semantics follow torchvision's
+``vit_b_16`` (pre-LN blocks, cls token, learned position embeddings) so
+public checkpoints convert weight-for-weight (``models/convert.py``).
+
+Endpoints (the ``cutOutputLayers`` contract of ``ImageFeaturizer``):
+``block1..depth`` (token tensors), ``pooled`` (final-LN cls token — the
+transfer-learning feature), ``logits``.
+"""
+
+from __future__ import annotations
+
+from typing import Any
+
+import jax.numpy as jnp
+from flax import linen as nn
+
+
+class MHA(nn.Module):
+    """Multi-head self-attention with explicit q/k/v/out Dense params
+    (kernel [W, W] — torch ``in_proj_weight`` slices transpose straight
+    in). Softmax runs in f32 regardless of compute dtype."""
+    heads: int
+    dtype: Any = jnp.bfloat16
+
+    @nn.compact
+    def __call__(self, x):
+        N, T, W = x.shape
+        hd = W // self.heads
+        q = nn.Dense(W, dtype=self.dtype, name="q")(x)
+        k = nn.Dense(W, dtype=self.dtype, name="k")(x)
+        v = nn.Dense(W, dtype=self.dtype, name="v")(x)
+
+        def split(a):
+            return a.reshape(N, T, self.heads, hd).transpose(0, 2, 1, 3)
+
+        q, k, v = split(q), split(k), split(v)
+        logits = jnp.einsum("nhqd,nhkd->nhqk", q, k,
+                            preferred_element_type=jnp.float32)
+        attn = nn.softmax(logits / jnp.sqrt(hd).astype(jnp.float32),
+                          axis=-1).astype(self.dtype)
+        out = jnp.einsum("nhqk,nhkd->nhqd", attn, v)
+        out = out.transpose(0, 2, 1, 3).reshape(N, T, W)
+        return nn.Dense(W, dtype=self.dtype, name="out")(out)
+
+
+class Block(nn.Module):
+    """Pre-LN transformer block: x + MHA(LN(x)); x + MLP(LN(x))."""
+    heads: int
+    mlp_dim: int
+    dtype: Any = jnp.bfloat16
+
+    @nn.compact
+    def __call__(self, x):
+        W = x.shape[-1]
+        h = nn.LayerNorm(dtype=jnp.float32, name="ln_1")(x)
+        x = x + MHA(self.heads, dtype=self.dtype,
+                    name="attn")(h.astype(self.dtype))
+        h = nn.LayerNorm(dtype=jnp.float32, name="ln_2")(x)
+        h = nn.Dense(self.mlp_dim, dtype=self.dtype,
+                     name="mlp_1")(h.astype(self.dtype))
+        h = nn.gelu(h, approximate=False)
+        h = nn.Dense(W, dtype=self.dtype, name="mlp_2")(h)
+        return x + h
+
+
+class ViT(nn.Module):
+    """Returns ``{"block1"..f"block{depth}", "pooled", "logits"}``."""
+    patch: int = 16
+    width: int = 768
+    depth: int = 12
+    heads: int = 12
+    mlp_dim: int = 3072
+    num_classes: int = 1000
+    dtype: Any = jnp.bfloat16
+
+    @nn.compact
+    def __call__(self, x, train: bool = False):
+        endpoints = {}
+        N = x.shape[0]
+        x = x.astype(self.dtype)
+        # patchify = one strided conv (a matmul on the MXU)
+        x = nn.Conv(self.width, (self.patch, self.patch),
+                    (self.patch, self.patch), padding="VALID",
+                    dtype=self.dtype, name="conv_proj")(x)
+        x = x.reshape(N, -1, self.width)               # [N, T, W]
+        cls = self.param("class_token", nn.initializers.zeros,
+                         (1, 1, self.width), jnp.float32)
+        x = jnp.concatenate(
+            [jnp.broadcast_to(cls, (N, 1, self.width)).astype(self.dtype),
+             x], axis=1)
+        T = x.shape[1]
+        pos = self.param("pos_embedding",
+                         nn.initializers.normal(stddev=0.02),
+                         (1, T, self.width), jnp.float32)
+        x = x + pos.astype(self.dtype)
+        for i in range(self.depth):
+            x = Block(self.heads, self.mlp_dim, dtype=self.dtype,
+                      name=f"block{i}")(x)
+            endpoints[f"block{i + 1}"] = x
+        x = nn.LayerNorm(dtype=jnp.float32, name="ln")(x)
+        endpoints["pooled"] = x[:, 0].astype(jnp.float32)
+        logits = nn.Dense(self.num_classes, dtype=self.dtype,
+                          name="head")(x[:, 0].astype(self.dtype))
+        endpoints["logits"] = logits.astype(jnp.float32)
+        return endpoints
+
+    @property
+    def layer_names(self) -> list[str]:
+        return ([f"block{i + 1}" for i in range(self.depth)]
+                + ["pooled", "logits"])
+
+
+def ViT_B_16(num_classes=1000, dtype=jnp.bfloat16):
+    return ViT(num_classes=num_classes, dtype=dtype)
+
+
+def ViT_L_16(num_classes=1000, dtype=jnp.bfloat16):
+    return ViT(width=1024, depth=24, heads=16, mlp_dim=4096,
+               num_classes=num_classes, dtype=dtype)

@@ -59,6 +59,13 @@ def _register_builtins():
             name=name, num_layers=layers, builder=builder,
             layer_names=("stage1", "stage2", "stage3", "stage4",
                          "pooled", "logits")))
+    from .vit import ViT_B_16, ViT_L_16
+    for name, builder, depth in [("ViT_B_16", ViT_B_16, 12),
+                                 ("ViT_L_16", ViT_L_16, 24)]:
+        register_model(ModelSchema(
+            name=name, num_layers=depth, builder=builder,
+            layer_names=tuple(f"block{i + 1}" for i in range(depth))
+            + ("pooled", "logits")))
 
 
 _register_builtins()

@@ -15,7 +15,7 @@
 //   hf_req_info(h, id, meth, mcap, path, pcap, &body_len, &hdr_len)
 //   hf_req_body(h, id, buf)              -> body_len copied
 //   hf_req_headers(h, id, buf)           -> raw header bytes copied
-//   hf_reply(h, id, status, ctype, body, len) -> 0 (conn gone: drops)
+//   hf_reply(h, id, status, extra_hdr_lines, body, len) -> 0
 //   hf_stop(h)
 //
 // Requests are parsed HTTP/1.1 with keep-alive and pipelining; replies
@@ -41,6 +41,7 @@
 #include <cstring>
 #include <deque>
 #include <mutex>
+#include <memory>
 #include <string>
 #include <thread>
 #include <unordered_map>
@@ -70,6 +71,10 @@ struct Req {
 
 struct Server {
     int listen_fd = -1, epoll_fd = -1, event_fd = -1;
+    ~Server() {
+        if (event_fd >= 0) ::close(event_fd);
+        if (epoll_fd >= 0) ::close(epoll_fd);
+    }
     std::thread loop;
     std::atomic<bool> stop{false};
 
@@ -85,11 +90,14 @@ struct Server {
 };
 
 std::mutex g_mu;
-std::unordered_map<int64_t, Server*> g_servers;
+std::unordered_map<int64_t, std::shared_ptr<Server>> g_servers;
 int64_t g_next_handle = 1;
 
 constexpr size_t kMaxHeaderBytes = 64 * 1024;
-constexpr size_t kMaxBodyBytes = size_t(1) << 30;  // 1 GiB
+constexpr size_t kMaxBodyBytes = size_t(64) << 20;  // 64 MiB
+// hard per-connection buffer cap, enforced in the recv path regardless
+// of parse state — an in-flight request must not suspend flood control
+constexpr size_t kMaxConnBuffer = kMaxBodyBytes + 2 * kMaxHeaderBytes;
 
 bool parse_one(Conn& c, Server& s) {
     // returns true if a complete request was consumed from c.in
@@ -288,6 +296,10 @@ void reactor(Server* s) {
                     ssize_t r = ::recv(fd, buf, sizeof buf, 0);
                     if (r > 0) {
                         c.in.append(buf, (size_t)r);
+                        if (c.in.size() > kMaxConnBuffer) {
+                            close_conn(*s, fd);
+                            break;
+                        }
                     } else if (r == 0) {  // peer closed
                         close_conn(*s, fd);
                         break;
@@ -308,7 +320,10 @@ void reactor(Server* s) {
     }
 }
 
-Server* get(int64_t h) {
+std::shared_ptr<Server> get(int64_t h) {
+    // shared_ptr: a caller mid-hf_reply keeps the Server alive across a
+    // concurrent hf_stop (stop closes sockets; memory lives until the
+    // last caller returns)
     std::lock_guard<std::mutex> lk(g_mu);
     auto it = g_servers.find(h);
     return it == g_servers.end() ? nullptr : it->second;
@@ -340,7 +355,8 @@ int64_t hf_start(const char* host, int port, int* out_port) {
     getsockname(fd, (sockaddr*)&addr, &alen);
     if (out_port) *out_port = (int)ntohs(addr.sin_port);
 
-    auto* s = new Server();
+    auto sp = std::make_shared<Server>();
+    Server* s = sp.get();
     s->listen_fd = fd;
     s->epoll_fd = epoll_create1(0);
     s->event_fd = eventfd(0, EFD_NONBLOCK);
@@ -354,12 +370,12 @@ int64_t hf_start(const char* host, int port, int* out_port) {
 
     std::lock_guard<std::mutex> lk(g_mu);
     int64_t h = g_next_handle++;
-    g_servers[h] = s;
+    g_servers[h] = sp;
     return h;
 }
 
 int64_t hf_poll(int64_t h, uint64_t* ids, int64_t max_n, int timeout_ms) {
-    Server* s = get(h);
+    auto s = get(h);
     if (!s) return -1;
     std::unique_lock<std::mutex> lk(s->mu);
     if (s->ready.empty())
@@ -376,7 +392,7 @@ int64_t hf_poll(int64_t h, uint64_t* ids, int64_t max_n, int timeout_ms) {
 int hf_req_info(int64_t h, uint64_t id, char* method, int64_t mcap,
                 char* path, int64_t pcap, int64_t* body_len,
                 int64_t* headers_len) {
-    Server* s = get(h);
+    auto s = get(h);
     if (!s) return -1;
     std::lock_guard<std::mutex> lk(s->mu);
     auto it = s->reqs.find(id);
@@ -389,7 +405,7 @@ int hf_req_info(int64_t h, uint64_t id, char* method, int64_t mcap,
 }
 
 int64_t hf_req_headers(int64_t h, uint64_t id, char* buf) {
-    Server* s = get(h);
+    auto s = get(h);
     if (!s) return -1;
     std::lock_guard<std::mutex> lk(s->mu);
     auto it = s->reqs.find(id);
@@ -400,7 +416,7 @@ int64_t hf_req_headers(int64_t h, uint64_t id, char* buf) {
 }
 
 int64_t hf_req_body(int64_t h, uint64_t id, char* buf) {
-    Server* s = get(h);
+    auto s = get(h);
     if (!s) return -1;
     std::lock_guard<std::mutex> lk(s->mu);
     auto it = s->reqs.find(id);
@@ -409,9 +425,11 @@ int64_t hf_req_body(int64_t h, uint64_t id, char* buf) {
     return (int64_t)it->second.body.size();
 }
 
-int hf_reply(int64_t h, uint64_t id, int status, const char* ctype,
+int hf_reply(int64_t h, uint64_t id, int status, const char* extra_hdrs,
              const char* body, int64_t len) {
-    Server* s = get(h);
+    // extra_hdrs: zero or more pre-formatted "Key: Value\r\n" lines
+    // (the pipeline's response headers, minus the reserved ones below)
+    auto s = get(h);
     if (!s) return -1;
     std::string resp;
     {
@@ -419,15 +437,15 @@ int hf_reply(int64_t h, uint64_t id, int status, const char* ctype,
         auto it = s->reqs.find(id);
         if (it == s->reqs.end()) return -1;  // already answered / gone
         bool ka = it->second.keepalive;
-        char hdr[256];
-        int hl = snprintf(
-            hdr, sizeof hdr,
-            "HTTP/1.1 %d %s\r\nContent-Type: %s\r\n"
-            "Content-Length: %lld\r\nConnection: %s\r\n\r\n",
-            status, status < 400 ? "OK" : "Error",
-            (ctype && *ctype) ? ctype : "application/octet-stream",
-            (long long)len, ka ? "keep-alive" : "close");
+        char hdr[128];
+        int hl = snprintf(hdr, sizeof hdr, "HTTP/1.1 %d %s\r\n",
+                          status, status < 400 ? "OK" : "Error");
         resp.assign(hdr, (size_t)hl);
+        if (extra_hdrs && *extra_hdrs) resp += extra_hdrs;
+        hl = snprintf(hdr, sizeof hdr,
+                      "Content-Length: %lld\r\nConnection: %s\r\n\r\n",
+                      (long long)len, ka ? "keep-alive" : "close");
+        resp.append(hdr, (size_t)hl);
         resp.append(body, (size_t)len);
         s->replies.emplace_back(id, std::move(resp));
     }
@@ -438,7 +456,7 @@ int hf_reply(int64_t h, uint64_t id, int status, const char* ctype,
 }
 
 void hf_stop(int64_t h) {
-    Server* s = nullptr;
+    std::shared_ptr<Server> s;
     {
         std::lock_guard<std::mutex> lk(g_mu);
         auto it = g_servers.find(h);
@@ -453,9 +471,9 @@ void hf_stop(int64_t h) {
     s->loop.join();
     for (auto& kv : s->conns) ::close(kv.first);
     ::close(s->listen_fd);
-    ::close(s->event_fd);
-    ::close(s->epoll_fd);
-    delete s;
+    // epoll_fd / event_fd close in ~Server when the last concurrent
+    // hf_reply/hf_poll holding a shared_ptr returns — a racing write to
+    // event_fd must hit the (dead) eventfd, never a reused fd number
 }
 
 }  // extern "C"

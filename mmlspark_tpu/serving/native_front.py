@@ -16,14 +16,19 @@ Python front when the toolchain is unavailable.
 from __future__ import annotations
 
 import ctypes
-import queue as _queue
+import logging
 import threading
 import time
+import traceback
 from collections import deque
+
+import queue as _queue
 
 from ..io.http.schema import HTTPRequestData, HTTPResponseData
 from ..native.loader import get_httpfront
 from .server import _SERVICES, CachedRequest, ServingServer
+
+_LOG = logging.getLogger("mmlspark_tpu.serving")
 
 _POLL_BATCH = 256
 
@@ -42,12 +47,16 @@ class _NativeCachedRequest(CachedRequest):
             return False
         srv = self._server
         body = response.entity or b""
-        ctype = response.headers.get("Content-Type",
-                                     "application/octet-stream") \
-            if response.headers else "application/octet-stream"
+        # every pipeline-set header rides through (Content-Length and
+        # Connection are owned by the reactor)
+        hdrs = dict(response.headers or {})
+        hdrs.setdefault("Content-Type", "application/octet-stream")
+        blob = "".join(
+            f"{k}: {v}\r\n" for k, v in hdrs.items()
+            if k.lower() not in ("content-length", "connection"))
         srv._lib.hf_reply(srv._handle, self._native_id,
                           int(response.status_code or 500),
-                          ctype.encode(), body, len(body))
+                          blob.encode("latin-1"), body, len(body))
         srv.history.pop(self.id, None)
         return True
 
@@ -70,16 +79,8 @@ class NativeServingServer(ServingServer):
         if handle <= 0:
             raise OSError(-handle, "hf_start failed")
         self._handle = handle
-        # shared state, mirroring ServingServer.__init__ minus the
-        # Python httpd
-        self.name = name
-        self.api_path = api_path.rstrip("/") or "/"
-        self.reply_timeout = reply_timeout
-        self.max_retries = max_retries
-        self.queue = _queue.Queue(maxsize=max_queue or 0)
-        self.history = {}
-        self._lock = threading.Lock()
-        self._routes = {}
+        self._init_shared_state(name, api_path, reply_timeout,
+                                max_retries, max_queue)
         self.address = (host, out_port.value)
         self._stop = threading.Event()
         self._poller = threading.Thread(target=self._poll_loop,
@@ -108,68 +109,83 @@ class NativeServingServer(ServingServer):
         blen = ctypes.c_int64(0)
         hlen = ctypes.c_int64(0)
         while not self._stop.is_set():
-            n = lib.hf_poll(h, ids, _POLL_BATCH, 50)
-            now = time.monotonic()
-            # expire overdue requests (replaces the per-request wait()
-            # timeout of the threaded front); also shed already-answered
-            # entries from the front so the deque tracks in-flight work,
-            # not reply_timeout's worth of history
-            while self._deadlines and (
-                    self._deadlines[0][0] <= now
-                    or self._deadlines[0][1]._event.is_set()):
-                _, cached = self._deadlines.popleft()
-                cached.reply(HTTPResponseData(
-                    status_code=504, reason="pipeline timeout"))
-            if len(self._deadlines) > 16384:
-                # out-of-order completions behind one slow request:
-                # compact answered entries wherever they sit
-                self._deadlines = deque(
-                    e for e in self._deadlines
-                    if not e[1]._event.is_set())
-            if n <= 0:
-                continue
-            for i in range(int(n)):
-                nid = ids[i]
-                if lib.hf_req_info(h, nid, meth, 16, path_buf, 4096,
-                                   ctypes.byref(blen),
-                                   ctypes.byref(hlen)) != 0:
-                    continue
-                body = b""
-                if blen.value:
-                    buf = ctypes.create_string_buffer(blen.value)
-                    lib.hf_req_body(h, nid, buf)
-                    body = buf.raw
-                headers: dict = {}
-                if hlen.value:
-                    hbuf = ctypes.create_string_buffer(hlen.value)
-                    lib.hf_req_headers(h, nid, hbuf)
-                    for line in hbuf.raw.decode(
-                            "latin-1").split("\r\n"):
-                        k, sep, v = line.partition(":")
-                        if sep:
-                            headers[k.strip()] = v.strip()
-                raw_path = path_buf.value.decode(errors="replace")
-                path = raw_path.split("?", 1)[0].rstrip("/") or "/"
-                route = self._routes.get(path)
-                if route is not None:
-                    status, out = route(body)
-                    lib.hf_reply(h, nid, status, b"", out, len(out))
-                    continue
-                if path != self.api_path:
-                    lib.hf_reply(h, nid, 404, b"", b"", 0)
-                    continue
-                req = HTTPRequestData(
-                    url=raw_path, method=meth.value.decode(),
-                    headers=headers, entity=body or None)
-                cached = _NativeCachedRequest(
-                    id=self._new_id(), request=req, server=self,
-                    native_id=nid)
-                with self._lock:
-                    self.history[cached.id] = cached
-                    self._deadlines.append(
-                        (now + self.reply_timeout, cached))
-                try:
-                    self.queue.put_nowait(cached)
-                except _queue.Full:
-                    cached.reply(HTTPResponseData(
-                        status_code=503, reason="queue full"))
+            try:
+                self._poll_once(lib, h, ids, meth, path_buf, blen, hlen)
+            except Exception:
+                # one bad request (or route handler) must not kill the
+                # single poller — that would brick the whole server,
+                # where the threaded front loses only one connection
+                _LOG.warning("native poll loop error: %s",
+                             traceback.format_exc())
+
+    def _poll_once(self, lib, h, ids, meth, path_buf, blen, hlen):
+        n = lib.hf_poll(h, ids, _POLL_BATCH, 50)
+        now = time.monotonic()
+        # expire overdue requests (replaces the per-request wait()
+        # timeout of the threaded front); also shed already-answered
+        # entries from the front so the deque tracks in-flight work,
+        # not reply_timeout's worth of history
+        while self._deadlines and (
+                self._deadlines[0][0] <= now
+                or self._deadlines[0][1]._event.is_set()):
+            _, cached = self._deadlines.popleft()
+            cached.reply(HTTPResponseData(
+                status_code=504, reason="pipeline timeout"))
+        if len(self._deadlines) > 16384:
+            # out-of-order completions behind one slow request:
+            # compact answered entries wherever they sit
+            self._deadlines = deque(
+                e for e in self._deadlines
+                if not e[1]._event.is_set())
+        for i in range(max(int(n), 0)):
+            try:
+                self._handle_request(lib, h, ids[i], meth, path_buf,
+                                     blen, hlen, now)
+            except Exception:
+                # contain failures per request (the threaded front loses
+                # one connection; we answer 500 and keep polling)
+                _LOG.warning("native request handling failed: %s",
+                             traceback.format_exc())
+                lib.hf_reply(h, ids[i], 500, b"", b"", 0)
+
+    def _handle_request(self, lib, h, nid, meth, path_buf, blen, hlen,
+                        now):
+        if lib.hf_req_info(h, nid, meth, 16, path_buf, 4096,
+                           ctypes.byref(blen), ctypes.byref(hlen)) != 0:
+            return
+        body = b""
+        if blen.value:
+            buf = ctypes.create_string_buffer(blen.value)
+            lib.hf_req_body(h, nid, buf)
+            body = buf.raw
+        headers: dict = {}
+        if hlen.value:
+            hbuf = ctypes.create_string_buffer(hlen.value)
+            lib.hf_req_headers(h, nid, hbuf)
+            for line in hbuf.raw.decode("latin-1").split("\r\n"):
+                k, sep, v = line.partition(":")
+                if sep:
+                    headers[k.strip()] = v.strip()
+        raw_path = path_buf.value.decode(errors="replace")
+        path = raw_path.split("?", 1)[0].rstrip("/") or "/"
+        route = self._routes.get(path)
+        if route is not None:
+            status, out = route(body)
+            lib.hf_reply(h, nid, status, b"", out, len(out))
+            return
+        if path != self.api_path:
+            lib.hf_reply(h, nid, 404, b"", b"", 0)
+            return
+        req = HTTPRequestData(
+            url=raw_path, method=meth.value.decode(), headers=headers,
+            entity=body or None)
+        cached = _NativeCachedRequest(
+            id=self._new_id(), request=req, server=self, native_id=nid)
+        with self._lock:
+            self.history[cached.id] = cached
+            self._deadlines.append((now + self.reply_timeout, cached))
+        try:
+            self.queue.put_nowait(cached)
+        except _queue.Full:
+            cached.reply(HTTPResponseData(
+                status_code=503, reason="queue full"))

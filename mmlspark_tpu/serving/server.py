@@ -97,9 +97,13 @@ class CachedRequest:
 class ServingServer:
     """HTTP server + request queue for one named service."""
 
-    def __init__(self, name: str, host: str = "127.0.0.1", port: int = 0,
-                 api_path: str = "/", reply_timeout: float = 30.0,
-                 max_retries: int = 2, max_queue: int = 0):
+    def _init_shared_state(self, name: str, api_path: str,
+                           reply_timeout: float, max_retries: int,
+                           max_queue: int) -> None:
+        """State shared by every front (threaded Python and native epoll —
+        ``native_front.NativeServingServer`` calls this too, so the two
+        cannot drift): the queue, replay bookkeeping, and route table
+        that ``next_batch``/``replay``/``_new_id`` operate on."""
         self.name = name
         self.api_path = api_path.rstrip("/") or "/"
         self.reply_timeout = reply_timeout
@@ -113,6 +117,12 @@ class ServingServer:
         # internal sub-path handlers (distributed mode registers
         # __reply__/__lease__ here): path -> fn(body) -> (status, bytes)
         self._routes: dict[str, callable] = {}
+
+    def __init__(self, name: str, host: str = "127.0.0.1", port: int = 0,
+                 api_path: str = "/", reply_timeout: float = 30.0,
+                 max_retries: int = 2, max_queue: int = 0):
+        self._init_shared_state(name, api_path, reply_timeout,
+                                max_retries, max_queue)
 
         serving = self
 

@@ -202,3 +202,103 @@ class TestVerifiedDownload:
         variables = torch_resnet_to_flax(t.state_dict(), "ResNet18")
         verify_checkpoint(variables,
                           os.path.join(d, "ResNet18.manifest.json"))
+
+
+# ---- torch ViT in EXACT torchvision vit_b_16 naming (the oracle) ----
+class TorchViTBlock(tnn.Module):
+    def __init__(self, w, heads, mlp):
+        super().__init__()
+        self.ln_1 = tnn.LayerNorm(w, eps=1e-6)
+        self.self_attention = tnn.MultiheadAttention(w, heads,
+                                                     batch_first=True)
+        self.ln_2 = tnn.LayerNorm(w, eps=1e-6)
+        self.mlp = tnn.Sequential(
+            tnn.Linear(w, mlp), tnn.GELU(), tnn.Dropout(0.0),
+            tnn.Linear(mlp, w), tnn.Dropout(0.0))
+
+    def forward(self, x):
+        h = self.ln_1(x)
+        h, _ = self.self_attention(h, h, h, need_weights=False)
+        x = x + h
+        return x + self.mlp(self.ln_2(x))
+
+
+class TorchViTEncoder(tnn.Module):
+    def __init__(self, w, depth, heads, mlp, tokens):
+        super().__init__()
+        import torch as _t
+        from collections import OrderedDict
+        self.pos_embedding = tnn.Parameter(
+            _t.empty(1, tokens, w).normal_(std=0.02))
+        self.layers = tnn.Sequential(OrderedDict(
+            (f"encoder_layer_{i}", TorchViTBlock(w, heads, mlp))
+            for i in range(depth)))
+        self.ln = tnn.LayerNorm(w, eps=1e-6)
+
+    def forward(self, x):
+        return self.ln(self.layers(x + self.pos_embedding))
+
+
+class TorchViT(tnn.Module):
+    def __init__(self, w=32, depth=2, heads=4, mlp=64, patch=8,
+                 image=16, classes=7):
+        super().__init__()
+        import torch as _t
+        from collections import OrderedDict
+        self.patch = patch
+        self.conv_proj = tnn.Conv2d(3, w, patch, patch)
+        self.class_token = tnn.Parameter(_t.zeros(1, 1, w).normal_())
+        tokens = (image // patch) ** 2 + 1
+        self.encoder = TorchViTEncoder(w, depth, heads, mlp, tokens)
+        self.heads = tnn.Sequential(OrderedDict(
+            [("head", tnn.Linear(w, classes))]))
+
+    def forward(self, x):
+        n = x.shape[0]
+        x = self.conv_proj(x)                      # [N, W, h, w]
+        x = x.reshape(n, x.shape[1], -1).permute(0, 2, 1)
+        cls = self.class_token.expand(n, -1, -1)
+        x = self.encoder(torch.cat([cls, x], dim=1))
+        return self.heads(x[:, 0])
+
+
+def test_vit_conversion_matches_torch():
+    from mmlspark_tpu.models.convert import torch_vit_to_flax, _VIT_ARCHS
+    from mmlspark_tpu.models.vit import ViT
+
+    torch.manual_seed(0)
+    tm = TorchViT().eval()
+    _VIT_ARCHS["_tiny"] = (32, 2)
+    try:
+        variables = torch_vit_to_flax(tm.state_dict(), "_tiny")
+    finally:
+        del _VIT_ARCHS["_tiny"]
+
+    fm = ViT(patch=8, width=32, depth=2, heads=4, mlp_dim=64,
+             num_classes=7, dtype=jnp.float32)
+    x = np.random.default_rng(0).normal(size=(2, 16, 16, 3)) \
+        .astype(np.float32)
+    with torch.no_grad():
+        want = tm(torch.from_numpy(x.transpose(0, 3, 1, 2))).numpy()
+    got = fm.apply(variables, jnp.asarray(x), False)
+    np.testing.assert_allclose(np.asarray(got["logits"]), want,
+                               rtol=1e-4, atol=1e-4)
+    assert got["pooled"].shape == (2, 32)
+    assert got["block2"].shape == (2, 5, 32)
+
+
+def test_vit_zoo_and_featurizer():
+    from mmlspark_tpu.core import DataFrame
+    from mmlspark_tpu.image import ImageFeaturizer
+
+    imgs = np.empty(3, object)
+    rng = np.random.default_rng(1)
+    for i in range(3):
+        imgs[i] = rng.integers(0, 255, size=(30, 40, 3)).astype(np.uint8)
+    df = DataFrame({"image": imgs})
+    out = ImageFeaturizer(modelName="ViT_B_16", cutOutputLayers=1,
+                          inputCol="image", outputCol="features",
+                          miniBatchSize=2).transform(df)
+    feats = np.stack(list(out["features"]))
+    assert feats.shape == (3, 768)
+    assert np.isfinite(feats).all()
