@@ -28,7 +28,7 @@ PACKAGES: dict[str, list[str]] = {
     "lightgbm2": ["test_lightgbm_sparse.py", "test_lightgbm_distributed.py",
                   "test_lightgbm_format_fixture.py"],
     "vw": ["test_vw.py"],
-    "dl": ["test_image_dl.py", "test_convert.py",
+    "dl": ["test_text_encoder.py", "test_image_dl.py", "test_convert.py",
            "test_transfer_learning.py", "test_checkpoint_profiling.py",
            "test_parallel.py", "test_pipeline_moe.py",
            "test_sharding_analysis.py"],

@@ -9,6 +9,10 @@ by ``jax.sharding`` over the mesh.
 """
 
 from .model import TPUModel
+from .text_encoder import (TextEncoder, TextEncoderFeaturizer,
+                           make_attention_fn)
 from .train import TrainState, make_train_step, shard_train_state
 
-__all__ = ["TPUModel", "TrainState", "make_train_step", "shard_train_state"]
+__all__ = ["TPUModel", "TrainState", "make_train_step",
+           "shard_train_state", "TextEncoder", "TextEncoderFeaturizer",
+           "make_attention_fn"]

@@ -1,0 +1,231 @@
+"""Long-context transformer text encoder — the user-facing surface of
+the sequence-parallel machinery.
+
+The reference has no attention models (SURVEY §5: long-context is
+"absent in the reference"); this is the first-class TPU-native extension
+the framework owes its DL path. A compact pre-LN transformer encoder
+whose attention implementation is pluggable:
+
+- ``dense``    — standard softmax attention (short inputs);
+- ``blockwise``— single-device flash-style blocks, O(T) memory;
+- ``ring``     — exact attention with Q/K/V sequence-sharded over an
+  ``sp`` mesh axis, K/V rotating via ``ppermute``
+  (``parallel/ring_attention.py``);
+- ``ulysses``  — all-to-all head/sequence reshard
+  (``parallel/ulysses.py``).
+
+``TextEncoderFeaturizer`` wraps it as a pipeline stage: token-id rows →
+mean-pooled embeddings, the text counterpart of ``ImageFeaturizer``.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from flax import linen as nn
+
+from ..core.contracts import HasInputCol, HasOutputCol
+from ..core.logging import BasicLogging
+from ..core.param import Param, TypeConverters as TC
+from ..core.pipeline import Transformer
+
+
+def _dense_attention(q, k, v, key_mask=None):
+    D = q.shape[-1]
+    s = jnp.einsum("bhqd,bhkd->bhqk", q, k,
+                   preferred_element_type=jnp.float32) * (D ** -0.5)
+    if key_mask is not None:
+        s = s + jnp.where(key_mask, 0.0, -jnp.inf)[:, None, None, :]
+    p = jax.nn.softmax(s, axis=-1).astype(v.dtype)
+    return jnp.einsum("bhqk,bhkd->bhqd", p, v)
+
+
+class EncoderBlock(nn.Module):
+    """Pre-LN block over an externally supplied attention fn
+    (``fn(q, k, v, key_mask)``, [B,H,T,D]³ → [B,H,T,D]) — the block is
+    agnostic to whether the sequence axis is sharded. ``key_mask``
+    excludes padding keys from every softmax, so a row's output never
+    depends on how far the batch was padded."""
+    heads: int
+    mlp_dim: int
+    attention_fn: Callable = _dense_attention
+    dtype: Any = jnp.bfloat16
+
+    @nn.compact
+    def __call__(self, x, key_mask=None):
+        W = x.shape[-1]
+        hd = W // self.heads
+        h = nn.LayerNorm(dtype=jnp.float32, name="ln_1")(x)
+        h = h.astype(self.dtype)
+        qkv = nn.Dense(3 * W, dtype=self.dtype, name="qkv")(h)
+        q, k, v = jnp.split(qkv, 3, axis=-1)
+
+        def split(a):
+            B, T = a.shape[:2]
+            return a.reshape(B, T, self.heads, hd).transpose(0, 2, 1, 3)
+
+        o = self.attention_fn(split(q), split(k), split(v), key_mask)
+        B, H, T, D = o.shape
+        o = o.transpose(0, 2, 1, 3).reshape(B, T, W).astype(self.dtype)
+        x = x + nn.Dense(W, dtype=self.dtype, name="out")(o)
+        h = nn.LayerNorm(dtype=jnp.float32, name="ln_2")(x)
+        h = nn.Dense(self.mlp_dim, dtype=self.dtype,
+                     name="mlp_1")(h.astype(self.dtype))
+        h = nn.gelu(h)
+        return x + nn.Dense(W, dtype=self.dtype, name="mlp_2")(h)
+
+
+class TextEncoder(nn.Module):
+    """Token ids [N, T] → ``{"tokens": [N, T, W], "pooled": [N, W]}``.
+
+    ``pooled`` is the masked mean over non-pad tokens (pad id 0) — the
+    transfer-learning feature vector."""
+    vocab: int = 32768
+    width: int = 256
+    depth: int = 4
+    heads: int = 8
+    mlp_dim: int = 1024
+    max_len: int = 65536
+    attention_fn: Callable = _dense_attention
+    dtype: Any = jnp.bfloat16
+
+    @nn.compact
+    def __call__(self, ids, train: bool = False):
+        N, T = ids.shape
+        x = nn.Embed(self.vocab, self.width, dtype=self.dtype,
+                     name="embed")(ids)
+        # fixed sinusoidal positions: length-extrapolable, nothing to
+        # shard or convert
+        pos = jnp.arange(T)[:, None]
+        dim = jnp.arange(self.width // 2)[None, :]
+        ang = pos / (10000.0 ** (2 * dim / self.width))
+        pe = jnp.concatenate([jnp.sin(ang), jnp.cos(ang)], axis=-1)
+        x = x + pe[None].astype(self.dtype)
+        key_mask = ids != 0
+        for i in range(self.depth):
+            x = EncoderBlock(self.heads, self.mlp_dim,
+                             attention_fn=self.attention_fn,
+                             dtype=self.dtype,
+                             name=f"block{i}")(x, key_mask)
+        x = nn.LayerNorm(dtype=jnp.float32, name="ln")(x)
+        mask = (ids != 0).astype(jnp.float32)[..., None]
+        pooled = (x * mask).sum(1) / jnp.maximum(mask.sum(1), 1.0)
+        return {"tokens": x, "pooled": pooled.astype(jnp.float32)}
+
+
+def make_attention_fn(impl: str = "dense", mesh=None, axis: str = "sp",
+                      block_size: int = 512) -> Callable:
+    """Resolve an attention implementation by name.
+
+    ``ring``/``ulysses`` need a mesh whose ``axis`` shards the sequence;
+    the returned fn expects its [B, H, T, D] inputs sharded accordingly
+    (shard with ``NamedSharding(mesh, P(None, None, axis, None))``)."""
+    if impl == "dense":
+        return _dense_attention
+    if impl == "blockwise":
+        from ..parallel.ring_attention import blockwise_attention
+        return lambda q, k, v, m=None: blockwise_attention(
+            q, k, v, block_size=block_size, key_mask=m)
+    if impl == "ring":
+        from ..parallel.ring_attention import make_ring_attention
+        if mesh is None:
+            raise ValueError("ring attention needs a mesh")
+        return make_ring_attention(mesh, causal=False, axis=axis)
+    if impl == "ulysses":
+        from ..parallel.ulysses import make_ulysses_attention
+        if mesh is None:
+            raise ValueError("ulysses attention needs a mesh")
+        return make_ulysses_attention(mesh, axis=axis)
+    raise ValueError(f"unknown attention impl {impl!r}; expected "
+                     "dense|blockwise|ring|ulysses")
+
+
+class TextEncoderFeaturizer(Transformer, HasInputCol, HasOutputCol,
+                            BasicLogging):
+    """Pipeline stage: tokenized text → pooled transformer embeddings.
+
+    The text counterpart of ``ImageFeaturizer`` (reference
+    ``image/ImageFeaturizer.scala:40-197`` — there is no reference text
+    transformer; SURVEY §5 marks this the framework's long-context
+    extension). Rows are token-id sequences; they are padded to the
+    batch max (pad id 0 is masked out of the mean-pool). For sequences
+    beyond one device's memory, pass ``attentionImpl="ring"`` (or
+    ``"ulysses"``) and a mesh.
+    """
+
+    attentionImpl = Param("attentionImpl",
+                          "dense|blockwise|ring|ulysses", TC.toString,
+                          default="dense", has_default=True)
+    seqChunk = Param("seqChunk", "pad sequence length to a multiple of "
+                     "this (ring/ulysses need the sp-axis size to "
+                     "divide T)", TC.toInt, default=128, has_default=True)
+    vocabSize = Param("vocabSize", "embedding vocabulary", TC.toInt,
+                      default=32768, has_default=True)
+    width = Param("width", "model width", TC.toInt, default=256,
+                  has_default=True)
+    depth = Param("depth", "encoder blocks", TC.toInt, default=4,
+                  has_default=True)
+    heads = Param("heads", "attention heads (must divide width)",
+                  TC.toInt, default=8, has_default=True)
+    seed = Param("seed", "init seed", TC.toInt, default=0,
+                 has_default=True)
+
+    # class-level fallbacks: the serializer reconstructs stages without
+    # running __init__ (meshes are runtime wiring, not persisted state)
+    _mesh = None
+    _cache = None
+
+    def __init__(self, mesh=None, **kwargs):
+        super().__init__(**kwargs)
+        self._setDefault(inputCol="tokens", outputCol="features")
+        self._mesh = mesh
+        self._cache = None
+
+    def _encoder(self):
+        if self._cache is None:
+            width, heads = self.get("width"), self.get("heads")
+            if width % (2 * heads) != 0:
+                raise ValueError(
+                    f"width={width} must be a multiple of 2*heads "
+                    f"(heads={heads}): heads split the width and the "
+                    "sinusoidal position encoding needs an even width")
+            attn = make_attention_fn(self.get("attentionImpl"),
+                                     mesh=self._mesh)
+            module = TextEncoder(vocab=self.get("vocabSize"),
+                                 width=width, heads=heads,
+                                 depth=self.get("depth"),
+                                 attention_fn=attn)
+            rng = jax.random.PRNGKey(self.get("seed"))
+            dummy = jnp.zeros((1, self.get("seqChunk")), jnp.int32)
+            variables = module.init(rng, dummy, False)
+            apply = jax.jit(
+                lambda v, x: module.apply(v, x, False)["pooled"])
+            self._cache = (apply, variables)
+        return self._cache
+
+    def _transform(self, df):
+        with self.log_call("transform"):
+            return self._transform_impl(df)
+
+    def _transform_impl(self, df):
+        apply, variables = self._encoder()
+        rows = list(df[self.get("inputCol")])
+        chunk = self.get("seqChunk")
+        T = max((len(r) for r in rows), default=1)
+        T = -(-T // chunk) * chunk
+        ids = np.zeros((len(rows), T), np.int32)
+        for i, r in enumerate(rows):
+            ids[i, :len(r)] = np.asarray(r, np.int32)
+
+        ids_dev = jnp.asarray(ids)
+        if self._mesh is not None:
+            from jax.sharding import NamedSharding, PartitionSpec as P
+            ids_dev = jax.device_put(
+                ids_dev, NamedSharding(self._mesh, P(None, "sp")))
+        pooled = np.asarray(apply(variables, ids_dev))
+        out = np.empty(len(rows), object)
+        out[:] = list(pooled)
+        return df.with_column(self.get("outputCol"), out)

@@ -93,6 +93,8 @@ std::mutex g_mu;
 std::unordered_map<int64_t, std::shared_ptr<Server>> g_servers;
 int64_t g_next_handle = 1;
 
+void flush_out(Server& s, Conn& c);
+
 constexpr size_t kMaxHeaderBytes = 64 * 1024;
 constexpr size_t kMaxBodyBytes = size_t(64) << 20;  // 64 MiB
 // hard per-connection buffer cap, enforced in the recv path regardless
@@ -145,8 +147,13 @@ bool parse_one(Conn& c, Server& s) {
             }
             clen = (size_t)strtoull(val.c_str(), nullptr, 10);
             if (clen > kMaxBodyBytes) {
+                // explicit 413 before close: an abrupt reset would look
+                // like a network fault and get retried forever
+                c.out += "HTTP/1.1 413 Payload Too Large\r\n"
+                         "Content-Length: 0\r\nConnection: close\r\n\r\n";
                 c.closing = true;
                 c.in.clear();
+                flush_out(s, c);
                 return false;
             }
         }

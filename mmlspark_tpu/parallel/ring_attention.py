@@ -30,19 +30,26 @@ def _block_update(q, k, v, m, l, acc, bias, scale):
     if bias is not None:
         s = s + bias
     m_new = jnp.maximum(m, s.max(axis=-1))
-    p = jnp.exp(s - m_new[..., None])
-    corr = jnp.exp(m - m_new)
+    # a fully-masked block leaves m_new = -inf; exp(s - m_new) would be
+    # exp(-inf - -inf) = nan, so shift by 0 there (every term is then
+    # exp(-inf) = 0, the correct weight)
+    m_safe = jnp.where(jnp.isneginf(m_new), 0.0, m_new)
+    p = jnp.exp(s - m_safe[..., None])
+    corr = jnp.exp(m - m_safe)
     l_new = l * corr + p.sum(axis=-1)
     acc_new = acc * corr[..., None] + jnp.einsum("bhqk,bhkd->bhqd", p, v)
     return m_new, l_new, acc_new
 
 
 def blockwise_attention(q, k, v, *, block_size: int = 512,
-                        causal: bool = False, scale: float | None = None):
+                        causal: bool = False, scale: float | None = None,
+                        key_mask=None):
     """Single-device blockwise (flash-style) attention.
 
     q/k/v: [B, H, T, D]. Computes exact softmax attention in blocks over the
-    key axis so the [T, T] score matrix never materializes.
+    key axis so the [T, T] score matrix never materializes. ``key_mask``
+    [B, T] bool marks valid keys (False = e.g. padding, excluded from
+    the softmax).
     """
     B, H, T, D = q.shape
     scale = scale if scale is not None else D ** -0.5
@@ -58,6 +65,9 @@ def blockwise_attention(q, k, v, *, block_size: int = 512,
 
     q_pos = jnp.arange(T)
 
+    if key_mask is not None and pad:
+        key_mask = jnp.pad(key_mask, ((0, 0), (0, pad)))
+
     def body(i, carry):
         m, l, acc = carry
         kv_i = jnp.take(kb, i, axis=2)
@@ -67,8 +77,12 @@ def blockwise_attention(q, k, v, *, block_size: int = 512,
         if causal:
             bias = bias + jnp.where(
                 k_pos[None, :] > q_pos[:, None], -jnp.inf, 0.0)
-        m, l, acc = _block_update(q, kv_i, vv_i, m, l, acc,
-                                  bias[None, None], scale)
+        bias = bias[None, None]
+        if key_mask is not None:
+            mb = jax.lax.dynamic_slice_in_dim(
+                key_mask, i * block_size, block_size, axis=1)
+            bias = bias + jnp.where(mb, 0.0, -jnp.inf)[:, None, None, :]
+        m, l, acc = _block_update(q, kv_i, vv_i, m, l, acc, bias, scale)
         return m, l, acc
 
     m0 = jnp.full((B, H, T), -jnp.inf, q.dtype)
@@ -79,7 +93,7 @@ def blockwise_attention(q, k, v, *, block_size: int = 512,
 
 
 def ring_attention(q, k, v, *, axis: str = "sp", causal: bool = False,
-                   scale: float | None = None):
+                   scale: float | None = None, key_mask=None):
     """Exact attention with Q/K/V sharded over mesh axis ``axis`` along T.
 
     Call inside ``shard_map``: each shard holds [B, H, T/n, D]. K/V rotate
@@ -93,40 +107,54 @@ def ring_attention(q, k, v, *, axis: str = "sp", causal: bool = False,
 
     q_pos = my * Tl + jnp.arange(Tl)
 
+    if key_mask is None:
+        key_mask = jnp.ones((B, Tl), bool)
+
     def body(i, carry):
-        m, l, acc, kc, vc = carry
+        m, l, acc, kc, vc, mc = carry
         src_shard = (my - i) % n          # whose K/V we currently hold
         k_pos = src_shard * Tl + jnp.arange(Tl)
         if causal:
             bias = jnp.where(k_pos[None, :] > q_pos[:, None], -jnp.inf, 0.0)
             bias = bias[None, None]
         else:
-            bias = None
+            bias = jnp.zeros((1, 1, 1, Tl), q.dtype)
+        # the key mask travels around the ring with its K/V block
+        bias = bias + jnp.where(mc, 0.0, -jnp.inf)[:, None, None, :]
         m, l, acc = _block_update(q, kc, vc, m, l, acc, bias, scale)
         # rotate K/V to the next device; XLA overlaps this with compute
         perm = [(j, (j + 1) % n) for j in range(n)]
         kc = jax.lax.ppermute(kc, axis, perm)
         vc = jax.lax.ppermute(vc, axis, perm)
-        return m, l, acc, kc, vc
+        mc = jax.lax.ppermute(mc, axis, perm)
+        return m, l, acc, kc, vc, mc
 
     m0 = jnp.full((B, H, Tl), -jnp.inf, q.dtype)
     l0 = jnp.zeros((B, H, Tl), q.dtype)
     a0 = jnp.zeros_like(q)
-    m, l, acc, _, _ = jax.lax.fori_loop(
-        0, n, body, (m0, l0, a0, k, v))
+    m, l, acc, _, _, _ = jax.lax.fori_loop(
+        0, n, body, (m0, l0, a0, k, v, key_mask))
     return acc / jnp.maximum(l, 1e-35)[..., None]
 
 
-def make_ring_attention(mesh, *, causal: bool = False):
+def make_ring_attention(mesh, *, causal: bool = False, axis: str = "sp"):
     """shard_map-wrapped ring attention: [B, H, T, D] sharded on T over
-    'sp'."""
+    ``axis``. The returned fn is ``fn(q, k, v, key_mask=None)`` with
+    ``key_mask`` [B, T] bool (True = valid key)."""
     from jax.sharding import PartitionSpec as P
-    spec = P(None, None, "sp", None)
+    spec = P(None, None, axis, None)
 
     @functools.partial(
-        jax.shard_map, mesh=mesh, in_specs=(spec, spec, spec),
-        out_specs=spec, check_vma=False)
-    def fn(q, k, v):
-        return ring_attention(q, k, v, axis="sp", causal=causal)
+        jax.shard_map, mesh=mesh,
+        in_specs=(spec, spec, spec, P(None, axis)), out_specs=spec,
+        check_vma=False)
+    def mapped(q, k, v, kmask):
+        return ring_attention(q, k, v, axis=axis, causal=causal,
+                              key_mask=kmask)
+
+    def fn(q, k, v, key_mask=None):
+        if key_mask is None:
+            key_mask = jnp.ones((q.shape[0], q.shape[2]), bool)
+        return mapped(q, k, v, key_mask)
 
     return fn

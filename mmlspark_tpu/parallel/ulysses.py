@@ -35,7 +35,7 @@ def make_ulysses_attention(mesh: Mesh, axis: str = "sp", *,
     """
     d = int(mesh.shape[axis])
 
-    def local(q, k, v):
+    def local(q, k, v, kmask):
         # [B, H, t, D] local sequence shard (t = T/d)
         B, H, t, D = q.shape
         if H % d != 0:
@@ -63,11 +63,24 @@ def make_ulysses_attention(mesh: Mesh, axis: str = "sp", *,
             return x.reshape(B, d * h, t, D)
 
         qh, kh, vh = seq_to_heads(q), seq_to_heads(k), seq_to_heads(v)
+        # every device attends over the full sequence for its head
+        # group, so it needs the full key mask
+        full_mask = jax.lax.all_gather(kmask, axis, axis=1, tiled=True)
         out = blockwise_attention(qh, kh, vh, causal=causal, scale=scale,
-                                  block_size=block_size)
+                                  block_size=block_size,
+                                  key_mask=full_mask)
         return heads_to_seq(out)
 
     spec = P(None, None, axis, None)
-    return jax.jit(jax.shard_map(local, mesh=mesh,
-                                 in_specs=(spec, spec, spec),
-                                 out_specs=spec, check_vma=False))
+    mapped = jax.jit(jax.shard_map(
+        local, mesh=mesh, in_specs=(spec, spec, spec, P(None, axis)),
+        out_specs=spec, check_vma=False))
+
+    @jax.jit
+    def fn(q, k, v, key_mask=None):
+        import jax.numpy as jnp
+        if key_mask is None:
+            key_mask = jnp.ones((q.shape[0], q.shape[2]), bool)
+        return mapped(q, k, v, key_mask)
+
+    return fn

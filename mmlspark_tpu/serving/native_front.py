@@ -43,20 +43,30 @@ class _NativeCachedRequest(CachedRequest):
         self._native_id = native_id
 
     def reply(self, response: HTTPResponseData) -> bool:
-        if not super().reply(response):
-            return False
+        # Build the wire bytes BEFORE marking the request answered: a
+        # bad header value must fail while the 504 sweep can still take
+        # over, not after the exactly-once latch is burned.
         srv = self._server
         body = response.entity or b""
         # every pipeline-set header rides through (Content-Length and
-        # Connection are owned by the reactor)
+        # Connection are owned by the reactor). CR/LF are stripped from
+        # names and values — embedded newlines would otherwise let a
+        # header-echoing pipeline be used for response splitting.
         hdrs = dict(response.headers or {})
         hdrs.setdefault("Content-Type", "application/octet-stream")
+
+        def clean(t):
+            return str(t).replace("\r", "").replace("\n", "")
+
         blob = "".join(
-            f"{k}: {v}\r\n" for k, v in hdrs.items()
-            if k.lower() not in ("content-length", "connection"))
+            f"{clean(k)}: {clean(v)}\r\n" for k, v in hdrs.items()
+            if k.lower() not in ("content-length", "connection")
+        ).encode("latin-1", errors="replace")
+        if not super().reply(response):
+            return False
         srv._lib.hf_reply(srv._handle, self._native_id,
                           int(response.status_code or 500),
-                          blob.encode("latin-1"), body, len(body))
+                          blob, body, len(body))
         srv.history.pop(self.id, None)
         return True
 
@@ -169,12 +179,13 @@ class NativeServingServer(ServingServer):
         raw_path = path_buf.value.decode(errors="replace")
         path = raw_path.split("?", 1)[0].rstrip("/") or "/"
         route = self._routes.get(path)
+        default_ct = b"Content-Type: application/octet-stream\r\n"
         if route is not None:
             status, out = route(body)
-            lib.hf_reply(h, nid, status, b"", out, len(out))
+            lib.hf_reply(h, nid, status, default_ct, out, len(out))
             return
         if path != self.api_path:
-            lib.hf_reply(h, nid, 404, b"", b"", 0)
+            lib.hf_reply(h, nid, 404, default_ct, b"", 0)
             return
         req = HTTPRequestData(
             url=raw_path, method=meth.value.decode(), headers=headers,

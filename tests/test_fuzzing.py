@@ -6,6 +6,7 @@ import numpy as np
 import pytest
 
 from mmlspark_tpu.core import DataFrame
+from mmlspark_tpu.dl import TextEncoderFeaturizer
 from mmlspark_tpu.testing import (TestObject, experiment_fuzzing,
                                   iter_stage_classes, serialization_fuzzing)
 
@@ -81,8 +82,15 @@ def make_test_objects() -> dict[str, TestObject]:
         "features", np.where(rng.random((40, 4)) < 0.2, np.nan,
                              num["features"]).astype(np.float32))
 
+    tok_rows = np.empty(6, object)
+    tok_rows[:] = [list(rng.integers(1, 500, size=n))
+                   for n in (5, 12, 3, 30, 8, 16)]
+    tok_df = DataFrame({"tokens": tok_rows})
+
     objs = [
         TestObject(DropColumns(cols=["label"]), num),
+        TestObject(TextEncoderFeaturizer(width=32, depth=1,
+                                         vocabSize=512), tok_df),
         TestObject(SelectColumns(cols=["features"]), num),
         TestObject(RenameColumn(inputCol="label", outputCol="y"), num),
         TestObject(Repartition(n=2), num),
