@@ -24,7 +24,7 @@ PACKAGES: dict[str, list[str]] = {
     "core": ["test_core_dataframe.py", "test_core_params_pipeline.py",
              "test_fuzzing.py", "test_longtail_io.py"],
     "featurize": ["test_featurize.py", "test_stages.py"],
-    "lightgbm1": ["test_lightgbm.py", "test_pallas_hist.py"],
+    "lightgbm1": ["test_lightgbm.py", "test_lightgbm_categorical.py", "test_pallas_hist.py"],
     "lightgbm2": ["test_lightgbm_sparse.py", "test_lightgbm_distributed.py",
                   "test_lightgbm_format_fixture.py"],
     "vw": ["test_vw.py"],

@@ -39,8 +39,13 @@ def _dense_attention(q, k, v, key_mask=None):
                    preferred_element_type=jnp.float32) * (D ** -0.5)
     if key_mask is not None:
         s = s + jnp.where(key_mask, 0.0, -jnp.inf)[:, None, None, :]
-    p = jax.nn.softmax(s, axis=-1).astype(v.dtype)
-    return jnp.einsum("bhqk,bhkd->bhqd", p, v)
+    p = jax.nn.softmax(s, axis=-1)
+    if key_mask is not None:
+        # an all-pad row (empty document) masks every key: softmax over
+        # -inf is NaN; emit zeros like the blockwise/ring accumulators
+        any_valid = key_mask.any(-1)[:, None, None, None]
+        p = jnp.where(any_valid, p, 0.0)
+    return jnp.einsum("bhqk,bhkd->bhqd", p.astype(v.dtype), v)
 
 
 class EncoderBlock(nn.Module):

@@ -115,6 +115,11 @@ class Booster:
         """Per-(row, tree) leaf node ids, dense or padded-COO input."""
         from .sparse import SparseData, predict_leaf_nodes_sparse
         if isinstance(x, SparseData):
+            if "cat_flag" in self.arrays and self.arrays["cat_flag"].any():
+                raise NotImplementedError(
+                    "this model contains categorical splits; sparse "
+                    "(padded-COO) prediction does not support them — "
+                    "densify the features")
             return predict_leaf_nodes_sparse(
                 self._device_arrays(t_end),
                 jnp.asarray(x.indices, jnp.int32),
@@ -154,9 +159,15 @@ class Booster:
 
     def _device_arrays(self, t_end: int):
         a = self.arrays
-        return tuple(jnp.asarray(a[k][:t_end]) for k in
+        base = tuple(jnp.asarray(a[k][:t_end]) for k in
                      ("feature", "threshold", "left", "right",
                       "leaf_value", "is_leaf", "default_left"))
+        if "cat_flag" in a:
+            return base + (jnp.asarray(a["cat_flag"][:t_end]),
+                           jnp.asarray(a["cat_left"][:t_end]))
+        T, NN = a["feature"][:t_end].shape
+        return base + (jnp.zeros((T, NN), bool),
+                       jnp.zeros((T, NN, 1), bool))
 
     # ---------------------------------------------------------- importances
     def feature_importances(self, importance_type: str = "split",
@@ -236,12 +247,37 @@ class Booster:
             return leaf_ord[c] * -1 - 1 if is_leaf[c] else int_ord[c]
 
         num_leaves = len(leaf_ids)
+        cat_flag = a.get("cat_flag")
+        cat_left = a.get("cat_left")
+        # categorical internal nodes: decision_type bit 0 set; threshold
+        # indexes into cat_boundaries/cat_threshold (LightGBM's 32-bit
+        # bitset encoding over raw category ids; bit c = category c goes
+        # left; our identity binning stores membership at bin c+1)
+        cat_idx_of: dict[int, int] = {}
+        cat_boundaries = [0]
+        cat_words: list[int] = []
+        if cat_flag is not None:
+            for i in internal_ids:
+                if not cat_flag[t, i]:
+                    continue
+                bits = np.flatnonzero(cat_left[t, i][1:])  # category ids
+                n_words = max((int(bits.max()) // 32 + 1) if bits.size
+                              else 1, 1)
+                words = [0] * n_words
+                for c in bits:
+                    words[c // 32] |= 1 << (c % 32)
+                cat_idx_of[i] = len(cat_boundaries) - 1
+                cat_words.extend(words)
+                cat_boundaries.append(len(cat_words))
         rows = {
             "split_feature": [int(a["feature"][t, i]) for i in internal_ids],
             "split_gain": [float(a["split_gain"][t, i])
                            for i in internal_ids],
-            "threshold": [float(a["threshold"][t, i]) for i in internal_ids],
-            "decision_type": [2] * len(internal_ids),  # missing=NaN, default left
+            "threshold": [float(cat_idx_of[i]) if i in cat_idx_of
+                          else float(a["threshold"][t, i])
+                          for i in internal_ids],
+            "decision_type": [1 if i in cat_idx_of else 2
+                              for i in internal_ids],
             "left_child": [child_code(int(a["left"][t, i]))
                            for i in internal_ids],
             "right_child": [child_code(int(a["right"][t, i]))
@@ -257,9 +293,15 @@ class Booster:
             "internal_count": [int(a["node_count"][t, i])
                                for i in internal_ids],
         }
-        out = [f"Tree={t}", f"num_leaves={num_leaves}", "num_cat=0"]
+        out = [f"Tree={t}", f"num_leaves={num_leaves}",
+               f"num_cat={len(cat_idx_of)}"]
         for key, vals in rows.items():
             out.append(f"{key}=" + " ".join(_fmt(v) for v in vals))
+        if cat_idx_of:
+            out.append("cat_boundaries=" + " ".join(
+                str(v) for v in cat_boundaries))
+            out.append("cat_threshold=" + " ".join(
+                str(v) for v in cat_words))
         out.append("shrinkage=1")
         return out
 
@@ -311,16 +353,14 @@ class Booster:
                 raw = td.get(key, "")
                 vals = [dtype(v) for v in raw.split()] if raw else []
                 return vals
-            if int(td.get("num_cat", "0")) > 0:
-                raise NotImplementedError(
-                    "native LightGBM model uses categorical splits "
-                    "(num_cat > 0); set-based categorical routing is not "
-                    "supported yet — retrain with numeric/ordinal features")
             dt = parse("decision_type", int)
-            if any(d & 1 for d in dt):
-                raise NotImplementedError(
-                    "categorical decision_type in native model is not "
-                    "supported yet")
+            n_cat = int(td.get("num_cat", "0"))
+            if n_cat > 0 or any(d & 1 for d in dt):
+                cat_bnd = parse("cat_boundaries", int)
+                cat_thr = parse("cat_threshold", int)
+                if "cat_flag" not in arr:
+                    arr["cat_flag"] = np.zeros((T, NN), bool)
+                    arr["cat_left"] = np.zeros((T, NN, 256), bool)
             sf = parse("split_feature", int)
             thr = parse("threshold", float)
             lc = parse("left_child", int)
@@ -345,6 +385,22 @@ class Booster:
                 # decision_type bit 1 = default-left for missing values
                 arr["default_left"][t, i] = bool(dt[i] & 2) \
                     if i < len(dt) else True
+                if i < len(dt) and dt[i] & 1:
+                    # categorical split: threshold indexes the bitset;
+                    # bit c set = raw category c goes left = bin c+1
+                    ci = int(thr[i])
+                    words = cat_thr[cat_bnd[ci]:cat_bnd[ci + 1]]
+                    arr["cat_flag"][t, i] = True
+                    for w_i, word in enumerate(words):
+                        word = int(word) & 0xFFFFFFFF
+                        for bit in range(32):
+                            if word >> bit & 1:
+                                c = w_i * 32 + bit
+                                if c + 1 >= 256:
+                                    raise NotImplementedError(
+                                        "categorical model uses category "
+                                        f"id {c} >= 255; unsupported")
+                                arr["cat_left"][t, i, c + 1] = True
                 arr["split_gain"][t, i] = sg[i] if i < len(sg) else 0
                 arr["node_value"][t, i] = iv[i] if i < len(iv) else 0
                 arr["node_weight"][t, i] = iw[i] if i < len(iw) else 0
@@ -377,8 +433,22 @@ def merge_boosters(first: Booster, second: Booster) -> Booster:
     ``booster/LightGBMBooster.scala:237-241``). The merged model keeps the
     first booster's init score; the second must have been trained from the
     first's predictions (init handled by the trainer)."""
-    a, b = first.arrays, second.arrays
+    a, b = dict(first.arrays), dict(second.arrays)
     nn = max(a["feature"].shape[1], b["feature"].shape[1])
+    # harmonize categorical arrays: either side may lack them (e.g. a
+    # continuation from a non-categorical native model), and their bin
+    # width may differ
+    if "cat_flag" in a or "cat_flag" in b:
+        bw = max(a["cat_left"].shape[2] if "cat_flag" in a else 1,
+                 b["cat_left"].shape[2] if "cat_flag" in b else 1)
+        for d in (a, b):
+            if "cat_flag" not in d:
+                d["cat_flag"] = np.zeros(d["feature"].shape, bool)
+                d["cat_left"] = np.zeros(d["feature"].shape + (bw,), bool)
+            elif d["cat_left"].shape[2] < bw:
+                d["cat_left"] = np.pad(
+                    d["cat_left"],
+                    ((0, 0), (0, 0), (0, bw - d["cat_left"].shape[2])))
 
     def pad(arr_dict):
         out = {}
@@ -386,7 +456,8 @@ def merge_boosters(first: Booster, second: Booster) -> Booster:
             if k == "num_nodes":
                 out[k] = v
             elif v.shape[1] < nn:
-                pad_width = ((0, 0), (0, nn - v.shape[1]))
+                pad_width = ((0, 0), (0, nn - v.shape[1])) \
+                    + ((0, 0),) * (v.ndim - 2)
                 out[k] = np.pad(v, pad_width)
             else:
                 out[k] = v
@@ -407,9 +478,10 @@ def merge_boosters(first: Booster, second: Booster) -> Booster:
 # ------------------------------------------------------------ jitted predict
 @functools.partial(jax.jit, static_argnames=("max_depth",))
 def _predict_leaf_nodes(tree_arrays, x, *, max_depth: int):
-    feature, threshold, left, right, leaf_value, is_leaf, default_left = \
-        tree_arrays
+    (feature, threshold, left, right, leaf_value, is_leaf, default_left,
+     cat_flag, cat_left) = tree_arrays
     T = feature.shape[0]
+    B = cat_left.shape[-1]
     n = x.shape[0]
     node = jnp.zeros((n, T), jnp.int32)
     t_idx = jnp.arange(T)[None, :]
@@ -419,7 +491,19 @@ def _predict_leaf_nodes(tree_arrays, x, *, max_depth: int):
         thr = threshold[t_idx, node]
         xv = jnp.take_along_axis(x, f.reshape(n, T), axis=1)
         missing = jnp.isnan(xv)
-        go_left = jnp.where(missing, default_left[t_idx, node], xv <= thr)
+        ord_left = xv <= thr
+        # categorical: raw value c lives in bin c+1 (identity binning);
+        # missing and out-of-range/unseen categories go right, matching
+        # LightGBM's "not in the bitset" rule (training validates
+        # categories fit the bin range, so no category shares a bin)
+        iv = jnp.nan_to_num(xv).astype(jnp.int32)
+        in_range = (~missing) & (xv >= 0) & (iv < B - 1) \
+            & (xv == iv.astype(xv.dtype))
+        cat_bin = jnp.clip(iv + 1, 0, B - 1)
+        cat_go = cat_left[t_idx, node, cat_bin] & in_range
+        go_left = jnp.where(cat_flag[t_idx, node], cat_go,
+                            jnp.where(missing, default_left[t_idx, node],
+                                      ord_left))
         nxt = jnp.where(go_left, left[t_idx, node], right[t_idx, node])
         return jnp.where(is_leaf[t_idx, node], node, nxt)
 

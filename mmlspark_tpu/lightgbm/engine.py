@@ -66,12 +66,17 @@ class TreeParams(NamedTuple):
     min_gain_to_split: float = 0.0
     parallelism: str = "data"    # data | voting (PV-Tree top-K)
     top_k: int = 20              # voting: local nominations per shard
+    cat_features: tuple = ()     # feature indices with set-based splits
+    cat_smooth: float = 10.0     # hessian smoothing in the g/h cat sort
 
 
 class Tree(NamedTuple):
     """Fixed-capacity tree arrays; node ids are append-ordered."""
     feature: jnp.ndarray      # i32 [NN] split feature (internal nodes)
     split_bin: jnp.ndarray    # i32 [NN] go left iff bin <= split_bin
+                              #   (categorical: rank(bin) <= split_bin)
+    cat_flag: jnp.ndarray     # bool [NN] node splits on a category set
+    cat_left: jnp.ndarray     # bool [NN, B] bin ids routed left
     left: jnp.ndarray         # i32 [NN]
     right: jnp.ndarray        # i32 [NN]
     leaf_value: jnp.ndarray   # f32 [NN] (already shrunk by learning_rate)
@@ -150,6 +155,14 @@ def grow_tree(bins: jnp.ndarray, grad: jnp.ndarray, hess: jnp.ndarray,
     max_depth = p.max_depth if p.max_depth and p.max_depth > 0 else 10 ** 9
     voting = p.parallelism == "voting" and psum_axis is not None
     C = min(2 * p.top_k, F)  # global candidate features per leaf (voting)
+    has_cat = len(p.cat_features) > 0
+    if has_cat and voting:
+        raise NotImplementedError(
+            "categorical splits + voting_parallel are not supported "
+            "together; use parallelism='data_parallel'")
+    if has_cat:
+        cat_feat_mask = jnp.zeros(F, bool).at[
+            jnp.asarray(p.cat_features, jnp.int32)].set(True)
 
     g = grad * row_mask
     h = hess * row_mask
@@ -165,6 +178,8 @@ def grow_tree(bins: jnp.ndarray, grad: jnp.ndarray, hess: jnp.ndarray,
     tree = Tree(
         feature=jnp.zeros(NN, jnp.int32),
         split_bin=jnp.full(NN, B, jnp.int32),
+        cat_flag=jnp.zeros(NN, bool),
+        cat_left=jnp.zeros((NN, B), bool),
         left=jnp.full(NN, -1, jnp.int32),
         right=jnp.full(NN, -1, jnp.int32),
         leaf_value=jnp.zeros(NN, jnp.float32).at[0].set(
@@ -272,6 +287,33 @@ def grow_tree(bins: jnp.ndarray, grad: jnp.ndarray, hess: jnp.ndarray,
             search = state["hist"]                         # [L, F, B, 3]
             n_search = F
         gl, hl, cl, gr, hr, cr, gain = _split_stats(search, p)
+        if has_cat:
+            # categorical features: LightGBM's many-vs-many heuristic —
+            # sort the leaf's category bins by gradient/hessian ratio and
+            # scan the SORTED order like an ordinal feature; position b
+            # then means "the b+1 best-ratio categories go left"
+            # (category_feature_encoder in the native core)
+            ratio = jnp.where(
+                search[..., 2] > 0,
+                search[..., 0] / (search[..., 1] + p.cat_smooth),
+                jnp.inf)                       # empty bins sort last
+            # the missing bin (0) must never enter a left set: predict
+            # and SHAP send missing right unconditionally (LightGBM's
+            # "NaN is in no bitset"), so training must match
+            ratio = ratio.at[..., 0].set(jnp.inf)
+            cat_order = jnp.argsort(ratio, axis=-1)       # [L, F, B]
+            sorted_hist = jnp.take_along_axis(
+                search, cat_order[..., None], axis=-2)
+            glc, hlc, clc, grc, hrc, crc, gainc = _split_stats(
+                sorted_hist, p)
+            cm = cat_feat_mask[None, :, None]
+            gl = jnp.where(cm, glc, gl)
+            hl = jnp.where(cm, hlc, hl)
+            cl = jnp.where(cm, clc, cl)
+            gr = jnp.where(cm, grc, gr)
+            hr = jnp.where(cm, hrc, hr)
+            cr = jnp.where(cm, crc, cr)
+            gain = jnp.where(cm, gainc, gain)
         if voting:
             feat_ok = feature_mask[state["cand_feat"]][:, :, None]
         else:
@@ -307,7 +349,19 @@ def grow_tree(bins: jnp.ndarray, grad: jnp.ndarray, hess: jnp.ndarray,
         new_slot = state["n_slots"]
         row_bin = jnp.take(bins, f_star, axis=1).astype(jnp.int32)
         in_parent = (state["slot"] == s_star) & found
-        goes_right = in_parent & (row_bin > b_star)
+        if has_cat:
+            is_cat = cat_feat_mask[f_star]
+            # rank of each bin in the chosen (slot, feature)'s ratio sort;
+            # left = the b_star+1 best-ratio categories
+            order_star = cat_order[s_star, f_star]        # [B]
+            rank = jnp.zeros(B, jnp.int32).at[order_star].set(
+                jnp.arange(B, dtype=jnp.int32))
+            left_set = is_cat & (rank <= b_star)          # bool [B]
+            right_rule = jnp.where(is_cat, rank[row_bin] > b_star,
+                                   row_bin > b_star)
+        else:
+            right_rule = row_bin > b_star
+        goes_right = in_parent & right_rule
         use_left = lc <= rc  # scatter the smaller child, derive sibling
         sel = jnp.where(use_left, in_parent & ~goes_right, goes_right)
         h_small = local_hist(sel.astype(jnp.float32))
@@ -333,6 +387,10 @@ def grow_tree(bins: jnp.ndarray, grad: jnp.ndarray, hess: jnp.ndarray,
             new_tree = Tree(
                 feature=tree.feature.at[parent].set(f_star),
                 split_bin=tree.split_bin.at[parent].set(b_star),
+                cat_flag=(tree.cat_flag.at[parent].set(is_cat)
+                          if has_cat else tree.cat_flag),
+                cat_left=(tree.cat_left.at[parent].set(left_set)
+                          if has_cat else tree.cat_left),
                 left=tree.left.at[parent].set(nl),
                 right=tree.right.at[parent].set(nr),
                 leaf_value=tree.leaf_value
@@ -411,7 +469,9 @@ def tree_route_bins(tree: Tree, bins: jnp.ndarray, *, max_depth: int):
         b = tree.split_bin[node]
         row_bin = jnp.take_along_axis(
             bins, f[:, None].astype(jnp.int32), axis=1)[:, 0].astype(jnp.int32)
-        nxt = jnp.where(row_bin <= b, tree.left[node], tree.right[node])
+        go_left = jnp.where(tree.cat_flag[node],
+                            tree.cat_left[node, row_bin], row_bin <= b)
+        nxt = jnp.where(go_left, tree.left[node], tree.right[node])
         return jnp.where(tree.is_leaf[node], node, nxt)
 
     return jax.lax.fori_loop(0, max_depth, step, node)

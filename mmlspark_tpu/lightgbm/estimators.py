@@ -61,14 +61,33 @@ class _LightGBMBase(Estimator, LightGBMSharedParams):
         return None
 
     def _preprocess(self, df):
-        if self.getCategoricalSlotIndexes() or self.getCategoricalSlotNames():
-            # Set-based categorical splits are not implemented yet; integer
-            # category ids still get per-value bins (ordinal splits), which
-            # differs from LightGBM's k-vs-rest partitioning.
-            self._log_event(
-                "warn", message="categoricalSlotIndexes/Names are treated as "
-                "ordinal (set-based categorical splits not yet implemented)")
         return df
+
+    def _categorical_slots(self, df) -> tuple:
+        """Resolve categoricalSlotIndexes/Names to slot indexes
+        (reference: names resolve through ML attribute metadata,
+        ``LightGBMBase.scala``; here through slotNames or the features
+        column's metadata)."""
+        idx = list(self.getCategoricalSlotIndexes() or [])
+        names = self.getCategoricalSlotNames() or []
+        if names:
+            slots = self.getSlotNames() or []
+            if not slots:
+                from ..core import ColumnMetadata
+                meta = ColumnMetadata.get(df, self.getFeaturesCol()) or {}
+                slots = meta.get("slot_names", [])
+            if not slots:
+                raise ValueError(
+                    "categoricalSlotNames given but no slot names are "
+                    "available: set slotNames (or attach 'slot_names' "
+                    "column metadata), or use categoricalSlotIndexes")
+            missing = [nm for nm in names if nm not in slots]
+            if missing:
+                raise ValueError(
+                    f"categoricalSlotNames not found in slotNames: "
+                    f"{missing}")
+            idx.extend(slots.index(nm) for nm in names)
+        return tuple(sorted(set(int(i) for i in idx)))
 
     def _fit(self, df):
         df = self._preprocess(df)
@@ -128,6 +147,7 @@ class _LightGBMBase(Estimator, LightGBMSharedParams):
                        if self.isSet("initScoreCol") else None)
 
         cfg = TrainConfig(**self._train_config_kwargs(),
+                          categorical_features=self._categorical_slots(df),
                           **self._objective_config(y))
         names = self.getSlotNames() or (
             None if sparse else

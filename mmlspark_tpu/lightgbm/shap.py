@@ -79,6 +79,8 @@ def tree_shap_values(arrays: dict, t: int, x: np.ndarray,
     """SHAP values of tree ``t`` for rows ``x`` → [n, F+1] (last = bias)."""
     feature = arrays["feature"][t]
     threshold = arrays["threshold"][t]
+    cat_flag = arrays["cat_flag"][t] if "cat_flag" in arrays else None
+    cat_left = arrays["cat_left"][t] if "cat_left" in arrays else None
     left = arrays["left"][t]
     right = arrays["right"][t]
     leaf_value = arrays["leaf_value"][t].astype(np.float64)
@@ -119,8 +121,18 @@ def tree_shap_values(arrays: dict, t: int, x: np.ndarray,
                 return
             f = int(feature[node])
             xv = row[f]
-            goes_left = bool(default_left[node]) if np.isnan(xv) \
-                else xv <= threshold[node]
+            if cat_flag is not None and cat_flag[node]:
+                # categorical: membership of the raw category's bin
+                # (identity binning: category c -> bin c+1)
+                if np.isnan(xv):
+                    goes_left = False
+                else:
+                    b = int(xv) + 1
+                    goes_left = bool(cat_left[node, b]) \
+                        if 0 <= b < cat_left.shape[1] else False
+            else:
+                goes_left = bool(default_left[node]) if np.isnan(xv) \
+                    else xv <= threshold[node]
             hot, cold = (left[node], right[node]) if goes_left \
                 else (right[node], left[node])
             tot = max(count[node], 1e-12)

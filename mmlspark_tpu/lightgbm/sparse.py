@@ -304,9 +304,16 @@ def grow_tree_sparse(indices: jnp.ndarray, ebins: jnp.ndarray,
 
     total_g, total_h, total_c = (psum(g.sum()), psum(h.sum()),
                                  psum(row_mask.sum()))
+    if p.cat_features:
+        raise NotImplementedError(
+            "categorical splits are not supported on the sparse "
+            "padded-COO path; densify categorical slots or drop "
+            "categoricalSlotIndexes")
     tree = Tree(
         feature=jnp.zeros(NN, jnp.int32),
         split_bin=jnp.full(NN, B, jnp.int32),
+        cat_flag=jnp.zeros(NN, bool),
+        cat_left=jnp.zeros((NN, B), bool),
         left=jnp.full(NN, -1, jnp.int32),
         right=jnp.full(NN, -1, jnp.int32),
         leaf_value=jnp.zeros(NN, jnp.float32).at[0].set(
@@ -388,6 +395,8 @@ def grow_tree_sparse(indices: jnp.ndarray, ebins: jnp.ndarray,
             new_tree = Tree(
                 feature=tree.feature.at[parent].set(f_star),
                 split_bin=tree.split_bin.at[parent].set(b_star),
+                cat_flag=tree.cat_flag,
+                cat_left=tree.cat_left,
                 left=tree.left.at[parent].set(nl),
                 right=tree.right.at[parent].set(nr),
                 leaf_value=tree.leaf_value
@@ -476,8 +485,10 @@ def predict_leaf_nodes_sparse(tree_arrays, indices, values, *,
     """Per-(row, tree) leaf node ids on raw COO features — the sparse
     counterpart of ``booster._predict_leaf_nodes`` (reference CSR predict,
     ``LightGBMBooster.scala:333-344``). Absent features read 0.0."""
-    feature, threshold, left, right, leaf_value, is_leaf, default_left = \
-        tree_arrays
+    # cat arrays (always appended by Booster._device_arrays) are unused:
+    # the sparse path refuses categorical training/models upstream
+    (feature, threshold, left, right, leaf_value, is_leaf, default_left,
+     _cat_flag, _cat_left) = tree_arrays
     T = feature.shape[0]
     n = indices.shape[0]
     node = jnp.zeros((n, T), jnp.int32)

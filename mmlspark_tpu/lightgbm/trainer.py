@@ -76,6 +76,8 @@ class TrainConfig:
     eval_freq: int = 1             # evaluate every k iterations (de-sync)
     parallelism: str = "data_parallel"  # | voting_parallel (PV-Tree)
     top_k: int = 20                # voting: local nominations per shard
+    categorical_features: tuple = ()  # slot indexes with set-based splits
+    cat_smooth: float = 10.0       # hessian smoothing in the cat sort
     # engine plumbing
     psum_axis: str | None = None
     fobj: Callable | None = None
@@ -93,7 +95,9 @@ class TrainConfig:
             min_gain_to_split=self.min_gain_to_split,
             parallelism=("voting" if self.parallelism == "voting_parallel"
                          else "data"),
-            top_k=self.top_k)
+            top_k=self.top_k,
+            cat_features=tuple(self.categorical_features),
+            cat_smooth=self.cat_smooth)
 
 
 def _apply_delta(scores, delta, k_cls: int, K: int):
@@ -275,6 +279,28 @@ def train(x: np.ndarray, y: np.ndarray, w: np.ndarray | None,
         boundaries = compute_bin_boundaries(x[:n_real], cfg.max_bin,
                                             sample_cnt=cfg.bin_sample_count,
                                             seed=cfg.seed)
+        for f in cfg.categorical_features:
+            # identity binning for categorical slots: category c (an
+            # integer value) lands in bin c+1 exactly, so the engine's
+            # per-bin histogram IS the per-category histogram (LightGBM
+            # bins categories by id too). Cardinality is bounded by the
+            # bin budget — sharing a bin would silently merge categories
+            # and break text-format round trips.
+            col = x[:n_real, f]
+            vals = col[~np.isnan(col)]
+            if vals.size and (np.any(vals < 0)
+                              or np.any(vals != np.floor(vals))):
+                raise ValueError(
+                    f"categorical slot {f} must hold non-negative "
+                    "integer category ids (reference LightGBM "
+                    "requirement); index labels first (ValueIndexer)")
+            if vals.size and vals.max() > cfg.max_bin - 2:
+                raise ValueError(
+                    f"categorical slot {f} has category id "
+                    f"{int(vals.max())} > max_bin-2 = {cfg.max_bin - 2}; "
+                    "raise maxBin or re-index the categories")
+            k = boundaries.shape[1]
+            boundaries[f] = np.arange(k) + 0.5
         bins = bin_features(jnp.asarray(x, jnp.float32),
                             jnp.asarray(boundaries))
     y_dev = jnp.asarray(y, jnp.float32)
@@ -692,6 +718,10 @@ def build_booster(trees: list[Tree], boundaries: np.ndarray,
         ("split_gain", np.float32), ("node_weight", np.float32),
         ("node_count", np.float32), ("node_value", np.float32)]}
     arr["num_nodes"] = np.zeros(T, np.int32)
+    if cfg.categorical_features:
+        B = cfg.max_bin + 1
+        arr["cat_flag"] = np.zeros((T, NN), bool)
+        arr["cat_left"] = np.zeros((T, NN, B), bool)
     for t, tree in enumerate(trees):
         arr["feature"][t] = tree.feature
         arr["left"][t] = tree.left
@@ -703,8 +733,13 @@ def build_booster(trees: list[Tree], boundaries: np.ndarray,
         arr["node_count"][t] = tree.node_count
         arr["node_value"][t] = tree.node_value
         arr["num_nodes"][t] = tree.num_nodes
+        if cfg.categorical_features:
+            arr["cat_flag"][t] = tree.cat_flag
+            arr["cat_left"][t] = tree.cat_left
         for i in range(int(tree.num_nodes)):
-            if not tree.is_leaf[i] and tree.left[i] >= 0:
+            if not tree.is_leaf[i] and tree.left[i] >= 0 \
+                    and not (cfg.categorical_features
+                             and tree.cat_flag[i]):
                 arr["threshold"][t, i] = bin_upper_value(
                     boundaries, int(tree.feature[i]),
                     int(tree.split_bin[i]))

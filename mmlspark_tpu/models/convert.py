@@ -253,3 +253,22 @@ def convert_torch_checkpoint(src, model_name: str,
         state_dict = src
     variables = torch_to_flax(state_dict, model_name)
     return save_converted(variables, model_name, out_dir)
+
+
+def _main(argv):
+    """CLI: ``python -m mmlspark_tpu.models.convert <src.pt[h]> <name>
+    [out_dir]`` — one-step torchvision→orbax conversion with manifest,
+    e.g. ``... resnet50-0676ba61.pth ResNet50``. Point
+    ``MMLSPARK_TPU_MODEL_DIR`` at the output to serve the weights."""
+    if len(argv) < 2:
+        print(_main.__doc__)
+        return 2
+    path = convert_torch_checkpoint(
+        argv[0], argv[1], argv[2] if len(argv) > 2 else None)
+    print(f"converted {argv[1]} -> {path}")
+    return 0
+
+
+if __name__ == "__main__":
+    import sys
+    raise SystemExit(_main(sys.argv[1:]))

@@ -148,12 +148,13 @@ bool parse_one(Conn& c, Server& s) {
             clen = (size_t)strtoull(val.c_str(), nullptr, 10);
             if (clen > kMaxBodyBytes) {
                 // explicit 413 before close: an abrupt reset would look
-                // like a network fault and get retried forever
+                // like a network fault and get retried forever. Only
+                // BUFFERED here — flush_out can close and erase the
+                // Conn, and our caller still holds the reference.
                 c.out += "HTTP/1.1 413 Payload Too Large\r\n"
                          "Content-Length: 0\r\nConnection: close\r\n\r\n";
                 c.closing = true;
                 c.in.clear();
-                flush_out(s, c);
                 return false;
             }
         }
@@ -282,7 +283,10 @@ void reactor(Server* s) {
                     if (s->conns.find(target[k].fd) != s->conns.end()) {
                         c.in_flight = false;
                         while (parse_one(c, *s)) {}
-                        if (c.closing && c.out.empty())
+                        if (!c.out.empty()) flush_out(*s, c);
+                        auto it2 = s->conns.find(target[k].fd);
+                        if (it2 != s->conns.end() && it2->second.closing
+                            && it2->second.out.empty())
                             close_conn(*s, target[k].fd);
                     }
                 }
@@ -319,7 +323,11 @@ void reactor(Server* s) {
                 }
                 if (s->conns.find(fd) != s->conns.end()) {
                     while (parse_one(c, *s)) {}
-                    if (c.closing && c.out.empty())
+                    if (!c.out.empty()) flush_out(*s, c);
+                    // flush_out may have closed + erased: re-look-up
+                    auto it2 = s->conns.find(fd);
+                    if (it2 != s->conns.end() && it2->second.closing &&
+                        it2->second.out.empty())
                         close_conn(*s, fd);
                 }
             }

@@ -1,0 +1,178 @@
+"""Set-based categorical splits (reference ``categoricalSlotIndexes`` /
+``categoricalSlotNames``, ``LightGBMParams.scala:191-197``): the engine
+sorts a leaf's category bins by gradient/hessian ratio and scans the
+sorted order (LightGBM's many-vs-many heuristic), so one split can
+isolate an arbitrary category SET — which no ordinal threshold can."""
+
+import numpy as np
+import pytest
+
+from mmlspark_tpu.core import DataFrame, load_stage
+from mmlspark_tpu.lightgbm import LightGBMClassifier
+from mmlspark_tpu.lightgbm.booster import Booster
+
+# label = [category in LEFT_SET], with the set chosen interleaved so no
+# single ordinal threshold separates it
+N_CAT = 12
+LEFT_SET = {1, 4, 6, 9}
+
+
+def cat_df(n=2000, seed=0, noise=0.0):
+    rng = np.random.default_rng(seed)
+    cats = rng.integers(0, N_CAT, size=n).astype(np.float32)
+    other = rng.normal(size=n).astype(np.float32)
+    y = np.isin(cats, list(LEFT_SET)).astype(np.float32)
+    if noise:
+        flip = rng.random(n) < noise
+        y = np.where(flip, 1 - y, y)
+    x = np.stack([cats, other], axis=1)
+    return DataFrame({"features": x, "label": y})
+
+
+def _accuracy(model, df):
+    pred = np.asarray(model.transform(df)["prediction"])
+    return float((pred == np.asarray(df["label"])).mean())
+
+
+class TestCategoricalSplits:
+    def test_one_split_isolates_a_category_set(self):
+        df = cat_df()
+        # a single tree with one split suffices when categories are
+        # set-partitioned; ordinal routing needs many threshold splits
+        cat = LightGBMClassifier(numIterations=8, numLeaves=2,
+                                 minDataInLeaf=5,
+                                 categoricalSlotIndexes=[0]).fit(df)
+        ordn = LightGBMClassifier(numIterations=8, numLeaves=2,
+                                  minDataInLeaf=5).fit(df)
+        acc_cat = _accuracy(cat, df)
+        acc_ord = _accuracy(ordn, df)
+        assert acc_cat > 0.99, acc_cat
+        # an ordinal threshold on an interleaved set cannot separate it
+        assert acc_ord < 0.9, acc_ord
+
+    def test_categorical_slot_names(self):
+        df = cat_df()
+        m = LightGBMClassifier(numIterations=4, numLeaves=2,
+                               minDataInLeaf=5,
+                               slotNames=["color", "other"],
+                               categoricalSlotNames=["color"]).fit(df)
+        assert _accuracy(m, df) > 0.99
+
+    def test_unknown_slot_name_raises(self):
+        with pytest.raises(ValueError, match="not found"):
+            LightGBMClassifier(numIterations=2,
+                               slotNames=["a", "b"],
+                               categoricalSlotNames=["zzz"]).fit(cat_df(200))
+
+    def test_save_load_roundtrip(self, tmp_path):
+        df = cat_df(800, noise=0.05)
+        m = LightGBMClassifier(numIterations=6, numLeaves=4,
+                               minDataInLeaf=5,
+                               categoricalSlotIndexes=[0]).fit(df)
+        want = np.asarray(m.transform(df)["probability"])
+        m.save(str(tmp_path / "m"))
+        got = np.asarray(load_stage(str(tmp_path / "m"))
+                         .transform(df)["probability"])
+        np.testing.assert_allclose(got, want, atol=1e-6)
+
+    def test_native_text_roundtrip(self):
+        df = cat_df(800)
+        m = LightGBMClassifier(numIterations=5, numLeaves=4,
+                               minDataInLeaf=5,
+                               categoricalSlotIndexes=[0]).fit(df)
+        text = m.get_native_model_string()
+        assert "num_cat=" in text and "cat_threshold=" in text
+        re = Booster.load_native(text)
+        x = np.asarray(df["features"])
+        want = m.booster.raw_scores(x)
+        got = re.raw_scores(x)
+        np.testing.assert_allclose(got, want, rtol=1e-4, atol=1e-5)
+
+    def test_load_handwritten_lightgbm_cat_model(self):
+        """A minimal native-LightGBM-shaped text model with one
+        categorical split: categories {0, 3} go left (bitset word
+        0b1001 = 9)."""
+        text = "\n".join([
+            "tree", "version=v3", "num_class=1",
+            "num_tree_per_iteration=1", "label_index=0",
+            "max_feature_idx=0", "objective=regression",
+            "feature_names=Column_0", "feature_infos=none", "",
+            "Tree=0", "num_leaves=2", "num_cat=1",
+            "split_feature=0", "split_gain=1", "threshold=0",
+            "decision_type=1", "left_child=-1", "right_child=-2",
+            "leaf_value=10 20", "leaf_weight=1 1", "leaf_count=1 1",
+            "internal_value=0", "internal_weight=2", "internal_count=2",
+            "cat_boundaries=0 1", "cat_threshold=9",
+            "shrinkage=1", "", "end of trees", "",
+            "parameters:", "end of parameters",
+        ])
+        b = Booster.load_native(text)
+        x = np.asarray([[0.0], [1.0], [2.0], [3.0], [4.0]], np.float32)
+        got = b.raw_scores(x)
+        np.testing.assert_allclose(got, [10, 20, 20, 10, 20])
+
+    def test_shap_sums_to_raw_score(self):
+        df = cat_df(400)
+        m = LightGBMClassifier(numIterations=4, numLeaves=4,
+                               minDataInLeaf=5,
+                               categoricalSlotIndexes=[0]).fit(df)
+        from mmlspark_tpu.lightgbm.shap import booster_shap_values
+        x = np.asarray(df["features"])[:50]
+        shap = booster_shap_values(m.booster, x, x.shape[1])
+        raw = m.booster.raw_scores(x)
+        np.testing.assert_allclose(shap.sum(axis=-1), raw,
+                                   rtol=1e-3, atol=1e-3)
+
+    def test_sparse_categorical_raises(self):
+        from mmlspark_tpu.lightgbm.engine import TreeParams
+        from mmlspark_tpu.lightgbm.sparse import grow_tree_sparse
+        import jax.numpy as jnp
+        rng = np.random.default_rng(0)
+        idx = jnp.asarray(rng.integers(0, 8, size=(100, 4)), jnp.int32)
+        with pytest.raises(NotImplementedError, match="sparse"):
+            grow_tree_sparse(
+                idx, jnp.zeros((100, 4), jnp.int32),
+                jnp.zeros(8, jnp.int32), jnp.zeros(100), jnp.ones(100),
+                jnp.ones(8, bool), jnp.ones(100),
+                params=TreeParams(cat_features=(0,)), num_features=8,
+                num_bins=4)
+
+    def test_voting_categorical_raises(self):
+        df = cat_df(600)
+        with pytest.raises(NotImplementedError, match="voting"):
+            LightGBMClassifier(numIterations=2, numShards=2,
+                               parallelism="voting_parallel",
+                               categoricalSlotIndexes=[0]).fit(df)
+
+    def test_missing_goes_right_train_and_predict(self):
+        rng = np.random.default_rng(3)
+        cats = rng.integers(0, 6, size=1000).astype(np.float32)
+        cats[:200] = np.nan  # missing categories
+        y = np.isin(cats, [1, 4]).astype(np.float32)  # NaN -> False
+        df = DataFrame({"features": cats[:, None], "label": y})
+        m = LightGBMClassifier(numIterations=4, numLeaves=3,
+                               minDataInLeaf=5,
+                               categoricalSlotIndexes=[0]).fit(df)
+        # training-time routing (scores) and predict-time routing agree
+        assert _accuracy(m, df) > 0.98
+
+    def test_unseen_category_routes_right(self):
+        df = cat_df(800)
+        m = LightGBMClassifier(numIterations=4, numLeaves=2,
+                               minDataInLeaf=5,
+                               categoricalSlotIndexes=[0]).fit(df)
+        x = np.asarray([[500.0, 0.0], [-3.0, 0.0], [2.5, 0.0]],
+                       np.float32)  # unseen / negative / non-integer
+        probs = m.booster.transform_scores(m.booster.raw_scores(x))
+        # all must take the "right" (not-in-set) branch = class 0 here
+        assert (probs < 0.5).all(), probs
+
+    def test_category_id_over_budget_raises(self):
+        rng = np.random.default_rng(1)
+        cats = rng.integers(0, 10, size=300).astype(np.float32)
+        cats[0] = 9999.0
+        df = DataFrame({"features": cats[:, None],
+                        "label": (cats % 2).astype(np.float32)})
+        with pytest.raises(ValueError, match="max_bin"):
+            LightGBMClassifier(numIterations=2,
+                               categoricalSlotIndexes=[0]).fit(df)

@@ -76,3 +76,13 @@ def test_save_load_roundtrip(tmp_path, token_df, dense_features):
     out = loaded.transform(token_df)
     np.testing.assert_allclose(np.stack(list(out["features"])),
                                dense_features, atol=1e-5)
+
+
+def test_empty_document_embeds_to_zeros():
+    rows = np.empty(2, object)
+    rows[:] = [[], [5, 6, 7]]
+    out = TextEncoderFeaturizer(width=64, depth=1).transform(
+        DataFrame({"tokens": rows}))
+    f = np.stack(list(out["features"]))
+    assert np.isfinite(f).all()
+    np.testing.assert_allclose(f[0], 0.0)
