@@ -91,6 +91,21 @@ class FeaturizeModel(Model, HasInputCols, HasOutputCol):
     def feature_dim(self) -> int:
         return sum(spec["width"] for spec in self.getEncodingPlan())
 
+    def slot_names(self) -> list[str]:
+        """Per-slot names of the assembled vector (reference: ML attribute
+        names on the assembled column) — lets downstream consumers
+        resolve names to slots (e.g. ``categoricalSlotNames``)."""
+        names: list[str] = []
+        for spec in self.getEncodingPlan():
+            col, w = spec["col"], spec["width"]
+            if spec["kind"] == "onehot":
+                names.extend(f"{col}_{lvl}" for lvl in spec["levels"])
+            elif w == 1:
+                names.append(col)
+            else:
+                names.extend(f"{col}_{i}" for i in range(w))
+        return names
+
     def _transform(self, df):
         n = df.num_rows
         blocks = []
@@ -135,5 +150,8 @@ class FeaturizeModel(Model, HasInputCols, HasOutputCol):
                 raise ValueError(f"unknown encoding kind {kind!r}")
         features = np.concatenate(blocks, axis=1) if blocks else \
             np.zeros((n, 0), dtype=np.float32)
-        return df.with_column(self.getOutputCol(),
-                              np.ascontiguousarray(features))
+        out = df.with_column(self.getOutputCol(),
+                             np.ascontiguousarray(features))
+        from ..core import ColumnMetadata
+        return ColumnMetadata.attach(out, self.getOutputCol(),
+                                     {"slot_names": self.slot_names()})

@@ -161,8 +161,11 @@ def grow_tree(bins: jnp.ndarray, grad: jnp.ndarray, hess: jnp.ndarray,
             "categorical splits + voting_parallel are not supported "
             "together; use parallelism='data_parallel'")
     if has_cat:
+        # sorted order is load-bearing: the apply phase maps f_star back
+        # to its compact column via searchsorted
+        cat_features = tuple(sorted(set(p.cat_features)))
         cat_feat_mask = jnp.zeros(F, bool).at[
-            jnp.asarray(p.cat_features, jnp.int32)].set(True)
+            jnp.asarray(cat_features, jnp.int32)].set(True)
 
     g = grad * row_mask
     h = hess * row_mask
@@ -292,28 +295,31 @@ def grow_tree(bins: jnp.ndarray, grad: jnp.ndarray, hess: jnp.ndarray,
             # sort the leaf's category bins by gradient/hessian ratio and
             # scan the SORTED order like an ordinal feature; position b
             # then means "the b+1 best-ratio categories go left"
-            # (category_feature_encoder in the native core)
+            # (category_feature_encoder in the native core). Only the
+            # categorical COLUMNS pay for the sort + second scan: the
+            # [L, Fc, B, 3] slice is gathered, scanned, and the stats
+            # scattered back.
+            cat_idx = jnp.asarray(cat_features, jnp.int32)
+            cat_hist = search[:, cat_idx]                  # [L, Fc, B, 3]
             ratio = jnp.where(
-                search[..., 2] > 0,
-                search[..., 0] / (search[..., 1] + p.cat_smooth),
+                cat_hist[..., 2] > 0,
+                cat_hist[..., 0] / (cat_hist[..., 1] + p.cat_smooth),
                 jnp.inf)                       # empty bins sort last
             # the missing bin (0) must never enter a left set: predict
             # and SHAP send missing right unconditionally (LightGBM's
             # "NaN is in no bitset"), so training must match
             ratio = ratio.at[..., 0].set(jnp.inf)
-            cat_order = jnp.argsort(ratio, axis=-1)       # [L, F, B]
+            cat_order_c = jnp.argsort(ratio, axis=-1)      # [L, Fc, B]
             sorted_hist = jnp.take_along_axis(
-                search, cat_order[..., None], axis=-2)
-            glc, hlc, clc, grc, hrc, crc, gainc = _split_stats(
-                sorted_hist, p)
-            cm = cat_feat_mask[None, :, None]
-            gl = jnp.where(cm, glc, gl)
-            hl = jnp.where(cm, hlc, hl)
-            cl = jnp.where(cm, clc, cl)
-            gr = jnp.where(cm, grc, gr)
-            hr = jnp.where(cm, hrc, hr)
-            cr = jnp.where(cm, crc, cr)
-            gain = jnp.where(cm, gainc, gain)
+                cat_hist, cat_order_c[..., None], axis=-2)
+            cstats = _split_stats(sorted_hist, p)
+            gl = gl.at[:, cat_idx].set(cstats[0])
+            hl = hl.at[:, cat_idx].set(cstats[1])
+            cl = cl.at[:, cat_idx].set(cstats[2])
+            gr = gr.at[:, cat_idx].set(cstats[3])
+            hr = hr.at[:, cat_idx].set(cstats[4])
+            cr = cr.at[:, cat_idx].set(cstats[5])
+            gain = gain.at[:, cat_idx].set(cstats[6])
         if voting:
             feat_ok = feature_mask[state["cand_feat"]][:, :, None]
         else:
@@ -351,9 +357,14 @@ def grow_tree(bins: jnp.ndarray, grad: jnp.ndarray, hess: jnp.ndarray,
         in_parent = (state["slot"] == s_star) & found
         if has_cat:
             is_cat = cat_feat_mask[f_star]
-            # rank of each bin in the chosen (slot, feature)'s ratio sort;
-            # left = the b_star+1 best-ratio categories
-            order_star = cat_order[s_star, f_star]        # [B]
+            # rank of each bin in the chosen (slot, feature)'s ratio
+            # sort; left = the b_star+1 best-ratio categories. f_star
+            # maps into the compact categorical column index (position
+            # of f_star within cat_features; 0 when not categorical —
+            # unused then, guarded by is_cat)
+            f_star_c = jnp.searchsorted(cat_idx, f_star)
+            f_star_c = jnp.clip(f_star_c, 0, cat_idx.shape[0] - 1)
+            order_star = cat_order_c[s_star, f_star_c]    # [B]
             rank = jnp.zeros(B, jnp.int32).at[order_star].set(
                 jnp.arange(B, dtype=jnp.int32))
             left_set = is_cat & (rank <= b_star)          # bool [B]

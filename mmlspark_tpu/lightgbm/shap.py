@@ -123,8 +123,10 @@ def tree_shap_values(arrays: dict, t: int, x: np.ndarray,
             xv = row[f]
             if cat_flag is not None and cat_flag[node]:
                 # categorical: membership of the raw category's bin
-                # (identity binning: category c -> bin c+1)
-                if np.isnan(xv):
+                # (identity binning: category c -> bin c+1); mirrors
+                # _predict_leaf_nodes exactly — non-integer, negative,
+                # out-of-range, and missing all go right
+                if np.isnan(xv) or xv < 0 or xv != np.floor(xv):
                     goes_left = False
                 else:
                     b = int(xv) + 1

@@ -50,6 +50,26 @@ class TestLightGBMBenchmarks:
             b.add(f"synthetic.{boosting}", auc, 0.015)
         b.verify(regenerate=REGEN)
 
+    def test_categorical_auc(self):
+        b = Benchmarks(os.path.join(
+            RESOURCE_DIR, "benchmarks_LightGBMCategorical.csv"))
+        rng = np.random.default_rng(5)
+        n = 2500
+        cats = rng.integers(0, 16, size=n).astype(np.float32)
+        num = rng.normal(size=(n, 3)).astype(np.float32)
+        margin = (np.isin(cats, [1, 4, 7, 12]) * 2.0 - 1.0
+                  + num[:, 0] + 0.3 * rng.normal(size=n))
+        y = (margin > 0).astype(np.float32)
+        x = np.concatenate([cats[:, None], num], axis=1)
+        df = DataFrame({"features": x, "label": y})
+        for mode, kw in (("set_split", {"categoricalSlotIndexes": [0]}),
+                         ("ordinal", {})):
+            model = LightGBMClassifier(numIterations=40, numLeaves=15,
+                                       numShards=1, seed=0, **kw).fit(df)
+            auc = roc_auc(y, model.transform(df)["probability"][:, 1])
+            b.add(f"categorical.{mode}", auc, 0.015)
+        b.verify(regenerate=REGEN)
+
     def test_regressor_rmse(self):
         b = Benchmarks(os.path.join(RESOURCE_DIR,
                                     "benchmarks_LightGBMRegressor.csv"))

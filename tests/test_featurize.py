@@ -133,3 +133,22 @@ def test_page_splitter():
     pages = out["pages"][0]
     assert all(len(p) <= 120 for p in pages)
     assert "".join(pages) == "word " * 100
+
+
+def test_featurize_emits_slot_names_metadata():
+    """The assembled vector carries per-slot names so downstream stages
+    can resolve names to slots (e.g. LightGBM categoricalSlotNames)."""
+    import numpy as np
+    from mmlspark_tpu.core import ColumnMetadata, DataFrame
+    from mmlspark_tpu.featurize import Featurize
+
+    df = DataFrame({
+        "age": np.asarray([20.0, 30.0, 40.0], np.float32),
+        "city": np.asarray(["a", "b", "a"], object),
+    })
+    model = Featurize(inputCols=["age", "city"]).fit(df)
+    out = model.transform(df)
+    meta = ColumnMetadata.get(out, "features")
+    assert meta and meta["slot_names"][0] == "age"
+    assert any(nm.startswith("city_") for nm in meta["slot_names"])
+    assert len(meta["slot_names"]) == out["features"].shape[1]
