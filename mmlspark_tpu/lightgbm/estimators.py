@@ -91,6 +91,10 @@ class _LightGBMBase(Estimator, LightGBMSharedParams):
 
     def _fit(self, df):
         df = self._preprocess(df)
+        # resolve name->slot via metadata BEFORE partitioning: derived
+        # frames carry metadata, but resolving once here also covers
+        # callers that hand-build partitions
+        self._resolved_cat_slots = self._categorical_slots(df)
         num_batches = self.getNumBatches()
         if num_batches and num_batches > 1:
             parts = df.repartition(num_batches).partitions()
@@ -146,8 +150,11 @@ class _LightGBMBase(Estimator, LightGBMSharedParams):
                                   np.float32)
                        if self.isSet("initScoreCol") else None)
 
+        cat_slots = getattr(self, "_resolved_cat_slots", None)
+        if cat_slots is None:
+            cat_slots = self._categorical_slots(df)
         cfg = TrainConfig(**self._train_config_kwargs(),
-                          categorical_features=self._categorical_slots(df),
+                          categorical_features=cat_slots,
                           **self._objective_config(y))
         names = self.getSlotNames() or (
             None if sparse else

@@ -126,7 +126,8 @@ def tree_shap_values(arrays: dict, t: int, x: np.ndarray,
                 # (identity binning: category c -> bin c+1); mirrors
                 # _predict_leaf_nodes exactly — non-integer, negative,
                 # out-of-range, and missing all go right
-                if np.isnan(xv) or xv < 0 or xv != np.floor(xv):
+                if (not np.isfinite(xv)) or xv < 0 \
+                        or xv != np.floor(xv):
                     goes_left = False
                 else:
                     b = int(xv) + 1

@@ -137,16 +137,19 @@ def ring_attention(q, k, v, *, axis: str = "sp", causal: bool = False,
     return acc / jnp.maximum(l, 1e-35)[..., None]
 
 
-def make_ring_attention(mesh, *, causal: bool = False, axis: str = "sp"):
+def make_ring_attention(mesh, *, causal: bool = False, axis: str = "sp",
+                        batch_axis: str | None = None):
     """shard_map-wrapped ring attention: [B, H, T, D] sharded on T over
-    ``axis``. The returned fn is ``fn(q, k, v, key_mask=None)`` with
-    ``key_mask`` [B, T] bool (True = valid key)."""
+    ``axis`` (and optionally on B over ``batch_axis`` — 2D data x
+    sequence parallelism; the ring runs independently per batch shard).
+    The returned fn is ``fn(q, k, v, key_mask=None)`` with ``key_mask``
+    [B, T] bool (True = valid key)."""
     from jax.sharding import PartitionSpec as P
-    spec = P(None, None, axis, None)
+    spec = P(batch_axis, None, axis, None)
 
     @functools.partial(
         jax.shard_map, mesh=mesh,
-        in_specs=(spec, spec, spec, P(None, axis)), out_specs=spec,
+        in_specs=(spec, spec, spec, P(batch_axis, axis)), out_specs=spec,
         check_vma=False)
     def mapped(q, k, v, kmask):
         return ring_attention(q, k, v, axis=axis, causal=causal,

@@ -26,12 +26,14 @@ from .ring_attention import blockwise_attention
 def make_ulysses_attention(mesh: Mesh, axis: str = "sp", *,
                            causal: bool = False,
                            scale: float | None = None,
-                           block_size: int = 512):
+                           block_size: int = 512,
+                           batch_axis: str | None = None):
     """Build an all-to-all sequence-parallel attention fn over ``mesh``.
 
     Inputs/outputs are [B, H, T, D] arrays sequence-sharded over ``axis``
-    (each device holds T/d of the sequence). H must be divisible by the
-    axis size.
+    (each device holds T/d of the sequence), optionally batch-sharded
+    over ``batch_axis`` (2D data x sequence parallelism). H must be
+    divisible by the axis size.
     """
     d = int(mesh.shape[axis])
 
@@ -71,9 +73,10 @@ def make_ulysses_attention(mesh: Mesh, axis: str = "sp", *,
                                   key_mask=full_mask)
         return heads_to_seq(out)
 
-    spec = P(None, None, axis, None)
+    spec = P(batch_axis, None, axis, None)
     mapped = jax.jit(jax.shard_map(
-        local, mesh=mesh, in_specs=(spec, spec, spec, P(None, axis)),
+        local, mesh=mesh,
+        in_specs=(spec, spec, spec, P(batch_axis, axis)),
         out_specs=spec, check_vma=False))
 
     @jax.jit
