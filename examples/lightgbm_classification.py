@@ -29,4 +29,18 @@ assert auc > 0.9, auc
 gbm = model.getStages()[-1]
 text = gbm.get_native_model_string()
 assert "split_feature=" in text
+
+# categorical set-splits: index the string column to integer category
+# ids and mark the slot categorical (docs/lightgbm.md "Categorical
+# features")
+levels = sorted(set(cat))
+color_idx = np.asarray([levels.index(c) for c in cat], np.float32)
+df_cat = DataFrame({"features": np.concatenate(
+    [color_idx[:, None], x], axis=1), "label": y})
+cat_model = LightGBMClassifier(numIterations=25, numLeaves=15,
+                               minDataInLeaf=5,
+                               categoricalSlotIndexes=[0]).fit(df_cat)
+cat_text = cat_model.get_native_model_string()
+import re
+assert re.search(r"num_cat=[1-9]", cat_text), "no categorical splits"
 done("lightgbm_classification")

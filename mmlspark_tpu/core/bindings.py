@@ -135,9 +135,13 @@ class ColumnMetadata:
 
     @classmethod
     def carry(cls, src: DataFrame, dst: DataFrame) -> DataFrame:
-        """Propagate metadata for every column dst kept from src."""
-        store = {c: m for c, m in getattr(src, cls._KEY, {}).items()
-                 if c in dst.columns}
+        """Propagate metadata for every column dst kept UNCHANGED from
+        src: a column whose array was replaced (same name, different
+        object) drops its metadata — stale slot_names silently resolving
+        against a rebuilt column would be worse than none."""
+        store = {c: dict(m) for c, m in getattr(src, cls._KEY, {}).items()
+                 if c in dst.columns
+                 and dst._data.get(c) is src._data.get(c)}
         if store:
             setattr(dst, cls._KEY, {**getattr(dst, cls._KEY, {}), **store})
         return dst

@@ -385,13 +385,11 @@ class DataFrame:
         out = DataFrame.__new__(DataFrame)
         out._data = data
         out.num_partitions = self.num_partitions
-        # column metadata (bindings.ColumnMetadata) rides along for the
-        # columns that survive the derivation
-        meta = getattr(self, "__column_metadata__", None)
-        if meta:
-            keep = {c: dict(m) for c, m in meta.items() if c in data}
-            if keep:
-                setattr(out, "__column_metadata__", keep)
+        # column metadata rides along for columns that survive the
+        # derivation unchanged (ColumnMetadata.carry drops metadata for
+        # replaced arrays — stale metadata must not resolve)
+        from .bindings import ColumnMetadata
+        ColumnMetadata.carry(self, out)
         return out
 
     # ------------------------------------------------------------------ repr

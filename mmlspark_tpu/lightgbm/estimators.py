@@ -94,7 +94,7 @@ class _LightGBMBase(Estimator, LightGBMSharedParams):
         # resolve name->slot via metadata BEFORE partitioning: derived
         # frames carry metadata, but resolving once here also covers
         # callers that hand-build partitions
-        self._resolved_cat_slots = self._categorical_slots(df)
+        cat_slots = self._categorical_slots(df)
         num_batches = self.getNumBatches()
         if num_batches and num_batches > 1:
             parts = df.repartition(num_batches).partitions()
@@ -106,13 +106,15 @@ class _LightGBMBase(Estimator, LightGBMSharedParams):
             booster = Booster.load_native(self.getModelString())
         result = None
         for part in parts:
-            result = self._fit_batch(part, init_booster=booster)
+            result = self._fit_batch(part, init_booster=booster,
+                                      cat_slots=cat_slots)
             booster = result.booster
         model = self._make_model(booster, result)
         self._copy_params_to(model)
         return model
 
-    def _fit_batch(self, df, init_booster: Booster | None) -> TrainResult:
+    def _fit_batch(self, df, init_booster: Booster | None,
+                   cat_slots: tuple | None = None) -> TrainResult:
         from .sparse import SparseData
 
         # ---- split validation rows (reference validationIndicatorCol)
@@ -150,7 +152,6 @@ class _LightGBMBase(Estimator, LightGBMSharedParams):
                                   np.float32)
                        if self.isSet("initScoreCol") else None)
 
-        cat_slots = getattr(self, "_resolved_cat_slots", None)
         if cat_slots is None:
             cat_slots = self._categorical_slots(df)
         cfg = TrainConfig(**self._train_config_kwargs(),

@@ -177,24 +177,16 @@ class TestCategoricalSplits:
             LightGBMClassifier(numIterations=2,
                                categoricalSlotIndexes=[0]).fit(df)
 
-    def test_slot_names_via_featurize_metadata(self):
-        """Featurize attaches slot_names metadata; categoricalSlotNames
-        resolves through it even across derived frames (numBatches
-        partitions the frame before fitting)."""
-        from mmlspark_tpu.featurize import Featurize
+    def test_slot_names_via_column_metadata(self):
+        """categoricalSlotNames resolves through the features column's
+        slot_names metadata, and the metadata survives derived frames
+        (repartition; numBatches partitions the frame before fitting)."""
         rng = np.random.default_rng(7)
         n = 1200
         color = rng.choice(list("abcdefgh"), size=n)
         num = rng.normal(size=n).astype(np.float32)
         left = np.isin(color, list("adf"))
         y = (left ^ (num > 1.0)).astype(np.float32)
-        df = DataFrame({"color": color.astype(object), "num": num,
-                        "label": y})
-        fz = Featurize(inputCols=["color", "num"],
-                       oneHotEncodeCategoricals=False,
-                       maxOneHotCardinality=0).fit(df)
-        # hashing would scatter categories; use ValueIndexer-style ints
-        # instead: index the color column manually
         levels = sorted(set(color))
         idx = np.asarray([levels.index(c) for c in color], np.float32)
         df2 = DataFrame({"features": np.stack([idx, num], 1), "label": y})
