@@ -135,16 +135,30 @@ class ColumnMetadata:
 
     @classmethod
     def carry(cls, src: DataFrame, dst: DataFrame) -> DataFrame:
-        """Propagate metadata for every column dst kept UNCHANGED from
-        src: a column whose array was replaced (same name, different
-        object) drops its metadata — stale slot_names silently resolving
-        against a rebuilt column would be worse than none."""
+        """Propagate metadata for every column dst kept from src.
+
+        Row-subset derivations (filter/take/sample/split) keep per-column
+        schema metadata valid, so propagation is by NAME; the one
+        invalidating operation — replacing a column's values under the
+        same name — is handled where it happens
+        (``DataFrame.with_column`` calls :meth:`invalidate`). Stale
+        slot_names silently resolving against a rebuilt column would be
+        worse than none."""
         store = {c: dict(m) for c, m in getattr(src, cls._KEY, {}).items()
-                 if c in dst.columns
-                 and dst._data.get(c) is src._data.get(c)}
+                 if c in dst.columns}
         if store:
             setattr(dst, cls._KEY, {**getattr(dst, cls._KEY, {}), **store})
         return dst
+
+    @classmethod
+    def invalidate(cls, df: DataFrame, col: str) -> DataFrame:
+        """Drop ``col``'s metadata (its values were replaced)."""
+        store = getattr(df, cls._KEY, None)
+        if store and col in store:
+            store = dict(store)
+            del store[col]
+            setattr(df, cls._KEY, store)
+        return df
 
     # categorical sugar (the reference's dominant metadata use)
     @classmethod

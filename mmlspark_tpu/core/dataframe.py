@@ -159,10 +159,17 @@ class DataFrame:
     def with_column(self, name: str, values: Any) -> "DataFrame":
         if callable(values) and not isinstance(values, np.ndarray):
             values = values(self)
+        replacing = name in self._data
         data = dict(self._data)
         data[name] = _normalize_column(
             values, self.num_rows if self._data else None)
-        return self._with_data(data)
+        out = self._with_data(data)
+        if replacing:
+            # replaced values invalidate the column's metadata (e.g.
+            # slot_names describing a rebuilt features matrix)
+            from .bindings import ColumnMetadata
+            ColumnMetadata.invalidate(out, name)
+        return out
 
     withColumn = with_column
 

@@ -152,3 +152,23 @@ def test_featurize_emits_slot_names_metadata():
     assert meta and meta["slot_names"][0] == "age"
     assert any(nm.startswith("city_") for nm in meta["slot_names"])
     assert len(meta["slot_names"]) == out["features"].shape[1]
+
+
+def test_column_metadata_carry_and_invalidation():
+    """Metadata survives row-subset ops (filter/take) but is dropped
+    when the column's values are replaced under the same name."""
+    import numpy as np
+    from mmlspark_tpu.core import ColumnMetadata, DataFrame
+
+    df = DataFrame({"f": np.arange(6, dtype=np.float32),
+                    "g": np.ones(6, np.float32)})
+    ColumnMetadata.attach(df, "f", {"slot_names": ["a"]})
+    filtered = df.filter(np.asarray([1, 0, 1, 1, 0, 1], bool))
+    assert ColumnMetadata.get(filtered, "f") == {"slot_names": ["a"]}
+    taken = filtered.take([0, 1])
+    assert ColumnMetadata.get(taken, "f") == {"slot_names": ["a"]}
+    added = taken.with_column("h", np.zeros(2, np.float32))
+    assert ColumnMetadata.get(added, "f") == {"slot_names": ["a"]}
+    replaced = added.with_column("f", np.zeros(2, np.float32))
+    assert ColumnMetadata.get(replaced, "f") is None
+    assert ColumnMetadata.get(added, "f") == {"slot_names": ["a"]}

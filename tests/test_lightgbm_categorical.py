@@ -193,8 +193,44 @@ class TestCategoricalSplits:
         from mmlspark_tpu.core import ColumnMetadata
         ColumnMetadata.attach(df2, "features",
                               {"slot_names": ["color", "num"]})
-        df2 = df2.repartition(3)  # metadata must survive derivation
+        # metadata must survive row-subset derivations and repartition
+        df2 = df2.filter(np.ones(n, bool)).repartition(3)
         m = LightGBMClassifier(numIterations=20, numLeaves=8,
                                minDataInLeaf=5, numBatches=2,
                                categoricalSlotNames=["color"]).fit(df2)
         assert _accuracy(m, df2) > 0.95
+
+    def test_ranker_with_categorical(self):
+        """lambdarank + categorical slot: the grad-override (fused) path
+        must thread cat splits like the plain objectives."""
+        from mmlspark_tpu.lightgbm import LightGBMRanker
+        rng = np.random.default_rng(11)
+        n_q, docs = 40, 8
+        n = n_q * docs
+        cat = rng.integers(0, 8, size=n).astype(np.float32)
+        num = rng.normal(size=(n, 2)).astype(np.float32)
+        rel = (np.isin(cat, [2, 5]) * 2 + (num[:, 0] > 0)).astype(
+            np.float32)
+        qid = np.repeat(np.arange(n_q), docs)
+        df = DataFrame({"features": np.concatenate([cat[:, None], num], 1),
+                        "label": rel, "query": qid})
+        m = LightGBMRanker(groupCol="query", numIterations=20,
+                           numLeaves=7, minDataInLeaf=3,
+                           categoricalSlotIndexes=[0]).fit(df)
+        scores = np.asarray(m.transform(df)["prediction"])
+        # mean within-query rank agreement between scores and relevance
+        agree = []
+        for q in range(n_q):
+            s_q = scores[qid == q]
+            r_q = rel[qid == q]
+            # concordant pair fraction
+            conc = tot = 0
+            for i in range(docs):
+                for j in range(i + 1, docs):
+                    if r_q[i] == r_q[j]:
+                        continue
+                    tot += 1
+                    conc += (s_q[i] - s_q[j]) * (r_q[i] - r_q[j]) > 0
+            if tot:
+                agree.append(conc / tot)
+        assert np.mean(agree) > 0.9, np.mean(agree)
