@@ -150,9 +150,18 @@ class Booster:
     def transform_scores(self, raw: np.ndarray) -> np.ndarray:
         if self.objective == "binary":
             return 1.0 / (1.0 + np.exp(-self.sigmoid * raw))
-        if self.objective in ("multiclass", "softmax", "multiclassova"):
+        if self.objective in ("multiclass", "softmax"):
             e = np.exp(raw - raw.max(axis=-1, keepdims=True))
             return e / e.sum(axis=-1, keepdims=True)
+        if self.objective == "multiclassova":
+            # per-class sigmoid, unnormalized — LightGBM MulticlassOVA
+            return 1.0 / (1.0 + np.exp(-self.sigmoid * raw))
+        if self.objective == "cross_entropy":
+            return 1.0 / (1.0 + np.exp(-raw))
+        if self.objective == "cross_entropy_lambda":
+            # native CrossEntropyLambda::ConvertOutput returns the
+            # intensity log1p(exp(score)), not a probability
+            return np.logaddexp(0.0, raw)
         if self.objective in ("poisson", "gamma", "tweedie"):
             return np.exp(raw)
         return raw
@@ -198,6 +207,9 @@ class Booster:
         names = self.feature_names or [f"Column_{i}" for i in range(F)]
         obj = {"binary": f"binary sigmoid:{self.sigmoid:g}",
                "multiclass": f"multiclass num_class:{self.num_class}",
+               "multiclassova": (f"multiclassova num_class:"
+                                 f"{self.num_class} "
+                                 f"sigmoid:{self.sigmoid:g}"),
                }.get(self.objective, self.objective)
         lines = [
             "tree", "version=v3", f"num_class={self.num_class}",

@@ -264,7 +264,8 @@ class _BoosterModelMixin:
 # ------------------------------------------------------------------ classifier
 class LightGBMClassifier(_LightGBMBase, HasRawPredictionCol,
                          HasProbabilityCol):
-    objective = Param("objective", "binary | multiclass", TC.toString,
+    objective = Param("objective", "binary | multiclass | multiclassova",
+                      TC.toString,
                       default="binary")
     isUnbalance = Param("isUnbalance", "auto-weight positive class",
                         TC.toBoolean, default=False)
@@ -349,7 +350,8 @@ class LightGBMClassificationModel(_BoosterModelMixin, Model,
 class LightGBMRegressor(_LightGBMBase):
     objective = Param("objective",
                       "regression | regression_l1 | huber | fair | poisson | "
-                      "quantile | mape | gamma | tweedie", TC.toString,
+                      "quantile | mape | gamma | tweedie | cross_entropy | "
+                      "cross_entropy_lambda", TC.toString,
                       default="regression")
     alpha = Param("alpha", "quantile level / huber delta", TC.toFloat,
                   default=0.9)

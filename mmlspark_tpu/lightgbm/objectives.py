@@ -111,12 +111,34 @@ def _multiclass(num_class):
     return gh
 
 
+_ALIASES = {
+    "regression_l2": "regression", "l2": "regression",
+    "mean_squared_error": "regression", "mse": "regression",
+    "regression_l1": "regression_l1", "l1": "regression_l1",
+    "mae": "regression_l1",
+    "softmax": "multiclass",
+    "multiclass_ova": "multiclassova", "ova": "multiclassova",
+    "ovr": "multiclassova",
+    "xentropy": "cross_entropy",
+    "xentlambda": "cross_entropy_lambda",
+}
+
+
+def canonical_objective(name: str) -> str:
+    """Map LightGBM objective aliases to one canonical name — resolved
+    ONCE (TrainConfig does it) so the booster's transform and the text
+    format always see canonical strings."""
+    return _ALIASES.get(name, name)
+
+
 # ----------------------------------------------------------------- factories
 def get_objective(name: str, *, num_class: int = 1, alpha: float = 0.9,
                   fair_c: float = 1.0, tweedie_variance_power: float = 1.5,
                   sigmoid: float = 1.0, pos_weight: float = 1.0,
                   boost_from_average: bool = True) -> Objective:
-    """Build the named objective. Names match LightGBM config strings."""
+    """Build the named objective. Names match LightGBM config strings
+    (aliases resolve via :func:`canonical_objective`)."""
+    name = canonical_objective(name)
 
     def const_init(value_fn):
         def init(y, w):
@@ -174,7 +196,7 @@ def get_objective(name: str, *, num_class: int = 1, alpha: float = 0.9,
         # Gradients are injected by the ranker (group-aware); the Objective
         # here only supplies init/transform semantics.
         return Objective(name, _l2, lambda y, w: 0.0, lambda s: s)
-    if name in ("multiclass", "softmax", "multiclassova"):
+    if name == "multiclass":
         def mc_init(y, w):
             counts = np.bincount(y.astype(np.int64),
                                  minlength=num_class).astype(np.float64)
@@ -183,6 +205,72 @@ def get_objective(name: str, *, num_class: int = 1, alpha: float = 0.9,
         return Objective(name, _multiclass(num_class), mc_init,
                          lambda s: jax.nn.softmax(s, axis=-1),
                          num_model_per_iter=num_class)
+    if name == "multiclassova":
+        # one-vs-all: K independent sigmoid binary objectives (LightGBM
+        # multiclass_objective.hpp MulticlassOVA) — per-class log-odds
+        # init, per-class sigmoid output (unnormalized, like LightGBM)
+        def ova_gh(scores, y, w):
+            onehot = jax.nn.one_hot(y.astype(jnp.int32), num_class)
+            p = _sigmoid(sigmoid * scores)
+            g = sigmoid * (p - onehot) * w[:, None]
+            h = sigmoid * sigmoid * p * (1.0 - p) * w[:, None]
+            return g, h
+
+        def ova_init(y, w):
+            if not boost_from_average:
+                return np.zeros(num_class)
+            counts = np.bincount(y.astype(np.int64),
+                                 minlength=num_class).astype(np.float64)
+            p = np.clip(counts / counts.sum(), 1e-12, 1.0 - 1e-12)
+            return np.log(p / (1.0 - p)) / sigmoid
+        return Objective(name, ova_gh, ova_init,
+                         lambda s: _sigmoid(sigmoid * s),
+                         num_model_per_iter=num_class)
+    if name == "cross_entropy":
+        # probabilistic labels in [0, 1] (LightGBM xentropy): identical
+        # gradients to binary but y enters as a probability
+        def xent_gh(scores, y, w):
+            p = _sigmoid(scores)
+            return (p - y) * w, p * (1.0 - p) * w
+
+        def xent_init(y, w):
+            if not boost_from_average:
+                return 0.0
+            p = float(np.clip(np.average(np.asarray(y, np.float64),
+                                         weights=w), 1e-12, 1 - 1e-12))
+            return float(np.log(p / (1 - p)))
+        return Objective(name, xent_gh, xent_init, _sigmoid)
+    if name == "cross_entropy_lambda":
+        # intensity-weighted cross entropy (LightGBM xentlambda):
+        # p = 1 - exp(-lambda) with lambda = log1p(exp(score)). The
+        # per-row gradients/hessians come from jax.grad — exact, no
+        # hand-derived formulas to get wrong.
+        def row_loss(s, y):
+            lam = jnp.logaddexp(0.0, s)
+            p = jnp.clip(1.0 - jnp.exp(-lam), 1e-12, 1.0 - 1e-12)
+            return -(y * jnp.log(p) + (1.0 - y) * jnp.log1p(-p))
+
+        d1 = jax.grad(row_loss)
+        d2 = jax.grad(d1)
+
+        def xlam_gh(scores, y, w):
+            g = jax.vmap(d1)(scores, y) * w
+            h = jnp.maximum(jax.vmap(d2)(scores, y), 1e-12) * w
+            return g, h
+
+        def xlam_init(y, w):
+            if not boost_from_average:
+                return 0.0
+            p = float(np.clip(np.average(np.asarray(y, np.float64),
+                                         weights=w), 1e-12, 1 - 1e-12))
+            # invert p = 1 - exp(-log1p(exp(s)))  =>  lambda = -log(1-p)
+            lam = -np.log1p(-p)
+            return float(np.log(np.expm1(lam))) if lam > 1e-12 else -30.0
+        # ConvertOutput parity with native CrossEntropyLambda: the
+        # predicted quantity is the INTENSITY lambda = log1p(exp(s)),
+        # not the probability 1 - exp(-lambda)
+        return Objective(name, xlam_gh, xlam_init,
+                         lambda s: jnp.logaddexp(0.0, s))
     raise ValueError(f"unknown objective {name!r}")
 
 

@@ -82,6 +82,10 @@ class TrainConfig:
     psum_axis: str | None = None
     fobj: Callable | None = None
 
+    def __post_init__(self):
+        from .objectives import canonical_objective
+        self.objective = canonical_objective(self.objective)
+
     def tree_params(self) -> TreeParams:
         # rf: trees are averaged, never shrunk (LightGBM rf.hpp forces
         # shrinkage_rate = 1; a shrunk average can't move the init score)
@@ -786,6 +790,25 @@ def _multi_logloss_dev(s, y, w):
     return -jnp.average(py, weights=w)
 
 
+@functools.partial(jax.jit, static_argnames=("sigmoid",))
+def _ova_logloss_dev(s, y, w, *, sigmoid):
+    """Mean per-class binary logloss with one-hot labels — the logloss
+    the multiclassova objective optimizes."""
+    K = s.shape[1]
+    onehot = jax.nn.one_hot(y.astype(jnp.int32), K)
+    p = jnp.clip(jax.nn.sigmoid(sigmoid * s), 1e-15, 1 - 1e-15)
+    ll = onehot * jnp.log(p) + (1 - onehot) * jnp.log1p(-p)
+    return -jnp.average(ll.sum(axis=1), weights=w)
+
+
+@jax.jit
+def _xentlambda_loss_dev(s, y, w):
+    lam = jnp.logaddexp(0.0, s)
+    p = jnp.clip(1.0 - jnp.exp(-lam), 1e-15, 1 - 1e-15)
+    return -jnp.average(y * jnp.log(p) + (1 - y) * jnp.log1p(-p),
+                        weights=w)
+
+
 def _eval_metric_device(name: str, scores, y, w, cfg: TrainConfig):
     """Metric computed ON DEVICE where supported; only the scalar crosses
     to host (VERDICT r1 weak #5: per-iteration np.asarray(scores) pulls).
@@ -800,12 +823,20 @@ def _eval_metric_device(name: str, scores, y, w, cfg: TrainConfig):
         return _binary_logloss_dev(scores, y, w, sigmoid=cfg.sigmoid)
     if name == "multi_logloss":
         return _multi_logloss_dev(scores, y, w)
+    if name == "ova_logloss":
+        return _ova_logloss_dev(scores, y, w, sigmoid=cfg.sigmoid)
+    if name == "xentlambda_loss":
+        return _xentlambda_loss_dev(scores, y, w)
     return None
 
 
 def _default_metric(objective: str) -> str:
     return {"binary": "auc", "multiclass": "multi_logloss",
-            "softmax": "multi_logloss", "lambdarank": "ndcg",
+            "softmax": "multi_logloss",
+            "multiclassova": "ova_logloss",
+            "cross_entropy": "binary_logloss",
+            "cross_entropy_lambda": "xentlambda_loss",
+            "lambdarank": "ndcg",
             "regression_l1": "mae"}.get(objective, "rmse")
 
 

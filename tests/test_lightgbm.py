@@ -283,3 +283,84 @@ def test_goss_on_device_learns():
     model = LightGBMClassifier(boostingType="goss", **small_params()).fit(df)
     out = model.transform(df)
     assert roc_auc(df["label"], out["probability"][:, 1]) > 0.9
+
+
+def test_multiclassova_objective():
+    """One-vs-all multiclass (LightGBM multiclassova): per-class sigmoid
+    models; accuracy comparable to softmax on separable data and
+    probabilities are per-class sigmoids (not a normalized softmax)."""
+    rng = np.random.default_rng(4)
+    n = 900
+    x = rng.normal(size=(n, 4)).astype(np.float32)
+    y = (np.argmax(x[:, :3], axis=1)).astype(np.float32)
+    df = DataFrame({"features": x, "label": y})
+    m = LightGBMClassifier(objective="multiclassova", numIterations=25,
+                           numLeaves=15, minDataInLeaf=5).fit(df)
+    out = m.transform(df)
+    acc = float((np.asarray(out["prediction"]) == y).mean())
+    assert acc > 0.9, acc
+    probs = np.asarray(out["probability"])
+    # unnormalized per-class sigmoids: rows need not sum to 1
+    assert probs.shape == (n, 3)
+    assert (probs > 0).all() and (probs < 1).all()
+
+
+def test_cross_entropy_objectives():
+    """Probabilistic labels in [0,1] (LightGBM xentropy/xentlambda):
+    predictions calibrate to the label probabilities."""
+    from mmlspark_tpu.lightgbm import LightGBMRegressor
+    rng = np.random.default_rng(5)
+    n = 1500
+    x = rng.normal(size=(n, 3)).astype(np.float32)
+    p_true = 1.0 / (1.0 + np.exp(-(1.5 * x[:, 0] - x[:, 1])))
+    y = p_true.astype(np.float32)  # soft labels
+    df = DataFrame({"features": x, "label": y})
+    for obj in ("cross_entropy", "cross_entropy_lambda"):
+        m = LightGBMRegressor(objective=obj, numIterations=60,
+                              numLeaves=15, minDataInLeaf=5).fit(df)
+        pred = np.asarray(m.transform(df)["prediction"])
+        assert (pred > 0).all()
+        if obj == "cross_entropy_lambda":
+            # native ConvertOutput parity: prediction is the intensity
+            # lambda; the probability is 1 - exp(-lambda)
+            pred = 1.0 - np.exp(-pred)
+        assert (pred < 1).all()
+        mae = float(np.mean(np.abs(pred - p_true)))
+        assert mae < 0.06, (obj, mae)
+
+
+def test_multiclassova_native_roundtrip():
+    rng = np.random.default_rng(6)
+    x = rng.normal(size=(400, 4)).astype(np.float32)
+    y = np.argmax(x[:, :3], axis=1).astype(np.float32)
+    df = DataFrame({"features": x, "label": y})
+    m = LightGBMClassifier(objective="multiclassova", numIterations=10,
+                           numLeaves=7, minDataInLeaf=5).fit(df)
+    text = m.get_native_model_string()
+    assert "multiclassova num_class:3" in text
+    re = Booster.load_native(text)
+    np.testing.assert_allclose(re.raw_scores(x), m.booster.raw_scores(x),
+                               rtol=1e-4, atol=1e-5)
+
+
+def test_multiclassova_validation_early_stopping():
+    """ova + validation used to crash (no default metric, K-column
+    scores fed to rmse); ova_logloss now drives early stopping."""
+    rng = np.random.default_rng(8)
+    n = 600
+    x = rng.normal(size=(n, 4)).astype(np.float32)
+    y = np.argmax(x[:, :3], axis=1).astype(np.float32)
+    isval = (np.arange(n) % 4 == 0)
+    df = DataFrame({"features": x, "label": y, "isVal": isval})
+    m = LightGBMClassifier(objective="multiclassova", numIterations=40,
+                           numLeaves=7, minDataInLeaf=5,
+                           validationIndicatorCol="isVal",
+                           earlyStoppingRound=3).fit(df)
+    out = m.transform(df)
+    assert float((np.asarray(out["prediction"]) == y).mean()) > 0.85
+    # alias canonicalization: 'ova' saves a loadable header
+    m2 = LightGBMClassifier(objective="ova", numIterations=5,
+                            numLeaves=7, minDataInLeaf=5).fit(
+        DataFrame({"features": x, "label": y}))
+    text = m2.get_native_model_string()
+    assert "multiclassova num_class:3" in text
