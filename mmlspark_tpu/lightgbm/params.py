@@ -128,6 +128,11 @@ class LightGBMLearnerParams:
                      "evaluate metrics every k iterations (k>1 removes the "
                      "per-iteration device sync; early stopping counts "
                      "evaluations)", TC.toInt, default=1)
+    scanChunk = Param("scanChunk",
+                      "boosting iterations fused into one device dispatch "
+                      "(lax.scan) when no validation/metrics/delegate "
+                      "observe per-iteration state; 1 disables", TC.toInt,
+                      default=8)
 
 
 class LightGBMSharedParams(LightGBMExecutionParams, LightGBMLearnerParams,
@@ -167,6 +172,7 @@ class LightGBMSharedParams(LightGBMExecutionParams, LightGBMLearnerParams,
             is_provide_training_metric=self.getIsProvideTrainingMetric(),
             verbosity=self.getVerbosity(),
             eval_freq=self.getEvalFreq(),
+            scan_chunk=self.getScanChunk(),
             sparse_max_bin=self.getMaxBinSparse(),
             parallelism=self.getParallelism(),
             top_k=self.getTopK(),

@@ -74,6 +74,8 @@ class TrainConfig:
     is_provide_training_metric: bool = False
     verbosity: int = -1
     eval_freq: int = 1             # evaluate every k iterations (de-sync)
+    scan_chunk: int = 8            # iterations fused per dispatch when
+                                   # nothing observes per-iteration state
     parallelism: str = "data_parallel"  # | voting_parallel (PV-Tree)
     top_k: int = 20                # voting: local nominations per shard
     categorical_features: tuple = ()  # slot indexes with set-based splits
@@ -457,8 +459,8 @@ def train(x: np.ndarray, y: np.ndarray, w: np.ndarray | None,
                     t, vbins, max_depth=cfg.num_leaves))(tree_b)
             return tree_b.leaf_value[arange_k[:, None], vleaf]
 
-        @jax.jit
-        def step(scores, vscores, feat_mask_dev, row_mask_dev, it_dev):
+        def step_impl(scores, vscores, feat_mask_dev, row_mask_dev,
+                      it_dev):
             # rf: gradients always at the constant init score (trees are
             # independent); gbdt/goss: at the running margin
             sfg = (jnp.zeros_like(scores) + base_const) if is_rf \
@@ -500,11 +502,83 @@ def train(x: np.ndarray, y: np.ndarray, w: np.ndarray | None,
             else:
                 new_vscores = vscores
             return new_scores, new_vscores, tree_b
-        return step
+
+        step = jax.jit(step_impl)
+
+        @jax.jit
+        def chunk_step(scores, vscores, feat_masks, row_masks, its):
+            """k boosting iterations as ONE dispatch: lax.scan over the
+            fused step. Used only when nothing observes per-iteration
+            state (no eval, no delegate) — a remote device pays a full
+            round trip per dispatch, so chunking divides that cost by
+            the chunk length."""
+            def body(carry, xs):
+                sc, vs = carry
+                fm, rm, it_d = xs
+                new_sc, new_vs, tree_b = step_impl(sc, vs, fm, rm, it_d)
+                return (new_sc, new_vs), tree_b
+            (sc, vs), tree_stack = jax.lax.scan(
+                body, (scores, vscores), (feat_masks, row_masks, its))
+            return sc, vs, tree_stack
+
+        return step, chunk_step
 
     use_fused = not is_dart  # dart's drop set is host-chosen per iter
-    fused_step = make_fused_step() if use_fused else None
-    for it in range(cfg.num_iterations):
+    fused_step = chunk_step = None
+    if use_fused:
+        fused_step, chunk_step = make_fused_step()
+
+    # ---- chunked fast path: scan cfg.scan_chunk iterations per dispatch
+    # when NOTHING observes per-iteration state — no eval/early stopping
+    # (no valid set, no training metric) and no delegate hooks. The host
+    # RNG calls (feature/bagging masks) happen in the same order as the
+    # per-iteration loop, so chunked and unchunked runs are identical.
+    chunk = max(int(cfg.scan_chunk), 1)
+    if (use_fused and chunk > 1 and delegate is None and valid is None
+            and not cfg.is_provide_training_metric):
+        it = 0
+        # only FULL chunks run through chunk_step: a partial tail would
+        # retrace/recompile the whole scan program for its odd shape,
+        # costing more than the dispatches it saves — the remainder runs
+        # on the per-iteration fused step instead
+        full_iters = (cfg.num_iterations // chunk) * chunk
+        while it < full_iters:
+            k = chunk
+            fms = np.ones((k, F), bool)
+            if cfg.feature_fraction < 1.0:
+                nf = max(1, int(round(cfg.feature_fraction * F)))
+                fms = np.zeros((k, F), bool)
+                for j in range(k):
+                    fms[j, rng.choice(F, size=nf, replace=False)] = True
+            if is_goss:
+                rms = jnp.broadcast_to(valid_mask_dev, (k, n))
+            elif (is_rf or cfg.bagging_freq > 0) \
+                    and cfg.bagging_fraction < 1.0:
+                rms_np = np.empty((k, n), np.float32)
+                for j in range(k):
+                    if is_rf or (it + j) % max(cfg.bagging_freq, 1) == 0:
+                        bag_mask = (bag_rng.random(n)
+                                    < cfg.bagging_fraction).astype(
+                                        np.float32)
+                    rms_np[j] = bag_mask * valid_mask_np
+                rms = jnp.asarray(rms_np)
+            else:
+                rms = jnp.broadcast_to(valid_mask_dev, (k, n))
+            its = jnp.asarray(
+                np.arange(it, it + k, dtype=np.int32))
+            scores, vscores, tree_stack = chunk_step(
+                scores, vscores, jnp.asarray(fms), rms, its)
+            trees.append(tree_stack)      # leaves [k, K, ...]
+            for _ in range(k):
+                for k_cls in range(K):
+                    tree_class.append(k_cls)
+                    tree_weights.append(1.0)
+            it += k
+        iter_range = range(full_iters, cfg.num_iterations)
+    else:
+        iter_range = range(cfg.num_iterations)
+
+    for it in iter_range:
         if delegate is not None:
             # rf averages unshrunk trees (tree_params forces lr=1); a
             # delegate LR schedule must not silently re-shrink them
@@ -513,7 +587,7 @@ def train(x: np.ndarray, y: np.ndarray, w: np.ndarray | None,
                 tp = tp._replace(learning_rate=float(lr))
                 grow, grow_multi = make_growers(tp)
                 if use_fused:
-                    fused_step = make_fused_step()
+                    fused_step, chunk_step = make_fused_step()
             delegate.before_train_iteration(it)
 
         # ---- dart: drop trees for gradient computation
@@ -684,15 +758,25 @@ def train(x: np.ndarray, y: np.ndarray, w: np.ndarray | None,
             delegate.after_train_iteration(it)
 
     if trees:
-        # trees holds one [K, ...] stack per iteration. ONE batched
+        # trees holds [K, ...] stacks (one per iteration) and/or
+        # [chunk, K, ...] stacks (one per scanned chunk). ONE batched
         # device→host pull for everything: device_get prefetches every
         # leaf asynchronously before blocking, so this costs ~one
         # round-trip rather than iterations × fields. (An eager
         # jnp.stack here would also re-enter the compiler per field —
         # and crashes on shard_map-produced leaves on CPU meshes.)
         host_stacks = jax.device_get(trees)
-        trees = [jax.tree.map(lambda a: a[k], stack)
-                 for stack in host_stacks for k in range(K)]
+        flat = []
+        for stack in host_stacks:
+            if np.ndim(stack.num_nodes) == 1:      # [K, ...]
+                flat.extend(jax.tree.map(lambda a, k=k: a[k], stack)
+                            for k in range(K))
+            else:                                  # [chunk, K, ...]
+                for t in range(stack.num_nodes.shape[0]):
+                    flat.extend(
+                        jax.tree.map(lambda a, t=t, k=k: a[t, k], stack)
+                        for k in range(K))
+        trees = flat
     booster = build_booster(trees, boundaries, cfg, base_score,
                             feature_names, np.asarray(tree_weights,
                                                       np.float32),

@@ -364,3 +364,22 @@ def test_multiclassova_validation_early_stopping():
         DataFrame({"features": x, "label": y}))
     text = m2.get_native_model_string()
     assert "multiclassova num_class:3" in text
+
+
+def test_scan_chunking_is_equivalent():
+    """scanChunk fuses k iterations into one dispatch; results must be
+    IDENTICAL to per-iteration dispatch (same host RNG order, same
+    fold_in keys) for gbdt, goss, and rf."""
+    df = classification_df(300, seed=3)
+    for mode, extra in (("gbdt", {}), ("goss", {}),
+                        ("rf", {"baggingFraction": 0.8, "baggingFreq": 1}),
+                        ("gbdt", {"featureFraction": 0.6})):
+        kw = dict(numIterations=11, numLeaves=7, minDataInLeaf=5,
+                  boostingType=mode, seed=7, **extra)
+        p1 = LightGBMClassifier(scanChunk=1, **kw).fit(df) \
+            .transform(df)["probability"]
+        p4 = LightGBMClassifier(scanChunk=4, **kw).fit(df) \
+            .transform(df)["probability"]
+        np.testing.assert_allclose(np.asarray(p4), np.asarray(p1),
+                                   rtol=1e-5, atol=1e-6,
+                                   err_msg=f"{mode} {extra}")
