@@ -85,6 +85,58 @@ class TestLightGBMBenchmarks:
         b.verify(regenerate=REGEN)
 
 
+class TestTrainBenchmarks:
+    """Reference benchmarks_VerifyTrainClassifier /
+    benchmarks_VerifyTuneHyperparameters analogs: the auto-featurizing
+    trainer across inner learners, and the random-search tuner."""
+
+    def test_train_classifier_learners(self):
+        from mmlspark_tpu.train import LogisticRegression, TrainClassifier
+        b = Benchmarks(os.path.join(RESOURCE_DIR,
+                                    "benchmarks_TrainClassifier.csv"))
+        rng = np.random.default_rng(9)
+        n = 1200
+        age = rng.normal(40, 12, n).astype(np.float32)
+        city = rng.choice(["a", "b", "c"], size=n).astype(object)
+        score = rng.normal(size=n).astype(np.float32)
+        y = ((age > 40) ^ (city == "b") ^ (score > 0.8)).astype(np.float32)
+        df = DataFrame({"age": age, "city": city, "score": score,
+                        "label": y})
+        learners = {
+            "lightgbm": LightGBMClassifier(
+                numIterations=30, numLeaves=15, minDataInLeaf=5, seed=0),
+            "lightgbm_rf": LightGBMClassifier(
+                boostingType="rf", baggingFraction=0.8, baggingFreq=1,
+                numIterations=30, numLeaves=15, minDataInLeaf=5, seed=0),
+            "logistic": LogisticRegression(maxIter=60),
+        }
+        for name, est in learners.items():
+            model = TrainClassifier(model=est, labelCol="label").fit(df)
+            pred = np.asarray(model.transform(df)["scored_labels"])
+            acc = float((pred == y).mean())
+            b.add(f"mixed.{name}", acc, 0.02)
+        b.verify(regenerate=REGEN)
+
+    def test_tune_hyperparameters_accuracy(self):
+        from mmlspark_tpu.automl import (HyperparamBuilder,
+                                         IntRangeHyperParam,
+                                         TuneHyperparameters)
+        b = Benchmarks(os.path.join(
+            RESOURCE_DIR, "benchmarks_TuneHyperparameters.csv"))
+        x, y, _ = tabular(n=800, seed=3)
+        df = DataFrame({"features": x, "label": y})
+        est = LightGBMClassifier(numIterations=15, minDataInLeaf=5,
+                                 seed=0)
+        space = HyperparamBuilder().addHyperparam(
+            est, "numLeaves", IntRangeHyperParam(4, 32)).build()
+        tuned = TuneHyperparameters(
+            models=[est], paramSpace=space, numFolds=3, numRuns=4,
+            evaluationMetric="accuracy", labelCol="label").fit(df)
+        b.add("synthetic.best_accuracy",
+              float(tuned.get("bestMetric")), 0.02)
+        b.verify(regenerate=REGEN)
+
+
 class TestVWBenchmarks:
     def test_classifier_auc(self):
         b = Benchmarks(os.path.join(
