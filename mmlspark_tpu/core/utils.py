@@ -128,6 +128,14 @@ def find_unused_column_name(prefix: str, df) -> str:
     return name
 
 
+def stable_sigmoid(x: np.ndarray) -> np.ndarray:
+    """Overflow-free logistic: exp is only ever taken of a non-positive
+    argument."""
+    x = np.asarray(x)
+    e = np.exp(-np.abs(x))
+    return np.where(x >= 0, 1.0 / (1.0 + e), e / (1.0 + e))
+
+
 def as_2d_features(df, features_col: str) -> np.ndarray:
     """Features column → dense float32 [n, d] matrix."""
     arr = df[features_col]

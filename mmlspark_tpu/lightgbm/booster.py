@@ -19,6 +19,8 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from ..core.utils import stable_sigmoid
+
 
 class Booster:
     """Stacked-tree GBDT model.
@@ -149,15 +151,15 @@ class Booster:
 
     def transform_scores(self, raw: np.ndarray) -> np.ndarray:
         if self.objective == "binary":
-            return 1.0 / (1.0 + np.exp(-self.sigmoid * raw))
+            return stable_sigmoid(self.sigmoid * raw)
         if self.objective in ("multiclass", "softmax"):
             e = np.exp(raw - raw.max(axis=-1, keepdims=True))
             return e / e.sum(axis=-1, keepdims=True)
         if self.objective == "multiclassova":
             # per-class sigmoid, unnormalized — LightGBM MulticlassOVA
-            return 1.0 / (1.0 + np.exp(-self.sigmoid * raw))
+            return stable_sigmoid(self.sigmoid * raw)
         if self.objective == "cross_entropy":
-            return 1.0 / (1.0 + np.exp(-raw))
+            return stable_sigmoid(raw)
         if self.objective == "cross_entropy_lambda":
             # native CrossEntropyLambda::ConvertOutput returns the
             # intensity log1p(exp(score)), not a probability

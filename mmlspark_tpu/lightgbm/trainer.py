@@ -26,6 +26,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from ..core.utils import stable_sigmoid
 from .binning import bin_features, compute_bin_boundaries, bin_upper_value
 from .booster import Booster
 from .engine import Tree, TreeParams, grow_tree, tree_route_bins
@@ -939,7 +940,7 @@ def eval_metric(name: str, raw_scores: np.ndarray, y: np.ndarray,
         p = raw_scores
         return roc_auc(y, p, w)
     if name == "binary_logloss":
-        p = 1 / (1 + np.exp(-cfg.sigmoid * raw_scores))
+        p = stable_sigmoid(cfg.sigmoid * raw_scores)
         p = np.clip(p, 1e-15, 1 - 1e-15)
         return float(-np.average(y * np.log(p) + (1 - y) * np.log(1 - p),
                                  weights=w))

@@ -24,7 +24,7 @@ from ..core import Estimator, Model, Param, TypeConverters as TC
 from ..core.contracts import (HasFeaturesCol, HasLabelCol, HasPredictionCol,
                               HasProbabilityCol, HasRawPredictionCol,
                               HasWeightCol)
-from ..core.utils import as_2d_features
+from ..core.utils import as_2d_features, stable_sigmoid
 
 
 class _LinearParams(HasFeaturesCol, HasLabelCol, HasPredictionCol,
@@ -182,7 +182,7 @@ class LogisticRegressionModel(Model, _LinearParams, HasProbabilityCol,
         if self.num_classes <= 2 and margin.shape[1] == 1:
             m = margin[:, 0]
             raw = np.stack([-m, m], axis=1)
-            p1 = 1.0 / (1.0 + np.exp(-m))
+            p1 = stable_sigmoid(m)
             prob = np.stack([1 - p1, p1], axis=1)
         else:
             raw = margin

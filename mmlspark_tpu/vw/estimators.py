@@ -16,6 +16,7 @@ from ..core import ComplexParam, Estimator, Model, Param, \
     TypeConverters as TC
 from ..core.contracts import (HasFeaturesCol, HasLabelCol, HasWeightCol,
                               HasProbabilityCol, HasRawPredictionCol)
+from ..core.utils import stable_sigmoid
 from .learner import VWConfig, VWModelState, train
 
 
@@ -148,7 +149,7 @@ class VowpalWabbitRegressionModel(Model, VowpalWabbitBaseParams):
         st: VWModelState = self.get("state")
         raw = st.predict_raw(idx, val)
         if st.config.link == "logistic":
-            raw = 1.0 / (1.0 + np.exp(-raw))
+            raw = stable_sigmoid(raw)
         return df.with_column(self.get("predictionCol"),
                               raw.astype(np.float32))
 
@@ -173,7 +174,7 @@ class VowpalWabbitClassificationModel(Model, VowpalWabbitBaseParams,
         idx, val = self._features(df)
         st: VWModelState = self.get("state")
         raw = st.predict_raw(idx, val)
-        prob1 = 1.0 / (1.0 + np.exp(-raw))
+        prob1 = stable_sigmoid(raw)
         probs = np.stack([1.0 - prob1, prob1], axis=1).astype(np.float32)
         pred = (prob1 >= self.get("thresholds")).astype(np.float32)
         return (df.with_column(self.getRawPredictionCol(),

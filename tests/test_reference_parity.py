@@ -1,0 +1,196 @@
+"""Real-dataset accuracy parity against the REFERENCE's committed values.
+
+Two independent oracles, neither derived from this engine:
+
+1. ``benchmarks_ReferenceParity.csv`` — expected values copied verbatim from
+   the reference's committed benchmark CSVs
+   (``/root/reference/src/test/resources/benchmarks/
+   benchmarks_VerifyLightGBMClassifier.csv`` rows 22-25,
+   ``benchmarks_VerifyTrainClassifier.csv`` breast-cancer rows), with the
+   reference's own precisions (``Benchmarks.scala:71-90`` semantics). The
+   dataset is sklearn's bundled UCI breast-cancer — the same dataset family
+   the reference fetches remotely. This file is NEVER regenerated from the
+   engine (``MMLSPARK_TPU_REGEN_BENCHMARKS`` is deliberately ignored).
+
+2. sklearn's independently-implemented HistGradientBoosting (the same
+   histogram-GBDT algorithm family as LightGBM) run at matched
+   hyperparameters at test time, for the datasets the reference's CSVs
+   cover only via its (offline-unreachable) blob store: multiclass
+   (digits/wine, mirroring BreastTissue/CarEvaluation in
+   ``verifyLearnerOnMulticlassCsvFile``) and regression RMSE (diabetes,
+   mirroring ``benchmarks_VerifyLightGBMRegressor.csv`` /
+   ``benchmarks_VerifyVowpalWabbitRegressor.csv``'s lower-is-better RMSE
+   pattern).
+"""
+
+import os
+
+import numpy as np
+import pytest
+from sklearn.datasets import (load_breast_cancer, load_diabetes,
+                              load_digits, load_wine)
+
+from mmlspark_tpu.core import DataFrame
+from mmlspark_tpu.lightgbm import LightGBMClassifier, LightGBMRegressor
+from mmlspark_tpu.lightgbm.trainer import roc_auc
+from mmlspark_tpu.testing import Benchmarks
+from mmlspark_tpu.train import LogisticRegression, TrainClassifier
+
+RESOURCE_DIR = os.path.join(os.path.dirname(__file__), "resources",
+                            "benchmarks")
+PARITY_CSV = os.path.join(RESOURCE_DIR, "benchmarks_ReferenceParity.csv")
+
+
+def pr_auc(y, p) -> float:
+    """Area under the precision-recall curve (Spark's ``areaUnderPR``
+    analog; trapezoid over recall at every ranked cut)."""
+    order = np.argsort(-np.asarray(p))
+    y = np.asarray(y)[order]
+    tp = np.cumsum(y)
+    prec = tp / np.arange(1, len(y) + 1)
+    rec = tp / max(tp[-1], 1)
+    return float(np.trapezoid(prec, rec))
+
+
+@pytest.fixture(scope="module")
+def breast_cancer():
+    d = load_breast_cancer()
+    return d.data.astype(np.float32), d.target.astype(np.float32)
+
+
+class TestReferenceCsvParity:
+    """Assert inside the reference's published tolerance bands."""
+
+    def test_lightgbm_boosting_modes(self, breast_cancer):
+        x, y = breast_cancer
+        df = DataFrame({"features": x, "label": y})
+        b = Benchmarks(PARITY_CSV)
+        for boosting in ("gbdt", "rf", "dart", "goss"):
+            kw = dict(boostingType=boosting, numIterations=10, numLeaves=5,
+                      numShards=1, seed=0)
+            if boosting == "rf":
+                # reference: model.setBaggingFraction(0.9).setBaggingFreq(1)
+                kw.update(baggingFraction=0.9, baggingFreq=1)
+            m = LightGBMClassifier(**kw).fit(df)
+            p = np.asarray(m.transform(df)["probability"][:, 1])
+            b.add(f"LightGBMClassifier_breast-cancer_{boosting}_AUROC",
+                  roc_auc(y, p), 0.1)
+        b.verify(regenerate=False)
+
+    def test_train_classifier_matrix(self, breast_cancer):
+        x, y = breast_cancer
+        df = DataFrame({f"f{i}": x[:, i] for i in range(x.shape[1])}
+                       | {"label": y})
+        learners = {
+            "GBT": LightGBMClassifier(numIterations=10, numLeaves=5,
+                                      seed=0),
+            "RandomForest": LightGBMClassifier(
+                boostingType="rf", baggingFraction=0.9, baggingFreq=1,
+                numIterations=10, numLeaves=5, seed=0),
+            "LogisticRegression": LogisticRegression(maxIter=100),
+        }
+        b = Benchmarks(PARITY_CSV)
+        for name, est in learners.items():
+            model = TrainClassifier(model=est, labelCol="label").fit(df)
+            p = np.asarray(model.transform(df)["probability"][:, 1])
+            b.add(f"TrainClassifier_{name}_breast-cancer_AUROC",
+                  roc_auc(y, p), 0.1)
+            if name != "GBT":  # GBT AUPR excluded — see CSV comment
+                b.add(f"TrainClassifier_{name}_breast-cancer_AUPR",
+                      pr_auc(y, p), 0.1)
+        b.verify(regenerate=False)
+
+    def test_parity_csv_never_regenerated(self, breast_cancer, monkeypatch):
+        """The regen escape hatch must not rewrite reference-sourced rows."""
+        monkeypatch.setenv("MMLSPARK_TPU_REGEN_BENCHMARKS", "1")
+        before = open(PARITY_CSV).read()
+        b = Benchmarks(PARITY_CSV)
+        b.add("LightGBMClassifier_breast-cancer_gbdt_AUROC", 0.5, 0.1)
+        with pytest.raises(AssertionError):
+            b.verify(regenerate=False)
+        assert open(PARITY_CSV).read() == before
+
+
+class TestSklearnOracleParity:
+    """Cross-check against sklearn's independent histogram-GBDT at matched
+    hyperparameters (same algorithm family as LightGBM; an engine bias that
+    a self-regenerated CSV would freeze in shows up here as a gap vs the
+    oracle)."""
+
+    def _oracle_clf(self, **kw):
+        from sklearn.ensemble import HistGradientBoostingClassifier
+        return HistGradientBoostingClassifier(
+            max_iter=20, max_leaf_nodes=15, learning_rate=0.1,
+            min_samples_leaf=20, early_stopping=False, **kw)
+
+    @pytest.mark.parametrize("loader", [load_digits, load_wine],
+                             ids=["digits", "wine"])
+    def test_multiclass_accuracy(self, loader):
+        d = loader()
+        x = d.data.astype(np.float32)
+        y = d.target.astype(np.float32)
+        oracle = self._oracle_clf().fit(x, y)
+        oracle_acc = float((oracle.predict(x) == y).mean())
+
+        df = DataFrame({"features": x, "label": y})
+        m = LightGBMClassifier(objective="multiclass", numIterations=20,
+                               numLeaves=15, minDataInLeaf=20,
+                               numShards=1, seed=0).fit(df)
+        pred = np.asarray(m.transform(df)["prediction"])
+        acc = float((pred == y).mean())
+        assert acc >= oracle_acc - 0.03, \
+            f"ours {acc:.4f} vs sklearn oracle {oracle_acc:.4f}"
+
+    def test_regression_rmse(self):
+        from sklearn.ensemble import HistGradientBoostingRegressor
+        d = load_diabetes()
+        x = d.data.astype(np.float32)
+        y = d.target.astype(np.float32)
+        oracle = HistGradientBoostingRegressor(
+            max_iter=40, max_leaf_nodes=15, learning_rate=0.1,
+            min_samples_leaf=20, early_stopping=False).fit(x, y)
+        oracle_rmse = float(np.sqrt(np.mean((oracle.predict(x) - y) ** 2)))
+
+        df = DataFrame({"features": x, "label": y})
+        m = LightGBMRegressor(objective="regression", numIterations=40,
+                              numLeaves=15, minDataInLeaf=20,
+                              numShards=1, seed=0).fit(df)
+        pred = np.asarray(m.transform(df)["prediction"])
+        rmse = float(np.sqrt(np.mean((pred - y) ** 2)))
+        assert rmse <= oracle_rmse * 1.15, \
+            f"ours {rmse:.3f} vs sklearn oracle {oracle_rmse:.3f}"
+
+    def test_vw_regressor_real_data(self):
+        """VerifyVowpalWabbitRegressor pattern: RMSE on a real regression
+        dataset, bounded by an independent linear-SGD oracle."""
+        from sklearn.linear_model import SGDRegressor
+        from mmlspark_tpu.vw import VowpalWabbitRegressor
+        d = load_diabetes()
+        x = d.data.astype(np.float32)
+        y = d.target.astype(np.float32)
+        y_c = y - y.mean()
+        oracle = SGDRegressor(max_iter=40, tol=None, random_state=0,
+                              learning_rate="invscaling").fit(x, y_c)
+        oracle_rmse = float(np.sqrt(np.mean((oracle.predict(x) - y_c) ** 2)))
+
+        df = DataFrame({"features": x, "label": y_c})
+        m = VowpalWabbitRegressor(numPasses=40, batchSize=64,
+                                  numShards=1).fit(df)
+        pred = np.asarray(m.transform(df)["prediction"])
+        rmse = float(np.sqrt(np.mean((pred - y_c) ** 2)))
+        assert rmse <= oracle_rmse * 1.25, \
+            f"ours {rmse:.3f} vs SGD oracle {oracle_rmse:.3f}"
+
+    def test_vw_classifier_real_data(self):
+        from mmlspark_tpu.vw import VowpalWabbitClassifier
+        d = load_breast_cancer()
+        x = d.data.astype(np.float32)
+        # VW is scale-sensitive (like the real VW without --normalized):
+        # standardize, as the reference pipelines do upstream of VW.
+        x = (x - x.mean(0)) / (x.std(0) + 1e-8)
+        y = d.target.astype(np.float32)
+        df = DataFrame({"features": x, "label": y})
+        m = VowpalWabbitClassifier(numPasses=8, batchSize=64,
+                                   numShards=1).fit(df)
+        p = np.asarray(m.transform(df)["probability"][:, 1])
+        assert roc_auc(y, p) >= 0.97
