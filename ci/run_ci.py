@@ -39,7 +39,7 @@ PACKAGES: dict[str, list[str]] = {
     "learners": ["test_learners.py", "test_linear.py",
                  "test_recommendation_lime.py", "test_cyber.py"],
     "io": ["test_native_codegen.py", "test_benchmarks.py",
-           "test_ci.py"],
+           "test_reference_parity.py", "test_ci.py"],
 }
 
 

@@ -58,6 +58,9 @@ class TrainConfig:
     max_drop: int = 50             # dart
     skip_drop: float = 0.5         # dart
     uniform_drop: bool = False     # dart (parity; sampling is uniform)
+    dart_mode: str = "fused"       # fused: one dispatch/iter with device
+                                   # delta buffers; stepwise: the reference
+                                   # semantics oracle (host-applied drops)
     sparse_max_bin: int = 16       # bin cap for the padded-COO path
     num_class: int = 1
     sigmoid: float = 1.0
@@ -107,10 +110,40 @@ class TrainConfig:
             cat_smooth=self.cat_smooth)
 
 
-def _apply_delta(scores, delta, k_cls: int, K: int):
-    if K == 1:
-        return scores + delta
-    return scores.at[:, k_cls].add(delta)
+def _score_update(c, d, coeff, cls):
+    """`c += coeff·d` (into class column ``cls`` when c is [n, K]).
+
+    The ONE arithmetic shape for every DART score update, used inline by
+    the fused step and via the jitted ``_apply_weighted`` by the stepwise
+    oracle: XLA/LLVM contract the mul+add into an FMA, so eager two-op
+    updates round differently — sharing the compiled expression is what
+    makes the two paths bit-comparable."""
+    upd = d * coeff
+    if c.ndim == 1:
+        return c + upd
+    return c.at[:, cls].add(upd)
+
+
+_apply_weighted = jax.jit(_score_update)
+
+
+def _dart_drop_set(rng, cfg: TrainConfig, n_flat: int) -> list[int]:
+    """Host-side DART drop-set draw (LightGBM DartBooster::DroppingTrees):
+    skip with probability skip_drop, else drop round(drop_rate·n) of the
+    standing trees, capped at max_drop, uniformly without replacement.
+    Shared by the stepwise and fused paths so both consume the identical
+    RNG sequence — the fused path's bit-match guarantee starts here."""
+    if n_flat == 0 or rng.random() < cfg.skip_drop:
+        return []
+    k_drop = min(cfg.max_drop, max(1, int(round(cfg.drop_rate * n_flat))))
+    return sorted(rng.choice(n_flat, size=min(k_drop, n_flat),
+                             replace=False).tolist())
+
+
+# test instrumentation: when set to a dict, train() stashes its final
+# running scores there (bit-match tests compare the device-maintained
+# margin across boosting paths, which the booster recomputation can mask)
+_debug_capture: dict | None = None
 
 
 @dataclasses.dataclass
@@ -427,20 +460,41 @@ def train(x: np.ndarray, y: np.ndarray, w: np.ndarray | None,
 
     grow, grow_multi = make_growers(tp)
 
+    if grad_hess_override is not None:
+        def gh_fn(s, y, w):
+            return grad_hess_override(s)
+    else:
+        gh_fn = obj.grad_hess
+    arange_k = jnp.arange(K)
+
+    def routed_vdelta(tree_b):
+        if sparse:
+            vleaf = jax.vmap(lambda t: sparse_route_bins(
+                t, vbinned.indices, vbinned.ebins, vbinned.zero_bin,
+                max_depth=cfg.num_leaves))(tree_b)
+        else:
+            vleaf = jax.vmap(lambda t: tree_route_bins(
+                t, vbins, max_depth=cfg.num_leaves))(tree_b)
+        return tree_b.leaf_value[arange_k[:, None], vleaf]
+
+    def grow_one(g, h, feat_mask_dev, row_mask_dev):
+        """Grow this iteration's K trees in one call → ([K,...] Tree stack,
+        [K, n] per-class train deltas)."""
+        if K == 1:
+            t1, rl1 = grow(g, h, feat_mask_dev, row_mask_dev)
+            tree_b = jax.tree.map(lambda a: a[None], t1)
+            row_leaf_b = rl1[None]
+        else:
+            tree_b, row_leaf_b = grow_multi(g.T, h.T, feat_mask_dev,
+                                            row_mask_dev)
+        return tree_b, tree_b.leaf_value[arange_k[:, None], row_leaf_b]
+
     def make_fused_step():
         """ONE jitted program for a full gbdt/goss boosting iteration:
         gradients → (GOSS mask) → tree growth → train/valid deltas →
         score updates. Eager per-op dispatch between these pieces costs a
         device round-trip each — ruinous when the device is remote — so
-        gbdt/goss/rf run as a single dispatch per iteration. dart keeps
-        the stepwise path: its drop set is chosen host-side per
-        iteration and rescales standing tree contributions."""
-        if grad_hess_override is not None:
-            def gh_fn(s, y, w):
-                return grad_hess_override(s)
-        else:
-            gh_fn = obj.grad_hess
-        arange_k = jnp.arange(K)
+        gbdt/goss/rf run as a single dispatch per iteration."""
         base_arr = np.asarray(base_score, np.float32).reshape(-1)
         base_const = jnp.float32(base_arr[0]) if K == 1 \
             else jnp.asarray(base_arr)
@@ -449,16 +503,6 @@ def train(x: np.ndarray, y: np.ndarray, w: np.ndarray | None,
             other_n=int(cfg.other_rate * n_real),
             amplify=(1.0 - cfg.top_rate) / max(cfg.other_rate, 1e-12)) \
             if is_goss else None
-
-        def routed_vdelta(tree_b):
-            if sparse:
-                vleaf = jax.vmap(lambda t: sparse_route_bins(
-                    t, vbinned.indices, vbinned.ebins, vbinned.zero_bin,
-                    max_depth=cfg.num_leaves))(tree_b)
-            else:
-                vleaf = jax.vmap(lambda t: tree_route_bins(
-                    t, vbins, max_depth=cfg.num_leaves))(tree_b)
-            return tree_b.leaf_value[arange_k[:, None], vleaf]
 
         def step_impl(scores, vscores, feat_mask_dev, row_mask_dev,
                       it_dev):
@@ -475,14 +519,7 @@ def train(x: np.ndarray, y: np.ndarray, w: np.ndarray | None,
                                 **goss_kw)
             else:
                 rm = row_mask_dev
-            if K == 1:
-                t1, rl1 = grow(g, h, feat_mask_dev, rm)
-                tree_b = jax.tree.map(lambda a: a[None], t1)
-                row_leaf_b = rl1[None]
-            else:
-                tree_b, row_leaf_b = grow_multi(g.T, h.T, feat_mask_dev,
-                                                rm)
-            delta_b = tree_b.leaf_value[arange_k[:, None], row_leaf_b]
+            tree_b, delta_b = grow_one(g, h, feat_mask_dev, rm)
             d = delta_b[0] if K == 1 else delta_b.T
             if is_rf:
                 # running average of tree outputs around the init score:
@@ -524,10 +561,146 @@ def train(x: np.ndarray, y: np.ndarray, w: np.ndarray | None,
 
         return step, chunk_step
 
-    use_fused = not is_dart  # dart's drop set is host-chosen per iter
+    # ---- device-side DART (docs/limitations.md r2 gap): per-tree train/
+    # valid deltas live in fixed-shape device buffers, the drop set is a
+    # host-chosen padded index vector, and the whole iteration — dropped-
+    # margin reconstruction → gradients → growth → new-tree add → standing-
+    # tree rescale → buffer updates — is ONE jitted dispatch, the same
+    # count as gbdt's fused step (and scan-chunkable the same way). The
+    # stepwise path (dart_mode="stepwise") is kept as the semantics oracle:
+    # both paths consume identical host RNG draws and apply identical
+    # float32 operations in identical order, so results bit-match.
+    T_max = cfg.num_iterations * K
+    D_drop = max(1, min(int(cfg.max_drop), T_max))
+
+    def make_dart_step():
+        def sub_body(c, xs, coeff_fn):
+            """Apply one (possibly padded) dropped tree's contribution to
+            the carried scores, mirroring the stepwise loop's ascending
+            per-tree order. ``coeff_fn(w)`` maps the tree's standing
+            weight to the scalar coefficient exactly as the oracle
+            computes it on host (barriers pin each scalar rounding step —
+            XLA would otherwise carry the chain in excess precision); the
+            padding mask multiplies last (exact: ×1 or ×±0, and ±0·d
+            FMA-adds as an exact no-op)."""
+            deltas, weights, idx, val = xs
+            coeff = coeff_fn(weights[idx]) * val
+            return _score_update(c, deltas[idx], coeff,
+                                 jnp.mod(idx, K)), None
+
+        def dart_impl(scores, vscores, deltas_buf, vdeltas_buf,
+                      weights_buf, didx, dval, new_w, factor,
+                      feat_mask_dev, row_mask_dev, it_dev):
+            # 1) margin with dropped trees removed (gradients see it)
+            eff, _ = jax.lax.scan(
+                lambda c, xs: sub_body(
+                    c, (deltas_buf, weights_buf) + xs, lambda w: -w),
+                scores, (didx, dval))
+            g, h = gh_fn(eff, y_dev, w_dev)
+            tree_b, delta_b = grow_one(g, h, feat_mask_dev, row_mask_dev)
+            # 2) new tree enters at weight 1/(k+1), class-ascending
+            new_scores = scores
+            for k_cls in range(K):
+                new_scores = _score_update(new_scores, delta_b[k_cls],
+                                           new_w, jnp.int32(k_cls))
+            if valid is not None:
+                vdelta_b = routed_vdelta(tree_b)
+                new_vscores = vscores
+                for k_cls in range(K):
+                    new_vscores = _score_update(
+                        new_vscores, vdelta_b[k_cls], new_w,
+                        jnp.int32(k_cls))
+            else:
+                vdelta_b = None
+                new_vscores = vscores
+            # 3) dropped trees' standing contribution rescales by k/(k+1).
+            # Each scalar step is barriered to its own f32 rounding — the
+            # stepwise oracle computes this coefficient on host in numpy
+            # f32, and XLA would otherwise carry the chain in excess
+            # precision and land 1 ulp away.
+            fm1 = jax.lax.optimization_barrier(factor - 1.0)
+            rescale = lambda w: jax.lax.optimization_barrier(  # noqa: E731
+                w * fm1)
+            new_scores, _ = jax.lax.scan(
+                lambda c, xs: sub_body(
+                    c, (deltas_buf, weights_buf) + xs, rescale),
+                new_scores, (didx, dval))
+            if valid is not None:
+                new_vscores, _ = jax.lax.scan(
+                    lambda c, xs: sub_body(
+                        c, (vdeltas_buf, weights_buf) + xs, rescale),
+                    new_vscores, (didx, dval))
+            # 4) buffers: slot in this iteration's deltas, fold the factor
+            # into dropped weights (padded entries multiply by 1)
+            slot = it_dev * K
+            new_deltas = jax.lax.dynamic_update_slice(
+                deltas_buf, delta_b, (slot, jnp.int32(0)))
+            new_vdeltas = vdeltas_buf if vdelta_b is None else \
+                jax.lax.dynamic_update_slice(vdeltas_buf, vdelta_b,
+                                             (slot, jnp.int32(0)))
+            new_weights = weights_buf.at[didx].multiply(
+                jnp.where(dval > 0, factor, 1.0))
+            new_weights = jax.lax.dynamic_update_slice(
+                new_weights, jnp.broadcast_to(new_w, (K,)), (slot,))
+            return (new_scores, new_vscores, new_deltas, new_vdeltas,
+                    new_weights, tree_b)
+
+        # donate the O(T·n) buffers so each iteration updates them in
+        # place (CPU lacks donation and would warn on every compile)
+        donate = (2, 3, 4) if jax.default_backend() == "tpu" else ()
+        step = jax.jit(dart_impl, donate_argnums=donate)
+
+        @functools.partial(jax.jit, donate_argnums=donate)
+        def dart_chunk(scores, vscores, deltas_buf, vdeltas_buf,
+                       weights_buf, feat_masks, row_masks, its, didxs,
+                       dvals, new_ws, factors):
+            def body(carry, xs):
+                out = dart_impl(*carry, *xs[3:], *xs[:3])
+                return out[:5], out[5]
+            carry, tree_stack = jax.lax.scan(
+                body,
+                (scores, vscores, deltas_buf, vdeltas_buf, weights_buf),
+                (feat_masks, row_masks, its, didxs, dvals, new_ws,
+                 factors))
+            return carry + (tree_stack,)
+
+        return step, dart_chunk
+
+    def dart_host_draw():
+        """One fused-dart iteration's host bookkeeping, shared by the
+        chunked and per-iteration paths (the bit-match guarantee needs
+        both to perform identical float32 folds in identical order):
+        draw the drop set, fold k/(k+1) into the host weight mirror,
+        append the new trees' class/weight entries, and return the
+        fixed-shape device inputs."""
+        dropped = _dart_drop_set(rng, cfg, len(tree_class))
+        didx = np.zeros(D_drop, np.int32)
+        dval = np.zeros(D_drop, np.float32)
+        didx[:len(dropped)] = dropped
+        dval[:len(dropped)] = 1.0
+        new_w = np.float32(1.0 / (len(dropped) + 1)) if dropped \
+            else np.float32(1.0)
+        factor = np.float32(len(dropped) / (len(dropped) + 1.0)) \
+            if dropped else np.float32(1.0)
+        for d in dropped:
+            tree_weights[d] = np.float32(tree_weights[d] * factor)
+        for k_cls in range(K):
+            tree_class.append(k_cls)
+            tree_weights.append(new_w)
+        return didx, dval, new_w, factor
+
+    dart_fused = is_dart and cfg.dart_mode != "stepwise"
+    use_fused = not is_dart  # gbdt/goss/rf single-dispatch path
     fused_step = chunk_step = None
     if use_fused:
         fused_step, chunk_step = make_fused_step()
+    dart_step = dart_chunk_step = None
+    if dart_fused:
+        dart_step, dart_chunk_step = make_dart_step()
+        deltas_buf = jnp.zeros((T_max, n), jnp.float32)
+        vdeltas_buf = jnp.zeros((T_max, nv), jnp.float32) \
+            if valid is not None else jnp.zeros((T_max, 1), jnp.float32)
+        weights_buf = jnp.ones(T_max, jnp.float32)
 
     # ---- chunked fast path: scan cfg.scan_chunk iterations per dispatch
     # when NOTHING observes per-iteration state — no eval/early stopping
@@ -535,21 +708,32 @@ def train(x: np.ndarray, y: np.ndarray, w: np.ndarray | None,
     # RNG calls (feature/bagging masks) happen in the same order as the
     # per-iteration loop, so chunked and unchunked runs are identical.
     chunk = max(int(cfg.scan_chunk), 1)
-    if (use_fused and chunk > 1 and delegate is None and valid is None
-            and not cfg.is_provide_training_metric):
+    if ((use_fused or dart_fused) and chunk > 1 and delegate is None
+            and valid is None and not cfg.is_provide_training_metric):
         it = 0
         # only FULL chunks run through chunk_step: a partial tail would
         # retrace/recompile the whole scan program for its odd shape,
         # costing more than the dispatches it saves — the remainder runs
         # on the per-iteration fused step instead
         full_iters = (cfg.num_iterations // chunk) * chunk
+        nf = max(1, int(round(cfg.feature_fraction * F)))
         while it < full_iters:
             k = chunk
             fms = np.ones((k, F), bool)
-            if cfg.feature_fraction < 1.0:
-                nf = max(1, int(round(cfg.feature_fraction * F)))
-                fms = np.zeros((k, F), bool)
-                for j in range(k):
+            didxs = np.zeros((k, D_drop), np.int32)
+            dvals = np.zeros((k, D_drop), np.float32)
+            new_ws = np.ones(k, np.float32)
+            factors = np.ones(k, np.float32)
+            for j in range(k):
+                # host RNG draws in the per-iteration loop's order: the
+                # drop set (dart) then the feature mask, both from `rng`.
+                # The host weight-mirror fold happens inside the draw, so
+                # iteration j can drop a tree iteration j-1 just added.
+                if dart_fused:
+                    (didxs[j], dvals[j], new_ws[j],
+                     factors[j]) = dart_host_draw()
+                if cfg.feature_fraction < 1.0:
+                    fms[j] = False
                     fms[j, rng.choice(F, size=nf, replace=False)] = True
             if is_goss:
                 rms = jnp.broadcast_to(valid_mask_dev, (k, n))
@@ -567,13 +751,22 @@ def train(x: np.ndarray, y: np.ndarray, w: np.ndarray | None,
                 rms = jnp.broadcast_to(valid_mask_dev, (k, n))
             its = jnp.asarray(
                 np.arange(it, it + k, dtype=np.int32))
-            scores, vscores, tree_stack = chunk_step(
-                scores, vscores, jnp.asarray(fms), rms, its)
-            trees.append(tree_stack)      # leaves [k, K, ...]
-            for _ in range(k):
-                for k_cls in range(K):
-                    tree_class.append(k_cls)
-                    tree_weights.append(1.0)
+            if dart_fused:
+                (scores, vscores, deltas_buf, vdeltas_buf, weights_buf,
+                 tree_stack) = dart_chunk_step(
+                    scores, vscores, deltas_buf, vdeltas_buf, weights_buf,
+                    jnp.asarray(fms), rms, its, jnp.asarray(didxs),
+                    jnp.asarray(dvals), jnp.asarray(new_ws),
+                    jnp.asarray(factors))
+                trees.append(tree_stack)  # host lists updated in j-loop
+            else:
+                scores, vscores, tree_stack = chunk_step(
+                    scores, vscores, jnp.asarray(fms), rms, its)
+                trees.append(tree_stack)      # leaves [k, K, ...]
+                for _ in range(k):
+                    for k_cls in range(K):
+                        tree_class.append(k_cls)
+                        tree_weights.append(1.0)
             it += k
         iter_range = range(full_iters, cfg.num_iterations)
     else:
@@ -589,26 +782,28 @@ def train(x: np.ndarray, y: np.ndarray, w: np.ndarray | None,
                 grow, grow_multi = make_growers(tp)
                 if use_fused:
                     fused_step, chunk_step = make_fused_step()
+                if dart_fused:
+                    dart_step, dart_chunk_step = make_dart_step()
             delegate.before_train_iteration(it)
 
-        # ---- dart: drop trees for gradient computation
-        new_tree_weight = 1.0
+        # ---- dart: drop trees for gradient computation (DART
+        # normalization: k dropped trees rescale by k/(k+1), the new tree
+        # enters at 1/(k+1))
+        new_tree_weight = np.float32(1.0)
         dropped: list[int] = []
         eff_scores = scores
-        n_flat = len(tree_class)  # trees holds [K,...] stacks per iter
-        if is_dart and n_flat and rng.random() >= cfg.skip_drop:
-            k_drop = min(cfg.max_drop,
-                         max(1, int(round(cfg.drop_rate * n_flat))))
-            dropped = sorted(
-                rng.choice(n_flat, size=min(k_drop, n_flat),
-                           replace=False).tolist())
+        dart_inputs = None
+        if dart_fused:
+            dart_inputs = dart_host_draw()
+        elif is_dart:
+            dropped = _dart_drop_set(rng, cfg, len(tree_class))
+            if dropped:
+                new_tree_weight = np.float32(1.0 / (len(dropped) + 1))
             for d in dropped:
-                eff_scores = _apply_delta(
-                    eff_scores, -tree_deltas[d] * tree_weights[d],
-                    tree_class[d], K)
-            # DART normalization: k dropped trees rescale by k/(k+1), the
-            # new tree enters at 1/(k+1).
-            new_tree_weight = 1.0 / (len(dropped) + 1)
+                eff_scores = _apply_weighted(
+                    eff_scores, tree_deltas[d],
+                    np.float32(-tree_weights[d]),
+                    np.int32(tree_class[d]))
 
         # ---- feature sampling
         feat_mask = np.ones(F, bool)
@@ -619,7 +814,23 @@ def train(x: np.ndarray, y: np.ndarray, w: np.ndarray | None,
 
         feat_mask_dev = jnp.asarray(feat_mask)
 
-        if fused_step is not None:
+        if dart_fused:
+            # ---- fused dart iteration: ONE device dispatch, like gbdt's
+            if cfg.bagging_freq > 0 and cfg.bagging_fraction < 1.0:
+                if it % max(cfg.bagging_freq, 1) == 0:
+                    bag_mask = (bag_rng.random(n)
+                                < cfg.bagging_fraction).astype(np.float32)
+                row_mask_dev = jnp.asarray(bag_mask) * valid_mask_dev
+            else:
+                row_mask_dev = valid_mask_dev
+            didx, dval, new_w, factor = dart_inputs
+            (scores, vscores, deltas_buf, vdeltas_buf, weights_buf,
+             tree_b) = dart_step(
+                scores, vscores, deltas_buf, vdeltas_buf, weights_buf,
+                jnp.asarray(didx), jnp.asarray(dval), new_w,
+                factor, feat_mask_dev, row_mask_dev, np.int32(it))
+            trees.append(tree_b)  # host mirror updated by dart_host_draw
+        elif fused_step is not None:
             # ---- fused gbdt/goss iteration: ONE device dispatch for
             # gradients + sampling + growth + deltas + score updates
             if is_goss:
@@ -692,24 +903,26 @@ def train(x: np.ndarray, y: np.ndarray, w: np.ndarray | None,
                 vdelta = None if vdelta_b is None else vdelta_b[k_cls]
                 tree_deltas.append(delta)
                 tree_vdeltas.append(vdelta)
-                scores = _apply_delta(scores, delta * new_tree_weight,
-                                      k_cls, K)
+                scores = _apply_weighted(scores, delta, new_tree_weight,
+                                         np.int32(k_cls))
                 if valid is not None:
-                    vscores = _apply_delta(vscores,
-                                           vdelta * new_tree_weight,
-                                           k_cls, K)
+                    vscores = _apply_weighted(vscores, vdelta,
+                                              new_tree_weight,
+                                              np.int32(k_cls))
 
-        if is_dart and dropped:
+        if is_dart and not dart_fused and dropped:
             # rescale dropped trees' standing contribution by k/(k+1)
-            factor = len(dropped) / (len(dropped) + 1.0)
+            factor = np.float32(len(dropped) / (len(dropped) + 1.0))
             for d in dropped:
-                adj = tree_deltas[d] * (tree_weights[d] * (factor - 1.0))
-                scores = _apply_delta(scores, adj, tree_class[d], K)
+                coeff = np.float32(tree_weights[d]
+                                   * (factor - np.float32(1.0)))
+                scores = _apply_weighted(scores, tree_deltas[d], coeff,
+                                         np.int32(tree_class[d]))
                 if valid is not None and tree_vdeltas[d] is not None:
-                    vadj = tree_vdeltas[d] * (tree_weights[d]
-                                              * (factor - 1.0))
-                    vscores = _apply_delta(vscores, vadj, tree_class[d], K)
-                tree_weights[d] *= factor
+                    vscores = _apply_weighted(vscores, tree_vdeltas[d],
+                                              coeff,
+                                              np.int32(tree_class[d]))
+                tree_weights[d] = np.float32(tree_weights[d] * factor)
 
         # ---- eval + early stopping (configurable cadence: eval_freq > 1
         # skips the device sync entirely on off iterations)
@@ -789,6 +1002,15 @@ def train(x: np.ndarray, y: np.ndarray, w: np.ndarray | None,
         prior_iters = init_booster.num_trees // max(K, 1)
     if best_iter >= 0:
         booster.best_iteration = best_iter + prior_iters
+    if _debug_capture is not None:
+        _debug_capture["scores"] = np.asarray(scores)
+        if dart_fused:
+            _debug_capture["dart_deltas"] = np.asarray(deltas_buf)
+            _debug_capture["dart_weights"] = np.asarray(weights_buf)
+        elif is_dart:
+            _debug_capture["dart_deltas"] = np.asarray(
+                jax.device_get(tree_deltas))
+            _debug_capture["dart_weights"] = np.asarray(tree_weights)
     return TrainResult(booster=booster, evals=evals, best_iteration=best_iter,
                        host_pulls_bulk=pulls_bulk,
                        host_pulls_scalar=pulls_scalar)

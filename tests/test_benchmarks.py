@@ -207,3 +207,40 @@ class TestLinearBenchmarks:
         acc = float((m.transform(df_m)["prediction"] == y3).mean())
         b.add("softmax.accuracy", acc, 0.01)
         b.verify(regenerate=REGEN)
+
+
+class TestRankerBenchmarks:
+    """MSLR-shaped ranking benchmark (BASELINE configs[2] names
+    LightGBMRanker on MSLR-WEB30K, which cannot be fetched zero-egress):
+    variable-size query groups with graded 0-4 relevance driven by a
+    latent linear utility — the ndcg@k values regression-check the whole
+    lambdarank + NDCG chain."""
+
+    @staticmethod
+    def msl_shaped(n_queries=80, f=32, seed=12):
+        rng = np.random.default_rng(seed)
+        w_true = rng.normal(size=f).astype(np.float32)
+        feats, rels, qids = [], [], []
+        for q in range(n_queries):
+            sz = int(rng.integers(8, 40))
+            xq = rng.normal(size=(sz, f)).astype(np.float32)
+            util = xq @ w_true + rng.normal(scale=2.0, size=sz)
+            cuts = np.quantile(util, [0.5, 0.75, 0.9, 0.97])
+            rels.append(np.digitize(util, cuts).astype(np.float32))
+            feats.append(xq)
+            qids.append(np.full(sz, q, np.int64))
+        return (np.concatenate(feats), np.concatenate(rels),
+                np.concatenate(qids))
+
+    def test_ranker_ndcg(self):
+        from mmlspark_tpu.lightgbm import LightGBMRanker
+        b = Benchmarks(os.path.join(RESOURCE_DIR,
+                                    "benchmarks_LightGBMRanker.csv"))
+        x, rel, qid = self.msl_shaped()
+        df = DataFrame({"features": x, "label": rel, "query": qid})
+        m = LightGBMRanker(groupCol="query", numIterations=40,
+                           numLeaves=15, minDataInLeaf=5, numShards=1,
+                           seed=0).fit(df)
+        for k in (1, 3, 5, 10):
+            b.add(f"mslr_shaped.ndcg@{k}", m.evaluate_ndcg(df, k=k), 0.02)
+        b.verify(regenerate=REGEN)
