@@ -87,7 +87,10 @@ class TextEncoder(nn.Module):
     """Token ids [N, T] → ``{"tokens": [N, T, W], "pooled": [N, W]}``.
 
     ``pooled`` is the masked mean over non-pad tokens (pad id 0) — the
-    transfer-learning feature vector."""
+    transfer-learning feature vector. Setup-style (not compact) so the
+    prologue (``embed_ids``) and epilogue (``finalize``) are callable on
+    their own — ``pipeline_encode`` runs them replicated around the
+    pipeline-parallel block stack."""
     vocab: int = 32768
     width: int = 256
     depth: int = 4
@@ -97,28 +100,39 @@ class TextEncoder(nn.Module):
     attention_fn: Callable = _dense_attention
     dtype: Any = jnp.bfloat16
 
-    @nn.compact
-    def __call__(self, ids, train: bool = False):
-        N, T = ids.shape
-        x = nn.Embed(self.vocab, self.width, dtype=self.dtype,
-                     name="embed")(ids)
-        # fixed sinusoidal positions: length-extrapolable, nothing to
-        # shard or convert
+    def setup(self):
+        self.embed_layer = nn.Embed(self.vocab, self.width,
+                                    dtype=self.dtype, name="embed")
+        self.blocks = [EncoderBlock(self.heads, self.mlp_dim,
+                                    attention_fn=self.attention_fn,
+                                    dtype=self.dtype, name=f"block{i}")
+                       for i in range(self.depth)]
+        self.final_ln = nn.LayerNorm(dtype=jnp.float32, name="ln")
+
+    def embed_ids(self, ids):
+        """Embedding + fixed sinusoidal positions (length-extrapolable,
+        nothing to shard or convert) → [N, T, W] block input."""
+        T = ids.shape[1]
+        x = self.embed_layer(ids)
         pos = jnp.arange(T)[:, None]
         dim = jnp.arange(self.width // 2)[None, :]
         ang = pos / (10000.0 ** (2 * dim / self.width))
         pe = jnp.concatenate([jnp.sin(ang), jnp.cos(ang)], axis=-1)
-        x = x + pe[None].astype(self.dtype)
-        key_mask = ids != 0
-        for i in range(self.depth):
-            x = EncoderBlock(self.heads, self.mlp_dim,
-                             attention_fn=self.attention_fn,
-                             dtype=self.dtype,
-                             name=f"block{i}")(x, key_mask)
-        x = nn.LayerNorm(dtype=jnp.float32, name="ln")(x)
+        return x + pe[None].astype(self.dtype)
+
+    def finalize(self, x, ids):
+        """Final LN + masked mean pool over non-pad tokens."""
+        x = self.final_ln(x)
         mask = (ids != 0).astype(jnp.float32)[..., None]
         pooled = (x * mask).sum(1) / jnp.maximum(mask.sum(1), 1.0)
         return {"tokens": x, "pooled": pooled.astype(jnp.float32)}
+
+    def __call__(self, ids, train: bool = False):
+        x = self.embed_ids(ids)
+        key_mask = ids != 0
+        for block in self.blocks:
+            x = block(x, key_mask)
+        return self.finalize(x, ids)
 
 
 def make_attention_fn(impl: str = "dense", mesh=None, axis: str = "sp",

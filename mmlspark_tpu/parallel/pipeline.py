@@ -22,19 +22,24 @@ from jax.sharding import PartitionSpec as P
 
 
 def pipeline_apply(mesh, stage_fn, stacked_params, microbatches,
-                   *, axis: str = "pp"):
+                   *, axis: str = "pp", aux=None):
     """Run microbatches through S = mesh.shape[axis] pipeline stages.
 
     stage_fn(params_i, h) -> h'  applied by stage i; ``stacked_params`` has
     leading dim S (stage-major, sharded over ``axis``); ``microbatches``
     is [M, mb, ...] (replicated). Returns [M, mb, ...] outputs of the last
     stage.
+
+    ``aux`` (optional, [M, ...] replicated) rides along with each
+    microbatch: at tick t stage s is processing microbatch t-s, so the
+    stage receives ``aux[t-s]`` and ``stage_fn(params_i, h, aux_mb)`` —
+    attention key masks being the motivating case.
     """
     S = int(mesh.shape[axis])
     M = microbatches.shape[0]
     T = M + S - 1
 
-    def body(params_local, xs):
+    def body(params_local, xs, aux_xs):
         params_local = jax.tree.map(lambda p: p[0], params_local)
         stage = jax.lax.axis_index(axis)
         h = jnp.zeros_like(xs[0])
@@ -47,7 +52,12 @@ def pipeline_apply(mesh, stage_fn, stacked_params, microbatches,
             inject = jnp.where(stage == 0,
                                jnp.where(t < M, 1.0, 0.0), 0.0)
             h_cur = inject * xs[mb] + (1.0 - inject) * h_in
-            h_out = stage_fn(params_local, h_cur)
+            if aux_xs is None:
+                h_out = stage_fn(params_local, h_cur)
+            else:
+                # the microbatch this stage is processing right now
+                own = jnp.clip(t - stage, 0, M - 1)
+                h_out = stage_fn(params_local, h_cur, aux_xs[own])
             # last stage emits microbatch t-(S-1)
             emit_idx = jnp.clip(t - (S - 1), 0, M - 1)
             emit = (stage == S - 1) & (t >= S - 1)
@@ -68,10 +78,15 @@ def pipeline_apply(mesh, stage_fn, stacked_params, microbatches,
             outs * (stage == S - 1).astype(outs.dtype), axis)
         return last
 
+    if aux is None:
+        return jax.shard_map(
+            lambda p, x: body(p, x, None), mesh=mesh,
+            in_specs=(P(axis), P()), out_specs=P(),
+            check_vma=False)(stacked_params, microbatches)
     return jax.shard_map(
         body, mesh=mesh,
-        in_specs=(P(axis), P()), out_specs=P(),
-        check_vma=False)(stacked_params, microbatches)
+        in_specs=(P(axis), P(), P()), out_specs=P(),
+        check_vma=False)(stacked_params, microbatches, aux)
 
 
 def make_pipeline_mlp(width: int):
@@ -81,3 +96,59 @@ def make_pipeline_mlp(width: int):
         W, b = params
         return h + jnp.tanh(h @ W + b)
     return stage_fn
+
+
+def pipeline_encode(mesh, module, variables, ids, *,
+                    num_microbatches: int | None = None,
+                    axis: str = "pp"):
+    """A REAL model through the pipeline: ``TextEncoder``'s depth
+    EncoderBlocks split across the ``axis`` stages (depth % S == 0, each
+    stage scanning depth/S blocks), embedding prologue and LN+pool
+    epilogue replicated. Numerically equivalent to
+    ``module.apply(variables, ids)`` (same blocks, same order; verified
+    by test).
+
+    ids [N, T] int32 with pad id 0; N must divide into the microbatch
+    count (default M = 2·S, the classic bubble-amortizing choice).
+    Returns the ``{"tokens", "pooled"}`` dict of the plain forward.
+    """
+    from ..dl.text_encoder import EncoderBlock, TextEncoder
+
+    S = int(mesh.shape[axis])
+    depth = module.depth
+    if depth % S:
+        raise ValueError(f"depth {depth} must divide into {S} stages")
+    L = depth // S
+    M = num_microbatches or min(2 * S, ids.shape[0])
+    N, T = ids.shape
+    if N % M:
+        raise ValueError(f"batch {N} must divide into {M} microbatches")
+
+    h = module.apply(variables, ids, method=TextEncoder.embed_ids)
+    key_mask = ids != 0
+
+    params = variables["params"]
+    block_trees = [params[f"block{i}"] for i in range(depth)]
+    # [S, L, ...] stage-major stack of block parameters
+    stacked = jax.tree.map(
+        lambda *leaves: jnp.stack(
+            [jnp.stack(leaves[s * L:(s + 1) * L]) for s in range(S)]),
+        *block_trees)
+
+    block = EncoderBlock(module.heads, module.mlp_dim,
+                         attention_fn=module.attention_fn,
+                         dtype=module.dtype)
+
+    def stage_fn(stage_params, h, mask_mb):
+        def one(h, p):
+            return block.apply({"params": p}, h, mask_mb), None
+        h, _ = jax.lax.scan(one, h, stage_params)
+        return h
+
+    mb = N // M
+    h_mb = h.reshape(M, mb, T, module.width)
+    mask_mb = key_mask.reshape(M, mb, T)
+    out = pipeline_apply(mesh, stage_fn, stacked, h_mb, axis=axis,
+                         aux=mask_mb)
+    x = out.reshape(N, T, module.width)
+    return module.apply(variables, x, ids, method=TextEncoder.finalize)
