@@ -341,12 +341,11 @@ def _serving_fallback(extras: dict) -> None:
         extras["error_serving_fallback"] = \
             "backend init failed in the scrubbed child too"
         return
-    env = dict(os.environ)
-    env["PYTHONPATH"] = os.pathsep.join(
-        p for p in env.get("PYTHONPATH", "").split(os.pathsep)
-        if p and ".axon_site" not in p)
+    from mmlspark_tpu.core.utils import scrubbed_cpu_env
+    env = scrubbed_cpu_env()
     env["MMLSPARK_TPU_BENCH_FORCE_CPU"] = "1"
     env["MMLSPARK_TPU_BENCH_ONLY"] = "serving"
+    proc = None
     try:
         proc = subprocess.run([sys.executable, os.path.abspath(__file__)],
                               env=env, capture_output=True, text=True,
@@ -362,7 +361,13 @@ def _serving_fallback(extras: dict) -> None:
         if merged_serving:
             extras["serving_measured_on"] = "cpu-host (tunnel down)"
     except Exception:
-        extras["error_serving_fallback"] = traceback.format_exc()[-800:]
+        # keep the child's actual cause, not just the parent-side parse
+        # failure (diagnosability is the whole point of this suite)
+        detail = traceback.format_exc()[-400:]
+        if proc is not None:
+            detail += (f"\nchild rc={proc.returncode}"
+                       f"\nchild stderr: {(proc.stderr or '')[-800:]}")
+        extras["error_serving_fallback"] = detail
 
 
 def main():

@@ -128,6 +128,37 @@ def find_unused_column_name(prefix: str, df) -> str:
     return name
 
 
+_AXON_HINTS = ("axon", "pallas_axon")
+
+
+def scrubbed_cpu_env(n_devices: int | None = None,
+                     extra_path: str | None = None) -> dict:
+    """Subprocess environment with every accelerator-tunnel hook removed
+    and the platform pinned to host CPU (optionally with ``n_devices``
+    virtual devices). The ONE copy of the wedge-guard scrub: a wedged
+    remote-device tunnel hangs ``jax.devices()`` inside any process whose
+    site-hook survives, and JAX_PLATFORMS alone does not override the
+    hook."""
+    import os
+    env = dict(os.environ)
+    for key in list(env):
+        if any(h in key.lower() for h in _AXON_HINTS):
+            del env[key]
+    parts = [p for p in env.get("PYTHONPATH", "").split(os.pathsep)
+             if p and not any(h in p.lower() for h in _AXON_HINTS)]
+    if extra_path:
+        parts.insert(0, extra_path)
+    env["PYTHONPATH"] = os.pathsep.join(parts)
+    env["JAX_PLATFORMS"] = "cpu"
+    if n_devices is not None:
+        flags = [f for f in env.get("XLA_FLAGS", "").split()
+                 if "xla_force_host_platform_device_count" not in f]
+        flags.append(f"--xla_force_host_platform_device_count={n_devices}")
+        env["XLA_FLAGS"] = " ".join(flags)
+    env["JAX_COMPILATION_CACHE_DIR"] = "/tmp/mmlspark_tpu_jax_cache"
+    return env
+
+
 def stable_sigmoid(x: np.ndarray) -> np.ndarray:
     """Overflow-free logistic: exp is only ever taken of a non-positive
     argument."""

@@ -112,19 +112,29 @@ def pipeline_encode(mesh, module, variables, ids, *,
     count (default M = 2·S, the classic bubble-amortizing choice).
     Returns the ``{"tokens", "pooled"}`` dict of the plain forward.
     """
-    from ..dl.text_encoder import EncoderBlock, TextEncoder
+    from ..dl.text_encoder import EncoderBlock
 
     S = int(mesh.shape[axis])
     depth = module.depth
     if depth % S:
         raise ValueError(f"depth {depth} must divide into {S} stages")
     L = depth // S
-    M = num_microbatches or min(2 * S, ids.shape[0])
     N, T = ids.shape
-    if N % M:
-        raise ValueError(f"batch {N} must divide into {M} microbatches")
+    if num_microbatches is None:
+        # the largest divisor of N that is <= 2*S (the classic
+        # bubble-amortizing target) — any batch size is accepted
+        M = next(m for m in range(min(2 * S, N), 0, -1) if N % m == 0)
+    else:
+        M = num_microbatches
+        if N % M:
+            raise ValueError(
+                f"batch {N} must divide into num_microbatches={M}; "
+                "pass a divisor of the batch size (or omit it for the "
+                "automatic choice)")
 
-    h = module.apply(variables, ids, method=TextEncoder.embed_ids)
+    # string method dispatch so TextEncoder subclasses keep their
+    # overridden prologue/epilogue
+    h = module.apply(variables, ids, method="embed_ids")
     key_mask = ids != 0
 
     params = variables["params"]
@@ -151,4 +161,4 @@ def pipeline_encode(mesh, module, variables, ids, *,
     out = pipeline_apply(mesh, stage_fn, stacked, h_mb, axis=axis,
                          aux=mask_mb)
     x = out.reshape(N, T, module.width)
-    return module.apply(variables, x, ids, method=TextEncoder.finalize)
+    return module.apply(variables, x, ids, method="finalize")

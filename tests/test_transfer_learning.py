@@ -120,3 +120,90 @@ def test_frozen_backbone_transfer():
     # task, and transfer beats random features
     assert acc_pretrained > 0.8, (acc_pretrained, acc_random)
     assert acc_pretrained >= acc_random, (acc_pretrained, acc_random)
+
+
+@pytest.mark.slow
+def test_pretrained_chain_torch_to_featurizer(tmp_path):
+    """The FULL pretrained-weight chain (reference
+    ``ModelDownloader.scala:37-60`` + ``ImageFeaturizer.scala:81-85``):
+    torch training → torchvision-layout state_dict → converter (orbax
+    checkpoint + SHA-256 manifest) → ModelDownloader with random init
+    FORBIDDEN (hash-verified restore) → ImageFeaturizer →
+    TrainClassifier, with transfer accuracy above the random-init floor.
+    Any break in the weight chain fails this test."""
+    torch = pytest.importorskip("torch")
+    from test_convert import TorchBasic, TorchResNet
+    from mmlspark_tpu.image import ImageFeaturizer
+    from mmlspark_tpu.models import ModelDownloader
+    from mmlspark_tpu.models.convert import convert_torch_checkpoint
+    from mmlspark_tpu.train import LogisticRegression, TrainClassifier
+
+    rng = np.random.default_rng(0)
+    imgs, labels = gratings(480, freq=4.0, rng=rng)
+
+    # -- pretext training in torch (the oracle side of the converter)
+    model = TorchResNet(TorchBasic, [2, 2, 2, 2], width=64,
+                        num_classes=len(ORIENTATIONS))
+    opt = torch.optim.Adam(model.parameters(), lr=1e-3)
+    xb = torch.tensor(imgs.transpose(0, 3, 1, 2))
+    yb = torch.tensor(labels, dtype=torch.long)
+    g = torch.Generator().manual_seed(0)
+    model.train()
+    for _ in range(30):
+        idx = torch.randint(0, len(imgs), (64,), generator=g)
+        opt.zero_grad()
+        loss = torch.nn.functional.cross_entropy(model(xb[idx]), yb[idx])
+        loss.backward()
+        opt.step()
+    model.eval()
+    # the pretext task was actually learned
+    assert float(loss.detach()) < 1.0
+
+    # -- convert + persist (orbax + manifest), then hash-verified restore
+    convert_torch_checkpoint(
+        {k: v.detach() for k, v in model.state_dict().items()},
+        "ResNet18", str(tmp_path))
+    loaded = ModelDownloader(str(tmp_path)).download_by_name(
+        "ResNet18", num_classes=len(ORIENTATIONS),
+        allow_random_init=False)
+
+    # tampered weights must fail the manifest check, like the reference's
+    # hash-verified download
+    import json as _json
+    mpath = tmp_path / "ResNet18.manifest.json"
+    manifest = _json.loads(mpath.read_text())
+    mpath.write_text(_json.dumps({**manifest, "sha256": "0" * 64}))
+    with pytest.raises(Exception, match="(?i)hash|sha|digest|mismatch"):
+        ModelDownloader(str(tmp_path)).download_by_name(
+            "ResNet18", num_classes=len(ORIENTATIONS),
+            allow_random_init=False)
+    mpath.write_text(_json.dumps(manifest))
+
+    # -- downstream probe at a HELD-OUT frequency through the featurizer.
+    # FEW-SHOT on purpose (48 probe-training rows): with enough labels a
+    # linear head separates orientation even on random-conv pooled
+    # features; the value of pretraining is sample efficiency.
+    down_imgs, down_labels = gratings(300, freq=7.0, rng=rng)
+    holdout = 252
+
+    def probe(loaded_model):
+        feat = ImageFeaturizer(model=loaded_model, cutOutputLayers=1,
+                               inputCol="image", outputCol="feats",
+                               autoResize=False, miniBatchSize=64)
+        fdf = feat.transform(DataFrame({"image": down_imgs,
+                                        "label": down_labels}))
+        fdf = DataFrame({"feats": np.asarray(fdf["feats"]),
+                         "label": np.asarray(fdf["label"])})
+        train_df = fdf.filter(np.arange(len(down_imgs)) >= holdout)
+        test_df = fdf.filter(np.arange(len(down_imgs)) < holdout)
+        head = TrainClassifier(model=LogisticRegression(maxIter=200),
+                               labelCol="label").fit(train_df)
+        pred = head.transform(test_df)["scored_labels"]
+        return float((pred == down_labels[:holdout]).mean())
+
+    acc_pretrained = probe(loaded)
+    acc_random = probe(ModelDownloader().download_by_name(
+        "ResNet18", num_classes=len(ORIENTATIONS),
+        allow_random_init=True))
+    assert acc_pretrained > 0.8, (acc_pretrained, acc_random)
+    assert acc_pretrained > acc_random + 0.05, (acc_pretrained, acc_random)
