@@ -22,7 +22,7 @@ REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 # package → test files (the reference splits slow packages into split1/2)
 PACKAGES: dict[str, list[str]] = {
     "core": ["test_core_dataframe.py", "test_core_params_pipeline.py",
-             "test_fuzzing.py", "test_longtail_io.py"],
+             "test_fuzzing.py", "test_longtail_io.py", "test_arrow.py"],
     "featurize": ["test_featurize.py", "test_stages.py"],
     "lightgbm1": ["test_lightgbm.py", "test_lightgbm_categorical.py", "test_pallas_hist.py"],
     "lightgbm2": ["test_lightgbm_sparse.py", "test_lightgbm_distributed.py",

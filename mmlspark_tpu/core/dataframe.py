@@ -375,6 +375,30 @@ class DataFrame:
             data[str(c)] = col
         return DataFrame(data, num_partitions=num_partitions)
 
+    def to_arrow(self):
+        """DataFrame → Arrow Table (zero-copy numeric columns, vector
+        columns as FixedSizeList, categorical metadata in field
+        metadata). See :mod:`mmlspark_tpu.core.arrow`."""
+        from .arrow import columns_to_table
+        return columns_to_table(self)
+
+    toArrow = to_arrow
+
+    @staticmethod
+    def from_arrow(table, num_partitions: int = 1) -> "DataFrame":
+        """Arrow Table / RecordBatch → DataFrame (zero-copy numeric
+        columns, dictionary arrays → categorical metadata)."""
+        from .arrow import from_arrow
+        return from_arrow(table, num_partitions=num_partitions)
+
+    @staticmethod
+    def from_arrow_batches(batches, num_partitions: int = 1) -> "DataFrame":
+        """Streaming columnar ingestion from an iterable of Arrow
+        RecordBatches (or a RecordBatchReader) — numeric data never
+        passes through Python objects."""
+        from .arrow import from_arrow_batches
+        return from_arrow_batches(batches, num_partitions=num_partitions)
+
     @staticmethod
     def from_rows(rows: Sequence[Mapping[str, Any]],
                   num_partitions: int = 1) -> "DataFrame":

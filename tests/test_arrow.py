@@ -1,0 +1,195 @@
+"""Arrow interchange (``core/arrow.py``; reference interchange role:
+``core/schema/SparkBindings.scala:13-39``; SURVEY §7.1 "columnar batches
+(Arrow) → fixed-shape jnp arrays")."""
+
+import numpy as np
+import pytest
+
+pa = pytest.importorskip("pyarrow")
+
+from mmlspark_tpu.core import DataFrame  # noqa: E402
+from mmlspark_tpu.core.bindings import ColumnMetadata  # noqa: E402
+
+
+def sample_df():
+    rng = np.random.default_rng(0)
+    return DataFrame({
+        "x": rng.normal(size=50).astype(np.float32),
+        "n": np.arange(50, dtype=np.int64),
+        "features": rng.normal(size=(50, 8)).astype(np.float32),
+        "name": np.asarray([f"row{i}" for i in range(50)], object),
+    })
+
+
+class TestRoundTrip:
+    def test_basic_round_trip(self):
+        df = sample_df()
+        table = df.to_arrow()
+        back = DataFrame.from_arrow(table)
+        assert back.columns == df.columns
+        np.testing.assert_array_equal(back["x"], df["x"])
+        np.testing.assert_array_equal(back["n"], df["n"])
+        np.testing.assert_array_equal(back["features"], df["features"])
+        assert list(back["name"]) == list(df["name"])
+        assert back["features"].shape == (50, 8)
+
+    def test_numeric_zero_copy_in(self):
+        """Single-chunk null-free numeric columns must not be copied on
+        import — the hot path for feature matrices."""
+        x = np.arange(1000, dtype=np.float64)
+        table = pa.table({"x": x})
+        df = DataFrame.from_arrow(table)
+        buf_view = table.column("x").chunk(0).to_numpy(
+            zero_copy_only=True)
+        assert np.shares_memory(df["x"], buf_view)
+
+    def test_vector_column_zero_copy_in(self):
+        flat = np.arange(400, dtype=np.float32)
+        arr = pa.FixedSizeListArray.from_arrays(pa.array(flat), 8)
+        table = pa.Table.from_arrays([arr], names=["v"])
+        df = DataFrame.from_arrow(table)
+        assert df["v"].shape == (50, 8)
+        values_view = table.column("v").chunk(0).values.to_numpy(
+            zero_copy_only=True)
+        assert np.shares_memory(df["v"], values_view)
+
+    def test_categorical_metadata_round_trip(self):
+        df = DataFrame({"city": np.asarray([0, 1, 2, 1, 0], np.float32),
+                        "y": np.ones(5, np.float32)})
+        ColumnMetadata.set_categorical(df, "city", ["ams", "ber", "cdg"])
+        back = DataFrame.from_arrow(df.to_arrow())
+        assert ColumnMetadata.categorical_levels(back, "city") == \
+            ["ams", "ber", "cdg"]
+        np.testing.assert_array_equal(back["city"], df["city"])
+
+    def test_dictionary_array_becomes_categorical(self):
+        """A Spark/pandas dictionary-encoded column lands as indices +
+        levels metadata — the exact shape ValueIndexer produces, so GBDT
+        categorical-slot threading works across the interchange."""
+        arr = pa.array(["red", "blue", "red", "green"]).dictionary_encode()
+        df = DataFrame.from_arrow(pa.Table.from_arrays([arr],
+                                                       names=["color"]))
+        levels = ColumnMetadata.categorical_levels(df, "color")
+        assert levels is not None and set(levels) == \
+            {"red", "blue", "green"}
+        decoded = [levels[int(i)] for i in df["color"]]
+        assert decoded == ["red", "blue", "red", "green"]
+
+    def test_nulls_become_nan(self):
+        table = pa.table({"x": pa.array([1.0, None, 3.0]),
+                          "k": pa.array([1, None, 3], pa.int32())})
+        df = DataFrame.from_arrow(table)
+        assert np.isnan(df["x"][1])
+        assert np.isnan(df["k"][1])  # int-with-null promotes to float
+
+    def test_multichunk_table(self):
+        t1 = pa.table({"x": np.arange(10.0)})
+        t2 = pa.table({"x": np.arange(10.0, 25.0)})
+        table = pa.concat_tables([t1, t2])
+        assert table.column("x").num_chunks == 2
+        df = DataFrame.from_arrow(table)
+        np.testing.assert_array_equal(df["x"], np.arange(25.0))
+
+
+class TestStreamingIngestion:
+    def test_from_batches(self):
+        rng = np.random.default_rng(1)
+        x = rng.normal(size=(64, 4)).astype(np.float32)
+        y = rng.normal(size=64)
+        full = pa.table({
+            "features": pa.FixedSizeListArray.from_arrays(
+                pa.array(x.reshape(-1)), 4),
+            "y": y,
+        })
+        batches = full.to_batches(max_chunksize=10)
+        assert len(batches) > 1
+        df = DataFrame.from_arrow_batches(iter(batches))
+        np.testing.assert_array_equal(df["features"], x)
+        np.testing.assert_array_equal(df["y"], y)
+        # numeric columns stayed numeric end to end — no object detour
+        assert df["features"].dtype == np.float32
+        assert df["y"].dtype == np.float64
+
+    def test_batches_stay_zero_copy_per_chunk(self):
+        """Numeric batch columns must come through as views of the Arrow
+        buffers (no copy-through-Python-objects): each batch's converted
+        chunk shares memory with the parent table's buffer."""
+        from mmlspark_tpu.core.arrow import table_to_columns
+        table = pa.table({"x": np.arange(100.0),
+                          "v": pa.FixedSizeListArray.from_arrays(
+                              pa.array(np.arange(300.0)), 3)})
+        parent_x = table.column("x").chunk(0).to_numpy(
+            zero_copy_only=True)
+        parent_v = table.column("v").chunk(0).values.to_numpy(
+            zero_copy_only=True)
+        for batch in table.to_batches(max_chunksize=25):
+            cols, _ = table_to_columns(batch)
+            assert np.shares_memory(cols["x"], parent_x)
+            assert np.shares_memory(cols["v"], parent_v)
+
+    def test_schema_drift_raises(self):
+        b1 = pa.record_batch({"x": np.arange(3.0)})
+        b2 = pa.record_batch({"y": np.arange(3.0)})
+        with pytest.raises(ValueError, match="drift"):
+            DataFrame.from_arrow_batches(iter([b1, b2]))
+
+
+class TestEngineIntegration:
+    def test_arrow_to_gbdt_with_categoricals(self):
+        """Arrow dictionary column → categorical split training without
+        any manual re-indexing (the slot-threading contract)."""
+        from mmlspark_tpu.lightgbm import LightGBMClassifier
+        from mmlspark_tpu.lightgbm.trainer import roc_auc
+        rng = np.random.default_rng(3)
+        n = 800
+        cats = rng.integers(0, 8, size=n)
+        num = rng.normal(size=n).astype(np.float64)
+        y = ((np.isin(cats, [1, 5]) * 2.0 - 1.0 + num
+              + 0.3 * rng.normal(size=n)) > 0).astype(np.float64)
+        names = np.asarray(["c%d" % c for c in cats])
+        table = pa.table({
+            "city": pa.array(names).dictionary_encode(),
+            "num": num,
+            "label": y,
+        })
+        df = DataFrame.from_arrow(table)
+        from mmlspark_tpu.featurize import Featurize
+        feat = Featurize(inputCols=["city", "num"], outputCol="features")
+        fdf = feat.fit(df).transform(df)
+        m = LightGBMClassifier(numIterations=25, numLeaves=15,
+                               minDataInLeaf=5, seed=0).fit(fdf)
+        auc = roc_auc(fdf["label"], m.transform(fdf)["probability"][:, 1])
+        assert auc > 0.85
+
+    def test_to_arrow_then_pandas_parity(self):
+        df = sample_df()
+        pdf = df.to_arrow().to_pandas()
+        assert list(pdf.columns) == df.columns
+        np.testing.assert_allclose(pdf["x"].to_numpy(), df["x"])
+
+
+class TestReviewRepros:
+    def test_differing_dictionaries_across_batches(self):
+        """Arrow IPC streams may legally replace the dictionary mid-
+        stream; decoding per-batch indices against the last dictionary
+        would silently mislabel categories."""
+        b1 = pa.record_batch(
+            {"color": pa.array(["red", "blue"]).dictionary_encode()})
+        b2 = pa.record_batch(
+            {"color": pa.array(["green", "red"]).dictionary_encode()})
+        df = DataFrame.from_arrow_batches(iter([b1, b2]))
+        levels = ColumnMetadata.categorical_levels(df, "color")
+        decoded = [levels[int(i)] for i in df["color"]]
+        assert decoded == ["red", "blue", "green", "red"]
+
+    def test_bool_with_nulls_becomes_nan(self):
+        df = DataFrame.from_arrow(
+            pa.table({"b": pa.array([True, None, False])}))
+        assert df["b"].dtype != object
+        assert np.isnan(df["b"][1]) and df["b"][0] == 1.0
+
+    def test_float32_nulls_keep_dtype(self):
+        df = DataFrame.from_arrow(pa.table(
+            {"x": pa.array([1.0, None, 3.0], pa.float32())}))
+        assert df["x"].dtype == np.float32
+        assert np.isnan(df["x"][1])
