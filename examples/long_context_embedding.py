@@ -37,4 +37,23 @@ fr = np.stack(list(ring["features"]))
 err = float(np.abs(fr - fd).max())
 print(f"ring vs dense max err over 4096 tokens: {err:.2e}")
 assert err < 5e-2
+
+# raw strings work too: TokenIdEncoder (VW-murmur hash ids, pad id 0)
+# feeds the featurizer directly — no pre-tokenized input needed
+from mmlspark_tpu.core.pipeline import PipelineModel
+from mmlspark_tpu.featurize import TokenIdEncoder
+
+docs = DataFrame({"text": np.asarray(
+    ["long context models embed entire documents in one pass",
+     "short note"], object)})
+text_pipe = PipelineModel(stages=[
+    TokenIdEncoder(inputCol="text", outputCol="tokens", maxLength=64,
+                   vocabSize=8192),
+    TextEncoderFeaturizer(inputCol="tokens", outputCol="features",
+                          vocabSize=8192, width=128, depth=2,
+                          seqChunk=64),
+])
+emb = text_pipe.transform(docs)["features"]
+assert emb.shape == (2, 128) and np.isfinite(emb).all()
+print("raw-text pipeline:", emb.shape)
 done("long_context_embedding")

@@ -177,11 +177,14 @@ def from_arrow_batches(batches, num_partitions: int = 1):
     dictionary would silently mislabel categories)."""
     pa = _require_pyarrow()
     from .dataframe import DataFrame
+    schema = getattr(batches, "schema", None)  # RecordBatchReader
     batch_list = list(batches)
-    if not batch_list:
+    if not batch_list and schema is None:
         return DataFrame()
     try:
-        table = pa.Table.from_batches(batch_list)
+        # a known schema keeps zero-row streams schema-correct: the
+        # columns come through empty but named and typed
+        table = pa.Table.from_batches(batch_list, schema=schema)
     except pa.lib.ArrowInvalid as e:
         raise ValueError(f"batch schema drift: {e}") from e
     return from_arrow(table, num_partitions=num_partitions)

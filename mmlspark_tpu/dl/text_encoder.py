@@ -245,6 +245,6 @@ class TextEncoderFeaturizer(Transformer, HasInputCol, HasOutputCol,
             ids_dev = jax.device_put(
                 ids_dev, NamedSharding(self._mesh, P(None, "sp")))
         pooled = np.asarray(apply(variables, ids_dev))
-        out = np.empty(len(rows), object)
-        out[:] = list(pooled)
-        return df.with_column(self.get("outputCol"), out)
+        # [n, W] numeric matrix, like ImageFeaturizer — feeds
+        # TrainClassifier / Featurize without an object-column detour
+        return df.with_column(self.get("outputCol"), pooled)

@@ -226,3 +226,79 @@ class PageSplitter(Transformer, HasInputCol, HasOutputCol):
                     start = end
             out[i] = pages
         return df.with_column(self.getOutputCol(), out)
+
+
+class TokenIdEncoder(Transformer, HasInputCol, HasOutputCol):
+    """Raw strings → fixed-shape int32 token-id matrix [n, maxLength] —
+    the input ``TextEncoderFeaturizer`` consumes, closing the raw-text →
+    embedding chain (reference ``TextFeaturizer.scala``'s tokenize-first
+    design, applied to the transformer path).
+
+    Two vocabulary modes:
+    - hashing (default): id = 2 + murmur3_32(token) % (vocabSize - 2),
+      the VW-compatible stable hash (``vw/murmur.py``) — no fitting, no
+      vocabulary file, deterministic across processes;
+    - ``vocabFile``: one token per line, ids assigned in file order from
+      2; out-of-vocabulary tokens map to the UNK id 1.
+
+    Id 0 is PAD (masked out of attention and pooling downstream); id 1
+    is reserved for UNK. Sequences truncate at ``maxLength`` and pad
+    with 0.
+    """
+
+    maxLength = Param("maxLength", "token-id row width (truncate/pad)",
+                      TC.toInt, default=128)
+    vocabSize = Param("vocabSize", "hash-id space (must match the "
+                      "encoder's vocabSize)", TC.toInt, default=32768)
+    toLowercase = Param("toLowercase", "lowercase before splitting",
+                        TC.toBoolean, default=True)
+    pattern = Param("pattern", "regex split pattern", TC.toString,
+                    default=r"\W+")
+    vocabFile = Param("vocabFile", "optional vocabulary file "
+                      "(one token per line; OOV -> unk id 1)",
+                      TC.toString, default="")
+
+    def __init__(self, **kwargs):
+        super().__init__(**kwargs)
+        self._setDefault(inputCol="text", outputCol="tokens")
+        self._vocab_cache: tuple[str, dict] | None = None
+
+    def _vocab(self) -> dict | None:
+        path = self.get("vocabFile")
+        if not path:
+            return None
+        # cache key includes vocabSize so changing it after the first
+        # transform re-runs the size validation below
+        key = (path, self.get("vocabSize"))
+        if self._vocab_cache is None or self._vocab_cache[0] != key:
+            with open(path) as f:
+                tokens = [ln.rstrip("\n") for ln in f if ln.strip()]
+            if len(tokens) + 2 > self.get("vocabSize"):
+                raise ValueError(
+                    f"vocab file holds {len(tokens)} tokens but "
+                    f"vocabSize={self.get('vocabSize')} (ids 0/1 are "
+                    "reserved); raise vocabSize")
+            self._vocab_cache = (key,
+                                 {t: i + 2 for i, t in enumerate(tokens)})
+        return self._vocab_cache[1]
+
+    def _transform(self, df):
+        from ..vw.murmur import murmur3_32
+        lower = self.get("toLowercase")
+        pat = self.get("pattern")
+        L = self.get("maxLength")
+        space = self.get("vocabSize") - 2
+        if space < 1:
+            raise ValueError("vocabSize must be > 2")
+        vocab = self._vocab()
+        col = df[self.getInputCol()]
+        out = np.zeros((len(col), L), np.int32)
+        for i, text in enumerate(col.tolist()):
+            toks = _tokenize(text, lower, pat)[:L]
+            if vocab is None:
+                ids = [2 + murmur3_32(t.encode("utf-8")) % space
+                       for t in toks]
+            else:
+                ids = [vocab.get(t, 1) for t in toks]
+            out[i, :len(ids)] = ids
+        return df.with_column(self.getOutputCol(), out)

@@ -193,3 +193,9 @@ class TestReviewRepros:
             {"x": pa.array([1.0, None, 3.0], pa.float32())}))
         assert df["x"].dtype == np.float32
         assert np.isnan(df["x"][1])
+
+    def test_empty_reader_keeps_schema(self):
+        schema = pa.schema([("x", pa.float64())])
+        reader = pa.RecordBatchReader.from_batches(schema, [])
+        df = DataFrame.from_arrow_batches(reader)
+        assert df.columns == ["x"] and len(df) == 0

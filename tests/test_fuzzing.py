@@ -32,7 +32,8 @@ def make_test_objects() -> dict[str, TestObject]:
                                         Featurize, ValueIndexer)
     from mmlspark_tpu.featurize.text import (HashingTF, IDF, MultiNGram,
                                              PageSplitter, TextFeaturizer,
-                                             Tokenizer, NGram)
+                                             TokenIdEncoder, Tokenizer,
+                                             NGram)
     from mmlspark_tpu.stages.misc import EnsembleByKey
     from mmlspark_tpu.image import (ImageSetAugmenter, ImageTransformer,
                                     ResizeImageTransformer, UnrollImage)
@@ -121,6 +122,8 @@ def make_test_objects() -> dict[str, TestObject]:
         TestObject(CountSelector(inputCol="features",
                                  outputCol="sel"), num),
         TestObject(Tokenizer(inputCol="text", outputCol="tok"), text_df),
+        TestObject(TokenIdEncoder(inputCol="text", outputCol="ids",
+                                  maxLength=8, vocabSize=256), text_df),
         TestObject(NGram(inputCol="tok", outputCol="ngrams", n=2),
                    Tokenizer(inputCol="text",
                              outputCol="tok").transform(text_df)),

@@ -86,3 +86,76 @@ def test_empty_document_embeds_to_zeros():
     f = np.stack(list(out["features"]))
     assert np.isfinite(f).all()
     np.testing.assert_allclose(f[0], 0.0)
+
+
+class TestTokenIdEncoder:
+    """Raw text → token ids → transformer embeddings: the end-to-end
+    text chain (docs/limitations.md r2 gap: the featurizer previously
+    consumed pre-tokenized id rows only)."""
+
+    def test_raw_text_to_embeddings(self):
+        from mmlspark_tpu.core.pipeline import PipelineModel
+        from mmlspark_tpu.featurize import TokenIdEncoder
+        docs = ["The quick brown fox jumps over the lazy dog",
+                "pack my box with five dozen liquor jugs",
+                "tiny text"]
+        df = DataFrame({"text": np.asarray(docs, object)})
+        pipe = PipelineModel(stages=[
+            TokenIdEncoder(inputCol="text", outputCol="tokens",
+                           maxLength=16, vocabSize=4096),
+            TextEncoderFeaturizer(inputCol="tokens", outputCol="emb",
+                                  vocabSize=4096, width=32, depth=1,
+                                  heads=2, seqChunk=16),
+        ])
+        out = pipe.transform(df)
+        assert out["emb"].shape == (3, 32)
+        assert np.isfinite(out["emb"]).all()
+
+    def test_deterministic_and_padded(self):
+        from mmlspark_tpu.featurize import TokenIdEncoder
+        enc = TokenIdEncoder(maxLength=8, vocabSize=1024)
+        df = DataFrame({"text": np.asarray(
+            ["hello world", "hello world", "hello"], object)})
+        ids = enc.transform(df)["tokens"]
+        assert ids.dtype == np.int32 and ids.shape == (3, 8)
+        np.testing.assert_array_equal(ids[0], ids[1])  # stable hash
+        assert ids[0, 0] == ids[2, 0]          # same first token id
+        assert (ids[2, 1:] == 0).all()          # pad id 0
+        assert (ids[ids > 0] >= 2).all()        # 0/1 reserved
+
+    def test_truncation(self):
+        from mmlspark_tpu.featurize import TokenIdEncoder
+        long = " ".join(f"w{i}" for i in range(50))
+        enc = TokenIdEncoder(maxLength=8)
+        ids = enc.transform(DataFrame({"text": np.asarray([long],
+                                                          object)}))
+        assert ids["tokens"].shape == (1, 8)
+        assert (ids["tokens"] > 0).all()
+
+    def test_vocab_file_mode(self, tmp_path):
+        from mmlspark_tpu.featurize import TokenIdEncoder
+        vf = tmp_path / "vocab.txt"
+        vf.write_text("hello\nworld\n")
+        enc = TokenIdEncoder(maxLength=4, vocabFile=str(vf))
+        ids = enc.transform(DataFrame({"text": np.asarray(
+            ["hello world zzz"], object)}))["tokens"]
+        np.testing.assert_array_equal(ids[0], [2, 3, 1, 0])  # OOV -> 1
+
+    def test_vocab_too_big_raises(self, tmp_path):
+        from mmlspark_tpu.featurize import TokenIdEncoder
+        vf = tmp_path / "vocab.txt"
+        vf.write_text("\n".join(f"t{i}" for i in range(10)))
+        enc = TokenIdEncoder(vocabFile=str(vf), vocabSize=8)
+        with pytest.raises(ValueError, match="vocabSize"):
+            enc.transform(DataFrame({"text": np.asarray(["t1"], object)}))
+
+    def test_save_load_round_trip(self, tmp_path):
+        from mmlspark_tpu.core.serialize import load_stage
+        from mmlspark_tpu.featurize import TokenIdEncoder
+        enc = TokenIdEncoder(maxLength=8, vocabSize=512,
+                             inputCol="text", outputCol="ids")
+        enc.save(str(tmp_path / "enc"))
+        enc2 = load_stage(str(tmp_path / "enc"))
+        df = DataFrame({"text": np.asarray(["alpha beta"], object)})
+        np.testing.assert_array_equal(enc.transform(df)["ids"],
+                                      enc2.transform(df)["ids"])
