@@ -39,7 +39,8 @@ PACKAGES: dict[str, list[str]] = {
     "learners": ["test_learners.py", "test_linear.py",
                  "test_recommendation_lime.py", "test_cyber.py"],
     "io": ["test_native_codegen.py", "test_benchmarks.py",
-           "test_reference_parity.py", "test_ci.py"],
+           "test_reference_parity.py", "test_out_of_core.py",
+           "test_ci.py"],
 }
 
 
@@ -59,7 +60,7 @@ def style() -> int:
             "jax.config.update('jax_platforms', 'cpu'); "
             "from mmlspark_tpu.codegen import generate_all; "
             "d = tempfile.mkdtemp(); out = generate_all(d); "
-            "assert out['stubs'] and out['r'], out; "
+            "assert out['stubs'] and out['r'] and out['pyspark'], out; "
             "print('codegen OK:', {k: len(v) if isinstance(v, list) else v"
             " for k, v in out.items()})")
     env = dict(os.environ, JAX_PLATFORMS="cpu")

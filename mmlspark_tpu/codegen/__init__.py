@@ -8,14 +8,20 @@ become:
 - typed ``.pyi`` stubs making the synthesized ``setX``/``getX`` accessors
   static (IDE/typing parity with the reference's generated classes);
 - a markdown API reference (the reference's generated pydocs);
-- optional PySpark bridge wrappers, emitted only when pyspark is present
-  (the reference ships its wrappers inside a Spark distribution).
+- an installable R package layout (DESCRIPTION/NAMESPACE + roxygen
+  wrappers over reticulate, the sparklyr-equivalent surface);
+- a PySpark-facing wrapper package whose fluent ``setX``/``getX``
+  classes ingest Spark DataFrames over the Arrow bridge (generation
+  needs no pyspark installed; only *using* the Spark ingestion path
+  does).
 """
 
+from .pygen import generate_pyspark, pyspark_class_for
 from .rgen import generate_r, r_function_for, snake_case
 from .wrappable import (generate_all, generate_docs, generate_stubs,
                         param_type_hint, py_stub_for)
 
 __all__ = ["generate_r", "r_function_for", "snake_case",
            "generate_all", "generate_docs", "generate_stubs",
+           "generate_pyspark", "pyspark_class_for",
            "param_type_hint", "py_stub_for"]

@@ -1,0 +1,171 @@
+"""PySpark-facing wrapper generation.
+
+Reference ``codegen/Wrappable.scala:70-468`` (``PythonWrappable``): every
+stage renders a complete PySpark wrapper class with fluent
+``setX``/``getX`` accessors, so Spark users drive the framework without
+learning a new surface. Here the generated wrappers accept
+``pyspark.sql.DataFrame`` inputs and move data over the Arrow bridge
+(``core/arrow.py``) into the TPU engine — columns, vectors and
+dictionary-encoded categoricals land zero-copy/metadata-correct — then
+hand results back as Arrow/pandas for Spark re-ingestion.
+
+Generation is pure reflection over ``Params.params()`` (the same walk as
+the stub/R generators); the emitted package imports only
+``mmlspark_tpu`` at runtime and degrades gracefully when pyspark is
+absent (plain DataFrames pass through untouched), so the wrappers are
+testable without a Spark installation.
+"""
+
+from __future__ import annotations
+
+import inspect
+import os
+from collections import defaultdict
+
+from ..testing.fuzzing import iter_stage_classes
+from .wrappable import param_type_hint, _accessor
+
+_RUNTIME = '''\
+"""Runtime shims for the generated PySpark wrappers (auto-generated)."""
+
+from mmlspark_tpu.core import DataFrame as _TpuDataFrame
+
+
+def to_tpu(df):
+    """pyspark.sql.DataFrame | pandas | Arrow | mmlspark_tpu DataFrame
+    → mmlspark_tpu DataFrame, through Arrow wherever possible."""
+    if isinstance(df, _TpuDataFrame):
+        return df
+    mod = type(df).__module__
+    if mod.startswith("pyspark"):
+        if hasattr(df, "toArrow"):          # Spark >= 4
+            return _TpuDataFrame.from_arrow(df.toArrow())
+        if hasattr(df, "_collect_as_arrow"):  # Spark 3.x fast path
+            return _TpuDataFrame.from_arrow_batches(
+                iter(df._collect_as_arrow()))
+        return _TpuDataFrame.from_pandas(df.toPandas())
+    if mod.startswith("pandas"):
+        return _TpuDataFrame.from_pandas(df)
+    if mod.startswith("pyarrow"):
+        return _TpuDataFrame.from_arrow(df)
+    raise TypeError(f"cannot ingest {type(df)!r}")
+
+
+def from_tpu(df, like=None):
+    """mmlspark_tpu DataFrame → the caller's ecosystem: a Spark session
+    (when ``like`` is a pyspark DataFrame) re-ingests via Arrow/pandas;
+    otherwise the columnar frame passes through."""
+    if like is not None and type(like).__module__.startswith("pyspark"):
+        spark = like.sparkSession
+        try:
+            return spark.createDataFrame(df.to_arrow())
+        except Exception:
+            return spark.createDataFrame(df.to_pandas())
+    return df
+
+
+class WrappedModel:
+    """Generic fitted-model wrapper: transform + save + attribute pass-
+    through to the underlying mmlspark_tpu model."""
+
+    def __init__(self, inner):
+        self._inner = inner
+
+    def transform(self, df):
+        return from_tpu(self._inner.transform(to_tpu(df)), like=df)
+
+    def save(self, path):
+        self._inner.save(path)
+        return self
+
+    def __getattr__(self, name):
+        return getattr(self._inner, name)
+'''
+
+
+def pyspark_class_for(cls) -> str:
+    """One generated wrapper class (reference
+    ``PythonWrappable.pyClass``)."""
+    params = sorted(cls.params(), key=lambda p: p.name)
+    doc = (inspect.getdoc(cls) or cls.__name__).splitlines()[0]
+    lines = [
+        f"class {cls.__name__}:",
+        f'    """{doc}',
+        "",
+        "    Generated PySpark-facing wrapper over"
+        f" ``{cls.__module__}.{cls.__name__}``.",
+        '    """',
+        "",
+        "    def __init__(self, **kwargs):",
+        f"        from {cls.__module__} import {cls.__name__} as _Inner",
+        "        self._inner = _Inner(**kwargs)",
+        "",
+    ]
+    for p in params:
+        acc = _accessor(p.name)
+        hint = param_type_hint(p)
+        lines += [
+            f"    def set{acc}(self, value: {hint})"
+            f" -> \"{cls.__name__}\":",
+            f"        self._inner.set({p.name!r}, value)",
+            "        return self",
+            "",
+            f"    def get{acc}(self) -> {hint}:",
+            f"        return self._inner.get({p.name!r})",
+            "",
+        ]
+    from ..core import Estimator, Transformer
+    if issubclass(cls, Estimator):
+        lines += [
+            "    def fit(self, df):",
+            "        return _rt.WrappedModel(self._inner.fit("
+            "_rt.to_tpu(df)))",
+            "",
+        ]
+    if issubclass(cls, Transformer) and not issubclass(cls, Estimator):
+        lines += [
+            "    def transform(self, df):",
+            "        return _rt.from_tpu(self._inner.transform("
+            "_rt.to_tpu(df)), like=df)",
+            "",
+        ]
+    lines += [
+        "    def save(self, path):",
+        "        self._inner.save(path)",
+        "        return self",
+    ]
+    return "\n".join(lines)
+
+
+def generate_pyspark(out_dir: str) -> list[str]:
+    """Write the PySpark wrapper package: one module per stage package
+    plus the runtime shim; importable as a plain directory package."""
+    by_pkg: dict[str, list] = defaultdict(list)
+    for cls in iter_stage_classes():
+        by_pkg[cls.__module__.split(".")[1]].append(cls)
+    os.makedirs(out_dir, exist_ok=True)
+    written = []
+    rt_path = os.path.join(out_dir, "_runtime.py")
+    with open(rt_path, "w") as f:
+        f.write(_RUNTIME)
+    written.append(rt_path)
+    header = ("# Auto-generated PySpark wrappers — regenerate with\n"
+              "#   python -m mmlspark_tpu.codegen\n"
+              "from typing import Any\n"
+              "from . import _runtime as _rt\n\n\n")
+    pkg_names = []
+    for pkg, classes in sorted(by_pkg.items()):
+        path = os.path.join(out_dir, f"{pkg}.py")
+        body = "\n\n\n".join(
+            pyspark_class_for(c)
+            for c in sorted(classes, key=lambda c: c.__name__))
+        with open(path, "w") as f:
+            f.write(header + body + "\n")
+        written.append(path)
+        pkg_names.append(pkg)
+    init = os.path.join(out_dir, "__init__.py")
+    with open(init, "w") as f:
+        f.write("# Auto-generated PySpark wrapper package\n"
+                + "".join(f"from . import {p}\n" for p in pkg_names))
+    written.append(init)
+    return written

@@ -78,14 +78,24 @@ def r_function_for(cls) -> str:
 
 
 def generate_r(out_dir: str) -> list[str]:
-    """Write one R source file per stage package + a package loader."""
+    """Write an INSTALLABLE R package layout (reference
+    ``Wrappable.scala:471-495`` emits a full sparklyr package):
+
+        <out_dir>/DESCRIPTION          package metadata + reticulate dep
+        <out_dir>/NAMESPACE            export() directive per wrapper
+        <out_dir>/R/<package>.R        roxygen-documented wrappers
+        <out_dir>/R/zzz.R              .onLoad python-availability check
+
+    ``R CMD INSTALL <out_dir>`` (or ``devtools::load_all``) loads it."""
     by_pkg: dict[str, list] = defaultdict(list)
     for cls in iter_stage_classes():
         by_pkg[cls.__module__.split(".")[1]].append(cls)
-    os.makedirs(out_dir, exist_ok=True)
+    r_dir = os.path.join(out_dir, "R")
+    os.makedirs(r_dir, exist_ok=True)
     written = []
+    exports: list[str] = []
     for pkg, classes in sorted(by_pkg.items()):
-        path = os.path.join(out_dir, f"{pkg}.R")
+        path = os.path.join(r_dir, f"{pkg}.R")
         body = "\n\n\n".join(
             r_function_for(c)
             for c in sorted(classes, key=lambda c: c.__name__))
@@ -93,7 +103,13 @@ def generate_r(out_dir: str) -> list[str]:
             f.write("# Auto-generated R bindings — regenerate with\n"
                     "#   python -m mmlspark_tpu.codegen\n\n" + body + "\n")
         written.append(path)
-    loader = os.path.join(out_dir, "zzz.R")
+        for c in sorted(classes, key=lambda c: c.__name__):
+            fn = "ml_" + snake_case(c.__name__)
+            exports.append(fn)
+            for p in c.params():
+                if isinstance(p, ServiceParam):
+                    exports.append(f"{fn}_set_{snake_case(p.name)}_col")
+    loader = os.path.join(r_dir, "zzz.R")
     with open(loader, "w") as f:
         f.write(
             "# package hooks: verify the Python side is importable\n"
@@ -103,4 +119,25 @@ def generate_r(out_dir: str) -> list[str]:
             "            \"install it in the active python env\")\n"
             "}\n")
     written.append(loader)
+    desc = os.path.join(out_dir, "DESCRIPTION")
+    with open(desc, "w") as f:
+        f.write(
+            "Package: mmlsparktpu\n"
+            "Type: Package\n"
+            "Title: R Bindings for the mmlspark_tpu Framework\n"
+            "Version: 0.1.0\n"
+            "Description: Auto-generated wrappers over the Python\n"
+            "    mmlspark_tpu package (pipeline stages, distributed\n"
+            "    GBDT, featurizers, serving) via reticulate.\n"
+            "License: MIT\n"
+            "Encoding: UTF-8\n"
+            "Imports: reticulate\n"
+            "RoxygenNote: 7.0.0\n")
+    written.append(desc)
+    ns = os.path.join(out_dir, "NAMESPACE")
+    with open(ns, "w") as f:
+        f.write("# Auto-generated — regenerate with "
+                "python -m mmlspark_tpu.codegen\n"
+                + "".join(f"export({e})\n" for e in sorted(exports)))
+    written.append(ns)
     return written

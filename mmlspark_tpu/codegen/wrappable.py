@@ -137,8 +137,11 @@ def generate_docs(out_path: str) -> str:
 
 
 def generate_all(base_dir: str = "generated") -> dict:
+    from .pygen import generate_pyspark
     from .rgen import generate_r
     stubs = generate_stubs(os.path.join(base_dir, "stubs"))
     docs = generate_docs(os.path.join(base_dir, "docs", "api.md"))
-    r = generate_r(os.path.join(base_dir, "R"))
-    return {"stubs": stubs, "docs": docs, "r": r}
+    r = generate_r(os.path.join(base_dir, "r_package"))
+    pyspark = generate_pyspark(os.path.join(base_dir, "pyspark",
+                                            "mmlspark_tpu_spark"))
+    return {"stubs": stubs, "docs": docs, "r": r, "pyspark": pyspark}

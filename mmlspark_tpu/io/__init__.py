@@ -8,8 +8,10 @@ and the PowerBI streaming sink.
 from .binary import (BinaryFileReader, decode_image, read_binary_files,
                      read_images)
 from .image_source import FileStreamSource, ImageStreamSource
+from .parquet import read_parquet, stream_parquet, write_parquet
 from .powerbi import PowerBIWriter
 
 __all__ = ["BinaryFileReader", "decode_image", "read_binary_files",
            "read_images", "PowerBIWriter", "FileStreamSource",
-           "ImageStreamSource"]
+           "ImageStreamSource", "read_parquet", "stream_parquet",
+           "write_parquet"]

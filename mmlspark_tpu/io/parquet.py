@@ -1,0 +1,44 @@
+"""Out-of-core Parquet ingestion.
+
+Reference: Spark's data sources stream unbounded partitioned data from
+HDFS (``io/binary/BinaryFileFormat.scala:34-110`` rides that machinery);
+the reference never holds a dataset in one JVM. The TPU-native analog:
+``pyarrow.dataset`` scans Parquet files/directories in bounded-size
+record batches, each landing through the Arrow bridge
+(``core/arrow.py``) as an engine-ready DataFrame — memory is bounded by
+the batch size, not the dataset, and the GBDT/VW estimators consume the
+stream with booster/weight continuation (``fit_stream``).
+"""
+
+from __future__ import annotations
+
+from typing import Iterator
+
+
+def read_parquet(path, columns=None, num_partitions: int = 1):
+    """Whole-file read: Parquet file/directory → DataFrame (numeric
+    columns zero-copy through Arrow)."""
+    import pyarrow.parquet as pq
+    from ..core.arrow import from_arrow
+    table = pq.read_table(path, columns=columns)
+    return from_arrow(table, num_partitions=num_partitions)
+
+
+def stream_parquet(path, columns=None,
+                   batch_rows: int = 65536) -> Iterator:
+    """Streaming read: yields DataFrames of <= batch_rows rows each;
+    peak memory is one batch regardless of the dataset size. Accepts a
+    file, a directory of parquet parts, or a list of paths."""
+    import pyarrow.dataset as ds
+    from ..core.arrow import from_arrow
+    dataset = ds.dataset(path, format="parquet")
+    for batch in dataset.to_batches(columns=columns,
+                                    batch_size=batch_rows):
+        if batch.num_rows:
+            yield from_arrow(batch)
+
+
+def write_parquet(df, path) -> None:
+    """DataFrame → one Parquet file (the round-trip partner)."""
+    import pyarrow.parquet as pq
+    pq.write_table(df.to_arrow(), path)

@@ -100,17 +100,39 @@ class _LightGBMBase(Estimator, LightGBMSharedParams):
             parts = df.repartition(num_batches).partitions()
         else:
             parts = [df]
+        return self._fit_batches(parts, cat_slots)
 
+    def _fit_batches(self, batches, cat_slots=None):
+        """The ONE continuation loop behind both ``numBatches`` and
+        ``fit_stream``: warm start from ``modelString``, then each batch
+        continues the previous batch's booster."""
         booster: Booster | None = None
         if self.getModelString():
             booster = Booster.load_native(self.getModelString())
         result = None
-        for part in parts:
-            result = self._fit_batch(part, init_booster=booster,
-                                      cat_slots=cat_slots)
+        for batch in batches:
+            if cat_slots is None:
+                cat_slots = self._categorical_slots(batch)
+            result = self._fit_batch(batch, init_booster=booster,
+                                     cat_slots=cat_slots)
             booster = result.booster
+        if result is None:
+            raise ValueError("received an empty batch stream")
         model = self._make_model(booster, result)
         self._copy_params_to(model)
+        return model
+
+    def fit_stream(self, batches):
+        """Out-of-core training: consume an iterable of DataFrames (e.g.
+        ``io.parquet.stream_parquet``) one at a time with booster
+        continuation — the same per-batch loop as ``numBatches``
+        (reference ``LightGBMBase`` batch training /
+        ``BinaryFileFormat.scala:34-110``'s unbounded-source role), but
+        memory-bounded by the largest batch instead of the dataset.
+        Each batch must carry the same columns; categorical slots
+        resolve from the first batch's metadata."""
+        model = self._fit_batches(self._preprocess(b) for b in batches)
+        model._resolve_parent(self)
         return model
 
     def _fit_batch(self, df, init_booster: Booster | None,
@@ -442,6 +464,31 @@ class LightGBMRanker(_LightGBMBase, HasGroupCol):
 
     def _make_model(self, booster, result):
         return LightGBMRankerModel(booster=booster)
+
+    def fit_stream(self, batches):
+        """Streaming fit with a group-integrity guard: each batch must
+        hold WHOLE query groups (the reference repartitions by the
+        grouping column for exactly this reason,
+        ``LightGBMRanker.scala:92-101``) — a group straddling two
+        batches would train as two independent queries with corrupted
+        pairwise gradients, so a group id reappearing in a later batch
+        raises instead of silently mis-training."""
+        gcol = self.getGroupCol()
+        seen: set = set()
+
+        def guarded():
+            for batch in batches:
+                gids = set(np.asarray(batch[gcol]).tolist())
+                overlap = gids & seen
+                if overlap:
+                    raise ValueError(
+                        f"query group(s) {sorted(overlap)[:5]} span "
+                        "multiple stream batches; the ranker needs whole "
+                        "groups per batch — repartition the stream by "
+                        "the grouping column")
+                seen.update(gids)
+                yield batch
+        return super().fit_stream(guarded())
 
 
 class LightGBMRankerModel(_BoosterModelMixin, Model, LightGBMSharedParams,

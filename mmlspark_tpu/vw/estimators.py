@@ -138,6 +138,29 @@ class _VWBaseEstimator(Estimator, VowpalWabbitBaseParams):
         self._copy_params_to(model)
         return model
 
+    def fit_stream(self, batches):
+        """Out-of-core online learning: each DataFrame batch continues
+        from the previous batch's weights (the ``initialModel`` warm
+        start VW is built around) — memory bounded by one batch."""
+        state = self.get("initialModel")
+        cfg = self._config(self._loss_default)
+        seen = False
+        for batch in batches:
+            idx, val = self._features(batch)
+            y = self._prepare_labels(
+                np.asarray(batch[self.getLabelCol()], np.float32))
+            w = (np.asarray(batch[self.getWeightCol()], np.float32)
+                 if self.isSet("weightCol") else None)
+            state = train(idx, val, y, w, cfg, initial=state,
+                          mesh=self._mesh(idx.shape[0]))
+            seen = True
+        if not seen:
+            raise ValueError("fit_stream received an empty batch stream")
+        model = self._make_model(state)
+        self._copy_params_to(model)
+        model._resolve_parent(self)
+        return model
+
 
 class VowpalWabbitRegressionModel(Model, VowpalWabbitBaseParams):
     predictionCol = Param("predictionCol", "output column", TC.toString,

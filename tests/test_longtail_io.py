@@ -137,8 +137,9 @@ class TestRGeneration:
         from mmlspark_tpu.codegen import generate_r
         files = generate_r(str(tmp_path))
         names = {os.path.basename(f) for f in files}
-        assert {"lightgbm.R", "stages.R", "vw.R", "zzz.R"} <= names
-        lgbm = (tmp_path / "lightgbm.R").read_text()
+        assert {"lightgbm.R", "stages.R", "vw.R", "zzz.R",
+                "DESCRIPTION", "NAMESPACE"} <= names
+        lgbm = (tmp_path / "R" / "lightgbm.R").read_text()
         assert "ml_light_gbm_classifier <- function(" in lgbm
         assert "num_iterations = NULL" in lgbm
         assert "#' @export" in lgbm

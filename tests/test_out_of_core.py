@@ -1,0 +1,191 @@
+"""Out-of-core ingestion + streaming training (the reference inherits
+unbounded partitioned data from Spark, ``io/binary/
+BinaryFileFormat.scala:34-110``; here Parquet streams through the Arrow
+bridge into booster/weight-continuation training)."""
+
+import numpy as np
+import pytest
+
+pa = pytest.importorskip("pyarrow")
+
+from mmlspark_tpu.core import DataFrame  # noqa: E402
+from mmlspark_tpu.io import (read_parquet, stream_parquet,  # noqa: E402
+                             write_parquet)
+from mmlspark_tpu.lightgbm import LightGBMClassifier  # noqa: E402
+from mmlspark_tpu.lightgbm.trainer import roc_auc  # noqa: E402
+
+
+def make_df(n=4000, seed=0):
+    rng = np.random.default_rng(seed)
+    x = rng.normal(size=(n, 10)).astype(np.float32)
+    y = ((x[:, 0] * 2 - x[:, 1] + 0.5 * x[:, 2]
+          + rng.normal(scale=0.4, size=n)) > 0).astype(np.float64)
+    return DataFrame({"features": x, "label": y})
+
+
+class TestParquetRoundTrip:
+    def test_write_read(self, tmp_path):
+        df = make_df(500)
+        p = str(tmp_path / "data.parquet")
+        write_parquet(df, p)
+        back = read_parquet(p)
+        np.testing.assert_array_equal(back["features"], df["features"])
+        np.testing.assert_array_equal(back["label"], df["label"])
+
+    def test_stream_bounded_batches(self, tmp_path):
+        df = make_df(1000)
+        p = str(tmp_path / "data.parquet")
+        write_parquet(df, p)
+        sizes = [len(b) for b in stream_parquet(p, batch_rows=256)]
+        assert sum(sizes) == 1000
+        assert max(sizes) <= 256
+
+    def test_stream_directory_of_parts(self, tmp_path):
+        for i in range(3):
+            write_parquet(make_df(200, seed=i),
+                          str(tmp_path / f"part-{i}.parquet"))
+        total = sum(len(b) for b in stream_parquet(str(tmp_path)))
+        assert total == 600
+
+    def test_column_projection(self, tmp_path):
+        df = make_df(100)
+        p = str(tmp_path / "d.parquet")
+        write_parquet(df, p)
+        only = read_parquet(p, columns=["label"])
+        assert only.columns == ["label"]
+
+
+class TestStreamingTraining:
+    def test_gbdt_fit_stream_matches_batched_fit(self, tmp_path):
+        """fit_stream over parquet batches is the same algorithm as
+        numBatches over in-memory partitions — identical quality, one
+        batch of memory."""
+        df = make_df(4000)
+        p = str(tmp_path / "train.parquet")
+        write_parquet(df, p)
+        kw = dict(numIterations=10, numLeaves=15, minDataInLeaf=5,
+                  numShards=1, seed=0)
+        streamed = LightGBMClassifier(**kw).fit_stream(
+            stream_parquet(p, batch_rows=1000))
+        auc_s = roc_auc(df["label"],
+                        streamed.transform(df)["probability"][:, 1])
+        batched = LightGBMClassifier(numBatches=4, **kw).fit(df)
+        auc_b = roc_auc(df["label"],
+                        batched.transform(df)["probability"][:, 1])
+        assert auc_s > 0.9
+        assert abs(auc_s - auc_b) < 0.03, (auc_s, auc_b)
+        # continuation really happened: 4 batches x numIterations trees
+        assert streamed.booster.num_trees == 40
+
+    def test_gbdt_empty_stream_raises(self):
+        with pytest.raises(ValueError, match="empty"):
+            LightGBMClassifier().fit_stream(iter([]))
+
+    def test_vw_fit_stream(self, tmp_path):
+        from mmlspark_tpu.vw import VowpalWabbitClassifier
+        df = make_df(3000, seed=5)
+        p = str(tmp_path / "vw.parquet")
+        write_parquet(df, p)
+        m = VowpalWabbitClassifier(numPasses=3, batchSize=128,
+                                   numShards=1).fit_stream(
+            stream_parquet(p, batch_rows=750))
+        auc = roc_auc(df["label"],
+                      m.transform(df)["probability"][:, 1])
+        assert auc > 0.9, auc
+
+
+class TestGeneratedWrappers:
+    def test_pyspark_package_generates_and_runs(self, tmp_path):
+        """The generated PySpark wrapper package imports standalone and
+        drives a full fit/transform through the Arrow/pandas ingestion
+        shim (reference Wrappable.scala:70-468's generated surface)."""
+        import importlib
+        import sys
+        from mmlspark_tpu.codegen.pygen import generate_pyspark
+        out = generate_pyspark(str(tmp_path / "mmlspark_tpu_spark"))
+        assert any(f.endswith("lightgbm.py") for f in out)
+        sys.path.insert(0, str(tmp_path))
+        try:
+            sp = importlib.import_module("mmlspark_tpu_spark")
+            df = make_df(600)
+            clf = (sp.lightgbm.LightGBMClassifier()
+                   .setNumIterations(10).setNumLeaves(7).setSeed(0))
+            assert clf.getNumIterations() == 10
+            model = clf.fit(df)
+            out_df = model.transform(df)
+            auc = roc_auc(df["label"], out_df["probability"][:, 1])
+            assert auc > 0.9
+        finally:
+            sys.path.remove(str(tmp_path))
+            sys.modules.pop("mmlspark_tpu_spark", None)
+
+    def test_pyspark_wrapper_param_surface_complete(self, tmp_path):
+        """Every param of every stage gets a fluent setX/getX pair."""
+        from mmlspark_tpu.codegen.pygen import pyspark_class_for
+        from mmlspark_tpu.lightgbm import LightGBMClassifier as Inner
+        src = pyspark_class_for(Inner)
+        for p in Inner.params():
+            acc = p.name[0].upper() + p.name[1:]
+            assert f"def set{acc}(" in src, p.name
+            assert f"def get{acc}(" in src, p.name
+
+    def test_r_package_layout(self, tmp_path):
+        import os
+        from mmlspark_tpu.codegen.rgen import generate_r
+        files = generate_r(str(tmp_path / "r_package"))
+        names = {os.path.relpath(f, str(tmp_path / "r_package"))
+                 for f in files}
+        assert "DESCRIPTION" in names and "NAMESPACE" in names
+        ns = open(str(tmp_path / "r_package" / "NAMESPACE")).read()
+        assert "export(ml_light_gbm_classifier)" in ns
+        desc = open(str(tmp_path / "r_package" / "DESCRIPTION")).read()
+        assert "Imports: reticulate" in desc
+        # every exported symbol is defined in some R source
+        import re
+        defined = set()
+        for f in files:
+            if f.endswith(".R"):
+                defined |= set(re.findall(
+                    r"^([a-z0-9_]+) <- function", open(f).read(),
+                    re.MULTILINE))
+        exported = set(re.findall(r"export\(([^)]+)\)", ns))
+        assert exported <= defined, exported - defined
+
+
+class TestStreamFitSemantics:
+    def test_fit_stream_resolves_parent(self, tmp_path):
+        df = make_df(500)
+        clf = LightGBMClassifier(numIterations=3, numLeaves=7, seed=0)
+        m = clf.fit_stream(iter([df]))
+        assert m.parent is clf
+
+    def test_ranker_rejects_straddling_groups(self):
+        from mmlspark_tpu.lightgbm import LightGBMRanker
+        rng = np.random.default_rng(0)
+        x = rng.normal(size=(60, 4)).astype(np.float32)
+        rel = rng.integers(0, 3, size=60).astype(np.float32)
+        qid = np.repeat(np.arange(6), 10)
+        b1 = DataFrame({"features": x[:35], "label": rel[:35],
+                        "query": qid[:35]})  # group 3 straddles
+        b2 = DataFrame({"features": x[35:], "label": rel[35:],
+                        "query": qid[35:]})
+        r = LightGBMRanker(groupCol="query", numIterations=3,
+                           numLeaves=7, minDataInLeaf=2)
+        with pytest.raises(ValueError, match="span"):
+            r.fit_stream(iter([b1, b2]))
+
+    def test_ranker_fit_stream_whole_groups_ok(self):
+        from mmlspark_tpu.lightgbm import LightGBMRanker
+        rng = np.random.default_rng(1)
+        x = rng.normal(size=(80, 4)).astype(np.float32)
+        rel = np.clip((x[:, 0] * 2).round(), 0, 3).astype(np.float32)
+        qid = np.repeat(np.arange(8), 10)
+        b1 = DataFrame({"features": x[:40], "label": rel[:40],
+                        "query": qid[:40]})
+        b2 = DataFrame({"features": x[40:], "label": rel[40:],
+                        "query": qid[40:]})
+        r = LightGBMRanker(groupCol="query", numIterations=5,
+                           numLeaves=7, minDataInLeaf=2)
+        m = r.fit_stream(iter([b1, b2]))
+        full = DataFrame({"features": x, "label": rel, "query": qid})
+        assert m.evaluate_ndcg(full, k=5) > 0.7
