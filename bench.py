@@ -8,7 +8,10 @@ bf16 ResNet-50 inference figure (~2500 img/s) per the BASELINE.json
 "≥3× A100 on a v5e-64 pod" target — 1.0 is chip-for-chip A100 parity.
 
 ``extras`` carries the rest of the suite (VERDICT r1 item 2):
-- ``resnet50_mfu`` — achieved FLOP/s ÷ chip peak (XLA cost analysis).
+- ``resnet50_mfu`` — achieved FLOP/s ÷ chip peak (XLA cost analysis),
+  best over a batch-size sweep with bf16-cast weights.
+- ``vit_mfu`` / ``encoder_mfu`` — ViT-B/16 and the long-context
+  TextEncoder under the same sweep harness.
 - ``gbdt_rows_per_sec`` — LightGBMClassifier training row-scans/sec
   (rows × iterations ÷ fit seconds) on a Higgs-shaped synthetic
   (28 features; ``docs/lightgbm.md:17-21`` is the speed claim being
@@ -105,6 +108,69 @@ def _watchdog(fn, extras: dict, key: str, timeout_s: float):
     return box.get("result")
 
 
+def _mfu_sweep(module, variables, make_input, batches, *, iters=20,
+               fallback_flops_per_item=0.0, output_key=None):
+    """Best-of-batch-sweep inference throughput + MFU for one model.
+
+    Weights are cast to bf16 (inference-only: halves the HBM weight
+    traffic that bounds the small-batch regime) and live on device; the
+    timed loop re-dispatches a resident input, so the number is the
+    compute path, not host→device transfer. Returns
+    (items/sec, mfu, best_batch, flops_per_item)."""
+    import jax
+    import jax.numpy as jnp
+
+    device = jax.devices()[0]
+    variables = jax.tree.map(
+        lambda a: a.astype(jnp.bfloat16)
+        if hasattr(a, "dtype") and a.dtype == jnp.float32 else a,
+        variables)
+    variables = jax.device_put(variables, device)
+
+    @jax.jit
+    def forward(x):
+        out = module.apply(variables, x, False)
+        return out[output_key] if output_key else out
+
+    best = (0.0, 0.0, 0, 0.0)
+    per_batch: dict[int, float] = {}
+    for batch in batches:
+        # one failing point (e.g. the largest batch OOMing HBM) must not
+        # discard the measurements already banked
+        try:
+            x = jax.device_put(make_input(batch), device)
+            # ONE compile per point: the AOT executable serves cost
+            # analysis, warmup and the timed loop (re-jitting the same
+            # computation doubles the remote-compiler round trips)
+            compiled = forward.lower(x).compile()
+            try:
+                cost = compiled.cost_analysis()
+                if isinstance(cost, (list, tuple)):
+                    cost = cost[0]
+                flops_per_batch = float(cost.get("flops", 0.0)) or \
+                    fallback_flops_per_item * batch
+            except Exception:
+                flops_per_batch = fallback_flops_per_item * batch
+            compiled(x).block_until_ready()
+            for _ in range(3):
+                compiled(x).block_until_ready()
+            t0 = time.perf_counter()
+            for _ in range(iters):
+                out = compiled(x)
+            out.block_until_ready()
+            dt = time.perf_counter() - t0
+        except Exception:
+            continue
+        ips = batch * iters / dt
+        per_batch[batch] = round(ips, 1)
+        mfu = ips / batch * flops_per_batch / V5E_PEAK_BF16_FLOPS
+        if ips > best[0]:
+            best = (ips, mfu, batch, flops_per_batch / batch)
+    if not per_batch:
+        raise RuntimeError(f"every batch size in {batches} failed")
+    return best, per_batch
+
+
 def bench_resnet(extras: dict) -> float:
     import jax
     import jax.numpy as jnp
@@ -114,50 +180,94 @@ def bench_resnet(extras: dict) -> float:
 
     loaded = ModelDownloader().download_by_name(
         "ResNet50", allow_random_init=True)  # weights init on host CPU
-    module, variables = loaded.module, loaded.variables
-
-    device = jax.devices()[0]
-    variables = jax.device_put(variables, device)
-
-    batch = 128
-
-    @jax.jit
-    def forward(x):
-        return module.apply(variables, x, False)["pooled"]
 
     rng = np.random.default_rng(0)
-    x = jax.device_put(
-        jnp.asarray(rng.normal(size=(batch, 224, 224, 3)), jnp.bfloat16),
-        device)
 
-    lowered = forward.lower(x)
-    compiled = lowered.compile()
+    def make_input(batch):
+        return jnp.asarray(rng.normal(size=(batch, 224, 224, 3)),
+                           jnp.bfloat16)
+
+    raw = os.environ.get("MMLSPARK_TPU_BENCH_RESNET_BATCHES",
+                         "128,256,512")
     try:
-        cost = compiled.cost_analysis()
-        if isinstance(cost, (list, tuple)):
-            cost = cost[0]
-        flops_per_batch = float(cost.get("flops", 0.0)) or \
-            RESNET50_FLOPS_PER_IMAGE * batch
-    except Exception:
-        flops_per_batch = RESNET50_FLOPS_PER_IMAGE * batch
-
-    forward(x).block_until_ready()  # compile+warm
-    for _ in range(3):
-        forward(x).block_until_ready()
-
-    iters = 20
-    t0 = time.perf_counter()
-    for _ in range(iters):
-        out = forward(x)
-    out.block_until_ready()
-    dt = time.perf_counter() - t0
-
-    images_per_sec = batch * iters / dt
-    extras["resnet50_flops_per_batch"] = flops_per_batch
-    extras["resnet50_mfu"] = round(
-        images_per_sec / batch * flops_per_batch / V5E_PEAK_BF16_FLOPS, 4)
+        batches = tuple(int(b) for b in raw.split(",") if b.strip())
+        assert batches
+    except (ValueError, AssertionError):
+        batches = (128, 256, 512)  # a bad knob must never cost the line
+    (ips, mfu, batch, fpi), per_batch = _mfu_sweep(
+        loaded.module, loaded.variables, make_input, batches,
+        fallback_flops_per_item=RESNET50_FLOPS_PER_IMAGE,
+        output_key="pooled")
+    extras["resnet50_mfu"] = round(mfu, 4)
+    extras["resnet50_best_batch"] = batch
+    extras["resnet50_ips_by_batch"] = per_batch
+    extras["resnet50_flops_per_image"] = fpi
     extras["platform"] = jax.devices()[0].platform
-    return images_per_sec
+    # the headline vs_baseline stays the batch-128 point (the A100
+    # figure is a batch~128 number and earlier rounds measured 128);
+    # the sweep best is in extras
+    extras["resnet50_best_images_per_sec"] = round(ips, 1)
+    return per_batch.get(128, ips)
+
+
+def bench_vit(extras: dict) -> None:
+    """ViT-B/16 inference MFU: transformer blocks are pure matmuls, the
+    cleanest MXU utilization read the zoo offers."""
+    import jax.numpy as jnp
+    import numpy as np
+
+    from mmlspark_tpu.models import ModelDownloader
+
+    loaded = ModelDownloader().download_by_name(
+        "ViT_B_16", allow_random_init=True)
+    rng = np.random.default_rng(1)
+
+    def make_input(batch):
+        return jnp.asarray(rng.normal(size=(batch, 224, 224, 3)),
+                           jnp.bfloat16)
+
+    # analytic fallback when XLA cost analysis is unavailable:
+    # ViT-B/16 at 224² is ~17.6 GFLOPs/image (the published figure)
+    (ips, mfu, batch, _), per_batch = _mfu_sweep(
+        loaded.module, loaded.variables, make_input, (64, 128, 256),
+        fallback_flops_per_item=17.6e9, output_key="pooled")
+    extras["vit_images_per_sec"] = round(ips, 1)
+    extras["vit_mfu"] = round(mfu, 4)
+    extras["vit_best_batch"] = batch
+    extras["vit_ips_by_batch"] = per_batch
+
+
+def bench_encoder(extras: dict) -> None:
+    """TextEncoder forward MFU at a long-context shape."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from mmlspark_tpu.dl.text_encoder import TextEncoder
+
+    W, depth, mlp, T = 512, 8, 2048, 2048
+    module = TextEncoder(vocab=32768, width=W, depth=depth, heads=8,
+                         mlp_dim=mlp)
+    rng = np.random.default_rng(2)
+    ids0 = jnp.asarray(rng.integers(1, 32768, size=(1, T)), jnp.int32)
+    with jax.default_device(jax.local_devices(backend="cpu")[0]):
+        variables = module.init(jax.random.PRNGKey(0), ids0, False)
+
+    def make_input(batch):
+        return jnp.asarray(rng.integers(1, 32768, size=(batch, T)),
+                           jnp.int32)
+
+    # analytic transformer-FLOPs fallback: per token per block,
+    # qkv+out 8W², mlp 4·W·mlp, attention 4·T·W
+    flops_per_seq = depth * T * (8 * W * W + 4 * W * mlp + 4 * T * W)
+    (ips, mfu, batch, _), per_batch = _mfu_sweep(
+        module, variables, make_input, (8, 16, 32), iters=10,
+        fallback_flops_per_item=float(flops_per_seq),
+        output_key="pooled")
+    extras["encoder_seqs_per_sec"] = round(ips, 1)
+    extras["encoder_mfu"] = round(mfu, 4)
+    extras["encoder_best_batch"] = batch
+    extras["encoder_ips_by_batch"] = per_batch
 
 
 def bench_gbdt(extras: dict) -> None:
@@ -398,6 +508,10 @@ def main():
         if want("resnet"):
             images_per_sec = _watchdog(bench_resnet, extras, "resnet",
                                        600.0) or 0.0
+        if want("vit"):
+            _watchdog(bench_vit, extras, "vit", 600.0)
+        if want("encoder"):
+            _watchdog(bench_encoder, extras, "encoder", 420.0)
         if want("gbdt"):
             _watchdog(bench_gbdt, extras, "gbdt", 420.0)
         if want("ranker"):
