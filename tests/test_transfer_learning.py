@@ -141,7 +141,10 @@ def test_pretrained_chain_torch_to_featurizer(tmp_path):
     rng = np.random.default_rng(0)
     imgs, labels = gratings(480, freq=4.0, rng=rng)
 
-    # -- pretext training in torch (the oracle side of the converter)
+    # -- pretext training in torch (the oracle side of the converter).
+    # Parameter init draws from torch's GLOBAL rng — pin it so suite
+    # ordering cannot hand this test a different starting point.
+    torch.manual_seed(0)
     model = TorchResNet(TorchBasic, [2, 2, 2, 2], width=64,
                         num_classes=len(ORIENTATIONS))
     opt = torch.optim.Adam(model.parameters(), lr=1e-3)
@@ -149,7 +152,10 @@ def test_pretrained_chain_torch_to_featurizer(tmp_path):
     yb = torch.tensor(labels, dtype=torch.long)
     g = torch.Generator().manual_seed(0)
     model.train()
-    for _ in range(30):
+    # 120 steps: enough for orientation features to consolidate (at ~30
+    # the loss is near zero but the representation barely beats random
+    # pooled-conv features on the held-out frequency)
+    for _ in range(120):
         idx = torch.randint(0, len(imgs), (64,), generator=g)
         opt.zero_grad()
         loss = torch.nn.functional.cross_entropy(model(xb[idx]), yb[idx])
