@@ -13,12 +13,14 @@ reference's semantics: in-flight requests are replayed if a batch fails
 """
 
 from .distributed import (DistributedServingServer, DriverRegistry,
+                          NativeDistributedServingServer,
                           RegistryClient, ServiceInfo, remote_worker_loop)
 from .server import ServingServer, serving_query
 from .udfs import make_reply_udf, send_reply_udf
 from .dsl import read_stream
 
-__all__ = ["DistributedServingServer", "DriverRegistry", "RegistryClient",
+__all__ = ["DistributedServingServer", "NativeDistributedServingServer",
+           "DriverRegistry", "RegistryClient",
            "ServiceInfo", "ServingServer", "remote_worker_loop",
            "serving_query", "make_reply_udf", "send_reply_udf",
            "read_stream"]

@@ -37,6 +37,7 @@ import numpy as np
 
 from ..core import DataFrame
 from ..io.http.schema import HTTPRequestData, HTTPResponseData
+from .native_front import NativeServingServer
 from .server import (CachedRequest, LowLatencyHandlerMixin,
                      QuietHTTPServer, ServingServer, _LOG)
 
@@ -450,3 +451,16 @@ def remote_worker_loop(driver_address, service_name: str, transform_fn,
                 idle = min(idle * 2, max_idle_interval)
     finally:
         conns.close()
+
+
+class NativeDistributedServingServer(DistributedServingServer,
+                                     NativeServingServer):
+    """Distributed worker whose public ingress is the native epoll front
+    (``httpfront.cpp``): the low-tail-latency reactor serves client
+    traffic AND the mesh-internal ``__reply__``/``__lease__`` endpoints —
+    both fronts share ``_init_shared_state``'s route table, so every
+    piece of the distributed logic (registration, cross-worker reply
+    routing, lease replay) is inherited unchanged; the MRO routes
+    ``DistributedServingServer``'s ``super()`` calls to the native
+    front. Raises at construction when the native toolchain is
+    unavailable (mirroring ``serving_query(backend="native")``)."""
