@@ -177,3 +177,24 @@ class TestDistributedRanker:
         s1 = np.asarray(m1.transform(df)["prediction"])
         s8 = np.asarray(m8.transform(df)["prediction"])
         np.testing.assert_allclose(s1, s8, atol=5e-3)
+
+
+class TestDistributedDart:
+    def test_dart_sharded_matches_single_device(self):
+        """Fused DART under the sharded histogram path: the drop-set /
+        rescale machinery operates on globally-replicated score and
+        delta buffers, so sharding must only change histogram summation
+        order (statistical, not structural, differences)."""
+        df = make_binary(n=1100)
+        kw = dict(boostingType="dart", numIterations=20, numLeaves=15,
+                  dropRate=0.25, skipDrop=0.3, seed=0)
+        single = LightGBMClassifier(numShards=1, **kw).fit(df)
+        sharded = LightGBMClassifier(numShards=8, **kw).fit(df)
+        y = df["label"]
+        auc_1 = roc_auc(y, single.transform(df)["probability"][:, 1])
+        auc_8 = roc_auc(y, sharded.transform(df)["probability"][:, 1])
+        assert auc_1 > 0.9
+        assert abs(auc_1 - auc_8) < 0.02
+        np.testing.assert_allclose(
+            single.transform(df)["probability"][:, 1],
+            sharded.transform(df)["probability"][:, 1], atol=5e-3)
