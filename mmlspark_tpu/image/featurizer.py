@@ -35,9 +35,13 @@ class ImageFeaturizer(Transformer, HasInputCol, HasOutputCol):
     miniBatchSize = Param("miniBatchSize", "device batch size", TC.toInt,
                           default=64, has_default=True)
 
+    # class-level fallback: the serializer reconstructs without __init__
+    _tpu_model = None
+
     def __init__(self, **kwargs):
         super().__init__(**kwargs)
         self._setDefault(inputCol="image", outputCol="features")
+        self._tpu_model = None
 
     def setModel(self, name_or_model):
         """Accepts a zoo name or a LoadedModel (reference
@@ -67,7 +71,14 @@ class ImageFeaturizer(Transformer, HasInputCol, HasOutputCol):
             df = ResizeImageTransformer(
                 inputCol=col, outputCol=col, height=size,
                 width=size).transform(df)
-        tpu_model = TPUModel(
-            model=loaded, inputCol=col, outputCol=self.getOutputCol(),
-            outputNode=endpoint, minibatchSize=self.get("miniBatchSize"))
-        return tpu_model.transform(df)
+        # reuse ONE TPUModel across transforms (its jitted apply is
+        # cached per model identity — a fresh instance per call would
+        # retrace and recompile every time)
+        key = (id(loaded), endpoint, col, self.getOutputCol(),
+               self.get("miniBatchSize"))
+        if self._tpu_model is None or self._tpu_model[0] != key:
+            self._tpu_model = (key, TPUModel(
+                model=loaded, inputCol=col,
+                outputCol=self.getOutputCol(), outputNode=endpoint,
+                minibatchSize=self.get("miniBatchSize")))
+        return self._tpu_model[1].transform(df)

@@ -225,3 +225,30 @@ class TestIO:
         assert img.shape == (4, 5, 3)
         # BGR order: red is the LAST channel
         assert img[0, 0, 2] == 255 and img[0, 0, 0] == 0
+
+
+def test_tpumodel_caches_jitted_apply():
+    """Repeated transforms must not retrace/recompile (through a remote
+    compiler that is the whole latency budget): one jit trace serves
+    every transform of the same model."""
+    count = {"n": 0}
+
+    class Counting(ResNet):
+        def __call__(self, x, train=False):
+            count["n"] += 1
+            return super().__call__(x, train)
+
+    m = Counting(stage_sizes=(1,), block=BasicBlock, width=8,
+                 num_classes=2, dtype=jnp.float32)
+    v = m.init(__import__("jax").random.PRNGKey(0),
+               jnp.zeros((1, 16, 16, 3)), False)
+    base = count["n"]
+    tm = TPUModel(model=(m, v), inputCol="image", outputCol="out",
+                  outputNode="pooled", minibatchSize=4)
+    df = DataFrame({"image": np.random.default_rng(0).normal(
+        size=(8, 16, 16, 3)).astype(np.float32)})
+    out1 = tm.transform(df)["out"]
+    out2 = tm.transform(df)["out"]
+    tm.transform(df)
+    assert count["n"] - base == 1, f"{count['n'] - base} traces"
+    np.testing.assert_array_equal(out1, out2)
