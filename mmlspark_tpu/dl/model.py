@@ -69,7 +69,12 @@ class TPUModel(Model, HasInputCol, HasOutputCol):
     def _apply_fn(self):
         """The jitted apply, cached per (module, variables) identity: a
         fresh closure per transform would RETRACE the model every call —
-        through a remote compiler that is the whole latency budget."""
+        through a remote compiler that is the whole latency budget.
+
+        Identity keying means weight UPDATES must arrive by reassignment
+        (``set("model", ...)`` / a new LoadedModel), never by mutating
+        the cached variables pytree in place — in-place writes would
+        silently serve the stale compiled weights."""
         module, variables = self._loaded()
         key = (id(module), id(variables))
         if self._run_cache is None or self._run_cache[0] != key:

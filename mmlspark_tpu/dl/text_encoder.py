@@ -53,19 +53,34 @@ class EncoderBlock(nn.Module):
     (``fn(q, k, v, key_mask)``, [B,H,T,D]³ → [B,H,T,D]) — the block is
     agnostic to whether the sequence axis is sharded. ``key_mask``
     excludes padding keys from every softmax, so a row's output never
-    depends on how far the batch was padded."""
+    depends on how far the batch was padded.
+
+    Setup-style with the attention residual (``attend``) and the
+    feed-forward residual (``ffn``) callable separately: the MoE encoder
+    (``models.moe.make_moe_text_encoder``) keeps the attention trunk and
+    swaps ``ffn`` for an expert-parallel mixture."""
     heads: int
     mlp_dim: int
+    width: int
     attention_fn: Callable = _dense_attention
     dtype: Any = jnp.bfloat16
 
-    @nn.compact
-    def __call__(self, x, key_mask=None):
-        W = x.shape[-1]
+    def setup(self):
+        W = self.width
+        self.ln_1 = nn.LayerNorm(dtype=jnp.float32, name="ln_1")
+        self.qkv_proj = nn.Dense(3 * W, dtype=self.dtype, name="qkv")
+        self.out_proj = nn.Dense(W, dtype=self.dtype, name="out")
+        self.ln_2 = nn.LayerNorm(dtype=jnp.float32, name="ln_2")
+        self.mlp_in = nn.Dense(self.mlp_dim, dtype=self.dtype,
+                               name="mlp_1")
+        self.mlp_out = nn.Dense(W, dtype=self.dtype, name="mlp_2")
+
+    def attend(self, x, key_mask=None):
+        """The attention residual: x + out_proj(attention(qkv(ln_1 x)))."""
+        W = self.width
         hd = W // self.heads
-        h = nn.LayerNorm(dtype=jnp.float32, name="ln_1")(x)
-        h = h.astype(self.dtype)
-        qkv = nn.Dense(3 * W, dtype=self.dtype, name="qkv")(h)
+        h = self.ln_1(x).astype(self.dtype)
+        qkv = self.qkv_proj(h)
         q, k, v = jnp.split(qkv, 3, axis=-1)
 
         def split(a):
@@ -75,12 +90,21 @@ class EncoderBlock(nn.Module):
         o = self.attention_fn(split(q), split(k), split(v), key_mask)
         B, H, T, D = o.shape
         o = o.transpose(0, 2, 1, 3).reshape(B, T, W).astype(self.dtype)
-        x = x + nn.Dense(W, dtype=self.dtype, name="out")(o)
-        h = nn.LayerNorm(dtype=jnp.float32, name="ln_2")(x)
-        h = nn.Dense(self.mlp_dim, dtype=self.dtype,
-                     name="mlp_1")(h.astype(self.dtype))
+        return x + self.out_proj(o)
+
+    def pre_ffn_norm(self, x):
+        """ln_2 alone — the MoE variant normalizes before its experts."""
+        return self.ln_2(x)
+
+    def ffn(self, x):
+        """The dense feed-forward residual."""
+        h = self.ln_2(x)
+        h = self.mlp_in(h.astype(self.dtype))
         h = nn.gelu(h)
-        return x + nn.Dense(W, dtype=self.dtype, name="mlp_2")(h)
+        return x + self.mlp_out(h)
+
+    def __call__(self, x, key_mask=None):
+        return self.ffn(self.attend(x, key_mask))
 
 
 class TextEncoder(nn.Module):
@@ -103,7 +127,7 @@ class TextEncoder(nn.Module):
     def setup(self):
         self.embed_layer = nn.Embed(self.vocab, self.width,
                                     dtype=self.dtype, name="embed")
-        self.blocks = [EncoderBlock(self.heads, self.mlp_dim,
+        self.blocks = [EncoderBlock(self.heads, self.mlp_dim, self.width,
                                     attention_fn=self.attention_fn,
                                     dtype=self.dtype, name=f"block{i}")
                        for i in range(self.depth)]

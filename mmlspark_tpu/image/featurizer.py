@@ -35,13 +35,15 @@ class ImageFeaturizer(Transformer, HasInputCol, HasOutputCol):
     miniBatchSize = Param("miniBatchSize", "device batch size", TC.toInt,
                           default=64, has_default=True)
 
-    # class-level fallback: the serializer reconstructs without __init__
+    # class-level fallbacks: the serializer reconstructs without __init__
     _tpu_model = None
+    _loaded_cache = None
 
     def __init__(self, **kwargs):
         super().__init__(**kwargs)
         self._setDefault(inputCol="image", outputCol="features")
         self._tpu_model = None
+        self._loaded_cache = None
 
     def setModel(self, name_or_model):
         """Accepts a zoo name or a LoadedModel (reference
@@ -54,7 +56,16 @@ class ImageFeaturizer(Transformer, HasInputCol, HasOutputCol):
         m = self.get("model")
         if m is not None:
             return m
-        return ModelDownloader().download_by_name(self.get("modelName"))
+        # cache the zoo resolution per (name, model dir): a fresh
+        # LoadedModel per transform would defeat the TPUModel jit cache
+        # (new identity → retrace) and re-restore weights every call
+        import os
+        key = (self.get("modelName"),
+               os.environ.get("MMLSPARK_TPU_MODEL_DIR", ""))
+        if self._loaded_cache is None or self._loaded_cache[0] != key:
+            self._loaded_cache = (
+                key, ModelDownloader().download_by_name(key[0]))
+        return self._loaded_cache[1]
 
     def _transform(self, df):
         loaded = self._loaded()

@@ -145,7 +145,7 @@ def pipeline_encode(mesh, module, variables, ids, *,
             [jnp.stack(leaves[s * L:(s + 1) * L]) for s in range(S)]),
         *block_trees)
 
-    block = EncoderBlock(module.heads, module.mlp_dim,
+    block = EncoderBlock(module.heads, module.mlp_dim, module.width,
                          attention_fn=module.attention_fn,
                          dtype=module.dtype)
 
