@@ -20,6 +20,7 @@ import jax.numpy as jnp
 import numpy as np
 
 from ..core.utils import stable_sigmoid
+from .engine import categorical_go_left
 
 
 class Booster:
@@ -117,11 +118,6 @@ class Booster:
         """Per-(row, tree) leaf node ids, dense or padded-COO input."""
         from .sparse import SparseData, predict_leaf_nodes_sparse
         if isinstance(x, SparseData):
-            if "cat_flag" in self.arrays and self.arrays["cat_flag"].any():
-                raise NotImplementedError(
-                    "this model contains categorical splits; sparse "
-                    "(padded-COO) prediction does not support them — "
-                    "densify the features")
             return predict_leaf_nodes_sparse(
                 self._device_arrays(t_end),
                 jnp.asarray(x.indices, jnp.int32),
@@ -495,7 +491,6 @@ def _predict_leaf_nodes(tree_arrays, x, *, max_depth: int):
     (feature, threshold, left, right, leaf_value, is_leaf, default_left,
      cat_flag, cat_left) = tree_arrays
     T = feature.shape[0]
-    B = cat_left.shape[-1]
     n = x.shape[0]
     node = jnp.zeros((n, T), jnp.int32)
     t_idx = jnp.arange(T)[None, :]
@@ -506,15 +501,7 @@ def _predict_leaf_nodes(tree_arrays, x, *, max_depth: int):
         xv = jnp.take_along_axis(x, f.reshape(n, T), axis=1)
         missing = jnp.isnan(xv)
         ord_left = xv <= thr
-        # categorical: raw value c lives in bin c+1 (identity binning);
-        # missing and out-of-range/unseen categories go right, matching
-        # LightGBM's "not in the bitset" rule (training validates
-        # categories fit the bin range, so no category shares a bin)
-        iv = jnp.nan_to_num(xv).astype(jnp.int32)
-        in_range = (~missing) & (xv >= 0) & (iv < B - 1) \
-            & (xv == iv.astype(xv.dtype))
-        cat_bin = jnp.clip(iv + 1, 0, B - 1)
-        cat_go = cat_left[t_idx, node, cat_bin] & in_range
+        cat_go = categorical_go_left(xv, missing, cat_left[t_idx, node])
         go_left = jnp.where(cat_flag[t_idx, node], cat_go,
                             jnp.where(missing, default_left[t_idx, node],
                                       ord_left))

@@ -133,6 +133,24 @@ def _split_stats(hist, p: TreeParams):
     return gl, hl, cl, gr, hr, cr, gain
 
 
+def categorical_go_left(xv, missing, cat_left_rows):
+    """Raw-value category routing, shared by the dense and COO
+    predictors (one copy of the bitset rule): value c lives in bin c+1
+    (identity binning); missing, negative, non-integer or out-of-range
+    values are "in no bitset" and go right — LightGBM's NaN/unseen rule.
+
+    cat_left_rows: bool [..., B], the cat_left row of each (row, node).
+    """
+    B = cat_left_rows.shape[-1]
+    iv = jnp.nan_to_num(xv).astype(jnp.int32)
+    in_range = (~missing) & (xv >= 0) & (iv < B - 1) \
+        & (xv == iv.astype(xv.dtype))
+    cat_bin = jnp.clip(iv + 1, 0, B - 1)
+    picked = jnp.take_along_axis(cat_left_rows, cat_bin[..., None],
+                                 axis=-1)[..., 0]
+    return picked & in_range
+
+
 @functools.partial(
     jax.jit,
     static_argnames=("params", "num_features", "psum_axis"))

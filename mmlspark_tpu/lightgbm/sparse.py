@@ -40,7 +40,8 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
-from .engine import Tree, TreeParams, _leaf_output, _split_stats
+from .engine import (Tree, TreeParams, _leaf_output,
+                     _split_stats, categorical_go_left)
 
 
 class SparseData(NamedTuple):
@@ -228,11 +229,46 @@ def _leaf_hist_sparse(binned: SparseBinned, gh1: jnp.ndarray,
 
 def _best_split_of_hist(hist: jnp.ndarray, p: TreeParams,
                         feature_mask: jnp.ndarray,
-                        cand_feat: jnp.ndarray | None = None):
+                        cand_feat: jnp.ndarray | None = None,
+                        cat_idx: jnp.ndarray | None = None):
     """[F|C, B, 3] histogram → best-split record
-    (gain, feat, bin, lg, lh, lc). Constraint masking matches the dense
-    engine's ``valid`` predicate."""
+    (gain, feat, bin, lg, lh, lc, is_cat, cat_left[B]). Constraint
+    masking matches the dense engine's ``valid`` predicate.
+
+    ``cat_idx`` ([Fc] int32, sorted) marks categorical features: only
+    those columns are gathered and re-scanned in gradient/hessian-ratio-
+    sorted order (LightGBM's many-vs-many heuristic, the same math — and
+    the same gather-only-the-cat-columns economy — as the dense engine's
+    ``has_cat`` branch; the sparse core use case is F = 2^18 hashed
+    features, which must not pay a full-width second scan). ``bin`` then
+    means "the bin+1 best-ratio categories go left". Because this engine
+    keeps only O(L) records — no per-leaf histograms to re-derive the
+    sort from later — the winning category set itself is part of the
+    record."""
     gl, hl, cl, gr, hr, cr, gain = _split_stats(hist, p)
+    B = hist.shape[-2]
+    order = None
+    if cat_idx is not None:
+        cat_hist = hist[cat_idx]                   # [Fc, B, 3]
+        ratio = jnp.where(cat_hist[..., 2] > 0,
+                          cat_hist[..., 0]
+                          / (cat_hist[..., 1] + p.cat_smooth),
+                          jnp.inf)                 # empty bins sort last
+        # the missing bin (0) never enters a left set: predict and SHAP
+        # send missing right unconditionally (LightGBM's "NaN is in no
+        # bitset"), so training must match
+        ratio = ratio.at[..., 0].set(jnp.inf)
+        order = jnp.argsort(ratio, axis=-1)        # [Fc, B]
+        sorted_hist = jnp.take_along_axis(cat_hist, order[..., None],
+                                          axis=-2)
+        cs = _split_stats(sorted_hist, p)
+        gl = gl.at[cat_idx].set(cs[0])
+        hl = hl.at[cat_idx].set(cs[1])
+        cl = cl.at[cat_idx].set(cs[2])
+        gr = gr.at[cat_idx].set(cs[3])
+        hr = hr.at[cat_idx].set(cs[4])
+        cr = cr.at[cat_idx].set(cs[5])
+        gain = gain.at[cat_idx].set(cs[6])
     if cand_feat is not None:
         feat_ok = feature_mask[cand_feat][:, None]
     else:
@@ -242,13 +278,24 @@ def _best_split_of_hist(hist: jnp.ndarray, p: TreeParams,
              & (hl >= p.min_sum_hessian_in_leaf)
              & (hr >= p.min_sum_hessian_in_leaf))
     gain = jnp.where(valid, gain, -jnp.inf)
-    B = hist.shape[-2]
     flat = jnp.argmax(gain)
     j = (flat // B).astype(jnp.int32)
     b = (flat % B).astype(jnp.int32)
     f = cand_feat[j] if cand_feat is not None else j
+    if cat_idx is not None:
+        # map the winning feature into its compact categorical column
+        # (the dense engine's searchsorted trick); guarded by is_cat
+        f_c = jnp.clip(jnp.searchsorted(cat_idx, j), 0,
+                       cat_idx.shape[0] - 1)
+        is_cat = cat_idx[f_c] == j
+        rank = jnp.zeros(B, jnp.int32).at[order[f_c]].set(
+            jnp.arange(B, dtype=jnp.int32))
+        left_set = is_cat & (rank <= b)
+    else:
+        is_cat = jnp.asarray(False)
+        left_set = jnp.zeros(B, bool)
     return (gain.reshape(-1)[flat], f, b,
-            gl[j, b], hl[j, b], cl[j, b])
+            gl[j, b], hl[j, b], cl[j, b], is_cat, left_set)
 
 
 @functools.partial(
@@ -275,6 +322,13 @@ def grow_tree_sparse(indices: jnp.ndarray, ebins: jnp.ndarray,
     max_depth = p.max_depth if p.max_depth and p.max_depth > 0 else 10 ** 9
     voting = p.parallelism == "voting" and psum_axis is not None
     C = min(2 * p.top_k, F)
+    has_cat = len(p.cat_features) > 0
+    if has_cat and voting:
+        raise NotImplementedError(
+            "categorical splits + voting_parallel are not supported "
+            "together; use parallelism='data_parallel'")
+    cat_idx = (jnp.asarray(sorted(set(p.cat_features)), jnp.int32)
+               if has_cat else None)
 
     g = grad * row_mask
     h = hess * row_mask
@@ -300,15 +354,11 @@ def grow_tree_sparse(indices: jnp.ndarray, ebins: jnp.ndarray,
             cols = psum(local_h[cand])                     # [C, B, 3]
             return _best_split_of_hist(cols, p, feature_mask,
                                        cand_feat=cand)
-        return _best_split_of_hist(psum(local_h), p, feature_mask)
+        return _best_split_of_hist(psum(local_h), p, feature_mask,
+                                   cat_idx=cat_idx)
 
     total_g, total_h, total_c = (psum(g.sum()), psum(h.sum()),
                                  psum(row_mask.sum()))
-    if p.cat_features:
-        raise NotImplementedError(
-            "categorical splits are not supported on the sparse "
-            "padded-COO path; densify categorical slots or drop "
-            "categoricalSlotIndexes")
     tree = Tree(
         feature=jnp.zeros(NN, jnp.int32),
         split_bin=jnp.full(NN, B, jnp.int32),
@@ -345,6 +395,11 @@ def grow_tree_sparse(indices: jnp.ndarray, ebins: jnp.ndarray,
             jnp.stack([root_rec[3], root_rec[4], root_rec[5]])),
         "rec_total": jnp.zeros((L, 3), jnp.float32).at[0].set(
             jnp.stack([total_g, total_h, total_c])),
+        # categorical records: whether the best split is a category set,
+        # and the set itself (O(L·B) — the sort order cannot be
+        # re-derived later without per-leaf histograms)
+        "rec_cat": jnp.zeros(L, bool).at[0].set(root_rec[6]),
+        "rec_cat_left": jnp.zeros((L, B), bool).at[0].set(root_rec[7]),
     }
 
     def row_bin_of(f_star):
@@ -376,9 +431,13 @@ def grow_tree_sparse(indices: jnp.ndarray, ebins: jnp.ndarray,
         rg, rh, rc = tg - lg, th - lh, tc - lc
 
         # ---- route rows + UNCONDITIONAL child histograms/collectives
+        is_cat_star = state["rec_cat"][s_star]
+        left_set_star = state["rec_cat_left"][s_star]      # bool [B]
         rb = row_bin_of(f_star)
+        right_rule = jnp.where(is_cat_star, ~left_set_star[rb],
+                               rb > b_star)
         in_parent = (state["slot"] == s_star) & found
-        goes_right = in_parent & (rb > b_star)
+        goes_right = in_parent & right_rule
         left_sel = (in_parent & ~goes_right).astype(jnp.float32)
         right_sel = goes_right.astype(jnp.float32)
         left_rec = reduce_and_record(
@@ -395,8 +454,8 @@ def grow_tree_sparse(indices: jnp.ndarray, ebins: jnp.ndarray,
             new_tree = Tree(
                 feature=tree.feature.at[parent].set(f_star),
                 split_bin=tree.split_bin.at[parent].set(b_star),
-                cat_flag=tree.cat_flag,
-                cat_left=tree.cat_left,
+                cat_flag=tree.cat_flag.at[parent].set(is_cat_star),
+                cat_left=tree.cat_left.at[parent].set(left_set_star),
                 left=tree.left.at[parent].set(nl),
                 right=tree.right.at[parent].set(nr),
                 leaf_value=tree.leaf_value
@@ -437,6 +496,12 @@ def grow_tree_sparse(indices: jnp.ndarray, ebins: jnp.ndarray,
                 "rec_total": state["rec_total"]
                     .at[s_star].set(jnp.stack([lg, lh, lc]))
                     .at[new_slot].set(jnp.stack([rg, rh, rc])),
+                "rec_cat": state["rec_cat"]
+                    .at[s_star].set(left_rec[6])
+                    .at[new_slot].set(right_rec[6]),
+                "rec_cat_left": state["rec_cat_left"]
+                    .at[s_star].set(left_rec[7])
+                    .at[new_slot].set(right_rec[7]),
             }
 
         def no_split(state):
@@ -471,8 +536,10 @@ def sparse_route_bins(tree: Tree, indices: jnp.ndarray, ebins: jnp.ndarray,
         has = match.any(axis=1)
         eb = jnp.max(jnp.where(match, ebins, 0), axis=1)
         rb = jnp.where(has, eb, zero_bin[f])
-        nxt = jnp.where(rb <= tree.split_bin[node],
-                        tree.left[node], tree.right[node])
+        go_left = jnp.where(tree.cat_flag[node],
+                            tree.cat_left[node, rb],
+                            rb <= tree.split_bin[node])
+        nxt = jnp.where(go_left, tree.left[node], tree.right[node])
         return jnp.where(tree.is_leaf[node], node, nxt)
 
     return jax.lax.fori_loop(0, max_depth, step, node)
@@ -485,10 +552,8 @@ def predict_leaf_nodes_sparse(tree_arrays, indices, values, *,
     """Per-(row, tree) leaf node ids on raw COO features — the sparse
     counterpart of ``booster._predict_leaf_nodes`` (reference CSR predict,
     ``LightGBMBooster.scala:333-344``). Absent features read 0.0."""
-    # cat arrays (always appended by Booster._device_arrays) are unused:
-    # the sparse path refuses categorical training/models upstream
     (feature, threshold, left, right, leaf_value, is_leaf, default_left,
-     _cat_flag, _cat_left) = tree_arrays
+     cat_flag, cat_left) = tree_arrays
     T = feature.shape[0]
     n = indices.shape[0]
     node = jnp.zeros((n, T), jnp.int32)
@@ -501,8 +566,11 @@ def predict_leaf_nodes_sparse(tree_arrays, indices, values, *,
         xv = jnp.sum(jnp.where(match, values[:, None, :], 0.0), axis=-1)
         # NaN = missing: honour default_left like the dense predictor
         # (training maps NaN to bin 0, which routes left)
-        go_left = jnp.where(jnp.isnan(xv), default_left[t_idx, node],
-                            xv <= thr)
+        missing = jnp.isnan(xv)
+        ord_left = jnp.where(missing, default_left[t_idx, node],
+                             xv <= thr)
+        cat_go = categorical_go_left(xv, missing, cat_left[t_idx, node])
+        go_left = jnp.where(cat_flag[t_idx, node], cat_go, ord_left)
         nxt = jnp.where(go_left, left[t_idx, node], right[t_idx, node])
         return jnp.where(is_leaf[t_idx, node], node, nxt)
 

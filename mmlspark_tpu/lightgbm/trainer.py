@@ -313,6 +313,27 @@ def train(x: np.ndarray, y: np.ndarray, w: np.ndarray | None,
             x, sparse_b, sample_cnt=entry_budget, seed=cfg.seed)
         # bins 1..(#cuts+1) for values, bin 0 for missing
         B_s = boundaries.shape[1] + 2
+        for f in cfg.categorical_features:
+            # identity binning for categorical slots (the sparse twin of
+            # the dense loop below): category c → bin c+1 exactly, and
+            # implicit zeros land in bin 1 = category 0. Cardinality is
+            # bounded by the sparse bin budget.
+            ent = x.values[x.indices == f]
+            vals = ent[~np.isnan(ent)]
+            if vals.size and (np.any(vals < 0)
+                              or np.any(vals != np.floor(vals))):
+                raise ValueError(
+                    f"categorical slot {f} must hold non-negative "
+                    "integer category ids (reference LightGBM "
+                    "requirement); index labels first (ValueIndexer)")
+            cap = boundaries.shape[1]
+            if vals.size and vals.max() > cap:
+                raise ValueError(
+                    f"categorical slot {f} has category id "
+                    f"{int(vals.max())} > {cap} (the effective sparse "
+                    "bin budget, min(sparseMaxBin, maxBin)); raise "
+                    "whichever is binding, or re-index the categories")
+            boundaries[f] = np.arange(cap) + 0.5
         binned = bin_sparse(x, boundaries)
         bins = None
     else:
@@ -1030,7 +1051,9 @@ def build_booster(trees: list[Tree], boundaries: np.ndarray,
         ("node_count", np.float32), ("node_value", np.float32)]}
     arr["num_nodes"] = np.zeros(T, np.int32)
     if cfg.categorical_features:
-        B = cfg.max_bin + 1
+        # the engine's bin width, not cfg.max_bin: the sparse path bins
+        # into sparse_max_bin-sized histograms
+        B = int(trees[0].cat_left.shape[-1]) if trees else cfg.max_bin + 1
         arr["cat_flag"] = np.zeros((T, NN), bool)
         arr["cat_left"] = np.zeros((T, NN, B), bool)
     for t, tree in enumerate(trees):

@@ -10,6 +10,7 @@ import pytest
 from mmlspark_tpu.core import DataFrame, load_stage
 from mmlspark_tpu.lightgbm import LightGBMClassifier
 from mmlspark_tpu.lightgbm.booster import Booster
+from mmlspark_tpu.lightgbm.trainer import roc_auc
 
 # label = [category in LEFT_SET], with the set chosen interleaved so no
 # single ordinal threshold separates it
@@ -123,19 +124,92 @@ class TestCategoricalSplits:
         np.testing.assert_allclose(shap.sum(axis=-1), raw,
                                    rtol=1e-3, atol=1e-3)
 
-    def test_sparse_categorical_raises(self):
-        from mmlspark_tpu.lightgbm.engine import TreeParams
-        from mmlspark_tpu.lightgbm.sparse import grow_tree_sparse
-        import jax.numpy as jnp
-        rng = np.random.default_rng(0)
-        idx = jnp.asarray(rng.integers(0, 8, size=(100, 4)), jnp.int32)
-        with pytest.raises(NotImplementedError, match="sparse"):
-            grow_tree_sparse(
-                idx, jnp.zeros((100, 4), jnp.int32),
-                jnp.zeros(8, jnp.int32), jnp.zeros(100), jnp.ones(100),
-                jnp.ones(8, bool), jnp.ones(100),
-                params=TreeParams(cat_features=(0,)), num_features=8,
-                num_bins=4)
+    @staticmethod
+    def _sparse_cat_data(n=1500, seed=5):
+        """Integer categorical slot 0 (8 categories; category 0 rides the
+        implicit-zero bin) + two sparse numeric slots; the signal lives
+        in a NON-contiguous category set, so ordinal thresholds cannot
+        express it."""
+        from test_lightgbm_sparse import dense_to_coo
+        rng = np.random.default_rng(seed)
+        cats = rng.integers(0, 8, size=n).astype(np.float32)
+        num = rng.normal(size=(n, 2)).astype(np.float32)
+        num[rng.random((n, 2)) > 0.5] = 0.0
+        margin = (np.isin(cats, [2, 5, 7]) * 2.0 - 1.0) + num[:, 0]
+        y = (margin + 0.3 * rng.normal(size=n) > 0).astype(np.float32)
+        dense = np.concatenate([cats[:, None], num], axis=1)
+        idx, val = dense_to_coo(dense)
+        return dense, idx, val, y
+
+    def test_sparse_set_split_beats_ordinal(self):
+        dense, idx, val, y = self._sparse_cat_data()
+        df = DataFrame({"features_indices": idx, "features_values": val,
+                        "label": y})
+        kw = dict(numIterations=25, numLeaves=15, minDataInLeaf=5,
+                  numShards=1, seed=0)
+        m_cat = LightGBMClassifier(categoricalSlotIndexes=[0],
+                                   **kw).fit(df)
+        m_ord = LightGBMClassifier(**kw).fit(df)
+        auc_cat = roc_auc(y, m_cat.transform(df)["probability"][:, 1])
+        auc_ord = roc_auc(y, m_ord.transform(df)["probability"][:, 1])
+        assert auc_cat > 0.9
+        assert auc_cat > auc_ord - 1e-6
+        # a real set split was trained
+        assert np.asarray(m_cat.booster.arrays["cat_flag"]).any()
+
+    def test_sparse_matches_dense_categorical(self):
+        dense, idx, val, y = self._sparse_cat_data()
+        sdf = DataFrame({"features_indices": idx, "features_values": val,
+                         "label": y})
+        ddf = DataFrame({"features": dense, "label": y})
+        kw = dict(numIterations=20, numLeaves=15, minDataInLeaf=5,
+                  numShards=1, seed=0, categoricalSlotIndexes=[0])
+        m_s = LightGBMClassifier(**kw).fit(sdf)
+        m_d = LightGBMClassifier(**kw).fit(ddf)
+        auc_s = roc_auc(y, m_s.transform(sdf)["probability"][:, 1])
+        auc_d = roc_auc(y, m_d.transform(ddf)["probability"][:, 1])
+        assert abs(auc_s - auc_d) < 0.03, (auc_s, auc_d)
+
+    def test_sparse_cat_predict_coo_equals_densified(self):
+        """The COO predictor's identity-bin category routing must agree
+        with the dense predictor on the same model."""
+        dense, idx, val, y = self._sparse_cat_data(n=800, seed=9)
+        sdf = DataFrame({"features_indices": idx, "features_values": val,
+                         "label": y})
+        m = LightGBMClassifier(numIterations=15, numLeaves=15,
+                               minDataInLeaf=5, numShards=1, seed=0,
+                               categoricalSlotIndexes=[0]).fit(sdf)
+        p_coo = m.transform(sdf)["probability"][:, 1]
+        p_dense = m.booster.transform_scores(
+            np.asarray(m.booster.raw_scores(dense)))[:, ]
+        np.testing.assert_allclose(np.asarray(p_coo),
+                                   np.asarray(p_dense), atol=1e-6)
+
+    def test_sparse_cat_sharded_matches_single(self):
+        dense, idx, val, y = self._sparse_cat_data(n=1600, seed=11)
+        df = DataFrame({"features_indices": idx, "features_values": val,
+                        "label": y})
+        kw = dict(numIterations=15, numLeaves=15, minDataInLeaf=5,
+                  seed=0, categoricalSlotIndexes=[0])
+        m1 = LightGBMClassifier(numShards=1, **kw).fit(df)
+        m8 = LightGBMClassifier(numShards=8, **kw).fit(df)
+        p1 = m1.transform(df)["probability"][:, 1]
+        p8 = m8.transform(df)["probability"][:, 1]
+        np.testing.assert_allclose(p1, p8, atol=5e-3)
+
+    def test_sparse_cat_save_load_round_trip(self, tmp_path):
+        from mmlspark_tpu.core.serialize import load_stage
+        dense, idx, val, y = self._sparse_cat_data(n=600, seed=13)
+        df = DataFrame({"features_indices": idx, "features_values": val,
+                        "label": y})
+        m = LightGBMClassifier(numIterations=10, numLeaves=7,
+                               minDataInLeaf=5, numShards=1, seed=0,
+                               categoricalSlotIndexes=[0]).fit(df)
+        m.save(str(tmp_path / "m"))
+        m2 = load_stage(str(tmp_path / "m"))
+        np.testing.assert_allclose(
+            np.asarray(m2.transform(df)["probability"]),
+            np.asarray(m.transform(df)["probability"]), atol=1e-6)
 
     def test_voting_categorical_raises(self):
         df = cat_df(600)
