@@ -133,6 +133,47 @@ def _split_stats(hist, p: TreeParams):
     return gl, hl, cl, gr, hr, cr, gain
 
 
+def _split_stats_with_cat(hist, p: TreeParams, *, cat_idx=None,
+                          cat_mask=None):
+    """``_split_stats`` with categorical columns re-scanned in
+    gradient/hessian-ratio-sorted order (LightGBM's many-vs-many
+    heuristic): position b then means "the b+1 best-ratio categories go
+    left". The ONE copy of the sort + merge used by split search AND
+    voting nomination in both engines — a nomination path scoring
+    categorical columns with the ordinal scan would systematically
+    under-vote them.
+
+    Exactly one of ``cat_idx`` (static feature columns to gather, for
+    full-width [..., F, B, 3] layouts) or ``cat_mask`` (per-column bool,
+    for per-leaf candidate layouts where columns vary) may be given;
+    both ``None`` → plain stats. Returns ``(stats7, order)`` where
+    ``order`` is the ratio argsort ([..., Fc|C, B]) or ``None``.
+    """
+    stats = _split_stats(hist, p)
+    if cat_idx is None and cat_mask is None:
+        return stats, None
+    cat_hist = hist if cat_idx is None else hist[..., cat_idx, :, :]
+    ratio = jnp.where(
+        cat_hist[..., 2] > 0,
+        cat_hist[..., 0] / (cat_hist[..., 1] + p.cat_smooth),
+        jnp.inf)                          # empty bins sort last
+    # the missing bin (0) must never enter a left set: predict and SHAP
+    # send missing right unconditionally (LightGBM's "NaN is in no
+    # bitset"), so training must match
+    ratio = ratio.at[..., 0].set(jnp.inf)
+    order = jnp.argsort(ratio, axis=-1)
+    sorted_hist = jnp.take_along_axis(cat_hist, order[..., None],
+                                      axis=-2)
+    cs = _split_stats(sorted_hist, p)
+    if cat_mask is not None:
+        m = cat_mask[..., None]
+        stats = tuple(jnp.where(m, c, s) for s, c in zip(stats, cs))
+    else:
+        stats = tuple(s.at[..., cat_idx, :].set(c)
+                      for s, c in zip(stats, cs))
+    return stats, order
+
+
 def categorical_go_left(xv, missing, cat_left_rows):
     """Raw-value category routing, shared by the dense and COO
     predictors (one copy of the bitset rule): value c lives in bin c+1
@@ -174,10 +215,6 @@ def grow_tree(bins: jnp.ndarray, grad: jnp.ndarray, hess: jnp.ndarray,
     voting = p.parallelism == "voting" and psum_axis is not None
     C = min(2 * p.top_k, F)  # global candidate features per leaf (voting)
     has_cat = len(p.cat_features) > 0
-    if has_cat and voting:
-        raise NotImplementedError(
-            "categorical splits + voting_parallel are not supported "
-            "together; use parallelism='data_parallel'")
     if has_cat:
         # sorted order is load-bearing: the apply phase maps f_star back
         # to its compact column via searchsorted
@@ -244,8 +281,14 @@ def grow_tree(bins: jnp.ndarray, grad: jnp.ndarray, hess: jnp.ndarray,
     def local_top_features(hists):
         """[M, F, B, 3] local hists → bool votes [M, F]: each shard
         nominates its top-K features by local best-bin gain (PV-Tree local
-        voting), honouring the feature_fraction mask."""
-        *_, gain = _split_stats(hists, p)                  # [M, F, B]
+        voting), honouring the feature_fraction mask. Categorical columns
+        are scored by their sorted-scan gain — the ordinal scan would
+        systematically under-vote a predictive non-contiguous set."""
+        stats, _ = _split_stats_with_cat(
+            hists, p,
+            cat_idx=jnp.asarray(cat_features, jnp.int32)
+            if has_cat else None)
+        gain = stats[6]                                    # [M, F, B]
         fgain = jnp.max(gain, axis=-1)                     # [M, F]
         fgain = jnp.where(feature_mask[None, :], fgain, -jnp.inf)
         _, top_idx = jax.lax.top_k(fgain, min(p.top_k, F))  # [M, k]
@@ -307,37 +350,21 @@ def grow_tree(bins: jnp.ndarray, grad: jnp.ndarray, hess: jnp.ndarray,
         else:
             search = state["hist"]                         # [L, F, B, 3]
             n_search = F
-        gl, hl, cl, gr, hr, cr, gain = _split_stats(search, p)
         if has_cat:
-            # categorical features: LightGBM's many-vs-many heuristic —
-            # sort the leaf's category bins by gradient/hessian ratio and
-            # scan the SORTED order like an ordinal feature; position b
-            # then means "the b+1 best-ratio categories go left"
-            # (category_feature_encoder in the native core). Only the
-            # categorical COLUMNS pay for the sort + second scan: the
-            # [L, Fc, B, 3] slice is gathered, scanned, and the stats
-            # scattered back.
+            # categorical: sorted-scan stats via the shared helper. In
+            # voting, candidate columns vary per (leaf, iteration) — no
+            # static gather, so every (small, 2·topK) candidate column
+            # pays the sort and stats select by the per-column mask; in
+            # data-parallel only the categorical COLUMNS pay.
             cat_idx = jnp.asarray(cat_features, jnp.int32)
-            cat_hist = search[:, cat_idx]                  # [L, Fc, B, 3]
-            ratio = jnp.where(
-                cat_hist[..., 2] > 0,
-                cat_hist[..., 0] / (cat_hist[..., 1] + p.cat_smooth),
-                jnp.inf)                       # empty bins sort last
-            # the missing bin (0) must never enter a left set: predict
-            # and SHAP send missing right unconditionally (LightGBM's
-            # "NaN is in no bitset"), so training must match
-            ratio = ratio.at[..., 0].set(jnp.inf)
-            cat_order_c = jnp.argsort(ratio, axis=-1)      # [L, Fc, B]
-            sorted_hist = jnp.take_along_axis(
-                cat_hist, cat_order_c[..., None], axis=-2)
-            cstats = _split_stats(sorted_hist, p)
-            gl = gl.at[:, cat_idx].set(cstats[0])
-            hl = hl.at[:, cat_idx].set(cstats[1])
-            cl = cl.at[:, cat_idx].set(cstats[2])
-            gr = gr.at[:, cat_idx].set(cstats[3])
-            hr = hr.at[:, cat_idx].set(cstats[4])
-            cr = cr.at[:, cat_idx].set(cstats[5])
-            gain = gain.at[:, cat_idx].set(cstats[6])
+            (gl, hl, cl, gr, hr, cr, gain), cat_order_c = \
+                _split_stats_with_cat(
+                    search, p,
+                    cat_idx=None if voting else cat_idx,
+                    cat_mask=cat_feat_mask[state["cand_feat"]]
+                    if voting else None)
+        else:
+            gl, hl, cl, gr, hr, cr, gain = _split_stats(search, p)
         if voting:
             feat_ok = feature_mask[state["cand_feat"]][:, :, None]
         else:
@@ -376,13 +403,17 @@ def grow_tree(bins: jnp.ndarray, grad: jnp.ndarray, hess: jnp.ndarray,
         if has_cat:
             is_cat = cat_feat_mask[f_star]
             # rank of each bin in the chosen (slot, feature)'s ratio
-            # sort; left = the b_star+1 best-ratio categories. f_star
-            # maps into the compact categorical column index (position
-            # of f_star within cat_features; 0 when not categorical —
-            # unused then, guarded by is_cat)
-            f_star_c = jnp.searchsorted(cat_idx, f_star)
-            f_star_c = jnp.clip(f_star_c, 0, cat_idx.shape[0] - 1)
-            order_star = cat_order_c[s_star, f_star_c]    # [B]
+            # sort; left = the b_star+1 best-ratio categories. In voting
+            # mode the sort lives at the candidate column j_star; in
+            # data-parallel f_star maps into the compact categorical
+            # column via searchsorted (0 when not categorical — unused
+            # then, guarded by is_cat)
+            if voting:
+                order_star = cat_order_c[s_star, j_star]  # [B]
+            else:
+                f_star_c = jnp.searchsorted(cat_idx, f_star)
+                f_star_c = jnp.clip(f_star_c, 0, cat_idx.shape[0] - 1)
+                order_star = cat_order_c[s_star, f_star_c]
             rank = jnp.zeros(B, jnp.int32).at[order_star].set(
                 jnp.arange(B, dtype=jnp.int32))
             left_set = is_cat & (rank <= b_star)          # bool [B]

@@ -41,7 +41,8 @@ import jax.numpy as jnp
 import numpy as np
 
 from .engine import (Tree, TreeParams, _leaf_output,
-                     _split_stats, categorical_go_left)
+                     _split_stats, _split_stats_with_cat,
+                     categorical_go_left)
 
 
 class SparseData(NamedTuple):
@@ -245,30 +246,16 @@ def _best_split_of_hist(hist: jnp.ndarray, p: TreeParams,
     keeps only O(L) records — no per-leaf histograms to re-derive the
     sort from later — the winning category set itself is part of the
     record."""
-    gl, hl, cl, gr, hr, cr, gain = _split_stats(hist, p)
     B = hist.shape[-2]
-    order = None
-    if cat_idx is not None:
-        cat_hist = hist[cat_idx]                   # [Fc, B, 3]
-        ratio = jnp.where(cat_hist[..., 2] > 0,
-                          cat_hist[..., 0]
-                          / (cat_hist[..., 1] + p.cat_smooth),
-                          jnp.inf)                 # empty bins sort last
-        # the missing bin (0) never enters a left set: predict and SHAP
-        # send missing right unconditionally (LightGBM's "NaN is in no
-        # bitset"), so training must match
-        ratio = ratio.at[..., 0].set(jnp.inf)
-        order = jnp.argsort(ratio, axis=-1)        # [Fc, B]
-        sorted_hist = jnp.take_along_axis(cat_hist, order[..., None],
-                                          axis=-2)
-        cs = _split_stats(sorted_hist, p)
-        gl = gl.at[cat_idx].set(cs[0])
-        hl = hl.at[cat_idx].set(cs[1])
-        cl = cl.at[cat_idx].set(cs[2])
-        gr = gr.at[cat_idx].set(cs[3])
-        hr = hr.at[cat_idx].set(cs[4])
-        cr = cr.at[cat_idx].set(cs[5])
-        gain = gain.at[cat_idx].set(cs[6])
+    is_cat_col = None
+    if cat_idx is not None and cand_feat is not None:
+        # voting: candidate columns vary per call — every (small) C
+        # column pays the sort, stats select by membership
+        is_cat_col = jnp.isin(cand_feat, cat_idx)        # [C]
+    (gl, hl, cl, gr, hr, cr, gain), order = _split_stats_with_cat(
+        hist, p,
+        cat_idx=cat_idx if is_cat_col is None else None,
+        cat_mask=is_cat_col)
     if cand_feat is not None:
         feat_ok = feature_mask[cand_feat][:, None]
     else:
@@ -283,12 +270,19 @@ def _best_split_of_hist(hist: jnp.ndarray, p: TreeParams,
     b = (flat % B).astype(jnp.int32)
     f = cand_feat[j] if cand_feat is not None else j
     if cat_idx is not None:
-        # map the winning feature into its compact categorical column
-        # (the dense engine's searchsorted trick); guarded by is_cat
-        f_c = jnp.clip(jnp.searchsorted(cat_idx, j), 0,
-                       cat_idx.shape[0] - 1)
-        is_cat = cat_idx[f_c] == j
-        rank = jnp.zeros(B, jnp.int32).at[order[f_c]].set(
+        if is_cat_col is not None:
+            # voting: the sort lives at the winning candidate column
+            is_cat = is_cat_col[j]
+            order_j = order[j]
+        else:
+            # data-parallel: map the winning feature into its compact
+            # categorical column (the dense engine's searchsorted
+            # trick); guarded by is_cat
+            f_c = jnp.clip(jnp.searchsorted(cat_idx, j), 0,
+                           cat_idx.shape[0] - 1)
+            is_cat = cat_idx[f_c] == j
+            order_j = order[f_c]
+        rank = jnp.zeros(B, jnp.int32).at[order_j].set(
             jnp.arange(B, dtype=jnp.int32))
         left_set = is_cat & (rank <= b)
     else:
@@ -323,10 +317,6 @@ def grow_tree_sparse(indices: jnp.ndarray, ebins: jnp.ndarray,
     voting = p.parallelism == "voting" and psum_axis is not None
     C = min(2 * p.top_k, F)
     has_cat = len(p.cat_features) > 0
-    if has_cat and voting:
-        raise NotImplementedError(
-            "categorical splits + voting_parallel are not supported "
-            "together; use parallelism='data_parallel'")
     cat_idx = (jnp.asarray(sorted(set(p.cat_features)), jnp.int32)
                if has_cat else None)
 
@@ -338,8 +328,10 @@ def grow_tree_sparse(indices: jnp.ndarray, ebins: jnp.ndarray,
         return jax.lax.psum(x, psum_axis) if psum_axis else x
 
     def local_top_features(hist):
-        """[F, B, 3] local hist → top-K feature votes [F] (PV-Tree)."""
-        *_, gain = _split_stats(hist, p)
+        """[F, B, 3] local hist → top-K feature votes [F] (PV-Tree).
+        Categorical columns vote by their sorted-scan gain."""
+        stats, _ = _split_stats_with_cat(hist, p, cat_idx=cat_idx)
+        gain = stats[6]
         fgain = jnp.where(feature_mask, jnp.max(gain, axis=-1), -jnp.inf)
         _, top_idx = jax.lax.top_k(fgain, min(p.top_k, F))
         return jnp.zeros_like(fgain).at[top_idx].set(1.0)
@@ -353,7 +345,7 @@ def grow_tree_sparse(indices: jnp.ndarray, ebins: jnp.ndarray,
             cand = cand.astype(jnp.int32)
             cols = psum(local_h[cand])                     # [C, B, 3]
             return _best_split_of_hist(cols, p, feature_mask,
-                                       cand_feat=cand)
+                                       cand_feat=cand, cat_idx=cat_idx)
         return _best_split_of_hist(psum(local_h), p, feature_mask,
                                    cat_idx=cat_idx)
 

@@ -52,7 +52,20 @@ def classification_metrics(y, pred, scores=None) -> dict:
            "confusion_matrix": cm}
     if scores is not None and cm.shape[0] <= 2:
         out["AUC"] = roc_auc(y, scores)
+        out["AUPR"] = pr_auc(y, scores)
     return out
+
+
+def pr_auc(y, scores) -> float:
+    """Area under the precision-recall curve (Spark's ``areaUnderPR``,
+    the second metric of the reference's TrainClassifier benchmark
+    matrix): trapezoid over recall at every ranked cut."""
+    order = np.argsort(-np.asarray(scores))
+    y = np.asarray(y)[order]
+    tp = np.cumsum(y)
+    prec = tp / np.arange(1, len(y) + 1)
+    rec = tp / max(tp[-1], 1)
+    return float(np.trapezoid(prec, rec))
 
 
 def regression_metrics(y, pred) -> dict:

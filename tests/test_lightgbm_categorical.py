@@ -211,12 +211,36 @@ class TestCategoricalSplits:
             np.asarray(m2.transform(df)["probability"]),
             np.asarray(m.transform(df)["probability"]), atol=1e-6)
 
-    def test_voting_categorical_raises(self):
-        df = cat_df(600)
-        with pytest.raises(NotImplementedError, match="voting"):
-            LightGBMClassifier(numIterations=2, numShards=2,
-                               parallelism="voting_parallel",
+    def test_voting_categorical_matches_data_parallel(self):
+        """Categorical set splits under PV-Tree voting: candidate columns
+        pay the ratio-sort and the winning set rides the record — AUC
+        must match the data_parallel path (same global histograms when
+        the category feature wins the vote)."""
+        df = cat_df(1200)
+        kw = dict(numIterations=20, numLeaves=15, minDataInLeaf=5,
+                  seed=0, categoricalSlotIndexes=[0])
+        y = df["label"]
+        m_dp = LightGBMClassifier(numShards=8, **kw).fit(df)
+        m_v = LightGBMClassifier(numShards=8,
+                                 parallelism="voting_parallel", topK=3,
+                                 **kw).fit(df)
+        auc_dp = roc_auc(y, m_dp.transform(df)["probability"][:, 1])
+        auc_v = roc_auc(y, m_v.transform(df)["probability"][:, 1])
+        assert auc_v > 0.9
+        assert abs(auc_dp - auc_v) < 0.03, (auc_dp, auc_v)
+        assert np.asarray(m_v.booster.arrays["cat_flag"]).any()
+
+    def test_sparse_voting_categorical(self):
+        dense, idx, val, y = self._sparse_cat_data(n=1600, seed=21)
+        df = DataFrame({"features_indices": idx, "features_values": val,
+                        "label": y})
+        m = LightGBMClassifier(numIterations=20, numLeaves=15,
+                               minDataInLeaf=5, numShards=8, seed=0,
+                               parallelism="voting_parallel", topK=2,
                                categoricalSlotIndexes=[0]).fit(df)
+        auc = roc_auc(y, m.transform(df)["probability"][:, 1])
+        assert auc > 0.9, auc
+        assert np.asarray(m.booster.arrays["cat_flag"]).any()
 
     def test_missing_goes_right_train_and_predict(self):
         rng = np.random.default_rng(3)

@@ -41,15 +41,7 @@ RESOURCE_DIR = os.path.join(os.path.dirname(__file__), "resources",
 PARITY_CSV = os.path.join(RESOURCE_DIR, "benchmarks_ReferenceParity.csv")
 
 
-def pr_auc(y, p) -> float:
-    """Area under the precision-recall curve (Spark's ``areaUnderPR``
-    analog; trapezoid over recall at every ranked cut)."""
-    order = np.argsort(-np.asarray(p))
-    y = np.asarray(y)[order]
-    tp = np.cumsum(y)
-    prec = tp / np.arange(1, len(y) + 1)
-    rec = tp / max(tp[-1], 1)
-    return float(np.trapezoid(prec, rec))
+from mmlspark_tpu.train.statistics import pr_auc  # noqa: E402
 
 
 @pytest.fixture(scope="module")
