@@ -12,6 +12,8 @@ bf16 ResNet-50 inference figure (~2500 img/s) per the BASELINE.json
   best over a batch-size sweep with bf16-cast weights.
 - ``vit_mfu`` / ``encoder_mfu`` — ViT-B/16 and the long-context
   TextEncoder under the same sweep harness.
+- ``train_images_per_sec`` / ``train_mfu_est`` — ResNet-50 SGD training
+  step throughput (the transfer north star is a training workload).
 - ``gbdt_rows_per_sec`` — LightGBMClassifier training row-scans/sec
   (rows × iterations ÷ fit seconds) on a Higgs-shaped synthetic
   (28 features; ``docs/lightgbm.md:17-21`` is the speed claim being
@@ -229,6 +231,46 @@ def bench_resnet(extras: dict) -> float:
     except Exception:
         extras["error_featurizer"] = traceback.format_exc()[-800:]
     return per_batch.get(128, ips)
+
+
+def bench_train(extras: dict) -> None:
+    """ResNet-50 TRAINING throughput (SGD, bf16 activations) — the
+    transfer-learning north star is a training workload; inference-only
+    coverage was the r2 gap. FLOPs ≈ 3× the forward cost (fwd + bwd)."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+    import optax
+
+    from mmlspark_tpu.dl.train import init_train_state, make_train_step
+    from mmlspark_tpu.models import ModelDownloader
+
+    loaded = ModelDownloader().download_by_name(
+        "ResNet50", num_classes=100, allow_random_init=True)
+    tx = optax.sgd(1e-2, momentum=0.9)
+    rng = np.random.default_rng(3)
+    batch = int(os.environ.get("MMLSPARK_TPU_BENCH_TRAIN_BATCH", 128))
+    x = jnp.asarray(rng.normal(size=(batch, 224, 224, 3)), jnp.float32)
+    y = jnp.asarray(rng.integers(0, 100, size=batch), jnp.int32)
+    state = init_train_state(loaded.module, jax.random.PRNGKey(0),
+                             np.zeros((1, 224, 224, 3), np.float32), tx)
+    device = jax.devices()[0]
+    state = jax.device_put(state, device)
+    x, y = jax.device_put((x, y), device)
+    step = make_train_step(loaded.module, tx)
+    state, loss = step(state, x, y)      # compile + warm
+    jax.block_until_ready(loss)
+    iters = 10
+    t0 = time.perf_counter()
+    for _ in range(iters):
+        state, loss = step(state, x, y)
+    jax.block_until_ready(loss)
+    dt = time.perf_counter() - t0
+    ips = batch * iters / dt
+    extras["train_images_per_sec"] = round(ips, 1)
+    extras["train_mfu_est"] = round(
+        ips * 3 * RESNET50_FLOPS_PER_IMAGE / V5E_PEAK_BF16_FLOPS, 4)
+    assert np.isfinite(float(loss))
 
 
 def bench_vit(extras: dict) -> None:
@@ -529,6 +571,8 @@ def main():
         if want("resnet"):
             images_per_sec = _watchdog(bench_resnet, extras, "resnet",
                                        600.0) or 0.0
+        if want("train"):
+            _watchdog(bench_train, extras, "train", 600.0)
         if want("vit"):
             _watchdog(bench_vit, extras, "vit", 600.0)
         if want("encoder"):

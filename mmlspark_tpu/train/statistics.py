@@ -59,12 +59,15 @@ def classification_metrics(y, pred, scores=None) -> dict:
 def pr_auc(y, scores) -> float:
     """Area under the precision-recall curve (Spark's ``areaUnderPR``,
     the second metric of the reference's TrainClassifier benchmark
-    matrix): trapezoid over recall at every ranked cut."""
+    matrix): trapezoid over recall at every ranked cut, anchored at
+    (recall 0, precision 1) like Spark's curve — without the anchor the
+    area below the first cut (1/P of the axis, large for rare
+    positives) is silently dropped."""
     order = np.argsort(-np.asarray(scores))
     y = np.asarray(y)[order]
     tp = np.cumsum(y)
-    prec = tp / np.arange(1, len(y) + 1)
-    rec = tp / max(tp[-1], 1)
+    prec = np.r_[1.0, tp / np.arange(1, len(y) + 1)]
+    rec = np.r_[0.0, tp / max(tp[-1], 1)]
     return float(np.trapezoid(prec, rec))
 
 
