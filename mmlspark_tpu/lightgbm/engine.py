@@ -68,6 +68,7 @@ class TreeParams(NamedTuple):
     top_k: int = 20              # voting: local nominations per shard
     cat_features: tuple = ()     # feature indices with set-based splits
     cat_smooth: float = 10.0     # hessian smoothing in the g/h cat sort
+    max_cat_threshold: int = 32  # max categories in a split's left set
 
 
 class Tree(NamedTuple):
@@ -165,6 +166,11 @@ def _split_stats_with_cat(hist, p: TreeParams, *, cat_idx=None,
     sorted_hist = jnp.take_along_axis(cat_hist, order[..., None],
                                       axis=-2)
     cs = _split_stats(sorted_hist, p)
+    # sorted position b means "b+1 categories go left": LightGBM's
+    # max_cat_threshold caps the left-set size
+    B = cat_hist.shape[-2]
+    cap = jnp.arange(B) < p.max_cat_threshold
+    cs = cs[:6] + (jnp.where(cap, cs[6], -jnp.inf),)
     if cat_mask is not None:
         m = cat_mask[..., None]
         stats = tuple(jnp.where(m, c, s) for s, c in zip(stats, cs))

@@ -107,6 +107,13 @@ class LightGBMLearnerParams:
                              default=True)
     seed = Param("seed", "random seed", TC.toInt, default=0)
     verbosity = Param("verbosity", "log level", TC.toInt, default=-1)
+    catSmooth = Param("catSmooth", "hessian smoothing in the categorical "
+                      "gradient/hessian ratio sort", TC.toFloat,
+                      default=10.0)
+    maxCatThreshold = Param("maxCatThreshold",
+                            "max categories in one split's left set "
+                            "(LightGBM max_cat_threshold)", TC.toInt,
+                            default=32)
     categoricalSlotIndexes = Param("categoricalSlotIndexes",
                                    "feature slots treated as categorical",
                                    TC.toListInt, default=[])
@@ -176,5 +183,7 @@ class LightGBMSharedParams(LightGBMExecutionParams, LightGBMLearnerParams,
             sparse_max_bin=self.getMaxBinSparse(),
             parallelism=self.getParallelism(),
             top_k=self.getTopK(),
+            cat_smooth=self.getCatSmooth(),
+            max_cat_threshold=self.getMaxCatThreshold(),
             fobj=self.get("fobj"),
         )

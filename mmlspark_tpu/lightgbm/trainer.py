@@ -84,6 +84,7 @@ class TrainConfig:
     top_k: int = 20                # voting: local nominations per shard
     categorical_features: tuple = ()  # slot indexes with set-based splits
     cat_smooth: float = 10.0       # hessian smoothing in the cat sort
+    max_cat_threshold: int = 32    # max categories in a split's left set
     # engine plumbing
     psum_axis: str | None = None
     fobj: Callable | None = None
@@ -91,6 +92,12 @@ class TrainConfig:
     def __post_init__(self):
         from .objectives import canonical_objective
         self.objective = canonical_objective(self.objective)
+        if self.categorical_features and self.max_cat_threshold <= 0:
+            # all-False cap would silently disable every categorical
+            # split (native LightGBM: CHECK_GT(max_cat_threshold, 0))
+            raise ValueError(
+                f"maxCatThreshold={self.max_cat_threshold} must be "
+                "positive when categorical slots are declared")
 
     def tree_params(self) -> TreeParams:
         # rf: trees are averaged, never shrunk (LightGBM rf.hpp forces
@@ -107,7 +114,8 @@ class TrainConfig:
                          else "data"),
             top_k=self.top_k,
             cat_features=tuple(self.categorical_features),
-            cat_smooth=self.cat_smooth)
+            cat_smooth=self.cat_smooth,
+            max_cat_threshold=self.max_cat_threshold)
 
 
 def _score_update(c, d, coeff, cls):

@@ -332,3 +332,38 @@ class TestCategoricalSplits:
             if tot:
                 agree.append(conc / tot)
         assert np.mean(agree) > 0.9, np.mean(agree)
+
+    def test_max_cat_threshold_caps_left_set(self):
+        """LightGBM's max_cat_threshold: no split may send more than K
+        categories left (prevents overfit mega-sets on high-cardinality
+        features)."""
+        rng = np.random.default_rng(17)
+        n = 2000
+        cats = rng.integers(0, 40, size=n).astype(np.float32)
+        good = np.asarray([1, 3, 5, 7, 9, 11, 13, 15, 17, 19, 21, 23])
+        y = ((np.isin(cats, good) * 2.0 - 1.0
+              + 0.3 * rng.normal(size=n)) > 0).astype(np.float32)
+        df = DataFrame({"features": cats[:, None], "label": y})
+        m = LightGBMClassifier(numIterations=10, numLeaves=15,
+                               minDataInLeaf=5, numShards=1, seed=0,
+                               maxBin=64, maxCatThreshold=4,
+                               categoricalSlotIndexes=[0]).fit(df)
+        cat_flag = np.asarray(m.booster.arrays["cat_flag"])
+        cat_left = np.asarray(m.booster.arrays["cat_left"])
+        assert cat_flag.any()
+        sizes = cat_left[cat_flag].sum(axis=-1)
+        assert sizes.max() <= 4, sizes.max()
+        # and an uncapped model uses bigger sets on the same data
+        m2 = LightGBMClassifier(numIterations=10, numLeaves=15,
+                                minDataInLeaf=5, numShards=1, seed=0,
+                                maxBin=64,
+                                categoricalSlotIndexes=[0]).fit(df)
+        s2 = np.asarray(m2.booster.arrays["cat_left"])[
+            np.asarray(m2.booster.arrays["cat_flag"])].sum(axis=-1)
+        assert s2.max() > 4
+
+    def test_non_positive_max_cat_threshold_raises(self):
+        df = cat_df(300)
+        with pytest.raises(ValueError, match="maxCatThreshold"):
+            LightGBMClassifier(numIterations=2, maxCatThreshold=0,
+                               categoricalSlotIndexes=[0]).fit(df)
