@@ -79,10 +79,13 @@ class Booster:
             return self.num_trees
         return min(self.num_trees, it * self.num_class)
 
-    def raw_scores(self, x, num_iteration: int | None = None) -> np.ndarray:
+    def raw_scores(self, x, num_iteration: int | None = None,
+                   start_iteration: int = 0) -> np.ndarray:
         """Raw margin scores [n] or [n, K]. ``x`` is a dense [n, F] matrix
         or a ``sparse.SparseData`` (padded-COO; the reference's CSR predict
-        path, ``LightGBMBooster.scala:453-488``)."""
+        path, ``LightGBMBooster.scala:453-488``). ``start_iteration``
+        skips the first k iterations' trees (reference
+        ``setStartIteration``, ``LightGBMModelMethods.scala``)."""
         from .sparse import SparseData
         is_sparse = isinstance(x, SparseData)
         n_rows = x.n_rows if is_sparse else x.shape[0]
@@ -103,13 +106,16 @@ class Booster:
         leaves = self._leaf_nodes(x, t_end)          # [n, T]
         leaf_vals = jnp.asarray(self.arrays["leaf_value"][:t_end])[
             jnp.arange(t_end)[None, :], leaves]
-        w = jnp.asarray(self.tree_weights[:t_end])
-        weighted = leaf_vals * w[None, :]
+        w = np.array(self.tree_weights[:t_end])
+        t_start = max(int(start_iteration), 0) * self.num_class
+        if t_start:
+            w[:t_start] = 0.0      # skipped iterations contribute nothing
+        weighted = leaf_vals * jnp.asarray(w)[None, :]
         per_class = weighted.reshape(n_rows, t_end // self.num_class,
                                      self.num_class)
         scores = per_class.sum(axis=1)
         if self.average_output:
-            scores = scores / (t_end // self.num_class)
+            scores = scores / max((t_end - t_start) // self.num_class, 1)
         scores = scores + jnp.asarray(self.init_score).reshape(1, -1)
         out = np.asarray(scores)
         return out[:, 0] if self.num_class == 1 else out

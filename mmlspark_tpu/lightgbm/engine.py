@@ -69,6 +69,7 @@ class TreeParams(NamedTuple):
     cat_features: tuple = ()     # feature indices with set-based splits
     cat_smooth: float = 10.0     # hessian smoothing in the g/h cat sort
     max_cat_threshold: int = 32  # max categories in a split's left set
+    max_delta_step: float = 0.0  # cap on leaf outputs (0 = off)
 
 
 class Tree(NamedTuple):
@@ -94,11 +95,22 @@ def _thresh_l1(g, l1):
 
 
 def _leaf_output(g, h, p: TreeParams):
-    return -_thresh_l1(g, p.lambda_l1) / (h + p.lambda_l2 + 1e-35)
+    out = -_thresh_l1(g, p.lambda_l1) / (h + p.lambda_l2 + 1e-35)
+    if p.max_delta_step > 0:
+        # LightGBM max_delta_step: cap the leaf output magnitude (the
+        # stabilizer for extreme-gradient objectives like poisson)
+        out = jnp.clip(out, -p.max_delta_step, p.max_delta_step)
+    return out
 
 
 def _leaf_gain(g, h, p: TreeParams):
     t = _thresh_l1(g, p.lambda_l1)
+    if p.max_delta_step > 0:
+        # gain at the CLIPPED output (LightGBM's
+        # GetLeafSplitGainGivenOutput) — the unconstrained t²/(h+λ)
+        # would overstate splits whose outputs the cap then truncates
+        o = _leaf_output(g, h, p)
+        return -(2.0 * t * o + (h + p.lambda_l2) * o * o)
     return t * t / (h + p.lambda_l2 + 1e-35)
 
 

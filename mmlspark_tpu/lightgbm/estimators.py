@@ -227,6 +227,10 @@ class _BoosterModelMixin:
         "numIterationsForPrediction",
         "use only the first k iterations when predicting (0 = all/best)",
         TC.toInt, default=0)
+    startIteration = Param(
+        "startIteration",
+        "skip the first k iterations when predicting (reference "
+        "setStartIteration)", TC.toInt, default=0)
 
     booster: Booster
 
@@ -254,6 +258,15 @@ class _BoosterModelMixin:
 
     def _maybe_extra_outputs(self, df, x):
         out = df
+        if self.get("startIteration") and (
+                self.isSet("leafPredictionCol")
+                or self.isSet("featuresShapCol")):
+            # leaf/SHAP outputs ignore the start offset — silently mixing
+            # full-model SHAP with tail-model scores in one row would be
+            # worse than refusing
+            raise ValueError(
+                "startIteration applies to score outputs only; unset "
+                "leafPredictionCol/featuresShapCol (or startIteration)")
         if self.isSet("leafPredictionCol"):
             leaves = self.booster.predict_leaf(x, self._num_iter())
             out = out.with_column(self.getLeafPredictionCol(),
@@ -333,7 +346,9 @@ class LightGBMClassificationModel(_BoosterModelMixin, Model,
     def _transform(self, df):
         x = extract_features(df, self.getFeaturesCol(),
                              self.getSparseFeatureCount())
-        raw = self.booster.raw_scores(x, self._num_iter())
+        raw = self.booster.raw_scores(
+            x, self._num_iter(),
+            start_iteration=self.get("startIteration"))
         prob = np.asarray(self.booster.transform_scores(raw))
         if raw.ndim == 1:  # binary: expand to 2-class columns
             raw2 = np.stack([-raw, raw], axis=1)
@@ -401,7 +416,9 @@ class LightGBMRegressionModel(_BoosterModelMixin, Model,
     def _transform(self, df):
         x = extract_features(df, self.getFeaturesCol(),
                              self.getSparseFeatureCount())
-        raw = self.booster.raw_scores(x, self._num_iter())
+        raw = self.booster.raw_scores(
+            x, self._num_iter(),
+            start_iteration=self.get("startIteration"))
         pred = np.asarray(self.booster.transform_scores(raw))
         out = df.with_column(self.getPredictionCol(), pred)
         return self._maybe_extra_outputs(out, x)
@@ -501,7 +518,9 @@ class LightGBMRankerModel(_BoosterModelMixin, Model, LightGBMSharedParams,
     def _transform(self, df):
         x = extract_features(df, self.getFeaturesCol(),
                              self.getSparseFeatureCount())
-        raw = self.booster.raw_scores(x, self._num_iter())
+        raw = self.booster.raw_scores(
+            x, self._num_iter(),
+            start_iteration=self.get("startIteration"))
         out = df.with_column(self.getPredictionCol(), np.asarray(raw))
         return self._maybe_extra_outputs(out, x)
 

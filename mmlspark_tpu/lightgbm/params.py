@@ -107,6 +107,25 @@ class LightGBMLearnerParams:
                              default=True)
     seed = Param("seed", "random seed", TC.toInt, default=0)
     verbosity = Param("verbosity", "log level", TC.toInt, default=-1)
+    improvementTolerance = Param(
+        "improvementTolerance", "early stopping requires the metric to "
+        "improve by more than this", TC.toFloat, default=0.0)
+    maxDeltaStep = Param("maxDeltaStep", "cap on leaf output magnitude "
+                         "(0 = unconstrained)", TC.toFloat, default=0.0)
+    maxBinByFeature = Param("maxBinByFeature",
+                            "per-feature bin budgets (dense path)",
+                            TC.toListInt, default=[])
+    posBaggingFraction = Param("posBaggingFraction",
+                               "bagging keep-rate for positive rows "
+                               "(class-stratified bagging)", TC.toFloat,
+                               default=1.0)
+    negBaggingFraction = Param("negBaggingFraction",
+                               "bagging keep-rate for negative rows",
+                               TC.toFloat, default=1.0)
+    xgboostDartMode = Param("xgboostDartMode",
+                            "xgboost-style dart normalization "
+                            "(not implemented; raises if set)",
+                            TC.toBoolean, default=False)
     catSmooth = Param("catSmooth", "hessian smoothing in the categorical "
                       "gradient/hessian ratio sort", TC.toFloat,
                       default=10.0)
@@ -185,5 +204,11 @@ class LightGBMSharedParams(LightGBMExecutionParams, LightGBMLearnerParams,
             top_k=self.getTopK(),
             cat_smooth=self.getCatSmooth(),
             max_cat_threshold=self.getMaxCatThreshold(),
+            max_delta_step=self.getMaxDeltaStep(),
+            improvement_tolerance=self.getImprovementTolerance(),
+            max_bin_by_feature=tuple(self.getMaxBinByFeature() or ()),
+            pos_bagging_fraction=self.getPosBaggingFraction(),
+            neg_bagging_fraction=self.getNegBaggingFraction(),
+            xgboost_dart_mode=self.getXgboostDartMode(),
             fobj=self.get("fobj"),
         )

@@ -50,6 +50,8 @@ class TrainConfig:
     min_gain_to_split: float = 0.0
     feature_fraction: float = 1.0
     bagging_fraction: float = 1.0
+    pos_bagging_fraction: float = 1.0  # class-stratified bagging (binary)
+    neg_bagging_fraction: float = 1.0
     bagging_freq: int = 0
     boosting_type: str = "gbdt"
     top_rate: float = 0.2          # goss
@@ -85,6 +87,10 @@ class TrainConfig:
     categorical_features: tuple = ()  # slot indexes with set-based splits
     cat_smooth: float = 10.0       # hessian smoothing in the cat sort
     max_cat_threshold: int = 32    # max categories in a split's left set
+    max_delta_step: float = 0.0    # cap on leaf outputs (0 = off)
+    improvement_tolerance: float = 0.0  # early stopping must beat this
+    max_bin_by_feature: tuple = ()  # per-feature bin budgets (dense only)
+    xgboost_dart_mode: bool = False
     # engine plumbing
     psum_axis: str | None = None
     fobj: Callable | None = None
@@ -98,6 +104,22 @@ class TrainConfig:
             raise ValueError(
                 f"maxCatThreshold={self.max_cat_threshold} must be "
                 "positive when categorical slots are declared")
+        if self.xgboost_dart_mode and self.boosting_type == "dart":
+            # the xgboost-style normalization constants are native
+            # implementation details; wrong guessed semantics would be
+            # worse than a loud gap. Inert (like the reference) when the
+            # boosting type is not dart.
+            raise NotImplementedError(
+                "xgboostDartMode is not implemented; use the default "
+                "DART normalization (new tree 1/(k+1), dropped k/(k+1))")
+        if (self.pos_bagging_fraction != 1.0
+                or self.neg_bagging_fraction != 1.0) \
+                and self.objective != "binary":
+            # label-sign stratification is meaningless outside binary;
+            # native LightGBM restricts these params the same way
+            raise ValueError(
+                "posBaggingFraction/negBaggingFraction require the "
+                f"binary objective (got {self.objective!r})")
 
     def tree_params(self) -> TreeParams:
         # rf: trees are averaged, never shrunk (LightGBM rf.hpp forces
@@ -115,7 +137,8 @@ class TrainConfig:
             top_k=self.top_k,
             cat_features=tuple(self.categorical_features),
             cat_smooth=self.cat_smooth,
-            max_cat_threshold=self.max_cat_threshold)
+            max_cat_threshold=self.max_cat_threshold,
+            max_delta_step=self.max_delta_step)
 
 
 def _score_update(c, d, coeff, cls):
@@ -313,6 +336,10 @@ def train(x: np.ndarray, y: np.ndarray, w: np.ndarray | None,
 
     # ---- binning (host boundaries, device mapping)
     if sparse:
+        if cfg.max_bin_by_feature:
+            raise NotImplementedError(
+                "maxBinByFeature is dense-only: the sparse binning's "
+                "reserved zero-separator cuts cannot be truncated")
         sparse_b = min(cfg.sparse_max_bin, cfg.max_bin)
         # bin_sample_count is a ROW budget; the COO sampler works in
         # entries, so scale by the per-row entry capacity W
@@ -348,6 +375,33 @@ def train(x: np.ndarray, y: np.ndarray, w: np.ndarray | None,
         boundaries = compute_bin_boundaries(x[:n_real], cfg.max_bin,
                                             sample_cnt=cfg.bin_sample_count,
                                             seed=cfg.seed)
+        if cfg.max_bin_by_feature:
+            # LightGBM max_bin_by_feature: per-feature bin budgets. A
+            # budget of k bins keeps the first k-1 cuts (the rest become
+            # +inf, i.e. empty bins — the scan just never splits there).
+            budgets = tuple(cfg.max_bin_by_feature)
+            if len(budgets) != F:
+                raise ValueError(
+                    f"maxBinByFeature has {len(budgets)} entries for "
+                    f"{F} features")
+            for f, budget in enumerate(budgets):
+                if not budget:
+                    continue
+                if budget == 1:
+                    # all cuts at +inf would silently disable the
+                    # feature (LightGBM: max_bin_by_feature > 1)
+                    raise ValueError(
+                        f"maxBinByFeature[{f}]=1 would leave feature "
+                        f"{f} unsplittable; use >= 2 (or 0 for the "
+                        "default budget)")
+                if f in cfg.categorical_features:
+                    # identity binning would overwrite the budget below
+                    raise ValueError(
+                        f"maxBinByFeature cannot cap categorical slot "
+                        f"{f}: categories bin by id (cap cardinality "
+                        "by re-indexing instead)")
+                if budget < cfg.max_bin:
+                    boundaries[f, budget - 1:] = np.inf
         for f in cfg.categorical_features:
             # identity binning for categorical slots: category c (an
             # integer value) lands in bin c+1 exactly, so the engine's
@@ -429,6 +483,24 @@ def train(x: np.ndarray, y: np.ndarray, w: np.ndarray | None,
     evals: list[dict] = []
     best_iter, best_metric, rounds_no_improve = -1, None, 0
     bag_mask = np.ones(n, np.float32)
+    # class-stratified bagging (LightGBM pos/neg_bagging_fraction):
+    # independent keep-rates per class for unbalanced binary data
+    stratified_bag = (cfg.pos_bagging_fraction != 1.0
+                      or cfg.neg_bagging_fraction != 1.0)
+    bagging_active = cfg.bagging_fraction < 1.0 or stratified_bag
+    if stratified_bag:
+        bag_thresh = np.where(np.asarray(y, np.float32) > 0,
+                              np.float32(cfg.pos_bagging_fraction),
+                              np.float32(cfg.neg_bagging_fraction))
+
+    def draw_bag() -> np.ndarray:
+        """One host-RNG bagging draw (plain or class-stratified); every
+        path draws through here so chunked/fused/stepwise consume the
+        identical RNG sequence."""
+        u = bag_rng.random(n)
+        if stratified_bag:
+            return (u < bag_thresh).astype(np.float32)
+        return (u < cfg.bagging_fraction).astype(np.float32)
     # single source of truth for the pad/ignore mask: host copy feeds the
     # fused path's host-side bagging product, device copy everything else
     valid_mask_np = np.asarray(pad_mask, np.float32) \
@@ -766,14 +838,11 @@ def train(x: np.ndarray, y: np.ndarray, w: np.ndarray | None,
                     fms[j, rng.choice(F, size=nf, replace=False)] = True
             if is_goss:
                 rms = jnp.broadcast_to(valid_mask_dev, (k, n))
-            elif (is_rf or cfg.bagging_freq > 0) \
-                    and cfg.bagging_fraction < 1.0:
+            elif (is_rf or cfg.bagging_freq > 0) and bagging_active:
                 rms_np = np.empty((k, n), np.float32)
                 for j in range(k):
                     if is_rf or (it + j) % max(cfg.bagging_freq, 1) == 0:
-                        bag_mask = (bag_rng.random(n)
-                                    < cfg.bagging_fraction).astype(
-                                        np.float32)
+                        bag_mask = draw_bag()
                     rms_np[j] = bag_mask * valid_mask_np
                 rms = jnp.asarray(rms_np)
             else:
@@ -845,10 +914,9 @@ def train(x: np.ndarray, y: np.ndarray, w: np.ndarray | None,
 
         if dart_fused:
             # ---- fused dart iteration: ONE device dispatch, like gbdt's
-            if cfg.bagging_freq > 0 and cfg.bagging_fraction < 1.0:
+            if cfg.bagging_freq > 0 and bagging_active:
                 if it % max(cfg.bagging_freq, 1) == 0:
-                    bag_mask = (bag_rng.random(n)
-                                < cfg.bagging_fraction).astype(np.float32)
+                    bag_mask = draw_bag()
                 row_mask_dev = jnp.asarray(bag_mask) * valid_mask_dev
             else:
                 row_mask_dev = valid_mask_dev
@@ -864,11 +932,9 @@ def train(x: np.ndarray, y: np.ndarray, w: np.ndarray | None,
             # gradients + sampling + growth + deltas + score updates
             if is_goss:
                 row_in = valid_mask_dev
-            elif (is_rf or cfg.bagging_freq > 0) \
-                    and cfg.bagging_fraction < 1.0:
+            elif (is_rf or cfg.bagging_freq > 0) and bagging_active:
                 if is_rf or it % max(cfg.bagging_freq, 1) == 0:
-                    bag_mask = (bag_rng.random(n)
-                                < cfg.bagging_fraction).astype(np.float32)
+                    bag_mask = draw_bag()
                 row_in = jnp.asarray(bag_mask * valid_mask_np)
             else:
                 row_in = valid_mask_dev
@@ -887,10 +953,9 @@ def train(x: np.ndarray, y: np.ndarray, w: np.ndarray | None,
                 g, h = obj.grad_hess(eff_scores, y_dev, w_dev)
 
             # row sampling (padded rows always excluded: SPMD "ignore")
-            if cfg.bagging_freq > 0 and cfg.bagging_fraction < 1.0:
+            if cfg.bagging_freq > 0 and bagging_active:
                 if it % max(cfg.bagging_freq, 1) == 0:
-                    bag_mask = (bag_rng.random(n)
-                                < cfg.bagging_fraction).astype(np.float32)
+                    bag_mask = draw_bag()
                 row_mask_dev = jnp.asarray(bag_mask) * valid_mask_dev
             else:
                 row_mask_dev = valid_mask_dev
@@ -987,9 +1052,11 @@ def train(x: np.ndarray, y: np.ndarray, w: np.ndarray | None,
                                     None if wv is None else np.asarray(wv),
                                     cfg)
             evals.append({"iteration": it, metric_name: m})
+            tol = cfg.improvement_tolerance
             better = (best_metric is None
-                      or (m > best_metric if _higher_better(metric_name)
-                          else m < best_metric))
+                      or (m > best_metric + tol
+                          if _higher_better(metric_name)
+                          else m < best_metric - tol))
             if better:
                 best_metric, best_iter, rounds_no_improve = m, it, 0
             else:

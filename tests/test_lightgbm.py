@@ -464,3 +464,138 @@ class TestDeviceSideDart:
         res = train(x, y, None, cfg, valid=(xv, yv, None))
         assert res.host_pulls_bulk == 0
         assert [e["iteration"] for e in res.evals] == [3, 7, 11]
+
+
+class TestLongTailParams:
+    """Reference param-surface long tail (LightGBMParams.scala):
+    improvementTolerance, maxDeltaStep, pos/negBaggingFraction,
+    startIteration, maxBinByFeature."""
+
+    def test_max_delta_step_caps_leaf_values(self):
+        df = classification_df(500)
+        kw = dict(numIterations=10, numLeaves=15, minDataInLeaf=5,
+                  numShards=1, seed=0)
+        m = LightGBMClassifier(maxDeltaStep=0.01, **kw).fit(df)
+        leaves = np.asarray(m.booster.arrays["leaf_value"])
+        # leaf values carry learning_rate (0.1) shrinkage on top
+        assert np.abs(leaves).max() <= 0.01 * 0.1 + 1e-6
+        m2 = LightGBMClassifier(**kw).fit(df)
+        assert np.abs(np.asarray(
+            m2.booster.arrays["leaf_value"])).max() > 0.001 + 1e-6
+
+    def test_improvement_tolerance_stops_earlier(self):
+        rng = np.random.default_rng(3)
+        x = rng.normal(size=(800, 8)).astype(np.float32)
+        y = (x[:, 0] + rng.normal(scale=1.5, size=800) > 0).astype(
+            np.float32)
+        flag = np.zeros(800, bool)
+        flag[::4] = True
+        df = DataFrame({"features": x, "label": y, "valid": flag})
+        kw = dict(numIterations=60, numLeaves=7, minDataInLeaf=5,
+                  numShards=1, seed=0, validationIndicatorCol="valid",
+                  earlyStoppingRound=5)
+        m_tol = LightGBMClassifier(improvementTolerance=0.05, **kw).fit(df)
+        m_no = LightGBMClassifier(**kw).fit(df)
+        it_tol = m_tol.booster.best_iteration
+        it_no = m_no.booster.best_iteration
+        assert it_tol >= 0
+        assert it_tol <= it_no or it_no < 0
+
+    def test_stratified_bagging(self):
+        rng = np.random.default_rng(5)
+        x = rng.normal(size=(1500, 6)).astype(np.float32)
+        y = (rng.random(1500) < 0.1).astype(np.float32)  # rare positives
+        df = DataFrame({"features": x, "label": y})
+        m = LightGBMClassifier(numIterations=5, numLeaves=7,
+                               minDataInLeaf=2, numShards=1, seed=0,
+                               baggingFreq=1, posBaggingFraction=1.0,
+                               negBaggingFraction=0.2).fit(df)
+        # root node_count reflects the stratified sample: ~all positives
+        # + ~20% negatives
+        counts = np.asarray(m.booster.arrays["node_count"])[:, 0]
+        expect = y.sum() + 0.2 * (1500 - y.sum())
+        assert abs(counts.mean() - expect) < 0.15 * expect, (
+            counts.mean(), expect)
+
+    def test_start_iteration_prediction(self):
+        df = classification_df(500)
+        m = LightGBMClassifier(numIterations=12, numLeaves=7,
+                               minDataInLeaf=5, numShards=1,
+                               seed=0).fit(df)
+        x = np.asarray(df["features"])
+        full = np.asarray(m.booster.raw_scores(x))
+        head = np.asarray(m.booster.raw_scores(x, num_iteration=4))
+        tail = np.asarray(m.booster.raw_scores(x, start_iteration=4))
+        init = float(m.booster.init_score)
+        np.testing.assert_allclose(head + tail - init, full, atol=1e-5)
+        # the model param routes through transform
+        m.set("startIteration", 4)
+        p_tail = np.asarray(m.transform(df)["probability"][:, 1])
+        np.testing.assert_allclose(
+            p_tail, np.asarray(m.booster.transform_scores(tail)),
+            atol=1e-6)
+
+    def test_max_bin_by_feature(self):
+        rng = np.random.default_rng(7)
+        x = rng.normal(size=(800, 3)).astype(np.float32)
+        y = (x[:, 0] + 0.5 * x[:, 1] > 0).astype(np.float32)
+        df = DataFrame({"features": x, "label": y})
+        m = LightGBMClassifier(numIterations=10, numLeaves=15,
+                               minDataInLeaf=5, numShards=1, seed=0,
+                               maxBinByFeature=[2, 0, 0]).fit(df)
+        # feature 0 has a 2-bin budget → only one distinct threshold
+        arr = m.booster.arrays
+        f0_splits = arr["threshold"][(arr["feature"] == 0)
+                                     & ~arr["is_leaf"]
+                                     & (arr["left"] >= 0)]
+        assert len(set(np.round(f0_splits, 5).tolist())) <= 1
+        with pytest.raises(ValueError, match="maxBinByFeature"):
+            LightGBMClassifier(maxBinByFeature=[2],
+                               numIterations=2).fit(df)
+
+    def test_xgboost_dart_mode_raises(self):
+        df = classification_df(300)
+        with pytest.raises(NotImplementedError, match="xgboostDartMode"):
+            LightGBMClassifier(boostingType="dart",
+                               xgboostDartMode=True,
+                               numIterations=2).fit(df)
+
+    def test_stratified_bagging_requires_binary(self):
+        rng = np.random.default_rng(1)
+        x = rng.normal(size=(300, 4)).astype(np.float32)
+        y = rng.normal(size=300).astype(np.float32)
+        df = DataFrame({"features": x, "label": y})
+        from mmlspark_tpu.lightgbm import LightGBMRegressor
+        with pytest.raises(ValueError, match="binary"):
+            LightGBMRegressor(numIterations=2, baggingFreq=1,
+                              negBaggingFraction=0.5).fit(df)
+
+    def test_start_iteration_refuses_leaf_and_shap(self):
+        df = classification_df(300)
+        m = LightGBMClassifier(numIterations=5, numLeaves=7,
+                               minDataInLeaf=5, numShards=1,
+                               seed=0).fit(df)
+        m.set("startIteration", 2)
+        m.set("leafPredictionCol", "leaves")
+        with pytest.raises(ValueError, match="startIteration"):
+            m.transform(df)
+
+    def test_max_bin_by_feature_rejects_categorical_and_one(self):
+        rng = np.random.default_rng(2)
+        x = np.stack([rng.integers(0, 5, 300), rng.normal(size=300)],
+                     axis=1).astype(np.float32)
+        y = (x[:, 1] > 0).astype(np.float32)
+        df = DataFrame({"features": x, "label": y})
+        with pytest.raises(ValueError, match="categorical"):
+            LightGBMClassifier(numIterations=2, maxBinByFeature=[4, 0],
+                               categoricalSlotIndexes=[0]).fit(df)
+        with pytest.raises(ValueError, match="unsplittable"):
+            LightGBMClassifier(numIterations=2,
+                               maxBinByFeature=[0, 1]).fit(df)
+
+    def test_xgboost_dart_mode_inert_outside_dart(self):
+        df = classification_df(300)
+        m = LightGBMClassifier(numIterations=3, numLeaves=7,
+                               minDataInLeaf=5, numShards=1, seed=0,
+                               xgboostDartMode=True).fit(df)
+        assert m.booster.num_trees == 3
