@@ -210,10 +210,23 @@ class VowpalWabbitClassificationModel(Model, VowpalWabbitBaseParams,
 class VowpalWabbitClassifier(_VWBaseEstimator):
     """Binary classifier; labels {0,1} are mapped to VW's {-1,+1}
     (reference ``VowpalWabbitClassifier.scala`` trains with
-    ``--loss_function logistic``)."""
+    ``--loss_function logistic``; ``labelConversion=False`` for data
+    already in {-1,+1})."""
     _loss_default = "logistic"
 
+    labelConversion = Param(
+        "labelConversion", "convert 0/1 labels to VW's -1/+1 "
+        "(disable when labels already are -1/+1)", TC.toBoolean,
+        default=True)
+
     def _prepare_labels(self, y: np.ndarray) -> np.ndarray:
+        if not self.get("labelConversion"):
+            bad = np.setdiff1d(np.unique(y), [-1.0, 1.0])
+            if bad.size:
+                raise ValueError(
+                    "labelConversion=False requires -1/+1 labels; "
+                    f"found {bad[:5].tolist()}")
+            return np.asarray(y, np.float32)
         return np.where(y > 0, 1.0, -1.0).astype(np.float32)
 
     def _make_model(self, state):

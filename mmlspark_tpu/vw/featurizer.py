@@ -33,6 +33,16 @@ class VowpalWabbitFeaturizer(Transformer, HasInputCols, HasOutputCol):
                         "fixed nnz capacity per row (padding width); 0 = "
                         "auto from data", TC.toInt, default=0,
                         has_default=True)
+    prefixStringsWithColumnName = Param(
+        "prefixStringsWithColumnName",
+        "prefix hashed feature names with the column name (reference "
+        "default; disabling matches raw-VW lines where only the value "
+        "is hashed)", TC.toBoolean, default=True)
+    preserveOrderNumBits = Param(
+        "preserveOrderNumBits",
+        "accepted for API parity: the reference declares this param "
+        "but never consumes it (VowpalWabbitFeaturizer.scala:47-54)",
+        TC.toInt, default=0)
 
     def __init__(self, **kwargs):
         super().__init__(**kwargs)
@@ -40,7 +50,10 @@ class VowpalWabbitFeaturizer(Transformer, HasInputCols, HasOutputCol):
 
     # ------------------------------------------------------------------
     def _row_features(self, colname: str, value, ns_hash: int,
-                      num_bits: int, split: bool):
+                      num_bits: int, split: bool,
+                      prefix: str | None = None):
+        if prefix is None:
+            prefix = colname
         """(indices, values) contributed by one cell — dispatch on type,
         mirroring the reference's per-type featurizers."""
         out_i, out_v = [], []
@@ -61,19 +74,19 @@ class VowpalWabbitFeaturizer(Transformer, HasInputCols, HasOutputCol):
                 # StringSplitFeaturizer: each token a unit feature
                 for tok in value.split():
                     out_i.append(vw_feature_hash(
-                        colname + tok, ns_hash, num_bits))
+                        prefix + tok, ns_hash, num_bits))
                     out_v.append(1.0)
             else:
                 # StringFeaturizer: categorical "col=value" unit feature
                 out_i.append(vw_feature_hash(
-                    colname + value, ns_hash, num_bits))
+                    prefix + value, ns_hash, num_bits))
                 out_v.append(1.0)
         elif isinstance(value, dict):
             # MapFeaturizer: key → "col+key", weight = mapped value
             for k, v in value.items():
                 if float(v) != 0.0:
                     out_i.append(vw_feature_hash(
-                        colname + str(k), ns_hash, num_bits))
+                        prefix + str(k), ns_hash, num_bits))
                     out_v.append(float(v))
         elif isinstance(value, (list, tuple, np.ndarray)):
             arr = np.asarray(value)
@@ -81,7 +94,7 @@ class VowpalWabbitFeaturizer(Transformer, HasInputCols, HasOutputCol):
                 # SeqFeaturizer of strings
                 for s in arr:
                     out_i.append(vw_feature_hash(
-                        colname + str(s), ns_hash, num_bits))
+                        prefix + str(s), ns_hash, num_bits))
                     out_v.append(1.0)
             else:
                 # VectorFeaturizer: dense vector, index = hash(col) + slot
@@ -170,7 +183,10 @@ class VowpalWabbitFeaturizer(Transformer, HasInputCols, HasOutputCol):
         return rows, out_idx[pick], out_val[pick]
 
     def _column_coo(self, colname: str, data, n: int, ns_hash: int,
-                    num_bits: int, split: bool):
+                    num_bits: int, split: bool,
+                    prefix: str | None = None):
+        if prefix is None:
+            prefix = colname
         """One column → (rows, indices, values) COO triples, vectorized
         per dtype; exotic cell types fall back to the per-row dispatcher."""
         arr = np.asarray(data)
@@ -197,14 +213,16 @@ class VowpalWabbitFeaturizer(Transformer, HasInputCols, HasOutputCol):
             return r.astype(np.int64), slot_idx[cpos], v[r, cpos]
         if arr.dtype == object and all(
                 x is None or isinstance(x, str) for x in arr):
-            return self._string_coo(colname, arr, ns_hash, num_bits, split)
+            return self._string_coo(prefix, arr, ns_hash,
+                                    num_bits, split)
         # mixed/object cells (dicts, sequences): per-row dispatch
         rows: list[int] = []
         idxs: list[int] = []
         vals: list[float] = []
         for r in range(n):
-            i, v = self._row_features(colname, data[r], ns_hash, num_bits,
-                                      split)
+            i, v = self._row_features(colname, data[r], ns_hash,
+                                      num_bits, split,
+                                      prefix=prefix)
             rows.extend([r] * len(i))
             idxs.extend(i)
             vals.extend(v)
@@ -221,8 +239,15 @@ class VowpalWabbitFeaturizer(Transformer, HasInputCols, HasOutputCol):
 
         n = len(df)
         col_data = {c: df[c] for c in list(cols) + list(split_cols - set(cols))}
+        # prefixStringsWithColumnName=False drops the column-name prefix
+        # from STRING-VALUED hashes only (string/seq/map/token cells);
+        # numeric/bool/vector features keep hashing the column name —
+        # an empty name there would collapse every such column onto one
+        # index and silently merge them
+        use_prefix = self.get("prefixStringsWithColumnName")
         triples = [self._column_coo(c, data, n, ns_hash, num_bits,
-                                    c in split_cols)
+                                    c in split_cols,
+                                    prefix=None if use_prefix else "")
                    for c, data in col_data.items()]
         rows = np.concatenate([t[0] for t in triples]) if triples else \
             np.zeros(0, np.int64)

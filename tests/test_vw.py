@@ -334,3 +334,54 @@ class TestNativeHashParity:
             outputCol="f").transform(df)
         np.testing.assert_array_equal(cut["f_indices"][0],
                                       full["f_indices"][0][:2])
+
+
+class TestFeaturizerLongTail:
+    def test_prefix_strings_with_column_name(self):
+        from mmlspark_tpu.vw import VowpalWabbitFeaturizer
+        df = DataFrame({"city": np.asarray(["ams", "ber"], object)})
+        with_prefix = VowpalWabbitFeaturizer(
+            inputCols=["city"], outputCol="f").transform(df)
+        bare = VowpalWabbitFeaturizer(
+            inputCols=["city"], outputCol="f",
+            prefixStringsWithColumnName=False).transform(df)
+        # different hash inputs → different indices
+        assert set(np.asarray(with_prefix["f_indices"]).ravel()) != \
+            set(np.asarray(bare["f_indices"]).ravel())
+        # and the bare mode equals hashing the raw value alone (the
+        # reference's prefixName="" semantics, default namespace seed 0)
+        from mmlspark_tpu.vw.murmur import vw_feature_hash
+        expect = vw_feature_hash("ams", 0, 18)
+        assert expect in set(np.asarray(bare["f_indices"]).ravel())
+
+    def test_label_conversion_off(self):
+        from mmlspark_tpu.vw import VowpalWabbitClassifier
+        rng = np.random.default_rng(0)
+        x = rng.normal(size=(400, 6)).astype(np.float32)
+        y_pm = np.where(x[:, 0] > 0, 1.0, -1.0).astype(np.float32)
+        df = DataFrame({"features": x, "label": y_pm})
+        m = VowpalWabbitClassifier(numPasses=4, batchSize=64,
+                                   numShards=1,
+                                   labelConversion=False).fit(df)
+        p = np.asarray(m.transform(df)["probability"][:, 1])
+        auc = roc_auc((y_pm > 0).astype(np.float32), p)
+        assert auc > 0.9
+        with pytest.raises(ValueError, match="labelConversion"):
+            VowpalWabbitClassifier(labelConversion=False).fit(
+                DataFrame({"features": x,
+                           "label": (y_pm > 0).astype(np.float32)}))
+
+    def test_bare_prefix_keeps_numeric_columns_distinct(self):
+        """Dropping the prefix must not collapse numeric columns onto
+        one hash index (string-valued hashes only)."""
+        from mmlspark_tpu.vw import VowpalWabbitFeaturizer
+        df = DataFrame({"age": np.asarray([3.0, 5.0], np.float32),
+                        "income": np.asarray([7.0, 11.0], np.float32)})
+        out = VowpalWabbitFeaturizer(
+            inputCols=["age", "income"], outputCol="f",
+            prefixStringsWithColumnName=False).transform(df)
+        idx = np.asarray(out["f_indices"])
+        vals = np.asarray(out["f_values"])
+        # two distinct indices per row, original values unmerged
+        assert len(set(idx[0][idx[0] >= 0].tolist())) == 2
+        assert set(np.round(vals[0][vals[0] != 0], 3)) == {3.0, 7.0}
