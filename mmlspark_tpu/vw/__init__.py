@@ -12,13 +12,14 @@ scatter-add SGD in XLA; the spanning-tree AllReduce becomes weight-averaging
 from .murmur import murmur3_32, vw_hash, vw_feature_hash
 from .featurizer import VowpalWabbitFeaturizer
 from .interactions import VowpalWabbitInteractions
+from .vector_zipper import VectorZipper
 from .estimators import (VowpalWabbitClassifier, VowpalWabbitClassificationModel,
                          VowpalWabbitRegressor, VowpalWabbitRegressionModel)
 from .contextual_bandit import (VowpalWabbitContextualBandit,
                                 ContextualBanditMetrics)
 
 __all__ = ["murmur3_32", "vw_hash", "vw_feature_hash",
-           "VowpalWabbitFeaturizer", "VowpalWabbitInteractions",
+           "VowpalWabbitFeaturizer", "VowpalWabbitInteractions", "VectorZipper",
            "VowpalWabbitClassifier", "VowpalWabbitClassificationModel",
            "VowpalWabbitRegressor", "VowpalWabbitRegressionModel",
            "VowpalWabbitContextualBandit", "ContextualBanditMetrics"]

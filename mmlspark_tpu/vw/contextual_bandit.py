@@ -67,6 +67,10 @@ class VowpalWabbitContextualBandit(Estimator, HasFeaturesCol):
     learningRate = Param("learningRate", "learning rate", TC.toFloat,
                          default=0.5)
     batchSize = Param("batchSize", "minibatch size", TC.toInt, default=256)
+    epsilon = Param("epsilon", "exploration rate of the epsilon-greedy "
+                    "policy (reference setEpsilon, "
+                    "VowpalWabbitContextualBandit.scala:134-139)",
+                    TC.toFloat, default=0.05)
 
     def _fit(self, df):
         base = self.getFeaturesCol()
@@ -108,6 +112,10 @@ class VowpalWabbitContextualBanditModel(Model, HasFeaturesCol):
         return df.with_column(self.get("predictionCol"),
                               raw.astype(np.float32))
 
+    epsilon = Param("epsilon", "exploration rate of the epsilon-greedy "
+                    "policy (copied from the estimator at fit)",
+                    TC.toFloat, default=0.05)
+
     def best_actions(self, df, group_col: str = "decision") -> np.ndarray:
         """argmin predicted cost per decision group."""
         out = self.transform(df)
@@ -120,3 +128,25 @@ class VowpalWabbitContextualBanditModel(Model, HasFeaturesCol):
                 best[g] = (p, a)
         return np.asarray([best[g][1] for g in
                            sorted(best, key=lambda x: str(x))])
+
+    def action_probabilities(self, df,
+                             group_col: str = "decision") -> "object":
+        """Epsilon-greedy policy distribution (VW ``--cb_explore_adf
+        --epsilon``): per decision, the argmin-cost action gets
+        1 - ε + ε/K and every other action ε/K — the probabilities
+        logged for the next round of off-policy training. Returns the
+        scored DataFrame with a ``policyProbability`` column."""
+        out = self.transform(df)
+        groups = np.asarray(out[group_col])
+        preds = np.asarray(out[self.get("predictionCol")])
+        eps = self.get("epsilon")
+        # one pass: group ids → inverse, grouped first-wins argmin via a
+        # stable lexsort (no per-group rescan of the full array)
+        _, inv = np.unique(groups, return_inverse=True)
+        k_per = np.bincount(inv)
+        order = np.lexsort((preds, inv))
+        starts = np.r_[0, np.cumsum(k_per)[:-1]]
+        greedy_rows = order[starts]
+        probs = eps / k_per[inv].astype(np.float64)
+        probs[greedy_rows] += 1.0 - eps
+        return out.with_column("policyProbability", probs)

@@ -40,8 +40,9 @@ class VowpalWabbitFeaturizer(Transformer, HasInputCols, HasOutputCol):
         "is hashed)", TC.toBoolean, default=True)
     preserveOrderNumBits = Param(
         "preserveOrderNumBits",
-        "accepted for API parity: the reference declares this param "
-        "but never consumes it (VowpalWabbitFeaturizer.scala:47-54)",
+        "reserve the top bits of each index for the feature's position "
+        "in its row (reference transform: index |= pos << "
+        "(30 - preserveOrderNumBits); numBits + this must be <= 30)",
         TC.toInt, default=0)
 
     def __init__(self, **kwargs):
@@ -52,22 +53,25 @@ class VowpalWabbitFeaturizer(Transformer, HasInputCols, HasOutputCol):
     def _row_features(self, colname: str, value, ns_hash: int,
                       num_bits: int, split: bool,
                       prefix: str | None = None):
+        """(indices, values) contributed by one cell — dispatch on type,
+        mirroring the reference's per-type featurizers. ``prefix`` is the
+        reference's prefixName (empty when
+        prefixStringsWithColumnName=False); sequences of strings never
+        use it (VowpalWabbitFeaturizer.scala:81-82)."""
         if prefix is None:
             prefix = colname
-        """(indices, values) contributed by one cell — dispatch on type,
-        mirroring the reference's per-type featurizers."""
         out_i, out_v = [], []
         if value is None:
             return out_i, out_v
         if isinstance(value, (bool, np.bool_)):
             # BooleanFeaturizer: presence feature when true
             if value:
-                out_i.append(vw_feature_hash(colname, ns_hash, num_bits))
+                out_i.append(vw_feature_hash(prefix, ns_hash, num_bits))
                 out_v.append(1.0)
         elif isinstance(value, (int, float, np.integer, np.floating)):
-            # NumericFeaturizer: index from column name, weight = value
+            # NumericFeaturizer: index from prefixName, weight = value
             if float(value) != 0.0:
-                out_i.append(vw_feature_hash(colname, ns_hash, num_bits))
+                out_i.append(vw_feature_hash(prefix, ns_hash, num_bits))
                 out_v.append(float(value))
         elif isinstance(value, str):
             if split:
@@ -91,14 +95,15 @@ class VowpalWabbitFeaturizer(Transformer, HasInputCols, HasOutputCol):
         elif isinstance(value, (list, tuple, np.ndarray)):
             arr = np.asarray(value)
             if arr.dtype.kind in "OUS":
-                # SeqFeaturizer of strings
+                # SeqFeaturizer of strings: NEVER prefixed (reference
+                # VowpalWabbitFeaturizer.scala:81-82)
                 for s in arr:
                     out_i.append(vw_feature_hash(
-                        prefix + str(s), ns_hash, num_bits))
+                        str(s), ns_hash, num_bits))
                     out_v.append(1.0)
             else:
-                # VectorFeaturizer: dense vector, index = hash(col) + slot
-                base = vw_feature_hash(colname, ns_hash, num_bits)
+                # VectorFeaturizer: dense vector, index = hash(name) + slot
+                base = vw_feature_hash(prefix, ns_hash, num_bits)
                 mask = (1 << num_bits) - 1
                 for slot, v in enumerate(arr.ravel()):
                     if float(v) != 0.0:
@@ -185,27 +190,28 @@ class VowpalWabbitFeaturizer(Transformer, HasInputCols, HasOutputCol):
     def _column_coo(self, colname: str, data, n: int, ns_hash: int,
                     num_bits: int, split: bool,
                     prefix: str | None = None):
+        """One column → (rows, indices, values) COO triples, vectorized
+        per dtype; exotic cell types fall back to the per-row
+        dispatcher."""
         if prefix is None:
             prefix = colname
-        """One column → (rows, indices, values) COO triples, vectorized
-        per dtype; exotic cell types fall back to the per-row dispatcher."""
         arr = np.asarray(data)
         mask = (1 << num_bits) - 1
         if arr.ndim == 1 and arr.dtype.kind == "b":
-            base = vw_feature_hash(colname, ns_hash, num_bits)
+            base = vw_feature_hash(prefix, ns_hash, num_bits)
             nz = np.flatnonzero(arr)
             return (nz.astype(np.int64),
                     np.full(nz.size, base, np.int32),
                     np.ones(nz.size, np.float32))
         if arr.ndim == 1 and arr.dtype.kind in "fiu":
-            base = vw_feature_hash(colname, ns_hash, num_bits)
+            base = vw_feature_hash(prefix, ns_hash, num_bits)
             v = arr.astype(np.float32)
             nz = np.flatnonzero(v != 0.0)
             return (nz.astype(np.int64),
                     np.full(nz.size, base, np.int32), v[nz])
         if arr.ndim == 2 and arr.dtype.kind in "fiu":
-            # VectorFeaturizer: index = hash(col) + slot
-            base = vw_feature_hash(colname, ns_hash, num_bits)
+            # VectorFeaturizer: index = hash(name) + slot
+            base = vw_feature_hash(prefix, ns_hash, num_bits)
             slot_idx = ((base + np.arange(arr.shape[1], dtype=np.int64))
                         & mask).astype(np.int32)
             v = arr.astype(np.float32)
@@ -234,16 +240,24 @@ class VowpalWabbitFeaturizer(Transformer, HasInputCols, HasOutputCol):
         num_bits = self.get("numBits")
         seed = self.get("hashSeed")
         split_cols = set(self.get("stringSplitInputCols"))
-        ns_hash = seed  # default (empty) namespace, VW semantics
+        # reference: namespaceHash = murmur(outputCol, seed)
+        # (VowpalWabbitFeaturizer.scala transform) — bit-parity of the
+        # hashed indices with the reference requires the same namespace
+        ns_hash = murmur3_32(self.getOutputCol().encode("utf-8"), seed)
         sum_collisions = self.get("sumCollisions")
+        order_bits = self.get("preserveOrderNumBits")
+        if order_bits and order_bits + num_bits > 30:
+            raise ValueError(
+                f"numBits ({num_bits}) + preserveOrderNumBits "
+                f"({order_bits}) must be <= 30 (reference validation)")
 
         n = len(df)
         col_data = {c: df[c] for c in list(cols) + list(split_cols - set(cols))}
-        # prefixStringsWithColumnName=False drops the column-name prefix
-        # from STRING-VALUED hashes only (string/seq/map/token cells);
-        # numeric/bool/vector features keep hashing the column name —
-        # an empty name there would collapse every such column onto one
-        # index and silently merge them
+        # prefixStringsWithColumnName=False passes an empty prefix to
+        # EVERY featurizer type, exactly like the reference
+        # (getFeaturizer's prefixName) — note that with the shared
+        # output-column namespace this merges same-typed numeric columns
+        # onto one index, also like the reference
         use_prefix = self.get("prefixStringsWithColumnName")
         triples = [self._column_coo(c, data, n, ns_hash, num_bits,
                                     c in split_cols,
@@ -255,6 +269,25 @@ class VowpalWabbitFeaturizer(Transformer, HasInputCols, HasOutputCol):
             np.zeros(0, np.int32)
         val = np.concatenate([t[2] for t in triples]) if triples else \
             np.zeros(0, np.float32)
+
+        if order_bits and rows.size:
+            # reference order preservation: stable-sort by row, then OR
+            # each feature's row-position into the high bits — collisions
+            # at different positions stay distinct, and sorting by the
+            # combined index reproduces input order
+            order0 = np.argsort(rows, kind="stable")
+            rows, idx, val = rows[order0], idx[order0], val[order0]
+            counts0 = np.bincount(rows, minlength=n)
+            if counts0.max(initial=0) > (1 << order_bits):
+                raise ValueError(
+                    f"a row has {int(counts0.max())} features — too many "
+                    f"for preserveOrderNumBits={order_bits} "
+                    f"(max {1 << order_bits}, reference validation)")
+            starts0 = np.zeros(n, np.int64)
+            np.cumsum(counts0[:-1], out=starts0[1:])
+            pos0 = np.arange(rows.size, dtype=np.int64) - starts0[rows]
+            idx = (idx.astype(np.int64)
+                   | (pos0 << (30 - order_bits))).astype(np.int32)
 
         if sum_collisions and rows.size:
             # merge duplicate (row, index) pairs, float64 accumulation

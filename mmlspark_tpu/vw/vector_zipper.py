@@ -1,0 +1,26 @@
+"""VectorZipper — combine columns into one sequence column.
+
+Reference ``vw/VectorZipper.scala``: zips one or more input columns into
+an array column, the shape the contextual-bandit action-dependent-feature
+pipelines feed (one sequence of per-action payloads per decision)."""
+
+from __future__ import annotations
+
+import numpy as np
+
+from ..core import Transformer
+from ..core.contracts import HasInputCols, HasOutputCol
+
+
+class VectorZipper(Transformer, HasInputCols, HasOutputCol):
+    def __init__(self, **kwargs):
+        super().__init__(**kwargs)
+        self._setDefault(outputCol="zipped")
+
+    def _transform(self, df):
+        cols = [df[c] for c in self.getInputCols()]
+        if not cols:
+            raise ValueError("VectorZipper needs at least one inputCol")
+        out = np.empty(len(df), object)
+        out[:] = [[col[i] for col in cols] for i in range(len(df))]
+        return df.with_column(self.getOutputCol(), out)

@@ -54,7 +54,7 @@ def make_test_objects() -> dict[str, TestObject]:
     from mmlspark_tpu.train import (ComputeModelStatistics,
                                     ComputePerInstanceStatistics,
                                     LinearRegression, LogisticRegression)
-    from mmlspark_tpu.vw import (VowpalWabbitClassifier,
+    from mmlspark_tpu.vw import (VectorZipper, VowpalWabbitClassifier,
                                  VowpalWabbitFeaturizer,
                                  VowpalWabbitRegressor)
 
@@ -143,6 +143,8 @@ def make_test_objects() -> dict[str, TestObject]:
                                   groupCol="group"), rank_df),
         TestObject(VowpalWabbitFeaturizer(inputCols=["cat", "num"]),
                    cat_df),
+        TestObject(VectorZipper(inputCols=["cat", "num"],
+                                outputCol="zipped"), cat_df),
         TestObject(VowpalWabbitClassifier(numPasses=2, numBits=8,
                                           numShards=1), num),
         TestObject(VowpalWabbitRegressor(numPasses=2, numBits=8,

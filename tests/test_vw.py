@@ -348,10 +348,11 @@ class TestFeaturizerLongTail:
         # different hash inputs → different indices
         assert set(np.asarray(with_prefix["f_indices"]).ravel()) != \
             set(np.asarray(bare["f_indices"]).ravel())
-        # and the bare mode equals hashing the raw value alone (the
-        # reference's prefixName="" semantics, default namespace seed 0)
+        # and the bare mode equals hashing the raw value alone under
+        # the reference's namespace = murmur(outputCol, seed)
         from mmlspark_tpu.vw.murmur import vw_feature_hash
-        expect = vw_feature_hash("ams", 0, 18)
+        ns = murmur3_32(b"f", 0)
+        expect = vw_feature_hash("ams", ns, 18)
         assert expect in set(np.asarray(bare["f_indices"]).ravel())
 
     def test_label_conversion_off(self):
@@ -371,9 +372,11 @@ class TestFeaturizerLongTail:
                 DataFrame({"features": x,
                            "label": (y_pm > 0).astype(np.float32)}))
 
-    def test_bare_prefix_keeps_numeric_columns_distinct(self):
-        """Dropping the prefix must not collapse numeric columns onto
-        one hash index (string-valued hashes only)."""
+    def test_bare_prefix_merges_numerics_like_reference(self):
+        """Reference semantics: prefixName="" reaches EVERY featurizer
+        (VowpalWabbitFeaturizer.scala:71-86), so flag-off numeric columns
+        share one hash index and sumCollisions merges them — silently
+        different features than flag-on, exactly like the reference."""
         from mmlspark_tpu.vw import VowpalWabbitFeaturizer
         df = DataFrame({"age": np.asarray([3.0, 5.0], np.float32),
                         "income": np.asarray([7.0, 11.0], np.float32)})
@@ -382,6 +385,80 @@ class TestFeaturizerLongTail:
             prefixStringsWithColumnName=False).transform(df)
         idx = np.asarray(out["f_indices"])
         vals = np.asarray(out["f_values"])
-        # two distinct indices per row, original values unmerged
-        assert len(set(idx[0][idx[0] >= 0].tolist())) == 2
-        assert set(np.round(vals[0][vals[0] != 0], 3)) == {3.0, 7.0}
+        assert len(set(idx[0][idx[0] >= 0].tolist())) == 1
+        assert vals[0][vals[0] != 0].tolist() == [10.0]
+
+    def test_string_sequences_never_prefixed(self):
+        """Arrays of strings hash the raw value regardless of the prefix
+        flag (VowpalWabbitFeaturizer.scala:81-82)."""
+        from mmlspark_tpu.vw import VowpalWabbitFeaturizer
+        from mmlspark_tpu.vw.murmur import vw_feature_hash
+        cells = np.empty(1, object)
+        cells[0] = ["tok1", "tok2"]
+        df = DataFrame({"tags": cells})
+        out = VowpalWabbitFeaturizer(inputCols=["tags"],
+                                     outputCol="f").transform(df)
+        ns = murmur3_32(b"f", 0)
+        got = set(np.asarray(out["f_indices"])[0].tolist()) - {-1}
+        assert got == {vw_feature_hash("tok1", ns, 18),
+                       vw_feature_hash("tok2", ns, 18)}
+
+    def test_preserve_order_num_bits(self):
+        """Order bits ride the top of each index (reference transform:
+        index |= pos << (30 - preserveOrderNumBits))."""
+        from mmlspark_tpu.vw import VowpalWabbitFeaturizer
+        df = DataFrame({"text": np.asarray(["aa bb cc"], object)})
+        out = VowpalWabbitFeaturizer(
+            inputCols=["text"], stringSplitInputCols=["text"],
+            outputCol="f", preserveOrderNumBits=4).transform(df)
+        idx = np.asarray(out["f_indices"])[0]
+        pos = idx[idx >= 0] >> (30 - 4)
+        assert pos.tolist() == [0, 1, 2]
+        with pytest.raises(ValueError, match="30"):
+            VowpalWabbitFeaturizer(
+                inputCols=["text"], preserveOrderNumBits=20,
+                numBits=18).transform(df)
+        with pytest.raises(ValueError, match="too many"):
+            VowpalWabbitFeaturizer(
+                inputCols=["text"], stringSplitInputCols=["text"],
+                preserveOrderNumBits=1).transform(df)
+
+
+class TestVectorZipperAndEpsilon:
+    def test_vector_zipper(self):
+        from mmlspark_tpu.vw import VectorZipper
+        df = DataFrame({"a": np.asarray(["x", "y"], object),
+                        "b": np.asarray([1.0, 2.0], np.float32)})
+        out = VectorZipper(inputCols=["a", "b"],
+                           outputCol="z").transform(df)
+        assert out["z"][0] == ["x", 1.0]
+        assert out["z"][1] == ["y", 2.0]
+
+    def test_cb_action_probabilities(self):
+        from mmlspark_tpu.vw import VowpalWabbitContextualBandit
+        rng = np.random.default_rng(0)
+        n_dec, k = 60, 3
+        rows = n_dec * k
+        idx = np.broadcast_to(np.arange(4, dtype=np.int32),
+                              (rows, 4)).copy()
+        val = rng.normal(size=(rows, 4)).astype(np.float32)
+        action = np.tile(np.arange(1, k + 1), n_dec)
+        decision = np.repeat(np.arange(n_dec), k)
+        cost = (val[:, 0] + 0.1 * rng.normal(size=rows)).astype(np.float32)
+        chosen = np.repeat(rng.integers(1, k + 1, n_dec), k)
+        prob = np.full(rows, 1.0 / k)
+        df = DataFrame({"features_indices": idx, "features_values": val,
+                        "action": action, "decision": decision,
+                        "cost": cost, "chosenAction": chosen,
+                        "probability": prob})
+        m = VowpalWabbitContextualBandit(numPasses=5,
+                                         batchSize=32).fit(df)
+        m.set("epsilon", 0.3)
+        out = m.action_probabilities(df, group_col="decision")
+        p = np.asarray(out["policyProbability"])
+        # per decision: probabilities sum to 1, greedy gets 1-eps+eps/k
+        for g in range(n_dec):
+            sel = p[decision == g]
+            assert abs(sel.sum() - 1.0) < 1e-9
+            assert abs(sel.max() - (0.7 + 0.1)) < 1e-9
+            assert abs(sel.min() - 0.1) < 1e-9
