@@ -8,9 +8,11 @@ search over estimators with k-fold CV, thread-pool parallel),
 from .hyperparams import (DiscreteHyperParam, DoubleRangeHyperParam,
                           FloatRangeHyperParam, HyperparamBuilder,
                           IntRangeHyperParam, GridSpace, RandomSpace)
+from .defaults import default_range, defaultRange
 from .tune import TuneHyperparameters, TuneHyperparametersModel, FindBestModel
 
 __all__ = ["DiscreteHyperParam", "DoubleRangeHyperParam",
            "FloatRangeHyperParam", "HyperparamBuilder", "IntRangeHyperParam",
            "GridSpace", "RandomSpace", "TuneHyperparameters",
-           "TuneHyperparametersModel", "FindBestModel"]
+           "TuneHyperparametersModel", "FindBestModel",
+           "default_range", "defaultRange"]

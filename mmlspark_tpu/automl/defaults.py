@@ -1,0 +1,59 @@
+"""Default hyperparameter search ranges per learner.
+
+Reference ``automl/DefaultHyperparams.scala``: a canned, sensible search
+space for each supported learner so ``TuneHyperparameters`` works out of
+the box without hand-building ranges.
+"""
+
+from __future__ import annotations
+
+from .hyperparams import (DoubleRangeHyperParam, HyperparamBuilder,
+                          IntRangeHyperParam)
+
+
+def default_range(estimator):
+    """Built (stage, param, dist) entries for ``estimator``'s type —
+    the reference's per-learner ``defaultRange`` overloads collapsed
+    into one type dispatch."""
+    def _is(cls_name: str) -> bool:
+        # isinstance-style dispatch without importing every learner
+        # package eagerly: match the class or any base by name, so
+        # subclasses keep their parent's default space (the reference's
+        # overload resolution is polymorphic too)
+        return any(c.__name__ == cls_name
+                   for c in type(estimator).__mro__)
+
+    b = HyperparamBuilder()
+    if _is("LogisticRegression"):
+        return (b.addHyperparam(estimator, "regParam",
+                                DoubleRangeHyperParam(0.001, 1.0))
+                 .addHyperparam(estimator, "maxIter",
+                                IntRangeHyperParam(20, 100))
+                 .build())
+    if any(_is(c) for c in ("LightGBMClassifier", "LightGBMRegressor",
+                            "LightGBMRanker")):
+        return (b.addHyperparam(estimator, "numLeaves",
+                                IntRangeHyperParam(4, 64))
+                 .addHyperparam(estimator, "numIterations",
+                                IntRangeHyperParam(20, 100))
+                 .addHyperparam(estimator, "learningRate",
+                                DoubleRangeHyperParam(0.01, 0.3))
+                 .addHyperparam(estimator, "baggingFraction",
+                                DoubleRangeHyperParam(0.6, 1.0))
+                 .build())
+    if any(_is(c) for c in ("VowpalWabbitClassifier",
+                            "VowpalWabbitRegressor")):
+        return (b.addHyperparam(estimator, "learningRate",
+                                DoubleRangeHyperParam(0.05, 1.0))
+                 .addHyperparam(estimator, "numPasses",
+                                IntRangeHyperParam(1, 10))
+                 .addHyperparam(estimator, "l2",
+                                DoubleRangeHyperParam(0.0, 1e-4))
+                 .build())
+    raise ValueError(
+        f"no default hyperparameter range for "
+        f"{type(estimator).__name__}; build one with "
+        "HyperparamBuilder")
+
+
+defaultRange = default_range

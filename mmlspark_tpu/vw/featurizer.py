@@ -19,6 +19,17 @@ from .murmur import namespace_hash, vw_feature_hash, vw_hash, murmur3_32
 _M32 = 0xFFFFFFFF
 
 
+def _row_positions(rows: np.ndarray, n: int):
+    """Per-entry position within its (sorted) row: counts, and
+    arange - exclusive-cumsum-starts gathered by row."""
+    counts = np.bincount(rows, minlength=n) if rows.size else \
+        np.zeros(n, np.int64)
+    starts = np.zeros(n, np.int64)
+    np.cumsum(counts[:-1], out=starts[1:])
+    pos = np.arange(rows.size, dtype=np.int64) - starts[rows]
+    return counts, pos
+
+
 class VowpalWabbitFeaturizer(Transformer, HasInputCols, HasOutputCol):
     numBits = Param("numBits", "log2 of feature space size", TC.toInt,
                     default=18)
@@ -174,7 +185,11 @@ class VowpalWabbitFeaturizer(Transformer, HasInputCols, HasOutputCol):
             m, colname.encode("utf-8"), len(colname.encode("utf-8")),
             ns_hash, num_bits, 1 if split else 0,
             out_offsets.ctypes.data_as(ctypes.POINTER(ctypes.c_int64)),
-            1 if self.get("sumCollisions") else 0,
+            # in-kernel premerge must not run when order bits are
+            # active: positions are assigned AFTER this call, and the
+            # reference merges only identical (index|pos) keys
+            1 if (self.get("sumCollisions")
+                  and not self.get("preserveOrderNumBits")) else 0,
             out_idx.ctypes.data_as(ctypes.POINTER(ctypes.c_int32)),
             out_val.ctypes.data_as(ctypes.POINTER(ctypes.c_float)),
             out_n.ctypes.data_as(ctypes.POINTER(ctypes.c_int32)))
@@ -277,15 +292,12 @@ class VowpalWabbitFeaturizer(Transformer, HasInputCols, HasOutputCol):
             # combined index reproduces input order
             order0 = np.argsort(rows, kind="stable")
             rows, idx, val = rows[order0], idx[order0], val[order0]
-            counts0 = np.bincount(rows, minlength=n)
+            counts0, pos0 = _row_positions(rows, n)
             if counts0.max(initial=0) > (1 << order_bits):
                 raise ValueError(
                     f"a row has {int(counts0.max())} features — too many "
                     f"for preserveOrderNumBits={order_bits} "
                     f"(max {1 << order_bits}, reference validation)")
-            starts0 = np.zeros(n, np.int64)
-            np.cumsum(counts0[:-1], out=starts0[1:])
-            pos0 = np.arange(rows.size, dtype=np.int64) - starts0[rows]
             idx = (idx.astype(np.int64)
                    | (pos0 << (30 - order_bits))).astype(np.int32)
 
@@ -307,13 +319,9 @@ class VowpalWabbitFeaturizer(Transformer, HasInputCols, HasOutputCol):
             order = np.argsort(rows, kind="stable")
             rows, idx, val = rows[order], idx[order], val[order]
 
-        counts = np.bincount(rows, minlength=n) if rows.size else \
-            np.zeros(n, np.int64)
+        counts, pos = _row_positions(rows, n)
         width = self.get("maxFeatures") or max(int(counts.max(initial=0)),
                                                1)
-        starts = np.zeros(n, np.int64)
-        np.cumsum(counts[:-1], out=starts[1:])
-        pos = np.arange(rows.size, dtype=np.int64) - starts[rows]
         keep = pos < width
         indices = np.full((n, width), -1, np.int32)
         values = np.zeros((n, width), np.float32)

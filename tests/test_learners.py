@@ -170,3 +170,31 @@ class TestIsolationForest:
         flagged = np.where(out["predictedLabel"] == 1.0)[0]
         assert len(flagged) > 0
         assert (flagged >= 300).mean() > 0.5
+
+
+def test_default_hyperparam_ranges():
+    """Reference DefaultHyperparams.scala: canned search spaces per
+    learner feed TuneHyperparameters without hand-built ranges."""
+    import numpy as np
+    from mmlspark_tpu.automl import (TuneHyperparameters, default_range)
+    from mmlspark_tpu.core import DataFrame
+    from mmlspark_tpu.lightgbm import LightGBMClassifier
+    from mmlspark_tpu.train import LogisticRegression
+
+    est = LightGBMClassifier(minDataInLeaf=5, seed=0)
+    space = default_range(est)
+    assert {e[1] for e in space} >= {"numLeaves", "numIterations"}
+    assert default_range(LogisticRegression())
+    import pytest
+    with pytest.raises(ValueError, match="no default"):
+        default_range(object())
+
+    rng = np.random.default_rng(0)
+    x = rng.normal(size=(400, 6)).astype(np.float32)
+    y = (x[:, 0] > 0).astype(np.float32)
+    df = DataFrame({"features": x, "label": y})
+    tuned = TuneHyperparameters(models=[est], paramSpace=space,
+                                numFolds=2, numRuns=2,
+                                evaluationMetric="accuracy",
+                                labelCol="label").fit(df)
+    assert tuned.get("bestMetric") > 0.7

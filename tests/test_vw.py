@@ -462,3 +462,29 @@ class TestVectorZipperAndEpsilon:
             assert abs(sel.sum() - 1.0) < 1e-9
             assert abs(sel.max() - (0.7 + 0.1)) < 1e-9
             assert abs(sel.min() - 0.1) < 1e-9
+
+    def test_preserve_order_with_duplicate_tokens(self):
+        """Duplicate tokens stay distinct under order bits (positions
+        differ), native and fallback paths identical — the in-kernel
+        premerge must not run before positions are assigned."""
+        from mmlspark_tpu.vw import VowpalWabbitFeaturizer
+        df = DataFrame({"text": np.asarray(["aa aa bb"], object)})
+        kw = dict(inputCols=["text"], stringSplitInputCols=["text"],
+                  outputCol="f", preserveOrderNumBits=4)
+        out = VowpalWabbitFeaturizer(**kw).transform(df)
+        idx = np.asarray(out["f_indices"])[0]
+        vals = np.asarray(out["f_values"])[0]
+        live = idx >= 0
+        assert live.sum() == 3                       # no premature merge
+        assert (idx[live] >> 26).tolist() == [0, 1, 2]
+        assert vals[live].tolist() == [1.0, 1.0, 1.0]
+        # force the python fallback and compare bitwise
+        import mmlspark_tpu.native.loader as nl
+        orig = nl.get_vwhash
+        nl.get_vwhash = lambda: None
+        try:
+            out2 = VowpalWabbitFeaturizer(**kw).transform(df)
+        finally:
+            nl.get_vwhash = orig
+        np.testing.assert_array_equal(np.asarray(out2["f_indices"]),
+                                      np.asarray(out["f_indices"]))
