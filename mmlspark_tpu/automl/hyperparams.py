@@ -85,7 +85,13 @@ class RandomSpace:
 
     def __init__(self, entries, seed: int = 0):
         self.entries = entries
-        np.random.default_rng(seed)  # seed threaded via dists
+        # re-seed every dist with a DISTINCT stream derived from this
+        # space's seed: dists default to their own seed=0, so without
+        # this, identically-constructed ranges draw in lockstep and
+        # random search collapses onto the diagonal of the cube
+        for i, (_, _, d) in enumerate(self.entries):
+            if hasattr(d, "_rng"):
+                d._rng = np.random.default_rng((seed, i))
 
     def param_maps(self, n: int):
         for _ in range(n):

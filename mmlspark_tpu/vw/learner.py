@@ -66,9 +66,25 @@ class VWModelState:
 
     def predict_raw(self, indices: np.ndarray, values: np.ndarray):
         w = jnp.asarray(self.weights)
+        indices = _strip_to_table(indices, self.config.num_bits)
         return np.asarray(_predict_raw(w, jnp.asarray(self.bias),
                                        jnp.asarray(indices),
                                        jnp.asarray(values)))
+
+
+def _strip_to_table(indices: np.ndarray, num_bits: int) -> np.ndarray:
+    """Mask feature indices into the 2^num_bits weight table, keeping -1
+    padding. VW strips anything above its bit budget — including the
+    featurizer's preserveOrderNumBits position prefix, which exists for
+    downstream consumers, not the learner ('will be stripped when
+    passing to VW', reference VowpalWabbitFeaturizer.scala transform).
+    Without the strip, out-of-table indices silently drop from XLA
+    scatter/gather and those features never train."""
+    indices = np.asarray(indices)
+    if indices.size and indices.max(initial=0) < (1 << num_bits):
+        return indices
+    mask = (1 << num_bits) - 1
+    return np.where(indices >= 0, indices & mask, -1).astype(np.int32)
 
 
 @jax.jit
@@ -90,6 +106,7 @@ def train(indices: np.ndarray, values: np.ndarray, labels: np.ndarray,
     n_pad = n_batches * bs - n
 
     # pad rows with weight 0 (never influence updates)
+    indices = _strip_to_table(indices, cfg.num_bits)
     idx = np.pad(indices, ((0, n_pad), (0, 0)), constant_values=-1)
     val = np.pad(values, ((0, n_pad), (0, 0)))
     y = np.pad(np.asarray(labels, np.float32), (0, n_pad))

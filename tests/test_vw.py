@@ -488,3 +488,29 @@ class TestVectorZipperAndEpsilon:
             nl.get_vwhash = orig
         np.testing.assert_array_equal(np.asarray(out2["f_indices"]),
                                       np.asarray(out["f_indices"]))
+
+    def test_order_bits_strip_before_learner(self):
+        """The learner strips the position prefix into its weight table
+        (reference: 'will be stripped when passing to VW') — training on
+        order-bit features must match training without them."""
+        from mmlspark_tpu.vw import (VowpalWabbitClassifier,
+                                     VowpalWabbitFeaturizer)
+        rng = np.random.default_rng(4)
+        text = np.asarray([" ".join(rng.choice(["aa", "bb", "cc"], 5))
+                           for _ in range(500)], object)
+        y = np.asarray([t.split().count("aa") >= 2 for t in text],
+                       np.float32)
+        df = DataFrame({"text": text, "label": y})
+        aucs = {}
+        for bits in (0, 3):
+            fdf = VowpalWabbitFeaturizer(
+                inputCols=["text"], stringSplitInputCols=["text"],
+                preserveOrderNumBits=bits,
+                outputCol="features").transform(df)
+            m = VowpalWabbitClassifier(numPasses=6, batchSize=64,
+                                       numShards=1).fit(fdf)
+            aucs[bits] = roc_auc(y, m.transform(fdf)["probability"][:, 1])
+        assert aucs[3] > 0.95
+        # stripping makes the representations equivalent up to collision
+        # merging; quality must not degrade materially
+        assert abs(aucs[0] - aucs[3]) < 0.05, aucs
