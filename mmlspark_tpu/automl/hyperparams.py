@@ -84,14 +84,21 @@ class RandomSpace:
     """Random draws (reference ``RandomSpace.paramMaps`` iterator)."""
 
     def __init__(self, entries, seed: int = 0):
-        self.entries = entries
-        # re-seed every dist with a DISTINCT stream derived from this
-        # space's seed: dists default to their own seed=0, so without
-        # this, identically-constructed ranges draw in lockstep and
-        # random search collapses onto the diagonal of the cube
-        for i, (_, _, d) in enumerate(self.entries):
+        import copy
+        # every dist gets a COPY with a distinct stream derived from
+        # this space's seed: dists default to their own seed=0, so
+        # without the reseed, identically-constructed ranges draw in
+        # lockstep and random search collapses onto the diagonal of the
+        # cube. Copying keeps the caller's dists (and sibling spaces
+        # over the same entries) untouched — seeded reproducibility
+        # must not depend on construction order.
+        reseeded = []
+        for i, (stage, name, d) in enumerate(entries):
             if hasattr(d, "_rng"):
+                d = copy.copy(d)
                 d._rng = np.random.default_rng((seed, i))
+            reseeded.append((stage, name, d))
+        self.entries = reseeded
 
     def param_maps(self, n: int):
         for _ in range(n):

@@ -3,7 +3,7 @@ from .value_indexer import ValueIndexer, ValueIndexerModel, IndexToValue
 from .clean_missing_data import CleanMissingData, CleanMissingDataModel
 from .data_conversion import DataConversion
 from .count_selector import CountSelector, CountSelectorModel
-from .text import (Tokenizer, TokenIdEncoder, NGram, MultiNGram, HashingTF, IDF, IDFModel,
+from .text import (StopWordsRemover, Tokenizer, TokenIdEncoder, NGram, MultiNGram, HashingTF, IDF, IDFModel,
                    TextFeaturizer, TextFeaturizerModel, PageSplitter)
 
 __all__ = [
@@ -11,6 +11,6 @@ __all__ = [
     "ValueIndexer", "ValueIndexerModel", "IndexToValue",
     "CleanMissingData", "CleanMissingDataModel",
     "DataConversion", "CountSelector", "CountSelectorModel",
-    "Tokenizer", "TokenIdEncoder", "NGram", "MultiNGram", "HashingTF", "IDF", "IDFModel",
+    "StopWordsRemover", "Tokenizer", "TokenIdEncoder", "NGram", "MultiNGram", "HashingTF", "IDF", "IDFModel",
     "TextFeaturizer", "TextFeaturizerModel", "PageSplitter",
 ]

@@ -18,12 +18,17 @@ from ..core import Estimator, Model, Transformer, Param, TypeConverters as TC
 from ..core.contracts import HasInputCol, HasOutputCol
 
 
-def _tokenize(text: str, lower: bool, pattern: str) -> list[str]:
+def _tokenize(text: str, lower: bool, pattern: str, *,
+              gaps: bool = True, min_len: int = 1) -> list[str]:
+    """THE tokenization path (Tokenizer and TokenIdEncoder both route
+    here): None-safe, optional lowercase, gaps-split or token-find
+    regex, minimum token length."""
     if text is None:
         return []
     if lower:
         text = text.lower()
-    return [t for t in re.split(pattern, text) if t]
+    parts = re.split(pattern, text) if gaps else re.findall(pattern, text)
+    return [t for t in parts if len(t) >= max(min_len, 1)]
 
 
 def _ngrams(tokens: list[str], n: int) -> list[str]:
@@ -46,12 +51,20 @@ class Tokenizer(Transformer, HasInputCol, HasOutputCol):
                         TC.toBoolean, default=True)
     pattern = Param("pattern", "regex split pattern", TC.toString,
                     default=r"\W+")
+    gaps = Param("gaps", "pattern matches gaps between tokens (True, "
+                 "Spark RegexTokenizer default) or the tokens "
+                 "themselves (False)", TC.toBoolean, default=True)
+    minTokenLength = Param("minTokenLength",
+                           "drop tokens shorter than this", TC.toInt,
+                           default=1)
 
     def _transform(self, df):
         lower, pat = self.getToLowercase(), self.getPattern()
+        gaps, min_len = self.get("gaps"), self.get("minTokenLength")
         col = df[self.getInputCol()]
         out = np.empty(len(col), dtype=object)
-        out[:] = [_tokenize(v, lower, pat) for v in col.tolist()]
+        out[:] = [_tokenize(v, lower, pat, gaps=gaps, min_len=min_len)
+                  for v in col.tolist()]
         return df.with_column(self.getOutputCol(), out)
 
 
@@ -63,6 +76,55 @@ class NGram(Transformer, HasInputCol, HasOutputCol):
         col = df[self.getInputCol()]
         out = np.empty(len(col), dtype=object)
         out[:] = [_ngrams(list(v), n) for v in col.tolist()]
+        return df.with_column(self.getOutputCol(), out)
+
+
+# a compact English stop list (Spark's StopWordsRemover ships a longer
+# one; this covers the high-frequency core the reference relies on)
+_ENGLISH_STOP_WORDS = frozenset("""
+a about above after again against all am an and any are as at be because
+been before being below between both but by could did do does doing down
+during each few for from further had has have having he her here hers
+herself him himself his how i if in into is it its itself just me more
+most my myself no nor not now of off on once only or other our ours
+ourselves out over own same she should so some such than that the their
+theirs them themselves then there these they this those through to too
+under until up very was we were what when where which while who whom why
+will with you your yours yourself yourselves
+""".split())
+
+
+class StopWordsRemover(Transformer, HasInputCol, HasOutputCol):
+    """Drop stop words from a token-list column (the Spark
+    ``StopWordsRemover`` the reference's TextFeaturizer composes)."""
+
+    stopWords = Param("stopWords", "custom stop word list (empty = the "
+                      "language default)", TC.toListString, default=[])
+    caseSensitive = Param("caseSensitive", "match case-sensitively",
+                          TC.toBoolean, default=False)
+    language = Param("language", "built-in stop list to use",
+                     TC.toString, default="english")
+
+    def _stop_set(self):
+        words = self.get("stopWords")
+        if not words:
+            lang = self.get("language")
+            if lang != "english":
+                raise ValueError(
+                    f"no built-in stop list for {lang!r}; pass stopWords")
+            words = _ENGLISH_STOP_WORDS
+        if self.get("caseSensitive"):
+            return frozenset(words)
+        return frozenset(w.lower() for w in words)
+
+    def _transform(self, df):
+        stop = self._stop_set()
+        cs = self.get("caseSensitive")
+        col = df[self.getInputCol()]
+        out = np.empty(len(col), dtype=object)
+        out[:] = [[t for t in toks
+                   if (t if cs else t.lower()) not in stop]
+                  for toks in col.tolist()]
         return df.with_column(self.getOutputCol(), out)
 
 
@@ -141,6 +203,24 @@ class TextFeaturizer(Estimator, HasInputCol, HasOutputCol):
                    default=True)
     minDocFreq = Param("minDocFreq", "IDF min doc frequency", TC.toInt,
                        default=0)
+    minTokenLength = Param("minTokenLength",
+                           "drop tokens shorter than this", TC.toInt,
+                           default=1)
+    tokenizerPattern = Param("tokenizerPattern", "tokenizer regex",
+                             TC.toString, default=r"\W+")
+    tokenizerGaps = Param("tokenizerGaps", "pattern matches gaps (True) "
+                          "or tokens (False)", TC.toBoolean, default=True)
+    useStopWordsRemover = Param("useStopWordsRemover",
+                                "drop stop words after tokenizing",
+                                TC.toBoolean, default=False)
+    stopWords = Param("stopWords", "custom stop word list",
+                      TC.toListString, default=[])
+    caseSensitiveStopWords = Param("caseSensitiveStopWords",
+                                   "stop-word matching is case-sensitive",
+                                   TC.toBoolean, default=False)
+    defaultStopWordLanguage = Param("defaultStopWordLanguage",
+                                    "built-in stop list", TC.toString,
+                                    default="english")
 
     def _fit(self, df):
         from ..core import PipelineModel
@@ -150,10 +230,26 @@ class TextFeaturizer(Estimator, HasInputCol, HasOutputCol):
         cur = df
         if self.getUseTokenizer():
             tok = Tokenizer(inputCol=cur_col, outputCol=f"{out_col}_tokens",
-                            toLowercase=self.getToLowercase())
+                            toLowercase=self.getToLowercase(),
+                            pattern=self.get("tokenizerPattern"),
+                            gaps=self.get("tokenizerGaps"),
+                            minTokenLength=self.get("minTokenLength"))
             stages.append(tok)
             cur = tok.transform(cur)
             cur_col = f"{out_col}_tokens"
+        if self.get("useStopWordsRemover"):
+            if not self.getUseTokenizer():
+                raise ValueError(
+                    "useStopWordsRemover needs useTokenizer=True "
+                    "(stop words apply to token lists)")
+            sw = StopWordsRemover(
+                inputCol=cur_col, outputCol=f"{out_col}_nostop",
+                stopWords=self.get("stopWords"),
+                caseSensitive=self.get("caseSensitiveStopWords"),
+                language=self.get("defaultStopWordLanguage"))
+            stages.append(sw)
+            cur = sw.transform(cur)
+            cur_col = f"{out_col}_nostop"
         if self.getUseNGram():
             ng = NGram(inputCol=cur_col, outputCol=f"{out_col}_ngrams",
                        n=self.getNGramLength())
@@ -171,8 +267,9 @@ class TextFeaturizer(Estimator, HasInputCol, HasOutputCol):
                             minDocFreq=self.getMinDocFreq()).fit(cur)
             stages.append(idf_model)
         helper_cols = [c for c in
-                       (f"{out_col}_tokens", f"{out_col}_ngrams",
-                        f"{out_col}_tf") if c != out_col]
+                       (f"{out_col}_tokens", f"{out_col}_nostop",
+                        f"{out_col}_ngrams", f"{out_col}_tf")
+                       if c != out_col]
         from ..stages.basic import DropColumns
         stages.append(DropColumns(cols=helper_cols))
         return TextFeaturizerModel().setStages(stages)

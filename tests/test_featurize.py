@@ -172,3 +172,49 @@ def test_column_metadata_carry_and_invalidation():
     replaced = added.with_column("f", np.zeros(2, np.float32))
     assert ColumnMetadata.get(replaced, "f") is None
     assert ColumnMetadata.get(added, "f") == {"slot_names": ["a"]}
+
+
+class TestStopWordsAndTokenizerControls:
+    """Reference TextFeaturizer surface: stop-word removal, token length
+    filter, gaps/token regex modes."""
+
+    def test_stop_words_remover(self):
+        from mmlspark_tpu.featurize import StopWordsRemover
+        toks = np.empty(2, object)
+        toks[:] = [["the", "Quick", "fox"], ["a", "dog"]]
+        df = DataFrame({"t": toks})
+        out = StopWordsRemover(inputCol="t", outputCol="o").transform(df)
+        assert out["o"][0] == ["Quick", "fox"]
+        assert out["o"][1] == ["dog"]
+        out_cs = StopWordsRemover(inputCol="t", outputCol="o",
+                                  stopWords=["quick"],
+                                  caseSensitive=True).transform(df)
+        assert out_cs["o"][0] == ["the", "Quick", "fox"]
+        import pytest
+        with pytest.raises(ValueError, match="stop list"):
+            StopWordsRemover(inputCol="t", outputCol="o",
+                             language="klingon").transform(df)
+
+    def test_tokenizer_gaps_and_min_length(self):
+        from mmlspark_tpu.featurize import Tokenizer
+        df = DataFrame({"t": np.asarray(["ab, c def!"], object)})
+        out = Tokenizer(inputCol="t", outputCol="o",
+                        minTokenLength=2).transform(df)
+        assert out["o"][0] == ["ab", "def"]
+        out2 = Tokenizer(inputCol="t", outputCol="o", gaps=False,
+                         pattern=r"[a-z]+").transform(df)
+        assert out2["o"][0] == ["ab", "c", "def"]
+
+    def test_text_featurizer_with_stop_words(self):
+        from mmlspark_tpu.featurize import TextFeaturizer
+        docs = np.asarray(["the good movie", "a bad movie",
+                           "the movie was good"], object)
+        df = DataFrame({"text": docs})
+        m = TextFeaturizer(inputCol="text", outputCol="f",
+                           useStopWordsRemover=True, numFeatures=64,
+                           useIDF=False).fit(df)
+        out = m.transform(df)
+        # stop words contribute nothing: "the"/"a"/"was" filtered
+        assert out["f"].shape == (3, 64)
+        assert out["f"][0].sum() == 2.0     # good + movie only
+        assert out["f"][1].sum() == 2.0     # bad + movie

@@ -31,7 +31,9 @@ def make_test_objects() -> dict[str, TestObject]:
     from mmlspark_tpu.featurize import (CleanMissingData, CountSelector,
                                         Featurize, ValueIndexer)
     from mmlspark_tpu.featurize.text import (HashingTF, IDF, MultiNGram,
-                                             PageSplitter, TextFeaturizer,
+                                             PageSplitter,
+                                             StopWordsRemover,
+                                             TextFeaturizer,
                                              TokenIdEncoder, Tokenizer,
                                              NGram)
     from mmlspark_tpu.stages.misc import EnsembleByKey
@@ -125,6 +127,9 @@ def make_test_objects() -> dict[str, TestObject]:
         TestObject(TokenIdEncoder(inputCol="text", outputCol="ids",
                                   maxLength=8, vocabSize=256), text_df),
         TestObject(NGram(inputCol="tok", outputCol="ngrams", n=2),
+                   Tokenizer(inputCol="text",
+                             outputCol="tok").transform(text_df)),
+        TestObject(StopWordsRemover(inputCol="tok", outputCol="nostop"),
                    Tokenizer(inputCol="text",
                              outputCol="tok").transform(text_df)),
         TestObject(HashingTF(inputCol="tok", outputCol="tf", numFeatures=64),
