@@ -134,21 +134,25 @@ class Booster:
             max_depth=self.max_depth_bound)
 
     def predict_leaf(self, x: np.ndarray,
-                     num_iteration: int | None = None) -> np.ndarray:
+                     num_iteration: int | None = None,
+                     start_iteration: int = 0) -> np.ndarray:
         """Leaf *index* per (row, tree) — reference ``predictLeaf``.
 
         Indices are leaf ordinals (leaves numbered in node-creation order
-        within each tree), matching LightGBM's predict_leaf_index semantics.
-        """
+        within each tree), matching LightGBM's predict_leaf_index
+        semantics; with ``start_iteration`` the leading iterations'
+        columns are dropped (output [n, T - start*K])."""
         t_end = self._effective_trees(num_iteration)
-        leaves = self._leaf_nodes(x, t_end)          # node ids [n, T]
-        # map node id -> leaf ordinal
+        t_start = max(int(start_iteration), 0) * self.num_class
+        leaves = np.asarray(self._leaf_nodes(x, t_end))  # node ids [n, T]
+        # map node id -> leaf ordinal, only for the kept columns
         is_leaf = self.arrays["is_leaf"][:t_end]
-        out = np.zeros_like(np.asarray(leaves))
-        for t in range(t_end):
+        out = np.zeros((leaves.shape[0], max(t_end - t_start, 0)),
+                       leaves.dtype)
+        for t in range(t_start, t_end):
             node_ids = np.flatnonzero(is_leaf[t])
             ordinal = {int(nid): i for i, nid in enumerate(node_ids)}
-            out[:, t] = [ordinal[int(v)] for v in np.asarray(leaves)[:, t]]
+            out[:, t - t_start] = [ordinal[int(v)] for v in leaves[:, t]]
         return out
 
     def transform_scores(self, raw: np.ndarray) -> np.ndarray:

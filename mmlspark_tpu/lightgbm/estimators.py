@@ -258,17 +258,10 @@ class _BoosterModelMixin:
 
     def _maybe_extra_outputs(self, df, x):
         out = df
-        if self.get("startIteration") and (
-                self.isSet("leafPredictionCol")
-                or self.isSet("featuresShapCol")):
-            # leaf/SHAP outputs ignore the start offset — silently mixing
-            # full-model SHAP with tail-model scores in one row would be
-            # worse than refusing
-            raise ValueError(
-                "startIteration applies to score outputs only; unset "
-                "leafPredictionCol/featuresShapCol (or startIteration)")
+        start = self.get("startIteration")
         if self.isSet("leafPredictionCol"):
-            leaves = self.booster.predict_leaf(x, self._num_iter())
+            leaves = self.booster.predict_leaf(x, self._num_iter(),
+                                               start_iteration=start)
             out = out.with_column(self.getLeafPredictionCol(),
                                   leaves.astype(np.float64))
         if self.isSet("featuresShapCol"):
@@ -280,7 +273,9 @@ class _BoosterModelMixin:
                     "supported (a dense [n, F] SHAP matrix at 2^18 "
                     "features would defeat the sparse path) — densify a "
                     "feature subset first")
-            shap = booster_shap_values(self.booster, x, x.shape[1])
+            shap = booster_shap_values(self.booster, x, x.shape[1],
+                                       start_iteration=start,
+                                       num_iteration=self._num_iter())
             out = out.with_column(self.getFeaturesShapCol(), shap)
         return out
 

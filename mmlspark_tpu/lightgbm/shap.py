@@ -162,22 +162,31 @@ def tree_shap_values(arrays: dict, t: int, x: np.ndarray,
 
 
 def booster_shap_values(booster, x: np.ndarray,
-                        num_features: int) -> np.ndarray:
+                        num_features: int,
+                        start_iteration: int = 0,
+                        num_iteration: int | None = None) -> np.ndarray:
     """Per-class SHAP values: [n, K*(F+1)] with each class's block ending
     in its bias slot — the reference's contract for multiclass
     ``featuresShap`` (K=1 collapses to [n, F+1]). Trees are interleaved by
-    class (tree t explains class t % K)."""
+    class (tree t explains class t % K). ``start_iteration`` skips the
+    leading iterations' trees, matching ``raw_scores`` so the SHAP sum
+    tracks the same margin."""
     x = np.asarray(x, dtype=np.float64)
     K = max(booster.num_class, 1)
     blk = num_features + 1
     out = np.zeros((x.shape[0], K * blk), dtype=np.float64)
-    t_end = booster._effective_trees(None)
+    t_end = booster._effective_trees(num_iteration)
+    t_start = max(int(start_iteration), 0) * K
     depth_cap = booster.max_depth_bound + 2
-    for t in range(t_end):
+    for t in range(t_start, t_end):
         k = t % K
         out[:, k * blk:(k + 1) * blk] += tree_shap_values(
             booster.arrays, t, x, num_features, depth_cap=depth_cap) \
             * float(booster.tree_weights[t])
+    if booster.average_output:
+        # rf: raw_scores divides the tree sum by the iteration count —
+        # the SHAP sum must track the same margin
+        out /= max((t_end - t_start) // K, 1)
     init = np.asarray(booster.init_score).reshape(-1)
     for k in range(K):
         if init.size:

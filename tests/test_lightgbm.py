@@ -570,15 +570,24 @@ class TestLongTailParams:
             LightGBMRegressor(numIterations=2, baggingFreq=1,
                               negBaggingFraction=0.5).fit(df)
 
-    def test_start_iteration_refuses_leaf_and_shap(self):
+    def test_start_iteration_leaf_and_shap_consistent(self):
+        """Leaf and SHAP outputs honour startIteration: leaf columns for
+        skipped iterations drop, and the SHAP sum equals the SAME
+        tail-model margin the score columns carry."""
         df = classification_df(300)
-        m = LightGBMClassifier(numIterations=5, numLeaves=7,
+        m = LightGBMClassifier(numIterations=6, numLeaves=7,
                                minDataInLeaf=5, numShards=1,
                                seed=0).fit(df)
         m.set("startIteration", 2)
         m.set("leafPredictionCol", "leaves")
-        with pytest.raises(ValueError, match="startIteration"):
-            m.transform(df)
+        m.set("featuresShapCol", "shap")
+        out = m.transform(df)
+        assert np.asarray(out["leaves"]).shape[1] == 4
+        x = np.asarray(df["features"])
+        raw_tail = np.asarray(m.booster.raw_scores(x, start_iteration=2))
+        np.testing.assert_allclose(
+            np.asarray(out["shap"]).sum(axis=-1), raw_tail,
+            rtol=1e-3, atol=1e-3)
 
     def test_max_bin_by_feature_rejects_categorical_and_one(self):
         rng = np.random.default_rng(2)
@@ -599,3 +608,27 @@ class TestLongTailParams:
                                minDataInLeaf=5, numShards=1, seed=0,
                                xgboostDartMode=True).fit(df)
         assert m.booster.num_trees == 3
+
+    def test_shap_honours_prediction_window_and_rf_average(self):
+        """SHAP must track the same margin as scores for BOTH window
+        params and for rf's averaged output."""
+        from mmlspark_tpu.lightgbm.shap import booster_shap_values
+        df = classification_df(400)
+        x = np.asarray(df["features"])
+        m = LightGBMClassifier(numIterations=6, numLeaves=7,
+                               minDataInLeaf=5, numShards=1,
+                               seed=0).fit(df)
+        shap = booster_shap_values(m.booster, x[:40], x.shape[1],
+                                   start_iteration=1, num_iteration=4)
+        raw = np.asarray(m.booster.raw_scores(
+            x[:40], num_iteration=4, start_iteration=1))
+        np.testing.assert_allclose(shap.sum(-1), raw, rtol=1e-3,
+                                   atol=1e-3)
+        rf = LightGBMClassifier(boostingType="rf", baggingFraction=0.8,
+                                baggingFreq=1, numIterations=6,
+                                numLeaves=7, minDataInLeaf=5,
+                                numShards=1, seed=0).fit(df)
+        shap_rf = booster_shap_values(rf.booster, x[:40], x.shape[1])
+        raw_rf = np.asarray(rf.booster.raw_scores(x[:40]))
+        np.testing.assert_allclose(shap_rf.sum(-1), raw_rf, rtol=1e-3,
+                                   atol=1e-3)
