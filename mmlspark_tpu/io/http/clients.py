@@ -53,12 +53,13 @@ def send_request(req: HTTPRequestData, timeout: float = 60.0,
 class SingleThreadedClient:
     """Sequential sender (reference ``SingleThreadedClient``)."""
 
-    def __init__(self, timeout: float = 60.0):
+    def __init__(self, timeout: float = 60.0, sender=send_request):
         self.timeout = timeout
+        self.sender = sender
 
     def send(self, requests: list[HTTPRequestData]) -> \
             list[HTTPResponseData]:
-        return [send_request(r, self.timeout) for r in requests]
+        return [self.sender(r, self.timeout) for r in requests]
 
 
 class AsyncClient:
@@ -68,16 +69,18 @@ class AsyncClient:
     ``concurrent_timeout``."""
 
     def __init__(self, concurrency: int = 8, timeout: float = 60.0,
-                 concurrent_timeout: float | None = None):
+                 concurrent_timeout: float | None = None,
+                 sender=send_request):
         self.concurrency = concurrency
         self.timeout = timeout
         self.concurrent_timeout = concurrent_timeout
+        self.sender = sender
 
     def send(self, requests: list[HTTPRequestData]) -> \
             list[HTTPResponseData]:
         watch = StopWatch()
         with watch, ThreadPoolExecutor(self.concurrency) as pool:
-            futures = [pool.submit(send_request, r, self.timeout)
+            futures = [pool.submit(self.sender, r, self.timeout)
                        for r in requests]
             out = []
             for f in futures:

@@ -15,7 +15,8 @@ import numpy as np
 
 from ...core import Transformer, Param, TypeConverters as TC, UDFParam
 from ...core.contracts import HasInputCol, HasOutputCol
-from .clients import AsyncClient, SingleThreadedClient
+from .clients import AsyncClient, SingleThreadedClient, \
+    send_request
 from .schema import HTTPRequestData, HTTPResponseData
 from .shared import SharedVariable
 
@@ -30,6 +31,11 @@ class HTTPTransformer(Transformer, HasInputCol, HasOutputCol):
     concurrentTimeout = Param("concurrentTimeout",
                               "await timeout for async mode (s)",
                               TC.toFloat, default=None, has_default=True)
+    handler = UDFParam("handler",
+                       "custom request strategy fn(request, timeout) -> "
+                       "HTTPResponseData (reference UDFParam 'handler'; "
+                       "default = the retry/backoff sender)",
+                       default=None, has_default=True)
 
     def __init__(self, **kwargs):
         super().__init__(**kwargs)
@@ -40,20 +46,27 @@ class HTTPTransformer(Transformer, HasInputCol, HasOutputCol):
         # one client per transformer instance, shared across calls
         # (reference SharedVariable per JVM, HTTPTransformer.scala:97-106);
         # lazy so instances reconstructed by load_stage (which bypasses
-        # __init__) still get one
-        holder = self.__dict__.get("_client_holder_v")
-        if holder is None:
-            holder = SharedVariable(self._make_client)
-            self.__dict__["_client_holder_v"] = holder
-        return holder
+        # __init__) still get one. Keyed by the client-shaping params so
+        # a later set("handler", ...) (or concurrency change) rebuilds
+        # instead of silently serving the stale strategy.
+        key = (self.get("concurrency"), self.get("timeout"),
+               self.get("concurrentTimeout"), id(self.get("handler")))
+        cached = self.__dict__.get("_client_holder_v")
+        if cached is None or cached[0] != key:
+            cached = (key, SharedVariable(self._make_client))
+            self.__dict__["_client_holder_v"] = cached
+        return cached[1]
 
     def _make_client(self):
         c = self.get("concurrency")
+        sender = self.get("handler") or send_request
         if c and c > 1:
             return AsyncClient(concurrency=c, timeout=self.get("timeout"),
                                concurrent_timeout=self.get(
-                                   "concurrentTimeout"))
-        return SingleThreadedClient(timeout=self.get("timeout"))
+                                   "concurrentTimeout"),
+                               sender=sender)
+        return SingleThreadedClient(timeout=self.get("timeout"),
+                                    sender=sender)
 
     def _transform(self, df):
         reqs = [r if isinstance(r, HTTPRequestData)

@@ -398,3 +398,55 @@ def test_microbatch_linger_grows_batches():
         assert max(seen_batches) >= 3  # linger coalesced concurrent load
     finally:
         query.stop()
+
+
+def test_http_transformer_custom_handler():
+    """The reference's UDFParam 'handler': a custom request strategy
+    replaces the built-in retry sender (both client modes)."""
+    import numpy as np
+    from mmlspark_tpu.core import DataFrame
+    from mmlspark_tpu.io.http.schema import (HTTPRequestData,
+                                             HTTPResponseData)
+    from mmlspark_tpu.io.http.transformer import HTTPTransformer
+
+    calls = []
+
+    def stub(req, timeout):
+        calls.append(req.url)
+        return HTTPResponseData(status_code=299,
+                                entity=req.url.encode())
+
+    reqs = np.empty(3, object)
+    reqs[:] = [HTTPRequestData(url=f"http://x/{i}", method="GET")
+               for i in range(3)]
+    df = DataFrame({"request": reqs})
+    for conc in (1, 3):
+        calls.clear()
+        t = HTTPTransformer(inputCol="request", outputCol="response",
+                            concurrency=conc, handler=stub)
+        out = t.transform(df)
+        assert len(calls) == 3
+        assert all(r.status_code == 299 for r in out["response"])
+
+
+def test_http_transformer_handler_set_after_first_transform():
+    import numpy as np
+    from mmlspark_tpu.core import DataFrame
+    from mmlspark_tpu.io.http.schema import (HTTPRequestData,
+                                             HTTPResponseData)
+    from mmlspark_tpu.io.http.transformer import HTTPTransformer
+
+    def stub(req, timeout):
+        return HTTPResponseData(status_code=299, entity=b"late")
+
+    reqs = np.empty(1, object)
+    reqs[:] = [HTTPRequestData(url="http://127.0.0.1:9/none",
+                               method="GET")]
+    df = DataFrame({"request": reqs})
+    t = HTTPTransformer(inputCol="request", outputCol="response",
+                        timeout=0.2)
+    first = t.transform(df)["response"][0]
+    assert first.status_code != 299    # real (failing) sender ran
+    t.set("handler", stub)
+    second = t.transform(df)["response"][0]
+    assert second.status_code == 299   # late-set strategy took effect
