@@ -8,8 +8,8 @@ and the per-row weighted least-squares solves are a single vmapped
 ``jnp.linalg.lstsq``.
 """
 
-from .lime import TabularLIME, ImageLIME, TextLIME
+from .lime import TabularLIME, TabularLIMEModel, ImageLIME, TextLIME
 from .superpixel import Superpixel, SuperpixelTransformer
 
-__all__ = ["TabularLIME", "ImageLIME", "TextLIME", "Superpixel",
+__all__ = ["TabularLIME", "TabularLIMEModel", "ImageLIME", "TextLIME", "Superpixel",
            "SuperpixelTransformer"]

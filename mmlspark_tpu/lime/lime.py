@@ -15,7 +15,8 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
-from ..core import ComplexParam, DataFrame, Transformer, Param, \
+from ..core import ComplexParam, DataFrame, Estimator, Model, \
+    Transformer, Param, \
     TypeConverters as TC
 from ..core.contracts import HasInputCol, HasOutputCol
 from ..core.utils import as_2d_features
@@ -23,21 +24,25 @@ from .superpixel import Superpixel
 
 
 @jax.jit
-def _weighted_lstsq(X, y, w):
+def _weighted_lstsq(X, y, w, reg):
     """One ridge-stabilized weighted least squares: X [S, F+1], y [S],
-    w [S] → coef [F+1]."""
+    w [S] → coef [F+1]. ``reg`` is the user regularization (reference
+    LIME's ``regularization``, a ridge here) on top of a 1e-6
+    stabilizer."""
     sw = jnp.sqrt(w)[:, None]
     A = X * sw
     b = y * sw[:, 0]
-    AtA = A.T @ A + 1e-6 * jnp.eye(X.shape[1])
+    AtA = A.T @ A + (reg + 1e-6) * jnp.eye(X.shape[1])
     return jnp.linalg.solve(AtA, A.T @ b)
 
 
-_batched_lstsq = jax.jit(jax.vmap(_weighted_lstsq))
+_batched_lstsq = jax.jit(jax.vmap(_weighted_lstsq,
+                                  in_axes=(0, 0, 0, None)))
 
 
 def _surrogate_fit(masks: np.ndarray, preds: np.ndarray,
-                   kernel_width: float) -> np.ndarray:
+                   kernel_width: float,
+                   regularization: float = 0.0) -> np.ndarray:
     """masks [R, S, F] binary, preds [R, S] → coefs [R, F]."""
     R, S, F = masks.shape
     ones = np.ones((R, S, 1), np.float32)
@@ -46,19 +51,38 @@ def _surrogate_fit(masks: np.ndarray, preds: np.ndarray,
     # LIME proximity kernel: exp(-d²/width²), d = fraction masked off
     d = 1.0 - masks.mean(axis=2)
     w = jnp.asarray(np.exp(-(d ** 2) / kernel_width ** 2))
-    coefs = _batched_lstsq(X, y, w)
+    coefs = _batched_lstsq(X, y, w, jnp.float32(regularization))
     return np.asarray(coefs)[:, :F]
 
 
-class _LIMEBase(Transformer, HasInputCol, HasOutputCol):
+def _surrogate_fit_linear(Z: np.ndarray, preds: np.ndarray,
+                          regularization: float) -> np.ndarray:
+    """Unweighted local linear fit for gaussian perturbations:
+    Z [R, S, F] standardized offsets, preds [R, S] → coefs [R, F] (in
+    standardized units — the reference's lasso without sample weights)."""
+    R, S, F = Z.shape
+    ones = np.ones((R, S, 1), np.float32)
+    X = jnp.asarray(np.concatenate([Z, ones], axis=2))
+    y = jnp.asarray(preds)
+    w = jnp.ones((R, S), jnp.float32)
+    coefs = _batched_lstsq(X, y, w, jnp.float32(regularization))
+    return np.asarray(coefs)[:, :F]
+
+
+class _LIMEParams(HasInputCol, HasOutputCol):
+    """Params + scoring shared by every LIME stage (estimator, model and
+    the mask-based transformers) — ONE declaration each."""
+
     model = ComplexParam("model", "transformer to explain")
     predictionCol = Param("predictionCol",
                           "column of the model's output to explain",
                           TC.toString, default="prediction")
     nSamples = Param("nSamples", "perturbations per row", TC.toInt,
                      default=100)
-    kernelWidth = Param("kernelWidth", "proximity kernel width", TC.toFloat,
-                        default=0.75)
+    regularization = Param("regularization",
+                           "regularization of the local surrogate fit "
+                           "(reference LIME's lasso strength; a ridge "
+                           "penalty here)", TC.toFloat, default=0.0)
     seed = Param("seed", "sampling seed", TC.toInt, default=0)
 
     def _predict(self, df) -> np.ndarray:
@@ -67,31 +91,75 @@ class _LIMEBase(Transformer, HasInputCol, HasOutputCol):
         return p[:, -1] if p.ndim == 2 else p
 
 
-class TabularLIME(_LIMEBase):
-    """Per-feature linear attribution for vector-feature rows."""
+class _LIMEBase(Transformer, _LIMEParams):
+    kernelWidth = Param("kernelWidth", "proximity kernel width", TC.toFloat,
+                        default=0.75)
+
+
+class TabularLIME(Estimator, _LIMEParams):
+    """Estimator half of tabular LIME (reference ``LIME.scala:169-199``):
+    fit computes per-column standard deviations (the reference fits a
+    StandardScaler) which the model uses to scale its gaussian
+    perturbations around each explained instance."""
+
+    def __init__(self, **kwargs):
+        super().__init__(**kwargs)
+        self._setDefault(inputCol="features", outputCol="weights")
+
+    def _fit(self, df):
+        x = as_2d_features(df, self.getInputCol()).astype(np.float64)
+        stds = (x.std(axis=0, ddof=1) if x.shape[0] > 1
+                else np.ones(x.shape[1]))
+        stds = np.where(stds > 0, stds, 1.0)
+        model = TabularLIMEModel()
+        self._copy_params_to(model)
+        model.set("columnSTDs", [float(v) for v in stds])
+        return model
+
+
+class TabularLIMEModel(Model, _LIMEParams):
+    """Per-feature linear attribution: perturb each instance with
+    gaussian noise scaled by ``columnSTDs`` (reference
+    ``perturbedDenseVectors``, ``LIME.scala:216-221``), score through
+    the explained model, fit a regularized local linear surrogate."""
+
+    columnSTDs = Param("columnSTDs", "per-column perturbation scales",
+                       TC.toListFloat, default=[])
 
     def __init__(self, **kwargs):
         super().__init__(**kwargs)
         self._setDefault(inputCol="features", outputCol="weights")
 
     def _transform(self, df):
-        x = as_2d_features(df, self.getInputCol()).astype(np.float32)
+        x = as_2d_features(df, self.getInputCol()).astype(np.float64)
         n, F = x.shape
+        stds = np.asarray(self.get("columnSTDs"), np.float64)
+        if stds.size == 0:
+            raise ValueError(
+                "columnSTDs is unset — fit TabularLIME first (or set "
+                "per-column perturbation scales explicitly)")
+        if stds.shape[0] != F:
+            raise ValueError(
+                f"columnSTDs has {stds.shape[0]} entries for {F} "
+                "features")
+        if not np.all(stds > 0):
+            raise ValueError(
+                "columnSTDs must be strictly positive (zero would make "
+                "the standardized surrogate design NaN)")
         S = self.get("nSamples")
         rng = np.random.default_rng(self.get("seed"))
-        sigma = x.std(axis=0, keepdims=True) + 1e-9
-
-        # binary on/off masks: off = feature replaced by its mean
-        masks = (rng.random((n, S, F)) < 0.5).astype(np.float32)
-        mean = x.mean(axis=0, keepdims=True)
-        perturbed = masks * x[:, None, :] + (1 - masks) * mean[None]
-        del sigma
-
-        flat = perturbed.reshape(n * S, F)
+        noise = rng.standard_normal((n, S, F)) * stds[None, None, :]
+        perturbed = x[:, None, :] + noise            # around the instance
+        flat = perturbed.reshape(n * S, F).astype(np.float32)
         preds = self._predict(
             DataFrame({self.getInputCol(): flat})).reshape(n, S)
-        coefs = _surrogate_fit(masks, preds.astype(np.float32),
-                               self.get("kernelWidth"))
+        # local surrogate on standardized offsets (unit-variance design,
+        # like the reference's scaler-backed fit); coefficients are
+        # rescaled back to raw feature units
+        Z = (noise / stds[None, None, :]).astype(np.float32)
+        coefs = _surrogate_fit_linear(Z, preds.astype(np.float32),
+                                      self.get("regularization"))
+        coefs = coefs / stds[None, :]
         return df.with_column(self.getOutputCol(),
                               coefs.astype(np.float64))
 
@@ -138,7 +206,8 @@ class ImageLIME(_LIMEBase):
             preds = self._predict(
                 DataFrame({self.getInputCol(): batch.astype(np.float32)}))
             coefs = _surrogate_fit(masks[None], preds[None].astype(
-                np.float32), self.get("kernelWidth"))[0]
+                np.float32), self.get("kernelWidth"),
+                self.get("regularization"))[0]
             weights_out[r] = coefs
             spx_out[r] = labels
         out = df.with_column(self.getOutputCol(), weights_out)
@@ -178,7 +247,8 @@ class TextLIME(_LIMEBase):
             preds = self._predict(DataFrame({self.getInputCol(): col}))
             coefs = _surrogate_fit(masks[None],
                                    preds[None].astype(np.float32),
-                                   self.get("kernelWidth"))[0]
+                                   self.get("kernelWidth"),
+                                   self.get("regularization"))[0]
             weights_out[r] = coefs
             tokens_out[r] = toks
         return (df.with_column(self.getOutputCol(), weights_out)

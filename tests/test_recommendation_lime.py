@@ -122,13 +122,11 @@ class TestLIME:
         x = rng.normal(size=(5, 4)).astype(np.float32)
         df = DataFrame({"features": x})
         lime = TabularLIME(model=_LinearModel(w), nSamples=400, seed=1)
-        out = lime.transform(df)["weights"]
-        # LIME's mask coefficients are per-instance attributions: switching
-        # feature j on moves the prediction by w_j · (x_j - mean_j)
-        mean = x.mean(axis=0)
+        out = lime.fit(df).transform(df)["weights"]
+        # gaussian-perturbation LIME around a linear model recovers the
+        # model's own coefficients (reference TabularLIMEModel semantics)
         for r in range(5):
-            expected = w * (x[r] - mean)
-            np.testing.assert_allclose(out[r], expected, atol=0.05)
+            np.testing.assert_allclose(out[r], w, atol=0.1)
 
     def test_superpixels_partition_image(self):
         img = np.zeros((32, 32, 3), np.float32)
