@@ -28,11 +28,15 @@ def _weighted_lstsq(X, y, w, reg):
     """One ridge-stabilized weighted least squares: X [S, F+1], y [S],
     w [S] → coef [F+1]. ``reg`` is the user regularization (reference
     LIME's ``regularization``, a ridge here) on top of a 1e-6
-    stabilizer."""
+    stabilizer — the INTERCEPT column only gets the stabilizer (LIME
+    never shrinks the baseline; a shrunk intercept leaks the model's
+    baseline into every feature weight)."""
     sw = jnp.sqrt(w)[:, None]
     A = X * sw
     b = y * sw[:, 0]
-    AtA = A.T @ A + (reg + 1e-6) * jnp.eye(X.shape[1])
+    eye = jnp.eye(X.shape[1])
+    penalty = reg * eye.at[-1, -1].set(0.0) + 1e-6 * eye
+    AtA = A.T @ A + penalty
     return jnp.linalg.solve(AtA, A.T @ b)
 
 
@@ -40,19 +44,25 @@ _batched_lstsq = jax.jit(jax.vmap(_weighted_lstsq,
                                   in_axes=(0, 0, 0, None)))
 
 
+def _fit_surrogates(feats: np.ndarray, preds: np.ndarray,
+                    w: np.ndarray, regularization: float) -> np.ndarray:
+    """Shared fit core: feats [R, S, F] + intercept column → [R, F]."""
+    R, S, F = feats.shape
+    ones = np.ones((R, S, 1), np.float32)
+    X = jnp.asarray(np.concatenate([feats, ones], axis=2))
+    coefs = _batched_lstsq(X, jnp.asarray(preds), jnp.asarray(w),
+                           jnp.float32(regularization))
+    return np.asarray(coefs)[:, :F]
+
+
 def _surrogate_fit(masks: np.ndarray, preds: np.ndarray,
                    kernel_width: float,
                    regularization: float = 0.0) -> np.ndarray:
     """masks [R, S, F] binary, preds [R, S] → coefs [R, F]."""
-    R, S, F = masks.shape
-    ones = np.ones((R, S, 1), np.float32)
-    X = jnp.asarray(np.concatenate([masks, ones], axis=2))
-    y = jnp.asarray(preds)
     # LIME proximity kernel: exp(-d²/width²), d = fraction masked off
     d = 1.0 - masks.mean(axis=2)
-    w = jnp.asarray(np.exp(-(d ** 2) / kernel_width ** 2))
-    coefs = _batched_lstsq(X, y, w, jnp.float32(regularization))
-    return np.asarray(coefs)[:, :F]
+    w = np.exp(-(d ** 2) / kernel_width ** 2).astype(np.float32)
+    return _fit_surrogates(masks, preds, w, regularization)
 
 
 def _surrogate_fit_linear(Z: np.ndarray, preds: np.ndarray,
@@ -60,13 +70,8 @@ def _surrogate_fit_linear(Z: np.ndarray, preds: np.ndarray,
     """Unweighted local linear fit for gaussian perturbations:
     Z [R, S, F] standardized offsets, preds [R, S] → coefs [R, F] (in
     standardized units — the reference's lasso without sample weights)."""
-    R, S, F = Z.shape
-    ones = np.ones((R, S, 1), np.float32)
-    X = jnp.asarray(np.concatenate([Z, ones], axis=2))
-    y = jnp.asarray(preds)
-    w = jnp.ones((R, S), jnp.float32)
-    coefs = _batched_lstsq(X, y, w, jnp.float32(regularization))
-    return np.asarray(coefs)[:, :F]
+    w = np.ones(Z.shape[:2], np.float32)
+    return _fit_surrogates(Z, preds, w, regularization)
 
 
 class _LIMEParams(HasInputCol, HasOutputCol):
@@ -148,15 +153,16 @@ class TabularLIMEModel(Model, _LIMEParams):
                 "the standardized surrogate design NaN)")
         S = self.get("nSamples")
         rng = np.random.default_rng(self.get("seed"))
-        noise = rng.standard_normal((n, S, F)) * stds[None, None, :]
-        perturbed = x[:, None, :] + noise            # around the instance
+        # the standard-normal draws ARE the standardized design — scale
+        # up once for the perturbation instead of dividing back later
+        Z = rng.standard_normal((n, S, F)).astype(np.float32)
+        perturbed = x[:, None, :] + Z * stds[None, None, :]
         flat = perturbed.reshape(n * S, F).astype(np.float32)
         preds = self._predict(
             DataFrame({self.getInputCol(): flat})).reshape(n, S)
         # local surrogate on standardized offsets (unit-variance design,
         # like the reference's scaler-backed fit); coefficients are
         # rescaled back to raw feature units
-        Z = (noise / stds[None, None, :]).astype(np.float32)
         coefs = _surrogate_fit_linear(Z, preds.astype(np.float32),
                                       self.get("regularization"))
         coefs = coefs / stds[None, :]

@@ -40,6 +40,11 @@ class VowpalWabbitBaseParams(HasFeaturesCol, HasLabelCol, HasWeightCol):
                                 default=None, has_default=True)
     numShards = Param("numShards", "device shards (0 = auto)", TC.toInt,
                       default=0)
+    additionalFeatures = Param(
+        "additionalFeatures", "extra sparse feature columns (their "
+        "namespaces concatenate with featuresCol per row — reference "
+        "VowpalWabbitBase.scala:59; interactions across namespaces come "
+        "from VowpalWabbitInteractions)", TC.toListString, default=[])
     useBarrierExecutionMode = Param("useBarrierExecutionMode",
                                     "inert; SPMD is inherently barriered",
                                     TC.toBoolean, default=False)
@@ -93,8 +98,7 @@ class VowpalWabbitBaseParams(HasFeaturesCol, HasLabelCol, HasWeightCol):
             setattr(cfg, k, v)
         return cfg
 
-    def _features(self, df):
-        base = self.getFeaturesCol()
+    def _one_feature_col(self, df, base):
         icol, vcol = f"{base}_indices", f"{base}_values"
         if icol in df.columns:
             return np.asarray(df[icol], np.int32), \
@@ -104,6 +108,27 @@ class VowpalWabbitBaseParams(HasFeaturesCol, HasLabelCol, HasWeightCol):
         n, f = dense.shape
         idx = np.broadcast_to(np.arange(f, dtype=np.int32), (n, f))
         return np.ascontiguousarray(idx), dense
+
+    def _features(self, df):
+        cols = [self.getFeaturesCol()] + list(
+            self.get("additionalFeatures") or [])
+        if len(cols) > 1:
+            # dense columns all map to indices 0..f-1 — concatenating
+            # them would silently alias every column onto the same
+            # weight slots; namespaces must be hashed (COO) to combine
+            dense = [c for c in cols if f"{c}_indices" not in df.columns]
+            if dense:
+                raise ValueError(
+                    f"additionalFeatures requires hashed sparse "
+                    f"columns; {dense} are dense — run them through "
+                    "VowpalWabbitFeaturizer first")
+        parts = [self._one_feature_col(df, c) for c in cols]
+        if len(parts) == 1:
+            return parts[0]
+        # concatenate namespaces along the per-row capacity axis
+        idx = np.concatenate([p[0] for p in parts], axis=1)
+        val = np.concatenate([p[1] for p in parts], axis=1)
+        return idx, val
 
     def _mesh(self, n_rows: int):
         import jax

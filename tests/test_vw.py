@@ -514,3 +514,38 @@ class TestVectorZipperAndEpsilon:
         # stripping makes the representations equivalent up to collision
         # merging; quality must not degrade materially
         assert abs(aucs[0] - aucs[3]) < 0.05, aucs
+
+
+def test_additional_features_concatenate_namespaces():
+    """Reference additionalFeatures: extra sparse columns join the main
+    features per row."""
+    rng = np.random.default_rng(0)
+    n = 600
+    x1 = rng.normal(size=(n, 3)).astype(np.float32)
+    x2 = rng.normal(size=(n, 3)).astype(np.float32)
+    y = (x1[:, 0] + x2[:, 1] > 0).astype(np.float32)
+    df = DataFrame({"a": x1, "b": x2, "label": y})
+    fa = VowpalWabbitFeaturizer(inputCols=["a"], outputCol="fa",
+                                numBits=12).transform(df)
+    fb = VowpalWabbitFeaturizer(inputCols=["b"], outputCol="fb",
+                                numBits=12).transform(fa)
+    m = VowpalWabbitClassifier(featuresCol="fa",
+                               additionalFeatures=["fb"],
+                               numPasses=6, batchSize=64,
+                               numShards=1).fit(fb)
+    auc_both = roc_auc(y, m.transform(fb)["probability"][:, 1])
+    m1 = VowpalWabbitClassifier(featuresCol="fa", numPasses=6,
+                                batchSize=64, numShards=1).fit(fb)
+    auc_one = roc_auc(y, m1.transform(fb)["probability"][:, 1])
+    assert auc_both > 0.9
+    assert auc_both > auc_one + 0.05   # the extra namespace mattered
+
+
+def test_additional_features_rejects_dense():
+    rng = np.random.default_rng(0)
+    df = DataFrame({"a": rng.normal(size=(50, 3)).astype(np.float32),
+                    "b": rng.normal(size=(50, 3)).astype(np.float32),
+                    "label": np.ones(50, np.float32)})
+    with pytest.raises(ValueError, match="dense"):
+        VowpalWabbitClassifier(featuresCol="a",
+                               additionalFeatures=["b"]).fit(df)
