@@ -7,11 +7,14 @@ complement sampling (``complement_access.py``), per-tenant indexers and
 scalers (``feature/``).
 """
 
-from .feature import IdIndexer, IdIndexerModel, StandardScalarScaler, \
-    LinearScalarScaler
+from .feature import (ConnectedComponents, IdIndexer,
+                      IdIndexerModel, MultiIndexer,
+                      MultiIndexerModel, StandardScalarScaler,
+                      LinearScalarScaler)
 from .anomaly import AccessAnomaly, AccessAnomalyModel, \
     ComplementAccessTransformer
 
-__all__ = ["IdIndexer", "IdIndexerModel", "StandardScalarScaler",
+__all__ = ["ConnectedComponents", "IdIndexer", "IdIndexerModel",
+           "MultiIndexer", "MultiIndexerModel", "StandardScalarScaler",
            "LinearScalarScaler", "AccessAnomaly", "AccessAnomalyModel",
            "ComplementAccessTransformer"]

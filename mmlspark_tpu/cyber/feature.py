@@ -10,7 +10,7 @@ from __future__ import annotations
 import numpy as np
 
 from ..core import ComplexParam, DataFrame, Estimator, Model, Param, \
-    TypeConverters as TC
+    Transformer, TypeConverters as TC
 
 
 class IdIndexer(Estimator):
@@ -117,3 +117,103 @@ class LinearScalarScaler(_PartitionedScaler):
         return (float(vals.min()), float(vals.max()),
                 (self.get("minRequiredValue"),
                  self.get("maxRequiredValue")))
+
+
+class MultiIndexer(Estimator):
+    """Index several (inputCol, outputCol) pairs in one fit (reference
+    ``cyber/feature/indexers.py`` ``MultiIndexer``: a convenience over a
+    list of IdIndexers sharing the tenant key)."""
+
+    partitionKey = Param("partitionKey", "tenant column", TC.toString)
+    inputCols = Param("inputCols", "raw id columns", TC.toListString)
+    outputCols = Param("outputCols", "indexed id columns",
+                       TC.toListString)
+    resetPerPartition = Param("resetPerPartition",
+                              "ids restart at 1 per tenant", TC.toBoolean,
+                              default=True)
+
+    def _fit(self, df):
+        ins = self.get("inputCols")
+        outs = self.get("outputCols")
+        if len(ins) != len(outs):
+            raise ValueError(
+                f"inputCols ({len(ins)}) and outputCols ({len(outs)}) "
+                "must pair up")
+        models = [IdIndexer(inputCol=i, outputCol=o,
+                            partitionKey=self.get("partitionKey"),
+                            resetPerPartition=self.get(
+                                "resetPerPartition")).fit(df)
+                  for i, o in zip(ins, outs)]
+        model = MultiIndexerModel(models=models)
+        self._copy_params_to(model)
+        return model
+
+
+class MultiIndexerModel(Model):
+    partitionKey = Param("partitionKey", "tenant column", TC.toString)
+    inputCols = Param("inputCols", "raw id columns", TC.toListString)
+    outputCols = Param("outputCols", "indexed id columns",
+                       TC.toListString)
+    resetPerPartition = Param("resetPerPartition", "per-tenant ids",
+                              TC.toBoolean, default=True)
+    models = ComplexParam("models", "fitted per-column IdIndexerModels")
+
+    def get_indexer(self, input_col: str):
+        """The fitted IdIndexerModel for one column (reference
+        ``MultiIndexerModel.get_indexer``)."""
+        for m in self.get("models"):
+            if m.get("inputCol") == input_col:
+                return m
+        raise KeyError(f"no indexer for column {input_col!r}")
+
+    def _transform(self, df):
+        out = df
+        for m in self.get("models"):
+            out = m.transform(out)
+        return out
+
+
+class ConnectedComponents(Transformer):
+    """Assign each (user, resource) edge its bipartite connected
+    component (reference ``cyber/utils`` ``ConnectedComponents``): the
+    access-anomaly recipe models each component independently, since
+    scores across disconnected access graphs are incomparable."""
+
+    partitionKey = Param("partitionKey", "tenant column", TC.toString)
+    userCol = Param("userCol", "user column", TC.toString,
+                    default="user")
+    resCol = Param("resCol", "resource column", TC.toString,
+                   default="res")
+    componentCol = Param("componentCol", "output component id column",
+                         TC.toString, default="component")
+
+    def _transform(self, df):
+        tenants = df[self.get("partitionKey")]
+        users = df[self.get("userCol")]
+        ress = df[self.get("resCol")]
+        # union-find over (tenant, 'u', user) and (tenant, 'r', res)
+        parent: dict = {}
+
+        def find(a):
+            root = a
+            while parent.setdefault(root, root) != root:
+                root = parent[root]
+            while parent[a] != root:       # path compression
+                parent[a], a = root, parent[a]
+            return root
+
+        def union(a, b):
+            ra, rb = find(a), find(b)
+            if ra != rb:
+                parent[rb] = ra
+
+        n = len(df)
+        for i in range(n):
+            union((tenants[i], "u", users[i]),
+                  (tenants[i], "r", ress[i]))
+        labels: dict = {}
+        out = np.zeros(n, np.int64)
+        for i in range(n):
+            root = find((tenants[i], "u", users[i]))
+            out[i] = labels.setdefault(root, len(labels))
+        return df.with_column(self.get("componentCol"), out)

@@ -113,6 +113,18 @@ class VowpalWabbitBaseParams(HasFeaturesCol, HasLabelCol, HasWeightCol):
         cols = [self.getFeaturesCol()] + list(
             self.get("additionalFeatures") or [])
         if len(cols) > 1:
+            if len(set(cols)) != len(cols):
+                # a duplicated namespace would scatter-add every feature
+                # twice — silently doubling its weight updates
+                raise ValueError(
+                    f"duplicate feature columns in featuresCol + "
+                    f"additionalFeatures: {cols}")
+            missing = [c for c in cols
+                       if f"{c}_indices" not in df.columns
+                       and c not in df.columns]
+            if missing:
+                raise KeyError(
+                    f"feature column(s) {missing} not in {df.columns}")
             # dense columns all map to indices 0..f-1 — concatenating
             # them would silently alias every column onto the same
             # weight slots; namespaces must be hashed (COO) to combine

@@ -99,3 +99,44 @@ class TestAccessAnomaly:
         for t, c in counts.items():
             # sampling can fall slightly short of quota, never to ~zero
             assert c > per_tenant_want // 2, (t, counts)
+
+
+class TestMultiIndexerAndComponents:
+    def test_multi_indexer(self):
+        from mmlspark_tpu.cyber import MultiIndexer
+        df = DataFrame({
+            "tenant": np.asarray(["t1", "t1", "t2"], object),
+            "user": np.asarray(["u1", "u2", "u1"], object),
+            "res": np.asarray(["r1", "r1", "r9"], object)})
+        m = MultiIndexer(partitionKey="tenant",
+                         inputCols=["user", "res"],
+                         outputCols=["uid", "rid"]).fit(df)
+        out = m.transform(df)
+        assert out["uid"].tolist() == [1, 2, 1]   # per-tenant restart
+        assert out["rid"].tolist() == [1, 1, 1]
+        assert m.get_indexer("user").get("outputCol") == "uid"
+        import pytest
+        with pytest.raises(KeyError):
+            m.get_indexer("nope")
+
+    def test_connected_components(self):
+        from mmlspark_tpu.cyber import ConnectedComponents
+        df = DataFrame({
+            "tenant": np.asarray(["t"] * 5, object),
+            "user": np.asarray(["u1", "u2", "u2", "u3", "u4"], object),
+            "res": np.asarray(["r1", "r1", "r2", "r3", "r3"], object)})
+        out = ConnectedComponents(partitionKey="tenant").transform(df)
+        c = out["component"]
+        # {u1,u2}x{r1,r2} one component; {u3,u4}x{r3} another
+        assert c[0] == c[1] == c[2]
+        assert c[3] == c[4] != c[0]
+
+    def test_components_tenant_isolated(self):
+        from mmlspark_tpu.cyber import ConnectedComponents
+        df = DataFrame({
+            "tenant": np.asarray(["a", "b"], object),
+            "user": np.asarray(["u", "u"], object),
+            "res": np.asarray(["r", "r"], object)})
+        c = ConnectedComponents(partitionKey="tenant").transform(
+            df)["component"]
+        assert c[0] != c[1]   # same names, different tenants

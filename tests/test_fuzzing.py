@@ -205,7 +205,8 @@ _EXCLUDED = {
     "VowpalWabbitInteractions", "UnrollBinaryImage", "DataConversion",
     "IndexToValue", "TimeIntervalMiniBatchTransformer",
     # cyber: need tenant-keyed inputs; fuzzed in test_cyber
-    "IdIndexer", "StandardScalarScaler", "LinearScalarScaler",
+    "IdIndexer", "MultiIndexer", "ConnectedComponents",
+    "StandardScalarScaler", "LinearScalarScaler",
     "AccessAnomaly", "ComplementAccessTransformer",
     "RecommendationIndexer",
     # models produced by estimators (covered via their estimators)

@@ -549,3 +549,17 @@ def test_additional_features_rejects_dense():
     with pytest.raises(ValueError, match="dense"):
         VowpalWabbitClassifier(featuresCol="a",
                                additionalFeatures=["b"]).fit(df)
+
+
+def test_additional_features_error_paths():
+    rng = np.random.default_rng(0)
+    df = DataFrame({"a": rng.normal(size=(20, 2)).astype(np.float32),
+                    "label": np.ones(20, np.float32)})
+    fdf = VowpalWabbitFeaturizer(inputCols=["a"], outputCol="fa",
+                                 numBits=8).transform(df)
+    with pytest.raises(KeyError, match="not in"):
+        VowpalWabbitClassifier(featuresCol="fa",
+                               additionalFeatures=["nope"]).fit(fdf)
+    with pytest.raises(ValueError, match="duplicate"):
+        VowpalWabbitClassifier(featuresCol="fa",
+                               additionalFeatures=["fa"]).fit(fdf)
