@@ -11,6 +11,7 @@ import numpy as np
 
 from ..core import ComplexParam, DataFrame, Estimator, Model, Param, \
     Transformer, TypeConverters as TC
+from ..core.param import StageListParam
 
 
 class IdIndexer(Estimator):
@@ -156,7 +157,8 @@ class MultiIndexerModel(Model):
                        TC.toListString)
     resetPerPartition = Param("resetPerPartition", "per-tenant ids",
                               TC.toBoolean, default=True)
-    models = ComplexParam("models", "fitted per-column IdIndexerModels")
+    models = StageListParam("models",
+                            "fitted per-column IdIndexerModels")
 
     def get_indexer(self, input_col: str):
         """The fitted IdIndexerModel for one column (reference

@@ -11,10 +11,10 @@ from .train_classifier import (TrainClassifier, TrainRegressor,
 from .linear import (LinearRegression, LinearRegressionModel,
                      LogisticRegression, LogisticRegressionModel)
 from .statistics import (ComputeModelStatistics, ComputePerInstanceStatistics,
-                         MetricConstants)
+                         MetricConstants, MetricsLogger)
 
 __all__ = ["LinearRegression", "LinearRegressionModel",
            "LogisticRegression", "LogisticRegressionModel",
            "TrainClassifier", "TrainRegressor", "TrainedClassifierModel",
-           "TrainedRegressorModel", "ComputeModelStatistics",
+           "TrainedRegressorModel", "ComputeModelStatistics", "MetricsLogger",
            "ComputePerInstanceStatistics", "MetricConstants"]

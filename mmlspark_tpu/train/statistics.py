@@ -94,6 +94,35 @@ def roc_curve(y: np.ndarray, scores: np.ndarray,
                       "true_positive_rate": tps[idx] / P})
 
 
+class MetricsLogger:
+    """Structured metric logging (reference ``MetricsLogger``,
+    ``ComputeModelStatistics.scala:473-494``): one JSON info line per
+    metric set, tagged with the emitting stage uid."""
+
+    def __init__(self, uid: str | None = None):
+        import logging
+        self.uid = uid
+        self._logger = logging.getLogger("mmlspark_tpu.metrics")
+
+    def _log(self, kind: str, metrics: dict) -> None:
+        import json
+        self._logger.info(json.dumps(
+            {"uid": self.uid, "kind": kind,
+             "metrics": {k: float(v) for k, v in metrics.items()}}))
+
+    def log_classification_metrics(self, accuracy: float,
+                                   precision: float,
+                                   recall: float) -> None:
+        self._log("Classification Metrics",
+                  {"accuracy": accuracy, "precision": precision,
+                   "recall": recall})
+
+    def log_regression_metrics(self, mse: float, rmse: float, r2: float,
+                               mae: float) -> None:
+        self._log("Regression Metrics",
+                  {"mse": mse, "rmse": rmse, "r2": r2, "mae": mae})
+
+
 class ComputeModelStatistics(Transformer, HasLabelCol):
     """Emits a one-row metrics DataFrame for scored data."""
 
@@ -122,8 +151,14 @@ class ComputeModelStatistics(Transformer, HasLabelCol):
                     else np.asarray(s, np.float64)
             m = classification_metrics(y, pred, scores)
             m.pop("confusion_matrix")
+            MetricsLogger(getattr(self, "uid", None)) \
+                .log_classification_metrics(m["accuracy"],
+                                            m["precision"], m["recall"])
         else:
             m = regression_metrics(y, pred)
+            MetricsLogger(getattr(self, "uid", None)) \
+                .log_regression_metrics(m["mse"], m["rmse"], m["r^2"],
+                                        m["mae"])
         return DataFrame({k: np.asarray([v]) for k, v in m.items()})
 
 

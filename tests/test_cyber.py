@@ -140,3 +140,16 @@ class TestMultiIndexerAndComponents:
         c = ConnectedComponents(partitionKey="tenant").transform(
             df)["component"]
         assert c[0] != c[1]   # same names, different tenants
+
+    def test_multi_indexer_save_load(self, tmp_path):
+        from mmlspark_tpu.core.serialize import load_stage
+        from mmlspark_tpu.cyber import MultiIndexer
+        df = DataFrame({
+            "tenant": np.asarray(["t1", "t1"], object),
+            "user": np.asarray(["u1", "u2"], object)})
+        m = MultiIndexer(partitionKey="tenant", inputCols=["user"],
+                         outputCols=["uid"]).fit(df)
+        m.save(str(tmp_path / "mi"))
+        m2 = load_stage(str(tmp_path / "mi"))
+        assert m2.transform(df)["uid"].tolist() == \
+            m.transform(df)["uid"].tolist()

@@ -198,3 +198,19 @@ def test_default_hyperparam_ranges():
                                 evaluationMetric="accuracy",
                                 labelCol="label").fit(df)
     assert tuned.get("bestMetric") > 0.7
+
+
+def test_metrics_logger_emits_structured_lines(caplog):
+    import logging
+    import numpy as np
+    from mmlspark_tpu.core import DataFrame
+    from mmlspark_tpu.train import ComputeModelStatistics
+
+    df = DataFrame({"label": np.asarray([0.0, 1.0, 1.0, 0.0]),
+                    "prediction": np.asarray([0.0, 1.0, 0.0, 0.0]),
+                    "probability": np.asarray([[.8, .2], [.1, .9],
+                                               [.6, .4], [.7, .3]])})
+    with caplog.at_level(logging.INFO, logger="mmlspark_tpu.metrics"):
+        ComputeModelStatistics(labelCol="label").transform(df)
+    assert any("Classification Metrics" in r.message
+               for r in caplog.records)
