@@ -1,0 +1,30 @@
+#!/bin/bash
+# Probe the TPU tunnel every 5 minutes; when it answers, run the
+# requested bench.py subset once and stop. Results land in
+# $OUT_DIR/bench_recovered.json. The round-2/3 failure mode this guards:
+# the tunnel wedges for hours, then recovers silently — a human (or
+# agent) polling by hand misses the window.
+set -u
+ONLY="${MMLSPARK_TPU_WATCH_ONLY:-gbdt,ranker}"
+OUT_DIR="${MMLSPARK_TPU_WATCH_DIR:-/tmp/bench_watcher}"
+mkdir -p "$OUT_DIR"
+cd "$(dirname "$0")/.."
+while true; do
+  if timeout 60 python -c "import jax; assert jax.devices()[0].platform == 'tpu'" \
+      >>"$OUT_DIR/probe.log" 2>&1; then
+    echo "$(date -u +%FT%TZ) tunnel up — running bench ($ONLY)" >>"$OUT_DIR/probe.log"
+    MMLSPARK_TPU_BENCH_ONLY="$ONLY" timeout 1200 python bench.py \
+      >"$OUT_DIR/bench_recovered.json" 2>>"$OUT_DIR/probe.log"
+    # only stop on a non-empty result with NO error keys at all — a
+    # mid-suite wedge records error_gbdt/error_ranker (not
+    # error_backend) and must keep the retry loop alive
+    if [ -s "$OUT_DIR/bench_recovered.json" ] && \
+       ! grep -q '"error' "$OUT_DIR/bench_recovered.json"; then
+      echo "$(date -u +%FT%TZ) banked" >>"$OUT_DIR/probe.log"
+      break
+    fi
+  else
+    echo "$(date -u +%FT%TZ) tunnel down" >>"$OUT_DIR/probe.log"
+  fi
+  sleep 300
+done

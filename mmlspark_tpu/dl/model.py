@@ -49,6 +49,14 @@ class TPUModel(Model, HasInputCol, HasOutputCol):
     inputShape = Param("inputShape", "per-row input shape (tuple), e.g. "
                        "(224, 224, 3) for NHWC images", TC.identity,
                        default=None, has_default=True)
+    transferDtype = Param(
+        "transferDtype",
+        "host->device wire dtype: 'auto' keeps uint8 columns as uint8 "
+        "(4x fewer bytes than float32; the model's on-device cast "
+        "handles widening), 'bfloat16' halves float transfer — lossless "
+        "when the model's first op casts to bf16 anyway — and 'float32' "
+        "always widens on host (pre-round-3 behavior)", TC.toString,
+        default="auto", has_default=True)
 
     # class-level fallback: the serializer reconstructs instances
     # without running __init__
@@ -134,10 +142,21 @@ class TPUModel(Model, HasInputCol, HasOutputCol):
         return df
 
     def _coerce_input(self, col) -> np.ndarray:
+        mode = self.get("transferDtype")
         if isinstance(col, np.ndarray) and col.dtype != object:
-            x = np.asarray(col, np.float32)
+            # uint8 survives every narrowing mode: bfloat16 would DOUBLE
+            # a uint8 column's wire bytes if it forced the float path
+            keep_u8 = mode in ("auto", "uint8", "bfloat16") \
+                and col.dtype == np.uint8
+            x = col if keep_u8 else np.asarray(col, np.float32)
         else:
             x = np.stack([np.asarray(a, np.float32) for a in col])
+        if mode == "bfloat16" and x.dtype == np.float32:
+            # device compute is bf16 in every zoo model, so narrowing on
+            # the host wire loses nothing the MXU would have kept — and
+            # host->device (worse, host->tunnel->device) bytes halve
+            import ml_dtypes
+            x = x.astype(ml_dtypes.bfloat16)
         shape = self.get("inputShape")
         if shape is not None and x.ndim == 2:
             # unrolled CHW vectors → NHWC images (undo UnrollImage)

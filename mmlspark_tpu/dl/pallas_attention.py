@@ -1,0 +1,166 @@
+"""Pallas TPU kernel: fused flash attention (forward).
+
+The long-context encoder's hot op. The XLA formulation
+(``text_encoder._dense_attention``) materializes the [T, T] score matrix
+in HBM — at T=2048, B=32, H=8 that is 4 GB of f32 score traffic per
+layer, and HBM bandwidth, not the MXU, bounds throughput. The TPU-native
+formulation streams K/V blocks through VMEM with a running-softmax
+accumulator (same math as ``parallel/ring_attention._block_update``), so
+scores never leave the chip:
+
+    grid = (B*H, T/block_q, T/block_k), k-blocks innermost
+    per (q-block, k-block) cell:  s = q k^T on the MXU,
+        online max/denominator update in VMEM scratch,
+        acc += softmax-weights @ v on the MXU
+    emit acc / l once per q-block on the last k step.
+
+Backward runs the blockwise (XLA) formulation via recompute — inference
+is the featurizer's hot path; training pays one extra forward.
+
+Tiling: q/k/v blocks keep head_dim on the lane axis (pads to 128 lanes
+below head_dim 128 — run heads at 64 or 128 wide for best effect), and
+the running max/denominator ride a (block_q, 128) f32 scratch so their
+updates stay VPU-shaped. Mask handling matches the dense path bit-wise:
+fully-masked rows emit zeros.
+
+No reference counterpart (SURVEY §5: long-context is "absent in the
+reference") — this kernel serves the framework's first-class extension.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+_NEG = -1e30  # additive mask value; -inf breaks the running-max algebra
+
+
+def _flash_kernel(q_ref, k_ref, v_ref, mask_ref, o_ref,
+                  m_scr, l_scr, acc_scr, *, scale: float):
+    """One (bh, q-block, k-block) grid cell of the online softmax."""
+    kb = pl.program_id(2)
+    nk = pl.num_programs(2)
+
+    @pl.when(kb == 0)
+    def _init():
+        m_scr[:] = jnp.full_like(m_scr, _NEG)
+        l_scr[:] = jnp.zeros_like(l_scr)
+        acc_scr[:] = jnp.zeros_like(acc_scr)
+
+    q = q_ref[0]                                   # [BQ, D]
+    k = k_ref[0]                                   # [BK, D]
+    s = jax.lax.dot_general(                       # [BQ, BK] f32 on MXU
+        q, k, (((1,), (1,)), ((), ())),
+        preferred_element_type=jnp.float32) * scale
+    valid = mask_ref[0, :] != 0                    # [BK]
+    s = jnp.where(valid[None, :], s, _NEG)
+
+    m_prev = m_scr[:, :1]                          # [BQ, 1]
+    l_prev = l_scr[:, :1]
+    m_new = jnp.maximum(m_prev, jnp.max(s, axis=-1, keepdims=True))
+    p = jnp.exp(s - m_new)                         # [BQ, BK]
+    # a fully-masked block: every s is _NEG and m_new is _NEG, so
+    # p = exp(0) = 1 row-wide — kill it with the validity mask
+    p = jnp.where(valid[None, :], p, 0.0)
+    corr = jnp.exp(m_prev - m_new)                 # [BQ, 1]
+    l_scr[:, :1] = l_prev * corr + jnp.sum(p, axis=-1, keepdims=True)
+    m_scr[:, :1] = m_new
+    # p rounds to the value dtype before the MXU pass — bit-matching the
+    # dense path's ``p.astype(v.dtype)`` (text_encoder.py:48)
+    acc_scr[:] = acc_scr[:] * corr + jax.lax.dot_general(
+        p.astype(v_ref.dtype), v_ref[0], (((1,), (0,)), ((), ())),
+        preferred_element_type=jnp.float32)
+
+    @pl.when(kb == nk - 1)
+    def _emit():
+        l = jnp.maximum(l_scr[:, :1], 1e-35)
+        o_ref[0] = (acc_scr[:] / l).astype(o_ref.dtype)
+
+
+@functools.partial(jax.jit,
+                   static_argnames=("block_q", "block_k", "interpret"))
+def _flash_forward(q, k, v, key_mask, *, block_q: int = 256,
+                   block_k: int = 512, interpret: bool = False):
+    B, H, T, D = q.shape
+    scale = D ** -0.5
+    bq = min(block_q, max(8, T))
+    bk = min(block_k, max(128, T))
+    qp = (-T) % bq
+    kp = (-T) % bk
+
+    qf = jnp.pad(q.reshape(B * H, T, D), ((0, 0), (0, qp), (0, 0)))
+    kf = jnp.pad(k.reshape(B * H, T, D), ((0, 0), (0, kp), (0, 0)))
+    vf = jnp.pad(v.reshape(B * H, T, D), ((0, 0), (0, kp), (0, 0)))
+    # [B, T] bool → [B*H, Tk] i8, padded keys invalid
+    mask = jnp.broadcast_to(key_mask[:, None, :], (B, H, T)) \
+        .reshape(B * H, T).astype(jnp.int8)
+    mask = jnp.pad(mask, ((0, 0), (0, kp)))
+
+    nq, nk = (T + qp) // bq, (T + kp) // bk
+    out = pl.pallas_call(
+        functools.partial(_flash_kernel, scale=scale),
+        grid=(B * H, nq, nk),
+        in_specs=[
+            pl.BlockSpec((1, bq, D), lambda b, iq, ik: (b, iq, 0)),
+            pl.BlockSpec((1, bk, D), lambda b, iq, ik: (b, ik, 0)),
+            pl.BlockSpec((1, bk, D), lambda b, iq, ik: (b, ik, 0)),
+            pl.BlockSpec((1, bk), lambda b, iq, ik: (b, ik)),
+        ],
+        out_specs=pl.BlockSpec((1, bq, D), lambda b, iq, ik: (b, iq, 0)),
+        out_shape=jax.ShapeDtypeStruct((B * H, T + qp, D), v.dtype),
+        scratch_shapes=[
+            pltpu.VMEM((bq, 128), jnp.float32),   # running max
+            pltpu.VMEM((bq, 128), jnp.float32),   # running denominator
+            pltpu.VMEM((bq, D), jnp.float32),     # output accumulator
+        ],
+        compiler_params=pltpu.CompilerParams(
+            dimension_semantics=("parallel", "parallel", "arbitrary")),
+        interpret=interpret,
+    )(qf, kf, vf, mask)
+    return out[:, :T].reshape(B, H, T, D)
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(4, 5, 6))
+def _flash(q, k, v, key_mask, block_q, block_k, interpret):
+    return _flash_forward(q, k, v, key_mask, block_q=block_q,
+                          block_k=block_k, interpret=interpret)
+
+
+def _flash_fwd(q, k, v, key_mask, block_q, block_k, interpret):
+    out = _flash(q, k, v, key_mask, block_q, block_k, interpret)
+    return out, (q, k, v, key_mask)
+
+
+def _flash_bwd(block_q, block_k, interpret, res, g):
+    # recompute-based backward through the XLA blockwise formulation:
+    # same math, O(T) memory, and jax.vjp handles the chain exactly
+    from ..parallel.ring_attention import blockwise_attention
+    q, k, v, key_mask = res
+
+    def ref(q, k, v):
+        return blockwise_attention(q, k, v, block_size=block_k,
+                                   key_mask=key_mask)
+
+    _, vjp = jax.vjp(ref, q, k, v)
+    dq, dk, dv = vjp(g)
+    return dq, dk, dv, None
+
+
+_flash.defvjp(_flash_fwd, _flash_bwd)
+
+
+def flash_attention(q, k, v, key_mask=None, *, block_q: int = 256,
+                    block_k: int = 512, interpret: bool | None = None):
+    """Fused flash attention. q/k/v [B, H, T, D]; ``key_mask`` [B, T]
+    bool (True = valid). Off-TPU it runs the Pallas interpreter (slow —
+    tests only); the XLA ``blockwise`` impl is the right CPU choice.
+    """
+    if interpret is None:
+        interpret = jax.default_backend() not in ("tpu", "axon")
+    if key_mask is None:
+        key_mask = jnp.ones((q.shape[0], q.shape[2]), bool)
+    return _flash(q, k, v, key_mask, block_q, block_k, bool(interpret))

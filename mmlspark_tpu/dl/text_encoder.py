@@ -168,6 +168,10 @@ def make_attention_fn(impl: str = "dense", mesh=None, axis: str = "sp",
     (shard with ``NamedSharding(mesh, P(None, None, axis, None))``)."""
     if impl == "dense":
         return _dense_attention
+    if impl == "pallas":
+        from .pallas_attention import flash_attention
+        return lambda q, k, v, m=None: flash_attention(
+            q, k, v, key_mask=m, block_k=block_size)
     if impl == "blockwise":
         from ..parallel.ring_attention import blockwise_attention
         return lambda q, k, v, m=None: blockwise_attention(
@@ -183,7 +187,7 @@ def make_attention_fn(impl: str = "dense", mesh=None, axis: str = "sp",
             raise ValueError("ulysses attention needs a mesh")
         return make_ulysses_attention(mesh, axis=axis)
     raise ValueError(f"unknown attention impl {impl!r}; expected "
-                     "dense|blockwise|ring|ulysses")
+                     "dense|pallas|blockwise|ring|ulysses")
 
 
 class TextEncoderFeaturizer(Transformer, HasInputCol, HasOutputCol,
@@ -200,8 +204,8 @@ class TextEncoderFeaturizer(Transformer, HasInputCol, HasOutputCol,
     """
 
     attentionImpl = Param("attentionImpl",
-                          "dense|blockwise|ring|ulysses", TC.toString,
-                          default="dense", has_default=True)
+                          "dense|pallas|blockwise|ring|ulysses",
+                          TC.toString, default="dense", has_default=True)
     seqChunk = Param("seqChunk", "pad sequence length to a multiple of "
                      "this (ring/ulysses need the sp-axis size to "
                      "divide T)", TC.toInt, default=128, has_default=True)

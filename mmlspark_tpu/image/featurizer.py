@@ -34,6 +34,12 @@ class ImageFeaturizer(Transformer, HasInputCol, HasOutputCol):
                        has_default=True)
     miniBatchSize = Param("miniBatchSize", "device batch size", TC.toInt,
                           default=64, has_default=True)
+    transferDtype = Param(
+        "transferDtype", "host->device wire dtype (see TPUModel); "
+        "'auto' additionally narrows float inputs to bfloat16 here when "
+        "the zoo model computes in bf16 (its first op is the cast, so "
+        "the wire narrowing is lossless)", TC.toString, default="auto",
+        has_default=True)
 
     # class-level fallbacks: the serializer reconstructs without __init__
     _tpu_model = None
@@ -85,11 +91,18 @@ class ImageFeaturizer(Transformer, HasInputCol, HasOutputCol):
         # reuse ONE TPUModel across transforms (its jitted apply is
         # cached per model identity — a fresh instance per call would
         # retrace and recompile every time)
+        wire = self.get("transferDtype")
+        if wire == "auto" and getattr(loaded.module, "dtype", None) is not \
+                None:
+            import jax.numpy as jnp
+            if loaded.module.dtype == jnp.bfloat16:
+                wire = "bfloat16"
         key = (id(loaded), endpoint, col, self.getOutputCol(),
-               self.get("miniBatchSize"))
+               self.get("miniBatchSize"), wire)
         if self._tpu_model is None or self._tpu_model[0] != key:
             self._tpu_model = (key, TPUModel(
                 model=loaded, inputCol=col,
                 outputCol=self.getOutputCol(), outputNode=endpoint,
-                minibatchSize=self.get("miniBatchSize")))
+                minibatchSize=self.get("miniBatchSize"),
+                transferDtype=wire))
         return self._tpu_model[1].transform(df)

@@ -146,6 +146,26 @@ class TestTPUModel:
         out = m.transform(images_df)
         assert out["p"].shape == (6, 16) and out["l"].shape == (6, 4)
 
+    def test_transfer_dtype_wire_paths(self, images_df):
+        """uint8 columns ride the wire un-widened and bf16 narrowing
+        matches the float32 path (the model casts to bf16 on device
+        anyway, so the wire dtype must not change results materially)."""
+        loaded = tiny_loaded()
+        kw = dict(model=loaded, inputCol="image", outputCol="feat",
+                  outputNode="pooled", minibatchSize=8)
+        f32 = TPUModel(**kw).transform(images_df)["feat"]
+        bf = TPUModel(transferDtype="bfloat16", **kw) \
+            .transform(images_df)["feat"]
+        np.testing.assert_allclose(f32, bf, atol=2e-2)
+        u8 = DataFrame({"image": (np.clip(images_df["image"], 0, 1)
+                                  * 255).astype(np.uint8)})
+        out = TPUModel(**kw).transform(u8)["feat"]  # auto keeps uint8
+        assert out.dtype == np.float32 and out.shape == (6, 16)
+        # every narrowing mode must keep uint8 un-widened on the wire
+        for mode in ("auto", "uint8", "bfloat16"):
+            m = TPUModel(transferDtype=mode, **kw)
+            assert m._coerce_input(u8["image"]).dtype == np.uint8, mode
+
 
 class TestImageFeaturizer:
     def test_cut_layers(self, images_df):

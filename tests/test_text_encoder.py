@@ -56,7 +56,8 @@ def test_batch_composition_independence(token_df, dense_features):
                                dense_features[0], atol=2e-3)
 
 
-@pytest.mark.parametrize("impl", ["blockwise", "ring", "ulysses"])
+@pytest.mark.parametrize("impl", ["blockwise", "pallas", "ring",
+                                  "ulysses"])
 def test_sharded_impls_match_dense(impl, token_df, dense_features):
     mesh = None
     if impl in ("ring", "ulysses"):
