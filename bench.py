@@ -358,6 +358,8 @@ def _finalize_encoder(extras: dict, impls=("dense", "pallas")) -> None:
     extras["encoder_seqs_per_sec"] = extras[f"encoder_seqs_per_sec_{best}"]
     extras["encoder_mfu"] = extras[f"encoder_mfu_{best}"]
     extras["encoder_best_batch"] = extras[f"encoder_best_batch_{best}"]
+    extras["encoder_ips_by_batch"] = extras[
+        f"encoder_ips_by_batch_{best}"]
     extras["encoder_best_impl"] = best
 
 

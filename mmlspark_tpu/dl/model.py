@@ -53,9 +53,10 @@ class TPUModel(Model, HasInputCol, HasOutputCol):
         "transferDtype",
         "host->device wire dtype: 'auto' keeps uint8 columns as uint8 "
         "(4x fewer bytes than float32; the model's on-device cast "
-        "handles widening), 'bfloat16' halves float transfer — lossless "
-        "when the model's first op casts to bf16 anyway — and 'float32' "
-        "always widens on host (pre-round-3 behavior)", TC.toString,
+        "handles widening), 'uint8' ditto (explicit), 'bfloat16' "
+        "additionally halves float transfer — lossless when the "
+        "model's first op casts to bf16 anyway — and 'float32' always "
+        "widens on host (pre-round-3 behavior)", TC.toString,
         default="auto", has_default=True)
 
     # class-level fallback: the serializer reconstructs instances
@@ -143,6 +144,10 @@ class TPUModel(Model, HasInputCol, HasOutputCol):
 
     def _coerce_input(self, col) -> np.ndarray:
         mode = self.get("transferDtype")
+        if mode not in ("auto", "uint8", "bfloat16", "float32"):
+            raise ValueError(
+                f"unknown transferDtype {mode!r}; expected "
+                "auto|uint8|bfloat16|float32")
         if isinstance(col, np.ndarray) and col.dtype != object:
             # uint8 survives every narrowing mode: bfloat16 would DOUBLE
             # a uint8 column's wire bytes if it forced the float path

@@ -5,6 +5,8 @@ from .data_conversion import DataConversion
 from .count_selector import CountSelector, CountSelectorModel
 from .text import (StopWordsRemover, Tokenizer, TokenIdEncoder, NGram, MultiNGram, HashingTF, IDF, IDFModel,
                    TextFeaturizer, TextFeaturizerModel, PageSplitter)
+from .vector import VectorAssembler, OneHotEncoder, OneHotEncoderModel
+from .embedding import Word2Vec, Word2VecModel
 
 __all__ = [
     "Featurize", "FeaturizeModel",
@@ -13,4 +15,6 @@ __all__ = [
     "DataConversion", "CountSelector", "CountSelectorModel",
     "StopWordsRemover", "Tokenizer", "TokenIdEncoder", "NGram", "MultiNGram", "HashingTF", "IDF", "IDFModel",
     "TextFeaturizer", "TextFeaturizerModel", "PageSplitter",
+    "VectorAssembler", "OneHotEncoder", "OneHotEncoderModel",
+    "Word2Vec", "Word2VecModel",
 ]

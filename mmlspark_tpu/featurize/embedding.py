@@ -1,0 +1,210 @@
+"""Word2Vec — TPU-native skip-gram with negative sampling.
+
+Reference surface: SparkML ``Word2Vec`` (tested at
+``core/ml/Word2VecSpec.scala`` — fit on token-list rows, ``transform``
+averages word vectors per document, ``findSynonyms`` returns cosine
+neighbors). The reference delegates to Spark's hierarchical-softmax
+implementation; the TPU design instead trains skip-gram with negative
+sampling as ONE jitted dispatch per epoch:
+
+- (center, context) pairs are built host-side once and live on device;
+- each epoch shuffles with ``jax.random.permutation`` and runs a
+  ``lax.scan`` over fixed-shape minibatches (no per-batch dispatch);
+- negatives come from the unigram^0.75 distribution via
+  ``jax.random.categorical`` on device;
+- the embedding update is a scatter-add of the batch gradient — the
+  gather→MXU dot→scatter pattern XLA schedules well at these table
+  sizes.
+"""
+
+from __future__ import annotations
+
+import functools
+from collections import Counter
+
+import numpy as np
+
+from ..core import Estimator, Model, Param, TypeConverters as TC
+from ..core.contracts import HasInputCol, HasOutputCol
+
+
+@functools.lru_cache(maxsize=1)
+def _train_epoch_fn():
+    """Build the jitted epoch lazily: importing jax (and initializing a
+    backend) at module load would make every ``import
+    mmlspark_tpu.featurize`` pay for it — the package convention is
+    jax-free imports for host-side stages."""
+    import jax
+    import jax.numpy as jnp
+
+    @functools.partial(jax.jit,
+                       static_argnames=("steps", "batch", "k_neg"))
+    def _train_epoch(emb_in, emb_out, pairs, neg_logits, key, lr, *,
+                     steps: int, batch: int, k_neg: int):
+        """One epoch: shuffle → scan over fixed minibatches → mean loss."""
+        key, pk = jax.random.split(key)
+        perm = jax.random.permutation(pk, pairs.shape[0])
+        sh = pairs[perm][:steps * batch].reshape(steps, batch, 2)
+        step_keys = jax.random.split(key, steps)
+
+        def scatter_row_mean(table, idx, grads, lr):
+            """Apply the PER-ROW MEAN of the batch gradient. A plain
+            scatter-add sums every duplicate contribution into one
+            step — with a small vocabulary (hundreds of duplicates per
+            batch) that multiplies the effective rate by the duplicate
+            count and diverges; the mean keeps each row's step at
+            ``lr`` regardless of how often the batch touched it."""
+            cnt = jnp.zeros((table.shape[0], 1), table.dtype) \
+                .at[idx].add(1.0)
+            acc = jnp.zeros_like(table).at[idx].add(grads)
+            return table - lr * acc / jnp.maximum(cnt, 1.0)
+
+        def body(carry, xs):
+            e_in, e_out = carry
+            b, k = xs
+            centers, contexts = b[:, 0], b[:, 1]
+            negs = jax.random.categorical(k, neg_logits,
+                                          shape=(batch, k_neg))
+
+            def loss_fn(vi, uo, un):
+                pos = jnp.sum(vi * uo, axis=-1)
+                neg = jnp.einsum("bd,bkd->bk", vi, un,
+                                 preferred_element_type=jnp.float32)
+                # SUM over the batch: combined with the per-row mean
+                # below, every touched row moves ~``stepSize``/step
+                return -(jnp.sum(jax.nn.log_sigmoid(pos))
+                         + jnp.sum(jax.nn.log_sigmoid(-neg)))
+
+            vi, uo, un = e_in[centers], e_out[contexts], e_out[negs]
+            loss, (gvi, guo, gun) = jax.value_and_grad(
+                loss_fn, argnums=(0, 1, 2))(vi, uo, un)
+            e_in = scatter_row_mean(e_in, centers, gvi, lr)
+            out_idx = jnp.concatenate([contexts, negs.reshape(-1)])
+            out_g = jnp.concatenate([guo,
+                                     gun.reshape(-1, gun.shape[-1])])
+            e_out = scatter_row_mean(e_out, out_idx, out_g, lr)
+            return (e_in, e_out), loss
+
+        (emb_in, emb_out), losses = jax.lax.scan(
+            body, (emb_in, emb_out), (sh, step_keys))
+        return emb_in, emb_out, losses.mean()
+
+    return _train_epoch
+
+
+class Word2Vec(Estimator, HasInputCol, HasOutputCol):
+    """Fit skip-gram embeddings on a token-list column."""
+
+    vectorSize = Param("vectorSize", "embedding width", TC.toInt,
+                       default=100, has_default=True)
+    windowSize = Param("windowSize", "context window radius", TC.toInt,
+                       default=5, has_default=True)
+    minCount = Param("minCount", "drop words rarer than this", TC.toInt,
+                     default=5, has_default=True)
+    maxIter = Param("maxIter", "training epochs", TC.toInt, default=1,
+                    has_default=True)
+    stepSize = Param("stepSize", "SGD learning rate", TC.toFloat,
+                     default=0.025, has_default=True)
+    numNegatives = Param("numNegatives", "negative samples per pair",
+                         TC.toInt, default=5, has_default=True)
+    batchSize = Param("batchSize", "pairs per scan step", TC.toInt,
+                      default=1024, has_default=True)
+    seed = Param("seed", "init/shuffle seed", TC.toInt, default=0,
+                 has_default=True)
+
+    def __init__(self, **kwargs):
+        super().__init__(**kwargs)
+        self._setDefault(inputCol="tokens", outputCol="features")
+
+    def _fit(self, df):
+        import jax
+        import jax.numpy as jnp
+
+        docs = [list(map(str, d)) if d is not None else []
+                for d in df[self.getInputCol()]]
+        counts = Counter(w for d in docs for w in d)
+        vocab = sorted(w for w, c in counts.items()
+                       if c >= self.get("minCount"))
+        if not vocab:
+            raise ValueError(
+                "empty vocabulary: every token fell under "
+                f"minCount={self.get('minCount')}")
+        index = {w: i for i, w in enumerate(vocab)}
+        window = self.get("windowSize")
+
+        pairs: list[tuple[int, int]] = []
+        for d in docs:
+            ids = [index[w] for w in d if w in index]
+            for i, c in enumerate(ids):
+                for j in range(max(0, i - window),
+                               min(len(ids), i + window + 1)):
+                    if j != i:
+                        pairs.append((c, ids[j]))
+        if not pairs:
+            raise ValueError("no (center, context) pairs: documents too "
+                             "short for the window")
+
+        V, D = len(vocab), self.get("vectorSize")
+        rng = np.random.default_rng(self.get("seed"))
+        emb_in = jnp.asarray(
+            rng.uniform(-0.5 / D, 0.5 / D, size=(V, D)), jnp.float32)
+        emb_out = jnp.zeros((V, D), jnp.float32)
+        freq = np.asarray([counts[w] for w in vocab], np.float64)
+        neg_logits = jnp.asarray(0.75 * np.log(freq), jnp.float32)
+
+        pairs_dev = jnp.asarray(np.asarray(pairs, np.int32))
+        batch = min(self.get("batchSize"), len(pairs))
+        steps = max(1, len(pairs) // batch)
+        key = jax.random.PRNGKey(self.get("seed"))
+        lr = jnp.float32(self.get("stepSize"))
+        train_epoch = _train_epoch_fn()
+        for _ in range(self.get("maxIter")):
+            key, ek = jax.random.split(key)
+            emb_in, emb_out, _ = train_epoch(
+                emb_in, emb_out, pairs_dev, neg_logits, ek, lr,
+                steps=steps, batch=batch,
+                k_neg=self.get("numNegatives"))
+
+        model = Word2VecModel() \
+            .set("vocabulary", vocab) \
+            .set("wordVectors", np.asarray(emb_in).tolist())
+        self._copy_params_to(model)
+        return model
+
+
+class Word2VecModel(Model, HasInputCol, HasOutputCol):
+    vocabulary = Param("vocabulary", "fitted vocabulary (sorted)")
+    wordVectors = Param("wordVectors", "[V, D] embedding rows")
+
+    def _vectors(self) -> tuple[dict[str, int], np.ndarray]:
+        vocab = self.get("vocabulary")
+        mat = np.asarray(self.get("wordVectors"), np.float32)
+        return {w: i for i, w in enumerate(vocab)}, mat
+
+    def getVectors(self) -> dict[str, np.ndarray]:
+        index, mat = self._vectors()
+        return {w: mat[i] for w, i in index.items()}
+
+    def findSynonyms(self, word: str, num: int) -> list[tuple[str, float]]:
+        """Cosine-nearest vocabulary words (the word itself excluded)."""
+        index, mat = self._vectors()
+        if word not in index:
+            raise KeyError(f"{word!r} not in the fitted vocabulary")
+        q = mat[index[word]]
+        norms = np.linalg.norm(mat, axis=1) * np.linalg.norm(q)
+        sims = mat @ q / np.maximum(norms, 1e-12)
+        sims[index[word]] = -np.inf
+        vocab = self.get("vocabulary")
+        top = np.argsort(-sims)[:num]
+        return [(vocab[i], float(sims[i])) for i in top]
+
+    def _transform(self, df):
+        index, mat = self._vectors()
+        D = mat.shape[1]
+        out = np.zeros((df.num_rows, D), np.float32)
+        for r, doc in enumerate(df[self.getInputCol()]):
+            ids = [index[str(w)] for w in (doc or [])
+                   if str(w) in index]
+            if ids:
+                out[r] = mat[ids].mean(axis=0)
+        return df.with_column(self.getOutputCol(), out)

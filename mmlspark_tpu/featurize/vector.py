@@ -1,0 +1,120 @@
+"""Vector assembly and one-hot encoding stages.
+
+Reference: the SparkML ``VectorAssembler``/``OneHotEncoder`` surface the
+ecosystem leans on (tested at
+``core/schema/VerifyFastVectorAssembler.scala`` and
+``core/ml/OneHotEncoderSpec.scala``; ``Featurize`` composes the same
+operations internally, ``featurize/Featurize.scala:36``). Standalone
+stages so user pipelines can assemble/encode without the full
+auto-featurizer — the TPU design keeps them host-side numpy: both are
+data-plumbing (concatenation, indexing), not compute.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from ..core import Estimator, Model, Transformer, Param, \
+    TypeConverters as TC
+from ..core.contracts import HasInputCol, HasInputCols, HasOutputCol
+
+
+def _as_matrix(arr, n: int, col: str) -> np.ndarray:
+    """One column → [n, w] float32 (scalars become w=1)."""
+    if arr.dtype == object:
+        try:
+            return np.stack([np.asarray(v, np.float32).ravel()
+                             for v in arr])
+        except ValueError as e:
+            raise ValueError(
+                f"column {col!r} has ragged/non-numeric vector rows: "
+                f"{e}") from e
+    if arr.ndim == 1:
+        return np.asarray(arr, np.float32).reshape(n, 1)
+    return np.asarray(arr, np.float32).reshape(n, -1)
+
+
+class VectorAssembler(Transformer, HasInputCols, HasOutputCol):
+    """Concatenate numeric scalar/vector columns into one vector column.
+
+    ``handleInvalid``: "error" raises on NaN, "keep" propagates NaN,
+    "skip" drops invalid rows (the SparkML contract).
+    """
+
+    handleInvalid = Param("handleInvalid", "error|keep|skip on NaN rows",
+                          TC.toString, default="error", has_default=True)
+
+    def __init__(self, **kwargs):
+        super().__init__(**kwargs)
+        self._setDefault(outputCol="features")
+
+    def _transform(self, df):
+        n = df.num_rows
+        blocks = [_as_matrix(df[c], n, c) for c in self.getInputCols()]
+        mat = np.concatenate(blocks, axis=1) if blocks else \
+            np.zeros((n, 0), np.float32)
+        bad = np.isnan(mat).any(axis=1)
+        mode = self.get("handleInvalid")
+        if bad.any():
+            if mode == "error":
+                raise ValueError(
+                    f"{int(bad.sum())} rows contain NaN; set "
+                    "handleInvalid='keep' or 'skip'")
+            if mode == "skip":
+                df = df.take(np.flatnonzero(~bad))
+                mat = mat[~bad]
+        return df.with_column(self.getOutputCol(), mat)
+
+
+class OneHotEncoder(Estimator, HasInputCol, HasOutputCol):
+    """Category indices → one-hot vectors (SparkML semantics:
+    ``dropLast=True`` encodes the last category as the all-zeros
+    vector, keeping the encoding linearly independent)."""
+
+    dropLast = Param("dropLast", "last category encodes as all-zeros",
+                     TC.toBoolean, default=True, has_default=True)
+    handleInvalid = Param("handleInvalid",
+                          "error|keep for out-of-range indices at "
+                          "transform ('keep' adds a catch-all slot)",
+                          TC.toString, default="error", has_default=True)
+
+    def _fit(self, df):
+        idx = np.asarray(df[self.getInputCol()])
+        if idx.dtype.kind not in "iuf":
+            raise TypeError("OneHotEncoder expects numeric category "
+                            f"indices, got dtype {idx.dtype}")
+        if idx.size and (idx < 0).any():
+            raise ValueError("category indices must be non-negative")
+        size = int(idx.max()) + 1 if idx.size else 0
+        model = OneHotEncoderModel().set("categorySize", size)
+        self._copy_params_to(model)
+        return model
+
+
+class OneHotEncoderModel(Model, HasInputCol, HasOutputCol):
+    categorySize = Param("categorySize", "number of fitted categories",
+                         TC.toInt)
+    dropLast = Param("dropLast", "last category encodes as all-zeros",
+                     TC.toBoolean, default=True, has_default=True)
+    handleInvalid = Param("handleInvalid",
+                          "error|keep for out-of-range indices",
+                          TC.toString, default="error", has_default=True)
+
+    def _transform(self, df):
+        size = self.get("categorySize")
+        drop = self.get("dropLast")
+        keep_invalid = self.get("handleInvalid") == "keep"
+        idx = np.asarray(df[self.getInputCol()]).astype(np.int64)
+        width = size + (1 if keep_invalid else 0)
+        oob = (idx < 0) | (idx >= size)
+        if oob.any():
+            if not keep_invalid:
+                raise ValueError(
+                    f"{int(oob.sum())} indices outside the fitted "
+                    f"[0, {size}) range; set handleInvalid='keep'")
+            idx = np.where(oob, size, idx)  # catch-all slot
+        out_width = width - (1 if drop else 0)
+        mat = np.zeros((len(idx), max(out_width, 0)), np.float32)
+        valid = idx < out_width
+        mat[np.flatnonzero(valid), idx[valid]] = 1.0
+        return df.with_column(self.getOutputCol(), mat)

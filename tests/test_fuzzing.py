@@ -29,7 +29,9 @@ def _str_col(values):
 def make_test_objects() -> dict[str, TestObject]:
     """TestObjects keyed by stage class name (reference testObjects())."""
     from mmlspark_tpu.featurize import (CleanMissingData, CountSelector,
-                                        Featurize, ValueIndexer)
+                                        Featurize, OneHotEncoder,
+                                        ValueIndexer, VectorAssembler,
+                                        Word2Vec)
     from mmlspark_tpu.featurize.text import (HashingTF, IDF, MultiNGram,
                                              PageSplitter,
                                              StopWordsRemover,
@@ -173,6 +175,15 @@ def make_test_objects() -> dict[str, TestObject]:
         TestObject(ComputeModelStatistics(labelCol="label"), scored_df),
         TestObject(ComputePerInstanceStatistics(labelCol="label"),
                    scored_df),
+        TestObject(VectorAssembler(inputCols=["features", "label"]),
+                   num),
+        TestObject(OneHotEncoder(inputCol="idx", outputCol="oh"),
+                   DataFrame({"idx": np.arange(12) % 3})),
+        TestObject(Word2Vec(inputCol="words", outputCol="emb",
+                            vectorSize=8, minCount=1, maxIter=1,
+                            batchSize=64),
+                   DataFrame({"words": _str_col(
+                       [["a", "b", "c"], ["b", "c", "d"]] * 4)})),
     ]
     return {type(o.stage).__name__: o for o in objs}
 

@@ -1,0 +1,148 @@
+"""VectorAssembler / OneHotEncoder / Word2Vec — the core-ml stage
+surface the reference tests at ``core/ml/{Word2VecSpec,
+OneHotEncoderSpec}.scala`` and
+``core/schema/VerifyFastVectorAssembler.scala``."""
+
+import numpy as np
+import pytest
+
+from mmlspark_tpu.core import DataFrame, load_stage
+from mmlspark_tpu.featurize import (OneHotEncoder, VectorAssembler,
+                                    Word2Vec)
+
+
+def _obj_col(values):
+    col = np.empty(len(values), object)
+    col[:] = values
+    return col
+
+
+class TestVectorAssembler:
+    def test_concatenates_scalars_and_vectors(self):
+        df = DataFrame({
+            "a": np.asarray([1.0, 2.0], np.float32),
+            "b": np.asarray([[10.0, 11.0], [20.0, 21.0]], np.float32),
+            "c": np.asarray([5, 6], np.int64),
+        })
+        out = VectorAssembler(inputCols=["a", "b", "c"]).transform(df)
+        np.testing.assert_allclose(
+            out["features"],
+            [[1, 10, 11, 5], [2, 20, 21, 6]])
+
+    def test_object_vector_rows(self):
+        df = DataFrame({"v": _obj_col([[1.0, 2.0], [3.0, 4.0]])})
+        out = VectorAssembler(inputCols=["v"]).transform(df)
+        np.testing.assert_allclose(out["features"], [[1, 2], [3, 4]])
+
+    def test_handle_invalid_modes(self):
+        df = DataFrame({"a": np.asarray([1.0, np.nan, 3.0])})
+        with pytest.raises(ValueError, match="NaN"):
+            VectorAssembler(inputCols=["a"]).transform(df)
+        kept = VectorAssembler(inputCols=["a"], handleInvalid="keep") \
+            .transform(df)
+        assert np.isnan(kept["features"][1, 0])
+        skipped = VectorAssembler(inputCols=["a"], handleInvalid="skip") \
+            .transform(df)
+        np.testing.assert_allclose(skipped["features"], [[1.0], [3.0]])
+        assert skipped.num_rows == 2
+
+
+class TestOneHotEncoder:
+    def test_drop_last_semantics(self):
+        df = DataFrame({"idx": np.asarray([0, 1, 2, 1])})
+        model = OneHotEncoder(inputCol="idx", outputCol="oh").fit(df)
+        out = model.transform(df)["oh"]
+        # dropLast: category 2 (the max) is the all-zeros vector
+        np.testing.assert_allclose(
+            out, [[1, 0], [0, 1], [0, 0], [0, 1]])
+
+    def test_keep_all_and_invalid(self):
+        df = DataFrame({"idx": np.asarray([0, 1])})
+        model = OneHotEncoder(inputCol="idx", outputCol="oh",
+                              dropLast=False).fit(df)
+        np.testing.assert_allclose(model.transform(df)["oh"],
+                                   [[1, 0], [0, 1]])
+        unseen = DataFrame({"idx": np.asarray([5])})
+        with pytest.raises(ValueError, match="outside"):
+            model.transform(unseen)
+        keep = OneHotEncoder(inputCol="idx", outputCol="oh",
+                             dropLast=False,
+                             handleInvalid="keep").fit(df)
+        np.testing.assert_allclose(keep.transform(unseen)["oh"],
+                                   [[0, 0, 1]])
+
+    def test_save_load(self, tmp_path):
+        df = DataFrame({"idx": np.asarray([0, 1, 2])})
+        model = OneHotEncoder(inputCol="idx", outputCol="oh").fit(df)
+        model.save(str(tmp_path / "ohe"))
+        again = load_stage(str(tmp_path / "ohe"))
+        np.testing.assert_allclose(again.transform(df)["oh"],
+                                   model.transform(df)["oh"])
+
+
+@pytest.fixture(scope="module")
+def corpus():
+    # two co-occurrence clusters: fruit words and vehicle words never
+    # share a document, so skip-gram must separate them
+    fruit = ["apple", "banana", "cherry", "mango"]
+    cars = ["car", "truck", "wheel", "engine"]
+    rng = np.random.default_rng(0)
+    docs = []
+    for _ in range(120):
+        pool = fruit if rng.random() < 0.5 else cars
+        docs.append(list(rng.choice(pool, size=6)))
+    return DataFrame({"tokens": _obj_col(docs)})
+
+
+class TestWord2Vec:
+    def test_clusters_separate(self, corpus):
+        model = Word2Vec(inputCol="tokens", vectorSize=16, minCount=1,
+                         windowSize=3, maxIter=20, stepSize=0.1,
+                         batchSize=256, seed=1).fit(corpus)
+        vecs = model.getVectors()
+
+        def cos(a, b):
+            return float(np.dot(vecs[a], vecs[b])
+                         / (np.linalg.norm(vecs[a])
+                            * np.linalg.norm(vecs[b]) + 1e-12))
+
+        within = cos("apple", "banana")
+        across = cos("apple", "truck")
+        assert within > across + 0.2, (within, across)
+
+    def test_find_synonyms(self, corpus):
+        model = Word2Vec(inputCol="tokens", vectorSize=16, minCount=1,
+                         windowSize=3, maxIter=20, stepSize=0.1,
+                         batchSize=256, seed=1).fit(corpus)
+        syns = [w for w, _ in model.findSynonyms("car", 3)]
+        assert set(syns) <= {"truck", "wheel", "engine"}, syns
+
+    def test_transform_averages_and_handles_oov(self, corpus):
+        model = Word2Vec(inputCol="tokens", vectorSize=8, minCount=1,
+                         maxIter=1).fit(corpus)
+        docs = _obj_col([["apple", "banana"], ["apple", "zzz-oov"], []])
+        out = model.transform(DataFrame({"tokens": docs}))["features"]
+        assert out.shape == (3, 8)
+        vecs = model.getVectors()
+        np.testing.assert_allclose(
+            out[0], (vecs["apple"] + vecs["banana"]) / 2, atol=1e-6)
+        np.testing.assert_allclose(out[1], vecs["apple"], atol=1e-6)
+        np.testing.assert_allclose(out[2], 0.0)
+
+    def test_min_count_filters(self, corpus):
+        model = Word2Vec(inputCol="tokens", vectorSize=8, minCount=1,
+                         maxIter=1).fit(corpus)
+        assert len(model.get("vocabulary")) == 8
+        with pytest.raises(ValueError, match="empty vocabulary"):
+            Word2Vec(inputCol="tokens", minCount=10**9).fit(corpus)
+
+    def test_save_load_roundtrip(self, tmp_path, corpus):
+        model = Word2Vec(inputCol="tokens", vectorSize=8, minCount=1,
+                         maxIter=1).fit(corpus)
+        model.save(str(tmp_path / "w2v"))
+        again = load_stage(str(tmp_path / "w2v"))
+        docs = _obj_col([["apple", "car"]])
+        df = DataFrame({"tokens": docs})
+        np.testing.assert_allclose(again.transform(df)["features"],
+                                   model.transform(df)["features"],
+                                   atol=1e-6)
