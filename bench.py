@@ -330,8 +330,16 @@ def make_bench_encoder(impl: str):
         module = TextEncoder(vocab=32768, width=W, depth=depth, heads=8,
                              mlp_dim=mlp,
                              attention_fn=make_attention_fn(impl))
+        # init traces the forward: do it with the dense attention_fn
+        # (attention has no params, so the variables are identical) —
+        # tracing the Pallas kernel under a CPU default_device would
+        # either fail to lower or crawl through the interpreter
+        init_module = TextEncoder(vocab=32768, width=W, depth=depth,
+                                  heads=8, mlp_dim=mlp,
+                                  attention_fn=make_attention_fn("dense"))
         with jax.default_device(jax.local_devices(backend="cpu")[0]):
-            variables = module.init(jax.random.PRNGKey(0), ids0, False)
+            variables = init_module.init(jax.random.PRNGKey(0), ids0,
+                                         False)
         (ips, mfu, batch, _), per_batch = _mfu_sweep(
             module, variables, make_input, (8, 16, 32), iters=10,
             fallback_flops_per_item=float(flops_per_seq),

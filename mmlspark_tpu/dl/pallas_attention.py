@@ -36,6 +36,8 @@ import jax.numpy as jnp
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
+from ..utils.platform import target_platform  # noqa: F401 (re-export)
+
 _NEG = -1e30  # additive mask value; -inf breaks the running-max algebra
 
 
@@ -160,7 +162,7 @@ def flash_attention(q, k, v, key_mask=None, *, block_q: int = 256,
     tests only); the XLA ``blockwise`` impl is the right CPU choice.
     """
     if interpret is None:
-        interpret = jax.default_backend() not in ("tpu", "axon")
+        interpret = target_platform() not in ("tpu", "axon")
     if key_mask is None:
         key_mask = jnp.ones((q.shape[0], q.shape[2]), bool)
     return _flash(q, k, v, key_mask, block_q, block_k, bool(interpret))

@@ -120,8 +120,17 @@ class Word2Vec(Estimator, HasInputCol, HasOutputCol):
         import jax
         import jax.numpy as jnp
 
+        raw_docs = df[self.getInputCol()]
+        if any(isinstance(d, str) for d in raw_docs):
+            # a str is an iterable of CHARACTERS — training on it would
+            # silently fit character embeddings (SparkML's Word2Vec
+            # rejects non-Array[String] columns at the schema level)
+            raise TypeError(
+                f"inputCol {self.getInputCol()!r} holds plain strings; "
+                "Word2Vec expects token lists — split first (e.g. "
+                "TextFeaturizer / s.split())")
         docs = [list(map(str, d)) if d is not None else []
-                for d in df[self.getInputCol()]]
+                for d in raw_docs]
         counts = Counter(w for d in docs for w in d)
         vocab = sorted(w for w, c in counts.items()
                        if c >= self.get("minCount"))
@@ -177,9 +186,18 @@ class Word2VecModel(Model, HasInputCol, HasOutputCol):
     wordVectors = Param("wordVectors", "[V, D] embedding rows")
 
     def _vectors(self) -> tuple[dict[str, int], np.ndarray]:
+        # wordVectors persists as a nested list (JSON-serializable); the
+        # O(V·D) list→array parse is cached by identity so repeated
+        # transform/findSynonyms calls pay it once, not per call
         vocab = self.get("vocabulary")
-        mat = np.asarray(self.get("wordVectors"), np.float32)
-        return {w: i for i, w in enumerate(vocab)}, mat
+        raw = self.get("wordVectors")
+        cached = getattr(self, "_vec_cache", None)
+        if cached is not None and cached[0] is raw and cached[1] is vocab:
+            return cached[2], cached[3]
+        mat = np.asarray(raw, np.float32)
+        index = {w: i for i, w in enumerate(vocab)}
+        self._vec_cache = (raw, vocab, index, mat)
+        return index, mat
 
     def getVectors(self) -> dict[str, np.ndarray]:
         index, mat = self._vectors()

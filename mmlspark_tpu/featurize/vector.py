@@ -55,6 +55,9 @@ class VectorAssembler(Transformer, HasInputCols, HasOutputCol):
             np.zeros((n, 0), np.float32)
         bad = np.isnan(mat).any(axis=1)
         mode = self.get("handleInvalid")
+        if mode not in ("error", "keep", "skip"):
+            raise ValueError(
+                f"handleInvalid={mode!r} is not one of error|keep|skip")
         if bad.any():
             if mode == "error":
                 raise ValueError(

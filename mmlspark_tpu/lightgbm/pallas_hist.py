@@ -121,8 +121,11 @@ def hist_pallas(bins: jnp.ndarray, vals: jnp.ndarray, *, num_bins: int,
 
 
 def use_pallas_hist() -> bool:
-    """TPU only — the scatter path wins on CPU."""
+    """TPU only — the scatter path wins on CPU. Honours an active
+    ``jax.default_device(...)`` CPU pin (compiled Pallas cannot lower for
+    a CPU placement)."""
     try:
-        return jax.default_backend() in ("tpu", "axon")
+        from ..utils.platform import target_platform
+        return target_platform() in ("tpu", "axon")
     except Exception:
         return False

@@ -20,7 +20,8 @@ from __future__ import annotations
 
 import dataclasses
 import functools
-from typing import Callable
+from collections import OrderedDict
+from typing import Callable, NamedTuple
 
 import jax
 import jax.numpy as jnp
@@ -263,6 +264,185 @@ def make_grower(*, mesh, mesh_axis: str | None, tp: TreeParams,
         in_specs=(*data_specs, gh_spec, gh_spec, P(), P(mesh_axis)),
         out_specs=(P(), gh_spec), check_vma=False)
     return lambda g2, h2, fm, rm: mapped(*data, g2, h2, fm, rm)
+
+
+def _goss_row_select(key, *, top_n: int, other_n: int, amplify: float):
+    """Row-selection hook for GOSS — one body for both fused builders."""
+    def row_select(g, rm, it_dev):
+        gmag = jnp.abs(g) if g.ndim == 1 else jnp.linalg.norm(g, axis=1)
+        return _goss_mask(gmag, rm, jax.random.fold_in(key, it_dev),
+                          top_n=top_n, other_n=other_n, amplify=amplify)
+    return row_select
+
+
+def _identity_row_select(g, rm, it_dev):
+    return rm
+
+
+def _chunk_scan(step_fn):
+    """k boosting iterations as ONE dispatch: lax.scan over the fused
+    step. Used only when nothing observes per-iteration state (no eval,
+    no delegate) — a remote device pays a full round trip per dispatch,
+    so chunking divides that cost by the chunk length. One body for both
+    fused builders."""
+    def chunk(scores, vscores, fms, rms, its):
+        def body(carry, xs):
+            sc, vs = carry
+            fm, rm, it_d = xs
+            new_sc, new_vs, tree_b = step_fn(sc, vs, fm, rm, it_d)
+            return (new_sc, new_vs), tree_b
+        (sc, vs), tree_stack = jax.lax.scan(body, (scores, vscores),
+                                            (fms, rms, its))
+        return sc, vs, tree_stack
+    return chunk
+
+
+def _fused_step_math(scores, vscores, fm, rm, it_dev, *, base, gh_fn,
+                     row_select, grow_one, routed_vdelta, is_rf: bool,
+                     K: int, has_valid: bool):
+    """THE fused boosting-iteration math — gradients → row selection →
+    growth → train/valid score updates — shared verbatim by the
+    cross-fit-cached builder (``_build_fused``) and the per-fit closure
+    builder (``make_fused_step``), so the two paths cannot drift.
+
+    ``base``: init score (scalar for K==1, [K] otherwise); ``gh_fn(s)``
+    → (grad, hess); ``row_select(g, rm, it)`` → effective row mask
+    (GOSS sampling or identity); ``grow_one(g, h, fm, rm)`` → ([K,…]
+    Tree stack, [K, n] train deltas); ``routed_vdelta(tree_b)`` → [K, nv]
+    valid deltas."""
+    # rf: gradients always at the constant init score (trees are
+    # independent); gbdt/goss: at the running margin
+    sfg = (jnp.zeros_like(scores) + base) if is_rf else scores
+    g, h = gh_fn(sfg)
+    rm2 = row_select(g, rm, it_dev)
+    tree_b, delta_b = grow_one(g, h, fm, rm2)
+    d = delta_b[0] if K == 1 else delta_b.T
+    if is_rf:
+        # running average of tree outputs around the init score:
+        # scores = base + prev + (d - prev)/m with m = it + 1
+        m = (it_dev + 1).astype(jnp.float32)
+        new_scores = scores + (d - (scores - base)) / m
+    else:
+        new_scores = scores + d
+    if has_valid:
+        vd_b = routed_vdelta(tree_b)
+        vd = vd_b[0] if K == 1 else vd_b.T
+        if is_rf:
+            m = (it_dev + 1).astype(jnp.float32)
+            new_vscores = vscores + (vd - (vscores - base)) / m
+        else:
+            new_vscores = vscores + vd
+    else:
+        new_vscores = vscores
+    return new_scores, new_vscores, tree_b
+
+
+class _FusedStatics(NamedTuple):
+    """Everything that shapes the fused boosting step's trace, as a
+    hashable cross-fit cache key. Arrays ride the ``data`` pytree argument
+    instead — a cached trace must never bake one fit's data in as
+    constants, or the next same-shape fit would silently train on stale
+    labels. Over-keying is safe (an extra cache entry); under-keying is
+    not, so every config field the trace can see is here."""
+    obj_key: tuple          # get_objective kwargs, incl. derived pos_weight
+    tp: TreeParams          # growth statics (leaves, bins, reg, cats, …)
+    boosting: str           # gbdt | goss | rf
+    K: int
+    n: int
+    F: int
+    sparse: bool
+    num_bins: int           # sparse bin count (0 on the dense path)
+    has_valid: bool
+    top_n: int              # goss statics (0/0/1.0 otherwise)
+    other_n: int
+    amplify: float
+
+
+# LRU of (step, chunk_step) jitted callables. Re-jitting per fit retraces
+# AND recompiles the whole fused program — ~4 s on a host CPU and tens of
+# seconds through a remote-device tunnel, paid by every fit in an AutoML
+# sweep or CV fold. Bounded: each entry pins compiled executables.
+_FUSED_CACHE: OrderedDict = OrderedDict()
+_FUSED_CACHE_MAX = 16
+
+
+def _build_fused(st: _FusedStatics):
+    """(step, chunk_step) for one static configuration; both take the
+    per-fit arrays as a leading ``data`` pytree. Bodies mirror the
+    closure-based ``make_fused_step`` (kept for the delegate/fobj/mesh
+    paths) — the math must stay identical between the two."""
+    name, num_class, alpha, fair_c, tvp, sigmoid, pos_weight, bfa = \
+        st.obj_key
+    obj = get_objective(name, num_class=num_class, alpha=alpha,
+                        fair_c=fair_c, tweedie_variance_power=tvp,
+                        sigmoid=sigmoid, pos_weight=pos_weight,
+                        boost_from_average=bfa)
+    is_rf = st.boosting == "rf"
+    is_goss = st.boosting == "goss"
+    arange_k = jnp.arange(st.K)
+
+    def grow_one(data, g, h, fm, rm):
+        if st.sparse:
+            def one(gk, hk):
+                return grow_tree_sparse(
+                    data["si"], data["se"], data["sz"], gk, hk, fm, rm,
+                    params=st.tp, num_features=st.F,
+                    num_bins=st.num_bins, psum_axis=None)
+        else:
+            def one(gk, hk):
+                return grow_tree(data["bins"], gk, hk, fm, rm,
+                                 params=st.tp, num_features=st.F,
+                                 psum_axis=None)
+        if st.K == 1:
+            t1, rl1 = one(g, h)
+            tree_b = jax.tree.map(lambda a: a[None], t1)
+            row_leaf_b = rl1[None]
+        else:
+            tree_b, row_leaf_b = jax.vmap(one)(g.T, h.T)
+        return tree_b, tree_b.leaf_value[arange_k[:, None], row_leaf_b]
+
+    def routed_vdelta(data, tree_b):
+        if st.sparse:
+            vleaf = jax.vmap(lambda t: sparse_route_bins(
+                t, data["vi"], data["ve"], data["vz"],
+                max_depth=st.tp.num_leaves))(tree_b)
+        else:
+            vleaf = jax.vmap(lambda t: tree_route_bins(
+                t, data["vb"], max_depth=st.tp.num_leaves))(tree_b)
+        return tree_b.leaf_value[arange_k[:, None], vleaf]
+
+    def step_impl(data, scores, vscores, fm, rm, it_dev):
+        return _fused_step_math(
+            scores, vscores, fm, rm, it_dev, base=data["base"],
+            gh_fn=lambda s: obj.grad_hess(s, data["y"], data["w"]),
+            row_select=_goss_row_select(
+                data["gkey"], top_n=st.top_n, other_n=st.other_n,
+                amplify=st.amplify) if is_goss else _identity_row_select,
+            grow_one=lambda g, h, fm2, rm2: grow_one(data, g, h, fm2,
+                                                     rm2),
+            routed_vdelta=lambda tb: routed_vdelta(data, tb),
+            is_rf=is_rf, K=st.K, has_valid=st.has_valid)
+
+    step = jax.jit(step_impl)
+
+    @jax.jit
+    def chunk_step(data, scores, vscores, fms, rms, its):
+        return _chunk_scan(functools.partial(step_impl, data))(
+            scores, vscores, fms, rms, its)
+
+    return step, chunk_step
+
+
+def _fused_cached(st: _FusedStatics):
+    fns = _FUSED_CACHE.get(st)
+    if fns is None:
+        fns = _build_fused(st)
+        _FUSED_CACHE[st] = fns
+        while len(_FUSED_CACHE) > _FUSED_CACHE_MAX:
+            _FUSED_CACHE.popitem(last=False)
+    else:
+        _FUSED_CACHE.move_to_end(st)
+    return fns
 
 
 def train(x: np.ndarray, y: np.ndarray, w: np.ndarray | None,
@@ -605,61 +785,20 @@ def train(x: np.ndarray, y: np.ndarray, w: np.ndarray | None,
             amplify=(1.0 - cfg.top_rate) / max(cfg.other_rate, 1e-12)) \
             if is_goss else None
 
+        row_select = _goss_row_select(goss_key, **goss_kw) if is_goss \
+            else _identity_row_select
+
         def step_impl(scores, vscores, feat_mask_dev, row_mask_dev,
                       it_dev):
-            # rf: gradients always at the constant init score (trees are
-            # independent); gbdt/goss: at the running margin
-            sfg = (jnp.zeros_like(scores) + base_const) if is_rf \
-                else scores
-            g, h = gh_fn(sfg, y_dev, w_dev)
-            if is_goss:
-                gmag = jnp.abs(g) if g.ndim == 1 \
-                    else jnp.linalg.norm(g, axis=1)
-                rm = _goss_mask(gmag, row_mask_dev,
-                                jax.random.fold_in(goss_key, it_dev),
-                                **goss_kw)
-            else:
-                rm = row_mask_dev
-            tree_b, delta_b = grow_one(g, h, feat_mask_dev, rm)
-            d = delta_b[0] if K == 1 else delta_b.T
-            if is_rf:
-                # running average of tree outputs around the init score:
-                # scores = base + prev + (d - prev)/m with m = it + 1
-                m = (it_dev + 1).astype(jnp.float32)
-                new_scores = scores + (d - (scores - base_const)) / m
-            else:
-                new_scores = scores + d
-            if valid is not None:
-                vdelta_b = routed_vdelta(tree_b)
-                vd = vdelta_b[0] if K == 1 else vdelta_b.T
-                if is_rf:
-                    m = (it_dev + 1).astype(jnp.float32)
-                    new_vscores = vscores + (vd - (vscores
-                                                   - base_const)) / m
-                else:
-                    new_vscores = vscores + vd
-            else:
-                new_vscores = vscores
-            return new_scores, new_vscores, tree_b
+            return _fused_step_math(
+                scores, vscores, feat_mask_dev, row_mask_dev, it_dev,
+                base=base_const, gh_fn=lambda s: gh_fn(s, y_dev, w_dev),
+                row_select=row_select, grow_one=grow_one,
+                routed_vdelta=routed_vdelta, is_rf=is_rf, K=K,
+                has_valid=valid is not None)
 
         step = jax.jit(step_impl)
-
-        @jax.jit
-        def chunk_step(scores, vscores, feat_masks, row_masks, its):
-            """k boosting iterations as ONE dispatch: lax.scan over the
-            fused step. Used only when nothing observes per-iteration
-            state (no eval, no delegate) — a remote device pays a full
-            round trip per dispatch, so chunking divides that cost by
-            the chunk length."""
-            def body(carry, xs):
-                sc, vs = carry
-                fm, rm, it_d = xs
-                new_sc, new_vs, tree_b = step_impl(sc, vs, fm, rm, it_d)
-                return (new_sc, new_vs), tree_b
-            (sc, vs), tree_stack = jax.lax.scan(
-                body, (scores, vscores), (feat_masks, row_masks, its))
-            return sc, vs, tree_stack
-
+        chunk_step = jax.jit(_chunk_scan(step_impl))
         return step, chunk_step
 
     # ---- device-side DART (docs/limitations.md r2 gap): per-tree train/
@@ -793,7 +932,51 @@ def train(x: np.ndarray, y: np.ndarray, w: np.ndarray | None,
     dart_fused = is_dart and cfg.dart_mode != "stepwise"
     use_fused = not is_dart  # gbdt/goss/rf single-dispatch path
     fused_step = chunk_step = None
-    if use_fused:
+    # cross-fit trace reuse: the common path (single-chip, built-in
+    # objective, no delegate) takes jitted callables from a module-level
+    # LRU keyed by statics, with per-fit arrays threaded as arguments —
+    # so a CV fold / AutoML sweep / repeat fit skips retrace+recompile.
+    # Delegate LR schedules mutate tp mid-loop, custom fobj/ranker
+    # gradients close over user state, and mesh paths shard_map over
+    # placed data: those keep the per-fit closure builder.
+    fused_cacheable = (use_fused and mesh is None and delegate is None
+                       and grad_hess_override is None and cfg.fobj is None)
+    if fused_cacheable:
+        goss_kw_c = dict(
+            top_n=int(cfg.top_rate * n_real),
+            other_n=int(cfg.other_rate * n_real),
+            amplify=(1.0 - cfg.top_rate) / max(cfg.other_rate, 1e-12)) \
+            if is_goss else dict(top_n=0, other_n=0, amplify=1.0)
+        st_key = _FusedStatics(
+            obj_key=(cfg.objective, cfg.num_class, cfg.alpha, cfg.fair_c,
+                     cfg.tweedie_variance_power, cfg.sigmoid,
+                     float(pos_weight), cfg.boost_from_average),
+            tp=tp, boosting=cfg.boosting_type, K=K, n=n, F=F,
+            sparse=sparse, num_bins=(B_s if sparse else 0),
+            has_valid=valid is not None, **goss_kw_c)
+        raw_step, raw_chunk = _fused_cached(st_key)
+        base_arr_c = np.asarray(base_score, np.float32).reshape(-1)
+        fdata = {"y": y_dev, "w": w_dev, "gkey": goss_key,
+                 "base": jnp.float32(base_arr_c[0]) if K == 1
+                 else jnp.asarray(base_arr_c)}
+        if sparse:
+            fdata.update(si=binned.indices, se=binned.ebins,
+                         sz=binned.zero_bin)
+        else:
+            fdata["bins"] = bins
+        if valid is not None:
+            if sparse:
+                fdata.update(vi=vbinned.indices, ve=vbinned.ebins,
+                             vz=vbinned.zero_bin)
+            else:
+                fdata["vb"] = vbins
+
+        def fused_step(s, vs, fm, rm, it):
+            return raw_step(fdata, s, vs, fm, rm, it)
+
+        def chunk_step(s, vs, fms, rms, its):
+            return raw_chunk(fdata, s, vs, fms, rms, its)
+    elif use_fused:
         fused_step, chunk_step = make_fused_step()
     dart_step = dart_chunk_step = None
     if dart_fused:

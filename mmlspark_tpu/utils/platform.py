@@ -1,0 +1,27 @@
+"""Dependency-light platform detection (jax-only imports).
+
+Lives outside the flax/optax-coupled ``dl`` package so engine code
+(e.g. the LightGBM Pallas histogram gate) can import it without pulling
+the whole DL stack — or failing on minimal installs that lack flax.
+"""
+
+from __future__ import annotations
+
+import jax
+
+
+def target_platform() -> str:
+    """Platform uncommitted computations will land on: honours an active
+    ``jax.default_device(...)`` context (e.g. a host-CPU ``module.init``
+    on a TPU-attached process) before falling back to the default
+    backend. Compiled Pallas must not lower for a CPU placement."""
+    dev = jax.config.jax_default_device
+    if isinstance(dev, str):       # jax accepts platform-name strings too
+        return dev
+    platform = getattr(dev, "platform", None)
+    if platform is not None:
+        return platform
+    try:
+        return jax.default_backend()
+    except Exception:  # pragma: no cover - no backend at all
+        return "cpu"

@@ -632,3 +632,43 @@ class TestLongTailParams:
         raw_rf = np.asarray(rf.booster.raw_scores(x[:40]))
         np.testing.assert_allclose(shap_rf.sum(-1), raw_rf, rtol=1e-3,
                                    atol=1e-3)
+
+
+class TestFusedTraceCache:
+    """The cross-fit trace cache must reuse compiled steps across
+    same-shape fits WITHOUT baking the previous fit's data in as
+    constants (the classic stale-capture bug of cached jitted
+    closures)."""
+
+    def _mkdata(self, seed, signal_col):
+        rng = np.random.default_rng(seed)
+        x = rng.normal(size=(1500, 10)).astype(np.float32)
+        y = (x[:, signal_col] > 0).astype(np.float32)
+        return DataFrame({"features": x, "label": y}), x, y
+
+    def test_refit_hits_cache_and_sees_new_data(self):
+        from mmlspark_tpu.lightgbm import trainer as trainer_mod
+        trainer_mod._FUSED_CACHE.clear()
+        df_a, _, _ = self._mkdata(0, signal_col=0)
+        df_b, xb, yb = self._mkdata(1, signal_col=7)
+        kw = dict(numIterations=15, numLeaves=15, learningRate=0.3)
+        LightGBMClassifier(**kw).fit(df_a)
+        assert len(trainer_mod._FUSED_CACHE) == 1
+        model_b = LightGBMClassifier(**kw).fit(df_b)
+        # same statics -> same entry reused, not a second compile
+        assert len(trainer_mod._FUSED_CACHE) == 1
+        # had fit B reused fit A's baked labels/features, accuracy on
+        # B's signal (feature 7, unrelated to A's feature 0) would be
+        # near chance
+        pred = model_b.transform(df_b)["prediction"]
+        acc = float((np.asarray(pred) == yb).mean())
+        assert acc > 0.9, acc
+
+    def test_different_objective_gets_its_own_entry(self):
+        from mmlspark_tpu.lightgbm import trainer as trainer_mod
+        from mmlspark_tpu.lightgbm import LightGBMRegressor
+        trainer_mod._FUSED_CACHE.clear()
+        df, _, _ = self._mkdata(2, signal_col=3)
+        LightGBMClassifier(numIterations=5).fit(df)
+        LightGBMRegressor(numIterations=5).fit(df)
+        assert len(trainer_mod._FUSED_CACHE) == 2
