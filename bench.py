@@ -228,6 +228,20 @@ def bench_resnet(extras: dict) -> float:
         feat.transform(df)
         extras["featurizer_e2e_images_per_sec"] = round(
             n_img / (time.perf_counter() - t0), 1)
+        # realistic ingest: decoded JPEGs are uint8 — the wire keeps
+        # them uint8 (4x fewer host->device bytes than f32), so this is
+        # the number a real image pipeline sees
+        imgs_u8 = (imgs - imgs.min()) / (np.ptp(imgs) + 1e-6)
+        df_u8 = DataFrame(
+            {"image": (imgs_u8 * 255).astype(np.uint8)})
+        feat_u8 = ImageFeaturizer(model=loaded, cutOutputLayers=1,
+                                  inputCol="image", outputCol="features",
+                                  autoResize=False, miniBatchSize=128)
+        feat_u8.transform(df_u8)  # warm
+        t0 = time.perf_counter()
+        feat_u8.transform(df_u8)
+        extras["featurizer_e2e_u8_images_per_sec"] = round(
+            n_img / (time.perf_counter() - t0), 1)
     except Exception:
         extras["error_featurizer"] = traceback.format_exc()[-800:]
     return per_batch.get(128, ips)
@@ -249,28 +263,58 @@ def bench_train(extras: dict) -> None:
         "ResNet50", num_classes=100, allow_random_init=True)
     tx = optax.sgd(1e-2, momentum=0.9)
     rng = np.random.default_rng(3)
-    batch = int(os.environ.get("MMLSPARK_TPU_BENCH_TRAIN_BATCH", 128))
-    x = jnp.asarray(rng.normal(size=(batch, 224, 224, 3)), jnp.float32)
-    y = jnp.asarray(rng.integers(0, 100, size=batch), jnp.int32)
-    state = init_train_state(loaded.module, jax.random.PRNGKey(0),
-                             np.zeros((1, 224, 224, 3), np.float32), tx)
+    raw = os.environ.get("MMLSPARK_TPU_BENCH_TRAIN_BATCHES", "128,256")
+    try:
+        batches = tuple(int(b) for b in raw.split(",") if b.strip())
+        assert batches
+    except (ValueError, AssertionError):
+        batches = (128, 256)
     device = jax.devices()[0]
-    state = jax.device_put(state, device)
-    x, y = jax.device_put((x, y), device)
     step = make_train_step(loaded.module, tx)
-    state, loss = step(state, x, y)      # compile + warm
-    jax.block_until_ready(loss)
+    per_batch: dict[int, float] = {}
     iters = 10
-    t0 = time.perf_counter()
-    for _ in range(iters):
-        state, loss = step(state, x, y)
-    jax.block_until_ready(loss)
-    dt = time.perf_counter() - t0
-    ips = batch * iters / dt
-    extras["train_images_per_sec"] = round(ips, 1)
+    loss = None
+    for batch in batches:
+        try:
+            # fresh state per point: the step donates its input state,
+            # and a larger batch must not inherit a donated-away buffer
+            state = jax.device_put(
+                init_train_state(loaded.module, jax.random.PRNGKey(0),
+                                 np.zeros((1, 224, 224, 3), np.float32),
+                                 tx),
+                device)
+            x = jax.device_put(jnp.asarray(
+                rng.normal(size=(batch, 224, 224, 3)), jnp.float32),
+                device)
+            y = jax.device_put(jnp.asarray(
+                rng.integers(0, 100, size=batch), jnp.int32), device)
+            state, loss = step(state, x, y)      # compile + warm
+            jax.block_until_ready(loss)
+            t0 = time.perf_counter()
+            for _ in range(iters):
+                state, loss = step(state, x, y)
+            jax.block_until_ready(loss)
+            per_batch[batch] = round(batch * iters
+                                     / (time.perf_counter() - t0), 1)
+            assert np.isfinite(float(loss))
+            del state, x, y
+        except Exception:
+            # one failing point (e.g. the largest batch OOMing HBM)
+            # must not discard the measurements already banked
+            extras[f"error_train_batch_{batch}"] = \
+                traceback.format_exc()[-400:]
+    if not per_batch:
+        raise RuntimeError("every train batch size failed")
+    # headline stays the FIRST (=128 by default) point for cross-round
+    # comparability, like bench_resnet; the sweep best rides extras
+    headline = per_batch.get(batches[0], next(iter(per_batch.values())))
+    best_batch = max(per_batch, key=per_batch.get)
+    extras["train_images_per_sec"] = round(headline, 1)
+    extras["train_best_batch"] = best_batch
+    extras["train_best_images_per_sec"] = per_batch[best_batch]
+    extras["train_ips_by_batch"] = per_batch
     extras["train_mfu_est"] = round(
-        ips * 3 * RESNET50_FLOPS_PER_IMAGE / V5E_PEAK_BF16_FLOPS, 4)
-    assert np.isfinite(float(loss))
+        headline * 3 * RESNET50_FLOPS_PER_IMAGE / V5E_PEAK_BF16_FLOPS, 4)
 
 
 def bench_vit(extras: dict) -> None:
@@ -606,9 +650,17 @@ def main():
         extras["error_backend"] = traceback.format_exc()[-1500:]
 
     if "error_backend" not in extras:
+        # ordered by banking priority: the known failure mode is the
+        # tunnel wedging MID-suite, killing whatever is queued late —
+        # headline first, then the trainer numbers, then the sweeps
+        # (serving last: it alone has a cpu-host fallback)
         if want("resnet"):
             images_per_sec = _watchdog(bench_resnet, extras, "resnet",
                                        600.0) or 0.0
+        if want("gbdt"):
+            _watchdog(bench_gbdt, extras, "gbdt", 420.0)
+        if want("ranker"):
+            _watchdog(bench_ranker, extras, "ranker", 420.0)
         if want("train"):
             _watchdog(bench_train, extras, "train", 600.0)
         if want("vit"):
@@ -618,10 +670,6 @@ def main():
                 _watchdog(make_bench_encoder(impl), extras,
                           f"encoder_{impl}", 420.0)
             _finalize_encoder(extras)
-        if want("gbdt"):
-            _watchdog(bench_gbdt, extras, "gbdt", 420.0)
-        if want("ranker"):
-            _watchdog(bench_ranker, extras, "ranker", 420.0)
         if want("serving"):
             _watchdog(bench_serving, extras, "serving", 240.0)
     else:

@@ -23,7 +23,8 @@ REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 PACKAGES: dict[str, list[str]] = {
     "core": ["test_core_dataframe.py", "test_core_params_pipeline.py",
              "test_fuzzing.py", "test_longtail_io.py", "test_arrow.py"],
-    "featurize": ["test_featurize.py", "test_stages.py"],
+    "featurize": ["test_featurize.py", "test_stages.py",
+              "test_vector_embedding.py"],
     "lightgbm1": ["test_lightgbm.py", "test_lightgbm_categorical.py", "test_pallas_hist.py"],
     "lightgbm2": ["test_lightgbm_sparse.py", "test_lightgbm_distributed.py",
                   "test_lightgbm_format_fixture.py"],
@@ -31,7 +32,7 @@ PACKAGES: dict[str, list[str]] = {
     "dl": ["test_text_encoder.py", "test_image_dl.py", "test_convert.py",
            "test_transfer_learning.py", "test_checkpoint_profiling.py",
            "test_parallel.py", "test_pipeline_moe.py",
-           "test_sharding_analysis.py"],
+           "test_sharding_analysis.py", "test_pallas_attention.py"],
     "serving": ["test_http_serving.py", "test_serving_distributed.py",
                 "test_serving_native.py"],
     "cognitive": ["test_cognitive.py", "test_cognitive_speech.py",

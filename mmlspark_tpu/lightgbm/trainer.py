@@ -346,7 +346,7 @@ class _FusedStatics(NamedTuple):
     not, so every config field the trace can see is here."""
     obj_key: tuple          # get_objective kwargs, incl. derived pos_weight
     tp: TreeParams          # growth statics (leaves, bins, reg, cats, …)
-    boosting: str           # gbdt | goss | rf
+    boosting: str           # gbdt | goss | rf | dart
     K: int
     n: int
     F: int
@@ -366,19 +366,18 @@ _FUSED_CACHE: OrderedDict = OrderedDict()
 _FUSED_CACHE_MAX = 16
 
 
-def _build_fused(st: _FusedStatics):
-    """(step, chunk_step) for one static configuration; both take the
-    per-fit arrays as a leading ``data`` pytree. Bodies mirror the
-    closure-based ``make_fused_step`` (kept for the delegate/fobj/mesh
-    paths) — the math must stay identical between the two."""
+def _statics_objective(st: _FusedStatics) -> Objective:
     name, num_class, alpha, fair_c, tvp, sigmoid, pos_weight, bfa = \
         st.obj_key
-    obj = get_objective(name, num_class=num_class, alpha=alpha,
-                        fair_c=fair_c, tweedie_variance_power=tvp,
-                        sigmoid=sigmoid, pos_weight=pos_weight,
-                        boost_from_average=bfa)
-    is_rf = st.boosting == "rf"
-    is_goss = st.boosting == "goss"
+    return get_objective(name, num_class=num_class, alpha=alpha,
+                         fair_c=fair_c, tweedie_variance_power=tvp,
+                         sigmoid=sigmoid, pos_weight=pos_weight,
+                         boost_from_average=bfa)
+
+
+def _data_growers(st: _FusedStatics):
+    """(grow_one, routed_vdelta) reading their arrays from the ``data``
+    pytree — shared by the cached gbdt/goss/rf and dart builders."""
     arange_k = jnp.arange(st.K)
 
     def grow_one(data, g, h, fm, rm):
@@ -411,6 +410,19 @@ def _build_fused(st: _FusedStatics):
                 t, data["vb"], max_depth=st.tp.num_leaves))(tree_b)
         return tree_b.leaf_value[arange_k[:, None], vleaf]
 
+    return grow_one, routed_vdelta
+
+
+def _build_fused(st: _FusedStatics):
+    """(step, chunk_step) for one static configuration; both take the
+    per-fit arrays as a leading ``data`` pytree. Bodies mirror the
+    closure-based ``make_fused_step`` (kept for the delegate/fobj/mesh
+    paths) — the math must stay identical between the two."""
+    obj = _statics_objective(st)
+    is_rf = st.boosting == "rf"
+    is_goss = st.boosting == "goss"
+    grow_one, routed_vdelta = _data_growers(st)
+
     def step_impl(data, scores, vscores, fm, rm, it_dev):
         return _fused_step_math(
             scores, vscores, fm, rm, it_dev, base=data["base"],
@@ -433,10 +445,138 @@ def _build_fused(st: _FusedStatics):
     return step, chunk_step
 
 
+def _dart_sub_body(c, xs, coeff_fn, K: int):
+    """Apply one (possibly padded) dropped tree's contribution to the
+    carried scores, mirroring the stepwise loop's ascending per-tree
+    order. ``coeff_fn(w)`` maps the tree's standing weight to the scalar
+    coefficient exactly as the oracle computes it on host (barriers pin
+    each scalar rounding step — XLA would otherwise carry the chain in
+    excess precision); the padding mask multiplies last (exact: ×1 or
+    ×±0, and ±0·d FMA-adds as an exact no-op)."""
+    deltas, weights, idx, val = xs
+    coeff = coeff_fn(weights[idx]) * val
+    return _score_update(c, deltas[idx], coeff, jnp.mod(idx, K)), None
+
+
+def _dart_step_math(scores, vscores, deltas_buf, vdeltas_buf,
+                    weights_buf, didx, dval, new_w, factor,
+                    feat_mask_dev, row_mask_dev, it_dev, *, gh_fn,
+                    grow_one, routed_vdelta, K: int, has_valid: bool):
+    """THE fused DART iteration — dropped-margin reconstruction →
+    gradients → growth → new-tree add → standing-tree rescale → buffer
+    updates — shared verbatim by the cross-fit-cached builder
+    (``_build_dart``) and the per-fit closure builder
+    (``make_dart_step``), so the two paths cannot drift. Bit-matches the
+    stepwise oracle (``dart_mode="stepwise"``) by construction."""
+    # 1) margin with dropped trees removed (gradients see it)
+    eff, _ = jax.lax.scan(
+        lambda c, xs: _dart_sub_body(
+            c, (deltas_buf, weights_buf) + xs, lambda w: -w, K),
+        scores, (didx, dval))
+    g, h = gh_fn(eff)
+    tree_b, delta_b = grow_one(g, h, feat_mask_dev, row_mask_dev)
+    # 2) new tree enters at weight 1/(k+1), class-ascending
+    new_scores = scores
+    for k_cls in range(K):
+        new_scores = _score_update(new_scores, delta_b[k_cls], new_w,
+                                   jnp.int32(k_cls))
+    if has_valid:
+        vdelta_b = routed_vdelta(tree_b)
+        new_vscores = vscores
+        for k_cls in range(K):
+            new_vscores = _score_update(new_vscores, vdelta_b[k_cls],
+                                        new_w, jnp.int32(k_cls))
+    else:
+        vdelta_b = None
+        new_vscores = vscores
+    # 3) dropped trees' standing contribution rescales by k/(k+1).
+    # Each scalar step is barriered to its own f32 rounding — the
+    # stepwise oracle computes this coefficient on host in numpy f32,
+    # and XLA would otherwise carry the chain in excess precision and
+    # land 1 ulp away.
+    fm1 = jax.lax.optimization_barrier(factor - 1.0)
+    rescale = lambda w: jax.lax.optimization_barrier(  # noqa: E731
+        w * fm1)
+    new_scores, _ = jax.lax.scan(
+        lambda c, xs: _dart_sub_body(
+            c, (deltas_buf, weights_buf) + xs, rescale, K),
+        new_scores, (didx, dval))
+    if has_valid:
+        new_vscores, _ = jax.lax.scan(
+            lambda c, xs: _dart_sub_body(
+                c, (vdeltas_buf, weights_buf) + xs, rescale, K),
+            new_vscores, (didx, dval))
+    # 4) buffers: slot in this iteration's deltas, fold the factor into
+    # dropped weights (padded entries multiply by 1)
+    slot = it_dev * K
+    new_deltas = jax.lax.dynamic_update_slice(
+        deltas_buf, delta_b, (slot, jnp.int32(0)))
+    new_vdeltas = vdeltas_buf if vdelta_b is None else \
+        jax.lax.dynamic_update_slice(vdeltas_buf, vdelta_b,
+                                     (slot, jnp.int32(0)))
+    new_weights = weights_buf.at[didx].multiply(
+        jnp.where(dval > 0, factor, 1.0))
+    new_weights = jax.lax.dynamic_update_slice(
+        new_weights, jnp.broadcast_to(new_w, (K,)), (slot,))
+    return (new_scores, new_vscores, new_deltas, new_vdeltas,
+            new_weights, tree_b)
+
+
+def _dart_chunk_scan(step_fn):
+    """k fused-DART iterations as ONE dispatch — one body for both dart
+    builders. ``step_fn`` is the 12-arg dart step."""
+    def chunk(scores, vscores, deltas_buf, vdeltas_buf, weights_buf,
+              feat_masks, row_masks, its, didxs, dvals, new_ws, factors):
+        def body(carry, xs):
+            out = step_fn(*carry, *xs[3:], *xs[:3])
+            return out[:5], out[5]
+        carry, tree_stack = jax.lax.scan(
+            body,
+            (scores, vscores, deltas_buf, vdeltas_buf, weights_buf),
+            (feat_masks, row_masks, its, didxs, dvals, new_ws, factors))
+        return carry + (tree_stack,)
+    return chunk
+
+
+def _build_dart(st: _FusedStatics):
+    """Cross-fit-cacheable fused-DART (step, chunk) — the dart twin of
+    ``_build_fused``."""
+    obj = _statics_objective(st)
+    grow_one, routed_vdelta = _data_growers(st)
+
+    def dart_impl(data, scores, vscores, deltas_buf, vdeltas_buf,
+                  weights_buf, didx, dval, new_w, factor, feat_mask_dev,
+                  row_mask_dev, it_dev):
+        return _dart_step_math(
+            scores, vscores, deltas_buf, vdeltas_buf, weights_buf, didx,
+            dval, new_w, factor, feat_mask_dev, row_mask_dev, it_dev,
+            gh_fn=lambda s: obj.grad_hess(s, data["y"], data["w"]),
+            grow_one=lambda g, h, fm, rm: grow_one(data, g, h, fm, rm),
+            routed_vdelta=lambda tb: routed_vdelta(data, tb),
+            K=st.K, has_valid=st.has_valid)
+
+    # donate the O(T·n) buffers so each iteration updates them in place
+    # (CPU lacks donation and would warn on every compile); +1 for the
+    # leading data arg
+    donate = (3, 4, 5) if jax.default_backend() == "tpu" else ()
+    step = jax.jit(dart_impl, donate_argnums=donate)
+
+    @functools.partial(jax.jit, donate_argnums=donate)
+    def dart_chunk(data, scores, vscores, deltas_buf, vdeltas_buf,
+                   weights_buf, feat_masks, row_masks, its, didxs,
+                   dvals, new_ws, factors):
+        return _dart_chunk_scan(functools.partial(dart_impl, data))(
+            scores, vscores, deltas_buf, vdeltas_buf, weights_buf,
+            feat_masks, row_masks, its, didxs, dvals, new_ws, factors)
+
+    return step, dart_chunk
+
+
 def _fused_cached(st: _FusedStatics):
+    builder = _build_dart if st.boosting == "dart" else _build_fused
     fns = _FUSED_CACHE.get(st)
     if fns is None:
-        fns = _build_fused(st)
+        fns = builder(st)
         _FUSED_CACHE[st] = fns
         while len(_FUSED_CACHE) > _FUSED_CACHE_MAX:
             _FUSED_CACHE.popitem(last=False)
@@ -814,96 +954,22 @@ def train(x: np.ndarray, y: np.ndarray, w: np.ndarray | None,
     D_drop = max(1, min(int(cfg.max_drop), T_max))
 
     def make_dart_step():
-        def sub_body(c, xs, coeff_fn):
-            """Apply one (possibly padded) dropped tree's contribution to
-            the carried scores, mirroring the stepwise loop's ascending
-            per-tree order. ``coeff_fn(w)`` maps the tree's standing
-            weight to the scalar coefficient exactly as the oracle
-            computes it on host (barriers pin each scalar rounding step —
-            XLA would otherwise carry the chain in excess precision); the
-            padding mask multiplies last (exact: ×1 or ×±0, and ±0·d
-            FMA-adds as an exact no-op)."""
-            deltas, weights, idx, val = xs
-            coeff = coeff_fn(weights[idx]) * val
-            return _score_update(c, deltas[idx], coeff,
-                                 jnp.mod(idx, K)), None
-
         def dart_impl(scores, vscores, deltas_buf, vdeltas_buf,
                       weights_buf, didx, dval, new_w, factor,
                       feat_mask_dev, row_mask_dev, it_dev):
-            # 1) margin with dropped trees removed (gradients see it)
-            eff, _ = jax.lax.scan(
-                lambda c, xs: sub_body(
-                    c, (deltas_buf, weights_buf) + xs, lambda w: -w),
-                scores, (didx, dval))
-            g, h = gh_fn(eff, y_dev, w_dev)
-            tree_b, delta_b = grow_one(g, h, feat_mask_dev, row_mask_dev)
-            # 2) new tree enters at weight 1/(k+1), class-ascending
-            new_scores = scores
-            for k_cls in range(K):
-                new_scores = _score_update(new_scores, delta_b[k_cls],
-                                           new_w, jnp.int32(k_cls))
-            if valid is not None:
-                vdelta_b = routed_vdelta(tree_b)
-                new_vscores = vscores
-                for k_cls in range(K):
-                    new_vscores = _score_update(
-                        new_vscores, vdelta_b[k_cls], new_w,
-                        jnp.int32(k_cls))
-            else:
-                vdelta_b = None
-                new_vscores = vscores
-            # 3) dropped trees' standing contribution rescales by k/(k+1).
-            # Each scalar step is barriered to its own f32 rounding — the
-            # stepwise oracle computes this coefficient on host in numpy
-            # f32, and XLA would otherwise carry the chain in excess
-            # precision and land 1 ulp away.
-            fm1 = jax.lax.optimization_barrier(factor - 1.0)
-            rescale = lambda w: jax.lax.optimization_barrier(  # noqa: E731
-                w * fm1)
-            new_scores, _ = jax.lax.scan(
-                lambda c, xs: sub_body(
-                    c, (deltas_buf, weights_buf) + xs, rescale),
-                new_scores, (didx, dval))
-            if valid is not None:
-                new_vscores, _ = jax.lax.scan(
-                    lambda c, xs: sub_body(
-                        c, (vdeltas_buf, weights_buf) + xs, rescale),
-                    new_vscores, (didx, dval))
-            # 4) buffers: slot in this iteration's deltas, fold the factor
-            # into dropped weights (padded entries multiply by 1)
-            slot = it_dev * K
-            new_deltas = jax.lax.dynamic_update_slice(
-                deltas_buf, delta_b, (slot, jnp.int32(0)))
-            new_vdeltas = vdeltas_buf if vdelta_b is None else \
-                jax.lax.dynamic_update_slice(vdeltas_buf, vdelta_b,
-                                             (slot, jnp.int32(0)))
-            new_weights = weights_buf.at[didx].multiply(
-                jnp.where(dval > 0, factor, 1.0))
-            new_weights = jax.lax.dynamic_update_slice(
-                new_weights, jnp.broadcast_to(new_w, (K,)), (slot,))
-            return (new_scores, new_vscores, new_deltas, new_vdeltas,
-                    new_weights, tree_b)
+            return _dart_step_math(
+                scores, vscores, deltas_buf, vdeltas_buf, weights_buf,
+                didx, dval, new_w, factor, feat_mask_dev, row_mask_dev,
+                it_dev, gh_fn=lambda s: gh_fn(s, y_dev, w_dev),
+                grow_one=grow_one, routed_vdelta=routed_vdelta, K=K,
+                has_valid=valid is not None)
 
         # donate the O(T·n) buffers so each iteration updates them in
         # place (CPU lacks donation and would warn on every compile)
         donate = (2, 3, 4) if jax.default_backend() == "tpu" else ()
         step = jax.jit(dart_impl, donate_argnums=donate)
-
-        @functools.partial(jax.jit, donate_argnums=donate)
-        def dart_chunk(scores, vscores, deltas_buf, vdeltas_buf,
-                       weights_buf, feat_masks, row_masks, its, didxs,
-                       dvals, new_ws, factors):
-            def body(carry, xs):
-                out = dart_impl(*carry, *xs[3:], *xs[:3])
-                return out[:5], out[5]
-            carry, tree_stack = jax.lax.scan(
-                body,
-                (scores, vscores, deltas_buf, vdeltas_buf, weights_buf),
-                (feat_masks, row_masks, its, didxs, dvals, new_ws,
-                 factors))
-            return carry + (tree_stack,)
-
+        dart_chunk = functools.partial(jax.jit, donate_argnums=donate)(
+            _dart_chunk_scan(dart_impl))
         return step, dart_chunk
 
     def dart_host_draw():
@@ -939,9 +1005,9 @@ def train(x: np.ndarray, y: np.ndarray, w: np.ndarray | None,
     # Delegate LR schedules mutate tp mid-loop, custom fobj/ranker
     # gradients close over user state, and mesh paths shard_map over
     # placed data: those keep the per-fit closure builder.
-    fused_cacheable = (use_fused and mesh is None and delegate is None
+    trace_cacheable = (mesh is None and delegate is None
                        and grad_hess_override is None and cfg.fobj is None)
-    if fused_cacheable:
+    if trace_cacheable:
         goss_kw_c = dict(
             top_n=int(cfg.top_rate * n_real),
             other_n=int(cfg.other_rate * n_real),
@@ -954,7 +1020,6 @@ def train(x: np.ndarray, y: np.ndarray, w: np.ndarray | None,
             tp=tp, boosting=cfg.boosting_type, K=K, n=n, F=F,
             sparse=sparse, num_bins=(B_s if sparse else 0),
             has_valid=valid is not None, **goss_kw_c)
-        raw_step, raw_chunk = _fused_cached(st_key)
         base_arr_c = np.asarray(base_score, np.float32).reshape(-1)
         fdata = {"y": y_dev, "w": w_dev, "gkey": goss_key,
                  "base": jnp.float32(base_arr_c[0]) if K == 1
@@ -970,6 +1035,8 @@ def train(x: np.ndarray, y: np.ndarray, w: np.ndarray | None,
                              vz=vbinned.zero_bin)
             else:
                 fdata["vb"] = vbins
+    if use_fused and trace_cacheable:
+        raw_step, raw_chunk = _fused_cached(st_key)
 
         def fused_step(s, vs, fm, rm, it):
             return raw_step(fdata, s, vs, fm, rm, it)
@@ -980,7 +1047,16 @@ def train(x: np.ndarray, y: np.ndarray, w: np.ndarray | None,
         fused_step, chunk_step = make_fused_step()
     dart_step = dart_chunk_step = None
     if dart_fused:
-        dart_step, dart_chunk_step = make_dart_step()
+        if trace_cacheable:
+            raw_dstep, raw_dchunk = _fused_cached(st_key)
+
+            def dart_step(*args):
+                return raw_dstep(fdata, *args)
+
+            def dart_chunk_step(*args):
+                return raw_dchunk(fdata, *args)
+        else:
+            dart_step, dart_chunk_step = make_dart_step()
         deltas_buf = jnp.zeros((T_max, n), jnp.float32)
         vdeltas_buf = jnp.zeros((T_max, nv), jnp.float32) \
             if valid is not None else jnp.zeros((T_max, 1), jnp.float32)
