@@ -476,6 +476,75 @@ def bench_ranker(extras: dict) -> None:
     extras["ranker_ndcg10"] = round(m.evaluate_ndcg(df, k=10), 4)
 
 
+def bench_gbdt_sparse(extras: dict) -> None:
+    """Padded-COO GBDT training pace on hashed-text-shaped data (high
+    logical width, few entries per row) — the sparse engine
+    (``lightgbm/sparse.py``) had no perf number before this."""
+    import numpy as np
+
+    from mmlspark_tpu.lightgbm.sparse import SparseData
+    from mmlspark_tpu.lightgbm.trainer import TrainConfig, train
+
+    n_rows = int(os.environ.get("MMLSPARK_TPU_BENCH_SPARSE_ROWS",
+                                200_000))
+    width, F, n_iters = 32, 10_000, 10
+    rng = np.random.default_rng(13)
+    # unique indices per row (the SparseData invariant): draw a wide
+    # permutation block-wise to stay cheap at bench scale
+    idx = np.stack([rng.choice(F, size=width, replace=False)
+                    for _ in range(512)])
+    idx = np.tile(idx, (n_rows // 512 + 1, 1))[:n_rows].astype(np.int32)
+    val = rng.normal(size=(n_rows, width)).astype(np.float32)
+    w_sig = rng.normal(size=F).astype(np.float32)
+    margin = (val * w_sig[idx]).sum(1)
+    y = (margin > 0).astype(np.float32)
+    sd = SparseData(idx, val, F)
+    cfg = TrainConfig(objective="binary", num_iterations=n_iters,
+                      num_leaves=31, learning_rate=0.1)
+    train(sd, y, None, cfg)  # warm the compile cache
+    t0 = time.perf_counter()
+    train(sd, y, None, cfg)
+    dt = time.perf_counter() - t0
+    extras["gbdt_sparse_rows_per_sec"] = round(n_rows * n_iters / dt, 1)
+    extras["gbdt_sparse_fit_seconds"] = round(dt, 3)
+
+
+def bench_vw(extras: dict) -> None:
+    """VowpalWabbit-equivalent online learning pace: murmur-hash
+    featurization (native batch hasher) + AdaGrad sparse SGD on device —
+    the reference's third engine (``vw/VowpalWabbitBase.scala``) had no
+    bench row before this."""
+    import numpy as np
+
+    from mmlspark_tpu.core import DataFrame
+    from mmlspark_tpu.vw import (VowpalWabbitClassifier,
+                                 VowpalWabbitFeaturizer)
+
+    n_rows = int(os.environ.get("MMLSPARK_TPU_BENCH_VW_ROWS", 200_000))
+    rng = np.random.default_rng(9)
+    feats = rng.normal(size=(n_rows, 30)).astype(np.float32)
+    labels = (feats[:, :5].sum(1) > 0).astype(np.float32)
+    df = DataFrame({"features": feats, "label": labels})
+
+    featurizer = VowpalWabbitFeaturizer(inputCols=["features"],
+                                        outputCol="vw_features")
+    hashed = featurizer.transform(df)       # warm any native load
+    t0 = time.perf_counter()
+    hashed = featurizer.transform(df)
+    extras["vw_featurize_rows_per_sec"] = round(
+        n_rows / (time.perf_counter() - t0), 1)
+
+    passes = 3
+    clf = VowpalWabbitClassifier(featuresCol="vw_features",
+                                 numPasses=passes, numBits=18)
+    clf.fit(hashed)  # warm the compile cache
+    t0 = time.perf_counter()
+    clf.fit(hashed)
+    dt = time.perf_counter() - t0
+    extras["vw_rows_per_sec"] = round(n_rows * passes / dt, 1)
+    extras["vw_fit_seconds"] = round(dt, 3)
+
+
 def bench_serving(extras: dict) -> None:
     """End-to-end HTTP request→jitted pipeline→response latency against
     the reference's ~1 ms continuous-mode figure."""
@@ -661,6 +730,10 @@ def main():
             _watchdog(bench_gbdt, extras, "gbdt", 420.0)
         if want("ranker"):
             _watchdog(bench_ranker, extras, "ranker", 420.0)
+        if want("vw"):
+            _watchdog(bench_vw, extras, "vw", 300.0)
+        if want("gbdt_sparse"):
+            _watchdog(bench_gbdt_sparse, extras, "gbdt_sparse", 300.0)
         if want("train"):
             _watchdog(bench_train, extras, "train", 600.0)
         if want("vit"):

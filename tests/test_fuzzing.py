@@ -185,7 +185,43 @@ def make_test_objects() -> dict[str, TestObject]:
                    DataFrame({"words": _str_col(
                        [["a", "b", "c"], ["b", "c", "d"]] * 4)})),
     ]
+    objs += _longtail_test_objects(rng, cat_df)
     return {type(o.stage).__name__: o for o in objs}
+
+
+def _longtail_test_objects(rng, cat_df) -> list[TestObject]:
+    """Stages that need paired fit/transform frames or upstream stages."""
+    from mmlspark_tpu.featurize import (DataConversion, IndexToValue,
+                                        ValueIndexer)
+    from mmlspark_tpu.nn import ConditionalKNN
+    from mmlspark_tpu.vw import (VowpalWabbitFeaturizer,
+                                 VowpalWabbitInteractions)
+
+    mixed = DataFrame({
+        "a": np.asarray([1, 2, 3, 4], np.int64),
+        "b": _str_col(["1.5", "2.5", "x", "4.0"])})
+    idx_model = ValueIndexer(inputCol="cat", outputCol="idx").fit(cat_df)
+    indexed = idx_model.transform(cat_df)
+    hashed2 = VowpalWabbitFeaturizer(
+        inputCols=["cat"], outputCol="h1").transform(
+        VowpalWabbitFeaturizer(inputCols=["num"],
+                               outputCol="h0").transform(cat_df))
+    ck_fit = DataFrame({
+        "features": rng.normal(size=(12, 3)).astype(np.float32),
+        "values": _str_col([f"v{i}" for i in range(12)]),
+        "labels": _str_col(["x", "y"] * 6)})
+    ck_q = DataFrame({
+        "features": rng.normal(size=(4, 3)).astype(np.float32),
+        "conditioner": _str_col([["x"], ["y"], ["x", "y"], ["y"]])})
+    return [
+        TestObject(DataConversion(inputCols=["a"], convertTo="double"), mixed),
+        TestObject(IndexToValue(inputCol="idx", outputCol="orig")
+                   .set("levels", idx_model.get("levels")), indexed),
+        TestObject(VowpalWabbitInteractions(
+            inputCols=["h0", "h1"], outputCol="crossed", numBits=12),
+            hashed2),
+        TestObject(ConditionalKNN(k=3), ck_fit, ck_q),
+    ]
 
 
 _OBJECTS = make_test_objects()
@@ -210,11 +246,10 @@ _EXCLUDED = {
     "UDFTransformer", "Lambda", "TPUModel", "ImageFeaturizer",
     "TrainClassifier", "TrainRegressor", "TrainedClassifierModel",
     "TrainedRegressorModel", "TuneHyperparameters", "FindBestModel",
-    "ConditionalKNN", "TabularLIME", "ImageLIME", "TextLIME",
+    "TabularLIME", "ImageLIME", "TextLIME",
     "SuperpixelTransformer", "RankingAdapter",
     "RankingTrainValidationSplit", "VowpalWabbitContextualBandit",
-    "VowpalWabbitInteractions", "UnrollBinaryImage", "DataConversion",
-    "IndexToValue", "TimeIntervalMiniBatchTransformer",
+    "UnrollBinaryImage", "TimeIntervalMiniBatchTransformer",
     # cyber: need tenant-keyed inputs; fuzzed in test_cyber
     "IdIndexer", "MultiIndexer", "ConnectedComponents",
     "StandardScalarScaler", "LinearScalarScaler",
