@@ -672,3 +672,85 @@ class TestFusedTraceCache:
         LightGBMClassifier(numIterations=5).fit(df)
         LightGBMRegressor(numIterations=5).fit(df)
         assert len(trainer_mod._FUSED_CACHE) == 2
+
+    def test_learning_rate_sweep_shares_one_trace(self):
+        """lr is a traced scalar in the cached path: sweeping it must
+        reuse ONE compiled step and still produce exactly the model the
+        closure (delegate) path produces. Both paths now shrink via the
+        same isolated post-hoc multiply — this oracle guards that the
+        two builders stay bit-identical (traced-scalar vs baked-constant
+        lr), incl. under max_delta_step>0; accuracy-level correctness of
+        the shrinkage itself is covered by the reference-parity CSVs."""
+        from mmlspark_tpu.lightgbm import trainer as trainer_mod
+
+        class _NoOpDelegate:
+            """Forces the closure (make_fused_step) path; changes no
+            semantics: lr unchanged, hooks empty."""
+            def get_learning_rate(self, it):
+                return None
+
+            def before_train_iteration(self, it):
+                pass
+
+            def after_train_iteration(self, it):
+                pass
+
+        rng = np.random.default_rng(4)
+        x = rng.normal(size=(1500, 10)).astype(np.float32)
+        y = (x[:, 1] > 0).astype(np.float32)
+        for mds in (0.0, 0.02):
+            cfgkw = dict(objective="binary", num_iterations=12,
+                         num_leaves=15, max_delta_step=mds)
+            trainer_mod._FUSED_CACHE.clear()
+            trainer_mod.train(x, y, None, trainer_mod.TrainConfig(
+                learning_rate=0.1, **cfgkw))
+            r_cached = trainer_mod.train(x, y, None,
+                                         trainer_mod.TrainConfig(
+                                             learning_rate=0.05, **cfgkw))
+            assert len(trainer_mod._FUSED_CACHE) == 1
+            r_closure = trainer_mod.train(
+                x, y, None,
+                trainer_mod.TrainConfig(learning_rate=0.05, **cfgkw),
+                delegate=_NoOpDelegate())
+            for fld in ("leaf_value", "feature", "left", "right"):
+                np.testing.assert_array_equal(
+                    r_cached.booster.arrays[fld],
+                    r_closure.booster.arrays[fld], err_msg=fld)
+            np.testing.assert_array_equal(
+                np.asarray(r_cached.booster.raw_scores(x)),
+                np.asarray(r_closure.booster.raw_scores(x)))
+
+
+def test_delegate_learning_rate_schedule():
+    """A delegate LR schedule (reference delegate hooks,
+    ``LightGBMDelegate.scala``) applies mid-fit: trees before the switch
+    bit-match a constant-lr run, trees after reflect the new rate —
+    growers are lr-free so only the step closures rebuild."""
+    from mmlspark_tpu.lightgbm.trainer import TrainConfig, train
+
+    class _Halver:
+        def __init__(self, switch_at):
+            self.switch_at = switch_at
+
+        def get_learning_rate(self, it):
+            return 0.1 if it < self.switch_at else 0.05
+
+        def before_train_iteration(self, it):
+            pass
+
+        def after_train_iteration(self, it):
+            pass
+
+    rng = np.random.default_rng(6)
+    x = rng.normal(size=(800, 6)).astype(np.float32)
+    y = (x[:, 0] + 0.5 * x[:, 3] > 0).astype(np.float32)
+    cfgkw = dict(objective="binary", num_iterations=8, num_leaves=7,
+                 learning_rate=0.1)
+    r_const = train(x, y, None, TrainConfig(**cfgkw))
+    r_sched = train(x, y, None, TrainConfig(**cfgkw),
+                    delegate=_Halver(switch_at=4))
+    lv_c = r_const.booster.arrays["leaf_value"]
+    lv_s = r_sched.booster.arrays["leaf_value"]
+    np.testing.assert_array_equal(lv_s[:4], lv_c[:4])
+    assert not np.array_equal(lv_s[4], lv_c[4]), \
+        "the LR switch at iteration 4 must change the 5th tree"
