@@ -358,7 +358,12 @@ def make_bench_encoder(impl: str):
         from mmlspark_tpu.dl.text_encoder import TextEncoder, \
             make_attention_fn
 
-        W, depth, mlp, T = 512, 8, 2048, 2048
+        raw_shape = os.environ.get("MMLSPARK_TPU_BENCH_ENCODER_SHAPE",
+                                   "512,8,2048,2048")
+        try:
+            W, depth, mlp, T = (int(x) for x in raw_shape.split(","))
+        except ValueError:
+            W, depth, mlp, T = 512, 8, 2048, 2048
         rng = np.random.default_rng(2)
         ids0 = jnp.asarray(rng.integers(1, 32768, size=(1, T)),
                            jnp.int32)
@@ -392,6 +397,41 @@ def make_bench_encoder(impl: str):
         extras[f"encoder_ips_by_batch_{impl}"] = per_batch
         extras[f"encoder_seqs_per_sec_{impl}"] = round(ips, 1)
         extras[f"encoder_best_batch_{impl}"] = batch
+
+        # train-step pace at the same long-context shape: exercises the
+        # backward (pallas = fused FA2-style dq/dkv kernels; dense = XLA
+        # autodiff through the materialized scores). Fault-isolated: a
+        # bwd OOM must not discard the banked forward numbers.
+        try:
+            import optax
+
+            from mmlspark_tpu.dl.train import (init_train_state,
+                                               make_train_step)
+            tb = 8
+            tx = optax.sgd(1e-3)
+            with jax.default_device(jax.local_devices(backend="cpu")[0]):
+                state0 = init_train_state(
+                    init_module, jax.random.PRNGKey(1), ids0, tx)
+            state = jax.device_put(state0, jax.devices()[0])
+            del state0
+            xb = make_input(tb)
+            yb = jnp.asarray(rng.integers(0, 2, size=tb), jnp.int32)
+            step = make_train_step(
+                module, tx, fetch="pooled",
+                loss_fn=lambda pooled, y: jnp.mean(
+                    (pooled.mean(-1) - y) ** 2))
+            state, loss = step(state, xb, yb)     # compile + warm
+            jax.block_until_ready(loss)
+            iters = 5
+            t0 = time.perf_counter()
+            for _ in range(iters):
+                state, loss = step(state, xb, yb)
+            jax.block_until_ready(loss)
+            extras[f"encoder_train_seqs_per_sec_{impl}"] = round(
+                tb * iters / (time.perf_counter() - t0), 1)
+        except Exception:
+            extras[f"error_encoder_train_{impl}"] = \
+                traceback.format_exc()[-500:]
 
     return bench
 

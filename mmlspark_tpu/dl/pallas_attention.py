@@ -83,17 +83,28 @@ def _flash_kernel(q_ref, k_ref, v_ref, mask_ref, o_ref,
         o_ref[0] = (acc_scr[:] / l).astype(o_ref.dtype)
 
 
-@functools.partial(jax.jit,
-                   static_argnames=("block_q", "block_k", "interpret"))
-def _flash_forward(q, k, v, key_mask, *, block_q: int = 256,
-                   block_k: int = 512, interpret: bool = False):
+def _flash_kernel_lse(q_ref, k_ref, v_ref, mask_ref, o_ref, lse_ref,
+                      m_scr, l_scr, acc_scr, *, scale: float):
+    """Forward cell that additionally emits the logsumexp row stats the
+    fused backward needs (same math as ``_flash_kernel``)."""
+    _flash_kernel(q_ref, k_ref, v_ref, mask_ref, o_ref,
+                  m_scr, l_scr, acc_scr, scale=scale)
+    kb = pl.program_id(2)
+    nk = pl.num_programs(2)
+
+    @pl.when(kb == nk - 1)
+    def _emit_lse():
+        l = jnp.maximum(l_scr[:, :1], 1e-35)
+        lse_ref[0] = m_scr[:, :1] + jnp.log(l)
+
+
+def _flash_pack(q, k, v, key_mask, block_q, block_k):
+    """Shared padding/reshape for forward and backward kernels."""
     B, H, T, D = q.shape
-    scale = D ** -0.5
     bq = min(block_q, max(8, T))
     bk = min(block_k, max(128, T))
     qp = (-T) % bq
     kp = (-T) % bk
-
     qf = jnp.pad(q.reshape(B * H, T, D), ((0, 0), (0, qp), (0, 0)))
     kf = jnp.pad(k.reshape(B * H, T, D), ((0, 0), (0, kp), (0, 0)))
     vf = jnp.pad(v.reshape(B * H, T, D), ((0, 0), (0, kp), (0, 0)))
@@ -101,47 +112,240 @@ def _flash_forward(q, k, v, key_mask, *, block_q: int = 256,
     mask = jnp.broadcast_to(key_mask[:, None, :], (B, H, T)) \
         .reshape(B * H, T).astype(jnp.int8)
     mask = jnp.pad(mask, ((0, 0), (0, kp)))
+    return qf, kf, vf, mask, (B, H, T, D, bq, bk, qp, kp)
 
+
+@functools.partial(jax.jit,
+                   static_argnames=("block_q", "block_k", "interpret",
+                                    "with_lse"))
+def _flash_forward(q, k, v, key_mask, *, block_q: int = 256,
+                   block_k: int = 512, interpret: bool = False,
+                   with_lse: bool = False):
+    qf, kf, vf, mask, (B, H, T, D, bq, bk, qp, kp) = _flash_pack(
+        q, k, v, key_mask, block_q, block_k)
+    scale = D ** -0.5
     nq, nk = (T + qp) // bq, (T + kp) // bk
+    in_specs = [
+        pl.BlockSpec((1, bq, D), lambda b, iq, ik: (b, iq, 0)),
+        pl.BlockSpec((1, bk, D), lambda b, iq, ik: (b, ik, 0)),
+        pl.BlockSpec((1, bk, D), lambda b, iq, ik: (b, ik, 0)),
+        pl.BlockSpec((1, bk), lambda b, iq, ik: (b, ik)),
+    ]
+    o_spec = pl.BlockSpec((1, bq, D), lambda b, iq, ik: (b, iq, 0))
+    o_shape = jax.ShapeDtypeStruct((B * H, T + qp, D), v.dtype)
+    scratch = [
+        pltpu.VMEM((bq, 128), jnp.float32),   # running max
+        pltpu.VMEM((bq, 128), jnp.float32),   # running denominator
+        pltpu.VMEM((bq, D), jnp.float32),     # output accumulator
+    ]
+    params = pltpu.CompilerParams(
+        dimension_semantics=("parallel", "parallel", "arbitrary"))
+    if with_lse:
+        out, lse = pl.pallas_call(
+            functools.partial(_flash_kernel_lse, scale=scale),
+            grid=(B * H, nq, nk),
+            in_specs=in_specs,
+            out_specs=(o_spec,
+                       pl.BlockSpec((1, bq, 1),
+                                    lambda b, iq, ik: (b, iq, 0))),
+            out_shape=(o_shape,
+                       jax.ShapeDtypeStruct((B * H, T + qp, 1),
+                                            jnp.float32)),
+            scratch_shapes=scratch,
+            compiler_params=params,
+            interpret=interpret,
+        )(qf, kf, vf, mask)
+        return (out[:, :T].reshape(B, H, T, D),
+                lse[:, :T, 0].reshape(B, H, T))
     out = pl.pallas_call(
         functools.partial(_flash_kernel, scale=scale),
+        grid=(B * H, nq, nk),
+        in_specs=in_specs,
+        out_specs=o_spec,
+        out_shape=o_shape,
+        scratch_shapes=scratch,
+        compiler_params=params,
+        interpret=interpret,
+    )(qf, kf, vf, mask)
+    return out[:, :T].reshape(B, H, T, D)
+
+
+def _bwd_dq_kernel(q_ref, k_ref, v_ref, mask_ref, do_ref, lse_ref,
+                   dsum_ref, dq_ref, dq_scr, *, scale: float):
+    """dq = Σ_k ds·K with ds = p·(dp − D)·scale, p = exp(s − lse)."""
+    kb = pl.program_id(2)
+    nk = pl.num_programs(2)
+
+    @pl.when(kb == 0)
+    def _init():
+        dq_scr[:] = jnp.zeros_like(dq_scr)
+
+    q = q_ref[0]                                   # [BQ, D]
+    k = k_ref[0]                                   # [BK, D]
+    s = jax.lax.dot_general(
+        q, k, (((1,), (1,)), ((), ())),
+        preferred_element_type=jnp.float32) * scale
+    valid = mask_ref[0, :] != 0
+    p = jnp.exp(s - lse_ref[0])                    # lse [BQ, 1] bcasts
+    p = jnp.where(valid[None, :], p, 0.0)
+    do = do_ref[0].astype(jnp.float32)
+    dp = jax.lax.dot_general(                      # [BQ, BK]
+        do, v_ref[0], (((1,), (1,)), ((), ())),
+        preferred_element_type=jnp.float32)
+    ds = p * (dp - dsum_ref[0]) * scale            # dsum [BQ, 1]
+    dq_scr[:] = dq_scr[:] + jax.lax.dot_general(
+        ds.astype(k.dtype), k, (((1,), (0,)), ((), ())),
+        preferred_element_type=jnp.float32)
+
+    @pl.when(kb == nk - 1)
+    def _emit():
+        dq_ref[0] = dq_scr[:].astype(dq_ref.dtype)
+
+
+def _bwd_dkv_kernel(k_ref, v_ref, mask_ref, q_ref, do_ref, lse_ref,
+                    dsum_ref, dk_ref, dv_ref, dk_scr, dv_scr, *,
+                    scale: float):
+    """dv = Σ_q pᵀ·dO; dk = Σ_q dsᵀ·Q — accumulated over q blocks."""
+    qb = pl.program_id(2)
+    nq = pl.num_programs(2)
+
+    @pl.when(qb == 0)
+    def _init():
+        dk_scr[:] = jnp.zeros_like(dk_scr)
+        dv_scr[:] = jnp.zeros_like(dv_scr)
+
+    q = q_ref[0]
+    k = k_ref[0]
+    s = jax.lax.dot_general(
+        q, k, (((1,), (1,)), ((), ())),
+        preferred_element_type=jnp.float32) * scale  # [BQ, BK]
+    valid = mask_ref[0, :] != 0
+    p = jnp.exp(s - lse_ref[0])
+    p = jnp.where(valid[None, :], p, 0.0)
+    do = do_ref[0].astype(jnp.float32)
+    dv_scr[:] = dv_scr[:] + jax.lax.dot_general(     # pᵀ [BK,BQ] · dO
+        p.astype(do_ref.dtype), do, (((0,), (0,)), ((), ())),
+        preferred_element_type=jnp.float32)
+    dp = jax.lax.dot_general(
+        do, v_ref[0], (((1,), (1,)), ((), ())),
+        preferred_element_type=jnp.float32)
+    ds = p * (dp - dsum_ref[0]) * scale
+    dk_scr[:] = dk_scr[:] + jax.lax.dot_general(     # dsᵀ [BK,BQ] · Q
+        ds.astype(q.dtype), q, (((0,), (0,)), ((), ())),
+        preferred_element_type=jnp.float32)
+
+    @pl.when(qb == nq - 1)
+    def _emit():
+        dk_ref[0] = dk_scr[:].astype(dk_ref.dtype)
+        dv_ref[0] = dv_scr[:].astype(dv_ref.dtype)
+
+
+@functools.partial(jax.jit,
+                   static_argnames=("block_q", "block_k", "interpret"))
+def _flash_backward(q, k, v, key_mask, o, lse, g, *, block_q: int = 256,
+                    block_k: int = 512, interpret: bool = False):
+    """Fused FlashAttention-2-style backward: recompute p per block from
+    the saved logsumexp, never materializing [T, T] in HBM."""
+    qf, kf, vf, mask, (B, H, T, D, bq, bk, qp, kp) = _flash_pack(
+        q, k, v, key_mask, block_q, block_k)
+    scale = D ** -0.5
+    gf = jnp.pad(g.reshape(B * H, T, D), ((0, 0), (0, qp), (0, 0)))
+    # D_i = Σ_d dO·O per row; zero for padded rows since g pads with 0
+    dsum = jnp.sum(g.astype(jnp.float32) * o.astype(jnp.float32),
+                   axis=-1).reshape(B * H, T)
+    dsum = jnp.pad(dsum, ((0, 0), (0, qp)))[..., None]   # [BH, Tq, 1]
+    lse_f = jnp.pad(lse.reshape(B * H, T), ((0, 0), (0, qp)),
+                    constant_values=0.0)[..., None]      # [BH, Tq, 1]
+    nq, nk = (T + qp) // bq, (T + kp) // bk
+
+    dq = pl.pallas_call(
+        functools.partial(_bwd_dq_kernel, scale=scale),
         grid=(B * H, nq, nk),
         in_specs=[
             pl.BlockSpec((1, bq, D), lambda b, iq, ik: (b, iq, 0)),
             pl.BlockSpec((1, bk, D), lambda b, iq, ik: (b, ik, 0)),
             pl.BlockSpec((1, bk, D), lambda b, iq, ik: (b, ik, 0)),
             pl.BlockSpec((1, bk), lambda b, iq, ik: (b, ik)),
+            pl.BlockSpec((1, bq, D), lambda b, iq, ik: (b, iq, 0)),
+            pl.BlockSpec((1, bq, 1), lambda b, iq, ik: (b, iq, 0)),
+            pl.BlockSpec((1, bq, 1), lambda b, iq, ik: (b, iq, 0)),
         ],
         out_specs=pl.BlockSpec((1, bq, D), lambda b, iq, ik: (b, iq, 0)),
-        out_shape=jax.ShapeDtypeStruct((B * H, T + qp, D), v.dtype),
-        scratch_shapes=[
-            pltpu.VMEM((bq, 128), jnp.float32),   # running max
-            pltpu.VMEM((bq, 128), jnp.float32),   # running denominator
-            pltpu.VMEM((bq, D), jnp.float32),     # output accumulator
-        ],
+        out_shape=jax.ShapeDtypeStruct((B * H, T + qp, D), q.dtype),
+        scratch_shapes=[pltpu.VMEM((bq, D), jnp.float32)],
         compiler_params=pltpu.CompilerParams(
             dimension_semantics=("parallel", "parallel", "arbitrary")),
         interpret=interpret,
-    )(qf, kf, vf, mask)
-    return out[:, :T].reshape(B, H, T, D)
+    )(qf, kf, vf, mask, gf, lse_f, dsum)
+
+    dk, dv = pl.pallas_call(
+        functools.partial(_bwd_dkv_kernel, scale=scale),
+        grid=(B * H, nk, nq),
+        in_specs=[
+            pl.BlockSpec((1, bk, D), lambda b, ik, iq: (b, ik, 0)),
+            pl.BlockSpec((1, bk, D), lambda b, ik, iq: (b, ik, 0)),
+            pl.BlockSpec((1, bk), lambda b, ik, iq: (b, ik)),
+            pl.BlockSpec((1, bq, D), lambda b, ik, iq: (b, iq, 0)),
+            pl.BlockSpec((1, bq, D), lambda b, ik, iq: (b, iq, 0)),
+            pl.BlockSpec((1, bq, 1), lambda b, ik, iq: (b, iq, 0)),
+            pl.BlockSpec((1, bq, 1), lambda b, ik, iq: (b, iq, 0)),
+        ],
+        out_specs=(
+            pl.BlockSpec((1, bk, D), lambda b, ik, iq: (b, ik, 0)),
+            pl.BlockSpec((1, bk, D), lambda b, ik, iq: (b, ik, 0)),
+        ),
+        out_shape=(
+            jax.ShapeDtypeStruct((B * H, T + kp, D), k.dtype),
+            jax.ShapeDtypeStruct((B * H, T + kp, D), v.dtype),
+        ),
+        scratch_shapes=[pltpu.VMEM((bk, D), jnp.float32),
+                        pltpu.VMEM((bk, D), jnp.float32)],
+        compiler_params=pltpu.CompilerParams(
+            dimension_semantics=("parallel", "parallel", "arbitrary")),
+        interpret=interpret,
+    )(kf, vf, mask, qf, gf, lse_f, dsum)
+
+    return (dq[:, :T].reshape(B, H, T, D),
+            dk[:, :T].reshape(B, H, T, D),
+            dv[:, :T].reshape(B, H, T, D))
 
 
-@functools.partial(jax.custom_vjp, nondiff_argnums=(4, 5, 6))
-def _flash(q, k, v, key_mask, block_q, block_k, interpret):
+@functools.partial(jax.custom_vjp, nondiff_argnums=(4, 5, 6, 7))
+def _flash(q, k, v, key_mask, block_q, block_k, interpret, bwd_impl):
     return _flash_forward(q, k, v, key_mask, block_q=block_q,
                           block_k=block_k, interpret=interpret)
 
 
-def _flash_fwd(q, k, v, key_mask, block_q, block_k, interpret):
-    out = _flash(q, k, v, key_mask, block_q, block_k, interpret)
-    return out, (q, k, v, key_mask)
+def _flash_fwd(q, k, v, key_mask, block_q, block_k, interpret, bwd_impl):
+    # forward-for-gradient also emits the logsumexp row stats, but only
+    # when the fused backward will actually consume them — the blockwise
+    # backward recomputes from q/k/v and would otherwise pin out+lse in
+    # the residuals for nothing
+    fused_bwd = bwd_impl == "pallas" or (bwd_impl == "auto"
+                                         and not interpret)
+    if fused_bwd:
+        out, lse = _flash_forward(q, k, v, key_mask, block_q=block_q,
+                                  block_k=block_k, interpret=interpret,
+                                  with_lse=True)
+        return out, (q, k, v, key_mask, out, lse)
+    out = _flash_forward(q, k, v, key_mask, block_q=block_q,
+                         block_k=block_k, interpret=interpret)
+    return out, (q, k, v, key_mask, None, None)
 
 
-def _flash_bwd(block_q, block_k, interpret, res, g):
+def _flash_bwd(block_q, block_k, interpret, bwd_impl, res, g):
+    q, k, v, key_mask, out, lse = res
+    if bwd_impl == "pallas" or (bwd_impl == "auto" and not interpret):
+        # fused FA2-style backward: per-block p recomputed from the
+        # saved logsumexp, [T, T] never touches HBM
+        dq, dk, dv = _flash_backward(q, k, v, key_mask, out, lse, g,
+                                     block_q=block_q, block_k=block_k,
+                                     interpret=interpret)
+        return dq, dk, dv, None
     # recompute-based backward through the XLA blockwise formulation:
-    # same math, O(T) memory, and jax.vjp handles the chain exactly
+    # same math, O(T) memory — the right choice off-TPU where the Pallas
+    # interpreter would crawl
     from ..parallel.ring_attention import blockwise_attention
-    q, k, v, key_mask = res
 
     def ref(q, k, v):
         return blockwise_attention(q, k, v, block_size=block_k,
@@ -156,13 +360,22 @@ _flash.defvjp(_flash_fwd, _flash_bwd)
 
 
 def flash_attention(q, k, v, key_mask=None, *, block_q: int = 256,
-                    block_k: int = 512, interpret: bool | None = None):
+                    block_k: int = 512, interpret: bool | None = None,
+                    bwd_impl: str = "auto"):
     """Fused flash attention. q/k/v [B, H, T, D]; ``key_mask`` [B, T]
     bool (True = valid). Off-TPU it runs the Pallas interpreter (slow —
     tests only); the XLA ``blockwise`` impl is the right CPU choice.
+
+    ``bwd_impl``: "auto" uses the fused Pallas backward on TPU and the
+    XLA blockwise recompute elsewhere; "pallas"/"blockwise" force one
+    (tests force "pallas" under the interpreter).
     """
     if interpret is None:
         interpret = target_platform() not in ("tpu", "axon")
+    if bwd_impl not in ("auto", "pallas", "blockwise"):
+        raise ValueError(f"bwd_impl={bwd_impl!r} is not one of "
+                         "auto|pallas|blockwise")
     if key_mask is None:
         key_mask = jnp.ones((q.shape[0], q.shape[2]), bool)
-    return _flash(q, k, v, key_mask, block_q, block_k, bool(interpret))
+    return _flash(q, k, v, key_mask, block_q, block_k, bool(interpret),
+                  bwd_impl)

@@ -106,12 +106,15 @@ def make_train_step(module, tx, mesh=None,
 
         def loss_of(params):
             variables = {"params": params}
-            mutable = []
             if state.batch_stats:
                 variables["batch_stats"] = state.batch_stats
-                mutable = ["batch_stats"]
-            out = module.apply(variables, images, True, mutable=mutable)
-            outputs, new_model_state = out if mutable else (out, {})
+                outputs, new_model_state = module.apply(
+                    variables, images, True, mutable=["batch_stats"])
+            else:
+                # no mutable kwarg at all: flax returns (out, state) for
+                # ANY list-valued mutable, including []
+                outputs = module.apply(variables, images, True)
+                new_model_state = {}
             logits = outputs[fetch] if isinstance(outputs, dict) else outputs
             return loss_of.loss(logits, labels), new_model_state
 
