@@ -242,18 +242,26 @@ def _bwd_dkv_kernel(k_ref, v_ref, mask_ref, q_ref, do_ref, lse_ref,
 
 @functools.partial(jax.jit,
                    static_argnames=("block_q", "block_k", "interpret"))
-def _flash_backward(q, k, v, key_mask, o, lse, g, *, block_q: int = 256,
-                    block_k: int = 512, interpret: bool = False):
+def _flash_backward(q, k, v, key_mask, o, lse, g, dlse=None, *,
+                    block_q: int = 256, block_k: int = 512,
+                    interpret: bool = False):
     """Fused FlashAttention-2-style backward: recompute p per block from
-    the saved logsumexp, never materializing [T, T] in HBM."""
+    the saved logsumexp, never materializing [T, T] in HBM.
+
+    ``dlse``: cotangent of the logsumexp output (the lse-returning
+    variant). ∂lse/∂s_j = p_j folds into the D-term: ds = p·(dp − (D −
+    dlse))·scale."""
     qf, kf, vf, mask, (B, H, T, D, bq, bk, qp, kp) = _flash_pack(
         q, k, v, key_mask, block_q, block_k)
     scale = D ** -0.5
     gf = jnp.pad(g.reshape(B * H, T, D), ((0, 0), (0, qp), (0, 0)))
     # D_i = Σ_d dO·O per row; zero for padded rows since g pads with 0
     dsum = jnp.sum(g.astype(jnp.float32) * o.astype(jnp.float32),
-                   axis=-1).reshape(B * H, T)
-    dsum = jnp.pad(dsum, ((0, 0), (0, qp)))[..., None]   # [BH, Tq, 1]
+                   axis=-1)                                 # [B, H, T]
+    if dlse is not None:
+        dsum = dsum - dlse.astype(jnp.float32)
+    dsum = jnp.pad(dsum.reshape(B * H, T),
+                   ((0, 0), (0, qp)))[..., None]            # [BH, Tq, 1]
     lse_f = jnp.pad(lse.reshape(B * H, T), ((0, 0), (0, qp)),
                     constant_values=0.0)[..., None]      # [BH, Tq, 1]
     nq, nk = (T + qp) // bq, (T + kp) // bk
@@ -357,6 +365,68 @@ def _flash_bwd(block_q, block_k, interpret, bwd_impl, res, g):
 
 
 _flash.defvjp(_flash_fwd, _flash_bwd)
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(4, 5, 6))
+def _flash_lse(q, k, v, key_mask, block_q, block_k, interpret):
+    return _flash_forward(q, k, v, key_mask, block_q=block_q,
+                          block_k=block_k, interpret=interpret,
+                          with_lse=True)
+
+
+def _flash_lse_fwd(q, k, v, key_mask, block_q, block_k, interpret):
+    out, lse = _flash_forward(q, k, v, key_mask, block_q=block_q,
+                              block_k=block_k, interpret=interpret,
+                              with_lse=True)
+    return (out, lse), (q, k, v, key_mask, out, lse)
+
+
+# test hook: force the fused backward through the interpreter so the
+# dlse kernel math is exercised off-TPU (tiny shapes only — slow)
+_FORCE_FUSED_LSE_BWD = False
+
+
+def _flash_lse_bwd(block_q, block_k, interpret, res, cots):
+    g, dlse = cots
+    q, k, v, key_mask, out, lse = res
+    if not interpret or _FORCE_FUSED_LSE_BWD:
+        dq, dk, dv = _flash_backward(q, k, v, key_mask, out, lse, g,
+                                     dlse=dlse, block_q=block_q,
+                                     block_k=block_k,
+                                     interpret=interpret)
+        return dq, dk, dv, None
+    # off-TPU: XLA recompute through the blockwise (o, lse) reference —
+    # the interpreted Pallas backward would crawl (tests force it via
+    # flash_attention_lse(..., interpret=False) refs when needed)
+    from ..parallel.ring_attention import blockwise_attention
+
+    def ref(q, k, v):
+        return blockwise_attention(q, k, v, block_size=block_k,
+                                   key_mask=key_mask, return_lse=True)
+
+    _, vjp = jax.vjp(ref, q, k, v)
+    dq, dk, dv = vjp((g, dlse))
+    return dq, dk, dv, None
+
+
+_flash_lse.defvjp(_flash_lse_fwd, _flash_lse_bwd)
+
+
+def flash_attention_lse(q, k, v, key_mask=None, *, block_q: int = 256,
+                        block_k: int = 512,
+                        interpret: bool | None = None):
+    """Flash attention that also returns the per-row logsumexp of the
+    scaled scores — the merge statistic ring attention needs to combine
+    per-shard partial attentions. Returns ``(o [B,H,T,D], lse [B,H,T])``;
+    fully-masked rows report lse ≈ -1e30 (their o is zero), which the
+    standard lse-merge treats as an empty contribution. Differentiable
+    in both outputs (fused Pallas backward)."""
+    if interpret is None:
+        interpret = target_platform() not in ("tpu", "axon")
+    if key_mask is None:
+        key_mask = jnp.ones((q.shape[0], q.shape[2]), bool)
+    return _flash_lse(q, k, v, key_mask, block_q, block_k,
+                      bool(interpret))
 
 
 def flash_attention(q, k, v, key_mask=None, *, block_q: int = 256,

@@ -176,18 +176,20 @@ def make_attention_fn(impl: str = "dense", mesh=None, axis: str = "sp",
         from ..parallel.ring_attention import blockwise_attention
         return lambda q, k, v, m=None: blockwise_attention(
             q, k, v, block_size=block_size, key_mask=m)
-    if impl == "ring":
+    if impl in ("ring", "ring_flash"):
         from ..parallel.ring_attention import make_ring_attention
         if mesh is None:
             raise ValueError("ring attention needs a mesh")
-        return make_ring_attention(mesh, causal=False, axis=axis)
+        return make_ring_attention(
+            mesh, causal=False, axis=axis,
+            local_impl="flash" if impl == "ring_flash" else "blockwise")
     if impl == "ulysses":
         from ..parallel.ulysses import make_ulysses_attention
         if mesh is None:
             raise ValueError("ulysses attention needs a mesh")
         return make_ulysses_attention(mesh, axis=axis)
     raise ValueError(f"unknown attention impl {impl!r}; expected "
-                     "dense|pallas|blockwise|ring|ulysses")
+                     "dense|pallas|blockwise|ring|ring_flash|ulysses")
 
 
 class TextEncoderFeaturizer(Transformer, HasInputCol, HasOutputCol,
@@ -204,7 +206,7 @@ class TextEncoderFeaturizer(Transformer, HasInputCol, HasOutputCol,
     """
 
     attentionImpl = Param("attentionImpl",
-                          "dense|pallas|blockwise|ring|ulysses",
+                          "dense|pallas|blockwise|ring|ring_flash|ulysses",
                           TC.toString, default="dense", has_default=True)
     seqChunk = Param("seqChunk", "pad sequence length to a multiple of "
                      "this (ring/ulysses need the sp-axis size to "

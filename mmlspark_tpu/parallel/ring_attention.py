@@ -43,13 +43,14 @@ def _block_update(q, k, v, m, l, acc, bias, scale):
 
 def blockwise_attention(q, k, v, *, block_size: int = 512,
                         causal: bool = False, scale: float | None = None,
-                        key_mask=None):
+                        key_mask=None, return_lse: bool = False):
     """Single-device blockwise (flash-style) attention.
 
     q/k/v: [B, H, T, D]. Computes exact softmax attention in blocks over the
     key axis so the [T, T] score matrix never materializes. ``key_mask``
     [B, T] bool marks valid keys (False = e.g. padding, excluded from
-    the softmax).
+    the softmax). ``return_lse`` additionally returns the per-row
+    logsumexp [B, H, T] (fully-masked rows report -inf).
     """
     B, H, T, D = q.shape
     scale = scale if scale is not None else D ** -0.5
@@ -89,16 +90,27 @@ def blockwise_attention(q, k, v, *, block_size: int = 512,
     l0 = jnp.zeros((B, H, T), q.dtype)
     a0 = jnp.zeros_like(q)
     m, l, acc = jax.lax.fori_loop(0, nb, body, (m0, l0, a0))
-    return acc / jnp.maximum(l, 1e-35)[..., None]
+    out = acc / jnp.maximum(l, 1e-35)[..., None]
+    if return_lse:
+        return out, m + jnp.log(jnp.maximum(l, 1e-35))
+    return out
 
 
 def ring_attention(q, k, v, *, axis: str = "sp", causal: bool = False,
-                   scale: float | None = None, key_mask=None):
+                   scale: float | None = None, key_mask=None,
+                   local_impl: str = "blockwise"):
     """Exact attention with Q/K/V sharded over mesh axis ``axis`` along T.
 
     Call inside ``shard_map``: each shard holds [B, H, T/n, D]. K/V rotate
     n-1 times around the ring; causal masking uses global block positions
     (shards are assumed laid out in sequence order along the axis).
+
+    ``local_impl``: "blockwise" computes each shard-local attention with
+    the XLA running-softmax update; "flash" uses the fused Pallas kernel
+    per ring step (``dl/pallas_attention.flash_attention_lse``) and
+    merges the per-step normalized partials via the standard lse merge —
+    the TPU choice (non-causal only: the kernel masks keys, not
+    positions).
     """
     n = jax.lax.axis_size(axis)
     my = jax.lax.axis_index(axis)
@@ -109,6 +121,48 @@ def ring_attention(q, k, v, *, axis: str = "sp", causal: bool = False,
 
     if key_mask is None:
         key_mask = jnp.ones((B, Tl), bool)
+
+    if local_impl == "flash":
+        if causal:
+            raise NotImplementedError(
+                "local_impl='flash' supports non-causal ring attention "
+                "only (the fused kernel masks keys, not positions)")
+        if scale != D ** -0.5:
+            raise NotImplementedError(
+                "local_impl='flash' uses the kernel's fixed D**-0.5 "
+                "scale")
+        from ..dl.pallas_attention import flash_attention_lse
+
+        def body_flash(i, carry):
+            o, lse, kc, vc, mc = carry
+            o_i, lse_i = flash_attention_lse(q, kc, vc, key_mask=mc)
+            # merge two normalized partial attentions: softmax weights
+            # are exp(lse - M) per side; empty sides carry lse ≈ -1e30.
+            # The o carry accumulates in f32 (the merge weights are f32;
+            # a bf16 carry would promote and break the fori_loop carry
+            # aval), cast back after the loop.
+            m_new = jnp.maximum(lse, lse_i)
+            la = jnp.exp(lse - m_new)
+            lb = jnp.exp(lse_i - m_new)
+            denom = jnp.maximum(la + lb, 1e-35)
+            o = (o * la[..., None]
+                 + o_i.astype(jnp.float32) * lb[..., None]) \
+                / denom[..., None]
+            lse = m_new + jnp.log(denom)
+            perm = [(j, (j + 1) % n) for j in range(n)]
+            kc = jax.lax.ppermute(kc, axis, perm)
+            vc = jax.lax.ppermute(vc, axis, perm)
+            mc = jax.lax.ppermute(mc, axis, perm)
+            return o, lse, kc, vc, mc
+
+        o0 = jnp.zeros(q.shape, jnp.float32)
+        lse0 = jnp.full((B, H, Tl), -1e30, jnp.float32)
+        o, _, _, _, _ = jax.lax.fori_loop(
+            0, n, body_flash, (o0, lse0, k, v, key_mask))
+        return o.astype(q.dtype)
+    if local_impl != "blockwise":
+        raise ValueError(f"unknown local_impl {local_impl!r}; expected "
+                         "blockwise|flash")
 
     def body(i, carry):
         m, l, acc, kc, vc, mc = carry
@@ -138,7 +192,8 @@ def ring_attention(q, k, v, *, axis: str = "sp", causal: bool = False,
 
 
 def make_ring_attention(mesh, *, causal: bool = False, axis: str = "sp",
-                        batch_axis: str | None = None):
+                        batch_axis: str | None = None,
+                        local_impl: str = "blockwise"):
     """shard_map-wrapped ring attention: [B, H, T, D] sharded on T over
     ``axis`` (and optionally on B over ``batch_axis`` — 2D data x
     sequence parallelism; the ring runs independently per batch shard).
@@ -153,7 +208,7 @@ def make_ring_attention(mesh, *, causal: bool = False, axis: str = "sp",
         check_vma=False)
     def mapped(q, k, v, kmask):
         return ring_attention(q, k, v, axis=axis, causal=causal,
-                              key_mask=kmask)
+                              key_mask=kmask, local_impl=local_impl)
 
     def fn(q, k, v, key_mask=None):
         if key_mask is None:
