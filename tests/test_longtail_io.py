@@ -209,3 +209,68 @@ class TestFileStream:
         assert batch["image"][0].shape == (4, 5, 3)
         assert batch["image"][1] is None
         assert batch["error"][1] is not None
+
+
+class TestPowerBIWriter:
+    """Reference ``io/powerbi/PowerBIWriter.scala`` — POST row batches
+    to a push-dataset endpoint, batched by batch_size."""
+
+    def test_batches_posted_to_local_endpoint(self):
+        from mmlspark_tpu.io.powerbi import PowerBIWriter
+
+        bodies = []
+
+        class H(BaseHTTPRequestHandler):
+            def do_POST(self):
+                n = int(self.headers["Content-Length"])
+                bodies.append(json.loads(self.rfile.read(n)))
+                self.send_response(200)
+                self.end_headers()
+
+            def log_message(self, *a):
+                pass
+
+        srv = ThreadingHTTPServer(("127.0.0.1", 0), H)
+        threading.Thread(target=srv.serve_forever, daemon=True).start()
+        try:
+            df = DataFrame({"x": np.arange(7, dtype=np.float64),
+                            "name": np.asarray(list("abcdefg"), object)})
+            url = f"http://127.0.0.1:{srv.server_address[1]}/push"
+            sent = PowerBIWriter(url, batch_size=3).write(df)
+            assert sent == 3                      # 3 + 3 + 1 rows
+            got = [r for b in bodies for r in b["rows"]]
+            assert len(got) == 7
+            assert got[0]["name"] == "a" and got[6]["x"] == 6.0
+        finally:
+            srv.shutdown()
+
+
+def test_make_reply_udf_typed_values():
+    """Reference ``ServingUDFs.makeReplyUDF`` — every payload type maps
+    to a proper HTTPResponseData."""
+    from mmlspark_tpu.serving.udfs import make_reply_udf
+
+    r = make_reply_udf("hello")
+    assert r.status_code == 200 and r.entity == b"hello"
+    r = make_reply_udf(b"\x01\x02")
+    assert r.entity == b"\x01\x02"
+    r = make_reply_udf({"a": [1, 2]})
+    assert json.loads(r.entity) == {"a": [1, 2]}
+    assert r.headers.get("Content-Type") == "application/json"
+    r = make_reply_udf(np.asarray([1.5, 2.5]))
+    assert json.loads(r.entity) == [1.5, 2.5]
+    assert make_reply_udf(r) is r                # idempotent
+
+
+def test_assert_model_equal_catches_differences():
+    """testing.assert_model_equal — the ModelEquality analog the fuzzing
+    suite leans on must both pass equals and fail unequals."""
+    from mmlspark_tpu.stages import RenameColumn
+    from mmlspark_tpu.testing import assert_model_equal
+
+    a = RenameColumn(inputCol="x", outputCol="y")
+    b = RenameColumn(inputCol="x", outputCol="y")
+    assert_model_equal(a, b)
+    c = RenameColumn(inputCol="x", outputCol="z")
+    with pytest.raises(AssertionError):
+        assert_model_equal(a, c)

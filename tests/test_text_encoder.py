@@ -57,10 +57,10 @@ def test_batch_composition_independence(token_df, dense_features):
 
 
 @pytest.mark.parametrize("impl", ["blockwise", "pallas", "ring",
-                                  "ulysses"])
+                                  "ring_flash", "ulysses"])
 def test_sharded_impls_match_dense(impl, token_df, dense_features):
     mesh = None
-    if impl in ("ring", "ulysses"):
+    if impl in ("ring", "ring_flash", "ulysses"):
         mesh = Mesh(np.asarray(jax.devices()), ("sp",))
     out = TextEncoderFeaturizer(mesh=mesh, attentionImpl=impl,
                                 width=64, depth=2).transform(token_df)
