@@ -12,7 +12,10 @@ whose attention implementation is pluggable:
   ``sp`` mesh axis, K/V rotating via ``ppermute``
   (``parallel/ring_attention.py``);
 - ``ulysses``  — all-to-all head/sequence reshard
-  (``parallel/ulysses.py``).
+  (``parallel/ulysses.py``);
+- ``ring_flash`` / ``ulysses_flash`` — the sharded impls with the fused
+  Pallas kernel (``pallas_attention.py``) as each device's local
+  attention (non-causal).
 
 ``TextEncoderFeaturizer`` wraps it as a pipeline stage: token-id rows →
 mean-pooled embeddings, the text counterpart of ``ImageFeaturizer``.
@@ -183,13 +186,17 @@ def make_attention_fn(impl: str = "dense", mesh=None, axis: str = "sp",
         return make_ring_attention(
             mesh, causal=False, axis=axis,
             local_impl="flash" if impl == "ring_flash" else "blockwise")
-    if impl == "ulysses":
+    if impl in ("ulysses", "ulysses_flash"):
         from ..parallel.ulysses import make_ulysses_attention
         if mesh is None:
             raise ValueError("ulysses attention needs a mesh")
-        return make_ulysses_attention(mesh, axis=axis)
+        return make_ulysses_attention(
+            mesh, axis=axis,
+            local_impl="flash" if impl == "ulysses_flash"
+            else "blockwise")
     raise ValueError(f"unknown attention impl {impl!r}; expected "
-                     "dense|pallas|blockwise|ring|ring_flash|ulysses")
+                     "dense|pallas|blockwise|ring|ring_flash|ulysses|"
+                     "ulysses_flash")
 
 
 class TextEncoderFeaturizer(Transformer, HasInputCol, HasOutputCol,
@@ -206,7 +213,8 @@ class TextEncoderFeaturizer(Transformer, HasInputCol, HasOutputCol,
     """
 
     attentionImpl = Param("attentionImpl",
-                          "dense|pallas|blockwise|ring|ring_flash|ulysses",
+                          "dense|pallas|blockwise|ring|ring_flash|ulysses|"
+                          "ulysses_flash",
                           TC.toString, default="dense", has_default=True)
     seqChunk = Param("seqChunk", "pad sequence length to a multiple of "
                      "this (ring/ulysses need the sp-axis size to "

@@ -27,15 +27,28 @@ def make_ulysses_attention(mesh: Mesh, axis: str = "sp", *,
                            causal: bool = False,
                            scale: float | None = None,
                            block_size: int = 512,
-                           batch_axis: str | None = None):
+                           batch_axis: str | None = None,
+                           local_impl: str = "blockwise"):
     """Build an all-to-all sequence-parallel attention fn over ``mesh``.
 
     Inputs/outputs are [B, H, T, D] arrays sequence-sharded over ``axis``
     (each device holds T/d of the sequence), optionally batch-sharded
     over ``batch_axis`` (2D data x sequence parallelism). H must be
     divisible by the axis size.
+
+    ``local_impl``: "blockwise" (XLA running softmax) or "flash" (the
+    fused Pallas kernel, ``dl/pallas_attention.py``) for each device's
+    full-sequence head-group attention — flash is non-causal and uses
+    the kernel's fixed D**-0.5 scale.
     """
     d = int(mesh.shape[axis])
+    if local_impl not in ("blockwise", "flash"):
+        raise ValueError(f"unknown local_impl {local_impl!r}; expected "
+                         "blockwise|flash")
+    if local_impl == "flash" and (causal or scale is not None):
+        raise NotImplementedError(
+            "local_impl='flash' supports non-causal attention at the "
+            "default D**-0.5 scale only")
 
     def local(q, k, v, kmask):
         # [B, H, t, D] local sequence shard (t = T/d)
@@ -68,9 +81,15 @@ def make_ulysses_attention(mesh: Mesh, axis: str = "sp", *,
         # every device attends over the full sequence for its head
         # group, so it needs the full key mask
         full_mask = jax.lax.all_gather(kmask, axis, axis=1, tiled=True)
-        out = blockwise_attention(qh, kh, vh, causal=causal, scale=scale,
-                                  block_size=block_size,
-                                  key_mask=full_mask)
+        if local_impl == "flash":
+            from ..dl.pallas_attention import flash_attention
+            out = flash_attention(qh, kh, vh, key_mask=full_mask,
+                                  block_k=block_size)
+        else:
+            out = blockwise_attention(qh, kh, vh, causal=causal,
+                                      scale=scale,
+                                      block_size=block_size,
+                                      key_mask=full_mask)
         return heads_to_seq(out)
 
     spec = P(batch_axis, None, axis, None)

@@ -205,7 +205,8 @@ class TestTwoDimensionalAttention:
     over sp; the ring (and ulysses' all-to-all) run independently per
     batch shard and must match single-device dense attention."""
 
-    def test_ring_dp_sp_matches_dense(self):
+    @pytest.mark.parametrize("local_impl", ["blockwise", "flash"])
+    def test_ring_dp_sp_matches_dense(self, local_impl):
         import jax
         import jax.numpy as jnp
         import numpy as np
@@ -222,7 +223,8 @@ class TestTwoDimensionalAttention:
         mask = jnp.asarray(rng.random((B, T)) > 0.2)
         want = blockwise_attention(q, k, v, key_mask=mask)
 
-        fn = make_ring_attention(mesh, batch_axis="dp")
+        fn = make_ring_attention(mesh, batch_axis="dp",
+                                 local_impl=local_impl)
         sh = NamedSharding(mesh, P("dp", None, "sp", None))
         qs, ks, vs = (jax.device_put(a, sh) for a in (q, k, v))
         ms = jax.device_put(mask, NamedSharding(mesh, P("dp", "sp")))
@@ -230,7 +232,8 @@ class TestTwoDimensionalAttention:
         np.testing.assert_allclose(np.asarray(got), np.asarray(want),
                                    atol=2e-5)
 
-    def test_ulysses_dp_sp_matches_dense(self):
+    @pytest.mark.parametrize("local_impl", ["blockwise", "flash"])
+    def test_ulysses_dp_sp_matches_dense(self, local_impl):
         import jax
         import jax.numpy as jnp
         import numpy as np
@@ -248,7 +251,8 @@ class TestTwoDimensionalAttention:
         mask = jnp.asarray(rng.random((B, T)) > 0.2)
         want = blockwise_attention(q, k, v, key_mask=mask)
 
-        fn = make_ulysses_attention(mesh, batch_axis="dp")
+        fn = make_ulysses_attention(mesh, batch_axis="dp",
+                                    local_impl=local_impl)
         sh = NamedSharding(mesh, P("dp", None, "sp", None))
         qs, ks, vs = (jax.device_put(a, sh) for a in (q, k, v))
         ms = jax.device_put(mask, NamedSharding(mesh, P("dp", "sp")))
@@ -309,3 +313,42 @@ class TestRingFlashLocal:
         np.testing.assert_allclose(np.asarray(out, np.float32),
                                    np.asarray(ref, np.float32),
                                    atol=5e-2)
+
+
+class TestUlyssesFlashLocal:
+    """Ulysses with the fused-Pallas local kernel (interpreted on CPU)
+    must match the blockwise-local variant and differentiate."""
+
+    def _mk(self, seed=15, B=1, H=8, T=64, D=16, dtype=jnp.float32):
+        rng = np.random.default_rng(seed)
+        return tuple(jnp.asarray(rng.normal(size=(B, H, T, D)), dtype)
+                     for _ in range(3))
+
+    def test_matches_blockwise(self):
+        from mmlspark_tpu.parallel.ulysses import make_ulysses_attention
+        q, k, v = self._mk()
+        mask = jnp.asarray(
+            np.random.default_rng(16).random((1, 64)) > 0.2)
+        mesh = Mesh(np.asarray(jax.devices()), ("sp",))
+        out_f = make_ulysses_attention(mesh, local_impl="flash")(
+            q, k, v, key_mask=mask)
+        out_b = make_ulysses_attention(mesh)(q, k, v, key_mask=mask)
+        np.testing.assert_allclose(np.asarray(out_f), np.asarray(out_b),
+                                   atol=2e-5)
+
+    def test_grads_match(self):
+        from mmlspark_tpu.parallel.ulysses import make_ulysses_attention
+        q, k, v = self._mk(seed=17)
+        mesh = Mesh(np.asarray(jax.devices()), ("sp",))
+        fn_f = make_ulysses_attention(mesh, local_impl="flash")
+        fn_b = make_ulysses_attention(mesh)
+        gf = jax.grad(lambda q: fn_f(q, k, v).sum())(q)
+        gb = jax.grad(lambda q: fn_b(q, k, v).sum())(q)
+        np.testing.assert_allclose(np.asarray(gf), np.asarray(gb),
+                                   atol=2e-5)
+
+    def test_causal_flash_raises(self):
+        from mmlspark_tpu.parallel.ulysses import make_ulysses_attention
+        mesh = Mesh(np.asarray(jax.devices()), ("sp",))
+        with pytest.raises(NotImplementedError):
+            make_ulysses_attention(mesh, causal=True, local_impl="flash")

@@ -57,10 +57,11 @@ def test_batch_composition_independence(token_df, dense_features):
 
 
 @pytest.mark.parametrize("impl", ["blockwise", "pallas", "ring",
-                                  "ring_flash", "ulysses"])
+                                  "ring_flash", "ulysses",
+                                  "ulysses_flash"])
 def test_sharded_impls_match_dense(impl, token_df, dense_features):
     mesh = None
-    if impl in ("ring", "ring_flash", "ulysses"):
+    if impl in ("ring", "ring_flash", "ulysses", "ulysses_flash"):
         mesh = Mesh(np.asarray(jax.devices()), ("sp",))
     out = TextEncoderFeaturizer(mesh=mesh, attentionImpl=impl,
                                 width=64, depth=2).transform(token_df)
