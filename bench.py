@@ -475,7 +475,7 @@ def bench_gbdt(extras: dict) -> None:
                              learningRate=0.1)
     clf.fit(df)  # warm the compile cache (binning + tree growth kernels)
     t0 = time.perf_counter()
-    clf.fit(df)
+    model = clf.fit(df)
     dt = time.perf_counter() - t0
 
     rows_per_sec = n_rows * n_iters / dt
@@ -483,6 +483,15 @@ def bench_gbdt(extras: dict) -> None:
     extras["gbdt_fit_seconds"] = round(dt, 3)
     extras["gbdt_vs_lightgbm_cpu"] = round(
         rows_per_sec / GBDT_BASELINE_ROW_ITERS, 3)
+
+    # scoring pace (the serving-relevant half; the reference scores
+    # per-row over JNI, LightGBMBooster.score — here one batched
+    # dispatch routes all rows through all trees)
+    model.transform(df)  # warm
+    t0 = time.perf_counter()
+    model.transform(df)
+    extras["gbdt_score_rows_per_sec"] = round(
+        n_rows / (time.perf_counter() - t0), 1)
 
 
 def bench_ranker(extras: dict) -> None:
@@ -579,10 +588,16 @@ def bench_vw(extras: dict) -> None:
                                  numPasses=passes, numBits=18)
     clf.fit(hashed)  # warm the compile cache
     t0 = time.perf_counter()
-    clf.fit(hashed)
+    model = clf.fit(hashed)
     dt = time.perf_counter() - t0
     extras["vw_rows_per_sec"] = round(n_rows * passes / dt, 1)
     extras["vw_fit_seconds"] = round(dt, 3)
+
+    model.transform(hashed)  # warm
+    t0 = time.perf_counter()
+    model.transform(hashed)
+    extras["vw_score_rows_per_sec"] = round(
+        n_rows / (time.perf_counter() - t0), 1)
 
 
 def bench_serving(extras: dict) -> None:
