@@ -161,3 +161,40 @@ class TestTokenIdEncoder:
         df = DataFrame({"text": np.asarray(["alpha beta"], object)})
         np.testing.assert_array_equal(enc.transform(df)["ids"],
                                       enc2.transform(df)["ids"])
+
+
+def test_remat_blocks_bit_match_gradients():
+    """remat=True recomputes block activations in the backward
+    (jax.checkpoint): params, outputs, AND gradients must equal the
+    stored-activation encoder (to tight tolerance — XLA may fuse the
+    recomputed forward differently) — only the memory/FLOPs trade
+    differs."""
+    import optax
+    import jax.numpy as jnp
+
+    from mmlspark_tpu.dl.text_encoder import TextEncoder
+    from mmlspark_tpu.dl.train import init_train_state, make_train_step
+
+    rng = np.random.default_rng(20)
+    ids = jnp.asarray(rng.integers(1, 200, size=(2, 24)), jnp.int32)
+    y = jnp.asarray([0, 1], jnp.int32)
+    kw = dict(vocab=200, width=32, depth=2, heads=2, mlp_dim=64)
+    grads = {}
+    for remat in (False, True):
+        module = TextEncoder(remat=remat, **kw)
+        tx = optax.sgd(1e-2)
+        state = init_train_state(module, jax.random.PRNGKey(0), ids, tx)
+        step = make_train_step(
+            module, tx, fetch="pooled",
+            loss_fn=lambda pooled, y: jnp.mean(
+                (pooled.mean(-1) - y) ** 2))
+        new_state, loss = step(state, ids, y)
+        grads[remat] = (float(loss), new_state.params)
+    # tight tolerance, not bit-equality: the two are separately jitted
+    # programs and XLA may fuse the recomputed forward differently
+    np.testing.assert_allclose(grads[False][0], grads[True][0],
+                               rtol=1e-6)
+    jax.tree.map(
+        lambda a, b: np.testing.assert_allclose(a, b, rtol=1e-5,
+                                                atol=1e-7),
+        grads[False][1], grads[True][1])
