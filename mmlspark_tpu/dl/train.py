@@ -91,10 +91,20 @@ def softmax_xent(logits, labels):
 def make_train_step(module, tx, mesh=None,
                     loss_fn: Callable = softmax_xent,
                     fetch: str = "logits",
-                    batch_axes: tuple[str, ...] = ("dp",)):
+                    batch_axes: tuple[str, ...] = ("dp",),
+                    accum_steps: int = 1):
     """Build a jitted SPMD train step: (state, images, labels) → (state,
     loss). With a mesh, inputs are constrained batch-sharded and params
-    follow their placed shardings (GSPMD adds the gradient reductions)."""
+    follow their placed shardings (GSPMD adds the gradient reductions).
+
+    ``accum_steps > 1``: the batch splits into that many microbatches
+    whose gradients average under one ``lax.scan`` before a single
+    optimizer update — the large-effective-batch pattern when one
+    microbatch is all HBM affords. The batch dimension must divide by
+    ``accum_steps`` (and, with a mesh, each microbatch must still divide
+    the batch axes — otherwise GSPMD has to gather the unshardable
+    remainder). BatchNorm-style mutable stats take the LAST microbatch's
+    update (running averages, not exact-batch stats)."""
 
     def step(state: TrainState, images, labels):
         if mesh is not None:
@@ -104,23 +114,62 @@ def make_train_step(module, tx, mesh=None,
             labels = jax.lax.with_sharding_constraint(
                 labels, NamedSharding(mesh, P(*bspec)))
 
-        def loss_of(params):
+        def loss_of(params, stats, imgs, lbls):
             variables = {"params": params}
-            if state.batch_stats:
-                variables["batch_stats"] = state.batch_stats
+            if stats:
+                variables["batch_stats"] = stats
                 outputs, new_model_state = module.apply(
-                    variables, images, True, mutable=["batch_stats"])
+                    variables, imgs, True, mutable=["batch_stats"])
             else:
                 # no mutable kwarg at all: flax returns (out, state) for
                 # ANY list-valued mutable, including []
-                outputs = module.apply(variables, images, True)
+                outputs = module.apply(variables, imgs, True)
                 new_model_state = {}
             logits = outputs[fetch] if isinstance(outputs, dict) else outputs
-            return loss_of.loss(logits, labels), new_model_state
+            return loss_of.loss(logits, lbls), new_model_state
 
         loss_of.loss = loss_fn
-        (loss, new_model_state), grads = jax.value_and_grad(
-            loss_of, has_aux=True)(state.params)
+        grad_fn = jax.value_and_grad(loss_of, has_aux=True)
+        if accum_steps <= 1:
+            (loss, new_model_state), grads = grad_fn(
+                state.params, state.batch_stats, images, labels)
+        else:
+            n = images.shape[0]
+            if n % accum_steps:
+                raise ValueError(
+                    f"batch size {n} must divide by accum_steps="
+                    f"{accum_steps}")
+            m = n // accum_steps
+            imgs_mb = images.reshape(accum_steps, m, *images.shape[1:])
+            lbls_mb = labels.reshape(accum_steps, m, *labels.shape[1:])
+            if mesh is not None:
+                # keep each microbatch dp-sharded: without the constraint
+                # GSPMD all-gathers the split batch inside the scan,
+                # growing memory+comms instead of shrinking them
+                mb_axes = batch_axes if len(batch_axes) > 1 \
+                    else (batch_axes[0],)
+                imgs_mb = jax.lax.with_sharding_constraint(
+                    imgs_mb, NamedSharding(mesh, P(None, *mb_axes)))
+                lbls_mb = jax.lax.with_sharding_constraint(
+                    lbls_mb, NamedSharding(mesh, P(None, *mb_axes)))
+
+            def accum(carry, mb):
+                g_acc, l_acc, stats = carry
+                imgs, lbls = mb
+                (loss_i, mstate), g_i = grad_fn(state.params, stats,
+                                                imgs, lbls)
+                g_acc = jax.tree.map(jnp.add, g_acc, g_i)
+                stats = mstate.get("batch_stats", stats)
+                return (g_acc, l_acc + loss_i, stats), None
+
+            g0 = jax.tree.map(jnp.zeros_like, state.params)
+            (grads, loss, stats), _ = jax.lax.scan(
+                accum, (g0, jnp.float32(0.0), state.batch_stats),
+                (imgs_mb, lbls_mb))
+            inv = 1.0 / accum_steps
+            grads = jax.tree.map(lambda g: g * inv, grads)
+            loss = loss * inv
+            new_model_state = {"batch_stats": stats} if stats else {}
         updates, new_opt = tx.update(grads, state.opt_state, state.params)
         new_params = optax.apply_updates(state.params, updates)
         if mesh is not None:

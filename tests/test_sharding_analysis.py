@@ -122,3 +122,26 @@ class TestGBDTCollectives:
         hlo = jax.jit(fn).lower(bins, g, g, fm, rm).compile().as_text()
         assert "all-reduce" in hlo, (
             "distributed grow_tree must all-reduce histograms")
+
+
+def test_grad_accum_keeps_batch_sharded():
+    """accum_steps with a dp mesh must NOT all-gather the batch: the
+    microbatch reshape carries a sharding constraint so each device
+    keeps only its batch shard through the scan."""
+    import optax
+    from jax.sharding import Mesh
+
+    from mmlspark_tpu.dl.text_encoder import TextEncoder
+    from mmlspark_tpu.dl.train import init_train_state, make_train_step
+
+    mesh = Mesh(np.asarray(jax.devices()), ("dp",))
+    module = TextEncoder(vocab=64, width=16, depth=1, heads=2, mlp_dim=32)
+    tx = optax.sgd(1e-2)
+    # microbatch rows (batch/accum) must still divide the dp axis
+    ids = jnp.ones((32, 8), jnp.int32)
+    y = jnp.zeros(32, jnp.int32)
+    state = init_train_state(module, jax.random.PRNGKey(0), ids, tx)
+    step = make_train_step(module, tx, mesh=mesh, fetch="pooled",
+                           loss_fn=lambda p, t: p.sum(), accum_steps=2)
+    hlo = step.lower(state, ids, y).compile().as_text()
+    assert "all-gather" not in hlo, "batch was gathered inside the scan"

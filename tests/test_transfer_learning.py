@@ -213,3 +213,46 @@ def test_pretrained_chain_torch_to_featurizer(tmp_path):
         allow_random_init=True))
     assert acc_pretrained > 0.8, (acc_pretrained, acc_random)
     assert acc_pretrained > acc_random + 0.05, (acc_pretrained, acc_random)
+
+
+def test_gradient_accumulation_matches_full_batch():
+    """accum_steps=k averages microbatch gradients before ONE optimizer
+    update: for a mean loss over a batch split into equal microbatches,
+    the update equals the full-batch step (tight tolerance — summation
+    order differs)."""
+    from mmlspark_tpu.dl.text_encoder import TextEncoder
+    from mmlspark_tpu.dl.train import init_train_state, make_train_step
+
+    rng = np.random.default_rng(30)
+    ids = jnp.asarray(rng.integers(1, 100, size=(8, 16)), jnp.int32)
+    y = jnp.asarray(rng.integers(0, 2, size=8), jnp.int32)
+    kw = dict(vocab=100, width=16, depth=1, heads=2, mlp_dim=32)
+    loss_fn = lambda pooled, y: jnp.mean((pooled.mean(-1) - y) ** 2)  # noqa
+    outs = {}
+    for accum in (1, 4):
+        module = TextEncoder(**kw)
+        tx = optax.sgd(1e-2)
+        state = init_train_state(module, jax.random.PRNGKey(0), ids, tx)
+        step = make_train_step(module, tx, fetch="pooled",
+                               loss_fn=loss_fn, accum_steps=accum)
+        new_state, loss = step(state, ids, y)
+        outs[accum] = (float(loss), new_state.params)
+    np.testing.assert_allclose(outs[1][0], outs[4][0], rtol=1e-5)
+    jax.tree.map(
+        lambda a, b: np.testing.assert_allclose(a, b, rtol=1e-4,
+                                                atol=1e-7),
+        outs[1][1], outs[4][1])
+
+
+def test_gradient_accumulation_rejects_ragged_batch():
+    from mmlspark_tpu.dl.text_encoder import TextEncoder
+    from mmlspark_tpu.dl.train import init_train_state, make_train_step
+
+    module = TextEncoder(vocab=50, width=16, depth=1, heads=2, mlp_dim=32)
+    tx = optax.sgd(1e-2)
+    ids = jnp.asarray(np.ones((6, 8)), jnp.int32)
+    state = init_train_state(module, jax.random.PRNGKey(0), ids, tx)
+    step = make_train_step(module, tx, fetch="pooled",
+                           loss_fn=lambda p, y: p.sum(), accum_steps=4)
+    with pytest.raises(ValueError, match="divide"):
+        step(state, ids, jnp.zeros(6, jnp.int32))
