@@ -78,6 +78,10 @@ class ViT(nn.Module):
     mlp_dim: int = 3072
     num_classes: int = 1000
     dtype: Any = jnp.bfloat16
+    # rematerialize blocks in the backward (jax.checkpoint) — the
+    # fine-tune memory lever; param names unchanged, so converted
+    # checkpoints load identically
+    remat: bool = False
 
     @nn.compact
     def __call__(self, x, train: bool = False):
@@ -99,9 +103,10 @@ class ViT(nn.Module):
                          nn.initializers.normal(stddev=0.02),
                          (1, T, self.width), jnp.float32)
         x = x + pos.astype(self.dtype)
+        block_cls = nn.remat(Block) if self.remat else Block
         for i in range(self.depth):
-            x = Block(self.heads, self.mlp_dim, dtype=self.dtype,
-                      name=f"block{i}")(x)
+            x = block_cls(self.heads, self.mlp_dim, dtype=self.dtype,
+                          name=f"block{i}")(x)
             endpoints[f"block{i + 1}"] = x
         x = nn.LayerNorm(dtype=jnp.float32, name="ln")(x)
         endpoints["pooled"] = x[:, 0].astype(jnp.float32)
@@ -116,10 +121,10 @@ class ViT(nn.Module):
                 + ["pooled", "logits"])
 
 
-def ViT_B_16(num_classes=1000, dtype=jnp.bfloat16):
-    return ViT(num_classes=num_classes, dtype=dtype)
+def ViT_B_16(num_classes=1000, dtype=jnp.bfloat16, remat=False):
+    return ViT(num_classes=num_classes, dtype=dtype, remat=remat)
 
 
-def ViT_L_16(num_classes=1000, dtype=jnp.bfloat16):
+def ViT_L_16(num_classes=1000, dtype=jnp.bfloat16, remat=False):
     return ViT(width=1024, depth=24, heads=16, mlp_dim=4096,
-               num_classes=num_classes, dtype=dtype)
+               num_classes=num_classes, dtype=dtype, remat=remat)

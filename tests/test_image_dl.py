@@ -272,3 +272,32 @@ def test_tpumodel_caches_jitted_apply():
     tm.transform(df)
     assert count["n"] - base == 1, f"{count['n'] - base} traces"
     np.testing.assert_array_equal(out1, out2)
+
+
+def test_vit_remat_matches_stored_activations():
+    """ViT(remat=True): identical params/outputs and near-identical
+    gradients to the stored-activation model — only memory differs."""
+    import jax
+
+    from mmlspark_tpu.models.vit import ViT
+
+    rng = np.random.default_rng(31)
+    x = jnp.asarray(rng.normal(size=(2, 32, 32, 3)), jnp.float32)
+    y = jnp.asarray([0, 1], jnp.int32)
+    # f32 compute: asserts the remat MATH tightly; bf16 recompute
+    # rounding is exercised by the encoder remat test
+    kw = dict(patch=16, width=32, depth=2, heads=2, mlp_dim=64,
+              num_classes=4, dtype=jnp.float32)
+    outs = {}
+    for remat in (False, True):
+        module = ViT(remat=remat, **kw)
+        tx = optax.sgd(1e-2)
+        state = init_train_state(module, jax.random.PRNGKey(0), x, tx)
+        step = make_train_step(module, tx)
+        new_state, loss = step(state, x, y)
+        outs[remat] = (float(loss), new_state.params)
+    np.testing.assert_allclose(outs[False][0], outs[True][0], rtol=1e-6)
+    jax.tree.map(
+        lambda a, b: np.testing.assert_allclose(a, b, rtol=1e-5,
+                                                atol=1e-7),
+        outs[False][1], outs[True][1])
