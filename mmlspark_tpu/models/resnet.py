@@ -87,6 +87,12 @@ class ResNet(nn.Module):
     num_classes: int = 1000
     width: int = 64
     dtype: Any = jnp.bfloat16
+    # rematerialize blocks in the backward (jax.checkpoint) — the
+    # fine-tune memory lever. Blocks get explicit names reproducing the
+    # auto-name counter (``BottleneckBlock_0``…), because nn.remat would
+    # otherwise auto-name them ``CheckpointBottleneckBlock_0`` and break
+    # every converted checkpoint.
+    remat: bool = False
 
     @nn.compact
     def __call__(self, x, train: bool = False):
@@ -98,11 +104,17 @@ class ResNet(nn.Module):
                          dtype=self.dtype, name="bn_init")(x)
         x = nn.relu(x)
         x = nn.max_pool(x, (3, 3), (2, 2), padding=((1, 1), (1, 1)))
+        block_cls = nn.remat(self.block, static_argnums=(2,)) \
+            if self.remat else self.block
+        idx = 0
         for i, n_blocks in enumerate(self.stage_sizes):
             for j in range(n_blocks):
                 strides = 2 if i > 0 and j == 0 else 1
-                x = self.block(self.width * 2 ** i, strides,
-                               dtype=self.dtype)(x, train)
+                x = block_cls(self.width * 2 ** i, strides,
+                              dtype=self.dtype,
+                              name=f"{self.block.__name__}_{idx}")(
+                    x, train)
+                idx += 1
             endpoints[f"stage{i + 1}"] = x
         x = jnp.mean(x, axis=(1, 2))
         endpoints["pooled"] = x.astype(jnp.float32)
@@ -119,21 +131,21 @@ class ResNet(nn.Module):
                 + ["pooled", "logits"])
 
 
-def ResNet18(num_classes=1000, dtype=jnp.bfloat16):
+def ResNet18(num_classes=1000, dtype=jnp.bfloat16, remat=False):
     return ResNet(stage_sizes=(2, 2, 2, 2), block=BasicBlock,
-                  num_classes=num_classes, dtype=dtype)
+                  num_classes=num_classes, dtype=dtype, remat=remat)
 
 
-def ResNet34(num_classes=1000, dtype=jnp.bfloat16):
+def ResNet34(num_classes=1000, dtype=jnp.bfloat16, remat=False):
     return ResNet(stage_sizes=(3, 4, 6, 3), block=BasicBlock,
-                  num_classes=num_classes, dtype=dtype)
+                  num_classes=num_classes, dtype=dtype, remat=remat)
 
 
-def ResNet50(num_classes=1000, dtype=jnp.bfloat16):
+def ResNet50(num_classes=1000, dtype=jnp.bfloat16, remat=False):
     return ResNet(stage_sizes=(3, 4, 6, 3), block=BottleneckBlock,
-                  num_classes=num_classes, dtype=dtype)
+                  num_classes=num_classes, dtype=dtype, remat=remat)
 
 
-def ResNet101(num_classes=1000, dtype=jnp.bfloat16):
+def ResNet101(num_classes=1000, dtype=jnp.bfloat16, remat=False):
     return ResNet(stage_sizes=(3, 4, 23, 3), block=BottleneckBlock,
-                  num_classes=num_classes, dtype=dtype)
+                  num_classes=num_classes, dtype=dtype, remat=remat)

@@ -301,3 +301,43 @@ def test_vit_remat_matches_stored_activations():
         lambda a, b: np.testing.assert_allclose(a, b, rtol=1e-5,
                                                 atol=1e-7),
         outs[False][1], outs[True][1])
+
+
+def test_resnet_remat_stable_names_and_stats():
+    """ResNet remat must (a) keep the exact param tree of the historical
+    auto-named model — converted checkpoints depend on it — (b) update
+    batch_stats through the rematted blocks, (c) match the plain model's
+    training step tightly in f32."""
+    import jax
+
+    from mmlspark_tpu.models.resnet import ResNet18
+
+    rng = np.random.default_rng(33)
+    x = jnp.asarray(rng.normal(size=(2, 32, 32, 3)), jnp.float32)
+    y = jnp.asarray([0, 1], jnp.int32)
+    outs = {}
+    for remat in (False, True):
+        module = ResNet18(num_classes=4, dtype=jnp.float32, remat=remat)
+        tx = optax.sgd(1e-2)
+        state = init_train_state(module, jax.random.PRNGKey(0), x, tx)
+        step = make_train_step(module, tx)
+        new_state, loss = step(state, x, y)
+        outs[remat] = (float(loss), new_state)
+    s_plain, s_remat = outs[False][1], outs[True][1]
+    # (a) identical trees: same leaves, same names (incl. BasicBlock_0…)
+    assert jax.tree_util.tree_structure(s_plain.params) \
+        == jax.tree_util.tree_structure(s_remat.params)
+    assert "BasicBlock_0" in s_plain.params
+    # (b) stats moved off their init under remat
+    init_stats = init_train_state(
+        ResNet18(num_classes=4, dtype=jnp.float32, remat=True),
+        jax.random.PRNGKey(0), x, optax.sgd(1e-2)).batch_stats
+    moved = jax.tree.map(lambda a, b: bool(np.any(a != b)),
+                         init_stats, s_remat.batch_stats)
+    assert any(jax.tree.leaves(moved))
+    # (c) tight f32 agreement
+    np.testing.assert_allclose(outs[False][0], outs[True][0], rtol=1e-6)
+    jax.tree.map(
+        lambda a, b: np.testing.assert_allclose(a, b, rtol=1e-5,
+                                                atol=1e-7),
+        s_plain.params, s_remat.params)
