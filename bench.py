@@ -259,8 +259,12 @@ def bench_train(extras: dict) -> None:
     from mmlspark_tpu.dl.train import init_train_state, make_train_step
     from mmlspark_tpu.models import ModelDownloader
 
+    remat = os.environ.get("MMLSPARK_TPU_BENCH_TRAIN_REMAT") == "1"
     loaded = ModelDownloader().download_by_name(
-        "ResNet50", num_classes=100, allow_random_init=True)
+        "ResNet50", num_classes=100, allow_random_init=True,
+        remat=remat or None)
+    if remat:
+        extras["train_remat"] = True
     tx = optax.sgd(1e-2, momentum=0.9)
     rng = np.random.default_rng(3)
     raw = os.environ.get("MMLSPARK_TPU_BENCH_TRAIN_BATCHES", "128,256")

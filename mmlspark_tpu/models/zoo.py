@@ -101,9 +101,13 @@ class ModelDownloader:
             "MMLSPARK_TPU_MODEL_DIR", "")
 
     def download_by_name(self, name: str, *, num_classes: int | None = None,
-                         dtype=None,
+                         dtype=None, remat: bool | None = None,
                          allow_random_init: bool | None = None) -> LoadedModel:
         """Resolve ``name`` to a ready model.
+
+        ``remat``: rematerialize blocks in the backward
+        (``jax.checkpoint``) — the fine-tune memory lever; param names
+        are unchanged, so checkpoints load identically.
 
         ``allow_random_init``: when no checkpoint is found locally, True
         falls back to deterministic random init (useful for shape checks
@@ -118,6 +122,11 @@ class ModelDownloader:
             kwargs["num_classes"] = num_classes
         if dtype is not None:
             kwargs["dtype"] = dtype
+        if remat is not None:
+            # the fine-tune memory lever (ResNet/ViT/TextEncoder remat
+            # flags); param names are unchanged, so checkpoints load
+            # identically whether or not blocks rematerialize
+            kwargs["remat"] = remat
         module = schema.builder(**kwargs)
         variables = self._load_or_init(schema, module, allow_random_init)
         return LoadedModel(schema=schema, module=module, variables=variables)
