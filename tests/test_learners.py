@@ -214,3 +214,31 @@ def test_metrics_logger_emits_structured_lines(caplog):
         ComputeModelStatistics(labelCol="label").transform(df)
     assert any("Classification Metrics" in r.message
                for r in caplog.records)
+
+
+def test_lr_sweep_through_automl_shares_one_trace():
+    """TuneHyperparameters sweeping ONLY learningRate must reuse one
+    compiled boosting step across every draw x fold (the lr rides the
+    trace as a scalar): the whole sweep leaves a single cache entry."""
+    from mmlspark_tpu.automl import (DoubleRangeHyperParam,
+                                     HyperparamBuilder,
+                                     TuneHyperparameters)
+    from mmlspark_tpu.featurize import Featurize
+    from mmlspark_tpu.lightgbm import trainer as trainer_mod
+
+    df, y = class_df(n=240)
+    df = df.with_column("label", y.astype(np.float32))
+    df = Featurize(inputCols=["age", "city"]).fit(df).transform(df)
+    est = LightGBMClassifier(numIterations=8, numLeaves=7)
+    space = (HyperparamBuilder()
+             .addHyperparam(est, "learningRate",
+                            DoubleRangeHyperParam(0.05, 0.3))).build()
+    trainer_mod._FUSED_CACHE.clear()
+    tuned = TuneHyperparameters(
+        models=[est], paramSpace=space, numFolds=2, numRuns=3,
+        evaluationMetric="accuracy", labelCol="label").fit(df)
+    assert tuned.get("bestMetric") > 0.7
+    # 2 folds x 3 draws x (train shapes: fold split may produce two
+    # row counts) -> at most 2 entries, never one per lr draw
+    assert len(trainer_mod._FUSED_CACHE) <= 2, \
+        sorted((k.n, k.tp.learning_rate) for k in trainer_mod._FUSED_CACHE)
