@@ -238,7 +238,9 @@ def test_lr_sweep_through_automl_shares_one_trace():
         models=[est], paramSpace=space, numFolds=2, numRuns=3,
         evaluationMetric="accuracy", labelCol="label").fit(df)
     assert tuned.get("bestMetric") > 0.7
-    # 2 folds x 3 draws x (train shapes: fold split may produce two
-    # row counts) -> at most 2 entries, never one per lr draw
-    assert len(trainer_mod._FUSED_CACHE) <= 2, \
+    # exactly 2 entries: one for the 120-row fold-train shape (shared
+    # by every draw x fold — lr never keys) and one for the final
+    # 240-row full-data refit of the winner. n must divide numFolds or
+    # ragged folds add shape keys.
+    assert len(trainer_mod._FUSED_CACHE) == 2, \
         sorted((k.n, k.tp.learning_rate) for k in trainer_mod._FUSED_CACHE)
