@@ -3,7 +3,8 @@ from .value_indexer import ValueIndexer, ValueIndexerModel, IndexToValue
 from .clean_missing_data import CleanMissingData, CleanMissingDataModel
 from .data_conversion import DataConversion
 from .count_selector import CountSelector, CountSelectorModel
-from .text import (StopWordsRemover, Tokenizer, TokenIdEncoder, NGram, MultiNGram, HashingTF, IDF, IDFModel,
+from .text import (BpeTokenizer, BpeTokenizerModel,
+                   StopWordsRemover, Tokenizer, TokenIdEncoder, NGram, MultiNGram, HashingTF, IDF, IDFModel,
                    TextFeaturizer, TextFeaturizerModel, PageSplitter)
 from .vector import VectorAssembler, OneHotEncoder, OneHotEncoderModel
 from .embedding import Word2Vec, Word2VecModel
@@ -13,6 +14,7 @@ __all__ = [
     "ValueIndexer", "ValueIndexerModel", "IndexToValue",
     "CleanMissingData", "CleanMissingDataModel",
     "DataConversion", "CountSelector", "CountSelectorModel",
+    "BpeTokenizer", "BpeTokenizerModel",
     "StopWordsRemover", "Tokenizer", "TokenIdEncoder", "NGram", "MultiNGram", "HashingTF", "IDF", "IDFModel",
     "TextFeaturizer", "TextFeaturizerModel", "PageSplitter",
     "VectorAssembler", "OneHotEncoder", "OneHotEncoderModel",

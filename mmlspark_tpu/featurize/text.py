@@ -399,3 +399,170 @@ class TokenIdEncoder(Transformer, HasInputCol, HasOutputCol):
                 ids = [vocab.get(t, 1) for t in toks]
             out[i, :len(ids)] = ids
         return df.with_column(self.getOutputCol(), out)
+
+
+class BpeTokenizer(Estimator, HasInputCol, HasOutputCol):
+    """Learn byte-pair-encoding merges from a corpus and emit the same
+    fixed-shape int32 token-id matrix ``TokenIdEncoder`` produces — the
+    corpus-fitted alternative to its hashing/vocab-file modes, closing
+    the raw-text → subword-ids → ``TextEncoderFeaturizer`` chain without
+    an external vocabulary.
+
+    Classic whitespace-pretokenized BPE (Sennrich et al.): words split
+    to characters plus an end-of-word marker, and the most frequent
+    adjacent symbol pair merges repeatedly until the id budget
+    (``vocabSize`` minus PAD/UNK/base characters) is spent or no pair
+    repeats. No reference counterpart (``TextFeaturizer.scala`` stops at
+    word-level tokens); this serves the framework's long-context
+    extension.
+    """
+
+    vocabSize = Param("vocabSize", "total id budget incl. PAD=0/UNK=1 "
+                      "(must match the encoder's vocabSize)",
+                      TC.toInt, default=8192)
+    maxLength = Param("maxLength", "token-id row width (truncate/pad)",
+                      TC.toInt, default=128)
+    toLowercase = Param("toLowercase", "lowercase before splitting",
+                        TC.toBoolean, default=True)
+    pattern = Param("pattern", "regex pre-tokenizer split pattern",
+                    TC.toString, default=r"\W+")
+    minPairCount = Param("minPairCount", "stop merging below this pair "
+                         "frequency", TC.toInt, default=2)
+
+    def __init__(self, **kwargs):
+        super().__init__(**kwargs)
+        self._setDefault(inputCol="text", outputCol="tokens")
+
+    def _fit(self, df):
+        from collections import Counter, defaultdict
+
+        lower = self.get("toLowercase")
+        pat = self.get("pattern")
+        words = Counter()
+        for text in df[self.getInputCol()].tolist():
+            words.update(_tokenize(text, lower, pat))
+
+        # word id → (symbol tuple, count); incremental pair bookkeeping
+        # (the standard BPE fit): each merge touches only the words that
+        # contain its pair, not the whole corpus
+        syms: list[list[str]] = []
+        counts: list[int] = []
+        for w, c in words.items():
+            syms.append(list(w) + ["</w>"])
+            counts.append(c)
+        base = sorted({ch for s in syms for ch in s})
+        budget = self.get("vocabSize") - 2 - len(base)
+        if budget < 0:
+            raise ValueError(
+                f"vocabSize={self.get('vocabSize')} cannot hold the "
+                f"{len(base)} base symbols (+PAD/UNK); raise it")
+        min_count = int(self.get("minPairCount"))
+        if min_count < 1:
+            raise ValueError(
+                f"minPairCount={min_count} must be >= 1")
+
+        pairs: Counter = Counter()
+        where: defaultdict = defaultdict(set)   # pair → word ids
+        for wid, s in enumerate(syms):
+            for p in zip(s, s[1:]):
+                pairs[p] += counts[wid]
+                where[p].add(wid)
+
+        merges: list[list[str]] = []
+        for _ in range(budget):
+            if not pairs:
+                break
+            (a, b), top = max(pairs.items(), key=lambda kv: kv[1])
+            if top < min_count:
+                break
+            merged = a + b
+            for wid in list(where[(a, b)]):
+                s, c = syms[wid], counts[wid]
+                for p in zip(s, s[1:]):          # retract old pairs
+                    pairs[p] -= c
+                    if pairs[p] <= 0:
+                        del pairs[p]
+                    where[p].discard(wid)
+                out, i = [], 0
+                while i < len(s):
+                    if i + 1 < len(s) and s[i] == a and s[i + 1] == b:
+                        out.append(merged)
+                        i += 2
+                    else:
+                        out.append(s[i])
+                        i += 1
+                syms[wid] = out
+                for p in zip(out, out[1:]):      # add new pairs
+                    pairs[p] += c
+                    where[p].add(wid)
+            merges.append([a, b])
+
+        # two merge paths can concatenate to the same string — dedupe so
+        # no id slot is allocated to a token that can never be emitted
+        vocab = list(dict.fromkeys(base + [a + b for a, b in merges]))
+        model = BpeTokenizerModel() \
+            .set("merges", merges) \
+            .set("vocabulary", vocab)
+        self._copy_params_to(model)
+        return model
+
+
+class BpeTokenizerModel(Model, HasInputCol, HasOutputCol):
+    """Fitted BPE: greedy lowest-rank merging per word, then ids in
+    ``vocabulary`` order from 2 (0=PAD, 1=UNK for unseen characters)."""
+
+    merges = Param("merges", "ordered [a, b] merge rules")
+    vocabulary = Param("vocabulary", "id-ordered token strings")
+    # estimator params carried onto the model by _copy_params_to
+    vocabSize = BpeTokenizer.vocabSize
+    maxLength = BpeTokenizer.maxLength
+    toLowercase = BpeTokenizer.toLowercase
+    pattern = BpeTokenizer.pattern
+    minPairCount = BpeTokenizer.minPairCount
+
+    def _tables(self):
+        merges = self.get("merges")
+        vocab = self.get("vocabulary")
+        cached = getattr(self, "_bpe_cache", None)
+        if cached is not None and cached[0] is merges \
+                and cached[1] is vocab:
+            return cached[2], cached[3]
+        ranks = {(a, b): r for r, (a, b) in enumerate(merges)}
+        ids = {t: i + 2 for i, t in enumerate(vocab)}
+        self._bpe_cache = (merges, vocab, ranks, ids)
+        return ranks, ids
+
+    def encode_word(self, word: str) -> list[str]:
+        ranks, _ = self._tables()
+        sym = list(word) + ["</w>"]
+        while len(sym) > 1:
+            best, best_rank = None, None
+            for i, (a, b) in enumerate(zip(sym, sym[1:])):
+                r = ranks.get((a, b))
+                if r is not None and (best_rank is None or r < best_rank):
+                    best, best_rank = i, r
+            if best is None:
+                break
+            sym[best:best + 2] = [sym[best] + sym[best + 1]]
+        return sym
+
+    def _transform(self, df):
+        _, ids = self._tables()
+        lower = self.get("toLowercase")
+        pat = self.get("pattern")
+        L = self.get("maxLength")
+        col = df[self.getInputCol()]
+        out = np.zeros((len(col), L), np.int32)
+        word_cache: dict[str, list[int]] = {}
+        for i, text in enumerate(col.tolist()):
+            row: list[int] = []
+            for w in _tokenize(text, lower, pat):
+                got = word_cache.get(w)
+                if got is None:
+                    got = [ids.get(t, 1) for t in self.encode_word(w)]
+                    word_cache[w] = got
+                row.extend(got)
+                if len(row) >= L:
+                    break
+            out[i, :min(len(row), L)] = row[:L]
+        return df.with_column(self.getOutputCol(), out)

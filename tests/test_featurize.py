@@ -218,3 +218,54 @@ class TestStopWordsAndTokenizerControls:
         assert out["f"].shape == (3, 64)
         assert out["f"][0].sum() == 2.0     # good + movie only
         assert out["f"][1].sum() == 2.0     # bad + movie
+
+
+class TestBpeTokenizer:
+    """Corpus-fitted BPE: frequent pairs merge into subwords, encoding
+    feeds TextEncoderFeaturizer, round-trips persist."""
+
+    def _corpus(self):
+        col = np.empty(6, object)
+        col[:] = ["the lowest lower low", "lower and lower still",
+                  "new newer newest", "the low new lowest",
+                  "newer lower low", "low lower lowest newest"]
+        return DataFrame({"text": col})
+
+    def test_learns_frequent_merges(self):
+        from mmlspark_tpu.featurize import BpeTokenizer
+        model = BpeTokenizer(vocabSize=64, maxLength=16).fit(
+            self._corpus())
+        # "low" appears in low/lower/lowest: its chars must have fused
+        toks = model.encode_word("low")
+        assert len(toks) < 4, toks          # fewer than l,o,w,</w>
+        vocab = model.get("vocabulary")
+        assert any("lo" in t for t in vocab)
+
+    def test_ids_fixed_shape_and_oov(self):
+        from mmlspark_tpu.featurize import BpeTokenizer
+        model = BpeTokenizer(vocabSize=64, maxLength=8).fit(
+            self._corpus())
+        out = model.transform(self._corpus())["tokens"]
+        assert out.shape == (6, 8) and out.dtype == np.int32
+        assert (out >= 0).all()
+        # unseen characters map to UNK=1, never crash
+        q = np.empty(1, object)
+        q[:] = ["Ω unseen-glyphs"]
+        oov = model.transform(DataFrame({"text": q}))["tokens"]
+        assert (oov == 1).any()
+
+    def test_feeds_text_encoder_and_roundtrips(self, tmp_path):
+        from mmlspark_tpu.dl import TextEncoderFeaturizer
+        from mmlspark_tpu.featurize import BpeTokenizer
+        df = self._corpus()
+        model = BpeTokenizer(vocabSize=64, maxLength=12).fit(df)
+        ids = model.transform(df)
+        feats = TextEncoderFeaturizer(inputCol="tokens", width=32,
+                                      depth=1, heads=2, vocabSize=64) \
+            .transform(ids)["features"]
+        assert np.stack(list(feats)).shape == (6, 32)
+        model.save(str(tmp_path / "bpe"))
+        from mmlspark_tpu.core import load_stage
+        re_model = load_stage(str(tmp_path / "bpe"))
+        np.testing.assert_array_equal(
+            re_model.transform(df)["tokens"], ids["tokens"])

@@ -32,7 +32,8 @@ def make_test_objects() -> dict[str, TestObject]:
                                         Featurize, OneHotEncoder,
                                         ValueIndexer, VectorAssembler,
                                         Word2Vec)
-    from mmlspark_tpu.featurize.text import (HashingTF, IDF, MultiNGram,
+    from mmlspark_tpu.featurize.text import (BpeTokenizer, HashingTF,
+                                             IDF, MultiNGram,
                                              PageSplitter,
                                              StopWordsRemover,
                                              TextFeaturizer,
@@ -126,6 +127,8 @@ def make_test_objects() -> dict[str, TestObject]:
         TestObject(CountSelector(inputCol="features",
                                  outputCol="sel"), num),
         TestObject(Tokenizer(inputCol="text", outputCol="tok"), text_df),
+        TestObject(BpeTokenizer(inputCol="text", outputCol="ids",
+                                vocabSize=64, maxLength=8), text_df),
         TestObject(TokenIdEncoder(inputCol="text", outputCol="ids",
                                   maxLength=8, vocabSize=256), text_df),
         TestObject(NGram(inputCol="tok", outputCol="ngrams", n=2),
