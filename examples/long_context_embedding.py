@@ -56,4 +56,21 @@ text_pipe = PipelineModel(stages=[
 emb = text_pipe.transform(docs)["features"]
 assert emb.shape == (2, 128) and np.isfinite(emb).all()
 print("raw-text pipeline:", emb.shape)
+
+# corpus-fitted subwords: BpeTokenizer learns merges from the data and
+# emits the same fixed-shape id matrix — no vocabulary file needed
+from mmlspark_tpu.featurize import BpeTokenizer
+
+bpe = BpeTokenizer(inputCol="text", outputCol="tokens", vocabSize=256,
+                   maxLength=64).fit(docs)
+bpe_pipe = PipelineModel(stages=[
+    bpe,
+    TextEncoderFeaturizer(inputCol="tokens", outputCol="features",
+                          vocabSize=256, width=128, depth=2,
+                          seqChunk=64),
+])
+emb2 = bpe_pipe.transform(docs)["features"]
+assert emb2.shape == (2, 128) and np.isfinite(emb2).all()
+print("BPE subword pipeline:", emb2.shape,
+      f"({len(bpe.get('vocabulary'))} learned tokens)")
 done("long_context_embedding")
