@@ -434,6 +434,7 @@ class BpeTokenizer(Estimator, HasInputCol, HasOutputCol):
         self._setDefault(inputCol="text", outputCol="tokens")
 
     def _fit(self, df):
+        import heapq
         from collections import Counter, defaultdict
 
         lower = self.get("toLowercase")
@@ -468,21 +469,37 @@ class BpeTokenizer(Estimator, HasInputCol, HasOutputCol):
                 pairs[p] += counts[wid]
                 where[p].add(wid)
 
+        # merge selection via a lazily-invalidated max-heap (ADVICE r3):
+        # a full max() scan per merge is O(distinct pairs) and dominates
+        # large-vocab fits. Stale entries (count changed since push) are
+        # discarded at pop time by comparing against the live count.
+        # Ties break toward the lexicographically smallest pair — a
+        # deterministic, corpus-order-independent rule.
+        heap = [(-c, p) for p, c in pairs.items()]
+        heapq.heapify(heap)
+
         merges: list[list[str]] = []
         for _ in range(budget):
-            if not pairs:
+            top = None
+            while heap:
+                negc, p = heap[0]
+                if pairs.get(p, 0) == -negc:
+                    top = -negc
+                    break
+                heapq.heappop(heap)              # stale entry
+            if top is None or top < min_count:
                 break
-            (a, b), top = max(pairs.items(), key=lambda kv: kv[1])
-            if top < min_count:
-                break
+            a, b = p
             merged = a + b
+            touched: set = set()
             for wid in list(where[(a, b)]):
                 s, c = syms[wid], counts[wid]
-                for p in zip(s, s[1:]):          # retract old pairs
-                    pairs[p] -= c
-                    if pairs[p] <= 0:
-                        del pairs[p]
-                    where[p].discard(wid)
+                for pr in zip(s, s[1:]):         # retract old pairs
+                    pairs[pr] -= c
+                    if pairs[pr] <= 0:
+                        del pairs[pr]
+                    where[pr].discard(wid)
+                    touched.add(pr)
                 out, i = [], 0
                 while i < len(s):
                     if i + 1 < len(s) and s[i] == a and s[i + 1] == b:
@@ -492,9 +509,13 @@ class BpeTokenizer(Estimator, HasInputCol, HasOutputCol):
                         out.append(s[i])
                         i += 1
                 syms[wid] = out
-                for p in zip(out, out[1:]):      # add new pairs
-                    pairs[p] += c
-                    where[p].add(wid)
+                for pr in zip(out, out[1:]):     # add new pairs
+                    pairs[pr] += c
+                    where[pr].add(wid)
+                    touched.add(pr)
+            for pr in touched:
+                if pairs.get(pr, 0) > 0:
+                    heapq.heappush(heap, (-pairs[pr], pr))
             merges.append([a, b])
 
         # two merge paths can concatenate to the same string — dedupe so

@@ -28,6 +28,7 @@ import jax.numpy as jnp
 import numpy as np
 
 from ..core.utils import stable_sigmoid
+from ..utils.platform import target_platform
 from .binning import bin_features, compute_bin_boundaries, bin_upper_value
 from .booster import Booster
 from .engine import Tree, TreeParams, grow_tree, tree_route_bins
@@ -568,8 +569,11 @@ def _build_dart(st: _FusedStatics):
 
     # donate the O(T·n) buffers so each iteration updates them in place
     # (CPU lacks donation and would warn on every compile); +1 for the
-    # leading data arg
-    donate = (3, 4, 5) if jax.default_backend() == "tpu" else ()
+    # leading data arg. Gate on the PLACEMENT platform, not the default
+    # backend: under an active default_device(cpu) pin on a TPU-backed
+    # process the computation lands on CPU and donation would warn on
+    # every compile (and the cached entry bakes the decision in).
+    donate = (3, 4, 5) if target_platform() in ("tpu", "axon") else ()
     step = jax.jit(dart_impl, donate_argnums=donate)
 
     @functools.partial(jax.jit, donate_argnums=donate)
@@ -989,8 +993,9 @@ def train(x: np.ndarray, y: np.ndarray, w: np.ndarray | None,
                 has_valid=valid is not None)
 
         # donate the O(T·n) buffers so each iteration updates them in
-        # place (CPU lacks donation and would warn on every compile)
-        donate = (2, 3, 4) if jax.default_backend() == "tpu" else ()
+        # place (CPU lacks donation and would warn on every compile);
+        # placement platform, not default backend — see _build_dart
+        donate = (2, 3, 4) if target_platform() in ("tpu", "axon") else ()
         step = jax.jit(dart_impl, donate_argnums=donate)
         dart_chunk = functools.partial(jax.jit, donate_argnums=donate)(
             _dart_chunk_scan(dart_impl))

@@ -50,7 +50,9 @@ def blockwise_attention(q, k, v, *, block_size: int = 512,
     key axis so the [T, T] score matrix never materializes. ``key_mask``
     [B, T] bool marks valid keys (False = e.g. padding, excluded from
     the softmax). ``return_lse`` additionally returns the per-row
-    logsumexp [B, H, T] (fully-masked rows report -inf).
+    logsumexp [B, H, T]; fully-masked rows report the same finite
+    sentinel (~-1e30) as ``flash_attention_lse`` so the two backends of
+    the lse API agree (consumers may subtract or exp() across them).
     """
     B, H, T, D = q.shape
     scale = scale if scale is not None else D ** -0.5
@@ -92,7 +94,10 @@ def blockwise_attention(q, k, v, *, block_size: int = 512,
     m, l, acc = jax.lax.fori_loop(0, nb, body, (m0, l0, a0))
     out = acc / jnp.maximum(l, 1e-35)[..., None]
     if return_lse:
-        return out, m + jnp.log(jnp.maximum(l, 1e-35))
+        # clamp the fully-masked-row -inf to the flash kernel's finite
+        # sentinel so both lse backends agree (ADVICE r3)
+        return out, jnp.maximum(m + jnp.log(jnp.maximum(l, 1e-35)),
+                                -1e30)
     return out
 
 

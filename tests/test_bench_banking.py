@@ -1,0 +1,122 @@
+"""The wedge-proof scoreboard (VERDICT r3 Missing #1): every successful
+TPU sub-bench persists to the committed BENCH_TPU_BANKED.json, and a
+wedged-tunnel run surfaces those numbers as explicitly-stamped
+``last_measured_*`` extras instead of a bare 0.0 line.
+
+Reference analog: the perf claims in ``docs/lightgbm.md:17-21`` and
+``docs/mmlspark-serving.md:9-12`` are *published artifacts* — the
+benchmark result must survive infrastructure flakiness."""
+
+import json
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+import bench  # noqa: E402
+
+
+def test_bank_writes_and_merges(tmp_path, monkeypatch):
+    monkeypatch.setattr(bench, "BANKED_PATH",
+                        str(tmp_path / "banked.json"))
+    extras = {"gbdt_rows_per_sec": 1_650_000.0, "gbdt_fit_seconds": 6.0,
+              "error_ranker": "boom", "serving_p99_ms": 0.8}
+    bench._bank(extras, 10_000.0, "tpu")
+    banked = json.loads((tmp_path / "banked.json").read_text())
+    assert banked["gbdt_rows_per_sec"]["value"] == 1_650_000.0
+    assert banked["gbdt_rows_per_sec"]["platform"] == "tpu"
+    assert banked["gbdt_rows_per_sec"]["measured_at"].endswith("Z")
+    # serving scores on the host CPU by design — labeled honestly
+    assert banked["serving_p99_ms"]["platform"] == "cpu-host"
+    # errors are never banked
+    assert not any(k.startswith("error") for k in banked)
+    assert banked["imagefeaturizer_resnet50_inference"]["value"] == 10000.0
+
+    # second run updates only the keys it measured
+    bench._bank({"vit_mfu": 0.48}, 0.0, "tpu")
+    banked = json.loads((tmp_path / "banked.json").read_text())
+    assert banked["vit_mfu"]["value"] == 0.48
+    assert banked["gbdt_rows_per_sec"]["value"] == 1_650_000.0
+
+
+def test_bank_unchanged_value_keeps_measurement_stamp(tmp_path,
+                                                     monkeypatch):
+    """The suite re-banks accumulated extras after every sub-bench; a
+    key measured early must keep its original measured_at, not be
+    re-stamped with each later bank."""
+    monkeypatch.setattr(bench, "BANKED_PATH",
+                        str(tmp_path / "banked.json"))
+    (tmp_path / "banked.json").write_text(json.dumps({
+        "resnet50_mfu": {"value": 0.47,
+                         "measured_at": "2026-01-01T00:00:00Z",
+                         "platform": "tpu"}}))
+    bench._bank({"resnet50_mfu": 0.47, "vit_mfu": 0.48}, 0.0, "tpu")
+    banked = json.loads((tmp_path / "banked.json").read_text())
+    assert banked["resnet50_mfu"]["measured_at"] == \
+        "2026-01-01T00:00:00Z"
+    assert banked["vit_mfu"]["measured_at"] != "2026-01-01T00:00:00Z"
+
+
+def test_bank_contended_stamps_records(tmp_path, monkeypatch):
+    monkeypatch.setattr(bench, "BANKED_PATH",
+                        str(tmp_path / "banked.json"))
+    bench._bank({"gbdt_rows_per_sec": 2.0, "contended": True,
+                 "load_avg_start": 9.5}, 0.0, "tpu")
+    banked = json.loads((tmp_path / "banked.json").read_text())
+    assert banked["gbdt_rows_per_sec"]["contended"] is True
+    # run metadata is stamped into records, not banked as measurements
+    assert "contended" not in banked and "load_avg_start" not in banked
+    # a later clean re-measurement clears the stain
+    bench._bank({"gbdt_rows_per_sec": 3.0}, 0.0, "tpu")
+    banked = json.loads((tmp_path / "banked.json").read_text())
+    assert "contended" not in banked["gbdt_rows_per_sec"]
+
+
+def test_bank_real_chip_platforms_only(tmp_path, monkeypatch):
+    monkeypatch.setattr(bench, "BANKED_PATH",
+                        str(tmp_path / "banked.json"))
+    bench._bank({"gbdt_rows_per_sec": 1.0}, 0.0, "cpu")
+    bench._bank({"gbdt_rows_per_sec": 1.0}, 0.0, None)
+    assert not (tmp_path / "banked.json").exists()
+    # the tunnel chip may report either name (axon is the tunnel
+    # platform; the repo gates Pallas on the same pair)
+    bench._bank({"gbdt_rows_per_sec": 1.0}, 0.0, "axon")
+    banked = json.loads((tmp_path / "banked.json").read_text())
+    assert banked["gbdt_rows_per_sec"]["platform"] == "axon"
+
+
+def test_merge_banked_labels_staleness(tmp_path, monkeypatch):
+    monkeypatch.setattr(bench, "BANKED_PATH",
+                        str(tmp_path / "banked.json"))
+    (tmp_path / "banked.json").write_text(json.dumps({
+        "resnet50_mfu": {"value": 0.47,
+                         "measured_at": "2026-07-31T03:45:00Z",
+                         "platform": "tpu"}}))
+    extras = {"error_backend": "TimeoutError"}
+    bench._merge_banked_into(extras)
+    assert extras["stale"] is True
+    assert extras["last_measured_resnet50_mfu"] == 0.47
+    assert extras["last_measured_at"]["resnet50_mfu"] == \
+        "2026-07-31T03:45:00Z"
+    # the live keys are NOT silently substituted
+    assert "resnet50_mfu" not in extras
+
+
+def test_merge_banked_noop_without_file(tmp_path, monkeypatch):
+    monkeypatch.setattr(bench, "BANKED_PATH",
+                        str(tmp_path / "absent.json"))
+    extras = {}
+    bench._merge_banked_into(extras)
+    assert extras == {}
+
+
+def test_committed_banked_file_is_valid():
+    """The repo-root BENCH_TPU_BANKED.json must stay parseable and
+    carry provenance on every entry."""
+    with open(bench.BANKED_PATH) as f:
+        banked = json.load(f)
+    assert banked, "banked file must not be empty"
+    for key, rec in banked.items():
+        assert "value" in rec and "measured_at" in rec and \
+            "platform" in rec, key
