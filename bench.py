@@ -332,22 +332,33 @@ def bench_resnet(extras: dict) -> float:
 def bench_train(extras: dict) -> None:
     """ResNet-50 TRAINING throughput (SGD, bf16 activations) — the
     transfer-learning north star is a training workload; inference-only
-    coverage was the r2 gap. FLOPs ≈ 3× the forward cost (fwd + bwd)."""
+    coverage was the r2 gap. FLOPs from XLA cost analysis of the
+    COMPILED step (fwd+bwd+update), the same accounting bench_resnet
+    uses — the round-3 analytic 3×fwd estimate undercounted the real
+    conv FLOPs ~2× and made train MFU incomparable with inference MFU.
+    Knobs: MMLSPARK_TPU_BENCH_TRAIN_REMAT=1 (block rematerialization),
+    MMLSPARK_TPU_BENCH_TRAIN_OPT_BF16=1 (bf16 momentum buffer — halves
+    the optimizer-state HBM traffic per step)."""
     import jax
     import jax.numpy as jnp
     import numpy as np
     import optax
 
-    from mmlspark_tpu.dl.train import init_train_state, make_train_step
+    from mmlspark_tpu.dl.train import (init_train_state, make_train_step,
+                                       train_epoch)
     from mmlspark_tpu.models import ModelDownloader
 
     remat = os.environ.get("MMLSPARK_TPU_BENCH_TRAIN_REMAT") == "1"
+    opt_bf16 = os.environ.get("MMLSPARK_TPU_BENCH_TRAIN_OPT_BF16") == "1"
     loaded = ModelDownloader().download_by_name(
         "ResNet50", num_classes=100, allow_random_init=True,
         remat=remat or None)
     if remat:
         extras["train_remat"] = True
-    tx = optax.sgd(1e-2, momentum=0.9)
+    tx = optax.sgd(1e-2, momentum=0.9,
+                   accumulator_dtype=jnp.bfloat16 if opt_bf16 else None)
+    if opt_bf16:
+        extras["train_opt_bf16"] = True
     rng = np.random.default_rng(3)
     raw = os.environ.get("MMLSPARK_TPU_BENCH_TRAIN_BATCHES", "128,256")
     try:
@@ -358,6 +369,8 @@ def bench_train(extras: dict) -> None:
     device = jax.devices()[0]
     step = make_train_step(loaded.module, tx)
     per_batch: dict[int, float] = {}
+    flops_per_image = 0.0
+    e2e_step = step  # replaced by the batch[0] AOT executable below
     iters = 10
     loss = None
     for batch in batches:
@@ -374,11 +387,24 @@ def bench_train(extras: dict) -> None:
                 device)
             y = jax.device_put(jnp.asarray(
                 rng.integers(0, 100, size=batch), jnp.int32), device)
-            state, loss = step(state, x, y)      # compile + warm
+            # ONE compile per point (AOT), serving cost analysis too
+            compiled = step.lower(state, x, y).compile()
+            if not flops_per_image:  # any successful point serves it
+                try:
+                    cost = compiled.cost_analysis()
+                    if isinstance(cost, (list, tuple)):
+                        cost = cost[0]
+                    flops_per_image = \
+                        float(cost.get("flops", 0.0)) / batch
+                except Exception:
+                    flops_per_image = 0.0
+            if batch == batches[0]:
+                e2e_step = compiled  # reused by the e2e loop below
+            state, loss = compiled(state, x, y)   # warm
             jax.block_until_ready(loss)
             t0 = time.perf_counter()
             for _ in range(iters):
-                state, loss = step(state, x, y)
+                state, loss = compiled(state, x, y)
             jax.block_until_ready(loss)
             per_batch[batch] = round(batch * iters
                                      / (time.perf_counter() - t0), 1)
@@ -391,6 +417,8 @@ def bench_train(extras: dict) -> None:
                 traceback.format_exc()[-400:]
     if not per_batch:
         raise RuntimeError("every train batch size failed")
+    if not flops_per_image:  # cost analysis unavailable: analytic 3×fwd
+        flops_per_image = 3 * RESNET50_FLOPS_PER_IMAGE
     # headline stays the FIRST (=128 by default) point for cross-round
     # comparability, like bench_resnet; the sweep best rides extras
     headline = per_batch.get(batches[0], next(iter(per_batch.values())))
@@ -399,8 +427,34 @@ def bench_train(extras: dict) -> None:
     extras["train_best_batch"] = best_batch
     extras["train_best_images_per_sec"] = per_batch[best_batch]
     extras["train_ips_by_batch"] = per_batch
+    extras["train_flops_per_image"] = flops_per_image
     extras["train_mfu_est"] = round(
-        headline * 3 * RESNET50_FLOPS_PER_IMAGE / V5E_PEAK_BF16_FLOPS, 4)
+        headline * flops_per_image / V5E_PEAK_BF16_FLOPS, 4)
+    extras["train_mfu_best"] = round(
+        per_batch[best_batch] * flops_per_image / V5E_PEAK_BF16_FLOPS, 4)
+
+    # e2e: HOST-resident batches through the overlapped-transfer loop
+    # (dl.train.train_epoch) — the number a fine-tune pipeline sees,
+    # fault-isolated like the featurizer e2e. Reuses the batch[0] AOT
+    # executable: lower().compile() bypasses step's jit cache, so
+    # calling `step` here would re-trace + re-compile the whole graph.
+    try:
+        eb = batches[0]
+        state = jax.device_put(
+            init_train_state(loaded.module, jax.random.PRNGKey(0),
+                             np.zeros((1, 224, 224, 3), np.float32), tx),
+            device)
+        host_batches = [
+            (rng.normal(size=(eb, 224, 224, 3)).astype(np.float32),
+             rng.integers(0, 100, size=eb).astype(np.int32))
+            for _ in range(4)]
+        state, _ = train_epoch(e2e_step, state, host_batches[:1])  # warm
+        t0 = time.perf_counter()
+        state, losses = train_epoch(e2e_step, state, host_batches)
+        extras["train_e2e_images_per_sec"] = round(
+            eb * len(host_batches) / (time.perf_counter() - t0), 1)
+    except Exception:
+        extras["error_train_e2e"] = traceback.format_exc()[-400:]
 
 
 def bench_vit(extras: dict) -> None:

@@ -11,8 +11,9 @@ by ``jax.sharding`` over the mesh.
 from .model import TPUModel
 from .text_encoder import (TextEncoder, TextEncoderFeaturizer,
                            make_attention_fn)
-from .train import TrainState, make_train_step, shard_train_state
+from .train import (TrainState, make_train_step, shard_train_state,
+                    train_epoch)
 
 __all__ = ["TPUModel", "TrainState", "make_train_step",
-           "shard_train_state", "TextEncoder", "TextEncoderFeaturizer",
-           "make_attention_fn"]
+           "shard_train_state", "train_epoch", "TextEncoder",
+           "TextEncoderFeaturizer", "make_attention_fn"]
