@@ -213,7 +213,12 @@ def train_epoch(step, state, batches, placement=None):
     ``placement``: a Device or Sharding for the batches (defaults to the
     first device; pass a NamedSharding for mesh training). Returns
     (final_state, per-batch losses as floats) — losses are fetched once
-    at the end so the loop never blocks on a scalar."""
+    at the end so the loop never blocks on a scalar.
+
+    The input ``state`` is CONSUMED when ``batches`` is non-empty:
+    ``make_train_step`` donates its state argument, so the caller must
+    use the returned state (keeping a reference to the old one and
+    touching it raises a donated-buffer error)."""
     if placement is None:
         placement = jax.devices()[0]
     losses = []
