@@ -398,8 +398,6 @@ def bench_train(extras: dict) -> None:
                         float(cost.get("flops", 0.0)) / batch
                 except Exception:
                     flops_per_image = 0.0
-            if e2e_step is None:
-                e2e_step, e2e_batch = compiled, batch  # e2e reuses it
             state, loss = compiled(state, x, y)   # warm
             jax.block_until_ready(loss)
             t0 = time.perf_counter()
@@ -409,6 +407,8 @@ def bench_train(extras: dict) -> None:
             per_batch[batch] = round(batch * iters
                                      / (time.perf_counter() - t0), 1)
             assert np.isfinite(float(loss))
+            if e2e_step is None:  # first point that RAN successfully
+                e2e_step, e2e_batch = compiled, batch
             del state, x, y
         except Exception:
             # one failing point (e.g. the largest batch OOMing HBM)

@@ -1,0 +1,113 @@
+"""In-framework masked-LM pretraining for the text encoder.
+
+The reference ships pretrained models through its downloader
+(``downloader/ModelDownloader.scala:37-60``) and never trains one; this
+build is zero-egress, so pretrained text representations are produced
+IN the framework: BERT-style masked-token prediction over any corpus,
+yielding encoder weights the zoo serves to ``TextEncoderFeaturizer``
+exactly like the vision checkpoints (``image/ImageFeaturizer.scala:81-85``
+is the consumption pattern being mirrored).
+
+TPU shape notes: the whole step is one jitted graph (embedding + blocks
++ LM head + masked xent), masking is host-side numpy (cheap, keeps the
+graph static), batches stream through ``train_epoch``'s overlapped
+transfer loop.
+"""
+
+from __future__ import annotations
+
+from typing import Any
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+from flax import linen as nn
+
+from .text_encoder import TextEncoder
+from .train import TrainState, init_train_state, make_train_step, \
+    train_epoch
+
+
+class MaskedLMModel(nn.Module):
+    """Encoder trunk + token-level LM head. Params nest under
+    ``params["encoder"]`` / ``params["lm_head"]``, so the trunk's
+    weights lift out cleanly for zoo publication
+    (:func:`encoder_variables`)."""
+    encoder: TextEncoder
+
+    def setup(self):
+        self.lm_head = nn.Dense(self.encoder.vocab, dtype=jnp.float32,
+                                name="lm_head")
+
+    def __call__(self, ids, train: bool = False):
+        out = self.encoder(ids, train)
+        return {"logits": self.lm_head(out["tokens"]), **out}
+
+
+def masked_xent(logits, labels):
+    """Cross-entropy over positions with ``labels >= 0`` (−1 = ignore:
+    unmasked or pad). Mean over masked positions only."""
+    valid = labels >= 0
+    logp = jax.nn.log_softmax(logits.astype(jnp.float32))
+    ll = jnp.take_along_axis(
+        logp, jnp.maximum(labels, 0)[..., None], axis=-1)[..., 0]
+    return -(ll * valid).sum() / jnp.maximum(valid.sum(), 1)
+
+
+def mask_batch(ids: np.ndarray, rng: np.random.Generator, *,
+               mask_id: int, mask_frac: float = 0.15,
+               pad_id: int = 0) -> tuple[np.ndarray, np.ndarray]:
+    """BERT-style corruption: ``mask_frac`` of non-pad positions are
+    replaced by ``mask_id``; labels carry the original id there and −1
+    everywhere else."""
+    maskable = ids != pad_id
+    pick = (rng.random(ids.shape) < mask_frac) & maskable
+    x = np.where(pick, mask_id, ids).astype(np.int32)
+    y = np.where(pick, ids, -1).astype(np.int32)
+    return x, y
+
+
+def pretrain_masked_lm(encoder: TextEncoder, ids: np.ndarray, *,
+                       steps: int = 200, batch_size: int = 32,
+                       learning_rate: float = 1e-3,
+                       mask_frac: float = 0.15, mask_id: int | None = None,
+                       seed: int = 0,
+                       tx: Any = None) -> tuple[TrainState, list[float]]:
+    """Pretrain ``encoder`` on token-id rows ``ids`` [N, T] (pad id 0).
+
+    ``mask_id`` defaults to the encoder's top vocab slot — reserve it
+    when fitting the tokenizer (``BpeTokenizer`` never emits an id ≥ its
+    ``vocabSize``, so an encoder ``vocab`` of ``vocabSize + 1`` leaves
+    the slot free). Returns the full LM train state (resumable via
+    ``CheckpointManager``) and per-batch losses; lift the trunk with
+    :func:`encoder_variables` for zoo publication."""
+    ids = np.asarray(ids, np.int32)
+    if mask_id is None:
+        mask_id = encoder.vocab - 1
+    if ids.max(initial=0) >= mask_id:
+        raise ValueError(
+            f"corpus uses id {ids.max()} but mask_id={mask_id}; give the "
+            "encoder a spare top slot (vocab >= tokenizer vocab + 1)")
+    module = MaskedLMModel(encoder)
+    tx = tx or optax.adamw(learning_rate)
+    state = init_train_state(module, jax.random.PRNGKey(seed), ids[:1],
+                             tx)
+    rng = np.random.default_rng(seed)
+
+    def batches():
+        for _ in range(steps):
+            rows = ids[rng.integers(0, len(ids), size=batch_size)]
+            yield mask_batch(rows, rng, mask_id=mask_id,
+                             mask_frac=mask_frac)
+
+    step = make_train_step(module, tx, fetch="logits",
+                           loss_fn=masked_xent)
+    return train_epoch(step, state, batches())
+
+
+def encoder_variables(state: TrainState) -> dict:
+    """Extract the encoder trunk's variables from an LM train state, in
+    the shape ``TextEncoder.apply`` (and the zoo checkpoint format)
+    expects."""
+    return {"params": state.params["encoder"]}

@@ -32,7 +32,7 @@ from flax import linen as nn
 
 from ..core.contracts import HasInputCol, HasOutputCol
 from ..core.logging import BasicLogging
-from ..core.param import Param, TypeConverters as TC
+from ..core.param import ComplexParam, Param, TypeConverters as TC
 from ..core.pipeline import Transformer
 
 
@@ -235,6 +235,15 @@ class TextEncoderFeaturizer(Transformer, HasInputCol, HasOutputCol,
                   TC.toInt, default=8, has_default=True)
     seed = Param("seed", "init seed", TC.toInt, default=0,
                  has_default=True)
+    model = ComplexParam(
+        "model", "explicit LoadedModel text encoder — PRETRAINED "
+        "weights (e.g. dl.pretrain + the zoo); overrides the "
+        "width/depth/… params with the loaded architecture",
+        default=None, has_default=True)
+    modelName = Param(
+        "modelName", "zoo text-model name to resolve through "
+        "ModelDownloader (empty = random init from the width/depth "
+        "params)", TC.toString, default="", has_default=True)
 
     # class-level fallbacks: the serializer reconstructs stages without
     # running __init__ (meshes are runtime wiring, not persisted state)
@@ -249,21 +258,49 @@ class TextEncoderFeaturizer(Transformer, HasInputCol, HasOutputCol,
 
     def _encoder(self):
         if self._cache is None:
-            width, heads = self.get("width"), self.get("heads")
-            if width % (2 * heads) != 0:
-                raise ValueError(
-                    f"width={width} must be a multiple of 2*heads "
-                    f"(heads={heads}): heads split the width and the "
-                    "sinusoidal position encoding needs an even width")
             attn = make_attention_fn(self.get("attentionImpl"),
                                      mesh=self._mesh)
-            module = TextEncoder(vocab=self.get("vocabSize"),
-                                 width=width, heads=heads,
-                                 depth=self.get("depth"),
-                                 attention_fn=attn)
-            rng = jax.random.PRNGKey(self.get("seed"))
-            dummy = jnp.zeros((1, self.get("seqChunk")), jnp.int32)
-            variables = module.init(rng, dummy, False)
+            loaded = self.get("model")
+            if loaded is None and self.get("modelName"):
+                from ..models import ModelDownloader
+                # an explicitly named zoo model must fail loud when its
+                # checkpoint is missing — silently substituting random
+                # weights behind a "pretrained" param would quietly
+                # drop quality to the random-init floor
+                loaded = ModelDownloader().download_by_name(
+                    self.get("modelName"), allow_random_init=False)
+            if loaded is not None:
+                # pretrained path (the ImageFeaturizer pattern,
+                # ``ImageFeaturizer.scala:81-85``): rebuild the loaded
+                # architecture with the REQUESTED attention impl —
+                # attention has no params, so the weights are identical
+                lm = loaded.module
+                if not hasattr(lm, "vocab"):
+                    raise TypeError(
+                        f"model {getattr(loaded.schema, 'name', '?')!r} "
+                        "is not a text encoder (register text entries "
+                        "with models.register_text_encoder)")
+                module = TextEncoder(
+                    vocab=lm.vocab, width=lm.width, depth=lm.depth,
+                    heads=lm.heads, mlp_dim=lm.mlp_dim,
+                    max_len=lm.max_len, dtype=lm.dtype,
+                    attention_fn=attn)
+                variables = loaded.variables
+            else:
+                width, heads = self.get("width"), self.get("heads")
+                if width % (2 * heads) != 0:
+                    raise ValueError(
+                        f"width={width} must be a multiple of 2*heads "
+                        f"(heads={heads}): heads split the width and the "
+                        "sinusoidal position encoding needs an even "
+                        "width")
+                module = TextEncoder(vocab=self.get("vocabSize"),
+                                     width=width, heads=heads,
+                                     depth=self.get("depth"),
+                                     attention_fn=attn)
+                rng = jax.random.PRNGKey(self.get("seed"))
+                dummy = jnp.zeros((1, self.get("seqChunk")), jnp.int32)
+                variables = module.init(rng, dummy, False)
             apply = jax.jit(
                 lambda v, x: module.apply(v, x, False)["pooled"])
             self._cache = (apply, variables)

@@ -9,7 +9,9 @@ lookup rather than graph surgery.
 """
 
 from .resnet import ResNet, ResNet18, ResNet34, ResNet50, ResNet101
-from .zoo import ModelSchema, ModelDownloader, get_model, register_model
+from .zoo import (ModelSchema, ModelDownloader, get_model,
+                  register_model, register_text_encoder)
 
 __all__ = ["ResNet", "ResNet18", "ResNet34", "ResNet50", "ResNet101",
-           "ModelSchema", "ModelDownloader", "get_model", "register_model"]
+           "ModelSchema", "ModelDownloader", "get_model",
+           "register_model", "register_text_encoder"]

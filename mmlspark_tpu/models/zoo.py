@@ -66,6 +66,35 @@ def _register_builtins():
             name=name, num_layers=depth, builder=builder,
             layer_names=tuple(f"block{i + 1}" for i in range(depth))
             + ("pooled", "logits")))
+    # default text entry: the in-framework pretraining target
+    # (dl/pretrain.py) — the text counterpart of the CNN catalogue
+    register_text_encoder("TextEncoderBase", vocab=32768, width=256,
+                          depth=4, heads=8, mlp_dim=1024)
+
+
+def register_text_encoder(name: str, *, vocab: int, width: int,
+                          depth: int, heads: int,
+                          mlp_dim: int | None = None,
+                          seq_len: int = 128) -> ModelSchema:
+    """Register a text-encoder catalogue entry. The reference catalogue
+    is CNN-only (``downloader/Schema.scala``); text entries carry the
+    encoder hyperparameters so a zoo checkpoint (e.g. from
+    ``dl.pretrain.pretrain_masked_lm`` + ``models.convert
+    .save_converted``) reloads into the exact architecture that
+    produced it. ``seq_len`` only sizes the random-init dummy."""
+
+    def builder(**kwargs):
+        from ..dl.text_encoder import TextEncoder
+        return TextEncoder(vocab=vocab, width=width, depth=depth,
+                           heads=heads,
+                           mlp_dim=mlp_dim or 4 * width, **kwargs)
+
+    return register_model(ModelSchema(
+        name=name, dataset="custom", model_type="text",
+        num_layers=depth, input_node="tokens", input_size=seq_len,
+        num_classes=0, builder=builder,
+        layer_names=tuple(f"block{i}" for i in range(depth))
+        + ("tokens", "pooled")))
 
 
 _register_builtins()
@@ -177,8 +206,11 @@ class ModelDownloader:
                 "mmlspark_tpu.models.convert and set MMLSPARK_TPU_MODEL_DIR")
         rng = jax.random.PRNGKey(
             int(hashlib.md5(schema.name.encode()).hexdigest()[:8], 16))
-        dummy = np.zeros((1, schema.input_size, schema.input_size, 3),
-                         np.float32)
+        if schema.model_type == "text":
+            dummy = np.zeros((1, schema.input_size), np.int32)
+        else:
+            dummy = np.zeros((1, schema.input_size, schema.input_size, 3),
+                             np.float32)
         # init on host CPU when available: jitting module.init through a
         # remote-compile TPU tunnel is slow and can wedge; weights move to
         # device on first jitted apply (or an explicit device_put).
