@@ -22,7 +22,8 @@ from jax.sharding import PartitionSpec as P
 
 
 def pipeline_apply(mesh, stage_fn, stacked_params, microbatches,
-                   *, axis: str = "pp", aux=None):
+                   *, axis: str = "pp", aux=None,
+                   remat_stage: bool = False):
     """Run microbatches through S = mesh.shape[axis] pipeline stages.
 
     stage_fn(params_i, h) -> h'  applied by stage i; ``stacked_params`` has
@@ -34,10 +35,18 @@ def pipeline_apply(mesh, stage_fn, stacked_params, microbatches,
     microbatch: at tick t stage s is processing microbatch t-s, so the
     stage receives ``aux[t-s]`` and ``stage_fn(params_i, h, aux_mb)`` —
     attention key masks being the motivating case.
+
+    DIFFERENTIABLE: the schedule is a ``lax.scan`` over ticks, so
+    ``jax.grad`` runs a backward pipeline through the same ring
+    (reversed ``ppermute``s) — pp is a trainable strategy like sp, the
+    GPipe fwd+bwd schedule without 1F1B interleaving. ``remat_stage``
+    recomputes each stage call in the backward instead of storing its
+    activations (GPipe's memory trade; per-tick ``jax.checkpoint``).
     """
     S = int(mesh.shape[axis])
     M = microbatches.shape[0]
     T = M + S - 1
+    run_stage = jax.checkpoint(stage_fn) if remat_stage else stage_fn
 
     def body(params_local, xs, aux_xs):
         params_local = jax.tree.map(lambda p: p[0], params_local)
@@ -45,7 +54,7 @@ def pipeline_apply(mesh, stage_fn, stacked_params, microbatches,
         h = jnp.zeros_like(xs[0])
         outs = jnp.zeros_like(xs)
 
-        def tick(t, carry):
+        def tick(carry, t):
             h_in, outs = carry
             # stage 0 ingests microbatch t (while available)
             mb = jnp.clip(t, 0, M - 1)
@@ -53,11 +62,11 @@ def pipeline_apply(mesh, stage_fn, stacked_params, microbatches,
                                jnp.where(t < M, 1.0, 0.0), 0.0)
             h_cur = inject * xs[mb] + (1.0 - inject) * h_in
             if aux_xs is None:
-                h_out = stage_fn(params_local, h_cur)
+                h_out = run_stage(params_local, h_cur)
             else:
                 # the microbatch this stage is processing right now
                 own = jnp.clip(t - stage, 0, M - 1)
-                h_out = stage_fn(params_local, h_cur, aux_xs[own])
+                h_out = run_stage(params_local, h_cur, aux_xs[own])
             # last stage emits microbatch t-(S-1)
             emit_idx = jnp.clip(t - (S - 1), 0, M - 1)
             emit = (stage == S - 1) & (t >= S - 1)
@@ -69,9 +78,9 @@ def pipeline_apply(mesh, stage_fn, stacked_params, microbatches,
             # rotate activations forward around the ring
             perm = [(i, (i + 1) % S) for i in range(S)]
             h_next = jax.lax.ppermute(h_out, axis, perm)
-            return h_next, outs
+            return (h_next, outs), None
 
-        _, outs = jax.lax.fori_loop(0, T, tick, (h, outs))
+        (_, outs), _ = jax.lax.scan(tick, (h, outs), jnp.arange(T))
         # every shard returns its buffer; only the last stage's is real —
         # broadcast it to all shards so the output is replicated
         last = jax.lax.psum(
@@ -100,7 +109,7 @@ def make_pipeline_mlp(width: int):
 
 def pipeline_encode(mesh, module, variables, ids, *,
                     num_microbatches: int | None = None,
-                    axis: str = "pp"):
+                    axis: str = "pp", remat_stage: bool = False):
     """A REAL model through the pipeline: ``TextEncoder``'s depth
     EncoderBlocks split across the ``axis`` stages (depth % S == 0, each
     stage scanning depth/S blocks), embedding prologue and LN+pool
@@ -159,6 +168,6 @@ def pipeline_encode(mesh, module, variables, ids, *,
     h_mb = h.reshape(M, mb, T, module.width)
     mask_mb = key_mask.reshape(M, mb, T)
     out = pipeline_apply(mesh, stage_fn, stacked, h_mb, axis=axis,
-                         aux=mask_mb)
+                         aux=mask_mb, remat_stage=remat_stage)
     x = out.reshape(N, T, module.width)
     return module.apply(variables, x, ids, method="finalize")

