@@ -270,20 +270,33 @@ class Params:
     # ------------------------------------------------------------- reflection
     @classmethod
     def params(cls) -> list[Param]:
+        # cached per class (stored in cls.__dict__, so subclasses build
+        # their own): the MRO walk dominated hot paths like per-request
+        # model scoring (~30 params() calls per transform). Params are
+        # class attributes fixed at class-creation time — the framework
+        # never attaches one at runtime.
+        cached = cls.__dict__.get("_params_cache")
+        if cached is not None:
+            return cached
         out, seen = [], set()
         for klass in cls.__mro__:
             for k, v in vars(klass).items():
                 if isinstance(v, Param) and k not in seen:
                     seen.add(k)
                     out.append(v)
+        cls._params_cache = out
         return out
 
     @classmethod
     def get_param(cls, name: str) -> Param:
-        for p in cls.params():
-            if p.name == name:
-                return p
-        raise AttributeError(f"{cls.__name__} has no param {name!r}")
+        cached = cls.__dict__.get("_param_by_name")
+        if cached is None:
+            cached = {p.name: p for p in cls.params()}
+            cls._param_by_name = cached
+        p = cached.get(name)
+        if p is None:
+            raise AttributeError(f"{cls.__name__} has no param {name!r}")
+        return p
 
     @classmethod
     def has_param(cls, name: str) -> bool:

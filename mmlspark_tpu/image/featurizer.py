@@ -106,3 +106,10 @@ class ImageFeaturizer(Transformer, HasInputCol, HasOutputCol):
                 minibatchSize=self.get("miniBatchSize"),
                 transferDtype=wire))
         return self._tpu_model[1].transform(df)
+
+    @property
+    def last_transform_stats(self) -> dict | None:
+        """Timing breakdown of the last transform's device leg
+        (``TPUModel.last_stats``): prep/dispatch/drain/total ms — the
+        attribution that separates framework overhead from tunnel RTT."""
+        return self._tpu_model[1].last_stats if self._tpu_model else None

@@ -104,19 +104,20 @@ class Booster:
                 (n_rows, self.num_class)).astype(np.float32)
             return base[:, 0] if self.num_class == 1 else base
         leaves = self._leaf_nodes(x, t_end)          # [n, T]
-        leaf_vals = jnp.asarray(self.arrays["leaf_value"][:t_end])[
-            jnp.arange(t_end)[None, :], leaves]
         w = np.array(self.tree_weights[:t_end])
         t_start = max(int(start_iteration), 0) * self.num_class
         if t_start:
             w[:t_start] = 0.0      # skipped iterations contribute nothing
-        weighted = leaf_vals * jnp.asarray(w)[None, :]
-        per_class = weighted.reshape(n_rows, t_end // self.num_class,
-                                     self.num_class)
-        scores = per_class.sum(axis=1)
-        if self.average_output:
-            scores = scores / max((t_end - t_start) // self.num_class, 1)
-        scores = scores + jnp.asarray(self.init_score).reshape(1, -1)
+        avg_div = max((t_end - t_start) // self.num_class, 1) \
+            if self.average_output else 1
+        # ONE fused dispatch for the post-leaf math (gather + weight +
+        # reduce): the previous eager chain cost ~6 dispatches per call,
+        # which dominates single-row (serving) latency
+        scores = _score_math(
+            self._device_arrays(t_end)[4],  # the cached leaf_value
+            leaves, jnp.asarray(w),
+            jnp.asarray(self.init_score).reshape(-1),
+            num_class=self.num_class, avg_div=avg_div)
         out = np.asarray(scores)
         return out[:, 0] if self.num_class == 1 else out
 
@@ -175,16 +176,27 @@ class Booster:
         return raw
 
     def _device_arrays(self, t_end: int):
+        # cached per (arrays identity, t_end): re-uploading every tree
+        # array on each predict dominated per-request scoring latency.
+        # The arrays dict is never mutated in place after construction
+        # (merge/refit build a new Booster), so identity is a safe key.
+        cache = getattr(self, "_dev_cache", None)
+        if cache is not None and cache[0] is self.arrays \
+                and cache[1] == t_end:
+            return cache[2]
         a = self.arrays
         base = tuple(jnp.asarray(a[k][:t_end]) for k in
                      ("feature", "threshold", "left", "right",
                       "leaf_value", "is_leaf", "default_left"))
         if "cat_flag" in a:
-            return base + (jnp.asarray(a["cat_flag"][:t_end]),
-                           jnp.asarray(a["cat_left"][:t_end]))
-        T, NN = a["feature"][:t_end].shape
-        return base + (jnp.zeros((T, NN), bool),
-                       jnp.zeros((T, NN, 1), bool))
+            out = base + (jnp.asarray(a["cat_flag"][:t_end]),
+                          jnp.asarray(a["cat_left"][:t_end]))
+        else:
+            T, NN = a["feature"][:t_end].shape
+            out = base + (jnp.zeros((T, NN), bool),
+                          jnp.zeros((T, NN, 1), bool))
+        self._dev_cache = (self.arrays, t_end, out)
+        return out
 
     # ---------------------------------------------------------- importances
     def feature_importances(self, importance_type: str = "split",
@@ -496,6 +508,19 @@ def merge_boosters(first: Booster, second: Booster) -> Booster:
 
 
 # ------------------------------------------------------------ jitted predict
+@functools.partial(jax.jit, static_argnames=("num_class", "avg_div"))
+def _score_math(leaf_value, leaves, w, init_score, *, num_class: int,
+                avg_div: int):
+    """Post-leaf scoring in one compiled graph: gather each (row, tree)
+    leaf value, weight (DART/skip weights), reduce per class, add the
+    init score."""
+    n, T = leaves.shape
+    leaf_vals = leaf_value[jnp.arange(T)[None, :], leaves]
+    weighted = leaf_vals * w[None, :]
+    scores = weighted.reshape(n, T // num_class, num_class).sum(axis=1)
+    return scores / avg_div + init_score[None, :]
+
+
 @functools.partial(jax.jit, static_argnames=("max_depth",))
 def _predict_leaf_nodes(tree_arrays, x, *, max_depth: int):
     (feature, threshold, left, right, leaf_value, is_leaf, default_left,
