@@ -34,14 +34,15 @@ PACKAGES: dict[str, list[str]] = {
            "test_parallel.py", "test_pipeline_moe.py",
            "test_sharding_analysis.py", "test_pallas_attention.py"],
     "serving": ["test_http_serving.py", "test_serving_distributed.py",
-                "test_serving_native.py"],
+                "test_serving_native.py", "test_serving_model.py"],
     "cognitive": ["test_cognitive.py", "test_cognitive_speech.py",
                   "test_cognitive_breadth.py"],
     "learners": ["test_learners.py", "test_linear.py",
                  "test_recommendation_lime.py", "test_cyber.py"],
     "io": ["test_native_codegen.py", "test_benchmarks.py",
            "test_reference_parity.py", "test_out_of_core.py",
-           "test_ci.py"],
+           "test_ci.py", "test_bench_banking.py", "test_rcheck.py"],
+    "text": ["test_text_transfer.py"],
 }
 
 

@@ -17,11 +17,13 @@ become:
 """
 
 from .pygen import generate_pyspark, pyspark_class_for
+from .rcheck import RSyntaxError, check_package, check_r_source
 from .rgen import generate_r, r_function_for, snake_case
 from .wrappable import (generate_all, generate_docs, generate_stubs,
                         param_type_hint, py_stub_for)
 
 __all__ = ["generate_r", "r_function_for", "snake_case",
+           "check_package", "check_r_source", "RSyntaxError",
            "generate_all", "generate_docs", "generate_stubs",
            "generate_pyspark", "pyspark_class_for",
            "param_type_hint", "py_stub_for"]

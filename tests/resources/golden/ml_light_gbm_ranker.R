@@ -1,0 +1,140 @@
+#' Template-method base (reference ``LightGBMBase.train``):
+#'
+#' @param bagging_fraction row subsample fraction
+#' @param bagging_freq re-bag every k iterations
+#' @param bagging_seed bagging seed
+#' @param bin_sample_count rows sampled for bin boundaries
+#' @param boost_from_average init score from label average
+#' @param boosting_type gbdt | rf | dart | goss
+#' @param cat_smooth hessian smoothing in the categorical gradient/hessian ratio sort
+#' @param categorical_slot_indexes feature slots treated as categorical
+#' @param categorical_slot_names feature names treated as categorical
+#' @param default_listen_port inert (no socket mesh)
+#' @param drop_rate DART tree dropout rate
+#' @param early_stopping_round stop after k rounds without val improvement
+#' @param eval_at NDCG@k eval positions
+#' @param eval_freq evaluate metrics every k iterations (k>1 removes the per-iteration device sync; early stopping counts evaluations)
+#' @param feature_fraction feature subsample per tree
+#' @param features_col name of the features column
+#' @param fobj custom objective: (scores, labels, weights) -> (grad, hess), must be jittable
+#' @param group_col name of the query-group column (ranking)
+#' @param improvement_tolerance early stopping requires the metric to improve by more than this
+#' @param init_score_col column with initial scores (warm start / boosting continuation)
+#' @param is_provide_training_metric record metrics on training data
+#' @param label_col name of the label column
+#' @param lambda_l1 L1 regularization
+#' @param lambda_l2 L2 regularization
+#' @param learning_rate shrinkage rate
+#' @param max_bin max feature bins
+#' @param max_bin_by_feature per-feature bin budgets (dense path)
+#' @param max_bin_sparse bin cap for padded-COO sparse features (keeps the O(F·bins) split-search scratch small at 2^18-dim)
+#' @param max_cat_threshold max categories in one split's left set (LightGBM max_cat_threshold)
+#' @param max_delta_step cap on leaf output magnitude (0 = unconstrained)
+#' @param max_depth max tree depth (<=0 unlimited)
+#' @param max_drop DART max dropped trees
+#' @param max_position NDCG truncation for eval
+#' @param metric eval metric ('' = objective default)
+#' @param min_data_in_leaf min rows per leaf
+#' @param min_gain_to_split min split gain
+#' @param min_sum_hessian_in_leaf min hessian mass per leaf
+#' @param model_string initial model string for continuation
+#' @param neg_bagging_fraction bagging keep-rate for negative rows
+#' @param num_batches split training into sequential batches with model continuation
+#' @param num_iterations boosting rounds
+#' @param num_leaves max leaves per tree
+#' @param num_shards device shards for training (0 = all devices)
+#' @param num_threads host threads (0 = XLA default)
+#' @param objective lambdarank
+#' @param other_rate GOSS random keep rate
+#' @param parallelism data_parallel | voting_parallel
+#' @param pos_bagging_fraction bagging keep-rate for positive rows (class-stratified bagging)
+#' @param prediction_col name of the prediction column
+#' @param repartition_by_grouping_column keep query groups contiguous (reference :92-101)
+#' @param scan_chunk boosting iterations fused into one device dispatch (lax.scan) when no validation/metrics/delegate observe per-iteration state; 1 disables
+#' @param seed random seed
+#' @param shard_axis_name mesh axis to shard rows over
+#' @param skip_drop DART prob of skipping dropout
+#' @param slot_names feature names
+#' @param sparse_feature_count logical feature-space width for sparse input (0 = max index + 1)
+#' @param timeout inert (no socket mesh)
+#' @param top_k top-K features per shard in voting parallel
+#' @param top_rate GOSS top-gradient keep rate
+#' @param truncation_level lambdarank pair truncation level
+#' @param uniform_drop DART uniform dropout
+#' @param use_barrier_execution_mode inert; SPMD is inherently barriered
+#' @param validation_indicator_col boolean column marking rows held out for early-stopping validation
+#' @param verbosity log level
+#' @param weight_col name of the instance-weight column
+#' @param xgboost_dart_mode xgboost-style dart normalization (not implemented; raises if set)
+#' @export
+ml_light_gbm_ranker <- function(bagging_fraction = NULL, bagging_freq = NULL, bagging_seed = NULL, bin_sample_count = NULL, boost_from_average = NULL, boosting_type = NULL, cat_smooth = NULL, categorical_slot_indexes = NULL, categorical_slot_names = NULL, default_listen_port = NULL, drop_rate = NULL, early_stopping_round = NULL, eval_at = NULL, eval_freq = NULL, feature_fraction = NULL, features_col = NULL, fobj = NULL, group_col = NULL, improvement_tolerance = NULL, init_score_col = NULL, is_provide_training_metric = NULL, label_col = NULL, lambda_l1 = NULL, lambda_l2 = NULL, learning_rate = NULL, max_bin = NULL, max_bin_by_feature = NULL, max_bin_sparse = NULL, max_cat_threshold = NULL, max_delta_step = NULL, max_depth = NULL, max_drop = NULL, max_position = NULL, metric = NULL, min_data_in_leaf = NULL, min_gain_to_split = NULL, min_sum_hessian_in_leaf = NULL, model_string = NULL, neg_bagging_fraction = NULL, num_batches = NULL, num_iterations = NULL, num_leaves = NULL, num_shards = NULL, num_threads = NULL, objective = NULL, other_rate = NULL, parallelism = NULL, pos_bagging_fraction = NULL, prediction_col = NULL, repartition_by_grouping_column = NULL, scan_chunk = NULL, seed = NULL, shard_axis_name = NULL, skip_drop = NULL, slot_names = NULL, sparse_feature_count = NULL, timeout = NULL, top_k = NULL, top_rate = NULL, truncation_level = NULL, uniform_drop = NULL, use_barrier_execution_mode = NULL, validation_indicator_col = NULL, verbosity = NULL, weight_col = NULL, xgboost_dart_mode = NULL) {
+  mod <- reticulate::import("mmlspark_tpu.lightgbm.estimators")
+  kwargs <- list()
+  if (!is.null(bagging_fraction)) kwargs[["baggingFraction"]] <- bagging_fraction
+  if (!is.null(bagging_freq)) kwargs[["baggingFreq"]] <- bagging_freq
+  if (!is.null(bagging_seed)) kwargs[["baggingSeed"]] <- bagging_seed
+  if (!is.null(bin_sample_count)) kwargs[["binSampleCount"]] <- bin_sample_count
+  if (!is.null(boost_from_average)) kwargs[["boostFromAverage"]] <- boost_from_average
+  if (!is.null(boosting_type)) kwargs[["boostingType"]] <- boosting_type
+  if (!is.null(cat_smooth)) kwargs[["catSmooth"]] <- cat_smooth
+  if (!is.null(categorical_slot_indexes)) kwargs[["categoricalSlotIndexes"]] <- categorical_slot_indexes
+  if (!is.null(categorical_slot_names)) kwargs[["categoricalSlotNames"]] <- categorical_slot_names
+  if (!is.null(default_listen_port)) kwargs[["defaultListenPort"]] <- default_listen_port
+  if (!is.null(drop_rate)) kwargs[["dropRate"]] <- drop_rate
+  if (!is.null(early_stopping_round)) kwargs[["earlyStoppingRound"]] <- early_stopping_round
+  if (!is.null(eval_at)) kwargs[["evalAt"]] <- eval_at
+  if (!is.null(eval_freq)) kwargs[["evalFreq"]] <- eval_freq
+  if (!is.null(feature_fraction)) kwargs[["featureFraction"]] <- feature_fraction
+  if (!is.null(features_col)) kwargs[["featuresCol"]] <- features_col
+  if (!is.null(fobj)) kwargs[["fobj"]] <- fobj
+  if (!is.null(group_col)) kwargs[["groupCol"]] <- group_col
+  if (!is.null(improvement_tolerance)) kwargs[["improvementTolerance"]] <- improvement_tolerance
+  if (!is.null(init_score_col)) kwargs[["initScoreCol"]] <- init_score_col
+  if (!is.null(is_provide_training_metric)) kwargs[["isProvideTrainingMetric"]] <- is_provide_training_metric
+  if (!is.null(label_col)) kwargs[["labelCol"]] <- label_col
+  if (!is.null(lambda_l1)) kwargs[["lambdaL1"]] <- lambda_l1
+  if (!is.null(lambda_l2)) kwargs[["lambdaL2"]] <- lambda_l2
+  if (!is.null(learning_rate)) kwargs[["learningRate"]] <- learning_rate
+  if (!is.null(max_bin)) kwargs[["maxBin"]] <- max_bin
+  if (!is.null(max_bin_by_feature)) kwargs[["maxBinByFeature"]] <- max_bin_by_feature
+  if (!is.null(max_bin_sparse)) kwargs[["maxBinSparse"]] <- max_bin_sparse
+  if (!is.null(max_cat_threshold)) kwargs[["maxCatThreshold"]] <- max_cat_threshold
+  if (!is.null(max_delta_step)) kwargs[["maxDeltaStep"]] <- max_delta_step
+  if (!is.null(max_depth)) kwargs[["maxDepth"]] <- max_depth
+  if (!is.null(max_drop)) kwargs[["maxDrop"]] <- max_drop
+  if (!is.null(max_position)) kwargs[["maxPosition"]] <- max_position
+  if (!is.null(metric)) kwargs[["metric"]] <- metric
+  if (!is.null(min_data_in_leaf)) kwargs[["minDataInLeaf"]] <- min_data_in_leaf
+  if (!is.null(min_gain_to_split)) kwargs[["minGainToSplit"]] <- min_gain_to_split
+  if (!is.null(min_sum_hessian_in_leaf)) kwargs[["minSumHessianInLeaf"]] <- min_sum_hessian_in_leaf
+  if (!is.null(model_string)) kwargs[["modelString"]] <- model_string
+  if (!is.null(neg_bagging_fraction)) kwargs[["negBaggingFraction"]] <- neg_bagging_fraction
+  if (!is.null(num_batches)) kwargs[["numBatches"]] <- num_batches
+  if (!is.null(num_iterations)) kwargs[["numIterations"]] <- num_iterations
+  if (!is.null(num_leaves)) kwargs[["numLeaves"]] <- num_leaves
+  if (!is.null(num_shards)) kwargs[["numShards"]] <- num_shards
+  if (!is.null(num_threads)) kwargs[["numThreads"]] <- num_threads
+  if (!is.null(objective)) kwargs[["objective"]] <- objective
+  if (!is.null(other_rate)) kwargs[["otherRate"]] <- other_rate
+  if (!is.null(parallelism)) kwargs[["parallelism"]] <- parallelism
+  if (!is.null(pos_bagging_fraction)) kwargs[["posBaggingFraction"]] <- pos_bagging_fraction
+  if (!is.null(prediction_col)) kwargs[["predictionCol"]] <- prediction_col
+  if (!is.null(repartition_by_grouping_column)) kwargs[["repartitionByGroupingColumn"]] <- repartition_by_grouping_column
+  if (!is.null(scan_chunk)) kwargs[["scanChunk"]] <- scan_chunk
+  if (!is.null(seed)) kwargs[["seed"]] <- seed
+  if (!is.null(shard_axis_name)) kwargs[["shardAxisName"]] <- shard_axis_name
+  if (!is.null(skip_drop)) kwargs[["skipDrop"]] <- skip_drop
+  if (!is.null(slot_names)) kwargs[["slotNames"]] <- slot_names
+  if (!is.null(sparse_feature_count)) kwargs[["sparseFeatureCount"]] <- sparse_feature_count
+  if (!is.null(timeout)) kwargs[["timeout"]] <- timeout
+  if (!is.null(top_k)) kwargs[["topK"]] <- top_k
+  if (!is.null(top_rate)) kwargs[["topRate"]] <- top_rate
+  if (!is.null(truncation_level)) kwargs[["truncationLevel"]] <- truncation_level
+  if (!is.null(uniform_drop)) kwargs[["uniformDrop"]] <- uniform_drop
+  if (!is.null(use_barrier_execution_mode)) kwargs[["useBarrierExecutionMode"]] <- use_barrier_execution_mode
+  if (!is.null(validation_indicator_col)) kwargs[["validationIndicatorCol"]] <- validation_indicator_col
+  if (!is.null(verbosity)) kwargs[["verbosity"]] <- verbosity
+  if (!is.null(weight_col)) kwargs[["weightCol"]] <- weight_col
+  if (!is.null(xgboost_dart_mode)) kwargs[["xgboostDartMode"]] <- xgboost_dart_mode
+  do.call(mod$LightGBMRanker, kwargs)
+}

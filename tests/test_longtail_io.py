@@ -144,10 +144,12 @@ class TestRGeneration:
         assert "num_iterations = NULL" in lgbm
         assert "#' @export" in lgbm
         assert 'reticulate::import("mmlspark_tpu.lightgbm' in lgbm
-        # every generated file balances braces (cheap syntax sanity)
+        # every generated R file passes the vendored syntax checker
+        # (string/comment-aware; replaces the brace-count heuristic)
+        from mmlspark_tpu.codegen import check_r_source
         for f in files:
-            text = open(f).read()
-            assert text.count("{") == text.count("}"), f
+            if f.endswith(".R"):
+                check_r_source(open(f).read(), f)
 
 
 # ------------------------------------------------------------- file stream
