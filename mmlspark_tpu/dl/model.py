@@ -58,6 +58,14 @@ class TPUModel(Model, HasInputCol, HasOutputCol):
         "model's first op casts to bf16 anyway — and 'float32' always "
         "widens on host (pre-round-3 behavior)", TC.toString,
         default="auto", has_default=True)
+    pipelineDepth = Param(
+        "pipelineDepth",
+        "max in-flight dispatched batches before draining (>= 2). The "
+        "default keeps one batch computing while one drains; raise it "
+        "when the device sits behind a high-latency link (e.g. a "
+        "tunnel) so more transfers overlap each round trip — at the "
+        "cost of holding that many batches' outputs in device memory",
+        TC.toInt, default=2, has_default=True)
 
     # class-level fallback: the serializer reconstructs instances
     # without running __init__
@@ -124,10 +132,16 @@ class TPUModel(Model, HasInputCol, HasOutputCol):
                 chunks[endpoint].append(np.asarray(out[endpoint])[:real])
             drain_ms += (time.perf_counter() - t0) * 1e3
 
-        # double-buffered dispatch: pulling a batch's outputs blocks the
-        # host, so keep the NEXT batch already dispatched before pulling —
-        # device compute overlaps the host-side pull + prep (the input-
-        # pipeline overlap a per-batch sync loop forfeits)
+        # pipelined dispatch: pulling a batch's outputs blocks the
+        # host, so keep the next batch(es) already dispatched before
+        # pulling — device compute overlaps the host-side pull + prep
+        # (the input-pipeline overlap a per-batch sync loop forfeits)
+        depth = int(self.get("pipelineDepth"))
+        if depth < 2:
+            raise ValueError(
+                f"pipelineDepth={depth} must be >= 2 (one batch "
+                "computing while one drains); there is no synchronous "
+                "mode")
         inflight: list[tuple[int, dict]] = []
         for start in range(0, n, bs):
             t0 = time.perf_counter()
@@ -146,7 +160,7 @@ class TPUModel(Model, HasInputCol, HasOutputCol):
                         f"{sorted(out)}")
             inflight.append((real, out))
             dispatch_ms += (time.perf_counter() - t0) * 1e3
-            if len(inflight) >= 2:
+            if len(inflight) >= depth:
                 drain(inflight.pop(0))
         for entry in inflight:
             drain(entry)

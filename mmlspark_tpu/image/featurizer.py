@@ -40,6 +40,9 @@ class ImageFeaturizer(Transformer, HasInputCol, HasOutputCol):
         "the zoo model computes in bf16 (its first op is the cast, so "
         "the wire narrowing is lossless)", TC.toString, default="auto",
         has_default=True)
+    pipelineDepth = Param(
+        "pipelineDepth", "max in-flight device batches (see TPUModel)",
+        TC.toInt, default=2, has_default=True)
 
     # class-level fallbacks: the serializer reconstructs without __init__
     _tpu_model = None
@@ -105,6 +108,10 @@ class ImageFeaturizer(Transformer, HasInputCol, HasOutputCol):
                 outputCol=self.getOutputCol(), outputNode=endpoint,
                 minibatchSize=self.get("miniBatchSize"),
                 transferDtype=wire))
+        # depth rides OUTSIDE the cache key: it only shapes the host
+        # loop, so tuning it must not retrace the compiled model
+        self._tpu_model[1].set("pipelineDepth",
+                               self.get("pipelineDepth"))
         return self._tpu_model[1].transform(df)
 
     @property
