@@ -592,7 +592,8 @@ def make_bench_encoder(impl: str):
     return bench
 
 
-def _finalize_encoder(extras: dict, impls=("dense", "pallas")) -> None:
+def _finalize_encoder(extras: dict,
+                      impls=("dense", "pallas", "blockwise")) -> None:
     """Promote the fastest impl's numbers to the headline encoder keys."""
     best = None
     for impl in impls:
@@ -1027,10 +1028,15 @@ def main():
         if want("vit"):
             _watchdog(bench_vit, extras, "vit", 600.0)
         if want("encoder"):
-            for impl in ("dense", "pallas"):
+            raw_impls = os.environ.get("MMLSPARK_TPU_BENCH_ENCODER_IMPLS",
+                                       "dense,pallas,blockwise")
+            impls = tuple(i.strip() for i in raw_impls.split(",")
+                          if i.strip()) \
+                or ("dense", "pallas", "blockwise")
+            for impl in impls:
                 _watchdog(make_bench_encoder(impl), extras,
                           f"encoder_{impl}", 420.0)
-            _finalize_encoder(extras)
+            _finalize_encoder(extras, impls)
             _bank(extras, images_per_sec, _PLATFORM)  # encoder_* heads
         if want("serving"):
             # includes a small GBDT fit for the real-model row

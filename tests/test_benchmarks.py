@@ -141,8 +141,16 @@ class TestTrainBenchmarks:
                              ("digits", load_digits),
                              ("wine", load_wine)):
             d = loader()
-            out[name] = cls._split(d.data.astype(np.float32),
-                                   d.target.astype(np.float32))
+            x = d.data.astype(np.float32)
+            y = d.target.astype(np.float32)
+            if len(y) > 800:
+                # cap CI cost: the XLA:CPU scatter histogram makes the
+                # 10-class digits fit ~10x a binary one (the TPU path
+                # runs the Pallas kernel instead); 800 real rows keep
+                # the regression signal at a fraction of the time
+                keep = np.random.default_rng(29).permutation(len(y))[:800]
+                x, y = x[keep], y[keep]
+            out[name] = cls._split(x, y)
         return out
 
     def test_train_classifier_real_datasets(self):
