@@ -592,8 +592,10 @@ def make_bench_encoder(impl: str):
     return bench
 
 
-def _finalize_encoder(extras: dict,
-                      impls=("dense", "pallas", "blockwise")) -> None:
+_ENCODER_IMPLS = ("dense", "pallas", "blockwise")
+
+
+def _finalize_encoder(extras: dict, impls=_ENCODER_IMPLS) -> None:
     """Promote the fastest impl's numbers to the headline encoder keys."""
     best = None
     for impl in impls:
@@ -1029,10 +1031,9 @@ def main():
             _watchdog(bench_vit, extras, "vit", 600.0)
         if want("encoder"):
             raw_impls = os.environ.get("MMLSPARK_TPU_BENCH_ENCODER_IMPLS",
-                                       "dense,pallas,blockwise")
+                                       ",".join(_ENCODER_IMPLS))
             impls = tuple(i.strip() for i in raw_impls.split(",")
-                          if i.strip()) \
-                or ("dense", "pallas", "blockwise")
+                          if i.strip()) or _ENCODER_IMPLS
             for impl in impls:
                 _watchdog(make_bench_encoder(impl), extras,
                           f"encoder_{impl}", 420.0)
