@@ -844,7 +844,7 @@ def bench_serving(extras: dict) -> None:
                 float(np.percentile(lat, 99)), errors)
 
     def measure(backend: str, suffix: str, *, transform_fn=None,
-                payload=None, n=300, prefix="serving"):
+                payload=None, n=300, warmup=50, prefix="serving"):
         """Spin a query, run the latency loop, bank p50/p99 under
         ``{prefix}{suffix}_*`` — ONE measurement protocol for the toy
         and real-model rows."""
@@ -855,7 +855,7 @@ def bench_serving(extras: dict) -> None:
             if payload is None:
                 payload = np.zeros(16, np.float32).tobytes()
             p50, p99, errors = latency_loop(query.server.address,
-                                            payload, n=n)
+                                            payload, n=n, warmup=warmup)
             if errors:
                 raise RuntimeError(
                     f"{errors}/{n} serving requests returned non-200 — "
@@ -918,6 +918,51 @@ def bench_serving(extras: dict) -> None:
                     traceback.format_exc()[-500:]
     except Exception:
         extras["error_serving_model"] = traceback.format_exc()[-500:]
+
+    # ResNet endpoint (BASELINE configs[5] names one): device-resident
+    # zoo weights scoring one image per request — only meaningful with
+    # an accelerator (on this harness the ~69 ms tunnel RTT rides the
+    # latency; device_dispatch_rtt_ms above attributes it).
+    try:
+        if _BACKEND_OK and any(d.platform != "cpu" for d in jax.devices()):
+            from mmlspark_tpu.core import DataFrame
+            from mmlspark_tpu.image import ImageFeaturizer
+            from mmlspark_tpu.models import ModelDownloader
+            loaded = ModelDownloader().download_by_name(
+                "ResNet50", allow_random_init=True)
+            feat = ImageFeaturizer(model=loaded, cutOutputLayers=1,
+                                   inputCol="image", outputCol="features",
+                                   autoResize=False, miniBatchSize=8)
+            img_bytes = 224 * 224 * 3 * 4
+
+            def resnet_transform(df):
+                imgs = np.stack([
+                    np.frombuffer(r.entity, np.float32)
+                    .reshape(224, 224, 3)
+                    if r.entity and len(r.entity) == img_bytes
+                    else np.zeros((224, 224, 3), np.float32)
+                    for r in df["request"]])
+                out = feat.transform(DataFrame({"image": imgs}))
+                replies = np.empty(len(df), object)
+                replies[:] = [HTTPResponseData(
+                    status_code=200, entity=np.asarray(f).tobytes())
+                    for f in out["features"]]
+                return df.with_column("reply", replies)
+
+            # warm the fixed-shape compile outside the timed loop
+            probe = np.zeros((1, 224, 224, 3), np.float32)
+            feat.transform(DataFrame({"image": probe}))
+            payload = np.random.default_rng(23).normal(
+                size=(224, 224, 3)).astype(np.float32).tobytes()
+            measure("python", "", transform_fn=resnet_transform,
+                    payload=payload, n=120, warmup=20,
+                    prefix="serving_resnet")
+        else:
+            # explicit marker: "intentionally skipped" must be
+            # distinguishable from "silently lost" in the artifact
+            extras["serving_resnet_skipped"] = "no accelerator"
+    except Exception:
+        extras["error_serving_resnet"] = traceback.format_exc()[-500:]
 
     from mmlspark_tpu.native.loader import get_httpfront
     if get_httpfront() is not None:
