@@ -29,8 +29,28 @@ def init_moe_params(rng, num_experts: int, d_model: int, d_hidden: int):
     }
 
 
-def moe_forward(params, x):
-    """Single-device reference: x [T, D] → [T, D], top-1 routing."""
+def load_balance_loss(logits, expert):
+    """Switch-Transformer auxiliary loss: ``E · Σ_e f_e · P_e`` where
+    ``f_e`` is the fraction of tokens dispatched to expert e and
+    ``P_e`` the mean router probability for e. Equals 1.0 at perfect
+    uniformity; grows as routing collapses onto few experts. ``f`` is
+    non-differentiable (argmax counts); gradients reach the router
+    through ``P`` — the standard formulation."""
+    E = logits.shape[-1]
+    probs = jax.nn.softmax(logits, axis=-1)
+    f = jax.nn.one_hot(expert, E).mean(axis=0)
+    P = probs.mean(axis=0)
+    return E * jnp.sum(f * P)
+
+
+def moe_forward(params, x, *, return_aux: bool = False):
+    """Single-device reference: x [T, D] → [T, D], top-1 routing.
+
+    TRAINABLE end-to-end: experts get gradients through their outputs
+    and the router through the chosen-expert probability multiplier
+    (the Switch gating trick). ``return_aux=True`` additionally returns
+    ``{"balance_loss", "expert_fraction"}`` — add ``balance_loss``
+    (scaled ~1e-2) to the task loss to keep routing spread."""
     logits = x @ params["router"]                     # [T, E]
     expert = jnp.argmax(logits, axis=-1)
     gate = jax.nn.softmax(logits, axis=-1)
@@ -39,12 +59,20 @@ def moe_forward(params, x):
     h = jnp.einsum("te,td,edh->teh", dispatch, x, params["w_in"])
     h = jax.nn.gelu(h)
     y = jnp.einsum("teh,ehd->td", h, params["w_out"])
-    return y * gate_top[:, None]
+    out = y * gate_top[:, None]
+    if not return_aux:
+        return out
+    aux = {"balance_loss": load_balance_loss(logits, expert),
+           "expert_fraction": dispatch.mean(axis=0)}
+    return out, aux
 
 
-def make_sharded_moe(mesh, *, axis: str = "ep"):
+def make_sharded_moe(mesh, *, axis: str = "ep",
+                     return_aux: bool = False):
     """Expert-parallel forward: experts shard over ``axis``; tokens are
-    replicated in, outputs psum-combined."""
+    replicated in, outputs psum-combined. Differentiable like the
+    single-device reference (run under ``jit``); with ``return_aux``
+    the replicated balance-loss aux rides out alongside."""
     n = int(mesh.shape[axis])
 
     def local(params, x):
@@ -70,12 +98,21 @@ def make_sharded_moe(mesh, *, axis: str = "ep"):
         h = jax.nn.gelu(h)
         y = jnp.einsum("teh,ehd->td", h, params["w_out"])
         y = y * gate_top[:, None]
-        return jax.lax.psum(y, axis)
+        out = jax.lax.psum(y, axis)
+        if not return_aux:
+            return out
+        # every shard holds the FULL gathered logits, so the aux is
+        # computed identically everywhere — replicated by construction
+        aux = {"balance_loss": load_balance_loss(logits, expert),
+               "expert_fraction": jax.nn.one_hot(expert, E).mean(axis=0)}
+        return out, aux
 
     spec = {"router": P(None, axis), "w_in": P(axis),
             "w_out": P(axis)}
+    out_specs = (P(), {"balance_loss": P(), "expert_fraction": P()}) \
+        if return_aux else P()
     return jax.shard_map(local, mesh=mesh, in_specs=(spec, P()),
-                         out_specs=P(), check_vma=False)
+                         out_specs=out_specs, check_vma=False)
 
 
 def init_moe_blocks(rng, depth: int, d_model: int, num_experts: int,
@@ -87,17 +124,23 @@ def init_moe_blocks(rng, depth: int, d_model: int, num_experts: int,
 
 
 def moe_text_encoder_forward(module, variables, moe_blocks, ids,
-                             moe_apply=None):
+                             moe_apply=None, *, with_aux: bool = False):
     """The REAL TextEncoder with each block's dense feed-forward swapped
     for a top-1 MoE: embed → per block (attention residual, then
     x + MoE(ln_2 x)) → final LN + pool. ``moe_apply(params, tokens)``
     defaults to the single-device :func:`moe_forward`; pass a
     ``make_sharded_moe(mesh)`` for expert parallelism — the attention
     trunk and routing math are identical either way, which is what the
-    sharded-vs-single equivalence tests assert."""
+    sharded-vs-single equivalence tests assert.
+
+    ``with_aux=True``: ``moe_apply`` must be aux-returning (pass
+    ``return_aux=True`` to either builder); the output dict gains
+    ``balance_loss`` (mean over blocks — add it, scaled, to the task
+    loss when TRAINING the MoE) and per-block ``expert_fraction``."""
     from ..dl.text_encoder import EncoderBlock
 
-    moe_apply = moe_apply or moe_forward
+    moe_apply = moe_apply or functools.partial(moe_forward,
+                                               return_aux=with_aux)
     block = EncoderBlock(module.heads, module.mlp_dim, module.width,
                          attention_fn=module.attention_fn,
                          dtype=module.dtype)
@@ -105,14 +148,61 @@ def moe_text_encoder_forward(module, variables, moe_blocks, ids,
     key_mask = ids != 0
     N, T = ids.shape
     W = module.width
+    balance, fractions = [], []
     for i in range(module.depth):
         bvars = {"params": variables["params"][f"block{i}"]}
         x = block.apply(bvars, x, key_mask, method="attend")
         h = block.apply(bvars, x, method="pre_ffn_norm")
         y = moe_apply(moe_blocks[i],
                       h.reshape(N * T, W).astype(jnp.float32))
+        if with_aux:
+            y, aux = y
+            balance.append(aux["balance_loss"])
+            fractions.append(aux["expert_fraction"])
         x = x + y.reshape(N, T, W).astype(x.dtype)
-    return module.apply(variables, x, ids, method="finalize")
+    out = module.apply(variables, x, ids, method="finalize")
+    if with_aux:
+        out["balance_loss"] = jnp.mean(jnp.stack(balance))
+        out["expert_fraction"] = jnp.stack(fractions)
+    return out
+
+
+def make_moe_train_step(mesh, module, tx, *, axis: str = "ep",
+                        balance_weight: float = 1e-2, loss_fn=None):
+    """Jitted expert-parallel TRAINING step for the MoE text encoder:
+    (opt_state, variables, moe_blocks, ids, y) → updated (opt_state,
+    variables, moe_blocks, loss, balance). Gradients flow to the
+    attention trunk, the experts, AND the router (through the Switch
+    gate multiplier); the load-balance aux (scaled by
+    ``balance_weight``) keeps routing spread. Experts stay sharded over
+    ``axis`` throughout — the optimizer update runs on the sharded
+    leaves, so expert state never gathers."""
+    import optax
+
+    sharded = make_sharded_moe(mesh, axis=axis, return_aux=True)
+    loss_fn = loss_fn or (
+        lambda pooled, t: jnp.mean((pooled.mean(-1) - t) ** 2))
+
+    def loss_of(trainable, ids, y):
+        variables, moe_blocks = trainable
+        out = moe_text_encoder_forward(module, variables, moe_blocks,
+                                       ids, moe_apply=sharded,
+                                       with_aux=True)
+        task = loss_fn(out["pooled"], y)
+        return task + balance_weight * out["balance_loss"], \
+            (task, out["balance_loss"])
+
+    @jax.jit
+    def step(opt_state, variables, moe_blocks, ids, y):
+        (_, (task, balance)), grads = jax.value_and_grad(
+            loss_of, has_aux=True)((variables, moe_blocks), ids, y)
+        updates, opt_state = tx.update(grads, opt_state,
+                                       (variables, moe_blocks))
+        variables, moe_blocks = optax.apply_updates(
+            (variables, moe_blocks), updates)
+        return opt_state, variables, moe_blocks, task, balance
+
+    return step
 
 
 def make_moe_text_encoder(mesh, module, variables, moe_blocks, *,
