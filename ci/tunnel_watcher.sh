@@ -5,15 +5,23 @@
 # the tunnel wedges for hours, then recovers silently — a human (or
 # agent) polling by hand misses the window.
 set -u
-ONLY="${MMLSPARK_TPU_WATCH_ONLY:-gbdt,ranker}"
+# empty ONLY = the FULL suite: bench.py orders sub-benches by banking
+# priority and banks each one to BENCH_TPU_BANKED.json as it completes,
+# so a mid-run wedge still keeps everything measured up to that point
+ONLY="${MMLSPARK_TPU_WATCH_ONLY:-}"
 OUT_DIR="${MMLSPARK_TPU_WATCH_DIR:-/tmp/bench_watcher}"
+# must exceed bench.py's worst-case per-sub-bench watchdog sum (~4900s
+# for the full suite): the sub-bench watchdogs are the designed wedge
+# handling, and an outer kill before the final JSON print would leave
+# an empty result and loop forever
+RUN_TIMEOUT="${MMLSPARK_TPU_WATCH_TIMEOUT:-5400}"
 mkdir -p "$OUT_DIR"
 cd "$(dirname "$0")/.."
 while true; do
   if timeout 60 python -c "import jax; assert jax.devices()[0].platform == 'tpu'" \
       >>"$OUT_DIR/probe.log" 2>&1; then
-    echo "$(date -u +%FT%TZ) tunnel up — running bench ($ONLY)" >>"$OUT_DIR/probe.log"
-    MMLSPARK_TPU_BENCH_ONLY="$ONLY" timeout 1200 python bench.py \
+    echo "$(date -u +%FT%TZ) tunnel up — running bench (${ONLY:-full})" >>"$OUT_DIR/probe.log"
+    MMLSPARK_TPU_BENCH_ONLY="$ONLY" timeout "$RUN_TIMEOUT" python bench.py \
       >"$OUT_DIR/bench_recovered.json" 2>>"$OUT_DIR/probe.log"
     # only stop on a non-empty result with NO error keys at all — a
     # mid-suite wedge records error_gbdt/error_ranker (not
