@@ -72,6 +72,23 @@ def _register_builtins():
                           depth=4, heads=8, mlp_dim=1024)
 
 
+class _TextEncoderBuilder:
+    """Picklable text-encoder factory (a closure here would break
+    ComplexParam persistence of any stage holding the LoadedModel —
+    e.g. ``TextEncoderFeaturizer(model=...).save()``)."""
+
+    def __init__(self, vocab: int, width: int, depth: int, heads: int,
+                 mlp_dim: int):
+        self.vocab, self.width, self.depth = vocab, width, depth
+        self.heads, self.mlp_dim = heads, mlp_dim
+
+    def __call__(self, **kwargs):
+        from ..dl.text_encoder import TextEncoder
+        return TextEncoder(vocab=self.vocab, width=self.width,
+                           depth=self.depth, heads=self.heads,
+                           mlp_dim=self.mlp_dim, **kwargs)
+
+
 def register_text_encoder(name: str, *, vocab: int, width: int,
                           depth: int, heads: int,
                           mlp_dim: int | None = None,
@@ -82,17 +99,12 @@ def register_text_encoder(name: str, *, vocab: int, width: int,
     ``dl.pretrain.pretrain_masked_lm`` + ``models.convert
     .save_converted``) reloads into the exact architecture that
     produced it. ``seq_len`` only sizes the random-init dummy."""
-
-    def builder(**kwargs):
-        from ..dl.text_encoder import TextEncoder
-        return TextEncoder(vocab=vocab, width=width, depth=depth,
-                           heads=heads,
-                           mlp_dim=mlp_dim or 4 * width, **kwargs)
-
     return register_model(ModelSchema(
         name=name, dataset="custom", model_type="text",
         num_layers=depth, input_node="tokens", input_size=seq_len,
-        num_classes=0, builder=builder,
+        num_classes=0,
+        builder=_TextEncoderBuilder(vocab, width, depth, heads,
+                                    mlp_dim or 4 * width),
         layer_names=tuple(f"block{i}" for i in range(depth))
         + ("tokens", "pooled")))
 

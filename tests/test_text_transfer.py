@@ -226,6 +226,30 @@ class TestTextTransferChain:
         with pytest.raises(TypeError, match="not a text encoder"):
             TextEncoderFeaturizer(model=vis)._encoder()
 
+    def test_featurizer_with_loaded_model_persists(self, zoo_entry,
+                                                   pretrained_dir,
+                                                   tmp_path):
+        """A stage holding the pretrained LoadedModel must survive
+        save/load (ComplexParam pickling — a closure-based zoo builder
+        broke this)."""
+        from mmlspark_tpu.core import load_stage
+        from mmlspark_tpu.dl import TextEncoderFeaturizer
+        from mmlspark_tpu.models import ModelDownloader
+
+        loaded = ModelDownloader(pretrained_dir).download_by_name(
+            "TextEncoderTest", allow_random_init=False)
+        feat = TextEncoderFeaturizer(model=loaded, inputCol="tokens",
+                                     outputCol="features",
+                                     seqChunk=MAXLEN)
+        rows = np.zeros(2, object)
+        rows[:] = [[1, 2, 3], [4, 5]]
+        df = DataFrame({"tokens": rows})
+        before = np.stack(list(feat.transform(df)["features"]))
+        feat.save(str(tmp_path / "feat"))
+        re_feat = load_stage(str(tmp_path / "feat"))
+        after = np.stack(list(re_feat.transform(df)["features"]))
+        np.testing.assert_allclose(after, before, atol=1e-6)
+
     def test_zoo_text_random_init_and_manifest_guard(self, zoo_entry,
                                                      pretrained_dir):
         from mmlspark_tpu.models import ModelDownloader
