@@ -41,8 +41,23 @@ from ..utils.platform import target_platform  # noqa: F401 (re-export)
 _NEG = -1e30  # additive mask value; -inf breaks the running-max algebra
 
 
+def _allowed_2d(mask_ref, shape, qb_idx, kb_idx, causal: bool):
+    """[BQ, BK] validity: key mask (row-broadcast) ∧, when causal, the
+    lower-triangular position constraint from GLOBAL positions — block
+    index × block size + in-block iota on each axis."""
+    valid = (mask_ref[0, :] != 0)[None, :]
+    if not causal:
+        return jnp.broadcast_to(valid, shape)
+    qpos = qb_idx * shape[0] + jax.lax.broadcasted_iota(
+        jnp.int32, shape, 0)
+    kpos = kb_idx * shape[1] + jax.lax.broadcasted_iota(
+        jnp.int32, shape, 1)
+    return valid & (kpos <= qpos)
+
+
 def _flash_kernel(q_ref, k_ref, v_ref, mask_ref, o_ref,
-                  m_scr, l_scr, acc_scr, *, scale: float):
+                  m_scr, l_scr, acc_scr, *, scale: float,
+                  causal: bool = False):
     """One (bh, q-block, k-block) grid cell of the online softmax."""
     kb = pl.program_id(2)
     nk = pl.num_programs(2)
@@ -58,8 +73,9 @@ def _flash_kernel(q_ref, k_ref, v_ref, mask_ref, o_ref,
     s = jax.lax.dot_general(                       # [BQ, BK] f32 on MXU
         q, k, (((1,), (1,)), ((), ())),
         preferred_element_type=jnp.float32) * scale
-    valid = mask_ref[0, :] != 0                    # [BK]
-    s = jnp.where(valid[None, :], s, _NEG)
+    allowed = _allowed_2d(mask_ref, s.shape, pl.program_id(1), kb,
+                          causal)
+    s = jnp.where(allowed, s, _NEG)
 
     m_prev = m_scr[:, :1]                          # [BQ, 1]
     l_prev = l_scr[:, :1]
@@ -67,7 +83,7 @@ def _flash_kernel(q_ref, k_ref, v_ref, mask_ref, o_ref,
     p = jnp.exp(s - m_new)                         # [BQ, BK]
     # a fully-masked block: every s is _NEG and m_new is _NEG, so
     # p = exp(0) = 1 row-wide — kill it with the validity mask
-    p = jnp.where(valid[None, :], p, 0.0)
+    p = jnp.where(allowed, p, 0.0)
     corr = jnp.exp(m_prev - m_new)                 # [BQ, 1]
     l_scr[:, :1] = l_prev * corr + jnp.sum(p, axis=-1, keepdims=True)
     m_scr[:, :1] = m_new
@@ -84,11 +100,12 @@ def _flash_kernel(q_ref, k_ref, v_ref, mask_ref, o_ref,
 
 
 def _flash_kernel_lse(q_ref, k_ref, v_ref, mask_ref, o_ref, lse_ref,
-                      m_scr, l_scr, acc_scr, *, scale: float):
+                      m_scr, l_scr, acc_scr, *, scale: float,
+                      causal: bool = False):
     """Forward cell that additionally emits the logsumexp row stats the
     fused backward needs (same math as ``_flash_kernel``)."""
     _flash_kernel(q_ref, k_ref, v_ref, mask_ref, o_ref,
-                  m_scr, l_scr, acc_scr, scale=scale)
+                  m_scr, l_scr, acc_scr, scale=scale, causal=causal)
     kb = pl.program_id(2)
     nk = pl.num_programs(2)
 
@@ -117,10 +134,10 @@ def _flash_pack(q, k, v, key_mask, block_q, block_k):
 
 @functools.partial(jax.jit,
                    static_argnames=("block_q", "block_k", "interpret",
-                                    "with_lse"))
+                                    "with_lse", "causal"))
 def _flash_forward(q, k, v, key_mask, *, block_q: int = 256,
                    block_k: int = 512, interpret: bool = False,
-                   with_lse: bool = False):
+                   with_lse: bool = False, causal: bool = False):
     qf, kf, vf, mask, (B, H, T, D, bq, bk, qp, kp) = _flash_pack(
         q, k, v, key_mask, block_q, block_k)
     scale = D ** -0.5
@@ -142,7 +159,8 @@ def _flash_forward(q, k, v, key_mask, *, block_q: int = 256,
         dimension_semantics=("parallel", "parallel", "arbitrary"))
     if with_lse:
         out, lse = pl.pallas_call(
-            functools.partial(_flash_kernel_lse, scale=scale),
+            functools.partial(_flash_kernel_lse, scale=scale,
+                              causal=causal),
             grid=(B * H, nq, nk),
             in_specs=in_specs,
             out_specs=(o_spec,
@@ -158,7 +176,7 @@ def _flash_forward(q, k, v, key_mask, *, block_q: int = 256,
         return (out[:, :T].reshape(B, H, T, D),
                 lse[:, :T, 0].reshape(B, H, T))
     out = pl.pallas_call(
-        functools.partial(_flash_kernel, scale=scale),
+        functools.partial(_flash_kernel, scale=scale, causal=causal),
         grid=(B * H, nq, nk),
         in_specs=in_specs,
         out_specs=o_spec,
@@ -171,7 +189,8 @@ def _flash_forward(q, k, v, key_mask, *, block_q: int = 256,
 
 
 def _bwd_dq_kernel(q_ref, k_ref, v_ref, mask_ref, do_ref, lse_ref,
-                   dsum_ref, dq_ref, dq_scr, *, scale: float):
+                   dsum_ref, dq_ref, dq_scr, *, scale: float,
+                   causal: bool = False):
     """dq = Σ_k ds·K with ds = p·(dp − D)·scale, p = exp(s − lse)."""
     kb = pl.program_id(2)
     nk = pl.num_programs(2)
@@ -185,9 +204,10 @@ def _bwd_dq_kernel(q_ref, k_ref, v_ref, mask_ref, do_ref, lse_ref,
     s = jax.lax.dot_general(
         q, k, (((1,), (1,)), ((), ())),
         preferred_element_type=jnp.float32) * scale
-    valid = mask_ref[0, :] != 0
+    allowed = _allowed_2d(mask_ref, s.shape, pl.program_id(1), kb,
+                          causal)
     p = jnp.exp(s - lse_ref[0])                    # lse [BQ, 1] bcasts
-    p = jnp.where(valid[None, :], p, 0.0)
+    p = jnp.where(allowed, p, 0.0)
     do = do_ref[0].astype(jnp.float32)
     dp = jax.lax.dot_general(                      # [BQ, BK]
         do, v_ref[0], (((1,), (1,)), ((), ())),
@@ -204,7 +224,7 @@ def _bwd_dq_kernel(q_ref, k_ref, v_ref, mask_ref, do_ref, lse_ref,
 
 def _bwd_dkv_kernel(k_ref, v_ref, mask_ref, q_ref, do_ref, lse_ref,
                     dsum_ref, dk_ref, dv_ref, dk_scr, dv_scr, *,
-                    scale: float):
+                    scale: float, causal: bool = False):
     """dv = Σ_q pᵀ·dO; dk = Σ_q dsᵀ·Q — accumulated over q blocks."""
     qb = pl.program_id(2)
     nq = pl.num_programs(2)
@@ -219,9 +239,11 @@ def _bwd_dkv_kernel(k_ref, v_ref, mask_ref, q_ref, do_ref, lse_ref,
     s = jax.lax.dot_general(
         q, k, (((1,), (1,)), ((), ())),
         preferred_element_type=jnp.float32) * scale  # [BQ, BK]
-    valid = mask_ref[0, :] != 0
+    # grid here is (bh, k-block, q-block): q index is program_id(2)
+    allowed = _allowed_2d(mask_ref, s.shape, qb, pl.program_id(1),
+                          causal)
     p = jnp.exp(s - lse_ref[0])
-    p = jnp.where(valid[None, :], p, 0.0)
+    p = jnp.where(allowed, p, 0.0)
     do = do_ref[0].astype(jnp.float32)
     dv_scr[:] = dv_scr[:] + jax.lax.dot_general(     # pᵀ [BK,BQ] · dO
         p.astype(do_ref.dtype), do, (((0,), (0,)), ((), ())),
@@ -241,10 +263,11 @@ def _bwd_dkv_kernel(k_ref, v_ref, mask_ref, q_ref, do_ref, lse_ref,
 
 
 @functools.partial(jax.jit,
-                   static_argnames=("block_q", "block_k", "interpret"))
+                   static_argnames=("block_q", "block_k", "interpret",
+                                    "causal"))
 def _flash_backward(q, k, v, key_mask, o, lse, g, dlse=None, *,
                     block_q: int = 256, block_k: int = 512,
-                    interpret: bool = False):
+                    interpret: bool = False, causal: bool = False):
     """Fused FlashAttention-2-style backward: recompute p per block from
     the saved logsumexp, never materializing [T, T] in HBM.
 
@@ -267,7 +290,7 @@ def _flash_backward(q, k, v, key_mask, o, lse, g, dlse=None, *,
     nq, nk = (T + qp) // bq, (T + kp) // bk
 
     dq = pl.pallas_call(
-        functools.partial(_bwd_dq_kernel, scale=scale),
+        functools.partial(_bwd_dq_kernel, scale=scale, causal=causal),
         grid=(B * H, nq, nk),
         in_specs=[
             pl.BlockSpec((1, bq, D), lambda b, iq, ik: (b, iq, 0)),
@@ -287,7 +310,7 @@ def _flash_backward(q, k, v, key_mask, o, lse, g, dlse=None, *,
     )(qf, kf, vf, mask, gf, lse_f, dsum)
 
     dk, dv = pl.pallas_call(
-        functools.partial(_bwd_dkv_kernel, scale=scale),
+        functools.partial(_bwd_dkv_kernel, scale=scale, causal=causal),
         grid=(B * H, nk, nq),
         in_specs=[
             pl.BlockSpec((1, bk, D), lambda b, ik, iq: (b, ik, 0)),
@@ -318,13 +341,16 @@ def _flash_backward(q, k, v, key_mask, o, lse, g, dlse=None, *,
             dv[:, :T].reshape(B, H, T, D))
 
 
-@functools.partial(jax.custom_vjp, nondiff_argnums=(4, 5, 6, 7))
-def _flash(q, k, v, key_mask, block_q, block_k, interpret, bwd_impl):
+@functools.partial(jax.custom_vjp, nondiff_argnums=(4, 5, 6, 7, 8))
+def _flash(q, k, v, key_mask, block_q, block_k, interpret, bwd_impl,
+           causal):
     return _flash_forward(q, k, v, key_mask, block_q=block_q,
-                          block_k=block_k, interpret=interpret)
+                          block_k=block_k, interpret=interpret,
+                          causal=causal)
 
 
-def _flash_fwd(q, k, v, key_mask, block_q, block_k, interpret, bwd_impl):
+def _flash_fwd(q, k, v, key_mask, block_q, block_k, interpret, bwd_impl,
+               causal):
     # forward-for-gradient also emits the logsumexp row stats, but only
     # when the fused backward will actually consume them — the blockwise
     # backward recomputes from q/k/v and would otherwise pin out+lse in
@@ -334,21 +360,22 @@ def _flash_fwd(q, k, v, key_mask, block_q, block_k, interpret, bwd_impl):
     if fused_bwd:
         out, lse = _flash_forward(q, k, v, key_mask, block_q=block_q,
                                   block_k=block_k, interpret=interpret,
-                                  with_lse=True)
+                                  with_lse=True, causal=causal)
         return out, (q, k, v, key_mask, out, lse)
     out = _flash_forward(q, k, v, key_mask, block_q=block_q,
-                         block_k=block_k, interpret=interpret)
+                         block_k=block_k, interpret=interpret,
+                         causal=causal)
     return out, (q, k, v, key_mask, None, None)
 
 
-def _flash_bwd(block_q, block_k, interpret, bwd_impl, res, g):
+def _flash_bwd(block_q, block_k, interpret, bwd_impl, causal, res, g):
     q, k, v, key_mask, out, lse = res
     if bwd_impl == "pallas" or (bwd_impl == "auto" and not interpret):
         # fused FA2-style backward: per-block p recomputed from the
         # saved logsumexp, [T, T] never touches HBM
         dq, dk, dv = _flash_backward(q, k, v, key_mask, out, lse, g,
                                      block_q=block_q, block_k=block_k,
-                                     interpret=interpret)
+                                     interpret=interpret, causal=causal)
         return dq, dk, dv, None
     # recompute-based backward through the XLA blockwise formulation:
     # same math, O(T) memory — the right choice off-TPU where the Pallas
@@ -357,7 +384,7 @@ def _flash_bwd(block_q, block_k, interpret, bwd_impl, res, g):
 
     def ref(q, k, v):
         return blockwise_attention(q, k, v, block_size=block_k,
-                                   key_mask=key_mask)
+                                   key_mask=key_mask, causal=causal)
 
     _, vjp = jax.vjp(ref, q, k, v)
     dq, dk, dv = vjp(g)
@@ -431,7 +458,7 @@ def flash_attention_lse(q, k, v, key_mask=None, *, block_q: int = 256,
 
 def flash_attention(q, k, v, key_mask=None, *, block_q: int = 256,
                     block_k: int = 512, interpret: bool | None = None,
-                    bwd_impl: str = "auto"):
+                    bwd_impl: str = "auto", causal: bool = False):
     """Fused flash attention. q/k/v [B, H, T, D]; ``key_mask`` [B, T]
     bool (True = valid). Off-TPU it runs the Pallas interpreter (slow —
     tests only); the XLA ``blockwise`` impl is the right CPU choice.
@@ -439,6 +466,11 @@ def flash_attention(q, k, v, key_mask=None, *, block_q: int = 256,
     ``bwd_impl``: "auto" uses the fused Pallas backward on TPU and the
     XLA blockwise recompute elsewhere; "pallas"/"blockwise" force one
     (tests force "pallas" under the interpreter).
+
+    ``causal``: lower-triangular masking from global positions (the
+    LM/decoder pattern), fused into both forward and backward kernels.
+    Blocks fully above the diagonal still run (masked to zero) — the
+    2x compute saving from grid pruning is a future optimization.
     """
     if interpret is None:
         interpret = target_platform() not in ("tpu", "axon")
@@ -448,4 +480,4 @@ def flash_attention(q, k, v, key_mask=None, *, block_q: int = 256,
     if key_mask is None:
         key_mask = jnp.ones((q.shape[0], q.shape[2]), bool)
     return _flash(q, k, v, key_mask, block_q, block_k, bool(interpret),
-                  bwd_impl)
+                  bwd_impl, bool(causal))

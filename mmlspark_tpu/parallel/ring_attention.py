@@ -114,8 +114,11 @@ def ring_attention(q, k, v, *, axis: str = "sp", causal: bool = False,
     the XLA running-softmax update; "flash" uses the fused Pallas kernel
     per ring step (``dl/pallas_attention.flash_attention_lse``) and
     merges the per-step normalized partials via the standard lse merge —
-    the TPU choice (non-causal only: the kernel masks keys, not
-    positions).
+    the TPU choice. Non-causal only: the kernel's causal mode masks
+    GLOBAL positions from static block indices, but each ring step sees
+    a rotated K/V shard whose global offset is a traced axis index —
+    causal ring runs the blockwise local impl (ulysses_flash has no
+    such constraint).
     """
     n = jax.lax.axis_size(axis)
     my = jax.lax.axis_index(axis)
@@ -131,7 +134,11 @@ def ring_attention(q, k, v, *, axis: str = "sp", causal: bool = False,
         if causal:
             raise NotImplementedError(
                 "local_impl='flash' supports non-causal ring attention "
-                "only (the fused kernel masks keys, not positions)")
+                "only: each ring step's K/V shard has a TRACED global "
+                "position offset, which the kernel's static-block "
+                "causal mask cannot express — use local_impl="
+                "'blockwise' for causal ring, or ulysses_flash "
+                "(full sequence per device after the all-to-all)")
         if scale != D ** -0.5:
             raise NotImplementedError(
                 "local_impl='flash' uses the kernel's fixed D**-0.5 "

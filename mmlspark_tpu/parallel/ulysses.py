@@ -38,17 +38,21 @@ def make_ulysses_attention(mesh: Mesh, axis: str = "sp", *,
 
     ``local_impl``: "blockwise" (XLA running softmax) or "flash" (the
     fused Pallas kernel, ``dl/pallas_attention.py``) for each device's
-    full-sequence head-group attention — flash is non-causal and uses
-    the kernel's fixed D**-0.5 scale.
+    full-sequence head-group attention. Flash supports ``causal``
+    directly — after the all-to-all each device sees the FULL sequence
+    in global order, so the kernel's global-position triangular mask
+    applies as-is (unlike ring, where each shard's kernel call would
+    need traced position offsets) — but only the kernel's fixed
+    D**-0.5 scale.
     """
     d = int(mesh.shape[axis])
     if local_impl not in ("blockwise", "flash"):
         raise ValueError(f"unknown local_impl {local_impl!r}; expected "
                          "blockwise|flash")
-    if local_impl == "flash" and (causal or scale is not None):
+    if local_impl == "flash" and scale is not None:
         raise NotImplementedError(
-            "local_impl='flash' supports non-causal attention at the "
-            "default D**-0.5 scale only")
+            "local_impl='flash' supports the kernel's fixed D**-0.5 "
+            "scale only")
 
     def local(q, k, v, kmask):
         # [B, H, t, D] local sequence shard (t = T/d)
@@ -84,7 +88,7 @@ def make_ulysses_attention(mesh: Mesh, axis: str = "sp", *,
         if local_impl == "flash":
             from ..dl.pallas_attention import flash_attention
             out = flash_attention(qh, kh, vh, key_mask=full_mask,
-                                  block_k=block_size)
+                                  block_k=block_size, causal=causal)
         else:
             out = blockwise_attention(qh, kh, vh, causal=causal,
                                       scale=scale,

@@ -347,11 +347,28 @@ class TestUlyssesFlashLocal:
         np.testing.assert_allclose(np.asarray(gf), np.asarray(gb),
                                    atol=2e-5)
 
-    def test_causal_flash_raises(self):
+    def test_causal_flash_matches_blockwise(self):
+        """Causal ulysses_flash: after the all-to-all every device sees
+        the full sequence in global order, so the kernel's causal mode
+        applies directly (ring_flash cannot — traced shard offsets)."""
+        from mmlspark_tpu.parallel.ulysses import make_ulysses_attention
+        q, k, v = self._mk(seed=18)
+        mask = jnp.asarray(
+            np.random.default_rng(19).random((1, 64)) > 0.2)
+        mesh = Mesh(np.asarray(jax.devices()), ("sp",))
+        out_f = make_ulysses_attention(mesh, causal=True,
+                                       local_impl="flash")(
+            q, k, v, key_mask=mask)
+        out_b = make_ulysses_attention(mesh, causal=True)(
+            q, k, v, key_mask=mask)
+        np.testing.assert_allclose(np.asarray(out_f), np.asarray(out_b),
+                                   atol=2e-5)
+
+    def test_custom_scale_flash_raises(self):
         from mmlspark_tpu.parallel.ulysses import make_ulysses_attention
         mesh = Mesh(np.asarray(jax.devices()), ("sp",))
         with pytest.raises(NotImplementedError):
-            make_ulysses_attention(mesh, causal=True, local_impl="flash")
+            make_ulysses_attention(mesh, scale=0.5, local_impl="flash")
 
 
 def test_encoder_trains_through_ring_attention():
