@@ -10,7 +10,7 @@ by ``jax.sharding`` over the mesh.
 
 from .model import TPUModel
 from .pretrain import (MaskedLMModel, encoder_variables,
-                       pretrain_masked_lm)
+                       pretrain_causal_lm, pretrain_masked_lm)
 from .text_encoder import (TextEncoder, TextEncoderFeaturizer,
                            make_attention_fn)
 from .train import (TrainState, make_train_step, shard_train_state,
@@ -19,4 +19,5 @@ from .train import (TrainState, make_train_step, shard_train_state,
 __all__ = ["TPUModel", "TrainState", "make_train_step",
            "shard_train_state", "train_epoch", "TextEncoder",
            "TextEncoderFeaturizer", "make_attention_fn",
-           "MaskedLMModel", "encoder_variables", "pretrain_masked_lm"]
+           "MaskedLMModel", "encoder_variables", "pretrain_masked_lm",
+           "pretrain_causal_lm"]

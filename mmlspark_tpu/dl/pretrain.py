@@ -111,3 +111,57 @@ def encoder_variables(state: TrainState) -> dict:
     the shape ``TextEncoder.apply`` (and the zoo checkpoint format)
     expects."""
     return {"params": state.params["encoder"]}
+
+
+def pretrain_causal_lm(encoder: TextEncoder, ids: np.ndarray, *,
+                       steps: int = 200, batch_size: int = 32,
+                       learning_rate: float = 1e-3, seed: int = 0,
+                       tx: Any = None) -> tuple[TrainState, list[float]]:
+    """Next-token pretraining (the decoder-side twin of
+    :func:`pretrain_masked_lm`): logits at position t predict token
+    t+1, pad targets ignored. Pad id is 0 — the framework-wide
+    convention ``TextEncoder`` hardcodes for its attention key mask and
+    mean-pool (a configurable pad id here would silently desynchronize
+    from the encoder's).
+
+    The ``encoder`` MUST run causal attention (build it with
+    ``make_attention_fn(impl, causal=True)``) — with bidirectional
+    attention the objective is trivially cheatable by copying the next
+    token, and the check below rejects it: position i's logits must be
+    invariant to tokens at positions > i."""
+    ids = np.asarray(ids, np.int32)
+    module = MaskedLMModel(encoder)  # same trunk + token head
+    tx = tx or optax.adamw(learning_rate)
+    state = init_train_state(module, jax.random.PRNGKey(seed), ids[:1],
+                             tx)
+    # causality probe: perturb the LAST position, logits at earlier
+    # positions must not move (catches a bidirectional encoder passed
+    # by mistake — the failure mode is silent otherwise)
+    probe = ids[:1].copy()
+    if probe.shape[1] >= 2:
+        base = module.apply(
+            {"params": state.params}, jnp.asarray(probe))["logits"]
+        probe2 = probe.copy()
+        probe2[0, -1] = (probe2[0, -1] % (encoder.vocab - 2)) + 1
+        alt = module.apply(
+            {"params": state.params}, jnp.asarray(probe2))["logits"]
+        drift = float(jnp.abs(base[0, :-1] - alt[0, :-1]).max())
+        if drift > 1e-4:
+            raise ValueError(
+                "encoder attends to FUTURE positions (logit drift "
+                f"{drift:.2e} after perturbing the last token) — build "
+                "it with make_attention_fn(..., causal=True) for "
+                "causal-LM pretraining")
+    rng = np.random.default_rng(seed)
+
+    def batches():
+        for _ in range(steps):
+            rows = ids[rng.integers(0, len(ids), size=batch_size)]
+            x = rows[:, :-1]
+            y = np.where(rows[:, 1:] != 0, rows[:, 1:],
+                         -1).astype(np.int32)
+            yield x.astype(np.int32), y
+
+    step = make_train_step(module, tx, fetch="logits",
+                           loss_fn=masked_xent)
+    return train_epoch(step, state, batches())

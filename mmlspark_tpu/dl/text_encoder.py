@@ -23,6 +23,7 @@ mean-pooled embeddings, the text counterpart of ``ImageFeaturizer``.
 
 from __future__ import annotations
 
+import functools
 from typing import Any, Callable
 
 import jax
@@ -36,18 +37,22 @@ from ..core.param import ComplexParam, Param, TypeConverters as TC
 from ..core.pipeline import Transformer
 
 
-def _dense_attention(q, k, v, key_mask=None):
+def _dense_attention(q, k, v, key_mask=None, causal: bool = False):
     D = q.shape[-1]
     s = jnp.einsum("bhqd,bhkd->bhqk", q, k,
                    preferred_element_type=jnp.float32) * (D ** -0.5)
+    if causal:
+        T = q.shape[2]
+        tri = jnp.arange(T)[None, :] <= jnp.arange(T)[:, None]
+        s = jnp.where(tri[None, None], s, -jnp.inf)
     if key_mask is not None:
         s = s + jnp.where(key_mask, 0.0, -jnp.inf)[:, None, None, :]
     p = jax.nn.softmax(s, axis=-1)
-    if key_mask is not None:
-        # an all-pad row (empty document) masks every key: softmax over
-        # -inf is NaN; emit zeros like the blockwise/ring accumulators
-        any_valid = key_mask.any(-1)[:, None, None, None]
-        p = jnp.where(any_valid, p, 0.0)
+    if key_mask is not None or causal:
+        # a fully-masked row (empty document / a causal row whose own
+        # position is padded): softmax over -inf is NaN; emit zeros
+        # like the blockwise/ring accumulators
+        p = jnp.where(jnp.isnan(p), 0.0, p)
     return jnp.einsum("bhqk,bhkd->bhqd", p.astype(v.dtype), v)
 
 
@@ -169,35 +174,40 @@ class TextEncoder(nn.Module):
 
 
 def make_attention_fn(impl: str = "dense", mesh=None, axis: str = "sp",
-                      block_size: int = 512) -> Callable:
+                      block_size: int = 512,
+                      causal: bool = False) -> Callable:
     """Resolve an attention implementation by name.
 
     ``ring``/``ulysses`` need a mesh whose ``axis`` shards the sequence;
     the returned fn expects its [B, H, T, D] inputs sharded accordingly
-    (shard with ``NamedSharding(mesh, P(None, None, axis, None))``)."""
+    (shard with ``NamedSharding(mesh, P(None, None, axis, None))``).
+
+    ``causal``: lower-triangular masking (the LM/decoder pattern). Every
+    impl supports it except ``ring_flash`` (whose per-step K/V shards
+    carry traced global offsets — use ``ring`` or ``ulysses_flash``)."""
     if impl == "dense":
-        return _dense_attention
+        return functools.partial(_dense_attention, causal=causal)
     if impl == "pallas":
         from .pallas_attention import flash_attention
         return lambda q, k, v, m=None: flash_attention(
-            q, k, v, key_mask=m, block_k=block_size)
+            q, k, v, key_mask=m, block_k=block_size, causal=causal)
     if impl == "blockwise":
         from ..parallel.ring_attention import blockwise_attention
         return lambda q, k, v, m=None: blockwise_attention(
-            q, k, v, block_size=block_size, key_mask=m)
+            q, k, v, block_size=block_size, key_mask=m, causal=causal)
     if impl in ("ring", "ring_flash"):
         from ..parallel.ring_attention import make_ring_attention
         if mesh is None:
             raise ValueError("ring attention needs a mesh")
         return make_ring_attention(
-            mesh, causal=False, axis=axis,
+            mesh, causal=causal, axis=axis,
             local_impl="flash" if impl == "ring_flash" else "blockwise")
     if impl in ("ulysses", "ulysses_flash"):
         from ..parallel.ulysses import make_ulysses_attention
         if mesh is None:
             raise ValueError("ulysses attention needs a mesh")
         return make_ulysses_attention(
-            mesh, axis=axis,
+            mesh, axis=axis, causal=causal,
             local_impl="flash" if impl == "ulysses_flash"
             else "blockwise")
     raise ValueError(f"unknown attention impl {impl!r}; expected "

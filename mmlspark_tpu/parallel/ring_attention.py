@@ -212,6 +212,17 @@ def make_ring_attention(mesh, *, causal: bool = False, axis: str = "sp",
     The returned fn is ``fn(q, k, v, key_mask=None)`` with ``key_mask``
     [B, T] bool (True = valid key)."""
     from jax.sharding import PartitionSpec as P
+    if causal and local_impl == "flash":
+        # validate at BUILD time like make_ulysses_attention — inside
+        # ring_attention the same check would only fire mid-trace,
+        # buried in a shard_map traceback
+        raise NotImplementedError(
+            "local_impl='flash' supports non-causal ring attention "
+            "only: each ring step's K/V shard has a TRACED global "
+            "position offset, which the kernel's static-block causal "
+            "mask cannot express — use local_impl='blockwise' for "
+            "causal ring, or ulysses_flash (full sequence per device "
+            "after the all-to-all)")
     spec = P(batch_axis, None, axis, None)
 
     @functools.partial(
