@@ -844,30 +844,87 @@ def bench_serving(extras: dict) -> None:
                 float(np.percentile(lat, 99)), errors)
 
     def measure(backend: str, suffix: str, *, transform_fn=None,
-                payload=None, n=300, warmup=50, prefix="serving"):
-        """Spin a query, run the latency loop, bank p50/p99 under
-        ``{prefix}{suffix}_*`` — ONE measurement protocol for the toy
-        and real-model rows."""
+                payload=None, n=300, warmup=50, prefix="serving",
+                conc=1):
+        """Spin a query, run the latency loop, bank results under
+        ``{prefix}{suffix}_*`` — ONE measurement protocol for the toy,
+        real-model, and concurrency rows. ``conc > 1`` fans the loop
+        out over that many keep-alive connections and banks aggregate
+        throughput + worst per-connection tail latency instead of
+        single-connection percentiles."""
+        import threading
+
         query = serving_query(f"bench{prefix}{suffix}",
                               transform_fn or transform,
                               reply_timeout=10.0, backend=backend)
         try:
             if payload is None:
                 payload = np.zeros(16, np.float32).tobytes()
-            p50, p99, errors = latency_loop(query.server.address,
-                                            payload, n=n, warmup=warmup)
+            addr = query.server.address
+            if conc == 1:
+                p50, p99, errors = latency_loop(addr, payload, n=n,
+                                                warmup=warmup)
+                if errors:
+                    raise RuntimeError(
+                        f"{errors}/{n} serving requests returned "
+                        "non-200 — latency figures would be "
+                        "meaningless")
+                extras[f"{prefix}{suffix}_p50_ms"] = round(p50, 3)
+                extras[f"{prefix}{suffix}_p99_ms"] = round(p99, 3)
+                return
+            latency_loop(addr, payload, n=20, warmup=10)  # warm
+            results: list = [None] * conc
+
+            def worker(i):
+                # store failures — a thread exception would otherwise
+                # vanish to stderr and surface only as a NoneType error
+                try:
+                    results[i] = latency_loop(addr, payload, n=n,
+                                              warmup=0)
+                except Exception as e:
+                    results[i] = e
+
+            threads = [threading.Thread(target=worker, args=(i,))
+                       for i in range(conc)]
+            t0 = time.perf_counter()
+            for t in threads:
+                t.start()
+            for t in threads:
+                t.join()
+            dt = time.perf_counter() - t0
+            failed = [r for r in results if isinstance(r, Exception)]
+            if failed:
+                raise RuntimeError(
+                    f"{len(failed)}/{conc} connections failed under "
+                    f"load; first: {failed[0]!r}")
+            errors = sum(r[2] for r in results)
             if errors:
                 raise RuntimeError(
-                    f"{errors}/{n} serving requests returned non-200 — "
-                    "latency figures would be meaningless")
-            extras[f"{prefix}{suffix}_p50_ms"] = round(p50, 3)
-            extras[f"{prefix}{suffix}_p99_ms"] = round(p99, 3)
+                    f"{errors} non-200s under {conc}-way load")
+            extras[f"{prefix}{suffix}_concurrency"] = conc
+            extras[f"{prefix}{suffix}_throughput_rps"] = round(
+                conc * n / dt, 1)
+            extras[f"{prefix}{suffix}_loaded_p99_ms"] = round(
+                max(r[1] for r in results), 3)
         finally:
             query.stop()
 
     measure("python", "")
     extras["serving_vs_1ms_target"] = round(
         SERVING_TARGET_MS / extras["serving_p99_ms"], 3)
+
+    # concurrency throughput (the reference's serving story includes
+    # sustained load, docs/mmlspark-serving.md; round-2 measured ~9k
+    # req/s at 32-way by hand — this banks it). Same python front as
+    # the baseline p50/p99 rows so loaded-vs-unloaded compares like
+    # with like. Fault-isolated.
+    try:
+        conc = int(os.environ.get("MMLSPARK_TPU_BENCH_SERVING_CONC",
+                                  "16"))
+        measure("python", "", n=200, conc=conc)
+    except Exception:
+        extras["error_serving_throughput"] = \
+            traceback.format_exc()[-500:]
 
     # REAL-model serving (VERDICT r3 Missing #5 / BASELINE configs[5]):
     # a FITTED LightGBM pipeline behind the front — request = one
