@@ -41,21 +41,23 @@ from ..utils.platform import target_platform  # noqa: F401 (re-export)
 _NEG = -1e30  # additive mask value; -inf breaks the running-max algebra
 
 
-def _allowed_2d(mask_ref, shape, qb_idx, kb_idx, causal: bool):
+def _allowed_2d(mask_ref, off_ref, shape, qb_idx, kb_idx, causal: bool):
     """[BQ, BK] validity: key mask (row-broadcast) ∧, when causal, the
-    lower-triangular position constraint from GLOBAL positions — block
-    index × block size + in-block iota on each axis."""
+    lower-triangular position constraint from GLOBAL positions —
+    per-call offset (``off_ref`` [1, 2] = (q_off, k_off), traced: ring
+    attention passes each step's shard offsets) + block index × block
+    size + in-block iota on each axis."""
     valid = (mask_ref[0, :] != 0)[None, :]
     if not causal:
         return jnp.broadcast_to(valid, shape)
-    qpos = qb_idx * shape[0] + jax.lax.broadcasted_iota(
+    qpos = off_ref[0, 0] + qb_idx * shape[0] + jax.lax.broadcasted_iota(
         jnp.int32, shape, 0)
-    kpos = kb_idx * shape[1] + jax.lax.broadcasted_iota(
+    kpos = off_ref[0, 1] + kb_idx * shape[1] + jax.lax.broadcasted_iota(
         jnp.int32, shape, 1)
     return valid & (kpos <= qpos)
 
 
-def _flash_kernel(q_ref, k_ref, v_ref, mask_ref, o_ref,
+def _flash_kernel(q_ref, k_ref, v_ref, mask_ref, off_ref, o_ref,
                   m_scr, l_scr, acc_scr, *, scale: float,
                   causal: bool = False):
     """One (bh, q-block, k-block) grid cell of the online softmax."""
@@ -73,8 +75,8 @@ def _flash_kernel(q_ref, k_ref, v_ref, mask_ref, o_ref,
     s = jax.lax.dot_general(                       # [BQ, BK] f32 on MXU
         q, k, (((1,), (1,)), ((), ())),
         preferred_element_type=jnp.float32) * scale
-    allowed = _allowed_2d(mask_ref, s.shape, pl.program_id(1), kb,
-                          causal)
+    allowed = _allowed_2d(mask_ref, off_ref, s.shape,
+                          pl.program_id(1), kb, causal)
     s = jnp.where(allowed, s, _NEG)
 
     m_prev = m_scr[:, :1]                          # [BQ, 1]
@@ -99,12 +101,12 @@ def _flash_kernel(q_ref, k_ref, v_ref, mask_ref, o_ref,
         o_ref[0] = (acc_scr[:] / l).astype(o_ref.dtype)
 
 
-def _flash_kernel_lse(q_ref, k_ref, v_ref, mask_ref, o_ref, lse_ref,
-                      m_scr, l_scr, acc_scr, *, scale: float,
+def _flash_kernel_lse(q_ref, k_ref, v_ref, mask_ref, off_ref, o_ref,
+                      lse_ref, m_scr, l_scr, acc_scr, *, scale: float,
                       causal: bool = False):
     """Forward cell that additionally emits the logsumexp row stats the
     fused backward needs (same math as ``_flash_kernel``)."""
-    _flash_kernel(q_ref, k_ref, v_ref, mask_ref, o_ref,
+    _flash_kernel(q_ref, k_ref, v_ref, mask_ref, off_ref, o_ref,
                   m_scr, l_scr, acc_scr, scale=scale, causal=causal)
     kb = pl.program_id(2)
     nk = pl.num_programs(2)
@@ -135,18 +137,21 @@ def _flash_pack(q, k, v, key_mask, block_q, block_k):
 @functools.partial(jax.jit,
                    static_argnames=("block_q", "block_k", "interpret",
                                     "with_lse", "causal"))
-def _flash_forward(q, k, v, key_mask, *, block_q: int = 256,
+def _flash_forward(q, k, v, key_mask, offs=None, *, block_q: int = 256,
                    block_k: int = 512, interpret: bool = False,
                    with_lse: bool = False, causal: bool = False):
     qf, kf, vf, mask, (B, H, T, D, bq, bk, qp, kp) = _flash_pack(
         q, k, v, key_mask, block_q, block_k)
     scale = D ** -0.5
     nq, nk = (T + qp) // bq, (T + kp) // bk
+    if offs is None:
+        offs = jnp.zeros((1, 2), jnp.int32)
     in_specs = [
         pl.BlockSpec((1, bq, D), lambda b, iq, ik: (b, iq, 0)),
         pl.BlockSpec((1, bk, D), lambda b, iq, ik: (b, ik, 0)),
         pl.BlockSpec((1, bk, D), lambda b, iq, ik: (b, ik, 0)),
         pl.BlockSpec((1, bk), lambda b, iq, ik: (b, ik)),
+        pl.BlockSpec((1, 2), lambda b, iq, ik: (0, 0)),
     ]
     o_spec = pl.BlockSpec((1, bq, D), lambda b, iq, ik: (b, iq, 0))
     o_shape = jax.ShapeDtypeStruct((B * H, T + qp, D), v.dtype)
@@ -172,7 +177,7 @@ def _flash_forward(q, k, v, key_mask, *, block_q: int = 256,
             scratch_shapes=scratch,
             compiler_params=params,
             interpret=interpret,
-        )(qf, kf, vf, mask)
+        )(qf, kf, vf, mask, offs)
         return (out[:, :T].reshape(B, H, T, D),
                 lse[:, :T, 0].reshape(B, H, T))
     out = pl.pallas_call(
@@ -184,12 +189,12 @@ def _flash_forward(q, k, v, key_mask, *, block_q: int = 256,
         scratch_shapes=scratch,
         compiler_params=params,
         interpret=interpret,
-    )(qf, kf, vf, mask)
+    )(qf, kf, vf, mask, offs)
     return out[:, :T].reshape(B, H, T, D)
 
 
-def _bwd_dq_kernel(q_ref, k_ref, v_ref, mask_ref, do_ref, lse_ref,
-                   dsum_ref, dq_ref, dq_scr, *, scale: float,
+def _bwd_dq_kernel(q_ref, k_ref, v_ref, mask_ref, off_ref, do_ref,
+                   lse_ref, dsum_ref, dq_ref, dq_scr, *, scale: float,
                    causal: bool = False):
     """dq = Σ_k ds·K with ds = p·(dp − D)·scale, p = exp(s − lse)."""
     kb = pl.program_id(2)
@@ -204,8 +209,8 @@ def _bwd_dq_kernel(q_ref, k_ref, v_ref, mask_ref, do_ref, lse_ref,
     s = jax.lax.dot_general(
         q, k, (((1,), (1,)), ((), ())),
         preferred_element_type=jnp.float32) * scale
-    allowed = _allowed_2d(mask_ref, s.shape, pl.program_id(1), kb,
-                          causal)
+    allowed = _allowed_2d(mask_ref, off_ref, s.shape,
+                          pl.program_id(1), kb, causal)
     p = jnp.exp(s - lse_ref[0])                    # lse [BQ, 1] bcasts
     p = jnp.where(allowed, p, 0.0)
     do = do_ref[0].astype(jnp.float32)
@@ -222,9 +227,9 @@ def _bwd_dq_kernel(q_ref, k_ref, v_ref, mask_ref, do_ref, lse_ref,
         dq_ref[0] = dq_scr[:].astype(dq_ref.dtype)
 
 
-def _bwd_dkv_kernel(k_ref, v_ref, mask_ref, q_ref, do_ref, lse_ref,
-                    dsum_ref, dk_ref, dv_ref, dk_scr, dv_scr, *,
-                    scale: float, causal: bool = False):
+def _bwd_dkv_kernel(k_ref, v_ref, mask_ref, off_ref, q_ref, do_ref,
+                    lse_ref, dsum_ref, dk_ref, dv_ref, dk_scr, dv_scr,
+                    *, scale: float, causal: bool = False):
     """dv = Σ_q pᵀ·dO; dk = Σ_q dsᵀ·Q — accumulated over q blocks."""
     qb = pl.program_id(2)
     nq = pl.num_programs(2)
@@ -240,8 +245,8 @@ def _bwd_dkv_kernel(k_ref, v_ref, mask_ref, q_ref, do_ref, lse_ref,
         q, k, (((1,), (1,)), ((), ())),
         preferred_element_type=jnp.float32) * scale  # [BQ, BK]
     # grid here is (bh, k-block, q-block): q index is program_id(2)
-    allowed = _allowed_2d(mask_ref, s.shape, qb, pl.program_id(1),
-                          causal)
+    allowed = _allowed_2d(mask_ref, off_ref, s.shape, qb,
+                          pl.program_id(1), causal)
     p = jnp.exp(s - lse_ref[0])
     p = jnp.where(allowed, p, 0.0)
     do = do_ref[0].astype(jnp.float32)
@@ -265,9 +270,10 @@ def _bwd_dkv_kernel(k_ref, v_ref, mask_ref, q_ref, do_ref, lse_ref,
 @functools.partial(jax.jit,
                    static_argnames=("block_q", "block_k", "interpret",
                                     "causal"))
-def _flash_backward(q, k, v, key_mask, o, lse, g, dlse=None, *,
-                    block_q: int = 256, block_k: int = 512,
-                    interpret: bool = False, causal: bool = False):
+def _flash_backward(q, k, v, key_mask, o, lse, g, dlse=None,
+                    offs=None, *, block_q: int = 256,
+                    block_k: int = 512, interpret: bool = False,
+                    causal: bool = False):
     """Fused FlashAttention-2-style backward: recompute p per block from
     the saved logsumexp, never materializing [T, T] in HBM.
 
@@ -288,6 +294,8 @@ def _flash_backward(q, k, v, key_mask, o, lse, g, dlse=None, *,
     lse_f = jnp.pad(lse.reshape(B * H, T), ((0, 0), (0, qp)),
                     constant_values=0.0)[..., None]      # [BH, Tq, 1]
     nq, nk = (T + qp) // bq, (T + kp) // bk
+    if offs is None:
+        offs = jnp.zeros((1, 2), jnp.int32)
 
     dq = pl.pallas_call(
         functools.partial(_bwd_dq_kernel, scale=scale, causal=causal),
@@ -297,6 +305,7 @@ def _flash_backward(q, k, v, key_mask, o, lse, g, dlse=None, *,
             pl.BlockSpec((1, bk, D), lambda b, iq, ik: (b, ik, 0)),
             pl.BlockSpec((1, bk, D), lambda b, iq, ik: (b, ik, 0)),
             pl.BlockSpec((1, bk), lambda b, iq, ik: (b, ik)),
+            pl.BlockSpec((1, 2), lambda b, iq, ik: (0, 0)),
             pl.BlockSpec((1, bq, D), lambda b, iq, ik: (b, iq, 0)),
             pl.BlockSpec((1, bq, 1), lambda b, iq, ik: (b, iq, 0)),
             pl.BlockSpec((1, bq, 1), lambda b, iq, ik: (b, iq, 0)),
@@ -307,7 +316,7 @@ def _flash_backward(q, k, v, key_mask, o, lse, g, dlse=None, *,
         compiler_params=pltpu.CompilerParams(
             dimension_semantics=("parallel", "parallel", "arbitrary")),
         interpret=interpret,
-    )(qf, kf, vf, mask, gf, lse_f, dsum)
+    )(qf, kf, vf, mask, offs, gf, lse_f, dsum)
 
     dk, dv = pl.pallas_call(
         functools.partial(_bwd_dkv_kernel, scale=scale, causal=causal),
@@ -316,6 +325,7 @@ def _flash_backward(q, k, v, key_mask, o, lse, g, dlse=None, *,
             pl.BlockSpec((1, bk, D), lambda b, ik, iq: (b, ik, 0)),
             pl.BlockSpec((1, bk, D), lambda b, ik, iq: (b, ik, 0)),
             pl.BlockSpec((1, bk), lambda b, ik, iq: (b, ik)),
+            pl.BlockSpec((1, 2), lambda b, ik, iq: (0, 0)),
             pl.BlockSpec((1, bq, D), lambda b, ik, iq: (b, iq, 0)),
             pl.BlockSpec((1, bq, D), lambda b, ik, iq: (b, iq, 0)),
             pl.BlockSpec((1, bq, 1), lambda b, ik, iq: (b, iq, 0)),
@@ -334,23 +344,23 @@ def _flash_backward(q, k, v, key_mask, o, lse, g, dlse=None, *,
         compiler_params=pltpu.CompilerParams(
             dimension_semantics=("parallel", "parallel", "arbitrary")),
         interpret=interpret,
-    )(kf, vf, mask, qf, gf, lse_f, dsum)
+    )(kf, vf, mask, offs, qf, gf, lse_f, dsum)
 
     return (dq[:, :T].reshape(B, H, T, D),
             dk[:, :T].reshape(B, H, T, D),
             dv[:, :T].reshape(B, H, T, D))
 
 
-@functools.partial(jax.custom_vjp, nondiff_argnums=(4, 5, 6, 7, 8))
-def _flash(q, k, v, key_mask, block_q, block_k, interpret, bwd_impl,
-           causal):
-    return _flash_forward(q, k, v, key_mask, block_q=block_q,
+@functools.partial(jax.custom_vjp, nondiff_argnums=(5, 6, 7, 8, 9))
+def _flash(q, k, v, key_mask, offs, block_q, block_k, interpret,
+           bwd_impl, causal):
+    return _flash_forward(q, k, v, key_mask, offs, block_q=block_q,
                           block_k=block_k, interpret=interpret,
                           causal=causal)
 
 
-def _flash_fwd(q, k, v, key_mask, block_q, block_k, interpret, bwd_impl,
-               causal):
+def _flash_fwd(q, k, v, key_mask, offs, block_q, block_k, interpret,
+               bwd_impl, causal):
     # forward-for-gradient also emits the logsumexp row stats, but only
     # when the fused backward will actually consume them — the blockwise
     # backward recomputes from q/k/v and would otherwise pin out+lse in
@@ -358,54 +368,60 @@ def _flash_fwd(q, k, v, key_mask, block_q, block_k, interpret, bwd_impl,
     fused_bwd = bwd_impl == "pallas" or (bwd_impl == "auto"
                                          and not interpret)
     if fused_bwd:
-        out, lse = _flash_forward(q, k, v, key_mask, block_q=block_q,
-                                  block_k=block_k, interpret=interpret,
-                                  with_lse=True, causal=causal)
-        return out, (q, k, v, key_mask, out, lse)
-    out = _flash_forward(q, k, v, key_mask, block_q=block_q,
+        out, lse = _flash_forward(q, k, v, key_mask, offs,
+                                  block_q=block_q, block_k=block_k,
+                                  interpret=interpret, with_lse=True,
+                                  causal=causal)
+        return out, (q, k, v, key_mask, offs, out, lse)
+    out = _flash_forward(q, k, v, key_mask, offs, block_q=block_q,
                          block_k=block_k, interpret=interpret,
                          causal=causal)
-    return out, (q, k, v, key_mask, None, None)
+    return out, (q, k, v, key_mask, offs, None, None)
 
 
 def _flash_bwd(block_q, block_k, interpret, bwd_impl, causal, res, g):
-    q, k, v, key_mask, out, lse = res
+    q, k, v, key_mask, offs, out, lse = res
     if bwd_impl == "pallas" or (bwd_impl == "auto" and not interpret):
         # fused FA2-style backward: per-block p recomputed from the
         # saved logsumexp, [T, T] never touches HBM
         dq, dk, dv = _flash_backward(q, k, v, key_mask, out, lse, g,
-                                     block_q=block_q, block_k=block_k,
+                                     offs=offs, block_q=block_q,
+                                     block_k=block_k,
                                      interpret=interpret, causal=causal)
-        return dq, dk, dv, None
+        return dq, dk, dv, None, None
     # recompute-based backward through the XLA blockwise formulation:
-    # same math, O(T) memory — the right choice off-TPU where the Pallas
-    # interpreter would crawl
+    # same math, O(T) memory — with the causal mask's global-position
+    # offsets threaded through (the ring path's shard coordinates)
     from ..parallel.ring_attention import blockwise_attention
 
     def ref(q, k, v):
         return blockwise_attention(q, k, v, block_size=block_k,
-                                   key_mask=key_mask, causal=causal)
+                                   key_mask=key_mask, causal=causal,
+                                   q_offset=offs[0, 0],
+                                   k_offset=offs[0, 1])
 
     _, vjp = jax.vjp(ref, q, k, v)
     dq, dk, dv = vjp(g)
-    return dq, dk, dv, None
+    return dq, dk, dv, None, None
 
 
 _flash.defvjp(_flash_fwd, _flash_bwd)
 
 
-@functools.partial(jax.custom_vjp, nondiff_argnums=(4, 5, 6))
-def _flash_lse(q, k, v, key_mask, block_q, block_k, interpret):
-    return _flash_forward(q, k, v, key_mask, block_q=block_q,
+@functools.partial(jax.custom_vjp, nondiff_argnums=(5, 6, 7, 8))
+def _flash_lse(q, k, v, key_mask, offs, block_q, block_k, interpret,
+               causal):
+    return _flash_forward(q, k, v, key_mask, offs, block_q=block_q,
                           block_k=block_k, interpret=interpret,
-                          with_lse=True)
+                          with_lse=True, causal=causal)
 
 
-def _flash_lse_fwd(q, k, v, key_mask, block_q, block_k, interpret):
-    out, lse = _flash_forward(q, k, v, key_mask, block_q=block_q,
+def _flash_lse_fwd(q, k, v, key_mask, offs, block_q, block_k, interpret,
+                   causal):
+    out, lse = _flash_forward(q, k, v, key_mask, offs, block_q=block_q,
                               block_k=block_k, interpret=interpret,
-                              with_lse=True)
-    return (out, lse), (q, k, v, key_mask, out, lse)
+                              with_lse=True, causal=causal)
+    return (out, lse), (q, k, v, key_mask, offs, out, lse)
 
 
 # test hook: force the fused backward through the interpreter so the
@@ -413,52 +429,66 @@ def _flash_lse_fwd(q, k, v, key_mask, block_q, block_k, interpret):
 _FORCE_FUSED_LSE_BWD = False
 
 
-def _flash_lse_bwd(block_q, block_k, interpret, res, cots):
+def _flash_lse_bwd(block_q, block_k, interpret, causal, res, cots):
     g, dlse = cots
-    q, k, v, key_mask, out, lse = res
+    q, k, v, key_mask, offs, out, lse = res
     if not interpret or _FORCE_FUSED_LSE_BWD:
         dq, dk, dv = _flash_backward(q, k, v, key_mask, out, lse, g,
-                                     dlse=dlse, block_q=block_q,
-                                     block_k=block_k,
-                                     interpret=interpret)
-        return dq, dk, dv, None
-    # off-TPU: XLA recompute through the blockwise (o, lse) reference —
-    # the interpreted Pallas backward would crawl (tests force it via
-    # flash_attention_lse(..., interpret=False) refs when needed)
+                                     dlse=dlse, offs=offs,
+                                     block_q=block_q, block_k=block_k,
+                                     interpret=interpret, causal=causal)
+        return dq, dk, dv, None, None
+    # off-TPU: XLA recompute through the blockwise (o, lse) reference
+    # with the causal offsets threaded through — the interpreted Pallas
+    # backward would crawl (tests force it via _FORCE_FUSED_LSE_BWD)
     from ..parallel.ring_attention import blockwise_attention
 
     def ref(q, k, v):
         return blockwise_attention(q, k, v, block_size=block_k,
-                                   key_mask=key_mask, return_lse=True)
+                                   key_mask=key_mask, causal=causal,
+                                   q_offset=offs[0, 0],
+                                   k_offset=offs[0, 1],
+                                   return_lse=True)
 
     _, vjp = jax.vjp(ref, q, k, v)
     dq, dk, dv = vjp((g, dlse))
-    return dq, dk, dv, None
+    return dq, dk, dv, None, None
 
 
 _flash_lse.defvjp(_flash_lse_fwd, _flash_lse_bwd)
 
 
+def _pack_offs(q_offset, k_offset):
+    return jnp.stack([jnp.asarray(q_offset, jnp.int32),
+                      jnp.asarray(k_offset, jnp.int32)]).reshape(1, 2)
+
+
 def flash_attention_lse(q, k, v, key_mask=None, *, block_q: int = 256,
                         block_k: int = 512,
-                        interpret: bool | None = None):
+                        interpret: bool | None = None,
+                        causal: bool = False, q_offset=0, k_offset=0):
     """Flash attention that also returns the per-row logsumexp of the
     scaled scores — the merge statistic ring attention needs to combine
     per-shard partial attentions. Returns ``(o [B,H,T,D], lse [B,H,T])``;
     fully-masked rows report lse ≈ -1e30 (their o is zero), which the
     standard lse-merge treats as an empty contribution. Differentiable
-    in both outputs (fused Pallas backward)."""
+    in both outputs (fused Pallas backward).
+
+    ``causal`` masks GLOBAL positions ``offset + index`` — the
+    (possibly traced) ``q_offset``/``k_offset`` let sequence-sharded
+    callers (the causal ring) express each shard's true coordinates."""
     if interpret is None:
         interpret = target_platform() not in ("tpu", "axon")
     if key_mask is None:
         key_mask = jnp.ones((q.shape[0], q.shape[2]), bool)
-    return _flash_lse(q, k, v, key_mask, block_q, block_k,
-                      bool(interpret))
+    return _flash_lse(q, k, v, key_mask, _pack_offs(q_offset, k_offset),
+                      block_q, block_k, bool(interpret), bool(causal))
 
 
 def flash_attention(q, k, v, key_mask=None, *, block_q: int = 256,
                     block_k: int = 512, interpret: bool | None = None,
-                    bwd_impl: str = "auto", causal: bool = False):
+                    bwd_impl: str = "auto", causal: bool = False,
+                    q_offset=0, k_offset=0):
     """Fused flash attention. q/k/v [B, H, T, D]; ``key_mask`` [B, T]
     bool (True = valid). Off-TPU it runs the Pallas interpreter (slow —
     tests only); the XLA ``blockwise`` impl is the right CPU choice.
@@ -467,10 +497,11 @@ def flash_attention(q, k, v, key_mask=None, *, block_q: int = 256,
     XLA blockwise recompute elsewhere; "pallas"/"blockwise" force one
     (tests force "pallas" under the interpreter).
 
-    ``causal``: lower-triangular masking from global positions (the
-    LM/decoder pattern), fused into both forward and backward kernels.
-    Blocks fully above the diagonal still run (masked to zero) — the
-    2x compute saving from grid pruning is a future optimization.
+    ``causal``: lower-triangular masking from GLOBAL positions
+    (``offset + index``; offsets may be traced — sequence-sharded
+    callers pass shard coordinates), fused into both forward and
+    backward kernels. Blocks fully above the diagonal still run
+    (masked to zero) — grid pruning is a future optimization.
     """
     if interpret is None:
         interpret = target_platform() not in ("tpu", "axon")
@@ -479,5 +510,6 @@ def flash_attention(q, k, v, key_mask=None, *, block_q: int = 256,
                          "auto|pallas|blockwise")
     if key_mask is None:
         key_mask = jnp.ones((q.shape[0], q.shape[2]), bool)
-    return _flash(q, k, v, key_mask, block_q, block_k, bool(interpret),
-                  bwd_impl, bool(causal))
+    return _flash(q, k, v, key_mask, _pack_offs(q_offset, k_offset),
+                  block_q, block_k, bool(interpret), bwd_impl,
+                  bool(causal))

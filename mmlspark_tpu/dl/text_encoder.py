@@ -182,9 +182,10 @@ def make_attention_fn(impl: str = "dense", mesh=None, axis: str = "sp",
     the returned fn expects its [B, H, T, D] inputs sharded accordingly
     (shard with ``NamedSharding(mesh, P(None, None, axis, None))``).
 
-    ``causal``: lower-triangular masking (the LM/decoder pattern). Every
-    impl supports it except ``ring_flash`` (whose per-step K/V shards
-    carry traced global offsets — use ``ring`` or ``ulysses_flash``)."""
+    ``causal``: lower-triangular masking (the LM/decoder pattern),
+    supported by every implementation — the sharded flash variants
+    pass their shards' (traced) global position offsets into the
+    kernel's position mask."""
     if impl == "dense":
         return functools.partial(_dense_attention, causal=causal)
     if impl == "pallas":

@@ -43,7 +43,8 @@ def _block_update(q, k, v, m, l, acc, bias, scale):
 
 def blockwise_attention(q, k, v, *, block_size: int = 512,
                         causal: bool = False, scale: float | None = None,
-                        key_mask=None, return_lse: bool = False):
+                        key_mask=None, return_lse: bool = False,
+                        q_offset=0, k_offset=0):
     """Single-device blockwise (flash-style) attention.
 
     q/k/v: [B, H, T, D]. Computes exact softmax attention in blocks over the
@@ -53,6 +54,10 @@ def blockwise_attention(q, k, v, *, block_size: int = 512,
     logsumexp [B, H, T]; fully-masked rows report the same finite
     sentinel (~-1e30) as ``flash_attention_lse`` so the two backends of
     the lse API agree (consumers may subtract or exp() across them).
+
+    ``q_offset``/``k_offset`` (possibly traced) shift the GLOBAL
+    positions the causal mask compares — the O(T)-memory recompute
+    backward for offset-carrying fused-kernel calls (the causal ring).
     """
     B, H, T, D = q.shape
     scale = scale if scale is not None else D ** -0.5
@@ -66,7 +71,7 @@ def blockwise_attention(q, k, v, *, block_size: int = 512,
     kb = kp.reshape(B, H, nb, block_size, D)
     vb = vp.reshape(B, H, nb, block_size, D)
 
-    q_pos = jnp.arange(T)
+    q_pos = q_offset + jnp.arange(T)
 
     if key_mask is not None and pad:
         key_mask = jnp.pad(key_mask, ((0, 0), (0, pad)))
@@ -75,11 +80,12 @@ def blockwise_attention(q, k, v, *, block_size: int = 512,
         m, l, acc = carry
         kv_i = jnp.take(kb, i, axis=2)
         vv_i = jnp.take(vb, i, axis=2)
-        k_pos = i * block_size + jnp.arange(block_size)
-        bias = jnp.where(k_pos[None, :] >= T, -jnp.inf, 0.0)
+        k_idx = i * block_size + jnp.arange(block_size)  # LOCAL: pads
+        bias = jnp.where(k_idx[None, :] >= T, -jnp.inf, 0.0)
         if causal:
             bias = bias + jnp.where(
-                k_pos[None, :] > q_pos[:, None], -jnp.inf, 0.0)
+                (k_offset + k_idx)[None, :] > q_pos[:, None],
+                -jnp.inf, 0.0)
         bias = bias[None, None]
         if key_mask is not None:
             mb = jax.lax.dynamic_slice_in_dim(
@@ -92,7 +98,13 @@ def blockwise_attention(q, k, v, *, block_size: int = 512,
     l0 = jnp.zeros((B, H, T), q.dtype)
     a0 = jnp.zeros_like(q)
     m, l, acc = jax.lax.fori_loop(0, nb, body, (m0, l0, a0))
-    out = acc / jnp.maximum(l, 1e-35)[..., None]
+    # valid rows always have l >= 1 (the row max contributes exp(0));
+    # fully-masked rows have l == 0 EXACTLY, acc == 0. Dividing by a
+    # tiny clamp instead would NaN the BACKWARD: the quotient rule
+    # squares the denominator and (1e-35)^2 underflows float32 to 0,
+    # so the l-cotangent becomes 0 * inf.
+    l_safe = jnp.where(l > 0, l, 1.0)
+    out = acc / l_safe[..., None]
     if return_lse:
         # clamp the fully-masked-row -inf to the flash kernel's finite
         # sentinel so both lse backends agree (ADVICE r3)
@@ -114,11 +126,9 @@ def ring_attention(q, k, v, *, axis: str = "sp", causal: bool = False,
     the XLA running-softmax update; "flash" uses the fused Pallas kernel
     per ring step (``dl/pallas_attention.flash_attention_lse``) and
     merges the per-step normalized partials via the standard lse merge —
-    the TPU choice. Non-causal only: the kernel's causal mode masks
-    GLOBAL positions from static block indices, but each ring step sees
-    a rotated K/V shard whose global offset is a traced axis index —
-    causal ring runs the blockwise local impl (ulysses_flash has no
-    such constraint).
+    the TPU choice. Causal works in both: the kernel takes the held
+    K/V block's (traced) global position offsets, so each ring step
+    masks against true sequence coordinates.
     """
     n = jax.lax.axis_size(axis)
     my = jax.lax.axis_index(axis)
@@ -131,14 +141,6 @@ def ring_attention(q, k, v, *, axis: str = "sp", causal: bool = False,
         key_mask = jnp.ones((B, Tl), bool)
 
     if local_impl == "flash":
-        if causal:
-            raise NotImplementedError(
-                "local_impl='flash' supports non-causal ring attention "
-                "only: each ring step's K/V shard has a TRACED global "
-                "position offset, which the kernel's static-block "
-                "causal mask cannot express — use local_impl="
-                "'blockwise' for causal ring, or ulysses_flash "
-                "(full sequence per device after the all-to-all)")
         if scale != D ** -0.5:
             raise NotImplementedError(
                 "local_impl='flash' uses the kernel's fixed D**-0.5 "
@@ -147,7 +149,13 @@ def ring_attention(q, k, v, *, axis: str = "sp", causal: bool = False,
 
         def body_flash(i, carry):
             o, lse, kc, vc, mc = carry
-            o_i, lse_i = flash_attention_lse(q, kc, vc, key_mask=mc)
+            # the held K/V block's GLOBAL offset: whose shard is it
+            # after i rotations — traced, passed into the kernel's
+            # causal position mask (ignored when non-causal)
+            src_shard = (my - i) % n
+            o_i, lse_i = flash_attention_lse(
+                q, kc, vc, key_mask=mc, causal=causal,
+                q_offset=my * Tl, k_offset=src_shard * Tl)
             # merge two normalized partial attentions: softmax weights
             # are exp(lse - M) per side; empty sides carry lse ≈ -1e30.
             # The o carry accumulates in f32 (the merge weights are f32;
@@ -200,7 +208,9 @@ def ring_attention(q, k, v, *, axis: str = "sp", causal: bool = False,
     a0 = jnp.zeros_like(q)
     m, l, acc, _, _, _ = jax.lax.fori_loop(
         0, n, body, (m0, l0, a0, k, v, key_mask))
-    return acc / jnp.maximum(l, 1e-35)[..., None]
+    # l == 0 exactly for fully-masked rows (valid rows have l >= 1);
+    # see blockwise_attention for why a tiny clamp would NaN backward
+    return acc / jnp.where(l > 0, l, 1.0)[..., None]
 
 
 def make_ring_attention(mesh, *, causal: bool = False, axis: str = "sp",
@@ -212,17 +222,6 @@ def make_ring_attention(mesh, *, causal: bool = False, axis: str = "sp",
     The returned fn is ``fn(q, k, v, key_mask=None)`` with ``key_mask``
     [B, T] bool (True = valid key)."""
     from jax.sharding import PartitionSpec as P
-    if causal and local_impl == "flash":
-        # validate at BUILD time like make_ulysses_attention — inside
-        # ring_attention the same check would only fire mid-trace,
-        # buried in a shard_map traceback
-        raise NotImplementedError(
-            "local_impl='flash' supports non-causal ring attention "
-            "only: each ring step's K/V shard has a TRACED global "
-            "position offset, which the kernel's static-block causal "
-            "mask cannot express — use local_impl='blockwise' for "
-            "causal ring, or ulysses_flash (full sequence per device "
-            "after the all-to-all)")
     spec = P(batch_axis, None, axis, None)
 
     @functools.partial(

@@ -206,6 +206,97 @@ class TestCausal:
         for a, b in zip(g_f, g_b):
             np.testing.assert_allclose(a, b, atol=1e-4)
 
+    def test_offsets_reconstruct_full_causal_via_lse_merge(self):
+        """Global-position offsets, validated the way the causal ring
+        uses them: attend the SAME q block (global rows 32..63) against
+        two k shards (keys 0..31 at k_offset 0, keys 32..63 at
+        k_offset 32), lse-merge the partials, and recover rows 32..63
+        of the full causal attention exactly."""
+        from mmlspark_tpu.dl.pallas_attention import flash_attention_lse
+        q, k, v = _rand_qkv(T=64)
+        full = self._dense_causal(q, k, v)
+        qb = q[:, :, 32:]
+        o_parts, lse_parts = [], []
+        for k_off in (0, 32):
+            o_i, lse_i = flash_attention_lse(
+                qb, k[:, :, k_off:k_off + 32], v[:, :, k_off:k_off + 32],
+                block_q=16, block_k=16, causal=True, q_offset=32,
+                k_offset=k_off)
+            o_parts.append(np.asarray(o_i, np.float64))
+            lse_parts.append(np.asarray(lse_i, np.float64))
+        m = np.maximum(lse_parts[0], lse_parts[1])
+        wa = np.exp(lse_parts[0] - m)
+        wb = np.exp(lse_parts[1] - m)
+        merged = (o_parts[0] * wa[..., None]
+                  + o_parts[1] * wb[..., None]) / (wa + wb)[..., None]
+        np.testing.assert_allclose(merged, np.asarray(full[:, :, 32:]),
+                                   atol=2e-5)
+        # a k shard strictly in the future contributes nothing: its
+        # rows are fully masked -> zero output, lse at the sentinel
+        o_fut, lse_fut = flash_attention_lse(
+            q[:, :, :32], k[:, :, 32:], v[:, :, 32:], block_q=16,
+            block_k=16, causal=True, q_offset=0, k_offset=32)
+        np.testing.assert_allclose(np.asarray(o_fut), 0.0, atol=1e-6)
+        assert float(np.max(np.asarray(lse_fut))) < -1e29
+
+    def test_fused_backward_with_offsets_matches_blockwise(self):
+        """The Pallas bwd kernels with NONZERO offsets are the real-TPU
+        causal-ring gradient path — force them through the interpreter
+        and pin against the offset-aware blockwise autodiff (a swapped
+        q/k offset or a bad off_ref index map passes every zero-offset
+        test but corrupts ring training grads silently)."""
+        from mmlspark_tpu.parallel.ring_attention import \
+            blockwise_attention
+        q, k, v = _rand_qkv(B=1, H=2, T=32, D=16)
+        mask = jnp.asarray(np.random.default_rng(8).random((1, 32))
+                           > 0.2)
+        cot = _rand_qkv(B=1, H=2, T=32, D=16, seed=9)[0]
+
+        def loss_flash(q, k, v):
+            return (flash_attention(q, k, v, key_mask=mask, block_q=16,
+                                    block_k=16, bwd_impl="pallas",
+                                    causal=True, q_offset=32,
+                                    k_offset=16) * cot).sum()
+
+        def loss_block(q, k, v):
+            return (blockwise_attention(q, k, v, block_size=16,
+                                        key_mask=mask, causal=True,
+                                        q_offset=32, k_offset=16)
+                    * cot).sum()
+
+        g_f = jax.grad(loss_flash, argnums=(0, 1, 2))(q, k, v)
+        g_b = jax.grad(loss_block, argnums=(0, 1, 2))(q, k, v)
+        for a, b in zip(g_f, g_b):
+            np.testing.assert_allclose(a, b, atol=1e-4)
+
+    def test_fused_lse_backward_with_offsets(self, monkeypatch):
+        """Same pin for the lse-variant backward (the ring's actual
+        consumer), forced through the interpreted fused kernels."""
+        import mmlspark_tpu.dl.pallas_attention as pa
+        from mmlspark_tpu.parallel.ring_attention import \
+            blockwise_attention
+        q, k, v = _rand_qkv(B=1, H=2, T=32, D=16)
+        cot_o = _rand_qkv(B=1, H=2, T=32, D=16, seed=9)[0]
+
+        def loss_fused(q, k, v):
+            o, lse = pa.flash_attention_lse(q, k, v, block_q=16,
+                                            block_k=16, causal=True,
+                                            q_offset=32, k_offset=16)
+            return (o * cot_o).sum() + lse.sum()
+
+        def loss_block(q, k, v):
+            o, lse = blockwise_attention(q, k, v, block_size=16,
+                                         causal=True, q_offset=32,
+                                         k_offset=16, return_lse=True)
+            return (o * cot_o).sum() + lse.sum()
+
+        monkeypatch.setattr(pa, "_FORCE_FUSED_LSE_BWD", True)
+        g_f = jax.grad(loss_fused, argnums=(0, 1, 2))(q, k, v)
+        monkeypatch.setattr(pa, "_FORCE_FUSED_LSE_BWD", False)
+        g_b = jax.grad(loss_block, argnums=(0, 1, 2))(q, k, v)
+        for a, b in zip(g_f, g_b):
+            np.testing.assert_allclose(a, b, atol=1e-4)
+
     def test_blockwise_recompute_backward_respects_causal(self):
         """bwd_impl='blockwise' (the off-TPU default) must use the
         CAUSAL reference — a non-causal recompute would silently leak

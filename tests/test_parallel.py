@@ -134,6 +134,29 @@ class TestRingAttention:
         np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
                                    atol=2e-5)
 
+    def test_fully_masked_rows_backward_finite(self):
+        """Regression: dividing masked rows' acc (== 0) by a tiny
+        clamp NaN'd the BACKWARD — the quotient rule squares the
+        denominator and (1e-35)^2 underflows float32 to 0, so the
+        l-cotangent became 0 * inf. Valid rows always have l >= 1, so
+        the exact l == 0 guard costs nothing."""
+        rng = np.random.default_rng(23)
+        q, k, v = (jnp.asarray(rng.normal(size=(1, 2, 16, 8)),
+                               jnp.float32) for _ in range(3))
+        mask = jnp.zeros((1, 16), bool)  # every key masked
+        for kwargs in ({"key_mask": mask},
+                       {"causal": True, "q_offset": 0, "k_offset": 32},
+                       {"key_mask": mask, "return_lse": True}):
+            def loss(q, k, v, kw=kwargs):
+                out = blockwise_attention(q, k, v, block_size=8, **kw)
+                if isinstance(out, tuple):
+                    return out[0].sum() + out[1].sum()
+                return out.sum()
+
+            grads = jax.grad(loss, argnums=(0, 1, 2))(q, k, v)
+            for g in grads:
+                assert np.isfinite(np.asarray(g)).all(), kwargs
+
     @pytest.mark.parametrize("causal", [False, True])
     def test_ring_matches_reference(self, causal):
         rng = np.random.default_rng(3)
@@ -291,11 +314,34 @@ class TestRingFlashLocal:
         np.testing.assert_allclose(np.asarray(gf), np.asarray(gb),
                                    atol=2e-5)
 
-    def test_ring_flash_causal_raises_at_build_time(self):
-        # rejected at construction (not buried mid-trace in shard_map)
+    def test_ring_flash_causal_matches_blockwise(self):
+        """Causal ring_flash: each ring step passes the held K/V
+        block's traced global offset into the kernel's position mask —
+        must agree with the blockwise causal ring."""
         mesh = Mesh(np.asarray(jax.devices()), ("sp",))
-        with pytest.raises(NotImplementedError, match="TRACED global"):
-            make_ring_attention(mesh, causal=True, local_impl="flash")
+        rng = np.random.default_rng(21)
+        q, k, v = (jnp.asarray(rng.normal(size=(1, 2, 64, 16)),
+                               jnp.float32) for _ in range(3))
+        mask = jnp.asarray(rng.random((1, 64)) > 0.2)
+        out_f = make_ring_attention(mesh, causal=True,
+                                    local_impl="flash")(
+            q, k, v, key_mask=mask)
+        out_b = make_ring_attention(mesh, causal=True)(
+            q, k, v, key_mask=mask)
+        np.testing.assert_allclose(np.asarray(out_f), np.asarray(out_b),
+                                   atol=2e-5)
+
+    def test_ring_flash_causal_grads_match(self):
+        mesh = Mesh(np.asarray(jax.devices()), ("sp",))
+        rng = np.random.default_rng(22)
+        q, k, v = (jnp.asarray(rng.normal(size=(1, 2, 64, 16)),
+                               jnp.float32) for _ in range(3))
+        fn_f = make_ring_attention(mesh, causal=True, local_impl="flash")
+        fn_b = make_ring_attention(mesh, causal=True)
+        gf = jax.jit(jax.grad(lambda q: fn_f(q, k, v).sum()))(q)
+        gb = jax.jit(jax.grad(lambda q: fn_b(q, k, v).sum()))(q)
+        np.testing.assert_allclose(np.asarray(gf), np.asarray(gb),
+                                   atol=2e-5)
 
     def test_ring_flash_bf16_carry(self):
         # the o carry accumulates f32 (bf16 would promote mid-merge and
