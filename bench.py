@@ -1072,11 +1072,47 @@ def _serving_fallback(extras: dict) -> None:
         extras["error_serving_fallback"] = detail
 
 
+def _emit(images_per_sec: float, extras: dict) -> None:
+    print(json.dumps({
+        "metric": "imagefeaturizer_resnet50_inference",
+        "value": round(images_per_sec, 1),
+        "unit": "images/sec/chip",
+        "vs_baseline": round(images_per_sec / A100_IMAGES_PER_SEC, 3),
+        "extras": extras,
+    }), flush=True)
+
+
 def main():
     _ensure_cpu_backend_available()
     extras: dict = {}
     images_per_sec = 0.0
     only = os.environ.get("MMLSPARK_TPU_BENCH_ONLY", "")
+
+    # the driver's patience is unknown and the full suite can run for
+    # over an hour through the tunnel: a SIGTERM/SIGINT must still
+    # produce the one-line JSON with whatever was measured (and banked)
+    # so far, instead of dying silently mid-suite
+    import signal
+
+    def _on_term(signum, frame):
+        try:
+            extras.setdefault(
+                "killed", f"signal {signum} mid-suite; partial results")
+            # stale/last_measured_* is the WEDGED-tunnel contract only:
+            # freshly measured numbers must never be stamped stale
+            if "error_backend" in extras:
+                _merge_banked_into(extras)
+            _emit(images_per_sec, extras)
+        finally:
+            # 128+signum: a killed partial run must not look like a
+            # clean one to drivers/shells checking the exit status
+            os._exit(128 + signum)
+
+    for sig in (signal.SIGTERM, signal.SIGINT):
+        try:
+            signal.signal(sig, _on_term)
+        except (ValueError, OSError):
+            pass  # non-main thread / unsupported platform
 
     def want(name: str) -> bool:
         return not only or name in only.split(",")
@@ -1150,13 +1186,14 @@ def main():
         _serving_fallback(extras)
         _merge_banked_into(extras)
 
-    print(json.dumps({
-        "metric": "imagefeaturizer_resnet50_inference",
-        "value": round(images_per_sec, 1),
-        "unit": "images/sec/chip",
-        "vs_baseline": round(images_per_sec / A100_IMAGES_PER_SEC, 3),
-        "extras": extras,
-    }), flush=True)
+    # disarm before the final print: a signal landing between _emit and
+    # _exit would otherwise print a SECOND JSON line
+    for sig in (signal.SIGTERM, signal.SIGINT):
+        try:
+            signal.signal(sig, signal.SIG_DFL)
+        except (ValueError, OSError):
+            pass
+    _emit(images_per_sec, extras)
     # hard exit: a timed-out backend-acquisition thread is non-daemon and
     # would otherwise block interpreter shutdown after the line printed
     os._exit(0)
