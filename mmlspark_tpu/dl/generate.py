@@ -1,0 +1,115 @@
+"""Autoregressive generation for causal-LM models.
+
+Rounds out the text stack (BPE → causal pretraining → generation); the
+reference has no language-model surface at all (SURVEY §5 marks text as
+the framework's extension axis).
+
+TPU shape discipline: the ids buffer is a FIXED [B, max_len] array and
+the whole decode is one ``lax.scan`` under one ``jit`` — every step
+re-encodes the buffer through the causal encoder (prefill-style
+decode; the pad mask hides unwritten positions, and causality makes
+the logits at the last written position independent of the padding).
+O(steps · T²) attention: right for short generations and exact; a KV
+cache is the optimization, not a semantic change.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+def _make_run(module, max_new_tokens: int, temperature: float,
+              pad_id: int):
+    """One jitted decode program per (module, decode config) — weights
+    and buffers are traced arguments, so repeated generate() calls with
+    the same shapes hit the compile cache instead of retracing."""
+
+    @jax.jit
+    def run(params, buf, ptr, key):
+        B = buf.shape[0]
+
+        def step(carry, _):
+            buf, ptr, key = carry
+            logits = module.apply({"params": params}, buf)["logits"]
+            # logits at the LAST WRITTEN position predict the next token
+            last = jnp.take_along_axis(
+                logits, (ptr - 1)[:, None, None].astype(jnp.int32),
+                axis=1)[:, 0]                           # [B, V]
+            # never emit pad: it would terminate the row's mask early
+            last = last.at[:, pad_id].set(-jnp.inf)
+            key, sub = jax.random.split(key)
+            if temperature > 0:
+                nxt = jax.random.categorical(sub, last / temperature,
+                                             axis=-1)
+            else:
+                nxt = jnp.argmax(last, axis=-1)
+            nxt = nxt.astype(jnp.int32)
+            buf = buf.at[jnp.arange(B), ptr].set(nxt)
+            return (buf, ptr + 1, key), None
+
+        (buf, ptr, _), _ = jax.lax.scan(
+            step, (buf, ptr, key), None, length=max_new_tokens)
+        return buf
+
+    return run
+
+
+_RUN_CACHE: dict = {}
+
+
+def generate(module, variables, prompt_ids, *, max_new_tokens: int,
+             max_len: int | None = None, temperature: float = 0.0,
+             seed: int = 0, pad_id: int = 0):
+    """Generate continuations for a batch of prompts.
+
+    ``module`` must produce token logits (``MaskedLMModel`` — the same
+    trunk+head causal pretraining trains) and must run causal
+    attention — enforced by the same perturbation probe
+    ``pretrain_causal_lm`` uses (a bidirectional encoder would
+    condition on its own padding, silently).
+
+    ``prompt_ids``: [B, Tp] int32, RIGHT-padded with ``pad_id`` (a
+    left-padded or empty row raises — the write pointer is the non-pad
+    count). Returns [B, max_len] int32 — prompts, then generated
+    tokens, then pad. ``temperature`` 0 = greedy; > 0 = softmax
+    sampling."""
+    from .pretrain import assert_causal
+
+    prompt_ids = np.asarray(prompt_ids, np.int32)
+    B, Tp = prompt_ids.shape
+    max_len = max_len or (Tp + max_new_tokens)
+    if max_len < Tp + max_new_tokens:
+        raise ValueError(
+            f"max_len={max_len} cannot hold the prompt ({Tp}) plus "
+            f"{max_new_tokens} new tokens")
+    # per-row write pointer = non-pad count — only correct for strictly
+    # right-padded prompts, so validate instead of silently scrambling
+    ptr = (prompt_ids != pad_id).sum(axis=1).astype(np.int32)
+    if (ptr == 0).any():
+        raise ValueError("empty (all-pad) prompt row")
+    trailing_ok = np.all(
+        (np.arange(Tp)[None, :] < ptr[:, None])
+        == (prompt_ids != pad_id))
+    if not trailing_ok:
+        raise ValueError(
+            f"prompts must be RIGHT-padded with pad_id={pad_id} "
+            "(found a pad before a real token)")
+    vocab = getattr(getattr(module, "encoder", None), "vocab",
+                    int(prompt_ids.max()) + 2)
+    assert_causal(module, {"params": variables["params"]},
+                  prompt_ids[:1, :max(int(ptr[0]), 2)], vocab)
+
+    buf = np.full((B, max_len), pad_id, np.int32)
+    buf[:, :Tp] = prompt_ids
+    # keyed on the module OBJECT (hashable frozen dataclass): an id()
+    # key could collide after garbage collection and silently serve a
+    # different model's compiled program
+    key = (module, max_new_tokens, float(temperature), pad_id)
+    run = _RUN_CACHE.get(key)
+    if run is None:
+        run = _RUN_CACHE[key] = _make_run(module, max_new_tokens,
+                                          temperature, pad_id)
+    return np.asarray(run(variables["params"], jnp.asarray(buf),
+                          jnp.asarray(ptr), jax.random.PRNGKey(seed)))

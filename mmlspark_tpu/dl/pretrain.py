@@ -55,6 +55,28 @@ def masked_xent(logits, labels):
     return -(ll * valid).sum() / jnp.maximum(valid.sum(), 1)
 
 
+def assert_causal(module, variables, sample_ids: np.ndarray,
+                  vocab: int) -> None:
+    """Causality probe: perturb the LAST position of ``sample_ids``
+    [1, T]; logits at earlier positions must not move. Catches a
+    bidirectional encoder passed where causality is required
+    (pretraining, generation) — the failure mode is silent
+    otherwise."""
+    probe = np.asarray(sample_ids, np.int32)[:1].copy()
+    if probe.shape[1] < 2:
+        return
+    base = module.apply(variables, jnp.asarray(probe))["logits"]
+    probe2 = probe.copy()
+    probe2[0, -1] = (probe2[0, -1] % (vocab - 2)) + 1
+    alt = module.apply(variables, jnp.asarray(probe2))["logits"]
+    drift = float(jnp.abs(base[0, :-1] - alt[0, :-1]).max())
+    if drift > 1e-4:
+        raise ValueError(
+            "encoder attends to FUTURE positions (logit drift "
+            f"{drift:.2e} after perturbing the last token) — build it "
+            "with make_attention_fn(..., causal=True)")
+
+
 def mask_batch(ids: np.ndarray, rng: np.random.Generator, *,
                mask_id: int, mask_frac: float = 0.15,
                pad_id: int = 0) -> tuple[np.ndarray, np.ndarray]:
@@ -134,24 +156,8 @@ def pretrain_causal_lm(encoder: TextEncoder, ids: np.ndarray, *,
     tx = tx or optax.adamw(learning_rate)
     state = init_train_state(module, jax.random.PRNGKey(seed), ids[:1],
                              tx)
-    # causality probe: perturb the LAST position, logits at earlier
-    # positions must not move (catches a bidirectional encoder passed
-    # by mistake — the failure mode is silent otherwise)
-    probe = ids[:1].copy()
-    if probe.shape[1] >= 2:
-        base = module.apply(
-            {"params": state.params}, jnp.asarray(probe))["logits"]
-        probe2 = probe.copy()
-        probe2[0, -1] = (probe2[0, -1] % (encoder.vocab - 2)) + 1
-        alt = module.apply(
-            {"params": state.params}, jnp.asarray(probe2))["logits"]
-        drift = float(jnp.abs(base[0, :-1] - alt[0, :-1]).max())
-        if drift > 1e-4:
-            raise ValueError(
-                "encoder attends to FUTURE positions (logit drift "
-                f"{drift:.2e} after perturbing the last token) — build "
-                "it with make_attention_fn(..., causal=True) for "
-                "causal-LM pretraining")
+    assert_causal(module, {"params": state.params}, ids[:1],
+                  encoder.vocab)
     rng = np.random.default_rng(seed)
 
     def batches():

@@ -62,6 +62,78 @@ class TestCausalAttentionFn:
         assert float(jnp.abs(b1[0, :-1] - b2[0, :-1]).max()) > 1e-4
 
 
+@pytest.fixture(scope="module")
+def trained_lm():
+    """One 250-step causal pretraining shared by every generation
+    test (it dominates this file's wall-clock)."""
+    from mmlspark_tpu.dl import MaskedLMModel
+    state, _ = pretrain_causal_lm(
+        _encoder(causal=True), _ids(), steps=250, batch_size=32,
+        learning_rate=5e-3, seed=0)
+    return MaskedLMModel(_encoder(causal=True)), \
+        {"params": state.params}
+
+
+class TestGeneration:
+    """generate(): fixed-shape single-jit decode over the causal LM."""
+
+    def test_greedy_recovers_learned_structure(self, trained_lm):
+        """The training data alternates a -> (a + vocab//2 - 2): a
+        trained CLM generating greedily from even-position prompts must
+        reproduce that deterministic mapping most of the time."""
+        from mmlspark_tpu.dl import generate
+        module, variables = trained_lm
+        rng = np.random.default_rng(5)
+        a = rng.integers(2, 32, size=(8, 3))
+        prompts = np.empty((8, 5), np.int32)
+        prompts[:, 0::2] = a
+        prompts[:, 1::2] = a[:, :2] + 30  # vocab//2 - 2 = 30
+        out = generate(module, variables, prompts, max_new_tokens=1)
+        assert out.shape == (8, 6)
+        # prompt preserved verbatim
+        np.testing.assert_array_equal(out[:, :5], prompts)
+        hit = float(np.mean(out[:, 5] == prompts[:, 4] + 30))
+        assert hit >= 0.7, hit
+
+    def test_sampling_and_shapes(self, trained_lm):
+        from mmlspark_tpu.dl import generate
+        module, variables = trained_lm
+        prompts = np.asarray([[5, 35, 7, 0, 0],
+                              [9, 39, 11, 41, 13]], np.int32)
+        out = generate(module, variables, prompts, max_new_tokens=4,
+                       max_len=12, temperature=1.0, seed=3)
+        assert out.shape == (2, 12)
+        # row 0's prompt has 3 real tokens: new tokens land at 3..6
+        assert (out[0, 3:7] != 0).all()
+        assert (out[0, 7:] == 0).all()
+        # pad is never emitted
+        assert (out[1, :9] != 0).all()
+        with pytest.raises(ValueError, match="cannot hold"):
+            generate(module, variables, prompts, max_new_tokens=10,
+                     max_len=8)
+
+    def test_rejects_bad_prompts_and_bidirectional(self, trained_lm):
+        from mmlspark_tpu.dl import MaskedLMModel, generate
+        module, variables = trained_lm
+        # left padding silently scrambled output before the guard
+        with pytest.raises(ValueError, match="RIGHT-padded"):
+            generate(module, variables,
+                     np.asarray([[0, 5, 35]], np.int32),
+                     max_new_tokens=1)
+        with pytest.raises(ValueError, match="all-pad"):
+            generate(module, variables,
+                     np.asarray([[0, 0, 0]], np.int32),
+                     max_new_tokens=1)
+        # a bidirectional model is rejected by the causality probe
+        bidir = MaskedLMModel(_encoder(causal=False))
+        bidir_vars = {"params": bidir.init(
+            jax.random.PRNGKey(0), jnp.ones((1, 4), jnp.int32))["params"]}
+        with pytest.raises(ValueError, match="FUTURE positions"):
+            generate(bidir, bidir_vars,
+                     np.asarray([[5, 35, 7]], np.int32),
+                     max_new_tokens=1)
+
+
 class TestCausalLMPretrain:
     def test_rejects_bidirectional_encoder(self):
         with pytest.raises(ValueError, match="FUTURE positions"):
