@@ -5,12 +5,12 @@ reference has no language-model surface at all (SURVEY §5 marks text as
 the framework's extension axis).
 
 TPU shape discipline: the ids buffer is a FIXED [B, max_len] array and
-the whole decode is one ``lax.scan`` under one ``jit`` — every step
-re-encodes the buffer through the causal encoder (prefill-style
-decode; the pad mask hides unwritten positions, and causality makes
-the logits at the last written position independent of the padding).
-O(steps · T²) attention: right for short generations and exact; a KV
-cache is the optimization, not a semantic change.
+the whole decode is one ``lax.scan`` under one ``jit``. The default
+path keeps per-block KV caches — prefill and decode unify into one
+scan where each step embeds one token and attends over the cache
+(O(L²·W) total). ``use_cache=False`` re-encodes the buffer every step
+through the encoder's own attention_fn (O(steps·L²·W)) — the reference
+the cached path is equivalence-tested against.
 """
 
 from __future__ import annotations
@@ -18,6 +18,18 @@ from __future__ import annotations
 import jax
 import jax.numpy as jnp
 import numpy as np
+
+
+def _sample(logits, key, temperature: float, pad_id: int):
+    """Shared sampling epilogue — ONE copy so the cached and re-encode
+    paths cannot drift. Never emits pad (it would terminate the row's
+    mask early)."""
+    logits = logits.at[:, pad_id].set(-jnp.inf)
+    if temperature > 0:
+        nxt = jax.random.categorical(key, logits / temperature, axis=-1)
+    else:
+        nxt = jnp.argmax(logits, axis=-1)
+    return nxt.astype(jnp.int32)
 
 
 def _make_run(module, max_new_tokens: int, temperature: float,
@@ -30,27 +42,72 @@ def _make_run(module, max_new_tokens: int, temperature: float,
     def run(params, buf, ptr, key):
         B = buf.shape[0]
 
-        def step(carry, _):
-            buf, ptr, key = carry
+        def step(carry, i):
+            buf, ptr = carry
             logits = module.apply({"params": params}, buf)["logits"]
             # logits at the LAST WRITTEN position predict the next token
             last = jnp.take_along_axis(
                 logits, (ptr - 1)[:, None, None].astype(jnp.int32),
                 axis=1)[:, 0]                           # [B, V]
-            # never emit pad: it would terminate the row's mask early
-            last = last.at[:, pad_id].set(-jnp.inf)
-            key, sub = jax.random.split(key)
-            if temperature > 0:
-                nxt = jax.random.categorical(sub, last / temperature,
-                                             axis=-1)
-            else:
-                nxt = jnp.argmax(last, axis=-1)
-            nxt = nxt.astype(jnp.int32)
+            # per-step key by fold_in (not a split chain): deterministic
+            # given (seed, step index) alone
+            nxt = _sample(last, jax.random.fold_in(key, i), temperature,
+                          pad_id)
             buf = buf.at[jnp.arange(B), ptr].set(nxt)
-            return (buf, ptr + 1, key), None
+            return (buf, ptr + 1), None
 
-        (buf, ptr, _), _ = jax.lax.scan(
-            step, (buf, ptr, key), None, length=max_new_tokens)
+        (buf, ptr), _ = jax.lax.scan(
+            step, (buf, ptr), jnp.arange(max_new_tokens))
+        return buf
+
+    return run
+
+
+def _make_cached_run(module, max_new_tokens: int, temperature: float,
+                     pad_id: int, scan_len: int):
+    """KV-cached decode: ONE scan over every buffer position — each
+    step embeds one token, attends over per-block caches (O(L·W) per
+    step instead of a full O(L²·W) re-encode), and writes the sampled
+    token when the position falls inside a row's generation window.
+    Prefill and decode unify: prompt positions stream through the same
+    step, filling the caches."""
+
+    @jax.jit
+    def run(params, buf, ptr, key):
+        B, L = buf.shape
+        enc = module.encoder
+        hd = enc.width // enc.heads
+        caches = tuple(
+            (jnp.zeros((B, enc.heads, L, hd), enc.dtype),
+             jnp.zeros((B, enc.heads, L, hd), enc.dtype))
+            for _ in range(enc.depth))
+
+        def step(carry, pos):
+            buf, caches = carry
+            tok = jax.lax.dynamic_slice_in_dim(buf, pos, 1,
+                                               axis=1)[:, 0]
+            logits, caches = module.apply(
+                {"params": params}, tok, caches, pos,
+                method="decode_step")                   # [B, V]
+            # per-POSITION fold_in: for ragged batches the same written
+            # token index lands at different positions per row, so the
+            # temperature>0 stream is path-specific (greedy is the
+            # cached-vs-reencode equivalence contract)
+            nxt = _sample(logits, jax.random.fold_in(key, pos),
+                          temperature, pad_id)
+            # write at pos+1 only inside this row's generation window;
+            # prompt positions keep their tokens, the rest stays pad
+            write = (pos + 1 >= ptr) & (pos + 1 < ptr + max_new_tokens)
+            cur = jax.lax.dynamic_slice_in_dim(buf, pos + 1, 1,
+                                               axis=1)[:, 0]
+            buf = jax.lax.dynamic_update_slice(
+                buf, jnp.where(write, nxt, cur)[:, None], (0, pos + 1))
+            return (buf, caches), None
+
+        # scan only positions that can still write (the buffer tail past
+        # every row's window would burn full decode steps for nothing)
+        (buf, _), _ = jax.lax.scan(step, (buf, caches),
+                                   jnp.arange(min(scan_len, L - 1)))
         return buf
 
     return run
@@ -61,7 +118,7 @@ _RUN_CACHE: dict = {}
 
 def generate(module, variables, prompt_ids, *, max_new_tokens: int,
              max_len: int | None = None, temperature: float = 0.0,
-             seed: int = 0, pad_id: int = 0):
+             seed: int = 0, pad_id: int = 0, use_cache: bool = True):
     """Generate continuations for a batch of prompts.
 
     ``module`` must produce token logits (``MaskedLMModel`` — the same
@@ -74,7 +131,13 @@ def generate(module, variables, prompt_ids, *, max_new_tokens: int,
     left-padded or empty row raises — the write pointer is the non-pad
     count). Returns [B, max_len] int32 — prompts, then generated
     tokens, then pad. ``temperature`` 0 = greedy; > 0 = softmax
-    sampling."""
+    sampling.
+
+    ``use_cache`` (default): KV-cached decode — O(L²·W) total via one
+    scan with per-block caches. ``use_cache=False`` re-encodes the
+    whole buffer every step (O(steps·L²·W)) through the encoder's own
+    attention_fn — the reference path the cached one is tested
+    against."""
     from .pretrain import assert_causal
 
     prompt_ids = np.asarray(prompt_ids, np.int32)
@@ -106,10 +169,16 @@ def generate(module, variables, prompt_ids, *, max_new_tokens: int,
     # keyed on the module OBJECT (hashable frozen dataclass): an id()
     # key could collide after garbage collection and silently serve a
     # different model's compiled program
-    key = (module, max_new_tokens, float(temperature), pad_id)
+    scan_len = Tp + max_new_tokens - 1  # last useful write position
+    key = (module, max_new_tokens, float(temperature), pad_id,
+           bool(use_cache), scan_len if use_cache else None)
     run = _RUN_CACHE.get(key)
     if run is None:
-        run = _RUN_CACHE[key] = _make_run(module, max_new_tokens,
-                                          temperature, pad_id)
+        if use_cache:
+            run = _make_cached_run(module, max_new_tokens, temperature,
+                                   pad_id, scan_len)
+        else:
+            run = _make_run(module, max_new_tokens, temperature, pad_id)
+        _RUN_CACHE[key] = run
     return np.asarray(run(variables["params"], jnp.asarray(buf),
                           jnp.asarray(ptr), jax.random.PRNGKey(seed)))

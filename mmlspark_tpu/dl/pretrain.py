@@ -44,6 +44,16 @@ class MaskedLMModel(nn.Module):
         out = self.encoder(ids, train)
         return {"logits": self.lm_head(out["tokens"]), **out}
 
+    def decode_step(self, tok, caches, pos):
+        """One cached autoregressive step: [B] token ids at (traced)
+        position ``pos`` → ([B, V] logits, updated per-block KV
+        caches). Same params/math as the full forward restricted to the
+        causal row (``dl.generate`` uses this; equivalence pinned by
+        test)."""
+        x = self.encoder.embed_token(tok, pos)
+        x, caches = self.encoder.decode_blocks(x, caches, pos)
+        return self.lm_head(x)[:, 0], caches
+
 
 def masked_xent(logits, labels):
     """Cross-entropy over positions with ``labels >= 0`` (−1 = ignore:

@@ -114,6 +114,41 @@ class EncoderBlock(nn.Module):
     def __call__(self, x, key_mask=None):
         return self.ffn(self.attend(x, key_mask))
 
+    def decode_step(self, x_tok, k_cache, v_cache, pos):
+        """One autoregressive decode step through this block.
+
+        ``x_tok`` [B, 1, W] is the current position's activation;
+        ``k_cache``/``v_cache`` [B, H, L, hd] hold every previous
+        position's projections; ``pos`` (traced scalar) is the current
+        write index. Returns ``(y [B, 1, W], k_cache, v_cache)`` with
+        this position's k/v written. Same params, same math as the full
+        forward — attention reduces over cache entries ≤ pos (equal to
+        the causal row), so cached decode is equivalent to re-encoding
+        the whole prefix (pinned by test)."""
+        W = self.width
+        hd = W // self.heads
+        B = x_tok.shape[0]
+        h = self.ln_1(x_tok).astype(self.dtype)
+        qkv = self.qkv_proj(h)                       # [B, 1, 3W]
+        q, k, v = jnp.split(qkv, 3, axis=-1)
+
+        def split(a):                                # [B, H, 1, hd]
+            return a.reshape(B, 1, self.heads, hd).transpose(0, 2, 1, 3)
+
+        q, k, v = split(q), split(k), split(v)
+        k_cache = jax.lax.dynamic_update_slice(
+            k_cache, k.astype(k_cache.dtype), (0, 0, pos, 0))
+        v_cache = jax.lax.dynamic_update_slice(
+            v_cache, v.astype(v_cache.dtype), (0, 0, pos, 0))
+        L = k_cache.shape[2]
+        # ONE attention implementation: the dense path with the causal
+        # row as its key mask (keeps scale/dtype/masking in one place)
+        valid = jnp.broadcast_to((jnp.arange(L) <= pos)[None], (B, L))
+        o = _dense_attention(q, k_cache, v_cache, key_mask=valid)
+        o = o.transpose(0, 2, 1, 3).reshape(B, 1, W).astype(self.dtype)
+        x = x_tok + self.out_proj(o)
+        return self.ffn(x), k_cache, v_cache
+
 
 class TextEncoder(nn.Module):
     """Token ids [N, T] → ``{"tokens": [N, T, W], "pooled": [N, W]}``.
@@ -157,6 +192,27 @@ class TextEncoder(nn.Module):
         ang = pos / (10000.0 ** (2 * dim / self.width))
         pe = jnp.concatenate([jnp.sin(ang), jnp.cos(ang)], axis=-1)
         return x + pe[None].astype(self.dtype)
+
+    def embed_token(self, tok, pos):
+        """Single-position prologue for cached decoding: embed [B]
+        token ids + the sinusoidal position encoding at (traced) scalar
+        ``pos`` → [B, 1, W]. Same constants as ``embed_ids``."""
+        x = self.embed_layer(tok[:, None])           # [B, 1, W]
+        dim = jnp.arange(self.width // 2)
+        ang = pos.astype(jnp.float32) / (10000.0
+                                         ** (2 * dim / self.width))
+        pe = jnp.concatenate([jnp.sin(ang), jnp.cos(ang)])
+        return x + pe[None, None].astype(self.dtype)
+
+    def decode_blocks(self, x_tok, caches, pos):
+        """Run one position through every block with KV caches.
+        ``caches``: tuple of (k, v) per block. Returns (final-LN'd
+        [B, 1, W] activation, updated caches)."""
+        new_caches = []
+        for block, (kc, vc) in zip(self.blocks, caches):
+            x_tok, kc, vc = block.decode_step(x_tok, kc, vc, pos)
+            new_caches.append((kc, vc))
+        return self.final_ln(x_tok), tuple(new_caches)
 
     def finalize(self, x, ids):
         """Final LN + masked mean pool over non-pad tokens."""
