@@ -550,8 +550,13 @@ class BpeTokenizerModel(Model, HasInputCol, HasOutputCol):
             return cached[2], cached[3]
         ranks = {(a, b): r for r, (a, b) in enumerate(merges)}
         ids = {t: i + 2 for i, t in enumerate(vocab)}
-        self._bpe_cache = (merges, vocab, ranks, ids)
+        self._bpe_cache = (merges, vocab, ranks, ids,
+                           {i: t for t, i in ids.items()})
         return ranks, ids
+
+    def _id_to_tok(self) -> dict:
+        self._tables()
+        return self._bpe_cache[4]
 
     def encode_word(self, word: str) -> list[str]:
         ranks, _ = self._tables()
@@ -587,3 +592,19 @@ class BpeTokenizerModel(Model, HasInputCol, HasOutputCol):
                     break
             out[i, :min(len(row), L)] = row[:L]
         return df.with_column(self.getOutputCol(), out)
+
+    def decode(self, ids_row) -> str:
+        """Token ids → text: the inverse the generation path needs
+        (``dl.generate`` emits id rows). Subword pieces concatenate;
+        the ``</w>`` end-of-word marker becomes a space; PAD (0) stops
+        the row and UNK (1) renders as ``�`` (the original
+        characters are unrecoverable — BPE ids are the whole
+        vocabulary)."""
+        id_to_tok = self._id_to_tok()  # cached with the other tables
+        pieces: list[str] = []
+        for tid in np.asarray(ids_row).tolist():
+            if tid == 0:
+                break
+            # UNK (1) is never a vocabulary key → the fallback renders it
+            pieces.append(id_to_tok.get(int(tid), "�"))
+        return "".join(pieces).replace("</w>", " ").strip()

@@ -254,6 +254,23 @@ class TestBpeTokenizer:
         oov = model.transform(DataFrame({"text": q}))["tokens"]
         assert (oov == 1).any()
 
+    def test_decode_inverts_encode(self):
+        """ids → text: whitespace-normalized round trip (the BPE
+        pre-tokenizer splits on \\W+, so punctuation/case fold away by
+        design; lowercase word streams reconstruct exactly)."""
+        from mmlspark_tpu.featurize import BpeTokenizer
+        model = BpeTokenizer(vocabSize=64, maxLength=32).fit(
+            self._corpus())
+        out = model.transform(self._corpus())["tokens"]
+        texts = [t.lower() for t in self._corpus()["text"]]
+        for row, text in zip(out, texts):
+            assert model.decode(row) == " ".join(
+                text.split())
+        # PAD stops decoding; UNK renders visibly
+        assert model.decode([0, 5, 6]) == ""
+        got = model.decode([1, 0])
+        assert "�" in got
+
     def test_feeds_text_encoder_and_roundtrips(self, tmp_path):
         from mmlspark_tpu.dl import TextEncoderFeaturizer
         from mmlspark_tpu.featurize import BpeTokenizer
