@@ -19,6 +19,11 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from ..core.contracts import HasInputCol, HasOutputCol
+from ..core.param import (ComplexParam, Param, StageParam,
+                          TypeConverters as TC)
+from ..core.pipeline import Transformer
+
 
 def _sample(logits, key, temperature: float, pad_id: int):
     """Shared sampling epilogue — ONE copy so the cached and re-encode
@@ -182,3 +187,56 @@ def generate(module, variables, prompt_ids, *, max_new_tokens: int,
         _RUN_CACHE[key] = run
     return np.asarray(run(variables["params"], jnp.asarray(buf),
                           jnp.asarray(ptr), jax.random.PRNGKey(seed)))
+
+
+class TextGenerator(Transformer, HasInputCol, HasOutputCol):
+    """Pipeline stage: text prompts → generated continuations.
+
+    Composes the whole decoder stack at the framework's core
+    abstraction: a fitted ``BpeTokenizerModel`` encodes prompts to id
+    rows, :func:`generate` decodes with the causal LM (KV-cached), and
+    the tokenizer's ``decode`` renders continuations back to text. No
+    reference counterpart (SURVEY §5: text/long-context is the
+    framework's extension axis)."""
+
+    # StageParam: fitted stages round-trip through their OWN save/load
+    # (raw pickling would bake BpeTokenizerModel's internal caches and
+    # attribute layout into the artifact)
+    tokenizer = StageParam("tokenizer", "fitted BpeTokenizerModel")
+    lm = ComplexParam("lm", "(module, variables): a causal MaskedLMModel "
+                      "and its trained variables")
+    maxNewTokens = Param("maxNewTokens", "tokens to generate per row",
+                         TC.toInt, default=16, has_default=True)
+    temperature = Param("temperature", "0 = greedy; > 0 = sampling",
+                        TC.toFloat, default=0.0, has_default=True)
+    seed = Param("seed", "sampling seed", TC.toInt, default=0,
+                 has_default=True)
+
+    def __init__(self, **kwargs):
+        super().__init__(**kwargs)
+        self._setDefault(inputCol="text", outputCol="generated")
+
+    def _transform(self, df):
+        tok = self.get("tokenizer")
+        module, variables = self.get("lm")
+        if len(df) == 0:  # nothing to decode (and generate() reduces
+            return df.with_column(self.getOutputCol(),
+                                  np.empty(0, object))
+        ids = tok.transform(
+            df.with_column(tok.getInputCol(),
+                           df[self.getInputCol()]))[tok.getOutputCol()]
+        ids = np.asarray(ids, np.int32)
+        # generate() requires non-empty rows; give blank prompts UNK
+        ptr = (ids != 0).sum(axis=1)
+        ids[ptr == 0, 0] = 1
+        ptr = np.maximum(ptr, 1)
+        n_new = self.get("maxNewTokens")
+        out = generate(module, variables, ids, max_new_tokens=n_new,
+                       temperature=self.get("temperature"),
+                       seed=self.get("seed"))
+        # each row's continuation starts at ITS prompt length (ragged
+        # prompts generate before Tp), never contains pad
+        texts = np.empty(len(out), object)
+        texts[:] = [tok.decode(row[p:p + n_new])
+                    for row, p in zip(out, ptr)]
+        return df.with_column(self.getOutputCol(), texts)

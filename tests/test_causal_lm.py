@@ -153,6 +153,37 @@ class TestGeneration:
                      max_new_tokens=1)
 
 
+class TestTextGeneratorStage:
+    def test_strings_in_strings_out(self, trained_lm):
+        """The pipeline-level wrapper: prompts → BPE ids → cached
+        decode → continuations decoded back to text."""
+        from mmlspark_tpu.core import DataFrame
+        from mmlspark_tpu.dl import TextGenerator
+        from mmlspark_tpu.featurize import BpeTokenizer
+
+        module, variables = trained_lm
+        corpus = np.empty(4, object)
+        corpus[:] = ["abc abd", "bcd bce", "abc bcd", "abd bce"]
+        tok = BpeTokenizer(vocabSize=64, maxLength=8,
+                           inputCol="text",
+                           outputCol="tokens").fit(
+            DataFrame({"text": corpus}))
+        stage = TextGenerator(tokenizer=tok, lm=(module, variables),
+                              maxNewTokens=3, inputCol="text",
+                              outputCol="generated")
+        prompts = np.empty(2, object)
+        prompts[:] = ["abc", ""]  # incl. an empty prompt (UNK-seeded)
+        out = stage.transform(DataFrame({"text": prompts}))
+        gen = list(out["generated"])
+        assert len(gen) == 2
+        assert all(isinstance(g, str) for g in gen)
+        assert all(len(g) > 0 for g in gen)  # pad never generated
+        # zero-row input passes through with an empty output column
+        none_df = stage.transform(
+            DataFrame({"text": np.empty(0, object)}))
+        assert len(none_df["generated"]) == 0
+
+
 class TestCausalLMPretrain:
     def test_rejects_bidirectional_encoder(self):
         with pytest.raises(ValueError, match="FUTURE positions"):

@@ -247,6 +247,7 @@ _EXCLUDED = {
     "CustomOutputParser",
     # need a function/model/stage argument; fuzzed via dedicated tests
     "UDFTransformer", "Lambda", "TPUModel", "ImageFeaturizer",
+    "TextGenerator",
     "TrainClassifier", "TrainRegressor", "TrainedClassifierModel",
     "TrainedRegressorModel", "TuneHyperparameters", "FindBestModel",
     "TabularLIME", "ImageLIME", "TextLIME",
