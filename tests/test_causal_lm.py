@@ -183,6 +183,28 @@ class TestTextGeneratorStage:
             DataFrame({"text": np.empty(0, object)}))
         assert len(none_df["generated"]) == 0
 
+    def test_stage_persists(self, trained_lm, tmp_path):
+        """save/load round trip: the tokenizer rides its own
+        StageParam save path, the LM pickles, outputs match."""
+        from mmlspark_tpu.core import DataFrame, load_stage
+        from mmlspark_tpu.dl import TextGenerator
+        from mmlspark_tpu.featurize import BpeTokenizer
+
+        module, variables = trained_lm
+        corpus = np.empty(2, object)
+        corpus[:] = ["abc abd", "bcd bce"]
+        tok = BpeTokenizer(vocabSize=64, maxLength=8, inputCol="text",
+                           outputCol="tokens").fit(
+            DataFrame({"text": corpus}))
+        stage = TextGenerator(tokenizer=tok, lm=(module, variables),
+                              maxNewTokens=2)
+        df = DataFrame({"text": corpus})
+        before = list(stage.transform(df)["generated"])
+        stage.save(str(tmp_path / "gen"))
+        re_stage = load_stage(str(tmp_path / "gen"))
+        after = list(re_stage.transform(df)["generated"])
+        assert after == before
+
 
 class TestCausalLMPretrain:
     def test_rejects_bidirectional_encoder(self):
