@@ -57,7 +57,11 @@ def _load_banked() -> dict:
         return {}
 
 
-_BANK_SKIP = {"platform", "contended", "load_avg_start", "stale"}
+_BANK_SKIP = {"platform", "contended", "load_avg_start", "stale",
+              # config knobs, not measurements — they must not resurface
+              # as last_measured_* on wedged runs
+              "train_remat", "serving_concurrency",
+              "featurizer_e2e_u8_pipeline_depth"}
 
 
 def _bank(extras: dict, headline: float, platform: str | None) -> None:
@@ -76,8 +80,12 @@ def _bank(extras: dict, headline: float, platform: str | None) -> None:
     now = time.strftime("%Y-%m-%dT%H:%M:%SZ", time.gmtime())
     contended = bool(extras.get("contended"))
     for k, v in extras.items():
+        # measurements (and provenance strings like encoder_best_impl)
+        # only: marker keys (*_skipped), bools and config knobs must not
+        # resurface as last_measured_* later
         if k.startswith("error") or k in _BANK_SKIP or \
-                not isinstance(v, (int, float, dict, str, bool)):
+                k.endswith("_skipped") or isinstance(v, bool) or \
+                not isinstance(v, (int, float, dict, str)):
             continue
         prev = banked.get(k)
         if prev is not None and prev.get("value") == v:
@@ -1096,8 +1104,10 @@ def main():
 
     def _on_term(signum, frame):
         try:
+            # "error_" prefix so the tunnel watcher's error grep treats
+            # a killed partial run as incomplete and keeps retrying
             extras.setdefault(
-                "killed", f"signal {signum} mid-suite; partial results")
+                "error_killed", f"signal {signum} mid-suite; partial results")
             # stale/last_measured_* is the WEDGED-tunnel contract only:
             # freshly measured numbers must never be stamped stale
             if "error_backend" in extras:

@@ -26,8 +26,11 @@ while true; do
     # only stop on a non-empty result with NO error keys at all — a
     # mid-suite wedge records error_gbdt/error_ranker (not
     # error_backend) and must keep the retry loop alive
+    # also reject '"killed' explicitly: an outer `timeout` SIGTERM makes
+    # bench.py emit a valid partial JSON (error_killed now, bare
+    # "killed" in older builds) that must not count as a banked suite
     if [ -s "$OUT_DIR/bench_recovered.json" ] && \
-       ! grep -q '"error' "$OUT_DIR/bench_recovered.json"; then
+       ! grep -q '"error\|"killed' "$OUT_DIR/bench_recovered.json"; then
       echo "$(date -u +%FT%TZ) banked" >>"$OUT_DIR/probe.log"
       break
     fi

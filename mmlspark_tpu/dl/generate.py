@@ -15,6 +15,9 @@ the cached path is equivalence-tested against.
 
 from __future__ import annotations
 
+import threading
+from collections import OrderedDict
+
 import jax
 import jax.numpy as jnp
 import numpy as np
@@ -118,7 +121,18 @@ def _make_cached_run(module, max_new_tokens: int, temperature: float,
     return run
 
 
-_RUN_CACHE: dict = {}
+# bounded LRU: each entry pins its flax module AND its jitted decode
+# program for as long as it stays hot — an unbounded dict would leak
+# compiled programs in long-lived serving processes that cycle models
+_RUN_CACHE: OrderedDict = OrderedDict()
+_RUN_CACHE_MAX = 8
+# modules whose causality probe already passed — the property is fixed
+# per module architecture, so re-probing every generate() call would
+# cost two eager encoder forwards per request on the serving path
+_CAUSAL_OK: OrderedDict = OrderedDict()
+# one lock for both caches: concurrent serving threads cycling > MAX
+# models would otherwise race get/move_to_end against popitem eviction
+_CACHE_LOCK = threading.Lock()
 
 
 def generate(module, variables, prompt_ids, *, max_new_tokens: int,
@@ -164,10 +178,23 @@ def generate(module, variables, prompt_ids, *, max_new_tokens: int,
         raise ValueError(
             f"prompts must be RIGHT-padded with pad_id={pad_id} "
             "(found a pad before a real token)")
-    vocab = getattr(getattr(module, "encoder", None), "vocab",
-                    int(prompt_ids.max()) + 2)
-    assert_causal(module, {"params": variables["params"]},
-                  prompt_ids[:1, :max(int(ptr[0]), 2)], vocab)
+    with _CACHE_LOCK:
+        causal_ok = module in _CAUSAL_OK
+    if not causal_ok:
+        vocab = getattr(getattr(module, "encoder", None), "vocab",
+                        int(prompt_ids.max()) + 2)
+        probe = prompt_ids[:1, :max(int(ptr[0]), 2)]
+        if probe.shape[1] < 2:
+            # a single-token prompt would make the probe a silent no-op
+            # — duplicate the token so the check always actually runs
+            # before the module is marked causally OK
+            probe = np.repeat(probe, 2, axis=1)
+        assert_causal(module, {"params": variables["params"]}, probe,
+                      vocab)
+        with _CACHE_LOCK:
+            _CAUSAL_OK[module] = True
+            while len(_CAUSAL_OK) > _RUN_CACHE_MAX:
+                _CAUSAL_OK.popitem(last=False)
 
     buf = np.full((B, max_len), pad_id, np.int32)
     buf[:, :Tp] = prompt_ids
@@ -177,14 +204,20 @@ def generate(module, variables, prompt_ids, *, max_new_tokens: int,
     scan_len = Tp + max_new_tokens - 1  # last useful write position
     key = (module, max_new_tokens, float(temperature), pad_id,
            bool(use_cache), scan_len if use_cache else None)
-    run = _RUN_CACHE.get(key)
+    with _CACHE_LOCK:
+        run = _RUN_CACHE.get(key)
+        if run is not None:
+            _RUN_CACHE.move_to_end(key)
     if run is None:
         if use_cache:
             run = _make_cached_run(module, max_new_tokens, temperature,
                                    pad_id, scan_len)
         else:
             run = _make_run(module, max_new_tokens, temperature, pad_id)
-        _RUN_CACHE[key] = run
+        with _CACHE_LOCK:
+            _RUN_CACHE[key] = run
+            while len(_RUN_CACHE) > _RUN_CACHE_MAX:
+                _RUN_CACHE.popitem(last=False)
     return np.asarray(run(variables["params"], jnp.asarray(buf),
                           jnp.asarray(ptr), jax.random.PRNGKey(seed)))
 
