@@ -622,6 +622,83 @@ def _finalize_encoder(extras: dict, impls=_ENCODER_IMPLS) -> None:
     extras["encoder_best_impl"] = best
 
 
+def bench_gen(extras: dict) -> None:
+    """Autoregressive decode throughput over the causal LM: batched
+    prefill + KV-cached scan (``dl/generate.py``). Rows: prefill
+    tokens/sec (one causal forward seeding the caches — MXU-batched),
+    per-step decode latency/throughput, a batch sweep, and the
+    cached-vs-re-encode speedup the KV cache exists to buy. No
+    reference counterpart (text generation is the framework's
+    extension axis, SURVEY §5)."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from mmlspark_tpu.dl import MaskedLMModel, TextEncoder
+    from mmlspark_tpu.dl.generate import generate
+    from mmlspark_tpu.dl.text_encoder import make_attention_fn
+
+    rng = np.random.default_rng(0)
+    vocab, W, depth, mlp = 32768, 512, 8, 2048
+    enc = TextEncoder(vocab=vocab, width=W, depth=depth, heads=8,
+                      mlp_dim=mlp,
+                      attention_fn=make_attention_fn("dense",
+                                                     causal=True))
+    module = MaskedLMModel(enc)
+    # random weights: throughput does not depend on what the model
+    # learned, and init on the host CPU keeps the remote compiler out
+    # of weight initialization (same stance as bench_encoder)
+    with jax.default_device(jax.local_devices(backend="cpu")[0]):
+        variables = {"params": module.init(
+            jax.random.PRNGKey(0),
+            jnp.ones((1, 8), jnp.int32))["params"]}
+    variables = jax.device_put(variables, jax.devices()[0])
+
+    # 129 so the prefill bucket (multiples of 64) covers all but the
+    # last prompt position — the split below then measures a FULL
+    # batched prefill, not a half-streamed one
+    Tp, new = 129, 128
+
+    def timed(ids, n_new, use_cache=True, iters=3):
+        generate(module, variables, ids, max_new_tokens=n_new,
+                 use_cache=use_cache)           # compile + warm
+        t0 = time.perf_counter()
+        for _ in range(iters):
+            generate(module, variables, ids, max_new_tokens=n_new,
+                     use_cache=use_cache)
+        return (time.perf_counter() - t0) / iters
+
+    def prompts(B):
+        return rng.integers(2, vocab, size=(B, Tp)).astype(np.int32)
+
+    # prefill/decode split: new=1 is prefill + one scan step; the
+    # difference to new=1+N spreads over exactly N more scan steps
+    B = 32
+    ids = prompts(B)
+    t_one = timed(ids, 1)
+    t_full = timed(ids, new + 1)
+    per_step = (t_full - t_one) / new
+    t_prefill = max(t_one - per_step, 1e-9)
+    extras["gen_prefill_tokens_per_sec"] = round(B * Tp / t_prefill, 1)
+    extras["gen_decode_ms_per_step"] = round(per_step * 1000, 3)
+    extras["gen_decode_tokens_per_sec"] = round(B / per_step, 1)
+    extras["gen_tokens_per_sec"] = round(B * (new + 1) / t_full, 1)
+
+    by_batch = {}
+    for b in (1, 8, 32):
+        by_batch[str(b)] = round(
+            b * (new + 1) / timed(prompts(b), new + 1), 1)
+    extras["gen_tokens_per_sec_by_batch"] = by_batch
+
+    # what the KV cache buys: the re-encode reference recomputes the
+    # whole O(L²·W) forward every step — keep its shape small enough
+    # to finish, the ratio is the point
+    ids2 = prompts(8)[:, :32]
+    t_cached = timed(ids2, 32, use_cache=True)
+    t_re = timed(ids2, 32, use_cache=False)
+    extras["gen_cached_vs_reencode_speedup"] = round(t_re / t_cached, 2)
+
+
 def bench_gbdt(extras: dict) -> None:
     """LightGBM-equivalent training throughput, Higgs-shaped synthetic
     (28 features, the dataset of the reference's speed claim)."""
@@ -1187,6 +1264,8 @@ def main():
                           f"encoder_{impl}", 420.0)
             _finalize_encoder(extras, impls)
             _bank(extras, images_per_sec, _PLATFORM)  # encoder_* heads
+        if want("gen"):
+            _watchdog(bench_gen, extras, "gen", 420.0)
         if want("serving"):
             # includes a small GBDT fit for the real-model row
             _watchdog(bench_serving, extras, "serving", 360.0)

@@ -10,11 +10,11 @@ set -u
 # so a mid-run wedge still keeps everything measured up to that point
 ONLY="${MMLSPARK_TPU_WATCH_ONLY:-}"
 OUT_DIR="${MMLSPARK_TPU_WATCH_DIR:-/tmp/bench_watcher}"
-# must exceed bench.py's worst-case per-sub-bench watchdog sum (~4900s
-# for the full suite): the sub-bench watchdogs are the designed wedge
-# handling, and an outer kill before the final JSON print would leave
-# an empty result and loop forever
-RUN_TIMEOUT="${MMLSPARK_TPU_WATCH_TIMEOUT:-5400}"
+# must exceed bench.py's worst-case per-sub-bench watchdog sum (~5300s
+# for the full suite incl. the gen sub-bench): the sub-bench watchdogs
+# are the designed wedge handling, and an outer kill before the final
+# JSON print would leave an empty result and loop forever
+RUN_TIMEOUT="${MMLSPARK_TPU_WATCH_TIMEOUT:-6600}"
 mkdir -p "$OUT_DIR"
 cd "$(dirname "$0")/.."
 while true; do

@@ -72,13 +72,14 @@ def _make_run(module, max_new_tokens: int, temperature: float,
 
 
 def _make_cached_run(module, max_new_tokens: int, temperature: float,
-                     pad_id: int, scan_len: int):
-    """KV-cached decode: ONE scan over every buffer position — each
-    step embeds one token, attends over per-block caches (O(L·W) per
-    step instead of a full O(L²·W) re-encode), and writes the sampled
-    token when the position falls inside a row's generation window.
-    Prefill and decode unify: prompt positions stream through the same
-    step, filling the caches."""
+                     pad_id: int, scan_len: int, prefill_len: int):
+    """KV-cached decode: batched prefill + ONE scan over the writable
+    positions. The first ``prefill_len`` positions (statically
+    ``min(prompt_len) - 1`` — guaranteed real tokens in every row) seed
+    the per-block KV caches in one causal forward whose projections are
+    large MXU matmuls; the scan then starts at the first position whose
+    write can matter, each step embedding one token and attending over
+    the caches (O(L·W) per step instead of a full O(L²·W) re-encode)."""
 
     @jax.jit
     def run(params, buf, ptr, key):
@@ -89,6 +90,10 @@ def _make_cached_run(module, max_new_tokens: int, temperature: float,
             (jnp.zeros((B, enc.heads, L, hd), enc.dtype),
              jnp.zeros((B, enc.heads, L, hd), enc.dtype))
             for _ in range(enc.depth))
+        if prefill_len > 0:
+            caches = module.apply(
+                {"params": params}, buf[:, :prefill_len], caches,
+                method="prefill")
 
         def step(carry, pos):
             buf, caches = carry
@@ -112,10 +117,13 @@ def _make_cached_run(module, max_new_tokens: int, temperature: float,
                 buf, jnp.where(write, nxt, cur)[:, None], (0, pos + 1))
             return (buf, caches), None
 
-        # scan only positions that can still write (the buffer tail past
-        # every row's window would burn full decode steps for nothing)
-        (buf, _), _ = jax.lax.scan(step, (buf, caches),
-                                   jnp.arange(min(scan_len, L - 1)))
+        # scan only positions that can still write: start past the
+        # prefilled prefix, stop at the last useful write position (the
+        # buffer tail past every row's window would burn full decode
+        # steps for nothing)
+        (buf, _), _ = jax.lax.scan(
+            step, (buf, caches),
+            jnp.arange(prefill_len, min(scan_len, L - 1)))
         return buf
 
     return run
@@ -125,7 +133,7 @@ def _make_cached_run(module, max_new_tokens: int, temperature: float,
 # program for as long as it stays hot — an unbounded dict would leak
 # compiled programs in long-lived serving processes that cycle models
 _RUN_CACHE: OrderedDict = OrderedDict()
-_RUN_CACHE_MAX = 8
+_RUN_CACHE_MAX = 16
 # modules whose causality probe already passed — the property is fixed
 # per module architecture, so re-probing every generate() call would
 # cost two eager encoder forwards per request on the serving path
@@ -202,8 +210,22 @@ def generate(module, variables, prompt_ids, *, max_new_tokens: int,
     # key could collide after garbage collection and silently serve a
     # different model's compiled program
     scan_len = Tp + max_new_tokens - 1  # last useful write position
+    # batched-prefill length: positions [0, min(ptr) - 1) hold real
+    # tokens in EVERY row, so their caches can be seeded in one causal
+    # forward; the scan takes over at the first position whose write
+    # can matter. Static (ptr is host-side numpy), part of the key —
+    # bucketed DOWN to a power of two so ragged serving batches whose
+    # shortest prompt wobbles by a token share a compiled program
+    # (any prefix ≤ min(ptr)-1 is a valid prefill; the scan streams
+    # the remainder)
+    prefill_len = max(int(ptr.min()) - 1, 0)
+    if prefill_len >= 64:
+        prefill_len -= prefill_len % 64   # ≤ 63 steps streamed instead
+    elif prefill_len > 0:
+        prefill_len = 1 << (prefill_len.bit_length() - 1)
     key = (module, max_new_tokens, float(temperature), pad_id,
-           bool(use_cache), scan_len if use_cache else None)
+           bool(use_cache),
+           (scan_len, prefill_len) if use_cache else None)
     with _CACHE_LOCK:
         run = _RUN_CACHE.get(key)
         if run is not None:
@@ -211,7 +233,7 @@ def generate(module, variables, prompt_ids, *, max_new_tokens: int,
     if run is None:
         if use_cache:
             run = _make_cached_run(module, max_new_tokens, temperature,
-                                   pad_id, scan_len)
+                                   pad_id, scan_len, prefill_len)
         else:
             run = _make_run(module, max_new_tokens, temperature, pad_id)
         with _CACHE_LOCK:

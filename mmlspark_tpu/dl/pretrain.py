@@ -54,6 +54,13 @@ class MaskedLMModel(nn.Module):
         x, caches = self.encoder.decode_blocks(x, caches, pos)
         return self.lm_head(x)[:, 0], caches
 
+    def prefill(self, ids_prefix, caches):
+        """Batched prompt prefill: seed the KV caches for positions
+        ``[0, P)`` in one causal forward (``TextEncoder.prefill_caches``)
+        so ``dl.generate`` scans only from the first writable position
+        instead of streaming the whole prompt token-by-token."""
+        return self.encoder.prefill_caches(ids_prefix, caches)
+
 
 def masked_xent(logits, labels):
     """Cross-entropy over positions with ``labels >= 0`` (−1 = ignore:

@@ -83,10 +83,12 @@ class EncoderBlock(nn.Module):
                                name="mlp_1")
         self.mlp_out = nn.Dense(W, dtype=self.dtype, name="mlp_2")
 
-    def attend(self, x, key_mask=None):
-        """The attention residual: x + out_proj(attention(qkv(ln_1 x)))."""
-        W = self.width
-        hd = W // self.heads
+    def _project_qkv(self, x):
+        """ln_1 → fused qkv projection → per-head split: the ONE copy
+        of the pipeline ``attend``/``decode_step``/``prefill`` all run —
+        they must stay numerically in lockstep or cached decode drifts
+        from the re-encode reference. [B, T, W] → q, k, v [B, H, T, hd]."""
+        hd = self.width // self.heads
         h = self.ln_1(x).astype(self.dtype)
         qkv = self.qkv_proj(h)
         q, k, v = jnp.split(qkv, 3, axis=-1)
@@ -95,10 +97,18 @@ class EncoderBlock(nn.Module):
             B, T = a.shape[:2]
             return a.reshape(B, T, self.heads, hd).transpose(0, 2, 1, 3)
 
-        o = self.attention_fn(split(q), split(k), split(v), key_mask)
+        return split(q), split(k), split(v)
+
+    def _merge_out(self, o):
+        """Head merge + output projection ([B, H, T, hd] → [B, T, W])."""
         B, H, T, D = o.shape
-        o = o.transpose(0, 2, 1, 3).reshape(B, T, W).astype(self.dtype)
-        return x + self.out_proj(o)
+        o = o.transpose(0, 2, 1, 3).reshape(B, T, self.width)
+        return self.out_proj(o.astype(self.dtype))
+
+    def attend(self, x, key_mask=None):
+        """The attention residual: x + out_proj(attention(qkv(ln_1 x)))."""
+        q, k, v = self._project_qkv(x)
+        return x + self._merge_out(self.attention_fn(q, k, v, key_mask))
 
     def pre_ffn_norm(self, x):
         """ln_2 alone — the MoE variant normalizes before its experts."""
@@ -125,17 +135,8 @@ class EncoderBlock(nn.Module):
         forward — attention reduces over cache entries ≤ pos (equal to
         the causal row), so cached decode is equivalent to re-encoding
         the whole prefix (pinned by test)."""
-        W = self.width
-        hd = W // self.heads
         B = x_tok.shape[0]
-        h = self.ln_1(x_tok).astype(self.dtype)
-        qkv = self.qkv_proj(h)                       # [B, 1, 3W]
-        q, k, v = jnp.split(qkv, 3, axis=-1)
-
-        def split(a):                                # [B, H, 1, hd]
-            return a.reshape(B, 1, self.heads, hd).transpose(0, 2, 1, 3)
-
-        q, k, v = split(q), split(k), split(v)
+        q, k, v = self._project_qkv(x_tok)           # [B, H, 1, hd]
         k_cache = jax.lax.dynamic_update_slice(
             k_cache, k.astype(k_cache.dtype), (0, 0, pos, 0))
         v_cache = jax.lax.dynamic_update_slice(
@@ -145,9 +146,23 @@ class EncoderBlock(nn.Module):
         # row as its key mask (keeps scale/dtype/masking in one place)
         valid = jnp.broadcast_to((jnp.arange(L) <= pos)[None], (B, L))
         o = _dense_attention(q, k_cache, v_cache, key_mask=valid)
-        o = o.transpose(0, 2, 1, 3).reshape(B, 1, W).astype(self.dtype)
-        x = x_tok + self.out_proj(o)
+        x = x_tok + self._merge_out(o)
         return self.ffn(x), k_cache, v_cache
+
+    def prefill(self, x):
+        """Batched cache fill: the whole prompt prefix [B, P, W] in ONE
+        causal forward — the k/v the MXU computes as a single batched
+        matmul here are exactly what ``decode_step`` would have written
+        one position at a time (same projections, attention over keys
+        ≤ own position). Runs the block's OWN ``attention_fn`` (causal
+        for any LM that reaches decoding — ``dl.generate`` probes
+        this), so a flash/blockwise-configured model prefills at its
+        own O(T) memory profile instead of materializing dense scores.
+        Returns ``(y [B, P, W], k, v [B, H, P, hd])`` so the caller can
+        seed the decode caches."""
+        q, k, v = self._project_qkv(x)
+        o = self.attention_fn(q, k, v, None)
+        return self.ffn(x + self._merge_out(o)), k, v
 
 
 class TextEncoder(nn.Module):
@@ -213,6 +228,26 @@ class TextEncoder(nn.Module):
             x_tok, kc, vc = block.decode_step(x_tok, kc, vc, pos)
             new_caches.append((kc, vc))
         return self.final_ln(x_tok), tuple(new_caches)
+
+    def prefill_caches(self, ids_prefix, caches):
+        """Seed the decode caches for positions ``[0, P)`` with ONE
+        batched causal forward over the prompt prefix instead of P
+        sequential ``decode_blocks`` steps — prefill becomes large MXU
+        matmuls (O(P) parallel) rather than an O(P)-step scan of
+        [B, 1]-shaped work. ``ids_prefix`` must contain only real
+        tokens for every row (the caller prefixes at most
+        ``min(prompt_len) - 1`` positions). Returns the updated
+        caches."""
+        x = self.embed_ids(ids_prefix)
+        new_caches = []
+        for block, (kc, vc) in zip(self.blocks, caches):
+            x, k, v = block.prefill(x)
+            kc = jax.lax.dynamic_update_slice(
+                kc, k.astype(kc.dtype), (0, 0, 0, 0))
+            vc = jax.lax.dynamic_update_slice(
+                vc, v.astype(vc.dtype), (0, 0, 0, 0))
+            new_caches.append((kc, vc))
+        return tuple(new_caches)
 
     def finalize(self, x, ids):
         """Final LN + masked mean pool over non-pad tokens."""
