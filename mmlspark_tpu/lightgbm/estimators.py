@@ -184,13 +184,27 @@ class _LightGBMBase(Estimator, LightGBMSharedParams):
             [f"Column_{i}" for i in range(x.shape[1])])
         n_rows = x.n_rows if sparse else x.shape[0]
         mesh = self._training_mesh(n_rows)
+        axes = self._shard_axes()
         return train(x, y, w, cfg, valid=valid, init_booster=init_booster,
                      init_scores=init_scores,
                      valid_init_scores=valid_init_scores,
                      feature_names=names,
                      grad_hess_override=self._grad_override(train_df, y),
                      valid_eval_fn=valid_eval_fn, mesh=mesh,
-                     mesh_axis=self.getShardAxisName())
+                     mesh_axis=axes if len(axes) > 1 else axes[0])
+
+    def _shard_axes(self) -> tuple:
+        """``shardAxisName`` parsed: comma-separated names declare a
+        HIERARCHICAL mesh (e.g. ``"slice,dp"`` — rows shard over the
+        product, the histogram psum composes DCN across slices with ICI
+        within them)."""
+        axes = tuple(a.strip() for a in
+                     self.getShardAxisName().split(",") if a.strip())
+        if not axes:
+            raise ValueError(
+                "shardAxisName must name at least one mesh axis "
+                f"(got {self.getShardAxisName()!r})")
+        return axes
 
     def _training_mesh(self, n_rows: int):
         """Device mesh for distributed histogram training.
@@ -211,7 +225,25 @@ class _LightGBMBase(Estimator, LightGBMSharedParams):
         ns = min(ns, len(devices))
         if ns <= 1:
             return None
-        return Mesh(np.asarray(devices[:ns]), (self.getShardAxisName(),))
+        axes = self._shard_axes()
+        if len(axes) == 1:
+            return Mesh(np.asarray(devices[:ns]), axes)
+        if len(axes) != 2:
+            raise ValueError(
+                f"shardAxisName supports one or two levels, got {axes}")
+        # hierarchical (DCN x ICI): group devices by their slice when
+        # the platform exposes one (TPU pods set slice_index); hosts
+        # with a single slice still get the two-level mesh shape so the
+        # composed psum compiles identically
+        groups: dict = {}
+        for d in devices[:ns]:
+            groups.setdefault(getattr(d, "slice_index", 0), []).append(d)
+        sizes = {len(g) for g in groups.values()}
+        if len(groups) > 1 and len(sizes) == 1:
+            arr = np.asarray([g for g in groups.values()])
+        else:
+            arr = np.asarray(devices[:ns]).reshape(1, -1)
+        return Mesh(arr, axes)
 
 
 class _BoosterModelMixin:

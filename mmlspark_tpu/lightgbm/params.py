@@ -31,7 +31,9 @@ class LightGBMExecutionParams:
     numShards = Param("numShards",
                       "device shards for training (0 = all devices)",
                       TC.toInt, default=0)
-    shardAxisName = Param("shardAxisName", "mesh axis to shard rows over",
+    shardAxisName = Param("shardAxisName", "mesh axis to shard rows over "
+                          "(comma-separated for a hierarchical DCNxICI "
+                          "mesh, e.g. 'slice,dp')",
                           TC.toString, default="dp")
     useBarrierExecutionMode = Param("useBarrierExecutionMode",
                                     "inert; SPMD is inherently barriered",

@@ -216,7 +216,7 @@ def _goss_mask(gmag, valid_mask, key, *, top_n: int, other_n: int,
     return top * 1.0 + other * jnp.float32(amplify)
 
 
-def make_grower(*, mesh, mesh_axis: str | None, tp: TreeParams,
+def make_grower(*, mesh, mesh_axis: str | tuple | None, tp: TreeParams,
                 multi: bool, num_features: int, num_bins: int = 0,
                 dense_bins=None, sparse_binned=None):
     """ONE factory for every growth variant: dense or padded-COO data ×
@@ -227,6 +227,10 @@ def make_grower(*, mesh, mesh_axis: str | None, tp: TreeParams,
     With a mesh, rows shard over ``mesh_axis`` and the histogram
     reduction inside the grower becomes a real ``psum`` collective (the
     reference's socket allreduce, ``TrainUtils.scala:609-625``, on ICI).
+    ``mesh_axis`` may be a TUPLE of axis names for a hierarchical mesh
+    (e.g. ``("slice", "dp")``): rows shard over the product and the
+    psum composes across both levels — ICI within a slice, DCN across
+    slices (SURVEY §2.13).
     Binned data is threaded as explicit args — ``shard_map`` must not
     close over sharded arrays.
     """
@@ -610,7 +614,8 @@ def train(x: np.ndarray, y: np.ndarray, w: np.ndarray | None,
           feature_names: list[str] | None = None,
           grad_hess_override: Callable | None = None,
           valid_eval_fn: Callable | None = None,
-          delegate=None, mesh=None, mesh_axis: str = "dp") -> TrainResult:
+          delegate=None, mesh=None,
+          mesh_axis: str | tuple = "dp") -> TrainResult:
     """Training loop. x [n, F] float32 (NaN = missing), y [n].
 
     ``grad_hess_override`` lets the ranker inject lambdarank gradients (it
@@ -630,7 +635,8 @@ def train(x: np.ndarray, y: np.ndarray, w: np.ndarray | None,
     pad_mask = None
     if mesh is not None:
         from ..parallel.sharding import pad_rows
-        n_dev = int(mesh.shape[mesh_axis])
+        n_dev = int(np.prod([mesh.shape[a] for a in mesh_axis])) \
+            if isinstance(mesh_axis, tuple) else int(mesh.shape[mesh_axis])
         if sparse:
             x, _ = pad_sparse(x, n_dev)
         else:
