@@ -8,6 +8,7 @@ are flax modules jitted once, with weights living in device memory, sharded
 by ``jax.sharding`` over the mesh.
 """
 
+from .bert import BertEncoder
 from .generate import TextGenerator, generate
 from .model import TPUModel
 from .pretrain import (MaskedLMModel, encoder_variables,
@@ -21,4 +22,5 @@ __all__ = ["TPUModel", "TrainState", "make_train_step",
            "shard_train_state", "train_epoch", "TextEncoder",
            "TextEncoderFeaturizer", "make_attention_fn",
            "MaskedLMModel", "encoder_variables", "pretrain_masked_lm",
-           "pretrain_causal_lm", "generate", "TextGenerator"]
+           "pretrain_causal_lm", "generate", "TextGenerator",
+           "BertEncoder"]

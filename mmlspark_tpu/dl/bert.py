@@ -1,0 +1,141 @@
+"""BERT-architecture encoder for EXTERNAL checkpoint ingestion.
+
+``TextEncoder`` (pre-LN, sinusoidal positions) is the framework's native
+architecture; foreign pretrained checkpoints (BERT-class: post-LN
+blocks, LEARNED position + token-type embeddings, embedding LayerNorm)
+cannot be mapped onto it weight-for-weight. This module reproduces the
+published BERT computation exactly so ``models.convert
+.torch_bert_to_flax`` can ingest a foreign ``state_dict`` and the
+result is numerically the checkpoint's own network (oracle-tested
+against a locally-constructed torch reference, the vision-converter
+pattern). Fills the reference's pretrained-model supply chain for text
+(``downloader/ModelDownloader.scala:37-60`` + ``image/ImageFeaturizer
+.scala:81-85`` run real downloaded weights).
+
+Output contract matches ``TextEncoder`` — ``{"tokens": [N, T, W],
+"pooled": [N, W]}`` (masked mean over non-pad tokens) — so
+``TextEncoderFeaturizer`` and the zoo treat both interchangeably; a
+converted checkpoint additionally exposes ``"cls"`` (the [CLS]
+position) and, when the checkpoint carried a pooler, ``"cls_pooled"``
+(tanh-projected [CLS], BERT's sentence vector).
+
+The attention implementation is pluggable exactly like
+``TextEncoder``'s (dense/pallas/blockwise/ring/ulysses) — attention has
+no parameters, so converted weights are valid under any impl.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable
+
+import jax.numpy as jnp
+from flax import linen as nn
+
+from .text_encoder import _dense_attention
+
+
+class BertBlock(nn.Module):
+    """Post-LN transformer block (the published BERT layer): attention
+    and feed-forward residuals each followed by LayerNorm, exact-erf
+    GELU in the feed-forward."""
+    heads: int
+    mlp_dim: int
+    width: int
+    attention_fn: Callable = _dense_attention
+    dtype: Any = jnp.float32
+
+    def setup(self):
+        W = self.width
+        self.q = nn.Dense(W, dtype=self.dtype, name="q")
+        self.k = nn.Dense(W, dtype=self.dtype, name="k")
+        self.v = nn.Dense(W, dtype=self.dtype, name="v")
+        self.out = nn.Dense(W, dtype=self.dtype, name="out")
+        self.ln_att = nn.LayerNorm(epsilon=1e-12, dtype=jnp.float32,
+                                   name="ln_att")
+        self.mlp_1 = nn.Dense(self.mlp_dim, dtype=self.dtype,
+                              name="mlp_1")
+        self.mlp_2 = nn.Dense(W, dtype=self.dtype, name="mlp_2")
+        self.ln_ffn = nn.LayerNorm(epsilon=1e-12, dtype=jnp.float32,
+                                   name="ln_ffn")
+
+    def __call__(self, x, key_mask=None):
+        B, T, W = x.shape
+        hd = W // self.heads
+
+        def split(a):
+            return a.reshape(B, T, self.heads, hd).transpose(0, 2, 1, 3)
+
+        o = self.attention_fn(split(self.q(x)), split(self.k(x)),
+                              split(self.v(x)), key_mask)
+        o = o.transpose(0, 2, 1, 3).reshape(B, T, W).astype(self.dtype)
+        x = self.ln_att(x + self.out(o)).astype(self.dtype)
+        h = nn.gelu(self.mlp_1(x), approximate=False)
+        return self.ln_ffn(x + self.mlp_2(h)).astype(self.dtype)
+
+
+class BertEncoder(nn.Module):
+    """Token ids [N, T] → ``{"tokens", "pooled", "cls"[, "cls_pooled"]}``.
+
+    Same attribute names as ``TextEncoder`` (vocab/width/depth/heads/
+    mlp_dim/max_len/dtype/attention_fn) so ``TextEncoderFeaturizer``
+    rebuilds either architecture with a requested attention impl; pad
+    id 0 is masked out of attention keys and the mean pool, the
+    framework-wide convention (standard BERT vocabularies also place
+    [PAD] at 0)."""
+    vocab: int = 30522
+    width: int = 256
+    depth: int = 4
+    heads: int = 4
+    mlp_dim: int = 1024
+    max_len: int = 512
+    type_vocab: int = 2
+    pooler: bool = True
+    attention_fn: Callable = _dense_attention
+    dtype: Any = jnp.float32
+    # rematerialize blocks in the backward (the same fine-tuning memory
+    # lever TextEncoder exposes — activations recomputed, not stored)
+    remat: bool = False
+
+    def setup(self):
+        self.word = nn.Embed(self.vocab, self.width, dtype=self.dtype,
+                             name="word")
+        self.pos = nn.Embed(self.max_len, self.width, dtype=self.dtype,
+                            name="pos")
+        self.typ = nn.Embed(self.type_vocab, self.width,
+                            dtype=self.dtype, name="type")
+        self.embed_ln = nn.LayerNorm(epsilon=1e-12, dtype=jnp.float32,
+                                   name="embed_ln")  # BERT layer_norm_eps
+        block_cls = nn.remat(BertBlock) if self.remat else BertBlock
+        self.blocks = [block_cls(self.heads, self.mlp_dim, self.width,
+                                 attention_fn=self.attention_fn,
+                                 dtype=self.dtype, name=f"block{i}")
+                       for i in range(self.depth)]
+        if self.pooler:
+            self.pooler_dense = nn.Dense(self.width, dtype=self.dtype,
+                                         name="pooler")
+
+    def __call__(self, ids, train: bool = False, type_ids=None):
+        T = ids.shape[1]
+        if T > self.max_len:
+            # learned positions end at max_len; nn.Embed would silently
+            # CLAMP indices past the table (every overflow position
+            # reusing the last embedding) — fail loudly instead
+            raise ValueError(
+                f"sequence length {T} exceeds this checkpoint's "
+                f"learned position table ({self.max_len}); truncate or "
+                "chunk upstream (WordPieceTokenizerModel maxLength)")
+        x = self.word(ids) + self.pos(jnp.arange(T))[None]
+        x = x + self.typ(jnp.zeros_like(ids) if type_ids is None
+                         else type_ids)
+        x = self.embed_ln(x).astype(self.dtype)
+        key_mask = ids != 0
+        for block in self.blocks:
+            x = block(x, key_mask)
+        mask = key_mask.astype(jnp.float32)[..., None]
+        pooled = (x * mask).sum(1) / jnp.maximum(mask.sum(1), 1.0)
+        out = {"tokens": x, "pooled": pooled.astype(jnp.float32),
+               "cls": x[:, 0].astype(jnp.float32)}
+        if self.pooler:
+            out["cls_pooled"] = jnp.tanh(
+                self.pooler_dense(x[:, 0])).astype(jnp.float32)
+        return out

@@ -382,11 +382,16 @@ class TextEncoderFeaturizer(Transformer, HasInputCol, HasOutputCol,
                         f"model {getattr(loaded.schema, 'name', '?')!r} "
                         "is not a text encoder (register text entries "
                         "with models.register_text_encoder)")
-                module = TextEncoder(
-                    vocab=lm.vocab, width=lm.width, depth=lm.depth,
-                    heads=lm.heads, mlp_dim=lm.mlp_dim,
-                    max_len=lm.max_len, dtype=lm.dtype,
-                    attention_fn=attn)
+                kw = dict(vocab=lm.vocab, width=lm.width,
+                          depth=lm.depth, heads=lm.heads,
+                          mlp_dim=lm.mlp_dim, max_len=lm.max_len,
+                          dtype=lm.dtype, attention_fn=attn)
+                if hasattr(lm, "type_vocab"):   # ingested BertEncoder
+                    kw.update(type_vocab=lm.type_vocab,
+                              pooler=lm.pooler)
+                # rebuild the SAME architecture (TextEncoder or an
+                # ingested BertEncoder) with the requested attention
+                module = type(lm)(**kw)
                 variables = loaded.variables
             else:
                 width, heads = self.get("width"), self.get("heads")

@@ -4,6 +4,7 @@ from .clean_missing_data import CleanMissingData, CleanMissingDataModel
 from .data_conversion import DataConversion
 from .count_selector import CountSelector, CountSelectorModel
 from .text import (BpeTokenizer, BpeTokenizerModel,
+                   WordPieceTokenizerModel,
                    StopWordsRemover, Tokenizer, TokenIdEncoder, NGram, MultiNGram, HashingTF, IDF, IDFModel,
                    TextFeaturizer, TextFeaturizerModel, PageSplitter)
 from .vector import VectorAssembler, OneHotEncoder, OneHotEncoderModel
@@ -14,7 +15,7 @@ __all__ = [
     "ValueIndexer", "ValueIndexerModel", "IndexToValue",
     "CleanMissingData", "CleanMissingDataModel",
     "DataConversion", "CountSelector", "CountSelectorModel",
-    "BpeTokenizer", "BpeTokenizerModel",
+    "BpeTokenizer", "BpeTokenizerModel", "WordPieceTokenizerModel",
     "StopWordsRemover", "Tokenizer", "TokenIdEncoder", "NGram", "MultiNGram", "HashingTF", "IDF", "IDFModel",
     "TextFeaturizer", "TextFeaturizerModel", "PageSplitter",
     "VectorAssembler", "OneHotEncoder", "OneHotEncoderModel",

@@ -9,6 +9,7 @@ processes — the role VW-compatible murmur plays in the reference.
 
 from __future__ import annotations
 
+import os
 import re
 import zlib
 
@@ -608,3 +609,189 @@ class BpeTokenizerModel(Model, HasInputCol, HasOutputCol):
             # UNK (1) is never a vocabulary key → the fallback renders it
             pieces.append(id_to_tok.get(int(tid), "�"))
         return "".join(pieces).replace("</w>", " ").strip()
+
+
+class WordPieceTokenizerModel(Model, HasInputCol, HasOutputCol):
+    """IMPORTED-vocabulary subword tokenizer (BERT's WordPiece): ids
+    come from a foreign ``vocab.txt`` (one token per line, line number
+    = id) rather than a corpus fit — the tokenizer half of external
+    text-checkpoint ingestion (``models.convert.torch_bert_to_flax``
+    being the weights half; reference counterpart
+    ``downloader/ModelDownloader.scala:37-60``, whose models ship with
+    their own vocabularies).
+
+    Encoding is the published WordPiece scheme: whitespace split,
+    punctuation isolated, then greedy LONGEST-match against the
+    vocabulary with ``##``-prefixed continuation pieces; unmatched
+    words become ``[UNK]``. Rows render as ``[CLS] … [SEP]`` (when
+    ``addSpecialTokens``) padded with ``[PAD]`` to ``maxLength``.
+    ``[PAD]`` must sit at id 0 — the framework-wide pad-masking
+    convention, which standard BERT vocabularies already satisfy.
+    """
+
+    vocabulary = Param("vocabulary", "id-ordered token strings "
+                       "(vocab.txt order)")
+    maxLength = Param("maxLength", "token-id row width (truncate/pad)",
+                      TC.toInt, default=128, has_default=True)
+    toLowercase = Param("toLowercase", "lowercase before matching "
+                        "(uncased vocabularies)", TC.toBoolean,
+                        default=True, has_default=True)
+    addSpecialTokens = Param("addSpecialTokens", "wrap rows in "
+                             "[CLS]/[SEP]", TC.toBoolean, default=True,
+                             has_default=True)
+    maxCharsPerWord = Param("maxCharsPerWord", "words longer than this "
+                            "become [UNK]", TC.toInt, default=100,
+                            has_default=True)
+
+    def __init__(self, **kwargs):
+        super().__init__(**kwargs)
+        self._setDefault(inputCol="text", outputCol="tokens")
+
+    @classmethod
+    def from_vocab(cls, source, **kwargs) -> "WordPieceTokenizerModel":
+        """Build from a ``vocab.txt`` path or an id-ordered token list."""
+        if isinstance(source, (str, os.PathLike)):
+            with open(source, encoding="utf-8") as f:
+                tokens = [ln.rstrip("\r\n") for ln in f]
+            while tokens and not tokens[-1]:
+                tokens.pop()
+        else:
+            tokens = list(source)
+        model = cls(**kwargs).set("vocabulary", tokens)
+        model._lookup()                  # validate [PAD]/[UNK] up front
+        return model
+
+    def _lookup(self) -> dict:
+        vocab = self.get("vocabulary")
+        cached = getattr(self, "_wp_cache", None)
+        if cached is not None and cached[0] is vocab:
+            return cached[1]
+        ids = {t: i for i, t in enumerate(vocab)}
+        if ids.get("[PAD]") != 0:
+            raise ValueError(
+                "[PAD] must be id 0 (the framework-wide pad-masking "
+                "convention); this vocabulary puts it at "
+                f"{ids.get('[PAD]', 'absent')}")
+        if "[UNK]" not in ids:
+            raise ValueError("vocabulary has no [UNK] token")
+        self._wp_cache = (vocab, ids)
+        return ids
+
+    def encode_word(self, word: str) -> list[str]:
+        """Greedy longest-match WordPiece split of one word."""
+        ids = self._lookup()
+        if len(word) > self.get("maxCharsPerWord"):
+            return ["[UNK]"]
+        pieces: list[str] = []
+        start = 0
+        while start < len(word):
+            end = len(word)
+            piece = None
+            while start < end:
+                sub = word[start:end]
+                if start > 0:
+                    sub = "##" + sub
+                if sub in ids:
+                    piece = sub
+                    break
+                end -= 1
+            if piece is None:
+                return ["[UNK]"]
+            pieces.append(piece)
+            start = end
+        return pieces
+
+    @staticmethod
+    def _is_split_char(ch: str) -> bool:
+        """BERT basic-tokenizer split set: Unicode punctuation, ASCII
+        non-alphanumeric symbols ($ + = < > ^ ` | ~ …), and CJK
+        ideographs (each becomes its own word)."""
+        import unicodedata
+        cp = ord(ch)
+        if 33 <= cp <= 47 or 58 <= cp <= 64 or 91 <= cp <= 96 \
+                or 123 <= cp <= 126:
+            return True
+        if unicodedata.category(ch).startswith("P"):
+            return True
+        # CJK Unified Ideographs blocks (the BERT CJK ranges)
+        return (0x4E00 <= cp <= 0x9FFF or 0x3400 <= cp <= 0x4DBF
+                or 0x20000 <= cp <= 0x2A6DF or 0x2A700 <= cp <= 0x2B73F
+                or 0x2B740 <= cp <= 0x2B81F or 0x2B820 <= cp <= 0x2CEAF
+                or 0xF900 <= cp <= 0xFAFF or 0x2F800 <= cp <= 0x2FA1F)
+
+    def _words(self, text: str) -> list[str]:
+        """Basic tokenization (the BERT basic tokenizer): lowercase +
+        accent-strip for uncased vocabularies, whitespace split, with
+        punctuation/symbols/CJK isolated as single-char words."""
+        import unicodedata
+        if self.get("toLowercase"):
+            # NFD + drop combining marks: "café" → "cafe", matching how
+            # uncased vocabularies were built
+            text = "".join(
+                ch for ch in unicodedata.normalize("NFD", text.lower())
+                if unicodedata.category(ch) != "Mn")
+        words: list[str] = []
+        buf: list[str] = []
+        for ch in text:
+            if ch.isspace():
+                if buf:
+                    words.append("".join(buf))
+                    buf = []
+            elif self._is_split_char(ch):
+                if buf:
+                    words.append("".join(buf))
+                    buf = []
+                words.append(ch)
+            else:
+                buf.append(ch)
+        if buf:
+            words.append("".join(buf))
+        return words
+
+    def _transform(self, df):
+        ids = self._lookup()
+        L = self.get("maxLength")
+        special = self.get("addSpecialTokens")
+        cls_id, sep_id = ids.get("[CLS]"), ids.get("[SEP]")
+        if special and (cls_id is None or sep_id is None):
+            raise ValueError("addSpecialTokens needs [CLS] and [SEP] "
+                             "in the vocabulary")
+        unk = ids["[UNK]"]
+        col = df[self.getInputCol()]
+        out = np.zeros((len(col), L), np.int32)
+        word_cache: dict[str, list[int]] = {}
+        body = L - 2 if special else L
+        for i, text in enumerate(col.tolist()):
+            row: list[int] = []
+            for w in self._words(text):
+                got = word_cache.get(w)
+                if got is None:
+                    got = [ids.get(p, unk) for p in self.encode_word(w)]
+                    word_cache[w] = got
+                row.extend(got)
+                if len(row) >= body:
+                    break
+            row = row[:body]
+            if special:
+                row = [cls_id] + row + [sep_id]
+            out[i, :len(row)] = row
+        return df.with_column(self.getOutputCol(), out)
+
+    def decode(self, ids_row) -> str:
+        """Token ids → text: ``##`` continuations concatenate onto the
+        previous piece; specials ([CLS]/[SEP]/[PAD]) drop."""
+        vocab = self.get("vocabulary")
+        self._lookup()
+        words: list[str] = []
+        for tid in np.asarray(ids_row).tolist():
+            tid = int(tid)
+            if tid == 0:
+                break
+            tok = vocab[tid] if 0 <= tid < len(vocab) else "[UNK]"
+            if tok in ("[CLS]", "[SEP]", "[MASK]"):
+                continue
+            if tok.startswith("##") and words:
+                words[-1] += tok[2:]
+            else:
+                words.append(tok)
+        return " ".join(words)

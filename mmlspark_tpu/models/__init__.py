@@ -10,8 +10,10 @@ lookup rather than graph surgery.
 
 from .resnet import ResNet, ResNet18, ResNet34, ResNet50, ResNet101
 from .zoo import (ModelSchema, ModelDownloader, get_model,
-                  register_model, register_text_encoder)
+                  register_model, register_bert_encoder,
+                  register_text_encoder)
 
 __all__ = ["ResNet", "ResNet18", "ResNet34", "ResNet50", "ResNet101",
            "ModelSchema", "ModelDownloader", "get_model",
-           "register_model", "register_text_encoder"]
+           "register_model", "register_bert_encoder",
+           "register_text_encoder"]

@@ -233,6 +233,122 @@ def torch_vit_to_flax(state_dict: dict, model_name: str) -> dict:
     return {"params": params}
 
 
+def torch_bert_to_flax(state_dict: dict, heads: int | None = None,
+                       config=None) -> tuple[dict, dict]:
+    """Foreign BERT-style ``state_dict`` (HF naming:
+    ``embeddings.word_embeddings`` / ``encoder.layer.N.attention.self
+    .query`` / …, with or without a leading ``bert.`` prefix) → flax
+    variables for ``dl.bert.BertEncoder`` plus the inferred
+    architecture kwargs.
+
+    Every dimension is read from the weight shapes (vocab/width from
+    the word embedding, depth from the layer indices, mlp_dim from the
+    intermediate projection, max_len/type_vocab from their embeddings);
+    ``heads`` is the one dimension a state_dict cannot carry — pass it
+    explicitly, or pass ``config`` (the checkpoint's ``config.json``
+    path or dict; its ``num_attention_heads`` is used). With neither,
+    the ``width // 64`` BERT convention applies — WITH A WARNING,
+    because a non-standard head count (e.g. MiniLM's 12 heads at width
+    384) converts silently into different attention numerics than the
+    source network. The pretraining head
+    (``cls.*``) is dropped; any OTHER leftover key raises, like the
+    vision converters (a truncated/mismatched checkpoint must fail
+    loudly). Reference counterpart: ``downloader/ModelDownloader
+    .scala:37-60`` (its featurizers run real downloaded weights).
+    """
+    sd = {}
+    for k, v in state_dict.items():
+        k = k[5:] if k.startswith("bert.") else k
+        if k.startswith("cls."):       # masked-LM pretraining head
+            continue
+        sd[k] = v
+
+    def dense(torch_name: str):
+        return {"kernel": _np(sd.pop(torch_name + ".weight")).T,
+                "bias": _np(sd.pop(torch_name + ".bias"))}
+
+    def lnorm(torch_name: str):
+        # older BERT exports use gamma/beta instead of weight/bias
+        w = sd.pop(torch_name + ".weight", None)
+        w = sd.pop(torch_name + ".gamma") if w is None else w
+        b = sd.pop(torch_name + ".bias", None)
+        b = sd.pop(torch_name + ".beta") if b is None else b
+        return {"scale": _np(w), "bias": _np(b)}
+
+    word = _np(sd.pop("embeddings.word_embeddings.weight"))
+    pos = _np(sd.pop("embeddings.position_embeddings.weight"))
+    typ = _np(sd.pop("embeddings.token_type_embeddings.weight"))
+    sd.pop("embeddings.position_ids", None)   # a buffer, not a weight
+    vocab, width = word.shape
+    depth = 1 + max((int(k.split(".")[2]) for k in sd
+                     if k.startswith("encoder.layer.")), default=-1)
+    if depth <= 0:
+        raise ValueError("state_dict has no encoder.layer.* weights — "
+                         "not a BERT-style checkpoint")
+    params: dict = {
+        "word": {"embedding": word},
+        "pos": {"embedding": pos},
+        "type": {"embedding": typ},
+        "embed_ln": lnorm("embeddings.LayerNorm"),
+    }
+    mlp_dim = None
+    for i in range(depth):
+        t = f"encoder.layer.{i}"
+        blk = {
+            "q": dense(t + ".attention.self.query"),
+            "k": dense(t + ".attention.self.key"),
+            "v": dense(t + ".attention.self.value"),
+            "out": dense(t + ".attention.output.dense"),
+            "ln_att": lnorm(t + ".attention.output.LayerNorm"),
+            "mlp_1": dense(t + ".intermediate.dense"),
+            "mlp_2": dense(t + ".output.dense"),
+            "ln_ffn": lnorm(t + ".output.LayerNorm"),
+        }
+        mlp_dim = blk["mlp_1"]["kernel"].shape[1]
+        params[f"block{i}"] = blk
+    has_pooler = "pooler.dense.weight" in sd
+    if has_pooler:
+        params["pooler"] = dense("pooler.dense")
+    if sd:
+        leftover = sorted(sd)[:5]
+        raise ValueError(
+            f"{len(sd)} unconverted torch weights (first: {leftover}) — "
+            "state_dict does not match the expected BERT layout")
+    if heads is None and config is not None:
+        if isinstance(config, (str, os.PathLike)):
+            with open(config) as f:
+                config = json.load(f)
+        heads = config.get("num_attention_heads")
+    if heads is None:
+        import warnings
+        heads = max(width // 64, 1)
+        warnings.warn(
+            f"head count not provided — assuming {heads} "
+            f"(width {width} / 64, the BERT convention). A checkpoint "
+            "with a different head count would convert into DIFFERENT "
+            "attention numerics with no error; pass heads= or "
+            "config=<config.json> to be exact.", stacklevel=2)
+    arch = dict(vocab=int(vocab), width=int(width), depth=int(depth),
+                heads=int(heads),
+                mlp_dim=int(mlp_dim), max_len=int(pos.shape[0]),
+                type_vocab=int(typ.shape[0]), pooler=has_pooler)
+    if arch["width"] % arch["heads"] != 0:
+        raise ValueError(f"heads={arch['heads']} must divide "
+                         f"width={arch['width']}")
+    return {"params": params}, arch
+
+
+def bert_encoder_from_torch(state_dict: dict, heads: int | None = None,
+                            config=None):
+    """One-call ingestion: foreign BERT ``state_dict`` → ``(module,
+    variables)`` ready for ``TextEncoderFeaturizer(model=...)`` or zoo
+    publication via :func:`save_converted` +
+    ``models.register_bert_encoder``."""
+    from ..dl.bert import BertEncoder
+    variables, arch = torch_bert_to_flax(state_dict, heads, config)
+    return BertEncoder(**arch), variables
+
+
 def torch_to_flax(state_dict: dict, model_name: str) -> dict:
     """Dispatch to the family converter by zoo model name."""
     if model_name in _VIT_ARCHS:

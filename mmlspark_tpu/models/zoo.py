@@ -112,6 +112,41 @@ def register_text_encoder(name: str, *, vocab: int, width: int,
 _register_builtins()
 
 
+class _BertEncoderBuilder:
+    """Picklable BERT-encoder factory (mirrors ``_TextEncoderBuilder``
+    — a closure would break ComplexParam persistence)."""
+
+    def __init__(self, **arch):
+        self.arch = dict(arch)
+
+    def __call__(self, **kwargs):
+        from ..dl.bert import BertEncoder
+        return BertEncoder(**self.arch, **kwargs)
+
+
+def register_bert_encoder(name: str, *, vocab: int, width: int,
+                          depth: int, heads: int, mlp_dim: int,
+                          max_len: int = 512, type_vocab: int = 2,
+                          pooler: bool = True,
+                          seq_len: int = 128) -> ModelSchema:
+    """Register an ingested-BERT catalogue entry (the text counterpart
+    of the reference's downloaded-CNTK-model entries,
+    ``downloader/Schema.scala``): a foreign checkpoint converted by
+    ``models.convert.torch_bert_to_flax`` + ``save_converted`` reloads
+    into the exact BERT architecture that produced it."""
+    return register_model(ModelSchema(
+        name=name, dataset="custom", model_type="text",
+        num_layers=depth, input_node="tokens", input_size=seq_len,
+        num_classes=0,
+        builder=_BertEncoderBuilder(vocab=vocab, width=width,
+                                    depth=depth, heads=heads,
+                                    mlp_dim=mlp_dim, max_len=max_len,
+                                    type_vocab=type_vocab,
+                                    pooler=pooler),
+        layer_names=tuple(f"block{i}" for i in range(depth))
+        + ("tokens", "pooled", "cls")))
+
+
 def get_model(name: str) -> ModelSchema:
     if name not in _REGISTRY:
         raise KeyError(
