@@ -43,39 +43,107 @@ def load_balance_loss(logits, expert):
     return E * jnp.sum(f * P)
 
 
-def moe_forward(params, x, *, return_aux: bool = False):
+def _expert_positions(expert, E: int, valid=None):
+    """Each token's arrival rank within its expert's queue (token
+    order = batch order, the Switch first-come-first-served rule).
+    ``valid`` excludes tokens (padding) from consuming queue slots —
+    without it, a batch's pad positions all route to the same expert
+    (identical embeddings) and can crowd real tokens past capacity."""
+    onehot = jax.nn.one_hot(expert, E, dtype=jnp.int32)     # [T, E]
+    if valid is not None:
+        onehot = onehot * valid[:, None].astype(jnp.int32)
+    ranks = jnp.cumsum(onehot, axis=0) - 1
+    return jnp.take_along_axis(ranks, expert[:, None], axis=1)[:, 0]
+
+
+def _capacity(T: int, E: int, capacity_factor: float) -> int:
+    """Static per-expert token budget C = ceil(T/E · cf), clamped to T."""
+    return max(1, min(T, int(np.ceil(T / E * capacity_factor))))
+
+
+def _capacity_ffn(x, eid, pos, keep, w_in, w_out, C: int):
+    """Sort-free capacity dispatch: kept tokens scatter into per-expert
+    [E_local, C, D] buffers (unique slots by construction — ``pos`` is
+    the within-expert rank), the experts run as ONE batched matmul pair
+    (E_local·C·D·H FLOPs — independent of the global expert count),
+    and results gather back to token order. Overflowed/foreign tokens
+    contribute zero (their residual path passes through unchanged).
+    Scatter/gather are differentiable, so training flows exactly like
+    the dense formulation."""
+    E_loc, D = w_in.shape[0], x.shape[1]
+    slot = jnp.where(keep, eid * C + jnp.minimum(pos, C - 1), 0)
+    contrib = jnp.where(keep[:, None], x, 0.0)
+    buf = jnp.zeros((E_loc * C, D), x.dtype).at[slot].add(contrib)
+    h = jax.nn.gelu(jnp.einsum(
+        "ecd,edh->ech", buf.reshape(E_loc, C, D), w_in))
+    y = jnp.einsum("ech,ehd->ecd", h, w_out)
+    out = y.reshape(E_loc * C, -1)[slot]
+    return jnp.where(keep[:, None], out, 0.0)
+
+
+def moe_forward(params, x, *, return_aux: bool = False,
+                capacity_factor: float | None = None, valid=None):
     """Single-device reference: x [T, D] → [T, D], top-1 routing.
 
     TRAINABLE end-to-end: experts get gradients through their outputs
     and the router through the chosen-expert probability multiplier
     (the Switch gating trick). ``return_aux=True`` additionally returns
     ``{"balance_loss", "expert_fraction"}`` — add ``balance_loss``
-    (scaled ~1e-2) to the task loss to keep routing spread."""
+    (scaled ~1e-2) to the task loss to keep routing spread.
+
+    ``capacity_factor=None`` (default) is the DENSE dispatch — every
+    token through every expert, masked; exact, O(T·E·D·H), the
+    equivalence oracle. A float switches to capacity dispatch:
+    per-expert budget C = ceil(T/E · cf), tokens beyond it DROP (zero
+    MoE contribution, residual unchanged), compute O(T·cf·D·H) —
+    independent of E, the formulation that scales to real expert
+    counts. With cf ≥ E the two are identical (no token can
+    overflow). ``valid`` [T] bool marks real tokens: in capacity mode
+    invalid (pad) tokens neither consume queue slots nor receive
+    contributions; the dense path ignores it (pads are harmless there
+    — their outputs die at the masked pool)."""
     logits = x @ params["router"]                     # [T, E]
+    E = logits.shape[-1]
     expert = jnp.argmax(logits, axis=-1)
     gate = jax.nn.softmax(logits, axis=-1)
     gate_top = jnp.take_along_axis(gate, expert[:, None], axis=1)[:, 0]
-    dispatch = jax.nn.one_hot(expert, logits.shape[-1])   # [T, E]
-    h = jnp.einsum("te,td,edh->teh", dispatch, x, params["w_in"])
-    h = jax.nn.gelu(h)
-    y = jnp.einsum("teh,ehd->td", h, params["w_out"])
+    if capacity_factor is None:
+        dispatch = jax.nn.one_hot(expert, E)          # [T, E]
+        h = jnp.einsum("te,td,edh->teh", dispatch, x, params["w_in"])
+        h = jax.nn.gelu(h)
+        y = jnp.einsum("teh,ehd->td", h, params["w_out"])
+    else:
+        C = _capacity(x.shape[0], E, capacity_factor)
+        pos = _expert_positions(expert, E, valid)
+        keep = pos < C if valid is None else valid & (pos < C)
+        y = _capacity_ffn(x, expert, pos, keep,
+                          params["w_in"], params["w_out"], C)
     out = y * gate_top[:, None]
     if not return_aux:
         return out
     aux = {"balance_loss": load_balance_loss(logits, expert),
-           "expert_fraction": dispatch.mean(axis=0)}
+           "expert_fraction": jax.nn.one_hot(expert, E).mean(axis=0)}
     return out, aux
 
 
 def make_sharded_moe(mesh, *, axis: str = "ep",
-                     return_aux: bool = False):
+                     return_aux: bool = False,
+                     capacity_factor: float | None = None):
     """Expert-parallel forward: experts shard over ``axis``; tokens are
     replicated in, outputs psum-combined. Differentiable like the
     single-device reference (run under ``jit``); with ``return_aux``
-    the replicated balance-loss aux rides out alongside."""
+    the replicated balance-loss aux rides out alongside.
+
+    ``capacity_factor`` as in :func:`moe_forward`: None = dense-masked
+    dispatch (exact; per-device compute O(T·E/n·D·H), scaling with the
+    LOCAL expert count), a float = capacity dispatch (per-device
+    compute O(T·cf/n·D·H) — independent of E, required at real expert
+    widths). Routing/positions derive from the all-gathered logits, so
+    every shard agrees on queue ranks and the result equals the
+    single-device capacity path exactly."""
     n = int(mesh.shape[axis])
 
-    def local(params, x):
+    def local(params, x, valid):
         # params' expert dims are local shards [E/n, ...]; the router
         # column block is this shard's experts
         shard = jax.lax.axis_index(axis)
@@ -91,12 +159,21 @@ def make_sharded_moe(mesh, *, axis: str = "ep",
                                        axis=1)[:, 0]
         local_expert = expert - shard * e_per
         mine = (local_expert >= 0) & (local_expert < e_per)
-        dispatch = jax.nn.one_hot(
-            jnp.where(mine, local_expert, 0), e_per) \
-            * mine[:, None]                           # [T, E/n]
-        h = jnp.einsum("te,td,edh->teh", dispatch, x, params["w_in"])
-        h = jax.nn.gelu(h)
-        y = jnp.einsum("teh,ehd->td", h, params["w_out"])
+        if capacity_factor is None:
+            dispatch = jax.nn.one_hot(
+                jnp.where(mine, local_expert, 0), e_per) \
+                * mine[:, None]                       # [T, E/n]
+            h = jnp.einsum("te,td,edh->teh", dispatch, x,
+                           params["w_in"])
+            h = jax.nn.gelu(h)
+            y = jnp.einsum("teh,ehd->td", h, params["w_out"])
+        else:
+            C = _capacity(x.shape[0], E, capacity_factor)
+            pos = _expert_positions(expert, E, valid)  # global ranks
+            keep = mine & valid & (pos < C)
+            y = _capacity_ffn(x, jnp.where(mine, local_expert, 0),
+                              pos, keep, params["w_in"],
+                              params["w_out"], C)
         y = y * gate_top[:, None]
         out = jax.lax.psum(y, axis)
         if not return_aux:
@@ -111,8 +188,15 @@ def make_sharded_moe(mesh, *, axis: str = "ep",
             "w_out": P(axis)}
     out_specs = (P(), {"balance_loss": P(), "expert_fraction": P()}) \
         if return_aux else P()
-    return jax.shard_map(local, mesh=mesh, in_specs=(spec, P()),
-                         out_specs=out_specs, check_vma=False)
+    mapped = jax.shard_map(local, mesh=mesh, in_specs=(spec, P(), P()),
+                           out_specs=out_specs, check_vma=False)
+
+    def fn(params, x, valid=None):
+        if valid is None:
+            valid = jnp.ones(x.shape[0], bool)
+        return mapped(params, x, valid)
+
+    return fn
 
 
 def init_moe_blocks(rng, depth: int, d_model: int, num_experts: int,
@@ -149,12 +233,17 @@ def moe_text_encoder_forward(module, variables, moe_blocks, ids,
     N, T = ids.shape
     W = module.width
     balance, fractions = [], []
+    # pads must not consume capacity slots (capacity dispatch ranks
+    # queues in flattened batch order; identical pad embeddings would
+    # otherwise pile onto one expert ahead of real tokens)
+    valid = key_mask.reshape(N * T)
     for i in range(module.depth):
         bvars = {"params": variables["params"][f"block{i}"]}
         x = block.apply(bvars, x, key_mask, method="attend")
         h = block.apply(bvars, x, method="pre_ffn_norm")
         y = moe_apply(moe_blocks[i],
-                      h.reshape(N * T, W).astype(jnp.float32))
+                      h.reshape(N * T, W).astype(jnp.float32),
+                      valid=valid)
         if with_aux:
             y, aux = y
             balance.append(aux["balance_loss"])
@@ -168,7 +257,8 @@ def moe_text_encoder_forward(module, variables, moe_blocks, ids,
 
 
 def make_moe_train_step(mesh, module, tx, *, axis: str = "ep",
-                        balance_weight: float = 1e-2, loss_fn=None):
+                        balance_weight: float = 1e-2, loss_fn=None,
+                        capacity_factor: float | None = 1.25):
     """Jitted expert-parallel TRAINING step for the MoE text encoder:
     (opt_state, variables, moe_blocks, ids, y) → updated (opt_state,
     variables, moe_blocks, loss, balance). Gradients flow to the
@@ -176,10 +266,16 @@ def make_moe_train_step(mesh, module, tx, *, axis: str = "ep",
     gate multiplier); the load-balance aux (scaled by
     ``balance_weight``) keeps routing spread. Experts stay sharded over
     ``axis`` throughout — the optimizer update runs on the sharded
-    leaves, so expert state never gathers."""
+    leaves, so expert state never gathers.
+
+    Training defaults to CAPACITY dispatch (``capacity_factor=1.25``,
+    the Switch-Transformer setting): per-device expert compute is
+    independent of the expert count, the formulation that scales;
+    pass ``None`` for the exact dense-masked oracle."""
     import optax
 
-    sharded = make_sharded_moe(mesh, axis=axis, return_aux=True)
+    sharded = make_sharded_moe(mesh, axis=axis, return_aux=True,
+                               capacity_factor=capacity_factor)
     loss_fn = loss_fn or (
         lambda pooled, t: jnp.mean((pooled.mean(-1) - t) ** 2))
 
@@ -206,12 +302,15 @@ def make_moe_train_step(mesh, module, tx, *, axis: str = "ep",
 
 
 def make_moe_text_encoder(mesh, module, variables, moe_blocks, *,
-                          axis: str = "ep"):
+                          axis: str = "ep",
+                          capacity_factor: float | None = None):
     """Expert-parallel MoE text encoder: experts shard over ``axis``,
     attention stays replicated. Returns ``fn(ids) -> {"tokens",
     "pooled"}`` matching the single-device
-    :func:`moe_text_encoder_forward` bit-for-bit up to psum ordering."""
-    sharded = make_sharded_moe(mesh, axis=axis)
+    :func:`moe_text_encoder_forward` bit-for-bit up to psum ordering
+    (pass the same ``capacity_factor`` to both for capacity mode)."""
+    sharded = make_sharded_moe(mesh, axis=axis,
+                               capacity_factor=capacity_factor)
 
     def forward(ids):
         return moe_text_encoder_forward(module, variables, moe_blocks,

@@ -136,7 +136,10 @@ def register_bert_encoder(name: str, *, vocab: int, width: int,
     into the exact BERT architecture that produced it."""
     return register_model(ModelSchema(
         name=name, dataset="custom", model_type="text",
-        num_layers=depth, input_node="tokens", input_size=seq_len,
+        num_layers=depth, input_node="tokens",
+        # clamp: the random-init dummy must fit the checkpoint's
+        # learned position table or module.init raises
+        input_size=min(seq_len, max_len),
         num_classes=0,
         builder=_BertEncoderBuilder(vocab=vocab, width=width,
                                     depth=depth, heads=heads,

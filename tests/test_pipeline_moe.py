@@ -78,6 +78,160 @@ class TestExpertParallel:
         assert len(used) >= E // 2  # router spreads tokens
 
 
+class TestCapacityDispatch:
+    """Scalable O(T·capacity) dispatch (VERDICT r4 Weak #6: the dense
+    one-hot einsum runs every token through every local expert —
+    compute ×E/n with expert count)."""
+
+    def _setup(self, E=8, D=16, H=32, T=64, seed=0):
+        params = init_moe_params(jax.random.PRNGKey(seed), E, D, H)
+        x = jax.random.normal(jax.random.PRNGKey(seed + 1), (T, D))
+        return params, x
+
+    def test_high_capacity_equals_dense_oracle(self):
+        """cf ≥ E → no token can overflow → capacity dispatch must
+        reproduce the dense-masked formulation exactly."""
+        params, x = self._setup()
+        dense = moe_forward(params, x)
+        cap = moe_forward(params, x, capacity_factor=8.0)
+        np.testing.assert_allclose(np.asarray(cap), np.asarray(dense),
+                                   atol=1e-5)
+
+    def test_overflow_drops_in_queue_order(self):
+        """Collapse routing onto expert 0: only the first C tokens get
+        an expert contribution (Switch first-come-first-served), the
+        rest output exactly zero (residual untouched)."""
+        E, D, H, T = 8, 16, 32, 64
+        params, x = self._setup(E=E, D=D, H=H, T=T)
+        x = jnp.abs(x) + 0.1   # positive features: the all-ones router
+        params = dict(params)  # column below then wins for EVERY token
+        params["router"] = jnp.zeros((D, E)).at[:, 0].set(
+            10 * jnp.ones(D))
+        cf = 2.0
+        C = int(np.ceil(T / E * cf))
+        out = np.asarray(moe_forward(params, x, capacity_factor=cf))
+        dense = np.asarray(moe_forward(params, x))
+        np.testing.assert_allclose(out[:C], dense[:C], atol=1e-5)
+        np.testing.assert_array_equal(out[C:], 0.0)
+        assert np.abs(dense[C:]).max() > 0  # dense DID compute them
+
+    def test_sharded_capacity_matches_single(self):
+        """Shards rank queues from the same all-gathered routing, so
+        drops agree with the single-device capacity path exactly."""
+        params, x = self._setup(T=48)
+        mesh = Mesh(np.asarray(jax.devices()), ("ep",))
+        single = moe_forward(params, x, capacity_factor=1.25)
+        sharded = make_sharded_moe(mesh, capacity_factor=1.25)(params, x)
+        np.testing.assert_allclose(np.asarray(sharded),
+                                   np.asarray(single), atol=1e-5)
+
+    def test_dispatch_flops_independent_of_expert_count(self):
+        """The point of the formulation: quadrupling E leaves capacity
+        compute ~flat (dense grows ~4x). Asserted with XLA's own cost
+        analysis."""
+        D, H, T, cf = 32, 64, 256, 1.0
+
+        def flops(E, capacity_factor):
+            params = init_moe_params(jax.random.PRNGKey(0), E, D, H)
+            x = jnp.ones((T, D))
+            f = jax.jit(lambda p, x: moe_forward(
+                p, x, capacity_factor=capacity_factor))
+            cost = f.lower(params, x).compile().cost_analysis()
+            return float(cost["flops"])
+
+        dense_ratio = flops(32, None) / flops(8, None)
+        cap_ratio = flops(32, cf) / flops(8, cf)
+        assert dense_ratio > 3.0, dense_ratio      # dense scales with E
+        assert cap_ratio < 1.5, cap_ratio          # capacity does not
+
+    def test_pads_do_not_consume_capacity(self):
+        """Pad positions embed identically, so they all route to one
+        expert; ranked ahead of real tokens they would crowd them past
+        C. The valid mask must keep every real token's contribution
+        intact in a heavily padded batch."""
+        E, D, H = 8, 16, 32
+        params, x = self._setup(E=E, D=D, H=H, T=96)
+        valid = jnp.zeros(96, bool).at[64:].set(True)  # pads FIRST
+        dense = np.asarray(moe_forward(params, x))
+        cap = np.asarray(moe_forward(params, x, capacity_factor=2.0,
+                                     valid=valid))
+        # capacity per expert C = ceil(96/8*2) = 24 >= real tokens per
+        # expert, so with pads excluded nothing real can overflow
+        np.testing.assert_allclose(cap[64:], dense[64:], atol=1e-5)
+        np.testing.assert_array_equal(cap[:64], 0.0)  # pads get none
+        # encoder-level wiring: the pad mask threads through
+        # moe_text_encoder_forward into the dispatch — with capacity
+        # high enough that nothing real overflows, a padded batch must
+        # match its dense (exact) twin, which only holds if pads were
+        # excluded from ranking (they'd otherwise overflow expert
+        # queues at this cf on their own)
+        from mmlspark_tpu.models.moe import (init_moe_blocks,
+                                             moe_text_encoder_forward)
+        from mmlspark_tpu.dl.text_encoder import TextEncoder
+        import functools
+        enc = TextEncoder(vocab=64, width=16, depth=1, heads=2,
+                          mlp_dim=32, dtype=jnp.float32)
+        rng = np.random.default_rng(5)
+        padded = np.zeros((4, 32), np.int32)
+        padded[:, :4] = rng.integers(1, 64, size=(4, 4))
+        enc_vars = enc.init(jax.random.PRNGKey(0), jnp.asarray(padded))
+        blocks = init_moe_blocks(jax.random.PRNGKey(1), 1, 16, 8, 32)
+        # 16 real tokens over 8 experts, C = ceil(128/8*1.0) = 16: no
+        # real token can overflow, but the 112 pads would fill every
+        # queue if counted
+        ap = functools.partial(moe_forward, capacity_factor=1.0)
+        out_cap = moe_text_encoder_forward(enc, enc_vars, blocks,
+                                           jnp.asarray(padded),
+                                           moe_apply=ap)
+        out_dense = moe_text_encoder_forward(enc, enc_vars, blocks,
+                                             jnp.asarray(padded))
+        np.testing.assert_allclose(np.asarray(out_cap["pooled"]),
+                                   np.asarray(out_dense["pooled"]),
+                                   atol=1e-4)
+
+    def test_capacity_is_trainable(self):
+        """Gradients reach router and experts through the scatter/
+        gather dispatch (the Switch gate multiplier path)."""
+        params, x = self._setup()
+
+        def loss(p):
+            return jnp.sum(moe_forward(p, x, capacity_factor=1.25) ** 2)
+
+        g = jax.grad(loss)(params)
+        assert float(jnp.abs(g["router"]).max()) > 0
+        assert float(jnp.abs(g["w_in"]).max()) > 0
+        assert float(jnp.abs(g["w_out"]).max()) > 0
+
+    def test_train_step_capacity_default(self):
+        """make_moe_train_step defaults to capacity dispatch and still
+        trains the real MoE encoder."""
+        import optax
+
+        from mmlspark_tpu.dl.text_encoder import TextEncoder
+        from mmlspark_tpu.models.moe import (init_moe_blocks,
+                                             make_moe_train_step)
+        rng = np.random.default_rng(0)
+        enc = TextEncoder(vocab=64, width=16, depth=2, heads=2,
+                          mlp_dim=32, dtype=jnp.float32)
+        ids = jnp.asarray(rng.integers(1, 64, size=(8, 12)), jnp.int32)
+        y = jnp.asarray(rng.integers(0, 2, size=8), jnp.float32)
+        enc_vars = enc.init(jax.random.PRNGKey(0), ids)
+        mesh = Mesh(np.asarray(jax.devices()), ("ep",))
+        blocks = init_moe_blocks(jax.random.PRNGKey(1), enc.depth, 16,
+                                 8, 32)
+        tx = optax.sgd(1e-2)
+        step = make_moe_train_step(mesh, enc, tx)   # cf=1.25 default
+        opt = tx.init((enc_vars, blocks))
+        losses = []
+        for _ in range(8):
+            opt, enc_vars, blocks, task, balance = step(
+                opt, enc_vars, blocks, ids, y)
+            losses.append(float(task))
+            assert np.isfinite(losses[-1]) and np.isfinite(
+                float(balance))
+        assert losses[-1] < losses[0]
+
+
 class TestMoETraining:
     """Trainable expert parallelism (VERDICT r3 Weak #5: MoE was
     inference-only with no load-balancing loss)."""
