@@ -29,17 +29,29 @@ def init_moe_params(rng, num_experts: int, d_model: int, d_hidden: int):
     }
 
 
-def load_balance_loss(logits, expert):
+def load_balance_loss(logits, expert, valid=None):
     """Switch-Transformer auxiliary loss: ``E · Σ_e f_e · P_e`` where
     ``f_e`` is the fraction of tokens dispatched to expert e and
     ``P_e`` the mean router probability for e. Equals 1.0 at perfect
     uniformity; grows as routing collapses onto few experts. ``f`` is
     non-differentiable (argmax counts); gradients reach the router
-    through ``P`` — the standard formulation."""
+    through ``P`` — the standard formulation.
+
+    ``valid`` restricts both means to real tokens: pad positions embed
+    identically, all route to one expert, and would otherwise dominate
+    ``f`` on padded batches — the router would be trained by padding,
+    not data."""
     E = logits.shape[-1]
     probs = jax.nn.softmax(logits, axis=-1)
-    f = jax.nn.one_hot(expert, E).mean(axis=0)
-    P = probs.mean(axis=0)
+    onehot = jax.nn.one_hot(expert, E)
+    if valid is None:
+        f = onehot.mean(axis=0)
+        P = probs.mean(axis=0)
+    else:
+        v = valid.astype(jnp.float32)[:, None]
+        denom = jnp.maximum(v.sum(), 1.0)
+        f = (onehot * v).sum(axis=0) / denom
+        P = (probs * v).sum(axis=0) / denom
     return E * jnp.sum(f * P)
 
 
@@ -121,9 +133,17 @@ def moe_forward(params, x, *, return_aux: bool = False,
     out = y * gate_top[:, None]
     if not return_aux:
         return out
-    aux = {"balance_loss": load_balance_loss(logits, expert),
-           "expert_fraction": jax.nn.one_hot(expert, E).mean(axis=0)}
+    aux = {"balance_loss": load_balance_loss(logits, expert, valid),
+           "expert_fraction": _expert_fraction(expert, E, valid)}
     return out, aux
+
+
+def _expert_fraction(expert, E: int, valid=None):
+    onehot = jax.nn.one_hot(expert, E)
+    if valid is None:
+        return onehot.mean(axis=0)
+    v = valid.astype(jnp.float32)[:, None]
+    return (onehot * v).sum(axis=0) / jnp.maximum(v.sum(), 1.0)
 
 
 def make_sharded_moe(mesh, *, axis: str = "ep",
@@ -180,8 +200,8 @@ def make_sharded_moe(mesh, *, axis: str = "ep",
             return out
         # every shard holds the FULL gathered logits, so the aux is
         # computed identically everywhere — replicated by construction
-        aux = {"balance_loss": load_balance_loss(logits, expert),
-               "expert_fraction": jax.nn.one_hot(expert, E).mean(axis=0)}
+        aux = {"balance_loss": load_balance_loss(logits, expert, valid),
+               "expert_fraction": _expert_fraction(expert, E, valid)}
         return out, aux
 
     spec = {"router": P(None, axis), "w_in": P(axis),

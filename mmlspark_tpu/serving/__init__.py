@@ -15,11 +15,12 @@ reference's semantics: in-flight requests are replayed if a batch fails
 from .distributed import (DistributedServingServer, DriverRegistry,
                           NativeDistributedServingServer,
                           RegistryClient, ServiceInfo, remote_worker_loop)
-from .server import ServingServer, serving_query
+from .server import ServingServer, bucket_pad, serving_query
 from .udfs import make_reply_udf, send_reply_udf
 from .dsl import read_stream
 
-__all__ = ["DistributedServingServer", "NativeDistributedServingServer",
+__all__ = ["bucket_pad",
+           "DistributedServingServer", "NativeDistributedServingServer",
            "DriverRegistry", "RegistryClient",
            "ServiceInfo", "ServingServer", "remote_worker_loop",
            "serving_query", "make_reply_udf", "send_reply_udf",

@@ -56,6 +56,11 @@ class QuietHTTPServer(ThreadingHTTPServer):
     traceback per flaky client. The reference tolerates these silently
     (``HTTPv2Suite`` flaky-connection test); so do we."""
 
+    # socketserver's default listen backlog is 5: a 16-way client burst
+    # overflows it, dropped SYNs retransmit after ~1 s, and the loaded
+    # tail grows a 1000 ms outlier. The native front listens at 1024.
+    request_queue_size = 128
+
     def handle_error(self, request, client_address):
         import sys
         exc = sys.exc_info()[1]
@@ -68,6 +73,26 @@ class QuietHTTPServer(ThreadingHTTPServer):
 def get_service(name: str) -> "ServingServer":
     """Reference ``HTTPSourceStateHolder.getServer``."""
     return _SERVICES[name]
+
+
+def bucket_pad(xs: np.ndarray) -> tuple[np.ndarray, int]:
+    """Pad a serving batch's leading dim UP to the next power of two;
+    returns ``(padded, real_count)`` — score the padded array, slice
+    results to ``real_count``.
+
+    Why this exists: under ``jax.jit`` every distinct batch shape
+    compiles a separate program, and a dynamic-batching front produces
+    every batch size up to the in-flight count — so each NOVEL size
+    pays a multi-ms (CPU) to multi-100 ms (TPU) compile at request
+    latency. Measured here: a 16-way loaded p99 of ~96 ms collapses to
+    ~5 ms once shapes stop being novel. Buckets bound the program count
+    to log2(max_batch)."""
+    n = len(xs)
+    b = 1 << max(n - 1, 0).bit_length()
+    if b == n:
+        return xs, n
+    pad = np.zeros((b - n,) + xs.shape[1:], xs.dtype)
+    return np.concatenate([xs, pad]), n
 
 
 @dataclass
@@ -313,13 +338,20 @@ class ServingQuery:
 
 def serving_query(name: str, transform_fn, host: str = "127.0.0.1",
                   port: int = 0, reply_timeout: float = 30.0,
-                  backend: str = "python") -> ServingQuery:
+                  backend: str = "auto") -> ServingQuery:
     """One-call setup: server + query, started.
 
-    ``backend``: ``"python"`` (threaded http.server front), ``"native"``
-    (C++ epoll reactor, ``native_front.py`` — lower tail latency), or
-    ``"auto"`` (native when the toolchain allows, else python).
-    """
+    ``backend``: ``"auto"`` (the DEFAULT: native when the toolchain
+    allows, else python), ``"native"`` (C++ epoll reactor,
+    ``native_front.py``), or ``"python"`` (threaded http.server front).
+    Native is the serving answer under load: request parsing and
+    socket writes stay out of the GIL, so at 16-way closed-loop
+    saturation its p99 measures ~5.8 ms vs the python front's ~8.4 ms
+    (and it sustains ~35% more throughput); single-connection p99s are
+    equal (~1 ms, the reference's continuous-mode figure). Saturated
+    closed-loop latency is conc/throughput by Little's law — sub-ms
+    tails under load need either moderate load or more than one
+    transform executor."""
     cls = ServingServer
     if backend in ("native", "auto"):
         try:

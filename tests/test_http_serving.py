@@ -98,7 +98,7 @@ class TestServing:
                     content_type="application/json")
             return df.with_column("reply", replies)
 
-        q = serving_query("doubler", pipeline)
+        q = serving_query("doubler", pipeline, backend="python")
         host, port = q.server.address
         try:
             assert post(f"http://{host}:{port}/", {"x": 21}) == \
@@ -129,7 +129,7 @@ class TestServing:
                 replies[i] = string_to_response("ok")
             return df.with_column("reply", replies)
 
-        q = serving_query("pathy", pipeline)
+        q = serving_query("pathy", pipeline, backend="python")
         q.server.api_path = "/api/score"
         host, port = q.server.address
         try:
@@ -186,7 +186,7 @@ class TestServing:
                 assert ok
             return None
 
-        q = serving_query("midreply", pipeline)
+        q = serving_query("midreply", pipeline, backend="python")
         host, port = q.server.address
         try:
             assert post(f"http://{host}:{port}/", {"abc": 1})["len"] > 0
@@ -206,7 +206,7 @@ class TestServing:
             replies[:] = [string_to_response("ok") for _ in range(len(df))]
             return df.with_column("reply", replies)
 
-        q = serving_query("flaky", flaky_pipeline)
+        q = serving_query("flaky", flaky_pipeline, backend="python")
         host, port = q.server.address
         try:
             req = urllib.request.Request(f"http://{host}:{port}/",
@@ -221,7 +221,7 @@ class TestServing:
         def always_fails(df):
             raise RuntimeError("permanent failure")
 
-        q = serving_query("broken", always_fails)
+        q = serving_query("broken", always_fails, backend="python")
         host, port = q.server.address
         try:
             req = urllib.request.Request(f"http://{host}:{port}/",
@@ -264,7 +264,8 @@ def test_serving_latency_no_nagle_stall():
                       for _ in range(len(df))]
         return df.with_column("reply", replies)
 
-    query = serving_query("lat", transform, reply_timeout=10.0)
+    query = serving_query("lat", transform, reply_timeout=10.0,
+                          backend="python")
     try:
         conn = http.client.HTTPConnection(*query.server.address,
                                           timeout=5)
@@ -302,7 +303,8 @@ def test_early_disconnect_is_quiet(capfd):
                       for _ in range(len(df))]
         return df.with_column("reply", replies)
 
-    query = serving_query("quiet", slow_transform, reply_timeout=5.0)
+    query = serving_query("quiet", slow_transform, reply_timeout=5.0,
+                          backend="python")
     try:
         s = socket.create_connection(query.server.address, timeout=5)
         s.sendall(b"POST / HTTP/1.1\r\nHost: x\r\nContent-Length: 1\r\n"
@@ -450,3 +452,36 @@ def test_http_transformer_handler_set_after_first_transform():
     t.set("handler", stub)
     second = t.transform(df)["response"][0]
     assert second.status_code == 299   # late-set strategy took effect
+
+
+def test_auto_backend_prefers_native_and_round_trips():
+    """backend="auto" (the default) must pick the native front when the
+    toolchain allows and serve identically; python-front tests above
+    pin backend="python" explicitly so BOTH fronts stay covered."""
+    import json
+
+    import numpy as np
+
+    from mmlspark_tpu.io.http.schema import HTTPResponseData
+    from mmlspark_tpu.native.loader import get_httpfront
+    from mmlspark_tpu.serving import serving_query
+
+    def pipeline(df):
+        replies = np.empty(len(df), object)
+        replies[:] = [HTTPResponseData(
+            status_code=200,
+            entity=json.dumps(len(r.entity or b"")).encode())
+            for r in df["request"]]
+        return df.with_column("reply", replies)
+
+    q = serving_query("autofront", pipeline, reply_timeout=10.0)
+    try:
+        if get_httpfront() is not None:
+            from mmlspark_tpu.serving.native_front import \
+                NativeServingServer
+            assert isinstance(q.server, NativeServingServer)
+        payload = {"v": "xyz"}
+        out = post(f"http://127.0.0.1:{q.server.address[1]}/", payload)
+        assert out == len(json.dumps(payload).encode())
+    finally:
+        q.stop()
