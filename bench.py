@@ -879,7 +879,12 @@ def bench_serving(extras: dict) -> None:
     # tail as a ~50 ms outlier. The max bucket derives from the SAME
     # env knob the loaded rows read, so raising the concurrency cannot
     # reintroduce a novel shape mid-measurement.
-    conc = int(os.environ.get("MMLSPARK_TPU_BENCH_SERVING_CONC", "16"))
+    try:
+        conc = int(os.environ.get("MMLSPARK_TPU_BENCH_SERVING_CONC",
+                                  "16"))
+    except ValueError:
+        conc = 16  # a malformed knob must not cost every serving row
+    conc = max(1, min(conc, 256))
     b = 1
     while b < 2 * max(conc, 16):
         score(jax.device_put(np.zeros((b, 16), np.float32),

@@ -1,0 +1,183 @@
+"""Compressed-audio (MP3/OGG) container parsing for the speech path.
+
+Reference ``cognitive/SpeechToTextSDK.scala:341-346`` (``CompressedStream``):
+the SDK does NOT decode compressed audio locally — it wraps the stream
+with its codec so the recognition service decodes server-side. The
+TPU-native equivalent: sniff the container, walk its FRAME/PAGE
+structure (an MP3 frame or OGG page must never be split mid-unit — a
+receiver cannot resynchronize reliably inside one), chunk on those
+boundaries, and let the caller send chunks with the right Content-Type.
+Frame headers also carry enough timing to stamp Offset/Duration without
+decoding a single sample.
+
+Hand-written parsers over the PUBLISHED container layouts (MPEG audio
+frame header fields; the OGG page header of RFC 3533) — no codec
+libraries involved, nothing is decompressed.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+
+# MPEG audio frame header tables (Layer III). Bitrates in kbit/s; index
+# 0 is "free format" (unsupported here), 15 is invalid.
+_MP3_BITRATES_V1 = (None, 32, 40, 48, 56, 64, 80, 96, 112, 128, 160,
+                    192, 224, 256, 320, None)
+_MP3_BITRATES_V2 = (None, 8, 16, 24, 32, 40, 48, 56, 64, 80, 96, 112,
+                    128, 144, 160, None)
+_MP3_RATES = {3: (44100, 48000, 32000),    # MPEG1  (version bits 11)
+              2: (22050, 24000, 16000),    # MPEG2  (version bits 10)
+              0: (11025, 12000, 8000)}     # MPEG2.5 (version bits 00)
+
+
+@dataclass(frozen=True)
+class AudioUnit:
+    """One indivisible container unit (MP3 frame / OGG page)."""
+    offset: int          # byte offset in the source buffer
+    size: int            # bytes
+    duration_s: float    # decoded duration this unit carries
+
+
+def sniff_audio_format(data: bytes) -> str:
+    """``wav`` | ``mp3`` | ``ogg`` | ``raw`` by container magic (the
+    reference's ``fileType`` sniffing extended to compressed types)."""
+    if data[:4] == b"RIFF":
+        return "wav"
+    if data[:4] == b"OggS":
+        return "ogg"
+    if data[:3] == b"ID3":
+        return "mp3"
+    if len(data) >= 2 and data[0] == 0xFF and (data[1] & 0xE0) == 0xE0:
+        return "mp3"
+    return "raw"
+
+
+def _mp3_frame_at(data: bytes, i: int):
+    """Parse one MPEG frame header at ``i`` → (size, duration_s) or
+    None if the bytes there are not a valid Layer III header."""
+    if i + 4 > len(data) or data[i] != 0xFF or (data[i + 1] & 0xE0) != 0xE0:
+        return None
+    version = (data[i + 1] >> 3) & 0x3          # 3=MPEG1 2=MPEG2 0=2.5
+    layer = (data[i + 1] >> 1) & 0x3            # 1 = Layer III
+    if version == 1 or layer != 1:
+        return None
+    bitrate_idx = (data[i + 2] >> 4) & 0xF
+    rate_idx = (data[i + 2] >> 2) & 0x3
+    padding = (data[i + 2] >> 1) & 0x1
+    if rate_idx == 3:
+        return None
+    bitrates = _MP3_BITRATES_V1 if version == 3 else _MP3_BITRATES_V2
+    kbps = bitrates[bitrate_idx]
+    if kbps is None:
+        return None
+    rate = _MP3_RATES[version][rate_idx]
+    # Layer III: MPEG1 frames carry 1152 samples (coef 144 = 1152/8),
+    # MPEG2/2.5 carry 576 (coef 72)
+    coef, samples = (144, 1152) if version == 3 else (72, 576)
+    size = coef * kbps * 1000 // rate + padding
+    if size < 4:
+        return None
+    return size, samples / rate
+
+
+def parse_mp3_units(data: bytes) -> list[AudioUnit]:
+    """Walk the MPEG frame chain (skipping a leading ID3v2 tag) →
+    frame-boundary units with per-frame durations. Raises on buffers
+    with no parseable frame (matching ``parse_wav``'s fail-loud
+    stance)."""
+    i = 0
+    if data[:3] == b"ID3" and len(data) >= 10:
+        # ID3v2 size: 4 sync-safe bytes (7 bits each) after the flags
+        tag = (data[6] << 21) | (data[7] << 14) | (data[8] << 7) | data[9]
+        i = 10 + tag
+    units: list[AudioUnit] = []
+    while i < len(data) - 4:
+        got = _mp3_frame_at(data, i)
+        if got is None:
+            if units:
+                break           # trailing tag/junk after the chain
+            i += 1              # scan for the first sync word
+            continue
+        size, dur = got
+        if i + size > len(data):
+            break               # truncated final frame: drop it
+        units.append(AudioUnit(offset=i, size=size, duration_s=dur))
+        i += size
+    if not units:
+        raise ValueError("no MPEG audio frames found (not an MP3, or "
+                         "free-format/Layer I/II, which are unsupported)")
+    return units
+
+
+def parse_ogg_units(data: bytes,
+                    granule_rate: int | None = None) -> list[AudioUnit]:
+    """Walk OGG pages (RFC 3533 header: capture pattern, granule
+    position, segment table) → page-boundary units. Durations derive
+    from granule-position deltas; the granule clock is codec-defined —
+    48 kHz for Opus (RFC 7845 §4, the default,
+    ``OGG_DEFAULT_GRANULE_RATE``), the stream's own sample rate for
+    Vorbis — pass ``granule_rate`` for non-Opus streams."""
+    rate = granule_rate or OGG_DEFAULT_GRANULE_RATE
+    units: list[AudioUnit] = []
+    i = 0
+    prev_granule = 0
+    while i + 27 <= len(data):
+        if data[i:i + 4] != b"OggS":
+            if units:
+                break
+            raise ValueError("not an OGG stream (no OggS capture "
+                             "pattern at start)")
+        nsegs = data[i + 26]
+        header_len = 27 + nsegs
+        if i + header_len > len(data):
+            break
+        body = sum(data[i + 27:i + 27 + nsegs])
+        size = header_len + body
+        if i + size > len(data):
+            break               # truncated final page
+        granule = int.from_bytes(data[i + 6:i + 14], "little",
+                                 signed=True)
+        dur = 0.0
+        if granule > prev_granule >= 0:
+            dur = (granule - prev_granule) / rate
+            prev_granule = granule
+        elif granule >= 0:
+            prev_granule = granule
+        units.append(AudioUnit(offset=i, size=size, duration_s=dur))
+        i += size
+    if not units:
+        raise ValueError("no OGG pages found")
+    return units
+
+
+# Opus always uses a 48 kHz granule clock (RFC 7845 §4); Vorbis uses
+# its own sample rate — without decoding the id header we take the
+# Opus convention, which is what the speech services stream in practice
+OGG_DEFAULT_GRANULE_RATE = 48000
+
+CONTENT_TYPES = {"mp3": "audio/mpeg", "ogg": "audio/ogg",
+                 "wav": "audio/wav", "raw": "audio/pcm"}
+
+
+def chunk_units(units: list[AudioUnit], max_seconds: float,
+                data: bytes) -> list[tuple[bytes, float, float]]:
+    """Group whole units into transmit chunks of at most
+    ``max_seconds`` decoded audio → ``[(chunk_bytes, offset_s,
+    duration_s)]``. Boundaries always land between units, so every
+    chunk starts on a sync point the service can decode from."""
+    chunks: list[tuple[bytes, float, float]] = []
+    start = 0
+    t0 = 0.0
+    acc = 0.0
+    clock = 0.0
+    for k, u in enumerate(units):
+        if acc > 0 and acc + u.duration_s > max_seconds:
+            end = u.offset
+            chunks.append((data[units[start].offset:end], t0, acc))
+            start, t0, acc = k, clock, 0.0
+        acc += u.duration_s
+        clock += u.duration_s
+    last = units[-1]
+    chunks.append((data[units[start].offset:last.offset + last.size],
+                   t0, acc))
+    return chunks

@@ -178,9 +178,12 @@ class SpeechToTextSDK(SpeechToText):
     sampleRate = Param("sampleRate", "PCM sample rate (raw input)",
                        TC.toInt, default=16000)
     fileType = Param("fileType",
-                     "auto | wav | raw — auto sniffs the RIFF magic "
-                     "(reference fileType/AudioStreams)", TC.toString,
-                     default="auto")
+                     "auto | wav | raw | mp3 | ogg — auto sniffs the "
+                     "container magic (reference fileType/AudioStreams; "
+                     "mp3/ogg stream COMPRESSED with codec Content-Type "
+                     "like the reference's CompressedStream — chunked "
+                     "on frame/page boundaries, never decoded locally)",
+                     TC.toString, default="auto")
     maxSegmentSeconds = Param("maxSegmentSeconds",
                               "hard utterance length cap", TC.toFloat,
                               default=15.0)
@@ -194,15 +197,19 @@ class SpeechToTextSDK(SpeechToText):
         TC.toFloat, default=1.0)
 
     def _recognition_request(self, seg_bytes: bytes, df, row: int,
-                             sample_rate: int):
+                             sample_rate: int,
+                             content_type: str | None = None):
         """One REST recognition request (the SDK's per-utterance service
         hop); sent in bulk through the async client. The Content-Type
         advertises the ACTUAL sample rate (a WAV's own rate may differ
         from the sampleRate param — a mismatch would make the service
-        decode at the wrong speed)."""
+        decode at the wrong speed). Compressed chunks pass their codec
+        ``content_type`` (``audio/mpeg`` / ``audio/ogg``) — the
+        reference's ``CompressedStream`` contract: the SERVICE decodes,
+        the client only labels."""
         from ..io.http.schema import HTTPRequestData
         headers = self._headers(df, row)
-        headers["Content-Type"] = (
+        headers["Content-Type"] = content_type or (
             f"audio/wav; codecs=audio/pcm; samplerate={sample_rate}")
         return HTTPRequestData(url=self._build_url(df, row),
                                method="POST", headers=headers,
@@ -235,16 +242,56 @@ class SpeechToTextSDK(SpeechToText):
         meta = []  # (src_row, status, offset_samples, n_samples, rate)
         prefailed = []  # (src_row, error) rows that never reach the wire
         ftype = self.get("fileType")
-        if ftype not in ("auto", "wav", "raw"):
+        if ftype not in ("auto", "wav", "raw", "mp3", "ogg"):
             raise ValueError(
-                f"fileType must be auto | wav | raw, got {ftype!r}")
+                "fileType must be auto | wav | raw | mp3 | ogg, got "
+                f"{ftype!r}")
+        from .audio_codecs import (CONTENT_TYPES, chunk_units,
+                                   parse_mp3_units, parse_ogg_units,
+                                   sniff_audio_format)
         for i in range(len(df)):
             # batch rows already hold complete audio; PullAudioInputStream
             # remains the API for genuinely incremental sources
             data = bytes(self._resolve("audioData", df, i))
             row_rate = rate
-            if ftype == "wav" or (ftype == "auto"
-                                  and data[:4] == b"RIFF"):
+            sniffed = sniff_audio_format(data) if ftype == "auto" \
+                else ftype
+            if sniffed in ("mp3", "ogg"):
+                # compressed path (reference CompressedStream,
+                # SpeechToTextSDK.scala:341-346): never decoded locally
+                # — chunk on frame/page boundaries so every request
+                # starts at a codec sync point, stamp timing from the
+                # container's own frame durations / granule positions,
+                # and let the service decode. No local VAD (that would
+                # need PCM): chunks are fixed-duration utterances.
+                try:
+                    units = parse_mp3_units(data) if sniffed == "mp3" \
+                        else parse_ogg_units(data)
+                    # a bare MP3 sync word is only 11 bits: raw PCM can
+                    # collide (an int16 sample of -1 starts FF FF). In
+                    # AUTO mode demand a CHAINED frame sequence before
+                    # believing it — noise essentially never parses to
+                    # two back-to-back valid frames
+                    if ftype == "auto" and sniffed == "mp3" \
+                            and data[:3] != b"ID3" and len(units) < 2:
+                        raise ValueError("single unchained frame")
+                except ValueError as e:
+                    if ftype != "auto":
+                        prefailed.append((i, str(e)))
+                        continue
+                    # auto-sniff was a coincidence: fall through to the
+                    # raw-PCM path below, the pre-compressed behavior
+                    sniffed = "raw"
+                else:
+                    for chunk, off_s, dur_s in chunk_units(
+                            units, self.get("maxSegmentSeconds"), data):
+                        requests.append(self._recognition_request(
+                            chunk, df, i, row_rate,
+                            content_type=CONTENT_TYPES[sniffed]))
+                        # rate=1 ⇒ the "sample" unit below IS seconds
+                        meta.append((i, "Success", off_s, dur_s, 1))
+                    continue
+            if sniffed == "wav":
                 try:
                     audio, row_rate = parse_wav(data)
                 except ValueError as e:

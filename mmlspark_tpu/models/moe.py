@@ -43,16 +43,24 @@ def load_balance_loss(logits, expert, valid=None):
     not data."""
     E = logits.shape[-1]
     probs = jax.nn.softmax(logits, axis=-1)
-    onehot = jax.nn.one_hot(expert, E)
+    f = _expert_fraction(expert, E, valid)
     if valid is None:
-        f = onehot.mean(axis=0)
         P = probs.mean(axis=0)
     else:
         v = valid.astype(jnp.float32)[:, None]
-        denom = jnp.maximum(v.sum(), 1.0)
-        f = (onehot * v).sum(axis=0) / denom
-        P = (probs * v).sum(axis=0) / denom
+        P = (probs * v).sum(axis=0) / jnp.maximum(v.sum(), 1.0)
     return E * jnp.sum(f * P)
+
+
+def _expert_fraction(expert, E: int, valid=None):
+    """Fraction of (valid) tokens dispatched to each expert — shared by
+    the balance loss and the aux output so their masking rules cannot
+    diverge."""
+    onehot = jax.nn.one_hot(expert, E)
+    if valid is None:
+        return onehot.mean(axis=0)
+    v = valid.astype(jnp.float32)[:, None]
+    return (onehot * v).sum(axis=0) / jnp.maximum(v.sum(), 1.0)
 
 
 def _expert_positions(expert, E: int, valid=None):
@@ -136,14 +144,6 @@ def moe_forward(params, x, *, return_aux: bool = False,
     aux = {"balance_loss": load_balance_loss(logits, expert, valid),
            "expert_fraction": _expert_fraction(expert, E, valid)}
     return out, aux
-
-
-def _expert_fraction(expert, E: int, valid=None):
-    onehot = jax.nn.one_hot(expert, E)
-    if valid is None:
-        return onehot.mean(axis=0)
-    v = valid.astype(jnp.float32)[:, None]
-    return (onehot * v).sum(axis=0) / jnp.maximum(v.sum(), 1.0)
 
 
 def make_sharded_moe(mesh, *, axis: str = "ep",

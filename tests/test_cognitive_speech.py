@@ -44,8 +44,12 @@ def speech_api():
         def do_POST(self):
             n = int(self.headers.get("Content-Length", 0))
             body = self.rfile.read(n) if n else b""
+            ct = self.headers.get("Content-Type", "")
+            txt = f"heard {len(body)} bytes"
+            if not ct.startswith("audio/wav"):
+                txt += f" as {ct}"   # compressed path: codec label
             out = {"RecognitionStatus": "Success",
-                   "DisplayText": f"heard {len(body)} bytes",
+                   "DisplayText": txt,
                    "Offset": 0, "Duration": 0}
             if self.path.startswith("/transcribe"):
                 out["SpeakerId"] = "Guest_0"
@@ -336,10 +340,129 @@ class TestWavContainer:
 
     def test_file_type_validated(self):
         import pytest
-        sdk = SpeechToTextSDK(outputCol="t", fileType="mp3")
+        # mp3/ogg are valid since the CompressedStream equivalent landed
+        sdk = SpeechToTextSDK(outputCol="t", fileType="flac")
         sdk.set("subscriptionKey", "k")
         sdk.setAudioDataCol("audio")
         audio = np.empty(1, object)
         audio[0] = b"\x00\x00"
         with pytest.raises(ValueError, match="fileType"):
             sdk.transform(DataFrame({"audio": audio}))
+
+
+def mp3_frame(bitrate_idx=9, rate_idx=0, fill=0x55):
+    """One valid MPEG1 Layer III frame (128 kbps @ 44.1 kHz by default:
+    144*128000/44100 = 417 bytes, 1152 samples = 26.12 ms)."""
+    hdr = bytes([0xFF, 0xFB, (bitrate_idx << 4) | (rate_idx << 2), 0])
+    size = 144 * 128000 // 44100
+    return hdr + bytes([fill]) * (size - 4)
+
+
+def ogg_page(granule, seq, body=b"\x01" * 100):
+    return (b"OggS" + b"\x00\x00"
+            + int(granule).to_bytes(8, "little")
+            + (1234).to_bytes(4, "little")
+            + int(seq).to_bytes(4, "little")
+            + b"\x00\x00\x00\x00"
+            + bytes([1, len(body)]) + body)
+
+
+class TestCompressedAudio:
+    """MP3/OGG streaming without local decode (reference
+    CompressedStream, SpeechToTextSDK.scala:341-346): container frames
+    parsed for boundaries + timing, chunks labeled with their codec."""
+
+    def test_mp3_frame_walk_and_id3_skip(self):
+        from mmlspark_tpu.cognitive.audio_codecs import parse_mp3_units
+        frames = b"".join(mp3_frame() for _ in range(10))
+        units = parse_mp3_units(frames)
+        assert len(units) == 10
+        assert all(u.size == 417 for u in units)
+        assert abs(units[0].duration_s - 1152 / 44100) < 1e-9
+        # ID3v2 tag (sync-safe size 200) is skipped, chain still found
+        id3 = b"ID3\x04\x00\x00" + bytes([0, 0, 200 >> 7, 200 & 0x7F]) \
+            + b"\x00" * 200
+        assert len(parse_mp3_units(id3 + frames)) == 10
+        # truncated final frame is dropped, not mis-parsed
+        assert len(parse_mp3_units(frames[:-50])) == 9
+        with pytest.raises(ValueError, match="no MPEG"):
+            parse_mp3_units(b"\x00" * 1000)
+
+    def test_ogg_page_walk_and_granule_timing(self):
+        from mmlspark_tpu.cognitive.audio_codecs import parse_ogg_units
+        pages = b"".join(ogg_page(4800 * (i + 1), i) for i in range(5))
+        units = parse_ogg_units(pages)
+        assert len(units) == 5
+        # granule clock is 48 kHz: 4800-granule steps = 0.1 s pages
+        assert all(abs(u.duration_s - 0.1) < 1e-9 for u in units[1:])
+        with pytest.raises(ValueError, match="not an OGG"):
+            parse_ogg_units(b"junk" * 100)
+
+    def test_chunks_respect_frame_boundaries(self):
+        from mmlspark_tpu.cognitive.audio_codecs import (chunk_units,
+                                                         parse_mp3_units)
+        data = b"".join(mp3_frame() for _ in range(10))
+        units = parse_mp3_units(data)
+        chunks = chunk_units(units, 0.06, data)  # 2 frames ≈ 0.052 s
+        assert len(chunks) == 5
+        for k, (blob, off_s, dur_s) in enumerate(chunks):
+            assert len(blob) == 2 * 417          # whole frames only
+            assert blob[:2] == b"\xff\xfb"       # starts on a sync word
+            assert abs(off_s - k * 2 * 1152 / 44100) < 1e-6
+            assert abs(dur_s - 2 * 1152 / 44100) < 1e-6
+        # chunk bytes reassemble the original stream exactly
+        assert b"".join(c[0] for c in chunks) == data
+
+    def test_sdk_streams_mp3_with_codec_content_type(self, speech_api):
+        sdk = SpeechToTextSDK(url=f"{speech_api}/stt", outputCol="text",
+                              maxSegmentSeconds=0.06)
+        sdk.set("subscriptionKey", "k")
+        sdk.setAudioDataCol("audioData")
+        audio = np.empty(1, object)
+        audio[:] = [b"".join(mp3_frame() for _ in range(4))]
+        out = sdk.transform(DataFrame({"audioData": audio}))
+        rows = out["text"]
+        assert len(rows) == 2                    # 2 frames per chunk
+        for k, r in enumerate(rows):
+            assert r["RecognitionStatus"] == "Success"
+            assert r["DisplayText"].endswith("as audio/mpeg")
+            assert "834 bytes" in r["DisplayText"]   # 2 whole frames
+            want_off = int(k * 2 * 1152 / 44100 * 10_000_000)
+            assert abs(r["Offset"] - want_off) <= 1
+        # ogg rides the same path with its own label
+        audio[:] = [b"".join(ogg_page(4800 * (i + 1), i)
+                             for i in range(3))]
+        rows = sdk.transform(DataFrame({"audioData": audio}))["text"]
+        assert all(r["DisplayText"].endswith("as audio/ogg")
+                   for r in rows)
+
+    def test_bad_compressed_row_prefails_not_batch(self, speech_api):
+        sdk = SpeechToTextSDK(url=f"{speech_api}/stt", outputCol="text",
+                              fileType="mp3")
+        sdk.set("subscriptionKey", "k")
+        sdk.setAudioDataCol("audioData")
+        audio = np.empty(2, object)
+        audio[:] = [b"\x00" * 64, b"".join(mp3_frame()
+                                           for _ in range(2))]
+        out = sdk.transform(DataFrame({"audioData": audio}))
+        by_src = {int(s): r for s, r in zip(out["sourceRow"],
+                                            out["text"])}
+        assert by_src[0]["RecognitionStatus"] == "Error"
+        assert by_src[1]["RecognitionStatus"] == "Success"
+
+    def test_raw_pcm_sync_collision_falls_back(self, speech_api):
+        """Raw PCM whose first int16 sample is -1 starts with FF FF —
+        a valid MP3 sync pattern. Auto mode must still transcribe it as
+        the raw audio it is (chained-frame requirement), not error or
+        mislabel it audio/mpeg."""
+        sdk = SpeechToTextSDK(url=f"{speech_api}/stt", outputCol="text")
+        sdk.set("subscriptionKey", "k")
+        sdk.setAudioDataCol("audio")
+        pcm = np.concatenate([tone(0.4), silence(0.4)])
+        pcm[0] = -1                      # bytes FF FF: MP3 sync collide
+        audio = np.empty(1, object)
+        audio[0] = pcm.tobytes()
+        rows = list(sdk.transform(DataFrame({"audio": audio}))["text"])
+        assert len(rows) == 1
+        assert rows[0]["RecognitionStatus"] == "Success"
+        assert "as audio/" not in rows[0]["DisplayText"]  # raw PCM path
