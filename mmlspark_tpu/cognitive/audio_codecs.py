@@ -117,6 +117,15 @@ def parse_ogg_units(data: bytes,
     48 kHz for Opus (RFC 7845 §4, the default,
     ``OGG_DEFAULT_GRANULE_RATE``), the stream's own sample rate for
     Vorbis — pass ``granule_rate`` for non-Opus streams."""
+    if granule_rate is None and data[:4] == b"OggS" and len(data) > 27:
+        # the codec id header rides the first page's body IN THE CLEAR:
+        # Vorbis ("\x01vorbis": sample rate at bytes 12-16 LE) clocks
+        # granules at its own sample rate; Opus ("OpusHead") always at
+        # 48 kHz (RFC 7845 §4). Still zero decoding — header fields only.
+        ns = data[26]
+        body = data[27 + ns:27 + ns + sum(data[27:27 + ns])]
+        if body[:7] == b"\x01vorbis" and len(body) >= 16:
+            granule_rate = int.from_bytes(body[12:16], "little") or None
     rate = granule_rate or OGG_DEFAULT_GRANULE_RATE
     units: list[AudioUnit] = []
     i = 0
@@ -160,12 +169,15 @@ CONTENT_TYPES = {"mp3": "audio/mpeg", "ogg": "audio/ogg",
 
 
 def chunk_units(units: list[AudioUnit], max_seconds: float,
-                data: bytes) -> list[tuple[bytes, float, float]]:
+                data: bytes) -> list[tuple[bytes, float, float,
+                                           int, int]]:
     """Group whole units into transmit chunks of at most
     ``max_seconds`` decoded audio → ``[(chunk_bytes, offset_s,
-    duration_s)]``. Boundaries always land between units, so every
-    chunk starts on a sync point the service can decode from."""
-    chunks: list[tuple[bytes, float, float]] = []
+    duration_s, first_unit, end_unit)]``. Boundaries always land
+    between units, so every chunk starts on a sync point the service
+    can decode from; the unit span lets callers slice GROWING prefixes
+    of a chunk (intermediate hypotheses) on those same boundaries."""
+    chunks: list = []
     start = 0
     t0 = 0.0
     acc = 0.0
@@ -173,11 +185,12 @@ def chunk_units(units: list[AudioUnit], max_seconds: float,
     for k, u in enumerate(units):
         if acc > 0 and acc + u.duration_s > max_seconds:
             end = u.offset
-            chunks.append((data[units[start].offset:end], t0, acc))
+            chunks.append((data[units[start].offset:end], t0, acc,
+                           start, k))
             start, t0, acc = k, clock, 0.0
         acc += u.duration_s
         clock += u.duration_s
     last = units[-1]
     chunks.append((data[units[start].offset:last.offset + last.size],
-                   t0, acc))
+                   t0, acc, start, len(units)))
     return chunks

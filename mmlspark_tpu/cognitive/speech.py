@@ -283,11 +283,33 @@ class SpeechToTextSDK(SpeechToText):
                     # raw-PCM path below, the pre-compressed behavior
                     sniffed = "raw"
                 else:
-                    for chunk, off_s, dur_s in chunk_units(
+                    ct = CONTENT_TYPES[sniffed]
+                    for chunk, off_s, dur_s, u0, u1 in chunk_units(
                             units, self.get("maxSegmentSeconds"), data):
+                        if stream_partials:
+                            # growing PREFIXES of the chunk, sliced on
+                            # unit boundaries (every prefix starts at a
+                            # codec sync point and ends on a frame edge
+                            # — still nothing decoded locally)
+                            step = max(
+                                self.get("intermediateInterval"), 0.03)
+                            next_at, run = step, 0.0
+                            for j in range(u0, u1 - 1):
+                                run += units[j].duration_s
+                                if run < next_at:
+                                    continue
+                                next_at = run + step
+                                u = units[j]
+                                requests.append(
+                                    self._recognition_request(
+                                        data[units[u0].offset:
+                                             u.offset + u.size],
+                                        df, i, row_rate,
+                                        content_type=ct))
+                                meta.append((i, "Recognizing", off_s,
+                                             run, 1))
                         requests.append(self._recognition_request(
-                            chunk, df, i, row_rate,
-                            content_type=CONTENT_TYPES[sniffed]))
+                            chunk, df, i, row_rate, content_type=ct))
                         # rate=1 ⇒ the "sample" unit below IS seconds
                         meta.append((i, "Success", off_s, dur_s, 1))
                     continue

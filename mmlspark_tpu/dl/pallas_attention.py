@@ -135,6 +135,71 @@ def _flash_kernel_lse(q_ref, k_ref, v_ref, mask_ref, off_ref, o_ref,
         lse_ref[0] = m_scr[:, :1] + jnp.log(l)
 
 
+def _flash_kernel_causal_packed(q_ref, k_ref, v_ref, mask_ref, off_ref,
+                                o_ref, *maybe_lse, scale: float,
+                                bk: int, with_lse: bool):
+    """Causal forward with REAL grid pruning: one grid cell per
+    (bh, q-block), K/V resident whole-row in VMEM, and a
+    ``fori_loop`` over ONLY the reachable k-blocks — above-diagonal
+    blocks are never fetched, never launched, never masked. The
+    streaming-grid kernel (``_flash_kernel``) skips their MXU work via
+    ``pl.when`` but still runs their grid slots and block copies; this
+    kernel removes the slots themselves (the true ~2x causal saving),
+    at the cost of requiring K/V to fit VMEM — the fallback below keeps
+    the streaming path for longer T (and the sharded ring/ulysses
+    variants shrink per-device T long before that matters)."""
+    lse_ref = maybe_lse[0] if with_lse else None
+    qb = pl.program_id(1)
+    bq = q_ref.shape[1]
+    nk = k_ref.shape[1] // bk
+    # reachable bound from GLOBAL positions (traced ring offsets ride
+    # off_ref exactly as in the streaming kernel)
+    last_q = off_ref[0, 0] + qb * bq + bq - 1
+    n_reach = jnp.clip((last_q - off_ref[0, 1]) // bk + 1, 0, nk)
+
+    q = q_ref[0]                                   # [BQ, D]
+
+    def body(kb, carry):
+        m_prev, l_prev, acc = carry
+        k = k_ref[0, pl.ds(kb * bk, bk), :]        # [BK, D]
+        v = v_ref[0, pl.ds(kb * bk, bk), :]
+        s = jax.lax.dot_general(
+            q, k, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32) * scale
+        valid = (mask_ref[0, pl.ds(kb * bk, bk)] != 0)[None, :]
+        qpos = off_ref[0, 0] + qb * bq + jax.lax.broadcasted_iota(
+            jnp.int32, s.shape, 0)
+        kpos = off_ref[0, 1] + kb * bk + jax.lax.broadcasted_iota(
+            jnp.int32, s.shape, 1)
+        allowed = valid & (kpos <= qpos)
+        s = jnp.where(allowed, s, _NEG)
+        m_new = jnp.maximum(m_prev, jnp.max(s, axis=-1, keepdims=True))
+        p = jnp.exp(s - m_new)
+        p = jnp.where(allowed, p, 0.0)
+        corr = jnp.exp(m_prev - m_new)
+        l_new = l_prev * corr + jnp.sum(p, axis=-1, keepdims=True)
+        acc = acc * corr + jax.lax.dot_general(
+            p.astype(v.dtype), v, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+        return m_new, l_new, acc
+
+    D = q_ref.shape[2]
+    m0 = jnp.full((bq, 1), _NEG, jnp.float32)
+    l0 = jnp.zeros((bq, 1), jnp.float32)
+    acc0 = jnp.zeros((bq, D), jnp.float32)
+    m, l, acc = jax.lax.fori_loop(0, n_reach, body, (m0, l0, acc0))
+    l_safe = jnp.maximum(l, 1e-35)
+    o_ref[0] = (acc / l_safe).astype(o_ref.dtype)
+    if with_lse:
+        lse_ref[0] = m + jnp.log(l_safe)
+
+
+# K+V whole-row VMEM budget for the packed causal kernel; beyond this
+# the streaming grid takes over (VMEM is ~16 MiB/core — leave room for
+# q/o blocks, scratch, and double-buffering)
+_PACKED_KV_BYTES = 4 * 1024 * 1024
+
+
 def _flash_pack(q, k, v, key_mask, block_q, block_k):
     """Shared padding/reshape for forward and backward kernels."""
     B, H, T, D = q.shape
@@ -164,6 +229,43 @@ def _flash_forward(q, k, v, key_mask, offs=None, *, block_q: int = 256,
     nq, nk = (T + qp) // bq, (T + kp) // bk
     if offs is None:
         offs = jnp.zeros((1, 2), jnp.int32)
+    kv_bytes = 2 * (T + kp) * D * k.dtype.itemsize
+    if causal and kv_bytes <= _PACKED_KV_BYTES:
+        # pruned-grid causal path: grid cells exist only per q-block;
+        # reachable k-blocks iterate INSIDE the cell, so above-diagonal
+        # work is never launched at all
+        packed_specs = [
+            pl.BlockSpec((1, bq, D), lambda b, iq: (b, iq, 0)),
+            pl.BlockSpec((1, T + kp, D), lambda b, iq: (b, 0, 0)),
+            pl.BlockSpec((1, T + kp, D), lambda b, iq: (b, 0, 0)),
+            pl.BlockSpec((1, T + kp), lambda b, iq: (b, 0)),
+            pl.BlockSpec((1, 2), lambda b, iq: (0, 0)),
+        ]
+        o_spec = pl.BlockSpec((1, bq, D), lambda b, iq: (b, iq, 0))
+        o_shape = jax.ShapeDtypeStruct((B * H, T + qp, D), v.dtype)
+        params = pltpu.CompilerParams(
+            dimension_semantics=("parallel", "parallel"))
+        kern = functools.partial(_flash_kernel_causal_packed,
+                                 scale=scale, bk=bk, with_lse=with_lse)
+        if with_lse:
+            out, lse = pl.pallas_call(
+                kern, grid=(B * H, nq), in_specs=packed_specs,
+                out_specs=(o_spec,
+                           pl.BlockSpec((1, bq, 1),
+                                        lambda b, iq: (b, iq, 0))),
+                out_shape=(o_shape,
+                           jax.ShapeDtypeStruct((B * H, T + qp, 1),
+                                                jnp.float32)),
+                compiler_params=params, interpret=interpret,
+            )(qf, kf, vf, mask, offs)
+            return (out[:, :T].reshape(B, H, T, D),
+                    lse[:, :T, 0].reshape(B, H, T))
+        out = pl.pallas_call(
+            kern, grid=(B * H, nq), in_specs=packed_specs,
+            out_specs=o_spec, out_shape=o_shape,
+            compiler_params=params, interpret=interpret,
+        )(qf, kf, vf, mask, offs)
+        return out[:, :T].reshape(B, H, T, D)
     in_specs = [
         pl.BlockSpec((1, bq, D), lambda b, iq, ik: (b, iq, 0)),
         pl.BlockSpec((1, bk, D), lambda b, iq, ik: (b, ik, 0)),
@@ -526,9 +628,12 @@ def flash_attention(q, k, v, key_mask=None, *, block_q: int = 256,
     ``causal``: lower-triangular masking from GLOBAL positions
     (``offset + index``; offsets may be traced — sequence-sharded
     callers pass shard coordinates), fused into both forward and
-    backward kernels. Grid cells entirely above the diagonal skip
-    their MXU work (``pl.when`` on a per-cell reachability predicate)
-    — causal runs ~half the compute of non-causal at long T.
+    backward kernels. The forward PRUNES the grid outright when K/V
+    fit the VMEM budget (one cell per q-block, an inner loop over only
+    reachable k-blocks — above-diagonal work never launches); longer
+    sequences and the backward fall back to the streaming grid with a
+    ``pl.when`` reachability skip. Causal approaches half the
+    non-causal compute at long T (``bench.py`` flashcausal row).
     """
     if interpret is None:
         interpret = target_platform() not in ("tpu", "axon")

@@ -405,7 +405,8 @@ class TestCompressedAudio:
         units = parse_mp3_units(data)
         chunks = chunk_units(units, 0.06, data)  # 2 frames ≈ 0.052 s
         assert len(chunks) == 5
-        for k, (blob, off_s, dur_s) in enumerate(chunks):
+        for k, (blob, off_s, dur_s, u0, u1) in enumerate(chunks):
+            assert (u0, u1) == (2 * k, 2 * k + 2)
             assert len(blob) == 2 * 417          # whole frames only
             assert blob[:2] == b"\xff\xfb"       # starts on a sync word
             assert abs(off_s - k * 2 * 1152 / 44100) < 1e-6
@@ -466,3 +467,35 @@ class TestCompressedAudio:
         assert len(rows) == 1
         assert rows[0]["RecognitionStatus"] == "Success"
         assert "as audio/" not in rows[0]["DisplayText"]  # raw PCM path
+
+    def test_vorbis_granule_clock_sniffed(self):
+        """A Vorbis id header in the first page switches the granule
+        clock to the stream's own sample rate (no decoding — header
+        fields only); Opus/unknown streams keep the 48 kHz default."""
+        from mmlspark_tpu.cognitive.audio_codecs import parse_ogg_units
+        ident = (b"\x01vorbis" + b"\x00\x00\x00\x00" + b"\x02"
+                 + (44100).to_bytes(4, "little") + b"\x00" * 16)
+        pages = ogg_page(0, 0, body=ident) + b"".join(
+            ogg_page(44100 * (i + 1), i + 1) for i in range(3))
+        units = parse_ogg_units(pages)
+        assert all(abs(u.duration_s - 1.0) < 1e-9 for u in units[1:])
+
+    def test_compressed_partials_on_frame_boundaries(self, speech_api):
+        """streamIntermediateResults works for compressed rows too:
+        growing chunk prefixes sliced on frame boundaries."""
+        sdk = SpeechToTextSDK(url=f"{speech_api}/stt", outputCol="text",
+                              maxSegmentSeconds=0.3)
+        sdk.set("subscriptionKey", "k")
+        sdk.set("streamIntermediateResults", True)
+        sdk.set("intermediateInterval", 0.05)  # ~every 2 frames
+        sdk.setAudioDataCol("audio")
+        audio = np.empty(1, object)
+        audio[0] = b"".join(mp3_frame() for _ in range(8))
+        rows = list(sdk.transform(DataFrame({"audio": audio}))["text"])
+        statuses = [r["RecognitionStatus"] for r in rows]
+        assert statuses[-1] == "Success"
+        assert statuses.count("Recognizing") >= 2
+        # every partial is whole frames, growing monotonically
+        sizes = [int(r["DisplayText"].split()[1]) for r in rows]
+        assert all(s % 417 == 0 for s in sizes)
+        assert sizes == sorted(sizes)
