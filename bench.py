@@ -635,6 +635,13 @@ def bench_flash_causal(extras: dict) -> None:
 
     from mmlspark_tpu.dl.pallas_attention import flash_attention
 
+    if _PLATFORM not in ("tpu", "axon"):
+        # off-TPU the kernel would crawl through the Pallas interpreter
+        # at T=2048 and burn the whole watchdog (same reasoning as the
+        # encoder bench's dense-path fallback)
+        extras["flash_causal_skipped"] = f"no accelerator ({_PLATFORM})"
+        return
+
     rng = np.random.default_rng(0)
     B, H, T, D = 2, 8, 2048, 64
     q, k, v = (jnp.asarray(rng.normal(size=(B, H, T, D)), jnp.bfloat16)

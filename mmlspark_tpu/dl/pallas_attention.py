@@ -47,7 +47,9 @@ def _allowed_2d(mask_ref, off_ref, shape, qb_idx, kb_idx, causal: bool):
     per-call offset (``off_ref`` [1, 2] = (q_off, k_off), traced: ring
     attention passes each step's shard offsets) + block index × block
     size + in-block iota on each axis."""
-    valid = (mask_ref[0, :] != 0)[None, :]
+    # 2-D [1, BK] load — a 1-D vector load here crashes the Mosaic
+    # layout pass ("arr.size() >= layout_rank")
+    valid = mask_ref[0] != 0
     if not causal:
         return jnp.broadcast_to(valid, shape)
     qpos = off_ref[0, 0] + qb_idx * shape[0] + jax.lax.broadcasted_iota(
@@ -166,7 +168,7 @@ def _flash_kernel_causal_packed(q_ref, k_ref, v_ref, mask_ref, off_ref,
         s = jax.lax.dot_general(
             q, k, (((1,), (1,)), ((), ())),
             preferred_element_type=jnp.float32) * scale
-        valid = (mask_ref[0, pl.ds(kb * bk, bk)] != 0)[None, :]
+        valid = mask_ref[0, :, pl.ds(kb * bk, bk)] != 0   # [1, BK]
         qpos = off_ref[0, 0] + qb * bq + jax.lax.broadcasted_iota(
             jnp.int32, s.shape, 0)
         kpos = off_ref[0, 1] + kb * bk + jax.lax.broadcasted_iota(
@@ -210,10 +212,14 @@ def _flash_pack(q, k, v, key_mask, block_q, block_k):
     qf = jnp.pad(q.reshape(B * H, T, D), ((0, 0), (0, qp), (0, 0)))
     kf = jnp.pad(k.reshape(B * H, T, D), ((0, 0), (0, kp), (0, 0)))
     vf = jnp.pad(v.reshape(B * H, T, D), ((0, 0), (0, kp), (0, 0)))
-    # [B, T] bool → [B*H, Tk] i8, padded keys invalid
+    # [B, T] bool → [B*H, 1, Tk] i8, padded keys invalid. The unit
+    # middle axis is load-bearing on TPU: Mosaic requires a block's
+    # last-two dims to be (8k, 128k) or match the array, and a
+    # per-(b,h) mask row can only block as (1, bk) if the sublane axis
+    # is a real size-1 array dim.
     mask = jnp.broadcast_to(key_mask[:, None, :], (B, H, T)) \
         .reshape(B * H, T).astype(jnp.int8)
-    mask = jnp.pad(mask, ((0, 0), (0, kp)))
+    mask = jnp.pad(mask, ((0, 0), (0, kp)))[:, None, :]
     return qf, kf, vf, mask, (B, H, T, D, bq, bk, qp, kp)
 
 
@@ -238,7 +244,7 @@ def _flash_forward(q, k, v, key_mask, offs=None, *, block_q: int = 256,
             pl.BlockSpec((1, bq, D), lambda b, iq: (b, iq, 0)),
             pl.BlockSpec((1, T + kp, D), lambda b, iq: (b, 0, 0)),
             pl.BlockSpec((1, T + kp, D), lambda b, iq: (b, 0, 0)),
-            pl.BlockSpec((1, T + kp), lambda b, iq: (b, 0)),
+            pl.BlockSpec((1, 1, T + kp), lambda b, iq: (b, 0, 0)),
             pl.BlockSpec((1, 2), lambda b, iq: (0, 0)),
         ]
         o_spec = pl.BlockSpec((1, bq, D), lambda b, iq: (b, iq, 0))
@@ -270,7 +276,7 @@ def _flash_forward(q, k, v, key_mask, offs=None, *, block_q: int = 256,
         pl.BlockSpec((1, bq, D), lambda b, iq, ik: (b, iq, 0)),
         pl.BlockSpec((1, bk, D), lambda b, iq, ik: (b, ik, 0)),
         pl.BlockSpec((1, bk, D), lambda b, iq, ik: (b, ik, 0)),
-        pl.BlockSpec((1, bk), lambda b, iq, ik: (b, ik)),
+        pl.BlockSpec((1, 1, bk), lambda b, iq, ik: (b, 0, ik)),
         pl.BlockSpec((1, 2), lambda b, iq, ik: (0, 0)),
     ]
     o_spec = pl.BlockSpec((1, bq, D), lambda b, iq, ik: (b, iq, 0))
@@ -432,7 +438,7 @@ def _flash_backward(q, k, v, key_mask, o, lse, g, dlse=None,
             pl.BlockSpec((1, bq, D), lambda b, iq, ik: (b, iq, 0)),
             pl.BlockSpec((1, bk, D), lambda b, iq, ik: (b, ik, 0)),
             pl.BlockSpec((1, bk, D), lambda b, iq, ik: (b, ik, 0)),
-            pl.BlockSpec((1, bk), lambda b, iq, ik: (b, ik)),
+            pl.BlockSpec((1, 1, bk), lambda b, iq, ik: (b, 0, ik)),
             pl.BlockSpec((1, 2), lambda b, iq, ik: (0, 0)),
             pl.BlockSpec((1, bq, D), lambda b, iq, ik: (b, iq, 0)),
             pl.BlockSpec((1, bq, 1), lambda b, iq, ik: (b, iq, 0)),
@@ -452,7 +458,7 @@ def _flash_backward(q, k, v, key_mask, o, lse, g, dlse=None,
         in_specs=[
             pl.BlockSpec((1, bk, D), lambda b, ik, iq: (b, ik, 0)),
             pl.BlockSpec((1, bk, D), lambda b, ik, iq: (b, ik, 0)),
-            pl.BlockSpec((1, bk), lambda b, ik, iq: (b, ik)),
+            pl.BlockSpec((1, 1, bk), lambda b, ik, iq: (b, 0, ik)),
             pl.BlockSpec((1, 2), lambda b, ik, iq: (0, 0)),
             pl.BlockSpec((1, bq, D), lambda b, ik, iq: (b, iq, 0)),
             pl.BlockSpec((1, bq, D), lambda b, ik, iq: (b, iq, 0)),
