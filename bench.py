@@ -201,6 +201,27 @@ def _watchdog(fn, extras: dict, key: str, timeout_s: float):
     return box.get("result")
 
 
+def _t_block(f, x):
+    """Wall seconds of one blocking call — the null-dispatch floor."""
+    import jax
+    t0 = time.perf_counter()
+    jax.block_until_ready(f(x))
+    return time.perf_counter() - t0
+
+
+def _diff_timed(run_loop, iters, short, reps=2):
+    """Difference two loop lengths: ``run_loop(n)`` -> blocking wall
+    seconds for n chained iterations. Returns per-iteration seconds
+    with the constant per-call overhead (the tunnel's pipeline-fill
+    RTT) cancelled, or None when noise swamps the delta — callers must
+    DISCARD such points (clamping a non-positive delta would publish
+    absurd throughput)."""
+    t_short = min(run_loop(short) for _ in range(reps))
+    t_long = min(run_loop(short + iters) for _ in range(reps))
+    dt = (t_long - t_short) / iters
+    return dt if dt > 0 else None
+
+
 def _mfu_sweep(module, variables, make_input, batches, *, iters=20,
                fallback_flops_per_item=0.0, output_key=None,
                force_fallback_flops=False):
@@ -257,11 +278,10 @@ def _mfu_sweep(module, variables, make_input, batches, *, iters=20,
             for _ in range(3):
                 compiled(x).block_until_ready()
 
-            # difference two loop lengths: an async dispatch loop pays
-            # the tunnel's pipeline-fill RTT (~69 ms banked) once per
-            # BLOCKING call, which at iters=10-20 inflates per-iter
-            # time by several ms and understated every MFU row —
-            # subtracting a short loop cancels the constant
+            # an async dispatch loop pays the tunnel's pipeline-fill
+            # RTT (~69 ms banked) once per BLOCKING call, which at
+            # iters=10-20 inflates per-iter time by several ms and
+            # understated every MFU row — difference it out
             def loop(n):
                 t0 = time.perf_counter()
                 for _ in range(n):
@@ -269,13 +289,12 @@ def _mfu_sweep(module, variables, make_input, batches, *, iters=20,
                 out.block_until_ready()
                 return time.perf_counter() - t0
 
-            n_short = max(iters // 5, 2)
-            t_short = min(loop(n_short), loop(n_short))
-            t_long = min(loop(n_short + iters), loop(n_short + iters))
-            dt = max(t_long - t_short, 1e-9)
+            per_iter = _diff_timed(loop, iters, max(iters // 5, 2))
+            if per_iter is None:
+                continue                  # noise swamped the delta
         except Exception:
             continue
-        ips = batch * iters / dt
+        ips = batch / per_iter
         per_batch[batch] = round(ips, 1)
         mfu = ips / batch * flops_per_batch / V5E_PEAK_BF16_FLOPS
         if ips > best[0]:
@@ -609,20 +628,25 @@ def make_bench_encoder(impl: str):
             state, loss = step(state, xb, yb)     # compile + warm
             jax.block_until_ready(loss)
 
-            # difference two loop lengths (same RTT-cancelling trick
-            # as _mfu_sweep)
-            def loop(n, state):
+            # same RTT-cancelling differencing as _mfu_sweep; the
+            # train state threads through a mutable box so each timed
+            # loop continues from the last
+            box = {"state": state}
+
+            def loop(n):
+                s = box["state"]
                 t0 = time.perf_counter()
                 for _ in range(n):
-                    state, loss = step(state, xb, yb)
+                    s, loss = step(s, xb, yb)
                 jax.block_until_ready(loss)
-                return time.perf_counter() - t0, state
+                box["state"] = s
+                return time.perf_counter() - t0
 
-            iters = 5
-            t_short, state = loop(2, state)
-            t_long, state = loop(2 + iters, state)
+            per_iter = _diff_timed(loop, 5, 2)
+            if per_iter is None:
+                raise RuntimeError("timing noise swamped the delta")
             extras[f"encoder_train_seqs_per_sec_{impl}"] = round(
-                tb * iters / max(t_long - t_short, 1e-9), 1)
+                tb / per_iter, 1)
         except Exception:
             extras[f"error_encoder_train_{impl}"] = \
                 traceback.format_exc()[-500:]
@@ -689,28 +713,27 @@ def bench_flash_causal(extras: dict) -> None:
     # of µs) dwarfs the tunnel's call-to-call RTT JITTER (~0.5-1 ms
     # even after min-of-reps): iters=50 produced negative differences
     def timed(causal, iters=400, base=50, reps=5):
-        def make(n):
-            @jax.jit
-            def chained(q0):
-                def body(qc, _):
-                    return flash_attention(qc, k, v,
-                                           causal=causal), None
-                return jax.lax.scan(body, q0, None, length=n)[0]
-            return chained
+        progs: dict = {}
 
-        f_long, f_short = make(base + iters), make(base)
-        jax.block_until_ready(f_long(q))       # compile + warm
-        jax.block_until_ready(f_short(q))
+        def run_loop(n):
+            f = progs.get(n)
+            if f is None:
+                @jax.jit
+                def chained(q0, _n=n):
+                    def body(qc, _):
+                        return flash_attention(qc, k, v,
+                                               causal=causal), None
+                    return jax.lax.scan(body, q0, None, length=_n)[0]
+                jax.block_until_ready(chained(q))  # compile + warm
+                progs[n] = f = chained
+            t0 = time.perf_counter()
+            jax.block_until_ready(f(q))
+            return time.perf_counter() - t0
 
-        def best(f):
-            times = []
-            for _ in range(reps):
-                t0 = time.perf_counter()
-                jax.block_until_ready(f(q))
-                times.append(time.perf_counter() - t0)
-            return min(times)
-
-        return (best(f_long) - best(f_short)) / iters
+        per_iter = _diff_timed(run_loop, iters, base, reps=reps)
+        if per_iter is None:
+            raise RuntimeError("timing noise swamped the delta")
+        return per_iter
 
     t_full = timed(False)
     t_causal = timed(True)
@@ -794,8 +817,19 @@ def bench_gen(extras: dict) -> None:
     t_one = timed(ids, 1, max_len=L)
     t_full = timed(ids, new + 1, max_len=L)
     per_step = (t_full - t_one) / new
-    t_prefill = max(t_one - per_step, 1e-9)
-    extras["gen_prefill_tokens_per_sec"] = round(B * Tp / t_prefill, 1)
+    # t_one still contains one full blocking-dispatch RTT (the
+    # differencing above only cancels it out of per_step) — measure
+    # the null-dispatch floor explicitly and take it out of the
+    # prefill, which is otherwise a few ms of compute under ~69 ms of
+    # tunnel latency. Discard the row if noise leaves nothing.
+    nul = jax.jit(lambda a: a + 1)
+    z = jnp.zeros((8,), jnp.int32)
+    jax.block_until_ready(nul(z))
+    t_rtt = min(_t_block(nul, z) for _ in range(5))
+    t_prefill = t_one - per_step - t_rtt
+    if t_prefill > 0:
+        extras["gen_prefill_tokens_per_sec"] = round(
+            B * Tp / t_prefill, 1)
     extras["gen_decode_ms_per_step"] = round(per_step * 1000, 3)
     extras["gen_decode_tokens_per_sec"] = round(B / per_step, 1)
     extras["gen_tokens_per_sec"] = round(B * (new + 1) / t_full, 1)

@@ -265,7 +265,7 @@ class TextEncoder(nn.Module):
 
 
 def make_attention_fn(impl: str = "dense", mesh=None, axis: str = "sp",
-                      block_size: int = 512,
+                      block_size: int | None = None,
                       causal: bool = False) -> Callable:
     """Resolve an attention implementation by name.
 
@@ -286,7 +286,8 @@ def make_attention_fn(impl: str = "dense", mesh=None, axis: str = "sp",
     if impl == "blockwise":
         from ..parallel.ring_attention import blockwise_attention
         return lambda q, k, v, m=None: blockwise_attention(
-            q, k, v, block_size=block_size, key_mask=m, causal=causal)
+            q, k, v, block_size=block_size or 512, key_mask=m,
+            causal=causal)
     if impl in ("ring", "ring_flash"):
         from ..parallel.ring_attention import make_ring_attention
         if mesh is None:

@@ -98,6 +98,116 @@ def pipeline_apply(mesh, stage_fn, stacked_params, microbatches,
         check_vma=False)(stacked_params, microbatches, aux)
 
 
+def pipeline_train_1f1b(mesh, stage_fn, loss_fn, stacked_params,
+                        microbatches, targets, *, axis: str = "pp",
+                        aux=None):
+    """One 1F1B training step: (mean loss, stacked param grads).
+
+    The GPipe route (``jax.grad`` through ``pipeline_apply``) stores one
+    activation per tick across all M + S - 1 ticks — O(M) residuals per
+    device. This schedule interleaves: the backward of microbatch m runs
+    at stage s on tick ``m + 2(S-1) - s``, i.e. immediately after the
+    loss for m is available at the last stage, so a stage holds at most
+    2(S-1-s) in-flight activations — O(S), independent of M. Gradients
+    ride a REVERSE ppermute ring in the same ``lax.scan`` that carries
+    activations forward; each tick every stage runs one forward slot and
+    one backward slot (recompute-style ``jax.vjp`` from the saved stage
+    INPUT, so memory stays at the ring buffer). The FLOPs are ~4/3 of
+    the sequential fwd+bwd (the extra forward inside the vjp), the
+    classic 1F1B recompute trade.
+
+    stage_fn(params_i, h[, aux_mb]) -> h'   as in ``pipeline_apply``.
+    loss_fn(h_last, target_mb) -> scalar    (summed over microbatches,
+    returned as the mean over M).
+
+    ``microbatches`` [M, mb, ...] and ``targets`` [M, ...] replicated;
+    ``stacked_params`` stage-major over ``axis``. Returns
+    ``(loss, grads)`` with ``grads`` stacked like ``stacked_params``.
+    """
+    S = int(mesh.shape[axis])
+    M = microbatches.shape[0]
+    T = M + 2 * (S - 1)          # last backward: stage 0, tick M-1+2(S-1)
+    K = max(2 * S, 2)            # activation ring slots (>= 2(S-1)+1)
+
+    def body(params_stacked, xs, ys, aux_xs):
+        params_local = jax.tree.map(lambda p: p[0], params_stacked)
+        stage = jax.lax.axis_index(axis)
+        h0 = jnp.zeros_like(xs[0])
+        ring = jnp.zeros((K,) + xs.shape[1:], xs.dtype)
+        gacc = jax.tree.map(jnp.zeros_like, params_local)
+        loss0 = jnp.zeros((), jnp.float32)
+
+        def fwd(params, h, m):
+            if aux_xs is None:
+                return stage_fn(params, h)
+            return stage_fn(params, h, aux_xs[jnp.clip(m, 0, M - 1)])
+
+        def tick(carry, t):
+            h_in, g_in, ring, gacc, loss = carry
+
+            # ---- forward slot: stage s runs microbatch mf = t - s ----
+            mf = t - stage
+            f_valid = (mf >= 0) & (mf < M)
+            inject = (stage == 0) & f_valid
+            h_cur = jnp.where(inject, xs[jnp.clip(mf, 0, M - 1)], h_in)
+            # save the stage INPUT for the recompute-vjp backward slot
+            ring = jax.lax.cond(
+                f_valid,
+                lambda r: jax.lax.dynamic_update_slice(
+                    r, h_cur[None],
+                    (jnp.clip(mf, 0, M - 1) % K,) + (0,) * h_cur.ndim),
+                lambda r: r, ring)
+            h_out = fwd(params_local, h_cur, mf)
+
+            # ---- backward slot: stage s runs microbatch mb ----------
+            mb_idx = t - 2 * (S - 1) + stage
+            b_valid = (mb_idx >= 0) & (mb_idx < M)
+            m_safe = jnp.clip(mb_idx, 0, M - 1)
+            h_saved = ring[m_safe % K]
+            is_last = stage == S - 1
+
+            # ONE recompute-vjp through the stage from its saved input;
+            # the cotangent is either the locally-computed loss gradient
+            # (last stage — the backward of m shares m's forward tick)
+            # or the cotangent that just arrived on the reverse ring
+            out_saved, vjp = jax.vjp(
+                lambda p, h: fwd(p, h, m_safe), params_local, h_saved)
+            lval, g_loss = jax.value_and_grad(
+                lambda o: loss_fn(o, ys[m_safe]))(out_saved)
+            dp, dh = vjp(jnp.where(is_last, g_loss, g_in))
+            mask = b_valid
+            gacc = jax.tree.map(
+                lambda acc, g: acc + jnp.where(mask, g, 0), gacc, dp)
+            loss = loss + jnp.where(
+                mask & is_last, lval.astype(jnp.float32), 0.0)
+            g_out = jnp.where(mask, dh, 0)
+
+            # ---- ring transport ------------------------------------
+            h_next = jax.lax.ppermute(
+                h_out, axis, [(i, (i + 1) % S) for i in range(S)])
+            g_next = jax.lax.ppermute(
+                g_out, axis, [(i, (i - 1) % S) for i in range(S)])
+            return (h_next, g_next, ring, gacc, loss), None
+
+        g0 = jnp.zeros_like(xs[0])
+        (_, _, _, gacc, loss), _ = jax.lax.scan(
+            tick, (h0, g0, ring, gacc, loss0), jnp.arange(T))
+        # loss lives on the last stage only; grads are per-stage
+        loss = jax.lax.psum(loss, axis) / M
+        return loss, jax.tree.map(lambda g: g[None] / M, gacc)
+
+    in_specs = (P(axis), P(), P(), P())
+    out_specs = (P(), P(axis))
+    if aux is None:
+        return jax.shard_map(
+            lambda p, x, y: body(p, x, y, None), mesh=mesh,
+            in_specs=in_specs[:3], out_specs=out_specs,
+            check_vma=False)(stacked_params, microbatches, targets)
+    return jax.shard_map(
+        body, mesh=mesh, in_specs=in_specs, out_specs=out_specs,
+        check_vma=False)(stacked_params, microbatches, targets, aux)
+
+
 def make_pipeline_mlp(width: int):
     """A uniform-width residual MLP block for pipeline demos/tests:
     params = (W [width, width], b [width])."""

@@ -26,7 +26,7 @@ from .ring_attention import blockwise_attention
 def make_ulysses_attention(mesh: Mesh, axis: str = "sp", *,
                            causal: bool = False,
                            scale: float | None = None,
-                           block_size: int = 512,
+                           block_size: int | None = None,
                            batch_axis: str | None = None,
                            local_impl: str = "blockwise"):
     """Build an all-to-all sequence-parallel attention fn over ``mesh``.
@@ -92,7 +92,7 @@ def make_ulysses_attention(mesh: Mesh, axis: str = "sp", *,
         else:
             out = blockwise_attention(qh, kh, vh, causal=causal,
                                       scale=scale,
-                                      block_size=block_size,
+                                      block_size=block_size or 512,
                                       key_mask=full_mask)
         return heads_to_seq(out)
 

@@ -52,7 +52,7 @@
 #' @param repartition_by_grouping_column keep query groups contiguous (reference :92-101)
 #' @param scan_chunk boosting iterations fused into one device dispatch (lax.scan) when no validation/metrics/delegate observe per-iteration state; 1 disables
 #' @param seed random seed
-#' @param shard_axis_name mesh axis to shard rows over
+#' @param shard_axis_name mesh axis to shard rows over (comma-separated for a hierarchical DCNxICI mesh, e.g. 'slice,dp')
 #' @param skip_drop DART prob of skipping dropout
 #' @param slot_names feature names
 #' @param sparse_feature_count logical feature-space width for sparse input (0 = max index + 1)
