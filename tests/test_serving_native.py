@@ -152,3 +152,45 @@ def test_native_headers_reach_pipeline():
         assert seen.get("Content-Type") == "application/json"
     finally:
         q.stop()
+
+
+def test_loadgen_closed_loop_both_fronts():
+    """The native load generator (loadgen.cpp) must drive a correct
+    closed loop against BOTH fronts: zero errors, sane latencies, and
+    a throughput consistent with conc/latency. This is the client the
+    bench's loaded rows use — a broken parser here would silently bank
+    garbage tails."""
+    from mmlspark_tpu.serving.loadgen import run_load
+
+    payload = json.dumps({"x": 3}).encode()
+    for backend in ("native", "python"):
+        q = serving_query(f"lg-{backend}", doubler, backend=backend)
+        host, port = q.server.address
+        try:
+            r = run_load(host, port, payload, nconn=4, nreq=50,
+                         warmup=5)
+        finally:
+            q.stop()
+        assert r["errors"] == 0, (backend, r)
+        assert 0 < r["p50_ms"] <= r["loaded_p99_ms"], (backend, r)
+        assert r["throughput_rps"] > 50, (backend, r)
+
+
+def test_loadgen_reports_non_200(tmp_path):
+    """Non-200 replies count as errors, latencies still recorded."""
+    from mmlspark_tpu.serving.loadgen import run_load
+
+    def reject(df):
+        replies = np.empty(len(df), object)
+        for i in range(len(df)):
+            replies[i] = string_to_response("no", status_code=503)
+        return df.with_column("reply", replies)
+
+    q = serving_query("lg-reject", reject, backend="python")
+    host, port = q.server.address
+    try:
+        r = run_load(host, port, b"x", nconn=2, nreq=10, warmup=0)
+    finally:
+        q.stop()
+    assert r["errors"] == 20
+    assert r["p50_ms"] > 0
