@@ -23,7 +23,7 @@ from .sharding import (batch_sharding, pad_rows, replicated, shard_batch,
 from .ring_attention import ring_attention, blockwise_attention
 from .ulysses import make_ulysses_attention
 from .pipeline import (pipeline_apply, pipeline_train_1f1b,
-                       make_pipeline_mlp)
+                       pipeline_train_encoder_1f1b, make_pipeline_mlp)
 
 __all__ = [
     "make_ulysses_attention",
@@ -31,5 +31,6 @@ __all__ = [
     "mesh_shape_for", "allgather", "allreduce", "barrier", "psum_scatter",
     "ring_permute", "batch_sharding", "pad_rows", "replicated",
     "shard_batch", "unpad_rows", "ring_attention", "blockwise_attention",
-    "pipeline_apply", "pipeline_train_1f1b", "make_pipeline_mlp",
+    "pipeline_apply", "pipeline_train_1f1b",
+    "pipeline_train_encoder_1f1b", "make_pipeline_mlp",
 ]

@@ -100,7 +100,8 @@ def pipeline_apply(mesh, stage_fn, stacked_params, microbatches,
 
 def pipeline_train_1f1b(mesh, stage_fn, loss_fn, stacked_params,
                         microbatches, targets, *, axis: str = "pp",
-                        aux=None):
+                        aux=None, extra_params=None,
+                        return_input_grads: bool = False):
     """One 1F1B training step: (mean loss, stacked param grads).
 
     The GPipe route (``jax.grad`` through ``pipeline_apply``) stores one
@@ -118,32 +119,53 @@ def pipeline_train_1f1b(mesh, stage_fn, loss_fn, stacked_params,
 
     stage_fn(params_i, h[, aux_mb]) -> h'   as in ``pipeline_apply``.
     loss_fn(h_last, target_mb) -> scalar    (summed over microbatches,
-    returned as the mean over M).
+    returned as the mean over M); with ``extra_params`` the signature
+    becomes ``loss_fn(extra_params, h_last, target_mb)`` — an epilogue
+    (e.g. LN + pooling + head) differentiates INSIDE the loss and its
+    grads come back too.
 
-    ``microbatches`` [M, mb, ...] and ``targets`` [M, ...] replicated;
-    ``stacked_params`` stage-major over ``axis``. Returns
-    ``(loss, grads)`` with ``grads`` stacked like ``stacked_params``.
+    ``microbatches`` [M, mb, ...] replicated; ``targets`` any pytree of
+    [M, ...] leaves (replicated) — indexed per microbatch;
+    ``stacked_params`` stage-major over ``axis``.
+
+    Returns ``(loss, grads)`` with ``grads`` stacked like
+    ``stacked_params``. When ``extra_params`` is given or
+    ``return_input_grads`` is set, returns ``(loss, grads, out)`` where
+    ``out["extra_grads"]`` matches ``extra_params`` and
+    ``out["input_grads"]`` is d(loss)/d(microbatches) — the hook that
+    lets a replicated PROLOGUE (e.g. an embedding) train through its
+    own ``jax.vjp`` outside the pipeline.
     """
     S = int(mesh.shape[axis])
     M = microbatches.shape[0]
     T = M + 2 * (S - 1)          # last backward: stage 0, tick M-1+2(S-1)
     K = max(2 * S, 2)            # activation ring slots (>= 2(S-1)+1)
+    want_out = extra_params is not None or return_input_grads
 
-    def body(params_stacked, xs, ys, aux_xs):
+    def body(params_stacked, xs, ys, aux_xs, extra):
         params_local = jax.tree.map(lambda p: p[0], params_stacked)
         stage = jax.lax.axis_index(axis)
         h0 = jnp.zeros_like(xs[0])
         ring = jnp.zeros((K,) + xs.shape[1:], xs.dtype)
         gacc = jax.tree.map(jnp.zeros_like, params_local)
         loss0 = jnp.zeros((), jnp.float32)
+        eacc0 = jax.tree.map(jnp.zeros_like, extra) \
+            if extra is not None else None
+        dxs0 = jnp.zeros_like(xs) if return_input_grads else None
 
         def fwd(params, h, m):
             if aux_xs is None:
                 return stage_fn(params, h)
             return stage_fn(params, h, aux_xs[jnp.clip(m, 0, M - 1)])
 
+        def loss_at(e, o, m):
+            tgt = jax.tree.map(lambda a: a[m], ys)
+            if extra is None:
+                return loss_fn(o, tgt)
+            return loss_fn(e, o, tgt)
+
         def tick(carry, t):
-            h_in, g_in, ring, gacc, loss = carry
+            h_in, g_in, ring, gacc, loss, eacc, dxs = carry
 
             # ---- forward slot: stage s runs microbatch mf = t - s ----
             mf = t - stage
@@ -172,40 +194,190 @@ def pipeline_train_1f1b(mesh, stage_fn, loss_fn, stacked_params,
             # or the cotangent that just arrived on the reverse ring
             out_saved, vjp = jax.vjp(
                 lambda p, h: fwd(p, h, m_safe), params_local, h_saved)
-            lval, g_loss = jax.value_and_grad(
-                lambda o: loss_fn(o, ys[m_safe]))(out_saved)
+            if extra is not None:
+                lval, (de, g_loss) = jax.value_and_grad(
+                    lambda eo: loss_at(eo[0], eo[1], m_safe))(
+                        (extra, out_saved))
+            else:
+                de = None
+                lval, g_loss = jax.value_and_grad(
+                    lambda o: loss_at(None, o, m_safe))(out_saved)
             dp, dh = vjp(jnp.where(is_last, g_loss, g_in))
             mask = b_valid
             gacc = jax.tree.map(
                 lambda acc, g: acc + jnp.where(mask, g, 0), gacc, dp)
+            if eacc is not None:
+                emask = mask & is_last
+                eacc = jax.tree.map(
+                    lambda acc, g: acc + jnp.where(emask, g, 0),
+                    eacc, de)
             loss = loss + jnp.where(
                 mask & is_last, lval.astype(jnp.float32), 0.0)
             g_out = jnp.where(mask, dh, 0)
+            if dxs is not None:
+                # stage 0's dh IS d(loss)/d(xs[m]) — capture it for the
+                # caller's prologue vjp
+                wmask = mask & (stage == 0)
+                dxs = jax.lax.dynamic_update_slice(
+                    dxs, jnp.where(wmask, dh, dxs[m_safe])[None],
+                    (m_safe,) + (0,) * dh.ndim)
 
             # ---- ring transport ------------------------------------
             h_next = jax.lax.ppermute(
                 h_out, axis, [(i, (i + 1) % S) for i in range(S)])
             g_next = jax.lax.ppermute(
                 g_out, axis, [(i, (i - 1) % S) for i in range(S)])
-            return (h_next, g_next, ring, gacc, loss), None
+            return (h_next, g_next, ring, gacc, loss, eacc, dxs), None
 
         g0 = jnp.zeros_like(xs[0])
-        (_, _, _, gacc, loss), _ = jax.lax.scan(
-            tick, (h0, g0, ring, gacc, loss0), jnp.arange(T))
+        (_, _, _, gacc, loss, eacc, dxs), _ = jax.lax.scan(
+            tick, (h0, g0, ring, gacc, loss0, eacc0, dxs0),
+            jnp.arange(T))
         # loss lives on the last stage only; grads are per-stage
         loss = jax.lax.psum(loss, axis) / M
-        return loss, jax.tree.map(lambda g: g[None] / M, gacc)
+        grads = jax.tree.map(lambda g: g[None] / M, gacc)
+        outs = []
+        if eacc is not None:
+            # epilogue grads exist only on the last stage — share them
+            outs.append(jax.tree.map(
+                lambda g: jax.lax.psum(
+                    jnp.where(stage == S - 1, g, 0), axis) / M, eacc))
+        if dxs is not None:
+            outs.append(jax.lax.psum(
+                jnp.where(stage == 0, dxs, 0), axis) / M)
+        return (loss, grads, *outs)
 
-    in_specs = (P(axis), P(), P(), P())
-    out_specs = (P(), P(axis))
+    n_outs = 2 + (extra_params is not None) + bool(return_input_grads)
+    out_specs = (P(), P(axis)) + (P(),) * (n_outs - 2)
     if aux is None:
-        return jax.shard_map(
-            lambda p, x, y: body(p, x, y, None), mesh=mesh,
-            in_specs=in_specs[:3], out_specs=out_specs,
-            check_vma=False)(stacked_params, microbatches, targets)
-    return jax.shard_map(
-        body, mesh=mesh, in_specs=in_specs, out_specs=out_specs,
-        check_vma=False)(stacked_params, microbatches, targets, aux)
+        res = jax.shard_map(
+            lambda p, x, y, e: body(p, x, y, None, e), mesh=mesh,
+            in_specs=(P(axis), P(), P(), P()), out_specs=out_specs,
+            check_vma=False)(stacked_params, microbatches, targets,
+                             extra_params)
+    else:
+        res = jax.shard_map(
+            body, mesh=mesh, in_specs=(P(axis), P(), P(), P(), P()),
+            out_specs=out_specs, check_vma=False)(
+            stacked_params, microbatches, targets, aux, extra_params)
+    if not want_out:
+        return res[0], res[1]
+    out: dict = {}
+    idx = 2
+    if extra_params is not None:
+        out["extra_grads"] = res[idx]
+        idx += 1
+    if return_input_grads:
+        out["input_grads"] = res[idx]
+    return res[0], res[1], out
+
+
+def _encoder_stages(module, params, N: int, S: int,
+                    num_microbatches: int | None):
+    """Shared stage-splitting for the encoder pipeline paths
+    (``pipeline_encode`` and ``pipeline_train_encoder_1f1b``): checks
+    depth % S, picks the microbatch count, stacks block params
+    stage-major [S, L, ...], and builds the scanning stage_fn —
+    honoring ``module.remat`` (per-block rematerialization) so the
+    memory trade the user opted into survives the pipeline split."""
+    from ..dl.text_encoder import EncoderBlock
+
+    depth = module.depth
+    if depth % S:
+        raise ValueError(f"depth {depth} must divide into {S} stages")
+    L = depth // S
+    if num_microbatches is None:
+        # the largest divisor of N that is <= 2*S (the classic
+        # bubble-amortizing target) — any batch size is accepted
+        M = next(m for m in range(min(2 * S, N), 0, -1) if N % m == 0)
+    else:
+        M = num_microbatches
+        if N % M:
+            raise ValueError(
+                f"batch {N} must divide into num_microbatches={M}; "
+                "pass a divisor of the batch size (or omit it for the "
+                "automatic choice)")
+    block_trees = [params[f"block{i}"] for i in range(depth)]
+    # [S, L, ...] stage-major stack of block parameters
+    stacked = jax.tree.map(
+        lambda *leaves: jnp.stack(
+            [jnp.stack(leaves[s * L:(s + 1) * L]) for s in range(S)]),
+        *block_trees)
+    block_cls = EncoderBlock
+    if getattr(module, "remat", False):
+        import flax.linen as nn
+        block_cls = nn.remat(EncoderBlock)
+    block = block_cls(module.heads, module.mlp_dim, module.width,
+                      attention_fn=module.attention_fn,
+                      dtype=module.dtype)
+
+    def stage_fn(stage_params, h, mask_mb):
+        def one(h, p):
+            return block.apply({"params": p}, h, mask_mb), None
+        return jax.lax.scan(one, h, stage_params)[0]
+
+    return L, M, stacked, stage_fn
+
+
+def pipeline_train_encoder_1f1b(mesh, module, variables, ids, targets,
+                                loss_on_pooled, *,
+                                num_microbatches: int | None = None,
+                                axis: str = "pp"):
+    """One 1F1B training step over a REAL ``TextEncoder``: returns
+    ``(mean loss, grads)`` with ``grads`` matching
+    ``variables["params"]`` exactly — embedding prologue, every block,
+    and the LN epilogue all train, equal to the dense ``jax.grad``
+    (asserted by test).
+
+    Composition: the replicated embedding runs OUTSIDE the pipeline
+    under its own ``jax.vjp`` (fed by the schedule's input cotangents),
+    the depth blocks run as 1F1B stages, and the finalize epilogue +
+    ``loss_on_pooled(pooled, target_mb) -> scalar`` differentiate
+    inside the pipeline's loss slot via ``extra_params``.
+    """
+    S = int(mesh.shape[axis])
+    N, Tn = ids.shape
+    depth = module.depth
+    params = variables["params"]
+    L, M, stacked, stage_fn = _encoder_stages(module, params, N, S,
+                                              num_microbatches)
+    mb = N // M
+
+    # replicated prologue under its own vjp — the pipeline returns
+    # d(loss)/d(block inputs), which this closes over the embedding
+    h, embed_vjp = jax.vjp(
+        lambda p: module.apply({"params": p}, ids, method="embed_ids"),
+        params)
+    key_mask = ids != 0
+
+    def loss_fn(extra, h_tokens, tgt):
+        ids_mb, y_mb = tgt
+        out = module.apply({"params": {"ln": extra["ln"]}}, h_tokens,
+                           ids_mb, method="finalize")
+        return loss_on_pooled(out["pooled"], y_mb)
+
+    h_mb = h.reshape(M, mb, Tn, module.width)
+    mask_mb = key_mask.reshape(M, mb, Tn)
+    ids_mb = ids.reshape(M, mb, Tn)
+    y_mb = jax.tree.map(
+        lambda a: a.reshape((M, mb) + a.shape[1:]), targets)
+
+    loss, stacked_grads, out = pipeline_train_1f1b(
+        mesh, stage_fn, loss_fn, stacked, h_mb, (ids_mb, y_mb),
+        axis=axis, aux=mask_mb, extra_params={"ln": params["ln"]},
+        return_input_grads=True)
+
+    # assemble the full-tree gradient: embedding (through the input
+    # cotangents — already mean-normalized by the schedule), blocks
+    # (unstacked), epilogue LN
+    dx = out["input_grads"].reshape(N, Tn, module.width)
+    grads = dict(embed_vjp(dx)[0])    # embed grads; zeros elsewhere
+    grads["ln"] = jax.tree.map(
+        lambda a, b: a + b, grads["ln"], out["extra_grads"]["ln"])
+    for i in range(depth):
+        grads[f"block{i}"] = jax.tree.map(
+            lambda g, gi=i: g[gi // L, gi % L], stacked_grads)
+    return loss, grads
 
 
 def make_pipeline_mlp(width: int):
@@ -231,48 +403,15 @@ def pipeline_encode(mesh, module, variables, ids, *,
     count (default M = 2·S, the classic bubble-amortizing choice).
     Returns the ``{"tokens", "pooled"}`` dict of the plain forward.
     """
-    from ..dl.text_encoder import EncoderBlock
-
     S = int(mesh.shape[axis])
-    depth = module.depth
-    if depth % S:
-        raise ValueError(f"depth {depth} must divide into {S} stages")
-    L = depth // S
     N, T = ids.shape
-    if num_microbatches is None:
-        # the largest divisor of N that is <= 2*S (the classic
-        # bubble-amortizing target) — any batch size is accepted
-        M = next(m for m in range(min(2 * S, N), 0, -1) if N % m == 0)
-    else:
-        M = num_microbatches
-        if N % M:
-            raise ValueError(
-                f"batch {N} must divide into num_microbatches={M}; "
-                "pass a divisor of the batch size (or omit it for the "
-                "automatic choice)")
+    L, M, stacked, stage_fn = _encoder_stages(
+        module, variables["params"], N, S, num_microbatches)
 
     # string method dispatch so TextEncoder subclasses keep their
     # overridden prologue/epilogue
     h = module.apply(variables, ids, method="embed_ids")
     key_mask = ids != 0
-
-    params = variables["params"]
-    block_trees = [params[f"block{i}"] for i in range(depth)]
-    # [S, L, ...] stage-major stack of block parameters
-    stacked = jax.tree.map(
-        lambda *leaves: jnp.stack(
-            [jnp.stack(leaves[s * L:(s + 1) * L]) for s in range(S)]),
-        *block_trees)
-
-    block = EncoderBlock(module.heads, module.mlp_dim, module.width,
-                         attention_fn=module.attention_fn,
-                         dtype=module.dtype)
-
-    def stage_fn(stage_params, h, mask_mb):
-        def one(h, p):
-            return block.apply({"params": p}, h, mask_mb), None
-        h, _ = jax.lax.scan(one, h, stage_params)
-        return h
 
     mb = N // M
     h_mb = h.reshape(M, mb, T, module.width)
