@@ -22,7 +22,8 @@ from .sharding import (batch_sharding, pad_rows, replicated, shard_batch,
                        unpad_rows)
 from .ring_attention import ring_attention, blockwise_attention
 from .ulysses import make_ulysses_attention
-from .pipeline import (pipeline_apply, pipeline_train_1f1b,
+from .pipeline import (pipeline_apply, pipeline_encode,
+                       pipeline_train_1f1b,
                        pipeline_train_encoder_1f1b, make_pipeline_mlp)
 
 __all__ = [
@@ -31,6 +32,6 @@ __all__ = [
     "mesh_shape_for", "allgather", "allreduce", "barrier", "psum_scatter",
     "ring_permute", "batch_sharding", "pad_rows", "replicated",
     "shard_batch", "unpad_rows", "ring_attention", "blockwise_attention",
-    "pipeline_apply", "pipeline_train_1f1b",
+    "pipeline_apply", "pipeline_encode", "pipeline_train_1f1b",
     "pipeline_train_encoder_1f1b", "make_pipeline_mlp",
 ]
