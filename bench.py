@@ -394,6 +394,47 @@ def bench_resnet(extras: dict) -> float:
                 feat_u8.last_transform_stats
     except Exception:
         extras["error_featurizer"] = traceback.format_exc()[-800:]
+
+    # int8 post-training quantization (models/quantize.py): the v5e
+    # MXU runs int8 at 2x the bf16 rate — measure what that buys the
+    # featurizer's scoring path, with the fidelity number alongside so
+    # the speedup is never quoted without its accuracy cost. Fault-
+    # isolated; skipped off-accelerator (int8 conv on CPU crawls).
+    try:
+        if _PLATFORM not in ("tpu", "axon"):
+            extras["resnet50_int8_skipped"] = \
+                f"no accelerator ({_PLATFORM})"
+        else:
+            from mmlspark_tpu.models.quantize import (
+                quantization_fidelity, quantize_resnet)
+            qf, qp = quantize_resnet(loaded.module, loaded.variables)
+            qp = jax.device_put(qp, jax.devices()[0])
+            q_compiled = jax.jit(qf)
+            xb = jax.device_put(
+                jnp.asarray(rng.normal(size=(batch, 224, 224, 3)),
+                            jnp.float32), jax.devices()[0])
+            jax.block_until_ready(q_compiled(qp, xb))
+
+            def loop(n):
+                t0 = time.perf_counter()
+                for _ in range(n):
+                    out = q_compiled(qp, xb)
+                out.block_until_ready()
+                return time.perf_counter() - t0
+
+            per_iter = _diff_timed(loop, 20, 4)
+            if per_iter is not None:
+                q_ips = batch / per_iter
+                extras["resnet50_int8_images_per_sec"] = round(q_ips, 1)
+                extras["resnet50_int8_vs_bf16"] = round(
+                    q_ips / max(ips, 1e-9), 3)
+            small = np.asarray(rng.normal(size=(8, 224, 224, 3)),
+                               np.float32)
+            extras["resnet50_int8_fidelity_cos"] = round(
+                quantization_fidelity(loaded.module, loaded.variables,
+                                      q_compiled, qp, small), 5)
+    except Exception:
+        extras["error_resnet_int8"] = traceback.format_exc()[-600:]
     return per_batch.get(128, ips)
 
 

@@ -31,7 +31,7 @@ PACKAGES: dict[str, list[str]] = {
     "vw": ["test_vw.py"],
     "dl": ["test_text_encoder.py", "test_image_dl.py", "test_convert.py",
            "test_bert_convert.py", "test_transfer_learning.py",
-           "test_checkpoint_profiling.py",
+           "test_checkpoint_profiling.py", "test_quantize.py",
            "test_parallel.py", "test_pipeline_moe.py",
            "test_sharding_analysis.py", "test_pallas_attention.py"],
     "serving": ["test_http_serving.py", "test_serving_distributed.py",

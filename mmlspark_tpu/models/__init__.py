@@ -8,6 +8,7 @@ forward pass returns every named layer, so ``ImageFeaturizer``'s
 lookup rather than graph surgery.
 """
 
+from .quantize import quantization_fidelity, quantize_resnet
 from .resnet import ResNet, ResNet18, ResNet34, ResNet50, ResNet101
 from .zoo import (ModelSchema, ModelDownloader, get_model,
                   register_model, register_bert_encoder,
@@ -16,4 +17,5 @@ from .zoo import (ModelSchema, ModelDownloader, get_model,
 __all__ = ["ResNet", "ResNet18", "ResNet34", "ResNet50", "ResNet101",
            "ModelSchema", "ModelDownloader", "get_model",
            "register_model", "register_bert_encoder",
-           "register_text_encoder"]
+           "register_text_encoder", "quantize_resnet",
+           "quantization_fidelity"]

@@ -1,0 +1,163 @@
+"""Post-training int8 quantization for the ResNet scoring path.
+
+The v5e MXU runs int8 at twice the bf16 rate (394 TOPS vs 197 TFLOPS),
+and inference-only feature extraction — the reference's north-star
+``ImageFeaturizer`` workload (``image/ImageFeaturizer.scala:40-60``) —
+is exactly the place to spend that: no gradients, BN statistics frozen,
+and the pooled feature is robust to 8-bit weight error.
+
+Scheme (standard w8a8-dynamic):
+- BatchNorm FOLDS into the preceding conv (inference-only identity:
+  ``w' = w·γ/√(σ²+ε)``, ``b' = β − μ·γ/√(σ²+ε)``), so the quantized
+  graph has no normalization ops at all.
+- Weights: per-OUTPUT-CHANNEL symmetric int8 (``s_c = max|w_c|/127``).
+- Activations: per-TENSOR symmetric int8 with a DYNAMIC scale computed
+  on device per batch (one max-reduction — cheap next to the conv).
+- Accumulation in int32, dequantized as ``y·(s_x·s_c) + b`` in f32;
+  residual adds, relu, and pooling stay in f32.
+
+The quantized forward is a plain function over a folded/quantized
+param pytree — not a flax module — so it jits to ONE program with no
+framework overhead. Fidelity vs the f32 model is asserted by test
+(cosine > 0.99 on the pooled features) and reported by the bench row
+next to the speedup.
+"""
+
+from __future__ import annotations
+
+from typing import Any
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+_EPS = 1e-5
+
+
+def _fold(conv_params, bn_params, bn_stats):
+    """Fold a BatchNorm into its preceding bias-free conv."""
+    w = conv_params["kernel"].astype(jnp.float32)      # [kh,kw,ci,co]
+    gamma = bn_params["scale"].astype(jnp.float32)
+    beta = bn_params["bias"].astype(jnp.float32)
+    mean = bn_stats["mean"].astype(jnp.float32)
+    var = bn_stats["var"].astype(jnp.float32)
+    inv = gamma / jnp.sqrt(var + _EPS)
+    return w * inv[None, None, None, :], beta - mean * inv
+
+
+def _quant_w(w):
+    """Per-output-channel symmetric int8: (w_q int8, scale f32[co])."""
+    s = jnp.max(jnp.abs(w), axis=(0, 1, 2)) / 127.0
+    s = jnp.maximum(s, 1e-12)
+    wq = jnp.clip(jnp.round(w / s[None, None, None, :]),
+                  -127, 127).astype(jnp.int8)
+    return wq, s
+
+
+def _qconv(x, wq, s_w, b, *, strides, padding):
+    """int8 conv with dynamic per-tensor activation scale; f32 out."""
+    s_x = jnp.maximum(jnp.max(jnp.abs(x)) / 127.0, 1e-12)
+    xq = jnp.clip(jnp.round(x / s_x), -127, 127).astype(jnp.int8)
+    y = jax.lax.conv_general_dilated(
+        xq, wq, strides, padding,
+        dimension_numbers=("NHWC", "HWIO", "NHWC"),
+        preferred_element_type=jnp.int32)
+    return y.astype(jnp.float32) * (s_x * s_w)[None, None, None, :] \
+        + b[None, None, None, :]
+
+
+_PAD3 = ((1, 1), (1, 1))
+_PAD7 = ((3, 3), (3, 3))
+_PAD0 = ((0, 0), (0, 0))
+
+
+def _block_layout(block_name: str, n_conv: int):
+    """(strides, padding) per conv index for a basic/bottleneck block;
+    the last conv (if beyond the mains) is the 1x1 downsample."""
+    if block_name == "BasicBlock":
+        mains = [(None, _PAD3), ((1, 1), _PAD3)]   # stride on conv 0
+    else:
+        mains = [((1, 1), _PAD0), (None, _PAD3), ((1, 1), _PAD0)]
+    return mains, n_conv > len(mains)
+
+
+def quantize_resnet(module, variables) -> tuple[Any, Any]:
+    """Fold + quantize a fitted/converted ResNet; returns
+    ``(q_forward, qparams)`` with ``q_forward(qparams, images_f32) ->
+    pooled [N, C] f32`` (the ImageFeaturizer feature vector).
+
+    ``module`` must be a ``models.resnet.ResNet``; any of the zoo's
+    ResNet-18/34/50/101 work (both block types)."""
+    params = variables["params"]
+    stats = variables.get("batch_stats", {})
+    block_name = module.block.__name__
+    q: dict = {}
+    w, b = _fold(params["conv_init"], params["bn_init"],
+                 stats["bn_init"])
+    q["conv_init"] = (*_quant_w(w), b)
+
+    n_blocks = sum(module.stage_sizes)
+    blocks = []
+    for i in range(n_blocks):
+        bp = params[f"{block_name}_{i}"]
+        bs = stats[f"{block_name}_{i}"]
+        convs = sorted(k for k in bp if k.startswith("Conv_"))
+        qconvs = []
+        for k in convs:
+            j = k.split("_")[1]
+            w, bias = _fold(bp[k], bp[f"BatchNorm_{j}"],
+                            bs[f"BatchNorm_{j}"])
+            qconvs.append((*_quant_w(w), bias))
+        blocks.append(qconvs)
+    q["blocks"] = blocks
+    # the dense head stays OUT: the featurizer's endpoint of record is
+    # the POOLED vector before it, and carrying unread head params
+    # would cost ~8 MB of device transfer per ResNet-50 for nothing
+
+    stage_sizes = tuple(module.stage_sizes)
+
+    def q_forward(qp, x):
+        x = jnp.asarray(x, jnp.float32)
+        wq, sw, bias = qp["conv_init"]
+        x = jax.nn.relu(_qconv(x, wq, sw, bias, strides=(2, 2),
+                               padding=_PAD7))
+        x = jax.lax.reduce_window(
+            x, -jnp.inf, jax.lax.max, (1, 3, 3, 1), (1, 2, 2, 1),
+            ((0, 0), (1, 1), (1, 1), (0, 0)))
+        idx = 0
+        for i, nb in enumerate(stage_sizes):
+            for j in range(nb):
+                strides = (2, 2) if i > 0 and j == 0 else (1, 1)
+                qconvs = qp["blocks"][idx]
+                mains, has_down = _block_layout(block_name,
+                                                len(qconvs))
+                residual = x
+                y = x
+                for ci, (st, pad) in enumerate(mains):
+                    wq, sw, bias = qconvs[ci]
+                    y = _qconv(y, wq, sw, bias,
+                               strides=st or strides, padding=pad)
+                    if ci < len(mains) - 1:
+                        y = jax.nn.relu(y)
+                if has_down:
+                    wq, sw, bias = qconvs[-1]
+                    residual = _qconv(residual, wq, sw, bias,
+                                      strides=strides, padding=_PAD0)
+                x = jax.nn.relu(y + residual)
+                idx += 1
+        return jnp.mean(x, axis=(1, 2))
+
+    return q_forward, q
+
+
+def quantization_fidelity(module, variables, q_forward, qparams,
+                          images) -> float:
+    """Mean cosine similarity between f32 and int8 pooled features —
+    the number the bench row reports next to the speedup."""
+    ref = module.apply(variables, jnp.asarray(images))["pooled"]
+    got = q_forward(qparams, images)
+    ref = np.asarray(ref, np.float64)
+    got = np.asarray(got, np.float64)
+    num = (ref * got).sum(-1)
+    den = np.linalg.norm(ref, axis=-1) * np.linalg.norm(got, axis=-1)
+    return float((num / np.maximum(den, 1e-12)).mean())

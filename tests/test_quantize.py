@@ -1,0 +1,73 @@
+"""Post-training int8 quantization (models/quantize.py) against the
+f32 ResNet forward."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from mmlspark_tpu.models.quantize import (quantization_fidelity,
+                                          quantize_resnet)
+from mmlspark_tpu.models.resnet import (BasicBlock, BottleneckBlock,
+                                        ResNet)
+
+
+def _build(block, stage_sizes, width=16):
+    module = ResNet(stage_sizes=stage_sizes, block=block, width=width,
+                    num_classes=10, dtype=jnp.float32)
+    rng = np.random.default_rng(0)
+    x0 = jnp.asarray(rng.normal(size=(1, 64, 64, 3)), jnp.float32)
+    variables = module.init(jax.random.PRNGKey(0), x0)
+    # random-init BN stats are mean=0/var=1 and each block's LAST BN
+    # has a zero-init gamma (resnet.py scale_init=zeros) — perturb the
+    # stats AND the scale params so every conv's fold carries real
+    # weight, otherwise those convs quantize an all-zero tensor and
+    # the fidelity assertion under-exercises them
+    prng = np.random.default_rng(1)
+
+    def jitter(a):
+        return a + jnp.asarray(prng.uniform(0.05, 0.3, a.shape),
+                               a.dtype)
+
+    stats = jax.tree.map(jitter, variables["batch_stats"])
+    params = jax.tree_util.tree_map_with_path(
+        lambda path, a: jitter(a)
+        if any(getattr(k, "key", None) == "scale" for k in path)
+        else a,
+        variables["params"])
+    return module, {"params": params, "batch_stats": stats}
+
+
+@pytest.mark.parametrize("block,sizes", [
+    (BasicBlock, (1, 1)),
+    (BottleneckBlock, (1, 1, 1)),
+])
+def test_fidelity_both_block_types(block, sizes):
+    module, variables = _build(block, sizes)
+    qf, qp = quantize_resnet(module, variables)
+    rng = np.random.default_rng(2)
+    images = rng.normal(size=(4, 64, 64, 3)).astype(np.float32)
+    cos = quantization_fidelity(module, variables, qf, qp, images)
+    assert cos > 0.99, cos
+
+
+def test_weights_are_int8():
+    module, variables = _build(BasicBlock, (1, 1))
+    _, qp = quantize_resnet(module, variables)
+    wq, sw, b = qp["conv_init"]
+    assert wq.dtype == jnp.int8
+    assert sw.dtype == jnp.float32 and b.dtype == jnp.float32
+    for qconvs in qp["blocks"]:
+        for wq, sw, b in qconvs:
+            assert wq.dtype == jnp.int8
+
+
+def test_forward_jits_once():
+    module, variables = _build(BottleneckBlock, (1, 1, 1))
+    qf, qp = quantize_resnet(module, variables)
+    f = jax.jit(qf)
+    rng = np.random.default_rng(3)
+    out = f(qp, jnp.asarray(rng.normal(size=(2, 64, 64, 3)),
+                            jnp.float32))
+    assert out.shape == (2, 16 * 4 * 4)  # width*4 (bottleneck) * 2^2
+    assert np.isfinite(np.asarray(out)).all()
