@@ -922,6 +922,36 @@ def bench_gen(extras: dict) -> None:
     extras["gen_cached_vs_reencode_speedup"] = round(
         per_step(False) / per_step(True), 2)
 
+    # speculative decode, B=1 (the launch-latency-bound case): draft =
+    # target is the acceptance UPPER BOUND (every proposal accepted,
+    # k+1 tokens per verify pass) — random weights give a real draft
+    # no way to agree, so this row measures what the machinery buys at
+    # full acceptance, labeled as such. Output equality with plain
+    # greedy is pinned by test regardless.
+    try:
+        from mmlspark_tpu.dl.speculative import generate_speculative
+        ids1 = prompts(1)
+        new1 = 64
+
+        def timed_spec(iters=3):
+            generate_speculative(module, variables, module, variables,
+                                 ids1, max_new_tokens=new1, k=4)
+            t0 = time.perf_counter()
+            rate = 0.0
+            for _ in range(iters):
+                _, rate = generate_speculative(
+                    module, variables, module, variables, ids1,
+                    max_new_tokens=new1, k=4)
+            return (time.perf_counter() - t0) / iters, rate
+
+        t_spec, rate = timed_spec()
+        t_plain = timed(ids1, new1, max_len=Tp + new1)
+        extras["gen_spec_tokens_per_sec_b1"] = round(new1 / t_spec, 1)
+        extras["gen_spec_tokens_per_pass"] = round(rate, 2)
+        extras["gen_spec_vs_plain_b1"] = round(t_plain / t_spec, 2)
+    except Exception:
+        extras["error_gen_spec"] = traceback.format_exc()[-500:]
+
 
 def bench_gbdt(extras: dict) -> None:
     """LightGBM-equivalent training throughput, Higgs-shaped synthetic

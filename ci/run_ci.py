@@ -43,7 +43,8 @@ PACKAGES: dict[str, list[str]] = {
     "io": ["test_native_codegen.py", "test_benchmarks.py",
            "test_reference_parity.py", "test_out_of_core.py",
            "test_ci.py", "test_bench_banking.py", "test_rcheck.py"],
-    "text": ["test_text_transfer.py", "test_causal_lm.py"],
+    "text": ["test_text_transfer.py", "test_causal_lm.py",
+             "test_speculative.py"],
 }
 
 

@@ -10,6 +10,7 @@ by ``jax.sharding`` over the mesh.
 
 from .bert import BertEncoder
 from .generate import TextGenerator, generate
+from .speculative import generate_speculative
 from .model import TPUModel
 from .pretrain import (MaskedLMModel, encoder_variables,
                        pretrain_causal_lm, pretrain_masked_lm)
@@ -22,5 +23,6 @@ __all__ = ["TPUModel", "TrainState", "make_train_step",
            "shard_train_state", "train_epoch", "TextEncoder",
            "TextEncoderFeaturizer", "make_attention_fn",
            "MaskedLMModel", "encoder_variables", "pretrain_masked_lm",
-           "pretrain_causal_lm", "generate", "TextGenerator",
+           "pretrain_causal_lm", "generate", "generate_speculative",
+           "TextGenerator",
            "BertEncoder"]

@@ -61,6 +61,16 @@ class MaskedLMModel(nn.Module):
         instead of streaming the whole prompt token-by-token."""
         return self.encoder.prefill_caches(ids_prefix, caches)
 
+    def decode_window(self, toks, caches, pos):
+        """Cached forward over a w-position window: [B, w] token ids
+        at global positions ``[pos, pos+w)`` → ([B, w, V] logits,
+        updated caches). Speculative decoding's verify pass — the
+        target scores every draft position in ONE call
+        (``dl.speculative``)."""
+        x = self.encoder.embed_window(toks, pos)
+        x, caches = self.encoder.decode_window_blocks(x, caches, pos)
+        return self.lm_head(x), caches
+
 
 def masked_xent(logits, labels):
     """Cross-entropy over positions with ``labels >= 0`` (−1 = ignore:

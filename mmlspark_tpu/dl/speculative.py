@@ -1,0 +1,193 @@
+"""Speculative decoding: a cheap draft proposes, the target verifies.
+
+Single-stream autoregressive decode is launch-latency-bound on TPU —
+each step is a [1, W]-shaped forward whose matmuls can't feed the MXU
+(``bench.py`` gen rows: B=1 decodes ~40x slower per chip-second than
+B=32). Speculation converts k sequential target steps into ONE
+k+1-position cached window forward (``MaskedLMModel.decode_window``):
+a draft model proposes k tokens by ordinary cached decode, the target
+scores all of them in one pass, and the longest agreeing prefix is
+accepted plus the target's own next token — so every round advances by
+at least one token and the output is EXACTLY the target's greedy
+decode, no matter how bad the draft is (asserted by test). Gains scale
+with draft acceptance; a same-family smaller/distilled draft is the
+intended pairing.
+
+Greedy only (temperature 0): stochastic acceptance needs the
+rejection-sampling correction and is out of scope. Batch 1 only: rows
+accept different prefix lengths, and per-row position pointers would
+need ragged caches (the batched path stays ``dl.generate``).
+
+No reference counterpart (text generation is the framework's extension
+axis, SURVEY §5).
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from .generate import (_CACHE_LOCK, _CAUSAL_OK, _RUN_CACHE,
+                       _RUN_CACHE_MAX)
+
+
+def _make_spec_run(module, draft_module, max_new_tokens: int,
+                   pad_id: int, k: int, prefill_len: int):
+    """One jitted speculative decode program per (modules, config)."""
+
+    def init_caches(mod, B, L):
+        enc = mod.encoder
+        hd = enc.width // enc.heads
+        return tuple(
+            (jnp.zeros((B, enc.heads, L, hd), enc.dtype),
+             jnp.zeros((B, enc.heads, L, hd), enc.dtype))
+            for _ in range(enc.depth))
+
+    @jax.jit
+    def run(params, draft_params, buf, ptr0):
+        B, L = buf.shape
+        caches_t = init_caches(module, B, L)
+        caches_d = init_caches(draft_module, B, L)
+        if prefill_len > 0:
+            caches_t = module.apply(
+                {"params": params}, buf[:, :prefill_len], caches_t,
+                method="prefill")
+            caches_d = draft_module.apply(
+                {"params": draft_params}, buf[:, :prefill_len],
+                caches_d, method="prefill")
+        end = ptr0 + max_new_tokens
+
+        def cond(carry):
+            buf, ptr, *_ = carry
+            return ptr < end
+
+        def body(carry):
+            buf, ptr, rounds, caches_t, caches_d = carry
+            # --- draft: k ordinary cached steps from the last token --
+            tok = jax.lax.dynamic_slice_in_dim(buf, ptr - 1, 1,
+                                               axis=1)[:, 0]
+            drafts = []
+            for j in range(k):
+                logits_d, caches_d = draft_module.apply(
+                    {"params": draft_params}, tok, caches_d,
+                    ptr - 1 + j, method="decode_step")
+                logits_d = logits_d.at[:, pad_id].set(-jnp.inf)
+                tok = jnp.argmax(logits_d, axis=-1).astype(jnp.int32)
+                drafts.append(tok)
+            d = jnp.stack(drafts, axis=1)                 # [B, k]
+
+            # --- target: verify the whole window in ONE pass --------
+            last = jax.lax.dynamic_slice_in_dim(buf, ptr - 1, 1,
+                                                axis=1)[:, 0]
+            window = jnp.concatenate([last[:, None], d], 1)  # [B,k+1]
+            logits_t, caches_t = module.apply(
+                {"params": params}, window, caches_t, ptr - 1,
+                method="decode_window")                # [B, k+1, V]
+            logits_t = logits_t.at[:, :, pad_id].set(-jnp.inf)
+            t = jnp.argmax(logits_t, axis=-1).astype(jnp.int32)
+
+            # --- accept the longest agreeing prefix + bonus token ---
+            # d[:, j] accepted iff all d[:, :j+1] == t[:, :j+1]
+            agree = jnp.cumprod(
+                (d == t[:, :k]).astype(jnp.int32), axis=1)   # [B, k]
+            n_acc = agree.sum(axis=1)[0]        # B == 1 (asserted)
+            # emit d_1..d_n then the target's own token at the
+            # divergence point (t[n_acc]) — always >= 1 new token
+            emit = jnp.concatenate(
+                [d, jnp.zeros((B, 1), jnp.int32)], axis=1)   # [B,k+1]
+            bonus = jnp.take_along_axis(
+                t, n_acc[None, None].astype(jnp.int32), axis=1)[:, 0]
+            emit = jax.lax.dynamic_update_slice(
+                emit, bonus[:, None], (0, n_acc))
+            n_new = jnp.minimum(n_acc + 1, end - ptr)
+            # masked window write: positions beyond n_new keep buf
+            old = jax.lax.dynamic_slice(buf, (0, ptr), (B, k + 1))
+            write = jnp.where(jnp.arange(k + 1)[None] < n_new,
+                              emit, old)
+            buf = jax.lax.dynamic_update_slice(buf, write, (0, ptr))
+            return buf, ptr + n_new, rounds + 1, caches_t, caches_d
+
+        # the buffer is padded with k+1 slack positions so the window
+        # write near the end never clips
+        buf, ptr, rounds, _, _ = jax.lax.while_loop(
+            cond, body,
+            (buf, ptr0, jnp.zeros((), jnp.int32), caches_t, caches_d))
+        return buf, ptr, rounds
+
+    return run
+
+
+def generate_speculative(module, variables, draft_module,
+                         draft_variables, prompt_ids, *,
+                         max_new_tokens: int, k: int = 4,
+                         pad_id: int = 0):
+    """Greedy speculative decode for ONE prompt row.
+
+    ``prompt_ids`` [1, Tp] int32 (no pad holes); returns
+    ``(ids [1, Tp + max_new_tokens], tokens_per_pass)`` where
+    ``tokens_per_pass`` is generated-tokens / target-verify-passes —
+    the speedup knob (k+1 when the draft always agrees, 1 when it
+    never does). The output tokens are identical to
+    ``generate(module, ..., temperature=0)`` regardless of the draft
+    (the acceptance rule only ever keeps tokens the target itself
+    would have picked)."""
+    from .pretrain import assert_causal
+
+    prompt_ids = np.asarray(prompt_ids, np.int32)
+    if k < 1:
+        raise ValueError(f"k={k}: the draft must propose at least one "
+                         "token per round")
+    if prompt_ids.ndim != 2 or prompt_ids.shape[0] != 1:
+        raise ValueError("speculative decode is single-stream: pass "
+                         "prompt_ids of shape [1, Tp] (batched "
+                         "decoding is dl.generate)")
+    if (prompt_ids == pad_id).any():
+        raise ValueError("speculative decode needs a dense prompt "
+                         "row (no pad)")
+    if module.encoder.vocab != draft_module.encoder.vocab:
+        raise ValueError("draft and target must share a vocabulary")
+    Tp = prompt_ids.shape[1]
+    if Tp < 1:
+        raise ValueError("empty prompt")
+    # causality probes memoized per module (same pattern and cache as
+    # generate(): two eager forwards per probe must not recur per call
+    # — they would land inside the bench's timing window and on every
+    # serving request)
+    for mod, var in ((module, variables),
+                     (draft_module, draft_variables)):
+        with _CACHE_LOCK:
+            probed = mod in _CAUSAL_OK
+        if not probed:
+            assert_causal(mod, {"params": var["params"]},
+                          prompt_ids if Tp >= 2
+                          else np.repeat(prompt_ids, 2, axis=1),
+                          mod.encoder.vocab)
+            with _CACHE_LOCK:
+                _CAUSAL_OK[mod] = True
+                while len(_CAUSAL_OK) > _RUN_CACHE_MAX:
+                    _CAUSAL_OK.popitem(last=False)
+
+    total = Tp + max_new_tokens
+    prefill_len = Tp - 1
+    key = (module, draft_module, max_new_tokens, pad_id, int(k),
+           prefill_len, "spec")
+    with _CACHE_LOCK:
+        run = _RUN_CACHE.get(key)
+        if run is not None:
+            _RUN_CACHE.move_to_end(key)
+    if run is None:
+        run = _make_spec_run(module, draft_module, max_new_tokens,
+                             pad_id, int(k), prefill_len)
+        with _CACHE_LOCK:
+            _RUN_CACHE[key] = run
+            while len(_RUN_CACHE) > _RUN_CACHE_MAX:
+                _RUN_CACHE.popitem(last=False)
+
+    buf = np.full((1, total + k + 1), pad_id, np.int32)
+    buf[:, :Tp] = prompt_ids
+    out, ptr, rounds = run(variables["params"],
+                           draft_variables["params"],
+                           jnp.asarray(buf), Tp)
+    return (np.asarray(out[:, :total]),
+            float(ptr - Tp) / max(float(rounds), 1.0))

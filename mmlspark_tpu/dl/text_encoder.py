@@ -164,6 +164,38 @@ class EncoderBlock(nn.Module):
         o = self.attention_fn(q, k, v, None)
         return self.ffn(x + self._merge_out(o)), k, v
 
+    def decode_window(self, x_win, k_cache, v_cache, pos):
+        """``decode_step`` generalized to a w-position WINDOW: x_win
+        [B, w, W] holds activations for global positions
+        ``[pos, pos+w)``; caches hold every earlier position. Writes
+        the window's k/v, attends each window row over cache entries
+        ≤ its own global position (one [w, L] mask — the multi-row
+        causal slice), returns ``(y [B, w, W], k_cache, v_cache)``.
+        Speculative verification's workhorse: the target model scores
+        k+1 draft positions in ONE pass instead of k+1 scans."""
+        B, w = x_win.shape[:2]
+        q, k, v = self._project_qkv(x_win)            # [B, H, w, hd]
+        k_cache = jax.lax.dynamic_update_slice(
+            k_cache, k.astype(k_cache.dtype), (0, 0, pos, 0))
+        v_cache = jax.lax.dynamic_update_slice(
+            v_cache, v.astype(v_cache.dtype), (0, 0, pos, 0))
+        L = k_cache.shape[2]
+        scale = (self.width // self.heads) ** -0.5
+        # same formulation as _dense_attention (bf16 operands, f32 MXU
+        # accumulation, -inf masking, NaN guard) so windowed decode
+        # stays numerically in lockstep with decode_step/prefill
+        s = jnp.einsum("bhqd,bhkd->bhqk", q, k_cache,
+                       preferred_element_type=jnp.float32) * scale
+        allowed = (jnp.arange(L)[None, :]
+                   <= (pos + jnp.arange(w))[:, None])  # [w, L]
+        s = jnp.where(allowed[None, None], s, -jnp.inf)
+        p = jax.nn.softmax(s, axis=-1)
+        p = jnp.where(jnp.isnan(p), 0.0, p)
+        o = jnp.einsum("bhqk,bhkd->bhqd", p.astype(v_cache.dtype),
+                       v_cache)
+        x = x_win + self._merge_out(o)
+        return self.ffn(x), k_cache, v_cache
+
 
 class TextEncoder(nn.Module):
     """Token ids [N, T] → ``{"tokens": [N, T, W], "pooled": [N, W]}``.
@@ -228,6 +260,28 @@ class TextEncoder(nn.Module):
             x_tok, kc, vc = block.decode_step(x_tok, kc, vc, pos)
             new_caches.append((kc, vc))
         return self.final_ln(x_tok), tuple(new_caches)
+
+    def embed_window(self, toks, pos):
+        """Prologue for a w-position decode window: embed [B, w] token
+        ids at (traced) global positions ``[pos, pos+w)`` — same
+        constants as ``embed_ids``/``embed_token``."""
+        x = self.embed_layer(toks)                    # [B, w, W]
+        w = toks.shape[1]
+        dim = jnp.arange(self.width // 2)[None, :]
+        p = (pos + jnp.arange(w))[:, None].astype(jnp.float32)
+        ang = p / (10000.0 ** (2 * dim / self.width))
+        pe = jnp.concatenate([jnp.sin(ang), jnp.cos(ang)], axis=-1)
+        return x + pe[None].astype(self.dtype)
+
+    def decode_window_blocks(self, x_win, caches, pos):
+        """Run a w-position window through every block with KV caches
+        (``EncoderBlock.decode_window``). Returns (final-LN'd
+        [B, w, W], updated caches)."""
+        new_caches = []
+        for block, (kc, vc) in zip(self.blocks, caches):
+            x_win, kc, vc = block.decode_window(x_win, kc, vc, pos)
+            new_caches.append((kc, vc))
+        return self.final_ln(x_win), tuple(new_caches)
 
     def prefill_caches(self, ids_prefix, caches):
         """Seed the decode caches for positions ``[0, P)`` with ONE

@@ -89,7 +89,12 @@ def quantize_resnet(module, variables) -> tuple[Any, Any]:
     ``module`` must be a ``models.resnet.ResNet``; any of the zoo's
     ResNet-18/34/50/101 work (both block types)."""
     params = variables["params"]
-    stats = variables.get("batch_stats", {})
+    if "batch_stats" not in variables:
+        raise ValueError(
+            "quantize_resnet folds BatchNorm from running statistics "
+            "— pass the full variables dict (params + batch_stats), "
+            "not a params-only tree")
+    stats = variables["batch_stats"]
     block_name = module.block.__name__
     q: dict = {}
     w, b = _fold(params["conv_init"], params["bn_init"],

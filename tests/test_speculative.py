@@ -1,0 +1,119 @@
+"""Speculative decoding (dl/speculative.py): greedy-equivalence is the
+whole contract — the draft can only ever accelerate, never change, the
+target's output."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from mmlspark_tpu.dl import MaskedLMModel, TextEncoder, generate
+from mmlspark_tpu.dl.speculative import generate_speculative
+from mmlspark_tpu.dl.text_encoder import make_attention_fn
+
+
+def _model(depth, seed, width=32):
+    enc = TextEncoder(vocab=64, width=width, depth=depth, heads=2,
+                      mlp_dim=64, dtype=jnp.float32,
+                      attention_fn=make_attention_fn("dense",
+                                                     causal=True))
+    module = MaskedLMModel(enc)
+    variables = {"params": module.init(
+        jax.random.PRNGKey(seed),
+        jnp.ones((1, 8), jnp.int32))["params"]}
+    return module, variables
+
+
+@pytest.fixture(scope="module")
+def target():
+    return _model(depth=2, seed=0)
+
+
+def _prompt(n=7, seed=3):
+    return np.random.default_rng(seed).integers(
+        2, 64, size=(1, n)).astype(np.int32)
+
+
+class TestSpeculative:
+    def test_self_draft_matches_greedy_and_saturates(self, target):
+        """Draft == target: every proposal accepted, k+1 tokens per
+        verify pass, output equal to plain greedy decode."""
+        module, variables = target
+        ids = _prompt()
+        ref = generate(module, variables, ids, max_new_tokens=12)
+        out, rate = generate_speculative(
+            module, variables, module, variables, ids,
+            max_new_tokens=12, k=3)
+        np.testing.assert_array_equal(out, ref)
+        assert rate > 3.0  # k+1 = 4 up to the final clipped round
+
+    def test_bad_draft_still_matches_greedy(self, target):
+        """A DIFFERENT random draft disagrees almost always — output
+        must still be exactly the target's greedy decode, at >= 1
+        token per pass."""
+        module, variables = target
+        draft_module, draft_variables = _model(depth=1, seed=9)
+        ids = _prompt(seed=5)
+        ref = generate(module, variables, ids, max_new_tokens=10)
+        out, rate = generate_speculative(
+            module, variables, draft_module, draft_variables, ids,
+            max_new_tokens=10, k=4)
+        np.testing.assert_array_equal(out, ref)
+        assert rate >= 1.0
+
+    def test_k1_and_long_generation(self, target):
+        module, variables = target
+        ids = _prompt(seed=11)
+        ref = generate(module, variables, ids, max_new_tokens=17)
+        out, _ = generate_speculative(
+            module, variables, module, variables, ids,
+            max_new_tokens=17, k=1)
+        np.testing.assert_array_equal(out, ref)
+
+    def test_rejects_batched_and_padded_prompts(self, target):
+        module, variables = target
+        with pytest.raises(ValueError, match="single-stream"):
+            generate_speculative(module, variables, module, variables,
+                                 np.ones((2, 4), np.int32),
+                                 max_new_tokens=4)
+        bad = np.array([[5, 0, 7]], np.int32)
+        with pytest.raises(ValueError, match="dense prompt"):
+            generate_speculative(module, variables, module, variables,
+                                 bad, max_new_tokens=4)
+
+    def test_window_decode_matches_stepwise(self, target):
+        """decode_window == k sequential decode_steps (same caches,
+        same logits) — the verify pass's correctness in isolation."""
+        module, variables = target
+        enc = module.encoder
+        ids = _prompt(n=6, seed=13)
+        hd = enc.width // enc.heads
+        L = 16
+
+        def caches():
+            return tuple(
+                (jnp.zeros((1, enc.heads, L, hd), enc.dtype),
+                 jnp.zeros((1, enc.heads, L, hd), enc.dtype))
+                for _ in range(enc.depth))
+
+        c1 = module.apply({"params": variables["params"]},
+                          jnp.asarray(ids[:, :3]), caches(),
+                          method="prefill")
+        c2 = jax.tree.map(lambda a: a, c1)
+        window = jnp.asarray(ids[:, 3:6])
+        lw, c1 = module.apply({"params": variables["params"]},
+                              window, c1, 3, method="decode_window")
+        steps = []
+        for j in range(3):
+            lj, c2 = module.apply({"params": variables["params"]},
+                                  window[:, j], c2,
+                                  jnp.asarray(3 + j, jnp.int32),
+                                  method="decode_step")
+            steps.append(lj)
+        np.testing.assert_allclose(np.asarray(lw[:, -1]),
+                                   np.asarray(steps[-1]), atol=1e-4)
+        for (k1, v1), (k2, v2) in zip(c1, c2):
+            np.testing.assert_allclose(np.asarray(k1),
+                                       np.asarray(k2), atol=1e-5)
+            np.testing.assert_allclose(np.asarray(v1),
+                                       np.asarray(v2), atol=1e-5)
