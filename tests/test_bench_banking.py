@@ -120,3 +120,38 @@ def test_committed_banked_file_is_valid():
     for key, rec in banked.items():
         assert "value" in rec and "measured_at" in rec and \
             "platform" in rec, key
+
+
+def test_bank_serving_rows_allowed_off_chip(tmp_path, monkeypatch):
+    """Serving rows are cpu-host by design: they bank (labeled) even
+    with the tunnel wedged, while chip rows still require the chip."""
+    monkeypatch.setattr(bench, "BANKED_PATH",
+                        str(tmp_path / "banked.json"))
+    bench._bank({"serving_p99_ms": 0.9,
+                 "gbdt_rows_per_sec": 1.0}, 0.0, "cpu")
+    with open(bench.BANKED_PATH) as f:
+        data = json.load(f)
+    assert data["serving_p99_ms"]["value"] == 0.9
+    assert data["serving_p99_ms"]["platform"] == "cpu-host"
+    assert "gbdt_rows_per_sec" not in data
+    # with no serving keys at all, an off-chip run writes nothing
+    monkeypatch.setattr(bench, "BANKED_PATH",
+                        str(tmp_path / "banked2.json"))
+    bench._bank({"gbdt_rows_per_sec": 1.0}, 123.0, "cpu")
+    assert not os.path.exists(bench.BANKED_PATH)
+
+
+def test_diff_timed_discards_noise():
+    """A non-positive long-minus-short delta must come back None —
+    clamping it once published absurd MFU numbers."""
+    seq = iter([0.5, 0.5, 0.4, 0.4])   # long runs FASTER than short
+
+    def run_loop(n):
+        return next(seq)
+
+    assert bench._diff_timed(run_loop, 10, 2) is None
+
+    # and a sane sequence divides over iters
+    seq2 = iter([0.1, 0.1, 1.1, 1.1])
+    per = bench._diff_timed(lambda n: next(seq2), 10, 2)
+    assert per is not None and abs(per - 0.1) < 1e-9
