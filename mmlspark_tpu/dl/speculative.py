@@ -75,6 +75,14 @@ def _make_spec_run(module, draft_module, max_new_tokens: int,
                 logits_d = logits_d.at[:, pad_id].set(-jnp.inf)
                 tok = jnp.argmax(logits_d, axis=-1).astype(jnp.int32)
                 drafts.append(tok)
+            # one extra CACHE-FILL step (logits discarded): the loop
+            # above wrote kv for positions ptr-1..ptr+k-2, but d_k's
+            # position would stay a zero-filled hole the NEXT round's
+            # draft attends over after full acceptance — which silently
+            # halved the self-draft acceptance rate
+            _, caches_d = draft_module.apply(
+                {"params": draft_params}, tok, caches_d,
+                ptr - 1 + k, method="decode_step")
             d = jnp.stack(drafts, axis=1)                 # [B, k]
 
             # --- target: verify the whole window in ONE pass --------
