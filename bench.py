@@ -739,6 +739,64 @@ def _finalize_encoder(extras: dict, impls=_ENCODER_IMPLS) -> None:
     extras["encoder_best_impl"] = best
 
 
+def bench_encoder_int8(extras: dict) -> None:
+    """int8 (w8a8-dynamic) TextEncoder vs the bf16 pallas path at the
+    same long-context shape — what the 2x int8 MXU rate buys the
+    embedding/scoring path, with fidelity alongside."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from mmlspark_tpu.dl.text_encoder import TextEncoder
+    from mmlspark_tpu.models.quantize import quantize_text_encoder
+
+    if _PLATFORM not in ("tpu", "axon"):
+        extras["encoder_int8_skipped"] = f"no accelerator ({_PLATFORM})"
+        return
+    raw_shape = os.environ.get("MMLSPARK_TPU_BENCH_ENCODER_SHAPE",
+                               "512,8,2048,2048")
+    try:
+        W, depth, mlp, T = (int(x) for x in raw_shape.split(","))
+    except ValueError:
+        W, depth, mlp, T = 512, 8, 2048, 2048
+    rng = np.random.default_rng(2)
+    module = TextEncoder(vocab=32768, width=W, depth=depth, heads=8,
+                         mlp_dim=mlp)
+    with jax.default_device(jax.local_devices(backend="cpu")[0]):
+        variables = module.init(
+            jax.random.PRNGKey(0),
+            jnp.asarray(rng.integers(1, 32768, size=(1, T)),
+                        jnp.int32), False)
+    qf, qp = quantize_text_encoder(module, variables)
+    qp = jax.device_put(qp, jax.devices()[0])
+    f = jax.jit(qf)
+    B = 8
+    ids = jax.device_put(
+        jnp.asarray(rng.integers(1, 32768, size=(B, T)), jnp.int32),
+        jax.devices()[0])
+    jax.block_until_ready(f(qp, ids))
+
+    def loop(n):
+        t0 = time.perf_counter()
+        for _ in range(n):
+            out = f(qp, ids)
+        out.block_until_ready()
+        return time.perf_counter() - t0
+
+    per_iter = _diff_timed(loop, 10, 2)
+    if per_iter is None:
+        raise RuntimeError("timing noise swamped the delta")
+    extras["encoder_int8_seqs_per_sec"] = round(B / per_iter, 1)
+    # the int8-vs-bf16 ratio is computed in main() AFTER this
+    # sub-bench merges: _watchdog hands each sub-bench a private
+    # scratch dict, so the encoder rows are not visible from here
+    from mmlspark_tpu.models.quantize import quantization_fidelity
+    small = jnp.asarray(rng.integers(1, 32768, size=(2, 256)),
+                        jnp.int32)
+    extras["encoder_int8_fidelity_cos"] = round(
+        quantization_fidelity(module, variables, f, qp, small), 5)
+
+
 def bench_flash_causal(extras: dict) -> None:
     """Causal-vs-full flash attention timing at T=2048 (VERDICT r4 task
     1b): the pruned-grid causal kernel should approach the ~2x saving
@@ -1606,6 +1664,17 @@ def main():
                           f"encoder_{impl}", 420.0)
             _finalize_encoder(extras, impls)
             _bank(extras, images_per_sec, _PLATFORM)  # encoder_* heads
+        if want("encoder_int8"):
+            _watchdog(bench_encoder_int8, extras, "encoder_int8",
+                      420.0)
+            # like-for-like ratio: int8 runs at B=8, so compare the
+            # best bf16 impl's B=8 point (not its best-of-batch)
+            by_batch = extras.get("encoder_ips_by_batch") or {}
+            bf16_b8 = by_batch.get("8") or by_batch.get(8)
+            int8 = extras.get("encoder_int8_seqs_per_sec")
+            if int8 and bf16_b8:
+                extras["encoder_int8_vs_bf16_b8"] = round(
+                    int8 / bf16_b8, 3)
         if want("flashcausal"):
             _watchdog(bench_flash_causal, extras, "flashcausal", 300.0)
         if want("gen"):

@@ -10,11 +10,12 @@ set -u
 # so a mid-run wedge still keeps everything measured up to that point
 ONLY="${MMLSPARK_TPU_WATCH_ONLY:-}"
 OUT_DIR="${MMLSPARK_TPU_WATCH_DIR:-/tmp/bench_watcher}"
-# must exceed bench.py's worst-case per-sub-bench watchdog sum (~5300s
-# for the full suite incl. the gen sub-bench): the sub-bench watchdogs
-# are the designed wedge handling, and an outer kill before the final
-# JSON print would leave an empty result and loop forever
-RUN_TIMEOUT="${MMLSPARK_TPU_WATCH_TIMEOUT:-6600}"
+# must exceed bench.py's worst-case per-sub-bench watchdog sum (~6300s
+# for the full suite incl. the encoder_int8 and gen sub-benches): the
+# sub-bench watchdogs are the designed wedge handling, and an outer
+# kill before the final JSON print would leave an empty result and
+# loop forever
+RUN_TIMEOUT="${MMLSPARK_TPU_WATCH_TIMEOUT:-7800}"
 mkdir -p "$OUT_DIR"
 cd "$(dirname "$0")/.."
 while true; do

@@ -8,7 +8,8 @@ forward pass returns every named layer, so ``ImageFeaturizer``'s
 lookup rather than graph surgery.
 """
 
-from .quantize import quantization_fidelity, quantize_resnet
+from .quantize import (quantization_fidelity, quantize_resnet,
+                       quantize_text_encoder)
 from .resnet import ResNet, ResNet18, ResNet34, ResNet50, ResNet101
 from .zoo import (ModelSchema, ModelDownloader, get_model,
                   register_model, register_bert_encoder,
@@ -18,4 +19,4 @@ __all__ = ["ResNet", "ResNet18", "ResNet34", "ResNet50", "ResNet101",
            "ModelSchema", "ModelDownloader", "get_model",
            "register_model", "register_bert_encoder",
            "register_text_encoder", "quantize_resnet",
-           "quantization_fidelity"]
+           "quantize_text_encoder", "quantization_fidelity"]

@@ -166,3 +166,131 @@ def quantization_fidelity(module, variables, q_forward, qparams,
     num = (ref * got).sum(-1)
     den = np.linalg.norm(ref, axis=-1) * np.linalg.norm(got, axis=-1)
     return float((num / np.maximum(den, 1e-12)).mean())
+
+
+def _quant_dense_w(w):
+    """Per-output-column symmetric int8 for a dense kernel [in, out]."""
+    s = jnp.max(jnp.abs(w), axis=0) / 127.0
+    s = jnp.maximum(s, 1e-12)
+    wq = jnp.clip(jnp.round(w / s[None, :]), -127, 127).astype(jnp.int8)
+    return wq, s
+
+
+def _qdense(x, wq, s_w, b):
+    """int8 matmul with dynamic per-tensor activation scale; f32 out.
+    x [..., in] f32/bf16 → [..., out] f32."""
+    s_x = jnp.maximum(jnp.max(jnp.abs(x)) / 127.0, 1e-12)
+    xq = jnp.clip(jnp.round(x / s_x), -127, 127).astype(jnp.int8)
+    y = jax.lax.dot_general(
+        xq, wq, (((xq.ndim - 1,), (0,)), ((), ())),
+        preferred_element_type=jnp.int32)
+    return y.astype(jnp.float32) * (s_x * s_w) + b
+
+
+def _ln(x, p):
+    """LayerNorm in f32 (flax defaults: eps 1e-6, scale+bias)."""
+    x = x.astype(jnp.float32)
+    mu = x.mean(-1, keepdims=True)
+    var = ((x - mu) ** 2).mean(-1, keepdims=True)
+    return (x - mu) / jnp.sqrt(var + 1e-6) * p["scale"] + p["bias"]
+
+
+def quantize_text_encoder(module, variables):
+    """w8a8-dynamic quantization of a ``dl.TextEncoder``'s dense
+    layers (qkv / out / mlp — the bulk of encoder FLOPs); embedding,
+    LayerNorms, softmax, and the attention contraction itself stay in
+    f32/bf16. Returns ``(q_forward, qparams)`` with
+    ``q_forward(qparams, ids) -> pooled [N, W] f32`` — the
+    ``TextEncoderFeaturizer`` feature vector. Fidelity vs the f32
+    forward is asserted by test (cos > 0.99).
+
+    Supports DENSE attention (the default and the causal variant —
+    causality is read off ``module.attention_fn``); a sharded or
+    Pallas attention_fn raises rather than silently quantizing into a
+    forward with different attention semantics."""
+    import functools
+
+    from ..dl.text_encoder import _dense_attention
+
+    fn = module.attention_fn
+    if fn is _dense_attention:
+        causal = False
+    elif isinstance(fn, functools.partial) \
+            and fn.func is _dense_attention:
+        causal = bool(fn.keywords.get("causal", False))
+    else:
+        raise ValueError(
+            "quantize_text_encoder supports dense attention only "
+            "(make_attention_fn('dense', ...)); got a custom/sharded "
+            "attention_fn whose semantics the quantized forward "
+            "cannot reproduce")
+    params = variables["params"]
+    q: dict = {"embed": params["embed"]["embedding"].astype(
+        jnp.float32)}
+    blocks = []
+    for i in range(module.depth):
+        bp = params[f"block{i}"]
+        blocks.append({
+            "ln_1": jax.tree.map(lambda a: a.astype(jnp.float32),
+                                 bp["ln_1"]),
+            "ln_2": jax.tree.map(lambda a: a.astype(jnp.float32),
+                                 bp["ln_2"]),
+            "qkv": (*_quant_dense_w(
+                bp["qkv"]["kernel"].astype(jnp.float32)),
+                bp["qkv"]["bias"].astype(jnp.float32)),
+            "out": (*_quant_dense_w(
+                bp["out"]["kernel"].astype(jnp.float32)),
+                bp["out"]["bias"].astype(jnp.float32)),
+            "mlp_1": (*_quant_dense_w(
+                bp["mlp_1"]["kernel"].astype(jnp.float32)),
+                bp["mlp_1"]["bias"].astype(jnp.float32)),
+            "mlp_2": (*_quant_dense_w(
+                bp["mlp_2"]["kernel"].astype(jnp.float32)),
+                bp["mlp_2"]["bias"].astype(jnp.float32)),
+        })
+    q["blocks"] = blocks
+    q["ln"] = jax.tree.map(lambda a: a.astype(jnp.float32),
+                           params["ln"])
+
+    heads, width = module.heads, module.width
+    hd = width // heads
+
+    def q_forward(qp, ids):
+        N, T = ids.shape
+        x = qp["embed"][ids]                          # [N, T, W] f32
+        pos = jnp.arange(T)[:, None]
+        dim = jnp.arange(width // 2)[None, :]
+        ang = pos / (10000.0 ** (2 * dim / width))
+        x = x + jnp.concatenate([jnp.sin(ang), jnp.cos(ang)],
+                                axis=-1)[None]
+        key_mask = ids != 0
+        for bp in qp["blocks"]:
+            h = _ln(x, bp["ln_1"])
+            qkv = _qdense(h, *bp["qkv"])              # [N, T, 3W]
+            qh, kh, vh = jnp.split(qkv, 3, axis=-1)
+
+            def split(a):
+                return a.reshape(N, T, heads, hd).transpose(0, 2, 1, 3)
+
+            s = jnp.einsum("bhqd,bhkd->bhqk", split(qh), split(kh),
+                           preferred_element_type=jnp.float32) \
+                * hd ** -0.5
+            if causal:
+                tri = jnp.arange(T)[None, :] <= jnp.arange(T)[:, None]
+                s = jnp.where(tri[None, None], s, -jnp.inf)
+            s = s + jnp.where(key_mask, 0.0,
+                              -jnp.inf)[:, None, None, :]
+            p = jax.nn.softmax(s, axis=-1)
+            p = jnp.where(jnp.isnan(p), 0.0, p)
+            o = jnp.einsum("bhqk,bhkd->bhqd", p, split(vh))
+            o = o.transpose(0, 2, 1, 3).reshape(N, T, width)
+            x = x + _qdense(o, *bp["out"])
+            h = _ln(x, bp["ln_2"])
+            h = _qdense(h, *bp["mlp_1"])
+            h = jax.nn.gelu(h)
+            x = x + _qdense(h, *bp["mlp_2"])
+        x = _ln(x, qp["ln"])
+        mask = key_mask.astype(jnp.float32)[..., None]
+        return (x * mask).sum(1) / jnp.maximum(mask.sum(1), 1.0)
+
+    return q_forward, q

@@ -71,3 +71,54 @@ def test_forward_jits_once():
                             jnp.float32))
     assert out.shape == (2, 16 * 4 * 4)  # width*4 (bottleneck) * 2^2
     assert np.isfinite(np.asarray(out)).all()
+
+
+def test_text_encoder_quantization_fidelity():
+    """quantize_text_encoder: int8 dense layers must preserve the
+    pooled embedding (cos > 0.99 vs the f32 forward), pad masks
+    included."""
+    from mmlspark_tpu.dl.text_encoder import TextEncoder
+    from mmlspark_tpu.models.quantize import quantize_text_encoder
+
+    module = TextEncoder(vocab=128, width=32, depth=2, heads=4,
+                         mlp_dim=64, dtype=jnp.float32)
+    rng = np.random.default_rng(4)
+    ids = rng.integers(1, 128, size=(4, 12)).astype(np.int32)
+    ids[:, 9:] = 0                       # pad tail: masks must hold
+    variables = module.init(jax.random.PRNGKey(0), jnp.asarray(ids))
+    qf, qp = quantize_text_encoder(module, variables)
+    cos = quantization_fidelity(module, variables, jax.jit(qf), qp,
+                                ids)
+    assert cos > 0.99, cos
+    # int8 weights really are int8
+    for bp in qp["blocks"]:
+        for k in ("qkv", "out", "mlp_1", "mlp_2"):
+            assert bp[k][0].dtype == jnp.int8
+
+
+def test_text_encoder_quantization_causal_and_rejects_custom():
+    """Causality is read off the attention_fn (a causal dense encoder
+    quantizes causally — fidelity holds); a Pallas/sharded fn raises
+    instead of silently quantizing into different semantics."""
+    from mmlspark_tpu.dl.text_encoder import (TextEncoder,
+                                              make_attention_fn)
+    from mmlspark_tpu.models.quantize import quantize_text_encoder
+
+    rng = np.random.default_rng(5)
+    ids = rng.integers(1, 128, size=(2, 10)).astype(np.int32)
+    causal_mod = TextEncoder(
+        vocab=128, width=32, depth=2, heads=4, mlp_dim=64,
+        dtype=jnp.float32,
+        attention_fn=make_attention_fn("dense", causal=True))
+    variables = causal_mod.init(jax.random.PRNGKey(1),
+                                jnp.asarray(ids))
+    qf, qp = quantize_text_encoder(causal_mod, variables)
+    cos = quantization_fidelity(causal_mod, variables, jax.jit(qf),
+                                qp, ids)
+    assert cos > 0.99, cos
+
+    pallas_mod = TextEncoder(
+        vocab=128, width=32, depth=2, heads=4, mlp_dim=64,
+        dtype=jnp.float32, attention_fn=make_attention_fn("pallas"))
+    with pytest.raises(ValueError, match="dense attention only"):
+        quantize_text_encoder(pallas_mod, variables)
