@@ -1426,6 +1426,14 @@ def bench_serving(extras: dict) -> None:
                 for p in probs]
             return df.with_column("reply", replies)
 
+        # the fitted GBDT scores on whatever backend is live: with the
+        # chip up, every request pays a device dispatch THROUGH THE
+        # TUNNEL inside the handler (~69 ms RTT dominates the row) —
+        # mark it so a 68 ms model row next to a 1 ms cpu-host run is
+        # read as tunnel placement, not a serving regression
+        if _BACKEND_OK and _PLATFORM in ("tpu", "axon"):
+            extras["serving_model_includes_tunnel_dispatch"] = True
+
         from mmlspark_tpu.native.loader import get_httpfront
         backends = [("python", "")]
         if get_httpfront() is not None:
