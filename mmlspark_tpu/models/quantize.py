@@ -155,17 +155,22 @@ def quantize_resnet(module, variables) -> tuple[Any, Any]:
     return q_forward, q
 
 
+def cosine_fidelity(a, b) -> float:
+    """Mean row-wise cosine similarity — the ONE copy of the fidelity
+    arithmetic (tests and benches must not re-derive it)."""
+    a = np.asarray(a, np.float64)
+    b = np.asarray(b, np.float64)
+    num = (a * b).sum(-1)
+    den = np.linalg.norm(a, axis=-1) * np.linalg.norm(b, axis=-1)
+    return float((num / np.maximum(den, 1e-12)).mean())
+
+
 def quantization_fidelity(module, variables, q_forward, qparams,
                           images) -> float:
     """Mean cosine similarity between f32 and int8 pooled features —
     the number the bench row reports next to the speedup."""
     ref = module.apply(variables, jnp.asarray(images))["pooled"]
-    got = q_forward(qparams, images)
-    ref = np.asarray(ref, np.float64)
-    got = np.asarray(got, np.float64)
-    num = (ref * got).sum(-1)
-    den = np.linalg.norm(ref, axis=-1) * np.linalg.norm(got, axis=-1)
-    return float((num / np.maximum(den, 1e-12)).mean())
+    return cosine_fidelity(ref, q_forward(qparams, images))
 
 
 def _quant_dense_w(w):

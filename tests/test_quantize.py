@@ -122,3 +122,34 @@ def test_text_encoder_quantization_causal_and_rejects_custom():
         dtype=jnp.float32, attention_fn=make_attention_fn("pallas"))
     with pytest.raises(ValueError, match="dense attention only"):
         quantize_text_encoder(pallas_mod, variables)
+
+
+def test_image_featurizer_quantize_param():
+    """ImageFeaturizer(quantize=True) scores through the int8 path and
+    its features track the f32 path; a non-pooled endpoint rejects."""
+    from mmlspark_tpu.core import DataFrame
+    from mmlspark_tpu.image import ImageFeaturizer
+    from mmlspark_tpu.models.zoo import LoadedModel, ModelSchema
+
+    module, variables = _build(BasicBlock, (1, 1), width=8)
+    schema = ModelSchema(name="tinyq", input_size=32,
+                         layer_names=("stage1", "stage2", "pooled",
+                                      "logits"))
+    loaded = LoadedModel(schema=schema, module=module,
+                         variables=variables)
+    rng = np.random.default_rng(6)
+    imgs = rng.normal(size=(5, 32, 32, 3)).astype(np.float32)
+    df = DataFrame({"image": imgs})
+    f32 = ImageFeaturizer(model=loaded, autoResize=False,
+                          miniBatchSize=4).transform(df)
+    q = ImageFeaturizer(model=loaded, autoResize=False,
+                        miniBatchSize=4, quantize=True).transform(df)
+    from mmlspark_tpu.models.quantize import cosine_fidelity
+    a = np.stack(list(f32["features"]))
+    b = np.stack(list(q["features"]))
+    assert cosine_fidelity(a, b) > 0.99
+
+    bad = ImageFeaturizer(model=loaded, autoResize=False,
+                          quantize=True, cutOutputLayers=0)
+    with pytest.raises(ValueError, match="pooled endpoint only"):
+        bad.transform(df)
