@@ -401,6 +401,12 @@ class TextEncoderFeaturizer(Transformer, HasInputCol, HasOutputCol,
         "modelName", "zoo text-model name to resolve through "
         "ModelDownloader (empty = random init from the width/depth "
         "params)", TC.toString, default="", has_default=True)
+    quantize = Param(
+        "quantize", "embed through the int8 post-training-quantized "
+        "path (models.quantize_text_encoder: dense layers int8, "
+        "attention bf16/f32 — 2x MXU rate on v5e); plain TextEncoder "
+        "with dense attention only", TC.toBoolean, default=False,
+        has_default=True)
 
     # class-level fallbacks: the serializer reconstructs stages without
     # running __init__ (meshes are runtime wiring, not persisted state)
@@ -463,8 +469,19 @@ class TextEncoderFeaturizer(Transformer, HasInputCol, HasOutputCol,
                 rng = jax.random.PRNGKey(self.get("seed"))
                 dummy = jnp.zeros((1, self.get("seqChunk")), jnp.int32)
                 variables = module.init(rng, dummy, False)
-            apply = jax.jit(
-                lambda v, x: module.apply(v, x, False)["pooled"])
+            if self.get("quantize"):
+                from ..models.quantize import quantize_text_encoder
+                if type(module) is not TextEncoder:
+                    raise ValueError(
+                        "quantize=True supports plain TextEncoder "
+                        f"models only (got {type(module).__name__})")
+                qf, qp = quantize_text_encoder(
+                    module, {"params": variables["params"]})
+                apply = jax.jit(lambda v, x: qf(v["params"], x))
+                variables = {"params": qp}
+            else:
+                apply = jax.jit(
+                    lambda v, x: module.apply(v, x, False)["pooled"])
             self._cache = (apply, variables)
         return self._cache
 

@@ -153,3 +153,28 @@ def test_image_featurizer_quantize_param():
                           quantize=True, cutOutputLayers=0)
     with pytest.raises(ValueError, match="pooled endpoint only"):
         bad.transform(df)
+
+
+def test_text_featurizer_quantize_param():
+    """TextEncoderFeaturizer(quantize=True): int8 embeddings track the
+    f32 path; non-dense attention impls reject via the underlying
+    validator."""
+    from mmlspark_tpu.core import DataFrame
+    from mmlspark_tpu.dl import TextEncoderFeaturizer
+    from mmlspark_tpu.models.quantize import cosine_fidelity
+
+    rng = np.random.default_rng(7)
+    rows = np.empty(3, object)
+    rows[:] = [list(rng.integers(1, 200, size=n)) for n in (9, 5, 12)]
+    df = DataFrame({"tokens": rows})
+    kw = dict(vocabSize=256, width=32, depth=2, heads=4, seqChunk=16)
+    a = TextEncoderFeaturizer(**kw).transform(df)["features"]
+    b = TextEncoderFeaturizer(quantize=True, **kw).transform(
+        df)["features"]
+    assert cosine_fidelity(np.stack(list(a)),
+                           np.stack(list(b))) > 0.99
+
+    bad = TextEncoderFeaturizer(quantize=True, attentionImpl="pallas",
+                                **kw)
+    with pytest.raises(ValueError, match="dense attention only"):
+        bad.transform(df)
