@@ -121,3 +121,37 @@ class TestSpeculative:
                                        np.asarray(k2), atol=1e-5)
             np.testing.assert_allclose(np.asarray(v1),
                                        np.asarray(v2), atol=1e-5)
+
+
+def test_trained_draft_actually_accelerates():
+    """The intended pairing end to end: target and a SMALLER draft
+    pretrained on the same (strongly structured) corpus — the draft
+    agrees with the target's greedy decode and tokens-per-pass beats
+    the no-draft floor of 1. A cyclic corpus makes the continuation
+    deterministic, so the assertion is stable."""
+    from mmlspark_tpu.dl import pretrain_causal_lm
+
+    period = 7
+    seq = np.tile(np.arange(2, 2 + period), 6)[None, :32]  # [1, 32]
+    corpus = np.repeat(seq, 16, axis=0).astype(np.int32)
+
+    def train(depth, width):
+        enc = TextEncoder(vocab=16, width=width, depth=depth, heads=2,
+                          mlp_dim=2 * width, dtype=jnp.float32,
+                          attention_fn=make_attention_fn(
+                              "dense", causal=True))
+        state, losses = pretrain_causal_lm(enc, corpus, steps=150,
+                                           batch_size=8, seed=0)
+        return MaskedLMModel(enc), {"params": state.params}
+
+    target, tvars = train(depth=2, width=32)
+    draft, dvars = train(depth=1, width=16)
+
+    prompt = seq[:, :10]
+    ref = generate(target, tvars, prompt, max_new_tokens=14)
+    out, rate = generate_speculative(target, tvars, draft, dvars,
+                                     prompt, max_new_tokens=14, k=3)
+    np.testing.assert_array_equal(out, ref)
+    # both models learn the cycle; the draft should agree well above
+    # the no-speculation floor
+    assert rate > 1.5, rate
