@@ -13,8 +13,12 @@ decode, no matter how bad the draft is (asserted by test). Gains scale
 with draft acceptance; a same-family smaller/distilled draft is the
 intended pairing.
 
-Greedy only (temperature 0): stochastic acceptance needs the
-rejection-sampling correction and is out of scope. Batch 1 only: rows
+Temperature 0 uses greedy acceptance (longest agreeing prefix — output
+EXACTLY the target's greedy decode); temperature > 0 uses the
+rejection-sampling correction (:func:`_acceptance`), which makes the
+emitted tokens an EXACT sample from the target's autoregressive
+distribution regardless of the draft — the acceptance math is a pure
+function pinned by a Monte-Carlo distribution test. Batch 1 only: rows
 accept different prefix lengths, and per-row position pointers would
 need ragged caches (the batched path stays ``dl.generate``).
 
@@ -32,8 +36,35 @@ from .generate import (_CACHE_LOCK, _CAUSAL_OK, _RUN_CACHE,
                        _RUN_CACHE_MAX)
 
 
+def _acceptance(p_d, p_t, d, u):
+    """Rejection-sampling acceptance (Leviathan et al.'s rule): accept
+    draft token ``d[j] ~ p_d[j]`` when ``u[j] < p_t[j][d_j]/p_d[j][d_j]``;
+    the round ends at the first rejection, whose replacement must be
+    drawn from the RESIDUAL ``norm(relu(p_t[j*] - p_d[j*]))`` — the
+    correction that makes each emitted token an exact sample from p_t.
+
+    Pure function so the math is testable without models:
+    ``p_d [k, V]``, ``p_t [k+1, V]`` (row k = the bonus distribution),
+    ``d [k]`` draft tokens, ``u [k]`` uniforms. Returns
+    ``(n_acc, replacement_dist [V])`` where replacement_dist is the
+    residual at the rejection row, or ``p_t[k]`` (the plain bonus
+    distribution) when every draft token was accepted."""
+    k = d.shape[0]
+    pd_tok = jnp.take_along_axis(p_d, d[:, None], axis=1)[:, 0]
+    pt_tok = jnp.take_along_axis(p_t[:k], d[:, None], axis=1)[:, 0]
+    ratio = pt_tok / jnp.maximum(pd_tok, 1e-20)
+    accept = u < jnp.minimum(ratio, 1.0)
+    n_acc = jnp.cumprod(accept.astype(jnp.int32)).sum()
+    j_star = jnp.minimum(n_acc, k - 1)
+    residual = jnp.maximum(p_t[j_star] - p_d[j_star], 0.0)
+    residual = residual / jnp.maximum(residual.sum(), 1e-20)
+    replacement = jnp.where(n_acc == k, p_t[k], residual)
+    return n_acc, replacement
+
+
 def _make_spec_run(module, draft_module, max_new_tokens: int,
-                   pad_id: int, k: int, prefill_len: int):
+                   pad_id: int, k: int, prefill_len: int,
+                   temperature: float):
     """One jitted speculative decode program per (modules, config)."""
 
     def init_caches(mod, B, L):
@@ -45,7 +76,7 @@ def _make_spec_run(module, draft_module, max_new_tokens: int,
             for _ in range(enc.depth))
 
     @jax.jit
-    def run(params, draft_params, buf, ptr0):
+    def run(params, draft_params, buf, ptr0, key):
         B, L = buf.shape
         caches_t = init_caches(module, B, L)
         caches_d = init_caches(draft_module, B, L)
@@ -67,13 +98,26 @@ def _make_spec_run(module, draft_module, max_new_tokens: int,
             # --- draft: k ordinary cached steps from the last token --
             tok = jax.lax.dynamic_slice_in_dim(buf, ptr - 1, 1,
                                                axis=1)[:, 0]
-            drafts = []
+            drafts, p_d_rows = [], []
             for j in range(k):
                 logits_d, caches_d = draft_module.apply(
                     {"params": draft_params}, tok, caches_d,
                     ptr - 1 + j, method="decode_step")
                 logits_d = logits_d.at[:, pad_id].set(-jnp.inf)
-                tok = jnp.argmax(logits_d, axis=-1).astype(jnp.int32)
+                if temperature > 0:
+                    # per-POSITION fold_in, the same key schedule as
+                    # dl.generate's cached path (a token at absolute
+                    # position q samples with fold_in(key, q - 1)) —
+                    # so self-draft full acceptance reproduces
+                    # generate()'s sampled stream
+                    scaled = logits_d / temperature
+                    p_d_rows.append(jax.nn.softmax(scaled, -1)[0])
+                    tok = jax.random.categorical(
+                        jax.random.fold_in(key, ptr - 1 + j), scaled,
+                        axis=-1).astype(jnp.int32)
+                else:
+                    tok = jnp.argmax(logits_d,
+                                     axis=-1).astype(jnp.int32)
                 drafts.append(tok)
             # one extra CACHE-FILL step (logits discarded): the loop
             # above wrote kv for positions ptr-1..ptr+k-2, but d_k's
@@ -93,19 +137,48 @@ def _make_spec_run(module, draft_module, max_new_tokens: int,
                 {"params": params}, window, caches_t, ptr - 1,
                 method="decode_window")                # [B, k+1, V]
             logits_t = logits_t.at[:, :, pad_id].set(-jnp.inf)
-            t = jnp.argmax(logits_t, axis=-1).astype(jnp.int32)
 
-            # --- accept the longest agreeing prefix + bonus token ---
-            # d[:, j] accepted iff all d[:, :j+1] == t[:, :j+1]
-            agree = jnp.cumprod(
-                (d == t[:, :k]).astype(jnp.int32), axis=1)   # [B, k]
-            n_acc = agree.sum(axis=1)[0]        # B == 1 (asserted)
-            # emit d_1..d_n then the target's own token at the
-            # divergence point (t[n_acc]) — always >= 1 new token
+            if temperature > 0:
+                # --- rejection-sampling acceptance (_acceptance) ----
+                p_t = jax.nn.softmax(logits_t[0] / temperature, -1)
+                p_d = jnp.stack(p_d_rows)                 # [k, V]
+                # acceptance uniforms: a DISTINCT stream from the
+                # token-sampling keys (offset fold), one per row
+                ukey = jax.random.fold_in(key, 0x5bd1)
+                u = jax.random.uniform(
+                    jax.random.fold_in(ukey, ptr), (k,))
+                n_acc, repl_dist = _acceptance(p_d, p_t, d[0], u)
+                # replacement/bonus key: on FULL acceptance, the bonus
+                # samples from p_t[k] with that position's
+                # generate-matching key (fresh — the draft loop never
+                # folded position ptr-1+k). On a REJECTION the
+                # residual draw must be INDEPENDENT of the rejected
+                # draft token, but fold_in(key, ptr-1+n_acc) is
+                # exactly the key that sampled it — same Gumbel noise
+                # would correlate the replacement with what was just
+                # rejected and break the exactness proof — so the
+                # rejection path routes through a distinct fold.
+                acc_key = jax.random.fold_in(key, ptr - 1 + k)
+                rej_key = jax.random.fold_in(
+                    jax.random.fold_in(key, 0x9e37), ptr - 1 + n_acc)
+                bkey = jnp.where(n_acc == k, acc_key, rej_key)
+                bonus = jax.random.categorical(
+                    bkey, jnp.log(jnp.maximum(repl_dist, 1e-20)))[None]
+                bonus = bonus.astype(jnp.int32)
+            else:
+                # --- greedy: accept the longest agreeing prefix -----
+                t = jnp.argmax(logits_t, axis=-1).astype(jnp.int32)
+                # d[:, j] accepted iff all d[:, :j+1] == t[:, :j+1]
+                agree = jnp.cumprod(
+                    (d == t[:, :k]).astype(jnp.int32), axis=1)
+                n_acc = agree.sum(axis=1)[0]    # B == 1 (asserted)
+                bonus = jnp.take_along_axis(
+                    t, n_acc[None, None].astype(jnp.int32),
+                    axis=1)[:, 0]
+            # emit d_1..d_n then the replacement/bonus token at the
+            # divergence point — always >= 1 new token
             emit = jnp.concatenate(
                 [d, jnp.zeros((B, 1), jnp.int32)], axis=1)   # [B,k+1]
-            bonus = jnp.take_along_axis(
-                t, n_acc[None, None].astype(jnp.int32), axis=1)[:, 0]
             emit = jax.lax.dynamic_update_slice(
                 emit, bonus[:, None], (0, n_acc))
             n_new = jnp.minimum(n_acc + 1, end - ptr)
@@ -129,17 +202,23 @@ def _make_spec_run(module, draft_module, max_new_tokens: int,
 def generate_speculative(module, variables, draft_module,
                          draft_variables, prompt_ids, *,
                          max_new_tokens: int, k: int = 4,
-                         pad_id: int = 0):
-    """Greedy speculative decode for ONE prompt row.
+                         pad_id: int = 0, temperature: float = 0.0,
+                         seed: int = 0):
+    """Speculative decode for ONE prompt row.
 
     ``prompt_ids`` [1, Tp] int32 (no pad holes); returns
     ``(ids [1, Tp + max_new_tokens], tokens_per_pass)`` where
     ``tokens_per_pass`` is generated-tokens / target-verify-passes —
     the speedup knob (k+1 when the draft always agrees, 1 when it
-    never does). The output tokens are identical to
-    ``generate(module, ..., temperature=0)`` regardless of the draft
-    (the acceptance rule only ever keeps tokens the target itself
-    would have picked)."""
+    never does).
+
+    ``temperature=0`` (default): greedy acceptance — output identical
+    to ``generate(module, ..., temperature=0)`` regardless of the
+    draft. ``temperature > 0``: rejection-sampling acceptance
+    (:func:`_acceptance`) — each emitted token is an EXACT sample from
+    the target's distribution at that temperature regardless of the
+    draft; with draft == target the stream reproduces ``generate``'s
+    sampled output (same per-position key schedule)."""
     from .pretrain import assert_causal
 
     prompt_ids = np.asarray(prompt_ids, np.int32)
@@ -178,17 +257,18 @@ def generate_speculative(module, variables, draft_module,
 
     total = Tp + max_new_tokens
     prefill_len = Tp - 1
-    key = (module, draft_module, max_new_tokens, pad_id, int(k),
-           prefill_len, "spec")
+    cache_key = (module, draft_module, max_new_tokens, pad_id, int(k),
+                 prefill_len, float(temperature), "spec")
     with _CACHE_LOCK:
-        run = _RUN_CACHE.get(key)
+        run = _RUN_CACHE.get(cache_key)
         if run is not None:
-            _RUN_CACHE.move_to_end(key)
+            _RUN_CACHE.move_to_end(cache_key)
     if run is None:
         run = _make_spec_run(module, draft_module, max_new_tokens,
-                             pad_id, int(k), prefill_len)
+                             pad_id, int(k), prefill_len,
+                             float(temperature))
         with _CACHE_LOCK:
-            _RUN_CACHE[key] = run
+            _RUN_CACHE[cache_key] = run
             while len(_RUN_CACHE) > _RUN_CACHE_MAX:
                 _RUN_CACHE.popitem(last=False)
 
@@ -196,6 +276,7 @@ def generate_speculative(module, variables, draft_module,
     buf[:, :Tp] = prompt_ids
     out, ptr, rounds = run(variables["params"],
                            draft_variables["params"],
-                           jnp.asarray(buf), Tp)
+                           jnp.asarray(buf), Tp,
+                           jax.random.PRNGKey(seed))
     return (np.asarray(out[:, :total]),
             float(ptr - Tp) / max(float(rounds), 1.0))
