@@ -18,9 +18,15 @@ EXACTLY the target's greedy decode); temperature > 0 uses the
 rejection-sampling correction (:func:`_acceptance`), which makes the
 emitted tokens an EXACT sample from the target's autoregressive
 distribution regardless of the draft — the acceptance math is a pure
-function pinned by a Monte-Carlo distribution test. Batch 1 only: rows
-accept different prefix lengths, and per-row position pointers would
-need ragged caches (the batched path stays ``dl.generate``).
+function pinned by a Monte-Carlo distribution test.
+
+Greedy mode decodes BATCHES too: rows synchronize on the minimum
+per-row acceptance each round (the target's row at that slot is every
+row's correct next token — divergence bonus for the limiting row,
+already-approved draft for the rest), so per-row output stays exactly
+greedy at a tokens-per-pass rate set by the slowest row. Sampled mode
+is single-stream: rows retrying positions across rounds would need
+position-keyed acceptance draws to stay exact.
 
 No reference counterpart (text generation is the framework's extension
 axis, SURVEY §5).
@@ -171,9 +177,20 @@ def _make_spec_run(module, draft_module, max_new_tokens: int,
                 # d[:, j] accepted iff all d[:, :j+1] == t[:, :j+1]
                 agree = jnp.cumprod(
                     (d == t[:, :k]).astype(jnp.int32), axis=1)
-                n_acc = agree.sum(axis=1)[0]    # B == 1 (asserted)
+                # batched rows synchronize on the MINIMUM acceptance:
+                # every row's first n_min draft tokens are
+                # target-approved, and t[:, n_min] is each row's
+                # correct next token either way — for a row whose
+                # acceptance ended AT n_min it is the divergence
+                # bonus; for a row that accepted further,
+                # d[n_min+1] == t[n_min] by that very acceptance. Rows
+                # beyond n_min re-propose the same (deterministic)
+                # drafts next round, so output stays exactly greedy
+                # per row; only the tokens-per-pass rate pays for the
+                # sync.
+                n_acc = jnp.min(agree.sum(axis=1))
                 bonus = jnp.take_along_axis(
-                    t, n_acc[None, None].astype(jnp.int32),
+                    t, jnp.full((B, 1), n_acc, jnp.int32),
                     axis=1)[:, 0]
             # emit d_1..d_n then the replacement/bonus token at the
             # divergence point — always >= 1 new token
@@ -204,10 +221,12 @@ def generate_speculative(module, variables, draft_module,
                          max_new_tokens: int, k: int = 4,
                          pad_id: int = 0, temperature: float = 0.0,
                          seed: int = 0):
-    """Speculative decode for ONE prompt row.
+    """Speculative decode.
 
-    ``prompt_ids`` [1, Tp] int32 (no pad holes); returns
-    ``(ids [1, Tp + max_new_tokens], tokens_per_pass)`` where
+    ``prompt_ids`` [B, Tp] int32 (no pad holes; B > 1 for greedy
+    only — rows synchronize on the minimum per-row acceptance, exact
+    per-row output at a rate set by the slowest row); returns
+    ``(ids [B, Tp + max_new_tokens], tokens_per_pass)`` where
     ``tokens_per_pass`` is generated-tokens / target-verify-passes —
     the speedup knob (k+1 when the draft always agrees, 1 when it
     never does).
@@ -225,10 +244,15 @@ def generate_speculative(module, variables, draft_module,
     if k < 1:
         raise ValueError(f"k={k}: the draft must propose at least one "
                          "token per round")
-    if prompt_ids.ndim != 2 or prompt_ids.shape[0] != 1:
-        raise ValueError("speculative decode is single-stream: pass "
-                         "prompt_ids of shape [1, Tp] (batched "
-                         "decoding is dl.generate)")
+    if prompt_ids.ndim != 2:
+        raise ValueError("prompt_ids must be [B, Tp]")
+    if temperature > 0 and prompt_ids.shape[0] != 1:
+        raise ValueError(
+            "sampled (temperature > 0) speculative decode is "
+            "single-stream: batched rows retrying positions across "
+            "rounds would need position-keyed acceptance draws; pass "
+            "one row, or use temperature=0 (batched greedy is "
+            "supported) or dl.generate")
     if (prompt_ids == pad_id).any():
         raise ValueError("speculative decode needs a dense prompt "
                          "row (no pad)")
@@ -272,7 +296,8 @@ def generate_speculative(module, variables, draft_module,
             while len(_RUN_CACHE) > _RUN_CACHE_MAX:
                 _RUN_CACHE.popitem(last=False)
 
-    buf = np.full((1, total + k + 1), pad_id, np.int32)
+    buf = np.full((prompt_ids.shape[0], total + k + 1), pad_id,
+                  np.int32)
     buf[:, :Tp] = prompt_ids
     out, ptr, rounds = run(variables["params"],
                            draft_variables["params"],

@@ -80,12 +80,33 @@ class TestSpeculative:
             max_new_tokens=17, k=1)
         np.testing.assert_array_equal(out, ref)
 
-    def test_rejects_batched_and_padded_prompts(self, target):
+    def test_batched_greedy_matches_generate_per_row(self, target):
+        """B=3 greedy speculation: every row's output equals plain
+        greedy decode even though rows accept at different rates (the
+        sync-on-min rule never commits an unapproved token)."""
+        module, variables = target
+        draft_module, draft_variables = _model(depth=1, seed=41)
+        rng = np.random.default_rng(17)
+        ids = rng.integers(2, 64, size=(3, 6)).astype(np.int32)
+        ref = generate(module, variables, ids, max_new_tokens=9)
+        out, rate = generate_speculative(
+            module, variables, draft_module, draft_variables, ids,
+            max_new_tokens=9, k=3)
+        np.testing.assert_array_equal(out, ref)
+        assert rate >= 1.0
+        # and self-draft still saturates batched
+        out2, rate2 = generate_speculative(
+            module, variables, module, variables, ids,
+            max_new_tokens=9, k=2)
+        np.testing.assert_array_equal(out2, ref)
+        assert rate2 == pytest.approx(3.0)
+
+    def test_rejects_sampled_batched_and_padded_prompts(self, target):
         module, variables = target
         with pytest.raises(ValueError, match="single-stream"):
             generate_speculative(module, variables, module, variables,
                                  np.ones((2, 4), np.int32),
-                                 max_new_tokens=4)
+                                 max_new_tokens=4, temperature=1.0)
         bad = np.array([[5, 0, 7]], np.int32)
         with pytest.raises(ValueError, match="dense prompt"):
             generate_speculative(module, variables, module, variables,
