@@ -1007,6 +1007,20 @@ def bench_gen(extras: dict) -> None:
         extras["gen_spec_tokens_per_sec_b1"] = round(new1 / t_spec, 1)
         extras["gen_spec_tokens_per_pass"] = round(rate, 2)
         extras["gen_spec_vs_plain_b1"] = round(t_plain / t_spec, 2)
+
+        # batched greedy speculation (sync-on-min): B=8 self-draft
+        ids8 = prompts(8)
+        generate_speculative(module, variables, module, variables,
+                             ids8, max_new_tokens=new1, k=4)
+        t0 = time.perf_counter()
+        for _ in range(3):
+            _, rate8 = generate_speculative(
+                module, variables, module, variables, ids8,
+                max_new_tokens=new1, k=4)
+        t_spec8 = (time.perf_counter() - t0) / 3
+        extras["gen_spec_tokens_per_sec_b8"] = round(
+            8 * new1 / t_spec8, 1)
+        extras["gen_spec_b8_tokens_per_pass"] = round(rate8, 2)
     except Exception:
         extras["error_gen_spec"] = traceback.format_exc()[-500:]
 
