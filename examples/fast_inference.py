@@ -61,4 +61,22 @@ assert (out2 == ref).all()
 print(f"self-draft speculative == greedy, {rate2:.2f} tokens/pass")
 assert rate2 > rate
 
+# batched greedy (sync-on-min): every row still exactly greedy
+prompts4 = rng.integers(2, 128, size=(4, 6)).astype(np.int32)
+ref4 = generate(target, tvars, prompts4, max_new_tokens=8)
+out4, rate4 = generate_speculative(target, tvars, target, tvars,
+                                   prompts4, max_new_tokens=8, k=3)
+assert (out4 == ref4).all()
+print(f"batched B=4 speculative == greedy, {rate4:.2f} tokens/pass")
+
+# sampled mode: rejection acceptance, self-draft reproduces generate's
+# sampled stream (shared per-position key schedule)
+refs = generate(target, tvars, prompt, max_new_tokens=8,
+                temperature=0.8, seed=7)
+outs, _ = generate_speculative(target, tvars, target, tvars, prompt,
+                               max_new_tokens=8, k=3, temperature=0.8,
+                               seed=7)
+assert (outs == refs).all()
+print("sampled speculative == generate's sampled stream")
+
 done("fast_inference")
