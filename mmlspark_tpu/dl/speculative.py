@@ -20,13 +20,15 @@ emitted tokens an EXACT sample from the target's autoregressive
 distribution regardless of the draft — the acceptance math is a pure
 function pinned by a Monte-Carlo distribution test.
 
-Greedy mode decodes BATCHES too: rows synchronize on the minimum
-per-row acceptance each round (the target's row at that slot is every
-row's correct next token — divergence bonus for the limiting row,
-already-approved draft for the rest), so per-row output stays exactly
-greedy at a tokens-per-pass rate set by the slowest row. Sampled mode
-is single-stream: rows retrying positions across rounds would need
-position-keyed acceptance draws to stay exact.
+Both modes decode BATCHES: rows synchronize on the minimum per-row
+acceptance each round — the committed token at the sync slot is the
+limiting row's divergence bonus/replacement and the other rows'
+already-accepted draft, so per-row output semantics are unchanged at a
+tokens-per-pass rate set by the slowest row. Every random draw is
+keyed by ABSOLUTE POSITION (never by round), so a row that accepted
+beyond the sync point redraws identical decisions when it retries
+those positions next round — the property that keeps batched sampled
+decoding distribution-exact per row.
 
 No reference counterpart (text generation is the framework's extension
 axis, SURVEY §5).
@@ -117,7 +119,7 @@ def _make_spec_run(module, draft_module, max_new_tokens: int,
                     # so self-draft full acceptance reproduces
                     # generate()'s sampled stream
                     scaled = logits_d / temperature
-                    p_d_rows.append(jax.nn.softmax(scaled, -1)[0])
+                    p_d_rows.append(jax.nn.softmax(scaled, -1))
                     tok = jax.random.categorical(
                         jax.random.fold_in(key, ptr - 1 + j), scaled,
                         axis=-1).astype(jnp.int32)
@@ -146,31 +148,54 @@ def _make_spec_run(module, draft_module, max_new_tokens: int,
 
             if temperature > 0:
                 # --- rejection-sampling acceptance (_acceptance) ----
-                p_t = jax.nn.softmax(logits_t[0] / temperature, -1)
-                p_d = jnp.stack(p_d_rows)                 # [k, V]
+                p_t = jax.nn.softmax(logits_t / temperature,
+                                     -1)                  # [B, k+1, V]
+                p_d = jnp.stack(p_d_rows, axis=1)         # [B, k, V]
                 # acceptance uniforms: a DISTINCT stream from the
-                # token-sampling keys (offset fold), one per row
+                # token-sampling keys, keyed PER ABSOLUTE POSITION
+                # (not per round) — a batched row that accepted beyond
+                # the sync point retries the same positions next round
+                # and must redraw the SAME decisions, or exactness
+                # breaks. Keys are shared across rows with per-row
+                # noise coming from the batch dimension (the same
+                # semantics as generate()'s batched sampling, pinned
+                # by test) — note row i > 0 of a batch therefore does
+                # NOT reproduce a single-row run of the same prompt,
+                # exactly like generate().
                 ukey = jax.random.fold_in(key, 0x5bd1)
-                u = jax.random.uniform(
-                    jax.random.fold_in(ukey, ptr), (k,))
-                n_acc, repl_dist = _acceptance(p_d, p_t, d[0], u)
-                # replacement/bonus key: on FULL acceptance, the bonus
-                # samples from p_t[k] with that position's
-                # generate-matching key (fresh — the draft loop never
-                # folded position ptr-1+k). On a REJECTION the
-                # residual draw must be INDEPENDENT of the rejected
-                # draft token, but fold_in(key, ptr-1+n_acc) is
-                # exactly the key that sampled it — same Gumbel noise
-                # would correlate the replacement with what was just
-                # rejected and break the exactness proof — so the
-                # rejection path routes through a distinct fold.
+                u = jax.vmap(lambda j: jax.random.uniform(
+                    jax.random.fold_in(ukey, ptr + j), (B,)))(
+                    jnp.arange(k)).T                       # [B, k]
+                n_rows, repl_rows = jax.vmap(_acceptance)(p_d, p_t, d,
+                                                          u)
+                # batched sync-on-min (see the greedy branch): rows
+                # past n_min commit their already-accepted d[n_min];
+                # rows AT n_min commit their replacement sample
+                n_acc = jnp.min(n_rows)
+                # replacement/bonus key: on FULL acceptance the bonus
+                # samples with that position's generate-matching key
+                # (fresh — the draft loop never folded ptr-1+k). On a
+                # REJECTION the residual draw must be INDEPENDENT of
+                # the rejected draft token, whose key was exactly
+                # fold_in(key, ptr-1+n_acc) — same Gumbel noise would
+                # correlate the two draws and skew the distribution
+                # (Monte-Carlo-pinned) — so rejections route through a
+                # distinct fold, still position-keyed for retry
+                # determinism.
                 acc_key = jax.random.fold_in(key, ptr - 1 + k)
                 rej_key = jax.random.fold_in(
                     jax.random.fold_in(key, 0x9e37), ptr - 1 + n_acc)
                 bkey = jnp.where(n_acc == k, acc_key, rej_key)
-                bonus = jax.random.categorical(
-                    bkey, jnp.log(jnp.maximum(repl_dist, 1e-20)))[None]
-                bonus = bonus.astype(jnp.int32)
+                # rows AT the sync point sample from their own
+                # replacement distribution (repl_rows[i] was computed
+                # at that row's j* == n_min); rows past it never use
+                # it — they commit their already-accepted d[n_min]
+                sampled = jax.random.categorical(
+                    bkey, jnp.log(jnp.maximum(repl_rows, 1e-20)),
+                    axis=-1).astype(jnp.int32)             # [B]
+                bonus = jnp.where(
+                    n_rows > n_acc,
+                    d[:, jnp.minimum(n_acc, k - 1)], sampled)
             else:
                 # --- greedy: accept the longest agreeing prefix -----
                 t = jnp.argmax(logits_t, axis=-1).astype(jnp.int32)
@@ -223,9 +248,9 @@ def generate_speculative(module, variables, draft_module,
                          seed: int = 0):
     """Speculative decode.
 
-    ``prompt_ids`` [B, Tp] int32 (no pad holes; B > 1 for greedy
-    only — rows synchronize on the minimum per-row acceptance, exact
-    per-row output at a rate set by the slowest row); returns
+    ``prompt_ids`` [B, Tp] int32 (no pad holes; rows synchronize on
+    the minimum per-row acceptance — exact per-row output at a rate
+    set by the slowest row); returns
     ``(ids [B, Tp + max_new_tokens], tokens_per_pass)`` where
     ``tokens_per_pass`` is generated-tokens / target-verify-passes —
     the speedup knob (k+1 when the draft always agrees, 1 when it
@@ -246,13 +271,6 @@ def generate_speculative(module, variables, draft_module,
                          "token per round")
     if prompt_ids.ndim != 2:
         raise ValueError("prompt_ids must be [B, Tp]")
-    if temperature > 0 and prompt_ids.shape[0] != 1:
-        raise ValueError(
-            "sampled (temperature > 0) speculative decode is "
-            "single-stream: batched rows retrying positions across "
-            "rounds would need position-keyed acceptance draws; pass "
-            "one row, or use temperature=0 (batched greedy is "
-            "supported) or dl.generate")
     if (prompt_ids == pad_id).any():
         raise ValueError("speculative decode needs a dense prompt "
                          "row (no pad)")

@@ -101,12 +101,8 @@ class TestSpeculative:
         np.testing.assert_array_equal(out2, ref)
         assert rate2 == pytest.approx(3.0)
 
-    def test_rejects_sampled_batched_and_padded_prompts(self, target):
+    def test_rejects_padded_prompts(self, target):
         module, variables = target
-        with pytest.raises(ValueError, match="single-stream"):
-            generate_speculative(module, variables, module, variables,
-                                 np.ones((2, 4), np.int32),
-                                 max_new_tokens=4, temperature=1.0)
         bad = np.array([[5, 0, 7]], np.int32)
         with pytest.raises(ValueError, match="dense prompt"):
             generate_speculative(module, variables, module, variables,
@@ -265,6 +261,38 @@ class TestStochasticSpeculative:
             module, variables, module, variables, ids,
             max_new_tokens=10, k=3, temperature=0.8, seed=5)
         np.testing.assert_array_equal(out, ref)
+
+    def test_batched_sampled_self_draft_matches_generate(self, target):
+        """B=3 sampled speculation with draft == target: full
+        acceptance plus the shared position-keyed schedule and the
+        batched-categorical semantics generate() itself uses mean the
+        whole BATCH reproduces generate's sampled streams."""
+        module, variables = target
+        rng = np.random.default_rng(29)
+        ids = rng.integers(2, 64, size=(3, 6)).astype(np.int32)
+        ref = generate(module, variables, ids, max_new_tokens=9,
+                       temperature=0.9, seed=11)
+        out, _ = generate_speculative(
+            module, variables, module, variables, ids,
+            max_new_tokens=9, k=3, temperature=0.9, seed=11)
+        np.testing.assert_array_equal(out, ref)
+
+    def test_batched_sampled_bad_draft_deterministic_valid(self,
+                                                           target):
+        """Batched sampled speculation with a DISAGREEING draft: rows
+        retry positions across rounds, and the position-keyed draws
+        keep the run deterministic and in-vocab."""
+        module, variables = target
+        draft_module, draft_variables = _model(depth=1, seed=43)
+        rng = np.random.default_rng(31)
+        ids = rng.integers(2, 64, size=(3, 5)).astype(np.int32)
+        outs = [generate_speculative(
+            module, variables, draft_module, draft_variables, ids,
+            max_new_tokens=8, k=3, temperature=1.0, seed=13)[0]
+            for _ in range(2)]
+        np.testing.assert_array_equal(outs[0], outs[1])
+        gen = outs[0][:, ids.shape[1]:]
+        assert ((gen >= 1) & (gen < 64)).all()
 
     def test_bad_draft_sampled_is_deterministic_and_valid(self,
                                                           target):
