@@ -266,6 +266,17 @@ class TextGenerator(Transformer, HasInputCol, HasOutputCol):
                         TC.toFloat, default=0.0, has_default=True)
     seed = Param("seed", "sampling seed", TC.toInt, default=0,
                  has_default=True)
+    draftLm = ComplexParam(
+        "draftLm", "(module, variables) of a smaller same-vocab causal "
+        "LM: when set, decoding runs SPECULATIVELY (dl.speculative — "
+        "the draft proposes, the lm verifies k positions per pass; "
+        "per-row output semantics unchanged). Rows are grouped by "
+        "prompt length (speculation needs dense equal-length rows), "
+        "one compiled program per distinct length.",
+        default=None, has_default=True)
+    speculativeK = Param(
+        "speculativeK", "draft tokens proposed per verify pass",
+        TC.toInt, default=4, has_default=True)
 
     def __init__(self, **kwargs):
         super().__init__(**kwargs)
@@ -286,12 +297,29 @@ class TextGenerator(Transformer, HasInputCol, HasOutputCol):
         ids[ptr == 0, 0] = 1
         ptr = np.maximum(ptr, 1)
         n_new = self.get("maxNewTokens")
+        draft = self.get("draftLm")
+        texts = np.empty(len(ids), object)
+        if draft is not None:
+            from .speculative import generate_speculative
+            draft_module, draft_variables = draft
+            # speculation needs dense equal-length rows: group ragged
+            # prompts by length, one batched call per group
+            for plen in np.unique(ptr):
+                rows = np.flatnonzero(ptr == plen)
+                out_g, _ = generate_speculative(
+                    module, variables, draft_module, draft_variables,
+                    ids[rows, :plen], max_new_tokens=n_new,
+                    k=self.get("speculativeK"),
+                    temperature=self.get("temperature"),
+                    seed=self.get("seed"))
+                for r, row in zip(rows, out_g):
+                    texts[r] = tok.decode(row[plen:plen + n_new])
+            return df.with_column(self.getOutputCol(), texts)
         out = generate(module, variables, ids, max_new_tokens=n_new,
                        temperature=self.get("temperature"),
                        seed=self.get("seed"))
         # each row's continuation starts at ITS prompt length (ragged
         # prompts generate before Tp), never contains pad
-        texts = np.empty(len(out), object)
         texts[:] = [tok.decode(row[p:p + n_new])
                     for row, p in zip(out, ptr)]
         return df.with_column(self.getOutputCol(), texts)

@@ -210,6 +210,30 @@ class TestTextGeneratorStage:
             DataFrame({"text": np.empty(0, object)}))
         assert len(none_df["generated"]) == 0
 
+    def test_stage_speculative_matches_plain_greedy(self, trained_lm):
+        """draftLm set (self-draft): greedy outputs must be IDENTICAL
+        to the plain stage, ragged prompt lengths grouped correctly."""
+        from mmlspark_tpu.core import DataFrame
+        from mmlspark_tpu.dl import TextGenerator
+        from mmlspark_tpu.featurize import BpeTokenizer
+
+        module, variables = trained_lm
+        corpus = np.empty(4, object)
+        corpus[:] = ["abc abd", "bcd bce", "abc bcd", "abd bce"]
+        tok = BpeTokenizer(vocabSize=64, maxLength=8, inputCol="text",
+                           outputCol="tokens").fit(
+            DataFrame({"text": corpus}))
+        prompts = np.empty(3, object)
+        prompts[:] = ["abc", "bcd bce", "abd"]  # ragged lengths
+        df = DataFrame({"text": prompts})
+        plain = TextGenerator(tokenizer=tok, lm=(module, variables),
+                              maxNewTokens=3)
+        spec = TextGenerator(tokenizer=tok, lm=(module, variables),
+                             draftLm=(module, variables),
+                             speculativeK=2, maxNewTokens=3)
+        assert list(spec.transform(df)["generated"]) == \
+            list(plain.transform(df)["generated"])
+
     def test_stage_persists(self, trained_lm, tmp_path):
         """save/load round trip: the tokenizer rides its own
         StageParam save path, the LM pickles, outputs match."""
