@@ -30,7 +30,14 @@ import jax  # noqa: E402
 # every backends() call, even for CPU-only tests. An explicit config
 # update wins over the hook's; tests must never touch the tunnel.
 jax.config.update("jax_platforms", "cpu")
-jax.config.update("jax_compilation_cache_dir", "/tmp/mmlspark_tpu_jax_cache")
+# a cache dir SEPARATE from bench.py's: when the axon tunnel is up the
+# bench's compiles go through the remote compile service, and CPU
+# executables cached from the REMOTE machine's -march poison a shared
+# dir — loading them locally shifts float results (a knife-edge
+# statistical test failed deterministically from this) and risks
+# SIGILL per the cpu_aot_loader warning
+jax.config.update("jax_compilation_cache_dir",
+                  "/tmp/mmlspark_tpu_jax_cache_tests")
 jax.config.update("jax_persistent_cache_min_compile_time_secs", 1.0)
 
 
