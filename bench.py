@@ -208,12 +208,31 @@ def _watchdog(fn, extras: dict, key: str, timeout_s: float):
     return box.get("result")
 
 
+@functools.lru_cache(maxsize=None)
+def _phase_hist():
+    """The obs registry's bench histogram — lazy so importing bench.py
+    (harness smoke, --help) stays free of mmlspark_tpu imports."""
+    from mmlspark_tpu.obs import registry
+    return registry.histogram(
+        "bench_phase_seconds",
+        "bench timed-region wall seconds, by phase")
+
+
+def _timed(phase: str):
+    """THE bench stopwatch: ``with _timed("x") as t: ...`` then read
+    ``t.seconds``. Every timed region lands in the process-wide obs
+    registry (``bench_phase_seconds{phase=...}``) so bench timings sit
+    on the same scrape surface as serving/training series instead of
+    dying in paired ``perf_counter`` reads."""
+    return _phase_hist().time(phase=phase)
+
+
 def _t_block(f, x):
     """Wall seconds of one blocking call — the null-dispatch floor."""
     import jax
-    t0 = time.perf_counter()
-    jax.block_until_ready(f(x))
-    return time.perf_counter() - t0
+    with _timed("block") as t:
+        jax.block_until_ready(f(x))
+    return t.seconds
 
 
 def _diff_timed(run_loop, iters, short, reps=2):
@@ -290,11 +309,11 @@ def _mfu_sweep(module, variables, make_input, batches, *, iters=20,
             # iters=10-20 inflates per-iter time by several ms and
             # understated every MFU row — difference it out
             def loop(n):
-                t0 = time.perf_counter()
-                for _ in range(n):
-                    out = compiled(x)
-                out.block_until_ready()
-                return time.perf_counter() - t0
+                with _timed("mfu_loop") as t:
+                    for _ in range(n):
+                        out = compiled(x)
+                    out.block_until_ready()
+                return t.seconds
 
             per_iter = _diff_timed(loop, iters, max(iters // 5, 2))
             if per_iter is None:

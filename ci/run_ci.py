@@ -43,6 +43,7 @@ PACKAGES: dict[str, list[str]] = {
     "io": ["test_native_codegen.py", "test_benchmarks.py",
            "test_reference_parity.py", "test_out_of_core.py",
            "test_ci.py", "test_bench_banking.py", "test_rcheck.py"],
+    "obs": ["test_obs.py"],
     "text": ["test_text_transfer.py", "test_causal_lm.py",
              "test_speculative.py"],
 }
@@ -56,6 +57,17 @@ def _run(cmd: list[str], **kw) -> int:
 def style() -> int:
     rc = _run([sys.executable, "-m", "compileall", "-q",
                "mmlspark_tpu", "tests", "examples", "ci"])
+    if rc:
+        return rc
+    # obs must import cleanly with no backend and no JAX import at all
+    # (serving fronts scrape it from handler threads before/without any
+    # device init; a JAX import sneaking in would drag backend setup
+    # into every importer)
+    smoke = ("import sys; from mmlspark_tpu.obs import registry, tracer; "
+             "assert 'jax' not in sys.modules, 'obs import pulled in jax'; "
+             "print('obs import OK (no jax)')")
+    rc = _run([sys.executable, "-c", smoke],
+              env=dict(os.environ, JAX_PLATFORMS="cpu"))
     if rc:
         return rc
     # codegen reflection must walk every stage without error (the

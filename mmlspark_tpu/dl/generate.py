@@ -269,10 +269,17 @@ class TextGenerator(Transformer, HasInputCol, HasOutputCol):
     draftLm = ComplexParam(
         "draftLm", "(module, variables) of a smaller same-vocab causal "
         "LM: when set, decoding runs SPECULATIVELY (dl.speculative — "
-        "the draft proposes, the lm verifies k positions per pass; "
-        "per-row output semantics unchanged). Rows are grouped by "
-        "prompt length (speculation needs dense equal-length rows), "
-        "one compiled program per distinct length.",
+        "the draft proposes, the lm verifies k positions per pass). "
+        "temperature=0: output identical to the non-draft stage. "
+        "temperature>0: each token is still an EXACT sample from the "
+        "lm's distribution (rejection-sampling acceptance, see "
+        "dl.speculative), but the sampled STREAM differs from the "
+        "non-draft stage run — length-grouping changes batch "
+        "composition and per-row key schedules, so equality is "
+        "distribution-exactness, not stream equality. Rows are "
+        "grouped by prompt length (speculation needs dense "
+        "equal-length rows), one compiled program per distinct "
+        "length.",
         default=None, has_default=True)
     speculativeK = Param(
         "speculativeK", "draft tokens proposed per verify pass",

@@ -28,6 +28,8 @@ import jax.numpy as jnp
 import numpy as np
 
 from ..core.utils import stable_sigmoid
+from ..obs import registry as _obs
+from ..obs.tracing import tracer as _tracer
 from ..utils.platform import target_platform
 from .binning import bin_features, compute_bin_boundaries, bin_upper_value
 from .booster import Booster
@@ -1102,6 +1104,23 @@ def train(x: np.ndarray, y: np.ndarray, w: np.ndarray | None,
             if valid is not None else jnp.zeros((T_max, 1), jnp.float32)
         weights_buf = jnp.ones(T_max, jnp.float32)
 
+    # ---- observability (obs subsystem): the boosting loop is a span
+    # tree (lightgbm.fit → boosting_round) in the JSON telemetry sink,
+    # and every round's host wall time (dispatch + any blocking eval
+    # sync — what the old private stopwatches measured) lands in the
+    # process-wide per-round histogram. Spans are non-current with
+    # explicit parentage: a loop body with breaks must not own ambient
+    # context.
+    _round_hist = _obs.histogram(
+        "lightgbm_boosting_round_seconds",
+        "host wall seconds per boosting round (chunked rounds record "
+        "one sample per scan chunk), by dispatch mode")
+    _round_mode = "stepwise" if (is_dart and not dart_fused) else "fused"
+    _fit_span = _tracer.start_span(
+        "lightgbm.fit", current=False, objective=cfg.objective,
+        boosting=cfg.boosting_type, iterations=cfg.num_iterations,
+        rows=n_real, features=F)
+
     # ---- chunked fast path: scan cfg.scan_chunk iterations per dispatch
     # when NOTHING observes per-iteration state — no eval/early stopping
     # (no valid set, no training metric) and no delegate hooks. The host
@@ -1148,6 +1167,9 @@ def train(x: np.ndarray, y: np.ndarray, w: np.ndarray | None,
                 rms = jnp.broadcast_to(valid_mask_dev, (k, n))
             its = jnp.asarray(
                 np.arange(it, it + k, dtype=np.int32))
+            _chunk_span = _tracer.start_span(
+                "boosting_round", parent=_fit_span, current=False,
+                iteration=it, iterations=k, mode="chunked")
             if dart_fused:
                 (scores, vscores, deltas_buf, vdeltas_buf, weights_buf,
                  tree_stack) = dart_chunk_step(
@@ -1164,12 +1186,17 @@ def train(x: np.ndarray, y: np.ndarray, w: np.ndarray | None,
                     for k_cls in range(K):
                         tree_class.append(k_cls)
                         tree_weights.append(1.0)
+            _round_hist.observe(_tracer.end_span(_chunk_span).seconds,
+                                mode="chunked")
             it += k
         iter_range = range(full_iters, cfg.num_iterations)
     else:
         iter_range = range(cfg.num_iterations)
 
     for it in iter_range:
+        _round_span = _tracer.start_span(
+            "boosting_round", parent=_fit_span, current=False,
+            iteration=it, mode=_round_mode)
         if delegate is not None:
             # rf averages unshrunk trees (tree_params forces lr=1); a
             # delegate LR schedule must not silently re-shrink them
@@ -1356,9 +1383,13 @@ def train(x: np.ndarray, y: np.ndarray, w: np.ndarray | None,
                 rounds_no_improve += 1
             if (cfg.early_stopping_round > 0
                     and rounds_no_improve >= cfg.early_stopping_round):
+                _round_hist.observe(
+                    _tracer.end_span(_round_span).seconds, mode=_round_mode)
                 break
         if delegate is not None:
             delegate.after_train_iteration(it)
+        _round_hist.observe(_tracer.end_span(_round_span).seconds,
+                            mode=_round_mode)
 
     if trees:
         # trees holds [K, ...] stacks (one per iteration) and/or
@@ -1400,6 +1431,11 @@ def train(x: np.ndarray, y: np.ndarray, w: np.ndarray | None,
             _debug_capture["dart_deltas"] = np.asarray(
                 jax.device_get(tree_deltas))
             _debug_capture["dart_weights"] = np.asarray(tree_weights)
+    # span ends only on the success path: an exception mid-fit drops the
+    # (non-current) span unemitted, which cannot corrupt ambient context
+    _fit_span.set_attr("trees", len(trees))
+    _fit_span.set_attr("best_iteration", best_iter)
+    _tracer.end_span(_fit_span)
     return TrainResult(booster=booster, evals=evals, best_iteration=best_iter,
                        host_pulls_bulk=pulls_bulk,
                        host_pulls_scalar=pulls_scalar)

@@ -11,8 +11,13 @@ Scheme (standard w8a8-dynamic):
   ``w' = w·γ/√(σ²+ε)``, ``b' = β − μ·γ/√(σ²+ε)``), so the quantized
   graph has no normalization ops at all.
 - Weights: per-OUTPUT-CHANNEL symmetric int8 (``s_c = max|w_c|/127``).
-- Activations: per-TENSOR symmetric int8 with a DYNAMIC scale computed
-  on device per batch (one max-reduction — cheap next to the conv).
+- Activations: per-ROW symmetric int8 with a DYNAMIC scale computed on
+  device (max over the non-batch axes — one reduction, cheap next to
+  the conv). Per-row, NOT per-tensor: a whole-batch max would let one
+  outlier row squeeze the int8 range of every other row, making a
+  quantized row's features depend on its minibatch neighbors (and on
+  miniBatchSize) — the f32 path is row-independent and the quantized
+  path must match (ADVICE round-5).
 - Accumulation in int32, dequantized as ``y·(s_x·s_c) + b`` in f32;
   residual adds, relu, and pooling stay in f32.
 
@@ -55,14 +60,17 @@ def _quant_w(w):
 
 
 def _qconv(x, wq, s_w, b, *, strides, padding):
-    """int8 conv with dynamic per-tensor activation scale; f32 out."""
-    s_x = jnp.maximum(jnp.max(jnp.abs(x)) / 127.0, 1e-12)
-    xq = jnp.clip(jnp.round(x / s_x), -127, 127).astype(jnp.int8)
+    """int8 conv with dynamic per-row activation scale; f32 out."""
+    s_x = jnp.maximum(
+        jnp.max(jnp.abs(x), axis=(1, 2, 3)) / 127.0, 1e-12)  # [N]
+    xq = jnp.clip(jnp.round(x / s_x[:, None, None, None]),
+                  -127, 127).astype(jnp.int8)
     y = jax.lax.conv_general_dilated(
         xq, wq, strides, padding,
         dimension_numbers=("NHWC", "HWIO", "NHWC"),
         preferred_element_type=jnp.int32)
-    return y.astype(jnp.float32) * (s_x * s_w)[None, None, None, :] \
+    return y.astype(jnp.float32) \
+        * (s_x[:, None, None, None] * s_w[None, None, None, :]) \
         + b[None, None, None, :]
 
 
@@ -182,9 +190,19 @@ def _quant_dense_w(w):
 
 
 def _qdense(x, wq, s_w, b):
-    """int8 matmul with dynamic per-tensor activation scale; f32 out.
-    x [..., in] f32/bf16 → [..., out] f32."""
-    s_x = jnp.maximum(jnp.max(jnp.abs(x)) / 127.0, 1e-12)
+    """int8 matmul with dynamic per-row activation scale; f32 out.
+    x [N, ..., in] f32/bf16 → [N, ..., out] f32 (scale is max over the
+    non-batch axes, so row outputs are minibatch-independent)."""
+    if x.ndim < 2:
+        # 1-D input has no non-batch axes: the per-row max degenerates
+        # to a per-element scale and every value quantizes to ±127 —
+        # fail loudly instead
+        raise ValueError("_qdense needs a batched input [N, ..., in]; "
+                         f"got shape {x.shape}")
+    row_axes = tuple(range(1, x.ndim))
+    s_x = jnp.maximum(
+        jnp.max(jnp.abs(x), axis=row_axes, keepdims=True) / 127.0,
+        1e-12)  # [N, 1, ..., 1]
     xq = jnp.clip(jnp.round(x / s_x), -127, 127).astype(jnp.int8)
     y = jax.lax.dot_general(
         xq, wq, (((xq.ndim - 1,), (0,)), ((), ())),

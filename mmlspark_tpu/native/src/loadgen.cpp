@@ -7,17 +7,21 @@
 // client threads steal the GIL from the very server they measure).
 // This is the classic reason load tests use wrk/ab; neither ships in
 // this image, so this is the minimal equivalent: one OS thread per
-// connection, blocking sockets, TCP_NODELAY, strict request-response
-// (no pipelining), per-request wall latency recorded.
+// connection, blocking sockets with SO_RCVTIMEO/SO_SNDTIMEO (a server
+// that accepts but never replies becomes a transport failure, not a
+// thread the bench watchdog cannot kill), TCP_NODELAY, strict
+// request-response (no pipelining), per-request wall latency recorded.
 //
 // Counterpart of the reference's perf narrative for its serving layer
 // (docs/mmlspark-serving.md "sub-millisecond latency"); no reference
 // source equivalent — its load tests ran external tooling.
 
 #include <arpa/inet.h>
+#include <netdb.h>
 #include <netinet/in.h>
 #include <netinet/tcp.h>
 #include <sys/socket.h>
+#include <sys/time.h>
 #include <unistd.h>
 
 #include <atomic>
@@ -36,20 +40,39 @@ struct ConnResult {
   bool hard_fail = false;
 };
 
+// Per-operation I/O deadline. Applied as SO_RCVTIMEO/SO_SNDTIMEO so a
+// recv/send against a stalled server fails (EAGAIN) instead of
+// blocking forever; on Linux SO_SNDTIMEO also bounds connect(). A
+// timeout surfaces through the existing n<=0 transport-failure paths.
+constexpr long kIoTimeoutSec = 5;
+
 int connect_to(const char* host, int port) {
-  int fd = ::socket(AF_INET, SOCK_STREAM, 0);
-  if (fd < 0) return -1;
-  int one = 1;
-  ::setsockopt(fd, IPPROTO_TCP, TCP_NODELAY, &one, sizeof(one));
-  sockaddr_in addr{};
-  addr.sin_family = AF_INET;
-  addr.sin_port = htons(static_cast<uint16_t>(port));
-  if (::inet_pton(AF_INET, host, &addr.sin_addr) != 1 ||
-      ::connect(fd, reinterpret_cast<sockaddr*>(&addr),
-                sizeof(addr)) != 0) {
-    ::close(fd);
+  // getaddrinfo so hostnames ('localhost') work, not just IPv4
+  // literals — an unresolvable host is a failed connection, never a
+  // silent fallthrough.
+  addrinfo hints{};
+  hints.ai_family = AF_UNSPEC;
+  hints.ai_socktype = SOCK_STREAM;
+  addrinfo* res = nullptr;
+  const std::string service = std::to_string(port);
+  if (::getaddrinfo(host, service.c_str(), &hints, &res) != 0 ||
+      res == nullptr)
     return -1;
+  int fd = -1;
+  for (addrinfo* ai = res; ai != nullptr; ai = ai->ai_next) {
+    fd = ::socket(ai->ai_family, ai->ai_socktype, ai->ai_protocol);
+    if (fd < 0) continue;
+    int one = 1;
+    ::setsockopt(fd, IPPROTO_TCP, TCP_NODELAY, &one, sizeof(one));
+    timeval tv{};
+    tv.tv_sec = kIoTimeoutSec;
+    ::setsockopt(fd, SOL_SOCKET, SO_RCVTIMEO, &tv, sizeof(tv));
+    ::setsockopt(fd, SOL_SOCKET, SO_SNDTIMEO, &tv, sizeof(tv));
+    if (::connect(fd, ai->ai_addr, ai->ai_addrlen) == 0) break;
+    ::close(fd);
+    fd = -1;
   }
+  ::freeaddrinfo(res);
   return fd;
 }
 

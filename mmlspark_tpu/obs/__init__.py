@@ -1,0 +1,19 @@
+"""Unified observability: process-wide metrics + tracing.
+
+One registry (``registry``) and one tracer (``tracer``) shared by every
+layer — serving fronts, the distributed worker mesh, collectives, the
+LightGBM boosting loop, and the bench suite — replacing the fragmented
+per-component stopwatches the reference inherited (per-stage JSON
+telemetry + VW nanosecond timers, SURVEY §5). See docs/observability.md.
+
+Import is side-effect-free and backend-free: safe under
+``JAX_PLATFORMS=cpu`` before (or without) JAX initialization.
+"""
+
+from .metrics import (DEFAULT_LATENCY_BUCKETS, Counter, Gauge, Histogram,
+                      MetricsRegistry, registry)
+from .tracing import Span, StageTimer, Tracer, tracer
+
+__all__ = ["registry", "tracer", "MetricsRegistry", "Counter", "Gauge",
+           "Histogram", "Tracer", "Span", "StageTimer",
+           "DEFAULT_LATENCY_BUCKETS"]

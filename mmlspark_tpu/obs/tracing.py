@@ -1,0 +1,219 @@
+"""Spans and the process-wide tracer.
+
+The reference has no tracer (SURVEY §5) — only the ``Timer`` transformer
+and VW's stopwatches. This is the structured replacement: a
+:class:`Span` is a named, timed region with a trace id, a span id, and a
+parent id propagated through ``contextvars`` — nest ``tracer.span``
+calls and the tree falls out. Spans emit as JSON events through the SAME
+logger ``BasicLogging`` writes stage telemetry to
+(``mmlspark_tpu.telemetry``), so one sink carries both: a traced
+LightGBM ``fit`` shows the stage event and its nested boosting-round
+spans side by side.
+
+Device time: a span with ``device=True`` additionally wraps the region
+in ``jax.profiler.TraceAnnotation`` so it shows up named in XProf
+traces captured by ``utils.profiling.profile_trace`` — wall time on the
+span, device time in the profile, correlated by name. JAX is imported
+lazily and only then; this module must import with no backend.
+
+Cross-thread propagation: ``contextvars`` do not cross ``threading``
+boundaries, so hand the parent over explicitly —
+``tracer.span("work", parent=parent_span)`` — exactly what the serving
+worker pool does per batch.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import contextvars
+import itertools
+import json
+import logging
+import os
+import threading
+import time
+from dataclasses import dataclass, field
+
+from .metrics import registry as _registry
+
+# the BasicLogging sink, by name (NOT by import: core imports obs for
+# span linkage, so obs importing core back would cycle)
+_TELEMETRY = logging.getLogger("mmlspark_tpu.telemetry")
+
+_ids = itertools.count(1)
+_id_lock = threading.Lock()
+_PROC = f"{os.getpid():x}"
+
+
+def _new_id() -> str:
+    with _id_lock:
+        return f"{_PROC}-{next(_ids):x}"
+
+
+@dataclass
+class Span:
+    """One named, timed region. ``seconds`` is None until the span ends."""
+
+    name: str
+    trace_id: str
+    span_id: str
+    parent_id: str | None = None
+    attrs: dict = field(default_factory=dict)
+    start_wall: float = 0.0       # epoch seconds (event timestamps)
+    seconds: float | None = None  # wall duration, set at end
+    error: str | None = None
+    _t0: float = 0.0              # perf_counter anchor
+
+    def set_attr(self, key: str, value) -> None:
+        self.attrs[key] = value
+
+
+_current_span: contextvars.ContextVar[Span | None] = \
+    contextvars.ContextVar("mmlspark_tpu_obs_span", default=None)
+
+_UNSET = object()
+
+
+class Tracer:
+    """Creates spans, propagates parentage, emits span events.
+
+    ``metric`` (a histogram name) records each span's wall seconds into
+    the metrics registry labeled by span name — tracing and metrics stay
+    one subsystem, not two."""
+
+    def __init__(self, registry=None, metric: str | None = None):
+        self.registry = registry if registry is not None else _registry
+        self.metric = metric
+
+    # -- context -----------------------------------------------------------
+    def current_span(self) -> Span | None:
+        return _current_span.get()
+
+    # -- span lifecycle ----------------------------------------------------
+    def start_span(self, name: str, *, parent=_UNSET,
+                   current: bool = True, **attrs) -> Span:
+        """Begin a span. Prefer the ``span(...)`` context manager; this
+        begin/end surface exists for regions that cannot nest a ``with``
+        block (e.g. a loop body with breaks). Every ``start_span`` must
+        be paired with ``end_span``. ``current=False`` records parentage
+        without touching the ambient context — children must then name
+        this span as ``parent=`` explicitly, but an unpaired end can
+        never corrupt the context of unrelated spans."""
+        if parent is _UNSET:
+            parent = _current_span.get()
+        if isinstance(parent, Span):
+            trace_id, parent_id = parent.trace_id, parent.span_id
+        else:
+            trace_id, parent_id = _new_id(), None
+        span = Span(name=name, trace_id=trace_id, span_id=_new_id(),
+                    parent_id=parent_id, attrs=dict(attrs),
+                    start_wall=time.time(), _t0=time.perf_counter())
+        if current:
+            span._token = _current_span.set(span)
+        return span
+
+    def end_span(self, span: Span, error: BaseException | None = None,
+                 emit: bool = True) -> Span:
+        if getattr(span, "_done", False):
+            return span  # already ended (loop break + fallthrough)
+        span._done = True
+        span.seconds = time.perf_counter() - span._t0
+        if error is not None:
+            span.error = repr(error)
+        token = getattr(span, "_token", None)
+        if token is not None:
+            span._token = None
+            try:
+                _current_span.reset(token)
+            except ValueError:
+                # ended from a different context than it started in
+                # (cross-thread hand-off); parentage is already recorded
+                pass
+        if emit:
+            self._emit(span)
+        if self.metric is not None:
+            self.registry.histogram(
+                self.metric, "span wall seconds").observe(
+                    span.seconds, span=span.name)
+        return span
+
+    @contextlib.contextmanager
+    def span(self, name: str, *, parent=_UNSET, device: bool = False,
+             **attrs):
+        """``with tracer.span("stage.fit", rows=n) as sp: ...``
+
+        ``parent``: explicit parent Span (or None to force a new root) —
+        required when crossing a thread boundary. ``device=True`` also
+        annotates the region for XProf device traces."""
+        span = self.start_span(name, parent=parent, **attrs)
+        ann = None
+        if device:
+            try:
+                import jax
+                ann = jax.profiler.TraceAnnotation(name)
+                ann.__enter__()
+            except Exception:
+                ann = None
+        try:
+            yield span
+        except BaseException as e:
+            self.end_span(span, error=e)
+            raise
+        finally:
+            if ann is not None:
+                ann.__exit__(None, None, None)
+            self.end_span(span)
+
+    # -- emission ----------------------------------------------------------
+    def _emit(self, span: Span) -> None:
+        # same gate BasicLogging rides on: when nothing listens at INFO
+        # the span costs two clock reads and a few dict ops, no json
+        if not _TELEMETRY.isEnabledFor(logging.INFO):
+            return
+        payload = {
+            "event": "span",
+            "name": span.name,
+            "traceId": span.trace_id,
+            "spanId": span.span_id,
+            "parentId": span.parent_id,
+            "startWall": span.start_wall,
+            "seconds": span.seconds,
+        }
+        if span.attrs:
+            payload["attrs"] = {k: v for k, v in span.attrs.items()
+                                if isinstance(v, (str, int, float, bool,
+                                                  type(None)))}
+        if span.error is not None:
+            payload["error"] = span.error
+        _TELEMETRY.info(json.dumps(payload))
+
+
+# THE process-wide tracer (parallel to ``metrics.registry``).
+tracer = Tracer()
+
+
+class StageTimer:
+    """Accumulate named wall-clock spans (the VW ``TrainingStats``
+    nanosecond-timing surface, ``vw/VowpalWabbitBase.scala:27-49``).
+
+    Subsumed by the obs tracer: each ``span`` both nests in the ambient
+    trace (so it shows up in the telemetry sink with parentage) and
+    accumulates into ``totals_ns`` — the original surface callers keep.
+    """
+
+    def __init__(self, tracer_: Tracer | None = None):
+        self.totals_ns: dict[str, int] = {}
+        self._tracer = tracer_ or tracer
+
+    @contextlib.contextmanager
+    def span(self, name: str):
+        t0 = time.perf_counter_ns()
+        try:
+            with self._tracer.span(name):
+                yield
+        finally:
+            self.totals_ns[name] = self.totals_ns.get(name, 0) + \
+                time.perf_counter_ns() - t0
+
+    def as_dict(self) -> dict[str, float]:
+        return {k: v / 1e9 for k, v in self.totals_ns.items()}

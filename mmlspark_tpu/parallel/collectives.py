@@ -6,45 +6,90 @@ backends (SURVEY §2.13): LightGBM's raw-TCP ring/Bruck allreduce
 (``vw/VowpalWabbitBase.scala:434-461``), and Spark broadcast/barrier
 (``LightGBMBase.scala:256-261``). Inside ``shard_map``/``pjit`` these lower
 to XLA collectives that ride ICI within a slice and DCN across slices.
+
+Observability: every collective records into the process-wide obs
+registry — ``collective_calls_total{op,axis}`` and
+``collective_bytes_total{op,axis}`` (per-shard payload bytes). Because
+these helpers run at TRACE time, the counters measure distinct traced
+call sites × retraces, not per-step executions (XLA replays the
+compiled program without re-entering Python) — the right number for
+"what collectives does this program issue, and how big are they".
+Per-execution device time comes from the paired ``named_scope``: capture
+with ``utils.profiling.profile_trace`` and the op shows up labeled in
+XProf, the TPU equivalent of wrapping a socket allreduce in a stopwatch.
 """
 
 from __future__ import annotations
 
+import contextlib
+
 import jax
 import jax.numpy as jnp
+
+from ..obs import registry as _obs
+
+_m_calls = _obs.counter(
+    "collective_calls_total",
+    "collective trace-time issue count, by op/axis")
+_m_bytes = _obs.counter(
+    "collective_bytes_total",
+    "per-shard payload bytes at collective issue, by op/axis")
+
+
+@contextlib.contextmanager
+def _observed(op: str, x, axis):
+    """XProf naming scope; records one collective issue on clean exit —
+    a typo'd axis (or any trace error) raises out of the wrapped lax
+    call and must not leave a phantom series in the registry."""
+    try:
+        nbytes = int(x.size) * x.dtype.itemsize
+    except Exception:
+        nbytes = 0
+    label = axis if isinstance(axis, str) else ",".join(axis)
+    try:
+        scope = jax.named_scope(f"collective.{op}[{label}]")
+    except Exception:  # pragma: no cover - named_scope is cosmetic
+        scope = contextlib.nullcontext()
+    with scope:
+        yield
+    _m_calls.inc(1, op=op, axis=label)
+    _m_bytes.inc(nbytes, op=op, axis=label)
 
 
 def allreduce(x, axis: str | tuple[str, ...], op: str = "sum"):
     """psum/pmax/pmin/pmean over a named mesh axis (LightGBM's histogram
     allreduce; VW's weight averaging with op="mean")."""
-    if op == "sum":
-        return jax.lax.psum(x, axis)
-    if op == "mean":
-        return jax.lax.pmean(x, axis)
-    if op == "max":
-        return jax.lax.pmax(x, axis)
-    if op == "min":
-        return jax.lax.pmin(x, axis)
-    raise ValueError(f"unknown op {op!r}")
+    fns = {"sum": jax.lax.psum, "mean": jax.lax.pmean,
+           "max": jax.lax.pmax, "min": jax.lax.pmin}
+    # validated BEFORE recording: a typo'd op must raise, not leave a
+    # phantom collective series in the registry for the process lifetime
+    if op not in fns:
+        raise ValueError(f"unknown op {op!r}")
+    with _observed(f"allreduce_{op}", x, axis):
+        return fns[op](x, axis)
 
 
 def allgather(x, axis: str, *, tiled: bool = True, gather_axis: int = 0):
     """Gather shards along a named axis (voting-parallel top-K exchange)."""
-    return jax.lax.all_gather(x, axis, axis=gather_axis, tiled=tiled)
+    with _observed("allgather", x, axis):
+        return jax.lax.all_gather(x, axis, axis=gather_axis, tiled=tiled)
 
 
 def psum_scatter(x, axis: str, *, scatter_axis: int = 0):
     """reduce_scatter: each shard gets one slice of the summed tensor."""
-    return jax.lax.psum_scatter(x, axis, scatter_dimension=scatter_axis,
-                                tiled=True)
+    with _observed("psum_scatter", x, axis):
+        return jax.lax.psum_scatter(x, axis,
+                                    scatter_dimension=scatter_axis,
+                                    tiled=True)
 
 
 def ring_permute(x, axis: str, shift: int = 1):
     """Rotate shards around the ring of a named axis (the building block of
     ring attention / sequence parallelism)."""
-    n = jax.lax.axis_size(axis)
-    perm = [(i, (i + shift) % n) for i in range(n)]
-    return jax.lax.ppermute(x, axis, perm)
+    with _observed("ring_permute", x, axis):
+        n = jax.lax.axis_size(axis)
+        perm = [(i, (i + shift) % n) for i in range(n)]
+        return jax.lax.ppermute(x, axis, perm)
 
 
 def barrier(axis: str):
@@ -55,4 +100,6 @@ def barrier(axis: str):
     every program step is already a barrier, but this is handy to delimit
     phases explicitly.
     """
-    return jax.lax.psum(jnp.zeros((), jnp.int32), axis)
+    z = jnp.zeros((), jnp.int32)
+    with _observed("barrier", z, axis):
+        return jax.lax.psum(z, axis)

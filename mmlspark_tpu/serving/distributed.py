@@ -37,9 +37,26 @@ import numpy as np
 
 from ..core import DataFrame
 from ..io.http.schema import HTTPRequestData, HTTPResponseData
+from ..obs import registry as _obs
 from .native_front import NativeServingServer
 from .server import (CachedRequest, LowLatencyHandlerMixin,
                      QuietHTTPServer, ServingServer, _LOG)
+
+# mesh-internal traffic series (obs subsystem): every lease/reply hop
+# counts calls and payload bytes, so a scrape shows where cross-worker
+# bandwidth and replay churn go
+_m_mesh_calls = _obs.counter(
+    "serving_mesh_calls_total",
+    "mesh-internal endpoint hits, by service/endpoint")
+_m_mesh_bytes = _obs.counter(
+    "serving_mesh_bytes_total",
+    "mesh-internal payload bytes, by service/endpoint/direction")
+_m_mesh_reply_seconds = _obs.histogram(
+    "serving_mesh_reply_seconds",
+    "cross-worker reply forwarding wall seconds")
+_m_lease_replays = _obs.counter(
+    "serving_lease_replays_total",
+    "requests replayed because their lease expired (worker death)")
 
 
 @dataclasses.dataclass
@@ -76,11 +93,13 @@ def _resp_from_json(d: dict) -> HTTPResponseData:
         entity=base64.b64decode(d["entity_b64"]) or None)
 
 
-def _post(host: str, port: int, path: str, payload: dict,
+def _post(host: str, port: int, path: str, payload: dict | bytes,
           timeout: float = 10.0) -> tuple[int, bytes]:
+    body = payload if isinstance(payload, bytes) \
+        else json.dumps(payload).encode()
     conn = http.client.HTTPConnection(host, port, timeout=timeout)
     try:
-        conn.request("POST", path, body=json.dumps(payload).encode(),
+        conn.request("POST", path, body=body,
                      headers={"Content-Type": "application/json"})
         resp = conn.getresponse()
         return resp.status, resp.read()
@@ -266,6 +285,11 @@ class DistributedServingServer(ServingServer):
         d = json.loads(body)
         if not self._check_secret(d):
             return 403, b'{"error": "bad mesh secret"}'
+        # counted only past the secret check: the series measures real
+        # cross-worker traffic, not junk sprayed at the public port
+        _m_mesh_calls.inc(1, service=self.name, endpoint="__reply__")
+        _m_mesh_bytes.inc(len(body), service=self.name,
+                          endpoint="__reply__", direction="in")
         with self._lock:
             cached = self.history.get(d["id"])
         self._leases.pop(d["id"], None)
@@ -290,7 +314,11 @@ class DistributedServingServer(ServingServer):
             self._leases[c.id] = (deadline, c)
         out = [{"id": c.id, "request": _req_to_json(c.request)}
                for c in batch]
-        return 200, json.dumps(out).encode()
+        payload = json.dumps(out).encode()
+        _m_mesh_calls.inc(1, service=self.name, endpoint="__lease__")
+        _m_mesh_bytes.inc(len(payload), service=self.name,
+                          endpoint="__lease__", direction="out")
+        return 200, payload
 
     def _monitor_leases(self):
         while not self._stopping.wait(
@@ -308,6 +336,7 @@ class DistributedServingServer(ServingServer):
                 # that request is answered, nothing to replay
                 entry = self._leases.pop(i, None)
                 if entry is not None and not entry[1]._event.is_set():
+                    _m_lease_replays.inc(1, service=self.name)
                     self.replay(entry[1])
 
     # -- cross-worker reply routing ----------------------------------------
@@ -328,13 +357,27 @@ class DistributedServingServer(ServingServer):
         if info is None:
             return False
         base = "" if info.api_path == "/" else info.api_path
+        # serialized once, measured as actually sent on the wire (json
+        # envelope, base64'd entity) — the same measure the receiving
+        # _handle_reply takes, so in/out for one hop agree
+        payload = json.dumps(
+            {"id": request_id,
+             "response": _resp_to_json(response),
+             "secret": self.mesh_secret}).encode()
+        sent = len(payload)
+        t0 = time.perf_counter()
         try:
-            status, body = _post(info.host, info.port, f"{base}/__reply__",
-                                 {"id": request_id,
-                                  "response": _resp_to_json(response),
-                                  "secret": self.mesh_secret})
+            status, body = _post(info.host, info.port,
+                                 f"{base}/__reply__", payload)
         except OSError:
             return False  # owner unreachable (crashed); bool contract
+        # observed only for completed round trips: a crashed owner's
+        # instant connection-refused (or timeout) sample would misstate
+        # healthy forwarding latency
+        _m_mesh_reply_seconds.observe(time.perf_counter() - t0,
+                                      service=self.name)
+        _m_mesh_bytes.inc(sent, service=self.name,
+                          endpoint="__reply__", direction="out")
         return status == 200 and json.loads(body).get("delivered", False)
 
 

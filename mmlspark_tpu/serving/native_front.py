@@ -68,6 +68,11 @@ class _NativeCachedRequest(CachedRequest):
                           int(response.status_code or 500),
                           blob, body, len(body))
         srv.history.pop(self.id, None)
+        # same per-route series the threaded front records (obs
+        # subsystem); latency runs intake → reply
+        srv._observe_request(srv.api_path,
+                             int(response.status_code or 500),
+                             time.perf_counter() - self.created)
         return True
 
 
@@ -163,6 +168,7 @@ class NativeServingServer(ServingServer):
         if lib.hf_req_info(h, nid, meth, 16, path_buf, 4096,
                            ctypes.byref(blen), ctypes.byref(hlen)) != 0:
             return
+        t0 = time.perf_counter()
         body = b""
         if blen.value:
             buf = ctypes.create_string_buffer(blen.value)
@@ -183,9 +189,13 @@ class NativeServingServer(ServingServer):
         if route is not None:
             status, out = route(body)
             lib.hf_reply(h, nid, status, default_ct, out, len(out))
+            self._observe_request(path, status, time.perf_counter() - t0)
             return
         if path != self.api_path:
             lib.hf_reply(h, nid, 404, default_ct, b"", 0)
+            # measured like every other exit — the threaded front records
+            # real elapsed time for 404s, and the two series must agree
+            self._observe_request(path, 404, time.perf_counter() - t0)
             return
         req = HTTPRequestData(
             url=raw_path, method=meth.value.decode(), headers=headers,

@@ -27,6 +27,8 @@ import logging
 
 from ..core import DataFrame
 from ..io.http.schema import HTTPRequestData, HTTPResponseData
+from ..obs import registry as _obs
+from ..obs.tracing import tracer as _tracer
 
 _LOG = logging.getLogger("mmlspark_tpu.serving")
 
@@ -104,6 +106,10 @@ class CachedRequest:
     _event: threading.Event = field(default_factory=threading.Event)
     _response: HTTPResponseData | None = None
     retries: int = 0
+    # intake timestamp (perf_counter) — the native front measures
+    # request latency from here at reply time; the threaded front times
+    # in-handler instead (same series either way)
+    created: float = field(default_factory=time.perf_counter)
 
     def reply(self, response: HTTPResponseData) -> bool:
         if self._event.is_set():
@@ -142,6 +148,47 @@ class ServingServer:
         # internal sub-path handlers (distributed mode registers
         # __reply__/__lease__ here): path -> fn(body) -> (status, bytes)
         self._routes: dict[str, callable] = {}
+        # -- observability (process-wide registry: obs subsystem) ----------
+        # per-route request/error/latency series + a Prometheus text
+        # exposition endpoint. Registered in shared state so BOTH fronts
+        # (threaded python and native epoll) and distributed mode serve
+        # and record identically.
+        self._m_requests = _obs.counter(
+            "serving_requests_total",
+            "requests answered, by service/route/status code")
+        self._m_errors = _obs.counter(
+            "serving_errors_total",
+            "requests answered with status >= 400, by service/route")
+        self._m_latency = _obs.histogram(
+            "serving_request_seconds",
+            "request wall seconds from intake to reply, by service/route")
+        self._m_queue = _obs.gauge(
+            "serving_queue_depth", "queued requests awaiting the executor")
+        self._routes["/metrics"] = self._metrics_route
+        if self.api_path != "/":
+            self._routes[f"{self.api_path}/metrics"] = self._metrics_route
+
+    def _metrics_route(self, body: bytes) -> tuple[int, bytes]:
+        """``GET /metrics``: Prometheus text exposition of the
+        process-wide registry (every subsystem's series, not just this
+        server's — one scrape surface per process)."""
+        return 200, _obs.exposition().encode()
+
+    def _observe_request(self, route: str, status: int,
+                         seconds: float) -> None:
+        """ONE recording site for both fronts: count + latency, by route.
+
+        Only known routes become label values — anything else collapses
+        to ``<unmatched>`` so a client spraying distinct paths cannot
+        grow the registry (and the /metrics exposition) without bound.
+        """
+        if route != self.api_path and route not in self._routes:
+            route = "<unmatched>"
+        self._m_requests.inc(1, service=self.name, route=route,
+                             code=str(status))
+        if status >= 400:
+            self._m_errors.inc(1, service=self.name, route=route)
+        self._m_latency.observe(seconds, service=self.name, route=route)
 
     def __init__(self, name: str, host: str = "127.0.0.1", port: int = 0,
                  api_path: str = "/", reply_timeout: float = 30.0,
@@ -154,11 +201,20 @@ class ServingServer:
         class Handler(LowLatencyHandlerMixin,
                       BaseHTTPRequestHandler):
             def _serve(self):
+                # every exit records into the shared per-route series
+                # (requests/errors/latency) — same recording site the
+                # native front uses, so the two fronts cannot drift
+                t0 = time.perf_counter()
+                path = self.path.split("?", 1)[0].rstrip("/") or "/"
+                status = self._serve_inner(path)
+                serving._observe_request(path, status,
+                                         time.perf_counter() - t0)
+
+            def _serve_inner(self, path: str) -> int:
                 # route on the service path like the reference WorkerServer
                 # (continuous/HTTPSourceV2.scala PublicHandler): anything
                 # not addressed to this service's api_path is 404, never
                 # queued.
-                path = self.path.split("?", 1)[0].rstrip("/") or "/"
                 length = int(self.headers.get("Content-Length", 0))
                 body = self.rfile.read(length) if length else None
                 route = serving._routes.get(path)
@@ -168,12 +224,12 @@ class ServingServer:
                     self.send_header("Content-Length", str(len(out)))
                     self.end_headers()
                     self.wfile.write(out)
-                    return
+                    return status
                 if path != serving.api_path:
                     self.send_response(404)
                     self.send_header("Content-Length", "0")
                     self.end_headers()
-                    return
+                    return 404
                 req = HTTPRequestData(
                     url=self.path, method=self.command,
                     headers=dict(self.headers.items()), entity=body)
@@ -189,7 +245,7 @@ class ServingServer:
                     self.send_header("Retry-After", "1")
                     self.send_header("Content-Length", "0")
                     self.end_headers()
-                    return
+                    return 503
                 resp = cached.wait(serving.reply_timeout)
                 with serving._lock:
                     serving.history.pop(cached.id, None)
@@ -204,6 +260,7 @@ class ServingServer:
                     self.wfile.write(body)
                 except (BrokenPipeError, ConnectionResetError):
                     pass  # flaky client; reference tolerates these too
+                return resp.status_code or 500
 
             do_GET = do_POST = do_PUT = _serve
 
@@ -255,6 +312,10 @@ class ServingServer:
                     batch.append(self.queue.get(timeout=remaining))
             except queue.Empty:
                 break
+        # depth AFTER the drain = standing backlog the executor can't
+        # keep up with (qsize is approximate under concurrency; a gauge
+        # tolerates that)
+        self._m_queue.set(self.queue.qsize(), service=self.name)
         return batch
 
     def replay(self, cached: CachedRequest) -> None:
@@ -309,18 +370,32 @@ class ServingQuery:
         self._thread.join(timeout)
 
     def _run(self):
+        batch_rows = _obs.histogram(
+            "serving_batch_rows", "requests per executor batch",
+            buckets=tuple(float(1 << k) for k in range(11)))
+        batch_seconds = _obs.histogram(
+            "serving_batch_seconds", "transform wall seconds per batch")
+        batch_failures = _obs.counter(
+            "serving_batch_failures_total",
+            "executor batches that raised and were replayed")
         while not self._stop.is_set():
             batch = self.server.next_batch(max_batch=self.max_batch,
                                            linger=self.linger)
             if not batch:
                 continue
+            batch_rows.observe(len(batch), service=self.name)
             ids = np.empty(len(batch), object)
             reqs = np.empty(len(batch), object)
             ids[:] = [c.id for c in batch]
             reqs[:] = [c.request for c in batch]
             df = DataFrame({"id": ids, "request": reqs})
             try:
-                out = self.transform_fn(df)
+                # the span roots here (the executor thread has no ambient
+                # context); batch latency also lands in the registry
+                with batch_seconds.time(service=self.name), \
+                        _tracer.span("serving.batch", parent=None,
+                                     service=self.name, rows=len(batch)):
+                    out = self.transform_fn(df)
                 if out is not None and "reply" in getattr(
                         out, "columns", []):
                     by_id = {c.id: c for c in batch}
@@ -330,6 +405,7 @@ class ServingQuery:
                             c.reply(reply)
             except Exception as e:  # replay the whole failed batch
                 self.exception = e
+                batch_failures.inc(1, service=self.name)
                 _LOG.warning("serving batch failed, replaying: %s",
                              traceback.format_exc())
                 for c in batch:

@@ -5,12 +5,22 @@ values with explicit tolerance recorded in CSVs
 (``src/test/resources/benchmarks/benchmarks_<Suite>.csv``); the test
 recomputes each metric and ``compareBenchmark`` asserts it matches within
 precision. Same CSV format here: ``name,value,precision`` rows.
+
+Timings come through the obs subsystem, not private stopwatches: a
+``timed(...)`` region records into the process-wide registry
+(``benchmark_seconds{name=...}``) and the benchmark row reads the value
+back from that same histogram, so a benchmark timing is always also a
+scrapeable series (``/metrics``, ``registry.snapshot()``) — one
+measurement surface for benches, serving, and training alike.
 """
 
 from __future__ import annotations
 
+import contextlib
 import csv
 import os
+
+from ..obs.metrics import registry as _registry
 
 
 class Benchmarks:
@@ -23,6 +33,33 @@ class Benchmarks:
     def add(self, name: str, value: float, precision: float) -> None:
         """Reference ``addBenchmark``."""
         self.recorded.append((name, float(value), float(precision)))
+
+    @contextlib.contextmanager
+    def timed(self, name: str, precision: float):
+        """Time a region through the obs registry and record the row.
+
+        The wall seconds land in the process-wide
+        ``benchmark_seconds{name=...}`` histogram (scrapeable alongside
+        serving/training series) and THIS region's duration becomes the
+        CSV row — not an aggregate over the labeled series, which would
+        fold warmup passes and prior in-process runs into the value."""
+        hist = _registry.histogram(
+            "benchmark_seconds", "benchmark timed-region wall seconds")
+        with hist.time(name=name) as t:
+            yield
+        self.add(name, t.seconds, precision)
+
+    def add_from_registry(self, name: str, sample: str,
+                          precision: float, registry=None) -> None:
+        """Record a registry sample (a ``snapshot()`` key, e.g.
+        ``serving_requests_total{route="/"}``) as a benchmark row."""
+        snap = (registry if registry is not None else _registry) \
+            .snapshot()
+        if sample not in snap:
+            raise KeyError(
+                f"registry sample {sample!r} not found; known samples "
+                f"include {sorted(snap)[:8]}...")
+        self.add(name, snap[sample], precision)
 
     def _load(self) -> dict[str, tuple[float, float]]:
         out = {}

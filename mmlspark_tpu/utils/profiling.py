@@ -1,16 +1,20 @@
-"""Profiling & tracing.
+"""Profiling: device traces (XProf) + trace annotations.
 
 The reference has no tracer (SURVEY §5) — only the ``Timer`` transformer
 and VW's nanosecond stopwatches. The TPU build upgrades this to
-``jax.profiler`` device traces (viewable in XProf/TensorBoard) plus the
-same stage-timing surface.
+``jax.profiler`` device traces (viewable in XProf/TensorBoard); the
+host-side span/timing surface lives in ``mmlspark_tpu.obs`` (one
+registry + tracer for every layer — see docs/observability.md).
+``StageTimer`` is re-exported from there: same ``span``/``as_dict``
+contract, now nesting into the process-wide trace as well.
 """
 
 from __future__ import annotations
 
 import contextlib
 import functools
-import time
+
+from ..obs.tracing import StageTimer  # noqa: F401  (compat re-export)
 
 
 @contextlib.contextmanager
@@ -38,23 +42,3 @@ def profiled(name: str | None = None):
                 return fn(*args, **kwargs)
         return inner
     return wrap
-
-
-class StageTimer:
-    """Accumulate named wall-clock spans (the VW ``TrainingStats``
-    nanosecond-timing surface, ``vw/VowpalWabbitBase.scala:27-49``)."""
-
-    def __init__(self):
-        self.totals_ns: dict[str, int] = {}
-
-    @contextlib.contextmanager
-    def span(self, name: str):
-        t0 = time.perf_counter_ns()
-        try:
-            yield
-        finally:
-            self.totals_ns[name] = self.totals_ns.get(name, 0) + \
-                time.perf_counter_ns() - t0
-
-    def as_dict(self) -> dict[str, float]:
-        return {k: v / 1e9 for k, v in self.totals_ns.items()}

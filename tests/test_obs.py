@@ -1,0 +1,374 @@
+"""Unified observability subsystem (mmlspark_tpu/obs/): metric
+semantics under threads, span nesting/propagation (including across the
+serving worker pool), Prometheus text exposition, and the ``/metrics``
+route end-to-end on a live serving server.
+"""
+
+import http.client
+import json
+import logging
+import threading
+
+import numpy as np
+import pytest
+
+from mmlspark_tpu.obs import (DEFAULT_LATENCY_BUCKETS, MetricsRegistry,
+                              StageTimer, Tracer, registry, tracer)
+
+
+@pytest.fixture()
+def reg():
+    """A private registry per test — the process-wide one stays
+    untouched so e2e tests and production wiring keep accumulating."""
+    return MetricsRegistry()
+
+
+@pytest.fixture()
+def telemetry_events():
+    """Capture mmlspark_tpu.telemetry JSON events for the test's
+    duration; yields the decoded list."""
+    logger = logging.getLogger("mmlspark_tpu.telemetry")
+    records = []
+
+    class Capture(logging.Handler):
+        def emit(self, record):
+            records.append(json.loads(record.getMessage()))
+
+    handler = Capture(level=logging.INFO)
+    old_level = logger.level
+    logger.addHandler(handler)
+    logger.setLevel(logging.INFO)
+    try:
+        yield records
+    finally:
+        logger.removeHandler(handler)
+        logger.setLevel(old_level)
+
+
+class TestMetricSemantics:
+    def test_counter(self, reg):
+        c = reg.counter("c_total", "help")
+        c.inc()
+        c.inc(2.5)
+        assert c.value() == 3.5
+        c.inc(1, route="/a")
+        c.inc(1, route="/a")
+        c.inc(1, route="/b")
+        assert c.value(route="/a") == 2
+        assert c.value(route="/b") == 1
+        assert c.value() == 3.5  # unlabeled series is its own series
+        with pytest.raises(ValueError):
+            c.inc(-1)
+
+    def test_gauge(self, reg):
+        g = reg.gauge("g")
+        g.set(7, svc="x")
+        g.inc(2, svc="x")
+        g.dec(1, svc="x")
+        assert g.value(svc="x") == 8
+        g.dec(5)  # gauges go negative
+        assert g.value() == -5
+
+    def test_histogram_buckets_sum_count(self, reg):
+        h = reg.histogram("h_seconds", buckets=(1.0, 2.0, 4.0))
+        for v in (0.5, 1.0, 3.0, 100.0):
+            h.observe(v)
+        assert h.count() == 4
+        assert h.sum() == pytest.approx(104.5)
+        s = reg.snapshot()
+        # cumulative buckets: 0.5 and 1.0 land in le=1 (upper bounds
+        # are inclusive), 3.0 in le=4, 100.0 only in +Inf
+        assert s['h_seconds_bucket{le="1"}'] == 2
+        assert s['h_seconds_bucket{le="2"}'] == 2
+        assert s['h_seconds_bucket{le="4"}'] == 3
+        assert s['h_seconds_bucket{le="+Inf"}'] == 4
+        assert s["h_seconds_count"] == 4
+
+    def test_histogram_timer(self, reg):
+        h = reg.histogram("t_seconds")
+        with h.time(phase="x") as t:
+            pass
+        assert t.seconds >= 0
+        assert h.count(phase="x") == 1
+        assert h.sum(phase="x") == pytest.approx(t.seconds)
+
+    def test_default_buckets_are_log_scale(self):
+        ratios = {DEFAULT_LATENCY_BUCKETS[i + 1] / DEFAULT_LATENCY_BUCKETS[i]
+                  for i in range(len(DEFAULT_LATENCY_BUCKETS) - 1)}
+        assert ratios == {2.0}
+
+    def test_get_or_create_idempotent_and_type_checked(self, reg):
+        c1 = reg.counter("m")
+        assert reg.counter("m") is c1
+        with pytest.raises(TypeError):
+            reg.gauge("m")
+        with pytest.raises(TypeError):
+            reg.histogram("m")
+        # conflicting bucket ladders are as bad as conflicting kinds:
+        # creation order must never silently decide which one wins
+        h = reg.histogram("h", buckets=(1.0, 2.0))
+        assert reg.histogram("h", buckets=(2.0, 1.0)) is h  # order-free
+        with pytest.raises(ValueError):
+            reg.histogram("h", buckets=(1.0, 4.0))
+        with pytest.raises(ValueError):
+            reg.histogram("h")  # defaults conflict with the custom ladder
+
+    def test_exact_counts_under_threads(self, reg):
+        c = reg.counter("c_total")
+        g = reg.gauge("g")
+        h = reg.histogram("h", buckets=(0.5,))
+        n_threads, n_iter = 8, 2000
+
+        def work():
+            for _ in range(n_iter):
+                c.inc(1, t="x")
+                g.inc(1)
+                h.observe(1.0)
+
+        threads = [threading.Thread(target=work)
+                   for _ in range(n_threads)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        total = n_threads * n_iter
+        assert c.value(t="x") == total
+        assert g.value() == total
+        assert h.count() == total
+        assert reg.snapshot()['h_bucket{le="+Inf"}'] == total
+
+
+class TestExposition:
+    def test_format(self, reg):
+        c = reg.counter("req_total", "requests served")
+        c.inc(3, route="/a", code="200")
+        reg.gauge("depth", "queue depth").set(2)
+        reg.histogram("lat_seconds", "latency", buckets=(1.0,)) \
+            .observe(0.5)
+        text = reg.exposition()
+        assert text.endswith("\n")
+        lines = text.splitlines()
+        assert "# HELP req_total requests served" in lines
+        assert "# TYPE req_total counter" in lines
+        assert "# TYPE depth gauge" in lines
+        assert "# TYPE lat_seconds histogram" in lines
+        # labels sorted by key, values quoted
+        assert 'req_total{code="200",route="/a"} 3' in lines
+        assert "depth 2" in lines
+        assert 'lat_seconds_bucket{le="1"} 1' in lines
+        assert 'lat_seconds_bucket{le="+Inf"} 1' in lines
+        assert "lat_seconds_sum 0.5" in lines
+        assert "lat_seconds_count 1" in lines
+
+    def test_label_escaping(self, reg):
+        reg.counter("c").inc(1, path='a"b\\c\nd')
+        text = reg.exposition()
+        assert 'c{path="a\\"b\\\\c\\nd"} 1' in text
+
+    def test_snapshot_matches_exposition(self, reg):
+        reg.counter("x_total").inc(4, k="v")
+        reg.histogram("y", buckets=(1.0,)).observe(2.0)
+        snap = reg.snapshot()
+        sample_lines = {
+            line.rsplit(" ", 1)[0]: float(line.rsplit(" ", 1)[1])
+            for line in reg.exposition().splitlines()
+            if not line.startswith("#")}
+        assert sample_lines == snap
+
+
+class TestTracing:
+    def test_nesting_and_context_propagation(self, reg,
+                                             telemetry_events):
+        tr = Tracer(registry=reg)
+        with tr.span("outer") as outer:
+            assert tr.current_span() is outer
+            with tr.span("inner") as inner:
+                assert inner.parent_id == outer.span_id
+                assert inner.trace_id == outer.trace_id
+        assert tr.current_span() is None
+        names = [e["name"] for e in telemetry_events
+                 if e.get("event") == "span"]
+        assert names == ["inner", "outer"]  # children end first
+        by_name = {e["name"]: e for e in telemetry_events}
+        assert by_name["inner"]["parentId"] == \
+            by_name["outer"]["spanId"]
+        assert by_name["outer"]["parentId"] is None
+        assert by_name["outer"]["seconds"] >= \
+            by_name["inner"]["seconds"]
+
+    def test_cross_thread_explicit_parent(self, reg):
+        tr = Tracer(registry=reg)
+        seen = {}
+
+        def worker(parent):
+            with tr.span("child", parent=parent) as sp:
+                seen["trace"] = sp.trace_id
+                seen["parent"] = sp.parent_id
+
+        with tr.span("root") as root:
+            t = threading.Thread(target=worker, args=(root,))
+            t.start()
+            t.join()
+        assert seen["trace"] == root.trace_id
+        assert seen["parent"] == root.span_id
+
+    def test_error_recorded_and_raised(self, reg, telemetry_events):
+        tr = Tracer(registry=reg)
+        with pytest.raises(RuntimeError):
+            with tr.span("boom"):
+                raise RuntimeError("nope")
+        (event,) = [e for e in telemetry_events if e["name"] == "boom"]
+        assert "nope" in event["error"]
+
+    def test_span_metric_lands_in_registry(self, reg):
+        tr = Tracer(registry=reg, metric="span_seconds")
+        with tr.span("timed"):
+            pass
+        assert reg.histogram("span_seconds").count(span="timed") == 1
+
+    def test_non_current_span_leaves_context_alone(self, reg):
+        tr = Tracer(registry=reg)
+        sp = tr.start_span("detached", current=False)
+        assert tr.current_span() is None
+        tr.end_span(sp, emit=False)
+        assert sp.seconds is not None
+        # idempotent end (break + fallthrough double-end)
+        s0 = sp.seconds
+        tr.end_span(sp, emit=False)
+        assert sp.seconds == s0
+
+    def test_stage_timer_compat_and_nesting(self, reg,
+                                            telemetry_events):
+        st = StageTimer()
+        with st.span("stage"):
+            pass
+        assert list(st.as_dict()) == ["stage"]
+        assert st.as_dict()["stage"] >= 0
+        assert any(e["name"] == "stage" for e in telemetry_events)
+
+    def test_profiling_reexport(self):
+        from mmlspark_tpu.utils.profiling import StageTimer as ST
+        assert ST is StageTimer
+
+
+def _get(addr, path):
+    conn = http.client.HTTPConnection(*addr, timeout=10)
+    try:
+        conn.request("GET", path)
+        resp = conn.getresponse()
+        return resp.status, resp.read()
+    finally:
+        conn.close()
+
+
+def _post(addr, body):
+    conn = http.client.HTTPConnection(*addr, timeout=10)
+    try:
+        conn.request("POST", "/", body=body)
+        resp = conn.getresponse()
+        return resp.status, resp.read()
+    finally:
+        conn.close()
+
+
+class TestServingEndToEnd:
+    def test_metrics_route_and_worker_pool_spans(self,
+                                                 telemetry_events):
+        """POSTs through a live server land in the per-route series
+        (scrapeable at GET /metrics AND via registry.snapshot()), and
+        a transform that opens spans nests them under the executor's
+        serving.batch span across the worker-pool thread boundary."""
+        from mmlspark_tpu.io.http import HTTPResponseData
+        from mmlspark_tpu.serving.server import serving_query
+
+        def transform(df):
+            with tracer.span("transform.work", rows=len(df)):
+                replies = np.empty(len(df), object)
+                replies[:] = [HTTPResponseData(
+                    status_code=200, entity=b"ok")] * len(df)
+            return df.with_column("reply", replies)
+
+        query = serving_query("obs-e2e", transform, backend="python")
+        addr = query.server.address
+        try:
+            for _ in range(5):
+                status, body = _post(addr, b"payload")
+                assert (status, body) == (200, b"ok")
+            status, text = _get(addr, "/metrics")
+        finally:
+            query.stop()
+        assert status == 200
+        text = text.decode()
+
+        # exposition and snapshot agree on non-zero request counts +
+        # latency buckets for the exercised route
+        snap = registry.snapshot()
+        req_key = ('serving_requests_total{code="200",route="/",'
+                   'service="obs-e2e"}')
+        assert snap[req_key] >= 5
+        lat_inf = ('serving_request_seconds_bucket{route="/",'
+                   'service="obs-e2e",le="+Inf"}')
+        assert snap[lat_inf] >= 5
+        assert f"{req_key} {int(snap[req_key])}" in text
+        assert "serving_request_seconds_bucket" in text
+        assert "# TYPE serving_requests_total counter" in text
+
+        # worker-pool span propagation: transform.work roots under the
+        # executor thread's serving.batch span, same trace
+        spans = [e for e in telemetry_events if e.get("event") == "span"]
+        batches = {e["spanId"]: e for e in spans
+                   if e["name"] == "serving.batch"}
+        works = [e for e in spans if e["name"] == "transform.work"]
+        assert batches and works
+        for w in works:
+            assert w["parentId"] in batches
+            assert w["traceId"] == batches[w["parentId"]]["traceId"]
+
+    def test_metrics_route_404s_do_not_queue(self):
+        from mmlspark_tpu.serving.server import serving_query
+
+        def transform(df):
+            return df  # never replies; nothing should reach it
+
+        query = serving_query("obs-404", transform, backend="python")
+        addr = query.server.address
+        try:
+            status, _ = _get(addr, "/nope")
+        finally:
+            query.stop()
+        assert status == 404
+        # unknown paths collapse to one label value — a client spraying
+        # distinct paths must not grow the registry without bound
+        assert registry.counter("serving_errors_total").value(
+            service="obs-404", route="<unmatched>") == 1
+        assert registry.counter("serving_errors_total").value(
+            service="obs-404", route="/nope") == 0
+
+
+class TestLightGBMSpans:
+    def test_fit_produces_nested_boosting_round_spans(
+            self, telemetry_events):
+        """Acceptance: a traced fit emits lightgbm.fit with
+        boosting_round children in the JSON telemetry log, and the
+        per-round histogram fills."""
+        from mmlspark_tpu.core import DataFrame
+        from mmlspark_tpu.lightgbm import LightGBMClassifier
+
+        rng = np.random.default_rng(3)
+        x = rng.normal(size=(200, 4)).astype(np.float32)
+        y = (x[:, 0] > 0).astype(np.float32)
+        before = registry.histogram(
+            "lightgbm_boosting_round_seconds").count(mode="fused")
+        LightGBMClassifier(numIterations=3, numShards=1).fit(
+            DataFrame({"features": x, "label": y}))
+        spans = [e for e in telemetry_events if e.get("event") == "span"]
+        fits = [e for e in spans if e["name"] == "lightgbm.fit"]
+        rounds = [e for e in spans if e["name"] == "boosting_round"]
+        assert len(fits) == 1
+        assert fits[0]["attrs"]["iterations"] == 3
+        assert rounds and all(
+            r["parentId"] == fits[0]["spanId"] and
+            r["traceId"] == fits[0]["traceId"] for r in rounds)
+        after = registry.histogram(
+            "lightgbm_boosting_round_seconds").count(mode="fused")
+        assert after - before == len(rounds)

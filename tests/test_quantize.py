@@ -51,6 +51,38 @@ def test_fidelity_both_block_types(block, sizes):
     assert cos > 0.99, cos
 
 
+def test_rows_independent_of_minibatch_neighbors():
+    """Per-row dynamic activation scale (ADVICE round-5): a quantized
+    row's features must not change when an outlier row joins its
+    minibatch — scales are max over non-batch axes, never batch-wide."""
+    module, variables = _build(BasicBlock, (1, 1))
+    qf, qp = quantize_resnet(module, variables)
+    f = jax.jit(qf)
+    rng = np.random.default_rng(7)
+    row = rng.normal(size=(1, 64, 64, 3)).astype(np.float32)
+    outlier = (100.0 * rng.normal(size=(1, 64, 64, 3))).astype(
+        np.float32)
+    alone = np.asarray(f(qp, jnp.asarray(row)))
+    batched = np.asarray(f(qp, jnp.asarray(
+        np.concatenate([row, outlier]))))
+    np.testing.assert_array_equal(alone[0], batched[0])
+
+
+def test_qdense_rows_independent():
+    from mmlspark_tpu.models.quantize import _qdense, _quant_dense_w
+    rng = np.random.default_rng(8)
+    w = jnp.asarray(rng.normal(size=(6, 5)), jnp.float32)
+    wq, sw = _quant_dense_w(w)
+    b = jnp.zeros(5, jnp.float32)
+    x = jnp.asarray(rng.normal(size=(1, 3, 6)), jnp.float32)
+    outlier = 50.0 * jnp.asarray(rng.normal(size=(1, 3, 6)),
+                                 jnp.float32)
+    alone = np.asarray(_qdense(x, wq, sw, b))
+    batched = np.asarray(_qdense(jnp.concatenate([x, outlier]),
+                                 wq, sw, b))
+    np.testing.assert_array_equal(alone[0], batched[0])
+
+
 def test_weights_are_int8():
     module, variables = _build(BasicBlock, (1, 1))
     _, qp = quantize_resnet(module, variables)
