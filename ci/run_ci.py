@@ -44,6 +44,7 @@ PACKAGES: dict[str, list[str]] = {
            "test_reference_parity.py", "test_out_of_core.py",
            "test_ci.py", "test_bench_banking.py", "test_rcheck.py"],
     "obs": ["test_obs.py"],
+    "sched": ["test_sched.py"],  # admission/batching policy + scheduler
     "text": ["test_text_transfer.py", "test_causal_lm.py",
              "test_speculative.py"],
 }
@@ -66,6 +67,18 @@ def style() -> int:
     smoke = ("import sys; from mmlspark_tpu.obs import registry, tracer; "
              "assert 'jax' not in sys.modules, 'obs import pulled in jax'; "
              "print('obs import OK (no jax)')")
+    rc = _run([sys.executable, "-c", smoke],
+              env=dict(os.environ, JAX_PLATFORMS="cpu"))
+    if rc:
+        return rc
+    # sched (admission control + batch policy) is pure stdlib + obs:
+    # it must import and schedule with no device and no JAX at all —
+    # the serving fronts run it from handler threads, and offline
+    # pipelines use the same BatchPolicy on machines with no TPU
+    smoke = ("import sys; import mmlspark_tpu.sched as s; "
+             "assert 'jax' not in sys.modules, 'sched import pulled jax'; "
+             "s.RequestScheduler('ci-smoke').submit(type('I', (), {})()); "
+             "print('sched import OK (no jax)')")
     rc = _run([sys.executable, "-c", smoke],
               env=dict(os.environ, JAX_PLATFORMS="cpu"))
     if rc:

@@ -9,7 +9,7 @@ by ``jax.sharding`` over the mesh.
 """
 
 from .bert import BertEncoder
-from .generate import TextGenerator, generate
+from .generate import ContinuousGenerator, TextGenerator, generate
 from .speculative import generate_speculative
 from .model import TPUModel
 from .pretrain import (MaskedLMModel, encoder_variables,
@@ -24,5 +24,5 @@ __all__ = ["TPUModel", "TrainState", "make_train_step",
            "TextEncoderFeaturizer", "make_attention_fn",
            "MaskedLMModel", "encoder_variables", "pretrain_masked_lm",
            "pretrain_causal_lm", "generate", "generate_speculative",
-           "TextGenerator",
+           "TextGenerator", "ContinuousGenerator",
            "BertEncoder"]

@@ -244,6 +244,148 @@ def generate(module, variables, prompt_ids, *, max_new_tokens: int,
                           jnp.asarray(ptr), jax.random.PRNGKey(seed)))
 
 
+class ContinuousGenerator:
+    """Continuous batching for causal-LM decoding: a FIXED pool of
+    sequence slots over a fixed ``[slots, max_len]`` token buffer, with
+    new sequences admitted into free slots at **step boundaries**
+    instead of waiting for the whole batch to drain.
+
+    Why: classic dynamic batching (``generate`` behind a batcher) makes
+    an arriving prompt wait for every in-flight generation to finish —
+    up to ``max_new_tokens`` full steps of queueing. Here a sequence
+    waits at most ONE decode step for a free slot. Slot bookkeeping and
+    admission order live in ``sched.SlotScheduler`` (the same policy
+    layer online serving uses — pure Python, device-free); this class
+    is the device half: ONE jitted step program whose shapes never
+    change (``[slots, max_len]``), so admission costs a buffer write,
+    never a recompile.
+
+    Decode math matches ``generate(use_cache=False)``: each step runs a
+    full causal forward and samples from the logits at each row's
+    ``ptr - 1`` (``_sample``, the shared epilogue). With
+    ``temperature=0`` (greedy) per-sequence outputs are IDENTICAL to
+    the non-continuous path — rows of a causal transformer are batch-
+    independent — which is the equivalence contract the tests pin.
+    With ``temperature > 0`` each token is still a sample from the
+    model's distribution, but the sampled STREAM differs from
+    ``generate``'s: keys fold in the global step index, and a sequence
+    admitted mid-flight sees different step indices than one starting a
+    fresh batch (same caveat as ``TextGenerator.draftLm``).
+
+    Each step re-encodes the whole buffer (O(L²·W) per step, the
+    ``use_cache=False`` reference path); slot-wise KV caches with
+    per-slot prefill are the follow-up optimization and change nothing
+    about the admission protocol.
+    """
+
+    def __init__(self, module, variables, *, slots: int = 4,
+                 max_len: int = 64, temperature: float = 0.0,
+                 pad_id: int = 0, seed: int = 0,
+                 service: str = "generate", registry=None):
+        from ..sched import SlotScheduler
+
+        self.module = module
+        self.variables = variables
+        self.slots = int(slots)
+        self.max_len = int(max_len)
+        self.temperature = float(temperature)
+        self.pad_id = int(pad_id)
+        self.sched = SlotScheduler(self.slots, service=service,
+                                   registry=registry)
+        self._buf = jnp.full((self.slots, self.max_len), self.pad_id,
+                             jnp.int32)
+        # free slots idle at ptr=1 (keeps the ptr-1 logit gather in
+        # bounds); their sampled tokens are never written (write mask)
+        self._ptr = jnp.ones((self.slots,), jnp.int32)
+        self._active = np.zeros(self.slots, bool)
+        self._key = jax.random.PRNGKey(seed)
+        self._step_idx = 0
+        self._probed = False
+        self._run = self._make_step()
+
+    def _make_step(self):
+        module, temperature, pad_id = \
+            self.module, self.temperature, self.pad_id
+        S, L = self.slots, self.max_len
+
+        @jax.jit
+        def step(params, buf, ptr, active, key, i):
+            logits = module.apply({"params": params}, buf)["logits"]
+            last = jnp.take_along_axis(
+                logits, (ptr - 1)[:, None, None].astype(jnp.int32),
+                axis=1)[:, 0]                            # [S, V]
+            nxt = _sample(last, jax.random.fold_in(key, i), temperature,
+                          pad_id)
+            write = active & (ptr < L)
+            at = jnp.minimum(ptr, L - 1)
+            cur = buf[jnp.arange(S), at]
+            buf = buf.at[jnp.arange(S), at].set(
+                jnp.where(write, nxt, cur))
+            return buf, ptr + write.astype(jnp.int32)
+
+        return step
+
+    # -- intake ------------------------------------------------------------
+    def submit(self, seq_id, prompt_ids, max_new_tokens: int) -> None:
+        """Queue one sequence. ``prompt_ids``: 1-D int32, no padding.
+        Admitted at the next step boundary with a free slot."""
+        prompt = np.asarray(prompt_ids, np.int32).reshape(-1)
+        if prompt.size == 0:
+            raise ValueError("empty prompt")
+        if (prompt == self.pad_id).any():
+            raise ValueError(f"prompt contains pad_id={self.pad_id}")
+        if prompt.size + max_new_tokens > self.max_len:
+            raise ValueError(
+                f"prompt ({prompt.size}) + {max_new_tokens} new tokens "
+                f"exceeds max_len={self.max_len}")
+        if not self._probed:
+            # same causality gate as generate(): a bidirectional
+            # encoder would silently condition on its own padding
+            from .pretrain import assert_causal
+            probe = prompt[None, :] if prompt.size >= 2 else \
+                np.repeat(prompt[None, :], 2, axis=1)
+            vocab = getattr(getattr(self.module, "encoder", None),
+                            "vocab", int(probe.max()) + 2)
+            assert_causal(self.module,
+                          {"params": self.variables["params"]}, probe,
+                          vocab)
+            self._probed = True
+        self.sched.offer(seq_id, prompt, int(max_new_tokens))
+
+    # -- the boundary protocol ---------------------------------------------
+    def step(self) -> list:
+        """One step boundary: admit pending sequences into free slots,
+        run one jitted decode step, account completions. Returns
+        ``(seq_id, output_row)`` pairs finished by this step."""
+        for a in self.sched.admit():
+            row = np.full(self.max_len, self.pad_id, np.int32)
+            row[:len(a.prompt)] = a.prompt
+            self._buf = self._buf.at[a.slot].set(jnp.asarray(row))
+            self._ptr = self._ptr.at[a.slot].set(len(a.prompt))
+            self._active[a.slot] = True
+        if not self._active.any():
+            return []
+        self._buf, self._ptr = self._run(
+            self.variables["params"], self._buf, self._ptr,
+            jnp.asarray(self._active), self._key, self._step_idx)
+        self._step_idx += 1
+        done = []
+        for seq_id, slot in self.sched.step():
+            self._active[slot] = False
+            done.append((seq_id, np.asarray(self._buf[slot])))
+        return done
+
+    def run_until_drained(self) -> dict:
+        """Step until every offered sequence completes; returns
+        ``{seq_id: [max_len] int32 row}`` (prompt, then generated
+        tokens, then pad)."""
+        out = {}
+        while self.sched.busy:
+            for seq_id, row in self.step():
+                out[seq_id] = row
+        return out
+
+
 class TextGenerator(Transformer, HasInputCol, HasOutputCol):
     """Pipeline stage: text prompts → generated continuations.
 

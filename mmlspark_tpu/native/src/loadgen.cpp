@@ -128,12 +128,16 @@ int read_response(int fd, std::string& carry) {
 }
 
 void run_conn(const char* host, int port, const std::string& request,
-              long nreq, double* lat_ms, ConnResult* res) {
+              long nreq, double* lat_ms, int* status_out,
+              ConnResult* res) {
   int fd = connect_to(host, port);
   if (fd < 0) {
     res->hard_fail = true;
     res->errors = nreq;
-    for (long i = 0; i < nreq; ++i) lat_ms[i] = -1.0;
+    for (long i = 0; i < nreq; ++i) {
+      lat_ms[i] = -1.0;
+      if (status_out) status_out[i] = -1;
+    }
     return;
   }
   std::string carry;
@@ -147,16 +151,21 @@ void run_conn(const char* host, int port, const std::string& request,
     // server fails sends in ~0.05 ms and near-zero "latencies" would
     // otherwise pollute the percentiles and count as completions.
     // Non-200 HTTP replies are real round trips — latency stands,
-    // error counted.
+    // error counted; the per-request status lets the Python side
+    // separate sheds (429) from successes instead of folding them.
     lat_ms[i] = status < 0 ? -1.0
         : std::chrono::duration<double, std::milli>(t1 - t0).count();
+    if (status_out) status_out[i] = status;
     if (status != 200) {
       ++res->errors;
       if (status < 0) {  // transport death: reconnect once, else bail
         ::close(fd);
         fd = connect_to(host, port);
         if (fd < 0) {
-          for (long j = i + 1; j < nreq; ++j) lat_ms[j] = -1.0;
+          for (long j = i + 1; j < nreq; ++j) {
+            lat_ms[j] = -1.0;
+            if (status_out) status_out[j] = -1;
+          }
           res->errors += nreq - i - 1;
           res->hard_fail = true;
           return;
@@ -174,11 +183,14 @@ extern "C" {
 
 // Drive `nconn` keep-alive connections of `nreq` serial POSTs each.
 // lat_ms must hold nconn*nreq doubles (connection-major; failed slots
-// are -1). Returns total non-200/transport errors, or -1 when every
-// connection failed to even connect.
-long lg_run(const char* host, int port, int nconn, long nreq,
-            const char* path, const unsigned char* body, long body_len,
-            double* lat_ms, double* wall_s) {
+// are -1); status_out, when non-null, receives the per-request HTTP
+// status (-1 = transport failure) so the caller can split successes
+// from sheds (429) and errors instead of folding them into one number.
+// Returns total non-200/transport errors, or -1 when every connection
+// failed to even connect.
+long lg_run2(const char* host, int port, int nconn, long nreq,
+             const char* path, const unsigned char* body, long body_len,
+             double* lat_ms, int* status_out, double* wall_s) {
   std::string request;
   request.reserve(256 + static_cast<size_t>(body_len));
   request += "POST ";
@@ -196,6 +208,8 @@ long lg_run(const char* host, int port, int nconn, long nreq,
   for (int c = 0; c < nconn; ++c)
     threads.emplace_back(run_conn, host, port, std::cref(request), nreq,
                          lat_ms + static_cast<long>(c) * nreq,
+                         status_out ? status_out
+                             + static_cast<long>(c) * nreq : nullptr,
                          &results[static_cast<size_t>(c)]);
   for (auto& t : threads) t.join();
   auto t1 = Clock::now();
@@ -209,6 +223,14 @@ long lg_run(const char* host, int port, int nconn, long nreq,
   }
   if (hard == nconn) return -1;
   return errors;
+}
+
+// Back-compat entry point (no per-request statuses).
+long lg_run(const char* host, int port, int nconn, long nreq,
+            const char* path, const unsigned char* body, long body_len,
+            double* lat_ms, double* wall_s) {
+  return lg_run2(host, port, nconn, nreq, path, body, body_len, lat_ms,
+                 nullptr, wall_s);
 }
 
 }  // extern "C"

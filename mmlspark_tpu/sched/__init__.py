@@ -1,0 +1,30 @@
+"""Admission-controlled request scheduling (see docs/serving.md
+"Scheduling and overload").
+
+The process-wide layer between the HTTP fronts and the model executors:
+
+- :class:`~.policy.AdmissionController` — bounded queues, per-route
+  concurrency limits, predictive deadline-budget load shedding
+  (429 + Retry-After).
+- :class:`~.policy.BatchPolicy` — the adaptive batch-close decision
+  (deadline slack / padding-bucket fill / learned service-time EWMA),
+  shared by online serving and ``stages.DynamicBufferedBatcher``.
+- :class:`~.scheduler.RequestScheduler` — the deadline-aware queue the
+  serving fronts enqueue into and ``ServingQuery`` pulls batches from
+  (condition-variable wakeups: zero idle CPU, immediate dispatch).
+- :class:`~.continuous.SlotScheduler` — step-boundary admission for
+  continuous generation batching (device half:
+  ``dl.generate.ContinuousGenerator``).
+
+Import is stdlib + obs only — NO JAX, no HTTP, no device: policy code
+must run anywhere (the CI smoke check asserts the import graph).
+"""
+
+from .continuous import SlotAssignment, SlotScheduler
+from .policy import (AdmissionConfig, AdmissionController, BatchPolicy,
+                     ServiceTimeEstimator, Shed, bucket_of)
+from .scheduler import RequestScheduler
+
+__all__ = ["AdmissionConfig", "AdmissionController", "BatchPolicy",
+           "RequestScheduler", "ServiceTimeEstimator", "Shed",
+           "SlotAssignment", "SlotScheduler", "bucket_of"]

@@ -1,0 +1,131 @@
+"""Slot scheduling for continuous batching.
+
+Classic dynamic batching drains a generation batch completely before
+admitting new sequences: a request arriving one step after a batch of
+long generations launched waits for ALL of them. Continuous batching
+(the vLLM/Orca policy, and what the TPU serving comparison in
+arXiv:2605.25645 attributes most of its tail-latency win to) instead
+keeps a FIXED pool of sequence slots and admits new sequences into free
+slots at **step boundaries** — a fixed [slots, max_len] buffer keeps
+the compiled step program's shapes constant, so admission costs a host-
+side buffer write, never a recompile.
+
+This module is the pure bookkeeping half (no JAX — usable and tested
+with no device): which slots are free, FIFO admission, per-slot token
+budgets, and the obs wiring. The device half — the jitted decode step
+driving a real model — lives in ``dl.generate.ContinuousGenerator``
+and asks this class what to do at every boundary.
+"""
+
+from __future__ import annotations
+
+from collections import deque
+from dataclasses import dataclass
+
+from ..obs import registry as _default_registry
+
+
+@dataclass
+class SlotAssignment:
+    """One admission: write ``prompt`` into buffer row ``slot`` and
+    generate ``max_new_tokens`` for it."""
+    slot: int
+    seq_id: object
+    prompt: object
+    max_new_tokens: int
+
+
+class SlotScheduler:
+    """Fixed-pool sequence slots with step-boundary admission.
+
+    Protocol (driven by the generation loop):
+
+    1. ``offer(seq_id, prompt, max_new_tokens)`` — enqueue work (FIFO).
+    2. ``admit()`` at a step boundary — returns :class:`SlotAssignment`s
+       for every free slot with pending work.
+    3. ``step()`` after each decode step — advances every active slot's
+       generated-token count and returns the ``seq_id``/slot pairs that
+       just completed their budget (their slots are freed immediately,
+       so the next ``admit`` can refill them).
+    """
+
+    def __init__(self, slots: int, service: str = "generate",
+                 registry=None):
+        if slots < 1:
+            raise ValueError("need at least one slot")
+        reg = registry if registry is not None else _default_registry
+        self.slots = int(slots)
+        self.service = service
+        self._free: deque[int] = deque(range(slots))
+        self._pending: deque[tuple] = deque()
+        # slot -> [seq_id, generated, budget]
+        self._active: dict[int, list] = {}
+        self._c_admitted = reg.counter(
+            "sched_continuous_admitted_total",
+            "sequences admitted into in-flight generation, by service")
+        self._c_steps = reg.counter(
+            "sched_continuous_steps_total",
+            "decode steps executed, by service")
+        self._g_active = reg.gauge(
+            "sched_continuous_active_slots",
+            "slots generating this step, by service")
+        self._h_occupancy = reg.histogram(
+            "sched_continuous_occupancy",
+            "active slots per decode step, by service",
+            buckets=tuple(float(1 << k) for k in range(11)))
+
+    # -- intake ------------------------------------------------------------
+    def offer(self, seq_id, prompt, max_new_tokens: int) -> None:
+        if max_new_tokens < 1:
+            raise ValueError("max_new_tokens must be >= 1")
+        self._pending.append((seq_id, prompt, int(max_new_tokens)))
+
+    # -- boundary protocol -------------------------------------------------
+    def admit(self) -> list[SlotAssignment]:
+        """Fill free slots from the FIFO at a step boundary."""
+        out: list[SlotAssignment] = []
+        while self._free and self._pending:
+            slot = self._free.popleft()
+            seq_id, prompt, budget = self._pending.popleft()
+            self._active[slot] = [seq_id, 0, budget]
+            out.append(SlotAssignment(slot=slot, seq_id=seq_id,
+                                      prompt=prompt,
+                                      max_new_tokens=budget))
+            self._c_admitted.inc(1, service=self.service)
+        self._g_active.set(len(self._active), service=self.service)
+        return out
+
+    def step(self) -> list[tuple[object, int]]:
+        """Account one executed decode step; returns ``(seq_id, slot)``
+        for sequences that just finished (slots freed immediately)."""
+        self._c_steps.inc(1, service=self.service)
+        self._h_occupancy.observe(len(self._active),
+                                  service=self.service)
+        done: list[tuple[object, int]] = []
+        for slot in list(self._active):
+            state = self._active[slot]
+            state[1] += 1
+            if state[1] >= state[2]:
+                done.append((state[0], slot))
+                del self._active[slot]
+                self._free.append(slot)
+        self._g_active.set(len(self._active), service=self.service)
+        return done
+
+    # -- introspection -----------------------------------------------------
+    @property
+    def active_slots(self) -> dict[int, tuple]:
+        """slot -> (seq_id, generated, budget) — a read-only view."""
+        return {s: tuple(v) for s, v in self._active.items()}
+
+    def remaining(self, slot: int) -> int:
+        seq_id, generated, budget = self._active[slot]
+        return budget - generated
+
+    @property
+    def pending_count(self) -> int:
+        return len(self._pending)
+
+    @property
+    def busy(self) -> bool:
+        return bool(self._active or self._pending)

@@ -14,7 +14,8 @@ reference's semantics: in-flight requests are replayed if a batch fails
 
 from .distributed import (DistributedServingServer, DriverRegistry,
                           NativeDistributedServingServer,
-                          RegistryClient, ServiceInfo, remote_worker_loop)
+                          RegistryClient, ServiceInfo, pick_least_loaded,
+                          remote_worker_loop)
 from .server import ServingServer, bucket_pad, serving_query
 from .udfs import make_reply_udf, send_reply_udf
 from .dsl import read_stream
@@ -22,6 +23,7 @@ from .dsl import read_stream
 __all__ = ["bucket_pad",
            "DistributedServingServer", "NativeDistributedServingServer",
            "DriverRegistry", "RegistryClient",
-           "ServiceInfo", "ServingServer", "remote_worker_loop",
+           "ServiceInfo", "ServingServer", "pick_least_loaded",
+           "remote_worker_loop",
            "serving_query", "make_reply_udf", "send_reply_udf",
            "read_stream"]

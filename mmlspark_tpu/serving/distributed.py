@@ -61,12 +61,27 @@ _m_lease_replays = _obs.counter(
 
 @dataclasses.dataclass
 class ServiceInfo:
-    """Reference ``ServiceInfo`` — one worker's public coordinates."""
+    """Reference ``ServiceInfo`` — one worker's public coordinates,
+    plus its load signal (queue depth and EWMA request latency) so
+    registry clients can route to the least-loaded worker instead of
+    blindly. Defaults keep old registry payloads parseable."""
     name: str
     worker_id: str
     host: str
     port: int
     api_path: str = "/"
+    queue_depth: int = 0
+    ewma_latency_ms: float = 0.0
+
+
+def pick_least_loaded(infos: list[ServiceInfo]) -> ServiceInfo | None:
+    """Least-loaded routing: order by queue depth first (requests
+    already committed to a worker), then EWMA latency (how fast it
+    drains them). Ties break on worker_id for determinism."""
+    if not infos:
+        return None
+    return min(infos, key=lambda i: (i.queue_depth, i.ewma_latency_ms,
+                                     i.worker_id))
 
 
 def _req_to_json(r: HTTPRequestData) -> dict:
@@ -210,6 +225,13 @@ class RegistryClient:
         finally:
             conn.close()
 
+    def least_loaded(self, name: str) -> ServiceInfo | None:
+        """The worker a load-aware client should talk to: each
+        ``ServiceInfo`` carries the queue depth / EWMA latency its
+        owner last reported (``DistributedServingServer`` re-registers
+        on a heartbeat), and :func:`pick_least_loaded` orders them."""
+        return pick_least_loaded(self.workers(name))
+
 
 # ------------------------------------------------------------------- worker
 class DistributedServingServer(ServingServer):
@@ -225,8 +247,12 @@ class DistributedServingServer(ServingServer):
     def __init__(self, name: str, driver_address, *,
                  worker_id: str | None = None, host: str = "127.0.0.1",
                  port: int = 0, lease_timeout: float = 5.0,
-                 mesh_secret: str = "", **kwargs):
+                 mesh_secret: str = "", load_report_interval: float = 1.0,
+                 **kwargs):
         super().__init__(name, host=host, port=port, **kwargs)
+        # heartbeat cadence for re-registering this worker's load signal
+        # (queue depth + EWMA latency) with the driver registry
+        self.load_report_interval = float(load_report_interval)
         self.worker_id = worker_id or uuid.uuid4().hex[:12]
         self.lease_timeout = lease_timeout
         # the internal endpoints share the public listener; when the
@@ -246,6 +272,8 @@ class DistributedServingServer(ServingServer):
         self._routes[f"{base}/__lease__"] = self._handle_lease
         self._monitor = threading.Thread(target=self._monitor_leases,
                                          daemon=True)
+        self._load_reporter = threading.Thread(target=self._report_load,
+                                               daemon=True)
         self._stopping = threading.Event()
 
     def _new_id(self) -> str:
@@ -257,13 +285,17 @@ class DistributedServingServer(ServingServer):
     def service_info(self) -> ServiceInfo:
         return ServiceInfo(name=self.name, worker_id=self.worker_id,
                            host=self.address[0], port=self.address[1],
-                           api_path=self.api_path)
+                           api_path=self.api_path,
+                           queue_depth=int(self.queue.qsize()),
+                           ewma_latency_ms=float(
+                               getattr(self, "_lat_ewma", 0.0)) * 1e3)
 
     def start(self):
         super().start()
         for info in self.registry.register(self.service_info):
             self._peers[info.worker_id] = info
         self._monitor.start()
+        self._load_reporter.start()
         return self
 
     def stop(self):
@@ -306,9 +338,16 @@ class DistributedServingServer(ServingServer):
         batch: list[CachedRequest] = []
         while len(batch) < n:
             try:
-                batch.append(self.queue.get_nowait())
+                c = self.queue.get_nowait()
             except queue.Empty:
                 break
+            # same expiry contract as the local execution path: a
+            # request whose deadline lapsed while queued is answered
+            # 429 here, not serialized and shipped to a remote worker
+            # that would spend device time on a reply nobody awaits
+            if self.scheduler.shed_if_expired(c):
+                continue
+            batch.append(c)
         deadline = time.monotonic() + self.lease_timeout
         for c in batch:
             self._leases[c.id] = (deadline, c)
@@ -319,6 +358,21 @@ class DistributedServingServer(ServingServer):
         _m_mesh_bytes.inc(len(payload), service=self.name,
                           endpoint="__lease__", direction="out")
         return 200, payload
+
+    def _report_load(self):
+        # load heartbeat: re-registering refreshes this worker's
+        # queue_depth / ewma_latency_ms in the driver table, the signal
+        # least_loaded routing reads. It runs on its OWN thread because
+        # register() blocks up to its HTTP timeout when the driver is
+        # slow or partitioned — inline on the lease monitor that stall
+        # would delay the expiry replay clients depend on. Best-effort:
+        # an unreachable driver just means a stale load table.
+        while not self._stopping.wait(self.load_report_interval):
+            try:
+                for info in self.registry.register(self.service_info):
+                    self._peers[info.worker_id] = info
+            except Exception:
+                pass
 
     def _monitor_leases(self):
         while not self._stopping.wait(
@@ -449,6 +503,9 @@ def remote_worker_loop(driver_address, service_name: str, transform_fn,
                 time.sleep(max_idle_interval)
                 continue
             got = False
+            # drain the most-backlogged ingest first (the registry table
+            # carries each server's last-reported queue depth)
+            infos.sort(key=lambda i: -i.queue_depth)
             for info in infos:
                 base = "" if info.api_path == "/" else info.api_path
                 try:

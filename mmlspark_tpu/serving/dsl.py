@@ -79,7 +79,12 @@ class _ReadStreamBuilder:
             port=int(getattr(self, "_port", 0)),
             api_path="/" + getattr(self, "_api", ""),
             reply_timeout=float(getattr(self, "_replyTimeout", 30.0)),
-            max_queue=int(getattr(self, "_maxQueue", 0)))
+            max_queue=int(getattr(self, "_maxQueue", 0)),
+            # sched subsystem knobs: per-request deadline budget
+            # (seconds; drives 429 load shedding + adaptive batch
+            # closes) and per-route concurrency limit
+            deadline=float(getattr(self, "_deadline", 0.0)),
+            max_inflight=int(getattr(self, "_maxInflight", 0)))
         name = getattr(self, "_api", "default")
         if self._mode == "distributed":
             from .distributed import DistributedServingServer

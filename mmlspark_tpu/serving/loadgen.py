@@ -23,46 +23,78 @@ from ..native.loader import NativeLoader
 _loader = NativeLoader("loadgen", ["loadgen.cpp"])
 
 
+def summarize(lat: np.ndarray, status: np.ndarray, wall_s: float,
+              warmup: int = 20) -> dict:
+    """Shape raw per-request ``(latency_ms, http_status)`` matrices
+    (connection-major ``[nconn, nreq]``; status -1 = transport failure)
+    into the bench summary. Split out so the shaping is testable
+    without the native client.
+
+    Success percentiles (``p50_ms``/``p99_ms``/``loaded_p99_ms``) cover
+    ONLY 2xx round trips: a 429 shed answers in microseconds, so
+    folding sheds into the latency columns would let an overloaded
+    server look *faster* as it sheds more. Non-2xx traffic is reported
+    on its own — ``shed`` (429), ``rejected`` (other non-2xx),
+    ``transport_errors`` — plus ``shed_rate`` over completed round
+    trips. ``throughput_rps`` counts 2xx only (work actually served);
+    ``completed_rps`` keeps the old every-round-trip rate."""
+    nreq = lat.shape[1]
+    steady_lat = lat[:, warmup:] if nreq > warmup else lat
+    steady_st = status[:, warmup:] if nreq > warmup else status
+    if not (status >= 0).any():
+        raise RuntimeError("loadgen: every request failed")
+    ok = (steady_st >= 200) & (steady_st < 300)
+    # an overloaded run can shed EVERYTHING: percentiles go NaN (there
+    # is no success latency to report), the shed/rejected counts stand
+    ok_lat = steady_lat[ok] if ok.any() else np.asarray([np.nan])
+    per_conn_p99 = [float(np.percentile(row[m], 99))
+                    for row, m in zip(steady_lat, ok) if m.any()] \
+        or [float("nan")]
+    all_ok = (status >= 200) & (status < 300)
+    completed = int((status >= 0).sum())
+    shed = int((status == 429).sum())
+    return {
+        "p50_ms": float(np.percentile(ok_lat, 50)),
+        "p99_ms": float(np.percentile(ok_lat, 99)),
+        "loaded_p99_ms": max(per_conn_p99),
+        "throughput_rps": int(all_ok.sum()) / max(wall_s, 1e-9),
+        "completed_rps": completed / max(wall_s, 1e-9),
+        "shed": shed,
+        "shed_rate": shed / max(completed, 1),
+        "rejected": int(((status >= 0) & ~all_ok & (status != 429)).sum()),
+        "transport_errors": int((status < 0).sum()),
+        "errors": int(((status < 0) | ((status >= 0) & ~all_ok)).sum()),
+    }
+
+
 def run_load(host: str, port: int, payload: bytes, *, nconn: int = 16,
              nreq: int = 300, path: str = "/",
              warmup: int = 20) -> dict:
     """Closed-loop load: ``nconn`` keep-alive connections, ``nreq``
-    serial POSTs each. Returns ``{p50_ms, p99_ms, loaded_p99_ms,
-    throughput_rps, errors}`` where ``loaded_p99_ms`` is the max over
-    connections of the per-connection p99 (the benches' loaded-tail
-    semantics). Percentiles and throughput cover requests that
-    completed an HTTP round trip (non-200 replies included — they are
-    also counted in ``errors``); transport failures are excluded from
-    both. Raises when nothing could connect."""
+    serial POSTs each; see :func:`summarize` for the returned summary
+    (success-only percentiles; 429 sheds and other non-2xx reported
+    separately with ``shed_rate``). Raises when nothing could
+    connect."""
     lib = _loader.load()
-    lib.lg_run.restype = ctypes.c_long
-    lib.lg_run.argtypes = [
+    lib.lg_run2.restype = ctypes.c_long
+    lib.lg_run2.argtypes = [
         ctypes.c_char_p, ctypes.c_int, ctypes.c_int, ctypes.c_long,
         ctypes.c_char_p, ctypes.c_char_p, ctypes.c_long,
         ctypes.POINTER(ctypes.c_double),
+        ctypes.POINTER(ctypes.c_int),
         ctypes.POINTER(ctypes.c_double)]
     lat = np.empty(nconn * nreq, np.float64)
+    status = np.empty(nconn * nreq, np.int32)
     wall = ctypes.c_double(0.0)
-    errors = int(lib.lg_run(
+    errors = int(lib.lg_run2(
         host.encode(), int(port), int(nconn), int(nreq), path.encode(),
         payload, len(payload),
         lat.ctypes.data_as(ctypes.POINTER(ctypes.c_double)),
+        status.ctypes.data_as(ctypes.POINTER(ctypes.c_int)),
         ctypes.byref(wall)))
     if errors < 0:
         raise RuntimeError("loadgen: no connection could be "
                            "established")
-    lat = lat.reshape(nconn, nreq)
-    steady = lat[:, warmup:] if nreq > warmup else lat
-    ok = steady[steady >= 0]
-    if ok.size == 0:
-        raise RuntimeError("loadgen: every request failed")
-    per_conn_p99 = [float(np.percentile(row[row >= 0], 99))
-                    for row in steady if (row >= 0).any()]
-    done = int((lat >= 0).sum())
-    return {
-        "p50_ms": float(np.percentile(ok, 50)),
-        "p99_ms": float(np.percentile(ok, 99)),
-        "loaded_p99_ms": max(per_conn_p99),
-        "throughput_rps": done / max(wall.value, 1e-9),
-        "errors": errors,
-    }
+    return summarize(lat.reshape(nconn, nreq),
+                     status.reshape(nconn, nreq), wall.value,
+                     warmup=warmup)

@@ -22,10 +22,9 @@ import time
 import traceback
 from collections import deque
 
-import queue as _queue
-
 from ..io.http.schema import HTTPRequestData, HTTPResponseData
 from ..native.loader import get_httpfront
+from ..sched import Shed
 from .server import _SERVICES, CachedRequest, ServingServer
 
 _LOG = logging.getLogger("mmlspark_tpu.serving")
@@ -81,7 +80,8 @@ class NativeServingServer(ServingServer):
 
     def __init__(self, name: str, host: str = "127.0.0.1", port: int = 0,
                  api_path: str = "/", reply_timeout: float = 30.0,
-                 max_retries: int = 2, max_queue: int = 0):
+                 max_retries: int = 2, max_queue: int = 0,
+                 deadline: float = 0.0, max_inflight: int = 0):
         lib = get_httpfront()
         if lib is None:
             raise RuntimeError(
@@ -95,7 +95,8 @@ class NativeServingServer(ServingServer):
             raise OSError(-handle, "hf_start failed")
         self._handle = handle
         self._init_shared_state(name, api_path, reply_timeout,
-                                max_retries, max_queue)
+                                max_retries, max_queue, deadline=deadline,
+                                max_inflight=max_inflight)
         self.address = (host, out_port.value)
         self._stop = threading.Event()
         self._poller = threading.Thread(target=self._poll_loop,
@@ -110,6 +111,7 @@ class NativeServingServer(ServingServer):
         return self
 
     def stop(self):
+        self.scheduler.close()
         self._stop.set()
         self._poller.join(timeout=5)
         self._lib.hf_stop(self._handle)
@@ -206,7 +208,10 @@ class NativeServingServer(ServingServer):
             self.history[cached.id] = cached
             self._deadlines.append((now + self.reply_timeout, cached))
         try:
-            self.queue.put_nowait(cached)
-        except _queue.Full:
+            self._admit(cached, path)
+        except Shed as s:
+            # same contract as the threaded front: 503 on hard queue
+            # overflow, 429 + Retry-After on policy sheds
             cached.reply(HTTPResponseData(
-                status_code=503, reason="queue full"))
+                status_code=s.status, reason=f"shed: {s.reason}",
+                headers={"Retry-After": str(s.retry_after)}))

@@ -13,6 +13,7 @@ Reference mapping:
 
 from __future__ import annotations
 
+import math
 import queue
 import threading
 import time
@@ -29,6 +30,7 @@ from ..core import DataFrame
 from ..io.http.schema import HTTPRequestData, HTTPResponseData
 from ..obs import registry as _obs
 from ..obs.tracing import tracer as _tracer
+from ..sched import RequestScheduler, Shed
 
 _LOG = logging.getLogger("mmlspark_tpu.serving")
 
@@ -100,7 +102,15 @@ def bucket_pad(xs: np.ndarray) -> tuple[np.ndarray, int]:
 @dataclass
 class CachedRequest:
     """An in-flight request (reference ``CachedRequest``): body + the
-    machinery to reply exactly once."""
+    machinery to reply exactly once.
+
+    The reply latch is now an atomic check-and-set under a per-request
+    lock, with a second terminal transition — :meth:`abandon` — taken
+    when the waiting client gives up (handler timeout): a later
+    pipeline ``reply`` then returns False and is dropped cleanly
+    instead of racing the latch, and ``on_done`` (the scheduler's
+    in-flight release) fires exactly once on whichever transition wins.
+    """
     id: str
     request: HTTPRequestData
     _event: threading.Event = field(default_factory=threading.Event)
@@ -110,19 +120,54 @@ class CachedRequest:
     # request latency from here at reply time; the threaded front times
     # in-handler instead (same series either way)
     created: float = field(default_factory=time.perf_counter)
+    # absolute deadline on the scheduler's monotonic clock (None = no
+    # deadline) and the route label — set at admission (sched subsystem)
+    deadline: float | None = None
+    route: str = "/"
+    # fired exactly once when the request reaches ANY terminal state
+    # (reply or abandon); the serving layer hangs the scheduler's
+    # in-flight release here
+    on_done: object = None
+    abandoned: bool = False
+    _lock: threading.Lock = field(default_factory=threading.Lock)
 
     def reply(self, response: HTTPResponseData) -> bool:
-        if self._event.is_set():
-            return False
-        self._response = response
-        self._event.set()
+        with self._lock:
+            if self._event.is_set():
+                return False
+            self._response = response
+            self._event.set()
+        self._fire_done()
+        return True
+
+    def abandon(self, response: HTTPResponseData | None = None) -> bool:
+        """Terminal no-client-listening state (handler wait timed out):
+        marks the slot dead so a later ``reply`` is dropped cleanly.
+        Returns False when a real reply won the race."""
+        with self._lock:
+            if self._event.is_set():
+                return False
+            self.abandoned = True
+            self._response = response or HTTPResponseData(
+                status_code=504, reason="pipeline timeout")
+            self._event.set()
+        self._fire_done()
         return True
 
     def wait(self, timeout: float) -> HTTPResponseData:
         if not self._event.wait(timeout):
-            return HTTPResponseData(status_code=504,
-                                    reason="pipeline timeout")
+            # mark abandoned; on a lost race the landed reply stands
+            self.abandon()
         return self._response
+
+    def _fire_done(self) -> None:
+        cb, self.on_done = self.on_done, None
+        if cb is not None:
+            try:
+                cb()
+            except Exception:
+                _LOG.warning("request done-callback failed: %s",
+                             traceback.format_exc())
 
 
 class ServingServer:
@@ -130,19 +175,26 @@ class ServingServer:
 
     def _init_shared_state(self, name: str, api_path: str,
                            reply_timeout: float, max_retries: int,
-                           max_queue: int) -> None:
+                           max_queue: int, deadline: float = 0.0,
+                           max_inflight: int = 0) -> None:
         """State shared by every front (threaded Python and native epoll —
         ``native_front.NativeServingServer`` calls this too, so the two
-        cannot drift): the queue, replay bookkeeping, and route table
+        cannot drift): the scheduler, replay bookkeeping, and route table
         that ``next_batch``/``replay``/``_new_id`` operate on."""
         self.name = name
         self.api_path = api_path.rstrip("/") or "/"
         self.reply_timeout = reply_timeout
         self.max_retries = max_retries
-        # bounded intake = backpressure: a full queue answers 503
-        # immediately instead of buffering unboundedly (VERDICT r1 weak #7)
-        self.queue: queue.Queue[CachedRequest] = queue.Queue(
-            maxsize=max_queue or 0)
+        # the admission-controlled scheduler (sched subsystem) replaces
+        # the plain FIFO: bounded intake still answers 503 on hard
+        # overflow (VERDICT r1 weak #7), and the deadline budget adds
+        # predictive load shedding (429 + Retry-After) plus expiry sheds
+        # before execution. Queue-compatible, so the mesh lease drain,
+        # replay, and queue-poking tests work unchanged.
+        self.scheduler = RequestScheduler(
+            name, max_queue=max_queue or 0, max_inflight=max_inflight,
+            deadline=deadline, on_shed=self._shed_reply)
+        self.queue = self.scheduler
         self.history: dict[str, CachedRequest] = {}
         self._lock = threading.Lock()
         # internal sub-path handlers (distributed mode registers
@@ -164,6 +216,11 @@ class ServingServer:
             "request wall seconds from intake to reply, by service/route")
         self._m_queue = _obs.gauge(
             "serving_queue_depth", "queued requests awaiting the executor")
+        self._m_lat_ewma = _obs.gauge(
+            "serving_request_seconds_ewma",
+            "EWMA request latency, by service (load-aware routing input)")
+        self._lat_ewma = 0.0
+        self._lat_seen = False
         self._routes["/metrics"] = self._metrics_route
         if self.api_path != "/":
             self._routes[f"{self.api_path}/metrics"] = self._metrics_route
@@ -189,12 +246,58 @@ class ServingServer:
         if status >= 400:
             self._m_errors.inc(1, service=self.name, route=route)
         self._m_latency.observe(seconds, service=self.name, route=route)
+        # EWMA latency for load-aware routing (ServiceInfo carries it to
+        # the driver registry); a float read-modify-write race here only
+        # smears the smoothing, never corrupts the series
+        self._lat_ewma = seconds if not self._lat_seen else \
+            0.2 * seconds + 0.8 * self._lat_ewma
+        self._lat_seen = True
+        self._m_lat_ewma.set(self._lat_ewma, service=self.name)
+
+    def _shed_reply(self, cached: "CachedRequest", reason: str,
+                    retry_after: float) -> None:
+        """Scheduler ``on_shed`` callback: answer a request shed AFTER
+        queueing (deadline expired before execution). Works through
+        ``CachedRequest.reply``, so both fronts (threaded wait and
+        native reactor) deliver it the same way."""
+        cached.reply(HTTPResponseData(
+            status_code=429, reason=f"shed: {reason}",
+            headers={"Retry-After": str(max(1, int(retry_after)))}))
+
+    def _admit(self, cached: "CachedRequest", route: str) -> None:
+        """Shared admission path for both fronts: a client can tighten
+        its budget with an ``X-Deadline-Ms`` header (capped at the
+        service default when one is configured — a client cannot ask
+        for MORE queueing than the service allows); raises
+        :class:`~..sched.Shed` when the scheduler rejects."""
+        budget = None
+        for k, v in (cached.request.headers or {}).items():
+            if k.lower() == "x-deadline-ms":
+                try:
+                    # clamp to a positive finite floor: a 0/negative
+                    # header must read as "already out of budget"
+                    # (immediate shed), NOT as "no deadline", and
+                    # "nan"/"inf" parse without ValueError but would
+                    # sail through every deadline comparison — all of
+                    # them would loosen the budget the contract says
+                    # can only be tightened
+                    budget = float(v) / 1e3
+                    budget = max(budget, 1e-6) \
+                        if math.isfinite(budget) else None
+                except (TypeError, ValueError):
+                    budget = None
+                if budget is not None and self.scheduler.default_deadline:
+                    budget = min(budget, self.scheduler.default_deadline)
+                break
+        self.scheduler.submit(cached, route=route, deadline=budget)
 
     def __init__(self, name: str, host: str = "127.0.0.1", port: int = 0,
                  api_path: str = "/", reply_timeout: float = 30.0,
-                 max_retries: int = 2, max_queue: int = 0):
+                 max_retries: int = 2, max_queue: int = 0,
+                 deadline: float = 0.0, max_inflight: int = 0):
         self._init_shared_state(name, api_path, reply_timeout,
-                                max_retries, max_queue)
+                                max_retries, max_queue, deadline=deadline,
+                                max_inflight=max_inflight)
 
         serving = self
 
@@ -237,15 +340,18 @@ class ServingServer:
                 with serving._lock:
                     serving.history[cached.id] = cached
                 try:
-                    serving.queue.put_nowait(cached)
-                except queue.Full:
+                    serving._admit(cached, path)
+                except Shed as s:
+                    # hard queue overflow keeps the 503 contract; policy
+                    # sheds (deadline budget, concurrency) answer 429 —
+                    # both carry Retry-After sized to the predicted drain
                     with serving._lock:
                         serving.history.pop(cached.id, None)
-                    self.send_response(503)
-                    self.send_header("Retry-After", "1")
+                    self.send_response(s.status)
+                    self.send_header("Retry-After", str(s.retry_after))
                     self.send_header("Content-Length", "0")
                     self.end_headers()
-                    return 503
+                    return s.status
                 resp = cached.wait(serving.reply_timeout)
                 with serving._lock:
                     serving.history.pop(cached.id, None)
@@ -280,38 +386,26 @@ class ServingServer:
         return self
 
     def stop(self):
+        self.scheduler.close()
         self._httpd.shutdown()
         self._httpd.server_close()
         _SERVICES.pop(self.name, None)
 
     # -- batch intake (called by the query loop) ---------------------------
-    def next_batch(self, max_wait: float = 0.005,
+    def next_batch(self, max_wait: float | None = 0.005,
                    max_batch: int = 1024,
                    linger: float = 0.0) -> list[CachedRequest]:
-        """Dynamic batching: whatever accumulated, like the reference's
-        ``DynamicBufferedBatcher`` — small batches under light load (low
-        latency), large under heavy load. ``max_wait`` is only the idle
-        poll timeout (an arriving request is picked up immediately);
-        ``linger`` optionally waits after the first request to grow the
-        batch (micro-batch throughput mode); ``max_batch=1`` is strict
-        record-at-a-time (continuous mode)."""
-        batch: list[CachedRequest] = []
-        try:
-            batch.append(self.queue.get(timeout=max_wait))
-        except queue.Empty:
-            return batch
-        deadline = time.monotonic() + linger if linger > 0 else None
-        while len(batch) < max_batch:
-            try:
-                if deadline is None:
-                    batch.append(self.queue.get_nowait())
-                else:
-                    remaining = deadline - time.monotonic()
-                    if remaining <= 0:
-                        break
-                    batch.append(self.queue.get(timeout=remaining))
-            except queue.Empty:
-                break
+        """Dynamic batching through the sched subsystem's adaptive
+        policy: small batches under light load (a lone request is
+        dispatched immediately — condition-variable wakeup, no poll
+        floor), large under heavy load, with closes decided by deadline
+        slack / padding-bucket fill / the learned service-time EWMA.
+        ``max_wait`` bounds the idle wait (None = block until work or a
+        ``wake()``/``close()`` — the zero-idle-CPU mode ServingQuery
+        uses); ``linger`` is the micro-batch wait budget; ``max_batch=1``
+        is strict record-at-a-time (continuous mode)."""
+        batch = self.scheduler.next_batch(max_batch=max_batch,
+                                          linger=linger, max_wait=max_wait)
         # depth AFTER the drain = standing backlog the executor can't
         # keep up with (qsize is approximate under concurrency; a gauge
         # tolerates that)
@@ -363,6 +457,12 @@ class ServingQuery:
 
     def stop(self):
         self._stop.set()
+        # close (not wake) the scheduler: close is sticky, so the
+        # executor cannot miss it in the window between checking the
+        # stop flag and re-entering next_batch — a wake() generation
+        # bump is only visible to an already-parked waiter, and losing
+        # it would stall this join for its full timeout
+        self.server.scheduler.close()
         self._thread.join(timeout=5)
         self.server.stop()
 
@@ -379,9 +479,18 @@ class ServingQuery:
             "serving_batch_failures_total",
             "executor batches that raised and were replayed")
         while not self._stop.is_set():
-            batch = self.server.next_batch(max_batch=self.max_batch,
+            # max_wait=None: block on the scheduler's condition variable
+            # until work arrives (zero idle CPU; stop() wakes us)
+            batch = self.server.next_batch(max_wait=None,
+                                           max_batch=self.max_batch,
                                            linger=self.linger)
             if not batch:
+                if self.server.scheduler.closed:
+                    # scheduler torn down under us (server.stop()
+                    # called before query.stop()): nothing more can
+                    # arrive, and next_batch no longer blocks — looping
+                    # would busy-spin a full core
+                    break
                 continue
             batch_rows.observe(len(batch), service=self.name)
             ids = np.empty(len(batch), object)
@@ -392,10 +501,16 @@ class ServingQuery:
             try:
                 # the span roots here (the executor thread has no ambient
                 # context); batch latency also lands in the registry
-                with batch_seconds.time(service=self.name), \
+                with batch_seconds.time(service=self.name) as bt, \
                         _tracer.span("serving.batch", parent=None,
                                      service=self.name, rows=len(batch)):
                     out = self.transform_fn(df)
+                # feed the scheduler's service-time model (EWMA per
+                # padding bucket, stored in the obs registry): this is
+                # what admission's predictive shed and the batcher's
+                # close decision read back
+                self.server.scheduler.estimator.observe(
+                    len(batch), bt.seconds)
                 if out is not None and "reply" in getattr(
                         out, "columns", []):
                     by_id = {c.id: c for c in batch}
@@ -414,7 +529,9 @@ class ServingQuery:
 
 def serving_query(name: str, transform_fn, host: str = "127.0.0.1",
                   port: int = 0, reply_timeout: float = 30.0,
-                  backend: str = "auto") -> ServingQuery:
+                  backend: str = "auto", max_queue: int = 0,
+                  deadline: float = 0.0,
+                  max_inflight: int = 0) -> ServingQuery:
     """One-call setup: server + query, started.
 
     ``backend``: ``"auto"`` (the DEFAULT: native when the toolchain
@@ -439,6 +556,7 @@ def serving_query(name: str, transform_fn, host: str = "127.0.0.1",
         except Exception:
             if backend == "native":
                 raise
-    server = cls(name, host=host, port=port,
-                 reply_timeout=reply_timeout).start()
+    server = cls(name, host=host, port=port, reply_timeout=reply_timeout,
+                 max_queue=max_queue, deadline=deadline,
+                 max_inflight=max_inflight).start()
     return ServingQuery(server, transform_fn).start()

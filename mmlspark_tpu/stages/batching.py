@@ -134,15 +134,26 @@ class FlattenBatch(Transformer):
 class DynamicBufferedBatcher:
     """Queue-based adaptive batcher (reference ``stages/Batchers.scala:1-152``).
 
-    A producer thread fills a bounded queue; ``__iter__`` yields batches of
-    whatever has accumulated — under light load batches are small (low
-    latency), under heavy load they grow (high throughput). This is the core
-    of the serving engine's latency/throughput tradeoff.
+    A producer thread fills a bounded queue; ``__iter__`` yields batches
+    sized by the SAME close policy online serving uses
+    (``sched.BatchPolicy`` — one batching brain for offline pipelines
+    and the serving fronts): under light load batches are small (low
+    latency), under heavy load they grow (high throughput), and with a
+    ``linger`` budget the policy's padding-bucket / service-time logic
+    decides whether waiting longer costs more than it gains. The default
+    (``max_batch=None``, ``linger=0``) reproduces the reference's
+    take-what-accumulated behavior exactly.
     """
 
-    def __init__(self, it: Iterator, max_buffer_size: int = 1024):
+    def __init__(self, it: Iterator, max_buffer_size: int = 1024,
+                 max_batch: int | None = None, linger: float = 0.0,
+                 policy=None):
+        from ..sched import BatchPolicy
+
         self._it = it
         self._queue: queue.Queue = queue.Queue(maxsize=max_buffer_size)
+        self._policy = policy or BatchPolicy(
+            max_batch=max_batch or max_buffer_size, linger=linger)
         self._done = threading.Event()
         self._thread = threading.Thread(target=self._produce, daemon=True)
         self._thread.start()
@@ -155,6 +166,7 @@ class DynamicBufferedBatcher:
             self._done.set()
 
     def __iter__(self):
+        from ..sched.policy import CLOSE, GROW
         while True:
             batch = []
             try:
@@ -163,11 +175,28 @@ class DynamicBufferedBatcher:
                 if self._done.is_set() and self._queue.empty():
                     return
                 continue
+            linger_end = time.monotonic() + self._policy.linger
             while True:
-                try:
-                    batch.append(self._queue.get_nowait())
-                except queue.Empty:
+                action, wait_s, _reason = self._policy.decide(
+                    len(batch), queue_empty=self._queue.empty(),
+                    linger_remaining=linger_end - time.monotonic())
+                if action == GROW:
+                    try:
+                        batch.append(self._queue.get_nowait())
+                    except queue.Empty:
+                        pass  # producer raced us; policy re-decides
+                    continue
+                if action == CLOSE:
                     break
+                if self._done.is_set():
+                    # producer exhausted: nothing can arrive, so paying
+                    # the remaining linger would only delay the final
+                    # partial batch
+                    break
+                try:  # WAIT: pay bounded latency to grow the batch
+                    batch.append(self._queue.get(timeout=wait_s))
+                except queue.Empty:
+                    pass
             yield batch
 
 

@@ -19,6 +19,9 @@ from __future__ import annotations
 import contextlib
 import csv
 import os
+import threading
+import time
+from math import ceil as _ceil
 
 from ..obs.metrics import registry as _registry
 
@@ -98,3 +101,135 @@ class Benchmarks:
         if errors:
             raise AssertionError("benchmark regressions:\n"
                                  + "\n".join(errors))
+
+
+class _SynthRequest:
+    """A scheduler item for the overload scenario: carries the latch the
+    arrival thread waits on plus the attributes the sched subsystem
+    decorates (route/deadline/on_done)."""
+
+    __slots__ = ("submitted", "done_at", "status", "route", "deadline",
+                 "on_done", "_event")
+
+    def __init__(self):
+        self.submitted = time.monotonic()
+        self.done_at = None
+        self.status = None
+        self.route = "/"
+        self.deadline = None
+        self.on_done = None
+        self._event = threading.Event()
+
+    def reply(self, status: int) -> bool:
+        # reply-exactly-once latch, same contract as serving's
+        # CachedRequest (the scheduler's expiry shed path calls this)
+        if self._event.is_set():
+            return False
+        self.status = status
+        self.done_at = time.monotonic()
+        self._event.set()
+        cb, self.on_done = self.on_done, None
+        if cb is not None:
+            cb()
+        return True
+
+
+def overload_scenario(*, service: str = "overload-bench",
+                      deadline_s: float = 0.2,
+                      item_service_s: float = 0.004,
+                      max_queue: int = 64,
+                      max_batch: int = 8,
+                      rate_factor: float = 2.0,
+                      n_requests: int = 400,
+                      registry=None) -> dict:
+    """Synthetic overload benchmark for the sched subsystem (ISSUE 2
+    acceptance): offer load at ``rate_factor``× the sustainable rate
+    into a :class:`~mmlspark_tpu.sched.RequestScheduler` backed by a
+    deterministic executor (``item_service_s`` seconds per request,
+    batched up to ``max_batch``), then read the ``sched_*`` series back
+    from the obs registry.
+
+    A correct scheduler under 2× overload must (a) bound the queue —
+    admission sheds BEFORE depth runs away, (b) keep the latency of
+    requests it chose to admit within the deadline budget — expiry
+    sheds fire before execution, never after — and (c) shed the excess
+    as 429s rather than timing everyone out. The returned dict carries
+    the measured p99/max depth plus the registry readings
+    (``sched_admitted_total``, ``sched_shed_total`` by reason,
+    ``sched_queue_wait_seconds`` count) so benches can bank and tests
+    can assert on either surface.
+    """
+    from ..obs.metrics import registry as _default
+    from ..sched import RequestScheduler, Shed
+
+    reg = registry if registry is not None else _default
+    shed_answered: list[_SynthRequest] = []
+    sched = RequestScheduler(
+        service, max_queue=max_queue, deadline=deadline_s, registry=reg,
+        on_shed=lambda item, reason, retry_after:
+            (shed_answered.append(item), item.reply(429)))
+    done: list[_SynthRequest] = []
+    stop = threading.Event()
+    depth_high = [0]
+
+    def executor():
+        while not stop.is_set() or sched.qsize():
+            batch = sched.next_batch(max_batch=max_batch, max_wait=0.05)
+            if not batch:
+                continue
+            t0 = time.monotonic()
+            time.sleep(item_service_s * len(batch))  # deterministic work
+            sched.estimator.observe(len(batch),
+                                    time.monotonic() - t0)
+            for item in batch:
+                item.reply(200)
+                done.append(item)
+
+    worker = threading.Thread(target=executor, daemon=True)
+    worker.start()
+    interval = item_service_s / rate_factor
+    admitted = shed_at_intake = 0
+    # prime the service-time EWMA so predictive admission has a model
+    # from the first request (a cold registry sheds nothing until the
+    # first batch lands)
+    sched.estimator.observe(1, item_service_s)
+    for _ in range(n_requests):
+        req = _SynthRequest()
+        try:
+            sched.submit(req)
+            admitted += 1
+        except Shed:
+            shed_at_intake += 1
+        depth_high[0] = max(depth_high[0], sched.qsize())
+        time.sleep(interval)
+    stop.set()
+    sched.wake()
+    worker.join(timeout=10)
+    lat = sorted((r.done_at - r.submitted) for r in done
+                 if r.done_at is not None)
+    snap = reg.snapshot()
+
+    def _series(prefix: str) -> dict:
+        return {k: v for k, v in snap.items()
+                if k.startswith(prefix) and f'service="{service}"' in k}
+
+    return {
+        "offered": n_requests,
+        "admitted": admitted,
+        "answered_200": len(lat),
+        "shed_at_intake": shed_at_intake,
+        "shed_after_queueing": len(shed_answered),
+        "deadline_s": deadline_s,
+        "max_queue": max_queue,
+        "max_depth_seen": depth_high[0],
+        # nearest-rank percentiles: ceil(q*n)-1 — int(n*0.99)-1 would
+        # sit one rank low and hide exactly the tail samples a
+        # deadline-SLO acceptance check exists to catch
+        "p50_s": lat[max(_ceil(0.50 * len(lat)) - 1, 0)]
+        if lat else float("nan"),
+        "p99_s": lat[max(_ceil(0.99 * len(lat)) - 1, 0)]
+        if lat else float("nan"),
+        "sched_admitted_total": _series("sched_admitted_total"),
+        "sched_shed_total": _series("sched_shed_total"),
+        "sched_queue_wait_count": _series("sched_queue_wait_seconds_count"),
+    }

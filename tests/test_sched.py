@@ -1,0 +1,593 @@
+"""The admission-controlled request scheduler (ISSUE 2).
+
+Covers: policy units (estimator / admission / batch close), the
+scheduler's condition-variable dispatch and deadline-expiry shedding,
+the serving integration (429 + Retry-After on both overload paths, the
+client-timeout slot-leak regression), least-loaded routing, the
+continuous-batching equivalence contract, the loadgen status split,
+and the synthetic-overload acceptance benchmark."""
+
+import http.client
+import json
+import subprocess
+import sys
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from mmlspark_tpu.obs.metrics import MetricsRegistry
+from mmlspark_tpu.sched import (AdmissionConfig, AdmissionController,
+                                BatchPolicy, RequestScheduler,
+                                ServiceTimeEstimator, Shed, SlotScheduler,
+                                bucket_of)
+from mmlspark_tpu.sched.policy import CLOSE, GROW, WAIT
+
+
+class Item:
+    """Minimal scheduler item: latch + the attrs sched decorates."""
+
+    def __init__(self, tag=None):
+        self.tag = tag
+        self.route = "/"
+        self.deadline = None
+        self.on_done = None
+        self.status = None
+        self._event = threading.Event()
+
+    def reply(self, status):
+        if self._event.is_set():
+            return False
+        self.status = status
+        self._event.set()
+        cb, self.on_done = self.on_done, None
+        if cb:
+            cb()
+        return True
+
+
+class TestPolicyUnits:
+    def test_bucket_of(self):
+        assert [bucket_of(n) for n in (1, 2, 3, 4, 5, 9)] == \
+            [1, 2, 4, 4, 8, 16]
+
+    def test_estimator_learns_and_extrapolates(self):
+        reg = MetricsRegistry()
+        est = ServiceTimeEstimator("svc", registry=reg)
+        assert est.estimate(4) is None and est.item_seconds() is None
+        est.observe(4, 0.040)
+        assert est.estimate(3) == pytest.approx(0.040)   # same bucket
+        # unobserved bucket extrapolates linearly from the nearest
+        assert est.estimate(8) == pytest.approx(0.080)
+        assert est.estimate(1) == pytest.approx(0.010)
+        assert est.item_seconds() == pytest.approx(0.010)
+        # EWMA folds, stored in the registry (scrape-visible)
+        est.observe(4, 0.080)
+        assert 0.040 < est.estimate(4) < 0.080
+        snap = reg.snapshot()
+        assert any(k.startswith("sched_service_seconds_ewma")
+                   for k in snap)
+
+    def test_admission_sheds_and_accounts(self):
+        reg = MetricsRegistry()
+        est = ServiceTimeEstimator("svc", registry=reg)
+        adm = AdmissionController(
+            "svc", AdmissionConfig(max_queue=2, max_inflight=3,
+                                   deadline=0.1), est, registry=reg)
+        with pytest.raises(Shed) as e:
+            adm.try_admit("/", depth=2)
+        assert e.value.reason == "queue_full" and e.value.status == 503
+        # deadline-budget shed: predicted completion (depth+1)*item_s
+        # exceeds the budget while the queue bound alone would admit
+        est.observe(1, 0.07)   # item_s = 70 ms; budget = 100 ms
+        with pytest.raises(Shed) as e:
+            adm.try_admit("/", depth=1)   # predicted 140 ms > 100 ms
+        assert e.value.reason == "deadline" and e.value.status == 429
+        assert e.value.retry_after >= 1
+        # inflight cap
+        for _ in range(3):
+            adm.try_admit("/", depth=0, deadline_budget=10.0)
+        with pytest.raises(Shed) as e:
+            adm.try_admit("/", depth=0, deadline_budget=10.0)
+        assert e.value.reason == "inflight"
+        adm.release("/")
+        adm.try_admit("/", depth=0, deadline_budget=10.0)  # slot freed
+
+    def test_batch_policy_close_reasons(self):
+        reg = MetricsRegistry()
+        est = ServiceTimeEstimator("svc", registry=reg)
+        p = BatchPolicy(max_batch=8, linger=0.0, estimator=est)
+        assert p.decide(8, queue_empty=False)[::2] == (CLOSE, "full")
+        assert p.decide(3, queue_empty=False)[0] == GROW
+        assert p.decide(3, queue_empty=True)[::2] == (CLOSE, "drain")
+        # deadline: slack no longer covers the estimated service
+        est.observe(4, 0.040)
+        assert p.decide(4, queue_empty=True,
+                        oldest_slack=0.030)[::2] == (CLOSE, "deadline")
+        # linger budget: wait while it lasts, close when it runs out
+        pl = BatchPolicy(max_batch=8, linger=0.1, estimator=est)
+        act, wait_s, _ = pl.decide(3, queue_empty=True,
+                                   oldest_slack=10.0,
+                                   linger_remaining=0.05)
+        assert act == WAIT and 0 < wait_s <= 0.05
+        assert pl.decide(3, queue_empty=True, oldest_slack=10.0,
+                         linger_remaining=0.0)[::2] == (CLOSE, "linger")
+        # bucket boundary: growing 4 -> 8 costs est(8)-est(4) = 40 ms,
+        # more than the 10 ms wait budget left -> close on the bucket
+        assert pl.decide(4, queue_empty=True, oldest_slack=10.0,
+                         linger_remaining=0.01)[::2] == (CLOSE, "bucket")
+
+
+class TestRequestScheduler:
+    def test_cv_dispatch_is_immediate(self):
+        """A lone request must dispatch without any poll/linger floor:
+        the executor blocks on the condition variable and the submit
+        wakes it directly."""
+        s = RequestScheduler("cv", registry=MetricsRegistry())
+        got = []
+        t = threading.Thread(
+            target=lambda: got.append(s.next_batch(max_wait=None)))
+        t.start()
+        time.sleep(0.05)          # executor parked, zero CPU
+        t0 = time.perf_counter()
+        s.submit(Item("x"))
+        t.join(timeout=2)
+        elapsed = time.perf_counter() - t0
+        assert [i.tag for i in got[0]] == ["x"]
+        assert elapsed < 0.05, f"dispatch took {elapsed * 1e3:.1f} ms"
+
+    def test_wake_unblocks_idle_executor(self):
+        s = RequestScheduler("wk", registry=MetricsRegistry())
+        got = []
+        t = threading.Thread(
+            target=lambda: got.append(s.next_batch(max_wait=None)))
+        t.start()
+        time.sleep(0.05)
+        s.wake()
+        t.join(timeout=2)
+        assert got == [[]]  # woke empty so the owner can check stop
+
+    def test_deadline_expiry_sheds_before_execution(self):
+        reg = MetricsRegistry()
+        shed = []
+        s = RequestScheduler(
+            "exp", deadline=0.05, registry=reg,
+            on_shed=lambda i, reason, ra: shed.append((i.tag, reason)))
+        s.submit(Item("dead"))
+        time.sleep(0.12)          # deadline passes while queued
+        s.submit(Item("live"), deadline=10.0)
+        batch = s.next_batch(max_batch=8, max_wait=0.2)
+        assert [i.tag for i in batch] == ["live"]
+        assert shed == [("dead", "expired")]
+        snap = reg.snapshot()
+        key = ('sched_shed_total{reason="expired",route="/",'
+               'service="exp"}')
+        assert snap[key] == 1.0
+
+    def test_burst_never_exceeds_queue_bound(self):
+        """Backpressure under a concurrent burst: depth stays within
+        max_queue, the overflow sheds (no unbounded buffering)."""
+        s = RequestScheduler("bq", max_queue=5,
+                             registry=MetricsRegistry())
+        outcomes = []
+        lock = threading.Lock()
+
+        def client():
+            for _ in range(25):
+                try:
+                    s.submit(Item())
+                    ok = True
+                except Shed as e:
+                    assert e.reason == "queue_full"
+                    ok = False
+                with lock:
+                    outcomes.append(ok)
+                assert s.qsize() <= 5
+
+        threads = [threading.Thread(target=client) for _ in range(4)]
+        [t.start() for t in threads]
+        [t.join(timeout=10) for t in threads]
+        assert s.qsize() <= 5
+        assert outcomes.count(True) >= 5
+        assert outcomes.count(False) >= 1
+
+    def test_slotted_items_do_not_leak_inflight(self):
+        """An item that cannot carry the accounting hooks (__slots__
+        without route/on_done) must give its admission slot straight
+        back — otherwise max_inflight routes wedge shut after a few
+        such requests."""
+        class Slotted:
+            __slots__ = ()
+
+        s = RequestScheduler("sl", max_inflight=2,
+                             registry=MetricsRegistry())
+        for _ in range(5):     # > max_inflight: would shed if leaking
+            s.submit(Slotted())
+        assert s.admission.inflight("/") == 0
+        assert len(s.next_batch(max_wait=0.1)) == 5
+
+    def test_queue_compat_surface(self):
+        import queue as q
+        s = RequestScheduler("qc", max_queue=2,
+                             registry=MetricsRegistry())
+        s.put_nowait(Item("a"))
+        s.put_nowait(Item("b"))
+        with pytest.raises(q.Full):
+            s.put_nowait(Item("c"))
+        assert s.qsize() == 2 and not s.empty()
+        assert s.get_nowait().tag == "a"
+        assert s.get(timeout=0.1).tag == "b"
+        with pytest.raises(q.Empty):
+            s.get_nowait()
+
+
+def _post_raw(addr, body=b"{}", headers=None, timeout=10):
+    conn = http.client.HTTPConnection(*addr, timeout=timeout)
+    try:
+        conn.request("POST", "/", body=body, headers=headers or {})
+        resp = conn.getresponse()
+        return resp.status, dict(resp.getheaders()), resp.read()
+    finally:
+        conn.close()
+
+
+class TestServingIntegration:
+    def test_overload_sheds_429_with_retry_after(self):
+        """Once the learned service rate says the deadline budget is
+        unpayable, new arrivals get 429 + Retry-After instead of
+        queueing toward a guaranteed timeout."""
+        from mmlspark_tpu.io.http.schema import HTTPResponseData
+        from mmlspark_tpu.serving import serving_query
+
+        def slow(df):
+            time.sleep(0.12)
+            replies = np.empty(len(df), object)
+            replies[:] = [HTTPResponseData(status_code=200, entity=b"ok")
+                          for _ in range(len(df))]
+            return df.with_column("reply", replies)
+
+        q = serving_query("shed429", slow, backend="python",
+                          deadline=0.05, reply_timeout=5.0)
+        try:
+            # trains the estimator: item service ~0.12 s >> 0.05 budget
+            status, _, _ = _post_raw(q.server.address)
+            assert status == 200
+            statuses, headers = [], []
+            for _ in range(3):
+                st, hd, _ = _post_raw(q.server.address)
+                statuses.append(st)
+                headers.append(hd)
+            assert statuses.count(429) >= 1, statuses
+            shed_hdrs = [h for st, h in zip(statuses, headers)
+                         if st == 429]
+            assert all("Retry-After" in h for h in shed_hdrs)
+        finally:
+            q.stop()
+
+    def test_queued_request_expires_to_429_before_execution(self):
+        """A request whose deadline lapses while the executor is busy
+        is answered 429 at the next pop — never executed."""
+        from mmlspark_tpu.io.http.schema import HTTPResponseData
+        from mmlspark_tpu.serving import serving_query
+
+        seen = []
+        started = threading.Event()
+
+        def slow(df):
+            started.set()
+            seen.extend(df["id"])
+            time.sleep(0.4)
+            replies = np.empty(len(df), object)
+            replies[:] = [HTTPResponseData(status_code=200, entity=b"ok")
+                          for _ in range(len(df))]
+            return df.with_column("reply", replies)
+
+        q = serving_query("expire429", slow, backend="python",
+                          reply_timeout=5.0)
+        results = {}
+        try:
+            ta = threading.Thread(target=lambda: results.update(
+                a=_post_raw(q.server.address)))
+            ta.start()
+            assert started.wait(5)   # A is executing (0.4 s)
+            # B queues with a 100 ms budget; expires before A finishes
+            tb = threading.Thread(target=lambda: results.update(
+                b=_post_raw(q.server.address,
+                            headers={"X-Deadline-Ms": "100"})))
+            tb.start()
+            ta.join(timeout=10)
+            tb.join(timeout=10)
+            assert results["a"][0] == 200
+            assert results["b"][0] == 429, results["b"]
+            assert "Retry-After" in results["b"][1]
+            assert len(seen) == 1    # B never reached the pipeline
+        finally:
+            q.stop()
+
+    def test_zero_deadline_header_cannot_loosen_budget(self):
+        """X-Deadline-Ms: 0 must read as "already out of budget" (429
+        at the next pop), never as "no deadline" — a client may only
+        tighten the budget."""
+        from mmlspark_tpu.io.http.schema import HTTPResponseData
+        from mmlspark_tpu.serving import serving_query
+
+        def echo(df):
+            replies = np.empty(len(df), object)
+            replies[:] = [HTTPResponseData(status_code=200, entity=b"ok")
+                          for _ in range(len(df))]
+            return df.with_column("reply", replies)
+
+        q = serving_query("zero-dl", echo, backend="python",
+                          reply_timeout=5.0)
+        try:
+            st, hdrs, _ = _post_raw(q.server.address,
+                                    headers={"X-Deadline-Ms": "0"})
+            assert st == 429, st
+            assert "Retry-After" in hdrs
+            st, _, _ = _post_raw(q.server.address)   # no header: served
+            assert st == 200
+            # "nan" parses as float but must not become a NaN deadline
+            # (now()+nan passes every comparison = no enforcement at
+            # all); non-finite falls back to the service default
+            from mmlspark_tpu.io.http.schema import HTTPRequestData
+            from mmlspark_tpu.serving.server import CachedRequest
+            c = CachedRequest(id="nan", request=HTTPRequestData(
+                url="/", headers={"X-Deadline-Ms": "nan"}))
+            q.server._admit(c, "/")
+            assert c.deadline is None
+        finally:
+            q.stop()
+
+    def test_client_timeout_releases_slot_and_drops_late_reply(self):
+        """Slot-leak regression: the handler's wait times out -> the
+        entry is abandoned, the scheduler's in-flight count returns to
+        zero, and the pipeline's late reply is dropped cleanly."""
+        from mmlspark_tpu.io.http.schema import HTTPResponseData
+        from mmlspark_tpu.serving import serving_query
+
+        def very_slow(df):
+            time.sleep(0.4)
+            replies = np.empty(len(df), object)
+            replies[:] = [HTTPResponseData(status_code=200, entity=b"x")
+                          for _ in range(len(df))]
+            return df.with_column("reply", replies)
+
+        q = serving_query("leak", very_slow, backend="python",
+                          reply_timeout=0.1)
+        try:
+            status, _, _ = _post_raw(q.server.address, timeout=10)
+            assert status == 504      # client timed out first
+            # let the pipeline finish and try its late reply
+            deadline = time.monotonic() + 5
+            while time.monotonic() < deadline and \
+                    q.server.scheduler.admission.inflight("/") != 0:
+                time.sleep(0.02)
+            assert q.server.scheduler.admission.inflight("/") == 0
+        finally:
+            q.stop()
+
+    def test_abandon_latch_drops_late_reply_exactly_once(self):
+        from mmlspark_tpu.io.http.schema import (HTTPRequestData,
+                                                 HTTPResponseData)
+        from mmlspark_tpu.serving.server import CachedRequest
+
+        released = []
+        c = CachedRequest(id="r1", request=HTTPRequestData(url="/"))
+        c.on_done = lambda: released.append(1)
+        assert c.abandon() is True
+        assert c.abandoned
+        # the late reply is dropped cleanly, done fired exactly once
+        assert c.reply(HTTPResponseData(status_code=200)) is False
+        assert released == [1]
+        # and the reverse race: reply wins, abandon is a no-op
+        c2 = CachedRequest(id="r2", request=HTTPRequestData(url="/"))
+        assert c2.reply(HTTPResponseData(status_code=200)) is True
+        assert c2.abandon() is False and not c2.abandoned
+
+
+class TestLeastLoadedRouting:
+    def test_pick_least_loaded_pure(self):
+        from mmlspark_tpu.serving import ServiceInfo, pick_least_loaded
+        a = ServiceInfo(name="s", worker_id="a", host="h", port=1,
+                        queue_depth=4, ewma_latency_ms=1.0)
+        b = ServiceInfo(name="s", worker_id="b", host="h", port=2,
+                        queue_depth=0, ewma_latency_ms=9.0)
+        c = ServiceInfo(name="s", worker_id="c", host="h", port=3,
+                        queue_depth=0, ewma_latency_ms=2.0)
+        assert pick_least_loaded([a, b, c]).worker_id == "c"
+        assert pick_least_loaded([]) is None
+
+    def test_registry_routes_to_idle_worker(self):
+        """The loaded worker's heartbeat reports its queue depth; a
+        registry client picks the idle one."""
+        from mmlspark_tpu.io.http.schema import HTTPRequestData
+        from mmlspark_tpu.serving import (DistributedServingServer,
+                                          DriverRegistry, RegistryClient)
+        from mmlspark_tpu.serving.server import CachedRequest
+
+        reg = DriverRegistry().start()
+        busy = DistributedServingServer(
+            "lb", reg.address, worker_id="busy",
+            load_report_interval=0.05).start()
+        idle = DistributedServingServer(
+            "lb", reg.address, worker_id="idle",
+            load_report_interval=0.05).start()
+        try:
+            for i in range(6):   # back up the busy worker's queue
+                busy.queue.put_nowait(CachedRequest(
+                    id=f"busy/{i}", request=HTTPRequestData(url="/")))
+            deadline = time.monotonic() + 5
+            client = RegistryClient(reg.address)
+            picked = None
+            while time.monotonic() < deadline:
+                picked = client.least_loaded("lb")
+                if picked and picked.worker_id == "idle":
+                    break
+                time.sleep(0.05)
+            assert picked is not None and picked.worker_id == "idle"
+            infos = {i.worker_id: i for i in client.workers("lb")}
+            assert infos["busy"].queue_depth >= 6
+        finally:
+            busy.stop()
+            idle.stop()
+            reg.stop()
+
+
+class TestContinuousBatching:
+    def test_slot_scheduler_protocol(self):
+        reg = MetricsRegistry()
+        s = SlotScheduler(2, registry=reg)
+        s.offer("a", [1], 2)
+        s.offer("b", [2], 1)
+        s.offer("c", [3], 1)
+        assert [(x.slot, x.seq_id) for x in s.admit()] == \
+            [(0, "a"), (1, "b")]
+        assert s.step() == [("b", 1)]       # b done, slot 1 freed
+        assert [(x.slot, x.seq_id) for x in s.admit()] == [(1, "c")]
+        assert sorted(s.step()) == [("a", 0), ("c", 1)]
+        assert not s.busy
+        assert reg.snapshot()[
+            'sched_continuous_admitted_total{service="generate"}'] == 3.0
+
+    def test_continuous_matches_generate_greedy(self):
+        """Admission into in-flight batches preserves per-sequence
+        outputs: greedy continuous decoding (5 sequences through 2
+        slots) must equal generate() run per prompt."""
+        import jax
+        import jax.numpy as jnp
+
+        from mmlspark_tpu.dl import (ContinuousGenerator, MaskedLMModel,
+                                     TextEncoder, generate,
+                                     make_attention_fn)
+
+        enc = TextEncoder(vocab=32, width=16, depth=1, heads=2,
+                          mlp_dim=32, dtype=jnp.float32,
+                          attention_fn=make_attention_fn(
+                              "dense", causal=True))
+        module = MaskedLMModel(enc)
+        variables = module.init(jax.random.PRNGKey(0),
+                                np.zeros((1, 24), np.int32))
+        rng = np.random.default_rng(0)
+        prompts = [rng.integers(2, 30, size=n).astype(np.int32)
+                   for n in (3, 5, 2, 4, 6)]
+        ref = {i: generate(module, variables, p[None, :],
+                           max_new_tokens=4, max_len=24,
+                           temperature=0.0)[0]
+               for i, p in enumerate(prompts)}
+        gen = ContinuousGenerator(module, variables, slots=2, max_len=24,
+                                  registry=MetricsRegistry())
+        for i, p in enumerate(prompts):
+            gen.submit(i, p, 4)
+        got = gen.run_until_drained()
+        assert set(got) == set(ref)
+        for i, p in enumerate(prompts):
+            np.testing.assert_array_equal(got[i][:len(p) + 4],
+                                          ref[i][:len(p) + 4])
+        # 5 sequences through 2 slots in fewer steps than draining
+        # batch-by-batch would take (3 waves x 4 steps = 12 is the
+        # continuous bound; drain-style grouping needs 12 too with
+        # ceil(5/2)=3 waves, but continuous packs slot reuse tighter
+        # when budgets are ragged — here just pin admissions happened)
+        assert gen.sched._c_admitted.value(service="generate") == 5.0
+
+    def test_continuous_validates_prompts(self):
+        import jax
+        import jax.numpy as jnp
+
+        from mmlspark_tpu.dl import (ContinuousGenerator, MaskedLMModel,
+                                     TextEncoder, make_attention_fn)
+        enc = TextEncoder(vocab=32, width=16, depth=1, heads=2,
+                          mlp_dim=32, dtype=jnp.float32,
+                          attention_fn=make_attention_fn(
+                              "dense", causal=True))
+        module = MaskedLMModel(enc)
+        variables = module.init(jax.random.PRNGKey(0),
+                                np.zeros((1, 16), np.int32))
+        gen = ContinuousGenerator(module, variables, slots=1, max_len=16,
+                                  registry=MetricsRegistry())
+        with pytest.raises(ValueError):
+            gen.submit("x", np.asarray([], np.int32), 2)
+        with pytest.raises(ValueError):
+            gen.submit("x", np.asarray([5] * 15, np.int32), 4)  # too long
+        with pytest.raises(ValueError):
+            gen.submit("x", np.asarray([0, 5], np.int32), 2)  # pad inside
+
+
+class TestSharedBatchingBrain:
+    def test_dynamic_buffered_batcher_exactness(self):
+        from mmlspark_tpu.stages import DynamicBufferedBatcher
+        batches = list(DynamicBufferedBatcher(iter(range(100))))
+        assert [x for b in batches for x in b] == list(range(100))
+
+    def test_dynamic_buffered_batcher_max_batch(self):
+        from mmlspark_tpu.stages import DynamicBufferedBatcher
+        batches = list(DynamicBufferedBatcher(iter(range(64)),
+                                              max_batch=8))
+        assert [x for b in batches for x in b] == list(range(64))
+        assert max(len(b) for b in batches) <= 8
+
+    def test_dynamic_buffered_batcher_linger_coalesces(self):
+        from mmlspark_tpu.stages import DynamicBufferedBatcher
+
+        def trickle():
+            for i in range(10):
+                time.sleep(0.01)
+                yield i
+
+        batches = list(DynamicBufferedBatcher(trickle(), linger=0.2))
+        assert [x for b in batches for x in b] == list(range(10))
+        # a 10 ms trickle under a 200 ms linger coalesces into few
+        # batches; the no-linger policy would yield ~10 singletons
+        assert len(batches) <= 3, batches
+
+
+class TestLoadgenShaping:
+    def test_summarize_separates_sheds_from_success_latency(self):
+        from mmlspark_tpu.serving.loadgen import summarize
+        # one connection, 8 requests: 4 fast 200s, 2 sub-ms 429 sheds,
+        # 1 rejected 503, 1 transport failure
+        lat = np.asarray([[5.0, 5.0, 0.1, 5.0, 0.1, 9.0, 0.2, -1.0]])
+        st = np.asarray([[200, 200, 429, 200, 429, 200, 503, -1]])
+        r = summarize(lat, st, wall_s=1.0, warmup=0)
+        assert r["shed"] == 2 and r["rejected"] == 1
+        assert r["transport_errors"] == 1 and r["errors"] == 4
+        assert r["shed_rate"] == pytest.approx(2 / 7)
+        # percentiles over the four 200s only: sheds must not drag the
+        # latency columns down
+        assert r["p50_ms"] == pytest.approx(5.0)
+        assert r["throughput_rps"] == pytest.approx(4.0)
+        assert r["completed_rps"] == pytest.approx(7.0)
+
+
+class TestOverloadBenchmark:
+    def test_scheduler_bounds_depth_and_tail_under_2x_overload(self):
+        """ISSUE 2 acceptance: loadgen at 2x the sustainable rate ->
+        queue depth stays bounded, admitted-request p99 stays within
+        the configured deadline, the excess sheds — all read back from
+        the sched_* series in the obs registry."""
+        from mmlspark_tpu.testing.benchmarks import overload_scenario
+        reg = MetricsRegistry()
+        r = overload_scenario(registry=reg, rate_factor=2.0)
+        assert r["max_depth_seen"] <= r["max_queue"]
+        assert r["shed_at_intake"] + r["shed_after_queueing"] > 0
+        assert r["answered_200"] > 0
+        assert r["p99_s"] <= r["deadline_s"] + 0.05, r
+        # the registry view agrees with the host-side accounting
+        admitted = sum(r["sched_admitted_total"].values())
+        shed = sum(r["sched_shed_total"].values())
+        assert admitted == r["admitted"]
+        assert shed == r["shed_at_intake"] + r["shed_after_queueing"]
+
+
+def test_sched_imports_without_jax():
+    """Policy code must be usable with no device and no JAX at all
+    (the CI smoke contract)."""
+    code = ("import sys; import mmlspark_tpu.sched as s; "
+            "assert 'jax' not in sys.modules, 'sched import pulled jax'; "
+            "s.RequestScheduler('smoke').submit(type('I', (), {})()); "
+            "print('ok')")
+    out = subprocess.run([sys.executable, "-c", code],
+                         capture_output=True, text=True, timeout=120)
+    assert out.returncode == 0, out.stderr
+    assert out.stdout.strip() == "ok"

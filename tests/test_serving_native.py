@@ -177,7 +177,10 @@ def test_loadgen_closed_loop_both_fronts():
 
 
 def test_loadgen_reports_non_200(tmp_path):
-    """Non-200 replies count as errors, latencies still recorded."""
+    """Non-2xx replies are counted SEPARATELY from success latency
+    (sched satellite): an all-503 run reports 20 rejections, zero
+    sheds, and NaN success percentiles — it must not fold sub-ms
+    rejection round trips into p50 and look fast."""
     from mmlspark_tpu.serving.loadgen import run_load
 
     def reject(df):
@@ -193,4 +196,7 @@ def test_loadgen_reports_non_200(tmp_path):
     finally:
         q.stop()
     assert r["errors"] == 20
-    assert r["p50_ms"] > 0
+    assert r["rejected"] == 20 and r["shed"] == 0
+    assert r["shed_rate"] == 0.0 and r["transport_errors"] == 0
+    assert np.isnan(r["p50_ms"])  # no successes -> no success latency
+    assert r["throughput_rps"] == 0.0 and r["completed_rps"] > 0
