@@ -45,6 +45,7 @@ PACKAGES: dict[str, list[str]] = {
            "test_ci.py", "test_bench_banking.py", "test_rcheck.py"],
     "obs": ["test_obs.py"],
     "sched": ["test_sched.py"],  # admission/batching policy + scheduler
+    "resilience": ["test_resilience.py"],  # retry/breaker/faults/chaos
     "text": ["test_text_transfer.py", "test_causal_lm.py",
              "test_speculative.py"],
 }
@@ -79,6 +80,27 @@ def style() -> int:
              "assert 'jax' not in sys.modules, 'sched import pulled jax'; "
              "s.RequestScheduler('ci-smoke').submit(type('I', (), {})()); "
              "print('sched import OK (no jax)')")
+    rc = _run([sys.executable, "-c", smoke],
+              env=dict(os.environ, JAX_PLATFORMS="cpu"))
+    if rc:
+        return rc
+    # resilience (retry policy + breakers + fault injector) is pure
+    # stdlib + obs: it must import, back off, break, and arm a seeded
+    # fault schedule with no device and no JAX at all — the HTTP client
+    # stack and serving mesh run it from handler threads
+    smoke = (
+        "import sys; "
+        "from mmlspark_tpu.resilience import (RetryPolicy, FaultRule, "
+        "breaker_for, faults); "
+        "assert 'jax' not in sys.modules, 'resilience import pulled jax'; "
+        "p = RetryPolicy(seed=0, sleep=lambda s: None); "
+        "c = p.start(deadline=1.0, op='ci'); "
+        "assert c.backoff(status=503) and not c.backoff(status=404); "
+        "b = breaker_for('ci-smoke', min_calls=1); b.record_failure(); "
+        "assert b.state == 'open' and not b.allow(); "
+        "exec('with faults(7, [FaultRule(point=\"p\", kind=\"error\")]) "
+        "as inj:\\n    assert inj.probe(\"p\") is not None'); "
+        "print('resilience import OK (no jax)')")
     rc = _run([sys.executable, "-c", smoke],
               env=dict(os.environ, JAX_PLATFORMS="cpu"))
     if rc:

@@ -19,6 +19,8 @@ from .core import (DataFrame, Pipeline, PipelineModel, Transformer, Estimator,
 #   train, automl — auto-training + sweeps      (reference train/, automl/)
 #   nn, recommendation, isolationforest, lime — learners long tail
 #   io        — binary/image readers, writers   (reference io/)
+#   obs, sched, resilience — serving/ops planes (metrics+tracing,
+#                            admission control, retry/breaker/faults)
 
 __all__ = ["DataFrame", "Pipeline", "PipelineModel", "Transformer",
            "Estimator", "Model", "load_stage", "__version__"]

@@ -17,8 +17,9 @@ import numpy as np
 
 from ..core import Transformer, Param, ServiceParam, TypeConverters as TC
 from ..core.contracts import HasOutputCol
-from ..io.http.clients import AsyncClient
+from ..io.http.clients import AsyncClient, send_request
 from ..io.http.schema import HTTPRequestData, HTTPResponseData
+from ..resilience import breaker_for
 
 
 class CognitiveServiceBase(Transformer, HasOutputCol):
@@ -34,6 +35,10 @@ class CognitiveServiceBase(Transformer, HasOutputCol):
     # subclasses override
     _method = "POST"
     _content_type = "application/json"
+    # per-endpoint circuit breaker construction knobs (first creation
+    # wins — breakers are shared process-wide by endpoint host)
+    _breaker_config: dict = {"failure_threshold": 0.5, "min_calls": 4,
+                             "window": 20, "reset_timeout": 5.0}
 
     def setLocation(self, location: str):
         """Region shorthand: fills url from the service's path template."""
@@ -97,14 +102,52 @@ class CognitiveServiceBase(Transformer, HasOutputCol):
     def _parse_response(self, resp: HTTPResponseData) -> Any:
         return resp.json()
 
+    # -------------------------------------------------------- client stack
+    def _endpoint_key(self) -> str:
+        """Breaker key: the endpoint host — one failure view per peer,
+        shared by every service object talking to it."""
+        from urllib.parse import urlparse
+        url = self.get("url") or ""
+        return urlparse(url).netloc or url or type(self).__name__
+
+    def _guarded_sender(self):
+        """The per-row sender, routed through the endpoint's circuit
+        breaker (resilience subsystem): a dead endpoint degrades to
+        instant error-column rows (503, ``Retry-After`` = the breaker's
+        reset window) instead of burning one serial socket timeout per
+        row; transport failures (status 0) and 5xx count against the
+        breaker, everything the endpoint actually answered counts
+        for it."""
+        breaker = breaker_for(self._endpoint_key(), **self._breaker_config)
+
+        def sender(req: HTTPRequestData, timeout: float) \
+                -> HTTPResponseData:
+            if not breaker.allow():
+                return HTTPResponseData(
+                    status_code=503,
+                    reason=f"circuit open: {breaker.endpoint}",
+                    headers={"Retry-After":
+                             str(max(int(breaker.reset_timeout), 1))},
+                    entity=None)
+            resp = send_request(req, timeout)
+            breaker.record(resp.status_code != 0
+                           and resp.status_code < 500)
+            return resp
+
+        return sender
+
+    def _client(self) -> AsyncClient:
+        return AsyncClient(concurrency=self.get("concurrency"),
+                           timeout=self.get("timeout"),
+                           sender=self._guarded_sender())
+
     # ------------------------------------------------------------ transform
     def _transform(self, df):
         n = len(df)
         requests: list[HTTPRequestData | None] = [
             self._build_request(df, i) for i in range(n)]
         live = [(i, r) for i, r in enumerate(requests) if r is not None]
-        client = AsyncClient(concurrency=self.get("concurrency"),
-                             timeout=self.get("timeout"))
+        client = self._client()
         responses = client.send([r for _, r in live])
         out = np.empty(n, object)
         err = np.empty(n, object)
@@ -143,17 +186,22 @@ class _AsyncReplyMixin:
 
     _TERMINAL = ("succeeded", "failed", "partiallycompleted")
 
-    def _poll(self, location: str, key: str | None):
+    def _poll(self, location: str, key: str | None, sender=None):
         import time
-
-        from ..io.http.clients import send_request
         headers = {}
         if key:
             headers["Ocp-Apim-Subscription-Key"] = str(key)
+        # polls share the endpoint breaker with the POST path (sender =
+        # _guarded_sender): once the endpoint dies mid-operation the
+        # breaker opens and the remaining polls answer 503 locally
+        # (terminal below) instead of burning maxPollingRetries socket
+        # timeouts against a corpse
+        sender = sender or self._guarded_sender()
         delay = self.get("pollingDelay")
         for _ in range(self.get("maxPollingRetries")):
-            resp = send_request(HTTPRequestData(
-                url=location, method="GET", headers=headers))
+            resp = sender(HTTPRequestData(
+                url=location, method="GET", headers=headers),
+                self.get("timeout"))
             if 200 <= resp.status_code < 300:
                 parsed = resp.json()
                 status = str(parsed.get("status", "")).lower()
@@ -175,8 +223,8 @@ class _AsyncReplyMixin:
         n = len(df)
         requests = [self._build_request(df, i) for i in range(n)]
         live = [(i, r) for i, r in enumerate(requests) if r is not None]
-        client = AsyncClient(concurrency=self.get("concurrency"),
-                             timeout=self.get("timeout"))
+        # async-reply POSTs share the endpoint breaker with the sync path
+        client = self._client()
         responses = client.send([r for _, r in live])
         out = np.empty(n, object)
         err = np.empty(n, object)
@@ -203,9 +251,10 @@ class _AsyncReplyMixin:
             # concurrency the POST fan-out had
             from concurrent.futures import ThreadPoolExecutor
             workers = max(int(self.get("concurrency")), 1)
+            sender = self._guarded_sender()
             with ThreadPoolExecutor(max_workers=workers) as pool:
                 results = list(pool.map(
-                    lambda p: self._poll(p[1], p[2]), pending))
+                    lambda p: self._poll(p[1], p[2], sender), pending))
             for (i, _, _), (res, e) in zip(pending, results):
                 out[i], err[i] = res, e
         return (df.with_column(self.getOutputCol(), out)

@@ -6,17 +6,38 @@ from ``initialModel`` bytes, streaming queries use ``checkpointLocation``.
 The DL path adds real training, so it gets real checkpoints: orbax-backed
 save/restore of :class:`TrainState` with step-numbered directories and
 retention.
+
+Crash safety (resilience subsystem): a save writes into a temp directory
+and ``os.replace``-renames it into ``step_NNN`` — a crash mid-write
+(exercised by the ``checkpoint.write`` fault-injection point) leaves an
+invisible ``.tmp-*`` orphan, never a half-written step. ``all_steps`` /
+``restore`` additionally skip — and count, via
+``resilience_checkpoint_skipped_total`` — partially-written or corrupt
+step dirs instead of crashing mid-resume: a torn copy from an older
+non-atomic writer costs one older checkpoint, not the training run.
 """
 
 from __future__ import annotations
 
+import logging
 import os
 import re
+import shutil
+import uuid
 
 import jax
 import numpy as np
 
+from ..obs import registry as _obs
+from ..resilience.faults import injector as _faults
 from .train import TrainState
+
+_LOG = logging.getLogger("mmlspark_tpu.dl.checkpoint")
+
+_m_skipped = _obs.counter(
+    "resilience_checkpoint_skipped_total",
+    "checkpoint step dirs skipped at restore/listing, by reason "
+    "(partial | corrupt)")
 
 
 class CheckpointManager:
@@ -25,6 +46,10 @@ class CheckpointManager:
     def __init__(self, directory: str, max_to_keep: int = 3):
         self.directory = os.path.abspath(directory)
         self.max_to_keep = max_to_keep
+        # partial dirs already counted+warned about: the skip counter
+        # measures skipped checkpoints, not how often the store was
+        # listed (all_steps runs on every save via _retain)
+        self._partial_counted: set[str] = set()
         os.makedirs(self.directory, exist_ok=True)
 
     def _step_dir(self, step: int) -> str:
@@ -34,8 +59,20 @@ class CheckpointManager:
         out = []
         for name in os.listdir(self.directory):
             m = re.fullmatch(r"step_(\d+)", name)
-            if m:
-                out.append(int(m.group(1)))
+            if not m:
+                continue
+            # an empty step dir is a torn write from a non-atomic
+            # writer (or a crash between mkdir and content): listing it
+            # would make latest_step()/restore() chase a ghost
+            path = os.path.join(self.directory, name)
+            if os.path.isdir(path) and not os.listdir(path):
+                if name not in self._partial_counted:
+                    self._partial_counted.add(name)
+                    _m_skipped.inc(1, reason="partial")
+                    _LOG.warning("checkpoint %s is empty (torn write) — "
+                                 "skipped", path)
+                continue
+            out.append(int(m.group(1)))
         return sorted(out)
 
     def latest_step(self) -> int | None:
@@ -43,18 +80,35 @@ class CheckpointManager:
         return steps[-1] if steps else None
 
     def save(self, state: TrainState, step: int | None = None) -> str:
+        """Atomic save: the tree is written into a ``.tmp-*`` sibling
+        and renamed into ``step_NNN`` in one ``os.replace`` — readers
+        (and a resume after a crash here) only ever see complete
+        checkpoints. The ``checkpoint.write`` injection point sits
+        between write and rename: exactly where a real crash tears a
+        non-atomic writer."""
         import orbax.checkpoint as ocp
         step = int(state.step) if step is None else step
-        path = self._step_dir(step)
-        with ocp.PyTreeCheckpointer() as ck:
-            ck.save(path, jax.tree.map(np.asarray, {
-                "params": state.params,
-                "batch_stats": state.batch_stats,
-                "opt_state": state.opt_state,
-                "step": state.step,
-            }), force=True)
+        final = self._step_dir(step)
+        tmp = os.path.join(
+            self.directory,
+            f".tmp-step_{step:010d}-{uuid.uuid4().hex[:8]}")
+        try:
+            with ocp.PyTreeCheckpointer() as ck:
+                ck.save(tmp, jax.tree.map(np.asarray, {
+                    "params": state.params,
+                    "batch_stats": state.batch_stats,
+                    "opt_state": state.opt_state,
+                    "step": state.step,
+                }), force=True)
+            _faults.apply("checkpoint.write", key=str(step))
+            if os.path.isdir(final):
+                shutil.rmtree(final)
+            os.replace(tmp, final)
+        except BaseException:
+            shutil.rmtree(tmp, ignore_errors=True)
+            raise
         self._retain()
-        return path
+        return final
 
     def restore(self, step: int | None = None,
                 target: TrainState | None = None) -> TrainState:
@@ -67,12 +121,38 @@ class CheckpointManager:
         ``ScaleByAdamState``), so resuming adam/momentum without a target
         would silently hand the optimizer the wrong container types. Pass
         the live state for anything beyond stateless optimizers.
-        """
-        import orbax.checkpoint as ocp
-        step = self.latest_step() if step is None else step
-        if step is None:
+
+        With ``step=None`` (resume-latest), a corrupt checkpoint is
+        skipped — counted in ``resilience_checkpoint_skipped_total`` —
+        and the next older step is tried; an EXPLICIT step that fails
+        to load raises (the caller asked for that one)."""
+        if step is not None:
+            return self._restore_one(step, target)
+        candidates = self.all_steps()
+        if not candidates:
             raise FileNotFoundError(
                 f"no checkpoints under {self.directory}")
+        last_err: Exception | None = None
+        for s in reversed(candidates):
+            try:
+                return self._restore_one(s, target)
+            except Exception as e:  # unreadable content: fall back
+                last_err = e
+                _m_skipped.inc(1, reason="corrupt")
+                # loud, with the real exception: a structural mismatch
+                # or transient IO error looks identical to corruption
+                # from here, and silently resuming from an OLDER step
+                # must leave a visible trail, not just a metric
+                _LOG.warning("checkpoint step %d failed to restore "
+                             "(%s: %s) — falling back to an older step",
+                             s, type(e).__name__, e)
+        raise FileNotFoundError(
+            f"no restorable checkpoint under {self.directory} "
+            f"({len(candidates)} corrupt)") from last_err
+
+    def _restore_one(self, step: int,
+                     target: TrainState | None) -> TrainState:
+        import orbax.checkpoint as ocp
         with ocp.PyTreeCheckpointer() as ck:
             if target is None:
                 tree = ck.restore(self._step_dir(step))
@@ -93,7 +173,12 @@ class CheckpointManager:
                           opt_state=tree["opt_state"], step=tree["step"])
 
     def _retain(self) -> None:
-        import shutil
         steps = self.all_steps()
         for s in steps[:-self.max_to_keep]:
             shutil.rmtree(self._step_dir(s), ignore_errors=True)
+        # sweep .tmp-* orphans from crashed saves (invisible to
+        # all_steps, but they hold disk until someone collects them)
+        for name in os.listdir(self.directory):
+            if name.startswith(".tmp-step_"):
+                shutil.rmtree(os.path.join(self.directory, name),
+                              ignore_errors=True)

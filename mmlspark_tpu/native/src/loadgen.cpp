@@ -26,7 +26,10 @@
 
 #include <atomic>
 #include <chrono>
+#include <cctype>
+#include <cstdlib>
 #include <cstring>
+#include <ctime>
 #include <string>
 #include <thread>
 #include <vector>
@@ -86,11 +89,33 @@ bool send_all(int fd, const char* buf, size_t len) {
   return true;
 }
 
+// Case-insensitive scan for a numeric header value within [pos, end).
+// Returns the parsed value or `fallback` when the header is absent.
+double scan_numeric_header(const std::string& buf, size_t header_end,
+                           const char* name, size_t name_len,
+                           double fallback) {
+  for (size_t pos = 0; pos < header_end;) {
+    size_t eol = buf.find("\r\n", pos);
+    if (eol == std::string::npos || eol > header_end) eol = header_end;
+    if (eol - pos > name_len) {
+      bool match = true;
+      for (size_t i = 0; i < name_len; ++i)
+        if (std::tolower(buf[pos + i]) != name[i]) { match = false; break; }
+      if (match) return std::strtod(buf.c_str() + pos + name_len, nullptr);
+    }
+    pos = eol + 2;
+  }
+  return fallback;
+}
+
 // Read one HTTP/1.1 response; returns status code or -1 on transport
 // error. Handles Content-Length bodies (the serving fronts always set
 // it); `carry` holds bytes read past the current response (defensive —
-// strict request-response means there should be none).
-int read_response(int fd, std::string& carry) {
+// strict request-response means there should be none). `retry_after_s`,
+// when non-null, receives the Retry-After header in seconds (0 when
+// absent) — the sched subsystem's 429/503 sheds always set it.
+int read_response(int fd, std::string& carry,
+                  double* retry_after_s = nullptr) {
   std::string buf = std::move(carry);
   carry.clear();
   char tmp[8192];
@@ -103,20 +128,11 @@ int read_response(int fd, std::string& carry) {
   int status = -1;
   if (buf.size() >= 12 && buf.compare(0, 5, "HTTP/") == 0)
     status = std::atoi(buf.c_str() + 9);
-  size_t clen = 0;
-  // case-insensitive Content-Length scan within the header block
-  for (size_t pos = 0; pos < header_end;) {
-    size_t eol = buf.find("\r\n", pos);
-    if (eol == std::string::npos || eol > header_end) eol = header_end;
-    if (eol - pos > 15) {
-      static const char kName[] = "content-length:";
-      bool match = true;
-      for (int i = 0; i < 15; ++i)
-        if (std::tolower(buf[pos + i]) != kName[i]) { match = false; break; }
-      if (match) clen = std::strtoul(buf.c_str() + pos + 15, nullptr, 10);
-    }
-    pos = eol + 2;
-  }
+  size_t clen = static_cast<size_t>(scan_numeric_header(
+      buf, header_end, "content-length:", 15, 0.0));
+  if (retry_after_s)
+    *retry_after_s = scan_numeric_header(buf, header_end,
+                                         "retry-after:", 12, 0.0);
   size_t need = header_end + 4 + clen;
   while (buf.size() < need) {
     ssize_t n = ::recv(fd, tmp, sizeof(tmp), 0);
@@ -127,8 +143,13 @@ int read_response(int fd, std::string& carry) {
   return status;
 }
 
+// Cap on how long a Retry-After instruction is honored: the bench's
+// retry exists to measure the shed/retry contract, not to park a
+// closed-loop thread for a server-chosen eternity.
+constexpr double kMaxRetryAfterSec = 2.0;
+
 void run_conn(const char* host, int port, const std::string& request,
-              long nreq, double* lat_ms, int* status_out,
+              long nreq, int retry_shed, double* lat_ms, int* status_out,
               ConnResult* res) {
   int fd = connect_to(host, port);
   if (fd < 0) {
@@ -144,18 +165,41 @@ void run_conn(const char* host, int port, const std::string& request,
   for (long i = 0; i < nreq; ++i) {
     auto t0 = Clock::now();
     int status = -1;
+    double retry_after = 0.0;
     if (send_all(fd, request.data(), request.size()))
-      status = read_response(fd, carry);
+      status = read_response(fd, carry, &retry_after);
     auto t1 = Clock::now();
+    bool retried = false;
+    if (retry_shed && (status == 429 || status == 503)) {
+      // honor the shed's Retry-After with ONE bounded re-attempt;
+      // the recorded latency is the re-attempt's round trip (the
+      // back-off wait is the server's instruction, not its latency)
+      double wait = retry_after > 0 ? retry_after : 0.05;
+      if (wait > kMaxRetryAfterSec) wait = kMaxRetryAfterSec;
+      timespec ts;
+      ts.tv_sec = static_cast<time_t>(wait);
+      ts.tv_nsec = static_cast<long>((wait - ts.tv_sec) * 1e9);
+      ::nanosleep(&ts, nullptr);
+      t0 = Clock::now();
+      status = -1;
+      if (send_all(fd, request.data(), request.size()))
+        status = read_response(fd, carry);
+      t1 = Clock::now();
+      retried = true;
+    }
     // transport failures record -1, NOT time-until-failure: a dead
     // server fails sends in ~0.05 ms and near-zero "latencies" would
     // otherwise pollute the percentiles and count as completions.
     // Non-200 HTTP replies are real round trips — latency stands,
     // error counted; the per-request status lets the Python side
     // separate sheds (429) from successes instead of folding them.
+    // A retried request reports status + 1000 (e.g. 1200 = 200 on
+    // the bounded re-attempt), so retry traffic stays distinguishable
+    // from first-offer load in the summary.
     lat_ms[i] = status < 0 ? -1.0
         : std::chrono::duration<double, std::milli>(t1 - t0).count();
-    if (status_out) status_out[i] = status;
+    if (status_out)
+      status_out[i] = (retried && status >= 0) ? status + 1000 : status;
     if (status != 200) {
       ++res->errors;
       if (status < 0) {  // transport death: reconnect once, else bail
@@ -186,11 +230,15 @@ extern "C" {
 // are -1); status_out, when non-null, receives the per-request HTTP
 // status (-1 = transport failure) so the caller can split successes
 // from sheds (429) and errors instead of folding them into one number.
-// Returns total non-200/transport errors, or -1 when every connection
-// failed to even connect.
-long lg_run2(const char* host, int port, int nconn, long nreq,
+// retry_shed != 0 honors Retry-After on 429/503 with one bounded
+// re-attempt; such requests report status + 1000 (1200 = 200 on the
+// re-attempt) so retry traffic is distinguishable from first-offer
+// load. Returns total non-200/transport errors, or -1 when every
+// connection failed to even connect.
+long lg_run3(const char* host, int port, int nconn, long nreq,
              const char* path, const unsigned char* body, long body_len,
-             double* lat_ms, int* status_out, double* wall_s) {
+             int retry_shed, double* lat_ms, int* status_out,
+             double* wall_s) {
   std::string request;
   request.reserve(256 + static_cast<size_t>(body_len));
   request += "POST ";
@@ -207,6 +255,7 @@ long lg_run2(const char* host, int port, int nconn, long nreq,
   auto t0 = Clock::now();
   for (int c = 0; c < nconn; ++c)
     threads.emplace_back(run_conn, host, port, std::cref(request), nreq,
+                         retry_shed,
                          lat_ms + static_cast<long>(c) * nreq,
                          status_out ? status_out
                              + static_cast<long>(c) * nreq : nullptr,
@@ -223,6 +272,14 @@ long lg_run2(const char* host, int port, int nconn, long nreq,
   }
   if (hard == nconn) return -1;
   return errors;
+}
+
+// Back-compat entry point (no Retry-After re-attempts).
+long lg_run2(const char* host, int port, int nconn, long nreq,
+             const char* path, const unsigned char* body, long body_len,
+             double* lat_ms, int* status_out, double* wall_s) {
+  return lg_run3(host, port, nconn, nreq, path, body, body_len, 0,
+                 lat_ms, status_out, wall_s);
 }
 
 // Back-compat entry point (no per-request statuses).

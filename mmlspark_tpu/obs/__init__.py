@@ -1,7 +1,8 @@
 """Unified observability: process-wide metrics + tracing.
 
 One registry (``registry``) and one tracer (``tracer``) shared by every
-layer — serving fronts, the distributed worker mesh, collectives, the
+layer — serving fronts, the distributed worker mesh, the resilience
+subsystem (retry/breaker/fault-injection series), collectives, the
 LightGBM boosting loop, and the bench suite — replacing the fragmented
 per-component stopwatches the reference inherited (per-stage JSON
 telemetry + VW nanosecond timers, SURVEY §5). See docs/observability.md.

@@ -81,6 +81,17 @@ class _Metric:
         threads' ``inc``/``observe`` calls."""
         return dict(self._series)
 
+    def remove_matching(self, **labels) -> None:
+        """Drop every series whose label set CONTAINS the given pairs
+        (e.g. ``remove_matching(endpoint=wid)`` clears all from/to
+        transition combos for one endpoint). For metrics labeled by
+        unbounded identities — per-worker breaker endpoints in a mesh
+        with churn — the exposition would otherwise grow forever."""
+        want = set(_label_key(labels))
+        with self._lock:
+            for key in [k for k in self._series if want <= set(k)]:
+                del self._series[key]
+
     def _samples(self, series: dict) -> dict[str, float]:
         """Flat ``{sample_name: value}`` from a ``_copy_series`` copy."""
         return {_render(self.name, k): v for k, v in series.items()}

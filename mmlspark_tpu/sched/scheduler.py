@@ -115,6 +115,18 @@ class RequestScheduler:
                 raise _queue.Full
             self._append_locked(item)
 
+    def put_front(self, item) -> None:
+        """Bound-checked enqueue at the FRONT (no admission math):
+        replayed/requeued work already waited through the queue once —
+        parking it behind the whole standing backlog again would double
+        its latency and burn what deadline budget the retry has left
+        (the resilience subsystem's lease-replay path uses this)."""
+        with self._cv:
+            if self.admission.config.max_queue and \
+                    len(self._items) >= self.admission.config.max_queue:
+                raise _queue.Full
+            self._append_locked(item, front=True)
+
     def get_nowait(self):
         with self._cv:
             if not self._items:
@@ -269,8 +281,11 @@ class RequestScheduler:
         return True
 
     # -- internals ---------------------------------------------------------
-    def _append_locked(self, item) -> None:
-        self._items.append(item)
+    def _append_locked(self, item, front: bool = False) -> None:
+        if front:
+            self._items.appendleft(item)
+        else:
+            self._items.append(item)
         self._enq_at[id(item)] = now()
         self._g_depth.set(len(self._items), service=self.service)
         self._cv.notify()

@@ -38,6 +38,8 @@ import numpy as np
 from ..core import DataFrame
 from ..io.http.schema import HTTPRequestData, HTTPResponseData
 from ..obs import registry as _obs
+from ..resilience import breaker_for, drop_breaker
+from ..resilience.faults import WorkerKilled, injector as _faults
 from .native_front import NativeServingServer
 from .server import (CachedRequest, LowLatencyHandlerMixin,
                      QuietHTTPServer, ServingServer, _LOG)
@@ -57,6 +59,18 @@ _m_mesh_reply_seconds = _obs.histogram(
 _m_lease_replays = _obs.counter(
     "serving_lease_replays_total",
     "requests replayed because their lease expired (worker death)")
+# failure-detection series (resilience subsystem)
+_m_worker_deaths = _obs.counter(
+    "resilience_worker_deaths_total",
+    "workers marked dead by registry heartbeat liveness, by service")
+_m_registry_workers = _obs.gauge(
+    "serving_registry_workers", "live registered workers, by service")
+
+# registry suffix under which compute workers (remote_worker_loop)
+# heartbeat their liveness — the ingest servers' failure detector reads
+# this table to requeue a dead worker's leases without waiting for the
+# full lease deadline
+COMPUTE_SUFFIX = "#compute"
 
 
 @dataclasses.dataclass
@@ -125,11 +139,23 @@ def _post(host: str, port: int, path: str, payload: dict | bytes,
 # ----------------------------------------------------------------- registry
 class DriverRegistry:
     """Driver-side worker registry (reference ``DriverServiceUtils``
-    service, ``HTTPSourceV2.scala:133-194``)."""
+    service, ``HTTPSourceV2.scala:133-194``), now with heartbeat
+    liveness: every registration stamps ``last_seen``, and a monitor
+    thread marks workers dead — deregistering them and counting
+    ``resilience_worker_deaths_total`` — once they miss beats for
+    ``heartbeat_timeout`` seconds. Registered workers already
+    re-register on a heartbeat (``DistributedServingServer`` every
+    ``load_report_interval``, ``remote_worker_loop`` every poll beat),
+    so a crashed worker disappears from the table instead of routing
+    traffic forever. ``heartbeat_timeout=0`` disables pruning."""
 
-    def __init__(self, host: str = "127.0.0.1", port: int = 0):
+    def __init__(self, host: str = "127.0.0.1", port: int = 0,
+                 heartbeat_timeout: float = 15.0):
         self._services: dict[str, dict[str, ServiceInfo]] = {}
+        self._last_seen: dict[tuple[str, str], float] = {}
+        self.heartbeat_timeout = float(heartbeat_timeout)
         self._lock = threading.Lock()
+        self._stopping = threading.Event()
         registry = self
 
         class Handler(LowLatencyHandlerMixin,
@@ -142,11 +168,17 @@ class DriverRegistry:
                     with registry._lock:
                         registry._services.setdefault(
                             info.name, {})[info.worker_id] = info
+                        registry._last_seen[(info.name, info.worker_id)] \
+                            = time.monotonic()
+                        registry._set_workers_gauge_locked(info.name)
                     out = registry._table_json(info.name)
                 elif self.path == "/unregister":
                     with registry._lock:
                         registry._services.get(body["name"], {}).pop(
                             body["worker_id"], None)
+                        registry._last_seen.pop(
+                            (body["name"], body["worker_id"]), None)
+                        registry._set_workers_gauge_locked(body["name"])
                     out = b"[]"
                 else:
                     self.send_response(404)
@@ -177,6 +209,8 @@ class DriverRegistry:
         self.address = self._httpd.server_address
         self._thread = threading.Thread(target=self._httpd.serve_forever,
                                         daemon=True)
+        self._liveness = threading.Thread(target=self._monitor_liveness,
+                                          daemon=True)
 
     def _table_json(self, name: str) -> bytes:
         with self._lock:
@@ -187,11 +221,39 @@ class DriverRegistry:
         with self._lock:
             return list(self._services.get(name, {}).values())
 
+    def _set_workers_gauge_locked(self, name: str) -> None:
+        _m_registry_workers.set(len(self._services.get(name, {})),
+                                service=name)
+
+    def _monitor_liveness(self):
+        """Mark-dead + deregister on missed heartbeats: the mesh's
+        failure detector. Everything routing on the table (lease pulls,
+        least-loaded picks, reply forwarding) stops seeing a worker
+        within one heartbeat_timeout of its last beat."""
+        poll = max(self.heartbeat_timeout / 4.0, 0.05)
+        while not self._stopping.wait(poll):
+            cutoff = time.monotonic() - self.heartbeat_timeout
+            with self._lock:
+                dead = [(n, w) for (n, w), seen in self._last_seen.items()
+                        if seen < cutoff]
+                for name, worker_id in dead:
+                    self._services.get(name, {}).pop(worker_id, None)
+                    self._last_seen.pop((name, worker_id), None)
+                    self._set_workers_gauge_locked(name)
+            for name, worker_id in dead:
+                _m_worker_deaths.inc(1, service=name)
+                _LOG.warning("registry: worker %s/%s missed heartbeats "
+                             "for %.1fs — marked dead", name, worker_id,
+                             self.heartbeat_timeout)
+
     def start(self):
         self._thread.start()
+        if self.heartbeat_timeout > 0:
+            self._liveness.start()
         return self
 
     def stop(self):
+        self._stopping.set()
         self._httpd.shutdown()
         self._httpd.server_close()
 
@@ -264,7 +326,9 @@ class DistributedServingServer(ServingServer):
         # CachedRequest's reply-exactly-once latch, so a late reply from a
         # presumed-dead worker can still win if nobody answered yet)
         self.epoch = 0
-        self._leases: dict[str, tuple[float, CachedRequest]] = {}
+        # lease entries are (deadline, cached[, lessee_worker_id]);
+        # 2-tuples stay accepted (tests and old callers poke them in)
+        self._leases: dict[str, tuple] = {}
         self.registry = RegistryClient(driver_address)
         self._peers: dict[str, ServiceInfo] = {}
         base = "" if self.api_path == "/" else self.api_path
@@ -314,6 +378,13 @@ class DistributedServingServer(ServingServer):
 
     # -- internal endpoints -------------------------------------------------
     def _handle_reply(self, body: bytes) -> tuple[int, bytes]:
+        # named injection point for the reply hop: an injected error
+        # status is returned to the posting worker (whose retry/replay
+        # machinery must absorb it); a drop aborts the connection the
+        # way a dying ingest server would
+        act = _faults.apply("mesh.reply", key=self.worker_id)
+        if act is not None:
+            return act.status, b'{"error": "injected fault"}'
         d = json.loads(body)
         if not self._check_secret(d):
             return 403, b'{"error": "bad mesh secret"}'
@@ -331,10 +402,19 @@ class DistributedServingServer(ServingServer):
         return 200, json.dumps({"delivered": bool(ok)}).encode()
 
     def _handle_lease(self, body: bytes) -> tuple[int, bytes]:
+        # named injection point for the lease hop (the worker absorbs
+        # an injected error by skipping this ingest for a round)
+        act = _faults.apply("mesh.lease", key=self.worker_id)
+        if act is not None:
+            return act.status, b'{"error": "injected fault"}'
         d = json.loads(body or b"{}")
         if not self._check_secret(d):
             return 403, b'{"error": "bad mesh secret"}'
         n = int(d.get("max", 64))
+        # lessee id (when the puller identifies itself): lets the lease
+        # monitor requeue this batch the moment the registry marks the
+        # lessee dead, instead of waiting out the full lease deadline
+        lessee = str(d.get("worker", "")) or None
         batch: list[CachedRequest] = []
         while len(batch) < n:
             try:
@@ -350,7 +430,7 @@ class DistributedServingServer(ServingServer):
             batch.append(c)
         deadline = time.monotonic() + self.lease_timeout
         for c in batch:
-            self._leases[c.id] = (deadline, c)
+            self._leases[c.id] = (deadline, c, lessee)
         out = [{"id": c.id, "request": _req_to_json(c.request)}
                for c in batch]
         payload = json.dumps(out).encode()
@@ -369,17 +449,64 @@ class DistributedServingServer(ServingServer):
         # an unreachable driver just means a stale load table.
         while not self._stopping.wait(self.load_report_interval):
             try:
-                for info in self.registry.register(self.service_info):
-                    self._peers[info.worker_id] = info
+                # injection point: a dropped heartbeat simulates a
+                # partitioned ingest server (the registry will mark it
+                # dead after heartbeat_timeout)
+                _faults.apply("worker.heartbeat", key=self.worker_id)
+                table = {info.worker_id: info
+                         for info in self.registry.register(
+                             self.service_info)}
+                # the registry table is the truth: evict departed peers
+                # and their breakers — worker ids are per-process
+                # identities, so without eviction a mesh with churn
+                # retains a breaker + gauge series per worker forever
+                for gone in set(self._peers) - set(table):
+                    drop_breaker(f"mesh:{self.name}:{gone}")
+                self._peers = table
+            except WorkerKilled:
+                return  # injected death: stop beating, keep the body
             except Exception:
                 pass
+
+    def _live_lessees(self) -> set[str] | None:
+        """Live compute workers from the registry's heartbeat table
+        (``<name>#compute``); None when the driver is unreachable —
+        detection then falls back to deadline-only expiry rather than
+        declaring everyone dead on a registry blip."""
+        try:
+            infos = self.registry.workers(self.name + COMPUTE_SUFFIX)
+        except Exception:
+            return None
+        return {i.worker_id for i in infos}
 
     def _monitor_leases(self):
         while not self._stopping.wait(
                 min(self.lease_timeout / 4.0, 0.25)):
             now = time.monotonic()
-            expired = [i for i, (dl, _) in list(self._leases.items())
-                       if dl < now]
+            # the registry round trip is only worth taking when an
+            # identified lessee actually holds a lease — an idle ingest
+            # must not generate 4 control-plane requests per second
+            live = self._live_lessees() if any(
+                len(e) > 2 and e[2]
+                for e in list(self._leases.values())) else None
+            expired = []
+            for i, entry in list(self._leases.items()):
+                lessee = entry[2] if len(entry) > 2 else None
+                if entry[0] < now:
+                    expired.append(i)
+                elif (live is not None and lessee is not None
+                        and lessee not in live):
+                    # failure DETECTION beat the deadline: an identified
+                    # lessee always registers its heartbeat BEFORE its
+                    # first lease pull (remote_worker_loop's loop
+                    # order), so absence from the live table means the
+                    # registry marked it dead — requeue now. Anonymous
+                    # pullers (no worker id in the lease request) keep
+                    # the deadline-only contract. A false positive (a
+                    # stalled-but-alive worker) only risks a duplicate
+                    # reply, which CachedRequest's reply-exactly-once
+                    # latch absorbs.
+                    expired.append(i)
             if not expired:
                 continue
             self.epoch += 1  # a worker died mid-lease: new replay wave
@@ -410,6 +537,12 @@ class DistributedServingServer(ServingServer):
             info = self._peers.get(owner)
         if info is None:
             return False
+        # per-peer breaker (resilience subsystem): a dead owner fails
+        # this forward in microseconds instead of a socket timeout per
+        # reply, and half-open probes re-learn the peer when it returns
+        breaker = breaker_for(f"mesh:{self.name}:{owner}")
+        if not breaker.allow():
+            return False
         base = "" if info.api_path == "/" else info.api_path
         # serialized once, measured as actually sent on the wire (json
         # envelope, base64'd entity) — the same measure the receiving
@@ -424,7 +557,9 @@ class DistributedServingServer(ServingServer):
             status, body = _post(info.host, info.port,
                                  f"{base}/__reply__", payload)
         except OSError:
+            breaker.record_failure()
             return False  # owner unreachable (crashed); bool contract
+        breaker.record_success()
         # observed only for completed round trips: a crashed owner's
         # instant connection-refused (or timeout) sample would misstate
         # healthy forwarding latency
@@ -480,7 +615,9 @@ def remote_worker_loop(driver_address, service_name: str, transform_fn,
                        *, poll_interval: float = 0.01,
                        max_idle_interval: float = 0.2,
                        stop_event: threading.Event | None = None,
-                       max_batch: int = 64, mesh_secret: str = "") -> None:
+                       max_batch: int = 64, mesh_secret: str = "",
+                       worker_id: str | None = None,
+                       heartbeat_interval: float = 0.25) -> None:
     """A compute worker with no public ingress: leases request batches from
     every registered ingest server, runs the pipeline, and posts replies
     back to each request's owner. Run one per process for model-compute
@@ -490,37 +627,92 @@ def remote_worker_loop(driver_address, service_name: str, transform_fn,
     → DataFrame(id, reply). Connections to ingest servers are persistent
     keep-alive, and the idle poll backs off from ``poll_interval`` to
     ``max_idle_interval``.
+
+    Failure detection (resilience subsystem): the worker heartbeats its
+    liveness to the driver registry under ``<service>#compute`` every
+    ``heartbeat_interval`` seconds, and identifies itself on every lease
+    pull — an ingest server requeues this worker's leases the moment the
+    registry marks it dead, instead of waiting out the lease deadline.
+    Lease pulls to each ingest run behind a per-ingest circuit breaker,
+    so a dead ingest server costs one socket timeout, not one per poll.
+    The loop carries the ``worker.heartbeat`` and ``worker.death``
+    injection points (a ``kill`` exits as if SIGKILLed, stranding any
+    leased batch — exactly what the replay machinery must absorb).
     """
     client = RegistryClient(driver_address)
     stop_event = stop_event or threading.Event()
     conns = _PeerConnections()
+    wid = worker_id or uuid.uuid4().hex[:12]
+    liveness = ServiceInfo(name=service_name + COMPUTE_SUFFIX,
+                           worker_id=wid, host="0.0.0.0", port=0)
     idle = poll_interval
+    last_beat = 0.0
+    killed = False
+    known_ingests: set[str] = set()
     try:
         while not stop_event.is_set():
+            if time.monotonic() - last_beat >= heartbeat_interval:
+                try:
+                    # injection point: a dropped beat simulates a
+                    # partition; a kill raises out of the loop below
+                    _faults.apply("worker.heartbeat", key=wid)
+                    client.register(liveness)
+                    last_beat = time.monotonic()
+                except WorkerKilled:
+                    killed = True
+                    return  # injected death: vanish without unregister
+                except Exception:
+                    pass  # missed beat; the detector tolerates a few
+            if last_beat == 0.0:
+                # never successfully registered: do NOT pull leases yet.
+                # Identified lease pulls promise "the lessee is in the
+                # heartbeat table" — leasing before the first register
+                # lands would make the ingest's failure detector requeue
+                # work this live worker is actively processing.
+                time.sleep(min(heartbeat_interval, max_idle_interval))
+                continue
             try:
                 infos = client.workers(service_name)
             except Exception:
                 time.sleep(max_idle_interval)
                 continue
+            # evict breakers for ingest servers that left the table —
+            # their ids are per-process identities, so a mesh with
+            # ingest churn would otherwise accrete breakers forever
+            current = {i.worker_id for i in infos}
+            for gone in known_ingests - current:
+                drop_breaker(f"mesh:{service_name}:ingest:{gone}")
+            known_ingests = current
             got = False
             # drain the most-backlogged ingest first (the registry table
             # carries each server's last-reported queue depth)
             infos.sort(key=lambda i: -i.queue_depth)
             for info in infos:
                 base = "" if info.api_path == "/" else info.api_path
+                breaker = breaker_for(
+                    f"mesh:{service_name}:ingest:{info.worker_id}")
+                if not breaker.allow():
+                    continue  # ingest known-dead; probe after reset
                 try:
                     status, body = conns.post(info.host, info.port,
                                               f"{base}/__lease__",
                                               {"max": max_batch,
-                                               "secret": mesh_secret})
+                                               "secret": mesh_secret,
+                                               "worker": wid})
                 except Exception:
+                    breaker.record_failure()
                     continue  # ingest server died; registry will catch up
+                breaker.record_success()
                 if status != 200:
                     continue
                 items = json.loads(body)
                 if not items:
                     continue
                 got = True
+                # injection point AFTER the lease is held: a kill here
+                # is the mid-batch worker death the lease replay (and
+                # its chaos test) exists for
+                _faults.apply("worker.death", key=wid)
                 ids = np.empty(len(items), object)
                 reqs = np.empty(len(items), object)
                 ids[:] = [i["id"] for i in items]
@@ -549,8 +741,16 @@ def remote_worker_loop(driver_address, service_name: str, transform_fn,
             else:
                 time.sleep(idle)
                 idle = min(idle * 2, max_idle_interval)
+    except WorkerKilled:
+        killed = True
+        return  # injected mid-batch death: leased work is stranded
     finally:
         conns.close()
+        if not killed:  # a dead worker never says goodbye — the
+            try:        # detector, not the socket, reports it
+                client.unregister(liveness.name, wid)
+            except Exception:
+                pass
 
 
 class NativeDistributedServingServer(DistributedServingServer,

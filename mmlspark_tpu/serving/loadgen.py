@@ -8,6 +8,13 @@ closed loop from C++ threads (keep-alive, TCP_NODELAY, strict
 request-response); this module shapes its raw latencies into the same
 percentile summary the benches bank.
 
+With ``retry=True`` the client honors ``Retry-After`` on 429/503 sheds
+with ONE bounded re-attempt per request (the resilience contract: back
+off as told, re-offer once). Retried requests come back with status
+``+1000`` (1200 = 200 on the re-attempt) and are reported as their own
+``retried`` / ``retried_ok`` columns — retry traffic never blends into
+the first-offer percentiles.
+
 No reference counterpart — the reference's serving perf narrative
 (``docs/mmlspark-serving.md``) relied on external load tooling.
 """
@@ -22,37 +29,54 @@ from ..native.loader import NativeLoader
 
 _loader = NativeLoader("loadgen", ["loadgen.cpp"])
 
+# statuses >= this mark a request answered on the bounded Retry-After
+# re-attempt (loadgen.cpp encodes final_status + 1000)
+_RETRIED_BASE = 1000
+
 
 def summarize(lat: np.ndarray, status: np.ndarray, wall_s: float,
               warmup: int = 20) -> dict:
     """Shape raw per-request ``(latency_ms, http_status)`` matrices
-    (connection-major ``[nconn, nreq]``; status -1 = transport failure)
-    into the bench summary. Split out so the shaping is testable
-    without the native client.
+    (connection-major ``[nconn, nreq]``; status -1 = transport failure,
+    status >= 1000 = answered on a Retry-After re-attempt) into the
+    bench summary. Split out so the shaping is testable without the
+    native client.
 
     Success percentiles (``p50_ms``/``p99_ms``/``loaded_p99_ms``) cover
-    ONLY 2xx round trips: a 429 shed answers in microseconds, so
-    folding sheds into the latency columns would let an overloaded
-    server look *faster* as it sheds more. Non-2xx traffic is reported
-    on its own — ``shed`` (429), ``rejected`` (other non-2xx),
-    ``transport_errors`` — plus ``shed_rate`` over completed round
-    trips. ``throughput_rps`` counts 2xx only (work actually served);
-    ``completed_rps`` keeps the old every-round-trip rate."""
-    nreq = lat.shape[1]
-    steady_lat = lat[:, warmup:] if nreq > warmup else lat
-    steady_st = status[:, warmup:] if nreq > warmup else status
+    ONLY first-offer 2xx round trips: a 429 shed answers in
+    microseconds, so folding sheds into the latency columns would let
+    an overloaded server look *faster* as it sheds more — and a retried
+    request is not first-offer load, so it reports separately
+    (``retried`` = re-attempts taken, ``retried_ok`` = re-attempts that
+    landed 2xx). Non-2xx traffic is reported on its own — ``shed``
+    (final outcome 429, whether on first offer or still shed on the
+    re-attempt), ``rejected`` (other non-2xx), ``transport_errors`` —
+    plus ``shed_rate`` over completed round trips; a shed that a
+    re-attempt then answered counts in ``retried_ok``, not ``shed``.
+    ``throughput_rps`` counts 2xx only (work actually served, retried
+    or not); ``completed_rps`` keeps the old every-round-trip rate."""
     if not (status >= 0).any():
         raise RuntimeError("loadgen: every request failed")
-    ok = (steady_st >= 200) & (steady_st < 300)
+    retried_all = status >= _RETRIED_BASE
+    final = np.where(retried_all, status - _RETRIED_BASE, status)
+    nreq = lat.shape[1]
+    steady_lat = lat[:, warmup:] if nreq > warmup else lat
+    steady_st = final[:, warmup:] if nreq > warmup else final
+    steady_retried = retried_all[:, warmup:] if nreq > warmup \
+        else retried_all
+    ok = (steady_st >= 200) & (steady_st < 300) & ~steady_retried
     # an overloaded run can shed EVERYTHING: percentiles go NaN (there
     # is no success latency to report), the shed/rejected counts stand
     ok_lat = steady_lat[ok] if ok.any() else np.asarray([np.nan])
     per_conn_p99 = [float(np.percentile(row[m], 99))
                     for row, m in zip(steady_lat, ok) if m.any()] \
         or [float("nan")]
-    all_ok = (status >= 200) & (status < 300)
-    completed = int((status >= 0).sum())
-    shed = int((status == 429).sum())
+    all_ok = (final >= 200) & (final < 300)
+    completed = int((final >= 0).sum())
+    # the FINAL outcome classifies: a request still shed on its bounded
+    # re-attempt (1429) is a shed — excluding it would understate
+    # shed_rate exactly when shedding is heaviest
+    shed = int((final == 429).sum())
     return {
         "p50_ms": float(np.percentile(ok_lat, 50)),
         "p99_ms": float(np.percentile(ok_lat, 99)),
@@ -61,34 +85,37 @@ def summarize(lat: np.ndarray, status: np.ndarray, wall_s: float,
         "completed_rps": completed / max(wall_s, 1e-9),
         "shed": shed,
         "shed_rate": shed / max(completed, 1),
-        "rejected": int(((status >= 0) & ~all_ok & (status != 429)).sum()),
-        "transport_errors": int((status < 0).sum()),
-        "errors": int(((status < 0) | ((status >= 0) & ~all_ok)).sum()),
+        "retried": int(retried_all.sum()),
+        "retried_ok": int((retried_all & all_ok).sum()),
+        "rejected": int(((final >= 0) & ~all_ok & (final != 429)).sum()),
+        "transport_errors": int((final < 0).sum()),
+        "errors": int(((final < 0) | ((final >= 0) & ~all_ok)).sum()),
     }
 
 
 def run_load(host: str, port: int, payload: bytes, *, nconn: int = 16,
              nreq: int = 300, path: str = "/",
-             warmup: int = 20) -> dict:
+             warmup: int = 20, retry: bool = False) -> dict:
     """Closed-loop load: ``nconn`` keep-alive connections, ``nreq``
     serial POSTs each; see :func:`summarize` for the returned summary
     (success-only percentiles; 429 sheds and other non-2xx reported
-    separately with ``shed_rate``). Raises when nothing could
-    connect."""
+    separately with ``shed_rate``). ``retry=True`` honors Retry-After
+    on 429/503 with one bounded re-attempt per request, reported under
+    ``retried``/``retried_ok``. Raises when nothing could connect."""
     lib = _loader.load()
-    lib.lg_run2.restype = ctypes.c_long
-    lib.lg_run2.argtypes = [
+    lib.lg_run3.restype = ctypes.c_long
+    lib.lg_run3.argtypes = [
         ctypes.c_char_p, ctypes.c_int, ctypes.c_int, ctypes.c_long,
-        ctypes.c_char_p, ctypes.c_char_p, ctypes.c_long,
+        ctypes.c_char_p, ctypes.c_char_p, ctypes.c_long, ctypes.c_int,
         ctypes.POINTER(ctypes.c_double),
         ctypes.POINTER(ctypes.c_int),
         ctypes.POINTER(ctypes.c_double)]
     lat = np.empty(nconn * nreq, np.float64)
     status = np.empty(nconn * nreq, np.int32)
     wall = ctypes.c_double(0.0)
-    errors = int(lib.lg_run2(
+    errors = int(lib.lg_run3(
         host.encode(), int(port), int(nconn), int(nreq), path.encode(),
-        payload, len(payload),
+        payload, len(payload), 1 if retry else 0,
         lat.ctypes.data_as(ctypes.POINTER(ctypes.c_double)),
         status.ctypes.data_as(ctypes.POINTER(ctypes.c_int)),
         ctypes.byref(wall)))

@@ -423,8 +423,11 @@ class ServingServer:
             return
         try:
             # non-blocking: with a bounded queue a blocking put here could
-            # deadlock the very consumer that would drain it
-            self.queue.put_nowait(cached)
+            # deadlock the very consumer that would drain it. Replays go
+            # to the FRONT: this request already waited through the
+            # queue once, and a replay is racing what is left of its
+            # deadline budget (resilience: detection-driven requeue)
+            self.queue.put_front(cached)
         except queue.Full:
             cached.reply(HTTPResponseData(
                 status_code=503, reason="replay rejected: queue full"))

@@ -233,3 +233,118 @@ def overload_scenario(*, service: str = "overload-bench",
         "sched_shed_total": _series("sched_shed_total"),
         "sched_queue_wait_count": _series("sched_queue_wait_seconds_count"),
     }
+
+
+def chaos_scenario(*, service: str = "chaos-bench", seed: int = 11,
+                   n_requests: int = 40, n_workers: int = 3,
+                   error_rate: float = 0.05,
+                   latency_spike_s: float = 0.05,
+                   latency_rate: float = 0.05,
+                   kill_after_leases: int = 1,
+                   request_timeout_s: float = 10.0) -> dict:
+    """Seeded chaos acceptance for the resilience subsystem (ISSUE 4):
+    a real worker mesh (driver registry with heartbeat liveness, one
+    ingest server, ``n_workers`` in-thread compute workers) driven under
+    an armed fault schedule — one injected worker death mid-lease
+    (``worker.death``, after ``kill_after_leases`` healthy leases), 5%%
+    injected 503s and latency spikes on the client's ``http.send`` hop —
+    while a closed-loop client offers ``n_requests`` through the
+    resilience :class:`~mmlspark_tpu.resilience.RetryPolicy`.
+
+    The contract measured: every accepted request is answered 200 (the
+    killed worker's leases replay to survivors, injected 503s are
+    re-offered per ``Retry-After``) or shed per policy (429/503 only);
+    ZERO transport errors (status 0 / connection reset) reach the
+    client. The returned dict carries the realized fault ``schedule`` —
+    a pure function of the seed and per-point probe order, so re-running
+    with the same seed reproduces it — plus the ``resilience_*`` /
+    ``serving_lease_replays_total`` registry readings the acceptance
+    asserts on.
+
+    Fault decisions are per-point deterministic; the client runs
+    single-threaded so the realized schedule is also totally ordered.
+    """
+    import numpy as np
+
+    from ..io.http.clients import send_request
+    from ..io.http.schema import HTTPRequestData, HTTPResponseData
+    from ..resilience import FaultRule, RetryPolicy, faults
+    from ..serving import (DistributedServingServer, DriverRegistry,
+                           remote_worker_loop)
+
+    def echo(df):
+        replies = np.empty(len(df), object)
+        replies[:] = [HTTPResponseData(status_code=200,
+                                       entity=(r.entity or b"").upper())
+                      for r in df["request"]]
+        return df.with_column("reply", replies)
+
+    snap_before = _registry.snapshot()
+    driver = DriverRegistry(heartbeat_timeout=0.75).start()
+    server = DistributedServingServer(
+        service, driver.address, lease_timeout=2.0, reply_timeout=15.0,
+        load_report_interval=0.2).start()
+    stop = threading.Event()
+    workers = [threading.Thread(
+        target=remote_worker_loop,
+        args=(driver.address, service, echo),
+        kwargs={"stop_event": stop, "heartbeat_interval": 0.2,
+                "max_batch": 4, "worker_id": f"chaos-w{i}"},
+        daemon=True) for i in range(n_workers)]
+    rules = [
+        FaultRule(point="worker.death", kind="kill", p=1.0,
+                  after=kill_after_leases, times=1),
+        FaultRule(point="http.send", kind="error", p=error_rate,
+                  status=503, retry_after=0.05),
+        FaultRule(point="http.send", kind="latency", p=latency_rate,
+                  latency_s=latency_spike_s),
+    ]
+    policy = RetryPolicy(seed=seed, base_delay=0.02, max_delay=0.5,
+                         max_attempts=5)
+    statuses: list[int] = []
+    url = f"http://{server.address[0]}:{server.address[1]}/"
+    try:
+        with faults(seed, rules) as inj:
+            for w in workers:
+                w.start()
+            for i in range(n_requests):
+                resp = send_request(
+                    HTTPRequestData(url=url, method="POST", headers={},
+                                    entity=f"req-{i}".encode()),
+                    timeout=request_timeout_s, policy=policy)
+                statuses.append(resp.status_code)
+            schedule = inj.schedule()
+    finally:
+        stop.set()
+        for w in workers:
+            w.join(timeout=5)
+        server.stop()
+        driver.stop()
+    snap = _registry.snapshot()
+
+    def _delta(prefix: str) -> float:
+        return sum(v - snap_before.get(k, 0.0)
+                   for k, v in snap.items() if k.startswith(prefix))
+
+    answered = sum(1 for s in statuses if 200 <= s < 300)
+    policy_sheds = sum(1 for s in statuses if s in (429, 503))
+    return {
+        "offered": n_requests,
+        "answered_200": answered,
+        "policy_sheds": policy_sheds,
+        "transport_errors": sum(1 for s in statuses if s == 0),
+        "non_policy_errors": sum(
+            1 for s in statuses
+            if not (200 <= s < 300) and s not in (429, 503)),
+        "schedule": schedule,
+        "retries_taken": _delta("resilience_retry_total"),
+        "faults_injected": _delta("resilience_faults_injected_total"),
+        "lease_replays": _delta("serving_lease_replays_total"),
+        "worker_deaths_detected": _delta("resilience_worker_deaths_total"),
+        "breaker_state_present": any(
+            k.startswith("resilience_breaker_state") for k in snap),
+        "retry_total_present": any(
+            k.startswith("resilience_retry_total") for k in snap),
+        "lease_replays_present": any(
+            k.startswith("serving_lease_replays_total") for k in snap),
+    }

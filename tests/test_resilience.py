@@ -1,0 +1,659 @@
+"""Resilience subsystem (ISSUE 4): unified retry/backoff policy, circuit
+breakers, deterministic fault injection, mesh failure detection, atomic
+checkpoints, and the seeded chaos acceptance scenario.
+
+Counterpart of the reference's fault-tolerance story
+(``FaultToleranceUtils``, epoch-tagged lease replay in
+``HTTPSourceV2.scala``) — but TESTED under injected faults instead of
+assumed."""
+
+import http.client
+import json
+import os
+import threading
+import time
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+import numpy as np
+import pytest
+
+from mmlspark_tpu.obs import registry as obs_registry
+from mmlspark_tpu.resilience import (CircuitBreaker, FaultRule, RetryPolicy,
+                                     WorkerKilled, breaker_for, faults,
+                                     injector, parse_retry_after,
+                                     reset_breakers)
+
+
+def _delta(snap_before, prefix):
+    snap = obs_registry.snapshot()
+    return sum(v - snap_before.get(k, 0.0) for k, v in snap.items()
+               if k.startswith(prefix))
+
+
+@pytest.fixture(autouse=True)
+def _clean_resilience_state():
+    """Breakers are process-global by endpoint and the injector is
+    process-global by design — tests must not leak either."""
+    reset_breakers()
+    injector.clear()
+    yield
+    reset_breakers()
+    injector.clear()
+
+
+# --------------------------------------------------------------- RetryPolicy
+class TestRetryPolicy:
+    def test_decorrelated_jitter_bounded_and_seeded(self):
+        taken1, taken2 = [], []
+        for taken in (taken1, taken2):
+            p = RetryPolicy(seed=42, base_delay=0.01, max_delay=0.08,
+                            max_attempts=6, sleep=taken.append)
+            call = p.start(deadline=100, op="t")
+            while call.backoff(status=503):
+                pass
+        assert taken1 == taken2, "same seed must give same jitter"
+        assert len(taken1) == 5  # max_attempts - 1 re-attempts
+        assert all(0.01 <= d <= 0.08 for d in taken1), taken1
+
+    def test_deadline_gates_every_sleep_and_attempt(self):
+        taken = []
+        p = RetryPolicy(delays=(10.0,), sleep=taken.append)
+        call = p.start(deadline=0.2, op="t")
+        # the ladder says sleep 10 s, the budget has 0.2 s: no sleep is
+        # taken and the call reports deadline exhaustion
+        assert call.backoff(status=503) is False
+        assert taken == []
+        assert call.give_up_cause == "deadline"
+
+    def test_attempt_timeout_shrinks_to_remaining_budget(self):
+        p = RetryPolicy(sleep=lambda s: None)
+        call = p.start(deadline=0.5, op="t")
+        assert call.attempt_timeout(60.0) <= 0.5
+        assert p.start(deadline=None, op="t").attempt_timeout(60.0) == 60.0
+
+    def test_retry_after_floors_the_next_delay(self):
+        taken = []
+        p = RetryPolicy(seed=0, base_delay=0.001, max_delay=0.01,
+                        sleep=taken.append)
+        call = p.start(deadline=100, op="t")
+        assert call.backoff(status=429, retry_after=0.5)
+        assert taken[-1] >= 0.5, "Retry-After must floor the backoff"
+
+    def test_retry_after_beyond_budget_gives_up(self):
+        taken = []
+        p = RetryPolicy(seed=0, base_delay=0.001, sleep=taken.append)
+        call = p.start(deadline=0.3, op="t")
+        assert call.backoff(status=429, retry_after=5.0) is False
+        assert taken == [] and call.give_up_cause == "deadline"
+
+    def test_non_retryable_status_stops_immediately(self):
+        p = RetryPolicy(sleep=lambda s: None)
+        call = p.start(deadline=100, op="t")
+        assert call.backoff(status=404) is False
+        assert call.give_up_cause is None  # classification, not budget
+
+    def test_empty_ladder_means_one_attempt_no_retries(self):
+        # retries=() is an explicit "do not retry" (non-idempotent
+        # POSTs); it must not fall through to the jittered default
+        p = RetryPolicy(delays=(), sleep=lambda s: None)
+        assert p.max_attempts == 1
+        call = p.start(deadline=100, op="t")
+        assert call.backoff(status=503) is False
+
+    def test_legacy_ladder_replayed_exactly(self):
+        taken = []
+        p = RetryPolicy(delays=(0.0, 0.0, 0.0), sleep=taken.append)
+        call = p.start(deadline=100, op="t")
+        n = 0
+        while call.backoff(status=500):
+            n += 1
+        assert n == 3 and p.max_attempts == 4
+
+    def test_retry_metrics_recorded(self):
+        before = obs_registry.snapshot()
+        p = RetryPolicy(seed=1, base_delay=0.0, max_delay=0.0,
+                        sleep=lambda s: None)
+        call = p.start(deadline=100, op="metrics-test")
+        while call.backoff(status=503):
+            pass
+        assert _delta(before, "resilience_retry_total") >= 1
+        assert _delta(before, "resilience_retry_give_up_total") >= 1
+
+    def test_parse_retry_after(self):
+        assert parse_retry_after("2") == 2.0
+        assert parse_retry_after("0.5") == 0.5
+        assert parse_retry_after(None) is None
+        assert parse_retry_after("Wed, 21 Oct") is None
+        assert parse_retry_after("-1") is None
+
+
+# ---------------------------------------------------------- send_request fix
+@pytest.fixture(scope="module")
+def shed_then_ok_server():
+    """Answers 503 + Retry-After for the first N requests of each path,
+    then 200 — the shape of the sched subsystem's overload sheds."""
+    hits = {}
+    lock = threading.Lock()
+
+    class Handler(BaseHTTPRequestHandler):
+        protocol_version = "HTTP/1.1"
+
+        def do_POST(self):
+            n = int(self.headers.get("Content-Length", 0))
+            self.rfile.read(n) if n else None
+            with lock:
+                hits[self.path] = hits.get(self.path, 0) + 1
+                count = hits[self.path]
+            sheds = int(self.path.rsplit("shed", 1)[-1] or 0) \
+                if "shed" in self.path else 0
+            if count <= sheds:
+                self.send_response(503)
+                self.send_header("Retry-After", "0.05")
+                self.send_header("Content-Length", "0")
+                self.end_headers()
+            else:
+                body = b"ok"
+                self.send_response(200)
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+
+        do_GET = do_POST
+
+        def log_message(self, *a):
+            pass
+
+    httpd = ThreadingHTTPServer(("127.0.0.1", 0), Handler)
+    threading.Thread(target=httpd.serve_forever, daemon=True).start()
+    yield f"127.0.0.1:{httpd.server_address[1]}"
+    httpd.shutdown()
+
+
+class TestSendRequestDeadline:
+    def test_whole_call_fits_in_timeout_budget(self, shed_then_ok_server):
+        """The old ladder slept 1.6 s of backoff regardless of budget;
+        now the whole call — retries included — finishes inside
+        ``timeout``."""
+        from mmlspark_tpu.io.http.clients import send_request
+        from mmlspark_tpu.io.http.schema import HTTPRequestData
+        t0 = time.monotonic()
+        resp = send_request(HTTPRequestData(
+            url=f"http://{shed_then_ok_server}/always/shed99",
+            method="POST", headers={}, entity=b"x"), timeout=0.5)
+        elapsed = time.monotonic() - t0
+        assert resp.status_code == 503
+        assert elapsed < 1.5, f"budget 0.5s but call took {elapsed:.2f}s"
+
+    def test_transport_errors_also_budgeted(self):
+        """URLError retries used to ignore the budget entirely."""
+        from mmlspark_tpu.io.http.clients import send_request
+        from mmlspark_tpu.io.http.schema import HTTPRequestData
+        t0 = time.monotonic()
+        resp = send_request(HTTPRequestData(
+            url="http://127.0.0.1:9/unreachable", method="POST",
+            headers={}, entity=b"x"), timeout=0.4)
+        elapsed = time.monotonic() - t0
+        assert resp.status_code == 0
+        assert elapsed < 2.0, f"budget 0.4s but call took {elapsed:.2f}s"
+
+    def test_retry_after_honored_to_success(self, shed_then_ok_server):
+        from mmlspark_tpu.io.http.clients import send_request
+        from mmlspark_tpu.io.http.schema import HTTPRequestData
+        before = obs_registry.snapshot()
+        resp = send_request(HTTPRequestData(
+            url=f"http://{shed_then_ok_server}/ok/shed2",
+            method="POST", headers={}, entity=b"x"), timeout=5.0)
+        assert resp.status_code == 200 and resp.entity == b"ok"
+        assert _delta(before, "resilience_retry_total") >= 2
+
+    def test_legacy_retries_tuple_still_accepted(self, shed_then_ok_server):
+        from mmlspark_tpu.io.http.clients import send_request
+        from mmlspark_tpu.io.http.schema import HTTPRequestData
+        resp = send_request(HTTPRequestData(
+            url=f"http://{shed_then_ok_server}/legacy/shed1",
+            method="POST", headers={}, entity=b"x"),
+            timeout=5.0, retries=(0.01, 0.02))
+        assert resp.status_code == 200
+
+    def test_injected_fault_exercises_retry_path(self, shed_then_ok_server):
+        """An armed ``http.send`` error is retried exactly like a real
+        503 — the fault plane drives production code, not a mock."""
+        from mmlspark_tpu.io.http.clients import send_request
+        from mmlspark_tpu.io.http.schema import HTTPRequestData
+        with faults(3, [FaultRule(point="http.send", kind="error",
+                                  status=503, retry_after=0.01, times=1)]):
+            resp = send_request(HTTPRequestData(
+                url=f"http://{shed_then_ok_server}/inj/plain",
+                method="POST", headers={}, entity=b"x"), timeout=5.0)
+        assert resp.status_code == 200
+
+
+# ------------------------------------------------------------ CircuitBreaker
+class TestCircuitBreaker:
+    def test_state_machine_full_cycle(self):
+        t = [0.0]
+        b = CircuitBreaker("ep1", min_calls=4, failure_threshold=0.5,
+                           reset_timeout=2.0, clock=lambda: t[0])
+        assert b.state == "closed" and b.allow()
+        for _ in range(4):
+            b.record_failure()
+        assert b.state == "open"
+        assert not b.allow()  # rejected while open
+        t[0] = 2.5
+        assert b.allow()      # half-open admits one probe
+        assert b.state == "half_open"
+        assert not b.allow()  # only one probe at a time
+        b.record_failure()    # probe failed: re-open, timer re-armed
+        assert b.state == "open" and not b.allow()
+        t[0] = 5.0
+        assert b.allow()
+        b.record_success()    # probe landed: closed again
+        assert b.state == "closed" and b.allow()
+
+    def test_failure_rate_threshold_not_just_any_failure(self):
+        b = CircuitBreaker("ep2", min_calls=4, failure_threshold=0.5,
+                           window=10)
+        for ok in (True, True, True, False, True, False, True, True):
+            b.record(ok)
+        assert b.state == "closed"  # 2/8 failures < 0.5
+
+    def test_metrics_series(self):
+        before = obs_registry.snapshot()
+        t = [0.0]
+        b = CircuitBreaker("ep3", min_calls=2, reset_timeout=1.0,
+                           clock=lambda: t[0])
+        b.record_failure()
+        b.record_failure()
+        assert not b.allow()
+        snap = obs_registry.snapshot()
+        assert snap['resilience_breaker_state{endpoint="ep3"}'] == 1
+        assert _delta(before, "resilience_breaker_transitions_total") >= 1
+        assert _delta(before, "resilience_breaker_rejected_total") >= 1
+
+    def test_breaker_for_is_idempotent(self):
+        a = breaker_for("shared-ep", min_calls=2)
+        b = breaker_for("shared-ep", min_calls=99)
+        assert a is b and a.min_calls == 2
+
+    def test_drop_breaker_evicts_object_and_all_series(self):
+        from mmlspark_tpu.resilience import drop_breaker
+        t = [0.0]
+        a = breaker_for("churned-worker-ep", min_calls=1,
+                        clock=lambda: t[0])
+        a.record_failure()          # transition series
+        assert not a.allow()        # rejected series
+        snap = obs_registry.snapshot()
+        assert any('endpoint="churned-worker-ep"' in k for k in snap
+                   if k.startswith("resilience_breaker"))
+        drop_breaker("churned-worker-ep")
+        snap = obs_registry.snapshot()
+        assert not any('endpoint="churned-worker-ep"' in k for k in snap), \
+            [k for k in snap if "churned" in k]
+        assert breaker_for("churned-worker-ep") is not a  # fresh object
+
+
+# ------------------------------------------------------------- FaultInjector
+class TestFaultInjector:
+    def test_same_seed_same_schedule(self):
+        rules = [FaultRule(point="p", kind="error", p=0.3)]
+        outcomes = []
+        for _ in range(2):
+            with faults(9, list(rules)) as inj:
+                hits = [inj.probe("p") is not None for _ in range(100)]
+                outcomes.append((hits, inj.schedule()))
+        assert outcomes[0] == outcomes[1]
+        assert 0 < sum(outcomes[0][0]) < 100
+
+    def test_after_and_times_bound_the_schedule(self):
+        with faults(1, [FaultRule(point="p", kind="error", after=3,
+                                  times=2)]) as inj:
+            fired = [inj.probe("p") is not None for _ in range(10)]
+        assert fired == [False] * 3 + [True, True] + [False] * 5
+
+    def test_match_filters_on_key(self):
+        with faults(1, [FaultRule(point="p", kind="kill",
+                                  match="victim")]) as inj:
+            assert inj.probe("p", key="bystander-1") is None
+            with pytest.raises(WorkerKilled):
+                inj.apply("p", key="the-victim-worker")
+
+    def test_latency_sleeps_and_continues(self):
+        slept = []
+        with faults(1, [FaultRule(point="p", kind="latency",
+                                  latency_s=0.123)]) as inj:
+            inj._sleep = slept.append
+            assert inj.apply("p") is None
+        assert slept == [0.123]
+
+    def test_disarmed_probe_is_none(self):
+        assert injector.probe("anything") is None
+
+    def test_injected_counter(self):
+        before = obs_registry.snapshot()
+        with faults(1, [FaultRule(point="p", kind="error")]) as inj:
+            inj.probe("p")
+        assert _delta(before, "resilience_faults_injected_total") == 1
+
+
+# -------------------------------------------------------- cognitive breaker
+class TestCognitiveBreaker:
+    def test_dead_endpoint_degrades_to_error_rows_fast(self):
+        """Per-row calls route through the endpoint breaker: a dead
+        endpoint costs a few probe timeouts, then error-column rows are
+        produced locally (503 circuit open) instead of one serial
+        timeout per row."""
+        from mmlspark_tpu.cognitive.base import _JsonBodyService
+        from mmlspark_tpu.core import DataFrame
+
+        class Stub(_JsonBodyService):
+            _breaker_config = {"failure_threshold": 0.5, "min_calls": 2,
+                               "window": 4, "reset_timeout": 60.0}
+
+        t = Stub(url="http://127.0.0.1:9/dead", outputCol="o",
+                 timeout=0.2, concurrency=1)
+        df = DataFrame({"x": np.asarray(list("abcdef"), object)})
+        out = t.transform(df)
+        errs = list(out["error"])
+        assert all(e is not None for e in errs)
+        # the tail of the frame must be breaker answers, not timeouts
+        assert any("circuit open" in str(e.get("reason", ""))
+                   for e in errs if isinstance(e, dict)), errs
+        assert errs[-1]["statusCode"] == 503
+
+
+# ---------------------------------------------------------- atomic ckpt (dl)
+class TestAtomicCheckpoint:
+    def _state(self, step=1):
+        from mmlspark_tpu.dl.train import TrainState
+        return TrainState(
+            params={"w": np.arange(6, dtype=np.float32).reshape(2, 3)},
+            batch_stats={"m": np.zeros(3, np.float32)},
+            opt_state={"mu": np.ones(3, np.float32)},
+            step=np.asarray(step, np.int32))
+
+    def test_crash_mid_save_leaves_store_consistent(self, tmp_path):
+        from mmlspark_tpu.dl.checkpoint import CheckpointManager
+        from mmlspark_tpu.resilience import InjectedDrop
+        mgr = CheckpointManager(str(tmp_path / "ck"))
+        mgr.save(self._state(1), step=1)
+        with faults(1, [FaultRule(point="checkpoint.write",
+                                  kind="drop", times=1)]):
+            with pytest.raises(InjectedDrop):
+                mgr.save(self._state(2), step=2)
+        # the torn save left no step dir and no visible state change
+        assert mgr.all_steps() == [1]
+        restored = mgr.restore()
+        np.testing.assert_array_equal(np.asarray(restored.step), 1)
+        assert not [d for d in os.listdir(tmp_path / "ck")
+                    if d.startswith(".tmp-")], "torn temp dir leaked"
+
+    def test_restore_skips_corrupt_step(self, tmp_path):
+        from mmlspark_tpu.dl.checkpoint import CheckpointManager
+        before = obs_registry.snapshot()
+        mgr = CheckpointManager(str(tmp_path / "ck"))
+        mgr.save(self._state(1), step=1)
+        mgr.save(self._state(2), step=2)
+        # corrupt the latest step in place (torn copy from a non-atomic
+        # writer): garble every file under it
+        step2 = mgr._step_dir(2)
+        for root, _, files in os.walk(step2):
+            for f in files:
+                with open(os.path.join(root, f), "wb") as fh:
+                    fh.write(b"\x00garbage\x00")
+        restored = mgr.restore()
+        np.testing.assert_array_equal(np.asarray(restored.step), 1)
+        assert _delta(before, "resilience_checkpoint_skipped_total") >= 1
+
+    def test_all_steps_skips_empty_partial_dirs(self, tmp_path):
+        from mmlspark_tpu.dl.checkpoint import CheckpointManager
+        before = obs_registry.snapshot()
+        mgr = CheckpointManager(str(tmp_path / "ck"))
+        mgr.save(self._state(3), step=3)
+        os.makedirs(os.path.join(str(tmp_path / "ck"), "step_0000000007"))
+        assert mgr.all_steps() == [3]
+        assert mgr.latest_step() == 3
+        assert _delta(before, "resilience_checkpoint_skipped_total") >= 1
+
+    def test_explicit_corrupt_step_still_raises(self, tmp_path):
+        from mmlspark_tpu.dl.checkpoint import CheckpointManager
+        mgr = CheckpointManager(str(tmp_path / "ck"))
+        with pytest.raises(Exception):
+            mgr.restore(step=42)
+
+
+# ----------------------------------------------------------- sched put_front
+class TestSchedulerPutFront:
+    def test_replayed_work_jumps_the_queue(self):
+        from mmlspark_tpu.sched import RequestScheduler
+
+        class Item:
+            pass
+
+        s = RequestScheduler("putfront-test")
+        a, b, c = Item(), Item(), Item()
+        s.put_nowait(a)
+        s.put_nowait(b)
+        s.put_front(c)
+        assert [s.get_nowait() for _ in range(3)] == [c, a, b]
+
+    def test_put_front_respects_bound(self):
+        import queue as q
+
+        from mmlspark_tpu.sched import RequestScheduler
+
+        s = RequestScheduler("putfront-bound", max_queue=1)
+        s.put_nowait(object())
+        with pytest.raises(q.Full):
+            s.put_front(object())
+
+
+# ------------------------------------------------------- failure detection
+class TestFailureDetection:
+    def test_registry_marks_dead_on_missed_beats(self):
+        from mmlspark_tpu.serving import (DriverRegistry, RegistryClient,
+                                          ServiceInfo)
+        before = obs_registry.snapshot()
+        driver = DriverRegistry(heartbeat_timeout=0.3).start()
+        try:
+            client = RegistryClient(driver.address)
+            client.register(ServiceInfo(name="dtest", worker_id="w1",
+                                        host="127.0.0.1", port=1))
+            assert [i.worker_id for i in client.workers("dtest")] == ["w1"]
+            deadline = time.monotonic() + 5
+            while client.workers("dtest") and time.monotonic() < deadline:
+                time.sleep(0.05)
+            assert client.workers("dtest") == []
+            assert _delta(before, "resilience_worker_deaths_total") >= 1
+        finally:
+            driver.stop()
+
+    def test_heartbeats_keep_worker_alive(self):
+        from mmlspark_tpu.serving import (DriverRegistry, RegistryClient,
+                                          ServiceInfo)
+        driver = DriverRegistry(heartbeat_timeout=0.4).start()
+        try:
+            client = RegistryClient(driver.address)
+            info = ServiceInfo(name="htest", worker_id="w1",
+                               host="127.0.0.1", port=1)
+            for _ in range(8):  # beat for ~0.8 s at 0.1 s cadence
+                client.register(info)
+                time.sleep(0.1)
+            assert [i.worker_id for i in client.workers("htest")] == ["w1"]
+        finally:
+            driver.stop()
+
+
+# ---------------------------------------------- chaos: lease replay (ISSUE)
+def _post(addr, body, timeout=30):
+    conn = http.client.HTTPConnection(*addr, timeout=timeout)
+    try:
+        conn.request("POST", "/", body=body)
+        resp = conn.getresponse()
+        return resp.status, resp.read()
+    finally:
+        conn.close()
+
+
+class TestChaosLeaseReplay:
+    def test_injected_worker_death_mid_batch_replays_to_survivor(self):
+        """ISSUE 4 satellite: kill a mesh worker mid-batch via the
+        FaultInjector; every accepted request must be answered by a
+        survivor, ``serving_lease_replays_total`` must increment, and
+        no client may see a non-policy error. ``lease_timeout`` is set
+        FAR above the observed recovery, so the requeue is provably
+        driven by heartbeat failure detection, not deadline lapse."""
+        from mmlspark_tpu.io.http.schema import HTTPResponseData
+        from mmlspark_tpu.serving import (DistributedServingServer,
+                                          DriverRegistry,
+                                          remote_worker_loop)
+
+        def echo(df):
+            replies = np.empty(len(df), object)
+            replies[:] = [HTTPResponseData(
+                status_code=200, entity=(r.entity or b"").upper())
+                for r in df["request"]]
+            return df.with_column("reply", replies)
+
+        before = obs_registry.snapshot()
+        driver = DriverRegistry(heartbeat_timeout=0.5).start()
+        server = DistributedServingServer(
+            "chaos-replay", driver.address, lease_timeout=30.0,
+            reply_timeout=25.0).start()
+        stop = threading.Event()
+        workers = [threading.Thread(
+            target=remote_worker_loop,
+            args=(driver.address, "chaos-replay", echo),
+            kwargs={"stop_event": stop, "heartbeat_interval": 0.1,
+                    "worker_id": f"cw{i}"}, daemon=True)
+            for i in range(2)]
+        results = []
+        lock = threading.Lock()
+
+        def client(i):
+            s, b = _post(server.address, f"precious-{i}".encode(),
+                         timeout=25)
+            with lock:
+                results.append((s, b))
+
+        try:
+            # first non-empty lease kills its holder, batch stranded
+            with faults(13, [FaultRule(point="worker.death",
+                                       kind="kill", times=1)]):
+                for w in workers:
+                    w.start()
+                t0 = time.monotonic()
+                threads = [threading.Thread(target=client, args=(i,))
+                           for i in range(4)]
+                for t in threads:
+                    t.start()
+                for t in threads:
+                    t.join(timeout=25)
+                recovery = time.monotonic() - t0
+            assert not any(t.is_alive() for t in threads), \
+                "a client never got an answer"
+            assert len(results) == 4
+            assert all(s == 200 for s, _ in results), results
+            bodies = sorted(b for _, b in results)
+            assert bodies == sorted(
+                f"PRECIOUS-{i}".encode() for i in range(4))
+            assert _delta(before, "serving_lease_replays_total") >= 1
+            # detection (0.5 s heartbeat timeout) drove the requeue —
+            # the 30 s lease deadline never came close
+            assert recovery < 20.0, recovery
+        finally:
+            stop.set()
+            for w in workers:
+                w.join(timeout=5)
+            server.stop()
+            driver.stop()
+
+
+# ------------------------------------------------- chaos acceptance scenario
+class TestChaosScenario:
+    def test_seeded_chaos_acceptance_and_reproducibility(self):
+        """ISSUE 4 acceptance: 1 worker kill + 5% injected 503s +
+        latency spikes; the mesh answers 100% of accepted requests or
+        sheds per policy (429/503 only); zero transport errors reach
+        clients; resilience_retry_total / resilience_breaker_state /
+        serving_lease_replays_total are in the snapshot; the same seed
+        realizes the same fault schedule."""
+        from mmlspark_tpu.testing.benchmarks import chaos_scenario
+        runs = [chaos_scenario(seed=5, n_requests=24, n_workers=3,
+                               error_rate=0.15)
+                for _ in range(2)]
+        for r in runs:
+            assert r["answered_200"] + r["policy_sheds"] == r["offered"], r
+            assert r["transport_errors"] == 0, r
+            assert r["non_policy_errors"] == 0, r
+            assert r["lease_replays"] >= 1, r
+            assert r["retry_total_present"]
+            assert r["breaker_state_present"]
+            assert r["lease_replays_present"]
+            assert r["faults_injected"] >= 1
+        assert runs[0]["schedule"] == runs[1]["schedule"], \
+            "same seed must realize the same fault schedule"
+
+
+# ------------------------------------------------------ loadgen retry split
+class TestLoadgenRetrySplit:
+    def test_summarize_reports_retried_separately(self):
+        from mmlspark_tpu.serving.loadgen import summarize
+        # 8 requests: 4 first-offer 200s, 1 retried-200 (1200), 1
+        # retried-429 (1429: still shed after the re-attempt), 1 shed
+        # (429 first-offer, retry off for it), 1 transport failure
+        lat = np.asarray([[5.0, 5.0, 3.0, 5.0, 2.0, 0.1, 5.0, -1.0]])
+        st = np.asarray([[200, 200, 1200, 200, 1429, 429, 200, -1]])
+        r = summarize(lat, st, wall_s=1.0, warmup=0)
+        assert r["retried"] == 2 and r["retried_ok"] == 1
+        # final outcome classifies sheds: the first-offer 429 AND the
+        # still-shed re-attempt (1429) both count
+        assert r["shed"] == 2
+        assert r["transport_errors"] == 1
+        # first-offer successes only in the percentile columns
+        assert r["p50_ms"] == pytest.approx(5.0)
+        # throughput counts all 2xx work actually served (4 + 1 retried)
+        assert r["throughput_rps"] == pytest.approx(5.0)
+
+    def test_native_loadgen_honors_retry_after(self):
+        from mmlspark_tpu.native.loader import NativeLoader
+        if NativeLoader("loadgen", ["loadgen.cpp"]).load() is None:
+            pytest.skip("native toolchain unavailable")
+        from mmlspark_tpu.serving.loadgen import run_load
+        hits = [0]
+        lock = threading.Lock()
+
+        class Handler(BaseHTTPRequestHandler):
+            protocol_version = "HTTP/1.1"
+
+            def do_POST(self):
+                n = int(self.headers.get("Content-Length", 0))
+                self.rfile.read(n) if n else None
+                with lock:
+                    hits[0] += 1
+                    # shed the 1st and 3rd round trips: each shed's
+                    # bounded re-attempt (the next hit) then succeeds
+                    shed = hits[0] in (1, 3)
+                if shed:
+                    self.send_response(429)
+                    self.send_header("Retry-After", "0.05")
+                    self.send_header("Content-Length", "0")
+                    self.end_headers()
+                else:
+                    self.send_response(200)
+                    self.send_header("Content-Length", "2")
+                    self.end_headers()
+                    self.wfile.write(b"ok")
+
+            def log_message(self, *a):
+                pass
+
+        httpd = ThreadingHTTPServer(("127.0.0.1", 0), Handler)
+        threading.Thread(target=httpd.serve_forever, daemon=True).start()
+        try:
+            r = run_load("127.0.0.1", httpd.server_address[1], b"x",
+                         nconn=1, nreq=8, warmup=0, retry=True)
+            assert r["retried"] == 2 and r["retried_ok"] == 2, r
+            assert r["shed"] == 0, r
+            assert r["errors"] == 0, r
+        finally:
+            httpd.shutdown()
