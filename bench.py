@@ -1598,6 +1598,51 @@ def _serving_fallback(extras: dict) -> None:
         extras["error_serving_fallback"] = detail
 
 
+def bench_multichip(extras: dict) -> None:
+    """Sharded BERT train step + LightGBM histogram build on ALL local
+    devices (the partition-rule engine end to end): throughput, weak-
+    scaling efficiency vs 1 device, per-device MFU. Every earlier round
+    benched single-host only — this is the row the pod-scale trajectory
+    tracks.
+
+    Runs in a scrubbed subprocess on a virtual 8-device CPU platform
+    (the ``dryrun_multichip`` contract: the session environment pins
+    JAX to the single-chip tunnel, which can never yield 8 devices and
+    hangs when wedged); on a real multi-chip host the same body runs on
+    the chips and these keys become chip numbers. The platform rides
+    in ``multichip_platform`` so nobody mistakes host-CPU scaling
+    numbers for TPU MFU."""
+    import subprocess
+    import sys
+
+    from mmlspark_tpu.core.utils import scrubbed_cpu_env
+
+    n = 8
+    repo = os.path.dirname(os.path.abspath(__file__))
+    code = ("import jax; jax.config.update('jax_platforms', 'cpu'); "
+            "from mmlspark_tpu.testing.multichip_bench import main; "
+            f"main({n})")
+    proc = subprocess.run(
+        [sys.executable, "-c", code],
+        env=scrubbed_cpu_env(n, extra_path=repo), cwd=repo,
+        capture_output=True, text=True,
+        timeout=540 * _timeout_scale())
+    parsed = None
+    for line in reversed((proc.stdout or "").splitlines()):
+        try:
+            candidate = json.loads(line)
+        except ValueError:
+            continue
+        if isinstance(candidate, dict):  # skip stray scalar JSON lines
+            parsed = candidate
+            break
+    if proc.returncode != 0 or not isinstance(parsed, dict):
+        raise RuntimeError(
+            f"multichip bench subprocess failed (rc={proc.returncode}):\n"
+            f"{((proc.stdout or '') + (proc.stderr or ''))[-2000:]}")
+    extras.update(parsed)
+
+
 def _emit(images_per_sec: float, extras: dict) -> None:
     print(json.dumps({
         "metric": "imagefeaturizer_resnet50_inference",
@@ -1720,6 +1765,10 @@ def main():
             _watchdog(bench_flash_causal, extras, "flashcausal", 300.0)
         if want("gen"):
             _watchdog(bench_gen, extras, "gen", 420.0)
+        if want("multichip"):
+            # scrubbed-subprocess bench: immune to a wedged tunnel, so
+            # it can run even late in the suite
+            _watchdog(bench_multichip, extras, "multichip", 600.0)
         if want("serving"):
             # includes a small GBDT fit for the real-model row
             _watchdog(bench_serving, extras, "serving", 360.0)

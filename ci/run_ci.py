@@ -46,6 +46,7 @@ PACKAGES: dict[str, list[str]] = {
     "obs": ["test_obs.py"],
     "sched": ["test_sched.py"],  # admission/batching policy + scheduler
     "resilience": ["test_resilience.py"],  # retry/breaker/faults/chaos
+    "parallel": ["test_partition.py"],  # partition rules + pjit steps
     "text": ["test_text_transfer.py", "test_causal_lm.py",
              "test_speculative.py"],
 }
@@ -101,6 +102,25 @@ def style() -> int:
         "exec('with faults(7, [FaultRule(point=\"p\", kind=\"error\")]) "
         "as inj:\\n    assert inj.probe(\"p\") is not None'); "
         "print('resilience import OK (no jax)')")
+    rc = _run([sys.executable, "-c", smoke],
+              env=dict(os.environ, JAX_PLATFORMS="cpu"))
+    if rc:
+        return rc
+    # the partition-rule engine must import, match, and register rule
+    # sets with no JAX at all: model modules register their rules at
+    # import time on device-less machines, and rule sets are plain
+    # (regex, tuple) data until something shards for real
+    smoke = (
+        "import sys; "
+        "from mmlspark_tpu.parallel.partition import ("
+        "DtypePolicy, match_partition_rules, partition_rules_for, "
+        "register_partition_rules); "
+        "assert 'jax' not in sys.modules, 'partition import pulled jax'; "
+        "register_partition_rules('ci-smoke', [(r'kernel', (None, 'tp'))]); "
+        "assert partition_rules_for('ci-smoke'); "
+        "assert DtypePolicy().param_dtype == 'float32'; "
+        "assert 'jax' not in sys.modules, 'rule registration pulled jax'; "
+        "print('parallel.partition import OK (no jax)')")
     rc = _run([sys.executable, "-c", smoke],
               env=dict(os.environ, JAX_PLATFORMS="cpu"))
     if rc:

@@ -139,3 +139,28 @@ class BertEncoder(nn.Module):
             out["cls_pooled"] = jnp.tanh(
                 self.pooler_dense(x[:, 0])).astype(jnp.float32)
         return out
+
+
+# Partition rules for ingested BERT checkpoints: vocab-sharded word
+# embedding (the one genuinely large table), Megatron column→row pairs
+# inside each block (q/k/v/mlp_1 shard outputs, out/mlp_2 shard
+# inputs), everything per-channel replicated. Specs right-align
+# (parallel/partition.py); `re.search` is unanchored, so the same
+# rules match the tree under any prefix — a bare params dict, a
+# TrainState, or an optax moment tree.
+from ..parallel.partition import register_partition_rules
+
+register_partition_rules("BertEncoder", [
+    (r"word/embedding", ("tp", None)),
+    (r"(pos|type)/embedding", ()),
+    (r"(embed_ln|ln_att|ln_ffn)/(scale|bias)", ()),
+    (r"(q|k|v)/kernel", (None, "tp")),
+    (r"(q|k|v)/bias", ("tp",)),
+    (r"out/kernel", ("tp", None)),
+    (r"out/bias", ()),
+    (r"mlp_1/kernel", (None, "tp")),
+    (r"mlp_1/bias", ("tp",)),
+    (r"mlp_2/kernel", ("tp", None)),
+    (r"mlp_2/bias", ()),
+    (r"pooler/(kernel|bias)", ()),
+])

@@ -36,6 +36,8 @@ import jax.numpy as jnp
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
+from ..parallel.compat import tpu_compiler_params as _CompilerParams
+
 from ..utils.platform import target_platform  # noqa: F401 (re-export)
 
 _NEG = -1e30  # additive mask value; -inf breaks the running-max algebra
@@ -275,7 +277,7 @@ def _flash_forward(q, k, v, key_mask, offs=None, *, block_q: int = 256,
         ]
         o_spec = pl.BlockSpec((1, bq, D), lambda b, iq: (b, iq, 0))
         o_shape = jax.ShapeDtypeStruct((B * H, T + qp, D), v.dtype)
-        params = pltpu.CompilerParams(
+        params = _CompilerParams(
             dimension_semantics=("parallel", "parallel"))
         kern = functools.partial(_flash_kernel_causal_packed,
                                  scale=scale, bk=bk, with_lse=with_lse)
@@ -312,7 +314,7 @@ def _flash_forward(q, k, v, key_mask, offs=None, *, block_q: int = 256,
         pltpu.VMEM((bq, 128), jnp.float32),   # running denominator
         pltpu.VMEM((bq, D), jnp.float32),     # output accumulator
     ]
-    params = pltpu.CompilerParams(
+    params = _CompilerParams(
         dimension_semantics=("parallel", "parallel", "arbitrary"))
     if with_lse:
         out, lse = pl.pallas_call(
@@ -473,7 +475,7 @@ def _flash_backward(q, k, v, key_mask, o, lse, g, dlse=None,
         out_specs=pl.BlockSpec((1, bq, D), lambda b, iq, ik: (b, iq, 0)),
         out_shape=jax.ShapeDtypeStruct((B * H, T + qp, D), q.dtype),
         scratch_shapes=[pltpu.VMEM((bq, D), jnp.float32)],
-        compiler_params=pltpu.CompilerParams(
+        compiler_params=_CompilerParams(
             dimension_semantics=("parallel", "parallel", "arbitrary")),
         interpret=interpret,
     )(qf, kf, vf, mask, offs, gf, lse_f, dsum)
@@ -501,7 +503,7 @@ def _flash_backward(q, k, v, key_mask, o, lse, g, dlse=None,
         ),
         scratch_shapes=[pltpu.VMEM((bk, D), jnp.float32),
                         pltpu.VMEM((bk, D), jnp.float32)],
-        compiler_params=pltpu.CompilerParams(
+        compiler_params=_CompilerParams(
             dimension_semantics=("parallel", "parallel", "arbitrary")),
         interpret=interpret,
     )(kf, vf, mask, offs, qf, gf, lse_f, dsum)

@@ -24,9 +24,12 @@ import numpy as np
 import optax
 from flax import linen as nn
 
+from ..parallel.partition import (partition_rules_for,
+                                  register_partition_rules)
 from .text_encoder import TextEncoder
-from .train import TrainState, init_train_state, make_train_step, \
-    train_epoch
+from .train import (TrainState, init_train_state,
+                    make_partitioned_train_step, make_train_step,
+                    partition_train_state, train_epoch)
 
 
 class MaskedLMModel(nn.Module):
@@ -70,6 +73,50 @@ class MaskedLMModel(nn.Module):
         x = self.encoder.embed_window(toks, pos)
         x, caches = self.encoder.decode_window_blocks(x, caches, pos)
         return self.lm_head(x), caches
+
+
+# Partition rules for the pretraining LM: the encoder trunk's rules
+# (paths under ``encoder/`` still hit them — re.search is unanchored)
+# plus the LM head, column-parallel like every other vocab-sized
+# projection. Registered here, next to MaskedLMModel, so the rule set
+# lives beside the architecture it describes.
+register_partition_rules("TextEncoderLM", (
+    *partition_rules_for("TextEncoder"),
+    (r"lm_head/kernel", (None, "tp")),
+    (r"lm_head/bias", ("tp",)),
+))
+
+
+def _mesh_step_and_state(module, tx, state, mesh, dtype_policy,
+                         batch_size):
+    """Shared mesh plumbing for both pretraining objectives: validate
+    the mesh/batch pairing, shard the LM TrainState per the
+    TextEncoderLM rules, build the pjit'd step, and return the batch
+    placement ``train_epoch`` should device_put host batches with
+    (rows over ``dp``)."""
+    import jax
+    from jax.sharding import NamedSharding, PartitionSpec as P
+    if mesh is None:
+        step = make_train_step(module, tx, fetch="logits",
+                               loss_fn=masked_xent)
+        return step, jax.tree.map(jnp.asarray, state), None
+    if "dp" not in mesh.shape:
+        raise ValueError(
+            f"pretraining shards batches over axis 'dp'; mesh has "
+            f"{tuple(mesh.shape)}")
+    if batch_size % mesh.shape["dp"]:
+        raise ValueError(
+            f"batch_size={batch_size} must divide by the dp axis "
+            f"({mesh.shape['dp']})")
+    state, shardings = partition_train_state(
+        state, mesh, partition_rules_for("TextEncoderLM"),
+        dtype_policy=dtype_policy)
+    step = make_partitioned_train_step(
+        module, tx, mesh, shardings, fetch="logits",
+        loss_fn=masked_xent, dtype_policy=dtype_policy)
+    # spec spelled exactly like the step's batch in_shardings so the
+    # device_put in train_epoch and the compiled signature agree
+    return step, state, NamedSharding(mesh, P("dp"))
 
 
 def masked_xent(logits, labels):
@@ -121,7 +168,7 @@ def pretrain_masked_lm(encoder: TextEncoder, ids: np.ndarray, *,
                        steps: int = 200, batch_size: int = 32,
                        learning_rate: float = 1e-3,
                        mask_frac: float = 0.15, mask_id: int | None = None,
-                       seed: int = 0,
+                       seed: int = 0, mesh=None, dtype_policy=None,
                        tx: Any = None) -> tuple[TrainState, list[float]]:
     """Pretrain ``encoder`` on token-id rows ``ids`` [N, T] (pad id 0).
 
@@ -130,7 +177,11 @@ def pretrain_masked_lm(encoder: TextEncoder, ids: np.ndarray, *,
     ``vocabSize``, so an encoder ``vocab`` of ``vocabSize + 1`` leaves
     the slot free). Returns the full LM train state (resumable via
     ``CheckpointManager``) and per-batch losses; lift the trunk with
-    :func:`encoder_variables` for zoo publication."""
+    :func:`encoder_variables` for zoo publication.
+
+    ``mesh``: pjit the step over it (batch over ``dp``, weights per the
+    TextEncoderLM partition rules; ``dtype_policy`` rides along) —
+    ``batch_size`` must divide by the ``dp`` axis size."""
     ids = np.asarray(ids, np.int32)
     if mask_id is None:
         mask_id = encoder.vocab - 1
@@ -150,9 +201,9 @@ def pretrain_masked_lm(encoder: TextEncoder, ids: np.ndarray, *,
             yield mask_batch(rows, rng, mask_id=mask_id,
                              mask_frac=mask_frac)
 
-    step = make_train_step(module, tx, fetch="logits",
-                           loss_fn=masked_xent)
-    return train_epoch(step, state, batches())
+    step, state, placement = _mesh_step_and_state(
+        module, tx, state, mesh, dtype_policy, batch_size)
+    return train_epoch(step, state, batches(), placement=placement)
 
 
 def encoder_variables(state: TrainState) -> dict:
@@ -165,6 +216,7 @@ def encoder_variables(state: TrainState) -> dict:
 def pretrain_causal_lm(encoder: TextEncoder, ids: np.ndarray, *,
                        steps: int = 200, batch_size: int = 32,
                        learning_rate: float = 1e-3, seed: int = 0,
+                       mesh=None, dtype_policy=None,
                        tx: Any = None) -> tuple[TrainState, list[float]]:
     """Next-token pretraining (the decoder-side twin of
     :func:`pretrain_masked_lm`): logits at position t predict token
@@ -177,7 +229,10 @@ def pretrain_causal_lm(encoder: TextEncoder, ids: np.ndarray, *,
     ``make_attention_fn(impl, causal=True)``) — with bidirectional
     attention the objective is trivially cheatable by copying the next
     token, and the check below rejects it: position i's logits must be
-    invariant to tokens at positions > i."""
+    invariant to tokens at positions > i.
+
+    ``mesh``/``dtype_policy``: same pjit contract as
+    :func:`pretrain_masked_lm`."""
     ids = np.asarray(ids, np.int32)
     module = MaskedLMModel(encoder)  # same trunk + token head
     tx = tx or optax.adamw(learning_rate)
@@ -195,6 +250,6 @@ def pretrain_causal_lm(encoder: TextEncoder, ids: np.ndarray, *,
                          -1).astype(np.int32)
             yield x.astype(np.int32), y
 
-    step = make_train_step(module, tx, fetch="logits",
-                           loss_fn=masked_xent)
-    return train_epoch(step, state, batches())
+    step, state, placement = _mesh_step_and_state(
+        module, tx, state, mesh, dtype_policy, batch_size)
+    return train_epoch(step, state, batches(), placement=placement)

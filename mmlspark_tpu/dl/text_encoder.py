@@ -318,6 +318,29 @@ class TextEncoder(nn.Module):
         return self.finalize(x, ids)
 
 
+# Partition rules for the native TextEncoder: vocab-sharded embedding,
+# fused qkv projection column-parallel (its [W, 3W] kernel's output dim
+# concatenates q|k|v, each head-aligned, so sharding the last dim over
+# tp keeps whole heads on one shard as long as tp divides heads), out
+# and mlp_2 row-parallel. Specs right-align (parallel/partition.py).
+from ..parallel.partition import register_partition_rules as \
+    _register_partition_rules
+
+_register_partition_rules("TextEncoder", [
+    (r"embed/embedding", ("tp", None)),
+    (r"(ln_1|ln_2)/(scale|bias)", ()),
+    (r"(^|/)ln/(scale|bias)", ()),
+    (r"qkv/kernel", (None, "tp")),
+    (r"qkv/bias", ("tp",)),
+    (r"out/kernel", ("tp", None)),
+    (r"out/bias", ()),
+    (r"mlp_1/kernel", (None, "tp")),
+    (r"mlp_1/bias", ("tp",)),
+    (r"mlp_2/kernel", ("tp", None)),
+    (r"mlp_2/bias", ()),
+])
+
+
 def make_attention_fn(impl: str = "dense", mesh=None, axis: str = "sp",
                       block_size: int | None = None,
                       causal: bool = False) -> Callable:
@@ -499,12 +522,29 @@ class TextEncoderFeaturizer(Transformer, HasInputCol, HasOutputCol,
         for i, r in enumerate(rows):
             ids[i, :len(r)] = np.asarray(r, np.int32)
 
-        ids_dev = jnp.asarray(ids)
+        n_real = len(rows)
         if self._mesh is not None:
             from jax.sharding import NamedSharding, PartitionSpec as P
+            axes = dict(self._mesh.shape)
+            dp = int(axes.get("dp", 1))
+            if dp > 1:
+                # data-parallel embedding: rows pad to the dp shard
+                # count (pad rows are all-pad-id, masked out of
+                # attention and the mean pool anyway) and split over
+                # the dp axis — every local device embeds its slice of
+                # the batch. pad_rows preserves the int32 id dtype.
+                from ..parallel.sharding import pad_rows
+                ids, _ = pad_rows(ids, dp, pad_value=0)
+            # sequence stays sharded over sp when the mesh carries that
+            # axis (the ring/ulysses long-context contract); a dp-only
+            # mesh replicates the sequence dim
+            sp = "sp" if int(axes.get("sp", 1)) > 1 else None
+            spec = P("dp" if dp > 1 else None, sp)
             ids_dev = jax.device_put(
-                ids_dev, NamedSharding(self._mesh, P(None, "sp")))
-        pooled = np.asarray(apply(variables, ids_dev))
+                jnp.asarray(ids), NamedSharding(self._mesh, spec))
+        else:
+            ids_dev = jnp.asarray(ids)
+        pooled = np.asarray(apply(variables, ids_dev))[:n_real]
         # [n, W] numeric matrix, like ImageFeaturizer — feeds
         # TrainClassifier / Featurize without an object-column detour
         return df.with_column(self.get("outputCol"), pooled)

@@ -88,6 +88,29 @@ def softmax_xent(logits, labels):
     return -jnp.mean(jnp.sum(onehot * logp, axis=-1))
 
 
+def _make_loss_of(module, loss_fn: Callable, fetch: str):
+    """(params, stats, imgs, lbls) → (loss, new_model_state): the ONE
+    forward+loss body shared by the jitted single-device step and the
+    pjit'd partitioned step — the numerical-equivalence contract
+    between them is this function being literally the same code."""
+
+    def loss_of(params, stats, imgs, lbls):
+        variables = {"params": params}
+        if stats:
+            variables["batch_stats"] = stats
+            outputs, new_model_state = module.apply(
+                variables, imgs, True, mutable=["batch_stats"])
+        else:
+            # no mutable kwarg at all: flax returns (out, state) for
+            # ANY list-valued mutable, including []
+            outputs = module.apply(variables, imgs, True)
+            new_model_state = {}
+        logits = outputs[fetch] if isinstance(outputs, dict) else outputs
+        return loss_fn(logits, lbls), new_model_state
+
+    return loss_of
+
+
 def make_train_step(module, tx, mesh=None,
                     loss_fn: Callable = softmax_xent,
                     fetch: str = "logits",
@@ -114,21 +137,7 @@ def make_train_step(module, tx, mesh=None,
             labels = jax.lax.with_sharding_constraint(
                 labels, NamedSharding(mesh, P(*bspec)))
 
-        def loss_of(params, stats, imgs, lbls):
-            variables = {"params": params}
-            if stats:
-                variables["batch_stats"] = stats
-                outputs, new_model_state = module.apply(
-                    variables, imgs, True, mutable=["batch_stats"])
-            else:
-                # no mutable kwarg at all: flax returns (out, state) for
-                # ANY list-valued mutable, including []
-                outputs = module.apply(variables, imgs, True)
-                new_model_state = {}
-            logits = outputs[fetch] if isinstance(outputs, dict) else outputs
-            return loss_of.loss(logits, lbls), new_model_state
-
-        loss_of.loss = loss_fn
+        loss_of = _make_loss_of(module, loss_fn, fetch)
         grad_fn = jax.value_and_grad(loss_of, has_aux=True)
         if accum_steps <= 1:
             (loss, new_model_state), grads = grad_fn(
@@ -198,6 +207,135 @@ def make_train_step(module, tx, mesh=None,
         return new_state, loss
 
     return jax.jit(step, donate_argnums=(0,))
+
+
+def partition_train_state(state: TrainState, mesh, rules, *,
+                          dtype_policy=None, on_unmatched="replicate"):
+    """Place a TrainState onto a mesh per a model's partition rules.
+
+    The rules match over the FULL state pytree: optax optimizer states
+    nest the param tree, so ``.../mu/block0/qkv/kernel`` hits the same
+    rule as the param and the moments co-locate with their weights (the
+    fmengine TrainState pattern, SNIPPETS.md [2]). Scalars (``step``,
+    adam ``count``) replicate automatically; BatchNorm ``batch_stats``
+    need their own rules (the ResNet set carries them).
+
+    Returns ``(sharded_state, state_shardings)`` — feed the shardings
+    to :func:`make_partitioned_train_step` so the compiled step's
+    in/out layouts pin to this placement.
+    """
+    from ..parallel.partition import match_partition_rules, shard_params
+    specs = match_partition_rules(rules, state,
+                                  on_unmatched=on_unmatched)
+    state = jax.tree.map(jnp.asarray, state)
+    if dtype_policy is not None:
+        # params and their optimizer moments share the storage dtype;
+        # batch_stats ride along (float running stats), step/count are
+        # ints and pass through untouched
+        state = dtype_policy.cast_params(state)
+    return shard_params(mesh, state, specs)
+
+
+def make_partitioned_train_step(module, tx, mesh, state_shardings, *,
+                                loss_fn: Callable = softmax_xent,
+                                fetch: str = "logits",
+                                batch_axes: tuple[str, ...] = ("dp",),
+                                accum_steps: int = 1,
+                                dtype_policy=None):
+    """The pjit'd twin of :func:`make_train_step`: one SPMD train step
+    over a dp×tp mesh, driven by rule-derived shardings instead of the
+    per-leaf heuristic.
+
+    ``state_shardings`` (from :func:`partition_train_state`) become the
+    step's in/out shardings, so GSPMD can never drift the state layout
+    between steps, and the input state buffer is DONATED — at tp>1 the
+    param shards update in place. Batches shard over ``batch_axes``;
+    gradients reduce over the batch axes by sharding propagation (the
+    psum GSPMD inserts), exactly as the heuristic step.
+
+    Math is :func:`_make_loss_of` + the same optax update as
+    ``make_train_step`` — on a 1-device mesh the two produce the same
+    loss trajectory to float tolerance (pinned by test).
+
+    ``dtype_policy``: float inputs cast to ``compute_dtype`` on entry;
+    with ``accum_steps > 1`` the gradient accumulator carries
+    ``grad_accum_dtype`` (the arXiv:2008.01040 mixed-precision knob —
+    bf16 grads accumulate badly over many microbatches; f32 costs HBM).
+    """
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    bspec = P(batch_axes if len(batch_axes) > 1 else batch_axes[0])
+    batch_sh = NamedSharding(mesh, bspec)
+    repl = NamedSharding(mesh, P())
+
+    def step(state: TrainState, images, labels):
+        if dtype_policy is not None and jnp.issubdtype(
+                images.dtype, jnp.floating):
+            images = dtype_policy.cast_compute(images)
+        loss_of = _make_loss_of(module, loss_fn, fetch)
+        grad_fn = jax.value_and_grad(loss_of, has_aux=True)
+        if accum_steps <= 1:
+            (loss, new_model_state), grads = grad_fn(
+                state.params, state.batch_stats, images, labels)
+        else:
+            n = images.shape[0]
+            if n % accum_steps:
+                raise ValueError(
+                    f"batch size {n} must divide by accum_steps="
+                    f"{accum_steps}")
+            m = n // accum_steps
+            imgs_mb = images.reshape(accum_steps, m, *images.shape[1:])
+            lbls_mb = labels.reshape(accum_steps, m, *labels.shape[1:])
+            # keep each microbatch batch-sharded inside the scan (the
+            # same GSPMD gather hazard make_train_step documents)
+            mb_sh = NamedSharding(mesh, P(None, *bspec))
+            imgs_mb = jax.lax.with_sharding_constraint(imgs_mb, mb_sh)
+            lbls_mb = jax.lax.with_sharding_constraint(lbls_mb, mb_sh)
+
+            def accum(carry, mb):
+                g_acc, l_acc, stats = carry
+                imgs, lbls = mb
+                (loss_i, mstate), g_i = grad_fn(state.params, stats,
+                                                imgs, lbls)
+                # cast INTO the accumulator dtype: with a lower-precision
+                # grad_accum_dtype the bare add would promote the scan
+                # carry and lax.scan rejects the carry-dtype drift
+                g_acc = jax.tree.map(lambda a, g: a + g.astype(a.dtype),
+                                     g_acc, g_i)
+                stats = mstate.get("batch_stats", stats)
+                return (g_acc, l_acc + loss_i, stats), None
+
+            def zeros_accum(p):
+                if dtype_policy is not None and \
+                        dtype_policy.grad_accum_dtype is not None and \
+                        jnp.issubdtype(p.dtype, jnp.floating):
+                    return jnp.zeros(
+                        p.shape, jnp.dtype(dtype_policy.grad_accum_dtype))
+                return jnp.zeros_like(p)
+
+            g0 = jax.tree.map(zeros_accum, state.params)
+            (grads, loss, stats), _ = jax.lax.scan(
+                accum, (g0, jnp.float32(0.0), state.batch_stats),
+                (imgs_mb, lbls_mb))
+            inv = 1.0 / accum_steps
+            grads = jax.tree.map(
+                lambda g, p: (g * inv).astype(p.dtype),
+                grads, state.params)
+            loss = loss * inv
+            new_model_state = {"batch_stats": stats} if stats else {}
+        updates, new_opt = tx.update(grads, state.opt_state, state.params)
+        new_params = optax.apply_updates(state.params, updates)
+        new_state = TrainState(
+            params=new_params,
+            batch_stats=new_model_state.get("batch_stats",
+                                            state.batch_stats),
+            opt_state=new_opt, step=state.step + 1)
+        return new_state, loss
+
+    return jax.jit(step,
+                   in_shardings=(state_shardings, batch_sh, batch_sh),
+                   out_shardings=(state_shardings, repl),
+                   donate_argnums=(0,))
 
 
 def train_epoch(step, state, batches, placement=None):

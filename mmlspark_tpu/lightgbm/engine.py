@@ -245,7 +245,13 @@ def grow_tree(bins: jnp.ndarray, grad: jnp.ndarray, hess: jnp.ndarray,
     cnt_w = row_mask  # counts honour the bagging mask
 
     def psum(x):
-        return jax.lax.psum(x, psum_axis) if psum_axis else x
+        # routed through parallel.collectives so every histogram/vote
+        # reduction records parallel_collective_bytes_total{op,axis}
+        # (trace-time) beside the rest of the sharding engine's series
+        if psum_axis is None:
+            return x
+        from ..parallel.collectives import allreduce
+        return allreduce(x, psum_axis)
 
     # ---- root
     total_g, total_h, total_c = (psum(g.sum()), psum(h.sum()),

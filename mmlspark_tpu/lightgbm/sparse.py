@@ -325,7 +325,12 @@ def grow_tree_sparse(indices: jnp.ndarray, ebins: jnp.ndarray,
     gh1 = jnp.stack([g, h, row_mask], axis=1)   # [n, 3]
 
     def psum(x):
-        return jax.lax.psum(x, psum_axis) if psum_axis else x
+        # same routing as engine.grow_tree: the collective records into
+        # the parallel_* obs series at trace time
+        if psum_axis is None:
+            return x
+        from ..parallel.collectives import allreduce
+        return allreduce(x, psum_axis)
 
     def local_top_features(hist):
         """[F, B, 3] local hist → top-K feature votes [F] (PV-Tree).

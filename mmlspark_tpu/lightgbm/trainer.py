@@ -35,6 +35,7 @@ from .binning import bin_features, compute_bin_boundaries, bin_upper_value
 from .booster import Booster
 from .engine import Tree, TreeParams, grow_tree, tree_route_bins
 from .objectives import Objective, get_objective
+from ..parallel.compat import shard_map as _shard_map
 from .sparse import (SparseData, bin_sparse, compute_sparse_bin_boundaries,
                      grow_tree_sparse, pad_sparse, sparse_route_bins)
 
@@ -266,7 +267,7 @@ def make_grower(*, mesh, mesh_axis: str | tuple | None, tp: TreeParams,
         jitted = jax.jit(body)
         return lambda g2, h2, fm, rm: jitted(*data, g2, h2, fm, rm)
     gh_spec = P(None, mesh_axis) if multi else P(mesh_axis)
-    mapped = jax.shard_map(
+    mapped = _shard_map(
         body, mesh=mesh,
         in_specs=(*data_specs, gh_spec, gh_spec, P(), P(mesh_axis)),
         out_specs=(P(), gh_spec), check_vma=False)

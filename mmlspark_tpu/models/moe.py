@@ -15,6 +15,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 from jax.sharding import PartitionSpec as P
+from ..parallel.compat import shard_map as _shard_map
 
 
 def init_moe_params(rng, num_experts: int, d_model: int, d_hidden: int):
@@ -208,7 +209,7 @@ def make_sharded_moe(mesh, *, axis: str = "ep",
             "w_out": P(axis)}
     out_specs = (P(), {"balance_loss": P(), "expert_fraction": P()}) \
         if return_aux else P()
-    mapped = jax.shard_map(local, mesh=mesh, in_specs=(spec, P(), P()),
+    mapped = _shard_map(local, mesh=mesh, in_specs=(spec, P(), P()),
                            out_specs=out_specs, check_vma=False)
 
     def fn(params, x, valid=None):

@@ -131,6 +131,26 @@ class ResNet(nn.Module):
                 + ["pooled", "logits"])
 
 
+# Partition rules for the whole ResNet family (18/34/50/101 share the
+# naming scheme). Specs are right-aligned (parallel/partition.py): a
+# bare ("tp",) shards the LAST dim — a conv kernel's out-channels or a
+# dense kernel's features — which is the only dim worth sharding in a
+# CNN (channel counts are the 128-multiples; spatial dims are tiny).
+# BatchNorm state (params AND batch_stats mean/var — the same rules
+# match a full TrainState) replicates: per-channel vectors are noise
+# next to one conv kernel, and replicated stats keep the EMA update
+# collective-free.
+from ..parallel.partition import register_partition_rules
+
+register_partition_rules("ResNet", [
+    (r"(bn_init|BatchNorm_\d+)/(scale|bias|mean|var)", ()),
+    (r"conv_init/kernel", ("tp",)),
+    (r"Conv_\d+/kernel", ("tp",)),
+    (r"head/kernel", (None, "tp")),
+    (r"head/bias", ()),
+])
+
+
 def ResNet18(num_classes=1000, dtype=jnp.bfloat16, remat=False):
     return ResNet(stage_sizes=(2, 2, 2, 2), block=BasicBlock,
                   num_classes=num_classes, dtype=dtype, remat=remat)

@@ -121,6 +121,33 @@ class ViT(nn.Module):
                 + ["pooled", "logits"])
 
 
+# Partition rules for the ViT family: the Megatron column→row pairing —
+# q/k/v and mlp_1 shard their OUTPUT features ("column parallel"), out
+# and mlp_2 shard their INPUT features ("row parallel") so the only
+# cross-shard reduction per block is the one GSPMD inserts after each
+# row-parallel matmul. Specs right-align (parallel/partition.py), so
+# the same rules cover scan-stacked block params.
+from ..parallel.partition import register_partition_rules
+
+register_partition_rules("ViT", [
+    (r"(class_token|pos_embedding)", ()),
+    (r"conv_proj/kernel", ("tp",)),
+    (r"conv_proj/bias", ("tp",)),
+    (r"(ln_1|ln_2)/(scale|bias)", ()),
+    (r"(^|/)ln/(scale|bias)", ()),
+    (r"attn/(q|k|v)/kernel", (None, "tp")),
+    (r"attn/(q|k|v)/bias", ("tp",)),
+    (r"attn/out/kernel", ("tp", None)),
+    (r"attn/out/bias", ()),
+    (r"mlp_1/kernel", (None, "tp")),
+    (r"mlp_1/bias", ("tp",)),
+    (r"mlp_2/kernel", ("tp", None)),
+    (r"mlp_2/bias", ()),
+    (r"head/kernel", (None, "tp")),
+    (r"head/bias", ()),
+])
+
+
 def ViT_B_16(num_classes=1000, dtype=jnp.bfloat16, remat=False):
     return ViT(num_classes=num_classes, dtype=dtype, remat=remat)
 

@@ -1,5 +1,5 @@
-"""Distributed backend: device meshes, collectives, sharding helpers, and
-sequence parallelism.
+"""Distributed backend: device meshes, collectives, sharding helpers,
+partition rules, and sequence parallelism.
 
 This package is the TPU-native replacement for the reference's entire L3
 "distributed coordination / comm" layer (SURVEY §2.13): the driver
@@ -12,26 +12,79 @@ into a ``jax.sharding.Mesh`` + XLA collectives over ICI/DCN:
 - socket allreduce  → :func:`allreduce` / ``psum`` inside ``shard_map``
 - spanning tree     → the same (XLA picks the reduction topology)
 - empty partitions  → padding masks (:func:`pad_rows`), never ragged shards
+- per-model layout  → :func:`match_partition_rules` (regex rules →
+  ``PartitionSpec``, ``partition.py``) + :func:`shard_params` /
+  :func:`gather_params` with a :class:`DtypePolicy`
+
+Import is LIGHT: ``partition`` and ``mesh`` are JAX-free at module
+scope (rule sets register at model-import time on device-less
+machines; the CI smoke imports ``mmlspark_tpu.parallel.partition``
+with no JAX in ``sys.modules``). Everything that needs JAX —
+collectives, sharding placement, ring/ulysses attention, pipeline
+parallelism — loads lazily on first attribute access (PEP 562).
 """
 
 from .mesh import (MeshSpec, build_mesh, distributed_init, local_mesh,
                    mesh_shape_for)
-from .collectives import (allgather, allreduce, barrier, psum_scatter,
-                          ring_permute)
-from .sharding import (batch_sharding, pad_rows, replicated, shard_batch,
-                       unpad_rows)
-from .ring_attention import ring_attention, blockwise_attention
-from .ulysses import make_ulysses_attention
-from .pipeline import (pipeline_apply, pipeline_encode,
-                       pipeline_train_1f1b,
-                       pipeline_train_encoder_1f1b, make_pipeline_mlp)
+from .partition import (DtypePolicy, PartitionRule, dtype_policy_for,
+                        gather_params, match_partition_rules,
+                        named_leaves, partition_rules_for,
+                        register_partition_rules, registered_rule_sets,
+                        shard_params, to_shardings)
+
+# attribute name → submodule that defines it; resolved (and cached in
+# module globals) on first access so `import mmlspark_tpu.parallel`
+# never drags in JAX
+_LAZY = {
+    "allgather": ".collectives", "allreduce": ".collectives",
+    "barrier": ".collectives", "psum_scatter": ".collectives",
+    "ring_permute": ".collectives",
+    "batch_sharding": ".sharding", "pad_rows": ".sharding",
+    "replicated": ".sharding", "shard_batch": ".sharding",
+    "unpad_rows": ".sharding",
+    # NOT "ring_attention": the function shares its submodule's name,
+    # and the import system rebinds the package attr to the MODULE on
+    # any `import ...parallel.ring_attention` — a lazy attr of that
+    # name would be import-order dependent. The package-level name is
+    # therefore deterministically the submodule (from-import falls back
+    # to the submodule when the attr is absent); use
+    # `make_ring_attention` / `ring_attention.ring_attention` for the
+    # functions.
+    "make_ring_attention": ".ring_attention",
+    "blockwise_attention": ".ring_attention",
+    "make_ulysses_attention": ".ulysses",
+    "pipeline_apply": ".pipeline", "pipeline_encode": ".pipeline",
+    "pipeline_train_1f1b": ".pipeline",
+    "pipeline_train_encoder_1f1b": ".pipeline",
+    "make_pipeline_mlp": ".pipeline",
+}
 
 __all__ = [
     "make_ulysses_attention",
     "MeshSpec", "build_mesh", "distributed_init", "local_mesh",
     "mesh_shape_for", "allgather", "allreduce", "barrier", "psum_scatter",
     "ring_permute", "batch_sharding", "pad_rows", "replicated",
-    "shard_batch", "unpad_rows", "ring_attention", "blockwise_attention",
+    "shard_batch", "unpad_rows", "make_ring_attention",
+    "blockwise_attention",
     "pipeline_apply", "pipeline_encode", "pipeline_train_1f1b",
     "pipeline_train_encoder_1f1b", "make_pipeline_mlp",
+    "DtypePolicy", "PartitionRule", "match_partition_rules",
+    "named_leaves", "shard_params", "gather_params", "to_shardings",
+    "register_partition_rules", "partition_rules_for",
+    "dtype_policy_for", "registered_rule_sets",
 ]
+
+
+def __getattr__(name: str):
+    target = _LAZY.get(name)
+    if target is None:
+        raise AttributeError(
+            f"module {__name__!r} has no attribute {name!r}")
+    import importlib
+    value = getattr(importlib.import_module(target, __name__), name)
+    globals()[name] = value
+    return value
+
+
+def __dir__():
+    return sorted(set(globals()) | set(_LAZY))

@@ -27,6 +27,7 @@ import jax
 import jax.numpy as jnp
 
 from ..obs import registry as _obs
+from .compat import axis_size as _axis_size
 
 _m_calls = _obs.counter(
     "collective_calls_total",
@@ -34,6 +35,15 @@ _m_calls = _obs.counter(
 _m_bytes = _obs.counter(
     "collective_bytes_total",
     "per-shard payload bytes at collective issue, by op/axis")
+# the parallel_* twin of collective_bytes_total: the partition-engine
+# series family (parallel_rule_match_total / parallel_unmatched_leaves
+# _total / parallel_collective_bytes_total) lives on one prefix so a
+# dashboard for "what is the sharding engine doing" is one glob; the
+# legacy collective_* names keep recording for existing consumers
+_m_par_bytes = _obs.counter(
+    "parallel_collective_bytes_total",
+    "per-shard payload bytes at collective issue, by op/axis "
+    "(partition-engine series; same numbers as collective_bytes_total)")
 
 
 @contextlib.contextmanager
@@ -54,6 +64,7 @@ def _observed(op: str, x, axis):
         yield
     _m_calls.inc(1, op=op, axis=label)
     _m_bytes.inc(nbytes, op=op, axis=label)
+    _m_par_bytes.inc(nbytes, op=op, axis=label)
 
 
 def allreduce(x, axis: str | tuple[str, ...], op: str = "sum"):
@@ -87,7 +98,7 @@ def ring_permute(x, axis: str, shift: int = 1):
     """Rotate shards around the ring of a named axis (the building block of
     ring attention / sequence parallelism)."""
     with _observed("ring_permute", x, axis):
-        n = jax.lax.axis_size(axis)
+        n = _axis_size(axis)
         perm = [(i, (i + shift) % n) for i in range(n)]
         return jax.lax.ppermute(x, axis, perm)
 

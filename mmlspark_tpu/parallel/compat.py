@@ -1,0 +1,54 @@
+"""JAX API compatibility for the sharding layer.
+
+The framework targets current JAX, where ``shard_map`` is a top-level
+``jax.shard_map`` with a ``check_vma`` knob; on the previous API
+generation the same transform lives at
+``jax.experimental.shard_map.shard_map`` and the knob is ``check_rep``.
+Every in-repo call site goes through :func:`shard_map` so the version
+split is handled in exactly one place (the bake-what-you-have stance:
+no pip installs inside the image, so the code must run on the JAX it
+finds).
+
+JAX-free at module scope, like the rest of the package's light
+surface.
+"""
+
+from __future__ import annotations
+
+
+def shard_map(f, *, mesh, in_specs, out_specs, check_vma=None):
+    """``jax.shard_map`` on new JAX, ``jax.experimental.shard_map`` on
+    old, with ``check_vma``/``check_rep`` translated. ``check_vma=None``
+    means "library default" on either version."""
+    import jax
+    if hasattr(jax, "shard_map"):
+        kw = {} if check_vma is None else {"check_vma": check_vma}
+        return jax.shard_map(f, mesh=mesh, in_specs=in_specs,
+                             out_specs=out_specs, **kw)
+    from jax.experimental.shard_map import shard_map as _shard_map
+    kw = {} if check_vma is None else {"check_rep": check_vma}
+    return _shard_map(f, mesh=mesh, in_specs=in_specs,
+                      out_specs=out_specs, **kw)
+
+
+def tpu_compiler_params(**kwargs):
+    """Pallas TPU compiler params across the rename: ``CompilerParams``
+    on new JAX was ``TPUCompilerParams`` one generation back — same
+    fields, renamed class."""
+    from jax.experimental.pallas import tpu as pltpu
+    cls = getattr(pltpu, "CompilerParams", None) \
+        or pltpu.TPUCompilerParams
+    return cls(**kwargs)
+
+
+def axis_size(axis) -> int:
+    """STATIC size of a named mesh axis from inside shard_map/pjit.
+
+    ``jax.lax.axis_size`` on new JAX; on old JAX the classic
+    ``psum(1, axis)`` trick — a psum of a concrete Python scalar is
+    evaluated at trace time, so the result is a real int either way
+    (ring permutation tables and loop bounds need it concrete)."""
+    import jax
+    if hasattr(jax.lax, "axis_size"):
+        return jax.lax.axis_size(axis)
+    return jax.lax.psum(1, axis)

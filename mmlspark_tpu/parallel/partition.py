@@ -1,0 +1,306 @@
+"""Partition-rule engine: regex rules over named parameters → PartitionSpec.
+
+The fmengine ``match_partition_rules`` pattern (SNIPPETS.md [2]) made
+TPU-native: a model ships a SMALL ordered list of ``(regex, spec)``
+rules instead of hand-annotating every leaf, and the engine walks any
+pytree of named parameters — a bare flax params dict, a full
+``dl.train.TrainState`` (optax optimizer states nest the param tree, so
+the same rules match ``.../mu/block0/qkv/kernel``), or anything else
+with string-keyed paths — producing the spec pytree that ``jax.jit``'s
+``in_shardings``/``out_shardings`` and :func:`shard_params` consume.
+
+Semantics:
+
+- **first match wins** — rules are ordered, ``re.search`` over the
+  ``/``-joined leaf path; put specific rules before general ones.
+- **scalars replicate** — 0-d and single-element leaves never match a
+  rule (nothing to shard).
+- **specs are right-aligned** — a rule spec ``("tp",)`` places ``tp``
+  on the LAST dim, left-padding with ``None`` to the leaf's rank. Scan
+  stacking and microbatching PREPEND axes, so one rule written for the
+  unstacked layer also covers its ``lax.scan``-stacked twin
+  ``[L, in, out]``.
+- **unmatched leaves replicate LOUDLY** — counted in the process-wide
+  obs registry (``parallel_unmatched_leaves_total``) and warned once
+  per path; pass ``on_unmatched="error"`` to make it fatal (what the
+  per-model rule-set tests do).
+- matched rules are counted per-pattern in
+  ``parallel_rule_match_total{rule=...}``.
+
+Sharding decisions and dtype decisions are the same knob seen from two
+sides (mixed-precision findings of arXiv:2008.01040), so the dtype half
+lives here too: a :class:`DtypePolicy` names the param / compute /
+grad-accumulation dtypes and is applied by :func:`shard_params` in the
+same pass that places the leaves.
+
+This module imports NO JAX at module scope (CI smoke-checks that): rule
+sets register at model-definition import time on machines with no
+device, and specs are plain tuples until a function that actually
+needs ``jax.sharding`` runs.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import re
+import warnings
+from typing import Any, Sequence
+
+from ..obs import registry as _obs
+
+_m_rule_match = _obs.counter(
+    "parallel_rule_match_total",
+    "partition-rule hits while matching param trees, by rule pattern")
+_m_unmatched = _obs.counter(
+    "parallel_unmatched_leaves_total",
+    "param leaves no partition rule matched (loud replicated fallback)")
+_m_demoted = _obs.counter(
+    "parallel_spec_demoted_total",
+    "matched specs demoted to fewer axes because a dim does not divide "
+    "the mesh axis, by axis")
+
+# rule: (regex over the /-joined leaf path, spec entries right-aligned
+# to the leaf's trailing dims; each entry None | axis name | tuple of
+# axis names)
+PartitionRule = tuple[str, tuple]
+
+
+@dataclasses.dataclass(frozen=True)
+class DtypePolicy:
+    """Param / compute / grad-accumulation dtypes, named as strings so
+    the policy (like the rules it rides beside) is constructible with
+    no JAX import. ``None`` entries mean "leave as is". Casts apply to
+    floating leaves ONLY — integer ids, bin indices, bool masks and
+    step counters pass through untouched (the ``pad_rows`` dtype
+    contract, applied to casting)."""
+    param_dtype: str | None = "float32"
+    compute_dtype: str | None = "bfloat16"
+    grad_accum_dtype: str | None = "float32"
+
+    def _cast(self, tree, dtype_name: str | None):
+        if dtype_name is None:
+            return tree
+        import jax
+        import jax.numpy as jnp
+        dtype = jnp.dtype(dtype_name)
+
+        def one(leaf):
+            arr = jnp.asarray(leaf)
+            if jnp.issubdtype(arr.dtype, jnp.floating):
+                return arr.astype(dtype)
+            return arr
+        return jax.tree.map(one, tree)
+
+    def cast_params(self, tree):
+        """Storage dtype for parameters (and optimizer moments)."""
+        return self._cast(tree, self.param_dtype)
+
+    def cast_compute(self, tree):
+        """Activation/input dtype for the forward/backward."""
+        return self._cast(tree, self.compute_dtype)
+
+    def cast_grad_accum(self, tree):
+        """Dtype of the gradient accumulator under microbatching."""
+        return self._cast(tree, self.grad_accum_dtype)
+
+
+# ---------------------------------------------------------------- paths
+
+def _key_str(key) -> str:
+    """One path component as a bare name (no brackets/dots), so rules
+    read ``block0/qkv/kernel`` whatever node types the tree mixes."""
+    for attr in ("key", "name", "idx"):
+        if hasattr(key, attr):
+            return str(getattr(key, attr))
+    return str(key)
+
+
+def named_leaves(tree, sep: str = "/"):
+    """``[(path, leaf), ...]`` with ``sep``-joined string paths — dict
+    keys, dataclass/NamedTuple fields and sequence indices all render
+    as bare names (``0/mu/block0/qkv/kernel``)."""
+    from jax.tree_util import tree_flatten_with_path
+    flat, _ = tree_flatten_with_path(tree)
+    return [(sep.join(_key_str(k) for k in path), leaf)
+            for path, leaf in flat]
+
+
+def _tree_map_with_name(fn, tree, sep: str = "/"):
+    """tree_map whose fn receives (path_name, leaf)."""
+    import jax
+    from jax.tree_util import tree_flatten_with_path
+    flat, treedef = tree_flatten_with_path(tree)
+    out = [fn(sep.join(_key_str(k) for k in path), leaf)
+           for path, leaf in flat]
+    return jax.tree_util.tree_unflatten(treedef, out)
+
+
+# ------------------------------------------------------------- matching
+
+def _fit_spec(spec: Sequence, ndim: int, name: str):
+    """Right-align a rule spec to a leaf's rank (left-pad with None)."""
+    spec = tuple(spec)
+    if len(spec) > ndim:
+        raise ValueError(
+            f"partition rule spec {spec} has more entries than leaf "
+            f"{name!r} has dims ({ndim})")
+    return (None,) * (ndim - len(spec)) + spec
+
+
+def match_partition_rules(rules: Sequence[PartitionRule], params, *,
+                          on_unmatched: str = "replicate",
+                          _count: bool = True):
+    """Pytree of ``PartitionSpec`` congruent with ``params``.
+
+    ``rules``: ordered ``(regex, spec)`` pairs — first ``re.search``
+    match on the ``/``-joined leaf path wins; the spec right-aligns to
+    the leaf's rank. Scalar / single-element leaves always replicate.
+    ``on_unmatched``: ``"replicate"`` (loud fallback: warning + the
+    ``parallel_unmatched_leaves_total`` counter) or ``"error"``.
+    """
+    import numpy as np
+    from jax.sharding import PartitionSpec as P
+    if on_unmatched not in ("replicate", "error"):
+        raise ValueError(f"on_unmatched={on_unmatched!r}")
+    compiled = [(re.compile(rule), rule, spec) for rule, spec in rules]
+
+    def spec_of(name: str, leaf):
+        shape = getattr(leaf, "shape", ())
+        if len(shape) == 0 or int(np.prod(shape)) <= 1:
+            return P()
+        for rx, rule, spec in compiled:
+            if rx.search(name) is not None:
+                if _count:
+                    _m_rule_match.inc(1, rule=rule)
+                return P(*_fit_spec(spec, len(shape), name))
+        if on_unmatched == "error":
+            raise ValueError(
+                f"no partition rule matched param {name!r} "
+                f"(shape {tuple(shape)})")
+        if _count:
+            _m_unmatched.inc(1)
+        warnings.warn(
+            f"no partition rule matched param {name!r} "
+            f"(shape {tuple(shape)}); replicating it — add a rule "
+            "(or register one next to the model) to silence this",
+            stacklevel=2)
+        return P()
+
+    return _tree_map_with_name(spec_of, params)
+
+
+def to_shardings(mesh, params, specs):
+    """Spec pytree → ``NamedSharding`` pytree for a CONCRETE mesh.
+
+    ``jax.device_put`` (unlike a jit-internal sharding constraint)
+    refuses dims that don't divide their mesh axes, so any spec entry
+    whose axis product does not divide the leaf dim is demoted to
+    ``None`` here — counted per-axis in
+    ``parallel_spec_demoted_total{axis=...}`` so a silently-replicated
+    embedding table shows up on the scrape, not in an OOM.
+    """
+    import jax
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    def one(leaf, spec):
+        shape = getattr(leaf, "shape", ())
+        if len(tuple(spec)) > len(shape):
+            # same loud contract _fit_spec gives the rules path — a
+            # mis-ranked hand spec must name itself, not IndexError
+            raise ValueError(
+                f"spec {tuple(spec)} has more entries than the leaf "
+                f"has dims (shape {tuple(shape)})")
+        # right-align short specs, the same convention _fit_spec gives
+        # rule specs (scan stacking prepends axes; a hand-written short
+        # spec must not silently mean something different here)
+        entries = [None] * (len(shape) - len(tuple(spec))) + list(spec)
+        for i, entry in enumerate(entries):
+            if entry is None:
+                continue
+            axes = (entry,) if isinstance(entry, str) else tuple(entry)
+            # an axis the mesh does not carry (e.g. a tp rule against a
+            # dp-only local_mesh) demotes exactly like a non-divisible
+            # dim — replicate that dim, loudly, instead of KeyError
+            if any(a not in mesh.shape for a in axes):
+                _m_demoted.inc(1, axis=",".join(axes))
+                entries[i] = None
+                continue
+            size = 1
+            for a in axes:
+                size *= mesh.shape[a]
+            if shape[i] % size:
+                _m_demoted.inc(1, axis=",".join(axes))
+                entries[i] = None
+        return NamedSharding(mesh, P(*entries))
+
+    return jax.tree.map(one, params, specs,
+                        is_leaf=lambda x: isinstance(x, P))
+
+
+def shard_params(mesh, params, specs=None, *, rules=None,
+                 dtype_policy: DtypePolicy | None = None,
+                 on_unmatched: str = "replicate"):
+    """Place a param pytree onto ``mesh`` per rules/specs (+ optional
+    dtype policy). Returns ``(sharded_params, shardings)`` — the
+    shardings are what a pjit'd step passes as in/out_shardings so the
+    placement survives updates without re-layout.
+    """
+    import jax
+    if specs is None:
+        if rules is None:
+            raise ValueError("pass specs= or rules=")
+        specs = match_partition_rules(rules, params,
+                                      on_unmatched=on_unmatched)
+    if dtype_policy is not None:
+        params = dtype_policy.cast_params(params)
+    shardings = to_shardings(mesh, params, specs)
+    # ONE batched transfer for the whole pytree: device_put accepts
+    # congruent value/sharding trees, and a TrainState has hundreds of
+    # leaves (optax moments triple the param count) — per-leaf calls
+    # would serialize that many host->device transfers
+    placed = jax.device_put(params, shardings)
+    return placed, shardings
+
+
+def gather_params(params):
+    """Sharded pytree → fully-gathered HOST numpy pytree (checkpoint
+    publication, the zoo's consumption format). The inverse of
+    :func:`shard_params` up to dtype policy."""
+    import jax
+    import numpy as np
+    return jax.tree.map(lambda l: np.asarray(jax.device_get(l)), params)
+
+
+# ------------------------------------------------- per-model rule sets
+
+_RULE_SETS: dict[str, tuple[tuple[PartitionRule, ...],
+                            DtypePolicy | None]] = {}
+
+
+def register_partition_rules(name: str, rules: Sequence[PartitionRule],
+                             dtype_policy: DtypePolicy | None = None
+                             ) -> None:
+    """Register a model family's rule set (called next to the model
+    definition, at import time — no JAX needed). Re-registration
+    overwrites: the model file is the single source of truth."""
+    _RULE_SETS[name] = (tuple(rules), dtype_policy)
+
+
+def partition_rules_for(name: str) -> tuple[PartitionRule, ...]:
+    if name not in _RULE_SETS:
+        raise KeyError(
+            f"no partition rules registered for {name!r}; known: "
+            f"{sorted(_RULE_SETS)}")
+    return _RULE_SETS[name][0]
+
+
+def dtype_policy_for(name: str) -> DtypePolicy | None:
+    if name not in _RULE_SETS:
+        raise KeyError(
+            f"no partition rules registered for {name!r}; known: "
+            f"{sorted(_RULE_SETS)}")
+    return _RULE_SETS[name][1]
+
+
+def registered_rule_sets() -> list[str]:
+    return sorted(_RULE_SETS)

@@ -19,6 +19,7 @@ import functools
 import jax
 import jax.numpy as jnp
 from jax.sharding import PartitionSpec as P
+from .compat import shard_map as _shard_map
 
 
 def pipeline_apply(mesh, stage_fn, stacked_params, microbatches,
@@ -88,11 +89,11 @@ def pipeline_apply(mesh, stage_fn, stacked_params, microbatches,
         return last
 
     if aux is None:
-        return jax.shard_map(
+        return _shard_map(
             lambda p, x: body(p, x, None), mesh=mesh,
             in_specs=(P(axis), P()), out_specs=P(),
             check_vma=False)(stacked_params, microbatches)
-    return jax.shard_map(
+    return _shard_map(
         body, mesh=mesh,
         in_specs=(P(axis), P(), P()), out_specs=P(),
         check_vma=False)(stacked_params, microbatches, aux)
@@ -250,13 +251,13 @@ def pipeline_train_1f1b(mesh, stage_fn, loss_fn, stacked_params,
     n_outs = 2 + (extra_params is not None) + bool(return_input_grads)
     out_specs = (P(), P(axis)) + (P(),) * (n_outs - 2)
     if aux is None:
-        res = jax.shard_map(
+        res = _shard_map(
             lambda p, x, y, e: body(p, x, y, None, e), mesh=mesh,
             in_specs=(P(axis), P(), P(), P()), out_specs=out_specs,
             check_vma=False)(stacked_params, microbatches, targets,
                              extra_params)
     else:
-        res = jax.shard_map(
+        res = _shard_map(
             body, mesh=mesh, in_specs=(P(axis), P(), P(), P(), P()),
             out_specs=out_specs, check_vma=False)(
             stacked_params, microbatches, targets, aux, extra_params)

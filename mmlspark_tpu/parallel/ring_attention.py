@@ -19,6 +19,8 @@ import functools
 
 import jax
 import jax.numpy as jnp
+from .compat import axis_size as _axis_size, \
+    shard_map as _shard_map
 
 
 def _block_update(q, k, v, m, l, acc, bias, scale):
@@ -130,7 +132,7 @@ def ring_attention(q, k, v, *, axis: str = "sp", causal: bool = False,
     K/V block's (traced) global position offsets, so each ring step
     masks against true sequence coordinates.
     """
-    n = jax.lax.axis_size(axis)
+    n = _axis_size(axis)
     my = jax.lax.axis_index(axis)
     B, H, Tl, D = q.shape
     scale = scale if scale is not None else D ** -0.5
@@ -225,7 +227,7 @@ def make_ring_attention(mesh, *, causal: bool = False, axis: str = "sp",
     spec = P(batch_axis, None, axis, None)
 
     @functools.partial(
-        jax.shard_map, mesh=mesh,
+        _shard_map, mesh=mesh,
         in_specs=(spec, spec, spec, P(batch_axis, axis)), out_specs=spec,
         check_vma=False)
     def mapped(q, k, v, kmask):

@@ -30,6 +30,11 @@ def pad_rows(arrays, multiple: int, pad_value=0.0):
     (padded_arrays, mask) where mask is f32 [n_padded] with 1 = real row.
 
     Accepts a single array or a sequence; None entries pass through.
+    Each array keeps its OWN dtype: the pad constant is cast into it
+    per-array, so padding an int label column (or a bool flag column)
+    alongside float features never silently promotes it to float —
+    downstream jit signatures and gather indices depend on the dtype
+    surviving the pad. The validity mask alone is always f32.
     """
     single = not isinstance(arrays, (list, tuple))
     arrs = [arrays] if single else list(arrays)
@@ -40,10 +45,15 @@ def pad_rows(arrays, multiple: int, pad_value=0.0):
         if a is None:
             out.append(None)
             continue
+        a = np.asarray(a)
         if a.shape[0] != n:
             raise ValueError("inconsistent leading dims")
         pad_width = [(0, n_pad)] + [(0, 0)] * (a.ndim - 1)
-        out.append(np.pad(a, pad_width, constant_values=pad_value))
+        # the pad constant casts into each array's OWN dtype — the
+        # explicit cast pins the dtype-preservation contract the
+        # regression test asserts, independent of np.pad's casting rules
+        fill = np.asarray(pad_value).astype(a.dtype, casting="unsafe")
+        out.append(np.pad(a, pad_width, constant_values=fill))
     mask = np.ones(n + n_pad, np.float32)
     mask[n:] = 0.0
     return (out[0] if single else out), mask

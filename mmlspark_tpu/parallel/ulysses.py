@@ -21,6 +21,7 @@ import jax.numpy as jnp
 from jax.sharding import Mesh, PartitionSpec as P
 
 from .ring_attention import blockwise_attention
+from .compat import shard_map as _shard_map
 
 
 def make_ulysses_attention(mesh: Mesh, axis: str = "sp", *,
@@ -97,7 +98,7 @@ def make_ulysses_attention(mesh: Mesh, axis: str = "sp", *,
         return heads_to_seq(out)
 
     spec = P(batch_axis, None, axis, None)
-    mapped = jax.jit(jax.shard_map(
+    mapped = jax.jit(_shard_map(
         local, mesh=mesh,
         in_specs=(spec, spec, spec, P(batch_axis, axis)),
         out_specs=spec, check_vma=False))

@@ -26,6 +26,7 @@ from typing import Callable
 import jax
 import jax.numpy as jnp
 import numpy as np
+from ..parallel.compat import shard_map as _shard_map
 
 
 @dataclasses.dataclass
@@ -211,7 +212,7 @@ def train(indices: np.ndarray, values: np.ndarray, labels: np.ndarray,
             return mean(w), mean(bias), mean(g2), mean(g2b), mean(t)
 
         rep = P()
-        step_pass = jax.shard_map(
+        step_pass = _shard_map(
             local_pass, mesh=mesh,
             in_specs=(rep, rep, rep, rep, rep, P(mesh_axis)),
             out_specs=(rep, rep, rep, rep, rep), check_vma=False)

@@ -38,7 +38,11 @@ jax.config.update("jax_platforms", "cpu")
 # SIGILL per the cpu_aot_loader warning
 jax.config.update("jax_compilation_cache_dir",
                   "/tmp/mmlspark_tpu_jax_cache_tests")
-jax.config.update("jax_persistent_cache_min_compile_time_secs", 1.0)
+# cache aggressively: the suite compiles hundreds of sub-second SPMD
+# programs (8-device shard_map bodies recompile per hyperparameter set)
+# whose compile time dominates some files — at 1.0s threshold most of
+# them re-compiled every run
+jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.25)
 
 
 @pytest.fixture(scope="session")

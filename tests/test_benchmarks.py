@@ -153,6 +153,7 @@ class TestTrainBenchmarks:
             out[name] = cls._split(x, y)
         return out
 
+    @pytest.mark.slow
     def test_train_classifier_real_datasets(self):
         from mmlspark_tpu.train import LogisticRegression, TrainClassifier
         b = Benchmarks(os.path.join(
@@ -253,6 +254,7 @@ class TestVWBenchmarks:
 
 
 class TestSparseGBDTBenchmarks:
+    @pytest.mark.slow
     def test_sparse_classifier_auc(self):
         from test_lightgbm_sparse import dense_to_coo
         b = Benchmarks(os.path.join(

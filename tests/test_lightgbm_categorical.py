@@ -185,6 +185,7 @@ class TestCategoricalSplits:
         np.testing.assert_allclose(np.asarray(p_coo),
                                    np.asarray(p_dense), atol=1e-6)
 
+    @pytest.mark.slow
     def test_sparse_cat_sharded_matches_single(self):
         dense, idx, val, y = self._sparse_cat_data(n=1600, seed=11)
         df = DataFrame({"features_indices": idx, "features_values": val,
@@ -211,6 +212,7 @@ class TestCategoricalSplits:
             np.asarray(m2.transform(df)["probability"]),
             np.asarray(m.transform(df)["probability"]), atol=1e-6)
 
+    @pytest.mark.slow
     def test_voting_categorical_matches_data_parallel(self):
         """Categorical set splits under PV-Tree voting: candidate columns
         pay the ratio-sort and the winning set rides the record — AUC
@@ -230,6 +232,7 @@ class TestCategoricalSplits:
         assert abs(auc_dp - auc_v) < 0.03, (auc_dp, auc_v)
         assert np.asarray(m_v.booster.arrays["cat_flag"]).any()
 
+    @pytest.mark.slow
     def test_sparse_voting_categorical(self):
         dense, idx, val, y = self._sparse_cat_data(n=1600, seed=21)
         df = DataFrame({"features_indices": idx, "features_values": val,

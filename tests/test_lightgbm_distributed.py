@@ -9,6 +9,7 @@ not getting stuck on empty partitions / unbalanced shards).
 """
 
 import numpy as np
+import pytest
 
 from mmlspark_tpu.core import DataFrame
 from mmlspark_tpu.lightgbm import LightGBMClassifier, LightGBMRegressor
@@ -24,6 +25,7 @@ def make_binary(n=1200, f=12, seed=0):
 
 
 class TestDistributedTraining:
+    @pytest.mark.slow
     def test_sharded_matches_single_device(self):
         df = make_binary()
         single = (LightGBMClassifier(numIterations=30, numLeaves=15,
@@ -41,6 +43,7 @@ class TestDistributedTraining:
         np.testing.assert_allclose(single["probability"][:, 1],
                                    sharded["probability"][:, 1], atol=5e-3)
 
+    @pytest.mark.slow
     def test_unbalanced_padding(self):
         # 1203 rows over 8 shards → 5 pad rows; the SPMD 'ignore' path
         df = make_binary(n=1203)
@@ -49,6 +52,7 @@ class TestDistributedTraining:
         assert out["prediction"].shape == (1203,)
         assert roc_auc(df["label"], out["probability"][:, 1]) > 0.85
 
+    @pytest.mark.slow
     def test_regressor_sharded(self):
         rng = np.random.default_rng(3)
         x = rng.normal(size=(900, 8)).astype(np.float32)
@@ -68,6 +72,7 @@ class TestDistributedTraining:
         mesh = clf._training_mesh(10_000)             # big data auto-shards
         assert mesh is not None and mesh.shape["dp"] == 8
 
+    @pytest.mark.slow
     def test_hierarchical_two_level_psum_matches_flat(self):
         """shardAxisName="slice,dp" shards rows over a two-level
         (DCN x ICI) mesh; the histogram psum composes over the axis
@@ -106,6 +111,7 @@ class TestVotingParallel:
     ``params/LightGBMParams.scala:16-21``, ``LightGBMConstants.scala:24-26``
     — previously accepted and silently ignored, VERDICT r1 missing #3)."""
 
+    @pytest.mark.slow
     def test_voting_matches_data_parallel_auc(self):
         # wide feature space is voting's regime; top-2K candidates must
         # recover (nearly) the data_parallel splits
@@ -156,6 +162,7 @@ class TestMulticlassDistributed:
         y = np.digitize(x[:, 0], [-0.5, 0.5]).astype(np.float32)
         return x, y
 
+    @pytest.mark.slow
     def test_dense_sharded_matches_single(self):
         x, y = self._multi()
         df = DataFrame({"features": x, "label": y})
@@ -168,6 +175,7 @@ class TestMulticlassDistributed:
                                    atol=6e-3)
         assert (m8.transform(df)["prediction"] == y).mean() > 0.95
 
+    @pytest.mark.slow
     def test_sparse_sharded_multiclass(self):
         from test_lightgbm_sparse import dense_to_coo
         x, _ = self._multi(seed=5)
@@ -191,6 +199,7 @@ class TestDistributedRanker:
     ranking quality, under group sizes that do NOT align with the shard
     count."""
 
+    @pytest.mark.slow
     def test_ranker_sharded_matches_single(self):
         from test_benchmarks import TestRankerBenchmarks
         from mmlspark_tpu.lightgbm import LightGBMRanker
@@ -212,6 +221,7 @@ class TestDistributedRanker:
 
 
 class TestDistributedDart:
+    @pytest.mark.slow
     def test_dart_sharded_matches_single_device(self):
         """Fused DART under the sharded histogram path: the drop-set /
         rescale machinery operates on globally-replicated score and

@@ -223,6 +223,7 @@ class TestHighDimHashed:
             "materialization at 2^18 features would cost ~2000 MB")
 
 
+@pytest.mark.slow
 class TestSparseDistributed:
     def test_sharded_sparse_matches_single(self):
         df, x, y = sparse_binary_df(n=1200, seed=9)

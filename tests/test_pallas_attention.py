@@ -16,6 +16,12 @@ from mmlspark_tpu.dl.pallas_attention import flash_attention
 from mmlspark_tpu.dl.text_encoder import _dense_attention
 
 
+# Revived by parallel/compat (seed-era API-skew failures) but compile-heavy
+# SPMD programs: marked slow so tier-1 stays inside its wall clock. The
+# per-package CI run (no marker filter) still executes them.
+pytestmark = pytest.mark.slow
+
+
 def _rand_qkv(B=2, H=3, T=160, D=32, seed=0, dtype=jnp.float32):
     rng = np.random.default_rng(seed)
     mk = lambda: jnp.asarray(

@@ -16,6 +16,7 @@ from mmlspark_tpu.parallel import (MeshSpec, allreduce, allgather, barrier,
                                    build_mesh, local_mesh, pad_rows,
                                    psum_scatter, ring_permute, shard_batch,
                                    unpad_rows)
+from mmlspark_tpu.parallel.compat import shard_map
 from mmlspark_tpu.parallel.ring_attention import (blockwise_attention,
                                                   make_ring_attention,
                                                   ring_attention)
@@ -54,7 +55,7 @@ class TestCollectives:
         self.mesh = local_mesh()
 
     def _run(self, fn, x, out_specs=P("dp")):
-        return jax.shard_map(fn, mesh=self.mesh, in_specs=P("dp"),
+        return shard_map(fn, mesh=self.mesh, in_specs=P("dp"),
                              out_specs=out_specs, check_vma=False)(x)
 
     def test_allreduce_sum(self):
@@ -77,7 +78,7 @@ class TestCollectives:
     def test_psum_scatter(self):
         # replicated input; each shard receives its slice of the full sum
         x = np.arange(8, dtype=np.float32)
-        out = jax.shard_map(lambda s: psum_scatter(s, "dp"),
+        out = shard_map(lambda s: psum_scatter(s, "dp"),
                             mesh=self.mesh, in_specs=P(None),
                             out_specs=P("dp"), check_vma=False)(x)
         np.testing.assert_allclose(np.asarray(out), 8 * x)
@@ -104,6 +105,28 @@ class TestShardingHelpers:
         b = np.arange(5, dtype=np.float32)
         (pa, pn, pb), mask = pad_rows([a, None, b], 4)
         assert pa.shape == (8, 2) and pn is None and pb.shape == (8,)
+
+    def test_pad_rows_preserves_int_and_bool_dtypes(self):
+        """Regression: padding an int label (or bool flag) column next
+        to float features must not silently promote it to float — jit
+        signatures and gather indices downstream depend on the dtype
+        surviving the pad. Only the validity mask is f32."""
+        feats = np.ones((5, 2), np.float32)
+        labels = np.arange(5, dtype=np.int32)
+        flags = np.array([True, False, True, False, True])
+        ids64 = np.arange(5, dtype=np.int64)
+        (pf, pl, pb, pi), mask = pad_rows([feats, labels, flags, ids64],
+                                          8, pad_value=0.0)
+        assert pf.dtype == np.float32
+        assert pl.dtype == np.int32 and pl.shape == (8,)
+        assert pb.dtype == np.bool_
+        assert pi.dtype == np.int64
+        assert mask.dtype == np.float32
+        np.testing.assert_array_equal(pl[:5], labels)
+        assert not pl[5:].any() and not pb[5:].any()
+        # non-zero float pad constant still casts into each dtype
+        (pl2,), _ = pad_rows([labels], 8, pad_value=1.0)
+        assert pl2.dtype == np.int32 and pl2[5:].tolist() == [1, 1, 1]
 
     def test_shard_batch(self):
         mesh = local_mesh()
@@ -158,6 +181,7 @@ class TestRingAttention:
                 assert np.isfinite(np.asarray(g)).all(), kwargs
 
     @pytest.mark.parametrize("causal", [False, True])
+    @pytest.mark.slow
     def test_ring_matches_reference(self, causal):
         rng = np.random.default_rng(3)
         B, H, T, D = 1, 2, 64, 8  # T divisible by 8 shards
@@ -171,6 +195,7 @@ class TestRingAttention:
                                    atol=2e-5)
 
 
+@pytest.mark.slow
 class TestUlyssesAttention:
     """All-to-all (Ulysses) sequence parallelism must match single-device
     attention exactly — and its HLO must show the all-to-all collective."""
@@ -223,6 +248,7 @@ class TestUlyssesAttention:
             make_ulysses_attention(mesh)(q, k, v)
 
 
+@pytest.mark.slow
 class TestTwoDimensionalAttention:
     """2D data x sequence parallelism: batch shards over dp, sequence
     over sp; the ring (and ulysses' all-to-all) run independently per
@@ -284,6 +310,7 @@ class TestTwoDimensionalAttention:
                                    atol=2e-5)
 
 
+@pytest.mark.slow
 class TestRingFlashLocal:
     """Ring attention with the fused-Pallas local kernel (interpreted on
     the CPU mesh) must match the blockwise-local ring and differentiate."""
@@ -359,6 +386,7 @@ class TestRingFlashLocal:
                                    atol=5e-2)
 
 
+@pytest.mark.slow
 class TestUlyssesFlashLocal:
     """Ulysses with the fused-Pallas local kernel (interpreted on CPU)
     must match the blockwise-local variant and differentiate."""
@@ -415,6 +443,7 @@ class TestUlyssesFlashLocal:
             make_ulysses_attention(mesh, scale=0.5, local_impl="flash")
 
 
+@pytest.mark.slow
 def test_encoder_trains_through_ring_attention():
     """Full encoder train step whose attention is the shard_map ring:
     gradients flow back through the ppermute rotation and match the

@@ -1,6 +1,8 @@
 """Pipeline parallelism (pp) and expert parallelism (ep) against
 single-device references."""
 
+import pytest
+
 import jax
 import jax.numpy as jnp
 import numpy as np
@@ -17,6 +19,7 @@ def pp_mesh(n=4):
     return Mesh(np.asarray(jax.devices()[:n]), ("pp",))
 
 
+@pytest.mark.slow
 class TestPipelineParallel:
     def test_matches_sequential(self):
         S, M, mb, width = 4, 6, 2, 8
@@ -58,6 +61,7 @@ class TestPipelineParallel:
         np.testing.assert_allclose(np.asarray(out), ref, atol=1e-5)
 
 
+@pytest.mark.slow
 class TestPipeline1F1B:
     """The interleaved schedule must produce the SAME loss and param
     grads as a dense (single-device, sequential) fwd+bwd."""
@@ -155,6 +159,7 @@ class TestPipeline1F1B:
                 atol=5e-5, err_msg=str(k))
 
 
+@pytest.mark.slow
 class TestExpertParallel:
     def test_sharded_matches_single_device(self):
         E, D, H, T = 8, 16, 32, 24
@@ -214,6 +219,7 @@ class TestCapacityDispatch:
         np.testing.assert_array_equal(out[C:], 0.0)
         assert np.abs(dense[C:]).max() > 0  # dense DID compute them
 
+    @pytest.mark.slow
     def test_sharded_capacity_matches_single(self):
         """Shards rank queues from the same all-gathered routing, so
         drops agree with the single-device capacity path exactly."""
@@ -224,6 +230,7 @@ class TestCapacityDispatch:
         np.testing.assert_allclose(np.asarray(sharded),
                                    np.asarray(single), atol=1e-5)
 
+    @pytest.mark.slow
     def test_dispatch_flops_independent_of_expert_count(self):
         """The point of the formulation: quadrupling E leaves capacity
         compute ~flat (dense grows ~4x). Asserted with XLA's own cost
@@ -236,6 +243,8 @@ class TestCapacityDispatch:
             f = jax.jit(lambda p, x: moe_forward(
                 p, x, capacity_factor=capacity_factor))
             cost = f.lower(params, x).compile().cost_analysis()
+            if isinstance(cost, (list, tuple)):  # old-JAX shape
+                cost = cost[0]
             return float(cost["flops"])
 
         dense_ratio = flops(32, None) / flops(8, None)
@@ -301,6 +310,7 @@ class TestCapacityDispatch:
         assert float(jnp.abs(g["w_in"]).max()) > 0
         assert float(jnp.abs(g["w_out"]).max()) > 0
 
+    @pytest.mark.slow
     def test_train_step_capacity_default(self):
         """make_moe_train_step defaults to capacity dispatch and still
         trains the real MoE encoder."""
@@ -331,6 +341,7 @@ class TestCapacityDispatch:
         assert losses[-1] < losses[0]
 
 
+@pytest.mark.slow
 class TestMoETraining:
     """Trainable expert parallelism (VERDICT r3 Weak #5: MoE was
     inference-only with no load-balancing loss)."""
@@ -438,6 +449,7 @@ class TestMoETraining:
         assert losses[-1] < losses[0], losses
 
 
+@pytest.mark.slow
 class TestPipelineRealModel:
     """pipeline_encode: the REAL TextEncoder blocks as GPipe stages must
     reproduce the plain single-device forward (same blocks, same order —
@@ -476,6 +488,7 @@ class TestPipelineRealModel:
             pipeline_encode(pp_mesh(4), module, variables, ids)
 
 
+@pytest.mark.slow
 class TestPipelineTraining:
     """Gradients THROUGH the pipeline (VERDICT r3 item 9): the tick
     schedule is a scan, so jax.grad runs the backward pipeline over the
@@ -567,6 +580,7 @@ class TestPipelineTraining:
         assert float(loss2) < float(loss1)
 
 
+@pytest.mark.slow
 class TestMoERealModel:
     """Expert parallelism composed with the REAL TextEncoder (r2 weak
     #6: ep previously ran only a toy MLP): attention trunk replicated,

@@ -13,6 +13,7 @@ import jax.numpy as jnp
 import optax
 from jax.sharding import Mesh, PartitionSpec as P
 
+from mmlspark_tpu.parallel.compat import shard_map
 from mmlspark_tpu.dl.train import (init_train_state, make_train_step,
                                    shard_train_state)
 from mmlspark_tpu.models.resnet import BasicBlock, ResNet
@@ -111,7 +112,7 @@ class TestGBDTCollectives:
             return grow_tree(b, g, h, fm, rm, params=tp, num_features=F,
                              psum_axis="dp")
 
-        fn = jax.shard_map(local, mesh=mesh,
+        fn = shard_map(local, mesh=mesh,
                            in_specs=(P("dp"), P("dp"), P("dp"), P(),
                                      P("dp")),
                            out_specs=(P(), P("dp")), check_vma=False)

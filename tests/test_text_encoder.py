@@ -59,6 +59,7 @@ def test_batch_composition_independence(token_df, dense_features):
 @pytest.mark.parametrize("impl", ["blockwise", "pallas", "ring",
                                   "ring_flash", "ulysses",
                                   "ulysses_flash"])
+@pytest.mark.slow
 def test_sharded_impls_match_dense(impl, token_df, dense_features):
     mesh = None
     if impl in ("ring", "ring_flash", "ulysses", "ulysses_flash"):
