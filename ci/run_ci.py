@@ -44,6 +44,7 @@ PACKAGES: dict[str, list[str]] = {
            "test_reference_parity.py", "test_out_of_core.py",
            "test_ci.py", "test_bench_banking.py", "test_rcheck.py"],
     "obs": ["test_obs.py"],
+    "analysis": ["test_analysis.py"],  # graftcheck passes + gate + clock
     "sched": ["test_sched.py"],  # admission/batching policy + scheduler
     "resilience": ["test_resilience.py"],  # retry/breaker/faults/chaos
     "parallel": ["test_partition.py"],  # partition rules + pjit steps
@@ -125,6 +126,24 @@ def style() -> int:
               env=dict(os.environ, JAX_PLATFORMS="cpu"))
     if rc:
         return rc
+    # graftcheck (static analysis) is pure stdlib: it must import AND
+    # analyze with no JAX at all — it runs as a gate on machines (and
+    # in contexts) where importing the analyzed code is not an option
+    smoke = ("import sys; from mmlspark_tpu.analysis import ("
+             "Project, run_passes); "
+             "assert 'jax' not in sys.modules, 'analysis import pulled "
+             "jax'; "
+             "p = Project.load('.', 'mmlspark_tpu'); "
+             "assert len(p.modules) > 100, len(p.modules); "
+             "run_passes(p); "
+             "assert 'jax' not in sys.modules, 'analysis run pulled "
+             "jax'; "
+             "print('analysis import+run OK (no jax, "
+             "%d modules)' % len(p.modules))")
+    rc = _run([sys.executable, "-c", smoke],
+              env=dict(os.environ, JAX_PLATFORMS="cpu"))
+    if rc:
+        return rc
     # codegen reflection must walk every stage without error (the
     # reference's Style job runs codegen as part of the build)
     code = ("import os, tempfile, jax; "
@@ -165,6 +184,39 @@ def tests(package: str | None, retries: int = 1) -> int:
     return 0
 
 
+def analysis() -> int:
+    """The graftcheck gate: zero unbaselined findings over the package,
+    stale baseline entries fail too (--strict), and the traceability
+    report is regenerated to a TEMP file and diffed against the
+    committed copy — regenerating in place would overwrite the evidence
+    and mask staleness from everything that runs after this stage.
+    Budget: < 60 s — it runs pure ast, no JAX, so it actually finishes
+    in a few seconds."""
+    import filecmp
+    import tempfile
+    t0 = time.monotonic()
+    committed = os.path.join(REPO, "mmlspark_tpu", "analysis",
+                             "traceability.json")
+    with tempfile.NamedTemporaryFile(suffix=".json", delete=False) as f:
+        fresh = f.name
+    try:
+        rc = _run([sys.executable, "-m", "mmlspark_tpu.analysis",
+                   "--strict", "--traceability", fresh])
+        if rc == 0 and not filecmp.cmp(fresh, committed, shallow=False):
+            print("analysis: committed traceability.json is STALE — "
+                  "regenerate it:\n  python -m mmlspark_tpu.analysis "
+                  "--strict --traceability "
+                  "mmlspark_tpu/analysis/traceability.json")
+            rc = 1
+    finally:
+        os.unlink(fresh)
+    took = time.monotonic() - t0
+    if took > 60:
+        print(f"analysis gate exceeded its 60s budget ({took:.0f}s)")
+        return rc or 3
+    return rc
+
+
 def examples() -> int:
     return _run([sys.executable, os.path.join("examples", "run_all.py")])
 
@@ -176,16 +228,17 @@ def multichip() -> int:
 
 def main() -> int:
     ap = argparse.ArgumentParser()
-    ap.add_argument("--only", choices=["style", "tests", "examples",
-                                       "multichip"])
+    ap.add_argument("--only", choices=["style", "analysis", "tests",
+                                       "examples", "multichip"])
     ap.add_argument("--package", choices=sorted(PACKAGES))
     args = ap.parse_args()
     t0 = time.monotonic()
     stages = ([args.only] if args.only
-              else ["style", "tests", "examples", "multichip"])
+              else ["style", "analysis", "tests", "examples",
+                    "multichip"])
     for stage in stages:
-        rc = {"style": style, "examples": examples,
-              "multichip": multichip}.get(
+        rc = {"style": style, "analysis": analysis,
+              "examples": examples, "multichip": multichip}.get(
                   stage, lambda: tests(args.package))()
         if rc:
             print(f"CI FAILED at {stage} (rc={rc})")

@@ -1,0 +1,30 @@
+"""graftcheck — JAX-aware static analysis for the mmlspark_tpu codebase.
+
+Five passes over the package source (pure ``ast``; imports neither JAX
+nor the analyzed code):
+
+- ``trace-safety``   host ops reachable from jit/pjit/shard_map/
+                     pallas_call wrap sites; wall-clock reads in
+                     control-plane deadline paths; feeds the
+                     stage/featurizer traceability report
+- ``recompile-hazard``  jit-in-loop rewraps, Python branches on traced
+                     values, concretizing casts, unhashable static args
+- ``lock-discipline``   mutations of lock-owning classes' shared state
+                     outside the lock
+- ``donation``       donated buffers read after the donating call;
+                     train steps wrapped without donate_argnums
+- ``collective-audit``  raw lax.p* bypassing parallel.collectives'
+                     obs accounting; undeclared literal axis names
+
+CLI: ``python -m mmlspark_tpu.analysis`` (see ``__main__.py``); the CI
+gate runs it with ``--strict`` and fails on any unbaselined finding.
+Baseline entries (``analysis/baseline.json``) each carry a written
+justification — see docs/analysis.md for the triage workflow.
+"""
+
+from .core import (AnalysisPass, Finding, Project, all_passes,
+                   register_pass, run_passes)
+from .trace_safety import build_traceability
+
+__all__ = ["AnalysisPass", "Finding", "Project", "all_passes",
+           "register_pass", "run_passes", "build_traceability"]

@@ -1,0 +1,118 @@
+"""graftcheck CLI: ``python -m mmlspark_tpu.analysis``.
+
+Exit codes: 0 = clean (no unbaselined error/warning findings);
+1 = unbaselined findings (or, with ``--strict``, stale baseline
+entries); 2 = usage/baseline-contract errors.
+
+The CI gate is exactly::
+
+    python -m mmlspark_tpu.analysis --strict \
+        --json analysis_report.json \
+        --traceability mmlspark_tpu/analysis/traceability.json
+
+which must finish < 60 s (pure ``ast`` over the package; no JAX, no
+imports of the analyzed code).
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import time
+
+from . import baseline as baseline_mod
+from .core import Project, run_passes
+from .report import render_json, render_text
+from .trace_safety import build_traceability
+
+DEFAULT_BASELINE = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                                "baseline.json")
+
+
+def main(argv: list[str] | None = None) -> int:
+    ap = argparse.ArgumentParser(
+        prog="python -m mmlspark_tpu.analysis",
+        description="graftcheck: JAX-aware static analysis "
+                    "(trace-safety, recompile hazards, lock discipline, "
+                    "donation, collective audit)")
+    ap.add_argument("--root", default=None,
+                    help="repo root (default: the directory containing "
+                         "the analyzed package)")
+    ap.add_argument("--package", default="mmlspark_tpu",
+                    help="dotted package to analyze (default: "
+                         "mmlspark_tpu)")
+    ap.add_argument("--baseline", default=DEFAULT_BASELINE,
+                    help="baseline file (default: the package's "
+                         "analysis/baseline.json)")
+    ap.add_argument("--json", dest="json_out", default=None,
+                    help="write the machine-readable report here")
+    ap.add_argument("--traceability", default=None,
+                    help="write the stage/featurizer TRACEABLE/"
+                         "HOST-BOUND report here")
+    ap.add_argument("--write-baseline", action="store_true",
+                    help="append current unbaselined findings to the "
+                         "baseline with TODO justifications (then edit "
+                         "them — the gate rejects TODOs)")
+    ap.add_argument("--strict", action="store_true",
+                    help="also fail on stale baseline entries")
+    ap.add_argument("--quiet", action="store_true",
+                    help="suppress the text report (exit code only)")
+    args = ap.parse_args(argv)
+
+    t0 = time.monotonic()
+    root = args.root
+    if root is None:
+        # the package's own location: .../repo/mmlspark_tpu/analysis ->
+        # repo
+        here = os.path.dirname(os.path.abspath(__file__))
+        root = os.path.dirname(os.path.dirname(here))
+    project = Project.load(root, args.package)
+    if not project.modules:
+        print(f"no modules found under {root}/{args.package}",
+              file=sys.stderr)
+        return 2
+    findings = run_passes(project)
+
+    if args.write_baseline:
+        existing = baseline_mod.load(args.baseline, lenient=True)
+        unb, _, _ = baseline_mod.apply(findings, existing)
+        added = baseline_mod.write(args.baseline, unb, existing)
+        print(f"baseline: {added} new entr"
+              f"{'y' if added == 1 else 'ies'} written to "
+              f"{args.baseline} — edit every TODO justification before "
+              f"committing")
+        return 0
+
+    try:
+        base = baseline_mod.load(args.baseline)
+    except baseline_mod.BaselineError as e:
+        print(f"baseline contract violation: {e}", file=sys.stderr)
+        return 2
+    unbaselined, suppressed, stale = baseline_mod.apply(findings, base)
+
+    if args.traceability:
+        tr = build_traceability(project)
+        with open(args.traceability, "w", encoding="utf-8") as f:
+            json.dump(tr, f, indent=2)
+            f.write("\n")
+    if args.json_out:
+        with open(args.json_out, "w", encoding="utf-8") as f:
+            f.write(render_json(unbaselined, suppressed, stale,
+                                len(project.modules)))
+    if not args.quiet:
+        print(render_text(unbaselined, suppressed, stale,
+                          len(project.modules)), end="")
+        print(f"({time.monotonic() - t0:.1f}s)")
+    if unbaselined:
+        return 1
+    if args.strict and stale:
+        if not args.quiet:
+            print("strict: stale baseline entries present — delete them")
+        return 1
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

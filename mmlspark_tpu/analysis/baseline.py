@@ -1,0 +1,114 @@
+"""The justification-carrying baseline: known findings the gate accepts.
+
+The contract (enforced here, relied on by the CI gate):
+
+- every entry carries a **non-empty human justification** — a baseline
+  is a reviewed decision, not a mute button; loading a baseline with a
+  missing/empty justification raises;
+- entries key on the finding **fingerprint** (pass|rule|path|symbol|
+  detail — line-number free, see ``core.Finding.fingerprint``), so
+  reformatting does not churn the file but *moving the code to another
+  file or symbol does* — the justification must be re-reviewed where
+  the code now lives;
+- **stale entries** (fingerprints no current finding produces) are
+  reported so the file shrinks as fixes land; ``--strict`` makes them
+  fail the gate.
+
+Workflow: run ``python -m mmlspark_tpu.analysis --write-baseline`` to
+append new findings with ``justification: "TODO"`` placeholders, then
+replace every TODO with the actual reason before committing — the gate
+rejects TODOs like any other empty justification.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+
+from .core import Finding
+
+PLACEHOLDER = "TODO"
+
+
+class BaselineError(ValueError):
+    """A baseline file violates the contract (bad shape, missing or
+    placeholder justification)."""
+
+
+def load(path: str, lenient: bool = False) -> dict[str, dict]:
+    """fingerprint → entry. Missing file = empty baseline. ``lenient``
+    skips the justification check (ONLY for ``--write-baseline``, which
+    must be able to re-open its own placeholder output)."""
+    if not os.path.exists(path):
+        return {}
+    with open(path, encoding="utf-8") as f:
+        data = json.load(f)
+    if not isinstance(data, dict) or "findings" not in data:
+        raise BaselineError(f"{path}: expected {{'findings': [...]}}")
+    out: dict[str, dict] = {}
+    for i, entry in enumerate(data["findings"]):
+        fp = entry.get("fingerprint")
+        just = (entry.get("justification") or "").strip()
+        if not fp:
+            raise BaselineError(f"{path}: entry {i} has no fingerprint")
+        if not lenient and (not just
+                            or just.upper().startswith(PLACEHOLDER)):
+            raise BaselineError(
+                f"{path}: entry {fp} ({entry.get('rule', '?')} in "
+                f"{entry.get('path', '?')}) has no justification — every "
+                f"baselined finding must say WHY it is acceptable")
+        if fp in out:
+            raise BaselineError(f"{path}: duplicate fingerprint {fp}")
+        out[fp] = entry
+    return out
+
+
+def apply(findings: list[Finding], baseline: dict[str, dict]
+          ) -> tuple[list[Finding], list[Finding], list[dict]]:
+    """→ (unbaselined, suppressed, stale_entries). ``info`` findings are
+    report-only and never need baselining."""
+    unbaselined: list[Finding] = []
+    suppressed: list[Finding] = []
+    seen: set[str] = set()
+    for f in findings:
+        fp = f.fingerprint
+        if f.severity == "info":
+            continue
+        if fp in baseline:
+            suppressed.append(f)
+            seen.add(fp)
+        else:
+            unbaselined.append(f)
+    stale = [e for fp, e in baseline.items() if fp not in seen]
+    return unbaselined, suppressed, stale
+
+
+def write(path: str, findings: list[Finding],
+          existing: dict[str, dict] | None = None) -> int:
+    """Merge current unbaselined findings into the baseline file with
+    placeholder justifications (which the loader will reject until a
+    human replaces them). Returns the number of NEW entries."""
+    entries: dict[str, dict] = dict(existing or {})
+    added = 0
+    for f in findings:
+        if f.severity == "info" or f.fingerprint in entries:
+            continue
+        entries[f.fingerprint] = {
+            "fingerprint": f.fingerprint, "pass": f.pass_name,
+            "rule": f.rule, "path": f.path, "symbol": f.symbol,
+            "message": f.message,
+            "justification": PLACEHOLDER + ": replace with the reason "
+                             "this finding is acceptable",
+        }
+        added += 1
+    payload = {
+        "version": 1,
+        "comment": "graftcheck baseline — every entry needs a human "
+                   "justification; the gate rejects TODO placeholders. "
+                   "See docs/analysis.md for the triage workflow.",
+        "findings": [entries[fp] for fp in sorted(entries)],
+    }
+    with open(path, "w", encoding="utf-8") as f:
+        json.dump(payload, f, indent=2, sort_keys=False)
+        f.write("\n")
+    return added

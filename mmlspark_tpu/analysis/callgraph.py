@@ -1,0 +1,330 @@
+"""Name resolution, wrap-site discovery, and the module-level call graph.
+
+Everything here is best-effort *static* resolution over ``ast``: a name
+is resolved through the module's import table (``import jax.numpy as
+jnp`` makes ``jnp.dot`` resolve to ``jax.numpy.dot``), calls on ``self``
+resolve to methods of the enclosing class, and bare names resolve to
+module-level functions or single-hop ``from .mod import fn`` imports
+inside the analyzed package. Anything dynamic (getattr, dict dispatch,
+re-bound callables) stays unresolved — passes must treat "unresolved"
+as "unknown", never as "safe" or "unsafe".
+
+The central artifact is the set of **traced regions**: functions wrapped
+by (or decorated with) ``jit`` / ``pjit`` / ``shard_map`` /
+``pallas_call`` — the compile boundaries the ROADMAP's whole-pipeline
+compilation item cares about — plus everything reachable from them
+through the call graph (bounded depth). Lambdas handed straight to a
+wrapper are traced regions of their enclosing function.
+"""
+
+from __future__ import annotations
+
+import ast
+import dataclasses
+
+from .core import ModuleInfo, Project
+
+# call targets (last dotted component) that wrap a Python callable into
+# a traced/staged computation. `vmap`/`grad` trace too, but they are
+# almost always re-wrapped in jit at the real boundary — listing them
+# would double-count the same region.
+WRAP_NAMES = frozenset({"jit", "pjit", "shard_map", "pallas_call"})
+
+# how deep reachability walks from a traced entry. Two hops catches the
+# helper-inside-a-step pattern without dragging in half the package
+# through utility fan-out (each hop multiplies false-positive surface:
+# a deep callee may be host-side when called from elsewhere).
+REACH_DEPTH = 4
+
+
+def dotted(node: ast.AST) -> str | None:
+    """``a.b.c`` attribute chains / bare names → dotted string."""
+    parts: list[str] = []
+    while isinstance(node, ast.Attribute):
+        parts.append(node.attr)
+        node = node.value
+    if isinstance(node, ast.Name):
+        parts.append(node.id)
+        return ".".join(reversed(parts))
+    return None
+
+
+def import_table(tree: ast.Module, module_name: str) -> dict[str, str]:
+    """local alias → fully dotted origin. Relative imports are resolved
+    against ``module_name`` so ``from ..obs import registry`` inside
+    ``mmlspark_tpu.sched.policy`` maps ``registry`` →
+    ``mmlspark_tpu.obs.registry``."""
+    table: dict[str, str] = {}
+    pkg_parts = module_name.split(".")
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Import):
+            for a in node.names:
+                if a.asname:
+                    table[a.asname] = a.name       # jnp -> jax.numpy
+                else:
+                    head = a.name.split(".")[0]
+                    table[head] = head             # import a.b binds `a`
+        elif isinstance(node, ast.ImportFrom):
+            if node.level:
+                base = pkg_parts[:-node.level] if node.level <= len(
+                    pkg_parts) else []
+                prefix = ".".join(base + ([node.module]
+                                          if node.module else []))
+            else:
+                prefix = node.module or ""
+            for a in node.names:
+                if a.name == "*":
+                    continue
+                origin = f"{prefix}.{a.name}" if prefix else a.name
+                table[a.asname or a.name] = origin
+    return table
+
+
+def resolve(name: str | None, imports: dict[str, str]) -> str | None:
+    """Expand the leading component of a dotted name through the import
+    table (``jnp.dot`` → ``jax.numpy.dot``)."""
+    if name is None:
+        return None
+    head, _, rest = name.partition(".")
+    origin = imports.get(head)
+    if origin is None:
+        return name
+    return f"{origin}.{rest}" if rest else origin
+
+
+@dataclasses.dataclass
+class FuncInfo:
+    """One function/method definition."""
+
+    qualname: str                  # "Class.method" / "fn" / "fn.<locals>.g"
+    node: ast.AST                  # FunctionDef | AsyncFunctionDef | Lambda
+    class_name: str | None = None  # enclosing class, if a method
+
+    @property
+    def params(self) -> list[str]:
+        a = self.node.args
+        names = [p.arg for p in a.posonlyargs + a.args + a.kwonlyargs]
+        if a.vararg:
+            names.append(a.vararg.arg)
+        return names
+
+    @property
+    def positional_params(self) -> list[str]:
+        a = self.node.args
+        names = [p.arg for p in a.posonlyargs + a.args]
+        if names and names[0] in ("self", "cls"):
+            names = names[1:]
+        return names
+
+
+class ModuleGraph:
+    """Per-module function index + call graph + traced entries."""
+
+    def __init__(self, module: ModuleInfo):
+        self.module = module
+        self.imports = import_table(module.tree, module.name)
+        self.functions: dict[str, FuncInfo] = {}
+        #: caller qualname -> set of locally-resolved callee qualnames
+        self.calls: dict[str, set[str]] = {}
+        #: callee qualname -> list of (caller qualname, Call node)
+        self.call_sites: dict[str, list[tuple[str, ast.Call]]] = {}
+        #: qualnames wrapped by jit/pjit/shard_map/pallas_call, with the
+        #: wrap Call node (None for decorators carrying no call)
+        self.traced_entries: dict[str, list[ast.Call | None]] = {}
+        self._index()
+        self._find_wraps()
+
+    # -- indexing -----------------------------------------------------------
+    def _index(self) -> None:
+        module = self.module
+
+        def visit_body(body, prefix: str, class_name: str | None):
+            for node in body:
+                if isinstance(node, (ast.FunctionDef,
+                                     ast.AsyncFunctionDef)):
+                    q = f"{prefix}{node.name}"
+                    self.functions[q] = FuncInfo(q, node, class_name)
+                    visit_body(node.body, f"{q}.<locals>.", class_name)
+                elif isinstance(node, ast.ClassDef):
+                    visit_body(node.body, f"{node.name}.", node.name)
+                elif isinstance(node, (ast.If, ast.Try, ast.With,
+                                       ast.For, ast.While)):
+                    for field in ("body", "orelse", "finalbody",
+                                  "handlers"):
+                        sub = getattr(node, field, [])
+                        for item in sub:
+                            if isinstance(item, ast.ExceptHandler):
+                                visit_body(item.body, prefix, class_name)
+                        if sub and not isinstance(sub[0],
+                                                  ast.ExceptHandler):
+                            visit_body(sub, prefix, class_name)
+
+        visit_body(module.tree.body, "", None)
+        # call edges: walk each function's own statements (not nested
+        # defs' — those have their own entry)
+        for q, fi in self.functions.items():
+            callees: set[str] = set()
+            for call in self._own_calls(fi.node):
+                target = self._resolve_local_callee(call, fi)
+                if target is not None:
+                    callees.add(target)
+                    self.call_sites.setdefault(target, []).append((q, call))
+            self.calls[q] = callees
+
+    def _own_calls(self, root: ast.AST) -> list[ast.Call]:
+        """Every Call lexically inside ``root`` but NOT inside a nested
+        def (nested defs are separate function entries)."""
+        out: list[ast.Call] = []
+
+        def walk(node):
+            for child in ast.iter_child_nodes(node):
+                if isinstance(child, (ast.FunctionDef,
+                                      ast.AsyncFunctionDef)):
+                    continue
+                if isinstance(child, ast.Call):
+                    out.append(child)
+                walk(child)
+
+        walk(root)
+        return out
+
+    def _resolve_local_callee(self, call: ast.Call,
+                              caller: FuncInfo) -> str | None:
+        """Resolve a call target to a qualname in THIS module (methods
+        via self/cls, bare module-level names, nested defs)."""
+        f = call.func
+        if isinstance(f, ast.Attribute) and isinstance(f.value, ast.Name) \
+                and f.value.id in ("self", "cls") and caller.class_name:
+            q = f"{caller.class_name}.{f.attr}"
+            return q if q in self.functions else None
+        if isinstance(f, ast.Name):
+            q = f"{caller.qualname}.<locals>.{f.id}"
+            if q in self.functions:
+                return q
+            if f.id in self.functions:
+                return f.id
+        return None
+
+    # -- wrap-site discovery ------------------------------------------------
+    def resolve_call_name(self, call: ast.Call) -> str | None:
+        return resolve(dotted(call.func), self.imports)
+
+    def _is_wrap(self, resolved: str | None) -> bool:
+        if resolved is None:
+            return False
+        last = resolved.rsplit(".", 1)[-1]
+        return last in WRAP_NAMES
+
+    def _wrapped_target(self, call: ast.Call) -> ast.AST | None:
+        """The callable a wrap call stages: first positional arg, or the
+        ``partial(jit, ...)`` / keyword ``fun=`` forms."""
+        if call.args:
+            return call.args[0]
+        for kw in call.keywords:
+            if kw.arg in ("fun", "f", "func", "kernel"):
+                return kw.value
+        return None
+
+    def _mark_traced(self, target: ast.AST, wrap_call: ast.Call | None,
+                     scope: FuncInfo | None) -> None:
+        if isinstance(target, ast.Lambda):
+            # a lambda handed to jit: treat the ENCLOSING function as
+            # hosting a traced region (the lambda body is its code)
+            if scope is not None:
+                self.traced_entries.setdefault(
+                    scope.qualname, []).append(wrap_call)
+            return
+        name = dotted(target)
+        if name is None:
+            return
+        candidates = []
+        if scope is not None:
+            candidates.append(f"{scope.qualname}.<locals>.{name}")
+            if scope.class_name and name.startswith("self."):
+                candidates.append(
+                    f"{scope.class_name}.{name.split('.', 1)[1]}")
+        candidates.append(name)
+        for q in candidates:
+            if q in self.functions:
+                self.traced_entries.setdefault(q, []).append(wrap_call)
+                return
+
+    def _find_wraps(self) -> None:
+        # decorators: @jit / @partial(jit, ...) / @jax.jit
+        for q, fi in self.functions.items():
+            for dec in getattr(fi.node, "decorator_list", []):
+                resolved = resolve(dotted(dec), self.imports)
+                if self._is_wrap(resolved):
+                    self.traced_entries.setdefault(q, []).append(None)
+                elif isinstance(dec, ast.Call):
+                    dec_name = resolve(dotted(dec.func), self.imports)
+                    if self._is_wrap(dec_name):
+                        self.traced_entries.setdefault(q, []).append(dec)
+                    elif dec_name and dec_name.rsplit(".", 1)[-1] \
+                            == "partial" and dec.args:
+                        inner = resolve(dotted(dec.args[0]), self.imports)
+                        if self._is_wrap(inner):
+                            self.traced_entries.setdefault(
+                                q, []).append(dec)
+        # call-form wraps: jit(fn, ...) anywhere in the module (the
+        # module-level scope covers class bodies and top-level code)
+        scopes: list[tuple[FuncInfo | None, ast.AST]] = [
+            (None, self.module.tree)]
+        scopes += [(fi, fi.node) for fi in self.functions.values()]
+        for scope, root in scopes:
+            for call in self._own_calls(root):
+                resolved = self.resolve_call_name(call)
+                if not self._is_wrap(resolved):
+                    # partial(jit, ...) in call position
+                    if resolved and resolved.rsplit(".", 1)[-1] \
+                            == "partial" and call.args:
+                        inner = resolve(dotted(call.args[0]), self.imports)
+                        if self._is_wrap(inner) and len(call.args) > 1:
+                            self._mark_traced(call.args[1], call, scope)
+                    continue
+                target = self._wrapped_target(call)
+                if target is not None:
+                    self._mark_traced(target, call, scope)
+
+    # -- reachability -------------------------------------------------------
+    def traced_functions(self, depth: int = REACH_DEPTH
+                         ) -> dict[str, int]:
+        """qualname → hop distance from the nearest traced entry (0 =
+        entry itself), over the intra-module call graph."""
+        dist = {q: 0 for q in self.traced_entries}
+        frontier = list(dist)
+        for d in range(1, depth + 1):
+            nxt: list[str] = []
+            for q in frontier:
+                for callee in self.calls.get(q, ()):
+                    if callee not in dist:
+                        dist[callee] = d
+                        nxt.append(callee)
+            frontier = nxt
+        return dist
+
+
+class ProjectGraph:
+    """Lazily built per-module graphs, shared across passes (built once
+    per run through :meth:`of`)."""
+
+    def __init__(self, project: Project):
+        self.project = project
+        self._graphs: dict[str, ModuleGraph] = {}
+
+    def of(self, module: ModuleInfo) -> ModuleGraph:
+        g = self._graphs.get(module.name)
+        if g is None:
+            g = self._graphs[module.name] = ModuleGraph(module)
+        return g
+
+
+def graphs_for(project: Project) -> ProjectGraph:
+    """One ProjectGraph per Project instance (passes share the index
+    work instead of each rebuilding it). Cached ON the project — an
+    id()-keyed module global would go stale when a GC'd project's id is
+    reused by a new one (exactly the churn a test suite produces)."""
+    pg = getattr(project, "_graphs", None)
+    if pg is None:
+        pg = project._graphs = ProjectGraph(project)
+    return pg

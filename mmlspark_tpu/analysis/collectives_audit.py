@@ -1,0 +1,170 @@
+"""Pass 5 — collective/mesh audit: raw ``lax.p*`` calls that bypass
+``parallel.collectives``, and collective axis names no enclosing mesh
+binds.
+
+PR 4 routed the LightGBM histogram/vote reductions through
+``parallel.collectives`` so every collective lands in the obs registry
+(``parallel_collective_bytes_total{op,axis}``). A raw
+``jax.lax.psum``/``ppermute``/``all_gather`` call site silently escapes
+that accounting — the scrape under-reports cross-chip traffic exactly
+where it matters. Rule ``raw-collective`` (warning) flags them
+everywhere except ``parallel/collectives.py`` and ``parallel/compat.py``
+(the blessed wrappers' own bodies).
+
+Rule ``unbound-axis`` (error) checks literal axis names: a string axis
+passed to a collective must appear among the module's declared axes
+(string literals inside ``shard_map``/``Mesh``/``make_mesh``/
+``PartitionSpec``/``axis_names=`` forms). A typo'd axis fails at run
+time with an unbound-name error — but only on the multi-device path CI
+rarely exercises, which is why it is worth proving statically. Axes
+passed as variables are not checkable and are skipped; modules that
+declare no axes at all are skipped too (nothing to check against).
+"""
+
+from __future__ import annotations
+
+import ast
+
+from .callgraph import dotted, graphs_for, resolve
+from .core import AnalysisPass, Finding, ModuleInfo, Project, register_pass
+
+COLLECTIVE_NAMES = frozenset({
+    "psum", "pmean", "pmax", "pmin", "all_gather", "all_to_all",
+    "ppermute", "pshuffle", "psum_scatter", "axis_index", "pbroadcast"})
+# modules allowed to touch lax.p* directly (the instrumented wrappers)
+BLESSED = ("parallel/collectives.py", "parallel/compat.py")
+
+
+def _is_collective(resolved: str | None) -> str | None:
+    if not resolved:
+        return None
+    head, _, last = resolved.rpartition(".")
+    if last in COLLECTIVE_NAMES and (
+            "lax" in head.split(".") or head in ("jax.lax", "lax")):
+        return last
+    return None
+
+
+def _strings_in(node: ast.AST) -> set[str]:
+    return {s.value for s in ast.walk(node)
+            if isinstance(s, ast.Constant) and isinstance(s.value, str)
+            and s.value.isidentifier()}
+
+
+def _declared_axes(g, mod: ModuleInfo) -> set[str]:
+    """Axis names the module provably binds. Deliberately narrow — only
+    axis-bearing positions are harvested, because every over-collected
+    string ('flash', a mode default…) is a typo the unbound-axis rule
+    can no longer catch:
+
+    - positional string args of ``PartitionSpec``/``P``/``NamedSharding``
+      (their positionals ARE axis names);
+    - the axis-names argument of ``Mesh``/``make_mesh``/``mesh`` (2nd
+      positional or ``axis_names=``);
+    - ``axis_names=``/``axis_name=``/``axis_resources=`` kwargs of any
+      call (shard_map/pjit forms);
+    - defaults of parameters whose NAME mentions axis
+      (``def ring(..., axis: str = "sp")`` — callers inherit it).
+    """
+    axes: set[str] = set()
+    spec_binders = {"PartitionSpec", "P", "NamedSharding"}
+    mesh_binders = {"Mesh", "make_mesh", "mesh", "make_simple_mesh"}
+    for node in ast.walk(mod.tree):
+        if isinstance(node, ast.Call):
+            resolved = resolve(dotted(node.func), g.imports) or ""
+            last = resolved.rsplit(".", 1)[-1]
+            if last in spec_binders:
+                for a in node.args:
+                    axes |= _strings_in(a)
+            elif last in mesh_binders and len(node.args) >= 2:
+                axes |= _strings_in(node.args[1])
+            for kw in node.keywords:
+                if kw.arg in ("axis_names", "axis_name",
+                              "axis_resources"):
+                    axes |= _strings_in(kw.value)
+        elif isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            args = node.args
+            pos = args.posonlyargs + args.args
+            for a, d in zip(pos[len(pos) - len(args.defaults):],
+                            args.defaults):
+                if "axis" in a.arg and isinstance(d, ast.Constant) \
+                        and isinstance(d.value, str):
+                    axes.add(d.value)
+            for a, d in zip(args.kwonlyargs, args.kw_defaults):
+                if d is not None and "axis" in a.arg and \
+                        isinstance(d, ast.Constant) and \
+                        isinstance(d.value, str):
+                    axes.add(d.value)
+    return axes
+
+
+def _axis_literals(call: ast.Call) -> list[str]:
+    """String-literal axis names handed to a collective call: the
+    second positional arg (lax convention) or axis/axis_name kwargs,
+    including tuples of names."""
+    cands: list[ast.AST] = []
+    if len(call.args) >= 2:
+        cands.append(call.args[1])
+    elif call.args and _last_name(call) == "axis_index":
+        cands.append(call.args[0])
+    for kw in call.keywords:
+        if kw.arg in ("axis", "axis_name"):
+            cands.append(kw.value)
+    out = []
+    for c in cands:
+        for sub in ast.walk(c):
+            if isinstance(sub, ast.Constant) and \
+                    isinstance(sub.value, str):
+                out.append(sub.value)
+    return out
+
+
+def _last_name(call: ast.Call) -> str:
+    name = dotted(call.func) or ""
+    return name.rsplit(".", 1)[-1]
+
+
+@register_pass
+class CollectiveAuditPass(AnalysisPass):
+    name = "collective-audit"
+    description = ("raw lax.p* collectives bypassing parallel."
+                   "collectives' obs accounting; literal axis names no "
+                   "mesh in the module declares")
+
+    def run(self, project: Project) -> list[Finding]:
+        graphs = graphs_for(project)
+        out: list[Finding] = []
+        for mod in project.modules.values():
+            g = graphs.of(mod)
+            blessed = any(mod.rel_path.endswith(b) for b in BLESSED)
+            axes = None  # computed lazily per module
+            for fi in g.functions.values():
+                for call in g._own_calls(fi.node):
+                    resolved = resolve(dotted(call.func), g.imports)
+                    op = _is_collective(resolved)
+                    if op is None:
+                        continue
+                    if not blessed:
+                        out.append(self.finding(
+                            "raw-collective", "warning", mod, call,
+                            fi.qualname,
+                            f"raw jax.lax.{op} in {fi.qualname!r} "
+                            f"bypasses parallel.collectives — this "
+                            f"transfer never lands in parallel_"
+                            f"collective_bytes_total (obs accounting)",
+                            detail=op))
+                    if axes is None:
+                        axes = _declared_axes(g, mod)
+                    if axes:
+                        for lit in _axis_literals(call):
+                            if lit not in axes:
+                                out.append(self.finding(
+                                    "unbound-axis", "error", mod, call,
+                                    fi.qualname,
+                                    f"axis {lit!r} in jax.lax.{op} is "
+                                    f"not declared by any mesh/"
+                                    f"shard_map/PartitionSpec in this "
+                                    f"module (known: "
+                                    f"{', '.join(sorted(axes))})",
+                                    detail=f"{op}:{lit}"))
+        return out

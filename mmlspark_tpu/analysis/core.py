@@ -1,0 +1,190 @@
+"""graftcheck core: findings, the parsed-project model, and the pass
+framework.
+
+The analyzer is **pure stdlib** (``ast`` + ``hashlib``): it parses the
+package source, never imports it, so it runs with no JAX, no device and
+no side effects — the same posture TVM takes with compile-time program
+analysis (PAPERS.md arXiv:1802.04799): decide what a program *can* do
+before anything executes. The CI smoke check asserts
+``import mmlspark_tpu.analysis`` pulls in neither JAX nor the package
+under analysis.
+
+Vocabulary:
+
+- A :class:`Finding` is one diagnostic: ``(pass, rule, severity, path,
+  line, symbol, message)`` plus a *stable fingerprint* that survives
+  line-number drift — the baseline file keys on it.
+- A :class:`Project` is the parsed package: every module's AST + source,
+  keyed by dotted name.
+- An :class:`AnalysisPass` turns a Project into findings. Passes
+  register themselves in :data:`PASS_REGISTRY` at import.
+
+Severities: ``error`` (a correctness contract is violated), ``warning``
+(hazard that needs a human look), ``info`` (report-only, never gates).
+The CI gate fails on any unbaselined error or warning.
+"""
+
+from __future__ import annotations
+
+import ast
+import dataclasses
+import hashlib
+import os
+
+SEVERITIES = ("error", "warning", "info")
+
+
+@dataclasses.dataclass
+class Finding:
+    """One diagnostic emitted by a pass."""
+
+    pass_name: str
+    rule: str
+    severity: str
+    path: str          # repo-relative, forward slashes
+    line: int
+    symbol: str        # qualified name of the enclosing def/class ("" = module)
+    message: str
+    detail: str = ""   # stable token folded into the fingerprint (e.g. the
+                       # flagged call name) — never line numbers
+
+    def __post_init__(self):
+        if self.severity not in SEVERITIES:
+            raise ValueError(f"bad severity {self.severity!r}")
+
+    @property
+    def fingerprint(self) -> str:
+        """Stable id for baselining: pass|rule|path|symbol|detail hashed.
+        Line numbers are deliberately excluded so reformatting a file
+        does not invalidate its baseline; one fingerprint therefore
+        suppresses EVERY identical finding in the same symbol (adding a
+        second identical hazard to a baselined function will not fail
+        the gate — the triage workflow in docs/analysis.md calls this
+        out)."""
+        raw = "|".join((self.pass_name, self.rule, self.path,
+                        self.symbol, self.detail))
+        return hashlib.sha1(raw.encode()).hexdigest()[:12]
+
+    def location(self) -> str:
+        return f"{self.path}:{self.line}"
+
+    def to_json(self) -> dict:
+        return {"pass": self.pass_name, "rule": self.rule,
+                "severity": self.severity, "path": self.path,
+                "line": self.line, "symbol": self.symbol,
+                "message": self.message, "fingerprint": self.fingerprint}
+
+
+@dataclasses.dataclass
+class ModuleInfo:
+    """One parsed module."""
+
+    name: str          # dotted ("mmlspark_tpu.sched.policy")
+    path: str          # absolute
+    rel_path: str      # repo-relative, forward slashes
+    tree: ast.Module
+    source: str
+
+
+class Project:
+    """The parsed package: module table + conveniences shared by passes."""
+
+    def __init__(self, root: str, package: str):
+        self.root = os.path.abspath(root)
+        self.package = package
+        self.modules: dict[str, ModuleInfo] = {}
+        self.skipped: list[tuple[str, str]] = []  # (rel_path, why)
+
+    @classmethod
+    def load(cls, root: str, package: str = "mmlspark_tpu") -> "Project":
+        """Parse every ``.py`` under ``root/package``. Unparseable files
+        are recorded in ``skipped`` (and surfaced as findings by
+        :func:`run_passes`) rather than aborting the whole run."""
+        proj = cls(root, package)
+        pkg_dir = os.path.join(proj.root, *package.split("."))
+        for dirpath, dirnames, filenames in os.walk(pkg_dir):
+            dirnames[:] = sorted(d for d in dirnames
+                                 if d != "__pycache__")
+            for fn in sorted(filenames):
+                if not fn.endswith(".py"):
+                    continue
+                path = os.path.join(dirpath, fn)
+                rel = os.path.relpath(path, proj.root).replace(os.sep, "/")
+                parts = rel[:-3].split("/")
+                if parts[-1] == "__init__":
+                    parts = parts[:-1]
+                name = ".".join(parts)
+                try:
+                    with open(path, encoding="utf-8") as f:
+                        src = f.read()
+                    tree = ast.parse(src, filename=rel)
+                except (SyntaxError, UnicodeDecodeError, OSError) as e:
+                    proj.skipped.append((rel, f"{type(e).__name__}: {e}"))
+                    continue
+                proj.modules[name] = ModuleInfo(
+                    name=name, path=path, rel_path=rel, tree=tree,
+                    source=src)
+        return proj
+
+    def module_for_path(self, rel_path: str) -> ModuleInfo | None:
+        for m in self.modules.values():
+            if m.rel_path == rel_path:
+                return m
+        return None
+
+
+class AnalysisPass:
+    """Base pass: subclass, set ``name``/``description``, implement
+    :meth:`run`."""
+
+    name = "base"
+    description = ""
+
+    def run(self, project: Project) -> list[Finding]:
+        raise NotImplementedError
+
+    def finding(self, rule: str, severity: str, module: ModuleInfo,
+                node: ast.AST | None, symbol: str, message: str,
+                detail: str = "") -> Finding:
+        return Finding(pass_name=self.name, rule=rule, severity=severity,
+                       path=module.rel_path,
+                       line=getattr(node, "lineno", 0) or 0,
+                       symbol=symbol, message=message,
+                       detail=detail or rule)
+
+
+# pass registry: passes append themselves at import (order = report order)
+PASS_REGISTRY: list[type[AnalysisPass]] = []
+
+
+def register_pass(cls: type[AnalysisPass]) -> type[AnalysisPass]:
+    if cls.name in {p.name for p in PASS_REGISTRY}:
+        raise ValueError(f"duplicate pass name {cls.name!r}")
+    PASS_REGISTRY.append(cls)
+    return cls
+
+
+def all_passes() -> list[AnalysisPass]:
+    # imported here (not at module top) so core stays import-cycle-free
+    from . import (trace_safety, recompile, locks, donation,  # noqa: F401
+                   collectives_audit)  # noqa: F401
+    return [cls() for cls in PASS_REGISTRY]
+
+
+def run_passes(project: Project,
+               passes: list[AnalysisPass] | None = None) -> list[Finding]:
+    """Run every (or the given) pass over the project; unparseable files
+    become error findings so a syntax error cannot silently shrink the
+    analyzed surface."""
+    out: list[Finding] = []
+    for rel, why in project.skipped:
+        out.append(Finding(
+            pass_name="project", rule="unparseable", severity="error",
+            path=rel, line=0, symbol="",
+            message=f"file could not be parsed ({why}) — "
+                    f"it is invisible to every pass", detail="unparseable"))
+    for p in (passes if passes is not None else all_passes()):
+        out.extend(p.run(project))
+    order = {s: i for i, s in enumerate(SEVERITIES)}
+    out.sort(key=lambda f: (order[f.severity], f.path, f.line, f.rule))
+    return out

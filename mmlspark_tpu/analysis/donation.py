@@ -1,0 +1,142 @@
+"""Pass 4 — donation/aliasing: donated buffers read after the call, and
+train steps that forget to donate at all.
+
+``donate_argnums`` lets XLA reuse an input buffer for an output — the
+difference between 1× and 2× peak memory for optimizer state. The two
+failure modes:
+
+- ``use-after-donate`` (error): the caller passes a name into a
+  donated position and then reads that name again. JAX marks the buffer
+  deleted; the read raises (or silently sees garbage under some
+  transfer guards). Only provable when the wrap and the call share a
+  scope and the argument is a plain name — exactly the
+  ``state = step(state, batch)`` shape train loops use.
+- ``missing-donation`` (warning): a ``jit``/``pjit`` wrap of a function
+  whose name says it is a train/update step (``*train_step*``,
+  ``*update*``, ``*step_fn*``) with no ``donate_argnums``: the step
+  carries its state twice. The fix is one kwarg; the baseline is for
+  steps that genuinely must keep their input (e.g. trajectory pinning
+  comparisons in tests).
+"""
+
+from __future__ import annotations
+
+import ast
+
+from .callgraph import dotted, graphs_for, resolve
+from .core import AnalysisPass, Finding, ModuleInfo, Project, register_pass
+
+STEP_NAME_HINTS = ("train_step", "update_step", "step_fn", "opt_step")
+
+
+def _donated_nums(call: ast.Call) -> list[int]:
+    for kw in call.keywords:
+        if kw.arg == "donate_argnums":
+            return [n.value for n in ast.walk(kw.value)
+                    if isinstance(n, ast.Constant)
+                    and isinstance(n.value, int)]
+    return []
+
+
+@register_pass
+class DonationPass(AnalysisPass):
+    name = "donation"
+    description = ("donated buffers used after the donating call; "
+                   "train-step wraps with no donate_argnums")
+
+    def run(self, project: Project) -> list[Finding]:
+        graphs = graphs_for(project)
+        out: list[Finding] = []
+        for mod in project.modules.values():
+            g = graphs.of(mod)
+            for fi in g.functions.values():
+                out.extend(self._use_after_donate(g, mod, fi))
+            out.extend(self._missing_donation(g, mod))
+        return out
+
+    # -- use-after-donate ---------------------------------------------------
+    def _use_after_donate(self, g, mod: ModuleInfo, fi) -> list[Finding]:
+        """Within one function body: ``step = jit(f, donate_argnums=…)``
+        …… ``out = step(x, …)`` …… later load of ``x``."""
+        wrapped: dict[str, list[int]] = {}   # local name -> donated nums
+        out: list[Finding] = []
+        #: donated arg name -> (line of donating call, callee name)
+        donated_at: dict[str, tuple[int, str]] = {}
+
+        for node in ast.walk(fi.node):
+            if isinstance(node, ast.Assign) and \
+                    isinstance(node.value, ast.Call):
+                resolved = resolve(dotted(node.value.func), g.imports)
+                if resolved and resolved.rsplit(".", 1)[-1] in \
+                        ("jit", "pjit") and len(node.targets) == 1 and \
+                        isinstance(node.targets[0], ast.Name):
+                    nums = _donated_nums(node.value)
+                    if nums:
+                        wrapped[node.targets[0].id] = nums
+
+        if not wrapped:
+            return out
+        # single linear sweep in line order: calls bind donations, later
+        # Name loads of a donated arg fire. Loops re-binding the name
+        # (state = step(state, …)) clear the donation on the STORE.
+        events: list[tuple[int, str, object]] = []
+        for node in ast.walk(fi.node):
+            if isinstance(node, ast.Call) and \
+                    isinstance(node.func, ast.Name) and \
+                    node.func.id in wrapped:
+                events.append((node.lineno, "call", node))
+            elif isinstance(node, ast.Name):
+                kind = ("load" if isinstance(node.ctx, ast.Load)
+                        else "store")
+                events.append((node.lineno, kind, node))
+        # within one line, execution order is loads → the call → the
+        # store: `state = step(state, b)` rebinds AFTER donating, so
+        # the store must clear the fresh donation, not precede it
+        prio = {"load": 0, "call": 1, "store": 2}
+        events.sort(key=lambda e: (e[0], prio[e[1]]))
+        for line, kind, node in events:
+            if kind == "call":
+                # register at the call's END line so a multi-line call's
+                # own argument loads never read as use-after-donate
+                end = getattr(node, "end_lineno", line) or line
+                for i in wrapped[node.func.id]:
+                    if i < len(node.args) and \
+                            isinstance(node.args[i], ast.Name):
+                        donated_at[node.args[i].id] = (end,
+                                                       node.func.id)
+            elif kind == "store" and node.id in donated_at:
+                del donated_at[node.id]     # rebound: fresh buffer
+            elif kind == "load" and node.id in donated_at:
+                dline, callee = donated_at[node.id]
+                if line > dline:
+                    out.append(self.finding(
+                        "use-after-donate", "error", mod, node,
+                        fi.qualname,
+                        f"{node.id!r} was donated to {callee!r} (line "
+                        f"{dline}) and read again here: the buffer is "
+                        f"deleted after the call",
+                        detail=f"{callee}:{node.id}"))
+                    del donated_at[node.id]  # one finding per donation
+        return out
+
+    # -- missing-donation ---------------------------------------------------
+    def _missing_donation(self, g, mod: ModuleInfo) -> list[Finding]:
+        out = []
+        for q, wraps in sorted(g.traced_entries.items()):
+            base = q.rsplit(".", 1)[-1].lower()
+            if not any(h in base for h in STEP_NAME_HINTS):
+                continue
+            for wrap in wraps:
+                if wrap is None:
+                    continue
+                resolved = resolve(dotted(wrap.func), g.imports) or ""
+                if resolved.rsplit(".", 1)[-1] not in ("jit", "pjit"):
+                    continue
+                if not any(kw.arg == "donate_argnums"
+                           for kw in wrap.keywords):
+                    out.append(self.finding(
+                        "missing-donation", "warning", mod, wrap, q,
+                        f"train step {q!r} is wrapped without "
+                        f"donate_argnums: optimizer/param state is held "
+                        f"twice per step (2x peak memory)", detail=q))
+        return out

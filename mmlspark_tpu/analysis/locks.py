@@ -1,0 +1,341 @@
+"""Pass 3 — lock discipline: mutations of shared state that dodge the
+class's own lock.
+
+The control plane (obs registry, scheduler queues, breaker maps, the
+serving mesh's lease table) is mutated from handler threads, executor
+threads, and monitor threads at once. The convention the codebase
+follows — and this pass turns into a contract — is: *a class that owns
+a lock routes every mutation of its shared attributes through it*.
+
+Two rules:
+
+- ``lock-inconsistent`` (error): an attribute is mutated under
+  ``with self._lock`` in one method and WITHOUT it in another. The
+  guarded sites prove the author considers the attribute shared; the
+  unguarded one is the bug (or needs a written justification).
+- ``lock-unguarded`` (warning): a mutable container attribute
+  (dict/list/set/deque assigned in ``__init__``) of a lock-owning class
+  is mutated from two or more methods, never under any lock. Multiple
+  mutating methods on a lock-owning class almost always means multiple
+  threads (the single-writer case is one method).
+
+What does NOT fire: reads (they are a different, rarer contract);
+``__init__``/``__post_init__`` (the object is not shared yet); methods
+whose every intra-class call site is inside a ``with``-lock block or in
+another such method (transitively) — the ``_locked``-suffix helper
+pattern (``_append_locked``, ``_check_reset_locked``) is recognized
+both by that call-site analysis and by the name suffix itself.
+"""
+
+from __future__ import annotations
+
+import ast
+
+from .callgraph import dotted, graphs_for, resolve
+from .core import AnalysisPass, Finding, ModuleInfo, Project, register_pass
+
+LOCK_FACTORIES = frozenset({"Lock", "RLock", "Condition"})
+MUTATOR_METHODS = frozenset({
+    "append", "appendleft", "extend", "insert", "add", "remove",
+    "discard", "pop", "popitem", "popleft", "clear", "update",
+    "setdefault", "sort", "reverse"})
+CONTAINER_FACTORIES = frozenset({
+    "dict", "list", "set", "deque", "defaultdict", "OrderedDict",
+    "Counter"})
+INIT_METHODS = frozenset({"__init__", "__post_init__", "__new__"})
+
+
+def _lock_factory_name(value: ast.AST) -> bool:
+    if isinstance(value, ast.Call):
+        name = dotted(value.func)
+        if name and name.rsplit(".", 1)[-1] in LOCK_FACTORIES:
+            return True
+        # dataclass field(default_factory=threading.Lock)
+        if name and name.rsplit(".", 1)[-1] == "field":
+            for kw in value.keywords:
+                if kw.arg == "default_factory":
+                    fac = dotted(kw.value)
+                    if fac and fac.rsplit(".", 1)[-1] in LOCK_FACTORIES:
+                        return True
+    return False
+
+
+def _self_attr(node: ast.AST) -> str | None:
+    """``self.X`` (or a subscript/attribute path rooted there) → X."""
+    while isinstance(node, (ast.Subscript, ast.Attribute)):
+        if isinstance(node, ast.Attribute) and \
+                isinstance(node.value, ast.Name) and \
+                node.value.id == "self":
+            return node.attr
+        node = node.value
+    return None
+
+
+class _ClassModel:
+    """Locks, per-method mutations (with held-lock context), and the
+    intra-class held-call graph for one class."""
+
+    def __init__(self, mod: ModuleInfo, cls: ast.ClassDef):
+        self.mod = mod
+        self.cls = cls
+        self.locks: set[str] = set()          # self.<name> lock attrs
+        self.container_attrs: set[str] = set()
+        #: method -> list of (attr, node, frozenset(held_locks), how)
+        self.mutations: dict[str, list] = {}
+        #: method -> {callee_method: set of frozensets of held locks}
+        self.held_calls: dict[str, dict[str, set[frozenset]]] = {}
+        self.methods: dict[str, ast.AST] = {}
+        self._collect()
+
+    def scan(self) -> None:
+        """Scan method bodies. Called AFTER the pass has merged
+        inherited locks in (a subclass of a lock-owning base guards
+        with ``self._lock`` it never declared itself)."""
+        for name, fn in self.methods.items():
+            self._scan_method(name, fn)
+
+    def _collect(self) -> None:
+        for node in self.cls.body:
+            # class-body lock declarations (dataclass fields)
+            if isinstance(node, ast.AnnAssign) and node.value is not None \
+                    and isinstance(node.target, ast.Name):
+                if _lock_factory_name(node.value):
+                    self.locks.add(node.target.id)
+            elif isinstance(node, ast.Assign) and node.value is not None:
+                for t in node.targets:
+                    if isinstance(t, ast.Name) and \
+                            _lock_factory_name(node.value):
+                        self.locks.add(t.id)
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                self.methods[node.name] = node
+        # __init__-time lock + container discovery
+        for m in INIT_METHODS | {"_init_shared_state"}:
+            fn = self.methods.get(m)
+            if fn is None:
+                continue
+            for stmt in ast.walk(fn):
+                if isinstance(stmt, (ast.Assign, ast.AnnAssign)):
+                    targets = stmt.targets if isinstance(
+                        stmt, ast.Assign) else [stmt.target]
+                    value = stmt.value
+                    if value is None:
+                        continue
+                    for t in targets:
+                        attr = _self_attr(t) if isinstance(
+                            t, ast.Attribute) else None
+                        if attr is None:
+                            continue
+                        if _lock_factory_name(value):
+                            self.locks.add(attr)
+                        elif self._container_value(value):
+                            self.container_attrs.add(attr)
+
+    @staticmethod
+    def _container_value(value: ast.AST) -> bool:
+        if isinstance(value, (ast.Dict, ast.List, ast.Set)):
+            return True
+        if isinstance(value, ast.Call):
+            name = dotted(value.func)
+            return bool(name) and \
+                name.rsplit(".", 1)[-1] in CONTAINER_FACTORIES
+        return False
+
+    def _scan_method(self, name: str, fn: ast.AST) -> None:
+        muts: list = []
+        calls: dict[str, set[frozenset]] = {}
+
+        def walk(node, held: frozenset):
+            for child in ast.iter_child_nodes(node):
+                if isinstance(child, (ast.FunctionDef,
+                                      ast.AsyncFunctionDef, ast.Lambda)):
+                    continue  # nested defs execute later, context unknown
+                now_held = held
+                if isinstance(child, ast.With):
+                    acquired = set()
+                    for item in child.items:
+                        attr = _self_attr(item.context_expr)
+                        if attr in self.locks:
+                            acquired.add(attr)
+                    now_held = held | frozenset(acquired)
+                self._record(child, now_held, muts, calls)
+                walk(child, now_held)
+
+        walk(fn, frozenset())
+        self.mutations[name] = muts
+        self.held_calls[name] = calls
+
+    def _record(self, node, held, muts, calls) -> None:
+        if isinstance(node, (ast.Assign, ast.AugAssign, ast.AnnAssign)):
+            targets = (node.targets if isinstance(node, ast.Assign)
+                       else [node.target])
+            flat = []
+            for t in targets:
+                if isinstance(t, (ast.Tuple, ast.List)):
+                    flat.extend(t.elts)
+                else:
+                    flat.append(t)
+            for t in flat:
+                attr = _self_attr(t)
+                if attr is not None and attr not in self.locks:
+                    how = ("augassign" if isinstance(node, ast.AugAssign)
+                           else "assign")
+                    # self.x[k] = v is a container mutation of x
+                    if isinstance(t, ast.Subscript):
+                        how = "setitem"
+                    muts.append((attr, node, held, how))
+        elif isinstance(node, ast.Delete):
+            for t in node.targets:
+                attr = _self_attr(t)
+                if attr is not None:
+                    muts.append((attr, node, held, "del"))
+        elif isinstance(node, ast.Call):
+            f = node.func
+            if isinstance(f, ast.Attribute) and f.attr in MUTATOR_METHODS:
+                attr = _self_attr(f.value)
+                if attr is not None:
+                    muts.append((attr, node, held, f".{f.attr}"))
+            elif isinstance(f, ast.Attribute) and \
+                    isinstance(f.value, ast.Name) and \
+                    f.value.id in ("self", "cls") and \
+                    f.attr in self.methods:
+                calls.setdefault(f.attr, set()).add(held)
+
+    def always_held(self) -> dict[str, frozenset]:
+        """method → set of locks provably held at EVERY intra-class call
+        site (transitively). Methods never called intra-class hold
+        nothing (they are external entry points) — unless their name
+        carries the ``_locked`` convention suffix, which documents the
+        contract explicitly."""
+        held: dict[str, frozenset] = {}
+        for name in self.methods:
+            if name.endswith("_locked"):
+                held[name] = frozenset(self.locks)
+        for _ in range(len(self.methods) + 1):
+            changed = False
+            for name in self.methods:
+                if name in held and held[name] == frozenset(self.locks):
+                    continue
+                sites: list[frozenset] = []
+                for caller, callees in self.held_calls.items():
+                    for callee, heldsets in callees.items():
+                        if callee != name:
+                            continue
+                        for h in heldsets:
+                            sites.append(h | held.get(caller,
+                                                      frozenset()))
+                if not sites:
+                    continue
+                common = frozenset.intersection(*map(frozenset, sites))
+                prev = held.get(name)
+                new = common | (prev or frozenset())
+                if new != prev:
+                    held[name] = new
+                    changed = True
+            if not changed:
+                break
+        return held
+
+
+@register_pass
+class LockDisciplinePass(AnalysisPass):
+    name = "lock-discipline"
+    description = ("mutations of lock-owning classes' shared attributes "
+                   "outside the lock (inconsistent or never-guarded)")
+
+    def run(self, project: Project) -> list[Finding]:
+        graphs = graphs_for(project)
+        # project-wide top-level class models, for inherited-lock
+        # resolution (a DistributedServingServer guards with the
+        # self._lock its ServingServer base created)
+        models: dict[tuple[str, str], _ClassModel] = {}
+        by_name: dict[str, list[tuple[str, str]]] = {}
+        for mod in project.modules.values():
+            for node in mod.tree.body:
+                if isinstance(node, ast.ClassDef):
+                    key = (mod.name, node.name)
+                    models[key] = _ClassModel(mod, node)
+                    by_name.setdefault(node.name, []).append(key)
+
+        def base_keys(key: tuple[str, str]) -> list[tuple[str, str]]:
+            mod_name, _ = key
+            model = models[key]
+            g = graphs.of(model.mod)
+            out = []
+            for base in model.cls.bases:
+                name = resolve(dotted(base), g.imports)
+                if not name:
+                    continue
+                bmod, _, bcls = name.rpartition(".")
+                if (bmod, bcls) in models:
+                    out.append((bmod, bcls))
+                elif len(by_name.get(name.rsplit(".", 1)[-1], [])) == 1:
+                    out.append(by_name[name.rsplit(".", 1)[-1]][0])
+            return out
+
+        def inherited_locks(key, seen=None) -> set[str]:
+            seen = seen or set()
+            if key in seen:
+                return set()
+            seen.add(key)
+            locks = set(models[key].locks)
+            for bk in base_keys(key):
+                locks |= inherited_locks(bk, seen)
+            return locks
+
+        out: list[Finding] = []
+        for key in sorted(models):
+            model = models[key]
+            model.locks = inherited_locks(key)
+            model.scan()
+            out.extend(self._check_class(model))
+        return out
+
+    def _check_class(self, model: "_ClassModel") -> list[Finding]:
+        mod, cls = model.mod, model.cls
+        if not model.locks:
+            return []
+        held_map = model.always_held()
+        # guarded = attrs mutated under a lock in ≥1 non-init method
+        guarded: dict[str, str] = {}
+        for m, muts in model.mutations.items():
+            if m in INIT_METHODS:
+                continue
+            eff = held_map.get(m, frozenset())
+            for attr, node, held, how in muts:
+                locks = held | eff
+                if locks:
+                    guarded.setdefault(attr, sorted(locks)[0])
+        out: list[Finding] = []
+        unguarded_sites: dict[str, list] = {}
+        for m, muts in model.mutations.items():
+            if m in INIT_METHODS or m == "_init_shared_state":
+                continue
+            eff = held_map.get(m, frozenset())
+            for attr, node, held, how in muts:
+                if held or eff:
+                    continue
+                if attr in guarded:
+                    out.append(self.finding(
+                        "lock-inconsistent", "error", mod, node,
+                        f"{cls.name}.{m}",
+                        f"{cls.name}.{attr} is guarded by self."
+                        f"{guarded[attr]} elsewhere but mutated here "
+                        f"({how}) without it",
+                        detail=f"{attr}:{how}"))
+                else:
+                    unguarded_sites.setdefault(attr, []).append(
+                        (m, node, how))
+        for attr, sites in sorted(unguarded_sites.items()):
+            methods = {m for m, _, _ in sites}
+            if attr in model.container_attrs and len(methods) >= 2:
+                m, node, how = sites[0]
+                out.append(self.finding(
+                    "lock-unguarded", "warning", mod, node,
+                    f"{cls.name}.{m}",
+                    f"{cls.name}.{attr} (shared container) is mutated "
+                    f"from {len(methods)} methods "
+                    f"({', '.join(sorted(methods))}) and never under "
+                    f"any of this class's locks "
+                    f"({', '.join(sorted(model.locks))})",
+                    detail=attr))
+        return out

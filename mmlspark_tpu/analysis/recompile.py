@@ -1,0 +1,243 @@
+"""Pass 2 — recompile hazards: code shapes that make XLA re-trace or
+re-compile per call instead of once.
+
+The serving stack exists because compiles at request latency are
+catastrophic (``serving.bucket_pad``'s docstring measures p99 96 ms →
+5 ms once shapes stop being novel). The hazards this pass can prove
+statically:
+
+- ``jit-in-loop`` — a ``jit``/``pjit``/``shard_map`` wrap call inside a
+  ``for``/``while`` body builds a NEW wrapped callable (and cache entry)
+  every iteration; hoist the wrap out of the loop.
+- ``traced-branch`` — Python ``if``/``while`` comparing a traced
+  parameter's *value* inside a wrapped function: every distinct outcome
+  re-traces (or throws ``ConcretizationTypeError`` outright). Static
+  facts — ``x is None``, ``x.shape``/``ndim``/``dtype``, ``len(x)`` —
+  are exempt (they are trace-time constants).
+- ``traced-concretize`` — ``bool()/int()/float()`` applied to a traced
+  parameter expression inside a wrapped function: concretization, the
+  same failure spelled differently.
+- ``unhashable-static`` — ``static_argnums`` pointing at a parameter
+  whose default is a list/dict/set: every call raises (static args are
+  cache keys and must hash).
+
+Parameters that are *obviously* static are skipped: named in
+``static_argnums``/``static_argnames`` at the wrap site, annotated with
+a Python scalar type (``bool``/``int``/``str``), or defaulted to a
+Python constant — branching on those is exactly what static args are
+for.
+"""
+
+from __future__ import annotations
+
+import ast
+
+from .callgraph import (FuncInfo, ModuleGraph, dotted, graphs_for,
+                        resolve)
+from .core import AnalysisPass, Finding, ModuleInfo, Project, register_pass
+
+_STATIC_ANNOTATIONS = {"bool", "int", "str", "float"}
+_STATIC_ATTRS = {"shape", "ndim", "dtype", "size", "sharding"}
+
+
+def _static_params(fi: FuncInfo,
+                   wraps: list[ast.Call | None]) -> set[str]:
+    """Parameter names the wrap sites mark static, plus annotation/
+    default-based obviously-static ones."""
+    static: set[str] = set()
+    pos = fi.positional_params
+    for wrap in wraps:
+        if wrap is None:
+            continue
+        for kw in wrap.keywords:
+            if kw.arg == "static_argnums":
+                for n in ast.walk(kw.value):
+                    if isinstance(n, ast.Constant) and \
+                            isinstance(n.value, int) and \
+                            0 <= n.value < len(pos):
+                        static.add(pos[n.value])
+            elif kw.arg == "static_argnames":
+                for n in ast.walk(kw.value):
+                    if isinstance(n, ast.Constant) and \
+                            isinstance(n.value, str):
+                        static.add(n.value)
+    args = fi.node.args
+    for a in args.posonlyargs + args.args + args.kwonlyargs:
+        ann = dotted(a.annotation) if a.annotation is not None else None
+        if ann and ann.rsplit(".", 1)[-1] in _STATIC_ANNOTATIONS:
+            static.add(a.arg)
+    defaults = args.defaults
+    params_with_defaults = (args.posonlyargs + args.args)[
+        len(args.posonlyargs) + len(args.args) - len(defaults):]
+    for a, d in zip(params_with_defaults, defaults):
+        if isinstance(d, ast.Constant) and not isinstance(d.value,
+                                                          (bytes,)):
+            if isinstance(d.value, (bool, int, str, float, type(None))):
+                static.add(a.arg)
+    for a, d in zip(args.kwonlyargs, args.kw_defaults):
+        if isinstance(d, ast.Constant) and \
+                isinstance(d.value, (bool, int, str, float, type(None))):
+            static.add(a.arg)
+    return static
+
+
+def _param_rooted(expr: ast.AST, params: set[str]) -> str | None:
+    """The parameter name an expression reads through value-land (not
+    through static attributes like ``.shape``). Returns None when the
+    expression cannot reach a traced parameter's values."""
+    for node in ast.walk(expr):
+        if isinstance(node, ast.Attribute) and node.attr in _STATIC_ATTRS:
+            return None  # rooted in a static fact, not values
+        if isinstance(node, ast.Call) and dotted(node.func) == "len":
+            return None  # len(tracer) is its static leading dim
+    for node in ast.walk(expr):
+        if isinstance(node, ast.Name) and node.id in params:
+            return node.id
+    return None
+
+
+class _FnScanner(ast.NodeVisitor):
+    def __init__(self, pass_, mod, fi: FuncInfo, params: set[str]):
+        self.pass_ = pass_
+        self.mod = mod
+        self.fi = fi
+        self.params = params
+        self.findings: list[Finding] = []
+
+    def _flag_test(self, test: ast.AST, kind: str) -> None:
+        # exempt static shapes of test: `x is None`, pure static attrs
+        if isinstance(test, ast.Compare) and \
+                any(isinstance(op, (ast.Is, ast.IsNot))
+                    for op in test.ops):
+            return
+        if not isinstance(test, (ast.Compare, ast.BoolOp, ast.UnaryOp)):
+            return  # bare-name truthiness: usually a static flag — skip
+        p = _param_rooted(test, self.params)
+        if p is not None:
+            self.findings.append(self.pass_.finding(
+                "traced-branch", "error", self.mod, test,
+                self.fi.qualname,
+                f"Python {kind} on traced parameter {p!r} inside "
+                f"{self.fi.qualname!r}: re-traces per outcome (or raises "
+                f"ConcretizationTypeError) — use lax.cond/lax.while_loop "
+                f"or mark the arg static", detail=f"{kind}:{p}"))
+
+    def visit_If(self, node: ast.If) -> None:
+        self._flag_test(node.test, "if")
+        self.generic_visit(node)
+
+    def visit_While(self, node: ast.While) -> None:
+        self._flag_test(node.test, "while")
+        self.generic_visit(node)
+
+    def visit_IfExp(self, node: ast.IfExp) -> None:
+        self._flag_test(node.test, "if")
+        self.generic_visit(node)
+
+    def visit_Call(self, node: ast.Call) -> None:
+        fname = dotted(node.func)
+        if fname in ("bool", "int", "float") and len(node.args) == 1:
+            p = _param_rooted(node.args[0], self.params)
+            if p is not None:
+                self.findings.append(self.pass_.finding(
+                    "traced-concretize", "error", self.mod, node,
+                    self.fi.qualname,
+                    f"{fname}() concretizes traced parameter {p!r} "
+                    f"inside {self.fi.qualname!r}",
+                    detail=f"{fname}:{p}"))
+        self.generic_visit(node)
+
+
+@register_pass
+class RecompilePass(AnalysisPass):
+    name = "recompile-hazard"
+    description = ("jit-in-loop rewraps, Python branches on traced "
+                   "values, concretizing casts, unhashable static args")
+
+    def run(self, project: Project) -> list[Finding]:
+        graphs = graphs_for(project)
+        out: list[Finding] = []
+        for mod in project.modules.values():
+            g = graphs.of(mod)
+            out.extend(self._jit_in_loop(g, mod))
+            for q, wraps in sorted(g.traced_entries.items()):
+                fi = g.functions.get(q)
+                if fi is None:
+                    continue
+                static = _static_params(fi, wraps)
+                params = {p for p in fi.params
+                          if p not in static and p not in ("self", "cls")}
+                sc = _FnScanner(self, mod, fi, params)
+                for stmt in fi.node.body:
+                    sc.visit(stmt)
+                out.extend(sc.findings)
+                out.extend(self._unhashable_static(g, mod, fi, wraps))
+        return out
+
+    def _jit_in_loop(self, g: ModuleGraph, mod: ModuleInfo
+                     ) -> list[Finding]:
+        out = []
+
+        def walk(node, in_loop: bool, symbol: str):
+            for child in ast.iter_child_nodes(node):
+                sym = symbol
+                if isinstance(child, (ast.FunctionDef,
+                                      ast.AsyncFunctionDef)):
+                    # a def resets loop context (the body runs at call
+                    # time, not per enclosing-loop iteration)
+                    walk(child, False, child.name)
+                    continue
+                loop = in_loop or isinstance(child, (ast.For, ast.While))
+                if isinstance(child, ast.Call) and in_loop:
+                    resolved = resolve(dotted(child.func), g.imports)
+                    if resolved and resolved.rsplit(".", 1)[-1] in \
+                            ("jit", "pjit", "shard_map", "pallas_call"):
+                        out.append(self.finding(
+                            "jit-in-loop", "warning", mod, child, sym,
+                            f"{resolved} called inside a loop: builds a "
+                            f"new wrapped callable (and trace-cache "
+                            f"entry) per iteration — hoist the wrap",
+                            detail=resolved))
+                walk(child, loop, sym)
+
+        walk(mod.tree, False, "")
+        return out
+
+    def _unhashable_static(self, g: ModuleGraph, mod: ModuleInfo,
+                           fi: FuncInfo, wraps: list[ast.Call | None]
+                           ) -> list[Finding]:
+        out = []
+        args = fi.node.args
+        defaults = dict(zip(
+            [a.arg for a in (args.posonlyargs + args.args)[
+                len(args.posonlyargs) + len(args.args)
+                - len(args.defaults):]], args.defaults))
+        defaults.update({a.arg: d for a, d in zip(args.kwonlyargs,
+                                                  args.kw_defaults)
+                         if d is not None})
+        pos = fi.positional_params
+        for wrap in wraps:
+            if wrap is None:
+                continue
+            named: list[str] = []
+            for kw in wrap.keywords:
+                if kw.arg == "static_argnums":
+                    named += [pos[n.value] for n in ast.walk(kw.value)
+                              if isinstance(n, ast.Constant)
+                              and isinstance(n.value, int)
+                              and 0 <= n.value < len(pos)]
+                elif kw.arg == "static_argnames":
+                    named += [n.value for n in ast.walk(kw.value)
+                              if isinstance(n, ast.Constant)
+                              and isinstance(n.value, str)]
+            for p in named:
+                d = defaults.get(p)
+                if isinstance(d, (ast.List, ast.Dict, ast.Set)):
+                    out.append(self.finding(
+                        "unhashable-static", "error", mod, wrap,
+                        fi.qualname,
+                        f"static arg {p!r} of {fi.qualname!r} defaults "
+                        f"to an unhashable "
+                        f"{type(d).__name__.lower()} — static args are "
+                        f"cache keys and must hash", detail=p))
+        return out

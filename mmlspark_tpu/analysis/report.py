@@ -1,0 +1,70 @@
+"""Report rendering: human text and machine JSON."""
+
+from __future__ import annotations
+
+import json
+
+from .core import Finding
+
+_SEV_ORDER = {"error": 0, "warning": 1, "info": 2}
+
+
+def summarize(findings: list[Finding]) -> dict:
+    by_pass: dict[str, int] = {}
+    by_sev: dict[str, int] = {}
+    for f in findings:
+        by_pass[f.pass_name] = by_pass.get(f.pass_name, 0) + 1
+        by_sev[f.severity] = by_sev.get(f.severity, 0) + 1
+    return {"total": len(findings), "by_pass": by_pass,
+            "by_severity": by_sev}
+
+
+def render_text(unbaselined: list[Finding], suppressed: list[Finding],
+                stale: list[dict], modules: int) -> str:
+    lines: list[str] = []
+    lines.append(f"graftcheck: {modules} modules analyzed, "
+                 f"{len(unbaselined)} unbaselined finding(s), "
+                 f"{len(suppressed)} baselined, "
+                 f"{len(stale)} stale baseline entr"
+                 f"{'y' if len(stale) == 1 else 'ies'}")
+    current_pass = None
+    for f in sorted(unbaselined,
+                    key=lambda f: (f.pass_name, _SEV_ORDER[f.severity],
+                                   f.path, f.line)):
+        if f.pass_name != current_pass:
+            current_pass = f.pass_name
+            lines.append("")
+            lines.append(f"[{f.pass_name}]")
+        lines.append(f"  {f.severity.upper():7s} {f.location()} "
+                     f"[{f.rule}] ({f.fingerprint})")
+        lines.append(f"          {f.message}")
+    if stale:
+        lines.append("")
+        lines.append("stale baseline entries (fix landed? delete them):")
+        for e in stale:
+            lines.append(f"  {e['fingerprint']} [{e.get('rule', '?')}] "
+                         f"{e.get('path', '?')} :: "
+                         f"{e.get('symbol', '')}")
+    if not unbaselined:
+        lines.append("gate: CLEAN")
+    else:
+        lines.append("")
+        lines.append(
+            "gate: FAIL — fix the findings above, or baseline them WITH "
+            "a justification (--write-baseline, then edit the TODOs; "
+            "see docs/analysis.md)")
+    return "\n".join(lines) + "\n"
+
+
+def render_json(unbaselined: list[Finding], suppressed: list[Finding],
+                stale: list[dict], modules: int) -> str:
+    payload = {
+        "version": 1,
+        "modules_analyzed": modules,
+        "summary": summarize(unbaselined),
+        "findings": [f.to_json() for f in unbaselined],
+        "suppressed": [f.to_json() for f in suppressed],
+        "stale_baseline": stale,
+        "gate": "clean" if not unbaselined else "fail",
+    }
+    return json.dumps(payload, indent=2) + "\n"

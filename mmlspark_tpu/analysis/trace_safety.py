@@ -1,0 +1,374 @@
+"""Pass 1 — trace-safety: host ops inside traced regions, wall-clock in
+deadline paths, and the stage/featurizer traceability report.
+
+A function staged by ``jit``/``pjit``/``shard_map``/``pallas_call``
+executes its Python body **once per trace**, not once per step. A host
+op inside it is therefore one of two bugs waiting to happen:
+
+- a *silent constant*: ``time.time()``, ``random.random()``, an
+  ``np.*`` read of a traced value — evaluated at trace time, frozen
+  into the compiled program, and never updated again;
+- a *tracer leak*: ``.item()`` / ``print`` / file I/O force
+  materialization, which either throws ``ConcretizationTypeError`` or
+  inserts a blocking device→host sync into the hot path.
+
+Lock acquisition in a traced region is its own hazard class: the lock
+is taken at trace time (usually harmless but always meaningless) and
+NOT taken per step — a reader assuming per-step mutual exclusion is
+wrong on both counts.
+
+The same host-op scanner classifies every stage/featurizer as
+``TRACEABLE`` or ``HOST-BOUND`` (``analysis/traceability.json``) — the
+work-list for the ROADMAP's whole-pipeline XLA compilation item: a
+Pipeline can lower featurize → model → postproc into one pjit'd
+computation exactly when every stage on the path is TRACEABLE, and the
+report's per-stage ``reasons`` name what blocks the rest.
+
+Separately (not gated on traced regions), the ``wallclock-deadline``
+rule flags ``time.time()`` anywhere in the control plane (``sched/``,
+``resilience/``, ``serving/``, ``obs/``): deadline, lease, and backoff
+arithmetic must ride ``time.monotonic()`` — an NTP step backwards would
+otherwise un-expire leases or fire every deadline shed at once
+(tests/test_analysis.py carries the clock-step regression test).
+"""
+
+from __future__ import annotations
+
+import ast
+import dataclasses
+
+from .callgraph import ModuleGraph, dotted, graphs_for, resolve
+from .core import AnalysisPass, Finding, ModuleInfo, Project, register_pass
+
+# resolved-call-prefix → (rule, severity, short reason). First match by
+# dotted-prefix wins; "prefix" means exact name or name + ".".
+HOST_CALL_TABLE: tuple[tuple[str, str, str, str], ...] = (
+    ("time.time", "host-time", "error",
+     "host clock read is frozen at trace time"),
+    ("time.monotonic", "host-time", "error",
+     "host clock read is frozen at trace time"),
+    ("time.perf_counter", "host-time", "error",
+     "host clock read is frozen at trace time"),
+    ("time.sleep", "host-time", "error",
+     "sleeps at trace time only; no-op per step"),
+    ("print", "host-print", "warning",
+     "prints the tracer at trace time (use jax.debug.print)"),
+    ("builtins.print", "host-print", "warning",
+     "prints the tracer at trace time (use jax.debug.print)"),
+    ("open", "host-io", "error", "file I/O inside a traced region"),
+    ("input", "host-io", "error", "blocking host input"),
+    ("socket.", "host-io", "error", "socket I/O inside a traced region"),
+    ("http.", "host-io", "error", "HTTP I/O inside a traced region"),
+    ("urllib.", "host-io", "error", "HTTP I/O inside a traced region"),
+    ("requests.", "host-io", "error", "HTTP I/O inside a traced region"),
+    ("subprocess.", "host-io", "error", "subprocess inside a traced region"),
+    ("random.", "host-rng", "warning",
+     "stdlib RNG draws once at trace time (use jax.random)"),
+    ("numpy.asarray", "host-materialize", "warning",
+     "materializes the traced value on host"),
+    ("numpy.array", "host-materialize", "warning",
+     "materializes the traced value on host"),
+    ("np.asarray", "host-materialize", "warning",
+     "materializes the traced value on host"),
+    ("np.array", "host-materialize", "warning",
+     "materializes the traced value on host"),
+    ("jax.device_get", "host-materialize", "warning",
+     "forces a device→host sync inside the traced region"),
+)
+
+# method names that force materialization when called on a traced value
+MATERIALIZE_METHODS = frozenset({"item", "tolist", "to_py"})
+# logger-ish receivers for `.warning(...)`-style calls
+LOG_METHODS = frozenset({"debug", "info", "warning", "error", "exception",
+                         "critical", "log"})
+LOG_RECEIVER_HINTS = ("log", "logger")
+
+# control-plane packages whose deadline/lease arithmetic must never use
+# the wall clock (satellite: the time.time-vs-monotonic bug class)
+WALLCLOCK_PACKAGES = ("sched", "resilience", "serving", "obs")
+
+
+@dataclasses.dataclass
+class HostOp:
+    node: ast.AST
+    rule: str
+    severity: str
+    token: str      # stable detail ("time.time", ".item", "with-lock")
+    reason: str
+
+
+def _lockish_name(name: str | None) -> bool:
+    if not name:
+        return False
+    last = name.rsplit(".", 1)[-1].lower()
+    return "lock" in last or last in ("_cv", "cv", "cond", "condition")
+
+
+def scan_host_ops(graph: ModuleGraph, fn_node: ast.AST,
+                  include_nested: bool = True) -> list[HostOp]:
+    """Host ops lexically inside ``fn_node``. With ``include_nested``,
+    nested defs are scanned too (inside a traced region they are traced
+    helpers — scan bodies, cond branches)."""
+    out: list[HostOp] = []
+
+    def visit(node):
+        for child in ast.iter_child_nodes(node):
+            if isinstance(child, (ast.FunctionDef, ast.AsyncFunctionDef)) \
+                    and not include_nested:
+                continue
+            if isinstance(child, ast.With):
+                for item in child.items:
+                    name = dotted(item.context_expr)
+                    if name is None and isinstance(item.context_expr,
+                                                   ast.Call):
+                        name = dotted(item.context_expr.func)
+                    if _lockish_name(name):
+                        out.append(HostOp(
+                            child, "lock-in-trace", "error",
+                            f"with:{name}",
+                            "lock held at trace time, not per step"))
+            if isinstance(child, ast.Call):
+                _visit_call(child)
+            visit(child)
+
+    def _visit_call(call: ast.Call) -> None:
+        resolved = resolve(dotted(call.func), graph.imports)
+        if resolved:
+            for prefix, rule, sev, reason in HOST_CALL_TABLE:
+                if resolved == prefix or (prefix.endswith(".") and
+                                          resolved.startswith(prefix)) \
+                        or resolved.startswith(prefix + "."):
+                    out.append(HostOp(call, rule, sev, prefix.rstrip("."),
+                                      reason))
+                    return
+        f = call.func
+        if isinstance(f, ast.Attribute):
+            if f.attr in MATERIALIZE_METHODS and not call.args:
+                out.append(HostOp(
+                    call, "host-materialize", "warning", f".{f.attr}",
+                    "materializes the traced value on host"))
+            elif f.attr == "acquire":
+                out.append(HostOp(
+                    call, "lock-in-trace", "error", ".acquire",
+                    "lock held at trace time, not per step"))
+            elif f.attr in LOG_METHODS:
+                recv = dotted(f.value) or ""
+                if any(h in recv.lower() for h in LOG_RECEIVER_HINTS):
+                    out.append(HostOp(
+                        call, "host-log", "warning", f"log.{f.attr}",
+                        "logging executes at trace time only"))
+
+    visit(fn_node)
+    return out
+
+
+def _expand_traced(graph: ModuleGraph) -> dict[str, int]:
+    """Traced entries + call-graph reachability + lexically nested defs
+    of traced functions (a nested def inside a traced body runs at
+    trace time even when handed to scan/cond rather than called)."""
+    dist = graph.traced_functions()
+    changed = True
+    while changed:
+        changed = False
+        for q in list(dist):
+            prefix = q + ".<locals>."
+            for other in graph.functions:
+                if other.startswith(prefix) and other not in dist:
+                    dist[other] = dist[q]
+                    changed = True
+    return dist
+
+
+@register_pass
+class TraceSafetyPass(AnalysisPass):
+    name = "trace-safety"
+    description = ("host ops (clock, I/O, prints, locks, RNG, numpy "
+                   "materialization) reachable from jit/pjit/shard_map/"
+                   "pallas_call wrap sites; wall-clock reads in "
+                   "control-plane deadline paths")
+
+    def run(self, project: Project) -> list[Finding]:
+        graphs = graphs_for(project)
+        out: list[Finding] = []
+        pkg = project.package
+        for mod in project.modules.values():
+            g = graphs.of(mod)
+            traced = _expand_traced(g)
+            seen: set[int] = set()
+            for q, d in sorted(traced.items()):
+                fi = g.functions.get(q)
+                if fi is None:
+                    continue
+                # entry functions scan nested defs; reached helpers
+                # scan only their own statements (their nested defs are
+                # separate entries if also reached)
+                for op in scan_host_ops(g, fi.node,
+                                        include_nested=(d == 0)):
+                    if id(op.node) in seen:
+                        continue
+                    seen.add(id(op.node))
+                    via = "" if d == 0 else f" ({d} calls below the wrap)"
+                    out.append(self.finding(
+                        op.rule, op.severity, mod, op.node, q,
+                        f"{op.token} inside traced region {q!r}{via}: "
+                        f"{op.reason}",
+                        detail=op.token))
+            # wall-clock rule: whole control-plane modules, traced or not
+            rel = mod.name[len(pkg) + 1:] if mod.name.startswith(pkg + ".") \
+                else mod.name
+            if rel.split(".", 1)[0] in WALLCLOCK_PACKAGES:
+                out.extend(self._wallclock(g, mod))
+        return out
+
+    def _wallclock(self, g: ModuleGraph, mod: ModuleInfo) -> list[Finding]:
+        out = []
+        for q, fi in sorted(g.functions.items()):
+            for call in g._own_calls(fi.node):
+                if resolve(dotted(call.func), g.imports) == "time.time":
+                    out.append(self.finding(
+                        "wallclock-deadline", "error", mod, call, q,
+                        "time.time() in a control-plane module: deadline/"
+                        "lease/backoff arithmetic must use time.monotonic()"
+                        " — an NTP step would un-expire leases or fire "
+                        "every shed at once", detail="time.time"))
+        return out
+
+
+# --------------------------------------------------------- traceability
+# stage base classes (mmlspark_tpu.core.pipeline) that mark a class as a
+# registered Stage for the report
+STAGE_BASES = frozenset({"Transformer", "Estimator", "Model",
+                         "PipelineStage"})
+STAGE_METHODS = ("transform", "_transform", "fit", "_fit")
+# classification marker set: anything here makes a stage HOST-BOUND for
+# whole-pipeline compilation purposes. Broader than the traced-region
+# rules: plain numpy compute is fine on host today but blocks lowering
+# the stage into one XLA computation.
+_NUMPY_PREFIXES = ("numpy.", "np.")
+
+
+def _class_index(project: Project) -> dict[str, tuple[ModuleInfo,
+                                                      ast.ClassDef]]:
+    idx: dict[str, tuple[ModuleInfo, ast.ClassDef]] = {}
+    for mod in project.modules.values():
+        if ".stages" not in mod.name and ".featurize" not in mod.name:
+            continue
+        for node in mod.tree.body:
+            if isinstance(node, ast.ClassDef):
+                idx[node.name] = (mod, node)
+    return idx
+
+
+def _is_stage(cls: ast.ClassDef, idx, seen=None) -> bool:
+    seen = seen or set()
+    if cls.name in seen:
+        return False
+    seen.add(cls.name)
+    for base in cls.bases:
+        name = dotted(base)
+        if name is None:
+            continue
+        last = name.rsplit(".", 1)[-1]
+        if last in STAGE_BASES:
+            return True
+        if last in idx and _is_stage(idx[last][1], idx, seen):
+            return True
+    return False
+
+
+def _stage_markers(project: Project, mod: ModuleInfo,
+                   cls: ast.ClassDef, idx) -> tuple[list[str], set[str]]:
+    """→ (host markers blocking traceability, child stage classes this
+    stage instantiates). Scans the stage's transform/fit methods plus
+    same-class and same-module helpers (depth-limited through the call
+    graph), plus inherited methods from in-scope bases. Children matter
+    because a composite stage (TextFeaturizer building Tokenizer →
+    HashingTF → IDF) is only as traceable as the stages it assembles —
+    :func:`build_traceability` propagates their markers in."""
+    graphs = graphs_for(project)
+    markers: set[str] = set()
+    children: set[str] = set()
+    visited: set[tuple[str, str]] = set()
+
+    def scan_method(mmod: ModuleInfo, qual: str, depth: int) -> None:
+        if depth > 3 or (mmod.name, qual) in visited:
+            return
+        visited.add((mmod.name, qual))
+        g = graphs.of(mmod)
+        fi = g.functions.get(qual)
+        if fi is None:
+            return
+        for op in scan_host_ops(g, fi.node):
+            markers.add(f"{op.rule}:{op.token}")
+        for call in g._own_calls(fi.node):
+            resolved = resolve(dotted(call.func), g.imports)
+            if resolved and any(resolved.startswith(p)
+                                for p in _NUMPY_PREFIXES):
+                markers.add(f"host-numpy:{resolved}")
+            last = (resolved or "").rsplit(".", 1)[-1]
+            if last in idx and last != cls.name:
+                children.add(last)
+        for callee in g.calls.get(qual, ()):
+            scan_method(mmod, callee, depth + 1)
+
+    def scan_class(cmod: ModuleInfo, cnode: ast.ClassDef,
+                   depth: int) -> None:
+        for m in STAGE_METHODS:
+            scan_method(cmod, f"{cnode.name}.{m}", depth)
+        for base in cnode.bases:
+            name = dotted(base)
+            last = name.rsplit(".", 1)[-1] if name else ""
+            if last in idx and depth < 3:
+                bmod, bnode = idx[last]
+                scan_class(bmod, bnode, depth + 1)
+
+    scan_class(mod, cls, 0)
+    return sorted(markers), children
+
+
+def build_traceability(project: Project) -> dict:
+    """Classify every registered stage/featurizer class in ``stages/``
+    and ``featurize/`` as TRACEABLE or HOST-BOUND, with reasons — the
+    feeder report for whole-pipeline XLA compilation (ROADMAP)."""
+    idx = _class_index(project)
+    own: dict[str, list[str]] = {}
+    kids: dict[str, set[str]] = {}
+    for name in sorted(idx):
+        mod, cls = idx[name]
+        if not _is_stage(cls, idx):
+            continue
+        own[name], kids[name] = _stage_markers(project, mod, cls, idx)
+    # composite propagation to a fixpoint: a stage that builds other
+    # stages is only as traceable as what it assembles
+    merged = {n: set(m) for n, m in own.items()}
+    changed = True
+    while changed:
+        changed = False
+        for n, children in kids.items():
+            for c in children:
+                if c not in merged:
+                    continue
+                add = {f"via:{c}"} if merged[c] else set()
+                if not add <= merged[n]:
+                    merged[n] |= add
+                    changed = True
+    stages = []
+    for name in sorted(own):
+        mod, _cls = idx[name]
+        markers = sorted(merged[name])
+        stages.append({
+            "stage": name,
+            "module": mod.name,
+            "kind": "featurizer" if ".featurize" in mod.name else "stage",
+            "classification": "HOST-BOUND" if markers else "TRACEABLE",
+            "reasons": markers,
+        })
+    n_traceable = sum(1 for s in stages
+                      if s["classification"] == "TRACEABLE")
+    return {
+        "version": 1,
+        "package": project.package,
+        "summary": {"stages": len(stages), "traceable": n_traceable,
+                    "host_bound": len(stages) - n_traceable},
+        "stages": stages,
+    }

@@ -15,6 +15,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 from jax.sharding import PartitionSpec as P
+from ..parallel import collectives as _coll
 from ..parallel.compat import shard_map as _shard_map
 
 
@@ -167,11 +168,11 @@ def make_sharded_moe(mesh, *, axis: str = "ep",
     def local(params, x, valid):
         # params' expert dims are local shards [E/n, ...]; the router
         # column block is this shard's experts
-        shard = jax.lax.axis_index(axis)
+        shard = _coll.axis_index(axis)
         logits_local = x @ params["router"]           # [T, E/n]
         # global top-1 routing needs all logits: gather over the axis
-        logits = jax.lax.all_gather(logits_local, axis, axis=1,
-                                    tiled=True)       # [T, E]
+        logits = _coll.allgather(logits_local, axis,
+                                 gather_axis=1)       # [T, E]
         E = logits.shape[-1]
         e_per = E // n
         expert = jnp.argmax(logits, axis=-1)          # [T]
@@ -196,7 +197,7 @@ def make_sharded_moe(mesh, *, axis: str = "ep",
                               pos, keep, params["w_in"],
                               params["w_out"], C)
         y = y * gate_top[:, None]
-        out = jax.lax.psum(y, axis)
+        out = _coll.allreduce(y, axis)
         if not return_aux:
             return out
         # every shard holds the FULL gathered logits, so the aux is

@@ -94,6 +94,33 @@ def psum_scatter(x, axis: str, *, scatter_axis: int = 0):
                                     tiled=True)
 
 
+def ppermute(x, axis: str, perm):
+    """Point-to-point shard permutation with an explicit ``(src, dst)``
+    list (ring attention's rotation, the pipeline ring's activation
+    hand-off). Same accounting as every other collective here — raw
+    ``jax.lax.ppermute`` call sites bypass the obs byte series and are
+    flagged by graftcheck's collective-audit pass."""
+    with _observed("ppermute", x, axis):
+        return jax.lax.ppermute(x, axis, perm)
+
+
+def all_to_all(x, axis: str, *, split_axis: int, concat_axis: int,
+               tiled: bool = False):
+    """Shard-count transpose (Ulysses' sequence↔heads exchange)."""
+    with _observed("all_to_all", x, axis):
+        return jax.lax.all_to_all(x, axis, split_axis=split_axis,
+                                  concat_axis=concat_axis, tiled=tiled)
+
+
+def axis_index(axis: str):
+    """This shard's coordinate along a named axis. Moves no real
+    payload; recorded (like :func:`barrier`, as a scalar token) so the
+    calls-total series still shows which programs ask for topology."""
+    z = jnp.zeros((), jnp.int32)
+    with _observed("axis_index", z, axis):
+        return jax.lax.axis_index(axis)
+
+
 def ring_permute(x, axis: str, shift: int = 1):
     """Rotate shards around the ring of a named axis (the building block of
     ring attention / sequence parallelism)."""

@@ -19,6 +19,7 @@ import functools
 import jax
 import jax.numpy as jnp
 from jax.sharding import PartitionSpec as P
+from . import collectives as _coll
 from .compat import shard_map as _shard_map
 
 
@@ -51,7 +52,7 @@ def pipeline_apply(mesh, stage_fn, stacked_params, microbatches,
 
     def body(params_local, xs, aux_xs):
         params_local = jax.tree.map(lambda p: p[0], params_local)
-        stage = jax.lax.axis_index(axis)
+        stage = _coll.axis_index(axis)
         h = jnp.zeros_like(xs[0])
         outs = jnp.zeros_like(xs)
 
@@ -78,13 +79,13 @@ def pipeline_apply(mesh, stage_fn, stacked_params, microbatches,
                 lambda o: o, outs)
             # rotate activations forward around the ring
             perm = [(i, (i + 1) % S) for i in range(S)]
-            h_next = jax.lax.ppermute(h_out, axis, perm)
+            h_next = _coll.ppermute(h_out, axis, perm)
             return (h_next, outs), None
 
         (_, outs), _ = jax.lax.scan(tick, (h, outs), jnp.arange(T))
         # every shard returns its buffer; only the last stage's is real —
         # broadcast it to all shards so the output is replicated
-        last = jax.lax.psum(
+        last = _coll.allreduce(
             outs * (stage == S - 1).astype(outs.dtype), axis)
         return last
 
@@ -145,7 +146,7 @@ def pipeline_train_1f1b(mesh, stage_fn, loss_fn, stacked_params,
 
     def body(params_stacked, xs, ys, aux_xs, extra):
         params_local = jax.tree.map(lambda p: p[0], params_stacked)
-        stage = jax.lax.axis_index(axis)
+        stage = _coll.axis_index(axis)
         h0 = jnp.zeros_like(xs[0])
         ring = jnp.zeros((K,) + xs.shape[1:], xs.dtype)
         gacc = jax.tree.map(jnp.zeros_like, params_local)
@@ -224,9 +225,9 @@ def pipeline_train_1f1b(mesh, stage_fn, loss_fn, stacked_params,
                     (m_safe,) + (0,) * dh.ndim)
 
             # ---- ring transport ------------------------------------
-            h_next = jax.lax.ppermute(
+            h_next = _coll.ppermute(
                 h_out, axis, [(i, (i + 1) % S) for i in range(S)])
-            g_next = jax.lax.ppermute(
+            g_next = _coll.ppermute(
                 g_out, axis, [(i, (i - 1) % S) for i in range(S)])
             return (h_next, g_next, ring, gacc, loss, eacc, dxs), None
 
@@ -235,16 +236,16 @@ def pipeline_train_1f1b(mesh, stage_fn, loss_fn, stacked_params,
             tick, (h0, g0, ring, gacc, loss0, eacc0, dxs0),
             jnp.arange(T))
         # loss lives on the last stage only; grads are per-stage
-        loss = jax.lax.psum(loss, axis) / M
+        loss = _coll.allreduce(loss, axis) / M
         grads = jax.tree.map(lambda g: g[None] / M, gacc)
         outs = []
         if eacc is not None:
             # epilogue grads exist only on the last stage — share them
             outs.append(jax.tree.map(
-                lambda g: jax.lax.psum(
+                lambda g: _coll.allreduce(
                     jnp.where(stage == S - 1, g, 0), axis) / M, eacc))
         if dxs is not None:
-            outs.append(jax.lax.psum(
+            outs.append(_coll.allreduce(
                 jnp.where(stage == 0, dxs, 0), axis) / M)
         return (loss, grads, *outs)
 

@@ -19,6 +19,7 @@ import functools
 
 import jax
 import jax.numpy as jnp
+from . import collectives as _coll
 from .compat import axis_size as _axis_size, \
     shard_map as _shard_map
 
@@ -133,7 +134,7 @@ def ring_attention(q, k, v, *, axis: str = "sp", causal: bool = False,
     masks against true sequence coordinates.
     """
     n = _axis_size(axis)
-    my = jax.lax.axis_index(axis)
+    my = _coll.axis_index(axis)
     B, H, Tl, D = q.shape
     scale = scale if scale is not None else D ** -0.5
 
@@ -172,9 +173,9 @@ def ring_attention(q, k, v, *, axis: str = "sp", causal: bool = False,
                 / denom[..., None]
             lse = m_new + jnp.log(denom)
             perm = [(j, (j + 1) % n) for j in range(n)]
-            kc = jax.lax.ppermute(kc, axis, perm)
-            vc = jax.lax.ppermute(vc, axis, perm)
-            mc = jax.lax.ppermute(mc, axis, perm)
+            kc = _coll.ppermute(kc, axis, perm)
+            vc = _coll.ppermute(vc, axis, perm)
+            mc = _coll.ppermute(mc, axis, perm)
             return o, lse, kc, vc, mc
 
         o0 = jnp.zeros(q.shape, jnp.float32)
@@ -200,9 +201,9 @@ def ring_attention(q, k, v, *, axis: str = "sp", causal: bool = False,
         m, l, acc = _block_update(q, kc, vc, m, l, acc, bias, scale)
         # rotate K/V to the next device; XLA overlaps this with compute
         perm = [(j, (j + 1) % n) for j in range(n)]
-        kc = jax.lax.ppermute(kc, axis, perm)
-        vc = jax.lax.ppermute(vc, axis, perm)
-        mc = jax.lax.ppermute(mc, axis, perm)
+        kc = _coll.ppermute(kc, axis, perm)
+        vc = _coll.ppermute(vc, axis, perm)
+        mc = _coll.ppermute(mc, axis, perm)
         return m, l, acc, kc, vc, mc
 
     m0 = jnp.full((B, H, Tl), -jnp.inf, q.dtype)

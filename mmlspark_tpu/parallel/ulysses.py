@@ -20,6 +20,7 @@ import jax
 import jax.numpy as jnp
 from jax.sharding import Mesh, PartitionSpec as P
 
+from . import collectives as _coll
 from .ring_attention import blockwise_attention
 from .compat import shard_map as _shard_map
 
@@ -69,8 +70,8 @@ def make_ulysses_attention(mesh: Mesh, axis: str = "sp", *,
             # device's sequence chunk lands on device j; received chunks
             # stack in source-device order = sequence order
             x = x.reshape(B, d, h, t, D)
-            x = jax.lax.all_to_all(x, axis, split_axis=1, concat_axis=2,
-                                   tiled=False)     # [B, h, d, t, D]
+            x = _coll.all_to_all(x, axis, split_axis=1,
+                                 concat_axis=2, tiled=False)     # [B, h, d, t, D]
             return x.reshape(B, h, d * t, D)
 
         def heads_to_seq(x):
@@ -78,14 +79,14 @@ def make_ulysses_attention(mesh: Mesh, axis: str = "sp", *,
             # every head-group goes home to device i, head-groups stack
             # in source-device order = head order
             x = x.reshape(B, h, d, t, D)
-            x = jax.lax.all_to_all(x, axis, split_axis=2, concat_axis=1,
-                                   tiled=False)     # [B, d, h, t, D]
+            x = _coll.all_to_all(x, axis, split_axis=2,
+                                 concat_axis=1, tiled=False)     # [B, d, h, t, D]
             return x.reshape(B, d * h, t, D)
 
         qh, kh, vh = seq_to_heads(q), seq_to_heads(k), seq_to_heads(v)
         # every device attends over the full sequence for its head
         # group, so it needs the full key mask
-        full_mask = jax.lax.all_gather(kmask, axis, axis=1, tiled=True)
+        full_mask = _coll.allgather(kmask, axis, gather_axis=1)
         if local_impl == "flash":
             from ..dl.pallas_attention import flash_attention
             out = flash_attention(qh, kh, vh, key_mask=full_mask,

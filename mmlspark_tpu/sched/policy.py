@@ -214,9 +214,8 @@ class AdmissionController:
                            retry_after=predicted - budget)
         self._c_admitted.inc(1, service=self.service, route=route)
         with self._lock:
-            self._inflight[route] = self._inflight.get(route, 0) + 1
-        self._g_inflight.set(self._inflight[route],
-                             service=self.service, route=route)
+            cur = self._inflight[route] = self._inflight.get(route, 0) + 1
+        self._g_inflight.set(cur, service=self.service, route=route)
 
     def release(self, route: str) -> None:
         """A previously admitted request finished (replied, shed after

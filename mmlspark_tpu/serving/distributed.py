@@ -356,8 +356,10 @@ class DistributedServingServer(ServingServer):
 
     def start(self):
         super().start()
-        for info in self.registry.register(self.service_info):
-            self._peers[info.worker_id] = info
+        infos = self.registry.register(self.service_info)
+        with self._lock:
+            for info in infos:
+                self._peers[info.worker_id] = info
         self._monitor.start()
         self._load_reporter.start()
         return self
@@ -393,9 +395,12 @@ class DistributedServingServer(ServingServer):
         _m_mesh_calls.inc(1, service=self.name, endpoint="__reply__")
         _m_mesh_bytes.inc(len(body), service=self.name,
                           endpoint="__reply__", direction="in")
+        # history read and lease drop in ONE critical section: the lease
+        # monitor (its own thread) and handler threads race on _leases —
+        # graftcheck's lock-discipline pass gates this (docs/analysis.md)
         with self._lock:
             cached = self.history.get(d["id"])
-        self._leases.pop(d["id"], None)
+            self._leases.pop(d["id"], None)
         if cached is None:
             return 404, b'{"delivered": false}'
         ok = cached.reply(_resp_from_json(d["response"]))
@@ -429,8 +434,9 @@ class DistributedServingServer(ServingServer):
                 continue
             batch.append(c)
         deadline = time.monotonic() + self.lease_timeout
-        for c in batch:
-            self._leases[c.id] = (deadline, c, lessee)
+        with self._lock:
+            for c in batch:
+                self._leases[c.id] = (deadline, c, lessee)
         out = [{"id": c.id, "request": _req_to_json(c.request)}
                for c in batch]
         payload = json.dumps(out).encode()
@@ -460,9 +466,11 @@ class DistributedServingServer(ServingServer):
                 # and their breakers — worker ids are per-process
                 # identities, so without eviction a mesh with churn
                 # retains a breaker + gauge series per worker forever
-                for gone in set(self._peers) - set(table):
-                    drop_breaker(f"mesh:{self.name}:{gone}")
-                self._peers = table
+                with self._lock:
+                    gone = set(self._peers) - set(table)
+                    self._peers = table
+                for wid in gone:
+                    drop_breaker(f"mesh:{self.name}:{wid}")
             except WorkerKilled:
                 return  # injected death: stop beating, keep the body
             except Exception:
@@ -483,14 +491,18 @@ class DistributedServingServer(ServingServer):
         while not self._stopping.wait(
                 min(self.lease_timeout / 4.0, 0.25)):
             now = time.monotonic()
+            # snapshot under the lock; the registry round trip and the
+            # expiry scan run on the copy (holding _lock across an HTTP
+            # call would stall every handler thread's lease/reply)
+            with self._lock:
+                entries = list(self._leases.items())
             # the registry round trip is only worth taking when an
             # identified lessee actually holds a lease — an idle ingest
             # must not generate 4 control-plane requests per second
             live = self._live_lessees() if any(
-                len(e) > 2 and e[2]
-                for e in list(self._leases.values())) else None
+                len(e) > 2 and e[2] for _, e in entries) else None
             expired = []
-            for i, entry in list(self._leases.items()):
+            for i, entry in entries:
                 lessee = entry[2] if len(entry) > 2 else None
                 if entry[0] < now:
                     expired.append(i)
@@ -512,13 +524,19 @@ class DistributedServingServer(ServingServer):
             self.epoch += 1  # a worker died mid-lease: new replay wave
             _LOG.warning("service %s: %d leases expired, replaying at "
                          "epoch %d", self.name, len(expired), self.epoch)
-            for i in expired:
-                # a reply may land concurrently and pop the lease first —
-                # that request is answered, nothing to replay
-                entry = self._leases.pop(i, None)
-                if entry is not None and not entry[1]._event.is_set():
-                    _m_lease_replays.inc(1, service=self.name)
-                    self.replay(entry[1])
+            to_replay = []
+            with self._lock:
+                for i in expired:
+                    # a reply may land concurrently and pop the lease
+                    # first — that request is answered, nothing to replay
+                    entry = self._leases.pop(i, None)
+                    if entry is not None and not entry[1]._event.is_set():
+                        to_replay.append(entry[1])
+            # replays re-enter the scheduler (its own condition variable)
+            # outside _lock: lock order stays one-directional
+            for cached in to_replay:
+                _m_lease_replays.inc(1, service=self.name)
+                self.replay(cached)
 
     # -- cross-worker reply routing ----------------------------------------
     def reply_to(self, request_id: str, response: HTTPResponseData) -> bool:
@@ -528,13 +546,18 @@ class DistributedServingServer(ServingServer):
         if owner == self.worker_id:
             with self._lock:
                 cached = self.history.get(request_id)
-            self._leases.pop(request_id, None)
+                self._leases.pop(request_id, None)
             return cached is not None and cached.reply(response)
-        info = self._peers.get(owner)
-        if info is None:
-            for i in self.registry.workers(self.name):
-                self._peers[i.worker_id] = i
+        with self._lock:
             info = self._peers.get(owner)
+        if info is None:
+            # registry refresh happens OUTSIDE the lock (HTTP round
+            # trip); only the table merge is a critical section
+            fresh = {i.worker_id: i for i in
+                     self.registry.workers(self.name)}
+            with self._lock:
+                self._peers.update(fresh)
+                info = self._peers.get(owner)
         if info is None:
             return False
         # per-peer breaker (resilience subsystem): a dead owner fails

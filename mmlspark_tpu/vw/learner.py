@@ -26,6 +26,7 @@ from typing import Callable
 import jax
 import jax.numpy as jnp
 import numpy as np
+from ..parallel import collectives as _coll
 from ..parallel.compat import shard_map as _shard_map
 
 
@@ -208,7 +209,7 @@ def train(indices: np.ndarray, values: np.ndarray, labels: np.ndarray,
         def local_pass(w, bias, g2, g2b, t, batch_arrays):
             w, bias, g2, g2b, t = run_pass(w, bias, g2, g2b, t,
                                            batch_arrays)
-            mean = lambda v: jax.lax.pmean(v, mesh_axis)
+            mean = lambda v: _coll.allreduce(v, mesh_axis, op="mean")
             return mean(w), mean(bias), mean(g2), mean(g2b), mean(t)
 
         rep = P()

@@ -1,0 +1,611 @@
+"""graftcheck (mmlspark_tpu/analysis): per-pass known-bad fixtures must
+flag, a curated known-good corpus must stay silent, the analyzer must
+run with no JAX import, the repo itself must gate clean against the
+committed baseline — and the wall-clock regression tests prove the
+deadline paths the trace-safety pass guards really are step-immune.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import subprocess
+import sys
+import textwrap
+import time
+
+import pytest
+
+from mmlspark_tpu.analysis import Project, run_passes
+from mmlspark_tpu.analysis import baseline as baseline_mod
+from mmlspark_tpu.analysis.collectives_audit import CollectiveAuditPass
+from mmlspark_tpu.analysis.donation import DonationPass
+from mmlspark_tpu.analysis.locks import LockDisciplinePass
+from mmlspark_tpu.analysis.recompile import RecompilePass
+from mmlspark_tpu.analysis.trace_safety import (TraceSafetyPass,
+                                                build_traceability)
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def make_project(tmp_path, files: dict[str, str]) -> Project:
+    """Write ``files`` (relative paths inside a fixture package) and
+    parse them. ``{"sched/mod.py": ...}`` lands as
+    ``fixturepkg.sched.mod``."""
+    pkg = tmp_path / "fixturepkg"
+    pkg.mkdir(exist_ok=True)
+    (pkg / "__init__.py").write_text("")
+    for rel, src in files.items():
+        p = pkg / rel
+        p.parent.mkdir(parents=True, exist_ok=True)
+        if p.parent != pkg and not (p.parent / "__init__.py").exists():
+            (p.parent / "__init__.py").write_text("")
+        p.write_text(textwrap.dedent(src))
+    return Project.load(str(tmp_path), "fixturepkg")
+
+
+def rules_of(findings):
+    return {f.rule for f in findings}
+
+
+# ---------------------------------------------------------- trace-safety
+class TestTraceSafety:
+    def test_host_ops_in_jitted_fn_flagged(self, tmp_path):
+        proj = make_project(tmp_path, {"mod.py": """
+            import time
+            import jax
+
+            def step(x):
+                t = time.time()
+                print(x)
+                return x * t
+
+            step = jax.jit(step)
+        """})
+        fs = TraceSafetyPass().run(proj)
+        assert "host-time" in rules_of(fs)
+        assert "host-print" in rules_of(fs)
+        sevs = {f.rule: f.severity for f in fs}
+        assert sevs["host-time"] == "error"
+
+    def test_reachability_through_helper(self, tmp_path):
+        proj = make_project(tmp_path, {"mod.py": """
+            import time
+            import jax
+
+            def helper(x):
+                time.sleep(0.1)
+                return x
+
+            @jax.jit
+            def entry(x):
+                return helper(x)
+        """})
+        fs = TraceSafetyPass().run(proj)
+        assert any(f.rule == "host-time" and "helper" in f.symbol
+                   for f in fs)
+
+    def test_lock_and_materialize_in_shard_map(self, tmp_path):
+        proj = make_project(tmp_path, {"mod.py": """
+            import jax
+            from jax.experimental.shard_map import shard_map
+
+            class Runner:
+                def local(self, x):
+                    with self._lock:
+                        y = x.item()
+                    return y
+
+                def build(self, mesh):
+                    return shard_map(self.local, mesh=mesh)
+        """})
+        fs = TraceSafetyPass().run(proj)
+        assert "lock-in-trace" in rules_of(fs)
+        assert "host-materialize" in rules_of(fs)
+
+    def test_wallclock_in_sched_package(self, tmp_path):
+        proj = make_project(tmp_path, {"sched/mod.py": """
+            import time
+
+            def deadline_for(budget):
+                return time.time() + budget
+        """})
+        fs = TraceSafetyPass().run(proj)
+        assert any(f.rule == "wallclock-deadline" and
+                   f.severity == "error" for f in fs)
+
+    def test_monotonic_in_sched_package_silent(self, tmp_path):
+        proj = make_project(tmp_path, {"sched/mod.py": """
+            import time
+
+            def deadline_for(budget):
+                return time.monotonic() + budget
+        """})
+        assert TraceSafetyPass().run(proj) == []
+
+
+# ------------------------------------------------------ recompile-hazard
+class TestRecompile:
+    def test_traced_branch(self, tmp_path):
+        proj = make_project(tmp_path, {"mod.py": """
+            import jax
+
+            @jax.jit
+            def f(x):
+                if x > 0:
+                    return x
+                return -x
+        """})
+        fs = RecompilePass().run(proj)
+        assert "traced-branch" in rules_of(fs)
+
+    def test_static_facts_not_flagged(self, tmp_path):
+        proj = make_project(tmp_path, {"mod.py": """
+            import jax
+
+            @jax.jit
+            def f(x, training: bool = False, mask=None):
+                if mask is None:
+                    mask = x
+                if x.shape[0] > 4:
+                    x = x[:4]
+                if len(x) > 2:
+                    x = x + 1
+                if training:
+                    x = x * 2
+                return x
+        """})
+        assert RecompilePass().run(proj) == []
+
+    def test_static_argnums_branch_ok(self, tmp_path):
+        proj = make_project(tmp_path, {"mod.py": """
+            import jax
+
+            def f(x, n):
+                if n > 3:
+                    return x * n
+                return x
+
+            g = jax.jit(f, static_argnums=(1,))
+        """})
+        assert RecompilePass().run(proj) == []
+
+    def test_jit_in_loop(self, tmp_path):
+        proj = make_project(tmp_path, {"mod.py": """
+            import jax
+
+            def sweep(fns, x):
+                outs = []
+                for fn in fns:
+                    outs.append(jax.jit(fn)(x))
+                return outs
+        """})
+        fs = RecompilePass().run(proj)
+        assert "jit-in-loop" in rules_of(fs)
+
+    def test_concretize_and_unhashable_static(self, tmp_path):
+        proj = make_project(tmp_path, {"mod.py": """
+            import jax
+
+            @jax.jit
+            def f(x):
+                return float(x + 1)
+
+            def h(x, opts=[1, 2]):
+                return x
+
+            h2 = jax.jit(h, static_argnums=(1,))
+        """})
+        fs = RecompilePass().run(proj)
+        assert "traced-concretize" in rules_of(fs)
+        assert "unhashable-static" in rules_of(fs)
+
+
+# ------------------------------------------------------- lock-discipline
+LOCKED_CLASS = """
+    import threading
+
+    class Box:
+        def __init__(self):
+            self._lock = threading.Lock()
+            self._items = {}
+
+        def put(self, k, v):
+            with self._lock:
+                self._items[k] = v
+
+        def drop(self, k):
+            self._items.pop(k, None)
+"""
+
+
+class TestLockDiscipline:
+    def test_inconsistent_mutation(self, tmp_path):
+        proj = make_project(tmp_path, {"mod.py": LOCKED_CLASS})
+        fs = LockDisciplinePass().run(proj)
+        assert any(f.rule == "lock-inconsistent" and "drop" in f.symbol
+                   for f in fs)
+
+    def test_never_guarded_container(self, tmp_path):
+        proj = make_project(tmp_path, {"mod.py": """
+            import threading
+
+            class Box:
+                def __init__(self):
+                    self._lock = threading.Lock()
+                    self._leases = {}
+
+                def add(self, k, v):
+                    self._leases[k] = v
+
+                def expire(self, k):
+                    self._leases.pop(k, None)
+        """})
+        fs = LockDisciplinePass().run(proj)
+        assert "lock-unguarded" in rules_of(fs)
+
+    def test_inherited_lock_seen(self, tmp_path):
+        proj = make_project(tmp_path, {
+            "base.py": """
+                import threading
+
+                class Base:
+                    def __init__(self):
+                        self._lock = threading.Lock()
+            """,
+            "sub.py": """
+                from .base import Base
+
+                class Sub(Base):
+                    def __init__(self):
+                        super().__init__()
+                        self._table = {}
+
+                    def learn(self, k, v):
+                        self._table[k] = v
+
+                    def forget(self, k):
+                        self._table.pop(k, None)
+            """})
+        fs = LockDisciplinePass().run(proj)
+        assert any(f.rule == "lock-unguarded" and "Sub" in f.symbol
+                   for f in fs)
+
+    def test_locked_helper_convention_silent(self, tmp_path):
+        proj = make_project(tmp_path, {"mod.py": """
+            import threading
+
+            class Queue:
+                def __init__(self):
+                    self._cv = threading.Condition()
+                    self._items = []
+
+                def put(self, item):
+                    with self._cv:
+                        self._append_locked(item)
+
+                def take(self):
+                    with self._cv:
+                        return self._pop_locked()
+
+                def _append_locked(self, item):
+                    self._items.append(item)
+
+                def _pop_locked(self):
+                    return self._items.pop()
+        """})
+        assert LockDisciplinePass().run(proj) == []
+
+
+# -------------------------------------------------------------- donation
+class TestDonation:
+    def test_use_after_donate(self, tmp_path):
+        proj = make_project(tmp_path, {"mod.py": """
+            import jax
+
+            def update(state, batch):
+                return state
+
+            def train(state, batch):
+                step = jax.jit(update, donate_argnums=(0,))
+                new = step(state, batch)
+                check = state
+                return new, check
+        """})
+        fs = DonationPass().run(proj)
+        assert "use-after-donate" in rules_of(fs)
+
+    def test_rebinding_clears_donation(self, tmp_path):
+        proj = make_project(tmp_path, {"mod.py": """
+            import jax
+
+            def update(state, batch):
+                return state
+
+            def train(state, batches):
+                step = jax.jit(update, donate_argnums=(0,))
+                for b in batches:
+                    state = step(state, b)
+                return state
+        """})
+        assert DonationPass().run(proj) == []
+
+    def test_missing_donation_on_train_step(self, tmp_path):
+        proj = make_project(tmp_path, {"mod.py": """
+            import jax
+
+            def make(loss_fn):
+                def train_step(state, batch):
+                    return state
+                return jax.jit(train_step)
+        """})
+        fs = DonationPass().run(proj)
+        assert "missing-donation" in rules_of(fs)
+
+    def test_donating_train_step_silent(self, tmp_path):
+        proj = make_project(tmp_path, {"mod.py": """
+            import jax
+
+            def make(loss_fn):
+                def train_step(state, batch):
+                    return state
+                return jax.jit(train_step, donate_argnums=(0,))
+        """})
+        assert DonationPass().run(proj) == []
+
+
+# ------------------------------------------------------ collective-audit
+class TestCollectiveAudit:
+    def test_raw_collective_flagged(self, tmp_path):
+        proj = make_project(tmp_path, {"mod.py": """
+            import jax
+
+            def allsum(x, axis):
+                return jax.lax.psum(x, axis)
+        """})
+        fs = CollectiveAuditPass().run(proj)
+        assert "raw-collective" in rules_of(fs)
+
+    def test_unbound_axis(self, tmp_path):
+        proj = make_project(tmp_path, {"mod.py": """
+            import jax
+            from jax.sharding import PartitionSpec as P
+
+            SPEC = P("dp")
+
+            def reduce(x):
+                return jax.lax.psum(x, "tp")
+        """})
+        fs = CollectiveAuditPass().run(proj)
+        assert any(f.rule == "unbound-axis" and "'tp'" in f.message
+                   for f in fs)
+
+    def test_declared_axis_silent(self, tmp_path):
+        proj = make_project(tmp_path, {"mod.py": """
+            import jax
+            from jax.sharding import PartitionSpec as P
+
+            SPEC = P("dp")
+
+            def reduce(x):
+                return jax.lax.psum(x, "dp")
+        """})
+        fs = CollectiveAuditPass().run(proj)
+        assert "unbound-axis" not in rules_of(fs)
+
+
+# ---------------------------------------------------- known-good corpus
+# idiomatic code in every hazard family the passes cover — NONE of it
+# may produce a finding (the zero-false-positive contract)
+GOOD_CORPUS = {
+    "compute.py": """
+        import jax
+        import jax.numpy as jnp
+
+        @jax.jit
+        def step(x, scale: float = 1.0):
+            # static branch (annotation/default), shape facts, is-None
+            if x.shape[-1] > 128:
+                x = x[..., :128]
+            y = jnp.where(x > 0, x, -x)      # traced select, not a branch
+            return y * scale
+
+        def make_train_step(loss_fn):
+            def train_step(state, batch):
+                return jax.tree.map(lambda p: p - 1e-3, state)
+            return jax.jit(train_step, donate_argnums=(0,))
+
+        def loop(state, batches):
+            step_fn = make_train_step(None)
+            for b in batches:
+                state = step_fn(state, b)
+            return state
+    """,
+    "plumbing.py": """
+        import threading
+        import time
+
+        class Registry:
+            def __init__(self):
+                self._lock = threading.Lock()
+                self._table = {}
+                self.started_at = time.monotonic()
+
+            def put(self, k, v):
+                with self._lock:
+                    self._table[k] = v
+
+            def drop(self, k):
+                with self._lock:
+                    self._table.pop(k, None)
+
+            def snapshot(self):
+                with self._lock:
+                    return dict(self._table)
+    """,
+    "host_side.py": """
+        import time
+        import numpy as np
+
+        def bench(fn, x):
+            # host code may use clocks/numpy freely: nothing here is
+            # wrapped, so the trace-safety pass must stay out
+            t0 = time.perf_counter()
+            out = np.asarray(fn(x))
+            return out, time.perf_counter() - t0
+    """,
+}
+
+
+class TestKnownGoodCorpus:
+    def test_corpus_is_silent(self, tmp_path):
+        proj = make_project(tmp_path, GOOD_CORPUS)
+        findings = run_passes(proj)
+        gating = [f for f in findings if f.severity != "info"]
+        assert gating == [], [f.to_json() for f in gating]
+
+
+# --------------------------------------------------- baseline + gating
+class TestBaseline:
+    def test_baseline_requires_justification(self, tmp_path):
+        path = tmp_path / "baseline.json"
+        path.write_text(json.dumps({"findings": [
+            {"fingerprint": "abc", "justification": ""}]}))
+        with pytest.raises(baseline_mod.BaselineError):
+            baseline_mod.load(str(path))
+        path.write_text(json.dumps({"findings": [
+            {"fingerprint": "abc",
+             "justification": "TODO: fill me in"}]}))
+        with pytest.raises(baseline_mod.BaselineError):
+            baseline_mod.load(str(path))
+
+    def test_apply_splits_and_reports_stale(self, tmp_path):
+        proj = make_project(tmp_path, {"mod.py": LOCKED_CLASS})
+        findings = LockDisciplinePass().run(proj)
+        assert findings
+        fp = findings[0].fingerprint
+        base = {fp: {"fingerprint": fp, "justification": "reviewed"},
+                "dead": {"fingerprint": "dead",
+                         "justification": "old"}}
+        unb, supp, stale = baseline_mod.apply(findings, base)
+        assert supp and not unb
+        assert [e["fingerprint"] for e in stale] == ["dead"]
+
+    def test_repo_gates_clean_with_committed_baseline(self):
+        """THE acceptance check: graftcheck over mmlspark_tpu with the
+        committed baseline reports zero unbaselined findings, and every
+        baseline entry is live (no stale) and justified."""
+        proj = Project.load(REPO, "mmlspark_tpu")
+        findings = run_passes(proj)
+        base = baseline_mod.load(os.path.join(
+            REPO, "mmlspark_tpu", "analysis", "baseline.json"))
+        unb, _supp, stale = baseline_mod.apply(findings, base)
+        assert unb == [], [f.to_json() for f in unb]
+        assert stale == [], stale
+
+    def test_traceability_covers_every_stage(self):
+        proj = Project.load(REPO, "mmlspark_tpu")
+        tr = build_traceability(proj)
+        assert tr["summary"]["stages"] > 40
+        for s in tr["stages"]:
+            assert s["classification"] in ("TRACEABLE", "HOST-BOUND")
+            if s["classification"] == "HOST-BOUND":
+                assert s["reasons"], s  # reasons name what blocks it
+        # the committed report matches the current code EXACTLY —
+        # classifications and reasons included, not just the stage set
+        # (a stage silently flipping TRACEABLE→HOST-BOUND must fail CI:
+        # the report is the pipeline-compilation work-list)
+        with open(os.path.join(REPO, "mmlspark_tpu", "analysis",
+                               "traceability.json")) as f:
+            committed = json.load(f)
+        assert committed["stages"] == tr["stages"]
+        assert committed["summary"] == tr["summary"]
+
+    def test_fingerprints_survive_line_drift(self, tmp_path):
+        proj1 = make_project(tmp_path, {"mod.py": LOCKED_CLASS})
+        f1 = LockDisciplinePass().run(proj1)[0].fingerprint
+        shifted = "# a comment\n# another\n" + textwrap.dedent(
+            LOCKED_CLASS)
+        (tmp_path / "fixturepkg" / "mod.py").write_text(shifted)
+        proj2 = Project.load(str(tmp_path), "fixturepkg")
+        f2 = LockDisciplinePass().run(proj2)[0].fingerprint
+        assert f1 == f2
+
+
+# ----------------------------------------------------------- no-JAX CLI
+class TestNoJax:
+    def test_analysis_runs_without_jax(self):
+        """The analyzer imports and the full CLI gate runs with JAX
+        never imported (pure ast — usable on machines with no JAX)."""
+        code = (
+            "import sys\n"
+            "import mmlspark_tpu.analysis as a\n"
+            "assert 'jax' not in sys.modules, 'import pulled in jax'\n"
+            "from mmlspark_tpu.analysis.__main__ import main\n"
+            f"rc = main(['--root', {REPO!r}, '--quiet'])\n"
+            "assert rc == 0, f'gate not clean: {rc}'\n"
+            "assert 'jax' not in sys.modules, 'analysis pulled in jax'\n"
+            "print('OK')\n")
+        out = subprocess.run([sys.executable, "-c", code], cwd=REPO,
+                             capture_output=True, text=True, timeout=120)
+        assert out.returncode == 0, out.stdout + out.stderr
+        assert "OK" in out.stdout
+
+
+# ------------------------------------- wall-clock step regression tests
+class TestClockStepRegression:
+    """The bug class the wallclock-deadline rule guards: deadline/lease
+    arithmetic must ride time.monotonic(), so stepping the WALL clock
+    (NTP correction) in either direction must not shed, expire, or
+    give up anything."""
+
+    def _submitted_item(self, sched):
+        class Item:
+            pass
+        sched.submit(Item(), deadline=30.0)
+
+    def test_scheduler_survives_wall_clock_steps(self, monkeypatch):
+        from mmlspark_tpu.sched import RequestScheduler
+
+        wall = [1e9]
+        monkeypatch.setattr(time, "time", lambda: wall[0])
+        sched = RequestScheduler("clockstep-fwd")
+        self._submitted_item(sched)
+        wall[0] += 3600          # NTP jumps an hour forward...
+        batch = sched.next_batch(max_batch=4, max_wait=0.2)
+        assert len(batch) == 1   # ...the 30s deadline did NOT expire
+        self._submitted_item(sched)
+        wall[0] -= 7200          # ...and an hour back
+        batch = sched.next_batch(max_batch=4, max_wait=0.2)
+        assert len(batch) == 1
+        shed = sched.admission._c_shed
+        assert shed.value(service="clockstep-fwd", route="/",
+                          reason="expired") == 0
+
+    def test_retry_budget_survives_wall_clock_steps(self, monkeypatch):
+        from mmlspark_tpu.resilience import RetryPolicy
+
+        wall = [1e9]
+        monkeypatch.setattr(time, "time", lambda: wall[0])
+        policy = RetryPolicy(seed=0, max_attempts=4,
+                             sleep=lambda s: None)
+        call = policy.start(deadline=60.0, op="clockstep")
+        wall[0] += 3600
+        # a forward wall step must not eat the 60s budget
+        assert call.backoff(status=503)
+        assert call.remaining() > 50.0
+        wall[0] -= 7200
+        assert call.backoff(status=503)
+        assert call.give_up_cause is None
+
+    def test_breaker_reset_timer_survives_wall_steps(self, monkeypatch):
+        from mmlspark_tpu.resilience import CircuitBreaker
+
+        wall = [1e9]
+        monkeypatch.setattr(time, "time", lambda: wall[0])
+        b = CircuitBreaker("clockstep-ep", min_calls=1, window=4,
+                           reset_timeout=30.0)
+        b.record_failure()
+        assert b.state == "open"
+        wall[0] += 3600   # a wall jump must NOT half-open the breaker
+        assert b.state == "open" and not b.allow()
