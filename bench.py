@@ -1189,6 +1189,33 @@ def bench_vw(extras: dict) -> None:
         n_rows / (time.perf_counter() - t0), 1)
 
 
+def bench_observability(extras: dict) -> None:
+    """Tracing/profiler overhead guard (ISSUE 8): the synthetic serving
+    pipeline's p99 with the full tracing+profiler stack ON must stay
+    within 5% of OFF, and the seeded chaos run must yield complete
+    cross-process span trees. Banks the measured overhead so the bench
+    JSON records what continuous observability actually costs."""
+    from mmlspark_tpu.testing.benchmarks import (chaos_scenario,
+                                                 tracing_overhead_scenario)
+
+    r = tracing_overhead_scenario()
+    extras["tracing_p99_off_ms"] = round(r["p99_off_s"] * 1e3, 3)
+    extras["tracing_p99_on_ms"] = round(r["p99_on_s"] * 1e3, 3)
+    extras["tracing_overhead_pct"] = round(r["overhead_pct"], 2)
+    extras["tracing_overhead_within_5pct"] = bool(r["within_bound"])
+    extras["tracing_feature_records"] = int(r["feature_records"])
+
+    # the chaos trace acceptance, bench-side: every answered request's
+    # cross-process tree is complete (driver queue + worker execute +
+    # device under one trace id)
+    c = chaos_scenario(seed=11, n_requests=24, n_workers=3)
+    extras["tracing_chaos_answered"] = int(c["answered_200"])
+    extras["tracing_chaos_complete_traces"] = int(c["complete_traces"])
+    if c["sampled_trace"] is not None:
+        extras["tracing_chaos_sampled_trace"] = \
+            c["sampled_trace"]["trace_id"]
+
+
 def bench_serving(extras: dict) -> None:
     """End-to-end HTTP request→jitted pipeline→response latency against
     the reference's ~1 ms continuous-mode figure."""
@@ -1366,6 +1393,12 @@ def bench_serving(extras: dict) -> None:
                 extras[f"{prefix}{suffix}_loaded_p99_ms"] = round(
                     r["loaded_p99_ms"], 3)
                 extras[f"{prefix}{suffix}_load_client"] = "native"
+                if r.get("slowest"):
+                    # flight-recorder lookup keys for the loaded tail:
+                    # these trace ids resolve at GET /debug/trace on
+                    # the server under test (ISSUE 8)
+                    extras[f"{prefix}{suffix}_p99_slowest_traces"] = \
+                        [s["trace_id"] for s in r["slowest"][:4]]
                 return
             except Exception:
                 # record WHY before falling back — a server failing
@@ -1769,6 +1802,10 @@ def main():
             # scrubbed-subprocess bench: immune to a wedged tunnel, so
             # it can run even late in the suite
             _watchdog(bench_multichip, extras, "multichip", 600.0)
+        if want("observability"):
+            # pure host-side (scheduler + in-thread mesh): tunnel-immune
+            _watchdog(bench_observability, extras, "observability",
+                      240.0)
         if want("serving"):
             # includes a small GBDT fit for the real-model row
             _watchdog(bench_serving, extras, "serving", 360.0)

@@ -43,7 +43,7 @@ PACKAGES: dict[str, list[str]] = {
     "io": ["test_native_codegen.py", "test_benchmarks.py",
            "test_reference_parity.py", "test_out_of_core.py",
            "test_ci.py", "test_bench_banking.py", "test_rcheck.py"],
-    "obs": ["test_obs.py"],
+    "obs": ["test_obs.py", "test_obs_profile.py"],
     "analysis": ["test_analysis.py"],  # graftcheck passes + gate + clock
     "sched": ["test_sched.py"],  # admission/batching policy + scheduler
     "resilience": ["test_resilience.py"],  # retry/breaker/faults/chaos
@@ -66,10 +66,25 @@ def style() -> int:
     # obs must import cleanly with no backend and no JAX import at all
     # (serving fronts scrape it from handler threads before/without any
     # device init; a JAX import sneaking in would drag backend setup
-    # into every importer)
-    smoke = ("import sys; from mmlspark_tpu.obs import registry, tracer; "
-             "assert 'jax' not in sys.modules, 'obs import pulled in jax'; "
-             "print('obs import OK (no jax)')")
+    # into every importer). The tracing data plane rides along: the
+    # propagation/export/profile surfaces must inject+extract a
+    # traceparent, retain a trace in the flight recorder, and render
+    # Chrome-trace JSON — all with no JAX in the process.
+    smoke = (
+        "import sys; "
+        "from mmlspark_tpu.obs import (registry, tracer, inject, "
+        "extract, flight_recorder, compile_tracker, step_profiler, "
+        "feature_log, chrome_trace); "
+        "assert 'jax' not in sys.modules, 'obs import pulled in jax'; "
+        "exec('with tracer.span(\"ci\") as sp:\\n    h = inject({}, sp)'); "
+        "ctx = extract(h); assert ctx.trace_id == sp.trace_id; "
+        "flight_recorder.install(); "
+        "flight_recorder.note_request(sp.trace_id, 0.5, status=200); "
+        "assert flight_recorder.tree(sp.trace_id) is not None; "
+        "assert chrome_trace([sp])['traceEvents']; "
+        "feature_log.record(service='ci', route='/', batch=1); "
+        "assert 'jax' not in sys.modules, 'obs data plane pulled jax'; "
+        "print('obs import OK (no jax)')")
     rc = _run([sys.executable, "-c", smoke],
               env=dict(os.environ, JAX_PLATFORMS="cpu"))
     if rc:

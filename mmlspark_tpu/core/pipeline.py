@@ -15,6 +15,7 @@ from .dataframe import DataFrame
 from .param import Params, Param, StageListParam, StageParam
 from .logging import BasicLogging
 from .serialize import SaveLoadMixin, register_stage
+from ..obs.profile import pipeline_profiler as _pipeline_profiler
 
 
 class PipelineStage(Params, BasicLogging, SaveLoadMixin):
@@ -107,8 +108,19 @@ class PipelineModel(Model):
 
     def _transform(self, df: DataFrame) -> DataFrame:
         cur = df
+        prof = _pipeline_profiler()
+        if prof is None:
+            for stage in self.getOrDefault("stages"):
+                cur = stage.transform(cur)
+            return cur
+        # per-stage host-dispatch vs device-execute attribution (obs
+        # StepProfiler, opt-in: enable_pipeline_profiling() or
+        # MMLSPARK_TPU_PROFILE_PIPELINE=1). The handle's done() sync is
+        # the measurement — it serializes the async dispatch pipeline,
+        # which is exactly why the default path stays untouched.
         for stage in self.getOrDefault("stages"):
-            cur = stage.transform(cur)
+            with prof.step(type(stage).__name__) as h:
+                cur = h.done(stage.transform(cur))
         return cur
 
 

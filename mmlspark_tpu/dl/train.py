@@ -23,6 +23,8 @@ import numpy as np
 import optax
 from jax.sharding import NamedSharding, PartitionSpec as P
 
+from ..parallel import compat as _compat
+
 
 @dataclasses.dataclass
 class TrainState:
@@ -206,7 +208,11 @@ def make_train_step(module, tx, mesh=None,
             opt_state=new_opt, step=state.step + 1)
         return new_state, loss
 
-    return jax.jit(step, donate_argnums=(0,))
+    # compat.jit = jax.jit + the obs CompileTracker: a train step that
+    # recompiles mid-run (shape drift, sharding drift) shows up in
+    # profile_compiles_total{fn="train_step"} instead of as silent
+    # multi-second stalls
+    return _compat.jit(step, name="train_step", donate_argnums=(0,))
 
 
 def partition_train_state(state: TrainState, mesh, rules, *,
@@ -332,10 +338,10 @@ def make_partitioned_train_step(module, tx, mesh, state_shardings, *,
             opt_state=new_opt, step=state.step + 1)
         return new_state, loss
 
-    return jax.jit(step,
-                   in_shardings=(state_shardings, batch_sh, batch_sh),
-                   out_shardings=(state_shardings, repl),
-                   donate_argnums=(0,))
+    return _compat.jit(step, name="partitioned_train_step",
+                       in_shardings=(state_shardings, batch_sh, batch_sh),
+                       out_shardings=(state_shardings, repl),
+                       donate_argnums=(0,))
 
 
 def train_epoch(step, state, batches, placement=None):

@@ -13,6 +13,12 @@ it — and a 429/503 carrying ``Retry-After`` (the sched subsystem's
 sheds) floors the next backoff instead of hammering the overloaded
 peer. Each attempt passes the ``http.send`` fault-injection point, so
 chaos tests drive this path without monkeypatching.
+
+Trace propagation (obs subsystem): every send opens an ``http.send``
+span and injects its W3C-style ``traceparent`` into the outgoing
+headers, so a server on the other end parents its request span into
+the CALLER's trace — the driver→worker hop stops severing the tree.
+Retries re-send under the same span: one logical exchange, one span.
 """
 
 from __future__ import annotations
@@ -23,6 +29,8 @@ import urllib.request
 from concurrent.futures import ThreadPoolExecutor
 
 from ...core.utils import StopWatch
+from ...obs.propagation import inject as _inject
+from ...obs.tracing import tracer as _tracer
 from ...resilience import RetryPolicy, parse_retry_after
 from ...resilience.faults import injector as _faults
 from .schema import HTTPRequestData, HTTPResponseData
@@ -61,6 +69,19 @@ def send_request(req: HTTPRequestData, timeout: float = 60.0,
                     retry_statuses=frozenset(RETRY_STATUSES))
         if retries is not None else DEFAULT_POLICY)
     call = pol.start(deadline=timeout, op="http.send")
+    with _tracer.span("http.send", url=req.url,
+                      method=req.method) as send_span:
+        resp = _send_with_retries(req, timeout, call, send_span)
+        send_span.set_attr("status", resp.status_code)
+        return resp
+
+
+def _send_with_retries(req: HTTPRequestData, timeout: float, call,
+                       send_span) -> HTTPResponseData:
+    # one traceparent for the whole logical exchange: a retry is the
+    # same request, so the server-side spans of every attempt join the
+    # same tree under the one http.send span
+    headers = _inject(dict(req.headers or {}), send_span)
     last: HTTPResponseData | None = None
     while True:
         try:
@@ -81,7 +102,7 @@ def send_request(req: HTTPRequestData, timeout: float = 60.0,
             else:
                 r = urllib.request.Request(
                     req.url, data=req.entity, method=req.method,
-                    headers=dict(req.headers))
+                    headers=headers)
                 with urllib.request.urlopen(
                         r, timeout=attempt_timeout) as ok:
                     return HTTPResponseData(

@@ -148,9 +148,22 @@ int read_response(int fd, std::string& carry,
 // closed-loop thread for a server-chosen eternity.
 constexpr double kMaxRetryAfterSec = 2.0;
 
-void run_conn(const char* host, int port, const std::string& request,
-              long nreq, int retry_shed, double* lat_ms, int* status_out,
-              ConnResult* res) {
+// Per-request W3C-style traceparent header: trace id =
+// <prefix><conn:4hex><req:8hex>, so the Python summary can RECONSTRUCT
+// the trace id of any (connection, request) slot — the p99-slowest
+// requests become flight-recorder lookup keys without shipping ids
+// back through the FFI.
+std::string trace_header(const std::string& prefix, int conn, long req) {
+  char buf[64];
+  std::snprintf(buf, sizeof(buf), "%04x%08lx", conn,
+                static_cast<unsigned long>(req));
+  return "Traceparent: 00-" + prefix + buf + "-0001-01\r\n";
+}
+
+void run_conn(const char* host, int port, const std::string& head,
+              const std::string& body, const std::string& trace_prefix,
+              int conn_idx, long nreq, int retry_shed, double* lat_ms,
+              int* status_out, ConnResult* res) {
   int fd = connect_to(host, port);
   if (fd < 0) {
     res->hard_fail = true;
@@ -162,7 +175,11 @@ void run_conn(const char* host, int port, const std::string& request,
     return;
   }
   std::string carry;
+  std::string request = head + "\r\n" + body;  // traceless form
   for (long i = 0; i < nreq; ++i) {
+    if (!trace_prefix.empty())
+      request = head + trace_header(trace_prefix, conn_idx, i)
+          + "\r\n" + body;
     auto t0 = Clock::now();
     int status = -1;
     double retry_after = 0.0;
@@ -173,7 +190,8 @@ void run_conn(const char* host, int port, const std::string& request,
     if (retry_shed && (status == 429 || status == 503)) {
       // honor the shed's Retry-After with ONE bounded re-attempt;
       // the recorded latency is the re-attempt's round trip (the
-      // back-off wait is the server's instruction, not its latency)
+      // back-off wait is the server's instruction, not its latency).
+      // Same traceparent: one logical request, one trace.
       double wait = retry_after > 0 ? retry_after : 0.05;
       if (wait > kMaxRetryAfterSec) wait = kMaxRetryAfterSec;
       timespec ts;
@@ -233,28 +251,35 @@ extern "C" {
 // retry_shed != 0 honors Retry-After on 429/503 with one bounded
 // re-attempt; such requests report status + 1000 (1200 = 200 on the
 // re-attempt) so retry traffic is distinguishable from first-offer
-// load. Returns total non-200/transport errors, or -1 when every
-// connection failed to even connect.
-long lg_run3(const char* host, int port, int nconn, long nreq,
+// load. trace_prefix, when non-empty, stamps every request with a
+// deterministic traceparent (<prefix><conn:4hex><req:8hex>) so outliers
+// can be looked up in the server's flight recorder. Returns total
+// non-200/transport errors, or -1 when every connection failed to even
+// connect.
+long lg_run4(const char* host, int port, int nconn, long nreq,
              const char* path, const unsigned char* body, long body_len,
-             int retry_shed, double* lat_ms, int* status_out,
-             double* wall_s) {
-  std::string request;
-  request.reserve(256 + static_cast<size_t>(body_len));
-  request += "POST ";
-  request += path;
-  request += " HTTP/1.1\r\nHost: bench\r\nContent-Length: ";
-  request += std::to_string(body_len);
-  request += "\r\nConnection: keep-alive\r\n\r\n";
-  request.append(reinterpret_cast<const char*>(body),
-                 static_cast<size_t>(body_len));
+             int retry_shed, const char* trace_prefix, double* lat_ms,
+             int* status_out, double* wall_s) {
+  // head stops before the blank line: the per-request traceparent (and
+  // the terminating \r\n) are appended per send
+  std::string head;
+  head.reserve(256);
+  head += "POST ";
+  head += path;
+  head += " HTTP/1.1\r\nHost: bench\r\nContent-Length: ";
+  head += std::to_string(body_len);
+  head += "\r\nConnection: keep-alive\r\n";
+  std::string payload(reinterpret_cast<const char*>(body),
+                      static_cast<size_t>(body_len));
+  std::string prefix(trace_prefix ? trace_prefix : "");
 
   std::vector<ConnResult> results(static_cast<size_t>(nconn));
   std::vector<std::thread> threads;
   threads.reserve(static_cast<size_t>(nconn));
   auto t0 = Clock::now();
   for (int c = 0; c < nconn; ++c)
-    threads.emplace_back(run_conn, host, port, std::cref(request), nreq,
+    threads.emplace_back(run_conn, host, port, std::cref(head),
+                         std::cref(payload), std::cref(prefix), c, nreq,
                          retry_shed,
                          lat_ms + static_cast<long>(c) * nreq,
                          status_out ? status_out
@@ -272,6 +297,15 @@ long lg_run3(const char* host, int port, int nconn, long nreq,
   }
   if (hard == nconn) return -1;
   return errors;
+}
+
+// Back-compat entry point (no traceparent stamping).
+long lg_run3(const char* host, int port, int nconn, long nreq,
+             const char* path, const unsigned char* body, long body_len,
+             int retry_shed, double* lat_ms, int* status_out,
+             double* wall_s) {
+  return lg_run4(host, port, nconn, nreq, path, body, body_len,
+                 retry_shed, "", lat_ms, status_out, wall_s);
 }
 
 // Back-compat entry point (no Retry-After re-attempts).

@@ -1,11 +1,17 @@
-"""Unified observability: process-wide metrics + tracing.
+"""Unified observability: process-wide metrics + tracing + profiling.
 
-One registry (``registry``) and one tracer (``tracer``) shared by every
-layer — serving fronts, the distributed worker mesh, the resilience
-subsystem (retry/breaker/fault-injection series), collectives, the
-LightGBM boosting loop, and the bench suite — replacing the fragmented
-per-component stopwatches the reference inherited (per-stage JSON
-telemetry + VW nanosecond timers, SURVEY §5). See docs/observability.md.
+One registry (``registry``), one tracer (``tracer``), one flight
+recorder (``flight_recorder``), one compile tracker
+(``compile_tracker``) shared by every layer — serving fronts, the
+distributed worker mesh, the resilience subsystem (retry/breaker/
+fault-injection series), collectives, the LightGBM boosting loop, and
+the bench suite — replacing the fragmented per-component stopwatches
+the reference inherited (per-stage JSON telemetry + VW nanosecond
+timers, SURVEY §5). Cross-process trace propagation lives in
+``obs.propagation`` (W3C-style traceparent), Chrome-trace export and
+the flight recorder in ``obs.export``, the continuous compile/step
+profiler and cost-model feature log in ``obs.profile``. See
+docs/observability.md.
 
 Import is side-effect-free and backend-free: safe under
 ``JAX_PLATFORMS=cpu`` before (or without) JAX initialization.
@@ -13,8 +19,18 @@ Import is side-effect-free and backend-free: safe under
 
 from .metrics import (DEFAULT_LATENCY_BUCKETS, Counter, Gauge, Histogram,
                       MetricsRegistry, registry)
-from .tracing import Span, StageTimer, Tracer, tracer
+from .tracing import Span, StageTimer, Tracer, tracer, wall_now
+from .propagation import TraceContext, extract, inject
+from .export import (FlightRecorder, SpanCollector, chrome_trace,
+                     flight_recorder)
+from .profile import (CompileTracker, FeatureLog, StepProfiler,
+                      compile_tracker, feature_log, step_profiler)
 
 __all__ = ["registry", "tracer", "MetricsRegistry", "Counter", "Gauge",
-           "Histogram", "Tracer", "Span", "StageTimer",
-           "DEFAULT_LATENCY_BUCKETS"]
+           "Histogram", "Tracer", "Span", "StageTimer", "wall_now",
+           "DEFAULT_LATENCY_BUCKETS",
+           "TraceContext", "extract", "inject",
+           "FlightRecorder", "SpanCollector", "chrome_trace",
+           "flight_recorder",
+           "CompileTracker", "FeatureLog", "StepProfiler",
+           "compile_tracker", "feature_log", "step_profiler"]

@@ -1,0 +1,417 @@
+"""Continuous compile/device profiler + the cost-model feature log.
+
+Three instruments, all always-on-capable (bounded, registry-backed, no
+trace files to rotate):
+
+- :class:`CompileTracker` — wraps ``jax.jit`` call sites (route through
+  :func:`mmlspark_tpu.parallel.compat.jit`) so every retrace is counted
+  and every compile's wall time lands in a histogram, per function.
+  This is the RUNTIME counterpart of graftcheck's static
+  recompile-hazard pass: the static pass says "this branch COULD
+  recompile per step"; the tracker says "this function DID compile 14
+  times in the last hour". Steady-state serving must show zero misses.
+
+- :class:`StepProfiler` — attributes wall time into host-dispatch vs
+  device-execute per pipeline stage using the ``block_until_ready``
+  delta (dispatch returns as soon as XLA enqueues; the remainder until
+  the sync completes is device/transfer time). This generalizes
+  bench.py's MFU accounting into an always-on gauge: pass ``flops`` and
+  ``profile_mfu{stage=...}`` updates per step. The ~64 ms contended
+  dispatch RTT in BENCH_TPU_BANKED.json is exactly what this surface
+  makes visible per stage, continuously.
+
+- :class:`FeatureLog` — a bounded structured log appending one record
+  per served request (route, batch/bucket, dtype/shapes when known,
+  queue ms, execute ms, device ms): the training data for the learned
+  scheduler cost model (arXiv:2008.01040) and the measurement substrate
+  a TVM-style autotuner (arXiv:1802.04799) searches over.
+
+``utils.profiling``'s device-trace helpers (:func:`profile_trace`,
+:func:`profiled`) moved here — that module keeps deprecation shims.
+
+Import is stdlib-only; JAX is imported lazily inside the jit wrapper
+and the XProf helpers only.
+"""
+
+from __future__ import annotations
+
+import collections
+import contextlib
+import functools
+import os
+import threading
+import time
+
+from .metrics import registry as _registry
+from .tracing import tracer as _tracer, wall_now
+
+# per-chip peak used by the MFU gauge when the caller does not override
+# it (bench.py's V5E_PEAK_BF16_FLOPS; TPU v5e bf16)
+DEFAULT_PEAK_FLOPS = 197e12
+
+
+class CompileTracker:
+    """Counts retraces and compile time per jitted function.
+
+    ``tracker.jit(fn, name=..., **jit_kwargs)`` returns a callable with
+    ``jax.jit`` semantics whose Python body is instrumented: the wrapped
+    function executes once per TRACE, so each execution is a cache miss
+    (a compile). Per-call hit/miss outcomes and compile wall seconds go
+    to the obs registry:
+
+    - ``profile_compiles_total{fn=...}`` — retrace count (>= 2 on a
+      shape-unstable function; the static recompile-hazard pass's
+      runtime ground truth),
+    - ``profile_jit_calls_total{fn=...,outcome=hit|miss}``,
+    - ``profile_compile_seconds{fn=...}`` — trace+compile wall time.
+
+    Intentionally lock-free: the trace-noting shim runs INSIDE the
+    traced region (that is the mechanism), where lock acquisition is a
+    trace-safety hazard. Python-level dict bumps are GIL-atomic enough
+    for compile events, which JAX serializes under its own tracing
+    machinery; the registry counters (internally locked) carry the
+    authoritative monotone series.
+    """
+
+    def __init__(self, registry=None):
+        reg = registry if registry is not None else _registry
+        self._traces: dict[str, int] = {}
+        self._calls: dict[str, int] = {}
+        self._c_compiles = reg.counter(
+            "profile_compiles_total",
+            "jit retraces (compiles) per tracked function")
+        self._c_calls = reg.counter(
+            "profile_jit_calls_total",
+            "tracked jit calls, by function and cache outcome")
+        self._h_compile = reg.histogram(
+            "profile_compile_seconds",
+            "trace+compile wall seconds per tracked function")
+
+    def _note_trace(self, label: str) -> None:
+        # runs at trace time, inside the traced region: must stay free
+        # of locks/clock/IO (graftcheck's trace-safety pass gates this
+        # file). The dict bump is best-effort; the counter is exact.
+        self._traces[label] = self._traces.get(label, 0) + 1
+        self._c_compiles.inc(1, fn=label)
+
+    def jit(self, fn=None, *, name: str | None = None, **jit_kwargs):
+        """``jax.jit`` with compile tracking. Usable as a decorator
+        (``@tracker.jit`` / ``@tracker.jit(name=...)``) or call-form;
+        ``jit_kwargs`` pass through (donate_argnums, in_shardings, ...).
+        ``lower``/``eval_shape``/``clear_cache`` forward to the
+        underlying jitted callable."""
+        if fn is None:
+            return functools.partial(self.jit, name=name, **jit_kwargs)
+        import jax
+        label = name or getattr(fn, "__name__", None) or "<jit>"
+
+        @functools.wraps(fn)
+        def traced(*args, **kwargs):
+            self._note_trace(label)
+            return fn(*args, **kwargs)
+
+        compiled = jax.jit(traced, **jit_kwargs)
+
+        @functools.wraps(fn)
+        def call(*args, **kwargs):
+            before = self._traces.get(label, 0)
+            t0 = time.perf_counter()
+            out = compiled(*args, **kwargs)
+            if self._traces.get(label, 0) > before:
+                # the call that traced pays trace+compile inline: its
+                # wall time IS the compile cost (async device dispatch
+                # makes a cache-hit call return in microseconds)
+                self._h_compile.observe(time.perf_counter() - t0,
+                                        fn=label)
+                self._c_calls.inc(1, fn=label, outcome="miss")
+            else:
+                self._c_calls.inc(1, fn=label, outcome="hit")
+            self._calls[label] = self._calls.get(label, 0) + 1
+            return out
+
+        for attr in ("lower", "eval_shape", "trace", "clear_cache"):
+            if hasattr(compiled, attr):
+                setattr(call, attr, getattr(compiled, attr))
+        call.__tracked_label__ = label
+        return call
+
+    # -- read surface ------------------------------------------------------
+    def compiles(self, name: str) -> int:
+        """Retrace count for a tracked function (0 if never traced)."""
+        return self._traces.get(name, 0)
+
+    def calls(self, name: str) -> int:
+        return self._calls.get(name, 0)
+
+    def stats(self) -> dict[str, dict[str, int]]:
+        return {label: {"compiles": n,
+                        "calls": self._calls.get(label, 0)}
+                for label, n in sorted(self._traces.items())}
+
+    def unstable(self, min_compiles: int = 2) -> dict[str, int]:
+        """Functions that recompiled — the runtime recompile-hazard
+        flags. A steady-state serving process must return ``{}`` here
+        (after warmup); a shape-unstable fn shows its retrace count."""
+        return {label: n for label, n in sorted(self._traces.items())
+                if n >= min_compiles}
+
+
+#: THE process-wide tracker (``parallel.compat.jit`` routes through it).
+compile_tracker = CompileTracker()
+
+
+class _StepHandle:
+    """Yielded by :meth:`StepProfiler.step`: call ``done(result)`` with
+    whatever the stage produced so the profiler can measure the
+    device-execute tail (``block_until_ready`` delta). Without it the
+    whole step is attributed to host dispatch. After the ``with`` block
+    exits, ``seconds`` / ``dispatch_seconds`` / ``device_seconds``
+    carry the measured split (callers like ``stages.Timer`` re-surface
+    them)."""
+
+    __slots__ = ("result", "seconds", "dispatch_seconds",
+                 "device_seconds")
+
+    def __init__(self):
+        self.result = None
+        self.seconds = 0.0
+        self.dispatch_seconds = 0.0
+        self.device_seconds = 0.0
+
+    def done(self, result):
+        self.result = result
+        return result
+
+
+def _block_on(obj) -> bool:
+    """Best-effort sync on anything block_until_ready-able (a jax
+    array, a tuple/list/dict of them, or a DataFrame's columns).
+    Returns whether anything was actually synced — a pure-host stage
+    records device_seconds ~0 with ``synced=False``."""
+    if obj is None or isinstance(obj, (str, bytes, int, float, bool)):
+        # scalars can't hold device handles, and a str ITERATES TO
+        # ITSELF — without this cut a single text cell recurses forever
+        return False
+    synced = False
+    blocker = getattr(obj, "block_until_ready", None)
+    if callable(blocker):
+        blocker()
+        return True
+    # numeric numpy arrays cannot hold device handles: skip before the
+    # generic __iter__ branch walks a million rows in Python
+    dt = getattr(obj, "dtype", None)
+    if dt is not None and getattr(dt, "kind", "O") != "O":
+        return False
+    cols = getattr(obj, "columns", None)
+    if cols is not None and hasattr(obj, "__getitem__"):
+        for c in cols:  # DataFrame-shaped: sync column by column
+            if _block_on(obj[c]):
+                synced = True
+        return synced
+    if isinstance(obj, dict):
+        obj = obj.values()
+    if isinstance(obj, (list, tuple)) or hasattr(obj, "__iter__"):
+        try:
+            for leaf in obj:
+                if _block_on(leaf):
+                    synced = True
+        except TypeError:
+            pass
+    return synced
+
+
+class StepProfiler:
+    """Host-dispatch vs device-execute attribution per pipeline stage.
+
+    ``with profiler.step("featurize", flops=f) as h: h.done(stage(x))``
+    records:
+
+    - ``profile_step_seconds{stage=...,phase=dispatch|device}`` — the
+      host time until dispatch returned vs the block_until_ready tail,
+    - ``profile_steps_total{stage=...}``,
+    - ``profile_mfu{stage=...}`` when ``flops`` is given (always-on MFU:
+      flops / total seconds / peak),
+
+    and emits ``profile.dispatch`` / ``profile.device`` child spans
+    under the ambient trace (or an explicit ``parent=``), so a request's
+    flame graph shows where host↔device time went per stage.
+    """
+
+    def __init__(self, service: str = "", registry=None, tracer=None,
+                 peak_flops: float = DEFAULT_PEAK_FLOPS):
+        reg = registry if registry is not None else _registry
+        self.service = service
+        self.peak_flops = float(peak_flops)
+        self._tracer = tracer if tracer is not None else _tracer
+        self._h_step = reg.histogram(
+            "profile_step_seconds",
+            "per-stage wall seconds, split host-dispatch vs device")
+        self._c_steps = reg.counter(
+            "profile_steps_total", "profiled stage executions")
+        self._g_mfu = reg.gauge(
+            "profile_mfu",
+            "achieved FLOP/s over peak per stage (always-on MFU)")
+
+    _AMBIENT = object()
+
+    @contextlib.contextmanager
+    def step(self, stage: str, *, parent=_AMBIENT,
+             flops: float | None = None, features: dict | None = None):
+        handle = _StepHandle()
+        if parent is StepProfiler._AMBIENT:
+            parent = self._tracer.current_span()
+        w0 = wall_now()
+        t0 = time.perf_counter()
+        try:
+            yield handle
+        finally:
+            t1 = time.perf_counter()
+            synced = False
+            if handle.result is not None:
+                try:
+                    synced = _block_on(handle.result)
+                except Exception:
+                    synced = False
+            t2 = time.perf_counter()
+            dispatch_s, device_s = t1 - t0, t2 - t1
+            handle.dispatch_seconds = dispatch_s
+            handle.device_seconds = device_s
+            handle.seconds = t2 - t0
+            self._h_step.observe(dispatch_s, stage=stage,
+                                 phase="dispatch")
+            self._h_step.observe(device_s, stage=stage, phase="device")
+            self._c_steps.inc(1, stage=stage)
+            if flops:
+                self.record_mfu(stage, flops, t2 - t0)
+            dspan = self._tracer.emit_span(
+                "profile.dispatch", parent=parent, seconds=dispatch_s,
+                start_wall=w0, stage=stage)
+            self._tracer.emit_span(
+                "profile.device", parent=dspan, seconds=device_s,
+                start_wall=w0 + dispatch_s, stage=stage, synced=synced)
+            if features is not None:
+                feature_log.record(
+                    stage=stage, dispatch_ms=dispatch_s * 1e3,
+                    device_ms=device_s * 1e3, **features)
+
+    def record_mfu(self, stage: str, flops: float,
+                   seconds: float) -> float:
+        """Set the always-on MFU gauge from an externally measured
+        (flops, seconds) pair — bench.py's sweep and the step context
+        both land here."""
+        mfu = float(flops) / max(float(seconds), 1e-12) / self.peak_flops
+        self._g_mfu.set(mfu, stage=stage)
+        return mfu
+
+
+#: THE process-wide step profiler (serving, pipelines, benches share it
+#: so the mfu/step series stay one family).
+step_profiler = StepProfiler()
+
+
+class FeatureLog:
+    """Bounded in-memory log of per-request cost-model features.
+
+    One dict per served request, appended by the serving executor
+    (route, batch, padding bucket, queue/execute ms) and enriched by
+    model transforms through :meth:`record` or
+    ``StepProfiler.step(features=...)`` (op shapes, dtype, device ms).
+    This is TRAINING DATA for the learned performance model that will
+    replace ``sched/policy.py``'s EWMA — bounded (ring buffer) so an
+    always-on server never grows it past ``maxlen`` records.
+    """
+
+    def __init__(self, maxlen: int = 4096, registry=None):
+        reg = registry if registry is not None else _registry
+        self._lock = threading.Lock()
+        self._records = collections.deque(maxlen=int(maxlen))
+        self._c_records = reg.counter(
+            "profile_feature_records_total",
+            "cost-model feature records appended, by service")
+
+    def record(self, **fields) -> None:
+        with self._lock:
+            self._records.append(dict(fields))
+        self._c_records.inc(1, service=str(fields.get("service", "")))
+
+    def snapshot(self) -> list[dict]:
+        """Copy of the retained records, oldest first."""
+        with self._lock:
+            return [dict(r) for r in self._records]
+
+    def clear(self) -> None:
+        with self._lock:
+            self._records.clear()
+
+    def __len__(self) -> int:
+        with self._lock:
+            return len(self._records)
+
+
+#: THE process-wide feature log.
+feature_log = FeatureLog()
+
+
+# ------------------------------------------------- pipeline profiling hook
+# PipelineModel.transform consults this: None (the default) keeps the
+# async-dispatch pipeline untouched; enabling it syncs per stage (that
+# is the point — attribution requires the block_until_ready delta).
+_pipeline_profiler: StepProfiler | None = None
+_env_checked = False
+
+
+def enable_pipeline_profiling(profiler: StepProfiler | None = None
+                              ) -> StepProfiler:
+    """Turn on per-stage host/device attribution for every
+    ``PipelineModel.transform`` (also via MMLSPARK_TPU_PROFILE_PIPELINE=1).
+    Costs one device sync per stage — measurement, not a free lunch."""
+    global _pipeline_profiler
+    _pipeline_profiler = profiler if profiler is not None \
+        else step_profiler
+    return _pipeline_profiler
+
+
+def disable_pipeline_profiling() -> None:
+    global _pipeline_profiler, _env_checked
+    _pipeline_profiler = None
+    _env_checked = True  # an explicit disable beats the env default
+
+
+def pipeline_profiler() -> StepProfiler | None:
+    """The active pipeline profiler or None (the hot-path check)."""
+    global _env_checked
+    if _pipeline_profiler is None and not _env_checked:
+        _env_checked = True
+        if os.environ.get("MMLSPARK_TPU_PROFILE_PIPELINE") == "1":
+            enable_pipeline_profiling()
+    return _pipeline_profiler
+
+
+# ----------------------------------------------------- XProf device traces
+# (folded in from utils/profiling.py — the duplicate timing path PR 1
+# left behind; that module now shims here with a DeprecationWarning)
+@contextlib.contextmanager
+def profile_trace(log_dir: str, *, host_tracer_level: int = 2):
+    """Capture a device+host trace for the enclosed region
+    (``jax.profiler.trace`` wrapper; open with XProf/TensorBoard)."""
+    import jax
+    jax.profiler.start_trace(log_dir, create_perfetto_link=False)
+    try:
+        yield
+    finally:
+        jax.profiler.stop_trace()
+
+
+def profiled(name: str | None = None):
+    """Decorator: annotate a function in device traces
+    (``jax.profiler.TraceAnnotation``) and record wall time."""
+    def wrap(fn):
+        label = name or fn.__qualname__
+
+        @functools.wraps(fn)
+        def inner(*args, **kwargs):
+            import jax
+            with jax.profiler.TraceAnnotation(label):
+                return fn(*args, **kwargs)
+        return inner
+    return wrap

@@ -20,6 +20,13 @@ Cross-thread propagation: ``contextvars`` do not cross ``threading``
 boundaries, so hand the parent over explicitly —
 ``tracer.span("work", parent=parent_span)`` — exactly what the serving
 worker pool does per batch.
+
+Cross-PROCESS propagation lives in :mod:`.propagation` (W3C-style
+``traceparent`` headers / lease metadata): ``start_span`` accepts any
+parent carrying ``trace_id``/``span_id`` attributes, so an extracted
+remote context parents a local span directly. Ids are pure lowercase
+hex for exactly that reason — they must survive a ``-``-delimited
+header field.
 """
 
 from __future__ import annotations
@@ -44,10 +51,27 @@ _ids = itertools.count(1)
 _id_lock = threading.Lock()
 _PROC = f"{os.getpid():x}"
 
+# Wall-clock anchor taken ONCE at import: span timestamps are civil time
+# for trace viewers, but deriving them from the monotonic clock after
+# this single read means an NTP step mid-run can never make a child span
+# appear to start before its parent (and no deadline-path code ever
+# reads time.time()).
+_WALL0 = time.time()
+_PERF0 = time.perf_counter()
+
+
+def wall_now() -> float:
+    """Epoch seconds derived from the monotonic clock (one wall read at
+    import, monotonic deltas after) — the timestamp base for every span."""
+    return _WALL0 + (time.perf_counter() - _PERF0)
+
 
 def _new_id() -> str:
+    # pure hex (no separators): ids travel inside W3C-style traceparent
+    # headers where "-" delimits fields. The zero-padded counter keeps
+    # pid-prefix + counter concatenation collision-free per process.
     with _id_lock:
-        return f"{_PROC}-{next(_ids):x}"
+        return f"{_PROC}{next(_ids):06x}"
 
 
 @dataclass
@@ -62,10 +86,33 @@ class Span:
     start_wall: float = 0.0       # epoch seconds (event timestamps)
     seconds: float | None = None  # wall duration, set at end
     error: str | None = None
+    proc: str = ""                # emitting process (hex pid)
     _t0: float = 0.0              # perf_counter anchor
 
     def set_attr(self, key: str, value) -> None:
         self.attrs[key] = value
+
+    def to_dict(self) -> dict:
+        """Wire/export form — the same field names ``Tracer._emit``
+        writes to the telemetry log, so a span serialized into a mesh
+        reply and a span grepped from the log read identically."""
+        payload = {
+            "event": "span",
+            "name": self.name,
+            "traceId": self.trace_id,
+            "spanId": self.span_id,
+            "parentId": self.parent_id,
+            "startWall": self.start_wall,
+            "seconds": self.seconds,
+            "proc": self.proc or _PROC,
+        }
+        if self.attrs:
+            payload["attrs"] = {k: v for k, v in self.attrs.items()
+                                if isinstance(v, (str, int, float, bool,
+                                                  type(None)))}
+        if self.error is not None:
+            payload["error"] = self.error
+        return payload
 
 
 _current_span: contextvars.ContextVar[Span | None] = \
@@ -84,10 +131,27 @@ class Tracer:
     def __init__(self, registry=None, metric: str | None = None):
         self.registry = registry if registry is not None else _registry
         self.metric = metric
+        # finished-span sinks (the flight recorder / test collectors):
+        # called on EVERY end_span regardless of the logging gate
+        self._sinks: list = []
 
     # -- context -----------------------------------------------------------
     def current_span(self) -> Span | None:
         return _current_span.get()
+
+    # -- sinks -------------------------------------------------------------
+    def add_sink(self, sink) -> None:
+        """Register ``sink(span)`` to receive every finished span
+        (idempotent). Sinks run on the ending thread and must be cheap
+        and never raise — the flight recorder's collection hook."""
+        if sink not in self._sinks:
+            self._sinks.append(sink)
+
+    def remove_sink(self, sink) -> None:
+        try:
+            self._sinks.remove(sink)
+        except ValueError:
+            pass
 
     # -- span lifecycle ----------------------------------------------------
     def start_span(self, name: str, *, parent=_UNSET,
@@ -101,13 +165,18 @@ class Tracer:
         never corrupt the context of unrelated spans."""
         if parent is _UNSET:
             parent = _current_span.get()
-        if isinstance(parent, Span):
-            trace_id, parent_id = parent.trace_id, parent.span_id
+        # duck-typed parentage: a Span OR any context carrying
+        # trace_id/span_id (a propagation.TraceContext extracted from a
+        # remote hop) parents this span into its trace
+        tid = getattr(parent, "trace_id", None)
+        if tid is not None:
+            trace_id, parent_id = tid, getattr(parent, "span_id", None)
         else:
             trace_id, parent_id = _new_id(), None
         span = Span(name=name, trace_id=trace_id, span_id=_new_id(),
                     parent_id=parent_id, attrs=dict(attrs),
-                    start_wall=time.time(), _t0=time.perf_counter())
+                    start_wall=wall_now(), proc=_PROC,
+                    _t0=time.perf_counter())
         if current:
             span._token = _current_span.set(span)
         return span
@@ -164,28 +233,47 @@ class Tracer:
                 ann.__exit__(None, None, None)
             self.end_span(span)
 
+    # -- retroactive spans -------------------------------------------------
+    def emit_span(self, name: str, *, parent, seconds: float,
+                  start_wall: float | None = None,
+                  error: str | None = None, **attrs) -> Span:
+        """Synthesize an already-measured span — for durations observed
+        after the fact (a queue wait known only at pop time, a worker's
+        share of a batch). ``parent`` is a Span / TraceContext / None;
+        ``start_wall`` defaults to ``now - seconds``."""
+        tid = getattr(parent, "trace_id", None)
+        if tid is not None:
+            trace_id, parent_id = tid, getattr(parent, "span_id", None)
+        else:
+            trace_id, parent_id = _new_id(), None
+        seconds = max(float(seconds), 0.0)
+        span = Span(name=name, trace_id=trace_id, span_id=_new_id(),
+                    parent_id=parent_id, attrs=dict(attrs),
+                    start_wall=(wall_now() - seconds
+                                if start_wall is None else start_wall),
+                    seconds=seconds, error=error, proc=_PROC)
+        span._done = True
+        self._emit(span)
+        if self.metric is not None:
+            self.registry.histogram(
+                self.metric, "span wall seconds").observe(
+                    span.seconds, span=span.name)
+        return span
+
     # -- emission ----------------------------------------------------------
     def _emit(self, span: Span) -> None:
+        # sinks first, and unconditionally: the flight recorder must see
+        # spans even when nobody listens to the telemetry log
+        for sink in self._sinks:
+            try:
+                sink(span)
+            except Exception:
+                pass  # a broken sink must never kill the traced code
         # same gate BasicLogging rides on: when nothing listens at INFO
         # the span costs two clock reads and a few dict ops, no json
         if not _TELEMETRY.isEnabledFor(logging.INFO):
             return
-        payload = {
-            "event": "span",
-            "name": span.name,
-            "traceId": span.trace_id,
-            "spanId": span.span_id,
-            "parentId": span.parent_id,
-            "startWall": span.start_wall,
-            "seconds": span.seconds,
-        }
-        if span.attrs:
-            payload["attrs"] = {k: v for k, v in span.attrs.items()
-                                if isinstance(v, (str, int, float, bool,
-                                                  type(None)))}
-        if span.error is not None:
-            payload["error"] = span.error
-        _TELEMETRY.info(json.dumps(payload))
+        _TELEMETRY.info(json.dumps(span.to_dict()))
 
 
 # THE process-wide tracer (parallel to ``metrics.registry``).

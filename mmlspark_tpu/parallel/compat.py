@@ -31,6 +31,22 @@ def shard_map(f, *, mesh, in_specs, out_specs, check_vma=None):
                       out_specs=out_specs, **kw)
 
 
+def jit(fn=None, *, name: str | None = None, **jit_kwargs):
+    """``jax.jit`` through the obs :class:`CompileTracker`: identical
+    call semantics (decorator or call-form; ``donate_argnums`` /
+    ``in_shardings`` / ... pass through), but every retrace is counted
+    and every compile's wall time is recorded per function in the
+    process-wide registry (``profile_compiles_total{fn=...}`` etc.) —
+    the runtime counterpart of graftcheck's static recompile-hazard
+    pass. Route jit call sites through here so a production server can
+    answer "did anything recompile under load?" from a scrape.
+
+    JAX-free until called (the tracker imports jax lazily), like the
+    rest of this module's surface."""
+    from ..obs.profile import compile_tracker
+    return compile_tracker.jit(fn, name=name, **jit_kwargs)
+
+
 def tpu_compiler_params(**kwargs):
     """Pallas TPU compiler params across the rename: ``CompilerParams``
     on new JAX was ``TPUCompilerParams`` one generation back — same

@@ -33,6 +33,7 @@ import threading
 from collections import deque
 
 from ..obs import registry as _default_registry
+from ..obs.tracing import tracer as _obs_tracer
 from .policy import (GROW, WAIT, AdmissionConfig, AdmissionController,
                      BatchPolicy, ServiceTimeEstimator, Shed, now)
 
@@ -246,6 +247,7 @@ class RequestScheduler:
             self._h_wait.observe(w, service=self.service)
         for item in shed:
             self._shed_item(item, "expired")
+        self.annotate_queue_spans(batch)
         return batch
 
     def wake(self) -> None:
@@ -269,6 +271,20 @@ class RequestScheduler:
     def release(self, route: str = "/") -> None:
         """Forward to admission accounting (a request finished)."""
         self.admission.release(route)
+
+    def annotate_queue_spans(self, items) -> None:
+        """Emit a ``sched.queue`` child span (obs subsystem) for every
+        just-dispatched item that carries a request span — the measured
+        queue wait becomes a node in the request's cross-process tree.
+        Called OUTSIDE the cv by both drain paths (``next_batch`` and
+        the mesh ``__lease__`` drain); span emission does registry/sink
+        work that must never run under the scheduler lock."""
+        for item in items:
+            sp = getattr(item, "span", None)
+            qw = getattr(item, "queue_wait", None)
+            if sp is not None and qw is not None:
+                _obs_tracer.emit_span("sched.queue", parent=sp,
+                                      seconds=qw, service=self.service)
 
     def shed_if_expired(self, item) -> bool:
         """Expiry check for drain paths that bypass :meth:`next_batch`
@@ -299,10 +315,19 @@ class RequestScheduler:
         item = self._items.popleft()
         t0 = self._enq_at.pop(id(item), None)
         if t0 is not None:
+            wait = now() - t0
+            try:
+                # stamp the wait on the item: the serving layer's trace
+                # annotation (sched.queue spans) and cost-model feature
+                # log read it back outside the cv. Slotted items simply
+                # don't carry it.
+                item.queue_wait = wait
+            except AttributeError:
+                pass
             if waits is None:
-                self._h_wait.observe(now() - t0, service=self.service)
+                self._h_wait.observe(wait, service=self.service)
             else:
-                waits.append(now() - t0)
+                waits.append(wait)
         if waits is None:
             self._g_depth.set(len(self._items), service=self.service)
         return item

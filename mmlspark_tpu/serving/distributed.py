@@ -38,6 +38,9 @@ import numpy as np
 from ..core import DataFrame
 from ..io.http.schema import HTTPRequestData, HTTPResponseData
 from ..obs import registry as _obs
+from ..obs.export import flight_recorder as _flight
+from ..obs.propagation import TraceContext
+from ..obs.tracing import tracer as _tracer
 from ..resilience import breaker_for, drop_breaker
 from ..resilience.faults import WorkerKilled, injector as _faults
 from .native_front import NativeServingServer
@@ -395,6 +398,12 @@ class DistributedServingServer(ServingServer):
         _m_mesh_calls.inc(1, service=self.name, endpoint="__reply__")
         _m_mesh_bytes.inc(len(body), service=self.name,
                           endpoint="__reply__", direction="in")
+        # the worker's spans ride home in the reply payload: fold them
+        # into this process's flight recorder BEFORE the reply latch
+        # fires, so note_request (triggered by the waiting handler) sees
+        # the complete cross-process tree
+        if d.get("spans"):
+            _flight.ingest(d["spans"])
         # history read and lease drop in ONE critical section: the lease
         # monitor (its own thread) and handler threads race on _leases —
         # graftcheck's lock-discipline pass gates this (docs/analysis.md)
@@ -437,8 +446,21 @@ class DistributedServingServer(ServingServer):
         with self._lock:
             for c in batch:
                 self._leases[c.id] = (deadline, c, lessee)
-        out = [{"id": c.id, "request": _req_to_json(c.request)}
-               for c in batch]
+        # the lease drain bypasses next_batch, so the queue-wait spans
+        # are annotated here (outside _lock — span emission does
+        # registry/sink work)
+        self.scheduler.annotate_queue_spans(batch)
+        out = []
+        for c in batch:
+            entry = {"id": c.id, "request": _req_to_json(c.request)}
+            sp = getattr(c, "span", None)
+            if sp is not None:
+                # trace context rides the lease: the compute worker
+                # parents its execute/device spans into THIS request's
+                # tree instead of starting a fresh root
+                entry["trace"] = {"trace_id": sp.trace_id,
+                                  "span_id": sp.span_id}
+            out.append(entry)
         payload = json.dumps(out).encode()
         _m_mesh_calls.inc(1, service=self.name, endpoint="__lease__")
         _m_mesh_bytes.inc(len(payload), service=self.name,
@@ -593,6 +615,44 @@ class DistributedServingServer(ServingServer):
         return status == 200 and json.loads(body).get("delivered", False)
 
 
+def _worker_spans(items: list, wid: str, service: str, execute_s: float,
+                  out) -> dict[str, list[dict]]:
+    """Per-request trace annotation on the compute-worker side: for
+    every leased item that carried trace context, emit a
+    ``worker.execute`` span (the batch's transform wall time — what
+    each rider paid) with a ``worker.device`` child measured by the
+    block_until_ready delta on whatever the transform returned. Returns
+    ``request id → [span wire dicts]`` for the reply payloads; the
+    spans ALSO emit through this process's tracer (local telemetry)."""
+    traced = [i for i in items if i.get("trace")]
+    if not traced:
+        return {}
+    t0 = time.perf_counter()
+    synced = False
+    if out is not None:
+        from ..obs.profile import _block_on
+        for col in (getattr(out, "columns", None) or ()):
+            try:
+                if _block_on(out[col]):
+                    synced = True
+            except Exception:
+                pass
+    device_s = time.perf_counter() - t0
+    spans_by_id: dict[str, list[dict]] = {}
+    for i in traced:
+        tr = i["trace"]
+        parent = TraceContext(trace_id=str(tr.get("trace_id", "")),
+                              span_id=str(tr.get("span_id", "")))
+        wspan = _tracer.emit_span(
+            "worker.execute", parent=parent, seconds=execute_s,
+            worker=wid, service=service, rows=len(items))
+        dspan = _tracer.emit_span(
+            "worker.device", parent=wspan, seconds=device_s,
+            worker=wid, synced=synced)
+        spans_by_id[str(i["id"])] = [wspan.to_dict(), dspan.to_dict()]
+    return spans_by_id
+
+
 # ---------------------------------------------------------------- pull loop
 class _PeerConnections:
     """Persistent keep-alive connections, one per ingest server — the
@@ -740,6 +800,7 @@ def remote_worker_loop(driver_address, service_name: str, transform_fn,
                 reqs = np.empty(len(items), object)
                 ids[:] = [i["id"] for i in items]
                 reqs[:] = [_req_from_json(i["request"]) for i in items]
+                t0 = time.perf_counter()
                 try:
                     out = transform_fn(
                         DataFrame({"id": ids, "request": reqs}))
@@ -750,13 +811,21 @@ def remote_worker_loop(driver_address, service_name: str, transform_fn,
                                  out, "columns", []) else [])
                 except Exception:
                     continue  # lease expiry will replay the batch
+                spans_by_id = _worker_spans(
+                    items, wid, service_name,
+                    time.perf_counter() - t0, out)
                 for rid, reply in pairs:
                     try:
                         conns.post(info.host, info.port,
                                    f"{base}/__reply__",
                                    {"id": rid,
                                     "response": _resp_to_json(reply),
-                                    "secret": mesh_secret})
+                                    "secret": mesh_secret,
+                                    # this worker's spans for THIS
+                                    # request ride home with the reply,
+                                    # completing the ingest server's
+                                    # cross-process tree
+                                    "spans": spans_by_id.get(rid, [])})
                     except Exception:
                         pass
             if got:

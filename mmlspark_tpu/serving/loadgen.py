@@ -15,6 +15,12 @@ off as told, re-offer once). Retried requests come back with status
 ``retried`` / ``retried_ok`` columns — retry traffic never blends into
 the first-offer percentiles.
 
+Trace correlation (obs subsystem): every request carries a
+DETERMINISTIC traceparent — trace id ``<prefix><conn:4hex><req:8hex>``
+— so the summary can reconstruct the trace ids of the p99-slowest
+requests (``slowest`` column) and a bench outlier becomes a lookup key
+into the server's flight recorder (``GET /debug/trace``).
+
 No reference counterpart — the reference's serving perf narrative
 (``docs/mmlspark-serving.md``) relied on external load tooling.
 """
@@ -22,6 +28,7 @@ No reference counterpart — the reference's serving perf narrative
 from __future__ import annotations
 
 import ctypes
+import uuid
 
 import numpy as np
 
@@ -34,8 +41,33 @@ _loader = NativeLoader("loadgen", ["loadgen.cpp"])
 _RETRIED_BASE = 1000
 
 
+def trace_id_of(trace_prefix: str, conn: int, req: int) -> str:
+    """The trace id loadgen.cpp stamped on request ``req`` of
+    connection ``conn`` (the reconstruction contract both sides share)."""
+    return f"{trace_prefix}{conn:04x}{req:08x}"
+
+
+def _slowest_trace_ids(steady_lat: np.ndarray, ok: np.ndarray,
+                       warmup_offset: int, trace_prefix: str,
+                       top: int = 8) -> list[dict]:
+    """Trace ids of the p99-slowest first-offer successes (at least the
+    single slowest), slowest first — the flight-recorder lookup keys."""
+    ci, ri = np.nonzero(ok)
+    if not len(ci):
+        return []
+    lats = steady_lat[ci, ri]
+    thr = float(np.percentile(lats, 99))
+    order = np.argsort(-lats)
+    picks = [j for j in order if lats[j] >= thr][:top] \
+        or [int(order[0])]
+    return [{"trace_id": trace_id_of(trace_prefix, int(ci[j]),
+                                     int(ri[j]) + warmup_offset),
+             "ms": round(float(lats[j]), 3)}
+            for j in picks]
+
+
 def summarize(lat: np.ndarray, status: np.ndarray, wall_s: float,
-              warmup: int = 20) -> dict:
+              warmup: int = 20, trace_prefix: str | None = None) -> dict:
     """Shape raw per-request ``(latency_ms, http_status)`` matrices
     (connection-major ``[nconn, nreq]``; status -1 = transport failure,
     status >= 1000 = answered on a Retry-After re-attempt) into the
@@ -77,7 +109,10 @@ def summarize(lat: np.ndarray, status: np.ndarray, wall_s: float,
     # re-attempt (1429) is a shed — excluding it would understate
     # shed_rate exactly when shedding is heaviest
     shed = int((final == 429).sum())
+    slowest = [] if trace_prefix is None else _slowest_trace_ids(
+        steady_lat, ok, warmup if nreq > warmup else 0, trace_prefix)
     return {
+        "slowest": slowest,
         "p50_ms": float(np.percentile(ok_lat, 50)),
         "p99_ms": float(np.percentile(ok_lat, 99)),
         "loaded_p99_ms": max(per_conn_p99),
@@ -95,27 +130,36 @@ def summarize(lat: np.ndarray, status: np.ndarray, wall_s: float,
 
 def run_load(host: str, port: int, payload: bytes, *, nconn: int = 16,
              nreq: int = 300, path: str = "/",
-             warmup: int = 20, retry: bool = False) -> dict:
+             warmup: int = 20, retry: bool = False,
+             trace: bool = True) -> dict:
     """Closed-loop load: ``nconn`` keep-alive connections, ``nreq``
     serial POSTs each; see :func:`summarize` for the returned summary
     (success-only percentiles; 429 sheds and other non-2xx reported
     separately with ``shed_rate``). ``retry=True`` honors Retry-After
     on 429/503 with one bounded re-attempt per request, reported under
-    ``retried``/``retried_ok``. Raises when nothing could connect."""
+    ``retried``/``retried_ok``. ``trace=True`` (default) stamps every
+    request with a deterministic traceparent and reports the
+    p99-slowest requests' trace ids under ``slowest`` — look them up at
+    the server's ``GET /debug/trace``. Raises when nothing could
+    connect."""
     lib = _loader.load()
-    lib.lg_run3.restype = ctypes.c_long
-    lib.lg_run3.argtypes = [
+    # 20 hex prefix + 4 (conn) + 8 (req) = a 32-hex W3C-shaped trace id
+    trace_prefix = uuid.uuid4().hex[:20] if trace else None
+    lib.lg_run4.restype = ctypes.c_long
+    lib.lg_run4.argtypes = [
         ctypes.c_char_p, ctypes.c_int, ctypes.c_int, ctypes.c_long,
         ctypes.c_char_p, ctypes.c_char_p, ctypes.c_long, ctypes.c_int,
+        ctypes.c_char_p,
         ctypes.POINTER(ctypes.c_double),
         ctypes.POINTER(ctypes.c_int),
         ctypes.POINTER(ctypes.c_double)]
     lat = np.empty(nconn * nreq, np.float64)
     status = np.empty(nconn * nreq, np.int32)
     wall = ctypes.c_double(0.0)
-    errors = int(lib.lg_run3(
+    errors = int(lib.lg_run4(
         host.encode(), int(port), int(nconn), int(nreq), path.encode(),
         payload, len(payload), 1 if retry else 0,
+        (trace_prefix or "").encode(),
         lat.ctypes.data_as(ctypes.POINTER(ctypes.c_double)),
         status.ctypes.data_as(ctypes.POINTER(ctypes.c_int)),
         ctypes.byref(wall)))
@@ -124,4 +168,4 @@ def run_load(host: str, port: int, payload: bytes, *, nconn: int = 16,
                            "established")
     return summarize(lat.reshape(nconn, nreq),
                      status.reshape(nconn, nreq), wall.value,
-                     warmup=warmup)
+                     warmup=warmup, trace_prefix=trace_prefix)

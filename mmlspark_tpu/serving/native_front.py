@@ -68,10 +68,14 @@ class _NativeCachedRequest(CachedRequest):
                           blob, body, len(body))
         srv.history.pop(self.id, None)
         # same per-route series the threaded front records (obs
-        # subsystem); latency runs intake → reply
+        # subsystem); latency runs intake → reply. The request span
+        # closes here too — reply() is this front's single exit, on
+        # whichever thread delivered the answer (executor, mesh reply
+        # hop, or the poller's 504 sweep).
         srv._observe_request(srv.api_path,
                              int(response.status_code or 500),
                              time.perf_counter() - self.created)
+        srv._finish_request(self, int(response.status_code or 500))
         return True
 
 
@@ -204,6 +208,9 @@ class NativeServingServer(ServingServer):
             entity=body or None)
         cached = _NativeCachedRequest(
             id=self._new_id(), request=req, server=self, native_id=nid)
+        # span opens before admission (same ordering as the threaded
+        # front); reply() closes it on every exit path
+        self._start_request_span(cached, path)
         with self._lock:
             self.history[cached.id] = cached
             self._deadlines.append((now + self.reply_timeout, cached))

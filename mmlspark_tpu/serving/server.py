@@ -29,8 +29,12 @@ import logging
 from ..core import DataFrame
 from ..io.http.schema import HTTPRequestData, HTTPResponseData
 from ..obs import registry as _obs
+from ..obs.export import debug_trace_payload, flight_recorder as _flight
+from ..obs.profile import feature_log as _features
+from ..obs.propagation import extract as _extract
 from ..obs.tracing import tracer as _tracer
 from ..sched import RequestScheduler, Shed
+from ..sched.policy import bucket_of
 
 _LOG = logging.getLogger("mmlspark_tpu.serving")
 
@@ -129,6 +133,10 @@ class CachedRequest:
     # in-flight release here
     on_done: object = None
     abandoned: bool = False
+    # the request's span in the cross-process trace (obs subsystem) and
+    # the queue wait the scheduler stamped at pop — both None until set
+    span: object = None
+    queue_wait: float | None = None
     _lock: threading.Lock = field(default_factory=threading.Lock)
 
     def reply(self, response: HTTPResponseData) -> bool:
@@ -224,12 +232,62 @@ class ServingServer:
         self._routes["/metrics"] = self._metrics_route
         if self.api_path != "/":
             self._routes[f"{self.api_path}/metrics"] = self._metrics_route
+        # flight recorder + trace debug surface (obs subsystem): the
+        # recorder collects every span once installed; requests report
+        # their outcome through _finish_request so the N slowest /
+        # errored keep their full cross-process trees, served at
+        # GET /debug/trace by BOTH fronts (shared route table)
+        _flight.install()
+        self._routes["/debug/trace"] = self._debug_trace_route
+        if self.api_path != "/":
+            self._routes[f"{self.api_path}/debug/trace"] = \
+                self._debug_trace_route
 
     def _metrics_route(self, body: bytes) -> tuple[int, bytes]:
         """``GET /metrics``: Prometheus text exposition of the
         process-wide registry (every subsystem's series, not just this
         server's — one scrape surface per process)."""
         return 200, _obs.exposition().encode()
+
+    def _debug_trace_route(self, body: bytes) -> tuple[int, bytes]:
+        """``GET /debug/trace``: the flight recorder's retained span
+        trees (slowest + errored requests) as Chrome-trace/Perfetto
+        JSON with per-trace summaries — save as ``.json``, open in
+        Perfetto, find the trace_id the load generator printed."""
+        return 200, debug_trace_payload()
+
+    def _start_request_span(self, cached: "CachedRequest",
+                            route: str) -> None:
+        """Open the request's span: parented into the CLIENT's trace
+        when the request carries a traceparent header (the HTTP client
+        stack injects one), a fresh root otherwise. ``current=False``:
+        handler/poller threads serve many requests concurrently, so the
+        ambient context must stay untouched — children name this span
+        explicitly (scheduler queue spans, executor execute spans)."""
+        ctx = _extract(cached.request.headers)
+        cached.span = _tracer.start_span(
+            "serving.request", parent=ctx, current=False,
+            service=self.name, route=route, worker=self._worker_label())
+
+    def _worker_label(self) -> str:
+        """Distributed mode overrides identity via worker_id; the
+        single-process server labels spans with its service name."""
+        return getattr(self, "worker_id", "") or self.name
+
+    def _finish_request(self, cached: "CachedRequest",
+                        status: int) -> None:
+        """Close the request span and report the outcome to the flight
+        recorder (which decides whether the tree is retained). ONE site
+        for both fronts; idempotent via end_span's done-latch."""
+        span = cached.span
+        if span is None:
+            return
+        already = getattr(span, "_done", False)
+        span.set_attr("status", int(status))
+        _tracer.end_span(span)
+        if not already:
+            _flight.note_request(span.trace_id, span.seconds or 0.0,
+                                 status=int(status))
 
     def _observe_request(self, route: str, status: int,
                          seconds: float) -> None:
@@ -337,6 +395,9 @@ class ServingServer:
                     url=self.path, method=self.command,
                     headers=dict(self.headers.items()), entity=body)
                 cached = CachedRequest(id=serving._new_id(), request=req)
+                # span opens BEFORE admission so a queue span (and the
+                # shed outcome) lands inside the request's trace
+                serving._start_request_span(cached, path)
                 with serving._lock:
                     serving.history[cached.id] = cached
                 try:
@@ -347,6 +408,7 @@ class ServingServer:
                     # both carry Retry-After sized to the predicted drain
                     with serving._lock:
                         serving.history.pop(cached.id, None)
+                    serving._finish_request(cached, s.status)
                     self.send_response(s.status)
                     self.send_header("Retry-After", str(s.retry_after))
                     self.send_header("Content-Length", "0")
@@ -355,6 +417,7 @@ class ServingServer:
                 resp = cached.wait(serving.reply_timeout)
                 with serving._lock:
                     serving.history.pop(cached.id, None)
+                serving._finish_request(cached, resp.status_code or 500)
                 try:
                     self.send_response(resp.status_code or 500)
                     body = resp.entity or b""
@@ -472,6 +535,32 @@ class ServingQuery:
     def await_termination(self, timeout: float | None = None):
         self._thread.join(timeout)
 
+    def _annotate_batch(self, batch, execute_s: float) -> None:
+        """Per-request trace + cost-model bookkeeping for one executed
+        batch (obs subsystem): a ``serving.execute`` child span under
+        each request's span (the whole batch's transform time — the
+        latency each rider actually paid), and one feature-log record
+        per request (route, batch/bucket, queue/execute ms, entity
+        bytes) — the learned scheduler model's training rows."""
+        n = len(batch)
+        bucket = bucket_of(n)
+        for c in batch:
+            sp = getattr(c, "span", None)
+            if sp is not None:
+                _tracer.emit_span("serving.execute", parent=sp,
+                                  seconds=execute_s, service=self.name,
+                                  rows=n)
+            _features.record(
+                service=self.name,
+                route=getattr(c, "route", "/"),
+                batch=n, bucket=bucket,
+                queue_ms=round((getattr(c, "queue_wait", None) or 0.0)
+                               * 1e3, 4),
+                execute_ms=round(execute_s * 1e3, 4),
+                entity_bytes=len(getattr(c.request, "entity", b"")
+                                 or b""),
+                trace_id=(sp.trace_id if sp is not None else None))
+
     def _run(self):
         batch_rows = _obs.histogram(
             "serving_batch_rows", "requests per executor batch",
@@ -514,6 +603,7 @@ class ServingQuery:
                 # close decision read back
                 self.server.scheduler.estimator.observe(
                     len(batch), bt.seconds)
+                self._annotate_batch(batch, bt.seconds)
                 if out is not None and "reply" in getattr(
                         out, "columns", []):
                     by_id = {c.id: c for c in batch}

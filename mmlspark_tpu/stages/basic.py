@@ -7,8 +7,6 @@ caching, timing.
 
 from __future__ import annotations
 
-import time
-
 import numpy as np
 
 from ..core import Transformer, Param, TypeConverters as TC, UDFParam
@@ -119,8 +117,21 @@ class Explode(Transformer, HasInputCol, HasOutputCol):
 class Timer(Transformer):
     """Wrap a stage and log its wall time (reference ``stages/Timer.scala``).
 
-    The measured duration is recorded on ``lastDuration`` and logged through
-    the telemetry channel.
+    The measured duration is recorded on ``lastDuration`` and logged
+    through the telemetry channel. Measurement runs through the obs
+    :class:`~mmlspark_tpu.obs.profile.StepProfiler`, so a timed stage
+    also lands in the ``profile_step_seconds`` host-dispatch vs
+    device-execute split and emits dispatch/device child spans under
+    the ambient trace — one timing surface, not a private stopwatch.
+
+    DELIBERATE semantic point: Timer now syncs the wrapped stage's
+    output (``block_until_ready``) before stopping the clock. The old
+    stopwatch measured only dispatch, which for a device-backed stage
+    under JAX's async dispatch reported near-zero — the one number a
+    user wrapping a stage in Timer explicitly asked NOT to get. The
+    sync costs the measured stage its dispatch overlap; that is what
+    measuring completion means. Un-timed pipelines are untouched
+    (``PipelineModel`` profiles only behind an explicit opt-in).
     """
 
     from ..core.param import StageParam as _SP
@@ -131,15 +142,17 @@ class Timer(Transformer):
     lastDuration: float | None = None
 
     def _transform(self, df):
-        inner = self.get("stage")
-        start = time.perf_counter()
         from ..core import Estimator
-        if isinstance(inner, Estimator):
-            fitted = inner.fit(df)
-            out = fitted.transform(df)
-        else:
-            out = inner.transform(df)
-        self.lastDuration = time.perf_counter() - start
+        from ..obs.profile import step_profiler
+        inner = self.get("stage")
+        with step_profiler.step(type(inner).__name__) as h:
+            if isinstance(inner, Estimator):
+                fitted = inner.fit(df)
+                out = fitted.transform(df)
+            else:
+                out = inner.transform(df)
+            h.done(out)
+        self.lastDuration = h.seconds
         self._log_event("timer", stage=type(inner).__name__,
                         seconds=self.lastDuration)
         return out

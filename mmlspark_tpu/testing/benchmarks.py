@@ -109,7 +109,7 @@ class _SynthRequest:
     decorates (route/deadline/on_done)."""
 
     __slots__ = ("submitted", "done_at", "status", "route", "deadline",
-                 "on_done", "_event")
+                 "on_done", "span", "queue_wait", "_event")
 
     def __init__(self):
         self.submitted = time.monotonic()
@@ -118,6 +118,8 @@ class _SynthRequest:
         self.route = "/"
         self.deadline = None
         self.on_done = None
+        self.span = None        # request span (tracing scenarios)
+        self.queue_wait = None  # stamped by the scheduler at pop
         self._event = threading.Event()
 
     def reply(self, status: int) -> bool:
@@ -235,13 +237,145 @@ def overload_scenario(*, service: str = "overload-bench",
     }
 
 
+def tracing_overhead_scenario(*, service: str = "tracing-bench",
+                              n_requests: int = 200,
+                              item_service_s: float = 0.005,
+                              max_batch: int = 8,
+                              reps: int = 3,
+                              registry=None) -> dict:
+    """Profiler-overhead guard (ISSUE 8 satellite): the same synthetic
+    serving pipeline (RequestScheduler + deterministic executor — no
+    HTTP socket, so loopback jitter cannot masquerade as tracing cost)
+    measured with the full tracing+profiler stack OFF vs ON, asserting
+    the instrumented p99 stays within 5%% of bare.
+
+    ON means everything a traced serving request pays: a request span
+    per item, the scheduler's ``sched.queue`` child span, a retroactive
+    execute span, a cost-model feature-log record, a ``StepProfiler``
+    step around each executor batch, and a flight-recorder
+    ``note_request`` per reply. The modes run INTERLEAVED (off, on,
+    off, on, ...) and each mode keeps its best-of-``reps`` p99 — the
+    same min-of-runs discipline bench.py's loaded rows use: the
+    per-rep minimum is the deterministic floor (service time + any
+    instrumentation cost), so host contention and sleep jitter — which
+    hit both modes but not symmetrically within one rep — cannot
+    manufacture or mask overhead. Returns both p99s, ``overhead_pct``,
+    and ``within_bound`` (the 5%% contract — asserted by the test AND
+    banked in the bench JSON).
+    """
+    from ..obs.export import flight_recorder
+    from ..obs.profile import StepProfiler, feature_log
+    from ..obs.metrics import registry as _default
+    from ..obs.tracing import tracer
+    from ..sched import RequestScheduler
+
+    reg = registry if registry is not None else _default
+    profiler = StepProfiler(service=service, registry=reg)
+    flight_recorder.install()
+
+    def one_run(traced: bool) -> float:
+        sched = RequestScheduler(f"{service}-{'on' if traced else 'off'}",
+                                 registry=reg)
+        done: list[_SynthRequest] = []
+        stop = threading.Event()
+
+        def executor():
+            while not stop.is_set() or sched.qsize():
+                batch = sched.next_batch(max_batch=max_batch,
+                                         max_wait=0.05)
+                if not batch:
+                    continue
+                if traced:
+                    with profiler.step("tracing-bench.batch") as h:
+                        time.sleep(item_service_s * len(batch))
+                        h.done(None)
+                else:
+                    time.sleep(item_service_s * len(batch))
+                for item in batch:
+                    span = getattr(item, "span", None)
+                    if span is not None:
+                        tracer.emit_span(
+                            "serving.execute", parent=span,
+                            seconds=item_service_s * len(batch),
+                            service=service, rows=len(batch))
+                        feature_log.record(
+                            service=service, route="/",
+                            batch=len(batch),
+                            queue_ms=(getattr(item, "queue_wait", 0.0)
+                                      or 0.0) * 1e3,
+                            execute_ms=item_service_s * len(batch)
+                            * 1e3, trace_id=span.trace_id)
+                    item.reply(200)
+                    if span is not None:
+                        span.set_attr("status", 200)
+                        tracer.end_span(span)
+                        flight_recorder.note_request(
+                            span.trace_id,
+                            time.monotonic() - item.submitted,
+                            status=200)
+                    done.append(item)
+
+        worker = threading.Thread(target=executor, daemon=True)
+        worker.start()
+        # pace BELOW saturation: the executor's cost is linear in batch
+        # size here, so an overloaded run would measure queue growth —
+        # the one thing that is NOT tracing overhead — in both modes
+        interval = item_service_s * 1.5
+        for _ in range(n_requests):
+            req = _SynthRequest()
+            if traced:
+                req.span = tracer.start_span(
+                    "serving.request", parent=None, current=False,
+                    service=service, route="/")
+            try:
+                sched.submit(req)
+            except Exception:
+                req.reply(503)
+            time.sleep(interval)
+        stop.set()
+        sched.wake()
+        worker.join(timeout=20)
+        lat = sorted((r.done_at - r.submitted) for r in done
+                     if r.done_at is not None and r.status == 200)
+        if not lat:
+            return float("nan")
+        return lat[max(_ceil(0.99 * len(lat)) - 1, 0)]
+
+    offs, ons = [], []
+    for _ in range(reps):
+        offs.append(one_run(False))
+        ons.append(one_run(True))
+    p99_off, p99_on = min(offs), min(ons)
+    overhead_pct = (p99_on - p99_off) / p99_off * 100.0
+    return {
+        "n_requests": n_requests,
+        "item_service_s": item_service_s,
+        "reps": reps,
+        "p99_off_s": p99_off,
+        "p99_on_s": p99_on,
+        "overhead_pct": overhead_pct,
+        "bound_pct": 5.0,
+        "within_bound": overhead_pct <= 5.0,
+        "feature_records": len(feature_log),
+    }
+
+
+# span names a COMPLETE cross-process tree must contain for a request
+# answered through the worker mesh (chaos acceptance): the driver-side
+# request root + its queue wait, and the compute worker's execute +
+# device spans, all under one trace id
+COMPLETE_TRACE_SPANS = frozenset({"serving.request", "sched.queue",
+                                  "worker.execute", "worker.device"})
+
+
 def chaos_scenario(*, service: str = "chaos-bench", seed: int = 11,
                    n_requests: int = 40, n_workers: int = 3,
                    error_rate: float = 0.05,
                    latency_spike_s: float = 0.05,
                    latency_rate: float = 0.05,
                    kill_after_leases: int = 1,
-                   request_timeout_s: float = 10.0) -> dict:
+                   request_timeout_s: float = 10.0,
+                   trace_dir: str | None = None) -> dict:
     """Seeded chaos acceptance for the resilience subsystem (ISSUE 4):
     a real worker mesh (driver registry with heartbeat liveness, one
     ingest server, ``n_workers`` in-thread compute workers) driven under
@@ -263,11 +397,24 @@ def chaos_scenario(*, service: str = "chaos-bench", seed: int = 11,
 
     Fault decisions are per-point deterministic; the client runs
     single-threaded so the realized schedule is also totally ordered.
+
+    Tracing (ISSUE 8 acceptance): every client request runs under a
+    ``client.request`` root span, so the whole run is cross-process
+    traced — the result reports, per answered request, whether its span
+    tree is COMPLETE (:data:`COMPLETE_TRACE_SPANS` under one trace id)
+    and samples one such tree; ``trace_dir`` additionally exports the
+    collected spans as Chrome-trace/Perfetto JSON
+    (``<trace_dir>/chaos_trace.json``).
     """
+    import json as _json
+    import os as _os
+
     import numpy as np
 
     from ..io.http.clients import send_request
     from ..io.http.schema import HTTPRequestData, HTTPResponseData
+    from ..obs.export import SpanCollector, chrome_trace
+    from ..obs.tracing import tracer
     from ..resilience import FaultRule, RetryPolicy, faults
     from ..serving import (DistributedServingServer, DriverRegistry,
                            remote_worker_loop)
@@ -302,16 +449,22 @@ def chaos_scenario(*, service: str = "chaos-bench", seed: int = 11,
     policy = RetryPolicy(seed=seed, base_delay=0.02, max_delay=0.5,
                          max_attempts=5)
     statuses: list[int] = []
+    trace_ids: list[str] = []
     url = f"http://{server.address[0]}:{server.address[1]}/"
     try:
-        with faults(seed, rules) as inj:
+        with SpanCollector() as collector, faults(seed, rules) as inj:
             for w in workers:
                 w.start()
             for i in range(n_requests):
-                resp = send_request(
-                    HTTPRequestData(url=url, method="POST", headers={},
-                                    entity=f"req-{i}".encode()),
-                    timeout=request_timeout_s, policy=policy)
+                # client-side root span: the trace id every downstream
+                # hop (ingest, lease, worker, reply) joins
+                with tracer.span("client.request", i=i) as sp:
+                    trace_ids.append(sp.trace_id)
+                    resp = send_request(
+                        HTTPRequestData(url=url, method="POST",
+                                        headers={},
+                                        entity=f"req-{i}".encode()),
+                        timeout=request_timeout_s, policy=policy)
                 statuses.append(resp.status_code)
             schedule = inj.schedule()
     finally:
@@ -320,6 +473,27 @@ def chaos_scenario(*, service: str = "chaos-bench", seed: int = 11,
             w.join(timeout=5)
         server.stop()
         driver.stop()
+    # span-tree completeness per answered request (trace acceptance)
+    names = collector.names_by_trace()
+    answered_trees = {t: sorted(n for n in names.get(t, set()) if n)
+                      for t, s in zip(trace_ids, statuses)
+                      if 200 <= s < 300}
+    complete = {t for t, ns in answered_trees.items()
+                if COMPLETE_TRACE_SPANS <= set(ns)}
+    sampled = None
+    trace_path = None
+    if complete:
+        sample_id = sorted(complete)[0]
+        sampled = {"trace_id": sample_id,
+                   "spans": answered_trees[sample_id]}
+        if trace_dir is not None:
+            spans = [d for d in collector.spans()
+                     if d.get("traceId") in complete]
+            trace_path = _os.path.join(trace_dir, "chaos_trace.json")
+            with open(trace_path, "w") as f:
+                _json.dump(chrome_trace(spans, extra_metadata={
+                    "scenario": "chaos", "seed": seed,
+                    "sampled_trace_id": sample_id}), f)
     snap = _registry.snapshot()
 
     def _delta(prefix: str) -> float:
@@ -332,6 +506,10 @@ def chaos_scenario(*, service: str = "chaos-bench", seed: int = 11,
         "offered": n_requests,
         "answered_200": answered,
         "policy_sheds": policy_sheds,
+        "answered_traces": len(answered_trees),
+        "complete_traces": len(complete),
+        "sampled_trace": sampled,
+        "trace_path": trace_path,
         "transport_errors": sum(1 for s in statuses if s == 0),
         "non_policy_errors": sum(
             1 for s in statuses

@@ -1,5 +1,12 @@
-"""Cross-cutting utilities: profiling/tracing."""
+"""Cross-cutting utilities: profiling/tracing.
 
-from .profiling import profile_trace, profiled, StageTimer
+The timing/profiling surface itself lives in ``mmlspark_tpu.obs`` (one
+registry + tracer + profiler for every layer); these re-exports keep the
+historic ``mmlspark_tpu.utils`` import path working without routing
+through the deprecated ``utils.profiling`` shim module.
+"""
+
+from ..obs.profile import profile_trace, profiled
+from ..obs.tracing import StageTimer
 
 __all__ = ["profile_trace", "profiled", "StageTimer"]

@@ -1,44 +1,24 @@
-"""Profiling: device traces (XProf) + trace annotations.
+"""DEPRECATED shim — the profiling surface moved to ``mmlspark_tpu.obs``.
 
-The reference has no tracer (SURVEY §5) — only the ``Timer`` transformer
-and VW's nanosecond stopwatches. The TPU build upgrades this to
-``jax.profiler`` device traces (viewable in XProf/TensorBoard); the
-host-side span/timing surface lives in ``mmlspark_tpu.obs`` (one
-registry + tracer for every layer — see docs/observability.md).
-``StageTimer`` is re-exported from there: same ``span``/``as_dict``
-contract, now nesting into the process-wide trace as well.
+PR 1 left this module as the XProf half of a split timing story; the
+continuous profiler (``obs/profile.py``) subsumed it: ``profile_trace``
+and ``profiled`` live there (unchanged contracts), ``StageTimer`` in
+``obs.tracing``, and the new always-on surfaces (``CompileTracker``,
+``StepProfiler``, the cost-model feature log) have no equivalent here.
+
+Importing from this module keeps working but warns once; update imports
+to ``mmlspark_tpu.obs.profile`` / ``mmlspark_tpu.obs``.
 """
 
 from __future__ import annotations
 
-import contextlib
-import functools
+import warnings
 
+from ..obs.profile import profile_trace, profiled  # noqa: F401
 from ..obs.tracing import StageTimer  # noqa: F401  (compat re-export)
 
-
-@contextlib.contextmanager
-def profile_trace(log_dir: str, *, host_tracer_level: int = 2):
-    """Capture a device+host trace for the enclosed region
-    (``jax.profiler.trace`` wrapper; open with XProf/TensorBoard)."""
-    import jax
-    jax.profiler.start_trace(log_dir, create_perfetto_link=False)
-    try:
-        yield
-    finally:
-        jax.profiler.stop_trace()
-
-
-def profiled(name: str | None = None):
-    """Decorator: annotate a function in device traces
-    (``jax.profiler.TraceAnnotation``) and record wall time."""
-    def wrap(fn):
-        label = name or fn.__qualname__
-
-        @functools.wraps(fn)
-        def inner(*args, **kwargs):
-            import jax
-            with jax.profiler.TraceAnnotation(label):
-                return fn(*args, **kwargs)
-        return inner
-    return wrap
+warnings.warn(
+    "mmlspark_tpu.utils.profiling is deprecated: profile_trace/profiled "
+    "moved to mmlspark_tpu.obs.profile (StageTimer to mmlspark_tpu.obs); "
+    "this shim will be removed once in-repo callers are migrated",
+    DeprecationWarning, stacklevel=2)

@@ -294,6 +294,103 @@ class TestInProcessWorkerLoop:
                 s.stop()
 
 
+class TestTracePropagation:
+    """ISSUE 8: one request → ONE cross-process span tree. The client's
+    span rides the traceparent header to the ingest server (HTTP hop),
+    the lease carries it to a REAL subprocess worker, and the worker's
+    spans ride the reply payload home into the driver's flight
+    recorder."""
+
+    @pytest.mark.parametrize("server_cls", _front_params())
+    def test_driver_worker_reply_tree(self, driver, server_cls):
+        from mmlspark_tpu.io.http.clients import send_request
+        from mmlspark_tpu.io.http.schema import HTTPRequestData
+        from mmlspark_tpu.obs import flight_recorder, tracer
+        from mmlspark_tpu.obs.tracing import _PROC
+
+        svc = f"trsvc-{server_cls.__name__}"
+        server = server_cls(svc, driver.address,
+                            lease_timeout=10.0).start()
+        worker = _spawn_worker(driver.address, svc, "echo")
+        try:
+            url = f"http://{server.address[0]}:{server.address[1]}/"
+            with tracer.span("client.request") as client_span:
+                tid = client_span.trace_id
+                resp = send_request(
+                    HTTPRequestData(url=url, method="POST", headers={},
+                                    entity=b"trace me"),
+                    timeout=30)
+            assert resp.status_code == 200
+        finally:
+            worker.kill()
+            worker.wait()
+            server.stop()
+        tree = flight_recorder.tree(tid)
+        assert tree is not None, "request's trace not in the recorder"
+        by_id = {s["spanId"]: s for s in tree["spans"]}
+        names = {s["name"] for s in tree["spans"]}
+        assert {"http.send", "serving.request", "sched.queue",
+                "worker.execute", "worker.device"} <= names, names
+        # HTTP hop: the server's request span parents into the
+        # CLIENT's trace through the traceparent header round-trip
+        (req_span,) = [s for s in tree["spans"]
+                       if s["name"] == "serving.request"]
+        assert by_id[req_span["parentId"]]["name"] == "http.send"
+        assert req_span["attrs"]["status"] == 200
+        # mesh hop: the worker's spans hang under the request span and
+        # really came from the OTHER process
+        (wex,) = [s for s in tree["spans"]
+                  if s["name"] == "worker.execute"]
+        assert wex["parentId"] == req_span["spanId"]
+        assert wex["proc"] and wex["proc"] != _PROC
+        (wdev,) = [s for s in tree["spans"]
+                   if s["name"] == "worker.device"]
+        assert wdev["parentId"] == wex["spanId"]
+        # queue wait is the driver's: same process as the request span
+        (qspan,) = [s for s in tree["spans"]
+                    if s["name"] == "sched.queue"]
+        assert qspan["parentId"] == req_span["spanId"]
+        assert qspan["proc"] == _PROC
+
+    def test_lease_payload_carries_trace_context(self, driver):
+        """The __lease__ wire format: an item leased for a traced
+        request carries {trace_id, span_id}; untraced items carry no
+        trace key (old workers keep parsing)."""
+        import json as _json
+
+        server = DistributedServingServer("lsvc", driver.address).start()
+        try:
+            got = {}
+
+            def client():
+                got["resp"] = _post(server.address, b"traced-lease")
+
+            t = threading.Thread(target=client)
+            t.start()
+            deadline = time.monotonic() + 10
+            while server.queue.empty() and time.monotonic() < deadline:
+                time.sleep(0.01)
+            # direct lease pull (we play the worker)
+            conn = http.client.HTTPConnection(*server.address,
+                                              timeout=5)
+            conn.request("POST", "/__lease__", body=b'{"max": 4}')
+            items = _json.loads(conn.getresponse().read())
+            conn.close()
+            assert items, "nothing leased"
+            entry = items[0]
+            assert "trace" in entry
+            cached = server._leases[entry["id"]][1]
+            assert entry["trace"]["trace_id"] == cached.span.trace_id
+            assert entry["trace"]["span_id"] == cached.span.span_id
+            # answer it so the client thread finishes
+            server.reply_to(entry["id"], HTTPResponseData(
+                status_code=200, entity=b"done"))
+            t.join(timeout=10)
+            assert got["resp"] == (200, b"done")
+        finally:
+            server.stop()
+
+
 class TestDslDistributed:
     def test_read_stream_distributed_server(self):
         """readStream.distributedServer() loads a registry-backed server
