@@ -1216,6 +1216,34 @@ def bench_observability(extras: dict) -> None:
             c["sampled_trace"]["trace_id"]
 
 
+def bench_elasticity(extras: dict) -> None:
+    """Multi-tenant elasticity acceptance (ISSUE 9): the seeded
+    mixed-workload chaos scenario — three SLO-tiered tenants under
+    diurnal load, one worker kill, one persistent degradation, 5%%
+    injected 503s — banked as per-tenant p99 / shed-rate, utilization,
+    and autoscale event counts, with the contract flags alongside so a
+    regression shows up as a flipped boolean, not a silently drifting
+    number."""
+    from mmlspark_tpu.testing.benchmarks import mixed_tenant_scenario
+
+    r = mixed_tenant_scenario()
+    for name, p in r["per_tenant"].items():
+        extras[f"tenant_{name}_p99_ms"] = round(p["p99_s"] * 1e3, 2)
+        extras[f"tenant_{name}_shed_rate"] = round(p["shed_rate"], 4)
+    extras["tenant_gold_within_slo"] = bool(r["within_gold_slo"])
+    extras["tenant_silver_within_slo"] = bool(r["within_silver_slo"])
+    extras["tenant_be_absorbed_burst"] = bool(r["be_absorbed_burst"])
+    extras["tenant_utilization"] = round(r["utilization"], 3)
+    extras["tenant_lease_replays"] = int(r["lease_replays"])
+    extras["autoscale_ups"] = int(r["autoscale_ups"])
+    extras["autoscale_downs"] = int(r["autoscale_downs"])
+    extras["autoscale_replaces"] = int(r["autoscale_replaces"])
+    extras["autoscale_workers_peak"] = int(r["workers_peak"])
+    extras["autoscale_cooldown_violations"] = \
+        int(r["cooldown_violations"])
+    extras["autoscale_tracked_diurnal"] = bool(r["scaled_with_diurnal"])
+
+
 def bench_serving(extras: dict) -> None:
     """End-to-end HTTP request→jitted pipeline→response latency against
     the reference's ~1 ms continuous-mode figure."""
@@ -1806,6 +1834,10 @@ def main():
             # pure host-side (scheduler + in-thread mesh): tunnel-immune
             _watchdog(bench_observability, extras, "observability",
                       240.0)
+        if want("elasticity"):
+            # pure host-side (synthetic tenants + autoscaled pool):
+            # tunnel-immune like observability
+            _watchdog(bench_elasticity, extras, "elasticity", 240.0)
         if want("serving"):
             # includes a small GBDT fit for the real-model row
             _watchdog(bench_serving, extras, "serving", 360.0)

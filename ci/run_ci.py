@@ -46,6 +46,8 @@ PACKAGES: dict[str, list[str]] = {
     "obs": ["test_obs.py", "test_obs_profile.py"],
     "analysis": ["test_analysis.py"],  # graftcheck passes + gate + clock
     "sched": ["test_sched.py"],  # admission/batching policy + scheduler
+    "tenancy": ["test_tenancy.py"],  # quotas, SLO tiers, fair dispatch
+    "autoscale": ["test_autoscale.py"],  # autoscaler + mixed-tenant chaos
     "resilience": ["test_resilience.py"],  # retry/breaker/faults/chaos
     "parallel": ["test_partition.py"],  # partition rules + pjit steps
     "text": ["test_text_transfer.py", "test_causal_lm.py",
@@ -97,6 +99,40 @@ def style() -> int:
              "assert 'jax' not in sys.modules, 'sched import pulled jax'; "
              "s.RequestScheduler('ci-smoke').submit(type('I', (), {})()); "
              "print('sched import OK (no jax)')")
+    rc = _run([sys.executable, "-c", smoke],
+              env=dict(os.environ, JAX_PLATFORMS="cpu"))
+    if rc:
+        return rc
+    # tenancy (per-tenant quotas + SLO tiers + weighted-fair dispatch)
+    # and the autoscaler are control-plane code: both must import AND
+    # make decisions with no device and no JAX at all — admission runs
+    # from handler threads, the autoscaler from its own control thread
+    smoke = (
+        "import sys\n"
+        "from mmlspark_tpu.sched import Tenancy, TenantQuota, "
+        "RequestScheduler, Shed, GOLD\n"
+        "from mmlspark_tpu.serving.autoscale import Autoscaler, "
+        "AutoscaleConfig, AutoscaleSignals\n"
+        "assert 'jax' not in sys.modules, 'tenancy/autoscale pulled "
+        "jax'\n"
+        "t = Tenancy('ci', quotas={'g': TenantQuota(tier=GOLD, "
+        "rate=1.0, burst=1.0)}, tier_deadlines={GOLD: 0.5})\n"
+        "s = RequestScheduler('ci', tenancy=t)\n"
+        "s.submit(type('I', (), {})(), tenant='g')\n"
+        "try:\n"
+        "    s.submit(type('I', (), {})(), tenant='g')\n"
+        "except Shed as e:\n"
+        "    assert e.status == 429 and e.retry_after >= 1\n"
+        "class P:\n"
+        "    n = 1\n"
+        "    def count(self): return self.n\n"
+        "    def scale_up(self): self.n += 1\n"
+        "    def scale_down(self): self.n -= 1\n"
+        "a = Autoscaler('ci', P(), AutoscaleConfig(up_stable=1))\n"
+        "assert a.tick(AutoscaleSignals(queue_depth=99)) == 'up'\n"
+        "assert 'jax' not in sys.modules, 'tenancy/autoscale pulled "
+        "jax'\n"
+        "print('tenancy+autoscale import OK (no jax)')")
     rc = _run([sys.executable, "-c", smoke],
               env=dict(os.environ, JAX_PLATFORMS="cpu"))
     if rc:

@@ -162,8 +162,9 @@ std::string trace_header(const std::string& prefix, int conn, long req) {
 
 void run_conn(const char* host, int port, const std::string& head,
               const std::string& body, const std::string& trace_prefix,
-              int conn_idx, long nreq, int retry_shed, double* lat_ms,
-              int* status_out, ConnResult* res) {
+              const std::string& tenant_header, int conn_idx, long nreq,
+              int retry_shed, double* lat_ms, int* status_out,
+              ConnResult* res) {
   int fd = connect_to(host, port);
   if (fd < 0) {
     res->hard_fail = true;
@@ -175,11 +176,14 @@ void run_conn(const char* host, int port, const std::string& head,
     return;
   }
   std::string carry;
-  std::string request = head + "\r\n" + body;  // traceless form
+  // the tenant header is fixed PER CONNECTION (lg_run5): one closed
+  // loop = one tenant, so the Python summary can split percentiles and
+  // shed counts per tenant from connection-major matrices alone
+  std::string request = head + tenant_header + "\r\n" + body;
   for (long i = 0; i < nreq; ++i) {
     if (!trace_prefix.empty())
-      request = head + trace_header(trace_prefix, conn_idx, i)
-          + "\r\n" + body;
+      request = head + tenant_header
+          + trace_header(trace_prefix, conn_idx, i) + "\r\n" + body;
     auto t0 = Clock::now();
     int status = -1;
     double retry_after = 0.0;
@@ -253,15 +257,20 @@ extern "C" {
 // re-attempt) so retry traffic is distinguishable from first-offer
 // load. trace_prefix, when non-empty, stamps every request with a
 // deterministic traceparent (<prefix><conn:4hex><req:8hex>) so outliers
-// can be looked up in the server's flight recorder. Returns total
-// non-200/transport errors, or -1 when every connection failed to even
-// connect.
-long lg_run4(const char* host, int port, int nconn, long nreq,
+// can be looked up in the server's flight recorder. tenants, when
+// non-empty, is a comma-separated list: connection c stamps
+// "X-Tenant: <tenants[c % n]>" on every request (one tenant per
+// connection, so the Python summary can split its per-tenant columns
+// from connection-major matrices). Returns total non-200/transport
+// errors, or -1 when every connection failed to even connect.
+long lg_run5(const char* host, int port, int nconn, long nreq,
              const char* path, const unsigned char* body, long body_len,
-             int retry_shed, const char* trace_prefix, double* lat_ms,
-             int* status_out, double* wall_s) {
-  // head stops before the blank line: the per-request traceparent (and
-  // the terminating \r\n) are appended per send
+             int retry_shed, const char* trace_prefix,
+             const char* tenants, double* lat_ms, int* status_out,
+             double* wall_s) {
+  // head stops before the blank line: the per-connection X-Tenant and
+  // per-request traceparent (and the terminating \r\n) are appended
+  // per connection/send
   std::string head;
   head.reserve(256);
   head += "POST ";
@@ -272,6 +281,20 @@ long lg_run4(const char* host, int port, int nconn, long nreq,
   std::string payload(reinterpret_cast<const char*>(body),
                       static_cast<size_t>(body_len));
   std::string prefix(trace_prefix ? trace_prefix : "");
+  std::vector<std::string> tenant_headers;
+  if (tenants && tenants[0]) {
+    std::string list(tenants);
+    size_t pos = 0;
+    while (pos <= list.size()) {
+      size_t comma = list.find(',', pos);
+      if (comma == std::string::npos) comma = list.size();
+      if (comma > pos)
+        tenant_headers.push_back(
+            "X-Tenant: " + list.substr(pos, comma - pos) + "\r\n");
+      pos = comma + 1;
+    }
+  }
+  if (tenant_headers.empty()) tenant_headers.push_back("");
 
   std::vector<ConnResult> results(static_cast<size_t>(nconn));
   std::vector<std::thread> threads;
@@ -279,8 +302,11 @@ long lg_run4(const char* host, int port, int nconn, long nreq,
   auto t0 = Clock::now();
   for (int c = 0; c < nconn; ++c)
     threads.emplace_back(run_conn, host, port, std::cref(head),
-                         std::cref(payload), std::cref(prefix), c, nreq,
-                         retry_shed,
+                         std::cref(payload), std::cref(prefix),
+                         std::cref(tenant_headers[
+                             static_cast<size_t>(c)
+                             % tenant_headers.size()]),
+                         c, nreq, retry_shed,
                          lat_ms + static_cast<long>(c) * nreq,
                          status_out ? status_out
                              + static_cast<long>(c) * nreq : nullptr,
@@ -297,6 +323,16 @@ long lg_run4(const char* host, int port, int nconn, long nreq,
   }
   if (hard == nconn) return -1;
   return errors;
+}
+
+// Back-compat entry point (no per-connection X-Tenant stamping).
+long lg_run4(const char* host, int port, int nconn, long nreq,
+             const char* path, const unsigned char* body, long body_len,
+             int retry_shed, const char* trace_prefix, double* lat_ms,
+             int* status_out, double* wall_s) {
+  return lg_run5(host, port, nconn, nreq, path, body, body_len,
+                 retry_shed, trace_prefix, "", lat_ms, status_out,
+                 wall_s);
 }
 
 // Back-compat entry point (no traceparent stamping).

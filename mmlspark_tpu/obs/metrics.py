@@ -278,6 +278,16 @@ class MetricsRegistry:
                   ) -> Histogram:
         return self._get_or_create(Histogram, name, help, buckets=buckets)
 
+    def metrics(self, prefix: str = "") -> list["_Metric"]:
+        """Registered metric objects whose name starts with ``prefix``
+        — the eviction surface: callers bounding label cardinality
+        (idle-tenant sweeps, mesh churn) iterate these and
+        :meth:`_Metric.remove_matching` the departing identity's
+        series without having to hold references to every metric."""
+        with self._lock:
+            return [m for name, m in self._metrics.items()
+                    if name.startswith(prefix)]
+
     def _collect(self) -> list[tuple["_Metric", dict]]:
         """Value-copy every metric's series under the lock; callers
         render outside it (a scrape must not stall ``inc``/``observe``

@@ -17,6 +17,8 @@ point                   where it fires
                         reporter), once per beat
 ``worker.death``        compute-worker loop, after it takes a non-empty
                         lease (a ``kill`` here strands the batch mid-flight)
+``worker.slow``         compute-worker loops, once per leased batch (a
+                        ``slow`` rule arms the persistent degradation)
 ``checkpoint.write``    ``dl/checkpoint.CheckpointManager.save``, after the
                         temp-dir write, **before** the atomic rename
 ======================  ====================================================
@@ -25,7 +27,14 @@ Fault kinds: ``latency`` (sleep then continue), ``error`` (the hook
 returns/serves an injected HTTP status), ``drop`` (raises
 :class:`InjectedDrop`, an ``OSError`` — existing transport-failure
 handling takes over), ``kill`` (raises :class:`WorkerKilled` — the
-worker loop dies as if SIGKILLed).
+worker loop dies as if SIGKILLed), ``slow`` (arms a PERSISTENT
+per-key service-time multiplier — read back via
+:meth:`FaultInjector.degradation` — modeling a sick-but-alive worker:
+thermal throttling, a noisy neighbor, a failing disk. Distinct from a
+one-shot ``latency`` spike: the degradation stays until the schedule
+is cleared, which is exactly what autoscaling and load-aware routing
+must route around; the ``worker.slow`` point in the compute loops
+probes it once per leased batch).
 
 **Determinism.** Each rule draws from its own RNG stream seeded by
 ``(seed, point, rule index)``, and fires as a pure function of the
@@ -77,7 +86,7 @@ class FaultRule:
     on the probe's key (e.g. a worker id or URL)."""
 
     point: str
-    kind: str                       # latency | error | drop | kill
+    kind: str                       # latency | error | drop | kill | slow
     p: float = 1.0
     after: int = 0
     times: int | None = None
@@ -85,6 +94,7 @@ class FaultRule:
     status: int = 503
     retry_after: float | None = None
     match: str = ""
+    factor: float = 1.0             # slow: persistent service multiplier
 
 
 @dataclass
@@ -96,6 +106,7 @@ class FaultAction:
     latency_s: float = 0.0
     status: int = 503
     retry_after: float | None = None
+    factor: float = 1.0
 
 
 class FaultInjector:
@@ -115,6 +126,7 @@ class FaultInjector:
         self._match_counts: dict[int, int] = {}
         self._fired: dict[int, int] = {}
         self._schedule: list[tuple] = []
+        self._degraded: dict[str, float] = {}
         self._c_injected = None
         self._sleep = time.sleep
 
@@ -137,6 +149,7 @@ class FaultInjector:
             self._match_counts = {}
             self._fired = {}
             self._schedule = []
+            self._degraded = {}
             self._c_injected = self._reg.counter(
                 "resilience_faults_injected_total",
                 "faults fired by the injector, by point and kind")
@@ -148,6 +161,7 @@ class FaultInjector:
             self._armed = False
             self._rules = []
             self._rngs = {}
+            self._degraded = {}
 
     def probe(self, point: str, key: str = "") -> FaultAction | None:
         """Ask whether a fault fires at ``point`` for ``key``. First
@@ -178,7 +192,8 @@ class FaultInjector:
                 return FaultAction(point=point, kind=rule.kind,
                                    latency_s=rule.latency_s,
                                    status=rule.status,
-                                   retry_after=rule.retry_after)
+                                   retry_after=rule.retry_after,
+                                   factor=rule.factor)
         return None
 
     def apply(self, point: str, key: str = "") -> FaultAction | None:
@@ -199,7 +214,25 @@ class FaultInjector:
             raise InjectedDrop(f"injected drop at {point}")
         if act.kind == "kill":
             raise WorkerKilled(f"injected worker death at {point}")
+        if act.kind == "slow":
+            # persistent degradation: the KEY (a worker id) stays slow
+            # until the schedule is cleared — hooks read the multiplier
+            # back via degradation() on every subsequent batch
+            with self._lock:
+                self._degraded[key] = max(act.factor,
+                                          self._degraded.get(key, 1.0))
+            return None
         return act
+
+    def degradation(self, key: str = "") -> float:
+        """The armed service-time multiplier for ``key`` (1.0 = healthy
+        or disarmed). Production hooks multiply their measured service
+        time by this — one dict read when armed, one attribute read
+        when not."""
+        if not self._armed:
+            return 1.0
+        with self._lock:
+            return self._degraded.get(key, 1.0)
 
     def schedule(self) -> list[tuple]:
         """The realized fault schedule so far:

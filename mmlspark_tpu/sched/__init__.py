@@ -15,6 +15,10 @@ The process-wide layer between the HTTP fronts and the model executors:
 - :class:`~.continuous.SlotScheduler` — step-boundary admission for
   continuous generation batching (device half:
   ``dl.generate.ContinuousGenerator``).
+- :class:`~.tenancy.Tenancy` — per-tenant quotas (rate / inflight /
+  queue share), SLO tiers (gold / silver / best-effort deadlines), and
+  the weighted-fair queue the scheduler dispatches from when tenancy
+  is attached (docs/serving.md "Tenancy, SLO tiers & autoscaling").
 
 Import is stdlib + obs only — NO JAX, no HTTP, no device: policy code
 must run anywhere (the CI smoke check asserts the import graph).
@@ -24,7 +28,13 @@ from .continuous import SlotAssignment, SlotScheduler
 from .policy import (AdmissionConfig, AdmissionController, BatchPolicy,
                      ServiceTimeEstimator, Shed, bucket_of)
 from .scheduler import RequestScheduler
+from .tenancy import (BEST_EFFORT, DEFAULT_TENANT, GOLD, SILVER,
+                      Tenancy, TenantQuota, WeightedFairQueue,
+                      clean_tenant)
 
 __all__ = ["AdmissionConfig", "AdmissionController", "BatchPolicy",
            "RequestScheduler", "ServiceTimeEstimator", "Shed",
-           "SlotAssignment", "SlotScheduler", "bucket_of"]
+           "SlotAssignment", "SlotScheduler", "bucket_of",
+           "Tenancy", "TenantQuota", "WeightedFairQueue",
+           "clean_tenant", "DEFAULT_TENANT",
+           "GOLD", "SILVER", "BEST_EFFORT"]

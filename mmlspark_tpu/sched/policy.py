@@ -172,11 +172,15 @@ class AdmissionController:
     """
 
     def __init__(self, service: str, config: AdmissionConfig,
-                 estimator: ServiceTimeEstimator, registry=None):
+                 estimator: ServiceTimeEstimator, registry=None,
+                 tenancy=None):
         reg = registry if registry is not None else _default_registry
         self.service = service
         self.config = config
         self.estimator = estimator
+        # optional per-tenant layer (sched.tenancy.Tenancy): quotas,
+        # tiers, and the WFQ-aware wait estimate below
+        self.tenancy = tenancy
         self._inflight: dict[str, int] = {}
         self._lock = threading.Lock()
         self._c_admitted = reg.counter(
@@ -190,34 +194,62 @@ class AdmissionController:
             "admitted-but-unanswered requests, by service/route")
 
     def try_admit(self, route: str, depth: int,
-                  deadline_budget: float | None = None) -> None:
+                  deadline_budget: float | None = None,
+                  tenant: str = "", tenant_depth: int = 0) -> None:
         """Raise :class:`Shed` unless the request should be queued.
         ``depth`` is the current queue depth; ``deadline_budget`` the
-        request's remaining budget in seconds (None → config default)."""
+        request's remaining budget in seconds (None → config default);
+        ``tenant``/``tenant_depth`` feed the per-tenant gates and the
+        WFQ-aware wait estimate when a tenancy policy is attached."""
         cfg = self.config
         if cfg.max_queue and depth >= cfg.max_queue:
-            self._shed(route, "queue_full", retry_after=1)
+            self._shed(route, "queue_full", retry_after=1, tenant=tenant)
         if cfg.max_inflight:
             with self._lock:
                 cur = self._inflight.get(route, 0)
             if cur >= cfg.max_inflight:
-                self._shed(route, "inflight", retry_after=1)
+                self._shed(route, "inflight", retry_after=1,
+                           tenant=tenant)
         budget = cfg.deadline if deadline_budget is None else deadline_budget
         item_s = self.estimator.item_seconds()
         if budget and item_s:
             # predicted completion = queue drain ahead of us plus our
             # own service — the deadline bounds the whole path, so a
             # request that cannot FINISH in budget is shed at the door
-            predicted = (depth + 1) * item_s
+            ahead = depth + 1
+            if self.tenancy is not None and tenant:
+                # under weighted-fair dispatch a tenant does NOT wait
+                # out the whole queue: by the time its (d_t+1)-th item
+                # dispatches, total dispatches ≈ (d_t+1)/share — so a
+                # gold arrival behind a best-effort backlog is admitted
+                # on ITS predicted wait, not the queue's (capped at the
+                # full-drain estimate; fairness can't make it worse)
+                share = self.tenancy.share_for(tenant)
+                ahead = min((tenant_depth + 1) / max(share, 1e-6),
+                            depth + 1)
+            predicted = ahead * item_s
             if predicted > budget:
                 self._shed(route, "deadline",
-                           retry_after=predicted - budget)
+                           retry_after=predicted - budget,
+                           tenant=tenant)
+        if self.tenancy is not None and tenant:
+            # per-tenant gates LAST, so quota tokens are only consumed
+            # by requests the global gates would actually queue
+            try:
+                self.tenancy.try_admit(tenant, route, tenant_depth,
+                                       cfg.max_queue)
+            except Shed as s:
+                # the tenancy layer counted the per-tenant series; the
+                # global reason-summed series must see the shed too
+                self._c_shed.inc(1, service=self.service, route=route,
+                                 reason=s.reason)
+                raise
         self._c_admitted.inc(1, service=self.service, route=route)
         with self._lock:
             cur = self._inflight[route] = self._inflight.get(route, 0) + 1
         self._g_inflight.set(cur, service=self.service, route=route)
 
-    def release(self, route: str) -> None:
+    def release(self, route: str, tenant: str = "") -> None:
         """A previously admitted request finished (replied, shed after
         queueing, or abandoned) — exactly-once per request, enforced by
         the caller's done-latch."""
@@ -225,19 +257,27 @@ class AdmissionController:
             cur = max(self._inflight.get(route, 0) - 1, 0)
             self._inflight[route] = cur
         self._g_inflight.set(cur, service=self.service, route=route)
+        if self.tenancy is not None and tenant:
+            self.tenancy.release(tenant)
 
-    def count_shed(self, route: str, reason: str) -> None:
+    def count_shed(self, route: str, reason: str,
+                   tenant: str = "") -> None:
         """Record a shed decided elsewhere (in-queue expiry)."""
         self._c_shed.inc(1, service=self.service, route=route,
                          reason=reason)
+        if self.tenancy is not None and tenant:
+            self.tenancy.count_shed(tenant, reason)
 
     def inflight(self, route: str) -> int:
         with self._lock:
             return self._inflight.get(route, 0)
 
-    def _shed(self, route: str, reason: str, retry_after: float):
+    def _shed(self, route: str, reason: str, retry_after: float,
+              tenant: str = ""):
         self._c_shed.inc(1, service=self.service, route=route,
                          reason=reason)
+        if self.tenancy is not None and tenant:
+            self.tenancy.count_shed(tenant, reason)
         raise Shed(reason, retry_after)
 
 

@@ -36,30 +36,42 @@ from ..obs import registry as _default_registry
 from ..obs.tracing import tracer as _obs_tracer
 from .policy import (GROW, WAIT, AdmissionConfig, AdmissionController,
                      BatchPolicy, ServiceTimeEstimator, Shed, now)
+from .tenancy import DEFAULT_TENANT, WeightedFairQueue
 
 __all__ = ["RequestScheduler", "Shed"]
 
 
 class RequestScheduler:
-    """Deadline-aware bounded request queue with adaptive batching."""
+    """Deadline-aware bounded request queue with adaptive batching.
+
+    With a :class:`~.tenancy.Tenancy` attached (``tenancy=``) the
+    scheduler becomes multi-tenant: intake runs the per-tenant gates
+    (rate / inflight / queue-share quotas, tier-deadline budgets) and
+    the backing queue becomes a :class:`~.tenancy.WeightedFairQueue`,
+    so dispatch interleaves tenants by tier weight instead of strict
+    arrival order. Without one, behavior is exactly the single-queue
+    scheduler it always was."""
 
     def __init__(self, service: str, *, max_queue: int = 0,
                  max_inflight: int = 0, deadline: float = 0.0,
                  on_shed=None, registry=None,
-                 estimator: ServiceTimeEstimator | None = None):
+                 estimator: ServiceTimeEstimator | None = None,
+                 tenancy=None):
         reg = registry if registry is not None else _default_registry
         self.service = service
         self.default_deadline = float(deadline)
         self.on_shed = on_shed
+        self.tenancy = tenancy
         self.estimator = estimator or ServiceTimeEstimator(
             service, registry=reg)
         self.admission = AdmissionController(
             service,
             AdmissionConfig(max_queue=max_queue, max_inflight=max_inflight,
                             deadline=deadline),
-            self.estimator, registry=reg)
+            self.estimator, registry=reg, tenancy=tenancy)
         self._cv = threading.Condition()
-        self._items: deque = deque()
+        self._items = (WeightedFairQueue(tenancy)
+                       if tenancy is not None else deque())
         self._enq_at: dict[int, float] = {}   # id(item) -> enqueue time
         self._closed = False
         self._gen = 0     # wake() generation: lets waiters observe a poke
@@ -74,13 +86,27 @@ class RequestScheduler:
 
     # -- intake ------------------------------------------------------------
     def submit(self, item, route: str = "/",
-               deadline: float | None = None) -> None:
+               deadline: float | None = None,
+               tenant: str = "") -> None:
         """Admission-controlled intake. ``deadline`` is the request's
         budget in SECONDS from now (None → the configured default; 0 →
-        no deadline). Raises :class:`Shed` on rejection — the caller
-        answers the client (``Shed.status``: 503 for hard queue
-        overflow, 429 + ``retry_after`` for policy sheds)."""
+        no deadline); ``tenant`` selects the quota/tier bucket when a
+        tenancy policy is attached (empty → :data:`DEFAULT_TENANT`).
+        Raises :class:`Shed` on rejection — the caller answers the
+        client (``Shed.status``: 503 for hard queue overflow, 429 +
+        ``retry_after`` for policy sheds)."""
+        tenancy = self.tenancy
+        if tenancy is not None:
+            tenant = tenant or DEFAULT_TENANT
         budget = self.default_deadline if deadline is None else deadline
+        if tenancy is not None:
+            # the tier's SLO deadline CAPS the budget: a gold request
+            # becomes deadline-carrying even when the client sent no
+            # budget at all — the tier contract is the service's, not
+            # the client's, to loosen
+            tier_dl = tenancy.deadline_for(tenant)
+            if tier_dl:
+                budget = min(budget, tier_dl) if budget else tier_dl
         with self._cv:
             # depth check and append are ONE critical section: checked
             # outside the cv, N racing submitters could all read
@@ -88,23 +114,34 @@ class RequestScheduler:
             # queue.Queue(maxsize) enforced strictly. try_admit's
             # registry locks nest inside the cv; nothing that holds a
             # registry lock ever takes the cv, so the order is safe.
+            tdepth = self._items.depth(tenant) \
+                if tenancy is not None else 0
             self.admission.try_admit(route, len(self._items),
-                                     deadline_budget=budget or None)
+                                     deadline_budget=budget or None,
+                                     tenant=tenant, tenant_depth=tdepth)
             # decorate BEFORE the item becomes executor-reachable: once
             # appended, a reply (and so the done-callback releasing the
-            # in-flight slot) can fire at any moment
+            # in-flight slot) can fire at any moment. The tenant stamp
+            # must land before the append — the fair queue buckets by it.
             try:
                 item.route = route
+                item.tenant = tenant
                 if budget:
                     item.deadline = now() + budget
-                item.on_done = lambda: self.admission.release(route)
+                item.on_done = lambda: self.admission.release(
+                    route, tenant=tenant)
             except AttributeError:
                 # slotted/frozen items cannot carry the accounting
                 # hooks: give the just-taken in-flight slot back here,
                 # or every such request would leak one until the route
                 # sheds "inflight" forever
-                self.admission.release(route)
+                self.admission.release(route, tenant=tenant)
             self._append_locked(item)
+            # snapshot under the cv (the fair queue has no lock of its
+            # own); the registry writes happen outside it below
+            depths = self._items.depths() if tenancy is not None else None
+        if depths is not None:
+            tenancy.update_queue_gauges(depths)
 
     # -- queue-compatible surface ------------------------------------------
     def put_nowait(self, item) -> None:
@@ -240,9 +277,13 @@ class RequestScheduler:
                                   reason=reason or "drain")
                 break
             self._g_depth.set(len(self._items), service=self.service)
+            depths = self._items.depths() \
+                if self.tenancy is not None else None
         # registry writes happen OUTSIDE the cv: per-item label
         # rendering + registry locking inside the drain loop would
         # stall every submitter for the whole O(batch) drain
+        if depths is not None:
+            self.tenancy.update_queue_gauges(depths)
         for w in waits:
             self._h_wait.observe(w, service=self.service)
         for item in shed:
@@ -348,7 +389,8 @@ class RequestScheduler:
         return dl is not None and dl < now() + est_service
 
     def _shed_item(self, item, reason: str) -> None:
-        self.admission.count_shed(getattr(item, "route", "/"), reason)
+        self.admission.count_shed(getattr(item, "route", "/"), reason,
+                                  tenant=getattr(item, "tenant", ""))
         if self.on_shed is not None:
             try:
                 self.on_shed(item, reason, 1.0)

@@ -12,6 +12,8 @@ reference's semantics: in-flight requests are replayed if a batch fails
 (the epoch/history-queue mechanism of ``HTTPSourceV2.scala:488-517``).
 """
 
+from .autoscale import (AutoscaleConfig, AutoscaleSignals, Autoscaler,
+                        ComputeWorkerPool)
 from .distributed import (DistributedServingServer, DriverRegistry,
                           NativeDistributedServingServer,
                           RegistryClient, ServiceInfo, pick_least_loaded,
@@ -21,6 +23,8 @@ from .udfs import make_reply_udf, send_reply_udf
 from .dsl import read_stream
 
 __all__ = ["bucket_pad",
+           "Autoscaler", "AutoscaleConfig", "AutoscaleSignals",
+           "ComputeWorkerPool",
            "DistributedServingServer", "NativeDistributedServingServer",
            "DriverRegistry", "RegistryClient",
            "ServiceInfo", "ServingServer", "pick_least_loaded",

@@ -1,0 +1,366 @@
+"""Obs-driven autoscaler: grow/shrink compute workers from live signals.
+
+The mesh can shed, retry, and survive worker death — but its capacity
+is whatever was started by hand. This module closes the loop: a control
+thread reads the signals the rest of the stack already publishes into
+the obs registry (queue depth, per-tenant EWMA latency vs its SLO tier,
+open circuit breakers, detected worker deaths) and drives a worker pool
+toward the load.
+
+Design rules (each one is a production scar, not a preference):
+
+- **Hysteresis**: a direction must hold for ``up_stable`` /
+  ``down_stable`` consecutive evaluations before acting — a breaker
+  flapping half-open or one bursty second must not thrash the pool.
+- **Cooldown**: after any scale action, no further action for
+  ``cooldown`` seconds — the new capacity needs time to show up in the
+  very signals being read, or the loop chases its own wake.
+- **Repair is not scaling**: a detected worker death is replaced
+  immediately, bypassing hysteresis AND cooldown — restoring capacity
+  the plan already called for must not wait out a window that exists to
+  damp *decisions*.
+- **Drain, never kill**: scale-down asks the pool to *drain* a worker.
+  For mesh workers (:class:`ComputeWorkerPool`) that sets the worker's
+  stop event: ``remote_worker_loop`` finishes and replies its current
+  lease, then unregisters; anything it somehow strands is replayed by
+  the ingest servers' existing lease-replay path. In-flight work is
+  never lost to a scaling decision.
+- **Monotonic time only** (:func:`sched.policy.now`): cooldown and
+  event arithmetic must not jump with wall-clock steps (graftcheck's
+  wallclock-deadline pass gates this file).
+
+The pool is duck-typed (``count()`` / ``scale_up()`` /
+``scale_down()``), so the same :class:`Autoscaler` drives real mesh
+workers, subprocess pools, and the synthetic pools the
+``mixed_tenant_scenario`` acceptance uses.
+
+Import is stdlib + obs + sched only — no JAX, no device (the CI smoke
+asserts it).
+"""
+
+from __future__ import annotations
+
+import logging
+import threading
+import uuid
+from dataclasses import dataclass, field
+
+from ..obs import registry as _default_registry
+from ..sched.policy import now
+
+_LOG = logging.getLogger("mmlspark_tpu.serving")
+
+__all__ = ["AutoscaleConfig", "AutoscaleSignals", "Autoscaler",
+           "ComputeWorkerPool"]
+
+
+@dataclass
+class AutoscaleConfig:
+    """Knobs for :class:`Autoscaler` (see docs/serving.md "Tenancy,
+    SLO tiers & autoscaling")."""
+
+    min_workers: int = 1
+    max_workers: int = 8
+    interval: float = 0.5      # evaluation cadence seconds
+    queue_high: float = 8.0    # queued requests PER WORKER → overload
+    queue_low: float = 1.0     # queued requests per worker → idle
+    slo_high: float = 0.9      # max tenant EWMA/SLO ratio → overload
+    slo_low: float = 0.5       # below this (and queue_low) → idle
+    up_stable: int = 2         # consecutive overloaded ticks before up
+    down_stable: int = 4       # consecutive idle ticks before down
+    cooldown: float = 5.0      # seconds after an action with no action
+    step: int = 1              # workers added/removed per action
+
+
+@dataclass
+class AutoscaleSignals:
+    """One evaluation's inputs (registry-read by default; injectable
+    for tests and synthetic scenarios)."""
+
+    queue_depth: float = 0.0
+    slo_pressure: float = 0.0   # max tenant EWMA latency / SLO deadline
+    breakers_open: int = 0      # open/half-open breakers in the process
+    worker_deaths: float = 0.0  # CUMULATIVE detected-death count
+
+
+@dataclass
+class AutoscaleEvent:
+    """One acted decision (the scenario asserts on these)."""
+
+    t: float                    # monotonic timestamp
+    direction: str              # up | down | replace
+    workers: int                # pool size AFTER the action
+    reason: str = ""
+
+
+class Autoscaler:
+    """The control loop: evaluate signals, decide, drive the pool.
+
+    ``pool`` must expose ``count() -> int`` (live, non-draining
+    workers), ``scale_up() -> worker_id`` and ``scale_down() ->
+    worker_id | None`` (pick a victim and START draining it — the call
+    must not block on the drain). ``tenancy`` (optional,
+    :class:`~..sched.tenancy.Tenancy`) supplies SLO pressure;
+    ``signals`` (optional callable → :class:`AutoscaleSignals`)
+    replaces the registry reads entirely.
+    """
+
+    def __init__(self, service: str, pool,
+                 config: AutoscaleConfig | None = None, *,
+                 registry=None, tenancy=None, signals=None):
+        reg = registry if registry is not None else _default_registry
+        self.service = service
+        self.pool = pool
+        self.config = config or AutoscaleConfig()
+        self.tenancy = tenancy
+        self._signals = signals
+        self._registry = reg
+        self.events: list[AutoscaleEvent] = []
+        self._lock = threading.Lock()
+        self._desired = max(self.config.min_workers, 0)
+        self._cooldown_until = 0.0
+        self._up_streak = 0
+        self._down_streak = 0
+        self._deaths_seen = 0.0
+        self._stop = threading.Event()
+        self._thread: threading.Thread | None = None
+        self._g_workers = reg.gauge(
+            "autoscale_workers", "live compute workers, by service")
+        self._g_desired = reg.gauge(
+            "autoscale_desired", "the autoscaler's target, by service")
+        self._c_events = reg.counter(
+            "autoscale_events_total",
+            "acted scale decisions, by service/direction "
+            "(up | down | replace)")
+        self._c_blocked = reg.counter(
+            "autoscale_blocked_total",
+            "actionable pressure NOT acted on, by service/reason "
+            "(cooldown | hysteresis | limit)")
+
+    # -- signal acquisition --------------------------------------------------
+    def read_signals(self) -> AutoscaleSignals:
+        """Default signal source: the process-wide obs registry — the
+        same series operators watch, so the loop scales on exactly what
+        the dashboards show."""
+        if self._signals is not None:
+            return self._signals()
+        snap = self._registry.snapshot()
+        svc = f'service="{self.service}"'
+        svc_sub = f'service="{self.service}#'  # e.g. <svc>#compute
+        queue = sum(v for k, v in snap.items()
+                    if k.startswith("sched_queue_depth{") and svc in k)
+        deaths = sum(v for k, v in snap.items()
+                     if k.startswith("resilience_worker_deaths_total")
+                     and (svc in k or svc_sub in k))
+        # only THIS service's mesh breakers (endpoints are
+        # mesh:<service>:<worker> / mesh:<service>:ingest:<id>): an
+        # unrelated service's stuck-open breaker in the same process
+        # must not veto this pool's scale-down forever
+        mesh = f"mesh:{self.service}:"
+        breakers = sum(1 for k, v in snap.items()
+                       if k.startswith("resilience_breaker_state")
+                       and mesh in k and v >= 1.0)
+        pressure = (self.tenancy.slo_pressure()
+                    if self.tenancy is not None else 0.0)
+        return AutoscaleSignals(queue_depth=queue, slo_pressure=pressure,
+                                breakers_open=breakers,
+                                worker_deaths=deaths)
+
+    # -- the decision --------------------------------------------------------
+    def tick(self, signals: AutoscaleSignals | None = None) -> str:
+        """One evaluation. Returns the decision taken: ``up`` /
+        ``down`` / ``replace`` / ``cooldown`` (actionable pressure
+        suppressed) / ``hold``."""
+        cfg = self.config
+        s = signals if signals is not None else self.read_signals()
+        t = now()
+        n = self.pool.count()
+        self._g_workers.set(n, service=self.service)
+        died = s.worker_deaths > self._deaths_seen
+        self._deaths_seen = max(self._deaths_seen, s.worker_deaths)
+        if n < self._desired and (died or n < cfg.min_workers):
+            # repair: restore capacity the plan already called for —
+            # bypasses hysteresis and cooldown (see module docstring)
+            while self.pool.count() < self._desired:
+                self.pool.scale_up()
+            self._record("replace", t, "worker death detected")
+            return "replace"
+        over = (s.queue_depth > cfg.queue_high * max(n, 1)
+                or s.slo_pressure > cfg.slo_high)
+        # an open breaker means some endpoint is sick: it VETOES
+        # scale-down (idle signals may just mean traffic is failing
+        # fast) but does not itself scale up — hysteresis absorbs flaps
+        under = (s.queue_depth < cfg.queue_low * max(n, 1)
+                 and s.slo_pressure < cfg.slo_low
+                 and s.breakers_open == 0)
+        self._up_streak = self._up_streak + 1 if over else 0
+        self._down_streak = self._down_streak + 1 if under else 0
+        if t < self._cooldown_until:
+            if over or under:
+                self._c_blocked.inc(1, service=self.service,
+                                    reason="cooldown")
+                return "cooldown"
+            return "hold"
+        if over:
+            if self._up_streak < cfg.up_stable:
+                self._c_blocked.inc(1, service=self.service,
+                                    reason="hysteresis")
+                return "hold"
+            if n >= cfg.max_workers:
+                self._c_blocked.inc(1, service=self.service,
+                                    reason="limit")
+                return "hold"
+            for _ in range(min(cfg.step, cfg.max_workers - n)):
+                self.pool.scale_up()
+            self._after_action(t)
+            self._record("up", t, f"depth={s.queue_depth:.0f} "
+                                  f"slo={s.slo_pressure:.2f}")
+            return "up"
+        if under:
+            if self._down_streak < cfg.down_stable:
+                self._c_blocked.inc(1, service=self.service,
+                                    reason="hysteresis")
+                return "hold"
+            if n <= cfg.min_workers:
+                return "hold"
+            for _ in range(min(cfg.step, n - cfg.min_workers)):
+                self.pool.scale_down()
+            self._after_action(t)
+            self._record("down", t, f"depth={s.queue_depth:.0f}")
+            return "down"
+        return "hold"
+
+    def _after_action(self, t: float) -> None:
+        self._desired = self.pool.count()
+        self._cooldown_until = t + self.config.cooldown
+        self._up_streak = self._down_streak = 0
+
+    def _record(self, direction: str, t: float, reason: str) -> None:
+        n = self.pool.count()
+        self._desired = max(self._desired, self.config.min_workers)
+        with self._lock:
+            self.events.append(AutoscaleEvent(t=t, direction=direction,
+                                              workers=n, reason=reason))
+        self._c_events.inc(1, service=self.service, direction=direction)
+        self._g_workers.set(n, service=self.service)
+        self._g_desired.set(self._desired, service=self.service)
+
+    def event_log(self) -> list[AutoscaleEvent]:
+        with self._lock:
+            return list(self.events)
+
+    # -- lifecycle -----------------------------------------------------------
+    def ensure_min(self) -> None:
+        """Bring the pool up to ``min_workers`` (called by start)."""
+        while self.pool.count() < self.config.min_workers:
+            self.pool.scale_up()
+        self._desired = max(self.pool.count(), self.config.min_workers)
+        self._g_desired.set(self._desired, service=self.service)
+
+    def start(self) -> "Autoscaler":
+        self.ensure_min()
+        self._thread = threading.Thread(target=self._run, daemon=True)
+        self._thread.start()
+        return self
+
+    def _run(self) -> None:
+        while not self._stop.wait(self.config.interval):
+            try:
+                self.tick()
+            except Exception:  # a bad read must not kill the loop
+                _LOG.warning("autoscaler tick failed", exc_info=True)
+
+    def stop(self) -> None:
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout=5)
+
+
+@dataclass
+class _PoolWorker:
+    thread: threading.Thread
+    stop: threading.Event
+    started: float = 0.0
+    draining: bool = field(default=False)
+
+
+class ComputeWorkerPool:
+    """An autoscalable pool of ``remote_worker_loop`` compute workers.
+
+    Each ``scale_up`` spawns one worker thread running the standard
+    lease-pull loop (heartbeats under ``<service>#compute``, identified
+    lease pulls, per-ingest breakers — everything the resilience layer
+    already provides). ``scale_down`` picks the NEWEST non-draining
+    worker and sets its stop event: the loop finishes and replies its
+    current lease round, then unregisters — and if it strands anything,
+    the ingest servers' lease-replay path answers it on a survivor.
+    Worker ids are stable (``<prefix>-w<N>``) so fault rules can target
+    one by substring match.
+    """
+
+    def __init__(self, driver_address, service: str, transform_fn, *,
+                 max_batch: int = 64, heartbeat_interval: float = 0.25,
+                 mesh_secret: str = "", prefix: str | None = None):
+        self.driver_address = driver_address
+        self.service = service
+        self.transform_fn = transform_fn
+        self.max_batch = max_batch
+        self.heartbeat_interval = heartbeat_interval
+        self.mesh_secret = mesh_secret
+        self.prefix = prefix or f"pool-{uuid.uuid4().hex[:6]}"
+        self._lock = threading.Lock()
+        self._workers: dict[str, _PoolWorker] = {}
+        self._seq = 0
+
+    def count(self) -> int:
+        """Live, non-draining workers (capacity the scheduler can use)."""
+        with self._lock:
+            return sum(1 for w in self._workers.values()
+                       if w.thread.is_alive() and not w.draining)
+
+    def worker_ids(self) -> list[str]:
+        with self._lock:
+            return [wid for wid, w in self._workers.items()
+                    if w.thread.is_alive() and not w.draining]
+
+    def scale_up(self) -> str:
+        from .distributed import remote_worker_loop
+        with self._lock:
+            wid = f"{self.prefix}-w{self._seq}"
+            self._seq += 1
+            stop = threading.Event()
+            th = threading.Thread(
+                target=remote_worker_loop,
+                args=(self.driver_address, self.service,
+                      self.transform_fn),
+                kwargs={"stop_event": stop, "max_batch": self.max_batch,
+                        "heartbeat_interval": self.heartbeat_interval,
+                        "mesh_secret": self.mesh_secret,
+                        "worker_id": wid},
+                daemon=True, name=f"compute-{wid}")
+            self._workers[wid] = _PoolWorker(thread=th, stop=stop,
+                                             started=now())
+            th.start()
+        return wid
+
+    def scale_down(self) -> str | None:
+        """Start draining the newest non-draining worker (LIFO: the
+        oldest workers keep their warmed caches/breaker state)."""
+        with self._lock:
+            candidates = [(w.started, wid) for wid, w in
+                          self._workers.items()
+                          if w.thread.is_alive() and not w.draining]
+            if not candidates:
+                return None
+            _, wid = max(candidates)
+            self._workers[wid].draining = True
+            self._workers[wid].stop.set()
+        return wid
+
+    def stop(self, timeout: float = 5.0) -> None:
+        with self._lock:
+            workers = list(self._workers.values())
+        for w in workers:
+            w.stop.set()
+        for w in workers:
+            w.thread.join(timeout=timeout)

@@ -453,6 +453,12 @@ class DistributedServingServer(ServingServer):
         out = []
         for c in batch:
             entry = {"id": c.id, "request": _req_to_json(c.request)}
+            tenant = getattr(c, "tenant", "")
+            if tenant:
+                # the tenant rides the lease: compute workers label
+                # their telemetry (and any per-tenant batching they
+                # grow) with the quota bucket the ingest side resolved
+                entry["tenant"] = tenant
             sp = getattr(c, "span", None)
             if sp is not None:
                 # trace context rides the lease: the compute worker
@@ -794,8 +800,12 @@ def remote_worker_loop(driver_address, service_name: str, transform_fn,
                 got = True
                 # injection point AFTER the lease is held: a kill here
                 # is the mid-batch worker death the lease replay (and
-                # its chaos test) exists for
+                # its chaos test) exists for; a "slow" rule here arms a
+                # persistent per-worker degradation instead (the
+                # sick-but-alive worker load-aware routing must route
+                # around)
                 _faults.apply("worker.death", key=wid)
+                _faults.apply("worker.slow", key=wid)
                 ids = np.empty(len(items), object)
                 reqs = np.empty(len(items), object)
                 ids[:] = [i["id"] for i in items]
@@ -804,6 +814,13 @@ def remote_worker_loop(driver_address, service_name: str, transform_fn,
                 try:
                     out = transform_fn(
                         DataFrame({"id": ids, "request": reqs}))
+                    slow = _faults.degradation(wid)
+                    if slow > 1.0:
+                        # stretch this worker's service time by the
+                        # injected factor: latency the mesh observes
+                        # (EWMA, lease pacing), not a one-shot spike
+                        time.sleep((time.perf_counter() - t0)
+                                   * (slow - 1.0))
                     # ServingQuery contract: a transform may reply itself
                     # (send_reply_udf) and return None / no "reply" column
                     pairs = (list(zip(out["id"], out["reply"]))

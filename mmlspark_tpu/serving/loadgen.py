@@ -21,6 +21,11 @@ DETERMINISTIC traceparent — trace id ``<prefix><conn:4hex><req:8hex>``
 requests (``slowest`` column) and a bench outlier becomes a lookup key
 into the server's flight recorder (``GET /debug/trace``).
 
+Multi-tenant loads (sched.tenancy): ``run_load(..., tenants=[...])``
+stamps ``X-Tenant`` per connection (lg_run5) and splits the summary
+per tenant — a gold tenant's p99 and a best-effort tenant's shed rate
+never blend into one column.
+
 No reference counterpart — the reference's serving perf narrative
 (``docs/mmlspark-serving.md``) relied on external load tooling.
 """
@@ -67,7 +72,8 @@ def _slowest_trace_ids(steady_lat: np.ndarray, ok: np.ndarray,
 
 
 def summarize(lat: np.ndarray, status: np.ndarray, wall_s: float,
-              warmup: int = 20, trace_prefix: str | None = None) -> dict:
+              warmup: int = 20, trace_prefix: str | None = None,
+              tenants: list[str] | None = None) -> dict:
     """Shape raw per-request ``(latency_ms, http_status)`` matrices
     (connection-major ``[nconn, nreq]``; status -1 = transport failure,
     status >= 1000 = answered on a Retry-After re-attempt) into the
@@ -86,7 +92,13 @@ def summarize(lat: np.ndarray, status: np.ndarray, wall_s: float,
     plus ``shed_rate`` over completed round trips; a shed that a
     re-attempt then answered counts in ``retried_ok``, not ``shed``.
     ``throughput_rps`` counts 2xx only (work actually served, retried
-    or not); ``completed_rps`` keeps the old every-round-trip rate."""
+    or not); ``completed_rps`` keeps the old every-round-trip rate.
+
+    ``tenants`` (one name per connection — lg_run5 stamps X-Tenant per
+    connection) additionally splits the summary per tenant under a
+    ``tenants`` key: mixed-workload bench numbers stay honest only if
+    a gold tenant's p99 and a best-effort tenant's shed rate never
+    blend into one column."""
     if not (status >= 0).any():
         raise RuntimeError("loadgen: every request failed")
     retried_all = status >= _RETRIED_BASE
@@ -111,7 +123,29 @@ def summarize(lat: np.ndarray, status: np.ndarray, wall_s: float,
     shed = int((final == 429).sum())
     slowest = [] if trace_prefix is None else _slowest_trace_ids(
         steady_lat, ok, warmup if nreq > warmup else 0, trace_prefix)
+    by_tenant = {}
+    if tenants:
+        # tenant is constant per connection (lg_run5 stamps X-Tenant at
+        # connect), so the split is a row selection on the
+        # connection-major matrices — each tenant re-runs the same
+        # shaping over its own rows (recursion bottoms out: the
+        # sub-call passes tenants=None)
+        for name in dict.fromkeys(tenants):   # stable unique order
+            rows = [c for c, t in enumerate(tenants) if t == name]
+            try:
+                sub = summarize(lat[rows], status[rows], wall_s,
+                                warmup=warmup)
+            except RuntimeError:
+                # every one of this tenant's requests failed: report
+                # the failure count rather than erasing the tenant
+                sub = {"transport_errors":
+                       int((status[rows] < 0).sum())}
+            by_tenant[name] = {k: sub[k] for k in (
+                "p50_ms", "p99_ms", "shed", "shed_rate", "retried",
+                "retried_ok", "rejected", "throughput_rps",
+                "transport_errors") if k in sub}
     return {
+        "tenants": by_tenant,
         "slowest": slowest,
         "p50_ms": float(np.percentile(ok_lat, 50)),
         "p99_ms": float(np.percentile(ok_lat, 99)),
@@ -131,7 +165,8 @@ def summarize(lat: np.ndarray, status: np.ndarray, wall_s: float,
 def run_load(host: str, port: int, payload: bytes, *, nconn: int = 16,
              nreq: int = 300, path: str = "/",
              warmup: int = 20, retry: bool = False,
-             trace: bool = True) -> dict:
+             trace: bool = True,
+             tenants: list[str] | None = None) -> dict:
     """Closed-loop load: ``nconn`` keep-alive connections, ``nreq``
     serial POSTs each; see :func:`summarize` for the returned summary
     (success-only percentiles; 429 sheds and other non-2xx reported
@@ -140,32 +175,38 @@ def run_load(host: str, port: int, payload: bytes, *, nconn: int = 16,
     ``retried``/``retried_ok``. ``trace=True`` (default) stamps every
     request with a deterministic traceparent and reports the
     p99-slowest requests' trace ids under ``slowest`` — look them up at
-    the server's ``GET /debug/trace``. Raises when nothing could
-    connect."""
+    the server's ``GET /debug/trace``. ``tenants`` assigns connection
+    ``c`` the tenant ``tenants[c % len]``, stamped as ``X-Tenant`` on
+    every request (lg_run5) and split out per tenant in the summary's
+    ``tenants`` key. Raises when nothing could connect."""
     lib = _loader.load()
     # 20 hex prefix + 4 (conn) + 8 (req) = a 32-hex W3C-shaped trace id
     trace_prefix = uuid.uuid4().hex[:20] if trace else None
-    lib.lg_run4.restype = ctypes.c_long
-    lib.lg_run4.argtypes = [
+    lib.lg_run5.restype = ctypes.c_long
+    lib.lg_run5.argtypes = [
         ctypes.c_char_p, ctypes.c_int, ctypes.c_int, ctypes.c_long,
         ctypes.c_char_p, ctypes.c_char_p, ctypes.c_long, ctypes.c_int,
-        ctypes.c_char_p,
+        ctypes.c_char_p, ctypes.c_char_p,
         ctypes.POINTER(ctypes.c_double),
         ctypes.POINTER(ctypes.c_int),
         ctypes.POINTER(ctypes.c_double)]
     lat = np.empty(nconn * nreq, np.float64)
     status = np.empty(nconn * nreq, np.int32)
     wall = ctypes.c_double(0.0)
-    errors = int(lib.lg_run4(
+    errors = int(lib.lg_run5(
         host.encode(), int(port), int(nconn), int(nreq), path.encode(),
         payload, len(payload), 1 if retry else 0,
         (trace_prefix or "").encode(),
+        ",".join(tenants or []).encode(),
         lat.ctypes.data_as(ctypes.POINTER(ctypes.c_double)),
         status.ctypes.data_as(ctypes.POINTER(ctypes.c_int)),
         ctypes.byref(wall)))
     if errors < 0:
         raise RuntimeError("loadgen: no connection could be "
                            "established")
+    conn_tenants = [tenants[c % len(tenants)]
+                    for c in range(nconn)] if tenants else None
     return summarize(lat.reshape(nconn, nreq),
                      status.reshape(nconn, nreq), wall.value,
-                     warmup=warmup, trace_prefix=trace_prefix)
+                     warmup=warmup, trace_prefix=trace_prefix,
+                     tenants=conn_tenants)

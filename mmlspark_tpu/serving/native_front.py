@@ -85,7 +85,8 @@ class NativeServingServer(ServingServer):
     def __init__(self, name: str, host: str = "127.0.0.1", port: int = 0,
                  api_path: str = "/", reply_timeout: float = 30.0,
                  max_retries: int = 2, max_queue: int = 0,
-                 deadline: float = 0.0, max_inflight: int = 0):
+                 deadline: float = 0.0, max_inflight: int = 0,
+                 tenancy=None):
         lib = get_httpfront()
         if lib is None:
             raise RuntimeError(
@@ -100,7 +101,8 @@ class NativeServingServer(ServingServer):
         self._handle = handle
         self._init_shared_state(name, api_path, reply_timeout,
                                 max_retries, max_queue, deadline=deadline,
-                                max_inflight=max_inflight)
+                                max_inflight=max_inflight,
+                                tenancy=tenancy)
         self.address = (host, out_port.value)
         self._stop = threading.Event()
         self._poller = threading.Thread(target=self._poll_loop,

@@ -35,6 +35,7 @@ from ..obs.propagation import extract as _extract
 from ..obs.tracing import tracer as _tracer
 from ..sched import RequestScheduler, Shed
 from ..sched.policy import bucket_of
+from ..sched.tenancy import clean_tenant
 
 _LOG = logging.getLogger("mmlspark_tpu.serving")
 
@@ -128,6 +129,9 @@ class CachedRequest:
     # deadline) and the route label — set at admission (sched subsystem)
     deadline: float | None = None
     route: str = "/"
+    # quota/tier bucket from the X-Tenant header (sched.tenancy); ""
+    # when the service runs without a tenancy policy
+    tenant: str = ""
     # fired exactly once when the request reaches ANY terminal state
     # (reply or abandon); the serving layer hangs the scheduler's
     # in-flight release here
@@ -184,7 +188,8 @@ class ServingServer:
     def _init_shared_state(self, name: str, api_path: str,
                            reply_timeout: float, max_retries: int,
                            max_queue: int, deadline: float = 0.0,
-                           max_inflight: int = 0) -> None:
+                           max_inflight: int = 0,
+                           tenancy=None) -> None:
         """State shared by every front (threaded Python and native epoll —
         ``native_front.NativeServingServer`` calls this too, so the two
         cannot drift): the scheduler, replay bookkeeping, and route table
@@ -201,7 +206,8 @@ class ServingServer:
         # replay, and queue-poking tests work unchanged.
         self.scheduler = RequestScheduler(
             name, max_queue=max_queue or 0, max_inflight=max_inflight,
-            deadline=deadline, on_shed=self._shed_reply)
+            deadline=deadline, on_shed=self._shed_reply,
+            tenancy=tenancy)
         self.queue = self.scheduler
         self.history: dict[str, CachedRequest] = {}
         self._lock = threading.Lock()
@@ -227,6 +233,11 @@ class ServingServer:
         self._m_lat_ewma = _obs.gauge(
             "serving_request_seconds_ewma",
             "EWMA request latency, by service (load-aware routing input)")
+        # per-tenant outcome series (sched.tenancy): label cardinality
+        # is bounded by the tenancy policy's idle-tenant eviction
+        self._m_tenant_requests = _obs.counter(
+            "serving_tenant_requests_total",
+            "requests answered, by service/tenant/status code")
         self._lat_ewma = 0.0
         self._lat_seen = False
         self._routes["/metrics"] = self._metrics_route
@@ -279,6 +290,14 @@ class ServingServer:
         """Close the request span and report the outcome to the flight
         recorder (which decides whether the tree is retained). ONE site
         for both fronts; idempotent via end_span's done-latch."""
+        # only with a tenancy policy attached: its idle-tenant eviction
+        # is what bounds this label's cardinality — without one, a
+        # client spraying X-Tenant values could grow the exposition
+        # forever (same rationale as the <unmatched> route collapse)
+        if cached.tenant and self.scheduler.tenancy is not None:
+            self._m_tenant_requests.inc(1, service=self.name,
+                                        tenant=cached.tenant,
+                                        code=str(int(status)))
         span = cached.span
         if span is None:
             return
@@ -326,11 +345,15 @@ class ServingServer:
         """Shared admission path for both fronts: a client can tighten
         its budget with an ``X-Deadline-Ms`` header (capped at the
         service default when one is configured — a client cannot ask
-        for MORE queueing than the service allows); raises
-        :class:`~..sched.Shed` when the scheduler rejects."""
+        for MORE queueing than the service allows) and names its quota
+        bucket with ``X-Tenant`` (sanitized; junk values collapse to
+        the default tenant); raises :class:`~..sched.Shed` when the
+        scheduler rejects."""
         budget = None
+        tenant = ""
         for k, v in (cached.request.headers or {}).items():
-            if k.lower() == "x-deadline-ms":
+            lk = k.lower()
+            if lk == "x-deadline-ms":
                 try:
                     # clamp to a positive finite floor: a 0/negative
                     # header must read as "already out of budget"
@@ -346,16 +369,20 @@ class ServingServer:
                     budget = None
                 if budget is not None and self.scheduler.default_deadline:
                     budget = min(budget, self.scheduler.default_deadline)
-                break
-        self.scheduler.submit(cached, route=route, deadline=budget)
+            elif lk == "x-tenant":
+                tenant = clean_tenant(v)
+        self.scheduler.submit(cached, route=route, deadline=budget,
+                              tenant=tenant)
 
     def __init__(self, name: str, host: str = "127.0.0.1", port: int = 0,
                  api_path: str = "/", reply_timeout: float = 30.0,
                  max_retries: int = 2, max_queue: int = 0,
-                 deadline: float = 0.0, max_inflight: int = 0):
+                 deadline: float = 0.0, max_inflight: int = 0,
+                 tenancy=None):
         self._init_shared_state(name, api_path, reply_timeout,
                                 max_retries, max_queue, deadline=deadline,
-                                max_inflight=max_inflight)
+                                max_inflight=max_inflight,
+                                tenancy=tenancy)
 
         serving = self
 
@@ -544,22 +571,29 @@ class ServingQuery:
         bytes) — the learned scheduler model's training rows."""
         n = len(batch)
         bucket = bucket_of(n)
+        tenancy = self.server.scheduler.tenancy
         for c in batch:
             sp = getattr(c, "span", None)
             if sp is not None:
                 _tracer.emit_span("serving.execute", parent=sp,
                                   seconds=execute_s, service=self.name,
                                   rows=n)
+            tenant = getattr(c, "tenant", "")
+            queue_s = getattr(c, "queue_wait", None) or 0.0
             _features.record(
                 service=self.name,
                 route=getattr(c, "route", "/"),
+                tenant=tenant,
                 batch=n, bucket=bucket,
-                queue_ms=round((getattr(c, "queue_wait", None) or 0.0)
-                               * 1e3, 4),
+                queue_ms=round(queue_s * 1e3, 4),
                 execute_ms=round(execute_s * 1e3, 4),
                 entity_bytes=len(getattr(c.request, "entity", b"")
                                  or b""),
                 trace_id=(sp.trace_id if sp is not None else None))
+            if tenancy is not None and tenant:
+                # the tenant's EWMA latency (queue + execute — what the
+                # rider actually paid): the autoscaler's SLO pressure
+                tenancy.observe_latency(tenant, queue_s + execute_s)
 
     def _run(self):
         batch_rows = _obs.histogram(
@@ -624,7 +658,7 @@ def serving_query(name: str, transform_fn, host: str = "127.0.0.1",
                   port: int = 0, reply_timeout: float = 30.0,
                   backend: str = "auto", max_queue: int = 0,
                   deadline: float = 0.0,
-                  max_inflight: int = 0) -> ServingQuery:
+                  max_inflight: int = 0, tenancy=None) -> ServingQuery:
     """One-call setup: server + query, started.
 
     ``backend``: ``"auto"`` (the DEFAULT: native when the toolchain
@@ -651,5 +685,5 @@ def serving_query(name: str, transform_fn, host: str = "127.0.0.1",
                 raise
     server = cls(name, host=host, port=port, reply_timeout=reply_timeout,
                  max_queue=max_queue, deadline=deadline,
-                 max_inflight=max_inflight).start()
+                 max_inflight=max_inflight, tenancy=tenancy).start()
     return ServingQuery(server, transform_fn).start()
