@@ -1244,6 +1244,38 @@ def bench_elasticity(extras: dict) -> None:
     extras["autoscale_tracked_diurnal"] = bool(r["scaled_with_diurnal"])
 
 
+def bench_pipeline_fusion(extras: dict) -> None:
+    """Whole-pipeline XLA compilation acceptance (ISSUE 10): fused vs
+    per-stage e2e latency and dispatch count on the featurizer
+    (clean→assemble→infer→postproc) and text (host-tokenize→encoder)
+    pipelines. Contract flags bank alongside the raw numbers: the
+    featurizer pipeline must collapse to ≤ 2 dispatches per request,
+    run ≥ 3× faster than eager per-stage execution, and stay
+    bit-equivalent (atol 1e-5) on every benchmarked pipeline."""
+    from mmlspark_tpu.testing.benchmarks import pipeline_fusion_scenario
+
+    r = pipeline_fusion_scenario(n_rows=256, width=128, reps=40)
+    for name in ("featurizer", "text"):
+        p = r[name]
+        extras[f"pipeline_fusion_{name}_eager_ms"] = round(
+            p["eager_ms"], 3)
+        extras[f"pipeline_fusion_{name}_fused_ms"] = round(
+            p["fused_ms"], 3)
+        extras[f"pipeline_fusion_{name}_speedup"] = round(
+            p["speedup"], 2)
+        extras[f"pipeline_fusion_{name}_dispatches"] = int(
+            p["dispatches"])
+        extras[f"pipeline_fusion_{name}_segments"] = int(p["segments"])
+        extras[f"pipeline_fusion_{name}_equivalent"] = bool(
+            p["equivalent"])
+    extras["pipeline_fusion_le_2_dispatches"] = bool(
+        r["featurizer_fused_le_2_dispatches"])
+    extras["pipeline_fusion_speedup_ge_3x"] = bool(
+        r["featurizer_speedup_ge_3x"])
+    extras["pipeline_fusion_all_equivalent"] = bool(
+        r["all_equivalent"])
+
+
 def bench_serving(extras: dict) -> None:
     """End-to-end HTTP request→jitted pipeline→response latency against
     the reference's ~1 ms continuous-mode figure."""
@@ -1838,6 +1870,11 @@ def main():
             # pure host-side (synthetic tenants + autoscaled pool):
             # tunnel-immune like observability
             _watchdog(bench_elasticity, extras, "elasticity", 240.0)
+        if want("pipeline_fusion"):
+            # fused vs per-stage pipelines on whatever backend the
+            # suite acquired (devices already up by this point)
+            _watchdog(bench_pipeline_fusion, extras, "pipeline_fusion",
+                      240.0)
         if want("serving"):
             # includes a small GBDT fit for the real-model row
             _watchdog(bench_serving, extras, "serving", 360.0)

@@ -50,9 +50,17 @@ PACKAGES: dict[str, list[str]] = {
     "autoscale": ["test_autoscale.py"],  # autoscaler + mixed-tenant chaos
     "resilience": ["test_resilience.py"],  # retry/breaker/faults/chaos
     "parallel": ["test_partition.py"],  # partition rules + pjit steps
+    "compile": ["test_pipeline_compile.py"],  # whole-pipeline fusion
     "text": ["test_text_transfer.py", "test_causal_lm.py",
              "test_speculative.py"],
 }
+
+# traceable-count ratchet (ISSUE 10): the analysis gate fails if the
+# regenerated traceability report classifies fewer stages TRACEABLE
+# than the committed burn-down achieved — host ops must not creep back
+# into stage transform/fit paths. Raise this as more stages convert;
+# never lower it without a written justification in the PR.
+TRACEABLE_RATCHET = 36
 
 
 def _run(cmd: list[str], **kw) -> int:
@@ -177,6 +185,29 @@ def style() -> int:
               env=dict(os.environ, JAX_PLATFORMS="cpu"))
     if rc:
         return rc
+    # the pipeline compiler must import AND build an (all-host) plan
+    # with no JAX in the process: plan construction is schema walking,
+    # and fused segments only touch a backend on first execution — a
+    # JAX import sneaking into compile/plan time would drag backend
+    # setup into every control-plane importer of core
+    smoke = (
+        "import sys; import numpy as np; "
+        "from mmlspark_tpu.core import (DataFrame, compile_pipeline, "
+        "CompiledPipeline); "
+        "from mmlspark_tpu.stages import TextPreprocessor; "
+        "assert 'jax' not in sys.modules, 'core.compile pulled in jax'; "
+        "df = DataFrame({'t': np.asarray(['A', 'B'], object)}); "
+        "cp = compile_pipeline([TextPreprocessor(inputCol='t', "
+        "outputCol='o', normFunc='lower')], df); "
+        "assert isinstance(cp, CompiledPipeline); "
+        "assert cp.compiled_segments == 0 and cp.eager_stages == 1; "
+        "assert cp.transform(df)['o'].tolist() == ['a', 'b']; "
+        "assert 'jax' not in sys.modules, 'host-only plan pulled jax'; "
+        "print('core.compile import+plan OK (no jax)')")
+    rc = _run([sys.executable, "-c", smoke],
+              env=dict(os.environ, JAX_PLATFORMS="cpu"))
+    if rc:
+        return rc
     # graftcheck (static analysis) is pure stdlib: it must import AND
     # analyze with no JAX at all — it runs as a gate on machines (and
     # in contexts) where importing the analyzed code is not an option
@@ -259,6 +290,20 @@ def analysis() -> int:
                   "--strict --traceability "
                   "mmlspark_tpu/analysis/traceability.json")
             rc = 1
+        if rc == 0:
+            # the traceable-count ratchet: a host op creeping back into
+            # a converted stage silently shrinks the fused spans —
+            # whole-pipeline compilation's work-list only burns DOWN
+            import json
+            with open(fresh, encoding="utf-8") as f:
+                n = json.load(f)["summary"]["traceable"]
+            if n < TRACEABLE_RATCHET:
+                print(f"analysis: traceability ratchet broken — "
+                      f"{n} stages TRACEABLE < committed floor "
+                      f"{TRACEABLE_RATCHET}. A host op (numpy call, "
+                      f".tolist) crept back into a stage transform/fit "
+                      f"path; see the stage's 'reasons' in the report.")
+                rc = 1
     finally:
         os.unlink(fresh)
     took = time.monotonic() - t0

@@ -7,6 +7,7 @@ from .param import (Param, Params, ComplexParam, TypeConverters, StageParam,
                     ServiceParam)
 from .pipeline import (PipelineStage, Transformer, Estimator, Model, Pipeline,
                        PipelineModel, ml_transform, ml_fit)
+from .compile import CompiledPipeline, compile_pipeline
 from .serialize import load_stage, register_stage
 from .utils import (ClusterUtil, StopWatch, retry_with_timeout,
                     find_unused_column_name, as_2d_features)
@@ -20,6 +21,7 @@ __all__ = [
     "ServiceParam",
     "PipelineStage", "Transformer", "Estimator", "Model", "Pipeline",
     "PipelineModel", "ml_transform", "ml_fit",
+    "CompiledPipeline", "compile_pipeline",
     "load_stage", "register_stage",
     "ClusterUtil", "StopWatch", "retry_with_timeout",
     "find_unused_column_name", "as_2d_features", "contracts",

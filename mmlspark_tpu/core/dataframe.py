@@ -28,6 +28,14 @@ def _normalize_column(values: Any, n_rows: int | None = None) -> np.ndarray:
     """Normalize arbitrary user input into a canonical column array."""
     if isinstance(values, np.ndarray):
         arr = values
+    elif hasattr(values, "__array__") and getattr(values, "shape", None):
+        # device-backed arrays (jax.numpy) land here: stages compute in
+        # jnp and hand results straight to with_column — materializing
+        # at the DataFrame boundary is THE host sync point (the fused
+        # pipeline path skips this entirely between stages). 0-d
+        # scalars (shape == (), falsy) fall through to the scalar
+        # broadcast below
+        arr = np.asarray(values)
     elif isinstance(values, (list, tuple)):
         has_seq = any(isinstance(v, (list, tuple, np.ndarray)) for v in values)
         if has_seq:
@@ -58,13 +66,108 @@ def _normalize_column(values: Any, n_rows: int | None = None) -> np.ndarray:
             arr = np.full(n_rows, values)
     if arr.dtype.kind == "U":
         arr = arr.astype(object)
-    if n_rows is not None and arr.ndim >= 1 and arr.shape[0] != n_rows:
-        if arr.ndim == 0:
-            arr = np.full(n_rows, arr[()])
-        else:
-            raise ValueError(
-                f"column length {arr.shape[0]} != DataFrame length {n_rows}")
+    if n_rows is not None and arr.ndim == 0:
+        arr = np.full(n_rows, arr[()])
+    if n_rows is not None and arr.shape[0] != n_rows:
+        raise ValueError(
+            f"column length {arr.shape[0]} != DataFrame length {n_rows}")
     return arr
+
+
+# ---------------------------------------------------------- host boundary
+# The ONE place stage code materializes device values / builds object
+# (string, ragged) columns. Stages and featurizers route their host
+# plumbing through these helpers so their own transform/fit bodies stay
+# free of host ops — that is what graftcheck's traceability report
+# measures, and what lets the pipeline compiler (core/compile.py) fuse
+# them. Genuinely host-bound work (tokenizer string loops, HTTP) stays
+# in the stages and keeps them HOST-BOUND, by design.
+
+def jittable_dtype(dtype) -> bool:
+    """Can a column of this dtype enter a traced (jit) segment? Numeric
+    and bool only — object (string/ragged) and datetime columns stay on
+    host (``core/compile.py`` carries them around fused segments)."""
+    return getattr(dtype, "kind", "") in "biuf"
+
+
+def to_host(values: Any) -> np.ndarray:
+    """Materialize a (possibly device-backed) array on host as numpy.
+    For a jax array this is the device→host sync; for numpy it is
+    free."""
+    return np.asarray(values)
+
+
+def to_host_list(values: Any) -> list:
+    """Materialize as a plain Python list (param storage, level lists)."""
+    return np.asarray(values).tolist()
+
+
+def object_column(cells: Iterable) -> np.ndarray:
+    """Build a 1-D object column from arbitrary per-row cells without
+    numpy guessing at a rectangular layout (lists of arrays must stay
+    one-cell-per-row)."""
+    cells = list(cells)
+    arr = np.empty(len(cells), dtype=object)
+    arr[:] = cells
+    return arr
+
+
+def repeat_rows(values: np.ndarray, lengths: Iterable[int]) -> np.ndarray:
+    """Repeat each row of ``values`` by the matching length (the
+    FlattenBatch/Explode scalar-broadcast path)."""
+    return np.repeat(values, np.asarray(list(lengths)), axis=0)
+
+
+def unique_host(values, return_counts: bool = False,
+                drop_nan: bool = False):
+    """EXACT distinct values of a host column — the fit-time helper.
+    Fitted params (category levels, class-weight keys) must hold the
+    exact values ``transform`` will later look up; routing uniqueness
+    through the device would round them through jax's 32-bit lattice
+    (float64 0.1 → 0.10000000149…, int64 ≥ 2**31 truncated) and the
+    fitted model would miss the very values it was fit on."""
+    arr = np.asarray(values)
+    if return_counts:
+        vals, cnts = np.unique(arr, return_counts=True)
+        if drop_nan and vals.dtype.kind == "f":
+            keep = ~np.isnan(vals)
+            vals, cnts = vals[keep], cnts[keep]
+        return vals, cnts
+    vals = np.unique(arr)
+    if drop_nan and vals.dtype.kind == "f":
+        vals = vals[~np.isnan(vals)]
+    return vals
+
+
+def argsort_host(values) -> np.ndarray:
+    """EXACT stable argsort on host. Epoch-millisecond timestamps are
+    int64 ~1.7e12 — a device argsort truncates them to int32 and
+    inverts the order across every 2**31 wrap."""
+    return np.argsort(np.asarray(values), kind="stable")
+
+
+def concat_host(parts) -> np.ndarray:
+    """EXACT concatenation of host arrays along axis 0. Routing the
+    eager un-batch path through the device would demote int64 columns
+    (epoch millis wrap at 2**31) and float64 to float32; host columns
+    flatten on host in their own dtype."""
+    return np.concatenate([np.asarray(p) for p in parts], axis=0)
+
+
+def f32_exact(value) -> bool:
+    """True if ``value`` survives a float32 round-trip exactly — the
+    gate for traced lookup tables. Fitted keys compare in the device's
+    float32 lattice; a key that doesn't round-trip (ints ≥ 2**24,
+    float64 dust) would silently collide with a neighbor or miss."""
+    v = float(value)
+    return float(np.float32(v)) == v
+
+
+def quantile_host(values, q) -> float:
+    """EXACT quantile of a host column in its own dtype — the profiling
+    helper. Summary statistics are reporting output, not device math:
+    a float64 column's quantiles must not round through float32."""
+    return float(np.quantile(np.asarray(values), q))
 
 
 class Row(dict):
@@ -198,6 +301,10 @@ class DataFrame:
 
     def take(self, indices) -> "DataFrame":
         idx = np.asarray(indices)
+        if idx.dtype.kind not in "iub":
+            # an empty Python list arrives float64; row indices are
+            # integral by contract either way
+            idx = idx.astype(np.int64)
         return self._with_data({k: v[idx] for k, v in self._data.items()})
 
     def sample(self, fraction: float, seed: int = 0,

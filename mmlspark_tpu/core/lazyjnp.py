@@ -1,0 +1,43 @@
+"""Lazy ``jax.numpy`` proxy for stage modules.
+
+Stage and featurizer modules compute with ``jax.numpy`` (that is what
+makes them TRACEABLE — see ``analysis/traceability.json`` and
+``docs/pipeline_compilation.md``), but the package must stay importable
+on machines with no JAX at all: graftcheck analyzes it as pure ast, the
+codegen walks it, and control-plane processes import it for the stage
+registry. This proxy defers the ``import jax.numpy`` to the first
+attribute access, so ``from ..core.lazyjnp import jnp`` at module top
+costs nothing until a transform actually runs.
+
+Inside a traced ``_trace`` body the proxy adds one dict lookup per op —
+negligible against trace time, and zero against the compiled program
+(tracing happens once per shape).
+"""
+
+from __future__ import annotations
+
+
+class _LazyModule:
+    """Attribute-forwarding proxy that imports its target on first use."""
+
+    __slots__ = ("_name", "_mod")
+
+    def __init__(self, name: str):
+        self._name = name
+        self._mod = None
+
+    def __getattr__(self, attr: str):
+        mod = self._mod
+        if mod is None:
+            import importlib
+            mod = self._mod = importlib.import_module(self._name)
+        return getattr(mod, attr)
+
+
+#: ``jax.numpy``, imported on first attribute access.
+jnp = _LazyModule("jax.numpy")
+
+#: ``jax.random``, imported on first attribute access (StratifiedRepartition
+#: draws its shuffle from here — device RNG, not host RNG, so the stage's
+#: compute stays on the traceable side of the report).
+jrandom = _LazyModule("jax.random")

@@ -31,6 +31,58 @@ class PipelineStage(Params, BasicLogging, SaveLoadMixin):
 
 
 class Transformer(PipelineStage):
+    # ------------------------------------------- traceable-stage protocol
+    # A stage that can lower into a fused XLA computation exposes
+    # ``_trace(columns) -> columns``: a PURE jax.numpy function over a
+    # dict of column arrays (numeric columns only — strings and ragged
+    # cells never enter a traced segment). The contract:
+    #
+    # - no host ops: no numpy calls, no I/O, no clock, no Python-level
+    #   data-dependent control flow (graftcheck's trace-safety pass and
+    #   the traceability report police this statically);
+    # - static shapes: output shapes must be a function of input shapes
+    #   and stage params, never of the VALUES flowing through (a stage
+    #   whose output length depends on the data — Explode, FlattenBatch
+    #   over ragged cells — stays host-bound);
+    # - ``_trace_ok(schema, n_rows)`` is the static-shape contract
+    #   check: given ``{col: (dtype, trailing_shape)}`` and the row
+    #   count, the stage says whether THIS configuration can trace
+    #   (e.g. DataConversion to "string" cannot; VectorAssembler with
+    #   handleInvalid="skip" cannot — its output length is data-
+    #   dependent).
+    #
+    # Default = ``_trace`` absent → host-bound: the pipeline compiler
+    # (core/compile.py) runs the stage eagerly and splits the fused
+    # segment around it.
+    _trace = None
+
+    #: set True by stages whose _trace changes the row count (mini-
+    #: batchers, FlattenBatch): they can only fuse when EVERY column is
+    #: in the traced dict — a host-carried column could not be re-
+    #: attached to a different-length frame.
+    _trace_changes_rows = False
+
+    def supports_trace(self, schema: dict, n_rows: int | None = None
+                       ) -> bool:
+        """Can this stage instance lower into a fused segment for a
+        frame with this ``schema`` (``DataFrame.schema``)?"""
+        if getattr(type(self), "_trace", None) is None:
+            return False
+        try:
+            return bool(self._trace_ok(schema, n_rows))
+        except Exception:
+            return False
+
+    def _trace_ok(self, schema: dict, n_rows: int | None) -> bool:
+        """Per-stage static-shape contract; override to veto configs."""
+        return True
+
+    def _post_host(self, df: DataFrame) -> DataFrame:
+        """Host-side metadata hook applied after a fused segment that
+        contained this stage (partition counts, column metadata —
+        things that live on the DataFrame, not in the arrays)."""
+        return df
+
     def transform(self, df: DataFrame) -> DataFrame:
         with self.log_call("transform"):
             return self._transform(df)
@@ -123,15 +175,29 @@ class PipelineModel(Model):
                 cur = h.done(stage.transform(cur))
         return cur
 
+    def compile(self, example_df: DataFrame, *, mesh=None, rules=None,
+                donate: bool = True, service: str = "pipeline"):
+        """Lower this pipeline into a :class:`~.compile.CompiledPipeline`:
+        maximal runs of traceable stages fuse into single jitted (or,
+        with ``mesh``+``rules``, pjit'd) XLA computations with donated
+        inter-stage buffers; host-bound stages run eagerly between
+        segments. ``example_df`` drives schema propagation — segment
+        grouping needs each stage's OUTPUT schema, so the example is
+        transformed eagerly once at compile time."""
+        from .compile import compile_pipeline
+        return compile_pipeline(self, example_df, mesh=mesh, rules=rules,
+                                donate=donate, service=service)
+
 
 # ---------------------------------------------------------------- fluent API
 # Reference core/spark/FluentAPI.scala:12-30 — df.mlTransform(t1, t2),
 # df.mlFit(e): chain stages without building a Pipeline.
 def ml_transform(df: DataFrame, *stages: Transformer) -> DataFrame:
-    cur = df
-    for s in stages:
-        cur = s.transform(cur)
-    return cur
+    # Routed through PipelineModel._transform rather than a bare loop so
+    # the fluent entry point shares the pipeline profiler hook (and any
+    # future fused execution) with Pipeline.fit().transform() — bench
+    # numbers taken on either entry point measure the same path.
+    return PipelineModel(list(stages)).transform(df)
 
 
 def ml_fit(df: DataFrame, estimator: Estimator) -> Model:

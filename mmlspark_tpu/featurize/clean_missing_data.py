@@ -3,14 +3,18 @@
 Reference ``featurize/CleanMissingData.scala``: per-column cleaning with
 mean / median / custom replacement, fitted as a model so the replacement
 values learned on train data apply to test data.
+
+Fully jax.numpy: the fitted model's transform is a pure
+``where(isnan(x), fill, x)`` — the canonical traceable stage, fused
+into whole-pipeline XLA segments via ``_trace``.
 """
 
 from __future__ import annotations
 
-import numpy as np
-
 from ..core import Estimator, Model, Param, TypeConverters as TC
 from ..core.contracts import HasInputCols, HasOutputCols
+from ..core.dataframe import jittable_dtype
+from ..core.lazyjnp import jnp
 
 
 MEAN, MEDIAN, CUSTOM = "Mean", "Median", "Custom"
@@ -26,12 +30,12 @@ class CleanMissingData(Estimator, HasInputCols, HasOutputCols):
         mode = self.getCleaningMode()
         fills = {}
         for col in self.getInputCols():
-            arr = np.asarray(df[col], dtype=np.float64)
-            valid = arr[~np.isnan(arr)]
+            arr = jnp.asarray(df[col], dtype=jnp.float32)
+            valid = arr[~jnp.isnan(arr)]
             if mode == MEAN:
                 fills[col] = float(valid.mean()) if valid.size else 0.0
             elif mode == MEDIAN:
-                fills[col] = float(np.median(valid)) if valid.size else 0.0
+                fills[col] = float(jnp.median(valid)) if valid.size else 0.0
             elif mode == CUSTOM:
                 fills[col] = self.getCustomValue()
             else:
@@ -44,12 +48,26 @@ class CleanMissingData(Estimator, HasInputCols, HasOutputCols):
 class CleanMissingDataModel(Model, HasInputCols, HasOutputCols):
     fillValues = Param("fillValues", "column → replacement value", TC.toDict)
 
+    def _out_cols(self):
+        return self.get("outputCols") or self.getInputCols()
+
     def _transform(self, df):
         fills = self.getFillValues()
-        out_cols = self.get("outputCols") or self.getInputCols()
         cur = df
-        for in_col, out_col in zip(self.getInputCols(), out_cols):
-            arr = np.asarray(df[in_col], dtype=np.float64).copy()
-            arr[np.isnan(arr)] = fills[in_col]
+        for in_col, out_col in zip(self.getInputCols(), self._out_cols()):
+            arr = jnp.asarray(df[in_col], dtype=jnp.float32)
+            arr = jnp.where(jnp.isnan(arr), fills[in_col], arr)
             cur = cur.with_column(out_col, arr)
         return cur
+
+    def _trace_ok(self, schema, n_rows):
+        return all(c in schema and jittable_dtype(schema[c][0])
+                   for c in self.getInputCols())
+
+    def _trace(self, cols):
+        fills = self.getFillValues()
+        out = dict(cols)
+        for in_col, out_col in zip(self.getInputCols(), self._out_cols()):
+            x = cols[in_col].astype(jnp.float32)
+            out[out_col] = jnp.where(jnp.isnan(x), fills[in_col], x)
+        return out

@@ -2,22 +2,25 @@
 
 Reference ``featurize/CountSelector.scala``: drop feature-vector slots that
 are zero for every row (dead features inflate histogram work on device).
+
+The fitted model is a static gather over the kept slot indices — pure
+jax.numpy, fused into whole-pipeline XLA segments via ``_trace``.
 """
 
 from __future__ import annotations
 
-import numpy as np
-
 from ..core import Estimator, Model, Param
 from ..core.contracts import HasInputCol, HasOutputCol
+from ..core.dataframe import jittable_dtype, to_host_list
+from ..core.lazyjnp import jnp
 from ..core.utils import as_2d_features
 
 
 class CountSelector(Estimator, HasInputCol, HasOutputCol):
     def _fit(self, df):
-        x = as_2d_features(df, self.getInputCol())
-        keep = np.flatnonzero((x != 0).any(axis=0)).tolist()
-        model = CountSelectorModel().setIndices(keep)
+        x = jnp.asarray(as_2d_features(df, self.getInputCol()))
+        keep = to_host_list(jnp.flatnonzero(jnp.any(x != 0, axis=0)))
+        model = CountSelectorModel().setIndices([int(i) for i in keep])
         self._copy_params_to(model)
         return model
 
@@ -26,6 +29,19 @@ class CountSelectorModel(Model, HasInputCol, HasOutputCol):
     indices = Param("indices", "kept feature-slot indices")
 
     def _transform(self, df):
-        x = as_2d_features(df, self.getInputCol())
-        idx = np.asarray(self.getIndices(), dtype=np.int64)
+        x = jnp.asarray(as_2d_features(df, self.getInputCol()))
+        idx = jnp.asarray(self.getIndices(), dtype=jnp.int32)
         return df.with_column(self.getOutputCol(), x[:, idx])
+
+    def _trace_ok(self, schema, n_rows):
+        ic = self.getInputCol()
+        if ic not in schema:
+            return False
+        dtype, shape = schema[ic]
+        return jittable_dtype(dtype) and len(shape) == 1
+
+    def _trace(self, cols):
+        idx = jnp.asarray(self.getIndices(), dtype=jnp.int32)
+        out = dict(cols)
+        out[self.getOutputCol()] = cols[self.getInputCol()][:, idx]
+        return out

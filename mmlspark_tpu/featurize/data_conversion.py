@@ -2,6 +2,11 @@
 
 Reference ``featurize/DataConversion.scala``: cast a set of columns to a
 target type (boolean/byte/short/integer/long/float/double/string/date).
+
+Numeric targets are pure dtype casts (traceable — ``_trace`` maps them
+onto the nearest jax dtype inside a fused segment; the eager path keeps
+exact numpy dtypes, e.g. real float64, which XLA's f32-default world
+cannot represent). String/date targets are host conversions.
 """
 
 from __future__ import annotations
@@ -10,6 +15,7 @@ import numpy as np
 
 from ..core import Transformer, Param, TypeConverters as TC
 from ..core.contracts import HasInputCols
+from ..core.dataframe import jittable_dtype, object_column
 
 _CONVERSIONS = {
     "boolean": np.bool_,
@@ -22,6 +28,12 @@ _CONVERSIONS = {
     "string": object,
     "date": "datetime64[s]",
 }
+
+# targets a traced segment can produce (dtype casts XLA supports; jax
+# demotes 64-bit to 32-bit without x64, so long/double stay eager-exact
+# but trace-approximate — close enough for fused inference paths)
+_TRACEABLE_TARGETS = ("boolean", "byte", "short", "integer", "long",
+                      "float", "double")
 
 
 class DataConversion(Transformer, HasInputCols):
@@ -39,16 +51,28 @@ class DataConversion(Transformer, HasInputCols):
         for col in self.getInputCols():
             arr = df[col]
             if target == "string":
-                out = np.asarray([None if v is None else str(v)
-                                  for v in arr.tolist()], dtype=object)
+                out = object_column(None if v is None else str(v)
+                                    for v in arr)
             elif target == "date":
                 import pandas as pd
                 out = pd.to_datetime(
-                    pd.Series(arr.tolist()),
+                    pd.Series(list(arr)),
                     format=self.getDateTimeFormat()).to_numpy()
             else:
                 if arr.dtype == object:
-                    arr = np.asarray(arr.tolist(), dtype=np.float64)
+                    arr = arr.astype(np.float64)
                 out = arr.astype(_CONVERSIONS[target])
             cur = cur.with_column(col, out)
         return cur
+
+    def _trace_ok(self, schema, n_rows):
+        return self.getConvertTo() in _TRACEABLE_TARGETS and all(
+            c in schema and jittable_dtype(schema[c][0])
+            for c in self.getInputCols())
+
+    def _trace(self, cols):
+        target = _CONVERSIONS[self.getConvertTo()]
+        out = dict(cols)
+        for col in self.getInputCols():
+            out[col] = cols[col].astype(target)
+        return out

@@ -6,21 +6,22 @@ missing values are imputed, string/categorical columns are one-hot encoded
 (or hashed when cardinality exceeds the feature budget), vector columns are
 flattened, everything is assembled into a single fixed-width float vector —
 exactly the shape the TPU wants (a dense [n, d] matrix feeding the MXU).
+
+Numeric/vector/datetime encodings run through jax.numpy; string
+encodings (one-hot/hash) are host work in ``_hostenc``. A fitted model
+whose plan is numeric/vector-only carries a ``_trace`` form and fuses
+into whole-pipeline XLA segments.
 """
 
 from __future__ import annotations
 
-import zlib
-
-import numpy as np
-
 from ..core import Estimator, Model, Param, TypeConverters as TC
 from ..core.contracts import HasInputCols, HasOutputCol
+from ..core.dataframe import jittable_dtype, to_host
+from ..core.lazyjnp import jnp
+from ._hostenc import encode_hash, encode_onehot, stable_hash
 
-
-def _stable_hash(value: str, seed: int = 0) -> int:
-    """Deterministic cross-process string hash (crc32-based)."""
-    return zlib.crc32(value.encode("utf-8"), seed) & 0x7FFFFFFF
+_ = stable_hash  # re-exported for callers that hashed through this module
 
 
 class Featurize(Estimator, HasInputCols, HasOutputCol):
@@ -48,13 +49,20 @@ class Featurize(Estimator, HasInputCols, HasOutputCol):
                 plan.append({"col": col, "kind": "vector",
                              "width": int(arr.shape[1])})
             elif arr.dtype == object:
-                sample = next((v for v in arr.tolist() if v is not None), None)
-                if isinstance(sample, (bytes, np.ndarray, list, tuple)):
-                    width = len(np.asarray(sample).ravel())
-                    plan.append({"col": col, "kind": "vector", "width": width})
+                sample = next((v for v in arr if v is not None), None)
+                # vector cells are ordered sequences (bytes, array,
+                # list, tuple) — dict/set cells have __len__ too but
+                # belong on the categorical path below
+                if isinstance(sample, bytes) or (
+                        sample is not None
+                        and not isinstance(sample, (str, dict, set,
+                                                    frozenset))
+                        and hasattr(sample, "__len__")):
+                    width = int(to_host(sample).ravel().size)
+                    plan.append({"col": col, "kind": "vector",
+                                 "width": width})
                     continue
-                levels = sorted({str(v) for v in arr.tolist()
-                                 if v is not None})
+                levels = sorted({str(v) for v in arr if v is not None})
                 if (self.getOneHotEncodeCategoricals()
                         and len(levels) <= self.getMaxOneHotCardinality()):
                     plan.append({"col": col, "kind": "onehot",
@@ -66,8 +74,8 @@ class Featurize(Estimator, HasInputCols, HasOutputCol):
                 plan.append({"col": col, "kind": "numeric", "width": 1,
                              "fill": 0.0})
             elif arr.dtype.kind in "iuf":
-                vals = np.asarray(arr, dtype=np.float64)
-                valid = vals[~np.isnan(vals)]
+                vals = jnp.asarray(arr, dtype=jnp.float32)
+                valid = vals[~jnp.isnan(vals)]
                 fill = float(valid.mean()) if (self.getImputeMissing()
                                                and valid.size) else 0.0
                 plan.append({"col": col, "kind": "numeric", "width": 1,
@@ -80,6 +88,11 @@ class Featurize(Estimator, HasInputCols, HasOutputCol):
         model = FeaturizeModel().setEncodingPlan(plan)
         self._copy_params_to(model)
         return model
+
+
+#: plan kinds whose encodings are pure jnp over numeric columns — the
+#: fusable subset (strings/datetime need host conversion)
+_TRACEABLE_KINDS = frozenset({"numeric", "vector"})
 
 
 class FeaturizeModel(Model, HasInputCols, HasOutputCol):
@@ -106,6 +119,20 @@ class FeaturizeModel(Model, HasInputCols, HasOutputCol):
                 names.extend(f"{col}_{i}" for i in range(w))
         return names
 
+    def _encode_numeric(self, x, spec: dict):
+        """[n] numeric → [n, 1] float32 with NaN imputation (shared by
+        the eager and traced paths — pure jnp)."""
+        vals = x.astype(jnp.float32).reshape(-1, 1)
+        return jnp.where(jnp.isnan(vals), jnp.float32(spec["fill"]), vals)
+
+    def _encode_vector(self, x, n: int, spec: dict):
+        mat = x.astype(jnp.float32).reshape(n, -1)
+        if mat.shape[1] != spec["width"]:
+            raise ValueError(
+                f"vector column {spec['col']!r} width {mat.shape[1]} "
+                f"!= fitted width {spec['width']}")
+        return mat
+
     def _transform(self, df):
         n = df.num_rows
         blocks = []
@@ -113,45 +140,60 @@ class FeaturizeModel(Model, HasInputCols, HasOutputCol):
             arr = df[spec["col"]]
             kind = spec["kind"]
             if kind == "numeric":
-                vals = np.asarray(arr, dtype=np.float32).reshape(n, 1)
-                nan = np.isnan(vals)
-                if nan.any():
-                    vals = np.where(nan, np.float32(spec["fill"]), vals)
-                blocks.append(vals)
+                blocks.append(self._encode_numeric(jnp.asarray(arr), spec))
             elif kind == "vector":
                 if arr.dtype == object:
-                    mat = np.stack([np.asarray(v, dtype=np.float32).ravel()
-                                    for v in arr])
+                    mat = jnp.stack(
+                        [jnp.asarray(to_host(v),
+                                     dtype=jnp.float32).ravel()
+                         for v in arr])
+                    mat = self._encode_vector(mat, n, spec)
                 else:
-                    mat = np.asarray(arr, dtype=np.float32).reshape(n, -1)
-                if mat.shape[1] != spec["width"]:
-                    raise ValueError(
-                        f"vector column {spec['col']!r} width {mat.shape[1]} "
-                        f"!= fitted width {spec['width']}")
+                    mat = self._encode_vector(jnp.asarray(arr), n, spec)
                 blocks.append(mat)
             elif kind == "onehot":
-                lookup = {v: i for i, v in enumerate(spec["levels"])}
-                mat = np.zeros((n, spec["width"]), dtype=np.float32)
-                for i, v in enumerate(arr.tolist()):
-                    j = lookup.get(str(v))
-                    if j is not None:
-                        mat[i, j] = 1.0
-                blocks.append(mat)
+                blocks.append(jnp.asarray(
+                    encode_onehot(arr, spec["levels"], spec["width"])))
             elif kind == "hash":
-                mat = np.zeros((n, spec["width"]), dtype=np.float32)
-                for i, v in enumerate(arr.tolist()):
-                    if v is not None:
-                        mat[i, _stable_hash(str(v)) % spec["width"]] += 1.0
-                blocks.append(mat)
+                blocks.append(jnp.asarray(
+                    encode_hash(arr, spec["width"])))
             elif kind == "datetime":
-                vals = arr.astype("datetime64[s]").astype(np.float64)
-                blocks.append(vals.astype(np.float32).reshape(n, 1))
+                vals = arr.astype("datetime64[s]").astype("float64")
+                blocks.append(jnp.asarray(vals,
+                                          dtype=jnp.float32).reshape(n, 1))
             else:  # pragma: no cover
                 raise ValueError(f"unknown encoding kind {kind!r}")
-        features = np.concatenate(blocks, axis=1) if blocks else \
-            np.zeros((n, 0), dtype=np.float32)
-        out = df.with_column(self.getOutputCol(),
-                             np.ascontiguousarray(features))
+        features = jnp.concatenate(blocks, axis=1) if blocks else \
+            jnp.zeros((n, 0), dtype=jnp.float32)
+        out = df.with_column(self.getOutputCol(), features)
+        return self._attach_meta(out)
+
+    def _attach_meta(self, df):
         from ..core import ColumnMetadata
-        return ColumnMetadata.attach(out, self.getOutputCol(),
+        return ColumnMetadata.attach(df, self.getOutputCol(),
                                      {"slot_names": self.slot_names()})
+
+    def _trace_ok(self, schema, n_rows):
+        plan = self.getEncodingPlan() or []
+        return bool(plan) and all(
+            spec["kind"] in _TRACEABLE_KINDS
+            and spec["col"] in schema
+            and jittable_dtype(schema[spec["col"]][0])
+            for spec in plan)
+
+    def _trace(self, cols):
+        blocks = []
+        for spec in self.getEncodingPlan():
+            x = cols[spec["col"]]
+            if spec["kind"] == "numeric":
+                blocks.append(self._encode_numeric(x, spec))
+            else:  # vector
+                blocks.append(self._encode_vector(x, x.shape[0], spec))
+        out = dict(cols)
+        out[self.getOutputCol()] = jnp.concatenate(blocks, axis=1)
+        return out
+
+    def _post_host(self, df):
+        # fused segments rebuild the frame without column metadata;
+        # re-attach the slot names the traced output carries implicitly
+        return self._attach_meta(df)

@@ -4,14 +4,34 @@ Reference ``featurize/ValueIndexer.scala`` / ``IndexToValue.scala`` +
 categorical metadata (``core/schema/Categoricals.scala``): map arbitrary
 category values to dense integer indices (and back), recording the level
 order on the model so downstream stages (one-hot, label decoding) agree.
+
+Numeric level sets index through a ``searchsorted`` gather — pure
+jax.numpy, traceable into fused segments. String levels stay a host
+dict lookup (genuinely host-bound, like the tokenizers).
 """
 
 from __future__ import annotations
 
-import numpy as np
-
 from ..core import Estimator, Model, Param, TypeConverters as TC
 from ..core.contracts import HasInputCol, HasOutputCol
+from ..core.dataframe import (jittable_dtype, object_column, to_host,
+                              to_host_list, unique_host)
+from ..core.lazyjnp import jnp
+
+
+def _numeric_levels(levels) -> bool:
+    try:
+        if not all(isinstance(v, (int, float)) and not isinstance(v, bool)
+                   for v in levels):
+            return False
+    except TypeError:
+        return False
+    # int levels beyond the device's 32-bit lattice cannot build the
+    # traced lookup table (jnp.asarray raises OverflowError at trace
+    # time); the fit path keeps them int64-exact, so gate the traced
+    # form off and let the host dict lookup handle them
+    return all(-2 ** 31 <= v < 2 ** 31 for v in levels
+               if isinstance(v, int))
 
 
 class ValueIndexer(Estimator, HasInputCol, HasOutputCol):
@@ -20,10 +40,13 @@ class ValueIndexer(Estimator, HasInputCol, HasOutputCol):
     def _fit(self, df):
         col = df[self.getInputCol()]
         if col.dtype == object:
-            levels = sorted({v for v in col.tolist() if v is not None},
+            levels = sorted({v for v in col if v is not None},
                             key=lambda v: str(v))
         else:
-            levels = np.unique(col[~_isnan(col)]).tolist()
+            # fit-time uniqueness stays on host and EXACT: the fitted
+            # levels must equal the values transform will look up
+            # (unique_host's docstring has the 32-bit demotion story)
+            levels = to_host_list(unique_host(col, drop_nan=True))
         model = ValueIndexerModel().setLevels(list(levels))
         self._copy_params_to(model)
         return model
@@ -40,16 +63,42 @@ class ValueIndexerModel(Model, HasInputCol, HasOutputCol):
         lookup = {v: i for i, v in enumerate(levels)}
         col = df[self.getInputCol()]
         unknown = self.getUnknownIndex()
-        out = np.empty(len(col), dtype=np.int64)
-        for i, v in enumerate(col.tolist()):
+        out = []
+        for v in col:
             if v in lookup:
-                out[i] = lookup[v]
+                out.append(lookup[v])
             elif unknown >= 0:
-                out[i] = unknown
+                out.append(unknown)
             else:
                 raise ValueError(f"unseen value {v!r} in column "
                                  f"{self.getInputCol()!r}")
-        return df.with_column(self.getOutputCol(), out)
+        # this is the HOST lookup path (string levels can never fuse):
+        # stay on host — no device round-trip for a dict lookup. int32
+        # matches the traced form's output dtype
+        return df.with_column(self.getOutputCol(),
+                              to_host(out).astype("int32"))
+
+    def _trace_ok(self, schema, n_rows):
+        ic = self.getInputCol()
+        # the traced form cannot raise on unseen values: it needs a
+        # well-defined unknownIndex and numeric, sorted-comparable levels
+        return ic in schema and jittable_dtype(schema[ic][0]) \
+            and self.getUnknownIndex() >= 0 \
+            and _numeric_levels(self.getLevels())
+
+    def _trace(self, cols):
+        levels = jnp.asarray(sorted(self.getLevels()))
+        x = cols[self.getInputCol()]
+        idx = jnp.clip(jnp.searchsorted(levels, x), 0, levels.size - 1)
+        hit = levels[idx] == x
+        # map the sorted position back to the DECLARED level order
+        order = jnp.asarray(
+            [self.getLevels().index(v)
+             for v in sorted(self.getLevels())], dtype=jnp.int32)
+        out = dict(cols)
+        out[self.getOutputCol()] = jnp.where(
+            hit, order[idx], self.getUnknownIndex()).astype(jnp.int32)
+        return out
 
 
 class IndexToValue(Model, HasInputCol, HasOutputCol):
@@ -59,19 +108,22 @@ class IndexToValue(Model, HasInputCol, HasOutputCol):
 
     def _transform(self, df):
         levels = self.getLevels()
-        idx = df[self.getInputCol()].astype(np.int64)
-        values = np.empty(len(idx), dtype=object)
-        for i, j in enumerate(idx):
-            values[i] = levels[j]
-        arr = np.asarray(values)
+        idx = df[self.getInputCol()].astype(int)
+        values = object_column(levels[int(j)] for j in idx)
         try:
-            arr = arr.astype(type(levels[0])) if levels else arr
+            arr = values.astype(type(levels[0])) if levels else values
         except (ValueError, TypeError):
-            pass
+            arr = values
         return df.with_column(self.getOutputCol(), arr)
 
+    def _trace_ok(self, schema, n_rows):
+        ic = self.getInputCol()
+        return ic in schema and jittable_dtype(schema[ic][0]) \
+            and _numeric_levels(self.getLevels())
 
-def _isnan(arr: np.ndarray) -> np.ndarray:
-    if arr.dtype.kind == "f":
-        return np.isnan(arr)
-    return np.zeros(arr.shape[0], dtype=bool)
+    def _trace(self, cols):
+        levels = jnp.asarray(self.getLevels())
+        out = dict(cols)
+        out[self.getOutputCol()] = levels[
+            cols[self.getInputCol()].astype(jnp.int32)]
+        return out

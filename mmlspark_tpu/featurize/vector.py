@@ -6,8 +6,14 @@ ecosystem leans on (tested at
 ``core/ml/OneHotEncoderSpec.scala``; ``Featurize`` composes the same
 operations internally, ``featurize/Featurize.scala:36``). Standalone
 stages so user pipelines can assemble/encode without the full
-auto-featurizer — the TPU design keeps them host-side numpy: both are
-data-plumbing (concatenation, indexing), not compute.
+auto-featurizer.
+
+Both are pure data movement (concatenate, compare-and-select) over
+jax.numpy, so both carry ``_trace`` forms and fuse into whole-pipeline
+XLA segments — with two static-shape caveats: ``handleInvalid="skip"``
+makes the assembler's output length data-dependent (host-bound), and
+"error" modes must raise on bad data, which a traced program cannot
+(only "keep" modes fuse).
 """
 
 from __future__ import annotations
@@ -17,21 +23,28 @@ import numpy as np
 from ..core import Estimator, Model, Transformer, Param, \
     TypeConverters as TC
 from ..core.contracts import HasInputCol, HasInputCols, HasOutputCol
+from ..core.dataframe import jittable_dtype, to_host
+from ..core.lazyjnp import jnp
 
 
-def _as_matrix(arr, n: int, col: str) -> np.ndarray:
+def _as_matrix(arr, n: int, col: str):
     """One column → [n, w] float32 (scalars become w=1)."""
     if arr.dtype == object:
         try:
-            return np.stack([np.asarray(v, np.float32).ravel()
-                             for v in arr])
-        except ValueError as e:
+            return jnp.stack([jnp.asarray(to_host(v), jnp.float32).ravel()
+                              for v in arr])
+        except (ValueError, TypeError) as e:
             raise ValueError(
                 f"column {col!r} has ragged/non-numeric vector rows: "
                 f"{e}") from e
-    if arr.ndim == 1:
-        return np.asarray(arr, np.float32).reshape(n, 1)
-    return np.asarray(arr, np.float32).reshape(n, -1)
+    return _matrixify(jnp.asarray(arr, jnp.float32), n)
+
+
+def _matrixify(x, n: int):
+    """[n] or [n, ...] → [n, w] (the traced-path reshape; no host)."""
+    if x.ndim == 1:
+        return x.reshape(n, 1)
+    return x.reshape(n, -1)
 
 
 class VectorAssembler(Transformer, HasInputCols, HasOutputCol):
@@ -51,22 +64,43 @@ class VectorAssembler(Transformer, HasInputCols, HasOutputCol):
     def _transform(self, df):
         n = df.num_rows
         blocks = [_as_matrix(df[c], n, c) for c in self.getInputCols()]
-        mat = np.concatenate(blocks, axis=1) if blocks else \
-            np.zeros((n, 0), np.float32)
-        bad = np.isnan(mat).any(axis=1)
+        mat = jnp.concatenate(blocks, axis=1) if blocks else \
+            jnp.zeros((n, 0), jnp.float32)
+        bad = jnp.isnan(mat).any(axis=1)
         mode = self.get("handleInvalid")
         if mode not in ("error", "keep", "skip"):
             raise ValueError(
                 f"handleInvalid={mode!r} is not one of error|keep|skip")
-        if bad.any():
+        if bool(bad.any()):
             if mode == "error":
                 raise ValueError(
                     f"{int(bad.sum())} rows contain NaN; set "
                     "handleInvalid='keep' or 'skip'")
             if mode == "skip":
-                df = df.take(np.flatnonzero(~bad))
-                mat = mat[~bad]
+                keep = to_host(~bad)
+                df = df.take(keep.nonzero()[0])
+                mat = mat[keep]
         return df.with_column(self.getOutputCol(), mat)
+
+    def _trace_ok(self, schema, n_rows):
+        # "skip" drops rows (data-dependent length); "error" must raise
+        # on NaN — neither has a static traced form
+        if self.get("handleInvalid") != "keep":
+            return False
+        return all(c in schema and jittable_dtype(schema[c][0])
+                   and len(schema[c][1]) <= 1
+                   for c in self.getInputCols())
+
+    def _trace(self, cols):
+        first = cols[self.getInputCols()[0]] if self.getInputCols() \
+            else next(iter(cols.values()))
+        n = first.shape[0]
+        blocks = [_matrixify(cols[c].astype(jnp.float32), n)
+                  for c in self.getInputCols()]
+        out = dict(cols)
+        out[self.getOutputCol()] = jnp.concatenate(blocks, axis=1) \
+            if blocks else jnp.zeros((n, 0), jnp.float32)
+        return out
 
 
 class OneHotEncoder(Estimator, HasInputCol, HasOutputCol):
@@ -82,11 +116,12 @@ class OneHotEncoder(Estimator, HasInputCol, HasOutputCol):
                           TC.toString, default="error", has_default=True)
 
     def _fit(self, df):
-        idx = np.asarray(df[self.getInputCol()])
-        if idx.dtype.kind not in "iuf":
+        raw = df[self.getInputCol()]
+        if raw.dtype.kind not in "iuf":
             raise TypeError("OneHotEncoder expects numeric category "
-                            f"indices, got dtype {idx.dtype}")
-        if idx.size and (idx < 0).any():
+                            f"indices, got dtype {raw.dtype}")
+        idx = jnp.asarray(raw)
+        if idx.size and bool((idx < 0).any()):
             raise ValueError("category indices must be non-negative")
         size = int(idx.max()) + 1 if idx.size else 0
         model = OneHotEncoderModel().set("categorySize", size)
@@ -103,21 +138,46 @@ class OneHotEncoderModel(Model, HasInputCol, HasOutputCol):
                           "error|keep for out-of-range indices",
                           TC.toString, default="error", has_default=True)
 
-    def _transform(self, df):
+    def _widths(self) -> tuple[int, int]:
         size = self.get("categorySize")
-        drop = self.get("dropLast")
         keep_invalid = self.get("handleInvalid") == "keep"
-        idx = np.asarray(df[self.getInputCol()]).astype(np.int64)
         width = size + (1 if keep_invalid else 0)
+        out_width = width - (1 if self.get("dropLast") else 0)
+        return size, max(out_width, 0)
+
+    def _encode(self, idx, out_width: int):
+        """[n] int indices → [n, out_width] one-hot (pure jnp; the
+        shared body of the eager and traced paths). Out-of-range
+        indices must already be mapped to the catch-all slot by the
+        caller (``jnp.where(oob, size, idx)``)."""
+        return (idx[:, None] == jnp.arange(out_width)[None, :]) \
+            .astype(jnp.float32)
+
+    def _transform(self, df):
+        size, out_width = self._widths()
+        keep_invalid = self.get("handleInvalid") == "keep"
+        idx = jnp.asarray(to_host(df[self.getInputCol()]).astype(np.int64))
         oob = (idx < 0) | (idx >= size)
-        if oob.any():
+        if bool(oob.any()):
             if not keep_invalid:
                 raise ValueError(
                     f"{int(oob.sum())} indices outside the fitted "
                     f"[0, {size}) range; set handleInvalid='keep'")
-            idx = np.where(oob, size, idx)  # catch-all slot
-        out_width = width - (1 if drop else 0)
-        mat = np.zeros((len(idx), max(out_width, 0)), np.float32)
-        valid = idx < out_width
-        mat[np.flatnonzero(valid), idx[valid]] = 1.0
-        return df.with_column(self.getOutputCol(), mat)
+            idx = jnp.where(oob, size, idx)  # catch-all slot
+        return df.with_column(self.getOutputCol(),
+                              self._encode(idx, out_width))
+
+    def _trace_ok(self, schema, n_rows):
+        ic = self.getInputCol()
+        # "error" must raise on out-of-range — host-bound by contract
+        return ic in schema and jittable_dtype(schema[ic][0]) \
+            and self.get("handleInvalid") == "keep" \
+            and len(schema[ic][1]) == 0
+
+    def _trace(self, cols):
+        size, out_width = self._widths()
+        idx = cols[self.getInputCol()].astype(jnp.int32)
+        idx = jnp.where((idx < 0) | (idx >= size), size, idx)
+        out = dict(cols)
+        out[self.getOutputCol()] = self._encode(idx, out_width)
+        return out

@@ -125,6 +125,21 @@ class ServingStream:
         self._stages.append(stage)
         return self
 
+    def compile_pipeline(self, example_df, **compile_kw):
+        """Lower the transform chain added so far into ONE
+        :class:`~mmlspark_tpu.core.compile.CompiledPipeline`: maximal
+        runs of traceable stages fuse into single jitted XLA segments
+        (donated inter-stage buffers), host-bound stages keep running
+        eagerly between them. ``example_df`` must look like the frames
+        the executor will build (typically ``{"id", "request"}`` plus
+        whatever ``parse_request`` produces) — it drives the schema
+        propagation that decides segment boundaries."""
+        from ..core.compile import compile_pipeline
+        compile_kw.setdefault("service", "serving")
+        self._stages = [compile_pipeline(self._stages, example_df,
+                                         **compile_kw)]
+        return self
+
     def parse_request(self, parser=None):
         """Add a stage turning the raw request into a value column
         (reference ``ServingImplicits.parseRequest``). Default: body text →
@@ -157,6 +172,15 @@ class ServingStream:
                 out[:] = [make_reply_udf(fn(v)) for v in df[col]]
                 df = df.with_column("reply", out)
             return df
+
+        # surface fused-pipeline dispatch counts to the executor's
+        # FeatureLog rows (ServingQuery reads transform_fn.compiled_segments).
+        # None = compile_pipeline never ran; 0 = it ran and everything
+        # stayed host-bound — operators auditing fusion coverage need
+        # the distinction
+        segs = [s.compiled_segments for s in stages
+                if hasattr(s, "compiled_segments")]
+        run.compiled_segments = sum(segs) if segs else None
 
         self.server.start()
         return ServingQuery(self.server, run, name=name,

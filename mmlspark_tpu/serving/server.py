@@ -572,6 +572,11 @@ class ServingQuery:
         n = len(batch)
         bucket = bucket_of(n)
         tenancy = self.server.scheduler.tenancy
+        # fused-pipeline transparency: a CompiledPipeline transform_fn
+        # (or a DSL chain that compiled one) reports how many XLA
+        # segments — i.e. device dispatches for the traced portion —
+        # served this request; None = plain host path
+        segments = getattr(self.transform_fn, "compiled_segments", None)
         for c in batch:
             sp = getattr(c, "span", None)
             if sp is not None:
@@ -589,6 +594,7 @@ class ServingQuery:
                 execute_ms=round(execute_s * 1e3, 4),
                 entity_bytes=len(getattr(c.request, "entity", b"")
                                  or b""),
+                compiled_segments=segments,
                 trace_id=(sp.trace_id if sp is not None else None))
             if tenancy is not None and tenant:
                 # the tenant's EWMA latency (queue + execute — what the

@@ -7,10 +7,9 @@ caching, timing.
 
 from __future__ import annotations
 
-import numpy as np
-
 from ..core import Transformer, Param, TypeConverters as TC, UDFParam
 from ..core.contracts import HasInputCol, HasInputCols, HasOutputCol
+from ..core.dataframe import jittable_dtype, object_column
 
 
 class DropColumns(Transformer):
@@ -21,6 +20,15 @@ class DropColumns(Transformer):
         present = [c for c in self.getCols() if c in df.columns]
         return df.drop(*present) if present else df
 
+    def _trace_ok(self, schema, n_rows):
+        # a dropped host-carried column would survive the segment
+        return all(jittable_dtype(schema[c][0])
+                   for c in self.getCols() if c in schema)
+
+    def _trace(self, cols):
+        drop = set(self.getCols())
+        return {c: v for c, v in cols.items() if c not in drop}
+
 
 class SelectColumns(Transformer):
     cols = Param("cols", "columns to keep", TC.toListString)
@@ -28,18 +36,46 @@ class SelectColumns(Transformer):
     def _transform(self, df):
         return df.select(*self.getCols())
 
+    def _trace_ok(self, schema, n_rows):
+        # selecting implicitly drops the rest — every column must be in
+        # the traced dict for the effect to be complete
+        return all(jittable_dtype(dt) for dt, _ in schema.values()) \
+            and all(c in schema for c in self.getCols())
+
+    def _trace(self, cols):
+        return {c: cols[c] for c in self.getCols()}
+
 
 class RenameColumn(Transformer, HasInputCol, HasOutputCol):
     def _transform(self, df):
         return df.with_column_renamed(self.getInputCol(), self.getOutputCol())
 
+    def _trace_ok(self, schema, n_rows):
+        ic = self.getInputCol()
+        return ic in schema and jittable_dtype(schema[ic][0])
+
+    def _trace(self, cols):
+        old, new = self.getInputCol(), self.getOutputCol()
+        return {(new if c == old else c): v for c, v in cols.items()}
+
 
 class UDFTransformer(Transformer, HasInputCol, HasInputCols, HasOutputCol):
     """Apply a user function to one or more columns (reference
     ``stages/UDFTransformer.scala``). The function receives numpy arrays
-    (whole-column, not per-row — columnar by design)."""
+    (whole-column, not per-row — columnar by design).
+
+    ``jitSafe=True`` declares the function a pure ``jax.numpy``
+    computation with static output shapes, letting the pipeline
+    compiler fuse this stage into an XLA segment (the udf then receives
+    tracers; a host-op inside it will fail the trace and fall back
+    eagerly, loudly). This is how model-inference stages ride the fused
+    serving path."""
 
     udf = UDFParam("udf", "function(column_array...) -> column_array")
+    jitSafe = Param("jitSafe",
+                    "udf is pure jax.numpy with static shapes (enables "
+                    "whole-pipeline fusion)", TC.toBoolean, default=False,
+                    has_default=True)
 
     def _transform(self, df):
         fn = self.get("udf")
@@ -48,6 +84,21 @@ class UDFTransformer(Transformer, HasInputCol, HasInputCols, HasOutputCol):
         else:
             args = [df[self.getInputCol()]]
         return df.with_column(self.getOutputCol(), fn(*args))
+
+    def _in_cols(self):
+        return self.getInputCols() if self.isSet("inputCols") \
+            else [self.getInputCol()]
+
+    def _trace_ok(self, schema, n_rows):
+        return self.get("jitSafe") and all(
+            c in schema and jittable_dtype(schema[c][0])
+            for c in self._in_cols())
+
+    def _trace(self, cols):
+        out = dict(cols)
+        out[self.getOutputCol()] = self.get("udf")(
+            *[cols[c] for c in self._in_cols()])
+        return out
 
 
 class Lambda(Transformer):
@@ -87,6 +138,12 @@ class Repartition(Transformer):
             return df
         return df.repartition(self.getN())
 
+    def _trace(self, cols):
+        return cols  # partition count is host metadata, not array data
+
+    def _post_host(self, df):
+        return df if self.getDisable() else df.repartition(self.getN())
+
 
 class Cacher(Transformer):
     disable = Param("disable", "no-op passthrough", TC.toBoolean,
@@ -95,23 +152,30 @@ class Cacher(Transformer):
     def _transform(self, df):
         return df if self.getDisable() else df.cache()
 
+    def _trace(self, cols):
+        return cols  # cache() is a host-side no-op on materialized data
+
 
 class Explode(Transformer, HasInputCol, HasOutputCol):
     """Explode a list column into one row per element (reference
-    ``stages/Explode.scala``)."""
+    ``stages/Explode.scala``).
+
+    Output length is the SUM of per-row list lengths — data-dependent,
+    so no static-shape ``_trace`` exists and the pipeline compiler
+    splits fused segments around it (its host plumbing is free of
+    numpy scratch work, but dynamic shapes cannot lower to XLA)."""
 
     def _transform(self, df):
         col = df[self.getInputCol()]
-        lengths = np.asarray([len(v) for v in col.tolist()])
-        idx = np.repeat(np.arange(df.num_rows), lengths)
-        exploded = np.empty(int(lengths.sum()), dtype=object)
-        k = 0
-        for v in col.tolist():
+        idx: list[int] = []
+        exploded: list = []
+        for i, v in enumerate(col):
             for item in v:
-                exploded[k] = item
-                k += 1
+                idx.append(i)
+                exploded.append(item)
         out = df.take(idx)
-        return out.with_column(self.getOutputCol(), exploded)
+        return out.with_column(self.getOutputCol(),
+                               object_column(exploded))
 
 
 class Timer(Transformer):

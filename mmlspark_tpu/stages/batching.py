@@ -17,18 +17,36 @@ from typing import Iterator
 import numpy as np
 
 from ..core import DataFrame, Transformer, Param, TypeConverters as TC
+from ..core.dataframe import (argsort_host, concat_host, jittable_dtype,
+                              object_column, repeat_rows, to_host)
+from ..core.lazyjnp import jnp
 
 
 def _batch_df(df: DataFrame, bounds: list[tuple[int, int]]) -> DataFrame:
-    """Rows → one row per (start, end) batch; each cell becomes an array."""
+    """Rows → one row per (start, end) batch; each cell becomes an array.
+    Cells are views of the source columns (slicing, no scratch buffer);
+    the object column wrapper is the one host allocation."""
     data = {}
     for col in df.columns:
         arr = df[col]
-        cells = np.empty(len(bounds), dtype=object)
-        cells[:] = [arr[a:b] for a, b in bounds]
-        data[col] = cells
+        data[col] = object_column([arr[a:b] for a, b in bounds])
     out = DataFrame(data)
     out.num_partitions = df.num_partitions
+    return out
+
+
+def _uniform_batch_trace(cols: dict, size: int) -> dict:
+    """The jnp mini-batch path: [n, ...] → [n/size, size, ...] (or one
+    [1, n, ...] batch when size >= n). Static shapes — n is concrete at
+    trace time, so the reshape is a free layout change XLA folds away;
+    this replaces the per-column host scratch buffer entirely."""
+    out = {}
+    for c, v in cols.items():
+        n = v.shape[0]
+        if size >= n:
+            out[c] = v[None]
+        else:
+            out[c] = v.reshape((n // size, size) + v.shape[1:])
     return out
 
 
@@ -37,25 +55,55 @@ class FixedMiniBatchTransformer(Transformer):
     maxBufferSize = Param("maxBufferSize", "kept for API parity", TC.toInt,
                           default=1 << 20)
 
+    _trace_changes_rows = True
+
     def _transform(self, df):
         size = self.getBatchSize()
         n = df.num_rows
         bounds = [(i, min(i + size, n)) for i in range(0, n, size)]
         return _batch_df(df, bounds)
 
+    def _trace_ok(self, schema, n_rows):
+        if not n_rows:
+            return False
+        size = self.getBatchSize()
+        return size >= n_rows or n_rows % size == 0
+
+    def _trace(self, cols):
+        return _uniform_batch_trace(cols, self.getBatchSize())
+
 
 class DynamicMiniBatchTransformer(Transformer):
     """One batch per partition (the dynamic batcher consumes whatever is
-    available — in columnar form, a partition is 'what's available')."""
+    available — in columnar form, a partition is 'what's available').
+
+    This stage sits in every served batch pipeline, so its traced form
+    matters most: one batch of everything available is a pure
+    ``[n, ...] → [1, n, ...]`` expand — zero host work, fully fusable
+    (the ``numpy.empty`` scratch buffer is gone; the eager path slices
+    views and only wraps them in an object column)."""
 
     maxBatchSize = Param("maxBatchSize", "upper bound on batch size",
                          TC.toInt, default=1 << 30)
+
+    _trace_changes_rows = True
 
     def _transform(self, df):
         size = min(self.getMaxBatchSize(), max(df.num_rows, 1))
         n = df.num_rows
         bounds = [(i, min(i + size, n)) for i in range(0, n, size)] or []
         return _batch_df(df, bounds)
+
+    def _trace_ok(self, schema, n_rows):
+        if not n_rows:
+            return False
+        size = min(self.getMaxBatchSize(), max(n_rows, 1))
+        return size >= n_rows or n_rows % size == 0
+
+    def _trace(self, cols):
+        n = max((v.shape[0] for v in cols.values()), default=1)
+        return _uniform_batch_trace(
+            cols, min(self.getMaxBatchSize(), max(n, 1)))
 
 
 class TimeIntervalMiniBatchTransformer(Transformer):
@@ -71,13 +119,18 @@ class TimeIntervalMiniBatchTransformer(Transformer):
     maxBatchSize = Param("maxBatchSize", "upper bound on batch size",
                          TC.toInt, default=1 << 30)
 
+    _trace_changes_rows = True
+
     def _transform(self, df):
         n = df.num_rows
         if not self.isSet("timestampCol"):
             bounds = [(0, n)] if n else []
             return _batch_df(df, bounds)
-        ts = np.asarray(df[self.getTimestampCol()], dtype=np.int64)
-        order = np.argsort(ts, kind="stable")
+        ts = df[self.getTimestampCol()].astype(np.int64)
+        # stable host argsort: epoch-millis are int64 and must sort
+        # exactly (argsort_host's docstring has the 2**31-wrap story);
+        # the windowing loop below relies on stability
+        order = argsort_host(ts)
         sorted_df = df.take(order)
         ts = ts[order]
         window = self.getMillisToWait()
@@ -90,9 +143,19 @@ class TimeIntervalMiniBatchTransformer(Transformer):
                 start = i
         return _batch_df(sorted_df, bounds)
 
+    def _trace_ok(self, schema, n_rows):
+        # window boundaries are data-dependent; only the no-timestamp
+        # single-batch form has static shapes
+        return bool(n_rows) and not self.isSet("timestampCol")
+
+    def _trace(self, cols):
+        return {c: v[None] for c, v in cols.items()}
+
 
 class FlattenBatch(Transformer):
     """Inverse of the mini-batchers: list-valued rows → one row per element."""
+
+    _trace_changes_rows = True
 
     def _transform(self, df):
         cols = df.columns
@@ -103,7 +166,7 @@ class FlattenBatch(Transformer):
             cells = df[c]
             if cells.dtype == object and len(cells) and \
                     hasattr(cells[0], "__len__"):
-                lengths = np.asarray([len(v) for v in cells.tolist()])
+                lengths = [len(v) for v in cells]
                 break
         if lengths is None:
             return df
@@ -112,23 +175,32 @@ class FlattenBatch(Transformer):
             cells = df[c]
             if cells.dtype == object and hasattr(cells[0], "__len__") and \
                     not isinstance(cells[0], str):
-                parts = [np.asarray(v) for v in cells.tolist()]
+                parts = [to_host(v) for v in cells]
                 if parts and parts[0].dtype != object and \
                         all(p.ndim == parts[0].ndim for p in parts):
-                    data[c] = np.concatenate(parts, axis=0)
+                    # numeric cells: concatenate on host in the cells'
+                    # own dtype — int64 epoch millis from the
+                    # time-interval batcher must not round through the
+                    # device's 32-bit lattice on the eager path
+                    data[c] = concat_host(parts)
                 else:
-                    flat = np.empty(int(lengths.sum()), dtype=object)
-                    k = 0
-                    for v in cells.tolist():
-                        for item in v:
-                            flat[k] = item
-                            k += 1
-                    data[c] = flat
+                    data[c] = object_column(
+                        item for v in cells for item in v)
             else:
-                data[c] = np.repeat(cells, lengths, axis=0)
+                data[c] = repeat_rows(cells, lengths)
         out = DataFrame(data)
         out.num_partitions = df.num_partitions
         return out
+
+    def _trace_ok(self, schema, n_rows):
+        # the traced form merges the two leading axes of every column:
+        # all columns must be batched (trailing shape present)
+        return bool(schema) and all(
+            jittable_dtype(dt) and len(shape) >= 1
+            for dt, shape in schema.values())
+
+    def _trace(self, cols):
+        return {c: v.reshape((-1,) + v.shape[2:]) for c, v in cols.items()}
 
 
 class DynamicBufferedBatcher:
@@ -207,4 +279,10 @@ class PartitionConsolidator(Transformer):
     to a single partition while preserving rows."""
 
     def _transform(self, df):
+        return df.repartition(1)
+
+    def _trace(self, cols):
+        return cols  # partition collapse is host metadata
+
+    def _post_host(self, df):
         return df.repartition(1)

@@ -2,6 +2,11 @@
 
 Reference ``stages/``: SummarizeData, ClassBalancer, StratifiedRepartition,
 EnsembleByKey, TextPreprocessor, UnicodeNormalize (SURVEY §2.9).
+
+Numeric compute here runs through ``jax.numpy`` (eagerly outside a
+pipeline, traced inside a fused segment where a ``_trace`` form exists);
+string normalization stays plain Python — those loops are genuinely
+host work and keep their stages out of fused segments at runtime.
 """
 
 from __future__ import annotations
@@ -14,6 +19,9 @@ import numpy as np
 from ..core import DataFrame, Estimator, Model, Transformer, Param, \
     TypeConverters as TC
 from ..core.contracts import HasInputCol, HasLabelCol, HasOutputCol, HasSeed
+from ..core.dataframe import (f32_exact, jittable_dtype, quantile_host,
+                              to_host, to_host_list, unique_host)
+from ..core.lazyjnp import jnp, jrandom
 
 
 class SummarizeData(Transformer):
@@ -37,37 +45,50 @@ class SummarizeData(Transformer):
         for col in df.columns:
             arr = df[col]
             row = {"Feature": col}
+            numeric = arr.dtype.kind in "iuf" and arr.ndim == 1
+            hostlike = arr.dtype == object or arr.dtype.kind in "MmUS"
+            valid = None
+            if numeric:
+                # profiling output, not device math: stats stay on host
+                # in the column's own dtype so float64 columns don't
+                # merge distinct values (or degrade mean/quantiles)
+                # through the device's 32-bit lattice
+                x = to_host(arr)
+                nan = x != x
+                valid = x[~nan]
             if self.getCounts():
                 row["Count"] = float(len(arr))
-                row["Unique Value Count"] = float(len(set(map(str, arr.tolist())))) \
-                    if arr.dtype == object else float(np.unique(arr[~_nan(arr)]).size)
-                row["Missing Value Count"] = float(_nan(arr).sum()) if \
-                    arr.dtype != object else float(sum(v is None for v in arr))
-            numeric = arr.dtype.kind in "iuf" and arr.ndim == 1
+                if hostlike:
+                    row["Unique Value Count"] = float(
+                        len({str(v) for v in arr}))
+                    row["Missing Value Count"] = float(
+                        sum(v is None for v in arr)) \
+                        if arr.dtype == object else 0.0
+                elif numeric:
+                    row["Unique Value Count"] = float(
+                        unique_host(valid).size)
+                    row["Missing Value Count"] = float(nan.sum())
+                else:
+                    row["Unique Value Count"] = float(
+                        unique_host(to_host(arr)).size)
+                    row["Missing Value Count"] = 0.0
             if self.getBasic():
-                if numeric:
-                    vals = arr[~_nan(arr)].astype(np.float64)
-                    row.update({"Mean": float(vals.mean()) if vals.size else np.nan,
-                                "Std": float(vals.std(ddof=1)) if vals.size > 1 else np.nan,
-                                "Min": float(vals.min()) if vals.size else np.nan,
-                                "Max": float(vals.max()) if vals.size else np.nan})
+                if numeric and valid.size:
+                    row.update({
+                        "Mean": float(valid.mean()),
+                        "Std": float(valid.std(ddof=1))
+                        if valid.size > 1 else np.nan,
+                        "Min": float(valid.min()),
+                        "Max": float(valid.max())})
                 else:
                     row.update({"Mean": np.nan, "Std": np.nan,
                                 "Min": np.nan, "Max": np.nan})
             if self.getSample():
-                vals = arr[~_nan(arr)].astype(np.float64) if numeric else \
-                    np.empty(0)
                 for p in self.getPercentiles():
-                    row[f"Quantile_{p}"] = float(np.quantile(vals, p)) \
-                        if vals.size else np.nan
+                    row[f"Quantile_{p}"] = quantile_host(valid, p) \
+                        if numeric and valid.size else np.nan
             rows.append(row)
         return DataFrame.from_rows(rows)
-
-
-def _nan(arr):
-    if arr.dtype.kind == "f":
-        return np.isnan(arr)
-    return np.zeros(len(arr), dtype=bool)
 
 
 class ClassBalancer(Estimator, HasInputCol):
@@ -81,10 +102,22 @@ class ClassBalancer(Estimator, HasInputCol):
 
     def _fit(self, df):
         col = df[self.getInputCol()]
-        values, counts = np.unique(col, return_counts=True)
-        weights = counts.max() / counts.astype(np.float64)
+        if col.dtype == object:
+            counts: dict[str, int] = {}
+            for v in col:
+                counts[str(v)] = counts.get(str(v), 0) + 1
+        else:
+            # EXACT host uniqueness: weight keys are str(value) and
+            # _transform looks up str() of the exact column values — a
+            # device round-trip would store float32-rounded keys that
+            # the lookup then misses (unique_host's docstring)
+            values, cnts = unique_host(col, return_counts=True)
+            counts = {str(v): int(c)
+                      for v, c in zip(to_host_list(values),
+                                      to_host_list(cnts))}
+        top = max(counts.values())
         model = ClassBalancerModel().setWeights(
-            {str(v): float(w) for v, w in zip(values.tolist(), weights)})
+            {k: float(top) / c for k, c in counts.items()})
         self._copy_params_to(model)
         return model
 
@@ -97,8 +130,41 @@ class ClassBalancerModel(Model, HasInputCol):
     def _transform(self, df):
         w = self.getWeights()
         col = df[self.getInputCol()]
-        out = np.asarray([w[str(v)] for v in col.tolist()], dtype=np.float64)
-        return df.with_column(self.getOutputCol(), out)
+        # look up str() of the same Python values fit stored: str(numpy
+        # float32 scalar) is the SHORT repr ('0.1') while fit's keys
+        # came from to_host_list (Python floats → '0.10000000149…')
+        vals = col if col.dtype == object else to_host_list(col)
+        return df.with_column(self.getOutputCol(),
+                              [w[str(v)] for v in vals])
+
+    def _trace_ok(self, schema, n_rows):
+        ic = self.getInputCol()
+        if ic not in schema or not jittable_dtype(schema[ic][0]):
+            return False
+        try:
+            keys = [float(k) for k in self.getWeights()]
+        except (TypeError, ValueError):
+            return False  # non-numeric class labels: host dict lookup
+        # keys that don't survive a float32 round-trip would collide
+        # with a neighbor (ints ≥ 2**24) or miss in the traced
+        # searchsorted — stay on the exact host lookup
+        return all(f32_exact(k) for k in keys)
+
+    def _trace(self, cols):
+        items = sorted((float(k), float(v))
+                       for k, v in self.getWeights().items())
+        keys = jnp.asarray([k for k, _ in items])
+        vals = jnp.asarray([v for _, v in items])
+        x = cols[self.getInputCol()]
+        idx = jnp.clip(jnp.searchsorted(keys, x), 0, len(items) - 1)
+        out = dict(cols)
+        # a traced computation cannot raise on an unseen label the way
+        # the eager dict lookup does (KeyError) — gate on an exact key
+        # match and emit NaN instead of silently borrowing the nearest
+        # class's weight; NaN poisons downstream losses loudly
+        out[self.getOutputCol()] = jnp.where(keys[idx] == x, vals[idx],
+                                             jnp.nan)
+        return out
 
 
 class StratifiedRepartition(Transformer, HasLabelCol, HasSeed):
@@ -112,21 +178,23 @@ class StratifiedRepartition(Transformer, HasLabelCol, HasSeed):
 
     def _transform(self, df):
         labels = df[self.getLabelCol()]
-        rng = np.random.default_rng(self.getSeed())
-        order = []
-        # Round-robin interleave per label so contiguous block partitioning
-        # gives each partition a balanced label mix.
-        by_label = {}
-        for v in np.unique(labels):
-            idx = np.flatnonzero(labels == v)
-            rng.shuffle(idx)
-            by_label[v] = list(idx)
-        pools = list(by_label.values())
+        groups: dict[str, list[int]] = {}
+        for i, v in enumerate(labels):
+            groups.setdefault(str(v), []).append(i)
+        key = jrandom.PRNGKey(self.getSeed())
+        pools = []
+        for k in sorted(groups):
+            key, sub = jrandom.split(key)
+            pools.append(list(to_host_list(
+                jrandom.permutation(sub, jnp.asarray(groups[k])))))
+        order: list[int] = []
+        # Round-robin interleave per label so contiguous block
+        # partitioning gives each partition a balanced label mix.
         while any(pools):
             for pool in pools:
                 if pool:
                     order.append(pool.pop())
-        return df.take(np.asarray(order, dtype=np.int64))
+        return df.take(order)
 
 
 class EnsembleByKey(Transformer):
@@ -142,8 +210,7 @@ class EnsembleByKey(Transformer):
 
     def _transform(self, df):
         keys, cols = self.getKeys(), self.getCols()
-        key_arrays = [df[k] for k in keys]
-        key_tuples = list(zip(*[a.tolist() for a in key_arrays]))
+        key_tuples = list(zip(*[list(df[k]) for k in keys]))
         groups: dict = {}
         for i, kt in enumerate(key_tuples):
             groups.setdefault(kt, []).append(i)
@@ -152,17 +219,23 @@ class EnsembleByKey(Transformer):
             row = dict(zip(keys, kt))
             for c in cols:
                 arr = df[c]
-                vals = np.stack([np.asarray(arr[i], dtype=np.float64)
-                                 for i in idxs]) if arr.dtype == object else \
-                    np.asarray(arr[idxs], dtype=np.float64)
-                row[f"mean({c})"] = vals.mean(axis=0)
+                if arr.dtype == object:
+                    vals = jnp.stack(
+                        [jnp.asarray(to_host(arr[i]), dtype=jnp.float32)
+                         for i in idxs])
+                else:
+                    vals = jnp.asarray(arr[idxs], dtype=jnp.float32)
+                mean = vals.mean(axis=0)
+                row[f"mean({c})"] = float(mean) if mean.ndim == 0 \
+                    else to_host(mean)
             rows.append(row)
         return DataFrame.from_rows(rows)
 
 
 class TextPreprocessor(Transformer, HasInputCol, HasOutputCol):
     """Trie-based string normalization map (reference
-    ``stages/TextPreprocessor.scala``)."""
+    ``stages/TextPreprocessor.scala``). Pure host string work, by
+    nature — never enters a fused segment."""
 
     map = Param("map", "substring → replacement", TC.toDict, default={},
                 has_default=True)
@@ -178,18 +251,19 @@ class TextPreprocessor(Transformer, HasInputCol, HasOutputCol):
             pattern = re.compile("|".join(
                 re.escape(k) for k in sorted(mapping, key=len, reverse=True)))
         col = df[self.getInputCol()]
-        out = np.empty(len(col), dtype=object)
-        for i, v in enumerate(col.tolist()):
+        out = []
+        for v in col:
             s = norm(v) if v is not None else v
             if s is not None and pattern is not None:
                 s = pattern.sub(lambda m: mapping[m.group(0)], s)
-            out[i] = s
+            out.append(s)
         return df.with_column(self.getOutputCol(), out)
 
 
 class UnicodeNormalize(Transformer, HasInputCol, HasOutputCol):
     """Unicode NFC/NFKC/... normalization (reference
-    ``stages/UnicodeNormalize.scala``)."""
+    ``stages/UnicodeNormalize.scala``). Host string work, like
+    TextPreprocessor."""
 
     form = Param("form", "NFC | NFD | NFKC | NFKD", TC.toString,
                  default="NFKC")
@@ -199,11 +273,11 @@ class UnicodeNormalize(Transformer, HasInputCol, HasOutputCol):
     def _transform(self, df):
         form, lower = self.getForm(), self.getLower()
         col = df[self.getInputCol()]
-        out = np.empty(len(col), dtype=object)
-        for i, v in enumerate(col.tolist()):
+        out = []
+        for v in col:
             if v is None:
-                out[i] = None
+                out.append(None)
             else:
                 s = unicodedata.normalize(form, v)
-                out[i] = s.lower() if lower else s
+                out.append(s.lower() if lower else s)
         return df.with_column(self.getOutputCol(), out)

@@ -982,3 +982,140 @@ def mixed_tenant_scenario(*, service: str = "tenant-bench",
         "count_samples": samples,
         "schedule": sorted(schedule),
     }
+
+
+# --------------------------------------------------- whole-pipeline fusion
+def _fusion_pipelines(n_rows: int, width: int, seed: int = 7):
+    """The two benchmark pipelines of the whole-pipeline-compilation
+    acceptance (ISSUE 10): a featurize→infer→postproc chain shaped like
+    the image-featurizer serving path (dense feature matrix through a
+    model head), and a text featurize→encoder chain whose tokenizer is
+    genuinely host-bound (string ops split the fused span)."""
+    import numpy as np
+    import jax.numpy as jnp
+
+    from ..core import DataFrame, PipelineModel
+    from ..featurize import CleanMissingData, VectorAssembler
+    from ..stages import SelectColumns, UDFTransformer
+
+    rng = np.random.default_rng(seed)
+
+    # -- featurizer pipeline: clean → assemble → model head → postproc
+    feat_df = DataFrame({
+        "img": rng.normal(size=(n_rows, width)).astype(np.float32),
+        "aux": np.where(rng.random(n_rows) < 0.25, np.nan,
+                        rng.normal(size=n_rows)).astype(np.float32),
+    })
+    w1 = jnp.asarray(rng.normal(size=(width + 1, 128)) * 0.05,
+                     jnp.float32)
+    w2 = jnp.asarray(rng.normal(size=(128, 10)) * 0.05, jnp.float32)
+    clean = CleanMissingData(inputCols=["aux"],
+                             cleaningMode="Mean").fit(feat_df)
+    feat_pm = PipelineModel([
+        clean,
+        VectorAssembler(inputCols=["img", "aux"], outputCol="features",
+                        handleInvalid="keep"),
+        UDFTransformer(inputCol="features", outputCol="logits",
+                       jitSafe=True,
+                       udf=lambda f: jnp.tanh(f @ w1) @ w2),
+        UDFTransformer(inputCol="logits", outputCol="pred", jitSafe=True,
+                       udf=lambda z: jnp.argmax(z, axis=-1)
+                       .astype(jnp.float32)),
+        SelectColumns(cols=["pred"]),
+    ])
+
+    # -- text pipeline: host tokenizer → embed+encode (BERT-shaped) → pool
+    seq, vocab, dim = 16, 512, 64
+    texts = np.empty(n_rows, object)
+    texts[:] = [" ".join(rng.choice(["the", "cat", "sat", "on", "mat",
+                                     "dog", "ran", "fast", "tpu", "jit"],
+                                    size=8)) for _ in range(n_rows)]
+    text_df = DataFrame({"text": texts})
+
+    def tokenize(col):
+        # genuinely host-bound: python string hashing per token
+        ids = np.zeros((len(col), seq), np.int32)
+        for i, s in enumerate(col):
+            for j, tok in enumerate(str(s).split()[:seq]):
+                ids[i, j] = (hash(tok) & 0x7FFFFFFF) % vocab
+        return ids
+
+    emb = jnp.asarray(rng.normal(size=(vocab, dim)) * 0.05, jnp.float32)
+    wq = jnp.asarray(rng.normal(size=(dim, dim)) * 0.05, jnp.float32)
+    wo = jnp.asarray(rng.normal(size=(dim, 8)) * 0.05, jnp.float32)
+
+    def encode(ids):
+        x = emb[ids]                     # [n, seq, dim]
+        a = jnp.einsum("nsd,de,nte->nst", x, wq, x)
+        a = a / jnp.sqrt(jnp.float32(dim))
+        x = x + jnp.einsum("nst,ntd->nsd", a, x)
+        return jnp.tanh(x.mean(axis=1) @ wo)   # [n, 8]
+
+    text_pm = PipelineModel([
+        UDFTransformer(inputCol="text", outputCol="ids", udf=tokenize),
+        UDFTransformer(inputCol="ids", outputCol="enc", jitSafe=True,
+                       udf=encode),
+        UDFTransformer(inputCol="enc", outputCol="score", jitSafe=True,
+                       udf=lambda e: e.sum(axis=-1)),
+        SelectColumns(cols=["score"]),
+    ])
+    return (feat_pm, feat_df, "pred"), (text_pm, text_df, "score")
+
+
+def _bench_pipeline(pm, df, out_col: str, reps: int) -> dict:
+    """Median e2e latency of eager per-stage vs compiled execution, the
+    fused path's dispatch count, and bit-equivalence of the outputs."""
+    import numpy as np
+
+    cp = pm.compile(df)
+    eager_out = pm.transform(df)
+    fused_out = cp.transform(df)        # warmup = the one compile
+    diff = float(np.max(np.abs(
+        np.asarray(eager_out[out_col], np.float32)
+        - np.asarray(fused_out[out_col], np.float32)))) \
+        if len(df) else 0.0
+
+    def _median(fn) -> float:
+        times = []
+        for _ in range(reps):
+            t0 = time.perf_counter()
+            fn(df)
+            times.append(time.perf_counter() - t0)
+        times.sort()
+        return times[len(times) // 2]
+
+    eager_s = _median(pm.transform)
+    fused_s = _median(cp.transform)
+    return {
+        "eager_ms": eager_s * 1e3,
+        "fused_ms": fused_s * 1e3,
+        "speedup": eager_s / max(fused_s, 1e-9),
+        "segments": cp.compiled_segments,
+        "eager_stages_in_plan": cp.eager_stages,
+        # device dispatches for the traced portion + host stages that
+        # still run between segments — the per-request dispatch count
+        "dispatches": cp.compiled_segments + cp.eager_stages,
+        "max_abs_diff": diff,
+        "equivalent": bool(diff <= 1e-5),
+        "plan": cp.describe(),
+    }
+
+
+def pipeline_fusion_scenario(*, n_rows: int = 64, width: int = 64,
+                             reps: int = 30) -> dict:
+    """Fused vs per-stage pipeline execution (whole-pipeline XLA
+    compilation acceptance): the featurizer pipeline must fuse into ≤ 2
+    dispatches per request and run ≥ 3× faster end to end than eager
+    per-stage execution, bit-equivalent within 1e-5."""
+    feat, text = _fusion_pipelines(n_rows, width)
+    feat_r = _bench_pipeline(*feat, reps=reps)
+    text_r = _bench_pipeline(*text, reps=reps)
+    return {
+        "featurizer": feat_r,
+        "text": text_r,
+        "featurizer_fused_le_2_dispatches": bool(
+            feat_r["dispatches"] <= 2),
+        "featurizer_speedup_ge_3x": bool(feat_r["speedup"] >= 3.0),
+        "all_equivalent": bool(feat_r["equivalent"]
+                               and text_r["equivalent"]),
+    }

@@ -1,0 +1,594 @@
+"""Whole-pipeline XLA compilation (core/compile.py + the traceable-stage
+protocol): per-stage fused-vs-eager equivalence for every newly
+traceable stage, segment grouping around host-bound stages, the
+compile-once CompileTracker regression, the fluent-API profiling route,
+runtime fallback, serving integration, and the traceable-count ratchet.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+
+import numpy as np
+import pytest
+
+from mmlspark_tpu.core import DataFrame, PipelineModel, compile_pipeline
+from mmlspark_tpu.core.compile import FusedSegment
+from mmlspark_tpu.core.dataframe import object_column
+
+
+def jnp():
+    import jax.numpy as jnp
+    return jnp
+
+
+def num_df(n=8, width=3, nan=False, seed=0):
+    rng = np.random.default_rng(seed)
+    aux = rng.normal(size=n).astype(np.float32)
+    if nan:
+        aux[::3] = np.nan
+    return DataFrame({
+        "a": rng.normal(size=(n, width)).astype(np.float32),
+        "b": aux,
+        "c": rng.integers(0, 4, size=n).astype(np.int64),
+    })
+
+
+def _stage_cases():
+    """(name, stage, df) for every newly-TRACEABLE stage that carries a
+    ``_trace`` form — the fused output must match eager ``_transform``
+    on the same columns (atol 1e-6)."""
+    from mmlspark_tpu.featurize import (CleanMissingData, CountSelector,
+                                        DataConversion, Featurize,
+                                        IndexToValue, OneHotEncoder,
+                                        ValueIndexer, VectorAssembler)
+    from mmlspark_tpu.stages import (Cacher, ClassBalancer, DropColumns,
+                                     DynamicMiniBatchTransformer,
+                                     FixedMiniBatchTransformer,
+                                     FlattenBatch, PartitionConsolidator,
+                                     RenameColumn, Repartition,
+                                     SelectColumns,
+                                     TimeIntervalMiniBatchTransformer,
+                                     UDFTransformer)
+
+    df = num_df(nan=True)
+    batched = DataFrame({
+        "v": np.arange(12, dtype=np.float32).reshape(4, 3),
+        "w": np.arange(24, dtype=np.float32).reshape(4, 3, 2),
+    })
+    idx_df = DataFrame({"i": np.asarray([0, 2, 1, 1], np.int64)})
+    cases = [
+        ("DropColumns", DropColumns(cols=["b"]), df),
+        ("SelectColumns", SelectColumns(cols=["a", "c"]), df),
+        ("RenameColumn", RenameColumn(inputCol="b", outputCol="b2"), df),
+        ("UDFTransformer",
+         UDFTransformer(inputCol="b", outputCol="d", jitSafe=True,
+                        udf=lambda b: b * 2.0), num_df()),
+        ("Cacher", Cacher(), df),
+        ("Repartition", Repartition(n=2), df),
+        ("PartitionConsolidator", PartitionConsolidator(), df),
+        ("FixedMiniBatchTransformer",
+         FixedMiniBatchTransformer(batchSize=4), num_df()),
+        ("DynamicMiniBatchTransformer", DynamicMiniBatchTransformer(),
+         num_df()),
+        ("TimeIntervalMiniBatchTransformer",
+         TimeIntervalMiniBatchTransformer(), num_df()),
+        ("FlattenBatch", FlattenBatch(), batched),
+        ("CleanMissingDataModel",
+         CleanMissingData(inputCols=["b"],
+                          cleaningMode="Median").fit(df), df),
+        ("DataConversion",
+         DataConversion(inputCols=["c"], convertTo="float"), num_df()),
+        ("CountSelectorModel",
+         CountSelector(inputCol="a", outputCol="a2").fit(num_df()),
+         num_df()),
+        ("ValueIndexerModel",
+         ValueIndexer(inputCol="c", outputCol="ci").fit(num_df())
+         .copy({"unknownIndex": 0}), num_df(seed=1)),
+        ("IndexToValue",
+         IndexToValue(inputCol="i", outputCol="v")
+         .setLevels([10.0, 20.0, 30.0]), idx_df),
+        ("OneHotEncoderModel",
+         OneHotEncoder(inputCol="i", outputCol="oh",
+                       handleInvalid="keep").fit(idx_df), idx_df),
+        ("VectorAssembler",
+         VectorAssembler(inputCols=["a", "b"], outputCol="f",
+                         handleInvalid="keep"), num_df(nan=True)),
+        ("FeaturizeModel",
+         Featurize(inputCols=["a", "b"], outputCol="f").fit(df), df),
+        ("ClassBalancerModel",
+         ClassBalancer(inputCol="c", outputCol="w").fit(num_df()),
+         num_df()),
+    ]
+    return cases
+
+
+def _as_dense(col):
+    """Eager object-cell columns (mini-batchers) → stacked numeric."""
+    if col.dtype == object:
+        return np.stack([np.asarray(v, np.float32) for v in col])
+    return np.asarray(col, np.float32)
+
+
+class TestFusedEagerEquivalence:
+    @pytest.mark.parametrize(
+        "name,stage,df", _stage_cases(),
+        ids=[c[0] for c in _stage_cases()])
+    def test_trace_matches_transform(self, name, stage, df):
+        assert stage.supports_trace(df.schema, df.num_rows), \
+            f"{name} must accept this schema"
+        cols = {c: jnp().asarray(df[c]) for c in df.columns}
+        traced = stage._trace(dict(cols))
+        eager = stage._transform(df)
+        for c in traced:
+            if c in eager.columns:
+                np.testing.assert_allclose(
+                    _as_dense(eager[c]).reshape(-1),
+                    np.asarray(traced[c], np.float32).reshape(-1),
+                    atol=1e-6, err_msg=f"{name} column {c!r}")
+
+    @pytest.mark.parametrize(
+        "name,stage,df", _stage_cases(),
+        ids=[c[0] for c in _stage_cases()])
+    def test_compiled_single_stage_pipeline(self, name, stage, df):
+        cp = compile_pipeline([stage], df)
+        assert cp.compiled_segments == 1 and cp.eager_stages == 0
+        out = cp.transform(df)
+        eager = stage.transform(df)
+        for c in eager.columns:
+            np.testing.assert_allclose(
+                _as_dense(eager[c]).reshape(-1),
+                _as_dense(out[c]).reshape(-1),
+                atol=1e-6, err_msg=f"{name} column {c!r}")
+
+
+class TestSegmentGrouping:
+    def _host_stage(self):
+        from mmlspark_tpu.stages import TextPreprocessor
+        return TextPreprocessor(inputCol="t", outputCol="t2",
+                                normFunc="lower")
+
+    def _jit_stage(self, out="d", k=2.0):
+        from mmlspark_tpu.stages import UDFTransformer
+        return UDFTransformer(inputCol="v", outputCol=out, jitSafe=True,
+                              udf=lambda v: v * k)
+
+    def _mixed_df(self):
+        return DataFrame({
+            "t": object_column(["A", "B", "C", "D"]),
+            "v": np.arange(4, dtype=np.float32)})
+
+    def test_host_stage_splits_segment(self):
+        df = self._mixed_df()
+        cp = compile_pipeline(
+            [self._jit_stage("d1"), self._host_stage(),
+             self._jit_stage("d2", 3.0)], df)
+        kinds = [p["kind"] for p in cp.describe()]
+        assert kinds == ["fused", "eager", "fused"]
+        assert cp.compiled_segments == 2
+        out = cp.transform(df)
+        assert out["d1"].tolist() == [0.0, 2.0, 4.0, 6.0]
+        assert out["d2"].tolist() == [0.0, 3.0, 6.0, 9.0]
+        assert out["t2"].tolist() == ["a", "b", "c", "d"]
+
+    def test_maximal_run_fuses_once(self):
+        df = DataFrame({"v": np.arange(4, dtype=np.float32)})
+        cp = compile_pipeline(
+            [self._jit_stage("d1"), self._jit_stage("d2"),
+             self._jit_stage("d3")], df)
+        assert cp.compiled_segments == 1 and cp.fused_stages == 3
+
+    def test_all_host_pipeline_degrades_to_eager(self):
+        df = self._mixed_df()
+        cp = compile_pipeline([self._host_stage()], df)
+        assert cp.compiled_segments == 0 and cp.eager_stages == 1
+        pm = PipelineModel([self._host_stage()])
+        assert cp.transform(df)["t2"].tolist() == \
+            pm.transform(df)["t2"].tolist()
+
+    def test_empty_pipeline(self):
+        df = self._mixed_df()
+        cp = compile_pipeline([], df)
+        assert cp.compiled_segments == 0
+        out = cp.transform(df)
+        assert out.columns == df.columns
+
+    def test_row_changing_stage_needs_all_numeric(self):
+        # a mini-batcher cannot fuse when a host string column would
+        # have to be re-attached to a reshaped frame
+        from mmlspark_tpu.stages import DynamicMiniBatchTransformer
+        cp = compile_pipeline([DynamicMiniBatchTransformer()],
+                              self._mixed_df())
+        assert cp.compiled_segments == 0 and cp.eager_stages == 1
+
+
+class TestCompileTrackerRegression:
+    def test_fused_pipeline_compiles_once_not_per_stage(self):
+        from mmlspark_tpu.obs.profile import compile_tracker
+        df = DataFrame({"v": np.arange(8, dtype=np.float32)})
+        from mmlspark_tpu.stages import UDFTransformer
+        stages = [UDFTransformer(inputCol="v", outputCol=f"o{i}",
+                                 jitSafe=True, udf=lambda v, i=i: v + i)
+                  for i in range(4)]
+        cp = compile_pipeline(stages, df, service="compile-once-test")
+        assert cp.compiled_segments == 1
+        seg = cp.plan[0].name
+        for _ in range(6):
+            cp.transform(df)
+        # ONE compile for the whole 4-stage pipeline across 6 calls —
+        # not one per stage, not one per call
+        assert compile_tracker.compiles(seg) == 1
+        assert compile_tracker.calls(seg) == 6
+
+    def test_runtime_shape_mismatch_falls_back_eager(self):
+        from mmlspark_tpu.obs.metrics import registry
+        from mmlspark_tpu.stages import FixedMiniBatchTransformer
+        example = DataFrame({"v": np.arange(8, dtype=np.float32)})
+        cp = compile_pipeline([FixedMiniBatchTransformer(batchSize=4)],
+                              example, service="fallback-test")
+        assert cp.compiled_segments == 1
+        odd = DataFrame({"v": np.arange(7, dtype=np.float32)})
+        out = cp.transform(odd)           # 7 % 4 != 0: reshape fails
+        eager = FixedMiniBatchTransformer(batchSize=4).transform(odd)
+        assert [v.tolist() for v in out["v"]] == \
+            [v.tolist() for v in eager["v"]]
+        snap = registry.snapshot()
+        key = 'pipeline_fused_fallback_total{segment="fallback-test:seg0"}'
+        assert snap.get(key, 0) >= 1
+
+
+class TestFluentApiProfiledRoute:
+    def test_ml_transform_hits_pipeline_profiler(self):
+        from mmlspark_tpu.obs.metrics import MetricsRegistry
+        from mmlspark_tpu.obs.profile import (StepProfiler,
+                                              disable_pipeline_profiling,
+                                              enable_pipeline_profiling)
+        from mmlspark_tpu.stages import DropColumns
+        reg = MetricsRegistry()
+        try:
+            enable_pipeline_profiling(StepProfiler(registry=reg))
+            df = num_df()
+            out = df.mlTransform(DropColumns(cols=["b"]))
+            assert "b" not in out.columns
+            snap = reg.snapshot()
+            assert snap.get(
+                'profile_steps_total{stage="DropColumns"}', 0) >= 1
+        finally:
+            disable_pipeline_profiling()
+
+
+class TestServingFusedPath:
+    def test_dsl_compiled_pipeline_serves_and_logs_segments(self):
+        import http.client
+
+        from mmlspark_tpu.io.http.schema import HTTPRequestData
+        from mmlspark_tpu.obs.profile import feature_log
+        from mmlspark_tpu.serving.dsl import read_stream
+        from mmlspark_tpu.stages import UDFTransformer
+
+        def parse(col):
+            return np.asarray([float(r.entity or b"0") for r in col],
+                              np.float32)
+
+        example = DataFrame({
+            "id": object_column(["x"]),
+            "request": object_column(
+                [HTTPRequestData(entity=b"1.5")]),
+        })
+        feature_log.clear()
+        q = (read_stream().server()
+             .address("127.0.0.1", 0, "fused")
+             .load()
+             .transform(UDFTransformer(inputCol="request",
+                                       outputCol="value", udf=parse))
+             .transform(UDFTransformer(inputCol="value",
+                                       outputCol="doubled", jitSafe=True,
+                                       udf=lambda v: v * 2.0))
+             .compile_pipeline(
+                 example.withColumn("value",
+                                    np.asarray([1.5], np.float32)))
+             .with_reply(lambda v: str(float(v)), input_col="doubled")
+             .start())
+        try:
+            host, port = q.server.address
+            conn = http.client.HTTPConnection(host, port, timeout=10)
+            conn.request("POST", "/fused", body=b"21.0")
+            resp = conn.getresponse()
+            body = resp.read()
+            assert resp.status == 200
+            assert float(body) == 42.0
+            conn.close()
+            recs = feature_log.snapshot()
+            assert recs, "executor must append a feature record"
+            assert recs[-1]["compiled_segments"] == 1
+        finally:
+            q.stop()
+
+
+class TestTraceableRatchet:
+    def test_committed_report_meets_floor(self):
+        """The burn-down's floor: the committed traceability report
+        must keep >= 35 of the 57 stages TRACEABLE (run_ci.py enforces
+        the same ratchet in the analysis gate)."""
+        path = os.path.join(os.path.dirname(__file__), os.pardir,
+                            "mmlspark_tpu", "analysis",
+                            "traceability.json")
+        with open(path, encoding="utf-8") as f:
+            report = json.load(f)
+        assert report["summary"]["traceable"] >= 35
+        assert report["summary"]["stages"] == 57
+
+
+class TestCompiledPipelineSurface:
+    def test_describe_and_counts(self):
+        from mmlspark_tpu.stages import DropColumns
+        df = num_df()
+        cp = compile_pipeline([DropColumns(cols=["b"])], df)
+        d = cp.describe()
+        assert d[0]["kind"] == "fused"
+        assert d[0]["stages"] == ["DropColumns"]
+        assert isinstance(cp.plan[0], FusedSegment)
+
+    def test_pipeline_model_compile_entry_point(self):
+        from mmlspark_tpu.stages import UDFTransformer
+        df = DataFrame({"v": np.arange(4, dtype=np.float32)})
+        pm = PipelineModel([UDFTransformer(
+            inputCol="v", outputCol="o", jitSafe=True,
+            udf=lambda v: v + 1)])
+        cp = pm.compile(df)
+        np.testing.assert_allclose(cp.transform(df)["o"],
+                                   pm.transform(df)["o"])
+
+
+class TestFitExactness:
+    """Fit-time params must hold EXACT column values — routing fit
+    uniqueness/sort through the device rounds float64/int64 through
+    jax's 32-bit lattice and the fitted model then misses the very
+    values transform looks up (review regressions)."""
+
+    def test_value_indexer_float64_roundtrip(self):
+        from mmlspark_tpu.featurize import ValueIndexer
+        df = DataFrame({"c": np.asarray([0.1, 0.2, 0.3], np.float64)})
+        m = ValueIndexer(inputCol="c", outputCol="i").fit(df)
+        assert m.getLevels() == [0.1, 0.2, 0.3]
+        # default unknownIndex=-1 raises on unseen — same frame must
+        # index cleanly
+        np.testing.assert_array_equal(m.transform(df)["i"], [0, 1, 2])
+
+    def test_value_indexer_int64_beyond_int32(self):
+        from mmlspark_tpu.featurize import ValueIndexer
+        df = DataFrame({"c": np.asarray([2**31, 2**31 + 5], np.int64)})
+        m = ValueIndexer(inputCol="c", outputCol="i").fit(df)
+        assert m.getLevels() == [2**31, 2**31 + 5]
+
+    def test_class_balancer_float64_keys(self):
+        from mmlspark_tpu.stages import ClassBalancer
+        df = DataFrame({"y": np.asarray([0.1, 0.1, 0.2], np.float64)})
+        m = ClassBalancer(inputCol="y").fit(df)
+        assert set(m.getWeights()) == {"0.1", "0.2"}
+        np.testing.assert_allclose(m.transform(df)["weight"],
+                                   [1.0, 1.0, 2.0])
+
+    def test_time_interval_batcher_int64_order(self):
+        from mmlspark_tpu.stages import TimeIntervalMiniBatchTransformer
+        # 1 ms apart but straddling the int32 wrap: a 32-bit sort
+        # inverts them
+        df = DataFrame({
+            "ts": np.asarray([2**31, 2**31 - 1], np.int64),
+            "v": np.asarray([1.0, 2.0], np.float32),
+        })
+        t = TimeIntervalMiniBatchTransformer(timestampCol="ts",
+                                             millisToWait=10**6)
+        first_batch_ts = t.transform(df)["ts"][0]
+        np.testing.assert_array_equal(first_batch_ts,
+                                      [2**31 - 1, 2**31])
+
+    def test_flatten_batch_int64_exact(self):
+        from mmlspark_tpu.stages import (FlattenBatch,
+                                         TimeIntervalMiniBatchTransformer)
+        ts = np.asarray([1_700_000_000_000, 1_700_000_000_001], np.int64)
+        df = DataFrame({"ts": ts, "v": np.asarray([1.0, 2.0], np.float32)})
+        batched = TimeIntervalMiniBatchTransformer(
+            timestampCol="ts", millisToWait=10**6).transform(df)
+        flat = FlattenBatch().transform(batched)
+        # the eager un-batch path must not round epoch millis through
+        # the device's int32 lattice (review regression)
+        assert flat["ts"].dtype == np.int64
+        np.testing.assert_array_equal(flat["ts"], ts)
+
+    def test_summarize_data_float64_unique_exact(self):
+        from mmlspark_tpu.stages import SummarizeData
+        df = DataFrame({"x": np.asarray([0.1, 0.1 + 1e-12, 5.0],
+                                        np.float64)})
+        out = SummarizeData().transform(df)
+        row = {c: out[c][0] for c in out.columns}
+        # 0.1 and 0.1+1e-12 merge in float32 — the profile must count
+        # them distinct (review regression)
+        assert row["Unique Value Count"] == 3.0
+        np.testing.assert_allclose(row["Mean"],
+                                   np.mean([0.1, 0.1 + 1e-12, 5.0]))
+
+    def test_value_indexer_model_big_levels_compile_eagerly(self):
+        from mmlspark_tpu.featurize import ValueIndexer
+        df = DataFrame({"c": np.asarray([2**31 + 5, 7], np.int64)})
+        m = ValueIndexer(inputCol="c", outputCol="i").fit(df)
+        m.set("unknownIndex", 99)
+        # levels beyond int32 cannot build the traced lookup table:
+        # the gate must veto (not crash compile with OverflowError)
+        assert not m._trace_ok({"c": (np.dtype(np.int64), ())}, 2)
+        cp = compile_pipeline([m], df, service="big-levels")
+        assert cp.compiled_segments == 0 and cp.eager_stages == 1
+        np.testing.assert_array_equal(cp.transform(df)["i"],
+                                      m.transform(df)["i"])
+
+    def test_post_host_runs_on_empty_frame(self):
+        from mmlspark_tpu.stages import Repartition
+        example = DataFrame({"v": np.arange(8, dtype=np.float32)})
+        cp = compile_pipeline([Repartition(n=3)], example,
+                              service="empty-post-host")
+        assert cp.compiled_segments == 1
+        empty = DataFrame({"v": np.zeros((0,), np.float32)})
+        out = cp.transform(empty)
+        # a 0-row frame is falsy — the _post_host repartition must not
+        # be dropped by a truthiness check (review regression)
+        assert out.num_partitions == 3
+
+    def test_with_column_zero_d_scalar_broadcasts(self):
+        df = DataFrame({"v": np.asarray([1.0, 2.0, 3.0], np.float32)})
+        # numpy and jnp 0-d scalars have __array__ AND shape — they
+        # must broadcast like Python scalars, not store a 0-d column
+        # (review regression)
+        out = df.with_column("s", np.float64(7.0))
+        np.testing.assert_array_equal(out["s"], [7.0, 7.0, 7.0])
+        import jax.numpy as jnp
+        out = df.with_column("m", jnp.asarray(df["v"]).mean())
+        assert out["m"].shape == (3,)
+        np.testing.assert_allclose(out["m"], [2.0, 2.0, 2.0])
+
+    def test_class_balancer_float32_labels(self):
+        from mmlspark_tpu.stages import ClassBalancer
+        # str(np.float32(0.1)) is '0.1' but fit stores the Python-float
+        # repr — transform must normalize to the same values fit saw
+        # (review regression: KeyError on every float32 label column)
+        df = DataFrame({"y": np.asarray([0.1, 0.1, 0.2], np.float32)})
+        m = ClassBalancer(inputCol="y").fit(df)
+        np.testing.assert_allclose(m.transform(df)["weight"],
+                                   [1.0, 1.0, 2.0])
+
+    def test_class_balancer_trace_vetoes_non_f32_exact_labels(self):
+        from mmlspark_tpu.stages import ClassBalancer
+        # 2**24 and 2**24+1 collide in float32: the traced searchsorted
+        # would give both labels one weight — gate must veto (review
+        # regression: silent fused-vs-eager divergence)
+        df = DataFrame({"y": np.asarray([2**24, 2**24 + 1, 2**24 + 1,
+                                         2**24 + 1], np.int64)})
+        m = ClassBalancer(inputCol="y").fit(df)
+        assert not m._trace_ok({"y": (np.dtype(np.int64), ())}, 4)
+        cp = compile_pipeline([m], df, service="f32-veto")
+        assert cp.compiled_segments == 0
+        np.testing.assert_allclose(cp.transform(df)["weight"],
+                                   m.transform(df)["weight"])
+
+    def test_class_balancer_trace_unseen_label_is_nan(self):
+        from mmlspark_tpu.stages import ClassBalancer
+        df = DataFrame({"y": np.asarray([0.0, 0.0, 1.0], np.float32)})
+        m = ClassBalancer(inputCol="y").fit(df)
+        out = m._trace({"y": np.asarray([0.0, 1.0, 2.0], np.float32)})
+        w = np.asarray(out["weight"])
+        # seen labels keep their exact weights; the unseen label gets
+        # NaN (a traced form cannot raise the eager KeyError) rather
+        # than silently borrowing a neighboring class's weight
+        assert w[0] == 1.0 and w[1] == 2.0 and np.isnan(w[2])
+
+
+class TestRuntimeSchemaDrift:
+    def test_row_changing_segment_with_host_column_runs_eagerly(self):
+        """A row-count-changing run fuses only when the COMPILE example
+        is all-numeric; a runtime frame carrying a host column must
+        degrade to eager execution, not a mis-aligned frame."""
+        from mmlspark_tpu.obs.metrics import registry
+        from mmlspark_tpu.stages import FixedMiniBatchTransformer
+
+        ex = DataFrame({"x": np.arange(8, dtype=np.float32)})
+        cp = compile_pipeline([FixedMiniBatchTransformer(batchSize=4)],
+                              ex)
+        before = registry.snapshot().get(
+            'pipeline_fused_fallback_total{segment="pipeline:seg0"}', 0)
+        rt = DataFrame({
+            "x": np.arange(8, dtype=np.float32),
+            "s": object_column([f"r{i}" for i in range(8)]),
+        })
+        got = cp.transform(rt)
+        assert got.num_rows == 2
+        assert len(got["s"]) == 2  # batched with the numeric column
+        after = registry.snapshot().get(
+            'pipeline_fused_fallback_total{segment="pipeline:seg0"}', 0)
+        assert after == before + 1
+
+    def test_host_numpy_segment_leaves_warning_filters_alone(self):
+        """Host-column segments never donate, so the donated-buffers
+        warning suppression must not be installed process-wide."""
+        import warnings
+
+        from mmlspark_tpu.stages import UDFTransformer
+
+        df = DataFrame({"v": np.arange(4, dtype=np.float32)})
+        cp = compile_pipeline([UDFTransformer(
+            inputCol="v", outputCol="o", jitSafe=True,
+            udf=lambda v: v * 2)], df)
+        n = len(warnings.filters)
+        cp.transform(df)
+        assert len(warnings.filters) == n
+
+
+class TestHostColumnDrift:
+    def test_select_columns_does_not_leak_host_column(self):
+        """A fused SelectColumns must not re-attach a host column the
+        compile example never showed — runtime host-set drift degrades
+        to eager execution (review regression)."""
+        from mmlspark_tpu.stages import SelectColumns
+        ex = DataFrame({"a": np.arange(4, dtype=np.float32),
+                        "b": np.arange(4, dtype=np.float32)})
+        cp = compile_pipeline([SelectColumns(cols=["a"])], ex)
+        rt = DataFrame({"a": np.arange(4, dtype=np.float32),
+                        "b": np.arange(4, dtype=np.float32),
+                        "s": object_column(list("wxyz"))})
+        got = cp.transform(rt)
+        assert got.columns == ["a"]  # eager semantics: 's' dropped
+
+    def test_drop_columns_drops_runtime_object_column(self):
+        from mmlspark_tpu.stages import DropColumns
+        ex = DataFrame({"a": np.arange(4, dtype=np.float32),
+                        "b": np.arange(4, dtype=np.float32)})
+        cp = compile_pipeline([DropColumns(cols=["b"])], ex)
+        rt = DataFrame({"a": np.arange(4, dtype=np.float32),
+                        "b": object_column(list("wxyz"))})
+        got = cp.transform(rt)
+        assert got.columns == ["a"]
+
+    def test_matching_host_set_still_fuses(self):
+        """Host columns present in BOTH example and runtime frames keep
+        the fused path (the serving case: id/request object columns on
+        every request)."""
+        from mmlspark_tpu.obs.metrics import registry
+        from mmlspark_tpu.stages import UDFTransformer
+        ex = DataFrame({"v": np.arange(4, dtype=np.float32),
+                        "id": object_column(list("abcd"))})
+        cp = compile_pipeline([UDFTransformer(
+            inputCol="v", outputCol="o", jitSafe=True,
+            udf=lambda v: v + 1)], ex)
+        seg = cp.plan[0]
+        before = registry.snapshot().get(
+            f'pipeline_fused_calls_total{{segment="{seg.name}"}}', 0)
+        got = cp.transform(ex)
+        np.testing.assert_allclose(got["o"], np.arange(4) + 1)
+        after = registry.snapshot().get(
+            f'pipeline_fused_calls_total{{segment="{seg.name}"}}', 0)
+        assert after == before + 1  # fused, not fallback
+
+
+class TestFeaturizeCellKinds:
+    def test_dict_cells_take_categorical_path(self):
+        """dict cells have __len__ but are not vectors — they must
+        one-hot/hash like any categorical (review regression: the
+        vector path crashed on float(dict))."""
+        from mmlspark_tpu.featurize import Featurize
+        df = DataFrame({"c": object_column(
+            [{"a": 1}, {"b": 2}, {"a": 1}, {"c": 3}])})
+        model = Featurize(inputCols=["c"]).fit(df)
+        out = model.transform(df)
+        feats = np.asarray(out[model.getOutputCol()], np.float32)
+        assert feats.shape[0] == 4
+        # identical dicts encode identically
+        np.testing.assert_array_equal(feats[0], feats[2])
+
+
+class TestValueIndexerHostPath:
+    def test_string_levels_stay_on_host_int32(self):
+        from mmlspark_tpu.featurize import ValueIndexer
+        df = DataFrame({"c": object_column(["b", "a", "b"])})
+        m = ValueIndexer(inputCol="c", outputCol="i").fit(df)
+        out = m.transform(df)["i"]
+        assert out.dtype == np.int32
+        np.testing.assert_array_equal(out, [1, 0, 1])
