@@ -1276,6 +1276,37 @@ def bench_pipeline_fusion(extras: dict) -> None:
         r["all_equivalent"])
 
 
+def bench_aot(extras: dict) -> None:
+    """AOT executable-store acceptance (ISSUE 11): compilation as a
+    build step, not a request-latency event. Banks the store build
+    wall time, the cold-vs-warm scale-up first-request latencies
+    against steady-state p99, store hit/miss counts, and the contract
+    flags — an autoscaler-added worker must serve its first request
+    with zero runtime compiles (``profile_runtime_compiles_total == 0``,
+    ``aot_store_hit_total >= 1``) within 2x steady-state p99, with
+    AOT-loaded output bit-equal to the runtime-compiled segments."""
+    from mmlspark_tpu.testing.benchmarks import aot_scale_up_scenario
+
+    r = aot_scale_up_scenario()
+    extras["aot_build_wall_s"] = round(r["build_wall_s"], 3)
+    extras["aot_store_entries"] = int(r["store_entries"])
+    extras["aot_steady_p99_ms"] = round(r["steady_p99_s"] * 1e3, 3)
+    extras["aot_cold_first_ms"] = round(r["cold_first_s"] * 1e3, 3)
+    extras["aot_warm_first_ms"] = round(r["warm_first_s"] * 1e3, 3)
+    extras["aot_cold_over_steady"] = round(r["cold_over_steady"], 1)
+    extras["aot_warm_over_steady"] = round(r["warm_over_steady"], 2)
+    extras["aot_store_hits"] = int(r["store_hits"])
+    extras["aot_store_misses"] = int(r["store_misses"])
+    extras["aot_runtime_compiles"] = int(r["runtime_compiles"])
+    extras["aot_scale_decision"] = r["scale_decision"]
+    extras["aot_warm_within_2x_steady"] = bool(
+        r["warm_within_2x_steady"])
+    extras["aot_zero_runtime_compiles"] = bool(
+        r["zero_runtime_compiles"])
+    extras["aot_warm_hit_ge_1"] = bool(r["warm_hit_ge_1"])
+    extras["aot_equivalent"] = bool(r["equivalent"])
+
+
 def bench_serving(extras: dict) -> None:
     """End-to-end HTTP request→jitted pipeline→response latency against
     the reference's ~1 ms continuous-mode figure."""
@@ -1875,6 +1906,10 @@ def main():
             # suite acquired (devices already up by this point)
             _watchdog(bench_pipeline_fusion, extras, "pipeline_fusion",
                       240.0)
+        if want("aot"):
+            # build-step compilation vs request-latency compilation on
+            # the acquired backend (store in a scenario-owned tmp dir)
+            _watchdog(bench_aot, extras, "aot", 240.0)
         if want("serving"):
             # includes a small GBDT fit for the real-model row
             _watchdog(bench_serving, extras, "serving", 360.0)

@@ -112,6 +112,11 @@ class FusedSegment:
         # non-donated argument instead
         self.no_donate = frozenset(no_donate)
         self._fn = None
+        # per-bucket AOT executables: arg_sig -> jax.stages.Compiled
+        # (store-loaded or backfilled); None = bucket known ineligible.
+        # Filled by core.aot warm loading and the first-call store
+        # lookup; absent sigs fall through to the plain jit path.
+        self._exes: dict = {}
         reg = _registry()
         self._c_calls = reg.counter(
             "pipeline_fused_calls_total",
@@ -156,6 +161,31 @@ class FusedSegment:
         self._fn = compat.jit(self._body, name=self.name, **kwargs)
         return self._fn
 
+    def _aot_executable(self, donated: dict, dropped: dict):
+        """The ahead-of-time path: a warm-loaded (or store-resident)
+        executable for THIS bucket, or None → plain jit. A store miss
+        compiles-and-backfills inside the store (loud counters), so a
+        fresh process only ever pays each bucket's compile once across
+        the whole fleet's lifetime. Failures degrade to the jit path —
+        AOT is an accelerator, never a correctness gate."""
+        from . import aot
+        store = aot.active_store()
+        if store is None and not self._exes:
+            return None
+        sig = aot.arg_sig(donated, dropped)
+        if sig in self._exes:
+            return self._exes[sig]
+        if store is None:
+            return None
+        try:
+            exe = store.load_or_compile(self, donated, dropped)
+        except Exception:
+            _LOG.warning("aot lookup failed for segment %s; using the "
+                         "runtime jit path", self.name, exc_info=True)
+            exe = None
+        self._exes[sig] = exe
+        return exe
+
     # -- execution ---------------------------------------------------------
     def _eager(self, df: DataFrame) -> DataFrame:
         self._c_fallback.inc(1, segment=self.name)
@@ -187,7 +217,8 @@ class FusedSegment:
         # during argument processing, which is measurably cheaper than a
         # Python-level jnp.asarray pass per column first
         donated, dropped = self._split(num)
-        fn = self._ensure_fn(donated, dropped)
+        fn = self._aot_executable(donated, dropped) \
+            or self._ensure_fn(donated, dropped)
         try:
             if profiler is None:
                 out = fn(donated, dropped)
@@ -289,6 +320,16 @@ class CompiledPipeline:
             else:
                 out.append({"kind": "eager", "stage": p.name})
         return out
+
+    def warm_aot(self, store=None) -> int:
+        """Preload every store-resident executable for this plan's
+        fused segments (the scale-up warm boot — see ``core/aot.py``
+        and ``docs/aot.md``). Returns executables loaded; 0 when no
+        store is installed/on disk."""
+        from . import aot
+        if store is not None:
+            aot.install(store)
+        return aot.maybe_warm(self, service=self.service)
 
     # -- execution ---------------------------------------------------------
     def transform(self, df: DataFrame) -> DataFrame:

@@ -155,7 +155,14 @@ def scrubbed_cpu_env(n_devices: int | None = None,
                  if "xla_force_host_platform_device_count" not in f]
         flags.append(f"--xla_force_host_platform_device_count={n_devices}")
         env["XLA_FLAGS"] = " ".join(flags)
-    env["JAX_COMPILATION_CACHE_DIR"] = "/tmp/mmlspark_tpu_jax_cache"
+    # persistent-compile-cache placement: an explicit operator override
+    # wins, then the AOT store root (core/aot.py — the two caches
+    # co-locate), then the historical default. Never clobber a set
+    # value: a child that silently wrote elsewhere would split the
+    # cache the parent is warming.
+    if not env.get("JAX_COMPILATION_CACHE_DIR"):
+        from .aot import jax_cache_dir
+        env["JAX_COMPILATION_CACHE_DIR"] = jax_cache_dir()
     return env
 
 

@@ -13,6 +13,7 @@ import numpy as np
 
 from ..core import DataFrame, Transformer, Param, TypeConverters as TC
 from ..core.contracts import HasInputCol, HasOutputCol
+from ..core.dataframe import jittable_dtype
 from . import ops
 from .transforms import images_to_batch
 
@@ -50,16 +51,42 @@ class ResizeImageTransformer(Transformer, HasInputCol, HasOutputCol):
 class UnrollImage(Transformer, HasInputCol, HasOutputCol):
     """Image → flat feature vector in CHW order
     (reference ``image/UnrollImage.scala`` — CNTK expects channels-first;
-    we keep the same layout so unrolled features are comparable)."""
+    we keep the same layout so unrolled features are comparable).
+
+    The unroll itself is a pure transpose+reshape, so it computes in
+    jnp and carries a ``_trace`` form (ISSUE 11 straggler): a stacked
+    numeric NHWC column fuses into the surrounding XLA segment. Object
+    columns of per-row images still stack on host first
+    (``images_to_batch``) on the eager path."""
 
     def __init__(self, **kwargs):
         super().__init__(**kwargs)
         self._setDefault(inputCol="image", outputCol="unrolled")
 
+    @staticmethod
+    def _unroll(batch):
+        """NHWC → [n, C*H*W], shared by the eager and traced paths."""
+        return jnp.transpose(batch, (0, 3, 1, 2)) \
+            .reshape(batch.shape[0], -1)
+
     def _transform(self, df):
         batch, _ = images_to_batch(df[self.getInputCol()])
-        flat = np.transpose(batch, (0, 3, 1, 2)).reshape(batch.shape[0], -1)
-        return df.with_column(self.getOutputCol(), flat)
+        return df.with_column(self.getOutputCol(),
+                              self._unroll(jnp.asarray(batch)))
+
+    def _trace_ok(self, schema, n_rows):
+        ic = self.getInputCol()
+        # the traced form needs an already-stacked numeric NHWC column
+        # (trailing [H, W, C]); object columns stay on the eager path,
+        # where images_to_batch stacks (and grayscale-expands) on host
+        return ic in schema and jittable_dtype(schema[ic][0]) \
+            and len(schema[ic][1]) == 3
+
+    def _trace(self, cols):
+        out = dict(cols)
+        batch = cols[self.getInputCol()].astype(jnp.float32)
+        out[self.getOutputCol()] = self._unroll(batch)
+        return out
 
 
 class UnrollBinaryImage(Transformer, HasInputCol, HasOutputCol):

@@ -77,9 +77,19 @@ class CompileTracker:
         reg = registry if registry is not None else _registry
         self._traces: dict[str, int] = {}
         self._calls: dict[str, int] = {}
+        # steady-state assertion mode (AOT acceptance, ISSUE 11):
+        # after mark_steady(), every further compile is a violation —
+        # counted separately so "did the warm worker compile?" is one
+        # scrape of profile_runtime_compiles_total, which must stay 0.
+        self._steady = False
+        self._steady_base: dict[str, int] = {}
         self._c_compiles = reg.counter(
             "profile_compiles_total",
             "jit retraces (compiles) per tracked function")
+        self._c_runtime = reg.counter(
+            "profile_runtime_compiles_total",
+            "compiles AFTER steady state was declared (mark_steady) — "
+            "an AOT-warmed server must hold this at 0")
         self._c_calls = reg.counter(
             "profile_jit_calls_total",
             "tracked jit calls, by function and cache outcome")
@@ -93,6 +103,8 @@ class CompileTracker:
         # file). The dict bump is best-effort; the counter is exact.
         self._traces[label] = self._traces.get(label, 0) + 1
         self._c_compiles.inc(1, fn=label)
+        if self._steady:
+            self._c_runtime.inc(1, fn=label)
 
     def jit(self, fn=None, *, name: str | None = None, **jit_kwargs):
         """``jax.jit`` with compile tracking. Usable as a decorator
@@ -154,6 +166,46 @@ class CompileTracker:
         (after warmup); a shape-unstable fn shows its retrace count."""
         return {label: n for label, n in sorted(self._traces.items())
                 if n >= min_compiles}
+
+    # -- steady-state assertion mode (AOT warm-boot acceptance) ----------
+    def mark_steady(self) -> None:
+        """Declare warmup over: from here, every compile is a
+        violation (``profile_runtime_compiles_total`` counts it). Call
+        after an AOT warm load, or after a deliberate warmup sweep."""
+        self._steady_base = dict(self._traces)
+        self._steady = True
+
+    def unmark_steady(self) -> None:
+        self._steady = False
+
+    @property
+    def steady(self) -> bool:
+        return self._steady
+
+    def runtime_compiled(self) -> dict[str, int]:
+        """Per-function compiles since :meth:`mark_steady` — the
+        functions an operator must add to the AOT build."""
+        if not self._steady:
+            return {}
+        return {label: n - self._steady_base.get(label, 0)
+                for label, n in sorted(self._traces.items())
+                if n > self._steady_base.get(label, 0)}
+
+    def runtime_compiles(self) -> int:
+        """Total compiles since steady state was declared (0 = the
+        AOT contract held)."""
+        return sum(self.runtime_compiled().values())
+
+    def assert_steady_state(self) -> None:
+        """Raise (loudly, with the offending functions) if anything
+        compiled after :meth:`mark_steady` — the scale-up acceptance's
+        programmatic form."""
+        bad = self.runtime_compiled()
+        if bad:
+            raise AssertionError(
+                f"{sum(bad.values())} runtime compile(s) in steady "
+                f"state: {bad} — add these (fn × bucket) to the AOT "
+                "build (python -m mmlspark_tpu.core.aot build)")
 
 
 #: THE process-wide tracker (``parallel.compat.jit`` routes through it).

@@ -41,10 +41,60 @@ def jit(fn=None, *, name: str | None = None, **jit_kwargs):
     pass. Route jit call sites through here so a production server can
     answer "did anything recompile under load?" from a scrape.
 
+    The returned callable forwards ``lower`` — the ahead-of-time path:
+    ``fn.lower(*args).compile()`` plus :func:`serialize_compiled` /
+    :func:`deserialize_compiled` is how the AOT executable store
+    (``core/aot.py``) turns request-latency compiles into build-step
+    artifacts.
+
     JAX-free until called (the tracker imports jax lazily), like the
     rest of this module's surface."""
     from ..obs.profile import compile_tracker
     return compile_tracker.jit(fn, name=name, **jit_kwargs)
+
+
+def aot_serialization_available() -> bool:
+    """Whether this JAX build can serialize compiled executables
+    (``jax.experimental.serialize_executable``). When False the AOT
+    store (``core/aot.py``) degrades to retrace-tier entries — still a
+    build-time cost, just paid per process at warm load."""
+    try:
+        from jax.experimental import serialize_executable  # noqa: F401
+        return hasattr(serialize_executable, "serialize")
+    except ImportError:
+        return False
+
+
+def serialize_compiled(compiled) -> bytes:
+    """``jax.stages.Compiled`` → one self-contained blob (payload +
+    pytree defs pickled together). Raises RuntimeError on JAX builds
+    without ``serialize_executable`` — the AOT store catches it and
+    writes a retrace-tier entry instead."""
+    import pickle
+    try:
+        from jax.experimental.serialize_executable import serialize
+    except ImportError as e:
+        raise RuntimeError(
+            "this JAX build has no serialize_executable") from e
+    payload, in_tree, out_tree = serialize(compiled)
+    return pickle.dumps((payload, in_tree, out_tree),
+                        protocol=pickle.HIGHEST_PROTOCOL)
+
+
+def deserialize_compiled(blob: bytes, backend=None):
+    """Inverse of :func:`serialize_compiled`: blob → a loaded
+    ``jax.stages.Compiled`` bound to ``backend`` (default: the
+    process's default backend)."""
+    import pickle
+    try:
+        from jax.experimental.serialize_executable import (
+            deserialize_and_load)
+    except ImportError as e:
+        raise RuntimeError(
+            "this JAX build has no serialize_executable") from e
+    payload, in_tree, out_tree = pickle.loads(blob)
+    return deserialize_and_load(payload, in_tree, out_tree,
+                                backend=backend)
 
 
 def tpu_compiler_params(**kwargs):

@@ -296,14 +296,29 @@ class ComputeWorkerPool:
     the ingest servers' lease-replay path answers it on a survivor.
     Worker ids are stable (``<prefix>-w<N>``) so fault rules can target
     one by substring match.
+
+    ``transform_factory`` (optional) builds a FRESH transform per
+    ``scale_up`` — the honest model of a scale-up event, where the new
+    worker is a new process with cold jit caches. Each fresh transform
+    warm-loads the AOT executable store inside ``remote_worker_loop``
+    (``core/aot.py``, ``docs/aot.md``), so an autoscaler-added worker's
+    first request pays a store load, not a compile storm. Without a
+    factory every worker shares ``transform_fn`` (and its already-
+    warmed segments) — fine when threads stand in for one process's
+    capacity, dishonest as a scale-up benchmark.
     """
 
-    def __init__(self, driver_address, service: str, transform_fn, *,
-                 max_batch: int = 64, heartbeat_interval: float = 0.25,
+    def __init__(self, driver_address, service: str, transform_fn=None,
+                 *, transform_factory=None, max_batch: int = 64,
+                 heartbeat_interval: float = 0.25,
                  mesh_secret: str = "", prefix: str | None = None):
+        if transform_fn is None and transform_factory is None:
+            raise ValueError("ComputeWorkerPool needs transform_fn or "
+                             "transform_factory")
         self.driver_address = driver_address
         self.service = service
         self.transform_fn = transform_fn
+        self.transform_factory = transform_factory
         self.max_batch = max_batch
         self.heartbeat_interval = heartbeat_interval
         self.mesh_secret = mesh_secret
@@ -325,14 +340,18 @@ class ComputeWorkerPool:
 
     def scale_up(self) -> str:
         from .distributed import remote_worker_loop
+        # a factory means "fresh worker, cold caches": build its
+        # transform before taking the lock (compiles/store loads must
+        # not serialize the pool)
+        fn = (self.transform_factory() if self.transform_factory
+              is not None else self.transform_fn)
         with self._lock:
             wid = f"{self.prefix}-w{self._seq}"
             self._seq += 1
             stop = threading.Event()
             th = threading.Thread(
                 target=remote_worker_loop,
-                args=(self.driver_address, self.service,
-                      self.transform_fn),
+                args=(self.driver_address, self.service, fn),
                 kwargs={"stop_event": stop, "max_batch": self.max_batch,
                         "heartbeat_interval": self.heartbeat_interval,
                         "mesh_secret": self.mesh_secret,

@@ -732,6 +732,12 @@ def remote_worker_loop(driver_address, service_name: str, transform_fn,
     stop_event = stop_event or threading.Event()
     conns = _PeerConnections()
     wid = worker_id or uuid.uuid4().hex[:12]
+    # AOT warm boot BEFORE the first lease pull: a worker the
+    # autoscaler just added loads its fused-segment executables from
+    # the on-disk store (core/aot.py) instead of paying a compile storm
+    # on first traffic — the scale-up acceptance's mechanism
+    from ..core import aot
+    aot.maybe_warm(transform_fn, service=service_name)
     liveness = ServiceInfo(name=service_name + COMPUTE_SUFFIX,
                            worker_id=wid, host="0.0.0.0", port=0)
     idle = poll_interval

@@ -125,7 +125,8 @@ class ServingStream:
         self._stages.append(stage)
         return self
 
-    def compile_pipeline(self, example_df, **compile_kw):
+    def compile_pipeline(self, example_df, aot_buckets=None,
+                         **compile_kw):
         """Lower the transform chain added so far into ONE
         :class:`~mmlspark_tpu.core.compile.CompiledPipeline`: maximal
         runs of traceable stages fuse into single jitted XLA segments
@@ -133,11 +134,28 @@ class ServingStream:
         eagerly between them. ``example_df`` must look like the frames
         the executor will build (typically ``{"id", "request"}`` plus
         whatever ``parse_request`` produces) — it drives the schema
-        propagation that decides segment boundaries."""
+        propagation that decides segment boundaries.
+
+        ``aot_buckets``: padding-bucket row counts to register with the
+        AOT executable store's build CLI (``python -m
+        mmlspark_tpu.core.aot build``) — compilation becomes a build
+        step, and ``start()`` warm-loads the store so a fresh worker's
+        first request never pays a compile (``docs/aot.md``)."""
         from ..core.compile import compile_pipeline
         compile_kw.setdefault("service", "serving")
-        self._stages = [compile_pipeline(self._stages, example_df,
+        pre_stages = list(self._stages)
+        self._stages = [compile_pipeline(pre_stages, example_df,
                                          **compile_kw)]
+        if aot_buckets:
+            from ..core import aot
+            service = self.server.name
+            buckets = tuple(int(b) for b in aot_buckets)
+            aot.register_buildable(
+                service,
+                lambda: {"stages": pre_stages, "example": example_df,
+                         "buckets": buckets,
+                         "mesh": compile_kw.get("mesh"),
+                         "rules": compile_kw.get("rules")})
         return self
 
     def parse_request(self, parser=None):
@@ -181,6 +199,12 @@ class ServingStream:
         segs = [s.compiled_segments for s in stages
                 if hasattr(s, "compiled_segments")]
         run.compiled_segments = sum(segs) if segs else None
+        # the warm helpers (core/aot.maybe_warm) and introspection walk
+        # the chain through this attribute — the closure hides it.
+        # ServingQuery.start() below owns the AOT warm boot (it follows
+        # run.stages to the fused segments), so the chain loads its
+        # store executables before the first request on either path.
+        run.stages = stages
 
         self.server.start()
         return ServingQuery(self.server, run, name=name,

@@ -11,6 +11,14 @@ worker mesh all work unchanged. Replies go straight to the reactor via
 
 Opt in with ``serving_query(..., backend="native")``; falls back to the
 Python front when the toolchain is unavailable.
+
+Everything registered in ``ServingServer._init_shared_state`` rides
+along unchanged — including the AOT executable-store surfaces
+(``GET /debug/aot``, the ``aot_*`` metric family on ``/metrics``), and
+the warm boot itself: ``ServingQuery.start`` loads store executables
+before this front's poller delivers its first request, so a native
+scale-up worker boots hot exactly like the threaded one
+(``core/aot.py``, ``docs/aot.md``).
 """
 
 from __future__ import annotations

@@ -253,6 +253,29 @@ class ServingServer:
         if self.api_path != "/":
             self._routes[f"{self.api_path}/debug/trace"] = \
                 self._debug_trace_route
+        # AOT store introspection (core/aot.py): what the process's
+        # executable store holds vs what compiled at runtime — served
+        # by BOTH fronts (shared route table), like /metrics
+        self._routes["/debug/aot"] = self._debug_aot_route
+        if self.api_path != "/":
+            self._routes[f"{self.api_path}/debug/aot"] = \
+                self._debug_aot_route
+
+    def _debug_aot_route(self, body: bytes) -> tuple[int, bytes]:
+        """``GET /debug/aot``: active store stats + the CompileTracker
+        steady-state view (runtime compiles since mark_steady — the
+        functions an operator must add to the AOT build)."""
+        import json as _json
+
+        from ..core import aot
+        from ..obs.profile import compile_tracker
+        store = aot.active_store()
+        payload = {
+            "store": store.stats() if store is not None else None,
+            "steady": compile_tracker.steady,
+            "runtime_compiles": compile_tracker.runtime_compiled(),
+        }
+        return 200, _json.dumps(payload, indent=1).encode()
 
     def _metrics_route(self, body: bytes) -> tuple[int, bytes]:
         """``GET /metrics``: Prometheus text exposition of the
@@ -545,6 +568,13 @@ class ServingQuery:
         self.exception: Exception | None = None
 
     def start(self):
+        # AOT warm boot for a transform_fn handed to serving_query
+        # directly (a CompiledPipeline, or anything exposing its
+        # stages): executables load BEFORE the executor thread can pull
+        # a batch, so the first request never pays a compile. The DSL
+        # path (ServingStream.start) warms the same way.
+        from ..core import aot
+        aot.maybe_warm(self.transform_fn, service=self.name)
         self._thread.start()
         return self
 

@@ -1119,3 +1119,189 @@ def pipeline_fusion_scenario(*, n_rows: int = 64, width: int = 64,
         "all_equivalent": bool(feat_r["equivalent"]
                                and text_r["equivalent"]),
     }
+
+
+# --------------------------------------------------------------------- AOT
+def _aot_bench_spec(n_rows: int, width: int, seed: int = 9):
+    """A deterministic, fully param-fingerprintable serving pipeline
+    (no callable params — those are AOT-ineligible by design) shaped
+    like the featurizer serving path: clean → one-hot → assemble."""
+    import numpy as np
+
+    from ..core import DataFrame
+    from ..featurize import CleanMissingData, VectorAssembler
+    from ..featurize.vector import OneHotEncoderModel
+
+    rng = np.random.default_rng(seed)
+    aux = rng.normal(size=n_rows).astype(np.float32)
+    aux[::5] = np.nan
+    df = DataFrame({
+        "img": rng.normal(size=(n_rows, width)).astype(np.float32),
+        "aux": aux,
+        "cat": rng.integers(0, 8, size=n_rows).astype(np.int32),
+    })
+    clean = CleanMissingData(inputCols=["aux"],
+                             cleaningMode="Median").fit(df)
+    stages = [
+        clean,
+        OneHotEncoderModel(inputCol="cat", outputCol="onehot",
+                           categorySize=8, handleInvalid="keep"),
+        VectorAssembler(inputCols=["img", "aux", "onehot"],
+                        outputCol="features", handleInvalid="keep"),
+    ]
+    return stages, df
+
+
+def aot_scale_up_scenario(*, n_rows: int = 64, width: int = 48,
+                          reps: int = 80, seed: int = 9,
+                          store_root: str | None = None) -> dict:
+    """AOT executable-store acceptance (ISSUE 11): an autoscaler-added
+    worker's first request must be as fast as its thousandth.
+
+    The scenario builds the store once (the build step), measures a
+    warmed worker's steady-state latency, then compares two scale-up
+    events — each a FRESH :class:`~..core.compile.CompiledPipeline`
+    whose fused segments have cold jit caches, exactly what a new
+    worker process has:
+
+    - **cold** (today's behavior, store uninstalled): the first request
+      pays the full XLA compile at request latency;
+    - **warm** (the tentpole): a real :class:`~..serving.autoscale
+      .Autoscaler` decision scales the pool up, the new worker
+      warm-loads the store, ``CompileTracker.mark_steady()`` arms the
+      zero-runtime-compile assertion, and the first request must land
+      within 2× the steady-state p99 with ``profile_runtime_compiles
+      _total == 0`` and ≥ 1 store hit.
+
+    Outputs are checked bit-equal (atol 0) between the AOT-loaded and
+    runtime-compiled executables — same XLA program, same bits.
+    """
+    import shutil
+    import tempfile
+
+    import numpy as np
+
+    from ..core import aot, compile_pipeline
+    from ..obs.metrics import registry as _reg
+    from ..obs.profile import compile_tracker
+    from ..serving.autoscale import (Autoscaler, AutoscaleConfig,
+                                     AutoscaleSignals)
+
+    stages, example = _aot_bench_spec(n_rows, width, seed)
+
+    def fresh_worker():
+        """A new worker process's pipeline: fresh FusedSegments, cold
+        jit caches (jit keys on the body's identity)."""
+        return compile_pipeline(stages, example, service="aot-bench")
+
+    def _sum(prefix):
+        return sum(v for k, v in _reg.snapshot().items()
+                   if k.startswith(prefix))
+
+    owns_root = store_root is None
+    root = store_root or tempfile.mkdtemp(prefix="mmlspark_tpu_aotb_")
+    prev_store = aot.active_store()
+    try:
+        store = aot.AotStore(root)
+        # -- the build step -------------------------------------------
+        t0 = time.perf_counter()
+        build_cp = fresh_worker()
+        build_records = aot.build_pipeline(build_cp, example, store)
+        build_wall_s = time.perf_counter() - t0
+
+        # -- steady-state worker --------------------------------------
+        aot.install(store)
+        steady_cp = fresh_worker()
+        steady_cp.warm_aot()
+        ref = steady_cp.transform(example)  # warmed; also the reference
+        lats = []
+        for _ in range(reps):
+            t0 = time.perf_counter()
+            steady_cp.transform(example)
+            lats.append(time.perf_counter() - t0)
+        lats.sort()
+        steady_p99_s = _pctl(lats, 0.99)
+
+        # -- cold scale-up (the "before" picture) ---------------------
+        aot.uninstall()
+        cold_cp = fresh_worker()
+        t0 = time.perf_counter()
+        cold_out = cold_cp.transform(example)
+        cold_first_s = time.perf_counter() - t0
+
+        # -- warm scale-up through a real autoscaler decision ---------
+        aot.install(store)
+        hits0, miss0 = _sum("aot_store_hit_total"), \
+            _sum("aot_store_miss_total")
+
+        class _Pool:
+            def __init__(self):
+                self.workers = []
+
+            def count(self):
+                return len(self.workers)
+
+            def scale_up(self):
+                cp = fresh_worker()
+                warmed = cp.warm_aot()
+                self.workers.append((cp, warmed))
+                return f"w{len(self.workers) - 1}"
+
+            def scale_down(self):
+                return self.workers.pop()[0] if self.workers else None
+
+        pool = _Pool()
+        scaler = Autoscaler(
+            "aot-bench", pool,
+            AutoscaleConfig(min_workers=1, max_workers=4, up_stable=1,
+                            cooldown=0.0))
+        scaler.ensure_min()
+        decision = scaler.tick(AutoscaleSignals(queue_depth=1e4))
+        new_cp, warmed = pool.workers[-1]
+        compile_tracker.mark_steady()
+        t0 = time.perf_counter()
+        warm_out = new_cp.transform(example)
+        warm_first_s = time.perf_counter() - t0
+        runtime_compiles = compile_tracker.runtime_compiles()
+        runtime_compiled = compile_tracker.runtime_compiled()
+        compile_tracker.unmark_steady()
+        hits = _sum("aot_store_hit_total") - hits0
+        misses = _sum("aot_store_miss_total") - miss0
+
+        equivalent = all(
+            np.asarray(ref[c]).shape == np.asarray(warm_out[c]).shape
+            and np.array_equal(np.asarray(ref[c]),
+                               np.asarray(warm_out[c]))
+            and np.array_equal(np.asarray(ref[c]),
+                               np.asarray(cold_out[c]))
+            for c in ref.columns)
+        return {
+            "build_wall_s": build_wall_s,
+            "build_segments": sum(1 for r in build_records
+                                  if r.get("built")),
+            "store_entries": store.stats()["entries"],
+            "steady_p99_s": steady_p99_s,
+            "cold_first_s": cold_first_s,
+            "warm_first_s": warm_first_s,
+            "cold_over_steady": cold_first_s / max(steady_p99_s, 1e-9),
+            "warm_over_steady": warm_first_s / max(steady_p99_s, 1e-9),
+            "scale_decision": decision,
+            "worker_warm_loaded": int(warmed),
+            "store_hits": float(hits),
+            "store_misses": float(misses),
+            "runtime_compiles": int(runtime_compiles),
+            "runtime_compiled": runtime_compiled,
+            "equivalent": bool(equivalent),
+            "warm_within_2x_steady": bool(
+                warm_first_s <= 2.0 * steady_p99_s),
+            "zero_runtime_compiles": bool(runtime_compiles == 0),
+            "warm_hit_ge_1": bool(hits >= 1),
+        }
+    finally:
+        compile_tracker.unmark_steady()
+        if prev_store is not None:
+            aot.install(prev_store)
+        else:
+            aot.uninstall()
+        if owns_root:
+            shutil.rmtree(root, ignore_errors=True)

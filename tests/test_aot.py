@@ -1,0 +1,470 @@
+"""AOT executable store (core/aot.py, ISSUE 11): fingerprint stability
+across processes, stale-fingerprint invalidation, corrupt-entry loud
+fallback, warm-load bit-equivalence, the CompileTracker steady-state
+assertion, the autoscaler scale-up scenario, and the build CLI round
+trip."""
+
+from __future__ import annotations
+
+import json
+import os
+import subprocess
+import sys
+
+import numpy as np
+import pytest
+
+from mmlspark_tpu.core import DataFrame, compile_pipeline
+from mmlspark_tpu.core import aot
+from mmlspark_tpu.core.aot import AotStore
+from mmlspark_tpu.core.utils import scrubbed_cpu_env
+from mmlspark_tpu.obs.metrics import registry as _reg
+from mmlspark_tpu.obs.profile import compile_tracker
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _spec(n=8, width=4, seed=3, cat_size=3):
+    """Deterministic fully-param pipeline + example (no callables, no
+    fitting randomness — the fingerprint tests depend on it)."""
+    from mmlspark_tpu.featurize import CleanMissingData, VectorAssembler
+    from mmlspark_tpu.featurize.vector import OneHotEncoderModel
+
+    rng = np.random.default_rng(seed)
+    aux = rng.normal(size=n).astype(np.float32)
+    aux[::3] = np.nan
+    df = DataFrame({
+        "x": rng.normal(size=(n, width)).astype(np.float32),
+        "aux": aux,
+        "cat": (np.arange(n) % cat_size).astype(np.int32),
+    })
+    stages = [
+        CleanMissingData(inputCols=["aux"], cleaningMode="Mean").fit(df),
+        OneHotEncoderModel(inputCol="cat", outputCol="onehot",
+                           categorySize=cat_size, handleInvalid="keep"),
+        VectorAssembler(inputCols=["x", "aux", "onehot"],
+                        outputCol="features", handleInvalid="keep"),
+    ]
+    return stages, df
+
+
+@pytest.fixture(autouse=True)
+def _no_active_store():
+    """Each test owns its store; never leak one into other suites."""
+    prev = aot.active_store()
+    aot.uninstall()
+    compile_tracker.unmark_steady()
+    yield
+    compile_tracker.unmark_steady()
+    if prev is not None:
+        aot.install(prev)
+    else:
+        aot.uninstall()
+
+
+def _counter_sum(prefix: str) -> float:
+    return sum(v for k, v in _reg.snapshot().items()
+               if k.startswith(prefix))
+
+
+# ------------------------------------------------------------ fingerprints
+NO_JAX_FP_SNIPPET = """
+import sys, json
+from mmlspark_tpu.featurize.vector import (OneHotEncoderModel,
+                                           VectorAssembler)
+from mmlspark_tpu.core import aot
+assert 'jax' not in sys.modules, 'aot fingerprint layer pulled in jax'
+stages = [
+    OneHotEncoderModel(inputCol='cat', outputCol='onehot',
+                       categorySize=3, handleInvalid='keep'),
+    VectorAssembler(inputCols=['x', 'onehot'], outputCol='features',
+                    handleInvalid='keep'),
+]
+key = aot.segment_static_key(stages, no_donate=('cat',),
+                             expected_host=('id',), platform='cpu')
+donated = [['x', 'float32', [8, 4]]]
+dropped = [['cat', 'int32', [8]]]
+print(json.dumps(aot.fingerprints(key, donated, dropped)))
+assert 'jax' not in sys.modules, 'fingerprints() pulled in jax'
+"""
+
+
+class TestFingerprints:
+    def _fp_here(self):
+        from mmlspark_tpu.featurize.vector import (OneHotEncoderModel,
+                                                   VectorAssembler)
+        stages = [
+            OneHotEncoderModel(inputCol="cat", outputCol="onehot",
+                               categorySize=3, handleInvalid="keep"),
+            VectorAssembler(inputCols=["x", "onehot"],
+                            outputCol="features",
+                            handleInvalid="keep"),
+        ]
+        key = aot.segment_static_key(stages, no_donate=("cat",),
+                                     expected_host=("id",),
+                                     platform="cpu")
+        return aot.fingerprints(key, [["x", "float32", [8, 4]]],
+                                [["cat", "int32", [8]]])
+
+    def test_stable_across_processes_and_jax_free(self):
+        """The exact key this process computes, a fresh no-JAX process
+        computes too — a store built on one machine must match on the
+        next, and key computation must never drag backend init into a
+        control-plane process."""
+        out = subprocess.run(
+            [sys.executable, "-c", NO_JAX_FP_SNIPPET],
+            capture_output=True, text=True, cwd=REPO,
+            env=scrubbed_cpu_env(), check=True)
+        child = tuple(json.loads(out.stdout.strip()))
+        assert child == self._fp_here()
+
+    def test_param_change_moves_static_fingerprint(self):
+        from mmlspark_tpu.featurize.vector import OneHotEncoderModel
+        a = aot.segment_static_key(
+            [OneHotEncoderModel(inputCol="c", outputCol="o",
+                                categorySize=3, handleInvalid="keep")],
+            platform="cpu")
+        b = aot.segment_static_key(
+            [OneHotEncoderModel(inputCol="c", outputCol="o",
+                                categorySize=4, handleInvalid="keep")],
+            platform="cpu")
+        assert aot.fingerprints(a, [], [])[0] != \
+            aot.fingerprints(b, [], [])[0]
+
+    def test_bucket_moves_full_not_static(self):
+        from mmlspark_tpu.featurize.vector import OneHotEncoderModel
+        key = aot.segment_static_key(
+            [OneHotEncoderModel(inputCol="c", outputCol="o",
+                                categorySize=3, handleInvalid="keep")],
+            platform="cpu")
+        s4, f4 = aot.fingerprints(key, [["c", "int32", [4]]], [])
+        s8, f8 = aot.fingerprints(key, [["c", "int32", [8]]], [])
+        assert s4 == s8 and f4 != f8
+
+    def test_callable_param_is_unfingerprintable(self):
+        from mmlspark_tpu.stages import UDFTransformer
+        stage = UDFTransformer(inputCol="b", outputCol="d", jitSafe=True,
+                               udf=lambda b: b * 2.0)
+        with pytest.raises(aot.Unfingerprintable):
+            aot.segment_static_key([stage], platform="cpu")
+
+    def test_fitted_state_moves_fingerprint(self):
+        """Refit on different data → different fill values in params →
+        a new static fingerprint (stale entries can never match)."""
+        stages_a, df = _spec(seed=3)
+        stages_b, _ = _spec(seed=4)
+        ka = aot.segment_static_key(stages_a, platform="cpu")
+        kb = aot.segment_static_key(stages_b, platform="cpu")
+        assert aot.fingerprints(ka, [], [])[0] != \
+            aot.fingerprints(kb, [], [])[0]
+
+
+# ------------------------------------------------------------------ store
+class TestStore:
+    def _build(self, tmp_path, stages=None, df=None, service="t"):
+        if stages is None:
+            stages, df = _spec()
+        store = AotStore(str(tmp_path / "store"))
+        cp = compile_pipeline(stages, df, service=service)
+        records = aot.build_pipeline(cp, df, store)
+        return store, records, stages, df
+
+    def test_build_then_load_bit_equal_zero_compiles(self, tmp_path):
+        store, records, stages, df = self._build(tmp_path)
+        assert any(r.get("built") for r in records)
+        # reference: a runtime-compiled plan with NO store in play
+        ref = compile_pipeline(stages, df, service="t-ref").transform(df)
+        aot.install(store)
+        fresh = compile_pipeline(stages, df, service="t")
+        assert fresh.warm_aot() >= 1
+        compile_tracker.mark_steady()
+        out = fresh.transform(df)
+        assert compile_tracker.runtime_compiles() == 0, \
+            compile_tracker.runtime_compiled()
+        for c in ref.columns:
+            a, b = np.asarray(ref[c]), np.asarray(out[c])
+            assert a.shape == b.shape
+            assert np.array_equal(a, b), c  # bit-equal, atol 0
+
+    def test_request_path_miss_backfills(self, tmp_path):
+        """No warm load: the first request hits the store lookup,
+        misses (absent, counted), compiles, and BACKFILLS the store so
+        the next fresh process hits."""
+        stages, df = _spec()
+        store = aot.install(AotStore(str(tmp_path / "store")))
+        misses0 = _counter_sum("aot_store_miss_total")
+        cp = compile_pipeline(stages, df, service="t")
+        eager_ref = cp.plan  # plan built; nothing compiled yet
+        out = cp.transform(df)
+        assert _counter_sum("aot_store_miss_total") == misses0 + 1
+        assert store.stats()["entries"] == 1
+        # a second fresh plan now loads what the miss backfilled
+        hits0 = _counter_sum("aot_store_hit_total")
+        cp2 = compile_pipeline(stages, df, service="t")
+        assert cp2.warm_aot() == 1
+        assert _counter_sum("aot_store_hit_total") == hits0 + 1
+        for c in out.columns:
+            assert np.array_equal(np.asarray(out[c]),
+                                  np.asarray(cp2.transform(df)[c]))
+
+    def test_corrupt_entry_loud_fallback(self, tmp_path, caplog):
+        """A flipped byte in exe.bin → checksum mismatch → counted
+        corrupt miss + warning + runtime compile; never a wrong (or
+        crashed) answer."""
+        store, records, stages, df = self._build(tmp_path)
+        ref = compile_pipeline(stages, df, service="t-ref").transform(df)
+        entry = store.entries()[0]
+        exe_path = os.path.join(entry["_dir"], "exe.bin")
+        blob = bytearray(open(exe_path, "rb").read())
+        blob[len(blob) // 2] ^= 0xFF
+        with open(exe_path, "wb") as f:
+            f.write(bytes(blob))
+        aot.install(store)
+        corrupt0 = sum(
+            v for k, v in _reg.snapshot().items()
+            if k.startswith("aot_store_miss_total")
+            and 'reason="corrupt"' in k)
+        cp = compile_pipeline(stages, df, service="t")
+        assert cp.warm_aot() == 0  # nothing loadable
+        with caplog.at_level("WARNING",
+                             logger="mmlspark_tpu.core.aot"):
+            out = cp.transform(df)  # miss → compile-and-backfill
+        assert any("corrupt" in r.message for r in caplog.records)
+        corrupt = sum(
+            v for k, v in _reg.snapshot().items()
+            if k.startswith("aot_store_miss_total")
+            and 'reason="corrupt"' in k)
+        assert corrupt > corrupt0
+        for c in ref.columns:
+            assert np.array_equal(np.asarray(ref[c]),
+                                  np.asarray(out[c])), c
+        # the backfill REPLACED the corrupt entry: next process loads
+        cp2 = compile_pipeline(stages, df, service="t")
+        assert cp2.warm_aot() == 1
+
+    def test_stale_param_change_rebuilds_not_wrong(self, tmp_path):
+        """A param change moves the fingerprint: the old entry simply
+        never matches (no wrong answers), the new config compiles and
+        backfills, and gc() reclaims the orphan."""
+        stages, df = self._build(tmp_path)[2:]
+        store = AotStore(str(tmp_path / "store"))
+        assert store.stats()["entries"] == 1
+        old_fp = store.entries()[0]["static_fp"]
+        # change fitted state: a different categorySize
+        stages2, df2 = _spec(cat_size=4)
+        aot.install(store)
+        cp = compile_pipeline(stages2, df2, service="t")
+        assert cp.warm_aot() == 0  # stale entry must NOT load
+        out = cp.transform(df2)     # miss → rebuild under the new fp
+        assert store.stats()["entries"] == 2
+        ref = compile_pipeline(stages2, df2,
+                               service="t-ref").transform(df2)
+        for c in ref.columns:
+            assert np.array_equal(np.asarray(ref[c]),
+                                  np.asarray(out[c])), c
+        live = {m["static_fp"] for m in store.entries()} - {old_fp}
+        removed = store.gc(keep_static=live)
+        assert len(removed) == 1
+        assert store.stats()["entries"] == 1
+        assert store.entries()[0]["static_fp"] != old_fp
+
+    def test_version_stale_entries_gc(self, tmp_path):
+        store = self._build(tmp_path)[0]
+        meta_path = os.path.join(store.entries()[0]["_dir"],
+                                 "meta.json")
+        with open(meta_path, encoding="utf-8") as f:
+            meta = json.load(f)
+        meta["versions"] = {"jax": "0.0.1", "jaxlib": "0.0.1"}
+        with open(meta_path, "w", encoding="utf-8") as f:
+            json.dump(meta, f)
+        assert len(store.gc()) == 1
+        assert store.stats()["entries"] == 0
+
+    def test_unfingerprintable_segment_keeps_jit_path(self, tmp_path):
+        """A lambda-param stage fuses fine but cannot be keyed: the
+        store says so loudly (reason=unfingerprintable) and the plain
+        jit path serves correct results."""
+        import jax.numpy as jnp
+        from mmlspark_tpu.stages import UDFTransformer
+        rng = np.random.default_rng(0)
+        df = DataFrame({"b": rng.normal(size=8).astype(np.float32)})
+        stages = [UDFTransformer(inputCol="b", outputCol="d",
+                                 jitSafe=True,
+                                 udf=lambda b: jnp.tanh(b) * 2.0)]
+        store = aot.install(AotStore(str(tmp_path / "store")))
+        n0 = sum(v for k, v in _reg.snapshot().items()
+                 if k.startswith("aot_store_miss_total")
+                 and 'reason="unfingerprintable"' in k)
+        cp = compile_pipeline(stages, df, service="t")
+        assert cp.compiled_segments == 1
+        out = cp.transform(df)
+        np.testing.assert_allclose(
+            np.asarray(out["d"]), np.tanh(np.asarray(df["b"])) * 2.0,
+            atol=1e-6)
+        n1 = sum(v for k, v in _reg.snapshot().items()
+                 if k.startswith("aot_store_miss_total")
+                 and 'reason="unfingerprintable"' in k)
+        assert n1 == n0 + 1
+        assert store.stats()["entries"] == 0
+
+    def test_atomic_writes_no_tmp_left(self, tmp_path):
+        store = self._build(tmp_path)[0]
+        leftovers = [p for p, _, _ in os.walk(store.root)
+                     if os.path.basename(p).startswith(".tmp-")]
+        assert leftovers == []
+
+
+# ----------------------------------------------- CompileTracker steady mode
+class TestSteadyState:
+    def test_runtime_compile_counted_and_raises(self):
+        from mmlspark_tpu.parallel import compat
+        base = _counter_sum("profile_runtime_compiles_total")
+        compile_tracker.mark_steady()
+        try:
+            fn = compat.jit(lambda x: x + 1, name="steady-violator")
+            fn(np.float32(1.0))  # a compile AFTER steady — a violation
+            assert compile_tracker.runtime_compiles() == 1
+            assert "steady-violator" in compile_tracker.runtime_compiled()
+            assert _counter_sum("profile_runtime_compiles_total") \
+                == base + 1
+            with pytest.raises(AssertionError, match="steady-violator"):
+                compile_tracker.assert_steady_state()
+        finally:
+            compile_tracker.unmark_steady()
+
+    def test_clean_steady_state_passes(self):
+        from mmlspark_tpu.parallel import compat
+        fn = compat.jit(lambda x: x * 2, name="steady-clean")
+        fn(np.float32(1.0))  # warmup compile
+        compile_tracker.mark_steady()
+        try:
+            fn(np.float32(2.0))  # cache hit
+            assert compile_tracker.runtime_compiles() == 0
+            compile_tracker.assert_steady_state()
+        finally:
+            compile_tracker.unmark_steady()
+
+
+# ------------------------------------------------------ serving + registry
+class TestServingIntegration:
+    def test_warm_walks_dsl_run_closure(self, tmp_path):
+        """The DSL start() chain exposes run.stages; maybe_warm must
+        reach the CompiledPipeline inside it."""
+        stages, df = _spec()
+        store, _, _, _ = TestStore()._build(tmp_path, stages, df)
+        aot.install(store)
+        cp = compile_pipeline(stages, df, service="t")
+
+        def run(frame):
+            return cp.transform(frame)
+        run.stages = [cp]
+        assert aot.maybe_warm(run, service="t") >= 1
+        compile_tracker.mark_steady()
+        run(df)
+        assert compile_tracker.runtime_compiles() == 0
+
+    def test_dsl_compile_pipeline_registers_buildable(self):
+        from mmlspark_tpu.serving.dsl import read_stream
+        stages, df = _spec()
+        stream = (read_stream().server()
+                  .address("127.0.0.1", 0, "aot-reg-test").load())
+        try:
+            for s in stages:
+                stream.transform(s)
+            stream.compile_pipeline(df, aot_buckets=(4, 8))
+            assert "aot-reg-test" in aot.buildable_services()
+            spec = aot._BUILDERS["aot-reg-test"]()
+            assert spec["buckets"] == (4, 8)
+            assert spec["stages"] == stages
+        finally:
+            aot._BUILDERS.pop("aot-reg-test", None)
+            stream.server._httpd.server_close()
+
+    def test_build_registered_covers_buckets(self, tmp_path):
+        stages, df = _spec()
+        aot.register_buildable(
+            "aot-build-test",
+            lambda: {"stages": stages, "example": df,
+                     "buckets": (4, 8)})
+        try:
+            store = AotStore(str(tmp_path / "store"))
+            report = aot.build_registered("aot-build-test", store,
+                                          log=lambda *_: None)
+            assert store.stats()["entries"] == 2  # one per bucket
+            assert report["coverage"]["covered"] >= 3
+            built = report["services"]["aot-build-test"]
+            assert built["buckets"] == [4, 8]
+        finally:
+            aot._BUILDERS.pop("aot-build-test", None)
+
+    def test_scrubbed_env_cache_dir_contract(self, monkeypatch):
+        # explicit operator override wins
+        monkeypatch.setenv("JAX_COMPILATION_CACHE_DIR", "/tmp/mine")
+        assert scrubbed_cpu_env()["JAX_COMPILATION_CACHE_DIR"] \
+            == "/tmp/mine"
+        # AOT store root co-locates the jax cache
+        monkeypatch.delenv("JAX_COMPILATION_CACHE_DIR", raising=False)
+        monkeypatch.setenv("MMLSPARK_TPU_AOT_STORE", "/tmp/aotroot")
+        assert scrubbed_cpu_env()["JAX_COMPILATION_CACHE_DIR"] \
+            == os.path.join("/tmp/aotroot", "jax_cache")
+        # neither set → the historical default
+        monkeypatch.delenv("MMLSPARK_TPU_AOT_STORE", raising=False)
+        assert scrubbed_cpu_env()["JAX_COMPILATION_CACHE_DIR"] \
+            == "/tmp/mmlspark_tpu_jax_cache"
+
+
+# ------------------------------------------------------- scale-up scenario
+class TestScaleUpScenario:
+    def test_autoscaled_worker_first_request_is_warm(self):
+        """The acceptance: an autoscaler-added worker serves its first
+        request with zero runtime compiles, ≥1 store hit, and latency
+        within 2× steady-state p99 — vs the cold worker's compile-storm
+        first request."""
+        from mmlspark_tpu.testing.benchmarks import aot_scale_up_scenario
+        r = aot_scale_up_scenario(reps=40)
+        assert r["scale_decision"] == "up"
+        assert r["zero_runtime_compiles"], r["runtime_compiled"]
+        assert r["warm_hit_ge_1"]
+        assert r["equivalent"]
+        assert r["warm_within_2x_steady"], \
+            (r["warm_first_s"], r["steady_p99_s"])
+        # the cold picture the store exists to fix: a real compile at
+        # request latency (loose bound — CI boxes share cores)
+        assert r["cold_first_s"] > r["warm_first_s"]
+        assert r["store_misses"] == 0
+
+
+# ------------------------------------------------------------------- CLI
+@pytest.mark.slow
+class TestCli:
+    def test_selftest_round_trip(self):
+        """build in one scrubbed process, verify (warm-load + zero
+        runtime compiles + bit-equal) in another — the CI job's body."""
+        out = subprocess.run(
+            [sys.executable, "-m", "mmlspark_tpu.core.aot", "selftest"],
+            capture_output=True, text=True, cwd=REPO,
+            env=scrubbed_cpu_env(), timeout=600)
+        assert out.returncode == 0, out.stdout + out.stderr
+        assert "selftest OK" in out.stdout
+
+    def test_list_and_gc_cli(self, tmp_path):
+        root = str(tmp_path / "store")
+        env = scrubbed_cpu_env()
+        out = subprocess.run(
+            [sys.executable, "-m", "mmlspark_tpu.core.aot", "build",
+             "--service", "__selftest__", "--root", root],
+            capture_output=True, text=True, cwd=REPO, env=env,
+            timeout=600)
+        assert out.returncode == 0, out.stdout + out.stderr
+        out = subprocess.run(
+            [sys.executable, "-m", "mmlspark_tpu.core.aot", "list",
+             "--root", root],
+            capture_output=True, text=True, cwd=REPO, env=env,
+            timeout=600)
+        assert out.returncode == 0 and "__selftest__:seg" in out.stdout
+        out = subprocess.run(
+            [sys.executable, "-m", "mmlspark_tpu.core.aot", "gc",
+             "--root", root, "--keep-static", "0" * 64],
+            capture_output=True, text=True, cwd=REPO, env=env,
+            timeout=600)
+        assert out.returncode == 0 and "removed 2" in out.stdout
