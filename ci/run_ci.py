@@ -52,6 +52,7 @@ PACKAGES: dict[str, list[str]] = {
     "parallel": ["test_partition.py"],  # partition rules + pjit steps
     "compile": ["test_pipeline_compile.py"],  # whole-pipeline fusion
     "aot": ["test_aot.py"],  # AOT executable store + warm boot
+    "perf": ["test_perf.py"],  # learned cost model + kernel autotuner
     "text": ["test_text_transfer.py", "test_causal_lm.py",
              "test_speculative.py"],
 }
@@ -232,6 +233,44 @@ def style() -> int:
         "print('core.aot fingerprint+store OK (no jax)')")
     rc = _run([sys.executable, "-c", smoke],
               env=dict(os.environ, JAX_PLATFORMS="cpu"))
+    if rc:
+        return rc
+    # the learned-performance layer (cost model + autotuner registry)
+    # is control-plane code consulted from scheduler/handler threads:
+    # it must import, train on synthetic FeatureLog rows, predict, and
+    # answer winner lookups with no device and no JAX in the process
+    smoke = (
+        "import sys\n"
+        "from mmlspark_tpu.obs.profile import FEATURE_SCHEMA_VERSION\n"
+        "from mmlspark_tpu.perf import CostModel, autotune\n"
+        "from mmlspark_tpu.sched.policy import ServiceTimeEstimator, "
+        "bucket_of\n"
+        "assert 'jax' not in sys.modules, 'perf import pulled in jax'\n"
+        "rows = [dict(service='ci', route='/', batch=b, "
+        "bucket=bucket_of(b), entity_bytes=b * 64.0, queue_depth=2.0, "
+        "execute_ms=1.0 + 0.1 * bucket_of(b), "
+        "schema_version=FEATURE_SCHEMA_VERSION) "
+        "for b in (1, 2, 3, 4, 6, 8, 12, 16) * 8]\n"
+        "m = CostModel(min_rows=16)\n"
+        "assert m.predict_batch_ms('ci', 4) is None  # cold -> EWMA\n"
+        "assert m.fit(rows) == len(rows)\n"
+        "p = m.predict_batch_ms('ci', 4)\n"
+        "assert p is not None and 1.0 < p < 3.0, p\n"
+        "est = ServiceTimeEstimator('ci-est', cost_model=m)\n"
+        "assert est.estimate(4) is None  # model cold for THIS service\n"
+        "assert autotune.kernel_winner('hist', "
+        "autotune.hist_key(1024, 8, 16), 'cpu') is None\n"
+        "assert autotune.hist_candidates(1024, 8, 16)\n"
+        "assert 'jax' not in sys.modules, 'perf data plane pulled jax'\n"
+        "print('perf import OK (no jax)')")
+    # isolated perf store: a developer's ambient /tmp autotune registry
+    # (import-time maybe_load) would otherwise fail the winner-is-None
+    # assert on a machine where the CLI was ever run at this shape
+    import tempfile
+    rc = _run([sys.executable, "-c", smoke],
+              env=dict(os.environ, JAX_PLATFORMS="cpu",
+                       MMLSPARK_TPU_PERF_STORE=tempfile.mkdtemp(
+                           prefix="mmlspark_tpu_perf_smoke_")))
     if rc:
         return rc
     # graftcheck (static analysis) is pure stdlib: it must import AND

@@ -847,11 +847,31 @@ def build_pipeline(cp, example_df, store: AotStore) -> list[dict]:
     return records
 
 
+def _bucket_build_order(service: str, buckets) -> list[int]:
+    """Cost-model build planner (ISSUE 12): order a service's padding
+    buckets by predicted traffic value — observed FeatureLog request
+    share × the learned model's predicted execute cost — so an
+    interrupted or time-boxed build compiles the hot path first.
+    Deterministic ascending order when nothing has been learned yet
+    (a fresh process, or perf unavailable)."""
+    try:
+        from ..perf.costmodel import bucket_build_priority
+        ranked = bucket_build_priority(service, buckets)
+    except Exception:
+        ranked = []
+    if ranked:
+        _LOG.info("AOT build order for %r by predicted traffic value: "
+                  "%s", service, ranked)
+        return ranked
+    return sorted({int(x) for x in buckets})
+
+
 def build_registered(service: str | None = None,
                      store: AotStore | None = None,
                      log=print) -> dict:
     """The build CLI body: for every registered service × padding
-    bucket, compile the pipeline's fused segments into the store.
+    bucket, compile the pipeline's fused segments into the store —
+    most-valuable buckets first (:func:`_bucket_build_order`).
     Returns a report incl. the AOT coverage of TRACEABLE stages (from
     ``analysis/traceability.json``)."""
     from .compile import compile_pipeline
@@ -869,7 +889,8 @@ def build_registered(service: str | None = None,
         buckets = tuple(spec.get("buckets") or
                         (len(spec["example"]),))
         svc_records = []
-        for b in sorted(set(int(x) for x in buckets)):
+        build_order = _bucket_build_order(svc, buckets)
+        for b in build_order:
             example = _resize_example(spec["example"], b)
             cp = compile_pipeline(
                 spec["stages"], example, mesh=spec.get("mesh"),
@@ -883,6 +904,7 @@ def build_registered(service: str | None = None,
             svc_records.extend(recs)
         report["services"][svc] = {
             "buckets": sorted(set(int(x) for x in buckets)),
+            "build_order": build_order,
             "segments": svc_records}
         report["entries"].extend(svc_records)
     report["coverage"] = _traceable_coverage(built_stage_classes)

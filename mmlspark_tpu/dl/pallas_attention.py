@@ -625,7 +625,46 @@ def _pack_offs(q_offset, k_offset):
                       jnp.asarray(k_offset, jnp.int32)]).reshape(1, 2)
 
 
-def flash_attention_lse(q, k, v, key_mask=None, *, block_q: int = 256,
+def _tuned_blocks(T: int, D: int, causal: bool,
+                  platform: str) -> tuple[int, int] | None:
+    """Autotuned (block_q, block_k) for this (shape-bucket, platform)
+    from the offline winner registry (``perf.autotune``, ISSUE 12), or
+    None when untuned — the hand-picked defaults apply then, so an
+    untuned shape behaves exactly as before. The lookup is a plain
+    dict read: flash_attention runs at jit trace time inside jitted
+    encoders, where locks/IO/clock are trace-safety hazards."""
+    try:
+        from ..perf import autotune
+    except Exception:  # pragma: no cover - perf layer optional
+        return None
+    w = autotune.kernel_winner("flash_attention",
+                               autotune.attn_key(T, D, causal), platform)
+    if not w:
+        return None
+    try:
+        return int(w["block_q"]), int(w["block_k"])
+    except (KeyError, TypeError, ValueError):
+        return None
+
+
+def _resolve_blocks(q, k, block_q, block_k, causal: bool,
+                    platform: str) -> tuple[int, int]:
+    """Final (block_q, block_k): explicit caller values win; otherwise
+    the autotuned winner for this shape bucket; otherwise the measured
+    hand-picked defaults (256 / ``_resolve_block_k`` auto)."""
+    tuned = None
+    if block_q is None or block_k is None:
+        tuned = _tuned_blocks(int(q.shape[2]), int(q.shape[3]),
+                              bool(causal), platform)
+    if block_q is None:
+        block_q = tuned[0] if tuned else 256
+    if block_k is None and tuned is not None:
+        block_k = tuned[1]
+    return int(block_q), _resolve_block_k(block_k, k, causal)
+
+
+def flash_attention_lse(q, k, v, key_mask=None, *,
+                        block_q: int | None = None,
                         block_k: int | None = None,
                         interpret: bool | None = None,
                         causal: bool = False, q_offset=0, k_offset=0):
@@ -638,17 +677,24 @@ def flash_attention_lse(q, k, v, key_mask=None, *, block_q: int = 256,
 
     ``causal`` masks GLOBAL positions ``offset + index`` — the
     (possibly traced) ``q_offset``/``k_offset`` let sequence-sharded
-    callers (the causal ring) express each shard's true coordinates."""
+    callers (the causal ring) express each shard's true coordinates.
+
+    ``block_q``/``block_k`` default to the autotuned winner for this
+    (shape-bucket, platform) when one is registered (``perf.autotune``),
+    else the measured hand-picked tiles — explicit values always win."""
+    plat = target_platform()
     if interpret is None:
-        interpret = target_platform() not in ("tpu", "axon")
+        interpret = plat not in ("tpu", "axon")
     if key_mask is None:
         key_mask = jnp.ones((q.shape[0], q.shape[2]), bool)
-    block_k = _resolve_block_k(block_k, k, causal)
+    block_q, block_k = _resolve_blocks(q, k, block_q, block_k, causal,
+                                       plat)
     return _flash_lse(q, k, v, key_mask, _pack_offs(q_offset, k_offset),
                       block_q, block_k, bool(interpret), bool(causal))
 
 
-def flash_attention(q, k, v, key_mask=None, *, block_q: int = 256,
+def flash_attention(q, k, v, key_mask=None, *,
+                    block_q: int | None = None,
                     block_k: int | None = None,
                     interpret: bool | None = None,
                     bwd_impl: str = "auto", causal: bool = False,
@@ -674,15 +720,21 @@ def flash_attention(q, k, v, key_mask=None, *, block_q: int = 256,
     granularity — v5e-measured best for it). Net: causal ≈ parity with
     the auto-bk full path at T=2048, 1.55x faster at T=8192
     (``bench.py`` flashcausal rows).
+
+    ``block_q``/``block_k`` default to the autotuned winner for this
+    (shape-bucket, platform) when one is registered (``perf.autotune``),
+    else the measured hand-picked tiles — explicit values always win.
     """
+    plat = target_platform()
     if interpret is None:
-        interpret = target_platform() not in ("tpu", "axon")
+        interpret = plat not in ("tpu", "axon")
     if bwd_impl not in ("auto", "pallas", "blockwise"):
         raise ValueError(f"bwd_impl={bwd_impl!r} is not one of "
                          "auto|pallas|blockwise")
     if key_mask is None:
         key_mask = jnp.ones((q.shape[0], q.shape[2]), bool)
-    block_k = _resolve_block_k(block_k, k, causal)
+    block_q, block_k = _resolve_blocks(q, k, block_q, block_k, causal,
+                                       plat)
     return _flash(q, k, v, key_mask, _pack_offs(q_offset, k_offset),
                   block_q, block_k, bool(interpret), bwd_impl,
                   bool(causal))

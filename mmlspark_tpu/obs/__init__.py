@@ -23,8 +23,9 @@ from .tracing import Span, StageTimer, Tracer, tracer, wall_now
 from .propagation import TraceContext, extract, inject
 from .export import (FlightRecorder, SpanCollector, chrome_trace,
                      flight_recorder)
-from .profile import (CompileTracker, FeatureLog, StepProfiler,
-                      compile_tracker, feature_log, step_profiler)
+from .profile import (FEATURE_SCHEMA_VERSION, CompileTracker, FeatureLog,
+                      StepProfiler, compile_tracker, feature_log,
+                      step_profiler)
 
 __all__ = ["registry", "tracer", "MetricsRegistry", "Counter", "Gauge",
            "Histogram", "Tracer", "Span", "StageTimer", "wall_now",
@@ -33,4 +34,5 @@ __all__ = ["registry", "tracer", "MetricsRegistry", "Counter", "Gauge",
            "FlightRecorder", "SpanCollector", "chrome_trace",
            "flight_recorder",
            "CompileTracker", "FeatureLog", "StepProfiler",
+           "FEATURE_SCHEMA_VERSION",
            "compile_tracker", "feature_log", "step_profiler"]

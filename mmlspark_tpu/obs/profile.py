@@ -39,6 +39,7 @@ import collections
 import contextlib
 import functools
 import os
+import sys
 import threading
 import time
 
@@ -361,6 +362,50 @@ class StepProfiler:
 step_profiler = StepProfiler()
 
 
+#: Feature-row schema version (ISSUE 12). v2 adds the fields the cost
+#: model needs that PR 6 did not record — ``padded_batch`` (the
+#: post-bucket batch shape the executor actually runs), ``queue_depth``
+#: at execute time, ``compiled_segments``, and the device ``platform``
+#: — plus this stamp itself. Consumers (``perf.costmodel``) SKIP rows
+#: whose version does not match, loudly, instead of misparsing old logs.
+FEATURE_SCHEMA_VERSION = 2
+
+_platform_cache: str | None = None
+
+
+def device_platform() -> str:
+    """Best-effort device platform for feature rows WITHOUT importing
+    jax OR initializing its backend — a host-only serving process must
+    not drag backend bring-up (seconds; on a TPU host it claims the
+    device) into its executor thread. ``"none"`` until something else
+    imports jax; a merely-imported jax reports the pinned platform
+    config (or ``"uninitialized"``) until something else actually
+    initializes a backend; cached once a live backend answers."""
+    global _platform_cache
+    if _platform_cache is not None:
+        return _platform_cache
+    mod = sys.modules.get("jax")
+    if mod is None:
+        return "none"       # don't cache: jax may import later
+    # only ask default_backend() once backends exist — the call itself
+    # INITIALIZES them otherwise (private attr read is guarded: on API
+    # drift this degrades to the config string, never to an init)
+    xb = sys.modules.get("jax._src.xla_bridge")
+    if xb is not None and getattr(xb, "_backends", None):
+        try:
+            _platform_cache = str(mod.default_backend())
+            return _platform_cache
+        except Exception:
+            return "unknown"    # don't cache a failed backend
+    try:
+        plats = mod.config.jax_platforms
+        if plats:
+            return str(plats).split(",")[0]
+    except Exception:
+        pass
+    return "uninitialized"
+
+
 class FeatureLog:
     """Bounded in-memory log of per-request cost-model features.
 
@@ -368,23 +413,39 @@ class FeatureLog:
     (route, batch, padding bucket, queue/execute ms) and enriched by
     model transforms through :meth:`record` or
     ``StepProfiler.step(features=...)`` (op shapes, dtype, device ms).
-    This is TRAINING DATA for the learned performance model that will
-    replace ``sched/policy.py``'s EWMA — bounded (ring buffer) so an
-    always-on server never grows it past ``maxlen`` records.
+    This is TRAINING DATA for the learned performance model
+    (``perf.costmodel``) that prices ``sched/policy.py``'s admission
+    and batch-close decisions — bounded (ring buffer) so an always-on
+    server never grows it past ``maxlen`` records.
+
+    Every record is stamped with :data:`FEATURE_SCHEMA_VERSION` and the
+    device ``platform`` unless the caller supplies them;
+    :attr:`total_recorded` counts monotonically past the ring bound
+    (the cost model's refresh trigger).
     """
 
     def __init__(self, maxlen: int = 4096, registry=None):
         reg = registry if registry is not None else _registry
         self._lock = threading.Lock()
         self._records = collections.deque(maxlen=int(maxlen))
+        self._total = 0
         self._c_records = reg.counter(
             "profile_feature_records_total",
             "cost-model feature records appended, by service")
 
     def record(self, **fields) -> None:
+        fields.setdefault("schema_version", FEATURE_SCHEMA_VERSION)
+        fields.setdefault("platform", device_platform())
         with self._lock:
             self._records.append(dict(fields))
+            self._total += 1
         self._c_records.inc(1, service=str(fields.get("service", "")))
+
+    @property
+    def total_recorded(self) -> int:
+        """Monotone append count (NOT bounded by the ring)."""
+        with self._lock:
+            return self._total
 
     def snapshot(self) -> list[dict]:
         """Copy of the retained records, oldest first."""

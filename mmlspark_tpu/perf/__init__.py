@@ -1,0 +1,20 @@
+"""Learned-performance subsystem (ISSUE 12): the consumers of the
+telemetry PR 6 built.
+
+- :mod:`.costmodel` — a numpy ridge regression over FeatureLog rows
+  that replaces the scheduler's per-bucket EWMA (behind a loud
+  fallback gate), feeds the autoscaler's capacity prediction, and
+  orders the AOT build by predicted traffic value.
+- :mod:`.autotune` — the offline TVM-style tile search for the Pallas
+  kernels, persisting winners the kernels consult at call time.
+
+Import is stdlib + numpy + obs/sched only — no JAX, no device (the CI
+smoke asserts it). See docs/perf.md.
+"""
+
+from .costmodel import (CostModel, bucket_build_priority, enabled,
+                        model_path, perf_root, shared_cost_model)
+from . import autotune
+
+__all__ = ["CostModel", "bucket_build_priority", "enabled",
+           "model_path", "perf_root", "shared_cost_model", "autotune"]

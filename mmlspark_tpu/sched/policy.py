@@ -27,12 +27,15 @@ no device and no JAX (the CI smoke check asserts this).
 
 from __future__ import annotations
 
+import logging
 import math
 import threading
 import time
 from dataclasses import dataclass
 
 from ..obs import registry as _default_registry
+
+_COSTMODEL_LOG = logging.getLogger("mmlspark_tpu.sched")
 
 # close-decision outcomes (returned by BatchPolicy.decide)
 GROW = "grow"     # more work is queued: take it
@@ -47,22 +50,35 @@ def bucket_of(n: int) -> int:
 
 
 class ServiceTimeEstimator:
-    """EWMA of batch service seconds, one series per padding bucket.
+    """Service-time pricing: learned cost model first, EWMA fallback.
 
-    The store IS the obs registry: ``observe`` writes the updated EWMA
-    into the ``sched_service_seconds_ewma{service=...,bucket=...}``
+    The EWMA store IS the obs registry: ``observe`` writes the updated
+    EWMA into the ``sched_service_seconds_ewma{service=...,bucket=...}``
     gauge and ``estimate`` reads it back, so the learned model is
     scrape-visible and survives scheduler re-construction (the registry
     is idempotent get-or-create). A second gauge,
     ``sched_item_seconds_ewma{service=...}``, tracks the per-item
     service cost across all buckets — the admission controller's
     service-rate input.
+
+    With a cost model attached (``perf.costmodel.CostModel`` —
+    ``RequestScheduler`` attaches the process-wide one by default),
+    ``estimate``/``item_seconds`` consult the model FIRST and fall back
+    to the EWMA when it declines (cold for this service, or its recent
+    error tripped the gate). Every answer is attributed
+    (``sched_costmodel_requests_total{source=model|ewma}``) and every
+    executed batch scores the model's prediction against the observed
+    time (``sched_costmodel_error_ms``), so a regressing model is
+    visible on the same scrape that shows its predictions. The EWMA
+    keeps training regardless — the fallback is always warm.
     """
 
-    def __init__(self, service: str, alpha: float = 0.25, registry=None):
+    def __init__(self, service: str, alpha: float = 0.25, registry=None,
+                 cost_model=None):
         reg = registry if registry is not None else _default_registry
         self.service = service
         self.alpha = float(alpha)
+        self.cost_model = cost_model
         self._g_bucket = reg.gauge(
             "sched_service_seconds_ewma",
             "EWMA batch service seconds, by service and padding bucket")
@@ -72,7 +88,20 @@ class ServiceTimeEstimator:
         self._c_obs = reg.counter(
             "sched_service_observations_total",
             "service-time samples folded into the EWMA, by service/bucket")
+        self._c_src = reg.counter(
+            "sched_costmodel_requests_total",
+            "service-time estimates answered, by service and source "
+            "(model | ewma)")
+        self._h_err = reg.histogram(
+            "sched_costmodel_error_ms",
+            "abs(cost-model predicted - observed) batch ms, by service")
+        self._obs_n = 0
         self._lock = threading.Lock()
+
+    def attach_cost_model(self, model) -> None:
+        """Attach a learned cost model (``perf.costmodel.CostModel``);
+        ``None`` detaches — pure-EWMA pricing again."""
+        self.cost_model = model
 
     def observe(self, batch_size: int, seconds: float) -> None:
         """Fold one executed batch into the per-bucket and per-item
@@ -84,27 +113,73 @@ class ServiceTimeEstimator:
         label values in the exposition, `sum by (service)` is exact)."""
         if batch_size <= 0:
             return
+        cm = self.cost_model
+        pred_ms = None
+        if cm is not None:
+            # score the model against what actually happened (read
+            # only: must not bump the fallback counters)
+            pred_ms = cm.predict_batch_ms(self.service, batch_size,
+                                          count=False)
         b = bucket_of(batch_size)
         seconds = max(float(seconds), 1e-9)
         per_item = seconds / float(batch_size)
         with self._lock:
             cur = self._g_bucket.value(service=self.service, bucket=str(b))
-            new = seconds if cur == 0.0 else \
-                self.alpha * seconds + (1 - self.alpha) * cur
-            self._g_bucket.set(new, service=self.service, bucket=str(b))
             item_cur = self._g_item.value(service=self.service)
+            if cur == 0.0:
+                # cold bucket: seed from the per-item global estimate
+                # scaled by batch size (when one exists) instead of the
+                # raw sample — one outlier first batch must not
+                # mis-price the whole bucket until it decays
+                prior = item_cur * batch_size if item_cur > 0.0 else None
+                new = seconds if prior is None else \
+                    self.alpha * seconds + (1 - self.alpha) * prior
+            else:
+                new = self.alpha * seconds + (1 - self.alpha) * cur
+            self._g_bucket.set(new, service=self.service, bucket=str(b))
             item_new = per_item if item_cur == 0.0 else \
                 self.alpha * per_item + (1 - self.alpha) * item_cur
             self._g_item.set(item_new, service=self.service)
             self._c_obs.inc(1, service=self.service, bucket=str(b))
+        if cm is not None:
+            actual_ms = seconds * 1e3
+            if pred_ms is not None:
+                self._h_err.observe(abs(pred_ms - actual_ms),
+                                    service=self.service)
+            try:
+                cm.observe(self.service, pred_ms, actual_ms)
+                self._obs_n += 1
+                if self._obs_n % 32 == 0:
+                    # online refresh: serving traffic trains the model
+                    # that prices serving traffic (cheap no-op until
+                    # enough new FeatureLog rows accumulated)
+                    cm.maybe_refresh()
+            except Exception:
+                _COSTMODEL_LOG.warning(
+                    "cost-model bookkeeping failed", exc_info=True)
 
     def estimate(self, batch_size: int) -> float | None:
-        """Expected service seconds for a batch of ``batch_size``
-        (registry read). Unobserved buckets extrapolate from the
-        nearest observed bucket linearly in padded size — an
-        overestimate on hardware with sublinear batch scaling, which
-        errs toward closing batches early (latency-safe). ``None``
-        until any sample exists."""
+        """Expected service seconds for a batch of ``batch_size``:
+        the learned cost model when it answers, else the EWMA registry
+        read. Unobserved buckets extrapolate from the nearest observed
+        bucket linearly in padded size — an overestimate on hardware
+        with sublinear batch scaling, which errs toward closing batches
+        early (latency-safe). ``None`` until any sample exists."""
+        cm = self.cost_model
+        if cm is not None:
+            ms = cm.predict_batch_ms(self.service, batch_size)
+            if ms is not None:
+                self._c_src.inc(1, service=self.service, source="model")
+                return ms / 1e3
+        out = self._ewma_estimate(batch_size)
+        if cm is not None and out is not None:
+            # attribute only ANSWERED estimates: a double-cold None is
+            # not an ewma-served request, and counting it would
+            # understate model coverage during warmup
+            self._c_src.inc(1, service=self.service, source="ewma")
+        return out
+
+    def _ewma_estimate(self, batch_size: int) -> float | None:
         want = bucket_of(batch_size)
         direct = self._read_bucket(want)
         if direct is not None:
@@ -120,8 +195,17 @@ class ServiceTimeEstimator:
         return None
 
     def item_seconds(self) -> float | None:
-        """Per-item EWMA service seconds (admission's service rate);
-        ``None`` until any sample exists."""
+        """Per-item service seconds (admission's service rate): the
+        cost model's per-item prediction at the observed operating
+        point when it answers (marginal cost — NOT a batch-of-one,
+        whose fixed dispatch intercept would inflate Little's-law
+        drain estimates by the batching factor), else the per-item
+        EWMA; ``None`` until any sample exists."""
+        cm = self.cost_model
+        if cm is not None:
+            ms = cm.predict_item_ms(self.service)
+            if ms is not None:
+                return ms / 1e3
         v = self._g_item.value(service=self.service)
         return v if v > 0.0 else None
 

@@ -64,6 +64,24 @@ class RequestScheduler:
         self.tenancy = tenancy
         self.estimator = estimator or ServiceTimeEstimator(
             service, registry=reg)
+        if self.estimator.cost_model is None and registry is None:
+            # the learned-performance loop (ISSUE 12): serving-path
+            # schedulers price with the process-wide cost model, which
+            # answers only once FeatureLog traffic trained it for this
+            # service — until then (and whenever its error gate trips)
+            # estimates come from the EWMA exactly as before. Only on
+            # the DEFAULT registry: a caller passing its own registry
+            # is isolating (tests, scenarios), and the shared model's
+            # metrics/gate state live on the default registry — a
+            # half-attached split family would be worse than no model.
+            # Lazy import: policy code must stay importable without
+            # perf (and perf imports sched.policy).
+            try:
+                from ..perf.costmodel import enabled, shared_cost_model
+                if enabled():
+                    self.estimator.attach_cost_model(shared_cost_model())
+            except Exception:  # pragma: no cover - perf layer optional
+                pass
         self.admission = AdmissionController(
             service,
             AdmissionConfig(max_queue=max_queue, max_inflight=max_inflight,

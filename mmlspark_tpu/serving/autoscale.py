@@ -43,6 +43,7 @@ from __future__ import annotations
 import logging
 import threading
 import uuid
+from collections import deque
 from dataclasses import dataclass, field
 
 from ..obs import registry as _default_registry
@@ -70,6 +71,19 @@ class AutoscaleConfig:
     down_stable: int = 4       # consecutive idle ticks before down
     cooldown: float = 5.0      # seconds after an action with no action
     step: int = 1              # workers added/removed per action
+    # -- predictive capacity (ISSUE 12): scale on where the load is
+    # GOING, not where it is, so scale-up LEADS the diurnal curve.
+    # The depth trend over the last history_ticks evaluations is
+    # extrapolated lead_ticks ahead; predicted pressure feeds the same
+    # hysteresis machinery as measured pressure (a noisy slope still
+    # cannot thrash the pool). wait_high (seconds) additionally prices
+    # the predicted backlog through the learned cost model: when the
+    # predicted per-worker drain time exceeds it, that is overload even
+    # below the raw depth threshold. 0 = depth-only.
+    predictive: bool = False
+    lead_ticks: int = 4        # evaluation intervals to extrapolate
+    history_ticks: int = 8     # trend window, in evaluations
+    wait_high: float = 0.0     # predicted per-worker drain s → overload
 
 
 @dataclass
@@ -102,18 +116,28 @@ class Autoscaler:
     must not block on the drain). ``tenancy`` (optional,
     :class:`~..sched.tenancy.Tenancy`) supplies SLO pressure;
     ``signals`` (optional callable → :class:`AutoscaleSignals`)
-    replaces the registry reads entirely.
+    replaces the registry reads entirely. ``item_seconds`` (optional
+    zero-arg callable → per-item service seconds or None — typically
+    the scheduler estimator's cost-model-backed ``item_seconds``)
+    prices the predicted backlog when ``config.predictive`` is on; a
+    cold model returns None and the loop degrades to depth thresholds,
+    never to a stale price.
     """
 
     def __init__(self, service: str, pool,
                  config: AutoscaleConfig | None = None, *,
-                 registry=None, tenancy=None, signals=None):
+                 registry=None, tenancy=None, signals=None,
+                 item_seconds=None):
         reg = registry if registry is not None else _default_registry
         self.service = service
         self.pool = pool
         self.config = config or AutoscaleConfig()
         self.tenancy = tenancy
         self._signals = signals
+        self._item_seconds = item_seconds
+        self._depth_hist: deque = deque(
+            maxlen=max(int(self.config.history_ticks), 2))
+        self._tick_i = 0
         self._registry = reg
         self.events: list[AutoscaleEvent] = []
         self._lock = threading.Lock()
@@ -136,6 +160,13 @@ class Autoscaler:
             "autoscale_blocked_total",
             "actionable pressure NOT acted on, by service/reason "
             "(cooldown | hysteresis | limit)")
+        self._g_pred = reg.gauge(
+            "autoscale_predicted_depth",
+            "trend-extrapolated queue depth lead_ticks ahead, by service")
+        self._c_pred = reg.counter(
+            "autoscale_predictive_total",
+            "overload pressure that fired on PREDICTED load before the "
+            "raw thresholds did, by service")
 
     # -- signal acquisition --------------------------------------------------
     def read_signals(self) -> AutoscaleSignals:
@@ -193,6 +224,29 @@ class Autoscaler:
         under = (s.queue_depth < cfg.queue_low * max(n, 1)
                  and s.slo_pressure < cfg.slo_low
                  and s.breakers_open == 0)
+        if cfg.predictive:
+            # predictive capacity (ISSUE 12): extrapolate the depth
+            # trend lead_ticks ahead; predicted pressure runs through
+            # the SAME hysteresis/cooldown machinery as measured
+            # pressure, so it buys lead time, not thrash
+            self._tick_i += 1
+            self._depth_hist.append((self._tick_i, s.queue_depth))
+            pred = self._predict_depth(s.queue_depth)
+            self._g_pred.set(pred, service=self.service)
+            over_pred = pred > cfg.queue_high * max(n, 1)
+            if not over_pred and cfg.wait_high > 0:
+                item_s = self._predicted_item_seconds()
+                if item_s:
+                    # the learned price: predicted backlog drain time
+                    # per worker — overload before the raw depth
+                    # threshold when requests are expensive
+                    over_pred = (pred * item_s / max(n, 1)
+                                 > cfg.wait_high)
+            if over_pred and not over:
+                self._c_pred.inc(1, service=self.service)
+            over = over or over_pred
+            # and never walk capacity down INTO a predicted rise
+            under = under and pred < cfg.queue_low * max(n, 1)
         self._up_streak = self._up_streak + 1 if over else 0
         self._down_streak = self._down_streak + 1 if under else 0
         if t < self._cooldown_until:
@@ -229,6 +283,32 @@ class Autoscaler:
             self._record("down", t, f"depth={s.queue_depth:.0f}")
             return "down"
         return "hold"
+
+    def _predict_depth(self, depth: float) -> float:
+        """Least-squares depth slope per tick over the history window,
+        extrapolated ``lead_ticks`` ahead (clamped at zero). Under 3
+        samples there is no trend — predicted = measured."""
+        h = self._depth_hist
+        if len(h) < 3:
+            return depth
+        n = len(h)
+        mt = sum(t for t, _ in h) / n
+        md = sum(d for _, d in h) / n
+        num = sum((t - mt) * (d - md) for t, d in h)
+        den = sum((t - mt) ** 2 for t, _ in h)
+        if den <= 0:
+            return depth
+        slope = num / den
+        return max(depth + slope * self.config.lead_ticks, 0.0)
+
+    def _predicted_item_seconds(self) -> float | None:
+        if self._item_seconds is None:
+            return None
+        try:
+            v = self._item_seconds()
+            return v if v and v > 0 else None
+        except Exception:  # a bad price must not kill the loop
+            return None
 
     def _after_action(self, t: float) -> None:
         self._desired = self.pool.count()

@@ -601,6 +601,10 @@ class ServingQuery:
         bytes) — the learned scheduler model's training rows."""
         n = len(batch)
         bucket = bucket_of(n)
+        # standing backlog at annotate time: the queue-depth feature the
+        # cost model trains on (what admission saw is gone by now; the
+        # post-drain depth is the stationary load signal)
+        queue_depth = self.server.scheduler.qsize()
         tenancy = self.server.scheduler.tenancy
         # fused-pipeline transparency: a CompiledPipeline transform_fn
         # (or a DSL chain that compiled one) reports how many XLA
@@ -620,6 +624,12 @@ class ServingQuery:
                 route=getattr(c, "route", "/"),
                 tenant=tenant,
                 batch=n, bucket=bucket,
+                # schema v2 (ISSUE 12): the post-bucket padded batch
+                # shape the executor actually ran, and the queue depth
+                # — the cost model's missing features (schema_version
+                # and platform are stamped by FeatureLog.record)
+                padded_batch=bucket,
+                queue_depth=queue_depth,
                 queue_ms=round(queue_s * 1e3, 4),
                 execute_ms=round(execute_s * 1e3, 4),
                 entity_bytes=len(getattr(c.request, "entity", b"")

@@ -593,6 +593,7 @@ def mixed_tenant_scenario(*, service: str = "tenant-bench",
                           be_rate_cap: float = 30.0,
                           utilization_floor: float = 0.15,
                           slow_factor: float = 3.0,
+                          predictive: bool = False,
                           registry=None) -> dict:
     """Long-running mixed-workload elasticity acceptance (ISSUE 9).
 
@@ -626,6 +627,13 @@ def mixed_tenant_scenario(*, service: str = "tenant-bench",
     counts, so two runs realize the same ``schedule`` (compared sorted:
     thread interleaving may reorder firings across points, never change
     them).
+
+    ``predictive=True`` (ISSUE 12) arms the autoscaler's trend-
+    extrapolated capacity prediction, priced by the scheduler
+    estimator's (cost-model-backed) per-item service time — the result
+    additionally reports ``scale_up_lag_s``, the gap between the
+    offered load's diurnal rise and the first scale-up (smaller =
+    the pool leads the curve), with the gold-tier contract unchanged.
     """
     import queue as _queue
 
@@ -792,8 +800,11 @@ def mixed_tenant_scenario(*, service: str = "tenant-bench",
         AutoscaleConfig(min_workers=1, max_workers=worker_max,
                         interval=0.1, queue_high=6.0, queue_low=1.5,
                         slo_high=0.8, slo_low=0.4, up_stable=2,
-                        down_stable=5, cooldown=0.6),
-        registry=reg, tenancy=tenancy)
+                        down_stable=5, cooldown=0.6,
+                        predictive=predictive, lead_ticks=5,
+                        history_ticks=8, wait_high=0.25),
+        registry=reg, tenancy=tenancy,
+        item_seconds=sched.estimator.item_seconds)
 
     rules = [
         # one worker killed mid-lease: the SECOND worker the autoscaler
@@ -928,6 +939,21 @@ def mixed_tenant_scenario(*, service: str = "tenant-bench",
     peak_max = max(in_peak, default=0)
     final_count = samples[-1][1] if samples else 0
 
+    # -- scale-up lead/lag vs the diurnal rise (ISSUE 12) --------------------
+    # load-rise time: first instant the total offered rate crosses
+    # halfway between its trough and peak (pure function of the specs —
+    # comparable across runs); lag = first up-event minus that instant.
+    # Smaller (or negative) = the pool LEADS the curve.
+    grid = [i * 0.01 for i in range(int(period_s * 100) + 1)]
+    totals = [sum(_diurnal_rate(spec, t, period_s)
+                  for spec in MIXED_TENANTS.values()) for t in grid]
+    rise_level = min(totals) + 0.5 * (max(totals) - min(totals))
+    load_rise_s = next((t for t, r in zip(grid, totals)
+                        if r >= rise_level), 0.0)
+    first_up_s = min((e.t - t0 for e in ups), default=None)
+    scale_up_lag_s = (first_up_s - load_rise_s
+                      if first_up_s is not None else None)
+
     # -- utilization ---------------------------------------------------------
     busy = sum(w.busy_s for w in pool.workers.values())
     alive = sum((w.ended - w.started) for w in pool.workers.values()
@@ -962,6 +988,10 @@ def mixed_tenant_scenario(*, service: str = "tenant-bench",
         "be_absorbed_burst": bool(be["shed_total"] > 0),
         "workers_peak": peak_max,
         "workers_final": final_count,
+        "predictive": bool(predictive),
+        "load_rise_s": load_rise_s,
+        "first_up_s": first_up_s,
+        "scale_up_lag_s": scale_up_lag_s,
         "autoscale_ups": len(ups),
         "autoscale_downs": len(downs),
         "autoscale_replaces": len(replaces),
@@ -1305,3 +1335,178 @@ def aot_scale_up_scenario(*, n_rows: int = 64, width: int = 48,
             aot.uninstall()
         if owns_root:
             shutil.rmtree(root, ignore_errors=True)
+
+
+# ------------------------------------------------- learned cost model
+def synth_feature_rows(n_rows: int = 1200, *, seed: int = 5,
+                       service: str = "costmodel-bench") -> list[dict]:
+    """Deterministic FeatureLog-shaped rows with a known cost
+    structure: three routes whose execute time depends on the padding
+    bucket AND the entity size — the per-request signal a per-bucket
+    EWMA cannot see, which is exactly where the learned model earns its
+    keep. Noise is seeded; two calls produce identical rows."""
+    import numpy as np
+
+    from ..obs.profile import FEATURE_SCHEMA_VERSION
+    from ..sched.policy import bucket_of
+
+    rng = np.random.default_rng(seed)
+    # route -> (base_ms, per-padded-row ms, per-KB ms)
+    routes = {"/feat": (0.8, 0.05, 0.030),
+              "/gbdt": (2.0, 0.15, 0.004),
+              "/gen": (5.0, 0.40, 0.012)}
+    names = sorted(routes)
+    rows = []
+    for i in range(n_rows):
+        route = names[int(rng.integers(0, len(names)))]
+        base, per_row, per_kb = routes[route]
+        batch = int(rng.integers(1, 65))
+        bucket = bucket_of(batch)
+        entity_kb = float(rng.uniform(0.5, 200.0))
+        depth = float(max(rng.normal(8.0, 4.0), 0.0))
+        ms = (base + per_row * bucket + per_kb * entity_kb
+              + float(rng.normal(0.0, 0.15)))
+        rows.append({
+            "service": service, "route": route, "batch": batch,
+            "bucket": bucket, "padded_batch": bucket,
+            "entity_bytes": entity_kb * 1024.0, "queue_depth": depth,
+            "queue_ms": depth * 0.5, "execute_ms": max(ms, 0.05),
+            "schema_version": FEATURE_SCHEMA_VERSION,
+            "platform": "synthetic",
+        })
+    return rows
+
+
+def costmodel_scenario(*, n_rows: int = 1200, seed: int = 5,
+                       holdout: float = 0.25, registry=None) -> dict:
+    """Learned-cost-model acceptance (ISSUE 12): train on the first
+    (1 - holdout) of a synthetic FeatureLog stream, score BOTH brains
+    on the held-out tail — the model predicts per row (bucket + entity
+    bytes + depth), the EWMA baseline is a ``ServiceTimeEstimator`` fed
+    the same training stream in arrival order, exactly as the scheduler
+    trains it today. Banked: both MAEs and ``model_beats_ewma``."""
+    from ..obs.metrics import registry as _default
+    from ..perf.costmodel import CostModel
+    from ..sched.policy import ServiceTimeEstimator
+
+    reg = registry if registry is not None else _default
+    service = "costmodel-bench"
+    rows = synth_feature_rows(n_rows, seed=seed, service=service)
+    n_train = int(len(rows) * (1.0 - holdout))
+    train, held = rows[:n_train], rows[n_train:]
+
+    model = CostModel(min_rows=32, registry=reg)
+    used = model.fit(train)
+
+    ewma = ServiceTimeEstimator(service, registry=reg)
+    for r in train:
+        ewma.observe(r["batch"], r["execute_ms"] / 1e3)
+
+    model_abs, ewma_abs = [], []
+    for r in held:
+        actual = r["execute_ms"]
+        pred = model.predict_batch_ms(
+            service, r["batch"], route=r["route"],
+            entity_bytes=r["entity_bytes"],
+            queue_depth=r["queue_depth"], count=False)
+        if pred is not None:
+            model_abs.append(abs(pred - actual))
+        est = ewma.estimate(r["batch"])
+        if est is not None:
+            ewma_abs.append(abs(est * 1e3 - actual))
+    model_mae = (sum(model_abs) / len(model_abs)
+                 if model_abs else float("nan"))
+    ewma_mae = (sum(ewma_abs) / len(ewma_abs)
+                if ewma_abs else float("nan"))
+
+    # the fallback gate, exercised: a cold model must answer None
+    cold = CostModel(min_rows=32, registry=reg)
+    cold_pred = cold.predict_batch_ms(service, 8)
+    return {
+        "n_train": len(train), "n_holdout": len(held),
+        "rows_used": used,
+        "model_mae_ms": model_mae,
+        "ewma_mae_ms": ewma_mae,
+        "model_beats_ewma": bool(model_mae < ewma_mae),
+        "model_covered": len(model_abs),
+        "cold_falls_back": bool(cold_pred is None),
+    }
+
+
+def autoscale_lead_scenario(*, ticks: int = 200, period_ticks: int = 100,
+                            base_rate: float = 2.0, swing: float = 30.0,
+                            drain_per_worker: float = 4.0,
+                            lead_ticks: int = 6,
+                            registry=None) -> dict:
+    """Predictive-autoscaling lead/lag acceptance (ISSUE 12), fully
+    deterministic: a simulated diurnal arrival rate feeds a backlog
+    that a synthetic pool drains at ``drain_per_worker`` per tick; the
+    SAME simulation drives a reactive and a predictive
+    :class:`~..serving.autoscale.Autoscaler` tick by tick. The metric
+    is ticks between the load rise (the first tick arrivals exceed the
+    minimum pool's drain capacity — when backlog starts building) and
+    the first scale-up. Predictive must fire no later than reactive,
+    and earlier once the trend is visible — scale-up LEADS the curve
+    instead of trailing it."""
+    import math as _math
+
+    from ..obs.metrics import registry as _default
+    from ..serving.autoscale import (Autoscaler, AutoscaleConfig,
+                                     AutoscaleSignals)
+
+    reg = registry if registry is not None else _default
+
+    def rate(i: int) -> float:
+        phase = (i % period_ticks) / period_ticks
+        return base_rate + swing * 0.5 * (
+            1.0 - _math.cos(2.0 * _math.pi * phase))
+
+    def run(predictive: bool) -> dict:
+        class _Pool:
+            n = 1
+
+            def count(self):
+                return self.n
+
+            def scale_up(self):
+                self.n += 1
+
+            def scale_down(self):
+                self.n -= 1
+
+        pool = _Pool()
+        auto = Autoscaler(
+            f"lead-{'pred' if predictive else 'react'}", pool,
+            AutoscaleConfig(min_workers=1, max_workers=8,
+                            queue_high=8.0, queue_low=1.0,
+                            up_stable=2, down_stable=10, cooldown=0.0,
+                            predictive=predictive,
+                            lead_ticks=lead_ticks, history_ticks=8),
+            registry=reg)
+        backlog = 0.0
+        rise_tick = up_tick = None
+        for i in range(ticks):
+            r = rate(i)
+            if rise_tick is None and r > drain_per_worker:
+                rise_tick = i   # backlog starts building here
+            backlog = max(backlog + r - pool.n * drain_per_worker, 0.0)
+            decision = auto.tick(AutoscaleSignals(queue_depth=backlog))
+            if decision == "up" and up_tick is None:
+                up_tick = i
+        return {"rise_tick": rise_tick, "up_tick": up_tick,
+                "lag_ticks": (up_tick - rise_tick
+                              if up_tick is not None
+                              and rise_tick is not None else None)}
+
+    react = run(False)
+    pred = run(True)
+    both = (react["lag_ticks"] is not None
+            and pred["lag_ticks"] is not None)
+    return {
+        "reactive": react,
+        "predictive": pred,
+        "lag_reactive_ticks": react["lag_ticks"],
+        "lag_predictive_ticks": pred["lag_ticks"],
+        "predictive_leads": bool(
+            both and pred["lag_ticks"] < react["lag_ticks"]),
+    }

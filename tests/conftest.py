@@ -17,6 +17,15 @@ if "xla_force_host_platform_device_count" not in flags:
     os.environ["XLA_FLAGS"] = (
         flags + " --xla_force_host_platform_device_count=8").strip()
 
+# Isolate the learned-performance store (perf/): a leftover autotune
+# winner registry or persisted cost model in the per-user /tmp default
+# would change kernel tile configs and scheduler pricing under tests —
+# ambient machine state must not steer deterministic suites.
+import tempfile  # noqa: E402
+
+os.environ["MMLSPARK_TPU_PERF_STORE"] = tempfile.mkdtemp(
+    prefix="mmlspark_tpu_perf_tests_")
+
 import numpy as np  # noqa: E402
 import pytest  # noqa: E402
 

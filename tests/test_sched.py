@@ -69,6 +69,30 @@ class TestPolicyUnits:
         assert any(k.startswith("sched_service_seconds_ewma")
                    for k in snap)
 
+    def test_cold_bucket_seeds_from_item_estimate(self):
+        """Cold-start bias regression (ISSUE 12): the FIRST observation
+        for a bucket must not seed the EWMA directly once a per-item
+        global estimate exists — one outlier first batch would mis-price
+        admission for that bucket until it decays. The seed blends the
+        sample with item_ewma × batch_size at the usual alpha."""
+        reg = MetricsRegistry()
+        est = ServiceTimeEstimator("svc", registry=reg)
+        # the very first bucket ever still seeds directly (no prior)
+        est.observe(1, 0.010)
+        assert est.estimate(1) == pytest.approx(0.010)
+        # stabilize the per-item estimate at ~10 ms
+        for _ in range(8):
+            est.observe(1, 0.010)
+        item_s = est.item_seconds()
+        assert item_s == pytest.approx(0.010, rel=1e-6)
+        # an outlier first batch for bucket 8: 800 ms where the prior
+        # says 8 × 10 ms = 80 ms. Old behavior stored 0.8 verbatim; the
+        # seeded blend is alpha*0.8 + (1-alpha)*0.08 = 0.26
+        est.observe(8, 0.800)
+        want = 0.25 * 0.800 + 0.75 * (item_s * 8)
+        assert est.estimate(8) == pytest.approx(want, rel=1e-6)
+        assert est.estimate(8) < 0.3  # nowhere near the raw outlier
+
     def test_admission_sheds_and_accounts(self):
         reg = MetricsRegistry()
         est = ServiceTimeEstimator("svc", registry=reg)
