@@ -1830,7 +1830,10 @@ def bench_multichip(extras: dict) -> None:
         [sys.executable, "-c", code],
         env=scrubbed_cpu_env(n, extra_path=repo), cwd=repo,
         capture_output=True, text=True,
-        timeout=540 * _timeout_scale())
+        # the crosshost section spawns 2-process pods (each booting its
+        # own jax + gloo + compiles) inside this subprocess — roughly a
+        # second full bench body on top of the single-host sections
+        timeout=1080 * _timeout_scale())
     parsed = None
     for line in reversed((proc.stdout or "").splitlines()):
         try:

@@ -53,6 +53,9 @@ PACKAGES: dict[str, list[str]] = {
     "compile": ["test_pipeline_compile.py"],  # whole-pipeline fusion
     "aot": ["test_aot.py"],  # AOT executable store + warm boot
     "perf": ["test_perf.py"],  # learned cost model + kernel autotuner
+    # pod-scale SPMD harness: runs UNFILTERED (no -m 'not slow'), so
+    # the 2-process CPU pods execute here under the package wall clock
+    "multihost": ["test_multihost.py"],
     "text": ["test_text_transfer.py", "test_causal_lm.py",
              "test_speculative.py"],
 }
@@ -185,6 +188,28 @@ def style() -> int:
         "assert DtypePolicy().param_dtype == 'float32'; "
         "assert 'jax' not in sys.modules, 'rule registration pulled jax'; "
         "print('parallel.partition import OK (no jax)')")
+    rc = _run([sys.executable, "-c", smoke],
+              env=dict(os.environ, JAX_PLATFORMS="cpu"))
+    if rc:
+        return rc
+    # the multi-host launcher is pure stdlib until a worker boots: the
+    # coordinator side (port pick, env synthesis, target validation)
+    # must work on the build/driver machine with no JAX at all — JAX
+    # only loads inside the spawned worker processes
+    smoke = (
+        "import sys; "
+        "from mmlspark_tpu.parallel.multihost import ("
+        "free_port, launch_pod, worker_env); "
+        "assert 'jax' not in sys.modules, 'multihost import pulled jax'; "
+        "env = worker_env(process_id=1, num_processes=2, "
+        "coordinator='127.0.0.1:1234', local_devices=4); "
+        "assert env['MMLSPARK_TPU_COORDINATOR'] == '127.0.0.1:1234'; "
+        "assert env['MMLSPARK_TPU_PROCESS_ID'] == '1'; "
+        "assert env['JAX_CPU_COLLECTIVES_IMPLEMENTATION'] == 'gloo'; "
+        "assert 'JAX_COMPILATION_CACHE_DIR' not in env; "
+        "assert 0 < free_port() < 65536; "
+        "assert 'jax' not in sys.modules, 'launcher plumbing pulled jax'; "
+        "print('parallel.multihost import OK (no jax)')")
     rc = _run([sys.executable, "-c", smoke],
               env=dict(os.environ, JAX_PLATFORMS="cpu"))
     if rc:

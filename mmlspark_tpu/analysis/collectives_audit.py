@@ -11,6 +11,15 @@ where it matters. Rule ``raw-collective`` (warning) flags them
 everywhere except ``parallel/collectives.py`` and ``parallel/compat.py``
 (the blessed wrappers' own bodies).
 
+Rule ``raw-sharding-constraint`` (warning) is the same discipline for
+activation sharding: ``jax.lax.with_sharding_constraint`` (or the
+``jax.experimental.pjit`` spelling) called outside ``parallel/`` skips
+``parallel.compat.with_sharding_constraint`` — the one site that
+handles the API-generation split, resolves bare PartitionSpecs against
+the context mesh, and demotes (with a counter) axes the mesh cannot
+honor. A raw call site works on today's jax and silently breaks on the
+other generation.
+
 Rule ``unbound-axis`` (error) checks literal axis names: a string axis
 passed to a collective must appear among the module's declared axes
 (string literals inside ``shard_map``/``Mesh``/``make_mesh``/
@@ -43,6 +52,17 @@ def _is_collective(resolved: str | None) -> str | None:
             "lax" in head.split(".") or head in ("jax.lax", "lax")):
         return last
     return None
+
+
+def _is_raw_constraint(resolved: str | None) -> bool:
+    """A jax-spelled ``with_sharding_constraint`` — either generation's
+    home (``jax.lax`` / ``jax.experimental.pjit``) or the bare ``jax.``
+    re-export; the compat wrapper's own qualname never matches."""
+    if not resolved or not resolved.endswith("with_sharding_constraint"):
+        return False
+    head = resolved.rpartition(".")[0]
+    parts = head.split(".")
+    return "jax" in parts or "lax" in parts or "pjit" in parts
 
 
 def _strings_in(node: ast.AST) -> set[str]:
@@ -141,6 +161,16 @@ class CollectiveAuditPass(AnalysisPass):
             for fi in g.functions.values():
                 for call in g._own_calls(fi.node):
                     resolved = resolve(dotted(call.func), g.imports)
+                    if not blessed and _is_raw_constraint(resolved):
+                        out.append(self.finding(
+                            "raw-sharding-constraint", "warning", mod,
+                            call, fi.qualname,
+                            f"raw with_sharding_constraint in "
+                            f"{fi.qualname!r} bypasses parallel.compat "
+                            f"— no API-generation split, no context-"
+                            f"mesh spec resolution, no demotion "
+                            f"accounting", detail=resolved))
+                        continue
                     op = _is_collective(resolved)
                     if op is None:
                         continue

@@ -226,11 +226,25 @@ def _sha(payload) -> str:
 
 
 def mesh_descriptor(mesh) -> list | None:
-    """A mesh's fingerprint-relevant identity: axis names + shape."""
+    """A mesh's fingerprint-relevant identity: axis names + shape.
+
+    On a multi-process (pod) mesh the descriptor additionally carries
+    ``[process_count, process_index]``: an executable compiled for a
+    2-process (2, 4) mesh addresses only this worker's shard of the
+    devices, so a pod worker must never warm-load a single-host build
+    of the "same" mesh shape (nor another rank's). Single-host meshes
+    keep the bare two-element form, so existing store fingerprints
+    stay valid.
+    """
     if mesh is None:
         return None
-    return [list(getattr(mesh, "axis_names", ())),
-            list(np.asarray(mesh.devices).shape)]
+    devs = np.asarray(mesh.devices)
+    desc = [list(getattr(mesh, "axis_names", ())), list(devs.shape)]
+    procs = sorted({getattr(d, "process_index", 0) for d in devs.flat})
+    if procs != [0]:
+        import jax
+        desc.append([len(procs), int(jax.process_index())])
+    return desc
 
 
 def _canon_rules(rules) -> list | None:

@@ -242,6 +242,34 @@ class FusedSegment:
         self._c_calls.inc(1, segment=self.name)
         return merged
 
+    def run_sharded(self, columns: dict) -> dict:
+        """Execute the fused body on already-GLOBAL device arrays and
+        return device outputs — the pod serving path.
+
+        ``run()`` is host-mediated: numpy in, ``jax.device_get`` out.
+        On a multi-process mesh both ends break — no single process
+        holds a full row batch, and ``device_get`` on a non-fully-
+        addressable array raises. Here the caller feeds global arrays
+        (``parallel.feed_process_local`` / ``compat
+        .make_array_from_process_local_data``) whose rows live on
+        different hosts, every process executes the same program, and
+        outputs stay sharded on device; gather explicitly via
+        ``compat.process_allgather(..., tiled=True)`` when a host copy
+        is wanted. No eager fallback: eager stage-by-stage transforms
+        are host numpy code and cannot run on a sharded batch, so
+        errors propagate.
+        """
+        donated, dropped = self._split(dict(columns))
+        fn = self._aot_executable(donated, dropped) \
+            or self._ensure_fn(donated, dropped)
+        if self.mesh is not None:
+            with self.mesh:
+                out = fn(donated, dropped)
+        else:
+            out = fn(donated, dropped)
+        self._c_calls.inc(1, segment=self.name)
+        return out
+
 
 def _merge_traced(df: DataFrame, out: dict, carry,
                   stages) -> DataFrame:

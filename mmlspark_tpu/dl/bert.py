@@ -31,6 +31,7 @@ from typing import Any, Callable
 import jax.numpy as jnp
 from flax import linen as nn
 
+from ..parallel.partition import constrain_activation
 from .text_encoder import _dense_attention
 
 
@@ -127,10 +128,16 @@ class BertEncoder(nn.Module):
         x = self.word(ids) + self.pos(jnp.arange(T))[None]
         x = x + self.typ(jnp.zeros_like(ids) if type_ids is None
                          else type_ids)
-        x = self.embed_ln(x).astype(self.dtype)
+        # block-boundary activation sharding (batch over dp, per the
+        # registered activation spec): under a mesh-scoped partitioned
+        # step this pins the [B, T, W] residual stream — and the remat
+        # recompute buffers with it — batch-sharded between blocks;
+        # with no mesh in scope it is the identity
+        x = constrain_activation(self.embed_ln(x).astype(self.dtype),
+                                 "BertEncoder")
         key_mask = ids != 0
         for block in self.blocks:
-            x = block(x, key_mask)
+            x = constrain_activation(block(x, key_mask), "BertEncoder")
         mask = key_mask.astype(jnp.float32)[..., None]
         pooled = (x * mask).sum(1) / jnp.maximum(mask.sum(1), 1.0)
         out = {"tokens": x, "pooled": pooled.astype(jnp.float32),
@@ -148,7 +155,7 @@ class BertEncoder(nn.Module):
 # (parallel/partition.py); `re.search` is unanchored, so the same
 # rules match the tree under any prefix — a bare params dict, a
 # TrainState, or an optax moment tree.
-from ..parallel.partition import register_partition_rules
+from ..parallel.partition import DtypePolicy, register_partition_rules
 
 register_partition_rules("BertEncoder", [
     (r"word/embedding", ("tp", None)),
@@ -163,4 +170,11 @@ register_partition_rules("BertEncoder", [
     (r"mlp_2/kernel", ("tp", None)),
     (r"mlp_2/bias", ()),
     (r"pooler/(kernel|bias)", ()),
-])
+],
+    # chip-tuned defaults, selectable via dtype_policy_for: bf16
+    # compute with fp32 storage/accum (arXiv:2008.01040's safe point);
+    # activations batch-shard over dp at block boundaries
+    dtype_policy=DtypePolicy(param_dtype="float32",
+                             compute_dtype="bfloat16",
+                             grad_accum_dtype="float32"),
+    activation_spec=("dp",))

@@ -24,7 +24,8 @@ import numpy as np
 import optax
 from flax import linen as nn
 
-from ..parallel.partition import (partition_rules_for,
+from ..parallel.partition import (DtypePolicy, activation_spec_for,
+                                  dtype_policy_for, partition_rules_for,
                                   register_partition_rules)
 from .text_encoder import TextEncoder
 from .train import (TrainState, init_train_state,
@@ -84,7 +85,13 @@ register_partition_rules("TextEncoderLM", (
     *partition_rules_for("TextEncoder"),
     (r"lm_head/kernel", (None, "tp")),
     (r"lm_head/bias", ("tp",)),
-))
+),
+    # inherit the trunk's chip defaults (bf16 compute / fp32 accum,
+    # dp-sharded block-boundary activations)
+    dtype_policy=dtype_policy_for("TextEncoder") or DtypePolicy(
+        param_dtype="float32", compute_dtype="bfloat16",
+        grad_accum_dtype="float32"),
+    activation_spec=activation_spec_for("TextEncoder") or ("dp",))
 
 
 def _mesh_step_and_state(module, tx, state, mesh, dtype_policy,

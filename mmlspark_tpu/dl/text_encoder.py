@@ -311,10 +311,13 @@ class TextEncoder(nn.Module):
         return {"tokens": x, "pooled": pooled.astype(jnp.float32)}
 
     def __call__(self, ids, train: bool = False):
-        x = self.embed_ids(ids)
+        from ..parallel.partition import constrain_activation
+        # block-boundary activation sharding (batch over dp per the
+        # registered activation spec) — identity with no mesh in scope
+        x = constrain_activation(self.embed_ids(ids), "TextEncoder")
         key_mask = ids != 0
         for block in self.blocks:
-            x = block(x, key_mask)
+            x = constrain_activation(block(x, key_mask), "TextEncoder")
         return self.finalize(x, ids)
 
 
@@ -323,8 +326,8 @@ class TextEncoder(nn.Module):
 # concatenates q|k|v, each head-aligned, so sharding the last dim over
 # tp keeps whole heads on one shard as long as tp divides heads), out
 # and mlp_2 row-parallel. Specs right-align (parallel/partition.py).
-from ..parallel.partition import register_partition_rules as \
-    _register_partition_rules
+from ..parallel.partition import DtypePolicy as _DtypePolicy, \
+    register_partition_rules as _register_partition_rules
 
 _register_partition_rules("TextEncoder", [
     (r"embed/embedding", ("tp", None)),
@@ -338,7 +341,13 @@ _register_partition_rules("TextEncoder", [
     (r"mlp_1/bias", ("tp",)),
     (r"mlp_2/kernel", ("tp", None)),
     (r"mlp_2/bias", ()),
-])
+],
+    # bf16 compute / fp32 storage+accum, batch-sharded activations at
+    # block boundaries (same chip defaults as the BertEncoder set)
+    dtype_policy=_DtypePolicy(param_dtype="float32",
+                              compute_dtype="bfloat16",
+                              grad_accum_dtype="float32"),
+    activation_spec=("dp",))
 
 
 def make_attention_fn(impl: str = "dense", mesh=None, axis: str = "sp",

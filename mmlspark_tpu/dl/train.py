@@ -132,11 +132,20 @@ def make_train_step(module, tx, mesh=None,
     update (running averages, not exact-batch stats)."""
 
     def step(state: TrainState, images, labels):
+        if mesh is None:
+            return _body(state, images, labels)
+        # trace under the mesh context so block-boundary activation
+        # constraints inside the MODEL (partition.constrain_activation)
+        # resolve against this mesh instead of no-op'ing
+        with mesh:
+            return _body(state, images, labels)
+
+    def _body(state: TrainState, images, labels):
         if mesh is not None:
             bspec = P(batch_axes if len(batch_axes) > 1 else batch_axes[0])
-            images = jax.lax.with_sharding_constraint(
+            images = _compat.with_sharding_constraint(
                 images, NamedSharding(mesh, P(*bspec)))
-            labels = jax.lax.with_sharding_constraint(
+            labels = _compat.with_sharding_constraint(
                 labels, NamedSharding(mesh, P(*bspec)))
 
         loss_of = _make_loss_of(module, loss_fn, fetch)
@@ -159,9 +168,9 @@ def make_train_step(module, tx, mesh=None,
                 # growing memory+comms instead of shrinking them
                 mb_axes = batch_axes if len(batch_axes) > 1 \
                     else (batch_axes[0],)
-                imgs_mb = jax.lax.with_sharding_constraint(
+                imgs_mb = _compat.with_sharding_constraint(
                     imgs_mb, NamedSharding(mesh, P(None, *mb_axes)))
-                lbls_mb = jax.lax.with_sharding_constraint(
+                lbls_mb = _compat.with_sharding_constraint(
                     lbls_mb, NamedSharding(mesh, P(None, *mb_axes)))
 
             def accum(carry, mb):
@@ -190,7 +199,7 @@ def make_train_step(module, tx, mesh=None,
             # shard_train_state's and every subsequent step recompiles
             tp = mesh.shape.get("tp", 1)
             new_params = jax.tree_util.tree_map_with_path(
-                lambda path, leaf: jax.lax.with_sharding_constraint(
+                lambda path, leaf: _compat.with_sharding_constraint(
                     leaf, NamedSharding(
                         mesh, param_spec(path, leaf, tp) if tp > 1
                         else P())),
@@ -198,7 +207,7 @@ def make_train_step(module, tx, mesh=None,
             # optimizer state is placed replicated by shard_train_state —
             # pin it too, or the drift problem just moves into opt_state
             new_opt = jax.tree.map(
-                lambda leaf: jax.lax.with_sharding_constraint(
+                lambda leaf: _compat.with_sharding_constraint(
                     leaf, NamedSharding(mesh, P())),
                 new_opt)
         new_state = TrainState(
@@ -275,6 +284,13 @@ def make_partitioned_train_step(module, tx, mesh, state_shardings, *,
     repl = NamedSharding(mesh, P())
 
     def step(state: TrainState, images, labels):
+        # mesh context for the whole traced body: model-internal
+        # block-boundary constraints (partition.constrain_activation)
+        # resolve against the step's mesh
+        with mesh:
+            return _body(state, images, labels)
+
+    def _body(state: TrainState, images, labels):
         if dtype_policy is not None and jnp.issubdtype(
                 images.dtype, jnp.floating):
             images = dtype_policy.cast_compute(images)
@@ -295,8 +311,8 @@ def make_partitioned_train_step(module, tx, mesh, state_shardings, *,
             # keep each microbatch batch-sharded inside the scan (the
             # same GSPMD gather hazard make_train_step documents)
             mb_sh = NamedSharding(mesh, P(None, *bspec))
-            imgs_mb = jax.lax.with_sharding_constraint(imgs_mb, mb_sh)
-            lbls_mb = jax.lax.with_sharding_constraint(lbls_mb, mb_sh)
+            imgs_mb = _compat.with_sharding_constraint(imgs_mb, mb_sh)
+            lbls_mb = _compat.with_sharding_constraint(lbls_mb, mb_sh)
 
             def accum(carry, mb):
                 g_acc, l_acc, stats = carry

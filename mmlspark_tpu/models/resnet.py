@@ -106,6 +106,7 @@ class ResNet(nn.Module):
         x = nn.max_pool(x, (3, 3), (2, 2), padding=((1, 1), (1, 1)))
         block_cls = nn.remat(self.block, static_argnums=(2,)) \
             if self.remat else self.block
+        from ..parallel.partition import constrain_activation
         idx = 0
         for i, n_blocks in enumerate(self.stage_sizes):
             for j in range(n_blocks):
@@ -115,6 +116,9 @@ class ResNet(nn.Module):
                               name=f"{self.block.__name__}_{idx}")(
                     x, train)
                 idx += 1
+            # stage-boundary activation sharding (batch over dp per the
+            # registered spec) — identity with no mesh in scope
+            x = constrain_activation(x, "ResNet")
             endpoints[f"stage{i + 1}"] = x
         x = jnp.mean(x, axis=(1, 2))
         endpoints["pooled"] = x.astype(jnp.float32)
@@ -140,7 +144,7 @@ class ResNet(nn.Module):
 # match a full TrainState) replicates: per-channel vectors are noise
 # next to one conv kernel, and replicated stats keep the EMA update
 # collective-free.
-from ..parallel.partition import register_partition_rules
+from ..parallel.partition import DtypePolicy, register_partition_rules
 
 register_partition_rules("ResNet", [
     (r"(bn_init|BatchNorm_\d+)/(scale|bias|mean|var)", ()),
@@ -148,7 +152,13 @@ register_partition_rules("ResNet", [
     (r"Conv_\d+/kernel", ("tp",)),
     (r"head/kernel", (None, "tp")),
     (r"head/bias", ()),
-])
+],
+    # bf16 conv compute over fp32 params/BN stats; NHWC activations
+    # batch-shard over dp at stage boundaries
+    dtype_policy=DtypePolicy(param_dtype="float32",
+                             compute_dtype="bfloat16",
+                             grad_accum_dtype="float32"),
+    activation_spec=("dp",))
 
 
 def ResNet18(num_classes=1000, dtype=jnp.bfloat16, remat=False):

@@ -104,9 +104,13 @@ class ViT(nn.Module):
                          (1, T, self.width), jnp.float32)
         x = x + pos.astype(self.dtype)
         block_cls = nn.remat(Block) if self.remat else Block
+        from ..parallel.partition import constrain_activation
         for i in range(self.depth):
-            x = block_cls(self.heads, self.mlp_dim, dtype=self.dtype,
-                          name=f"block{i}")(x)
+            # block-boundary activation sharding (batch over dp per the
+            # registered spec) — identity with no mesh in scope
+            x = constrain_activation(
+                block_cls(self.heads, self.mlp_dim, dtype=self.dtype,
+                          name=f"block{i}")(x), "ViT")
             endpoints[f"block{i + 1}"] = x
         x = nn.LayerNorm(dtype=jnp.float32, name="ln")(x)
         endpoints["pooled"] = x[:, 0].astype(jnp.float32)
@@ -127,7 +131,7 @@ class ViT(nn.Module):
 # cross-shard reduction per block is the one GSPMD inserts after each
 # row-parallel matmul. Specs right-align (parallel/partition.py), so
 # the same rules cover scan-stacked block params.
-from ..parallel.partition import register_partition_rules
+from ..parallel.partition import DtypePolicy, register_partition_rules
 
 register_partition_rules("ViT", [
     (r"(class_token|pos_embedding)", ()),
@@ -145,7 +149,13 @@ register_partition_rules("ViT", [
     (r"mlp_2/bias", ()),
     (r"head/kernel", (None, "tp")),
     (r"head/bias", ()),
-])
+],
+    # bf16 compute / fp32 storage+accum; batch-sharded activations at
+    # block boundaries (the framework-wide chip defaults)
+    dtype_policy=DtypePolicy(param_dtype="float32",
+                             compute_dtype="bfloat16",
+                             grad_accum_dtype="float32"),
+    activation_spec=("dp",))
 
 
 def ViT_B_16(num_classes=1000, dtype=jnp.bfloat16, remat=False):

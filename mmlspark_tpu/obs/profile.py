@@ -273,6 +273,30 @@ def _block_on(obj) -> bool:
     return synced
 
 
+def process_label() -> str | None:
+    """This worker's ``process`` metric label, or None when the label
+    should not be attached. Single-process runs (the overwhelmingly
+    common case, and every existing dashboard/test) get None so their
+    sample names stay exactly as before; only a live multi-process
+    (pod) backend yields ``"0"``/``"1"``/… so per-worker series stay
+    distinguishable when N workers push to one aggregation point.
+    Guarded like :func:`device_platform`: never imports jax, never
+    initializes a backend — ``jax.process_count()`` would bring one up.
+    """
+    mod = sys.modules.get("jax")
+    if mod is None:
+        return None
+    xb = sys.modules.get("jax._src.xla_bridge")
+    if xb is None or not getattr(xb, "_backends", None):
+        return None     # don't cache: distributed init may come later
+    try:
+        if int(mod.process_count()) <= 1:
+            return None
+        return str(int(mod.process_index()))
+    except Exception:
+        return None
+
+
 class StepProfiler:
     """Host-dispatch vs device-execute attribution per pipeline stage.
 
@@ -330,10 +354,15 @@ class StepProfiler:
             handle.dispatch_seconds = dispatch_s
             handle.device_seconds = device_s
             handle.seconds = t2 - t0
+            # on a pod worker the step/mfu families carry a `process`
+            # label; single-process series keep their exact names
+            pl = process_label()
+            plab = {"process": pl} if pl is not None else {}
             self._h_step.observe(dispatch_s, stage=stage,
-                                 phase="dispatch")
-            self._h_step.observe(device_s, stage=stage, phase="device")
-            self._c_steps.inc(1, stage=stage)
+                                 phase="dispatch", **plab)
+            self._h_step.observe(device_s, stage=stage, phase="device",
+                                 **plab)
+            self._c_steps.inc(1, stage=stage, **plab)
             if flops:
                 self.record_mfu(stage, flops, t2 - t0)
             dspan = self._tracer.emit_span(
@@ -353,7 +382,11 @@ class StepProfiler:
         (flops, seconds) pair — bench.py's sweep and the step context
         both land here."""
         mfu = float(flops) / max(float(seconds), 1e-12) / self.peak_flops
-        self._g_mfu.set(mfu, stage=stage)
+        pl = process_label()
+        if pl is not None:
+            self._g_mfu.set(mfu, stage=stage, process=pl)
+        else:
+            self._g_mfu.set(mfu, stage=stage)
         return mfu
 
 

@@ -26,7 +26,8 @@ parallelism — loads lazily on first attribute access (PEP 562).
 
 from .mesh import (MeshSpec, build_mesh, distributed_init, local_mesh,
                    mesh_shape_for)
-from .partition import (DtypePolicy, PartitionRule, dtype_policy_for,
+from .partition import (DtypePolicy, PartitionRule, activation_spec_for,
+                        constrain_activation, dtype_policy_for,
                         gather_params, match_partition_rules,
                         named_leaves, partition_rules_for,
                         register_partition_rules, registered_rule_sets,
@@ -52,6 +53,11 @@ _LAZY = {
     # functions.
     "make_ring_attention": ".ring_attention",
     "blockwise_attention": ".ring_attention",
+    # multihost harness: JAX-free at import like mesh/partition, but
+    # routed lazily anyway — the harness is pod-bootstrap surface, not
+    # something every `import mmlspark_tpu.parallel` needs resident
+    "launch_pod": ".multihost", "pod_mesh": ".multihost",
+    "feed_process_local": ".multihost", "worker_env": ".multihost",
     "make_ulysses_attention": ".ulysses",
     "pipeline_apply": ".pipeline", "pipeline_encode": ".pipeline",
     "pipeline_train_1f1b": ".pipeline",
@@ -71,7 +77,9 @@ __all__ = [
     "DtypePolicy", "PartitionRule", "match_partition_rules",
     "named_leaves", "shard_params", "gather_params", "to_shardings",
     "register_partition_rules", "partition_rules_for",
-    "dtype_policy_for", "registered_rule_sets",
+    "dtype_policy_for", "activation_spec_for", "constrain_activation",
+    "registered_rule_sets",
+    "launch_pod", "pod_mesh", "feed_process_local", "worker_env",
 ]
 
 

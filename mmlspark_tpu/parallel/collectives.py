@@ -62,9 +62,14 @@ def _observed(op: str, x, axis):
         scope = contextlib.nullcontext()
     with scope:
         yield
-    _m_calls.inc(1, op=op, axis=label)
-    _m_bytes.inc(nbytes, op=op, axis=label)
-    _m_par_bytes.inc(nbytes, op=op, axis=label)
+    # pod workers tag the series per-process (obs.profile.process_label
+    # is None single-process, so existing sample names stay unchanged)
+    from ..obs.profile import process_label
+    pl = process_label()
+    plab = {"process": pl} if pl is not None else {}
+    _m_calls.inc(1, op=op, axis=label, **plab)
+    _m_bytes.inc(nbytes, op=op, axis=label, **plab)
+    _m_par_bytes.inc(nbytes, op=op, axis=label, **plab)
 
 
 def allreduce(x, axis: str | tuple[str, ...], op: str = "sum"):

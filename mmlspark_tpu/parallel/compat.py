@@ -15,6 +15,15 @@ surface.
 
 from __future__ import annotations
 
+from ..obs import registry as _obs
+
+# same series to_shardings demotes into: a constraint the mesh cannot
+# honor replicates that dim LOUDLY, wherever the demotion happens
+_m_demoted = _obs.counter(
+    "parallel_spec_demoted_total",
+    "matched specs demoted to fewer axes because a dim does not divide "
+    "the mesh axis, by axis")
+
 
 def shard_map(f, *, mesh, in_specs, out_specs, check_vma=None):
     """``jax.shard_map`` on new JAX, ``jax.experimental.shard_map`` on
@@ -105,6 +114,121 @@ def tpu_compiler_params(**kwargs):
     cls = getattr(pltpu, "CompilerParams", None) \
         or pltpu.TPUCompilerParams
     return cls(**kwargs)
+
+
+def _context_mesh():
+    """The physical mesh an enclosing ``with mesh:`` bound to this
+    thread, or None. The pjit resource env moved modules across JAX
+    generations; every read is guarded so API drift degrades to "no
+    context mesh" (a no-op constraint), never to an ImportError."""
+    try:
+        from jax.interpreters import pxla
+        m = pxla.thread_resources.env.physical_mesh
+        if m is not None and not getattr(m, "empty", True):
+            return m
+    except Exception:
+        pass
+    return None
+
+
+def with_sharding_constraint(x, spec, mesh=None):
+    """One wrapper for the sharding-constraint API split (current JAX:
+    ``jax.lax.with_sharding_constraint``; the previous generation:
+    ``jax.experimental.pjit.with_sharding_constraint``) — the same
+    single-call-site contract :func:`shard_map` gives the other split.
+    graftcheck's collective-audit flags raw constraint call sites
+    outside ``parallel/``, so this is THE way model and train-step code
+    annotates activations.
+
+    ``spec``: a ``NamedSharding`` (applied as-is), or a
+    ``PartitionSpec`` / tuple of axis entries resolved against
+    ``mesh``, falling back to the thread's context mesh (an enclosing
+    ``with mesh:`` — the partitioned train steps enter it around their
+    body so model-internal block-boundary constraints resolve). With no
+    mesh anywhere the constraint is meaningless and ``x`` returns
+    unchanged — model code runs un-annotated on a single device without
+    carrying mesh plumbing.
+
+    Entries the mesh cannot honor (axis absent, or the dim not
+    divisible by the axis size) demote to ``None`` per-dim, counted in
+    ``parallel_spec_demoted_total{axis=...}`` — the ``to_shardings``
+    contract applied to activations, so a batch of 2 under a dp=8 mesh
+    replicates loudly instead of failing the compile."""
+    import jax
+    from jax.sharding import NamedSharding, PartitionSpec as P
+    fn = getattr(jax.lax, "with_sharding_constraint", None)
+    if fn is None:  # previous API generation
+        from jax.experimental.pjit import with_sharding_constraint as fn
+    if isinstance(spec, NamedSharding):
+        return fn(x, spec)
+    if mesh is None:
+        mesh = _context_mesh()
+        if mesh is None:
+            return x
+    entries = list(tuple(spec))
+    shape = getattr(x, "shape", ())
+    if len(entries) > len(shape):
+        raise ValueError(
+            f"constraint spec {tuple(spec)} has more entries than the "
+            f"value has dims (shape {tuple(shape)})")
+    for i, entry in enumerate(entries):
+        if entry is None:
+            continue
+        axes = (entry,) if isinstance(entry, str) else tuple(entry)
+        size = 1
+        for a in axes:
+            size *= mesh.shape.get(a, 0)
+        if size == 0 or shape[i] % size:
+            _m_demoted.inc(1, axis=",".join(axes))
+            entries[i] = None
+    return fn(x, NamedSharding(mesh, P(*entries)))
+
+
+def make_array_from_process_local_data(sharding, local_data):
+    """Per-host feeding across the API generations: each process hands
+    its LOCAL rows and gets back one global array sharded per
+    ``sharding`` (current JAX: ``jax.make_array_from_process_local_
+    data``; older: ``multihost_utils.host_local_array_to_global_
+    array``). On a single-process mesh this degrades to a plain
+    ``device_put`` of the (already-global) data."""
+    import jax
+    fn = getattr(jax, "make_array_from_process_local_data", None)
+    if fn is not None:
+        return fn(sharding, local_data)
+    if jax.process_count() == 1:  # pragma: no cover - old-API fallback
+        return jax.device_put(local_data, sharding)
+    from jax.experimental import multihost_utils  # pragma: no cover
+    return multihost_utils.host_local_array_to_global_array(
+        local_data, sharding.mesh, sharding.spec)
+
+
+def process_allgather(x, *, tiled: bool = False):
+    """Global array → full host numpy value on EVERY process — the
+    read-side twin of :func:`make_array_from_process_local_data`, and
+    the loud-error escape hatch ``gather_params`` points at when a leaf
+    spans processes. Single-process arrays take the plain
+    ``device_get`` path (no collective, no coordination service)."""
+    import jax
+    import numpy as np
+    if getattr(x, "is_fully_addressable", True):
+        return np.asarray(jax.device_get(x))
+    from jax.experimental import multihost_utils
+    return np.asarray(multihost_utils.process_allgather(x, tiled=tiled))
+
+
+def enable_cpu_multiprocess_collectives() -> bool:
+    """Switch the CPU backend's collectives to the gloo implementation
+    — REQUIRED before ``jax.distributed.initialize`` on a multi-process
+    CPU (DCN-style) run: without it initialization succeeds but the
+    first cross-process execution fails with "Multiprocess computations
+    aren't implemented on the CPU backend". Returns whether the config
+    took (False on JAX builds without the knob, e.g. TPU-only)."""
+    import jax
+    try:
+        jax.config.update("jax_cpu_collectives_implementation", "gloo")
+        return True
+    except Exception:
+        return False
 
 
 def axis_size(axis) -> int:

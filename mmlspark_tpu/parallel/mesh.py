@@ -86,7 +86,7 @@ def mesh_shape_for(n_devices: int, **axes: int) -> MeshSpec:
 
 def distributed_init(coordinator_address: str | None = None,
                      num_processes: int | None = None,
-                     process_id: int | None = None) -> None:
+                     process_id: int | None = None) -> bool:
     """Multi-host bootstrap: JAX coordination service.
 
     Stands in for the reference's driver rendezvous
@@ -95,17 +95,31 @@ def distributed_init(coordinator_address: str | None = None,
     reporting ``host:port`` over a raw socket and receiving the peer list,
     every process dials the coordinator and PJRT wires the ICI/DCN mesh.
 
-    No-ops on single-process (local/test) runs so library code can call it
-    unconditionally.
+    Arguments default from ``MMLSPARK_TPU_COORDINATOR`` /
+    ``MMLSPARK_TPU_NUM_PROCESSES`` / ``MMLSPARK_TPU_PROCESS_ID`` (what
+    ``parallel.multihost`` exports into its workers); explicit arguments
+    win, and ``process_id=0`` is a real value, not a fall-through to the
+    env (the coordinator itself is process 0).
+
+    No-ops (returns False) on single-process (local/test) runs so
+    library code can call it unconditionally; returns True once the
+    coordination service is up.
     """
     import jax
 
     addr = coordinator_address or os.environ.get("MMLSPARK_TPU_COORDINATOR")
     if addr is None:
-        return
+        return False
+    # CPU (DCN-style) pods need the gloo collectives backend BEFORE
+    # initialize — without it init succeeds and the first cross-process
+    # execution fails (see compat.enable_cpu_multiprocess_collectives)
+    from .compat import enable_cpu_multiprocess_collectives
+    if (os.environ.get("JAX_PLATFORMS") or "").startswith("cpu"):
+        enable_cpu_multiprocess_collectives()
     jax.distributed.initialize(
         coordinator_address=addr,
-        num_processes=num_processes
-        or int(os.environ.get("MMLSPARK_TPU_NUM_PROCESSES", "1")),
-        process_id=process_id
-        or int(os.environ.get("MMLSPARK_TPU_PROCESS_ID", "0")))
+        num_processes=num_processes if num_processes is not None
+        else int(os.environ.get("MMLSPARK_TPU_NUM_PROCESSES", "1")),
+        process_id=process_id if process_id is not None
+        else int(os.environ.get("MMLSPARK_TPU_PROCESS_ID", "0")))
+    return True

@@ -254,36 +254,84 @@ def shard_params(mesh, params, specs=None, *, rules=None,
     if dtype_policy is not None:
         params = dtype_policy.cast_params(params)
     shardings = to_shardings(mesh, params, specs)
-    # ONE batched transfer for the whole pytree: device_put accepts
-    # congruent value/sharding trees, and a TrainState has hundreds of
-    # leaves (optax moments triple the param count) — per-leaf calls
-    # would serialize that many host->device transfers
-    placed = jax.device_put(params, shardings)
+    import numpy as np
+    if len({getattr(d, "process_index", 0)
+            for d in np.asarray(mesh.devices).flat}) > 1:
+        # multi-process mesh: device_put cannot place a host value onto
+        # devices other processes own. Every process holds the same
+        # full host value (seeded init — the multihost contract) and
+        # make_array_from_callback materializes only the addressable
+        # shards from it, per leaf.
+        def place(v, s):
+            host = np.asarray(v)
+            return jax.make_array_from_callback(
+                host.shape, s, lambda idx, host=host: host[idx])
+        placed = jax.tree.map(place, params, shardings)
+    else:
+        # ONE batched transfer for the whole pytree: device_put accepts
+        # congruent value/sharding trees, and a TrainState has hundreds
+        # of leaves (optax moments triple the param count) — per-leaf
+        # calls would serialize that many host->device transfers
+        placed = jax.device_put(params, shardings)
     return placed, shardings
 
 
 def gather_params(params):
     """Sharded pytree → fully-gathered HOST numpy pytree (checkpoint
     publication, the zoo's consumption format). The inverse of
-    :func:`shard_params` up to dtype policy."""
+    :func:`shard_params` up to dtype policy.
+
+    Single-process only: a leaf whose shards span processes raises
+    loudly here — ``device_get`` of a non-addressable array would
+    otherwise hang or crash deep inside the runtime. Cross-host
+    gathering is a collective; use ``compat.process_allgather`` (every
+    process gets the full value) instead."""
     import jax
     import numpy as np
-    return jax.tree.map(lambda l: np.asarray(jax.device_get(l)), params)
+
+    def one(leaf):
+        if not getattr(leaf, "is_fully_addressable", True):
+            raise RuntimeError(
+                "gather_params on a multi-process array: this leaf's "
+                "shards live on devices other processes own, so a "
+                "host gather here is a cross-host collective, not a "
+                "device_get. Use parallel.compat.process_allgather "
+                "(all processes must call it) or keep the state "
+                "sharded.")
+        return np.asarray(jax.device_get(leaf))
+    return jax.tree.map(one, params)
 
 
 # ------------------------------------------------- per-model rule sets
 
+# name -> (rules, dtype policy, activation spec). The activation spec
+# is LEFT-aligned (PartitionSpec semantics: entry i constrains dim i —
+# activations are batch-leading, so ("dp",) means "shard the batch
+# dim") unlike the right-aligned WEIGHT rules above (weights are
+# feature-trailing).
 _RULE_SETS: dict[str, tuple[tuple[PartitionRule, ...],
-                            DtypePolicy | None]] = {}
+                            DtypePolicy | None,
+                            tuple | None]] = {}
 
 
 def register_partition_rules(name: str, rules: Sequence[PartitionRule],
-                             dtype_policy: DtypePolicy | None = None
+                             dtype_policy: DtypePolicy | None = None,
+                             activation_spec: Sequence | None = None
                              ) -> None:
     """Register a model family's rule set (called next to the model
     definition, at import time — no JAX needed). Re-registration
-    overwrites: the model file is the single source of truth."""
-    _RULE_SETS[name] = (tuple(rules), dtype_policy)
+    overwrites: the model file is the single source of truth.
+
+    ``dtype_policy``: the family's chip-tuned default (bf16 compute,
+    fp32 params/accum) — what ``partition_train_state`` /
+    ``make_partitioned_train_step`` callers pick up via
+    :func:`dtype_policy_for`. ``activation_spec``: the LEFT-aligned
+    PartitionSpec entries :func:`constrain_activation` applies at the
+    model's block boundaries (``("dp",)`` = batch-shard activations /
+    remat buffers; plain data until a mesh is in scope)."""
+    _RULE_SETS[name] = (tuple(rules), dtype_policy,
+                        tuple(activation_spec)
+                        if activation_spec is not None else None)
 
 
 def partition_rules_for(name: str) -> tuple[PartitionRule, ...]:
@@ -300,6 +348,29 @@ def dtype_policy_for(name: str) -> DtypePolicy | None:
             f"no partition rules registered for {name!r}; known: "
             f"{sorted(_RULE_SETS)}")
     return _RULE_SETS[name][1]
+
+
+def activation_spec_for(name: str) -> tuple | None:
+    if name not in _RULE_SETS:
+        raise KeyError(
+            f"no partition rules registered for {name!r}; known: "
+            f"{sorted(_RULE_SETS)}")
+    return _RULE_SETS[name][2]
+
+
+def constrain_activation(x, model: str):
+    """Apply ``model``'s registered activation spec to a block-boundary
+    value via ``compat.with_sharding_constraint``. No-op when the model
+    registers no spec, or when no mesh is in scope (single-device runs
+    and un-partitioned tests see the exact unconstrained computation) —
+    so model ``__call__`` bodies call this unconditionally without mesh
+    plumbing. The partitioned train steps enter ``with mesh:`` around
+    their body, which is what puts a mesh in scope here."""
+    ent = _RULE_SETS.get(model)
+    if ent is None or ent[2] is None:
+        return x
+    from .compat import with_sharding_constraint
+    return with_sharding_constraint(x, ent[2])
 
 
 def registered_rule_sets() -> list[str]:

@@ -172,6 +172,68 @@ def run(n_devices: int = 8) -> dict:
     out["sharded_gbdt_hist_rows_per_sec_1dev"] = round(rps_1, 1)
     out["sharded_gbdt_scaling_efficiency"] = round(
         rps_n / (n_devices * rps_1), 4) if rps_1 else 0.0
+    out.update(crosshost(local_devices=n_devices // 2))
+    return out
+
+
+def crosshost(local_devices: int = 4, timeout: float = 420.0) -> dict:
+    """The DCN section: the SAME (2, local) dcn×ici mesh program run as
+    a 2-process pod (4 devices per worker, gloo collectives over
+    loopback) and as 1 process owning all 8 devices. Identical global
+    mesh, identical program, identical data — the throughput ratio
+    isolates the process boundary (serialization, gloo hops, per-rank
+    dispatch), which is the crosshost scaling-efficiency number the
+    pod roadmap items track. Plus: cross-host fused-serving p99 with
+    the bit-equality digest checked against the single-process run,
+    the instrumented dp-axis allreduce's per-shard byte count, and
+    the warmed pod worker's runtime-compile count (must be 0)."""
+    from ..parallel.multihost import launch_pod
+
+    scen = "mmlspark_tpu.testing.multihost_scenarios"
+    mesh = [2, local_devices]
+    total = 2 * local_devices
+    # Per-step compute must dominate the per-step process-boundary cost
+    # (gloo hops + per-rank dispatch are ~fixed per step) or the ratio
+    # measures dispatch overhead, not the data plane: at batch 64 /
+    # width 128 the ratio reads ~0.44, at this size ~0.9.
+    train_args = {"mesh": mesh, "steps": 2, "batch": 128, "seq_len": 64,
+                  "width": 192, "bench_iters": 4, "seed": 0}
+    pod = launch_pod(f"{scen}:train_trajectory", num_processes=2,
+                     local_devices=local_devices, args=train_args,
+                     timeout=timeout)
+    solo = launch_pod(f"{scen}:train_trajectory", num_processes=1,
+                      local_devices=total, args=train_args,
+                      timeout=timeout)
+    out: dict = {
+        "crosshost_processes": 2,
+        "crosshost_mesh": mesh,
+        "crosshost_train_images_per_sec": round(pod[0]["ips"], 1),
+        "crosshost_train_images_per_sec_1proc": round(
+            solo[0]["ips"], 1),
+        "crosshost_scaling_efficiency": round(
+            pod[0]["ips"] / solo[0]["ips"], 4) if solo[0]["ips"] else 0.0,
+        "crosshost_loss_max_abs_diff": max(
+            abs(a - b) for a, b in zip(pod[0]["losses"],
+                                       solo[0]["losses"])),
+        "crosshost_runtime_compiles": sum(
+            r["runtime_compiles"] for r in pod),
+    }
+    serve_args = {"mesh": mesh, "rows": 64, "feats": 16, "requests": 24,
+                  "seed": 0}
+    spod = launch_pod(f"{scen}:fused_serving", num_processes=2,
+                      local_devices=local_devices, args=serve_args,
+                      timeout=timeout)
+    ssolo = launch_pod(f"{scen}:fused_serving", num_processes=1,
+                       local_devices=total, args=serve_args,
+                       timeout=timeout)
+    out["crosshost_serving_p99_ms"] = max(r["p99_ms"] for r in spod)
+    out["crosshost_serving_bit_equal"] = bool(
+        all(r["bit_equal"] for r in spod + ssolo)
+        and len({r["digest"] for r in spod + ssolo}) == 1)
+    cb = launch_pod(f"{scen}:collective_bytes", num_processes=2,
+                    local_devices=local_devices,
+                    args={"mesh": mesh, "rows": 1024}, timeout=timeout)
+    out["crosshost_collective_bytes"] = sum(r["bytes"] for r in cb)
     return out
 
 

@@ -1,0 +1,292 @@
+"""Pod worker bodies for the multi-host harness.
+
+Each public function here is a ``launch_pod`` target
+(``mmlspark_tpu.testing.multihost_scenarios:<name>``): it runs on EVERY
+rank of the pod after ``distributed_init``, takes one JSON payload
+dict, and returns a JSON-serializable result dict the launcher collects
+rank-ordered. The 2-process CPU harness test
+(``tests/test_multihost.py``) and the multichip bench's crosshost
+section (``testing/multichip_bench.py``) share these bodies, so the CI
+assertion and the banked bench number are the same program.
+
+The scenarios all build the SAME mesh shape regardless of process
+count (``payload["mesh"]``, default ``[2, 4]``): 2 processes × 4 local
+devices and 1 process × 8 local devices both yield a (dp=2, tp=4)
+mesh running an identical program — the only variable left is the
+process boundary, which is exactly what the crosshost efficiency and
+trajectory-equality acceptances isolate.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import time
+
+import numpy as np
+
+from ..parallel.multihost import (DCN_AXIS, ICI_AXIS, feed_process_local,
+                                  this_process)
+
+
+def _mesh(shape):
+    """An explicit-shape dcn×ici mesh: devices sorted process-major (so
+    the outer/dp axis walks processes) and reshaped to ``shape`` — the
+    same layout :func:`~..parallel.multihost.pod_mesh` derives, but
+    with the shape pinned so a 1-process run can reproduce a pod's
+    mesh exactly."""
+    import jax
+    from jax.sharding import Mesh
+    d0, d1 = int(shape[0]), int(shape[1])
+    devs = sorted(jax.devices(),
+                  key=lambda d: (getattr(d, "process_index", 0), d.id))
+    if len(devs) != d0 * d1:
+        raise RuntimeError(
+            f"mesh shape {shape} needs {d0 * d1} devices, have "
+            f"{len(devs)}")
+    return Mesh(np.asarray(devs).reshape(d0, d1), (DCN_AXIS, ICI_AXIS))
+
+
+def _my_rows(arr):
+    """This process's contiguous block of a batch-leading host array —
+    the rows ``feed_process_local`` expects each rank to contribute.
+    Process-major device sort means dp block ``i`` belongs to process
+    ``i``; a single process owns everything."""
+    idx, cnt = this_process()
+    if cnt == 1:
+        return arr
+    if arr.shape[0] % cnt:
+        raise ValueError(
+            f"batch {arr.shape[0]} must divide by process count {cnt}")
+    per = arr.shape[0] // cnt
+    return arr[idx * per:(idx + 1) * per]
+
+
+def _ref_pipeline(a):
+    """Single-jit reference for the fused serving pipeline: the same
+    math as its two jit-safe UDF stages, used for the bit-equality
+    check. Module-level (not a lambda inside the scenario) so the
+    traced region graftcheck sees is exactly this body."""
+    import jax.numpy as jnp
+    return jnp.tanh(a * 2.0 + 1.0)
+
+
+def _dp_allreduce(a):
+    """The shard_map body for the crosshost byte count: one observed
+    allreduce over the dp (DCN) axis."""
+    from ..parallel import collectives
+    return collectives.allreduce(a, DCN_AXIS)
+
+
+# --------------------------------------------------------------- scenarios
+
+def check_init(payload: dict) -> dict:
+    """The ``distributed_init`` acceptance body: global mesh shape,
+    process-local shard placement, and (via the harness rc) clean
+    shutdown."""
+    import jax
+    idx, cnt = this_process()
+    from ..parallel.multihost import pod_mesh
+    mesh = pod_mesh()
+    local = len(jax.local_devices())
+    rows_per = 2
+    stamped = np.full((rows_per, 3), idx, np.float32)
+    garr = feed_process_local(mesh, stamped if cnt > 1
+                              else np.full((rows_per * cnt, 3), 0.0,
+                                           np.float32))
+    shard_local = all(
+        getattr(sh.device, "process_index", 0) == idx
+        and float(np.asarray(sh.data).ravel()[0]) == float(idx)
+        for sh in garr.addressable_shards) if cnt > 1 else True
+    return {
+        "process_index": idx,
+        "process_count": cnt,
+        "device_count": len(jax.devices()),
+        "local_device_count": local,
+        "mesh_axes": list(mesh.axis_names),
+        "mesh_shape": [int(mesh.shape[DCN_AXIS]),
+                       int(mesh.shape[ICI_AXIS])],
+        "global_rows": int(garr.shape[0]),
+        "fully_addressable": bool(garr.is_fully_addressable),
+        "shard_local": bool(shard_local),
+    }
+
+
+def train_trajectory(payload: dict) -> dict:
+    """The partitioned train step on the pod: rule-sharded BertEncoder
+    TrainState, per-host batch feeding, seeded loss trajectory (the
+    1-proc vs 2-proc atol-1e-5 acceptance), steady-state runtime-compile
+    count, and (``bench_iters > 0``) images/sec for the crosshost
+    scaling-efficiency ratio."""
+    import jax
+    import jax.numpy as jnp
+    import optax
+
+    from ..dl.bert import BertEncoder
+    from ..dl.train import (init_train_state, make_partitioned_train_step,
+                            partition_train_state)
+    from ..obs.profile import compile_tracker
+    from ..parallel import compat
+    from ..parallel.partition import partition_rules_for
+
+    shape = payload.get("mesh") or [2, 4]
+    steps = int(payload.get("steps", 3))
+    B = int(payload.get("batch", 16))
+    T = int(payload.get("seq_len", 16))
+    seed = int(payload.get("seed", 0))
+    bench_iters = int(payload.get("bench_iters", 0))
+    width = int(payload.get("width", 64))
+
+    mesh = _mesh(shape)
+    # f32 end to end: the trajectory acceptance compares float losses
+    # across runs at atol 1e-5, which bf16 compute would not hold
+    module = BertEncoder(vocab=512, width=width, depth=2, heads=4,
+                         mlp_dim=2 * width, max_len=T, pooler=False,
+                         dtype=jnp.float32)
+    tx = optax.adamw(1e-3)
+    rng = np.random.default_rng(seed)
+    batches = [(rng.integers(1, 512, size=(B, T)).astype(np.int32),
+                rng.integers(0, 64, size=B).astype(np.int32))
+               for _ in range(steps)]
+    # every rank initializes the SAME full host params (same key) —
+    # the shard_params multi-process contract
+    state = init_train_state(module, jax.random.PRNGKey(seed),
+                             jnp.asarray(batches[0][0][:1]), tx)
+    state, shardings = partition_train_state(
+        state, mesh, partition_rules_for("BertEncoder"))
+    step = make_partitioned_train_step(module, tx, mesh, shardings,
+                                       fetch="pooled")
+
+    def feed(ids, labels):
+        return (feed_process_local(mesh, _my_rows(ids)),
+                feed_process_local(mesh, _my_rows(labels)))
+
+    losses = []
+    for i, (ids, labels) in enumerate(batches):
+        gi, gl = feed(ids, labels)
+        state, loss = step(state, gi, gl)
+        losses.append(float(np.asarray(
+            compat.process_allgather(loss)).ravel()[0]))
+        if i == 0:
+            # warmup over: the zero-runtime-compiles pod acceptance —
+            # every later step must hit the compile cache
+            compile_tracker.mark_steady()
+    out = {"losses": losses, "process_count": this_process()[1],
+           "mesh_shape": [int(s) for s in shape]}
+    if bench_iters:
+        gi, gl = feed(*batches[-1])
+
+        def run(n):
+            s, loss = state, None
+            for _ in range(n):
+                s, loss = step(s, gi, gl)
+            jax.block_until_ready(loss)
+            return s
+
+        state = run(1)
+        t0 = time.perf_counter()
+        state = run(bench_iters)
+        out["ips"] = B * bench_iters / (time.perf_counter() - t0)
+    out["runtime_compiles"] = int(compile_tracker.runtime_compiles())
+    compile_tracker.unmark_steady()
+    return out
+
+
+def fused_serving(payload: dict) -> dict:
+    """The dp-sharded fused serving segment answering requests whose
+    rows live on different hosts: compile a jit-safe elementwise
+    pipeline against the pod mesh, feed each request per-host, execute
+    via ``FusedSegment.run_sharded``, gather with ``process_allgather``.
+    Reduction-free elementwise stages make the output bit-stable, so
+    the rank-0 sha256 digest is the cross-run bit-equality witness
+    (pod run vs single-host run of the same seed must match exactly)."""
+    import jax
+    import jax.numpy as jnp
+
+    from ..core import DataFrame, compile_pipeline
+    from ..core.compile import FusedSegment
+    from ..parallel import compat
+    from ..stages.basic import UDFTransformer
+
+    shape = payload.get("mesh") or [2, 4]
+    rows = int(payload.get("rows", 32))
+    feats = int(payload.get("feats", 8))
+    reqs = int(payload.get("requests", 8))
+    seed = int(payload.get("seed", 0))
+
+    mesh = _mesh(shape)
+    stages = [
+        UDFTransformer(inputCol="x", outputCol="scaled",
+                       udf=lambda a: a * 2.0 + 1.0, jitSafe=True),
+        UDFTransformer(inputCol="scaled", outputCol="score",
+                       udf=lambda a: jnp.tanh(a), jitSafe=True),
+    ]
+    rng = np.random.default_rng(seed)
+    example = DataFrame(
+        {"x": rng.standard_normal((rows, feats)).astype(np.float32)})
+    # weight-style rules RIGHT-align (partition.to_shardings), so the
+    # row dim of a [rows, feats] column needs the explicit 2-entry form
+    cp = compile_pipeline(stages, example, mesh=mesh,
+                          rules=[(r".*", ("dp", None))],
+                          service="podserve")
+    seg = cp.plan[0]
+    if not isinstance(seg, FusedSegment):
+        raise RuntimeError(f"pipeline did not fuse: {cp.describe()}")
+
+    def serve(xr):
+        gx = feed_process_local(mesh, _my_rows(xr))
+        out = seg.run_sharded({"x": gx})
+        return compat.process_allgather(out["score"], tiled=True)
+
+    warm_x = rng.standard_normal((rows, feats)).astype(np.float32)
+    score = serve(warm_x)  # compile + the bit-equality witness
+    ref = np.asarray(jax.jit(_ref_pipeline)(warm_x))
+    bit_equal = bool(np.array_equal(np.asarray(score), ref))
+    digest = hashlib.sha256(
+        np.ascontiguousarray(np.asarray(score)).tobytes()).hexdigest()
+    lat = []
+    for _ in range(reqs):
+        xr = rng.standard_normal((rows, feats)).astype(np.float32)
+        t0 = time.perf_counter()
+        serve(xr)
+        lat.append(time.perf_counter() - t0)
+    lat.sort()
+    p99 = lat[min(len(lat) - 1, int(round(0.99 * (len(lat) - 1))))]
+    return {"bit_equal": bit_equal, "digest": digest,
+            "p99_ms": round(p99 * 1e3, 3), "requests": reqs,
+            "process_count": this_process()[1]}
+
+
+def collective_bytes(payload: dict) -> dict:
+    """An explicit cross-host allreduce through the instrumented
+    ``parallel.collectives`` wrapper: the GSPMD-inserted collectives of
+    the train step bypass the obs byte series (they exist only inside
+    the compiled program), so the crosshost byte number comes from a
+    shard_map'd ``allreduce`` over the dp (DCN) axis — and lands in
+    ``collective_bytes_total{...,process=<rank>}``, the new per-process
+    label family."""
+    import jax
+    from jax.sharding import PartitionSpec as P
+
+    from ..obs import registry as _reg
+    from ..parallel import compat
+
+    shape = payload.get("mesh") or [2, 4]
+    rows = int(payload.get("rows", 512))
+    mesh = _mesh(shape)
+    x = np.arange(rows * 4, dtype=np.float32).reshape(rows, 4)
+    gx = feed_process_local(mesh, _my_rows(x))
+    fn = compat.jit(
+        compat.shard_map(_dp_allreduce, mesh=mesh, in_specs=P(DCN_AXIS),
+                         out_specs=P(DCN_AXIS)),
+        name="crosshost_allreduce")
+    out = fn(gx)
+    jax.block_until_ready(out)
+    idx, cnt = this_process()
+    plab = {"process": str(idx)} if cnt > 1 else {}
+    nbytes = _reg.counter(
+        "collective_bytes_total",
+        "per-shard payload bytes at collective issue, by op/axis").value(
+        op="allreduce_sum", axis=DCN_AXIS, **plab)
+    total = np.asarray(compat.process_allgather(out, tiled=True))
+    return {"bytes": float(nbytes), "process": idx,
+            "labelled": bool(plab), "checksum": float(total.sum())}
