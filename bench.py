@@ -1387,6 +1387,64 @@ def bench_costmodel(extras: dict) -> None:
                 default_ms / max(rec["winner"]["ms"], 1e-9), 3)
 
 
+def bench_fleet(extras: dict) -> None:
+    """Fleet telemetry plane acceptance (ISSUE 15). Banks: (1) the
+    cost of one federated ``/metrics?scope=fleet`` exposition (8 ranks
+    x 200 samples merged with identity relabeling) against the
+    per-process alternative (8 separate ``/metrics`` renders) — the
+    overhead a pod operator pays for the single-scrape view; (2) the
+    chaos trajectory: waves from an injected ``worker.slow`` to the
+    ``fleet_straggler`` flip (detection latency), the straggler-sourced
+    autoscaler replace, the healthz ok→degraded→ok walk, and the gold
+    burn-rate staying under the page threshold."""
+    from mmlspark_tpu.obs.fleet import FleetAggregator
+    from mmlspark_tpu.obs.metrics import MetricsRegistry
+    from mmlspark_tpu.testing.benchmarks import fleet_chaos_scenario
+
+    n_ranks, n_samples, reps = 8, 200, 50
+    src = MetricsRegistry()
+    g = src.gauge("profile_step_seconds_sum", "per-stage wall seconds")
+    c = src.gauge("serving_requests_total", "requests by route")
+    for j in range(n_samples // 2):
+        g.set(j * 0.01, stage=f"s{j}")
+        c.set(float(j), route=f"/r{j}")
+    snap = src.snapshot()
+    agg = FleetAggregator(MetricsRegistry(), max_sources=n_ranks)
+    for rank in range(n_ranks):
+        agg.ingest_snapshot(dict(snap), process=str(rank),
+                            channel="bench")
+    t0 = time.perf_counter()
+    for _ in range(reps):
+        fleet_text = agg.exposition()
+    fleet_ms = (time.perf_counter() - t0) / reps * 1e3
+    t0 = time.perf_counter()
+    for _ in range(reps):
+        for _rank in range(n_ranks):
+            src.exposition()
+    per_proc_ms = (time.perf_counter() - t0) / reps * 1e3
+    extras["fleet_scrape_ms"] = round(fleet_ms, 3)
+    extras["fleet_per_process_scrape_ms"] = round(per_proc_ms, 3)
+    extras["fleet_scrape_overhead_x"] = round(
+        fleet_ms / max(per_proc_ms, 1e-9), 3)
+    extras["fleet_scrape_samples"] = sum(
+        1 for ln in fleet_text.splitlines()
+        if ln and not ln.startswith("#"))
+
+    r = fleet_chaos_scenario(seed=31)
+    extras["fleet_ticks_to_flag"] = int(r["ticks_to_flag"] or -1)
+    extras["fleet_flagged"] = bool(r["flagged"])
+    extras["fleet_straggler_replaces"] = int(r["straggler_replaces"])
+    extras["fleet_healthz_trajectory"] = "->".join(r["verdicts"])
+    extras["fleet_healthz_flipped"] = bool(r["healthz_flipped"])
+    extras["fleet_recovered"] = bool(r["recovered"])
+    extras["fleet_recover_waves"] = int(r["recover_waves"])
+    extras["fleet_gold_burn"] = round(r["gold_burn"], 3)
+    extras["fleet_gold_under_page"] = bool(r["gold_under_page"])
+    extras["fleet_be_burn"] = round(r["be_burn"], 3)
+    extras["fleet_hbm_devices"] = int(r["hbm_devices"])
+    extras["fleet_mem_gauges_present"] = bool(r["mem_gauges_present"])
+
+
 def bench_serving(extras: dict) -> None:
     """End-to-end HTTP request→jitted pipeline→response latency against
     the reference's ~1 ms continuous-mode figure."""
@@ -1997,6 +2055,10 @@ def main():
             # learned cost model vs EWMA, predictive-autoscale lead/lag,
             # and the kernel autotuner (host-side except the tune run)
             _watchdog(bench_costmodel, extras, "costmodel", 240.0)
+        if want("fleet"):
+            # fleet federation + chaos health trajectory (in-thread
+            # mesh + synthetic snapshots: tunnel-immune)
+            _watchdog(bench_fleet, extras, "fleet", 240.0)
         if want("serving"):
             # includes a small GBDT fit for the real-model row
             _watchdog(bench_serving, extras, "serving", 360.0)

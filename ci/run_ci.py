@@ -44,6 +44,9 @@ PACKAGES: dict[str, list[str]] = {
            "test_reference_parity.py", "test_out_of_core.py",
            "test_ci.py", "test_bench_banking.py", "test_rcheck.py"],
     "obs": ["test_obs.py", "test_obs_profile.py"],
+    # fleet telemetry plane: federation + straggler/burn health + the
+    # chaos trajectory, and the HBM memory profiler's degradation story
+    "fleet": ["test_fleet.py", "test_obs_memory.py"],
     "analysis": ["test_analysis.py"],  # graftcheck passes + gate + clock
     "sched": ["test_sched.py"],  # admission/batching policy + scheduler
     "tenancy": ["test_tenancy.py"],  # quotas, SLO tiers, fair dispatch
@@ -102,6 +105,43 @@ def style() -> int:
         "feature_log.record(service='ci', route='/', batch=1); "
         "assert 'jax' not in sys.modules, 'obs data plane pulled jax'; "
         "print('obs import OK (no jax)')")
+    rc = _run([sys.executable, "-c", smoke],
+              env=dict(os.environ, JAX_PLATFORMS="cpu"))
+    if rc:
+        return rc
+    # the fleet telemetry plane is control-plane code scraped from
+    # handler threads: it must import, merge two ranks' snapshots into
+    # one collision-free exposition, and answer a health tick with no
+    # JAX at all — and the HBM memory gauges must be ABSENT (not zero,
+    # not raising) in a backend-free process
+    smoke = (
+        "import sys\n"
+        "from mmlspark_tpu.obs.fleet import (FleetAggregator, "
+        "FleetHealth, fleet_aggregator)\n"
+        "from mmlspark_tpu.obs.memory import (device_memory_stats, "
+        "memory_profiler)\n"
+        "from mmlspark_tpu.obs.metrics import MetricsRegistry\n"
+        "assert 'jax' not in sys.modules, 'obs.fleet pulled in jax'\n"
+        "agg = FleetAggregator(MetricsRegistry())\n"
+        "agg.ingest_snapshot({'profile_step_seconds_sum"
+        "{stage=\"x\"}': 1.0}, process='0')\n"
+        "agg.ingest_snapshot({'profile_step_seconds_sum"
+        "{stage=\"x\"}': 2.0}, process='1')\n"
+        "text = agg.exposition()\n"
+        "assert 'process=\"0\"' in text and 'process=\"1\"' in text\n"
+        "merged = agg.merged_samples()\n"
+        "assert len(merged) == 2, merged  # zero collisions\n"
+        "h = FleetHealth(agg, registry=MetricsRegistry())\n"
+        "assert h.tick() == 'ok'\n"
+        "status, body = h.healthz_payload()\n"
+        "assert status == 200 and b'\"ok\"' in body\n"
+        "assert device_memory_stats() == []\n"
+        "assert memory_profiler.update() == []\n"
+        "from mmlspark_tpu.obs import registry\n"
+        "assert not any(k.startswith('mem_hbm_') "
+        "for k in registry.snapshot())\n"
+        "assert 'jax' not in sys.modules, 'fleet health tick pulled jax'\n"
+        "print('obs.fleet federation OK (no jax)')")
     rc = _run([sys.executable, "-c", smoke],
               env=dict(os.environ, JAX_PLATFORMS="cpu"))
     if rc:

@@ -357,7 +357,14 @@ class CompiledPipeline:
         from . import aot
         if store is not None:
             aot.install(store)
-        return aot.maybe_warm(self, service=self.service)
+        loaded = aot.maybe_warm(self, service=self.service)
+        if loaded:
+            # HBM watermark after the warm boot (obs.memory): what
+            # preloading the executable store cost in device memory,
+            # scrapeable as mem_event_watermark_bytes{event="aot_warm"}
+            from ..obs.memory import memory_profiler
+            memory_profiler.note_event("aot_warm")
+        return loaded
 
     # -- execution ---------------------------------------------------------
     def transform(self, df: DataFrame) -> DataFrame:

@@ -26,6 +26,11 @@ from .export import (FlightRecorder, SpanCollector, chrome_trace,
 from .profile import (FEATURE_SCHEMA_VERSION, CompileTracker, FeatureLog,
                       StepProfiler, compile_tracker, feature_log,
                       step_profiler)
+from .memory import MemoryProfiler, device_memory_stats, memory_profiler
+from .fleet import (BurnRateMonitor, FleetAggregator, FleetHealth,
+                    StragglerDetector, fleet_aggregator, fleet_health,
+                    local_fleet_snapshot, parse_exposition, parse_sample,
+                    straggler_workers)
 
 __all__ = ["registry", "tracer", "MetricsRegistry", "Counter", "Gauge",
            "Histogram", "Tracer", "Span", "StageTimer", "wall_now",
@@ -35,4 +40,9 @@ __all__ = ["registry", "tracer", "MetricsRegistry", "Counter", "Gauge",
            "flight_recorder",
            "CompileTracker", "FeatureLog", "StepProfiler",
            "FEATURE_SCHEMA_VERSION",
-           "compile_tracker", "feature_log", "step_profiler"]
+           "compile_tracker", "feature_log", "step_profiler",
+           "MemoryProfiler", "device_memory_stats", "memory_profiler",
+           "FleetAggregator", "FleetHealth", "StragglerDetector",
+           "BurnRateMonitor", "fleet_aggregator", "fleet_health",
+           "local_fleet_snapshot", "parse_exposition", "parse_sample",
+           "straggler_workers"]

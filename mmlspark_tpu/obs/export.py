@@ -211,6 +211,60 @@ class FlightRecorder:
                             for s in bucket):
                 bucket.append(d)
 
+    def pending_spans(self, *, drain: bool = False,
+                      max_spans: int = 256) -> list[dict]:
+        """Flat copy of pending (not-yet-retained) spans, oldest trace
+        first, bounded by ``max_spans``. With ``drain=True`` the copied
+        spans are removed — a mesh worker's heartbeat flushes its local
+        recorder home this way, so spans for a request that never
+        replies (worker death) still reach the ingest-side recorder
+        instead of rotting in the worker's pending ring."""
+        out: list[dict] = []
+        with self._lock:
+            for tid in list(self._pending):
+                if len(out) >= max_spans:
+                    break
+                bucket = self._pending[tid]
+                take = bucket[:max_spans - len(out)]
+                out.extend(dict(s) for s in take)
+                if drain:
+                    rest = bucket[len(take):]
+                    if rest:
+                        self._pending[tid] = rest
+                    else:
+                        del self._pending[tid]
+        return out
+
+    def mark_incomplete(self, trace_id: str,
+                        reason: str = "worker lost") -> bool:
+        """The process emitting part of this trace died mid-request
+        (lease replay after worker death): promote whatever spans made
+        it home into the kept store, flagged ``incomplete``, so
+        ``/debug/trace`` shows a closed — not orphaned — tree. If the
+        trace was already kept, just flags it. False when the trace is
+        unknown on this recorder."""
+        trace_id = str(trace_id or "")
+        if not trace_id:
+            return False
+        with self._lock:
+            kept = self._kept.get(trace_id)
+            if kept is not None:
+                kept["incomplete"] = True
+                kept["note"] = reason
+                return True
+            spans = self._pending.pop(trace_id, None)
+            if spans is None:
+                return False
+            self._kept[trace_id] = {
+                "seconds": 0.0, "status": 0, "error": True,
+                "incomplete": True, "note": reason, "spans": spans}
+            self._errored.append(trace_id)
+            if len(self._errored) > self.keep_errored:
+                old = self._errored.popleft()
+                self._kept.pop(old, None)
+            self._c_traces.inc(1, outcome="kept_incomplete")
+            return True
+
     def _evict_one_pending_locked(self) -> None:
         """Evict the oldest SINGLE-span pending trace first: the steady
         stream of lone root spans (an outbound ``http.send`` with no
@@ -236,7 +290,13 @@ class FlightRecorder:
             return
         error = bool(error) or int(status) >= 500
         with self._lock:
-            if trace_id in self._kept:
+            prior = self._kept.get(trace_id)
+            if prior is not None:
+                if prior.get("incomplete"):
+                    # the replayed request completed elsewhere — record
+                    # the real outcome, keep the incomplete flag
+                    prior["seconds"] = float(seconds)
+                    prior["status"] = int(status)
                 return
             spans = self._pending.pop(trace_id, [])
             if error:
@@ -273,6 +333,7 @@ class FlightRecorder:
         with self._lock:
             items = [{"trace_id": t, "seconds": k["seconds"],
                       "status": k["status"], "error": k["error"],
+                      "incomplete": bool(k.get("incomplete")),
                       "spans": [dict(s) for s in k["spans"]]}
                      for t, k in self._kept.items()]
         return sorted(items, key=lambda d: -d["seconds"])
@@ -284,6 +345,7 @@ class FlightRecorder:
                 return None
             return {"trace_id": str(trace_id), "seconds": k["seconds"],
                     "status": k["status"], "error": k["error"],
+                    "incomplete": bool(k.get("incomplete")),
                     "spans": [dict(s) for s in k["spans"]]}
 
     def chrome(self) -> dict:
@@ -315,6 +377,7 @@ def debug_trace_payload(recorder: FlightRecorder | None = None) -> bytes:
         "traces": [{"trace_id": t["trace_id"],
                     "seconds": round(t["seconds"], 6),
                     "status": t["status"], "error": t["error"],
+                    "incomplete": t.get("incomplete", False),
                     "spans": len(t["spans"])}
                    for t in trees],
         **rec.chrome(),

@@ -1,0 +1,179 @@
+"""Device-memory telemetry: always-on HBM gauges + watermark deltas.
+
+PR 12 made the data plane pod-scale, but nothing in the stack consults
+``device.memory_stats()`` — an OOM on a v5e rank is invisible until XLA
+aborts. This module turns the runtime's allocator counters into
+registry series every scrape sees:
+
+- ``mem_hbm_bytes_in_use{device=...}`` / ``mem_hbm_peak_bytes`` /
+  ``mem_hbm_limit_bytes`` — per local device, ``process``-labelled on a
+  pod (same labelling contract as ``profile_step_seconds``), refreshed
+  by :meth:`MemoryProfiler.update` (the serving fronts refresh on every
+  ``/metrics`` scrape via ``obs.fleet``).
+- ``mem_segment_delta_bytes{stage=...}`` — the live-buffer delta one
+  profiled stage left behind (StepProfiler samples the watermark around
+  every ``step``), so a FusedSegment that leaks device buffers shows up
+  as a growing delta, per segment.
+- ``mem_event_watermark_bytes{event=...}`` — the watermark at named
+  lifecycle events (AOT warm boot, autoscaler scale-up), so "what did
+  warm-loading the store cost in HBM" is one scrape.
+
+Degradation contract (the CI no-JAX smoke asserts it): with no jax in
+the process, or a backend whose devices expose no ``memory_stats``
+(CPU), every function returns ``[]``/``None`` and the gauges are simply
+ABSENT — never an exception, never a zero sample that looks like a
+measurement. The guard never imports jax and never initializes a
+backend (same discipline as :func:`~.profile.device_platform`).
+"""
+
+from __future__ import annotations
+
+import sys
+import threading
+
+from .metrics import registry as _registry
+from .profile import process_label
+
+__all__ = ["MemoryProfiler", "device_memory_stats", "memory_profiler"]
+
+# allocator-stat key -> (our metric suffix). Runtimes differ slightly in
+# what they report; only keys that exist become samples.
+_STAT_KEYS = (
+    ("bytes_in_use", "mem_hbm_bytes_in_use"),
+    ("peak_bytes_in_use", "mem_hbm_peak_bytes"),
+    ("bytes_limit", "mem_hbm_limit_bytes"),
+)
+
+
+def _live_devices() -> list:
+    """``jax.local_devices()`` ONLY when a backend is already live.
+    Never imports jax, never initializes a backend — the same guard as
+    ``profile.device_platform`` (a host-only serving process must not
+    pay backend bring-up for a metrics scrape)."""
+    mod = sys.modules.get("jax")
+    if mod is None:
+        return []
+    xb = sys.modules.get("jax._src.xla_bridge")
+    if xb is None or not getattr(xb, "_backends", None):
+        return []
+    try:
+        return list(mod.local_devices())
+    except Exception:
+        return []
+
+
+def device_memory_stats() -> list[dict]:
+    """Per-device allocator stats: ``[{"device": "0", "bytes_in_use":
+    ..., ...}]`` with only the keys the runtime reports. ``[]`` when no
+    live backend, or when no device exposes ``memory_stats`` (CPU) —
+    the documented fallback the fleet exposition carries on hosts
+    without HBM."""
+    out: list[dict] = []
+    for d in _live_devices():
+        stats = None
+        try:
+            fn = getattr(d, "memory_stats", None)
+            stats = fn() if callable(fn) else None
+        except Exception:
+            stats = None
+        if not stats:
+            continue
+        rec = {"device": str(getattr(d, "id", len(out)))}
+        for key, _ in _STAT_KEYS:
+            v = stats.get(key)
+            if v is not None:
+                rec[key] = int(v)
+        if len(rec) > 1:
+            out.append(rec)
+    return out
+
+
+class MemoryProfiler:
+    """Registry-backed view over :func:`device_memory_stats`.
+
+    Stateless apart from its gauge handles; every method tolerates a
+    backend-free process by doing nothing (gauges stay absent).
+    """
+
+    def __init__(self, registry=None):
+        reg = registry if registry is not None else _registry
+        self._lock = threading.Lock()
+        self._gauges = {
+            suffix: reg.gauge(suffix, help_)
+            for suffix, help_ in (
+                ("mem_hbm_bytes_in_use",
+                 "allocator bytes currently live, per local device"),
+                ("mem_hbm_peak_bytes",
+                 "allocator peak bytes since process start, per device"),
+                ("mem_hbm_limit_bytes",
+                 "allocator capacity, per local device"),
+            )}
+        self._g_segment = reg.gauge(
+            "mem_segment_delta_bytes",
+            "live-buffer delta across one profiled stage execution, "
+            "by stage")
+        self._g_event = reg.gauge(
+            "mem_event_watermark_bytes",
+            "total live bytes at a named lifecycle event "
+            "(aot_warm, scale_up, ...)")
+        #: devices whose gauges were ever set — so a device that stops
+        #: reporting (runtime drift) does not leave a stale sample
+        self._seen_devices: set[str] = set()
+
+    def _plab(self) -> dict:
+        pl = process_label()
+        return {"process": pl} if pl is not None else {}
+
+    def update(self) -> list[dict]:
+        """Refresh the ``mem_hbm_*`` gauges from the live allocator;
+        returns the raw stats (``[]`` on CPU/no-JAX — gauges absent)."""
+        stats = device_memory_stats()
+        plab = self._plab()
+        reported: set[str] = set()
+        for rec in stats:
+            dev = rec["device"]
+            reported.add(dev)
+            for key, suffix in _STAT_KEYS:
+                if key in rec:
+                    self._gauges[suffix].set(rec[key], device=dev, **plab)
+        with self._lock:
+            gone = self._seen_devices - reported
+            self._seen_devices |= reported
+        for dev in gone:
+            for g in self._gauges.values():
+                g.remove_matching(device=dev)
+        return stats
+
+    def watermark(self) -> int | None:
+        """Total live bytes across local devices, or None when the
+        backend reports no memory stats (the delta hooks skip instead
+        of recording a fake zero)."""
+        vals = [r["bytes_in_use"] for r in device_memory_stats()
+                if "bytes_in_use" in r]
+        return sum(vals) if vals else None
+
+    def segment_delta(self, stage: str, before: int | None,
+                      after: int | None) -> int | None:
+        """Record the live-buffer delta one profiled stage left behind
+        (StepProfiler samples ``watermark()`` around the step and lands
+        both ends here). None in, nothing recorded."""
+        if before is None or after is None:
+            return None
+        delta = int(after) - int(before)
+        self._g_segment.set(delta, stage=stage, **self._plab())
+        return delta
+
+    def note_event(self, event: str) -> int | None:
+        """Stamp the current watermark for a lifecycle event (AOT warm
+        boot, autoscaler scale-up) and refresh the per-device gauges, so
+        the event's memory cost is scrapeable next to its latency."""
+        self.update()
+        wm = self.watermark()
+        if wm is not None:
+            self._g_event.set(wm, event=event, **self._plab())
+        return wm
+
+
+#: THE process-wide memory profiler (StepProfiler, the AOT warm path,
+#: and the fleet scrape surface share it so the series stay one family).
+memory_profiler = MemoryProfiler()

@@ -337,6 +337,9 @@ class StepProfiler:
         handle = _StepHandle()
         if parent is StepProfiler._AMBIENT:
             parent = self._tracer.current_span()
+        # lazy: memory imports this module for process_label
+        from .memory import memory_profiler
+        mem0 = memory_profiler.watermark()
         w0 = wall_now()
         t0 = time.perf_counter()
         try:
@@ -363,6 +366,10 @@ class StepProfiler:
             self._h_step.observe(device_s, stage=stage, phase="device",
                                  **plab)
             self._c_steps.inc(1, stage=stage, **plab)
+            # live-buffer delta this stage left behind (HBM profiler;
+            # absent on hosts whose devices report no memory stats)
+            memory_profiler.segment_delta(
+                stage, mem0, memory_profiler.watermark())
             if flops:
                 self.record_mfu(stage, flops, t2 - t0)
             dspan = self._tracer.emit_span(
@@ -395,13 +402,16 @@ class StepProfiler:
 step_profiler = StepProfiler()
 
 
-#: Feature-row schema version (ISSUE 12). v2 adds the fields the cost
+#: Feature-row schema version. v2 (ISSUE 12) added the fields the cost
 #: model needs that PR 6 did not record — ``padded_batch`` (the
 #: post-bucket batch shape the executor actually runs), ``queue_depth``
 #: at execute time, ``compiled_segments``, and the device ``platform``
-#: — plus this stamp itself. Consumers (``perf.costmodel``) SKIP rows
-#: whose version does not match, loudly, instead of misparsing old logs.
-FEATURE_SCHEMA_VERSION = 2
+#: — plus this stamp itself. v3 (ISSUE 15) stamps the ``process`` index
+#: (``process_label()``; None on single-process hosts) so fleet-merged
+#: training data is rank-attributable. Consumers (``perf.costmodel``)
+#: accept v3 and v2 rows and SKIP anything else, loudly, instead of
+#: misparsing old logs.
+FEATURE_SCHEMA_VERSION = 3
 
 _platform_cache: str | None = None
 
@@ -469,6 +479,7 @@ class FeatureLog:
     def record(self, **fields) -> None:
         fields.setdefault("schema_version", FEATURE_SCHEMA_VERSION)
         fields.setdefault("platform", device_platform())
+        fields.setdefault("process", process_label())
         with self._lock:
             self._records.append(dict(fields))
             self._total += 1

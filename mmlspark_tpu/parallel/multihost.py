@@ -194,6 +194,23 @@ def feed_process_local(mesh, local_rows, axis: str = DCN_AXIS):
         NamedSharding(mesh, P(axis)), local_rows)
 
 
+def fleet_result(extra: dict | None = None) -> dict:
+    """The standard MULTIHOST_RESULT fleet envelope: this rank's index
+    plus its prefix-filtered registry snapshot (and device-memory
+    stats when the backend reports them), ready for
+    ``obs.fleet.ingest_pod_results`` on the launcher side — the push
+    half of pod-scale metric federation rides the result channel the
+    harness already has."""
+    from ..obs.fleet import local_fleet_snapshot
+    from ..obs.memory import memory_profiler
+    memory_profiler.update()      # mem_hbm_* into the snapshot, if any
+    idx, _ = this_process()
+    out = {"process": idx, "snapshot": local_fleet_snapshot()}
+    if extra:
+        out.update(extra)
+    return out
+
+
 def _worker_main(argv: list[str]) -> int:
     """``python -m mmlspark_tpu.parallel.multihost module:fn json`` —
     the body every :func:`launch_pod` worker runs."""

@@ -28,10 +28,12 @@ to what a pure-stdlib/numpy control plane can train online:
   autotune winner registry), so a rebooted server prices with last
   boot's model until fresh traffic retrains it.
 
-Rows are schema-checked: anything whose ``schema_version`` does not
-match ``obs.profile.FEATURE_SCHEMA_VERSION`` is SKIPPED loudly
-(counted + warned), never misparsed — old logs degrade to the EWMA,
-not to garbage predictions.
+Rows are schema-checked: anything whose ``schema_version`` is not in
+``ACCEPTED_SCHEMA_VERSIONS`` is SKIPPED loudly (counted + warned),
+never misparsed — old logs degrade to the EWMA, not to garbage
+predictions. v2 rows stay accepted alongside the current v3: v3 only
+added the ``process`` rank stamp (a label, not a feature column), so
+a pre-fleet log still fits and prices correctly.
 
 Import is stdlib + numpy + obs/sched only — no JAX, no device (the CI
 smoke asserts it). Prediction takes a lock; it runs on scheduler and
@@ -67,6 +69,11 @@ DEFAULT_PERF_ROOT = "/tmp/mmlspark_tpu_perf-" + str(
 #: the model's feature vector (after the intercept); per-key training
 #: means fill features the caller cannot supply at estimate time.
 FEATURES = ("bucket", "batch", "entity_kb", "queue_depth")
+
+#: Row schemas this model can consume. v3 (the fleet PR) added only the
+#: ``process`` rank stamp — no feature column changed — so v2 logs
+#: remain fully usable; anything else is skipped loudly in :meth:`fit`.
+ACCEPTED_SCHEMA_VERSIONS = frozenset({FEATURE_SCHEMA_VERSION, 2})
 
 MODEL_VERSION = 1
 
@@ -152,7 +159,7 @@ class CostModel:
         by_key: dict[tuple[str, str], list[tuple[list, float]]] = {}
         skipped_schema = skipped_bad = 0
         for row in rows:
-            if row.get("schema_version") != FEATURE_SCHEMA_VERSION:
+            if row.get("schema_version") not in ACCEPTED_SCHEMA_VERSIONS:
                 skipped_schema += 1
                 continue
             try:
@@ -173,8 +180,8 @@ class CostModel:
             self._c_skipped.inc(skipped_schema, reason="schema")
             _LOG.warning(
                 "cost model skipped %d FeatureLog rows with schema_version"
-                " != %d (old log format — retrain from fresh traffic)",
-                skipped_schema, FEATURE_SCHEMA_VERSION)
+                " not in %s (old log format — retrain from fresh traffic)",
+                skipped_schema, sorted(ACCEPTED_SCHEMA_VERSIONS))
         if skipped_bad:
             self._c_skipped.inc(skipped_bad, reason="bad")
         used = 0
@@ -383,13 +390,14 @@ class CostModel:
         with open(path, encoding="utf-8") as f:
             payload = json.load(f)
         if payload.get("version") != MODEL_VERSION or \
-                payload.get("schema_version") != FEATURE_SCHEMA_VERSION:
+                payload.get("schema_version") not in \
+                ACCEPTED_SCHEMA_VERSIONS:
             raise ValueError(
                 f"cost-model file {path!r} has version="
                 f"{payload.get('version')} schema_version="
                 f"{payload.get('schema_version')}; this build expects "
-                f"({MODEL_VERSION}, {FEATURE_SCHEMA_VERSION}) — "
-                "rebuild it from fresh FeatureLog traffic")
+                f"({MODEL_VERSION}, {sorted(ACCEPTED_SCHEMA_VERSIONS)})"
+                " — rebuild it from fresh FeatureLog traffic")
         loaded = {}
         for m in payload.get("models", ()):
             loaded[(str(m["service"]), str(m["route"]))] = {

@@ -60,6 +60,13 @@ SILVER = "silver"
 BEST_EFFORT = "best_effort"
 TIER_WEIGHTS = {GOLD: 8.0, SILVER: 4.0, BEST_EFFORT: 1.0}
 
+#: per-tier error budgets — the fraction of a tenant's requests allowed
+#: to shed/fail before its SLO is breached. The fleet health plane
+#: (obs.fleet.BurnRateMonitor) divides observed shed rates by these to
+#: get burn multiples: burn 1.0 = consuming budget exactly at the SLO
+#: rate, 10x = paging. Gold's budget is 100x tighter than best-effort's.
+TIER_ERROR_BUDGETS = {GOLD: 0.001, SILVER: 0.01, BEST_EFFORT: 0.1}
+
 #: the bucket requests land in when tenancy is on but no (valid)
 #: ``X-Tenant`` header arrived — shares the default quota
 DEFAULT_TENANT = "default"
@@ -180,6 +187,15 @@ class Tenancy:
     def weight_for(self, tenant: str) -> float:
         q = self.quota_for(tenant)
         return q.weight or TIER_WEIGHTS.get(q.tier, 1.0)
+
+    def error_budget_for(self, tenant: str) -> float:
+        """The tenant's SLO error budget (allowed shed/fail fraction)
+        from its tier — the burn-rate denominator
+        (``obs.fleet.BurnRateMonitor``; wired by the serving fronts via
+        ``FleetHealth.attach_tenancy``)."""
+        q = self.quota_for(tenant)
+        return TIER_ERROR_BUDGETS.get(q.tier,
+                                      TIER_ERROR_BUDGETS[BEST_EFFORT])
 
     def share_for(self, tenant: str) -> float:
         """This tenant's weighted share of dispatches among the tenants
@@ -340,10 +356,13 @@ class Tenancy:
 
 def evict_tenant_series(tenant: str, registry=None,
                         prefixes: tuple[str, ...] = ("sched_",
-                                                     "serving_")) -> None:
-    """Drop every ``sched_*``/``serving_*`` series labeled with this
-    tenant from the registry — the metric-side half of idle-tenant
-    eviction (the state half lives in :meth:`Tenancy.maybe_evict_idle`).
+                                                     "serving_",
+                                                     "slo_")) -> None:
+    """Drop every ``sched_*``/``serving_*``/``slo_*`` series labeled
+    with this tenant from the registry — the metric-side half of
+    idle-tenant eviction (the state half lives in
+    :meth:`Tenancy.maybe_evict_idle`). ``slo_`` covers the burn-rate
+    gauges the fleet health plane derives from this tenant's counters.
     """
     reg = registry if registry is not None else _default_registry
     for prefix in prefixes:

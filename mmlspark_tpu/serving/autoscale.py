@@ -95,6 +95,7 @@ class AutoscaleSignals:
     slo_pressure: float = 0.0   # max tenant EWMA latency / SLO deadline
     breakers_open: int = 0      # open/half-open breakers in the process
     worker_deaths: float = 0.0  # CUMULATIVE detected-death count
+    stragglers: float = 0.0     # currently-flagged fleet_straggler ranks
 
 
 @dataclass
@@ -146,6 +147,7 @@ class Autoscaler:
         self._up_streak = 0
         self._down_streak = 0
         self._deaths_seen = 0.0
+        self._straggler_level = 0.0
         self._stop = threading.Event()
         self._thread: threading.Thread | None = None
         self._g_workers = reg.gauge(
@@ -193,9 +195,16 @@ class Autoscaler:
                        and mesh in k and v >= 1.0)
         pressure = (self.tenancy.slo_pressure()
                     if self.tenancy is not None else 0.0)
+        # fleet health (obs.fleet): ranks currently flagged straggler.
+        # The gauge is keyed by worker/process, not service — one sick
+        # rank degrades the whole fleet's step time, so every pool
+        # sharing the process reads the same count.
+        stragglers = sum(1 for k, v in snap.items()
+                         if k.startswith("fleet_straggler{") and v >= 1.0)
         return AutoscaleSignals(queue_depth=queue, slo_pressure=pressure,
                                 breakers_open=breakers,
-                                worker_deaths=deaths)
+                                worker_deaths=deaths,
+                                stragglers=stragglers)
 
     # -- the decision --------------------------------------------------------
     def tick(self, signals: AutoscaleSignals | None = None) -> str:
@@ -216,6 +225,20 @@ class Autoscaler:
                 self.pool.scale_up()
             self._record("replace", t, "worker death detected")
             return "replace"
+        if (s.stragglers > self._straggler_level
+                and n < cfg.max_workers):
+            # straggler replace (obs.fleet): a sick-but-alive rank was
+            # flagged — add replacement capacity immediately (rising
+            # edge only; bypasses hysteresis like the death path).
+            # Routing already deprioritizes the flagged worker
+            # (pick_least_loaded), and normal scale-down drains the
+            # excess once the rank recovers.
+            self._straggler_level = s.stragglers
+            self.pool.scale_up()
+            self._desired = max(self._desired, self.pool.count())
+            self._record("replace", t, "straggler flagged")
+            return "replace"
+        self._straggler_level = min(self._straggler_level, s.stragglers)
         over = (s.queue_depth > cfg.queue_high * max(n, 1)
                 or s.slo_pressure > cfg.slo_high)
         # an open breaker means some endpoint is sick: it VETOES
@@ -440,6 +463,11 @@ class ComputeWorkerPool:
             self._workers[wid] = _PoolWorker(thread=th, stop=stop,
                                              started=now())
             th.start()
+        # HBM watermark at the scale-up event (obs.memory): the new
+        # worker's warm boot shows its device-memory cost next to its
+        # latency cost (mem_event_watermark_bytes{event="scale_up"})
+        from ..obs.memory import memory_profiler
+        memory_profiler.note_event("scale_up")
         return wid
 
     def scale_down(self) -> str | None:

@@ -39,13 +39,23 @@ from ..core import DataFrame
 from ..io.http.schema import HTTPRequestData, HTTPResponseData
 from ..obs import registry as _obs
 from ..obs.export import flight_recorder as _flight
+from ..obs.fleet import (fleet_aggregator as _fleet_agg, own_worker_samples,
+                         local_fleet_snapshot, straggler_workers)
+from ..obs.profile import process_label
 from ..obs.propagation import TraceContext
 from ..obs.tracing import tracer as _tracer
 from ..resilience import breaker_for, drop_breaker
 from ..resilience.faults import WorkerKilled, injector as _faults
 from .native_front import NativeServingServer
 from .server import (CachedRequest, LowLatencyHandlerMixin,
-                     QuietHTTPServer, ServingServer, _LOG)
+                     QuietHTTPServer, ServingServer, _LOG, _SERVICES)
+
+# per-worker execute timing lands in the SAME family the StepProfiler
+# fills (profile_step_seconds), labelled worker=<id> — the straggler
+# detector reads per-rank/per-worker means off one family
+_h_worker_step = _obs.histogram(
+    "profile_step_seconds",
+    "per-stage wall seconds, split host-dispatch vs device")
 
 # mesh-internal traffic series (obs subsystem): every lease/reply hop
 # counts calls and payload bytes, so a scrape shows where cross-worker
@@ -91,13 +101,20 @@ class ServiceInfo:
     ewma_latency_ms: float = 0.0
 
 
-def pick_least_loaded(infos: list[ServiceInfo]) -> ServiceInfo | None:
+def pick_least_loaded(infos: list[ServiceInfo],
+                      avoid=None) -> ServiceInfo | None:
     """Least-loaded routing: order by queue depth first (requests
     already committed to a worker), then EWMA latency (how fast it
-    drains them). Ties break on worker_id for determinism."""
+    drains them). Ties break on worker_id for determinism. Workers the
+    fleet health plane flags as stragglers (``avoid``; defaults to the
+    live ``fleet_straggler`` flag set) sort behind every healthy worker
+    — still pickable when they are all that's left."""
     if not infos:
         return None
-    return min(infos, key=lambda i: (i.queue_depth, i.ewma_latency_ms,
+    if avoid is None:
+        avoid = straggler_workers()
+    return min(infos, key=lambda i: (1 if i.worker_id in avoid else 0,
+                                     i.queue_depth, i.ewma_latency_ms,
                                      i.worker_id))
 
 
@@ -337,6 +354,10 @@ class DistributedServingServer(ServingServer):
         base = "" if self.api_path == "/" else self.api_path
         self._routes[f"{base}/__reply__"] = self._handle_reply
         self._routes[f"{base}/__lease__"] = self._handle_lease
+        # fleet telemetry ingest (obs.fleet): compute workers push
+        # their registry samples + pending spans here on the heartbeat
+        # cadence, next to __lease__/__reply__ on the same listener
+        self._routes[f"{base}/__fleet__"] = self._handle_fleet
         self._monitor = threading.Thread(target=self._monitor_leases,
                                          daemon=True)
         self._load_reporter = threading.Thread(target=self._report_load,
@@ -414,6 +435,28 @@ class DistributedServingServer(ServingServer):
             return 404, b'{"delivered": false}'
         ok = cached.reply(_resp_from_json(d["response"]))
         return 200, json.dumps({"delivered": bool(ok)}).encode()
+
+    def _handle_fleet(self, body: bytes) -> tuple[int, bytes]:
+        """Worker telemetry push: ``{"worker", "process", "snapshot",
+        "spans", "secret"}``. The snapshot merges into the process-wide
+        FleetAggregator (worker/process labels stamped there); pending
+        spans flushed from the worker's flight recorder fold into the
+        ingest-side recorder so a tree that dies on the worker can
+        still be closed or marked incomplete here."""
+        d = json.loads(body or b"{}")
+        if not self._check_secret(d):
+            return 403, b'{"error": "bad mesh secret"}'
+        _m_mesh_calls.inc(1, service=self.name, endpoint="__fleet__")
+        _m_mesh_bytes.inc(len(body), service=self.name,
+                          endpoint="__fleet__", direction="in")
+        if d.get("spans"):
+            _flight.ingest(d["spans"])
+        snap = d.get("snapshot")
+        if isinstance(snap, dict):
+            _fleet_agg.ingest_snapshot(
+                snap, process=d.get("process"), worker=d.get("worker"),
+                channel="heartbeat")
+        return 200, b'{"ok": true}'
 
     def _handle_lease(self, body: bytes) -> tuple[int, bytes]:
         # named injection point for the lease hop (the worker absorbs
@@ -499,6 +542,10 @@ class DistributedServingServer(ServingServer):
                     self._peers = table
                 for wid in gone:
                     drop_breaker(f"mesh:{self.name}:{wid}")
+                    # departed peer: its fleet source (and any
+                    # fleet_* series keyed by it) go too — bounded
+                    # eviction on death, not just staleness
+                    _fleet_agg.evict_worker(wid)
             except WorkerKilled:
                 return  # injected death: stop beating, keep the body
             except Exception:
@@ -553,6 +600,7 @@ class DistributedServingServer(ServingServer):
             _LOG.warning("service %s: %d leases expired, replaying at "
                          "epoch %d", self.name, len(expired), self.epoch)
             to_replay = []
+            dead_lessees = set()
             with self._lock:
                 for i in expired:
                     # a reply may land concurrently and pop the lease
@@ -560,11 +608,25 @@ class DistributedServingServer(ServingServer):
                     entry = self._leases.pop(i, None)
                     if entry is not None and not entry[1]._event.is_set():
                         to_replay.append(entry[1])
+                        if len(entry) > 2 and entry[2]:
+                            dead_lessees.add(entry[2])
             # replays re-enter the scheduler (its own condition variable)
             # outside _lock: lock order stays one-directional
             for cached in to_replay:
                 _m_lease_replays.inc(1, service=self.name)
+                # the dead worker's spans (whatever its heartbeat
+                # flushed home) become a closed, incomplete-flagged
+                # tree instead of rotting orphaned in pending; if the
+                # replay completes elsewhere, note_request fills in
+                # the real outcome and the flag stays
+                sp = getattr(cached, "span", None)
+                if sp is not None:
+                    _flight.mark_incomplete(
+                        sp.trace_id, reason="lease expired: worker lost")
                 self.replay(cached)
+            for wid in dead_lessees:
+                # dead lessee: drop its fleet source + keyed series
+                _fleet_agg.evict_worker(wid)
 
     # -- cross-worker reply routing ----------------------------------------
     def reply_to(self, request_id: str, response: HTTPResponseData) -> bool:
@@ -619,6 +681,33 @@ class DistributedServingServer(ServingServer):
         _m_mesh_bytes.inc(sent, service=self.name,
                           endpoint="__reply__", direction="out")
         return status == 200 and json.loads(body).get("delivered", False)
+
+
+def _worker_fleet_payload(wid: str, secret: str,
+                          own_process: bool) -> dict:
+    """What a compute worker pushes over ``__fleet__`` each heartbeat.
+
+    A worker that owns its process (a pod rank, or a standalone worker
+    process with no in-process ingest) ships its full prefix-filtered
+    registry snapshot and DRAINS its local flight recorder's pending
+    spans — that flush is what lets the ingest-side recorder close or
+    mark-incomplete a tree whose worker later dies. A thread-pool
+    worker SHARES the ingest's registry and recorder, so it ships only
+    the series already labelled ``worker="<id>"`` and never drains
+    (draining would strip the ingest's own in-flight traces).
+    ``own_process`` is decided ONCE at worker-loop start: a thread
+    worker must never flip to draining just because the servers it
+    shares a process with stopped first — that window would strip
+    other traces still pending in the shared recorder."""
+    pl = process_label()
+    if own_process:
+        snap = local_fleet_snapshot()
+        spans = _flight.pending_spans(drain=True)
+    else:
+        snap = own_worker_samples(wid)
+        spans = []
+    return {"worker": wid, "process": pl, "snapshot": snap,
+            "spans": spans, "secret": secret}
 
 
 def _worker_spans(items: list, wid: str, service: str, execute_s: float,
@@ -732,6 +821,15 @@ def remote_worker_loop(driver_address, service_name: str, transform_fn,
     stop_event = stop_event or threading.Event()
     conns = _PeerConnections()
     wid = worker_id or uuid.uuid4().hex[:12]
+    # collect this worker's spans locally (idempotent when an ingest in
+    # this process already installed): the heartbeat flushes pending
+    # spans home so a trace that dies here is not orphaned. Whether
+    # this loop OWNS its process (may drain the recorder on flush) is
+    # fixed now — _SERVICES can empty out later when co-resident
+    # servers stop, and a thread worker that flipped to draining then
+    # would strip traces other servers in this process still own.
+    own_process = process_label() is not None or not _SERVICES
+    _flight.install()
     # AOT warm boot BEFORE the first lease pull: a worker the
     # autoscaler just added loads its fused-segment executables from
     # the on-disk store (core/aot.py) instead of paying a compile storm
@@ -742,6 +840,7 @@ def remote_worker_loop(driver_address, service_name: str, transform_fn,
                            worker_id=wid, host="0.0.0.0", port=0)
     idle = poll_interval
     last_beat = 0.0
+    last_fleet = 0.0
     killed = False
     known_ingests: set[str] = set()
     try:
@@ -778,6 +877,21 @@ def remote_worker_loop(driver_address, service_name: str, transform_fn,
             for gone in known_ingests - current:
                 drop_breaker(f"mesh:{service_name}:ingest:{gone}")
             known_ingests = current
+            # fleet telemetry push, heartbeat cadence: this worker's
+            # samples + pending-span flush to every ingest server's
+            # aggregator. Best-effort — a missed push only means one
+            # staler source on that ingest's fleet view.
+            if time.monotonic() - last_fleet >= heartbeat_interval:
+                fleet_payload = _worker_fleet_payload(
+                    wid, mesh_secret, own_process)
+                for info in infos:
+                    base = "" if info.api_path == "/" else info.api_path
+                    try:
+                        conns.post(info.host, info.port,
+                                   f"{base}/__fleet__", fleet_payload)
+                    except Exception:
+                        pass
+                last_fleet = time.monotonic()
             got = False
             # drain the most-backlogged ingest first (the registry table
             # carries each server's last-reported queue depth)
@@ -804,6 +918,21 @@ def remote_worker_loop(driver_address, service_name: str, transform_fn,
                 if not items:
                     continue
                 got = True
+                # the lease is acknowledged into each request's trace
+                # BEFORE the death injection point: if this worker dies
+                # mid-batch, these spans are what its last heartbeat
+                # flushed home — the ingest's recorder closes the tree
+                # as incomplete instead of orphaning it
+                for it in items:
+                    tr = it.get("trace")
+                    if tr:
+                        _tracer.emit_span(
+                            "worker.lease",
+                            parent=TraceContext(
+                                trace_id=str(tr.get("trace_id", "")),
+                                span_id=str(tr.get("span_id", ""))),
+                            seconds=0.0, worker=wid,
+                            service=service_name, rows=len(items))
                 # injection point AFTER the lease is held: a kill here
                 # is the mid-batch worker death the lease replay (and
                 # its chaos test) exists for; a "slow" rule here arms a
@@ -834,6 +963,11 @@ def remote_worker_loop(driver_address, service_name: str, transform_fn,
                                  out, "columns", []) else [])
                 except Exception:
                     continue  # lease expiry will replay the batch
+                # per-worker execute time (slow-factor inclusive) into
+                # the step family — the straggler detector's signal
+                _h_worker_step.observe(
+                    time.perf_counter() - t0, stage="worker_execute",
+                    phase="execute", worker=wid)
                 spans_by_id = _worker_spans(
                     items, wid, service_name,
                     time.perf_counter() - t0, out)

@@ -200,7 +200,15 @@ class NativeServingServer(ServingServer):
                     headers[k.strip()] = v.strip()
         raw_path = path_buf.value.decode(errors="replace")
         path = raw_path.split("?", 1)[0].rstrip("/") or "/"
-        route = self._routes.get(path)
+        # query-scoped routes first ("/metrics?scope=fleet" is a
+        # literal key — same order as the threaded front), then the
+        # query-stripped path
+        route = None
+        if "?" in raw_path:
+            query = raw_path.split("?", 1)[1]
+            route = self._routes.get(f"{path}?{query}")
+        if route is None:
+            route = self._routes.get(path)
         default_ct = b"Content-Type: application/octet-stream\r\n"
         if route is not None:
             status, out = route(body)

@@ -30,6 +30,9 @@ from ..core import DataFrame
 from ..io.http.schema import HTTPRequestData, HTTPResponseData
 from ..obs import registry as _obs
 from ..obs.export import debug_trace_payload, flight_recorder as _flight
+from ..obs.fleet import (fleet_aggregator as _fleet_agg,
+                         fleet_health as _fleet_health)
+from ..obs.memory import memory_profiler as _memory
 from ..obs.profile import feature_log as _features
 from ..obs.propagation import extract as _extract
 from ..obs.tracing import tracer as _tracer
@@ -260,6 +263,21 @@ class ServingServer:
         if self.api_path != "/":
             self._routes[f"{self.api_path}/debug/aot"] = \
                 self._debug_aot_route
+        # fleet telemetry plane (obs.fleet, ISSUE 15): the fleet-scoped
+        # exposition ("?scope=fleet" is a LITERAL route key — both
+        # fronts try the query-preserving key before the stripped
+        # path), the per-source debug view, and the SLO-burn /healthz
+        # verdict. Shared route table → identical on both fronts.
+        self._routes["/metrics?scope=fleet"] = self._fleet_metrics_route
+        self._routes["/debug/fleet"] = self._debug_fleet_route
+        self._routes["/healthz"] = self._healthz_route
+        if self.api_path != "/":
+            for suffix in ("/metrics?scope=fleet", "/debug/fleet",
+                           "/healthz"):
+                self._routes[f"{self.api_path}{suffix}"] = \
+                    self._routes[suffix]
+        if tenancy is not None:
+            _fleet_health.attach_tenancy(tenancy)
 
     def _debug_aot_route(self, body: bytes) -> tuple[int, bytes]:
         """``GET /debug/aot``: active store stats + the CompileTracker
@@ -289,6 +307,26 @@ class ServingServer:
         JSON with per-trace summaries — save as ``.json``, open in
         Perfetto, find the trace_id the load generator printed."""
         return 200, debug_trace_payload()
+
+    def _fleet_metrics_route(self, body: bytes) -> tuple[int, bytes]:
+        """``GET /metrics?scope=fleet``: the local exposition plus
+        every merged remote source's samples (pod ranks, heartbeating
+        mesh workers, pulled peers) — one scrape for the whole fleet.
+        Memory gauges refresh on scrape so they are never staler than
+        the reading."""
+        _memory.update()
+        return 200, _fleet_agg.exposition().encode()
+
+    def _debug_fleet_route(self, body: bytes) -> tuple[int, bytes]:
+        """``GET /debug/fleet``: verdict + per-source staleness/size,
+        flagged stragglers, and per-tenant burn rates as JSON."""
+        return 200, _fleet_health.debug_payload()
+
+    def _healthz_route(self, body: bytes) -> tuple[int, bytes]:
+        """``GET /healthz``: the fleet health verdict. 200 for
+        ok/degraded (a slow fleet must not be drained by its load
+        balancer), 503 only when critical (SLO burn is paging)."""
+        return _fleet_health.healthz_payload()
 
     def _start_request_span(self, cached: "CachedRequest",
                             route: str) -> None:
@@ -428,7 +466,14 @@ class ServingServer:
                 # queued.
                 length = int(self.headers.get("Content-Length", 0))
                 body = self.rfile.read(length) if length else None
-                route = serving._routes.get(path)
+                # query-scoped routes first ("/metrics?scope=fleet" is
+                # a literal key), then the query-stripped path
+                route = None
+                if "?" in self.path:
+                    query = self.path.split("?", 1)[1]
+                    route = serving._routes.get(f"{path}?{query}")
+                if route is None:
+                    route = serving._routes.get(path)
                 if route is not None:
                     status, out = route(body or b"")
                     self.send_response(status)

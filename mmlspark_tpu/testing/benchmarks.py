@@ -1014,6 +1014,287 @@ def mixed_tenant_scenario(*, service: str = "tenant-bench",
     }
 
 
+# ----------------------------------------------------- fleet telemetry chaos
+def fleet_chaos_scenario(*, service: str = "fleet-bench", seed: int = 31,
+                         n_workers: int = 3, base_step_s: float = 0.01,
+                         slow_factor: float = 6.0, wave_size: int = 6,
+                         warmup_waves: int = 4, max_flag_waves: int = 40,
+                         max_recover_s: float = 20.0,
+                         request_timeout_s: float = 20.0) -> dict:
+    """Fleet-plane chaos acceptance (ISSUE 15): a real worker mesh
+    (driver registry, one ingest, ``n_workers`` in-thread compute
+    workers whose transform sleeps ``base_step_s`` per batch — a
+    deterministic service time the slow-factor stretch is visible
+    against) driven in waves while the fleet health plane watches.
+
+    The trajectory measured, phase by phase:
+
+    1. **healthy warmup** — ``GET /healthz`` (via
+       :meth:`~mmlspark_tpu.obs.fleet.FleetHealth.healthz_payload`, the
+       exact body the route serves) answers ``ok``;
+    2. **injected straggler** — a ``worker.slow`` rule arms a
+       persistent ``slow_factor`` degradation on one worker; the
+       scenario counts waves (one health tick per wave) until
+       ``fleet_straggler{worker=...}`` flips — the detection latency —
+       and the :class:`~mmlspark_tpu.serving.autoscale.Autoscaler`,
+       ticked on the same cadence, must record a ``replace`` event
+       sourced from the straggler signal (``reason="straggler
+       flagged"``). Healthz now answers ``degraded`` (still HTTP 200:
+       a slow fleet must not be drained by its load balancer);
+    3. **replacement** — a ``worker.death`` kill takes the flagged
+       worker mid-lease: the lease monitor detects, replays its
+       stranded batch to survivors, and evicts its fleet source (the
+       ``remove_matching`` sweep also clears its step series from the
+       shared registry), after which the detector unflags and healthz
+       returns to ``ok``.
+
+    Tenant traffic rides along under a :class:`~mmlspark_tpu.sched.\
+Tenancy` (gold ``search`` / best-effort ``batch``) so the burn-rate
+    side of the verdict is live: gold takes zero sheds (burn 0, below
+    the page threshold throughout — the acceptance bound) while one
+    controlled ``batch`` shed keeps ``slo_burn_rate`` visibly nonzero
+    without ever crossing the degraded threshold at the final tick.
+
+    Scenario isolation: worker ids are per-scenario, so any
+    worker-labelled step series / fleet sources lingering from earlier
+    scenarios in this process are scrubbed first — the straggler
+    median must only see THIS run's ranks. On exit the scenario evicts
+    its own sources the same way.
+    """
+    from concurrent.futures import ThreadPoolExecutor
+
+    import numpy as np
+
+    from ..io.http.clients import send_request
+    from ..io.http.schema import HTTPRequestData, HTTPResponseData
+    from ..obs.fleet import FleetHealth, fleet_aggregator, parse_sample
+    from ..obs.memory import device_memory_stats
+    from ..obs.tracing import tracer
+    from ..resilience import FaultRule, faults
+    from ..sched import Shed, Tenancy, TenantQuota
+    from ..serving import (DistributedServingServer, DriverRegistry,
+                           remote_worker_loop)
+    from ..serving.autoscale import Autoscaler, AutoscaleConfig
+
+    # -- scenario isolation: scrub residue from earlier runs ----------------
+    for src in list(fleet_aggregator.sources()):
+        fleet_aggregator.evict(src, reason="scenario_reset")
+    stale = {labels["worker"] for k in _registry.snapshot()
+             for _, labels in (parse_sample(k),) if "worker" in labels}
+    for prefix in ("profile_step_seconds", "fleet_"):
+        for m in _registry.metrics(prefix):
+            for w in stale:
+                m.remove_matching(worker=w)
+
+    def stepped(df):
+        time.sleep(base_step_s)   # deterministic per-batch service time
+        replies = np.empty(len(df), object)
+        replies[:] = [HTTPResponseData(status_code=200,
+                                       entity=(r.entity or b"").upper())
+                      for r in df["request"]]
+        return df.with_column("reply", replies)
+
+    ten = Tenancy(service, quotas={
+        "search": TenantQuota(tier="gold"),
+        "batch": TenantQuota(tier="best_effort"),
+    })
+    health = FleetHealth(fleet_aggregator, service=service)
+    health.attach_tenancy(ten)
+
+    class _FakePool:
+        """Synthetic capacity counter: the autoscaler's straggler path
+        only needs count/scale_up (real pools are chaos_scenario's
+        business)."""
+
+        def __init__(self, n):
+            self.n = n
+
+        def count(self):
+            return self.n
+
+        def scale_up(self):
+            self.n += 1
+            return f"replacement-{self.n}"
+
+        def scale_down(self):
+            self.n -= 1
+
+    pool = _FakePool(n_workers)
+    auto = Autoscaler(
+        service, pool,
+        AutoscaleConfig(min_workers=n_workers, max_workers=n_workers + 2,
+                        interval=0.05, queue_high=1e9, queue_low=-1.0,
+                        slo_high=1e9, slo_low=-1.0, cooldown=0.0),
+        registry=_registry, tenancy=ten)
+
+    straggler_spans: list = []
+
+    def _sink(sp):
+        if sp.name == "fleet.straggler":
+            straggler_spans.append(sp)
+
+    wids = [f"fleet-w{i}" for i in range(n_workers)]
+    w0 = wids[0]
+    driver = DriverRegistry(heartbeat_timeout=0.75).start()
+    server = DistributedServingServer(
+        service, driver.address, lease_timeout=2.0, reply_timeout=15.0,
+        load_report_interval=0.2).start()
+    stops = [threading.Event() for _ in wids]
+    workers = [threading.Thread(
+        target=remote_worker_loop,
+        args=(driver.address, service, stepped),
+        kwargs={"stop_event": stops[i], "heartbeat_interval": 0.1,
+                "max_batch": 4, "worker_id": wids[i]},
+        daemon=True) for i in range(n_workers)]
+    url = f"http://{server.address[0]}:{server.address[1]}/"
+    pump = ThreadPoolExecutor(max_workers=wave_size)
+    shed_at = wave_size * warmup_waves   # first post-baseline request
+    statuses: list[int] = []
+    sheds: dict = {}
+    seq = [0]
+
+    def send_wave(count, tenant_for=None):
+        futs = []
+        for _ in range(count):
+            i = seq[0]
+            seq[0] += 1
+            tenant = tenant_for or ("batch" if i % 4 == 0 else "search")
+            if i == shed_at:
+                # ONE controlled best-effort shed: slo_burn_rate gets
+                # a visible numerator without the trajectory depending
+                # on quota timing
+                ten.count_shed("batch", "tenant_rate")
+                sheds["batch"] = sheds.get("batch", 0) + 1
+                continue
+            try:
+                ten.try_admit(tenant, "/", 0, 128)
+            except Shed as s:
+                sheds[tenant] = sheds.get(tenant, 0) + 1
+                sheds[s.reason] = sheds.get(s.reason, 0) + 1
+                continue
+            t0 = time.monotonic()
+            futs.append((tenant, t0, pump.submit(
+                send_request,
+                HTTPRequestData(url=url, method="POST", headers={},
+                                entity=f"req-{i}".encode()),
+                timeout=request_timeout_s)))
+        for tenant, t0, f in futs:
+            resp = f.result()
+            statuses.append(resp.status_code)
+            ten.release(tenant)
+            ten.observe_latency(tenant, time.monotonic() - t0)
+
+    ticks_to_flag = None
+    recovered = False
+    recover_waves = 0
+    evicted = False
+    schedule_a: list = []
+    schedule_b: list = []
+    tracer.add_sink(_sink)
+    try:
+        for w in workers:
+            w.start()
+        # phase 1: healthy warmup → baseline tick → verdict must be ok
+        for _ in range(warmup_waves):
+            send_wave(wave_size)
+        status_start, _ = health.healthz_payload()
+        verdict_start = health.verdict()
+        auto.tick()
+        # phase 2: arm the persistent degradation; tick per wave until
+        # the flag flips (detection latency, in waves)
+        with faults(seed, [FaultRule(point="worker.slow", kind="slow",
+                                     match=w0, times=1,
+                                     factor=slow_factor)]) as inj:
+            for t_i in range(max_flag_waves):
+                send_wave(wave_size)
+                health.tick()
+                auto.tick()
+                if ("worker", w0) in health.stragglers.flagged():
+                    ticks_to_flag = t_i + 1
+                    break
+            schedule_a = inj.schedule()
+            status_flag, _ = health.healthz_payload()
+            verdict_flag = health.verdict()
+            auto.tick()
+        # phase 3: kill the flagged worker mid-lease — the real death
+        # path replays its batch, evicts its fleet source, and the
+        # remove_matching sweep clears its series; verdict walks home
+        with faults(seed + 1, [FaultRule(point="worker.death",
+                                         kind="kill", match=w0,
+                                         times=1)]) as inj2:
+            deadline = time.monotonic() + max_recover_s
+            while time.monotonic() < deadline:
+                send_wave(wave_size)
+                recover_waves += 1
+                health.tick()
+                auto.tick()
+                gone = f"worker:{w0}" not in fleet_aggregator.sources()
+                if gone and ("worker", w0) not in \
+                        health.stragglers.flagged():
+                    recovered = True
+                    break
+            schedule_b = inj2.schedule()
+        evicted = f"worker:{w0}" not in fleet_aggregator.sources()
+        # settle: batch-heavy traffic bounds the final burn ratio
+        # (1 shed / >20 admits) well under the degraded threshold
+        for _ in range(2):
+            send_wave(10, tenant_for="batch")
+        status_end, _ = health.healthz_payload()
+        verdict_end = health.verdict()
+    finally:
+        tracer.remove_sink(_sink)
+        for ev in stops:
+            ev.set()
+        for w in workers:
+            w.join(timeout=5)
+        server.stop()
+        driver.stop()
+        pump.shutdown(wait=False)
+        for wid in wids:
+            fleet_aggregator.evict(f"worker:{wid}",
+                                   reason="scenario_end")
+
+    burns = health.burn.latest()
+    gold_burn = max(burns.get("search", {}).values(), default=0.0)
+    be_burn = max(burns.get("batch", {}).values(), default=0.0)
+    replaces = [e for e in auto.event_log()
+                if e.direction == "replace"
+                and e.reason == "straggler flagged"]
+    verdicts = [verdict_start, verdict_flag, verdict_end]
+    return {
+        "seed": seed,
+        "workers": n_workers,
+        "slow_worker": w0,
+        "slow_factor": slow_factor,
+        "offered": seq[0],
+        "answered_200": sum(1 for s in statuses if 200 <= s < 300),
+        "transport_errors": sum(1 for s in statuses if s == 0),
+        "sheds": dict(sheds),
+        "ticks_to_flag": ticks_to_flag,
+        "flagged": bool(ticks_to_flag is not None),
+        "straggler_spans": len(straggler_spans),
+        "verdicts": verdicts,
+        "healthz_statuses": [status_start, status_flag, status_end],
+        "healthz_flipped": bool(verdicts == ["ok", "degraded", "ok"]),
+        "straggler_replaces": len(replaces),
+        "workers_after_replace": pool.count(),
+        "recovered": recovered,
+        "recover_waves": recover_waves,
+        "evicted": evicted,
+        "worker_degraded": any(p == "worker.slow"
+                               for p, *_ in schedule_a),
+        "worker_killed": any(p == "worker.death"
+                             for p, *_ in schedule_b),
+        "gold_burn": gold_burn,
+        "be_burn": be_burn,
+        "page_burn": health.page_burn,
+        "gold_under_page": bool(gold_burn < health.page_burn),
+        "hbm_devices": len(device_memory_stats()),
+        "mem_gauges_present": any(k.startswith("mem_hbm_")
+                                  for k in _registry.snapshot()),
+    }
+
+
 # --------------------------------------------------- whole-pipeline fusion
 def _fusion_pipelines(n_rows: int, width: int, seed: int = 7):
     """The two benchmark pipelines of the whole-pipeline-compilation

@@ -25,7 +25,7 @@ import time
 import numpy as np
 
 from ..parallel.multihost import (DCN_AXIS, ICI_AXIS, feed_process_local,
-                                  this_process)
+                                  fleet_result, this_process)
 
 
 def _mesh(shape):
@@ -254,6 +254,45 @@ def fused_serving(payload: dict) -> dict:
     return {"bit_equal": bit_equal, "digest": digest,
             "p99_ms": round(p99 * 1e3, 3), "requests": reqs,
             "process_count": this_process()[1]}
+
+
+def fleet_telemetry(payload: dict) -> dict:
+    """The fleet-federation acceptance body: every rank produces the
+    telemetry the fleet plane federates — profiled steps
+    (``profile_step_seconds{...,process=<rank>}``), one instrumented
+    cross-host allreduce (``collective_bytes_total``), and the memory
+    profiler's gauges (``mem_hbm_*`` on real accelerators; absent, not
+    raising, on CPU pods) — and ships it home on the result channel via
+    :func:`~..parallel.multihost.fleet_result`. The launcher-side test
+    merges the rank envelopes through ``obs.fleet.ingest_pod_results``
+    and asserts one ``?scope=fleet`` exposition carries both ranks with
+    zero label collisions."""
+    import jax
+    from jax.sharding import PartitionSpec as P
+
+    from ..obs.memory import device_memory_stats
+    from ..obs.profile import step_profiler
+    from ..parallel import compat
+
+    shape = payload.get("mesh") or [2, 4]
+    steps = int(payload.get("steps", 3))
+    rows = int(payload.get("rows", 64))
+    mesh = _mesh(shape)
+    x = np.arange(rows * 4, dtype=np.float32).reshape(rows, 4)
+    gx = feed_process_local(mesh, _my_rows(x))
+    fn = compat.jit(
+        compat.shard_map(_dp_allreduce, mesh=mesh, in_specs=P(DCN_AXIS),
+                         out_specs=P(DCN_AXIS)),
+        name="fleet_allreduce")
+    for _ in range(steps):
+        with step_profiler.step("fleet_step") as h:
+            h.done(fn(gx))
+    _, cnt = this_process()
+    return fleet_result({
+        "process_count": cnt,
+        "hbm_devices": len(device_memory_stats()),
+        "local_devices": len(jax.local_devices()),
+    })
 
 
 def collective_bytes(payload: dict) -> dict:

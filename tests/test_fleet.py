@@ -1,0 +1,763 @@
+"""Fleet telemetry plane (obs/fleet.py + serving wiring, ISSUE 15):
+metric federation, straggler detection, SLO burn-rate health — plus
+the FlightRecorder multi-source ingest contract and the v2/v3
+FeatureLog schema window the cost model accepts."""
+
+import http.client
+import json
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from mmlspark_tpu.obs.export import FlightRecorder, chrome_trace
+from mmlspark_tpu.obs.fleet import (BurnRateMonitor, FleetAggregator,
+                                    FleetHealth, StragglerDetector,
+                                    ingest_pod_results, parse_exposition,
+                                    parse_sample, render_sample)
+from mmlspark_tpu.obs.metrics import MetricsRegistry
+
+
+def _mono(start=1000.0):
+    """A hand-cranked monotonic clock for window tests."""
+    state = {"t": start}
+
+    def clock():
+        return state["t"]
+
+    clock.advance = lambda dt: state.__setitem__("t", state["t"] + dt)
+    return clock
+
+
+def _step_samples(process: str, mean_s: float, n: int = 4) -> dict:
+    return {
+        f'profile_step_seconds_sum{{process="{process}"}}': mean_s * n,
+        f'profile_step_seconds_count{{process="{process}"}}': float(n),
+    }
+
+
+# --------------------------------------------------------- sample parsing
+
+class TestSampleParsing:
+    def test_round_trip_with_escapes(self):
+        reg = MetricsRegistry()
+        reg.counter("fam_total", "h").inc(
+            3, tenant='we"ird\\te\nnant', route="/api")
+        (sample, value), = reg.snapshot().items()
+        name, labels = parse_sample(sample)
+        assert name == "fam_total"
+        assert labels == {"tenant": 'we"ird\\te\nnant', "route": "/api"}
+        assert render_sample(name, labels) == sample
+        assert value == 3.0
+
+    def test_no_labels_and_opaque_forms(self):
+        assert parse_sample("plain_total") == ("plain_total", {})
+        # malformed bodies come back opaque, never raise
+        for bad in ("x{unclosed", 'x{k="v', "x{k=v}", 'x{k="v"extra}'):
+            assert parse_sample(bad) == (bad, {})
+
+    def test_parse_exposition_inverse_of_registry(self):
+        reg = MetricsRegistry()
+        reg.gauge("g_one", "h").set(2.5, a="b")
+        reg.counter("c_one", "h").inc(4)
+        parsed = parse_exposition(reg.exposition())
+        assert parsed['g_one{a="b"}'] == 2.5
+        assert parsed["c_one"] == 4.0
+
+
+# ------------------------------------------------------------- federation
+
+class TestFleetAggregator:
+    def test_two_rank_merge_no_collisions(self):
+        reg = MetricsRegistry()
+        agg = FleetAggregator(reg)
+        agg.ingest_snapshot(_step_samples("ignored", 0.1) | {
+            "profile_steps_total": 4.0}, process=0)
+        agg.ingest_snapshot({
+            'profile_step_seconds_sum{process="1"}': 0.8,
+            "profile_steps_total": 4.0}, process=1)
+        merged = agg.merged_samples()
+        # the bare counter got a process stamp per source: no collision
+        assert merged['profile_steps_total{process="0"}'] == 4.0
+        assert merged['profile_steps_total{process="1"}'] == 4.0
+        # existing labels preserved (setdefault, not overwrite)
+        assert 'profile_step_seconds_sum{process="ignored"}' in merged
+        assert merged['profile_step_seconds_sum{process="1"}'] == 0.8
+
+    def test_last_write_wins_per_source(self):
+        agg = FleetAggregator(MetricsRegistry())
+        agg.ingest_snapshot({"sched_x": 1.0}, worker="w1")
+        agg.ingest_snapshot({"sched_x": 5.0}, worker="w1")
+        assert agg.merged_samples()['sched_x{worker="w1"}'] == 5.0
+        assert len(agg.sources()) == 1
+
+    def test_staleness_and_source_gauges(self):
+        reg = MetricsRegistry()
+        clock = _mono()
+        agg = FleetAggregator(reg, clock=clock)
+        agg.ingest_snapshot({"sched_x": 1.0}, process=0, channel="pod")
+        clock.advance(7.5)
+        agg.merged_samples()
+        snap = reg.snapshot()
+        assert snap['fleet_source_staleness_seconds{source="proc:0"}'] \
+            == 7.5
+        assert snap['fleet_sources{channel="pod"}'] == 1.0
+        assert snap['fleet_merges_total{channel="pod"}'] == 1.0
+
+    def test_eviction_on_death_scrubs_registry(self):
+        reg = MetricsRegistry()
+        agg = FleetAggregator(reg)
+        agg.ingest_snapshot({"sched_x": 1.0}, worker="w9")
+        reg.gauge("fleet_straggler", "h").set(1.0, worker="w9")
+        agg.merged_samples()
+        assert agg.evict_worker("w9") is True
+        assert agg.evict_worker("w9") is False
+        assert agg.merged_samples() == {}
+        snap = reg.snapshot()
+        assert not any("w9" in k for k in snap
+                       if k.startswith(("fleet_straggler",
+                                        "fleet_source_staleness")))
+        assert snap['fleet_sources_evicted_total{reason="death"}'] == 1.0
+
+    def test_bounded_sources_evict_oldest(self):
+        reg = MetricsRegistry()
+        clock = _mono()
+        agg = FleetAggregator(reg, max_sources=2, clock=clock)
+        for i in range(3):
+            agg.ingest_snapshot({"sched_x": float(i)}, process=i)
+            clock.advance(1.0)
+        srcs = agg.sources()
+        assert set(srcs) == {"proc:1", "proc:2"}
+        assert reg.snapshot()[
+            'fleet_sources_evicted_total{reason="bound"}'] == 1.0
+
+    def test_pull_path_ingest_exposition(self):
+        peer = MetricsRegistry()
+        peer.counter("serving_requests_total", "h").inc(3, route="/api")
+        agg = FleetAggregator(MetricsRegistry())
+        agg.ingest_exposition(peer.exposition(), process=4,
+                              channel="pull")
+        merged = agg.merged_samples()
+        assert merged[
+            'serving_requests_total{process="4",route="/api"}'] == 3.0
+
+    def test_exposition_appends_remote_lines(self):
+        reg = MetricsRegistry()
+        reg.gauge("sched_local", "h").set(1.0)
+        agg = FleetAggregator(reg)
+        agg.ingest_snapshot({"sched_remote": 2.0}, process=1)
+        text = agg.exposition()
+        assert "# HELP sched_local" in text
+        assert 'sched_remote{process="1"} 2' in text
+        # remote lines parse back (the peer-of-peer pull path)
+        assert parse_exposition(text)['sched_remote{process="1"}'] == 2.0
+
+    def test_ingest_pod_results(self):
+        agg = FleetAggregator(MetricsRegistry())
+        results = [
+            {"process": 0, "snapshot": {"sched_x": 1.0}},
+            {"process": 1, "snapshot": {"sched_x": 2.0}},
+            {"no": "snapshot"},
+        ]
+        assert ingest_pod_results(results, agg) == 2
+        merged = agg.merged_samples()
+        assert merged['sched_x{process="0"}'] == 1.0
+        assert merged['sched_x{process="1"}'] == 2.0
+
+
+# ---------------------------------------------------- straggler detection
+
+class TestStragglerDetector:
+    def _det(self, reg=None):
+        reg = reg or MetricsRegistry()
+        agg = FleetAggregator(reg)
+        return StragglerDetector(agg, registry=reg), agg, reg
+
+    def test_mad_flags_outlier_and_recovers(self):
+        det, agg, reg = self._det()
+        for p, mean in (("0", 0.10), ("1", 0.11), ("2", 0.09),
+                        ("3", 0.50)):
+            agg.ingest_snapshot(_step_samples(p, mean), process=p)
+        flagged = det.tick()
+        assert flagged == {("process", "3")}
+        assert reg.snapshot()[
+            'fleet_straggler{process="3"}'] == 1.0
+        assert reg.snapshot()[
+            'fleet_straggler{process="0"}'] == 0.0
+        # recovery: the rank's mean falls back to the pack
+        agg.ingest_snapshot(_step_samples("3", 0.1), process="3")
+        assert det.tick() == set()
+        assert det.flagged() == frozenset()
+        assert reg.snapshot()['fleet_straggler{process="3"}'] == 0.0
+
+    def test_uniform_fleet_never_pages(self):
+        det, agg, _ = self._det()
+        # microscopic jitter around a common mean: the MAD floor
+        # (mad_floor_frac * median) must absorb it
+        for p, mean in (("0", 0.1000), ("1", 0.1001), ("2", 0.0999),
+                        ("3", 0.1002)):
+            agg.ingest_snapshot(_step_samples(p, mean), process=p)
+        assert det.tick() == set()
+
+    def test_two_rank_ratio_test(self):
+        det, agg, _ = self._det()
+        agg.ingest_snapshot(_step_samples("0", 0.1), process="0")
+        agg.ingest_snapshot(_step_samples("1", 0.25), process="1")
+        assert det.tick() == {("process", "1")}
+        agg.ingest_snapshot(_step_samples("1", 0.15), process="1")
+        assert det.tick() == set()
+
+    def test_worker_and_process_groups_independent(self):
+        """A slow pod rank is never compared against serving threads:
+        the worker-labelled and process-labelled populations detect
+        separately."""
+        det, agg, _ = self._det()
+        for w, mean in (("w0", 0.01), ("w1", 0.011), ("w2", 0.0105)):
+            agg.ingest_snapshot({
+                f'profile_step_seconds_sum{{worker="{w}"}}': mean * 4,
+                f'profile_step_seconds_count{{worker="{w}"}}': 4.0,
+            }, worker=w)
+        for p, mean in (("0", 0.10), ("1", 0.11), ("2", 0.09),
+                        ("3", 0.55)):
+            agg.ingest_snapshot(_step_samples(p, mean), process=p)
+        flagged = det.tick()
+        assert flagged == {("process", "3")}
+        assert det.flagged_workers() == frozenset()
+
+    def test_flagged_workers_feed_routing(self):
+        det, agg, _ = self._det()
+        agg.ingest_snapshot({
+            'profile_step_seconds_sum{worker="wa"}': 0.4,
+            'profile_step_seconds_count{worker="wa"}': 4.0,
+            'profile_step_seconds_sum{worker="wb"}': 4.0,
+            'profile_step_seconds_count{worker="wb"}': 4.0,
+        }, channel="heartbeat")
+        det.tick()
+        assert det.flagged_workers() == frozenset({"wb"})
+
+    def test_gone_rank_gauges_removed(self):
+        det, agg, reg = self._det()
+        for p, mean in (("0", 0.1), ("1", 0.11), ("2", 0.5)):
+            agg.ingest_snapshot(_step_samples(p, mean), process=p)
+        det.tick()
+        agg.evict("proc:2")
+        det.tick()
+        assert not any('process="2"' in k for k in reg.snapshot()
+                       if k.startswith("fleet_straggler"))
+
+    def test_straggler_span_emitted_on_flip(self):
+        from mmlspark_tpu.obs.tracing import tracer
+        det, agg, _ = self._det()
+        seen = []
+        sink = seen.append
+        tracer.add_sink(sink)
+        try:
+            for p, mean in (("0", 0.1), ("1", 0.11), ("2", 0.09),
+                            ("3", 0.6)):
+                agg.ingest_snapshot(_step_samples(p, mean), process=p)
+            det.tick()
+            det.tick()   # still flagged: no second span (flip only)
+        finally:
+            tracer.remove_sink(sink)
+        spans = [s for s in seen if s.name == "fleet.straggler"]
+        assert len(spans) == 1
+        assert spans[0].attrs.get("process") == "3"
+
+
+# ------------------------------------------------------- SLO burn rate
+
+class TestBurnRateMonitor:
+    def _samples(self, adm, shed, tenant="gold"):
+        return {
+            f'sched_tenant_admitted_total{{tenant="{tenant}"}}':
+                float(adm),
+            f'sched_tenant_shed_total{{tenant="{tenant}"}}': float(shed),
+        }
+
+    def test_no_traffic_burns_zero(self):
+        mon = BurnRateMonitor(MetricsRegistry(), clock=_mono())
+        burns = mon.tick(self._samples(0, 0))
+        assert burns["gold"] == {"fast": 0.0, "slow": 0.0}
+
+    def test_burn_is_shed_rate_over_budget(self):
+        reg = MetricsRegistry()
+        clock = _mono()
+        mon = BurnRateMonitor(
+            reg, clock=clock, budget_for=lambda t: 0.01,
+            windows={"fast": 30.0, "slow": 180.0})
+        mon.tick(self._samples(0, 0))
+        clock.advance(10.0)
+        burns = mon.tick(self._samples(90, 10))
+        # 10% shed over a 1% budget = burn 10x, both windows
+        assert burns["gold"]["fast"] == pytest.approx(10.0)
+        assert burns["gold"]["slow"] == pytest.approx(10.0)
+        assert reg.snapshot()[
+            'slo_burn_rate{tenant="gold",window="fast"}'] == \
+            pytest.approx(10.0)
+
+    def test_fast_window_recovers_before_slow(self):
+        clock = _mono()
+        mon = BurnRateMonitor(
+            MetricsRegistry(), clock=clock, budget_for=lambda t: 0.01,
+            windows={"fast": 30.0, "slow": 180.0})
+        mon.tick(self._samples(0, 0))
+        clock.advance(10.0)
+        mon.tick(self._samples(50, 50))      # incident
+        clock.advance(40.0)                  # fast window rolls past it
+        burns = mon.tick(self._samples(150, 50))  # clean traffic since
+        assert burns["gold"]["fast"] == 0.0
+        assert burns["gold"]["slow"] > 0.0   # slow window still remembers
+
+    def test_tenancy_budget_wiring(self):
+        from mmlspark_tpu.sched import Tenancy, TenantQuota
+        from mmlspark_tpu.sched.tenancy import TIER_ERROR_BUDGETS
+
+        ten = Tenancy("svc", quotas={
+            "acme": TenantQuota(tier="gold"),
+            "free": TenantQuota(tier="best_effort")},
+            registry=MetricsRegistry())
+        mon = BurnRateMonitor(MetricsRegistry(), clock=_mono(),
+                              budget_for=ten.error_budget_for)
+        assert mon.budget("acme") == TIER_ERROR_BUDGETS["gold"] == 0.001
+        assert mon.budget("free") == TIER_ERROR_BUDGETS["best_effort"]
+        # unknown tenant: the default budget, never a KeyError
+        assert mon.budget("stranger") > 0
+
+    def test_history_is_pruned(self):
+        clock = _mono()
+        mon = BurnRateMonitor(MetricsRegistry(), clock=clock,
+                              windows={"fast": 5.0, "slow": 10.0})
+        for i in range(100):
+            mon.tick(self._samples(i, 0))
+            clock.advance(1.0)
+        assert len(mon._history) <= 20
+
+
+# ------------------------------------------------------------ health
+
+class TestFleetHealth:
+    def _health(self, **kw):
+        reg = MetricsRegistry()
+        agg = FleetAggregator(reg)
+        return FleetHealth(agg, registry=reg, **kw), agg, reg
+
+    def test_ok_when_quiet(self):
+        health, _, reg = self._health()
+        assert health.tick() == "ok"
+        assert reg.snapshot()["fleet_health"] == 0.0
+
+    def test_straggler_degrades(self):
+        health, agg, reg = self._health()
+        for p, mean in (("0", 0.1), ("1", 0.11), ("2", 0.09),
+                        ("3", 0.6)):
+            agg.ingest_snapshot(_step_samples(p, mean), process=p)
+        assert health.tick() == "degraded"
+        assert reg.snapshot()["fleet_health"] == 1.0
+        status, body = health.healthz_payload()
+        assert status == 200          # degraded still answers 200
+        payload = json.loads(body)
+        assert payload["status"] == "degraded"
+        assert payload["stragglers"] == ["process:3"]
+
+    def test_page_burn_goes_critical_503(self):
+        health, agg, _ = self._health()
+        clock = _mono()
+        health.burn._clock = clock
+        health.burn.set_budget_for(lambda t: 0.001)
+        agg.ingest_snapshot({
+            'sched_tenant_admitted_total{tenant="gold"}': 0.0,
+            'sched_tenant_shed_total{tenant="gold"}': 0.0}, process=0)
+        health.tick()
+        clock.advance(10.0)
+        agg.ingest_snapshot({
+            'sched_tenant_admitted_total{tenant="gold"}': 80.0,
+            'sched_tenant_shed_total{tenant="gold"}': 20.0}, process=0)
+        assert health.tick() == "critical"
+        status, body = health.healthz_payload()
+        assert status == 503
+        assert json.loads(body)["status"] == "critical"
+
+    def test_debug_payload_shape(self):
+        health, agg, _ = self._health()
+        agg.ingest_snapshot({"sched_x": 1.0}, worker="w1",
+                            channel="heartbeat")
+        payload = json.loads(health.debug_payload())
+        assert payload["status"] == "ok"
+        assert payload["sources"]["worker:w1"]["channel"] == "heartbeat"
+        assert "burn" in payload and "stragglers" in payload
+
+
+# ------------------------------------------------- served fleet routes
+
+class TestServedRoutes:
+    """The fleet routes ride the shared route table: the literal
+    ``?scope=fleet`` key is tried before the stripped path on both
+    fronts."""
+
+    def _get(self, addr, path):
+        conn = http.client.HTTPConnection(*addr, timeout=10)
+        try:
+            conn.request("GET", path)
+            resp = conn.getresponse()
+            return resp.status, resp.read()
+        finally:
+            conn.close()
+
+    @pytest.fixture
+    def query(self):
+        from mmlspark_tpu.io.http.schema import HTTPResponseData
+        from mmlspark_tpu.serving import serving_query
+
+        def pipeline(df):
+            replies = np.empty(len(df), object)
+            replies[:] = [HTTPResponseData(status_code=200, entity=b"ok")
+                          for _ in df["request"]]
+            return df.with_column("reply", replies)
+
+        q = serving_query("fleetroutes", pipeline, backend="python")
+        yield q
+        q.stop()
+
+    def test_scope_fleet_carries_remote_samples(self, query):
+        from mmlspark_tpu.obs.fleet import fleet_aggregator
+        fleet_aggregator.ingest_snapshot(
+            {"sched_fleet_route_probe": 42.0}, process="77",
+            channel="test")
+        try:
+            status, body = self._get(query.server.address,
+                                     "/metrics?scope=fleet")
+            assert status == 200
+            text = body.decode()
+            assert 'sched_fleet_route_probe{process="77"} 42' in text
+            # plain /metrics stays local: no federated sample
+            status, body = self._get(query.server.address, "/metrics")
+            assert status == 200
+            assert "sched_fleet_route_probe" not in body.decode()
+        finally:
+            fleet_aggregator.evict("proc:77", reason="test")
+
+    def test_debug_fleet_and_healthz(self, query):
+        status, body = self._get(query.server.address, "/debug/fleet")
+        assert status == 200
+        payload = json.loads(body)
+        assert payload["status"] in ("ok", "degraded", "critical")
+        status, body = self._get(query.server.address, "/healthz")
+        assert status in (200, 503)
+        assert json.loads(body)["status"] in ("ok", "degraded",
+                                              "critical")
+
+
+# ------------------------------------------- mesh heartbeat federation
+
+class TestMeshFleetChannel:
+    def test_worker_heartbeat_pushes_fleet_source(self):
+        """A lease-pulling worker thread heartbeats its samples over
+        ``__fleet__``; the ingest merges it as a worker-keyed source."""
+        from mmlspark_tpu.io.http.schema import HTTPResponseData
+        from mmlspark_tpu.obs.fleet import fleet_aggregator
+        from mmlspark_tpu.serving import (DistributedServingServer,
+                                          DriverRegistry,
+                                          remote_worker_loop)
+
+        driver = DriverRegistry().start()
+        server = DistributedServingServer(
+            "fleetmesh", driver.address, worker_id="fm-ingest").start()
+        stop = threading.Event()
+
+        def transform(df):
+            replies = np.empty(len(df), object)
+            replies[:] = [HTTPResponseData(status_code=200, entity=b"x")
+                          for _ in df["request"]]
+            return df.with_column("reply", replies)
+
+        w = threading.Thread(
+            target=remote_worker_loop,
+            args=(driver.address, "fleetmesh", transform),
+            kwargs={"stop_event": stop, "worker_id": "fm-w0",
+                    "heartbeat_interval": 0.05}, daemon=True)
+        w.start()
+        try:
+            deadline = time.monotonic() + 10.0
+            while time.monotonic() < deadline:
+                if "worker:fm-w0" in fleet_aggregator.sources():
+                    break
+                time.sleep(0.02)
+            src = fleet_aggregator.sources()["worker:fm-w0"]
+            assert src["channel"] == "heartbeat"
+            assert src["worker"] == "fm-w0"
+        finally:
+            stop.set()
+            w.join(timeout=5)
+            server.stop()
+            driver.stop()
+            fleet_aggregator.evict_worker("fm-w0")
+
+    def test_pick_least_loaded_avoids_flagged(self):
+        from mmlspark_tpu.serving.distributed import (ServiceInfo,
+                                                      pick_least_loaded)
+        infos = [
+            ServiceInfo("svc", "w1", "h", 1, queue_depth=0),
+            ServiceInfo("svc", "w2", "h", 1, queue_depth=5),
+        ]
+        # unflagged: least-loaded wins
+        assert pick_least_loaded(infos, avoid=frozenset()).worker_id \
+            == "w1"
+        # flagged: the straggler loses even with the shorter queue
+        assert pick_least_loaded(
+            infos, avoid=frozenset({"w1"})).worker_id == "w2"
+        # every candidate flagged: still answers (degraded beats down)
+        assert pick_least_loaded(
+            infos, avoid=frozenset({"w1", "w2"})).worker_id == "w1"
+
+
+# ------------------------------------------------- autoscaler coupling
+
+class TestAutoscalerStragglerReplace:
+    def _auto(self, pool, reg=None):
+        from mmlspark_tpu.serving.autoscale import (AutoscaleConfig,
+                                                    Autoscaler)
+        cfg = AutoscaleConfig(min_workers=1, max_workers=4, up_stable=2,
+                              down_stable=2, cooldown=0.1)
+        a = Autoscaler("fleet-as", pool, cfg,
+                       registry=reg or MetricsRegistry())
+        a.ensure_min()
+        return a
+
+    def test_rising_edge_replaces_once(self):
+        from mmlspark_tpu.serving.autoscale import AutoscaleSignals as S
+
+        class Pool:
+            n = 1
+
+            def count(self):
+                return self.n
+
+            def scale_up(self):
+                self.n += 1
+                return f"w{self.n}"
+
+            def scale_down(self):
+                self.n -= 1
+                return "w"
+
+        pool = Pool()
+        a = self._auto(pool)
+        assert a.tick(S(stragglers=1)) == "replace"
+        assert pool.n == 2
+        # level-triggered would thrash: same flag count holds
+        assert a.tick(S(stragglers=1)) != "replace"
+        # recovery then a NEW flag: replace again
+        a.tick(S(stragglers=0))
+        time.sleep(0.12)   # clear cooldown for an unambiguous read
+        assert a.tick(S(stragglers=1)) == "replace"
+        events = [e for e in a.event_log() if e.direction == "replace"]
+        assert len(events) == 2
+        assert all(e.reason == "straggler flagged" for e in events)
+
+    def test_read_signals_counts_flagged_ranks(self):
+        reg = MetricsRegistry()
+        reg.gauge("fleet_straggler", "h").set(1.0, worker="w1")
+        reg.gauge("fleet_straggler", "h").set(0.0, worker="w2")
+        reg.gauge("fleet_straggler", "h").set(1.0, process="3")
+        a = self._auto(
+            type("P", (), {"count": lambda s: 1,
+                           "scale_up": lambda s: "w",
+                           "scale_down": lambda s: None})(), reg)
+        assert a.read_signals().stragglers == 2
+
+
+# -------------------------------------- flight recorder multi-source
+
+def _span(rank: int, trace: str, sid: str, name: str = "work") -> dict:
+    return {"traceId": trace, "spanId": sid, "parentId": None,
+            "name": name, "seconds": 0.01, "startWall": 1.0 + rank,
+            "proc": f"rank{rank}", "attrs": {}}
+
+
+class TestFlightRecorderMultiSource:
+    def test_concurrent_ingest_dedups_span_ids(self):
+        fr = FlightRecorder(registry=MetricsRegistry())
+        n_ranks, per_rank = 6, 40
+
+        def rank(i):
+            # every rank re-sends the SAME span ids for a shared trace
+            # (heartbeat + reply both carry them): dedup must hold
+            # under interleaving
+            for j in range(per_rank):
+                fr.ingest([_span(i, "t-shared", f"s{j % 10}")])
+
+        threads = [threading.Thread(target=rank, args=(i,))
+                   for i in range(n_ranks)]
+        [t.start() for t in threads]
+        [t.join() for t in threads]
+        fr.note_request("t-shared", 1.0, status=500)
+        tree, = fr.trees()
+        ids = [s["spanId"] for s in tree["spans"]]
+        assert sorted(ids) == sorted(set(ids))
+        assert len(ids) == 10
+
+    def test_pending_bounded_under_flood(self):
+        fr = FlightRecorder(max_pending=32, registry=MetricsRegistry())
+        for i in range(500):
+            fr.ingest([_span(i % 4, f"t{i}", "s0")])
+        assert len(fr._pending) <= 32
+
+    def test_chrome_trace_distinct_pids_per_rank(self):
+        spans = [_span(r, f"t{r}", f"s{r}") for r in range(3)]
+        trace = chrome_trace(spans)
+        pids = {e["pid"] for e in trace["traceEvents"]
+                if e["ph"] == "X"}
+        assert len(pids) == 3
+        names = {e["args"]["name"] for e in trace["traceEvents"]
+                 if e.get("name") == "process_name"}
+        assert names == {"proc rank0", "proc rank1", "proc rank2"}
+
+    def test_pending_spans_drain(self):
+        fr = FlightRecorder(registry=MetricsRegistry())
+        fr.ingest([_span(0, "t1", "s1"), _span(0, "t1", "s2"),
+                   _span(0, "t2", "s3")])
+        peek = fr.pending_spans()
+        assert len(peek) == 3
+        assert len(fr.pending_spans()) == 3     # peek did not consume
+        drained = fr.pending_spans(drain=True)
+        assert len(drained) == 3
+        assert fr.pending_spans() == []
+        # bounded drain leaves the remainder pending
+        fr.ingest([_span(0, "t3", f"s{i}") for i in range(5)])
+        assert len(fr.pending_spans(drain=True, max_spans=2)) == 2
+        assert len(fr.pending_spans()) == 3
+
+    def test_mark_incomplete_closes_worker_death_trace(self):
+        fr = FlightRecorder(registry=MetricsRegistry())
+        fr.ingest([_span(1, "t-dead", "s1"), _span(1, "t-dead", "s2")])
+        assert fr.mark_incomplete("t-dead", reason="lease expired") \
+            is True
+        assert fr.mark_incomplete("t-unknown") is False
+        tree, = fr.trees()
+        assert tree["incomplete"] is True
+        assert tree["error"] is True
+        assert len(tree["spans"]) == 2
+        # the replayed request completes elsewhere: outcome recorded,
+        # incomplete flag kept
+        fr.note_request("t-dead", 0.5, status=200)
+        tree, = fr.trees()
+        assert tree["seconds"] == 0.5 and tree["status"] == 200
+        assert tree["incomplete"] is True
+
+    def test_thread_worker_payload_never_drains_shared_recorder(self):
+        """Regression: ``own_process`` is decided once at worker-loop
+        start. A thread worker whose co-resident servers already
+        stopped must keep spans=[] on its fleet pushes — re-evaluating
+        the guard per heartbeat would let it drain the process-wide
+        recorder and strip OTHER servers' in-flight traces (seen as
+        trace trees missing their ingest-side spans)."""
+        from mmlspark_tpu.obs.export import flight_recorder
+        from mmlspark_tpu.serving.distributed import _worker_fleet_payload
+        flight_recorder.pending_spans(drain=True)  # isolate
+        try:
+            flight_recorder.ingest([_span(0, "t-live", "s-live")])
+            pl = _worker_fleet_payload("w-thread", "", False)
+            assert pl["spans"] == []
+            assert len(flight_recorder.pending_spans()) == 1
+            pl = _worker_fleet_payload("w-own", "", True)
+            assert len(pl["spans"]) == 1
+            assert flight_recorder.pending_spans() == []
+        finally:
+            flight_recorder.pending_spans(drain=True)
+
+    def test_lease_replay_marks_ingest_side_trace(self):
+        """serving.distributed._monitor_leases calls mark_incomplete
+        before replaying a dead worker's lease — simulate that contract
+        end to end on one recorder."""
+        fr = FlightRecorder(registry=MetricsRegistry())
+        # ingest-side queue spans landed when the request was admitted
+        fr.ingest([_span(0, "t-req", "q1", name="serving.queue")])
+        # worker died: its lease expires, replay marks then requeues
+        assert fr.mark_incomplete("t-req", "lease expired: worker lost")
+        from mmlspark_tpu.obs.export import debug_trace_payload
+        payload = json.loads(debug_trace_payload(fr))
+        (t,) = [t for t in payload["traces"]
+                if t["trace_id"] == "t-req"]
+        assert t["incomplete"] is True
+
+
+# ------------------------------------------- cost-model schema window
+
+class TestCostModelSchemaWindow:
+    def _rows(self, version, n=40):
+        rng = np.random.default_rng(0)
+        rows = []
+        for _ in range(n):
+            b = int(rng.integers(1, 32))
+            rows.append({
+                "schema_version": version, "service": "s", "route": "",
+                "batch": b, "bucket": b, "entity_bytes": 1024,
+                "queue_depth": 1, "execute_ms": 2.0 * b + 1.0,
+            })
+        return rows
+
+    def test_v2_and_v3_rows_both_fit(self):
+        from mmlspark_tpu.obs.profile import FEATURE_SCHEMA_VERSION
+        from mmlspark_tpu.perf.costmodel import (
+            ACCEPTED_SCHEMA_VERSIONS, CostModel)
+
+        assert FEATURE_SCHEMA_VERSION == 3
+        assert ACCEPTED_SCHEMA_VERSIONS == {2, 3}
+        reg = MetricsRegistry()
+        model = CostModel(min_rows=16, registry=reg)
+        used = model.fit(self._rows(2, 20) + self._rows(3, 20))
+        assert used == 40
+        assert reg.snapshot().get(
+            'sched_costmodel_skipped_rows_total{reason="schema"}') \
+            is None
+
+    def test_v1_rows_still_skip_loudly(self):
+        from mmlspark_tpu.perf.costmodel import CostModel
+
+        reg = MetricsRegistry()
+        model = CostModel(min_rows=16, registry=reg)
+        model.fit(self._rows(1, 10) + self._rows(3, 40))
+        snap = reg.snapshot()
+        skipped = [v for k, v in snap.items()
+                   if "skipped" in k and 'reason="schema"' in k]
+        assert skipped == [10.0]
+
+    def test_feature_rows_stamp_process(self):
+        from mmlspark_tpu.obs.profile import FeatureLog
+        log = FeatureLog(maxlen=4, registry=MetricsRegistry())
+        log.record(service="s", batch=2)
+        row = log.snapshot()[-1]
+        assert row["schema_version"] == 3
+        assert "process" in row          # None single-process, a rank
+        assert row["process"] is None    # index string on a pod
+
+
+# --------------------------------------------------- fleet chaos acceptance
+class TestFleetChaosScenario:
+    def test_straggler_flag_replace_and_healthz_trajectory(self):
+        """ISSUE 15 acceptance: an injected ``worker.slow`` rank is
+        flagged by ``fleet_straggler`` within bounded ticks, the
+        autoscaler records a ``replace`` event sourced from the
+        straggler signal, and ``GET /healthz`` flips ok→degraded→ok
+        with gold burn-rate below the page threshold. Recovery rides
+        the REAL death path: the flagged worker is killed mid-lease,
+        its batch replays to survivors, and its fleet source (plus the
+        remove_matching series sweep) is evicted."""
+        from mmlspark_tpu.testing.benchmarks import fleet_chaos_scenario
+        r = fleet_chaos_scenario(seed=31)
+        assert r["flagged"], r
+        assert r["ticks_to_flag"] <= 40, r
+        assert r["straggler_spans"] >= 1, r
+        assert r["verdicts"] == ["ok", "degraded", "ok"], r
+        # degraded still answers 200 — only critical is 503
+        assert r["healthz_statuses"] == [200, 200, 200], r
+        assert r["straggler_replaces"] == 1, r
+        assert r["workers_after_replace"] == r["workers"] + 1, r
+        assert r["worker_degraded"] and r["worker_killed"], r
+        assert r["recovered"] and r["evicted"], r
+        assert r["gold_under_page"], r
+        assert r["gold_burn"] == 0.0, r
+        assert r["transport_errors"] == 0, r
+        # CPU fallback: no HBM devices -> mem gauges absent, not zero
+        assert r["hbm_devices"] == 0 and not r["mem_gauges_present"], r

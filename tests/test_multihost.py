@@ -332,6 +332,42 @@ class TestTwoProcessPod:
         assert all(r["bit_equal"] for r in pod + solo)
         assert len({r["digest"] for r in pod + solo}) == 1
 
+    def test_fleet_telemetry_federates_both_ranks(self):
+        """The fleet-plane acceptance: one merged ``?scope=fleet``
+        exposition carries BOTH ranks' step-profile and collective-byte
+        series (process-labelled, zero collisions); on the CPU pod the
+        documented mem_hbm_* fallback is ABSENT gauges, never a
+        raise."""
+        from mmlspark_tpu.obs.fleet import (FleetAggregator,
+                                            ingest_pod_results,
+                                            parse_sample)
+        results = multihost.launch_pod(
+            f"{self.SCEN}:fleet_telemetry", num_processes=2,
+            local_devices=4, args={"mesh": [2, 4], "steps": 3,
+                                   "rows": 64},
+            timeout=240, extra_path=REPO)
+        from mmlspark_tpu.obs.metrics import MetricsRegistry
+        agg = FleetAggregator(MetricsRegistry())
+        assert ingest_pod_results(results, agg) == 2
+        merged = agg.merged_samples()
+        for fam in ("profile_step_seconds_count",
+                    "collective_bytes_total"):
+            procs = {parse_sample(k)[1].get("process")
+                     for k in merged if parse_sample(k)[0] == fam}
+            assert {"0", "1"} <= procs, (fam, sorted(merged))
+        # zero cross-rank collisions: every federated sample names
+        # exactly one rank (the dict-keyed merge cannot alias two)
+        assert all(parse_sample(k)[1].get("process") in {"0", "1"}
+                   for k in merged)
+        # CPU pod: memory_stats() reports nothing → gauges absent
+        for r in results:
+            assert r["hbm_devices"] == 0
+            assert not any(k.startswith("mem_hbm_")
+                           for k in r["snapshot"])
+        text = agg.exposition()  # the /metrics?scope=fleet body
+        assert 'profile_step_seconds_count{' in text
+        assert 'process="0"' in text and 'process="1"' in text
+
     def test_collective_bytes_carry_process_label(self):
         results = multihost.launch_pod(
             f"{self.SCEN}:collective_bytes", num_processes=2,

@@ -1,0 +1,151 @@
+"""HBM memory profiler (obs/memory.py): always-on gauges, watermark
+deltas, lifecycle events — and the degradation contract (no JAX / no
+HBM → absent gauges, never an exception)."""
+
+import os
+import subprocess
+import sys
+from types import SimpleNamespace
+
+import pytest
+
+from mmlspark_tpu.obs import memory as memmod
+from mmlspark_tpu.obs.memory import MemoryProfiler, device_memory_stats
+from mmlspark_tpu.obs.metrics import MetricsRegistry
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+FAKE_STATS = [
+    {"device": "0", "bytes_in_use": 100, "peak_bytes_in_use": 150,
+     "bytes_limit": 1000},
+    {"device": "1", "bytes_in_use": 50, "peak_bytes_in_use": 60,
+     "bytes_limit": 1000},
+]
+
+
+@pytest.fixture
+def prof(monkeypatch):
+    reg = MetricsRegistry()
+    p = MemoryProfiler(registry=reg)
+    monkeypatch.setattr(memmod, "device_memory_stats",
+                        lambda: [dict(r) for r in FAKE_STATS])
+    return p, reg
+
+
+class TestDegradation:
+    def test_no_jax_import_returns_empty_never_raises(self):
+        """The documented contract: a jax-free process scrapes ABSENT
+        mem gauges, not zeros, not a traceback (CI smoke mirrors
+        this)."""
+        out = subprocess.run(
+            [sys.executable, "-c",
+             "import sys; sys.modules['jax'] = None\n"
+             "from mmlspark_tpu.obs.memory import (MemoryProfiler,\n"
+             "    device_memory_stats, memory_profiler)\n"
+             "assert device_memory_stats() == []\n"
+             "assert memory_profiler.update() == []\n"
+             "assert memory_profiler.watermark() is None\n"
+             "assert memory_profiler.note_event('boot') is None\n"
+             "from mmlspark_tpu.obs import registry\n"
+             "snap = registry.snapshot()\n"
+             "assert not any(k.startswith('mem_hbm_') for k in snap)\n"
+             "print('OK')"],
+            capture_output=True, text=True, cwd=REPO,
+            env={**os.environ, "PYTHONPATH": REPO})
+        assert out.returncode == 0, out.stdout + out.stderr
+        assert out.stdout.strip() == "OK"
+
+    def test_cpu_devices_without_memory_stats_skipped(self, monkeypatch):
+        """Devices answering None/{} (CPU) contribute nothing; a
+        half-reporting runtime still yields its known keys."""
+        fakes = [SimpleNamespace(id=0, memory_stats=lambda: None),
+                 SimpleNamespace(id=1, memory_stats=lambda: {}),
+                 SimpleNamespace(id=2,
+                                 memory_stats=lambda: {"bytes_in_use": 7})]
+        monkeypatch.setattr(memmod, "_live_devices", lambda: fakes)
+        stats = device_memory_stats()
+        assert stats == [{"device": "2", "bytes_in_use": 7}]
+
+    def test_raising_memory_stats_tolerated(self, monkeypatch):
+        def boom():
+            raise RuntimeError("runtime drift")
+
+        fakes = [SimpleNamespace(id=0, memory_stats=boom)]
+        monkeypatch.setattr(memmod, "_live_devices", lambda: fakes)
+        assert device_memory_stats() == []
+
+
+class TestMemoryProfiler:
+    def test_update_sets_per_device_gauges(self, prof):
+        p, reg = prof
+        stats = p.update()
+        assert len(stats) == 2
+        snap = reg.snapshot()
+        assert snap['mem_hbm_bytes_in_use{device="0"}'] == 100
+        assert snap['mem_hbm_peak_bytes{device="0"}'] == 150
+        assert snap['mem_hbm_limit_bytes{device="1"}'] == 1000
+        assert snap['mem_hbm_bytes_in_use{device="1"}'] == 50
+
+    def test_gone_device_swept(self, prof, monkeypatch):
+        p, reg = prof
+        p.update()
+        monkeypatch.setattr(memmod, "device_memory_stats",
+                            lambda: [dict(FAKE_STATS[0])])
+        p.update()
+        snap = reg.snapshot()
+        assert 'mem_hbm_bytes_in_use{device="0"}' in snap
+        assert not any('device="1"' in k for k in snap)
+
+    def test_watermark_sums_live_bytes(self, prof):
+        p, _ = prof
+        assert p.watermark() == 150
+
+    def test_segment_delta_none_safe(self, prof):
+        p, reg = prof
+        assert p.segment_delta("stage0", None, 5) is None
+        assert p.segment_delta("stage0", 5, None) is None
+        assert not any(k.startswith("mem_segment_delta_bytes")
+                       for k in reg.snapshot())
+        assert p.segment_delta("stage0", 100, 164) == 64
+        assert reg.snapshot()[
+            'mem_segment_delta_bytes{stage="stage0"}'] == 64
+
+    def test_note_event_stamps_watermark(self, prof):
+        p, reg = prof
+        assert p.note_event("aot_warm") == 150
+        assert reg.snapshot()[
+            'mem_event_watermark_bytes{event="aot_warm"}'] == 150
+
+
+class TestHooks:
+    def test_step_profiler_records_segment_delta(self, monkeypatch):
+        """StepProfiler brackets every step with watermark() and lands
+        the delta in mem_segment_delta_bytes{stage=...} — the
+        per-FusedSegment live-buffer hook."""
+        from mmlspark_tpu.obs import registry as global_reg
+        from mmlspark_tpu.obs.memory import memory_profiler
+        from mmlspark_tpu.obs.profile import step_profiler
+
+        marks = iter([1000, 1256])
+        monkeypatch.setattr(memory_profiler, "watermark",
+                            lambda: next(marks, 1256))
+        with step_profiler.step("memtest_stage") as h:
+            h.done(None)
+        val = global_reg.gauge("mem_segment_delta_bytes").value(
+            stage="memtest_stage")
+        assert val == 256
+
+    def test_scale_up_notes_memory_event(self, monkeypatch):
+        from mmlspark_tpu.obs.memory import memory_profiler
+        from mmlspark_tpu.serving.autoscale import ComputeWorkerPool
+
+        seen = []
+        monkeypatch.setattr(memory_profiler, "note_event",
+                            lambda ev: seen.append(ev))
+        pool = ComputeWorkerPool(
+            ("127.0.0.1", 1), "memsvc", lambda df: df, prefix="memw")
+        try:
+            pool.scale_up()
+        finally:
+            pool.stop(timeout=2.0)
+        assert seen == ["scale_up"]
