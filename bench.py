@@ -34,6 +34,7 @@ mode was rc=1 with no line at all; VERDICT "What's weak" #1).
 from __future__ import annotations
 
 import functools
+import glob
 import json
 import os
 import time
@@ -1918,6 +1919,39 @@ def _emit(images_per_sec: float, extras: dict) -> None:
     }), flush=True)
 
 
+def _compare_main(argv) -> int:
+    """``bench.py --compare OLD.json [NEW.json]``: diff a banked run
+    against another (NEW defaults to the newest committed BENCH_r0*
+    trajectory file) through the obs.regression trajectory gate, print
+    the table, and append the one-line verdict to BENCH_NOTES.md so
+    the bank's narrative carries the diff. Host-side only — no
+    backend, no jax."""
+    from mmlspark_tpu.obs.regression import (compare_benches, format_table,
+                                             gate_verdict,
+                                             history_from_files, load_bench)
+    args = [a for a in argv if a != "--compare"]
+    if not args:
+        print("usage: bench.py --compare OLD.json [NEW.json]")
+        return 2
+    trajectory = sorted(glob.glob("BENCH_r0*.json"))
+    old_p = args[0]
+    new_p = args[1] if len(args) > 1 else (
+        trajectory[-1] if trajectory else None)
+    if new_p is None:
+        print("--compare: no NEW.json given and no BENCH_r0*.json found")
+        return 2
+    rows = compare_benches(load_bench(old_p), load_bench(new_p),
+                           history_from_files(trajectory))
+    print(f"{old_p} -> {new_p}")
+    print(format_table(rows))
+    verdict = gate_verdict(rows)
+    print(verdict)
+    with open("BENCH_NOTES.md", "a", encoding="utf-8") as f:
+        f.write(f"\n- `--compare {os.path.basename(old_p)} -> "
+                f"{os.path.basename(new_p)}`: {verdict}\n")
+    return 1 if verdict.startswith("REGRESSION") else 0
+
+
 def main():
     _ensure_cpu_backend_available()
     extras: dict = {}
@@ -2082,4 +2116,7 @@ def main():
 
 
 if __name__ == "__main__":
+    import sys
+    if "--compare" in sys.argv[1:]:
+        sys.exit(_compare_main(sys.argv[1:]))
     main()

@@ -47,6 +47,12 @@ PACKAGES: dict[str, list[str]] = {
     # fleet telemetry plane: federation + straggler/burn health + the
     # chaos trajectory, and the HBM memory profiler's degradation story
     "fleet": ["test_fleet.py", "test_obs_memory.py"],
+    # telemetry history plane: the bounded time-series store, recorder,
+    # /debug/timeline on both fronts, and the recorder overhead guard
+    "timeseries": ["test_timeseries.py"],
+    # perf-regression sentinel: offline bench-trajectory gate + live
+    # CUSUM watch + seeded regression-chaos acceptance
+    "regression": ["test_regression.py"],
     "analysis": ["test_analysis.py"],  # graftcheck passes + gate + clock
     "sched": ["test_sched.py"],  # admission/batching policy + scheduler
     "tenancy": ["test_tenancy.py"],  # quotas, SLO tiers, fair dispatch
@@ -142,6 +148,44 @@ def style() -> int:
         "for k in registry.snapshot())\n"
         "assert 'jax' not in sys.modules, 'fleet health tick pulled jax'\n"
         "print('obs.fleet federation OK (no jax)')")
+    rc = _run([sys.executable, "-c", smoke],
+              env=dict(os.environ, JAX_PLATFORMS="cpu"))
+    if rc:
+        return rc
+    # the telemetry history plane is control-plane code ticked from a
+    # daemon thread and served from handler threads: the store must
+    # record/query, the offline gate must diff a synthetic regression,
+    # and the CUSUM sentinel must warm up and alarm — all stdlib-only,
+    # with no JAX in the process
+    smoke = (
+        "import sys\n"
+        "from mmlspark_tpu.obs.metrics import MetricsRegistry\n"
+        "from mmlspark_tpu.obs.timeseries import Recorder, "
+        "TimeSeriesStore, timeline_payload\n"
+        "from mmlspark_tpu.obs.regression import (CusumDetector, "
+        "compare_benches, gate_verdict)\n"
+        "assert 'jax' not in sys.modules, 'history plane pulled in jax'\n"
+        "reg = MetricsRegistry()\n"
+        "g = reg.gauge('sched_ci_depth', 'smoke')\n"
+        "store = TimeSeriesStore(reg)\n"
+        "rec = Recorder(store, reg)\n"
+        "for v in (1.0, 2.0, 3.0):\n"
+        "    g.set(v)\n"
+        "    rec.tick()\n"
+        "assert [p[1] for p in store.points('sched_ci_depth')] == "
+        "[1.0, 2.0, 3.0]\n"
+        "status, body = timeline_payload('series=sched_&window=60', "
+        "store=store)\n"
+        "assert status == 200 and b'sched_ci_depth' in body\n"
+        "rows = compare_benches({'m_per_sec': 100.0}, "
+        "{'m_per_sec': 80.0})\n"
+        "assert gate_verdict(rows).startswith('REGRESSION')\n"
+        "det = CusumDetector(warmup=4, direction='lower_bad')\n"
+        "for v in (0.42, 0.41, 0.43, 0.42, 0.42):\n"
+        "    det.update(v)\n"
+        "assert any(det.update(0.05) for _ in range(4))\n"
+        "assert 'jax' not in sys.modules, 'history plane pulled in jax'\n"
+        "print('obs history plane OK (no jax)')")
     rc = _run([sys.executable, "-c", smoke],
               env=dict(os.environ, JAX_PLATFORMS="cpu"))
     if rc:
@@ -443,6 +487,27 @@ def analysis() -> int:
     return rc
 
 
+def regression_gate() -> int:
+    """The perf-regression trajectory gate (ISSUE 16): diff the newest
+    banked ``BENCH_r0*.json`` against its predecessor, the whole
+    trajectory pricing each metric's noise. Exit 1 = a gated metric
+    regressed beyond tolerance; a too-short trajectory (fresh clone,
+    < 2 banked runs) is a pass with a note, not a failure. Budget:
+    < 60 s — it is pure JSON diffing, no JAX, no benchmarks re-run."""
+    t0 = time.monotonic()
+    rc = _run([sys.executable, "-m", "mmlspark_tpu.obs.regression",
+               "gate"], env=dict(os.environ, JAX_PLATFORMS="cpu"))
+    if rc == 2:
+        print("regression gate: trajectory too short to judge — "
+              "treating as pass")
+        rc = 0
+    took = time.monotonic() - t0
+    if took > 60:
+        print(f"regression gate exceeded its 60s budget ({took:.0f}s)")
+        return rc or 3
+    return rc
+
+
 def aot_roundtrip() -> int:
     """Build-then-load round trip across two scrubbed processes: the
     store built by one process must warm-load in a fresh one with zero
@@ -463,17 +528,19 @@ def multichip() -> int:
 
 def main() -> int:
     ap = argparse.ArgumentParser()
-    ap.add_argument("--only", choices=["style", "analysis", "tests",
+    ap.add_argument("--only", choices=["style", "analysis",
+                                       "regression_gate", "tests",
                                        "aot_roundtrip", "examples",
                                        "multichip"])
     ap.add_argument("--package", choices=sorted(PACKAGES))
     args = ap.parse_args()
     t0 = time.monotonic()
     stages = ([args.only] if args.only
-              else ["style", "analysis", "tests", "aot_roundtrip",
-                    "examples", "multichip"])
+              else ["style", "analysis", "regression_gate", "tests",
+                    "aot_roundtrip", "examples", "multichip"])
     for stage in stages:
         rc = {"style": style, "analysis": analysis,
+              "regression_gate": regression_gate,
               "aot_roundtrip": aot_roundtrip,
               "examples": examples, "multichip": multichip}.get(
                   stage, lambda: tests(args.package))()

@@ -10,8 +10,12 @@ the reference inherited (per-stage JSON telemetry + VW nanosecond
 timers, SURVEY §5). Cross-process trace propagation lives in
 ``obs.propagation`` (W3C-style traceparent), Chrome-trace export and
 the flight recorder in ``obs.export``, the continuous compile/step
-profiler and cost-model feature log in ``obs.profile``. See
-docs/observability.md.
+profiler and cost-model feature log in ``obs.profile``. The telemetry
+HISTORY plane (ISSUE 16) lives in ``obs.timeseries`` — one bounded
+in-process time-series store (``timeseries_store``) fed by a
+``Recorder`` tick over the registry, served at ``GET /debug/timeline``
+— and ``obs.regression`` watches it live (CUSUM step-change sentinel)
+and gates bench trajectories offline. See docs/observability.md.
 
 Import is side-effect-free and backend-free: safe under
 ``JAX_PLATFORMS=cpu`` before (or without) JAX initialization.
@@ -27,10 +31,14 @@ from .profile import (FEATURE_SCHEMA_VERSION, CompileTracker, FeatureLog,
                       StepProfiler, compile_tracker, feature_log,
                       step_profiler)
 from .memory import MemoryProfiler, device_memory_stats, memory_profiler
+from .timeseries import (Recorder, TimeSeriesStore, recorder,
+                         timeline_payload, timeseries_store)
 from .fleet import (BurnRateMonitor, FleetAggregator, FleetHealth,
                     StragglerDetector, fleet_aggregator, fleet_health,
                     local_fleet_snapshot, parse_exposition, parse_sample,
                     straggler_workers)
+from .regression import (CusumDetector, RegressionSentinel, compare_benches,
+                         sentinel)
 
 __all__ = ["registry", "tracer", "MetricsRegistry", "Counter", "Gauge",
            "Histogram", "Tracer", "Span", "StageTimer", "wall_now",
@@ -45,4 +53,8 @@ __all__ = ["registry", "tracer", "MetricsRegistry", "Counter", "Gauge",
            "FleetAggregator", "FleetHealth", "StragglerDetector",
            "BurnRateMonitor", "fleet_aggregator", "fleet_health",
            "local_fleet_snapshot", "parse_exposition", "parse_sample",
-           "straggler_workers"]
+           "straggler_workers",
+           "TimeSeriesStore", "Recorder", "timeseries_store", "recorder",
+           "timeline_payload",
+           "CusumDetector", "RegressionSentinel", "compare_benches",
+           "sentinel"]

@@ -25,6 +25,16 @@ single place to see the fleet. This module is that place:
   the single ``GET /healthz`` verdict (ok/degraded/critical) that the
   autoscaler and ``pick_least_loaded`` consult.
 
+History (ISSUE 16): the ad-hoc private histories this module used to
+keep — the burn monitor's tick list, the detector's last-flag-only
+memory — are re-based on the shared
+:class:`~mmlspark_tpu.obs.timeseries.TimeSeriesStore`: burn windows
+are store-window deltas over ``slo_tenant_*`` series, and straggler
+flap suppression debounces re-flags against ``mad_over_time`` of the
+rank's recorded score trajectory. Components built against the
+process-wide registry share the process-wide store (one queryable
+substrate); a private registry (test isolation) gets a private store.
+
 Clock discipline: everything here uses ``time.monotonic`` (graftcheck's
 wallclock pass holds for ``obs/``); burn-rate windows are monotonic
 spans, never wall timestamps. All shared state (source tables, flagged
@@ -39,6 +49,7 @@ import threading
 import time
 
 from .metrics import _escape, registry as _registry
+from .timeseries import TimeSeriesStore, timeseries_store as _shared_store
 from .tracing import tracer as _tracer
 
 __all__ = [
@@ -63,6 +74,19 @@ __all__ = [
 FEDERATED_PREFIXES = (
     "profile_", "collective_", "mem_", "sched_", "serving_", "aot_",
 )
+
+
+def _store_for(store, registry, clock=time.monotonic):
+    """The history substrate a component should use: an explicit one
+    wins; the process-wide registry pairs with the process-wide store
+    (ONE queryable history plane); a private registry or custom clock
+    (test isolation) gets a private store on the same clock."""
+    if store is not None:
+        return store
+    if registry is None and clock is time.monotonic:
+        return _shared_store
+    return TimeSeriesStore(
+        registry if registry is not None else _registry, clock=clock)
 
 # ---------------------------------------------------------------------------
 # sample-name parsing — the inverse of metrics._render, so snapshots and
@@ -175,7 +199,22 @@ class FleetAggregator:
     (registries are cumulative, so last-write-wins is exact). Identity
     labels are stamped into every sample that does not already carry
     them, which is what makes the merged exposition collision-free.
+
+    Staleness is CONSUMED here too (ISSUE 16 satellite), not just
+    exported: each source's push cadence is learned as an EWMA of its
+    inter-arrival gaps, and :meth:`check_staleness` flags sources whose
+    age exceeds ``STALE_FACTOR`` × that cadence —
+    ``fleet_sources_stale_total`` counts the flips and
+    :class:`FleetHealth` folds the flags into a DEGRADED (never
+    critical) verdict: a quiet rank is a telemetry gap, not proof the
+    service is failing its SLO.
     """
+
+    #: a source older than this multiple of its learned cadence is stale
+    STALE_FACTOR = 3.0
+    #: absolute grace floor: sub-second cadences (in-thread mesh
+    #: heartbeats) would otherwise flag on routine GIL/scheduler jitter
+    MIN_STALE_S = 1.0
 
     def __init__(self, registry=None, *, max_sources: int = 64,
                  clock=time.monotonic):
@@ -198,6 +237,11 @@ class FleetAggregator:
         self._c_evicted = self._reg.counter(
             "fleet_sources_evicted_total",
             "fleet sources dropped, by reason (death|bound)")
+        self._stale: set = set()   # sources currently flagged stale
+        self._c_stale = self._reg.counter(
+            "fleet_sources_stale_total",
+            "fleet sources that went stale (age > 3x learned cadence), "
+            "by source")
 
     # -- ingest -----------------------------------------------------------
 
@@ -230,10 +274,19 @@ class FleetAggregator:
         now = self._clock()
         evicted = []
         with self._lock:
+            prev = self._sources.get(source)
+            cadence = None if prev is None else prev.get("cadence")
+            if prev is not None:
+                gap = max(0.0, now - prev["at"])
+                # EWMA of inter-arrival gaps: adapts to a source that
+                # legitimately slows its push rate without a restart
+                cadence = gap if cadence is None \
+                    else 0.5 * cadence + 0.5 * gap
             self._sources[source] = {
                 "samples": relabelled, "at": now, "process": proc,
-                "worker": wid, "channel": channel,
+                "worker": wid, "channel": channel, "cadence": cadence,
             }
+            self._stale.discard(source)   # fresh push clears the flag
             self._channels.add(channel)
             while len(self._sources) > self._max_sources:
                 oldest = min(self._sources, key=lambda s:
@@ -257,6 +310,7 @@ class FleetAggregator:
         straggler flag do not linger forever."""
         with self._lock:
             info = self._sources.pop(source, None)
+            self._stale.discard(source)
         if info is None:
             return False
         self._scrub(source, info)
@@ -296,9 +350,43 @@ class FleetAggregator:
                     "worker": info["worker"],
                     "channel": info["channel"],
                     "samples": len(info["samples"]),
+                    "cadence_s": (None if info.get("cadence") is None
+                                  else round(info["cadence"], 3)),
+                    "stale": key in self._stale,
                 }
                 for key, info in self._sources.items()
             }
+
+    def check_staleness(self, factor: float | None = None) -> dict:
+        """Flag sources whose age exceeds ``factor`` × learned cadence
+        (default :data:`STALE_FACTOR`), with :data:`MIN_STALE_S` as an
+        absolute grace floor. A source with no learned cadence yet
+        (single push) is never stale — one push proves nothing about
+        its rhythm. Rising edges count into
+        ``fleet_sources_stale_total``; a fresh push clears the flag.
+        Returns ``{source: {"age_s", "cadence_s"}}`` of current
+        flags."""
+        factor = self.STALE_FACTOR if factor is None else float(factor)
+        now = self._clock()
+        stale: dict = {}
+        newly: list = []
+        with self._lock:
+            for key, info in self._sources.items():
+                cadence = info.get("cadence")
+                if not cadence or cadence <= 0:
+                    continue
+                age = now - info["at"]
+                if age > max(factor * cadence, self.MIN_STALE_S):
+                    stale[key] = {"age_s": round(age, 3),
+                                  "cadence_s": round(cadence, 3)}
+                    if key not in self._stale:
+                        self._stale.add(key)
+                        newly.append(key)
+                else:
+                    self._stale.discard(key)
+        for key in newly:
+            self._c_stale.inc(source=key)
+        return stale
 
     def merged_samples(self, *, include_local: bool = False,
                        update_gauges: bool = True) -> dict:
@@ -374,23 +462,41 @@ class StragglerDetector:
     a serving thread. With exactly two members MAD is degenerate, so a
     ratio test applies (slower/faster > ``ratio_floor``). The MAD is
     floored at ``mad_floor_frac``·median so a perfectly uniform fleet
-    with microscopic jitter does not page."""
+    with microscopic jitter does not page.
+
+    Flap suppression (ISSUE 16): each rank's score (mean / fleet
+    median) is recorded into the history store every tick. A rank that
+    RE-flags within ``flap_window_s`` of its last unflag is debounced:
+    the re-flag only lands immediately when its excess over the group
+    threshold clears ``flap_k`` × ``mad_over_time`` of its own recorded
+    score trajectory — i.e. the breach is large against the rank's own
+    recent noise. A threshold-straddling jitterer is held back (counted
+    in ``fleet_straggler_flaps_suppressed_total``) until it breaches on
+    two CONSECUTIVE ticks, so a genuine relapse is delayed by at most
+    one tick while alert flapping stops. First-ever flags are never
+    delayed (no unflag history — nothing to debounce against)."""
 
     #: sample families whose per-rank sums/counts define "step time"
     FAMILIES = ("profile_step_seconds",)
 
     def __init__(self, aggregator=None, registry=None, *, k: float = 3.0,
                  ratio_floor: float = 2.0, mad_floor_frac: float = 0.05,
-                 min_count: float = 1.0):
+                 min_count: float = 1.0, store=None,
+                 flap_window_s: float = 120.0, flap_k: float = 3.0):
         self._agg = aggregator if aggregator is not None else fleet_aggregator
         self._reg = registry if registry is not None else _registry
+        self._store = _store_for(store, registry)
         self.k = float(k)
         self.ratio_floor = float(ratio_floor)
         self.mad_floor_frac = float(mad_floor_frac)
         self.min_count = float(min_count)
+        self.flap_window_s = float(flap_window_s)
+        self.flap_k = float(flap_k)
         self._lock = threading.Lock()
         self._flagged: set = set()   # {(label, value)}
         self._known: set = set()
+        self._unflag_at: dict = {}   # {(label, value): t of last unflag}
+        self._pending: dict = {}     # {(label, value): raw-flag streak}
         self._g = self._reg.gauge(
             "fleet_straggler",
             "1 while a rank's mean step time exceeds median + k*MAD "
@@ -398,6 +504,9 @@ class StragglerDetector:
         self._g_score = self._reg.gauge(
             "fleet_straggler_score",
             "mean step seconds over fleet median, by process/worker")
+        self._c_flaps = self._reg.counter(
+            "fleet_straggler_flaps_suppressed_total",
+            "re-flags debounced by the score-history noise gate")
 
     def rank_means(self, samples: dict) -> dict:
         """``{(label, value): mean_step_seconds}`` from the merged
@@ -435,19 +544,23 @@ class StragglerDetector:
         mid = n // 2
         return vals[mid] if n % 2 else (vals[mid - 1] + vals[mid]) / 2.0
 
-    def _detect_group(self, means: dict) -> set:
+    def _detect_group(self, means: dict) -> tuple:
+        """(flagged idents, flag threshold in raw mean-seconds). The
+        threshold is what flap suppression measures excess against;
+        None when the group is too small to judge."""
         if len(means) < 2:
-            return set()
+            return set(), None
         vals = [v for v in means.values()]
         med = self._median(vals)
         if len(means) == 2:
             (i1, v1), (i2, v2) = sorted(means.items(), key=lambda kv: kv[1])
+            thr = v1 * self.ratio_floor if v1 > 0 else None
             if v1 > 0 and v2 / v1 > self.ratio_floor:
-                return {i2}
-            return set()
+                return {i2}, thr
+            return set(), thr
         mad = self._median([abs(v - med) for v in vals])
         thr = med + self.k * max(mad, self.mad_floor_frac * med, 1e-9)
-        return {ident for ident, v in means.items() if v > thr}
+        return {ident for ident, v in means.items() if v > thr}, thr
 
     def tick(self, samples=None) -> set:
         """Recompute flags from the merged fleet view. Returns the
@@ -458,22 +571,63 @@ class StragglerDetector:
         groups: dict = {}
         for ident, mean in means.items():
             groups.setdefault(ident[0], {})[ident] = mean
-        flagged: set = set()
+        raw: set = set()
         medians: dict = {}
+        thresholds: dict = {}
         for label, group in groups.items():
-            flagged |= self._detect_group(group)
+            got, thr = self._detect_group(group)
+            raw |= got
             medians[label] = self._median(list(group.values()))
-        for (label, value), mean in means.items():
-            med = medians.get(label) or 0.0
-            self._g_score.set(mean / med if med > 0 else 1.0,
-                              **{label: value})
-            self._g.set(1.0 if (label, value) in flagged else 0.0,
-                        **{label: value})
+            thresholds[label] = thr
+        scores = {
+            (label, value): (mean / medians[label]
+                             if medians.get(label, 0.0) > 0 else 1.0)
+            for (label, value), mean in means.items()}
+        now = self._store.now()
+        # record every rank's score trajectory — the flap-suppression
+        # history AND an operator-queryable /debug/timeline series
+        self._store.append_many(
+            {render_sample("fleet_straggler_score", {lab: val}): s
+             for (lab, val), s in scores.items()}, t=now)
+        suppressed: list = []
         with self._lock:
-            newly = flagged - self._flagged
+            prev = set(self._flagged)
+            flagged = set(raw)
+            for ident in sorted(raw - prev):
+                label, value = ident
+                streak = self._pending.get(ident, 0) + 1
+                self._pending[ident] = streak
+                thr, med = thresholds.get(label), medians.get(label, 0.0)
+                last_unflag = self._unflag_at.get(ident)
+                if (last_unflag is None
+                        or now - last_unflag > self.flap_window_s
+                        or streak >= 2 or thr is None or med <= 0):
+                    continue   # not a flap (or sustained): flag lands
+                vol = self._store.mad_over_time(
+                    render_sample("fleet_straggler_score",
+                                  {label: value}),
+                    self.flap_window_s)
+                excess = (means[ident] - thr) / med
+                if excess <= self.flap_k * vol:
+                    flagged.discard(ident)
+                    suppressed.append(ident)
+            for ident in [i for i in self._pending if i not in raw]:
+                self._pending.pop(ident)
+            for ident in prev - flagged:
+                self._unflag_at[ident] = now
+            for ident in [i for i in self._unflag_at
+                          if i not in means]:
+                self._unflag_at.pop(ident)
+            newly = flagged - prev
             gone = self._known - set(means)
             self._flagged = flagged
             self._known = set(means)
+        for label, value in sorted(suppressed):
+            self._c_flaps.inc(**{label: value})
+        for (label, value), score in scores.items():
+            self._g_score.set(score, **{label: value})
+            self._g.set(1.0 if (label, value) in flagged else 0.0,
+                        **{label: value})
         for label, value in gone:
             self._g.remove_matching(**{label: value})
             self._g_score.remove_matching(**{label: value})
@@ -518,26 +672,35 @@ class BurnRateMonitor:
     """Multi-window error-budget burn over the ``sched_tenant_*``
     counters.
 
-    Each ``tick`` snapshots per-tenant (admitted, shed) totals onto a
-    monotonic history; the burn for a window is ``(shed / total) /
-    budget`` over that window's delta — burn 1.0 means the tenant is
-    consuming budget exactly as fast as the SLO allows, ``page_burn``
-    (default 10×) means an incident."""
+    Each ``tick`` appends per-tenant (admitted, shed) totals as
+    ``slo_tenant_admitted`` / ``slo_tenant_shed`` series in the history
+    store (ISSUE 16: the store IS the history — no private tick list),
+    plus a ``slo_burn_ticks`` marker series recording when the monitor
+    looked; the burn for a window is ``(shed / total) / budget`` over
+    that window's store delta — burn 1.0 means the tenant is consuming
+    budget exactly as fast as the SLO allows, ``page_burn`` (default
+    10×) means an incident."""
 
     def __init__(self, registry=None, *, windows=None, budget_for=None,
-                 service: str = "", clock=time.monotonic):
+                 service: str = "", clock=time.monotonic, store=None):
         self._reg = registry if registry is not None else _registry
         self._clock = clock
+        self._store = _store_for(store, registry, clock)
         self.windows = dict(windows) if windows else dict(DEFAULT_WINDOWS)
         self._budget_for = budget_for
         self._service = service
         self._lock = threading.Lock()
-        self._history: list = []   # [(t, {tenant: (admitted, shed)})]
         self._latest: dict = {}    # {tenant: {window: burn}}
         self._g_burn = self._reg.gauge(
             "slo_burn_rate",
             "error-budget burn multiple, by tenant and window "
             "(1.0 = burning exactly at the SLO rate)")
+
+    def _series(self, family: str, tenant: str | None = None) -> str:
+        labels = {"service": self._service} if self._service else {}
+        if tenant is not None:
+            labels["tenant"] = tenant
+        return render_sample(family, labels)
 
     def set_budget_for(self, fn) -> None:
         self._budget_for = fn
@@ -582,21 +745,35 @@ class BurnRateMonitor:
         totals = self._totals(samples)
         now = self._clock()
         horizon = max(self.windows.values()) * 1.5 + 1.0
-        with self._lock:
-            self._history.append((now, totals))
-            while self._history and self._history[0][0] < now - horizon:
-                self._history.pop(0)
-            history = list(self._history)
+        # one batch append at one timestamp: the tick marker plus every
+        # tenant's cumulative totals. Retention = the burn horizon, so
+        # the store prunes exactly what the old private list did.
+        batch = {self._series("slo_burn_ticks"): now}
+        for tenant, (adm, shed) in totals.items():
+            batch[self._series("slo_tenant_admitted", tenant)] = adm
+            batch[self._series("slo_tenant_shed", tenant)] = shed
+        self._store.append_many(batch, t=now, retention_s=horizon)
         burns: dict = {}
         for tenant, (adm_now, shed_now) in totals.items():
             budget = self.budget(tenant)
+            adm_series = self._series("slo_tenant_admitted", tenant)
+            shed_series = self._series("slo_tenant_shed", tenant)
             per_window: dict = {}
             for wname, wsec in self.windows.items():
+                # base = the tenant's totals at the oldest tick inside
+                # the window; a tenant that first appeared later than
+                # that tick has no point there — its whole total is
+                # in-window (base 0), same as the old history list
+                ticks = self._store.points(self._series("slo_burn_ticks"),
+                                           wsec, now=now)
+                t0 = ticks[0][0] if ticks else now
                 base_adm = base_shed = 0.0
-                for t, past in history:
-                    if t >= now - wsec:
-                        base_adm, base_shed = past.get(tenant, (0.0, 0.0))
-                        break
+                adm_pts = self._store.points(adm_series, wsec, now=now)
+                if adm_pts and adm_pts[0][0] <= t0 + 1e-9:
+                    base_adm = adm_pts[0][1]
+                shed_pts = self._store.points(shed_series, wsec, now=now)
+                if shed_pts and shed_pts[0][0] <= t0 + 1e-9:
+                    base_shed = shed_pts[0][1]
                 d_adm = max(0.0, adm_now - base_adm)
                 d_shed = max(0.0, shed_now - base_shed)
                 total = d_adm + d_shed
@@ -625,19 +802,22 @@ class FleetHealth:
 
     def __init__(self, aggregator=None, registry=None, *,
                  page_burn: float = 10.0, degraded_burn: float = 1.0,
-                 windows=None, service: str = ""):
+                 windows=None, service: str = "", store=None):
         self._reg = registry if registry is not None else _registry
+        self._store = _store_for(store, registry)
         self.aggregator = (aggregator if aggregator is not None
                            else fleet_aggregator)
         self.stragglers = StragglerDetector(self.aggregator,
-                                            registry=self._reg)
+                                            registry=self._reg,
+                                            store=self._store)
         self.burn = BurnRateMonitor(registry=self._reg, windows=windows,
-                                    service=service)
+                                    service=service, store=self._store)
         self.page_burn = float(page_burn)
         self.degraded_burn = float(degraded_burn)
         self._lock = threading.Lock()
         self._verdict = "ok"
         self._reasons: list = []
+        self._sentinel = None
         self._g_health = self._reg.gauge(
             "fleet_health",
             "healthz verdict: 0 ok, 1 degraded, 2 critical")
@@ -648,6 +828,14 @@ class FleetHealth:
         fn = getattr(tenancy, "error_budget_for", None)
         if callable(fn):
             self.burn.set_budget_for(fn)
+
+    def attach_sentinel(self, sentinel) -> None:
+        """Point the verdict at a perf-regression sentinel
+        (``obs.regression.RegressionSentinel``): series with a
+        SUSTAINED live regression mark the fleet degraded — slower than
+        it was is sick, but never load-balancer-drain critical. The
+        sentinel module attaches the process-wide pair on import."""
+        self._sentinel = sentinel
 
     def tick(self) -> str:
         """One health evaluation: refresh memory gauges, detect
@@ -672,6 +860,21 @@ class FleetHealth:
             elif fast >= self.degraded_burn and verdict != "critical":
                 verdict = "degraded"
                 reasons.append(f"{tenant} burning (fast burn {fast:.1f})")
+        stale = self.aggregator.check_staleness()
+        if stale:
+            # a source that stopped reporting is a blind spot, not an
+            # outage: never escalate past degraded on staleness alone
+            if verdict == "ok":
+                verdict = "degraded"
+            reasons.append("stale_sources=%d" % len(stale))
+        sentinel = self._sentinel
+        if sentinel is not None:
+            sustained = sentinel.sustained()
+            if sustained:
+                if verdict == "ok":
+                    verdict = "degraded"
+                reasons.append(
+                    "regression=" + ",".join(sorted(sustained)))
         with self._lock:
             self._verdict = verdict
             self._reasons = reasons
@@ -693,6 +896,9 @@ class FleetHealth:
                 f"{lab}:{val}" for lab, val in self.stragglers.flagged()),
             "burn": self.burn.latest(),
             "sources": len(self.aggregator.sources()),
+            "stale_sources": sorted(
+                k for k, v in self.aggregator.sources().items()
+                if v.get("stale")),
         }
         return self.VERDICTS[verdict][1], json.dumps(body, indent=1).encode()
 

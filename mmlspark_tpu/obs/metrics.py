@@ -63,6 +63,41 @@ def _num(v: float) -> str:
     return f"{v:.10g}"
 
 
+def bucket_quantile(bounds: tuple, counts, q: float) -> float:
+    """Estimate the ``q``-quantile of a bucketed distribution.
+
+    ``bounds`` are the finite upper bucket bounds (sorted ascending);
+    ``counts`` are PER-BUCKET (non-cumulative) observation counts, one
+    per bound plus a final +Inf bucket. The estimate interpolates
+    linearly inside the target bucket — exact at bucket edges, off by
+    at most half a bucket width inside one, which on the factor-2
+    latency ladder bounds relative error at ~50% of the true value.
+
+    Documented bias at the top: mass in the +Inf bucket has no upper
+    edge to interpolate toward, so any quantile landing there is
+    CLAMPED to the highest finite bound. A p99 that truly lives above
+    the ladder reads as ``bounds[-1]`` — an underestimate, never a
+    fabricated larger number. Widen the ladder if the tail matters.
+    """
+    total = float(sum(counts))
+    if total <= 0:
+        return 0.0
+    q = min(max(float(q), 0.0), 1.0)
+    target = q * total
+    cum = 0.0
+    for i, c in enumerate(counts):
+        if c <= 0:
+            continue
+        if cum + c >= target:
+            if i >= len(bounds):        # +Inf bucket: clamp (see above)
+                return float(bounds[-1])
+            lo = float(bounds[i - 1]) if i > 0 else 0.0
+            hi = float(bounds[i])
+            return lo + (hi - lo) * ((target - cum) / c)
+        cum += c
+    return float(bounds[-1])
+
+
 class _Metric:
     """Base: one named metric holding per-label-combination series."""
 
@@ -206,6 +241,18 @@ class Histogram(_Metric):
         with self._lock:
             s = self._series.get(_label_key(labels))
             return 0 if s is None else s.count
+
+    def quantile(self, q: float, **labels) -> float:
+        """Estimated ``q``-quantile of one label combination's series
+        (:func:`bucket_quantile`: linear interpolation inside the
+        log-ladder bucket, clamped at the +Inf bucket). 0.0 when the
+        series has no observations."""
+        with self._lock:
+            s = self._series.get(_label_key(labels))
+            counts = None if s is None else tuple(s.counts)
+        if counts is None:
+            return 0.0
+        return bucket_quantile(self.buckets, counts, q)
 
     def sum(self, **labels) -> float:
         with self._lock:

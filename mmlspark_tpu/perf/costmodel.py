@@ -143,6 +143,12 @@ class CostModel:
             "sched_costmodel_skipped_rows_total",
             "FeatureLog rows the trainer skipped, by reason "
             "(schema | bad)")
+        # the history plane's Recorder ticks every sched_-prefixed
+        # sample into the time-series store, so this error gauge (and
+        # the scheduler's sched_costmodel_error_ms histogram, which the
+        # regression sentinel's cost-model watch CUSUMs) gets a
+        # queryable drift trajectory for free — /debug/timeline shows
+        # the scheduler being priced progressively wrong
         self._g_mae = reg.gauge(
             "sched_costmodel_mae_ms",
             "EWMA absolute prediction error ms, by service")

@@ -43,10 +43,11 @@ from __future__ import annotations
 import logging
 import threading
 import uuid
-from collections import deque
 from dataclasses import dataclass, field
 
 from ..obs import registry as _default_registry
+from ..obs.fleet import render_sample
+from ..obs.timeseries import TimeSeriesStore, timeseries_store
 from ..sched.policy import now
 
 _LOG = logging.getLogger("mmlspark_tpu.serving")
@@ -128,7 +129,7 @@ class Autoscaler:
     def __init__(self, service: str, pool,
                  config: AutoscaleConfig | None = None, *,
                  registry=None, tenancy=None, signals=None,
-                 item_seconds=None):
+                 item_seconds=None, store=None):
         reg = registry if registry is not None else _default_registry
         self.service = service
         self.pool = pool
@@ -136,8 +137,18 @@ class Autoscaler:
         self.tenancy = tenancy
         self._signals = signals
         self._item_seconds = item_seconds
-        self._depth_hist: deque = deque(
-            maxlen=max(int(self.config.history_ticks), 2))
+        # depth trend lives in the time-series store (ISSUE 16): the
+        # same window /debug/timeline serves is the one the slope is
+        # fit over. Private registry → private store, so tests and
+        # scenarios never share trend history through the singleton.
+        self._store = (store if store is not None
+                       else timeseries_store if registry is None
+                       else TimeSeriesStore(reg))
+        self._depth_series = render_sample(
+            "autoscale_depth", {"service": service})
+        self._store.ensure(self._depth_series,
+                           maxlen=max(int(self.config.history_ticks), 2),
+                           retention_s=86400.0)
         self._tick_i = 0
         self._registry = reg
         self.events: list[AutoscaleEvent] = []
@@ -253,7 +264,7 @@ class Autoscaler:
             # the SAME hysteresis/cooldown machinery as measured
             # pressure, so it buys lead time, not thrash
             self._tick_i += 1
-            self._depth_hist.append((self._tick_i, s.queue_depth))
+            self._store.append(self._depth_series, s.queue_depth)
             pred = self._predict_depth(s.queue_depth)
             self._g_pred.set(pred, service=self.service)
             over_pred = pred > cfg.queue_high * max(n, 1)
@@ -308,10 +319,14 @@ class Autoscaler:
         return "hold"
 
     def _predict_depth(self, depth: float) -> float:
-        """Least-squares depth slope per tick over the history window,
-        extrapolated ``lead_ticks`` ahead (clamped at zero). Under 3
-        samples there is no trend — predicted = measured."""
-        h = self._depth_hist
+        """Least-squares depth slope per tick over the history window
+        (read back from the time-series store — sample index is the x
+        axis, so wall-clock jitter between evaluations cannot tilt the
+        fit), extrapolated ``lead_ticks`` ahead (clamped at zero).
+        Under 3 samples there is no trend — predicted = measured."""
+        h = list(enumerate(
+            v for _, v in self._store.last_n(
+                self._depth_series, max(int(self.config.history_ticks), 2))))
         if len(h) < 3:
             return depth
         n = len(h)

@@ -202,13 +202,20 @@ class NativeServingServer(ServingServer):
         path = raw_path.split("?", 1)[0].rstrip("/") or "/"
         # query-scoped routes first ("/metrics?scope=fleet" is a
         # literal key — same order as the threaded front), then the
-        # query-stripped path
+        # query-stripped path, then the query-route table (variable
+        # query values — /debug/timeline?series=&window=)
         route = None
+        query = ""
         if "?" in raw_path:
             query = raw_path.split("?", 1)[1]
             route = self._routes.get(f"{path}?{query}")
         if route is None:
             route = self._routes.get(path)
+        if route is None:
+            qroute = self._query_routes.get(path)
+            if qroute is not None:
+                def route(b, _q=query, _h=qroute):
+                    return _h(_q, b)
         default_ct = b"Content-Type: application/octet-stream\r\n"
         if route is not None:
             status, out = route(body)

@@ -35,6 +35,8 @@ from ..obs.fleet import (fleet_aggregator as _fleet_agg,
 from ..obs.memory import memory_profiler as _memory
 from ..obs.profile import feature_log as _features
 from ..obs.propagation import extract as _extract
+from ..obs.timeseries import (recorder as _recorder,
+                              timeline_payload as _timeline)
 from ..obs.tracing import tracer as _tracer
 from ..sched import RequestScheduler, Shed
 from ..sched.policy import bucket_of
@@ -276,6 +278,17 @@ class ServingServer:
                            "/healthz"):
                 self._routes[f"{self.api_path}{suffix}"] = \
                     self._routes[suffix]
+        # telemetry history plane (obs.timeseries, ISSUE 16): the
+        # timeline query surface. Its query VALUES vary per request
+        # (series=<patterns>&window=<seconds>), so it cannot be a
+        # literal ``path?query`` key — query routes are a second table
+        # (path -> fn(query, body)) both fronts consult after the
+        # literal lookups, keeping the existing routes byte-identical.
+        self._query_routes: dict[str, callable] = {}
+        self._query_routes["/debug/timeline"] = self._debug_timeline_route
+        if self.api_path != "/":
+            self._query_routes[f"{self.api_path}/debug/timeline"] = \
+                self._debug_timeline_route
         if tenancy is not None:
             _fleet_health.attach_tenancy(tenancy)
 
@@ -327,6 +340,15 @@ class ServingServer:
         ok/degraded (a slow fleet must not be drained by its load
         balancer), 503 only when critical (SLO burn is paging)."""
         return _fleet_health.healthz_payload()
+
+    def _debug_timeline_route(self, query: str,
+                              body: bytes) -> tuple[int, bytes]:
+        """``GET /debug/timeline?series=&window=``: the history
+        store's recorded series as JSON — ``series`` is a
+        comma-separated name/prefix list, ``window`` trailing seconds
+        (default 300); without ``series`` an index of recorded series.
+        Served by BOTH fronts via the shared query-route table."""
+        return _timeline(query)
 
     def _start_request_span(self, cached: "CachedRequest",
                             route: str) -> None:
@@ -467,13 +489,21 @@ class ServingServer:
                 length = int(self.headers.get("Content-Length", 0))
                 body = self.rfile.read(length) if length else None
                 # query-scoped routes first ("/metrics?scope=fleet" is
-                # a literal key), then the query-stripped path
+                # a literal key), then the query-stripped path, then
+                # the query-route table (variable query values —
+                # /debug/timeline?series=&window=)
                 route = None
+                query = ""
                 if "?" in self.path:
                     query = self.path.split("?", 1)[1]
                     route = serving._routes.get(f"{path}?{query}")
                 if route is None:
                     route = serving._routes.get(path)
+                if route is None:
+                    qroute = serving._query_routes.get(path)
+                    if qroute is not None:
+                        def route(b, _q=query, _h=qroute):
+                            return _h(_q, b)
                 if route is not None:
                     status, out = route(body or b"")
                     self.send_response(status)
@@ -777,4 +807,10 @@ def serving_query(name: str, transform_fn, host: str = "127.0.0.1",
     server = cls(name, host=host, port=port, reply_timeout=reply_timeout,
                  max_queue=max_queue, deadline=deadline,
                  max_inflight=max_inflight, tenancy=tenancy).start()
+    # history plane (obs.timeseries): a served process records its own
+    # trajectory — the sentinel's windowed p99 and the /debug/timeline
+    # surface need points, not just instantaneous gauges. Idempotent;
+    # bare ServingServer construction stays recorder-free so overhead
+    # harnesses can measure the recorder-off baseline.
+    _recorder.start()
     return ServingQuery(server, transform_fn).start()

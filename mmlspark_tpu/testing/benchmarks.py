@@ -1791,3 +1791,216 @@ def autoscale_lead_scenario(*, ticks: int = 200, period_ticks: int = 100,
         "predictive_leads": bool(
             both and pred["lag_ticks"] < react["lag_ticks"]),
     }
+
+
+def recorder_overhead_scenario(*, service: str = "recorder-bench",
+                               n_requests: int = 600,
+                               item_service_s: float = 0.002,
+                               max_batch: int = 8,
+                               reps: int = 3,
+                               record_interval_s: float = 1.0,
+                               registry_gauges: int = 120,
+                               registry=None) -> dict:
+    """History-plane overhead guard (ISSUE 16): the same synthetic
+    serving pipeline as :func:`tracing_overhead_scenario` (scheduler +
+    deterministic executor, no HTTP socket) measured with the
+    time-series :class:`~mmlspark_tpu.obs.timeseries.Recorder` thread
+    OFF vs ON at its production cadence (1 s), over a registry
+    pre-seeded with ``registry_gauges`` extra gauge series so the
+    snapshot walks a production-scale sample surface.
+
+    The 1%% verdict is NOT read off the end-to-end p99 delta — a 1%%
+    effect (~30 us here) sits below the host's run-to-run p99 drift,
+    so an e2e diff would be a coin flip (the tracing guard's 5%% bound
+    is already at that noise floor). Instead the bound is decomposed
+    into two precisely measurable parts, and the e2e OFF/ON p99s ride
+    along as reported context only:
+
+    * ``overhead_pct`` — the recorder's amortized per-request share of
+      p99: median synchronous tick cost (timed directly, us
+      precision) x ``interarrival / record_interval_s``, over the
+      pipeline's best-of-``reps`` bare p99.
+    * ``affected_fraction`` — the collision geometry: a tick delays at
+      most ~2 in-flight requests, so
+      ``2 * interarrival / record_interval_s`` of requests can feel a
+      tick at all. Kept below the 1%% tail cut, a colliding tick
+      cannot reach the p99 statistic — the p99 request is a
+      non-collided one paying only the amortized share."""
+    from ..obs.metrics import MetricsRegistry
+    from ..obs.timeseries import Recorder, TimeSeriesStore
+    from ..sched import RequestScheduler
+
+    reg = registry if registry is not None else MetricsRegistry()
+    pad = reg.gauge("profile_bench_pad",
+                    "synthetic sample surface for the overhead guard")
+    for i in range(max(int(registry_gauges), 0)):
+        pad.set(float(i), idx=str(i))
+
+    def one_run(recording: bool) -> float:
+        sched = RequestScheduler(
+            f"{service}-{'on' if recording else 'off'}", registry=reg)
+        rec = None
+        if recording:
+            rec = Recorder(TimeSeriesStore(reg), reg)
+            rec.start(record_interval_s)
+        done: list[_SynthRequest] = []
+        stop = threading.Event()
+
+        def executor():
+            while not stop.is_set() or sched.qsize():
+                batch = sched.next_batch(max_batch=max_batch,
+                                         max_wait=0.05)
+                if not batch:
+                    continue
+                time.sleep(item_service_s * len(batch))
+                for item in batch:
+                    item.reply(200)
+                    done.append(item)
+
+        worker = threading.Thread(target=executor, daemon=True)
+        worker.start()
+        interval = item_service_s * 1.5
+        try:
+            for _ in range(n_requests):
+                req = _SynthRequest()
+                try:
+                    sched.submit(req)
+                except Exception:
+                    req.reply(503)
+                time.sleep(interval)
+            stop.set()
+            sched.wake()
+            worker.join(timeout=20)
+        finally:
+            if rec is not None:
+                rec.stop()
+        lat = sorted((r.done_at - r.submitted) for r in done
+                     if r.done_at is not None and r.status == 200)
+        if not lat:
+            return float("nan")
+        return lat[max(_ceil(0.99 * len(lat)) - 1, 0)]
+
+    offs, ons = [], []
+    for _ in range(reps):
+        offs.append(one_run(False))
+        ons.append(one_run(True))
+    p99_off, p99_on = min(offs), min(ons)
+
+    costs = []
+    probe = Recorder(TimeSeriesStore(reg), reg)
+    for _ in range(50):
+        t0 = time.perf_counter()
+        probe.tick()
+        costs.append(time.perf_counter() - t0)
+    costs.sort()
+    tick_cost_s = costs[len(costs) // 2]
+
+    interarrival = item_service_s * 1.5
+    amortized_s = tick_cost_s * interarrival / record_interval_s
+    overhead_pct = amortized_s / p99_off * 100.0
+    affected_fraction = 2.0 * interarrival / record_interval_s
+    return {
+        "n_requests": n_requests,
+        "item_service_s": item_service_s,
+        "reps": reps,
+        "record_interval_s": record_interval_s,
+        "registry_gauges": registry_gauges,
+        "p99_off_s": p99_off,
+        "p99_on_s": p99_on,
+        "tick_cost_s": tick_cost_s,
+        "amortized_per_request_s": amortized_s,
+        "affected_fraction": affected_fraction,
+        "overhead_pct": overhead_pct,
+        "bound_pct": 1.0,
+        "within_bound": (overhead_pct <= 1.0
+                         and affected_fraction <= 0.01),
+    }
+
+
+def regression_chaos_scenario(*, service: str = "regression-bench",
+                              seed: int = 23, chaos: bool = True,
+                              warmup: int = 8, inject_after: int = 12,
+                              max_ticks: int = 40,
+                              base_step_s: float = 0.010,
+                              slow_factor: float = 6.0,
+                              sustain_ticks: int = 3) -> dict:
+    """Live perf-regression acceptance (ISSUE 16): a seeded synthetic
+    training loop exports ``profile_mfu`` each tick; the recorder
+    samples it into a private store and the CUSUM sentinel watches.
+    With ``chaos=True`` a ``worker.slow`` fault (the resilience
+    plane's persistent-degradation path, ``factor=slow_factor``) arms
+    after ``inject_after`` ticks — MFU steps down by that factor and
+    the sentinel must flip ``obs_regression_active{series=
+    profile_mfu}`` within 20 recorder ticks of the step, after which
+    ``FleetHealth`` (sentinel attached) reads DEGRADED. With
+    ``chaos=False`` the identical replay must alarm exactly never —
+    the detector is a pure fold over the value sequence, so the
+    healthy trajectory is bit-identical run to run."""
+    from ..obs.fleet import FleetAggregator, FleetHealth
+    from ..obs.metrics import MetricsRegistry
+    from ..obs.regression import RegressionSentinel, SeriesWatch, _pull_mfu
+    from ..obs.timeseries import Recorder, TimeSeriesStore
+    from ..resilience import FaultRule, faults
+
+    reg = MetricsRegistry()
+    store = TimeSeriesStore(reg)
+    recorder = Recorder(store, reg)
+    sent = RegressionSentinel(store, reg, watches=[
+        SeriesWatch("profile_mfu", _pull_mfu, direction="lower_bad",
+                    warmup=warmup)], sustain_ticks=sustain_ticks)
+    health = FleetHealth(FleetAggregator(reg), registry=reg,
+                         service=service, store=store)
+    health.attach_sentinel(sent)
+    g_mfu = reg.gauge("profile_mfu", "model FLOP utilization, by stage")
+    peak_flops = 1.0e12
+    flops_per_step = base_step_s * peak_flops * 0.42   # healthy MFU 0.42
+
+    rules = []
+    if chaos:
+        rules = [FaultRule(point="worker.slow", kind="slow",
+                           match="trainer", times=1, after=inject_after,
+                           factor=slow_factor)]
+    step_at = None
+    alarm_tick = None
+    degraded_tick = None
+    events = 0
+    mfu_trace: list = []
+    with faults(seed, rules):
+        from ..resilience.faults import injector
+        for t in range(max_ticks):
+            injector.apply("worker.slow", "trainer")
+            slow = injector.degradation("trainer")
+            if slow > 1.0 and step_at is None:
+                step_at = t
+            step_s = base_step_s * slow
+            mfu = flops_per_step / (peak_flops * step_s)
+            mfu_trace.append(round(mfu, 4))
+            g_mfu.set(mfu, stage="train")
+            recorder.tick()
+            active = sent.tick()
+            verdict = health.tick()
+            if active and alarm_tick is None:
+                alarm_tick = t
+            if verdict == "degraded" and degraded_tick is None:
+                degraded_tick = t
+            if alarm_tick is not None and degraded_tick is not None \
+                    and t >= alarm_tick + sustain_ticks:
+                break
+        snap = reg.snapshot()
+        events = int(sum(v for k, v in snap.items()
+                         if k.startswith("obs_regression_events_total")))
+    return {
+        "chaos": chaos,
+        "seed": seed,
+        "mfu_healthy": mfu_trace[0] if mfu_trace else None,
+        "mfu_degraded": mfu_trace[-1] if mfu_trace else None,
+        "step_at_tick": step_at,
+        "alarm_tick": alarm_tick,
+        "ticks_to_alarm": (alarm_tick - step_at
+                           if alarm_tick is not None and step_at is not None
+                           else None),
+        "degraded_tick": degraded_tick,
+        "events": events,
+        "verdict_end": health.verdict(),
+        "mfu_trace": mfu_trace,
+    }

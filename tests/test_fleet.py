@@ -165,6 +165,67 @@ class TestFleetAggregator:
         assert merged['sched_x{process="0"}'] == 1.0
         assert merged['sched_x{process="1"}'] == 2.0
 
+    def test_staleness_consumer_frozen_clock(self):
+        """ISSUE 16 satellite: a source quiet for > 3x its learned
+        cadence flags stale exactly once (counter), and a fresh push
+        clears the flag. Frozen clock: no sleeps, no flake."""
+        reg = MetricsRegistry()
+        clock = _mono()
+        agg = FleetAggregator(reg, clock=clock)
+        agg.ingest_snapshot({"sched_x": 1.0}, process=0)
+        clock.advance(10.0)
+        agg.ingest_snapshot({"sched_x": 2.0}, process=0)  # cadence = 10 s
+        clock.advance(29.0)
+        assert agg.check_staleness() == {}      # age 29 < 3 x 10
+        clock.advance(2.0)
+        stale = agg.check_staleness()           # age 31 > 30: stale
+        assert stale["proc:0"]["age_s"] == 31.0
+        assert stale["proc:0"]["cadence_s"] == 10.0
+        agg.check_staleness()                   # still stale: no re-count
+        assert reg.snapshot()[
+            'fleet_sources_stale_total{source="proc:0"}'] == 1.0
+        assert agg.sources()["proc:0"]["stale"] is True
+        agg.ingest_snapshot({"sched_x": 3.0}, process=0)
+        assert agg.check_staleness() == {}
+        assert agg.sources()["proc:0"]["stale"] is False
+
+    def test_single_push_never_stale(self):
+        # one push proves nothing about a source's rhythm
+        clock = _mono()
+        agg = FleetAggregator(MetricsRegistry(), clock=clock)
+        agg.ingest_snapshot({"sched_x": 1.0}, process=0)
+        clock.advance(9999.0)
+        assert agg.check_staleness() == {}
+
+    def test_sub_second_cadence_gets_grace_floor(self):
+        # mesh heartbeats push every ~0.1 s; scheduler jitter of a few
+        # hundred ms must NOT flag (MIN_STALE_S absolute floor)
+        clock = _mono()
+        agg = FleetAggregator(MetricsRegistry(), clock=clock)
+        agg.ingest_snapshot({"sched_x": 1.0}, worker="w0")
+        clock.advance(0.1)
+        agg.ingest_snapshot({"sched_x": 2.0}, worker="w0")
+        clock.advance(0.9)            # 9x cadence, but under the floor
+        assert agg.check_staleness() == {}
+        clock.advance(0.2)            # past the 1 s floor: stale
+        assert "worker:w0" in agg.check_staleness()
+
+    def test_stale_source_degrades_health_never_critical(self):
+        reg = MetricsRegistry()
+        clock = _mono()
+        agg = FleetAggregator(reg, clock=clock)
+        health = FleetHealth(agg, registry=reg)
+        agg.ingest_snapshot({"sched_x": 1.0}, process=0)
+        clock.advance(10.0)
+        agg.ingest_snapshot({"sched_x": 2.0}, process=0)
+        clock.advance(31.0)
+        assert health.tick() == "degraded"
+        status, body = health.healthz_payload()
+        assert status == 200          # degraded still answers 200
+        payload = json.loads(body)
+        assert payload["stale_sources"] == ["proc:0"]
+        assert any("stale_sources=1" in r for r in payload["reasons"])
+
 
 # ---------------------------------------------------- straggler detection
 
@@ -264,6 +325,61 @@ class TestStragglerDetector:
         assert len(spans) == 1
         assert spans[0].attrs.get("process") == "3"
 
+    def test_flap_suppression_debounces_marginal_reflag(self):
+        """ISSUE 16 satellite: a rank that unflags and then wanders
+        marginally back over the threshold is held back one tick (its
+        excess is small against its own recorded score volatility);
+        breaching on two consecutive ticks lands. First flag and
+        recovery stay immediate."""
+        det, agg, reg = self._det()
+
+        def push(mean3):
+            for p, m in (("0", 0.10), ("1", 0.11), ("2", 0.105)):
+                agg.ingest_snapshot(_step_samples(p, m), process=p)
+            agg.ingest_snapshot(_step_samples("3", mean3), process="3")
+
+        push(0.20)
+        assert det.tick() == {("process", "3")}   # first flag: immediate
+        push(0.105)
+        assert det.tick() == set()                # recovery: immediate
+        push(0.13)                                # marginal re-breach
+        assert det.tick() == set()                # debounced
+        assert reg.snapshot()[
+            'fleet_straggler_flaps_suppressed_total{process="3"}'] == 1.0
+        push(0.13)                                # consecutive: sustained
+        assert det.tick() == {("process", "3")}
+
+    def test_flap_suppression_passes_large_excess(self):
+        # a relapse far beyond the rank's own score noise lands
+        # immediately even inside the flap window
+        det, agg, _ = self._det()
+
+        def push(mean3):
+            for p, m in (("0", 0.10), ("1", 0.11), ("2", 0.105)):
+                agg.ingest_snapshot(_step_samples(p, m), process=p)
+            agg.ingest_snapshot(_step_samples("3", mean3), process="3")
+
+        push(0.20)
+        assert det.tick() == {("process", "3")}
+        push(0.105)
+        assert det.tick() == set()
+        push(0.60)                                # massive relapse
+        assert det.tick() == {("process", "3")}
+
+    def test_scores_recorded_into_history_store(self):
+        from mmlspark_tpu.obs.timeseries import TimeSeriesStore
+        reg = MetricsRegistry()
+        agg = FleetAggregator(reg)
+        store = TimeSeriesStore(reg)
+        det = StragglerDetector(agg, registry=reg, store=store)
+        for p, mean in (("0", 0.1), ("1", 0.11), ("2", 0.09)):
+            agg.ingest_snapshot(_step_samples(p, mean), process=p)
+        det.tick()
+        det.tick()
+        pts = store.points('fleet_straggler_score{process="1"}')
+        assert len(pts) == 2
+        assert pts[0][1] == pytest.approx(0.11 / 0.1)
+
 
 # ------------------------------------------------------- SLO burn rate
 
@@ -331,7 +447,11 @@ class TestBurnRateMonitor:
         for i in range(100):
             mon.tick(self._samples(i, 0))
             clock.advance(1.0)
-        assert len(mon._history) <= 20
+        # history lives in the time-series store now; retention is the
+        # horizon (max window × 1.5 + 1), so 100 one-second ticks must
+        # not accumulate — every series stays bounded by the horizon
+        _, points = mon._store.size()
+        assert points <= 3 * 20
 
 
 # ------------------------------------------------------------ health

@@ -1,0 +1,346 @@
+"""Telemetry history plane (obs/timeseries.py, ISSUE 16): the bounded
+time-series store, the registry recorder, the /debug/timeline route on
+BOTH serving fronts, histogram quantiles, and the ≤1% recorder
+overhead guard."""
+
+import http.client
+import json
+import math
+
+import numpy as np
+import pytest
+
+from mmlspark_tpu.obs.metrics import (DEFAULT_LATENCY_BUCKETS,
+                                      MetricsRegistry, bucket_quantile)
+from mmlspark_tpu.obs.timeseries import (DEFAULT_RECORD_PREFIXES, Recorder,
+                                         TimeSeriesStore)
+
+
+def _mono(start=1000.0):
+    state = {"t": start}
+
+    def clock():
+        return state["t"]
+
+    clock.advance = lambda dt: state.__setitem__("t", state["t"] + dt)
+    return clock
+
+
+def _store(**kw):
+    reg = MetricsRegistry()
+    clock = _mono()
+    return TimeSeriesStore(reg, clock=clock, **kw), reg, clock
+
+
+# ------------------------------------------------------------- store core
+
+class TestTimeSeriesStore:
+    def test_append_points_window_clipping(self):
+        store, _, clock = _store()
+        for v in (1.0, 2.0, 3.0):
+            store.append("sched_x", v)
+            clock.advance(10.0)
+        assert [p[1] for p in store.points("sched_x")] == [1.0, 2.0, 3.0]
+        # clock is now 30 s past the first point: a trailing 25 s
+        # window keeps only the last two
+        assert [p[1] for p in store.points("sched_x", 25.0)] == [2.0, 3.0]
+        assert store.latest("sched_x")[1] == 3.0
+        assert store.points("unknown") == []
+
+    def test_ring_eviction_bounded_and_counted(self):
+        store, reg, _ = _store()
+        store.ensure("sched_x", maxlen=4)
+        for v in range(10):
+            store.append("sched_x", float(v))
+        pts = store.points("sched_x")
+        assert len(pts) == 4
+        assert [p[1] for p in pts] == [6.0, 7.0, 8.0, 9.0]
+        snap = reg.snapshot()
+        assert snap['obs_timeseries_evicted_total{reason="ring"}'] == 6.0
+        assert snap["obs_timeseries_points"] == 4.0
+
+    def test_retention_eviction_frozen_clock(self):
+        store, reg, clock = _store()
+        store.ensure("sched_x", retention_s=30.0)
+        for _ in range(6):
+            store.append("sched_x", 1.0)
+            clock.advance(10.0)
+        # eviction runs at append time: the last append (t=+50) drops
+        # everything older than its 30 s horizon (t=+10 survives, at
+        # exactly the horizon edge)
+        assert len(store.points("sched_x")) == 4
+        assert reg.snapshot()[
+            'obs_timeseries_evicted_total{reason="retention"}'] == 2.0
+
+    def test_global_bound_evicts_oldest_first(self):
+        store, reg, clock = _store(max_total_points=6)
+        for i in range(4):
+            store.append("sched_old", float(i))
+            clock.advance(1.0)
+        for i in range(4):
+            store.append("sched_new", float(i))
+            clock.advance(1.0)
+        n_series, n_points = store.size()
+        assert n_points == 6
+        # the two oldest points (both in sched_old) were dropped
+        assert len(store.points("sched_old")) == 2
+        assert len(store.points("sched_new")) == 4
+        assert reg.snapshot()[
+            'obs_timeseries_evicted_total{reason="global"}'] == 2.0
+
+    def test_increase_survives_counter_reset(self):
+        store, _, clock = _store()
+        for v in (10.0, 15.0, 2.0, 5.0):   # reset between 15 and 2
+            store.append("sched_total", v)
+            clock.advance(1.0)
+        # positive deltas only: 5 + 3, never a negative fabrication
+        assert store.increase("sched_total", 100.0) == 8.0
+        assert store.rate("sched_total", 100.0) == pytest.approx(8.0 / 3.0)
+
+    def test_window_functions(self):
+        store, _, clock = _store()
+        for v in (1.0, 9.0, 2.0, 8.0, 5.0):
+            store.append("sched_x", v)
+            clock.advance(1.0)
+        assert store.avg_over_time("sched_x", 100.0) == 5.0
+        assert store.min_over_time("sched_x", 100.0) == 1.0
+        assert store.max_over_time("sched_x", 100.0) == 9.0
+        # MAD of [1,9,2,8,5]: median 5, deviations [4,4,3,3,0] -> 3
+        assert store.mad_over_time("sched_x", 100.0) == 3.0
+        assert store.mad_over_time("sched_x", 0.5) == 0.0  # 1 point
+
+    def test_range_matches_exact_and_prefix(self):
+        store, _, _ = _store()
+        store.append('serving_x{route="/a"}', 1.0)
+        store.append('serving_x{route="/b"}', 2.0)
+        store.append("profile_y", 3.0)
+        out = store.range(["serving_x"])
+        assert set(out) == {'serving_x{route="/a"}',
+                            'serving_x{route="/b"}'}
+        assert set(store.range(["profile_y"])) == {"profile_y"}
+
+    def test_quantile_over_time_windowed(self):
+        """The reconstructed quantile sees only the WINDOW's
+        observations: old latency in the cumulative buckets must not
+        leak into a recent-window p99."""
+        store, reg, clock = _store()
+        h = reg.histogram("serving_request_seconds", "h",
+                          buckets=DEFAULT_LATENCY_BUCKETS)
+        rec = Recorder(store, reg, prefixes=("serving_",))
+
+        def observe_and_tick(vals):
+            for v in vals:
+                h.observe(v, route="/")
+            rec.tick()
+            clock.advance(10.0)
+
+        # seed tick: labelled bucket series only exist once observed,
+        # so the full-window increase needs a pre-era endpoint
+        observe_and_tick([0.001])
+        observe_and_tick([0.001] * 149)  # old: 1 ms era (dominant)
+        observe_and_tick([0.1] * 50)     # recent: 100 ms era
+        # window spanning the last two ticks: only the 100 ms era's
+        # bucket deltas land in it (increase needs both endpoints)
+        recent = store.quantile_over_time(
+            "serving_request_seconds", 0.5, 25.0, route="/")
+        assert 0.05 <= recent <= 0.2    # sees only the 100 ms era
+        full = store.quantile_over_time(
+            "serving_request_seconds", 0.5, 1000.0, route="/")
+        assert full < 0.05              # both eras: median back at ~1 ms
+        # empty window: no observation, not a crash
+        assert store.quantile_over_time(
+            "serving_request_seconds", 0.99, 1e-6) == 0.0
+
+    def test_clear_resets(self):
+        store, _, _ = _store()
+        store.append("sched_x", 1.0)
+        store.clear()
+        assert store.size() == (0, 0)
+
+
+# ---------------------------------------------------------- histogram q
+
+class TestHistogramQuantile:
+    def test_bucket_quantile_against_exact_percentiles(self):
+        """The log-ladder interpolation must land within one bucket's
+        width of numpy's exact percentile on a known sample."""
+        rng = np.random.default_rng(7)
+        samples = rng.uniform(0.001, 0.2, size=2000)
+        reg = MetricsRegistry()
+        h = reg.histogram("lat_seconds", "h",
+                          buckets=DEFAULT_LATENCY_BUCKETS)
+        for s in samples:
+            h.observe(float(s))
+        for q in (0.5, 0.9, 0.99):
+            exact = float(np.percentile(samples, q * 100))
+            est = h.quantile(q)
+            # bucket edges double: the estimate is within the bucket
+            # that holds the exact value (factor-2 bound each side)
+            assert exact / 2 <= est <= exact * 2, (q, exact, est)
+
+    def test_quantile_labels_and_missing_series(self):
+        reg = MetricsRegistry()
+        h = reg.histogram("lat_seconds", "h", buckets=(0.1, 1.0))
+        h.observe(0.05, route="/a")
+        assert h.quantile(0.5, route="/a") > 0
+        assert h.quantile(0.5, route="/zzz") == 0.0
+
+    def test_inf_bucket_clamps_to_top_bound(self):
+        # observations beyond the ladder clamp to the top finite bound
+        # (documented +Inf bias: the estimator cannot see past it)
+        assert bucket_quantile((0.1, 1.0), [0, 0, 5], 0.99) == 1.0
+
+    def test_edge_cases(self):
+        assert bucket_quantile((0.1, 1.0), [0, 0, 0], 0.5) == 0.0
+        assert bucket_quantile((), [], 0.5) == 0.0
+        # q clamped into [0, 1]
+        assert bucket_quantile((0.1,), [4, 0], 2.0) == 0.1
+
+
+# -------------------------------------------------------------- recorder
+
+class TestRecorder:
+    def test_tick_samples_only_configured_prefixes(self):
+        reg = MetricsRegistry()
+        store = TimeSeriesStore(reg)
+        reg.gauge("serving_queue_depth", "h").set(3.0)
+        reg.gauge("profile_mfu", "h").set(0.4, stage="train")
+        reg.gauge("unrelated_gauge", "h").set(9.0)
+        rec = Recorder(store, reg)
+        n = rec.tick()
+        assert n >= 2
+        names = store.series_names()
+        assert "serving_queue_depth" in names
+        assert 'profile_mfu{stage="train"}' in names
+        assert not any(n.startswith("unrelated") for n in names)
+        snap = reg.snapshot()
+        assert snap["obs_recorder_ticks_total"] == 1.0
+        assert snap["obs_recorder_points_total"] == float(n)
+        assert "obs_recorder_tick_seconds" in snap
+
+    def test_default_prefixes_cover_federated_families(self):
+        for p in ("profile_", "sched_", "serving_", "mem_", "fleet_",
+                  "aot_", "slo_"):
+            assert p in DEFAULT_RECORD_PREFIXES
+
+    def test_start_stop_idempotent(self):
+        reg = MetricsRegistry()
+        rec = Recorder(TimeSeriesStore(reg), reg)
+        try:
+            assert not rec.running
+            rec.start(0.05)
+            t1 = rec._thread
+            rec.start(0.05)          # idempotent: same thread
+            assert rec._thread is t1
+            assert rec.running
+        finally:
+            rec.stop()
+        assert not rec.running
+        rec.start(0.05)              # restartable after stop
+        try:
+            assert rec.running
+        finally:
+            rec.stop()
+
+
+# ------------------------------------------------------- /debug/timeline
+
+class TestTimelineRoute:
+    def _get(self, addr, path):
+        conn = http.client.HTTPConnection(*addr, timeout=10)
+        try:
+            conn.request("GET", path)
+            resp = conn.getresponse()
+            return resp.status, resp.read()
+        finally:
+            conn.close()
+
+    def _pipeline(self):
+        from mmlspark_tpu.io.http.schema import HTTPResponseData
+
+        def pipeline(df):
+            replies = np.empty(len(df), object)
+            replies[:] = [HTTPResponseData(status_code=200, entity=b"ok")
+                          for _ in df["request"]]
+            return df.with_column("reply", replies)
+
+        return pipeline
+
+    def _post(self, addr):
+        conn = http.client.HTTPConnection(*addr, timeout=10)
+        try:
+            conn.request("POST", "/", body=b"x")
+            resp = conn.getresponse()
+            resp.read()
+            return resp.status
+        finally:
+            conn.close()
+
+    def _assert_timeline(self, addr):
+        from mmlspark_tpu.obs.timeseries import recorder
+        assert self._post(addr) == 200
+        recorder.tick()       # deterministic sample (thread-free test)
+        # index mode: no series param -> names + sizes
+        status, body = self._get(addr, "/debug/timeline")
+        assert status == 200
+        payload = json.loads(body)
+        assert payload["series_total"] >= 1
+        assert isinstance(payload["series"], dict)
+        # query mode: prefix patterns + window (query-string routing)
+        status, body = self._get(
+            addr, "/debug/timeline?series=serving_&window=600")
+        assert status == 200
+        payload = json.loads(body)
+        assert payload["window_s"] == 600.0
+        assert any(name.startswith("serving_")
+                   for name in payload["series"])
+        some = next(iter(payload["series"].values()))
+        assert all(len(p) == 2 for p in some)
+        # bad window -> 400, never a stack trace
+        status, _ = self._get(addr, "/debug/timeline?window=banana")
+        assert status == 400
+
+    def test_timeline_on_python_front(self):
+        from mmlspark_tpu.serving import serving_query
+        q = serving_query("timelinepy", self._pipeline(),
+                          backend="python")
+        try:
+            self._assert_timeline(q.server.address)
+        finally:
+            q.stop()
+
+    def test_timeline_on_native_front(self):
+        from mmlspark_tpu.native.loader import get_httpfront
+        if get_httpfront() is None:
+            pytest.skip("native http front unavailable")
+        from mmlspark_tpu.serving import serving_query
+        q = serving_query("timelinenat", self._pipeline(),
+                          backend="native")
+        try:
+            self._assert_timeline(q.server.address)
+        finally:
+            q.stop()
+
+
+# ------------------------------------------------------- overhead guard
+
+class TestRecorderOverheadGuard:
+    def test_recorder_overhead_within_1pct(self):
+        """ISSUE 16 acceptance: the recorder at production cadence
+        costs the serving p99 less than 1% — amortized tick share
+        bounded directly (us-precision timing, not an e2e p99 diff
+        that would drown in host noise) plus the collision-geometry
+        check that keeps a tick out of the p99 tail. One bounded
+        re-measure absorbs a noisy scheduler rep — persistent
+        overhead still fails both."""
+        from mmlspark_tpu.testing.benchmarks import \
+            recorder_overhead_scenario
+
+        r = recorder_overhead_scenario()
+        if not r["within_bound"]:
+            r = recorder_overhead_scenario()
+        assert r["within_bound"], r
+        assert r["p99_on_s"] > 0 and r["p99_off_s"] > 0
+        assert r["tick_cost_s"] > 0
+        assert r["affected_fraction"] <= 0.01
+        assert not math.isnan(r["overhead_pct"])
