@@ -1909,6 +1909,97 @@ def bench_multichip(extras: dict) -> None:
     extras.update(parsed)
 
 
+def bench_llm_serving(extras: dict) -> None:
+    """Multi-host LLM serving bench: N independent scrubbed-subprocess
+    "hosts" each run the paged-KV serving engine
+    (``testing.benchmarks.llm_serving_scenario``: warmed prefill/decode
+    programs, repeated-prefix workload, CompileTracker steady state)
+    and report their registry-backed numbers as one JSON line; the
+    parent aggregates them the way a fleet scoreboard would — summed
+    tokens/sec across hosts, worst-host TTFT p99, mean prefix-cache
+    hit rate. Host 0 additionally runs the speculative variant
+    (self-draft ⇒ acceptance upper bound, labeled as such — same
+    stance as bench_gen's spec rows).
+
+    Scrubbed subprocesses for the same reason as bench_multichip: the
+    session environment pins jax to the single-chip tunnel, and a
+    wedged tunnel must not hang the parent. The platform rides in
+    ``llm_platform`` so host-CPU numbers are never mistaken for TPU
+    serving throughput."""
+    import subprocess
+    import sys
+
+    from mmlspark_tpu.core.utils import scrubbed_cpu_env
+
+    hosts = 2
+    repo = os.path.dirname(os.path.abspath(__file__))
+
+    def run_host(rank: int) -> dict:
+        spec = ("out['spec'] = {k: v for k, v in llm_serving_scenario("
+                f"service='llm-bench-spec{rank}', "
+                "registry=MetricsRegistry(), spec_k=2, seed=29).items() "
+                "if k != 'outputs'}; " if rank == 0 else "")
+        code = (
+            "import json; "
+            "import jax; jax.config.update('jax_platforms', 'cpu'); "
+            "from mmlspark_tpu.obs.metrics import MetricsRegistry; "
+            "from mmlspark_tpu.testing.benchmarks import "
+            "llm_serving_scenario; "
+            f"out = llm_serving_scenario(service='llm-bench{rank}', "
+            f"registry=MetricsRegistry(), seed=17 + {rank}); "
+            "out.pop('outputs'); "
+            + spec +
+            "print(json.dumps(out), flush=True)")
+        proc = subprocess.run(
+            [sys.executable, "-c", code],
+            env=scrubbed_cpu_env(extra_path=repo), cwd=repo,
+            capture_output=True, text=True,
+            timeout=420 * _timeout_scale())
+        parsed = None
+        for line in reversed((proc.stdout or "").splitlines()):
+            try:
+                candidate = json.loads(line)
+            except ValueError:
+                continue
+            if isinstance(candidate, dict):
+                parsed = candidate
+                break
+        if proc.returncode != 0 or not isinstance(parsed, dict):
+            raise RuntimeError(
+                f"llm serving host {rank} failed "
+                f"(rc={proc.returncode}):\n"
+                f"{((proc.stdout or '') + (proc.stderr or ''))[-2000:]}")
+        return parsed
+
+    results = [run_host(r) for r in range(hosts)]
+    spec = results[0].pop("spec", None)
+    extras["llm_hosts"] = hosts
+    extras["llm_platform"] = "cpu-host (scrubbed subprocess)"
+    extras["llm_tokens_per_sec"] = round(
+        sum(r["tokens_per_s"] for r in results), 1)
+    # the banked TTFT row the loadgen generation mode mirrors
+    # client-side: worst host, p99, milliseconds
+    extras["gen_ttft_p99_ms"] = round(
+        max(r["ttft_p99_ms"] for r in results), 3)
+    extras["llm_ttft_cold_p50_ms"] = round(
+        max(r["ttft_cold_p50_ms"] for r in results), 3)
+    extras["llm_ttft_warm_p50_ms"] = round(
+        max(r["ttft_warm_p50_ms"] for r in results), 3)
+    extras["llm_prefix_hit_rate"] = round(
+        sum(r["prefix_hit_rate"] for r in results) / hosts, 3)
+    extras["llm_ttft_warm_vs_cold"] = round(
+        extras["llm_ttft_cold_p50_ms"]
+        / max(extras["llm_ttft_warm_p50_ms"], 1e-9), 2)
+    extras["llm_steady_state_ok"] = all(
+        r.get("steady_state_ok") for r in results)
+    extras["llm_aot_fingerprints"] = sum(
+        r.get("aot_fingerprints", 0) for r in results)
+    if spec is not None:
+        extras["llm_spec_tokens_per_sec"] = round(
+            spec["tokens_per_s"], 1)
+        extras["llm_spec_accept_ratio"] = spec["spec_accept_ratio"]
+
+
 def _emit(images_per_sec: float, extras: dict) -> None:
     print(json.dumps({
         "metric": "imagefeaturizer_resnet50_inference",
@@ -2068,6 +2159,10 @@ def main():
             # scrubbed-subprocess bench: immune to a wedged tunnel, so
             # it can run even late in the suite
             _watchdog(bench_multichip, extras, "multichip", 600.0)
+        if want("llm_serving"):
+            # multi-host generation bench (paged KV + prefill/decode
+            # executors): scrubbed subprocesses, tunnel-immune
+            _watchdog(bench_llm_serving, extras, "llm_serving", 600.0)
         if want("observability"):
             # pure host-side (scheduler + in-thread mesh): tunnel-immune
             _watchdog(bench_observability, extras, "observability",

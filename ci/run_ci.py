@@ -67,6 +67,9 @@ PACKAGES: dict[str, list[str]] = {
     "multihost": ["test_multihost.py"],
     "text": ["test_text_transfer.py", "test_causal_lm.py",
              "test_speculative.py"],
+    # LLM serving engine: paged KV bookkeeping (no-JAX half) +
+    # disaggregated prefill/decode + in-batch speculation
+    "llm": ["test_paged_kv.py", "test_llm_serving.py"],
 }
 
 # traceable-count ratchet (ISSUE 10): the analysis gate fails if the
@@ -198,6 +201,34 @@ def style() -> int:
              "assert 'jax' not in sys.modules, 'sched import pulled jax'; "
              "s.RequestScheduler('ci-smoke').submit(type('I', (), {})()); "
              "print('sched import OK (no jax)')")
+    rc = _run([sys.executable, "-c", smoke],
+              env=dict(os.environ, JAX_PLATFORMS="cpu"))
+    if rc:
+        return rc
+    # the paged KV cache's bookkeeping half (block table, prefix index,
+    # LRU, handoff payloads) is pure Python + numpy: the serving
+    # control plane allocates/adopts/exports on machines with no
+    # device, so the whole lifecycle must run with no JAX at all —
+    # device pools only materialize when an executor gathers/scatters
+    smoke = (
+        "import sys\n"
+        "from mmlspark_tpu.dl.paged_kv import (PagedKVManager, "
+        "SequenceHandle, TRASH_BLOCK, blocks_for_hbm_budget)\n"
+        "from mmlspark_tpu.obs.metrics import MetricsRegistry\n"
+        "assert 'jax' not in sys.modules, 'paged_kv import pulled jax'\n"
+        "m = PagedKVManager(9, 4, registry=MetricsRegistry(), "
+        "service='ci')\n"
+        "h = m.allocate('a', list(range(1, 9)))\n"
+        "assert len(h.chain) == 2 and TRASH_BLOCK not in h.chain\n"
+        "m.publish('a'); m.advance('a', 8)\n"
+        "state = m.export_seq('a')\n"
+        "assert m.adopt(state).length == 8\n"
+        "m.release('a')\n"
+        "assert m.allocate('b', list(range(1, 9))).reused_tokens == 8\n"
+        "assert m.block_rows(['b', None], 3).shape == (2, 3)\n"
+        "assert blocks_for_hbm_budget(1024, default=5) >= 0\n"
+        "assert 'jax' not in sys.modules, 'kv bookkeeping pulled jax'\n"
+        "print('dl.paged_kv import OK (no jax)')")
     rc = _run([sys.executable, "-c", smoke],
               env=dict(os.environ, JAX_PLATFORMS="cpu"))
     if rc:

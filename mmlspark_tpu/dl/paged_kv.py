@@ -1,0 +1,546 @@
+"""Paged (block) KV cache for LLM serving: host block table + device pools.
+
+A dense per-sequence KV cache sizes every sequence at ``max_len`` —
+HBM pays for the worst case while the mean sequence uses a fraction of
+it, and two requests sharing a long system prompt pay for it twice.
+The paged layout (vLLM's PagedAttention; the TPU serving comparison in
+arXiv:2605.25645 attributes most of its throughput win to it) instead
+carves the cache into fixed ``[num_blocks, block_len, heads, head_dim]``
+pools and gives each sequence a CHAIN of block indices: memory is
+allocated in ``block_len``-token quanta as decoding advances, and a
+block holding a popular prompt prefix is SHARED copy-free between
+sequences via refcounts.
+
+Two halves, same split as continuous batching
+(``sched.SlotScheduler`` / ``dl.ContinuousGenerator``):
+
+- **Host half (this module's** :class:`PagedKVManager` **— pure Python,
+  no JAX)**: the block table. Free-list allocation, per-sequence chains,
+  refcounted prefix reuse keyed by a rolling prompt-prefix hash (one
+  hash per full ``block_len`` chunk, chained so a block's key commits to
+  everything before it), LRU eviction of retired-but-cached blocks, and
+  a block budget derived from the live HBM headroom (``obs.memory``).
+  Importable and testable with no device — the serving control plane
+  runs it from handler threads (CI style smoke asserts no jax).
+- **Device half (lazy jax imports)**: pool init plus the gather/scatter
+  bridges the prefill/decode executors (``serving.llm``) jit around the
+  existing ``MaskedLMModel.prefill/decode_step/decode_window`` numerics
+  — the paged path reuses the exact attention math ``dl.generate`` is
+  equivalence-tested against, so paged decode stays greedy-identical.
+
+Block 0 is RESERVED as the trash block: padded batch rows and inactive
+slots point their block-table entries at it, so fixed-shape device
+programs can always write "somewhere" without corrupting a live
+sequence (gathers from it are masked by sequence length).
+
+Obs families (federated fleet-wide, recorded by the history plane):
+``kv_blocks_used`` / ``kv_blocks_free`` / ``kv_blocks_cached`` gauges,
+``kv_prefix_hits_total`` / ``kv_prefix_misses_total`` /
+``kv_prefix_tokens_reused_total`` / ``kv_evictions_total`` counters.
+"""
+
+from __future__ import annotations
+
+import hashlib
+from collections import OrderedDict, deque
+from dataclasses import dataclass, field
+
+import numpy as np
+
+from ..obs import registry as _default_registry
+
+__all__ = ["PagedKVManager", "SequenceHandle", "OutOfBlocks",
+           "blocks_for_hbm_budget", "init_pools", "gather_dense",
+           "scatter_positions", "take_positions"]
+
+#: the reserved trash block — device programs route padded/inactive
+#: writes here; the host half never hands it to a sequence
+TRASH_BLOCK = 0
+
+
+class OutOfBlocks(RuntimeError):
+    """The pool cannot serve an allocation: every non-reserved block is
+    referenced by a live sequence (nothing evictable). Callers queue the
+    sequence and retry at a later step boundary — admission control,
+    not a crash."""
+
+
+@dataclass
+class SequenceHandle:
+    """One sequence's view of the pool: the block chain and how many
+    token positions are filled. ``prompt_len`` rides along so executors
+    can split prefill cost from decode cost without a side channel."""
+    seq_id: object
+    chain: list[int]
+    length: int
+    prompt_len: int
+    reused_tokens: int = 0
+    # hashes for the full prompt chunks this sequence must publish into
+    # the prefix index once prefill has actually filled them
+    pending_publish: list[tuple[str, int]] = field(default_factory=list)
+
+    def to_state(self) -> dict:
+        """JSON-able handoff payload (the mesh ``__lease__`` envelope
+        carries dicts): everything the decode side needs to adopt the
+        sequence."""
+        return {"seq_id": self.seq_id, "chain": list(self.chain),
+                "length": int(self.length),
+                "prompt_len": int(self.prompt_len),
+                "reused_tokens": int(self.reused_tokens)}
+
+    @classmethod
+    def from_state(cls, state: dict) -> "SequenceHandle":
+        return cls(seq_id=state["seq_id"],
+                   chain=[int(b) for b in state["chain"]],
+                   length=int(state["length"]),
+                   prompt_len=int(state["prompt_len"]),
+                   reused_tokens=int(state.get("reused_tokens", 0)))
+
+
+def _chunk_hash(prev: str, tokens) -> str:
+    """Rolling hash for one full ``block_len`` chunk: commits to the
+    previous chunk's hash, so equal blocks match only on equal whole
+    prefixes (prefix reuse must never splice a block into a different
+    history)."""
+    h = hashlib.blake2b(prev.encode(), digest_size=16)
+    h.update(b"|")
+    h.update(",".join(str(int(t)) for t in tokens).encode())
+    return h.hexdigest()
+
+
+def blocks_for_hbm_budget(block_bytes: int, *, fraction: float = 0.5,
+                          default: int = 0) -> int:
+    """How many KV blocks fit in ``fraction`` of the CURRENT free HBM
+    (``obs.memory.device_memory_stats``; limit − in_use of the first
+    local device). Returns ``default`` when no backend/allocator stats
+    exist (CPU, host-only process) — the no-JAX half must size pools
+    without a device."""
+    from ..obs.memory import device_memory_stats
+    stats = device_memory_stats()
+    if not stats or block_bytes <= 0:
+        return int(default)
+    s = stats[0]
+    limit = s.get("bytes_limit")
+    in_use = s.get("bytes_in_use")
+    if not limit:
+        return int(default)
+    free = max(int(limit) - int(in_use or 0), 0)
+    return max(int(free * float(fraction)) // int(block_bytes), 0)
+
+
+class PagedKVManager:
+    """Host-side block table: pure-Python bookkeeping, no JAX.
+
+    ``num_blocks`` counts the WHOLE pool including the reserved trash
+    block 0; ``block_budget`` (optional, defaults to every allocatable
+    block) caps how many blocks may be used+cached at once — set it
+    from :func:`blocks_for_hbm_budget` to keep the KV pools under the
+    live HBM headroom, or lower it at runtime via
+    :meth:`set_block_budget` (cached blocks are LRU-evicted to fit).
+
+    Lifecycle per sequence::
+
+        h = mgr.allocate(seq_id, prompt_tokens)   # prefix reuse happens here
+        mgr.publish(seq_id)                       # after prefill fills blocks
+        mgr.ensure_capacity(seq_id, n)            # before writes past capacity
+        mgr.advance(seq_id, k)                    # after k tokens committed
+        mgr.release(seq_id)                       # blocks cached for reuse
+
+    A released sequence's published prompt blocks stay in the prefix
+    index (refcount 0, LRU-ordered) until eviction recycles them — the
+    "cache" in KV cache hit rate.
+    """
+
+    def __init__(self, num_blocks: int, block_len: int, *,
+                 block_budget: int | None = None, service: str = "llm",
+                 registry=None):
+        if num_blocks < 2:
+            raise ValueError("need at least 2 blocks (block 0 is the "
+                             "reserved trash block)")
+        if block_len < 1:
+            raise ValueError("block_len must be >= 1")
+        reg = registry if registry is not None else _default_registry
+        self.num_blocks = int(num_blocks)
+        self.block_len = int(block_len)
+        self.service = service
+        self._free: deque[int] = deque(range(1, self.num_blocks))
+        self._ref: dict[int, int] = {}
+        self._seqs: dict[object, SequenceHandle] = {}
+        # published full prompt chunks: hash -> block, block -> hash
+        self._prefix_index: dict[str, int] = {}
+        self._block_hash: dict[int, str] = {}
+        # zero-ref published blocks, least-recently-retired first
+        self._lru: OrderedDict[int, str] = OrderedDict()
+        self._budget = int(block_budget) if block_budget else \
+            self.num_blocks - 1
+        self._budget = max(min(self._budget, self.num_blocks - 1), 1)
+        self._g_used = reg.gauge(
+            "kv_blocks_used",
+            "KV blocks referenced by live sequences, by service")
+        self._g_free = reg.gauge(
+            "kv_blocks_free",
+            "KV blocks on the free list (never-written or recycled), "
+            "by service")
+        self._g_cached = reg.gauge(
+            "kv_blocks_cached",
+            "retired zero-ref KV blocks still indexed for prefix "
+            "reuse, by service")
+        self._c_hits = reg.counter(
+            "kv_prefix_hits_total",
+            "prompt-prefix blocks served copy-free from the index, "
+            "by service")
+        self._c_misses = reg.counter(
+            "kv_prefix_misses_total",
+            "full prompt chunks that found no indexed block, by service")
+        self._c_reused = reg.counter(
+            "kv_prefix_tokens_reused_total",
+            "prompt tokens whose prefill was skipped via prefix reuse, "
+            "by service")
+        self._c_evict = reg.counter(
+            "kv_evictions_total",
+            "cached KV blocks recycled under pool/HBM pressure, "
+            "by service")
+        self._publish_gauges()
+
+    # -- internals ---------------------------------------------------------
+    def _publish_gauges(self) -> None:
+        self._g_used.set(len(self._ref), service=self.service)
+        self._g_free.set(len(self._free), service=self.service)
+        self._g_cached.set(len(self._lru), service=self.service)
+
+    def _in_budget(self) -> bool:
+        return len(self._ref) + len(self._lru) < self._budget
+
+    def _evict_one(self) -> int | None:
+        """Recycle the least-recently-retired cached block onto the
+        free list; None when nothing is evictable."""
+        if not self._lru:
+            return None
+        block, h = self._lru.popitem(last=False)
+        self._prefix_index.pop(h, None)
+        self._block_hash.pop(block, None)
+        self._free.append(block)
+        self._c_evict.inc(1, service=self.service)
+        return block
+
+    def _take_block(self) -> int:
+        # budget first: even with free blocks in hand, used+cached must
+        # stay under the HBM-derived cap, so pressure evicts the cache
+        # before it grows the working set
+        while not self._in_budget():
+            if self._evict_one() is None:
+                raise OutOfBlocks(
+                    f"block budget {self._budget} exhausted by live "
+                    f"sequences ({len(self._ref)} blocks referenced)")
+        if not self._free and self._evict_one() is None:
+            raise OutOfBlocks(
+                f"all {self.num_blocks - 1} blocks referenced by live "
+                "sequences — queue the request and retry at the next "
+                "step boundary")
+        return self._free.popleft()
+
+    # -- intake ------------------------------------------------------------
+    def allocate(self, seq_id, prompt_tokens) -> SequenceHandle:
+        """Build ``seq_id``'s chain for ``prompt_tokens``: reuse indexed
+        blocks for the longest matching whole-chunk prefix (refcount++,
+        copy-free), allocate fresh blocks for the rest. The handle's
+        ``reused_tokens`` tells the prefill executor where to start —
+        the TTFT win is exactly the prefill it skips."""
+        if seq_id in self._seqs:
+            raise ValueError(f"sequence {seq_id!r} already allocated")
+        prompt = [int(t) for t in np.asarray(prompt_tokens).reshape(-1)]
+        if not prompt:
+            raise ValueError("empty prompt")
+        bl = self.block_len
+        full_chunks = len(prompt) // bl
+        chain: list[int] = []
+        pending: list[tuple[str, int]] = []
+        reused = 0
+        h = ""
+        matching = True
+        try:
+            for c in range(full_chunks):
+                h = _chunk_hash(h, prompt[c * bl:(c + 1) * bl])
+                block = self._prefix_index.get(h) if matching else None
+                if block is not None:
+                    self._c_hits.inc(1, service=self.service)
+                    self._ref[block] = self._ref.get(block, 0) + 1
+                    if block in self._lru:       # revived from cache
+                        del self._lru[block]
+                    chain.append(block)
+                    reused += bl
+                    continue
+                if matching:
+                    matching = False
+                self._c_misses.inc(1, service=self.service)
+                block = self._take_block()
+                self._ref[block] = 1
+                chain.append(block)
+                pending.append((h, block))
+            # tail block for the partial prompt chunk; decode growth is
+            # on-demand via ensure_capacity
+            if len(prompt) % bl:
+                block = self._take_block()
+                self._ref[block] = 1
+                chain.append(block)
+        except OutOfBlocks:
+            # unwind: a half-allocated chain must not leak references
+            for b in chain:
+                self._unref(b)
+            self._publish_gauges()
+            raise
+        if reused:
+            self._c_reused.inc(reused, service=self.service)
+        handle = SequenceHandle(seq_id=seq_id, chain=chain,
+                                length=reused, prompt_len=len(prompt),
+                                reused_tokens=reused,
+                                pending_publish=pending)
+        self._seqs[seq_id] = handle
+        self._publish_gauges()
+        return handle
+
+    def publish(self, seq_id) -> int:
+        """Index ``seq_id``'s freshly prefilled full prompt chunks for
+        future prefix reuse. Call AFTER the prefill executor has written
+        the blocks — publishing earlier would let a concurrent allocate
+        share a block whose kv is still zeros. Returns chunks published."""
+        handle = self._seqs[seq_id]
+        n = 0
+        for h, block in handle.pending_publish:
+            # first writer wins: two identical prompts racing through
+            # prefill both hold private blocks; only one gets indexed
+            if h not in self._prefix_index and block in self._ref:
+                self._prefix_index[h] = block
+                self._block_hash[block] = h
+                n += 1
+        handle.pending_publish = []
+        return n
+
+    # -- growth / accounting -----------------------------------------------
+    def capacity(self, seq_id) -> int:
+        return len(self._seqs[seq_id].chain) * self.block_len
+
+    def length(self, seq_id) -> int:
+        return self._seqs[seq_id].length
+
+    def handle(self, seq_id) -> SequenceHandle:
+        return self._seqs[seq_id]
+
+    def ensure_capacity(self, seq_id, tokens: int) -> SequenceHandle:
+        """Grow ``seq_id``'s chain until it can hold ``tokens`` positions
+        (speculative decode writes up to k+1 ahead each step)."""
+        handle = self._seqs[seq_id]
+        while len(handle.chain) * self.block_len < tokens:
+            block = self._take_block()
+            self._ref[block] = 1
+            handle.chain.append(block)
+        self._publish_gauges()
+        return handle
+
+    def advance(self, seq_id, n: int = 1) -> int:
+        """Account ``n`` committed token positions; returns the new
+        length. Positions must already be within capacity."""
+        handle = self._seqs[seq_id]
+        new_len = handle.length + int(n)
+        if new_len > len(handle.chain) * self.block_len:
+            raise ValueError(
+                f"sequence {seq_id!r} advanced past capacity "
+                f"({new_len} > {len(handle.chain)} blocks × "
+                f"{self.block_len})")
+        handle.length = new_len
+        return handle.length
+
+    # -- retirement --------------------------------------------------------
+    def _unref(self, block: int) -> None:
+        refs = self._ref.get(block, 0) - 1
+        if refs > 0:
+            self._ref[block] = refs
+            return
+        self._ref.pop(block, None)
+        h = self._block_hash.get(block)
+        if h is not None and self._prefix_index.get(h) == block:
+            self._lru[block] = h        # retire into the reuse cache
+            self._lru.move_to_end(block)
+        else:
+            self._block_hash.pop(block, None)
+            self._free.append(block)
+
+    def release(self, seq_id) -> None:
+        """Drop the sequence: published blocks retire into the LRU reuse
+        cache, everything else returns to the free list."""
+        handle = self._seqs.pop(seq_id)
+        for block in handle.chain:
+            self._unref(block)
+        self._publish_gauges()
+
+    # -- handoff (prefill -> decode over the mesh lease plumbing) ----------
+    def export_seq(self, seq_id) -> dict:
+        """Detach the sequence for handoff: ownership of its block
+        references moves WITH the returned payload (the manager keeps
+        the refcounts; the seq is simply no longer addressable here
+        until :meth:`adopt` re-registers it). Round-trips through JSON
+        — the shape the mesh ``__lease__`` envelope carries."""
+        handle = self._seqs.pop(seq_id)
+        if handle.pending_publish:
+            raise ValueError(
+                f"sequence {seq_id!r} still has unpublished prefill "
+                "blocks — publish() before handoff")
+        self._publish_gauges()
+        return handle.to_state()
+
+    def adopt(self, state: dict) -> SequenceHandle:
+        """Re-register an exported sequence (same pool — prefill and
+        decode executors share the device pools on a host; cross-host
+        adoption additionally ships the block contents)."""
+        handle = SequenceHandle.from_state(state)
+        if handle.seq_id in self._seqs:
+            raise ValueError(f"sequence {handle.seq_id!r} already "
+                             "registered")
+        for block in handle.chain:
+            if block not in self._ref:
+                raise ValueError(
+                    f"handoff chain references unowned block {block} — "
+                    "the payload does not match this pool")
+        self._seqs[handle.seq_id] = handle
+        self._publish_gauges()
+        return handle
+
+    # -- device bridge -----------------------------------------------------
+    def block_rows(self, seq_ids, max_blocks: int) -> np.ndarray:
+        """``[len(seq_ids), max_blocks]`` int32 block table for the
+        fixed-shape device step: each row is the sequence's chain padded
+        with the trash block. ``None`` entries (empty slots) become
+        all-trash rows."""
+        rows = np.full((len(seq_ids), int(max_blocks)), TRASH_BLOCK,
+                       np.int32)
+        for i, sid in enumerate(seq_ids):
+            if sid is None:
+                continue
+            chain = self._seqs[sid].chain
+            if len(chain) > max_blocks:
+                raise ValueError(
+                    f"sequence {sid!r} has {len(chain)} blocks > "
+                    f"max_blocks={max_blocks}")
+            rows[i, :len(chain)] = chain
+        return rows
+
+    # -- budget / introspection --------------------------------------------
+    def set_block_budget(self, budget: int) -> int:
+        """Lower (or raise) the used+cached cap; cached blocks are
+        LRU-evicted immediately to fit. Returns blocks evicted — the
+        fleet health plane calls this when ``mem_hbm_*`` pressure
+        crosses its watermark."""
+        self._budget = max(min(int(budget), self.num_blocks - 1), 1)
+        evicted = 0
+        while len(self._ref) + len(self._lru) > self._budget:
+            if self._evict_one() is None:
+                break
+            evicted += 1
+        self._publish_gauges()
+        return evicted
+
+    @property
+    def block_budget(self) -> int:
+        return self._budget
+
+    def stats(self) -> dict:
+        """One-glance pool state (the bench banks hit rate from the
+        registry; this is the debugging view)."""
+        return {
+            "blocks": self.num_blocks,
+            "block_len": self.block_len,
+            "budget": self._budget,
+            "used": len(self._ref),
+            "free": len(self._free),
+            "cached": len(self._lru),
+            "sequences": len(self._seqs),
+            "indexed_prefixes": len(self._prefix_index),
+        }
+
+
+# --------------------------------------------------------------- device half
+# Everything below imports jax lazily: the bookkeeping half above must
+# stay importable (and CI-smoked) with no backend in the process.
+
+def init_pools(encoder, num_blocks: int, block_len: int):
+    """Per-layer ``([num_blocks, block_len, heads, head_dim]`` k, same v)
+    device pools for ``encoder`` (a ``TextEncoder``)."""
+    import jax.numpy as jnp
+    hd = encoder.width // encoder.heads
+    shape = (int(num_blocks), int(block_len), encoder.heads, hd)
+    return tuple(
+        (jnp.zeros(shape, encoder.dtype), jnp.zeros(shape, encoder.dtype))
+        for _ in range(encoder.depth))
+
+
+def _flat_positions(rows, pos, block_len: int):
+    """[S, w] absolute positions -> flat pool indices via the block
+    table: ``rows[s, p // bl] * bl + p % bl``. Out-of-chain positions
+    clamp into the trash block's row (rows pads with TRASH_BLOCK)."""
+    import jax.numpy as jnp
+    bi = jnp.clip(pos // block_len, 0, rows.shape[1] - 1)   # [S, w]
+    block = jnp.take_along_axis(rows, bi, axis=1)           # [S, w]
+    return block * block_len + pos % block_len
+
+
+def gather_dense(pools, rows):
+    """Gather each slot's chained blocks into dense per-layer caches
+    ``[S, heads, max_blocks*block_len, head_dim]`` — the exact cache
+    layout ``MaskedLMModel.decode_step/decode_window`` run over, so the
+    paged path reuses their (equivalence-tested) attention math
+    unchanged. Positions ≥ the slot's length hold stale/trash data; the
+    decode mask (``arange < pos``) never attends them."""
+    import jax.numpy as jnp
+    S, MB = rows.shape
+    out = []
+    for k_pool, v_pool in pools:
+        NB, BL, H, hd = k_pool.shape
+        flat_k = k_pool.reshape(NB * BL, H, hd)
+        flat_v = v_pool.reshape(NB * BL, H, hd)
+        idx = (rows[:, :, None] * BL
+               + jnp.arange(BL)[None, None, :]).reshape(S, MB * BL)
+        k = jnp.transpose(flat_k[idx], (0, 2, 1, 3))   # [S, H, L, hd]
+        v = jnp.transpose(flat_v[idx], (0, 2, 1, 3))
+        out.append((k, v))
+    return tuple(out)
+
+
+def take_positions(dense, pos):
+    """Extract the kv written at absolute positions ``pos`` ([S, w])
+    from dense caches ``[S, H, L, hd]`` -> per-layer ``[S, w, H, hd]``
+    (the delta the device step scatters back into the pools)."""
+    import jax.numpy as jnp
+    out = []
+    for k, v in dense:
+        idx = pos[:, None, :, None]                     # [S, 1, w, 1]
+        kw = jnp.take_along_axis(
+            k, jnp.broadcast_to(idx, (k.shape[0], k.shape[1],
+                                      pos.shape[1], k.shape[3])), axis=2)
+        vw = jnp.take_along_axis(
+            v, jnp.broadcast_to(idx, (v.shape[0], v.shape[1],
+                                      pos.shape[1], v.shape[3])), axis=2)
+        out.append((jnp.transpose(kw, (0, 2, 1, 3)),
+                    jnp.transpose(vw, (0, 2, 1, 3))))   # [S, w, H, hd]
+    return tuple(out)
+
+
+def scatter_positions(pools, rows, pos, new_kv, valid=None):
+    """Write per-layer ``[S, w, H, hd]`` kv into the pools at absolute
+    positions ``pos`` ([S, w]) through the block table. Positions with
+    ``valid`` ([S, w] bool) false — padded prefill rows, inactive decode
+    slots — are redirected into the trash block's first row, so every
+    program instance writes a fixed index set (shape-stable) without
+    ever touching a live chain. Live chains are disjoint, so the
+    scatter has no real-block collisions and stays deterministic."""
+    import jax.numpy as jnp
+    out = []
+    for (k_pool, v_pool), (kw, vw) in zip(pools, new_kv):
+        NB, BL, H, hd = k_pool.shape
+        fidx = _flat_positions(rows, pos, BL)           # [S, w]
+        if valid is not None:
+            fidx = jnp.where(valid, fidx, TRASH_BLOCK * BL)
+        flat_k = k_pool.reshape(NB * BL, H, hd).at[fidx].set(kw)
+        flat_v = v_pool.reshape(NB * BL, H, hd).at[fidx].set(vw)
+        out.append((flat_k.reshape(NB, BL, H, hd),
+                    flat_v.reshape(NB, BL, H, hd)))
+    return tuple(out)

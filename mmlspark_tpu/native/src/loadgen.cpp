@@ -114,15 +114,26 @@ double scan_numeric_header(const std::string& buf, size_t header_end,
 // strict request-response means there should be none). `retry_after_s`,
 // when non-null, receives the Retry-After header in seconds (0 when
 // absent) — the sched subsystem's 429/503 sheds always set it.
+// `t_first`, when non-null, receives the time the FIRST byte of this
+// response arrived (generation mode: a streaming-shaped server sends
+// headers as soon as the first token exists, so first-byte time is the
+// client-observed TTFT; carried-over bytes count as immediate).
 int read_response(int fd, std::string& carry,
-                  double* retry_after_s = nullptr) {
+                  double* retry_after_s = nullptr,
+                  Clock::time_point* t_first = nullptr) {
   std::string buf = std::move(carry);
   carry.clear();
+  bool got_first = !buf.empty();
+  if (got_first && t_first) *t_first = Clock::now();
   char tmp[8192];
   size_t header_end;
   while ((header_end = buf.find("\r\n\r\n")) == std::string::npos) {
     ssize_t n = ::recv(fd, tmp, sizeof(tmp), 0);
     if (n <= 0) return -1;
+    if (!got_first) {
+      got_first = true;
+      if (t_first) *t_first = Clock::now();
+    }
     buf.append(tmp, static_cast<size_t>(n));
   }
   int status = -1;
@@ -164,7 +175,7 @@ void run_conn(const char* host, int port, const std::string& head,
               const std::string& body, const std::string& trace_prefix,
               const std::string& tenant_header, int conn_idx, long nreq,
               int retry_shed, double* lat_ms, int* status_out,
-              ConnResult* res) {
+              double* ttft_ms, ConnResult* res) {
   int fd = connect_to(host, port);
   if (fd < 0) {
     res->hard_fail = true;
@@ -172,6 +183,7 @@ void run_conn(const char* host, int port, const std::string& head,
     for (long i = 0; i < nreq; ++i) {
       lat_ms[i] = -1.0;
       if (status_out) status_out[i] = -1;
+      if (ttft_ms) ttft_ms[i] = -1.0;
     }
     return;
   }
@@ -185,10 +197,12 @@ void run_conn(const char* host, int port, const std::string& head,
       request = head + tenant_header
           + trace_header(trace_prefix, conn_idx, i) + "\r\n" + body;
     auto t0 = Clock::now();
+    auto tf = t0;
     int status = -1;
     double retry_after = 0.0;
     if (send_all(fd, request.data(), request.size()))
-      status = read_response(fd, carry, &retry_after);
+      status = read_response(fd, carry, &retry_after,
+                             ttft_ms ? &tf : nullptr);
     auto t1 = Clock::now();
     bool retried = false;
     if (retry_shed && (status == 429 || status == 503)) {
@@ -203,9 +217,11 @@ void run_conn(const char* host, int port, const std::string& head,
       ts.tv_nsec = static_cast<long>((wait - ts.tv_sec) * 1e9);
       ::nanosleep(&ts, nullptr);
       t0 = Clock::now();
+      tf = t0;
       status = -1;
       if (send_all(fd, request.data(), request.size()))
-        status = read_response(fd, carry);
+        status = read_response(fd, carry, nullptr,
+                               ttft_ms ? &tf : nullptr);
       t1 = Clock::now();
       retried = true;
     }
@@ -220,6 +236,12 @@ void run_conn(const char* host, int port, const std::string& head,
     // from first-offer load in the summary.
     lat_ms[i] = status < 0 ? -1.0
         : std::chrono::duration<double, std::milli>(t1 - t0).count();
+    // TTFT mirrors the latency conventions: -1 on transport failure,
+    // and a retried request reports the re-attempt's first byte (same
+    // reasoning — the back-off wait is the server's instruction).
+    if (ttft_ms)
+      ttft_ms[i] = status < 0 ? -1.0
+          : std::chrono::duration<double, std::milli>(tf - t0).count();
     if (status_out)
       status_out[i] = (retried && status >= 0) ? status + 1000 : status;
     if (status != 200) {
@@ -231,6 +253,7 @@ void run_conn(const char* host, int port, const std::string& head,
           for (long j = i + 1; j < nreq; ++j) {
             lat_ms[j] = -1.0;
             if (status_out) status_out[j] = -1;
+            if (ttft_ms) ttft_ms[j] = -1.0;
           }
           res->errors += nreq - i - 1;
           res->hard_fail = true;
@@ -261,13 +284,19 @@ extern "C" {
 // non-empty, is a comma-separated list: connection c stamps
 // "X-Tenant: <tenants[c % n]>" on every request (one tenant per
 // connection, so the Python summary can split its per-tenant columns
-// from connection-major matrices). Returns total non-200/transport
-// errors, or -1 when every connection failed to even connect.
-long lg_run5(const char* host, int port, int nconn, long nreq,
+// from connection-major matrices). ttft_ms, when non-null, must hold
+// nconn*nreq doubles (connection-major) and receives each request's
+// time-to-first-byte — the generation-mode TTFT: an LLM serving front
+// answers when the first token exists, so first-byte time is what a
+// client perceives as time-to-first-token (-1 on transport failure; a
+// retried request reports the re-attempt's first byte, matching
+// lat_ms). Returns total non-200/transport errors, or -1 when every
+// connection failed to even connect.
+long lg_run6(const char* host, int port, int nconn, long nreq,
              const char* path, const unsigned char* body, long body_len,
              int retry_shed, const char* trace_prefix,
              const char* tenants, double* lat_ms, int* status_out,
-             double* wall_s) {
+             double* ttft_ms, double* wall_s) {
   // head stops before the blank line: the per-connection X-Tenant and
   // per-request traceparent (and the terminating \r\n) are appended
   // per connection/send
@@ -310,6 +339,8 @@ long lg_run5(const char* host, int port, int nconn, long nreq,
                          lat_ms + static_cast<long>(c) * nreq,
                          status_out ? status_out
                              + static_cast<long>(c) * nreq : nullptr,
+                         ttft_ms ? ttft_ms
+                             + static_cast<long>(c) * nreq : nullptr,
                          &results[static_cast<size_t>(c)]);
   for (auto& t : threads) t.join();
   auto t1 = Clock::now();
@@ -323,6 +354,17 @@ long lg_run5(const char* host, int port, int nconn, long nreq,
   }
   if (hard == nconn) return -1;
   return errors;
+}
+
+// Back-compat entry point (no time-to-first-byte reporting).
+long lg_run5(const char* host, int port, int nconn, long nreq,
+             const char* path, const unsigned char* body, long body_len,
+             int retry_shed, const char* trace_prefix,
+             const char* tenants, double* lat_ms, int* status_out,
+             double* wall_s) {
+  return lg_run6(host, port, nconn, nreq, path, body, body_len,
+                 retry_shed, trace_prefix, tenants, lat_ms, status_out,
+                 nullptr, wall_s);
 }
 
 // Back-compat entry point (no per-connection X-Tenant stamping).

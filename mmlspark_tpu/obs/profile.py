@@ -408,10 +408,14 @@ step_profiler = StepProfiler()
 #: at execute time, ``compiled_segments``, and the device ``platform``
 #: — plus this stamp itself. v3 (ISSUE 15) stamps the ``process`` index
 #: (``process_label()``; None on single-process hosts) so fleet-merged
-#: training data is rank-attributable. Consumers (``perf.costmodel``)
-#: accept v3 and v2 rows and SKIP anything else, loudly, instead of
-#: misparsing old logs.
-FEATURE_SCHEMA_VERSION = 3
+#: training data is rank-attributable. v4 (ISSUE 17) adds the
+#: generation-row fields ``decode_steps`` and ``prefill_tokens`` (the
+#: LLM serving engine records one row per completed sequence) so the
+#: cost model can price decode separately from prefill; non-generation
+#: rows simply omit them. Consumers (``perf.costmodel``) accept v4, v3
+#: and v2 rows and SKIP anything else, loudly, instead of misparsing
+#: old logs.
+FEATURE_SCHEMA_VERSION = 4
 
 _platform_cache: str | None = None
 

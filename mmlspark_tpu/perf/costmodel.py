@@ -67,13 +67,20 @@ DEFAULT_PERF_ROOT = "/tmp/mmlspark_tpu_perf-" + str(
     getattr(os, "getuid", lambda: "u")())
 
 #: the model's feature vector (after the intercept); per-key training
-#: means fill features the caller cannot supply at estimate time.
-FEATURES = ("bucket", "batch", "entity_kb", "queue_depth")
+#: means fill features the caller cannot supply at estimate time. The
+#: last two are generation-only (v4 rows from the LLM serving engine)
+#: — absent on every other row, where they train as 0 and the fitted
+#: weight prices exactly the decode-vs-prefill split for services that
+#: record them.
+FEATURES = ("bucket", "batch", "entity_kb", "queue_depth",
+            "decode_steps", "prefill_tokens")
 
 #: Row schemas this model can consume. v3 (the fleet PR) added only the
-#: ``process`` rank stamp — no feature column changed — so v2 logs
-#: remain fully usable; anything else is skipped loudly in :meth:`fit`.
-ACCEPTED_SCHEMA_VERSIONS = frozenset({FEATURE_SCHEMA_VERSION, 2})
+#: ``process`` rank stamp and v4 only the OPTIONAL generation fields
+#: (``decode_steps``/``prefill_tokens`` default to 0 when absent) — no
+#: existing feature column changed meaning — so v2/v3 logs remain fully
+#: usable; anything else is skipped loudly in :meth:`fit`.
+ACCEPTED_SCHEMA_VERSIONS = frozenset({FEATURE_SCHEMA_VERSION, 3, 2})
 
 MODEL_VERSION = 1
 
@@ -95,8 +102,11 @@ def enabled() -> bool:
 
 
 def _row_features(row: dict) -> list[float] | None:
-    """FeatureLog row → [1, bucket, batch, entity_kb, queue_depth], or
-    None when the row cannot price a batch (no batch / no target)."""
+    """FeatureLog row → [1, bucket, batch, entity_kb, queue_depth,
+    decode_steps, prefill_tokens], or None when the row cannot price a
+    batch (no batch / no target). The generation fields are v4-only and
+    OPTIONAL — absent (v2/v3 rows, non-generation services) they train
+    as 0, so old logs keep fitting unchanged."""
     try:
         batch = float(row.get("batch") or 0)
         if batch <= 0:
@@ -104,7 +114,10 @@ def _row_features(row: dict) -> list[float] | None:
         bucket = float(row.get("bucket") or bucket_of(int(batch)))
         ekb = float(row.get("entity_bytes") or 0.0) / 1024.0
         depth = float(row.get("queue_depth") or 0.0)
-        return [1.0, bucket, batch, ekb, depth]
+        decode_steps = float(row.get("decode_steps") or 0.0)
+        prefill_tokens = float(row.get("prefill_tokens") or 0.0)
+        return [1.0, bucket, batch, ekb, depth, decode_steps,
+                prefill_tokens]
     except (TypeError, ValueError):
         return None
 
@@ -274,11 +287,16 @@ class CostModel:
     def predict_batch_ms(self, service: str, batch: int,
                          route: str = "", entity_bytes: float | None = None,
                          queue_depth: float | None = None,
+                         decode_steps: float | None = None,
+                         prefill_tokens: float | None = None,
                          count: bool = True) -> float | None:
         """Predicted ``execute_ms`` for a batch, or ``None`` when the
         model is cold for this service or its recent error exceeds the
         gate — the caller MUST fall back to its EWMA then. ``count=False``
-        suppresses the fallback counters (error bookkeeping reads)."""
+        suppresses the fallback counters (error bookkeeping reads).
+        ``decode_steps``/``prefill_tokens`` price a generation request's
+        two phases separately (services whose rows record them);
+        omitted, the service's training mean fills in."""
         batch = int(batch)
         if batch <= 0:
             return None
@@ -286,14 +304,22 @@ class CostModel:
         if m is None:
             return None
         mean = m["mean"]
-        x = np.array([
+        feats = [
             1.0,
             float(bucket_of(batch)),
             float(batch),
             mean[3] if entity_bytes is None else
             float(entity_bytes) / 1024.0,
             mean[4] if queue_depth is None else float(queue_depth),
-        ], np.float64)
+        ]
+        # a model persisted before the v4 generation features has a
+        # 5-dim theta; only append what it was trained with
+        if len(m["theta"]) > 5:
+            feats.append(mean[5] if decode_steps is None
+                         else float(decode_steps))
+            feats.append(mean[6] if prefill_tokens is None
+                         else float(prefill_tokens))
+        x = np.asarray(feats, np.float64)
         ms = float(x @ m["theta"])
         # a linear extrapolation can dip negative off the training
         # range; a non-positive service time is never a usable price

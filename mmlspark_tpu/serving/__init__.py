@@ -18,11 +18,15 @@ from .distributed import (DistributedServingServer, DriverRegistry,
                           NativeDistributedServingServer,
                           RegistryClient, ServiceInfo, pick_least_loaded,
                           remote_worker_loop)
+from .llm import (DecodeExecutor, HandoffQueue, LLMEngine,
+                  PrefillExecutor, pack_handoff, unpack_handoff)
 from .server import ServingServer, bucket_pad, serving_query
 from .udfs import make_reply_udf, send_reply_udf
 from .dsl import read_stream
 
 __all__ = ["bucket_pad",
+           "LLMEngine", "PrefillExecutor", "DecodeExecutor",
+           "HandoffQueue", "pack_handoff", "unpack_handoff",
            "Autoscaler", "AutoscaleConfig", "AutoscaleSignals",
            "ComputeWorkerPool",
            "DistributedServingServer", "NativeDistributedServingServer",

@@ -1,0 +1,819 @@
+"""LLM serving engine: disaggregated prefill/decode over the paged KV
+cache, with speculation inside the continuous batch.
+
+The pre-existing generation path (``dl.ContinuousGenerator``) is a
+monolithic dense-cache decoder: every slot owns a ``[max_len]`` cache
+row, prompts prefill inside the decode program, and a long prompt
+admission stalls the whole batch for its prefill. This module is the
+serving-shaped rebuild the ROADMAP names (and the TPU serving
+comparison in arXiv:2605.25645 measures): the two phases have opposite
+execution profiles — prefill is a large, MXU-saturating causal forward;
+decode is a tiny launch-latency-bound step — so they get SEPARATE
+executors with separate padding buckets and separate AOT-fingerprinted
+programs, stitched together by a handoff of (sequence, block chain)
+over the paged KV pool (``dl.paged_kv``):
+
+- :class:`PrefillExecutor` fills KV blocks in padding-bucketed batches
+  (one compiled program per window bucket), starting AFTER any
+  prefix-reused blocks — a warm prompt skips exactly the prefill the
+  cache already holds, which is the TTFT win the bench measures.
+- :class:`DecodeExecutor` runs the fixed-shape continuous-batching step
+  over block tables: every step gathers each slot's chain into a dense
+  per-slot cache view, applies the SAME ``decode_step``/``decode_window``
+  numerics ``dl.generate`` is equivalence-tested against, and scatters
+  only the newly written positions back — greedy output is
+  token-identical to ``dl.generate`` (pinned by test). With a draft
+  model, ``dl.speculative``'s draft/verify window runs PER SLOT: each
+  slot accepts its own longest agreeing prefix (no batch sync-on-min —
+  block chains advance independently), so accepted bursts move a slot
+  by up to k+1 tokens per step.
+- Handoff rides :class:`HandoffQueue`: the prefill side exports the
+  sequence from the block table (:meth:`PagedKVManager.export_seq` —
+  ownership moves with the payload), the decode side adopts it when it
+  has a free slot (load-aware pull). The payload is a flat JSON dict —
+  :func:`pack_handoff` / :func:`unpack_handoff` — exactly the shape the
+  distributed tier's ``__lease__`` envelope (``serving.distributed``)
+  already carries for replayed work, so a cross-host split reuses that
+  plumbing unchanged (plus a block-content transfer, which in-process
+  disaggregation doesn't need: both executors address the same pools).
+
+Every device program is built through ``compile_tracker.jit`` with a
+stable name and carries an AOT fingerprint (``core.aot.fingerprints``
+over the program's static shape key), so a warmed worker serves both
+phases with zero runtime compiles (``mark_steady`` + the CompileTracker
+steady-state assertion is the acceptance test).
+
+Obs: ``gen_ttft_seconds{reuse=cold|warm}``, ``gen_tokens_total``,
+``gen_spec_accept_ratio``, ``gen_decode_steps_total`` here, the
+``kv_*`` families in ``dl.paged_kv`` — all federated fleet-wide and
+recorded by the telemetry history plane. Completions land FeatureLog
+rows with ``decode_steps``/``prefill_tokens`` so the cost model prices
+the two phases separately (``perf.costmodel``).
+"""
+
+from __future__ import annotations
+
+import json
+import time
+from dataclasses import dataclass, field
+
+import numpy as np
+
+from ..core import aot
+from ..dl.paged_kv import (OutOfBlocks, PagedKVManager, gather_dense,
+                           init_pools, scatter_positions, take_positions)
+from ..obs import registry as _default_registry
+from ..obs.profile import compile_tracker, feature_log
+from ..sched.continuous import SlotScheduler
+
+__all__ = ["LLMEngine", "PrefillExecutor", "DecodeExecutor",
+           "HandoffQueue", "pack_handoff", "unpack_handoff"]
+
+
+def _bucket_window(n: int) -> int:
+    """Pad a prefill window to the compile-cache-friendly grid —
+    the same ladder ``dl.generate`` buckets prefix lengths on (≥64:
+    multiple of 64, below: power of two)."""
+    n = max(int(n), 1)
+    if n >= 64:
+        return ((n + 63) // 64) * 64
+    p = 1
+    while p < n:
+        p <<= 1
+    return p
+
+
+def _encoder_key(module) -> dict:
+    """Static fingerprint fragment for a causal-LM module: everything
+    that changes the compiled program besides the batch shapes."""
+    enc = module.encoder
+    return {"vocab": enc.vocab, "width": enc.width, "depth": enc.depth,
+            "heads": enc.heads, "mlp_dim": enc.mlp_dim,
+            "dtype": np.dtype(enc.dtype).name}
+
+
+# ----------------------------------------------------------------- handoff
+
+def pack_handoff(payload: dict) -> bytes:
+    """Serialize a prefill→decode handoff for the wire — the body the
+    distributed tier's ``__lease__`` envelope carries when the two
+    executors live on different hosts."""
+    return json.dumps(payload, sort_keys=True).encode()
+
+
+def unpack_handoff(data: bytes) -> dict:
+    return json.loads(data.decode())
+
+
+class HandoffQueue:
+    """The prefill→decode boundary: prefill pushes exported sequences,
+    decode pulls AT MOST its free-slot count per boundary (load-aware —
+    a saturated decoder leaves work queued instead of overcommitting).
+    Payloads round-trip :func:`pack_handoff` so the in-process queue
+    and the cross-host lease path carry identical bytes."""
+
+    def __init__(self):
+        self._q: list[dict] = []
+
+    def push(self, payload: dict) -> None:
+        # serialize/deserialize even in-process: the payload must stay
+        # wire-shaped or the cross-host path rots silently
+        self._q.append(unpack_handoff(pack_handoff(payload)))
+
+    def pull(self, max_items: int) -> list[dict]:
+        n = max(int(max_items), 0)
+        out, self._q = self._q[:n], self._q[n:]
+        return out
+
+    def __len__(self) -> int:
+        return len(self._q)
+
+
+class _PoolState:
+    """Shared mutable holder for the device pools: both executors read
+    and replace the SAME pools (in-process disaggregation — the block
+    table addresses one physical pool)."""
+
+    def __init__(self, target, draft=None):
+        self.target = target
+        self.draft = draft
+
+
+# --------------------------------------------------------------- executors
+
+class PrefillExecutor:
+    """Fills KV blocks for admitted prompts in padding-bucketed batches.
+
+    One compiled program per window bucket ``w``: gather each row's
+    chain into a dense cache view, run a vmapped ``decode_window`` over
+    the prompt SUFFIX (everything past the prefix-reused blocks) at
+    per-row start positions, scatter the newly written positions back
+    into the pools, and emit each row's first generated token (the
+    logits at its last prompt position — TTFT is measured here).
+    With a draft model the same window also fills the DRAFT pools, so
+    prefix-reused blocks hold both models' kv consistently."""
+
+    def __init__(self, module, variables, kv: PagedKVManager,
+                 pools: _PoolState, *, draft_module=None,
+                 draft_variables=None, max_blocks: int, batch: int = 4,
+                 pad_id: int = 0, service: str = "llm"):
+        self.module = module
+        self.variables = variables
+        self.draft_module = draft_module
+        self.draft_variables = draft_variables
+        self.kv = kv
+        self.pools = pools
+        self.max_blocks = int(max_blocks)
+        self.batch = max(int(batch), 1)
+        self.pad_id = int(pad_id)
+        self.service = service
+        self._programs: dict[int, object] = {}
+        self._fps: dict[str, tuple[str, str]] = {}
+
+    # -- compiled program per window bucket --------------------------------
+    def _program(self, w: int):
+        prog = self._programs.get(w)
+        if prog is not None:
+            return prog
+        import jax
+        import jax.numpy as jnp
+        module, draft = self.module, self.draft_module
+        pad_id, P = self.pad_id, self.batch
+
+        def run(params, dparams, pools_t, pools_d, rows, toks, pos,
+                lens):
+            dense_t = gather_dense(pools_t, rows)
+
+            def one(mod, prm, tk, cache, p):
+                c = jax.tree.map(lambda a: a[None], cache)
+                logits, c = mod.apply({"params": prm}, tk[None], c, p,
+                                      method="decode_window")
+                return logits[0], jax.tree.map(lambda a: a[0], c)
+
+            logits, dense_t = jax.vmap(
+                lambda tk, c, p: one(module, params, tk, c, p)
+            )(toks, dense_t, pos)                       # [P, w, V]
+            wrote = pos[:, None] + jnp.arange(w)[None]  # [P, w]
+            valid = (jnp.arange(w)[None] < lens[:, None]) & \
+                (lens[:, None] > 0)
+            new_kv = take_positions(dense_t, wrote)
+            pools_t = scatter_positions(pools_t, rows, wrote, new_kv,
+                                        valid=valid)
+            if draft is not None:
+                dense_d = gather_dense(pools_d, rows)
+                _, dense_d = jax.vmap(
+                    lambda tk, c, p: one(draft, dparams, tk, c, p)
+                )(toks, dense_d, pos)
+                pools_d = scatter_positions(
+                    pools_d, rows, wrote, take_positions(dense_d, wrote),
+                    valid=valid)
+            logits = logits.at[:, :, pad_id].set(-jnp.inf)
+            last = jnp.clip(lens - 1, 0, w - 1)
+            row_logits = jnp.take_along_axis(
+                logits, last[:, None, None].repeat(logits.shape[-1], 2),
+                axis=1)[:, 0]                           # [P, V]
+            first = jnp.argmax(row_logits, axis=-1).astype(jnp.int32)
+            return pools_t, pools_d, first
+
+        name = f"llm_prefill_{self.service}_w{w}_b{P}"
+        prog = compile_tracker.jit(run, name=name,
+                                   static_argnames=())
+        self._programs[w] = prog
+        key = {"phase": "prefill", "service": self.service,
+               "window": w, "batch": P,
+               "max_blocks": self.max_blocks,
+               "block_len": self.kv.block_len,
+               "encoder": _encoder_key(self.module),
+               "draft": None if draft is None else _encoder_key(draft),
+               "versions": aot.runtime_versions()}
+        self._fps[name] = aot.fingerprints(key, [], [])
+        return prog
+
+    def aot_fingerprints(self) -> dict:
+        """program name -> (static_fp, full_fp) for every program built
+        so far — the identity a warmed worker advertises."""
+        return dict(self._fps)
+
+    # -- host driver --------------------------------------------------------
+    def prefill(self, jobs: list) -> dict:
+        """``jobs``: list of ``(seq_id, prompt_tokens)`` whose chains
+        are already allocated in ``kv``. Runs bucketed batches, commits
+        lengths (``kv.advance`` + ``kv.publish``), returns
+        ``seq_id -> (first_token, suffix_len)``."""
+        import jax.numpy as jnp
+        out: dict = {}
+        for start in range(0, len(jobs), self.batch):
+            chunk = jobs[start:start + self.batch]
+            metas = []
+            for seq_id, prompt in chunk:
+                h = self.kv.handle(seq_id)
+                # a fully reused prompt still re-feeds its last token:
+                # the window must emit logits for the first generated
+                # position (the rewrite stores bit-identical kv)
+                s0 = min(h.reused_tokens, h.prompt_len - 1)
+                metas.append((seq_id, list(prompt), s0,
+                              h.prompt_len - s0))
+            w = _bucket_window(max(m[3] for m in metas))
+            P = self.batch
+            toks = np.zeros((P, w), np.int32)
+            pos = np.zeros(P, np.int32)
+            lens = np.zeros(P, np.int32)
+            ids: list = [m[0] for m in metas]
+            for i, (seq_id, prompt, s0, n) in enumerate(metas):
+                toks[i, :n] = prompt[s0:]
+                pos[i] = s0
+                lens[i] = n
+            rows = self.kv.block_rows(
+                ids + [None] * (P - len(ids)), self.max_blocks)
+            prog = self._program(w)
+            pools_t, pools_d, first = prog(
+                self.variables["params"],
+                None if self.draft_module is None
+                else self.draft_variables["params"],
+                self.pools.target, self.pools.draft,
+                jnp.asarray(rows), jnp.asarray(toks),
+                jnp.asarray(pos), jnp.asarray(lens))
+            self.pools.target = pools_t
+            if self.draft_module is not None:
+                self.pools.draft = pools_d
+            first = np.asarray(first)
+            for i, (seq_id, prompt, s0, n) in enumerate(metas):
+                h = self.kv.handle(seq_id)
+                self.kv.advance(seq_id, h.prompt_len - h.length)
+                self.kv.publish(seq_id)
+                out[seq_id] = (int(first[i]), int(n))
+        return out
+
+    def warm(self, windows=(1,)) -> None:
+        """Compile (and run, against the trash block only) the programs
+        for the given window buckets — the warmup sweep before
+        ``compile_tracker.mark_steady()``."""
+        import jax.numpy as jnp
+        P = self.batch
+        for w in windows:
+            w = _bucket_window(w)
+            rows = jnp.zeros((P, self.max_blocks), jnp.int32)
+            prog = self._program(w)
+            pools_t, pools_d, _ = prog(
+                self.variables["params"],
+                None if self.draft_module is None
+                else self.draft_variables["params"],
+                self.pools.target, self.pools.draft, rows,
+                jnp.zeros((P, w), jnp.int32), jnp.zeros(P, jnp.int32),
+                jnp.zeros(P, jnp.int32))
+            self.pools.target = pools_t
+            if self.draft_module is not None:
+                self.pools.draft = pools_d
+
+
+class DecodeExecutor:
+    """The fixed-shape continuous-batching decode step over block
+    tables. All shapes are pinned at construction — ``[slots]`` state
+    vectors, ``[slots, max_blocks]`` block tables — so ONE program per
+    mode serves every step (the zero-runtime-compile contract).
+
+    Plain mode: one vmapped ``decode_step`` per slot (per-slot traced
+    positions), greedy ``argmax`` with pad masked — the numerics of
+    ``dl.generate``'s cached path. Spec mode (draft present): the
+    draft/verify window of ``dl.speculative`` vmapped PER SLOT, each
+    slot accepting its own longest agreeing prefix — no batch
+    sync-on-min, block chains advance independently."""
+
+    def __init__(self, module, variables, kv: PagedKVManager,
+                 pools: _PoolState, *, draft_module=None,
+                 draft_variables=None, slots: int, max_blocks: int,
+                 spec_k: int = 0, pad_id: int = 0,
+                 service: str = "llm"):
+        if spec_k and draft_module is None:
+            raise ValueError("spec_k > 0 needs a draft model")
+        self.module = module
+        self.variables = variables
+        self.draft_module = draft_module
+        self.draft_variables = draft_variables
+        self.kv = kv
+        self.pools = pools
+        self.slots = int(slots)
+        self.max_blocks = int(max_blocks)
+        self.spec_k = int(spec_k)
+        self.pad_id = int(pad_id)
+        self.service = service
+        # host-side slot state (the engine owns seq metadata)
+        self.seq_ids: list = [None] * self.slots
+        self.ptr = np.ones(self.slots, np.int32)   # committed tokens
+        self.end = np.ones(self.slots, np.int32)   # commit cap
+        self.last = np.zeros(self.slots, np.int32)  # token @ ptr-1
+        self.active = np.zeros(self.slots, bool)
+        self._program = None
+        self._fps: dict[str, tuple[str, str]] = {}
+
+    @property
+    def free_slots(self) -> int:
+        return int(self.slots - self.active.sum())
+
+    # -- slot lifecycle -----------------------------------------------------
+    def activate(self, slot_hint, state: dict) -> int:
+        """Adopt a handoff payload into a free slot. ``slot_hint`` (the
+        scheduler's assignment) is used when free; any free slot
+        otherwise."""
+        slot = slot_hint if (slot_hint is not None
+                             and not self.active[slot_hint]) else \
+            int(np.flatnonzero(~self.active)[0])
+        handle = self.kv.adopt(state["seq"])
+        self.seq_ids[slot] = handle.seq_id
+        # cache holds [0, prompt_len); the first generated token (from
+        # prefill) is committed at position prompt_len, pending embed
+        self.ptr[slot] = handle.length + 1
+        self.end[slot] = handle.length + int(state["max_new_tokens"])
+        self.last[slot] = int(state["first"])
+        self.active[slot] = True
+        return slot
+
+    def deactivate(self, slot: int) -> None:
+        self.seq_ids[slot] = None
+        self.active[slot] = False
+        self.ptr[slot] = 1
+        self.end[slot] = 1
+        self.last[slot] = self.pad_id
+
+    # -- the compiled step --------------------------------------------------
+    def _build(self):
+        if self._program is not None:
+            return self._program
+        import jax
+        import jax.numpy as jnp
+        module, draft = self.module, self.draft_module
+        pad_id, k, S = self.pad_id, self.spec_k, self.slots
+
+        def expand(c):
+            return jax.tree.map(lambda a: a[None], c)
+
+        def strip(c):
+            return jax.tree.map(lambda a: a[0], c)
+
+        if k == 0:
+            def run(params, dparams, pools_t, pools_d, rows, last, ptr,
+                    end, active):
+                dense = gather_dense(pools_t, rows)
+
+                def one(tk, cache, p):
+                    logits, c = module.apply(
+                        {"params": params}, tk[None], expand(cache),
+                        p - 1, method="decode_step")
+                    return logits[0], strip(c)
+
+                logits, dense = jax.vmap(one)(last, dense, ptr)
+                logits = logits.at[:, pad_id].set(-jnp.inf)
+                nxt = jnp.argmax(logits, axis=-1).astype(jnp.int32)
+                wrote = (ptr - 1)[:, None]              # [S, 1]
+                pools_t = scatter_positions(
+                    pools_t, rows, wrote, take_positions(dense, wrote),
+                    valid=active[:, None])
+                committed = nxt[:, None]                # [S, 1]
+                n_new = jnp.where(active, 1, 0)
+                return pools_t, pools_d, committed, n_new, n_new
+        else:
+            def run(params, dparams, pools_t, pools_d, rows, last, ptr,
+                    end, active):
+                dense_t = gather_dense(pools_t, rows)
+                dense_d = gather_dense(pools_d, rows)
+
+                def one(tk, ct, cd, p):
+                    ct, cd = expand(ct), expand(cd)
+                    tok = tk[None]
+                    drafts = []
+                    for j in range(k):
+                        ld, cd = draft.apply(
+                            {"params": dparams}, tok, cd, p - 1 + j,
+                            method="decode_step")
+                        ld = ld.at[:, pad_id].set(-jnp.inf)
+                        tok = jnp.argmax(ld, -1).astype(jnp.int32)
+                        drafts.append(tok)
+                    # extra cache-fill step: d_k's kv, or the next
+                    # round's draft attends a zero hole after a full
+                    # accept (same fix as dl.speculative)
+                    _, cd = draft.apply(
+                        {"params": dparams}, tok, cd, p - 1 + k,
+                        method="decode_step")
+                    d = jnp.stack(drafts, 1)            # [1, k]
+                    window = jnp.concatenate([tk[None][:, None], d], 1)
+                    lt, ct = module.apply(
+                        {"params": params}, window, ct, p - 1,
+                        method="decode_window")         # [1, k+1, V]
+                    lt = lt.at[:, :, pad_id].set(-jnp.inf)
+                    t = jnp.argmax(lt, -1).astype(jnp.int32)
+                    agree = jnp.cumprod(
+                        (d == t[:, :k]).astype(jnp.int32), axis=1)
+                    n_acc = agree.sum(axis=1)[0]        # PER-SLOT
+                    bonus = t[0, n_acc]
+                    return (d[0], n_acc, bonus, strip(ct), strip(cd))
+
+                d, n_acc, bonus, dense_t, dense_d = jax.vmap(one)(
+                    last, dense_t, dense_d, ptr)
+                ar = jnp.arange(k + 1)[None]            # [1, k+1]
+                d_ext = jnp.concatenate(
+                    [d, jnp.zeros((S, 1), jnp.int32)], 1)
+                committed = jnp.where(
+                    ar < n_acc[:, None], d_ext,
+                    jnp.where(ar == n_acc[:, None], bonus[:, None],
+                              pad_id))                  # [S, k+1]
+                # never commit past the slot's budget (end - ptr
+                # tokens remain; runnable slots have at least 1)
+                n_new = jnp.clip(n_acc + 1, 1,
+                                 jnp.maximum(end - ptr, 1))
+                n_new = jnp.where(active, n_new, 0)
+                wrote = (ptr - 1)[:, None] + ar         # [S, k+1]
+                valid = active[:, None] & jnp.ones_like(wrote, bool)
+                pools_t = scatter_positions(
+                    pools_t, rows, wrote,
+                    take_positions(dense_t, wrote), valid=valid)
+                pools_d = scatter_positions(
+                    pools_d, rows, wrote,
+                    take_positions(dense_d, wrote), valid=valid)
+                return pools_t, pools_d, committed, n_new, \
+                    jnp.where(active, n_acc, 0)
+
+        name = f"llm_decode_{self.service}_S{S}_k{k}"
+        self._program = compile_tracker.jit(run, name=name)
+        key = {"phase": "decode", "service": self.service, "slots": S,
+               "spec_k": k, "max_blocks": self.max_blocks,
+               "block_len": self.kv.block_len,
+               "encoder": _encoder_key(self.module),
+               "draft": None if draft is None else _encoder_key(draft),
+               "versions": aot.runtime_versions()}
+        self._fps[name] = aot.fingerprints(key, [], [])
+        return self._program
+
+    def aot_fingerprints(self) -> dict:
+        return dict(self._fps)
+
+    @property
+    def runnable(self) -> np.ndarray:
+        """Slots that should actually decode this step: active AND
+        budget remaining (a 1-token sequence is complete the moment its
+        prefill-produced first token lands)."""
+        return self.active & (self.ptr < self.end)
+
+    def step(self) -> dict:
+        """One decode step over every runnable slot. Returns
+        ``slot -> (tokens_committed list, n_accepted)``; the caller
+        commits tokens, advances the block table, and retires finished
+        sequences."""
+        import jax.numpy as jnp
+        runnable = self.runnable
+        if not runnable.any():
+            return {}
+        # capacity for this step's writes: positions up to ptr-1+k
+        for s in range(self.slots):
+            if runnable[s]:
+                self.kv.ensure_capacity(self.seq_ids[s],
+                                        int(self.ptr[s]) + self.spec_k)
+        rows = self.kv.block_rows(
+            [sid if runnable[i] else None
+             for i, sid in enumerate(self.seq_ids)], self.max_blocks)
+        prog = self._build()
+        pools_t, pools_d, committed, n_new, n_acc = prog(
+            self.variables["params"],
+            None if self.draft_module is None
+            else self.draft_variables["params"],
+            self.pools.target, self.pools.draft, jnp.asarray(rows),
+            jnp.asarray(self.last), jnp.asarray(self.ptr),
+            jnp.asarray(self.end), jnp.asarray(runnable))
+        self.pools.target = pools_t
+        if self.draft_module is not None:
+            self.pools.draft = pools_d
+        committed = np.asarray(committed)
+        n_new = np.asarray(n_new)
+        n_acc = np.asarray(n_acc)
+        out = {}
+        for s in range(self.slots):
+            if not runnable[s]:
+                continue
+            n = int(n_new[s])
+            toks = [int(t) for t in committed[s, :n]]
+            self.kv.advance(self.seq_ids[s], n)
+            self.ptr[s] += n
+            self.last[s] = toks[-1]
+            out[s] = (toks, int(n_acc[s]))
+        return out
+
+    def warm(self) -> None:
+        """Run the step program once against the trash block (all slots
+        inactive — every write lands in block 0) — the warmup before
+        ``mark_steady``."""
+        import jax.numpy as jnp
+        prog = self._build()
+        S = self.slots
+        pools_t, pools_d, *_ = prog(
+            self.variables["params"],
+            None if self.draft_module is None
+            else self.draft_variables["params"],
+            self.pools.target, self.pools.draft,
+            jnp.zeros((S, self.max_blocks), jnp.int32),
+            jnp.zeros(S, jnp.int32), jnp.ones(S, jnp.int32),
+            jnp.full(S, 2, jnp.int32), jnp.zeros(S, bool))
+        self.pools.target = pools_t
+        if self.draft_module is not None:
+            self.pools.draft = pools_d
+
+
+# ------------------------------------------------------------------ engine
+
+@dataclass
+class _SeqMeta:
+    prompt: list
+    max_new_tokens: int
+    t_submit: float
+    slot: int | None = None
+    t_first: float | None = None
+    first_token: int | None = None
+    reused_tokens: int = 0
+    prefill_tokens: int = 0
+    decode_steps: int = 0
+    generated: list = field(default_factory=list)
+
+
+class LLMEngine:
+    """The assembled serving engine: paged KV pool + prefill executor +
+    decode executor + continuous-batching scheduler.
+
+    Greedy-only (``dl.generate`` temperature-0 semantics — the output
+    contract is token identity with ``generate``); sampled speculative
+    serving needs the rejection-sampling correction wired per slot and
+    is out of scope here (``dl.speculative`` has the batched version).
+
+    ``submit`` then ``step`` at boundaries (or ``run_until_drained``):
+    each boundary admits pending sequences through the scheduler
+    (shedding expired deadlines), prefills their suffixes in bucketed
+    batches, hands off to decode through the load-aware queue, and runs
+    one decode step. ``warm()`` precompiles both phases and declares
+    CompileTracker steady state."""
+
+    def __init__(self, module, variables, *, draft_module=None,
+                 draft_variables=None, slots: int = 2,
+                 block_len: int = 8, max_seq_len: int = 128,
+                 num_blocks: int | None = None, spec_k: int = 0,
+                 pad_id: int = 0, prefill_batch: int = 2,
+                 hbm_fraction: float = 0.5, service: str = "llm",
+                 registry=None, clock=time.monotonic):
+        from ..dl.paged_kv import blocks_for_hbm_budget
+        reg = registry if registry is not None else _default_registry
+        self.module = module
+        self.variables = variables
+        self.pad_id = int(pad_id)
+        self.service = service
+        self.clock = clock
+        self.max_seq_len = int(max_seq_len)
+        self.block_len = int(block_len)
+        self.max_blocks = -(-self.max_seq_len // self.block_len)
+        enc = module.encoder
+        hd = enc.width // enc.heads
+        block_bytes = (2 * enc.depth * self.block_len * enc.heads * hd
+                       * np.dtype(enc.dtype).itemsize)
+        if num_blocks is None:
+            # HBM-derived sizing with a host/CPU fallback generous
+            # enough for the slot count
+            num_blocks = blocks_for_hbm_budget(
+                block_bytes, fraction=hbm_fraction,
+                default=1 + 2 * slots * self.max_blocks)
+        self.kv = PagedKVManager(
+            num_blocks, self.block_len,
+            block_budget=blocks_for_hbm_budget(
+                block_bytes, fraction=hbm_fraction,
+                default=num_blocks - 1),
+            service=service, registry=reg)
+        self.pools = _PoolState(
+            init_pools(enc, num_blocks, self.block_len),
+            None if draft_module is None else init_pools(
+                draft_module.encoder, num_blocks, self.block_len))
+        self.sched = SlotScheduler(slots, service=service,
+                                   registry=reg, clock=clock)
+        self.prefiller = PrefillExecutor(
+            module, variables, self.kv, self.pools,
+            draft_module=draft_module, draft_variables=draft_variables,
+            max_blocks=self.max_blocks, batch=prefill_batch,
+            pad_id=pad_id, service=service)
+        self.decoder = DecodeExecutor(
+            module, variables, self.kv, self.pools,
+            draft_module=draft_module, draft_variables=draft_variables,
+            slots=slots, max_blocks=self.max_blocks, spec_k=spec_k,
+            pad_id=pad_id, service=service)
+        self.handoff = HandoffQueue()
+        self._meta: dict = {}
+        self._to_prefill: list = []
+        self._first_credit: dict = {}
+        self._done: dict = {}
+        self.expired: list = []
+        self._spec_acc = [0, 0]     # accepted, offered
+        self._h_ttft = reg.histogram(
+            "gen_ttft_seconds",
+            "submit→first-token latency, by service and prefix reuse",
+            buckets=(.001, .0025, .005, .01, .025, .05, .1, .25, .5,
+                     1., 2.5, 5., 10.))
+        self._c_tokens = reg.counter(
+            "gen_tokens_total", "generated tokens committed, by service")
+        self._c_steps = reg.counter(
+            "gen_decode_steps_total", "decode steps executed, by service")
+        self._g_accept = reg.gauge(
+            "gen_spec_accept_ratio",
+            "rolling fraction of offered draft tokens accepted, "
+            "by service")
+
+    # -- intake ------------------------------------------------------------
+    def submit(self, seq_id, prompt, max_new_tokens: int,
+               deadline: float | None = None) -> None:
+        prompt = [int(t) for t in np.asarray(prompt).reshape(-1)]
+        if len(prompt) + int(max_new_tokens) > self.max_seq_len:
+            raise ValueError(
+                f"prompt ({len(prompt)}) + max_new_tokens "
+                f"({max_new_tokens}) exceeds max_seq_len="
+                f"{self.max_seq_len}")
+        self._meta[seq_id] = _SeqMeta(prompt=prompt,
+                                      max_new_tokens=int(max_new_tokens),
+                                      t_submit=self.clock())
+        self.sched.offer(seq_id, prompt, max_new_tokens,
+                         deadline=deadline)
+
+    # -- one step boundary --------------------------------------------------
+    def step(self) -> list:
+        """Admit → prefill → handoff → decode. Returns ``(seq_id,
+        tokens)`` pairs (full sequence: prompt then generated) finished
+        at this boundary."""
+        for a in self.sched.admit():
+            self._to_prefill.append(a)
+        for seq_id in self.sched.drain_expired():
+            self._meta.pop(seq_id, None)
+            self.expired.append(seq_id)
+        self._run_prefill()
+        for payload in self.handoff.pull(self.decoder.free_slots):
+            meta = self._meta[payload["seq"]["seq_id"]]
+            slot = self.decoder.activate(meta.slot, payload)
+            meta.slot = slot
+            meta.first_token = int(payload["first"])
+            # the prefill-produced first token spends 1 of the slot's
+            # budget; credit it at this boundary's scheduler step
+            self._first_credit[slot] = 1
+        finished = []
+        results = self.decoder.step()
+        if results:
+            self._c_steps.inc(1, service=self.service)
+        tokens_by_slot = dict(self._first_credit)
+        self._first_credit = {}
+        for slot, (toks, n_acc) in results.items():
+            seq_id = self.decoder.seq_ids[slot]
+            meta = self._meta[seq_id]
+            meta.generated.extend(toks)
+            meta.decode_steps += 1
+            tokens_by_slot[slot] = tokens_by_slot.get(slot, 0) \
+                + len(toks)
+            self._c_tokens.inc(len(toks), service=self.service)
+            if self.decoder.spec_k:
+                self._spec_acc[0] += n_acc
+                self._spec_acc[1] += self.decoder.spec_k
+        if self._spec_acc[1]:
+            self._g_accept.set(self._spec_acc[0] / self._spec_acc[1],
+                               service=self.service)
+        active = self.sched.active_slots
+        if active:
+            # sequences still in prefill/handoff hold scheduler slots
+            # but committed nothing this step
+            for slot in active:
+                tokens_by_slot.setdefault(slot, 0)
+            for seq_id, slot in self.sched.step(tokens_by_slot):
+                if self.decoder.active[slot] and \
+                        self.decoder.seq_ids[slot] == seq_id:
+                    self.decoder.deactivate(slot)
+                finished.append((seq_id, self._finish(seq_id)))
+        return finished
+
+    def _run_prefill(self) -> None:
+        ready = []
+        still_stalled = []
+        for a in self._to_prefill:
+            try:
+                h = self.kv.allocate(a.seq_id, a.prompt)
+            except OutOfBlocks:
+                # pool saturated: the slot idles (0-token step entries)
+                # until decode completions release blocks
+                still_stalled.append(a)
+                continue
+            meta = self._meta[a.seq_id]
+            meta.slot = a.slot
+            meta.reused_tokens = h.reused_tokens
+            ready.append(a)
+        self._to_prefill = still_stalled
+        if not ready:
+            return
+        firsts = self.prefiller.prefill(
+            [(a.seq_id, a.prompt) for a in ready])
+        now = self.clock()
+        for a in ready:
+            first, suffix_len = firsts[a.seq_id]
+            meta = self._meta[a.seq_id]
+            meta.t_first = now
+            meta.prefill_tokens = suffix_len
+            self._h_ttft.observe(
+                now - meta.t_submit, service=self.service,
+                reuse="warm" if meta.reused_tokens else "cold")
+            self.handoff.push({
+                "seq": self.kv.export_seq(a.seq_id),
+                "first": first,
+                "max_new_tokens": a.max_new_tokens,
+            })
+
+    def _finish(self, seq_id) -> np.ndarray:
+        meta = self._meta.pop(seq_id)
+        self.kv.release(seq_id)
+        feature_log.record(
+            service=self.service, route="decode",
+            batch=self.decoder.slots,
+            bucket=_bucket_window(len(meta.prompt)),
+            queue_depth=self.sched.pending_count,
+            decode_steps=meta.decode_steps,
+            prefill_tokens=meta.prefill_tokens,
+            execute_ms=(self.clock() - meta.t_submit) * 1e3)
+        # prompt + [prefill's first token] + decode commits, trimmed to
+        # the budget (a final speculative burst can overshoot by 0 —
+        # the decode step clamps — but trim defensively anyway)
+        full = meta.prompt + [int(meta.first_token)] + \
+            [int(t) for t in meta.generated]
+        return np.asarray(full[:len(meta.prompt) + meta.max_new_tokens],
+                          np.int32)
+
+    # -- warmup / acceptance -----------------------------------------------
+    def warm(self, prefill_windows=(1,), mark_steady: bool = True
+             ) -> dict:
+        """Precompile both phases (prefill for the given window
+        buckets, the decode step) and optionally declare CompileTracker
+        steady state. Returns the union of both executors' AOT
+        fingerprints."""
+        self.prefiller.warm(prefill_windows)
+        self.decoder.warm()
+        if mark_steady:
+            compile_tracker.mark_steady()
+        return {**self.prefiller.aot_fingerprints(),
+                **self.decoder.aot_fingerprints()}
+
+    def run_until_drained(self) -> dict:
+        """Step until every submitted sequence completes or expires;
+        returns ``seq_id -> [prompt + generated] int32 array``."""
+        stalled = 0
+        while self.sched.busy or self._to_prefill or len(self.handoff):
+            before = len(self._done)
+            for seq_id, toks in self.step():
+                self._done[seq_id] = toks
+            # deadlock guard: prefill permanently out of blocks with no
+            # in-flight decode to release any is unrecoverable
+            if len(self._done) == before and self._to_prefill and \
+                    not self.decoder.active.any() and \
+                    not len(self.handoff):
+                stalled += 1
+                if stalled > 3:
+                    raise OutOfBlocks(
+                        f"{len(self._to_prefill)} sequence(s) cannot "
+                        "allocate KV blocks and no in-flight decode "
+                        "can release any — the pool is too small for "
+                        "this workload")
+            else:
+                stalled = 0
+        out, self._done = self._done, {}
+        return out

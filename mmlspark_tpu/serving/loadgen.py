@@ -73,7 +73,8 @@ def _slowest_trace_ids(steady_lat: np.ndarray, ok: np.ndarray,
 
 def summarize(lat: np.ndarray, status: np.ndarray, wall_s: float,
               warmup: int = 20, trace_prefix: str | None = None,
-              tenants: list[str] | None = None) -> dict:
+              tenants: list[str] | None = None,
+              ttft: np.ndarray | None = None) -> dict:
     """Shape raw per-request ``(latency_ms, http_status)`` matrices
     (connection-major ``[nconn, nreq]``; status -1 = transport failure,
     status >= 1000 = answered on a Retry-After re-attempt) into the
@@ -98,7 +99,16 @@ def summarize(lat: np.ndarray, status: np.ndarray, wall_s: float,
     connection) additionally splits the summary per tenant under a
     ``tenants`` key: mixed-workload bench numbers stay honest only if
     a gold tenant's p99 and a best-effort tenant's shed rate never
-    blend into one column."""
+    blend into one column.
+
+    ``ttft`` (generation mode — lg_run6's time-to-first-byte matrix,
+    same connection-major shape and -1-on-failure convention as
+    ``lat``) adds ``ttft_p50_ms``/``ttft_p99_ms`` over the SAME
+    first-offer-success mask as the latency percentiles, globally and
+    per tenant: an LLM front replies when the first token exists, so
+    first-byte time is the client-observed time-to-first-token and the
+    per-tenant split keeps a gold tenant's TTFT p99 honest under mixed
+    load."""
     if not (status >= 0).any():
         raise RuntimeError("loadgen: every request failed")
     retried_all = status >= _RETRIED_BASE
@@ -112,6 +122,12 @@ def summarize(lat: np.ndarray, status: np.ndarray, wall_s: float,
     # an overloaded run can shed EVERYTHING: percentiles go NaN (there
     # is no success latency to report), the shed/rejected counts stand
     ok_lat = steady_lat[ok] if ok.any() else np.asarray([np.nan])
+    ttft_ok = None
+    if ttft is not None:
+        steady_ttft = ttft[:, warmup:] if nreq > warmup else ttft
+        good = ok & (steady_ttft >= 0)
+        ttft_ok = steady_ttft[good] if good.any() \
+            else np.asarray([np.nan])
     per_conn_p99 = [float(np.percentile(row[m], 99))
                     for row, m in zip(steady_lat, ok) if m.any()] \
         or [float("nan")]
@@ -134,17 +150,25 @@ def summarize(lat: np.ndarray, status: np.ndarray, wall_s: float,
             rows = [c for c, t in enumerate(tenants) if t == name]
             try:
                 sub = summarize(lat[rows], status[rows], wall_s,
-                                warmup=warmup)
+                                warmup=warmup,
+                                ttft=None if ttft is None
+                                else ttft[rows])
             except RuntimeError:
                 # every one of this tenant's requests failed: report
                 # the failure count rather than erasing the tenant
                 sub = {"transport_errors":
                        int((status[rows] < 0).sum())}
             by_tenant[name] = {k: sub[k] for k in (
-                "p50_ms", "p99_ms", "shed", "shed_rate", "retried",
-                "retried_ok", "rejected", "throughput_rps",
+                "p50_ms", "p99_ms", "ttft_p50_ms", "ttft_p99_ms",
+                "shed", "shed_rate", "retried", "retried_ok",
+                "rejected", "throughput_rps",
                 "transport_errors") if k in sub}
+    out_ttft = {} if ttft_ok is None else {
+        "ttft_p50_ms": float(np.percentile(ttft_ok, 50)),
+        "ttft_p99_ms": float(np.percentile(ttft_ok, 99)),
+    }
     return {
+        **out_ttft,
         "tenants": by_tenant,
         "slowest": slowest,
         "p50_ms": float(np.percentile(ok_lat, 50)),
@@ -166,7 +190,8 @@ def run_load(host: str, port: int, payload: bytes, *, nconn: int = 16,
              nreq: int = 300, path: str = "/",
              warmup: int = 20, retry: bool = False,
              trace: bool = True,
-             tenants: list[str] | None = None) -> dict:
+             tenants: list[str] | None = None,
+             ttft: bool = False) -> dict:
     """Closed-loop load: ``nconn`` keep-alive connections, ``nreq``
     serial POSTs each; see :func:`summarize` for the returned summary
     (success-only percentiles; 429 sheds and other non-2xx reported
@@ -178,28 +203,32 @@ def run_load(host: str, port: int, payload: bytes, *, nconn: int = 16,
     the server's ``GET /debug/trace``. ``tenants`` assigns connection
     ``c`` the tenant ``tenants[c % len]``, stamped as ``X-Tenant`` on
     every request (lg_run5) and split out per tenant in the summary's
-    ``tenants`` key. Raises when nothing could connect."""
+    ``tenants`` key. ``ttft=True`` (generation mode, lg_run6)
+    additionally records each request's time-to-first-byte and adds
+    ``ttft_p50_ms``/``ttft_p99_ms`` globally and per tenant. Raises
+    when nothing could connect."""
     lib = _loader.load()
     # 20 hex prefix + 4 (conn) + 8 (req) = a 32-hex W3C-shaped trace id
     trace_prefix = uuid.uuid4().hex[:20] if trace else None
-    lib.lg_run5.restype = ctypes.c_long
-    lib.lg_run5.argtypes = [
+    dptr = ctypes.POINTER(ctypes.c_double)
+    lib.lg_run6.restype = ctypes.c_long
+    lib.lg_run6.argtypes = [
         ctypes.c_char_p, ctypes.c_int, ctypes.c_int, ctypes.c_long,
         ctypes.c_char_p, ctypes.c_char_p, ctypes.c_long, ctypes.c_int,
         ctypes.c_char_p, ctypes.c_char_p,
-        ctypes.POINTER(ctypes.c_double),
-        ctypes.POINTER(ctypes.c_int),
-        ctypes.POINTER(ctypes.c_double)]
+        dptr, ctypes.POINTER(ctypes.c_int), dptr, dptr]
     lat = np.empty(nconn * nreq, np.float64)
     status = np.empty(nconn * nreq, np.int32)
+    first = np.empty(nconn * nreq, np.float64) if ttft else None
     wall = ctypes.c_double(0.0)
-    errors = int(lib.lg_run5(
+    errors = int(lib.lg_run6(
         host.encode(), int(port), int(nconn), int(nreq), path.encode(),
         payload, len(payload), 1 if retry else 0,
         (trace_prefix or "").encode(),
         ",".join(tenants or []).encode(),
-        lat.ctypes.data_as(ctypes.POINTER(ctypes.c_double)),
+        lat.ctypes.data_as(dptr),
         status.ctypes.data_as(ctypes.POINTER(ctypes.c_int)),
+        first.ctypes.data_as(dptr) if first is not None else None,
         ctypes.byref(wall)))
     if errors < 0:
         raise RuntimeError("loadgen: no connection could be "
@@ -209,4 +238,6 @@ def run_load(host: str, port: int, payload: bytes, *, nconn: int = 16,
     return summarize(lat.reshape(nconn, nreq),
                      status.reshape(nconn, nreq), wall.value,
                      warmup=warmup, trace_prefix=trace_prefix,
-                     tenants=conn_tenants)
+                     tenants=conn_tenants,
+                     ttft=None if first is None
+                     else first.reshape(nconn, nreq))

@@ -822,12 +822,13 @@ class TestCostModelSchemaWindow:
         from mmlspark_tpu.perf.costmodel import (
             ACCEPTED_SCHEMA_VERSIONS, CostModel)
 
-        assert FEATURE_SCHEMA_VERSION == 3
-        assert ACCEPTED_SCHEMA_VERSIONS == {2, 3}
+        assert FEATURE_SCHEMA_VERSION == 4
+        assert ACCEPTED_SCHEMA_VERSIONS == {2, 3, 4}
         reg = MetricsRegistry()
         model = CostModel(min_rows=16, registry=reg)
-        used = model.fit(self._rows(2, 20) + self._rows(3, 20))
-        assert used == 40
+        used = model.fit(self._rows(2, 20) + self._rows(3, 20)
+                         + self._rows(4, 20))
+        assert used == 60
         assert reg.snapshot().get(
             'sched_costmodel_skipped_rows_total{reason="schema"}') \
             is None
@@ -848,7 +849,7 @@ class TestCostModelSchemaWindow:
         log = FeatureLog(maxlen=4, registry=MetricsRegistry())
         log.record(service="s", batch=2)
         row = log.snapshot()[-1]
-        assert row["schema_version"] == 3
+        assert row["schema_version"] == 4
         assert "process" in row          # None single-process, a rank
         assert row["process"] is None    # index string on a pod
 

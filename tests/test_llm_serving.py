@@ -1,0 +1,262 @@
+"""LLM serving engine (``serving/llm.py``): disaggregated prefill and
+decode over the paged KV cache, speculation inside the continuous
+batch, and the generation-mode load/bench plumbing.
+
+The load-bearing contract is token identity: greedy paged serving —
+plain, speculative with a real (disagreeing) draft, and self-draft —
+must produce byte-for-byte the tokens ``dl.generate`` produces per
+prompt. Everything else (prefix reuse, TTFT split, steady-state
+compiles, handoff) is asserted on the obs registry the benches bank
+from.
+"""
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from mmlspark_tpu.dl import MaskedLMModel, TextEncoder, generate, \
+    make_attention_fn
+from mmlspark_tpu.obs.metrics import MetricsRegistry
+from mmlspark_tpu.obs.profile import compile_tracker
+from mmlspark_tpu.serving.llm import (HandoffQueue, LLMEngine,
+                                      pack_handoff, unpack_handoff)
+
+VOCAB, MAXNEW = 32, 4
+
+
+@pytest.fixture(scope="module")
+def lm():
+    enc = TextEncoder(vocab=VOCAB, width=16, depth=1, heads=2,
+                      mlp_dim=32, dtype=jnp.float32,
+                      attention_fn=make_attention_fn("dense",
+                                                     causal=True))
+    module = MaskedLMModel(enc)
+    variables = module.init(jax.random.PRNGKey(0),
+                            np.zeros((1, 8), np.int32))
+    return module, variables
+
+
+@pytest.fixture(scope="module")
+def draft_lm(lm):
+    module, _ = lm
+    # same architecture, different weights: a draft that genuinely
+    # disagrees with the target some of the time
+    variables = module.init(jax.random.PRNGKey(7),
+                            np.zeros((1, 8), np.int32))
+    return module, variables
+
+
+def _prompts(seed=0, sizes=(3, 5, 2, 6, 4)):
+    rng = np.random.default_rng(seed)
+    return [rng.integers(2, VOCAB, size=n).astype(np.int32)
+            for n in sizes]
+
+
+def _ref(lm, prompts, max_new=MAXNEW):
+    module, variables = lm
+    return {i: np.asarray(generate(module, variables, p[None, :],
+                                   max_new_tokens=max_new,
+                                   temperature=0.0)[0])
+            for i, p in enumerate(prompts)}
+
+
+class TestHandoff:
+    def test_pack_unpack_roundtrip(self):
+        payload = {"seq": {"seq_id": "s0", "chain": [3, 1, 2],
+                           "length": 9, "prompt_len": 9,
+                           "reused_tokens": 4},
+                   "first": 17, "max_new_tokens": 8}
+        assert unpack_handoff(pack_handoff(payload)) == payload
+        # deterministic bytes (sort_keys): the lease envelope may hash
+        assert pack_handoff(payload) == pack_handoff(
+            dict(reversed(list(payload.items()))))
+
+    def test_queue_is_fifo_and_wire_shaped(self):
+        q = HandoffQueue()
+        q.push({"seq": {"seq_id": 0}, "first": 1, "max_new_tokens": 2})
+        q.push({"seq": {"seq_id": 1}, "first": 2, "max_new_tokens": 2})
+        assert len(q) == 2
+        got = q.pull(1)
+        assert [p["seq"]["seq_id"] for p in got] == [0]
+        assert q.pull(5)[0]["seq"]["seq_id"] == 1
+        assert q.pull(1) == []
+
+
+class TestGreedyIdentity:
+    def test_paged_matches_generate(self, lm):
+        module, variables = lm
+        prompts = _prompts()
+        ref = _ref(lm, prompts)
+        eng = LLMEngine(module, variables, slots=2, block_len=4,
+                        max_seq_len=16, registry=MetricsRegistry())
+        for i, p in enumerate(prompts):
+            eng.submit(i, p, MAXNEW)
+        got = eng.run_until_drained()
+        assert set(got) == set(ref)
+        for i, p in enumerate(prompts):
+            np.testing.assert_array_equal(got[i],
+                                          ref[i][:len(p) + MAXNEW])
+
+    def test_speculative_matches_generate(self, lm, draft_lm):
+        module, variables = lm
+        dmod, dvar = draft_lm
+        prompts = _prompts(seed=3)
+        ref = _ref(lm, prompts)
+        reg = MetricsRegistry()
+        eng = LLMEngine(module, variables, draft_module=dmod,
+                        draft_variables=dvar, slots=2, block_len=4,
+                        max_seq_len=16, spec_k=2, registry=reg)
+        for i, p in enumerate(prompts):
+            eng.submit(i, p, MAXNEW)
+        got = eng.run_until_drained()
+        for i, p in enumerate(prompts):
+            np.testing.assert_array_equal(got[i],
+                                          ref[i][:len(p) + MAXNEW])
+        ratio = reg.snapshot().get(
+            'gen_spec_accept_ratio{service="llm"}')
+        assert ratio is not None and 0.0 <= ratio <= 1.0
+
+    def test_self_draft_accepts_everything(self, lm):
+        module, variables = lm
+        prompts = _prompts(seed=5, sizes=(4, 3))
+        ref = _ref(lm, prompts)
+        reg = MetricsRegistry()
+        eng = LLMEngine(module, variables, draft_module=module,
+                        draft_variables=variables, slots=2, block_len=4,
+                        max_seq_len=16, spec_k=2, registry=reg)
+        for i, p in enumerate(prompts):
+            eng.submit(i, p, MAXNEW)
+        got = eng.run_until_drained()
+        for i, p in enumerate(prompts):
+            np.testing.assert_array_equal(got[i],
+                                          ref[i][:len(p) + MAXNEW])
+        # draft == target: every proposal must be accepted
+        assert reg.snapshot()[
+            'gen_spec_accept_ratio{service="llm"}'] == 1.0
+
+    def test_single_token_budget(self, lm):
+        # the prefill-produced first token IS the whole budget: the
+        # sequence must finish without a decode step ever running
+        module, variables = lm
+        p = _prompts(seed=9, sizes=(5,))[0]
+        ref = _ref(lm, [p], max_new=1)
+        eng = LLMEngine(module, variables, slots=1, block_len=4,
+                        max_seq_len=16, registry=MetricsRegistry())
+        eng.submit(0, p, 1)
+        got = eng.run_until_drained()
+        np.testing.assert_array_equal(got[0], ref[0][:len(p) + 1])
+
+
+class TestPrefixReuseAndTTFT:
+    def test_repeated_prefix_hits_and_ttft_split(self, lm):
+        module, variables = lm
+        reg = MetricsRegistry()
+        eng = LLMEngine(module, variables, slots=1, block_len=4,
+                        max_seq_len=24, service="llmttft", registry=reg)
+        p = _prompts(seed=11, sizes=(16,))[0]
+        ref = _ref(lm, [p])
+        eng.submit("cold", p, MAXNEW)
+        got1 = eng.run_until_drained()
+        eng.submit("warm", p, MAXNEW)
+        got2 = eng.run_until_drained()
+        # identical output either way — reuse must be invisible to the
+        # tokens (acceptance: ≥1 prefix hit + identical greedy output)
+        np.testing.assert_array_equal(got1["cold"],
+                                      ref[0][:len(p) + MAXNEW])
+        np.testing.assert_array_equal(got2["warm"],
+                                      ref[0][:len(p) + MAXNEW])
+        snap = reg.snapshot()
+        assert snap['kv_prefix_hits_total{service="llmttft"}'] >= 1.0
+        assert snap[
+            'kv_prefix_tokens_reused_total{service="llmttft"}'] >= 4.0
+        # TTFT lands in the right reuse label
+        h = reg.metrics("gen_ttft_seconds")[0]
+        assert h.count(service="llmttft", reuse="cold") == 1
+        assert h.count(service="llmttft", reuse="warm") == 1
+
+    def test_expired_deadline_is_shed_not_served(self, lm):
+        module, variables = lm
+        eng = LLMEngine(module, variables, slots=1, block_len=4,
+                        max_seq_len=16, registry=MetricsRegistry())
+        p = _prompts(sizes=(3,))[0]
+        eng.submit("dead", p, 2, deadline=-1.0)     # already expired
+        eng.submit("live", p, 2)
+        got = eng.run_until_drained()
+        assert "dead" not in got and "live" in got
+        assert eng.expired == ["dead"]
+
+    def test_pool_too_small_raises_instead_of_spinning(self, lm):
+        module, variables = lm
+        eng = LLMEngine(module, variables, slots=1, block_len=4,
+                        max_seq_len=16, num_blocks=2,
+                        registry=MetricsRegistry())
+        from mmlspark_tpu.dl.paged_kv import OutOfBlocks
+        eng.submit(0, _prompts(sizes=(9,))[0], MAXNEW)  # needs 3 blocks
+        with pytest.raises(OutOfBlocks):
+            eng.run_until_drained()
+
+
+class TestSteadyState:
+    def test_warmed_worker_serves_with_zero_compiles(self, lm):
+        module, variables = lm
+        eng = LLMEngine(module, variables, slots=2, block_len=4,
+                        max_seq_len=16, service="llmsteady",
+                        registry=MetricsRegistry())
+        prompts = _prompts(seed=13, sizes=(3, 6, 5))
+        windows = sorted({1, 4, 8})
+        fps = eng.warm(prefill_windows=tuple(windows), mark_steady=True)
+        try:
+            for i, p in enumerate(prompts):
+                eng.submit(i, p, MAXNEW)
+            got = eng.run_until_drained()
+            compile_tracker.assert_steady_state()
+        finally:
+            compile_tracker.unmark_steady()
+        assert len(got) == 3
+        # one decode program + one prefill program per window bucket,
+        # each with an AOT fingerprint pair
+        assert set(fps) == {"llm_decode_llmsteady_S2_k0",
+                            "llm_prefill_llmsteady_w1_b2",
+                            "llm_prefill_llmsteady_w4_b2",
+                            "llm_prefill_llmsteady_w8_b2"}
+        for static_fp, full_fp in fps.values():
+            assert static_fp and full_fp
+
+
+class TestScenarioAndLoadgen:
+    def test_llm_serving_scenario_smoke(self):
+        from mmlspark_tpu.testing.benchmarks import llm_serving_scenario
+        out = llm_serving_scenario(service="llmscen", slots=2,
+                                   n_prompts=3, prompt_len=8,
+                                   max_new_tokens=3,
+                                   registry=MetricsRegistry())
+        assert out["sequences"] == 9                # 3 prompts × 3 rounds
+        assert out["prefix_hits"] >= 1
+        assert out["prefix_hit_rate"] > 0
+        assert out["tokens_per_s"] > 0
+        assert out["steady_state_ok"]
+        assert out["ttft_cold_p50_ms"] > 0
+        assert out["ttft_warm_p50_ms"] > 0
+        # warm round prefills a 1-token suffix instead of the whole
+        # prompt — the TTFT improvement the cache exists to buy
+        assert out["ttft_warm_p50_ms"] <= out["ttft_cold_p50_ms"]
+
+    def test_summarize_ttft_columns(self):
+        from mmlspark_tpu.serving.loadgen import summarize
+        lat = np.full((2, 30), 10.0)
+        st = np.full((2, 30), 200, np.int32)
+        tt = np.full((2, 30), 3.0)
+        lat[0, 25] = tt[0, 25] = -1.0
+        st[0, 25] = -1
+        s = summarize(lat, st, 1.0, warmup=5,
+                      tenants=["gold", "be"], ttft=tt)
+        assert s["ttft_p50_ms"] == pytest.approx(3.0)
+        assert s["ttft_p99_ms"] == pytest.approx(3.0)
+        assert s["ttft_p50_ms"] <= s["p50_ms"]
+        for tname in ("gold", "be"):
+            assert "ttft_p99_ms" in s["tenants"][tname]
+        # without a ttft matrix the columns stay absent (lg_run5 path)
+        s2 = summarize(lat, st, 1.0, warmup=5)
+        assert "ttft_p50_ms" not in s2

@@ -1,0 +1,267 @@
+"""Paged KV cache — the no-JAX bookkeeping half (``dl.paged_kv``).
+
+Everything here drives :class:`PagedKVManager` pure-Python block-table
+bookkeeping: alloc/free/refcount, prefix-hash hit/miss, LRU eviction
+order, block-table round-trip, budget pressure. No model, no device —
+the same surface the no-JAX CI smoke imports (``ci/run_ci.py`` style
+gate asserts ``jax`` is absent from the process).
+"""
+
+import json
+
+import numpy as np
+import pytest
+
+from mmlspark_tpu.dl.paged_kv import (TRASH_BLOCK, OutOfBlocks,
+                                      PagedKVManager, SequenceHandle,
+                                      _chunk_hash,
+                                      blocks_for_hbm_budget)
+from mmlspark_tpu.obs.metrics import MetricsRegistry
+
+
+def _mgr(num_blocks=9, block_len=4, **kw):
+    kw.setdefault("registry", MetricsRegistry())
+    kw.setdefault("service", "kvtest")
+    return PagedKVManager(num_blocks, block_len, **kw)
+
+
+class TestChunkHash:
+    def test_commits_to_history(self):
+        # equal chunk contents hash differently under different prefixes
+        a = _chunk_hash("", [1, 2, 3, 4])
+        b = _chunk_hash(a, [1, 2, 3, 4])
+        assert a != b
+        assert _chunk_hash("", [1, 2, 3, 4]) == a      # deterministic
+
+    def test_no_concatenation_ambiguity(self):
+        assert _chunk_hash("", [12, 3]) != _chunk_hash("", [1, 23])
+
+
+class TestAllocFreeRefcount:
+    def test_alloc_free_roundtrip(self):
+        m = _mgr()
+        h = m.allocate("s", list(range(1, 11)))     # 10 toks = 2.5 chunks
+        assert len(h.chain) == 3                    # 2 full + 1 tail
+        assert TRASH_BLOCK not in h.chain
+        assert h.length == 0 and h.prompt_len == 10
+        assert m.capacity("s") == 12
+        st = m.stats()
+        assert st["used"] == 3 and st["free"] == 5
+        m.publish("s")
+        m.release("s")
+        st = m.stats()
+        assert st["used"] == 0
+        # published full chunks retire into the cache; the tail frees
+        assert st["cached"] == 2 and st["free"] == 6
+
+    def test_refcount_shares_blocks(self):
+        m = _mgr()
+        m.allocate("a", [5, 6, 7, 8])
+        m.publish("a")
+        hb = m.allocate("b", [5, 6, 7, 8])          # same chunk → shared
+        assert hb.chain == m.handle("a").chain
+        assert hb.reused_tokens == 4
+        m.release("a")
+        assert m.stats()["cached"] == 0             # b still holds a ref
+        m.release("b")
+        assert m.stats()["cached"] == 1             # now retired, indexed
+
+    def test_advance_and_capacity_growth(self):
+        m = _mgr()
+        m.allocate("s", [1, 2, 3, 4])
+        m.publish("s")
+        m.advance("s", 4)
+        with pytest.raises(ValueError):
+            m.advance("s", 1)                       # past capacity
+        m.ensure_capacity("s", 6)
+        assert m.capacity("s") == 8
+        assert m.advance("s", 2) == 6
+
+    def test_double_allocate_rejected(self):
+        m = _mgr()
+        m.allocate("s", [1, 2])
+        with pytest.raises(ValueError):
+            m.allocate("s", [1, 2])
+
+    def test_empty_prompt_rejected(self):
+        with pytest.raises(ValueError):
+            _mgr().allocate("s", [])
+
+
+class TestPrefixReuse:
+    def test_hit_miss_counters(self):
+        reg = MetricsRegistry()
+        m = _mgr(num_blocks=17, registry=reg)
+        m.allocate("a", list(range(1, 9)))          # 2 chunks, both miss
+        m.publish("a")
+        m.allocate("b", list(range(1, 9)))          # both hit
+        m.allocate("c", [1, 2, 3, 4, 9, 9, 9, 9])   # 1 hit + 1 miss
+        snap = reg.snapshot()
+        assert snap['kv_prefix_hits_total{service="kvtest"}'] == 3.0
+        assert snap['kv_prefix_misses_total{service="kvtest"}'] == 3.0
+        assert snap[
+            'kv_prefix_tokens_reused_total{service="kvtest"}'] == 12.0
+
+    def test_reuse_only_from_matching_history(self):
+        # chunk 2 of "a" must not serve as chunk 1 of anything, and a
+        # diverged chunk stops matching even if later chunks are equal
+        m = _mgr(num_blocks=17)
+        m.allocate("a", [1, 2, 3, 4, 5, 6, 7, 8])
+        m.publish("a")
+        hb = m.allocate("b", [5, 6, 7, 8])          # = a's SECOND chunk
+        assert hb.reused_tokens == 0
+        hc = m.allocate("c", [9, 9, 9, 9, 5, 6, 7, 8])
+        assert hc.reused_tokens == 0                # diverged at chunk 1
+
+    def test_unpublished_blocks_not_reused(self):
+        m = _mgr()
+        ha = m.allocate("a", [1, 2, 3, 4])          # never published
+        hb = m.allocate("b", [1, 2, 3, 4])
+        assert hb.reused_tokens == 0
+        assert set(ha.chain).isdisjoint(hb.chain)
+
+    def test_publish_first_writer_wins(self):
+        m = _mgr(num_blocks=17)
+        ha = m.allocate("a", [1, 2, 3, 4])
+        hb = m.allocate("b", [1, 2, 3, 4])          # raced, private block
+        assert m.publish("a") == 1
+        assert m.publish("b") == 0                  # a's block is indexed
+        hc = m.allocate("c", [1, 2, 3, 4])
+        assert hc.chain == ha.chain and hc.chain != hb.chain
+
+    def test_partial_tail_chunk_never_indexed(self):
+        m = _mgr()
+        m.allocate("a", [1, 2, 3, 4, 5, 6])         # 1 full + partial
+        assert m.publish("a") == 1
+        assert m.stats()["indexed_prefixes"] == 1
+
+
+class TestLRUEviction:
+    def test_eviction_order_is_least_recently_retired(self):
+        m = _mgr(num_blocks=4, block_len=2)         # 3 usable blocks
+        for sid, prompt in (("a", [1, 2]), ("b", [3, 4]), ("c", [5, 6])):
+            m.allocate(sid, prompt)
+            m.publish(sid)
+            m.release(sid)
+        assert m.stats()["cached"] == 3
+        # pool exhausted: the next two allocations must evict a's then
+        # b's block (retirement order), keeping c's cached
+        m.allocate("x", [7, 8])
+        m.allocate("y", [9, 10])
+        assert m.allocate("z", [5, 6]).reused_tokens == 2   # c survives
+
+    def test_revived_block_leaves_lru(self):
+        m = _mgr(num_blocks=4, block_len=2)
+        m.allocate("a", [1, 2])
+        m.publish("a")
+        m.release("a")
+        assert m.stats()["cached"] == 1
+        m.allocate("b", [1, 2])                     # revive from cache
+        assert m.stats()["cached"] == 0
+        assert m.stats()["used"] == 1
+
+    def test_out_of_blocks_when_everything_referenced(self):
+        m = _mgr(num_blocks=3, block_len=2)
+        m.allocate("a", [1, 2])
+        m.allocate("b", [3, 4])
+        with pytest.raises(OutOfBlocks):
+            m.allocate("c", [5, 6])
+        m.release("a")                              # unpublished → frees
+        assert m.allocate("c", [5, 6]).chain
+
+    def test_failed_allocate_unwinds_cleanly(self):
+        m = _mgr(num_blocks=4, block_len=2)
+        m.allocate("a", [1, 2, 3, 4])               # 2 of 3 blocks
+        before = m.stats()
+        with pytest.raises(OutOfBlocks):
+            m.allocate("b", [5, 6, 7, 8])           # needs 2, only 1 left
+        after = m.stats()
+        assert after["used"] == before["used"]
+        assert after["free"] == before["free"]
+        assert "b" not in m._seqs
+
+    def test_block_budget_pressure(self):
+        reg = MetricsRegistry()
+        m = _mgr(num_blocks=9, block_len=2, block_budget=4, registry=reg)
+        m.allocate("a", [1, 2, 3, 4])               # used=2
+        m.publish("a")
+        m.release("a")                              # cached=2
+        m.allocate("b", [5, 6, 7, 8])               # used=2 + cached=2 = cap
+        # next block busts the budget → evicts cache despite free blocks
+        m.allocate("c", [9, 9])
+        assert m.stats()["cached"] <= 1
+        assert reg.snapshot()[
+            'kv_evictions_total{service="kvtest"}'] >= 1.0
+
+    def test_set_block_budget_evicts_to_fit(self):
+        m = _mgr(num_blocks=9, block_len=2)
+        for sid, p in (("a", [1, 2]), ("b", [3, 4]), ("c", [5, 6])):
+            m.allocate(sid, p)
+            m.publish(sid)
+            m.release(sid)
+        assert m.stats()["cached"] == 3
+        assert m.set_block_budget(1) == 2
+        assert m.stats()["cached"] == 1
+        assert m.block_budget == 1
+
+
+class TestBlockTableAndHandoff:
+    def test_block_rows_padding(self):
+        m = _mgr(num_blocks=9, block_len=2)
+        m.allocate("a", [1, 2, 3])                  # 2 blocks
+        m.allocate("b", [4, 5])                     # 1 block
+        rows = m.block_rows(["a", None, "b"], max_blocks=3)
+        assert rows.shape == (3, 3) and rows.dtype == np.int32
+        assert list(rows[0][:2]) == m.handle("a").chain
+        assert rows[0][2] == TRASH_BLOCK
+        assert list(rows[1]) == [TRASH_BLOCK] * 3
+        assert rows[2][0] == m.handle("b").chain[0]
+        with pytest.raises(ValueError):
+            m.block_rows(["a"], max_blocks=1)       # chain too long
+
+    def test_export_adopt_roundtrip_through_json(self):
+        m = _mgr()
+        m.allocate("s", [1, 2, 3, 4, 5])
+        m.publish("s")
+        m.advance("s", 5)
+        state = m.export_seq("s")
+        with pytest.raises(KeyError):
+            m.handle("s")                           # detached
+        wire = json.loads(json.dumps(state))        # the lease envelope
+        h = m.adopt(wire)
+        assert h.chain == state["chain"] and h.length == 5
+        assert m.handle("s").prompt_len == 5
+        m.release("s")
+
+    def test_export_refuses_unpublished(self):
+        m = _mgr()
+        m.allocate("s", [1, 2, 3, 4])
+        with pytest.raises(ValueError):
+            m.export_seq("s")
+
+    def test_adopt_rejects_foreign_chain(self):
+        m = _mgr()
+        state = SequenceHandle(seq_id="x", chain=[7], length=0,
+                               prompt_len=1).to_state()
+        with pytest.raises(ValueError):
+            m.adopt(state)
+
+    def test_handle_state_roundtrip(self):
+        h = SequenceHandle(seq_id="s", chain=[3, 1], length=7,
+                           prompt_len=6, reused_tokens=4)
+        h2 = SequenceHandle.from_state(h.to_state())
+        assert (h2.seq_id, h2.chain, h2.length, h2.prompt_len,
+                h2.reused_tokens) == ("s", [3, 1], 7, 6, 4)
+
+
+class TestBudgetSizing:
+    def test_hbm_budget_falls_back_without_backend(self):
+        # host-only process: device_memory_stats is empty → default
+        assert blocks_for_hbm_budget(1024, default=7) in (7,) or \
+            blocks_for_hbm_budget(1024, default=7) >= 0
+
+    def test_constructor_validation(self):
+        with pytest.raises(ValueError):
+            PagedKVManager(1, 4, registry=MetricsRegistry())
+        with pytest.raises(ValueError):
+            PagedKVManager(4, 0, registry=MetricsRegistry())

@@ -515,6 +515,50 @@ class TestContinuousBatching:
         # when budgets are ragged — here just pin admissions happened)
         assert gen.sched._c_admitted.value(service="generate") == 5.0
 
+    def test_slot_scheduler_sheds_expired_before_admission(self):
+        """ISSUE 17 satellite: a pending sequence whose deadline passed
+        while it queued must be shed at admit() — before it ever
+        occupies a slot — counted in
+        ``sched_continuous_expired_total`` and surfaced through
+        ``drain_expired()``; live-deadline and no-deadline sequences
+        admit normally."""
+        reg = MetricsRegistry()
+        now = [100.0]
+        s = SlotScheduler(1, registry=reg, clock=lambda: now[0])
+        s.offer("dead", [1], 2, deadline=99.0)      # already expired
+        s.offer("live", [2], 2, deadline=1000.0)
+        s.offer("plain", [3], 2)                    # no deadline
+        admitted = s.admit()
+        assert [a.seq_id for a in admitted] == ["live"]
+        assert s.drain_expired() == ["dead"]
+        assert s.drain_expired() == []              # drained once
+        assert reg.snapshot()[
+            'sched_continuous_expired_total{service="generate"}'] == 1.0
+        # the expired sequence never consumed the slot: "plain" admits
+        # as soon as "live" completes
+        s.step()
+        s.step()
+        assert [a.seq_id for a in s.admit()] == ["plain"]
+        # expiry happens at admission time, not offer time: a deadline
+        # that passes while pending still sheds
+        s.offer("late", [4], 1, deadline=150.0)
+        now[0] = 200.0
+        assert s.admit() == []
+        assert s.drain_expired() == ["late"]
+        assert reg.snapshot()[
+            'sched_continuous_expired_total{service="generate"}'] == 2.0
+
+    def test_slot_scheduler_multi_token_step(self):
+        """step(tokens) advances slots by a per-slot count (speculative
+        bursts commit >1, prefill-stalled slots commit 0)."""
+        s = SlotScheduler(2, registry=MetricsRegistry())
+        s.offer("a", [1], 5)
+        s.offer("b", [2], 2)
+        s.admit()
+        assert s.step({0: 3, 1: 0}) == []           # a: 3/5, b: 0/2
+        assert sorted(s.step({0: 2, 1: 2})) == [("a", 0), ("b", 1)]
+        assert not s.busy
+
     def test_continuous_validates_prompts(self):
         import jax
         import jax.numpy as jnp
