@@ -2000,6 +2000,84 @@ def bench_llm_serving(extras: dict) -> None:
         extras["llm_spec_accept_ratio"] = spec["spec_accept_ratio"]
 
 
+def bench_llm_decode(extras: dict) -> None:
+    """Long-context decode throughput, paged kernel vs the dense
+    re-gather fallback, banked side by side
+    (``testing.benchmarks.llm_decode_scenario``: >=4k tokens of
+    resident KV, decode-only timed window, CompileTracker steady
+    state). Two scrubbed subprocesses run the IDENTICAL scenario — the
+    second with ``MMLSPARK_TPU_PAGED_ATTN=0`` — so the banked pair
+    isolates the kernel swap: ``llm_decode_tokens_per_sec`` (paged,
+    higher-good) against ``llm_decode_tokens_per_sec_dense``, and the
+    per-run ``kv_dense_gather_bytes_total`` readings
+    (``llm_decode_paged_gather_bytes`` must be exactly 0 — steady
+    paged decode never re-materialises the dense cache;
+    ``llm_decode_dense_gather_bytes`` is the bytes/run the old path
+    pays). The RegressionGate reads direction from the names. The
+    platform rides in ``llm_decode_platform`` so host-CPU numbers are
+    never mistaken for TPU decode throughput."""
+    import subprocess
+    import sys
+
+    from mmlspark_tpu.core.utils import scrubbed_cpu_env
+
+    repo = os.path.dirname(os.path.abspath(__file__))
+
+    def run_variant(paged: bool) -> dict:
+        code = (
+            "import json; "
+            "import jax; jax.config.update('jax_platforms', 'cpu'); "
+            "from mmlspark_tpu.obs.metrics import MetricsRegistry; "
+            "from mmlspark_tpu.testing.benchmarks import "
+            "llm_decode_scenario; "
+            "out = llm_decode_scenario("
+            f"service='llm-decode-{'paged' if paged else 'dense'}', "
+            "registry=MetricsRegistry()); "
+            "out.pop('outputs'); "
+            "print(json.dumps(out), flush=True)")
+        env = scrubbed_cpu_env(extra_path=repo)
+        if not paged:
+            env["MMLSPARK_TPU_PAGED_ATTN"] = "0"
+        proc = subprocess.run(
+            [sys.executable, "-c", code], env=env, cwd=repo,
+            capture_output=True, text=True,
+            timeout=420 * _timeout_scale())
+        parsed = None
+        for line in reversed((proc.stdout or "").splitlines()):
+            try:
+                candidate = json.loads(line)
+            except ValueError:
+                continue
+            if isinstance(candidate, dict):
+                parsed = candidate
+                break
+        if proc.returncode != 0 or not isinstance(parsed, dict):
+            raise RuntimeError(
+                f"llm decode bench ({'paged' if paged else 'dense'}) "
+                f"failed (rc={proc.returncode}):\n"
+                f"{((proc.stdout or '') + (proc.stderr or ''))[-2000:]}")
+        return parsed
+
+    paged = run_variant(True)
+    dense = run_variant(False)
+    extras["llm_decode_platform"] = "cpu-host (scrubbed subprocess)"
+    extras["llm_decode_context_tokens"] = paged["context_tokens"]
+    extras["llm_decode_tokens_per_sec"] = round(
+        paged["tokens_per_s"], 1)
+    extras["llm_decode_tokens_per_sec_dense"] = round(
+        dense["tokens_per_s"], 1)
+    extras["llm_decode_paged_vs_dense"] = round(
+        paged["tokens_per_s"] / max(dense["tokens_per_s"], 1e-9), 3)
+    extras["llm_decode_paged_gather_bytes"] = paged[
+        "dense_gather_bytes"]
+    extras["llm_decode_dense_gather_bytes"] = dense[
+        "dense_gather_bytes"]
+    extras["llm_decode_attn_ms_per_step"] = round(
+        paged["attn_ms_per_step"], 3)
+    extras["llm_decode_steady_state_ok"] = bool(
+        paged["steady_state_ok"] and dense["steady_state_ok"])
+
+
 def _emit(images_per_sec: float, extras: dict) -> None:
     print(json.dumps({
         "metric": "imagefeaturizer_resnet50_inference",
@@ -2163,6 +2241,11 @@ def main():
             # multi-host generation bench (paged KV + prefill/decode
             # executors): scrubbed subprocesses, tunnel-immune
             _watchdog(bench_llm_serving, extras, "llm_serving", 600.0)
+        if want("llm_decode"):
+            # long-context decode throughput, paged kernel vs dense
+            # re-gather fallback banked side by side: scrubbed
+            # subprocesses, tunnel-immune
+            _watchdog(bench_llm_decode, extras, "llm_decode", 900.0)
         if want("observability"):
             # pure host-side (scheduler + in-thread mesh): tunnel-immune
             _watchdog(bench_observability, extras, "observability",

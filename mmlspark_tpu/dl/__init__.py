@@ -44,6 +44,8 @@ _EXPORTS = {
     "train_epoch": ".train",
     "PagedKVManager": ".paged_kv",
     "SequenceHandle": ".paged_kv",
+    "paged_attention": ".pallas_paged_attention",
+    "paged_window_attention": ".pallas_paged_attention",
 }
 
 __all__ = sorted(_EXPORTS)

@@ -42,6 +42,7 @@ Obs families (federated fleet-wide, recorded by the history plane):
 from __future__ import annotations
 
 import hashlib
+import os
 from collections import OrderedDict, deque
 from dataclasses import dataclass, field
 
@@ -51,11 +52,24 @@ from ..obs import registry as _default_registry
 
 __all__ = ["PagedKVManager", "SequenceHandle", "OutOfBlocks",
            "blocks_for_hbm_budget", "init_pools", "gather_dense",
-           "scatter_positions", "take_positions"]
+           "paged_attention_enabled", "scatter_positions",
+           "take_positions"]
 
 #: the reserved trash block — device programs route padded/inactive
 #: writes here; the host half never hands it to a sequence
 TRASH_BLOCK = 0
+
+
+def paged_attention_enabled() -> bool:
+    """Kill switch for the paged-attention decode kernel
+    (``dl.pallas_paged_attention``): ``MMLSPARK_TPU_PAGED_ATTN=0``
+    routes the serving executors back through the dense
+    ``gather_dense`` round-trip (same escape-hatch pattern as
+    ``MMLSPARK_TPU_COSTMODEL=0``). The fallback is loud:
+    ``kv_dense_gather_bytes_total`` counts every byte it re-gathers,
+    and reads 0 when the kernel path is live. JAX-free on purpose —
+    the bookkeeping half stays importable without a backend."""
+    return os.environ.get("MMLSPARK_TPU_PAGED_ATTN", "1") != "0"
 
 
 class OutOfBlocks(RuntimeError):
@@ -429,10 +443,18 @@ class PagedKVManager:
         """Lower (or raise) the used+cached cap; cached blocks are
         LRU-evicted immediately to fit. Returns blocks evicted — the
         fleet health plane calls this when ``mem_hbm_*`` pressure
-        crosses its watermark."""
+        crosses its watermark.
+
+        Eviction here aligns with :meth:`_take_block`'s strict
+        ``used + cached < budget`` pre-allocation invariant: a shrink
+        pays its whole eviction debt now (counted
+        ``kv_evictions_total``), so the next ``allocate`` never evicts
+        on the lowered budget's behalf. Stopping at ``== budget`` — the
+        old behaviour — left exactly one cached block to be reclaimed
+        lazily at the next allocation."""
         self._budget = max(min(int(budget), self.num_blocks - 1), 1)
         evicted = 0
-        while len(self._ref) + len(self._lru) > self._budget:
+        while len(self._ref) + len(self._lru) >= self._budget:
             if self._evict_one() is None:
                 break
             evicted += 1
@@ -489,7 +511,13 @@ def gather_dense(pools, rows):
     layout ``MaskedLMModel.decode_step/decode_window`` run over, so the
     paged path reuses their (equivalence-tested) attention math
     unchanged. Positions ≥ the slot's length hold stale/trash data; the
-    decode mask (``arange < pos``) never attends them."""
+    decode mask (``arange < pos``) never attends them.
+
+    DEPRECATION SEAM: the serving executors no longer call this per
+    step — ``dl.pallas_paged_attention`` reads the pools in place. It
+    stays callable behind ``MMLSPARK_TPU_PAGED_ATTN=0``
+    (:func:`paged_attention_enabled`), where every re-gathered byte is
+    counted in ``kv_dense_gather_bytes_total``."""
     import jax.numpy as jnp
     S, MB = rows.shape
     out = []
@@ -508,7 +536,11 @@ def gather_dense(pools, rows):
 def take_positions(dense, pos):
     """Extract the kv written at absolute positions ``pos`` ([S, w])
     from dense caches ``[S, H, L, hd]`` -> per-layer ``[S, w, H, hd]``
-    (the delta the device step scatters back into the pools)."""
+    (the delta the device step scatters back into the pools).
+
+    DEPRECATION SEAM: only the ``MMLSPARK_TPU_PAGED_ATTN=0`` fallback
+    executors still round-trip through this — the paged-attention path
+    computes layer kv directly and scatters once."""
     import jax.numpy as jnp
     out = []
     for k, v in dense:

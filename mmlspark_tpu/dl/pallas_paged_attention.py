@@ -1,0 +1,306 @@
+"""Pallas TPU kernel: paged decode attention over the block table.
+
+The LLM serving engine's hot op. The PR 15 executors materialize each
+slot's whole KV history with ``paged_kv.gather_dense`` before every
+decode step — O(context) HBM traffic per generated token and a second
+resident copy of the KV working set, exactly the bandwidth the paged
+pool exists to save. This kernel reads the fixed per-layer pools
+``[num_blocks, block_len, heads, head_dim]`` IN PLACE:
+
+    grid = (slots/slots_tile, slots_tile, max_blocks), blocks innermost
+    the int32 block table rides scalar prefetch (SMEM), so each grid
+    cell's BlockSpec index_map streams pool block ``rows[s, j]``
+    straight HBM→VMEM — the gather IS the block fetch, no dense copy
+    per (slot, chain-position) cell: per-head q·kᵀ on the MXU,
+        online max/denominator update in VMEM scratch (flash style),
+        acc += softmax-weights @ v
+    emit acc / l once per slot on the last chain block.
+
+Masking: table rows pad with ``TRASH_BLOCK`` — those cells are skipped
+outright (``pl.when``), and in-block key positions mask against each
+query row's global position (``t <= pos + i``), which also covers
+positions ≥ the slot's length inside the tail block. A windowed variant
+(q = k+1 rows per slot) serves speculative verify with the same kernel.
+
+Off-TPU the SAME call runs a pure-``lax`` reference (``jnp.take`` over
+the table inside the jit — no pool-level dense gather round-trip, no
+writeback) whose formulation matches ``EncoderBlock.decode_window`` /
+``_dense_attention`` bit-for-bit, so CPU tier-1 asserts byte-identical
+greedy serving through identical program logic. The platform switch is
+the same one ``pallas_attention.flash_attention`` uses.
+
+Tile tuning: ``block_kv`` (key positions per inner VMEM chunk — the
+score-block width, same VMEM discipline as ``_resolve_block_k``) and
+``slots_tile`` (slots packed per parallel grid row — launch geometry
+for tiny per-slot decode work) default to the ``perf.autotune`` winner
+for this (context-bucket, platform) when one is registered, keyed
+``kernel="paged_attn"``; explicit values always win, and every config
+computes identical results (tuning moves time, never tokens).
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+from ..parallel.compat import tpu_compiler_params as _CompilerParams
+from ..utils.platform import target_platform
+from .paged_kv import TRASH_BLOCK, paged_attention_enabled  # noqa: F401
+
+_NEG = -1e30  # additive mask value; -inf breaks the running-max algebra
+
+
+# --------------------------------------------------------------- lax path
+@jax.jit
+def _paged_reference(q, k_pool, v_pool, rows, pos):
+    """Pure-lax paged attention: ``jnp.take`` each slot's chained
+    blocks THROUGH the table inside the jit (fused by XLA — no
+    materialized dense cache crossing a program boundary, no
+    writeback), then the exact ``decode_window`` score formulation:
+    f32 einsum × hd^-0.5, -inf outside ``t <= pos + i``, softmax,
+    NaN→0 for fully-masked rows, ``p.astype(v.dtype)`` before the
+    value einsum. Bit-identical to the dense-cache decode math — the
+    byte-identity contract with ``dl.generate`` rides on it."""
+    S, H, w, hd = q.shape
+    NB, BL = k_pool.shape[0], k_pool.shape[1]
+    MB = rows.shape[1]
+    L = MB * BL
+    idx = (rows[:, :, None] * BL
+           + jnp.arange(BL)[None, None, :]).reshape(S, L)
+    k = jnp.take(k_pool.reshape(NB * BL, H, hd), idx, axis=0)
+    v = jnp.take(v_pool.reshape(NB * BL, H, hd), idx, axis=0)
+    k = jnp.transpose(k, (0, 2, 1, 3))                  # [S, H, L, hd]
+    v = jnp.transpose(v, (0, 2, 1, 3))
+    s = jnp.einsum("bhqd,bhkd->bhqk", q, k,
+                   preferred_element_type=jnp.float32) * (hd ** -0.5)
+    allowed = (jnp.arange(L)[None, None, :]
+               <= (pos[:, None] + jnp.arange(w)[None, :])[:, :, None])
+    s = jnp.where(allowed[:, None], s, -jnp.inf)
+    p = jax.nn.softmax(s, axis=-1)
+    p = jnp.where(jnp.isnan(p), 0.0, p)
+    return jnp.einsum("bhqk,bhkd->bhqd", p.astype(v.dtype), v)
+
+
+# ------------------------------------------------------------ pallas path
+def _paged_kernel(rows_ref, pos_ref, q_ref, k_ref, v_ref, o_ref,
+                  m_scr, l_scr, acc_scr, *, scale: float, heads: int,
+                  w: int, block_len: int, block_kv: int,
+                  slots_tile: int):
+    """One (slot-group, slot, chain-block) grid cell. The k/v refs
+    already hold pool block ``rows[s, j]`` — the scalar-prefetched
+    table drove the fetch; this body only ever sees one slot's own
+    chain (or the trash block, which it skips)."""
+    g = pl.program_id(0)
+    u = pl.program_id(1)
+    j = pl.program_id(2)
+    nj = pl.num_programs(2)
+    s_idx = g * slots_tile + u
+
+    @pl.when(j == 0)
+    def _init():
+        m_scr[:] = jnp.full_like(m_scr, _NEG)
+        l_scr[:] = jnp.zeros_like(l_scr)
+        acc_scr[:] = jnp.zeros_like(acc_scr)
+
+    block_id = rows_ref[s_idx, j]
+    pos = pos_ref[s_idx, 0]
+
+    @pl.when(block_id != TRASH_BLOCK)
+    def _compute():
+        q = q_ref[0]                       # [heads*w, hd]
+        k = k_ref[0]                       # [block_len, H, hd]
+        v = v_ref[0]
+        for c in range(-(-block_len // block_kv)):
+            lo = c * block_kv
+            hi = min(block_len, lo + block_kv)
+            cw = hi - lo
+            # chain-logical key positions of this chunk vs each query
+            # row's global position: covers causality AND length (the
+            # tail block's unwritten positions are > pos + i)
+            tpos = j * block_len + lo + jax.lax.broadcasted_iota(
+                jnp.int32, (w, cw), 1)
+            qpos = pos + jax.lax.broadcasted_iota(jnp.int32, (w, cw), 0)
+            allowed = tpos <= qpos
+            for h in range(heads):
+                r0 = h * w
+                s = jax.lax.dot_general(   # [w, cw] f32 on the MXU
+                    q[r0:r0 + w], k[lo:hi, h, :],
+                    (((1,), (1,)), ((), ())),
+                    preferred_element_type=jnp.float32) * scale
+                s = jnp.where(allowed, s, _NEG)
+                m_prev = m_scr[r0:r0 + w, :1]
+                l_prev = l_scr[r0:r0 + w, :1]
+                m_new = jnp.maximum(
+                    m_prev, jnp.max(s, axis=-1, keepdims=True))
+                p = jnp.exp(s - m_new)
+                p = jnp.where(allowed, p, 0.0)
+                corr = jnp.exp(m_prev - m_new)
+                l_scr[r0:r0 + w, :1] = l_prev * corr \
+                    + jnp.sum(p, axis=-1, keepdims=True)
+                m_scr[r0:r0 + w, :1] = m_new
+                acc_scr[r0:r0 + w, :] = acc_scr[r0:r0 + w, :] * corr \
+                    + jax.lax.dot_general(
+                        p.astype(v.dtype), v[lo:hi, h, :],
+                        (((1,), (0,)), ((), ())),
+                        preferred_element_type=jnp.float32)
+
+    @pl.when(j == nj - 1)
+    def _emit():
+        R = heads * w
+        l = jnp.maximum(l_scr[:R, :1], 1e-35)
+        o_ref[0] = (acc_scr[:R] / l).astype(o_ref.dtype)
+
+
+@functools.partial(jax.jit, static_argnames=("block_kv", "slots_tile",
+                                             "interpret"))
+def _paged_pallas(q, k_pool, v_pool, rows, pos, *, block_kv: int,
+                  slots_tile: int, interpret: bool):
+    S, H, w, hd = q.shape
+    BL = k_pool.shape[1]
+    MB = rows.shape[1]
+    st = max(min(int(slots_tile), max(S, 1)), 1)
+    bkv = max(min(int(block_kv), BL), 1)
+    Sp = -(-S // st) * st
+    R = H * w
+    Rp = max(R, 8)                        # sublane-minimum scratch rows
+    qf = jnp.pad(q.reshape(S, R, hd), ((0, Sp - S), (0, 0), (0, 0)))
+    rows_p = jnp.pad(rows.astype(jnp.int32), ((0, Sp - S), (0, 0)),
+                     constant_values=TRASH_BLOCK)
+    pos_p = jnp.pad(pos.astype(jnp.int32), (0, Sp - S))[:, None]
+    kern = functools.partial(_paged_kernel, scale=hd ** -0.5, heads=H,
+                             w=w, block_len=BL, block_kv=bkv,
+                             slots_tile=st)
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=2,
+        grid=(Sp // st, st, MB),
+        in_specs=[
+            pl.BlockSpec((1, R, hd),
+                         lambda g, u, j, rt, pt: (g * st + u, 0, 0)),
+            # the zero-copy read: the table entry IS the block index
+            pl.BlockSpec((1, BL, H, hd),
+                         lambda g, u, j, rt, pt:
+                         (rt[g * st + u, j], 0, 0, 0)),
+            pl.BlockSpec((1, BL, H, hd),
+                         lambda g, u, j, rt, pt:
+                         (rt[g * st + u, j], 0, 0, 0)),
+        ],
+        out_specs=pl.BlockSpec(
+            (1, R, hd), lambda g, u, j, rt, pt: (g * st + u, 0, 0)),
+        scratch_shapes=[
+            pltpu.VMEM((Rp, 128), jnp.float32),   # running max
+            pltpu.VMEM((Rp, 128), jnp.float32),   # running denominator
+            pltpu.VMEM((Rp, hd), jnp.float32),    # output accumulator
+        ],
+    )
+    out = pl.pallas_call(
+        kern,
+        grid_spec=grid_spec,
+        out_shape=jax.ShapeDtypeStruct((Sp, R, hd), v_pool.dtype),
+        compiler_params=_CompilerParams(
+            dimension_semantics=("parallel", "arbitrary", "arbitrary")),
+        interpret=interpret,
+    )(rows_p, pos_p, qf, k_pool, v_pool)
+    return out[:S].reshape(S, H, w, hd)
+
+
+# ------------------------------------------------------------- resolution
+def _tuned_paged(context: int, hd: int, w: int,
+                 platform: str) -> tuple[int, int] | None:
+    """Autotuned (block_kv, slots_tile) for this (context-bucket,
+    platform) from the offline winner registry, or None when untuned.
+    A plain dict read — this runs at jit trace time inside the serving
+    programs, where locks/IO/clock are trace-safety hazards."""
+    try:
+        from ..perf import autotune
+    except Exception:  # pragma: no cover - perf layer optional
+        return None
+    win = autotune.kernel_winner("paged_attn",
+                                 autotune.paged_key(context, hd, w),
+                                 platform)
+    if not win:
+        return None
+    try:
+        return int(win["block_kv"]), int(win["slots_tile"])
+    except (KeyError, TypeError, ValueError):
+        return None
+
+
+def _resolve_paged(block_kv, slots_tile, *, context: int,
+                   block_len: int, hd: int, w: int,
+                   platform: str) -> tuple[int, int]:
+    """Final (block_kv, slots_tile): explicit caller values win; then
+    the autotuned winner for this context bucket; then the defaults
+    (whole pool block per chunk, one slot per grid row)."""
+    tuned = None
+    if block_kv is None or slots_tile is None:
+        tuned = _tuned_paged(context, hd, w, platform)
+    if block_kv is None:
+        block_kv = tuned[0] if tuned else block_len
+    if slots_tile is None:
+        slots_tile = tuned[1] if tuned else 1
+    return (max(min(int(block_kv), int(block_len)), 1),
+            max(int(slots_tile), 1))
+
+
+# --------------------------------------------------------------- public
+def paged_window_attention(q, k_pool, v_pool, rows, pos, *,
+                           block_kv: int | None = None,
+                           slots_tile: int | None = None,
+                           impl: str | None = None,
+                           interpret: bool | None = None):
+    """Windowed paged attention: ``q`` [S, H, w, hd] holds w query rows
+    per slot at global positions ``pos[s] + i`` (speculative verify
+    passes the k+1 draft window); ``k_pool``/``v_pool`` are ONE layer's
+    pools ``[num_blocks, block_len, H, hd]``; ``rows`` [S, max_blocks]
+    is the ``PagedKVManager.block_rows`` table (TRASH_BLOCK padding);
+    ``pos`` [S] int32. Query row i attends pool positions
+    ``t <= pos + i`` through the slot's chain — the window's own k/v
+    must already be scattered (write-then-attend, like
+    ``decode_window``'s cache update). Returns [S, H, w, hd].
+
+    ``impl``: "pallas" | "lax" | None (platform switch — TPU-class
+    backends run the kernel, everything else the bit-exact lax
+    reference). ``interpret`` forces the Pallas interpreter (tests).
+    ``block_kv``/``slots_tile`` default to the autotuned winner
+    (``perf.autotune``, kernel "paged_attn"), else block_len / 1;
+    every config returns identical values."""
+    plat = target_platform()
+    if impl is None:
+        impl = "pallas" if plat in ("tpu", "axon") else "lax"
+    rows = jnp.asarray(rows, jnp.int32)
+    pos = jnp.asarray(pos, jnp.int32)
+    if impl == "lax":
+        return _paged_reference(q, k_pool, v_pool, rows, pos)
+    if impl != "pallas":
+        raise ValueError(f"impl={impl!r} is not one of pallas|lax")
+    if interpret is None:
+        interpret = plat not in ("tpu", "axon")
+    BL = int(k_pool.shape[1])
+    context = int(rows.shape[1]) * BL
+    block_kv, slots_tile = _resolve_paged(
+        block_kv, slots_tile, context=context, block_len=BL,
+        hd=int(q.shape[3]), w=int(q.shape[2]), platform=plat)
+    return _paged_pallas(q, k_pool, v_pool, rows, pos,
+                         block_kv=block_kv, slots_tile=slots_tile,
+                         interpret=bool(interpret))
+
+
+def paged_attention(q, k_pool, v_pool, rows, pos, *,
+                    block_kv: int | None = None,
+                    slots_tile: int | None = None,
+                    impl: str | None = None,
+                    interpret: bool | None = None):
+    """Single-token paged decode attention: ``q`` [S, H, hd] is each
+    slot's newest query at global position ``pos[s]`` (already written
+    to the pools); attends pool positions ``t <= pos[s]`` through the
+    block table. The w=1 case of :func:`paged_window_attention` —
+    returns [S, H, hd]."""
+    out = paged_window_attention(q[:, :, None, :], k_pool, v_pool,
+                                 rows, pos, block_kv=block_kv,
+                                 slots_tile=slots_tile, impl=impl,
+                                 interpret=interpret)
+    return out[:, :, 0, :]

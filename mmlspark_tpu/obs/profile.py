@@ -412,10 +412,14 @@ step_profiler = StepProfiler()
 #: generation-row fields ``decode_steps`` and ``prefill_tokens`` (the
 #: LLM serving engine records one row per completed sequence) so the
 #: cost model can price decode separately from prefill; non-generation
-#: rows simply omit them. Consumers (``perf.costmodel``) accept v4, v3
-#: and v2 rows and SKIP anything else, loudly, instead of misparsing
-#: old logs.
-FEATURE_SCHEMA_VERSION = 4
+#: rows simply omit them. v5 (ISSUE 18) adds ``context_blocks`` (KV
+#: blocks resident at completion) so decode-step time is priced by
+#: resident context, not just batch — the paged-attention kernel's
+#: cost scales with the chain length it streams. Consumers
+#: (``perf.costmodel``) accept v5 through v2 rows and SKIP anything
+#: else, loudly, instead of misparsing old logs; fields absent in old
+#: rows train as 0.
+FEATURE_SCHEMA_VERSION = 5
 
 _platform_cache: str | None = None
 

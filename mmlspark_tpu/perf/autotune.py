@@ -1,10 +1,12 @@
 """Offline autotuner for the Pallas kernels (ISSUE 12).
 
-TVM-style search (arXiv:1802.04799), scoped to the two kernels this
-repo hand-tuned: the flash-attention forward's ``block_q × block_k``
-tiles (``dl/pallas_attention.py`` ships 256/auto) and the GBDT
-histogram's ``feat_block × block_rows`` tiles (``lightgbm/
-pallas_hist.py`` ships 8/2048). The tuner
+TVM-style search (arXiv:1802.04799), scoped to the kernels this repo
+hand-tuned: the flash-attention forward's ``block_q × block_k`` tiles
+(``dl/pallas_attention.py`` ships 256/auto), the GBDT histogram's
+``feat_block × block_rows`` tiles (``lightgbm/pallas_hist.py`` ships
+8/2048), and the paged decode attention's ``block_kv × slots_tile``
+(``dl/pallas_paged_attention.py`` ships block_len/1; ISSUE 18). The
+tuner
 
 - enumerates a DETERMINISTIC candidate grid respecting the same VMEM
   budget logic the kernels encode (``_resolve_block_k``'s per-block
@@ -35,6 +37,8 @@ CLI::
     python -m mmlspark_tpu.perf.autotune attention --t 2048 --d 64
     python -m mmlspark_tpu.perf.autotune hist --rows 65536 \
         --features 32 --bins 64
+    python -m mmlspark_tpu.perf.autotune paged --context 4096 \
+        --block-len 128 --heads 8 --d 64
     python -m mmlspark_tpu.perf.autotune list
 
 Module import is stdlib + numpy + obs/sched only (no JAX); the measure
@@ -55,10 +59,11 @@ from .costmodel import perf_root
 
 _LOG = logging.getLogger("mmlspark_tpu.perf")
 
-__all__ = ["registry_path", "attn_key", "hist_key", "kernel_winner",
-           "lookup_stats", "clear", "load", "maybe_load", "save",
-           "attention_candidates", "hist_candidates", "tune_attention",
-           "tune_hist"]
+__all__ = ["registry_path", "attn_key", "hist_key", "paged_key",
+           "kernel_winner", "lookup_stats", "clear", "load",
+           "maybe_load", "save", "attention_candidates",
+           "hist_candidates", "paged_candidates", "tune_attention",
+           "tune_hist", "tune_paged_attention"]
 
 REGISTRY_VERSION = 1
 
@@ -68,6 +73,8 @@ _ATTN_BQ = (128, 256, 512)
 _ATTN_BK = (256, 512, 1024, 2048)
 _HIST_FB = (8, 16)
 _HIST_BR = (512, 1024, 2048, 4096)
+_PAGED_BKV = (128, 256, 512, 1024, 2048)
+_PAGED_ST = (1, 2, 4, 8)
 
 # histogram per-cell VMEM ceiling for candidate filtering: bins block
 # (fb × br i32) + vals block (3 × br f32) + output (fb × 3 × bins f32),
@@ -89,6 +96,14 @@ def attn_key(T: int, D: int, causal: bool = False) -> str:
 
 def hist_key(n: int, F: int, num_bins: int) -> str:
     return f"n{bucket_of(int(n))}-F{int(F)}-B{int(num_bins)}"
+
+
+def paged_key(context: int, D: int, w: int = 1) -> str:
+    """Shape bucket for paged decode attention: resident context
+    (``max_blocks × block_len``) rounded to its power-of-two bucket —
+    one winner serves every table size padding into it — head dim and
+    verify-window width exact (w=1 plain decode, w=k+1 speculative)."""
+    return f"L{bucket_of(int(context))}-D{int(D)}-w{int(w)}"
 
 
 # ------------------------------------------------- in-process winner table
@@ -205,6 +220,34 @@ def attention_candidates(T: int, D: int, *, causal: bool = False,
     return out
 
 
+def paged_candidates(context: int, block_len: int, heads: int,
+                     head_dim: int, *, w: int = 1,
+                     itemsize: int = 4) -> list[dict]:
+    """The ``block_kv × slots_tile`` grid for one paged-decode shape.
+    ``block_kv`` is the score-chunk width inside one pool block — the
+    same per-chunk K-byte budget and hard 2048 cap as
+    ``_resolve_block_k`` apply, and a chunk never exceeds ``block_len``
+    (the kernel streams whole pool blocks; chunking past one is
+    meaningless). ``slots_tile`` packs slots per parallel grid row —
+    pure launch geometry, results invariant. The kernel's own default
+    (whole block, one slot) is always candidate 0, so an untuned-equal
+    winner is representable."""
+    bl = max(int(block_len), 1)
+    bkv_cap = min(_attn_bk_budget(head_dim, itemsize), 2048)
+    seen, out = set(), []
+    for bkv in (bl,) + _PAGED_BKV:
+        if bkv > bkv_cap and bkv != bl:
+            continue
+        bkv_eff = max(min(bkv, bl), 1)
+        for st in _PAGED_ST:
+            cfg = (bkv_eff, st)
+            if cfg in seen:
+                continue
+            seen.add(cfg)
+            out.append({"block_kv": bkv_eff, "slots_tile": st})
+    return out
+
+
 def hist_candidates(n: int, F: int, num_bins: int) -> list[dict]:
     """The ``feat_block × block_rows`` grid for one histogram shape,
     filtered by the per-cell VMEM ceiling and capped at one row block
@@ -268,6 +311,45 @@ def measure_attention(config: dict, *, T: int, D: int,
             q, k, v, key_mask=mask, block_q=int(config["block_q"]),
             block_k=int(config["block_k"]), causal=causal,
             interpret=interpret, bwd_impl="blockwise")
+        jax.block_until_ready(out)
+
+    return _time_best(run, reps)
+
+
+def measure_paged_attention(config: dict, *, context: int,
+                            block_len: int, heads: int, head_dim: int,
+                            w: int = 1, slots: int = 4, reps: int = 3,
+                            seed: int = 0,
+                            interpret: bool | None = None) -> float:
+    """Real wall-clock ms for one (block_kv, slots_tile) config:
+    ``slots`` full chains of ``context // block_len`` pool blocks,
+    deterministic inputs (seeded). Raises on compile failure — the
+    search discards such configs."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from ..dl.pallas_paged_attention import paged_window_attention
+
+    mb = max(int(context) // max(int(block_len), 1), 1)
+    nb = slots * mb + 1  # + the TRASH_BLOCK scratch row
+    rng = np.random.default_rng(seed)
+    q = jnp.asarray(rng.normal(size=(slots, heads, w, head_dim)),
+                    jnp.float32)
+    kp = jnp.asarray(rng.normal(
+        size=(nb, block_len, heads, head_dim)), jnp.float32)
+    vp = jnp.asarray(rng.normal(
+        size=(nb, block_len, heads, head_dim)), jnp.float32)
+    rows = jnp.asarray(
+        1 + np.arange(slots * mb).reshape(slots, mb), jnp.int32)
+    pos = jnp.full((slots,), mb * int(block_len) - w, jnp.int32)
+    impl = "pallas" if interpret else None
+
+    def run():
+        out = paged_window_attention(
+            q, kp, vp, rows, pos, block_kv=int(config["block_kv"]),
+            slots_tile=int(config["slots_tile"]), impl=impl,
+            interpret=interpret)
         jax.block_until_ready(out)
 
     return _time_best(run, reps)
@@ -373,6 +455,24 @@ def tune_attention(T: int, D: int, *, causal: bool = False,
                    persist=persist, path=path)
 
 
+def tune_paged_attention(context: int, block_len: int, heads: int,
+                         head_dim: int, *, w: int = 1, slots: int = 4,
+                         reps: int = 3, seed: int = 0,
+                         platform: str | None = None, measure=None,
+                         interpret: bool | None = None,
+                         persist: bool = True, path: str | None = None,
+                         registry=None) -> dict:
+    platform = platform or current_platform()
+    cands = paged_candidates(context, block_len, heads, head_dim, w=w)
+    meas = measure or (lambda cfg: measure_paged_attention(
+        cfg, context=context, block_len=block_len, heads=heads,
+        head_dim=head_dim, w=w, slots=slots, reps=reps, seed=seed,
+        interpret=interpret))
+    return _search("paged_attn", paged_key(context, head_dim, w),
+                   cands, meas, platform=platform, registry=registry,
+                   persist=persist, path=path)
+
+
 def tune_hist(n: int, F: int, num_bins: int, *, reps: int = 3,
               seed: int = 0, platform: str | None = None,
               measure=None, interpret: bool | None = None,
@@ -408,7 +508,17 @@ def _cli(argv=None) -> int:
     hi.add_argument("--rows", type=int, required=True)
     hi.add_argument("--features", type=int, required=True)
     hi.add_argument("--bins", type=int, required=True)
-    for p in (at, hi):
+    pg = sub.add_parser("paged",
+                        help="tune paged-decode-attention tiles")
+    pg.add_argument("--context", type=int, required=True)
+    pg.add_argument("--block-len", type=int, required=True)
+    pg.add_argument("--heads", type=int, required=True)
+    pg.add_argument("--d", type=int, required=True)
+    pg.add_argument("--w", type=int, default=1,
+                    help="query window width (1 = plain decode, "
+                         "k+1 = speculative verify)")
+    pg.add_argument("--slots", type=int, default=4)
+    for p in (at, hi, pg):
         p.add_argument("--reps", type=int, default=3)
         p.add_argument("--seed", type=int, default=0)
         p.add_argument("--path", default=None,
@@ -441,6 +551,12 @@ def _cli(argv=None) -> int:
                              batch=args.batch, heads=args.heads,
                              reps=args.reps, seed=args.seed,
                              interpret=interp, path=path)
+    elif args.cmd == "paged":
+        rec = tune_paged_attention(args.context, args.block_len,
+                                   args.heads, args.d, w=args.w,
+                                   slots=args.slots, reps=args.reps,
+                                   seed=args.seed, interpret=interp,
+                                   path=path)
     else:
         rec = tune_hist(args.rows, args.features, args.bins,
                         reps=args.reps, seed=args.seed,

@@ -68,19 +68,22 @@ DEFAULT_PERF_ROOT = "/tmp/mmlspark_tpu_perf-" + str(
 
 #: the model's feature vector (after the intercept); per-key training
 #: means fill features the caller cannot supply at estimate time. The
-#: last two are generation-only (v4 rows from the LLM serving engine)
-#: — absent on every other row, where they train as 0 and the fitted
-#: weight prices exactly the decode-vs-prefill split for services that
-#: record them.
+#: last three are generation-only (v4/v5 rows from the LLM serving
+#: engine) — absent on every other row, where they train as 0 and the
+#: fitted weights price exactly the decode-vs-prefill split (and, via
+#: ``context_blocks``, decode cost by resident context — the chain
+#: length the paged-attention kernel streams per step) for services
+#: that record them.
 FEATURES = ("bucket", "batch", "entity_kb", "queue_depth",
-            "decode_steps", "prefill_tokens")
+            "decode_steps", "prefill_tokens", "context_blocks")
 
 #: Row schemas this model can consume. v3 (the fleet PR) added only the
-#: ``process`` rank stamp and v4 only the OPTIONAL generation fields
-#: (``decode_steps``/``prefill_tokens`` default to 0 when absent) — no
-#: existing feature column changed meaning — so v2/v3 logs remain fully
-#: usable; anything else is skipped loudly in :meth:`fit`.
-ACCEPTED_SCHEMA_VERSIONS = frozenset({FEATURE_SCHEMA_VERSION, 3, 2})
+#: ``process`` rank stamp, v4 only the OPTIONAL generation fields
+#: (``decode_steps``/``prefill_tokens`` default to 0 when absent), and
+#: v5 only the OPTIONAL ``context_blocks`` (same default) — no existing
+#: feature column changed meaning — so v2–v4 logs remain fully usable;
+#: anything else is skipped loudly in :meth:`fit`.
+ACCEPTED_SCHEMA_VERSIONS = frozenset({FEATURE_SCHEMA_VERSION, 4, 3, 2})
 
 MODEL_VERSION = 1
 
@@ -103,10 +106,10 @@ def enabled() -> bool:
 
 def _row_features(row: dict) -> list[float] | None:
     """FeatureLog row → [1, bucket, batch, entity_kb, queue_depth,
-    decode_steps, prefill_tokens], or None when the row cannot price a
-    batch (no batch / no target). The generation fields are v4-only and
-    OPTIONAL — absent (v2/v3 rows, non-generation services) they train
-    as 0, so old logs keep fitting unchanged."""
+    decode_steps, prefill_tokens, context_blocks], or None when the row
+    cannot price a batch (no batch / no target). The generation fields
+    are v4+/v5-only and OPTIONAL — absent (older rows, non-generation
+    services) they train as 0, so old logs keep fitting unchanged."""
     try:
         batch = float(row.get("batch") or 0)
         if batch <= 0:
@@ -116,8 +119,9 @@ def _row_features(row: dict) -> list[float] | None:
         depth = float(row.get("queue_depth") or 0.0)
         decode_steps = float(row.get("decode_steps") or 0.0)
         prefill_tokens = float(row.get("prefill_tokens") or 0.0)
+        context_blocks = float(row.get("context_blocks") or 0.0)
         return [1.0, bucket, batch, ekb, depth, decode_steps,
-                prefill_tokens]
+                prefill_tokens, context_blocks]
     except (TypeError, ValueError):
         return None
 
@@ -289,14 +293,16 @@ class CostModel:
                          queue_depth: float | None = None,
                          decode_steps: float | None = None,
                          prefill_tokens: float | None = None,
+                         context_blocks: float | None = None,
                          count: bool = True) -> float | None:
         """Predicted ``execute_ms`` for a batch, or ``None`` when the
         model is cold for this service or its recent error exceeds the
         gate — the caller MUST fall back to its EWMA then. ``count=False``
         suppresses the fallback counters (error bookkeeping reads).
         ``decode_steps``/``prefill_tokens`` price a generation request's
-        two phases separately (services whose rows record them);
-        omitted, the service's training mean fills in."""
+        two phases separately and ``context_blocks`` its resident
+        KV-chain length (services whose rows record them); omitted, the
+        service's training mean fills in."""
         batch = int(batch)
         if batch <= 0:
             return None
@@ -313,12 +319,16 @@ class CostModel:
             mean[4] if queue_depth is None else float(queue_depth),
         ]
         # a model persisted before the v4 generation features has a
-        # 5-dim theta; only append what it was trained with
+        # 5-dim theta (and a pre-v5 one a 7-dim); only append what it
+        # was trained with
         if len(m["theta"]) > 5:
             feats.append(mean[5] if decode_steps is None
                          else float(decode_steps))
             feats.append(mean[6] if prefill_tokens is None
                          else float(prefill_tokens))
+        if len(m["theta"]) > 7:
+            feats.append(mean[7] if context_blocks is None
+                         else float(context_blocks))
         x = np.asarray(feats, np.float64)
         ms = float(x @ m["theta"])
         # a linear extrapolation can dip negative off the training

@@ -18,15 +18,21 @@ over the paged KV pool (``dl.paged_kv``):
   prefix-reused blocks — a warm prompt skips exactly the prefill the
   cache already holds, which is the TTFT win the bench measures.
 - :class:`DecodeExecutor` runs the fixed-shape continuous-batching step
-  over block tables: every step gathers each slot's chain into a dense
-  per-slot cache view, applies the SAME ``decode_step``/``decode_window``
-  numerics ``dl.generate`` is equivalence-tested against, and scatters
-  only the newly written positions back — greedy output is
-  token-identical to ``dl.generate`` (pinned by test). With a draft
-  model, ``dl.speculative``'s draft/verify window runs PER SLOT: each
-  slot accepts its own longest agreeing prefix (no batch sync-on-min —
+  over block tables. Attention reads the pools IN PLACE through the
+  block table (``dl.pallas_paged_attention`` — the Pallas kernel on
+  TPU, its bit-exact lax reference on CPU): each step embeds the
+  slots' tokens, scatters the new kv through the table, and attends
+  each slot's own chain with no dense gather — the
+  ``gather_dense``-per-step round trip of the first cut is gone
+  (``MMLSPARK_TPU_PAGED_ATTN=0`` brings it back, loudly:
+  ``kv_dense_gather_bytes_total`` counts every re-gathered byte and
+  reads 0 on the paged path). Greedy output stays token-identical to
+  ``dl.generate`` (pinned by test). With a draft model,
+  ``dl.speculative``'s draft/verify window runs PER SLOT: each slot
+  accepts its own longest agreeing prefix (no batch sync-on-min —
   block chains advance independently), so accepted bursts move a slot
-  by up to k+1 tokens per step.
+  by up to k+1 tokens per step; the verify window is the kernel's
+  windowed variant (k+1 query rows per slot).
 - Handoff rides :class:`HandoffQueue`: the prefill side exports the
   sequence from the block table (:meth:`PagedKVManager.export_seq` —
   ownership moves with the payload), the decode side adopts it when it
@@ -41,14 +47,21 @@ Every device program is built through ``compile_tracker.jit`` with a
 stable name and carries an AOT fingerprint (``core.aot.fingerprints``
 over the program's static shape key), so a warmed worker serves both
 phases with zero runtime compiles (``mark_steady`` + the CompileTracker
-steady-state assertion is the acceptance test).
+steady-state assertion is the acceptance test). On TPU-class backends
+the pools are DONATED to every program (``donate_argnums``): each step
+writes its kv into the buffers it read from, so steady-state decode
+allocates nothing per step (donation is skipped off-TPU, where XLA
+ignores it with a warning).
 
 Obs: ``gen_ttft_seconds{reuse=cold|warm}``, ``gen_tokens_total``,
-``gen_spec_accept_ratio``, ``gen_decode_steps_total`` here, the
-``kv_*`` families in ``dl.paged_kv`` — all federated fleet-wide and
-recorded by the telemetry history plane. Completions land FeatureLog
-rows with ``decode_steps``/``prefill_tokens`` so the cost model prices
-the two phases separately (``perf.costmodel``).
+``gen_spec_accept_ratio``, ``gen_decode_steps_total``,
+``gen_decode_attn_seconds{phase}`` and the dense-fallback odometer
+``kv_dense_gather_bytes_total`` here, the ``kv_*`` families in
+``dl.paged_kv`` — all federated fleet-wide and recorded by the
+telemetry history plane. Completions land FeatureLog rows with
+``decode_steps``/``prefill_tokens``/``context_blocks`` so the cost
+model prices the two phases separately and decode by resident context
+(``perf.costmodel``, schema v5).
 """
 
 from __future__ import annotations
@@ -61,7 +74,8 @@ import numpy as np
 
 from ..core import aot
 from ..dl.paged_kv import (OutOfBlocks, PagedKVManager, gather_dense,
-                           init_pools, scatter_positions, take_positions)
+                           init_pools, paged_attention_enabled,
+                           scatter_positions, take_positions)
 from ..obs import registry as _default_registry
 from ..obs.profile import compile_tracker, feature_log
 from ..sched.continuous import SlotScheduler
@@ -90,6 +104,80 @@ def _encoder_key(module) -> dict:
     return {"vocab": enc.vocab, "width": enc.width, "depth": enc.depth,
             "heads": enc.heads, "mlp_dim": enc.mlp_dim,
             "dtype": np.dtype(enc.dtype).name}
+
+
+def _donate_pools_kwargs() -> dict:
+    """``donate_argnums`` for the pool arguments (positions 2/3 of
+    every executor program) on backends where donation is real — each
+    step then writes its kv into the buffers it read from, so warmed
+    decode allocates nothing per step. Off-TPU XLA ignores donation
+    with a warning per program, so skip it there."""
+    try:
+        from ..utils.platform import target_platform
+        if target_platform() in ("tpu", "axon"):
+            return {"donate_argnums": (2, 3)}
+    except Exception:  # pragma: no cover - platform probe best-effort
+        pass
+    return {}
+
+
+def _dense_gather_bytes(module, n_rows: int, max_blocks: int,
+                        block_len: int) -> int:
+    """Bytes ONE ``gather_dense`` over ``n_rows`` chains materializes
+    for ``module``'s pools — what the ``MMLSPARK_TPU_PAGED_ATTN=0``
+    fallback moves per call and the paged path doesn't."""
+    enc = module.encoder
+    hd = enc.width // enc.heads
+    return int(2 * enc.depth * n_rows * max_blocks * block_len
+               * enc.heads * hd * np.dtype(enc.dtype).itemsize)
+
+
+def _paged_window_walk(mod, toks, pools, rows, pos, valid):
+    """The paged decode forward: [S, w] token ids at per-slot global
+    positions ``[pos[s], pos[s]+w)`` → ([S, w, V] logits, updated
+    pools), reading/writing the pools IN PLACE through the block table.
+
+    Per block: project qkv, scatter the window's kv through the table
+    (write-then-attend, the order ``decode_step``/``decode_window``
+    keep; ``valid`` False redirects a row's writes to the trash block),
+    then ``dl.paged_window_attention`` over each slot's own chain — no
+    dense gather anywhere. The embed/projection/attention/ffn math is
+    element-for-element the ``embed_window → decode_window_blocks →
+    lm_head`` composition (the lax attention path shares
+    ``decode_window``'s exact formulation), so greedy tokens stay
+    byte-identical to ``dl.generate`` on CPU tier-1.
+
+    Runs under ``module.apply(..., method=_paged_window_walk)`` —
+    ``mod`` is the bound ``MaskedLMModel``."""
+    import jax.numpy as jnp
+
+    from ..dl.pallas_paged_attention import paged_window_attention
+
+    enc = mod.encoder
+    w = toks.shape[1]
+    # batched embed_window: same constants/ops per element, positions
+    # per slot instead of one traced scalar
+    x = enc.embed_layer(toks)                           # [S, w, W]
+    dim = jnp.arange(enc.width // 2)[None, None, :]
+    p = (pos[:, None] + jnp.arange(w)[None, :]
+         ).astype(jnp.float32)[:, :, None]
+    ang = p / (10000.0 ** (2 * dim / enc.width))
+    pe = jnp.concatenate([jnp.sin(ang), jnp.cos(ang)], axis=-1)
+    x = x + pe.astype(enc.dtype)
+    wrote = pos[:, None] + jnp.arange(w)[None]          # [S, w]
+    new_pools = []
+    for blk, (kp, vp) in zip(enc.blocks, pools):
+        q, k, v = blk._project_qkv(x)                   # [S, H, w, hd]
+        (kp, vp), = scatter_positions(
+            ((kp, vp),), rows, wrote,
+            ((k.transpose(0, 2, 1, 3).astype(kp.dtype),
+              v.transpose(0, 2, 1, 3).astype(vp.dtype)),),
+            valid=valid)
+        o = paged_window_attention(q, kp, vp, rows, pos)
+        x = blk.ffn(x + blk._merge_out(o))
+        new_pools.append((kp, vp))
+    x = enc.final_ln(x)
+    return mod.lm_head(x), tuple(new_pools)
 
 
 # ----------------------------------------------------------------- handoff
@@ -144,19 +232,23 @@ class _PoolState:
 class PrefillExecutor:
     """Fills KV blocks for admitted prompts in padding-bucketed batches.
 
-    One compiled program per window bucket ``w``: gather each row's
-    chain into a dense cache view, run a vmapped ``decode_window`` over
-    the prompt SUFFIX (everything past the prefix-reused blocks) at
-    per-row start positions, scatter the newly written positions back
-    into the pools, and emit each row's first generated token (the
-    logits at its last prompt position — TTFT is measured here).
-    With a draft model the same window also fills the DRAFT pools, so
-    prefix-reused blocks hold both models' kv consistently."""
+    One compiled program per window bucket ``w``: run the paged window
+    walk (:func:`_paged_window_walk`) over the prompt SUFFIX
+    (everything past the prefix-reused blocks) at per-row start
+    positions — SCATTER-ONLY: each block's kv writes through the table
+    as it is computed and attention reads the pools in place, no
+    ``gather_dense``/``take_positions`` round trip — and emit each
+    row's first generated token (the logits at its last prompt
+    position — TTFT is measured here). With a draft model the same
+    window also fills the DRAFT pools, so prefix-reused blocks hold
+    both models' kv consistently. ``MMLSPARK_TPU_PAGED_ATTN=0`` keeps
+    the old gather→vmapped-``decode_window``→scatter program callable
+    (every gathered byte counted ``kv_dense_gather_bytes_total``)."""
 
     def __init__(self, module, variables, kv: PagedKVManager,
                  pools: _PoolState, *, draft_module=None,
                  draft_variables=None, max_blocks: int, batch: int = 4,
-                 pad_id: int = 0, service: str = "llm"):
+                 pad_id: int = 0, service: str = "llm", registry=None):
         self.module = module
         self.variables = variables
         self.draft_module = draft_module
@@ -167,6 +259,22 @@ class PrefillExecutor:
         self.batch = max(int(batch), 1)
         self.pad_id = int(pad_id)
         self.service = service
+        self.paged = paged_attention_enabled()
+        reg = registry if registry is not None else _default_registry
+        self._h_attn = reg.histogram(
+            "gen_decode_attn_seconds",
+            "attention-program wall time, by service and phase",
+            buckets=(.0005, .001, .0025, .005, .01, .025, .05, .1,
+                     .25, .5, 1., 2.5))
+        self._c_gather = reg.counter(
+            "kv_dense_gather_bytes_total",
+            "bytes materialized by gather_dense in the dense-attention "
+            "fallback (0 on the paged-kernel path), by service/phase")
+        self._gather_bytes = _dense_gather_bytes(
+            module, self.batch, self.max_blocks, kv.block_len)
+        if draft_module is not None:
+            self._gather_bytes += _dense_gather_bytes(
+                draft_module, self.batch, self.max_blocks, kv.block_len)
         self._programs: dict[int, object] = {}
         self._fps: dict[str, tuple[str, str]] = {}
 
@@ -180,47 +288,70 @@ class PrefillExecutor:
         module, draft = self.module, self.draft_module
         pad_id, P = self.pad_id, self.batch
 
-        def run(params, dparams, pools_t, pools_d, rows, toks, pos,
-                lens):
-            dense_t = gather_dense(pools_t, rows)
+        if self.paged:
+            def run(params, dparams, pools_t, pools_d, rows, toks, pos,
+                    lens):
+                valid = (jnp.arange(w)[None] < lens[:, None]) & \
+                    (lens[:, None] > 0)
+                logits, pools_t = module.apply(
+                    {"params": params}, toks, pools_t, rows, pos,
+                    valid, method=_paged_window_walk)   # [P, w, V]
+                if draft is not None:
+                    _, pools_d = draft.apply(
+                        {"params": dparams}, toks, pools_d, rows, pos,
+                        valid, method=_paged_window_walk)
+                logits = logits.at[:, :, pad_id].set(-jnp.inf)
+                last = jnp.clip(lens - 1, 0, w - 1)
+                row_logits = jnp.take_along_axis(
+                    logits,
+                    last[:, None, None].repeat(logits.shape[-1], 2),
+                    axis=1)[:, 0]                       # [P, V]
+                first = jnp.argmax(row_logits, -1).astype(jnp.int32)
+                return pools_t, pools_d, first
+        else:
+            def run(params, dparams, pools_t, pools_d, rows, toks, pos,
+                    lens):
+                dense_t = gather_dense(pools_t, rows)
 
-            def one(mod, prm, tk, cache, p):
-                c = jax.tree.map(lambda a: a[None], cache)
-                logits, c = mod.apply({"params": prm}, tk[None], c, p,
-                                      method="decode_window")
-                return logits[0], jax.tree.map(lambda a: a[0], c)
+                def one(mod, prm, tk, cache, p):
+                    c = jax.tree.map(lambda a: a[None], cache)
+                    logits, c = mod.apply({"params": prm}, tk[None], c,
+                                          p, method="decode_window")
+                    return logits[0], jax.tree.map(lambda a: a[0], c)
 
-            logits, dense_t = jax.vmap(
-                lambda tk, c, p: one(module, params, tk, c, p)
-            )(toks, dense_t, pos)                       # [P, w, V]
-            wrote = pos[:, None] + jnp.arange(w)[None]  # [P, w]
-            valid = (jnp.arange(w)[None] < lens[:, None]) & \
-                (lens[:, None] > 0)
-            new_kv = take_positions(dense_t, wrote)
-            pools_t = scatter_positions(pools_t, rows, wrote, new_kv,
-                                        valid=valid)
-            if draft is not None:
-                dense_d = gather_dense(pools_d, rows)
-                _, dense_d = jax.vmap(
-                    lambda tk, c, p: one(draft, dparams, tk, c, p)
-                )(toks, dense_d, pos)
-                pools_d = scatter_positions(
-                    pools_d, rows, wrote, take_positions(dense_d, wrote),
-                    valid=valid)
-            logits = logits.at[:, :, pad_id].set(-jnp.inf)
-            last = jnp.clip(lens - 1, 0, w - 1)
-            row_logits = jnp.take_along_axis(
-                logits, last[:, None, None].repeat(logits.shape[-1], 2),
-                axis=1)[:, 0]                           # [P, V]
-            first = jnp.argmax(row_logits, axis=-1).astype(jnp.int32)
-            return pools_t, pools_d, first
+                logits, dense_t = jax.vmap(
+                    lambda tk, c, p: one(module, params, tk, c, p)
+                )(toks, dense_t, pos)                   # [P, w, V]
+                wrote = pos[:, None] + jnp.arange(w)[None]  # [P, w]
+                valid = (jnp.arange(w)[None] < lens[:, None]) & \
+                    (lens[:, None] > 0)
+                new_kv = take_positions(dense_t, wrote)
+                pools_t = scatter_positions(pools_t, rows, wrote,
+                                            new_kv, valid=valid)
+                if draft is not None:
+                    dense_d = gather_dense(pools_d, rows)
+                    _, dense_d = jax.vmap(
+                        lambda tk, c, p: one(draft, dparams, tk, c, p)
+                    )(toks, dense_d, pos)
+                    pools_d = scatter_positions(
+                        pools_d, rows, wrote,
+                        take_positions(dense_d, wrote), valid=valid)
+                logits = logits.at[:, :, pad_id].set(-jnp.inf)
+                last = jnp.clip(lens - 1, 0, w - 1)
+                row_logits = jnp.take_along_axis(
+                    logits,
+                    last[:, None, None].repeat(logits.shape[-1], 2),
+                    axis=1)[:, 0]                       # [P, V]
+                first = jnp.argmax(row_logits, -1).astype(jnp.int32)
+                return pools_t, pools_d, first
 
         name = f"llm_prefill_{self.service}_w{w}_b{P}"
         prog = compile_tracker.jit(run, name=name,
-                                   static_argnames=())
+                                   **_donate_pools_kwargs())
         self._programs[w] = prog
         key = {"phase": "prefill", "service": self.service,
                "window": w, "batch": P,
+               "attn": "paged" if self.paged else "dense",
                "max_blocks": self.max_blocks,
                "block_len": self.kv.block_len,
                "encoder": _encoder_key(self.module),
@@ -266,6 +397,7 @@ class PrefillExecutor:
             rows = self.kv.block_rows(
                 ids + [None] * (P - len(ids)), self.max_blocks)
             prog = self._program(w)
+            t0 = time.perf_counter()
             pools_t, pools_d, first = prog(
                 self.variables["params"],
                 None if self.draft_module is None
@@ -273,6 +405,12 @@ class PrefillExecutor:
                 self.pools.target, self.pools.draft,
                 jnp.asarray(rows), jnp.asarray(toks),
                 jnp.asarray(pos), jnp.asarray(lens))
+            self._h_attn.observe(time.perf_counter() - t0,
+                                 service=self.service, phase="prefill")
+            if not self.paged:
+                self._c_gather.inc(self._gather_bytes,
+                                   service=self.service,
+                                   phase="prefill")
             self.pools.target = pools_t
             if self.draft_module is not None:
                 self.pools.draft = pools_d
@@ -312,18 +450,23 @@ class DecodeExecutor:
     vectors, ``[slots, max_blocks]`` block tables — so ONE program per
     mode serves every step (the zero-runtime-compile contract).
 
-    Plain mode: one vmapped ``decode_step`` per slot (per-slot traced
-    positions), greedy ``argmax`` with pad masked — the numerics of
-    ``dl.generate``'s cached path. Spec mode (draft present): the
-    draft/verify window of ``dl.speculative`` vmapped PER SLOT, each
-    slot accepting its own longest agreeing prefix — no batch
-    sync-on-min, block chains advance independently."""
+    Plain mode: ONE paged window walk of width 1 — embed the slots'
+    last tokens, scatter kv through the table, paged attention over
+    each chain in place, greedy ``argmax`` with pad masked — the
+    numerics of ``dl.generate``'s cached path with zero dense
+    gathers. Spec mode (draft present): ``dl.speculative``'s
+    draft/verify runs as k width-1 draft walks plus one width-(k+1)
+    target walk (the kernel's windowed variant); each slot accepts its
+    own longest agreeing prefix — no batch sync-on-min, block chains
+    advance independently. ``MMLSPARK_TPU_PAGED_ATTN=0`` keeps the
+    old gather→vmapped-``decode_step``→scatter program callable
+    (``kv_dense_gather_bytes_total`` counts what it moves)."""
 
     def __init__(self, module, variables, kv: PagedKVManager,
                  pools: _PoolState, *, draft_module=None,
                  draft_variables=None, slots: int, max_blocks: int,
                  spec_k: int = 0, pad_id: int = 0,
-                 service: str = "llm"):
+                 service: str = "llm", registry=None):
         if spec_k and draft_module is None:
             raise ValueError("spec_k > 0 needs a draft model")
         self.module = module
@@ -337,6 +480,23 @@ class DecodeExecutor:
         self.spec_k = int(spec_k)
         self.pad_id = int(pad_id)
         self.service = service
+        self.paged = paged_attention_enabled()
+        reg = registry if registry is not None else _default_registry
+        self._h_attn = reg.histogram(
+            "gen_decode_attn_seconds",
+            "attention-program wall time, by service and phase",
+            buckets=(.0005, .001, .0025, .005, .01, .025, .05, .1,
+                     .25, .5, 1., 2.5))
+        self._c_gather = reg.counter(
+            "kv_dense_gather_bytes_total",
+            "bytes materialized by gather_dense in the dense-attention "
+            "fallback (0 on the paged-kernel path), by service/phase")
+        self._gather_bytes = _dense_gather_bytes(
+            module, int(slots), int(max_blocks), kv.block_len)
+        if draft_module is not None:
+            self._gather_bytes += _dense_gather_bytes(
+                draft_module, int(slots), int(max_blocks),
+                kv.block_len)
         # host-side slot state (the engine owns seq metadata)
         self.seq_ids: list = [None] * self.slots
         self.ptr = np.ones(self.slots, np.int32)   # committed tokens
@@ -390,7 +550,66 @@ class DecodeExecutor:
         def strip(c):
             return jax.tree.map(lambda a: a[0], c)
 
-        if k == 0:
+        if self.paged and k == 0:
+            def run(params, dparams, pools_t, pools_d, rows, last, ptr,
+                    end, active):
+                logits, pools_t = module.apply(
+                    {"params": params}, last[:, None], pools_t, rows,
+                    ptr - 1, active[:, None],
+                    method=_paged_window_walk)          # [S, 1, V]
+                logits = logits[:, 0].at[:, pad_id].set(-jnp.inf)
+                nxt = jnp.argmax(logits, axis=-1).astype(jnp.int32)
+                committed = nxt[:, None]                # [S, 1]
+                n_new = jnp.where(active, 1, 0)
+                return pools_t, pools_d, committed, n_new, n_new
+        elif self.paged:
+            def run(params, dparams, pools_t, pools_d, rows, last, ptr,
+                    end, active):
+                pos = ptr - 1
+                av = active[:, None]
+                tok = last[:, None]                     # [S, 1]
+                drafts = []
+                for j in range(k):
+                    ld, pools_d = draft.apply(
+                        {"params": dparams}, tok, pools_d, rows,
+                        pos + j, av, method=_paged_window_walk)
+                    ld = ld[:, 0].at[:, pad_id].set(-jnp.inf)
+                    tok = jnp.argmax(ld, -1).astype(jnp.int32)[:, None]
+                    drafts.append(tok[:, 0])
+                # extra cache-fill step: d_k's kv, or the next round's
+                # draft attends a zero hole after a full accept (same
+                # fix as dl.speculative)
+                _, pools_d = draft.apply(
+                    {"params": dparams}, tok, pools_d, rows, pos + k,
+                    av, method=_paged_window_walk)
+                d = jnp.stack(drafts, 1)                # [S, k]
+                window = jnp.concatenate([last[:, None], d], 1)
+                lt, pools_t = module.apply(
+                    {"params": params}, window, pools_t, rows, pos,
+                    av & jnp.ones((S, k + 1), bool),
+                    method=_paged_window_walk)          # [S, k+1, V]
+                lt = lt.at[:, :, pad_id].set(-jnp.inf)
+                t = jnp.argmax(lt, -1).astype(jnp.int32)
+                agree = jnp.cumprod(
+                    (d == t[:, :k]).astype(jnp.int32), axis=1)
+                n_acc = agree.sum(axis=1)               # PER-SLOT
+                bonus = jnp.take_along_axis(
+                    t, n_acc[:, None], axis=1)[:, 0]
+                ar = jnp.arange(k + 1)[None]            # [1, k+1]
+                d_ext = jnp.concatenate(
+                    [d, jnp.zeros((S, 1), jnp.int32)], 1)
+                committed = jnp.where(
+                    ar < n_acc[:, None], d_ext,
+                    jnp.where(ar == n_acc[:, None], bonus[:, None],
+                              pad_id))                  # [S, k+1]
+                # never commit past the slot's budget (end - ptr
+                # tokens remain; runnable slots have at least 1)
+                n_new = jnp.clip(n_acc + 1, 1,
+                                 jnp.maximum(end - ptr, 1))
+                n_new = jnp.where(active, n_new, 0)
+                return pools_t, pools_d, committed, n_new, \
+                    jnp.where(active, n_acc, 0)
+        elif k == 0:
             def run(params, dparams, pools_t, pools_d, rows, last, ptr,
                     end, active):
                 dense = gather_dense(pools_t, rows)
@@ -472,10 +691,13 @@ class DecodeExecutor:
                 return pools_t, pools_d, committed, n_new, \
                     jnp.where(active, n_acc, 0)
 
-        name = f"llm_decode_{self.service}_S{S}_k{k}"
-        self._program = compile_tracker.jit(run, name=name)
+        attn = "paged" if self.paged else "dense"
+        name = f"llm_decode_{attn}_{self.service}_S{S}_k{k}"
+        self._program = compile_tracker.jit(run, name=name,
+                                            **_donate_pools_kwargs())
         key = {"phase": "decode", "service": self.service, "slots": S,
-               "spec_k": k, "max_blocks": self.max_blocks,
+               "spec_k": k, "attn": attn,
+               "max_blocks": self.max_blocks,
                "block_len": self.kv.block_len,
                "encoder": _encoder_key(self.module),
                "draft": None if draft is None else _encoder_key(draft),
@@ -511,6 +733,7 @@ class DecodeExecutor:
             [sid if runnable[i] else None
              for i, sid in enumerate(self.seq_ids)], self.max_blocks)
         prog = self._build()
+        t0 = time.perf_counter()
         pools_t, pools_d, committed, n_new, n_acc = prog(
             self.variables["params"],
             None if self.draft_module is None
@@ -518,6 +741,13 @@ class DecodeExecutor:
             self.pools.target, self.pools.draft, jnp.asarray(rows),
             jnp.asarray(self.last), jnp.asarray(self.ptr),
             jnp.asarray(self.end), jnp.asarray(runnable))
+        self._h_attn.observe(time.perf_counter() - t0,
+                             service=self.service, phase="decode")
+        if not self.paged:
+            # the fallback's whole cost, made loud: these bytes are
+            # exactly what the paged kernel does not move
+            self._c_gather.inc(self._gather_bytes,
+                               service=self.service, phase="decode")
         self.pools.target = pools_t
         if self.draft_module is not None:
             self.pools.draft = pools_d
@@ -631,12 +861,12 @@ class LLMEngine:
             module, variables, self.kv, self.pools,
             draft_module=draft_module, draft_variables=draft_variables,
             max_blocks=self.max_blocks, batch=prefill_batch,
-            pad_id=pad_id, service=service)
+            pad_id=pad_id, service=service, registry=reg)
         self.decoder = DecodeExecutor(
             module, variables, self.kv, self.pools,
             draft_module=draft_module, draft_variables=draft_variables,
             slots=slots, max_blocks=self.max_blocks, spec_k=spec_k,
-            pad_id=pad_id, service=service)
+            pad_id=pad_id, service=service, registry=reg)
         self.handoff = HandoffQueue()
         self._meta: dict = {}
         self._to_prefill: list = []
@@ -763,6 +993,8 @@ class LLMEngine:
     def _finish(self, seq_id) -> np.ndarray:
         meta = self._meta.pop(seq_id)
         self.kv.release(seq_id)
+        total_len = min(len(meta.prompt) + 1 + len(meta.generated),
+                        len(meta.prompt) + meta.max_new_tokens)
         feature_log.record(
             service=self.service, route="decode",
             batch=self.decoder.slots,
@@ -770,6 +1002,7 @@ class LLMEngine:
             queue_depth=self.sched.pending_count,
             decode_steps=meta.decode_steps,
             prefill_tokens=meta.prefill_tokens,
+            context_blocks=-(-total_len // self.block_len),
             execute_ms=(self.clock() - meta.t_submit) * 1e3)
         # prompt + [prefill's first token] + decode commits, trimmed to
         # the budget (a final speculative burst can overshoot by 0 —

@@ -2141,3 +2141,97 @@ def llm_serving_scenario(*, service: str = "llm-bench", slots: int = 2,
         "steady_state_ok": steady_ok,
         "outputs": {k: [int(t) for t in v] for k, v in outputs.items()},
     }
+
+
+def llm_decode_scenario(*, service: str = "llm-decode-bench",
+                        context_tokens: int = 4096,
+                        block_len: int = 128,
+                        max_new_tokens: int = 32, slots: int = 1,
+                        vocab: int = 64, seed: int = 23,
+                        registry=None) -> dict:
+    """Long-context decode-throughput bench (ISSUE 18 acceptance):
+    steady-state tokens/sec of the decode executor at ``context_tokens``
+    of resident KV — the regime the paged-attention kernel exists for,
+    where the old path re-gathered the whole dense cache every step.
+
+    One sequence fills ``context_tokens - max_new_tokens`` prompt
+    tokens, then the timed window covers ONLY the drained decode steps
+    (the first engine boundary — prefill + first decode step — runs
+    before the clock starts, so prefill cost never pollutes the decode
+    number). Runs inside CompileTracker steady state: a runtime compile
+    mid-decode fails the scenario. The path's identity rides along in
+    the numbers — ``dense_gather_bytes`` is exactly 0 on the paged
+    path and the old path's per-step re-gather total behind
+    ``MMLSPARK_TPU_PAGED_ATTN=0`` — so the side-by-side bank
+    (``bench_llm_decode``) can prove which kernel produced which
+    column."""
+    import jax.numpy as jnp
+    import numpy as np
+
+    from ..dl import MaskedLMModel, TextEncoder
+    from ..dl.paged_kv import paged_attention_enabled
+    from ..dl.text_encoder import make_attention_fn
+    from ..obs.metrics import registry as _default
+    from ..obs.profile import compile_tracker
+    from ..serving.llm import LLMEngine, _bucket_window
+
+    import jax
+
+    reg = registry if registry is not None else _default
+    enc = TextEncoder(vocab=vocab, width=32, depth=1, heads=2,
+                      mlp_dim=64, dtype=jnp.float32,
+                      attention_fn=make_attention_fn("dense",
+                                                     causal=True))
+    module = MaskedLMModel(encoder=enc)
+    variables = module.init(jax.random.PRNGKey(0),
+                            np.zeros((1, 8), np.int32))
+    rng = np.random.default_rng(seed)
+    prompt_len = int(context_tokens) - int(max_new_tokens)
+    prompt = [int(t) for t in rng.integers(2, vocab, size=prompt_len)]
+
+    engine = LLMEngine(module, variables, slots=slots,
+                       block_len=block_len, max_seq_len=context_tokens,
+                       service=service, registry=reg)
+    windows = sorted({_bucket_window(prompt_len), 1})
+    fps = engine.warm(prefill_windows=tuple(windows), mark_steady=True)
+    try:
+        engine.submit("ctx0", prompt, max_new_tokens)
+        engine.step()            # admit + prefill + first decode step
+        snap0 = reg.snapshot()
+
+        def _sum(snapshot, prefix):
+            return sum(v for k, v in snapshot.items()
+                       if k.startswith(prefix)
+                       and f'service="{service}"' in k)
+
+        tok0 = _sum(snap0, "gen_tokens_total")
+        t0 = time.monotonic()
+        outputs = engine.run_until_drained()
+        decode_wall_s = time.monotonic() - t0
+        compile_tracker.assert_steady_state()
+        steady_ok = True
+    finally:
+        compile_tracker.unmark_steady()
+
+    snap = reg.snapshot()
+    decode_tokens = _sum(snap, "gen_tokens_total") - tok0
+    gather_bytes = _sum(snap, "kv_dense_gather_bytes_total")
+    attn_decode_s = sum(
+        v for k, v in snap.items()
+        if k.startswith("gen_decode_attn_seconds_sum")
+        and f'service="{service}"' in k and 'phase="decode"' in k)
+    steps = _sum(snap, "gen_decode_steps_total")
+    return {
+        "context_tokens": int(context_tokens),
+        "context_blocks": -(-int(context_tokens) // int(block_len)),
+        "paged_attention": bool(paged_attention_enabled()),
+        "decode_tokens": int(decode_tokens),
+        "decode_wall_s": decode_wall_s,
+        "tokens_per_s": decode_tokens / max(decode_wall_s, 1e-9),
+        "dense_gather_bytes": int(gather_bytes),
+        "attn_ms_per_step": (attn_decode_s / max(steps, 1)) * 1e3,
+        "decode_steps": int(steps),
+        "aot_fingerprints": len(fps),
+        "steady_state_ok": steady_ok,
+        "outputs": {k: [int(t) for t in v] for k, v in outputs.items()},
+    }

@@ -822,12 +822,12 @@ class TestCostModelSchemaWindow:
         from mmlspark_tpu.perf.costmodel import (
             ACCEPTED_SCHEMA_VERSIONS, CostModel)
 
-        assert FEATURE_SCHEMA_VERSION == 4
-        assert ACCEPTED_SCHEMA_VERSIONS == {2, 3, 4}
+        assert FEATURE_SCHEMA_VERSION == 5
+        assert ACCEPTED_SCHEMA_VERSIONS == {2, 3, 4, 5}
         reg = MetricsRegistry()
         model = CostModel(min_rows=16, registry=reg)
         used = model.fit(self._rows(2, 20) + self._rows(3, 20)
-                         + self._rows(4, 20))
+                         + self._rows(4, 10) + self._rows(5, 10))
         assert used == 60
         assert reg.snapshot().get(
             'sched_costmodel_skipped_rows_total{reason="schema"}') \
@@ -849,7 +849,7 @@ class TestCostModelSchemaWindow:
         log = FeatureLog(maxlen=4, registry=MetricsRegistry())
         log.record(service="s", batch=2)
         row = log.snapshot()[-1]
-        assert row["schema_version"] == 4
+        assert row["schema_version"] == 5
         assert "process" in row          # None single-process, a rank
         assert row["process"] is None    # index string on a pod
 

@@ -217,7 +217,7 @@ class TestSteadyState:
         assert len(got) == 3
         # one decode program + one prefill program per window bucket,
         # each with an AOT fingerprint pair
-        assert set(fps) == {"llm_decode_llmsteady_S2_k0",
+        assert set(fps) == {"llm_decode_paged_llmsteady_S2_k0",
                             "llm_prefill_llmsteady_w1_b2",
                             "llm_prefill_llmsteady_w4_b2",
                             "llm_prefill_llmsteady_w8_b2"}
@@ -242,6 +242,20 @@ class TestScenarioAndLoadgen:
         # warm round prefills a 1-token suffix instead of the whole
         # prompt — the TTFT improvement the cache exists to buy
         assert out["ttft_warm_p50_ms"] <= out["ttft_cold_p50_ms"]
+
+    def test_llm_decode_scenario_smoke(self):
+        from mmlspark_tpu.testing.benchmarks import llm_decode_scenario
+        out = llm_decode_scenario(service="llmdecscen",
+                                  context_tokens=256, block_len=16,
+                                  max_new_tokens=8,
+                                  registry=MetricsRegistry())
+        assert out["context_blocks"] == 16
+        assert out["paged_attention"] is True
+        assert out["tokens_per_s"] > 0
+        # steady paged decode never re-materialises the dense cache
+        assert out["dense_gather_bytes"] == 0
+        assert out["decode_tokens"] > 0
+        assert out["steady_state_ok"]
 
     def test_summarize_ttft_columns(self):
         from mmlspark_tpu.serving.loadgen import summarize

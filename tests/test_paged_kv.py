@@ -194,15 +194,43 @@ class TestLRUEviction:
             'kv_evictions_total{service="kvtest"}'] >= 1.0
 
     def test_set_block_budget_evicts_to_fit(self):
+        # the shrink pays its WHOLE eviction debt immediately: the
+        # budget invariant is strict (used + cached < budget, matching
+        # _take_block's pre-allocation check), so budget=1 with 3
+        # cached evicts all 3 — none left for the next allocation to
+        # reclaim lazily
         m = _mgr(num_blocks=9, block_len=2)
         for sid, p in (("a", [1, 2]), ("b", [3, 4]), ("c", [5, 6])):
             m.allocate(sid, p)
             m.publish(sid)
             m.release(sid)
         assert m.stats()["cached"] == 3
-        assert m.set_block_budget(1) == 2
-        assert m.stats()["cached"] == 1
+        assert m.set_block_budget(1) == 3
+        assert m.stats()["cached"] == 0
         assert m.block_budget == 1
+
+    def test_set_block_budget_shrink_evicts_eagerly_while_lru_warm(self):
+        # regression (ISSUE 18): the old shrink loop stopped at
+        # used + cached == budget, leaving exactly one cached block for
+        # the NEXT allocation to evict lazily. A shrink must be done
+        # evicting the moment it returns: the follow-up allocate takes
+        # a free block with no further eviction and the counter stays
+        # where the shrink left it.
+        reg = MetricsRegistry()
+        m = _mgr(num_blocks=9, block_len=2, registry=reg)
+        for sid, p in (("a", [1, 2]), ("b", [3, 4]), ("c", [5, 6])):
+            m.allocate(sid, p)
+            m.publish(sid)
+            m.release(sid)
+        assert m.stats()["cached"] == 3
+        evicted = m.set_block_budget(2)
+        assert evicted == 2                          # 1 cached survives
+        assert m.stats()["cached"] == 1
+        key = 'kv_evictions_total{service="kvtest"}'
+        assert reg.snapshot()[key] == 2.0
+        m.allocate("d", [7, 8])                      # used=1 + cached=1
+        assert reg.snapshot()[key] == 2.0            # no lazy catch-up
+        assert m.stats()["cached"] == 1
 
 
 class TestBlockTableAndHandoff:

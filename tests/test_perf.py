@@ -503,6 +503,151 @@ class TestAutotune:
         assert "hist|" in proc.stdout
 
 
+class TestPagedAutotune:
+    """The ``paged_attn`` kernel entry (ISSUE 18): block_kv ×
+    slots_tile grid over the paged decode-attention kernel."""
+
+    CTX, BL, HEADS, HD = 4096, 128, 8, 64
+
+    def _fake_measure(self, timings):
+        def measure(cfg):
+            v = timings[(cfg["block_kv"], cfg["slots_tile"])]
+            if isinstance(v, Exception):
+                raise v
+            return v
+        return measure
+
+    def _cands(self):
+        return autotune.paged_candidates(self.CTX, self.BL,
+                                         self.HEADS, self.HD)
+
+    def test_candidates_default_first_unique_and_block_bounded(self):
+        cands = self._cands()
+        # the kernel's untuned default is always representable
+        assert cands[0] == {"block_kv": self.BL, "slots_tile": 1}
+        pairs = [(c["block_kv"], c["slots_tile"]) for c in cands]
+        assert len(pairs) == len(set(pairs))
+        for c in cands:
+            # chunks never exceed one pool block
+            assert 1 <= c["block_kv"] <= self.BL
+
+    def test_deterministic_registry(self, tmp_path):
+        cands = self._cands()
+        timings = {(c["block_kv"], c["slots_tile"]): 4.0 + 0.1 * i
+                   for i, c in enumerate(cands)}
+        paths = []
+        for name in ("a.json", "b.json"):
+            autotune.clear()
+            p = str(tmp_path / name)
+            rec = autotune.tune_paged_attention(
+                self.CTX, self.BL, self.HEADS, self.HD,
+                platform="testpf",
+                measure=self._fake_measure(timings), path=p,
+                registry=_reg())
+            assert rec["winner"] is not None
+            paths.append(p)
+        a, b = (open(p, "rb").read() for p in paths)
+        assert a == b
+        autotune.clear()
+
+    def test_all_invalid_persists_nothing(self, tmp_path):
+        cands = self._cands()
+        timings = {(c["block_kv"], c["slots_tile"]):
+                   RuntimeError("mosaic boom") for c in cands}
+        autotune.clear()
+        p = str(tmp_path / "t.json")
+        rec = autotune.tune_paged_attention(
+            self.CTX, self.BL, self.HEADS, self.HD, platform="testpf",
+            measure=self._fake_measure(timings), path=p,
+            registry=_reg())
+        assert rec["winner"] is None
+        assert not os.path.exists(p)
+        assert autotune.kernel_winner(
+            "paged_attn", autotune.paged_key(self.CTX, self.HD),
+            "testpf") is None
+        autotune.clear()
+
+    def test_roundtrip_lookup_and_bucketing(self, tmp_path):
+        cands = self._cands()
+        best = cands[-1]
+        timings = {(c["block_kv"], c["slots_tile"]): 9.0
+                   for c in cands}
+        timings[(best["block_kv"], best["slots_tile"])] = 1.0
+        autotune.clear()
+        p = str(tmp_path / "t.json")
+        autotune.tune_paged_attention(
+            self.CTX, self.BL, self.HEADS, self.HD, platform="testpf",
+            measure=self._fake_measure(timings), path=p,
+            registry=_reg())
+        autotune.clear()
+        assert autotune.load(p) == 1
+        w = autotune.kernel_winner(
+            "paged_attn", autotune.paged_key(self.CTX, self.HD),
+            "testpf")
+        assert w is not None
+        assert (w["block_kv"], w["slots_tile"]) == \
+            (best["block_kv"], best["slots_tile"])
+        # pow2-bucketed context: 3000 pads into the 4096 bucket
+        assert autotune.paged_key(3000, self.HD) == \
+            autotune.paged_key(self.CTX, self.HD)
+        # the verify window width keys separately (w=k+1 speculative)
+        assert autotune.paged_key(self.CTX, self.HD, w=3) != \
+            autotune.paged_key(self.CTX, self.HD)
+        # other platform → miss
+        assert autotune.kernel_winner(
+            "paged_attn", autotune.paged_key(self.CTX, self.HD),
+            "tpu") is None
+        autotune.clear()
+
+
+class TestCostModelContextBlocks:
+    """Schema v5 (ISSUE 18): ``context_blocks`` joins the feature set;
+    v2–v4 rows stay trainable with the feature read as 0."""
+
+    def _rows(self, n=600, seed=9, per_block_ms=0.05):
+        rows = synth_feature_rows(n, seed=seed)
+        rng = np.random.default_rng(seed + 1)
+        for r in rows:
+            cb = float(rng.integers(0, 64))
+            r["context_blocks"] = cb
+            r["execute_ms"] += per_block_ms * cb
+        return rows
+
+    def test_context_blocks_trains_and_prices(self):
+        m = CostModel(min_rows=32, registry=_reg())
+        rows = self._rows()
+        assert m.fit(rows) == len(rows)
+        theta = next(iter(m._models.values()))["theta"]
+        assert len(theta) == 8
+        hi = m.predict_batch_ms(SVC, 16, route="/feat",
+                                entity_bytes=64 * 1024, queue_depth=4,
+                                context_blocks=64)
+        lo = m.predict_batch_ms(SVC, 16, route="/feat",
+                                entity_bytes=64 * 1024, queue_depth=4,
+                                context_blocks=0)
+        assert hi is not None and lo is not None and hi > lo
+
+    def test_v4_and_older_rows_accepted_feature_reads_zero(self):
+        reg = _reg()
+        m = CostModel(min_rows=8, registry=reg)
+        v4 = [dict(r, schema_version=4)
+              for r in synth_feature_rows(64, seed=5)]
+        v2 = [dict(r, schema_version=2)
+              for r in synth_feature_rows(64, seed=6)]
+        assert m.fit(v4 + v2) == 128
+        assert reg.snapshot().get(
+            'sched_costmodel_skipped_rows_total{reason="schema"}') \
+            is None
+        # absent context_blocks trained as 0 → theta still 8-dim and
+        # the kwarg is accepted at predict time
+        theta = next(iter(m._models.values()))["theta"]
+        assert len(theta) == 8
+        assert m.predict_batch_ms(SVC, 8, route="/feat",
+                                  entity_bytes=32 * 1024,
+                                  queue_depth=2,
+                                  context_blocks=16) is not None
+
+
 class TestKernelsConsultRegistry:
     def test_hist_uses_winner_and_matches_default(self):
         """A registered winner changes the tiles the kernel runs with
