@@ -1446,6 +1446,42 @@ def bench_fleet(extras: dict) -> None:
     extras["fleet_mem_gauges_present"] = bool(r["mem_gauges_present"])
 
 
+def bench_deploy(extras: dict) -> None:
+    """Zero-downtime model-lifecycle acceptance (ISSUE 19). Banks the
+    rollout scenario's contract surface: a blue/green flip across the
+    autoscaled mixed-tenant fleet with zero non-canary 5xx, zero
+    dropped in-flight requests and zero runtime compiles
+    (``rollout_zero_5xx``), the seeded bad canary auto-rolled-back
+    from burn rate alone within a bounded number of controller ticks
+    (``rollback_ticks``) with the gold tier untouched
+    (``canary_gold_sheds``) — plus a same-seed double run asserting
+    the realized fault schedule is identical (the deploy plane's
+    chaos is reproducible, same contract as bench_elasticity)."""
+    from mmlspark_tpu.testing.benchmarks import rollout_scenario
+
+    r = rollout_scenario(seed=29)
+    r2 = rollout_scenario(seed=29, service="rollout-bench2")
+    extras["rollout_zero_5xx"] = bool(
+        r["rollout_zero_5xx"] and r["drained_completed"]
+        and r["zero_runtime_compiles"])
+    extras["rollout_non_canary_5xx"] = int(r["non_canary_5xx"])
+    extras["rollout_unanswered"] = int(r["unanswered"])
+    extras["rollout_byte_identical"] = bool(r["byte_identical"])
+    extras["rollout_draining_final"] = int(r["draining_inflight_final"])
+    extras["rollout_runtime_compiles"] = int(r["runtime_compiles"])
+    extras["rollout_worker_killed"] = bool(r["worker_killed"])
+    extras["rollout_lease_replays"] = int(r["lease_replays"])
+    extras["rollback_ticks"] = int(r["rollback_ticks"] or -1)
+    extras["rollback_reason"] = str(r["rollback_reason"])
+    extras["rollback_restored_active"] = str(r["active_after"])
+    extras["canary_5xx"] = int(r["canary_5xx"])
+    extras["canary_gold_sheds"] = int(r["canary_gold_sheds"])
+    extras["rollout_gold_unharmed"] = bool(r["gold_unharmed"])
+    extras["rollout_workers_peak"] = int(r["workers_peak"])
+    extras["rollout_schedule_reproducible"] = bool(
+        r["schedule"] == r2["schedule"] and r["schedule"])
+
+
 def bench_serving(extras: dict) -> None:
     """End-to-end HTTP request→jitted pipeline→response latency against
     the reference's ~1 ms continuous-mode figure."""
@@ -2271,6 +2307,10 @@ def main():
             # fleet federation + chaos health trajectory (in-thread
             # mesh + synthetic snapshots: tunnel-immune)
             _watchdog(bench_fleet, extras, "fleet", 240.0)
+        if want("deploy"):
+            # blue/green flip + seeded-bad-canary rollback across the
+            # synthetic fleet (host-side only: tunnel-immune)
+            _watchdog(bench_deploy, extras, "deploy", 240.0)
         if want("serving"):
             # includes a small GBDT fit for the real-model row
             _watchdog(bench_serving, extras, "serving", 360.0)

@@ -72,6 +72,9 @@ PACKAGES: dict[str, list[str]] = {
     # paged-attention kernel equivalence suite
     "llm": ["test_paged_kv.py", "test_llm_serving.py",
             "test_paged_attention.py"],
+    # zero-downtime model lifecycle: versioned registry + blue/green
+    # router + canary burn-rate rollback, and the rollout acceptance
+    "deploy": ["test_deploy.py"],
 }
 
 # traceable-count ratchet (ISSUE 10): the analysis gate fails if the
@@ -420,6 +423,43 @@ def style() -> int:
               env=dict(os.environ, JAX_PLATFORMS="cpu",
                        MMLSPARK_TPU_PERF_STORE=tempfile.mkdtemp(
                            prefix="mmlspark_tpu_perf_smoke_")))
+    if rc:
+        return rc
+    # the deploy plane is control-plane code (registry + router +
+    # rollout controller): it must register versions, stage + flip
+    # atomically, and answer a controller tick with no JAX in the
+    # process — the serving fronts route every request through it from
+    # handler threads, long before any device init
+    smoke = (
+        "import sys\n"
+        "from mmlspark_tpu.serving.deploy import (ModelRegistry, "
+        "RolloutConfig, RolloutController, VersionRouter)\n"
+        "from mmlspark_tpu.obs.metrics import MetricsRegistry\n"
+        "assert 'jax' not in sys.modules, 'deploy import pulled jax'\n"
+        "reg = MetricsRegistry()\n"
+        "m = ModelRegistry(service='smoke', registry=reg)\n"
+        "m.register('v1', transform=lambda b: b)\n"
+        "m.register('v2', transform=lambda b: b)\n"
+        "r = VersionRouter(m, service='smoke', metrics=reg)\n"
+        "r.set_active('v1')\n"
+        "r.stage('v2', canary_share=0.25)\n"
+        "assert r.assign('gold')[0] == 'v1'\n"
+        "assert r.flip() == 'v2' and r.active == 'v2'\n"
+        "assert r.draining_inflight() == 1\n"
+        "r.release('v1')\n"
+        "assert r.draining_inflight() == 0\n"
+        "c = RolloutController(r, metrics=reg, "
+        "config=RolloutConfig(rollback_windows=1))\n"
+        "assert c.tick(burns={}) == 'idle'\n"
+        "m.register('v3', transform=lambda b: b)\n"
+        "r.stage('v3')\n"
+        "assert c.tick(burns={'canary': {'fast': 9.0, 'slow': 9.0}}) "
+        "== 'rollback'\n"
+        "assert c.deploy_reasons(), 'rollback flap must degrade healthz'\n"
+        "assert 'jax' not in sys.modules, 'deploy plane pulled jax'\n"
+        "print('serving.deploy control plane OK (no jax)')")
+    rc = _run([sys.executable, "-c", smoke],
+              env=dict(os.environ, JAX_PLATFORMS="cpu"))
     if rc:
         return rc
     # graftcheck (static analysis) is pure stdlib: it must import AND

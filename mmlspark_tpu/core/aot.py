@@ -125,7 +125,51 @@ def _metrics():
             "lower+compile wall seconds per store build, by segment"),
         "entries": reg.gauge(
             "aot_store_entries", "executables resident in the store"),
+        "gc_kept": reg.counter(
+            "aot_gc_kept_versions",
+            "gc-stale entries spared because a deploy-registry "
+            "version still needs them (deploy state or keep-last-N)"),
     }
+
+
+# ------------------------------------------------------- deploy registry
+def _registry_versions(root: str) -> list[dict]:
+    """Version records from the deploy-plane registry persisted beside
+    the store tree (``serving/deploy.py`` writes ``registry.json``
+    there). Read as plain JSON — the gc/list paths must not grow a
+    serving import."""
+    try:
+        with open(os.path.join(root, "registry.json"),
+                  encoding="utf-8") as f:
+            payload = json.load(f)
+    except (OSError, ValueError):
+        return []
+    recs = payload.get("versions", [])
+    return [r for r in recs if isinstance(r, dict) and r.get("name")]
+
+
+#: registry states that pin a version's entries unconditionally — the
+#: live deploy set (mirrors serving.deploy.DEPLOY_STATES): collecting
+#: the active version or a rollback target mid-deploy would turn the
+#: next flip into a compile storm
+_DEPLOY_STATES = ("warming", "candidate", "active", "draining")
+
+
+def _protected_static_fps(root: str,
+                          keep_model_versions: int | None) -> set:
+    """Static fingerprints gc must spare: every registry version in a
+    deploy state, plus — with ``keep_model_versions=N`` — the last N
+    versions by registration order (the operator's rollback horizon)."""
+    recs = _registry_versions(root)
+    keep: set = set()
+    for rec in recs:
+        if rec.get("state") in _DEPLOY_STATES:
+            keep.update(rec.get("static_fps", []))
+    if keep_model_versions:
+        ordered = sorted(recs, key=lambda r: r.get("seq", 0))
+        for rec in ordered[-int(keep_model_versions):]:
+            keep.update(rec.get("static_fps", []))
+    return keep
 
 
 # ----------------------------------------------------------- fingerprints
@@ -445,14 +489,26 @@ class AotStore:
         return True
 
     def gc(self, keep_static: set[str] | None = None,
-           keep_versions: bool = True) -> list[str]:
+           keep_versions: bool = True,
+           keep_model_versions: int | None = None) -> list[str]:
         """Remove stale entries: anything whose static fingerprint is
         not in ``keep_static`` (when given), plus — with
         ``keep_versions`` — anything built against a different
         jax/jaxlib than this process would fingerprint (those can never
-        match again; they are dead weight)."""
+        match again; they are dead weight).
+
+        Deploy-plane protection (``serving/deploy.py``,
+        ``registry.json`` beside the tree): an entry a registry version
+        in a deploy state (warming/candidate/active/draining) still
+        points at is NEVER removed — whatever keep_static says — and
+        ``keep_model_versions=N`` (CLI ``gc --keep-versions N``)
+        additionally pins the last N registered versions, so a rollback
+        target survives every gc that runs mid-deploy. Spared entries
+        count in ``aot_gc_kept_versions``."""
         versions = runtime_versions()
-        removed = []
+        protected = _protected_static_fps(self.root,
+                                          keep_model_versions)
+        removed, kept = [], 0
         for meta in self.entries():
             stale = False
             if keep_static is not None \
@@ -461,9 +517,16 @@ class AotStore:
             if keep_versions and meta.get("versions") not in (
                     None, versions):
                 stale = True
+            if stale and meta.get("static_fp") in protected:
+                kept += 1
+                continue
             if stale:
                 shutil.rmtree(meta["_dir"], ignore_errors=True)
                 removed.append(meta["full_fp"])
+        if kept:
+            self._m["gc_kept"].inc(kept)
+            _LOG.info("aot store gc: kept %d entries pinned by the "
+                      "deploy registry", kept)
         if removed:
             _LOG.info("aot store gc: removed %d stale entries",
                       len(removed))
@@ -1052,13 +1115,21 @@ def _cli(argv=None) -> int:
                         "aot.register_buildable)")
     b.add_argument("--service", default=None)
     b.add_argument("--root", default=None)
-    ls = sub.add_parser("list", help="print store entries")
+    ls = sub.add_parser("list", help="print store entries (and the "
+                        "deploy registry's versions, when present)")
     ls.add_argument("--root", default=None)
     g = sub.add_parser("gc", help="drop version-stale entries (and "
-                       "anything not matching --keep-static)")
+                       "anything not matching --keep-static); "
+                       "registry versions in a deploy state are "
+                       "always spared")
     g.add_argument("--root", default=None)
     g.add_argument("--keep-static", action="append", default=None,
                    metavar="FP")
+    g.add_argument("--keep-versions", type=int, default=None,
+                   metavar="N",
+                   help="additionally pin the last N deploy-registry "
+                        "versions' entries (rollback horizon); spared "
+                        "entries count in aot_gc_kept_versions")
     st = sub.add_parser("selftest", help="build-then-load round trip "
                         "in two scrubbed subprocesses (CI job)")
     st.add_argument("--root", default=None)
@@ -1072,16 +1143,41 @@ def _cli(argv=None) -> int:
 
     if args.cmd == "list":
         store = AotStore(args.root)
-        for m in store.entries():
+        entries = store.entries()
+        for m in entries:
             print(f"{m['full_fp'][:16]} {m.get('tier', '?'):10s} "
                   f"{m.get('segment', '?')}")
+        # deploy registry (serving/deploy.py persists registry.json
+        # beside the tree): version names, fingerprints, and per-bucket
+        # built/warm state — the operator's pre-flip checklist
+        recs = _registry_versions(store.root)
+        if recs:
+            by_static: dict = {}
+            for m in entries:
+                by_static.setdefault(m.get("static_fp"), []).append(m)
+            print("registry versions:")
+            for rec in sorted(recs, key=lambda r: r.get("seq", 0)):
+                fps = rec.get("static_fps", [])
+                print(f"  {rec['name']:20s} "
+                      f"{rec.get('state', '?'):10s} "
+                      f"warmed={rec.get('warmed', 0)} "
+                      f"fps={','.join(fp[:12] for fp in fps) or '-'}")
+                for fp in fps:
+                    for m in by_static.get(fp, []):
+                        spec = m.get("donated") or []
+                        bucket = spec[0][2][0] if spec and \
+                            spec[0][2] else "?"
+                        print(f"    bucket={bucket:<6} "
+                              f"{m.get('tier', '?'):10s} "
+                              f"{m['full_fp'][:16]}")
         print(json.dumps(store.stats(), indent=1))
         return 0
 
     if args.cmd == "gc":
         store = AotStore(args.root)
         keep = set(args.keep_static) if args.keep_static else None
-        removed = store.gc(keep_static=keep)
+        removed = store.gc(keep_static=keep,
+                           keep_model_versions=args.keep_versions)
         print(f"gc: removed {len(removed)} entries; "
               f"{store.stats()['entries']} remain")
         return 0

@@ -73,7 +73,7 @@ __all__ = [
 #: twice; the ingest already observed the request side).
 FEDERATED_PREFIXES = (
     "profile_", "collective_", "mem_", "sched_", "serving_", "aot_",
-    "kv_", "gen_",
+    "kv_", "gen_", "deploy_",
 )
 
 
@@ -717,25 +717,39 @@ class BurnRateMonitor:
         return DEFAULT_ERROR_BUDGET
 
     def _totals(self, samples: dict) -> dict:
-        """{tenant: (admitted, shed)} from sched_tenant_* samples,
-        optionally filtered to one service."""
+        """{tenant: (admitted, bad)} from the tenant counters,
+        optionally filtered to one service. The bad side folds sheds
+        (``sched_tenant_shed_total``) together with server-side 5xx
+        (``serving_tenant_requests_total{code=5xx}``) — a canary build
+        answering 500s burns its error budget exactly like one being
+        shed, which is what lets the rollout controller (deploy plane)
+        act on burn alone. Admissions stay the denominator: every
+        answered request was admitted, so the two families never
+        double-count the good side."""
         out: dict = {}
         for sample, v in samples.items():
             name, labels = parse_sample(sample)
             if name not in ("sched_tenant_admitted_total",
-                            "sched_tenant_shed_total"):
+                            "sched_tenant_shed_total",
+                            "serving_tenant_requests_total"):
                 continue
             if self._service and labels.get("service") != self._service:
                 continue
             tenant = labels.get("tenant")
             if tenant is None:
                 continue
-            adm, shed = out.get(tenant, (0.0, 0.0))
+            if name == "serving_tenant_requests_total":
+                try:
+                    if int(labels.get("code", "0")) < 500:
+                        continue
+                except ValueError:
+                    continue
+            adm, bad = out.get(tenant, (0.0, 0.0))
             if name == "sched_tenant_admitted_total":
                 adm += float(v)
             else:
-                shed += float(v)
-            out[tenant] = (adm, shed)
+                bad += float(v)
+            out[tenant] = (adm, bad)
         return out
 
     def tick(self, samples=None) -> dict:
@@ -819,6 +833,7 @@ class FleetHealth:
         self._verdict = "ok"
         self._reasons: list = []
         self._sentinel = None
+        self._deploy_reasons = None
         self._g_health = self._reg.gauge(
             "fleet_health",
             "healthz verdict: 0 ok, 1 degraded, 2 critical")
@@ -837,6 +852,14 @@ class FleetHealth:
         it was is sick, but never load-balancer-drain critical. The
         sentinel module attaches the process-wide pair on import."""
         self._sentinel = sentinel
+
+    def attach_deploy(self, reasons_fn) -> None:
+        """Point the verdict at the deploy plane
+        (``serving.deploy.RolloutController.deploy_reasons``): while a
+        rollback flap is in progress the fleet reads degraded — traffic
+        is snapping back to the prior version, so "slow but serving",
+        never load-balancer-drain critical."""
+        self._deploy_reasons = reasons_fn
 
     def tick(self) -> str:
         """One health evaluation: refresh memory gauges, detect
@@ -876,6 +899,16 @@ class FleetHealth:
                     verdict = "degraded"
                 reasons.append(
                     "regression=" + ",".join(sorted(sustained)))
+        deploy_fn = self._deploy_reasons
+        if deploy_fn is not None:
+            try:
+                flapping = list(deploy_fn())
+            except Exception:
+                flapping = []
+            if flapping:
+                if verdict == "ok":
+                    verdict = "degraded"
+                reasons.extend(flapping)
         with self._lock:
             self._verdict = verdict
             self._reasons = reasons

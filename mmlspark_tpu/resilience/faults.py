@@ -21,10 +21,20 @@ point                   where it fires
                         ``slow`` rule arms the persistent degradation)
 ``checkpoint.write``    ``dl/checkpoint.CheckpointManager.save``, after the
                         temp-dir write, **before** the atomic rename
+``model.bad``           serving executor (``ServingQuery._execute_group``),
+                        once per version sub-batch at execute time, keyed
+                        by the model version name — an ``error`` rule makes
+                        that version answer injected 5xx, a ``corrupt``
+                        rule flips its output bytes under a healthy status
+                        (what shadow comparison catches). The deploy
+                        plane's rollback acceptance seeds a bad canary
+                        through this point.
 ======================  ====================================================
 
 Fault kinds: ``latency`` (sleep then continue), ``error`` (the hook
-returns/serves an injected HTTP status), ``drop`` (raises
+returns/serves an injected HTTP status), ``corrupt`` (the hook mangles
+its otherwise-healthy output — wrong bytes, right status), ``drop``
+(raises
 :class:`InjectedDrop`, an ``OSError`` — existing transport-failure
 handling takes over), ``kill`` (raises :class:`WorkerKilled` — the
 worker loop dies as if SIGKILLed), ``slow`` (arms a PERSISTENT
@@ -86,7 +96,7 @@ class FaultRule:
     on the probe's key (e.g. a worker id or URL)."""
 
     point: str
-    kind: str                       # latency | error | drop | kill | slow
+    kind: str       # latency | error | corrupt | drop | kill | slow
     p: float = 1.0
     after: int = 0
     times: int | None = None
@@ -200,9 +210,9 @@ class FaultInjector:
         """Probe AND act with the standard semantics: ``latency``
         sleeps here and returns None (execution continues); ``drop``
         raises :class:`InjectedDrop`; ``kill`` raises
-        :class:`WorkerKilled`; ``error`` returns the action — the hook
-        turns it into its layer's error shape (an HTTP status, an
-        error row…)."""
+        :class:`WorkerKilled`; ``error`` and ``corrupt`` return the
+        action — the hook turns it into its layer's failure shape (an
+        HTTP status, an error row, mangled output bytes…)."""
         act = self.probe(point, key)
         if act is None:
             return None

@@ -14,6 +14,8 @@ reference's semantics: in-flight requests are replayed if a batch fails
 
 from .autoscale import (AutoscaleConfig, AutoscaleSignals, Autoscaler,
                         ComputeWorkerPool)
+from .deploy import (ModelRegistry, ModelVersion, RolloutConfig,
+                     RolloutController, VersionRouter)
 from .distributed import (DistributedServingServer, DriverRegistry,
                           NativeDistributedServingServer,
                           RegistryClient, ServiceInfo, pick_least_loaded,
@@ -29,6 +31,8 @@ __all__ = ["bucket_pad",
            "HandoffQueue", "pack_handoff", "unpack_handoff",
            "Autoscaler", "AutoscaleConfig", "AutoscaleSignals",
            "ComputeWorkerPool",
+           "ModelRegistry", "ModelVersion", "VersionRouter",
+           "RolloutConfig", "RolloutController",
            "DistributedServingServer", "NativeDistributedServingServer",
            "DriverRegistry", "RegistryClient",
            "ServiceInfo", "ServingServer", "pick_least_loaded",

@@ -424,19 +424,30 @@ class ComputeWorkerPool:
     factory every worker shares ``transform_fn`` (and its already-
     warmed segments) — fine when threads stand in for one process's
     capacity, dishonest as a scale-up benchmark.
+
+    ``version_router`` (deploy plane, ``serving.deploy``) supersedes
+    both: a worker the autoscaler adds MID-DEPLOY must serve the
+    version that is active at spawn time — not whatever transform the
+    pool was built with — or a scale-up during a rollout silently
+    un-flips part of the fleet. The router's ``active_transform`` is
+    read per ``scale_up``, and the worker loop AOT-warms it like any
+    factory-built transform.
     """
 
     def __init__(self, driver_address, service: str, transform_fn=None,
-                 *, transform_factory=None, max_batch: int = 64,
+                 *, transform_factory=None, version_router=None,
+                 max_batch: int = 64,
                  heartbeat_interval: float = 0.25,
                  mesh_secret: str = "", prefix: str | None = None):
-        if transform_fn is None and transform_factory is None:
-            raise ValueError("ComputeWorkerPool needs transform_fn or "
-                             "transform_factory")
+        if transform_fn is None and transform_factory is None \
+                and version_router is None:
+            raise ValueError("ComputeWorkerPool needs transform_fn, "
+                             "transform_factory, or version_router")
         self.driver_address = driver_address
         self.service = service
         self.transform_fn = transform_fn
         self.transform_factory = transform_factory
+        self.version_router = version_router
         self.max_batch = max_batch
         self.heartbeat_interval = heartbeat_interval
         self.mesh_secret = mesh_secret
@@ -460,9 +471,16 @@ class ComputeWorkerPool:
         from .distributed import remote_worker_loop
         # a factory means "fresh worker, cold caches": build its
         # transform before taking the lock (compiles/store loads must
-        # not serialize the pool)
-        fn = (self.transform_factory() if self.transform_factory
-              is not None else self.transform_fn)
+        # not serialize the pool). A version router wins outright —
+        # the new worker must honor the ACTIVE version at spawn time
+        # (scale-up mid-deploy must not resurrect the old model)
+        if self.version_router is not None:
+            fn = self.version_router.active_transform() \
+                or self.transform_fn
+        elif self.transform_factory is not None:
+            fn = self.transform_factory()
+        else:
+            fn = self.transform_fn
         with self._lock:
             wid = f"{self.prefix}-w{self._seq}"
             self._seq += 1

@@ -1,0 +1,691 @@
+"""Zero-downtime model lifecycle: versioned registry, blue/green
+router, canary burn-rate gating, automatic rollback.
+
+The AOT store's content-addressed static fingerprint (``core/aot.py``)
+IS a model version: two builds of the same pipeline class with
+different fitted params fingerprint differently, so "deploy a new
+model" is "publish new store entries beside the old ones and flip a
+pointer". This module makes that flip a first-class operation:
+
+- :class:`ModelRegistry` — named versions keyed by their static AOT
+  fingerprints, persisted as ``registry.json`` beside the store root
+  (so ``aot gc --keep-versions N`` can protect rollback targets
+  without importing this module).
+- :class:`VersionRouter` — the per-request routing point both serving
+  fronts pass through (``ServingServer._admit``). Active / candidate /
+  draining states; a flip is ONE atomic pointer swap under the router
+  lock; in-flight requests complete on the version that admitted them
+  (the drain is counted in ``deploy_draining_inflight``). Canary
+  traffic is a deterministic admission-counter slice re-labeled onto a
+  canary TENANT, so the candidate gets its own ``sched_tenant_*`` /
+  ``serving_tenant_*`` series and its own error budget through the
+  existing tenancy plane — no parallel accounting. Shadow mode mirrors
+  active traffic through the candidate and compares responses
+  (``deploy_shadow_mismatch_total``) without returning them.
+- :class:`RolloutController` — the control loop (same shape as
+  ``serving.autoscale.Autoscaler``: hysteresis, cooldown, monotonic
+  clock only) that watches the canary tenant's multi-window SLO burn
+  (``obs.fleet.BurnRateMonitor``) and the CUSUM sentinel
+  (``obs.regression``). Sustained burn over budget rolls back to the
+  prior version (``deploy_rollbacks_total{reason}`` + a
+  ``deploy.rollback`` span) and degrades ``/healthz`` for the flap
+  window; promotion requires N consecutive healthy canary windows.
+
+Design rules (mirroring the autoscaler's):
+
+- **determinism**: the canary slice is an admission-counter stride,
+  not an RNG draw — the same request sequence always canaries the
+  same requests, so chaos/bench runs reproduce by seed.
+- **one atomic swap**: every router transition (flip, rollback,
+  stage) happens under one lock; readers (``assign``) see either the
+  old world or the new, never a half-flip.
+- **drain, never drop**: a flipped-away version keeps serving its
+  admitted in-flight requests; it retires only when its inflight
+  count returns to zero.
+- **monotonic clock only**: the controller runs on
+  ``sched.policy.now`` — a wall-clock step must not fake a healthy
+  window or a flap expiry (graftcheck wallclock pass).
+
+Import is stdlib + obs/sched only — no JAX (the CI style job smokes
+registry + flip + controller tick with no jax in the process).
+"""
+
+from __future__ import annotations
+
+import json
+import logging
+import os
+import tempfile
+import threading
+from dataclasses import dataclass, field
+
+from ..obs import registry as _default_registry
+from ..obs.tracing import tracer as _tracer
+from ..sched.policy import now
+
+_LOG = logging.getLogger("mmlspark_tpu.serving.deploy")
+
+REGISTRY_FILE = "registry.json"
+
+# version lifecycle states
+REGISTERED = "registered"   # named, not yet warmed or routed
+WARMING = "warming"         # executables pre-loading on live workers
+CANDIDATE = "candidate"     # staged for traffic (canary slice/shadow)
+ACTIVE = "active"           # owns the traffic pointer
+DRAINING = "draining"       # flipped away; finishing admitted work
+RETIRED = "retired"         # done; eligible for gc (subject to last-N)
+
+#: states that pin a version's store entries against ``aot.gc`` no
+#: matter what keep-last-N says: collecting a rollback target (or the
+#: version currently serving) mid-deploy would turn the next flip into
+#: a compile storm
+DEPLOY_STATES = (WARMING, CANDIDATE, ACTIVE, DRAINING)
+
+_STATE_CODE = {REGISTERED: 0, WARMING: 1, CANDIDATE: 2, ACTIVE: 3,
+               DRAINING: 4, RETIRED: 5}
+
+
+@dataclass
+class ModelVersion:
+    """One named, deployable model build.
+
+    ``static_fps`` are the AOT static fingerprints of its fused
+    segments — the durable identity ``aot.gc`` protects; ``transform``
+    is the runtime callable (in-memory only; re-attached after a
+    registry reload by re-calling :meth:`ModelRegistry.register`)."""
+
+    name: str
+    seq: int
+    static_fps: tuple = ()
+    state: str = REGISTERED
+    warmed: int = 0
+    transform: object = None
+    meta: dict = field(default_factory=dict)
+
+    def record(self) -> dict:
+        return {"name": self.name, "seq": self.seq,
+                "static_fps": list(self.static_fps),
+                "state": self.state, "warmed": self.warmed,
+                "meta": dict(self.meta)}
+
+
+def static_fps_of(obj, platform: str | None = None) -> tuple:
+    """Best-effort static fingerprints of every fused segment in a
+    transform object (``aot._segments_of`` reachability). Empty for a
+    plain host callable — such a version still deploys, it just has no
+    store entries to protect."""
+    try:
+        from ..core import aot
+        fps = []
+        for seg in aot._segments_of(obj):
+            key = aot.segment_static_key(
+                seg.stages, no_donate=getattr(seg, "no_donate", ()),
+                expected_host=getattr(seg, "expected_host", ()),
+                platform=platform)
+            fps.append(aot._sha(key))
+        return tuple(dict.fromkeys(fps))
+    except Exception:
+        return ()
+
+
+class ModelRegistry:
+    """Named model versions keyed by AOT static fingerprints.
+
+    Persists to ``<root>/registry.json`` beside the AOT store tree
+    (atomic tmp+replace, like the store's own writes) so the ``aot``
+    CLI — a different process — can list versions and protect rollback
+    targets during gc. ``root=None`` keeps the registry in-memory
+    (tests, pure-routing deployments with no store)."""
+
+    def __init__(self, root: str | None = None, *, service: str = "",
+                 registry=None):
+        self.root = root
+        self.service = service
+        self._reg = registry if registry is not None \
+            else _default_registry
+        self._lock = threading.Lock()
+        self._versions: dict[str, ModelVersion] = {}
+        self._g_versions = self._reg.gauge(
+            "deploy_registry_versions",
+            "model versions known to the deploy registry, by service")
+        self._g_state = self._reg.gauge(
+            "deploy_version_state",
+            "version lifecycle state code (0 registered, 1 warming, "
+            "2 candidate, 3 active, 4 draining, 5 retired)")
+        if root:
+            self._load()
+
+    # -- persistence ---------------------------------------------------
+    def path(self) -> str | None:
+        return os.path.join(self.root, REGISTRY_FILE) if self.root \
+            else None
+
+    def _load(self) -> None:
+        path = self.path()
+        try:
+            with open(path, encoding="utf-8") as f:
+                payload = json.load(f)
+        except (OSError, ValueError):
+            return
+        with self._lock:
+            for rec in payload.get("versions", []):
+                v = ModelVersion(
+                    name=str(rec.get("name", "")),
+                    seq=int(rec.get("seq", 0)),
+                    static_fps=tuple(rec.get("static_fps", [])),
+                    state=str(rec.get("state", REGISTERED)),
+                    warmed=int(rec.get("warmed", 0)),
+                    meta=dict(rec.get("meta", {})))
+                if v.name:
+                    self._versions[v.name] = v
+            self._gauges_locked()
+
+    def _save_locked(self) -> None:
+        self._gauges_locked()
+        path = self.path()
+        if path is None:
+            return
+        payload = {"service": self.service,
+                   "versions": [v.record() for v in
+                                self._ordered_locked()]}
+        os.makedirs(self.root, exist_ok=True)
+        fd, tmp = tempfile.mkstemp(dir=self.root, prefix=".registry-")
+        try:
+            with os.fdopen(fd, "w", encoding="utf-8") as f:
+                json.dump(payload, f, indent=1, sort_keys=True)
+            os.replace(tmp, path)
+        except OSError:
+            try:
+                os.unlink(tmp)
+            except OSError:
+                pass
+            raise
+
+    def _gauges_locked(self) -> None:
+        self._g_versions.set(len(self._versions),
+                             service=self.service)
+        for v in self._versions.values():
+            self._g_state.set(_STATE_CODE.get(v.state, 0),
+                              service=self.service, version=v.name)
+
+    def _ordered_locked(self) -> list[ModelVersion]:
+        return sorted(self._versions.values(), key=lambda v: v.seq)
+
+    # -- registration --------------------------------------------------
+    def register(self, name: str, transform=None, *,
+                 static_fps=None, meta: dict | None = None
+                 ) -> ModelVersion:
+        """Register (or re-attach, after a reload) a named version.
+        ``static_fps`` defaults to the fingerprints derivable from
+        ``transform``; an existing name keeps its sequence number and
+        state — re-registering is how a restarted process re-attaches
+        the runtime callable to a persisted version."""
+        fps = tuple(static_fps) if static_fps is not None \
+            else static_fps_of(transform)
+        with self._lock:
+            v = self._versions.get(name)
+            if v is None:
+                seq = 1 + max((x.seq for x in
+                               self._versions.values()), default=0)
+                v = ModelVersion(name=name, seq=seq)
+                self._versions[name] = v
+            v.transform = transform
+            if fps:
+                v.static_fps = fps
+            if meta:
+                v.meta.update(meta)
+            self._save_locked()
+            return v
+
+    def get(self, name: str) -> ModelVersion | None:
+        with self._lock:
+            return self._versions.get(name)
+
+    def versions(self) -> list[ModelVersion]:
+        """All versions, oldest first (deploy order)."""
+        with self._lock:
+            return self._ordered_locked()
+
+    def set_state(self, name: str, state: str) -> None:
+        with self._lock:
+            v = self._versions.get(name)
+            if v is None or v.state == state:
+                return
+            v.state = state
+            self._save_locked()
+
+    # -- blue/green warm -----------------------------------------------
+    def warm(self, name: str, service: str = "") -> int:
+        """Warm-load the version's executables from the active AOT
+        store (``aot.maybe_warm``) BEFORE any traffic sees it — the
+        blue/green half of a deploy. Counts the loads on the version
+        record so ``aot list`` can show warm state offline."""
+        with self._lock:
+            v = self._versions.get(name)
+        if v is None:
+            return 0
+        from ..core import aot
+        n = aot.maybe_warm(v.transform, service=service or self.service)
+        with self._lock:
+            v.warmed += n
+            if v.state == REGISTERED:
+                v.state = WARMING
+            self._save_locked()
+        return n
+
+    def prebuild(self, name: str, store=None, log=_LOG.info) -> dict:
+        """Pre-build the version's executables beside the old ones via
+        ``aot.build_registered`` (the version's transform is registered
+        as a buildable under ``<service>/<name>``). New entries land in
+        the SAME content-addressed tree — fingerprints differ, so the
+        old version's entries are untouched."""
+        with self._lock:
+            v = self._versions.get(name)
+        if v is None:
+            raise KeyError(name)
+        from ..core import aot
+        report = aot.build_registered(None, store)
+        built = {e["static_fp"] for e in report.get("entries", [])}
+        if built:
+            with self._lock:
+                v.static_fps = tuple(dict.fromkeys(
+                    list(v.static_fps) + sorted(built)))
+                self._save_locked()
+        log("deploy prebuild [%s]: %d entries" %
+            (name, len(report.get("entries", []))))
+        return report
+
+    # -- gc protection -------------------------------------------------
+    def protected_fps(self, keep_last: int | None = None) -> set:
+        """Static fingerprints ``aot.gc`` must not collect: every
+        version in a deploy state (warming/candidate/active/draining —
+        the live rollback set), plus the last ``keep_last`` versions by
+        sequence (the operator's rollback horizon)."""
+        with self._lock:
+            ordered = self._ordered_locked()
+        keep: set = set()
+        for v in ordered:
+            if v.state in DEPLOY_STATES:
+                keep.update(v.static_fps)
+        if keep_last:
+            for v in ordered[-int(keep_last):]:
+                keep.update(v.static_fps)
+        return keep
+
+
+class VersionRouter:
+    """The atomic traffic pointer both serving fronts route through.
+
+    ``assign`` is called once per admitted request (inside
+    ``ServingServer._admit``, before the scheduler sees it) and stamps
+    the request with the version that must serve it; ``release`` fires
+    from ``_finish_request`` — the one terminal site both fronts share
+    — so per-version inflight counts are exact and a draining version
+    retires precisely when its last admitted request completes."""
+
+    def __init__(self, registry: ModelRegistry, *, service: str = "",
+                 canary_share: float = 0.0,
+                 canary_tenant: str = "canary",
+                 shadow: bool = False, metrics=None):
+        self.registry = registry
+        self.service = service or registry.service
+        self.canary_tenant = canary_tenant
+        self.shadow = bool(shadow)
+        self._share = 0.0
+        self._stride = 0
+        self._lock = threading.Lock()
+        self.active: str | None = None
+        self.candidate: str | None = None
+        self.prior: str | None = None
+        self._inflight: dict[str, int] = {}
+        self._admitted = 0
+        reg = metrics if metrics is not None else _default_registry
+        self._c_flips = reg.counter(
+            "deploy_flips_total",
+            "atomic active-version swaps (promotions included)")
+        self._c_rollbacks = reg.counter(
+            "deploy_rollbacks_total",
+            "automatic/manual rollbacks, by service and reason")
+        self._c_canary = reg.counter(
+            "deploy_canary_requests_total",
+            "requests routed to the candidate's canary slice")
+        self._c_shadow = reg.counter(
+            "deploy_shadow_mismatch_total",
+            "shadow-mode responses that differed from the active "
+            "version's")
+        self._g_draining = reg.gauge(
+            "deploy_draining_inflight",
+            "admitted requests still completing on a flipped-away "
+            "version, by service and version")
+        self._set_share(canary_share)
+
+    def _set_share(self, share: float) -> None:
+        share = max(0.0, min(1.0, float(share)))
+        self._share = share
+        # deterministic slice: every stride-th admission canaries, so
+        # the same request sequence canaries the same requests (no RNG)
+        self._stride = int(round(1.0 / share)) if share > 0 else 0
+
+    # -- lifecycle transitions -----------------------------------------
+    def set_active(self, name: str) -> None:
+        """Initial deploy (no traffic yet to drain from)."""
+        with self._lock:
+            old = self.active
+            self.active = name
+        self.registry.set_state(name, ACTIVE)
+        if old and old != name:
+            self._drain(old)
+
+    def stage(self, name: str, *, canary_share: float | None = None,
+              shadow: bool | None = None) -> None:
+        """Stage a warmed version as the candidate: it starts receiving
+        the canary slice (or mirrored shadow traffic) on the next
+        admission — no restart, no queue flush."""
+        with self._lock:
+            if canary_share is not None:
+                self._set_share(canary_share)
+            if shadow is not None:
+                self.shadow = bool(shadow)
+            self.candidate = name
+        self.registry.set_state(name, CANDIDATE)
+
+    def flip(self) -> str | None:
+        """Promote the candidate: ONE pointer swap under the lock.
+        Requests admitted before the swap complete on the old version
+        (it drains); requests admitted after see only the new one."""
+        with self._lock:
+            if self.candidate is None:
+                return None
+            old, new = self.active, self.candidate
+            self.prior = old
+            self.active = new
+            self.candidate = None
+        self._c_flips.inc(1, service=self.service)
+        _tracer.emit_span("deploy.flip", parent=None, seconds=0.0,
+                          service=self.service, version=new,
+                          prior=old or "")
+        self.registry.set_state(new, ACTIVE)
+        if old:
+            self._drain(old)
+        return new
+
+    def rollback(self, reason: str = "manual") -> str | None:
+        """Back out the deploy: demote a live candidate, or — after a
+        full flip — swap the prior version back in. Returns the demoted
+        version (None when there is nothing to roll back)."""
+        with self._lock:
+            if self.candidate is not None:
+                bad, self.candidate = self.candidate, None
+                restored = self.active
+            elif self.prior is not None:
+                bad, self.active = self.active, self.prior
+                restored = self.prior
+                self.prior = None
+            else:
+                return None
+        self._c_rollbacks.inc(1, service=self.service, reason=reason)
+        _tracer.emit_span("deploy.rollback", parent=None, seconds=0.0,
+                          service=self.service, version=bad or "",
+                          restored=restored or "", reason=reason)
+        _LOG.warning("deploy rollback [%s]: %s -> %s (%s)",
+                     self.service, bad, restored, reason)
+        if bad:
+            self._drain(bad)
+        return bad
+
+    def _drain(self, name: str) -> None:
+        with self._lock:
+            left = self._inflight.get(name, 0)
+        if left > 0:
+            self.registry.set_state(name, DRAINING)
+            self._g_draining.set(left, service=self.service,
+                                 version=name)
+        else:
+            self.registry.set_state(name, RETIRED)
+            self._g_draining.set(0, service=self.service, version=name)
+
+    # -- per-request hot path ------------------------------------------
+    def assign(self, tenant: str = "") -> tuple[str, str | None]:
+        """Admission-time routing decision: ``(version, tenant_override)``.
+        Acquires the version's inflight slot — the caller must
+        ``release`` on every terminal outcome (the serving layer wires
+        this through ``_finish_request``)."""
+        with self._lock:
+            self._admitted += 1
+            ver = self.active or ""
+            override = None
+            if (self.candidate is not None and not self.shadow
+                    and self._stride
+                    and self._admitted % self._stride == 0):
+                ver = self.candidate
+                override = self.canary_tenant
+            if ver:
+                self._inflight[ver] = self._inflight.get(ver, 0) + 1
+        if override is not None:
+            self._c_canary.inc(1, service=self.service, version=ver)
+        return ver, override
+
+    def release(self, name: str) -> None:
+        with self._lock:
+            left = max(self._inflight.get(name, 1) - 1, 0)
+            self._inflight[name] = left
+        v = self.registry.get(name)
+        if v is not None and v.state == DRAINING:
+            self._g_draining.set(left, service=self.service,
+                                 version=name)
+            if left == 0:
+                self.registry.set_state(name, RETIRED)
+
+    def inflight(self, name: str) -> int:
+        with self._lock:
+            return self._inflight.get(name, 0)
+
+    def draining_inflight(self) -> int:
+        """Total admitted requests still completing on draining
+        versions (0 = every flip fully drained)."""
+        total = 0
+        with self._lock:
+            counts = dict(self._inflight)
+        for name, left in counts.items():
+            v = self.registry.get(name)
+            if v is not None and v.state == DRAINING:
+                total += left
+        return total
+
+    # -- executor / worker-pool lookups --------------------------------
+    def transform_for(self, name: str):
+        v = self.registry.get(name)
+        return v.transform if v is not None else None
+
+    def active_transform(self):
+        with self._lock:
+            name = self.active
+        return self.transform_for(name) if name else None
+
+    def transform_factory(self):
+        """A zero-arg factory for ``ComputeWorkerPool``: a worker added
+        by the autoscaler mid-deploy builds (and AOT-warms) the version
+        that is active AT SPAWN TIME, not whatever was active when the
+        pool was constructed."""
+        def factory():
+            return self.active_transform()
+        return factory
+
+    def shadow_pair(self) -> tuple[str, str] | None:
+        """(active, candidate) when shadow comparison should run."""
+        with self._lock:
+            if self.shadow and self.candidate and self.active:
+                return self.active, self.candidate
+        return None
+
+    def note_shadow_mismatch(self, n: int = 1) -> None:
+        if n > 0:
+            self._c_shadow.inc(n, service=self.service)
+
+    def describe(self) -> dict:
+        with self._lock:
+            state = {
+                "service": self.service,
+                "active": self.active,
+                "candidate": self.candidate,
+                "prior": self.prior,
+                "canary_share": self._share,
+                "canary_tenant": self.canary_tenant,
+                "shadow": self.shadow,
+                "admitted": self._admitted,
+                "inflight": dict(self._inflight),
+            }
+        state["versions"] = [v.record() for v in
+                             self.registry.versions()]
+        return state
+
+
+@dataclass
+class RolloutConfig:
+    """Rollback/promotion policy knobs (autoscaler-config idiom)."""
+
+    interval: float = 0.5        # control period (start() cadence)
+    burn_threshold: float = 2.0  # canary fast-window burn => unhealthy
+    slow_threshold: float = 1.0  # slow-window confirmation (multi-
+                                 # window: a blip must not roll back)
+    rollback_windows: int = 2    # consecutive unhealthy ticks to act
+    promote_windows: int = 6     # consecutive healthy ticks to promote
+    cooldown: float = 2.0        # post-action quiet period
+    flap_s: float = 5.0          # /healthz degraded window after a
+                                 # rollback
+
+
+class RolloutController:
+    """Watches the canary and decides: hold, promote, or roll back.
+
+    Same control shape as ``serving.autoscale.Autoscaler``: periodic
+    ``tick`` on a monotonic clock, hysteresis streaks, post-action
+    cooldown, an events list for forensics. The canary's health signal
+    is the existing SLO plane — the canary tenant's multi-window burn
+    from :class:`~mmlspark_tpu.obs.fleet.BurnRateMonitor` plus the
+    CUSUM sentinel's sustained set — so a rollback needs no new
+    measurement machinery, only a policy over signals the fleet
+    already pages on."""
+
+    def __init__(self, router: VersionRouter, *, burn=None,
+                 sentinel=None, config: RolloutConfig | None = None,
+                 health=None, metrics=None, clock=now):
+        self.router = router
+        self.burn = burn
+        self.sentinel = sentinel
+        self.config = config or RolloutConfig()
+        self.clock = clock
+        reg = metrics if metrics is not None else _default_registry
+        self._g_healthy = reg.gauge(
+            "deploy_canary_healthy_windows",
+            "consecutive healthy canary windows (promotion progress)")
+        self._c_promotions = reg.counter(
+            "deploy_promotions_total",
+            "candidates promoted to active after N healthy windows")
+        self.events: list[dict] = []
+        self._lock = threading.Lock()
+        self._healthy = 0
+        self._unhealthy = 0
+        self._cooldown_until = 0.0
+        self._flap_until = 0.0
+        self._flap_version = ""
+        self._stop = threading.Event()
+        self._thread: threading.Thread | None = None
+        if health is not None:
+            attach = getattr(health, "attach_deploy", None)
+            if callable(attach):
+                attach(self.deploy_reasons)
+
+    def _record(self, kind: str, **attrs) -> None:
+        self.events.append({"t": self.clock(), "kind": kind, **attrs})
+
+    def deploy_reasons(self) -> list[str]:
+        """The /healthz hook (``FleetHealth.attach_deploy``): non-empty
+        while a rollback flap is in progress — the fleet must read
+        degraded while traffic snaps back to the prior version."""
+        with self._lock:
+            if self.clock() < self._flap_until:
+                return [f"deploy rollback flap ({self._flap_version})"]
+        return []
+
+    def tick(self, burns: dict | None = None) -> str:
+        """One control decision. ``burns`` (``{tenant: {window:
+        burn}}``) is read from the attached BurnRateMonitor when not
+        injected (tests/scenarios pass it directly)."""
+        cfg = self.config
+        t = self.clock()
+        if self.router.candidate is None:
+            with self._lock:
+                self._healthy = self._unhealthy = 0
+            self._g_healthy.set(0, service=self.router.service)
+            return "idle"
+        if t < self._cooldown_until:
+            return "cooldown"
+        if burns is None:
+            burns = self.burn.tick() if self.burn is not None else {}
+        canary = burns.get(self.router.canary_tenant, {})
+        fast = float(canary.get("fast", 0.0))
+        slow = float(canary.get("slow", 0.0))
+        sustained = frozenset()
+        if self.sentinel is not None:
+            sustained = self.sentinel.sustained()
+        burning = fast >= cfg.burn_threshold \
+            and slow >= cfg.slow_threshold
+        if burning or sustained:
+            with self._lock:
+                self._unhealthy += 1
+                self._healthy = 0
+                unhealthy = self._unhealthy
+            self._g_healthy.set(0, service=self.router.service)
+            if unhealthy < cfg.rollback_windows:
+                return "hold"
+            reason = "burn" if burning else "regression"
+            bad = self.router.rollback(reason)
+            with self._lock:
+                self._unhealthy = 0
+                self._cooldown_until = t + cfg.cooldown
+                self._flap_until = t + cfg.flap_s
+                self._flap_version = bad or ""
+            self._record("rollback", version=bad, reason=reason,
+                         fast_burn=round(fast, 3),
+                         slow_burn=round(slow, 3),
+                         regressions=sorted(sustained))
+            return "rollback"
+        with self._lock:
+            self._healthy += 1
+            self._unhealthy = 0
+            healthy = self._healthy
+        self._g_healthy.set(healthy, service=self.router.service)
+        if healthy < cfg.promote_windows:
+            return "hold"
+        promoted = self.router.flip()
+        self._c_promotions.inc(1, service=self.router.service)
+        with self._lock:
+            self._healthy = 0
+            self._cooldown_until = t + cfg.cooldown
+        self._record("promote", version=promoted,
+                     healthy_windows=healthy)
+        return "promote"
+
+    # -- background loop (autoscaler idiom) ----------------------------
+    def start(self) -> "RolloutController":
+        if self._thread is not None and self._thread.is_alive():
+            return self
+        self._stop.clear()
+        self._thread = threading.Thread(target=self._run, daemon=True,
+                                        name="rollout-controller")
+        self._thread.start()
+        return self
+
+    def _run(self) -> None:
+        while not self._stop.wait(self.config.interval):
+            try:
+                self.tick()
+            except Exception:
+                _LOG.warning("rollout tick failed", exc_info=True)
+
+    def stop(self) -> None:
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout=5)
+            self._thread = None

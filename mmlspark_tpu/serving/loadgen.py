@@ -74,7 +74,8 @@ def _slowest_trace_ids(steady_lat: np.ndarray, ok: np.ndarray,
 def summarize(lat: np.ndarray, status: np.ndarray, wall_s: float,
               warmup: int = 20, trace_prefix: str | None = None,
               tenants: list[str] | None = None,
-              ttft: np.ndarray | None = None) -> dict:
+              ttft: np.ndarray | None = None,
+              versions=None) -> dict:
     """Shape raw per-request ``(latency_ms, http_status)`` matrices
     (connection-major ``[nconn, nreq]``; status -1 = transport failure,
     status >= 1000 = answered on a Retry-After re-attempt) into the
@@ -108,7 +109,16 @@ def summarize(lat: np.ndarray, status: np.ndarray, wall_s: float,
     per tenant: an LLM front replies when the first token exists, so
     first-byte time is the client-observed time-to-first-token and the
     per-tenant split keeps a gold tenant's TTFT p99 honest under mixed
-    load."""
+    load.
+
+    ``versions`` (deploy plane — the ``X-Model-Version`` label each
+    RESPONSE carried, connection-major like ``lat``; empty string =
+    unversioned) splits p50/p99/error-rate per observed version under
+    a ``versions`` key. Unlike the per-connection ``tenants`` row
+    selection, a blue/green flip lands MID-connection, so this split
+    is a per-request mask over the steady-state window — it is how a
+    bench proves the flip from the client side (old version's
+    percentiles before, new version's after, no error spike between)."""
     if not (status >= 0).any():
         raise RuntimeError("loadgen: every request failed")
     retried_all = status >= _RETRIED_BASE
@@ -163,6 +173,32 @@ def summarize(lat: np.ndarray, status: np.ndarray, wall_s: float,
                 "shed", "shed_rate", "retried", "retried_ok",
                 "rejected", "throughput_rps",
                 "transport_errors") if k in sub}
+    by_version = {}
+    if versions is not None:
+        va = np.asarray(versions, dtype=object)
+        steady_ver = va[:, warmup:] if nreq > warmup else va
+        seen = dict.fromkeys(v for row in np.asarray(versions,
+                                                     dtype=object)
+                             for v in row if v)
+        for name in seen:
+            vmask = steady_ver == name
+            v_ok = ok & vmask
+            v_lat = steady_lat[v_ok] if v_ok.any() \
+                else np.asarray([np.nan])
+            v_final = steady_st[vmask]
+            n = int((v_final >= 0).sum())
+            # errors here = any non-2xx final outcome on this
+            # version's responses (sheds included: a version that
+            # sheds its riders is not serving them)
+            errs = int(((v_final >= 0) & ((v_final < 200) |
+                                          (v_final >= 300))).sum())
+            by_version[name] = {
+                "n": n,
+                "p50_ms": float(np.percentile(v_lat, 50)),
+                "p99_ms": float(np.percentile(v_lat, 99)),
+                "errors": errs,
+                "error_rate": errs / max(n, 1),
+            }
     out_ttft = {} if ttft_ok is None else {
         "ttft_p50_ms": float(np.percentile(ttft_ok, 50)),
         "ttft_p99_ms": float(np.percentile(ttft_ok, 99)),
@@ -170,6 +206,7 @@ def summarize(lat: np.ndarray, status: np.ndarray, wall_s: float,
     return {
         **out_ttft,
         "tenants": by_tenant,
+        "versions": by_version,
         "slowest": slowest,
         "p50_ms": float(np.percentile(ok_lat, 50)),
         "p99_ms": float(np.percentile(ok_lat, 99)),

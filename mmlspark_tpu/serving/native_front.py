@@ -55,6 +55,10 @@ class _NativeCachedRequest(CachedRequest):
         # over, not after the exactly-once latch is burned.
         srv = self._server
         body = response.entity or b""
+        # deploy plane: echo the serving version before the header
+        # blob is built (the threaded front stamps at its own write
+        # site — same shared helper, so the fronts cannot drift)
+        srv._stamp_version(self, response)
         # every pipeline-set header rides through (Content-Length and
         # Connection are owned by the reactor). CR/LF are stripped from
         # names and values — embedded newlines would otherwise let a

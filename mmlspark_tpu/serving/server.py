@@ -38,6 +38,7 @@ from ..obs.propagation import extract as _extract
 from ..obs.timeseries import (recorder as _recorder,
                               timeline_payload as _timeline)
 from ..obs.tracing import tracer as _tracer
+from ..resilience.faults import injector as _inj
 from ..sched import RequestScheduler, Shed
 from ..sched.policy import bucket_of
 from ..sched.tenancy import clean_tenant
@@ -146,6 +147,12 @@ class CachedRequest:
     # the queue wait the scheduler stamped at pop — both None until set
     span: object = None
     queue_wait: float | None = None
+    # deploy plane (serving.deploy): the model version that admitted
+    # this request — it completes on that version even across a flip;
+    # the released latch makes the router's inflight release one-shot
+    # (the Shed path and _finish_request can both reach it)
+    model_version: str = ""
+    _version_released: bool = False
     _lock: threading.Lock = field(default_factory=threading.Lock)
 
     def reply(self, response: HTTPResponseData) -> bool:
@@ -289,6 +296,15 @@ class ServingServer:
         if self.api_path != "/":
             self._query_routes[f"{self.api_path}/debug/timeline"] = \
                 self._debug_timeline_route
+        # deploy plane (serving.deploy, ISSUE 19): no router until an
+        # operator attaches one — versionless serving stays the exact
+        # pre-deploy-plane path. The debug surface is shared-state so
+        # both fronts serve it.
+        self.version_router = None
+        self._routes["/debug/deploy"] = self._debug_deploy_route
+        if self.api_path != "/":
+            self._routes[f"{self.api_path}/debug/deploy"] = \
+                self._debug_deploy_route
         if tenancy is not None:
             _fleet_health.attach_tenancy(tenancy)
 
@@ -307,6 +323,49 @@ class ServingServer:
             "runtime_compiles": compile_tracker.runtime_compiled(),
         }
         return 200, _json.dumps(payload, indent=1).encode()
+
+    def _debug_deploy_route(self, body: bytes) -> tuple[int, bytes]:
+        """``GET /debug/deploy``: the version router's live state —
+        active/candidate/prior pointers, canary config, per-version
+        inflight, and the registry's version table."""
+        import json as _json
+        router = self.version_router
+        payload = router.describe() if router is not None \
+            else {"router": None}
+        return 200, _json.dumps(payload, indent=1).encode()
+
+    def attach_router(self, router) -> "ServingServer":
+        """Attach a :class:`~mmlspark_tpu.serving.deploy.VersionRouter`:
+        every subsequently admitted request is stamped with (and
+        completes on) the version the router assigns, and replies
+        carry ``X-Model-Version``. Works on both fronts — admission,
+        the terminal release, and the executor all run through shared
+        state."""
+        self.version_router = router
+        return self
+
+    def _stamp_version(self, cached: "CachedRequest",
+                       response: HTTPResponseData) -> None:
+        """Echo the serving version on a response (deploy satellite:
+        the flip must be visible client-side). setdefault — the
+        executor's per-group stamp is authoritative when present."""
+        router = self.version_router
+        if router is None or response is None:
+            return
+        ver = cached.model_version or router.active or ""
+        if ver and isinstance(response.headers, dict):
+            response.headers.setdefault("X-Model-Version", ver)
+
+    def _release_version(self, cached: "CachedRequest") -> None:
+        """One-shot release of the admitted version's inflight slot
+        (drain accounting): reachable from BOTH the Shed-at-admission
+        path and _finish_request, so the latch keeps it exact."""
+        router = self.version_router
+        if router is None or not cached.model_version \
+                or cached._version_released:
+            return
+        cached._version_released = True
+        router.release(cached.model_version)
 
     def _metrics_route(self, body: bytes) -> tuple[int, bytes]:
         """``GET /metrics``: Prometheus text exposition of the
@@ -377,6 +436,7 @@ class ServingServer:
         # is what bounds this label's cardinality — without one, a
         # client spraying X-Tenant values could grow the exposition
         # forever (same rationale as the <unmatched> route collapse)
+        self._release_version(cached)
         if cached.tenant and self.scheduler.tenancy is not None:
             self._m_tenant_requests.inc(1, service=self.name,
                                         tenant=cached.tenant,
@@ -420,9 +480,11 @@ class ServingServer:
         queueing (deadline expired before execution). Works through
         ``CachedRequest.reply``, so both fronts (threaded wait and
         native reactor) deliver it the same way."""
-        cached.reply(HTTPResponseData(
+        resp = HTTPResponseData(
             status_code=429, reason=f"shed: {reason}",
-            headers={"Retry-After": str(max(1, int(retry_after)))}))
+            headers={"Retry-After": str(max(1, int(retry_after)))})
+        self._stamp_version(cached, resp)
+        cached.reply(resp)
 
     def _admit(self, cached: "CachedRequest", route: str) -> None:
         """Shared admission path for both fronts: a client can tighten
@@ -454,8 +516,24 @@ class ServingServer:
                     budget = min(budget, self.scheduler.default_deadline)
             elif lk == "x-tenant":
                 tenant = clean_tenant(v)
-        self.scheduler.submit(cached, route=route, deadline=budget,
-                              tenant=tenant)
+        # deploy plane: the router decides WHICH version serves this
+        # request (and whether it rides the canary slice under the
+        # canary tenant's own quota/budget) at admission — the request
+        # then completes on that version even if a flip lands while it
+        # queues. assign() acquires the version's inflight slot, so a
+        # scheduler rejection must release it before re-raising.
+        router = self.version_router
+        if router is not None:
+            ver, override = router.assign(tenant)
+            cached.model_version = ver
+            if override:
+                tenant = override
+        try:
+            self.scheduler.submit(cached, route=route, deadline=budget,
+                                  tenant=tenant)
+        except Shed:
+            self._release_version(cached)
+            raise
 
     def __init__(self, name: str, host: str = "127.0.0.1", port: int = 0,
                  api_path: str = "/", reply_timeout: float = 30.0,
@@ -536,6 +614,9 @@ class ServingServer:
                     serving._finish_request(cached, s.status)
                     self.send_response(s.status)
                     self.send_header("Retry-After", str(s.retry_after))
+                    if cached.model_version:
+                        self.send_header("X-Model-Version",
+                                         cached.model_version)
                     self.send_header("Content-Length", "0")
                     self.end_headers()
                     return s.status
@@ -543,6 +624,7 @@ class ServingServer:
                 with serving._lock:
                     serving.history.pop(cached.id, None)
                 serving._finish_request(cached, resp.status_code or 500)
+                serving._stamp_version(cached, resp)
                 try:
                     self.send_response(resp.status_code or 500)
                     body = resp.entity or b""
@@ -740,46 +822,143 @@ class ServingQuery:
                     break
                 continue
             batch_rows.observe(len(batch), service=self.name)
-            ids = np.empty(len(batch), object)
-            reqs = np.empty(len(batch), object)
-            ids[:] = [c.id for c in batch]
-            reqs[:] = [c.request for c in batch]
-            df = DataFrame({"id": ids, "request": reqs})
-            try:
-                # the span roots here (the executor thread has no ambient
-                # context); batch latency also lands in the registry
-                with batch_seconds.time(service=self.name) as bt, \
-                        _tracer.span("serving.batch", parent=None,
-                                     service=self.name, rows=len(batch)):
-                    out = self.transform_fn(df)
-                # feed the scheduler's service-time model (EWMA per
-                # padding bucket, stored in the obs registry): this is
-                # what admission's predictive shed and the batcher's
-                # close decision read back
-                self.server.scheduler.estimator.observe(
-                    len(batch), bt.seconds)
-                self._annotate_batch(batch, bt.seconds)
-                if out is not None and "reply" in getattr(
-                        out, "columns", []):
-                    by_id = {c.id: c for c in batch}
-                    for rid, reply in zip(out["id"], out["reply"]):
-                        c = by_id.get(rid)
-                        if c is not None:
-                            c.reply(reply)
-            except Exception as e:  # replay the whole failed batch
-                self.exception = e
-                batch_failures.inc(1, service=self.name)
-                _LOG.warning("serving batch failed, replaying: %s",
-                             traceback.format_exc())
-                for c in batch:
-                    self.server.replay(c)
+            for ver, fn, members in self._transform_groups(batch):
+                self._execute_group(ver, fn, members, batch_seconds,
+                                    batch_failures)
+
+    def _transform_groups(self, batch) -> list[tuple]:
+        """Partition a pulled batch by the version that ADMITTED each
+        request (deploy plane, serving.deploy): a request admitted
+        before a flip completes on the old version even when the
+        executor pulls it after the swap — the drain guarantee.
+        Versionless serving yields the whole batch on ``transform_fn``
+        (the exact pre-deploy-plane path, zero extra work)."""
+        router = getattr(self.server, "version_router", None)
+        if router is None:
+            return [("", self.transform_fn, batch)]
+        by_ver: dict[str, list] = {}
+        for c in batch:
+            by_ver.setdefault(
+                getattr(c, "model_version", "") or "", []).append(c)
+        groups = []
+        for ver, members in by_ver.items():
+            fn = router.transform_for(ver) if ver else None
+            groups.append((ver, fn or self.transform_fn, members))
+        return groups
+
+    def _execute_group(self, ver: str, fn, members,
+                       batch_seconds, batch_failures) -> None:
+        """Run one version's sub-batch through its transform and
+        reply, stamping ``X-Model-Version``. The seeded ``model.bad``
+        fault probes here — at execute time, keyed by version — so a
+        bad build's failure mode (injected 5xx, or corrupted output
+        bytes) is deterministic per seed like worker.death/worker.slow."""
+        act = _inj.apply("model.bad", key=ver) if ver else None
+        if act is not None and act.kind == "error":
+            # a broken build answering errors: every rider sees the
+            # injected status; _finish_request then counts the 5xx
+            # under the rider's tenant, which is what the rollout
+            # controller's burn signal reads
+            for c in members:
+                c.reply(HTTPResponseData(
+                    status_code=act.status or 500,
+                    reason="injected: model.bad",
+                    headers={"X-Model-Version": ver}))
+            return
+        ids = np.empty(len(members), object)
+        reqs = np.empty(len(members), object)
+        ids[:] = [c.id for c in members]
+        reqs[:] = [c.request for c in members]
+        df = DataFrame({"id": ids, "request": reqs})
+        try:
+            # the span roots here (the executor thread has no ambient
+            # context); batch latency also lands in the registry
+            with batch_seconds.time(service=self.name) as bt, \
+                    _tracer.span("serving.batch", parent=None,
+                                 service=self.name, rows=len(members)):
+                out = fn(df)
+            # feed the scheduler's service-time model (EWMA per
+            # padding bucket, stored in the obs registry): this is
+            # what admission's predictive shed and the batcher's
+            # close decision read back
+            self.server.scheduler.estimator.observe(
+                len(members), bt.seconds)
+            self._annotate_batch(members, bt.seconds)
+            if out is not None and "reply" in getattr(
+                    out, "columns", []):
+                corrupt = act is not None and act.kind == "corrupt"
+                by_id = {c.id: c for c in members}
+                for rid, reply in zip(out["id"], out["reply"]):
+                    c = by_id.get(rid)
+                    if c is None:
+                        continue
+                    if corrupt and getattr(reply, "entity", None):
+                        # model.bad `corrupt`: wrong bytes under a
+                        # healthy status — the failure mode shadow
+                        # comparison exists to catch
+                        reply.entity = bytes(
+                            b ^ 0xFF for b in reply.entity)
+                    if ver and isinstance(reply.headers, dict):
+                        reply.headers.setdefault(
+                            "X-Model-Version", ver)
+                    c.reply(reply)
+                self._maybe_shadow(ver, df, out)
+        except Exception as e:  # replay the whole failed group
+            self.exception = e
+            batch_failures.inc(1, service=self.name)
+            _LOG.warning("serving batch failed, replaying: %s",
+                         traceback.format_exc())
+            for c in members:
+                self.server.replay(c)
+
+    def _maybe_shadow(self, ver: str, df, active_out) -> None:
+        """Shadow mode (deploy plane): mirror the active group's frame
+        through the candidate and count divergent response payloads —
+        compared, never returned to a client."""
+        router = getattr(self.server, "version_router", None)
+        pair = router.shadow_pair() if router is not None else None
+        if pair is None or ver != pair[0]:
+            return
+        fn = router.transform_for(pair[1])
+        if fn is None:
+            return
+        s_act = _inj.apply("model.bad", key=pair[1])
+        if s_act is not None and s_act.kind == "error":
+            # a candidate that would answer errors diverges on every
+            # mirrored request
+            router.note_shadow_mismatch(len(df["id"]))
+            return
+        try:
+            shadow_out = fn(df)
+        except Exception:
+            router.note_shadow_mismatch(len(df["id"]))
+            return
+        if s_act is not None and s_act.kind == "corrupt" and \
+                shadow_out is not None and "reply" in getattr(
+                    shadow_out, "columns", []):
+            for reply in shadow_out["reply"]:
+                if getattr(reply, "entity", None):
+                    reply.entity = bytes(
+                        b ^ 0xFF for b in reply.entity)
+        replies = {}
+        if shadow_out is not None and "reply" in getattr(
+                shadow_out, "columns", []):
+            replies = dict(zip(shadow_out["id"], shadow_out["reply"]))
+        mismatches = 0
+        for rid, reply in zip(active_out["id"], active_out["reply"]):
+            shadow = replies.get(rid)
+            if shadow is None or getattr(shadow, "entity", None) != \
+                    getattr(reply, "entity", None):
+                mismatches += 1
+        router.note_shadow_mismatch(mismatches)
 
 
 def serving_query(name: str, transform_fn, host: str = "127.0.0.1",
                   port: int = 0, reply_timeout: float = 30.0,
                   backend: str = "auto", max_queue: int = 0,
                   deadline: float = 0.0,
-                  max_inflight: int = 0, tenancy=None) -> ServingQuery:
+                  max_inflight: int = 0, tenancy=None,
+                  router=None) -> ServingQuery:
     """One-call setup: server + query, started.
 
     ``backend``: ``"auto"`` (the DEFAULT: native when the toolchain
@@ -806,7 +985,13 @@ def serving_query(name: str, transform_fn, host: str = "127.0.0.1",
                 raise
     server = cls(name, host=host, port=port, reply_timeout=reply_timeout,
                  max_queue=max_queue, deadline=deadline,
-                 max_inflight=max_inflight, tenancy=tenancy).start()
+                 max_inflight=max_inflight, tenancy=tenancy)
+    if router is not None:
+        # deploy plane (serving.deploy): versioned routing from the
+        # very first request — admission stamps versions, replies echo
+        # X-Model-Version, flips drain through _finish_request
+        server.attach_router(router)
+    server.start()
     # history plane (obs.timeseries): a served process records its own
     # trajectory — the sentinel's windowed p99 and the /debug/timeline
     # surface need points, not just instantaneous gauges. Idempotent;

@@ -2235,3 +2235,514 @@ def llm_decode_scenario(*, service: str = "llm-decode-bench",
         "steady_state_ok": steady_ok,
         "outputs": {k: [int(t) for t in v] for k, v in outputs.items()},
     }
+
+
+# ------------------------------------------------- zero-downtime deploy
+def rollout_scenario(*, service: str = "rollout-bench", seed: int = 29,
+                     period_s: float = 2.0, periods: int = 2,
+                     max_queue: int = 128, max_batch: int = 8,
+                     worker_max: int = 4,
+                     canary_share: float = 0.25,
+                     stage_at: float = 0.25, flip_at: float = 0.40,
+                     canary_at: float = 0.55,
+                     bad_batches: int = 1,
+                     gold_slo_s: float = 0.6, silver_slo_s: float = 1.2,
+                     burn_windows: dict | None = None,
+                     tick_s: float = 0.05,
+                     max_rollback_ticks: int = 80,
+                     registry=None) -> dict:
+    """Zero-downtime model-lifecycle acceptance (ISSUE 19).
+
+    The mixed-tenant fleet from :func:`mixed_tenant_scenario` — diurnal
+    gold/silver/best-effort load into one tenancy-enabled scheduler,
+    drained by an autoscaled synthetic worker pool with the mesh's
+    lease-replay semantics — while a model update rolls through the
+    deploy plane's full lifecycle:
+
+    1. **Blue/green flip under load.** ``v1`` serves; ``v2`` is
+       registered, warmed and staged beside it, then promoted by ONE
+       :meth:`~mmlspark_tpu.serving.VersionRouter.flip` mid-load while
+       a seeded ``worker.death`` kills a worker holding a lease.
+       Contract: zero non-canary 5xx, zero dropped admitted requests
+       (kill included — the replay path completes them), every request
+       answered **byte-identically by the version that admitted it**
+       (pre-flip admissions complete on draining ``v1``), and
+       ``deploy_draining_inflight`` reaches 0.
+    2. **Seeded-bad canary auto-rollback.** ``v3`` is staged with a
+       canary slice; a seeded ``model.bad`` rule makes it answer
+       injected 500s. Those 500s land on the CANARY tenant's error
+       budget (the router re-tenants the slice), the
+       :class:`~mmlspark_tpu.obs.fleet.BurnRateMonitor` sees the burn,
+       and the :class:`~mmlspark_tpu.serving.RolloutController` rolls
+       back from burn rate alone — within a bounded number of ticks,
+       with zero gold-tier sheds or 5xx (the blast radius IS the
+       slice).
+
+    Runs inside CompileTracker steady state end to end: the deploy
+    plane itself (register/warm/stage/flip/rollback) must never
+    trigger a runtime compile.
+
+    Reproducible by seed: arrivals are precomputed pure functions of
+    the tenant specs; the ``worker.death`` rule fires at a fixed
+    matching-probe count and the ``model.bad`` rule is bounded to
+    ``bad_batches`` firings (probes 1..N always fire) — so two runs
+    realize the identical sorted ``schedule`` even though thread
+    interleaving decides WHICH admissions land in the canary slice.
+    """
+    import queue as _queue
+
+    from ..obs.fleet import BurnRateMonitor
+    from ..obs.metrics import registry as _default
+    from ..obs.profile import compile_tracker
+    from ..resilience import FaultRule, WorkerKilled, faults
+    from ..resilience.faults import injector as _inj
+    from ..sched import RequestScheduler, Shed, Tenancy, TenantQuota
+    from ..serving.autoscale import Autoscaler, AutoscaleConfig
+    from ..serving.deploy import (ModelRegistry, RolloutConfig,
+                                  RolloutController, VersionRouter)
+
+    reg = registry if registry is not None else _default
+    duration_s = period_s * periods
+    tenancy = Tenancy(
+        service,
+        quotas={
+            "cognitive": TenantQuota(tier="gold"),
+            "lightgbm": TenantQuota(tier="silver"),
+            "generate": TenantQuota(tier="best_effort", rate=30.0,
+                                    burst=10.0, queue_share=0.25),
+            # the canary slice's OWN budget bucket: injected 5xx burn
+            # here, never on the gold tier the request arrived under
+            "canary": TenantQuota(tier="silver"),
+        },
+        tier_deadlines={"gold": gold_slo_s, "silver": silver_slo_s},
+        registry=reg)
+    sched = RequestScheduler(
+        service, max_queue=max_queue, tenancy=tenancy, registry=reg,
+        on_shed=lambda item, reason, retry_after: item.reply(429))
+    sched.estimator.observe(1, 0.004)
+    m_t5 = reg.counter(
+        "serving_tenant_requests_total",
+        "requests answered, by service/tenant/status code")
+
+    # -- the deploy plane ----------------------------------------------
+    def _make_model(name: str):
+        def fn(payload: bytes) -> bytes:
+            return name.encode() + b":" + payload
+        return fn
+
+    mreg = ModelRegistry(service=service, registry=reg)
+    router = VersionRouter(mreg, service=service, canary_tenant="canary",
+                           metrics=reg)
+    mreg.register("v1", transform=_make_model("v1"))
+    router.set_active("v1")
+
+    monitor = BurnRateMonitor(
+        registry=reg, service=service,
+        windows=dict(burn_windows) if burn_windows
+        else {"fast": 0.5, "slow": 1.5},
+        budget_for=tenancy.error_budget_for)
+    ctl = RolloutController(
+        router, burn=monitor, metrics=reg,
+        config=RolloutConfig(interval=tick_s, burn_threshold=2.0,
+                             slow_threshold=1.0, rollback_windows=2,
+                             promote_windows=10 ** 6, cooldown=1.0,
+                             flap_s=1.0))
+
+    class _DeployRequest(_SynthRequest):
+        """Carries the admission-stamped version and releases its
+        router inflight slot on the first terminal reply — the same
+        exactly-once contract ``_finish_request`` wires for real
+        serving (the scheduler owns ``on_done`` for admission
+        accounting, so the release can't ride there)."""
+
+        __slots__ = ("version", "assigned_tenant", "payload", "result")
+
+        def __init__(self):
+            super().__init__()
+            self.version = ""
+            self.assigned_tenant = ""
+            self.payload = b""
+            self.result = None
+
+        def reply(self, status):
+            first = super().reply(status)
+            if first and self.version:
+                router.release(self.version)
+            return first
+
+    class _Worker:
+        __slots__ = ("thread", "stop", "draining", "killed", "busy_s",
+                     "items", "started", "ended")
+
+        def __init__(self):
+            self.thread = None
+            self.stop = threading.Event()
+            self.draining = False
+            self.killed = False
+            self.busy_s = 0.0
+            self.items = 0
+            self.started = time.monotonic()
+            self.ended = None
+
+    class _Pool:
+        """mixed_tenant_scenario's lease-replay pool, version-aware:
+        the executor groups each batch by the version stamped at
+        admission (the serving executor's ``_transform_groups``
+        contract) and probes ``model.bad`` once per version group."""
+
+        def __init__(self):
+            self._lock = threading.Lock()
+            self.workers: dict[str, _Worker] = {}
+            self.leases: dict[str, list] = {}
+            self.replays = 0
+            self._seq = 0
+
+        def count(self):
+            with self._lock:
+                return sum(1 for w in self.workers.values()
+                           if w.thread.is_alive() and not w.draining
+                           and not w.killed)
+
+        def scale_up(self):
+            with self._lock:
+                wid = f"w{self._seq}"
+                self._seq += 1
+                w = _Worker()
+                w.thread = threading.Thread(
+                    target=self._run, args=(wid, w), daemon=True)
+                self.workers[wid] = w
+                w.thread.start()
+            return wid
+
+        def scale_down(self):
+            with self._lock:
+                live = [(w.started, wid) for wid, w in
+                        self.workers.items()
+                        if w.thread.is_alive() and not w.draining
+                        and not w.killed]
+                if not live:
+                    return None
+                _, wid = max(live)
+                self.workers[wid].draining = True
+                self.workers[wid].stop.set()
+            return wid
+
+        def _run(self, wid, w):
+            try:
+                while not w.stop.is_set():
+                    batch = sched.next_batch(max_batch=max_batch,
+                                             max_wait=0.05)
+                    if not batch:
+                        continue
+                    with self._lock:
+                        self.leases[wid] = batch
+                    _inj.apply("worker.death", key=wid)
+                    _inj.apply("worker.slow", key=wid)
+                    cost = sum(i.cost for i in batch) \
+                        * _inj.degradation(wid)
+                    time.sleep(cost)
+                    w.busy_s += cost
+                    w.items += len(batch)
+                    sched.estimator.observe(len(batch), cost)
+                    groups: dict[str, list] = {}
+                    for item in batch:
+                        groups.setdefault(item.version, []).append(item)
+                    for ver, members in groups.items():
+                        act = _inj.apply("model.bad", key=ver) \
+                            if ver else None
+                        if act is not None and act.kind == "error":
+                            for item in members:
+                                # mirror _finish_request's per-tenant
+                                # status counting: the burn monitor
+                                # reads 5xx from this family
+                                m_t5.inc(1, service=service,
+                                         tenant=item.assigned_tenant,
+                                         code=str(act.status))
+                                item.reply(act.status)
+                            continue
+                        fn = router.transform_for(ver)
+                        for item in members:
+                            out = fn(item.payload) if fn is not None \
+                                else bytes(item.payload)
+                            if act is not None and act.kind == "corrupt":
+                                out = bytes(b ^ 0xFF for b in out)
+                            item.result = out
+                            tenancy.observe_latency(
+                                item.assigned_tenant,
+                                time.monotonic() - item.submitted)
+                            item.reply(200)
+                    with self._lock:
+                        self.leases.pop(wid, None)
+            except WorkerKilled:
+                w.killed = True
+            finally:
+                w.ended = time.monotonic()
+
+        def monitor(self, stop_ev):
+            while not stop_ev.wait(0.05):
+                dead = []
+                with self._lock:
+                    for wid, w in self.workers.items():
+                        if wid in self.leases and (
+                                w.killed or not w.thread.is_alive()):
+                            dead.append((wid, self.leases.pop(wid)))
+                for wid, batch in dead:
+                    for item in batch:
+                        if item._event.is_set():
+                            continue
+                        self.replays += 1
+                        try:
+                            sched.put_front(item)
+                        except _queue.Full:
+                            item.reply(503)
+
+        def stop(self):
+            with self._lock:
+                ws = list(self.workers.values())
+            for w in ws:
+                w.stop.set()
+            sched.wake()
+            for w in ws:
+                w.thread.join(timeout=5)
+                if w.ended is None:
+                    w.ended = time.monotonic()
+
+    pool = _Pool()
+    auto = Autoscaler(
+        service, pool,
+        AutoscaleConfig(min_workers=2, max_workers=worker_max,
+                        interval=0.1, queue_high=6.0, queue_low=1.5,
+                        slo_high=0.8, slo_low=0.4, up_stable=2,
+                        down_stable=5, cooldown=0.6),
+        registry=reg, tenancy=tenancy,
+        item_seconds=sched.estimator.item_seconds)
+
+    rules = [
+        # the flip-under-chaos worker: killed mid-lease once it is
+        # deep into the run (~the flip window, at this fleet's batch
+        # rate) — the replayed batch must still complete on whatever
+        # version each request was ADMITTED under
+        FaultRule(point="worker.death", kind="kill", match="w1",
+                  after=60, times=1),
+        # a persistently sick first worker: builds the queue pressure
+        # that makes the autoscaler spawn w1 (same dynamics as
+        # mixed_tenant_scenario, which this fleet is)
+        FaultRule(point="worker.slow", kind="slow", match="w0",
+                  after=3, times=1, factor=3.0),
+        # the bad canary: v3 answers injected 500s. Bounded to
+        # bad_batches firings so the realized schedule is identical
+        # across same-seed runs (probes 1..N always fire; batching
+        # jitter only moves WHEN probe N happens, never whether —
+        # and one bad batch keeps the fast burn window hot long
+        # enough for the rollback streak, so the default is 1)
+        FaultRule(point="model.bad", kind="error", match="v3",
+                  status=500, times=bad_batches),
+    ]
+
+    class _TenantResult:
+        __slots__ = ("requests", "intake_sheds")
+
+        def __init__(self):
+            self.requests = []
+            self.intake_sheds = {}   # {(assigned_tenant, reason): n}
+
+    results = {name: _TenantResult() for name in MIXED_TENANTS}
+    arrivals = {name: _arrival_schedule(spec, period_s, duration_s)
+                for name, spec in MIXED_TENANTS.items()}
+    samples: list[tuple[float, int]] = []
+    deploy_log: list[tuple] = []
+    staged_v3 = threading.Event()
+    stop_all = threading.Event()
+    t0 = time.monotonic()
+
+    def load(name, spec, res):
+        for i, t_rel in enumerate(arrivals[name]):
+            wait = (t0 + t_rel) - time.monotonic()
+            if wait > 0:
+                time.sleep(wait)
+            req = _DeployRequest()
+            req.cost = spec["cost_s"]
+            req.payload = f"{name}/{i}".encode()
+            # admission-time routing: the version is stamped BEFORE the
+            # scheduler sees the request (ServingServer._admit order),
+            # and a canary pick re-tenants it onto the canary budget
+            ver, override = router.assign(name)
+            req.version = ver
+            req.assigned_tenant = override or name
+            try:
+                sched.submit(req, tenant=req.assigned_tenant)
+                res.requests.append(req)
+            except Shed as s:
+                router.release(ver)   # never admitted: undo the slot
+                k = (req.assigned_tenant, s.reason)
+                res.intake_sheds[k] = res.intake_sheds.get(k, 0) + 1
+
+    def sampler():
+        while not stop_all.wait(0.05):
+            samples.append((time.monotonic() - t0, pool.count()))
+
+    def driver():
+        # phase 1: blue/green — build v2 beside v1, stage, one flip
+        _sleep_until(t0 + stage_at * duration_s)
+        mreg.register("v2", transform=_make_model("v2"))
+        try:
+            mreg.warm("v2")      # AOT warm standby (no-op for synth fns)
+        except Exception:
+            pass
+        router.stage("v2")
+        deploy_log.append(("stage", "v2",
+                           round(time.monotonic() - t0, 3)))
+        _sleep_until(t0 + flip_at * duration_s)
+        router.flip()
+        deploy_log.append(("flip", "v2",
+                           round(time.monotonic() - t0, 3)))
+        # phase 2: canary v3 — the seeded model.bad rule makes it burn
+        _sleep_until(t0 + canary_at * duration_s)
+        mreg.register("v3", transform=_make_model("v3"))
+        router.stage("v3", canary_share=canary_share)
+        deploy_log.append(("stage", "v3",
+                           round(time.monotonic() - t0, 3)))
+        staged_v3.set()
+
+    def _sleep_until(t):
+        d = t - time.monotonic()
+        if d > 0:
+            time.sleep(d)
+
+    compile_tracker.mark_steady()
+    try:
+        with faults(seed, rules, inj=_inj) as inj:
+            auto.start()
+            mon = threading.Thread(target=pool.monitor,
+                                   args=(stop_all,), daemon=True)
+            mon.start()
+            smp = threading.Thread(target=sampler, daemon=True)
+            smp.start()
+            drv = threading.Thread(target=driver, daemon=True)
+            drv.start()
+            loaders = [threading.Thread(target=load,
+                                        args=(n, s, results[n]),
+                                        daemon=True)
+                       for n, s in MIXED_TENANTS.items()]
+            for th in loaders:
+                th.start()
+
+            # the control loop: tick until the bad canary is rolled
+            # back (bounded) and the offered load has ended
+            rollback_ticks = None
+            ticks_after_stage = 0
+            while True:
+                time.sleep(tick_s)
+                r = ctl.tick()
+                if staged_v3.is_set() and rollback_ticks is None:
+                    ticks_after_stage += 1
+                    if r == "rollback":
+                        rollback_ticks = ticks_after_stage
+                    elif ticks_after_stage > max_rollback_ticks:
+                        break    # bounded: give up, report not rolled
+                if not any(th.is_alive() for th in loaders) and (
+                        rollback_ticks is not None
+                        or not staged_v3.is_set()
+                        or ticks_after_stage > max_rollback_ticks):
+                    break
+            for th in loaders:
+                th.join(timeout=duration_s + 30)
+            drv.join(timeout=duration_s + 30)
+            # drain: every admitted request reaches a terminal state
+            # and every flipped-away version empties
+            drain_end = time.monotonic() + 10.0
+            while time.monotonic() < drain_end:
+                if sched.qsize() == 0 and not pool.leases \
+                        and router.draining_inflight() == 0:
+                    break
+                time.sleep(0.05)
+            draining_final = router.draining_inflight()
+            schedule = inj.schedule()
+            stop_all.set()
+            auto.stop()
+            pool.stop()
+            mon.join(timeout=5)
+            smp.join(timeout=5)
+        runtime_compiles = compile_tracker.runtime_compiles()
+    finally:
+        compile_tracker.unmark_steady()
+
+    # -- per-ASSIGNED-tenant outcomes ----------------------------------
+    per_tenant: dict = {}
+    mismatches = 0
+    total_unanswered = 0
+    for name, res in results.items():
+        for req in res.requests:
+            bucket = per_tenant.setdefault(
+                req.assigned_tenant,
+                {"answered_200": 0, "status_5xx": 0, "expired": 0,
+                 "unanswered": 0, "sheds": {}, "lat": []})
+            if req.status == 200:
+                bucket["answered_200"] += 1
+                if req.done_at is not None:
+                    bucket["lat"].append(req.done_at - req.submitted)
+                expected = req.version.encode() + b":" + req.payload
+                if req.result != expected:
+                    mismatches += 1
+            elif req.status is not None and req.status >= 500:
+                bucket["status_5xx"] += 1
+            elif req.status == 429:
+                bucket["expired"] += 1
+            elif req.status is None:
+                bucket["unanswered"] += 1
+                total_unanswered += 1
+        for (assigned, reason), n in res.intake_sheds.items():
+            bucket = per_tenant.setdefault(
+                assigned,
+                {"answered_200": 0, "status_5xx": 0, "expired": 0,
+                 "unanswered": 0, "sheds": {}, "lat": []})
+            bucket["sheds"][reason] = bucket["sheds"].get(reason, 0) + n
+    for name, b in per_tenant.items():
+        lat = sorted(b.pop("lat"))
+        b["p50_s"] = _pctl(lat, 0.50)
+        b["p99_s"] = _pctl(lat, 0.99)
+        b["shed_total"] = sum(b["sheds"].values()) + b["expired"]
+
+    gold = per_tenant.get("cognitive", {})
+    canary = per_tenant.get("canary", {})
+    non_canary_5xx = sum(b["status_5xx"] for t, b in per_tenant.items()
+                         if t != "canary")
+    gold_sheds = gold.get("shed_total", 0)
+    rollbacks = [e for e in ctl.events if e["kind"] == "rollback"]
+    peak = max((c for _, c in samples), default=0)
+    return {
+        "seed": seed,
+        "service": service,
+        "duration_s": duration_s,
+        "per_tenant": per_tenant,
+        "deploy_log": deploy_log,
+        # phase 1 contract: the flip is invisible to clients
+        "non_canary_5xx": non_canary_5xx,
+        "rollout_zero_5xx": bool(non_canary_5xx == 0),
+        "unanswered": total_unanswered,
+        "drained_completed": bool(total_unanswered == 0),
+        "version_mismatches": mismatches,
+        "byte_identical": bool(mismatches == 0),
+        "draining_inflight_final": draining_final,
+        "drained_to_zero": bool(draining_final == 0),
+        "runtime_compiles": int(runtime_compiles),
+        "zero_runtime_compiles": bool(runtime_compiles == 0),
+        "worker_killed": any(p == "worker.death"
+                             for p, *_ in schedule),
+        "lease_replays": pool.replays,
+        # phase 2 contract: burn-rate rollback, bounded, sliced blast
+        "rollback_ticks": rollback_ticks,
+        "rolled_back": bool(rollback_ticks is not None),
+        "rollback_reason": rollbacks[-1]["reason"] if rollbacks
+        else None,
+        "active_after": router.active,
+        "candidate_after": router.candidate,
+        "canary_5xx": canary.get("status_5xx", 0),
+        "canary_gold_sheds": gold_sheds,
+        "gold_5xx": gold.get("status_5xx", 0),
+        "gold_unharmed": bool(gold_sheds == 0
+                              and gold.get("status_5xx", 0) == 0),
+        "workers_peak": peak,
+        "autoscaled": bool(peak >= 2),
+        "schedule": sorted(schedule),
+    }

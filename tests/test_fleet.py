@@ -774,7 +774,9 @@ class TestFlightRecorderMultiSource:
         trace trees missing their ingest-side spans)."""
         from mmlspark_tpu.obs.export import flight_recorder
         from mmlspark_tpu.serving.distributed import _worker_fleet_payload
-        flight_recorder.pending_spans(drain=True)  # isolate
+        # isolate: drain is bounded per call, loop until actually empty
+        while flight_recorder.pending_spans(drain=True):
+            pass
         try:
             flight_recorder.ingest([_span(0, "t-live", "s-live")])
             pl = _worker_fleet_payload("w-thread", "", False)
