@@ -41,7 +41,10 @@ import time
 import traceback
 
 A100_IMAGES_PER_SEC = 2500.0    # bf16 ResNet-50 inference, batch ~128
-V5E_PEAK_BF16_FLOPS = 197e12    # per-chip peak, TPU v5e
+# per-chip bf16 peak from the shared PeakSpec table (obs.attribution) —
+# env-overridable via MMLSPARK_TPU_PEAK_FLOPS, same as StepProfiler MFU
+from mmlspark_tpu.obs.attribution import peak_spec as _peak_spec
+V5E_PEAK_BF16_FLOPS = _peak_spec("tpu-v5e").peak_flops
 RESNET50_FLOPS_PER_IMAGE = 4.09e9   # fallback if XLA cost analysis absent
 GBDT_BASELINE_ROW_ITERS = 20e6  # upstream LightGBM Higgs rows×iters/sec
 SERVING_TARGET_MS = 1.0
@@ -293,14 +296,10 @@ def _mfu_sweep(module, variables, make_input, batches, *, iters=20,
                 # cost-analysis MFU by ~40% uncounted kernel flops)
                 flops_per_batch = fallback_flops_per_item * batch
             else:
-                try:
-                    cost = compiled.cost_analysis()
-                    if isinstance(cost, (list, tuple)):
-                        cost = cost[0]
-                    flops_per_batch = float(cost.get("flops", 0.0)) or \
-                        fallback_flops_per_item * batch
-                except Exception:
-                    flops_per_batch = fallback_flops_per_item * batch
+                from mmlspark_tpu.parallel.compat import cost_analysis
+                cost = cost_analysis(compiled)
+                flops_per_batch = (cost["flops"] if cost else 0.0) or \
+                    fallback_flops_per_item * batch
             compiled(x).block_until_ready()
             for _ in range(3):
                 compiled(x).block_until_ready()
@@ -519,14 +518,10 @@ def bench_train(extras: dict) -> None:
             # ONE compile per point (AOT), serving cost analysis too
             compiled = step.lower(state, x, y).compile()
             if not flops_per_image:  # any successful point serves it
-                try:
-                    cost = compiled.cost_analysis()
-                    if isinstance(cost, (list, tuple)):
-                        cost = cost[0]
-                    flops_per_image = \
-                        float(cost.get("flops", 0.0)) / batch
-                except Exception:
-                    flops_per_image = 0.0
+                from mmlspark_tpu.parallel.compat import cost_analysis
+                cost = cost_analysis(compiled)
+                flops_per_image = \
+                    (cost["flops"] if cost else 0.0) / batch
             state, loss = compiled(state, x, y)   # warm
             jax.block_until_ready(loss)
 
@@ -1482,6 +1477,38 @@ def bench_deploy(extras: dict) -> None:
         r["schedule"] == r2["schedule"] and r["schedule"])
 
 
+def bench_attribution(extras: dict) -> None:
+    """Cost-attribution acceptance (ISSUE 20). Banks the scenario's
+    contract surface: per-program roofline placement off real compiled
+    programs (the matmul reads compute-bound, the wide add
+    memory-bound, every utilization share <= 1.0), the fleet
+    ``goodput_ratio`` under seeded chaos with the waste taxonomy
+    itemized and the per-tick trace reproducible by seed, and the
+    cost model's v6 analytic columns at least matching the v5
+    baseline on held-out MAE."""
+    from mmlspark_tpu.testing.benchmarks import attribution_scenario
+
+    r = attribution_scenario(seed=29)
+    r2 = attribution_scenario(seed=29)
+    extras["attr_rooflines"] = r["rooflines"]
+    extras["attr_matmul_compute_bound"] = bool(
+        r["matmul_compute_bound"])
+    extras["attr_add_memory_bound"] = bool(r["add_memory_bound"])
+    extras["attr_utilization_max"] = round(
+        float(r["utilization_max"]), 6)
+    extras["attr_utilization_bounded"] = bool(
+        r["utilization_max"] <= 1.05)
+    extras["goodput_ratio"] = round(float(r["goodput_ratio"]), 6)
+    extras["goodput_waste_seconds"] = r["goodput_waste_seconds"]
+    extras["goodput_waste_itemized"] = bool(r["goodput_waste_itemized"])
+    extras["goodput_schedule_reproducible"] = bool(
+        r["goodput_ratio_trace"] == r2["goodput_ratio_trace"]
+        and r["goodput_ratio_trace"])
+    extras["costmodel_v6_mae_ms"] = round(float(r["v6_mae_ms"]), 4)
+    extras["costmodel_v5_mae_ms"] = round(float(r["v5_mae_ms"]), 4)
+    extras["costmodel_v6_no_worse"] = bool(r["v6_no_worse"])
+
+
 def bench_serving(extras: dict) -> None:
     """End-to-end HTTP request→jitted pipeline→response latency against
     the reference's ~1 ms continuous-mode figure."""
@@ -2311,6 +2338,11 @@ def main():
             # blue/green flip + seeded-bad-canary rollback across the
             # synthetic fleet (host-side only: tunnel-immune)
             _watchdog(bench_deploy, extras, "deploy", 240.0)
+        if want("attribution"):
+            # roofline placement + goodput ledger + v6 cost-model value
+            # (compiles two tiny programs on the acquired backend; the
+            # rest is host-side)
+            _watchdog(bench_attribution, extras, "attribution", 240.0)
         if want("serving"):
             # includes a small GBDT fit for the real-model row
             _watchdog(bench_serving, extras, "serving", 360.0)

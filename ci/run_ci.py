@@ -75,6 +75,9 @@ PACKAGES: dict[str, list[str]] = {
     # zero-downtime model lifecycle: versioned registry + blue/green
     # router + canary burn-rate rollback, and the rollout acceptance
     "deploy": ["test_deploy.py"],
+    # device cost-attribution plane: PeakSpec/rooflines, AOT cost
+    # persistence, goodput ledger, xprof capture surface, schema v6
+    "attribution": ["test_attribution.py"],
 }
 
 # traceable-count ratchet (ISSUE 10): the analysis gate fails if the
@@ -117,6 +120,26 @@ def style() -> int:
         "assert flight_recorder.tree(sp.trace_id) is not None; "
         "assert chrome_trace([sp])['traceEvents']; "
         "feature_log.record(service='ci', route='/', batch=1); "
+        # the cost-attribution plane rides along: PeakSpec resolution,
+        # a roofline record, a goodput ledger tick, and the xprof
+        # capture surface must all answer jax-free — a capture request
+        # degrades to 503-with-reason, it NEVER imports jax
+        "from mmlspark_tpu.obs.attribution import (CostAttribution, "
+        "peak_spec); "
+        "from mmlspark_tpu.obs.goodput import GoodputLedger; "
+        "from mmlspark_tpu.obs.xprof import XprofCaptures; "
+        "from mmlspark_tpu.obs.metrics import MetricsRegistry; "
+        "assert peak_spec().platform == 'cpu'; "
+        "ca = CostAttribution(registry=MetricsRegistry()); "
+        "assert ca.record_program('ci', 1e9, 1e3, "
+        "platform='cpu')['bound'] == 'compute'; "
+        "led = GoodputLedger(registry=MetricsRegistry()); "
+        "assert led.tick()['goodput_ratio'] == 1.0; "
+        "assert led.tick()['ticks'] == 2; "
+        "xc = XprofCaptures(root='/tmp/mmlspark_tpu_ci_xprof', "
+        "registry=MetricsRegistry()); "
+        "status, body = xc.handle_query('duration_ms=10', b''); "
+        "assert status == 503 and b'reason' in body, (status, body); "
         "assert 'jax' not in sys.modules, 'obs data plane pulled jax'; "
         "print('obs import OK (no jax)')")
     rc = _run([sys.executable, "-c", smoke],

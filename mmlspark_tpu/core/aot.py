@@ -657,6 +657,16 @@ class AotStore:
         except Exception:
             hlo = None
         from ..parallel import compat
+        # analytic cost attribution (obs.attribution, ISSUE 20): every
+        # built program carries its cost_analysis flops/bytes in
+        # meta.json, and exports its roofline placement now — warm
+        # loads re-export from the persisted pair without re-analyzing
+        cost = compat.cost_analysis(compiled)
+        if cost is not None:
+            from ..obs.attribution import cost_attribution
+            cost_attribution.record_program(
+                segment.name, cost["flops"], cost["bytes"],
+                service=segment.name.split(":", 1)[0])
         blob = None
         if compat.aot_serialization_available():
             try:
@@ -679,7 +689,9 @@ class AotStore:
                                   "versions":
                                       specs["static_key"]["versions"],
                                   "platform":
-                                      specs["static_key"]["platform"]},
+                                      specs["static_key"]["platform"],
+                                  **({"cost": cost} if cost is not None
+                                     else {})},
                       blob=blob, hlo_text=hlo)
             if backfill:
                 self._m["backfill"].inc(1, segment=segment.name)
@@ -732,6 +744,18 @@ class AotStore:
                         segment.name, exc_info=True)
                 segment._exes[sig] = exe
                 n += 1
+                # re-export the entry's persisted analytic cost (no
+                # re-analysis — a deserialized Compiled may not even
+                # support cost_analysis): warmed processes report the
+                # same roofline gauges the builder did
+                cost = meta.get("cost")
+                if isinstance(cost, dict):
+                    from ..obs.attribution import cost_attribution
+                    cost_attribution.record_program(
+                        segment.name,
+                        cost.get("flops", 0.0), cost.get("bytes", 0.0),
+                        service=segment.name.split(":", 1)[0],
+                        platform=meta.get("platform") or None)
         return n
 
     def stats(self) -> dict:

@@ -364,7 +364,31 @@ class CompiledPipeline:
             # scrapeable as mem_event_watermark_bytes{event="aot_warm"}
             from ..obs.memory import memory_profiler
             memory_profiler.note_event("aot_warm")
+        self.attribute_costs()
         return loaded
+
+    def attribute_costs(self) -> int:
+        """Export the roofline placement of every RESIDENT executable
+        (obs.attribution): store-warmed entries already re-exported
+        their persisted meta.json pair, so this pass covers what they
+        cannot — runtime-backfilled buckets and live Compiled objects
+        whose analysis never hit disk. Programs a backend refuses to
+        analyze are counted (``profile_cost_analysis_missing_total``),
+        never raised. Returns programs attributed."""
+        from ..obs.attribution import cost_attribution
+        n = 0
+        for item in self.plan:
+            if not isinstance(item, FusedSegment):
+                continue
+            for exe in item._exes.values():
+                if exe is None:
+                    continue
+                if cost_attribution.record_compiled(
+                        item.name, exe,
+                        service=item.name.split(":", 1)[0]) is not None:
+                    n += 1
+                    break  # one bucket prices the segment's program
+        return n
 
     # -- execution ---------------------------------------------------------
     def transform(self, df: DataFrame) -> DataFrame:

@@ -39,6 +39,11 @@ from .fleet import (BurnRateMonitor, FleetAggregator, FleetHealth,
                     straggler_workers)
 from .regression import (CusumDetector, RegressionSentinel, compare_benches,
                          sentinel)
+from .attribution import (PEAK_SPECS, CostAttribution, PeakSpec,
+                          cost_attribution, peak_spec)
+from .goodput import (WASTE_CAUSES, GoodputLedger, goodput_ledger,
+                      goodput_payload)
+from .xprof import XprofCaptures, xprof_captures
 
 __all__ = ["registry", "tracer", "MetricsRegistry", "Counter", "Gauge",
            "Histogram", "Tracer", "Span", "StageTimer", "wall_now",
@@ -57,4 +62,9 @@ __all__ = ["registry", "tracer", "MetricsRegistry", "Counter", "Gauge",
            "TimeSeriesStore", "Recorder", "timeseries_store", "recorder",
            "timeline_payload",
            "CusumDetector", "RegressionSentinel", "compare_benches",
-           "sentinel"]
+           "sentinel",
+           "PeakSpec", "PEAK_SPECS", "CostAttribution", "peak_spec",
+           "cost_attribution",
+           "GoodputLedger", "WASTE_CAUSES", "goodput_ledger",
+           "goodput_payload",
+           "XprofCaptures", "xprof_captures"]

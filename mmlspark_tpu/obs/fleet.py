@@ -73,7 +73,7 @@ __all__ = [
 #: twice; the ingest already observed the request side).
 FEDERATED_PREFIXES = (
     "profile_", "collective_", "mem_", "sched_", "serving_", "aot_",
-    "kv_", "gen_", "deploy_",
+    "kv_", "gen_", "deploy_", "goodput_",
 )
 
 

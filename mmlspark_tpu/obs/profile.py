@@ -43,12 +43,16 @@ import sys
 import threading
 import time
 
+from .attribution import PEAK_SPECS, peak_spec
 from .metrics import registry as _registry
 from .tracing import tracer as _tracer, wall_now
 
-# per-chip peak used by the MFU gauge when the caller does not override
-# it (bench.py's V5E_PEAK_BF16_FLOPS; TPU v5e bf16)
-DEFAULT_PEAK_FLOPS = 197e12
+# kept importable for callers that pinned against the old constant —
+# but it is now the v5e row of the shared PeakSpec table
+# (obs.attribution), not a free-floating literal. The MFU gauge itself
+# resolves the LIVE platform's peak per call unless explicitly
+# overridden.
+DEFAULT_PEAK_FLOPS = PEAK_SPECS["tpu-v5e"].peak_flops
 
 
 class CompileTracker:
@@ -315,10 +319,13 @@ class StepProfiler:
     """
 
     def __init__(self, service: str = "", registry=None, tracer=None,
-                 peak_flops: float = DEFAULT_PEAK_FLOPS):
+                 peak_flops: float | None = None):
         reg = registry if registry is not None else _registry
         self.service = service
-        self.peak_flops = float(peak_flops)
+        # None (default) = resolve the live platform's PeakSpec per
+        # call — the platform may only initialize after construction
+        self.peak_flops = None if peak_flops is None \
+            else float(peak_flops)
         self._tracer = tracer if tracer is not None else _tracer
         self._h_step = reg.histogram(
             "profile_step_seconds",
@@ -387,13 +394,21 @@ class StepProfiler:
                    seconds: float) -> float:
         """Set the always-on MFU gauge from an externally measured
         (flops, seconds) pair — bench.py's sweep and the step context
-        both land here."""
-        mfu = float(flops) / max(float(seconds), 1e-12) / self.peak_flops
+        both land here. The peak divided by is the resolved PeakSpec's
+        (env-overridable; obs.attribution) unless the profiler was
+        built with an explicit ``peak_flops``, and the gauge carries
+        the platform it was computed against."""
+        if self.peak_flops is not None:
+            peak, platform = self.peak_flops, device_platform()
+        else:
+            spec = peak_spec()
+            peak, platform = spec.peak_flops, spec.platform
+        mfu = float(flops) / max(float(seconds), 1e-12) / peak
+        labels = {"stage": stage, "platform": platform}
         pl = process_label()
         if pl is not None:
-            self._g_mfu.set(mfu, stage=stage, process=pl)
-        else:
-            self._g_mfu.set(mfu, stage=stage)
+            labels["process"] = pl
+        self._g_mfu.set(mfu, **labels)
         return mfu
 
 
@@ -415,11 +430,15 @@ step_profiler = StepProfiler()
 #: rows simply omit them. v5 (ISSUE 18) adds ``context_blocks`` (KV
 #: blocks resident at completion) so decode-step time is priced by
 #: resident context, not just batch — the paged-attention kernel's
-#: cost scales with the chain length it streams. Consumers
-#: (``perf.costmodel``) accept v5 through v2 rows and SKIP anything
-#: else, loudly, instead of misparsing old logs; fields absent in old
-#: rows train as 0.
-FEATURE_SCHEMA_VERSION = 5
+#: cost scales with the chain length it streams. v6 (ISSUE 20) adds
+#: the analytic-cost pair ``analytic_flops`` / ``analytic_bytes``
+#: (XLA ``cost_analysis`` totals for the service's compiled programs,
+#: from ``obs.attribution``) so the model can price requests by the
+#: device work they actually dispatch, not just by shape proxies.
+#: Consumers (``perf.costmodel``) accept v6 through v2 rows and SKIP
+#: anything else, loudly, instead of misparsing old logs; fields
+#: absent in old rows train as 0.
+FEATURE_SCHEMA_VERSION = 6
 
 _platform_cache: str | None = None
 

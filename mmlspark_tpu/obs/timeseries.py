@@ -59,7 +59,7 @@ __all__ = [
 #: families the fleet plane federates, plus the SLO burn series.
 DEFAULT_RECORD_PREFIXES = (
     "profile_", "sched_", "serving_", "mem_", "fleet_", "aot_", "slo_",
-    "kv_", "gen_", "deploy_",
+    "kv_", "gen_", "deploy_", "goodput_",
 )
 
 #: /debug/timeline response bounds: series per response, points per

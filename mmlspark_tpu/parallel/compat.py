@@ -24,6 +24,15 @@ _m_demoted = _obs.counter(
     "matched specs demoted to fewer axes because a dim does not divide "
     "the mesh axis, by axis")
 
+# cost_analysis() returns None, a list, or partial dicts depending on
+# backend/version — every consumer goes through cost_analysis() below,
+# and a backend that yields nothing usable is counted here, never
+# silently treated as free
+_m_cost_missing = _obs.counter(
+    "profile_cost_analysis_missing_total",
+    "compiled-program cost_analysis() reads that yielded nothing "
+    "usable, by reason (error | empty | zero)")
+
 
 def shard_map(f, *, mesh, in_specs, out_specs, check_vma=None):
     """``jax.shard_map`` on new JAX, ``jax.experimental.shard_map`` on
@@ -60,6 +69,40 @@ def jit(fn=None, *, name: str | None = None, **jit_kwargs):
     rest of this module's surface."""
     from ..obs.profile import compile_tracker
     return compile_tracker.jit(fn, name=name, **jit_kwargs)
+
+
+def cost_analysis(compiled) -> dict | None:
+    """Normalized XLA analytic cost for a ``jax.stages.Compiled``:
+    ``{"flops": float, "bytes": float}`` or None.
+
+    ``Compiled.cost_analysis()`` is backend- and version-dependent: it
+    can raise, return None, wrap the dict in a single-element list, or
+    omit keys ("bytes accessed" is the HBM-traffic key when present).
+    This is THE in-repo call site shape — consumers (the AOT store,
+    LLM warm paths, bench harnesses) never touch the raw API, and a
+    read that yields nothing usable is counted in
+    ``profile_cost_analysis_missing_total`` instead of being silently
+    treated as a free program."""
+    try:
+        cost = compiled.cost_analysis()
+    except Exception:
+        _m_cost_missing.inc(1, reason="error")
+        return None
+    if isinstance(cost, (list, tuple)):
+        cost = cost[0] if cost else None
+    if not isinstance(cost, dict) or not cost:
+        _m_cost_missing.inc(1, reason="empty")
+        return None
+    try:
+        flops = float(cost.get("flops", 0.0) or 0.0)
+        bytes_ = float(cost.get("bytes accessed", 0.0) or 0.0)
+    except (TypeError, ValueError):
+        _m_cost_missing.inc(1, reason="empty")
+        return None
+    if flops <= 0.0 and bytes_ <= 0.0:
+        _m_cost_missing.inc(1, reason="zero")
+        return None
+    return {"flops": flops, "bytes": bytes_}
 
 
 def aot_serialization_available() -> bool:

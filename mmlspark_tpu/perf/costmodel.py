@@ -75,15 +75,19 @@ DEFAULT_PERF_ROOT = "/tmp/mmlspark_tpu_perf-" + str(
 #: length the paged-attention kernel streams per step) for services
 #: that record them.
 FEATURES = ("bucket", "batch", "entity_kb", "queue_depth",
-            "decode_steps", "prefill_tokens", "context_blocks")
+            "decode_steps", "prefill_tokens", "context_blocks",
+            "analytic_tflops", "analytic_gb")
 
 #: Row schemas this model can consume. v3 (the fleet PR) added only the
 #: ``process`` rank stamp, v4 only the OPTIONAL generation fields
-#: (``decode_steps``/``prefill_tokens`` default to 0 when absent), and
-#: v5 only the OPTIONAL ``context_blocks`` (same default) — no existing
-#: feature column changed meaning — so v2–v4 logs remain fully usable;
-#: anything else is skipped loudly in :meth:`fit`.
-ACCEPTED_SCHEMA_VERSIONS = frozenset({FEATURE_SCHEMA_VERSION, 4, 3, 2})
+#: (``decode_steps``/``prefill_tokens`` default to 0 when absent), v5
+#: only the OPTIONAL ``context_blocks`` (same default), and v6 only the
+#: OPTIONAL analytic-cost pair (``analytic_flops``/``analytic_bytes``
+#: from obs.attribution, same default) — no existing feature column
+#: changed meaning — so v2–v5 logs remain fully usable; anything else
+#: is skipped loudly in :meth:`fit`.
+ACCEPTED_SCHEMA_VERSIONS = frozenset({FEATURE_SCHEMA_VERSION, 5, 4, 3,
+                                      2})
 
 MODEL_VERSION = 1
 
@@ -106,10 +110,14 @@ def enabled() -> bool:
 
 def _row_features(row: dict) -> list[float] | None:
     """FeatureLog row → [1, bucket, batch, entity_kb, queue_depth,
-    decode_steps, prefill_tokens, context_blocks], or None when the row
-    cannot price a batch (no batch / no target). The generation fields
-    are v4+/v5-only and OPTIONAL — absent (older rows, non-generation
-    services) they train as 0, so old logs keep fitting unchanged."""
+    decode_steps, prefill_tokens, context_blocks, analytic_tflops,
+    analytic_gb], or None when the row cannot price a batch (no batch /
+    no target). The generation fields are v4+/v5-only and the analytic
+    pair v6-only — all OPTIONAL: absent (older rows, services without
+    them) they train as 0, so old logs keep fitting unchanged. The
+    analytic pair is rescaled to Tflops/GB so its weights live in the
+    same numeric range as the other columns (raw flops counts would
+    dominate the ridge penalty)."""
     try:
         batch = float(row.get("batch") or 0)
         if batch <= 0:
@@ -120,8 +128,11 @@ def _row_features(row: dict) -> list[float] | None:
         decode_steps = float(row.get("decode_steps") or 0.0)
         prefill_tokens = float(row.get("prefill_tokens") or 0.0)
         context_blocks = float(row.get("context_blocks") or 0.0)
+        analytic_tflops = float(row.get("analytic_flops") or 0.0) / 1e12
+        analytic_gb = float(row.get("analytic_bytes") or 0.0) / 1e9
         return [1.0, bucket, batch, ekb, depth, decode_steps,
-                prefill_tokens, context_blocks]
+                prefill_tokens, context_blocks, analytic_tflops,
+                analytic_gb]
     except (TypeError, ValueError):
         return None
 
@@ -319,8 +330,8 @@ class CostModel:
             mean[4] if queue_depth is None else float(queue_depth),
         ]
         # a model persisted before the v4 generation features has a
-        # 5-dim theta (and a pre-v5 one a 7-dim); only append what it
-        # was trained with
+        # 5-dim theta (pre-v5: 7-dim, pre-v6: 8-dim); only append what
+        # it was trained with
         if len(m["theta"]) > 5:
             feats.append(mean[5] if decode_steps is None
                          else float(decode_steps))
@@ -329,6 +340,12 @@ class CostModel:
         if len(m["theta"]) > 7:
             feats.append(mean[7] if context_blocks is None
                          else float(context_blocks))
+        if len(m["theta"]) > 8:
+            # the v6 analytic pair has no request-time override — the
+            # service's training mean (its compiled programs' cost)
+            # always fills in
+            feats.append(mean[8])
+            feats.append(mean[9])
         x = np.asarray(feats, np.float64)
         ms = float(x @ m["theta"])
         # a linear extrapolation can dip negative off the training

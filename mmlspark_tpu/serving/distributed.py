@@ -358,6 +358,14 @@ class DistributedServingServer(ServingServer):
         # their registry samples + pending spans here on the heartbeat
         # cadence, next to __lease__/__reply__ on the same listener
         self._routes[f"{base}/__fleet__"] = self._handle_fleet
+        # pod xprof fanout (obs.xprof, ISSUE 20): on a mesh worker, one
+        # capture POST also captures every registered peer through
+        # their __fleet__ endpoint — override the shared-state handler
+        # under BOTH keys the base class registered
+        self._query_routes["/debug/xprof"] = self._fanout_xprof_route
+        if base:
+            self._query_routes[f"{base}/debug/xprof"] = \
+                self._fanout_xprof_route
         self._monitor = threading.Thread(target=self._monitor_leases,
                                          daemon=True)
         self._load_reporter = threading.Thread(target=self._report_load,
@@ -456,6 +464,17 @@ class DistributedServingServer(ServingServer):
             _fleet_agg.ingest_snapshot(
                 snap, process=d.get("process"), worker=d.get("worker"),
                 channel="heartbeat")
+        xp = d.get("xprof")
+        if isinstance(xp, dict):
+            # xprof fanout leg (obs.xprof): a peer's capture request
+            # rides the fleet channel — run a LOCAL capture and answer
+            # with its result so the fanning-out worker can aggregate
+            # per-rank outcomes
+            from ..obs.xprof import xprof_captures
+            import urllib.parse as _up
+            q = _up.urlencode({k: xp[k] for k in ("duration_ms", "tag")
+                               if xp.get(k) not in (None, "")})
+            return xprof_captures.handle_query(q, b"")
         return 200, b'{"ok": true}'
 
     def _handle_lease(self, body: bytes) -> tuple[int, bytes]:
@@ -627,6 +646,64 @@ class DistributedServingServer(ServingServer):
             for wid in dead_lessees:
                 # dead lessee: drop its fleet source + keyed series
                 _fleet_agg.evict_worker(wid)
+
+    def _fanout_xprof_route(self, query: str,
+                            body: bytes) -> tuple[int, bytes]:
+        """``/debug/xprof`` with pod fanout: list/fetch stay local, but
+        a capture request (``duration_ms=``) also POSTs an ``xprof``
+        payload to every registered peer's ``__fleet__`` endpoint —
+        concurrently, while the local capture blocks for its duration —
+        so ONE request captures every rank into its own rank-suffixed
+        directory. Peer failures are itemized, never fatal: the local
+        capture's status decides the response code."""
+        import urllib.parse as _up
+        from ..obs.xprof import xprof_captures
+        q = _up.parse_qs(query or "")
+        if "duration_ms" not in q:
+            return xprof_captures.handle_query(query, body)
+        try:
+            duration_s = float(q["duration_ms"][0]) / 1e3
+        except (TypeError, ValueError, IndexError):
+            duration_s = 0.0
+        with self._lock:
+            peers = [i for wid, i in self._peers.items()
+                     if wid != self.worker_id]
+        payload = {"xprof": {"duration_ms": (q["duration_ms"] or [""])[0],
+                             "tag": (q.get("tag") or [""])[0]},
+                   "secret": self.mesh_secret}
+        results: dict[str, dict] = {}
+        lock = threading.Lock()
+
+        def _one(info: ServiceInfo) -> None:
+            base = "" if info.api_path == "/" else info.api_path
+            try:
+                status, resp = _post(info.host, info.port,
+                                     f"{base}/__fleet__", payload,
+                                     timeout=duration_s + 10.0)
+                try:
+                    parsed = json.loads(resp or b"{}")
+                except ValueError:
+                    parsed = {"raw": len(resp)}
+                entry = {"status": status, "result": parsed}
+            except Exception as e:
+                entry = {"status": 0, "error": repr(e)}
+            with lock:
+                results[info.worker_id] = entry
+
+        threads = [threading.Thread(target=_one, args=(i,), daemon=True)
+                   for i in peers]
+        for t in threads:
+            t.start()
+        status, local_body = xprof_captures.handle_query(query, body)
+        for t in threads:
+            t.join(timeout=duration_s + 15.0)
+        try:
+            local = json.loads(local_body)
+        except ValueError:
+            local = {"raw": len(local_body)}
+        out = {"worker": self.worker_id, "local_status": status,
+               "local": local, "peers": results}
+        return status, json.dumps(out, indent=1).encode()
 
     # -- cross-worker reply routing ----------------------------------------
     def reply_to(self, request_id: str, response: HTTPResponseData) -> bool:

@@ -77,6 +77,7 @@ from ..dl.paged_kv import (OutOfBlocks, PagedKVManager, gather_dense,
                            init_pools, paged_attention_enabled,
                            scatter_positions, take_positions)
 from ..obs import registry as _default_registry
+from ..obs.attribution import cost_attribution
 from ..obs.profile import compile_tracker, feature_log
 from ..sched.continuous import SlotScheduler
 
@@ -95,6 +96,32 @@ def _bucket_window(n: int) -> int:
     while p < n:
         p <<= 1
     return p
+
+
+def _attribute_warm(prog, service: str, *args) -> None:
+    """Analytic roofline attribution for a warmed program
+    (obs.attribution, ISSUE 20): re-lower the tracked jit AOT and read
+    ``cost_analysis`` off the Lowered (a trace, not a compile — the
+    compile only happens on JAX builds whose Lowered cannot answer).
+    Runs at warm time, before ``mark_steady``, so the extra trace never
+    counts as a runtime compile. Failures degrade silently: attribution
+    is telemetry, never a serving gate."""
+    lower = getattr(prog, "lower", None)
+    if lower is None:
+        return
+    name = getattr(prog, "__tracked_label__", f"llm_{service}")
+    try:
+        lowered = lower(*args)
+    except Exception:
+        return
+    if cost_attribution.record_compiled(
+            name, lowered, service=service) is not None:
+        return
+    try:
+        compiled = lowered.compile()
+    except Exception:
+        return
+    cost_attribution.record_compiled(name, compiled, service=service)
 
 
 def _encoder_key(module) -> dict:
@@ -432,13 +459,17 @@ class PrefillExecutor:
             w = _bucket_window(w)
             rows = jnp.zeros((P, self.max_blocks), jnp.int32)
             prog = self._program(w)
-            pools_t, pools_d, _ = prog(
+            args = (
                 self.variables["params"],
                 None if self.draft_module is None
                 else self.draft_variables["params"],
                 self.pools.target, self.pools.draft, rows,
                 jnp.zeros((P, w), jnp.int32), jnp.zeros(P, jnp.int32),
                 jnp.zeros(P, jnp.int32))
+            # attribution must lower BEFORE the call: donation
+            # invalidates the pool buffers the args reference
+            _attribute_warm(prog, self.service, *args)
+            pools_t, pools_d, _ = prog(*args)
             self.pools.target = pools_t
             if self.draft_module is not None:
                 self.pools.draft = pools_d
@@ -773,7 +804,7 @@ class DecodeExecutor:
         import jax.numpy as jnp
         prog = self._build()
         S = self.slots
-        pools_t, pools_d, *_ = prog(
+        args = (
             self.variables["params"],
             None if self.draft_module is None
             else self.draft_variables["params"],
@@ -781,6 +812,10 @@ class DecodeExecutor:
             jnp.zeros((S, self.max_blocks), jnp.int32),
             jnp.zeros(S, jnp.int32), jnp.ones(S, jnp.int32),
             jnp.full(S, 2, jnp.int32), jnp.zeros(S, bool))
+        # attribution must lower BEFORE the call: donation invalidates
+        # the pool buffers the args reference
+        _attribute_warm(prog, self.service, *args)
+        pools_t, pools_d, *_ = prog(*args)
         self.pools.target = pools_t
         if self.draft_module is not None:
             self.pools.draft = pools_d
@@ -887,6 +922,12 @@ class LLMEngine:
             "gen_spec_accept_ratio",
             "rolling fraction of offered draft tokens accepted, "
             "by service")
+        self._c_spec_rejected = reg.counter(
+            "gen_spec_rejected_total",
+            "offered draft tokens rejected at verification, by service "
+            "— target-model work the speculative gamble threw away "
+            "(the goodput ledger prices it at the measured "
+            "seconds-per-token)")
 
     # -- intake ------------------------------------------------------------
     def submit(self, seq_id, prompt, max_new_tokens: int,
@@ -939,6 +980,10 @@ class LLMEngine:
             if self.decoder.spec_k:
                 self._spec_acc[0] += n_acc
                 self._spec_acc[1] += self.decoder.spec_k
+                rejected = self.decoder.spec_k - n_acc
+                if rejected > 0:
+                    self._c_spec_rejected.inc(rejected,
+                                              service=self.service)
         if self._spec_acc[1]:
             self._g_accept.set(self._spec_acc[0] / self._spec_acc[1],
                                service=self.service)
@@ -995,6 +1040,7 @@ class LLMEngine:
         self.kv.release(seq_id)
         total_len = min(len(meta.prompt) + 1 + len(meta.generated),
                         len(meta.prompt) + meta.max_new_tokens)
+        a_flops, a_bytes = cost_attribution.service_cost(self.service)
         feature_log.record(
             service=self.service, route="decode",
             batch=self.decoder.slots,
@@ -1003,7 +1049,8 @@ class LLMEngine:
             decode_steps=meta.decode_steps,
             prefill_tokens=meta.prefill_tokens,
             context_blocks=-(-total_len // self.block_len),
-            execute_ms=(self.clock() - meta.t_submit) * 1e3)
+            execute_ms=(self.clock() - meta.t_submit) * 1e3,
+            analytic_flops=a_flops, analytic_bytes=a_bytes)
         # prompt + [prefill's first token] + decode commits, trimmed to
         # the budget (a final speculative burst can overshoot by 0 —
         # the decode step clamps — but trim defensively anyway)

@@ -29,6 +29,7 @@ import logging
 from ..core import DataFrame
 from ..io.http.schema import HTTPRequestData, HTTPResponseData
 from ..obs import registry as _obs
+from ..obs.attribution import cost_attribution as _cost_attribution
 from ..obs.export import debug_trace_payload, flight_recorder as _flight
 from ..obs.fleet import (fleet_aggregator as _fleet_agg,
                          fleet_health as _fleet_health)
@@ -305,6 +306,20 @@ class ServingServer:
         if self.api_path != "/":
             self._routes[f"{self.api_path}/debug/deploy"] = \
                 self._debug_deploy_route
+        # cost-attribution plane (obs.attribution/goodput/xprof, ISSUE
+        # 20): the goodput ledger report is a literal route; /debug/
+        # xprof is a QUERY route (list on empty query, capture on
+        # ``duration_ms=``, download on ``fetch=``) so one path serves
+        # the whole capture workflow on BOTH fronts. The distributed
+        # server overrides the xprof handler with the pod-fanout
+        # variant.
+        self._routes["/debug/goodput"] = self._debug_goodput_route
+        self._query_routes["/debug/xprof"] = self._debug_xprof_route
+        if self.api_path != "/":
+            self._routes[f"{self.api_path}/debug/goodput"] = \
+                self._debug_goodput_route
+            self._query_routes[f"{self.api_path}/debug/xprof"] = \
+                self._debug_xprof_route
         if tenancy is not None:
             _fleet_health.attach_tenancy(tenancy)
 
@@ -333,6 +348,22 @@ class ServingServer:
         payload = router.describe() if router is not None \
             else {"router": None}
         return 200, _json.dumps(payload, indent=1).encode()
+
+    def _debug_goodput_route(self, body: bytes) -> tuple[int, bytes]:
+        """``GET /debug/goodput``: tick the fleet goodput ledger
+        against the live registry and report the ratio plus the
+        itemized waste taxonomy (obs.goodput)."""
+        from ..obs.goodput import goodput_payload
+        return 200, goodput_payload()
+
+    def _debug_xprof_route(self, query: str,
+                           body: bytes) -> tuple[int, bytes]:
+        """``GET/POST /debug/xprof``: list captures (empty query),
+        run a bounded device-profiler capture (``?duration_ms=``), or
+        download one (``?fetch=``) — obs.xprof; degrades to 503 with a
+        reason when jax is absent rather than importing it."""
+        from ..obs.xprof import xprof_captures
+        return xprof_captures.handle_query(query, body)
 
     def attach_router(self, router) -> "ServingServer":
         """Attach a :class:`~mmlspark_tpu.serving.deploy.VersionRouter`:
@@ -768,6 +799,9 @@ class ServingQuery:
         # segments — i.e. device dispatches for the traced portion —
         # served this request; None = plain host path
         segments = getattr(self.transform_fn, "compiled_segments", None)
+        # schema v6 (ISSUE 20): the service's summed analytic cost from
+        # the attribution table — 0.0 until something compiled for it
+        a_flops, a_bytes = _cost_attribution.service_cost(self.name)
         for c in batch:
             sp = getattr(c, "span", None)
             if sp is not None:
@@ -792,6 +826,7 @@ class ServingQuery:
                 entity_bytes=len(getattr(c.request, "entity", b"")
                                  or b""),
                 compiled_segments=segments,
+                analytic_flops=a_flops, analytic_bytes=a_bytes,
                 trace_id=(sp.trace_id if sp is not None else None))
             if tenancy is not None and tenant:
                 # the tenant's EWMA latency (queue + execute — what the

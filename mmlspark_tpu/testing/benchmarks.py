@@ -1952,7 +1952,8 @@ def regression_chaos_scenario(*, service: str = "regression-bench",
                          service=service, store=store)
     health.attach_sentinel(sent)
     g_mfu = reg.gauge("profile_mfu", "model FLOP utilization, by stage")
-    peak_flops = 1.0e12
+    from ..obs.attribution import peak_spec
+    peak_flops = peak_spec("cpu").peak_flops   # the 1 Tflop/s cpu row
     flops_per_step = base_step_s * peak_flops * 0.42   # healthy MFU 0.42
 
     rules = []
@@ -2745,4 +2746,200 @@ def rollout_scenario(*, service: str = "rollout-bench", seed: int = 29,
         "workers_peak": peak,
         "autoscaled": bool(peak >= 2),
         "schedule": sorted(schedule),
+    }
+
+
+# ---------------------------------------------- cost attribution plane
+def synth_attribution_rows(n_rows: int = 1200, *, seed: int = 29,
+                           service: str = "attr-bench") -> list[dict]:
+    """Schema-v6 FeatureLog-shaped rows where part of the cost rides
+    the ANALYTIC columns: each row's ``analytic_flops``/``analytic_
+    bytes`` vary with the program variant that served it (seeded,
+    independent of the other features), and ``execute_ms`` includes a
+    per-Tflop term — the signal only a v6-aware model can price.
+    Deterministic: two calls with one seed produce identical rows."""
+    import numpy as np
+
+    from ..obs.profile import FEATURE_SCHEMA_VERSION
+    from ..sched.policy import bucket_of
+
+    rng = np.random.default_rng(seed)
+    routes = {"/feat": (0.8, 0.05), "/gen": (5.0, 0.40)}
+    names = sorted(routes)
+    ms_per_tflop = 2.5
+    rows = []
+    for _ in range(n_rows):
+        route = names[int(rng.integers(0, len(names)))]
+        base, per_row = routes[route]
+        batch = int(rng.integers(1, 65))
+        bucket = bucket_of(batch)
+        depth = float(max(rng.normal(8.0, 4.0), 0.0))
+        tflops = float(rng.uniform(0.2, 6.0))
+        gbytes = tflops * float(rng.uniform(0.05, 0.15))
+        ms = (base + per_row * bucket + ms_per_tflop * tflops
+              + float(rng.normal(0.0, 0.15)))
+        rows.append({
+            "service": service, "route": route, "batch": batch,
+            "bucket": bucket, "padded_batch": bucket,
+            "entity_bytes": 1024.0, "queue_depth": depth,
+            "execute_ms": max(ms, 0.05),
+            "analytic_flops": tflops * 1e12,
+            "analytic_bytes": gbytes * 1e9,
+            "schema_version": FEATURE_SCHEMA_VERSION,
+            "platform": "synthetic",
+        })
+    return rows
+
+
+def attribution_scenario(*, seed: int = 29, n_rows: int = 1200,
+                         holdout: float = 0.25, ticks: int = 12,
+                         registry=None) -> dict:
+    """Cost-attribution acceptance (ISSUE 20), three banked pieces:
+
+    1. **Roofline placement** — two real programs compiled on the
+       analytic path (a 256x256 matmul and a wide elementwise add),
+       cost-analyzed and placed against the CPU :class:`PeakSpec`: the
+       matmul must read compute-bound, the add memory-bound, and every
+       utilization share <= 1.0 by construction.
+    2. **Goodput under seeded chaos** — a private registry is driven
+       through a deterministic tick schedule (useful step seconds
+       every tick; seeded waste bursts: spec rejects, eager fallbacks,
+       sheds, expirations, a runtime compile, a straggler window) and
+       a :class:`~..obs.goodput.GoodputLedger` prices it. Banked: the
+       final ratio, the itemized waste taxonomy, and the per-tick
+       ratio trace (bit-identical per seed).
+    3. **v6 model value** — the ridge cost model trained on rows whose
+       cost partly rides the analytic columns must beat (or match) the
+       SAME model trained with those columns stripped (the v5
+       baseline) on held-out MAE.
+    """
+    import numpy as np
+
+    from ..obs.attribution import CostAttribution, peak_spec
+    from ..obs.goodput import GoodputLedger, WASTE_CAUSES
+    from ..obs.metrics import MetricsRegistry
+    from ..perf.costmodel import CostModel
+
+    # -- 1: roofline placement off real compiled programs ---------------
+    reg = registry if registry is not None else MetricsRegistry()
+    attr = CostAttribution(registry=reg)
+    rooflines: dict[str, dict] = {}
+    import jax
+    import jax.numpy as jnp
+
+    a = jnp.ones((256, 256), jnp.float32)
+    big = jnp.ones((4, 1 << 20), jnp.float32)
+    programs = {
+        "attr_matmul_256": jax.jit(lambda m: m @ m).lower(a).compile(),
+        "attr_add_wide": jax.jit(lambda v: v + 1.0).lower(big).compile(),
+    }
+    for name, compiled in programs.items():
+        info = attr.record_compiled(name, compiled,
+                                    service="attr-bench",
+                                    platform="cpu")
+        if info is not None:
+            rooflines[name] = {
+                "bound": info["bound"],
+                "flops": info["flops"],
+                "bytes": info["bytes"],
+                "utilization_compute": round(
+                    info["compute_seconds"]
+                    / max(info["roofline_seconds"], 1e-18), 6),
+                "utilization_memory": round(
+                    info["memory_seconds"]
+                    / max(info["roofline_seconds"], 1e-18), 6),
+            }
+
+    # -- 2: goodput ledger under a seeded chaos schedule -----------------
+    rng = np.random.default_rng(seed)
+    greg = MetricsRegistry()
+    ledger = GoodputLedger(registry=greg)
+    h_step = greg.histogram("profile_step_seconds", "synthetic steps")
+    h_decode = greg.histogram("gen_decode_attn_seconds", "synthetic")
+    h_compile = greg.histogram("profile_compile_seconds", "synthetic")
+    c_tokens = greg.counter("gen_tokens_total", "synthetic")
+    c_spec = greg.counter("gen_spec_rejected_total", "synthetic")
+    c_fallback = greg.counter("pipeline_fused_fallback_total",
+                              "synthetic")
+    c_shed = greg.counter("sched_shed_total", "synthetic")
+    c_expired = greg.counter("sched_continuous_expired_total",
+                             "synthetic")
+    c_compiles = greg.counter("profile_runtime_compiles_total",
+                              "synthetic")
+    g_straggler = greg.gauge("fleet_straggler_score", "synthetic")
+    ledger.tick()  # baseline
+    ratio_trace = []
+    for t in range(ticks):
+        h_step.observe(0.010, stage="train")
+        for _ in range(8):
+            h_decode.observe(0.002, service="attr-bench")
+            c_tokens.inc(1, service="attr-bench")
+        if rng.random() < 0.5:
+            c_spec.inc(int(rng.integers(1, 6)), service="attr-bench")
+        if rng.random() < 0.3:
+            c_fallback.inc(1, segment="seg0")
+        if rng.random() < 0.3:
+            c_shed.inc(int(rng.integers(1, 4)), reason="backpressure")
+        if rng.random() < 0.2:
+            c_expired.inc(1, service="attr-bench")
+        if t == ticks // 2:
+            c_compiles.inc(1, fn="late_fn")
+            h_compile.observe(0.5, fn="late_fn")
+        g_straggler.set(3.0 if t >= ticks - 3 else 0.0, worker="w1")
+        payload = ledger.tick()
+        ratio_trace.append(round(payload["goodput_ratio"], 6))
+    waste = {c: round(payload["waste_seconds"][c], 6)
+             for c in WASTE_CAUSES}
+
+    # -- 3: v6 analytic columns vs the v5 baseline on held-out MAE -------
+    service = "attr-bench"
+    rows = synth_attribution_rows(n_rows, seed=seed, service=service)
+    n_train = int(len(rows) * (1.0 - holdout))
+    train, held = rows[:n_train], rows[n_train:]
+    stripped = [{k: v for k, v in r.items()
+                 if k not in ("analytic_flops", "analytic_bytes")}
+                for r in train]
+    m_v6 = CostModel(min_rows=32, registry=MetricsRegistry())
+    m_v6.fit(train)
+    m_v5 = CostModel(min_rows=32, registry=MetricsRegistry())
+    m_v5.fit(stripped)
+    v6_abs, v5_abs = [], []
+    for r in held:
+        actual = r["execute_ms"]
+        for model, acc in ((m_v6, v6_abs), (m_v5, v5_abs)):
+            pred = model.predict_batch_ms(
+                service, r["batch"], route=r["route"],
+                entity_bytes=r["entity_bytes"],
+                queue_depth=r["queue_depth"], count=False)
+            if pred is not None:
+                acc.append(abs(pred - actual))
+    v6_mae = sum(v6_abs) / len(v6_abs) if v6_abs else float("nan")
+    v5_mae = sum(v5_abs) / len(v5_abs) if v5_abs else float("nan")
+
+    return {
+        "seed": seed,
+        "platform_spec": {
+            "platform": peak_spec("cpu").platform,
+            "peak_flops": peak_spec("cpu").peak_flops,
+            "hbm_bytes_per_s": peak_spec("cpu").hbm_bytes_per_s,
+        },
+        "rooflines": rooflines,
+        "matmul_compute_bound": bool(
+            rooflines.get("attr_matmul_256", {}).get("bound")
+            == "compute"),
+        "add_memory_bound": bool(
+            rooflines.get("attr_add_wide", {}).get("bound")
+            == "memory"),
+        "utilization_max": max(
+            [u for r in rooflines.values()
+             for u in (r["utilization_compute"],
+                       r["utilization_memory"])], default=0.0),
+        "goodput_ratio": ratio_trace[-1] if ratio_trace else None,
+        "goodput_ratio_trace": ratio_trace,
+        "goodput_waste_seconds": waste,
+        "goodput_waste_itemized": bool(
+            sum(1 for v in waste.values() if v > 0) >= 4),
+        "v6_mae_ms": v6_mae,
+        "v5_mae_ms": v5_mae,
+        "v6_no_worse": bool(v6_mae <= v5_mae * 1.001),
     }

@@ -30,7 +30,11 @@ from __future__ import annotations
 import json
 import time
 
-V5E_PEAK_BF16_FLOPS = 197e12  # per-chip peak, TPU v5e (bench.py's)
+from ..obs.attribution import peak_spec as _peak_spec
+
+# per-chip bf16 peak from the shared PeakSpec table (bench.py's —
+# env-overridable via MMLSPARK_TPU_PEAK_FLOPS)
+V5E_PEAK_BF16_FLOPS = _peak_spec("tpu-v5e").peak_flops
 
 
 def _min_time(fn, reps: int = 3) -> float:
@@ -78,14 +82,16 @@ def _bert_step_ips(devices, per_device_batch: int, iters: int = 4):
                                        fetch="pooled")
     flops_per_image = 0.0
     try:
-        cost = step.lower(state, ids, labels).compile().cost_analysis()
-        if isinstance(cost, (list, tuple)):
-            cost = cost[0]
-        # sharded programs report per-device flops: scale back to the
-        # global batch so flops/image is mesh-size-independent
-        flops_per_image = float(cost.get("flops", 0.0)) * n / B
+        compiled = step.lower(state, ids, labels).compile()
     except Exception:
-        pass
+        compiled = None
+    if compiled is not None:
+        from ..parallel.compat import cost_analysis
+        cost = cost_analysis(compiled)
+        if cost is not None:
+            # sharded programs report per-device flops: scale back to
+            # the global batch so flops/image is mesh-size-independent
+            flops_per_image = cost["flops"] * n / B
     box = {"s": state}
 
     def run():

@@ -295,6 +295,88 @@ def fleet_telemetry(payload: dict) -> dict:
     })
 
 
+def xprof_fanout(payload: dict) -> dict:
+    """The pod xprof-capture fanout acceptance body (ISSUE 20): every
+    rank runs a mesh-registered :class:`DistributedServingServer`
+    (ports pinned by the payload — the launcher picks free ones); once
+    the registry table holds every rank, rank 0 POSTs its OWN
+    ``/debug/xprof?duration_ms=`` and the fanout handler must capture
+    every OTHER rank over ``__fleet__`` while capturing locally. Each
+    rank returns its local capture listing — the launcher asserts one
+    rank-suffixed capture directory per rank from the single POST."""
+    import http.client
+    import json as _json
+
+    import jax
+    import jax.numpy as jnp
+
+    from ..obs.xprof import xprof_captures
+    from ..serving.distributed import (DistributedServingServer,
+                                       DriverRegistry, RegistryClient)
+
+    idx, cnt = this_process()
+    registry_port = int(payload["registry_port"])
+    worker_ports = [int(p) for p in payload["worker_ports"]]
+    duration_ms = float(payload.get("duration_ms", 100.0))
+    service = str(payload.get("service", "xprof-pod"))
+    deadline = time.monotonic() + float(payload.get("timeout_s", 30.0))
+
+    # a live backend is the capture precondition (_jax_ready): touch it
+    jax.block_until_ready(jnp.zeros(1))
+
+    driver = None
+    if idx == 0:
+        driver = DriverRegistry(port=registry_port,
+                                heartbeat_timeout=0).start()
+    client = RegistryClient(("127.0.0.1", registry_port))
+    while time.monotonic() < deadline:
+        try:
+            client.workers(service)
+            break
+        except Exception:
+            time.sleep(0.05)
+    server = DistributedServingServer(
+        service, ("127.0.0.1", registry_port), worker_id=f"rank{idx}",
+        port=worker_ports[idx], load_report_interval=0.1).start()
+    out: dict = {"process": idx, "worker_id": f"rank{idx}"}
+    try:
+        # every rank waits for the full table (fanout needs peers)
+        while time.monotonic() < deadline:
+            with server._lock:
+                n = len(server._peers)
+            if n >= cnt:
+                break
+            time.sleep(0.05)
+        if idx == 0:
+            conn = http.client.HTTPConnection(
+                "127.0.0.1", worker_ports[0],
+                timeout=duration_ms / 1e3 + 20.0)
+            try:
+                conn.request("POST",
+                             f"/debug/xprof?duration_ms={duration_ms}"
+                             f"&tag=pod")
+                resp = conn.getresponse()
+                out["fanout_status"] = resp.status
+                out["fanout"] = _json.loads(resp.read())
+            finally:
+                conn.close()
+        else:
+            # the fanout's __fleet__ leg runs the capture on THIS
+            # rank's handler thread; wait until it lands on disk
+            while time.monotonic() < deadline:
+                if xprof_captures.list_captures()["captures"]:
+                    break
+                time.sleep(0.05)
+    finally:
+        server.stop()
+        if driver is not None:
+            driver.stop()
+    listing = xprof_captures.list_captures()
+    out["captures"] = [c["capture"] for c in listing["captures"]]
+    out["capture_root"] = listing["root"]
+    return out
+
+
 def collective_bytes(payload: dict) -> dict:
     """An explicit cross-host allreduce through the instrumented
     ``parallel.collectives`` wrapper: the GSPMD-inserted collectives of

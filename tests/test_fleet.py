@@ -824,13 +824,14 @@ class TestCostModelSchemaWindow:
         from mmlspark_tpu.perf.costmodel import (
             ACCEPTED_SCHEMA_VERSIONS, CostModel)
 
-        assert FEATURE_SCHEMA_VERSION == 5
-        assert ACCEPTED_SCHEMA_VERSIONS == {2, 3, 4, 5}
+        assert FEATURE_SCHEMA_VERSION == 6
+        assert ACCEPTED_SCHEMA_VERSIONS == {2, 3, 4, 5, 6}
         reg = MetricsRegistry()
         model = CostModel(min_rows=16, registry=reg)
         used = model.fit(self._rows(2, 20) + self._rows(3, 20)
-                         + self._rows(4, 10) + self._rows(5, 10))
-        assert used == 60
+                         + self._rows(4, 10) + self._rows(5, 10)
+                         + self._rows(6, 10))
+        assert used == 70
         assert reg.snapshot().get(
             'sched_costmodel_skipped_rows_total{reason="schema"}') \
             is None
@@ -851,7 +852,7 @@ class TestCostModelSchemaWindow:
         log = FeatureLog(maxlen=4, registry=MetricsRegistry())
         log.record(service="s", batch=2)
         row = log.snapshot()[-1]
-        assert row["schema_version"] == 5
+        assert row["schema_version"] == 6
         assert "process" in row          # None single-process, a rank
         assert row["process"] is None    # index string on a pod
 

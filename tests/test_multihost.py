@@ -368,6 +368,31 @@ class TestTwoProcessPod:
         assert 'profile_step_seconds_count{' in text
         assert 'process="0"' in text and 'process="1"' in text
 
+    def test_xprof_fanout_captures_every_rank(self):
+        """One ``POST /debug/xprof?duration_ms=`` on rank 0's mesh
+        server captures BOTH ranks (ISSUE 20): the fanout handler runs
+        the local capture and posts the xprof payload to the peer over
+        ``__fleet__``, so each rank's process ends up with exactly one
+        rank-suffixed capture directory."""
+        args = {"registry_port": multihost.free_port(),
+                "worker_ports": [multihost.free_port(),
+                                 multihost.free_port()],
+                "duration_ms": 100.0, "timeout_s": 60.0}
+        results = multihost.launch_pod(
+            f"{self.SCEN}:xprof_fanout", num_processes=2,
+            local_devices=1, args=args, timeout=240, extra_path=REPO)
+        assert [r["process"] for r in results] == [0, 1]
+        r0 = results[0]
+        assert r0["fanout_status"] == 200, r0
+        assert r0["fanout"]["local"]["capture"].endswith("-r0")
+        peer = r0["fanout"]["peers"]["rank1"]
+        assert peer["status"] == 200, peer
+        assert peer["result"]["capture"].endswith("-r1")
+        # one capture directory per rank, rank-suffixed, from ONE POST
+        for rank, r in enumerate(results):
+            assert len(r["captures"]) == 1, r
+            assert r["captures"][0].endswith(f"-r{rank}")
+
     def test_collective_bytes_carry_process_label(self):
         results = multihost.launch_pod(
             f"{self.SCEN}:collective_bytes", num_processes=2,

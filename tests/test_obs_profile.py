@@ -326,7 +326,9 @@ class TestStepProfiler:
                     'stage="matmul"}'] == 1
         assert snap['profile_step_seconds_count{phase="dispatch",'
                     'stage="matmul"}'] == 1
-        assert snap['profile_mfu{stage="matmul"}'] > 0
+        # the MFU gauge carries the PeakSpec platform it was computed
+        # against (obs.attribution) — tier-1 pins JAX_PLATFORMS=cpu
+        assert snap['profile_mfu{platform="cpu",stage="matmul"}'] > 0
         spans = {s["name"]: s for s in col.spans()}
         assert spans["profile.dispatch"]["traceId"] == root.trace_id
         assert spans["profile.dispatch"]["parentId"] == root.span_id

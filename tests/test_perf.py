@@ -618,7 +618,7 @@ class TestCostModelContextBlocks:
         rows = self._rows()
         assert m.fit(rows) == len(rows)
         theta = next(iter(m._models.values()))["theta"]
-        assert len(theta) == 8
+        assert len(theta) == 10
         hi = m.predict_batch_ms(SVC, 16, route="/feat",
                                 entity_bytes=64 * 1024, queue_depth=4,
                                 context_blocks=64)
@@ -638,10 +638,10 @@ class TestCostModelContextBlocks:
         assert reg.snapshot().get(
             'sched_costmodel_skipped_rows_total{reason="schema"}') \
             is None
-        # absent context_blocks trained as 0 → theta still 8-dim and
-        # the kwarg is accepted at predict time
+        # absent context_blocks/analytic pair trained as 0 → theta
+        # still full-width and the kwarg is accepted at predict time
         theta = next(iter(m._models.values()))["theta"]
-        assert len(theta) == 8
+        assert len(theta) == 10
         assert m.predict_batch_ms(SVC, 8, route="/feat",
                                   entity_bytes=32 * 1024,
                                   queue_depth=2,
